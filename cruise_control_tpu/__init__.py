@@ -1,0 +1,18 @@
+"""cruise-control-tpu: a TPU-native Kafka cluster balancer.
+
+A brand-new framework with the capabilities of Kafka Cruise Control
+(reference: cawright-rh/cruise-control), re-designed TPU-first:
+
+- cluster state lives in dense JAX arrays (``model/``),
+- goal scoring is a vmap'd kernel over thousands of candidate actions
+  (``analyzer/goals/``),
+- the rebalance search is a jitted fixed-point loop, shardable over a
+  ``jax.sharding.Mesh`` (``analyzer/search.py``, ``parallel/``),
+- monitoring, execution, anomaly detection and the REST surface are
+  host-side async services around that solver core
+  (``monitor/``, ``executor/``, ``detector/``, ``api/``).
+
+Reference layer map: see SURVEY.md §1 (cruise-control/src/main/java/...).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from .resources import Resource, NUM_RESOURCES, EPSILON_PERCENT
+from .broker_state import BrokerState, DiskState
+from .action import ActionType, ActionAcceptance

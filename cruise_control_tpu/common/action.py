@@ -1,0 +1,32 @@
+"""Balancing action taxonomy.
+
+Reference parity: analyzer/common/ActionType.java (INTER_BROKER_REPLICA_MOVEMENT,
+LEADERSHIP_MOVEMENT, INTER_BROKER_REPLICA_SWAP, INTRA_BROKER_REPLICA_MOVEMENT,
+INTRA_BROKER_REPLICA_SWAP) and ActionAcceptance.java (ACCEPT, REPLICA_REJECT,
+BROKER_REJECT).
+
+In the tensor solver a candidate action is a row of integers
+``(action_type, partition, src_slot, dst_broker, dst_slot_partition)`` and
+acceptance is a vectorized tri-state int8 array over candidates.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class ActionType(enum.IntEnum):
+    INTER_BROKER_REPLICA_MOVEMENT = 0
+    LEADERSHIP_MOVEMENT = 1
+    INTER_BROKER_REPLICA_SWAP = 2
+    INTRA_BROKER_REPLICA_MOVEMENT = 3
+    INTRA_BROKER_REPLICA_SWAP = 4
+
+
+class ActionAcceptance(enum.IntEnum):
+    """Tri-state acceptance; BROKER_REJECT prunes the destination broker for
+    the remainder of a swap search (AbstractGoal.java:332-335)."""
+
+    ACCEPT = 0
+    REPLICA_REJECT = 1
+    BROKER_REJECT = 2

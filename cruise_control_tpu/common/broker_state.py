@@ -1,0 +1,25 @@
+"""Broker / disk liveness states.
+
+Reference parity: model/Broker.java:37 ``State {ALIVE, DEAD, NEW, DEMOTED,
+BAD_DISKS}`` and model/Disk.java:32 ``State {ALIVE, DEAD}``.
+
+Encoded as small ints so the tensor model can carry a ``broker_state[B]``
+int8 array and goal kernels can build masks with simple comparisons.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class BrokerState(enum.IntEnum):
+    ALIVE = 0
+    DEAD = 1
+    NEW = 2
+    DEMOTED = 3
+    BAD_DISKS = 4
+
+
+class DiskState(enum.IntEnum):
+    ALIVE = 0
+    DEAD = 1

@@ -1,0 +1,48 @@
+"""Resource taxonomy for broker/partition load accounting.
+
+Reference parity: cruise-control/src/main/java/com/linkedin/kafka/
+cruisecontrol/common/Resource.java (CPU, NW_IN, NW_OUT, DISK with
+per-resource epsilon and balancing eligibility).
+
+In the tensor model a resource is an integer axis index into the trailing
+``R`` dimension of load/capacity arrays, so goal kernels can be written once
+and specialised per resource by indexing.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Resource(enum.IntEnum):
+    """Axis indices of the resource dimension in load/capacity tensors."""
+
+    CPU = 0
+    NW_IN = 1
+    NW_OUT = 2
+    DISK = 3
+
+    @property
+    def is_host_resource(self) -> bool:
+        # Reference: Resource.java — CPU, NW_IN, NW_OUT are host resources.
+        return self in (Resource.CPU, Resource.NW_IN, Resource.NW_OUT)
+
+    @property
+    def is_broker_resource(self) -> bool:
+        return True
+
+
+NUM_RESOURCES = len(Resource)
+
+# Reference: Resource.java:28-31 — epsilon chosen so that summing ~800k
+# replica float loads stays within precision; we use float32 on device and
+# the same relative epsilon for comparisons.
+EPSILON_PERCENT = 0.0008
+
+# Per-resource epsilon scale (mirrors Resource.java per-resource epsilon()).
+RESOURCE_EPSILON = {
+    Resource.CPU: 1e-4,
+    Resource.NW_IN: 1e-2,
+    Resource.NW_OUT: 1e-2,
+    Resource.DISK: 1e-2,
+}

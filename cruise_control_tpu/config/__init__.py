@@ -1,0 +1,3 @@
+from .configdef import ConfigDef, ConfigType, Importance, Range, ValidString, ConfigException
+from .abstract_config import AbstractConfig
+from .cruise_control_config import CruiseControlConfig

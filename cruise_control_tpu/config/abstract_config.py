@@ -1,0 +1,101 @@
+"""AbstractConfig: typed access + plugin instantiation.
+
+Reference parity: cruise-control-core .../common/config/AbstractConfig.java
+(typed getters, ``getConfiguredInstance`` reflection-based plugin loading).
+Python version loads plugins by dotted import path and passes the config to
+a ``configure(config)`` method when the plugin defines one — mirroring the
+reference's ``CruiseControlConfigurable.configure(Map)`` contract.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Mapping
+
+from .configdef import ConfigDef, ConfigException
+
+
+def resolve_class(spec: Any):
+    """Resolve a class from a dotted ``pkg.module.ClassName`` path (or pass
+    through an already-resolved class/callable)."""
+    if not isinstance(spec, str):
+        return spec
+    module_name, _, attr = spec.rpartition(".")
+    if not module_name:
+        raise ConfigException(f"not a dotted class path: {spec!r}")
+    try:
+        module = importlib.import_module(module_name)
+        return getattr(module, attr)
+    except (ImportError, AttributeError) as exc:
+        raise ConfigException(f"cannot load class {spec!r}: {exc}") from exc
+
+
+class AbstractConfig:
+    def __init__(self, definition: ConfigDef, props: Mapping[str, Any]):
+        self._definition = definition
+        self._props = dict(props)
+        self._values = definition.parse(props)
+        # Keys present in props but not defined are retained for plugins
+        # (originals()), matching AbstractConfig.java behavior.
+        defined = set(definition.names)
+        self._unused = {k: v for k, v in self._props.items() if k not in defined}
+
+    def originals(self) -> dict[str, Any]:
+        return dict(self._props)
+
+    def values(self) -> dict[str, Any]:
+        return dict(self._values)
+
+    def get(self, name: str) -> Any:
+        if name not in self._values:
+            raise ConfigException(f"unknown config {name!r}")
+        return self._values[name]
+
+    # Typed getters mirroring AbstractConfig.java
+    def get_int(self, name: str) -> int:
+        return self.get(name)
+
+    def get_long(self, name: str) -> int:
+        return self.get(name)
+
+    def get_double(self, name: str) -> float:
+        return self.get(name)
+
+    def get_boolean(self, name: str) -> bool:
+        return self.get(name)
+
+    def get_string(self, name: str) -> str:
+        return self.get(name)
+
+    def get_list(self, name: str) -> list[str]:
+        return self.get(name)
+
+    def get_configured_instance(self, name: str, expected_type: type | None = None, **kwargs) -> Any:
+        """Instantiate the plugin class named by config ``name`` and configure
+        it (AbstractConfig.getConfiguredInstance)."""
+        spec = self.get(name)
+        if spec is None:
+            return None
+        return self._make_instance(name, spec, expected_type, kwargs)
+
+    def get_configured_instances(self, name: str, expected_type: type | None = None, **kwargs) -> list[Any]:
+        specs = self.get(name) or []
+        return [self._make_instance(name, spec, expected_type, kwargs) for spec in specs]
+
+    def _make_instance(self, name: str, spec: Any, expected_type: type | None,
+                       extra: Mapping[str, Any]) -> Any:
+        cls = resolve_class(spec)
+        instance = cls()
+        if expected_type is not None and not isinstance(instance, expected_type):
+            raise ConfigException(
+                f"{name}: {cls!r} is not an instance of {expected_type!r}")
+        self._configure(instance, extra)
+        return instance
+
+    def _configure(self, instance: Any, extra: Mapping[str, Any]) -> None:
+        configure = getattr(instance, "configure", None)
+        if callable(configure):
+            merged = dict(self._values)
+            merged.update(self._unused)
+            merged.update(extra)
+            configure(merged)

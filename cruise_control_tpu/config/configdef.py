@@ -1,0 +1,212 @@
+"""Kafka-style typed configuration definitions.
+
+Reference parity: cruise-control-core/src/main/java/com/linkedin/
+cruisecontrol/common/config/ConfigDef.java — typed keys with defaults,
+validators, importance and documentation; parse() validates and coerces a
+raw ``{name: value}`` map.
+
+This is a fresh Python design (dataclasses, no reflection); plugin loading
+uses dotted import paths instead of Java class reflection
+(AbstractConfig.getConfiguredInstance).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, Iterable, Mapping
+
+
+class ConfigException(ValueError):
+    """Invalid configuration key/value (ConfigException.java equivalent)."""
+
+
+class ConfigType(enum.Enum):
+    BOOLEAN = "boolean"
+    STRING = "string"
+    INT = "int"
+    LONG = "long"
+    DOUBLE = "double"
+    LIST = "list"
+    CLASS = "class"
+    PASSWORD = "password"
+
+
+class Importance(enum.Enum):
+    HIGH = "high"
+    MEDIUM = "medium"
+    LOW = "low"
+
+
+_NO_DEFAULT = object()
+
+
+class Password:
+    """Opaque wrapper that hides secrets from str()/repr()
+    (core types/Password.java)."""
+
+    def __init__(self, value: str):
+        self.value = value
+
+    def __repr__(self) -> str:  # pragma: no cover - trivial
+        return "[hidden]"
+
+    __str__ = __repr__
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Password) and other.value == self.value
+
+    def __hash__(self) -> int:
+        return hash(self.value)
+
+
+@dataclasses.dataclass
+class Range:
+    """Numeric range validator (ConfigDef.Range)."""
+
+    min: float | None = None
+    max: float | None = None
+
+    def __call__(self, name: str, value: Any) -> None:
+        if value is None:
+            return
+        if self.min is not None and value < self.min:
+            raise ConfigException(f"{name}: value {value} below minimum {self.min}")
+        if self.max is not None and value > self.max:
+            raise ConfigException(f"{name}: value {value} above maximum {self.max}")
+
+    @classmethod
+    def at_least(cls, lo: float) -> "Range":
+        return cls(min=lo)
+
+    @classmethod
+    def between(cls, lo: float, hi: float) -> "Range":
+        return cls(min=lo, max=hi)
+
+
+@dataclasses.dataclass
+class ValidString:
+    """Enumerated-string validator (ConfigDef.ValidString)."""
+
+    allowed: tuple[str, ...] = ()
+
+    def __call__(self, name: str, value: Any) -> None:
+        if value is not None and value not in self.allowed:
+            raise ConfigException(
+                f"{name}: value {value!r} not in allowed set {self.allowed}")
+
+
+@dataclasses.dataclass
+class ConfigKey:
+    name: str
+    type: ConfigType
+    default: Any
+    validator: Callable[[str, Any], None] | None
+    importance: Importance
+    doc: str
+
+    @property
+    def has_default(self) -> bool:
+        return self.default is not _NO_DEFAULT
+
+
+def _parse_bool(name: str, value: Any) -> bool:
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, str):
+        low = value.strip().lower()
+        if low == "true":
+            return True
+        if low == "false":
+            return False
+    raise ConfigException(f"{name}: expected boolean, got {value!r}")
+
+
+def _parse_list(name: str, value: Any) -> list[str]:
+    if value is None:
+        return []
+    if isinstance(value, str):
+        return [v.strip() for v in value.split(",") if v.strip()]
+    if isinstance(value, (list, tuple)):
+        return [str(v) for v in value]
+    raise ConfigException(f"{name}: expected list, got {value!r}")
+
+
+class ConfigDef:
+    """A registry of typed config keys; ``parse`` coerces + validates a raw
+    mapping into a plain dict with defaults applied."""
+
+    def __init__(self) -> None:
+        self._keys: dict[str, ConfigKey] = {}
+
+    def define(
+        self,
+        name: str,
+        type: ConfigType,
+        default: Any = _NO_DEFAULT,
+        validator: Callable[[str, Any], None] | None = None,
+        importance: Importance = Importance.MEDIUM,
+        doc: str = "",
+    ) -> "ConfigDef":
+        if name in self._keys:
+            raise ConfigException(f"duplicate config key {name!r}")
+        self._keys[name] = ConfigKey(name, type, default, validator, importance, doc)
+        return self
+
+    def merge(self, other: "ConfigDef") -> "ConfigDef":
+        for key in other._keys.values():
+            if key.name not in self._keys:
+                self._keys[key.name] = key
+        return self
+
+    @property
+    def names(self) -> Iterable[str]:
+        return self._keys.keys()
+
+    def key(self, name: str) -> ConfigKey:
+        return self._keys[name]
+
+    def parse(self, props: Mapping[str, Any]) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for name, key in self._keys.items():
+            if name in props and props[name] is not None:
+                value = self._coerce(key, props[name])
+            elif key.has_default:
+                value = self._coerce(key, key.default) if key.default is not None else None
+            else:
+                raise ConfigException(f"missing required config {name!r}")
+            if key.validator is not None:
+                key.validator(name, value)
+            out[name] = value
+        return out
+
+    @staticmethod
+    def _coerce(key: ConfigKey, value: Any) -> Any:
+        if value is None:
+            return None
+        t = key.type
+        name = key.name
+        try:
+            if t is ConfigType.BOOLEAN:
+                return _parse_bool(name, value)
+            if t in (ConfigType.INT, ConfigType.LONG):
+                if isinstance(value, bool):
+                    raise ConfigException(f"{name}: expected int, got bool")
+                return int(value)
+            if t is ConfigType.DOUBLE:
+                if isinstance(value, bool):
+                    raise ConfigException(f"{name}: expected double, got bool")
+                return float(value)
+            if t is ConfigType.LIST:
+                return _parse_list(name, value)
+            if t is ConfigType.STRING:
+                return str(value)
+            if t is ConfigType.CLASS:
+                return value  # dotted path string or callable/class object
+            if t is ConfigType.PASSWORD:
+                return value if isinstance(value, Password) else Password(str(value))
+        except ConfigException:
+            raise
+        except (TypeError, ValueError) as exc:
+            raise ConfigException(f"{name}: cannot coerce {value!r} to {t.value}") from exc
+        raise ConfigException(f"{name}: unknown type {t}")

@@ -1,0 +1,291 @@
+"""The merged framework configuration.
+
+Reference parity: config/KafkaCruiseControlConfig.java (merges
+MonitorConfig / AnalyzerConfig / ExecutorConfig / AnomalyDetectorConfig /
+WebServerConfig / UserTaskManagerConfig constants and performs cross-field
+sanity checks such as hard-goals ⊆ goals). Defaults follow
+config/cruisecontrol.properties.
+
+The goal class names here are dotted paths into
+``cruise_control_tpu.analyzer.goals`` — the TPU-native goal kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from .abstract_config import AbstractConfig
+from .configdef import ConfigDef, ConfigException, ConfigType, Importance, Range
+
+_G = "cruise_control_tpu.analyzer.goals"
+
+# Default goal chain: mirrors config/cruisecontrol.properties goals= order.
+DEFAULT_GOALS = [
+    f"{_G}.RackAwareGoal",
+    f"{_G}.ReplicaCapacityGoal",
+    f"{_G}.DiskCapacityGoal",
+    f"{_G}.NetworkInboundCapacityGoal",
+    f"{_G}.NetworkOutboundCapacityGoal",
+    f"{_G}.CpuCapacityGoal",
+    f"{_G}.ReplicaDistributionGoal",
+    f"{_G}.PotentialNwOutGoal",
+    f"{_G}.DiskUsageDistributionGoal",
+    f"{_G}.NetworkInboundUsageDistributionGoal",
+    f"{_G}.NetworkOutboundUsageDistributionGoal",
+    f"{_G}.CpuUsageDistributionGoal",
+    f"{_G}.TopicReplicaDistributionGoal",
+    f"{_G}.LeaderReplicaDistributionGoal",
+    f"{_G}.LeaderBytesInDistributionGoal",
+]
+
+DEFAULT_HARD_GOALS = [
+    f"{_G}.RackAwareGoal",
+    f"{_G}.ReplicaCapacityGoal",
+    f"{_G}.DiskCapacityGoal",
+    f"{_G}.NetworkInboundCapacityGoal",
+    f"{_G}.NetworkOutboundCapacityGoal",
+    f"{_G}.CpuCapacityGoal",
+]
+
+DEFAULT_ANOMALY_DETECTION_GOALS = [
+    f"{_G}.RackAwareGoal",
+    f"{_G}.ReplicaCapacityGoal",
+    f"{_G}.DiskCapacityGoal",
+]
+
+
+def _definition() -> ConfigDef:
+    d = ConfigDef()
+    T, I = ConfigType, Importance
+
+    # --- Monitor (MonitorConfig.java; defaults cruisecontrol.properties) ---
+    d.define("bootstrap.servers", T.LIST, [], None, I.HIGH,
+             "Kafka bootstrap servers for the managed cluster.")
+    d.define("metric.sampling.interval.ms", T.LONG, 120_000, Range.at_least(1), I.HIGH,
+             "Interval of metric sampling (default 120s).")
+    d.define("partition.metrics.window.ms", T.LONG, 300_000, Range.at_least(1), I.HIGH,
+             "Partition metrics window size.")
+    d.define("num.partition.metrics.windows", T.INT, 5, Range.at_least(1), I.HIGH,
+             "Number of partition windows kept.")
+    d.define("broker.metrics.window.ms", T.LONG, 300_000, Range.at_least(1), I.HIGH,
+             "Broker metrics window size.")
+    d.define("num.broker.metrics.windows", T.INT, 20, Range.at_least(1), I.HIGH,
+             "Number of broker windows kept.")
+    d.define("min.samples.per.partition.metrics.window", T.INT, 1, Range.at_least(1), I.MEDIUM,
+             "Minimum samples for a partition window to be valid.")
+    d.define("min.samples.per.broker.metrics.window", T.INT, 1, Range.at_least(1), I.MEDIUM,
+             "Minimum samples for a broker window to be valid.")
+    d.define("min.valid.partition.ratio", T.DOUBLE, 0.95, Range.between(0, 1), I.HIGH,
+             "Minimum monitored-valid partition ratio for model building.")
+    d.define("max.allowed.extrapolations.per.partition", T.INT, 8, Range.at_least(0), I.LOW,
+             "Max extrapolated windows tolerated per partition entity.")
+    d.define("max.allowed.extrapolations.per.broker", T.INT, 8, Range.at_least(0), I.LOW,
+             "Max extrapolated windows tolerated per broker entity.")
+    d.define("metric.sampler.class", T.CLASS,
+             "cruise_control_tpu.monitor.sampling.synthetic_sampler.SyntheticMetricSampler",
+             None, I.HIGH, "Pluggable MetricSampler implementation.")
+    d.define("sample.store.class", T.CLASS,
+             "cruise_control_tpu.monitor.sampling.sample_store.FileSampleStore",
+             None, I.MEDIUM, "Pluggable SampleStore implementation.")
+    d.define("sample.store.path", T.STRING, "fileStore/samples", None, I.LOW,
+             "Directory for the file-backed sample store.")
+    d.define("num.metric.fetchers", T.INT, 1, Range.at_least(1), I.LOW,
+             "Parallel metric fetcher workers.")
+    d.define("broker.capacity.config.resolver.class", T.CLASS,
+             "cruise_control_tpu.monitor.capacity.FileCapacityResolver",
+             None, I.HIGH, "Pluggable broker capacity resolver.")
+    d.define("capacity.config.file", T.STRING, "config/capacity.json", None, I.HIGH,
+             "Capacity JSON file (DISK MB, CPU %, NW KB/s; JBOD maps).")
+    d.define("monitor.state.update.interval.ms", T.LONG, 30_000, Range.at_least(1), I.LOW,
+             "Monitor state refresh cadence.")
+
+    # --- Analyzer (AnalyzerConfig.java) ---
+    d.define("goals", T.LIST, list(DEFAULT_GOALS), None, I.HIGH,
+             "Default goal chain, priority order.")
+    d.define("hard.goals", T.LIST, list(DEFAULT_HARD_GOALS), None, I.HIGH,
+             "Goals that must always be satisfied.")
+    d.define("default.goals", T.LIST, [], None, I.MEDIUM,
+             "Goals used for precomputed proposals (empty = goals).")
+    d.define("anomaly.detection.goals", T.LIST, list(DEFAULT_ANOMALY_DETECTION_GOALS), None,
+             I.MEDIUM, "Goals replayed by the goal-violation detector.")
+    d.define("cpu.balance.threshold", T.DOUBLE, 1.1, Range.at_least(1), I.MEDIUM,
+             "Balance band multiplier for CPU.")
+    d.define("disk.balance.threshold", T.DOUBLE, 1.1, Range.at_least(1), I.MEDIUM,
+             "Balance band multiplier for disk.")
+    d.define("network.inbound.balance.threshold", T.DOUBLE, 1.1, Range.at_least(1), I.MEDIUM,
+             "Balance band multiplier for NW in.")
+    d.define("network.outbound.balance.threshold", T.DOUBLE, 1.1, Range.at_least(1), I.MEDIUM,
+             "Balance band multiplier for NW out.")
+    d.define("replica.count.balance.threshold", T.DOUBLE, 1.1, Range.at_least(1), I.MEDIUM,
+             "Balance band multiplier for replica counts.")
+    d.define("leader.replica.count.balance.threshold", T.DOUBLE, 1.1, Range.at_least(1), I.MEDIUM,
+             "Balance band multiplier for leader replica counts.")
+    d.define("topic.replica.count.balance.threshold", T.DOUBLE, 1.1, Range.at_least(1), I.MEDIUM,
+             "Balance band multiplier for per-topic replica counts.")
+    d.define("cpu.capacity.threshold", T.DOUBLE, 0.7, Range.between(0, 1), I.MEDIUM,
+             "Usable fraction of CPU capacity.")
+    d.define("disk.capacity.threshold", T.DOUBLE, 0.8, Range.between(0, 1), I.MEDIUM,
+             "Usable fraction of disk capacity.")
+    d.define("network.inbound.capacity.threshold", T.DOUBLE, 0.8, Range.between(0, 1), I.MEDIUM,
+             "Usable fraction of NW-in capacity.")
+    d.define("network.outbound.capacity.threshold", T.DOUBLE, 0.8, Range.between(0, 1), I.MEDIUM,
+             "Usable fraction of NW-out capacity.")
+    d.define("cpu.low.utilization.threshold", T.DOUBLE, 0.0, Range.between(0, 1), I.LOW,
+             "Below this avg utilization the resource is considered low-utilized.")
+    d.define("disk.low.utilization.threshold", T.DOUBLE, 0.0, Range.between(0, 1), I.LOW, "")
+    d.define("network.inbound.low.utilization.threshold", T.DOUBLE, 0.0, Range.between(0, 1), I.LOW, "")
+    d.define("network.outbound.low.utilization.threshold", T.DOUBLE, 0.0, Range.between(0, 1), I.LOW, "")
+    d.define("max.replicas.per.broker", T.LONG, 10_000, Range.at_least(1), I.MEDIUM,
+             "ReplicaCapacityGoal ceiling.")
+    d.define("proposal.expiration.ms", T.LONG, 60_000, Range.at_least(0), I.MEDIUM,
+             "Precomputed proposal freshness budget.")
+    d.define("num.proposal.precompute.threads", T.INT, 1, Range.at_least(1), I.LOW,
+             "Precompute workers (host-side; device search is batched).")
+    d.define("max.solver.rounds", T.INT, 2000, Range.at_least(1), I.MEDIUM,
+             "TPU solver: max accepted-move rounds per goal.")
+    d.define("solver.candidates.per.round", T.INT, 4096, Range.at_least(16), I.MEDIUM,
+             "TPU solver: candidate actions scored per round.")
+    d.define("solver.moves.per.round", T.INT, 64, Range.at_least(1), I.MEDIUM,
+             "TPU solver: max non-conflicting moves applied per round.")
+    d.define("goal.violation.distribution.threshold.multiplier", T.DOUBLE, 1.0,
+             Range.at_least(1), I.LOW,
+             "Detector-triggered balance-threshold relaxation.")
+    d.define("goal.balancedness.priority.weight", T.DOUBLE, 1.1, Range.at_least(1), I.LOW,
+             "Geometric weight per goal-priority level in balancedness score.")
+    d.define("goal.balancedness.strictness.weight", T.DOUBLE, 1.5, Range.at_least(1), I.LOW,
+             "Extra weight for hard goals in balancedness score.")
+    d.define("fast.mode.per.broker.move.timeout.ms", T.LONG, 500, Range.at_least(1), I.LOW,
+             "Fast-mode per-broker time budget.")
+
+    # --- Executor (ExecutorConfig.java) ---
+    d.define("num.concurrent.partition.movements.per.broker", T.INT, 10, Range.at_least(1),
+             I.HIGH, "Per-broker inter-broker replica move cap.")
+    d.define("max.num.cluster.partition.movements", T.INT, 1250, Range.at_least(1), I.HIGH,
+             "Cluster-wide in-flight replica move cap.")
+    d.define("num.concurrent.intra.broker.partition.movements", T.INT, 2, Range.at_least(1),
+             I.MEDIUM, "Per-broker intra-broker (disk) move cap.")
+    d.define("num.concurrent.leader.movements", T.INT, 1000, Range.at_least(1), I.HIGH,
+             "Cluster-wide leadership movement cap.")
+    d.define("max.num.cluster.movements", T.INT, 1250, Range.at_least(1), I.MEDIUM,
+             "Upper bound of total in-flight movements.")
+    d.define("execution.progress.check.interval.ms", T.LONG, 10_000, Range.at_least(1), I.HIGH,
+             "Execution progress poll interval.")
+    d.define("default.replication.throttle", T.LONG, None, None, I.MEDIUM,
+             "Bytes/sec replication throttle during moves (None = no throttle).")
+    d.define("replica.movement.strategies", T.LIST,
+             ["cruise_control_tpu.executor.strategy.BaseReplicaMovementStrategy"],
+             None, I.LOW, "Chain of replica movement orderings.")
+    d.define("default.replica.movement.strategies", T.LIST,
+             ["cruise_control_tpu.executor.strategy.BaseReplicaMovementStrategy"],
+             None, I.LOW, "Default strategy chain.")
+    d.define("executor.concurrency.adjuster.enabled", T.BOOLEAN, True, None, I.MEDIUM,
+             "Adaptive concurrency adjuster on/off.")
+    d.define("executor.concurrency.adjuster.interval.ms", T.LONG, 360_000, Range.at_least(1),
+             I.LOW, "Concurrency adjuster cadence.")
+    d.define("leader.movement.timeout.ms", T.LONG, 180_000, Range.at_least(1), I.LOW,
+             "Leadership movement timeout before marking dead.")
+    d.define("task.execution.alerting.threshold.ms", T.LONG, 90_000, Range.at_least(1), I.LOW,
+             "Slow-task alert threshold.")
+    d.define("admin.client.class", T.CLASS,
+             "cruise_control_tpu.executor.admin.SimulatedAdminBackend",
+             None, I.HIGH, "Cluster admin backend (simulated or Kafka).")
+
+    # --- Anomaly detector (AnomalyDetectorConfig.java) ---
+    d.define("anomaly.detection.interval.ms", T.LONG, 300_000, Range.at_least(1), I.HIGH,
+             "Base detector cadence.")
+    d.define("goal.violation.detection.interval.ms", T.LONG, None, None, I.LOW,
+             "Override for goal-violation detector cadence.")
+    d.define("metric.anomaly.detection.interval.ms", T.LONG, None, None, I.LOW, "")
+    d.define("broker.failure.detection.backoff.ms", T.LONG, 300_000, Range.at_least(1), I.LOW, "")
+    d.define("anomaly.notifier.class", T.CLASS,
+             "cruise_control_tpu.detector.notifier.SelfHealingNotifier",
+             None, I.HIGH, "AnomalyNotifier implementation.")
+    d.define("self.healing.enabled", T.BOOLEAN, False, None, I.HIGH,
+             "Global self-healing toggle.")
+    d.define("self.healing.broker.failure.enabled", T.BOOLEAN, True, None, I.MEDIUM, "")
+    d.define("self.healing.goal.violation.enabled", T.BOOLEAN, True, None, I.MEDIUM, "")
+    d.define("self.healing.disk.failure.enabled", T.BOOLEAN, True, None, I.MEDIUM, "")
+    d.define("self.healing.metric.anomaly.enabled", T.BOOLEAN, False, None, I.MEDIUM, "")
+    d.define("self.healing.topic.anomaly.enabled", T.BOOLEAN, False, None, I.MEDIUM, "")
+    d.define("self.healing.maintenance.event.enabled", T.BOOLEAN, False, None, I.MEDIUM, "")
+    d.define("broker.failure.alert.threshold.ms", T.LONG, 900_000, Range.at_least(0), I.MEDIUM,
+             "Age at which a broker failure alerts.")
+    d.define("broker.failure.self.healing.threshold.ms", T.LONG, 1_800_000, Range.at_least(0),
+             I.MEDIUM, "Age at which a broker failure auto-fixes.")
+    d.define("failed.brokers.file.path", T.STRING, "fileStore/failed_brokers.json", None, I.LOW,
+             "Persistence for failure times across restarts.")
+    d.define("metric.anomaly.finder.class", T.CLASS,
+             "cruise_control_tpu.detector.metric_anomaly.PercentileMetricAnomalyFinder",
+             None, I.LOW, "MetricAnomalyFinder implementation.")
+    d.define("metric.anomaly.percentile.upper.threshold", T.DOUBLE, 95.0,
+             Range.between(0, 100), I.LOW, "")
+    d.define("metric.anomaly.percentile.lower.threshold", T.DOUBLE, 2.0,
+             Range.between(0, 100), I.LOW, "")
+    d.define("slow.broker.bytes.in.rate.detection.threshold", T.DOUBLE, 1024.0,
+             Range.at_least(0), I.LOW, "Min traffic for slow-broker relevance (KB/s).")
+    d.define("slow.broker.demotion.score", T.INT, 5, Range.at_least(0), I.LOW,
+             "Scoring threshold for demotion of slow brokers.")
+    d.define("slow.broker.decommission.score", T.INT, 50, Range.at_least(0), I.LOW,
+             "Scoring threshold for removal of slow brokers.")
+    d.define("provisioner.class", T.CLASS,
+             "cruise_control_tpu.detector.provisioner.BasicProvisioner",
+             None, I.LOW, "Provisioner implementation.")
+
+    # --- Web server / API (WebServerConfig.java) ---
+    d.define("webserver.http.port", T.INT, 9090, Range.between(0, 65535), I.HIGH,
+             "REST port.")
+    d.define("webserver.http.address", T.STRING, "127.0.0.1", None, I.HIGH, "Bind address.")
+    d.define("webserver.api.urlprefix", T.STRING, "/kafkacruisecontrol/*", None, I.LOW,
+             "URL prefix of the REST API.")
+    d.define("webserver.session.maxExpiryPeriodMs", T.LONG, 60_000, Range.at_least(1), I.LOW,
+             "Async task session retention.")
+    d.define("two.step.verification.enabled", T.BOOLEAN, False, None, I.MEDIUM,
+             "Purgatory review flow on/off.")
+    d.define("webserver.security.enable", T.BOOLEAN, False, None, I.MEDIUM, "")
+    d.define("webserver.security.provider", T.CLASS,
+             "cruise_control_tpu.api.security.BasicSecurityProvider",
+             None, I.LOW, "SecurityProvider implementation.")
+    d.define("webserver.auth.credentials.file", T.STRING, None, None, I.LOW,
+             "htpasswd-style credentials for basic auth.")
+    d.define("max.active.user.tasks", T.INT, 25, Range.at_least(1), I.LOW,
+             "UserTaskManager active task cap.")
+    d.define("completed.user.task.retention.time.ms", T.LONG, 86_400_000, Range.at_least(1),
+             I.LOW, "Completed task retention.")
+
+    # --- TPU / device placement (new; no reference equivalent) ---
+    d.define("tpu.mesh.axis.candidates", T.STRING, "candidates", None, I.LOW,
+             "Mesh axis name over which candidate scoring is sharded.")
+    d.define("tpu.num.devices", T.INT, None, None, I.LOW,
+             "Device count override (None = all visible devices).")
+    d.define("tpu.solver.dtype", T.STRING, "float32", None, I.LOW,
+             "Accumulation dtype for goal kernels.")
+    return d
+
+
+_DEFINITION = _definition()
+
+
+class CruiseControlConfig(AbstractConfig):
+    """Merged, sanity-checked configuration (KafkaCruiseControlConfig.java)."""
+
+    def __init__(self, props: Mapping[str, Any] | None = None):
+        super().__init__(_DEFINITION, props or {})
+        self._sanity_check()
+
+    def _sanity_check(self) -> None:
+        # KafkaCruiseControlConfig.sanityCheckGoalNames: hard.goals ⊆ goals,
+        # anomaly.detection.goals ⊆ goals.
+        goal_list = self.get_list("goals")
+        if not goal_list:
+            # KafkaCruiseControlConfig.java:161-166 — empty goals fail fast.
+            raise ConfigException("goals must not be empty")
+        goals = set(goal_list)
+        for key in ("hard.goals", "anomaly.detection.goals"):
+            subset = set(self.get_list(key))
+            if not subset.issubset(goals):
+                raise ConfigException(
+                    f"{key} must be a subset of goals; extras: {sorted(subset - goals)}")
+        if self.get_int("num.concurrent.partition.movements.per.broker") > \
+                self.get_int("max.num.cluster.partition.movements"):
+            raise ConfigException(
+                "per-broker concurrent movements exceed the cluster-wide cap")

@@ -1,0 +1,3 @@
+from .metricdef import MetricDef, MetricInfo, ValueComputingStrategy
+from .kafka_metric_def import KafkaMetricDef, CommonMetric, BrokerMetric
+from .raw_metric_type import RawMetricType, MetricScope

@@ -1,0 +1,117 @@
+"""Model-level metric definitions (the bridge raw→model).
+
+Reference parity: monitor/metricdefinition/KafkaMetricDef.java:43-134 —
+~50 model metrics with COMMON (partition+broker) vs BROKER_ONLY scope and a
+per-metric window-reduction strategy; the four resource metrics map onto the
+``Resource`` axis used by the solver.
+"""
+
+from __future__ import annotations
+
+import enum
+
+from ..common.resources import Resource
+from .metricdef import MetricDef, ValueComputingStrategy as S
+
+COMMON = "common"
+BROKER_ONLY = "broker_only"
+
+
+class CommonMetric(enum.Enum):
+    """(ordinal, strategy, resource) per metric; COMMON scope = defined for
+    both partition and broker entities (KafkaMetricDef.java:43-53). The
+    ordinal keeps enum values unique (otherwise members alias)."""
+
+    CPU_USAGE = (0, S.AVG, Resource.CPU)
+    DISK_USAGE = (1, S.LATEST, Resource.DISK)
+    LEADER_BYTES_IN = (2, S.AVG, Resource.NW_IN)
+    LEADER_BYTES_OUT = (3, S.AVG, Resource.NW_OUT)
+    PRODUCE_RATE = (4, S.AVG, None)
+    FETCH_RATE = (5, S.AVG, None)
+    MESSAGE_IN_RATE = (6, S.AVG, None)
+    REPLICATION_BYTES_IN_RATE = (7, S.AVG, Resource.NW_IN)
+    REPLICATION_BYTES_OUT_RATE = (8, S.AVG, Resource.NW_OUT)
+
+    @property
+    def strategy(self) -> S:
+        return self.value[1]
+
+    @property
+    def resource(self) -> "Resource | None":
+        return self.value[2]
+
+
+# BROKER_ONLY latency metrics (KafkaMetricDef.java:55-101); all AVG.
+# Ordinal parity with the reference: MAX/MEAN block first (phase-outer,
+# op-middle), then log-flush, then the 50TH/999TH block.
+_BROKER_ONLY_NAMES: list[str] = [
+    "BROKER_PRODUCE_REQUEST_RATE",
+    "BROKER_CONSUMER_FETCH_REQUEST_RATE",
+    "BROKER_FOLLOWER_FETCH_REQUEST_RATE",
+    "BROKER_REQUEST_HANDLER_POOL_IDLE_PERCENT",
+    "BROKER_REQUEST_QUEUE_SIZE",
+    "BROKER_RESPONSE_QUEUE_SIZE",
+]
+for _phase in ("REQUEST_QUEUE", "TOTAL", "LOCAL"):
+    for _op in ("PRODUCE", "CONSUMER_FETCH", "FOLLOWER_FETCH"):
+        for _stat in ("MAX", "MEAN"):
+            _BROKER_ONLY_NAMES.append(f"BROKER_{_op}_{_phase}_TIME_MS_{_stat}")
+_BROKER_ONLY_NAMES += [
+    "BROKER_LOG_FLUSH_RATE",
+    "BROKER_LOG_FLUSH_TIME_MS_MAX",
+    "BROKER_LOG_FLUSH_TIME_MS_MEAN",
+]
+for _phase in ("REQUEST_QUEUE", "TOTAL", "LOCAL"):
+    for _op in ("PRODUCE", "CONSUMER_FETCH", "FOLLOWER_FETCH"):
+        for _stat in ("50TH", "999TH"):
+            _BROKER_ONLY_NAMES.append(f"BROKER_{_op}_{_phase}_TIME_MS_{_stat}")
+_BROKER_ONLY_NAMES += [
+    "BROKER_LOG_FLUSH_TIME_MS_50TH",
+    "BROKER_LOG_FLUSH_TIME_MS_999TH",
+]
+
+BrokerMetric = enum.Enum("BrokerMetric", [(n, n) for n in _BROKER_ONLY_NAMES])
+
+
+class KafkaMetricDef:
+    """Holds the two MetricDef registries (common/partition vs broker) and
+    the resource → metric-id maps consumed by the model builder."""
+
+    _common_def: MetricDef | None = None
+    _broker_def: MetricDef | None = None
+
+    @classmethod
+    def common_metric_def(cls) -> MetricDef:
+        if cls._common_def is None:
+            d = MetricDef()
+            for m in CommonMetric:
+                d.define(m.name, m.strategy, group=COMMON)
+            cls._common_def = d
+        return cls._common_def
+
+    @classmethod
+    def broker_metric_def(cls) -> MetricDef:
+        """Broker entities carry COMMON + BROKER_ONLY metrics."""
+        if cls._broker_def is None:
+            d = MetricDef()
+            for m in CommonMetric:
+                d.define(m.name, m.strategy, group=COMMON)
+            for name in _BROKER_ONLY_NAMES:
+                d.define(name, S.AVG, group=BROKER_ONLY)
+            cls._broker_def = d
+        return cls._broker_def
+
+    @classmethod
+    def resource_to_metric_ids(cls, which: str = "common") -> dict[Resource, list[int]]:
+        """Resource → metric ids whose values sum into that resource's load
+        (KafkaMetricDef.resourceToMetricIds)."""
+        d = cls.common_metric_def() if which == "common" else cls.broker_metric_def()
+        out: dict[Resource, list[int]] = {r: [] for r in Resource}
+        for m in CommonMetric:
+            if m.resource is not None:
+                out[m.resource].append(d.metric_info(m.name).id)
+        return out
+
+    @classmethod
+    def common_metric_id(cls, m: CommonMetric) -> int:
+        return cls.common_metric_def().metric_info(m.name).id

@@ -1,0 +1,73 @@
+"""Metric definition registry.
+
+Reference parity: cruise-control-core .../metricdef/MetricDef.java,
+MetricInfo.java, ValueComputingStrategy.java — maps metric name → integer id
+and records how samples within a window are reduced (AVG / MAX / LATEST).
+
+The integer ids are the row indices of the metric axis in the aggregator's
+dense window tensors, so the registry doubles as the tensor schema.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Iterable
+
+
+class ValueComputingStrategy(enum.Enum):
+    AVG = "avg"
+    MAX = "max"
+    LATEST = "latest"
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricInfo:
+    name: str
+    id: int
+    strategy: ValueComputingStrategy
+    group: str | None = None
+
+
+class MetricDef:
+    """Append-only metric registry; ids are assigned densely in definition
+    order (MetricDef.java:define)."""
+
+    def __init__(self) -> None:
+        self._by_name: dict[str, MetricInfo] = {}
+        self._by_id: list[MetricInfo] = []
+        self._groups: dict[str, list[MetricInfo]] = {}
+
+    def define(self, name: str, strategy: ValueComputingStrategy | str,
+               group: str | None = None) -> MetricInfo:
+        if name in self._by_name:
+            raise ValueError(f"metric {name!r} already defined")
+        if isinstance(strategy, str):
+            strategy = ValueComputingStrategy(strategy.lower())
+        info = MetricInfo(name=name, id=len(self._by_id), strategy=strategy, group=group)
+        self._by_name[name] = info
+        self._by_id.append(info)
+        if group is not None:
+            self._groups.setdefault(group, []).append(info)
+        return info
+
+    def metric_info(self, name: str) -> MetricInfo:
+        return self._by_name[name]
+
+    def metric_info_for_id(self, metric_id: int) -> MetricInfo:
+        return self._by_id[metric_id]
+
+    @property
+    def num_metrics(self) -> int:
+        return len(self._by_id)
+
+    def all(self) -> Iterable[MetricInfo]:
+        return tuple(self._by_id)
+
+    def ids_for_group(self, group: str) -> list[int]:
+        return [m.id for m in self._groups.get(group, [])]
+
+    def strategies_array(self):
+        """Per-metric strategy codes as a list aligned with ids (consumed by
+        the window-reduction kernel)."""
+        return [m.strategy for m in self._by_id]

@@ -1,0 +1,79 @@
+"""Raw metric taxonomy reported by the broker-side agent.
+
+Reference parity: cruise-control-metrics-reporter .../metric/RawMetricType.java
+(63 raw metric ids at BROKER/TOPIC/PARTITION scope, versioned serde). The
+names and scopes mirror the reference so samples are interoperable; ids are
+assigned from enumeration order and double as rows of the ingest tensors.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class MetricScope(enum.Enum):
+    BROKER = "broker"
+    TOPIC = "topic"
+    PARTITION = "partition"
+
+
+_BROKER = MetricScope.BROKER
+_TOPIC = MetricScope.TOPIC
+_PARTITION = MetricScope.PARTITION
+
+# name -> scope, in reference id order (RawMetricType.java:27-95).
+_RAW_METRICS: list[tuple[str, MetricScope]] = [
+    ("ALL_TOPIC_BYTES_IN", _BROKER),
+    ("ALL_TOPIC_BYTES_OUT", _BROKER),
+    ("TOPIC_BYTES_IN", _TOPIC),
+    ("TOPIC_BYTES_OUT", _TOPIC),
+    ("PARTITION_SIZE", _PARTITION),
+    ("BROKER_CPU_UTIL", _BROKER),
+    ("ALL_TOPIC_REPLICATION_BYTES_IN", _BROKER),
+    ("ALL_TOPIC_REPLICATION_BYTES_OUT", _BROKER),
+    ("ALL_TOPIC_PRODUCE_REQUEST_RATE", _BROKER),
+    ("ALL_TOPIC_FETCH_REQUEST_RATE", _BROKER),
+    ("ALL_TOPIC_MESSAGES_IN_PER_SEC", _BROKER),
+    ("TOPIC_REPLICATION_BYTES_IN", _TOPIC),
+    ("TOPIC_REPLICATION_BYTES_OUT", _TOPIC),
+    ("TOPIC_PRODUCE_REQUEST_RATE", _TOPIC),
+    ("TOPIC_FETCH_REQUEST_RATE", _TOPIC),
+    ("TOPIC_MESSAGES_IN_PER_SEC", _TOPIC),
+    ("BROKER_PRODUCE_REQUEST_RATE", _BROKER),
+    ("BROKER_CONSUMER_FETCH_REQUEST_RATE", _BROKER),
+    ("BROKER_FOLLOWER_FETCH_REQUEST_RATE", _BROKER),
+    ("BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT", _BROKER),
+    ("BROKER_REQUEST_QUEUE_SIZE", _BROKER),
+    ("BROKER_RESPONSE_QUEUE_SIZE", _BROKER),
+]
+
+# The 42 latency/percentile broker metrics (queue/total/local time for
+# produce / consumer-fetch / follower-fetch plus log-flush), MAX & MEAN then
+# 50TH & 999TH — generated phase-outer / op-middle to match the reference id
+# order exactly (RawMetricType.java:55-95).
+for _phase in ("REQUEST_QUEUE", "TOTAL", "LOCAL"):
+    for _op in ("PRODUCE", "CONSUMER_FETCH", "FOLLOWER_FETCH"):
+        for _stat in ("MAX", "MEAN"):
+            _RAW_METRICS.append((f"BROKER_{_op}_{_phase}_TIME_MS_{_stat}", _BROKER))
+_RAW_METRICS.append(("BROKER_LOG_FLUSH_RATE", _BROKER))
+_RAW_METRICS.append(("BROKER_LOG_FLUSH_TIME_MS_MAX", _BROKER))
+_RAW_METRICS.append(("BROKER_LOG_FLUSH_TIME_MS_MEAN", _BROKER))
+for _phase in ("REQUEST_QUEUE", "TOTAL", "LOCAL"):
+    for _op in ("PRODUCE", "CONSUMER_FETCH", "FOLLOWER_FETCH"):
+        for _stat in ("50TH", "999TH"):
+            _RAW_METRICS.append((f"BROKER_{_op}_{_phase}_TIME_MS_{_stat}", _BROKER))
+_RAW_METRICS.append(("BROKER_LOG_FLUSH_TIME_MS_50TH", _BROKER))
+_RAW_METRICS.append(("BROKER_LOG_FLUSH_TIME_MS_999TH", _BROKER))
+
+
+RawMetricType = enum.IntEnum("RawMetricType", [(name, i) for i, (name, _) in enumerate(_RAW_METRICS)])
+
+_SCOPES = {RawMetricType[name]: scope for name, scope in _RAW_METRICS}
+
+
+def scope_of(raw: "RawMetricType") -> MetricScope:
+    return _SCOPES[raw]
+
+
+def metrics_for_scope(scope: MetricScope) -> list["RawMetricType"]:
+    return [m for m in RawMetricType if _SCOPES[m] is scope]

@@ -1,0 +1,13 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax import so
+multi-chip sharding paths (Mesh/shard_map) are exercised without TPU pods."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (import after env setup)
+
+jax.config.update("jax_enable_x64", False)

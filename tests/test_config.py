@@ -1,0 +1,100 @@
+"""Config kernel tests (reference: core ConfigDefTest / KafkaCruiseControlConfig)."""
+
+import pytest
+
+from cruise_control_tpu.config import (
+    AbstractConfig, ConfigDef, ConfigException, ConfigType, CruiseControlConfig,
+    Range, ValidString,
+)
+from cruise_control_tpu.config.configdef import Importance, Password
+
+
+def _def():
+    d = ConfigDef()
+    d.define("a.int", ConfigType.INT, 7, Range.at_least(0), Importance.HIGH, "")
+    d.define("b.double", ConfigType.DOUBLE, 0.5, Range.between(0, 1), Importance.LOW, "")
+    d.define("c.list", ConfigType.LIST, ["x", "y"], None, Importance.LOW, "")
+    d.define("d.bool", ConfigType.BOOLEAN, False, None, Importance.LOW, "")
+    d.define("e.str", ConfigType.STRING, "hello", ValidString(("hello", "bye")), Importance.LOW, "")
+    d.define("f.required", ConfigType.INT)
+    d.define("g.pw", ConfigType.PASSWORD, None)
+    return d
+
+
+def test_defaults_and_coercion():
+    cfg = AbstractConfig(_def(), {"f.required": "42", "a.int": "3", "d.bool": "true",
+                                  "c.list": "p, q ,r"})
+    assert cfg.get_int("a.int") == 3
+    assert cfg.get_int("f.required") == 42
+    assert cfg.get_boolean("d.bool") is True
+    assert cfg.get_list("c.list") == ["p", "q", "r"]
+    assert cfg.get_double("b.double") == 0.5
+
+
+def test_missing_required():
+    with pytest.raises(ConfigException):
+        AbstractConfig(_def(), {})
+
+
+def test_range_validation():
+    with pytest.raises(ConfigException):
+        AbstractConfig(_def(), {"f.required": 1, "a.int": -2})
+
+
+def test_valid_string():
+    with pytest.raises(ConfigException):
+        AbstractConfig(_def(), {"f.required": 1, "e.str": "nope"})
+
+
+def test_password_hidden():
+    cfg = AbstractConfig(_def(), {"f.required": 1, "g.pw": "s3cret"})
+    pw = cfg.get("g.pw")
+    assert isinstance(pw, Password)
+    assert "s3cret" not in repr(pw)
+    assert pw.value == "s3cret"
+
+
+def test_bad_bool_rejected():
+    with pytest.raises(ConfigException):
+        AbstractConfig(_def(), {"f.required": 1, "d.bool": "yes"})
+
+
+def test_duplicate_key_rejected():
+    d = ConfigDef()
+    d.define("x", ConfigType.INT, 1)
+    with pytest.raises(ConfigException):
+        d.define("x", ConfigType.INT, 2)
+
+
+class _FakePlugin:
+    def __init__(self):
+        self.configured = None
+
+    def configure(self, config):
+        self.configured = config
+
+
+def test_configured_instance_loading():
+    d = ConfigDef()
+    d.define("plugin.class", ConfigType.CLASS, "tests.test_config._FakePlugin")
+    cfg = AbstractConfig(d, {})
+    inst = cfg.get_configured_instance("plugin.class")
+    assert isinstance(inst, _FakePlugin)
+    assert inst.configured is not None
+
+
+def test_cruise_control_config_defaults():
+    cfg = CruiseControlConfig()
+    assert cfg.get_long("metric.sampling.interval.ms") == 120_000
+    assert cfg.get_int("num.partition.metrics.windows") == 5
+    assert cfg.get_int("num.broker.metrics.windows") == 20
+    assert cfg.get_double("min.valid.partition.ratio") == 0.95
+    assert cfg.get_double("disk.capacity.threshold") == 0.8
+    assert cfg.get_int("num.concurrent.partition.movements.per.broker") == 10
+    assert len(cfg.get_list("goals")) == 15
+    assert set(cfg.get_list("hard.goals")) <= set(cfg.get_list("goals"))
+
+
+def test_cruise_control_config_sanity_check():
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"hard.goals": ["not.a.goal.InGoals"]})

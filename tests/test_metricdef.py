@@ -1,0 +1,64 @@
+"""Metric registry tests (reference: MetricDef / KafkaMetricDef / RawMetricType)."""
+
+from cruise_control_tpu.common import Resource
+from cruise_control_tpu.metricdef import (
+    CommonMetric, KafkaMetricDef, MetricDef, MetricScope, RawMetricType,
+    ValueComputingStrategy,
+)
+from cruise_control_tpu.metricdef.raw_metric_type import metrics_for_scope, scope_of
+
+
+def test_dense_ids():
+    d = MetricDef()
+    a = d.define("m0", ValueComputingStrategy.AVG)
+    b = d.define("m1", "max")
+    assert (a.id, b.id) == (0, 1)
+    assert d.metric_info_for_id(1).name == "m1"
+    assert d.num_metrics == 2
+
+
+def test_raw_metric_count_and_scopes():
+    # Reference RawMetricType.java defines 63 raw metrics (ids 0..62).
+    assert len(list(RawMetricType)) == 63
+    assert scope_of(RawMetricType.PARTITION_SIZE) is MetricScope.PARTITION
+    assert scope_of(RawMetricType.TOPIC_BYTES_IN) is MetricScope.TOPIC
+    assert scope_of(RawMetricType.BROKER_CPU_UTIL) is MetricScope.BROKER
+    assert len(metrics_for_scope(MetricScope.TOPIC)) == 7
+    assert len(metrics_for_scope(MetricScope.PARTITION)) == 1
+
+
+def test_raw_metric_id_parity():
+    # Pin wire ids to the reference enum (RawMetricType.java:27-95) so the
+    # generated ordering can never silently drift.
+    assert RawMetricType.ALL_TOPIC_BYTES_IN.value == 0
+    assert RawMetricType.PARTITION_SIZE.value == 4
+    assert RawMetricType.BROKER_CPU_UTIL.value == 5
+    assert RawMetricType.BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MAX.value == 22
+    assert RawMetricType.BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MAX.value == 24
+    assert RawMetricType.BROKER_PRODUCE_TOTAL_TIME_MS_MAX.value == 28
+    assert RawMetricType.BROKER_LOG_FLUSH_RATE.value == 40
+    assert RawMetricType.BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_50TH.value == 43
+    assert RawMetricType.BROKER_LOG_FLUSH_TIME_MS_999TH.value == 62
+
+
+def test_kafka_metric_def_resources():
+    common = KafkaMetricDef.common_metric_def()
+    assert common.num_metrics == len(CommonMetric)
+    r2m = KafkaMetricDef.resource_to_metric_ids("common")
+    # NW_IN ← LEADER_BYTES_IN + REPLICATION_BYTES_IN_RATE (KafkaMetricDef.java)
+    assert len(r2m[Resource.NW_IN]) == 2
+    assert len(r2m[Resource.NW_OUT]) == 2
+    assert len(r2m[Resource.CPU]) == 1
+    assert len(r2m[Resource.DISK]) == 1
+    # DISK uses LATEST strategy (disk usage is a level, not a rate).
+    disk_id = KafkaMetricDef.common_metric_id(CommonMetric.DISK_USAGE)
+    assert common.metric_info_for_id(disk_id).strategy is ValueComputingStrategy.LATEST
+
+
+def test_broker_metric_def_superset():
+    broker = KafkaMetricDef.broker_metric_def()
+    common = KafkaMetricDef.common_metric_def()
+    assert broker.num_metrics > common.num_metrics
+    # Common metrics share ids across both defs (same definition order).
+    for m in CommonMetric:
+        assert broker.metric_info(m.name).id == common.metric_info(m.name).id
