@@ -1,0 +1,6 @@
+from .extrapolation import Extrapolation
+from .raw_store import RawMetricStore
+from .aggregator import (
+    AggregationOptions, AggregationResult, Granularity, MetricSampleAggregator,
+    MetricSampleCompleteness, NotEnoughValidWindowsError,
+)

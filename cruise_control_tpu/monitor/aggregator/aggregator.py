@@ -1,0 +1,313 @@
+"""Windowed metric sample aggregation with completeness accounting.
+
+Reference parity: cruise-control-core .../aggregator/MetricSampleAggregator.java
+(addSample:141, aggregate:193, completeness:277), AggregationOptions.java
+(ENTITY vs ENTITY_GROUP granularity), MetricSampleCompleteness.java and
+NotEnoughValidWindowsException.java.
+
+Redesign: entities live as rows of one dense RawMetricStore, so completeness
+ratios and validity are single vectorized reductions. ``aggregate`` returns
+dense ndarrays ready to be fed to the JAX model builder — not per-entity
+objects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+from typing import Any, Callable, Hashable, Sequence
+
+import numpy as np
+
+from ...metricdef.metricdef import MetricDef
+from .extrapolation import Extrapolation
+from .raw_store import RawMetricStore
+
+
+class NotEnoughValidWindowsError(RuntimeError):
+    """Too few windows satisfy the completeness requirements
+    (NotEnoughValidWindowsException.java)."""
+
+
+class Granularity(enum.Enum):
+    """AggregationOptions.Granularity: ENTITY treats each entity separately;
+    ENTITY_GROUP invalidates a whole group if any member entity is invalid."""
+
+    ENTITY = "entity"
+    ENTITY_GROUP = "entity_group"
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregationOptions:
+    min_valid_entity_ratio: float = 0.0
+    min_valid_entity_group_ratio: float = 0.0
+    min_valid_windows: int = 1
+    max_allowed_extrapolations_per_entity: int = 8
+    granularity: Granularity = Granularity.ENTITY
+    interested_entities: tuple | None = None  # None = all known entities
+    include_invalid_entities: bool = False
+
+
+@dataclasses.dataclass
+class MetricSampleCompleteness:
+    """Per-window coverage ratios over the interested entity universe
+    (MetricSampleCompleteness.java)."""
+
+    window_indices: list[int]
+    valid_entity_ratio_by_window: np.ndarray  # [W]
+    valid_entity_group_ratio_by_window: np.ndarray  # [W]
+    valid_windows: list[int]
+    valid_entity_ratio: float
+    valid_entity_group_ratio: float
+    generation: int
+
+
+@dataclasses.dataclass
+class AggregationResult:
+    """Dense aggregation output: ``values[E, M, W]`` over the valid windows,
+    aligned with ``entities`` and ``window_indices``."""
+
+    entities: list
+    window_indices: list[int]
+    values: np.ndarray          # [E, M, W] float32
+    extrapolations: np.ndarray  # [E, W] int8 Extrapolation codes
+    entity_valid: np.ndarray    # [E] bool
+    completeness: MetricSampleCompleteness
+
+
+class MetricSampleAggregator:
+    """Thread-safe windowed aggregator over one entity kind.
+
+    ``group_fn`` maps an entity to its aggregation group (topic for
+    partition entities; None for broker entities).
+    """
+
+    def __init__(self, num_windows: int, window_ms: int, min_samples_per_window: int,
+                 metric_def: MetricDef, group_fn: Callable[[Any], Hashable] | None = None):
+        self._lock = threading.RLock()
+        self._window_ms = int(window_ms)
+        self._num_windows = int(num_windows)
+        self._metric_def = metric_def
+        self._group_fn = group_fn or (lambda e: e)
+        self._store = RawMetricStore(num_windows, min_samples_per_window, metric_def)
+        self._generation = 0
+        self._cache: dict[tuple, AggregationResult] = {}
+
+    @property
+    def window_ms(self) -> int:
+        return self._window_ms
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    @property
+    def store(self) -> RawMetricStore:
+        return self._store
+
+    def window_index_of(self, time_ms: int) -> int:
+        return int(time_ms) // self._window_ms
+
+    # ---- ingest ---------------------------------------------------------
+    def add_sample(self, entity, time_ms: int, metric_values: np.ndarray) -> bool:
+        """Add one sample (MetricSampleAggregator.addSample). Bumps the
+        aggregator generation used for proposal-cache invalidation
+        (LongGenerationed semantics)."""
+        with self._lock:
+            ok = self._store.add_sample(entity, self.window_index_of(time_ms), metric_values)
+            if ok:
+                self._generation += 1
+                self._cache.clear()
+            return ok
+
+    def add_samples_batch(self, entities: Sequence, time_ms: int, values: np.ndarray) -> None:
+        """Vectorized ingest: one sample per entity for one window."""
+        with self._lock:
+            w = self.window_index_of(time_ms)
+            self._store.roll_to(w)
+            if w < self._store.oldest_window_index:
+                return
+            rows = np.array([self._store._row_or_create(e) for e in entities], dtype=np.int64)
+            values = np.asarray(values, dtype=np.float32)
+            uniq, first_idx, counts = np.unique(rows, return_index=True, return_counts=True)
+            if len(uniq) == len(rows):
+                self._store.add_samples_batch(rows, w, values)
+            else:
+                # Duplicate entities in one batch: fast-path the unique first
+                # occurrences, loop the rest (numpy fancy-index writes would
+                # silently collapse repeated rows).
+                self._store.add_samples_batch(rows[first_idx], w, values[first_idx])
+                dup_mask = np.ones(len(rows), dtype=bool)
+                dup_mask[first_idx] = False
+                for i in np.nonzero(dup_mask)[0]:
+                    self._store.add_sample(entities[i], w, values[i])
+            self._generation += 1
+            self._cache.clear()
+
+    # ---- windows --------------------------------------------------------
+    def available_windows(self) -> list[int]:
+        with self._lock:
+            return self._store.stable_window_indices()
+
+    def num_available_windows(self) -> int:
+        return len(self.available_windows())
+
+    def all_window_times(self) -> list[int]:
+        return [w * self._window_ms for w in self.available_windows()]
+
+    def num_samples(self) -> int:
+        with self._lock:
+            return self._store.num_samples()
+
+    def retain_entities(self, entities) -> None:
+        with self._lock:
+            self._store.retain_entities(entities)
+            self._generation += 1
+            self._cache.clear()
+
+    def remove_entities(self, entities) -> None:
+        with self._lock:
+            self._store.remove_entities(entities)
+            self._generation += 1
+            self._cache.clear()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._store = RawMetricStore(
+                self._num_windows, self._store._min_samples, self._metric_def)
+            self._generation += 1
+            self._cache.clear()
+
+    # ---- aggregation ----------------------------------------------------
+    def completeness(self, options: AggregationOptions) -> MetricSampleCompleteness:
+        with self._lock:
+            return self._completeness_locked(options)
+
+    def _entity_rows(self, options: AggregationOptions) -> tuple[list, np.ndarray]:
+        known = self._store.entities
+        if options.interested_entities is None:
+            return known, np.arange(len(known), dtype=np.int64)
+        rows, ents = [], []
+        for e in options.interested_entities:
+            r = self._store.row(e)
+            ents.append(e)
+            rows.append(-1 if r is None else r)
+        return ents, np.array(rows, dtype=np.int64)
+
+    def _completeness_locked(self, options: AggregationOptions) -> MetricSampleCompleteness:
+        entities, rows = self._entity_rows(options)
+        windows = self._store.stable_window_indices()
+        if not windows or not entities:
+            raise NotEnoughValidWindowsError(
+                f"0 valid windows (required {options.min_valid_windows})")
+
+        _cats, valid, extrapolated = self._store.classify()
+        # Unknown interested entities contribute all-invalid rows.
+        valid_sel = np.zeros((len(entities), valid.shape[1]), dtype=bool)
+        known_mask = rows >= 0
+        valid_sel[known_mask] = valid[rows[known_mask]]
+        over_extra = np.zeros(len(entities), dtype=bool)
+        over_extra[known_mask] = (
+            extrapolated[rows[known_mask]].sum(axis=1)
+            > options.max_allowed_extrapolations_per_entity)
+        valid_sel[over_extra] = False
+
+        groups = [self._group_fn(e) for e in entities]
+        group_of: dict = {}
+        group_index = np.array([group_of.setdefault(g, len(group_of)) for g in groups],
+                               dtype=np.int64)
+        n_g = max(1, len(group_of))
+
+        # Per-window entity ratio; group valid in a window iff all members valid.
+        entity_ratio = valid_sel.mean(axis=0)
+        group_valid = np.ones((n_g, valid_sel.shape[1]), dtype=bool)
+        np.logical_and.at(group_valid, group_index, valid_sel)
+        group_ratio = group_valid.mean(axis=0)
+
+        if options.granularity is Granularity.ENTITY_GROUP:
+            # Entity coverage counts only entities in fully-valid groups
+            # (AggregationOptions ENTITY_GROUP semantics).
+            entity_ratio = (group_valid[group_index] & valid_sel).mean(axis=0)
+
+        ok = (entity_ratio >= options.min_valid_entity_ratio) & \
+             (group_ratio >= options.min_valid_entity_group_ratio)
+        valid_windows = [w for w, keep in zip(windows, ok) if keep]
+        if len(valid_windows) < options.min_valid_windows:
+            raise NotEnoughValidWindowsError(
+                f"{len(valid_windows)} valid windows out of {len(windows)} "
+                f"(required {options.min_valid_windows}); "
+                f"entity ratios {np.round(entity_ratio, 3).tolist()}")
+        sel = ok
+        return MetricSampleCompleteness(
+            window_indices=list(windows),
+            valid_entity_ratio_by_window=entity_ratio,
+            valid_entity_group_ratio_by_window=group_ratio,
+            valid_windows=valid_windows,
+            valid_entity_ratio=float(entity_ratio[sel].mean()) if sel.any() else 0.0,
+            valid_entity_group_ratio=float(group_ratio[sel].mean()) if sel.any() else 0.0,
+            generation=self._generation,
+        )
+
+    def aggregate(self, options: AggregationOptions) -> AggregationResult:
+        """Aggregate stable windows meeting the completeness requirements
+        (MetricSampleAggregator.aggregate:193). Cached by generation."""
+        with self._lock:
+            cache_key = (self._generation, options.min_valid_entity_ratio,
+                         options.min_valid_entity_group_ratio, options.min_valid_windows,
+                         options.max_allowed_extrapolations_per_entity, options.granularity,
+                         options.interested_entities, options.include_invalid_entities)
+            if cache_key in self._cache:
+                return self._cache[cache_key]
+            completeness = self._completeness_locked(options)
+            entities, rows = self._entity_rows(options)
+            values, cats = self._store.aggregate_values()
+            windows = self._store.stable_window_indices()
+            valid_set = set(completeness.valid_windows)
+            keep_cols = np.array([w in valid_set for w in windows])
+
+            known_mask = rows >= 0
+            out_vals = np.zeros((len(entities), values.shape[1], int(keep_cols.sum())),
+                                dtype=np.float32)
+            out_cats = np.full((len(entities), int(keep_cols.sum())),
+                               int(Extrapolation.NO_VALID_EXTRAPOLATION), dtype=np.int8)
+            out_vals[known_mask] = values[rows[known_mask]][:, :, keep_cols]
+            out_cats[known_mask] = cats[rows[known_mask]][:, keep_cols]
+
+            entity_valid = np.zeros(len(entities), dtype=bool)
+            ev = self._store.entity_validity(options.max_allowed_extrapolations_per_entity)
+            entity_valid[known_mask] = ev[rows[known_mask]]
+
+            if not options.include_invalid_entities:
+                # Zero out metric rows of invalid entities rather than drop
+                # them, keeping array alignment with `entities`.
+                out_vals[~entity_valid] = 0.0
+
+            result = AggregationResult(
+                entities=entities,
+                window_indices=completeness.valid_windows,
+                values=out_vals,
+                extrapolations=out_cats,
+                entity_valid=entity_valid,
+                completeness=completeness,
+            )
+            self._cache[cache_key] = result
+            return result
+
+    def peek_current_window(self) -> tuple[list, np.ndarray]:
+        """Reduce the in-fill current window only
+        (MetricSampleAggregator.peekCurrentWindow)."""
+        with self._lock:
+            e = self._store.num_entities
+            cur = self._store.current_window_index
+            if cur is None or e == 0:
+                return [], np.zeros((0, self._metric_def.num_metrics), dtype=np.float32)
+            slot = self._store._slot(cur)
+            counts = self._store._counts[:e, slot].astype(np.float32)
+            vals = self._store._values[:e, :, slot]
+            safe = np.maximum(counts, 1.0)[:, None]
+            avg_mask = self._store._avg_mask
+            reduced = np.where(avg_mask[None, :], vals / safe, vals)
+            reduced = np.where(counts[:, None] > 0, reduced, 0.0)
+            return self._store.entities, reduced.astype(np.float32)

@@ -1,0 +1,27 @@
+"""Extrapolation taxonomy for missing metric windows.
+
+Reference parity: cruise-control-core .../aggregator/Extrapolation.java and
+the category logic of RawMetricValues.aggregate (RawMetricValues.java:275-330):
+
+- ``NONE``: window has >= min samples.
+- ``AVG_AVAILABLE``: max(1, min//2) <= count < min — average of what's there.
+- ``AVG_ADJACENT``: count < half-min but both stable neighbours have >= min
+  samples — average across (prev, cur-if-any, next).
+- ``FORCED_INSUFFICIENT``: 0 < count < half-min, no valid neighbours.
+- ``NO_VALID_EXTRAPOLATION``: zero samples and no valid neighbours.
+
+Encoded as int8 category codes so the whole [entities × windows] plane is
+classified with vectorized comparisons instead of per-entity bookkeeping.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Extrapolation(enum.IntEnum):
+    NONE = 0
+    AVG_AVAILABLE = 1
+    AVG_ADJACENT = 2
+    FORCED_INSUFFICIENT = 3
+    NO_VALID_EXTRAPOLATION = 4

@@ -1,0 +1,284 @@
+"""Dense windowed raw-metric storage for all entities of one kind.
+
+Reference parity: cruise-control-core .../aggregator/RawMetricValues.java —
+but where the reference keeps one cyclic float[] per (entity, metric), this
+store keeps ONE dense ndarray ``values[E, M, W]`` plus ``counts[E, W]`` for
+the whole entity population, so validity/extrapolation classification and
+window reduction are single vectorized expressions over the population
+instead of per-entity loops. This is the host-side ingest tensor that feeds
+the JAX model builder.
+
+Window indexing mirrors WindowIndexedArrays: a logical ``window_index``
+(monotonic, time/window_ms) maps onto array slot ``window_index % W`` where
+``W = num_stable_windows + 1`` (the +1 is the in-fill current window).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...metricdef.metricdef import MetricDef, ValueComputingStrategy
+from .extrapolation import Extrapolation
+
+_GROW_FACTOR = 2
+
+
+class RawMetricStore:
+    def __init__(self, num_stable_windows: int, min_samples_per_window: int,
+                 metric_def: MetricDef, initial_capacity: int = 64):
+        if num_stable_windows < 1:
+            raise ValueError("need at least 1 stable window")
+        self._num_stable = num_stable_windows
+        self._buf_windows = num_stable_windows + 1
+        self._min_samples = max(1, min_samples_per_window)
+        # RawMetricValues.java:61 — half-min floor at 1.
+        self._half_min = max(1, self._min_samples // 2)
+        self._metric_def = metric_def
+        num_metrics = metric_def.num_metrics
+        strategies = metric_def.strategies_array()
+        self._avg_mask = np.array([s is ValueComputingStrategy.AVG for s in strategies])
+        self._max_mask = np.array([s is ValueComputingStrategy.MAX for s in strategies])
+        self._latest_mask = np.array([s is ValueComputingStrategy.LATEST for s in strategies])
+
+        cap = max(1, initial_capacity)
+        self._values = np.zeros((cap, num_metrics, self._buf_windows), dtype=np.float32)
+        self._counts = np.zeros((cap, self._buf_windows), dtype=np.int32)
+        self._row_of: dict = {}
+        self._entity_of: list = []
+        self._first_window_index: int | None = None
+        self._current_window_index: int | None = None
+        # classify() memo, invalidated on any mutation (classification is
+        # O(E×W) over the whole population; aggregate paths call it thrice).
+        self._classify_cache: tuple[np.ndarray, np.ndarray, np.ndarray] | None = None
+
+    # ---- entity registry -------------------------------------------------
+    @property
+    def num_entities(self) -> int:
+        return len(self._entity_of)
+
+    @property
+    def entities(self) -> list:
+        return list(self._entity_of)
+
+    def row(self, entity) -> int | None:
+        return self._row_of.get(entity)
+
+    def _row_or_create(self, entity) -> int:
+        r = self._row_of.get(entity)
+        if r is not None:
+            return r
+        r = len(self._entity_of)
+        if r >= self._values.shape[0]:
+            new_cap = max(1, self._values.shape[0]) * _GROW_FACTOR
+            self._values = np.concatenate(
+                [self._values, np.zeros((new_cap - self._values.shape[0],) + self._values.shape[1:],
+                                        dtype=np.float32)])
+            self._counts = np.concatenate(
+                [self._counts, np.zeros((new_cap - self._counts.shape[0], self._buf_windows),
+                                        dtype=np.int32)])
+        self._row_of[entity] = r
+        self._entity_of.append(entity)
+        self._classify_cache = None
+        return r
+
+    def remove_entities(self, entities) -> None:
+        """Drop entities (MetricSampleAggregator.removeEntities). Rows are
+        compacted lazily by rebuilding the arrays."""
+        drop = {e for e in entities if e in self._row_of}
+        if not drop:
+            return
+        keep_rows = [self._row_of[e] for e in self._entity_of if e not in drop]
+        keep_entities = [e for e in self._entity_of if e not in drop]
+        self._values = self._values[keep_rows].copy() if keep_rows else self._values[:0]
+        self._counts = self._counts[keep_rows].copy() if keep_rows else self._counts[:0]
+        self._entity_of = keep_entities
+        self._row_of = {e: i for i, e in enumerate(keep_entities)}
+        self._classify_cache = None
+
+    def retain_entities(self, entities) -> None:
+        keep = set(entities)
+        self.remove_entities([e for e in self._entity_of if e not in keep])
+
+    # ---- window bookkeeping ---------------------------------------------
+    @property
+    def current_window_index(self) -> int | None:
+        return self._current_window_index
+
+    @property
+    def oldest_window_index(self) -> int | None:
+        """Oldest retained window (stable range start). Stable windows are
+        those already rolled past: [oldest, current)."""
+        if self._current_window_index is None:
+            return None
+        return max(self._first_window_index, self._current_window_index - self._num_stable)
+
+    def stable_window_indices(self) -> list[int]:
+        if self._current_window_index is None:
+            return []
+        return list(range(self.oldest_window_index, self._current_window_index))
+
+    def _slot(self, window_index: int) -> int:
+        return window_index % self._buf_windows
+
+    def roll_to(self, window_index: int) -> int:
+        """Advance the current window to ``window_index``; newly-entered ring
+        slots are reset (RawMetricValues.resetWindowIndices). Returns number
+        of abandoned samples."""
+        if self._current_window_index is None:
+            self._first_window_index = window_index
+            self._current_window_index = window_index
+            return 0
+        current = self._current_window_index
+        if window_index <= current:
+            return 0
+        steps = window_index - current
+        abandoned = 0
+        n = min(steps, self._buf_windows)
+        for i in range(n):
+            slot = self._slot(window_index - n + 1 + i)
+            abandoned += int(self._counts[:len(self._entity_of), slot].sum())
+            self._counts[:, slot] = 0
+            self._values[:, :, slot] = 0.0
+        self._current_window_index = window_index
+        self._classify_cache = None
+        return abandoned
+
+    # ---- ingest ----------------------------------------------------------
+    def add_sample(self, entity, window_index: int, metric_values: np.ndarray) -> bool:
+        """Add one sample vector (aligned with the MetricDef ids) to the
+        entity's window. Late samples older than the retained range are
+        dropped (RawMetricValues.addSample:121-127); future windows roll the
+        buffer forward (MetricSampleAggregator.addSample window maintenance).
+        """
+        if self._current_window_index is None or window_index > self._current_window_index:
+            self.roll_to(window_index)
+        if window_index < self.oldest_window_index:
+            return False
+        row = self._row_or_create(entity)
+        slot = self._slot(window_index)
+        count = self._counts[row, slot]
+        v = np.asarray(metric_values, dtype=np.float32)
+        if count == 0:
+            self._values[row, :, slot] = v
+        else:
+            cur = self._values[row, :, slot].copy()
+            cur[self._avg_mask] += v[self._avg_mask]
+            cur[self._max_mask] = np.maximum(cur[self._max_mask], v[self._max_mask])
+            cur[self._latest_mask] = v[self._latest_mask]
+            self._values[row, :, slot] = cur
+        self._counts[row, slot] = count + 1
+        self._classify_cache = None
+        return True
+
+    def add_samples_batch(self, rows: np.ndarray, window_index: int, values: np.ndarray) -> None:
+        """Vectorized ingest of many single-sample entities in one window
+        (the common case: one sample per partition per fetch). ``rows`` MUST
+        be unique row indices — the aggregator deduplicates before calling."""
+        slot = self._slot(window_index)
+        fresh = self._counts[rows, slot] == 0
+        fr = rows[fresh]
+        self._values[fr, :, slot] = values[fresh]
+        stale = rows[~fresh]
+        if stale.size:
+            sv = values[~fresh]
+            cur = self._values[stale, :, slot]
+            cur[:, self._avg_mask] += sv[:, self._avg_mask]
+            cur[:, self._max_mask] = np.maximum(cur[:, self._max_mask], sv[:, self._max_mask])
+            cur[:, self._latest_mask] = sv[:, self._latest_mask]
+            self._values[stale, :, slot] = cur
+        self._counts[rows, slot] += 1
+        self._classify_cache = None
+
+    def num_samples(self) -> int:
+        return int(self._counts[:len(self._entity_of)].sum())
+
+    # ---- classification & aggregation (vectorized) ----------------------
+    def _stable_slots(self) -> np.ndarray:
+        return np.array([self._slot(w) for w in self.stable_window_indices()], dtype=np.int64)
+
+    def classify(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Classify every (entity, stable window) into an Extrapolation
+        category; returns (categories[E, Ws], valid[E, Ws], extrapolated[E, Ws]).
+
+        Mirrors RawMetricValues.aggregate's category decision
+        (RawMetricValues.java:292-330) and the validity rules of
+        updateEnoughSamples/updateForcedInsufficient/updateAvgAdjacent
+        (RawMetricValues.java:425-465): a window is valid iff it has any
+        sample or both stable neighbours have >= min samples; edge stable
+        windows have no neighbours. Memoized until the next mutation.
+        """
+        if self._classify_cache is not None:
+            return self._classify_cache
+        e = len(self._entity_of)
+        slots = self._stable_slots()
+        counts = self._counts[:e][:, slots]  # [E, Ws]
+        ws = len(slots)
+
+        enough = counts >= self._min_samples
+        avg_avail = (counts >= self._half_min) & ~enough
+        # Neighbour sufficiency (stable-window neighbours only; edges excluded).
+        prev_ok = np.zeros_like(enough)
+        next_ok = np.zeros_like(enough)
+        if ws >= 3:
+            prev_ok[:, 1:] = counts[:, :-1] >= self._min_samples
+            next_ok[:, :-1] = counts[:, 1:] >= self._min_samples
+            prev_ok[:, 0] = False
+            next_ok[:, -1] = False
+        adjacent = ~enough & ~avg_avail & prev_ok & next_ok
+        forced = ~enough & ~avg_avail & ~adjacent & (counts > 0)
+        nothing = ~enough & ~avg_avail & ~adjacent & (counts == 0)
+
+        cats = np.full((e, ws), int(Extrapolation.NONE), dtype=np.int8)
+        cats[avg_avail] = int(Extrapolation.AVG_AVAILABLE)
+        cats[adjacent] = int(Extrapolation.AVG_ADJACENT)
+        cats[forced] = int(Extrapolation.FORCED_INSUFFICIENT)
+        cats[nothing] = int(Extrapolation.NO_VALID_EXTRAPOLATION)
+
+        valid = (counts > 0) | (prev_ok & next_ok)
+        extrapolated = valid & ~enough
+        self._classify_cache = (cats, valid, extrapolated)
+        return self._classify_cache
+
+    def aggregate_values(self) -> tuple[np.ndarray, np.ndarray]:
+        """Reduce every stable window for every entity and metric; returns
+        (agg[E, M, Ws] float32, cats[E, Ws] int8).
+
+        AVG metrics divide the accumulated sum by the count; MAX/LATEST carry
+        the stored value (RawMetricValues.getValue). AVG_ADJACENT windows
+        blend (prev, cur, next) per RawMetricValues.java:303-318.
+        """
+        e = len(self._entity_of)
+        slots = self._stable_slots()
+        counts = self._counts[:e][:, slots].astype(np.float32)  # [E, Ws]
+        vals = self._values[:e][:, :, slots]  # [E, M, Ws]
+        cats, _valid, _extra = self.classify()
+
+        safe_counts = np.maximum(counts, 1.0)[:, None, :]
+        reduced = np.where(self._avg_mask[None, :, None], vals / safe_counts, vals)
+        reduced = np.where((counts[:, None, :] > 0), reduced, 0.0)
+
+        adjacent = cats == int(Extrapolation.AVG_ADJACENT)
+        if adjacent.any() and len(slots) >= 3:
+            prev_v = np.zeros_like(vals)
+            next_v = np.zeros_like(vals)
+            prev_v[:, :, 1:] = vals[:, :, :-1]
+            next_v[:, :, :-1] = vals[:, :, 1:]
+            prev_c = np.zeros_like(counts)
+            next_c = np.zeros_like(counts)
+            prev_c[:, 1:] = counts[:, :-1]
+            next_c[:, :-1] = counts[:, 1:]
+            has_cur = (counts > 0).astype(np.float32)
+            total = prev_v + next_v + vals * (counts[:, None, :] > 0)
+            denom_avg = prev_c + next_c + counts
+            denom_other = 2.0 + has_cur
+            blended = np.where(self._avg_mask[None, :, None],
+                               total / np.maximum(denom_avg, 1.0)[:, None, :],
+                               total / denom_other[:, None, :])
+            reduced = np.where(adjacent[:, None, :], blended, reduced)
+        return reduced.astype(np.float32), cats
+
+    def entity_validity(self, max_allowed_extrapolations: int) -> np.ndarray:
+        """Per-entity validity over all stable windows
+        (RawMetricValues.isValid)."""
+        _cats, valid, extrapolated = self.classify()
+        return valid.all(axis=1) & (extrapolated.sum(axis=1) <= max_allowed_extrapolations)

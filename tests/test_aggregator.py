@@ -1,0 +1,185 @@
+"""Aggregator tests.
+
+Mirrors the behaviors of core MetricSampleAggregatorTest / RawMetricValues:
+window rolling, AVG/MAX/LATEST reduction, the four extrapolation categories,
+completeness gating, and generation bumping.
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.metricdef.metricdef import MetricDef, ValueComputingStrategy as S
+from cruise_control_tpu.monitor.aggregator import (
+    AggregationOptions, Extrapolation, Granularity, MetricSampleAggregator,
+    NotEnoughValidWindowsError,
+)
+
+WINDOW_MS = 1000
+
+
+def make_def():
+    d = MetricDef()
+    d.define("avg_m", S.AVG)
+    d.define("max_m", S.MAX)
+    d.define("latest_m", S.LATEST)
+    return d
+
+
+def agg(num_windows=4, min_samples=2, group_fn=None):
+    return MetricSampleAggregator(num_windows, WINDOW_MS, min_samples, make_def(),
+                                  group_fn=group_fn)
+
+
+def fill_window(a, entity, window, n, base=10.0):
+    for i in range(n):
+        a.add_sample(entity, window * WINDOW_MS + i, np.array([base + i, base + i, base + i]))
+
+
+def test_avg_max_latest_reduction():
+    a = agg()
+    # Fill windows 0..3 (3 is still "current"; stable = 0..2 after rolling to 3).
+    for w in range(4):
+        fill_window(a, "e0", w, 2, base=10.0 * (w + 1))
+    res = a.aggregate(AggregationOptions(min_valid_windows=1))
+    assert res.window_indices == [0, 1, 2]
+    vals = res.values[0]  # [M, W]
+    # AVG: (10+11)/2=10.5 in window 0
+    assert vals[0, 0] == pytest.approx(10.5)
+    # MAX: max(10,11)=11
+    assert vals[1, 0] == pytest.approx(11.0)
+    # LATEST: last value wins
+    assert vals[2, 0] == pytest.approx(11.0)
+    assert (res.extrapolations[0] == Extrapolation.NONE).all()
+    assert res.entity_valid[0]
+
+
+def test_avg_available_extrapolation():
+    a = agg(min_samples=4)  # half-min = 2
+    for w in range(4):
+        n = 2 if w == 1 else 4  # window 1 has only half the required samples
+        fill_window(a, "e0", w, n)
+    res = a.aggregate(AggregationOptions(min_valid_windows=1))
+    cats = res.extrapolations[0]
+    assert cats[0] == Extrapolation.NONE
+    assert cats[1] == Extrapolation.AVG_AVAILABLE
+    assert res.entity_valid[0]  # extrapolated but valid
+
+
+def test_avg_adjacent_extrapolation():
+    a = agg(min_samples=2)
+    for w in range(4):
+        if w == 1:
+            continue  # window 1 empty; neighbours 0 and 2 are full
+        fill_window(a, "e0", w, 2, base=30.0)
+    res = a.aggregate(AggregationOptions(min_valid_windows=1))
+    cats = res.extrapolations[0]
+    assert cats[1] == Extrapolation.AVG_ADJACENT
+    # AVG metric: (sum0 + 0 + sum2) / (2 + 0 + 2) = avg of neighbours
+    assert res.values[0][0, 1] == pytest.approx(30.5)
+    # MAX metric: (31 + 31) / 2
+    assert res.values[0][1, 1] == pytest.approx(31.0)
+    assert res.entity_valid[0]
+
+
+def test_forced_insufficient_and_no_valid():
+    a = agg(min_samples=4)  # half-min = 2
+    # window 0: 1 sample (< half-min, edge → FORCED_INSUFFICIENT)
+    fill_window(a, "e0", 0, 1)
+    # window 1: 0 samples, neighbours not both full → NO_VALID
+    fill_window(a, "e0", 2, 1)
+    a.store.roll_to(3)
+    res = a.aggregate(AggregationOptions(min_valid_windows=1,
+                                         include_invalid_entities=True))
+    cats = res.extrapolations[0]
+    assert cats[0] == Extrapolation.FORCED_INSUFFICIENT
+    assert cats[1] == Extrapolation.NO_VALID_EXTRAPOLATION
+    assert not res.entity_valid[0]  # window 1 invalid → entity invalid
+
+
+def test_window_rolling_drops_old():
+    a = agg(num_windows=2)
+    fill_window(a, "e0", 0, 2)
+    fill_window(a, "e0", 10, 2)  # far future roll; old windows reset
+    assert a.available_windows() == [8, 9]
+    # windows 8,9 are empty (counts reset); only current window 10 has data.
+    assert a.num_samples() == 2
+
+
+def test_late_sample_dropped():
+    a = agg(num_windows=2)
+    fill_window(a, "e0", 5, 2)
+    assert not a.add_sample("e0", 0 * WINDOW_MS, np.zeros(3))
+
+
+def test_completeness_entity_ratio_gate():
+    a = agg(min_samples=1)
+    for w in range(4):
+        fill_window(a, "good", w, 1)
+    fill_window(a, "sparse", 0, 1)  # sparse entity misses windows 1,2
+    opts = AggregationOptions(min_valid_entity_ratio=0.9, min_valid_windows=3)
+    with pytest.raises(NotEnoughValidWindowsError):
+        a.aggregate(opts)
+    # Lower the bar: all 3 stable windows pass at 50% entity coverage.
+    res = a.aggregate(AggregationOptions(min_valid_entity_ratio=0.5, min_valid_windows=3))
+    assert len(res.window_indices) == 3
+
+
+def test_entity_group_granularity():
+    # Two entities in the same group (topic); one sparse entity poisons the
+    # group under ENTITY_GROUP granularity.
+    group_fn = lambda e: e.split("-")[0]
+    a = agg(min_samples=1, group_fn=group_fn)
+    for w in range(4):
+        fill_window(a, "t1-p0", w, 1)
+        fill_window(a, "t2-p0", w, 1)
+    fill_window(a, "t1-p1", 0, 1)  # t1-p1 invalid in windows 1,2
+    res_e = a.completeness(AggregationOptions(min_valid_windows=1,
+                                              granularity=Granularity.ENTITY))
+    res_g = a.completeness(AggregationOptions(min_valid_windows=1,
+                                              granularity=Granularity.ENTITY_GROUP))
+    # Under ENTITY granularity windows 1,2 have 2/3 coverage; under
+    # ENTITY_GROUP the t1 group is invalid there so coverage drops to 1/3.
+    assert res_e.valid_entity_ratio_by_window[1] == pytest.approx(2 / 3)
+    assert res_g.valid_entity_ratio_by_window[1] == pytest.approx(1 / 3)
+
+
+def test_generation_bumps_and_cache():
+    a = agg(min_samples=1)
+    g0 = a.generation
+    fill_window(a, "e0", 0, 1)
+    assert a.generation > g0
+    for w in range(1, 4):
+        fill_window(a, "e0", w, 1)
+    r1 = a.aggregate(AggregationOptions())
+    r2 = a.aggregate(AggregationOptions())
+    assert r1 is r2  # cached at same generation
+    fill_window(a, "e0", 3, 1)
+    r3 = a.aggregate(AggregationOptions())
+    assert r3 is not r1
+
+
+def test_batch_ingest_matches_loop():
+    a1 = agg(min_samples=1)
+    a2 = agg(min_samples=1)
+    ents = [f"p{i}" for i in range(5)]
+    vals = np.arange(15, dtype=np.float32).reshape(5, 3)
+    for w in range(4):
+        for i, e in enumerate(ents):
+            a1.add_sample(e, w * WINDOW_MS, vals[i])
+        a2.add_samples_batch(ents, w * WINDOW_MS, vals)
+    r1 = a1.aggregate(AggregationOptions())
+    r2 = a2.aggregate(AggregationOptions())
+    np.testing.assert_allclose(r1.values, r2.values)
+
+
+def test_remove_and_retain_entities():
+    a = agg(min_samples=1)
+    for w in range(4):
+        for e in ("a", "b", "c"):
+            fill_window(a, e, w, 1)
+    a.remove_entities(["b"])
+    res = a.aggregate(AggregationOptions())
+    assert res.entities == ["a", "c"]
+    a.retain_entities(["c"])
+    res = a.aggregate(AggregationOptions())
+    assert res.entities == ["c"]
