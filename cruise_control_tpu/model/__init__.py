@@ -1,0 +1,10 @@
+from .tensors import (
+    ClusterMeta, ClusterTensors, alive_mask, apply_leadership_move,
+    apply_replica_move, apply_swap, broker_leader_counts, broker_load,
+    broker_replica_counts, is_leader_slot, new_broker_mask, offline_replicas,
+    potential_nw_out, rack_partition_counts, replica_exists, replica_load,
+    set_broker_state, topic_broker_leader_counts, topic_broker_replica_counts,
+)
+from .builder import BrokerSpec, ClusterModelBuilder, PartitionSpec, derive_follower_load
+from .stats import ClusterModelStats, cluster_stats
+from . import fixtures

@@ -1,0 +1,163 @@
+"""Host-side builder: topology description → ClusterTensors + ClusterMeta.
+
+Reference parity: the construction path LoadMonitor.clusterModel →
+createRack/createBroker/createReplica/setReplicaLoads
+(ClusterModel.java:297-520, MonitorUtils.populatePartitionLoad:415).
+Redesign: the builder collects plain Python/numpy rows then freezes them
+into padded device arrays once; there is no mutable model object.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from ..common.broker_state import BrokerState
+from ..common.resources import NUM_RESOURCES, Resource
+from .tensors import ClusterMeta, ClusterTensors
+
+
+def _pad_up(n: int, bucket: int) -> int:
+    """Round up to a bucket size so recompilation only happens when a
+    cluster crosses a bucket boundary (dynamic topics/partitions strategy,
+    SURVEY.md §7 hard part (d))."""
+    if bucket <= 1:
+        return max(n, 1)
+    return max(bucket, ((n + bucket - 1) // bucket) * bucket)
+
+
+@dataclasses.dataclass
+class BrokerSpec:
+    broker_id: int
+    rack: str
+    capacity: Mapping[Resource, float]
+    state: BrokerState = BrokerState.ALIVE
+
+
+@dataclasses.dataclass
+class PartitionSpec:
+    topic: str
+    partition: int
+    replicas: Sequence[int]          # broker ids, leader first by convention
+    leader_index: int = 0            # index into replicas; -1 = no leader
+    leader_load: Mapping[Resource, float] | None = None
+    follower_load: Mapping[Resource, float] | None = None
+
+
+class ClusterModelBuilder:
+    def __init__(self, partition_bucket: int = 0, broker_bucket: int = 0):
+        self._brokers: list[BrokerSpec] = []
+        self._partitions: list[PartitionSpec] = []
+        self._partition_bucket = partition_bucket
+        self._broker_bucket = broker_bucket
+
+    def add_broker(self, broker_id: int, rack: str,
+                   capacity: Mapping[Resource, float],
+                   state: BrokerState = BrokerState.ALIVE) -> "ClusterModelBuilder":
+        self._brokers.append(BrokerSpec(broker_id, rack, capacity, state))
+        return self
+
+    def add_partition(self, topic: str, partition: int, replicas: Sequence[int],
+                      leader_load: Mapping[Resource, float] | None = None,
+                      follower_load: Mapping[Resource, float] | None = None,
+                      leader_index: int = 0) -> "ClusterModelBuilder":
+        self._partitions.append(PartitionSpec(topic, partition, replicas,
+                                              leader_index, leader_load, follower_load))
+        return self
+
+    def build(self) -> tuple[ClusterTensors, ClusterMeta]:
+        if not self._brokers:
+            raise ValueError("cluster must have at least one broker")
+        brokers = sorted(self._brokers, key=lambda b: b.broker_id)
+        broker_ids = [b.broker_id for b in brokers]
+        if len(set(broker_ids)) != len(broker_ids):
+            raise ValueError("duplicate broker ids")
+        broker_index = {bid: i for i, bid in enumerate(broker_ids)}
+        racks = sorted({b.rack for b in brokers})
+        rack_index = {r: i for i, r in enumerate(racks)}
+
+        topics = sorted({p.topic for p in self._partitions})
+        topic_index = {t: i for i, t in enumerate(topics)}
+        parts = sorted(self._partitions, key=lambda p: (p.topic, p.partition))
+
+        n_p = _pad_up(len(parts), self._partition_bucket)
+        n_b = _pad_up(len(brokers), self._broker_bucket)
+        max_rf = max((len(p.replicas) for p in parts), default=1)
+
+        assignment = np.full((n_p, max_rf), -1, dtype=np.int32)
+        leader_slot = np.full((n_p,), -1, dtype=np.int32)
+        leader_load = np.zeros((n_p, NUM_RESOURCES), dtype=np.float32)
+        follower_load = np.zeros((n_p, NUM_RESOURCES), dtype=np.float32)
+        topic_arr = np.zeros((n_p,), dtype=np.int32)
+        partition_mask = np.zeros((n_p,), dtype=bool)
+
+        seen_parts = set()
+        part_names: list[tuple[str, int]] = []
+        for i, p in enumerate(parts):
+            if (p.topic, p.partition) in seen_parts:
+                raise ValueError(f"duplicate partition {p.topic}-{p.partition}")
+            seen_parts.add((p.topic, p.partition))
+            if len(set(p.replicas)) != len(p.replicas):
+                raise ValueError(f"partition {p.topic}-{p.partition} has duplicate replicas")
+            if p.leader_index != -1 and not 0 <= p.leader_index < len(p.replicas):
+                raise ValueError(f"partition {p.topic}-{p.partition}: leader_index "
+                                 f"{p.leader_index} out of range for {len(p.replicas)} replicas")
+            for s, bid in enumerate(p.replicas):
+                if bid not in broker_index:
+                    raise ValueError(f"partition {p.topic}-{p.partition} references "
+                                     f"unknown broker {bid}")
+                assignment[i, s] = broker_index[bid]
+            leader_slot[i] = p.leader_index
+            topic_arr[i] = topic_index[p.topic]
+            partition_mask[i] = True
+            part_names.append((p.topic, p.partition))
+            if p.leader_load:
+                for r, v in p.leader_load.items():
+                    leader_load[i, int(r)] = v
+            if p.follower_load is not None:
+                for r, v in p.follower_load.items():
+                    follower_load[i, int(r)] = v
+            else:
+                follower_load[i] = derive_follower_load(leader_load[i])
+
+        capacity = np.zeros((n_b, NUM_RESOURCES), dtype=np.float32)
+        rack_arr = np.zeros((n_b,), dtype=np.int32)
+        broker_state = np.full((n_b,), int(BrokerState.DEAD), dtype=np.int8)
+        broker_mask = np.zeros((n_b,), dtype=bool)
+        for i, b in enumerate(brokers):
+            for r, v in b.capacity.items():
+                capacity[i, int(r)] = v
+            rack_arr[i] = rack_index[b.rack]
+            broker_state[i] = int(b.state)
+            broker_mask[i] = True
+
+        import jax.numpy as jnp
+        state = ClusterTensors(
+            assignment=jnp.asarray(assignment),
+            leader_slot=jnp.asarray(leader_slot),
+            leader_load=jnp.asarray(leader_load),
+            follower_load=jnp.asarray(follower_load),
+            capacity=jnp.asarray(capacity),
+            rack=jnp.asarray(rack_arr),
+            broker_state=jnp.asarray(broker_state),
+            topic=jnp.asarray(topic_arr),
+            partition_mask=jnp.asarray(partition_mask),
+            broker_mask=jnp.asarray(broker_mask),
+        )
+        meta = ClusterMeta(broker_ids=broker_ids, topic_names=topics,
+                           rack_names=racks, num_topics=len(topics),
+                           partition_index=part_names)
+        return state, meta
+
+
+def derive_follower_load(leader_load_row: np.ndarray,
+                         follower_cpu_fraction: float = 0.4) -> np.ndarray:
+    """Follower load from leader load: replication bytes-in ≈ leader
+    bytes-in, no NW_OUT, same disk footprint, reduced CPU
+    (ModelUtils.estimateFollowerCpuUtilFromLeaderLoad, ModelUtils.java:64)."""
+    out = np.array(leader_load_row, dtype=np.float32)
+    out[int(Resource.NW_OUT)] = 0.0
+    out[int(Resource.CPU)] = leader_load_row[int(Resource.CPU)] * follower_cpu_fraction
+    return out

@@ -1,0 +1,141 @@
+"""Deterministic and randomized cluster fixtures for tests and benchmarks.
+
+Reference parity (ideas, not data): cruise-control common/DeterministicCluster.java
+(small hand-built unbalanced clusters, rack-aware satisfiable/unsatisfiable
+topologies) and model/RandomCluster.java (clusters drawn from UNIFORM /
+LINEAR / EXPONENTIAL resource distributions).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+from ..common.broker_state import BrokerState
+from ..common.resources import Resource
+from .builder import ClusterModelBuilder
+from .tensors import ClusterMeta, ClusterTensors
+
+_CAP = {Resource.CPU: 100.0, Resource.NW_IN: 1000.0,
+        Resource.NW_OUT: 1000.0, Resource.DISK: 10000.0}
+
+
+def small_unbalanced(num_brokers: int = 3, partitions_per_topic: int = 4,
+                     rf: int = 2) -> tuple[ClusterTensors, ClusterMeta]:
+    """All leaders piled on broker 0 (DeterministicCluster.unbalanced idea):
+    replica and leader distribution goals must move load off broker 0."""
+    b = ClusterModelBuilder()
+    for i in range(num_brokers):
+        b.add_broker(i, f"r{i % 2}", _CAP)
+    for t in ("t1", "t2"):
+        for p in range(partitions_per_topic):
+            replicas = [0] + [1 + (p + k) % (num_brokers - 1) for k in range(rf - 1)] \
+                if num_brokers > 1 else [0]
+            b.add_partition(t, p, replicas,
+                            leader_load={Resource.CPU: 10.0, Resource.NW_IN: 50.0,
+                                         Resource.NW_OUT: 60.0, Resource.DISK: 300.0})
+    return b.build()
+
+
+def rack_aware_satisfiable() -> tuple[ClusterTensors, ClusterMeta]:
+    """Three racks, RF=2, one partition placed with both replicas in the
+    same rack (fixable: another rack has room).
+    (DeterministicCluster.rackAwareSatisfiable idea.)"""
+    b = ClusterModelBuilder()
+    b.add_broker(0, "rA", _CAP).add_broker(1, "rA", _CAP)
+    b.add_broker(2, "rB", _CAP).add_broker(3, "rC", _CAP)
+    load = {Resource.CPU: 5.0, Resource.NW_IN: 20.0, Resource.NW_OUT: 25.0,
+            Resource.DISK: 100.0}
+    b.add_partition("t1", 0, [0, 1], leader_load=load)      # violation: both in rA
+    b.add_partition("t1", 1, [2, 0], leader_load=load)
+    b.add_partition("t1", 2, [3, 2], leader_load=load)
+    return b.build()
+
+
+def rack_aware_unsatisfiable() -> tuple[ClusterTensors, ClusterMeta]:
+    """RF=3 but only two racks: RackAwareGoal must fail
+    (DeterministicCluster.rackAwareUnsatisfiable idea)."""
+    b = ClusterModelBuilder()
+    b.add_broker(0, "rA", _CAP).add_broker(1, "rA", _CAP).add_broker(2, "rB", _CAP)
+    load = {Resource.CPU: 5.0, Resource.NW_IN: 20.0, Resource.NW_OUT: 25.0,
+            Resource.DISK: 100.0}
+    b.add_partition("t1", 0, [0, 1, 2], leader_load=load)
+    return b.build()
+
+
+def dead_broker_cluster() -> tuple[ClusterTensors, ClusterMeta]:
+    """A 4-broker cluster where broker 3 is DEAD and hosts replicas —
+    self-healing must move them (deadBroker fixture idea)."""
+    b = ClusterModelBuilder()
+    for i in range(3):
+        b.add_broker(i, f"r{i}", _CAP)
+    b.add_broker(3, "r0", _CAP, state=BrokerState.DEAD)
+    load = {Resource.CPU: 5.0, Resource.NW_IN: 20.0, Resource.NW_OUT: 25.0,
+            Resource.DISK: 100.0}
+    for p in range(4):
+        b.add_partition("t1", p, [3, (p % 3)], leader_load=load)
+    return b.build()
+
+
+class Dist(enum.Enum):
+    UNIFORM = "uniform"
+    LINEAR = "linear"
+    EXPONENTIAL = "exponential"
+
+
+def random_cluster(num_brokers: int, num_topics: int, num_partitions: int,
+                   rf: int = 3, num_racks: int = 4, dist: Dist = Dist.UNIFORM,
+                   seed: int = 0, skew_to_first: float = 0.0,
+                   partition_bucket: int = 0, broker_bucket: int = 0,
+                   target_utilization: float = 0.5,
+                   ) -> tuple[ClusterTensors, ClusterMeta]:
+    """Random cluster à la RandomCluster.java: partition loads drawn from the
+    given distribution; ``skew_to_first`` biases placement toward low-index
+    brokers to create imbalance worth fixing. Loads are normalized so the
+    cluster-average NW_OUT utilization ≈ ``target_utilization``."""
+    rng = np.random.default_rng(seed)
+    rf = min(rf, num_brokers)
+    b = ClusterModelBuilder(partition_bucket=partition_bucket, broker_bucket=broker_bucket)
+    for i in range(num_brokers):
+        b.add_broker(i, f"rack{i % num_racks}", _CAP)
+
+    if dist is Dist.UNIFORM:
+        base = rng.uniform(0.2, 1.0, size=num_partitions)
+    elif dist is Dist.LINEAR:
+        base = np.linspace(0.1, 1.0, num_partitions)
+        rng.shuffle(base)
+    else:
+        base = rng.exponential(0.3, size=num_partitions).clip(0.02, 3.0)
+
+    topic_of = rng.integers(0, num_topics, size=num_partitions)
+    weights = np.ones(num_brokers)
+    if skew_to_first > 0:
+        weights = np.exp(-skew_to_first * np.arange(num_brokers) / max(1, num_brokers - 1))
+    weights = weights / weights.sum()
+
+    # Per-resource load coefficients solved so each resource's expected
+    # cluster-average utilization ≈ target. Replication multiplies NW_IN and
+    # DISK by rf and CPU by 1 + follower_fraction·(rf-1); NW_OUT is
+    # leader-only (derive_follower_load semantics).
+    mean_scale = float(base.mean())
+    per_broker = num_partitions / num_brokers * mean_scale
+    coeff = {
+        Resource.NW_OUT: target_utilization * _CAP[Resource.NW_OUT] / per_broker,
+        Resource.NW_IN: target_utilization * _CAP[Resource.NW_IN] / (per_broker * rf),
+        Resource.DISK: target_utilization * _CAP[Resource.DISK] / (per_broker * rf),
+        Resource.CPU: target_utilization * _CAP[Resource.CPU]
+        / (per_broker * (1.0 + 0.4 * (rf - 1))),
+    }
+
+    per_topic_counter: dict[int, int] = {}
+    for i in range(num_partitions):
+        t = int(topic_of[i])
+        pnum = per_topic_counter.get(t, 0)
+        per_topic_counter[t] = pnum + 1
+        replicas = rng.choice(num_brokers, size=rf, replace=False, p=weights)
+        scale = float(base[i])
+        b.add_partition(
+            f"topic{t}", pnum, [int(x) for x in replicas],
+            leader_load={r: coeff[r] * scale for r in Resource})
+    return b.build()

@@ -1,0 +1,78 @@
+"""Cluster statistics: the optimizer's objective snapshot.
+
+Reference parity: model/ClusterModelStats.java:84 (populate) — {AVG, MAX,
+MIN, ST_DEV} over alive brokers for per-resource utilization, potential
+NW-out, replica counts, leader-replica counts, topic-replica counts.
+Computed as one jitted reduction over the tensor model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..common.resources import NUM_RESOURCES
+from .tensors import (
+    ClusterTensors, alive_mask, broker_leader_counts, broker_load,
+    broker_replica_counts, potential_nw_out,
+)
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["utilization_avg", "utilization_max", "utilization_min",
+                      "utilization_std", "potential_nw_out_stats",
+                      "replica_count_stats", "leader_count_stats",
+                      "num_alive_brokers"],
+         meta_fields=[])
+@dataclasses.dataclass(frozen=True)
+class ClusterModelStats:
+    utilization_avg: jax.Array      # [R]
+    utilization_max: jax.Array      # [R]
+    utilization_min: jax.Array      # [R]
+    utilization_std: jax.Array      # [R]
+    potential_nw_out_stats: jax.Array  # [4] avg/max/min/std
+    replica_count_stats: jax.Array     # [4]
+    leader_count_stats: jax.Array      # [4]
+    num_alive_brokers: jax.Array       # scalar int32
+
+
+def _masked_stats(values: jax.Array, mask: jax.Array) -> jax.Array:
+    """avg/max/min/std over masked entries; zeros when mask is empty."""
+    n = jnp.maximum(mask.sum(), 1)
+    masked = jnp.where(mask, values, 0.0)
+    avg = masked.sum() / n
+    mx = jnp.where(mask, values, -jnp.inf).max()
+    mn = jnp.where(mask, values, jnp.inf).min()
+    var = jnp.where(mask, (values - avg) ** 2, 0.0).sum() / n
+    any_alive = mask.any()
+    return jnp.where(any_alive,
+                     jnp.stack([avg, mx, mn, jnp.sqrt(var)]),
+                     jnp.zeros(4))
+
+
+@jax.jit
+def cluster_stats(state: ClusterTensors) -> ClusterModelStats:
+    alive = alive_mask(state)
+    load = broker_load(state)                      # [B, R]
+    cap = jnp.maximum(state.capacity, 1e-9)
+    util = load / cap                              # [B, R]
+
+    per_resource = jax.vmap(lambda col: _masked_stats(col, alive), in_axes=1,
+                            out_axes=1)(util)      # [4, R]
+    pot = _masked_stats(potential_nw_out(state), alive)
+    rep = _masked_stats(broker_replica_counts(state).astype(jnp.float32), alive)
+    led = _masked_stats(broker_leader_counts(state).astype(jnp.float32), alive)
+
+    return ClusterModelStats(
+        utilization_avg=per_resource[0],
+        utilization_max=per_resource[1],
+        utilization_min=per_resource[2],
+        utilization_std=per_resource[3],
+        potential_nw_out_stats=pot,
+        replica_count_stats=rep,
+        leader_count_stats=led,
+        num_alive_brokers=alive.sum().astype(jnp.int32),
+    )

@@ -1,0 +1,235 @@
+"""The cluster model as dense device tensors.
+
+Reference parity: model/ClusterModel.java (rack→broker→replica topology with
+per-replica load), model/Load.java, model/Partition.java. Where the
+reference keeps a mutable object graph and mutates it during search, this
+model is a frozen pytree of arrays; "mutation" is a functional update that
+XLA fuses into the search loop, and a model "generation" is simply a new
+pytree value.
+
+Array schema (P partitions × S replica slots × B brokers × R resources):
+
+- ``assignment[P, S]`` int32 — broker index per replica slot, -1 empty.
+- ``leader_slot[P]`` int32 — which slot is the leader (-1 = offline/no leader).
+- ``leader_load[P, R]`` float32 — resource load a broker bears when hosting
+  the leader replica (CPU=leader cpu, NW_IN=leader bytes-in, NW_OUT=leader
+  bytes-out, DISK=partition size; MonitorUtils.populatePartitionLoad).
+- ``follower_load[P, R]`` float32 — load when hosting a follower (follower
+  cpu estimate, replication bytes-in, zero NW_OUT, same disk).
+- ``capacity[B, R]`` float32 — broker capacity (BrokerCapacityConfigResolver).
+- ``rack[B]`` int32 — rack index per broker (Rack.java topology flattened).
+- ``broker_state[B]`` int8 — BrokerState codes (ALIVE/DEAD/NEW/DEMOTED/BAD_DISKS).
+- ``topic[P]`` int32 — topic index per partition.
+- ``partition_mask[P]`` / ``broker_mask[B]`` bool — padding masks (static
+  shapes for XLA; clusters are padded up to bucket sizes).
+
+Padded replica slots use broker index = B (one-past-the-end) inside kernels
+so segment reductions drop them without branching.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.broker_state import BrokerState
+from ..common.resources import NUM_RESOURCES
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["assignment", "leader_slot", "leader_load", "follower_load",
+                      "capacity", "rack", "broker_state", "topic",
+                      "partition_mask", "broker_mask"],
+         meta_fields=[])
+@dataclasses.dataclass(frozen=True)
+class ClusterTensors:
+    assignment: jax.Array     # [P, S] int32
+    leader_slot: jax.Array    # [P] int32
+    leader_load: jax.Array    # [P, R] float32
+    follower_load: jax.Array  # [P, R] float32
+    capacity: jax.Array       # [B, R] float32
+    rack: jax.Array           # [B] int32
+    broker_state: jax.Array   # [B] int8
+    topic: jax.Array          # [P] int32
+    partition_mask: jax.Array  # [P] bool
+    broker_mask: jax.Array    # [B] bool
+
+    @property
+    def num_partitions(self) -> int:
+        return self.assignment.shape[0]
+
+    @property
+    def max_replication_factor(self) -> int:
+        return self.assignment.shape[1]
+
+    @property
+    def num_brokers(self) -> int:
+        return self.capacity.shape[0]
+
+    @property
+    def num_topics(self) -> int:
+        # Static upper bound: topics are indexed densely by the builder.
+        return self.num_partitions
+
+
+@dataclasses.dataclass
+class ClusterMeta:
+    """Host-side names for the integer indices of a ClusterTensors value
+    (broker ids, topic names, rack names). Not traced."""
+
+    broker_ids: list[int]
+    topic_names: list[str]
+    rack_names: list[str]
+    num_topics: int
+    partition_index: list[tuple[str, int]]  # row → (topic, partition number)
+
+
+# ---- derived quantities (all jittable) -----------------------------------
+
+def replica_exists(state: ClusterTensors) -> jax.Array:
+    """[P, S] bool — slot holds a real replica of a real partition."""
+    return (state.assignment >= 0) & state.partition_mask[:, None]
+
+
+def is_leader_slot(state: ClusterTensors) -> jax.Array:
+    """[P, S] bool — slot is the partition's leader."""
+    s = jnp.arange(state.max_replication_factor, dtype=state.leader_slot.dtype)
+    return (state.leader_slot[:, None] == s[None, :]) & replica_exists(state)
+
+
+def replica_load(state: ClusterTensors) -> jax.Array:
+    """[P, S, R] float32 — per-slot resource load (leader vs follower)."""
+    lead = is_leader_slot(state)
+    load = jnp.where(lead[:, :, None], state.leader_load[:, None, :],
+                     state.follower_load[:, None, :])
+    return load * replica_exists(state)[:, :, None]
+
+
+def _scatter_to_brokers(state: ClusterTensors, per_slot: jax.Array) -> jax.Array:
+    """Sum a [P, S] or [P, S, R] per-replica quantity into per-broker rows
+    ([B] or [B, R]). Padded slots route to a dead bucket at index B."""
+    b = state.num_brokers
+    seg = jnp.where(state.assignment >= 0, state.assignment, b).reshape(-1)
+    flat = per_slot.reshape((seg.shape[0],) + per_slot.shape[2:])
+    out = jax.ops.segment_sum(flat, seg, num_segments=b + 1)
+    return out[:b]
+
+
+def broker_load(state: ClusterTensors) -> jax.Array:
+    """[B, R] float32 — total resource load per broker
+    (ClusterModel load accounting; the solver's hottest reduction)."""
+    return _scatter_to_brokers(state, replica_load(state))
+
+
+def broker_replica_counts(state: ClusterTensors) -> jax.Array:
+    """[B] int32 — replicas hosted per broker."""
+    return _scatter_to_brokers(state, replica_exists(state).astype(jnp.int32))
+
+
+def broker_leader_counts(state: ClusterTensors) -> jax.Array:
+    """[B] int32 — leader replicas per broker."""
+    return _scatter_to_brokers(state, is_leader_slot(state).astype(jnp.int32))
+
+
+def _topic_broker_counts(state: ClusterTensors, num_topics: int,
+                         per_slot: jax.Array) -> jax.Array:
+    """[T, B] int32 — count of ``per_slot``-selected replicas per
+    (topic, broker) via one flattened segment-sum; masked-out slots route to
+    a one-past-the-end bucket."""
+    b = state.num_brokers
+    seg = jnp.where(per_slot, state.topic[:, None] * (b + 1)
+                    + jnp.where(state.assignment >= 0, state.assignment, b),
+                    num_topics * (b + 1))
+    flat = per_slot.astype(jnp.int32).reshape(-1)
+    out = jax.ops.segment_sum(flat, seg.reshape(-1), num_segments=num_topics * (b + 1) + 1)
+    return out[:num_topics * (b + 1)].reshape(num_topics, b + 1)[:, :b]
+
+
+def topic_broker_replica_counts(state: ClusterTensors, num_topics: int) -> jax.Array:
+    """[T, B] int32 — replicas per (topic, broker), for topic-replica
+    distribution and min-topic-leaders goals."""
+    return _topic_broker_counts(state, num_topics, replica_exists(state))
+
+
+def topic_broker_leader_counts(state: ClusterTensors, num_topics: int) -> jax.Array:
+    """[T, B] int32 — leaders per (topic, broker)."""
+    return _topic_broker_counts(state, num_topics, is_leader_slot(state))
+
+
+def potential_nw_out(state: ClusterTensors) -> jax.Array:
+    """[B] float32 — potential network-outbound load per broker: the NW_OUT
+    every broker would bear if all its replicas became leaders
+    (ClusterModel.potentialLeadershipLoadFor; used by PotentialNwOutGoal)."""
+    from ..common.resources import Resource
+    nw_out = state.leader_load[:, Resource.NW_OUT]
+    per_slot = jnp.broadcast_to(nw_out[:, None], state.assignment.shape) \
+        * replica_exists(state)
+    return _scatter_to_brokers(state, per_slot)
+
+
+def rack_partition_counts(state: ClusterTensors, num_racks: int) -> jax.Array:
+    """[P, K] int32 — replicas of each partition per rack (rack-aware goals)."""
+    exists = replica_exists(state)
+    broker_rack = jnp.concatenate([state.rack, jnp.array([num_racks], dtype=state.rack.dtype)])
+    slot_rack = broker_rack[jnp.where(state.assignment >= 0, state.assignment,
+                                      state.num_brokers)]
+    one_hot = jax.nn.one_hot(slot_rack, num_racks + 1, dtype=jnp.int32)
+    return (one_hot * exists[:, :, None].astype(jnp.int32)).sum(axis=1)[:, :num_racks]
+
+
+def alive_mask(state: ClusterTensors) -> jax.Array:
+    """[B] bool — broker alive & real (Broker.State ALIVE/NEW/DEMOTED/BAD_DISKS
+    count as alive for hosting; DEAD does not: Broker.java isAlive)."""
+    return (state.broker_state != jnp.int8(BrokerState.DEAD)) & state.broker_mask
+
+
+def new_broker_mask(state: ClusterTensors) -> jax.Array:
+    return (state.broker_state == jnp.int8(BrokerState.NEW)) & state.broker_mask
+
+
+def offline_replicas(state: ClusterTensors) -> jax.Array:
+    """[P, S] bool — replicas on dead brokers (self-healing eligible;
+    ClusterModel.selfHealingEligibleReplicas)."""
+    dead = ~alive_mask(state)
+    dead_pad = jnp.concatenate([dead, jnp.array([True])])
+    return replica_exists(state) & dead_pad[
+        jnp.where(state.assignment >= 0, state.assignment, state.num_brokers)]
+
+
+# ---- functional mutations (the search's move operators) ------------------
+
+def apply_replica_move(state: ClusterTensors, partition: jax.Array, slot: jax.Array,
+                       dst_broker: jax.Array) -> ClusterTensors:
+    """Move the replica at (partition, slot) to dst_broker
+    (ClusterModel.relocateReplica:380, functional)."""
+    new_assignment = state.assignment.at[partition, slot].set(
+        dst_broker.astype(state.assignment.dtype))
+    return dataclasses.replace(state, assignment=new_assignment)
+
+
+def apply_leadership_move(state: ClusterTensors, partition: jax.Array,
+                          new_leader_slot: jax.Array) -> ClusterTensors:
+    """Transfer leadership to another in-sync slot
+    (ClusterModel.relocateLeadership:409, functional)."""
+    new_leader = state.leader_slot.at[partition].set(
+        new_leader_slot.astype(state.leader_slot.dtype))
+    return dataclasses.replace(state, leader_slot=new_leader)
+
+
+def apply_swap(state: ClusterTensors, p1: jax.Array, s1: jax.Array,
+               p2: jax.Array, s2: jax.Array) -> ClusterTensors:
+    """Swap the broker placements of two replicas (INTER_BROKER_REPLICA_SWAP)."""
+    b1 = state.assignment[p1, s1]
+    b2 = state.assignment[p2, s2]
+    new_assignment = state.assignment.at[p1, s1].set(b2).at[p2, s2].set(b1)
+    return dataclasses.replace(state, assignment=new_assignment)
+
+
+def set_broker_state(state: ClusterTensors, broker: jax.Array, code: int) -> ClusterTensors:
+    """(ClusterModel.setBrokerState:297, functional)."""
+    return dataclasses.replace(
+        state, broker_state=state.broker_state.at[broker].set(jnp.int8(code)))

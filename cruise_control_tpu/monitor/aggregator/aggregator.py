@@ -279,10 +279,26 @@ class MetricSampleAggregator:
             ev = self._store.entity_validity(options.max_allowed_extrapolations_per_entity)
             entity_valid[known_mask] = ev[rows[known_mask]]
 
+            if options.granularity is Granularity.ENTITY_GROUP:
+                # One invalid member invalidates the whole group
+                # (AggregationOptions ENTITY_GROUP semantics).
+                group_of: dict = {}
+                group_index = np.array(
+                    [group_of.setdefault(self._group_fn(e), len(group_of))
+                     for e in entities], dtype=np.int64)
+                group_valid = np.ones(max(1, len(group_of)), dtype=bool)
+                np.logical_and.at(group_valid, group_index, entity_valid)
+                entity_valid = entity_valid & group_valid[group_index]
+
             if not options.include_invalid_entities:
                 # Zero out metric rows of invalid entities rather than drop
                 # them, keeping array alignment with `entities`.
                 out_vals[~entity_valid] = 0.0
+
+            # Freeze result arrays: the object is cached and shared between
+            # callers; in-place mutation must fail loudly, not poison the cache.
+            for arr in (out_vals, out_cats, entity_valid):
+                arr.setflags(write=False)
 
             result = AggregationResult(
                 entities=entities,

@@ -3,7 +3,10 @@ multi-chip sharding paths (Mesh/shard_map) are exercised without TPU pods."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the ambient environment may pin JAX_PLATFORMS to a TPU
+# tunnel (axon) whose remote compiles take tens of seconds per jit. Tests
+# always run on the virtual multi-device CPU platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
