@@ -1,0 +1,8 @@
+from .constraint import BalancingConstraint, OptimizationOptions, BALANCE_MARGIN
+from .derived import DerivedState, compute_derived, count_limits, resource_limits
+from .candidates import Candidates, CandidateDeltas, compute_deltas, generate_candidates
+from .proposals import ExecutionProposal, diff_proposals
+from .search import (ExclusionMasks, OptimizationFailureError, SearchConfig,
+                     optimize_goal, optimize_round)
+from .optimizer import (GoalOptimizer, GoalResult, OptimizerResult,
+                        balancedness_score, goals_by_priority)

@@ -1,0 +1,219 @@
+"""Candidate actions: batched generation and delta evaluation.
+
+The TPU-native replacement for the reference's per-replica greedy inner loop
+(AbstractGoal.rebalanceForBroker → maybeApplyBalancingAction): instead of
+trying one action at a time, the solver materializes a fixed-size batch of
+candidate actions each round, evaluates every goal's acceptance and the
+active goal's improvement for ALL of them in one fused kernel, and applies a
+conflict-free subset.
+
+A candidate is (kind, partition, src_slot, dst_broker, dst_slot):
+- kind 0 = INTER_BROKER_REPLICA_MOVEMENT: replica at (partition, src_slot)
+  moves to dst_broker (keeps leadership if it was the leader).
+- kind 1 = LEADERSHIP_MOVEMENT: leadership transfers from the current leader
+  slot to dst_slot (dst_broker is derived = broker of dst_slot).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..common.resources import Resource
+from ..model.tensors import ClusterTensors, is_leader_slot, replica_exists, replica_load
+from .derived import DerivedState
+
+KIND_MOVE = 0
+KIND_LEADERSHIP = 1
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["kind", "partition", "src_slot", "dst_broker", "dst_slot", "valid"],
+         meta_fields=[])
+@dataclasses.dataclass(frozen=True)
+class Candidates:
+    kind: jax.Array        # [N] int8
+    partition: jax.Array   # [N] int32
+    src_slot: jax.Array    # [N] int32
+    dst_broker: jax.Array  # [N] int32
+    dst_slot: jax.Array    # [N] int32 (leadership only)
+    valid: jax.Array       # [N] bool
+
+    @property
+    def n(self) -> int:
+        return self.kind.shape[0]
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["src_broker", "dst_broker", "load_delta", "replica_delta",
+                      "leader_delta", "partition", "topic", "src_slot",
+                      "dst_slot", "valid"],
+         meta_fields=[])
+@dataclasses.dataclass(frozen=True)
+class CandidateDeltas:
+    """Per-candidate effect: src loses, dst gains."""
+
+    src_broker: jax.Array    # [N] int32
+    dst_broker: jax.Array    # [N] int32
+    load_delta: jax.Array    # [N, R] — leaves src, arrives dst
+    replica_delta: jax.Array  # [N] int32 (1 for moves, 0 for leadership)
+    leader_delta: jax.Array   # [N] int32 (1 if leadership follows the action)
+    partition: jax.Array     # [N] int32
+    topic: jax.Array         # [N] int32
+    src_slot: jax.Array      # [N] int32
+    dst_slot: jax.Array      # [N] int32 (leadership target slot; 0 for moves)
+    valid: jax.Array         # [N] bool
+
+
+def compute_deltas(state: ClusterTensors, derived: DerivedState,
+                   cand: Candidates) -> CandidateDeltas:
+    """Gather the (src, dst, Δload) tuple for every candidate; also folds the
+    structural legitimacy checks (GoalUtils.legitMove: destination must not
+    already host the partition, source must exist, destination must be an
+    alive allowed broker, leadership destination must be a live replica)."""
+    p = cand.partition
+    b = state.num_brokers
+    assign_p = state.assignment[p]              # [N, S]
+    leader_slot_p = state.leader_slot[p]        # [N]
+
+    is_move = cand.kind == KIND_MOVE
+    # src broker: replica's broker for moves; current leader's broker for leadership.
+    src_slot = jnp.where(is_move, cand.src_slot, leader_slot_p)
+    src_broker = jnp.take_along_axis(
+        assign_p, jnp.maximum(src_slot, 0)[:, None], axis=1)[:, 0]
+    dst_broker = jnp.where(
+        is_move, cand.dst_broker,
+        jnp.take_along_axis(assign_p, jnp.maximum(cand.dst_slot, 0)[:, None], axis=1)[:, 0])
+
+    moving_is_leader = src_slot == leader_slot_p
+    lead = state.leader_load[p]      # [N, R]
+    foll = state.follower_load[p]    # [N, R]
+    move_vec = jnp.where(moving_is_leader[:, None], lead, foll)
+    leadership_vec = lead - foll
+    load_delta = jnp.where(is_move[:, None], move_vec, leadership_vec)
+
+    replica_delta = is_move.astype(jnp.int32)
+    leader_delta = (jnp.where(is_move, moving_is_leader, True)).astype(jnp.int32)
+
+    # Structural legitimacy -------------------------------------------------
+    src_exists = (src_slot >= 0) & (jnp.take_along_axis(
+        assign_p, jnp.maximum(src_slot, 0)[:, None], axis=1)[:, 0] >= 0)
+    dst_in_range = (dst_broker >= 0) & (dst_broker < b)
+    dst_safe = jnp.clip(dst_broker, 0, b - 1)
+    dst_alive = derived.alive[dst_safe] & dst_in_range
+
+    # Destination must not already host the partition (moves only);
+    # comparing against all S slots of the partition.
+    already_hosts = (assign_p == dst_broker[:, None]).any(axis=1)
+    move_ok = (~already_hosts) & derived.allowed_replica_move[dst_safe] \
+        & (src_broker != dst_broker)
+    # Leadership: destination slot must hold a live replica on an
+    # allowed-for-leadership broker, and differ from the current leader.
+    dst_slot_live = jnp.take_along_axis(
+        assign_p, jnp.maximum(cand.dst_slot, 0)[:, None], axis=1)[:, 0] >= 0
+    lead_ok = dst_slot_live & (cand.dst_slot != leader_slot_p) & (cand.dst_slot >= 0) \
+        & derived.allowed_leadership[dst_safe] & (leader_slot_p >= 0)
+
+    valid = cand.valid & derived.movable_partition[p] & src_exists & dst_alive \
+        & jnp.where(is_move, move_ok, lead_ok)
+
+    return CandidateDeltas(
+        src_broker=jnp.where(valid, src_broker, 0),
+        dst_broker=jnp.where(valid, dst_safe, 0),
+        load_delta=jnp.where(valid[:, None], load_delta, 0.0),
+        replica_delta=jnp.where(valid, replica_delta, 0),
+        leader_delta=jnp.where(valid, leader_delta, 0),
+        partition=p,
+        topic=state.topic[p],
+        src_slot=jnp.where(valid, src_slot, 0),
+        dst_slot=jnp.where(valid & ~is_move, cand.dst_slot, 0),
+        valid=valid,
+    )
+
+
+def generate_candidates(state: ClusterTensors, derived: DerivedState,
+                        source_score: jax.Array, dest_score: jax.Array,
+                        replica_weight: jax.Array, num_sources: int,
+                        num_dests: int, include_leadership: bool,
+                        leadership_only: bool = False,
+                        ) -> "tuple[Candidates, tuple[tuple[int, int], ...]]":
+    """Top-k × top-k candidate grid.
+
+    - ``source_score[B]``: how much each broker needs to shed (>0 = source).
+    - ``dest_score[B]``: how attractive each broker is as a destination
+      (-inf = not eligible).
+    - ``replica_weight[P, S]``: which replicas are worth moving (higher =
+      try first; the per-goal analogue of SortedReplicas score functions).
+
+    Replica moves: the ``num_sources`` highest-weight replicas living on
+    positive-score source brokers × the ``num_dests`` best destinations.
+    Leadership: the top leader slots on source brokers × their follower
+    slots (dst_broker implied by slot).
+
+    Returns (candidates, layout) where ``layout`` describes the grid blocks
+    — [k_src × k_dst] moves then [k_l × S] leadership — so the selector can
+    do a per-source best-destination reduction before global ranking.
+    """
+    b = state.num_brokers
+    s_dim = state.max_replication_factor
+    exists = replica_exists(state)
+    seg = jnp.where(state.assignment >= 0, state.assignment, b)
+    on_source = (jnp.concatenate([source_score, jnp.array([-1.0])])[seg] > 0.0) & exists
+
+    flat_weight = jnp.where(on_source, replica_weight, -jnp.inf).reshape(-1)
+    k_src = min(num_sources, flat_weight.shape[0])
+    top_w, top_idx = jax.lax.top_k(flat_weight, k_src)
+    cand_p = (top_idx // s_dim).astype(jnp.int32)
+    cand_s = (top_idx % s_dim).astype(jnp.int32)
+    src_valid = jnp.isfinite(top_w)
+
+    layout: list[tuple[int, int]] = []
+    parts: list[Candidates] = []
+    if not leadership_only:
+        k_dst = min(num_dests, b)
+        _dst_score, dst_idx = jax.lax.top_k(dest_score, k_dst)
+        dst_valid = jnp.isfinite(_dst_score)
+        n = k_src * k_dst
+        grid_p = jnp.repeat(cand_p, k_dst)
+        grid_s = jnp.repeat(cand_s, k_dst)
+        grid_valid = jnp.repeat(src_valid, k_dst) & jnp.tile(dst_valid, k_src)
+        grid_dst = jnp.tile(dst_idx.astype(jnp.int32), k_src)
+        parts.append(Candidates(
+            kind=jnp.zeros(n, dtype=jnp.int8),
+            partition=grid_p, src_slot=grid_s, dst_broker=grid_dst,
+            dst_slot=jnp.zeros(n, dtype=jnp.int32), valid=grid_valid))
+        layout.append((k_src, k_dst))
+
+    if include_leadership or leadership_only:
+        # Leadership candidates: for each top source replica that IS a
+        # leader, try every other slot.
+        lead_mask = is_leader_slot(state)
+        lead_weight = jnp.where(on_source & lead_mask, replica_weight, -jnp.inf)
+        flat_lw = lead_weight.reshape(-1)
+        k_l = min(num_sources, flat_lw.shape[0])
+        top_lw, top_lidx = jax.lax.top_k(flat_lw, k_l)
+        lp = (top_lidx // s_dim).astype(jnp.int32)
+        l_valid = jnp.isfinite(top_lw)
+        n = k_l * s_dim
+        grid_p = jnp.repeat(lp, s_dim)
+        grid_valid = jnp.repeat(l_valid, s_dim)
+        grid_dslot = jnp.tile(jnp.arange(s_dim, dtype=jnp.int32), k_l)
+        parts.append(Candidates(
+            kind=jnp.ones(n, dtype=jnp.int8),
+            partition=grid_p,
+            src_slot=jnp.zeros(n, dtype=jnp.int32),
+            dst_broker=jnp.zeros(n, dtype=jnp.int32),
+            dst_slot=grid_dslot, valid=grid_valid))
+        layout.append((k_l, s_dim))
+
+    return Candidates(
+        kind=jnp.concatenate([c.kind for c in parts]),
+        partition=jnp.concatenate([c.partition for c in parts]),
+        src_slot=jnp.concatenate([c.src_slot for c in parts]),
+        dst_broker=jnp.concatenate([c.dst_broker for c in parts]),
+        dst_slot=jnp.concatenate([c.dst_slot for c in parts]),
+        valid=jnp.concatenate([c.valid for c in parts]),
+    ), tuple(layout)

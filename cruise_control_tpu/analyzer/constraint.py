@@ -1,0 +1,85 @@
+"""Balancing constraints and per-optimization options.
+
+Reference parity: analyzer/BalancingConstraint.java:50-270 (thresholds from
+config), analyzer/OptimizationOptions.java (excluded topics / brokers for
+leadership / brokers for replica move, fast mode).
+
+These are *static* (hashable) dataclasses: they are baked into the jitted
+solver as compile-time constants, so changing a threshold triggers a
+recompile but costs nothing per-step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..common.resources import Resource
+from ..config.cruise_control_config import CruiseControlConfig
+
+# ResourceDistributionGoal.java:57 — goals aim inside the configured band so
+# results don't sit on the boundary.
+BALANCE_MARGIN = 0.9
+
+
+@dataclasses.dataclass(frozen=True)
+class BalancingConstraint:
+    resource_balance_threshold: tuple[float, float, float, float] = (1.1, 1.1, 1.1, 1.1)
+    capacity_threshold: tuple[float, float, float, float] = (0.7, 0.8, 0.8, 0.8)
+    low_utilization_threshold: tuple[float, float, float, float] = (0.0, 0.0, 0.0, 0.0)
+    replica_balance_threshold: float = 1.1
+    leader_replica_balance_threshold: float = 1.1
+    topic_replica_balance_threshold: float = 1.1
+    max_replicas_per_broker: int = 10_000
+    goal_violation_distribution_threshold_multiplier: float = 1.0
+
+    @classmethod
+    def from_config(cls, cfg: CruiseControlConfig) -> "BalancingConstraint":
+        def per_resource(fmt: dict[Resource, str]) -> tuple[float, ...]:
+            return tuple(cfg.get_double(fmt[r]) for r in Resource)
+
+        return cls(
+            resource_balance_threshold=per_resource({
+                Resource.CPU: "cpu.balance.threshold",
+                Resource.NW_IN: "network.inbound.balance.threshold",
+                Resource.NW_OUT: "network.outbound.balance.threshold",
+                Resource.DISK: "disk.balance.threshold"}),
+            capacity_threshold=per_resource({
+                Resource.CPU: "cpu.capacity.threshold",
+                Resource.NW_IN: "network.inbound.capacity.threshold",
+                Resource.NW_OUT: "network.outbound.capacity.threshold",
+                Resource.DISK: "disk.capacity.threshold"}),
+            low_utilization_threshold=per_resource({
+                Resource.CPU: "cpu.low.utilization.threshold",
+                Resource.NW_IN: "network.inbound.low.utilization.threshold",
+                Resource.NW_OUT: "network.outbound.low.utilization.threshold",
+                Resource.DISK: "disk.low.utilization.threshold"}),
+            replica_balance_threshold=cfg.get_double("replica.count.balance.threshold"),
+            leader_replica_balance_threshold=cfg.get_double(
+                "leader.replica.count.balance.threshold"),
+            topic_replica_balance_threshold=cfg.get_double(
+                "topic.replica.count.balance.threshold"),
+            max_replicas_per_broker=cfg.get_long("max.replicas.per.broker"),
+            goal_violation_distribution_threshold_multiplier=cfg.get_double(
+                "goal.violation.distribution.threshold.multiplier"),
+        )
+
+    def balance_band(self, resource: Resource,
+                     for_detector: bool = False) -> tuple[float, float]:
+        """(lower, upper) utilization multipliers around the average
+        (GoalUtils.computeResourceUtilizationBalanceThreshold)."""
+        t = self.resource_balance_threshold[int(resource)]
+        if for_detector:
+            t *= self.goal_violation_distribution_threshold_multiplier
+        spread = (t - 1.0) * BALANCE_MARGIN
+        return 1.0 - spread, 1.0 + spread
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizationOptions:
+    excluded_topics: tuple[str, ...] = ()
+    excluded_brokers_for_leadership: tuple[int, ...] = ()
+    excluded_brokers_for_replica_move: tuple[int, ...] = ()
+    requested_destination_broker_ids: tuple[int, ...] = ()
+    only_move_immigrant_replicas: bool = False
+    is_triggered_by_goal_violation: bool = False
+    fast_mode: bool = False

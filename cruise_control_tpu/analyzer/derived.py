@@ -1,0 +1,114 @@
+"""Per-round derived state shared by every goal kernel.
+
+The reference recomputes broker loads incrementally inside its object graph;
+here one fused computation refreshes every derived tensor per search round
+(cheap on TPU, and XLA fuses it into the round kernel).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..common.resources import NUM_RESOURCES, Resource
+from ..model.tensors import (
+    ClusterTensors, alive_mask, broker_leader_counts, broker_load,
+    broker_replica_counts, new_broker_mask, potential_nw_out,
+)
+from .constraint import BalancingConstraint
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["broker_load", "broker_replicas", "broker_leaders",
+                      "pot_nw_out", "alive", "new_brokers", "allowed_replica_move",
+                      "allowed_leadership", "avg_util", "avg_replicas",
+                      "avg_leaders", "movable_partition"],
+         meta_fields=[])
+@dataclasses.dataclass(frozen=True)
+class DerivedState:
+    broker_load: jax.Array        # [B, R]
+    broker_replicas: jax.Array    # [B] int32
+    broker_leaders: jax.Array     # [B] int32
+    pot_nw_out: jax.Array         # [B]
+    alive: jax.Array              # [B] bool
+    new_brokers: jax.Array        # [B] bool
+    allowed_replica_move: jax.Array  # [B] bool (alive & not excluded as dest)
+    allowed_leadership: jax.Array    # [B] bool
+    avg_util: jax.Array           # [R] — Σload / Σcapacity over allowed brokers
+    avg_replicas: jax.Array       # scalar f32 over alive brokers
+    avg_leaders: jax.Array        # scalar f32
+    movable_partition: jax.Array  # [P] bool (not in an excluded topic)
+
+
+def compute_derived(state: ClusterTensors,
+                    excluded_topic_mask: jax.Array | None = None,
+                    excluded_replica_move_brokers: jax.Array | None = None,
+                    excluded_leadership_brokers: jax.Array | None = None) -> DerivedState:
+    """All per-broker aggregates + cluster averages in one pass.
+
+    ``excluded_*`` are boolean masks aligned with topics/brokers (host-built
+    from OptimizationOptions by the optimizer).
+    """
+    alive = alive_mask(state)
+    load = broker_load(state)
+    reps = broker_replica_counts(state)
+    leads = broker_leader_counts(state)
+    pot = potential_nw_out(state)
+    new_b = new_broker_mask(state)
+
+    excl_rm = (jnp.zeros(state.num_brokers, dtype=bool)
+               if excluded_replica_move_brokers is None else excluded_replica_move_brokers)
+    excl_ld = (jnp.zeros(state.num_brokers, dtype=bool)
+               if excluded_leadership_brokers is None else excluded_leadership_brokers)
+    allowed_rm = alive & ~excl_rm
+    allowed_ld = alive & ~excl_ld
+
+    # avgUtilizationPercentage = Σ load / Σ capacity over brokers allowed
+    # replica moves (ResourceDistributionGoal.java:245-248).
+    cap_sum = jnp.maximum((state.capacity * allowed_rm[:, None]).sum(axis=0), 1e-9)
+    load_sum = (load * allowed_rm[:, None]).sum(axis=0)
+    avg_util = load_sum / cap_sum
+
+    n_alive = jnp.maximum(alive.sum(), 1)
+    avg_reps = (reps * alive).sum() / n_alive
+    avg_leads = (leads * alive).sum() / n_alive
+
+    if excluded_topic_mask is None:
+        movable = state.partition_mask
+    else:
+        movable = state.partition_mask & ~excluded_topic_mask[state.topic]
+
+    return DerivedState(
+        broker_load=load, broker_replicas=reps, broker_leaders=leads,
+        pot_nw_out=pot, alive=alive, new_brokers=new_b,
+        allowed_replica_move=allowed_rm, allowed_leadership=allowed_ld,
+        avg_util=avg_util, avg_replicas=avg_reps, avg_leaders=avg_leads,
+        movable_partition=movable,
+    )
+
+
+def resource_limits(state: ClusterTensors, derived: DerivedState,
+                    constraint: BalancingConstraint, resource: Resource,
+                    for_detector: bool = False) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(lower[B], upper[B], capacity_limit[B]) absolute load limits per
+    broker for one resource (balance band around the average utilization +
+    the capacity threshold; ResourceDistributionGoal.initGoalState /
+    CapacityGoal)."""
+    r = int(resource)
+    lo_mult, up_mult = constraint.balance_band(resource, for_detector)
+    cap = state.capacity[:, r]
+    lower = derived.avg_util[r] * lo_mult * cap
+    upper = derived.avg_util[r] * up_mult * cap
+    cap_limit = constraint.capacity_threshold[r] * cap
+    return lower, upper, cap_limit
+
+
+def count_limits(avg: jax.Array, threshold: float) -> tuple[jax.Array, jax.Array]:
+    """(lower, upper) replica-count limits
+    (ReplicaDistributionAbstractGoal.initGoalState: ceil(avg*t), floor(avg/t))."""
+    upper = jnp.ceil(avg * threshold)
+    lower = jnp.floor(avg / threshold)
+    return lower, upper

@@ -1,0 +1,92 @@
+"""Goal registry: zero-arg-constructible classes matching the dotted paths
+in CruiseControlConfig's default goal chain (AnalyzerConfig goals=...)."""
+
+from __future__ import annotations
+
+from ...common.resources import Resource
+from .base import Goal
+from .capacity import ReplicaCapacityGoal as _ReplicaCapacityBase, ResourceCapacityGoal
+from .distribution import (
+    CountDistributionGoal, LeaderBytesInDistributionGoal as _LeaderBytesInBase,
+    MinTopicLeadersPerBrokerGoal as _MinTopicLeadersBase,
+    PotentialNwOutGoal as _PotentialNwOutBase,
+    PreferredLeaderElectionGoal as _PreferredLeaderBase,
+    ResourceDistributionGoal, TopicReplicaDistributionGoal as _TopicReplicaBase,
+)
+from .rack import RackAwareDistributionGoal as _RackAwareDistBase, RackAwareGoal as _RackAwareBase
+
+
+def _preset(base, **kwargs):
+    """Subclass with baked constructor arguments so config reflection can
+    instantiate with no args (getConfiguredInstance contract)."""
+
+    class _Preset(base):
+        def __init__(self):
+            super().__init__(**kwargs)
+
+    _Preset.__name__ = kwargs.get("name", base.__name__)
+    _Preset.__qualname__ = _Preset.__name__
+    return _Preset
+
+
+RackAwareGoal = _preset(_RackAwareBase, name="RackAwareGoal", is_hard=True)
+RackAwareDistributionGoal = _preset(_RackAwareDistBase,
+                                    name="RackAwareDistributionGoal", is_hard=True)
+ReplicaCapacityGoal = _preset(_ReplicaCapacityBase, name="ReplicaCapacityGoal",
+                              is_hard=True)
+DiskCapacityGoal = _preset(ResourceCapacityGoal, name="DiskCapacityGoal",
+                           is_hard=True, resource=Resource.DISK)
+NetworkInboundCapacityGoal = _preset(ResourceCapacityGoal,
+                                     name="NetworkInboundCapacityGoal",
+                                     is_hard=True, resource=Resource.NW_IN)
+NetworkOutboundCapacityGoal = _preset(ResourceCapacityGoal,
+                                      name="NetworkOutboundCapacityGoal",
+                                      is_hard=True, include_leadership=True,
+                                      resource=Resource.NW_OUT)
+CpuCapacityGoal = _preset(ResourceCapacityGoal, name="CpuCapacityGoal",
+                          is_hard=True, include_leadership=True,
+                          resource=Resource.CPU)
+DiskUsageDistributionGoal = _preset(ResourceDistributionGoal,
+                                    name="DiskUsageDistributionGoal",
+                                    resource=Resource.DISK)
+NetworkInboundUsageDistributionGoal = _preset(ResourceDistributionGoal,
+                                              name="NetworkInboundUsageDistributionGoal",
+                                              resource=Resource.NW_IN)
+NetworkOutboundUsageDistributionGoal = _preset(ResourceDistributionGoal,
+                                               name="NetworkOutboundUsageDistributionGoal",
+                                               include_leadership=True,
+                                               resource=Resource.NW_OUT)
+CpuUsageDistributionGoal = _preset(ResourceDistributionGoal,
+                                   name="CpuUsageDistributionGoal",
+                                   include_leadership=True,
+                                   resource=Resource.CPU)
+ReplicaDistributionGoal = _preset(CountDistributionGoal,
+                                  name="ReplicaDistributionGoal", leaders=False)
+LeaderReplicaDistributionGoal = _preset(CountDistributionGoal,
+                                        name="LeaderReplicaDistributionGoal",
+                                        include_leadership=True, leaders=True)
+TopicReplicaDistributionGoal = _preset(_TopicReplicaBase,
+                                       name="TopicReplicaDistributionGoal")
+PotentialNwOutGoal = _preset(_PotentialNwOutBase, name="PotentialNwOutGoal")
+LeaderBytesInDistributionGoal = _preset(_LeaderBytesInBase,
+                                        name="LeaderBytesInDistributionGoal",
+                                        include_leadership=True,
+                                        leadership_only=True)
+PreferredLeaderElectionGoal = _preset(_PreferredLeaderBase,
+                                      name="PreferredLeaderElectionGoal",
+                                      include_leadership=True,
+                                      leadership_only=True)
+MinTopicLeadersPerBrokerGoal = _preset(_MinTopicLeadersBase,
+                                       name="MinTopicLeadersPerBrokerGoal",
+                                       is_hard=True)
+
+ALL_GOALS = {cls.__name__: cls for cls in [
+    RackAwareGoal, RackAwareDistributionGoal, ReplicaCapacityGoal,
+    DiskCapacityGoal, NetworkInboundCapacityGoal, NetworkOutboundCapacityGoal,
+    CpuCapacityGoal, DiskUsageDistributionGoal,
+    NetworkInboundUsageDistributionGoal, NetworkOutboundUsageDistributionGoal,
+    CpuUsageDistributionGoal, ReplicaDistributionGoal,
+    LeaderReplicaDistributionGoal, TopicReplicaDistributionGoal,
+    PotentialNwOutGoal, LeaderBytesInDistributionGoal,
+    PreferredLeaderElectionGoal, MinTopicLeadersPerBrokerGoal,
+]}

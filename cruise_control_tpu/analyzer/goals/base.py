@@ -1,0 +1,104 @@
+"""Goal SPI for the TPU solver.
+
+Reference parity: analyzer/goals/Goal.java:39-163 (optimize /
+actionAcceptance / completeness) and AbstractGoal.java. Redesigned for
+batch evaluation: a goal is a STATIC (hashable, frozen) object whose methods
+are pure traced functions over (state, derived, constraint, deltas). The
+sequential callback protocol "every previously optimized goal must accept
+the action" (AbstractGoal.maybeApplyBalancingAction:230) becomes an AND over
+each goal's vectorized ``acceptance`` mask, evaluated for thousands of
+candidates at once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ...model.tensors import ClusterTensors, replica_load
+from ..candidates import CandidateDeltas
+from ..constraint import BalancingConstraint
+from ..derived import DerivedState
+
+
+@dataclasses.dataclass(frozen=True)
+class Goal:
+    """Base goal. Subclasses override the kernel methods; instances carry
+    only static config (so they can be jit-static arguments)."""
+
+    name: str = "goal"
+    is_hard: bool = False
+    include_leadership: bool = False
+    leadership_only: bool = False
+
+    # -- evaluation kernels (traced) --------------------------------------
+    def prepare(self, state: ClusterTensors, derived: DerivedState,
+                constraint: BalancingConstraint, num_topics: int) -> Any:
+        """Optional per-round auxiliary tensors (e.g. [T, B] topic counts)."""
+        return None
+
+    def broker_violations(self, state, derived, constraint, aux) -> jax.Array:
+        """[B] violation magnitude per broker (0 = satisfied)."""
+        raise NotImplementedError
+
+    def objective(self, state, derived, constraint, aux) -> jax.Array:
+        """Scalar, lower is better. Default: total violation."""
+        return self.broker_violations(state, derived, constraint, aux).sum()
+
+    def acceptance(self, state, derived, constraint, aux,
+                   deltas: CandidateDeltas) -> jax.Array:
+        """[N] bool — does this (already-optimized) goal tolerate each
+        candidate action? (Goal.actionAcceptance, vectorized.)"""
+        return jnp.ones(deltas.valid.shape[0], dtype=bool)
+
+    def improvement(self, state, derived, constraint, aux,
+                    deltas: CandidateDeltas) -> jax.Array:
+        """[N] — decrease of this goal's objective if the candidate is
+        applied (positive = improves). Default: pairwise violation delta."""
+        raise NotImplementedError
+
+    # -- candidate generation hints ---------------------------------------
+    def source_score(self, state, derived, constraint, aux) -> jax.Array:
+        """[B] — >0 means the broker should shed (rebalanceForBroker's
+        requireLessLoad set)."""
+        return self.broker_violations(state, derived, constraint, aux)
+
+    def dest_score(self, state, derived, constraint, aux) -> jax.Array:
+        """[B] — destination attractiveness; -inf = ineligible."""
+        raise NotImplementedError
+
+    def replica_weight(self, state, derived, constraint, aux) -> jax.Array:
+        """[P, S] — which replicas to move first (SortedReplicas analogue)."""
+        return replica_load(state).sum(axis=-1)
+
+
+def pair_improvement(values: jax.Array, deltas: CandidateDeltas,
+                     delta: jax.Array, viol_fn) -> jax.Array:
+    """Improvement of Σ viol(broker) restricted to the touched (src, dst)
+    pair. ``values[B]`` is the per-broker quantity, ``delta[N]`` how much
+    each candidate transfers, ``viol_fn(value, broker_idx)`` the violation
+    magnitude (broker_idx lets per-broker limits be gathered)."""
+    src, dst = deltas.src_broker, deltas.dst_broker
+    before = viol_fn(values[src], src) + viol_fn(values[dst], dst)
+    after = viol_fn(values[src] - delta, src) + viol_fn(values[dst] + delta, dst)
+    return jnp.where(deltas.valid, before - after, -jnp.inf)
+
+
+def gather_pair(arr: jax.Array, deltas: CandidateDeltas,
+                column: int | None = None) -> tuple[jax.Array, jax.Array]:
+    """(src_value, dst_value) per candidate from a [B] or [B, R] array."""
+    if column is None:
+        return arr[deltas.src_broker], arr[deltas.dst_broker]
+    return arr[deltas.src_broker, column], arr[deltas.dst_broker, column]
+
+
+def new_broker_gate(derived: DerivedState, deltas: CandidateDeltas) -> jax.Array:
+    """When NEW brokers exist, only they may receive replicas
+    (ResourceDistributionGoal.rebalanceByMovingLoadIn:444-447)."""
+    has_new = derived.new_brokers.any()
+    dst_is_new = derived.new_brokers[deltas.dst_broker]
+    is_move = deltas.replica_delta > 0
+    return jnp.where(has_new & is_move, dst_is_new, True)

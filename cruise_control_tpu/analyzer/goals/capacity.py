@@ -1,0 +1,106 @@
+"""Hard capacity goals.
+
+Reference parity: analyzer/goals/CapacityGoal.java (+ the four 45-line
+specializations DiskCapacityGoal / NetworkInboundCapacityGoal /
+NetworkOutboundCapacityGoal / CpuCapacityGoal) and ReplicaCapacityGoal.java.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ...common.resources import Resource
+from ...model.tensors import replica_load
+from ..candidates import CandidateDeltas
+from .base import Goal, new_broker_gate, pair_improvement
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceCapacityGoal(Goal):
+    """Keep every alive broker's load for one resource under
+    capacity × capacity_threshold (CapacityGoal.java)."""
+
+    resource: Resource = Resource.DISK
+
+    def _limit(self, state, constraint):
+        r = int(self.resource)
+        return constraint.capacity_threshold[r] * state.capacity[:, r]
+
+    def broker_violations(self, state, derived, constraint, aux):
+        limit = self._limit(state, constraint)
+        load = derived.broker_load[:, int(self.resource)]
+        return jnp.where(derived.alive, jnp.maximum(load - limit, 0.0), 0.0)
+
+    def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
+        # isMovementAcceptableForCapacity: destination stays within its
+        # capacity limit after receiving the load.
+        r = int(self.resource)
+        limit = self._limit(state, constraint)
+        dst_after = derived.broker_load[deltas.dst_broker, r] + deltas.load_delta[:, r]
+        return dst_after <= limit[deltas.dst_broker] + 1e-6
+
+    def improvement(self, state, derived, constraint, aux, deltas):
+        r = int(self.resource)
+        limit = self._limit(state, constraint)
+
+        def viol(value, idx):
+            return jnp.maximum(value - limit[idx], 0.0)
+
+        return pair_improvement(derived.broker_load[:, r], deltas,
+                                deltas.load_delta[:, r], viol)
+
+    def dest_score(self, state, derived, constraint, aux):
+        limit = self._limit(state, constraint)
+        headroom = limit - derived.broker_load[:, int(self.resource)]
+        return jnp.where(derived.allowed_replica_move, headroom, -jnp.inf)
+
+    def replica_weight(self, state, derived, constraint, aux):
+        return replica_load(state)[:, :, int(self.resource)]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaCapacityGoal(Goal):
+    """Max replicas per alive broker (ReplicaCapacityGoal.java:340LoC)."""
+
+    def broker_violations(self, state, derived, constraint, aux):
+        over = derived.broker_replicas - constraint.max_replicas_per_broker
+        return jnp.where(derived.alive, jnp.maximum(over, 0).astype(jnp.float32), 0.0)
+
+    def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
+        dst_after = derived.broker_replicas[deltas.dst_broker] + deltas.replica_delta
+        return dst_after <= constraint.max_replicas_per_broker
+
+    def improvement(self, state, derived, constraint, aux, deltas):
+        cap = float(constraint.max_replicas_per_broker)
+
+        def viol(value, idx):
+            return jnp.maximum(value - cap, 0.0)
+
+        return pair_improvement(derived.broker_replicas.astype(jnp.float32), deltas,
+                                deltas.replica_delta.astype(jnp.float32), viol)
+
+    def dest_score(self, state, derived, constraint, aux):
+        headroom = (constraint.max_replicas_per_broker
+                    - derived.broker_replicas).astype(jnp.float32)
+        return jnp.where(derived.allowed_replica_move & (headroom > 0),
+                         headroom, -jnp.inf)
+
+    def replica_weight(self, state, derived, constraint, aux):
+        # Any replica works; prefer light ones to minimize load disturbance.
+        return -replica_load(state).sum(axis=-1)
+
+
+def make_capacity_goals() -> list[Goal]:
+    return [
+        ResourceCapacityGoal(name="DiskCapacityGoal", is_hard=True,
+                             resource=Resource.DISK),
+        ResourceCapacityGoal(name="NetworkInboundCapacityGoal", is_hard=True,
+                             resource=Resource.NW_IN),
+        ResourceCapacityGoal(name="NetworkOutboundCapacityGoal", is_hard=True,
+                             include_leadership=True, resource=Resource.NW_OUT),
+        ResourceCapacityGoal(name="CpuCapacityGoal", is_hard=True,
+                             include_leadership=True, resource=Resource.CPU),
+    ]

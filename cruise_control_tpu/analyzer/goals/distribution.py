@@ -1,0 +1,421 @@
+"""Soft distribution goals.
+
+Reference parity: analyzer/goals/ResourceDistributionGoal.java (1,078 LoC;
+per-resource balance band avg·(1±threshold·margin), move-out/move-in/swap),
+ReplicaDistributionGoal.java / LeaderReplicaDistributionGoal.java /
+TopicReplicaDistributionGoal.java over ReplicaDistributionAbstractGoal.java,
+PotentialNwOutGoal.java, LeaderBytesInDistributionGoal.java,
+PreferredLeaderElectionGoal.java, MinTopicLeadersPerBrokerGoal.java.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ...common.resources import Resource
+from ...model.tensors import (
+    is_leader_slot, replica_exists, replica_load, topic_broker_leader_counts,
+    topic_broker_replica_counts,
+)
+from ..candidates import CandidateDeltas
+from ..derived import count_limits, resource_limits
+from .base import Goal, new_broker_gate, pair_improvement
+
+
+def _band_viol(value, lower, upper):
+    return jnp.maximum(value - upper, 0.0) + jnp.maximum(lower - value, 0.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceDistributionGoal(Goal):
+    """Per-resource balance band around the cluster-average utilization
+    (ResourceDistributionGoal.java §A.1-A.2 of SURVEY.md)."""
+
+    resource: Resource = Resource.DISK
+
+    def _limits(self, state, derived, constraint):
+        return resource_limits(state, derived, constraint, self.resource)
+
+    def _low_util(self, derived, constraint):
+        # avg ≤ low.utilization.threshold flips the goal into no-op
+        # (over-provisioned detection; ResourceDistributionGoal.java:262-277).
+        r = int(self.resource)
+        return derived.avg_util[r] <= constraint.low_utilization_threshold[r]
+
+    def broker_violations(self, state, derived, constraint, aux):
+        r = int(self.resource)
+        lower, upper, _cap = self._limits(state, derived, constraint)
+        load = derived.broker_load[:, r]
+        viol = _band_viol(load, lower, upper)
+        viol = jnp.where(derived.alive & derived.allowed_replica_move, viol, 0.0)
+        return jnp.where(self._low_util(derived, constraint),
+                         jnp.zeros_like(viol), viol)
+
+    def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
+        # ResourceDistributionGoal.actionAcceptance (MOVE/LEADERSHIP arm):
+        # 1) if src above lower AND dst under upper now, require both to stay
+        #    in band after; 2) otherwise require the move not to increase the
+        #    pairwise utilization gap.
+        r = int(self.resource)
+        lower, upper, _cap = self._limits(state, derived, constraint)
+        load = derived.broker_load[:, r]
+        d = deltas.load_delta[:, r]
+        src, dst = deltas.src_broker, deltas.dst_broker
+        eps = 1e-6
+
+        src_above_lower = load[src] >= lower[src] - eps
+        dst_under_upper = load[dst] <= upper[dst] + eps
+        stays_in_band = (load[dst] + d <= upper[dst] + eps) \
+            & (load[src] - d >= lower[src] - eps)
+
+        cap_src = jnp.maximum(state.capacity[src, r], 1e-9)
+        cap_dst = jnp.maximum(state.capacity[dst, r], 1e-9)
+        util_src_before = load[src] / cap_src
+        util_dst_after = (load[dst] + d) / cap_dst
+        no_worse = util_dst_after <= util_src_before + eps
+
+        accept = jnp.where(src_above_lower & dst_under_upper, stays_in_band, no_worse)
+        return accept | (d <= eps) | self._low_util(derived, constraint) \
+            | (~derived.alive[src])
+
+    def improvement(self, state, derived, constraint, aux, deltas):
+        r = int(self.resource)
+        lower, upper, _cap = self._limits(state, derived, constraint)
+
+        def viol(value, idx):
+            return _band_viol(value, lower[idx], upper[idx])
+
+        imp = pair_improvement(derived.broker_load[:, r], deltas,
+                               deltas.load_delta[:, r], viol)
+        # Tiebreak: pull the pair toward the average even inside the band
+        # (variance reduction), weighted small so band fixes dominate.
+        load = derived.broker_load[:, r]
+        d = deltas.load_delta[:, r]
+        src, dst = deltas.src_broker, deltas.dst_broker
+        gap_before = load[src] - load[dst]
+        gap_after = gap_before - 2 * d
+        var_gain = (gap_before ** 2 - gap_after ** 2) * 1e-6
+        return jnp.where(deltas.valid, imp + var_gain, -jnp.inf) \
+            * new_broker_gate(derived, deltas)
+
+    def source_score(self, state, derived, constraint, aux):
+        r = int(self.resource)
+        _lower, upper, _cap = self._limits(state, derived, constraint)
+        over = derived.broker_load[:, r] - upper
+        return jnp.where(derived.alive, jnp.maximum(over, 0.0), 0.0)
+
+    def dest_score(self, state, derived, constraint, aux):
+        r = int(self.resource)
+        lower, upper, _cap = self._limits(state, derived, constraint)
+        load = derived.broker_load[:, r]
+        headroom = upper - load
+        under_bonus = jnp.maximum(lower - load, 0.0) * 10.0
+        has_new = derived.new_brokers.any()
+        eligible = jnp.where(has_new, derived.new_brokers, derived.allowed_replica_move)
+        return jnp.where(eligible & (headroom > 0), headroom + under_bonus, -jnp.inf)
+
+    def replica_weight(self, state, derived, constraint, aux):
+        return replica_load(state)[:, :, int(self.resource)]
+
+
+@dataclasses.dataclass(frozen=True)
+class CountDistributionGoal(Goal):
+    """Replica- / leader-count balance
+    (ReplicaDistributionGoal.java, LeaderReplicaDistributionGoal.java)."""
+
+    leaders: bool = False
+
+    def _counts(self, derived):
+        return (derived.broker_leaders if self.leaders
+                else derived.broker_replicas).astype(jnp.float32)
+
+    def _limits(self, derived, constraint):
+        if self.leaders:
+            return count_limits(derived.avg_leaders,
+                                constraint.leader_replica_balance_threshold)
+        return count_limits(derived.avg_replicas, constraint.replica_balance_threshold)
+
+    def _delta(self, deltas):
+        return (deltas.leader_delta if self.leaders else deltas.replica_delta) \
+            .astype(jnp.float32)
+
+    def broker_violations(self, state, derived, constraint, aux):
+        lower, upper = self._limits(derived, constraint)
+        viol = _band_viol(self._counts(derived), lower, upper)
+        return jnp.where(derived.alive, viol, 0.0)
+
+    def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
+        # ReplicaDistributionGoal.actionAcceptance: leadership/swap ACCEPT;
+        # moves must keep dst under upper and src above lower.
+        lower, upper = self._limits(derived, constraint)
+        counts = self._counts(derived)
+        d = self._delta(deltas)
+        dst_ok = counts[deltas.dst_broker] + d <= upper + 1e-6
+        src_ok = counts[deltas.src_broker] - d >= lower - 1e-6
+        return (d == 0) | (dst_ok & src_ok) | (~derived.alive[deltas.src_broker])
+
+    def improvement(self, state, derived, constraint, aux, deltas):
+        lower, upper = self._limits(derived, constraint)
+
+        def viol(value, idx):
+            return _band_viol(value, lower, upper)
+
+        imp = pair_improvement(self._counts(derived), deltas, self._delta(deltas), viol)
+        counts = self._counts(derived)
+        d = self._delta(deltas)
+        gap_before = counts[deltas.src_broker] - counts[deltas.dst_broker]
+        var_gain = (gap_before ** 2 - (gap_before - 2 * d) ** 2) * 1e-6
+        return jnp.where(deltas.valid, imp + var_gain, -jnp.inf) \
+            * new_broker_gate(derived, deltas)
+
+    def source_score(self, state, derived, constraint, aux):
+        _lower, upper = self._limits(derived, constraint)
+        over = self._counts(derived) - upper
+        return jnp.where(derived.alive, jnp.maximum(over, 0.0), 0.0)
+
+    def dest_score(self, state, derived, constraint, aux):
+        lower, upper = self._limits(derived, constraint)
+        counts = self._counts(derived)
+        headroom = upper - counts
+        under_bonus = jnp.maximum(lower - counts, 0.0) * 10.0
+        has_new = derived.new_brokers.any()
+        eligible = jnp.where(has_new, derived.new_brokers, derived.allowed_replica_move)
+        return jnp.where(eligible & (headroom > 0), headroom + under_bonus, -jnp.inf)
+
+    def replica_weight(self, state, derived, constraint, aux):
+        w = -replica_load(state).sum(axis=-1)  # light replicas first
+        if self.leaders:
+            return jnp.where(is_leader_slot(state), w, -jnp.inf)
+        return w
+
+
+@dataclasses.dataclass(frozen=True)
+class TopicReplicaDistributionGoal(Goal):
+    """Per-topic replica balance across brokers
+    (TopicReplicaDistributionGoal.java:594LoC). Uses a [T, B] count plane —
+    fine up to mid-size clusters; sharded over the mesh at large T×B."""
+
+    def prepare(self, state, derived, constraint, num_topics):
+        counts = topic_broker_replica_counts(state, num_topics).astype(jnp.float32)
+        n_alive = jnp.maximum(derived.alive.sum(), 1)
+        avg = (counts * derived.alive[None, :]).sum(axis=1) / n_alive  # [T]
+        upper = jnp.ceil(avg * constraint.topic_replica_balance_threshold)
+        lower = jnp.floor(avg / constraint.topic_replica_balance_threshold)
+        return {"counts": counts, "upper": upper, "lower": lower}
+
+    def broker_violations(self, state, derived, constraint, aux):
+        viol = _band_viol(aux["counts"], aux["lower"][:, None], aux["upper"][:, None])
+        return jnp.where(derived.alive, viol.sum(axis=0), 0.0)
+
+    def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
+        t = deltas.topic
+        d = deltas.replica_delta.astype(jnp.float32)
+        dst_cnt = aux["counts"][t, deltas.dst_broker]
+        src_cnt = aux["counts"][t, deltas.src_broker]
+        dst_ok = dst_cnt + d <= aux["upper"][t] + 1e-6
+        src_ok = src_cnt - d >= aux["lower"][t] - 1e-6
+        return (d == 0) | (dst_ok & src_ok) | (~derived.alive[deltas.src_broker])
+
+    def improvement(self, state, derived, constraint, aux, deltas):
+        t = deltas.topic
+        d = deltas.replica_delta.astype(jnp.float32)
+        up, lo = aux["upper"][t], aux["lower"][t]
+        src_cnt = aux["counts"][t, deltas.src_broker]
+        dst_cnt = aux["counts"][t, deltas.dst_broker]
+        before = _band_viol(src_cnt, lo, up) + _band_viol(dst_cnt, lo, up)
+        after = _band_viol(src_cnt - d, lo, up) + _band_viol(dst_cnt + d, lo, up)
+        var_gain = ((src_cnt - dst_cnt) ** 2 - (src_cnt - dst_cnt - 2 * d) ** 2) * 1e-6
+        return jnp.where(deltas.valid, before - after + var_gain, -jnp.inf) \
+            * new_broker_gate(derived, deltas)
+
+    def source_score(self, state, derived, constraint, aux):
+        over = jnp.maximum(aux["counts"] - aux["upper"][:, None], 0.0).sum(axis=0)
+        return jnp.where(derived.alive, over, 0.0)
+
+    def dest_score(self, state, derived, constraint, aux):
+        headroom = jnp.maximum(aux["upper"][:, None] - aux["counts"], 0.0).sum(axis=0)
+        has_new = derived.new_brokers.any()
+        eligible = jnp.where(has_new, derived.new_brokers, derived.allowed_replica_move)
+        return jnp.where(eligible, headroom, -jnp.inf)
+
+    def replica_weight(self, state, derived, constraint, aux):
+        b = state.num_brokers
+        t = state.topic[:, None]
+        slot_b = jnp.clip(state.assignment, 0, b - 1)
+        over = jnp.maximum(aux["counts"] - aux["upper"][:, None], 0.0)
+        w = over[t.repeat(state.max_replication_factor, 1), slot_b]
+        return jnp.where(replica_exists(state), w, -jnp.inf)
+
+
+@dataclasses.dataclass(frozen=True)
+class PotentialNwOutGoal(Goal):
+    """Keep potential NW-out (all replicas promoted) under the outbound
+    capacity limit (PotentialNwOutGoal.java:367LoC)."""
+
+    def _limit(self, state, constraint):
+        r = int(Resource.NW_OUT)
+        return constraint.capacity_threshold[r] * state.capacity[:, r]
+
+    def broker_violations(self, state, derived, constraint, aux):
+        limit = self._limit(state, constraint)
+        return jnp.where(derived.alive,
+                         jnp.maximum(derived.pot_nw_out - limit, 0.0), 0.0)
+
+    def _pot_delta(self, state, deltas):
+        # Moves shift the partition's full leader NW_OUT potential; pure
+        # leadership moves don't change which brokers host replicas.
+        nw = state.leader_load[deltas.partition, int(Resource.NW_OUT)]
+        return jnp.where(deltas.replica_delta > 0, nw, 0.0)
+
+    def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
+        limit = self._limit(state, constraint)
+        d = self._pot_delta(state, deltas)
+        dst_after = derived.pot_nw_out[deltas.dst_broker] + d
+        # Accept if destination stays within limit, or the source was
+        # already violating (net improvement allowed).
+        src_viol = derived.pot_nw_out[deltas.src_broker] > limit[deltas.src_broker]
+        return (dst_after <= limit[deltas.dst_broker] + 1e-6) | (d <= 0) | src_viol
+
+    def improvement(self, state, derived, constraint, aux, deltas):
+        limit = self._limit(state, constraint)
+
+        def viol(value, idx):
+            return jnp.maximum(value - limit[idx], 0.0)
+
+        return pair_improvement(derived.pot_nw_out, deltas,
+                                self._pot_delta(state, deltas), viol)
+
+    def dest_score(self, state, derived, constraint, aux):
+        headroom = self._limit(state, constraint) - derived.pot_nw_out
+        return jnp.where(derived.allowed_replica_move & (headroom > 0),
+                         headroom, -jnp.inf)
+
+    def replica_weight(self, state, derived, constraint, aux):
+        nw = state.leader_load[:, int(Resource.NW_OUT)]
+        return jnp.where(replica_exists(state), nw[:, None], -jnp.inf)
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderBytesInDistributionGoal(Goal):
+    """Balance leader bytes-in across brokers via leadership moves
+    (LeaderBytesInDistributionGoal.java:288LoC)."""
+
+    def prepare(self, state, derived, constraint, num_topics):
+        b = state.num_brokers
+        lead = is_leader_slot(state)
+        seg = jnp.where(lead, jnp.clip(state.assignment, 0, b - 1), b).reshape(-1)
+        nw_in = jnp.broadcast_to(
+            state.leader_load[:, int(Resource.NW_IN)][:, None],
+            lead.shape).reshape(-1)
+        lbi = jax.ops.segment_sum(jnp.where(seg < b, nw_in, 0.0), seg,
+                                  num_segments=b + 1)[:b]
+        n = jnp.maximum(derived.allowed_leadership.sum(), 1)
+        avg = (lbi * derived.allowed_leadership).sum() / n
+        return {"lbi": lbi, "avg": avg}
+
+    def _upper(self, aux, constraint):
+        t = constraint.resource_balance_threshold[int(Resource.NW_IN)]
+        return aux["avg"] * t
+
+    def broker_violations(self, state, derived, constraint, aux):
+        upper = self._upper(aux, constraint)
+        return jnp.where(derived.alive, jnp.maximum(aux["lbi"] - upper, 0.0), 0.0)
+
+    def _lbi_delta(self, state, deltas):
+        nw_in = state.leader_load[deltas.partition, int(Resource.NW_IN)]
+        return jnp.where(deltas.leader_delta > 0, nw_in, 0.0)
+
+    def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
+        upper = self._upper(aux, constraint)
+        d = self._lbi_delta(state, deltas)
+        dst_after = aux["lbi"][deltas.dst_broker] + d
+        src_over = aux["lbi"][deltas.src_broker] > upper
+        return (dst_after <= upper + 1e-6) | (d <= 0) | src_over
+
+    def improvement(self, state, derived, constraint, aux, deltas):
+        upper = self._upper(aux, constraint)
+
+        def viol(value, idx):
+            return jnp.maximum(value - upper, 0.0)
+
+        imp = pair_improvement(aux["lbi"], deltas, self._lbi_delta(state, deltas), viol)
+        lbi = aux["lbi"]
+        d = self._lbi_delta(state, deltas)
+        gap = lbi[deltas.src_broker] - lbi[deltas.dst_broker]
+        var_gain = (gap ** 2 - (gap - 2 * d) ** 2) * 1e-6
+        return jnp.where(deltas.valid, imp + var_gain, -jnp.inf)
+
+    def dest_score(self, state, derived, constraint, aux):
+        headroom = self._upper(aux, constraint) - aux["lbi"]
+        return jnp.where(derived.allowed_leadership, headroom, -jnp.inf)
+
+    def replica_weight(self, state, derived, constraint, aux):
+        nw_in = state.leader_load[:, int(Resource.NW_IN)]
+        return jnp.where(is_leader_slot(state), nw_in[:, None], -jnp.inf)
+
+
+@dataclasses.dataclass(frozen=True)
+class PreferredLeaderElectionGoal(Goal):
+    """Make slot 0 (the preferred replica) the leader everywhere
+    (PreferredLeaderElectionGoal.java:232LoC). Leadership-only."""
+
+    def broker_violations(self, state, derived, constraint, aux):
+        not_preferred = (state.leader_slot > 0) & state.partition_mask
+        b = state.num_brokers
+        lead_b = jnp.take_along_axis(
+            state.assignment, jnp.maximum(state.leader_slot, 0)[:, None], axis=1)[:, 0]
+        seg = jnp.where(not_preferred, jnp.clip(lead_b, 0, b - 1), b)
+        return jax.ops.segment_sum(not_preferred.astype(jnp.float32), seg,
+                                   num_segments=b + 1)[:b]
+
+    def improvement(self, state, derived, constraint, aux, deltas):
+        is_lead = deltas.replica_delta == 0
+        fixes = (deltas.src_slot != 0) & (deltas.dst_slot == 0)
+        imp = jnp.where(is_lead & fixes, 1.0, 0.0)
+        return jnp.where(deltas.valid, imp, -jnp.inf)
+
+    def dest_score(self, state, derived, constraint, aux):
+        return jnp.where(derived.allowed_leadership, 0.0, -jnp.inf)
+
+    def replica_weight(self, state, derived, constraint, aux):
+        not_preferred = (state.leader_slot > 0)[:, None]
+        return jnp.where(is_leader_slot(state) & not_preferred, 1.0, -jnp.inf)
+
+    def source_score(self, state, derived, constraint, aux):
+        return jnp.ones(state.num_brokers)
+
+
+@dataclasses.dataclass(frozen=True)
+class MinTopicLeadersPerBrokerGoal(Goal):
+    """Brokers must each host at least ``min_leaders`` leaders of every
+    interested topic (MinTopicLeadersPerBrokerGoal.java:465LoC). With the
+    default empty interest set this is a no-op, as in the reference."""
+
+    min_leaders: int = 0
+
+    def prepare(self, state, derived, constraint, num_topics):
+        if self.min_leaders <= 0:
+            return None
+        return {"leader_counts": topic_broker_leader_counts(state, num_topics)}
+
+    def broker_violations(self, state, derived, constraint, aux):
+        if aux is None:
+            return jnp.zeros(state.num_brokers)
+        deficit = jnp.maximum(self.min_leaders - aux["leader_counts"], 0)
+        return jnp.where(derived.alive, deficit.sum(axis=0).astype(jnp.float32), 0.0)
+
+    def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
+        if aux is None:
+            return jnp.ones(deltas.valid.shape[0], dtype=bool)
+        cnt = aux["leader_counts"][deltas.topic, deltas.src_broker]
+        d = deltas.leader_delta
+        return (d == 0) | (cnt - d >= self.min_leaders)
+
+    def improvement(self, state, derived, constraint, aux, deltas):
+        return jnp.where(deltas.valid, 0.0, -jnp.inf)
+
+    def dest_score(self, state, derived, constraint, aux):
+        return jnp.where(derived.allowed_leadership, 0.0, -jnp.inf)

@@ -1,0 +1,208 @@
+"""GoalOptimizer: run the goal chain by priority, collect stats, diff
+proposals.
+
+Reference parity: analyzer/GoalOptimizer.java:435-524 (optimizations():
+iterate goals in priority order, each mutating the shared model under the
+acceptance of all previously optimized goals; per-goal stats + durations;
+diff initial vs final into proposals) and OptimizerResult.java.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.abstract_config import resolve_class
+from ..config.cruise_control_config import CruiseControlConfig
+from ..model.stats import ClusterModelStats, cluster_stats
+from ..model.tensors import ClusterMeta, ClusterTensors
+from .constraint import BalancingConstraint, OptimizationOptions
+from .derived import compute_derived
+from .goals import ALL_GOALS
+from .goals.base import Goal
+from .proposals import ExecutionProposal, diff_proposals
+from .search import ExclusionMasks, OptimizationFailureError, SearchConfig, optimize_goal
+
+# Balancedness score weights (KafkaCruiseControlUtils.java:831-856): each
+# priority level weighs priorityWeight× the next, hard goals weigh
+# strictnessWeight×, normalized to MAX_BALANCEDNESS_SCORE.
+MAX_BALANCEDNESS_SCORE = 100.0
+
+
+@dataclasses.dataclass
+class GoalResult:
+    name: str
+    is_hard: bool
+    succeeded: bool
+    rounds: int
+    moves_applied: int
+    residual_violation: float
+    duration_s: float
+    violated_before: bool
+
+
+@dataclasses.dataclass
+class OptimizerResult:
+    proposals: list[ExecutionProposal]
+    goal_results: list[GoalResult]
+    stats_before: ClusterModelStats
+    stats_after: ClusterModelStats
+    violated_goals_before: list[str]
+    violated_goals_after: list[str]
+    balancedness_before: float
+    balancedness_after: float
+    duration_s: float
+
+    def summary(self) -> dict:
+        return {
+            "num_proposals": len(self.proposals),
+            "num_leadership_only": sum(p.is_leadership_only for p in self.proposals),
+            "violated_goals_before": self.violated_goals_before,
+            "violated_goals_after": self.violated_goals_after,
+            "balancedness_before": round(self.balancedness_before, 3),
+            "balancedness_after": round(self.balancedness_after, 3),
+            "duration_s": round(self.duration_s, 3),
+            "goals": {g.name: {"rounds": g.rounds, "moves": g.moves_applied,
+                               "violation": round(g.residual_violation, 4)}
+                      for g in self.goal_results},
+        }
+
+
+def goals_by_priority(cfg: CruiseControlConfig,
+                      goal_names: Sequence[str] | None = None) -> list[Goal]:
+    """Instantiate the goal chain (KafkaCruiseControlUtils.goalsByPriority:
+    config reflection over dotted paths; short names resolve through the
+    registry)."""
+    specs = list(goal_names) if goal_names else cfg.get_list("goals")
+    goals = []
+    for spec in specs:
+        short = spec.rsplit(".", 1)[-1]
+        cls = ALL_GOALS.get(short)
+        if cls is None:
+            cls = resolve_class(spec)
+        goals.append(cls())
+    return goals
+
+
+def balancedness_score(goals: Sequence[Goal], violated: set[str],
+                       priority_weight: float = 1.1,
+                       strictness_weight: float = 1.5) -> float:
+    """100 minus the normalized weighted cost of violated goals
+    (GoalViolationDetector.refreshBalancednessScore:282-287)."""
+    weights = []
+    for i, g in enumerate(goals):
+        w = priority_weight ** (len(goals) - 1 - i)
+        if g.is_hard:
+            w *= strictness_weight
+        weights.append(w)
+    total = sum(weights) or 1.0
+    cost = sum(w for g, w in zip(goals, weights) if g.name in violated)
+    return MAX_BALANCEDNESS_SCORE * (1.0 - cost / total)
+
+
+class GoalOptimizer:
+    """Facade over the per-goal batched search (GoalOptimizer.java:65)."""
+
+    def __init__(self, config: CruiseControlConfig | None = None):
+        self._config = config or CruiseControlConfig()
+        self._constraint = BalancingConstraint.from_config(self._config)
+        self._search_cfg = SearchConfig(
+            num_sources=min(256, self._config.get_int("solver.candidates.per.round") // 16),
+            num_dests=16,
+            moves_per_round=self._config.get_int("solver.moves.per.round"),
+            max_rounds=self._config.get_int("max.solver.rounds"),
+        )
+        self._priority_weight = self._config.get_double("goal.balancedness.priority.weight")
+        self._strictness_weight = self._config.get_double("goal.balancedness.strictness.weight")
+
+    @property
+    def constraint(self) -> BalancingConstraint:
+        return self._constraint
+
+    def _masks(self, state: ClusterTensors, meta: ClusterMeta,
+               options: OptimizationOptions) -> ExclusionMasks:
+        topic_mask = None
+        if options.excluded_topics:
+            excluded = set(options.excluded_topics)
+            topic_mask = jnp.asarray(np.array(
+                [t in excluded for t in meta.topic_names]
+                + [False] * (state.num_partitions - len(meta.topic_names)), dtype=bool))
+        rm_mask = None
+        if options.excluded_brokers_for_replica_move:
+            idx = {bid: i for i, bid in enumerate(meta.broker_ids)}
+            m = np.zeros(state.num_brokers, dtype=bool)
+            for bid in options.excluded_brokers_for_replica_move:
+                if bid in idx:
+                    m[idx[bid]] = True
+            rm_mask = jnp.asarray(m)
+        ld_mask = None
+        if options.excluded_brokers_for_leadership:
+            idx = {bid: i for i, bid in enumerate(meta.broker_ids)}
+            m = np.zeros(state.num_brokers, dtype=bool)
+            for bid in options.excluded_brokers_for_leadership:
+                if bid in idx:
+                    m[idx[bid]] = True
+            ld_mask = jnp.asarray(m)
+        return ExclusionMasks(excluded_topics=topic_mask,
+                              excluded_replica_move_brokers=rm_mask,
+                              excluded_leadership_brokers=ld_mask)
+
+    def optimizations(self, state: ClusterTensors, meta: ClusterMeta,
+                      goals: Sequence[Goal] | None = None,
+                      options: OptimizationOptions | None = None,
+                      ) -> tuple[ClusterTensors, OptimizerResult]:
+        """Run the goal chain; returns (final_state, OptimizerResult)."""
+        t_start = time.time()
+        options = options or OptimizationOptions()
+        goal_chain = list(goals) if goals is not None \
+            else goals_by_priority(self._config)
+        masks = self._masks(state, meta, options)
+        initial = state
+        stats_before = cluster_stats(state)
+
+        # Violations before optimization, per goal.
+        derived0 = compute_derived(state, masks.excluded_topics,
+                                   masks.excluded_replica_move_brokers,
+                                   masks.excluded_leadership_brokers)
+        violated_before: list[str] = []
+        for g in goal_chain:
+            aux = g.prepare(state, derived0, self._constraint, meta.num_topics)
+            if float(g.broker_violations(state, derived0, self._constraint,
+                                         aux).sum()) > 1e-6:
+                violated_before.append(g.name)
+
+        goal_results: list[GoalResult] = []
+        optimized: list[Goal] = []
+        for g in goal_chain:
+            t0 = time.time()
+            state, info = optimize_goal(state, g, optimized, self._constraint,
+                                        self._search_cfg, meta.num_topics, masks)
+            goal_results.append(GoalResult(
+                name=g.name, is_hard=g.is_hard, succeeded=info["succeeded"],
+                rounds=info["rounds"], moves_applied=info["moves_applied"],
+                residual_violation=info["residual_violation"],
+                duration_s=time.time() - t0,
+                violated_before=g.name in violated_before))
+            optimized.append(g)
+
+        violated_after = [r.name for r in goal_results if not r.succeeded]
+        stats_after = cluster_stats(state)
+        proposals = diff_proposals(initial, state, meta)
+        result = OptimizerResult(
+            proposals=proposals, goal_results=goal_results,
+            stats_before=stats_before, stats_after=stats_after,
+            violated_goals_before=violated_before,
+            violated_goals_after=violated_after,
+            balancedness_before=balancedness_score(
+                goal_chain, set(violated_before), self._priority_weight,
+                self._strictness_weight),
+            balancedness_after=balancedness_score(
+                goal_chain, set(violated_after), self._priority_weight,
+                self._strictness_weight),
+            duration_s=time.time() - t_start,
+        )
+        return state, result

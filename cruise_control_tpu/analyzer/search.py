@@ -1,0 +1,231 @@
+"""The batched rebalance search.
+
+TPU-native replacement for the reference's greedy inner loop
+(AbstractGoal.java:82-135 optimize → rebalanceForBroker → one
+maybeApplyBalancingAction at a time). Each round, ONE fused kernel:
+
+1. recomputes derived per-broker state,
+2. generates a top-k × top-k grid of candidate actions for the active goal,
+3. evaluates the active goal's improvement AND every previously-optimized
+   goal's acceptance for all candidates (the lexicographic-constraint stack
+   of SURVEY.md §A.3 as boolean masks),
+4. picks a conflict-free batch of the best improving candidates
+   (scatter-min rank dedup over partition/src/dst), and
+5. applies them functionally.
+
+The host loop only reads back one scalar ("moves applied") per round.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..model.tensors import ClusterTensors, offline_replicas
+from .candidates import KIND_MOVE, compute_deltas, generate_candidates
+from .constraint import BalancingConstraint
+from .derived import DerivedState, compute_derived
+from .goals.base import Goal
+
+_EPS_IMPROVEMENT = 1e-9
+_OFFLINE_BONUS = 1e12
+
+
+class OptimizationFailureError(RuntimeError):
+    """A hard goal could not be satisfied
+    (OptimizationFailureException equivalent)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchConfig:
+    num_sources: int = 64
+    num_dests: int = 32
+    moves_per_round: int = 32
+    max_rounds: int = 200
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["excluded_topics", "excluded_replica_move_brokers",
+                      "excluded_leadership_brokers"],
+         meta_fields=[])
+@dataclasses.dataclass(frozen=True)
+class ExclusionMasks:
+    """Traced boolean masks built from OptimizationOptions by the optimizer."""
+
+    excluded_topics: jax.Array | None = None            # [T] bool
+    excluded_replica_move_brokers: jax.Array | None = None  # [B] bool
+    excluded_leadership_brokers: jax.Array | None = None    # [B] bool
+
+
+def _conflict_free_top_m(score: jax.Array, partition: jax.Array,
+                         src: jax.Array, dst: jax.Array, m: int,
+                         num_partitions: int, num_brokers: int):
+    """Indices of up to ``m`` best-scoring candidates such that no two share
+    a partition, source broker, or destination broker. Scatter-min of the
+    score-rank per key resolves conflicts in parallel (no sequential scan)."""
+    k = min(m, score.shape[0])
+    top_score, top_idx = jax.lax.top_k(score, k)
+    ok = top_score > _EPS_IMPROVEMENT
+    rank = jnp.arange(k, dtype=jnp.int32)
+
+    sel_p = partition[top_idx]
+    sel_src = src[top_idx]
+    sel_dst = dst[top_idx]
+
+    big = jnp.int32(k + 1)
+    rank_eff = jnp.where(ok, rank, big)
+
+    first_p = jnp.full(num_partitions, big, dtype=jnp.int32).at[sel_p].min(rank_eff)
+    first_src = jnp.full(num_brokers, big, dtype=jnp.int32).at[sel_src].min(rank_eff)
+    first_dst = jnp.full(num_brokers, big, dtype=jnp.int32).at[sel_dst].min(rank_eff)
+
+    accept = ok & (first_p[sel_p] == rank) & (first_src[sel_src] == rank) \
+        & (first_dst[sel_dst] == rank)
+    return top_idx, accept
+
+
+@partial(jax.jit, static_argnames=("goal", "optimized", "constraint", "cfg",
+                                   "num_topics"))
+def optimize_round(state: ClusterTensors, goal: Goal,
+                   optimized: tuple[Goal, ...], constraint: BalancingConstraint,
+                   cfg: SearchConfig, num_topics: int,
+                   masks: ExclusionMasks) -> tuple[ClusterTensors, jax.Array]:
+    """One fused search round for ``goal``. Returns (new_state, num_applied)."""
+    derived = compute_derived(state, masks.excluded_topics,
+                              masks.excluded_replica_move_brokers,
+                              masks.excluded_leadership_brokers)
+    aux = goal.prepare(state, derived, constraint, num_topics)
+    aux_by_goal = {g.name: g.prepare(state, derived, constraint, num_topics)
+                   for g in optimized}
+
+    src_score = goal.source_score(state, derived, constraint, aux)
+    dst_score = goal.dest_score(state, derived, constraint, aux)
+    weight = goal.replica_weight(state, derived, constraint, aux)
+
+    # Self-healing has priority: replicas stranded on dead brokers are
+    # always sources with maximal weight, and moving one scores a large
+    # bonus so it wins over pure balance refinements
+    # (ClusterModel.selfHealingEligibleReplicas / _fixOfflineReplicasOnly).
+    off = offline_replicas(state)  # [P, S]
+    b = state.num_brokers
+    seg = jnp.where(state.assignment >= 0, state.assignment, b).reshape(-1)
+    offline_per_broker = jax.ops.segment_sum(
+        off.astype(jnp.float32).reshape(-1), seg, num_segments=b + 1)[:b]
+    if not goal.leadership_only:
+        src_score = src_score + offline_per_broker
+        weight = jnp.where(off, 1e30, weight)  # finite: top-k validity uses isfinite
+
+    cand, layout = generate_candidates(state, derived, src_score, dst_score, weight,
+                                       cfg.num_sources, cfg.num_dests,
+                                       goal.include_leadership, goal.leadership_only)
+    deltas = compute_deltas(state, derived, cand)
+
+    accept = deltas.valid
+    for g in optimized:
+        accept &= g.acceptance(state, derived, constraint,
+                               aux_by_goal[g.name], deltas)
+
+    moving_offline = off[deltas.partition, deltas.src_slot] & (deltas.replica_delta > 0)
+    imp = goal.improvement(state, derived, constraint, aux, deltas)
+    imp = jnp.where(moving_offline & jnp.isfinite(imp) & deltas.valid,
+                    jnp.maximum(imp, 0.0) + _OFFLINE_BONUS, imp)
+    score = jnp.where(accept, imp, -jnp.inf)
+
+    # Per-source best-destination reduction: each [rows × cols] grid block
+    # collapses to one candidate per source replica. Without this, equal
+    # scores cluster one partition's candidates at the head of the global
+    # sort and the conflict dedup throws most of the round away. A tiny
+    # deterministic jitter spreads tied argmaxes across destinations.
+    red_parts = []
+    offset = 0
+    for rows, cols in layout:
+        block = score[offset:offset + rows * cols].reshape(rows, cols)
+        col_ids = jnp.arange(cols, dtype=jnp.float32)[None, :]
+        row_ids = jnp.arange(rows, dtype=jnp.float32)[:, None]
+        jitter = ((row_ids * 37.0 + col_ids * 11.0) % 97.0) * 1e-7
+        best_col = jnp.argmax(jnp.where(jnp.isfinite(block), block + jitter,
+                                        -jnp.inf), axis=1)
+        red_parts.append(offset + jnp.arange(rows) * cols + best_col)
+        offset += rows * cols
+    red_idx = jnp.concatenate(red_parts)
+
+    top_idx_red, sel = _conflict_free_top_m(
+        score[red_idx], deltas.partition[red_idx], deltas.src_broker[red_idx],
+        deltas.dst_broker[red_idx], cfg.moves_per_round, state.num_partitions,
+        state.num_brokers)
+    top_idx = red_idx[top_idx_red]
+
+    sel_p = deltas.partition[top_idx]
+    sel_slot = deltas.src_slot[top_idx]
+    sel_dst_b = deltas.dst_broker[top_idx]
+    sel_kind = cand.kind[top_idx]
+    sel_dst_slot = cand.dst_slot[top_idx]
+    is_move = sel_kind == KIND_MOVE
+
+    # Non-selected rows are routed out of bounds (JAX scatters drop OOB
+    # indices), so duplicate candidate rows can never overwrite an accepted
+    # move with a stale no-op value.
+    p_pad = jnp.int32(state.num_partitions)
+    move_rows = jnp.where(sel & is_move, sel_p, p_pad)
+    new_assignment = state.assignment.at[move_rows, sel_slot].set(
+        sel_dst_b.astype(state.assignment.dtype), mode="drop")
+
+    lead_rows = jnp.where(sel & ~is_move, sel_p, p_pad)
+    new_leader = state.leader_slot.at[lead_rows].set(
+        sel_dst_slot.astype(state.leader_slot.dtype), mode="drop")
+
+    new_state = dataclasses.replace(state, assignment=new_assignment,
+                                    leader_slot=new_leader)
+    return new_state, sel.sum()
+
+
+def optimize_goal(state: ClusterTensors, goal: Goal,
+                  optimized: Sequence[Goal], constraint: BalancingConstraint,
+                  cfg: SearchConfig, num_topics: int,
+                  masks: ExclusionMasks | None = None,
+                  ) -> tuple[ClusterTensors, dict]:
+    """Run rounds for one goal until converged (no applicable improving
+    action) or the round cap. Host reads one scalar per round.
+
+    Raises OptimizationFailureError if a hard goal still has violations
+    after convergence (Goal.java:53-59 semantics).
+    """
+    masks = masks or ExclusionMasks()
+    opt_tuple = tuple(optimized)
+    total_applied = 0
+    rounds = 0
+    for rounds in range(1, cfg.max_rounds + 1):
+        state, applied = optimize_round(
+            state, goal, opt_tuple, constraint, cfg, num_topics, masks)
+        applied = int(applied)
+        total_applied += applied
+        if applied == 0:
+            break
+
+    derived = compute_derived(state, masks.excluded_topics,
+                              masks.excluded_replica_move_brokers,
+                              masks.excluded_leadership_brokers)
+    aux = goal.prepare(state, derived, constraint, num_topics)
+    violations = goal.broker_violations(state, derived, constraint, aux)
+    objective = float(goal.objective(state, derived, constraint, aux))
+    total_violation = float(violations.sum())
+    offline_remaining = int(offline_replicas(state).sum())
+    succeeded = total_violation <= 1e-6
+    if goal.is_hard and not succeeded:
+        raise OptimizationFailureError(
+            f"hard goal {goal.name} unsatisfied: residual violation "
+            f"{total_violation:.4f} after {rounds} rounds")
+    info = {
+        "goal": goal.name,
+        "rounds": rounds,
+        "moves_applied": total_applied,
+        "residual_violation": total_violation,
+        "succeeded": succeeded,
+        "objective": objective,
+        "offline_remaining": offline_remaining,
+    }
+    return state, info

@@ -185,6 +185,13 @@ class MetricSampleAggregator:
         with self._lock:
             return self._completeness_locked(options)
 
+    def _group_indices(self, entities) -> tuple[np.ndarray, int]:
+        """Dense group index per entity + group count."""
+        group_of: dict = {}
+        idx = np.array([group_of.setdefault(self._group_fn(e), len(group_of))
+                        for e in entities], dtype=np.int64)
+        return idx, max(1, len(group_of))
+
     def _entity_rows(self, options: AggregationOptions) -> tuple[list, np.ndarray]:
         known = self._store.entities
         if options.interested_entities is None:
@@ -214,11 +221,7 @@ class MetricSampleAggregator:
             > options.max_allowed_extrapolations_per_entity)
         valid_sel[over_extra] = False
 
-        groups = [self._group_fn(e) for e in entities]
-        group_of: dict = {}
-        group_index = np.array([group_of.setdefault(g, len(group_of)) for g in groups],
-                               dtype=np.int64)
-        n_g = max(1, len(group_of))
+        group_index, n_g = self._group_indices(entities)
 
         # Per-window entity ratio; group valid in a window iff all members valid.
         entity_ratio = valid_sel.mean(axis=0)
@@ -282,11 +285,8 @@ class MetricSampleAggregator:
             if options.granularity is Granularity.ENTITY_GROUP:
                 # One invalid member invalidates the whole group
                 # (AggregationOptions ENTITY_GROUP semantics).
-                group_of: dict = {}
-                group_index = np.array(
-                    [group_of.setdefault(self._group_fn(e), len(group_of))
-                     for e in entities], dtype=np.int64)
-                group_valid = np.ones(max(1, len(group_of)), dtype=bool)
+                group_index, n_g = self._group_indices(entities)
+                group_valid = np.ones(n_g, dtype=bool)
                 np.logical_and.at(group_valid, group_index, entity_valid)
                 entity_valid = entity_valid & group_valid[group_index]
 

@@ -1,0 +1,192 @@
+"""Analyzer invariant tests.
+
+Mirrors the reference's OptimizationVerifier.java strategy (§4 of
+SURVEY.md): run a goal list on deterministic + randomized clusters and
+assert INVARIANTS (hard goals satisfied, offline replicas moved, no
+regression), not golden outputs.
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import (
+    BalancingConstraint, ExclusionMasks, GoalOptimizer, OptimizationFailureError,
+    OptimizationOptions, SearchConfig, diff_proposals, optimize_goal,
+)
+from cruise_control_tpu.analyzer.goals import (
+    CpuCapacityGoal, DiskCapacityGoal, LeaderReplicaDistributionGoal,
+    NetworkInboundCapacityGoal, NetworkOutboundCapacityGoal, RackAwareGoal,
+    ReplicaCapacityGoal, ReplicaDistributionGoal,
+)
+from cruise_control_tpu.analyzer.optimizer import balancedness_score, goals_by_priority
+from cruise_control_tpu.common import Resource
+from cruise_control_tpu.config import CruiseControlConfig
+from cruise_control_tpu.model import (
+    broker_load, broker_replica_counts, fixtures, offline_replicas,
+    rack_partition_counts,
+)
+from cruise_control_tpu.model.tensors import replica_exists
+
+FAST = SearchConfig(num_sources=32, num_dests=8, moves_per_round=16, max_rounds=60)
+
+
+def run_goal(state, goal, num_topics, optimized=(), constraint=None):
+    return optimize_goal(state, goal, optimized, constraint or BalancingConstraint(),
+                         FAST, num_topics, ExclusionMasks())
+
+
+def test_rack_aware_fixes_satisfiable():
+    state, meta = fixtures.rack_aware_satisfiable()
+    final, info = run_goal(state, RackAwareGoal(), meta.num_topics)
+    counts = np.asarray(rack_partition_counts(final, len(meta.rack_names)))
+    live = np.asarray(final.partition_mask)
+    assert (counts[live] <= 1).all(), counts
+    assert info["succeeded"]
+
+
+def test_rack_aware_unsatisfiable_raises():
+    state, meta = fixtures.rack_aware_unsatisfiable()
+    with pytest.raises(OptimizationFailureError):
+        run_goal(state, RackAwareGoal(), meta.num_topics)
+
+
+def test_replica_distribution_balances():
+    state, meta = fixtures.small_unbalanced(num_brokers=3)
+    final, info = run_goal(state, ReplicaDistributionGoal(), meta.num_topics)
+    counts = np.asarray(broker_replica_counts(final))[:3]
+    # 16 replicas over 3 brokers within ceil/floor band of threshold 1.1:
+    # avg 5.33 -> [4, 6].
+    assert counts.max() <= 6 and counts.min() >= 4, counts
+    assert info["succeeded"]
+
+
+def test_capacity_goal_respects_limit():
+    state, meta = fixtures.small_unbalanced()
+    final, info = run_goal(state, CpuCapacityGoal(), meta.num_topics)
+    load = np.asarray(broker_load(final))[:, Resource.CPU]
+    limit = 0.7 * 100.0
+    assert (load <= limit + 1e-4).all(), load
+    assert info["succeeded"]
+
+
+def test_self_healing_moves_offline_replicas():
+    state, meta = fixtures.dead_broker_cluster()
+    assert int(np.asarray(offline_replicas(state)).sum()) == 4
+    final, info = run_goal(state, ReplicaDistributionGoal(), meta.num_topics)
+    assert info["offline_remaining"] == 0
+    # Load conservation: nothing lost, everything lives on alive brokers.
+    reps = np.asarray(broker_replica_counts(final))
+    assert reps.sum() == 8
+    assert reps[3] == 0  # dead broker drained
+
+
+def test_hard_goal_chain_on_random_cluster():
+    state, meta = fixtures.random_cluster(num_brokers=12, num_topics=6,
+                                          num_partitions=120, rf=3, seed=3,
+                                          skew_to_first=2.5)
+    cfg = CruiseControlConfig()
+    goals = goals_by_priority(cfg)[:6]  # the six hard goals
+    constraint = BalancingConstraint.from_config(cfg)
+    s = state
+    optimized = []
+    for g in goals:
+        s, info = optimize_goal(s, g, tuple(optimized), constraint, FAST,
+                                meta.num_topics, ExclusionMasks())
+        optimized.append(g)
+    # All hard constraints hold at the end (later goals never broke earlier
+    # ones thanks to the acceptance stack).
+    load = np.asarray(broker_load(s))
+    cap = np.asarray(s.capacity)
+    for r, thresh in ((Resource.DISK, 0.8), (Resource.NW_IN, 0.8),
+                      (Resource.NW_OUT, 0.8), (Resource.CPU, 0.7)):
+        assert (load[:12, r] <= thresh * cap[:12, r] + 1e-3).all(), (r, load[:, r])
+    counts = np.asarray(rack_partition_counts(s, len(meta.rack_names)))
+    assert (counts[np.asarray(s.partition_mask)] <= 1).all()
+
+
+def test_optimizer_end_to_end_improves_balancedness():
+    state, meta = fixtures.random_cluster(num_brokers=8, num_topics=4,
+                                          num_partitions=60, rf=2, seed=11,
+                                          skew_to_first=3.0)
+    cfg = CruiseControlConfig({"max.solver.rounds": 40,
+                               "solver.moves.per.round": 16})
+    opt = GoalOptimizer(cfg)
+    final, res = opt.optimizations(state, meta)
+    assert res.balancedness_after >= res.balancedness_before
+    # Hard goals must all be satisfied.
+    hard_after = [g for g in res.violated_goals_after
+                  if any(r.name == g and r.is_hard for r in res.goal_results)]
+    assert hard_after == []
+    # Proposals describe real changes only.
+    for p in res.proposals:
+        assert p.old_replicas != p.new_replicas or p.old_leader != p.new_leader
+
+
+def test_proposal_diff_roundtrip():
+    state, meta = fixtures.small_unbalanced()
+    final, _ = run_goal(state, ReplicaDistributionGoal(), meta.num_topics)
+    proposals = diff_proposals(state, final, meta)
+    assert proposals  # the unbalanced fixture must produce moves
+    moved = {(p.topic, p.partition) for p in proposals}
+    a0 = np.asarray(state.assignment)
+    a1 = np.asarray(final.assignment)
+    l0, l1 = np.asarray(state.leader_slot), np.asarray(final.leader_slot)
+    for i, (t, pn) in enumerate(meta.partition_index):
+        changed = (a0[i] != a1[i]).any() or l0[i] != l1[i]
+        assert changed == ((t, pn) in moved)
+    # Replica sets in proposals are consistent with the model.
+    for p in proposals:
+        assert len(set(p.new_replicas)) == len(p.new_replicas)
+        assert p.new_leader in p.new_replicas
+
+
+def test_excluded_topics_not_moved():
+    state, meta = fixtures.small_unbalanced()
+    opt = GoalOptimizer(CruiseControlConfig({"max.solver.rounds": 30,
+                                             "solver.moves.per.round": 8}))
+    final, res = opt.optimizations(
+        state, meta, goals=[ReplicaDistributionGoal()],
+        options=OptimizationOptions(excluded_topics=("t1",)))
+    for p in res.proposals:
+        assert p.topic != "t1"
+
+
+def test_balancedness_score_monotone():
+    goals = goals_by_priority(CruiseControlConfig())
+    all_names = {g.name for g in goals}
+    assert balancedness_score(goals, set()) == pytest.approx(100.0)
+    assert balancedness_score(goals, all_names) == pytest.approx(0.0)
+    partial = balancedness_score(goals, {"ReplicaDistributionGoal"})
+    assert 0 < partial < 100
+
+
+def test_preferred_leader_election_converges():
+    from cruise_control_tpu.analyzer.goals import PreferredLeaderElectionGoal
+    from cruise_control_tpu.model import ClusterModelBuilder
+    b = ClusterModelBuilder()
+    cap = {Resource.CPU: 100.0, Resource.NW_IN: 1000.0, Resource.NW_OUT: 1000.0,
+           Resource.DISK: 10000.0}
+    b.add_broker(0, "rA", cap).add_broker(1, "rB", cap).add_broker(2, "rC", cap)
+    load = {Resource.CPU: 5.0, Resource.NW_OUT: 20.0}
+    b.add_partition("t", 0, [0, 1], leader_load=load, leader_index=1)
+    b.add_partition("t", 1, [1, 2], leader_load=load, leader_index=1)
+    b.add_partition("t", 2, [2, 0], leader_load=load, leader_index=0)
+    state, meta = b.build()
+    final, info = run_goal(state, PreferredLeaderElectionGoal(), meta.num_topics)
+    assert np.asarray(final.leader_slot)[:3].tolist() == [0, 0, 0]
+    assert info["succeeded"]
+    assert info["rounds"] <= 5  # must not churn
+
+
+def test_no_phantom_replicas_after_optimization():
+    state, meta = fixtures.random_cluster(num_brokers=6, num_topics=3,
+                                          num_partitions=40, rf=2, seed=5)
+    final, _ = run_goal(state, ReplicaDistributionGoal(), meta.num_topics)
+    # Same number of replicas per partition; no duplicates within a partition.
+    e0 = np.asarray(replica_exists(state)).sum(axis=1)
+    e1 = np.asarray(replica_exists(final)).sum(axis=1)
+    np.testing.assert_array_equal(e0, e1)
+    a1 = np.asarray(final.assignment)
+    for row in a1[np.asarray(final.partition_mask)]:
+        live = row[row >= 0]
+        assert len(set(live.tolist())) == len(live)
