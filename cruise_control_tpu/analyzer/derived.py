@@ -46,17 +46,21 @@ class DerivedState:
 def compute_derived(state: ClusterTensors,
                     excluded_topic_mask: jax.Array | None = None,
                     excluded_replica_move_brokers: jax.Array | None = None,
-                    excluded_leadership_brokers: jax.Array | None = None) -> DerivedState:
+                    excluded_leadership_brokers: jax.Array | None = None,
+                    psum=None) -> DerivedState:
     """All per-broker aggregates + cluster averages in one pass.
 
     ``excluded_*`` are boolean masks aligned with topics/brokers (host-built
-    from OptimizationOptions by the optimizer).
+    from OptimizationOptions by the optimizer). ``psum`` combines the
+    partition-additive aggregates across a sharded mesh (identity when the
+    whole model lives on one device).
     """
+    p = psum or (lambda x: x)
     alive = alive_mask(state)
-    load = broker_load(state)
-    reps = broker_replica_counts(state)
-    leads = broker_leader_counts(state)
-    pot = potential_nw_out(state)
+    load = p(broker_load(state))
+    reps = p(broker_replica_counts(state))
+    leads = p(broker_leader_counts(state))
+    pot = p(potential_nw_out(state))
     new_b = new_broker_mask(state)
 
     excl_rm = (jnp.zeros(state.num_brokers, dtype=bool)

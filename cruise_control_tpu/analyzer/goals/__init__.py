@@ -29,9 +29,11 @@ def _preset(base, **kwargs):
     return _Preset
 
 
-RackAwareGoal = _preset(_RackAwareBase, name="RackAwareGoal", is_hard=True)
+RackAwareGoal = _preset(_RackAwareBase, name="RackAwareGoal", is_hard=True,
+                        partition_additive_scores=True)
 RackAwareDistributionGoal = _preset(_RackAwareDistBase,
-                                    name="RackAwareDistributionGoal", is_hard=True)
+                                    name="RackAwareDistributionGoal", is_hard=True,
+                                    partition_additive_scores=True)
 ReplicaCapacityGoal = _preset(_ReplicaCapacityBase, name="ReplicaCapacityGoal",
                               is_hard=True)
 DiskCapacityGoal = _preset(ResourceCapacityGoal, name="DiskCapacityGoal",
@@ -75,7 +77,8 @@ LeaderBytesInDistributionGoal = _preset(_LeaderBytesInBase,
 PreferredLeaderElectionGoal = _preset(_PreferredLeaderBase,
                                       name="PreferredLeaderElectionGoal",
                                       include_leadership=True,
-                                      leadership_only=True)
+                                      leadership_only=True,
+                                      partition_additive_scores=True)
 MinTopicLeadersPerBrokerGoal = _preset(_MinTopicLeadersBase,
                                        name="MinTopicLeadersPerBrokerGoal",
                                        is_hard=True)

@@ -33,12 +33,33 @@ class Goal:
     is_hard: bool = False
     include_leadership: bool = False
     leadership_only: bool = False
+    # True when broker_violations/source_score are additive reductions over
+    # the partition axis (rack duplicates, non-preferred leaders): under a
+    # partition-sharded mesh the sharded search psums them across devices.
+    partition_additive_scores: bool = False
 
     # -- evaluation kernels (traced) --------------------------------------
+    def prepare_partial(self, state: ClusterTensors, num_topics: int) -> Any:
+        """Per-round aux tensors that are ADDITIVE over the partition axis
+        (e.g. [T, B] topic counts). Under a partition-sharded mesh each
+        device computes its partial and the search psums the pytree."""
+        return None
+
+    def finalize_aux(self, partial: Any, state: ClusterTensors,
+                     derived: DerivedState,
+                     constraint: BalancingConstraint) -> Any:
+        """Non-additive post-processing of the (already psum'd) partial
+        (e.g. balance bands from counts). Default: aux = partial."""
+        return partial
+
     def prepare(self, state: ClusterTensors, derived: DerivedState,
                 constraint: BalancingConstraint, num_topics: int) -> Any:
-        """Optional per-round auxiliary tensors (e.g. [T, B] topic counts)."""
-        return None
+        """Single-device aux composition. Do NOT override this — the search
+        paths call prepare_partial/finalize_aux directly (the sharded path
+        psums the partial between them); override THOSE to customize aux, or
+        an override would be silently bypassed during optimization."""
+        return self.finalize_aux(self.prepare_partial(state, num_topics),
+                                 state, derived, constraint)
 
     def broker_violations(self, state, derived, constraint, aux) -> jax.Array:
         """[B] violation magnitude per broker (0 = satisfied)."""

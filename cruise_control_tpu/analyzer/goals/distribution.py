@@ -102,10 +102,17 @@ class ResourceDistributionGoal(Goal):
             * new_broker_gate(derived, deltas)
 
     def source_score(self, state, derived, constraint, aux):
+        # requireLessLoad brokers shed; when some broker sits below the lower
+        # band (requireMoreLoad, ResourceDistributionGoal.java:388), every
+        # broker above the lower band becomes a donor for move-in.
         r = int(self.resource)
-        _lower, upper, _cap = self._limits(state, derived, constraint)
-        over = derived.broker_load[:, r] - upper
-        return jnp.where(derived.alive, jnp.maximum(over, 0.0), 0.0)
+        lower, upper, _cap = self._limits(state, derived, constraint)
+        load = derived.broker_load[:, r]
+        eligible = derived.alive & derived.allowed_replica_move
+        any_under = ((load < lower) & eligible).any()
+        over = jnp.maximum(load - upper, 0.0)
+        donor = jnp.where(any_under, jnp.maximum(load - lower, 0.0), 0.0)
+        return jnp.where(derived.alive, over + donor, 0.0)
 
     def dest_score(self, state, derived, constraint, aux):
         r = int(self.resource)
@@ -172,9 +179,15 @@ class CountDistributionGoal(Goal):
             * new_broker_gate(derived, deltas)
 
     def source_score(self, state, derived, constraint, aux):
-        _lower, upper = self._limits(derived, constraint)
-        over = self._counts(derived) - upper
-        return jnp.where(derived.alive, jnp.maximum(over, 0.0), 0.0)
+        # Donor widening for under-lower brokers (move-in side), as in
+        # ReplicaDistributionGoal's rebalanceByMovingReplicasIn.
+        lower, upper = self._limits(derived, constraint)
+        counts = self._counts(derived)
+        eligible = derived.alive & derived.allowed_replica_move
+        any_under = ((counts < lower) & eligible).any()
+        over = jnp.maximum(counts - upper, 0.0)
+        donor = jnp.where(any_under, jnp.maximum(counts - lower, 0.0), 0.0)
+        return jnp.where(derived.alive, over + donor, 0.0)
 
     def dest_score(self, state, derived, constraint, aux):
         lower, upper = self._limits(derived, constraint)
@@ -198,8 +211,12 @@ class TopicReplicaDistributionGoal(Goal):
     (TopicReplicaDistributionGoal.java:594LoC). Uses a [T, B] count plane —
     fine up to mid-size clusters; sharded over the mesh at large T×B."""
 
-    def prepare(self, state, derived, constraint, num_topics):
-        counts = topic_broker_replica_counts(state, num_topics).astype(jnp.float32)
+    def prepare_partial(self, state, num_topics):
+        return {"counts": topic_broker_replica_counts(state, num_topics)
+                .astype(jnp.float32)}
+
+    def finalize_aux(self, partial, state, derived, constraint):
+        counts = partial["counts"]
         n_alive = jnp.maximum(derived.alive.sum(), 1)
         avg = (counts * derived.alive[None, :]).sum(axis=1) / n_alive  # [T]
         upper = jnp.ceil(avg * constraint.topic_replica_balance_threshold)
@@ -231,9 +248,21 @@ class TopicReplicaDistributionGoal(Goal):
         return jnp.where(deltas.valid, before - after + var_gain, -jnp.inf) \
             * new_broker_gate(derived, deltas)
 
+    def _over_donor(self, derived, aux):
+        """[T, B] — per-(topic, broker) shed pressure: count above the upper
+        band, plus (when some eligible broker is below the topic's lower
+        band) anything above the lower band (move-in donors)."""
+        counts = aux["counts"]
+        lo = aux["lower"][:, None]
+        eligible = derived.alive & derived.allowed_replica_move
+        deficit_any = ((counts < lo) & eligible[None, :]).any(axis=1)  # [T]
+        over = jnp.maximum(counts - aux["upper"][:, None], 0.0)
+        donor = jnp.where(deficit_any[:, None], jnp.maximum(counts - lo, 0.0), 0.0)
+        return over + donor
+
     def source_score(self, state, derived, constraint, aux):
-        over = jnp.maximum(aux["counts"] - aux["upper"][:, None], 0.0).sum(axis=0)
-        return jnp.where(derived.alive, over, 0.0)
+        score = self._over_donor(derived, aux).sum(axis=0)
+        return jnp.where(derived.alive, score, 0.0)
 
     def dest_score(self, state, derived, constraint, aux):
         headroom = jnp.maximum(aux["upper"][:, None] - aux["counts"], 0.0).sum(axis=0)
@@ -245,8 +274,8 @@ class TopicReplicaDistributionGoal(Goal):
         b = state.num_brokers
         t = state.topic[:, None]
         slot_b = jnp.clip(state.assignment, 0, b - 1)
-        over = jnp.maximum(aux["counts"] - aux["upper"][:, None], 0.0)
-        w = over[t.repeat(state.max_replication_factor, 1), slot_b]
+        pressure = self._over_donor(derived, aux)
+        w = pressure[t.repeat(state.max_replication_factor, 1), slot_b]
         return jnp.where(replica_exists(state), w, -jnp.inf)
 
 
@@ -303,7 +332,7 @@ class LeaderBytesInDistributionGoal(Goal):
     """Balance leader bytes-in across brokers via leadership moves
     (LeaderBytesInDistributionGoal.java:288LoC)."""
 
-    def prepare(self, state, derived, constraint, num_topics):
+    def prepare_partial(self, state, num_topics):
         b = state.num_brokers
         lead = is_leader_slot(state)
         seg = jnp.where(lead, jnp.clip(state.assignment, 0, b - 1), b).reshape(-1)
@@ -312,6 +341,10 @@ class LeaderBytesInDistributionGoal(Goal):
             lead.shape).reshape(-1)
         lbi = jax.ops.segment_sum(jnp.where(seg < b, nw_in, 0.0), seg,
                                   num_segments=b + 1)[:b]
+        return {"lbi": lbi}
+
+    def finalize_aux(self, partial, state, derived, constraint):
+        lbi = partial["lbi"]
         n = jnp.maximum(derived.allowed_leadership.sum(), 1)
         avg = (lbi * derived.allowed_leadership).sum() / n
         return {"lbi": lbi, "avg": avg}
@@ -396,7 +429,7 @@ class MinTopicLeadersPerBrokerGoal(Goal):
 
     min_leaders: int = 0
 
-    def prepare(self, state, derived, constraint, num_topics):
+    def prepare_partial(self, state, num_topics):
         if self.min_leaders <= 0:
             return None
         return {"leader_counts": topic_broker_leader_counts(state, num_topics)}

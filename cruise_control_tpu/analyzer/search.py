@@ -14,6 +14,11 @@ maybeApplyBalancingAction at a time). Each round, ONE fused kernel:
 5. applies them functionally.
 
 The host loop only reads back one scalar ("moves applied") per round.
+
+The round body is shared with the multi-chip path
+(parallel/sharded.py): ``score_round_candidates`` and ``apply_selected``
+take a ``psum`` hook / row offset so the same kernels run replicated or
+partition-sharded.
 """
 
 from __future__ import annotations
@@ -33,6 +38,9 @@ from .goals.base import Goal
 
 _EPS_IMPROVEMENT = 1e-9
 _OFFLINE_BONUS = 1e12
+# Relative width of the "these scores are effectively tied" window inside
+# which the destination-rotation preference may reorder choices.
+_TIE_WINDOW = 0.01
 
 
 class OptimizationFailureError(RuntimeError):
@@ -59,6 +67,56 @@ class ExclusionMasks:
     excluded_topics: jax.Array | None = None            # [T] bool
     excluded_replica_move_brokers: jax.Array | None = None  # [B] bool
     excluded_leadership_brokers: jax.Array | None = None    # [B] bool
+
+
+def goal_aux(goal: Goal, state: ClusterTensors, derived: DerivedState,
+             constraint: BalancingConstraint, num_topics: int, psum=None):
+    """Per-goal aux tensors; the partition-additive partial is psum'd when a
+    mesh hook is given (Goal.prepare_partial/finalize_aux contract)."""
+    partial_aux = goal.prepare_partial(state, num_topics)
+    if partial_aux is not None and psum is not None:
+        partial_aux = jax.tree.map(psum, partial_aux)
+    return goal.finalize_aux(partial_aux, state, derived, constraint)
+
+
+def reduce_per_source(score: jax.Array,
+                      layout: tuple[tuple[int, int], ...],
+                      row_offset: jax.Array | int = 0) -> jax.Array:
+    """Per-source best-destination reduction: each [rows × cols] grid block
+    collapses to one candidate per source replica. Without this, equal
+    scores cluster one partition's candidates at the head of the global
+    sort and the conflict dedup throws most of the round away.
+
+    Tie-breaking: among the columns whose score is within a small relative
+    window of the row's best, prefer column ((row + row_offset) mod cols),
+    then the next, etc. This spreads near-tied sources across DIFFERENT
+    destinations — otherwise all sources chase the single most-attractive
+    destination and the one-move-per-destination conflict rule caps the
+    round at one move. Columns outside the tie window are never chosen, so
+    a genuinely better candidate (e.g. the only one fixing a tiny capacity
+    violation) cannot be displaced. ``row_offset`` decorrelates devices in
+    the sharded path."""
+    red_parts = []
+    offset = 0
+    for rows, cols in layout:
+        block = score[offset:offset + rows * cols].reshape(rows, cols)
+        finite = jnp.isfinite(block)
+        safe = jnp.where(finite, block, -jnp.inf)
+        row_max = safe.max(axis=1, keepdims=True)
+        window = _TIE_WINDOW * jnp.maximum(jnp.abs(row_max), 1e-6)
+        tied = finite & (safe >= row_max - window)
+
+        col_ids = jnp.arange(cols, dtype=jnp.int32)[None, :]
+        row_ids = jnp.arange(rows, dtype=jnp.int32)[:, None] + row_offset
+        # Rotation rank: 0 for the row's preferred column, increasing after.
+        rot = (col_ids - row_ids) % cols
+        best_col = jnp.argmin(jnp.where(tied, rot, cols + 1), axis=1)
+        # Rows with no tied (finite) column keep plain argmax (all -inf:
+        # conflict selection drops them anyway).
+        best_col = jnp.where(tied.any(axis=1), best_col, jnp.argmax(safe, axis=1))
+        red_parts.append(offset + jnp.arange(rows) * cols + best_col)
+        offset += rows * cols
+    return jnp.concatenate(red_parts)
 
 
 def _conflict_free_top_m(score: jax.Array, partition: jax.Array,
@@ -88,23 +146,28 @@ def _conflict_free_top_m(score: jax.Array, partition: jax.Array,
     return top_idx, accept
 
 
-@partial(jax.jit, static_argnames=("goal", "optimized", "constraint", "cfg",
-                                   "num_topics"))
-def optimize_round(state: ClusterTensors, goal: Goal,
-                   optimized: tuple[Goal, ...], constraint: BalancingConstraint,
-                   cfg: SearchConfig, num_topics: int,
-                   masks: ExclusionMasks) -> tuple[ClusterTensors, jax.Array]:
-    """One fused search round for ``goal``. Returns (new_state, num_applied)."""
+def score_round_candidates(state: ClusterTensors, masks: ExclusionMasks,
+                           goal: Goal, optimized: tuple[Goal, ...],
+                           constraint: BalancingConstraint, cfg: SearchConfig,
+                           num_topics: int, psum=None, k_src: int | None = None):
+    """Shared round body: derived state → candidate grid → lexicographic
+    acceptance stack → scored candidates. ``psum`` combines partition-
+    additive aggregates across a mesh (None on a single device); ``k_src``
+    overrides the per-device source count in the sharded path.
+
+    Returns (cand, deltas, score, layout)."""
     derived = compute_derived(state, masks.excluded_topics,
                               masks.excluded_replica_move_brokers,
-                              masks.excluded_leadership_brokers)
-    aux = goal.prepare(state, derived, constraint, num_topics)
-    aux_by_goal = {g.name: g.prepare(state, derived, constraint, num_topics)
+                              masks.excluded_leadership_brokers, psum=psum)
+    aux = goal_aux(goal, state, derived, constraint, num_topics, psum)
+    aux_by_goal = {g.name: goal_aux(g, state, derived, constraint, num_topics, psum)
                    for g in optimized}
 
     src_score = goal.source_score(state, derived, constraint, aux)
     dst_score = goal.dest_score(state, derived, constraint, aux)
     weight = goal.replica_weight(state, derived, constraint, aux)
+    if psum is not None and goal.partition_additive_scores:
+        src_score = psum(src_score)
 
     # Self-healing has priority: replicas stranded on dead brokers are
     # always sources with maximal weight, and moving one scores a large
@@ -115,12 +178,14 @@ def optimize_round(state: ClusterTensors, goal: Goal,
     seg = jnp.where(state.assignment >= 0, state.assignment, b).reshape(-1)
     offline_per_broker = jax.ops.segment_sum(
         off.astype(jnp.float32).reshape(-1), seg, num_segments=b + 1)[:b]
+    if psum is not None:
+        offline_per_broker = psum(offline_per_broker)
     if not goal.leadership_only:
         src_score = src_score + offline_per_broker
         weight = jnp.where(off, 1e30, weight)  # finite: top-k validity uses isfinite
 
     cand, layout = generate_candidates(state, derived, src_score, dst_score, weight,
-                                       cfg.num_sources, cfg.num_dests,
+                                       k_src or cfg.num_sources, cfg.num_dests,
                                        goal.include_leadership, goal.leadership_only)
     deltas = compute_deltas(state, derived, cand)
 
@@ -134,24 +199,47 @@ def optimize_round(state: ClusterTensors, goal: Goal,
     imp = jnp.where(moving_offline & jnp.isfinite(imp) & deltas.valid,
                     jnp.maximum(imp, 0.0) + _OFFLINE_BONUS, imp)
     score = jnp.where(accept, imp, -jnp.inf)
+    return cand, deltas, score, layout
 
-    # Per-source best-destination reduction: each [rows × cols] grid block
-    # collapses to one candidate per source replica. Without this, equal
-    # scores cluster one partition's candidates at the head of the global
-    # sort and the conflict dedup throws most of the round away. A tiny
-    # deterministic jitter spreads tied argmaxes across destinations.
-    red_parts = []
-    offset = 0
-    for rows, cols in layout:
-        block = score[offset:offset + rows * cols].reshape(rows, cols)
-        col_ids = jnp.arange(cols, dtype=jnp.float32)[None, :]
-        row_ids = jnp.arange(rows, dtype=jnp.float32)[:, None]
-        jitter = ((row_ids * 37.0 + col_ids * 11.0) % 97.0) * 1e-7
-        best_col = jnp.argmax(jnp.where(jnp.isfinite(block), block + jitter,
-                                        -jnp.inf), axis=1)
-        red_parts.append(offset + jnp.arange(rows) * cols + best_col)
-        offset += rows * cols
-    red_idx = jnp.concatenate(red_parts)
+
+def apply_selected(state: ClusterTensors, sel: jax.Array, sel_p: jax.Array,
+                   sel_slot: jax.Array, sel_dst_b: jax.Array,
+                   sel_kind: jax.Array, sel_dst_slot: jax.Array,
+                   row_offset: jax.Array | int = 0) -> ClusterTensors:
+    """Apply a selected move batch functionally. ``sel_p`` holds partition
+    row ids relative to ``row_offset`` + local rows (global ids in the
+    sharded path); rows outside [0, P_local) and non-selected rows route out
+    of bounds — JAX scatters drop OOB indices, so duplicate candidate rows
+    can never overwrite an accepted move with a stale no-op value."""
+    p_local = state.num_partitions
+    local_row = sel_p - row_offset
+    in_range = (local_row >= 0) & (local_row < p_local)
+    is_move = sel_kind == KIND_MOVE
+    p_pad = jnp.int32(p_local)
+
+    move_rows = jnp.where(sel & is_move & in_range, local_row, p_pad)
+    new_assignment = state.assignment.at[move_rows, sel_slot].set(
+        sel_dst_b.astype(state.assignment.dtype), mode="drop")
+
+    lead_rows = jnp.where(sel & ~is_move & in_range, local_row, p_pad)
+    new_leader = state.leader_slot.at[lead_rows].set(
+        sel_dst_slot.astype(state.leader_slot.dtype), mode="drop")
+
+    return dataclasses.replace(state, assignment=new_assignment,
+                               leader_slot=new_leader)
+
+
+@partial(jax.jit, static_argnames=("goal", "optimized", "constraint", "cfg",
+                                   "num_topics"))
+def optimize_round(state: ClusterTensors, goal: Goal,
+                   optimized: tuple[Goal, ...], constraint: BalancingConstraint,
+                   cfg: SearchConfig, num_topics: int,
+                   masks: ExclusionMasks) -> tuple[ClusterTensors, jax.Array]:
+    """One fused search round for ``goal``. Returns (new_state, num_applied)."""
+    cand, deltas, score, layout = score_round_candidates(
+        state, masks, goal, optimized, constraint, cfg, num_topics)
+
+    red_idx = reduce_per_source(score, layout)
 
     top_idx_red, sel = _conflict_free_top_m(
         score[red_idx], deltas.partition[red_idx], deltas.src_broker[red_idx],
@@ -159,27 +247,9 @@ def optimize_round(state: ClusterTensors, goal: Goal,
         state.num_brokers)
     top_idx = red_idx[top_idx_red]
 
-    sel_p = deltas.partition[top_idx]
-    sel_slot = deltas.src_slot[top_idx]
-    sel_dst_b = deltas.dst_broker[top_idx]
-    sel_kind = cand.kind[top_idx]
-    sel_dst_slot = cand.dst_slot[top_idx]
-    is_move = sel_kind == KIND_MOVE
-
-    # Non-selected rows are routed out of bounds (JAX scatters drop OOB
-    # indices), so duplicate candidate rows can never overwrite an accepted
-    # move with a stale no-op value.
-    p_pad = jnp.int32(state.num_partitions)
-    move_rows = jnp.where(sel & is_move, sel_p, p_pad)
-    new_assignment = state.assignment.at[move_rows, sel_slot].set(
-        sel_dst_b.astype(state.assignment.dtype), mode="drop")
-
-    lead_rows = jnp.where(sel & ~is_move, sel_p, p_pad)
-    new_leader = state.leader_slot.at[lead_rows].set(
-        sel_dst_slot.astype(state.leader_slot.dtype), mode="drop")
-
-    new_state = dataclasses.replace(state, assignment=new_assignment,
-                                    leader_slot=new_leader)
+    new_state = apply_selected(
+        state, sel, deltas.partition[top_idx], deltas.src_slot[top_idx],
+        deltas.dst_broker[top_idx], cand.kind[top_idx], cand.dst_slot[top_idx])
     return new_state, sel.sum()
 
 

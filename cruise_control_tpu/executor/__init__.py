@@ -1,0 +1,36 @@
+"""Executor layer: applies optimization proposals to the managed cluster.
+
+Reference parity: executor/ (7,370 LoC — Executor, ExecutionTaskPlanner,
+ExecutionTask state machine, concurrency manager + adjuster, movement
+strategies, replication throttling, admin glue). The admin boundary is
+pluggable; an in-memory fake backs tests and simulations.
+"""
+
+from .admin import AdminBackend, InMemoryAdminBackend, PartitionState
+from .concurrency import ConcurrencyCaps, ExecutionConcurrencyManager
+from .executor import Executor, ExecutorState, OngoingExecutionError
+from .planner import ExecutionTaskPlanner
+from .strategy import (
+    BaseReplicaMovementStrategy, PostponeUrpReplicaMovementStrategy,
+    PrioritizeLargeReplicaMovementStrategy, PrioritizeMinIsrWithOfflineReplicasStrategy,
+    PrioritizeSmallReplicaMovementStrategy, ReplicaMovementStrategy,
+    STRATEGIES, strategy_chain,
+)
+from .task import (
+    ExecutionTask, ExecutionTaskManager, ExecutionTaskTracker, TaskState, TaskType,
+)
+from .throttle import ReplicationThrottleHelper
+
+__all__ = [
+    "AdminBackend", "InMemoryAdminBackend", "PartitionState",
+    "ConcurrencyCaps", "ExecutionConcurrencyManager",
+    "Executor", "ExecutorState", "OngoingExecutionError",
+    "ExecutionTaskPlanner",
+    "BaseReplicaMovementStrategy", "PostponeUrpReplicaMovementStrategy",
+    "PrioritizeLargeReplicaMovementStrategy",
+    "PrioritizeMinIsrWithOfflineReplicasStrategy",
+    "PrioritizeSmallReplicaMovementStrategy", "ReplicaMovementStrategy",
+    "STRATEGIES", "strategy_chain",
+    "ExecutionTask", "ExecutionTaskManager", "ExecutionTaskTracker",
+    "TaskState", "TaskType", "ReplicationThrottleHelper",
+]

@@ -1,0 +1,196 @@
+"""Cluster-admin backend: the executor's boundary to the managed Kafka
+cluster.
+
+Reference parity: executor/ExecutionUtils.java (750; submits+interprets
+AdminClient calls — alterPartitionReassignments:483, electLeaders:433,
+listPartitionsBeingReassigned) and ExecutorAdminUtils.java. The backend is
+pluggable (SURVEY.md §4: "a fake Kafka admin/metadata backend for executor
+logic"): ``InMemoryAdminBackend`` simulates reassignment progress for tests
+and simulations; a kafka-python/confluent binding can implement the same
+protocol against a live cluster (gated: no Kafka client in this image).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Iterable, Mapping, Protocol
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionState:
+    topic: str
+    partition: int
+    replicas: tuple[int, ...]      # current assignment, leader first
+    leader: int
+    isr: tuple[int, ...]
+    adding: tuple[int, ...] = ()   # reassignment in progress
+    removing: tuple[int, ...] = ()
+
+    @property
+    def is_reassigning(self) -> bool:
+        return bool(self.adding or self.removing)
+
+
+class AdminBackend(Protocol):
+    """Protocol over the handful of AdminClient calls the executor needs."""
+
+    def alter_partition_reassignments(
+            self, targets: Mapping[tuple[str, int], tuple[int, ...]]) -> None: ...
+
+    def cancel_partition_reassignments(
+            self, partitions: Iterable[tuple[str, int]]) -> None: ...
+
+    def elect_leaders(self, partitions: Iterable[tuple[str, int]]) -> None: ...
+
+    def list_reassigning_partitions(self) -> list[tuple[str, int]]: ...
+
+    def describe_partitions(self) -> dict[tuple[str, int], PartitionState]: ...
+
+    def alive_brokers(self) -> set[int]: ...
+
+    def alter_broker_configs(self, configs: Mapping[int, Mapping[str, str]]) -> None: ...
+
+    def alter_topic_configs(self, configs: Mapping[str, Mapping[str, str]]) -> None: ...
+
+    def describe_broker_configs(self, brokers: Iterable[int]
+                                ) -> dict[int, dict[str, str]]: ...
+
+    def describe_topic_configs(self, topics: Iterable[str]
+                               ) -> dict[str, dict[str, str]]: ...
+
+
+class InMemoryAdminBackend:
+    """Deterministic fake cluster: each ``tick()`` advances every ongoing
+    reassignment by ``steps_per_tick`` replicas (new replicas join the ISR,
+    removed ones leave), letting executor tests simulate slow/fast clusters,
+    broker death mid-move, and external reassignments."""
+
+    def __init__(self, partitions: Iterable[PartitionState],
+                 steps_per_tick: int = 1_000_000,
+                 auto_advance: bool = True):
+        self._lock = threading.RLock()
+        self._parts: dict[tuple[str, int], PartitionState] = {
+            (p.topic, p.partition): p for p in partitions}
+        self._alive: set[int] = {b for p in self._parts.values() for b in p.replicas}
+        self._steps_per_tick = steps_per_tick
+        # auto_advance: progress the simulated cluster whenever the executor
+        # polls it, so tests don't need a separate ticking thread.
+        self._auto_advance = auto_advance
+        self.broker_configs: dict[int, dict[str, str]] = {}
+        self.topic_configs: dict[str, dict[str, str]] = {}
+        self.reassignment_calls = 0
+        self.election_calls = 0
+
+    # ---- test controls ----------------------------------------------------
+    def kill_broker(self, broker: int) -> None:
+        with self._lock:
+            self._alive.discard(broker)
+
+    def revive_broker(self, broker: int) -> None:
+        with self._lock:
+            self._alive.add(broker)
+
+    def tick(self) -> None:
+        """Advance the simulated cluster one progress interval."""
+        with self._lock:
+            budget = self._steps_per_tick
+            for key in sorted(self._parts):
+                if budget <= 0:
+                    break
+                p = self._parts[key]
+                if not p.is_reassigning:
+                    continue
+                # New replicas catch up only if their broker is alive.
+                adding = tuple(b for b in p.adding if b not in self._alive) \
+                    if any(b not in self._alive for b in p.adding) else ()
+                target = tuple(b for b in p.replicas if b not in p.removing)
+                if adding:
+                    # stalled: dead destination keeps the reassignment open
+                    continue
+                leader = p.leader if p.leader in target and p.leader in self._alive \
+                    else next((b for b in target if b in self._alive), -1)
+                self._parts[key] = PartitionState(
+                    topic=p.topic, partition=p.partition, replicas=target,
+                    leader=leader, isr=tuple(b for b in target if b in self._alive))
+                budget -= 1
+
+    # ---- AdminBackend protocol -------------------------------------------
+    def alter_partition_reassignments(self, targets) -> None:
+        with self._lock:
+            self.reassignment_calls += 1
+            for (topic, part), new_replicas in targets.items():
+                p = self._parts[(topic, part)]
+                adding = tuple(b for b in new_replicas if b not in p.replicas)
+                removing = tuple(b for b in p.replicas if b not in new_replicas)
+                merged = tuple(new_replicas) + removing
+                leader = p.leader if p.leader in merged else new_replicas[0]
+                self._parts[(topic, part)] = PartitionState(
+                    topic=topic, partition=part, replicas=merged, leader=leader,
+                    isr=tuple(b for b in merged if b in self._alive),
+                    adding=adding, removing=removing)
+
+    def cancel_partition_reassignments(self, partitions) -> None:
+        with self._lock:
+            for key in partitions:
+                p = self._parts.get(key)
+                if p is None or not p.is_reassigning:
+                    continue
+                original = tuple(b for b in p.replicas if b not in p.adding)
+                self._parts[key] = PartitionState(
+                    topic=p.topic, partition=p.partition, replicas=original,
+                    leader=p.leader if p.leader in original else original[0],
+                    isr=tuple(b for b in original if b in self._alive))
+
+    def elect_leaders(self, partitions) -> None:
+        with self._lock:
+            self.election_calls += 1
+            for key in partitions:
+                p = self._parts[key]
+                preferred = p.replicas[0] if p.replicas else -1
+                if preferred in self._alive and preferred in p.isr:
+                    self._parts[key] = dataclasses.replace(p, leader=preferred)
+
+    def list_reassigning_partitions(self):
+        with self._lock:
+            return [k for k, p in self._parts.items() if p.is_reassigning]
+
+    def describe_partitions(self):
+        with self._lock:
+            if self._auto_advance:
+                self.tick()
+            return dict(self._parts)
+
+    def alive_brokers(self):
+        with self._lock:
+            return set(self._alive)
+
+    def alter_broker_configs(self, configs) -> None:
+        with self._lock:
+            for broker, kv in configs.items():
+                self.broker_configs.setdefault(broker, {}).update(kv)
+
+    def alter_topic_configs(self, configs) -> None:
+        with self._lock:
+            for topic, kv in configs.items():
+                self.topic_configs.setdefault(topic, {}).update(kv)
+
+    def describe_broker_configs(self, brokers):
+        with self._lock:
+            return {b: dict(self.broker_configs.get(b, {})) for b in brokers}
+
+    def describe_topic_configs(self, topics):
+        with self._lock:
+            return {t: dict(self.topic_configs.get(t, {})) for t in topics}
+
+    # ---- ClusterInfo protocol for strategies ------------------------------
+    def partition_size(self, topic: str, partition: int) -> float:
+        return 1.0
+
+    def is_under_replicated(self, topic: str, partition: int) -> bool:
+        with self._lock:
+            p = self._parts[(topic, partition)]
+            return len(p.isr) < len(p.replicas)
+
+    def is_under_min_isr_with_offline(self, topic: str, partition: int) -> bool:
+        return False

@@ -1,0 +1,109 @@
+"""Execution concurrency control.
+
+Reference parity: executor/concurrency/ExecutionConcurrencyManager.java (355;
+per-broker and cluster-wide caps for inter-broker, intra-broker and
+leadership actions) and the ConcurrencyAdjuster inside Executor.java:465-683
+(periodically raises/lowers caps from broker health: under-min-ISR state
+halves throughput, healthy metrics step it up).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+
+@dataclasses.dataclass
+class ConcurrencyCaps:
+    """Defaults follow config/cruisecontrol.properties
+    (num.concurrent.partition.movements.per.broker=10,
+    max.num.cluster.partition.movements=1250,
+    num.concurrent.intra.broker.partition.movements=2,
+    num.concurrent.leader.movements=1000)."""
+
+    inter_broker_per_broker: int = 10
+    cluster_inter_broker: int = 1250
+    intra_broker_per_broker: int = 2
+    leadership_cluster: int = 1000
+    leadership_per_broker: int = 250
+
+
+class ExecutionConcurrencyManager:
+    """Tracks caps + in-flight counts; thread-safe
+    (ExecutionConcurrencyManager.java)."""
+
+    # Adjuster bounds (ConcurrencyAdjuster MIN/MAX constants).
+    MIN_INTER_BROKER = 1
+    MAX_INTER_BROKER_MULTIPLIER = 2
+    MIN_LEADERSHIP = 100
+
+    def __init__(self, caps: ConcurrencyCaps | None = None):
+        self._caps = caps or ConcurrencyCaps()
+        self._base = dataclasses.replace(self._caps)
+        self._lock = threading.Lock()
+        self._inter_in_flight: dict[int, int] = {}   # broker -> count
+        self._intra_in_flight: dict[int, int] = {}
+        self._cluster_inter_in_flight = 0
+
+    # ---- capacity queries -------------------------------------------------
+    def inter_broker_headroom(self, broker: int) -> int:
+        with self._lock:
+            per = self._caps.inter_broker_per_broker - self._inter_in_flight.get(broker, 0)
+            cluster = self._caps.cluster_inter_broker - self._cluster_inter_in_flight
+            return max(0, min(per, cluster))
+
+    def cluster_inter_broker_headroom(self) -> int:
+        """Remaining cluster-wide inter-broker movement capacity; batch
+        sizes must be bounded by this, not the raw cap, or concurrent
+        batches can push in-flight past max.num.cluster.movements."""
+        with self._lock:
+            return max(0, self._caps.cluster_inter_broker
+                       - self._cluster_inter_in_flight)
+
+    def leadership_cap(self) -> int:
+        return self._caps.leadership_cluster
+
+    # ---- in-flight accounting --------------------------------------------
+    def acquire_inter_broker(self, brokers: tuple[int, ...]) -> None:
+        with self._lock:
+            for b in brokers:
+                self._inter_in_flight[b] = self._inter_in_flight.get(b, 0) + 1
+            self._cluster_inter_in_flight += 1
+
+    def release_inter_broker(self, brokers: tuple[int, ...]) -> None:
+        with self._lock:
+            for b in brokers:
+                self._inter_in_flight[b] = max(0, self._inter_in_flight.get(b, 0) - 1)
+            self._cluster_inter_in_flight = max(0, self._cluster_inter_in_flight - 1)
+
+    # ---- adaptive adjustment (ConcurrencyAdjuster) ------------------------
+    def adjust(self, cluster_healthy: bool, has_under_min_isr: bool) -> None:
+        """One adjuster tick: halve inter-broker concurrency under min-ISR
+        pressure, step up toward 2× base when healthy
+        (Executor.java:465-683)."""
+        with self._lock:
+            cap = self._caps.inter_broker_per_broker
+            if has_under_min_isr:
+                cap = max(self.MIN_INTER_BROKER, cap // 2)
+            elif cluster_healthy:
+                cap = min(self._base.inter_broker_per_broker
+                          * self.MAX_INTER_BROKER_MULTIPLIER, cap + 1)
+            else:
+                cap = max(self.MIN_INTER_BROKER, cap - 1)
+            self._caps.inter_broker_per_broker = cap
+
+            lcap = self._caps.leadership_cluster
+            if has_under_min_isr:
+                lcap = max(self.MIN_LEADERSHIP, lcap // 2)
+            elif cluster_healthy:
+                lcap = min(self._base.leadership_cluster, lcap + 100)
+            self._caps.leadership_cluster = lcap
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "interBrokerPerBroker": self._caps.inter_broker_per_broker,
+                "clusterInterBroker": self._caps.cluster_inter_broker,
+                "leadershipCluster": self._caps.leadership_cluster,
+                "interBrokerInFlight": self._cluster_inter_in_flight,
+            }

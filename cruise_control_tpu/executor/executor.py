@@ -1,0 +1,290 @@
+"""The Executor: applies optimization proposals to the live cluster.
+
+Reference parity: executor/Executor.java (2,223 LoC). Lifecycle:
+``execute_proposals`` reserves execution, expands proposals into tasks, and
+a background runnable works the three phases in order — inter-broker moves,
+intra-broker moves, leadership — batching per progress-check interval,
+polling completion, marking tasks on dead brokers DEAD, and re-submitting
+leftovers (Executor.java:1291 ProposalExecutionRunnable, :1436-1497 phase
+order, :2211 leftover re-execution). Stop signals abort pending work and
+cancel in-flight reassignments (userTriggeredStopExecution:1139).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Callable, Iterable, Sequence
+
+from ..analyzer.proposals import ExecutionProposal
+from .admin import AdminBackend
+from .concurrency import ConcurrencyCaps, ExecutionConcurrencyManager
+from .planner import ExecutionTaskPlanner
+from .strategy import ReplicaMovementStrategy
+from .task import (
+    ExecutionTask, ExecutionTaskManager, TaskState, TaskType,
+)
+from .throttle import ReplicationThrottleHelper
+
+
+class ExecutorState(enum.Enum):
+    """Executor.State (ExecutorState.java)."""
+
+    NO_TASK_IN_PROGRESS = "NO_TASK_IN_PROGRESS"
+    STARTING_EXECUTION = "STARTING_EXECUTION"
+    INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = \
+        "INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS"
+    INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = \
+        "INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS"
+    LEADER_MOVEMENT_TASK_IN_PROGRESS = "LEADER_MOVEMENT_TASK_IN_PROGRESS"
+    STOPPING_EXECUTION = "STOPPING_EXECUTION"
+
+
+class OngoingExecutionError(RuntimeError):
+    """An execution is already in progress (Executor's IllegalState)."""
+
+
+class Executor:
+    def __init__(self, admin: AdminBackend,
+                 caps: ConcurrencyCaps | None = None,
+                 strategy: ReplicaMovementStrategy | None = None,
+                 progress_check_interval_s: float = 0.05,
+                 replication_throttle: int | None = None,
+                 task_timeout_s: float = 3600.0,
+                 on_sampling_mode_change: Callable[[bool], None] | None = None,
+                 synchronous: bool = False):
+        self._admin = admin
+        self._concurrency = ExecutionConcurrencyManager(caps)
+        self._strategy = strategy
+        self._interval = progress_check_interval_s
+        self._task_timeout_s = task_timeout_s
+        self._throttle = ReplicationThrottleHelper(admin, replication_throttle)
+        # Executor.java:1408-1424: pause/restore metric sampling around
+        # execution so in-flight moves don't pollute the load model.
+        self._on_sampling_mode_change = on_sampling_mode_change
+        self._synchronous = synchronous
+
+        self._lock = threading.Lock()
+        self._state = ExecutorState.NO_TASK_IN_PROGRESS
+        self._stop_requested = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._task_manager: ExecutionTaskManager | None = None
+        self._planner: ExecutionTaskPlanner | None = None
+        self._uuid: str | None = None
+        self._history: list[dict] = []
+
+    # ---- public surface ---------------------------------------------------
+    @property
+    def state(self) -> ExecutorState:
+        return self._state
+
+    def has_ongoing_execution(self) -> bool:
+        return self._state is not ExecutorState.NO_TASK_IN_PROGRESS
+
+    def execute_proposals(self, proposals: Sequence[ExecutionProposal],
+                          uuid: str = "") -> None:
+        """Start executing; raises OngoingExecutionError when busy
+        (Executor.executeProposals:809)."""
+        with self._lock:
+            if self.has_ongoing_execution():
+                raise OngoingExecutionError(
+                    f"execution {self._uuid!r} still in progress")
+            self._state = ExecutorState.STARTING_EXECUTION
+            self._stop_requested.clear()
+            self._uuid = uuid
+            self._task_manager = ExecutionTaskManager()
+            self._planner = ExecutionTaskPlanner(self._strategy)
+            tasks = self._task_manager.tasks_from_proposals(proposals)
+            self._planner.add_tasks(tasks, self._admin)
+        if self._synchronous:
+            self._run()
+        else:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name=f"proposal-execution-{uuid}")
+            self._thread.start()
+
+    def stop_execution(self) -> None:
+        """User-triggered stop (Executor.userTriggeredStopExecution:1139):
+        drop pending tasks, cancel in-flight reassignments. Takes the lock so
+        a finishing runnable can't be resurrected into STOPPING."""
+        with self._lock:
+            if not self.has_ongoing_execution():
+                return
+            self._state = ExecutorState.STOPPING_EXECUTION
+            self._stop_requested.set()
+
+    def await_completion(self, timeout_s: float = 60.0) -> bool:
+        t = self._thread
+        if t is not None:
+            t.join(timeout_s)
+            return not t.is_alive()
+        return True
+
+    def execution_state(self) -> dict:
+        tm = self._task_manager
+        return {
+            "state": self._state.value,
+            "uuid": self._uuid,
+            "taskCounts": tm.tracker.counts() if tm else {},
+            "concurrency": self._concurrency.state(),
+            "recentHistory": self._history[-5:],
+        }
+
+    def adjust_concurrency(self, cluster_healthy: bool,
+                           has_under_min_isr: bool) -> None:
+        self._concurrency.adjust(cluster_healthy, has_under_min_isr)
+
+    def _set_phase(self, phase: ExecutorState) -> None:
+        # Never overwrite a user-requested STOPPING state from the worker.
+        with self._lock:
+            if not self._stop_requested.is_set():
+                self._state = phase
+
+    # ---- the proposal execution runnable ---------------------------------
+    def _run(self) -> None:
+        t0 = time.time()
+        stopped = False
+        try:
+            if self._on_sampling_mode_change:
+                self._on_sampling_mode_change(True)
+            stopped = not self._inter_broker_move_phase()
+            if not stopped:
+                stopped = not self._intra_broker_move_phase()
+            if not stopped:
+                stopped = not self._leadership_phase()
+        finally:
+            self._throttle.clear_throttles()
+            if self._on_sampling_mode_change:
+                self._on_sampling_mode_change(False)
+            tm = self._task_manager
+            self._history.append({
+                "uuid": self._uuid,
+                "stopped": stopped or self._stop_requested.is_set(),
+                "durationS": round(time.time() - t0, 3),
+                "taskCounts": tm.tracker.counts() if tm else {},
+            })
+            with self._lock:
+                self._state = ExecutorState.NO_TASK_IN_PROGRESS
+
+    def _abort_pending_and_inflight(self, in_flight: list[ExecutionTask]) -> None:
+        assert self._planner is not None and self._task_manager is not None
+        tracker = self._task_manager.tracker
+        dropped = self._planner.clear()
+        tracker.add(dropped)
+        for task in dropped:
+            tracker.transition(task, task.in_progress)
+            tracker.transition(task, task.abort)
+            tracker.transition(task, task.aborted)
+        if in_flight:
+            self._admin.cancel_partition_reassignments(
+                [t.topic_partition for t in in_flight])
+            for task in in_flight:
+                tracker.transition(task, task.abort)
+                tracker.transition(task, task.aborted)
+                self._concurrency.release_inter_broker(
+                    tuple(set(task.proposal.replicas_to_add)
+                          | set(task.proposal.replicas_to_remove)))
+            in_flight.clear()
+
+    def _inter_broker_move_phase(self) -> bool:
+        """Executor.interBrokerMoveReplicas:1603. Returns False if stopped."""
+        assert self._planner is not None and self._task_manager is not None
+        self._set_phase(ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS)
+        tracker = self._task_manager.tracker
+        in_flight: list[ExecutionTask] = []
+
+        while True:
+            if self._stop_requested.is_set():
+                self._abort_pending_and_inflight(in_flight)
+                return False
+
+            # Submit as many ready tasks as concurrency allows.
+            batch = self._planner.inter_broker_tasks(
+                self._concurrency.inter_broker_headroom,
+                max_total=self._concurrency.cluster_inter_broker_headroom())
+            if batch:
+                self._throttle.set_throttles(batch)
+                targets = {t.topic_partition: t.proposal.new_replicas for t in batch}
+                self._admin.alter_partition_reassignments(targets)
+                for task in batch:
+                    tracker.transition(task, task.in_progress)
+                    self._concurrency.acquire_inter_broker(
+                        tuple(set(task.proposal.replicas_to_add)
+                              | set(task.proposal.replicas_to_remove)))
+                in_flight.extend(batch)
+
+            if not in_flight and self._planner.num_pending(
+                    TaskType.INTER_BROKER_REPLICA_ACTION) == 0:
+                return True
+
+            time.sleep(self._interval)
+            self._poll_inter_broker(in_flight)
+
+    def _poll_inter_broker(self, in_flight: list[ExecutionTask]) -> None:
+        """waitForInterBrokerReplicaTasksToFinish: poll reassignment state,
+        complete finished tasks, kill tasks stuck on dead destinations
+        (ExecutionUtils.isInterBrokerReplicaActionDone)."""
+        assert self._task_manager is not None
+        tracker = self._task_manager.tracker
+        parts = self._admin.describe_partitions()
+        alive = self._admin.alive_brokers()
+        now = time.time()
+        still: list[ExecutionTask] = []
+        for task in in_flight:
+            p = parts.get(task.topic_partition)
+            done = p is not None and not p.is_reassigning \
+                and set(p.replicas) == set(task.proposal.new_replicas)
+            brokers = tuple(set(task.proposal.replicas_to_add)
+                            | set(task.proposal.replicas_to_remove))
+            if done:
+                tracker.transition(task, task.completed)
+                self._concurrency.release_inter_broker(brokers)
+            elif any(b not in alive for b in task.proposal.replicas_to_add) or \
+                    (task.start_time_ms > 0
+                     and now - task.start_time_ms / 1000 > self._task_timeout_s):
+                # Destination died or task timed out: mark DEAD, cancel.
+                self._admin.cancel_partition_reassignments([task.topic_partition])
+                tracker.transition(task, task.kill)
+                self._concurrency.release_inter_broker(brokers)
+            else:
+                still.append(task)
+        in_flight[:] = still
+
+    def _intra_broker_move_phase(self) -> bool:
+        """Executor.intraBrokerMoveReplicas:1672 (logdir moves). The tensor
+        model does not yet carry logdirs, so the phase is a structural no-op
+        that drains any queued intra-broker tasks."""
+        assert self._planner is not None and self._task_manager is not None
+        self._set_phase(ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS)
+        tracker = self._task_manager.tracker
+        for task in self._planner.intra_broker_tasks(max_total=1 << 30):
+            tracker.transition(task, task.in_progress)
+            tracker.transition(task, task.completed)
+        return not self._stop_requested.is_set()
+
+    def _leadership_phase(self) -> bool:
+        """Executor.moveLeaderships:1732 → electLeaders batches."""
+        assert self._planner is not None and self._task_manager is not None
+        self._set_phase(ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS)
+        tracker = self._task_manager.tracker
+        while True:
+            if self._stop_requested.is_set():
+                for task in self._planner.leadership_tasks(max_total=1 << 30):
+                    tracker.transition(task, task.in_progress)
+                    tracker.transition(task, task.abort)
+                    tracker.transition(task, task.aborted)
+                return False
+            batch = self._planner.leadership_tasks(self._concurrency.leadership_cap())
+            if not batch:
+                return True
+            self._admin.elect_leaders([t.topic_partition for t in batch])
+            parts = self._admin.describe_partitions()
+            for task in batch:
+                tracker.transition(task, task.in_progress)
+                p = parts.get(task.topic_partition)
+                if p is not None and p.leader == task.proposal.new_leader:
+                    tracker.transition(task, task.completed)
+                else:
+                    tracker.transition(task, task.kill)
+            time.sleep(0)  # yield between batches

@@ -1,0 +1,89 @@
+"""Execution task planning: which tasks are ready to submit next.
+
+Reference parity: executor/ExecutionTaskPlanner.java (540 LoC): pending
+task pools per type; inter-broker tasks are dequeued only when BOTH the
+source and destination brokers have concurrency headroom
+(getInterBrokerReplicaMovementTasks(readyBrokers):348); ordering is the
+pluggable ReplicaMovementStrategy chain.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable
+
+from .strategy import ClusterInfo, ReplicaMovementStrategy, strategy_chain
+from .task import ExecutionTask, TaskType
+
+
+class ExecutionTaskPlanner:
+    def __init__(self, strategy: ReplicaMovementStrategy | None = None):
+        self._strategy = strategy or strategy_chain([])
+        self._lock = threading.Lock()
+        self._pending: dict[TaskType, list[ExecutionTask]] = {t: [] for t in TaskType}
+
+    def add_tasks(self, tasks: Iterable[ExecutionTask], cluster: ClusterInfo) -> None:
+        with self._lock:
+            for t in tasks:
+                self._pending[t.task_type].append(t)
+            self._pending[TaskType.INTER_BROKER_REPLICA_ACTION] = self._strategy.sort(
+                self._pending[TaskType.INTER_BROKER_REPLICA_ACTION], cluster)
+
+    def num_pending(self, task_type: TaskType | None = None) -> int:
+        with self._lock:
+            if task_type is not None:
+                return len(self._pending[task_type])
+            return sum(len(v) for v in self._pending.values())
+
+    def inter_broker_tasks(self, headroom_of, max_total: int) -> list[ExecutionTask]:
+        """Dequeue inter-broker tasks whose participating brokers all have
+        headroom; ``headroom_of(broker) -> int`` is consulted and decremented
+        greedily in strategy order (ExecutionTaskPlanner.java:348)."""
+        picked: list[ExecutionTask] = []
+        budget: dict[int, int] = {}
+
+        def room(b: int) -> int:
+            if b not in budget:
+                budget[b] = headroom_of(b)
+            return budget[b]
+
+        with self._lock:
+            remaining = []
+            for task in self._pending[TaskType.INTER_BROKER_REPLICA_ACTION]:
+                if len(picked) >= max_total:
+                    remaining.append(task)
+                    continue
+                brokers = set(task.proposal.replicas_to_add) \
+                    | set(task.proposal.replicas_to_remove)
+                # Reorder-only tasks (empty add/remove sets) are metadata
+                # writes; they bypass per-broker movement caps.
+                if all(room(b) > 0 for b in brokers):
+                    for b in brokers:
+                        budget[b] -= 1
+                    picked.append(task)
+                else:
+                    remaining.append(task)
+            self._pending[TaskType.INTER_BROKER_REPLICA_ACTION] = remaining
+        return picked
+
+    def leadership_tasks(self, max_total: int) -> list[ExecutionTask]:
+        with self._lock:
+            pool = self._pending[TaskType.LEADER_ACTION]
+            picked, rest = pool[:max_total], pool[max_total:]
+            self._pending[TaskType.LEADER_ACTION] = rest
+            return picked
+
+    def intra_broker_tasks(self, max_total: int) -> list[ExecutionTask]:
+        with self._lock:
+            pool = self._pending[TaskType.INTRA_BROKER_REPLICA_ACTION]
+            picked, rest = pool[:max_total], pool[max_total:]
+            self._pending[TaskType.INTRA_BROKER_REPLICA_ACTION] = rest
+            return picked
+
+    def clear(self) -> list[ExecutionTask]:
+        """Drop all pending tasks (stop-execution); returns the dropped."""
+        with self._lock:
+            dropped = [t for pool in self._pending.values() for t in pool]
+            for pool in self._pending.values():
+                pool.clear()
+            return dropped

@@ -1,0 +1,123 @@
+"""Replica-movement ordering strategies.
+
+Reference parity: executor/strategy/ (539 LoC): ReplicaMovementStrategy SPI
+with chain()-able comparators — BaseReplicaMovementStrategy,
+PrioritizeSmallReplicaMovementStrategy, PrioritizeLargeReplicaMovementStrategy,
+PostponeUrpReplicaMovementStrategy, PrioritizeMinIsrWithOfflineReplicasStrategy.
+A strategy sorts the pending inter-broker tasks; chained strategies break
+ties left to right, with BaseReplicaMovementStrategy (execution id order)
+always the final tiebreak.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Protocol
+
+from .task import ExecutionTask
+
+
+class ClusterInfo(Protocol):
+    """Minimal cluster facts the strategies consult (the reference passes a
+    Kafka ``Cluster`` + min-ISR cache; here a narrow protocol the admin
+    backend implements)."""
+
+    def partition_size(self, topic: str, partition: int) -> float: ...
+    def is_under_replicated(self, topic: str, partition: int) -> bool: ...
+    def is_under_min_isr_with_offline(self, topic: str, partition: int) -> bool: ...
+
+
+class ReplicaMovementStrategy:
+    """SPI: returns a sort key for one task; lower sorts earlier
+    (ReplicaMovementStrategy.java)."""
+
+    name = "AbstractReplicaMovementStrategy"
+
+    def key(self, task: ExecutionTask, cluster: ClusterInfo):
+        return 0
+
+    def chain(self, nxt: "ReplicaMovementStrategy") -> "ReplicaMovementStrategy":
+        return _Chained(self, nxt)
+
+    def sort(self, tasks: Iterable[ExecutionTask],
+             cluster: ClusterInfo) -> list[ExecutionTask]:
+        final = self.chain(BaseReplicaMovementStrategy())
+        return sorted(tasks, key=lambda t: final.key(t, cluster))
+
+
+class _Chained(ReplicaMovementStrategy):
+    def __init__(self, first: ReplicaMovementStrategy, second: ReplicaMovementStrategy):
+        self._first, self._second = first, second
+        self.name = f"{first.name}->{second.name}"
+
+    def key(self, task, cluster):
+        fk = self._first.key(task, cluster)
+        sk = self._second.key(task, cluster)
+        fk = fk if isinstance(fk, tuple) else (fk,)
+        sk = sk if isinstance(sk, tuple) else (sk,)
+        return fk + sk
+
+
+class BaseReplicaMovementStrategy(ReplicaMovementStrategy):
+    """Execution-id order (BaseReplicaMovementStrategy.java)."""
+
+    name = "BaseReplicaMovementStrategy"
+
+    def key(self, task, cluster):
+        return task.execution_id
+
+
+class PrioritizeSmallReplicaMovementStrategy(ReplicaMovementStrategy):
+    name = "PrioritizeSmallReplicaMovementStrategy"
+
+    def key(self, task, cluster):
+        return cluster.partition_size(*task.topic_partition)
+
+
+class PrioritizeLargeReplicaMovementStrategy(ReplicaMovementStrategy):
+    name = "PrioritizeLargeReplicaMovementStrategy"
+
+    def key(self, task, cluster):
+        return -cluster.partition_size(*task.topic_partition)
+
+
+class PostponeUrpReplicaMovementStrategy(ReplicaMovementStrategy):
+    """Move healthy (non-under-replicated) partitions first
+    (PostponeUrpReplicaMovementStrategy.java)."""
+
+    name = "PostponeUrpReplicaMovementStrategy"
+
+    def key(self, task, cluster):
+        return 1 if cluster.is_under_replicated(*task.topic_partition) else 0
+
+
+class PrioritizeMinIsrWithOfflineReplicasStrategy(ReplicaMovementStrategy):
+    """(At/Under)MinISR partitions with offline replicas first
+    (PrioritizeMinIsrWithOfflineReplicasStrategy.java)."""
+
+    name = "PrioritizeMinIsrWithOfflineReplicasStrategy"
+
+    def key(self, task, cluster):
+        return 0 if cluster.is_under_min_isr_with_offline(*task.topic_partition) else 1
+
+
+STRATEGIES: dict[str, Callable[[], ReplicaMovementStrategy]] = {
+    cls.name: cls for cls in (
+        BaseReplicaMovementStrategy,
+        PrioritizeSmallReplicaMovementStrategy,
+        PrioritizeLargeReplicaMovementStrategy,
+        PostponeUrpReplicaMovementStrategy,
+        PrioritizeMinIsrWithOfflineReplicasStrategy,
+    )
+}
+
+
+def strategy_chain(names: Iterable[str]) -> ReplicaMovementStrategy:
+    """Build a chained strategy from config names
+    (default.replica.movement.strategies semantics)."""
+    chain: ReplicaMovementStrategy | None = None
+    for n in names:
+        if n not in STRATEGIES:
+            raise ValueError(f"unknown replica movement strategy {n!r}")
+        s = STRATEGIES[n]()
+        chain = s if chain is None else chain.chain(s)
+    return chain or BaseReplicaMovementStrategy()

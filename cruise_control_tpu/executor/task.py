@@ -1,0 +1,188 @@
+"""Execution task state machine and bookkeeping.
+
+Reference parity: executor/ExecutionTask.java (305 LoC; state machine
+PENDING → IN_PROGRESS → ABORTING/ABORTED/DEAD/COMPLETED),
+executor/ExecutionTaskTracker.java (433), executor/ExecutionTaskManager.java
+(384). The task types mirror ExecutionTask.TaskType: INTER_BROKER_REPLICA_ACTION,
+INTRA_BROKER_REPLICA_ACTION, LEADER_ACTION.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+import threading
+import time
+from typing import Iterable
+
+from ..analyzer.proposals import ExecutionProposal
+
+
+class TaskType(enum.Enum):
+    INTER_BROKER_REPLICA_ACTION = "inter_broker_replica_action"
+    INTRA_BROKER_REPLICA_ACTION = "intra_broker_replica_action"
+    LEADER_ACTION = "leader_action"
+
+
+class TaskState(enum.Enum):
+    PENDING = "pending"
+    IN_PROGRESS = "in_progress"
+    ABORTING = "aborting"
+    ABORTED = "aborted"
+    DEAD = "dead"
+    COMPLETED = "completed"
+
+
+# Legal transitions (ExecutionTask.java VALID_TRANSFER map).
+_VALID = {
+    TaskState.PENDING: {TaskState.IN_PROGRESS},
+    TaskState.IN_PROGRESS: {TaskState.ABORTING, TaskState.DEAD,
+                            TaskState.COMPLETED},
+    TaskState.ABORTING: {TaskState.ABORTED, TaskState.DEAD},
+    TaskState.ABORTED: set(),
+    TaskState.DEAD: set(),
+    TaskState.COMPLETED: set(),
+}
+
+
+@dataclasses.dataclass
+class ExecutionTask:
+    """One unit of executed work for a partition (ExecutionTask.java)."""
+
+    execution_id: int
+    proposal: ExecutionProposal
+    task_type: TaskType
+    state: TaskState = TaskState.PENDING
+    start_time_ms: int = -1
+    end_time_ms: int = -1
+    alert_time_ms: int = -1
+
+    def _transfer(self, to: TaskState) -> None:
+        if to not in _VALID[self.state]:
+            raise ValueError(
+                f"illegal task state transfer {self.state.value} -> {to.value} "
+                f"for task {self.execution_id}")
+        self.state = to
+
+    def in_progress(self, now_ms: int | None = None) -> None:
+        self._transfer(TaskState.IN_PROGRESS)
+        self.start_time_ms = now_ms if now_ms is not None else _now_ms()
+
+    def completed(self, now_ms: int | None = None) -> None:
+        self._transfer(TaskState.COMPLETED)
+        self.end_time_ms = now_ms if now_ms is not None else _now_ms()
+
+    def kill(self, now_ms: int | None = None) -> None:
+        self._transfer(TaskState.DEAD)
+        self.end_time_ms = now_ms if now_ms is not None else _now_ms()
+
+    def abort(self) -> None:
+        self._transfer(TaskState.ABORTING)
+
+    def aborted(self, now_ms: int | None = None) -> None:
+        self._transfer(TaskState.ABORTED)
+        self.end_time_ms = now_ms if now_ms is not None else _now_ms()
+
+    @property
+    def topic_partition(self) -> tuple[str, int]:
+        return (self.proposal.topic, self.proposal.partition)
+
+    def brokers_to_add(self) -> tuple[int, ...]:
+        return self.proposal.replicas_to_add
+
+    def brokers_to_remove(self) -> tuple[int, ...]:
+        return self.proposal.replicas_to_remove
+
+    def to_dict(self) -> dict:
+        return {
+            "executionId": self.execution_id,
+            "type": self.task_type.value,
+            "state": self.state.value,
+            "proposal": {
+                "topicPartition": f"{self.proposal.topic}-{self.proposal.partition}",
+                "oldLeader": self.proposal.old_leader,
+                "oldReplicas": list(self.proposal.old_replicas),
+                "newReplicas": list(self.proposal.new_replicas),
+                "newLeader": self.proposal.new_leader,
+            },
+        }
+
+
+def _now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+class ExecutionTaskTracker:
+    """Task counts by (type, state) + recent history
+    (ExecutionTaskTracker.java)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tasks: dict[TaskType, dict[TaskState, set[int]]] = {
+            t: {s: set() for s in TaskState} for t in TaskType}
+        self._by_id: dict[int, ExecutionTask] = {}
+
+    def add(self, tasks: Iterable[ExecutionTask]) -> None:
+        with self._lock:
+            for t in tasks:
+                self._tasks[t.task_type][t.state].add(t.execution_id)
+                self._by_id[t.execution_id] = t
+
+    def transition(self, task: ExecutionTask, action) -> None:
+        """Apply ``action`` (a bound state-machine method) and reindex."""
+        with self._lock:
+            self._tasks[task.task_type][task.state].discard(task.execution_id)
+            action()
+            self._tasks[task.task_type][task.state].add(task.execution_id)
+
+    def counts(self) -> dict[str, dict[str, int]]:
+        with self._lock:
+            return {t.value: {s.value: len(ids) for s, ids in by_state.items() if ids}
+                    for t, by_state in self._tasks.items()}
+
+    def tasks_in(self, task_type: TaskType, *states: TaskState) -> list[ExecutionTask]:
+        with self._lock:
+            ids = set().union(*(self._tasks[task_type][s] for s in states))
+            return [self._by_id[i] for i in sorted(ids)]
+
+    def num_finished(self) -> int:
+        done = (TaskState.COMPLETED, TaskState.ABORTED, TaskState.DEAD)
+        with self._lock:
+            return sum(len(self._tasks[t][s]) for t in TaskType for s in done)
+
+    def num_total(self) -> int:
+        with self._lock:
+            return len(self._by_id)
+
+    def is_done(self) -> bool:
+        return self.num_finished() == self.num_total()
+
+
+class ExecutionTaskManager:
+    """Creates tasks from proposals and owns the tracker
+    (ExecutionTaskManager.java). Phases (ExecutionTaskPlanner semantics):
+    a proposal can expand into up to three tasks — inter-broker move,
+    intra-broker move (logdir, not yet modeled), and a leader action when
+    the leader changes or the old leader is removed."""
+
+    def __init__(self):
+        self._id_gen = itertools.count()
+        self.tracker = ExecutionTaskTracker()
+
+    def tasks_from_proposals(self, proposals: Iterable[ExecutionProposal],
+                             ) -> list[ExecutionTask]:
+        tasks: list[ExecutionTask] = []
+        for p in proposals:
+            # Order-sensitive: a leadership-only proposal still needs a
+            # (metadata-only) reassignment to reorder the replica list,
+            # because preferred-leader election picks replicas[0]
+            # (ExecutionProposal leader-first convention).
+            if tuple(p.old_replicas) != tuple(p.new_replicas):
+                tasks.append(ExecutionTask(next(self._id_gen), p,
+                                           TaskType.INTER_BROKER_REPLICA_ACTION))
+            if p.new_leader != p.old_leader and p.new_leader >= 0:
+                tasks.append(ExecutionTask(next(self._id_gen), p,
+                                           TaskType.LEADER_ACTION))
+        self.tracker.add(tasks)
+        return tasks

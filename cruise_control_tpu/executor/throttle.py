@@ -1,0 +1,70 @@
+"""Replication throttling during reassignments.
+
+Reference parity: executor/ReplicationThrottleHelper.java (451 LoC): before
+submitting inter-broker moves, set ``leader.replication.throttled.rate`` /
+``follower.replication.throttled.rate`` on the participating brokers and
+``leader.replication.throttled.replicas`` / ``follower...`` on the moved
+topics; clear them when the affected tasks finish (only the values this
+helper set — user-set throttles are preserved).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from .admin import AdminBackend
+from .task import ExecutionTask
+
+LEADER_RATE = "leader.replication.throttled.rate"
+FOLLOWER_RATE = "follower.replication.throttled.rate"
+LEADER_REPLICAS = "leader.replication.throttled.replicas"
+FOLLOWER_REPLICAS = "follower.replication.throttled.replicas"
+WILDCARD = "*"
+
+
+class ReplicationThrottleHelper:
+    def __init__(self, admin: AdminBackend, rate_bytes_per_sec: int | None):
+        self._admin = admin
+        self._rate = rate_bytes_per_sec
+        # broker/topic -> {key: previous value} so operator-set throttles are
+        # restored on clear (ReplicationThrottleHelper.java checks existing
+        # configs before removing; "" marks a key that did not exist).
+        self._saved_broker: dict[int, dict[str, str]] = {}
+        self._saved_topic: dict[str, dict[str, str]] = {}
+
+    def set_throttles(self, tasks: Iterable[ExecutionTask]) -> None:
+        if self._rate is None:
+            return
+        brokers: set[int] = set()
+        topics: set[str] = set()
+        for t in tasks:
+            brokers |= set(t.proposal.old_replicas) | set(t.proposal.new_replicas)
+            topics.add(t.proposal.topic)
+        new_brokers = brokers - self._saved_broker.keys()
+        if new_brokers:
+            existing = self._admin.describe_broker_configs(new_brokers)
+            for b in new_brokers:
+                self._saved_broker[b] = {k: existing.get(b, {}).get(k, "")
+                                         for k in (LEADER_RATE, FOLLOWER_RATE)}
+            self._admin.alter_broker_configs({
+                b: {LEADER_RATE: str(self._rate), FOLLOWER_RATE: str(self._rate)}
+                for b in new_brokers})
+        new_topics = topics - self._saved_topic.keys()
+        if new_topics:
+            existing_t = self._admin.describe_topic_configs(new_topics)
+            for t in new_topics:
+                self._saved_topic[t] = {k: existing_t.get(t, {}).get(k, "")
+                                        for k in (LEADER_REPLICAS, FOLLOWER_REPLICAS)}
+            self._admin.alter_topic_configs({
+                t: {LEADER_REPLICAS: WILDCARD, FOLLOWER_REPLICAS: WILDCARD}
+                for t in new_topics})
+
+    def clear_throttles(self) -> None:
+        if self._rate is None:
+            return
+        if self._saved_broker:
+            self._admin.alter_broker_configs(dict(self._saved_broker))
+            self._saved_broker.clear()
+        if self._saved_topic:
+            self._admin.alter_topic_configs(dict(self._saved_topic))
+            self._saved_topic.clear()

@@ -1,0 +1,16 @@
+"""Multi-chip SPMD solver: device mesh + partition-axis-sharded search.
+
+TPU-native replacement for the reference in-JVM concurrency (precompute
+thread pool, shared mutable ClusterModel -- SURVEY.md §2.11): collectives
+over ICI/DCN instead of locks.
+"""
+
+from .mesh import PARTITION_AXIS, make_mesh, partition_sharding, replicated_sharding
+from .sharded import (
+    optimize_goal_sharded, shard_cluster, sharded_optimize_round,
+)
+
+__all__ = [
+    "PARTITION_AXIS", "make_mesh", "partition_sharding", "replicated_sharding",
+    "optimize_goal_sharded", "shard_cluster", "sharded_optimize_round",
+]

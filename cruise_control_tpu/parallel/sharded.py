@@ -1,0 +1,203 @@
+"""Multi-chip sharded rebalance search.
+
+SPMD version of ``analyzer.search.optimize_round`` over a 1-D device mesh:
+
+- the partition-indexed tensors (``assignment``, ``leader_slot``, loads,
+  ``topic``, ``partition_mask``) are sharded along the mesh axis ``"p"``;
+- broker-indexed tensors (capacity, rack, states) are replicated;
+- per-broker aggregates (loads, replica/leader counts) are computed as local
+  partial segment-sums and combined with ``psum`` — collectives ride ICI;
+- every device generates candidates from ITS partitions, scores them against
+  the global aggregates, and the small reduced candidate set is
+  ``all_gather``-ed so all devices agree on one conflict-free batch;
+- each device applies the agreed moves that land in its partition shard.
+
+The scoring body is the SAME code as the single-device round
+(search.score_round_candidates / apply_selected) with the psum hook and a
+per-shard row offset plugged in — one source of truth for goal semantics.
+
+This replaces the reference's precompute thread pool + shared mutable
+ClusterModel (GoalOptimizer.java:112-119, SURVEY.md §2.11) with pure SPMD:
+no locks, the "shared state" is the replicated per-broker aggregate.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..analyzer.constraint import BalancingConstraint
+from ..analyzer.derived import compute_derived
+from ..analyzer.search import (
+    ExclusionMasks, OptimizationFailureError, SearchConfig, _conflict_free_top_m,
+    apply_selected, goal_aux, reduce_per_source, score_round_candidates,
+)
+from ..model.tensors import ClusterTensors
+from .mesh import PARTITION_AXIS
+
+
+def _state_specs() -> ClusterTensors:
+    """PartitionSpec pytree for ClusterTensors: partition axis sharded,
+    broker axis replicated."""
+    return ClusterTensors(
+        assignment=P(PARTITION_AXIS), leader_slot=P(PARTITION_AXIS),
+        leader_load=P(PARTITION_AXIS), follower_load=P(PARTITION_AXIS),
+        capacity=P(), rack=P(), broker_state=P(), topic=P(PARTITION_AXIS),
+        partition_mask=P(PARTITION_AXIS), broker_mask=P())
+
+
+def shard_cluster(state: ClusterTensors, mesh: Mesh) -> ClusterTensors:
+    """Place a ClusterTensors on the mesh with the partition axis sharded.
+    Partition count must divide the mesh size (pad via the builder's
+    partition_bucket)."""
+    n = mesh.devices.size
+    if state.num_partitions % n != 0:
+        raise ValueError(
+            f"num_partitions {state.num_partitions} not divisible by mesh size {n}")
+    specs = _state_specs()
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs)
+
+
+def _psum(x):
+    return jax.lax.psum(x, PARTITION_AXIS)
+
+
+def _round_local(state: ClusterTensors, masks: ExclusionMasks, *, goal,
+                 optimized, constraint, cfg: SearchConfig, num_topics: int,
+                 num_shards: int):
+    """Per-device body of one sharded search round (runs under shard_map;
+    ``state`` holds this device's partition rows)."""
+    shard = jax.lax.axis_index(PARTITION_AXIS)
+    p_local = state.num_partitions
+    p_global = p_local * num_shards
+    offset = shard * p_local
+
+    k_src = max(1, cfg.num_sources // num_shards)
+    cand, deltas, score, layout = score_round_candidates(
+        state, masks, goal, optimized, constraint, cfg, num_topics,
+        psum=_psum, k_src=k_src)
+
+    # Shared per-source reduction; the shard-dependent row offset makes
+    # different devices lean toward different destinations among ties.
+    red_idx = reduce_per_source(score, layout, row_offset=shard * k_src)
+
+    # Gather every device's reduced candidates (global partition ids) so all
+    # devices agree on one conflict-free batch.
+    def gather(x):
+        return jax.lax.all_gather(x, PARTITION_AXIS).reshape(
+            (num_shards * x.shape[0],) + x.shape[1:])
+
+    g_score = gather(score[red_idx])
+    g_part = gather(deltas.partition[red_idx] + offset)
+    g_src = gather(deltas.src_broker[red_idx])
+    g_dst = gather(deltas.dst_broker[red_idx])
+    g_slot = gather(deltas.src_slot[red_idx])
+    g_dslot = gather(cand.dst_slot[red_idx])
+    g_kind = gather(cand.kind[red_idx])
+
+    top_idx, sel = _conflict_free_top_m(g_score, g_part, g_src, g_dst,
+                                        cfg.moves_per_round, p_global,
+                                        state.num_brokers)
+
+    new_state = apply_selected(state, sel, g_part[top_idx], g_slot[top_idx],
+                               g_dst[top_idx], g_kind[top_idx],
+                               g_dslot[top_idx], row_offset=offset)
+    return new_state, sel.sum()
+
+
+@lru_cache(maxsize=256)
+def _make_sharded_round(mesh: Mesh, goal, optimized, constraint,
+                        cfg: SearchConfig, num_topics: int,
+                        mask_presence: tuple[bool, bool, bool]):
+    """Build + jit the shard_map'd round for one (mesh, goal-chain) config."""
+    num_shards = mesh.devices.size
+    state_specs = _state_specs()
+    body = partial(_round_local, goal=goal, optimized=optimized,
+                   constraint=constraint, cfg=cfg, num_topics=num_topics,
+                   num_shards=num_shards)
+    mapped = shard_map(body, mesh=mesh,
+                       in_specs=(state_specs, _mask_specs(mask_presence)),
+                       out_specs=(state_specs, P()), check_vma=False)
+    return jax.jit(mapped)
+
+
+def _mask_specs(mask_presence: tuple[bool, bool, bool]) -> ExclusionMasks:
+    return ExclusionMasks(
+        excluded_topics=P() if mask_presence[0] else None,
+        excluded_replica_move_brokers=P() if mask_presence[1] else None,
+        excluded_leadership_brokers=P() if mask_presence[2] else None)
+
+
+@lru_cache(maxsize=256)
+def _make_sharded_check(mesh: Mesh, goal, constraint,
+                        num_topics: int, mask_presence: tuple[bool, bool, bool]):
+    """Total goal violation computed UNDER the mesh (no host gather): psum'd
+    derived state + psum'd aux partials, so [T, B]-aux goals never
+    materialize on one device."""
+
+    def body(state: ClusterTensors, masks: ExclusionMasks):
+        derived = compute_derived(state, masks.excluded_topics,
+                                  masks.excluded_replica_move_brokers,
+                                  masks.excluded_leadership_brokers, psum=_psum)
+        aux = goal_aux(goal, state, derived, constraint, num_topics, psum=_psum)
+        viol = goal.broker_violations(state, derived, constraint, aux)
+        if goal.partition_additive_scores:
+            viol = _psum(viol)
+        return viol.sum()
+
+    mapped = shard_map(body, mesh=mesh, in_specs=(_state_specs(),
+                                                  _mask_specs(mask_presence)),
+                       out_specs=P(), check_vma=False)
+    return jax.jit(mapped)
+
+
+def sharded_optimize_round(state: ClusterTensors, goal, optimized,
+                           constraint: BalancingConstraint, cfg: SearchConfig,
+                           num_topics: int, masks: ExclusionMasks,
+                           mesh: Mesh) -> tuple[ClusterTensors, jax.Array]:
+    presence = (masks.excluded_topics is not None,
+                masks.excluded_replica_move_brokers is not None,
+                masks.excluded_leadership_brokers is not None)
+    fn = _make_sharded_round(mesh, goal, tuple(optimized), constraint, cfg,
+                             num_topics, presence)
+    return fn(state, masks)
+
+
+def optimize_goal_sharded(state: ClusterTensors, goal, optimized,
+                          constraint: BalancingConstraint, cfg: SearchConfig,
+                          num_topics: int, mesh: Mesh,
+                          masks: ExclusionMasks | None = None,
+                          ) -> tuple[ClusterTensors, dict]:
+    """Sharded analogue of analyzer.search.optimize_goal: loop rounds until
+    no improving action applies; host reads one scalar per round."""
+    masks = masks or ExclusionMasks()
+    opt_tuple = tuple(optimized)
+    total_applied = 0
+    rounds = 0
+    for rounds in range(1, cfg.max_rounds + 1):
+        state, applied = sharded_optimize_round(
+            state, goal, opt_tuple, constraint, cfg, num_topics, masks, mesh)
+        applied = int(applied)
+        total_applied += applied
+        if applied == 0:
+            break
+
+    # Final violation check under the mesh — no host gather.
+    presence = (masks.excluded_topics is not None,
+                masks.excluded_replica_move_brokers is not None,
+                masks.excluded_leadership_brokers is not None)
+    check = _make_sharded_check(mesh, goal, constraint, num_topics, presence)
+    total_violation = float(check(state, masks))
+    succeeded = total_violation <= 1e-6
+    if goal.is_hard and not succeeded:
+        raise OptimizationFailureError(
+            f"hard goal {goal.name} unsatisfied: residual violation "
+            f"{total_violation:.4f} after {rounds} rounds")
+    return state, {
+        "goal": goal.name, "rounds": rounds, "moves_applied": total_applied,
+        "residual_violation": total_violation, "succeeded": succeeded,
+    }

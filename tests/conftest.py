@@ -1,16 +1,19 @@
-"""Test harness: force an 8-device virtual CPU platform BEFORE jax import so
-multi-chip sharding paths (Mesh/shard_map) are exercised without TPU pods."""
+"""Test harness: force an 8-device virtual CPU platform so multi-chip
+sharding paths (Mesh/shard_map) are exercised without TPU pods.
+
+The ambient environment may pin jax to a TPU tunnel (axon) via
+sitecustomize, which overrides JAX_PLATFORMS with a config update at
+interpreter startup — so env vars alone are not enough; we must update the
+jax config again after import (but before first backend use)."""
 
 import os
 
-# Hard override: the ambient environment may pin JAX_PLATFORMS to a TPU
-# tunnel (axon) whose remote compiles take tens of seconds per jit. Tests
-# always run on the virtual multi-device CPU platform.
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402  (import after env setup)
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,214 @@
+"""Executor layer tests.
+
+Mirrors the reference's component tier (SURVEY.md §4: ExecutionTaskPlannerTest,
+ExecutionTaskManagerTest, ConcurrencyAdjusterTest, ExecutorTest against
+embedded brokers — here the embedded cluster is InMemoryAdminBackend)."""
+
+import time
+
+import pytest
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.executor import (
+    ConcurrencyCaps, ExecutionConcurrencyManager, ExecutionTask,
+    ExecutionTaskManager, ExecutionTaskPlanner, Executor, InMemoryAdminBackend,
+    OngoingExecutionError, PartitionState, TaskState, TaskType,
+    strategy_chain,
+)
+from cruise_control_tpu.executor.strategy import (
+    PrioritizeSmallReplicaMovementStrategy, PostponeUrpReplicaMovementStrategy,
+)
+
+
+def proposal(topic="t", part=0, old=(0, 1), new=(2, 1), old_leader=0, new_leader=2):
+    return ExecutionProposal(topic=topic, partition=part, old_leader=old_leader,
+                             old_replicas=tuple(old), new_replicas=tuple(new),
+                             new_leader=new_leader)
+
+
+def make_cluster(n_parts=8, brokers=(0, 1, 2, 3)):
+    parts = [PartitionState(topic="t", partition=i,
+                            replicas=(brokers[i % len(brokers)],
+                                      brokers[(i + 1) % len(brokers)]),
+                            leader=brokers[i % len(brokers)],
+                            isr=(brokers[i % len(brokers)],
+                                 brokers[(i + 1) % len(brokers)]))
+             for i in range(n_parts)]
+    return InMemoryAdminBackend(parts, steps_per_tick=3)
+
+
+# ---- task state machine ----------------------------------------------------
+
+def test_task_state_machine_legal_path():
+    t = ExecutionTask(0, proposal(), TaskType.INTER_BROKER_REPLICA_ACTION)
+    assert t.state is TaskState.PENDING
+    t.in_progress()
+    t.completed()
+    assert t.state is TaskState.COMPLETED
+
+
+def test_task_state_machine_rejects_illegal_transfer():
+    t = ExecutionTask(0, proposal(), TaskType.INTER_BROKER_REPLICA_ACTION)
+    with pytest.raises(ValueError):
+        t.completed()  # PENDING -> COMPLETED not allowed
+    t.in_progress()
+    t.abort()
+    with pytest.raises(ValueError):
+        t.completed()  # ABORTING -> COMPLETED not allowed
+    t.aborted()
+    assert t.state is TaskState.ABORTED
+
+
+def test_task_manager_expands_proposals():
+    tm = ExecutionTaskManager()
+    tasks = tm.tasks_from_proposals([
+        proposal(part=0, old=(0, 1), new=(2, 1), new_leader=2),   # move + leader
+        proposal(part=1, old=(0, 1), new=(1, 0), old_leader=0, new_leader=1),  # reorder + leader
+        proposal(part=2, old=(0, 1), new=(0, 1), old_leader=0, new_leader=0),  # no-op
+    ])
+    kinds = [(t.task_type, t.proposal.partition) for t in tasks]
+    assert (TaskType.INTER_BROKER_REPLICA_ACTION, 0) in kinds
+    assert (TaskType.LEADER_ACTION, 0) in kinds
+    assert (TaskType.INTER_BROKER_REPLICA_ACTION, 1) in kinds
+    assert all(p != 2 for _, p in kinds)
+
+
+# ---- planner ---------------------------------------------------------------
+
+def test_planner_respects_broker_headroom():
+    planner = ExecutionTaskPlanner()
+    tm = ExecutionTaskManager()
+    # Three tasks all adding to broker 9.
+    tasks = tm.tasks_from_proposals([
+        proposal(part=i, old=(0, 1), new=(9, 1), new_leader=9) for i in range(3)])
+    inter = [t for t in tasks if t.task_type is TaskType.INTER_BROKER_REPLICA_ACTION]
+    planner.add_tasks(inter, make_cluster())
+    picked = planner.inter_broker_tasks(lambda b: 2, max_total=10)
+    assert len(picked) == 2  # broker 9 headroom = 2
+    assert planner.num_pending(TaskType.INTER_BROKER_REPLICA_ACTION) == 1
+
+
+def test_strategy_orders_small_first_and_postpones_urp():
+    class Info:
+        def partition_size(self, t, p):
+            return {0: 30.0, 1: 10.0, 2: 20.0}[p]
+
+        def is_under_replicated(self, t, p):
+            return p == 1
+
+        def is_under_min_isr_with_offline(self, t, p):
+            return False
+
+    tm = ExecutionTaskManager()
+    tasks = tm.tasks_from_proposals([
+        proposal(part=p, old=(0, 1), new=(2, 1), new_leader=2) for p in range(3)])
+    inter = [t for t in tasks if t.task_type is TaskType.INTER_BROKER_REPLICA_ACTION]
+    chain = strategy_chain(["PostponeUrpReplicaMovementStrategy",
+                            "PrioritizeSmallReplicaMovementStrategy"])
+    ordered = chain.sort(inter, Info())
+    # URP partition 1 last despite being smallest; others by size.
+    assert [t.proposal.partition for t in ordered] == [2, 0, 1]
+
+
+# ---- concurrency -----------------------------------------------------------
+
+def test_concurrency_adjuster_halves_and_recovers():
+    m = ExecutionConcurrencyManager(ConcurrencyCaps(inter_broker_per_broker=8))
+    m.adjust(cluster_healthy=False, has_under_min_isr=True)
+    assert m.state()["interBrokerPerBroker"] == 4
+    m.adjust(cluster_healthy=False, has_under_min_isr=True)
+    assert m.state()["interBrokerPerBroker"] == 2
+    for _ in range(20):
+        m.adjust(cluster_healthy=True, has_under_min_isr=False)
+    assert m.state()["interBrokerPerBroker"] == 16  # 2x base ceiling
+
+
+def test_concurrency_headroom_accounting():
+    m = ExecutionConcurrencyManager(ConcurrencyCaps(inter_broker_per_broker=2,
+                                                    cluster_inter_broker=3))
+    assert m.inter_broker_headroom(5) == 2
+    m.acquire_inter_broker((5, 6))
+    assert m.inter_broker_headroom(5) == 1
+    m.acquire_inter_broker((5,))
+    assert m.inter_broker_headroom(5) == 0
+    assert m.inter_broker_headroom(7) == 1  # cluster cap 3, 2 in flight
+    m.release_inter_broker((5, 6))
+    assert m.inter_broker_headroom(5) == 1
+
+
+# ---- executor end-to-end against the fake cluster --------------------------
+
+def test_executor_executes_proposals_to_completion():
+    admin = make_cluster()
+    ex = Executor(admin, progress_check_interval_s=0.005)
+    props = [proposal(part=0, old=(0, 1), new=(2, 1), new_leader=2),
+             proposal(part=1, old=(1, 2), old_leader=1, new=(3, 2), new_leader=3)]
+    ex.execute_proposals(props, uuid="test")
+    assert ex.await_completion(20)
+    parts = admin.describe_partitions()
+    assert set(parts[("t", 0)].replicas) == {1, 2}
+    assert parts[("t", 0)].leader == 2
+    assert set(parts[("t", 1)].replicas) == {2, 3}
+    assert parts[("t", 1)].leader == 3
+    counts = ex.execution_state()["taskCounts"]
+    assert counts["inter_broker_replica_action"] == {"completed": 2}
+    assert counts["leader_action"] == {"completed": 2}
+
+
+def test_executor_rejects_concurrent_execution():
+    admin = make_cluster()
+    ex = Executor(admin, progress_check_interval_s=0.05)
+    ex.execute_proposals([proposal(part=0, old=(0, 1), new=(2, 1), new_leader=2)])
+    try:
+        with pytest.raises(OngoingExecutionError):
+            ex.execute_proposals([proposal(part=1)])
+    finally:
+        assert ex.await_completion(20)
+
+
+def test_executor_stop_aborts_pending():
+    admin = make_cluster(n_parts=8)
+    admin._steps_per_tick = 0  # nothing ever completes
+    ex = Executor(admin, ConcurrencyCaps(inter_broker_per_broker=1,
+                                         cluster_inter_broker=1),
+                  progress_check_interval_s=0.01)
+    props = [proposal(part=i, old=(0, 1), new=(2, 1), new_leader=2)
+             for i in range(0, 8, 4)]
+    ex.execute_proposals(props)
+    time.sleep(0.05)
+    ex.stop_execution()
+    assert ex.await_completion(20)
+    counts = ex.execution_state()["taskCounts"]["inter_broker_replica_action"]
+    assert counts.get("aborted", 0) >= 1
+    assert admin.list_reassigning_partitions() == []
+
+
+def test_executor_marks_dead_destination_tasks():
+    admin = make_cluster()
+    ex = Executor(admin, progress_check_interval_s=0.005, task_timeout_s=0.5)
+    admin.kill_broker(2)
+    ex.execute_proposals([proposal(part=0, old=(0, 1), new=(2, 1), new_leader=2)])
+    assert ex.await_completion(20)
+    counts = ex.execution_state()["taskCounts"]
+    assert counts["inter_broker_replica_action"].get("dead") == 1
+
+
+def test_executor_throttle_set_and_cleared():
+    admin = make_cluster()
+    ex = Executor(admin, progress_check_interval_s=0.005,
+                  replication_throttle=12345)
+    ex.execute_proposals([proposal(part=0, old=(0, 1), new=(2, 1), new_leader=2)])
+    assert ex.await_completion(20)
+    # Throttles were written then cleared (empty string = removal marker).
+    assert admin.broker_configs[2]["leader.replication.throttled.rate"] == ""
+    assert admin.topic_configs["t"]["leader.replication.throttled.replicas"] == ""
+
+
+def test_sampling_mode_toggled_around_execution():
+    admin = make_cluster()
+    flips = []
+    ex = Executor(admin, progress_check_interval_s=0.005,
+                  on_sampling_mode_change=flips.append)
+    ex.execute_proposals([proposal(part=0, old=(0, 1), new=(2, 1), new_leader=2)])
+    assert ex.await_completion(20)
+    assert flips == [True, False]

@@ -1,0 +1,104 @@
+"""Sharded (multi-device mesh) search vs single-device search.
+
+Runs on the 8-device virtual CPU platform from conftest.py. Mirrors the
+reference's approach of testing multi-node behavior in-process (SURVEY.md §4:
+embedded brokers + model-level simulation) — here the mesh IS real SPMD, just
+on virtual devices.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.derived import compute_derived
+from cruise_control_tpu.analyzer.goals import (
+    RackAwareGoal, ReplicaDistributionGoal, NetworkOutboundUsageDistributionGoal,
+    TopicReplicaDistributionGoal,
+)
+from cruise_control_tpu.analyzer.search import ExclusionMasks, SearchConfig, optimize_goal
+from cruise_control_tpu.model.fixtures import random_cluster
+from cruise_control_tpu.model.tensors import broker_load, broker_replica_counts
+from cruise_control_tpu.parallel import (
+    make_mesh, optimize_goal_sharded, shard_cluster,
+)
+
+CONSTRAINT = BalancingConstraint()
+CFG = SearchConfig(num_sources=32, num_dests=8, moves_per_round=8, max_rounds=40)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    # 16 partitions/shard × 8 shards; skewed so there is work to do.
+    return random_cluster(num_brokers=12, num_topics=6, num_partitions=128,
+                          rf=2, num_racks=4, seed=7, skew_to_first=2.0,
+                          partition_bucket=8)
+
+
+def test_shard_cluster_roundtrip(mesh, cluster):
+    state, meta = cluster
+    sharded = shard_cluster(state, mesh)
+    np.testing.assert_array_equal(np.asarray(sharded.assignment),
+                                  np.asarray(state.assignment))
+    assert sharded.assignment.sharding.spec[0] == "p"
+
+
+def test_sharded_replica_distribution_balances(mesh, cluster):
+    state, meta = cluster
+    goal = ReplicaDistributionGoal()
+    sharded = shard_cluster(state, mesh)
+    out, info = optimize_goal_sharded(sharded, goal, (), CONSTRAINT, CFG,
+                                      meta.num_topics, mesh)
+    assert info["moves_applied"] > 0
+    # Single-device reference run reaches the same satisfied end state.
+    out_ref, info_ref = optimize_goal(state, goal, (), CONSTRAINT, CFG,
+                                      meta.num_topics)
+    assert info["succeeded"] and info_ref["succeeded"]
+    counts = np.asarray(broker_replica_counts(jax.device_get(out)))
+    counts_ref = np.asarray(broker_replica_counts(out_ref))
+    assert counts.max() - counts.min() <= counts_ref.max() - counts_ref.min() + 2
+
+
+def test_sharded_respects_prior_goal_acceptance(mesh, cluster):
+    state, meta = cluster
+    rack = RackAwareGoal()
+    sharded = shard_cluster(state, mesh)
+    out, _ = optimize_goal_sharded(sharded, rack, (), CONSTRAINT, CFG,
+                                   meta.num_topics, mesh)
+    out2, _ = optimize_goal_sharded(out, ReplicaDistributionGoal(), (rack,),
+                                    CONSTRAINT, CFG, meta.num_topics, mesh)
+    # Rack-awareness must not regress after the second goal ran.
+    full = jax.device_get(out2)
+    derived = compute_derived(full)
+    viol = rack.broker_violations(full, derived, CONSTRAINT, None)
+    assert float(viol.sum()) <= 1e-6
+
+
+def test_sharded_resource_distribution_improves_balance(mesh, cluster):
+    state, meta = cluster
+    goal = NetworkOutboundUsageDistributionGoal()
+    before = np.asarray(broker_load(state))[:, 2]
+    sharded = shard_cluster(state, mesh)
+    out, info = optimize_goal_sharded(sharded, goal, (), CONSTRAINT, CFG,
+                                      meta.num_topics, mesh)
+    after = np.asarray(broker_load(jax.device_get(out)))[:, 2]
+    assert after.std() < before.std()
+
+
+def test_sharded_topic_replica_aux_psum(mesh, cluster):
+    """TopicReplicaDistributionGoal's [T, B] aux is additive across shards —
+    the psum path must reproduce the single-device optimization."""
+    state, meta = cluster
+    goal = TopicReplicaDistributionGoal()
+    sharded = shard_cluster(state, mesh)
+    out, info = optimize_goal_sharded(sharded, goal, (), CONSTRAINT, CFG,
+                                      meta.num_topics, mesh)
+    out_ref, info_ref = optimize_goal(state, goal, (), CONSTRAINT, CFG,
+                                      meta.num_topics)
+    assert info["succeeded"] == info_ref["succeeded"]
