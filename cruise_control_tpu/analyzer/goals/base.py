@@ -116,6 +116,21 @@ def gather_pair(arr: jax.Array, deltas: CandidateDeltas,
     return arr[deltas.src_broker, column], arr[deltas.dst_broker, column]
 
 
+def donor_widened_shed(values: jax.Array, lower, upper,
+                       derived: DerivedState) -> jax.Array:
+    """Per-broker shed pressure with donor widening
+    (ResourceDistributionGoal.java:388 requireMoreLoad): anything above the
+    upper band sheds; when some eligible broker sits below the lower band,
+    every broker above the LOWER band becomes a donor for move-in.
+    ``values`` is [B] (or [T, B] for per-topic bands with broadcastable
+    lower/upper); masked to alive brokers."""
+    eligible = derived.alive & derived.allowed_replica_move
+    under_any = ((values < lower) & eligible).any(axis=-1, keepdims=True)
+    over = jnp.maximum(values - upper, 0.0)
+    donor = jnp.where(under_any, jnp.maximum(values - lower, 0.0), 0.0)
+    return jnp.where(derived.alive, over + donor, 0.0)
+
+
 def new_broker_gate(derived: DerivedState, deltas: CandidateDeltas) -> jax.Array:
     """When NEW brokers exist, only they may receive replicas
     (ResourceDistributionGoal.rebalanceByMovingLoadIn:444-447)."""

@@ -22,7 +22,7 @@ from ...model.tensors import (
 )
 from ..candidates import CandidateDeltas
 from ..derived import count_limits, resource_limits
-from .base import Goal, new_broker_gate, pair_improvement
+from .base import Goal, donor_widened_shed, new_broker_gate, pair_improvement
 
 
 def _band_viol(value, lower, upper):
@@ -102,17 +102,10 @@ class ResourceDistributionGoal(Goal):
             * new_broker_gate(derived, deltas)
 
     def source_score(self, state, derived, constraint, aux):
-        # requireLessLoad brokers shed; when some broker sits below the lower
-        # band (requireMoreLoad, ResourceDistributionGoal.java:388), every
-        # broker above the lower band becomes a donor for move-in.
         r = int(self.resource)
         lower, upper, _cap = self._limits(state, derived, constraint)
-        load = derived.broker_load[:, r]
-        eligible = derived.alive & derived.allowed_replica_move
-        any_under = ((load < lower) & eligible).any()
-        over = jnp.maximum(load - upper, 0.0)
-        donor = jnp.where(any_under, jnp.maximum(load - lower, 0.0), 0.0)
-        return jnp.where(derived.alive, over + donor, 0.0)
+        return donor_widened_shed(derived.broker_load[:, r], lower, upper,
+                                  derived)
 
     def dest_score(self, state, derived, constraint, aux):
         r = int(self.resource)
@@ -179,15 +172,8 @@ class CountDistributionGoal(Goal):
             * new_broker_gate(derived, deltas)
 
     def source_score(self, state, derived, constraint, aux):
-        # Donor widening for under-lower brokers (move-in side), as in
-        # ReplicaDistributionGoal's rebalanceByMovingReplicasIn.
         lower, upper = self._limits(derived, constraint)
-        counts = self._counts(derived)
-        eligible = derived.alive & derived.allowed_replica_move
-        any_under = ((counts < lower) & eligible).any()
-        over = jnp.maximum(counts - upper, 0.0)
-        donor = jnp.where(any_under, jnp.maximum(counts - lower, 0.0), 0.0)
-        return jnp.where(derived.alive, over + donor, 0.0)
+        return donor_widened_shed(self._counts(derived), lower, upper, derived)
 
     def dest_score(self, state, derived, constraint, aux):
         lower, upper = self._limits(derived, constraint)
@@ -249,16 +235,9 @@ class TopicReplicaDistributionGoal(Goal):
             * new_broker_gate(derived, deltas)
 
     def _over_donor(self, derived, aux):
-        """[T, B] — per-(topic, broker) shed pressure: count above the upper
-        band, plus (when some eligible broker is below the topic's lower
-        band) anything above the lower band (move-in donors)."""
-        counts = aux["counts"]
-        lo = aux["lower"][:, None]
-        eligible = derived.alive & derived.allowed_replica_move
-        deficit_any = ((counts < lo) & eligible[None, :]).any(axis=1)  # [T]
-        over = jnp.maximum(counts - aux["upper"][:, None], 0.0)
-        donor = jnp.where(deficit_any[:, None], jnp.maximum(counts - lo, 0.0), 0.0)
-        return over + donor
+        """[T, B] — per-(topic, broker) shed pressure with donor widening."""
+        return donor_widened_shed(aux["counts"], aux["lower"][:, None],
+                                  aux["upper"][:, None], derived)
 
     def source_score(self, state, derived, constraint, aux):
         score = self._over_donor(derived, aux).sum(axis=0)

@@ -166,14 +166,26 @@ class InMemoryAdminBackend:
             return set(self._alive)
 
     def alter_broker_configs(self, configs) -> None:
+        # Incremental-alter semantics: value None deletes the key
+        # (AlterConfigOp.OpType.DELETE), anything else sets it.
         with self._lock:
             for broker, kv in configs.items():
-                self.broker_configs.setdefault(broker, {}).update(kv)
+                target = self.broker_configs.setdefault(broker, {})
+                for k, v in kv.items():
+                    if v is None:
+                        target.pop(k, None)
+                    else:
+                        target[k] = v
 
     def alter_topic_configs(self, configs) -> None:
         with self._lock:
             for topic, kv in configs.items():
-                self.topic_configs.setdefault(topic, {}).update(kv)
+                target = self.topic_configs.setdefault(topic, {})
+                for k, v in kv.items():
+                    if v is None:
+                        target.pop(k, None)
+                    else:
+                        target[k] = v
 
     def describe_broker_configs(self, brokers):
         with self._lock:

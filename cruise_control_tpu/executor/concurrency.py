@@ -42,7 +42,6 @@ class ExecutionConcurrencyManager:
         self._base = dataclasses.replace(self._caps)
         self._lock = threading.Lock()
         self._inter_in_flight: dict[int, int] = {}   # broker -> count
-        self._intra_in_flight: dict[int, int] = {}
         self._cluster_inter_in_flight = 0
 
     # ---- capacity queries -------------------------------------------------
@@ -62,6 +61,12 @@ class ExecutionConcurrencyManager:
 
     def leadership_cap(self) -> int:
         return self._caps.leadership_cluster
+
+    def leadership_per_broker_cap(self) -> int:
+        return self._caps.leadership_per_broker
+
+    def intra_broker_per_broker_cap(self) -> int:
+        return self._caps.intra_broker_per_broker
 
     # ---- in-flight accounting --------------------------------------------
     def acquire_inter_broker(self, brokers: tuple[int, ...]) -> None:

@@ -258,9 +258,15 @@ class Executor:
         assert self._planner is not None and self._task_manager is not None
         self._set_phase(ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS)
         tracker = self._task_manager.tracker
-        for task in self._planner.intra_broker_tasks(max_total=1 << 30):
-            tracker.transition(task, task.in_progress)
-            tracker.transition(task, task.completed)
+        while True:
+            batch = self._planner.intra_broker_tasks(
+                max_total=1 << 30,
+                per_broker_cap=self._concurrency.intra_broker_per_broker_cap())
+            if not batch:
+                break
+            for task in batch:
+                tracker.transition(task, task.in_progress)
+                tracker.transition(task, task.completed)
         return not self._stop_requested.is_set()
 
     def _leadership_phase(self) -> bool:
@@ -275,7 +281,9 @@ class Executor:
                     tracker.transition(task, task.abort)
                     tracker.transition(task, task.aborted)
                 return False
-            batch = self._planner.leadership_tasks(self._concurrency.leadership_cap())
+            batch = self._planner.leadership_tasks(
+                self._concurrency.leadership_cap(),
+                per_broker_cap=self._concurrency.leadership_per_broker_cap())
             if not batch:
                 return True
             self._admin.elect_leaders([t.topic_partition for t in batch])

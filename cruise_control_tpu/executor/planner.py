@@ -66,18 +66,41 @@ class ExecutionTaskPlanner:
             self._pending[TaskType.INTER_BROKER_REPLICA_ACTION] = remaining
         return picked
 
-    def leadership_tasks(self, max_total: int) -> list[ExecutionTask]:
-        with self._lock:
-            pool = self._pending[TaskType.LEADER_ACTION]
-            picked, rest = pool[:max_total], pool[max_total:]
-            self._pending[TaskType.LEADER_ACTION] = rest
-            return picked
+    def leadership_tasks(self, max_total: int,
+                         per_broker_cap: int | None = None) -> list[ExecutionTask]:
+        """Dequeue leadership moves, bounding how many land on any single
+        new-leader broker per batch (num.concurrent.leader.movements.per.broker)."""
+        return self._capped_dequeue(TaskType.LEADER_ACTION, max_total,
+                                    per_broker_cap,
+                                    lambda t: (t.proposal.new_leader,))
 
-    def intra_broker_tasks(self, max_total: int) -> list[ExecutionTask]:
+    def intra_broker_tasks(self, max_total: int,
+                           per_broker_cap: int | None = None) -> list[ExecutionTask]:
+        """Dequeue intra-broker (logdir) moves, capped per affected broker
+        (num.concurrent.intra.broker.partition.movements)."""
+        return self._capped_dequeue(TaskType.INTRA_BROKER_REPLICA_ACTION,
+                                    max_total, per_broker_cap,
+                                    lambda t: tuple(t.proposal.new_replicas))
+
+    def _capped_dequeue(self, task_type: TaskType, max_total: int,
+                        per_broker_cap: int | None,
+                        brokers_of) -> list[ExecutionTask]:
         with self._lock:
-            pool = self._pending[TaskType.INTRA_BROKER_REPLICA_ACTION]
-            picked, rest = pool[:max_total], pool[max_total:]
-            self._pending[TaskType.INTRA_BROKER_REPLICA_ACTION] = rest
+            picked: list[ExecutionTask] = []
+            remaining: list[ExecutionTask] = []
+            used: dict[int, int] = {}
+            for task in self._pending[task_type]:
+                brokers = brokers_of(task)
+                fits = len(picked) < max_total and (
+                    per_broker_cap is None
+                    or all(used.get(b, 0) < per_broker_cap for b in brokers))
+                if fits:
+                    for b in brokers:
+                        used[b] = used.get(b, 0) + 1
+                    picked.append(task)
+                else:
+                    remaining.append(task)
+            self._pending[task_type] = remaining
             return picked
 
     def clear(self) -> list[ExecutionTask]:

@@ -28,9 +28,10 @@ class ReplicationThrottleHelper:
         self._rate = rate_bytes_per_sec
         # broker/topic -> {key: previous value} so operator-set throttles are
         # restored on clear (ReplicationThrottleHelper.java checks existing
-        # configs before removing; "" marks a key that did not exist).
-        self._saved_broker: dict[int, dict[str, str]] = {}
-        self._saved_topic: dict[str, dict[str, str]] = {}
+        # configs before removing). None marks a key that did not exist;
+        # clear passes it through as a config DELETE.
+        self._saved_broker: dict[int, dict[str, str | None]] = {}
+        self._saved_topic: dict[str, dict[str, str | None]] = {}
 
     def set_throttles(self, tasks: Iterable[ExecutionTask]) -> None:
         if self._rate is None:
@@ -44,7 +45,7 @@ class ReplicationThrottleHelper:
         if new_brokers:
             existing = self._admin.describe_broker_configs(new_brokers)
             for b in new_brokers:
-                self._saved_broker[b] = {k: existing.get(b, {}).get(k, "")
+                self._saved_broker[b] = {k: existing.get(b, {}).get(k)
                                          for k in (LEADER_RATE, FOLLOWER_RATE)}
             self._admin.alter_broker_configs({
                 b: {LEADER_RATE: str(self._rate), FOLLOWER_RATE: str(self._rate)}
@@ -53,7 +54,7 @@ class ReplicationThrottleHelper:
         if new_topics:
             existing_t = self._admin.describe_topic_configs(new_topics)
             for t in new_topics:
-                self._saved_topic[t] = {k: existing_t.get(t, {}).get(k, "")
+                self._saved_topic[t] = {k: existing_t.get(t, {}).get(k)
                                         for k in (LEADER_REPLICAS, FOLLOWER_REPLICAS)}
             self._admin.alter_topic_configs({
                 t: {LEADER_REPLICAS: WILDCARD, FOLLOWER_REPLICAS: WILDCARD}

@@ -161,3 +161,70 @@ def derive_follower_load(leader_load_row: np.ndarray,
     out[int(Resource.NW_OUT)] = 0.0
     out[int(Resource.CPU)] = leader_load_row[int(Resource.CPU)] * follower_cpu_fraction
     return out
+
+
+def build_cluster_from_arrays(brokers: Sequence[BrokerSpec],
+                              part_names: Sequence[tuple[str, int]],
+                              replicas: Sequence[Sequence[int]],
+                              leader_indices: np.ndarray,
+                              leader_load: np.ndarray,
+                              follower_load: np.ndarray,
+                              partition_bucket: int = 0,
+                              broker_bucket: int = 0,
+                              ) -> tuple[ClusterTensors, ClusterMeta]:
+    """Bulk freeze path: per-partition loads arrive as [P, R] matrices
+    (LoadMonitor's vectorized window reduction) instead of per-partition
+    dicts. ``replicas`` holds broker IDS; rows must be sorted by
+    (topic, partition) already."""
+    import jax.numpy as jnp
+
+    brokers = sorted(brokers, key=lambda b: b.broker_id)
+    broker_ids = [b.broker_id for b in brokers]
+    broker_index = {bid: i for i, bid in enumerate(broker_ids)}
+    racks = sorted({b.rack for b in brokers})
+    rack_index = {r: i for i, r in enumerate(racks)}
+    topics = sorted({t for t, _p in part_names})
+    topic_index = {t: i for i, t in enumerate(topics)}
+
+    n = len(part_names)
+    n_p = _pad_up(n, partition_bucket)
+    n_b = _pad_up(len(brokers), broker_bucket)
+    max_rf = max((len(r) for r in replicas), default=1)
+
+    assignment = np.full((n_p, max_rf), -1, dtype=np.int32)
+    for i, reps in enumerate(replicas):
+        for s, bid in enumerate(reps):
+            assignment[i, s] = broker_index[bid]
+    leader_slot = np.full((n_p,), -1, dtype=np.int32)
+    leader_slot[:n] = np.asarray(leader_indices, dtype=np.int32)
+    ll = np.zeros((n_p, NUM_RESOURCES), dtype=np.float32)
+    fl = np.zeros((n_p, NUM_RESOURCES), dtype=np.float32)
+    ll[:n] = leader_load
+    fl[:n] = follower_load
+    topic_arr = np.zeros((n_p,), dtype=np.int32)
+    topic_arr[:n] = [topic_index[t] for t, _p in part_names]
+    partition_mask = np.zeros((n_p,), dtype=bool)
+    partition_mask[:n] = True
+
+    capacity = np.zeros((n_b, NUM_RESOURCES), dtype=np.float32)
+    rack_arr = np.zeros((n_b,), dtype=np.int32)
+    broker_state = np.full((n_b,), int(BrokerState.DEAD), dtype=np.int8)
+    broker_mask = np.zeros((n_b,), dtype=bool)
+    for i, b in enumerate(brokers):
+        for r, v in b.capacity.items():
+            capacity[i, int(r)] = v
+        rack_arr[i] = rack_index[b.rack]
+        broker_state[i] = int(b.state)
+        broker_mask[i] = True
+
+    state = ClusterTensors(
+        assignment=jnp.asarray(assignment), leader_slot=jnp.asarray(leader_slot),
+        leader_load=jnp.asarray(ll), follower_load=jnp.asarray(fl),
+        capacity=jnp.asarray(capacity), rack=jnp.asarray(rack_arr),
+        broker_state=jnp.asarray(broker_state), topic=jnp.asarray(topic_arr),
+        partition_mask=jnp.asarray(partition_mask),
+        broker_mask=jnp.asarray(broker_mask))
+    meta = ClusterMeta(broker_ids=broker_ids, topic_names=topics,
+                       rack_names=racks, num_topics=len(topics),
+                       partition_index=list(part_names))
+    return state, meta

@@ -1,0 +1,202 @@
+"""CPU estimation: partition CPU load from broker CPU + traffic shares.
+
+Reference parity: model/ModelUtils.java (estimateLeaderCpuUtilPerCore:96,
+getFollowerCpuUtilFromLeaderLoad:64), model/ModelParameters.java (static
+coefficients, defaults 0.7/0.15/0.15), and
+model/LinearRegressionModelParameters.java (optional trained linear model
+fed by the TRAIN endpoint, updateModelCoefficient:70).
+
+Redesign notes: the reference estimates per-partition CPU one call at a
+time inside the sample processor; here the estimator is vectorized over
+whole partition arrays (the processor hands us columns, we hand back a
+column), and the trained model is an ordinary least-squares solve on a
+bucketed observation matrix (diversity bucketing by CPU percentile mirrors
+the reference's CPU_UTIL bucket histogram used to gate training
+completeness).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import numpy as np
+
+# Reference: ModelUtils.java:44-45.
+ALLOWED_METRIC_ERROR_FACTOR = 1.05
+UNSTABLE_METRIC_THROUGHPUT_THRESHOLD = 10.0
+
+
+@dataclasses.dataclass(frozen=True)
+class CpuModelCoefficients:
+    """Static CPU attribution weights (ModelParameters.java:23-31)."""
+
+    leader_bytes_in: float = 0.7
+    leader_bytes_out: float = 0.15
+    follower_bytes_in: float = 0.15
+
+
+def estimate_leader_cpu_util(broker_cpu_util: np.ndarray,
+                             broker_leader_bytes_in: np.ndarray,
+                             broker_leader_bytes_out: np.ndarray,
+                             broker_follower_bytes_in: np.ndarray,
+                             partition_bytes_in: np.ndarray,
+                             partition_bytes_out: np.ndarray,
+                             coef: CpuModelCoefficients = CpuModelCoefficients(),
+                             ) -> np.ndarray:
+    """Vectorized ModelUtils.estimateLeaderCpuUtilPerCore.
+
+    All broker_* inputs are per-partition columns (already gathered to the
+    leader broker of each partition). Returns per-partition leader CPU util
+    in [0, 1]; NaN marks the reference's ``null`` (inconsistent byte rates)
+    so callers can drop/extrapolate those samples.
+    """
+    bli = np.asarray(broker_leader_bytes_in, dtype=np.float64)
+    blo = np.asarray(broker_leader_bytes_out, dtype=np.float64)
+    bfi = np.asarray(broker_follower_bytes_in, dtype=np.float64)
+    pin = np.asarray(partition_bytes_in, dtype=np.float64)
+    pout = np.asarray(partition_bytes_out, dtype=np.float64)
+    cpu = np.asarray(broker_cpu_util, dtype=np.float64)
+
+    zero_broker = (bli == 0) & (blo == 0)
+    bad_in = (bli * ALLOWED_METRIC_ERROR_FACTOR < pin) & \
+        (bli > UNSTABLE_METRIC_THROUGHPUT_THRESHOLD)
+    bad_out = (blo * ALLOWED_METRIC_ERROR_FACTOR < pout) & \
+        (blo > UNSTABLE_METRIC_THROUGHPUT_THRESHOLD)
+
+    lead_in = coef.leader_bytes_in * bli
+    lead_out = coef.leader_bytes_out * blo
+    foll_in = coef.follower_bytes_in * bfi
+    total = lead_in + lead_out + foll_in
+    safe_total = np.where(total > 0, total, 1.0)
+    # Partition's share of each contribution (clip partition rates to broker
+    # rates — the reference tolerates up to 5% measurement error).
+    share_in = np.where(bli > 0, np.minimum(pin, bli) / np.where(bli > 0, bli, 1.0), 0.0)
+    share_out = np.where(blo > 0, np.minimum(pout, blo) / np.where(blo > 0, blo, 1.0), 0.0)
+    est = cpu * (lead_in * share_in + lead_out * share_out) / safe_total
+    est = np.where(zero_broker, 0.0, est)
+    return np.where(bad_in | bad_out, np.nan, est)
+
+
+def follower_cpu_util_from_leader_load(leader_bytes_in: np.ndarray,
+                                       leader_bytes_out: np.ndarray,
+                                       leader_cpu_util: np.ndarray,
+                                       coef: CpuModelCoefficients = CpuModelCoefficients(),
+                                       ) -> np.ndarray:
+    """Vectorized ModelUtils.getFollowerCpuUtilFromLeaderLoad:64."""
+    lin = np.asarray(leader_bytes_in, dtype=np.float64)
+    lout = np.asarray(leader_bytes_out, dtype=np.float64)
+    cpu = np.asarray(leader_cpu_util, dtype=np.float64)
+    denom = coef.leader_bytes_in * lin + coef.leader_bytes_out * lout
+    out = np.where(denom > 0, cpu * (coef.follower_bytes_in * lin) /
+                   np.where(denom > 0, denom, 1.0), 0.0)
+    return out
+
+
+class LinearRegressionCpuModel:
+    """Trained alternative (LinearRegressionModelParameters.java).
+
+    Observations are (leader_bytes_in, leader_bytes_out, follower_bytes_in)
+    → broker CPU util rows collected by the TRAIN flow. To avoid a fit
+    dominated by the steady-state operating point, observations are spread
+    across ``num_buckets`` CPU-utilization buckets with a per-bucket cap
+    (the reference keeps a CPU-bucket histogram and reports training
+    completeness as the fraction of buckets observed).
+    """
+
+    NUM_FEATURES = 3
+
+    def __init__(self, num_buckets: int = 20, max_per_bucket: int = 500,
+                 min_completeness: float = 0.5):
+        self._num_buckets = num_buckets
+        self._max_per_bucket = max_per_bucket
+        self._min_completeness = min_completeness
+        self._buckets: list[list[np.ndarray]] = [[] for _ in range(num_buckets)]
+        self._coef: np.ndarray | None = None
+        self._lock = threading.Lock()
+
+    def add_observations(self, cpu_util: np.ndarray, leader_bytes_in: np.ndarray,
+                         leader_bytes_out: np.ndarray,
+                         follower_bytes_in: np.ndarray) -> None:
+        cpu = np.clip(np.asarray(cpu_util, dtype=np.float64), 0.0, 1.0)
+        rows = np.stack([np.asarray(leader_bytes_in, np.float64),
+                         np.asarray(leader_bytes_out, np.float64),
+                         np.asarray(follower_bytes_in, np.float64),
+                         cpu], axis=-1).reshape(-1, 4)
+        idx = np.minimum((cpu.reshape(-1) * self._num_buckets).astype(int),
+                         self._num_buckets - 1)
+        with self._lock:
+            for b in range(self._num_buckets):
+                take = rows[idx == b]
+                room = self._max_per_bucket - len(self._buckets[b])
+                if room > 0 and len(take):
+                    self._buckets[b].extend(take[:room])
+
+    @property
+    def training_completeness(self) -> float:
+        with self._lock:
+            return sum(1 for b in self._buckets if b) / self._num_buckets
+
+    @property
+    def trained(self) -> bool:
+        return self._coef is not None
+
+    @property
+    def coefficients(self) -> np.ndarray | None:
+        return None if self._coef is None else self._coef.copy()
+
+    def train(self) -> bool:
+        """Least-squares fit; returns False when bucket diversity is below
+        the completeness threshold (LinearRegressionModelParameters:
+        training stays incomplete until enough CPU buckets are seen)."""
+        with self._lock:
+            if self.training_completeness_locked() < self._min_completeness:
+                return False
+            rows = np.concatenate([np.stack(b) for b in self._buckets if b])
+        x, y = rows[:, :3], rows[:, 3]
+        coef, *_ = np.linalg.lstsq(x, y, rcond=None)
+        self._coef = np.maximum(coef, 0.0)
+        return True
+
+    def training_completeness_locked(self) -> float:
+        return sum(1 for b in self._buckets if b) / self._num_buckets
+
+    def estimate_leader_cpu_util(self, partition_bytes_in: np.ndarray,
+                                 partition_bytes_out: np.ndarray) -> np.ndarray:
+        """LinearRegressionModelParameters-based per-partition estimate."""
+        if self._coef is None:
+            raise RuntimeError("linear regression CPU model is not trained")
+        pin = np.asarray(partition_bytes_in, np.float64)
+        pout = np.asarray(partition_bytes_out, np.float64)
+        return self._coef[0] * pin + self._coef[1] * pout
+
+
+@dataclasses.dataclass
+class CpuEstimator:
+    """Facade selecting static-coefficient vs trained model
+    (ModelUtils.init + useLinearRegressionModel flag)."""
+
+    coef: CpuModelCoefficients = dataclasses.field(default_factory=CpuModelCoefficients)
+    linear_model: LinearRegressionCpuModel | None = None
+    use_linear_regression: bool = False
+
+    def leader_cpu(self, broker_cpu_util, broker_leader_bytes_in,
+                   broker_leader_bytes_out, broker_follower_bytes_in,
+                   partition_bytes_in, partition_bytes_out) -> np.ndarray:
+        if self.use_linear_regression and self.linear_model is not None \
+                and self.linear_model.trained:
+            return self.linear_model.estimate_leader_cpu_util(
+                partition_bytes_in, partition_bytes_out)
+        return estimate_leader_cpu_util(
+            broker_cpu_util, broker_leader_bytes_in, broker_leader_bytes_out,
+            broker_follower_bytes_in, partition_bytes_in, partition_bytes_out,
+            self.coef)
+
+    def follower_cpu(self, leader_bytes_in, leader_bytes_out,
+                     leader_cpu_util) -> np.ndarray:
+        if self.use_linear_regression and self.linear_model is not None \
+                and self.linear_model.trained:
+            fb = self.linear_model.coefficients[2]
+            return fb * np.asarray(leader_bytes_in, np.float64)
+        return follower_cpu_util_from_leader_load(
+            leader_bytes_in, leader_bytes_out, leader_cpu_util, self.coef)

@@ -1,0 +1,20 @@
+"""Monitor layer: metric ingestion, windowed aggregation, model generation.
+
+Reference parity: monitor/ (LoadMonitor, LoadMonitorTaskRunner, sampling/,
+metricdefinition/ lives in ..metricdef).
+"""
+
+from .capacity import (
+    BrokerCapacityConfigResolver, FileCapacityResolver, StaticCapacityResolver,
+)
+from .load_monitor import (
+    LoadMonitor, LoadMonitorState, ModelCompletenessRequirements,
+)
+from .task_runner import LoadMonitorTaskRunner, RunnerState, SamplingMode
+
+__all__ = [
+    "BrokerCapacityConfigResolver", "FileCapacityResolver", "LoadMonitor",
+    "LoadMonitorState", "LoadMonitorTaskRunner",
+    "ModelCompletenessRequirements", "RunnerState", "SamplingMode",
+    "StaticCapacityResolver",
+]

@@ -1,0 +1,88 @@
+"""Broker capacity resolution.
+
+Reference parity: config/BrokerCapacityConfigFileResolver (reads
+capacity.json / capacityJBOD.json / capacityCores.json) behind the
+BrokerCapacityConfigResolver SPI. Capacity units match the reference: DISK
+in MB, CPU in percent (0-100, cores×100 in the cores format), NW_IN/NW_OUT
+in KB/s. Broker id -1 is the default capacity applied to brokers without an
+explicit entry.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Mapping, Protocol
+
+from ..common.resources import Resource
+
+DEFAULT_BROKER_ID = -1
+DEFAULT_CAPACITY = {Resource.CPU: 100.0, Resource.NW_IN: 10_000.0,
+                    Resource.NW_OUT: 10_000.0, Resource.DISK: 500_000.0}
+
+
+class BrokerCapacityConfigResolver(Protocol):
+    def capacity_for(self, broker_id: int) -> dict[Resource, float]: ...
+
+    def disk_capacity_by_logdir(self, broker_id: int) -> dict[str, float] | None: ...
+
+
+class StaticCapacityResolver:
+    """Fixed capacities from a mapping (tests / synthetic clusters)."""
+
+    def __init__(self, by_broker: Mapping[int, Mapping[Resource, float]],
+                 default: Mapping[Resource, float] | None = None):
+        self._by_broker = {b: dict(c) for b, c in by_broker.items()}
+        self._default = dict(default or DEFAULT_CAPACITY)
+
+    def capacity_for(self, broker_id: int) -> dict[Resource, float]:
+        return dict(self._by_broker.get(broker_id, self._default))
+
+    def disk_capacity_by_logdir(self, broker_id: int):
+        return None
+
+
+class FileCapacityResolver:
+    """capacity.json formats:
+
+    {"brokerCapacities": [{"brokerId": "-1"|"0"...,
+       "capacity": {"DISK": "100000"            # flat MB, or
+                    "DISK": {"/dir1": "50000", "/dir2": "50000"},  # JBOD
+                    "CPU": "100" | {"num.cores": "8"},
+                    "NW_IN": "10000", "NW_OUT": "10000"}}]}
+    """
+
+    def __init__(self, path: str):
+        with open(path) as f:
+            doc = json.load(f)
+        self._caps: dict[int, dict[Resource, float]] = {}
+        self._logdirs: dict[int, dict[str, float]] = {}
+        for entry in doc.get("brokerCapacities", []):
+            bid = int(entry["brokerId"])
+            cap = entry.get("capacity", {})
+            out: dict[Resource, float] = {}
+            disk = cap.get("DISK", DEFAULT_CAPACITY[Resource.DISK])
+            if isinstance(disk, dict):
+                dirs = {d: float(v) for d, v in disk.items()}
+                self._logdirs[bid] = dirs
+                out[Resource.DISK] = sum(dirs.values())
+            else:
+                out[Resource.DISK] = float(disk)
+            cpu = cap.get("CPU", DEFAULT_CAPACITY[Resource.CPU])
+            if isinstance(cpu, dict):  # capacityCores.json format
+                out[Resource.CPU] = float(cpu.get("num.cores", 1)) * 100.0
+            else:
+                out[Resource.CPU] = float(cpu)
+            out[Resource.NW_IN] = float(cap.get("NW_IN", DEFAULT_CAPACITY[Resource.NW_IN]))
+            out[Resource.NW_OUT] = float(cap.get("NW_OUT", DEFAULT_CAPACITY[Resource.NW_OUT]))
+            self._caps[bid] = out
+
+    def capacity_for(self, broker_id: int) -> dict[Resource, float]:
+        if broker_id in self._caps:
+            return dict(self._caps[broker_id])
+        if DEFAULT_BROKER_ID in self._caps:
+            return dict(self._caps[DEFAULT_BROKER_ID])
+        return dict(DEFAULT_CAPACITY)
+
+    def disk_capacity_by_logdir(self, broker_id: int):
+        dirs = self._logdirs.get(broker_id, self._logdirs.get(DEFAULT_BROKER_ID))
+        return dict(dirs) if dirs else None

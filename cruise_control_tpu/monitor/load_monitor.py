@@ -1,0 +1,264 @@
+"""LoadMonitor: aggregated windows + metadata → device-resident ClusterTensors.
+
+Reference parity: monitor/LoadMonitor.java (startUp:211,
+clusterModel:437-541, acquireForModelGeneration semaphore :93,169,
+pause/resumeMetricSampling), MonitorUtils.populatePartitionLoad:415,
+ModelCompletenessRequirements.java, LoadMonitorState.java.
+
+Redesign: the cluster model is not a mutable object graph guarded by a
+semaphore pool — it is a frozen pytree built in one vectorized pass from
+the aggregation matrices ([E, M, W] → per-partition resource rows) and
+shipped to device once per generation. The semaphore survives only as a
+bound on concurrent *builds* (each build is CPU+HBM work).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Mapping
+
+import numpy as np
+
+from ..common.broker_state import BrokerState
+from ..common.resources import Resource
+from ..config.cruise_control_config import CruiseControlConfig
+from ..executor.admin import AdminBackend, PartitionState
+from ..metricdef.kafka_metric_def import CommonMetric as CM, KafkaMetricDef
+from ..metricdef.metricdef import ValueComputingStrategy as S
+from ..model.builder import BrokerSpec, build_cluster_from_arrays
+from ..model.cpu_estimation import CpuEstimator
+from ..model.tensors import ClusterMeta, ClusterTensors
+from .aggregator.aggregator import (
+    AggregationOptions, AggregationResult, Granularity, MetricSampleAggregator,
+    NotEnoughValidWindowsError,
+)
+from .capacity import BrokerCapacityConfigResolver, StaticCapacityResolver
+from .sampling.fetcher import MetricFetcherManager
+from .sampling.sampler import MetricSampler, now_ms
+from .sampling.sample_store import NoopSampleStore, SampleStore
+from .task_runner import LoadMonitorTaskRunner, SamplingMode
+
+LOG = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelCompletenessRequirements:
+    """ModelCompletenessRequirements.java: gates model generation."""
+
+    min_valid_windows: int = 1
+    min_monitored_partitions_percentage: float = 0.95
+    include_all_topics: bool = False
+
+    def weaker(self) -> "ModelCompletenessRequirements":
+        return ModelCompletenessRequirements(1, 0.0, self.include_all_topics)
+
+
+@dataclasses.dataclass
+class LoadMonitorState:
+    runner_state: str
+    num_valid_windows: int
+    monitored_partitions_percentage: float
+    total_num_partitions: int
+    num_partition_samples: int
+    model_generation: int
+
+
+class ModelGenerationSemaphore:
+    """acquireForModelGeneration (LoadMonitor.java:93): bound concurrent
+    cluster-model builds."""
+
+    def __init__(self, permits: int = 2):
+        self._sem = threading.Semaphore(permits)
+
+    def __enter__(self):
+        self._sem.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._sem.release()
+        return False
+
+
+class LoadMonitor:
+    def __init__(self, config: CruiseControlConfig, metadata: AdminBackend,
+                 samplers: list[MetricSampler] | None = None,
+                 sample_store: SampleStore | None = None,
+                 capacity_resolver: BrokerCapacityConfigResolver | None = None,
+                 broker_racks: Mapping[int, str] | None = None,
+                 cpu_estimator: CpuEstimator | None = None,
+                 partition_bucket: int = 0):
+        self._config = config
+        self._metadata = metadata
+        self._capacity = capacity_resolver or StaticCapacityResolver({})
+        self._broker_racks = dict(broker_racks or {})
+        self._cpu = cpu_estimator or CpuEstimator()
+        self._partition_bucket = partition_bucket
+
+        self._partition_agg = MetricSampleAggregator(
+            num_windows=config.get("num.partition.metrics.windows"),
+            window_ms=config.get("partition.metrics.window.ms"),
+            min_samples_per_window=config.get("min.samples.per.partition.metrics.window"),
+            metric_def=KafkaMetricDef.common_metric_def(),
+            group_fn=lambda e: e.group)
+        self._broker_agg = MetricSampleAggregator(
+            num_windows=config.get("num.broker.metrics.windows"),
+            window_ms=config.get("broker.metrics.window.ms"),
+            min_samples_per_window=config.get("min.samples.per.broker.metrics.window"),
+            metric_def=KafkaMetricDef.broker_metric_def())
+
+        store = sample_store or NoopSampleStore()
+        if samplers is None:
+            from .sampling.sampler import NoopSampler
+            samplers = [NoopSampler()]
+        self._fetcher = MetricFetcherManager(samplers, self._partition_agg,
+                                             self._broker_agg, store)
+        self._task_runner = LoadMonitorTaskRunner(
+            self._fetcher, self._metadata, store,
+            sampling_interval_ms=config.get("metric.sampling.interval.ms"))
+        self._model_semaphore = ModelGenerationSemaphore()
+
+    # -- lifecycle --------------------------------------------------------
+    def start_up(self, block_on_load: bool = True) -> None:
+        self._task_runner.start(block_on_load=block_on_load)
+
+    def shutdown(self) -> None:
+        self._task_runner.shutdown()
+        self._fetcher.shutdown()
+
+    def pause_metric_sampling(self, reason: str = "") -> None:
+        self._task_runner.set_mode(SamplingMode.PAUSED, reason)
+
+    def resume_metric_sampling(self, reason: str = "") -> None:
+        self._task_runner.set_mode(SamplingMode.RUNNING, reason)
+
+    def bootstrap(self, start_ms: int, end_ms: int, clear_metrics: bool = True) -> None:
+        self._task_runner.bootstrap(start_ms, end_ms, clear_metrics)
+
+    @property
+    def task_runner(self) -> LoadMonitorTaskRunner:
+        return self._task_runner
+
+    @property
+    def partition_aggregator(self) -> MetricSampleAggregator:
+        return self._partition_agg
+
+    @property
+    def broker_aggregator(self) -> MetricSampleAggregator:
+        return self._broker_agg
+
+    @property
+    def model_generation(self) -> int:
+        return self._partition_agg.generation
+
+    def acquire_for_model_generation(self) -> ModelGenerationSemaphore:
+        return self._model_semaphore
+
+    # -- state ------------------------------------------------------------
+    def state(self) -> LoadMonitorState:
+        partitions = self._metadata.describe_partitions()
+        opts = self._aggregation_options(ModelCompletenessRequirements(1, 0.0))
+        try:
+            completeness = self._partition_agg.completeness(opts)
+            valid_windows = len(completeness.valid_windows)
+            ratio = completeness.valid_entity_ratio
+        except Exception:
+            valid_windows, ratio = 0, 0.0
+        return LoadMonitorState(
+            runner_state=self._task_runner.state_name,
+            num_valid_windows=valid_windows,
+            monitored_partitions_percentage=ratio,
+            total_num_partitions=len(partitions),
+            num_partition_samples=self._partition_agg.num_samples(),
+            model_generation=self.model_generation)
+
+    # -- model building ----------------------------------------------------
+    def _aggregation_options(self, req: ModelCompletenessRequirements,
+                             ) -> AggregationOptions:
+        return AggregationOptions(
+            min_valid_entity_ratio=req.min_monitored_partitions_percentage,
+            min_valid_windows=req.min_valid_windows,
+            max_allowed_extrapolations_per_entity=self._config.get(
+                "max.allowed.extrapolations.per.partition"),
+            granularity=(Granularity.ENTITY_GROUP if req.include_all_topics
+                         else Granularity.ENTITY),
+            include_invalid_entities=False)
+
+    def cluster_model(self, requirements: ModelCompletenessRequirements | None = None,
+                      ) -> tuple[ClusterTensors, ClusterMeta]:
+        """LoadMonitor.clusterModel:489 — aggregate valid windows, resolve
+        capacities, populate per-partition loads, freeze to tensors."""
+        req = requirements or ModelCompletenessRequirements(
+            min_valid_windows=1,
+            min_monitored_partitions_percentage=self._config.get(
+                "min.valid.partition.ratio"))
+        with self._model_semaphore:
+            partitions = self._metadata.describe_partitions()
+            alive = self._metadata.alive_brokers()
+            agg = self._partition_agg.aggregate(self._aggregation_options(req))
+            return self._build(partitions, alive, agg)
+
+    def _build(self, partitions: Mapping[tuple[str, int], PartitionState],
+               alive: set[int], agg: AggregationResult,
+               ) -> tuple[ClusterTensors, ClusterMeta]:
+        # Window reduction per metric strategy (Load.expectedUtilizationFor:
+        # AVG over windows for rates, LATEST window for disk usage).
+        mdef = KafkaMetricDef.common_metric_def()
+        vals = agg.values  # [E, M, W]
+        if vals.shape[2] == 0:
+            raise NotEnoughValidWindowsError("no valid windows for model generation")
+        reduced = np.empty(vals.shape[:2], dtype=np.float64)  # [E, M]
+        for info in mdef.all():
+            col = vals[:, info.id, :]
+            if info.strategy is S.LATEST:
+                reduced[:, info.id] = col[:, -1]
+            elif info.strategy is S.MAX:
+                reduced[:, info.id] = col.max(axis=1)
+            else:
+                reduced[:, info.id] = col.mean(axis=1)
+        row_of = {e: i for i, e in enumerate(agg.entities)}
+
+        all_brokers = sorted({b for st in partitions.values() for b in st.replicas}
+                             | alive)
+        brokers = [BrokerSpec(
+            bid, rack=self._broker_racks.get(bid, str(bid)),
+            capacity=self._capacity.capacity_for(bid),
+            state=(BrokerState.ALIVE if bid in alive else BrokerState.DEAD))
+            for bid in all_brokers]
+
+        # Vectorized load assembly: one gather from the reduced [E, M]
+        # matrix into [P, R] rows; entities with no valid aggregation
+        # contribute zero load (the reference drops them from the model;
+        # keeping them with zero load preserves placement for hard goals).
+        from .sampling.samples import PartitionEntity
+        ordered = sorted(partitions.items())
+        part_names = [tp for tp, _st in ordered]
+        states = [st for _tp, st in ordered]
+        rows = np.array([row_of.get(PartitionEntity(t, p), -1)
+                         for t, p in part_names], dtype=np.int64)
+        valid = (rows >= 0)
+        valid[valid] &= agg.entity_valid[rows[valid]]
+
+        metric_cols = [KafkaMetricDef.common_metric_id(m) for m in
+                       (CM.CPU_USAGE, CM.LEADER_BYTES_IN, CM.LEADER_BYTES_OUT,
+                        CM.DISK_USAGE)]
+        res_cols = [int(Resource.CPU), int(Resource.NW_IN),
+                    int(Resource.NW_OUT), int(Resource.DISK)]
+        leader_load = np.zeros((len(ordered), len(Resource)), dtype=np.float32)
+        leader_load[np.ix_(valid, res_cols)] = reduced[rows[valid]][:, metric_cols]
+
+        follower_load = leader_load.copy()
+        follower_load[:, int(Resource.NW_OUT)] = 0.0
+        follower_load[:, int(Resource.CPU)] = self._cpu.follower_cpu(
+            leader_load[:, int(Resource.NW_IN)],
+            leader_load[:, int(Resource.NW_OUT)],
+            leader_load[:, int(Resource.CPU)])
+
+        leader_indices = np.array(
+            [st.replicas.index(st.leader) if st.leader in st.replicas else -1
+             for st in states], dtype=np.int32)
+        return build_cluster_from_arrays(
+            brokers, part_names, [st.replicas for st in states],
+            leader_indices, leader_load, follower_load,
+            partition_bucket=self._partition_bucket)

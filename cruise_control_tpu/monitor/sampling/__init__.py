@@ -1,0 +1,28 @@
+"""Sampling subsystem: samplers, processor, sample store, fetch fan-out.
+
+Reference parity: monitor/sampling/ (MetricSampler SPI, SampleStore SPI,
+MetricFetcherManager, CruiseControlMetricsProcessor + holder/).
+"""
+
+from .fetcher import MetricFetcherManager, default_partition_assignor
+from .holder import BrokerLoad, group_by_broker
+from .processor import CruiseControlMetricsProcessor, ProcessorResult
+from .sample_store import FileSampleStore, NoopSampleStore, SampleStore
+from .sampler import (
+    CruiseControlMetricsReporterSampler, InMemoryMetricsTransport,
+    MetricSampler, NoopSampler, PrometheusMetricSampler, SamplerResult,
+    SyntheticSampler,
+)
+from .samples import (
+    BrokerEntity, BrokerMetricSample, PartitionEntity, PartitionMetricSample,
+)
+
+__all__ = [
+    "BrokerEntity", "BrokerLoad", "BrokerMetricSample",
+    "CruiseControlMetricsProcessor", "CruiseControlMetricsReporterSampler",
+    "FileSampleStore", "InMemoryMetricsTransport", "MetricFetcherManager",
+    "MetricSampler", "NoopSampleStore", "NoopSampler", "PartitionEntity",
+    "PartitionMetricSample", "PrometheusMetricSampler", "ProcessorResult",
+    "SampleStore", "SamplerResult", "SyntheticSampler",
+    "default_partition_assignor", "group_by_broker",
+]

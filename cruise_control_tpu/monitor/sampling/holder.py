@@ -1,0 +1,79 @@
+"""Per-broker raw-metric accumulation for one sampling interval.
+
+Reference parity: monitor/sampling/holder/BrokerLoad.java (328) — collects
+the broker/topic/partition raw metrics reported by each broker between two
+sampling points and answers the derived questions the processor asks
+(leader bytes in/out, replication bytes in, CPU util, per-topic rates).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+
+from ...metricdef.raw_metric_type import MetricScope, RawMetricType
+from ...reporter.metrics import CruiseControlMetric
+
+R = RawMetricType
+
+
+@dataclasses.dataclass
+class BrokerLoad:
+    broker_id: int
+    broker_metrics: dict[RawMetricType, list[float]] = dataclasses.field(
+        default_factory=lambda: defaultdict(list))
+    topic_metrics: dict[tuple[str, RawMetricType], list[float]] = dataclasses.field(
+        default_factory=lambda: defaultdict(list))
+    partition_sizes: dict[tuple[str, int], float] = dataclasses.field(
+        default_factory=dict)
+
+    def record(self, m: CruiseControlMetric) -> None:
+        if m.scope is MetricScope.BROKER:
+            self.broker_metrics[m.raw_type].append(m.value)
+        elif m.scope is MetricScope.TOPIC:
+            self.topic_metrics[(m.topic, m.raw_type)].append(m.value)
+        else:  # PARTITION_SIZE is the only partition-scope metric
+            self.partition_sizes[(m.topic, m.partition)] = m.value
+
+    # -- derived views ----------------------------------------------------
+    def broker_metric(self, raw: RawMetricType, default: float = 0.0) -> float:
+        vals = self.broker_metrics.get(raw)
+        return sum(vals) / len(vals) if vals else default
+
+    def has_broker_metric(self, raw: RawMetricType) -> bool:
+        return bool(self.broker_metrics.get(raw))
+
+    def topic_metric(self, topic: str, raw: RawMetricType,
+                     default: float = 0.0) -> float:
+        vals = self.topic_metrics.get((topic, raw))
+        return sum(vals) / len(vals) if vals else default
+
+    @property
+    def cpu_util(self) -> float:
+        return self.broker_metric(R.BROKER_CPU_UTIL)
+
+    @property
+    def leader_bytes_in(self) -> float:
+        return self.broker_metric(R.ALL_TOPIC_BYTES_IN)
+
+    @property
+    def leader_bytes_out(self) -> float:
+        return self.broker_metric(R.ALL_TOPIC_BYTES_OUT)
+
+    @property
+    def follower_bytes_in(self) -> float:
+        return self.broker_metric(R.ALL_TOPIC_REPLICATION_BYTES_IN)
+
+    def topics(self) -> set[str]:
+        return ({t for (t, _raw) in self.topic_metrics}
+                | {t for (t, _p) in self.partition_sizes})
+
+    def partition_size(self, topic: str, partition: int) -> float:
+        return self.partition_sizes.get((topic, partition), 0.0)
+
+
+def group_by_broker(metrics) -> dict[int, BrokerLoad]:
+    loads: dict[int, BrokerLoad] = {}
+    for m in metrics:
+        loads.setdefault(m.broker_id, BrokerLoad(m.broker_id)).record(m)
+    return loads

@@ -1,0 +1,96 @@
+"""SampleStore SPI: durable metric-sample persistence for warm restarts.
+
+Reference parity: monitor/sampling/KafkaSampleStore.java:94-204 (two sample
+topics, produced on every fetch, replayed in parallel at startup) and
+NoopSampleStore. Here the default durable store is an append-only JSONL
+file pair under ``sample.store.path`` (fileStore/ scratch dir in the
+reference deployment); a Kafka-topic store can implement the same protocol
+when a Kafka client is available.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Protocol
+
+from .sampler import SamplerResult
+from .samples import (
+    broker_samples_from_record, broker_samples_record,
+    partition_samples_from_record, partition_samples_record,
+)
+
+
+class SampleStore(Protocol):
+    def store_samples(self, result: SamplerResult) -> None: ...
+
+    def load_samples(self) -> SamplerResult: ...
+
+    def close(self) -> None: ...
+
+
+class NoopSampleStore:
+    def store_samples(self, result: SamplerResult) -> None:
+        pass
+
+    def load_samples(self) -> SamplerResult:
+        return SamplerResult([], [], 0)
+
+    def close(self) -> None:
+        pass
+
+
+class FileSampleStore:
+    """Append-only JSONL pair (partition-samples, broker-samples) with a
+    byte budget: when a file exceeds ``max_bytes`` it is compacted to the
+    newest half (the Kafka store relies on topic retention for the same)."""
+
+    def __init__(self, path: str, max_bytes: int = 64 * 1024 * 1024):
+        self._dir = path
+        self._max_bytes = max_bytes
+        os.makedirs(path, exist_ok=True)
+        self._ppath = os.path.join(path, "partition_samples.jsonl")
+        self._bpath = os.path.join(path, "broker_samples.jsonl")
+        self._lock = threading.Lock()
+
+    def store_samples(self, result: SamplerResult) -> None:
+        with self._lock:
+            self._append(self._ppath, partition_samples_record(result.partition_samples))
+            self._append(self._bpath, broker_samples_record(result.broker_samples))
+
+    def _append(self, path: str, rows: list[dict]) -> None:
+        if not rows:
+            return
+        with open(path, "a") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        if os.path.getsize(path) > self._max_bytes:
+            with open(path) as f:
+                lines = f.readlines()
+            with open(path, "w") as f:
+                f.writelines(lines[len(lines) // 2:])
+
+    def load_samples(self) -> SamplerResult:
+        with self._lock:
+            return SamplerResult(
+                partition_samples_from_record(self._read(self._ppath)),
+                broker_samples_from_record(self._read(self._bpath)), 0)
+
+    @staticmethod
+    def _read(path: str) -> list[dict]:
+        if not os.path.exists(path):
+            return []
+        rows = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        rows.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue  # torn tail write — skip
+        return rows
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,189 @@
+"""MetricSampler SPI + bundled implementations.
+
+Reference parity: monitor/sampling/MetricSampler.java plugin SPI with
+CruiseControlMetricsReporterSampler (consumes the reporter's metrics topic),
+PrometheusMetricSampler (PromQL over HTTP), and NoopSampler.
+
+Redesign: the Kafka consumer is abstracted behind ``MetricsTransport`` (an
+in-memory queue in this image — a kafka-python/confluent binding implements
+the same two methods against the real ``__CruiseControlMetrics`` topic).
+The Prometheus sampler maps PromQL queries onto raw metric types like the
+reference's PrometheusAdapter but is gated on an injectable ``http_get``
+so tests run without a server and the image needs no client library.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Callable, Iterable, Mapping, Protocol
+
+from ...executor.admin import PartitionState
+from ...metricdef.raw_metric_type import RawMetricType as R
+from ...model.cpu_estimation import CpuEstimator
+from ...reporter.metrics import CruiseControlMetric, deserialize
+from .processor import CruiseControlMetricsProcessor, ProcessorResult
+from .samples import BrokerMetricSample, PartitionMetricSample
+
+
+@dataclasses.dataclass
+class SamplerResult:
+    partition_samples: list[PartitionMetricSample]
+    broker_samples: list[BrokerMetricSample]
+    skipped_partitions: int = 0
+
+
+class MetricSampler(Protocol):
+    """getSamples(cluster, assigned partitions, [start, end)) → samples."""
+
+    def get_samples(self, partitions: Mapping[tuple[str, int], PartitionState],
+                    start_ms: int, end_ms: int) -> SamplerResult: ...
+
+    def close(self) -> None: ...
+
+
+class NoopSampler:
+    def get_samples(self, partitions, start_ms, end_ms) -> SamplerResult:
+        return SamplerResult([], [], 0)
+
+    def close(self) -> None:
+        pass
+
+
+class MetricsTransport(Protocol):
+    """Minimal consumer view of the metrics topic."""
+
+    def poll(self, start_ms: int, end_ms: int) -> list[bytes]: ...
+
+    def produce(self, payload: bytes) -> None: ...
+
+
+class InMemoryMetricsTransport:
+    """Test/simulation transport holding serialized metric records."""
+
+    def __init__(self):
+        self._records: list[tuple[int, bytes]] = []
+
+    def produce(self, payload: bytes) -> None:
+        m = deserialize(payload)
+        self._records.append((m.time_ms, payload))
+
+    def produce_metric(self, metric: CruiseControlMetric) -> None:
+        from ...reporter.metrics import serialize
+        self._records.append((metric.time_ms, serialize(metric)))
+
+    def poll(self, start_ms: int, end_ms: int) -> list[bytes]:
+        return [b for ts, b in self._records if start_ms <= ts < end_ms]
+
+
+class CruiseControlMetricsReporterSampler:
+    """Consumes reporter records from the transport and runs the processor
+    (CruiseControlMetricsReporterSampler.java + MetricsProcessor)."""
+
+    def __init__(self, transport: MetricsTransport,
+                 cpu_estimator: CpuEstimator | None = None):
+        self._transport = transport
+        self._processor = CruiseControlMetricsProcessor(cpu_estimator)
+
+    def get_samples(self, partitions, start_ms: int, end_ms: int) -> SamplerResult:
+        raw = [deserialize(b) for b in self._transport.poll(start_ms, end_ms)]
+        if partitions:
+            assigned = set(partitions)
+            raw = [m for m in raw
+                   if m.topic is None or m.partition < 0
+                   or (m.topic, m.partition) in assigned]
+        res: ProcessorResult = self._processor.process(raw, partitions, end_ms)
+        return SamplerResult(res.partition_samples, res.broker_samples,
+                             res.skipped_partitions)
+
+    def close(self) -> None:
+        pass
+
+
+# -- Prometheus ------------------------------------------------------------
+
+# PromQL per raw metric (PrometheusMetricSampler.java DEFAULT_QUERY_MAP).
+DEFAULT_PROMETHEUS_QUERIES: dict[R, str] = {
+    R.ALL_TOPIC_BYTES_IN: "sum(rate(kafka_server_BrokerTopicMetrics_BytesInPerSec[1m])) by (instance)",
+    R.ALL_TOPIC_BYTES_OUT: "sum(rate(kafka_server_BrokerTopicMetrics_BytesOutPerSec[1m])) by (instance)",
+    R.BROKER_CPU_UTIL: "1 - avg(rate(node_cpu_seconds_total{mode='idle'}[1m])) by (instance)",
+    R.TOPIC_BYTES_IN: "sum(rate(kafka_server_BrokerTopicMetrics_BytesInPerSec[1m])) by (instance, topic)",
+    R.TOPIC_BYTES_OUT: "sum(rate(kafka_server_BrokerTopicMetrics_BytesOutPerSec[1m])) by (instance, topic)",
+    R.PARTITION_SIZE: "kafka_log_Log_Size",
+}
+
+
+class PrometheusMetricSampler:
+    """PromQL-backed sampler. ``http_get(query, time_s) -> [(labels, value)]``
+    is injected (urllib against /api/v1/query in production)."""
+
+    def __init__(self, http_get: Callable[[str, float], list[tuple[dict, float]]],
+                 broker_of_instance: Callable[[str], int | None],
+                 queries: Mapping[R, str] | None = None,
+                 cpu_estimator: CpuEstimator | None = None):
+        self._http_get = http_get
+        self._broker_of = broker_of_instance
+        self._queries = dict(queries or DEFAULT_PROMETHEUS_QUERIES)
+        self._processor = CruiseControlMetricsProcessor(cpu_estimator)
+
+    def get_samples(self, partitions, start_ms: int, end_ms: int) -> SamplerResult:
+        raw: list[CruiseControlMetric] = []
+        t = end_ms / 1000.0
+        for rtype, q in self._queries.items():
+            for labels, value in self._http_get(q, t):
+                broker = self._broker_of(labels.get("instance", ""))
+                if broker is None or not math.isfinite(value):
+                    continue
+                topic = labels.get("topic")
+                part = int(labels.get("partition", -1))
+                raw.append(CruiseControlMetric(rtype, end_ms, broker, value,
+                                               topic=topic, partition=part))
+        res = self._processor.process(raw, partitions, end_ms)
+        return SamplerResult(res.partition_samples, res.broker_samples,
+                             res.skipped_partitions)
+
+    def close(self) -> None:
+        pass
+
+
+class SyntheticSampler:
+    """Deterministic load generator for demos and tests: stable per-partition
+    rates derived from a hash of (topic, partition) so windows are
+    self-consistent across intervals."""
+
+    def __init__(self, seed: int = 0, cpu_per_kb: float = 2e-4):
+        self._seed = seed
+        self._cpu_per_kb = cpu_per_kb
+
+    def get_samples(self, partitions, start_ms, end_ms) -> SamplerResult:
+        from ...metricdef.kafka_metric_def import CommonMetric as CM
+        psamples = []
+        per_broker: dict[int, float] = {}
+        for (topic, part), st in partitions.items():
+            if st.leader < 0:
+                continue
+            h = (hash((self._seed, topic, part)) % 1000) / 1000.0
+            bytes_in = 50.0 + 950.0 * h
+            bytes_out = 2.0 * bytes_in
+            psamples.append(PartitionMetricSample.make(topic, part, end_ms, {
+                CM.CPU_USAGE: self._cpu_per_kb * bytes_in,
+                CM.DISK_USAGE: 10_000.0 * h + 100.0,
+                CM.LEADER_BYTES_IN: bytes_in,
+                CM.LEADER_BYTES_OUT: bytes_out,
+                CM.REPLICATION_BYTES_IN_RATE: bytes_in,
+                CM.MESSAGE_IN_RATE: bytes_in / 2,
+            }))
+            per_broker[st.leader] = per_broker.get(st.leader, 0.0) + bytes_in
+        bsamples = [BrokerMetricSample.make(b, end_ms, {
+            CM.CPU_USAGE.name: min(1.0, self._cpu_per_kb * v),
+            CM.LEADER_BYTES_IN.name: v, CM.LEADER_BYTES_OUT.name: 2 * v,
+        }) for b, v in per_broker.items()]
+        return SamplerResult(psamples, bsamples, 0)
+
+    def close(self) -> None:
+        pass
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)

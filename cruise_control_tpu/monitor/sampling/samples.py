@@ -1,0 +1,116 @@
+"""Metric sample records flowing Monitor-ward.
+
+Reference parity: monitor/sampling/holder/PartitionMetricSample.java (156)
+and BrokerMetricSample.java (359) — one record per entity per sampling
+interval, carrying the model-metric values keyed by KafkaMetricDef ids.
+
+Redesign: samples are lightweight frozen records; batch ingestion converts
+a list of samples into one numpy matrix per entity class so the windowed
+aggregator does a single vectorized add per interval instead of per-entity
+calls (the reference loops addSample per sample).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from ...metricdef.kafka_metric_def import CommonMetric, KafkaMetricDef
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class PartitionEntity:
+    """Aggregation entity for a partition; group = topic
+    (KafkaPartitionMetricSampleAggregator: group-by-topic granularity)."""
+
+    topic: str
+    partition: int
+
+    @property
+    def group(self) -> str:
+        return self.topic
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class BrokerEntity:
+    broker_id: int
+
+    @property
+    def group(self) -> str:
+        return str(self.broker_id)
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionMetricSample:
+    """Per-partition sample over COMMON metrics (CPU_USAGE..REPLICATION_*)."""
+
+    entity: PartitionEntity
+    time_ms: int
+    values: tuple[float, ...]  # indexed by common metric id
+
+    @staticmethod
+    def make(topic: str, partition: int, time_ms: int,
+             by_metric: dict[CommonMetric, float]) -> "PartitionMetricSample":
+        n = KafkaMetricDef.common_metric_def().num_metrics
+        vals = [0.0] * n
+        for m, v in by_metric.items():
+            vals[KafkaMetricDef.common_metric_id(m)] = float(v)
+        return PartitionMetricSample(PartitionEntity(topic, partition),
+                                     time_ms, tuple(vals))
+
+    def metric_value(self, metric: CommonMetric) -> float:
+        return self.values[KafkaMetricDef.common_metric_id(metric)]
+
+
+@dataclasses.dataclass(frozen=True)
+class BrokerMetricSample:
+    """Per-broker sample over COMMON + BROKER_ONLY metrics."""
+
+    entity: BrokerEntity
+    time_ms: int
+    values: tuple[float, ...]  # indexed by broker metric id
+
+    @staticmethod
+    def make(broker_id: int, time_ms: int,
+             by_name: dict[str, float]) -> "BrokerMetricSample":
+        d = KafkaMetricDef.broker_metric_def()
+        vals = [0.0] * d.num_metrics
+        for name, v in by_name.items():
+            vals[d.metric_info(name).id] = float(v)
+        return BrokerMetricSample(BrokerEntity(broker_id), time_ms, tuple(vals))
+
+    def metric_value(self, name: str) -> float:
+        return self.values[KafkaMetricDef.broker_metric_def().metric_info(name).id]
+
+
+def samples_to_matrix(samples: Sequence[PartitionMetricSample | BrokerMetricSample],
+                      ) -> tuple[list, np.ndarray]:
+    """(entities, values[n, num_metrics]) for aggregator batch add."""
+    if not samples:
+        return [], np.zeros((0, 0), dtype=np.float32)
+    entities = [s.entity for s in samples]
+    values = np.asarray([s.values for s in samples], dtype=np.float32)
+    return entities, values
+
+
+def partition_samples_record(samples: Iterable[PartitionMetricSample]) -> list[dict]:
+    """JSON-able rows for the sample store."""
+    return [{"t": s.entity.topic, "p": s.entity.partition, "ms": s.time_ms,
+             "v": list(s.values)} for s in samples]
+
+
+def partition_samples_from_record(rows: Iterable[dict]) -> list[PartitionMetricSample]:
+    return [PartitionMetricSample(PartitionEntity(r["t"], r["p"]), r["ms"],
+                                  tuple(r["v"])) for r in rows]
+
+
+def broker_samples_record(samples: Iterable[BrokerMetricSample]) -> list[dict]:
+    return [{"b": s.entity.broker_id, "ms": s.time_ms, "v": list(s.values)}
+            for s in samples]
+
+
+def broker_samples_from_record(rows: Iterable[dict]) -> list[BrokerMetricSample]:
+    return [BrokerMetricSample(BrokerEntity(r["b"]), r["ms"], tuple(r["v"]))
+            for r in rows]

@@ -1,0 +1,15 @@
+"""Broker-side metrics reporter equivalent (cruise-control-metrics-reporter).
+
+The reporter runs inside/alongside each managed broker, samples its metric
+registry every interval, and produces serialized CruiseControlMetric
+records to the metrics transport (the ``__CruiseControlMetrics`` topic in a
+real deployment; an in-memory transport in tests/simulations).
+"""
+
+from .metrics import (
+    CruiseControlMetric, broker_metric, deserialize, partition_metric,
+    serialize, topic_metric,
+)
+
+__all__ = ["CruiseControlMetric", "broker_metric", "deserialize",
+           "partition_metric", "serialize", "topic_metric"]

@@ -1,0 +1,88 @@
+"""Raw metric records emitted by the broker-side reporter agent.
+
+Reference parity: cruise-control-metrics-reporter
+metric/CruiseControlMetric.java + BrokerMetric/TopicMetric/PartitionMetric
+records and MetricSerde.java (versioned binary serde over the
+``__CruiseControlMetrics`` topic).
+
+The serde here is a compact little-endian struct (type tag, version, raw
+metric id, time, broker id, value, optional topic/partition) — not the
+Java serde format (no cross-compat needed; both ends are ours).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+from ..metricdef.raw_metric_type import MetricScope, RawMetricType, scope_of
+
+SERDE_VERSION = 1
+_HEADER = struct.Struct("<BBhqid")  # version, scope, raw id, time_ms, broker, value
+_LEN = struct.Struct("<H")
+
+
+@dataclasses.dataclass(frozen=True)
+class CruiseControlMetric:
+    raw_type: RawMetricType
+    time_ms: int
+    broker_id: int
+    value: float
+    topic: str | None = None      # TOPIC and PARTITION scope
+    partition: int = -1           # PARTITION scope
+
+    @property
+    def scope(self) -> MetricScope:
+        return scope_of(self.raw_type)
+
+
+def broker_metric(raw: RawMetricType, time_ms: int, broker_id: int,
+                  value: float) -> CruiseControlMetric:
+    assert scope_of(raw) is MetricScope.BROKER, raw
+    return CruiseControlMetric(raw, time_ms, broker_id, value)
+
+
+def topic_metric(raw: RawMetricType, time_ms: int, broker_id: int,
+                 topic: str, value: float) -> CruiseControlMetric:
+    assert scope_of(raw) is MetricScope.TOPIC, raw
+    return CruiseControlMetric(raw, time_ms, broker_id, value, topic=topic)
+
+
+def partition_metric(raw: RawMetricType, time_ms: int, broker_id: int,
+                     topic: str, partition: int, value: float) -> CruiseControlMetric:
+    assert scope_of(raw) is MetricScope.PARTITION, raw
+    return CruiseControlMetric(raw, time_ms, broker_id, value, topic=topic,
+                               partition=partition)
+
+
+def serialize(m: CruiseControlMetric) -> bytes:
+    scope = {MetricScope.BROKER: 0, MetricScope.TOPIC: 1,
+             MetricScope.PARTITION: 2}[m.scope]
+    head = _HEADER.pack(SERDE_VERSION, scope, int(m.raw_type), m.time_ms,
+                        m.broker_id, m.value)
+    if m.scope is MetricScope.BROKER:
+        return head
+    tb = (m.topic or "").encode()
+    body = _LEN.pack(len(tb)) + tb
+    if m.scope is MetricScope.PARTITION:
+        body += struct.pack("<i", m.partition)
+    return head + body
+
+
+def deserialize(buf: bytes) -> CruiseControlMetric:
+    version, scope, raw_id, time_ms, broker, value = _HEADER.unpack_from(buf)
+    if version != SERDE_VERSION:
+        raise ValueError(f"unsupported metric serde version {version}")
+    raw = RawMetricType(raw_id)
+    if scope == 0:
+        return CruiseControlMetric(raw, time_ms, broker, value)
+    off = _HEADER.size
+    (tlen,) = _LEN.unpack_from(buf, off)
+    off += _LEN.size
+    topic = buf[off:off + tlen].decode()
+    off += tlen
+    if scope == 1:
+        return CruiseControlMetric(raw, time_ms, broker, value, topic=topic)
+    (part,) = struct.unpack_from("<i", buf, off)
+    return CruiseControlMetric(raw, time_ms, broker, value, topic=topic,
+                               partition=part)

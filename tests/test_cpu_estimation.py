@@ -1,0 +1,100 @@
+"""CPU estimation semantics (ModelUtils / LinearRegressionModelParameters parity)."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.model.cpu_estimation import (
+    CpuEstimator, CpuModelCoefficients, LinearRegressionCpuModel,
+    estimate_leader_cpu_util, follower_cpu_util_from_leader_load,
+)
+
+
+def test_static_estimate_splits_broker_cpu_by_traffic_share():
+    # One partition carrying all of the broker's leader traffic gets the
+    # whole leader share of broker CPU.
+    est = estimate_leader_cpu_util(
+        broker_cpu_util=np.array([0.5]),
+        broker_leader_bytes_in=np.array([100.0]),
+        broker_leader_bytes_out=np.array([200.0]),
+        broker_follower_bytes_in=np.array([0.0]),
+        partition_bytes_in=np.array([100.0]),
+        partition_bytes_out=np.array([200.0]))
+    assert est == pytest.approx(0.5)
+
+    # Half the traffic → half the leader-attributed CPU.
+    est_half = estimate_leader_cpu_util(
+        np.array([0.5]), np.array([100.0]), np.array([200.0]), np.array([0.0]),
+        np.array([50.0]), np.array([100.0]))
+    assert est_half == pytest.approx(0.25)
+
+
+def test_static_estimate_zero_broker_traffic_is_zero():
+    est = estimate_leader_cpu_util(
+        np.array([0.9]), np.array([0.0]), np.array([0.0]), np.array([5.0]),
+        np.array([0.0]), np.array([0.0]))
+    assert est == 0.0
+
+
+def test_static_estimate_inconsistent_rates_returns_nan():
+    # Partition rate > broker rate beyond the 5% error factor with a stable
+    # broker rate ⇒ the reference returns null; we return NaN.
+    est = estimate_leader_cpu_util(
+        np.array([0.5]), np.array([100.0]), np.array([100.0]), np.array([0.0]),
+        np.array([200.0]), np.array([10.0]))
+    assert np.isnan(est[0])
+
+
+def test_follower_cpu_from_leader_load():
+    coef = CpuModelCoefficients()
+    out = follower_cpu_util_from_leader_load(
+        np.array([100.0]), np.array([100.0]), np.array([0.4]), coef)
+    expect = 0.4 * (coef.follower_bytes_in * 100.0) / (
+        coef.leader_bytes_in * 100.0 + coef.leader_bytes_out * 100.0)
+    assert out == pytest.approx(expect)
+    assert follower_cpu_util_from_leader_load(
+        np.array([0.0]), np.array([0.0]), np.array([0.4]), coef) == 0.0
+
+
+def test_linear_regression_recovers_coefficients():
+    rng = np.random.default_rng(0)
+    n = 4000
+    lin = rng.uniform(0, 1000, n)
+    lout = rng.uniform(0, 1000, n)
+    fin = rng.uniform(0, 1000, n)
+    true = np.array([3e-4, 1e-4, 5e-5])
+    cpu = np.clip(true[0] * lin + true[1] * lout + true[2] * fin, 0, 1)
+    model = LinearRegressionCpuModel(num_buckets=10, max_per_bucket=1000,
+                                     min_completeness=0.3)
+    model.add_observations(cpu, lin, lout, fin)
+    assert model.train()
+    np.testing.assert_allclose(model.coefficients, true, rtol=1e-3)
+    est = model.estimate_leader_cpu_util(np.array([100.0]), np.array([100.0]))
+    assert est == pytest.approx(true[0] * 100 + true[1] * 100, rel=1e-3)
+
+
+def test_linear_regression_requires_bucket_diversity():
+    model = LinearRegressionCpuModel(num_buckets=10, min_completeness=0.5)
+    # All observations in one CPU bucket → not complete, no train.
+    model.add_observations(np.full(100, 0.05), np.ones(100), np.ones(100),
+                           np.ones(100))
+    assert not model.train()
+    assert model.training_completeness == pytest.approx(0.1)
+
+
+def test_estimator_facade_switches_models():
+    est = CpuEstimator()
+    static = est.leader_cpu(np.array([0.5]), np.array([100.0]),
+                            np.array([200.0]), np.array([0.0]),
+                            np.array([100.0]), np.array([200.0]))
+    assert static == pytest.approx(0.5)
+
+    model = LinearRegressionCpuModel(num_buckets=5, min_completeness=0.2)
+    rng = np.random.default_rng(1)
+    lin = rng.uniform(0, 100, 500)
+    model.add_observations(np.clip(2e-3 * lin, 0, 1), lin, np.zeros(500),
+                           np.zeros(500))
+    assert model.train()
+    est2 = CpuEstimator(linear_model=model, use_linear_regression=True)
+    out = est2.leader_cpu(None, None, None, None, np.array([50.0]),
+                          np.array([0.0]))
+    assert out == pytest.approx(0.1, rel=1e-2)

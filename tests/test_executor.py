@@ -199,9 +199,10 @@ def test_executor_throttle_set_and_cleared():
                   replication_throttle=12345)
     ex.execute_proposals([proposal(part=0, old=(0, 1), new=(2, 1), new_leader=2)])
     assert ex.await_completion(20)
-    # Throttles were written then cleared (empty string = removal marker).
-    assert admin.broker_configs[2]["leader.replication.throttled.rate"] == ""
-    assert admin.topic_configs["t"]["leader.replication.throttled.replicas"] == ""
+    # Throttles were written then deleted (keys the helper set must not
+    # survive the execution; pre-existing values would be restored).
+    assert "leader.replication.throttled.rate" not in admin.broker_configs[2]
+    assert "leader.replication.throttled.replicas" not in admin.topic_configs["t"]
 
 
 def test_sampling_mode_toggled_around_execution():

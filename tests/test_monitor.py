@@ -1,0 +1,186 @@
+"""Monitor ingestion: reporter serde → processor → samplers → LoadMonitor →
+ClusterTensors (reference parity: CruiseControlMetricsProcessor,
+MetricFetcherManager, KafkaSampleStore replay, LoadMonitor.clusterModel)."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.broker_state import BrokerState
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+from cruise_control_tpu.executor.admin import InMemoryAdminBackend, PartitionState
+from cruise_control_tpu.metricdef.kafka_metric_def import CommonMetric as CM
+from cruise_control_tpu.metricdef.raw_metric_type import RawMetricType as R
+from cruise_control_tpu.model.tensors import broker_load
+from cruise_control_tpu.monitor import (
+    LoadMonitor, ModelCompletenessRequirements, StaticCapacityResolver,
+)
+from cruise_control_tpu.monitor.sampling import (
+    CruiseControlMetricsProcessor, CruiseControlMetricsReporterSampler,
+    FileSampleStore, InMemoryMetricsTransport, SyntheticSampler,
+    default_partition_assignor,
+)
+from cruise_control_tpu.reporter.metrics import (
+    broker_metric, deserialize, partition_metric, serialize, topic_metric,
+)
+
+
+def _partitions(n_topics=2, parts_per_topic=2, brokers=(0, 1, 2)):
+    out = {}
+    for t in range(n_topics):
+        topic = f"t{t}"
+        for p in range(parts_per_topic):
+            leader = brokers[(t + p) % len(brokers)]
+            replicas = (leader, brokers[(t + p + 1) % len(brokers)])
+            out[(topic, p)] = PartitionState(topic, p, replicas, leader,
+                                             isr=replicas)
+    return out
+
+
+def _report_interval(transport, partitions, time_ms, bytes_in_per_topic=100.0):
+    """Emit a consistent raw-metric interval for every leader broker."""
+    by_broker = {}
+    for (topic, p), st in partitions.items():
+        by_broker.setdefault(st.leader, set()).add(topic)
+    for broker, topics in by_broker.items():
+        n = len(topics)
+        transport.produce_metric(broker_metric(R.BROKER_CPU_UTIL, time_ms, broker, 0.5))
+        transport.produce_metric(broker_metric(R.ALL_TOPIC_BYTES_IN, time_ms,
+                                               broker, bytes_in_per_topic * n))
+        transport.produce_metric(broker_metric(R.ALL_TOPIC_BYTES_OUT, time_ms,
+                                               broker, 2 * bytes_in_per_topic * n))
+        transport.produce_metric(broker_metric(R.ALL_TOPIC_REPLICATION_BYTES_IN,
+                                               time_ms, broker, 10.0))
+        for topic in topics:
+            transport.produce_metric(topic_metric(R.TOPIC_BYTES_IN, time_ms,
+                                                  broker, topic, bytes_in_per_topic))
+            transport.produce_metric(topic_metric(R.TOPIC_BYTES_OUT, time_ms,
+                                                  broker, topic, 2 * bytes_in_per_topic))
+        for (topic, p), st in partitions.items():
+            if st.leader == broker:
+                transport.produce_metric(partition_metric(
+                    R.PARTITION_SIZE, time_ms, broker, topic, p, 5000.0))
+
+
+def test_metric_serde_roundtrip():
+    for m in [broker_metric(R.BROKER_CPU_UTIL, 123, 7, 0.25),
+              topic_metric(R.TOPIC_BYTES_IN, 456, 1, "payments", 99.5),
+              partition_metric(R.PARTITION_SIZE, 789, 2, "payments", 3, 1e6)]:
+        assert deserialize(serialize(m)) == m
+
+
+def test_processor_distributes_topic_rates_and_estimates_cpu():
+    partitions = _partitions(n_topics=1, parts_per_topic=2, brokers=(0,))
+    transport = InMemoryMetricsTransport()
+    _report_interval(transport, partitions, 1000)
+    raw = [deserialize(b) for b in transport.poll(0, 2000)]
+    res = CruiseControlMetricsProcessor().process(raw, partitions, 1000)
+    assert len(res.partition_samples) == 2
+    assert res.skipped_partitions == 0
+    # Equal sizes → even split of the topic's 100 B/s.
+    for s in res.partition_samples:
+        assert s.metric_value(CM.LEADER_BYTES_IN) == pytest.approx(50.0)
+        assert s.metric_value(CM.DISK_USAGE) == pytest.approx(5000.0)
+        assert 0.0 < s.metric_value(CM.CPU_USAGE) <= 0.5
+    # Broker sample carries CPU + totals.
+    (b,) = res.broker_samples
+    assert b.metric_value("CPU_USAGE") == pytest.approx(0.5)
+    assert b.metric_value("LEADER_BYTES_IN") == pytest.approx(100.0)
+
+
+def test_partition_assignor_is_deterministic_and_complete():
+    partitions = _partitions(n_topics=5, parts_per_topic=7)
+    a = default_partition_assignor(partitions, 3)
+    b = default_partition_assignor(partitions, 3)
+    assert [sorted(x) for x in a] == [sorted(x) for x in b]
+    assert sum(len(x) for x in a) == len(partitions)
+
+
+def test_file_sample_store_roundtrip(tmp_path):
+    store = FileSampleStore(str(tmp_path / "samples"))
+    partitions = _partitions(n_topics=1, parts_per_topic=1, brokers=(0,))
+    res = SyntheticSampler().get_samples(partitions, 0, 1000)
+    store.store_samples(res)
+    loaded = store.load_samples()
+    assert loaded.partition_samples == res.partition_samples
+    assert loaded.broker_samples == res.broker_samples
+
+
+def _load_monitor(partitions, transport=None, store=None, interval_ms=1000):
+    backend = InMemoryAdminBackend(partitions.values())
+    cfg = CruiseControlConfig({
+        "metric.sampling.interval.ms": interval_ms,
+        "partition.metrics.window.ms": interval_ms,
+        "broker.metrics.window.ms": interval_ms,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.5,
+    })
+    sampler = (CruiseControlMetricsReporterSampler(transport)
+               if transport is not None else SyntheticSampler())
+    caps = StaticCapacityResolver({}, {Resource.CPU: 100.0, Resource.DISK: 1e6,
+                                       Resource.NW_IN: 1e5, Resource.NW_OUT: 1e5})
+    return LoadMonitor(cfg, backend, samplers=[sampler], sample_store=store,
+                       capacity_resolver=caps,
+                       broker_racks={0: "r0", 1: "r1", 2: "r2"})
+
+
+def test_load_monitor_builds_cluster_model_from_reporter_metrics():
+    partitions = _partitions(n_topics=2, parts_per_topic=3)
+    transport = InMemoryMetricsTransport()
+    monitor = _load_monitor(partitions, transport)
+    # Three sampling intervals → windows roll and stabilize.
+    for k in range(1, 4):
+        _report_interval(transport, partitions, k * 1000 - 500)
+        monitor.task_runner.run_sampling_once(end_ms=k * 1000)
+    state, meta = monitor.cluster_model(
+        ModelCompletenessRequirements(min_valid_windows=1,
+                                      min_monitored_partitions_percentage=0.5))
+    assert state.num_brokers == 3
+    assert sorted(meta.broker_ids) == [0, 1, 2]
+    assert meta.rack_names == ["r0", "r1", "r2"]
+    assert int(state.partition_mask.sum()) == len(partitions)
+    # Every broker leads one partition per topic (the full 100 B/s topic
+    # rate each → 200 leader NW_IN) and follows two partitions (replication
+    # NW_IN ≈ leader rate → +200).
+    loads = np.asarray(broker_load(state))
+    np.testing.assert_allclose(loads[:, int(Resource.NW_IN)], 400.0, rtol=0.05)
+    st = monitor.state()
+    assert st.total_num_partitions == len(partitions)
+    assert st.num_valid_windows >= 1
+    assert st.monitored_partitions_percentage == pytest.approx(1.0)
+
+
+def test_load_monitor_marks_dead_brokers():
+    partitions = _partitions(n_topics=1, parts_per_topic=2)
+    backend = InMemoryAdminBackend(partitions.values())
+    backend.kill_broker(2)
+    cfg = CruiseControlConfig({"partition.metrics.window.ms": 1000,
+                               "num.partition.metrics.windows": 2,
+                               "min.valid.partition.ratio": 0.0})
+    monitor = LoadMonitor(cfg, backend, samplers=[SyntheticSampler()])
+    monitor.task_runner.run_sampling_once(end_ms=1000)
+    monitor.task_runner.run_sampling_once(end_ms=2000)
+    state, meta = monitor.cluster_model(
+        ModelCompletenessRequirements(1, 0.0))
+    dead = np.asarray(state.broker_state) == int(BrokerState.DEAD)
+    assert dead[meta.broker_ids.index(2)]
+
+
+def test_sample_store_warm_restart(tmp_path):
+    partitions = _partitions(n_topics=1, parts_per_topic=2, brokers=(0, 1, 2))
+    store_dir = str(tmp_path / "warm")
+    store = FileSampleStore(store_dir)
+    m1 = _load_monitor(partitions, store=store)
+    for k in range(1, 3):
+        m1.task_runner.run_sampling_once(end_ms=k * 1000)
+    n_before = m1.partition_aggregator.num_samples()
+    assert n_before > 0
+
+    # Fresh monitor over the same store: replay restores the windows.
+    m2 = _load_monitor(partitions, store=FileSampleStore(store_dir))
+    m2.start_up(block_on_load=True)
+    try:
+        assert m2.task_runner.samples_loaded > 0
+        assert m2.partition_aggregator.num_samples() == n_before
+    finally:
+        m2.shutdown()
