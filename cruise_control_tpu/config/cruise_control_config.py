@@ -227,6 +227,11 @@ def _definition() -> ConfigDef:
              "Scoring threshold for demotion of slow brokers.")
     d.define("slow.broker.decommission.score", T.INT, 50, Range.at_least(0), I.LOW,
              "Scoring threshold for removal of slow brokers.")
+    d.define("self.healing.target.topic.replication.factor", T.INT, None, None,
+             I.LOW, "Desired RF enforced by the topic-anomaly detector; unset "
+             "disables RF anomaly detection (TopicReplicationFactorAnomalyFinder).")
+    d.define("topic.anomaly.topic.pattern", T.STRING, ".*", None, I.LOW,
+             "Regex scoping which topics the RF anomaly finder enforces.")
     d.define("provisioner.class", T.CLASS,
              "cruise_control_tpu.detector.provisioner.BasicProvisioner",
              None, I.LOW, "Provisioner implementation.")

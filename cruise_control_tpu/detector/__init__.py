@@ -1,0 +1,53 @@
+"""Anomaly detection + self-healing (reference: detector/ + notifier/).
+
+Host-side scheduling around device-side detection math: the goal-violation
+pass IS the batched TPU optimizer; slow-broker/percentile finders vectorize
+over the broker aggregator's window tensor.
+"""
+
+from .anomaly import (
+    Anomaly, AnomalyType, BrokerFailures, DiskFailures, GoalViolations,
+    MaintenanceEvent, MaintenanceEventType, MetricAnomaly, TopicAnomaly,
+)
+from .broker_failure import BrokerFailureDetector
+from .disk_failure import DiskFailureDetector
+from .goal_violation import GoalViolationDetector
+from .maintenance import (
+    FileMaintenanceEventReader, IdempotenceCache,
+    InMemoryMaintenanceEventReader, MaintenanceEventDetector,
+)
+from .manager import AnomalyDetectorManager, AnomalyStatus
+from .metric_anomaly import (
+    MetricAnomalyDetector, PercentileMetricAnomalyFinder, SlowBrokerFinder,
+)
+from .notifier import (
+    AlertaSelfHealingNotifier, AnomalyNotificationAction,
+    AnomalyNotificationResult, AnomalyNotifier, MSTeamsSelfHealingNotifier,
+    NoopNotifier, SelfHealingNotifier, SlackSelfHealingNotifier,
+)
+from .provisioner import (
+    BasicProvisioner, ProvisionRecommendation, ProvisionResponse,
+    ProvisionStatus, Provisioner, ProvisionerState,
+)
+from .topic_anomaly import (
+    PartitionSizeAnomalyFinder, TopicAnomalyDetector,
+    TopicReplicationFactorAnomalyFinder,
+)
+
+__all__ = [
+    "Anomaly", "AnomalyType", "BrokerFailures", "DiskFailures",
+    "GoalViolations", "MaintenanceEvent", "MaintenanceEventType",
+    "MetricAnomaly", "TopicAnomaly", "BrokerFailureDetector",
+    "DiskFailureDetector", "GoalViolationDetector",
+    "FileMaintenanceEventReader", "IdempotenceCache",
+    "InMemoryMaintenanceEventReader", "MaintenanceEventDetector",
+    "AnomalyDetectorManager", "AnomalyStatus", "MetricAnomalyDetector",
+    "PercentileMetricAnomalyFinder", "SlowBrokerFinder",
+    "AlertaSelfHealingNotifier", "AnomalyNotificationAction",
+    "AnomalyNotificationResult", "AnomalyNotifier",
+    "MSTeamsSelfHealingNotifier", "NoopNotifier", "SelfHealingNotifier",
+    "SlackSelfHealingNotifier", "BasicProvisioner",
+    "ProvisionRecommendation", "ProvisionResponse", "ProvisionStatus",
+    "Provisioner", "ProvisionerState", "PartitionSizeAnomalyFinder",
+    "TopicAnomalyDetector", "TopicReplicationFactorAnomalyFinder",
+]

@@ -1,0 +1,250 @@
+"""Anomaly SPI.
+
+Reference parity: cruise-control-core detector/Anomaly.java,
+detector/AnomalyType.java, and the concrete anomaly records under
+cruise-control detector/ (GoalViolations.java, BrokerFailures.java,
+DiskFailures.java, KafkaMetricAnomaly.java, TopicAnomaly.java,
+MaintenanceEvent.java), plus notifier/KafkaAnomalyType.java priorities.
+
+An anomaly is a host-side record; ``fix()`` dispatches the matching
+self-healing operation on the facade (the reference's runnables,
+AnomalyDetectorManager.java:549). Device math stays inside the detectors
+that created the anomaly.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+
+class AnomalyType(enum.Enum):
+    """Priority-ordered anomaly taxonomy (KafkaAnomalyType.java:62 — lower
+    value = higher priority in the handler queue)."""
+
+    BROKER_FAILURE = 0
+    DISK_FAILURE = 1
+    METRIC_ANOMALY = 2
+    GOAL_VIOLATION = 3
+    TOPIC_ANOMALY = 4
+    MAINTENANCE_EVENT = 5
+
+    @property
+    def priority(self) -> int:
+        return self.value
+
+
+_anomaly_seq = itertools.count()
+
+
+def _now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+@dataclass
+class Anomaly:
+    """Base anomaly (Anomaly.java). ``fix`` returns True when a fix was
+    started (Anomaly.fix contract)."""
+
+    anomaly_type: AnomalyType = AnomalyType.GOAL_VIOLATION
+    detection_time_ms: int = field(default_factory=_now_ms)
+    anomaly_id: str = field(default_factory=lambda: f"anomaly-{next(_anomaly_seq)}")
+
+    def reasons(self) -> list[str]:
+        return []
+
+    def fix(self, facade: Any) -> bool:
+        raise NotImplementedError
+
+    @property
+    def self_healing_config_key(self) -> str:
+        return {
+            AnomalyType.BROKER_FAILURE: "self.healing.broker.failure.enabled",
+            AnomalyType.DISK_FAILURE: "self.healing.disk.failure.enabled",
+            AnomalyType.METRIC_ANOMALY: "self.healing.metric.anomaly.enabled",
+            AnomalyType.GOAL_VIOLATION: "self.healing.goal.violation.enabled",
+            AnomalyType.TOPIC_ANOMALY: "self.healing.topic.anomaly.enabled",
+            AnomalyType.MAINTENANCE_EVENT: "self.healing.maintenance.event.enabled",
+        }[self.anomaly_type]
+
+    def __lt__(self, other: "Anomaly") -> bool:
+        # PriorityBlockingQueue ordering: type priority, then detection time.
+        return (self.anomaly_type.priority, self.detection_time_ms) < (
+            other.anomaly_type.priority, other.detection_time_ms)
+
+
+@dataclass
+class GoalViolations(Anomaly):
+    """detector/GoalViolations.java — fixable/unfixable violated goals from
+    one detection pass; fix = self-healing rebalance over the configured
+    detection goals."""
+
+    fixable_goals: list[str] = field(default_factory=list)
+    unfixable_goals: list[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        self.anomaly_type = AnomalyType.GOAL_VIOLATION
+
+    def reasons(self) -> list[str]:
+        out = [f"fixable goal violation: {g}" for g in self.fixable_goals]
+        out += [f"unfixable goal violation: {g}" for g in self.unfixable_goals]
+        return out
+
+    def fix(self, facade: Any) -> bool:
+        if not self.fixable_goals:
+            return False
+        facade.rebalance(goals=None, dryrun=False,
+                         is_triggered_by_user_request=False,
+                         reason=f"self-healing goal violation {self.fixable_goals}")
+        return True
+
+
+@dataclass
+class BrokerFailures(Anomaly):
+    """detector/BrokerFailures.java — brokers that left the cluster, with
+    first-seen failure times; fix = remove_brokers (self-healing)."""
+
+    failed_brokers: Mapping[int, int] = field(default_factory=dict)  # id → ms
+
+    def __post_init__(self):
+        self.anomaly_type = AnomalyType.BROKER_FAILURE
+
+    def reasons(self) -> list[str]:
+        return [f"broker {b} failed at {t}" for b, t in
+                sorted(self.failed_brokers.items())]
+
+    def fix(self, facade: Any) -> bool:
+        if not self.failed_brokers:
+            return False
+        facade.remove_brokers(sorted(self.failed_brokers), dryrun=False,
+                              is_triggered_by_user_request=False,
+                              reason="self-healing broker failure")
+        return True
+
+
+@dataclass
+class DiskFailures(Anomaly):
+    """detector/DiskFailures.java — offline log dirs per broker; fix =
+    fix_offline_replicas."""
+
+    failed_disks: Mapping[int, Sequence[str]] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.anomaly_type = AnomalyType.DISK_FAILURE
+
+    def reasons(self) -> list[str]:
+        return [f"broker {b} offline dirs {sorted(d)}"
+                for b, d in sorted(self.failed_disks.items())]
+
+    def fix(self, facade: Any) -> bool:
+        if not self.failed_disks:
+            return False
+        facade.fix_offline_replicas(dryrun=False,
+                                    is_triggered_by_user_request=False,
+                                    reason="self-healing disk failure")
+        return True
+
+
+@dataclass
+class MetricAnomaly(Anomaly):
+    """detector/KafkaMetricAnomaly.java + SlowBrokerFinder verdicts; fix =
+    demote (leadership off) or remove the slow brokers."""
+
+    broker_ids: Sequence[int] = field(default_factory=list)
+    metric_name: str = ""
+    description: str = ""
+    fix_by_removal: bool = False  # SlowBrokerFinder.java:43 remove vs demote
+
+    def __post_init__(self):
+        self.anomaly_type = AnomalyType.METRIC_ANOMALY
+
+    def reasons(self) -> list[str]:
+        return [f"metric anomaly on broker {b}: {self.metric_name} "
+                f"{self.description}" for b in self.broker_ids]
+
+    def fix(self, facade: Any) -> bool:
+        if not self.broker_ids:
+            return False
+        if self.fix_by_removal:
+            facade.remove_brokers(list(self.broker_ids), dryrun=False,
+                                  is_triggered_by_user_request=False,
+                                  reason="self-healing slow broker removal")
+        else:
+            facade.demote_brokers(list(self.broker_ids), dryrun=False,
+                                  is_triggered_by_user_request=False,
+                                  reason="self-healing slow broker demotion")
+        return True
+
+
+@dataclass
+class TopicAnomaly(Anomaly):
+    """detector/TopicAnomaly.java / TopicReplicationFactorAnomalyFinder —
+    topics whose RF deviates from the desired value; fix = RF update."""
+
+    topics_by_desired_rf: Mapping[int, Sequence[str]] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.anomaly_type = AnomalyType.TOPIC_ANOMALY
+
+    def reasons(self) -> list[str]:
+        return [f"topics needing RF={rf}: {sorted(ts)}"
+                for rf, ts in sorted(self.topics_by_desired_rf.items())]
+
+    def fix(self, facade: Any) -> bool:
+        if not self.topics_by_desired_rf:
+            return False
+        for rf, topics in sorted(self.topics_by_desired_rf.items()):
+            facade.update_topic_replication_factor(
+                list(topics), rf, dryrun=False,
+                is_triggered_by_user_request=False,
+                reason="self-healing topic replication factor")
+        return True
+
+
+class MaintenanceEventType(enum.Enum):
+    """MaintenancePlan taxonomy (detector/MaintenanceEventType.java)."""
+
+    ADD_BROKER = "ADD_BROKER"
+    REMOVE_BROKER = "REMOVE_BROKER"
+    FIX_OFFLINE_REPLICAS = "FIX_OFFLINE_REPLICAS"
+    REBALANCE = "REBALANCE"
+    DEMOTE_BROKER = "DEMOTE_BROKER"
+    TOPIC_REPLICATION_FACTOR = "TOPIC_REPLICATION_FACTOR"
+
+
+@dataclass
+class MaintenanceEvent(Anomaly):
+    """detector/MaintenanceEvent.java — an externally submitted maintenance
+    plan (the reference reads these from a Kafka topic)."""
+
+    event_type: MaintenanceEventType = MaintenanceEventType.REBALANCE
+    broker_ids: Sequence[int] = field(default_factory=list)
+    topics_by_rf: Mapping[int, Sequence[str]] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.anomaly_type = AnomalyType.MAINTENANCE_EVENT
+
+    def reasons(self) -> list[str]:
+        return [f"maintenance {self.event_type.value} brokers={list(self.broker_ids)}"]
+
+    def fix(self, facade: Any) -> bool:
+        t = MaintenanceEventType
+        kw = dict(dryrun=False, is_triggered_by_user_request=False,
+                  reason=f"maintenance event {self.event_type.value}")
+        if self.event_type is t.ADD_BROKER:
+            facade.add_brokers(list(self.broker_ids), **kw)
+        elif self.event_type is t.REMOVE_BROKER:
+            facade.remove_brokers(list(self.broker_ids), **kw)
+        elif self.event_type is t.DEMOTE_BROKER:
+            facade.demote_brokers(list(self.broker_ids), **kw)
+        elif self.event_type is t.FIX_OFFLINE_REPLICAS:
+            facade.fix_offline_replicas(**kw)
+        elif self.event_type is t.TOPIC_REPLICATION_FACTOR:
+            for rf, topics in sorted(self.topics_by_rf.items()):
+                facade.update_topic_replication_factor(list(topics), rf, **kw)
+        else:
+            facade.rebalance(goals=None, **kw)
+        return True

@@ -1,0 +1,87 @@
+"""Broker-failure detector with durable failure records.
+
+Reference parity: detector/AbstractBrokerFailureDetector.java (failure-time
+persistence to ``failed.brokers.file.path``:53,92-117 so restarts remember
+prior failures) + KafkaBrokerFailureDetector.java (metadata-polling
+liveness — the modern replacement for the legacy ZK watcher, which this
+framework intentionally does not carry: the metadata backend is the single
+source of liveness truth).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Callable
+
+from ..executor.admin import AdminBackend
+from .anomaly import BrokerFailures
+
+LOG = logging.getLogger(__name__)
+
+
+class BrokerFailureDetector:
+    def __init__(self, metadata: AdminBackend,
+                 report: Callable[[BrokerFailures], None],
+                 failed_brokers_file_path: str = "",
+                 now_ms: Callable[[], int] | None = None):
+        self._metadata = metadata
+        self._report = report
+        self._path = failed_brokers_file_path
+        self._now_ms = now_ms or (lambda: int(time.time() * 1000))
+        self._failed: dict[int, int] = {}          # broker id → first-seen ms
+        self._load_persisted_failures()
+
+    @property
+    def failed_brokers(self) -> dict[int, int]:
+        return dict(self._failed)
+
+    # -- persistence (AbstractBrokerFailureDetector.java:92-117) -----------
+    def _load_persisted_failures(self) -> None:
+        if not self._path or not os.path.exists(self._path):
+            return
+        try:
+            with open(self._path) as f:
+                self._failed = {int(k): int(v) for k, v in json.load(f).items()}
+        except Exception:
+            LOG.exception("could not parse failed-broker file %s", self._path)
+
+    def _persist(self) -> None:
+        if not self._path:
+            return
+        os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({str(k): v for k, v in self._failed.items()}, f)
+        os.replace(tmp, self._path)
+
+    # -- detection ---------------------------------------------------------
+    def _expected_brokers(self) -> set[int]:
+        """All brokers hosting replicas per current metadata — a broker is
+        'failed' when it holds replicas but is not alive (MonitorUtils)."""
+        expected: set[int] = set()
+        for st in self._metadata.describe_partitions().values():
+            expected |= set(st.replicas)
+        return expected
+
+    def run_once(self) -> BrokerFailures | None:
+        alive = self._metadata.alive_brokers()
+        dead = self._expected_brokers() - alive
+        changed = False
+        for b in dead:
+            if b not in self._failed:
+                self._failed[b] = self._now_ms()
+                changed = True
+        for b in list(self._failed):
+            if b not in dead:
+                del self._failed[b]
+                changed = True
+        if changed:
+            self._persist()
+        if not self._failed:
+            return None
+        anomaly = BrokerFailures(failed_brokers=dict(self._failed))
+        self._report(anomaly)
+        return anomaly

@@ -1,0 +1,84 @@
+"""Goal-violation detector.
+
+Reference parity: detector/GoalViolationDetector.java — on each interval,
+skip when the model generation is unchanged (:136), build a fresh cluster
+model, replay the ``anomaly.detection.goals`` WITHOUT executing, classify
+violations fixable/unfixable, refresh the cluster balancedness score
+(:282-287). The whole detection pass rides the batched TPU optimizer: one
+``GoalOptimizer.optimizations`` call scores and (virtually) fixes every
+goal at once.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+from ..analyzer.optimizer import (
+    GoalOptimizer, OptimizerResult, balancedness_score, goals_by_priority,
+)
+from ..config.cruise_control_config import CruiseControlConfig
+from ..monitor.load_monitor import LoadMonitor, ModelCompletenessRequirements
+from .anomaly import GoalViolations
+
+LOG = logging.getLogger(__name__)
+
+
+class GoalViolationDetector:
+    def __init__(self, config: CruiseControlConfig, load_monitor: LoadMonitor,
+                 optimizer: GoalOptimizer,
+                 report: Callable[[GoalViolations], None]):
+        self._config = config
+        self._load_monitor = load_monitor
+        self._optimizer = optimizer
+        self._report = report
+        self._goals = goals_by_priority(
+            config, config.get_list("anomaly.detection.goals"))
+        self._last_checked_generation = -1
+        self._balancedness_score = 100.0
+        self._last_result: OptimizerResult | None = None
+        self._priority_weight = config.get_double("goal.balancedness.priority.weight")
+        self._strictness_weight = config.get_double("goal.balancedness.strictness.weight")
+
+    @property
+    def balancedness_score(self) -> float:
+        """The 0..100 cluster balancedness gauge (:282-287, §A.4)."""
+        return self._balancedness_score
+
+    @property
+    def last_result(self) -> OptimizerResult | None:
+        return self._last_result
+
+    def run_once(self) -> GoalViolations | None:
+        gen = self._load_monitor.model_generation
+        if gen == self._last_checked_generation:
+            LOG.debug("model generation %d unchanged; skipping detection", gen)
+            return None
+        try:
+            state, meta = self._load_monitor.cluster_model(
+                ModelCompletenessRequirements(
+                    min_valid_windows=1,
+                    min_monitored_partitions_percentage=self._config.get(
+                        "min.valid.partition.ratio")))
+        except Exception as e:
+            LOG.info("skipping goal-violation detection: %s", e)
+            return None
+        self._last_checked_generation = gen
+
+        _final, result = self._optimizer.optimizations(state, meta, self._goals)
+        self._last_result = result
+        # Fixable = violated before and satisfiable by the solver; unfixable =
+        # still violated after optimization (GoalViolationDetector fixability
+        # classification).
+        fixable = [g for g in result.violated_goals_before
+                   if g not in result.violated_goals_after]
+        unfixable = list(result.violated_goals_after)
+        self._balancedness_score = balancedness_score(
+            self._goals, set(result.violated_goals_before),
+            self._priority_weight, self._strictness_weight)
+        if not fixable and not unfixable:
+            return None
+        violations = GoalViolations(fixable_goals=fixable,
+                                    unfixable_goals=unfixable)
+        self._report(violations)
+        return violations

@@ -1,0 +1,115 @@
+"""Maintenance-event ingestion.
+
+Reference parity: detector/MaintenanceEventDetector.java +
+MaintenanceEventTopicReader.java:350 (consume maintenance plans from a
+Kafka topic) + IdempotenceCache.java:106 (drop duplicate plans within a
+retention window). The reader is a pluggable source; the default is an
+in-memory queue (tests, embedding) and a JSON-lines file reader stands in
+for the Kafka topic in file-backed deployments.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Callable, Iterable, Protocol
+
+from .anomaly import MaintenanceEvent, MaintenanceEventType
+
+LOG = logging.getLogger(__name__)
+
+
+class MaintenanceEventReader(Protocol):
+    def read_events(self) -> Iterable[MaintenanceEvent]: ...
+
+
+class InMemoryMaintenanceEventReader:
+    """Test/embedded source: plans are submitted programmatically."""
+
+    def __init__(self):
+        self._queue: list[MaintenanceEvent] = []
+
+    def submit(self, event: MaintenanceEvent) -> None:
+        self._queue.append(event)
+
+    def read_events(self) -> list[MaintenanceEvent]:
+        out, self._queue = self._queue, []
+        return out
+
+
+class FileMaintenanceEventReader:
+    """JSON-lines file tail (the file plays the metrics-topic role):
+    each line {"type": ..., "brokers": [...], "topics_by_rf": {...}}."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._offset = 0
+
+    def read_events(self) -> list[MaintenanceEvent]:
+        if not os.path.exists(self._path):
+            return []
+        events: list[MaintenanceEvent] = []
+        with open(self._path) as f:
+            f.seek(self._offset)
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    d = json.loads(line)
+                    events.append(MaintenanceEvent(
+                        event_type=MaintenanceEventType(d["type"]),
+                        broker_ids=d.get("brokers", []),
+                        topics_by_rf={int(k): v for k, v in
+                                      d.get("topics_by_rf", {}).items()}))
+                except Exception:
+                    LOG.exception("bad maintenance plan line: %r", line)
+            self._offset = f.tell()
+        return events
+
+
+class IdempotenceCache:
+    """IdempotenceCache.java — drop plans identical to one seen within the
+    retention window."""
+
+    def __init__(self, retention_ms: int = 3_600_000,
+                 now_ms: Callable[[], int] | None = None):
+        self._retention_ms = retention_ms
+        self._now_ms = now_ms or (lambda: int(time.time() * 1000))
+        self._seen: dict[tuple, int] = {}
+
+    def _key(self, e: MaintenanceEvent) -> tuple:
+        return (e.event_type.value, tuple(sorted(e.broker_ids)),
+                tuple(sorted((rf, tuple(sorted(ts)))
+                             for rf, ts in e.topics_by_rf.items())))
+
+    def is_duplicate(self, event: MaintenanceEvent) -> bool:
+        now = self._now_ms()
+        self._seen = {k: t for k, t in self._seen.items()
+                      if now - t < self._retention_ms}
+        key = self._key(event)
+        if key in self._seen:
+            return True
+        self._seen[key] = now
+        return False
+
+
+class MaintenanceEventDetector:
+    def __init__(self, reader: MaintenanceEventReader,
+                 report: Callable[[MaintenanceEvent], None],
+                 idempotence_retention_ms: int = 3_600_000):
+        self._reader = reader
+        self._report = report
+        self._cache = IdempotenceCache(idempotence_retention_ms)
+
+    def run_once(self) -> list[MaintenanceEvent]:
+        out = []
+        for event in self._reader.read_events():
+            if self._cache.is_duplicate(event):
+                LOG.info("dropping duplicate maintenance plan %s", event.reasons())
+                continue
+            self._report(event)
+            out.append(event)
+        return out

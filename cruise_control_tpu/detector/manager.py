@@ -1,0 +1,208 @@
+"""Anomaly detector manager: scheduling + the single-consumer fix queue.
+
+Reference parity: detector/AnomalyDetectorManager.java:52-133 (one
+scheduled task per anomaly type feeding a PriorityBlockingQueue, one
+AnomalyHandlerTask draining it), :343-451 (take → notifier consult →
+FIX/CHECK/IGNORE), :513-549 (completeness check then ``anomaly.fix()``),
+:190 (self-healing gauges), and AnomalyState bookkeeping
+(detector/AnomalyState.java).
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+from ..config.cruise_control_config import CruiseControlConfig
+from .anomaly import Anomaly, AnomalyType
+from .notifier import (
+    AnomalyNotificationAction, AnomalyNotifier, SelfHealingNotifier,
+)
+
+LOG = logging.getLogger(__name__)
+
+
+class AnomalyStatus:
+    DETECTED = "DETECTED"
+    IGNORED = "IGNORED"
+    CHECK_WITH_DELAY = "CHECK_WITH_DELAY"
+    FIX_STARTED = "FIX_STARTED"
+    FIX_FAILED_TO_START = "FIX_FAILED_TO_START"
+
+
+@dataclass
+class AnomalyRecord:
+    anomaly: Anomaly
+    status: str = AnomalyStatus.DETECTED
+    status_time_ms: int = field(default_factory=lambda: int(time.time() * 1000))
+
+
+class AnomalyDetectorManager:
+    """Owns the detector schedule and the fix pipeline. Detectors are any
+    objects with ``run_once()``; they report anomalies via the ``report``
+    callback handed to them at construction (the queue's producer side)."""
+
+    def __init__(self, config: CruiseControlConfig | None = None,
+                 notifier: AnomalyNotifier | None = None,
+                 facade: Any = None):
+        self._config = config or CruiseControlConfig()
+        self._notifier = notifier or SelfHealingNotifier(self._config)
+        self._facade = facade
+        self._detectors: list[tuple[Any, float]] = []   # (detector, interval_s)
+        self._queue: list[tuple[tuple[int, int], int, Anomaly]] = []
+        self._queue_seq = 0
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._history: list[AnomalyRecord] = []
+        self._records: dict[str, AnomalyRecord] = {}
+        self._num_self_healing_started = 0
+        self._num_fix_failures = 0
+        self._recheck: list[tuple[float, Anomaly]] = []  # (due time s, anomaly)
+
+    # -- wiring ------------------------------------------------------------
+    def add_detector(self, detector: Any, interval_ms: int) -> None:
+        self._detectors.append((detector, interval_ms / 1000.0))
+
+    def report(self, anomaly: Anomaly) -> None:
+        """Producer side (what detectors call). Thread-safe."""
+        rec = AnomalyRecord(anomaly)
+        with self._cv:
+            self._records[anomaly.anomaly_id] = rec
+            self._history.append(rec)
+            for old in self._history[:-200]:
+                self._records.pop(old.anomaly.anomaly_id, None)
+            del self._history[:-200]
+            heapq.heappush(self._queue, (
+                (anomaly.anomaly_type.priority, anomaly.detection_time_ms),
+                self._queue_seq, anomaly))
+            self._queue_seq += 1
+            self._cv.notify_all()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start_detection(self) -> None:
+        """Spawn one scheduler thread per detector + the handler thread
+        (AnomalyDetectorManager.startDetection)."""
+        self._stop.clear()
+        for det, interval_s in self._detectors:
+            t = threading.Thread(target=self._detector_loop,
+                                 args=(det, interval_s),
+                                 name=f"anomaly-detector-{type(det).__name__}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        handler = threading.Thread(target=self._handler_loop,
+                                   name="anomaly-handler", daemon=True)
+        handler.start()
+        self._threads.append(handler)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+    def _detector_loop(self, detector: Any, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                detector.run_once()
+            except Exception:
+                LOG.exception("detector %s failed", type(detector).__name__)
+
+    # -- the handler (AnomalyHandlerTask, :343) ----------------------------
+    def _take(self, timeout_s: float) -> Anomaly | None:
+        deadline = time.time() + timeout_s
+        with self._cv:
+            while True:
+                now = time.time()
+                while self._recheck and self._recheck[0][0] <= now:
+                    _due, anomaly = heapq.heappop(self._recheck)
+                    heapq.heappush(self._queue, (
+                        (anomaly.anomaly_type.priority, anomaly.detection_time_ms),
+                        self._queue_seq, anomaly))
+                    self._queue_seq += 1
+                if self._queue:
+                    return heapq.heappop(self._queue)[2]
+                if self._stop.is_set() or now >= deadline:
+                    return None
+                wait = deadline - now
+                if self._recheck:
+                    wait = min(wait, self._recheck[0][0] - now)
+                self._cv.wait(timeout=max(wait, 0.01))
+
+    def _handler_loop(self) -> None:
+        while not self._stop.is_set():
+            anomaly = self._take(timeout_s=0.5)
+            if anomaly is not None:
+                self.handle_anomaly(anomaly)
+
+    def handle_anomaly(self, anomaly: Anomaly) -> str:
+        """One notifier-consult + fix cycle; returns the AnomalyStatus.
+        Public so tests and embedded deployments can drive it synchronously."""
+        rec = self._records.get(anomaly.anomaly_id) or AnomalyRecord(anomaly)
+        try:
+            result = self._notifier.on_anomaly(anomaly)
+        except Exception:
+            LOG.exception("notifier failed; ignoring anomaly")
+            rec.status = AnomalyStatus.IGNORED
+            return rec.status
+        if result.action is AnomalyNotificationAction.IGNORE:
+            rec.status = AnomalyStatus.IGNORED
+        elif result.action is AnomalyNotificationAction.CHECK:
+            rec.status = AnomalyStatus.CHECK_WITH_DELAY
+            with self._cv:
+                heapq.heappush(self._recheck,
+                               (time.time() + result.delay_ms / 1000.0, anomaly))
+                self._cv.notify_all()
+        else:
+            rec.status = self._fix(anomaly)
+        rec.status_time_ms = int(time.time() * 1000)
+        return rec.status
+
+    def _fix(self, anomaly: Anomaly) -> str:
+        """Completeness gate + fix dispatch (:513-549)."""
+        if self._facade is None:
+            return AnomalyStatus.FIX_FAILED_TO_START
+        ready = getattr(self._facade, "ready_for_self_healing", lambda: True)
+        if not ready():
+            LOG.info("skipping fix: load model not ready for self-healing")
+            return AnomalyStatus.FIX_FAILED_TO_START
+        try:
+            started = anomaly.fix(self._facade)
+        except Exception:
+            LOG.exception("anomaly fix failed to start")
+            self._num_fix_failures += 1
+            return AnomalyStatus.FIX_FAILED_TO_START
+        if started:
+            self._num_self_healing_started += 1
+            return AnomalyStatus.FIX_STARTED
+        return AnomalyStatus.FIX_FAILED_TO_START
+
+    # -- state (anomaly_detector_state endpoint) ---------------------------
+    def set_self_healing_for(self, anomaly_type: AnomalyType,
+                             enabled: bool) -> bool:
+        return self._notifier.set_self_healing_for(anomaly_type, enabled)
+
+    def state(self) -> dict:
+        enabled = self._notifier.self_healing_enabled()
+        return {
+            "selfHealingEnabled": [t.name for t, on in enabled.items() if on],
+            "selfHealingDisabled": [t.name for t, on in enabled.items() if not on],
+            "recentAnomalies": [
+                {"anomalyId": r.anomaly.anomaly_id,
+                 "type": r.anomaly.anomaly_type.name,
+                 "status": r.status,
+                 "statusTimeMs": r.status_time_ms,
+                 "reasons": r.anomaly.reasons()}
+                for r in self._history[-20:]],
+            "metrics": {
+                "numSelfHealingStarted": self._num_self_healing_started,
+                "numFixFailures": self._num_fix_failures,
+                "queueSize": len(self._queue)},
+        }

@@ -1,0 +1,223 @@
+"""Anomaly notifier SPI + self-healing escalation policy.
+
+Reference parity: detector/notifier/AnomalyNotifier.java (SPI),
+SelfHealingNotifier.java:59 (graded alert→auto-fix thresholds),
+SlackSelfHealingNotifier / MSTeamsSelfHealingNotifier /
+AlertaSelfHealingNotifier (webhook fan-outs), NoopNotifier.
+
+Webhook posts go through a pluggable ``http_post`` callable so tests (and
+the zero-egress build sandbox) can capture payloads instead of performing
+network IO.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import logging
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..config.cruise_control_config import CruiseControlConfig
+from .anomaly import Anomaly, AnomalyType
+
+LOG = logging.getLogger(__name__)
+
+
+class AnomalyNotificationAction(enum.Enum):
+    FIX = "FIX"
+    CHECK = "CHECK"       # re-check after a delay
+    IGNORE = "IGNORE"
+
+
+@dataclass(frozen=True)
+class AnomalyNotificationResult:
+    """AnomalyNotificationResult.java — action + optional re-check delay."""
+
+    action: AnomalyNotificationAction
+    delay_ms: int = 0
+
+    @staticmethod
+    def fix() -> "AnomalyNotificationResult":
+        return AnomalyNotificationResult(AnomalyNotificationAction.FIX)
+
+    @staticmethod
+    def check(delay_ms: int) -> "AnomalyNotificationResult":
+        return AnomalyNotificationResult(AnomalyNotificationAction.CHECK, delay_ms)
+
+    @staticmethod
+    def ignore() -> "AnomalyNotificationResult":
+        return AnomalyNotificationResult(AnomalyNotificationAction.IGNORE)
+
+
+class AnomalyNotifier:
+    """SPI (AnomalyNotifier.java). One callback per anomaly type; the
+    manager consults the result to fix / re-check / drop."""
+
+    def on_anomaly(self, anomaly: Anomaly) -> AnomalyNotificationResult:
+        raise NotImplementedError
+
+    def self_healing_enabled(self) -> dict[AnomalyType, bool]:
+        return {t: False for t in AnomalyType}
+
+    def set_self_healing_for(self, anomaly_type: AnomalyType, enabled: bool) -> bool:
+        """Admin-endpoint toggle; returns the previous value."""
+        return False
+
+
+class NoopNotifier(AnomalyNotifier):
+    """NoopNotifier.java — log and ignore."""
+
+    def on_anomaly(self, anomaly: Anomaly) -> AnomalyNotificationResult:
+        LOG.info("anomaly ignored (noop notifier): %s", anomaly.reasons())
+        return AnomalyNotificationResult.ignore()
+
+
+class SelfHealingNotifier(AnomalyNotifier):
+    """SelfHealingNotifier.java — per-type enable flags; broker failures
+    escalate alert → auto-fix by failure age (broker.failure.alert.threshold.ms
+    then self.healing.threshold); other types fix immediately when enabled."""
+
+    BROKER_FAILURE_ALERT_THRESHOLD_MS = 900_000       # :59
+
+    def __init__(self, config: CruiseControlConfig | None = None,
+                 now_ms: Callable[[], int] | None = None):
+        cfg = config or CruiseControlConfig()
+        self._now_ms = now_ms or (lambda: int(time.time() * 1000))
+        global_on = cfg.get_boolean("self.healing.enabled")
+        self._enabled = {
+            t: bool(global_on and cfg.get_boolean(
+                Anomaly(anomaly_type=t).self_healing_config_key))
+            for t in AnomalyType
+        }
+        self._alert_threshold_ms = self.BROKER_FAILURE_ALERT_THRESHOLD_MS
+        self._fix_threshold_ms = cfg.get_long("broker.failure.self.healing.threshold.ms")
+        self._alerted: set[int] = set()
+
+    def self_healing_enabled(self) -> dict[AnomalyType, bool]:
+        return dict(self._enabled)
+
+    def set_self_healing_for(self, anomaly_type: AnomalyType, enabled: bool) -> bool:
+        old = self._enabled[anomaly_type]
+        self._enabled[anomaly_type] = enabled
+        return old
+
+    # -- alert hook (webhook notifiers override) ---------------------------
+    def alert(self, anomaly: Anomaly, auto_fix_triggered: bool) -> None:
+        LOG.warning("anomaly alert (auto_fix=%s): %s", auto_fix_triggered,
+                    anomaly.reasons())
+
+    def on_anomaly(self, anomaly: Anomaly) -> AnomalyNotificationResult:
+        if anomaly.anomaly_type is AnomalyType.BROKER_FAILURE:
+            return self._on_broker_failure(anomaly)
+        if not self._enabled[anomaly.anomaly_type]:
+            self.alert(anomaly, auto_fix_triggered=False)
+            return AnomalyNotificationResult.ignore()
+        self.alert(anomaly, auto_fix_triggered=True)
+        return AnomalyNotificationResult.fix()
+
+    def _on_broker_failure(self, anomaly: Anomaly) -> AnomalyNotificationResult:
+        """Graded escalation (SelfHealingNotifier.java:59): before the alert
+        threshold → re-check; between alert and fix thresholds → alert +
+        re-check; past the fix threshold → fix (if enabled)."""
+        failed = getattr(anomaly, "failed_brokers", {})
+        # A broker that recovered leaves the alerted set so its NEXT failure
+        # alerts again.
+        self._alerted &= set(failed)
+        if not failed:
+            return AnomalyNotificationResult.ignore()
+        earliest = min(failed.values())
+        now = self._now_ms()
+        alert_at = earliest + self._alert_threshold_ms
+        fix_at = earliest + self._fix_threshold_ms
+        if now < alert_at:
+            return AnomalyNotificationResult.check(alert_at - now)
+        if now < fix_at:
+            new = set(failed) - self._alerted
+            if new:
+                self._alerted |= new
+                self.alert(anomaly, auto_fix_triggered=False)
+            return AnomalyNotificationResult.check(fix_at - now)
+        self._alerted -= set(failed)
+        if not self._enabled[AnomalyType.BROKER_FAILURE]:
+            self.alert(anomaly, auto_fix_triggered=False)
+            return AnomalyNotificationResult.ignore()
+        self.alert(anomaly, auto_fix_triggered=True)
+        return AnomalyNotificationResult.fix()
+
+
+def _default_http_post(url: str, payload: dict, headers: dict | None = None) -> int:
+    data = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=10) as resp:  # pragma: no cover
+        return resp.status
+
+
+class WebhookSelfHealingNotifier(SelfHealingNotifier):
+    """Base for the Slack/Teams/Alerta fan-outs: same escalation policy,
+    alert() additionally posts a JSON payload to a webhook URL."""
+
+    def __init__(self, config: CruiseControlConfig | None = None,
+                 webhook_url: str = "",
+                 http_post: Callable[..., int] | None = None, **kw):
+        super().__init__(config, **kw)
+        self._webhook_url = webhook_url
+        self._http_post = http_post or _default_http_post
+
+    def payload(self, anomaly: Anomaly, auto_fix_triggered: bool) -> dict:
+        raise NotImplementedError
+
+    def alert(self, anomaly: Anomaly, auto_fix_triggered: bool) -> None:
+        super().alert(anomaly, auto_fix_triggered)
+        if not self._webhook_url:
+            return
+        try:
+            self._http_post(self._webhook_url,
+                            self.payload(anomaly, auto_fix_triggered))
+        except Exception:
+            LOG.exception("webhook alert failed")
+
+
+class SlackSelfHealingNotifier(WebhookSelfHealingNotifier):
+    """SlackSelfHealingNotifier.java:85 — Slack incoming-webhook message."""
+
+    def payload(self, anomaly: Anomaly, auto_fix_triggered: bool) -> dict:
+        return {"text": (f":warning: cruise-control-tpu anomaly "
+                         f"{anomaly.anomaly_type.name} "
+                         f"(auto-fix: {auto_fix_triggered})\n"
+                         + "\n".join(anomaly.reasons()))}
+
+
+class MSTeamsSelfHealingNotifier(WebhookSelfHealingNotifier):
+    """MSTeamsSelfHealingNotifier.java:64 — MessageCard payload."""
+
+    def payload(self, anomaly: Anomaly, auto_fix_triggered: bool) -> dict:
+        return {"@type": "MessageCard", "@context": "https://schema.org/extensions",
+                "title": f"Anomaly: {anomaly.anomaly_type.name}",
+                "text": "; ".join(anomaly.reasons()),
+                "themeColor": "FF0000" if not auto_fix_triggered else "FFA500"}
+
+
+class AlertaSelfHealingNotifier(WebhookSelfHealingNotifier):
+    """AlertaSelfHealingNotifier.java:258 — Alerta alert API payload."""
+
+    def __init__(self, *a, environment: str = "Production",
+                 api_key: str = "", **kw):
+        super().__init__(*a, **kw)
+        self._environment = environment
+        self._api_key = api_key
+
+    def payload(self, anomaly: Anomaly, auto_fix_triggered: bool) -> dict:
+        return {"environment": self._environment,
+                "event": anomaly.anomaly_type.name,
+                "resource": anomaly.anomaly_id,
+                "severity": "critical" if anomaly.anomaly_type in
+                (AnomalyType.BROKER_FAILURE, AnomalyType.DISK_FAILURE)
+                else "warning",
+                "service": ["cruise-control-tpu"],
+                "text": "; ".join(anomaly.reasons()),
+                "attributes": {"autoFix": auto_fix_triggered}}

@@ -1,0 +1,90 @@
+"""Provisioner SPI + right-sizing recommendation records.
+
+Reference parity: detector/Provisioner.java SPI with
+BasicProvisioner/BasicBrokerProvisioner/PartitionProvisioner, and the
+ProvisionResponse/ProvisionStatus/ProvisionRecommendation records the
+analyzer attaches to optimizer results (analyzer/ProvisionStatus.java).
+
+The under/over-provisioned signal itself comes from the goal kernels: a
+capacity goal that cannot place all load ⇒ UNDER_PROVISIONED; every broker
+far below the low-utilization threshold ⇒ OVER_PROVISIONED.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+from dataclasses import dataclass, field
+from typing import Protocol
+
+LOG = logging.getLogger(__name__)
+
+
+class ProvisionStatus(enum.Enum):
+    RIGHT_SIZED = "RIGHT_SIZED"
+    UNDER_PROVISIONED = "UNDER_PROVISIONED"
+    OVER_PROVISIONED = "OVER_PROVISIONED"
+    UNDECIDED = "UNDECIDED"
+
+
+@dataclass(frozen=True)
+class ProvisionRecommendation:
+    """ProvisionRecommendation.java — how many brokers/partitions to add
+    (positive) or remove (negative), and for which resource/topic."""
+
+    status: ProvisionStatus
+    num_brokers: int = 0
+    num_partitions: int = 0
+    topic: str | None = None
+    resource: str | None = None
+
+    def to_dict(self) -> dict:
+        return {"status": self.status.value, "numBrokers": self.num_brokers,
+                "numPartitions": self.num_partitions, "topic": self.topic,
+                "resource": self.resource}
+
+
+@dataclass
+class ProvisionResponse:
+    """ProvisionResponse.java — aggregated status + recommendations."""
+
+    status: ProvisionStatus = ProvisionStatus.UNDECIDED
+    recommendations: list[ProvisionRecommendation] = field(default_factory=list)
+
+    def aggregate(self, rec: ProvisionRecommendation) -> None:
+        # UNDER dominates OVER dominates RIGHT_SIZED (ProvisionResponse.java).
+        order = [ProvisionStatus.UNDECIDED, ProvisionStatus.RIGHT_SIZED,
+                 ProvisionStatus.OVER_PROVISIONED, ProvisionStatus.UNDER_PROVISIONED]
+        if order.index(rec.status) > order.index(self.status):
+            self.status = rec.status
+        if rec.status is not ProvisionStatus.RIGHT_SIZED:
+            self.recommendations.append(rec)
+
+
+class ProvisionerState(enum.Enum):
+    COMPLETED = "COMPLETED"
+    COMPLETED_WITH_ERROR = "COMPLETED_WITH_ERROR"
+    IN_PROGRESS = "IN_PROGRESS"
+
+
+class Provisioner(Protocol):
+    """Provisioner.java SPI — carry out a rightsize action against the
+    deployment substrate (cloud API, k8s operator, ticket queue...)."""
+
+    def rightsize(self, recommendations: list[ProvisionRecommendation],
+                  ) -> ProvisionerState: ...
+
+
+class BasicProvisioner:
+    """BasicProvisioner.java — records the actions it would take; concrete
+    deployments subclass and call their infra API."""
+
+    def __init__(self):
+        self.executed: list[ProvisionRecommendation] = []
+
+    def rightsize(self, recommendations: list[ProvisionRecommendation],
+                  ) -> ProvisionerState:
+        for rec in recommendations:
+            LOG.info("provisioner action: %s", rec.to_dict())
+            self.executed.append(rec)
+        return ProvisionerState.COMPLETED

@@ -1,0 +1,78 @@
+"""Topic anomaly finders.
+
+Reference parity: detector/TopicAnomalyDetector.java with
+TopicReplicationFactorAnomalyFinder.java:284 (topics matching a pattern
+whose RF differs from the desired value, min-ISR-aware) and
+PartitionSizeAnomalyFinder.java:127 (partitions larger than a threshold).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Callable
+
+from ..config.cruise_control_config import CruiseControlConfig
+from ..executor.admin import AdminBackend
+from .anomaly import TopicAnomaly
+
+LOG = logging.getLogger(__name__)
+
+
+class TopicReplicationFactorAnomalyFinder:
+    """Topics whose RF ≠ desired RF. ``topic_pattern`` scopes enforcement
+    (self.healing.target.topic.replication.factor analogue)."""
+
+    def __init__(self, desired_rf: int = 3, topic_pattern: str = ".*",
+                 ignore_internal: bool = True):
+        self._desired_rf = desired_rf
+        self._pattern = re.compile(topic_pattern)
+        self._ignore_internal = ignore_internal
+
+    def find(self, metadata: AdminBackend) -> TopicAnomaly | None:
+        bad: set[str] = set()
+        for (topic, _p), st in metadata.describe_partitions().items():
+            if self._ignore_internal and topic.startswith("__"):
+                continue
+            if not self._pattern.fullmatch(topic):
+                continue
+            if len(st.replicas) != self._desired_rf:
+                bad.add(topic)
+        if not bad:
+            return None
+        return TopicAnomaly(topics_by_desired_rf={self._desired_rf: sorted(bad)})
+
+
+class PartitionSizeAnomalyFinder:
+    """Partitions whose disk size exceeds a threshold
+    (PartitionSizeAnomalyFinder.java:127). Reported for alerting; there is
+    no automated fix (matches the reference, which only notifies)."""
+
+    def __init__(self, max_partition_size_bytes: float = 1 << 40):
+        self._threshold = max_partition_size_bytes
+
+    def find_oversized(self, partition_sizes: dict[tuple[str, int], float],
+                       ) -> dict[tuple[str, int], float]:
+        return {tp: sz for tp, sz in partition_sizes.items()
+                if sz > self._threshold}
+
+
+class TopicAnomalyDetector:
+    def __init__(self, metadata: AdminBackend,
+                 report: Callable[[TopicAnomaly], None],
+                 config: CruiseControlConfig | None = None,
+                 desired_rf: int | None = None,
+                 topic_pattern: str = ".*"):
+        del config  # reserved for finder-class plugin configuration
+        self._metadata = metadata
+        self._report = report
+        self._finder = (TopicReplicationFactorAnomalyFinder(desired_rf, topic_pattern)
+                        if desired_rf is not None else None)
+
+    def run_once(self) -> TopicAnomaly | None:
+        if self._finder is None:
+            return None
+        anomaly = self._finder.find(self._metadata)
+        if anomaly is not None:
+            self._report(anomaly)
+        return anomaly

@@ -1,0 +1,435 @@
+"""The orchestration facade: the single object wiring monitor, analyzer,
+executor, and anomaly detection.
+
+Reference parity: KafkaCruiseControl.java:78 (constructor wiring :112-129,
+startUp:221, proposal/execute delegation) plus the operation runnables
+(servlet/handler/async/runnable/: RebalanceRunnable:115,
+AddBrokersRunnable, RemoveBrokersRunnable, DemoteBrokerRunnable,
+FixOfflineReplicasRunnable, UpdateTopicConfigurationRunnable,
+ProposalsRunnable) — here each runnable body is a facade method; the async
+wrapper lives in api/user_tasks.py.
+
+Broker-scoped operations are expressed as state edits on the tensor model
+(set_broker_state — NEW for additions, DEAD for removals, DEMOTED for
+demotions) followed by the same batched goal chain; the reference does the
+identical thing on its object graph before optimizing.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import numpy as np
+
+from .analyzer.constraint import OptimizationOptions
+from .analyzer.optimizer import (
+    GoalOptimizer, OptimizerResult, goals_by_priority,
+)
+from .analyzer.proposals import ExecutionProposal
+from .common.broker_state import BrokerState
+from .config.cruise_control_config import CruiseControlConfig
+from .detector.broker_failure import BrokerFailureDetector
+from .detector.disk_failure import DiskFailureDetector
+from .detector.goal_violation import GoalViolationDetector
+from .detector.maintenance import (
+    InMemoryMaintenanceEventReader, MaintenanceEventDetector,
+)
+from .detector.manager import AnomalyDetectorManager
+from .detector.metric_anomaly import MetricAnomalyDetector
+from .detector.notifier import AnomalyNotifier, SelfHealingNotifier
+from .detector.topic_anomaly import TopicAnomalyDetector
+from .executor.admin import AdminBackend
+from .executor.concurrency import ConcurrencyCaps
+from .executor.executor import Executor
+from .model.tensors import ClusterMeta, ClusterTensors, set_broker_state
+from .monitor.load_monitor import LoadMonitor, ModelCompletenessRequirements
+from .monitor.task_runner import SamplingMode
+
+LOG = logging.getLogger(__name__)
+OPERATION_LOG = logging.getLogger("cruise_control_tpu.operation")
+
+
+@dataclass
+class OperationResult:
+    """What every operation returns (the runnable's computeResult)."""
+
+    operation: str
+    dryrun: bool
+    optimizer_result: OptimizerResult | None = None
+    proposals: tuple[ExecutionProposal, ...] = ()
+    executed: bool = False
+    reason: str = ""
+    extra: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d = {"operation": self.operation, "dryrun": self.dryrun,
+             "executed": self.executed, "reason": self.reason,
+             "numProposals": len(self.proposals)}
+        if self.optimizer_result is not None:
+            d["summary"] = self.optimizer_result.summary()
+        d.update(self.extra)
+        return d
+
+
+class CruiseControl:
+    """The KafkaCruiseControl facade for the TPU framework."""
+
+    def __init__(self, config: CruiseControlConfig, admin: AdminBackend,
+                 load_monitor: LoadMonitor | None = None,
+                 executor: Executor | None = None,
+                 notifier: AnomalyNotifier | None = None):
+        self._config = config
+        self._admin = admin
+        self._load_monitor = load_monitor or LoadMonitor(config, admin)
+        self._executor = executor or Executor(
+            admin,
+            caps=ConcurrencyCaps(
+                inter_broker_per_broker=config.get_int(
+                    "num.concurrent.partition.movements.per.broker"),
+                cluster_inter_broker=config.get_int(
+                    "max.num.cluster.partition.movements"),
+                intra_broker_per_broker=config.get_int(
+                    "num.concurrent.intra.broker.partition.movements"),
+                leadership_cluster=config.get_int(
+                    "num.concurrent.leader.movements"),
+            ),
+            replication_throttle=config.get("default.replication.throttle"),
+            on_sampling_mode_change=self._on_execution_sampling_change)
+        self._optimizer = GoalOptimizer(config)
+        self._notifier = notifier or SelfHealingNotifier(config)
+        self._anomaly_detector = AnomalyDetectorManager(
+            config, self._notifier, facade=self)
+        self.maintenance_reader = InMemoryMaintenanceEventReader()
+        self._wire_detectors()
+
+        self._proposal_cache: tuple[int, float, OptimizerResult] | None = None
+        self._proposal_lock = threading.Lock()
+        self._started = False
+
+    # -- wiring ------------------------------------------------------------
+    def _wire_detectors(self) -> None:
+        cfg, report = self._config, self._anomaly_detector.report
+        interval = cfg.get_long("anomaly.detection.interval.ms")
+        mgr = self._anomaly_detector
+        self.goal_violation_detector = GoalViolationDetector(
+            cfg, self._load_monitor, self._optimizer, report)
+        mgr.add_detector(self.goal_violation_detector, interval)
+        mgr.add_detector(BrokerFailureDetector(
+            self._admin, report,
+            failed_brokers_file_path=cfg.get("failed.brokers.file.path")),
+            interval)
+        mgr.add_detector(DiskFailureDetector(self._admin, report), interval)
+        mgr.add_detector(MetricAnomalyDetector(
+            self._load_monitor.broker_aggregator, report, config=cfg),
+            cfg.get("metric.anomaly.detection.interval.ms") or interval)
+        target_rf = cfg.get("self.healing.target.topic.replication.factor")
+        if target_rf:
+            mgr.add_detector(TopicAnomalyDetector(
+                self._admin, report, cfg, desired_rf=int(target_rf),
+                topic_pattern=cfg.get("topic.anomaly.topic.pattern")), interval)
+        mgr.add_detector(MaintenanceEventDetector(
+            self.maintenance_reader, report), interval)
+
+    def _on_execution_sampling_change(self, executing: bool) -> None:
+        """Executor.java:1408-1424 — reduce sampling scope during moves and
+        RESTORE the prior mode afterwards (a user-initiated pause must
+        survive an execution that completes meanwhile)."""
+        runner = self._load_monitor.task_runner
+        try:
+            if executing:
+                self._sampling_mode_before_execution = runner.sampling_mode
+                runner.set_mode(SamplingMode.ONGOING_EXECUTION,
+                                reason="proposal execution")
+            elif runner.sampling_mode is SamplingMode.ONGOING_EXECUTION:
+                restore = getattr(self, "_sampling_mode_before_execution",
+                                  SamplingMode.RUNNING)
+                if restore is SamplingMode.ONGOING_EXECUTION:
+                    restore = SamplingMode.RUNNING
+                runner.set_mode(restore, reason="execution finished")
+        except Exception:
+            LOG.exception("could not flip sampling mode")
+
+    # -- lifecycle (KafkaCruiseControl.startUp:221) ------------------------
+    def start_up(self, block_on_load: bool = True) -> None:
+        self._load_monitor.start_up(block_on_load=block_on_load)
+        self._anomaly_detector.start_detection()
+        self._started = True
+
+    def shutdown(self) -> None:
+        self._anomaly_detector.shutdown()
+        self._executor.stop_execution()
+        self._load_monitor.shutdown()
+        self._started = False
+
+    # -- collaborators -----------------------------------------------------
+    @property
+    def config(self) -> CruiseControlConfig:
+        return self._config
+
+    @property
+    def load_monitor(self) -> LoadMonitor:
+        return self._load_monitor
+
+    @property
+    def executor(self) -> Executor:
+        return self._executor
+
+    @property
+    def optimizer(self) -> GoalOptimizer:
+        return self._optimizer
+
+    @property
+    def anomaly_detector(self) -> AnomalyDetectorManager:
+        return self._anomaly_detector
+
+    # -- model helpers -----------------------------------------------------
+    def _model(self, requirements: ModelCompletenessRequirements | None = None,
+               ) -> tuple[ClusterTensors, ClusterMeta]:
+        return self._load_monitor.cluster_model(requirements)
+
+    def ready_for_self_healing(self) -> bool:
+        """Completeness gate consulted before anomaly fixes
+        (AnomalyDetectorManager.java:513)."""
+        try:
+            state = self._load_monitor.state()
+        except Exception:
+            return False
+        return state.num_valid_windows >= 1
+
+    def _broker_indices(self, meta: ClusterMeta, broker_ids: Sequence[int],
+                        ) -> list[int]:
+        idx = {bid: i for i, bid in enumerate(meta.broker_ids)}
+        missing = [b for b in broker_ids if b not in idx]
+        if missing:
+            raise ValueError(f"brokers not in cluster model: {missing}")
+        return [idx[b] for b in broker_ids]
+
+    def _mark_brokers(self, state: ClusterTensors, meta: ClusterMeta,
+                      broker_ids: Sequence[int], code: BrokerState,
+                      ) -> ClusterTensors:
+        for i in self._broker_indices(meta, broker_ids):
+            state = set_broker_state(state, np.int32(i), int(code))
+        return state
+
+    def _goal_chain(self, goals: Sequence[str] | None):
+        names = list(goals) if goals else None
+        return goals_by_priority(self._config, names)
+
+    def _maybe_execute(self, result: OptimizerResult, dryrun: bool,
+                       operation: str, reason: str, uuid: str = "") -> bool:
+        if dryrun or not result.proposals:
+            return False
+        OPERATION_LOG.info("%s executing %d proposals (reason: %s)",
+                           operation, len(result.proposals), reason)
+        self._executor.execute_proposals(result.proposals, uuid=uuid)
+        return True
+
+    # -- operations (the runnables) ----------------------------------------
+    def proposals(self, goals: Sequence[str] | None = None,
+                  ignore_proposal_cache: bool = False,
+                  ) -> OperationResult:
+        """ProposalsRunnable — cached when the model generation and the
+        expiration budget allow (GoalOptimizer.validCachedProposal:232)."""
+        expiration_s = self._config.get_long("proposal.expiration.ms") / 1000.0
+        gen = self._load_monitor.model_generation
+        if not ignore_proposal_cache and goals is None:
+            with self._proposal_lock:
+                cached = self._proposal_cache
+                if cached is not None and cached[0] == gen \
+                        and time.time() - cached[1] < expiration_s:
+                    return OperationResult(
+                        "proposals", dryrun=True, optimizer_result=cached[2],
+                        proposals=cached[2].proposals, reason="cached")
+        state, meta = self._model()
+        _final, result = self._optimizer.optimizations(
+            state, meta, self._goal_chain(goals))
+        if goals is None:
+            with self._proposal_lock:
+                self._proposal_cache = (gen, time.time(), result)
+        return OperationResult("proposals", dryrun=True,
+                               optimizer_result=result,
+                               proposals=result.proposals)
+
+    def rebalance(self, goals: Sequence[str] | None = None, dryrun: bool = True,
+                  ignore_proposal_cache: bool = False,
+                  excluded_topics: Sequence[str] = (),
+                  destination_broker_ids: Sequence[int] = (),
+                  is_triggered_by_user_request: bool = True,
+                  reason: str = "", uuid: str = "") -> OperationResult:
+        """RebalanceRunnable.workWithoutClusterModel:115."""
+        del ignore_proposal_cache  # explicit model pass below is always fresh
+        state, meta = self._model()
+        options = OptimizationOptions(
+            excluded_topics=tuple(excluded_topics),
+            requested_destination_broker_ids=tuple(destination_broker_ids),
+            is_triggered_by_goal_violation=not is_triggered_by_user_request)
+        _final, result = self._optimizer.optimizations(
+            state, meta, self._goal_chain(goals), options)
+        executed = self._maybe_execute(result, dryrun, "rebalance", reason, uuid)
+        return OperationResult("rebalance", dryrun, result, result.proposals,
+                               executed, reason)
+
+    def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
+                    goals: Sequence[str] | None = None,
+                    is_triggered_by_user_request: bool = True,
+                    reason: str = "", uuid: str = "") -> OperationResult:
+        """AddBrokersRunnable — mark NEW; the new-broker gate routes load
+        onto them (ResourceDistributionGoal.rebalanceByMovingLoadIn:444)."""
+        state, meta = self._model()
+        state = self._mark_brokers(state, meta, broker_ids, BrokerState.NEW)
+        _final, result = self._optimizer.optimizations(
+            state, meta, self._goal_chain(goals))
+        executed = self._maybe_execute(result, dryrun, "add_broker", reason, uuid)
+        return OperationResult("add_broker", dryrun, result, result.proposals,
+                               executed, reason)
+
+    def remove_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
+                       goals: Sequence[str] | None = None,
+                       is_triggered_by_user_request: bool = True,
+                       reason: str = "", uuid: str = "") -> OperationResult:
+        """RemoveBrokersRunnable — mark DEAD so every replica they host
+        becomes self-healing-eligible and must be relocated."""
+        state, meta = self._model()
+        state = self._mark_brokers(state, meta, broker_ids, BrokerState.DEAD)
+        options = OptimizationOptions(
+            excluded_brokers_for_replica_move=tuple(broker_ids),
+            excluded_brokers_for_leadership=tuple(broker_ids))
+        _final, result = self._optimizer.optimizations(
+            state, meta, self._goal_chain(goals), options)
+        executed = self._maybe_execute(result, dryrun, "remove_broker", reason, uuid)
+        return OperationResult("remove_broker", dryrun, result,
+                               result.proposals, executed, reason)
+
+    def demote_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
+                       is_triggered_by_user_request: bool = True,
+                       reason: str = "", uuid: str = "") -> OperationResult:
+        """DemoteBrokerRunnable — PreferredLeaderElectionGoal with the
+        demoted brokers excluded from leadership."""
+        from .analyzer.goals import PreferredLeaderElectionGoal
+        state, meta = self._model()
+        state = self._mark_brokers(state, meta, broker_ids, BrokerState.DEMOTED)
+        options = OptimizationOptions(
+            excluded_brokers_for_leadership=tuple(broker_ids))
+        _final, result = self._optimizer.optimizations(
+            state, meta, [PreferredLeaderElectionGoal()], options)
+        executed = self._maybe_execute(result, dryrun, "demote_broker", reason, uuid)
+        return OperationResult("demote_broker", dryrun, result,
+                               result.proposals, executed, reason)
+
+    def fix_offline_replicas(self, dryrun: bool = True,
+                             goals: Sequence[str] | None = None,
+                             is_triggered_by_user_request: bool = True,
+                             reason: str = "", uuid: str = "") -> OperationResult:
+        """FixOfflineReplicasRunnable — the model already marks replicas on
+        dead brokers offline; the goal chain must relocate them."""
+        state, meta = self._model()
+        options = OptimizationOptions(only_move_immigrant_replicas=False)
+        _final, result = self._optimizer.optimizations(
+            state, meta, self._goal_chain(goals), options)
+        executed = self._maybe_execute(result, dryrun, "fix_offline_replicas",
+                                       reason, uuid)
+        return OperationResult("fix_offline_replicas", dryrun, result,
+                               result.proposals, executed, reason)
+
+    def update_topic_replication_factor(self, topics: Sequence[str],
+                                        replication_factor: int,
+                                        dryrun: bool = True,
+                                        is_triggered_by_user_request: bool = True,
+                                        reason: str = "", uuid: str = "",
+                                        ) -> OperationResult:
+        """UpdateTopicConfigurationRunnable — grow/shrink each partition's
+        replica list to the target RF (rack-diverse, least-loaded brokers
+        first for growth; drop the most-loaded non-leader for shrink)."""
+        state, meta = self._model()
+        want = set(topics)
+        partitions = self._admin.describe_partitions()
+        alive = self._admin.alive_brokers()
+        counts: dict[int, int] = {b: 0 for b in alive}
+        racks = {bid: meta.rack_names[int(state.rack[i])]
+                 for i, bid in enumerate(meta.broker_ids)}
+        for st in partitions.values():
+            for b in st.replicas:
+                counts[b] = counts.get(b, 0) + 1
+        proposals: list[ExecutionProposal] = []
+        for (topic, part), st in sorted(partitions.items()):
+            if topic not in want or len(st.replicas) == replication_factor:
+                continue
+            old = tuple(st.replicas)
+            leader = st.leader if st.leader is not None and st.leader >= 0 \
+                else (old[0] if old else -1)
+            new = list(old)
+            while len(new) > replication_factor and len(new) > 1:
+                victims = [b for b in new if b != leader] or new[1:]
+                victim = max(victims, key=lambda b: counts.get(b, 0))
+                new.remove(victim)
+                counts[victim] = counts.get(victim, 0) - 1
+            while len(new) < replication_factor:
+                used_racks = {racks.get(b) for b in new}
+                # Growth targets must be alive (a dead broker can appear in
+                # stale replica lists and would otherwise win on count).
+                candidates = [b for b in alive if b not in new]
+                if not candidates:
+                    break
+                fresh = [b for b in candidates if racks.get(b) not in used_racks]
+                pick = min(fresh or candidates, key=lambda b: counts.get(b, 0))
+                new.append(pick)
+                counts[pick] = counts.get(pick, 0) + 1
+            if tuple(new) != old:
+                proposals.append(ExecutionProposal(
+                    topic=topic, partition=part, old_leader=leader,
+                    old_replicas=old, new_replicas=tuple(new),
+                    new_leader=leader))
+        executed = False
+        if proposals and not dryrun:
+            self._executor.execute_proposals(proposals, uuid=uuid)
+            executed = True
+        return OperationResult("topic_configuration", dryrun, None,
+                               tuple(proposals), executed, reason,
+                               extra={"replicationFactor": replication_factor,
+                                      "topics": sorted(want)})
+
+    # -- admin toggles ------------------------------------------------------
+    def pause_metric_sampling(self, reason: str = "") -> None:
+        self._load_monitor.pause_metric_sampling(reason)
+
+    def resume_metric_sampling(self, reason: str = "") -> None:
+        self._load_monitor.resume_metric_sampling(reason)
+
+    def stop_proposal_execution(self) -> None:
+        self._executor.stop_execution()
+
+    # -- state (the STATE endpoint dashboard) -------------------------------
+    def state(self, substates: Sequence[str] = ()) -> dict:
+        want = {s.lower() for s in substates} or \
+            {"monitor", "executor", "analyzer", "anomaly_detector"}
+        out: dict[str, Any] = {}
+        if "monitor" in want:
+            ms = self._load_monitor.state()
+            out["MonitorState"] = {
+                "state": ms.runner_state,
+                "numValidWindows": ms.num_valid_windows,
+                "monitoredWindows": ms.num_valid_windows,
+                "monitoringCoveragePct": round(
+                    100.0 * ms.monitored_partitions_percentage, 3),
+                "totalNumPartitions": ms.total_num_partitions,
+                "numPartitionSamples": ms.num_partition_samples,
+                "modelGeneration": ms.model_generation,
+            }
+        if "executor" in want:
+            out["ExecutorState"] = self._executor.execution_state()
+        if "analyzer" in want:
+            with self._proposal_lock:
+                cached = self._proposal_cache
+            out["AnalyzerState"] = {
+                "isProposalReady": cached is not None,
+                "readyGoals": self._config.get_list("goals"),
+                "balancednessScore":
+                    self.goal_violation_detector.balancedness_score,
+            }
+        if "anomaly_detector" in want:
+            out["AnomalyDetectorState"] = self._anomaly_detector.state()
+        return out

@@ -1,0 +1,293 @@
+"""Detector layer: anomaly taxonomy, notifier escalation, detectors, the
+manager pipeline, and facade self-healing dispatch (reference parity:
+detector/ + notifier/ — AnomalyDetectorManagerTest, SlowBrokerFinderTest,
+BrokerFailureDetectorTest ideas re-expressed against the tensor stack)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+from cruise_control_tpu.detector import (
+    AnomalyDetectorManager, AnomalyStatus, AnomalyType, BrokerFailureDetector,
+    BrokerFailures, DiskFailureDetector, GoalViolations, IdempotenceCache,
+    InMemoryMaintenanceEventReader, MaintenanceEvent, MaintenanceEventDetector,
+    MaintenanceEventType, MetricAnomaly, NoopNotifier,
+    PercentileMetricAnomalyFinder, SelfHealingNotifier,
+    SlackSelfHealingNotifier, SlowBrokerFinder, TopicAnomalyDetector,
+)
+from cruise_control_tpu.detector.notifier import AnomalyNotificationAction
+from cruise_control_tpu.executor.admin import InMemoryAdminBackend, PartitionState
+from cruise_control_tpu.metricdef.kafka_metric_def import (
+    BrokerMetric, CommonMetric, KafkaMetricDef,
+)
+from cruise_control_tpu.monitor.aggregator.aggregator import MetricSampleAggregator
+from cruise_control_tpu.monitor.sampling.samples import BrokerEntity
+
+
+def _partitions(brokers=(0, 1, 2), n=4, rf=2):
+    out = {}
+    for p in range(n):
+        reps = tuple(brokers[(p + i) % len(brokers)] for i in range(rf))
+        out[("t0", p)] = PartitionState("t0", p, reps, reps[0], isr=reps)
+    return out
+
+
+class RecordingFacade:
+    """Captures the self-healing operations an anomaly fix dispatches."""
+
+    def __init__(self):
+        self.calls = []
+
+    def ready_for_self_healing(self):
+        return True
+
+    def __getattr__(self, name):
+        def record(*a, **kw):
+            self.calls.append((name, a, kw))
+        return record
+
+
+# ---- notifier escalation -------------------------------------------------
+
+def test_self_healing_notifier_broker_failure_escalation():
+    now = [1_000_000]
+    cfg = CruiseControlConfig({"self.healing.enabled": True,
+                               "broker.failure.self.healing.threshold.ms": 1000})
+    n = SelfHealingNotifier(cfg, now_ms=lambda: now[0])
+    n._alert_threshold_ms = 500
+    anomaly = BrokerFailures(failed_brokers={7: 1_000_000})
+    # Fresh failure → re-check before alerting.
+    r = n.on_anomaly(anomaly)
+    assert r.action is AnomalyNotificationAction.CHECK and r.delay_ms == 500
+    # Past alert threshold, before fix threshold → alert + re-check.
+    now[0] += 600
+    r = n.on_anomaly(anomaly)
+    assert r.action is AnomalyNotificationAction.CHECK
+    # Past the self-healing threshold → FIX.
+    now[0] += 600
+    assert n.on_anomaly(anomaly).action is AnomalyNotificationAction.FIX
+
+
+def test_self_healing_notifier_respects_per_type_flags():
+    cfg = CruiseControlConfig({"self.healing.enabled": True,
+                               "self.healing.goal.violation.enabled": False})
+    n = SelfHealingNotifier(cfg)
+    r = n.on_anomaly(GoalViolations(fixable_goals=["G"]))
+    assert r.action is AnomalyNotificationAction.IGNORE
+    assert n.set_self_healing_for(AnomalyType.GOAL_VIOLATION, True) is False
+    assert n.on_anomaly(GoalViolations(fixable_goals=["G"])).action \
+        is AnomalyNotificationAction.FIX
+
+
+def test_slack_notifier_posts_payload():
+    posts = []
+    cfg = CruiseControlConfig({"self.healing.enabled": True})
+    n = SlackSelfHealingNotifier(cfg, webhook_url="http://hook",
+                                 http_post=lambda url, payload: posts.append(
+                                     (url, payload)) or 200)
+    n.on_anomaly(GoalViolations(fixable_goals=["RackAwareGoal"]))
+    (url, payload), = posts
+    assert url == "http://hook" and "RackAwareGoal" in payload["text"]
+
+
+# ---- broker failure detector --------------------------------------------
+
+def test_broker_failure_detector_detects_and_persists(tmp_path):
+    path = str(tmp_path / "failed_brokers.json")
+    backend = InMemoryAdminBackend(_partitions().values())
+    seen = []
+    det = BrokerFailureDetector(backend, seen.append, path,
+                                now_ms=lambda: 42_000)
+    assert det.run_once() is None and not seen
+    backend.kill_broker(2)
+    anomaly = det.run_once()
+    assert anomaly.failed_brokers == {2: 42_000}
+    # A fresh detector (restart) remembers the original failure time.
+    det2 = BrokerFailureDetector(backend, seen.append, path,
+                                 now_ms=lambda: 99_000)
+    assert det2.failed_brokers == {2: 42_000}
+    # Revival clears the record.
+    backend.revive_broker(2)
+    assert det2.run_once() is None
+    assert det2.failed_brokers == {}
+
+
+# ---- disk failure detector ----------------------------------------------
+
+def test_disk_failure_detector_reads_logdirs():
+    backend = InMemoryAdminBackend(_partitions().values())
+    backend.describe_logdirs = lambda: {0: {"/d0": True, "/d1": False},
+                                        1: {"/d0": True}}
+    seen = []
+    det = DiskFailureDetector(backend, seen.append)
+    anomaly = det.run_once()
+    assert anomaly.failed_disks == {0: ["/d1"]}
+    # Unchanged offline set is not re-reported.
+    assert det.run_once() is None
+
+
+# ---- metric anomaly finders ---------------------------------------------
+
+def _broker_agg(num_windows=8):
+    return MetricSampleAggregator(
+        num_windows=num_windows, window_ms=1000, min_samples_per_window=1,
+        metric_def=KafkaMetricDef.broker_metric_def())
+
+
+def _fill_broker_windows(agg, values_by_broker, windows=7):
+    # One extra window past the spike: the aggregator only reports STABLE
+    # windows (the in-fill current window is excluded, reference semantics),
+    # so the last series value must land in a stable window.
+    mdef = KafkaMetricDef.broker_metric_def()
+    m = mdef.num_metrics
+    flush = mdef.metric_info(BrokerMetric.BROKER_LOG_FLUSH_TIME_MS_999TH.name).id
+    bytes_in = mdef.metric_info(CommonMetric.LEADER_BYTES_IN.name).id
+    for w in range(windows):
+        for b, (flush_series, bin_rate) in values_by_broker.items():
+            row = np.full(m, 1.0)
+            row[flush] = flush_series[min(w, len(flush_series) - 1)]
+            row[bytes_in] = bin_rate
+            agg.add_sample(BrokerEntity(b), w * 1000 + 500, row)
+
+
+def test_percentile_finder_flags_latest_window_outlier():
+    agg = _broker_agg()
+    # Broker 0 spikes in the latest window; broker 1 stays flat.
+    _fill_broker_windows(agg, {0: ([10, 10, 10, 10, 10, 500], 1e5),
+                               1: ([10] * 6, 1e5)})
+    finder = PercentileMetricAnomalyFinder(CruiseControlConfig())
+    anomalies = finder.find_anomalies(agg)
+    assert any(a.broker_ids == [0] and "above" in a.description
+               for a in anomalies)
+    assert not any(a.broker_ids == [1] for a in anomalies)
+
+
+def test_slow_broker_finder_escalates_demote_then_remove():
+    finder = SlowBrokerFinder(CruiseControlConfig(), demote_score=2,
+                              removal_score=4)
+    demoted = removed = False
+    for _round in range(6):
+        agg = _broker_agg()
+        _fill_broker_windows(agg, {0: ([10, 10, 10, 10, 10, 900], 1e6),
+                                   1: ([10] * 6, 1e6),
+                                   2: ([10] * 6, 1e6)})
+        for a in finder.find_anomalies(agg):
+            if a.fix_by_removal:
+                removed = True
+                assert a.broker_ids == [0]
+            else:
+                demoted = True
+                assert a.broker_ids == [0]
+    assert demoted and removed
+
+
+# ---- topic anomaly -------------------------------------------------------
+
+def test_topic_rf_anomaly_finder():
+    backend = InMemoryAdminBackend(_partitions(rf=2).values())
+    seen = []
+    det = TopicAnomalyDetector(backend, seen.append, desired_rf=3)
+    anomaly = det.run_once()
+    assert anomaly.topics_by_desired_rf == {3: ["t0"]}
+
+
+# ---- maintenance events --------------------------------------------------
+
+def test_maintenance_event_idempotence_and_dispatch():
+    reader = InMemoryMaintenanceEventReader()
+    seen = []
+    det = MaintenanceEventDetector(reader, seen.append)
+    ev = MaintenanceEvent(event_type=MaintenanceEventType.REMOVE_BROKER,
+                          broker_ids=[3])
+    reader.submit(ev)
+    reader.submit(MaintenanceEvent(
+        event_type=MaintenanceEventType.REMOVE_BROKER, broker_ids=[3]))
+    assert len(det.run_once()) == 1          # duplicate dropped
+    facade = RecordingFacade()
+    assert ev.fix(facade)
+    (name, args, _kw), = facade.calls
+    assert name == "remove_brokers" and args[0] == [3]
+
+
+def test_idempotence_cache_expires():
+    now = [0]
+    cache = IdempotenceCache(retention_ms=100, now_ms=lambda: now[0])
+    e = MaintenanceEvent(event_type=MaintenanceEventType.REBALANCE)
+    assert not cache.is_duplicate(e)
+    assert cache.is_duplicate(e)
+    now[0] = 500
+    assert not cache.is_duplicate(e)
+
+
+# ---- anomaly fix dispatch ------------------------------------------------
+
+def test_anomaly_fixes_dispatch_to_facade_methods():
+    facade = RecordingFacade()
+    BrokerFailures(failed_brokers={1: 0}).fix(facade)
+    GoalViolations(fixable_goals=["G"]).fix(facade)
+    MetricAnomaly(broker_ids=[2], fix_by_removal=False).fix(facade)
+    names = [c[0] for c in facade.calls]
+    assert names == ["remove_brokers", "rebalance", "demote_brokers"]
+
+
+# ---- manager pipeline ----------------------------------------------------
+
+def test_manager_priority_order_and_fix_pipeline():
+    facade = RecordingFacade()
+    cfg = CruiseControlConfig({"self.healing.enabled": True,
+                               "broker.failure.self.healing.threshold.ms": 0})
+    notifier = SelfHealingNotifier(cfg)
+    notifier._alert_threshold_ms = 0
+    mgr = AnomalyDetectorManager(cfg, notifier, facade=facade)
+    # Goal violation reported first, broker failure second — broker failure
+    # has higher priority and must be handled first.
+    mgr.report(GoalViolations(fixable_goals=["G"]))
+    mgr.report(BrokerFailures(failed_brokers={5: 0}))
+    first = mgr._take(timeout_s=0.1)
+    assert isinstance(first, BrokerFailures)
+    assert mgr.handle_anomaly(first) == AnomalyStatus.FIX_STARTED
+    second = mgr._take(timeout_s=0.1)
+    assert isinstance(second, GoalViolations)
+    assert mgr.handle_anomaly(second) == AnomalyStatus.FIX_STARTED
+    assert [c[0] for c in facade.calls] == ["remove_brokers", "rebalance"]
+    st = mgr.state()
+    assert st["metrics"]["numSelfHealingStarted"] == 2
+    assert {r["status"] for r in st["recentAnomalies"]} == {AnomalyStatus.FIX_STARTED}
+
+
+def test_manager_check_with_delay_requeues():
+    cfg = CruiseControlConfig({"self.healing.enabled": True,
+                               "broker.failure.self.healing.threshold.ms": 10_000})
+    notifier = SelfHealingNotifier(cfg)
+    mgr = AnomalyDetectorManager(cfg, notifier, facade=RecordingFacade())
+    anomaly = BrokerFailures(failed_brokers={1: int(time.time() * 1000)})
+    mgr.report(anomaly)
+    taken = mgr._take(timeout_s=0.1)
+    assert mgr.handle_anomaly(taken) == AnomalyStatus.CHECK_WITH_DELAY
+    # The recheck is scheduled in the future, so an immediate take times out.
+    assert mgr._take(timeout_s=0.05) is None
+    assert len(mgr._recheck) == 1
+
+
+def test_manager_runs_detector_threads():
+    cfg = CruiseControlConfig({"self.healing.enabled": True})
+
+    class TickDetector:
+        def __init__(self, report):
+            self.report = report
+
+        def run_once(self):
+            self.report(GoalViolations(fixable_goals=["G"]))
+
+    mgr = AnomalyDetectorManager(cfg, NoopNotifier(), facade=RecordingFacade())
+    mgr.add_detector(TickDetector(mgr.report), interval_ms=20)
+    mgr.start_detection()
+    try:
+        deadline = time.time() + 3
+        while time.time() < deadline and not mgr.state()["recentAnomalies"]:
+            time.sleep(0.02)
+    finally:
+        mgr.shutdown()
+    assert mgr.state()["recentAnomalies"]
