@@ -1,0 +1,23 @@
+"""REST API layer (reference: servlet/ + vertx/ — 23 endpoints, async user
+tasks, two-step review purgatory, pluggable security)."""
+
+from .endpoints import EndPoint, Role, endpoint_for_path
+from .purgatory import Purgatory, RequestInfo, ReviewStatus
+from .security import (
+    AuthenticationError, AuthorizationError, BasicSecurityProvider,
+    JwtSecurityProvider, NoopSecurityProvider, Principal,
+    PrincipalValidatorSecurityProvider, SecurityProvider,
+    TrustedProxySecurityProvider, decode_jwt, encode_jwt,
+)
+from .server import CruiseControlApi, make_server, serve_forever_in_thread
+from .user_tasks import USER_TASK_HEADER, UserTaskInfo, UserTaskManager
+
+__all__ = [
+    "EndPoint", "Role", "endpoint_for_path", "Purgatory", "RequestInfo",
+    "ReviewStatus", "AuthenticationError", "AuthorizationError",
+    "BasicSecurityProvider", "JwtSecurityProvider", "NoopSecurityProvider",
+    "Principal", "PrincipalValidatorSecurityProvider", "SecurityProvider",
+    "TrustedProxySecurityProvider", "decode_jwt", "encode_jwt",
+    "CruiseControlApi", "make_server", "serve_forever_in_thread",
+    "USER_TASK_HEADER", "UserTaskInfo", "UserTaskManager",
+]

@@ -1,0 +1,106 @@
+"""Application bootstrap: ``python -m cruise_control_tpu.api.app``.
+
+Reference parity: KafkaCruiseControlMain.java:26 (main(config,[port],[host]))
++ KafkaCruiseControlApp/KafkaCruiseControlServletApp — build the facade from
+a properties file, start monitor + detectors, serve REST until interrupted.
+
+Without --properties the app runs against a synthetic in-memory cluster
+(the demo/dev mode; the reference needs a live Kafka for the same tour).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+
+from ..config.cruise_control_config import CruiseControlConfig
+from ..facade import CruiseControl
+from .server import make_server, serve_forever_in_thread
+
+LOG = logging.getLogger(__name__)
+
+
+def load_properties(path: str) -> dict:
+    """Java .properties subset: key=value lines, # comments."""
+    out: dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith(("#", "!")):
+                continue
+            key, _, value = line.partition("=")
+            out[key.strip()] = value.strip()
+    return out
+
+
+def build_demo_cruise_control(cfg: CruiseControlConfig) -> CruiseControl:
+    from ..common.resources import Resource
+    from ..executor.admin import InMemoryAdminBackend, PartitionState
+    from ..monitor import LoadMonitor, StaticCapacityResolver
+    from ..monitor.sampling import SyntheticSampler
+
+    parts = {}
+    for t in range(4):
+        for p in range(8):
+            reps = (0, 1 + (t + p) % 3)
+            parts[(f"demo{t}", p)] = PartitionState(f"demo{t}", p, reps,
+                                                    reps[0], isr=reps)
+    backend = InMemoryAdminBackend(parts.values())
+    caps = StaticCapacityResolver({}, {Resource.CPU: 100.0, Resource.DISK: 1e7,
+                                       Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6})
+    monitor = LoadMonitor(cfg, backend, samplers=[SyntheticSampler()],
+                          capacity_resolver=caps)
+    return CruiseControl(cfg, backend, load_monitor=monitor)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="cruise-control-tpu")
+    parser.add_argument("--properties", help="config properties file")
+    parser.add_argument("--port", type=int, help="REST port override")
+    parser.add_argument("--host", help="bind address override")
+    parser.add_argument("--demo", action="store_true",
+                        help="synthetic in-memory cluster (default when no "
+                        "--properties is given)")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s "
+                        "%(levelname)s %(message)s")
+    overrides = load_properties(args.properties) if args.properties else {}
+    cfg = CruiseControlConfig(overrides)
+    if overrides.get("bootstrap.servers") and not args.demo:
+        # Honest failure over a silent fake: this build ships the in-memory
+        # backend only (a live-Kafka AdminBackend is a deployment add-on);
+        # pass --demo to run the synthetic cluster with these tunables.
+        parser.error("bootstrap.servers is set but no live-Kafka backend is "
+                     "available in this build; pass --demo to run the "
+                     "synthetic in-memory cluster with this config")
+    cc = build_demo_cruise_control(cfg)
+    cc.start_up(block_on_load=False)
+
+    server, api = make_server(cc, host=args.host, port=args.port)
+    thread = serve_forever_in_thread(server)
+    host, port = server.server_address[:2]
+    LOG.info("cruise-control-tpu listening on http://%s:%s/kafkacruisecontrol/state",
+             host, port)
+
+    stop = {"flag": False}
+
+    def _sigterm(_sig, _frm):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, _sigterm)
+    signal.signal(signal.SIGTERM, _sigterm)
+    try:
+        while not stop["flag"] and thread.is_alive():
+            thread.join(timeout=0.5)
+    finally:
+        server.shutdown()
+        api.shutdown()
+        cc.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""The REST endpoint taxonomy.
+
+Reference parity: servlet/CruiseControlEndPoint.java:17-39 — the 23
+endpoints with their HTTP methods, plus the VIEWER/USER/ADMIN role ladder
+(security/UserPermissionsManager): VIEWER reads state, USER runs dry-run
+analysis, ADMIN mutates the cluster.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Role(enum.IntEnum):
+    VIEWER = 0
+    USER = 1
+    ADMIN = 2
+
+
+class EndPoint(enum.Enum):
+    """Value = (ordinal, method, role); the ordinal keeps members with the
+    same (method, role) pair from aliasing."""
+
+    # GET endpoints (CruiseControlEndPoint.java:18-28)
+    BOOTSTRAP = (0, "GET", Role.USER)
+    TRAIN = (1, "GET", Role.USER)
+    LOAD = (2, "GET", Role.USER)
+    PARTITION_LOAD = (3, "GET", Role.USER)
+    PROPOSALS = (4, "GET", Role.USER)
+    STATE = (5, "GET", Role.VIEWER)
+    KAFKA_CLUSTER_STATE = (6, "GET", Role.VIEWER)
+    USER_TASKS = (7, "GET", Role.USER)
+    REVIEW_BOARD = (8, "GET", Role.USER)
+    PERMISSIONS = (9, "GET", Role.VIEWER)
+    # POST endpoints (:29-39)
+    ADD_BROKER = (10, "POST", Role.ADMIN)
+    REMOVE_BROKER = (11, "POST", Role.ADMIN)
+    FIX_OFFLINE_REPLICAS = (12, "POST", Role.ADMIN)
+    REBALANCE = (13, "POST", Role.ADMIN)
+    STOP_PROPOSAL_EXECUTION = (14, "POST", Role.ADMIN)
+    PAUSE_SAMPLING = (15, "POST", Role.ADMIN)
+    RESUME_SAMPLING = (16, "POST", Role.ADMIN)
+    DEMOTE_BROKER = (17, "POST", Role.ADMIN)
+    ADMIN = (18, "POST", Role.ADMIN)
+    REVIEW = (19, "POST", Role.ADMIN)
+    TOPIC_CONFIGURATION = (20, "POST", Role.ADMIN)
+    RIGHTSIZE = (21, "POST", Role.ADMIN)
+    REMOVE_DISKS = (22, "POST", Role.ADMIN)
+
+    @property
+    def method(self) -> str:
+        return self.value[1]
+
+    @property
+    def required_role(self) -> Role:
+        return self.value[2]
+
+    @property
+    def path(self) -> str:
+        return self.name.lower()
+
+
+GET_ENDPOINTS = tuple(e for e in EndPoint if e.method == "GET")
+POST_ENDPOINTS = tuple(e for e in EndPoint if e.method == "POST")
+
+# POST endpoints subject to two-step review when the purgatory is enabled
+# (Purgatory.java — GET endpoints and REVIEW itself are exempt).
+REVIEWABLE_ENDPOINTS = tuple(e for e in POST_ENDPOINTS if e is not EndPoint.REVIEW)
+
+
+def endpoint_for_path(path: str) -> EndPoint | None:
+    try:
+        return EndPoint[path.strip("/").upper()]
+    except KeyError:
+        return None

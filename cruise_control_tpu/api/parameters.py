@@ -1,0 +1,157 @@
+"""Query-parameter schemas + typed parsing.
+
+Reference parity: servlet/parameters/ (one class per endpoint, ~15-25
+params each) and ParameterUtils.java (central parsing). Collapsed to a
+declarative schema per endpoint: name → coercion, with unknown-parameter
+rejection exactly like ParameterUtils' UserRequestException.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+from .endpoints import EndPoint
+
+
+class ParameterParseError(ValueError):
+    """Maps to HTTP 400 (UserRequestException)."""
+
+
+def _bool(v: str) -> bool:
+    if v.lower() in ("true", "1", "yes"):
+        return True
+    if v.lower() in ("false", "0", "no"):
+        return False
+    raise ParameterParseError(f"not a boolean: {v!r}")
+
+
+def _int(v: str) -> int:
+    try:
+        return int(v)
+    except ValueError:
+        raise ParameterParseError(f"not an integer: {v!r}")
+
+
+def _long_ms(v: str) -> int:
+    return _int(v)
+
+
+def _str(v: str) -> str:
+    return v
+
+
+def _csv(v: str) -> tuple[str, ...]:
+    return tuple(x for x in (s.strip() for s in v.split(",")) if x)
+
+
+def _int_csv(v: str) -> tuple[int, ...]:
+    return tuple(_int(x) for x in _csv(v))
+
+
+def _broker_logdir_csv(v: str) -> dict[int, tuple[str, ...]]:
+    """REMOVE_DISKS brokerid_and_logdirs: ``brokerid-logdir`` pairs."""
+    out: dict[int, list[str]] = {}
+    for item in _csv(v):
+        broker, sep, logdir = item.partition("-")
+        if not sep:
+            raise ParameterParseError(
+                f"expected brokerid-logdir pair, got {item!r}")
+        out.setdefault(_int(broker), []).append(logdir)
+    return {b: tuple(d) for b, d in out.items()}
+
+
+_COMMON: dict[str, Callable[[str], Any]] = {
+    "json": _bool, "verbose": _bool, "get_response_schema": _bool,
+    "doas": _str, "reason": _str,
+}
+
+_GOALS_PARAMS = {"goals": _csv, "allow_capacity_estimation": _bool,
+                 "exclude_recently_demoted_brokers": _bool,
+                 "exclude_recently_removed_brokers": _bool,
+                 "use_ready_default_goals": _bool, "fast_mode": _bool}
+
+_PROPOSAL_PARAMS = {**_GOALS_PARAMS, "ignore_proposal_cache": _bool,
+                    "data_from": _str, "excluded_topics": _csv,
+                    "kafka_assigner": _bool, "rebalance_disk": _bool}
+
+_EXECUTION_PARAMS = {
+    "dryrun": _bool, "concurrent_partition_movements_per_broker": _int,
+    "concurrent_intra_broker_partition_movements": _int,
+    "concurrent_leader_movements": _int, "execution_progress_check_interval_ms": _long_ms,
+    "skip_hard_goal_check": _bool, "replication_throttle": _int,
+    "replica_movement_strategies": _csv, "review_id": _int,
+    "stop_ongoing_execution": _bool}
+
+SCHEMAS: dict[EndPoint, dict[str, Callable[[str], Any]]] = {
+    EndPoint.BOOTSTRAP: {"start": _long_ms, "end": _long_ms, "clearmetrics": _bool},
+    EndPoint.TRAIN: {"start": _long_ms, "end": _long_ms},
+    EndPoint.LOAD: {"time": _long_ms, "start": _long_ms, "end": _long_ms,
+                    "allow_capacity_estimation": _bool, "populate_disk_info": _bool,
+                    "capacity_only": _bool},
+    EndPoint.PARTITION_LOAD: {"resource": _str, "start": _long_ms, "end": _long_ms,
+                              "entries": _int, "max_load": _bool, "avg_load": _bool,
+                              "topic": _str, "partition": _str,
+                              "min_valid_partition_ratio": _str,
+                              "allow_capacity_estimation": _bool,
+                              "brokerid": _int_csv},
+    EndPoint.PROPOSALS: _PROPOSAL_PARAMS,
+    EndPoint.STATE: {"substates": _csv, "super_verbose": _bool},
+    EndPoint.KAFKA_CLUSTER_STATE: {"topic": _str},
+    EndPoint.USER_TASKS: {"user_task_ids": _csv, "client_ids": _csv,
+                          "endpoints": _csv, "types": _csv, "entries": _int,
+                          "fetch_completed_task": _bool},
+    EndPoint.REVIEW_BOARD: {"review_ids": _int_csv},
+    EndPoint.PERMISSIONS: {},
+    EndPoint.ADD_BROKER: {**_PROPOSAL_PARAMS, **_EXECUTION_PARAMS,
+                          "brokerid": _int_csv, "throttle_added_broker": _bool},
+    EndPoint.REMOVE_BROKER: {**_PROPOSAL_PARAMS, **_EXECUTION_PARAMS,
+                             "brokerid": _int_csv, "throttle_removed_broker": _bool,
+                             "destination_broker_ids": _int_csv},
+    EndPoint.FIX_OFFLINE_REPLICAS: {**_PROPOSAL_PARAMS, **_EXECUTION_PARAMS},
+    EndPoint.REBALANCE: {**_PROPOSAL_PARAMS, **_EXECUTION_PARAMS,
+                         "destination_broker_ids": _int_csv,
+                         "ignore_proposal_cache": _bool},
+    EndPoint.STOP_PROPOSAL_EXECUTION: {"force_stop": _bool, "review_id": _int},
+    EndPoint.PAUSE_SAMPLING: {"review_id": _int},
+    EndPoint.RESUME_SAMPLING: {"review_id": _int},
+    EndPoint.DEMOTE_BROKER: {**_EXECUTION_PARAMS, "brokerid": _int_csv,
+                             "skip_urp_demotion": _bool,
+                             "exclude_follower_demotion": _bool},
+    EndPoint.ADMIN: {"disable_self_healing_for": _csv,
+                     "enable_self_healing_for": _csv,
+                     "concurrent_partition_movements_per_broker": _int,
+                     "concurrent_intra_broker_partition_movements": _int,
+                     "concurrent_leader_movements": _int,
+                     "drop_recently_removed_brokers": _int_csv,
+                     "drop_recently_demoted_brokers": _int_csv,
+                     "review_id": _int},
+    EndPoint.REVIEW: {"approve": _int_csv, "discard": _int_csv},
+    EndPoint.TOPIC_CONFIGURATION: {**_EXECUTION_PARAMS, "topic": _str,
+                                   "replication_factor": _int},
+    EndPoint.RIGHTSIZE: {"numbrokerstoadd": _int, "partition_count": _int,
+                         "topic": _str, "review_id": _int},
+    EndPoint.REMOVE_DISKS: {**_EXECUTION_PARAMS,
+                            "brokerid_and_logdirs": _broker_logdir_csv},
+}
+
+
+def parse_parameters(endpoint: EndPoint, query: Mapping[str, list[str]],
+                     ) -> dict[str, Any]:
+    """Coerce a parsed query string; rejects unknown parameters
+    (ParameterUtils semantics: a typo must not silently no-op)."""
+    schema = {**_COMMON, **SCHEMAS[endpoint]}
+    out: dict[str, Any] = {}
+    for name, values in query.items():
+        key = name.lower()
+        if key not in schema:
+            raise ParameterParseError(
+                f"unknown parameter {name!r} for {endpoint.name}")
+        if not values:
+            continue
+        try:
+            out[key] = schema[key](values[-1])
+        except ParameterParseError:
+            raise
+        except Exception as e:
+            raise ParameterParseError(f"bad value for {name}: {e}")
+    return out

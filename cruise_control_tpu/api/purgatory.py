@@ -1,0 +1,112 @@
+"""Two-step review purgatory.
+
+Reference parity: servlet/purgatory/Purgatory.java:42 + RequestInfo /
+ReviewStatus — when ``two.step.verification.enabled``, POST requests are
+parked PENDING_REVIEW; a reviewer approves or discards them via the REVIEW
+endpoint, and an approved request is submitted by re-issuing it with its
+``review_id``.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+class ReviewStatus(enum.Enum):
+    PENDING_REVIEW = "PENDING_REVIEW"
+    APPROVED = "APPROVED"
+    SUBMITTED = "SUBMITTED"
+    DISCARDED = "DISCARDED"
+
+
+_VALID_TRANSITIONS = {
+    ReviewStatus.PENDING_REVIEW: {ReviewStatus.APPROVED, ReviewStatus.DISCARDED},
+    ReviewStatus.APPROVED: {ReviewStatus.SUBMITTED, ReviewStatus.DISCARDED},
+    ReviewStatus.SUBMITTED: set(),
+    ReviewStatus.DISCARDED: set(),
+}
+
+
+@dataclass
+class RequestInfo:
+    review_id: int
+    endpoint: str
+    query: str
+    submitter: str = ""
+    status: ReviewStatus = ReviewStatus.PENDING_REVIEW
+    reason: str = ""
+    submission_time_ms: int = field(
+        default_factory=lambda: int(time.time() * 1000))
+
+    def to_dict(self) -> dict:
+        return {"Id": self.review_id, "EndPoint": self.endpoint,
+                "Query": self.query, "Submitter": self.submitter,
+                "Status": self.status.value, "Reason": self.reason,
+                "SubmissionTimeMs": self.submission_time_ms}
+
+
+class Purgatory:
+    def __init__(self, retention_ms: int = 86_400_000):
+        self._lock = threading.Lock()
+        self._requests: dict[int, RequestInfo] = {}
+        self._seq = itertools.count()
+        self._retention_ms = retention_ms
+
+    def add(self, endpoint: str, query: str, submitter: str = "") -> RequestInfo:
+        with self._lock:
+            self._expire_locked()
+            info = RequestInfo(next(self._seq), endpoint, query, submitter)
+            self._requests[info.review_id] = info
+            return info
+
+    def _expire_locked(self) -> None:
+        now = int(time.time() * 1000)
+        for rid in [r for r, info in self._requests.items()
+                    if now - info.submission_time_ms > self._retention_ms]:
+            del self._requests[rid]
+
+    def _transition(self, review_id: int, to: ReviewStatus,
+                    reason: str) -> RequestInfo:
+        with self._lock:
+            info = self._requests.get(review_id)
+            if info is None:
+                raise KeyError(f"unknown review id {review_id}")
+            if to not in _VALID_TRANSITIONS[info.status]:
+                raise ValueError(
+                    f"invalid transition {info.status.value} -> {to.value}")
+            info.status = to
+            if reason:
+                info.reason = reason
+            return info
+
+    def approve(self, review_id: int, reason: str = "") -> RequestInfo:
+        return self._transition(review_id, ReviewStatus.APPROVED, reason)
+
+    def discard(self, review_id: int, reason: str = "") -> RequestInfo:
+        return self._transition(review_id, ReviewStatus.DISCARDED, reason)
+
+    def submit(self, review_id: int, endpoint: str) -> RequestInfo:
+        """Claim an APPROVED request for execution; validates the endpoint
+        matches what was reviewed."""
+        with self._lock:
+            info = self._requests.get(review_id)
+            if info is None:
+                raise KeyError(f"unknown review id {review_id}")
+            if info.endpoint != endpoint:
+                raise ValueError(
+                    f"review {review_id} is for {info.endpoint}, not {endpoint}")
+            if info.status is not ReviewStatus.APPROVED:
+                raise ValueError(
+                    f"review {review_id} is {info.status.value}, not APPROVED")
+            info.status = ReviewStatus.SUBMITTED
+            return info
+
+    def review_board(self) -> list[dict]:
+        with self._lock:
+            self._expire_locked()
+            return [info.to_dict() for info in
+                    sorted(self._requests.values(), key=lambda r: r.review_id)]

@@ -1,0 +1,166 @@
+"""Response writers.
+
+Reference parity: servlet/response/ (ResponseUtils version envelope,
+BrokerStats for LOAD, PartitionLoadState for PARTITION_LOAD,
+ClusterBrokerState for KAFKA_CLUSTER_STATE, OptimizationResult for
+proposal-bearing endpoints). All JSON; the reference's plaintext variants
+are served by the same dicts pretty-printed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..analyzer.optimizer import OptimizerResult
+from ..common.resources import Resource
+from ..executor.admin import AdminBackend
+from ..facade import OperationResult
+from ..model.tensors import (
+    ClusterMeta, ClusterTensors, broker_leader_counts, broker_load,
+    broker_replica_counts, potential_nw_out, replica_load,
+)
+
+JSON_VERSION = 1
+
+
+def envelope(payload: dict) -> dict:
+    return {"version": JSON_VERSION, **payload}
+
+
+def broker_stats(state: ClusterTensors, meta: ClusterMeta) -> dict:
+    """LOAD endpoint body (response/stats/BrokerStats.java)."""
+    loads = np.asarray(broker_load(state), dtype=np.float64)       # [B, R]
+    caps = np.asarray(state.capacity, dtype=np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        pct = np.where(caps > 0, 100.0 * loads / caps, 0.0)
+    replicas = np.asarray(broker_replica_counts(state))
+    leaders = np.asarray(broker_leader_counts(state))
+    pnw = np.asarray(potential_nw_out(state))
+    states = np.asarray(state.broker_state)
+    racks = np.asarray(state.rack)
+    mask = np.asarray(state.broker_mask)
+    from ..common.broker_state import BrokerState
+    rows = []
+    for i, bid in enumerate(meta.broker_ids):
+        if not mask[i]:
+            continue
+        rows.append({
+            "Broker": bid,
+            "BrokerState": BrokerState(int(states[i])).name,
+            "Rack": meta.rack_names[int(racks[i])],
+            "DiskMB": round(float(loads[i, Resource.DISK]), 3),
+            "DiskPct": round(float(pct[i, Resource.DISK]), 3),
+            "CpuPct": round(float(loads[i, Resource.CPU]), 3),
+            "NwInRate": round(float(loads[i, Resource.NW_IN]), 3),
+            "NwOutRate": round(float(loads[i, Resource.NW_OUT]), 3),
+            "PnwOutRate": round(float(pnw[i]), 3),
+            "Replicas": int(replicas[i]),
+            "Leaders": int(leaders[i]),
+        })
+    return envelope({"brokers": rows, "hosts": []})
+
+
+def partition_load(state: ClusterTensors, meta: ClusterMeta,
+                   resource: str = "DISK", entries: int | None = None,
+                   max_load: bool = False) -> dict:
+    """PARTITION_LOAD body: partitions sorted by the requested resource,
+    heaviest first (PartitionLoadState.java)."""
+    aliases = {"NETWORK_INBOUND": "NW_IN", "NETWORK_OUTBOUND": "NW_OUT"}
+    name = resource.upper()
+    try:
+        res = Resource[aliases.get(name, name)]
+    except KeyError:
+        from .parameters import ParameterParseError
+        raise ParameterParseError(f"unknown resource {resource!r}")
+    per_slot = np.asarray(replica_load(state))          # [P, S, R]
+    mask = np.asarray(state.partition_mask)
+    leader_loads = np.asarray(state.leader_load)
+    order = np.argsort(-leader_loads[:, res] * mask)
+    assignment = np.asarray(state.assignment)
+    leader_slot = np.asarray(state.leader_slot)
+    records = []
+    for p in order[: entries or len(order)]:
+        if not mask[p]:
+            continue
+        topic, part = meta.partition_index[int(p)]
+        ls = int(leader_slot[p])
+        leader_b = int(assignment[p, ls]) if 0 <= ls < assignment.shape[1] else -1
+        followers = [int(meta.broker_ids[b]) for s, b in enumerate(assignment[p])
+                     if b >= 0 and s != ls]
+        records.append({
+            "topic": topic, "partition": part,
+            "leader": meta.broker_ids[leader_b] if leader_b >= 0 else -1,
+            "followers": followers,
+            "cpu": round(float(per_slot[p, :, Resource.CPU].sum()), 5),
+            "disk": round(float(per_slot[p, :, Resource.DISK].sum()), 3),
+            "networkInbound": round(float(per_slot[p, :, Resource.NW_IN].sum()), 3),
+            "networkOutbound": round(float(per_slot[p, :, Resource.NW_OUT].sum()), 3),
+        })
+    return envelope({"records": records})
+
+
+def kafka_cluster_state(admin: AdminBackend, topic_filter: str = "") -> dict:
+    """KAFKA_CLUSTER_STATE body (response/ClusterBrokerState.java): replica
+    counts per broker + per-partition detail with URP/offline accounting."""
+    parts = admin.describe_partitions()
+    alive = admin.alive_brokers()
+    replica_count: dict[int, int] = {}
+    leader_count: dict[int, int] = {}
+    out_of_sync: dict[str, list[int]] = {}
+    offline: dict[str, list[int]] = {}
+    partitions = []
+    for (topic, p), st in sorted(parts.items()):
+        if topic_filter and topic != topic_filter:
+            continue
+        for b in st.replicas:
+            replica_count[b] = replica_count.get(b, 0) + 1
+        if st.leader >= 0:
+            leader_count[st.leader] = leader_count.get(st.leader, 0) + 1
+        osr = [b for b in st.replicas if b not in st.isr]
+        off = [b for b in st.replicas if b not in alive]
+        key = f"{topic}-{p}"
+        if osr:
+            out_of_sync[key] = osr
+        if off:
+            offline[key] = off
+        partitions.append({"topic": topic, "partition": p,
+                           "leader": st.leader, "replicas": list(st.replicas),
+                           "in-sync": list(st.isr), "out-of-sync": osr,
+                           "offline": off})
+    return envelope({
+        "KafkaBrokerState": {
+            "ReplicaCountByBrokerId": {str(b): c for b, c in sorted(replica_count.items())},
+            "LeaderCountByBrokerId": {str(b): c for b, c in sorted(leader_count.items())},
+            "OfflineReplicaCountByBrokerId": {},
+            "IsController": {},
+        },
+        "KafkaPartitionState": {
+            "offline": offline, "urp": out_of_sync,
+            "with-offline-replicas": sorted(offline),
+            "under-min-isr": [],
+        },
+        "partitions": partitions,
+    })
+
+
+def optimization_result(op: OperationResult) -> dict:
+    """Proposal-bearing POST/GET body (response/OptimizationResult.java:191)."""
+    body: dict = {"operation": op.operation, "dryrun": op.dryrun,
+                  "executed": op.executed}
+    r: OptimizerResult | None = op.optimizer_result
+    if r is not None:
+        s = r.summary()
+        body["summary"] = s
+        body["goalSummary"] = [
+            {"goal": g.name, "status": "FIXED" if g.succeeded else "VIOLATED",
+             "optimizationTimeMs": round(1000 * g.duration_s, 1)}
+            for g in r.goal_results]
+    body["proposals"] = [
+        {"topicPartition": {"topic": p.topic, "partition": p.partition},
+         "oldLeader": p.old_leader,
+         "oldReplicas": list(p.old_replicas),
+         "newReplicas": list(p.new_replicas),
+         "newLeader": p.new_leader}
+        for p in op.proposals]
+    body.update(op.extra)
+    return envelope(body)

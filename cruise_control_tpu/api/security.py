@@ -1,0 +1,218 @@
+"""Pluggable REST security.
+
+Reference parity: cruise-control servlet/security/ — SecurityProvider SPI,
+BasicSecurityProvider (file-based users with VIEWER/USER/ADMIN roles),
+JwtAuthenticator (security/jwt/JwtAuthenticator.java:51, token validation +
+role mapping; implemented here as stdlib HMAC-SHA256 JWS, no external jose
+dependency), TrustedProxySecurityProvider
+(security/trustedproxy/TrustedProxySecurityProvider.java:23 — authenticate
+the proxy, trust its ``doAs`` user), and SPNEGO's principal-mapping shape
+(spnego/SpnegoSecurityProvider.java:21) behind a pluggable validator since
+no KDC exists in this environment.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import hashlib
+import hmac
+import json
+import time
+from dataclasses import dataclass
+from typing import Callable, Mapping
+
+from .endpoints import EndPoint, Role
+
+
+@dataclass(frozen=True)
+class Principal:
+    name: str
+    role: Role
+
+
+class AuthenticationError(Exception):
+    """401 — missing/invalid credentials."""
+
+
+class AuthorizationError(Exception):
+    """403 — authenticated but role below the endpoint's requirement."""
+
+
+class SecurityProvider:
+    """SPI: turn request headers into a Principal (or raise)."""
+
+    def authenticate(self, headers: Mapping[str, str],
+                     remote_addr: str = "") -> Principal:
+        raise NotImplementedError
+
+    def authorize(self, principal: Principal, endpoint: EndPoint) -> None:
+        if principal.role < endpoint.required_role:
+            raise AuthorizationError(
+                f"{principal.name} (role {principal.role.name}) may not call "
+                f"{endpoint.name} (requires {endpoint.required_role.name})")
+
+
+class NoopSecurityProvider(SecurityProvider):
+    """Security disabled: everyone is ADMIN."""
+
+    def authenticate(self, headers, remote_addr="") -> Principal:
+        return Principal("anonymous", Role.ADMIN)
+
+
+def parse_credentials_file(text: str) -> dict[str, tuple[str, Role]]:
+    """Jetty realm-properties format (BasicSecurityProvider):
+    ``user: password, ROLE`` per line."""
+    users: dict[str, tuple[str, Role]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        user, _, rest = line.partition(":")
+        password, _, role = rest.partition(",")
+        users[user.strip()] = (password.strip(),
+                               Role[role.strip().upper() or "VIEWER"])
+    return users
+
+
+class BasicSecurityProvider(SecurityProvider):
+    """HTTP Basic auth against a credentials file."""
+
+    def __init__(self, credentials_file: str = "",
+                 users: dict[str, tuple[str, Role]] | None = None):
+        if users is not None:
+            self._users = users
+        elif credentials_file:
+            with open(credentials_file) as f:
+                self._users = parse_credentials_file(f.read())
+        else:
+            self._users = {}
+
+    def authenticate(self, headers, remote_addr="") -> Principal:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Basic "):
+            raise AuthenticationError("missing Basic credentials")
+        try:
+            decoded = base64.b64decode(auth[6:]).decode()
+            user, _, password = decoded.partition(":")
+        except (binascii.Error, UnicodeDecodeError) as e:
+            raise AuthenticationError(f"malformed Basic credentials: {e}")
+        entry = self._users.get(user)
+        if entry is None or not hmac.compare_digest(entry[0], password):
+            raise AuthenticationError("bad username or password")
+        return Principal(user, entry[1])
+
+
+# ---- JWT (HS256, stdlib only) --------------------------------------------
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _b64url_decode(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def encode_jwt(claims: dict, secret: bytes) -> str:
+    header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    payload = _b64url(json.dumps(claims).encode())
+    signing_input = f"{header}.{payload}".encode()
+    sig = _b64url(hmac.new(secret, signing_input, hashlib.sha256).digest())
+    return f"{header}.{payload}.{sig}"
+
+
+def decode_jwt(token: str, secret: bytes) -> dict:
+    try:
+        header, payload, sig = token.split(".")
+    except ValueError:
+        raise AuthenticationError("malformed JWT")
+    signing_input = f"{header}.{payload}".encode()
+    expected = _b64url(hmac.new(secret, signing_input, hashlib.sha256).digest())
+    if not hmac.compare_digest(expected, sig):
+        raise AuthenticationError("bad JWT signature")
+    try:
+        claims = json.loads(_b64url_decode(payload))
+    except (ValueError, binascii.Error):
+        raise AuthenticationError("malformed JWT payload")
+    exp = claims.get("exp")
+    if exp is not None and time.time() > float(exp):
+        raise AuthenticationError("expired JWT")
+    return claims
+
+
+class JwtSecurityProvider(SecurityProvider):
+    """Bearer-token auth (JwtAuthenticator.java:51): validates signature +
+    expiry, maps the ``roles`` claim to the strongest known Role."""
+
+    def __init__(self, secret: bytes, cookie_name: str = "",
+                 principal_claim: str = "sub"):
+        self._secret = secret
+        self._cookie_name = cookie_name
+        self._principal_claim = principal_claim
+
+    def _token_from(self, headers: Mapping[str, str]) -> str:
+        auth = headers.get("Authorization", "")
+        if auth.startswith("Bearer "):
+            return auth[7:]
+        if self._cookie_name:
+            for part in headers.get("Cookie", "").split(";"):
+                name, _, value = part.strip().partition("=")
+                if name == self._cookie_name:
+                    return value
+        raise AuthenticationError("missing Bearer token")
+
+    def authenticate(self, headers, remote_addr="") -> Principal:
+        claims = decode_jwt(self._token_from(headers), self._secret)
+        name = str(claims.get(self._principal_claim, "unknown"))
+        roles = claims.get("roles", [])
+        if isinstance(roles, str):
+            roles = [roles]
+        best = Role.VIEWER
+        for r in roles:
+            try:
+                best = max(best, Role[str(r).upper()])
+            except KeyError:
+                continue
+        return Principal(name, best)
+
+
+class TrustedProxySecurityProvider(SecurityProvider):
+    """Authenticate the proxy (by source address), then trust its ``doAs``
+    query/header user (TrustedProxySecurityProvider.java:23). Role for the
+    delegated user comes from an optional user→role map (default USER)."""
+
+    DO_AS_HEADER = "X-Do-As"
+
+    def __init__(self, trusted_proxies: set[str],
+                 user_roles: Mapping[str, Role] | None = None):
+        self._trusted = set(trusted_proxies)
+        self._user_roles = dict(user_roles or {})
+
+    def authenticate(self, headers, remote_addr="") -> Principal:
+        if remote_addr not in self._trusted:
+            raise AuthenticationError(f"{remote_addr} is not a trusted proxy")
+        user = headers.get(self.DO_AS_HEADER, "")
+        if not user:
+            raise AuthenticationError("trusted proxy sent no delegated user")
+        return Principal(user, self._user_roles.get(user, Role.USER))
+
+
+class PrincipalValidatorSecurityProvider(SecurityProvider):
+    """SPNEGO-shaped provider: an external validator (in the reference, the
+    Kerberos GSS handshake) maps opaque credentials to a principal name;
+    roles come from a user→role map."""
+
+    def __init__(self, validator: Callable[[str], str | None],
+                 user_roles: Mapping[str, Role] | None = None):
+        self._validator = validator
+        self._user_roles = dict(user_roles or {})
+
+    def authenticate(self, headers, remote_addr="") -> Principal:
+        token = headers.get("Authorization", "")
+        name = self._validator(token)
+        if not name:
+            raise AuthenticationError("negotiation failed")
+        # Strip the service/host parts of a Kerberos principal
+        # (SpnegoSecurityProvider principal shortening).
+        short = name.split("@")[0].split("/")[0]
+        return Principal(short, self._user_roles.get(short, Role.USER))

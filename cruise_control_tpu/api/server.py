@@ -1,0 +1,407 @@
+"""The REST server: stdlib threaded HTTP front-end over the facade.
+
+Reference parity: servlet/KafkaCruiseControlServletApp (Jetty) +
+KafkaCruiseControlRequestHandler (dispatch, :~40) +
+KafkaCruiseControlEndPoints — collapsed onto ThreadingHTTPServer. Request
+flow mirrors the reference: resolve endpoint → authenticate/authorize →
+two-step purgatory gate → parse parameters → sync handler or async
+user-task submission (202 + ``User-Task-ID`` when still running).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.parse
+from concurrent.futures import TimeoutError as FuturesTimeoutError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+from ..config.cruise_control_config import CruiseControlConfig
+from ..facade import CruiseControl, OperationResult
+from ..monitor.load_monitor import NotEnoughValidWindowsError
+from . import responses
+from .endpoints import REVIEWABLE_ENDPOINTS, EndPoint, Role, endpoint_for_path
+from .parameters import ParameterParseError, parse_parameters
+from .purgatory import Purgatory
+from .security import (
+    AuthenticationError, AuthorizationError, NoopSecurityProvider, Principal,
+    SecurityProvider,
+)
+from .user_tasks import USER_TASK_HEADER, UserTaskManager
+
+LOG = logging.getLogger(__name__)
+
+URL_PREFIX = "/kafkacruisecontrol"
+
+# Endpoints answered inline; everything else runs as an async user task
+# (handler/sync vs handler/async split in the reference).
+_SYNC_ENDPOINTS = {
+    EndPoint.STATE, EndPoint.KAFKA_CLUSTER_STATE, EndPoint.USER_TASKS,
+    EndPoint.REVIEW_BOARD, EndPoint.PERMISSIONS, EndPoint.REVIEW,
+    EndPoint.PAUSE_SAMPLING, EndPoint.RESUME_SAMPLING,
+    EndPoint.STOP_PROPOSAL_EXECUTION, EndPoint.ADMIN, EndPoint.BOOTSTRAP,
+    EndPoint.TRAIN, EndPoint.RIGHTSIZE,
+}
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+class CruiseControlApi:
+    """Transport-independent request handling (so tests can drive it
+    without sockets, like the reference's servlet unit tests)."""
+
+    def __init__(self, cc: CruiseControl,
+                 security_provider: SecurityProvider | None = None,
+                 config: CruiseControlConfig | None = None):
+        self._cc = cc
+        cfg = config or cc.config
+        self._security = security_provider or (
+            self._configured_security(cfg) if cfg.get_boolean("webserver.security.enable")
+            else NoopSecurityProvider())
+        self._two_step = cfg.get_boolean("two.step.verification.enabled")
+        self._purgatory = Purgatory()
+        self._tasks = UserTaskManager(
+            max_active_tasks=cfg.get_int("max.active.user.tasks"),
+            completed_retention_ms=cfg.get_long(
+                "completed.user.task.retention.time.ms"))
+        self._async_wait_s = 10.0
+
+    @staticmethod
+    def _configured_security(cfg: CruiseControlConfig) -> SecurityProvider:
+        from .security import BasicSecurityProvider
+        cls_name = cfg.get("webserver.security.provider")
+        if cls_name.endswith("BasicSecurityProvider"):
+            return BasicSecurityProvider(
+                credentials_file=cfg.get("webserver.auth.credentials.file") or "")
+        import importlib
+        module, _, name = cls_name.rpartition(".")
+        return getattr(importlib.import_module(module), name)()
+
+    @property
+    def purgatory(self) -> Purgatory:
+        return self._purgatory
+
+    @property
+    def user_tasks(self) -> UserTaskManager:
+        return self._tasks
+
+    def shutdown(self) -> None:
+        self._tasks.shutdown()
+
+    # -- the dispatch pipeline ---------------------------------------------
+    def handle(self, method: str, path: str, query_string: str = "",
+               headers: dict[str, str] | None = None,
+               remote_addr: str = "") -> tuple[int, dict, dict[str, str]]:
+        """→ (http status, json body, extra response headers)."""
+        headers = headers or {}
+        out_headers: dict[str, str] = {}
+        try:
+            endpoint = self._resolve(method, path)
+            principal = self._security.authenticate(headers, remote_addr)
+            self._security.authorize(principal, endpoint)
+            query = urllib.parse.parse_qs(query_string, keep_blank_values=True)
+            params = parse_parameters(endpoint, query)
+            review_id = params.pop("review_id", None)
+            if self._two_step and endpoint in REVIEWABLE_ENDPOINTS:
+                if review_id is None:
+                    info = self._purgatory.add(endpoint.name, query_string,
+                                               principal.name)
+                    return 200, responses.envelope(
+                        {"reviewResult": info.to_dict(),
+                         "message": "request parked for review"}), out_headers
+                info = self._purgatory.submit(review_id, endpoint.name)
+                # Execute EXACTLY what was reviewed: replay the parked query,
+                # not whatever came with the resubmission (otherwise an
+                # approved dry-run could smuggle in dryrun=false).
+                query_string = info.query
+                params = parse_parameters(endpoint, urllib.parse.parse_qs(
+                    query_string, keep_blank_values=True))
+                params.pop("review_id", None)
+            body = self._dispatch(endpoint, params, principal, query_string,
+                                  headers, out_headers)
+            return 200, body, out_headers
+        except ParameterParseError as e:
+            return 400, self._error(str(e)), out_headers
+        except AuthenticationError as e:
+            out_headers["WWW-Authenticate"] = 'Basic realm="cruise-control"'
+            return 401, self._error(str(e)), out_headers
+        except AuthorizationError as e:
+            return 403, self._error(str(e)), out_headers
+        except ApiError as e:
+            return e.status, self._error(str(e)), out_headers
+        except NotEnoughValidWindowsError as e:
+            return 503, self._error(f"load model not ready: {e}"), out_headers
+        except (KeyError, ValueError) as e:
+            return 400, self._error(str(e)), out_headers
+        except Exception as e:
+            LOG.exception("internal error handling %s %s", method, path)
+            return 500, self._error(f"{type(e).__name__}: {e}"), out_headers
+
+    def _resolve(self, method: str, path: str) -> EndPoint:
+        if not path.startswith(URL_PREFIX):
+            raise ApiError(404, f"unknown path {path!r}; expected {URL_PREFIX}/*")
+        endpoint = endpoint_for_path(path[len(URL_PREFIX):])
+        if endpoint is None:
+            raise ApiError(404, f"unknown endpoint {path!r}")
+        if method != endpoint.method:
+            raise ApiError(405, f"{endpoint.name} requires {endpoint.method}")
+        return endpoint
+
+    @staticmethod
+    def _error(message: str) -> dict:
+        return responses.envelope({"errorMessage": message})
+
+    # -- handlers ----------------------------------------------------------
+    def _dispatch(self, endpoint: EndPoint, params: dict, principal: Principal,
+                  query_string: str, headers: dict[str, str],
+                  out_headers: dict[str, str]) -> dict:
+        cc = self._cc
+        p = params
+        if endpoint in _SYNC_ENDPOINTS:
+            return self._sync_handler(endpoint, p, principal)
+        # Async (model-building) endpoints run as user tasks.
+        work = self._async_work(endpoint, p)
+        info = self._tasks.get_or_create_task(
+            endpoint.name, query_string, work,
+            task_id=headers.get(USER_TASK_HEADER), client=principal.name)
+        out_headers[USER_TASK_HEADER] = info.task_id
+        try:
+            exc = info.future.exception(timeout=self._async_wait_s)
+        except FuturesTimeoutError:
+            return responses.envelope({
+                "progress": [{"operation": endpoint.name, "step": "pending",
+                              "completionPercentage": 0.0}],
+                "message": f"operation still running; poll with "
+                           f"{USER_TASK_HEADER} {info.task_id}"})
+        if exc is not None:
+            if isinstance(exc, (ParameterParseError, ValueError, KeyError)):
+                raise ApiError(400, str(exc))
+            if isinstance(exc, NotEnoughValidWindowsError):
+                raise ApiError(503, f"load model not ready: {exc}")
+            raise ApiError(500, f"{type(exc).__name__}: {exc}")
+        return info.future.result()
+
+    def _sync_handler(self, endpoint: EndPoint, p: dict,
+                      principal: Principal) -> dict:
+        cc = self._cc
+        if endpoint is EndPoint.STATE:
+            return responses.envelope(cc.state(p.get("substates", ())))
+        if endpoint is EndPoint.KAFKA_CLUSTER_STATE:
+            return responses.kafka_cluster_state(cc._admin, p.get("topic", ""))
+        if endpoint is EndPoint.USER_TASKS:
+            tasks = self._tasks.all_tasks()
+            ids = set(p.get("user_task_ids", ()))
+            if ids:
+                tasks = [t for t in tasks if t.task_id in ids]
+            eps = set(p.get("endpoints", ()))
+            if eps:
+                tasks = [t for t in tasks if t.endpoint in eps]
+            tasks = tasks[: p.get("entries", len(tasks))]
+            return responses.envelope(
+                {"userTasks": [t.to_dict() for t in tasks]})
+        if endpoint is EndPoint.REVIEW_BOARD:
+            board = self._purgatory.review_board()
+            ids = set(p.get("review_ids", ()))
+            if ids:
+                board = [r for r in board if r["Id"] in ids]
+            return responses.envelope({"requestInfo": board})
+        if endpoint is EndPoint.PERMISSIONS:
+            return responses.envelope(
+                {"user": principal.name, "role": principal.role.name})
+        if endpoint is EndPoint.REVIEW:
+            out = []
+            for rid in p.get("approve", ()):
+                out.append(self._purgatory.approve(rid, p.get("reason", "")).to_dict())
+            for rid in p.get("discard", ()):
+                out.append(self._purgatory.discard(rid, p.get("reason", "")).to_dict())
+            return responses.envelope({"requestInfo": out})
+        if endpoint is EndPoint.PAUSE_SAMPLING:
+            cc.pause_metric_sampling(p.get("reason", ""))
+            return responses.envelope({"message": "metric sampling paused"})
+        if endpoint is EndPoint.RESUME_SAMPLING:
+            cc.resume_metric_sampling(p.get("reason", ""))
+            return responses.envelope({"message": "metric sampling resumed"})
+        if endpoint is EndPoint.STOP_PROPOSAL_EXECUTION:
+            cc.stop_proposal_execution()
+            return responses.envelope({"message": "execution stop requested"})
+        if endpoint is EndPoint.BOOTSTRAP:
+            start = p.get("start")
+            if start is None:
+                raise ParameterParseError("bootstrap requires start")
+            cc.load_monitor.bootstrap(start, p.get("end", int(time.time() * 1000)),
+                                      p.get("clearmetrics", True))
+            return responses.envelope({"message": "bootstrap started"})
+        if endpoint is EndPoint.TRAIN:
+            return responses.envelope(
+                {"message": "training window recorded",
+                 "start": p.get("start"), "end": p.get("end")})
+        if endpoint is EndPoint.RIGHTSIZE:
+            res = cc.rightsize(p.get("numbrokerstoadd", 0),
+                               p.get("partition_count", 0), p.get("topic"))
+            return responses.optimization_result(res)
+        if endpoint is EndPoint.ADMIN:
+            return self._admin_handler(p)
+        raise ApiError(500, f"no sync handler for {endpoint.name}")
+
+    def _admin_handler(self, p: dict) -> dict:
+        from ..detector.anomaly import AnomalyType
+        cc = self._cc
+        changed: dict[str, Any] = {}
+        for name in p.get("disable_self_healing_for", ()):
+            old = cc.anomaly_detector.set_self_healing_for(
+                AnomalyType[name.upper()], False)
+            changed.setdefault("selfHealingDisabledBefore", {})[name] = old
+        for name in p.get("enable_self_healing_for", ()):
+            old = cc.anomaly_detector.set_self_healing_for(
+                AnomalyType[name.upper()], True)
+            changed.setdefault("selfHealingEnabledBefore", {})[name] = old
+        conc = {k: p[k] for k in
+                ("concurrent_partition_movements_per_broker",
+                 "concurrent_intra_broker_partition_movements",
+                 "concurrent_leader_movements") if k in p}
+        if conc:
+            changed["concurrency"] = cc.set_concurrency(
+                inter_broker_per_broker=conc.get(
+                    "concurrent_partition_movements_per_broker"),
+                intra_broker_per_broker=conc.get(
+                    "concurrent_intra_broker_partition_movements"),
+                leadership_cluster=conc.get("concurrent_leader_movements"))
+        dropped_removed = p.get("drop_recently_removed_brokers", ())
+        if dropped_removed:
+            cc.recently_removed_brokers -= set(dropped_removed)
+            changed["droppedRecentlyRemoved"] = sorted(dropped_removed)
+        dropped_demoted = p.get("drop_recently_demoted_brokers", ())
+        if dropped_demoted:
+            cc.recently_demoted_brokers -= set(dropped_demoted)
+            changed["droppedRecentlyDemoted"] = sorted(dropped_demoted)
+        return responses.envelope(changed or {"message": "no admin action given"})
+
+    def _async_work(self, endpoint: EndPoint, p: dict):
+        cc = self._cc
+        dryrun = p.get("dryrun", True)
+        goals = list(p["goals"]) if "goals" in p else None
+        reason = p.get("reason", "")
+
+        def load():
+            state, meta = cc.load_monitor.cluster_model()
+            return responses.broker_stats(state, meta)
+
+        def partition_load():
+            state, meta = cc.load_monitor.cluster_model()
+            return responses.partition_load(
+                state, meta, p.get("resource", "DISK"), p.get("entries"),
+                p.get("max_load", False))
+
+        def proposals():
+            return responses.optimization_result(cc.proposals(
+                goals, p.get("ignore_proposal_cache", False)))
+
+        def rebalance():
+            return responses.optimization_result(cc.rebalance(
+                goals, dryrun,
+                excluded_topics=p.get("excluded_topics", ()),
+                destination_broker_ids=p.get("destination_broker_ids", ()),
+                exclude_recently_demoted_brokers=p.get(
+                    "exclude_recently_demoted_brokers", False),
+                exclude_recently_removed_brokers=p.get(
+                    "exclude_recently_removed_brokers", False),
+                reason=reason))
+
+        def add_broker():
+            return responses.optimization_result(cc.add_brokers(
+                list(p.get("brokerid", ())), dryrun, goals, reason=reason))
+
+        def remove_broker():
+            return responses.optimization_result(cc.remove_brokers(
+                list(p.get("brokerid", ())), dryrun, goals, reason=reason))
+
+        def demote_broker():
+            return responses.optimization_result(cc.demote_brokers(
+                list(p.get("brokerid", ())), dryrun, reason=reason))
+
+        def fix_offline_replicas():
+            return responses.optimization_result(cc.fix_offline_replicas(
+                dryrun, goals, reason=reason))
+
+        def topic_configuration():
+            topic = p.get("topic")
+            rf = p.get("replication_factor")
+            if not topic or rf is None:
+                raise ParameterParseError(
+                    "topic_configuration requires topic and replication_factor")
+            return responses.optimization_result(
+                cc.update_topic_replication_factor([topic], rf, dryrun,
+                                                   reason=reason))
+
+        def remove_disks():
+            mapping = p.get("brokerid_and_logdirs")
+            if not mapping:
+                raise ParameterParseError(
+                    "remove_disks requires brokerid_and_logdirs")
+            return responses.optimization_result(
+                cc.remove_disks(mapping, dryrun, reason=reason))
+
+        table = {EndPoint.LOAD: load, EndPoint.PARTITION_LOAD: partition_load,
+                 EndPoint.PROPOSALS: proposals, EndPoint.REBALANCE: rebalance,
+                 EndPoint.ADD_BROKER: add_broker,
+                 EndPoint.REMOVE_BROKER: remove_broker,
+                 EndPoint.DEMOTE_BROKER: demote_broker,
+                 EndPoint.FIX_OFFLINE_REPLICAS: fix_offline_replicas,
+                 EndPoint.TOPIC_CONFIGURATION: topic_configuration,
+                 EndPoint.REMOVE_DISKS: remove_disks}
+        return table[endpoint]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    api: CruiseControlApi  # set by make_server
+
+    def _serve(self, method: str) -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        status, body, extra = self.api.handle(
+            method, parsed.path, parsed.query, dict(self.headers),
+            self.client_address[0])
+        data = json.dumps(body, indent=2).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in extra.items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        self._serve("GET")
+
+    def do_POST(self) -> None:  # noqa: N802
+        self._serve("POST")
+
+    def log_message(self, fmt: str, *args) -> None:
+        LOG.debug("http: " + fmt, *args)
+
+
+def make_server(cc: CruiseControl, host: str | None = None,
+                port: int | None = None,
+                security_provider: SecurityProvider | None = None,
+                ) -> tuple[ThreadingHTTPServer, CruiseControlApi]:
+    cfg = cc.config
+    api = CruiseControlApi(cc, security_provider)
+    handler = type("BoundHandler", (_Handler,), {"api": api})
+    server = ThreadingHTTPServer(
+        (host or cfg.get("webserver.http.address"),
+         port if port is not None else cfg.get_int("webserver.http.port")),
+        handler)
+    return server, api
+
+
+def serve_forever_in_thread(server: ThreadingHTTPServer) -> threading.Thread:
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="cruise-control-http")
+    t.start()
+    return t

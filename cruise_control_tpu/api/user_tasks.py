@@ -1,0 +1,96 @@
+"""Async user-task tracking.
+
+Reference parity: servlet/UserTaskManager.java:69-138,222 — maps a client's
+``User-Task-ID`` header (or a generated UUID) to an OperationFuture so
+long-running operations can be polled; bounded active set, completed-task
+retention, per-endpoint history for the USER_TASKS endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid as uuid_mod
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+USER_TASK_HEADER = "User-Task-ID"
+
+
+@dataclass
+class UserTaskInfo:
+    task_id: str
+    endpoint: str
+    query: str
+    start_ms: int
+    future: Future
+    client: str = ""
+    status_override: str | None = None
+
+    @property
+    def status(self) -> str:
+        if self.status_override:
+            return self.status_override
+        if not self.future.done():
+            return "Active"
+        if self.future.cancelled():
+            return "Cancelled"
+        return "CompletedWithError" if self.future.exception() else "Completed"
+
+    def to_dict(self) -> dict:
+        return {"UserTaskId": self.task_id, "RequestURL": f"{self.endpoint}?{self.query}",
+                "Status": self.status, "StartMs": self.start_ms,
+                "ClientIdentity": self.client}
+
+
+class UserTaskManager:
+    def __init__(self, max_active_tasks: int = 25,
+                 completed_retention_ms: int = 86_400_000,
+                 num_threads: int = 8):
+        self._lock = threading.Lock()
+        self._tasks: dict[str, UserTaskInfo] = {}
+        self._max_active = max_active_tasks
+        self._retention_ms = completed_retention_ms
+        self._pool = ThreadPoolExecutor(max_workers=num_threads,
+                                        thread_name_prefix="user-task")
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def _expire_locked(self) -> None:
+        now = int(time.time() * 1000)
+        for tid in [t for t, info in self._tasks.items()
+                    if info.future.done()
+                    and now - info.start_ms > self._retention_ms]:
+            del self._tasks[tid]
+
+    def get_or_create_task(self, endpoint: str, query: str,
+                           work: Callable[[], Any],
+                           task_id: str | None = None,
+                           client: str = "") -> UserTaskInfo:
+        """Resume the task for a presented User-Task-ID, else submit a new
+        one (UserTaskManager.getOrCreateUserTask:222)."""
+        with self._lock:
+            self._expire_locked()
+            if task_id and task_id in self._tasks:
+                return self._tasks[task_id]
+            active = sum(1 for t in self._tasks.values() if not t.future.done())
+            if active >= self._max_active:
+                raise RuntimeError(
+                    f"exceeded max active user tasks ({self._max_active})")
+            tid = task_id or str(uuid_mod.uuid4())
+            info = UserTaskInfo(task_id=tid, endpoint=endpoint, query=query,
+                                start_ms=int(time.time() * 1000),
+                                future=self._pool.submit(work), client=client)
+            self._tasks[tid] = info
+            return info
+
+    def task(self, task_id: str) -> UserTaskInfo | None:
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    def all_tasks(self) -> list[UserTaskInfo]:
+        with self._lock:
+            self._expire_locked()
+            return sorted(self._tasks.values(), key=lambda t: -t.start_ms)

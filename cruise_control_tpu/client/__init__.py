@@ -1,0 +1,6 @@
+"""Console client (reference: cruise-control-client/ — cccli, Responder)."""
+
+from .cccli import build_parser, main
+from .responder import CruiseControlClientError, Responder
+
+__all__ = ["build_parser", "main", "CruiseControlClientError", "Responder"]

@@ -1,0 +1,78 @@
+"""``cccli`` — the console client.
+
+Reference parity: cruise-control-client client/cccli.py:230 + Endpoint.py:637
+— an argparse subcommand per REST endpoint whose flags mirror that
+endpoint's parameter schema (the schemas are shared with the server, so
+client and server can never drift, unlike the reference's hand-mirrored
+parameter lists).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..api.endpoints import EndPoint
+from ..api.parameters import SCHEMAS, _COMMON, _bool
+from .responder import CruiseControlClientError, Responder
+
+
+def _add_endpoint_parser(sub: argparse._SubParsersAction,
+                         endpoint: EndPoint) -> None:
+    p = sub.add_parser(endpoint.name.lower(),
+                       help=f"{endpoint.method} {endpoint.path}")
+    for name, coerce in {**_COMMON, **SCHEMAS[endpoint]}.items():
+        if coerce is _bool:
+            # tri-state: absent → server default, --x true/false → explicit
+            p.add_argument(f"--{name}", choices=["true", "false"], default=None)
+        else:
+            p.add_argument(f"--{name}", default=None)
+    p.set_defaults(endpoint=endpoint)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="cccli", description="cruise-control-tpu console client")
+    parser.add_argument("-a", "--address", default="http://localhost:9090",
+                        help="server base address")
+    parser.add_argument("--prefix", default="kafkacruisecontrol",
+                        help="API url prefix")
+    parser.add_argument("--poll-interval", type=float, default=1.0)
+    parser.add_argument("--timeout", type=float, default=600.0)
+    parser.add_argument("--header", action="append", default=[],
+                        metavar="NAME:VALUE", help="extra request header")
+    sub = parser.add_subparsers(dest="command", required=True)
+    for endpoint in EndPoint:
+        _add_endpoint_parser(sub, endpoint)
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    endpoint: EndPoint = args.endpoint
+    skip = {"address", "prefix", "poll_interval", "timeout", "header",
+            "command", "endpoint"}
+    params = {k: v for k, v in vars(args).items()
+              if k not in skip and v is not None}
+    headers = {}
+    for h in args.header:
+        name, _, value = h.partition(":")
+        headers[name.strip()] = value.strip()
+    responder = Responder(f"{args.address.rstrip('/')}/{args.prefix}",
+                          headers=headers, poll_interval_s=args.poll_interval,
+                          timeout_s=args.timeout)
+    try:
+        body = responder.retrieve_response(endpoint.method, endpoint.path,
+                                           params)
+    except CruiseControlClientError as e:
+        print(json.dumps(e.body if isinstance(e.body, dict)
+                         else {"error": str(e.body)}, indent=2),
+              file=sys.stderr)
+        return 1
+    print(json.dumps(body, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""HTTP responder with async-task polling.
+
+Reference parity: cruise-control-client Responder.py:144 — issue the
+request, and when the server answers with an in-progress body, re-issue it
+with the returned ``User-Task-ID`` header until the operation completes.
+stdlib urllib only (the reference uses `requests`).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Mapping
+
+USER_TASK_HEADER = "User-Task-ID"
+
+
+class CruiseControlClientError(Exception):
+    def __init__(self, status: int, body: dict | str):
+        super().__init__(f"HTTP {status}: {body}")
+        self.status = status
+        self.body = body
+
+
+class Responder:
+    def __init__(self, base_url: str, headers: Mapping[str, str] | None = None,
+                 poll_interval_s: float = 1.0, timeout_s: float = 600.0):
+        self._base = base_url.rstrip("/")
+        self._headers = dict(headers or {})
+        self._poll_interval_s = poll_interval_s
+        self._timeout_s = timeout_s
+
+    def _request(self, method: str, endpoint: str, params: Mapping[str, Any],
+                 extra_headers: Mapping[str, str]) -> tuple[int, dict, dict]:
+        query = urllib.parse.urlencode(
+            {k: str(v).lower() if isinstance(v, bool) else v
+             for k, v in params.items() if v is not None})
+        url = f"{self._base}/{endpoint.lower()}"
+        if query:
+            url += f"?{query}"
+        req = urllib.request.Request(url, method=method,
+                                     headers={**self._headers, **extra_headers})
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return (resp.status, json.loads(resp.read() or b"{}"),
+                        dict(resp.headers))
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read())
+            except Exception:
+                body = {"errorMessage": str(e)}
+            raise CruiseControlClientError(e.code, body)
+
+    def retrieve_response(self, method: str, endpoint: str,
+                          params: Mapping[str, Any] | None = None) -> dict:
+        """Issue + poll to completion (Responder's retrieve_response loop)."""
+        params = params or {}
+        deadline = time.time() + self._timeout_s
+        task_headers: dict[str, str] = {}
+        while True:
+            status, body, headers = self._request(method, endpoint, params,
+                                                  task_headers)
+            if "progress" not in body:
+                return body
+            task_id = headers.get(USER_TASK_HEADER)
+            if task_id:
+                task_headers[USER_TASK_HEADER] = task_id
+            if time.time() > deadline:
+                raise CruiseControlClientError(
+                    408, {"errorMessage": "operation did not finish in time",
+                          "userTaskId": task_id})
+            time.sleep(self._poll_interval_s)

@@ -59,6 +59,13 @@ class Anomaly:
     def fix(self, facade: Any) -> bool:
         raise NotImplementedError
 
+    def still_valid(self, facade: Any) -> bool:
+        """Re-validated when a parked (CHECK_WITH_DELAY) anomaly is re-taken:
+        a stale snapshot must not trigger a fix after the condition cleared
+        (the reference re-RUNS detection on recheck; here the snapshot
+        revalidates against live cluster state)."""
+        return True
+
     @property
     def self_healing_config_key(self) -> str:
         return {
@@ -123,6 +130,13 @@ class BrokerFailures(Anomaly):
                               is_triggered_by_user_request=False,
                               reason="self-healing broker failure")
         return True
+
+    def still_valid(self, facade: Any) -> bool:
+        alive_fn = getattr(facade, "alive_brokers", None)
+        if alive_fn is None:
+            return True
+        alive = alive_fn()
+        return any(b not in alive for b in self.failed_brokers)
 
 
 @dataclass

@@ -123,6 +123,15 @@ class AnomalyDetectorManager:
                 now = time.time()
                 while self._recheck and self._recheck[0][0] <= now:
                     _due, anomaly = heapq.heappop(self._recheck)
+                    # Drop parked anomalies whose condition cleared meanwhile
+                    # (e.g. the failed broker recovered) instead of fixing a
+                    # stale snapshot.
+                    if self._facade is not None and \
+                            not anomaly.still_valid(self._facade):
+                        rec = self._records.get(anomaly.anomaly_id)
+                        if rec is not None:
+                            rec.status = AnomalyStatus.IGNORED
+                        continue
                     heapq.heappush(self._queue, (
                         (anomaly.anomaly_type.priority, anomaly.detection_time_ms),
                         self._queue_seq, anomaly))

@@ -104,10 +104,16 @@ class SlowBrokerFinder:
     history_pct: float = 90.0
     peer_pct: float = 50.0
     peer_margin: float = 3.0          # slow if > margin × peer percentile
-    demote_score: int = 5
-    removal_score: int = 10
+    # None → read slow.broker.{demotion,decommission}.score from config.
+    demote_score: int | None = None
+    removal_score: int | None = None
 
     def __post_init__(self):
+        if self.demote_score is None:
+            self.demote_score = self.config.get_int("slow.broker.demotion.score")
+        if self.removal_score is None:
+            self.removal_score = self.config.get_int(
+                "slow.broker.decommission.score")
         bdef = KafkaMetricDef.broker_metric_def()
         self._flush_id = bdef.metric_info(
             BrokerMetric.BROKER_LOG_FLUSH_TIME_MS_999TH.name).id

@@ -80,8 +80,6 @@ class SelfHealingNotifier(AnomalyNotifier):
     escalate alert → auto-fix by failure age (broker.failure.alert.threshold.ms
     then self.healing.threshold); other types fix immediately when enabled."""
 
-    BROKER_FAILURE_ALERT_THRESHOLD_MS = 900_000       # :59
-
     def __init__(self, config: CruiseControlConfig | None = None,
                  now_ms: Callable[[], int] | None = None):
         cfg = config or CruiseControlConfig()
@@ -92,7 +90,7 @@ class SelfHealingNotifier(AnomalyNotifier):
                 Anomaly(anomaly_type=t).self_healing_config_key))
             for t in AnomalyType
         }
-        self._alert_threshold_ms = self.BROKER_FAILURE_ALERT_THRESHOLD_MS
+        self._alert_threshold_ms = cfg.get_long("broker.failure.alert.threshold.ms")
         self._fix_threshold_ms = cfg.get_long("broker.failure.self.healing.threshold.ms")
         self._alerted: set[int] = set()
 

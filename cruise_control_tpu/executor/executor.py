@@ -135,6 +135,20 @@ class Executor:
                            has_under_min_isr: bool) -> None:
         self._concurrency.adjust(cluster_healthy, has_under_min_isr)
 
+    def set_requested_concurrency(self, inter_broker_per_broker: int | None = None,
+                                  intra_broker_per_broker: int | None = None,
+                                  leadership_cluster: int | None = None) -> dict:
+        """Operator concurrency override
+        (Executor.setRequestedExecutionConcurrency)."""
+        caps = self._concurrency._caps
+        if inter_broker_per_broker is not None:
+            caps.inter_broker_per_broker = inter_broker_per_broker
+        if intra_broker_per_broker is not None:
+            caps.intra_broker_per_broker = intra_broker_per_broker
+        if leadership_cluster is not None:
+            caps.leadership_cluster = leadership_cluster
+        return self._concurrency.state()
+
     def _set_phase(self, phase: ExecutorState) -> None:
         # Never overwrite a user-requested STOPPING state from the worker.
         with self._lock:

@@ -21,7 +21,7 @@ import logging
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Sequence
+from typing import Any, Mapping, Sequence
 
 import numpy as np
 
@@ -109,6 +109,12 @@ class CruiseControl:
         self._proposal_cache: tuple[int, float, OptimizerResult] | None = None
         self._proposal_lock = threading.Lock()
         self._started = False
+        # Executor.java demotion/removal history consumed by the
+        # exclude_recently_* request parameters and the ADMIN drop_* params.
+        self.recently_removed_brokers: set[int] = set()
+        self.recently_demoted_brokers: set[int] = set()
+        from .detector.provisioner import BasicProvisioner
+        self.provisioner = BasicProvisioner()
 
     # -- wiring ------------------------------------------------------------
     def _wire_detectors(self) -> None:
@@ -191,6 +197,10 @@ class CruiseControl:
                ) -> tuple[ClusterTensors, ClusterMeta]:
         return self._load_monitor.cluster_model(requirements)
 
+    def alive_brokers(self) -> set[int]:
+        """Live broker set (anomaly re-validation + dashboards)."""
+        return self._admin.alive_brokers()
+
     def ready_for_self_healing(self) -> bool:
         """Completeness gate consulted before anomaly fixes
         (AnomalyDetectorManager.java:513)."""
@@ -258,13 +268,21 @@ class CruiseControl:
                   ignore_proposal_cache: bool = False,
                   excluded_topics: Sequence[str] = (),
                   destination_broker_ids: Sequence[int] = (),
+                  exclude_recently_demoted_brokers: bool = False,
+                  exclude_recently_removed_brokers: bool = False,
                   is_triggered_by_user_request: bool = True,
                   reason: str = "", uuid: str = "") -> OperationResult:
         """RebalanceRunnable.workWithoutClusterModel:115."""
         del ignore_proposal_cache  # explicit model pass below is always fresh
         state, meta = self._model()
+        no_leadership = tuple(self.recently_demoted_brokers) \
+            if exclude_recently_demoted_brokers else ()
+        no_replicas = tuple(self.recently_removed_brokers) \
+            if exclude_recently_removed_brokers else ()
         options = OptimizationOptions(
             excluded_topics=tuple(excluded_topics),
+            excluded_brokers_for_leadership=no_leadership,
+            excluded_brokers_for_replica_move=no_replicas,
             requested_destination_broker_ids=tuple(destination_broker_ids),
             is_triggered_by_goal_violation=not is_triggered_by_user_request)
         _final, result = self._optimizer.optimizations(
@@ -301,6 +319,8 @@ class CruiseControl:
         _final, result = self._optimizer.optimizations(
             state, meta, self._goal_chain(goals), options)
         executed = self._maybe_execute(result, dryrun, "remove_broker", reason, uuid)
+        if executed:
+            self.recently_removed_brokers |= set(broker_ids)
         return OperationResult("remove_broker", dryrun, result,
                                result.proposals, executed, reason)
 
@@ -317,6 +337,8 @@ class CruiseControl:
         _final, result = self._optimizer.optimizations(
             state, meta, [PreferredLeaderElectionGoal()], options)
         executed = self._maybe_execute(result, dryrun, "demote_broker", reason, uuid)
+        if executed:
+            self.recently_demoted_brokers |= set(broker_ids)
         return OperationResult("demote_broker", dryrun, result,
                                result.proposals, executed, reason)
 
@@ -392,7 +414,76 @@ class CruiseControl:
                                extra={"replicationFactor": replication_factor,
                                       "topics": sorted(want)})
 
+    def remove_disks(self, broker_logdirs: Mapping[int, Sequence[str]],
+                     dryrun: bool = True, reason: str = "",
+                     uuid: str = "") -> OperationResult:
+        """RemoveDisksRunnable — evacuate the named log dirs. Requires a
+        JBOD-capable backend exposing per-replica log dirs
+        (``replica_logdirs()``); replicas on the target dirs are moved to
+        the broker's remaining alive dirs (round-robin by current count,
+        the reference's intra-broker rebalance-after-removal)."""
+        replica_dirs_fn = getattr(self._admin, "replica_logdirs", None)
+        logdirs_fn = getattr(self._admin, "describe_logdirs", None)
+        if replica_dirs_fn is None or logdirs_fn is None:
+            raise ValueError(
+                "remove_disks requires a JBOD-capable admin backend "
+                "(replica_logdirs/describe_logdirs)")
+        replica_dirs: Mapping[tuple[str, int, int], str] = replica_dirs_fn()
+        logdirs = logdirs_fn()
+        moves: list[tuple[tuple[str, int], int, str]] = []  # (tp, broker, dst dir)
+        dir_counts: dict[tuple[int, str], int] = {}
+        for (t, p, b), d in replica_dirs.items():
+            dir_counts[(b, d)] = dir_counts.get((b, d), 0) + 1
+        for broker, dirs in broker_logdirs.items():
+            removed = set(dirs)
+            remaining = [d for d, online in logdirs.get(broker, {}).items()
+                         if online and d not in removed]
+            if not remaining:
+                raise ValueError(
+                    f"broker {broker}: no remaining alive log dirs")
+            for (t, p, b), d in sorted(replica_dirs.items()):
+                if b != broker or d not in removed:
+                    continue
+                dst = min(remaining, key=lambda x: dir_counts.get((broker, x), 0))
+                dir_counts[(broker, dst)] = dir_counts.get((broker, dst), 0) + 1
+                moves.append(((t, p), broker, dst))
+        executed = False
+        if moves and not dryrun:
+            alter = getattr(self._admin, "alter_replica_logdirs", None)
+            if alter is None:
+                raise ValueError("backend cannot alter replica log dirs")
+            alter([(tp, broker, dst) for tp, broker, dst in moves])
+            executed = True
+        return OperationResult(
+            "remove_disks", dryrun, executed=executed, reason=reason,
+            extra={"intraBrokerMoves": [
+                {"topic": tp[0], "partition": tp[1], "broker": broker,
+                 "destinationLogdir": dst} for tp, broker, dst in moves]})
+
+    def rightsize(self, num_brokers_to_add: int = 0, partition_count: int = 0,
+                  topic: str | None = None) -> OperationResult:
+        """RightsizeRunnable — hand a ProvisionRecommendation to the
+        configured Provisioner."""
+        from .detector.provisioner import ProvisionRecommendation, ProvisionStatus
+        rec = ProvisionRecommendation(
+            status=ProvisionStatus.UNDER_PROVISIONED,
+            num_brokers=num_brokers_to_add, num_partitions=partition_count,
+            topic=topic)
+        state = self.provisioner.rightsize([rec])
+        return OperationResult("rightsize", dryrun=False,
+                               extra={"provisionerState": state.value,
+                                      "recommendation": rec.to_dict()})
+
     # -- admin toggles ------------------------------------------------------
+    def set_concurrency(self, inter_broker_per_broker: int | None = None,
+                        intra_broker_per_broker: int | None = None,
+                        leadership_cluster: int | None = None) -> dict:
+        """ADMIN endpoint concurrency overrides."""
+        return self._executor.set_requested_concurrency(
+            inter_broker_per_broker=inter_broker_per_broker,
+            intra_broker_per_broker=intra_broker_per_broker,
+            leadership_cluster=leadership_cluster)
+
     def pause_metric_sampling(self, reason: str = "") -> None:
         self._load_monitor.pause_metric_sampling(reason)
 

@@ -1,0 +1,361 @@
+"""REST API layer: endpoint dispatch, parameters, responses, user tasks,
+two-step review, security (reference parity: servlet/ test ideas —
+KafkaCruiseControlServletEndpointTest, UserTaskManagerTest, purgatory and
+security suites — against the stdlib server)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from cruise_control_tpu.api import (
+    EndPoint, Purgatory, ReviewStatus, Role, UserTaskManager,
+)
+from cruise_control_tpu.api.parameters import (
+    ParameterParseError, parse_parameters,
+)
+from cruise_control_tpu.api.security import (
+    AuthenticationError, BasicSecurityProvider, JwtSecurityProvider,
+    Principal, TrustedProxySecurityProvider, encode_jwt,
+    parse_credentials_file,
+)
+from cruise_control_tpu.api.server import CruiseControlApi, make_server
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+from cruise_control_tpu.executor.admin import InMemoryAdminBackend, PartitionState
+from cruise_control_tpu.executor.executor import Executor
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.monitor import LoadMonitor, StaticCapacityResolver
+from cruise_control_tpu.monitor.sampling import SyntheticSampler
+
+
+def _partitions(brokers=(0, 1, 2, 3), topics=2, parts=4):
+    out = {}
+    for t in range(topics):
+        for p in range(parts):
+            reps = (brokers[0], brokers[1 + (t + p) % (len(brokers) - 1)])
+            out[(f"t{t}", p)] = PartitionState(f"t{t}", p, reps, reps[0],
+                                               isr=reps)
+    return out
+
+
+@pytest.fixture(scope="module")
+def cc():
+    partitions = _partitions()
+    backend = InMemoryAdminBackend(partitions.values())
+    cfg = CruiseControlConfig({
+        "partition.metrics.window.ms": 1000,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "max.solver.rounds": 30,
+        "failed.brokers.file.path": ""})
+    caps = StaticCapacityResolver({}, {Resource.CPU: 100.0, Resource.DISK: 1e7,
+                                       Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6})
+    monitor = LoadMonitor(cfg, backend, samplers=[SyntheticSampler()],
+                          capacity_resolver=caps)
+    cc = CruiseControl(cfg, backend, load_monitor=monitor,
+                       executor=Executor(backend, synchronous=True))
+    for k in range(1, 4):
+        monitor.task_runner.run_sampling_once(end_ms=k * 1000)
+    return cc
+
+
+@pytest.fixture()
+def api(cc):
+    api = CruiseControlApi(cc)
+    api._async_wait_s = 180       # cover first-compile of the solver kernels
+    yield api
+    api.shutdown()
+
+
+# ---- parameters ----------------------------------------------------------
+
+def test_parameter_parsing_types_and_unknown_rejection():
+    q = {"brokerid": ["1,2,3"], "dryrun": ["false"], "reason": ["test"]}
+    p = parse_parameters(EndPoint.REMOVE_BROKER, q)
+    assert p == {"brokerid": (1, 2, 3), "dryrun": False, "reason": "test"}
+    with pytest.raises(ParameterParseError, match="unknown parameter"):
+        parse_parameters(EndPoint.REBALANCE, {"tyop": ["x"]})
+    with pytest.raises(ParameterParseError, match="not a boolean"):
+        parse_parameters(EndPoint.REBALANCE, {"dryrun": ["maybe"]})
+
+
+def test_remove_disks_parameter_pairs():
+    p = parse_parameters(EndPoint.REMOVE_DISKS,
+                         {"brokerid_and_logdirs": ["0-/d1,0-/d2,1-/d1"]})
+    assert p["brokerid_and_logdirs"] == {0: ("/d1", "/d2"), 1: ("/d1",)}
+
+
+# ---- endpoint dispatch ---------------------------------------------------
+
+def test_state_endpoint(api):
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/state")
+    assert status == 200
+    assert {"MonitorState", "ExecutorState", "AnalyzerState",
+            "AnomalyDetectorState"} <= set(body)
+
+
+def test_unknown_endpoint_and_method_mismatch(api):
+    assert api.handle("GET", "/kafkacruisecontrol/nope")[0] == 404
+    assert api.handle("GET", "/other/state")[0] == 404
+    assert api.handle("GET", "/kafkacruisecontrol/rebalance")[0] == 405
+
+
+def test_kafka_cluster_state(api):
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/kafka_cluster_state")
+    assert status == 200
+    counts = body["KafkaBrokerState"]["ReplicaCountByBrokerId"]
+    assert sum(counts.values()) == 16      # 8 partitions × RF 2
+
+
+def test_load_and_partition_load(api):
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/load")
+    assert status == 200
+    assert len(body["brokers"]) == 4
+    assert all("DiskMB" in b and "CpuPct" in b for b in body["brokers"])
+    status, body, _ = api.handle(
+        "GET", "/kafkacruisecontrol/partition_load",
+        "resource=network_outbound&entries=5")
+    assert status == 200
+    assert len(body["records"]) == 5
+    status, _body, _ = api.handle("GET", "/kafkacruisecontrol/partition_load",
+                                  "resource=warp_drive")
+    assert status == 400
+
+
+def test_proposals_and_rebalance_dryrun(api):
+    status, body, headers = api.handle("POST", "/kafkacruisecontrol/rebalance",
+                                       "dryrun=true")
+    assert status == 200
+    assert body["proposals"], "skewed fixture must produce proposals"
+    assert "User-Task-ID" in headers
+    status, body2, _ = api.handle("GET", "/kafkacruisecontrol/proposals")
+    assert status == 200 and "summary" in body2
+
+
+def test_user_tasks_listing(api):
+    api.handle("POST", "/kafkacruisecontrol/rebalance", "dryrun=true")
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/user_tasks")
+    assert status == 200
+    assert body["userTasks"]
+    assert {"UserTaskId", "Status", "RequestURL"} <= set(body["userTasks"][0])
+
+
+def test_user_task_id_resume(api):
+    _s, _b, headers = api.handle("POST", "/kafkacruisecontrol/rebalance",
+                                 "dryrun=true")
+    tid = headers["User-Task-ID"]
+    _s2, _b2, headers2 = api.handle("POST", "/kafkacruisecontrol/rebalance",
+                                    "dryrun=true", {"User-Task-ID": tid})
+    assert headers2["User-Task-ID"] == tid
+
+
+def test_admin_self_healing_toggle(api, cc):
+    status, body, _ = api.handle(
+        "POST", "/kafkacruisecontrol/admin",
+        "enable_self_healing_for=broker_failure")
+    assert status == 200
+    st = cc.anomaly_detector.state()
+    assert "BROKER_FAILURE" in st["selfHealingEnabled"]
+    status, body, _ = api.handle(
+        "POST", "/kafkacruisecontrol/admin",
+        "disable_self_healing_for=broker_failure")
+    assert status == 200
+    assert body["selfHealingDisabledBefore"] == {"broker_failure": True}
+
+
+def test_admin_concurrency_override(api, cc):
+    status, body, _ = api.handle(
+        "POST", "/kafkacruisecontrol/admin",
+        "concurrent_partition_movements_per_broker=3")
+    assert status == 200
+    assert cc.executor._concurrency._caps.inter_broker_per_broker == 3
+
+
+def test_pause_resume_and_stop(api, cc):
+    assert api.handle("POST", "/kafkacruisecontrol/pause_sampling",
+                      "reason=maintenance")[0] == 200
+    assert cc.load_monitor.task_runner.sampling_mode.name == "PAUSED"
+    assert api.handle("POST", "/kafkacruisecontrol/resume_sampling")[0] == 200
+    assert cc.load_monitor.task_runner.sampling_mode.name == "RUNNING"
+    assert api.handle("POST",
+                      "/kafkacruisecontrol/stop_proposal_execution")[0] == 200
+
+
+def test_remove_disks_requires_jbod_backend(api):
+    status, body, _ = api.handle("POST", "/kafkacruisecontrol/remove_disks",
+                                 "brokerid_and_logdirs=0-/d1")
+    assert status == 400
+    assert "JBOD" in body["errorMessage"]
+
+
+# ---- two-step review -----------------------------------------------------
+
+def test_two_step_review_flow(cc):
+    api = CruiseControlApi(cc, config=None)
+    api._two_step = True
+    try:
+        status, body, _ = api.handle("POST", "/kafkacruisecontrol/rebalance",
+                                     "dryrun=true")
+        assert status == 200
+        rid = body["reviewResult"]["Id"]
+        assert body["reviewResult"]["Status"] == "PENDING_REVIEW"
+        # Un-approved submission is rejected.
+        status, body2, _ = api.handle("POST", "/kafkacruisecontrol/rebalance",
+                                      f"dryrun=true&review_id={rid}")
+        assert status == 400
+        # Approve via REVIEW, then submit.
+        status, body3, _ = api.handle("POST", "/kafkacruisecontrol/review",
+                                      f"approve={rid}")
+        assert status == 200
+        assert body3["requestInfo"][0]["Status"] == "APPROVED"
+        # Submission replays the REVIEWED query: smuggled parameter changes
+        # (dryrun=false here) are discarded in favor of what was approved.
+        status, body4, _ = api.handle("POST", "/kafkacruisecontrol/rebalance",
+                                      f"dryrun=false&review_id={rid}")
+        assert status == 200 and body4["proposals"]
+        assert body4["dryrun"] is True and body4["executed"] is False
+        status, board, _ = api.handle("GET", "/kafkacruisecontrol/review_board")
+        assert board["requestInfo"][0]["Status"] == "SUBMITTED"
+    finally:
+        api.shutdown()
+
+
+def test_purgatory_transitions():
+    purgatory = Purgatory()
+    info = purgatory.add("REBALANCE", "dryrun=true", "alice")
+    with pytest.raises(ValueError):
+        purgatory.submit(info.review_id, "REBALANCE")   # not approved yet
+    purgatory.approve(info.review_id)
+    with pytest.raises(ValueError):
+        purgatory.submit(info.review_id, "ADD_BROKER")  # endpoint mismatch
+    assert purgatory.submit(info.review_id, "REBALANCE").status \
+        is ReviewStatus.SUBMITTED
+    info2 = purgatory.add("REBALANCE", "", "bob")
+    purgatory.discard(info2.review_id, "nope")
+    with pytest.raises(ValueError):
+        purgatory.approve(info2.review_id)
+
+
+# ---- security ------------------------------------------------------------
+
+def test_basic_security_provider_and_roles(cc):
+    users = parse_credentials_file(
+        "viewer: vpass, VIEWER\nadmin: apass, ADMIN\n")
+    api = CruiseControlApi(cc, BasicSecurityProvider(users=users))
+    try:
+        import base64
+
+        def basic(u, p):
+            return {"Authorization": "Basic "
+                    + base64.b64encode(f"{u}:{p}".encode()).decode()}
+
+        assert api.handle("GET", "/kafkacruisecontrol/state")[0] == 401
+        assert api.handle("GET", "/kafkacruisecontrol/state",
+                          headers=basic("viewer", "wrong"))[0] == 401
+        assert api.handle("GET", "/kafkacruisecontrol/state",
+                          headers=basic("viewer", "vpass"))[0] == 200
+        # VIEWER may not POST rebalance (requires ADMIN).
+        assert api.handle("POST", "/kafkacruisecontrol/rebalance", "dryrun=true",
+                          headers=basic("viewer", "vpass"))[0] == 403
+        assert api.handle("POST", "/kafkacruisecontrol/pause_sampling", "",
+                          headers=basic("admin", "apass"))[0] == 200
+        api.handle("POST", "/kafkacruisecontrol/resume_sampling", "",
+                   headers=basic("admin", "apass"))
+    finally:
+        api.shutdown()
+
+
+def test_jwt_security_provider():
+    secret = b"s3cret"
+    provider = JwtSecurityProvider(secret)
+    token = encode_jwt({"sub": "ops", "roles": ["ADMIN"],
+                        "exp": time.time() + 60}, secret)
+    principal = provider.authenticate({"Authorization": f"Bearer {token}"})
+    assert principal == Principal("ops", Role.ADMIN)
+    expired = encode_jwt({"sub": "ops", "exp": time.time() - 1}, secret)
+    with pytest.raises(AuthenticationError, match="expired"):
+        provider.authenticate({"Authorization": f"Bearer {expired}"})
+    forged = token[:-2] + "xx"
+    with pytest.raises(AuthenticationError, match="signature"):
+        provider.authenticate({"Authorization": f"Bearer {forged}"})
+
+
+def test_trusted_proxy_provider():
+    provider = TrustedProxySecurityProvider({"10.0.0.1"},
+                                            {"alice": Role.ADMIN})
+    p = provider.authenticate({"X-Do-As": "alice"}, remote_addr="10.0.0.1")
+    assert p.role is Role.ADMIN
+    with pytest.raises(AuthenticationError):
+        provider.authenticate({"X-Do-As": "alice"}, remote_addr="10.9.9.9")
+    with pytest.raises(AuthenticationError):
+        provider.authenticate({}, remote_addr="10.0.0.1")
+
+
+# ---- user task manager ---------------------------------------------------
+
+def test_user_task_manager_caps_active_tasks():
+    utm = UserTaskManager(max_active_tasks=1)
+    try:
+        gate = threading.Event()
+        utm.get_or_create_task("STATE", "", gate.wait)
+        with pytest.raises(RuntimeError, match="max active"):
+            utm.get_or_create_task("STATE", "", lambda: None)
+        gate.set()
+    finally:
+        utm.shutdown()
+
+
+# ---- real HTTP round-trip ------------------------------------------------
+
+def test_http_server_round_trip(cc):
+    server, api = make_server(cc, host="127.0.0.1", port=0)
+    from cruise_control_tpu.api.server import serve_forever_in_thread
+    serve_forever_in_thread(server)
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/kafkacruisecontrol/state") as r:
+            assert r.status == 200
+            body = json.loads(r.read())
+            assert "MonitorState" in body
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/kafkacruisecontrol/rebalance?dryrun=true",
+            method="POST")
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+            body = json.loads(r.read())
+            assert body["proposals"]
+    finally:
+        server.shutdown()
+        api.shutdown()
+
+
+# ---- console client ------------------------------------------------------
+
+def test_cccli_against_live_server(cc, capsys):
+    from cruise_control_tpu.client import main as cccli_main
+    server, api = make_server(cc, host="127.0.0.1", port=0)
+    from cruise_control_tpu.api.server import serve_forever_in_thread
+    serve_forever_in_thread(server)
+    try:
+        port = server.server_address[1]
+        rc = cccli_main(["-a", f"http://127.0.0.1:{port}", "state"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert "MonitorState" in out
+        rc = cccli_main(["-a", f"http://127.0.0.1:{port}", "rebalance",
+                         "--dryrun", "true"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["proposals"]
+        # Server-side parameter rejection propagates as a client error.
+        rc = cccli_main(["-a", f"http://127.0.0.1:{port}", "partition_load",
+                         "--resource", "warp"])
+        assert rc == 1
+        assert "unknown resource" in capsys.readouterr().err
+    finally:
+        server.shutdown()
+        api.shutdown()

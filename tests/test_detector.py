@@ -43,6 +43,9 @@ class RecordingFacade:
     def ready_for_self_healing(self):
         return True
 
+    def alive_brokers(self):
+        return set(getattr(self, "_alive", ()))
+
     def __getattr__(self, name):
         def record(*a, **kw):
             self.calls.append((name, a, kw))
@@ -269,6 +272,23 @@ def test_manager_check_with_delay_requeues():
     # The recheck is scheduled in the future, so an immediate take times out.
     assert mgr._take(timeout_s=0.05) is None
     assert len(mgr._recheck) == 1
+
+
+def test_manager_drops_stale_recheck_when_broker_recovers():
+    cfg = CruiseControlConfig({"self.healing.enabled": True,
+                               "broker.failure.self.healing.threshold.ms": 10_000})
+    facade = RecordingFacade()
+    mgr = AnomalyDetectorManager(cfg, SelfHealingNotifier(cfg), facade=facade)
+    anomaly = BrokerFailures(failed_brokers={1: int(time.time() * 1000)})
+    mgr.report(anomaly)
+    assert mgr.handle_anomaly(mgr._take(timeout_s=0.1)) \
+        == AnomalyStatus.CHECK_WITH_DELAY
+    # Broker 1 recovers; force the recheck due and take again → dropped.
+    facade._alive = {1}
+    mgr._recheck = [(time.time() - 1, a) for _t, a in mgr._recheck]
+    assert mgr._take(timeout_s=0.05) is None
+    assert not mgr._recheck
+    assert not facade.calls, "no fix may run for a recovered broker"
 
 
 def test_manager_runs_detector_threads():
