@@ -13,6 +13,14 @@ from .distribution import (
     PreferredLeaderElectionGoal as _PreferredLeaderBase,
     ResourceDistributionGoal, TopicReplicaDistributionGoal as _TopicReplicaBase,
 )
+from .broker_set import BrokerSetAwareGoal as _BrokerSetAwareBase
+from .intra_broker import (
+    IntraBrokerDiskCapacityGoal, IntraBrokerDiskUsageDistributionGoal,
+)
+from .kafka_assigner import (
+    KafkaAssignerDiskUsageDistributionGoal as _KafkaAssignerDiskBase,
+    KafkaAssignerEvenRackAwareGoal as _KafkaAssignerRackBase,
+)
 from .rack import RackAwareDistributionGoal as _RackAwareDistBase, RackAwareGoal as _RackAwareBase
 
 
@@ -21,8 +29,8 @@ def _preset(base, **kwargs):
     instantiate with no args (getConfiguredInstance contract)."""
 
     class _Preset(base):
-        def __init__(self):
-            super().__init__(**kwargs)
+        def __init__(self, **overrides):
+            super().__init__(**{**kwargs, **overrides})
 
     _Preset.__name__ = kwargs.get("name", base.__name__)
     _Preset.__qualname__ = _Preset.__name__
@@ -82,6 +90,14 @@ PreferredLeaderElectionGoal = _preset(_PreferredLeaderBase,
 MinTopicLeadersPerBrokerGoal = _preset(_MinTopicLeadersBase,
                                        name="MinTopicLeadersPerBrokerGoal",
                                        is_hard=True)
+BrokerSetAwareGoal = _preset(_BrokerSetAwareBase, name="BrokerSetAwareGoal",
+                             is_hard=True, partition_additive_scores=True)
+KafkaAssignerEvenRackAwareGoal = _preset(_KafkaAssignerRackBase,
+                                         name="KafkaAssignerEvenRackAwareGoal",
+                                         is_hard=True,
+                                         partition_additive_scores=True)
+KafkaAssignerDiskUsageDistributionGoal = _preset(
+    _KafkaAssignerDiskBase, name="KafkaAssignerDiskUsageDistributionGoal")
 
 ALL_GOALS = {cls.__name__: cls for cls in [
     RackAwareGoal, RackAwareDistributionGoal, ReplicaCapacityGoal,
@@ -92,4 +108,6 @@ ALL_GOALS = {cls.__name__: cls for cls in [
     LeaderReplicaDistributionGoal, TopicReplicaDistributionGoal,
     PotentialNwOutGoal, LeaderBytesInDistributionGoal,
     PreferredLeaderElectionGoal, MinTopicLeadersPerBrokerGoal,
+    BrokerSetAwareGoal, KafkaAssignerEvenRackAwareGoal,
+    KafkaAssignerDiskUsageDistributionGoal,
 ]}

@@ -1,0 +1,65 @@
+"""Intra-broker disk goals.
+
+Reference parity: analyzer/goals/IntraBrokerDiskCapacityGoal.java:316 and
+IntraBrokerDiskUsageDistributionGoal.java:509. Unlike the inter-broker
+goals these act on the (broker, disk) axis with INTRA_BROKER_REPLICA
+actions; brokers are independent, so the whole pass is the [B]-parallel
+``balance_intra_broker`` kernel in model/disks.py — each goal object here
+binds that kernel to its objective (capacity vs balance band) and reports
+violations in the standard goal shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from ...model.disks import (
+    DiskTensors, balance_intra_broker, intra_broker_violations,
+)
+from ...model.tensors import ClusterTensors
+from ..constraint import BALANCE_MARGIN
+
+
+@dataclasses.dataclass(frozen=True)
+class IntraBrokerDiskCapacityGoal:
+    """Hard: no disk above capacity·threshold, nothing on dead disks."""
+
+    name: str = "IntraBrokerDiskCapacityGoal"
+    is_hard: bool = True
+    capacity_threshold: float = 0.8
+
+    def violations(self, state: ClusterTensors, disks: DiskTensors) -> jax.Array:
+        return intra_broker_violations(state, disks, self.capacity_threshold,
+                                       balance_band=None)
+
+    def optimize(self, state: ClusterTensors, disks: DiskTensors,
+                 max_rounds: int = 64) -> DiskTensors:
+        return balance_intra_broker(state, disks, self.capacity_threshold,
+                                    balance_band=None, max_rounds=max_rounds)
+
+
+@dataclasses.dataclass(frozen=True)
+class IntraBrokerDiskUsageDistributionGoal:
+    """Soft: every disk of a broker within avg·(1 ± (threshold-1)·margin)
+    of that broker's mean disk utilization."""
+
+    name: str = "IntraBrokerDiskUsageDistributionGoal"
+    is_hard: bool = False
+    capacity_threshold: float = 0.8
+    balance_threshold: float = 1.1
+
+    def _band(self) -> tuple[float, float]:
+        spread = (self.balance_threshold - 1.0) * BALANCE_MARGIN
+        return 1.0 - spread, 1.0 + spread
+
+    def violations(self, state: ClusterTensors, disks: DiskTensors) -> jax.Array:
+        return intra_broker_violations(state, disks, self.capacity_threshold,
+                                       balance_band=self._band())
+
+    def optimize(self, state: ClusterTensors, disks: DiskTensors,
+                 max_rounds: int = 64) -> DiskTensors:
+        return balance_intra_broker(state, disks, self.capacity_threshold,
+                                    balance_band=self._band(),
+                                    max_rounds=max_rounds)

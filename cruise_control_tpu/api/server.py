@@ -304,6 +304,9 @@ class CruiseControlApi:
                 goals, p.get("ignore_proposal_cache", False)))
 
         def rebalance():
+            if p.get("rebalance_disk"):
+                return responses.optimization_result(
+                    cc.rebalance_disk(dryrun, reason=reason))
             return responses.optimization_result(cc.rebalance(
                 goals, dryrun,
                 excluded_topics=p.get("excluded_topics", ()),

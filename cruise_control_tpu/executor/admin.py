@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Iterable, Mapping, Protocol
+from typing import Iterable, Mapping, Protocol, Sequence
 
 
 @dataclasses.dataclass(frozen=True)
@@ -194,6 +194,45 @@ class InMemoryAdminBackend:
     def describe_topic_configs(self, topics):
         with self._lock:
             return {t: dict(self.topic_configs.get(t, {})) for t in topics}
+
+    # ---- JBOD (log-dir) surface ------------------------------------------
+    def enable_jbod(self, logdirs_by_broker: Mapping[int, Sequence[str]],
+                    placement: Mapping[tuple[str, int, int], str] | None = None,
+                    ) -> None:
+        """Give brokers named log dirs; replicas without an explicit
+        placement land round-robin (tests / demo)."""
+        with self._lock:
+            self._logdirs = {b: {d: True for d in dirs}
+                             for b, dirs in logdirs_by_broker.items()}
+            self._replica_dirs = dict(placement or {})
+            for (topic, part), p in sorted(self._parts.items()):
+                for i, b in enumerate(p.replicas):
+                    key = (topic, part, b)
+                    dirs = sorted(self._logdirs.get(b, {}))
+                    if key not in self._replica_dirs and dirs:
+                        self._replica_dirs[key] = dirs[(part + i) % len(dirs)]
+
+    def kill_logdir(self, broker: int, logdir: str) -> None:
+        with self._lock:
+            self._logdirs[broker][logdir] = False
+
+    def describe_logdirs(self) -> dict[int, dict[str, bool]]:
+        with self._lock:
+            if not hasattr(self, "_logdirs"):
+                return {}
+            return {b: dict(d) for b, d in self._logdirs.items()}
+
+    def replica_logdirs(self) -> dict[tuple[str, int, int], str]:
+        with self._lock:
+            return dict(getattr(self, "_replica_dirs", {}))
+
+    def alter_replica_logdirs(self, moves: Sequence[tuple[tuple[str, int], int, str]],
+                              ) -> None:
+        """(topic-partition, broker, destination dir) — immediate apply
+        (the real AdminClient's alterReplicaLogDirs)."""
+        with self._lock:
+            for (topic, part), broker, dst in moves:
+                self._replica_dirs[(topic, part, broker)] = dst
 
     # ---- ClusterInfo protocol for strategies ------------------------------
     def partition_size(self, topic: str, partition: int) -> float:

@@ -414,51 +414,77 @@ class CruiseControl:
                                extra={"replicationFactor": replication_factor,
                                       "topics": sorted(want)})
 
+    def _disk_model(self, state, meta):
+        """(DiskTensors, DiskMeta) from the backend's JBOD surface, or raise
+        when the backend is not JBOD-capable."""
+        from .model.disks import build_disk_tensors
+        replica_dirs_fn = getattr(self._admin, "replica_logdirs", None)
+        logdirs_fn = getattr(self._admin, "describe_logdirs", None)
+        if replica_dirs_fn is None or logdirs_fn is None or not logdirs_fn():
+            raise ValueError(
+                "operation requires a JBOD-capable admin backend "
+                "(replica_logdirs/describe_logdirs)")
+        return build_disk_tensors(state, meta, logdirs_fn(), replica_dirs_fn())
+
+    def _intra_broker_result(self, operation, state, meta, disks0, disks1,
+                             disk_meta, dryrun, reason) -> OperationResult:
+        from .model.disks import diff_intra_broker_moves
+        moves = diff_intra_broker_moves(disks0, disks1, state, meta, disk_meta)
+        executed = False
+        if moves and not dryrun:
+            self._admin.alter_replica_logdirs(
+                [((m.topic, m.partition), m.broker_id, m.destination_logdir)
+                 for m in moves])
+            executed = True
+        return OperationResult(
+            operation, dryrun, executed=executed, reason=reason,
+            extra={"intraBrokerMoves": [
+                {"topic": m.topic, "partition": m.partition,
+                 "broker": m.broker_id, "sourceLogdir": m.source_logdir,
+                 "destinationLogdir": m.destination_logdir} for m in moves]})
+
     def remove_disks(self, broker_logdirs: Mapping[int, Sequence[str]],
                      dryrun: bool = True, reason: str = "",
                      uuid: str = "") -> OperationResult:
-        """RemoveDisksRunnable — evacuate the named log dirs. Requires a
-        JBOD-capable backend exposing per-replica log dirs
-        (``replica_logdirs()``); replicas on the target dirs are moved to
-        the broker's remaining alive dirs (round-robin by current count,
-        the reference's intra-broker rebalance-after-removal)."""
-        replica_dirs_fn = getattr(self._admin, "replica_logdirs", None)
-        logdirs_fn = getattr(self._admin, "describe_logdirs", None)
-        if replica_dirs_fn is None or logdirs_fn is None:
-            raise ValueError(
-                "remove_disks requires a JBOD-capable admin backend "
-                "(replica_logdirs/describe_logdirs)")
-        replica_dirs: Mapping[tuple[str, int, int], str] = replica_dirs_fn()
-        logdirs = logdirs_fn()
-        moves: list[tuple[tuple[str, int], int, str]] = []  # (tp, broker, dst dir)
-        dir_counts: dict[tuple[int, str], int] = {}
-        for (t, p, b), d in replica_dirs.items():
-            dir_counts[(b, d)] = dir_counts.get((b, d), 0) + 1
+        """RemoveDisksRunnable — mark the named log dirs dead in the disk
+        model and drain them with the [B]-parallel intra-broker kernel
+        (heaviest replicas first onto the least-utilized remaining dirs)."""
+        import dataclasses as dc
+
+        import jax.numpy as jnp
+
+        from .analyzer.goals.intra_broker import IntraBrokerDiskCapacityGoal
+        state, meta = self._model()
+        disks, disk_meta = self._disk_model(state, meta)
+        dead = np.asarray(disks.disk_alive).copy()
+        idx = {bid: i for i, bid in enumerate(meta.broker_ids)}
         for broker, dirs in broker_logdirs.items():
-            removed = set(dirs)
-            remaining = [d for d, online in logdirs.get(broker, {}).items()
-                         if online and d not in removed]
-            if not remaining:
-                raise ValueError(
-                    f"broker {broker}: no remaining alive log dirs")
-            for (t, p, b), d in sorted(replica_dirs.items()):
-                if b != broker or d not in removed:
-                    continue
-                dst = min(remaining, key=lambda x: dir_counts.get((broker, x), 0))
-                dir_counts[(broker, dst)] = dir_counts.get((broker, dst), 0) + 1
-                moves.append(((t, p), broker, dst))
-        executed = False
-        if moves and not dryrun:
-            alter = getattr(self._admin, "alter_replica_logdirs", None)
-            if alter is None:
-                raise ValueError("backend cannot alter replica log dirs")
-            alter([(tp, broker, dst) for tp, broker, dst in moves])
-            executed = True
-        return OperationResult(
-            "remove_disks", dryrun, executed=executed, reason=reason,
-            extra={"intraBrokerMoves": [
-                {"topic": tp[0], "partition": tp[1], "broker": broker,
-                 "destinationLogdir": dst} for tp, broker, dst in moves]})
+            if broker not in idx:
+                raise ValueError(f"unknown broker {broker}")
+            i = idx[broker]
+            for d in dirs:
+                if d not in disk_meta.dir_names[i]:
+                    raise ValueError(f"broker {broker} has no log dir {d!r}")
+                dead[i, disk_meta.dir_names[i].index(d)] = False
+            if not dead[i].any():
+                raise ValueError(f"broker {broker}: no remaining alive log dirs")
+        marked = dc.replace(disks, disk_alive=jnp.asarray(dead))
+        balanced = IntraBrokerDiskCapacityGoal().optimize(state, marked)
+        return self._intra_broker_result("remove_disks", state, meta, marked,
+                                         balanced, disk_meta, dryrun, reason)
+
+    def rebalance_disk(self, dryrun: bool = True, reason: str = "",
+                       uuid: str = "") -> OperationResult:
+        """REBALANCE?rebalance_disk=true — intra-broker disk-usage balance
+        (IntraBrokerDiskUsageDistributionGoal over every broker at once)."""
+        from .analyzer.goals.intra_broker import (
+            IntraBrokerDiskUsageDistributionGoal,
+        )
+        state, meta = self._model()
+        disks, disk_meta = self._disk_model(state, meta)
+        balanced = IntraBrokerDiskUsageDistributionGoal().optimize(state, disks)
+        return self._intra_broker_result("rebalance_disk", state, meta, disks,
+                                         balanced, disk_meta, dryrun, reason)
 
     def rightsize(self, num_brokers_to_add: int = 0, partition_count: int = 0,
                   topic: str | None = None) -> OperationResult:

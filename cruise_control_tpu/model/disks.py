@@ -1,0 +1,261 @@
+"""JBOD disk modeling + the intra-broker disk balancer.
+
+Reference parity: model/Disk.java (per-disk capacity + ALIVE/DEAD state),
+ClusterModel's disk-aware replica placement, and the intra-broker goals
+IntraBrokerDiskCapacityGoal.java:316 /
+IntraBrokerDiskUsageDistributionGoal.java:509 (move replicas between one
+broker's log dirs to respect per-disk capacity and balance usage).
+
+Kernel design: brokers are INDEPENDENT for intra-broker moves, so the
+balancer runs one move per broker per round, every broker in parallel — a
+[B]-wide vectorized greedy with no conflict resolution needed (the
+reference serializes disk-by-disk inside each broker). Disk identity is
+(broker, disk-slot); dead disks are treated as infinitely over capacity so
+their replicas drain first (the remove-disks / fix-offline-dirs path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.resources import Resource
+from .tensors import ClusterMeta, ClusterTensors, replica_exists, replica_load
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["disk_assignment", "disk_capacity", "disk_alive"],
+         meta_fields=[])
+@dataclasses.dataclass(frozen=True)
+class DiskTensors:
+    disk_assignment: jax.Array   # [P, S] int32 — disk slot within the broker, -1 none
+    disk_capacity: jax.Array     # [B, D] float32 — 0 = slot unused
+    disk_alive: jax.Array        # [B, D] bool
+
+    @property
+    def max_disks(self) -> int:
+        return self.disk_capacity.shape[1]
+
+
+@dataclasses.dataclass
+class DiskMeta:
+    """Host-side log-dir names per (broker index, disk slot)."""
+
+    dir_names: list[list[str]]   # [B][D] ('' for unused slots)
+
+    def slot_of(self, broker_idx: int, logdir: str) -> int:
+        return self.dir_names[broker_idx].index(logdir)
+
+
+def disk_load(state: ClusterTensors, disks: DiskTensors) -> jax.Array:
+    """[B, D] — disk-resource load per (broker, disk slot)."""
+    b, d = state.num_brokers, disks.max_disks
+    exists = replica_exists(state) & (disks.disk_assignment >= 0)
+    seg = jnp.where(exists,
+                    state.assignment * d + disks.disk_assignment,
+                    b * d)
+    load = replica_load(state)[:, :, Resource.DISK]
+    out = jax.ops.segment_sum(jnp.where(exists, load, 0.0).reshape(-1),
+                              seg.reshape(-1), num_segments=b * d + 1)
+    return out[: b * d].reshape(b, d)
+
+
+def intra_broker_violations(state: ClusterTensors, disks: DiskTensors,
+                            capacity_threshold: float = 0.8,
+                            balance_band: tuple[float, float] | None = None,
+                            ) -> jax.Array:
+    """[B, D] violation magnitude: load beyond capacity·threshold, any load
+    on a dead disk, and (optionally) load outside the per-broker balance
+    band — the two intra-broker goals' objectives fused."""
+    load = disk_load(state, disks)
+    cap = disks.disk_capacity
+    present = cap > 0
+    over_cap = jnp.maximum(load - cap * capacity_threshold, 0.0)
+    dead = present & ~disks.disk_alive
+    v = jnp.where(present, over_cap, 0.0) + jnp.where(dead, load, 0.0)
+    if balance_band is not None:
+        lower, upper = balance_band
+        util = jnp.where(present, load / jnp.maximum(cap, 1e-9), 0.0)
+        alive = present & disks.disk_alive
+        n_alive = jnp.maximum(alive.sum(axis=1, keepdims=True), 1)
+        avg = (util * alive).sum(axis=1, keepdims=True) / n_alive
+        band_v = jnp.maximum(util - avg * upper, 0.0) \
+            + jnp.maximum(avg * lower - util, 0.0)
+        v = v + jnp.where(alive, band_v * cap, 0.0)
+    return v
+
+
+def balance_intra_broker(state: ClusterTensors, disks: DiskTensors,
+                         capacity_threshold: float = 0.8,
+                         balance_band: tuple[float, float] | None = None,
+                         max_rounds: int = 64) -> DiskTensors:
+    """One fused `lax.while_loop`: per round, EVERY broker moves the
+    heaviest replica off its most-violating disk onto its least-utilized
+    alive disk (if that improves the violation), until fixed-point."""
+    b, d = state.num_brokers, disks.max_disks
+    p_count, s = state.assignment.shape
+    rep_load = replica_load(state)[:, :, Resource.DISK]            # [P, S]
+    exists = replica_exists(state)
+    # Flatten replicas for per-(broker,disk) argmax selection: for each
+    # (broker, disk) find its heaviest replica each round via segment_max.
+    flat_broker = jnp.where(exists, state.assignment, b).reshape(-1)
+    flat_load = jnp.where(exists, rep_load, -1.0).reshape(-1)
+
+    def round_fn(carry):
+        assign, _moved = carry
+        load = _disk_load_from(assign)
+        cap = disks.disk_capacity
+        present = cap > 0
+        alive = present & disks.disk_alive
+        # Source pressure = only the SHED side of the violation (over
+        # capacity, dead-disk load, above the band): an underfull disk has
+        # nothing to move and is the *destination*, not a source.
+        viol = _shed_pressure_from(load)
+        src_disk = jnp.argmax(viol, axis=1)                         # [B]
+        has_viol = jnp.take_along_axis(viol, src_disk[:, None], axis=1)[:, 0] > 1e-9
+        util = jnp.where(alive, load / jnp.maximum(cap, 1e-9), jnp.inf)
+        dst_disk = jnp.argmin(util, axis=1)                         # [B]
+        dst_ok = jnp.take_along_axis(alive, dst_disk[:, None], axis=1)[:, 0] \
+            & (dst_disk != src_disk)
+
+        # Heaviest replica on (broker, src_disk[broker]) per broker.
+        flat_disk = jnp.where((assign >= 0) & exists, assign, -1).reshape(-1)
+        on_src = (flat_disk == src_disk[jnp.clip(flat_broker, 0, b - 1)]) \
+            & (flat_broker < b)
+        seg = jnp.where(on_src, flat_broker, b)
+        # argmax per broker via one-hot of max value
+        score = jnp.where(on_src, flat_load, -1.0)
+        best = jax.ops.segment_max(score, seg, num_segments=b + 1)[:b]   # [B]
+        is_best = on_src & (score == best[jnp.clip(flat_broker, 0, b - 1)]) \
+            & (score >= 0)
+        # First best index per broker:
+        idx = jnp.where(is_best, jnp.arange(p_count * s), p_count * s)
+        pick = jax.ops.segment_min(idx, seg, num_segments=b + 1)[:b]     # [B]
+        valid = has_viol & dst_ok & (pick < p_count * s)
+
+        rows = jnp.clip(pick // s, 0, p_count - 1)
+        cols = jnp.clip(pick % s, 0, s - 1)
+        new_assign = assign.at[rows, cols].set(
+            jnp.where(valid, dst_disk.astype(assign.dtype),
+                      assign[rows, cols]))
+        return new_assign, valid.any()
+
+    def _disk_load_from(assign):
+        ex = exists & (assign >= 0)
+        seg = jnp.where(ex, state.assignment * d + assign, b * d)
+        out = jax.ops.segment_sum(jnp.where(ex, rep_load, 0.0).reshape(-1),
+                                  seg.reshape(-1), num_segments=b * d + 1)
+        return out[: b * d].reshape(b, d)
+
+    def _shed_pressure_from(load):
+        cap = disks.disk_capacity
+        present = cap > 0
+        over = jnp.maximum(load - cap * capacity_threshold, 0.0)
+        dead = present & ~disks.disk_alive
+        v = jnp.where(present, over, 0.0) + jnp.where(dead, load, 0.0)
+        if balance_band is not None:
+            _lower, upper = balance_band
+            util = jnp.where(present, load / jnp.maximum(cap, 1e-9), 0.0)
+            alive = present & disks.disk_alive
+            n_alive = jnp.maximum(alive.sum(axis=1, keepdims=True), 1)
+            avg = (util * alive).sum(axis=1, keepdims=True) / n_alive
+            v = v + jnp.where(alive,
+                              jnp.maximum(util - avg * upper, 0.0) * cap, 0.0)
+        return v
+
+    def cond(carry_round):
+        (_assign, moved), i = carry_round
+        return moved & (i < max_rounds)
+
+    def body(carry_round):
+        (assign, _moved), i = carry_round
+        return round_fn((assign, True)), i + 1
+
+    (assign, _), _rounds = jax.lax.while_loop(
+        cond, body, ((disks.disk_assignment, jnp.asarray(True)),
+                     jnp.asarray(0)))
+    return dataclasses.replace(disks, disk_assignment=assign)
+
+
+@dataclasses.dataclass(frozen=True)
+class IntraBrokerMove:
+    """One logdir move (ExecutionProposal's intra-broker leg)."""
+
+    topic: str
+    partition: int
+    broker_id: int
+    source_logdir: str
+    destination_logdir: str
+
+
+def diff_intra_broker_moves(initial: DiskTensors, final: DiskTensors,
+                            state: ClusterTensors, meta: ClusterMeta,
+                            disk_meta: DiskMeta) -> list[IntraBrokerMove]:
+    """Mirror of AnalyzerUtils.getDiff for the disk axis."""
+    before = np.asarray(initial.disk_assignment)
+    after = np.asarray(final.disk_assignment)
+    assign = np.asarray(state.assignment)
+    exists = np.asarray(replica_exists(state))
+    moves: list[IntraBrokerMove] = []
+    for p_idx, s_idx in zip(*np.nonzero((before != after) & exists)):
+        broker_idx = int(assign[p_idx, s_idx])
+        topic, part = meta.partition_index[int(p_idx)]
+        names = disk_meta.dir_names[broker_idx]
+        moves.append(IntraBrokerMove(
+            topic=topic, partition=part,
+            broker_id=meta.broker_ids[broker_idx],
+            source_logdir=names[int(before[p_idx, s_idx])],
+            destination_logdir=names[int(after[p_idx, s_idx])]))
+    return moves
+
+
+def build_disk_tensors(state: ClusterTensors, meta: ClusterMeta,
+                       logdirs_by_broker: dict[int, dict[str, bool]],
+                       replica_dirs: dict[tuple[str, int, int], str],
+                       capacity_by_dir: dict[tuple[int, str], float] | None = None,
+                       default_capacity: float = 1e12,
+                       ) -> tuple[DiskTensors, DiskMeta]:
+    """Assemble DiskTensors from backend JBOD facts (describe_logdirs +
+    replica_logdirs + per-dir capacities from capacityJBOD.json)."""
+    b = state.num_brokers
+    s = state.max_replication_factor
+    idx_of = {bid: i for i, bid in enumerate(meta.broker_ids)}
+    dir_names: list[list[str]] = [[] for _ in range(b)]
+    for bid, dirs in logdirs_by_broker.items():
+        if bid in idx_of:
+            dir_names[idx_of[bid]] = sorted(dirs)
+    d = max((len(n) for n in dir_names), default=1) or 1
+    cap = np.zeros((b, d), dtype=np.float32)
+    alive = np.zeros((b, d), dtype=bool)
+    for bid, dirs in logdirs_by_broker.items():
+        if bid not in idx_of:
+            continue
+        i = idx_of[bid]
+        for slot, name in enumerate(dir_names[i]):
+            cap[i, slot] = (capacity_by_dir or {}).get((bid, name),
+                                                       default_capacity)
+            alive[i, slot] = dirs[name]
+        dir_names[i] += [""] * (d - len(dir_names[i]))
+    for i in range(b):
+        if not dir_names[i]:
+            dir_names[i] = [""] * d
+
+    assign = np.asarray(state.assignment)
+    disk_assign = np.full((state.num_partitions, s), -1, dtype=np.int32)
+    for p_idx, (topic, part) in enumerate(meta.partition_index):
+        for s_idx in range(s):
+            broker_idx = assign[p_idx, s_idx]
+            if broker_idx < 0:
+                continue
+            bid = meta.broker_ids[broker_idx]
+            logdir = replica_dirs.get((topic, part, bid))
+            if logdir and logdir in dir_names[broker_idx]:
+                disk_assign[p_idx, s_idx] = dir_names[broker_idx].index(logdir)
+    return (DiskTensors(disk_assignment=jnp.asarray(disk_assign),
+                        disk_capacity=jnp.asarray(cap),
+                        disk_alive=jnp.asarray(alive)),
+            DiskMeta(dir_names=dir_names))

@@ -190,3 +190,63 @@ def test_no_phantom_replicas_after_optimization():
     for row in a1[np.asarray(final.partition_mask)]:
         live = row[row >= 0]
         assert len(set(live.tolist())) == len(live)
+
+
+def test_broker_set_aware_goal_confines_topics():
+    from cruise_control_tpu.analyzer.goals import BrokerSetAwareGoal
+    from cruise_control_tpu.model.builder import ClusterModelBuilder
+
+    cap = {Resource.CPU: 100.0, Resource.NW_IN: 1e5, Resource.NW_OUT: 1e5,
+           Resource.DISK: 1e6}
+    load = {Resource.CPU: 1.0, Resource.NW_IN: 10.0, Resource.NW_OUT: 10.0,
+            Resource.DISK: 100.0}
+    b = ClusterModelBuilder()
+    for i in range(4):
+        b.add_broker(i, f"r{i}", cap)
+    # Topic tA lives mostly in set 0 (brokers 0,1) with one stray replica on
+    # broker 3 (set 1); topic tB mostly set 1 with a stray on broker 0.
+    b.add_partition("tA", 0, [0, 1], leader_load=load)
+    b.add_partition("tA", 1, [1, 3], leader_load=load)
+    b.add_partition("tA", 2, [0, 1], leader_load=load)
+    b.add_partition("tB", 0, [2, 3], leader_load=load)
+    b.add_partition("tB", 1, [3, 0], leader_load=load)
+    b.add_partition("tB", 2, [2, 3], leader_load=load)
+    state, meta = b.build()
+    goal = BrokerSetAwareGoal(broker_sets=(0, 0, 1, 1))
+    final, info = run_goal(state, goal, meta.num_topics)
+    assert info["succeeded"]
+    assign = np.asarray(final.assignment)
+    sets = np.array([0, 0, 1, 1])
+    for p_idx, (topic, _p) in enumerate(meta.partition_index):
+        placed = [sets[b] for b in assign[p_idx] if b >= 0]
+        want = 0 if topic == "tA" else 1
+        assert all(s == want for s in placed), (topic, placed)
+
+
+def test_kafka_assigner_even_rack_aware_goal():
+    from cruise_control_tpu.analyzer.goals import KafkaAssignerEvenRackAwareGoal
+    state, meta = fixtures.random_cluster(
+        num_brokers=6, num_topics=3, num_partitions=24, rf=2, num_racks=3,
+        seed=3, skew_to_first=2.0)
+    final, info = run_goal(state, KafkaAssignerEvenRackAwareGoal(),
+                           meta.num_topics)
+    counts = np.asarray(rack_partition_counts(final, len(meta.rack_names)))
+    live = np.asarray(final.partition_mask)
+    assert (counts[live] <= 1).all(), "rack-awareness must hold"
+    reps = np.asarray(broker_replica_counts(final))[:6]
+    total = reps.sum()
+    assert reps.max() <= int(np.ceil(total / 6)) + 1, reps
+
+
+def test_kafka_assigner_disk_goal_balances_disk():
+    from cruise_control_tpu.analyzer.goals import (
+        KafkaAssignerDiskUsageDistributionGoal,
+    )
+    state, meta = fixtures.random_cluster(
+        num_brokers=5, num_topics=2, num_partitions=40, rf=2, num_racks=2,
+        seed=5, skew_to_first=3.0, target_utilization=0.5)
+    goal = KafkaAssignerDiskUsageDistributionGoal()
+    before = np.asarray(broker_load(state))[:, int(Resource.DISK)]
+    final, info = run_goal(state, goal, meta.num_topics)
+    after = np.asarray(broker_load(final))[:, int(Resource.DISK)]
+    assert after.std() < before.std(), (before, after)

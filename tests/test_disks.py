@@ -1,0 +1,150 @@
+"""JBOD disk model + intra-broker balancing (reference parity: Disk.java,
+IntraBrokerDiskCapacityGoal, IntraBrokerDiskUsageDistributionGoal,
+RemoveDisksRunnable)."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.goals.intra_broker import (
+    IntraBrokerDiskCapacityGoal, IntraBrokerDiskUsageDistributionGoal,
+)
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.builder import ClusterModelBuilder
+from cruise_control_tpu.model.disks import (
+    DiskMeta, DiskTensors, balance_intra_broker, build_disk_tensors,
+    diff_intra_broker_moves, disk_load, intra_broker_violations,
+)
+
+CAP = {Resource.CPU: 100.0, Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6,
+       Resource.DISK: 1e6}
+
+
+def _cluster(num_brokers=2, parts=6, disk_mb=100.0):
+    b = ClusterModelBuilder()
+    for i in range(num_brokers):
+        b.add_broker(i, f"r{i}", CAP)
+    load = {Resource.CPU: 1.0, Resource.NW_IN: 10.0, Resource.NW_OUT: 10.0,
+            Resource.DISK: disk_mb}
+    for p in range(parts):
+        b.add_partition("t0", p, [p % num_brokers], leader_load=load)
+    return b.build()
+
+
+def _disks(state, meta, num_dirs=2, capacity=400.0, skew_all_to_first=True):
+    p, s = state.assignment.shape
+    b = state.num_brokers
+    assign = np.asarray(state.assignment)
+    disk_assign = np.where(assign >= 0,
+                           0 if skew_all_to_first else assign % num_dirs, -1)
+    cap = np.full((b, num_dirs), capacity, dtype=np.float32)
+    alive = np.ones((b, num_dirs), dtype=bool)
+    disks = DiskTensors(disk_assignment=jnp.asarray(disk_assign, jnp.int32),
+                        disk_capacity=jnp.asarray(cap),
+                        disk_alive=jnp.asarray(alive))
+    dm = DiskMeta(dir_names=[[f"/d{k}" for k in range(num_dirs)]
+                             for _ in range(b)])
+    return disks, dm
+
+
+def test_disk_load_accounting():
+    state, meta = _cluster(num_brokers=2, parts=6, disk_mb=100.0)
+    disks, _ = _disks(state, meta)
+    loads = np.asarray(disk_load(state, disks))
+    # 3 partitions per broker, all on disk 0.
+    np.testing.assert_allclose(loads[:, 0], 300.0)
+    np.testing.assert_allclose(loads[:, 1], 0.0)
+
+
+def test_capacity_goal_drains_overfull_disk():
+    state, meta = _cluster(num_brokers=2, parts=6, disk_mb=100.0)
+    disks, dm = _disks(state, meta, capacity=300.0)   # 300 on d0, cap·0.8=240
+    goal = IntraBrokerDiskCapacityGoal()
+    assert float(goal.violations(state, disks).sum()) > 0
+    fixed = goal.optimize(state, disks)
+    assert float(goal.violations(state, fixed).sum()) == pytest.approx(0.0)
+    moves = diff_intra_broker_moves(disks, fixed, state, meta, dm)
+    assert moves and all(m.source_logdir == "/d0" and
+                         m.destination_logdir == "/d1" for m in moves)
+
+
+def test_dead_disk_fully_drains():
+    state, meta = _cluster(num_brokers=2, parts=6, disk_mb=100.0)
+    disks, dm = _disks(state, meta, capacity=1000.0)
+    dead = np.asarray(disks.disk_alive).copy()
+    dead[0, 0] = False                      # broker 0's /d0 dies
+    disks = dataclasses.replace(disks, disk_alive=jnp.asarray(dead))
+    fixed = balance_intra_broker(state, disks, capacity_threshold=0.8)
+    loads = np.asarray(disk_load(state, fixed))
+    assert loads[0, 0] == pytest.approx(0.0), "dead disk must drain"
+    assert loads[0, 1] == pytest.approx(300.0)
+    # Broker 1 untouched.
+    assert loads[1, 0] == pytest.approx(300.0)
+
+
+def test_usage_distribution_goal_balances_within_broker():
+    state, meta = _cluster(num_brokers=1, parts=8, disk_mb=100.0)
+    disks, _dm = _disks(state, meta, num_dirs=2, capacity=2000.0)
+    goal = IntraBrokerDiskUsageDistributionGoal()
+    fixed = goal.optimize(state, disks)
+    loads = np.asarray(disk_load(state, fixed))[0]
+    assert abs(loads[0] - loads[1]) <= 100.0, loads   # within one replica
+
+
+def test_build_disk_tensors_from_backend_facts():
+    state, meta = _cluster(num_brokers=2, parts=4, disk_mb=50.0)
+    logdirs = {0: {"/a": True, "/b": True}, 1: {"/a": True, "/b": False}}
+    replica_dirs = {("t0", 0, 0): "/a", ("t0", 2, 0): "/b",
+                    ("t0", 1, 1): "/a", ("t0", 3, 1): "/a"}
+    disks, dm = build_disk_tensors(state, meta, logdirs, replica_dirs,
+                                   capacity_by_dir={(0, "/a"): 111.0})
+    assert dm.dir_names[0] == ["/a", "/b"]
+    cap = np.asarray(disks.disk_capacity)
+    assert cap[0, 0] == pytest.approx(111.0)
+    alive = np.asarray(disks.disk_alive)
+    assert alive[1, 0] and not alive[1, 1]
+    loads = np.asarray(disk_load(state, disks))
+    assert loads[0, 0] == pytest.approx(50.0)
+    assert loads[0, 1] == pytest.approx(50.0)
+    assert loads[1, 0] == pytest.approx(100.0)
+
+
+def test_facade_remove_disks_and_rebalance_disk():
+    from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+    from cruise_control_tpu.executor.admin import InMemoryAdminBackend, PartitionState
+    from cruise_control_tpu.executor.executor import Executor
+    from cruise_control_tpu.facade import CruiseControl
+    from cruise_control_tpu.monitor import LoadMonitor, StaticCapacityResolver
+    from cruise_control_tpu.monitor.sampling import SyntheticSampler
+
+    parts = {("t0", p): PartitionState("t0", p, (p % 2,), p % 2,
+                                       isr=(p % 2,)) for p in range(6)}
+    backend = InMemoryAdminBackend(parts.values())
+    backend.enable_jbod({0: ["/d0", "/d1"], 1: ["/d0", "/d1"]})
+    cfg = CruiseControlConfig({"partition.metrics.window.ms": 1000,
+                               "num.partition.metrics.windows": 3,
+                               "min.valid.partition.ratio": 0.0,
+                               "failed.brokers.file.path": ""})
+    caps = StaticCapacityResolver({}, {Resource.CPU: 100.0, Resource.DISK: 1e7,
+                                       Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6})
+    monitor = LoadMonitor(cfg, backend, samplers=[SyntheticSampler()],
+                          capacity_resolver=caps)
+    cc = CruiseControl(cfg, backend, load_monitor=monitor,
+                       executor=Executor(backend, synchronous=True))
+    for k in range(1, 4):
+        monitor.task_runner.run_sampling_once(end_ms=k * 1000)
+
+    res = cc.remove_disks({0: ["/d0"]}, dryrun=False)
+    assert res.executed
+    after = backend.replica_logdirs()
+    for (topic, part, broker), d in after.items():
+        if broker == 0:
+            assert d == "/d1", (topic, part, d)
+    with pytest.raises(ValueError, match="no remaining alive"):
+        cc.remove_disks({0: ["/d0", "/d1"]})
+
+    res2 = cc.rebalance_disk(dryrun=True)
+    assert res2.operation == "rebalance_disk"
+    assert not res2.executed
