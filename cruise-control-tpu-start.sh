@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Start cruise-control-tpu (reference parity: kafka-cruise-control-start.sh).
+# Usage: ./cruise-control-tpu-start.sh [config/cruisecontrol.properties] [port]
+set -euo pipefail
+base_dir=$(dirname "$0")
+config=${1:-"$base_dir/config/cruisecontrol.properties"}
+port=${2:-}
+args=(--properties "$config" --demo)
+[[ -n "$port" ]] && args+=(--port "$port")
+mkdir -p "$base_dir/fileStore"
+echo $$ > "$base_dir/fileStore/cruise-control-tpu.pid"
+exec python -m cruise_control_tpu.api.app "${args[@]}"

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Stop cruise-control-tpu (reference parity: kafka-cruise-control-stop.sh).
+set -euo pipefail
+base_dir=$(dirname "$0")
+pidfile="$base_dir/fileStore/cruise-control-tpu.pid"
+if [[ -f "$pidfile" ]]; then
+  kill "$(cat "$pidfile")" 2>/dev/null || true
+  rm -f "$pidfile"
+  echo "stopped"
+else
+  pkill -f "cruise_control_tpu.api.app" || echo "no running instance found"
+fi

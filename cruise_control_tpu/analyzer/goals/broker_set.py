@@ -26,6 +26,23 @@ from ..candidates import CandidateDeltas
 from .base import Goal
 
 
+def broker_sets_from_file(path: str, broker_ids: list[int]) -> tuple[int, ...]:
+    """Parse brokerSets.json ({"brokerSets": [{"brokerSetId", "brokerIds"}]})
+    into the per-broker-index set-id tuple this goal consumes. Brokers not
+    named by any set share one implicit trailing set (the reference treats
+    unmapped brokers as an error; the implicit set keeps dev clusters
+    usable while still confining mapped topics)."""
+    import json
+    with open(path) as f:
+        doc = json.load(f)
+    set_of: dict[int, int] = {}
+    for k, entry in enumerate(doc.get("brokerSets", [])):
+        for bid in entry.get("brokerIds", []):
+            set_of[int(bid)] = k
+    implicit = len(doc.get("brokerSets", []))
+    return tuple(set_of.get(bid, implicit) for bid in broker_ids)
+
+
 @dataclasses.dataclass(frozen=True)
 class BrokerSetAwareGoal(Goal):
     name: str = "BrokerSetAwareGoal"

@@ -239,9 +239,11 @@ class CruiseControlApi:
                                       p.get("clearmetrics", True))
             return responses.envelope({"message": "bootstrap started"})
         if endpoint is EndPoint.TRAIN:
+            start = p.get("start", 0)
+            end = p.get("end", int(time.time() * 1000))
             return responses.envelope(
-                {"message": "training window recorded",
-                 "start": p.get("start"), "end": p.get("end")})
+                {"message": "training pass completed",
+                 **cc.load_monitor.train(start, end)})
         if endpoint is EndPoint.RIGHTSIZE:
             res = cc.rightsize(p.get("numbrokerstoadd", 0),
                                p.get("partition_count", 0), p.get("topic"))

@@ -133,6 +133,42 @@ class LoadMonitor:
     def resume_metric_sampling(self, reason: str = "") -> None:
         self._task_runner.set_mode(SamplingMode.RUNNING, reason)
 
+    def train(self, start_ms: int, end_ms: int) -> dict:
+        """TRAIN endpoint flow (TrainingTask → LinearRegressionModelParameters
+        .updateModelCoefficient:70): feed the broker aggregator's windowed
+        (CPU, leader-in, leader-out, replication-in) rows into the linear
+        CPU model; on a successful fit the estimator switches over."""
+        from ..metricdef.kafka_metric_def import BrokerMetric, KafkaMetricDef
+        from ..model.cpu_estimation import LinearRegressionCpuModel
+        from .aggregator.aggregator import AggregationOptions, Granularity
+
+        if self._cpu.linear_model is None:
+            self._cpu.linear_model = LinearRegressionCpuModel()
+        bdef = KafkaMetricDef.broker_metric_def()
+        opts = AggregationOptions(min_valid_entity_ratio=0.0, min_valid_windows=1,
+                                  granularity=Granularity.ENTITY,
+                                  include_invalid_entities=True)
+        agg = self._broker_agg.aggregate(opts)
+        window_ms = self._broker_agg.window_ms
+        valid = [i for i, w in enumerate(agg.window_indices)
+                 if start_ms <= w * window_ms <= end_ms]
+        ids = [bdef.metric_info(n).id for n in
+               (CM.CPU_USAGE.name, CM.LEADER_BYTES_IN.name,
+                CM.LEADER_BYTES_OUT.name, CM.REPLICATION_BYTES_IN_RATE.name)]
+        if valid and len(agg.entities):
+            cols = agg.values[:, :, valid]                     # [E, M, W']
+            self._cpu.linear_model.add_observations(
+                cols[:, ids[0], :], cols[:, ids[1], :],
+                cols[:, ids[2], :], cols[:, ids[3], :])
+        trained = self._cpu.linear_model.train()
+        if trained:
+            self._cpu.use_linear_regression = True
+        return {"trained": trained,
+                "trainingCompleteness": self._cpu.linear_model.training_completeness,
+                "coefficients": (None if not trained else
+                                 [float(c) for c in
+                                  self._cpu.linear_model.coefficients])}
+
     def bootstrap(self, start_ms: int, end_ms: int, clear_metrics: bool = True) -> None:
         self._task_runner.bootstrap(start_ms, end_ms, clear_metrics)
 

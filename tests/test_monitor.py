@@ -184,3 +184,36 @@ def test_sample_store_warm_restart(tmp_path):
         assert m2.partition_aggregator.num_samples() == n_before
     finally:
         m2.shutdown()
+
+
+def test_train_fits_linear_cpu_model():
+    """TRAIN flow: diverse (CPU, traffic) broker windows -> least-squares
+    coefficients; the estimator switches to the trained model
+    (LinearRegressionModelParameters.updateModelCoefficient idea)."""
+    from cruise_control_tpu.metricdef.kafka_metric_def import KafkaMetricDef
+    from cruise_control_tpu.monitor.sampling.samples import BrokerEntity
+
+    partitions = _partitions(n_topics=1, parts_per_topic=2, brokers=(0, 1, 2))
+    monitor = _load_monitor(partitions)
+    bdef = KafkaMetricDef.broker_metric_def()
+    agg = monitor.broker_aggregator
+    ids = {n: bdef.metric_info(n).id for n in
+           ("CPU_USAGE", "LEADER_BYTES_IN", "LEADER_BYTES_OUT",
+            "REPLICATION_BYTES_IN_RATE")}
+    # Synthesize windows where cpu = 0.001*in + 0.0005*out exactly, with
+    # rates spread wide so every CPU bucket gets hits.
+    rng = np.random.default_rng(0)
+    for w in range(40):
+        for b in (0, 1, 2):
+            row = np.zeros(bdef.num_metrics)
+            bytes_in = float(rng.uniform(0, 900))
+            bytes_out = float(rng.uniform(0, 400))
+            row[ids["LEADER_BYTES_IN"]] = bytes_in
+            row[ids["LEADER_BYTES_OUT"]] = bytes_out
+            row[ids["CPU_USAGE"]] = 0.001 * bytes_in + 0.0005 * bytes_out
+            agg.add_sample(BrokerEntity(b), w * 1000 + 500, row)
+    result = monitor.train(0, 50_000)
+    assert result["trained"], result
+    c = result["coefficients"]
+    assert c[0] == pytest.approx(0.001, rel=0.05)
+    assert c[1] == pytest.approx(0.0005, rel=0.1)
