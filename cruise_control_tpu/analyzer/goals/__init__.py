@@ -38,10 +38,12 @@ def _preset(base, **kwargs):
 
 
 RackAwareGoal = _preset(_RackAwareBase, name="RackAwareGoal", is_hard=True,
-                        partition_additive_scores=True)
+                        partition_additive_scores=True,
+                        independent_per_broker=True)
 RackAwareDistributionGoal = _preset(_RackAwareDistBase,
                                     name="RackAwareDistributionGoal", is_hard=True,
-                                    partition_additive_scores=True)
+                                    partition_additive_scores=True,
+                                    independent_per_broker=True)
 ReplicaCapacityGoal = _preset(_ReplicaCapacityBase, name="ReplicaCapacityGoal",
                               is_hard=True)
 DiskCapacityGoal = _preset(ResourceCapacityGoal, name="DiskCapacityGoal",
@@ -58,17 +60,21 @@ CpuCapacityGoal = _preset(ResourceCapacityGoal, name="CpuCapacityGoal",
                           resource=Resource.CPU)
 DiskUsageDistributionGoal = _preset(ResourceDistributionGoal,
                                     name="DiskUsageDistributionGoal",
+                                    supports_swap=True,
                                     resource=Resource.DISK)
 NetworkInboundUsageDistributionGoal = _preset(ResourceDistributionGoal,
                                               name="NetworkInboundUsageDistributionGoal",
+                                              supports_swap=True,
                                               resource=Resource.NW_IN)
 NetworkOutboundUsageDistributionGoal = _preset(ResourceDistributionGoal,
                                                name="NetworkOutboundUsageDistributionGoal",
                                                include_leadership=True,
+                                               supports_swap=True,
                                                resource=Resource.NW_OUT)
 CpuUsageDistributionGoal = _preset(ResourceDistributionGoal,
                                    name="CpuUsageDistributionGoal",
                                    include_leadership=True,
+                                   supports_swap=True,
                                    resource=Resource.CPU)
 ReplicaDistributionGoal = _preset(CountDistributionGoal,
                                   name="ReplicaDistributionGoal", leaders=False)
@@ -86,18 +92,21 @@ PreferredLeaderElectionGoal = _preset(_PreferredLeaderBase,
                                       name="PreferredLeaderElectionGoal",
                                       include_leadership=True,
                                       leadership_only=True,
-                                      partition_additive_scores=True)
+                                      partition_additive_scores=True,
+                                      independent_per_broker=True)
 MinTopicLeadersPerBrokerGoal = _preset(_MinTopicLeadersBase,
                                        name="MinTopicLeadersPerBrokerGoal",
                                        is_hard=True)
 BrokerSetAwareGoal = _preset(_BrokerSetAwareBase, name="BrokerSetAwareGoal",
-                             is_hard=True, partition_additive_scores=True)
+                             is_hard=True, partition_additive_scores=True,
+                             independent_per_broker=True)
 KafkaAssignerEvenRackAwareGoal = _preset(_KafkaAssignerRackBase,
                                          name="KafkaAssignerEvenRackAwareGoal",
                                          is_hard=True,
                                          partition_additive_scores=True)
 KafkaAssignerDiskUsageDistributionGoal = _preset(
-    _KafkaAssignerDiskBase, name="KafkaAssignerDiskUsageDistributionGoal")
+    _KafkaAssignerDiskBase, name="KafkaAssignerDiskUsageDistributionGoal",
+    supports_swap=True)
 
 ALL_GOALS = {cls.__name__: cls for cls in [
     RackAwareGoal, RackAwareDistributionGoal, ReplicaCapacityGoal,

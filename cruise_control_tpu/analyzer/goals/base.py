@@ -33,6 +33,16 @@ class Goal:
     is_hard: bool = False
     include_leadership: bool = False
     leadership_only: bool = False
+    # Swap phase eligibility (ResourceDistributionGoal.java:421-430: only
+    # when plain moves fail to reach the band are swaps tried).
+    supports_swap: bool = False
+    # True when acceptance/improvement depend ONLY on the candidate's own
+    # partition (rack layout, broker-set membership, preferred leader) and
+    # not on per-broker totals: the conflict-free accept step may then take
+    # MANY moves per broker per round (only one per partition), which is
+    # what makes structural goals converge in O(P / num_sources) rounds
+    # instead of O(P / num_dests).
+    independent_per_broker: bool = False
     # True when broker_violations/source_score are additive reductions over
     # the partition axis (rack duplicates, non-preferred leaders): under a
     # partition-sharded mesh the sharded search psums them across devices.
@@ -80,6 +90,20 @@ class Goal:
         """[N] — decrease of this goal's objective if the candidate is
         applied (positive = improves). Default: pairwise violation delta."""
         raise NotImplementedError
+
+    def swap_acceptance(self, state, derived, constraint, aux,
+                        fwd: CandidateDeltas, rev: CandidateDeltas,
+                        net: CandidateDeltas) -> jax.Array:
+        """[N] bool — tolerate each candidate SWAP. Default: both
+        directional legs pass ``acceptance`` independently (sound for
+        per-partition structural goals: rack, broker-set, topic counts).
+        Goals whose acceptance depends on per-broker TOTALS (resource load,
+        replica counts) override to judge ``net`` — a swap leaves counts
+        unchanged and transfers only load(a) − load(b), so leg-wise checks
+        would spuriously veto (ActionType.INTER_BROKER_REPLICA_SWAP
+        handling in the reference's actionAcceptance)."""
+        return self.acceptance(state, derived, constraint, aux, fwd) \
+            & self.acceptance(state, derived, constraint, aux, rev)
 
     # -- candidate generation hints ---------------------------------------
     def source_score(self, state, derived, constraint, aux) -> jax.Array:

@@ -60,6 +60,17 @@ class ResourceCapacityGoal(Goal):
     def replica_weight(self, state, derived, constraint, aux):
         return replica_load(state)[:, :, int(self.resource)]
 
+    def swap_acceptance(self, state, derived, constraint, aux, fwd, rev, net):
+        # Net transfer is SIGNED (a swap ranked on another resource can pull
+        # load toward the source on this one): bound BOTH endpoints.
+        r = int(self.resource)
+        limit = self._limit(state, constraint)
+        d = net.load_delta[:, r]
+        load = derived.broker_load[:, r]
+        dst_ok = load[net.dst_broker] + d <= limit[net.dst_broker] + 1e-6
+        src_ok = load[net.src_broker] - d <= limit[net.src_broker] + 1e-6
+        return dst_ok & src_ok
+
 
 @dataclasses.dataclass(frozen=True)
 class ReplicaCapacityGoal(Goal):
@@ -91,6 +102,10 @@ class ReplicaCapacityGoal(Goal):
     def replica_weight(self, state, derived, constraint, aux):
         # Any replica works; prefer light ones to minimize load disturbance.
         return -replica_load(state).sum(axis=-1)
+
+    def swap_acceptance(self, state, derived, constraint, aux, fwd, rev, net):
+        # Swaps never change per-broker replica counts: always acceptable.
+        return jnp.ones(net.valid.shape[0], dtype=bool)
 
 
 def make_capacity_goals() -> list[Goal]:

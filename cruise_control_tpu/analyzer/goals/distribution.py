@@ -120,6 +120,24 @@ class ResourceDistributionGoal(Goal):
     def replica_weight(self, state, derived, constraint, aux):
         return replica_load(state)[:, :, int(self.resource)]
 
+    def swap_acceptance(self, state, derived, constraint, aux, fwd, rev, net):
+        # Net transfer is SIGNED; accept iff the PAIR's band violation does
+        # not worsen (two-sided — the one-sided move acceptance would let a
+        # src-gaining swap blow past the source's band).
+        r = int(self.resource)
+        lower, upper, _cap = self._limits(state, derived, constraint)
+        load = derived.broker_load[:, r]
+        d = net.load_delta[:, r]
+        src, dst = net.src_broker, net.dst_broker
+
+        def viol(value, idx):
+            return _band_viol(value, lower[idx], upper[idx])
+
+        before = viol(load[src], src) + viol(load[dst], dst)
+        after = viol(load[src] - d, src) + viol(load[dst] + d, dst)
+        return (after <= before + 1e-6) \
+            | self._low_util(derived, constraint)
+
 
 @dataclasses.dataclass(frozen=True)
 class CountDistributionGoal(Goal):
@@ -189,6 +207,22 @@ class CountDistributionGoal(Goal):
         if self.leaders:
             return jnp.where(is_leader_slot(state), w, -jnp.inf)
         return w
+
+    def swap_acceptance(self, state, derived, constraint, aux, fwd, rev, net):
+        # Replica counts are swap-invariant; leadership may transfer with
+        # the heavier replica (net.leader_delta ∈ {-1, 0, 1}, signed) —
+        # accept iff the pair's count-band violation does not worsen.
+        lower, upper = self._limits(derived, constraint)
+        counts = self._counts(derived)
+        d = self._delta(net)
+
+        def viol(value):
+            return _band_viol(value, lower, upper)
+
+        src, dst = net.src_broker, net.dst_broker
+        before = viol(counts[src]) + viol(counts[dst])
+        after = viol(counts[src] - d) + viol(counts[dst] + d)
+        return after <= before + 1e-6
 
 
 @dataclasses.dataclass(frozen=True)

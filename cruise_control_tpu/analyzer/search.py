@@ -121,9 +121,11 @@ def reduce_per_source(score: jax.Array,
 
 def _conflict_free_top_m(score: jax.Array, partition: jax.Array,
                          src: jax.Array, dst: jax.Array, m: int,
-                         num_partitions: int, num_brokers: int):
+                         num_partitions: int, num_brokers: int,
+                         dedupe_brokers: bool = True):
     """Indices of up to ``m`` best-scoring candidates such that no two share
-    a partition, source broker, or destination broker. Scatter-min of the
+    a partition — nor, when ``dedupe_brokers`` (goals whose scores depend on
+    per-broker totals), a source or destination broker. Scatter-min of the
     score-rank per key resolves conflicts in parallel (no sequential scan)."""
     k = min(m, score.shape[0])
     top_score, top_idx = jax.lax.top_k(score, k)
@@ -138,11 +140,11 @@ def _conflict_free_top_m(score: jax.Array, partition: jax.Array,
     rank_eff = jnp.where(ok, rank, big)
 
     first_p = jnp.full(num_partitions, big, dtype=jnp.int32).at[sel_p].min(rank_eff)
-    first_src = jnp.full(num_brokers, big, dtype=jnp.int32).at[sel_src].min(rank_eff)
-    first_dst = jnp.full(num_brokers, big, dtype=jnp.int32).at[sel_dst].min(rank_eff)
-
-    accept = ok & (first_p[sel_p] == rank) & (first_src[sel_src] == rank) \
-        & (first_dst[sel_dst] == rank)
+    accept = ok & (first_p[sel_p] == rank)
+    if dedupe_brokers:
+        first_src = jnp.full(num_brokers, big, dtype=jnp.int32).at[sel_src].min(rank_eff)
+        first_dst = jnp.full(num_brokers, big, dtype=jnp.int32).at[sel_dst].min(rank_eff)
+        accept &= (first_src[sel_src] == rank) & (first_dst[sel_dst] == rank)
     return top_idx, accept
 
 
@@ -229,6 +231,214 @@ def apply_selected(state: ClusterTensors, sel: jax.Array, sel_p: jax.Array,
                                leader_slot=new_leader)
 
 
+def _per_broker_top_replicas(state: ClusterTensors, weight: jax.Array,
+                             brokers: jax.Array, j: int, largest: bool):
+    """For each broker in ``brokers[K]``: the j best replicas it hosts by
+    ``weight[P, S]`` (largest or smallest). Returns (flat_idx[K, j],
+    valid[K, j]) into the flattened [P*S] replica axis."""
+    from ..model.tensors import replica_exists
+    exists = replica_exists(state)
+    b = state.num_brokers
+    seg = jnp.where(state.assignment >= 0, state.assignment, b).reshape(-1)
+    flat_w = jnp.where(exists, weight, jnp.nan).reshape(-1)
+
+    def one(broker):
+        on_b = (seg == broker) & jnp.isfinite(flat_w)
+        key = jnp.where(on_b, flat_w if largest else -flat_w, -jnp.inf)
+        vals, idx = jax.lax.top_k(key, j)
+        return idx, jnp.isfinite(vals)
+
+    return jax.vmap(one)(brokers)
+
+
+def swap_round_candidates(state: ClusterTensors, masks: ExclusionMasks,
+                          goal: Goal, optimized: tuple[Goal, ...],
+                          constraint: BalancingConstraint, num_topics: int,
+                          k_brokers: int = 8, j_replicas: int = 4):
+    """INTER_BROKER_REPLICA_SWAP phase (AbstractGoal.maybeApplySwapAction:287
+    + the swap search of ResourceDistributionGoal.java:599-687), batched:
+
+    top-k overloaded brokers × top-k donors × (j heaviest source replicas ×
+    j lightest destination replicas) → K·K·j·j swap candidates. The active
+    goal scores the NET transfer (load(a) − load(b), replica counts
+    unchanged); every previously-optimized goal must accept BOTH directional
+    moves (the lexicographic stack applied to each leg). The source replica
+    must outweigh the destination replica (maxSourceReplicaLoad: a swap
+    always decreases the overloaded side, :599-687)."""
+    from .candidates import CandidateDeltas
+
+    derived = compute_derived(state, masks.excluded_topics,
+                              masks.excluded_replica_move_brokers,
+                              masks.excluded_leadership_brokers)
+    aux = goal_aux(goal, state, derived, constraint, num_topics)
+    aux_by_goal = {g.name: goal_aux(g, state, derived, constraint, num_topics)
+                   for g in optimized}
+
+    src_score = goal.source_score(state, derived, constraint, aux)
+    dst_score = goal.dest_score(state, derived, constraint, aux)
+    weight = goal.replica_weight(state, derived, constraint, aux)
+
+    k = min(k_brokers, state.num_brokers)
+    src_vals, src_brokers = jax.lax.top_k(
+        jnp.where(src_score > 0, src_score, -jnp.inf), k)
+    dst_vals, dst_brokers = jax.lax.top_k(dst_score, k)
+    src_b_ok = jnp.isfinite(src_vals)
+    dst_b_ok = jnp.isfinite(dst_vals)
+
+    heavy_idx, heavy_ok = _per_broker_top_replicas(
+        state, weight, src_brokers, j_replicas, largest=True)    # [K, j]
+    light_idx, light_ok = _per_broker_top_replicas(
+        state, weight, dst_brokers, j_replicas, largest=False)
+
+    s_dim = state.max_replication_factor
+    # Grid: [K_src, K_dst, j, j] flattened.
+    n = k * k * j_replicas * j_replicas
+    si, di, ai, bi = jnp.meshgrid(jnp.arange(k), jnp.arange(k),
+                                  jnp.arange(j_replicas),
+                                  jnp.arange(j_replicas), indexing="ij")
+    si, di, ai, bi = (x.reshape(-1) for x in (si, di, ai, bi))
+    src_b = src_brokers[si]
+    dst_b = dst_brokers[di]
+    a_flat = heavy_idx[si, ai]
+    b_flat = light_idx[di, bi]
+    p1, s1 = a_flat // s_dim, a_flat % s_dim
+    p2, s2 = b_flat // s_dim, b_flat % s_dim
+
+    base_valid = src_b_ok[si] & dst_b_ok[di] & heavy_ok[si, ai] \
+        & light_ok[di, bi] & (src_b != dst_b) \
+        & derived.movable_partition[p1] & derived.movable_partition[p2] \
+        & derived.allowed_replica_move[dst_b] \
+        & derived.allowed_replica_move[src_b]
+    # Distinct partitions, cross-hosting checks.
+    base_valid &= p1 != p2
+    base_valid &= ~(state.assignment[p1] == dst_b[:, None]).any(axis=1)
+    base_valid &= ~(state.assignment[p2] == src_b[:, None]).any(axis=1)
+    # The swap must shrink the overloaded side.
+    w_a = weight[p1, s1]
+    w_b = weight[p2, s2]
+    base_valid &= w_a > w_b
+
+    # Load vectors travel with the replicas (leadership keeps its replica).
+    lead1 = (state.leader_slot[p1] == s1)
+    lead2 = (state.leader_slot[p2] == s2)
+    load_a = jnp.where(lead1[:, None], state.leader_load[p1],
+                       state.follower_load[p1])
+    load_b = jnp.where(lead2[:, None], state.leader_load[p2],
+                       state.follower_load[p2])
+
+    def leg(partition, slot, load_vec, lead, src, dst, valid):
+        return CandidateDeltas(
+            src_broker=jnp.where(valid, src, 0),
+            dst_broker=jnp.where(valid, dst, 0),
+            load_delta=jnp.where(valid[:, None], load_vec, 0.0),
+            replica_delta=valid.astype(jnp.int32),
+            leader_delta=(valid & lead).astype(jnp.int32),
+            partition=partition, topic=state.topic[partition],
+            src_slot=jnp.where(valid, slot, 0),
+            dst_slot=jnp.zeros(n, dtype=jnp.int32), valid=valid)
+
+    fwd = leg(p1, s1, load_a, lead1, src_b, dst_b, base_valid)
+    rev = leg(p2, s2, load_b, lead2, dst_b, src_b, base_valid)
+    net = CandidateDeltas(
+        src_broker=fwd.src_broker, dst_broker=fwd.dst_broker,
+        load_delta=jnp.where(base_valid[:, None], load_a - load_b, 0.0),
+        replica_delta=jnp.zeros(n, dtype=jnp.int32),
+        leader_delta=jnp.where(base_valid,
+                               lead1.astype(jnp.int32) - lead2.astype(jnp.int32),
+                               0),
+        partition=p1, topic=state.topic[p1],
+        src_slot=fwd.src_slot, dst_slot=jnp.zeros(n, dtype=jnp.int32),
+        valid=base_valid)
+    accept = base_valid
+    for g in optimized:
+        accept &= g.swap_acceptance(state, derived, constraint,
+                                    aux_by_goal[g.name], fwd, rev, net)
+    imp = goal.improvement(state, derived, constraint, aux, net)
+    score = jnp.where(accept, imp, -jnp.inf)
+    return score, p1, s1, p2, s2, src_b, dst_b
+
+
+def _swap_round_body(state: ClusterTensors, goal: Goal,
+                     optimized: tuple[Goal, ...],
+                     constraint: BalancingConstraint, num_topics: int,
+                     masks: ExclusionMasks, moves: int = 8,
+                     ) -> tuple[ClusterTensors, jax.Array]:
+    """One batched swap round (traced body)."""
+    score, p1, s1, p2, s2, src_b, dst_b = swap_round_candidates(
+        state, masks, goal, optimized, constraint, num_topics)
+    # Selection: no two accepted swaps may share ANY partition (p1 or p2,
+    # across roles — else one partition could gain two replicas on a broker
+    # or a later scatter could half-overwrite an earlier swap) nor ANY
+    # broker (src or dst, across roles). One scatter array per key space,
+    # fed from both roles.
+    k = min(moves, score.shape[0])
+    top_score, top_idx = jax.lax.top_k(score, k)
+    ok = top_score > _EPS_IMPROVEMENT
+    rank = jnp.arange(k, dtype=jnp.int32)
+    big = jnp.int32(k + 1)
+    rank_eff = jnp.where(ok, rank, big)
+    sel_p1, sel_p2 = p1[top_idx], p2[top_idx]
+    sel_src, sel_dst = src_b[top_idx], dst_b[top_idx]
+    first_part = jnp.full(state.num_partitions, big, jnp.int32) \
+        .at[sel_p1].min(rank_eff).at[sel_p2].min(rank_eff)
+    first_broker = jnp.full(state.num_brokers, big, jnp.int32) \
+        .at[sel_src].min(rank_eff).at[sel_dst].min(rank_eff)
+    sel = ok & (first_part[sel_p1] == rank) & (first_part[sel_p2] == rank) \
+        & (first_broker[sel_src] == rank) & (first_broker[sel_dst] == rank)
+
+    p_pad = jnp.int32(state.num_partitions)
+    rows1 = jnp.where(sel, p1[top_idx], p_pad)
+    rows2 = jnp.where(sel, p2[top_idx], p_pad)
+    new_assignment = state.assignment \
+        .at[rows1, s1[top_idx]].set(dst_b[top_idx].astype(state.assignment.dtype),
+                                    mode="drop") \
+        .at[rows2, s2[top_idx]].set(src_b[top_idx].astype(state.assignment.dtype),
+                                    mode="drop")
+    return dataclasses.replace(state, assignment=new_assignment), sel.sum()
+
+
+@partial(jax.jit, static_argnames=("goal", "optimized", "constraint",
+                                   "num_topics", "moves"))
+def swap_round(state: ClusterTensors, goal: Goal, optimized: tuple[Goal, ...],
+               constraint: BalancingConstraint, num_topics: int,
+               masks: ExclusionMasks, moves: int = 8,
+               ) -> tuple[ClusterTensors, jax.Array]:
+    """One batched swap round. Returns (new_state, num_swaps_applied)."""
+    return _swap_round_body(state, goal, optimized, constraint, num_topics,
+                            masks, moves)
+
+
+def _round_body(state: ClusterTensors, goal: Goal, optimized: tuple[Goal, ...],
+                constraint: BalancingConstraint, cfg: SearchConfig,
+                num_topics: int, masks: ExclusionMasks,
+                ) -> tuple[ClusterTensors, jax.Array]:
+    """One search round (traced body shared by optimize_round and the fused
+    on-device driver)."""
+    cand, deltas, score, layout = score_round_candidates(
+        state, masks, goal, optimized, constraint, cfg, num_topics)
+
+    red_idx = reduce_per_source(score, layout)
+
+    # Per-partition-structural goals accept one move per PARTITION (not per
+    # broker) and a much larger batch: broker totals don't feed their
+    # acceptance, so parallel moves can't interact. Only sound when no
+    # previously-optimized goal is stacked — a prior capacity/distribution
+    # goal's acceptance DOES read broker totals and assumes one-at-a-time.
+    independent = goal.independent_per_broker and not optimized
+    m = max(cfg.moves_per_round, cfg.num_sources) if independent \
+        else cfg.moves_per_round
+    top_idx_red, sel = _conflict_free_top_m(
+        score[red_idx], deltas.partition[red_idx], deltas.src_broker[red_idx],
+        deltas.dst_broker[red_idx], m, state.num_partitions,
+        state.num_brokers, dedupe_brokers=not independent)
+    top_idx = red_idx[top_idx_red]
+
+    new_state = apply_selected(
+        state, sel, deltas.partition[top_idx], deltas.src_slot[top_idx],
+        deltas.dst_broker[top_idx], cand.kind[top_idx], cand.dst_slot[top_idx])
+    return new_state, sel.sum()
+
+
 @partial(jax.jit, static_argnames=("goal", "optimized", "constraint", "cfg",
                                    "num_topics"))
 def optimize_round(state: ClusterTensors, goal: Goal,
@@ -236,21 +446,63 @@ def optimize_round(state: ClusterTensors, goal: Goal,
                    cfg: SearchConfig, num_topics: int,
                    masks: ExclusionMasks) -> tuple[ClusterTensors, jax.Array]:
     """One fused search round for ``goal``. Returns (new_state, num_applied)."""
-    cand, deltas, score, layout = score_round_candidates(
-        state, masks, goal, optimized, constraint, cfg, num_topics)
+    return _round_body(state, goal, optimized, constraint, cfg, num_topics,
+                       masks)
 
-    red_idx = reduce_per_source(score, layout)
 
-    top_idx_red, sel = _conflict_free_top_m(
-        score[red_idx], deltas.partition[red_idx], deltas.src_broker[red_idx],
-        deltas.dst_broker[red_idx], cfg.moves_per_round, state.num_partitions,
-        state.num_brokers)
-    top_idx = red_idx[top_idx_red]
+@partial(jax.jit, static_argnames=("goal", "optimized", "constraint", "cfg",
+                                   "num_topics"))
+def optimize_rounds(state: ClusterTensors, goal: Goal,
+                    optimized: tuple[Goal, ...],
+                    constraint: BalancingConstraint, cfg: SearchConfig,
+                    num_topics: int, masks: ExclusionMasks,
+                    ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
+    """The FUSED multi-round driver: `lax.while_loop` runs search rounds
+    until convergence (or cfg.max_rounds) entirely on device — ONE host
+    round-trip per goal instead of one per round. This is what makes the
+    solver viable over a high-latency device link (and faster everywhere:
+    no per-round dispatch).
 
-    new_state = apply_selected(
-        state, sel, deltas.partition[top_idx], deltas.src_slot[top_idx],
-        deltas.dst_broker[top_idx], cand.kind[top_idx], cand.dst_slot[top_idx])
-    return new_state, sel.sum()
+    Returns (final_state, total_moves, rounds_run)."""
+
+    def cond(c):
+        _s, _moves, rounds, last = c
+        return (last > 0) & (rounds < cfg.max_rounds)
+
+    def body(c):
+        s, moves, rounds, _last = c
+        ns, applied = _round_body(s, goal, optimized, constraint, cfg,
+                                  num_topics, masks)
+        applied = applied.astype(jnp.int32)
+        return ns, moves + applied, rounds + 1, applied
+
+    final, moves, rounds, _ = jax.lax.while_loop(
+        cond, body, (state, jnp.int32(0), jnp.int32(0), jnp.int32(1)))
+    return final, moves, rounds
+
+
+@partial(jax.jit, static_argnames=("goal", "optimized", "constraint",
+                                   "num_topics", "moves", "max_rounds"))
+def swap_rounds(state: ClusterTensors, goal: Goal, optimized: tuple[Goal, ...],
+                constraint: BalancingConstraint, num_topics: int,
+                masks: ExclusionMasks, moves: int = 8, max_rounds: int = 64,
+                ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
+    """Fused swap-phase driver (while_loop analogue of optimize_rounds)."""
+
+    def cond(c):
+        _s, _swaps, rounds, last = c
+        return (last > 0) & (rounds < max_rounds)
+
+    def body(c):
+        s, swaps, rounds, _last = c
+        ns, applied = _swap_round_body(s, goal, optimized, constraint,
+                                       num_topics, masks, moves)
+        applied = applied.astype(jnp.int32)
+        return ns, swaps + applied, rounds + 1, applied
+
+    final, swaps, rounds, _ = jax.lax.while_loop(
+        cond, body, (state, jnp.int32(0), jnp.int32(0), jnp.int32(1)))
+    return final, swaps, rounds
 
 
 def optimize_goal(state: ClusterTensors, goal: Goal,
@@ -267,13 +519,25 @@ def optimize_goal(state: ClusterTensors, goal: Goal,
     masks = masks or ExclusionMasks()
     opt_tuple = tuple(optimized)
     total_applied = 0
+    total_swaps = 0
     rounds = 0
-    for rounds in range(1, cfg.max_rounds + 1):
-        state, applied = optimize_round(
+    # Fused drivers: ONE device call runs the whole move loop to
+    # convergence; swap phases interleave only for swap-capable goals
+    # (ResourceDistributionGoal.java:421-430: swaps after moves stall).
+    while rounds < cfg.max_rounds:
+        state, moves, r = optimize_rounds(
             state, goal, opt_tuple, constraint, cfg, num_topics, masks)
-        applied = int(applied)
-        total_applied += applied
-        if applied == 0:
+        total_applied += int(moves)
+        rounds += int(r)
+        if not goal.supports_swap:
+            break
+        state, swapped, sr = swap_rounds(
+            state, goal, opt_tuple, constraint, num_topics, masks)
+        swapped = int(swapped)
+        total_swaps += swapped
+        total_applied += swapped
+        rounds += int(sr)
+        if swapped == 0:
             break
 
     derived = compute_derived(state, masks.excluded_topics,
@@ -293,6 +557,7 @@ def optimize_goal(state: ClusterTensors, goal: Goal,
         "goal": goal.name,
         "rounds": rounds,
         "moves_applied": total_applied,
+        "swaps_applied": total_swaps,
         "residual_violation": total_violation,
         "succeeded": succeeded,
         "objective": objective,

@@ -177,6 +177,7 @@ def optimize_goal_sharded(state: ClusterTensors, goal, optimized,
     masks = masks or ExclusionMasks()
     opt_tuple = tuple(optimized)
     total_applied = 0
+    total_swaps = 0
     rounds = 0
     for rounds in range(1, cfg.max_rounds + 1):
         state, applied = sharded_optimize_round(
@@ -184,6 +185,20 @@ def optimize_goal_sharded(state: ClusterTensors, goal, optimized,
         applied = int(applied)
         total_applied += applied
         if applied == 0:
+            # Swap phase (parity with the single-device optimize_goal): the
+            # swap kernel runs as an ordinary jit over the global sharded
+            # arrays — XLA inserts the gathers it needs. Swaps are a tail
+            # refinement (a handful of rounds), so the gather cost is
+            # accepted rather than writing a shard_map swap kernel.
+            if goal.supports_swap:
+                from ..analyzer.search import swap_round
+                state, swapped = swap_round(
+                    state, goal, opt_tuple, constraint, num_topics, masks)
+                swapped = int(swapped)
+                total_swaps += swapped
+                total_applied += swapped
+                if swapped > 0:
+                    continue
             break
 
     # Final violation check under the mesh — no host gather.
@@ -199,5 +214,6 @@ def optimize_goal_sharded(state: ClusterTensors, goal, optimized,
             f"{total_violation:.4f} after {rounds} rounds")
     return state, {
         "goal": goal.name, "rounds": rounds, "moves_applied": total_applied,
+        "swaps_applied": total_swaps,
         "residual_violation": total_violation, "succeeded": succeeded,
     }

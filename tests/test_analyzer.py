@@ -250,3 +250,38 @@ def test_kafka_assigner_disk_goal_balances_disk():
     final, info = run_goal(state, goal, meta.num_topics)
     after = np.asarray(broker_load(final))[:, int(Resource.DISK)]
     assert after.std() < before.std(), (before, after)
+
+
+def test_swap_phase_balances_when_moves_cannot():
+    """Swap parity (AbstractGoal.maybeApplySwapAction:287): replica-count
+    capacity pins every broker at its replica cap, so no plain move is
+    possible — only swaps can equalize disk load."""
+    from cruise_control_tpu.analyzer.goals import DiskUsageDistributionGoal
+    from cruise_control_tpu.model.builder import ClusterModelBuilder
+
+    cap = {Resource.CPU: 100.0, Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6,
+           Resource.DISK: 1e6}
+    b = ClusterModelBuilder()
+    b.add_broker(0, "rA", cap).add_broker(1, "rB", cap)
+    # Broker 0 hosts 4 heavy partitions, broker 1 hosts 4 light ones.
+    for p in range(4):
+        b.add_partition("heavy", p, [0], leader_load={
+            Resource.CPU: 1.0, Resource.NW_IN: 10.0, Resource.NW_OUT: 10.0,
+            Resource.DISK: 200.0})
+    for p in range(4):
+        b.add_partition("light", p, [1], leader_load={
+            Resource.CPU: 1.0, Resource.NW_IN: 10.0, Resource.NW_OUT: 10.0,
+            Resource.DISK: 50.0})
+    state, meta = b.build()
+    goal = DiskUsageDistributionGoal()
+    # A replica cap of 4 per broker blocks every move; swaps keep counts.
+    constraint = BalancingConstraint(max_replicas_per_broker=4)
+    prior = (ReplicaCapacityGoal(),)
+    before = np.asarray(broker_load(state))[:2, int(Resource.DISK)]
+    final, info = run_goal(state, goal, meta.num_topics, optimized=prior,
+                           constraint=constraint)
+    after = np.asarray(broker_load(final))[:2, int(Resource.DISK)]
+    counts = np.asarray(broker_replica_counts(final))[:2]
+    assert (counts == 4).all(), counts
+    assert info["swaps_applied"] > 0, info
+    assert abs(after[0] - after[1]) < abs(before[0] - before[1]), (before, after)
