@@ -1,0 +1,5 @@
+"""Cross-cutting utilities (host-platform control, small helpers)."""
+
+from cruise_control_tpu.utils.platform import force_host_cpu_devices
+
+__all__ = ["force_host_cpu_devices"]

@@ -2,18 +2,10 @@
 sharding paths (Mesh/shard_map) are exercised without TPU pods.
 
 The ambient environment may pin jax to a TPU tunnel (axon) via
-sitecustomize, which overrides JAX_PLATFORMS with a config update at
-interpreter startup — so env vars alone are not enough; we must update the
-jax config again after import (but before first backend use)."""
+sitecustomize; see cruise_control_tpu/utils/platform.py — the shared home
+of the workaround — for why env vars alone are not enough."""
 
-import os
+from cruise_control_tpu.utils import force_host_cpu_devices
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-import jax  # noqa: E402  (import after env setup)
-
-jax.config.update("jax_platforms", "cpu")
+jax = force_host_cpu_devices(8)
 jax.config.update("jax_enable_x64", False)
