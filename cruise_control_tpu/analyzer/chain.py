@@ -1,0 +1,404 @@
+"""Chain-shared search kernels: one compilation for the whole goal chain.
+
+The per-goal kernels in ``search.py`` are jitted with (goal, optimized) as
+STATIC arguments, so a G-goal chain compiles G move drivers and G swap
+drivers, and the g-th kernel re-traces the aux + acceptance of all g-1
+prior goals — compile work grows quadratically along the chain
+(VERDICT round 1, "what's weak" #2).  This module recasts the chain as
+THREE compilations total:
+
+- ``chain_optimize_rounds``: the fused ``lax.while_loop`` move driver where
+  the ACTIVE goal is a traced index (``lax.switch`` over per-goal scoring
+  branches) and the previously-optimized set is a traced boolean mask
+  gating each goal's acceptance term.  Every goal's acceptance is traced
+  ONCE; per-goal aux tensors are wrapped in ``lax.cond`` so only the active
+  + prior goals' aux is actually computed at runtime.
+- ``chain_swap_rounds``: same treatment for the swap phase.
+- ``chain_goal_stats``: post-optimization violation/objective readback.
+
+Host drives the chain with the SAME compiled kernels for every goal:
+``optimize_goal_in_chain(state, chain, i, ...)``.
+
+Reference semantics preserved: the lexicographic acceptance stack of
+AbstractGoal.maybeApplyBalancingAction:230-272 (each candidate must be
+accepted by every previously-optimized goal), SURVEY.md §A.3.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..model.tensors import ClusterTensors, offline_replicas
+from .candidates import compute_deltas, generate_candidates
+from .constraint import BalancingConstraint
+from .derived import compute_derived
+from .goals.base import Goal
+from .search import (
+    _EPS_IMPROVEMENT, _OFFLINE_BONUS, ExclusionMasks,
+    OptimizationFailureError, SearchConfig, apply_selected,
+    apply_swap_selection, goal_aux, reduce_per_source, swap_grid,
+)
+
+
+def _gated_aux(needed: jax.Array, goal: Goal, state, derived, constraint,
+               num_topics: int):
+    """Compute ``goal``'s aux pytree only when ``needed`` (traced bool) —
+    zeros otherwise. Keeps the single chain kernel from paying every goal's
+    O(P) aux reductions on every round."""
+
+    def compute(_):
+        return goal_aux(goal, state, derived, constraint, num_topics)
+
+    shapes = jax.eval_shape(compute, 0)
+    if not jax.tree_util.tree_leaves(shapes):
+        return compute(0)  # aux is None/empty: nothing to gate
+
+    def zeros(_):
+        return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    return jax.lax.cond(needed, compute, zeros, 0)
+
+
+def _goal_flags(goals: tuple[Goal, ...]):
+    lead_only = jnp.asarray([g.leadership_only for g in goals])
+    incl_lead = jnp.asarray([g.include_leadership or g.leadership_only
+                             for g in goals])
+    indep = jnp.asarray([g.independent_per_broker for g in goals])
+    return lead_only, incl_lead, indep
+
+
+def _switch_scores(active_idx, goals, aux_list, state, derived, constraint):
+    """(src_score[B], dst_score[B], weight[P,S]) of the active goal."""
+
+    def branch(i):
+        g = goals[i]
+
+        def fn(_):
+            a = aux_list[i]
+            return (g.source_score(state, derived, constraint, a)
+                    .astype(jnp.float32),
+                    g.dest_score(state, derived, constraint, a)
+                    .astype(jnp.float32),
+                    g.replica_weight(state, derived, constraint, a)
+                    .astype(jnp.float32))
+        return fn
+
+    return jax.lax.switch(active_idx, [branch(i) for i in range(len(goals))], 0)
+
+
+def _chain_conflict_select(score, partition, src, dst, m: int,
+                           num_partitions: int, num_brokers: int,
+                           dedupe_brokers: jax.Array):
+    """``search._conflict_free_top_m`` with a TRACED broker-dedupe flag:
+    the per-partition constraint always applies; the per-broker constraint
+    is switched off for independent-per-broker goals at runtime."""
+    k = min(m, score.shape[0])
+    top_score, top_idx = jax.lax.top_k(score, k)
+    ok = top_score > _EPS_IMPROVEMENT
+    rank = jnp.arange(k, dtype=jnp.int32)
+
+    sel_p = partition[top_idx]
+    sel_src = src[top_idx]
+    sel_dst = dst[top_idx]
+
+    big = jnp.int32(k + 1)
+    rank_eff = jnp.where(ok, rank, big)
+
+    first_p = jnp.full(num_partitions, big, dtype=jnp.int32) \
+        .at[sel_p].min(rank_eff)
+    accept = ok & (first_p[sel_p] == rank)
+    first_src = jnp.full(num_brokers, big, dtype=jnp.int32) \
+        .at[sel_src].min(rank_eff)
+    first_dst = jnp.full(num_brokers, big, dtype=jnp.int32) \
+        .at[sel_dst].min(rank_eff)
+    broker_ok = (first_src[sel_src] == rank) & (first_dst[sel_dst] == rank)
+    accept &= jnp.where(dedupe_brokers, broker_ok, True)
+    return top_idx, accept
+
+
+def _chain_round_body(state: ClusterTensors, active_idx: jax.Array,
+                      prior_mask: jax.Array, goals: tuple[Goal, ...],
+                      constraint: BalancingConstraint, cfg: SearchConfig,
+                      num_topics: int, masks: ExclusionMasks,
+                      ) -> tuple[ClusterTensors, jax.Array]:
+    """One search round, chain-parameterized (traced body)."""
+    lead_only_f, incl_lead_f, indep_f = _goal_flags(goals)
+    is_lead_only = lead_only_f[active_idx]
+    has_leadership = incl_lead_f[active_idx]
+
+    derived = compute_derived(state, masks.excluded_topics,
+                              masks.excluded_replica_move_brokers,
+                              masks.excluded_leadership_brokers)
+    is_active = jnp.arange(len(goals)) == active_idx
+    aux_list = [_gated_aux(prior_mask[i] | is_active[i], g, state, derived,
+                           constraint, num_topics)
+                for i, g in enumerate(goals)]
+
+    src_score, dst_score, weight = _switch_scores(
+        active_idx, goals, aux_list, state, derived, constraint)
+
+    # Self-healing priority (see search.score_round_candidates): offline
+    # replicas are always sources with maximal weight for non-leadership
+    # goals.
+    off = offline_replicas(state)  # [P, S]
+    b = state.num_brokers
+    seg = jnp.where(state.assignment >= 0, state.assignment, b).reshape(-1)
+    offline_per_broker = jax.ops.segment_sum(
+        off.astype(jnp.float32).reshape(-1), seg, num_segments=b + 1)[:b]
+    src_score = src_score + jnp.where(is_lead_only, 0.0, offline_per_broker)
+    weight = jnp.where(off & ~is_lead_only, 1e30, weight)
+
+    # UNIFORM grid layout: both the move and the leadership block always
+    # exist (static shapes shared by every goal); the active goal's traced
+    # flags mask out the block it doesn't use.
+    cand, layout = generate_candidates(state, derived, src_score, dst_score,
+                                       weight, cfg.num_sources, cfg.num_dests,
+                                       include_leadership=True,
+                                       leadership_only=False)
+    (r0, c0), (r1, c1) = layout
+    block_ok = jnp.concatenate([
+        jnp.broadcast_to(~is_lead_only, (r0 * c0,)),
+        jnp.broadcast_to(has_leadership, (r1 * c1,)),
+    ])
+    cand = dataclasses.replace(cand, valid=cand.valid & block_ok)
+    deltas = compute_deltas(state, derived, cand)
+
+    accept = deltas.valid
+    for i, g in enumerate(goals):
+        accept &= (~prior_mask[i]) | g.acceptance(state, derived, constraint,
+                                                  aux_list[i], deltas)
+
+    moving_offline = off[deltas.partition, deltas.src_slot] \
+        & (deltas.replica_delta > 0)
+
+    def imp_branch(i):
+        g = goals[i]
+
+        def fn(_):
+            return g.improvement(state, derived, constraint, aux_list[i],
+                                 deltas).astype(jnp.float32)
+        return fn
+
+    imp = jax.lax.switch(active_idx,
+                         [imp_branch(i) for i in range(len(goals))], 0)
+    imp = jnp.where(moving_offline & jnp.isfinite(imp) & deltas.valid,
+                    jnp.maximum(imp, 0.0) + _OFFLINE_BONUS, imp)
+    score = jnp.where(accept, imp, -jnp.inf)
+
+    red_idx = reduce_per_source(score, layout)
+    # Independent-per-broker goals with no stacked priors may take many
+    # moves per broker per round (search._round_body rationale). The
+    # selection size is static at the larger value; broker-deduped goals
+    # additionally honor the configured moves_per_round as a true accept
+    # cap (applied to the conflict-free winners in score order), so
+    # solver.moves.per.round still throttles per-round churn.
+    independent = indep_f[active_idx] & ~prior_mask.any()
+    m = max(cfg.moves_per_round, cfg.num_sources)
+    top_idx_red, sel = _chain_conflict_select(
+        score[red_idx], deltas.partition[red_idx], deltas.src_broker[red_idx],
+        deltas.dst_broker[red_idx], m, state.num_partitions,
+        state.num_brokers, dedupe_brokers=~independent)
+    within_cap = jnp.cumsum(sel.astype(jnp.int32)) <= cfg.moves_per_round
+    sel &= jnp.where(independent, True, within_cap)
+    top_idx = red_idx[top_idx_red]
+
+    new_state = apply_selected(
+        state, sel, deltas.partition[top_idx], deltas.src_slot[top_idx],
+        deltas.dst_broker[top_idx], cand.kind[top_idx], cand.dst_slot[top_idx])
+    return new_state, sel.sum()
+
+
+@partial(jax.jit, static_argnames=("goals", "constraint", "cfg", "num_topics"))
+def chain_optimize_rounds(state: ClusterTensors, active_idx: jax.Array,
+                          prior_mask: jax.Array, goals: tuple[Goal, ...],
+                          constraint: BalancingConstraint, cfg: SearchConfig,
+                          num_topics: int, masks: ExclusionMasks,
+                          ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
+    """Fused multi-round driver for ANY goal in the chain: one compilation
+    serves all G (active_idx, prior_mask) combinations. Returns
+    (final_state, total_moves, rounds_run)."""
+
+    def cond(c):
+        _s, _moves, rounds, last = c
+        return (last > 0) & (rounds < cfg.max_rounds)
+
+    def body(c):
+        s, moves, rounds, _last = c
+        ns, applied = _chain_round_body(s, active_idx, prior_mask, goals,
+                                        constraint, cfg, num_topics, masks)
+        applied = applied.astype(jnp.int32)
+        return ns, moves + applied, rounds + 1, applied
+
+    final, moves, rounds, _ = jax.lax.while_loop(
+        cond, body, (state, jnp.int32(0), jnp.int32(0), jnp.int32(1)))
+    return final, moves, rounds
+
+
+def _chain_swap_body(state: ClusterTensors, active_idx: jax.Array,
+                     prior_mask: jax.Array, goals: tuple[Goal, ...],
+                     constraint: BalancingConstraint, num_topics: int,
+                     masks: ExclusionMasks, moves: int = 8,
+                     ) -> tuple[ClusterTensors, jax.Array]:
+    derived = compute_derived(state, masks.excluded_topics,
+                              masks.excluded_replica_move_brokers,
+                              masks.excluded_leadership_brokers)
+    is_active = jnp.arange(len(goals)) == active_idx
+    aux_list = [_gated_aux(prior_mask[i] | is_active[i], g, state, derived,
+                           constraint, num_topics)
+                for i, g in enumerate(goals)]
+    src_score, dst_score, weight = _switch_scores(
+        active_idx, goals, aux_list, state, derived, constraint)
+
+    fwd, rev, net, p1, s1, p2, s2, src_b, dst_b, base_valid = swap_grid(
+        state, derived, src_score, dst_score, weight)
+
+    accept = base_valid
+    for i, g in enumerate(goals):
+        accept &= (~prior_mask[i]) | g.swap_acceptance(
+            state, derived, constraint, aux_list[i], fwd, rev, net)
+
+    def imp_branch(i):
+        g = goals[i]
+
+        def fn(_):
+            return g.improvement(state, derived, constraint, aux_list[i],
+                                 net).astype(jnp.float32)
+        return fn
+
+    imp = jax.lax.switch(active_idx,
+                         [imp_branch(i) for i in range(len(goals))], 0)
+    score = jnp.where(accept, imp, -jnp.inf)
+    return apply_swap_selection(state, score, p1, s1, p2, s2, src_b, dst_b,
+                                moves)
+
+
+@partial(jax.jit, static_argnames=("goals", "constraint", "num_topics",
+                                   "moves", "max_rounds"))
+def chain_swap_rounds(state: ClusterTensors, active_idx: jax.Array,
+                      prior_mask: jax.Array, goals: tuple[Goal, ...],
+                      constraint: BalancingConstraint, num_topics: int,
+                      masks: ExclusionMasks, moves: int = 8,
+                      max_rounds: int = 64,
+                      ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
+    """Fused swap-phase driver, chain-parameterized."""
+
+    def cond(c):
+        _s, _swaps, rounds, last = c
+        return (last > 0) & (rounds < max_rounds)
+
+    def body(c):
+        s, swaps, rounds, _last = c
+        ns, applied = _chain_swap_body(s, active_idx, prior_mask, goals,
+                                       constraint, num_topics, masks, moves)
+        applied = applied.astype(jnp.int32)
+        return ns, swaps + applied, rounds + 1, applied
+
+    final, swaps, rounds, _ = jax.lax.while_loop(
+        cond, body, (state, jnp.int32(0), jnp.int32(0), jnp.int32(1)))
+    return final, swaps, rounds
+
+
+@partial(jax.jit, static_argnames=("goals", "constraint", "num_topics"))
+def chain_goal_stats(state: ClusterTensors, active_idx: jax.Array,
+                     goals: tuple[Goal, ...],
+                     constraint: BalancingConstraint, num_topics: int,
+                     masks: ExclusionMasks,
+                     ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(total_violation, objective, offline_remaining) of the active goal on
+    ``state`` — the post-optimization readback, on device in one call."""
+    derived = compute_derived(state, masks.excluded_topics,
+                              masks.excluded_replica_move_brokers,
+                              masks.excluded_leadership_brokers)
+
+    def branch(i):
+        g = goals[i]
+
+        def fn(_):
+            aux = goal_aux(g, state, derived, constraint, num_topics)
+            viol = g.broker_violations(state, derived, constraint, aux)
+            obj = g.objective(state, derived, constraint, aux)
+            return (viol.sum().astype(jnp.float32),
+                    obj.astype(jnp.float32))
+        return fn
+
+    viol, obj = jax.lax.switch(active_idx,
+                               [branch(i) for i in range(len(goals))], 0)
+    return viol, obj, offline_replicas(state).sum()
+
+
+@partial(jax.jit, static_argnames=("goals", "constraint", "num_topics"))
+def chain_all_violations(state: ClusterTensors, goals: tuple[Goal, ...],
+                         constraint: BalancingConstraint, num_topics: int,
+                         masks: ExclusionMasks) -> jax.Array:
+    """[G] total violation per goal on ``state`` in ONE device call — the
+    pre-optimization violation snapshot (derived state shared across
+    goals)."""
+    derived = compute_derived(state, masks.excluded_topics,
+                              masks.excluded_replica_move_brokers,
+                              masks.excluded_leadership_brokers)
+    totals = []
+    for g in goals:
+        aux = goal_aux(g, state, derived, constraint, num_topics)
+        totals.append(g.broker_violations(state, derived, constraint,
+                                          aux).sum().astype(jnp.float32))
+    return jnp.stack(totals)
+
+
+def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
+                           index: int, constraint: BalancingConstraint,
+                           cfg: SearchConfig, num_topics: int,
+                           masks: ExclusionMasks | None = None,
+                           ) -> tuple[ClusterTensors, dict]:
+    """Run goal ``chain[index]`` to convergence under the acceptance of
+    ``chain[:index]``, using the chain-shared kernels (same semantics and
+    info dict as ``search.optimize_goal``, one compile for the whole chain).
+    """
+    masks = masks or ExclusionMasks()
+    goals = tuple(chain)
+    goal = goals[index]
+    idx = jnp.int32(index)
+    prior = jnp.asarray([j < index for j in range(len(goals))])
+
+    total_applied = 0
+    total_swaps = 0
+    rounds = 0
+    while rounds < cfg.max_rounds:
+        state, moves, r = chain_optimize_rounds(
+            state, idx, prior, goals, constraint, cfg, num_topics, masks)
+        total_applied += int(moves)
+        rounds += int(r)
+        if not goal.supports_swap:
+            break
+        state, swapped, sr = chain_swap_rounds(
+            state, idx, prior, goals, constraint, num_topics, masks)
+        swapped = int(swapped)
+        total_swaps += swapped
+        total_applied += swapped
+        rounds += int(sr)
+        if swapped == 0:
+            break
+
+    viol, obj, offline = chain_goal_stats(state, idx, goals, constraint,
+                                          num_topics, masks)
+    total_violation = float(viol)
+    succeeded = total_violation <= 1e-6
+    if goal.is_hard and not succeeded:
+        raise OptimizationFailureError(
+            f"hard goal {goal.name} unsatisfied: residual violation "
+            f"{total_violation:.4f} after {rounds} rounds")
+    info = {
+        "goal": goal.name,
+        "rounds": rounds,
+        "moves_applied": total_applied,
+        "swaps_applied": total_swaps,
+        "residual_violation": total_violation,
+        "succeeded": succeeded,
+        "objective": float(obj),
+        "offline_remaining": int(offline),
+    }
+    return state, info

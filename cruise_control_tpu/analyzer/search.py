@@ -251,32 +251,20 @@ def _per_broker_top_replicas(state: ClusterTensors, weight: jax.Array,
     return jax.vmap(one)(brokers)
 
 
-def swap_round_candidates(state: ClusterTensors, masks: ExclusionMasks,
-                          goal: Goal, optimized: tuple[Goal, ...],
-                          constraint: BalancingConstraint, num_topics: int,
-                          k_brokers: int = 8, j_replicas: int = 4):
-    """INTER_BROKER_REPLICA_SWAP phase (AbstractGoal.maybeApplySwapAction:287
-    + the swap search of ResourceDistributionGoal.java:599-687), batched:
+def swap_grid(state: ClusterTensors, derived: DerivedState,
+              src_score: jax.Array, dst_score: jax.Array, weight: jax.Array,
+              k_brokers: int = 8, j_replicas: int = 4):
+    """The swap candidate grid (AbstractGoal.maybeApplySwapAction:287 + the
+    swap search of ResourceDistributionGoal.java:599-687), batched:
 
     top-k overloaded brokers × top-k donors × (j heaviest source replicas ×
-    j lightest destination replicas) → K·K·j·j swap candidates. The active
-    goal scores the NET transfer (load(a) − load(b), replica counts
-    unchanged); every previously-optimized goal must accept BOTH directional
-    moves (the lexicographic stack applied to each leg). The source replica
-    must outweigh the destination replica (maxSourceReplicaLoad: a swap
-    always decreases the overloaded side, :599-687)."""
+    j lightest destination replicas) → K·K·j·j swap candidates. The source
+    replica must outweigh the destination replica (maxSourceReplicaLoad: a
+    swap always decreases the overloaded side, :599-687).
+
+    Returns (fwd, rev, net, p1, s1, p2, s2, src_b, dst_b, base_valid) where
+    fwd/rev are the directional move legs and net the net transfer."""
     from .candidates import CandidateDeltas
-
-    derived = compute_derived(state, masks.excluded_topics,
-                              masks.excluded_replica_move_brokers,
-                              masks.excluded_leadership_brokers)
-    aux = goal_aux(goal, state, derived, constraint, num_topics)
-    aux_by_goal = {g.name: goal_aux(g, state, derived, constraint, num_topics)
-                   for g in optimized}
-
-    src_score = goal.source_score(state, derived, constraint, aux)
-    dst_score = goal.dest_score(state, derived, constraint, aux)
-    weight = goal.replica_weight(state, derived, constraint, aux)
 
     k = min(k_brokers, state.num_brokers)
     src_vals, src_brokers = jax.lax.top_k(
@@ -349,6 +337,29 @@ def swap_round_candidates(state: ClusterTensors, masks: ExclusionMasks,
         partition=p1, topic=state.topic[p1],
         src_slot=fwd.src_slot, dst_slot=jnp.zeros(n, dtype=jnp.int32),
         valid=base_valid)
+    return fwd, rev, net, p1, s1, p2, s2, src_b, dst_b, base_valid
+
+
+def swap_round_candidates(state: ClusterTensors, masks: ExclusionMasks,
+                          goal: Goal, optimized: tuple[Goal, ...],
+                          constraint: BalancingConstraint, num_topics: int,
+                          k_brokers: int = 8, j_replicas: int = 4):
+    """Per-goal swap scoring: the swap grid under the active goal's scores,
+    with every previously-optimized goal's swap acceptance (the
+    lexicographic stack applied to both legs / the net transfer)."""
+    derived = compute_derived(state, masks.excluded_topics,
+                              masks.excluded_replica_move_brokers,
+                              masks.excluded_leadership_brokers)
+    aux = goal_aux(goal, state, derived, constraint, num_topics)
+    aux_by_goal = {g.name: goal_aux(g, state, derived, constraint, num_topics)
+                   for g in optimized}
+
+    src_score = goal.source_score(state, derived, constraint, aux)
+    dst_score = goal.dest_score(state, derived, constraint, aux)
+    weight = goal.replica_weight(state, derived, constraint, aux)
+
+    fwd, rev, net, p1, s1, p2, s2, src_b, dst_b, base_valid = swap_grid(
+        state, derived, src_score, dst_score, weight, k_brokers, j_replicas)
     accept = base_valid
     for g in optimized:
         accept &= g.swap_acceptance(state, derived, constraint,
@@ -358,19 +369,17 @@ def swap_round_candidates(state: ClusterTensors, masks: ExclusionMasks,
     return score, p1, s1, p2, s2, src_b, dst_b
 
 
-def _swap_round_body(state: ClusterTensors, goal: Goal,
-                     optimized: tuple[Goal, ...],
-                     constraint: BalancingConstraint, num_topics: int,
-                     masks: ExclusionMasks, moves: int = 8,
-                     ) -> tuple[ClusterTensors, jax.Array]:
-    """One batched swap round (traced body)."""
-    score, p1, s1, p2, s2, src_b, dst_b = swap_round_candidates(
-        state, masks, goal, optimized, constraint, num_topics)
-    # Selection: no two accepted swaps may share ANY partition (p1 or p2,
-    # across roles — else one partition could gain two replicas on a broker
-    # or a later scatter could half-overwrite an earlier swap) nor ANY
-    # broker (src or dst, across roles). One scatter array per key space,
-    # fed from both roles.
+def apply_swap_selection(state: ClusterTensors, score: jax.Array,
+                         p1: jax.Array, s1: jax.Array, p2: jax.Array,
+                         s2: jax.Array, src_b: jax.Array, dst_b: jax.Array,
+                         moves: int = 8) -> tuple[ClusterTensors, jax.Array]:
+    """Select + apply a conflict-free batch of scored swaps.
+
+    Selection: no two accepted swaps may share ANY partition (p1 or p2,
+    across roles — else one partition could gain two replicas on a broker
+    or a later scatter could half-overwrite an earlier swap) nor ANY
+    broker (src or dst, across roles). One scatter array per key space,
+    fed from both roles."""
     k = min(moves, score.shape[0])
     top_score, top_idx = jax.lax.top_k(score, k)
     ok = top_score > _EPS_IMPROVEMENT
@@ -395,6 +404,18 @@ def _swap_round_body(state: ClusterTensors, goal: Goal,
         .at[rows2, s2[top_idx]].set(src_b[top_idx].astype(state.assignment.dtype),
                                     mode="drop")
     return dataclasses.replace(state, assignment=new_assignment), sel.sum()
+
+
+def _swap_round_body(state: ClusterTensors, goal: Goal,
+                     optimized: tuple[Goal, ...],
+                     constraint: BalancingConstraint, num_topics: int,
+                     masks: ExclusionMasks, moves: int = 8,
+                     ) -> tuple[ClusterTensors, jax.Array]:
+    """One batched swap round (traced body)."""
+    score, p1, s1, p2, s2, src_b, dst_b = swap_round_candidates(
+        state, masks, goal, optimized, constraint, num_topics)
+    return apply_swap_selection(state, score, p1, s1, p2, s2, src_b, dst_b,
+                                moves)
 
 
 @partial(jax.jit, static_argnames=("goal", "optimized", "constraint",
