@@ -149,7 +149,16 @@ def main() -> int:
     backstop.start()
     signal.signal(signal.SIGALRM, _alarm)
     signal.alarm(int(BUDGET_S))
+    try:
+        return _guarded_main(deadline)
+    except _Watchdog:
+        return 0
+    finally:
+        signal.alarm(0)
+        backstop.cancel()
 
+
+def _guarded_main(deadline: float) -> int:
     t0 = time.time()
     platform = _probe_device()
     if platform is None:
@@ -181,14 +190,9 @@ def main() -> int:
         if remaining < 60:
             break
         t0 = time.time()
-        try:
-            _emit(_run_stage(jax, num_brokers, num_partitions, device,
-                             on_cpu=platform is None or platform == "cpu"))
-        except _Watchdog:
-            return 0
+        _emit(_run_stage(jax, num_brokers, num_partitions, device,
+                         on_cpu=platform is None or platform == "cpu"))
         prev_total = time.time() - t0
-    signal.alarm(0)
-    backstop.cancel()
     return 0
 
 

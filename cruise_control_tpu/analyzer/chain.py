@@ -39,9 +39,10 @@ from .constraint import BalancingConstraint
 from .derived import compute_derived
 from .goals.base import Goal
 from .search import (
-    _EPS_IMPROVEMENT, _OFFLINE_BONUS, ExclusionMasks,
+    _OFFLINE_BONUS, _conflict_free_top_m, ExclusionMasks,
     OptimizationFailureError, SearchConfig, apply_selected,
-    apply_swap_selection, goal_aux, reduce_per_source, swap_grid,
+    apply_swap_selection, goal_aux, reduce_per_source, run_rounds_loop,
+    swap_grid,
 )
 
 
@@ -89,36 +90,6 @@ def _switch_scores(active_idx, goals, aux_list, state, derived, constraint):
         return fn
 
     return jax.lax.switch(active_idx, [branch(i) for i in range(len(goals))], 0)
-
-
-def _chain_conflict_select(score, partition, src, dst, m: int,
-                           num_partitions: int, num_brokers: int,
-                           dedupe_brokers: jax.Array):
-    """``search._conflict_free_top_m`` with a TRACED broker-dedupe flag:
-    the per-partition constraint always applies; the per-broker constraint
-    is switched off for independent-per-broker goals at runtime."""
-    k = min(m, score.shape[0])
-    top_score, top_idx = jax.lax.top_k(score, k)
-    ok = top_score > _EPS_IMPROVEMENT
-    rank = jnp.arange(k, dtype=jnp.int32)
-
-    sel_p = partition[top_idx]
-    sel_src = src[top_idx]
-    sel_dst = dst[top_idx]
-
-    big = jnp.int32(k + 1)
-    rank_eff = jnp.where(ok, rank, big)
-
-    first_p = jnp.full(num_partitions, big, dtype=jnp.int32) \
-        .at[sel_p].min(rank_eff)
-    accept = ok & (first_p[sel_p] == rank)
-    first_src = jnp.full(num_brokers, big, dtype=jnp.int32) \
-        .at[sel_src].min(rank_eff)
-    first_dst = jnp.full(num_brokers, big, dtype=jnp.int32) \
-        .at[sel_dst].min(rank_eff)
-    broker_ok = (first_src[sel_src] == rank) & (first_dst[sel_dst] == rank)
-    accept &= jnp.where(dedupe_brokers, broker_ok, True)
-    return top_idx, accept
 
 
 def _chain_round_body(state: ClusterTensors, active_idx: jax.Array,
@@ -199,7 +170,7 @@ def _chain_round_body(state: ClusterTensors, active_idx: jax.Array,
     # solver.moves.per.round still throttles per-round churn.
     independent = indep_f[active_idx] & ~prior_mask.any()
     m = max(cfg.moves_per_round, cfg.num_sources)
-    top_idx_red, sel = _chain_conflict_select(
+    top_idx_red, sel = _conflict_free_top_m(
         score[red_idx], deltas.partition[red_idx], deltas.src_broker[red_idx],
         deltas.dst_broker[red_idx], m, state.num_partitions,
         state.num_brokers, dedupe_brokers=~independent)
@@ -222,21 +193,10 @@ def chain_optimize_rounds(state: ClusterTensors, active_idx: jax.Array,
     """Fused multi-round driver for ANY goal in the chain: one compilation
     serves all G (active_idx, prior_mask) combinations. Returns
     (final_state, total_moves, rounds_run)."""
-
-    def cond(c):
-        _s, _moves, rounds, last = c
-        return (last > 0) & (rounds < cfg.max_rounds)
-
-    def body(c):
-        s, moves, rounds, _last = c
-        ns, applied = _chain_round_body(s, active_idx, prior_mask, goals,
-                                        constraint, cfg, num_topics, masks)
-        applied = applied.astype(jnp.int32)
-        return ns, moves + applied, rounds + 1, applied
-
-    final, moves, rounds, _ = jax.lax.while_loop(
-        cond, body, (state, jnp.int32(0), jnp.int32(0), jnp.int32(1)))
-    return final, moves, rounds
+    return run_rounds_loop(
+        lambda s: _chain_round_body(s, active_idx, prior_mask, goals,
+                                    constraint, cfg, num_topics, masks),
+        state, cfg.max_rounds)
 
 
 def _chain_swap_body(state: ClusterTensors, active_idx: jax.Array,
@@ -286,21 +246,10 @@ def chain_swap_rounds(state: ClusterTensors, active_idx: jax.Array,
                       max_rounds: int = 64,
                       ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
     """Fused swap-phase driver, chain-parameterized."""
-
-    def cond(c):
-        _s, _swaps, rounds, last = c
-        return (last > 0) & (rounds < max_rounds)
-
-    def body(c):
-        s, swaps, rounds, _last = c
-        ns, applied = _chain_swap_body(s, active_idx, prior_mask, goals,
-                                       constraint, num_topics, masks, moves)
-        applied = applied.astype(jnp.int32)
-        return ns, swaps + applied, rounds + 1, applied
-
-    final, swaps, rounds, _ = jax.lax.while_loop(
-        cond, body, (state, jnp.int32(0), jnp.int32(0), jnp.int32(1)))
-    return final, swaps, rounds
+    return run_rounds_loop(
+        lambda s: _chain_swap_body(s, active_idx, prior_mask, goals,
+                                   constraint, num_topics, masks, moves),
+        state, max_rounds)
 
 
 @partial(jax.jit, static_argnames=("goals", "constraint", "num_topics"))

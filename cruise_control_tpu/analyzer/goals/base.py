@@ -91,19 +91,40 @@ class Goal:
         applied (positive = improves). Default: pairwise violation delta."""
         raise NotImplementedError
 
+    def swap_leg_acceptance(self, state, derived, constraint, aux,
+                            leg: CandidateDeltas) -> jax.Array:
+        """[N] bool — tolerate one directional leg of a swap, judged as an
+        ordinary move. Default: ``acceptance``. Per-partition structural
+        goals (rack, broker-set, topic counts) keep this; goals judged on
+        per-broker TOTALS override it to all-true and judge the net
+        transfer in ``swap_net_acceptance`` instead. The sharded solver
+        evaluates leg acceptance on the device OWNING the leg's partition —
+        implementations may index per-partition state freely."""
+        return self.acceptance(state, derived, constraint, aux, leg)
+
+    def swap_net_acceptance(self, state, derived, constraint, aux,
+                            net: CandidateDeltas) -> jax.Array:
+        """[N] bool — tolerate the NET transfer of a swap (replica counts
+        unchanged, load(a) − load(b) moves src→dst). Default: all-true.
+        CONTRACT: implementations must use only broker-indexed state
+        (``derived`` aggregates, capacities) and the deltas' own fields —
+        ``net.partition`` holds GLOBAL partition ids under the sharded
+        solver, so per-partition gathers are out of bounds there."""
+        return jnp.ones(net.valid.shape[0], dtype=bool)
+
     def swap_acceptance(self, state, derived, constraint, aux,
                         fwd: CandidateDeltas, rev: CandidateDeltas,
                         net: CandidateDeltas) -> jax.Array:
-        """[N] bool — tolerate each candidate SWAP. Default: both
-        directional legs pass ``acceptance`` independently (sound for
-        per-partition structural goals: rack, broker-set, topic counts).
-        Goals whose acceptance depends on per-broker TOTALS (resource load,
-        replica counts) override to judge ``net`` — a swap leaves counts
-        unchanged and transfers only load(a) − load(b), so leg-wise checks
-        would spuriously veto (ActionType.INTER_BROKER_REPLICA_SWAP
-        handling in the reference's actionAcceptance)."""
-        return self.acceptance(state, derived, constraint, aux, fwd) \
-            & self.acceptance(state, derived, constraint, aux, rev)
+        """[N] bool — tolerate each candidate SWAP: both directional legs
+        pass ``swap_leg_acceptance`` and the net transfer passes
+        ``swap_net_acceptance`` (ActionType.INTER_BROKER_REPLICA_SWAP
+        handling in the reference's actionAcceptance). Override the two
+        components, not this composition — the sharded solver calls them
+        separately (legs on the owning device, net on the replicated
+        pairing grid)."""
+        return self.swap_leg_acceptance(state, derived, constraint, aux, fwd) \
+            & self.swap_leg_acceptance(state, derived, constraint, aux, rev) \
+            & self.swap_net_acceptance(state, derived, constraint, aux, net)
 
     # -- candidate generation hints ---------------------------------------
     def source_score(self, state, derived, constraint, aux) -> jax.Array:

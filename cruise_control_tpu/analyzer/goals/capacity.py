@@ -60,7 +60,12 @@ class ResourceCapacityGoal(Goal):
     def replica_weight(self, state, derived, constraint, aux):
         return replica_load(state)[:, :, int(self.resource)]
 
-    def swap_acceptance(self, state, derived, constraint, aux, fwd, rev, net):
+    def swap_leg_acceptance(self, state, derived, constraint, aux, leg):
+        # Judged on the net transfer only — a leg-wise capacity check would
+        # spuriously veto swaps whose net effect is within limits.
+        return jnp.ones(leg.valid.shape[0], dtype=bool)
+
+    def swap_net_acceptance(self, state, derived, constraint, aux, net):
         # Net transfer is SIGNED (a swap ranked on another resource can pull
         # load toward the source on this one): bound BOTH endpoints.
         r = int(self.resource)
@@ -103,9 +108,9 @@ class ReplicaCapacityGoal(Goal):
         # Any replica works; prefer light ones to minimize load disturbance.
         return -replica_load(state).sum(axis=-1)
 
-    def swap_acceptance(self, state, derived, constraint, aux, fwd, rev, net):
+    def swap_leg_acceptance(self, state, derived, constraint, aux, leg):
         # Swaps never change per-broker replica counts: always acceptable.
-        return jnp.ones(net.valid.shape[0], dtype=bool)
+        return jnp.ones(leg.valid.shape[0], dtype=bool)
 
 
 def make_capacity_goals() -> list[Goal]:

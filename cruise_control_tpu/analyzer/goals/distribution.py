@@ -120,7 +120,12 @@ class ResourceDistributionGoal(Goal):
     def replica_weight(self, state, derived, constraint, aux):
         return replica_load(state)[:, :, int(self.resource)]
 
-    def swap_acceptance(self, state, derived, constraint, aux, fwd, rev, net):
+    def swap_leg_acceptance(self, state, derived, constraint, aux, leg):
+        # Judged on the net transfer only (leg-wise band checks would veto
+        # swaps whose net effect stays inside the band).
+        return jnp.ones(leg.valid.shape[0], dtype=bool)
+
+    def swap_net_acceptance(self, state, derived, constraint, aux, net):
         # Net transfer is SIGNED; accept iff the PAIR's band violation does
         # not worsen (two-sided — the one-sided move acceptance would let a
         # src-gaining swap blow past the source's band).
@@ -208,7 +213,11 @@ class CountDistributionGoal(Goal):
             return jnp.where(is_leader_slot(state), w, -jnp.inf)
         return w
 
-    def swap_acceptance(self, state, derived, constraint, aux, fwd, rev, net):
+    def swap_leg_acceptance(self, state, derived, constraint, aux, leg):
+        # Counts are judged on the net transfer only.
+        return jnp.ones(leg.valid.shape[0], dtype=bool)
+
+    def swap_net_acceptance(self, state, derived, constraint, aux, net):
         # Replica counts are swap-invariant; leadership may transfer with
         # the heavier replica (net.leader_delta ∈ {-1, 0, 1}, signed) —
         # accept iff the pair's count-band violation does not worsen.

@@ -65,13 +65,13 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
         return jnp.where(derived.allowed_replica_move & (room > 0), room,
                          -jnp.inf)
 
-    def swap_acceptance(self, state, derived, constraint, aux, fwd, rev, net):
-        # Swaps keep per-broker counts, so only the RACK legs apply — the
-        # inherited leg-wise check would veto every swap once brokers sit at
-        # the even ceiling (the steady state of kafka-assigner mode).
-        rack = RackAwareGoal.acceptance
-        return rack(self, state, derived, constraint, aux, fwd) \
-            & rack(self, state, derived, constraint, aux, rev)
+    def swap_leg_acceptance(self, state, derived, constraint, aux, leg):
+        # Swaps keep per-broker counts, so only the RACK check applies per
+        # leg — the inherited move acceptance (count ceiling) would veto
+        # every swap once brokers sit at the even ceiling (the steady state
+        # of kafka-assigner mode).
+        return RackAwareGoal.acceptance(self, state, derived, constraint,
+                                        aux, leg)
 
     def replica_weight(self, state, derived, constraint, aux):
         # Unlike the pure rack goal (which only moves duplicated replicas),

@@ -122,11 +122,13 @@ def reduce_per_source(score: jax.Array,
 def _conflict_free_top_m(score: jax.Array, partition: jax.Array,
                          src: jax.Array, dst: jax.Array, m: int,
                          num_partitions: int, num_brokers: int,
-                         dedupe_brokers: bool = True):
+                         dedupe_brokers: bool | jax.Array = True):
     """Indices of up to ``m`` best-scoring candidates such that no two share
     a partition — nor, when ``dedupe_brokers`` (goals whose scores depend on
     per-broker totals), a source or destination broker. Scatter-min of the
-    score-rank per key resolves conflicts in parallel (no sequential scan)."""
+    score-rank per key resolves conflicts in parallel (no sequential scan).
+    ``dedupe_brokers`` may be a traced bool (the chain kernel switches it
+    per active goal at runtime)."""
     k = min(m, score.shape[0])
     top_score, top_idx = jax.lax.top_k(score, k)
     ok = top_score > _EPS_IMPROVEMENT
@@ -141,11 +143,39 @@ def _conflict_free_top_m(score: jax.Array, partition: jax.Array,
 
     first_p = jnp.full(num_partitions, big, dtype=jnp.int32).at[sel_p].min(rank_eff)
     accept = ok & (first_p[sel_p] == rank)
-    if dedupe_brokers:
-        first_src = jnp.full(num_brokers, big, dtype=jnp.int32).at[sel_src].min(rank_eff)
-        first_dst = jnp.full(num_brokers, big, dtype=jnp.int32).at[sel_dst].min(rank_eff)
-        accept &= (first_src[sel_src] == rank) & (first_dst[sel_dst] == rank)
+    if dedupe_brokers is False:
+        return top_idx, accept
+    first_src = jnp.full(num_brokers, big, dtype=jnp.int32).at[sel_src].min(rank_eff)
+    first_dst = jnp.full(num_brokers, big, dtype=jnp.int32).at[sel_dst].min(rank_eff)
+    broker_ok = (first_src[sel_src] == rank) & (first_dst[sel_dst] == rank)
+    if dedupe_brokers is True:
+        accept &= broker_ok
+    else:
+        accept &= jnp.where(dedupe_brokers, broker_ok, True)
     return top_idx, accept
+
+
+def run_rounds_loop(round_body, state: ClusterTensors, max_rounds: int,
+                    ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
+    """Shared fused-driver scaffold: iterate ``round_body(state) ->
+    (new_state, applied)`` under ``lax.while_loop`` until a round applies
+    nothing (or ``max_rounds``) entirely on device — ONE host round-trip
+    for the whole loop. Returns (final_state, total_applied, rounds_run).
+    Used by the single-chip, chain-shared, and sharded drivers alike."""
+
+    def cond(c):
+        _s, _total, rounds, last = c
+        return (last > 0) & (rounds < max_rounds)
+
+    def body(c):
+        s, total, rounds, _last = c
+        ns, applied = round_body(s)
+        applied = applied.astype(jnp.int32)
+        return ns, total + applied, rounds + 1, applied
+
+    final, total, rounds, _ = jax.lax.while_loop(
+        cond, body, (state, jnp.int32(0), jnp.int32(0), jnp.int32(1)))
+    return final, total, rounds
 
 
 def score_round_candidates(state: ClusterTensors, masks: ExclusionMasks,
@@ -485,21 +515,10 @@ def optimize_rounds(state: ClusterTensors, goal: Goal,
     no per-round dispatch).
 
     Returns (final_state, total_moves, rounds_run)."""
-
-    def cond(c):
-        _s, _moves, rounds, last = c
-        return (last > 0) & (rounds < cfg.max_rounds)
-
-    def body(c):
-        s, moves, rounds, _last = c
-        ns, applied = _round_body(s, goal, optimized, constraint, cfg,
-                                  num_topics, masks)
-        applied = applied.astype(jnp.int32)
-        return ns, moves + applied, rounds + 1, applied
-
-    final, moves, rounds, _ = jax.lax.while_loop(
-        cond, body, (state, jnp.int32(0), jnp.int32(0), jnp.int32(1)))
-    return final, moves, rounds
+    return run_rounds_loop(
+        lambda s: _round_body(s, goal, optimized, constraint, cfg,
+                              num_topics, masks),
+        state, cfg.max_rounds)
 
 
 @partial(jax.jit, static_argnames=("goal", "optimized", "constraint",
@@ -509,21 +528,10 @@ def swap_rounds(state: ClusterTensors, goal: Goal, optimized: tuple[Goal, ...],
                 masks: ExclusionMasks, moves: int = 8, max_rounds: int = 64,
                 ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
     """Fused swap-phase driver (while_loop analogue of optimize_rounds)."""
-
-    def cond(c):
-        _s, _swaps, rounds, last = c
-        return (last > 0) & (rounds < max_rounds)
-
-    def body(c):
-        s, swaps, rounds, _last = c
-        ns, applied = _swap_round_body(s, goal, optimized, constraint,
-                                       num_topics, masks, moves)
-        applied = applied.astype(jnp.int32)
-        return ns, swaps + applied, rounds + 1, applied
-
-    final, swaps, rounds, _ = jax.lax.while_loop(
-        cond, body, (state, jnp.int32(0), jnp.int32(0), jnp.int32(1)))
-    return final, swaps, rounds
+    return run_rounds_loop(
+        lambda s: _swap_round_body(s, goal, optimized, constraint,
+                                   num_topics, masks, moves),
+        state, max_rounds)
 
 
 def optimize_goal(state: ClusterTensors, goal: Goal,

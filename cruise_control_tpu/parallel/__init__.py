@@ -8,9 +8,11 @@ over ICI/DCN instead of locks.
 from .mesh import PARTITION_AXIS, make_mesh, partition_sharding, replicated_sharding
 from .sharded import (
     optimize_goal_sharded, shard_cluster, sharded_optimize_round,
+    sharded_swap_round,
 )
 
 __all__ = [
     "PARTITION_AXIS", "make_mesh", "partition_sharding", "replicated_sharding",
     "optimize_goal_sharded", "shard_cluster", "sharded_optimize_round",
+    "sharded_swap_round",
 ]
