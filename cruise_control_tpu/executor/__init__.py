@@ -8,7 +8,14 @@ pluggable; an in-memory fake backs tests and simulations.
 
 from .admin import AdminBackend, InMemoryAdminBackend, PartitionState
 from .concurrency import ConcurrencyCaps, ExecutionConcurrencyManager
-from .executor import Executor, ExecutorState, OngoingExecutionError
+from .executor import (
+    Executor, ExecutorState, OngoingExecutionError,
+    OngoingExternalReassignmentError,
+)
+from .notifier import (
+    ExecutorNotifier, LoggingExecutorNotifier, NoopExecutorNotifier,
+    WebhookExecutorNotifier,
+)
 from .planner import ExecutionTaskPlanner
 from .strategy import (
     BaseReplicaMovementStrategy, PostponeUrpReplicaMovementStrategy,
@@ -25,6 +32,9 @@ __all__ = [
     "AdminBackend", "InMemoryAdminBackend", "PartitionState",
     "ConcurrencyCaps", "ExecutionConcurrencyManager",
     "Executor", "ExecutorState", "OngoingExecutionError",
+    "OngoingExternalReassignmentError", "ExecutorNotifier",
+    "LoggingExecutorNotifier", "NoopExecutorNotifier",
+    "WebhookExecutorNotifier",
     "ExecutionTaskPlanner",
     "BaseReplicaMovementStrategy", "PostponeUrpReplicaMovementStrategy",
     "PrioritizeLargeReplicaMovementStrategy",

@@ -20,6 +20,7 @@ from typing import Callable, Iterable, Sequence
 from ..analyzer.proposals import ExecutionProposal
 from .admin import AdminBackend
 from .concurrency import ConcurrencyCaps, ExecutionConcurrencyManager
+from .notifier import ExecutorNotifier, LoggingExecutorNotifier
 from .planner import ExecutionTaskPlanner
 from .strategy import ReplicaMovementStrategy
 from .task import (
@@ -45,6 +46,13 @@ class OngoingExecutionError(RuntimeError):
     """An execution is already in progress (Executor's IllegalState)."""
 
 
+class OngoingExternalReassignmentError(RuntimeError):
+    """The cluster has partition reassignments this executor did not start
+    (ExecutionUtils.ongoingPartitionReassignments sanity check): refuse to
+    stack an execution on top unless told to stop the external agent or to
+    adopt the in-flight work."""
+
+
 class Executor:
     def __init__(self, admin: AdminBackend,
                  caps: ConcurrencyCaps | None = None,
@@ -53,7 +61,8 @@ class Executor:
                  replication_throttle: int | None = None,
                  task_timeout_s: float = 3600.0,
                  on_sampling_mode_change: Callable[[bool], None] | None = None,
-                 synchronous: bool = False):
+                 synchronous: bool = False,
+                 notifier: ExecutorNotifier | None = None):
         self._admin = admin
         self._concurrency = ExecutionConcurrencyManager(caps)
         self._strategy = strategy
@@ -64,6 +73,7 @@ class Executor:
         # execution so in-flight moves don't pollute the load model.
         self._on_sampling_mode_change = on_sampling_mode_change
         self._synchronous = synchronous
+        self._notifier = notifier or LoggingExecutorNotifier()
 
         self._lock = threading.Lock()
         self._state = ExecutorState.NO_TASK_IN_PROGRESS
@@ -83,13 +93,26 @@ class Executor:
         return self._state is not ExecutorState.NO_TASK_IN_PROGRESS
 
     def execute_proposals(self, proposals: Sequence[ExecutionProposal],
-                          uuid: str = "") -> None:
+                          uuid: str = "",
+                          stop_external_agent: bool = False) -> None:
         """Start executing; raises OngoingExecutionError when busy
-        (Executor.executeProposals:809)."""
+        (Executor.executeProposals:809). Reassignments already in flight
+        that this executor did not start are EXTERNAL: refused by default
+        (ExecutionUtils.ongoingPartitionReassignments sanity), cancelled
+        first when ``stop_external_agent`` (maybeStopExternalAgent:1261)."""
         with self._lock:
             if self.has_ongoing_execution():
                 raise OngoingExecutionError(
                     f"execution {self._uuid!r} still in progress")
+            external = self._admin.list_reassigning_partitions()
+            if external:
+                if not stop_external_agent:
+                    raise OngoingExternalReassignmentError(
+                        f"{len(external)} partition(s) already reassigning "
+                        "(external agent?): pass stop_external_agent=True "
+                        "to cancel them, or adopt_ongoing_reassignments() "
+                        "to track them to completion")
+                self._admin.cancel_partition_reassignments(external)
             self._state = ExecutorState.STARTING_EXECUTION
             self._stop_requested.clear()
             self._uuid = uuid
@@ -103,6 +126,104 @@ class Executor:
             self._thread = threading.Thread(target=self._run, daemon=True,
                                             name=f"proposal-execution-{uuid}")
             self._thread.start()
+
+    def adopt_ongoing_reassignments(self, uuid: str = "adopted") -> int:
+        """Recover after a restart: observe reassignments already in flight
+        (from a previous executor life or an external tool), reconstruct
+        their proposals from the cluster's adding/removing sets, and track
+        them to completion with the normal poll loop — without re-submitting
+        anything (Executor.java:1238 listPartitionsBeingReassigned recovery).
+        Returns the number of adopted tasks (0 = nothing to adopt)."""
+        with self._lock:
+            if self.has_ongoing_execution():
+                raise OngoingExecutionError(
+                    f"execution {self._uuid!r} still in progress")
+            parts = self._admin.describe_partitions()
+            adopted: list[ExecutionProposal] = []
+            for key, p in parts.items():
+                if not p.is_reassigning:
+                    continue
+                target = tuple(b for b in p.replicas if b not in p.removing)
+                original = tuple(b for b in p.replicas if b not in p.adding)
+                # Leadership-neutral: the broker-side reassignment protocol
+                # moves the leader itself if it sits on a removed replica —
+                # adoption only tracks the replica movement.
+                leader = p.leader if p.leader in target else target[0]
+                adopted.append(ExecutionProposal(
+                    topic=p.topic, partition=p.partition,
+                    old_leader=leader, old_replicas=original,
+                    new_replicas=target, new_leader=leader))
+            if not adopted:
+                return 0
+            self._state = ExecutorState.STARTING_EXECUTION
+            self._stop_requested.clear()
+            self._uuid = uuid
+            self._task_manager = ExecutionTaskManager()
+            self._planner = ExecutionTaskPlanner(self._strategy)
+            tasks = self._task_manager.tasks_from_proposals(adopted)
+        run = lambda: self._run_adopted(tasks)  # noqa: E731
+        if self._synchronous:
+            run()
+        else:
+            self._thread = threading.Thread(target=run, daemon=True,
+                                            name=f"adopted-execution-{uuid}")
+            self._thread.start()
+        return len(tasks)
+
+    def _run_adopted(self, tasks: list[ExecutionTask]) -> None:
+        """Poll already-submitted reassignments to completion (no new
+        alterPartitionReassignments calls)."""
+        t0 = time.time()
+        tracker = self._task_manager.tracker
+        in_flight = [t for t in tasks
+                     if t.task_type is TaskType.INTER_BROKER_REPLICA_ACTION]
+        with self._lock:
+            if not self._stop_requested.is_set():
+                self._state = \
+                    ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+        for task in in_flight:
+            tracker.transition(task, task.in_progress)
+        stopped = False
+        try:
+            # Adopted moves pollute the load model like any others: pause
+            # sampling for the duration (Executor.java:1408-1424).
+            if self._on_sampling_mode_change:
+                self._on_sampling_mode_change(True)
+            while in_flight:
+                if self._stop_requested.is_set():
+                    self._abort_pending_and_inflight(in_flight)
+                    stopped = True
+                    break
+                time.sleep(self._interval)
+                self._poll_inter_broker(in_flight)
+        finally:
+            if self._on_sampling_mode_change:
+                self._on_sampling_mode_change(False)
+            self._finish_run(t0, stopped)
+
+    def _finish_run(self, t0: float, stopped: bool) -> None:
+        tm = self._task_manager
+        summary = {
+            "uuid": self._uuid,
+            "stopped": stopped or self._stop_requested.is_set(),
+            "durationS": round(time.time() - t0, 3),
+            "taskCounts": tm.tracker.counts() if tm else {},
+        }
+        self._history.append(summary)
+        # Reset state FIRST: a raising notifier must not wedge the executor
+        # in an in-progress state forever.
+        with self._lock:
+            self._state = ExecutorState.NO_TASK_IN_PROGRESS
+        try:
+            if summary["stopped"]:
+                self._notifier.on_execution_stopped(summary)
+            else:
+                self._notifier.on_execution_finished(summary)
+        except Exception:  # noqa: BLE001 - notification is best-effort
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "executor notifier failed", exc_info=True)
 
     def stop_execution(self) -> None:
         """User-triggered stop (Executor.userTriggeredStopExecution:1139):
@@ -171,15 +292,7 @@ class Executor:
             self._throttle.clear_throttles()
             if self._on_sampling_mode_change:
                 self._on_sampling_mode_change(False)
-            tm = self._task_manager
-            self._history.append({
-                "uuid": self._uuid,
-                "stopped": stopped or self._stop_requested.is_set(),
-                "durationS": round(time.time() - t0, 3),
-                "taskCounts": tm.tracker.counts() if tm else {},
-            })
-            with self._lock:
-                self._state = ExecutorState.NO_TASK_IN_PROGRESS
+            self._finish_run(t0, stopped)
 
     def _abort_pending_and_inflight(self, in_flight: list[ExecutionTask]) -> None:
         assert self._planner is not None and self._task_manager is not None

@@ -1,0 +1,56 @@
+"""ExecutorNotifier SPI: alert on execution finish/stop.
+
+Reference parity: executor/ExecutorNotifier.java (SPI; sendNotification on
+execution finished or user-stopped) + the noop implementation. The notifier
+receives the execution summary record the executor also appends to its
+history, so external systems (ticketing, chat-ops) can mirror the
+operation audit log.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Protocol
+
+LOG = logging.getLogger(__name__)
+
+
+class ExecutorNotifier(Protocol):
+    def on_execution_finished(self, summary: dict) -> None: ...
+
+    def on_execution_stopped(self, summary: dict) -> None: ...
+
+
+class NoopExecutorNotifier:
+    def on_execution_finished(self, summary: dict) -> None:
+        pass
+
+    def on_execution_stopped(self, summary: dict) -> None:
+        pass
+
+
+class LoggingExecutorNotifier:
+    """Default: mirrors the reference's OPERATION_LOGGER-style audit line."""
+
+    def on_execution_finished(self, summary: dict) -> None:
+        LOG.info("execution finished: %s", summary)
+
+    def on_execution_stopped(self, summary: dict) -> None:
+        LOG.warning("execution stopped: %s", summary)
+
+
+class WebhookExecutorNotifier:
+    """POST the summary as JSON to a webhook (injectable http_post for
+    tests; shares the detector notifiers' webhook helper)."""
+
+    def __init__(self, url: str, http_post=None):
+        from ..detector.notifier import _default_http_post
+
+        self._url = url
+        self._post = http_post or _default_http_post
+
+    def on_execution_finished(self, summary: dict) -> None:
+        self._post(self._url, {"event": "execution_finished", **summary})
+
+    def on_execution_stopped(self, summary: dict) -> None:
+        self._post(self._url, {"event": "execution_stopped", **summary})

@@ -1,0 +1,52 @@
+"""Real-Kafka bindings (import-gated — no Kafka client ships in every
+environment).
+
+The framework's external boundaries are protocols with in-memory
+implementations used by tests and the demo mode:
+
+- ``executor.admin.AdminBackend``      ← ``KafkaAdminBackend`` (here)
+- ``monitor.sampling.MetricsTransport`` ← ``KafkaMetricsTransport`` (here)
+- ``monitor.sampling.SampleStore``      ← ``KafkaSampleStore`` (here)
+
+This package implements those protocols over ``kafka-python``
+(KafkaAdminClient / KafkaConsumer / KafkaProducer). Importing the package
+always succeeds; constructing any binding without kafka-python installed
+raises ``KafkaClientUnavailableError`` with install guidance. Reference
+parity: executor/ExecutionUtils.java:433,483 (electLeaders /
+alterPartitionReassignments), monitor/sampling/
+CruiseControlMetricsReporterSampler.java (metrics-topic consumer),
+monitor/sampling/KafkaSampleStore.java:94-204 (sample topics + replay).
+"""
+
+from __future__ import annotations
+
+try:  # pragma: no cover - exercised only where kafka-python is installed
+    import kafka  # noqa: F401  (kafka-python)
+    HAVE_KAFKA = True
+except ImportError:
+    HAVE_KAFKA = False
+
+
+class KafkaClientUnavailableError(ImportError):
+    """kafka-python is not installed in this environment."""
+
+    def __init__(self, what: str):
+        super().__init__(
+            f"{what} needs the kafka-python client "
+            "(pip install kafka-python>=2.1); this environment has no "
+            "Kafka client, so only the in-memory backends are available.")
+
+
+def require_kafka(what: str) -> None:
+    if not HAVE_KAFKA:
+        raise KafkaClientUnavailableError(what)
+
+
+from .admin import KafkaAdminBackend            # noqa: E402
+from .sample_store import KafkaSampleStore      # noqa: E402
+from .transport import KafkaMetricsTransport    # noqa: E402
+
+__all__ = [
+    "HAVE_KAFKA", "KafkaClientUnavailableError", "require_kafka",
+    "KafkaAdminBackend", "KafkaMetricsTransport", "KafkaSampleStore",
+]

@@ -1,0 +1,93 @@
+"""SampleStore over two Kafka topics (partition samples + training
+samples).
+
+Reference parity: monitor/sampling/KafkaSampleStore.java:94-106 (two
+durable topics ``__KafkaCruiseControlPartitionMetricSamples`` /
+``__KafkaCruiseControlModelTrainingSamples``), :179 (storeSamples
+producer), :204 (loadSamples replay at startup for warm windows).
+
+Serialization reuses the JSONL row format of
+``monitor.sampling.sample_store.FileSampleStore`` — one sample per record
+— so a cluster can migrate between file and Kafka persistence.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from ..monitor.sampling.sampler import SamplerResult
+from . import require_kafka
+
+LOG = logging.getLogger(__name__)
+
+PARTITION_SAMPLES_TOPIC = "__KafkaCruiseControlPartitionMetricSamples"
+TRAINING_SAMPLES_TOPIC = "__KafkaCruiseControlModelTrainingSamples"
+
+
+class KafkaSampleStore:
+    """Implements ``monitor.sampling.SampleStore`` against Kafka topics."""
+
+    def __init__(self, bootstrap_servers: str,
+                 partition_topic: str = PARTITION_SAMPLES_TOPIC,
+                 training_topic: str = TRAINING_SAMPLES_TOPIC,
+                 group_id: str = "cruise-control-tpu-sample-store",
+                 **kwargs):
+        require_kafka("KafkaSampleStore")
+        self._bootstrap = bootstrap_servers
+        self._topics = {"partition": partition_topic,
+                        "training": training_topic}
+        self._group = group_id
+        self._kwargs = kwargs
+        self._producer = None
+
+    def store_samples(self, result: SamplerResult) -> None:
+        from ..monitor.sampling.samples import (
+            broker_samples_record, partition_samples_record,
+        )
+
+        if self._producer is None:
+            from kafka import KafkaProducer
+
+            self._producer = KafkaProducer(
+                bootstrap_servers=self._bootstrap, acks=1, **self._kwargs)
+        for row in partition_samples_record(result.partition_samples):
+            self._producer.send(self._topics["partition"],
+                                json.dumps(row).encode())
+        # Broker samples feed the linear CPU model — the reference's
+        # "model training samples" topic.
+        for row in broker_samples_record(result.broker_samples):
+            self._producer.send(self._topics["training"],
+                                json.dumps(row).encode())
+        self._producer.flush()
+
+    def load_samples(self) -> SamplerResult:
+        """Replay both topics from the beginning (warm-start windows after a
+        restart — KafkaSampleStore.loadSamples:204)."""
+        from kafka import KafkaConsumer
+
+        from ..monitor.sampling.samples import (
+            broker_samples_from_record, partition_samples_from_record,
+        )
+
+        rows = {"partition": [], "training": []}
+        for kind, topic in self._topics.items():
+            consumer = KafkaConsumer(
+                topic, bootstrap_servers=self._bootstrap,
+                group_id=None, auto_offset_reset="earliest",
+                enable_auto_commit=False, consumer_timeout_ms=3_000,
+                **self._kwargs)
+            for record in consumer:
+                try:
+                    rows[kind].append(json.loads(record.value))
+                except (ValueError, TypeError):
+                    LOG.warning("skipping undecodable sample record at %s:%d",
+                                topic, record.offset)
+            consumer.close()
+        return SamplerResult(
+            partition_samples_from_record(rows["partition"]),
+            broker_samples_from_record(rows["training"]), 0)
+
+    def close(self) -> None:
+        if self._producer is not None:
+            self._producer.close()

@@ -6,10 +6,14 @@ records to the metrics transport (the ``__CruiseControlMetrics`` topic in a
 real deployment; an in-memory transport in tests/simulations).
 """
 
+from .agent import BrokerMetricsRegistry, MetricsReporterAgent, MetricsRegistryView
+from .container import cgroup_cpu_cores, container_cpu_util
 from .metrics import (
     CruiseControlMetric, broker_metric, deserialize, partition_metric,
     serialize, topic_metric,
 )
 
 __all__ = ["CruiseControlMetric", "broker_metric", "deserialize",
-           "partition_metric", "serialize", "topic_metric"]
+           "partition_metric", "serialize", "topic_metric",
+           "BrokerMetricsRegistry", "MetricsReporterAgent",
+           "MetricsRegistryView", "cgroup_cpu_cores", "container_cpu_util"]

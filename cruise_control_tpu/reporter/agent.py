@@ -1,0 +1,154 @@
+"""The broker-side metrics reporter agent.
+
+Reference parity: cruise-control-metrics-reporter
+CruiseControlMetricsReporter.java:62-93 (plugin registered inside the
+broker, periodic sampling loop), :241-270 (reporting interval, producer
+send), topic auto-creation (maybeCreateCruiseControlMetricsTopic) and
+YammerMetricProcessor (registry → raw metric records). Container CPU
+awareness via ``container.py``.
+
+Redesign: the broker's metrics registry is abstracted behind a small view
+(``snapshot(time_ms) -> [CruiseControlMetric]``) so the agent is testable
+and embeddable (a real deployment wires a psutil/JMX-bridge view; tests
+and the demo wire ``BrokerMetricsRegistry`` which the embedding process
+updates directly). Transport is the same ``MetricsTransport`` protocol the
+sampler consumes — in-memory for tests, Kafka via
+``cruise_control_tpu.kafka.KafkaMetricsTransport`` in production.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Protocol
+
+from ..metricdef.raw_metric_type import RawMetricType as R
+from .container import container_cpu_util
+from .metrics import (
+    CruiseControlMetric, broker_metric, partition_metric, serialize,
+    topic_metric,
+)
+
+LOG = logging.getLogger(__name__)
+
+
+class MetricsRegistryView(Protocol):
+    """What the agent samples each interval (YammerMetricProcessor's role)."""
+
+    def snapshot(self, time_ms: int) -> list[CruiseControlMetric]: ...
+
+
+class BrokerMetricsRegistry:
+    """A concrete registry the embedding broker process keeps updated:
+    per-topic byte rates, partition sizes, and host CPU utilization. Its
+    ``snapshot`` emits the same record families the reference's Yammer
+    walk produces (BROKER_CPU_UTIL, ALL_TOPIC_*, TOPIC_*, PARTITION_SIZE)."""
+
+    def __init__(self, broker_id: int):
+        self.broker_id = broker_id
+        self._lock = threading.Lock()
+        self._cpu_util = 0.0
+        self._topic_rates: dict[str, tuple[float, float]] = {}
+        self._replication_in = 0.0
+        self._partition_sizes: dict[tuple[str, int], float] = {}
+
+    def set_cpu_util(self, util: float) -> None:
+        with self._lock:
+            self._cpu_util = util
+
+    def set_topic_rate(self, topic: str, bytes_in: float, bytes_out: float) -> None:
+        with self._lock:
+            self._topic_rates[topic] = (bytes_in, bytes_out)
+
+    def set_replication_bytes_in(self, rate: float) -> None:
+        with self._lock:
+            self._replication_in = rate
+
+    def set_partition_size(self, topic: str, partition: int, size: float) -> None:
+        with self._lock:
+            self._partition_sizes[(topic, partition)] = size
+
+    def snapshot(self, time_ms: int) -> list[CruiseControlMetric]:
+        with self._lock:
+            bid = self.broker_id
+            out = [broker_metric(R.BROKER_CPU_UTIL, time_ms, bid, self._cpu_util)]
+            total_in = sum(r[0] for r in self._topic_rates.values())
+            total_out = sum(r[1] for r in self._topic_rates.values())
+            out.append(broker_metric(R.ALL_TOPIC_BYTES_IN, time_ms, bid, total_in))
+            out.append(broker_metric(R.ALL_TOPIC_BYTES_OUT, time_ms, bid, total_out))
+            out.append(broker_metric(R.ALL_TOPIC_REPLICATION_BYTES_IN, time_ms,
+                                     bid, self._replication_in))
+            for topic, (bin_, bout) in sorted(self._topic_rates.items()):
+                out.append(topic_metric(R.TOPIC_BYTES_IN, time_ms, bid, topic, bin_))
+                out.append(topic_metric(R.TOPIC_BYTES_OUT, time_ms, bid, topic, bout))
+            for (topic, part), size in sorted(self._partition_sizes.items()):
+                out.append(partition_metric(R.PARTITION_SIZE, time_ms, bid,
+                                            topic, part, size))
+            return out
+
+
+class MetricsReporterAgent:
+    """The in-broker sampling loop: every ``interval_s`` snapshot the
+    registry, adjust CPU for container limits, serialize, produce."""
+
+    def __init__(self, registry: MetricsRegistryView, transport,
+                 interval_s: float = 120.0,
+                 adjust_cpu_for_container: bool = True,
+                 cgroup_root: str | None = None,
+                 time_fn=time.time):
+        self._registry = registry
+        self._transport = transport
+        self._interval = interval_s
+        self._adjust_cpu = adjust_cpu_for_container
+        self._cgroup_root = cgroup_root
+        self._time = time_fn
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.reports = 0
+
+    def start(self) -> None:
+        """Create the metrics topic if the transport supports it
+        (maybeCreateCruiseControlMetricsTopic), then start the loop."""
+        ensure = getattr(self._transport, "ensure_topic", None)
+        if ensure is not None:
+            try:
+                ensure()
+            except Exception:  # noqa: BLE001 - topic may already exist / races
+                LOG.warning("metrics topic auto-creation failed", exc_info=True)
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="cc-metrics-reporter")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.report_once()
+            except Exception:  # noqa: BLE001 - keep the loop alive
+                LOG.warning("metric report failed", exc_info=True)
+
+    def report_once(self, time_ms: int | None = None) -> int:
+        """One sampling pass (public: tests and deterministic harnesses
+        drive intervals explicitly). Returns records produced."""
+        now_ms = int(self._time() * 1000) if time_ms is None else time_ms
+        records = self._registry.snapshot(now_ms)
+        n = 0
+        for m in records:
+            if self._adjust_cpu and m.raw_type is R.BROKER_CPU_UTIL:
+                kwargs = {} if self._cgroup_root is None \
+                    else {"root": self._cgroup_root}
+                m = broker_metric(R.BROKER_CPU_UTIL, m.time_ms, m.broker_id,
+                                  container_cpu_util(m.value, **kwargs))
+            self._transport.produce(serialize(m))
+            n += 1
+        flush = getattr(self._transport, "flush", None)
+        if flush is not None:
+            flush()
+        self.reports += 1
+        return n
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

@@ -1,0 +1,68 @@
+"""Container awareness: cgroup CPU limits for in-container brokers.
+
+Reference parity: cruise-control-metrics-reporter ContainerMetricUtils
+(adjusts the reported CPU utilization for cgroup CPU quotas so a broker
+limited to 2 of 64 host cores reports util relative to ITS allotment, not
+the host's). Supports cgroup v2 (``cpu.max``) and v1
+(``cpu/cpu.cfs_quota_us`` / ``cpu.cfs_period_us``); the filesystem root is
+injectable for tests.
+"""
+
+from __future__ import annotations
+
+import os
+
+CGROUP_ROOT = "/sys/fs/cgroup"
+
+
+def _read(path: str) -> str | None:
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def cgroup_cpu_cores(root: str = CGROUP_ROOT,
+                     host_cores: int | None = None) -> float:
+    """Effective CPU cores available to this process: the cgroup quota when
+    one is set, else the host core count."""
+    host = float(host_cores if host_cores is not None else os.cpu_count() or 1)
+
+    # cgroup v2: "cpu.max" = "<quota|max> <period>"
+    v2 = _read(os.path.join(root, "cpu.max"))
+    if v2:
+        parts = v2.split()
+        if len(parts) == 2 and parts[0] != "max":
+            try:
+                quota, period = float(parts[0]), float(parts[1])
+                if quota > 0 and period > 0:
+                    return min(host, quota / period)
+            except ValueError:
+                pass
+        return host
+
+    # cgroup v1
+    quota_s = _read(os.path.join(root, "cpu", "cpu.cfs_quota_us"))
+    period_s = _read(os.path.join(root, "cpu", "cpu.cfs_period_us"))
+    if quota_s and period_s:
+        try:
+            quota, period = float(quota_s), float(period_s)
+            if quota > 0 and period > 0:
+                return min(host, quota / period)
+        except ValueError:
+            pass
+    return host
+
+
+def container_cpu_util(host_cpu_util: float, root: str = CGROUP_ROOT,
+                       host_cores: int | None = None) -> float:
+    """Rescale a host-wide CPU utilization fraction to the container's CPU
+    allotment (ContainerMetricUtils.getContainerProcessCpuLoad): with a
+    quota of 2 cores on a 64-core host, 3% host util is ~96% of the
+    container's budget."""
+    host = float(host_cores if host_cores is not None else os.cpu_count() or 1)
+    cores = cgroup_cpu_cores(root, host_cores=int(host))
+    if cores <= 0:
+        return host_cpu_util
+    return min(1.0, host_cpu_util * host / cores)

@@ -26,7 +26,7 @@ def proposal(topic="t", part=0, old=(0, 1), new=(2, 1), old_leader=0, new_leader
                              new_leader=new_leader)
 
 
-def make_cluster(n_parts=8, brokers=(0, 1, 2, 3)):
+def make_cluster(n_parts=8, brokers=(0, 1, 2, 3), steps_per_tick=3):
     parts = [PartitionState(topic="t", partition=i,
                             replicas=(brokers[i % len(brokers)],
                                       brokers[(i + 1) % len(brokers)]),
@@ -34,7 +34,7 @@ def make_cluster(n_parts=8, brokers=(0, 1, 2, 3)):
                             isr=(brokers[i % len(brokers)],
                                  brokers[(i + 1) % len(brokers)]))
              for i in range(n_parts)]
-    return InMemoryAdminBackend(parts, steps_per_tick=3)
+    return InMemoryAdminBackend(parts, steps_per_tick=steps_per_tick)
 
 
 # ---- task state machine ----------------------------------------------------
@@ -213,3 +213,100 @@ def test_sampling_mode_toggled_around_execution():
     ex.execute_proposals([proposal(part=0, old=(0, 1), new=(2, 1), new_leader=2)])
     assert ex.await_completion(20)
     assert flips == [True, False]
+
+
+# ---- external reassignments, adoption, notifier ----------------------------
+
+class RecordingNotifier:
+    def __init__(self):
+        self.finished = []
+        self.stopped = []
+
+    def on_execution_finished(self, summary):
+        self.finished.append(summary)
+
+    def on_execution_stopped(self, summary):
+        self.stopped.append(summary)
+
+
+def test_refuses_external_reassignment_by_default():
+    """ExecutionUtils.ongoingPartitionReassignments sanity: an in-flight
+    reassignment this executor did not start blocks a new execution."""
+    from cruise_control_tpu.executor import OngoingExternalReassignmentError
+
+    admin = make_cluster(steps_per_tick=0)
+    admin._auto_advance = False
+    # External agent starts a reassignment.
+    admin.alter_partition_reassignments({("t", 0): (2, 1)})
+    ex = Executor(admin, synchronous=True)
+    with pytest.raises(OngoingExternalReassignmentError):
+        ex.execute_proposals([proposal(part=1, old=(1, 2), new=(3, 2),
+                                       old_leader=1, new_leader=3)], uuid="x")
+
+
+def test_stop_external_agent_cancels_then_executes():
+    """maybeStopExternalAgent (Executor.java:1261): with the flag, the
+    external reassignment is cancelled and the execution proceeds."""
+    admin = make_cluster(steps_per_tick=0)
+    admin._auto_advance = False
+    admin.alter_partition_reassignments({("t", 0): (2, 1)})
+    admin._steps_per_tick = 1_000_000
+    admin._auto_advance = True
+    ex = Executor(admin, synchronous=True)
+    ex.execute_proposals([proposal(part=1, old=(1, 2), new=(3, 2),
+                                   old_leader=1, new_leader=3)],
+                         uuid="y", stop_external_agent=True)
+    parts = admin.describe_partitions()
+    assert set(parts[("t", 0)].replicas) == {0, 1}  # external move undone
+    assert set(parts[("t", 1)].replicas) == {3, 2}  # our move applied
+
+
+def test_adopts_reassignments_after_restart():
+    """Executor.java:1238 recovery: a fresh executor (simulating a process
+    restart mid-move) observes the in-flight reassignment, reconstructs the
+    task, and tracks it to completion without re-submitting."""
+    admin = make_cluster(steps_per_tick=0)
+    admin._auto_advance = False
+    # Previous executor life submitted this, then the process died.
+    admin.alter_partition_reassignments({("t", 0): (2, 1)})
+    submits_before = admin.reassignment_calls
+
+    notifier = RecordingNotifier()
+    ex = Executor(admin, progress_check_interval_s=0.01, notifier=notifier)
+    adopted = ex.adopt_ongoing_reassignments(uuid="recovery")
+    assert adopted == 1
+    # Cluster makes progress; adopted task completes.
+    admin._steps_per_tick = 1_000_000
+    admin._auto_advance = True
+    assert ex.await_completion(10.0)
+    assert admin.reassignment_calls == submits_before  # nothing re-submitted
+    parts = admin.describe_partitions()
+    assert set(parts[("t", 0)].replicas) == {2, 1}
+    counts = ex.execution_state()["taskCounts"]
+    assert counts["inter_broker_replica_action"]["completed"] == 1
+    assert notifier.finished and notifier.finished[0]["uuid"] == "recovery"
+
+
+def test_adopt_with_nothing_in_flight_is_noop():
+    admin = make_cluster()
+    ex = Executor(admin, synchronous=True)
+    assert ex.adopt_ongoing_reassignments() == 0
+    assert not ex.has_ongoing_execution()
+
+
+def test_notifier_fires_on_finish_and_stop():
+    notifier = RecordingNotifier()
+    admin = make_cluster()
+    ex = Executor(admin, synchronous=True, notifier=notifier)
+    ex.execute_proposals([proposal()], uuid="n1")
+    assert [s["uuid"] for s in notifier.finished] == ["n1"]
+
+    admin2 = make_cluster(steps_per_tick=0)
+    admin2._auto_advance = False
+    notifier2 = RecordingNotifier()
+    ex2 = Executor(admin2, progress_check_interval_s=0.01, notifier=notifier2)
+    ex2.execute_proposals([proposal()], uuid="n2")
+    time.sleep(0.05)
+    ex2.stop_execution()
+    assert ex2.await_completion(10.0)
+    assert notifier2.stopped and notifier2.stopped[0]["uuid"] == "n2"

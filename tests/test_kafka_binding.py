@@ -1,0 +1,85 @@
+"""Kafka binding module: import gating + protocol conformance.
+
+No Kafka client ships in this environment, so these tests pin down the
+contract the binding must satisfy: the package imports cleanly, refuses
+construction with actionable guidance, and implements every method of the
+protocols it claims (AdminBackend / MetricsTransport / SampleStore) — the
+same surface the in-memory fakes already satisfy and the executor/monitor
+suites exercise. With kafka-python installed the constructors run instead
+(skipif on HAVE_KAFKA flips the gating test).
+"""
+
+import inspect
+
+import pytest
+
+from cruise_control_tpu import kafka as kafka_binding
+from cruise_control_tpu.executor.admin import AdminBackend, InMemoryAdminBackend
+from cruise_control_tpu.monitor.sampling.sample_store import (
+    FileSampleStore, NoopSampleStore, SampleStore,
+)
+from cruise_control_tpu.monitor.sampling.sampler import (
+    InMemoryMetricsTransport, MetricsTransport,
+)
+
+
+def _protocol_methods(proto) -> set[str]:
+    return {name for name, m in vars(proto).items()
+            if callable(m) and not name.startswith("_")}
+
+
+@pytest.mark.skipif(kafka_binding.HAVE_KAFKA,
+                    reason="kafka-python installed: constructors work")
+@pytest.mark.parametrize("ctor,args", [
+    (kafka_binding.KafkaAdminBackend, ("localhost:9092",)),
+    (kafka_binding.KafkaMetricsTransport, ("localhost:9092",)),
+    (kafka_binding.KafkaSampleStore, ("localhost:9092",)),
+])
+def test_construction_is_gated_with_guidance(ctor, args):
+    with pytest.raises(kafka_binding.KafkaClientUnavailableError) as err:
+        ctor(*args)
+    assert "kafka-python" in str(err.value)
+
+
+@pytest.mark.parametrize("impl,proto", [
+    (kafka_binding.KafkaAdminBackend, AdminBackend),
+    (InMemoryAdminBackend, AdminBackend),
+    (kafka_binding.KafkaMetricsTransport, MetricsTransport),
+    (InMemoryMetricsTransport, MetricsTransport),
+    (kafka_binding.KafkaSampleStore, SampleStore),
+    (FileSampleStore, SampleStore),
+    (NoopSampleStore, SampleStore),
+])
+def test_implements_full_protocol_surface(impl, proto):
+    missing = _protocol_methods(proto) - {
+        n for n, m in inspect.getmembers(impl, callable)
+        if not n.startswith("_")}
+    assert not missing, f"{impl.__name__} missing {sorted(missing)}"
+
+
+def test_protocol_method_signatures_match_admin():
+    """Positional arity of every AdminBackend method matches between the
+    Kafka binding and the in-memory fake (drift here breaks swapping)."""
+    for name in _protocol_methods(AdminBackend):
+        sig_kafka = inspect.signature(
+            getattr(kafka_binding.KafkaAdminBackend, name))
+        sig_fake = inspect.signature(getattr(InMemoryAdminBackend, name))
+        n_kafka = len([p for p in sig_kafka.parameters.values()
+                       if p.default is inspect.Parameter.empty
+                       and p.kind in (p.POSITIONAL_ONLY,
+                                      p.POSITIONAL_OR_KEYWORD)])
+        n_fake = len([p for p in sig_fake.parameters.values()
+                      if p.default is inspect.Parameter.empty
+                      and p.kind in (p.POSITIONAL_ONLY,
+                                     p.POSITIONAL_OR_KEYWORD)])
+        assert n_kafka == n_fake, name
+
+
+@pytest.mark.skipif(not kafka_binding.HAVE_KAFKA,
+                    reason="needs kafka-python + a live broker")
+def test_live_admin_backend_round_trip():  # pragma: no cover
+    """Executed only where kafka-python and a broker exist: the same
+    executor flow the in-memory suite runs, against localhost."""
+    backend = kafka_binding.KafkaAdminBackend("localhost:9092")
+    assert backend.alive_brokers()
+    backend.close()

@@ -1,0 +1,177 @@
+"""Broker-side reporter agent: registry → agent loop → transport →
+sampler → aggregator → model build (the reference's
+CruiseControlMetricsReporterTest + ContainerMetricUtils coverage)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+from cruise_control_tpu.executor.admin import InMemoryAdminBackend, PartitionState
+from cruise_control_tpu.metricdef.raw_metric_type import RawMetricType as R
+from cruise_control_tpu.model.tensors import broker_load
+from cruise_control_tpu.monitor import LoadMonitor, ModelCompletenessRequirements
+from cruise_control_tpu.monitor.sampling import (
+    CruiseControlMetricsReporterSampler, InMemoryMetricsTransport,
+)
+from cruise_control_tpu.reporter import (
+    BrokerMetricsRegistry, MetricsReporterAgent, cgroup_cpu_cores,
+    container_cpu_util, deserialize,
+)
+
+
+# ---- container awareness ---------------------------------------------------
+
+def _write(root, rel, content):
+    path = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(content)
+
+
+def test_cgroup_v2_quota(tmp_path):
+    _write(str(tmp_path), "cpu.max", "200000 100000\n")
+    assert cgroup_cpu_cores(str(tmp_path), host_cores=64) == 2.0
+    # 3% of a 64-core host = 96% of a 2-core allotment.
+    assert container_cpu_util(0.03, str(tmp_path), host_cores=64) \
+        == pytest.approx(0.96)
+
+
+def test_cgroup_v2_unlimited(tmp_path):
+    _write(str(tmp_path), "cpu.max", "max 100000\n")
+    assert cgroup_cpu_cores(str(tmp_path), host_cores=16) == 16.0
+    assert container_cpu_util(0.5, str(tmp_path), host_cores=16) == 0.5
+
+
+def test_cgroup_v1_quota(tmp_path):
+    _write(str(tmp_path), "cpu/cpu.cfs_quota_us", "400000")
+    _write(str(tmp_path), "cpu/cpu.cfs_period_us", "100000")
+    assert cgroup_cpu_cores(str(tmp_path), host_cores=32) == 4.0
+
+
+def test_cgroup_absent_falls_back_to_host(tmp_path):
+    assert cgroup_cpu_cores(str(tmp_path / "nope"), host_cores=8) == 8.0
+
+
+# ---- registry + agent ------------------------------------------------------
+
+def _registry(broker_id, topics=("t0",), cpu=0.5, bytes_in=100.0):
+    reg = BrokerMetricsRegistry(broker_id)
+    reg.set_cpu_util(cpu)
+    for t in topics:
+        reg.set_topic_rate(t, bytes_in, 2 * bytes_in)
+    reg.set_replication_bytes_in(10.0)
+    return reg
+
+
+def test_agent_reports_registry_snapshot(tmp_path):
+    reg = _registry(7, topics=("a", "b"))
+    reg.set_partition_size("a", 0, 5000.0)
+    transport = InMemoryMetricsTransport()
+    agent = MetricsReporterAgent(reg, transport, interval_s=3600,
+                                 cgroup_root=str(tmp_path / "none"))
+    n = agent.report_once(time_ms=1000)
+    records = [deserialize(b) for b in transport.poll(0, 2000)]
+    assert len(records) == n
+    by_type = {}
+    for m in records:
+        by_type.setdefault(m.raw_type, []).append(m)
+    assert by_type[R.ALL_TOPIC_BYTES_IN][0].value == pytest.approx(200.0)
+    assert len(by_type[R.TOPIC_BYTES_IN]) == 2
+    assert by_type[R.PARTITION_SIZE][0].partition == 0
+
+
+def test_agent_adjusts_cpu_for_container(tmp_path):
+    _write(str(tmp_path), "cpu.max", "100000 100000\n")  # 1 core
+    host = os.cpu_count() or 1
+    reg = _registry(1, cpu=0.5 / host)  # half of one host core
+    transport = InMemoryMetricsTransport()
+    agent = MetricsReporterAgent(reg, transport, cgroup_root=str(tmp_path))
+    agent.report_once(time_ms=1000)
+    cpu = [m for m in map(deserialize, transport.poll(0, 2000))
+           if m.raw_type is R.BROKER_CPU_UTIL]
+    assert cpu[0].value == pytest.approx(0.5)
+
+
+def test_agent_loop_runs_on_interval():
+    reg = _registry(0)
+    transport = InMemoryMetricsTransport()
+    agent = MetricsReporterAgent(reg, transport, interval_s=0.01)
+    agent.start()
+    deadline = time.time() + 5.0
+    while agent.reports < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    agent.stop()
+    assert agent.reports >= 3
+
+
+def test_agent_auto_creates_topic_when_transport_supports_it():
+    class TopicTransport(InMemoryMetricsTransport):
+        def __init__(self):
+            super().__init__()
+            self.created = 0
+
+        def ensure_topic(self):
+            self.created += 1
+
+    transport = TopicTransport()
+    agent = MetricsReporterAgent(_registry(0), transport, interval_s=3600)
+    agent.start()
+    agent.stop()
+    assert transport.created == 1
+
+
+# ---- end to end: agent → transport → sampler → aggregator → model ----------
+
+def test_end_to_end_agent_to_cluster_model(tmp_path):
+    brokers = (0, 1, 2)
+    partitions = {}
+    for t in range(2):
+        topic = f"t{t}"
+        for p in range(3):
+            leader = brokers[(t + p) % 3]
+            reps = (leader, brokers[(t + p + 1) % 3])
+            partitions[(topic, p)] = PartitionState(topic, p, reps, leader,
+                                                    isr=reps)
+
+    # One registry + agent per broker, all feeding one transport.
+    transport = InMemoryMetricsTransport()
+    agents = []
+    for b in brokers:
+        led_topics = {t for (t, _p), st in partitions.items()
+                      if st.leader == b}
+        reg = _registry(b, topics=tuple(sorted(led_topics)))
+        for (topic, p), st in partitions.items():
+            if st.leader == b:
+                reg.set_partition_size(topic, p, 5000.0)
+        agents.append(MetricsReporterAgent(
+            reg, transport, interval_s=3600,
+            cgroup_root=str(tmp_path / "none")))
+
+    backend = InMemoryAdminBackend(partitions.values())
+    cfg = CruiseControlConfig({"partition.metrics.window.ms": 1000,
+                               "num.partition.metrics.windows": 3,
+                               "min.valid.partition.ratio": 0.0})
+    monitor = LoadMonitor(
+        cfg, backend,
+        samplers=[CruiseControlMetricsReporterSampler(transport)])
+    for k in range(1, 4):
+        for agent in agents:
+            agent.report_once(time_ms=k * 1000 - 500)
+        monitor.task_runner.run_sampling_once(end_ms=k * 1000)
+
+    state, meta = monitor.cluster_model(
+        ModelCompletenessRequirements(min_valid_windows=1,
+                                      min_monitored_partitions_percentage=0.5))
+    assert state.num_brokers == 3
+    assert int(state.partition_mask.sum()) == len(partitions)
+    loads = np.asarray(broker_load(state))
+    # Each broker leads one partition per topic (100 B/s topic rate split
+    # across 3 partitions... each leads 2 partitions of different topics):
+    # leader NW_IN 100·(2/3)? — just require uniform positive load.
+    assert (loads[:, int(Resource.NW_IN)] > 0).all()
+    assert np.allclose(loads[:, int(Resource.NW_IN)],
+                       loads[0, int(Resource.NW_IN)], rtol=0.05)
