@@ -298,6 +298,13 @@ def chain_all_violations(state: ClusterTensors, goals: tuple[Goal, ...],
     return jnp.stack(totals)
 
 
+class StatsRegressionError(RuntimeError):
+    """A goal's own objective regressed during its own optimization — the
+    self-check invariant of AbstractGoal.java:111-119 (the reference throws
+    IllegalStateException when a goal's ClusterModelStatsComparator prefers
+    the pre-optimization stats)."""
+
+
 def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
                            index: int, constraint: BalancingConstraint,
                            cfg: SearchConfig, num_topics: int,
@@ -306,6 +313,12 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
     """Run goal ``chain[index]`` to convergence under the acceptance of
     ``chain[:index]``, using the chain-shared kernels (same semantics and
     info dict as ``search.optimize_goal``, one compile for the whole chain).
+
+    Enforces the per-goal stats-regression guard (AbstractGoal.java:111-119):
+    the active goal's objective on exit must not exceed its objective on
+    entry. Skipped when offline replicas exist at entry — self-healing
+    placement takes precedence over the goal's own balance objective
+    (ClusterModel.selfHealingEligibleReplicas semantics).
     """
     masks = masks or ExclusionMasks()
     goals = tuple(chain)
@@ -313,6 +326,8 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
     idx = jnp.int32(index)
     prior = jnp.asarray([j < index for j in range(len(goals))])
 
+    _viol0, obj0, offline0 = chain_goal_stats(state, idx, goals, constraint,
+                                              num_topics, masks)
     total_applied = 0
     total_swaps = 0
     rounds = 0
@@ -334,6 +349,12 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
 
     viol, obj, offline = chain_goal_stats(state, idx, goals, constraint,
                                           num_topics, masks)
+    if int(offline0) == 0:
+        before, after = float(obj0), float(obj)
+        if after > before + 1e-4 * max(1.0, abs(before)):
+            raise StatsRegressionError(
+                f"goal {goal.name} regressed its own objective during its "
+                f"optimization: {before:.6g} -> {after:.6g}")
     total_violation = float(viol)
     succeeded = total_violation <= 1e-6
     if goal.is_hard and not succeeded:
