@@ -252,14 +252,11 @@ def chain_swap_rounds(state: ClusterTensors, active_idx: jax.Array,
         state, max_rounds)
 
 
-@partial(jax.jit, static_argnames=("goals", "constraint", "num_topics"))
-def chain_goal_stats(state: ClusterTensors, active_idx: jax.Array,
-                     goals: tuple[Goal, ...],
-                     constraint: BalancingConstraint, num_topics: int,
-                     masks: ExclusionMasks,
-                     ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """(total_violation, objective, offline_remaining) of the active goal on
-    ``state`` — the post-optimization readback, on device in one call."""
+def _chain_goal_stats_body(state: ClusterTensors, active_idx: jax.Array,
+                           goals: tuple[Goal, ...],
+                           constraint: BalancingConstraint, num_topics: int,
+                           masks: ExclusionMasks,
+                           ) -> tuple[jax.Array, jax.Array, jax.Array]:
     derived = compute_derived(state, masks.excluded_topics,
                               masks.excluded_replica_move_brokers,
                               masks.excluded_leadership_brokers)
@@ -281,6 +278,18 @@ def chain_goal_stats(state: ClusterTensors, active_idx: jax.Array,
 
 
 @partial(jax.jit, static_argnames=("goals", "constraint", "num_topics"))
+def chain_goal_stats(state: ClusterTensors, active_idx: jax.Array,
+                     goals: tuple[Goal, ...],
+                     constraint: BalancingConstraint, num_topics: int,
+                     masks: ExclusionMasks,
+                     ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(total_violation, objective, offline_remaining) of the active goal on
+    ``state`` — the post-optimization readback, on device in one call."""
+    return _chain_goal_stats_body(state, active_idx, goals, constraint,
+                                  num_topics, masks)
+
+
+@partial(jax.jit, static_argnames=("goals", "constraint", "num_topics"))
 def chain_all_violations(state: ClusterTensors, goals: tuple[Goal, ...],
                          constraint: BalancingConstraint, num_topics: int,
                          masks: ExclusionMasks) -> jax.Array:
@@ -296,6 +305,164 @@ def chain_all_violations(state: ClusterTensors, goals: tuple[Goal, ...],
         totals.append(g.broker_violations(state, derived, constraint,
                                           aux).sum().astype(jnp.float32))
     return jnp.stack(totals)
+
+
+@partial(jax.jit, static_argnames=("goals", "constraint", "cfg", "num_topics",
+                                   "swap_moves", "swap_max_rounds"))
+def chain_optimize_full(state: ClusterTensors, goals: tuple[Goal, ...],
+                        constraint: BalancingConstraint, cfg: SearchConfig,
+                        num_topics: int, masks: ExclusionMasks,
+                        swap_moves: int = 8, swap_max_rounds: int = 64):
+    """The ENTIRE goal chain in ONE dispatch: ``lax.scan`` over the goal
+    index runs each goal's fused move/swap drivers under the acceptance of
+    all prior goals, collecting per-goal entry/exit stats on device.
+
+    This is the production solver path. The per-goal kernels above cost
+    ~4-6 host↔device round-trips per goal (stats, move driver, swap driver,
+    stats again) — a fixed ~0.5 s/goal floor over a high-latency device
+    link regardless of scale. Here the host dispatches once and reads back
+    one stacked stats pytree for the whole chain.
+
+    Per-goal fast path: a goal whose violations AND offline-replica count
+    are zero on entry is skipped entirely (``lax.cond``), unless an alive
+    excluded-for-replica-move broker still hosts replicas (the drain
+    story). This matches the search's own fixed point — zero violations
+    means either no broker has ``source_score > 0`` (goals tie sources to
+    violations) or no candidate scores a positive improvement (goals whose
+    improvement is the pairwise violation delta, e.g. preferred-leader) —
+    and mirrors the reference, whose greedy only acts on brokers outside
+    the goal's band (AbstractGoal.java:82-135).
+
+    Returns (final_state, per_goal_stats) where per_goal_stats is a dict of
+    [G]-arrays: viol_before/after, obj_before/after, offline_before,
+    moves, swaps, rounds.
+    """
+    g_count = len(goals)
+    supports_swap = jnp.asarray([g.supports_swap for g in goals])
+
+    def drain_pending(s: ClusterTensors) -> jax.Array:
+        """True while any ALIVE excluded-for-replica-move broker still hosts
+        replicas: the drain story (requireLessLoad includes excluded
+        brokers, ResourceDistributionGoal.java:387) — goals shed replicas
+        off excluded brokers even when their own violations are zero, so
+        the per-goal fast path must stay off."""
+        if masks.excluded_replica_move_brokers is None:
+            return jnp.bool_(False)
+        from ..model.tensors import alive_mask
+        excl_alive = masks.excluded_replica_move_brokers & alive_mask(s)
+        b = s.num_brokers
+        seg = jnp.where(s.assignment >= 0, s.assignment, b)
+        on_excl = jnp.concatenate([excl_alive, jnp.array([False])])[seg]
+        return on_excl.any()
+
+    def per_goal(carry_state, g):
+        prior = jnp.arange(g_count) < g
+        viol0, obj0, offline0 = _chain_goal_stats_body(
+            carry_state, g, goals, constraint, num_topics, masks)
+
+        def run(s):
+            # Interleave the fused move driver with the fused swap driver
+            # until a swap pass applies nothing (the host loop of
+            # optimize_goal_in_chain, on device).
+            def outer_cond(c):
+                _s, _m, _sw, rounds, last_swapped, first = c
+                return (first | (last_swapped > 0)) & (rounds < cfg.max_rounds)
+
+            def outer_body(c):
+                s, m_tot, sw_tot, rounds, _ls, _first = c
+                s, m, r = run_rounds_loop(
+                    lambda st: _chain_round_body(st, g, prior, goals,
+                                                 constraint, cfg, num_topics,
+                                                 masks),
+                    s, cfg.max_rounds)
+
+                def do_swap(st):
+                    return run_rounds_loop(
+                        lambda st2: _chain_swap_body(st2, g, prior, goals,
+                                                     constraint, num_topics,
+                                                     masks, swap_moves),
+                        st, swap_max_rounds)
+
+                def no_swap(st):
+                    return st, jnp.int32(0), jnp.int32(0)
+
+                s, sw, sr = jax.lax.cond(supports_swap[g], do_swap, no_swap, s)
+                return (s, m_tot + m, sw_tot + sw, rounds + r + sr, sw,
+                        jnp.bool_(False))
+
+            s, m, sw, rounds, _, _ = jax.lax.while_loop(
+                outer_cond, outer_body,
+                (s, jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                 jnp.bool_(True)))
+            return s, m, sw, rounds
+
+        def skip(s):
+            return s, jnp.int32(0), jnp.int32(0), jnp.int32(0)
+
+        new_state, moves, swaps, rounds = jax.lax.cond(
+            (viol0 > 0) | (offline0 > 0) | drain_pending(carry_state),
+            run, skip, carry_state)
+        viol1, obj1, offline1 = _chain_goal_stats_body(
+            new_state, g, goals, constraint, num_topics, masks)
+        ys = {"viol_before": viol0, "obj_before": obj0,
+              "offline_before": offline0, "viol_after": viol1,
+              "obj_after": obj1, "offline_after": offline1,
+              "moves": moves, "swaps": swaps, "rounds": rounds}
+        return new_state, ys
+
+    final_state, stats = jax.lax.scan(
+        per_goal, state, jnp.arange(g_count, dtype=jnp.int32))
+    return final_state, stats
+
+
+def optimize_chain(state: ClusterTensors, chain: Sequence[Goal],
+                   constraint: BalancingConstraint, cfg: SearchConfig,
+                   num_topics: int, masks: ExclusionMasks | None = None,
+                   ) -> tuple[ClusterTensors, list[dict]]:
+    """Run the whole goal chain with the single-dispatch fused kernel and
+    return (final_state, [per-goal info dict in chain order]).
+
+    Same semantics, error behavior, and info-dict shape as calling
+    ``optimize_goal_in_chain`` for each goal in order (the stats-regression
+    guard of AbstractGoal.java:111-119 and the hard-goal failure of
+    Goal.java:53-59 are checked per goal, in chain order, from the stacked
+    on-device stats), at a fraction of the host↔device round-trips.
+    """
+    masks = masks or ExclusionMasks()
+    goals = tuple(chain)
+    if not goals:
+        return state, []
+    state, stats = chain_optimize_full(state, goals, constraint, cfg,
+                                       num_topics, masks)
+    stats = {k: jax.device_get(v) for k, v in stats.items()}
+    infos: list[dict] = []
+    for i, goal in enumerate(goals):
+        obj0, obj1 = float(stats["obj_before"][i]), float(stats["obj_after"][i])
+        if int(stats["offline_before"][i]) == 0:
+            if obj1 > obj0 + 1e-4 * max(1.0, abs(obj0)):
+                raise StatsRegressionError(
+                    f"goal {goal.name} regressed its own objective during "
+                    f"its optimization: {obj0:.6g} -> {obj1:.6g}")
+        total_violation = float(stats["viol_after"][i])
+        succeeded = total_violation <= 1e-6
+        rounds = int(stats["rounds"][i])
+        if goal.is_hard and not succeeded:
+            raise OptimizationFailureError(
+                f"hard goal {goal.name} unsatisfied: residual violation "
+                f"{total_violation:.4f} after {rounds} rounds")
+        swaps = int(stats["swaps"][i])
+        infos.append({
+            "goal": goal.name,
+            "rounds": rounds,
+            "moves_applied": int(stats["moves"][i]) + swaps,
+            "swaps_applied": swaps,
+            "residual_violation": total_violation,
+            "succeeded": succeeded,
+            "objective": obj1,
+            "violated_on_entry": float(stats["viol_before"][i]) > 1e-6,
+            "offline_remaining": int(stats["offline_after"][i]),
+        })
+    return state, infos
 
 
 class StatsRegressionError(RuntimeError):
@@ -326,8 +493,8 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
     idx = jnp.int32(index)
     prior = jnp.asarray([j < index for j in range(len(goals))])
 
-    _viol0, obj0, offline0 = chain_goal_stats(state, idx, goals, constraint,
-                                              num_topics, masks)
+    viol0, obj0, offline0 = chain_goal_stats(state, idx, goals, constraint,
+                                             num_topics, masks)
     total_applied = 0
     total_swaps = 0
     rounds = 0
@@ -369,6 +536,7 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
         "residual_violation": total_violation,
         "succeeded": succeeded,
         "objective": float(obj),
+        "violated_on_entry": float(viol0) > 1e-6,
         "offline_remaining": int(offline),
     }
     return state, info

@@ -104,8 +104,14 @@ class ResourceDistributionGoal(Goal):
     def source_score(self, state, derived, constraint, aux):
         r = int(self.resource)
         lower, upper, _cap = self._limits(state, derived, constraint)
-        return donor_widened_shed(derived.broker_load[:, r], lower, upper,
+        shed = donor_widened_shed(derived.broker_load[:, r], lower, upper,
                                   derived)
+        # Low-utilization state is a no-op for balancing (the goal flips to
+        # over-provisioned detection, ResourceDistributionGoal.java:262-277):
+        # no sources, so the search — fused or per-goal — generates no
+        # candidates, consistent with broker_violations returning zeros.
+        return jnp.where(self._low_util(derived, constraint),
+                         jnp.zeros_like(shed), shed)
 
     def dest_score(self, state, derived, constraint, aux):
         r = int(self.resource)

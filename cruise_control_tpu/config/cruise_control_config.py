@@ -147,6 +147,10 @@ def _definition() -> ConfigDef:
              "TPU solver: candidate actions scored per round.")
     d.define("solver.moves.per.round", T.INT, 64, Range.at_least(1), I.MEDIUM,
              "TPU solver: max non-conflicting moves applied per round.")
+    d.define("solver.chain.fused", T.BOOLEAN, True, None, I.MEDIUM,
+             "TPU solver: run the whole goal chain in one device dispatch "
+             "(chain.chain_optimize_full) instead of one dispatch per goal "
+             "phase.")
     d.define("goal.violation.distribution.threshold.multiplier", T.DOUBLE, 1.0,
              Range.at_least(1), I.LOW,
              "Detector-triggered balance-threshold relaxation.")

@@ -25,6 +25,7 @@ JAX_PROCESS_ID.
 from __future__ import annotations
 
 import os
+import warnings
 
 import jax
 import numpy as np
@@ -78,6 +79,21 @@ def initialize(coordinator_address: str | None = None,
     if not explicit and not on_pod:
         return  # single-host run; nothing to join
     if _backend_initialized():
+        if not explicit:
+            # Pod env markers alone are not a request for multi-host init —
+            # single-host TPU VMs carry them too. A library user who touched
+            # JAX first gets a warning and a single-process runtime, not a
+            # crash.
+            # NOT latched as initialized: a later explicit
+            # initialize(coordinator_address=...) must still raise loudly
+            # rather than silently no-op on the idempotency check.
+            warnings.warn(
+                "parallel.distributed.initialize(): XLA backend already "
+                "initialized and no explicit multi-host configuration was "
+                "given — continuing single-process. To join a multi-host "
+                "runtime, call initialize() before any jax computation.",
+                RuntimeWarning, stacklevel=2)
+            return
         raise RuntimeError(
             "parallel.distributed.initialize() called after the XLA backend "
             "was already initialized — call it before any jax computation "

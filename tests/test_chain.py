@@ -11,7 +11,8 @@ import numpy as np
 import pytest
 
 from cruise_control_tpu.analyzer.chain import (
-    chain_goal_stats, chain_optimize_rounds, optimize_goal_in_chain,
+    chain_goal_stats, chain_optimize_rounds, optimize_chain,
+    optimize_goal_in_chain,
 )
 from cruise_control_tpu.analyzer.constraint import BalancingConstraint
 from cruise_control_tpu.analyzer.goals import (
@@ -83,6 +84,57 @@ def test_full_chain_driver_matches_per_goal_outcome():
                                   np.asarray(st_old.assignment))
     np.testing.assert_array_equal(np.asarray(st_new.leader_slot),
                                   np.asarray(st_old.leader_slot))
+
+
+def test_fused_full_chain_matches_per_goal_chain():
+    """chain_optimize_full (one dispatch for the whole chain) must walk the
+    same trajectory as optimize_goal_in_chain called per goal, and report
+    the same per-goal outcome stats."""
+    state, meta = _cluster()
+    constraint = BalancingConstraint()
+    masks = ExclusionMasks()
+    cfg = SearchConfig(num_sources=32, num_dests=8, moves_per_round=32,
+                       max_rounds=60)
+
+    st_seq = state
+    seq_infos = []
+    for i in range(len(CHAIN)):
+        st_seq, info = optimize_goal_in_chain(st_seq, CHAIN, i, constraint,
+                                              cfg, meta.num_topics, masks)
+        seq_infos.append(info)
+
+    st_fused, fused_infos = optimize_chain(state, CHAIN, constraint, cfg,
+                                           meta.num_topics, masks)
+    np.testing.assert_array_equal(np.asarray(st_fused.assignment),
+                                  np.asarray(st_seq.assignment))
+    np.testing.assert_array_equal(np.asarray(st_fused.leader_slot),
+                                  np.asarray(st_seq.leader_slot))
+    for seq, fused in zip(seq_infos, fused_infos):
+        assert fused["goal"] == seq["goal"]
+        assert fused["succeeded"] == seq["succeeded"]
+        assert fused["moves_applied"] == seq["moves_applied"]
+        assert fused["swaps_applied"] == seq["swaps_applied"]
+        assert fused["residual_violation"] == pytest.approx(
+            seq["residual_violation"], rel=1e-5, abs=1e-5)
+
+
+def test_fused_chain_skips_satisfied_goals():
+    """A goal with zero violations and no offline replicas on entry runs
+    zero rounds in the fused kernel (the on-device fast path)."""
+    state, meta = _cluster()
+    constraint = BalancingConstraint()
+    masks = ExclusionMasks()
+    cfg = SearchConfig(num_sources=32, num_dests=8, moves_per_round=32,
+                       max_rounds=60)
+    # Converge once, then re-run on the balanced state: every goal that is
+    # already satisfied must report 0 rounds.
+    st, infos = optimize_chain(state, CHAIN, constraint, cfg,
+                               meta.num_topics, masks)
+    _st2, infos2 = optimize_chain(st, CHAIN, constraint, cfg,
+                                  meta.num_topics, masks)
+    for info in infos2:
+        if info["residual_violation"] == 0.0:
+            assert info["rounds"] == 0, info
 
 
 def test_moves_per_round_caps_deduped_goals():
