@@ -37,8 +37,12 @@ import time
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/cc_tpu_jax_cache")
 
-# (num_brokers, num_partitions) smallest-first; BASELINE.md configs #2/#3.
-STAGES = [(16, 512), (50, 2_000), (100, 10_000), (1_000, 100_000)]
+# (num_brokers, num_partitions, drain) smallest-first; BASELINE.md configs
+# #2/#3/#4 — drain N means N brokers are marked DEAD (RemoveBrokers path:
+# every hosted replica becomes offline and must be re-placed under capacity
+# + rack constraints).
+STAGES = [(16, 512, 0), (50, 2_000, 0), (100, 10_000, 0), (1_000, 100_000, 0),
+          (1_000, 100_000, 50)]
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "840"))
 
@@ -73,15 +77,17 @@ def _alarm(_sig, _frame):
     raise _Watchdog()
 
 
-def _run_stage(jax, num_brokers: int, num_partitions: int, device: str,
-               on_cpu: bool) -> dict:
+def _run_stage(jax, num_brokers: int, num_partitions: int, drain: int,
+               device: str, on_cpu: bool) -> dict:
     from cruise_control_tpu.analyzer.optimizer import (
         GoalOptimizer, goals_by_priority,
     )
+    from cruise_control_tpu.common.broker_state import BrokerState
     from cruise_control_tpu.config.cruise_control_config import (
         CruiseControlConfig,
     )
     from cruise_control_tpu.model.fixtures import Dist, random_cluster
+    from cruise_control_tpu.model.tensors import set_broker_state
 
     # CPU (ambient or fallback) is scored on the same 8-chip parity basis so
     # the vs_baseline ratio means the same thing across devices.
@@ -94,15 +100,26 @@ def _run_stage(jax, num_brokers: int, num_partitions: int, device: str,
         num_partitions=num_partitions, rf=3, num_racks=8,
         dist=Dist.EXPONENTIAL, seed=42, skew_to_first=2.0,
         target_utilization=0.55)
+    if drain:
+        # BASELINE config #4: drain the last N brokers (RemoveBrokers
+        # semantics — mark DEAD, facade.py:308: every hosted replica is
+        # offline and must be re-placed elsewhere).
+        import jax.numpy as jnp
+        state = set_broker_state(
+            state, jnp.arange(num_brokers - drain, num_brokers),
+            BrokerState.DEAD)
     state = jax.device_put(state)
     jax.block_until_ready(state.assignment)
     build_s = time.time() - t0
 
     cfg = CruiseControlConfig()
-    optimizer = GoalOptimizer(cfg)
+    # The solver mesh spans every available chip (single-chip TPU tunnel →
+    # mesh None → single-device fused chain kernel).
+    optimizer = GoalOptimizer(cfg, mesh="auto")
 
-    # Warm-up pass: compiles the chain kernels (three compilations total —
-    # analyzer/chain.py — cached across runs via the persistent cache).
+    # Warm-up pass: compiles the fused whole-chain kernel (ONE compilation
+    # — analyzer/chain.py chain_optimize_full, or its sharded analogue —
+    # cached across runs via the persistent cache).
     t0 = time.time()
     _, warm = optimizer.optimizations(state, meta,
                                       goals=goals_by_priority(cfg))
@@ -114,16 +131,18 @@ def _run_stage(jax, num_brokers: int, num_partitions: int, device: str,
                                         goals=goals_by_priority(cfg))
     steady_s = time.time() - t0
 
+    name = f"rebalance_proposal_wall_clock_{num_brokers}brokers_" \
+        + (f"{num_partitions // 1000}kpartitions"
+           if num_partitions >= 1000 else f"{num_partitions}partitions") \
+        + (f"_drain{drain}" if drain else "")
     return {
-        "metric": f"rebalance_proposal_wall_clock_{num_brokers}brokers_"
-                  + (f"{num_partitions // 1000}kpartitions"
-                     if num_partitions >= 1000 else
-                     f"{num_partitions}partitions"),
+        "metric": name,
         "value": round(steady_s, 3),
         "unit": "s",
         "vs_baseline": round(budget_s / steady_s, 3),
         "extras": {
             "device": device,
+            "solver_devices": optimizer.solver_devices(),
             "model_build_s": round(build_s, 3),
             "warmup_incl_compile_s": round(warm_s, 3),
             "num_proposals": len(result.proposals),
@@ -180,7 +199,7 @@ def _guarded_main(deadline: float) -> int:
 
     stages = STAGES[:2] if os.environ.get("BENCH_SCALE") == "small" else STAGES
     prev_total = 0.0
-    for num_brokers, num_partitions in stages:
+    for num_brokers, num_partitions, drain in stages:
         remaining = deadline - time.time()
         # A stage costs roughly: build + compile (flat, shapes change) +
         # steady (scales). Skip if the remaining budget clearly can't fit
@@ -190,7 +209,7 @@ def _guarded_main(deadline: float) -> int:
         if remaining < 60:
             break
         t0 = time.time()
-        _emit(_run_stage(jax, num_brokers, num_partitions, device,
+        _emit(_run_stage(jax, num_brokers, num_partitions, drain, device,
                          on_cpu=platform is None or platform == "cpu"))
         prev_total = time.time() - t0
     return 0

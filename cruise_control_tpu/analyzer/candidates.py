@@ -164,11 +164,57 @@ def generate_candidates(state: ClusterTensors, derived: DerivedState,
     on_source = (jnp.concatenate([source_score, jnp.array([-1.0])])[seg] > 0.0) & exists
 
     flat_weight = jnp.where(on_source, replica_weight, -jnp.inf).reshape(-1)
-    k_src = min(num_sources, flat_weight.shape[0])
-    top_w, top_idx = jax.lax.top_k(flat_weight, k_src)
+    n_flat = flat_weight.shape[0]
+    k_src = min(num_sources, n_flat)
+
+    # Source rows must be BROKER-DIVERSE: conflict-free selection admits at
+    # most one move per source broker per round (for totals-dependent
+    # goals), so a global top-k by weight — which piles onto the few most
+    # overloaded brokers — caps accepted moves per round at a handful
+    # regardless of k. Mirror the reference's per-broker greedy
+    # (AbstractGoal.rebalanceForBroker iterates brokersToBalance, each
+    # offering its own sorted replicas): half the rows are the globally
+    # heaviest replicas (preserves offline/self-healing priority), half are
+    # the best (and second-best) replica of each of the top source brokers.
+    quarter = min(k_src // 4, b)
+    half = k_src - 2 * quarter            # exact: half + 2*quarter == k_src
+    seg_flat = seg.reshape(-1)
+    idxs = jnp.arange(n_flat, dtype=jnp.int32)
+
+    g_w, g_idx = jax.lax.top_k(flat_weight, half)
+    # Mask the global block's rows out of the per-broker selection so the
+    # broker blocks only ADD diversity (on skewed clusters the globally
+    # heaviest replicas are exactly the top brokers' best replicas, and a
+    # duplicate row wastes its whole k_dst grid slice).
+    in_global = jnp.zeros(n_flat + 1, dtype=bool).at[
+        jnp.where(jnp.isfinite(g_w), g_idx, n_flat)].set(True)[:n_flat]
+    flat_weight_rest = jnp.where(in_global, -jnp.inf, flat_weight)
+
+    def per_broker_best(fw):
+        smax = jax.ops.segment_max(fw, seg_flat, num_segments=b + 1)
+        is_best = jnp.isfinite(fw) & (fw == smax[seg_flat])
+        best = jax.ops.segment_min(jnp.where(is_best, idxs, n_flat),
+                                   seg_flat, num_segments=b + 1)
+        return smax[:b], best[:b]          # [B] weight, [B] flat idx
+
+    w1, best1 = per_broker_best(flat_weight_rest)
+    w2, best2 = per_broker_best(
+        jnp.where(idxs == jnp.concatenate(
+            [best1, jnp.array([n_flat], jnp.int32)])[seg_flat],
+            -jnp.inf, flat_weight_rest))
+    b_score = jnp.where(jnp.isfinite(w1), source_score, -jnp.inf)
+    tb_score, top_brokers = jax.lax.top_k(b_score, quarter)
+    broker_ok = jnp.isfinite(tb_score)
+    rows_b1 = jnp.where(broker_ok, best1[top_brokers], n_flat)
+    ok_b2 = broker_ok & jnp.isfinite(w2[top_brokers])
+    rows_b2 = jnp.where(ok_b2, best2[top_brokers], n_flat)
+
+    top_idx = jnp.concatenate([g_idx, rows_b1, rows_b2])[:k_src]
+    src_valid = jnp.concatenate([jnp.isfinite(g_w), broker_ok, ok_b2])[:k_src]
+    src_valid &= top_idx < n_flat
+    top_idx = jnp.minimum(top_idx, n_flat - 1)
     cand_p = (top_idx // s_dim).astype(jnp.int32)
     cand_s = (top_idx % s_dim).astype(jnp.int32)
-    src_valid = jnp.isfinite(top_w)
 
     layout: list[tuple[int, int]] = []
     parts: list[Candidates] = []

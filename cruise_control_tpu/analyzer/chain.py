@@ -47,13 +47,16 @@ from .search import (
 
 
 def _gated_aux(needed: jax.Array, goal: Goal, state, derived, constraint,
-               num_topics: int):
+               num_topics: int, psum=None):
     """Compute ``goal``'s aux pytree only when ``needed`` (traced bool) —
     zeros otherwise. Keeps the single chain kernel from paying every goal's
-    O(P) aux reductions on every round."""
+    O(P) aux reductions on every round. ``psum`` combines partition-additive
+    aux partials across a mesh (the collective runs in BOTH branches — a
+    ``lax.cond`` whose branches disagree on collectives would deadlock, and
+    psum of the zero pytree is free)."""
 
     def compute(_):
-        return goal_aux(goal, state, derived, constraint, num_topics)
+        return goal_aux(goal, state, derived, constraint, num_topics, psum)
 
     shapes = jax.eval_shape(compute, 0)
     if not jax.tree_util.tree_leaves(shapes):
@@ -62,7 +65,24 @@ def _gated_aux(needed: jax.Array, goal: Goal, state, derived, constraint,
     def zeros(_):
         return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
-    return jax.lax.cond(needed, compute, zeros, 0)
+    if psum is None:
+        return jax.lax.cond(needed, compute, zeros, 0)
+    # Under a mesh the psum must execute unconditionally on every device
+    # (a cond whose branches disagree on collectives would mismatch), but
+    # the O(P) LOCAL partial is still gated: lax.cond around
+    # prepare_partial (collective-free), psum of the (possibly zero)
+    # result outside.
+    partial_aux = goal.prepare_partial(state, num_topics)
+    if partial_aux is not None:
+        def compute_partial(_):
+            return goal.prepare_partial(state, num_topics)
+
+        def zero_partial(_):
+            return jax.tree.map(jnp.zeros_like, partial_aux)
+
+        partial_aux = jax.lax.cond(needed, compute_partial, zero_partial, 0)
+        partial_aux = jax.tree.map(psum, partial_aux)
+    return goal.finalize_aux(partial_aux, state, derived, constraint)
 
 
 def _goal_flags(goals: tuple[Goal, ...]):
@@ -435,6 +455,14 @@ def optimize_chain(state: ClusterTensors, chain: Sequence[Goal],
     state, stats = chain_optimize_full(state, goals, constraint, cfg,
                                        num_topics, masks)
     stats = {k: jax.device_get(v) for k, v in stats.items()}
+    return state, _chain_infos_from_stats(goals, stats)
+
+
+def _chain_infos_from_stats(goals: tuple[Goal, ...], stats: dict,
+                            ) -> list[dict]:
+    """Per-goal info dicts from the stacked on-device chain stats; raises
+    the per-goal errors in chain order (shared by the single-device and
+    sharded whole-chain kernels)."""
     infos: list[dict] = []
     for i, goal in enumerate(goals):
         obj0, obj1 = float(stats["obj_before"][i]), float(stats["obj_after"][i])
@@ -462,7 +490,7 @@ def optimize_chain(state: ClusterTensors, chain: Sequence[Goal],
             "violated_on_entry": float(stats["viol_before"][i]) > 1e-6,
             "offline_remaining": int(stats["offline_after"][i]),
         })
-    return state, infos
+    return infos
 
 
 class StatsRegressionError(RuntimeError):

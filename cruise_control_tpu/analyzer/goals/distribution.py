@@ -90,15 +90,20 @@ class ResourceDistributionGoal(Goal):
 
         imp = pair_improvement(derived.broker_load[:, r], deltas,
                                deltas.load_delta[:, r], viol)
-        # Tiebreak: pull the pair toward the average even inside the band
-        # (variance reduction), weighted small so band fixes dominate.
+        # Tiebreak among BAND-FIXING moves only: prefer the one narrowing
+        # the pair gap most. Never applied to imp <= 0 candidates — an
+        # unconditional variance term accepts unbounded in-band refinement
+        # churn (O(P) moves the reference never makes: its greedy only
+        # acts on brokers outside the band, ResourceDistributionGoal
+        # .java:380-435).
         load = derived.broker_load[:, r]
         d = deltas.load_delta[:, r]
         src, dst = deltas.src_broker, deltas.dst_broker
         gap_before = load[src] - load[dst]
         gap_after = gap_before - 2 * d
         var_gain = (gap_before ** 2 - gap_after ** 2) * 1e-6
-        return jnp.where(deltas.valid, imp + var_gain, -jnp.inf) \
+        return jnp.where(deltas.valid,
+                         imp + jnp.where(imp > 0, var_gain, 0.0), -jnp.inf) \
             * new_broker_gate(derived, deltas)
 
     def source_score(self, state, derived, constraint, aux):
@@ -196,8 +201,11 @@ class CountDistributionGoal(Goal):
         counts = self._counts(derived)
         d = self._delta(deltas)
         gap_before = counts[deltas.src_broker] - counts[deltas.dst_broker]
+        # Band-fixing tiebreak only (see ResourceDistributionGoal): an
+        # unconditional variance term would accept O(P) in-band churn.
         var_gain = (gap_before ** 2 - (gap_before - 2 * d) ** 2) * 1e-6
-        return jnp.where(deltas.valid, imp + var_gain, -jnp.inf) \
+        return jnp.where(deltas.valid,
+                         imp + jnp.where(imp > 0, var_gain, 0.0), -jnp.inf) \
             * new_broker_gate(derived, deltas)
 
     def source_score(self, state, derived, constraint, aux):
@@ -279,8 +287,11 @@ class TopicReplicaDistributionGoal(Goal):
         dst_cnt = aux["counts"][t, deltas.dst_broker]
         before = _band_viol(src_cnt, lo, up) + _band_viol(dst_cnt, lo, up)
         after = _band_viol(src_cnt - d, lo, up) + _band_viol(dst_cnt + d, lo, up)
+        imp = before - after
+        # Band-fixing tiebreak only (see ResourceDistributionGoal).
         var_gain = ((src_cnt - dst_cnt) ** 2 - (src_cnt - dst_cnt - 2 * d) ** 2) * 1e-6
-        return jnp.where(deltas.valid, before - after + var_gain, -jnp.inf) \
+        return jnp.where(deltas.valid,
+                         imp + jnp.where(imp > 0, var_gain, 0.0), -jnp.inf) \
             * new_broker_gate(derived, deltas)
 
     def _over_donor(self, derived, aux):

@@ -19,7 +19,12 @@ from ..model.tensors import ClusterMeta, ClusterTensors
 @dataclasses.dataclass(frozen=True)
 class ExecutionProposal:
     """One partition's reassignment (ExecutionProposal.java:309LoC):
-    broker ids (not indices), new replica order leader-first."""
+    broker ids (not indices), new replica order leader-first.
+
+    A proposal may additionally (or only) carry an intra-broker JBOD leg:
+    the replica on ``logdir_broker`` moves ``source_logdir`` →
+    ``destination_logdir`` (ReplicaPlacementInfo logdir semantics; executed
+    via alterReplicaLogDirs, Executor.java:1672)."""
 
     topic: str
     partition: int
@@ -27,11 +32,18 @@ class ExecutionProposal:
     old_replicas: tuple[int, ...]
     new_replicas: tuple[int, ...]
     new_leader: int
+    logdir_broker: int = -1
+    source_logdir: str | None = None
+    destination_logdir: str | None = None
 
     @property
     def is_leadership_only(self) -> bool:
         return set(self.old_replicas) == set(self.new_replicas) \
             and self.old_leader != self.new_leader
+
+    @property
+    def has_logdir_move(self) -> bool:
+        return self.logdir_broker >= 0 and self.destination_logdir is not None
 
     @property
     def replicas_to_add(self) -> tuple[int, ...]:

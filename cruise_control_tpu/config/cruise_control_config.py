@@ -147,6 +147,12 @@ def _definition() -> ConfigDef:
              "TPU solver: candidate actions scored per round.")
     d.define("solver.moves.per.round", T.INT, 64, Range.at_least(1), I.MEDIUM,
              "TPU solver: max non-conflicting moves applied per round.")
+    d.define("concurrency.adjuster.enabled", T.BOOLEAN, True, None, I.MEDIUM,
+             "Re-tune execution concurrency caps each interval from broker "
+             "health and (At/Under)MinISR state (Executor.java:465-683).")
+    d.define("concurrency.adjuster.interval.ms", T.LONG, 1_000,
+             Range.at_least(1), I.LOW,
+             "ConcurrencyAdjuster evaluation interval.")
     d.define("solver.chain.fused", T.BOOLEAN, True, None, I.MEDIUM,
              "TPU solver: run the whole goal chain in one device dispatch "
              "(chain.chain_optimize_full) instead of one dispatch per goal "

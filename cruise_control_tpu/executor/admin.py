@@ -68,12 +68,15 @@ class InMemoryAdminBackend:
 
     def __init__(self, partitions: Iterable[PartitionState],
                  steps_per_tick: int = 1_000_000,
-                 auto_advance: bool = True):
+                 auto_advance: bool = True,
+                 dir_moves_per_tick: int = 1_000_000):
         self._lock = threading.RLock()
         self._parts: dict[tuple[str, int], PartitionState] = {
             (p.topic, p.partition): p for p in partitions}
         self._alive: set[int] = {b for p in self._parts.values() for b in p.replicas}
         self._steps_per_tick = steps_per_tick
+        self._dir_moves_per_tick = dir_moves_per_tick
+        self._pending_dir_moves: dict[tuple[str, int, int], str] = {}
         # auto_advance: progress the simulated cluster whenever the executor
         # polls it, so tests don't need a separate ticking thread.
         self._auto_advance = auto_advance
@@ -94,6 +97,18 @@ class InMemoryAdminBackend:
     def tick(self) -> None:
         """Advance the simulated cluster one progress interval."""
         with self._lock:
+            # In-flight logdir moves complete dir_moves_per_tick at a time
+            # (brokers copy data between dirs; not instantaneous). Moves on
+            # dead brokers stall.
+            dir_budget = self._dir_moves_per_tick
+            for key in sorted(self._pending_dir_moves):
+                if dir_budget <= 0:
+                    break
+                _t, _p, broker = key
+                if broker not in self._alive:
+                    continue
+                self._replica_dirs[key] = self._pending_dir_moves.pop(key)
+                dir_budget -= 1
             budget = self._steps_per_tick
             for key in sorted(self._parts):
                 if budget <= 0:
@@ -222,17 +237,37 @@ class InMemoryAdminBackend:
                 return {}
             return {b: dict(d) for b, d in self._logdirs.items()}
 
-    def replica_logdirs(self) -> dict[tuple[str, int, int], str]:
+    def replica_logdirs(self, brokers: Iterable[int] | None = None,
+                        ) -> dict[tuple[str, int, int], str]:
+        if self._auto_advance:
+            self.tick()
         with self._lock:
-            return dict(getattr(self, "_replica_dirs", {}))
+            dirs = dict(getattr(self, "_replica_dirs", {}))
+        if brokers is not None:
+            wanted = set(brokers)
+            dirs = {k: v for k, v in dirs.items() if k[2] in wanted}
+        return dirs
 
     def alter_replica_logdirs(self, moves: Sequence[tuple[tuple[str, int], int, str]],
-                              ) -> None:
-        """(topic-partition, broker, destination dir) — immediate apply
-        (the real AdminClient's alterReplicaLogDirs)."""
+                              ) -> list[tuple[str, int, int]]:
+        """(topic-partition, broker, destination dir) — queued; ``tick()``
+        completes up to ``dir_moves_per_tick`` of them (the real
+        alterReplicaLogDirs returns immediately and the broker copies data
+        in the background; completion is observed via DescribeLogDirs).
+        Returns the keys rejected outright (destination dir unknown/dead —
+        the per-partition error codes of the real API)."""
+        failed: list[tuple[str, int, int]] = []
         with self._lock:
+            if not hasattr(self, "_replica_dirs"):
+                self._replica_dirs = {}
             for (topic, part), broker, dst in moves:
-                self._replica_dirs[(topic, part, broker)] = dst
+                known = getattr(self, "_logdirs", {}).get(broker)
+                if known is not None and not known.get(dst, False):
+                    failed.append((topic, part, broker))
+                    continue
+                if self._replica_dirs.get((topic, part, broker)) != dst:
+                    self._pending_dir_moves[(topic, part, broker)] = dst
+        return failed
 
     # ---- ClusterInfo protocol for strategies ------------------------------
     def partition_size(self, topic: str, partition: int) -> float:
@@ -244,4 +279,10 @@ class InMemoryAdminBackend:
             return len(p.isr) < len(p.replicas)
 
     def is_under_min_isr_with_offline(self, topic: str, partition: int) -> bool:
-        return False
+        with self._lock:
+            p = self._parts[(topic, partition)]
+            raw = self.topic_configs.get(topic, {}).get(
+                "min.insync.replicas", "1")
+            live = [b for b in p.isr if b in self._alive]
+            return len(live) < int(raw) \
+                and any(b not in self._alive for b in p.replicas)

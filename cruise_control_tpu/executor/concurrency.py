@@ -68,6 +68,12 @@ class ExecutionConcurrencyManager:
     def intra_broker_per_broker_cap(self) -> int:
         return self._caps.intra_broker_per_broker
 
+    def cluster_intra_broker_headroom(self, in_flight: int) -> int:
+        """Cluster-wide intra-broker batch bound: the reference caps total
+        in-flight movements by max.num.cluster.movements across phases
+        (Executor.java:1672 batch sizing); we reuse the cluster cap."""
+        return max(0, self._caps.cluster_inter_broker - in_flight)
+
     # ---- in-flight accounting --------------------------------------------
     def acquire_inter_broker(self, brokers: tuple[int, ...]) -> None:
         with self._lock:
@@ -93,8 +99,11 @@ class ExecutionConcurrencyManager:
             elif cluster_healthy:
                 cap = min(self._base.inter_broker_per_broker
                           * self.MAX_INTER_BROKER_MULTIPLIER, cap + 1)
-            else:
-                cap = max(self.MIN_INTER_BROKER, cap - 1)
+            # Unhealthy WITHOUT min-ISR pressure (e.g. offline replicas
+            # mid-drain — the very workload self-healing is executing) HOLDS
+            # the cap: decrementing here would decay recovery throughput to
+            # the minimum for the whole execution, since health only returns
+            # once recovery finishes.
             self._caps.inter_broker_per_broker = cap
 
             lcap = self._caps.leadership_cluster

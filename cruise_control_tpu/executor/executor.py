@@ -20,6 +20,7 @@ from typing import Callable, Iterable, Sequence
 from ..analyzer.proposals import ExecutionProposal
 from .admin import AdminBackend
 from .concurrency import ConcurrencyCaps, ExecutionConcurrencyManager
+from .min_isr import TopicMinIsrCache, cluster_isr_state
 from .notifier import ExecutorNotifier, LoggingExecutorNotifier
 from .planner import ExecutionTaskPlanner
 from .strategy import ReplicaMovementStrategy
@@ -62,9 +63,18 @@ class Executor:
                  task_timeout_s: float = 3600.0,
                  on_sampling_mode_change: Callable[[bool], None] | None = None,
                  synchronous: bool = False,
-                 notifier: ExecutorNotifier | None = None):
+                 notifier: ExecutorNotifier | None = None,
+                 adjuster_enabled: bool = True,
+                 adjuster_interval_s: float = 1.0):
         self._admin = admin
         self._concurrency = ExecutionConcurrencyManager(caps)
+        # ConcurrencyAdjuster (Executor.java:465-683): every interval the
+        # poll loop re-evaluates broker health and (At/Under)MinISR state
+        # from live metadata and re-tunes the caps.
+        self._adjuster_enabled = adjuster_enabled
+        self._adjuster_interval_s = adjuster_interval_s
+        self._min_isr_cache = TopicMinIsrCache()
+        self._last_adjust = 0.0
         self._strategy = strategy
         self._interval = progress_check_interval_s
         self._task_timeout_s = task_timeout_s
@@ -348,6 +358,21 @@ class Executor:
             time.sleep(self._interval)
             self._poll_inter_broker(in_flight)
 
+    def _maybe_adjust_concurrency(self, parts, alive: set[int]) -> None:
+        """One ConcurrencyAdjuster tick from the metadata snapshot the poll
+        already fetched: under-min-ISR pressure halves caps, healthy state
+        steps them back up (Executor.java:465-683, TopicMinIsrCache)."""
+        if not self._adjuster_enabled:
+            return
+        now = time.time()
+        if now - self._last_adjust < self._adjuster_interval_s:
+            return
+        self._last_adjust = now
+        min_isr = self._min_isr_cache.min_isr_by_topic(
+            self._admin, {p.topic for p in parts.values()})
+        healthy, under = cluster_isr_state(parts, alive, min_isr)
+        self._concurrency.adjust(healthy, under)
+
     def _poll_inter_broker(self, in_flight: list[ExecutionTask]) -> None:
         """waitForInterBrokerReplicaTasksToFinish: poll reassignment state,
         complete finished tasks, kill tasks stuck on dead destinations
@@ -356,6 +381,7 @@ class Executor:
         tracker = self._task_manager.tracker
         parts = self._admin.describe_partitions()
         alive = self._admin.alive_brokers()
+        self._maybe_adjust_concurrency(parts, alive)
         now = time.time()
         still: list[ExecutionTask] = []
         for task in in_flight:
@@ -379,22 +405,100 @@ class Executor:
         in_flight[:] = still
 
     def _intra_broker_move_phase(self) -> bool:
-        """Executor.intraBrokerMoveReplicas:1672 (logdir moves). The tensor
-        model does not yet carry logdirs, so the phase is a structural no-op
-        that drains any queued intra-broker tasks."""
+        """Executor.intraBrokerMoveReplicas:1672: submit alterReplicaLogDirs
+        batches under the per-broker intra-broker cap, poll replica logdir
+        placement for completion, DEAD-mark moves whose broker died or that
+        timed out. Backends without a JBOD surface fail queued intra tasks
+        instead of silently completing them."""
         assert self._planner is not None and self._task_manager is not None
         self._set_phase(ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS)
         tracker = self._task_manager.tracker
-        while True:
-            batch = self._planner.intra_broker_tasks(
-                max_total=1 << 30,
-                per_broker_cap=self._concurrency.intra_broker_per_broker_cap())
-            if not batch:
-                break
-            for task in batch:
+
+        alter = getattr(self._admin, "alter_replica_logdirs", None)
+        lookup = getattr(self._admin, "replica_logdirs", None)
+        if alter is None or lookup is None:
+            # Not JBOD-capable: every queued logdir move is DEAD on arrival
+            # (the reference would get an ApiException per task).
+            for task in self._planner.intra_broker_tasks(max_total=1 << 30):
                 tracker.transition(task, task.in_progress)
+                tracker.transition(task, task.kill)
+            return not self._stop_requested.is_set()
+
+        in_flight: list[ExecutionTask] = []
+        while True:
+            if self._stop_requested.is_set():
+                # Pending tasks abort; in-flight logdir copies cannot be
+                # cancelled through the admin API — mark them aborted and
+                # let the broker finish or fail them.
+                dropped = self._planner.intra_broker_tasks(max_total=1 << 30)
+                for task in dropped + in_flight:
+                    if task.state is TaskState.PENDING:
+                        tracker.transition(task, task.in_progress)
+                    tracker.transition(task, task.abort)
+                    tracker.transition(task, task.aborted)
+                in_flight.clear()
+                return False
+
+            inflight_per_broker: dict[int, int] = {}
+            for t in in_flight:
+                b = t.proposal.logdir_broker
+                inflight_per_broker[b] = inflight_per_broker.get(b, 0) + 1
+            batch = self._planner.intra_broker_tasks(
+                max_total=self._concurrency.cluster_intra_broker_headroom(
+                    len(in_flight)),
+                per_broker_cap=self._concurrency.intra_broker_per_broker_cap(),
+                in_flight_per_broker=inflight_per_broker)
+            if batch:
+                rejected = set(alter(
+                    [(t.topic_partition, t.proposal.logdir_broker,
+                      t.proposal.destination_logdir) for t in batch]) or ())
+                for task in batch:
+                    tracker.transition(task, task.in_progress)
+                    p = task.proposal
+                    if (p.topic, p.partition, p.logdir_broker) in rejected:
+                        # Broker refused the move (bad/dead destination dir):
+                        # DEAD immediately, don't poll a move that will
+                        # never happen.
+                        tracker.transition(task, task.kill)
+                    else:
+                        in_flight.append(task)
+
+            if not in_flight and self._planner.num_pending(
+                    TaskType.INTRA_BROKER_REPLICA_ACTION) == 0:
+                return True
+
+            time.sleep(self._interval)
+            self._poll_intra_broker(in_flight, lookup)
+
+    def _poll_intra_broker(self, in_flight: list[ExecutionTask],
+                           lookup) -> None:
+        """Completion = the replica's current logdir equals the destination
+        (DescribeLogDirs polling, ExecutorAdminUtils semantics); DEAD when
+        the broker died or the task timed out."""
+        assert self._task_manager is not None
+        tracker = self._task_manager.tracker
+        # Restrict the DescribeLogDirs fan-out to brokers with in-flight
+        # moves (ExecutorAdminUtils.getLogdirInfoForExecutingReplicaMove).
+        try:
+            dirs = lookup(sorted({t.proposal.logdir_broker
+                                  for t in in_flight}))
+        except TypeError:
+            dirs = lookup()
+        alive = self._admin.alive_brokers()
+        now = time.time()
+        still: list[ExecutionTask] = []
+        for task in in_flight:
+            p = task.proposal
+            key = (p.topic, p.partition, p.logdir_broker)
+            if dirs.get(key) == p.destination_logdir:
                 tracker.transition(task, task.completed)
-        return not self._stop_requested.is_set()
+            elif p.logdir_broker not in alive or \
+                    (task.start_time_ms > 0
+                     and now - task.start_time_ms / 1000 > self._task_timeout_s):
+                tracker.transition(task, task.kill)
+            else:
+                still.append(task)
+        in_flight[:] = still
 
     def _leadership_phase(self) -> bool:
         """Executor.moveLeaderships:1732 → electLeaders batches."""

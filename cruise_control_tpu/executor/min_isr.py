@@ -1,0 +1,61 @@
+"""Topic min.insync.replicas cache + under-min-ISR evaluation.
+
+Reference parity: common/TopicMinIsrCache.java — the ConcurrencyAdjuster
+(Executor.java:465-683) consults cached topic ``min.insync.replicas``
+values against live ISR sizes to decide whether to throttle execution.
+Config describes are rate-limited by a TTL so the poll loop does not spam
+describeTopicConfigs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterable, Mapping
+
+from .admin import PartitionState
+
+DEFAULT_MIN_ISR = 1
+
+
+class TopicMinIsrCache:
+    def __init__(self, ttl_s: float = 30.0):
+        self._ttl_s = ttl_s
+        self._cache: dict[str, tuple[float, int]] = {}
+
+    def min_isr_by_topic(self, admin, topics: Iterable[str]) -> dict[str, int]:
+        now = time.time()
+        missing = [t for t in topics
+                   if t not in self._cache
+                   or now - self._cache[t][0] > self._ttl_s]
+        if missing:
+            try:
+                configs = admin.describe_topic_configs(missing)
+            except Exception:  # noqa: BLE001 — degrade to defaults
+                configs = {}
+            for t in missing:
+                raw = (configs.get(t) or {}).get("min.insync.replicas")
+                try:
+                    value = int(raw) if raw is not None else DEFAULT_MIN_ISR
+                except (TypeError, ValueError):
+                    value = DEFAULT_MIN_ISR
+                self._cache[t] = (now, value)
+        return {t: self._cache[t][1] for t in topics if t in self._cache}
+
+
+def cluster_isr_state(parts: Mapping[tuple[str, int], PartitionState],
+                      alive: set[int],
+                      min_isr: Mapping[str, int]) -> tuple[bool, bool]:
+    """(cluster_healthy, has_under_min_isr) from a metadata snapshot:
+    healthy = every replica sits on an alive broker (no offline replicas);
+    under-min-ISR = some partition's live ISR is below its topic's
+    min.insync.replicas (ExecutionUtils.isClusterConcurrencyDecreaseNeeded)."""
+    healthy = True
+    under = False
+    for p in parts.values():
+        if any(b not in alive for b in p.replicas):
+            healthy = False
+        live_isr = sum(1 for b in p.isr if b in alive)
+        if live_isr < min_isr.get(p.topic, DEFAULT_MIN_ISR):
+            under = True
+            healthy = False
+    return healthy, under

@@ -75,20 +75,27 @@ class ExecutionTaskPlanner:
                                     lambda t: (t.proposal.new_leader,))
 
     def intra_broker_tasks(self, max_total: int,
-                           per_broker_cap: int | None = None) -> list[ExecutionTask]:
+                           per_broker_cap: int | None = None,
+                           in_flight_per_broker: dict[int, int] | None = None,
+                           ) -> list[ExecutionTask]:
         """Dequeue intra-broker (logdir) moves, capped per affected broker
-        (num.concurrent.intra.broker.partition.movements)."""
+        (num.concurrent.intra.broker.partition.movements). The caller's
+        in-flight counts seed the per-broker usage so the cap holds ACROSS
+        poll intervals, not just within one batch."""
         return self._capped_dequeue(TaskType.INTRA_BROKER_REPLICA_ACTION,
                                     max_total, per_broker_cap,
-                                    lambda t: tuple(t.proposal.new_replicas))
+                                    lambda t: (t.proposal.logdir_broker,),
+                                    in_flight_per_broker)
 
     def _capped_dequeue(self, task_type: TaskType, max_total: int,
                         per_broker_cap: int | None,
-                        brokers_of) -> list[ExecutionTask]:
+                        brokers_of,
+                        initial_used: dict[int, int] | None = None,
+                        ) -> list[ExecutionTask]:
         with self._lock:
             picked: list[ExecutionTask] = []
             remaining: list[ExecutionTask] = []
-            used: dict[int, int] = {}
+            used: dict[int, int] = dict(initial_used or {})
             for task in self._pending[task_type]:
                 brokers = brokers_of(task)
                 fits = len(picked) < max_total and (

@@ -181,6 +181,9 @@ class ExecutionTaskManager:
             if tuple(p.old_replicas) != tuple(p.new_replicas):
                 tasks.append(ExecutionTask(next(self._id_gen), p,
                                            TaskType.INTER_BROKER_REPLICA_ACTION))
+            if p.has_logdir_move:
+                tasks.append(ExecutionTask(next(self._id_gen), p,
+                                           TaskType.INTRA_BROKER_REPLICA_ACTION))
             if p.new_leader != p.old_leader and p.new_leader >= 0:
                 tasks.append(ExecutionTask(next(self._id_gen), p,
                                            TaskType.LEADER_ACTION))

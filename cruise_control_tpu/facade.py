@@ -98,7 +98,10 @@ class CruiseControl:
                     "num.concurrent.leader.movements"),
             ),
             replication_throttle=config.get("default.replication.throttle"),
-            on_sampling_mode_change=self._on_execution_sampling_change)
+            on_sampling_mode_change=self._on_execution_sampling_change,
+            adjuster_enabled=config.get_boolean("concurrency.adjuster.enabled"),
+            adjuster_interval_s=config.get_long(
+                "concurrency.adjuster.interval.ms") / 1000.0)
         self._optimizer = GoalOptimizer(config)
         self._notifier = notifier or SelfHealingNotifier(config)
         self._anomaly_detector = AnomalyDetectorManager(
@@ -428,13 +431,22 @@ class CruiseControl:
 
     def _intra_broker_result(self, operation, state, meta, disks0, disks1,
                              disk_meta, dryrun, reason) -> OperationResult:
+        from .analyzer.proposals import ExecutionProposal
         from .model.disks import diff_intra_broker_moves
         moves = diff_intra_broker_moves(disks0, disks1, state, meta, disk_meta)
         executed = False
         if moves and not dryrun:
-            self._admin.alter_replica_logdirs(
-                [((m.topic, m.partition), m.broker_id, m.destination_logdir)
-                 for m in moves])
+            # Submit through the Executor (intra-broker phase: per-broker
+            # caps, completion polling, dead-task handling — Executor.java
+            # :1672), NOT by calling the admin directly.
+            proposals = [ExecutionProposal(
+                topic=m.topic, partition=m.partition, old_leader=-1,
+                old_replicas=(), new_replicas=(), new_leader=-1,
+                logdir_broker=m.broker_id, source_logdir=m.source_logdir,
+                destination_logdir=m.destination_logdir) for m in moves]
+            OPERATION_LOG.info("%s executing %d intra-broker moves "
+                               "(reason: %s)", operation, len(moves), reason)
+            self._executor.execute_proposals(proposals, uuid=operation)
             executed = True
         return OperationResult(
             operation, dryrun, executed=executed, reason=reason,

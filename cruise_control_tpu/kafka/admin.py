@@ -145,12 +145,138 @@ class KafkaAdminBackend:
         return self._describe(ConfigResourceType.TOPIC, list(topics))
 
     # ---- log dirs (JBOD) -------------------------------------------------
-    def describe_logdirs(self) -> dict[int, dict[str, bool]]:
-        resp = self._admin.describe_log_dirs()
-        out: dict[int, dict[str, bool]] = {}
-        for broker_id, dirs in getattr(resp, "items", lambda: [])():
-            out[broker_id] = {d.log_dir: d.error_code == 0 for d in dirs}
+    def _await_each(self, futures: dict[int, object]) -> dict[int, object]:
+        """Wait for every future individually; failed/timed-out brokers are
+        skipped instead of aborting the batch (KafkaAdminClient's
+        _wait_for_futures raises on the FIRST failure, which would kill the
+        executor's poll thread because one broker was unreachable)."""
+        out: dict[int, object] = {}
+        for broker, f in futures.items():
+            try:
+                self._admin._wait_for_futures([f])
+            except Exception:  # noqa: BLE001 — per-broker degradation
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "logdir request to broker %s failed", broker,
+                    exc_info=True)
+                continue
+            if f.succeeded():
+                out[broker] = f.value
         return out
+
+    def _logdir_responses(self, brokers: Iterable[int] | None = None,
+                          ) -> dict[int, object]:
+        """One DescribeLogDirs response PER BROKER (KafkaAdminClient's
+        describe_log_dirs() only asks the least-loaded node; logdir state is
+        broker-local). ``brokers`` restricts the fan-out — the executor
+        passes only the brokers with in-flight moves, matching
+        ExecutorAdminUtils.getLogdirInfoForExecutingReplicaMove."""
+        targets = set(brokers) if brokers is not None else self.alive_brokers()
+        from kafka.protocol.admin import DescribeLogDirsRequest_v0
+
+        futures = {b: self._admin._send_request_to_node(
+            b, DescribeLogDirsRequest_v0()) for b in targets}
+        return self._await_each(futures)
+
+    def describe_logdirs(self) -> dict[int, dict[str, bool]]:
+        """broker -> {log_dir: healthy} (DiskFailureDetector's view)."""
+        out: dict[int, dict[str, bool]] = {}
+        for broker, resp in self._logdir_responses().items():
+            dirs: dict[str, bool] = {}
+            for entry in resp.log_dirs:
+                error_code, log_dir = entry[0], entry[1]
+                dirs[log_dir] = error_code == 0
+            out[broker] = dirs
+        return out
+
+    def replica_logdirs(self, brokers: Iterable[int] | None = None,
+                        ) -> dict[tuple[str, int, int], str]:
+        """(topic, partition, broker) -> current log dir. Future (in-flight
+        move) entries are skipped so completion polling sees the move only
+        once the broker promoted the future replica."""
+        out: dict[tuple[str, int, int], str] = {}
+        for broker, resp in self._logdir_responses(brokers).items():
+            for entry in resp.log_dirs:
+                log_dir, topics = entry[1], entry[2]
+                for name, partitions in topics:
+                    for p in partitions:
+                        idx, is_future = p[0], bool(p[3]) if len(p) > 3 else False
+                        if not is_future:
+                            out[(name, idx, broker)] = log_dir
+        return out
+
+    def alter_replica_logdirs(
+            self, moves) -> list[tuple[str, int, int]]:
+        """((topic, partition), broker, destination_dir) batch →
+        AlterReplicaLogDirs (API key 34) sent to each affected broker
+        (ExecutorAdminUtils.executeIntraBrokerReplicaMovements). Returns the
+        (topic, partition, broker) keys the brokers REJECTED (per-partition
+        error codes, e.g. LOG_DIR_NOT_FOUND/KAFKA_STORAGE_ERROR) so the
+        executor can DEAD-mark them immediately instead of polling a move
+        that will never happen."""
+        by_broker: dict[int, dict[str, dict[str, list[int]]]] = {}
+        for (topic, part), broker, dst in moves:
+            by_broker.setdefault(broker, {}).setdefault(dst, {}) \
+                .setdefault(topic, []).append(part)
+        req_cls = _alter_replica_logdirs_request()
+        futures = {}
+        for broker, by_dir in by_broker.items():
+            dirs = [(path, [(topic, parts) for topic, parts in topics.items()])
+                    for path, topics in by_dir.items()]
+            futures[broker] = self._admin._send_request_to_node(
+                broker, req_cls(dirs=dirs))
+        responses = self._await_each(futures)
+        failed: list[tuple[str, int, int]] = []
+        for broker in by_broker:
+            resp = responses.get(broker)
+            if resp is None:
+                # Entire broker request failed: every move on it is failed.
+                failed.extend((t, p, broker)
+                              for by_dir in [by_broker[broker]]
+                              for topics in by_dir.values()
+                              for t, parts in topics.items() for p in parts)
+                continue
+            for name, partitions in resp.responses:
+                for idx, error_code in partitions:
+                    if error_code != 0:
+                        failed.append((name, idx, broker))
+        return failed
 
     def close(self) -> None:
         self._admin.close()
+
+
+def _alter_replica_logdirs_request():
+    """kafka-python ships DescribeLogDirs but (in some versions) not
+    AlterReplicaLogDirs — define the v0 wire schema locally when absent."""
+    try:
+        from kafka.protocol.admin import AlterReplicaLogDirsRequest_v0
+        return AlterReplicaLogDirsRequest_v0
+    except ImportError:
+        from kafka.protocol.api import Request, Response
+        from kafka.protocol.types import Array, Int16, Int32, Schema, String
+
+        class AlterReplicaLogDirsResponse_v0(Response):
+            API_KEY = 34
+            API_VERSION = 0
+            SCHEMA = Schema(
+                ("throttle_time_ms", Int32),
+                ("responses", Array(
+                    ("name", String("utf-8")),
+                    ("partitions", Array(
+                        ("partition_index", Int32),
+                        ("error_code", Int16))))))
+
+        class AlterReplicaLogDirsRequest_v0(Request):
+            API_KEY = 34
+            API_VERSION = 0
+            RESPONSE_TYPE = AlterReplicaLogDirsResponse_v0
+            SCHEMA = Schema(
+                ("dirs", Array(
+                    ("path", String("utf-8")),
+                    ("topics", Array(
+                        ("name", String("utf-8")),
+                        ("partitions", Array(Int32)))))))
+
+        return AlterReplicaLogDirsRequest_v0

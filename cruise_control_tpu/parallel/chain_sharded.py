@@ -1,0 +1,496 @@
+"""Sharded chain kernels: the WHOLE goal chain, fused, under a device mesh.
+
+Production multi-chip solver path. One ``shard_map``-wrapped, jitted kernel
+runs the entire goal chain (``lax.scan`` over the goal index; the same
+structure as ``analyzer.chain.chain_optimize_full``) with:
+
+- partition-indexed tensors sharded along the mesh axis ``"p"``, broker
+  aggregates psum'd (ICI collectives) — the sharding model of
+  ``parallel.sharded``;
+- the active goal as a TRACED index (``lax.switch``) and prior goals as a
+  traced mask — ONE compilation per (mesh, chain, search config), not the
+  per-(goal, prior-chain) ``lru_cache`` blowup of the per-goal sharded
+  drivers (VERDICT round 2, missing #2);
+- one host dispatch and one stacked stats readback for the whole chain.
+
+Collectives appear inside ``scan``/``while_loop``/``cond`` bodies; every
+control-flow predicate is replicated (psum'd counters, the scanned goal
+index), so all devices execute identical programs and the collectives
+match — the same contract the fused per-goal sharded drivers rely on.
+
+Reference parity: GoalOptimizer.java:435-524 run under SPMD instead of a
+precompute thread pool (SURVEY.md §2.11 row 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..analyzer.candidates import (
+    Candidates, CandidateDeltas, compute_deltas, generate_candidates,
+)
+from ..analyzer.chain import (
+    _chain_infos_from_stats, _gated_aux, _goal_flags, _switch_scores,
+)
+from ..analyzer.constraint import BalancingConstraint
+from ..analyzer.derived import compute_derived
+from ..analyzer.search import (
+    _OFFLINE_BONUS, _EPS_IMPROVEMENT, ExclusionMasks, SearchConfig,
+    _conflict_free_top_m, _per_broker_top_replicas, apply_selected,
+    reduce_per_source, run_rounds_loop,
+)
+from ..model.tensors import ClusterTensors, alive_mask, offline_replicas
+from .mesh import PARTITION_AXIS
+from .sharded import _mask_specs, _psum, _state_specs
+
+
+def _offline_per_broker(state: ClusterTensors, off: jax.Array) -> jax.Array:
+    b = state.num_brokers
+    seg = jnp.where(state.assignment >= 0, state.assignment, b).reshape(-1)
+    local = jax.ops.segment_sum(off.astype(jnp.float32).reshape(-1), seg,
+                                num_segments=b + 1)[:b]
+    return _psum(local)
+
+
+def _chain_scores(state, derived, active_idx, prior_mask, goals, constraint,
+                  num_topics, additive_f):
+    """(aux_list, src_score, dst_score, weight) for the active goal under
+    the mesh. The psum of partition-additive source scores runs
+    unconditionally (collective-safety) and is selected by a traced flag."""
+    is_active = jnp.arange(len(goals)) == active_idx
+    aux_list = [_gated_aux(prior_mask[i] | is_active[i], g, state, derived,
+                           constraint, num_topics, psum=_psum)
+                for i, g in enumerate(goals)]
+    src_score, dst_score, weight = _switch_scores(
+        active_idx, goals, aux_list, state, derived, constraint)
+    src_score = jnp.where(additive_f[active_idx], _psum(src_score), src_score)
+    return aux_list, src_score, dst_score, weight
+
+
+def _chain_round_local(state: ClusterTensors, masks: ExclusionMasks,
+                       active_idx: jax.Array, prior_mask: jax.Array, *,
+                       goals, constraint: BalancingConstraint,
+                       cfg: SearchConfig, num_topics: int, num_shards: int):
+    """One chain-parameterized sharded search round (per-device body):
+    the sharded analogue of ``analyzer.chain._chain_round_body``."""
+    shard = jax.lax.axis_index(PARTITION_AXIS)
+    p_local = state.num_partitions
+    p_global = p_local * num_shards
+    offset = shard * p_local
+    k_src = max(1, cfg.num_sources // num_shards)
+
+    lead_only_f, incl_lead_f, indep_f = _goal_flags(goals)
+    additive_f = jnp.asarray([g.partition_additive_scores for g in goals])
+    is_lead_only = lead_only_f[active_idx]
+    has_leadership = incl_lead_f[active_idx]
+
+    derived = compute_derived(state, masks.excluded_topics,
+                              masks.excluded_replica_move_brokers,
+                              masks.excluded_leadership_brokers, psum=_psum)
+    aux_list, src_score, dst_score, weight = _chain_scores(
+        state, derived, active_idx, prior_mask, goals, constraint,
+        num_topics, additive_f)
+
+    # Self-healing priority (score_round_candidates semantics).
+    off = offline_replicas(state)
+    offline_pb = _offline_per_broker(state, off)
+    src_score = src_score + jnp.where(is_lead_only, 0.0, offline_pb)
+    weight = jnp.where(off & ~is_lead_only, 1e30, weight)
+
+    cand, layout = generate_candidates(state, derived, src_score, dst_score,
+                                       weight, k_src, cfg.num_dests,
+                                       include_leadership=True,
+                                       leadership_only=False)
+    (r0, c0), (r1, c1) = layout
+    block_ok = jnp.concatenate([
+        jnp.broadcast_to(~is_lead_only, (r0 * c0,)),
+        jnp.broadcast_to(has_leadership, (r1 * c1,)),
+    ])
+    cand = dataclasses.replace(cand, valid=cand.valid & block_ok)
+    deltas = compute_deltas(state, derived, cand)
+
+    accept = deltas.valid
+    for i, g in enumerate(goals):
+        accept &= (~prior_mask[i]) | g.acceptance(state, derived, constraint,
+                                                  aux_list[i], deltas)
+
+    moving_offline = off[deltas.partition, deltas.src_slot] \
+        & (deltas.replica_delta > 0)
+
+    def imp_branch(i):
+        g = goals[i]
+
+        def fn(_):
+            return g.improvement(state, derived, constraint, aux_list[i],
+                                 deltas).astype(jnp.float32)
+        return fn
+
+    imp = jax.lax.switch(active_idx,
+                         [imp_branch(i) for i in range(len(goals))], 0)
+    imp = jnp.where(moving_offline & jnp.isfinite(imp) & deltas.valid,
+                    jnp.maximum(imp, 0.0) + _OFFLINE_BONUS, imp)
+    score = jnp.where(accept, imp, -jnp.inf)
+
+    red_idx = reduce_per_source(score, layout, row_offset=shard * k_src)
+
+    def gather(x):
+        return jax.lax.all_gather(x, PARTITION_AXIS).reshape(
+            (num_shards * x.shape[0],) + x.shape[1:])
+
+    g_score = gather(score[red_idx])
+    g_part = gather(deltas.partition[red_idx] + offset)
+    g_src = gather(deltas.src_broker[red_idx])
+    g_dst = gather(deltas.dst_broker[red_idx])
+    g_slot = gather(deltas.src_slot[red_idx])
+    g_dslot = gather(cand.dst_slot[red_idx])
+    g_kind = gather(cand.kind[red_idx])
+
+    independent = indep_f[active_idx] & ~prior_mask.any()
+    m = max(cfg.moves_per_round, cfg.num_sources)
+    top_idx, sel = _conflict_free_top_m(
+        g_score, g_part, g_src, g_dst, m, p_global, state.num_brokers,
+        dedupe_brokers=~independent)
+    within_cap = jnp.cumsum(sel.astype(jnp.int32)) <= cfg.moves_per_round
+    sel &= jnp.where(independent, True, within_cap)
+
+    # ``sel`` is computed from gathered, replicated data — identical on
+    # every device, so its sum is already the global count.
+    new_state = apply_selected(state, sel, g_part[top_idx], g_slot[top_idx],
+                               g_dst[top_idx], g_kind[top_idx],
+                               g_dslot[top_idx], row_offset=offset)
+    return new_state, sel.sum()
+
+
+def _chain_swap_local(state: ClusterTensors, masks: ExclusionMasks,
+                      active_idx: jax.Array, prior_mask: jax.Array, *,
+                      goals, constraint: BalancingConstraint, num_topics: int,
+                      num_shards: int, k_brokers: int = 8,
+                      j_replicas: int = 4, moves: int = 8):
+    """Chain-parameterized sharded swap round — the card-gather kernel of
+    ``parallel.sharded._swap_round_local`` with the active goal as a traced
+    switch and prior acceptance as a traced mask."""
+    shard = jax.lax.axis_index(PARTITION_AXIS)
+    p_local = state.num_partitions
+    p_global = p_local * num_shards
+    offset = shard * p_local
+    b = state.num_brokers
+    s_dim = state.max_replication_factor
+    j = j_replicas
+
+    additive_f = jnp.asarray([g.partition_additive_scores for g in goals])
+    derived = compute_derived(state, masks.excluded_topics,
+                              masks.excluded_replica_move_brokers,
+                              masks.excluded_leadership_brokers, psum=_psum)
+    aux_list, src_score, dst_score, weight = _chain_scores(
+        state, derived, active_idx, prior_mask, goals, constraint,
+        num_topics, additive_f)
+
+    k = min(k_brokers, b)
+    src_vals, src_brokers = jax.lax.top_k(
+        jnp.where(src_score > 0, src_score, -jnp.inf), k)
+    dst_vals, dst_brokers = jax.lax.top_k(dst_score, k)
+    src_b_ok = jnp.isfinite(src_vals)
+    dst_b_ok = jnp.isfinite(dst_vals)
+
+    heavy_idx, heavy_ok = _per_broker_top_replicas(
+        state, weight, src_brokers, j, largest=True)
+    light_idx, light_ok = _per_broker_top_replicas(
+        state, weight, dst_brokers, j, largest=False)
+
+    p1, s1 = heavy_idx // s_dim, heavy_idx % s_dim
+    p2, s2 = light_idx // s_dim, light_idx % s_dim
+
+    def leg_masks(pp, ss, ok, counterparties):
+        n = k * j * k
+        cand = Candidates(
+            kind=jnp.zeros(n, dtype=jnp.int8),
+            partition=jnp.broadcast_to(pp[:, :, None], (k, j, k)).reshape(-1),
+            src_slot=jnp.broadcast_to(ss[:, :, None], (k, j, k)).reshape(-1),
+            dst_broker=jnp.broadcast_to(counterparties[None, None, :],
+                                        (k, j, k)).reshape(-1),
+            dst_slot=jnp.zeros(n, dtype=jnp.int32),
+            valid=jnp.broadcast_to(ok[:, :, None], (k, j, k)).reshape(-1))
+        d = compute_deltas(state, derived, cand)
+        acc = d.valid
+        for i, g in enumerate(goals):
+            acc &= (~prior_mask[i]) | g.swap_leg_acceptance(
+                state, derived, constraint, aux_list[i], d)
+        return acc.reshape(k, j, k)
+
+    leg_f = leg_masks(p1, s1, heavy_ok, dst_brokers)
+    leg_r = leg_masks(p2, s2, light_ok, src_brokers)
+
+    w_a = jnp.where(heavy_ok, weight[p1, s1], -jnp.inf)
+    w_b = jnp.where(light_ok, weight[p2, s2], jnp.inf)
+    lead1 = state.leader_slot[p1] == s1
+    lead2 = state.leader_slot[p2] == s2
+    load_a = jnp.where(lead1[..., None], state.leader_load[p1],
+                       state.follower_load[p1])
+    load_b = jnp.where(lead2[..., None], state.leader_load[p2],
+                       state.follower_load[p2])
+    gp1, gp2 = p1 + offset, p2 + offset
+    top1 = state.topic[p1]
+
+    def gather_cards(x):
+        y = jax.lax.all_gather(x, PARTITION_AXIS)
+        y = jnp.moveaxis(y, 0, 1)
+        return y.reshape((k, num_shards * j) + y.shape[3:])
+
+    g_wa = gather_cards(w_a)
+    g_wb = gather_cards(w_b)
+    hv, hsel = jax.lax.top_k(g_wa, j)
+    lv, lsel = jax.lax.top_k(-g_wb, j)
+    heavy_ok_g = jnp.isfinite(hv)
+    light_ok_g = jnp.isfinite(lv)
+
+    def pick(gathered, sel):
+        extra = gathered.ndim - 2
+        return jnp.take_along_axis(
+            gathered, sel.reshape(sel.shape + (1,) * extra), axis=1)
+
+    h_load = pick(gather_cards(load_a), hsel)
+    l_load = pick(gather_cards(load_b), lsel)
+    h_lead = pick(gather_cards(lead1), hsel)
+    l_lead = pick(gather_cards(lead2), lsel)
+    h_gp = pick(gather_cards(gp1), hsel)
+    l_gp = pick(gather_cards(gp2), lsel)
+    h_s = pick(gather_cards(s1), hsel)
+    l_s = pick(gather_cards(s2), lsel)
+    h_topic = pick(gather_cards(top1), hsel)
+    h_legs = pick(gather_cards(leg_f), hsel)
+    l_legs = pick(gather_cards(leg_r), lsel)
+    h_w = hv
+    l_w = -lv
+
+    n = k * k * j * j
+    si, di, ai, bi = jnp.meshgrid(jnp.arange(k), jnp.arange(k),
+                                  jnp.arange(j), jnp.arange(j), indexing="ij")
+    si, di, ai, bi = (x.reshape(-1) for x in (si, di, ai, bi))
+    src_b = src_brokers[si]
+    dst_b = dst_brokers[di]
+    wa = h_w[si, ai]
+    wb = l_w[di, bi]
+    sel_gp1 = h_gp[si, ai]
+    sel_gp2 = l_gp[di, bi]
+
+    base_valid = src_b_ok[si] & dst_b_ok[di] & heavy_ok_g[si, ai] \
+        & light_ok_g[di, bi] & (src_b != dst_b) & (sel_gp1 != sel_gp2) \
+        & (wa > wb) & h_legs[si, ai, di] & l_legs[di, bi, si]
+
+    lead_d = h_lead[si, ai].astype(jnp.int32) - l_lead[di, bi].astype(jnp.int32)
+    net_load = h_load[si, ai] - l_load[di, bi]
+    net = CandidateDeltas(
+        src_broker=jnp.where(base_valid, src_b, 0),
+        dst_broker=jnp.where(base_valid, dst_b, 0),
+        load_delta=jnp.where(base_valid[:, None], net_load, 0.0),
+        replica_delta=jnp.zeros(n, dtype=jnp.int32),
+        leader_delta=jnp.where(base_valid, lead_d, 0),
+        partition=sel_gp1, topic=h_topic[si, ai],
+        src_slot=h_s[si, ai], dst_slot=jnp.zeros(n, dtype=jnp.int32),
+        valid=base_valid)
+
+    accept = base_valid
+    for i, g in enumerate(goals):
+        accept &= (~prior_mask[i]) | g.swap_net_acceptance(
+            state, derived, constraint, aux_list[i], net)
+
+    def imp_branch(i):
+        g = goals[i]
+
+        def fn(_):
+            return g.improvement(state, derived, constraint, aux_list[i],
+                                 net).astype(jnp.float32)
+        return fn
+
+    imp = jax.lax.switch(active_idx,
+                         [imp_branch(i) for i in range(len(goals))], 0)
+    score = jnp.where(accept, imp, -jnp.inf)
+
+    k_m = min(moves, n)
+    top_score, top_idx = jax.lax.top_k(score, k_m)
+    ok = top_score > _EPS_IMPROVEMENT
+    rank = jnp.arange(k_m, dtype=jnp.int32)
+    big = jnp.int32(k_m + 1)
+    rank_eff = jnp.where(ok, rank, big)
+    t_gp1, t_gp2 = sel_gp1[top_idx], sel_gp2[top_idx]
+    t_src, t_dst = src_b[top_idx], dst_b[top_idx]
+    first_part = jnp.full(p_global, big, jnp.int32) \
+        .at[t_gp1].min(rank_eff).at[t_gp2].min(rank_eff)
+    first_broker = jnp.full(b, big, jnp.int32) \
+        .at[t_src].min(rank_eff).at[t_dst].min(rank_eff)
+    sel = ok & (first_part[t_gp1] == rank) & (first_part[t_gp2] == rank) \
+        & (first_broker[t_src] == rank) & (first_broker[t_dst] == rank)
+
+    p_pad = jnp.int32(p_local)
+    row1 = t_gp1 - offset
+    row2 = t_gp2 - offset
+    rows1 = jnp.where(sel & (row1 >= 0) & (row1 < p_local), row1, p_pad)
+    rows2 = jnp.where(sel & (row2 >= 0) & (row2 < p_local), row2, p_pad)
+    new_assignment = state.assignment \
+        .at[rows1, h_s[si, ai][top_idx]].set(
+            t_dst.astype(state.assignment.dtype), mode="drop") \
+        .at[rows2, l_s[di, bi][top_idx]].set(
+            t_src.astype(state.assignment.dtype), mode="drop")
+    return dataclasses.replace(state, assignment=new_assignment), sel.sum()
+
+
+def _chain_stats_local(state: ClusterTensors, masks: ExclusionMasks,
+                       active_idx: jax.Array, *, goals,
+                       constraint: BalancingConstraint, num_topics: int):
+    """(viol, obj, offline) of the active goal under the mesh. Dispatches
+    through ``Goal.objective`` like the single-device stats body; a goal
+    with ``partition_additive_scores`` must keep any objective override
+    partition-additive too (it is psum'd here)."""
+    additive_f = jnp.asarray([g.partition_additive_scores for g in goals])
+    derived = compute_derived(state, masks.excluded_topics,
+                              masks.excluded_replica_move_brokers,
+                              masks.excluded_leadership_brokers, psum=_psum)
+    is_active = jnp.arange(len(goals)) == active_idx
+    aux_list = [_gated_aux(is_active[i], g, state, derived, constraint,
+                           num_topics, psum=_psum)
+                for i, g in enumerate(goals)]
+
+    def branch(i):
+        g = goals[i]
+
+        def fn(_):
+            viol = g.broker_violations(state, derived, constraint,
+                                       aux_list[i]).sum().astype(jnp.float32)
+            obj = g.objective(state, derived, constraint,
+                              aux_list[i]).astype(jnp.float32)
+            return viol, obj
+        return fn
+
+    viol, obj = jax.lax.switch(active_idx,
+                               [branch(i) for i in range(len(goals))], 0)
+    viol = jnp.where(additive_f[active_idx], _psum(viol), viol)
+    obj = jnp.where(additive_f[active_idx], _psum(obj), obj)
+    offline = _psum(offline_replicas(state).sum())
+    return viol, obj, offline
+
+
+def _chain_full_local(state: ClusterTensors, masks: ExclusionMasks, *,
+                      goals, constraint: BalancingConstraint,
+                      cfg: SearchConfig, num_topics: int, num_shards: int,
+                      swap_moves: int, swap_max_rounds: int):
+    """Per-device body of the whole-chain kernel (the sharded analogue of
+    ``analyzer.chain.chain_optimize_full``'s traced body)."""
+    g_count = len(goals)
+    supports_swap = jnp.asarray([g.supports_swap for g in goals])
+
+    def drain_pending(s: ClusterTensors) -> jax.Array:
+        if masks.excluded_replica_move_brokers is None:
+            return jnp.bool_(False)
+        excl_alive = masks.excluded_replica_move_brokers & alive_mask(s)
+        b = s.num_brokers
+        seg = jnp.where(s.assignment >= 0, s.assignment, b)
+        on_excl = jnp.concatenate([excl_alive, jnp.array([False])])[seg]
+        return _psum(on_excl.sum()) > 0
+
+    def per_goal(carry_state, g):
+        prior = jnp.arange(g_count) < g
+        viol0, obj0, offline0 = _chain_stats_local(
+            carry_state, masks, g, goals=goals, constraint=constraint,
+            num_topics=num_topics)
+
+        def run(s):
+            def outer_cond(c):
+                _s, _m, _sw, rounds, last_swapped, first = c
+                return (first | (last_swapped > 0)) & (rounds < cfg.max_rounds)
+
+            def outer_body(c):
+                s, m_tot, sw_tot, rounds, _ls, _first = c
+                s, m, r = run_rounds_loop(
+                    lambda st: _chain_round_local(
+                        st, masks, g, prior, goals=goals,
+                        constraint=constraint, cfg=cfg,
+                        num_topics=num_topics, num_shards=num_shards),
+                    s, cfg.max_rounds)
+
+                def do_swap(st):
+                    return run_rounds_loop(
+                        lambda st2: _chain_swap_local(
+                            st2, masks, g, prior, goals=goals,
+                            constraint=constraint, num_topics=num_topics,
+                            num_shards=num_shards, moves=swap_moves),
+                        st, swap_max_rounds)
+
+                def no_swap(st):
+                    return st, jnp.int32(0), jnp.int32(0)
+
+                s, sw, sr = jax.lax.cond(supports_swap[g], do_swap, no_swap, s)
+                return (s, m_tot + m, sw_tot + sw, rounds + r + sr, sw,
+                        jnp.bool_(False))
+
+            s, m, sw, rounds, _, _ = jax.lax.while_loop(
+                outer_cond, outer_body,
+                (s, jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                 jnp.bool_(True)))
+            return s, m, sw, rounds
+
+        def skip(s):
+            return s, jnp.int32(0), jnp.int32(0), jnp.int32(0)
+
+        new_state, moves, swaps, rounds = jax.lax.cond(
+            (viol0 > 0) | (offline0 > 0) | drain_pending(carry_state),
+            run, skip, carry_state)
+        viol1, obj1, offline1 = _chain_stats_local(
+            new_state, masks, g, goals=goals, constraint=constraint,
+            num_topics=num_topics)
+        ys = {"viol_before": viol0, "obj_before": obj0,
+              "offline_before": offline0, "viol_after": viol1,
+              "obj_after": obj1, "offline_after": offline1,
+              "moves": moves, "swaps": swaps, "rounds": rounds}
+        return new_state, ys
+
+    return jax.lax.scan(per_goal, state, jnp.arange(g_count, dtype=jnp.int32))
+
+
+@lru_cache(maxsize=64)
+def _make_chain_full(mesh: Mesh, goals, constraint, cfg: SearchConfig,
+                     num_topics: int, mask_presence: tuple[bool, bool, bool],
+                     swap_moves: int, swap_max_rounds: int):
+    """ONE compile per (mesh, chain, search config) — the whole chain."""
+    body = partial(_chain_full_local, goals=goals, constraint=constraint,
+                   cfg=cfg, num_topics=num_topics,
+                   num_shards=mesh.devices.size, swap_moves=swap_moves,
+                   swap_max_rounds=swap_max_rounds)
+    stats_specs = {k: P() for k in
+                   ("viol_before", "obj_before", "offline_before",
+                    "viol_after", "obj_after", "offline_after",
+                    "moves", "swaps", "rounds")}
+    mapped = shard_map(body, mesh=mesh,
+                       in_specs=(_state_specs(), _mask_specs(mask_presence)),
+                       out_specs=(_state_specs(), stats_specs),
+                       check_vma=False)
+    return jax.jit(mapped)
+
+
+def optimize_chain_sharded(state: ClusterTensors, chain,
+                           constraint: BalancingConstraint, cfg: SearchConfig,
+                           num_topics: int, mesh: Mesh,
+                           masks: ExclusionMasks | None = None,
+                           swap_moves: int = 8, swap_max_rounds: int = 64,
+                           ) -> tuple[ClusterTensors, list[dict]]:
+    """Sharded analogue of ``analyzer.chain.optimize_chain``: the whole
+    chain in one dispatch over the mesh, same info-dict contract and error
+    behavior (hard-goal failure / stats-regression raised per goal in chain
+    order from the stacked stats)."""
+    masks = masks or ExclusionMasks()
+    goals = tuple(chain)
+    if not goals:
+        return state, []
+    presence = (masks.excluded_topics is not None,
+                masks.excluded_replica_move_brokers is not None,
+                masks.excluded_leadership_brokers is not None)
+    fn = _make_chain_full(mesh, goals, constraint, cfg, num_topics, presence,
+                          swap_moves, swap_max_rounds)
+    state, stats = fn(state, masks)
+    stats = {k: jax.device_get(v) for k, v in stats.items()}
+    return state, _chain_infos_from_stats(goals, stats)

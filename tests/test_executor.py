@@ -310,3 +310,173 @@ def test_notifier_fires_on_finish_and_stop():
     ex2.stop_execution()
     assert ex2.await_completion(10.0)
     assert notifier2.stopped and notifier2.stopped[0]["uuid"] == "n2"
+
+
+# ---- intra-broker (JBOD logdir) phase --------------------------------------
+
+def dir_proposal(part, broker, dst, src="d0", topic="t"):
+    return ExecutionProposal(topic=topic, partition=part, old_leader=-1,
+                             old_replicas=(), new_replicas=(), new_leader=-1,
+                             logdir_broker=broker, source_logdir=src,
+                             destination_logdir=dst)
+
+
+def make_jbod_cluster(n_parts=8, brokers=(0, 1), dir_moves_per_tick=1):
+    parts = [PartitionState(topic="t", partition=i,
+                            replicas=(brokers[i % len(brokers)],),
+                            leader=brokers[i % len(brokers)],
+                            isr=(brokers[i % len(brokers)],))
+             for i in range(n_parts)]
+    admin = InMemoryAdminBackend(parts, dir_moves_per_tick=dir_moves_per_tick)
+    admin.enable_jbod({b: ["d0", "d1"] for b in brokers},
+                      placement={("t", i, brokers[i % len(brokers)]): "d0"
+                                 for i in range(n_parts)})
+    return admin
+
+
+def test_intra_broker_phase_executes_and_polls_to_completion():
+    """Logdir moves are submitted via alter_replica_logdirs, polled against
+    replica_logdirs, and completed — not marked done without doing work
+    (the round-2 stub drained tasks as completed; Executor.java:1672)."""
+    admin = make_jbod_cluster(n_parts=4, brokers=(0,), dir_moves_per_tick=2)
+    ex = Executor(admin, ConcurrencyCaps(intra_broker_per_broker=2),
+                  progress_check_interval_s=0.005)
+    ex.execute_proposals([dir_proposal(i, 0, "d1") for i in range(4)],
+                         uuid="jbod")
+    assert ex.await_completion(30.0)
+    counts = ex.execution_state()["taskCounts"]
+    assert counts[TaskType.INTRA_BROKER_REPLICA_ACTION.value] == {
+        "completed": 4}
+    dirs = admin.replica_logdirs()
+    assert all(dirs[("t", i, 0)] == "d1" for i in range(4))
+
+
+def test_intra_broker_phase_respects_per_broker_cap():
+    """At most intra_broker_per_broker moves are in flight per broker at any
+    poll interval (num.concurrent.intra.broker.partition.movements)."""
+    admin = make_jbod_cluster(n_parts=8, brokers=(0,), dir_moves_per_tick=1)
+    observed = []
+    orig = admin.alter_replica_logdirs
+
+    def spy(moves):
+        observed.append(len(moves))
+        orig(moves)
+
+    admin.alter_replica_logdirs = spy
+    ex = Executor(admin, ConcurrencyCaps(intra_broker_per_broker=2),
+                  progress_check_interval_s=0.005)
+    ex.execute_proposals([dir_proposal(i, 0, "d1") for i in range(8)],
+                         uuid="jbod-cap")
+    assert ex.await_completion(30.0)
+    # First batch takes the full cap; every later batch only refills
+    # completed slots — the cap holds ACROSS poll intervals.
+    assert observed[0] == 2
+    assert all(n <= 2 for n in observed)
+    counts = ex.execution_state()["taskCounts"]
+    assert counts[TaskType.INTRA_BROKER_REPLICA_ACTION.value] == {
+        "completed": 8}
+
+
+def test_intra_broker_phase_kills_tasks_on_dead_broker():
+    admin = make_jbod_cluster(n_parts=4, brokers=(0, 1),
+                              dir_moves_per_tick=1)
+    ex = Executor(admin, ConcurrencyCaps(intra_broker_per_broker=1),
+                  progress_check_interval_s=0.005, task_timeout_s=0.3)
+    admin.kill_broker(1)
+    ex.execute_proposals([dir_proposal(i, i % 2, "d1") for i in range(4)],
+                         uuid="jbod-dead")
+    assert ex.await_completion(30.0)
+    counts = ex.execution_state()["taskCounts"]
+    by_state = counts[TaskType.INTRA_BROKER_REPLICA_ACTION.value]
+    assert by_state.get("completed") == 2      # broker 0's moves
+    assert by_state.get("dead") == 2           # broker 1 died
+
+
+def test_intra_broker_tasks_dead_without_jbod_backend():
+    """A backend without the JBOD surface DEAD-marks logdir tasks instead of
+    faking completion."""
+    admin = make_cluster()
+
+    class NoJbod:
+        def __getattr__(self, name):
+            if name in ("alter_replica_logdirs", "replica_logdirs"):
+                raise AttributeError(name)
+            return getattr(admin, name)
+
+    ex2 = Executor(NoJbod(), synchronous=True)
+    ex2.execute_proposals([dir_proposal(0, 0, "d1")], uuid="nojbod")
+    counts = ex2.execution_state()["taskCounts"]
+    assert counts[TaskType.INTRA_BROKER_REPLICA_ACTION.value] == {"dead": 1}
+
+
+def test_mixed_proposal_runs_all_three_phases():
+    """One proposal carrying an inter-broker move, a logdir leg, and a
+    leadership change expands into three tasks executed phase by phase."""
+    admin = make_jbod_cluster(n_parts=4, brokers=(0, 1, 2),
+                              dir_moves_per_tick=100)
+    p = ExecutionProposal(topic="t", partition=0, old_leader=0,
+                          old_replicas=(0,), new_replicas=(1,), new_leader=1,
+                          logdir_broker=1, source_logdir="d0",
+                          destination_logdir="d1")
+    ex = Executor(admin, progress_check_interval_s=0.005)
+    ex.execute_proposals([p], uuid="mixed")
+    assert ex.await_completion(30.0)
+    counts = ex.execution_state()["taskCounts"]
+    assert counts[TaskType.INTER_BROKER_REPLICA_ACTION.value] == {"completed": 1}
+    assert counts[TaskType.INTRA_BROKER_REPLICA_ACTION.value] == {"completed": 1}
+    assert admin.replica_logdirs()[("t", 0, 1)] == "d1"
+
+
+# ---- metric-driven concurrency adjuster ------------------------------------
+
+def test_adjuster_reduces_batch_when_isr_shrinks_mid_execution():
+    """Executor.java:465-683: under-min-ISR state observed during the poll
+    loop halves the per-broker inter-broker cap, so the NEXT submitted
+    batch is smaller; a healthy cluster steps it back up."""
+    # 12 proposals moving partitions 0..11 from broker 0 to broker 2; a
+    # bystander partition on broker 3 whose ISR will shrink mid-flight.
+    parts = [PartitionState(topic="t", partition=i, replicas=(0, 1),
+                            leader=0, isr=(0, 1)) for i in range(12)]
+    parts.append(PartitionState(topic="t", partition=99, replicas=(3, 1),
+                                leader=3, isr=(3, 1)))
+    admin = InMemoryAdminBackend(parts, steps_per_tick=0)
+    admin.alter_topic_configs({"t": {"min.insync.replicas": "2"}})
+    admin.revive_broker(2)
+
+    batch_sizes = []
+    orig = admin.alter_partition_reassignments
+
+    def spy(targets):
+        batch_sizes.append(len(targets))
+        orig(targets)
+
+    admin.alter_partition_reassignments = spy
+    ex = Executor(admin, ConcurrencyCaps(inter_broker_per_broker=4),
+                  progress_check_interval_s=0.01,
+                  adjuster_enabled=True, adjuster_interval_s=0.0)
+    ex.execute_proposals(
+        [proposal(part=i, old=(0, 1), new=(2, 1), new_leader=2)
+         for i in range(12)], uuid="adj")
+    # First batch goes out at the base cap while the cluster looks healthy.
+    deadline = time.time() + 5
+    while not batch_sizes and time.time() < deadline:
+        time.sleep(0.005)
+    assert batch_sizes and batch_sizes[0] == 4
+
+    # Shrink ISR below min.insync.replicas: kill the bystander broker.
+    admin.kill_broker(3)
+    time.sleep(0.1)
+    cap_under_pressure = ex.execution_state()["concurrency"][
+        "interBrokerPerBroker"]
+    assert cap_under_pressure < 4
+
+    # Recovery: revive the broker; the cap steps back up and execution
+    # completes.
+    admin.revive_broker(3)
+    admin._steps_per_tick = 1_000_000
+    assert ex.await_completion(30.0)
+    assert all(n <= 4 for n in batch_sizes)
+    assert any(n < 4 for n in batch_sizes[1:]), batch_sizes
+    counts = ex.execution_state()["taskCounts"]
+    assert counts[TaskType.INTER_BROKER_REPLICA_ACTION.value] == {
+        "completed": 12}

@@ -157,6 +157,70 @@ def test_distributed_single_process_path(mesh, cluster):
     assert res["succeeded"]
 
 
+def test_sharded_full_chain_matches_single_device_outcome(mesh, cluster):
+    """The fused whole-chain mesh kernel (parallel/chain_sharded.py) must
+    reach the same per-goal OUTCOME as the single-device whole-chain kernel:
+    identical success/violation profile and comparable balance. (Bitwise
+    trajectory equality is not expected — per-device top-k candidate
+    generation explores a different, equally valid move order.)"""
+    from cruise_control_tpu.analyzer.chain import optimize_chain
+    from cruise_control_tpu.analyzer.goals import (
+        PreferredLeaderElectionGoal, ReplicaCapacityGoal,
+    )
+    from cruise_control_tpu.parallel import optimize_chain_sharded
+
+    state, meta = cluster
+    chain = (RackAwareGoal(), ReplicaCapacityGoal(),
+             ReplicaDistributionGoal(),
+             NetworkOutboundUsageDistributionGoal(),
+             PreferredLeaderElectionGoal())
+    cfg = SearchConfig(num_sources=32, num_dests=8, moves_per_round=8,
+                       max_rounds=60)
+
+    st_single, infos_single = optimize_chain(state, chain, CONSTRAINT, cfg,
+                                             meta.num_topics)
+    sharded = shard_cluster(state, mesh)
+    st_mesh, infos_mesh = optimize_chain_sharded(
+        sharded, chain, CONSTRAINT, cfg, meta.num_topics, mesh)
+
+    for s, m in zip(infos_single, infos_mesh):
+        assert m["goal"] == s["goal"]
+        assert m["succeeded"] == s["succeeded"], (s, m)
+    # Replica-count spread after the chain is comparable.
+    counts_s = np.asarray(broker_replica_counts(st_single))
+    counts_m = np.asarray(broker_replica_counts(jax.device_get(st_mesh)))
+    spread_s = counts_s.max() - counts_s.min()
+    spread_m = counts_m.max() - counts_m.min()
+    assert spread_m <= spread_s + 2
+    # Rack-awareness holds on the mesh result.
+    full = jax.device_get(st_mesh)
+    derived = compute_derived(full)
+    viol = RackAwareGoal().broker_violations(full, derived, CONSTRAINT, None)
+    assert float(viol.sum()) <= 1e-6
+
+
+def test_goal_optimizer_uses_mesh(mesh, cluster):
+    """GoalOptimizer(mesh=...) routes optimizations through the sharded
+    chain kernel and reports the device count."""
+    from cruise_control_tpu.analyzer.optimizer import (
+        GoalOptimizer, goals_by_priority,
+    )
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+
+    state, meta = cluster
+    cfg = CruiseControlConfig()
+    opt = GoalOptimizer(cfg, mesh=mesh)
+    assert opt.solver_devices() == 8
+    chain = goals_by_priority(cfg, ["RackAwareGoal",
+                                    "ReplicaDistributionGoal"])
+    _st, result = opt.optimizations(state, meta, goals=chain)
+    assert result.balancedness_after >= result.balancedness_before
+    assert all(r.succeeded for r in result.goal_results
+               if r.name == "RackAwareGoal")
+
+
 def test_sharded_topic_replica_aux_psum(mesh, cluster):
     """TopicReplicaDistributionGoal's [T, B] aux is additive across shards —
     the psum path must reproduce the single-device optimization."""
