@@ -42,7 +42,7 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/cc_tpu_jax_cache")
 # every hosted replica becomes offline and must be re-placed under capacity
 # + rack constraints).
 STAGES = [(16, 512, 0), (50, 2_000, 0), (100, 10_000, 0), (1_000, 100_000, 0),
-          (1_000, 100_000, 50)]
+          (1_000, 100_000, 50), (7_000, 1_000_000, 0)]
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "840"))
 

@@ -10,6 +10,7 @@ diff initial vs final into proposals) and OptimizerResult.java.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from typing import Sequence
 
@@ -26,6 +27,8 @@ from .goals import ALL_GOALS
 from .goals.base import Goal
 from .proposals import ExecutionProposal, diff_proposals
 from .search import ExclusionMasks, OptimizationFailureError, SearchConfig
+
+LOG = logging.getLogger(__name__)
 
 # Balancedness score weights (KafkaCruiseControlUtils.java:831-856): each
 # priority level weighs priorityWeight× the next, hard goals weigh
@@ -104,6 +107,25 @@ def balancedness_score(goals: Sequence[Goal], violated: set[str],
     return MAX_BALANCEDNESS_SCORE * (1.0 - cost / total)
 
 
+def _apportioned_goal_results(goal_chain: Sequence[Goal], infos: list[dict],
+                              chain_s: float) -> list[GoalResult]:
+    """GoalResults from whole-chain kernel stats. Per-goal wall-clock cannot
+    be measured inside one dispatch; the chain time is apportioned by each
+    goal's share of search rounds (equal split when no goal ran).
+    violated_before follows the reference (GoalOptimizer.java:450-482): a
+    goal was violated BEFORE optimization iff it had work to do when its
+    turn came, or it failed."""
+    total_rounds = sum(info["rounds"] for info in infos) or None
+    return [GoalResult(
+        name=g.name, is_hard=g.is_hard, succeeded=info["succeeded"],
+        rounds=info["rounds"], moves_applied=info["moves_applied"],
+        residual_violation=info["residual_violation"],
+        duration_s=chain_s * (info["rounds"] / total_rounds
+                              if total_rounds else 1 / len(infos)),
+        violated_before=info["violated_on_entry"] or not info["succeeded"])
+        for g, info in zip(goal_chain, infos)]
+
+
 class GoalOptimizer:
     """Facade over the batched chain search (GoalOptimizer.java:65).
 
@@ -118,6 +140,11 @@ class GoalOptimizer:
         self._config = config or CruiseControlConfig()
         self._constraint = BalancingConstraint.from_config(self._config)
         self._cand_budget = self._config.get_int("solver.candidates.per.round")
+        # An EXPLICITLY configured candidate budget is a hard bound (the
+        # operator's memory knob); the default value means "auto-scale with
+        # cluster size".
+        self._cand_budget_explicit = \
+            "solver.candidates.per.round" in self._config.originals()
         self._moves_base = self._config.get_int("solver.moves.per.round")
         self._max_rounds = self._config.get_int("max.solver.rounds")
         self._priority_weight = self._config.get_double("goal.balancedness.priority.weight")
@@ -130,14 +157,18 @@ class GoalOptimizer:
             mesh = make_mesh() if len(jax.devices()) > 1 else None
         self._mesh = mesh if (mesh is not None
                               and mesh.devices.size > 1) else None
+        self._devices_used = int(self._mesh.devices.size) if self._mesh else 1
 
     @property
     def mesh(self):
         return self._mesh
 
     def solver_devices(self) -> int:
-        """Device count the solver actually uses (bench reporting)."""
-        return int(self._mesh.devices.size) if self._mesh is not None else 1
+        """Device count the LAST optimization pass actually ran on (bench
+        reporting — the mesh falls back to single-device when the partition
+        axis does not divide it, and reporting the mesh size then would
+        corrupt the vs-baseline comparison)."""
+        return self._devices_used
 
     @property
     def constraint(self) -> BalancingConstraint:
@@ -156,8 +187,12 @@ class GoalOptimizer:
         near-free on TPU (one fused kernel); round count is the scarce
         resource."""
         b = state.num_brokers
-        budget = max(self._cand_budget, min(65_536, b * 64))
+        budget = self._cand_budget if self._cand_budget_explicit \
+            else max(self._cand_budget, min(65_536, b * 64))
         num_dests = max(16, min(256, b // 4))
+        if self._cand_budget_explicit:
+            # Honor the operator's budget as a bound on the move grid.
+            num_dests = min(num_dests, max(4, budget // 64))
         num_sources = max(64, min(1024, budget // num_dests))
         moves = max(self._moves_base, min(512, b // 2))
         return SearchConfig(num_sources=num_sources, num_dests=num_dests,
@@ -197,6 +232,8 @@ class GoalOptimizer:
                       options: OptimizationOptions | None = None,
                       ) -> tuple[ClusterTensors, OptimizerResult]:
         """Run the goal chain; returns (final_state, OptimizerResult)."""
+        from ..utils.progress import step
+        step("OptimizationForGoalChain")
         t_start = time.time()
         options = options or OptimizationOptions()
         goal_chain = list(goals) if goals is not None \
@@ -210,7 +247,12 @@ class GoalOptimizer:
         if mesh is not None and state.num_partitions % mesh.devices.size != 0:
             # Partition axis must divide the mesh (pad via the builder's
             # partition_bucket to avoid this fallback).
+            LOG.warning(
+                "num_partitions %d not divisible by mesh size %d: falling "
+                "back to the single-device solver for this pass",
+                state.num_partitions, mesh.devices.size)
             mesh = None
+        self._devices_used = int(mesh.devices.size) if mesh is not None else 1
         if mesh is not None:
             # Multi-chip production path: whole chain, one dispatch, SPMD
             # over the mesh (parallel.chain_sharded).
@@ -220,40 +262,17 @@ class GoalOptimizer:
             state, infos = optimize_chain_sharded(
                 state, goal_chain, self._constraint, search_cfg,
                 meta.num_topics, mesh, masks)
-            chain_s = time.time() - t0
-            total_rounds = sum(info["rounds"] for info in infos) or None
-            goal_results = [GoalResult(
-                name=g.name, is_hard=g.is_hard, succeeded=info["succeeded"],
-                rounds=info["rounds"], moves_applied=info["moves_applied"],
-                residual_violation=info["residual_violation"],
-                duration_s=chain_s * (info["rounds"] / total_rounds
-                                      if total_rounds else 1 / len(infos)),
-                violated_before=info["violated_on_entry"]
-                or not info["succeeded"])
-                for g, info in zip(goal_chain, infos)]
+            goal_results = _apportioned_goal_results(
+                goal_chain, infos, time.time() - t0)
         elif self._fused_chain:
             # Production path: the whole chain in ONE device dispatch
-            # (chain.chain_optimize_full). Per-goal wall-clock cannot be
-            # measured per dispatch; the chain time is apportioned by each
-            # goal's share of search rounds (equal split when no goal ran).
+            # (chain.chain_optimize_full).
             t0 = time.time()
             state, infos = optimize_chain(
                 state, goal_chain, self._constraint, search_cfg,
                 meta.num_topics, masks)
-            chain_s = time.time() - t0
-            total_rounds = sum(info["rounds"] for info in infos) or None
-            goal_results = [GoalResult(
-                name=g.name, is_hard=g.is_hard, succeeded=info["succeeded"],
-                rounds=info["rounds"], moves_applied=info["moves_applied"],
-                residual_violation=info["residual_violation"],
-                duration_s=chain_s * (info["rounds"] / total_rounds
-                                      if total_rounds else 1 / len(infos)),
-                # Reference semantics (GoalOptimizer.java:450-482): a goal
-                # was violated BEFORE optimization iff it had work to do
-                # when its turn came, or it failed.
-                violated_before=info["violated_on_entry"]
-                or not info["succeeded"])
-                for g, info in zip(goal_chain, infos)]
+            goal_results = _apportioned_goal_results(
+                goal_chain, infos, time.time() - t0)
         else:
             # Per-goal dispatch path (kept for equivalence tests and
             # per-goal wall-clock attribution). Same on-entry
@@ -277,6 +296,14 @@ class GoalOptimizer:
         violated_after = [r.name for r in goal_results if not r.succeeded]
         stats_after = cluster_stats(state)
         proposals = diff_proposals(initial, state, meta)
+        # proposal-computation-timer + per-pass gauges
+        # (GoalOptimizer.java:128, Sensors.md).
+        from ..utils.sensors import SENSORS
+        SENSORS.record_timer("analyzer_proposal_computation",
+                             time.time() - t_start)
+        SENSORS.gauge("analyzer_num_proposals", len(proposals))
+        SENSORS.gauge("analyzer_violated_goals_after", len(violated_after))
+        SENSORS.gauge("analyzer_solver_devices", self.solver_devices())
         result = OptimizerResult(
             proposals=proposals, goal_results=goal_results,
             stats_before=stats_before, stats_after=stats_after,

@@ -1,0 +1,74 @@
+"""OpenAPI spec generated from the shared parameter schemas.
+
+Reference parity: cruise-control/src/main/resources/yaml/base.yaml (the
+hand-written spec the Vert.x front-end routes from,
+vertx/MainVerticle.java:54). Here the spec is DERIVED from the same
+``api.parameters.SCHEMAS`` tables the dispatcher validates against, so it
+cannot drift from the implementation. Served at ``/openapi``.
+"""
+
+from __future__ import annotations
+
+from .endpoints import EndPoint
+from .parameters import _COMMON, SCHEMAS
+from .server import URL_PREFIX
+
+_TYPE_BY_COERCION = {
+    "_bool": ("boolean", None),
+    "_int": ("integer", None),
+    "_long_ms": ("integer", "epoch milliseconds"),
+    "_str": ("string", None),
+    "_csv": ("string", "comma-separated list"),
+    "_int_csv": ("string", "comma-separated integers"),
+    "_broker_logdir_csv": ("string", "comma-separated brokerid-logdir pairs"),
+}
+
+
+def _param_spec(name: str, coercion) -> dict:
+    oa_type, note = _TYPE_BY_COERCION.get(
+        getattr(coercion, "__name__", ""), ("string", None))
+    out = {"name": name, "in": "query", "required": False,
+           "schema": {"type": oa_type}}
+    if note:
+        out["description"] = note
+    return out
+
+
+def openapi_spec() -> dict:
+    paths: dict = {}
+    for endpoint in EndPoint:
+        params = [_param_spec(n, c)
+                  for n, c in sorted({**_COMMON, **SCHEMAS[endpoint]}.items())]
+        paths[f"{URL_PREFIX}/{endpoint.name.lower()}"] = {
+            endpoint.method.lower(): {
+                "operationId": endpoint.name.lower(),
+                "summary": f"{endpoint.name} "
+                           f"(requires role {endpoint.required_role.name})",
+                "parameters": params,
+                "responses": {"200": {"description": "OK (JSON envelope)"},
+                              "202": {"description":
+                                      "async task accepted; poll with the "
+                                      "User-Task-ID header"},
+                              "400": {"description": "bad parameter"},
+                              "401": {"description": "unauthenticated"},
+                              "403": {"description": "unauthorized"}},
+            }}
+    paths["/metrics"] = {"get": {
+        "operationId": "metrics",
+        "summary": "Prometheus sensor exposition",
+        "responses": {"200": {"description": "text exposition format"}}}}
+    return {
+        "openapi": "3.0.0",
+        "info": {"title": "cruise-control-tpu",
+                 "description": "TPU-native Cruise Control REST API "
+                                "(endpoint parity with "
+                                "CruiseControlEndPoint.java:17-39)",
+                 "version": "1.0"},
+        "paths": paths,
+    }
+
+
+def openapi_yaml() -> str:
+    import yaml
+
+    return yaml.safe_dump(openapi_spec(), sort_keys=False)

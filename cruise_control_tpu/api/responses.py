@@ -143,8 +143,38 @@ def kafka_cluster_state(admin: AdminBackend, topic_filter: str = "") -> dict:
     })
 
 
-def optimization_result(op: OperationResult) -> dict:
-    """Proposal-bearing POST/GET body (response/OptimizationResult.java:191)."""
+_NON_VERBOSE_PROPOSAL_CAP = 1000
+
+
+def _stats_dict(stats) -> dict:
+    """ClusterModelStats → JSON (response/stats semantics)."""
+    import numpy as np
+
+    from ..common.resources import Resource
+    util = {}
+    for r in Resource:
+        util[r.name] = {
+            "avg": float(np.asarray(stats.utilization_avg)[int(r)]),
+            "max": float(np.asarray(stats.utilization_max)[int(r)]),
+            "min": float(np.asarray(stats.utilization_min)[int(r)]),
+            "stdDev": float(np.asarray(stats.utilization_std)[int(r)]),
+        }
+
+    def four(a):
+        avg, mx, mn, std = (float(x) for x in np.asarray(a))
+        return {"avg": avg, "max": mx, "min": mn, "stdDev": std}
+
+    return {"utilization": util,
+            "potentialNwOut": four(stats.potential_nw_out_stats),
+            "replicaCount": four(stats.replica_count_stats),
+            "leaderCount": four(stats.leader_count_stats),
+            "numAliveBrokers": int(stats.num_alive_brokers)}
+
+
+def optimization_result(op: OperationResult, verbose: bool = False) -> dict:
+    """Proposal-bearing POST/GET body (response/OptimizationResult.java:191).
+    ``verbose`` lifts the proposal-list cap and adds before/after cluster
+    stats (ParameterUtils verbose semantics)."""
     body: dict = {"operation": op.operation, "dryrun": op.dryrun,
                   "executed": op.executed}
     r: OptimizerResult | None = op.optimizer_result
@@ -155,12 +185,20 @@ def optimization_result(op: OperationResult) -> dict:
             {"goal": g.name, "status": "FIXED" if g.succeeded else "VIOLATED",
              "optimizationTimeMs": round(1000 * g.duration_s, 1)}
             for g in r.goal_results]
+        if verbose:
+            body["loadBeforeOptimization"] = _stats_dict(r.stats_before)
+            body["loadAfterOptimization"] = _stats_dict(r.stats_after)
+    proposals = list(op.proposals)
+    body["numProposals"] = len(proposals)
+    if not verbose and len(proposals) > _NON_VERBOSE_PROPOSAL_CAP:
+        body["proposalsTruncated"] = True
+        proposals = proposals[:_NON_VERBOSE_PROPOSAL_CAP]
     body["proposals"] = [
         {"topicPartition": {"topic": p.topic, "partition": p.partition},
          "oldLeader": p.old_leader,
          "oldReplicas": list(p.old_replicas),
          "newReplicas": list(p.new_replicas),
          "newLeader": p.new_leader}
-        for p in op.proposals]
+        for p in proposals]
     body.update(op.extra)
     return envelope(body)

@@ -30,7 +30,9 @@ from .security import (
     AuthenticationError, AuthorizationError, NoopSecurityProvider, Principal,
     SecurityProvider,
 )
-from .user_tasks import USER_TASK_HEADER, UserTaskManager
+from .user_tasks import (
+    USER_TASK_HEADER, TooManyUserTasksError, UserTaskManager,
+)
 
 LOG = logging.getLogger(__name__)
 
@@ -62,27 +64,72 @@ class CruiseControlApi:
                  config: CruiseControlConfig | None = None):
         self._cc = cc
         cfg = config or cc.config
+        self._config = cfg
         self._security = security_provider or (
             self._configured_security(cfg) if cfg.get_boolean("webserver.security.enable")
             else NoopSecurityProvider())
         self._two_step = cfg.get_boolean("two.step.verification.enabled")
-        self._purgatory = Purgatory()
+        self._purgatory = Purgatory(
+            retention_ms=cfg.get_long("two.step.purgatory.retention.time.ms"))
         self._tasks = UserTaskManager(
             max_active_tasks=cfg.get_int("max.active.user.tasks"),
             completed_retention_ms=cfg.get_long(
-                "completed.user.task.retention.time.ms"))
-        self._async_wait_s = 10.0
+                "completed.user.task.retention.time.ms"),
+            max_cached_completed_monitor_tasks=cfg.get_int(
+                "max.cached.completed.kafka.monitor.user.tasks"),
+            max_cached_completed_admin_tasks=cfg.get_int(
+                "max.cached.completed.kafka.admin.user.tasks"),
+            max_cached_completed_tasks=cfg.get_int(
+                "max.cached.completed.user.tasks"))
+        self._async_wait_s = cfg.get_long(
+            "webserver.request.maxBlockTimeMs") / 1000.0
 
     @staticmethod
     def _configured_security(cfg: CruiseControlConfig) -> SecurityProvider:
-        from .security import BasicSecurityProvider
+        from .security import BasicSecurityProvider, SpnegoSecurityProvider
         cls_name = cfg.get("webserver.security.provider")
         if cls_name.endswith("BasicSecurityProvider"):
             return BasicSecurityProvider(
                 credentials_file=cfg.get("webserver.auth.credentials.file") or "")
+        if cls_name.endswith("SpnegoSecurityProvider"):
+            return SpnegoSecurityProvider.from_config(cfg)
         import importlib
         module, _, name = cls_name.rpartition(".")
         return getattr(importlib.import_module(module), name)()
+
+    def authenticate_readonly(self, headers: dict[str, str],
+                              remote_addr: str = "") -> None:
+        """Auth gate for the non-endpoint GET surfaces (/metrics, /openapi):
+        any authenticated principal may read them; raises AuthenticationError
+        when security is enabled and credentials are missing/invalid."""
+        self._security.authenticate(headers, remote_addr)
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition of the sensor registry + live state gauges
+        (the JMX sensor surface of Sensors.md as a /metrics scrape)."""
+        from ..utils.sensors import SENSORS
+        extra: dict = {}
+        try:
+            st = self._cc.state()
+            ms = st.get("MonitorState", {})
+            extra["monitor_num_valid_windows"] = ms.get("numValidWindows", 0)
+            extra["monitor_monitored_partitions_percentage"] = \
+                ms.get("monitoringCoveragePct", 0.0)
+            extra["monitor_total_num_partitions"] = \
+                ms.get("totalNumPartitions", 0)
+            extra["analyzer_balancedness_score"] = \
+                st.get("AnalyzerState", {}).get("balancednessScore") or 0.0
+            ex = st.get("ExecutorState", {})
+            extra["executor_in_execution"] = \
+                0.0 if ex.get("state") == "NO_TASK_IN_PROGRESS" else 1.0
+            ad = st.get("AnomalyDetectorState", {})
+            for a_type, enabled in (ad.get("selfHealingEnabled") or {}).items():
+                SENSORS.gauge("anomaly_detector_self_healing_enabled",
+                              1.0 if enabled else 0.0,
+                              labels={"anomaly_type": str(a_type)})
+        except Exception:  # noqa: BLE001 — a scrape must not 500 on state
+            LOG.warning("metrics state snapshot failed", exc_info=True)
+        return SENSORS.render(extra)
 
     @property
     def purgatory(self) -> Purgatory:
@@ -107,7 +154,7 @@ class CruiseControlApi:
             principal = self._security.authenticate(headers, remote_addr)
             self._security.authorize(principal, endpoint)
             query = urllib.parse.parse_qs(query_string, keep_blank_values=True)
-            params = parse_parameters(endpoint, query)
+            params = self._parse(endpoint, query)
             review_id = params.pop("review_id", None)
             if self._two_step and endpoint in REVIEWABLE_ENDPOINTS:
                 if review_id is None:
@@ -121,11 +168,18 @@ class CruiseControlApi:
                 # not whatever came with the resubmission (otherwise an
                 # approved dry-run could smuggle in dryrun=false).
                 query_string = info.query
-                params = parse_parameters(endpoint, urllib.parse.parse_qs(
+                params = self._parse(endpoint, urllib.parse.parse_qs(
                     query_string, keep_blank_values=True))
                 params.pop("review_id", None)
             body = self._dispatch(endpoint, params, principal, query_string,
                                   headers, out_headers)
+            if params.get("get_response_schema"):
+                body = {**body, "responseSchema": _schema_of(body)}
+            if params.get("json") is False:
+                # json=false plaintext rendering (ParameterUtils wantJSON;
+                # the reference writes text tables).
+                out_headers["Content-Type"] = "text/plain; charset=utf-8"
+                body = {"__text__": _as_text(body)}
             return 200, body, out_headers
         except ParameterParseError as e:
             return 400, self._error(str(e)), out_headers
@@ -136,6 +190,8 @@ class CruiseControlApi:
             return 403, self._error(str(e)), out_headers
         except ApiError as e:
             return e.status, self._error(str(e)), out_headers
+        except TooManyUserTasksError as e:
+            return 429, self._error(str(e)), out_headers
         except NotEnoughValidWindowsError as e:
             return 503, self._error(f"load model not ready: {e}"), out_headers
         except (KeyError, ValueError) as e:
@@ -143,6 +199,28 @@ class CruiseControlApi:
         except Exception as e:
             LOG.exception("internal error handling %s %s", method, path)
             return 500, self._error(f"{type(e).__name__}: {e}"), out_headers
+
+    # Reference plugin-key spelling for each endpoint
+    # (CruiseControlParametersConfig / CruiseControlRequestConfig).
+    _PLUGIN_KEY = {EndPoint.STOP_PROPOSAL_EXECUTION: "stop.proposal"}
+
+    def _plugin(self, endpoint: EndPoint, suffix: str):
+        key = self._PLUGIN_KEY.get(endpoint,
+                                   endpoint.name.lower().replace("_", "."))
+        spec = self._config.get(f"{key}.{suffix}.class")
+        if not spec:
+            return None
+        from ..config.abstract_config import resolve_class
+        return resolve_class(spec) if isinstance(spec, str) else spec
+
+    def _parse(self, endpoint: EndPoint, query: dict) -> dict:
+        """Config-swappable parameter parsing
+        (CruiseControlParametersConfig reflection): a configured
+        ``<endpoint>.parameters.class`` replaces the built-in schema."""
+        custom = self._plugin(endpoint, "parameters")
+        if custom is not None:
+            return custom()(query) if isinstance(custom, type) else custom(query)
+        return parse_parameters(endpoint, query)
 
     def _resolve(self, method: str, path: str) -> EndPoint:
         if not path.startswith(URL_PREFIX):
@@ -164,6 +242,12 @@ class CruiseControlApi:
                   out_headers: dict[str, str]) -> dict:
         cc = self._cc
         p = params
+        custom = self._plugin(endpoint, "request")
+        if custom is not None:
+            # CruiseControlRequestConfig reflection: the configured request
+            # class handles the endpoint end to end.
+            handler = custom() if isinstance(custom, type) else custom
+            return handler.handle(cc, p, principal)
         if endpoint in _SYNC_ENDPOINTS:
             return self._sync_handler(endpoint, p, principal)
         # Async (model-building) endpoints run as user tasks.
@@ -175,9 +259,10 @@ class CruiseControlApi:
         try:
             exc = info.future.exception(timeout=self._async_wait_s)
         except FuturesTimeoutError:
+            progress = info.progress.to_list() if info.progress else []
             return responses.envelope({
-                "progress": [{"operation": endpoint.name, "step": "pending",
-                              "completionPercentage": 0.0}],
+                "progress": [{"operation": endpoint.name, **p}
+                             for p in progress],
                 "message": f"operation still running; poll with "
                            f"{USER_TASK_HEADER} {info.task_id}"})
         if exc is not None:
@@ -290,6 +375,26 @@ class CruiseControlApi:
         dryrun = p.get("dryrun", True)
         goals = list(p["goals"]) if "goals" in p else None
         reason = p.get("reason", "")
+        verbose = p.get("verbose", False)
+
+        def apply_execution_params():
+            """Per-request execution overrides (ParameterUtils): scoped to
+            the execution this request triggers — the executor snapshots
+            and restores the standing caps/strategy around it."""
+            if dryrun:
+                return
+            conc = {}
+            if "concurrent_partition_movements_per_broker" in p:
+                conc["inter_broker_per_broker"] = \
+                    p["concurrent_partition_movements_per_broker"]
+            if "concurrent_intra_broker_partition_movements" in p:
+                conc["intra_broker_per_broker"] = \
+                    p["concurrent_intra_broker_partition_movements"]
+            if "concurrent_leader_movements" in p:
+                conc["leadership_cluster"] = p["concurrent_leader_movements"]
+            strategies = p.get("replica_movement_strategies", ())
+            if conc or strategies:
+                cc.set_next_execution_overrides(strategies, conc)
 
         def load():
             state, meta = cc.load_monitor.cluster_model()
@@ -303,12 +408,13 @@ class CruiseControlApi:
 
         def proposals():
             return responses.optimization_result(cc.proposals(
-                goals, p.get("ignore_proposal_cache", False)))
+                goals, p.get("ignore_proposal_cache", False)), verbose)
 
         def rebalance():
+            apply_execution_params()
             if p.get("rebalance_disk"):
                 return responses.optimization_result(
-                    cc.rebalance_disk(dryrun, reason=reason))
+                    cc.rebalance_disk(dryrun, reason=reason), verbose)
             return responses.optimization_result(cc.rebalance(
                 goals, dryrun,
                 excluded_topics=p.get("excluded_topics", ()),
@@ -317,23 +423,29 @@ class CruiseControlApi:
                     "exclude_recently_demoted_brokers", False),
                 exclude_recently_removed_brokers=p.get(
                     "exclude_recently_removed_brokers", False),
-                reason=reason))
+                reason=reason), verbose)
 
         def add_broker():
+            apply_execution_params()
             return responses.optimization_result(cc.add_brokers(
-                list(p.get("brokerid", ())), dryrun, goals, reason=reason))
+                list(p.get("brokerid", ())), dryrun, goals, reason=reason),
+                verbose)
 
         def remove_broker():
+            apply_execution_params()
             return responses.optimization_result(cc.remove_brokers(
-                list(p.get("brokerid", ())), dryrun, goals, reason=reason))
+                list(p.get("brokerid", ())), dryrun, goals, reason=reason),
+                verbose)
 
         def demote_broker():
+            apply_execution_params()
             return responses.optimization_result(cc.demote_brokers(
-                list(p.get("brokerid", ())), dryrun, reason=reason))
+                list(p.get("brokerid", ())), dryrun, reason=reason), verbose)
 
         def fix_offline_replicas():
+            apply_execution_params()
             return responses.optimization_result(cc.fix_offline_replicas(
-                dryrun, goals, reason=reason))
+                dryrun, goals, reason=reason), verbose)
 
         def topic_configuration():
             topic = p.get("topic")
@@ -341,17 +453,19 @@ class CruiseControlApi:
             if not topic or rf is None:
                 raise ParameterParseError(
                     "topic_configuration requires topic and replication_factor")
+            apply_execution_params()
             return responses.optimization_result(
                 cc.update_topic_replication_factor([topic], rf, dryrun,
-                                                   reason=reason))
+                                                   reason=reason), verbose)
 
         def remove_disks():
             mapping = p.get("brokerid_and_logdirs")
             if not mapping:
                 raise ParameterParseError(
                     "remove_disks requires brokerid_and_logdirs")
+            apply_execution_params()
             return responses.optimization_result(
-                cc.remove_disks(mapping, dryrun, reason=reason))
+                cc.remove_disks(mapping, dryrun, reason=reason), verbose)
 
         table = {EndPoint.LOAD: load, EndPoint.PARTITION_LOAD: partition_load,
                  EndPoint.PROPOSALS: proposals, EndPoint.REBALANCE: rebalance,
@@ -364,17 +478,91 @@ class CruiseControlApi:
         return table[endpoint]
 
 
+def _schema_of(value: Any) -> Any:
+    """Response-shape description for get_response_schema=true (the
+    reference serves JSON schemas generated from its response classes)."""
+    if isinstance(value, dict):
+        return {k: _schema_of(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_schema_of(value[0])] if value else []
+    if isinstance(value, bool):
+        return "boolean"
+    if isinstance(value, (int, float)):
+        return "number"
+    return "string"
+
+
+def _as_text(value: Any, indent: int = 0) -> str:
+    """Plaintext rendering for json=false (key: value lines, nested
+    structures indented — the text-table role of the reference's
+    plaintext writers)."""
+    pad = " " * indent
+    if isinstance(value, dict):
+        lines = []
+        for k, v in value.items():
+            if isinstance(v, (dict, list)):
+                lines.append(f"{pad}{k}:")
+                lines.append(_as_text(v, indent + 2))
+            else:
+                lines.append(f"{pad}{k}: {v}")
+        return "\n".join(lines)
+    if isinstance(value, list):
+        return "\n".join(_as_text(v, indent) if isinstance(v, (dict, list))
+                         else f"{pad}- {v}" for v in value)
+    return f"{pad}{value}"
+
+
 class _Handler(BaseHTTPRequestHandler):
     api: CruiseControlApi  # set by make_server
 
+    def _serve_text(self, content: bytes, content_type: str) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(content)))
+        self.end_headers()
+        self.wfile.write(content)
+
     def _serve(self, method: str) -> None:
         parsed = urllib.parse.urlparse(self.path)
+        scrape_paths = {"/metrics": "metrics", URL_PREFIX + "/metrics": "metrics",
+                        "/openapi": "openapi", URL_PREFIX + "/openapi": "openapi"}
+        kind = scrape_paths.get(parsed.path) if method == "GET" else None
+        if kind is not None:
+            # These surfaces sit outside the endpoint enum but NOT outside
+            # security: live operational state must not leak unauthenticated.
+            from .security import AuthenticationError
+            try:
+                self.api.authenticate_readonly(dict(self.headers),
+                                               self.client_address[0])
+            except AuthenticationError as e:
+                data = json.dumps({"errorMessage": str(e)}).encode()
+                self.send_response(401)
+                self.send_header("WWW-Authenticate",
+                                 'Basic realm="cruise-control"')
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            if kind == "metrics":
+                self._serve_text(self.api.metrics_text().encode(),
+                                 "text/plain; version=0.0.4; charset=utf-8")
+            else:
+                from .openapi import openapi_yaml
+                self._serve_text(openapi_yaml().encode(), "application/yaml")
+            return
         status, body, extra = self.api.handle(
             method, parsed.path, parsed.query, dict(self.headers),
             self.client_address[0])
-        data = json.dumps(body, indent=2).encode()
+        if isinstance(body, dict) and "__text__" in body:
+            data = (body["__text__"] + "\n").encode()
+            content_type = extra.pop("Content-Type",
+                                     "text/plain; charset=utf-8")
+        else:
+            data = json.dumps(body, indent=2).encode()
+            content_type = extra.pop("Content-Type", "application/json")
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
         for k, v in extra.items():
             self.send_header(k, v)
@@ -402,6 +590,20 @@ def make_server(cc: CruiseControl, host: str | None = None,
         (host or cfg.get("webserver.http.address"),
          port if port is not None else cfg.get_int("webserver.http.port")),
         handler)
+    if cfg.get_boolean("webserver.ssl.enable"):
+        # webserver.ssl.* (WebServerConfig): PEM cert+key via stdlib ssl.
+        import ssl
+        pem = cfg.get("webserver.ssl.keystore.location")
+        if not pem:
+            raise ValueError("webserver.ssl.enable requires "
+                             "webserver.ssl.keystore.location (PEM file)")
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        password = cfg.get("webserver.ssl.keystore.password")
+        ctx.load_cert_chain(pem, password=str(password) if password else None)
+        include = cfg.get_list("webserver.ssl.include.ciphers")
+        if include:
+            ctx.set_ciphers(":".join(include))
+        server.socket = ctx.wrap_socket(server.socket, server_side=True)
     return server, api
 
 

@@ -3,7 +3,8 @@
 Reference parity: servlet/UserTaskManager.java:69-138,222 — maps a client's
 ``User-Task-ID`` header (or a generated UUID) to an OperationFuture so
 long-running operations can be polled; bounded active set, completed-task
-retention, per-endpoint history for the USER_TASKS endpoint.
+retention PER ENDPOINT CLASS (monitor-type vs admin-type task caches,
+UserTaskManager.java:69-138), typed OperationProgress surfaced mid-flight.
 """
 
 from __future__ import annotations
@@ -12,10 +13,23 @@ import threading
 import time
 import uuid as uuid_mod
 from concurrent.futures import Future, ThreadPoolExecutor
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable
 
+from ..utils.progress import OperationProgress, set_current
+
 USER_TASK_HEADER = "User-Task-ID"
+
+# Endpoint-class split (UserTaskManager.TaskState caches): read-only
+# monitor endpoints vs state-changing admin endpoints.
+_MONITOR_ENDPOINTS = {"LOAD", "PARTITION_LOAD", "PROPOSALS", "STATE",
+                      "KAFKA_CLUSTER_STATE", "USER_TASKS", "REVIEW_BOARD",
+                      "PERMISSIONS"}
+
+
+class TooManyUserTasksError(RuntimeError):
+    """Maps to HTTP 429 (the reference's ServletException on exceeding
+    max.active.user.tasks)."""
 
 
 @dataclass
@@ -27,6 +41,7 @@ class UserTaskInfo:
     future: Future
     client: str = ""
     status_override: str | None = None
+    progress: OperationProgress | None = None
 
     @property
     def status(self) -> str:
@@ -38,20 +53,34 @@ class UserTaskInfo:
             return "Cancelled"
         return "CompletedWithError" if self.future.exception() else "Completed"
 
+    @property
+    def is_monitor_task(self) -> bool:
+        return self.endpoint in _MONITOR_ENDPOINTS
+
     def to_dict(self) -> dict:
-        return {"UserTaskId": self.task_id, "RequestURL": f"{self.endpoint}?{self.query}",
-                "Status": self.status, "StartMs": self.start_ms,
-                "ClientIdentity": self.client}
+        out = {"UserTaskId": self.task_id,
+               "RequestURL": f"{self.endpoint}?{self.query}",
+               "Status": self.status, "StartMs": self.start_ms,
+               "ClientIdentity": self.client}
+        if self.progress is not None:
+            out["Progress"] = self.progress.to_list()
+        return out
 
 
 class UserTaskManager:
     def __init__(self, max_active_tasks: int = 25,
                  completed_retention_ms: int = 86_400_000,
-                 num_threads: int = 8):
+                 num_threads: int = 8,
+                 max_cached_completed_monitor_tasks: int = 20,
+                 max_cached_completed_admin_tasks: int = 30,
+                 max_cached_completed_tasks: int = 100):
         self._lock = threading.Lock()
         self._tasks: dict[str, UserTaskInfo] = {}
         self._max_active = max_active_tasks
         self._retention_ms = completed_retention_ms
+        self._max_completed = {True: max_cached_completed_monitor_tasks,
+                               False: max_cached_completed_admin_tasks}
+        self._max_completed_total = max_cached_completed_tasks
         self._pool = ThreadPoolExecutor(max_workers=num_threads,
                                         thread_name_prefix="user-task")
 
@@ -64,6 +93,21 @@ class UserTaskManager:
                     if info.future.done()
                     and now - info.start_ms > self._retention_ms]:
             del self._tasks[tid]
+        # Per-endpoint-class completed caches: keep the newest N completed
+        # monitor-type and admin-type tasks (UserTaskManager.java:69-138).
+        for is_monitor in (True, False):
+            done = sorted((t for t in self._tasks.values()
+                           if t.future.done()
+                           and t.is_monitor_task == is_monitor),
+                          key=lambda t: -t.start_ms)
+            for info in done[self._max_completed[is_monitor]:]:
+                del self._tasks[info.task_id]
+        # Overall completed bound on top of the per-class caches
+        # (max.cached.completed.user.tasks).
+        done = sorted((t for t in self._tasks.values() if t.future.done()),
+                      key=lambda t: -t.start_ms)
+        for info in done[self._max_completed_total:]:
+            del self._tasks[info.task_id]
 
     def get_or_create_task(self, endpoint: str, query: str,
                            work: Callable[[], Any],
@@ -77,12 +121,23 @@ class UserTaskManager:
                 return self._tasks[task_id]
             active = sum(1 for t in self._tasks.values() if not t.future.done())
             if active >= self._max_active:
-                raise RuntimeError(
+                raise TooManyUserTasksError(
                     f"exceeded max active user tasks ({self._max_active})")
             tid = task_id or str(uuid_mod.uuid4())
+            progress = OperationProgress(endpoint)
+
+            def tracked():
+                token = set_current(progress)
+                try:
+                    return work()
+                finally:
+                    progress.done()
+                    token.var.reset(token)
+
             info = UserTaskInfo(task_id=tid, endpoint=endpoint, query=query,
                                 start_ms=int(time.time() * 1000),
-                                future=self._pool.submit(work), client=client)
+                                future=self._pool.submit(tracked),
+                                client=client, progress=progress)
             self._tasks[tid] = info
             return info
 

@@ -98,6 +98,41 @@ def _definition() -> ConfigDef:
              "Capacity JSON file (DISK MB, CPU %, NW KB/s; JBOD maps).")
     d.define("monitor.state.update.interval.ms", T.LONG, 30_000, Range.at_least(1), I.LOW,
              "Monitor state refresh cadence.")
+    d.define("metric.sampler.partition.assignor.class", T.CLASS,
+             "cruise_control_tpu.monitor.sampling.fetcher.DefaultPartitionAssignor",
+             None, I.LOW, "Partition→fetcher assignment policy.")
+    d.define("fetch.metric.samples.max.retry.count", T.INT, 5,
+             Range.at_least(0), I.LOW, "Sampling fetch retries per window.")
+    d.define("skip.loading.samples", T.BOOLEAN, False, None, I.LOW,
+             "Skip the warm-start sample replay at startup.")
+    d.define("sampling.allow.cpu.capacity.estimation", T.BOOLEAN, True, None,
+             I.LOW, "Estimate CPU capacity from cores when unset.")
+    d.define("sample.partition.metric.store.on.execution.class", T.CLASS,
+             None, None, I.LOW,
+             "Extra store receiving samples gathered mid-execution.")
+    d.define("use.linear.regression.model", T.BOOLEAN, False, None, I.LOW,
+             "CPU estimation via the trained linear model instead of the "
+             "static coefficients.")
+    d.define("linear.regression.model.cpu.util.bucket.size", T.INT, 5,
+             Range.between(1, 100), I.LOW,
+             "CPU-utilization bucket width for training sample balance.")
+    d.define("leader.network.inbound.weight.for.cpu.util", T.DOUBLE, 0.6,
+             Range.at_least(0), I.LOW,
+             "Static CPU model coefficient (ModelParameters.java).")
+    d.define("leader.network.outbound.weight.for.cpu.util", T.DOUBLE, 0.1,
+             Range.at_least(0), I.LOW, "Static CPU model coefficient.")
+    d.define("follower.network.inbound.weight.for.cpu.util", T.DOUBLE, 0.3,
+             Range.at_least(0), I.LOW, "Static CPU model coefficient.")
+    d.define("topic.config.provider.class", T.CLASS, None, None, I.LOW,
+             "Pluggable topic-config source (default: the admin backend).")
+    d.define("zookeeper.security.enabled", T.BOOLEAN, False, None, I.LOW,
+             "Legacy ZK flag; accepted for config parity, ZK paths are not "
+             "implemented (metadata polling replaces the ZK watcher).")
+    d.define("failed.brokers.zk.path", T.STRING, None, None, I.LOW,
+             "Legacy ZK persistence path; the file store replaces it.")
+    d.define("network.client.provider.class", T.CLASS, None, None, I.LOW,
+             "Network client factory override (reference plumbing; the "
+             "kafka-python binding manages its own clients).")
 
     # --- Analyzer (AnalyzerConfig.java) ---
     d.define("goals", T.LIST, list(DEFAULT_GOALS), None, I.HIGH,
@@ -153,6 +188,50 @@ def _definition() -> ConfigDef:
     d.define("concurrency.adjuster.interval.ms", T.LONG, 1_000,
              Range.at_least(1), I.LOW,
              "ConcurrencyAdjuster evaluation interval.")
+    d.define("concurrency.adjuster.min.isr.check.enabled", T.BOOLEAN, True,
+             None, I.LOW, "Consult (At/Under)MinISR state when adjusting.")
+    d.define("concurrency.adjuster.min.isr.retention.ms", T.LONG, 30_000,
+             Range.at_least(1), I.LOW,
+             "TopicMinIsrCache entry TTL (TopicMinIsrCache.java).")
+    d.define("concurrency.adjuster.min.isr.cache.size", T.INT, 10_000,
+             Range.at_least(1), I.LOW, "TopicMinIsrCache size bound.")
+    d.define("concurrency.adjuster.inter.broker.replica.enabled", T.BOOLEAN,
+             True, None, I.LOW, "Adjust inter-broker movement caps.")
+    d.define("concurrency.adjuster.leadership.enabled", T.BOOLEAN, True, None,
+             I.LOW, "Adjust leadership movement caps.")
+    d.define("concurrency.adjuster.max.leadership.movements", T.INT, 1_000,
+             Range.at_least(1), I.LOW, "Adjuster ceiling for leadership.")
+    d.define("concurrency.adjuster.min.leadership.movements", T.INT, 100,
+             Range.at_least(1), I.LOW, "Adjuster floor for leadership.")
+    d.define("num.concurrent.leader.movements.per.broker", T.INT, 250,
+             Range.at_least(1), I.MEDIUM,
+             "Per-broker bound on leadership movements per batch.")
+    d.define("min.execution.progress.check.interval.ms", T.LONG, 5_000,
+             Range.at_least(1), I.LOW,
+             "Floor for the progress-check interval override.")
+    d.define("auto.stop.external.agent", T.BOOLEAN, True, None, I.MEDIUM,
+             "Cancel reassignments started by an external tool before "
+             "executing (maybeStopExternalAgent:1261).")
+    d.define("list.partition.reassignment.timeout.ms", T.LONG, 60_000,
+             Range.at_least(1), I.LOW, "listPartitionReassignments timeout.")
+    d.define("list.partition.reassignment.max.attempts", T.INT, 3,
+             Range.at_least(1), I.LOW, "listPartitionReassignments retries.")
+    d.define("logdir.response.timeout.ms", T.LONG, 10_000, Range.at_least(1),
+             I.LOW, "DescribeLogDirs per-broker timeout.")
+    d.define("admin.client.request.timeout.ms", T.LONG, 30_000,
+             Range.at_least(1), I.LOW, "AdminClient request timeout.")
+    d.define("executor.notifier.class", T.CLASS,
+             "cruise_control_tpu.executor.notifier.LoggingExecutorNotifier",
+             None, I.LOW, "ExecutorNotifier implementation.")
+    d.define("demotion.history.retention.time.ms", T.LONG, 86_400_000,
+             Range.at_least(1), I.LOW,
+             "How long recently-demoted brokers stay excluded.")
+    d.define("removal.history.retention.time.ms", T.LONG, 86_400_000,
+             Range.at_least(1), I.LOW,
+             "How long recently-removed brokers stay excluded.")
+    d.define("slow.task.alerting.backoff.ms", T.LONG, 60_000,
+             Range.at_least(0), I.LOW,
+             "Backoff between slow-task alerts.")
     d.define("solver.chain.fused", T.BOOLEAN, True, None, I.MEDIUM,
              "TPU solver: run the whole goal chain in one device dispatch "
              "(chain.chain_optimize_full) instead of one dispatch per goal "
@@ -166,6 +245,41 @@ def _definition() -> ConfigDef:
              "Extra weight for hard goals in balancedness score.")
     d.define("fast.mode.per.broker.move.timeout.ms", T.LONG, 500, Range.at_least(1), I.LOW,
              "Fast-mode per-broker time budget.")
+    d.define("intra.broker.goals", T.LIST,
+             ["IntraBrokerDiskCapacityGoal", "IntraBrokerDiskUsageDistributionGoal"],
+             None, I.LOW, "Goal chain for rebalance_disk/remove_disks.")
+    d.define("topics.excluded.from.partition.movement", T.STRING, "", None,
+             I.MEDIUM, "Regex of topics never moved.")
+    d.define("topic.replica.count.balance.min.gap", T.INT, 2,
+             Range.at_least(0), I.LOW,
+             "TopicReplicaDistribution band minimum width.")
+    d.define("topic.replica.count.balance.max.gap", T.INT, 40,
+             Range.at_least(0), I.LOW,
+             "TopicReplicaDistribution band maximum width.")
+    d.define("topics.with.min.leaders.per.broker", T.STRING, "", None, I.LOW,
+             "Regex of topics MinTopicLeadersPerBrokerGoal applies to.")
+    d.define("min.topic.leaders.per.broker", T.INT, 1, Range.at_least(0),
+             I.LOW, "Leader floor per broker for matched topics.")
+    d.define("allow.capacity.estimation.on.proposal.precompute", T.BOOLEAN,
+             True, None, I.LOW,
+             "Precompute passes may estimate missing capacities.")
+    d.define("optimization.options.generator.class", T.CLASS, None, None,
+             I.LOW, "OptimizationOptions generator plugin.")
+    d.define("broker.set.resolver.class", T.CLASS, None, None, I.LOW,
+             "BrokerSet membership resolver plugin.")
+    d.define("broker.set.assignment.policy.class", T.CLASS, None, None, I.LOW,
+             "BrokerSet assignment policy plugin.")
+    d.define("broker.set.config.file", T.STRING, "config/brokerSets.json",
+             None, I.LOW, "BrokerSet definitions.")
+    d.define("overprovisioned.min.brokers", T.INT, 3, Range.at_least(1),
+             I.LOW, "Provisioner floor before recommending removal.")
+    d.define("overprovisioned.max.replicas.per.broker", T.LONG, 1_500,
+             Range.at_least(1), I.LOW,
+             "Replica ceiling that still counts as over-provisioned.")
+    d.define("overprovisioned.min.extra.racks", T.INT, 2, Range.at_least(0),
+             I.LOW, "Extra racks required to call a cluster over-provisioned.")
+    d.define("metadata.factor.exponent", T.DOUBLE, 1.0, Range.at_least(0),
+             I.LOW, "Metadata-scale exponent in provision recommendations.")
 
     # --- Executor (ExecutorConfig.java) ---
     d.define("num.concurrent.partition.movements.per.broker", T.INT, 10, Range.at_least(1),
@@ -218,6 +332,66 @@ def _definition() -> ConfigDef:
     d.define("self.healing.metric.anomaly.enabled", T.BOOLEAN, False, None, I.MEDIUM, "")
     d.define("self.healing.topic.anomaly.enabled", T.BOOLEAN, False, None, I.MEDIUM, "")
     d.define("self.healing.maintenance.event.enabled", T.BOOLEAN, False, None, I.MEDIUM, "")
+    d.define("maintenance.event.reader.class", T.CLASS,
+             "cruise_control_tpu.detector.maintenance.InMemoryMaintenanceEventReader",
+             None, I.MEDIUM,
+             "Pluggable maintenance-plan source "
+             "(MaintenanceEventTopicReader analogue: "
+             "detector.maintenance_serde.TopicMaintenanceEventReader reads "
+             "versioned plans from a Kafka topic; the file reader tails a "
+             "JSON-lines file).")
+    d.define("maintenance.event.topic", T.STRING,
+             "__CruiseControlMaintenanceEvent", None, I.LOW,
+             "Topic the maintenance-plan reader consumes.")
+    d.define("maintenance.event.enable.idempotence", T.BOOLEAN, True, None,
+             I.LOW, "Drop duplicate maintenance plans (IdempotenceCache).")
+    d.define("maintenance.event.idempotence.retention.ms", T.LONG, 3_600_000,
+             Range.at_least(1), I.LOW, "Idempotence-cache retention window.")
+    d.define("maintenance.event.max.idempotence.cache.size", T.INT, 25,
+             Range.at_least(1), I.LOW, "Idempotence-cache size bound.")
+    d.define("maintenance.event.stop.ongoing.execution", T.BOOLEAN, False,
+             None, I.LOW,
+             "Maintenance plans may stop an in-flight execution.")
+    d.define("broker.failure.detection.interval.ms", T.LONG, None, None,
+             I.LOW, "Broker-failure detector interval "
+             "(None = anomaly.detection.interval.ms).")
+    d.define("disk.failure.detection.interval.ms", T.LONG, None, None, I.LOW,
+             "Disk-failure detector interval (None = shared default).")
+    d.define("topic.anomaly.detection.interval.ms", T.LONG, None, None, I.LOW,
+             "Topic-anomaly detector interval (None = shared default).")
+    d.define("kafka.broker.failure.detection.enable", T.BOOLEAN, True, None,
+             I.LOW, "Metadata-polling broker failure detection (the ZK "
+             "watcher variant is legacy and not implemented).")
+    d.define("fixable.failed.broker.count.threshold", T.INT, 10,
+             Range.at_least(0), I.LOW,
+             "Self-healing declines when more brokers than this failed.")
+    d.define("fixable.failed.broker.percentage.threshold", T.DOUBLE, 0.4,
+             Range.between(0, 1), I.LOW,
+             "Self-healing declines above this failed-broker fraction.")
+    d.define("self.healing.goals", T.LIST, [], None, I.LOW,
+             "Goal subset used when self-healing (empty = default goals).")
+    d.define("self.healing.exclude.recently.demoted.brokers", T.BOOLEAN, True,
+             None, I.LOW, "Self-healing skips recently demoted brokers for "
+             "leadership.")
+    d.define("self.healing.exclude.recently.removed.brokers", T.BOOLEAN, True,
+             None, I.LOW, "Self-healing skips recently removed brokers for "
+             "replica placement.")
+    d.define("num.cached.recent.anomaly.states", T.INT, 10, Range.at_least(1),
+             I.LOW, "Recent anomalies kept per type in the detector state.")
+    d.define("anomaly.detection.allow.capacity.estimation", T.BOOLEAN, True,
+             None, I.LOW, "Detectors may estimate missing broker capacity.")
+    d.define("metric.anomaly.class", T.CLASS, None, None, I.LOW,
+             "Metric-anomaly implementation override.")
+    d.define("goal.violations.class", T.CLASS, None, None, I.LOW,
+             "Goal-violation anomaly implementation override.")
+    d.define("broker.failures.class", T.CLASS, None, None, I.LOW,
+             "Broker-failure anomaly implementation override.")
+    d.define("disk.failures.class", T.CLASS, None, None, I.LOW,
+             "Disk-failure anomaly implementation override.")
+    d.define("maintenance.event.class", T.CLASS, None, None, I.LOW,
+             "Maintenance-event anomaly implementation override.")
+    d.define("topic.anomaly.finder.class", T.LIST, None, None, I.LOW,
+             "Topic-anomaly finder chain.")
     d.define("broker.failure.alert.threshold.ms", T.LONG, 900_000, Range.at_least(0), I.MEDIUM,
              "Age at which a broker failure alerts.")
     d.define("broker.failure.self.healing.threshold.ms", T.LONG, 1_800_000, Range.at_least(0),
@@ -266,6 +440,102 @@ def _definition() -> ConfigDef:
              "UserTaskManager active task cap.")
     d.define("completed.user.task.retention.time.ms", T.LONG, 86_400_000, Range.at_least(1),
              I.LOW, "Completed task retention.")
+    d.define("max.cached.completed.user.tasks", T.INT, 100, Range.at_least(1),
+             I.LOW, "Completed task cache size (default retention class).")
+    d.define("max.cached.completed.kafka.monitor.user.tasks", T.INT, 20,
+             Range.at_least(1), I.LOW,
+             "Per-endpoint-class retention: monitor-type tasks "
+             "(UserTaskManager.java:69-138).")
+    d.define("max.cached.completed.kafka.admin.user.tasks", T.INT, 30,
+             Range.at_least(1), I.LOW,
+             "Per-endpoint-class retention: admin-type tasks.")
+    d.define("webserver.request.maxBlockTimeMs", T.LONG, 10_000,
+             Range.at_least(0), I.LOW,
+             "How long a request blocks inline before returning 202 + "
+             "User-Task-ID (the async wait).")
+    d.define("webserver.session.maxExpiryTimeMs", T.LONG, 60_000,
+             Range.at_least(1), I.LOW, "Session retention.")
+    d.define("webserver.session.path", T.STRING, "/", None, I.LOW,
+             "Session cookie path.")
+    d.define("webserver.accesslog.enabled", T.BOOLEAN, True, None, I.LOW,
+             "Log one line per handled request.")
+    d.define("webserver.ui.diskpath", T.STRING, None, None, I.LOW,
+             "Static Web-UI directory served at / (none disables).")
+    d.define("webserver.ui.urlprefix", T.STRING, "/*", None, I.LOW,
+             "URL prefix of the served UI.")
+    d.define("webserver.http.cors.enabled", T.BOOLEAN, False, None, I.LOW,
+             "CORS headers on/off.")
+    d.define("webserver.http.cors.origin", T.STRING, "*", None, I.LOW,
+             "Access-Control-Allow-Origin value.")
+    d.define("webserver.http.cors.allowmethods", T.STRING, "OPTIONS,GET,POST",
+             None, I.LOW, "Access-Control-Allow-Methods value.")
+    d.define("webserver.http.cors.exposeheaders", T.STRING, "User-Task-ID",
+             None, I.LOW, "Access-Control-Expose-Headers value.")
+    d.define("webserver.ssl.enable", T.BOOLEAN, False, None, I.MEDIUM,
+             "Serve HTTPS (stdlib ssl; keystore location is a PEM "
+             "cert+key file here, not a JKS).")
+    d.define("webserver.ssl.keystore.location", T.STRING, None, None, I.MEDIUM,
+             "PEM file with certificate + private key.")
+    d.define("webserver.ssl.keystore.password", T.PASSWORD, None, None, I.LOW,
+             "Private-key password.")
+    d.define("webserver.ssl.keystore.type", T.STRING, "PEM", None, I.LOW,
+             "Keystore format (PEM only in this implementation).")
+    d.define("webserver.ssl.key.password", T.PASSWORD, None, None, I.LOW,
+             "Key password (alias of keystore.password for PEM).")
+    d.define("webserver.ssl.protocol", T.STRING, "TLS", None, I.LOW,
+             "SSL protocol.")
+    d.define("webserver.ssl.include.ciphers", T.LIST, None, None, I.LOW,
+             "Cipher allowlist (None = library default).")
+    d.define("webserver.ssl.exclude.ciphers", T.LIST, None, None, I.LOW,
+             "Cipher denylist.")
+    d.define("webserver.ssl.include.protocols", T.LIST, None, None, I.LOW,
+             "Protocol allowlist.")
+    d.define("webserver.ssl.exclude.protocols", T.LIST, None, None, I.LOW,
+             "Protocol denylist.")
+    d.define("two.step.purgatory.retention.time.ms", T.LONG, 1_209_600_000,
+             Range.at_least(1), I.LOW,
+             "How long un-reviewed requests stay parked (Purgatory.java).")
+    d.define("two.step.purgatory.max.requests", T.INT, 25, Range.at_least(1),
+             I.LOW, "Max parked requests.")
+    d.define("vertx.enabled", T.BOOLEAN, False, None, I.LOW,
+             "Reference dual-stack flag; this implementation has one HTTP "
+             "stack, so the flag is accepted and ignored.")
+    d.define("jwt.authentication.provider.url", T.STRING, None, None, I.LOW,
+             "Login redirect URL for JWT auth (token issuer).")
+    d.define("jwt.cookie.name", T.STRING, None, None, I.LOW,
+             "Cookie carrying the JWT (falls back to Bearer header).")
+    d.define("jwt.auth.certificate.location", T.STRING, None, None, I.LOW,
+             "Public key for token verification (RS256 requires the "
+             "cryptography package; HS256 secret file otherwise).")
+    d.define("jwt.expected.audiences", T.LIST, None, None, I.LOW,
+             "Accepted aud claims (None = any).")
+    d.define("spnego.principal", T.STRING, None, None, I.LOW,
+             "Kerberos service principal for SPNEGO.")
+    d.define("spnego.keytab.file", T.STRING, None, None, I.LOW,
+             "Keytab backing the service principal.")
+    d.define("trusted.proxy.services", T.LIST, None, None, I.LOW,
+             "Service principals allowed to proxy (doAs) requests.")
+    d.define("trusted.proxy.services.ip.regex", T.STRING, None, None, I.LOW,
+             "Source-address pattern a trusted proxy must match.")
+    d.define("trusted.proxy.spnego.fallback.enabled", T.BOOLEAN, False, None,
+             I.LOW, "Fall back to SPNEGO auth when the caller is not a "
+             "trusted proxy.")
+
+    # --- Per-endpoint plugin bindings (CruiseControlParametersConfig /
+    # CruiseControlRequestConfig: every endpoint's parameter parser and
+    # request handler are config-swappable classes; None = built-in) ---
+    for ep in ("bootstrap", "train", "load", "partition.load", "proposals",
+               "state", "kafka.cluster.state", "user.tasks", "review.board",
+               "permissions", "add.broker", "remove.broker",
+               "fix.offline.replicas", "rebalance", "stop.proposal",
+               "pause.sampling", "resume.sampling", "demote.broker", "admin",
+               "review", "topic.configuration", "rightsize", "remove.disks"):
+        d.define(f"{ep}.parameters.class", T.CLASS, None, None, I.LOW,
+                 f"Parameter-parsing plugin for the {ep} endpoint "
+                 "(callable(query) -> params dict).")
+        d.define(f"{ep}.request.class", T.CLASS, None, None, I.LOW,
+                 f"Request-handling plugin for the {ep} endpoint "
+                 "(instance.handle(facade, params, principal) -> body).")
 
     # --- TPU / device placement (new; no reference equivalent) ---
     d.define("tpu.mesh.axis.candidates", T.STRING, "candidates", None, I.LOW,

@@ -1,0 +1,120 @@
+"""Versioned maintenance-plan serde + Kafka-topic reader.
+
+Reference parity: detector/MaintenancePlanSerde.java (JSON envelope with
+``planType``/``version``/``crc``; deserialization verifies the type is
+known, the version is supported, and the content CRC matches) and
+MaintenanceEventTopicReader.java:350 (consume plans submitted by an ops
+pipeline from a Kafka topic between poll intervals).
+
+The wire format is a one-line JSON envelope::
+
+    {"planType": "REMOVE_BROKER", "version": 1, "crc": 1234567890,
+     "content": {"timeMs": ..., "brokers": [...], "topicsByRF": {...}}}
+
+CRC = crc32 of the canonical (sorted-keys, compact) content JSON — same
+role as MaintenancePlanSerde's content crc: a plan corrupted in transit or
+hand-edited in place is rejected rather than executed.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import zlib
+from typing import Callable, Iterable
+
+from .anomaly import MaintenanceEvent, MaintenanceEventType
+
+LOG = logging.getLogger(__name__)
+
+MAINTENANCE_TOPIC = "__CruiseControlMaintenanceEvent"
+
+# Latest supported envelope version per plan type
+# (MaintenancePlanSerde.verifyTypeAndVersion: each plan class carries a
+# LATEST_SUPPORTED_VERSION; newer producers are rejected, older accepted).
+LATEST_SUPPORTED_VERSION: dict[str, int] = {
+    t.value: 1 for t in MaintenanceEventType
+}
+
+
+class PlanSerdeError(ValueError):
+    """Unknown type, unsupported version, or CRC mismatch."""
+
+
+def _canonical(content: dict) -> bytes:
+    return json.dumps(content, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def serialize_plan(event: MaintenanceEvent, time_ms: int | None = None,
+                   version: int = 1) -> bytes:
+    content = {
+        "timeMs": time_ms if time_ms is not None else int(time.time() * 1000),
+        "brokers": sorted(int(b) for b in event.broker_ids),
+        "topicsByRF": {str(rf): sorted(ts)
+                       for rf, ts in event.topics_by_rf.items()},
+    }
+    return json.dumps({
+        "planType": event.event_type.value,
+        "version": version,
+        "crc": zlib.crc32(_canonical(content)),
+        "content": content,
+    }).encode()
+
+
+def deserialize_plan(payload: bytes) -> MaintenanceEvent:
+    try:
+        d = json.loads(payload.decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise PlanSerdeError(f"undecodable maintenance plan: {e}") from e
+    plan_type = d.get("planType")
+    latest = LATEST_SUPPORTED_VERSION.get(plan_type)
+    if latest is None:
+        raise PlanSerdeError(f"unknown maintenance plan type {plan_type!r}")
+    version = d.get("version")
+    if not isinstance(version, int) or version < 1 or version > latest:
+        raise PlanSerdeError(
+            f"unsupported {plan_type} plan version {version!r} "
+            f"(latest supported {latest})")
+    content = d.get("content")
+    if not isinstance(content, dict):
+        raise PlanSerdeError("maintenance plan without content")
+    crc = zlib.crc32(_canonical(content))
+    if crc != d.get("crc"):
+        raise PlanSerdeError(
+            f"maintenance plan crc mismatch: stored {d.get('crc')!r}, "
+            f"computed {crc}")
+    return MaintenanceEvent(
+        event_type=MaintenanceEventType(plan_type),
+        broker_ids=list(content.get("brokers", [])),
+        topics_by_rf={int(rf): list(ts)
+                      for rf, ts in (content.get("topicsByRF") or {}).items()})
+
+
+class TopicMaintenanceEventReader:
+    """MaintenanceEventReader over a maintenance-plan topic.
+
+    ``transport`` needs one method — ``poll(start_ms, end_ms) ->
+    Iterable[bytes]`` — the same shape as the metrics-topic transport
+    (kafka/transport.py KafkaMetricsTransport), so the live binding and the
+    in-memory fake both plug in. Undecodable/corrupt plans are dropped with
+    a log line (MaintenanceEventTopicReader skips bad records)."""
+
+    def __init__(self, transport, now_ms: Callable[[], int] | None = None):
+        self._transport = transport
+        self._now_ms = now_ms or (lambda: int(time.time() * 1000))
+        self._last_poll_ms = 0
+
+    def read_events(self) -> list[MaintenanceEvent]:
+        end = self._now_ms()
+        payloads: Iterable[bytes] = self._transport.poll(
+            self._last_poll_ms, end)
+        self._last_poll_ms = end
+        events: list[MaintenanceEvent] = []
+        for payload in payloads:
+            try:
+                events.append(deserialize_plan(payload))
+            except PlanSerdeError as e:
+                LOG.warning("dropping bad maintenance plan: %s", e)
+        return events

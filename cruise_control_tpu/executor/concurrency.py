@@ -113,6 +113,17 @@ class ExecutionConcurrencyManager:
                 lcap = min(self._base.leadership_cluster, lcap + 100)
             self._caps.leadership_cluster = lcap
 
+    def snapshot(self) -> ConcurrencyCaps:
+        with self._lock:
+            return dataclasses.replace(self._caps)
+
+    def restore(self, caps: ConcurrencyCaps) -> None:
+        """Undo per-execution overrides (the reference resets requested
+        concurrency when the execution finishes)."""
+        with self._lock:
+            for f in dataclasses.fields(ConcurrencyCaps):
+                setattr(self._caps, f.name, getattr(caps, f.name))
+
     def state(self) -> dict:
         with self._lock:
             return {

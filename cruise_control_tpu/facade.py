@@ -106,11 +106,12 @@ class CruiseControl:
         self._notifier = notifier or SelfHealingNotifier(config)
         self._anomaly_detector = AnomalyDetectorManager(
             config, self._notifier, facade=self)
-        self.maintenance_reader = InMemoryMaintenanceEventReader()
+        self.maintenance_reader = self._configured_maintenance_reader(config)
         self._wire_detectors()
 
         self._proposal_cache: tuple[int, float, OptimizerResult] | None = None
         self._proposal_lock = threading.Lock()
+        self._next_execution_overrides: tuple = (None, {})
         self._started = False
         # Executor.java demotion/removal history consumed by the
         # exclude_recently_* request parameters and the ADMIN drop_* params.
@@ -120,6 +121,26 @@ class CruiseControl:
         self.provisioner = BasicProvisioner()
 
     # -- wiring ------------------------------------------------------------
+    @staticmethod
+    def _configured_maintenance_reader(config: CruiseControlConfig):
+        """maintenance.event.reader.class plugin resolution
+        (AnomalyDetectorConfig.MAINTENANCE_EVENT_READER_CLASS_CONFIG). The
+        default in-memory reader takes no arguments; custom readers are
+        instantiated bare and may read their own config via attributes."""
+        from .config.abstract_config import resolve_class
+        spec = config.get("maintenance.event.reader.class")
+        cls = resolve_class(spec) if isinstance(spec, str) else spec
+        if cls is InMemoryMaintenanceEventReader or cls is None:
+            return InMemoryMaintenanceEventReader()
+        try:
+            return cls()
+        except TypeError:
+            # Reader needs deployment wiring (e.g. a Kafka transport):
+            # leave construction to the embedder, fall back in-memory.
+            LOG.warning("maintenance reader %s needs explicit construction; "
+                        "using the in-memory reader", spec)
+            return InMemoryMaintenanceEventReader()
+
     def _wire_detectors(self) -> None:
         cfg, report = self._config, self._anomaly_detector.report
         interval = cfg.get_long("anomaly.detection.interval.ms")
@@ -232,13 +253,29 @@ class CruiseControl:
         names = list(goals) if goals else None
         return goals_by_priority(self._config, names)
 
+    def set_next_execution_overrides(
+            self, replica_movement_strategies: Sequence[str] = (),
+            concurrency: Mapping[str, int] | None = None) -> None:
+        """Per-request execution overrides (ParameterUtils): consumed by the
+        next execution this facade starts and restored when it finishes —
+        they never mutate the standing configuration."""
+        strategy = None
+        if replica_movement_strategies:
+            from .executor.strategy import strategy_chain
+            strategy = strategy_chain(list(replica_movement_strategies))
+        self._next_execution_overrides = (strategy, dict(concurrency or {}))
+
     def _maybe_execute(self, result: OptimizerResult, dryrun: bool,
                        operation: str, reason: str, uuid: str = "") -> bool:
         if dryrun or not result.proposals:
             return False
         OPERATION_LOG.info("%s executing %d proposals (reason: %s)",
                            operation, len(result.proposals), reason)
-        self._executor.execute_proposals(result.proposals, uuid=uuid)
+        strategy, concurrency = self._next_execution_overrides
+        self._next_execution_overrides = (None, {})
+        self._executor.execute_proposals(
+            result.proposals, uuid=uuid, strategy=strategy,
+            concurrency_overrides=concurrency or None)
         return True
 
     # -- operations (the runnables) ----------------------------------------

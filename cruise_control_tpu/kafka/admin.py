@@ -126,8 +126,24 @@ class KafkaAdminBackend:
             [ConfigResource(resource_type, str(n)) for n in names])
         out = {}
         for r in resp:
-            for res in r.resources:
-                _err, _msg, _rtype, rname, entries = res[:5]
+            resources = getattr(r, "resources", None)
+            if resources is None:
+                raise RuntimeError(
+                    f"unexpected DescribeConfigs response shape: {type(r)!r} "
+                    "has no 'resources' field (kafka-python version drift?)")
+            for res in resources:
+                # DescribeConfigsResponse resource tuple:
+                # (error_code, error_message, resource_type, resource_name,
+                #  config_entries). Named access when available, positional
+                #  fallback with an explicit arity check.
+                if hasattr(res, "resource_name"):
+                    rname, entries = res.resource_name, res.config_entries
+                else:
+                    if len(res) < 5:
+                        raise RuntimeError(
+                            f"unexpected DescribeConfigs resource arity "
+                            f"{len(res)}: {res!r}")
+                    _err, _msg, _rtype, rname, entries = res[:5]
                 out[rname] = {e[0]: e[1] for e in entries}
         return out
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+import time
 from typing import Mapping
 
 import numpy as np
@@ -112,8 +113,9 @@ class LoadMonitor:
         if samplers is None:
             from .sampling.sampler import NoopSampler
             samplers = [NoopSampler()]
-        self._fetcher = MetricFetcherManager(samplers, self._partition_agg,
-                                             self._broker_agg, store)
+        self._fetcher = MetricFetcherManager(
+            samplers, self._partition_agg, self._broker_agg, store,
+            num_fetchers=config.get_int("num.metric.fetchers"))
         self._task_runner = LoadMonitorTaskRunner(
             self._fetcher, self._metadata, store,
             sampling_interval_ms=config.get("metric.sampling.interval.ms"))
@@ -229,11 +231,21 @@ class LoadMonitor:
             min_valid_windows=1,
             min_monitored_partitions_percentage=self._config.get(
                 "min.valid.partition.ratio"))
+        t0 = time.time()
+        from ..utils.progress import step
+        step("WaitingForClusterModel")
         with self._model_semaphore:
+            step("AggregatingMetrics")
             partitions = self._metadata.describe_partitions()
             alive = self._metadata.alive_brokers()
             agg = self._partition_agg.aggregate(self._aggregation_options(req))
-            return self._build(partitions, alive, agg)
+            step("GeneratingClusterModel")
+            built = self._build(partitions, alive, agg)
+        # cluster-model-creation-timer (LoadMonitor.java:177).
+        from ..utils.sensors import SENSORS
+        SENSORS.record_timer("monitor_cluster_model_creation",
+                             time.time() - t0)
+        return built
 
     def _build(self, partitions: Mapping[tuple[str, int], PartitionState],
                alive: set[int], agg: AggregationResult,

@@ -44,9 +44,22 @@ class MetricFetcherManager:
     def __init__(self, samplers: list[MetricSampler],
                  partition_aggregator, broker_aggregator,
                  sample_store: SampleStore,
-                 assignor: Callable = default_partition_assignor):
+                 assignor: Callable = default_partition_assignor,
+                 num_fetchers: int | None = None):
         if not samplers:
             raise ValueError("at least one sampler required")
+        # num.metric.fetchers fan-out (MetricFetcherManager.java:37-110):
+        # the reference runs N fetcher threads each with its own sampler
+        # instance. With one configured sampler and N > 1, clone it per
+        # fetcher when it supports clone(); a sampler without clone() is
+        # shared across threads (must then be thread-safe, like the
+        # synthetic and noop samplers).
+        n = num_fetchers or len(samplers)
+        if len(samplers) == 1 and n > 1:
+            base = samplers[0]
+            clone = getattr(base, "clone", None)
+            samplers = [base] + [clone() if clone else base
+                                 for _ in range(n - 1)]
         self._samplers = samplers
         self._partition_agg = partition_aggregator
         self._broker_agg = broker_aggregator

@@ -1,0 +1,67 @@
+"""Typed operation progress (async/progress/OperationProgress.java).
+
+A user task carries an ``OperationProgress``; the facade/monitor/analyzer
+record typed steps as the operation advances, and the USER_TASKS endpoint +
+the 202 in-flight response surface them mid-flight. The current task's
+progress travels via a ``contextvars.ContextVar`` so deep layers (the load
+monitor, the optimizer) need no plumbing — the same role as the reference
+passing the OperationProgress object down its runnables.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+
+_current: contextvars.ContextVar["OperationProgress | None"] = \
+    contextvars.ContextVar("operation_progress", default=None)
+
+# Step names mirror the reference's typed steps (OperationProgress.java):
+# Pending, RetrievingMetrics, AggregatingMetrics, GeneratingClusterModel,
+# OptimizationForGoal, WaitingForClusterModel.
+
+
+class OperationProgress:
+    def __init__(self, operation: str = ""):
+        self.operation = operation
+        self._lock = threading.Lock()
+        self._steps: list[dict] = []
+
+    def start_step(self, description: str) -> None:
+        now = time.time()
+        with self._lock:
+            if self._steps:
+                self._steps[-1].setdefault("durationS", round(
+                    now - self._steps[-1]["startS"], 3))
+                self._steps[-1]["completionPercentage"] = 100.0
+            self._steps.append({"step": description, "startS": now,
+                                "completionPercentage": 0.0})
+
+    def done(self) -> None:
+        with self._lock:
+            if self._steps:
+                self._steps[-1].setdefault("durationS", round(
+                    time.time() - self._steps[-1]["startS"], 3))
+                self._steps[-1]["completionPercentage"] = 100.0
+
+    def to_list(self) -> list[dict]:
+        with self._lock:
+            return [{"step": s["step"],
+                     "completionPercentage": s["completionPercentage"],
+                     **({"durationS": s["durationS"]} if "durationS" in s
+                        else {})}
+                    for s in self._steps] or \
+                [{"step": "Pending", "completionPercentage": 0.0}]
+
+
+def set_current(progress: OperationProgress | None):
+    return _current.set(progress)
+
+
+def step(description: str) -> None:
+    """Record a step on the ambient operation's progress (no-op outside a
+    tracked user task)."""
+    progress = _current.get()
+    if progress is not None:
+        progress.start_step(description)

@@ -1,0 +1,96 @@
+"""Self-instrumentation sensors → Prometheus text exposition.
+
+Reference parity: the Dropwizard MetricRegistry → JMX domain
+``kafka.cruisecontrol`` (KafkaCruiseControlApp.java:29-32) with ~40
+operational sensors (docs/wiki/User Guide/Sensors.md: valid-windows,
+monitored-partitions-percentage, balancedness-score,
+proposal-computation-timer GoalOptimizer.java:128,
+cluster-model-creation-timer LoadMonitor.java:177, execution
+counts/timers Executor.java:145-148,346). JMX is a JVM-ism; the TPU-era
+export surface is a Prometheus ``/metrics`` endpoint fed by the same
+sensor registry.
+
+Hot-path cost is one dict write per record — no locks on read-modify of
+floats beyond a plain mutex, nothing device-side.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_PREFIX = "kafka_cruisecontrol"
+
+
+class SensorRegistry:
+    """Counters, gauges and timers keyed by (name, labels)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, tuple], float] = {}
+        self._gauges: dict[tuple[str, tuple], float] = {}
+        # name -> (count, total_seconds, last_seconds, max_seconds)
+        self._timers: dict[tuple[str, tuple], tuple[int, float, float, float]] = {}
+
+    @staticmethod
+    def _key(name: str, labels: dict | None) -> tuple[str, tuple]:
+        return name, tuple(sorted((labels or {}).items()))
+
+    def count(self, name: str, value: float = 1.0,
+              labels: dict | None = None) -> None:
+        k = self._key(name, labels)
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0.0) + value
+
+    def gauge(self, name: str, value: float, labels: dict | None = None) -> None:
+        with self._lock:
+            self._gauges[self._key(name, labels)] = float(value)
+
+    def record_timer(self, name: str, seconds: float,
+                     labels: dict | None = None) -> None:
+        k = self._key(name, labels)
+        with self._lock:
+            count, total, _last, mx = self._timers.get(k, (0, 0.0, 0.0, 0.0))
+            self._timers[k] = (count + 1, total + seconds, seconds,
+                              max(mx, seconds))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._timers.clear()
+
+    # -- exposition --------------------------------------------------------
+    @staticmethod
+    def _fmt(name: str, labels: tuple, value: float) -> str:
+        full = f"{_PREFIX}_{name}"
+        if labels:
+            inner = ",".join(f'{k}="{v}"' for k, v in labels)
+            full += "{" + inner + "}"
+        return f"{full} {value}"
+
+    def render(self, extra_gauges: dict | None = None) -> str:
+        """Prometheus text format. ``extra_gauges`` lets the scrape handler
+        mix in live values (name -> value or (value, labels))."""
+        lines: list[str] = []
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            timers = dict(self._timers)
+        for name, value in (extra_gauges or {}).items():
+            labels: dict | None = None
+            if isinstance(value, tuple):
+                value, labels = value
+            gauges[self._key(name, labels)] = float(value)
+        for (name, labels), v in sorted(counters.items()):
+            lines.append(self._fmt(name + "_total", labels, v))
+        for (name, labels), v in sorted(gauges.items()):
+            lines.append(self._fmt(name, labels, v))
+        for (name, labels), (count, total, last, mx) in sorted(timers.items()):
+            lines.append(self._fmt(name + "_seconds_count", labels, count))
+            lines.append(self._fmt(name + "_seconds_sum", labels, total))
+            lines.append(self._fmt(name + "_seconds_last", labels, last))
+            lines.append(self._fmt(name + "_seconds_max", labels, mx))
+        return "\n".join(lines) + "\n"
+
+
+SENSORS = SensorRegistry()

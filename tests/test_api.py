@@ -359,3 +359,144 @@ def test_cccli_against_live_server(cc, capsys):
     finally:
         server.shutdown()
         api.shutdown()
+
+
+def test_metrics_endpoint_renders_prometheus(api):
+    """/metrics publishes the headline sensors (Sensors.md): valid windows,
+    monitored-partitions pct, balancedness, proposal-computation timer,
+    executor task counters."""
+    from cruise_control_tpu.utils.sensors import SENSORS
+
+    SENSORS.clear()  # the registry is process-global; isolate the scrape
+    SENSORS.record_timer("analyzer_proposal_computation", 1.25)
+    SENSORS.count("executor_tasks", 3, labels={
+        "type": "inter_broker_replica_action", "state": "completed"})
+    text = api.metrics_text()
+    assert "kafka_cruisecontrol_monitor_num_valid_windows" in text
+    assert "kafka_cruisecontrol_monitor_monitored_partitions_percentage" in text
+    assert "kafka_cruisecontrol_analyzer_balancedness_score" in text
+    assert "kafka_cruisecontrol_analyzer_proposal_computation_seconds_count" in text
+    assert 'kafka_cruisecontrol_executor_tasks_total{state="completed"' \
+           ',type="inter_broker_replica_action"} 3' in text
+
+
+def test_openapi_spec_covers_all_endpoints():
+    import yaml
+
+    from cruise_control_tpu.api.endpoints import EndPoint
+    from cruise_control_tpu.api.openapi import openapi_yaml
+
+    spec = yaml.safe_load(openapi_yaml())
+    assert spec["openapi"].startswith("3.")
+    for e in EndPoint:
+        path = f"/kafkacruisecontrol/{e.name.lower()}"
+        assert path in spec["paths"], path
+        assert e.method.lower() in spec["paths"][path]
+    # Parameters derive from the live schemas.
+    rb = spec["paths"]["/kafkacruisecontrol/rebalance"]["post"]["parameters"]
+    names = {p["name"] for p in rb}
+    assert {"dryrun", "goals", "verbose", "json",
+            "replica_movement_strategies"} <= names
+
+
+def test_json_false_renders_plaintext(api):
+    status, body, headers = api.handle(
+        "GET", "/kafkacruisecontrol/state", "json=false")
+    assert status == 200
+    assert "__text__" in body
+    assert "MonitorState" in body["__text__"]
+    assert headers["Content-Type"].startswith("text/plain")
+
+
+def test_get_response_schema_included(api):
+    status, body, _ = api.handle(
+        "GET", "/kafkacruisecontrol/state", "get_response_schema=true")
+    assert status == 200
+    assert body["responseSchema"]["version"] == "number"
+
+
+def test_verbose_adds_stats_and_caps_proposals(api):
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/proposals",
+                                 "verbose=true")
+    assert status == 200
+    assert "loadBeforeOptimization" in body
+    assert body["numProposals"] == len(body["proposals"])
+
+
+def test_endpoint_request_class_is_config_swappable(cc):
+    """CruiseControlRequestConfig reflection parity: a configured
+    <endpoint>.request.class takes over the endpoint end to end."""
+
+    class CustomStateHandler:
+        def handle(self, facade, params, principal):
+            return {"version": 1, "custom": True,
+                    "caller": principal.name}
+
+    import cruise_control_tpu.api.server as server_mod
+    cfg = CruiseControlConfig({
+        "state.request.class":
+            f"{__name__}.CustomStateHandler",
+        "failed.brokers.file.path": ""})
+    # Resolution goes through resolve_class on a dotted path; register the
+    # class where that path can find it.
+    import sys
+    setattr(sys.modules[__name__], "CustomStateHandler", CustomStateHandler)
+    api = server_mod.CruiseControlApi(cc, config=cfg)
+    try:
+        status, body, _ = api.handle("GET", "/kafkacruisecontrol/state")
+        assert status == 200
+        assert body == {"version": 1, "custom": True, "caller": "anonymous"}
+    finally:
+        api.shutdown()
+
+
+def test_user_task_manager_max_active_maps_to_429(cc):
+    import threading
+
+    from cruise_control_tpu.api.user_tasks import UserTaskManager
+
+    api = CruiseControlApi(cc)
+    api._async_wait_s = 0.01
+    gate = threading.Event()
+    api._tasks = UserTaskManager(max_active_tasks=1)
+    api._tasks.get_or_create_task("REBALANCE", "", gate.wait)
+    try:
+        status, body, _ = api.handle("POST", "/kafkacruisecontrol/rebalance",
+                                     "dryrun=true")
+        assert status == 429
+        assert "max active user tasks" in body["errorMessage"]
+    finally:
+        gate.set()
+        api.shutdown()
+
+
+def test_user_task_per_class_completed_retention():
+    from cruise_control_tpu.api.user_tasks import UserTaskManager
+
+    m = UserTaskManager(max_active_tasks=50,
+                        max_cached_completed_monitor_tasks=2,
+                        max_cached_completed_admin_tasks=3)
+    try:
+        for i in range(5):
+            m.get_or_create_task("PROPOSALS", f"q={i}", lambda: 1).future.result()
+        for i in range(5):
+            m.get_or_create_task("REBALANCE", f"q={i}", lambda: 1).future.result()
+        tasks = m.all_tasks()
+        monitor = [t for t in tasks if t.endpoint == "PROPOSALS"]
+        admin = [t for t in tasks if t.endpoint == "REBALANCE"]
+        assert len(monitor) == 2     # newest 2 monitor-type kept
+        assert len(admin) == 3       # newest 3 admin-type kept
+    finally:
+        m.shutdown()
+
+
+def test_async_task_reports_typed_progress(api):
+    """OperationProgress parity: a completed model-building task records
+    the typed steps (AggregatingMetrics → GeneratingClusterModel → ...)."""
+    api.handle("POST", "/kafkacruisecontrol/rebalance", "dryrun=true")
+    tasks = [t for t in api.user_tasks.all_tasks()
+             if t.endpoint == "REBALANCE"]
+    assert tasks
+    steps = [p["step"] for p in tasks[0].progress.to_list()]
+    assert "GeneratingClusterModel" in steps
+    assert "OptimizationForGoalChain" in steps

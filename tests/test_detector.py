@@ -311,3 +311,91 @@ def test_manager_runs_detector_threads():
     finally:
         mgr.shutdown()
     assert mgr.state()["recentAnomalies"]
+
+
+# ---- maintenance plan serde + topic reader ---------------------------------
+
+def test_maintenance_plan_serde_round_trip():
+    from cruise_control_tpu.detector.anomaly import (
+        MaintenanceEvent, MaintenanceEventType,
+    )
+    from cruise_control_tpu.detector.maintenance_serde import (
+        deserialize_plan, serialize_plan,
+    )
+
+    event = MaintenanceEvent(
+        event_type=MaintenanceEventType.TOPIC_REPLICATION_FACTOR,
+        broker_ids=[3, 1], topics_by_rf={3: ["t2", "t1"]})
+    back = deserialize_plan(serialize_plan(event, time_ms=123))
+    assert back.event_type is MaintenanceEventType.TOPIC_REPLICATION_FACTOR
+    assert sorted(back.broker_ids) == [1, 3]
+    assert back.topics_by_rf == {3: ["t1", "t2"]}
+
+
+def test_maintenance_plan_serde_rejects_bad_envelopes():
+    import json
+
+    import pytest
+
+    from cruise_control_tpu.detector.anomaly import (
+        MaintenanceEvent, MaintenanceEventType,
+    )
+    from cruise_control_tpu.detector.maintenance_serde import (
+        PlanSerdeError, deserialize_plan, serialize_plan,
+    )
+
+    good = serialize_plan(MaintenanceEvent(
+        event_type=MaintenanceEventType.REMOVE_BROKER, broker_ids=[5]))
+    d = json.loads(good)
+    # Corrupt content: crc must catch it.
+    d["content"]["brokers"] = [6]
+    with pytest.raises(PlanSerdeError, match="crc"):
+        deserialize_plan(json.dumps(d).encode())
+    # Unsupported (future) version.
+    d2 = json.loads(good)
+    d2["version"] = 99
+    with pytest.raises(PlanSerdeError, match="version"):
+        deserialize_plan(json.dumps(d2).encode())
+    # Unknown type.
+    d3 = json.loads(good)
+    d3["planType"] = "DESTROY_CLUSTER"
+    with pytest.raises(PlanSerdeError, match="unknown"):
+        deserialize_plan(json.dumps(d3).encode())
+
+
+def test_topic_reader_feeds_detector_and_drops_corrupt_plans():
+    """MaintenanceEventDetector consuming from the (fake) topic transport:
+    good plans reported once (idempotence cache), corrupt ones skipped."""
+    from cruise_control_tpu.detector.anomaly import (
+        MaintenanceEvent, MaintenanceEventType,
+    )
+    from cruise_control_tpu.detector.maintenance import (
+        MaintenanceEventDetector,
+    )
+    from cruise_control_tpu.detector.maintenance_serde import (
+        TopicMaintenanceEventReader, serialize_plan,
+    )
+
+    class FakeTransport:
+        def __init__(self):
+            self.records = []
+
+        def poll(self, start_ms, end_ms):
+            out, self.records = self.records, []
+            return out
+
+    transport = FakeTransport()
+    reader = TopicMaintenanceEventReader(transport)
+    reported = []
+    detector = MaintenanceEventDetector(reader, reported.append)
+
+    plan = MaintenanceEvent(event_type=MaintenanceEventType.REMOVE_BROKER,
+                            broker_ids=[7])
+    transport.records = [serialize_plan(plan, time_ms=1),
+                         b"not-json", serialize_plan(plan, time_ms=1)]
+    out = detector.run_once()
+    assert len(out) == 1
+    assert reported[0].broker_ids == [7]
+    # Same plan re-submitted within the idempotence window: dropped.
+    transport.records = [serialize_plan(plan, time_ms=1)]
+    assert detector.run_once() == []
