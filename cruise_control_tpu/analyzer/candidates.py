@@ -50,11 +50,24 @@ class Candidates:
 @partial(jax.tree_util.register_dataclass,
          data_fields=["src_broker", "dst_broker", "load_delta", "replica_delta",
                       "leader_delta", "partition", "topic", "src_slot",
-                      "dst_slot", "valid"],
+                      "dst_slot", "valid", "pre_src_load", "pre_dst_load",
+                      "pre_src_count", "pre_dst_count", "pre_src_leaders",
+                      "pre_dst_leaders", "pre_src_topic_count",
+                      "pre_dst_topic_count", "pre_src_topic_leaders",
+                      "pre_dst_pot", "pre_dst_lbi"],
          meta_fields=[])
 @dataclasses.dataclass(frozen=True)
 class CandidateDeltas:
-    """Per-candidate effect: src loses, dst gains."""
+    """Per-candidate effect: src loses, dst gains.
+
+    The optional ``pre_*`` fields carry the CUMULATIVE effect of
+    higher-ranked candidates selected in the same round on this candidate's
+    src/dst brokers (attach_cumulative). Goal acceptance adds them to the
+    round-start aggregates so a batch of same-broker moves is judged
+    jointly — the sound relaxation of one-move-per-broker-per-round.
+    Directionally conservative: dst pre terms count only inflows, src pre
+    terms only outflows, so a rejected earlier candidate can only make the
+    check stricter, never looser. ``None`` = single-candidate semantics."""
 
     src_broker: jax.Array    # [N] int32
     dst_broker: jax.Array    # [N] int32
@@ -66,6 +79,26 @@ class CandidateDeltas:
     src_slot: jax.Array      # [N] int32
     dst_slot: jax.Array      # [N] int32 (leadership target slot; 0 for moves)
     valid: jax.Array         # [N] bool
+    pre_src_load: jax.Array | None = None        # [N, R]
+    pre_dst_load: jax.Array | None = None        # [N, R]
+    pre_src_count: jax.Array | None = None       # [N] f32
+    pre_dst_count: jax.Array | None = None       # [N] f32
+    pre_src_leaders: jax.Array | None = None     # [N] f32
+    pre_dst_leaders: jax.Array | None = None     # [N] f32
+    pre_src_topic_count: jax.Array | None = None   # [N] f32 (same topic)
+    pre_dst_topic_count: jax.Array | None = None   # [N] f32
+    pre_src_topic_leaders: jax.Array | None = None  # [N] f32
+    pre_dst_pot: jax.Array | None = None         # [N] f32 potential NW-out
+    pre_dst_lbi: jax.Array | None = None         # [N] f32 leader bytes-in
+
+    def pre0(self, name: str):
+        """Pre-term or 0.0 (single-candidate semantics when absent)."""
+        value = getattr(self, name)
+        return 0.0 if value is None else value
+
+    def pre_load(self, name: str, r: int):
+        value = getattr(self, name)
+        return 0.0 if value is None else value[:, r]
 
 
 def compute_deltas(state: ClusterTensors, derived: DerivedState,
@@ -263,3 +296,56 @@ def generate_candidates(state: ClusterTensors, derived: DerivedState,
         dst_slot=jnp.concatenate([c.dst_slot for c in parts]),
         valid=jnp.concatenate([c.valid for c in parts]),
     ), tuple(layout)
+
+
+def attach_cumulative(sub: CandidateDeltas, considered: jax.Array,
+                      pot_delta: jax.Array, lbi_delta: jax.Array,
+                      ) -> tuple[CandidateDeltas, jax.Array]:
+    """Fill the ``pre_*`` fields of a RANK-ORDERED candidate batch: for each
+    candidate i, the summed effect of every considered candidate j < i on
+    i's src/dst brokers (pairwise masks + matmuls over the small selected
+    batch — [m, m] with m ≤ a few hundred).
+
+    ``considered[j]`` marks candidates whose effect must be assumed applied
+    (passed scoring + partition dedupe). Including candidates that a later
+    acceptance recheck rejects only OVERCOUNTS inflow/outflow — the checks
+    get stricter, never looser, so the relaxation stays sound.
+    ``pot_delta``/``lbi_delta`` are the per-candidate potential-NW-out and
+    leader-bytes-in transfer scalars (computed by the caller so this stays
+    free of per-partition state gathers — shard-safe).
+
+    Returns (sub with pre fields, has_earlier[m]) where ``has_earlier``
+    marks candidates sharing a src or dst broker with an earlier considered
+    candidate (the first candidate per broker keeps single-candidate
+    acceptance semantics)."""
+    m = sub.partition.shape[0]
+    idx = jnp.arange(m)
+    earlier = (idx[:, None] > idx[None, :]) & considered[None, :]
+    same_dst = earlier & (sub.dst_broker[:, None] == sub.dst_broker[None, :])
+    same_src = earlier & (sub.src_broker[:, None] == sub.src_broker[None, :])
+    cross_sd = earlier & (sub.src_broker[:, None] == sub.dst_broker[None, :])
+    cross_ds = earlier & (sub.dst_broker[:, None] == sub.src_broker[None, :])
+    same_topic = sub.topic[:, None] == sub.topic[None, :]
+
+    f32 = jnp.float32
+    rep = sub.replica_delta.astype(f32)
+    lead = sub.leader_delta.astype(f32)
+
+    def cum(mask, values):
+        return mask.astype(f32) @ values
+
+    has_earlier = (same_dst | same_src | cross_sd | cross_ds).any(axis=1)
+    return dataclasses.replace(
+        sub,
+        pre_src_load=cum(same_src, sub.load_delta),
+        pre_dst_load=cum(same_dst, sub.load_delta),
+        pre_src_count=cum(same_src, rep),
+        pre_dst_count=cum(same_dst, rep),
+        pre_src_leaders=cum(same_src, lead),
+        pre_dst_leaders=cum(same_dst, lead),
+        pre_src_topic_count=cum(same_src & same_topic, rep),
+        pre_dst_topic_count=cum(same_dst & same_topic, rep),
+        pre_src_topic_leaders=cum(same_src & same_topic, lead),
+        pre_dst_pot=cum(same_dst, pot_delta),
+        pre_dst_lbi=cum(same_dst, lbi_delta),
+    ), has_earlier

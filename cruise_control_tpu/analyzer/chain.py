@@ -39,10 +39,9 @@ from .constraint import BalancingConstraint
 from .derived import compute_derived
 from .goals.base import Goal
 from .search import (
-    _OFFLINE_BONUS, _conflict_free_top_m, ExclusionMasks,
-    OptimizationFailureError, SearchConfig, apply_selected,
-    apply_swap_selection, goal_aux, reduce_per_source, run_rounds_loop,
-    swap_grid,
+    _OFFLINE_BONUS, ExclusionMasks, OptimizationFailureError, SearchConfig,
+    apply_selected, apply_swap_selection, cumulative_select, goal_aux,
+    run_rounds_loop, swap_grid,
 )
 
 
@@ -181,23 +180,23 @@ def _chain_round_body(state: ClusterTensors, active_idx: jax.Array,
                     jnp.maximum(imp, 0.0) + _OFFLINE_BONUS, imp)
     score = jnp.where(accept, imp, -jnp.inf)
 
-    red_idx = reduce_per_source(score, layout)
-    # Independent-per-broker goals with no stacked priors may take many
-    # moves per broker per round (search._round_body rationale). The
-    # selection size is static at the larger value; broker-deduped goals
-    # additionally honor the configured moves_per_round as a true accept
-    # cap (applied to the conflict-free winners in score order), so
-    # solver.moves.per.round still throttles per-round churn.
     independent = indep_f[active_idx] & ~prior_mask.any()
     m = max(cfg.moves_per_round, cfg.num_sources)
-    top_idx_red, sel = _conflict_free_top_m(
-        score[red_idx], deltas.partition[red_idx], deltas.src_broker[red_idx],
-        deltas.dst_broker[red_idx], m, state.num_partitions,
-        state.num_brokers, dedupe_brokers=~independent)
-    within_cap = jnp.cumsum(sel.astype(jnp.int32)) <= cfg.moves_per_round
-    sel &= jnp.where(independent, True, within_cap)
-    top_idx = red_idx[top_idx_red]
+    is_active_f = is_active
 
+    def recheck(sub, has_earlier):
+        """Joint acceptance with cumulative pre-deltas (cumulative_select):
+        prior goals gated by the traced prior mask; the ACTIVE goal guards
+        its own band for interacting candidates."""
+        a = jnp.ones(sub.valid.shape[0], dtype=bool)
+        for i, g in enumerate(goals):
+            g_acc = g.acceptance(state, derived, constraint, aux_list[i], sub)
+            a &= (~prior_mask[i]) | g_acc
+            a &= (~is_active_f[i]) | (~has_earlier) | g_acc
+        return a
+
+    top_idx, sel = cumulative_select(state, deltas, score, layout, m,
+                                     cfg.moves_per_round, independent, recheck)
     new_state = apply_selected(
         state, sel, deltas.partition[top_idx], deltas.src_slot[top_idx],
         deltas.dst_broker[top_idx], cand.kind[top_idx], cand.dst_slot[top_idx])

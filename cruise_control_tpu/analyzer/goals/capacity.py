@@ -36,10 +36,12 @@ class ResourceCapacityGoal(Goal):
 
     def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
         # isMovementAcceptableForCapacity: destination stays within its
-        # capacity limit after receiving the load.
+        # capacity limit after receiving the load (including inflow from
+        # higher-ranked candidates accepted this round).
         r = int(self.resource)
         limit = self._limit(state, constraint)
-        dst_after = derived.broker_load[deltas.dst_broker, r] + deltas.load_delta[:, r]
+        dst_after = derived.broker_load[deltas.dst_broker, r] \
+            + deltas.pre_load("pre_dst_load", r) + deltas.load_delta[:, r]
         return dst_after <= limit[deltas.dst_broker] + 1e-6
 
     def improvement(self, state, derived, constraint, aux, deltas):
@@ -86,7 +88,8 @@ class ReplicaCapacityGoal(Goal):
         return jnp.where(derived.alive, jnp.maximum(over, 0).astype(jnp.float32), 0.0)
 
     def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
-        dst_after = derived.broker_replicas[deltas.dst_broker] + deltas.replica_delta
+        dst_after = derived.broker_replicas[deltas.dst_broker] \
+            + deltas.pre0("pre_dst_count") + deltas.replica_delta
         return dst_after <= constraint.max_replicas_per_broker
 
     def improvement(self, state, derived, constraint, aux, deltas):

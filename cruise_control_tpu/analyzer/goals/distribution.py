@@ -65,16 +65,19 @@ class ResourceDistributionGoal(Goal):
         d = deltas.load_delta[:, r]
         src, dst = deltas.src_broker, deltas.dst_broker
         eps = 1e-6
+        # Round-start loads shifted by same-round higher-ranked candidates.
+        ls = load[src] - deltas.pre_load("pre_src_load", r)
+        ld = load[dst] + deltas.pre_load("pre_dst_load", r)
 
-        src_above_lower = load[src] >= lower[src] - eps
-        dst_under_upper = load[dst] <= upper[dst] + eps
-        stays_in_band = (load[dst] + d <= upper[dst] + eps) \
-            & (load[src] - d >= lower[src] - eps)
+        src_above_lower = ls >= lower[src] - eps
+        dst_under_upper = ld <= upper[dst] + eps
+        stays_in_band = (ld + d <= upper[dst] + eps) \
+            & (ls - d >= lower[src] - eps)
 
         cap_src = jnp.maximum(state.capacity[src, r], 1e-9)
         cap_dst = jnp.maximum(state.capacity[dst, r], 1e-9)
-        util_src_before = load[src] / cap_src
-        util_dst_after = (load[dst] + d) / cap_dst
+        util_src_before = ls / cap_src
+        util_dst_after = (ld + d) / cap_dst
         no_worse = util_dst_after <= util_src_before + eps
 
         accept = jnp.where(src_above_lower & dst_under_upper, stays_in_band, no_worse)
@@ -183,12 +186,17 @@ class CountDistributionGoal(Goal):
 
     def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
         # ReplicaDistributionGoal.actionAcceptance: leadership/swap ACCEPT;
-        # moves must keep dst under upper and src above lower.
+        # moves must keep dst under upper and src above lower (counting
+        # same-round higher-ranked candidates' in/outflow).
         lower, upper = self._limits(derived, constraint)
         counts = self._counts(derived)
         d = self._delta(deltas)
-        dst_ok = counts[deltas.dst_broker] + d <= upper + 1e-6
-        src_ok = counts[deltas.src_broker] - d >= lower - 1e-6
+        pre_dst = deltas.pre0("pre_dst_leaders" if self.leaders
+                              else "pre_dst_count")
+        pre_src = deltas.pre0("pre_src_leaders" if self.leaders
+                              else "pre_src_count")
+        dst_ok = counts[deltas.dst_broker] + pre_dst + d <= upper + 1e-6
+        src_ok = counts[deltas.src_broker] - pre_src - d >= lower - 1e-6
         return (d == 0) | (dst_ok & src_ok) | (~derived.alive[deltas.src_broker])
 
     def improvement(self, state, derived, constraint, aux, deltas):
@@ -273,8 +281,10 @@ class TopicReplicaDistributionGoal(Goal):
     def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
         t = deltas.topic
         d = deltas.replica_delta.astype(jnp.float32)
-        dst_cnt = aux["counts"][t, deltas.dst_broker]
-        src_cnt = aux["counts"][t, deltas.src_broker]
+        dst_cnt = aux["counts"][t, deltas.dst_broker] \
+            + deltas.pre0("pre_dst_topic_count")
+        src_cnt = aux["counts"][t, deltas.src_broker] \
+            - deltas.pre0("pre_src_topic_count")
         dst_ok = dst_cnt + d <= aux["upper"][t] + 1e-6
         src_ok = src_cnt - d >= aux["lower"][t] - 1e-6
         return (d == 0) | (dst_ok & src_ok) | (~derived.alive[deltas.src_broker])
@@ -341,7 +351,8 @@ class PotentialNwOutGoal(Goal):
     def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
         limit = self._limit(state, constraint)
         d = self._pot_delta(state, deltas)
-        dst_after = derived.pot_nw_out[deltas.dst_broker] + d
+        dst_after = derived.pot_nw_out[deltas.dst_broker] \
+            + deltas.pre0("pre_dst_pot") + d
         # Accept if destination stays within limit, or the source was
         # already violating (net improvement allowed).
         src_viol = derived.pot_nw_out[deltas.src_broker] > limit[deltas.src_broker]
@@ -403,7 +414,8 @@ class LeaderBytesInDistributionGoal(Goal):
     def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
         upper = self._upper(aux, constraint)
         d = self._lbi_delta(state, deltas)
-        dst_after = aux["lbi"][deltas.dst_broker] + d
+        dst_after = aux["lbi"][deltas.dst_broker] \
+            + deltas.pre0("pre_dst_lbi") + d
         src_over = aux["lbi"][deltas.src_broker] > upper
         return (dst_after <= upper + 1e-6) | (d <= 0) | src_over
 
@@ -482,7 +494,8 @@ class MinTopicLeadersPerBrokerGoal(Goal):
     def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
         if aux is None:
             return jnp.ones(deltas.valid.shape[0], dtype=bool)
-        cnt = aux["leader_counts"][deltas.topic, deltas.src_broker]
+        cnt = aux["leader_counts"][deltas.topic, deltas.src_broker] \
+            - deltas.pre0("pre_src_topic_leaders")
         d = deltas.leader_delta
         return (d == 0) | (cnt - d >= self.min_leaders)
 

@@ -43,7 +43,8 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
     def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
         rack_ok = super().acceptance(state, derived, constraint, aux, deltas)
         cap = self._ceiling(derived)
-        under_cap = derived.broker_replicas[deltas.dst_broker] + 1 <= cap
+        under_cap = derived.broker_replicas[deltas.dst_broker] \
+            + deltas.pre0("pre_dst_count") + 1 <= cap
         is_move = deltas.replica_delta > 0
         return rack_ok & jnp.where(is_move, under_cap, True)
 
@@ -117,6 +118,7 @@ class KafkaAssignerDiskUsageDistributionGoal(Goal):
         dst_cap = jnp.maximum(state.capacity[deltas.dst_broker, Resource.DISK],
                               1e-9)
         dst_util_after = (derived.broker_load[deltas.dst_broker, Resource.DISK]
+                          + deltas.pre_load("pre_dst_load", int(Resource.DISK))
                           + deltas.load_delta[:, Resource.DISK]) / dst_cap
         is_move = deltas.replica_delta > 0
         return jnp.where(is_move, dst_util_after <= upper, True)

@@ -191,9 +191,12 @@ class GoalOptimizer:
             else max(self._cand_budget, min(65_536, b * 64))
         num_dests = max(16, min(256, b // 4))
         if self._cand_budget_explicit:
-            # Honor the operator's budget as a bound on the move grid.
-            num_dests = min(num_dests, max(4, budget // 64))
-        num_sources = max(64, min(1024, budget // num_dests))
+            # Honor the operator's budget as a bound on the move grid:
+            # sources × dests ≤ budget (floors drop to the minimum viable).
+            num_dests = min(num_dests, max(4, budget // 16))
+            num_sources = max(16, min(1024, budget // num_dests))
+        else:
+            num_sources = max(64, min(1024, budget // num_dests))
         moves = max(self._moves_base, min(512, b // 2))
         return SearchConfig(num_sources=num_sources, num_dests=num_dests,
                             moves_per_round=moves,

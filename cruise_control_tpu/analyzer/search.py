@@ -30,8 +30,11 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 
+from ..common.resources import Resource
 from ..model.tensors import ClusterTensors, offline_replicas
-from .candidates import KIND_MOVE, compute_deltas, generate_candidates
+from .candidates import (
+    KIND_MOVE, attach_cumulative, compute_deltas, generate_candidates,
+)
 from .constraint import BalancingConstraint
 from .derived import DerivedState, compute_derived
 from .goals.base import Goal
@@ -155,6 +158,55 @@ def _conflict_free_top_m(score: jax.Array, partition: jax.Array,
     return top_idx, accept
 
 
+def cumulative_select(state: ClusterTensors, deltas, score: jax.Array,
+                      layout, m: int, moves_cap: int,
+                      independent: bool | jax.Array, recheck):
+    """Conflict selection with JOINT acceptance instead of broker dedupe.
+
+    The old rule admitted at most ONE move per src/dst broker per round
+    (scatter-min dedupe), because each candidate's acceptance was judged
+    against round-start aggregates — sound but it serialized per-broker
+    throughput (~num_dests accepted moves/round at scale). Here the top-m
+    candidates (rank order, one per partition) get pairwise CUMULATIVE
+    pre-deltas (attach_cumulative), and ``recheck(sub, has_earlier)``
+    re-evaluates every stacked goal's acceptance with those shifts: many
+    moves may share a broker as long as their joint effect stays inside
+    every goal's bands/limits.
+
+    Returns (top_idx into the full grid, sel mask)."""
+    red_idx = reduce_per_source(score, layout)
+    red_score = score[red_idx]
+    k = min(m, red_score.shape[0])
+    top_score, top_i = jax.lax.top_k(red_score, k)
+    idx = red_idx[top_i]
+    ok = top_score > _EPS_IMPROVEMENT
+    rank = jnp.arange(k, dtype=jnp.int32)
+    big = jnp.int32(k + 1)
+    rank_eff = jnp.where(ok, rank, big)
+    sel_p = deltas.partition[idx]
+    first_p = jnp.full(state.num_partitions, big, jnp.int32) \
+        .at[sel_p].min(rank_eff)
+    part_ok = ok & (first_p[sel_p] == rank)
+
+    sub = jax.tree.map(lambda a: a[idx], deltas)
+    pot = jnp.where(sub.replica_delta > 0,
+                    state.leader_load[sub.partition, int(Resource.NW_OUT)],
+                    0.0)
+    lbi = jnp.where(sub.leader_delta > 0,
+                    state.leader_load[sub.partition, int(Resource.NW_IN)],
+                    0.0)
+    sub, has_earlier = attach_cumulative(sub, part_ok, pot, lbi)
+    sel = part_ok & recheck(sub, has_earlier)
+    within_cap = jnp.cumsum(sel.astype(jnp.int32)) <= moves_cap
+    if independent is True:
+        pass
+    elif independent is False:
+        sel &= within_cap
+    else:
+        sel &= jnp.where(independent, True, within_cap)
+    return idx, sel
+
+
 def run_rounds_loop(round_body, state: ClusterTensors, max_rounds: int,
                     ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
     """Shared fused-driver scaffold: iterate ``round_body(state) ->
@@ -231,7 +283,7 @@ def score_round_candidates(state: ClusterTensors, masks: ExclusionMasks,
     imp = jnp.where(moving_offline & jnp.isfinite(imp) & deltas.valid,
                     jnp.maximum(imp, 0.0) + _OFFLINE_BONUS, imp)
     score = jnp.where(accept, imp, -jnp.inf)
-    return cand, deltas, score, layout
+    return cand, deltas, score, layout, (derived, aux, aux_by_goal)
 
 
 def apply_selected(state: ClusterTensors, sel: jax.Array, sel_p: jax.Array,
@@ -465,25 +517,28 @@ def _round_body(state: ClusterTensors, goal: Goal, optimized: tuple[Goal, ...],
                 ) -> tuple[ClusterTensors, jax.Array]:
     """One search round (traced body shared by optimize_round and the fused
     on-device driver)."""
-    cand, deltas, score, layout = score_round_candidates(
-        state, masks, goal, optimized, constraint, cfg, num_topics)
+    cand, deltas, score, layout, (derived, aux, aux_by) = \
+        score_round_candidates(state, masks, goal, optimized, constraint,
+                               cfg, num_topics)
 
-    red_idx = reduce_per_source(score, layout)
-
-    # Per-partition-structural goals accept one move per PARTITION (not per
-    # broker) and a much larger batch: broker totals don't feed their
-    # acceptance, so parallel moves can't interact. Only sound when no
-    # previously-optimized goal is stacked — a prior capacity/distribution
-    # goal's acceptance DOES read broker totals and assumes one-at-a-time.
     independent = goal.independent_per_broker and not optimized
-    m = max(cfg.moves_per_round, cfg.num_sources) if independent \
-        else cfg.moves_per_round
-    top_idx_red, sel = _conflict_free_top_m(
-        score[red_idx], deltas.partition[red_idx], deltas.src_broker[red_idx],
-        deltas.dst_broker[red_idx], m, state.num_partitions,
-        state.num_brokers, dedupe_brokers=not independent)
-    top_idx = red_idx[top_idx_red]
+    m = max(cfg.moves_per_round, cfg.num_sources)
 
+    def recheck(sub, has_earlier):
+        """Joint acceptance of the selected batch: every stacked goal with
+        cumulative pre-deltas, plus the ACTIVE goal's own acceptance for
+        candidates that interact with an earlier one (guards against
+        jointly overshooting its own band; the first candidate per broker
+        keeps single-candidate semantics)."""
+        a = jnp.ones(sub.valid.shape[0], dtype=bool)
+        for g in optimized:
+            a &= g.acceptance(state, derived, constraint, aux_by[g.name], sub)
+        a &= (~has_earlier) | goal.acceptance(state, derived, constraint,
+                                              aux, sub)
+        return a
+
+    top_idx, sel = cumulative_select(state, deltas, score, layout, m,
+                                     cfg.moves_per_round, independent, recheck)
     new_state = apply_selected(
         state, sel, deltas.partition[top_idx], deltas.src_slot[top_idx],
         deltas.dst_broker[top_idx], cand.kind[top_idx], cand.dst_slot[top_idx])

@@ -46,6 +46,11 @@ class SecurityProvider:
                      remote_addr: str = "") -> Principal:
         raise NotImplementedError
 
+    def challenge(self) -> str:
+        """WWW-Authenticate value advertised on 401 (a Kerberos client
+        needs \"Negotiate\" or it never starts the handshake)."""
+        return 'Basic realm="cruise-control"'
+
     def authorize(self, principal: Principal, endpoint: EndPoint) -> None:
         if principal.role < endpoint.required_role:
             raise AuthorizationError(
@@ -241,6 +246,9 @@ class SpnegoSecurityProvider(PrincipalValidatorSecurityProvider):
     def from_config(cls, cfg) -> "SpnegoSecurityProvider":
         return cls(principal=cfg.get("spnego.principal"),
                    keytab_file=cfg.get("spnego.keytab.file"))
+
+    def challenge(self) -> str:
+        return "Negotiate"
 
     def _acceptor_credentials(self, gssapi):
         name = None

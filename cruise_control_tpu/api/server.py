@@ -184,7 +184,7 @@ class CruiseControlApi:
         except ParameterParseError as e:
             return 400, self._error(str(e)), out_headers
         except AuthenticationError as e:
-            out_headers["WWW-Authenticate"] = 'Basic realm="cruise-control"'
+            out_headers["WWW-Authenticate"] = self._security.challenge()
             return 401, self._error(str(e)), out_headers
         except AuthorizationError as e:
             return 403, self._error(str(e)), out_headers
@@ -377,12 +377,14 @@ class CruiseControlApi:
         reason = p.get("reason", "")
         verbose = p.get("verbose", False)
 
-        def apply_execution_params():
+        def exec_scope():
             """Per-request execution overrides (ParameterUtils): scoped to
-            the execution this request triggers — the executor snapshots
-            and restores the standing caps/strategy around it."""
+            the operation via the facade's context manager, so a dry run,
+            an empty result, or an exception never leaks them into a later
+            execution."""
+            import contextlib
             if dryrun:
-                return
+                return contextlib.nullcontext()
             conc = {}
             if "concurrent_partition_movements_per_broker" in p:
                 conc["inter_broker_per_broker"] = \
@@ -394,7 +396,8 @@ class CruiseControlApi:
                 conc["leadership_cluster"] = p["concurrent_leader_movements"]
             strategies = p.get("replica_movement_strategies", ())
             if conc or strategies:
-                cc.set_next_execution_overrides(strategies, conc)
+                return cc.execution_overrides(strategies, conc)
+            return contextlib.nullcontext()
 
         def load():
             state, meta = cc.load_monitor.cluster_model()
@@ -411,41 +414,42 @@ class CruiseControlApi:
                 goals, p.get("ignore_proposal_cache", False)), verbose)
 
         def rebalance():
-            apply_execution_params()
-            if p.get("rebalance_disk"):
-                return responses.optimization_result(
-                    cc.rebalance_disk(dryrun, reason=reason), verbose)
-            return responses.optimization_result(cc.rebalance(
-                goals, dryrun,
-                excluded_topics=p.get("excluded_topics", ()),
-                destination_broker_ids=p.get("destination_broker_ids", ()),
-                exclude_recently_demoted_brokers=p.get(
-                    "exclude_recently_demoted_brokers", False),
-                exclude_recently_removed_brokers=p.get(
-                    "exclude_recently_removed_brokers", False),
-                reason=reason), verbose)
+            with exec_scope():
+                if p.get("rebalance_disk"):
+                    return responses.optimization_result(
+                        cc.rebalance_disk(dryrun, reason=reason), verbose)
+                return responses.optimization_result(cc.rebalance(
+                    goals, dryrun,
+                    excluded_topics=p.get("excluded_topics", ()),
+                    destination_broker_ids=p.get("destination_broker_ids", ()),
+                    exclude_recently_demoted_brokers=p.get(
+                        "exclude_recently_demoted_brokers", False),
+                    exclude_recently_removed_brokers=p.get(
+                        "exclude_recently_removed_brokers", False),
+                    reason=reason), verbose)
 
         def add_broker():
-            apply_execution_params()
-            return responses.optimization_result(cc.add_brokers(
-                list(p.get("brokerid", ())), dryrun, goals, reason=reason),
-                verbose)
+            with exec_scope():
+                return responses.optimization_result(cc.add_brokers(
+                    list(p.get("brokerid", ())), dryrun, goals,
+                    reason=reason), verbose)
 
         def remove_broker():
-            apply_execution_params()
-            return responses.optimization_result(cc.remove_brokers(
-                list(p.get("brokerid", ())), dryrun, goals, reason=reason),
-                verbose)
+            with exec_scope():
+                return responses.optimization_result(cc.remove_brokers(
+                    list(p.get("brokerid", ())), dryrun, goals,
+                    reason=reason), verbose)
 
         def demote_broker():
-            apply_execution_params()
-            return responses.optimization_result(cc.demote_brokers(
-                list(p.get("brokerid", ())), dryrun, reason=reason), verbose)
+            with exec_scope():
+                return responses.optimization_result(cc.demote_brokers(
+                    list(p.get("brokerid", ())), dryrun, reason=reason),
+                    verbose)
 
         def fix_offline_replicas():
-            apply_execution_params()
-            return responses.optimization_result(cc.fix_offline_replicas(
-                dryrun, goals, reason=reason), verbose)
+            with exec_scope():
+                return responses.optimization_result(cc.fix_offline_replicas(
+                    dryrun, goals, reason=reason), verbose)
 
         def topic_configuration():
             topic = p.get("topic")
@@ -453,19 +457,19 @@ class CruiseControlApi:
             if not topic or rf is None:
                 raise ParameterParseError(
                     "topic_configuration requires topic and replication_factor")
-            apply_execution_params()
-            return responses.optimization_result(
-                cc.update_topic_replication_factor([topic], rf, dryrun,
-                                                   reason=reason), verbose)
+            with exec_scope():
+                return responses.optimization_result(
+                    cc.update_topic_replication_factor([topic], rf, dryrun,
+                                                       reason=reason), verbose)
 
         def remove_disks():
             mapping = p.get("brokerid_and_logdirs")
             if not mapping:
                 raise ParameterParseError(
                     "remove_disks requires brokerid_and_logdirs")
-            apply_execution_params()
-            return responses.optimization_result(
-                cc.remove_disks(mapping, dryrun, reason=reason), verbose)
+            with exec_scope():
+                return responses.optimization_result(
+                    cc.remove_disks(mapping, dryrun, reason=reason), verbose)
 
         table = {EndPoint.LOAD: load, EndPoint.PARTITION_LOAD: partition_load,
                  EndPoint.PROPOSALS: proposals, EndPoint.REBALANCE: rebalance,
@@ -538,7 +542,7 @@ class _Handler(BaseHTTPRequestHandler):
                 data = json.dumps({"errorMessage": str(e)}).encode()
                 self.send_response(401)
                 self.send_header("WWW-Authenticate",
-                                 'Basic realm="cruise-control"')
+                                 self.api._security.challenge())
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
@@ -551,6 +555,7 @@ class _Handler(BaseHTTPRequestHandler):
                 from .openapi import openapi_yaml
                 self._serve_text(openapi_yaml().encode(), "application/yaml")
             return
+        t0 = time.time()
         status, body, extra = self.api.handle(
             method, parsed.path, parsed.query, dict(self.headers),
             self.client_address[0])
@@ -564,10 +569,23 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        cfg = self.api._config
+        if cfg.get_boolean("webserver.http.cors.enabled"):
+            # webserver.http.cors.* (WebServerConfig CORS surface).
+            self.send_header("Access-Control-Allow-Origin",
+                             cfg.get("webserver.http.cors.origin"))
+            self.send_header("Access-Control-Allow-Methods",
+                             cfg.get("webserver.http.cors.allowmethods"))
+            self.send_header("Access-Control-Expose-Headers",
+                             cfg.get("webserver.http.cors.exposeheaders"))
         for k, v in extra.items():
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
+        if cfg.get_boolean("webserver.accesslog.enabled"):
+            LOG.info('access %s "%s %s" %d %dB %.1fms',
+                     self.client_address[0], method, self.path, status,
+                     len(data), 1000 * (time.time() - t0))
 
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         self._serve("GET")

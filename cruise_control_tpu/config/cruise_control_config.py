@@ -454,15 +454,19 @@ def _definition() -> ConfigDef:
              "How long a request blocks inline before returning 202 + "
              "User-Task-ID (the async wait).")
     d.define("webserver.session.maxExpiryTimeMs", T.LONG, 60_000,
-             Range.at_least(1), I.LOW, "Session retention.")
+             Range.at_least(1), I.LOW,
+             "Session retention (accepted for config parity; the stdlib "
+             "server is sessionless — tasks bind via User-Task-ID).")
     d.define("webserver.session.path", T.STRING, "/", None, I.LOW,
-             "Session cookie path.")
+             "Session cookie path (accepted for config parity; sessionless "
+             "server).")
     d.define("webserver.accesslog.enabled", T.BOOLEAN, True, None, I.LOW,
              "Log one line per handled request.")
     d.define("webserver.ui.diskpath", T.STRING, None, None, I.LOW,
-             "Static Web-UI directory served at / (none disables).")
+             "Static Web-UI directory (accepted for config parity; no UI "
+             "bundle ships with this framework).")
     d.define("webserver.ui.urlprefix", T.STRING, "/*", None, I.LOW,
-             "URL prefix of the served UI.")
+             "UI URL prefix (accepted for config parity).")
     d.define("webserver.http.cors.enabled", T.BOOLEAN, False, None, I.LOW,
              "CORS headers on/off.")
     d.define("webserver.http.cors.origin", T.STRING, "*", None, I.LOW,
@@ -483,15 +487,18 @@ def _definition() -> ConfigDef:
     d.define("webserver.ssl.key.password", T.PASSWORD, None, None, I.LOW,
              "Key password (alias of keystore.password for PEM).")
     d.define("webserver.ssl.protocol", T.STRING, "TLS", None, I.LOW,
-             "SSL protocol.")
+             "SSL protocol (accepted for parity; the stdlib server always "
+             "negotiates via PROTOCOL_TLS_SERVER).")
     d.define("webserver.ssl.include.ciphers", T.LIST, None, None, I.LOW,
              "Cipher allowlist (None = library default).")
     d.define("webserver.ssl.exclude.ciphers", T.LIST, None, None, I.LOW,
-             "Cipher denylist.")
+             "Cipher denylist (accepted for parity; use include.ciphers — "
+             "the stdlib ssl API takes an allowlist).")
     d.define("webserver.ssl.include.protocols", T.LIST, None, None, I.LOW,
-             "Protocol allowlist.")
+             "Protocol allowlist (accepted for parity; PROTOCOL_TLS_SERVER "
+             "negotiates the strongest shared version).")
     d.define("webserver.ssl.exclude.protocols", T.LIST, None, None, I.LOW,
-             "Protocol denylist.")
+             "Protocol denylist (accepted for parity; see include.protocols).")
     d.define("two.step.purgatory.retention.time.ms", T.LONG, 1_209_600_000,
              Range.at_least(1), I.LOW,
              "How long un-reviewed requests stay parked (Purgatory.java).")

@@ -389,6 +389,11 @@ class Executor:
         steps them back up (Executor.java:465-683, TopicMinIsrCache)."""
         if not self._adjuster_enabled:
             return
+        if self._caps_snapshot is not None:
+            # Per-execution concurrency overrides are an OPERATOR request:
+            # the adjuster must not clamp them back toward the standing base
+            # (the reference skips adjusting user-requested dimensions).
+            return
         now = time.time()
         if now - self._last_adjust < self._adjuster_interval_s:
             return

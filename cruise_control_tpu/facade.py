@@ -20,6 +20,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Mapping, Sequence
 
@@ -253,17 +254,23 @@ class CruiseControl:
         names = list(goals) if goals else None
         return goals_by_priority(self._config, names)
 
-    def set_next_execution_overrides(
-            self, replica_movement_strategies: Sequence[str] = (),
-            concurrency: Mapping[str, int] | None = None) -> None:
-        """Per-request execution overrides (ParameterUtils): consumed by the
-        next execution this facade starts and restored when it finishes —
-        they never mutate the standing configuration."""
+    @contextmanager
+    def execution_overrides(self,
+                            replica_movement_strategies: Sequence[str] = (),
+                            concurrency: Mapping[str, int] | None = None):
+        """Per-request execution overrides (ParameterUtils), scoped to the
+        operation run inside the ``with`` block: always cleared on exit —
+        a dry run, a zero-proposal result, or an optimizer exception can
+        never leak them into a later unrelated execution."""
         strategy = None
         if replica_movement_strategies:
             from .executor.strategy import strategy_chain
             strategy = strategy_chain(list(replica_movement_strategies))
         self._next_execution_overrides = (strategy, dict(concurrency or {}))
+        try:
+            yield
+        finally:
+            self._next_execution_overrides = (None, {})
 
     def _maybe_execute(self, result: OptimizerResult, dryrun: bool,
                        operation: str, reason: str, uuid: str = "") -> bool:
@@ -272,7 +279,6 @@ class CruiseControl:
         OPERATION_LOG.info("%s executing %d proposals (reason: %s)",
                            operation, len(result.proposals), reason)
         strategy, concurrency = self._next_execution_overrides
-        self._next_execution_overrides = (None, {})
         self._executor.execute_proposals(
             result.proposals, uuid=uuid, strategy=strategy,
             concurrency_overrides=concurrency or None)

@@ -231,10 +231,12 @@ class LoadMonitor:
             min_valid_windows=1,
             min_monitored_partitions_percentage=self._config.get(
                 "min.valid.partition.ratio"))
-        t0 = time.time()
         from ..utils.progress import step
         step("WaitingForClusterModel")
         with self._model_semaphore:
+            # Timer starts INSIDE the semaphore: queue wait is the
+            # WaitingForClusterModel step, not model-creation time.
+            t0 = time.time()
             step("AggregatingMetrics")
             partitions = self._metadata.describe_partitions()
             alive = self._metadata.alive_brokers()

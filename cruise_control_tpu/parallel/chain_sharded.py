@@ -33,7 +33,8 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..analyzer.candidates import (
-    Candidates, CandidateDeltas, compute_deltas, generate_candidates,
+    Candidates, CandidateDeltas, attach_cumulative, compute_deltas,
+    generate_candidates,
 )
 from ..analyzer.chain import (
     _chain_infos_from_stats, _gated_aux, _goal_flags, _switch_scores,
@@ -42,9 +43,10 @@ from ..analyzer.constraint import BalancingConstraint
 from ..analyzer.derived import compute_derived
 from ..analyzer.search import (
     _OFFLINE_BONUS, _EPS_IMPROVEMENT, ExclusionMasks, SearchConfig,
-    _conflict_free_top_m, _per_broker_top_replicas, apply_selected,
-    reduce_per_source, run_rounds_loop,
+    _per_broker_top_replicas, apply_selected, reduce_per_source,
+    run_rounds_loop,
 )
+from ..common.resources import Resource
 from ..model.tensors import ClusterTensors, alive_mask, offline_replicas
 from .mesh import PARTITION_AXIS
 from .sharded import _mask_specs, _psum, _state_specs
@@ -83,7 +85,10 @@ def _chain_round_local(state: ClusterTensors, masks: ExclusionMasks,
     p_local = state.num_partitions
     p_global = p_local * num_shards
     offset = shard * p_local
-    k_src = max(1, cfg.num_sources // num_shards)
+    # Per-device source floor: a too-thin slice (num_sources/shards)
+    # can strand the LAST violating replica below a device's top-k
+    # while the global single-device search would surface it.
+    k_src = max(16, cfg.num_sources // num_shards)
 
     lead_only_f, incl_lead_f, indep_f = _goal_flags(goals)
     additive_f = jnp.asarray([g.partition_additive_scores for g in goals])
@@ -93,6 +98,7 @@ def _chain_round_local(state: ClusterTensors, masks: ExclusionMasks,
     derived = compute_derived(state, masks.excluded_topics,
                               masks.excluded_replica_move_brokers,
                               masks.excluded_leadership_brokers, psum=_psum)
+    is_active = jnp.arange(len(goals)) == active_idx
     aux_list, src_score, dst_score, weight = _chain_scores(
         state, derived, active_idx, prior_mask, goals, constraint,
         num_topics, additive_f)
@@ -138,32 +144,87 @@ def _chain_round_local(state: ClusterTensors, masks: ExclusionMasks,
     score = jnp.where(accept, imp, -jnp.inf)
 
     red_idx = reduce_per_source(score, layout, row_offset=shard * k_src)
+    k_local = red_idx.shape[0]
 
     def gather(x):
         return jax.lax.all_gather(x, PARTITION_AXIS).reshape(
             (num_shards * x.shape[0],) + x.shape[1:])
 
+    # Per-candidate scalars that need LOCAL partition state are computed
+    # pre-gather (global partition ids cannot be gathered against the local
+    # shard); everything the joint-acceptance recheck needs travels with
+    # the candidate card.
+    local_sub = jax.tree.map(lambda a: a[red_idx], deltas)
+    pot_local = jnp.where(
+        local_sub.replica_delta > 0,
+        state.leader_load[local_sub.partition, int(Resource.NW_OUT)], 0.0)
+    lbi_local = jnp.where(
+        local_sub.leader_delta > 0,
+        state.leader_load[local_sub.partition, int(Resource.NW_IN)], 0.0)
+
+    g_sub = jax.tree.map(gather, local_sub)
+    g_sub = dataclasses.replace(g_sub, partition=gather(
+        local_sub.partition + offset))
     g_score = gather(score[red_idx])
-    g_part = gather(deltas.partition[red_idx] + offset)
-    g_src = gather(deltas.src_broker[red_idx])
-    g_dst = gather(deltas.dst_broker[red_idx])
-    g_slot = gather(deltas.src_slot[red_idx])
+    g_pot = gather(pot_local)
+    g_lbi = gather(lbi_local)
     g_dslot = gather(cand.dst_slot[red_idx])
     g_kind = gather(cand.kind[red_idx])
 
+    # Joint (cumulative) conflict selection, replicated: rank by score,
+    # dedupe partitions, pairwise pre-deltas in RANK order over the
+    # device-concatenated card array (search.cumulative_select semantics,
+    # inlined because rank != array order here).
+    k_global = num_shards * k_local
+    k = min(max(cfg.moves_per_round, cfg.num_sources), k_global)
+    top_score, order = jax.lax.top_k(g_score, k)
+    ranked = jax.tree.map(lambda a: a[order], g_sub)
+    ok = top_score > _EPS_IMPROVEMENT
+    rank = jnp.arange(k, dtype=jnp.int32)
+    big = jnp.int32(k + 1)
+    rank_eff = jnp.where(ok, rank, big)
+    first_p = jnp.full(p_global, big, jnp.int32) \
+        .at[ranked.partition].min(rank_eff)
+    part_ok = ok & (first_p[ranked.partition] == rank)
+    ranked, has_earlier = attach_cumulative(ranked, part_ok, g_pot[order],
+                                            g_lbi[order])
+
+    # Acceptance recheck: per-BROKER state (derived, aux) is replicated, so
+    # every device evaluates the full ranked batch identically — structural
+    # per-partition terms were already folded into pass-1 acceptance (the
+    # score), and per-partition scalars (pot/lbi) travel with the cards, so
+    # goal.acceptance here must only touch broker-indexed state. All the
+    # stacked goals' acceptance implementations satisfy that except the
+    # structural ones, whose acceptance ignores the pre fields and repeats
+    # the (partition-local) pass-1 verdict — evaluate those on the OWNING
+    # device and gather. To keep one code path, the recheck gates on
+    # ownership masks.
+    own = (ranked.partition >= offset) & (ranked.partition < offset + p_local)
+    local_rows = jnp.clip(ranked.partition - offset, 0, p_local - 1)
+    local_view = dataclasses.replace(ranked, partition=local_rows)
+
+    accept = jnp.ones(k, dtype=bool)
+    for i, g in enumerate(goals):
+        g_acc = g.acceptance(state, derived, constraint, aux_list[i],
+                             local_view)
+        # Rows this device does not own read clamped partition state —
+        # meaningless; trust the owner: psum of (owner's verdict), since
+        # exactly one device owns each row.
+        g_acc_owned = _psum(jnp.where(own, g_acc, False).astype(jnp.int32)) > 0
+        accept &= (~prior_mask[i]) | g_acc_owned
+        accept &= (~is_active[i]) | (~has_earlier) | g_acc_owned
+
     independent = indep_f[active_idx] & ~prior_mask.any()
-    m = max(cfg.moves_per_round, cfg.num_sources)
-    top_idx, sel = _conflict_free_top_m(
-        g_score, g_part, g_src, g_dst, m, p_global, state.num_brokers,
-        dedupe_brokers=~independent)
+    sel = part_ok & accept
     within_cap = jnp.cumsum(sel.astype(jnp.int32)) <= cfg.moves_per_round
     sel &= jnp.where(independent, True, within_cap)
 
     # ``sel`` is computed from gathered, replicated data — identical on
     # every device, so its sum is already the global count.
-    new_state = apply_selected(state, sel, g_part[top_idx], g_slot[top_idx],
-                               g_dst[top_idx], g_kind[top_idx],
-                               g_dslot[top_idx], row_offset=offset)
+    new_state = apply_selected(state, sel, ranked.partition,
+                               ranked.src_slot, ranked.dst_broker,
+                               g_kind[order], g_dslot[order],
+                               row_offset=offset)
     return new_state, sel.sum()
 
 

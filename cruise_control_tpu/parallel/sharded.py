@@ -80,8 +80,11 @@ def _round_local(state: ClusterTensors, masks: ExclusionMasks, *, goal,
     p_global = p_local * num_shards
     offset = shard * p_local
 
-    k_src = max(1, cfg.num_sources // num_shards)
-    cand, deltas, score, layout = score_round_candidates(
+    # Per-device source floor: a too-thin slice (num_sources/shards)
+    # can strand the LAST violating replica below a device's top-k
+    # while the global single-device search would surface it.
+    k_src = max(16, cfg.num_sources // num_shards)
+    cand, deltas, score, layout, _ctx = score_round_candidates(
         state, masks, goal, optimized, constraint, cfg, num_topics,
         psum=_psum, k_src=k_src)
 
