@@ -92,7 +92,9 @@ class BrokerSetAwareGoal(Goal):
         return replica_exists(state) & (slot_sets != topic_home[:, None])
 
     def broker_violations(self, state, derived, constraint, aux):
-        mis = self._misplaced(state, aux)
+        # Excluded-topic replicas are unmovable: not counted as violations
+        # (GoalUtils excluded-topic filtering semantics).
+        mis = self._misplaced(state, aux) & derived.movable_partition[:, None]
         b = state.num_brokers
         seg = jnp.where(state.assignment >= 0, state.assignment, b).reshape(-1)
         out = jax.ops.segment_sum(mis.astype(jnp.float32).reshape(-1), seg,

@@ -69,8 +69,15 @@ class ResourceDistributionGoal(Goal):
         ls = load[src] - deltas.pre_load("pre_src_load", r)
         ld = load[dst] + deltas.pre_load("pre_dst_load", r)
 
-        src_above_lower = ls >= lower[src] - eps
-        dst_under_upper = ld <= upper[dst] + eps
+        # BRANCH CHOICE uses the UNSHIFTED loads: the pre terms may
+        # overcount (rejected earlier candidates are included), and a
+        # shifted predicate could flip from the strict stays_in_band branch
+        # to the looser no_worse branch — non-monotone in the overcount,
+        # breaking the conservative-relaxation contract. The band/util
+        # CHECKS inside each branch use the shifted loads, where overcount
+        # is strictly stricter.
+        src_above_lower = load[src] >= lower[src] - eps
+        dst_under_upper = load[dst] <= upper[dst] + eps
         stays_in_band = (ld + d <= upper[dst] + eps) \
             & (ls - d >= lower[src] - eps)
 

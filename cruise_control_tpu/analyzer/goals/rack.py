@@ -51,7 +51,11 @@ class RackAwareGoal(Goal):
     of the partition (AbstractRackAwareGoal.java:96-130)."""
 
     def broker_violations(self, state, derived, constraint, aux):
-        dup = _duplicate_mask(state)
+        # Replicas of EXCLUDED topics cannot be moved, so their rack
+        # duplicates are not counted as violations (the reference's rack
+        # goal skips excluded topics rather than failing on them —
+        # GoalUtils excluded-topic filtering).
+        dup = _duplicate_mask(state) & derived.movable_partition[:, None]
         b = state.num_brokers
         seg = jnp.where(state.assignment >= 0, state.assignment, b).reshape(-1)
         out = jax.ops.segment_sum(dup.astype(jnp.float32).reshape(-1), seg,
@@ -154,7 +158,8 @@ class RackAwareDistributionGoal(RackAwareGoal):
         s = state.max_replication_factor
         earlier = jnp.tril(jnp.ones((s, s), dtype=bool), k=0)[None]
         rank_in_rack = (same & earlier).sum(axis=2)  # 1-based occurrence rank
-        over = (rank_in_rack > limit[:, None]) & replica_exists(state)
+        over = (rank_in_rack > limit[:, None]) & replica_exists(state) \
+            & derived.movable_partition[:, None]
         b = state.num_brokers
         seg = jnp.where(state.assignment >= 0, state.assignment, b).reshape(-1)
         out = jax.ops.segment_sum(over.astype(jnp.float32).reshape(-1), seg,

@@ -126,15 +126,55 @@ def encode_jwt(claims: dict, secret: bytes) -> str:
     return f"{header}.{payload}.{sig}"
 
 
-def decode_jwt(token: str, secret: bytes) -> dict:
+def decode_jwt(token: str, secret: bytes | None = None,
+               public_key_pem: bytes | None = None,
+               expected_audiences: tuple[str, ...] = ()) -> dict:
+    """Validate + decode a JWT. HS256 against ``secret``; RS256 against
+    ``public_key_pem`` (JwtAuthenticator.java:51 verifies RS256 tokens with
+    the certificate at jwt.auth.certificate.location — implemented via the
+    cryptography package)."""
     try:
-        header, payload, sig = token.split(".")
+        header_b64, payload, sig = token.split(".")
     except ValueError:
         raise AuthenticationError("malformed JWT")
-    signing_input = f"{header}.{payload}".encode()
-    expected = _b64url(hmac.new(secret, signing_input, hashlib.sha256).digest())
-    if not hmac.compare_digest(expected, sig):
-        raise AuthenticationError("bad JWT signature")
+    signing_input = f"{header_b64}.{payload}".encode()
+    try:
+        header = json.loads(_b64url_decode(header_b64))
+    except (ValueError, binascii.Error):
+        raise AuthenticationError("malformed JWT header")
+    if not isinstance(header, dict):
+        raise AuthenticationError("malformed JWT header")
+    alg = header.get("alg", "HS256")
+    if alg == "HS256":
+        if secret is None:
+            raise AuthenticationError("HS256 token but no shared secret "
+                                      "configured")
+        expected = _b64url(hmac.new(secret, signing_input,
+                                    hashlib.sha256).digest())
+        if not hmac.compare_digest(expected, sig):
+            raise AuthenticationError("bad JWT signature")
+    elif alg == "RS256":
+        if public_key_pem is None:
+            raise AuthenticationError("RS256 token but no verification key "
+                                      "configured (jwt.auth.certificate"
+                                      ".location)")
+        try:
+            from cryptography.hazmat.primitives import hashes, serialization
+            from cryptography.hazmat.primitives.asymmetric import padding
+            try:
+                key = serialization.load_pem_public_key(public_key_pem)
+            except ValueError:
+                from cryptography import x509
+                key = x509.load_pem_x509_certificate(
+                    public_key_pem).public_key()
+            key.verify(_b64url_decode(sig), signing_input,
+                       padding.PKCS1v15(), hashes.SHA256())
+        except AuthenticationError:
+            raise
+        except Exception:  # noqa: BLE001 — any crypto failure is a 401
+            raise AuthenticationError("bad JWT signature")
+    else:
+        raise AuthenticationError(f"unsupported JWT alg {alg!r}")
     try:
         claims = json.loads(_b64url_decode(payload))
     except (ValueError, binascii.Error):
@@ -142,18 +182,42 @@ def decode_jwt(token: str, secret: bytes) -> dict:
     exp = claims.get("exp")
     if exp is not None and time.time() > float(exp):
         raise AuthenticationError("expired JWT")
+    if expected_audiences:
+        aud = claims.get("aud")
+        auds = {aud} if isinstance(aud, str) else set(aud or ())
+        if not auds & set(expected_audiences):
+            raise AuthenticationError("JWT audience not accepted")
     return claims
 
 
 class JwtSecurityProvider(SecurityProvider):
-    """Bearer-token auth (JwtAuthenticator.java:51): validates signature +
-    expiry, maps the ``roles`` claim to the strongest known Role."""
+    """Bearer-token auth (JwtAuthenticator.java:51): validates signature
+    (HS256 shared secret or RS256 public key / certificate) + expiry +
+    audience, maps the ``roles`` claim to the strongest known Role."""
 
-    def __init__(self, secret: bytes, cookie_name: str = "",
-                 principal_claim: str = "sub"):
+    def __init__(self, secret: bytes | None = None, cookie_name: str = "",
+                 principal_claim: str = "sub",
+                 public_key_pem: bytes | None = None,
+                 expected_audiences: tuple[str, ...] = ()):
         self._secret = secret
         self._cookie_name = cookie_name
         self._principal_claim = principal_claim
+        self._public_key_pem = public_key_pem
+        self._expected_audiences = tuple(expected_audiences)
+
+    @classmethod
+    def from_config(cls, cfg) -> "JwtSecurityProvider":
+        """jwt.* config keys: certificate location (RS256), cookie name,
+        expected audiences."""
+        pem = None
+        location = cfg.get("jwt.auth.certificate.location")
+        if location:
+            with open(location, "rb") as f:
+                pem = f.read()
+        return cls(cookie_name=cfg.get("jwt.cookie.name") or "",
+                   public_key_pem=pem,
+                   expected_audiences=tuple(
+                       cfg.get_list("jwt.expected.audiences") or ()))
 
     def _token_from(self, headers: Mapping[str, str]) -> str:
         auth = headers.get("Authorization", "")
@@ -167,7 +231,8 @@ class JwtSecurityProvider(SecurityProvider):
         raise AuthenticationError("missing Bearer token")
 
     def authenticate(self, headers, remote_addr="") -> Principal:
-        claims = decode_jwt(self._token_from(headers), self._secret)
+        claims = decode_jwt(self._token_from(headers), self._secret,
+                            self._public_key_pem, self._expected_audiences)
         name = str(claims.get(self._principal_claim, "unknown"))
         roles = claims.get("roles", [])
         if isinstance(roles, str):

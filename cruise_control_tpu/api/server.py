@@ -93,6 +93,9 @@ class CruiseControlApi:
                 credentials_file=cfg.get("webserver.auth.credentials.file") or "")
         if cls_name.endswith("SpnegoSecurityProvider"):
             return SpnegoSecurityProvider.from_config(cfg)
+        if cls_name.endswith("JwtSecurityProvider"):
+            from .security import JwtSecurityProvider
+            return JwtSecurityProvider.from_config(cfg)
         import importlib
         module, _, name = cls_name.rpartition(".")
         return getattr(importlib.import_module(module), name)()

@@ -88,30 +88,36 @@ class ExecutionConcurrencyManager:
             self._cluster_inter_in_flight = max(0, self._cluster_inter_in_flight - 1)
 
     # ---- adaptive adjustment (ConcurrencyAdjuster) ------------------------
-    def adjust(self, cluster_healthy: bool, has_under_min_isr: bool) -> None:
+    def adjust(self, cluster_healthy: bool, has_under_min_isr: bool,
+               frozen: frozenset[str] = frozenset()) -> None:
         """One adjuster tick: halve inter-broker concurrency under min-ISR
         pressure, step up toward 2× base when healthy
-        (Executor.java:465-683)."""
+        (Executor.java:465-683). ``frozen`` names ConcurrencyCaps fields
+        carrying a per-execution OPERATOR override — those dimensions are
+        left alone (the reference skips user-requested dimensions); all
+        others keep adjusting, including the min-ISR safety step-down."""
         with self._lock:
-            cap = self._caps.inter_broker_per_broker
-            if has_under_min_isr:
-                cap = max(self.MIN_INTER_BROKER, cap // 2)
-            elif cluster_healthy:
-                cap = min(self._base.inter_broker_per_broker
-                          * self.MAX_INTER_BROKER_MULTIPLIER, cap + 1)
-            # Unhealthy WITHOUT min-ISR pressure (e.g. offline replicas
-            # mid-drain — the very workload self-healing is executing) HOLDS
-            # the cap: decrementing here would decay recovery throughput to
-            # the minimum for the whole execution, since health only returns
-            # once recovery finishes.
-            self._caps.inter_broker_per_broker = cap
+            if "inter_broker_per_broker" not in frozen:
+                cap = self._caps.inter_broker_per_broker
+                if has_under_min_isr:
+                    cap = max(self.MIN_INTER_BROKER, cap // 2)
+                elif cluster_healthy:
+                    cap = min(self._base.inter_broker_per_broker
+                              * self.MAX_INTER_BROKER_MULTIPLIER, cap + 1)
+                # Unhealthy WITHOUT min-ISR pressure (e.g. offline replicas
+                # mid-drain — the very workload self-healing is executing)
+                # HOLDS the cap: decrementing here would decay recovery
+                # throughput to the minimum for the whole execution, since
+                # health only returns once recovery finishes.
+                self._caps.inter_broker_per_broker = cap
 
-            lcap = self._caps.leadership_cluster
-            if has_under_min_isr:
-                lcap = max(self.MIN_LEADERSHIP, lcap // 2)
-            elif cluster_healthy:
-                lcap = min(self._base.leadership_cluster, lcap + 100)
-            self._caps.leadership_cluster = lcap
+            if "leadership_cluster" not in frozen:
+                lcap = self._caps.leadership_cluster
+                if has_under_min_isr:
+                    lcap = max(self.MIN_LEADERSHIP, lcap // 2)
+                elif cluster_healthy:
+                    lcap = min(self._base.leadership_cluster, lcap + 100)
+                self._caps.leadership_cluster = lcap
 
     def snapshot(self) -> ConcurrencyCaps:
         with self._lock:

@@ -94,6 +94,7 @@ class Executor:
         self._uuid: str | None = None
         self._history: list[dict] = []
         self._caps_snapshot: ConcurrencyCaps | None = None
+        self._override_dims: set[str] = set()
 
     # ---- public surface ---------------------------------------------------
     @property
@@ -135,6 +136,7 @@ class Executor:
             self._uuid = uuid
             if concurrency_overrides:
                 self._caps_snapshot = self._concurrency.snapshot()
+                self._override_dims = set(concurrency_overrides)
                 self.set_requested_concurrency(**concurrency_overrides)
             self._task_manager = ExecutionTaskManager()
             self._planner = ExecutionTaskPlanner(strategy or self._strategy)
@@ -248,6 +250,7 @@ class Executor:
             if self._caps_snapshot is not None:
                 self._concurrency.restore(self._caps_snapshot)
                 self._caps_snapshot = None
+                self._override_dims = set()
         try:
             if summary["stopped"]:
                 self._notifier.on_execution_stopped(summary)
@@ -389,11 +392,6 @@ class Executor:
         steps them back up (Executor.java:465-683, TopicMinIsrCache)."""
         if not self._adjuster_enabled:
             return
-        if self._caps_snapshot is not None:
-            # Per-execution concurrency overrides are an OPERATOR request:
-            # the adjuster must not clamp them back toward the standing base
-            # (the reference skips adjusting user-requested dimensions).
-            return
         now = time.time()
         if now - self._last_adjust < self._adjuster_interval_s:
             return
@@ -401,7 +399,11 @@ class Executor:
         min_isr = self._min_isr_cache.min_isr_by_topic(
             self._admin, {p.topic for p in parts.values()})
         healthy, under = cluster_isr_state(parts, alive, min_isr)
-        self._concurrency.adjust(healthy, under)
+        # Dimensions carrying a per-execution OPERATOR override are frozen
+        # (the reference skips user-requested dimensions); the others —
+        # including the min-ISR safety step-down — keep adjusting.
+        self._concurrency.adjust(healthy, under,
+                                 frozen=frozenset(self._override_dims))
 
     def _poll_inter_broker(self, in_flight: list[ExecutionTask]) -> None:
         """waitForInterBrokerReplicaTasksToFinish: poll reassignment state,

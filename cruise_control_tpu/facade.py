@@ -17,6 +17,7 @@ identical thing on its object graph before optimizing.
 
 from __future__ import annotations
 
+import contextvars
 import logging
 import threading
 import time
@@ -52,6 +53,11 @@ from .monitor.task_runner import SamplingMode
 
 LOG = logging.getLogger(__name__)
 OPERATION_LOG = logging.getLogger("cruise_control_tpu.operation")
+
+# Per-request execution overrides (strategy, concurrency dict) — thread/task
+# scoped via ContextVar; see CruiseControl.execution_overrides.
+_EXECUTION_OVERRIDES: contextvars.ContextVar[tuple] = \
+    contextvars.ContextVar("execution_overrides", default=(None, {}))
 
 
 @dataclass
@@ -112,7 +118,6 @@ class CruiseControl:
 
         self._proposal_cache: tuple[int, float, OptimizerResult] | None = None
         self._proposal_lock = threading.Lock()
-        self._next_execution_overrides: tuple = (None, {})
         self._started = False
         # Executor.java demotion/removal history consumed by the
         # exclude_recently_* request parameters and the ADMIN drop_* params.
@@ -259,18 +264,20 @@ class CruiseControl:
                             replica_movement_strategies: Sequence[str] = (),
                             concurrency: Mapping[str, int] | None = None):
         """Per-request execution overrides (ParameterUtils), scoped to the
-        operation run inside the ``with`` block: always cleared on exit —
-        a dry run, a zero-proposal result, or an optimizer exception can
-        never leak them into a later unrelated execution."""
+        operation run inside the ``with`` block. Carried in a ContextVar:
+        each request thread (ThreadingHTTPServer / user-task pool) sees only
+        ITS overrides — concurrent requests cannot clobber or clear each
+        other's — and exit always restores, so a dry run, zero-proposal
+        result, or optimizer exception never leaks them."""
         strategy = None
         if replica_movement_strategies:
             from .executor.strategy import strategy_chain
             strategy = strategy_chain(list(replica_movement_strategies))
-        self._next_execution_overrides = (strategy, dict(concurrency or {}))
+        token = _EXECUTION_OVERRIDES.set((strategy, dict(concurrency or {})))
         try:
             yield
         finally:
-            self._next_execution_overrides = (None, {})
+            _EXECUTION_OVERRIDES.reset(token)
 
     def _maybe_execute(self, result: OptimizerResult, dryrun: bool,
                        operation: str, reason: str, uuid: str = "") -> bool:
@@ -278,7 +285,7 @@ class CruiseControl:
             return False
         OPERATION_LOG.info("%s executing %d proposals (reason: %s)",
                            operation, len(result.proposals), reason)
-        strategy, concurrency = self._next_execution_overrides
+        strategy, concurrency = _EXECUTION_OVERRIDES.get()
         self._executor.execute_proposals(
             result.proposals, uuid=uuid, strategy=strategy,
             concurrency_overrides=concurrency or None)

@@ -500,3 +500,54 @@ def test_async_task_reports_typed_progress(api):
     steps = [p["step"] for p in tasks[0].progress.to_list()]
     assert "GeneratingClusterModel" in steps
     assert "OptimizationForGoalChain" in steps
+
+
+def test_jwt_rs256_round_trip():
+    """RS256 JWT verification against a public key (JwtAuthenticator.java
+    parity via the cryptography package), including audience checks."""
+    import base64
+    import json as json_mod
+    import time as time_mod
+
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+    from cruise_control_tpu.api.security import (
+        AuthenticationError, JwtSecurityProvider, Role,
+    )
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pem = key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo)
+
+    def b64url(data: bytes) -> str:
+        return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+    def sign(claims: dict) -> str:
+        header = b64url(json_mod.dumps({"alg": "RS256",
+                                        "typ": "JWT"}).encode())
+        payload = b64url(json_mod.dumps(claims).encode())
+        sig = key.sign(f"{header}.{payload}".encode(), padding.PKCS1v15(),
+                       hashes.SHA256())
+        return f"{header}.{payload}.{b64url(sig)}"
+
+    provider = JwtSecurityProvider(public_key_pem=pem,
+                                   expected_audiences=("cruise-control",))
+    token = sign({"sub": "alice", "roles": ["ADMIN"],
+                  "aud": "cruise-control",
+                  "exp": time_mod.time() + 60})
+    principal = provider.authenticate({"Authorization": f"Bearer {token}"})
+    assert principal.name == "alice" and principal.role is Role.ADMIN
+
+    import pytest as pytest_mod
+    with pytest_mod.raises(AuthenticationError, match="audience"):
+        provider.authenticate({"Authorization": "Bearer " + sign(
+            {"sub": "alice", "aud": "other", "exp": time_mod.time() + 60})})
+    # Tampered payload: signature must fail.
+    head, payload, sig = token.split(".")
+    evil = b64url(json_mod.dumps({"sub": "mallory", "roles": ["ADMIN"],
+                                  "aud": "cruise-control"}).encode())
+    with pytest_mod.raises(AuthenticationError, match="signature"):
+        provider.authenticate(
+            {"Authorization": f"Bearer {head}.{evil}.{sig}"})

@@ -175,3 +175,29 @@ def test_end_to_end_agent_to_cluster_model(tmp_path):
     assert (loads[:, int(Resource.NW_IN)] > 0).all()
     assert np.allclose(loads[:, int(Resource.NW_IN)],
                        loads[0, int(Resource.NW_IN)], rtol=0.05)
+
+
+def test_system_metrics_registry_psutil_bridge(tmp_path):
+    """SystemMetricsRegistry: real host CPU + NIC rates + log-dir partition
+    sizes (the deployer-side registry bridge)."""
+    from cruise_control_tpu.metricdef.raw_metric_type import RawMetricType as R
+    from cruise_control_tpu.reporter.agent import SystemMetricsRegistry
+
+    logdir = tmp_path / "kafka-logs"
+    pdir = logdir / "t7-3"
+    pdir.mkdir(parents=True)
+    (pdir / "00000000.log").write_bytes(b"x" * 2048)
+    (logdir / "not-a-partition").mkdir()
+
+    reg = SystemMetricsRegistry(broker_id=9, log_dirs=[str(logdir)])
+    first = reg.snapshot(time_ms=1_000)
+    types = {m.raw_type for m in first}
+    assert R.BROKER_CPU_UTIL in types
+    sizes = [m for m in first if m.raw_type is R.PARTITION_SIZE]
+    assert len(sizes) == 1
+    assert sizes[0].topic == "t7" and sizes[0].partition == 3
+    assert sizes[0].value == 2048.0
+    # Second snapshot: NIC deltas appear as ALL_TOPIC byte rates.
+    second = reg.snapshot(time_ms=2_000)
+    types2 = {m.raw_type for m in second}
+    assert {R.ALL_TOPIC_BYTES_IN, R.ALL_TOPIC_BYTES_OUT} <= types2
