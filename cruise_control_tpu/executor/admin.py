@@ -6,7 +6,7 @@ AdminClient calls — alterPartitionReassignments:483, electLeaders:433,
 listPartitionsBeingReassigned) and ExecutorAdminUtils.java. The backend is
 pluggable (SURVEY.md §4: "a fake Kafka admin/metadata backend for executor
 logic"): ``InMemoryAdminBackend`` simulates reassignment progress for tests
-and simulations; a kafka-python/confluent binding can implement the same
+and simulations; the wire binding (kafka.admin.KafkaAdminBackend) implements the same
 protocol against a live cluster (gated: no Kafka client in this image).
 """
 

@@ -1,18 +1,20 @@
-"""Real-Kafka bindings (import-gated — no Kafka client ships in every
-environment).
+"""Kafka bindings over the framework's OWN wire-protocol client.
 
 The framework's external boundaries are protocols with in-memory
-implementations used by tests and the demo mode:
+implementations used by unit tests and the demo mode:
 
-- ``executor.admin.AdminBackend``      ← ``KafkaAdminBackend`` (here)
-- ``monitor.sampling.MetricsTransport`` ← ``KafkaMetricsTransport`` (here)
-- ``monitor.sampling.SampleStore``      ← ``KafkaSampleStore`` (here)
+- ``executor.admin.AdminBackend``       ← ``KafkaAdminBackend``
+- ``monitor.sampling.MetricsTransport`` ← ``KafkaMetricsTransport``
+- ``monitor.sampling.SampleStore``      ← ``KafkaSampleStore``
 
-This package implements those protocols over ``kafka-python``
-(KafkaAdminClient / KafkaConsumer / KafkaProducer). Importing the package
-always succeeds; constructing any binding without kafka-python installed
-raises ``KafkaClientUnavailableError`` with install guidance. Reference
-parity: executor/ExecutionUtils.java:433,483 (electLeaders /
+Unlike round 2 (which wrapped kafka-python and could only ever run where
+that library was installed), these bindings speak the wire protocol
+directly (``kafka.wire``) — zero external dependencies, and integration-
+tested in every environment against the embedded wire-conformant broker
+(``kafka.wire.broker.EmbeddedKafkaCluster``), the stand-in for the
+reference's CCKafkaIntegrationTestHarness.
+
+Reference parity: executor/ExecutionUtils.java:433,483 (electLeaders /
 alterPartitionReassignments), monitor/sampling/
 CruiseControlMetricsReporterSampler.java (metrics-topic consumer),
 monitor/sampling/KafkaSampleStore.java:94-204 (sample topics + replay).
@@ -20,33 +22,25 @@ monitor/sampling/KafkaSampleStore.java:94-204 (sample topics + replay).
 
 from __future__ import annotations
 
-try:  # pragma: no cover - exercised only where kafka-python is installed
-    import kafka  # noqa: F401  (kafka-python)
-    HAVE_KAFKA = True
-except ImportError:
-    HAVE_KAFKA = False
+# The client is self-contained; it is always available.
+HAVE_KAFKA = True
 
 
 class KafkaClientUnavailableError(ImportError):
-    """kafka-python is not installed in this environment."""
-
-    def __init__(self, what: str):
-        super().__init__(
-            f"{what} needs the kafka-python client "
-            "(pip install kafka-python>=2.1); this environment has no "
-            "Kafka client, so only the in-memory backends are available.")
+    """Kept for API compatibility; never raised by the wire bindings."""
 
 
-def require_kafka(what: str) -> None:
-    if not HAVE_KAFKA:
-        raise KafkaClientUnavailableError(what)
+def require_kafka(what: str) -> None:  # pragma: no cover - compat shim
+    return None
 
 
 from .admin import KafkaAdminBackend            # noqa: E402
 from .sample_store import KafkaSampleStore      # noqa: E402
 from .transport import KafkaMetricsTransport    # noqa: E402
+from .wire.client import WireClient             # noqa: E402
 
 __all__ = [
     "HAVE_KAFKA", "KafkaClientUnavailableError", "require_kafka",
     "KafkaAdminBackend", "KafkaMetricsTransport", "KafkaSampleStore",
+    "WireClient",
 ]

@@ -1,210 +1,132 @@
-"""AdminBackend over kafka-python's KafkaAdminClient.
+"""AdminBackend over the framework's own wire client.
 
 Reference parity: executor/ExecutionUtils.java:483
 (alterPartitionReassignments), :433 (electLeaders),
 listPartitionsBeingReassigned (Executor.java:1238), incremental
-alter-configs for throttles (ReplicationThrottleHelper.java) and
-describeLogDirs (DiskFailureDetector.java).
+alter-configs for throttles (ReplicationThrottleHelper.java),
+describeLogDirs (DiskFailureDetector.java) and alterReplicaLogDirs
+(ExecutorAdminUtils.executeIntraBrokerReplicaMovements).
 
-kafka-python notes (>=2.1 — the KIP-455 reassignment and leader-election
-APIs arrived with the 2.1+ revival):
-- ``alter_partition_reassignments`` / ``list_partition_reassignments``
-  implement KIP-455 (cancel = target ``None``).
-- ``perform_leader_election`` with PREFERRED election type maps
-  electLeaders.
-- Config alteration is the legacy (non-incremental) AlterConfigs: this
-  binding emulates incremental semantics by describing first and merging
-  (value ``None`` deletes a key) — same observable behavior as the
-  reference's IncrementalAlterConfigs path.
+No external Kafka client: every call goes through
+``kafka.wire.WireClient`` — the same codec stack the embedded
+integration broker speaks, so this binding is integration-tested against
+real wire bytes in every environment (``tests/test_wire_integration.py``),
+not just where a client library happens to be installed.
 """
 
 from __future__ import annotations
 
-from typing import Iterable, Mapping
+import logging
+from typing import Iterable, Mapping, Sequence
 
 from ..executor.admin import PartitionState
-from . import require_kafka
+from .wire import messages as m
+from .wire.client import WireClient
+
+LOG = logging.getLogger(__name__)
 
 
 class KafkaAdminBackend:
     """Implements ``executor.admin.AdminBackend`` against a live cluster."""
 
-    def __init__(self, bootstrap_servers: str, client_id: str = "cruise-control-tpu",
-                 request_timeout_ms: int = 30_000, **kwargs):
-        require_kafka("KafkaAdminBackend")
-        from kafka import KafkaAdminClient
-
-        self._admin = KafkaAdminClient(
-            bootstrap_servers=bootstrap_servers, client_id=client_id,
-            request_timeout_ms=request_timeout_ms, **kwargs)
+    def __init__(self, bootstrap_servers: str,
+                 client_id: str = "cruise-control-tpu",
+                 request_timeout_ms: int = 30_000,
+                 client: WireClient | None = None,
+                 view_snapshot_ttl_s: float = 5.0):
+        self._client = client or WireClient(
+            bootstrap_servers, client_id=client_id,
+            timeout_s=request_timeout_ms / 1000.0)
+        # Movement-strategy views (partition_size etc.) are called once per
+        # TASK while sorting a plan; a short-TTL snapshot turns N-task sorts
+        # into one metadata + one logdir sweep instead of N full sweeps.
+        self._view_ttl_s = view_snapshot_ttl_s
+        self._view_cache: dict[str, tuple[float, object]] = {}
 
     # ---- reassignment / leadership ---------------------------------------
     def alter_partition_reassignments(
             self, targets: Mapping[tuple[str, int], tuple[int, ...]]) -> None:
-        from kafka.structs import TopicPartition
-
-        self._admin.alter_partition_reassignments({
-            TopicPartition(t, p): list(replicas)
-            for (t, p), replicas in targets.items()})
+        self._client.alter_partition_reassignments(
+            {tp: list(replicas) for tp, replicas in targets.items()})
 
     def cancel_partition_reassignments(
             self, partitions: Iterable[tuple[str, int]]) -> None:
-        from kafka.structs import TopicPartition
-
-        # KIP-455: a None target cancels the in-flight reassignment.
-        self._admin.alter_partition_reassignments({
-            TopicPartition(t, p): None for (t, p) in partitions})
+        # KIP-455: a null target cancels the in-flight reassignment.
+        self._client.alter_partition_reassignments(
+            {tp: None for tp in partitions})
 
     def elect_leaders(self, partitions: Iterable[tuple[str, int]]) -> None:
-        from kafka.admin import ElectionType
-        from kafka.structs import TopicPartition
-
-        self._admin.perform_leader_election(
-            ElectionType.PREFERRED,
-            [TopicPartition(t, p) for (t, p) in partitions])
+        failed = self._client.elect_leaders(partitions, m.ELECTION_PREFERRED)
+        for topic, part, code in failed:
+            # Per-partition election failures (e.g. preferred replica out of
+            # ISR) degrade to the poll loop: the executor observes leadership
+            # via metadata and times the task out if it never lands.
+            LOG.warning("leader election failed for %s-%d: %s", topic, part,
+                        m.ERROR_NAMES.get(code, code))
 
     def list_reassigning_partitions(self) -> list[tuple[str, int]]:
-        listing = self._admin.list_partition_reassignments()
-        return [(tp.topic, tp.partition) for tp in listing]
+        return list(self._client.list_partition_reassignments())
 
     # ---- metadata --------------------------------------------------------
     def describe_partitions(self) -> dict[tuple[str, int], PartitionState]:
-        listing = self._admin.list_partition_reassignments()
-        items = listing.items() if isinstance(listing, dict) else []
-        reassigning = {(tp.topic, tp.partition): st for tp, st in items}
+        reassigning = self._client.list_partition_reassignments()
+        meta = self._client.metadata(topics=None)
         out: dict[tuple[str, int], PartitionState] = {}
-        for topic_meta in self._admin.describe_topics():
-            topic = topic_meta["topic"]
-            for pm in topic_meta["partitions"]:
-                key = (topic, pm["partition"])
-                ra = reassigning.get(key)
+        for t in meta["topics"]:
+            if t["error_code"] != m.NONE:
+                continue
+            for pm in t["partitions"]:
+                key = (t["name"], pm["index"])
+                ra = reassigning.get(key, {})
                 out[key] = PartitionState(
-                    topic=topic, partition=pm["partition"],
+                    topic=t["name"], partition=pm["index"],
                     replicas=tuple(pm["replicas"]), leader=pm["leader"],
                     isr=tuple(pm["isr"]),
-                    adding=tuple(getattr(ra, "adding_replicas", ()) or ()),
-                    removing=tuple(getattr(ra, "removing_replicas", ()) or ()))
+                    adding=tuple(ra.get("adding", ())),
+                    removing=tuple(ra.get("removing", ())))
         return out
 
     def alive_brokers(self) -> set[int]:
-        return {b["node_id"] if isinstance(b, dict) else b.nodeId
-                for b in self._admin.describe_cluster()["brokers"]}
+        return self._client.alive_broker_ids()
 
-    # ---- configs (emulated incremental semantics) ------------------------
-    def _merge_alter(self, resource_type, name_to_kv, describe):
-        from kafka.admin import ConfigResource
+    # ---- configs (real KIP-339 incremental semantics) --------------------
+    def alter_broker_configs(self,
+                             configs: Mapping[int, Mapping[str, str]]) -> None:
+        self._client.incremental_alter_configs(m.RESOURCE_BROKER,
+                                               dict(configs))
 
-        current = describe([k for k in name_to_kv])
-        resources = []
-        for name, kv in name_to_kv.items():
-            merged = dict(current.get(name, {}))
-            for k, v in kv.items():
-                if v is None:
-                    merged.pop(k, None)
-                else:
-                    merged[k] = str(v)
-            resources.append(ConfigResource(resource_type, str(name),
-                                            configs=merged))
-        self._admin.alter_configs(resources)
-
-    def alter_broker_configs(self, configs: Mapping[int, Mapping[str, str]]) -> None:
-        from kafka.admin import ConfigResourceType
-
-        self._merge_alter(ConfigResourceType.BROKER, dict(configs),
-                          self.describe_broker_configs)
-
-    def alter_topic_configs(self, configs: Mapping[str, Mapping[str, str]]) -> None:
-        from kafka.admin import ConfigResourceType
-
-        self._merge_alter(ConfigResourceType.TOPIC, dict(configs),
-                          self.describe_topic_configs)
-
-    def _describe(self, resource_type, names):
-        from kafka.admin import ConfigResource
-
-        resp = self._admin.describe_configs(
-            [ConfigResource(resource_type, str(n)) for n in names])
-        out = {}
-        for r in resp:
-            resources = getattr(r, "resources", None)
-            if resources is None:
-                raise RuntimeError(
-                    f"unexpected DescribeConfigs response shape: {type(r)!r} "
-                    "has no 'resources' field (kafka-python version drift?)")
-            for res in resources:
-                # DescribeConfigsResponse resource tuple:
-                # (error_code, error_message, resource_type, resource_name,
-                #  config_entries). Named access when available, positional
-                #  fallback with an explicit arity check.
-                if hasattr(res, "resource_name"):
-                    rname, entries = res.resource_name, res.config_entries
-                else:
-                    if len(res) < 5:
-                        raise RuntimeError(
-                            f"unexpected DescribeConfigs resource arity "
-                            f"{len(res)}: {res!r}")
-                    _err, _msg, _rtype, rname, entries = res[:5]
-                out[rname] = {e[0]: e[1] for e in entries}
-        return out
+    def alter_topic_configs(self,
+                            configs: Mapping[str, Mapping[str, str]]) -> None:
+        self._client.incremental_alter_configs(m.RESOURCE_TOPIC,
+                                               dict(configs))
 
     def describe_broker_configs(self, brokers: Iterable[int]
                                 ) -> dict[int, dict[str, str]]:
-        from kafka.admin import ConfigResourceType
-
-        raw = self._describe(ConfigResourceType.BROKER, list(brokers))
+        raw = self._client.describe_configs(m.RESOURCE_BROKER, list(brokers))
         return {int(k): v for k, v in raw.items()}
 
     def describe_topic_configs(self, topics: Iterable[str]
                                ) -> dict[str, dict[str, str]]:
-        from kafka.admin import ConfigResourceType
-
-        return self._describe(ConfigResourceType.TOPIC, list(topics))
+        return self._client.describe_configs(m.RESOURCE_TOPIC, list(topics))
 
     # ---- log dirs (JBOD) -------------------------------------------------
-    def _await_each(self, futures: dict[int, object]) -> dict[int, object]:
-        """Wait for every future individually; failed/timed-out brokers are
-        skipped instead of aborting the batch (KafkaAdminClient's
-        _wait_for_futures raises on the FIRST failure, which would kill the
-        executor's poll thread because one broker was unreachable)."""
-        out: dict[int, object] = {}
-        for broker, f in futures.items():
+    def _each_broker(self, brokers: Iterable[int] | None):
+        """DescribeLogDirs is broker-local state: fan out per broker, and
+        degrade per broker — one unreachable broker must not kill the
+        executor's poll thread (ExecutorAdminUtils semantics)."""
+        targets = (set(brokers) if brokers is not None
+                   else self._client.alive_broker_ids())
+        for b in sorted(targets):
             try:
-                self._admin._wait_for_futures([f])
-            except Exception:  # noqa: BLE001 — per-broker degradation
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "logdir request to broker %s failed", broker,
-                    exc_info=True)
-                continue
-            if f.succeeded():
-                out[broker] = f.value
-        return out
-
-    def _logdir_responses(self, brokers: Iterable[int] | None = None,
-                          ) -> dict[int, object]:
-        """One DescribeLogDirs response PER BROKER (KafkaAdminClient's
-        describe_log_dirs() only asks the least-loaded node; logdir state is
-        broker-local). ``brokers`` restricts the fan-out — the executor
-        passes only the brokers with in-flight moves, matching
-        ExecutorAdminUtils.getLogdirInfoForExecutingReplicaMove."""
-        targets = set(brokers) if brokers is not None else self.alive_brokers()
-        from kafka.protocol.admin import DescribeLogDirsRequest_v0
-
-        futures = {b: self._admin._send_request_to_node(
-            b, DescribeLogDirsRequest_v0()) for b in targets}
-        return self._await_each(futures)
+                yield b, self._client.describe_log_dirs(b)
+            except (ConnectionError, m.KafkaProtocolError):
+                LOG.warning("logdir request to broker %s failed", b,
+                            exc_info=True)
 
     def describe_logdirs(self) -> dict[int, dict[str, bool]]:
         """broker -> {log_dir: healthy} (DiskFailureDetector's view)."""
-        out: dict[int, dict[str, bool]] = {}
-        for broker, resp in self._logdir_responses().items():
-            dirs: dict[str, bool] = {}
-            for entry in resp.log_dirs:
-                error_code, log_dir = entry[0], entry[1]
-                dirs[log_dir] = error_code == 0
-            out[broker] = dirs
-        return out
+        return {b: {r["log_dir"]: r["error_code"] == m.NONE for r in results}
+                for b, results in self._each_broker(None)}
 
     def replica_logdirs(self, brokers: Iterable[int] | None = None,
                         ) -> dict[tuple[str, int, int], str]:
@@ -212,21 +134,19 @@ class KafkaAdminBackend:
         move) entries are skipped so completion polling sees the move only
         once the broker promoted the future replica."""
         out: dict[tuple[str, int, int], str] = {}
-        for broker, resp in self._logdir_responses(brokers).items():
-            for entry in resp.log_dirs:
-                log_dir, topics = entry[1], entry[2]
-                for name, partitions in topics:
-                    for p in partitions:
-                        idx, is_future = p[0], bool(p[3]) if len(p) > 3 else False
-                        if not is_future:
-                            out[(name, idx, broker)] = log_dir
+        for b, results in self._each_broker(brokers):
+            for r in results:
+                for t in r["topics"]:
+                    for p in t["partitions"]:
+                        if not p["is_future_key"]:
+                            out[(t["name"], p["partition_index"], b)] = \
+                                r["log_dir"]
         return out
 
     def alter_replica_logdirs(
-            self, moves) -> list[tuple[str, int, int]]:
-        """((topic, partition), broker, destination_dir) batch →
-        AlterReplicaLogDirs (API key 34) sent to each affected broker
-        (ExecutorAdminUtils.executeIntraBrokerReplicaMovements). Returns the
+            self, moves: Sequence[tuple[tuple[str, int], int, str]],
+            ) -> list[tuple[str, int, int]]:
+        """((topic, partition), broker, destination_dir) batch. Returns the
         (topic, partition, broker) keys the brokers REJECTED (per-partition
         error codes, e.g. LOG_DIR_NOT_FOUND/KAFKA_STORAGE_ERROR) so the
         executor can DEAD-mark them immediately instead of polling a move
@@ -235,64 +155,94 @@ class KafkaAdminBackend:
         for (topic, part), broker, dst in moves:
             by_broker.setdefault(broker, {}).setdefault(dst, {}) \
                 .setdefault(topic, []).append(part)
-        req_cls = _alter_replica_logdirs_request()
-        futures = {}
-        for broker, by_dir in by_broker.items():
-            dirs = [(path, [(topic, parts) for topic, parts in topics.items()])
-                    for path, topics in by_dir.items()]
-            futures[broker] = self._admin._send_request_to_node(
-                broker, req_cls(dirs=dirs))
-        responses = self._await_each(futures)
         failed: list[tuple[str, int, int]] = []
-        for broker in by_broker:
-            resp = responses.get(broker)
-            if resp is None:
-                # Entire broker request failed: every move on it is failed.
-                failed.extend((t, p, broker)
-                              for by_dir in [by_broker[broker]]
-                              for topics in by_dir.values()
-                              for t, parts in topics.items() for p in parts)
+        for broker, by_dir in by_broker.items():
+            try:
+                rejected = self._client.alter_replica_log_dirs(broker, by_dir)
+            except (ConnectionError, m.KafkaProtocolError):
+                LOG.warning("alter_replica_log_dirs to broker %s failed",
+                            broker, exc_info=True)
+                failed.extend(
+                    (t, p, broker)
+                    for topics in by_dir.values()
+                    for t, parts in topics.items() for p in parts)
                 continue
-            for name, partitions in resp.responses:
-                for idx, error_code in partitions:
-                    if error_code != 0:
-                        failed.append((name, idx, broker))
+            failed.extend((t, p, broker) for t, p, _code in rejected)
         return failed
 
+    # ---- movement-strategy views (strategy.ClusterView) ------------------
+    # Called once per task while a plan is sorted; every view reads from a
+    # TTL'd whole-cluster snapshot (one sweep per sort, not per task).
+    def _view(self, key: str, compute):
+        import time
+
+        now = time.time()
+        hit = self._view_cache.get(key)
+        if hit is not None and now - hit[0] <= self._view_ttl_s:
+            return hit[1]
+        value = compute()
+        self._view_cache[key] = (now, value)
+        return value
+
+    def _partitions_view(self) -> dict[tuple[str, int], PartitionState]:
+        return self._view("partitions", self.describe_partitions)
+
+    def _alive_view(self) -> set[int]:
+        return self._view("alive", self.alive_brokers)
+
+    def _sizes_view(self) -> dict[tuple[str, int, int], int]:
+        def sweep():
+            sizes: dict[tuple[str, int, int], int] = {}
+            for b, results in self._each_broker(None):
+                for r in results:
+                    for t in r["topics"]:
+                        for p in t["partitions"]:
+                            sizes[(t["name"], p["partition_index"], b)] = \
+                                p["partition_size"]
+            return sizes
+        return self._view("sizes", sweep)
+
+    def _min_isr_view(self) -> dict[str, int]:
+        def sweep():
+            topics = {t for t, _p in self._partitions_view()}
+            out = {}
+            for t, cfg in self.describe_topic_configs(topics).items():
+                raw = cfg.get("min.insync.replicas")
+                try:
+                    out[t] = int(raw) if raw is not None else 1
+                except (TypeError, ValueError):
+                    out[t] = 1
+            return out
+        return self._view("min_isr", sweep)
+
+    def partition_size(self, topic: str, partition: int) -> float:
+        """Max on-disk size across replicas (DescribeLogDirs partition_size
+        — PrioritizeLargeReplicaMovementStrategy's sort key)."""
+        state = self._partitions_view().get((topic, partition))
+        if state is None:
+            return 0.0
+        sizes = self._sizes_view()
+        return float(max((sizes.get((topic, partition, b), 0)
+                          for b in state.replicas), default=0))
+
+    def is_under_replicated(self, topic: str, partition: int) -> bool:
+        """ISR smaller than the replica set
+        (PostponeUrpReplicaMovementStrategy's predicate)."""
+        state = self._partitions_view().get((topic, partition))
+        return state is not None and len(state.isr) < len(state.replicas)
+
+    def is_under_min_isr_with_offline(self, topic: str,
+                                      partition: int) -> bool:
+        """Live ISR below topic min.insync.replicas AND an offline replica
+        present (PrioritizeMinIsrWithOfflineReplicasStrategy's predicate)."""
+        state = self._partitions_view().get((topic, partition))
+        if state is None:
+            return False
+        alive = self._alive_view()
+        has_offline = any(b not in alive for b in state.replicas)
+        min_isr = self._min_isr_view().get(topic, 1)
+        live_isr = sum(1 for b in state.isr if b in alive)
+        return has_offline and live_isr < min_isr
+
     def close(self) -> None:
-        self._admin.close()
-
-
-def _alter_replica_logdirs_request():
-    """kafka-python ships DescribeLogDirs but (in some versions) not
-    AlterReplicaLogDirs — define the v0 wire schema locally when absent."""
-    try:
-        from kafka.protocol.admin import AlterReplicaLogDirsRequest_v0
-        return AlterReplicaLogDirsRequest_v0
-    except ImportError:
-        from kafka.protocol.api import Request, Response
-        from kafka.protocol.types import Array, Int16, Int32, Schema, String
-
-        class AlterReplicaLogDirsResponse_v0(Response):
-            API_KEY = 34
-            API_VERSION = 0
-            SCHEMA = Schema(
-                ("throttle_time_ms", Int32),
-                ("responses", Array(
-                    ("name", String("utf-8")),
-                    ("partitions", Array(
-                        ("partition_index", Int32),
-                        ("error_code", Int16))))))
-
-        class AlterReplicaLogDirsRequest_v0(Request):
-            API_KEY = 34
-            API_VERSION = 0
-            RESPONSE_TYPE = AlterReplicaLogDirsResponse_v0
-            SCHEMA = Schema(
-                ("dirs", Array(
-                    ("path", String("utf-8")),
-                    ("topics", Array(
-                        ("name", String("utf-8")),
-                        ("partitions", Array(Int32)))))))
-
-        return AlterReplicaLogDirsRequest_v0
+        self._client.close()

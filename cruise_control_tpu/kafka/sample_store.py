@@ -15,9 +15,12 @@ from __future__ import annotations
 
 import json
 import logging
+import time
 
 from ..monitor.sampling.sampler import SamplerResult
-from . import require_kafka
+from .wire import messages as m
+from .wire.client import WireClient
+from .wire.records import Record
 
 LOG = logging.getLogger(__name__)
 
@@ -31,63 +34,99 @@ class KafkaSampleStore:
     def __init__(self, bootstrap_servers: str,
                  partition_topic: str = PARTITION_SAMPLES_TOPIC,
                  training_topic: str = TRAINING_SAMPLES_TOPIC,
-                 group_id: str = "cruise-control-tpu-sample-store",
-                 **kwargs):
-        require_kafka("KafkaSampleStore")
-        self._bootstrap = bootstrap_servers
+                 num_partitions: int = 8, replication_factor: int = 1,
+                 client: WireClient | None = None, **_compat):
+        self._client = client or WireClient(
+            bootstrap_servers, client_id="cruise-control-tpu-samples")
         self._topics = {"partition": partition_topic,
                         "training": training_topic}
-        self._group = group_id
-        self._kwargs = kwargs
-        self._producer = None
+        self._num_partitions = num_partitions
+        self._rf = replication_factor
+        self._rr = 0
+
+    def _ensure_topics(self) -> None:
+        for topic in self._topics.values():
+            self._client.create_topic(
+                topic, self._num_partitions, self._rf,
+                configs={"cleanup.policy": "delete"})
+
+    def _produce_rows(self, topic: str, rows: list[dict]) -> None:
+        if not rows:
+            return
+        now = int(time.time() * 1000)
+        records = [Record(offset=i, timestamp_ms=now, key=None,
+                          value=json.dumps(row).encode())
+                   for i, row in enumerate(rows)]
+        try:
+            parts = sorted(self._client.partitions_for(topic))
+        except m.KafkaProtocolError:
+            parts = []
+        if not parts:
+            self._ensure_topics()
+            try:
+                parts = sorted(self._client.partitions_for(topic))
+            except m.KafkaProtocolError:
+                parts = []
+        if not parts:
+            # Metadata for a just-created topic can lag on a real cluster.
+            raise ConnectionError(
+                f"sample topic {topic!r} has no partitions yet")
+        self._rr = (self._rr + 1) % len(parts)
+        self._client.produce(topic, parts[self._rr], records)
 
     def store_samples(self, result: SamplerResult) -> None:
         from ..monitor.sampling.samples import (
             broker_samples_record, partition_samples_record,
         )
 
-        if self._producer is None:
-            from kafka import KafkaProducer
-
-            self._producer = KafkaProducer(
-                bootstrap_servers=self._bootstrap, acks=1, **self._kwargs)
-        for row in partition_samples_record(result.partition_samples):
-            self._producer.send(self._topics["partition"],
-                                json.dumps(row).encode())
+        self._produce_rows(self._topics["partition"],
+                           list(partition_samples_record(
+                               result.partition_samples)))
         # Broker samples feed the linear CPU model — the reference's
         # "model training samples" topic.
-        for row in broker_samples_record(result.broker_samples):
-            self._producer.send(self._topics["training"],
-                                json.dumps(row).encode())
-        self._producer.flush()
+        self._produce_rows(self._topics["training"],
+                           list(broker_samples_record(result.broker_samples)))
 
     def load_samples(self) -> SamplerResult:
         """Replay both topics from the beginning (warm-start windows after a
         restart — KafkaSampleStore.loadSamples:204)."""
-        from kafka import KafkaConsumer
-
         from ..monitor.sampling.samples import (
             broker_samples_from_record, partition_samples_from_record,
         )
 
-        rows = {"partition": [], "training": []}
+        rows: dict[str, list] = {"partition": [], "training": []}
         for kind, topic in self._topics.items():
-            consumer = KafkaConsumer(
-                topic, bootstrap_servers=self._bootstrap,
-                group_id=None, auto_offset_reset="earliest",
-                enable_auto_commit=False, consumer_timeout_ms=3_000,
-                **self._kwargs)
-            for record in consumer:
-                try:
-                    rows[kind].append(json.loads(record.value))
-                except (ValueError, TypeError):
-                    LOG.warning("skipping undecodable sample record at %s:%d",
-                                topic, record.offset)
-            consumer.close()
+            try:
+                parts = self._client.partitions_for(topic)
+            except m.KafkaProtocolError:
+                continue  # topic absent: cold start
+            for partition in sorted(parts):
+                offset = 0
+                while True:
+                    try:
+                        records, hw = self._client.fetch(topic, partition,
+                                                         offset)
+                    except (ConnectionError, m.KafkaProtocolError):
+                        LOG.warning("sample replay failed for %s-%d", topic,
+                                    partition, exc_info=True)
+                        break
+                    if not records:
+                        break
+                    for r in records:
+                        if r.value is None:
+                            continue
+                        try:
+                            rows[kind].append(json.loads(r.value))
+                        except (ValueError, TypeError):
+                            LOG.warning(
+                                "skipping undecodable sample record at %s:%d",
+                                topic, r.offset)
+                    offset = records[-1].offset + 1
+                    if offset >= hw:
+                        break
         return SamplerResult(
             partition_samples_from_record(rows["partition"]),
             broker_samples_from_record(rows["training"]), 0)
 
     def close(self) -> None:
-        if self._producer is not None:
-            self._producer.close()
+        self._client.close()

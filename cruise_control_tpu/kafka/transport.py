@@ -1,17 +1,21 @@
 """MetricsTransport over the ``__CruiseControlMetrics`` topic.
 
 Reference parity: monitor/sampling/CruiseControlMetricsReporterSampler.java
-(consume the reporter topic between two timestamps) and the reporter's
-producer side (CruiseControlMetricsReporter.java:241-270, topic
-auto-creation included — here exposed as ``ensure_topic`` so the
-broker-side agent can call it through the same transport).
+(consume the reporter topic between two timestamps — its offsetsForTimes
+strategy maps to ListOffsets with a timestamp) and the reporter's producer
+side (CruiseControlMetricsReporter.java:241-270, topic auto-creation
+included — exposed as ``ensure_topic`` so the broker-side agent can call it
+through the same transport).
 """
 
 from __future__ import annotations
 
 import logging
+import time
 
-from . import require_kafka
+from .wire import messages as m
+from .wire.client import WireClient
+from .wire.records import Record
 
 LOG = logging.getLogger(__name__)
 
@@ -23,103 +27,96 @@ class KafkaMetricsTransport:
     the broker-side agent, ``poll(start_ms, end_ms)`` from the sampler."""
 
     def __init__(self, bootstrap_servers: str, topic: str = METRICS_TOPIC,
-                 group_id: str = "cruise-control-tpu-sampler",
                  num_partitions: int = 32, replication_factor: int = 1,
-                 **kwargs):
-        require_kafka("KafkaMetricsTransport")
-        self._bootstrap = bootstrap_servers
+                 client: WireClient | None = None, **_compat):
+        self._client = client or WireClient(
+            bootstrap_servers, client_id="cruise-control-tpu-metrics")
         self._topic = topic
-        self._group = group_id
         self._num_partitions = num_partitions
         self._rf = replication_factor
-        self._kwargs = kwargs
-        self._producer = None
-        self._consumer = None
+        self._pending: list[Record] = []
+        self._rr = 0  # round-robin partition cursor
 
     # ---- topic auto-creation (reporter side) -----------------------------
     def ensure_topic(self) -> None:
         """Create the metrics topic if absent
         (CruiseControlMetricsReporter.maybeCreateTopic)."""
-        from kafka.admin import KafkaAdminClient, NewTopic
-        from kafka.errors import TopicAlreadyExistsError
-
-        admin = KafkaAdminClient(bootstrap_servers=self._bootstrap,
-                                 **self._kwargs)
-        try:
-            admin.create_topics([NewTopic(
-                name=self._topic, num_partitions=self._num_partitions,
-                replication_factor=self._rf,
-                topic_configs={"retention.ms": str(60 * 60 * 1000),
-                               "cleanup.policy": "delete"})])
-        except TopicAlreadyExistsError:
-            pass
-        finally:
-            admin.close()
+        self._client.create_topic(
+            self._topic, self._num_partitions, self._rf,
+            configs={"retention.ms": str(60 * 60 * 1000),
+                     "cleanup.policy": "delete"})
 
     # ---- MetricsTransport protocol ---------------------------------------
     def produce(self, payload: bytes) -> None:
-        if self._producer is None:
-            from kafka import KafkaProducer
-
-            self._producer = KafkaProducer(
-                bootstrap_servers=self._bootstrap, acks=1,
-                linger_ms=100, **self._kwargs)
-        self._producer.send(self._topic, payload)
+        self._pending.append(Record(
+            offset=0, timestamp_ms=int(time.time() * 1000),
+            key=None, value=payload))
 
     def flush(self) -> None:
-        if self._producer is not None:
-            self._producer.flush()
+        if not self._pending:
+            return
+        batch, self._pending = self._pending, []
+        try:
+            try:
+                parts = sorted(self._client.partitions_for(self._topic))
+            except m.KafkaProtocolError:
+                parts = []
+            if not parts:
+                self.ensure_topic()
+                try:
+                    parts = sorted(self._client.partitions_for(self._topic))
+                except m.KafkaProtocolError:
+                    parts = []
+            if not parts:
+                # Metadata for a just-created topic can lag on a real
+                # cluster (transient LEADER_NOT_AVAILABLE window).
+                raise ConnectionError(
+                    f"metrics topic {self._topic!r} has no partitions yet")
+            self._rr = (self._rr + 1) % len(parts)
+            for i, rec in enumerate(batch):
+                rec.offset = i
+            self._client.produce(self._topic, parts[self._rr], batch)
+        except (ConnectionError, m.KafkaProtocolError):
+            # Re-queue so a transient broker blip does not punch a hole in
+            # the metric windows the load model trains on (the Java
+            # producer's in-flight buffer gives the reference the same
+            # durability, CruiseControlMetricsReporter.java:241).
+            self._pending = batch + self._pending
+            raise
 
     def poll(self, start_ms: int, end_ms: int) -> list[bytes]:
         """All payloads with record timestamp in [start_ms, end_ms): seek
-        each partition to the start offset by time, read to the end
-        offset (the reference sampler's offsetsForTimes strategy)."""
-        from kafka import KafkaConsumer, TopicPartition
-
-        if self._consumer is None:
-            self._consumer = KafkaConsumer(
-                bootstrap_servers=self._bootstrap, group_id=self._group,
-                enable_auto_commit=False, consumer_timeout_ms=2_000,
-                **self._kwargs)
-        consumer = self._consumer
-        parts = consumer.partitions_for_topic(self._topic) or set()
-        tps = [TopicPartition(self._topic, p) for p in sorted(parts)]
-        if not tps:
-            return []
-        consumer.assign(tps)
-        start_offsets = consumer.offsets_for_times({tp: start_ms for tp in tps})
-        end_offsets = consumer.end_offsets(tps)
+        each partition to the start offset by time (ListOffsets), read to
+        the high watermark, filter BOTH bounds so adjacent windows never
+        double-count under producer clock skew."""
         out: list[bytes] = []
-        remaining: dict = {}
-        for tp in tps:
-            start = start_offsets.get(tp)
-            end = end_offsets.get(tp, 0)
-            # Partitions with no record at/after start_ms (None) or nothing
-            # between the seek point and the end offset will never deliver:
-            # keeping them in `remaining` would make every poll stall out
-            # the full consumer timeout.
-            if start is None or end <= start.offset:
-                continue
-            consumer.seek(tp, start.offset)
-            remaining[tp] = end
-        if not remaining:
+        try:
+            parts = self._client.partitions_for(self._topic)
+        except m.KafkaProtocolError:
             return []
-        consumer.assign(list(remaining))
-        for record in consumer:
-            # offsets_for_times seeks by timestamp index, but later offsets
-            # can carry earlier CreateTime stamps (producer clock skew):
-            # filter BOTH bounds so adjacent windows never double-count.
-            if start_ms <= record.timestamp < end_ms:
-                out.append(record.value)
-            tp = type(tps[0])(record.topic, record.partition)
-            if record.offset + 1 >= remaining.get(tp, 0):
-                remaining.pop(tp, None)
-                if not remaining:
-                    break
+        for partition in sorted(parts):
+            try:
+                start, _ts = self._client.list_offsets(self._topic, partition,
+                                                       start_ms)
+                if start < 0:  # no record at/after start_ms
+                    continue
+                offset = start
+                while True:
+                    records, hw = self._client.fetch(self._topic, partition,
+                                                     offset)
+                    if not records:
+                        break
+                    for r in records:
+                        if start_ms <= r.timestamp_ms < end_ms \
+                                and r.value is not None:
+                            out.append(r.value)
+                    offset = records[-1].offset + 1
+                    if offset >= hw:
+                        break
+            except (ConnectionError, m.KafkaProtocolError):
+                LOG.warning("metrics poll failed for %s-%d", self._topic,
+                            partition, exc_info=True)
         return out
 
     def close(self) -> None:
-        if self._producer is not None:
-            self._producer.close()
-        if self._consumer is not None:
-            self._consumer.close()
+        self._client.close()

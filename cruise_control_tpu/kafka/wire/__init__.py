@@ -1,0 +1,22 @@
+"""Self-contained Kafka wire-protocol implementation.
+
+The framework's own Kafka client — no external client library. The
+reference links the official Java AdminClient/Consumer/Producer
+(ExecutorAdminUtils.java, KafkaSampleStore.java:94,
+CruiseControlMetricsReporterSampler.java); this environment has no Kafka
+client at all, so the binding implements the protocol itself:
+
+- ``types``    — primitive + composite codecs (incl. flexible/compact
+                 encodings and tagged fields, KIP-482).
+- ``records``  — record-batch v2 serde (varint records, CRC32C framing).
+- ``messages`` — request/response schemas for the APIs the framework
+                 uses (metadata, configs, reassignment, leader election,
+                 log dirs, produce/fetch/list-offsets, create-topics).
+- ``client``   — blocking client: connection pool, correlation,
+                 metadata routing, produce/fetch/admin calls.
+- ``broker``   — an EMBEDDED in-process broker speaking the same wire
+                 format, the integration-test tier standing in for the
+                 reference's CCKafkaIntegrationTestHarness (real sockets,
+                 real bytes, no external processes).
+"""
+

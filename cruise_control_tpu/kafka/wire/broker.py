@@ -1,0 +1,669 @@
+"""Embedded in-process Kafka cluster speaking the real wire protocol.
+
+The integration-test tier: the reference boots actual broker JVMs
+(CCKafkaIntegrationTestHarness, CruiseControlIntegrationTestHarness.java:17);
+this environment has no Kafka distribution, so the harness implements the
+broker side of the same wire format the client speaks — every integration
+test round-trips real bytes over real sockets through both codec stacks.
+
+One ``EmbeddedKafkaCluster`` runs N TCP listeners (one per broker id)
+sharing one cluster state, so per-broker APIs (DescribeLogDirs,
+AlterReplicaLogDirs, broker DescribeConfigs) behave like the real thing:
+the answer depends on which broker you ask.
+
+Failure injection for detector/executor tests:
+- ``kill_broker(id)``        — listener stops accepting (dead broker)
+- ``set_logdir_health(...)`` — storage errors on DescribeLogDirs
+- ``auto_complete_reassignments=False`` + ``complete_reassignments()``
+  — hold reassignments in flight so poll loops are observable.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+
+from . import messages as m
+from .records import Record, decode_batches, encode_batch
+from .types import NullableString, TaggedFields, decode, encode
+
+LOG = logging.getLogger(__name__)
+
+DEFAULT_LOGDIRS = ("/data/d0", "/data/d1")
+
+
+@dataclass
+class PartitionLog:
+    replicas: list[int]
+    leader: int
+    isr: list[int]
+    records: list[Record] = field(default_factory=list)
+    next_offset: int = 0
+    adding: list[int] = field(default_factory=list)
+    removing: list[int] = field(default_factory=list)
+    target: list[int] | None = None          # in-flight reassignment target
+    logdir: dict[int, str] = field(default_factory=dict)  # broker -> dir
+
+
+@dataclass
+class TopicState:
+    partitions: dict[int, PartitionLog]
+    configs: dict[str, str] = field(default_factory=dict)
+    is_internal: bool = False
+
+
+class EmbeddedKafkaCluster:
+    def __init__(self, num_brokers: int = 1,
+                 racks: dict[int, str] | None = None,
+                 logdirs: tuple[str, ...] = DEFAULT_LOGDIRS,
+                 auto_complete_reassignments: bool = True,
+                 host: str = "127.0.0.1"):
+        self._host = host
+        self._lock = threading.RLock()
+        self.topics: dict[str, TopicState] = {}
+        self.broker_ids = list(range(num_brokers))
+        self.racks = racks or {}
+        self.logdir_names = logdirs
+        self.logdir_health: dict[int, dict[str, bool]] = {
+            b: {d: True for d in logdirs} for b in self.broker_ids}
+        self.broker_configs: dict[int, dict[str, str]] = {
+            b: {} for b in self.broker_ids}
+        self.auto_complete = auto_complete_reassignments
+        self._servers: dict[int, socket.socket] = {}
+        self._ports: dict[int, int] = {}
+        self._threads: list[threading.Thread] = []
+        self._dead: set[int] = set()
+        self._running = False
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self) -> "EmbeddedKafkaCluster":
+        self._running = True
+        for broker_id in self.broker_ids:
+            self._start_listener(broker_id)
+        return self
+
+    def _start_listener(self, broker_id: int) -> None:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self._host, self._ports.get(broker_id, 0)))
+        srv.listen(16)
+        # Timed accept: a thread blocked in accept() pins the listener's
+        # open file description, so close() from kill_broker()/stop() would
+        # leave the port LISTENING forever. The timeout bounds how long the
+        # accept loop can hold it after shutdown.
+        srv.settimeout(0.1)
+        self._ports[broker_id] = srv.getsockname()[1]
+        self._servers[broker_id] = srv
+        t = threading.Thread(target=self._accept_loop,
+                             args=(broker_id, srv),
+                             name=f"embedded-kafka-{broker_id}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._running = False
+        for srv in self._servers.values():
+            try:
+                srv.close()
+            except OSError:
+                pass
+        self._servers.clear()
+
+    @property
+    def bootstrap_servers(self) -> str:
+        return ",".join(f"{self._host}:{self._ports[b]}"
+                        for b in self.broker_ids if b not in self._dead)
+
+    def port_of(self, broker_id: int) -> int:
+        return self._ports[broker_id]
+
+    # ---- failure injection ----------------------------------------------
+    def kill_broker(self, broker_id: int) -> None:
+        self._dead.add(broker_id)
+        srv = self._servers.pop(broker_id, None)
+        if srv is not None:
+            srv.close()
+
+    def revive_broker(self, broker_id: int) -> None:
+        self._dead.discard(broker_id)
+        # The port may linger in CLOSE_WAIT until per-connection server
+        # threads notice the peer hung up; retry the bind briefly.
+        deadline = time.time() + 5.0
+        while True:
+            try:
+                self._start_listener(broker_id)
+                return
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.05)
+
+    def set_logdir_health(self, broker_id: int, logdir: str,
+                          healthy: bool) -> None:
+        self.logdir_health[broker_id][logdir] = healthy
+
+    def complete_reassignments(self) -> int:
+        """Finish every in-flight reassignment (manual mode)."""
+        with self._lock:
+            n = 0
+            for topic in self.topics.values():
+                for p in topic.partitions.values():
+                    if p.target is not None:
+                        self._finish_reassignment(p)
+                        n += 1
+            return n
+
+    def _finish_reassignment(self, p: PartitionLog) -> None:
+        assert p.target is not None
+        default_dir = self.logdir_names[0]
+        for b in p.target:
+            p.logdir.setdefault(b, default_dir)
+        for b in p.removing:
+            p.logdir.pop(b, None)
+        p.replicas = list(p.target)
+        p.isr = [b for b in p.replicas if b not in self._dead]
+        if p.leader not in p.replicas:
+            p.leader = next((b for b in p.replicas if b in p.isr), -1)
+        p.adding, p.removing, p.target = [], [], None
+
+    # ---- topic helpers (test setup) -------------------------------------
+    def create_topic(self, name: str, num_partitions: int = 1, rf: int = 1,
+                     configs: dict[str, str] | None = None,
+                     assignment: dict[int, list[int]] | None = None) -> None:
+        with self._lock:
+            alive = [b for b in self.broker_ids if b not in self._dead]
+            parts: dict[int, PartitionLog] = {}
+            for i in range(num_partitions):
+                replicas = (assignment[i] if assignment
+                            else [alive[(i + j) % len(alive)]
+                                  for j in range(min(rf, len(alive)))])
+                parts[i] = PartitionLog(
+                    replicas=list(replicas), leader=replicas[0],
+                    isr=list(replicas),
+                    logdir={b: self.logdir_names[0] for b in replicas})
+            self.topics[name] = TopicState(
+                partitions=parts, configs=dict(configs or {}),
+                is_internal=name.startswith("__"))
+
+    # ---- server loop -----------------------------------------------------
+    def _accept_loop(self, broker_id: int, srv: socket.socket) -> None:
+        with srv:
+            while self._running and broker_id not in self._dead \
+                    and srv is self._servers.get(broker_id):
+                try:
+                    conn, _addr = srv.accept()
+                except TimeoutError:
+                    continue
+                except OSError:
+                    return
+                t = threading.Thread(target=self._serve,
+                                     args=(broker_id, conn), daemon=True)
+                t.start()
+
+    def _read_exact(self, conn: socket.socket, n: int) -> bytes | None:
+        chunks = []
+        while n:
+            try:
+                chunk = conn.recv(n)
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def _serve(self, broker_id: int, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with conn:
+            while self._running and broker_id not in self._dead:
+                head = self._read_exact(conn, 4)
+                if head is None:
+                    return
+                (size,) = struct.unpack(">i", head)
+                frame = self._read_exact(conn, size)
+                if frame is None:
+                    return
+                try:
+                    reply = self._handle(broker_id, memoryview(frame))
+                except Exception:
+                    LOG.exception("embedded broker %d: request failed",
+                                  broker_id)
+                    return
+                try:
+                    conn.sendall(struct.pack(">i", len(reply)) + reply)
+                except OSError:
+                    return
+
+    def _handle(self, broker_id: int, frame: memoryview) -> bytes:
+        api_key, version, correlation = struct.unpack_from(">hhi", frame, 0)
+        pos = 8
+        _client_id, pos = NullableString.read(frame, pos)
+        api = m.BY_KEY.get(api_key)
+        if api is None or api.version != version:
+            raise ValueError(f"unsupported api {api_key} v{version}")
+        if api.flexible:
+            _tags, pos = TaggedFields.read(frame, pos)
+        request = decode(api.request, frame[pos:])
+        with self._lock:
+            response = self._dispatch(broker_id, api_key, request)
+        head = bytearray(struct.pack(">i", correlation))
+        if api.flexible:  # response header v1
+            TaggedFields.write(head, None)
+        return bytes(head) + encode(api.response, response)
+
+    def _dispatch(self, broker_id: int, api_key: int, req: dict) -> dict:
+        handler = {
+            m.API_API_VERSIONS: self._h_api_versions,
+            m.API_METADATA: self._h_metadata,
+            m.API_CREATE_TOPICS: self._h_create_topics,
+            m.API_PRODUCE: self._h_produce,
+            m.API_FETCH: self._h_fetch,
+            m.API_LIST_OFFSETS: self._h_list_offsets,
+            m.API_DESCRIBE_CONFIGS: self._h_describe_configs,
+            m.API_ALTER_CONFIGS: self._h_alter_configs,
+            m.API_INCREMENTAL_ALTER_CONFIGS: self._h_incremental_alter,
+            m.API_ALTER_PARTITION_REASSIGNMENTS: self._h_alter_reassign,
+            m.API_LIST_PARTITION_REASSIGNMENTS: self._h_list_reassign,
+            m.API_ELECT_LEADERS: self._h_elect_leaders,
+            m.API_DESCRIBE_LOG_DIRS: self._h_describe_log_dirs,
+            m.API_ALTER_REPLICA_LOG_DIRS: self._h_alter_replica_log_dirs,
+        }[api_key]
+        return handler(broker_id, req)
+
+    # ---- handlers --------------------------------------------------------
+    def _h_api_versions(self, broker_id: int, req: dict) -> dict:
+        return {"error_code": m.NONE,
+                "api_keys": [{"api_key": a.key, "min_version": a.version,
+                              "max_version": a.version} for a in m.ALL_APIS]}
+
+    def _alive(self) -> list[int]:
+        return [b for b in self.broker_ids if b not in self._dead]
+
+    def _h_metadata(self, broker_id: int, req: dict) -> dict:
+        names = req["topics"]
+        if names is None:
+            names = list(self.topics)
+        topics = []
+        for name in names:
+            t = self.topics.get(name)
+            if t is None:
+                topics.append({"error_code": m.UNKNOWN_TOPIC_OR_PARTITION,
+                               "name": name, "is_internal": False,
+                               "partitions": []})
+                continue
+            topics.append({
+                "error_code": m.NONE, "name": name,
+                "is_internal": t.is_internal,
+                "partitions": [
+                    {"error_code": m.NONE, "index": i,
+                     "leader": p.leader, "replicas": list(p.replicas),
+                     "isr": list(p.isr)}
+                    for i, p in sorted(t.partitions.items())]})
+        alive = self._alive()
+        return {
+            "brokers": [{"node_id": b, "host": self._host,
+                         "port": self._ports[b],
+                         "rack": self.racks.get(b)} for b in alive],
+            "controller_id": alive[0] if alive else -1,
+            "topics": topics}
+
+    def _h_create_topics(self, broker_id: int, req: dict) -> dict:
+        out = []
+        for t in req["topics"]:
+            if t["name"] in self.topics:
+                out.append({"name": t["name"],
+                            "error_code": m.TOPIC_ALREADY_EXISTS})
+                continue
+            self.create_topic(
+                t["name"], max(t["num_partitions"], 1),
+                max(t["replication_factor"], 1),
+                configs={c["name"]: c["value"] for c in t["configs"]
+                         if c["value"] is not None},
+                assignment={a["partition_index"]: a["broker_ids"]
+                            for a in t["assignments"]} or None)
+            out.append({"name": t["name"], "error_code": m.NONE})
+        return {"topics": out}
+
+    def _partition(self, topic: str, index: int) -> PartitionLog | None:
+        t = self.topics.get(topic)
+        return t.partitions.get(index) if t else None
+
+    def _h_produce(self, broker_id: int, req: dict) -> dict:
+        topics_out = []
+        for t in req["topics"]:
+            parts_out = []
+            for pr in t["partitions"]:
+                p = self._partition(t["name"], pr["index"])
+                if p is None:
+                    parts_out.append(
+                        {"index": pr["index"],
+                         "error_code": m.UNKNOWN_TOPIC_OR_PARTITION,
+                         "base_offset": -1, "log_append_time_ms": -1})
+                    continue
+                if p.leader != broker_id:
+                    parts_out.append(
+                        {"index": pr["index"],
+                         "error_code": m.NOT_LEADER_OR_FOLLOWER,
+                         "base_offset": -1, "log_append_time_ms": -1})
+                    continue
+                base = p.next_offset
+                for rec in decode_batches(pr["records"] or b""):
+                    p.records.append(Record(
+                        offset=p.next_offset,
+                        timestamp_ms=rec.timestamp_ms,
+                        key=rec.key, value=rec.value, headers=rec.headers))
+                    p.next_offset += 1
+                parts_out.append({"index": pr["index"], "error_code": m.NONE,
+                                  "base_offset": base,
+                                  "log_append_time_ms": -1})
+            topics_out.append({"name": t["name"], "partitions": parts_out})
+        return {"topics": topics_out, "throttle_time_ms": 0}
+
+    def _h_fetch(self, broker_id: int, req: dict) -> dict:
+        topics_out = []
+        for t in req["topics"]:
+            parts_out = []
+            for pr in t["partitions"]:
+                p = self._partition(t["name"], pr["index"])
+                if p is None:
+                    parts_out.append({
+                        "index": pr["index"],
+                        "error_code": m.UNKNOWN_TOPIC_OR_PARTITION,
+                        "high_watermark": -1, "last_stable_offset": -1,
+                        "aborted_transactions": None, "records": None})
+                    continue
+                offset = pr["fetch_offset"]
+                if offset > p.next_offset or offset < 0:
+                    parts_out.append({
+                        "index": pr["index"],
+                        "error_code": m.OFFSET_OUT_OF_RANGE,
+                        "high_watermark": p.next_offset,
+                        "last_stable_offset": p.next_offset,
+                        "aborted_transactions": None, "records": None})
+                    continue
+                window = [r for r in p.records if r.offset >= offset]
+                budget = pr["max_bytes"]
+                batch = b""
+                if window:
+                    # Paginate by WHOLE batches: grow the record count until
+                    # the encoding would exceed the byte budget, always
+                    # returning at least one record (the real broker's
+                    # at-least-one-complete-batch contract). A truncated
+                    # partial batch would decode to [] and read as
+                    # end-of-data — silent data loss past the budget point.
+                    n = len(window)
+                    batch = encode_batch(window)
+                    while len(batch) > budget and n > 1:
+                        n = max(1, n // 2)
+                        batch = encode_batch(window[:n])
+                parts_out.append({
+                    "index": pr["index"], "error_code": m.NONE,
+                    "high_watermark": p.next_offset,
+                    "last_stable_offset": p.next_offset,
+                    "aborted_transactions": None,
+                    "records": batch})
+            topics_out.append({"name": t["name"], "partitions": parts_out})
+        return {"throttle_time_ms": 0, "topics": topics_out}
+
+    def _h_list_offsets(self, broker_id: int, req: dict) -> dict:
+        topics_out = []
+        for t in req["topics"]:
+            parts_out = []
+            for pr in t["partitions"]:
+                p = self._partition(t["name"], pr["index"])
+                if p is None:
+                    parts_out.append({
+                        "index": pr["index"],
+                        "error_code": m.UNKNOWN_TOPIC_OR_PARTITION,
+                        "timestamp_ms": -1, "offset": -1})
+                    continue
+                ts = pr["timestamp_ms"]
+                if ts == m.LATEST_TIMESTAMP:
+                    offset, rts = p.next_offset, -1
+                elif ts == m.EARLIEST_TIMESTAMP:
+                    offset = p.records[0].offset if p.records else 0
+                    rts = -1
+                else:
+                    hit = next((r for r in p.records
+                                if r.timestamp_ms >= ts), None)
+                    offset = hit.offset if hit else -1
+                    rts = hit.timestamp_ms if hit else -1
+                parts_out.append({"index": pr["index"], "error_code": m.NONE,
+                                  "timestamp_ms": rts, "offset": offset})
+            topics_out.append({"name": t["name"], "partitions": parts_out})
+        return {"topics": topics_out}
+
+    def _h_describe_configs(self, broker_id: int, req: dict) -> dict:
+        results = []
+        for r in req["resources"]:
+            if r["resource_type"] == m.RESOURCE_TOPIC:
+                t = self.topics.get(r["resource_name"])
+                if t is None:
+                    results.append({
+                        "error_code": m.UNKNOWN_TOPIC_OR_PARTITION,
+                        "error_message": "unknown topic",
+                        "resource_type": r["resource_type"],
+                        "resource_name": r["resource_name"], "configs": []})
+                    continue
+                configs = t.configs
+            else:
+                configs = self.broker_configs.get(
+                    int(r["resource_name"]), {})
+            keys = r["configuration_keys"]
+            results.append({
+                "error_code": m.NONE, "error_message": None,
+                "resource_type": r["resource_type"],
+                "resource_name": r["resource_name"],
+                "configs": [
+                    {"name": k, "value": v, "read_only": False,
+                     "is_default": False, "is_sensitive": False}
+                    for k, v in configs.items()
+                    if keys is None or k in keys]})
+        return {"throttle_time_ms": 0, "results": results}
+
+    def _config_store(self, resource_type: int, name: str) -> dict | None:
+        if resource_type == m.RESOURCE_TOPIC:
+            t = self.topics.get(name)
+            return t.configs if t else None
+        return self.broker_configs.setdefault(int(name), {})
+
+    def _h_alter_configs(self, broker_id: int, req: dict) -> dict:
+        responses = []
+        for r in req["resources"]:
+            store = self._config_store(r["resource_type"],
+                                       r["resource_name"])
+            if store is None:
+                responses.append({
+                    "error_code": m.UNKNOWN_TOPIC_OR_PARTITION,
+                    "error_message": "unknown topic",
+                    "resource_type": r["resource_type"],
+                    "resource_name": r["resource_name"]})
+                continue
+            if not req["validate_only"]:
+                store.clear()  # legacy AlterConfigs = full replace
+                for c in r["configs"]:
+                    if c["value"] is not None:
+                        store[c["name"]] = c["value"]
+            responses.append({"error_code": m.NONE, "error_message": None,
+                              "resource_type": r["resource_type"],
+                              "resource_name": r["resource_name"]})
+        return {"throttle_time_ms": 0, "responses": responses}
+
+    def _h_incremental_alter(self, broker_id: int, req: dict) -> dict:
+        responses = []
+        for r in req["resources"]:
+            store = self._config_store(r["resource_type"],
+                                       r["resource_name"])
+            if store is None:
+                responses.append({
+                    "error_code": m.UNKNOWN_TOPIC_OR_PARTITION,
+                    "error_message": "unknown topic",
+                    "resource_type": r["resource_type"],
+                    "resource_name": r["resource_name"]})
+                continue
+            if not req["validate_only"]:
+                for c in r["configs"]:
+                    if c["config_operation"] == m.OP_DELETE:
+                        store.pop(c["name"], None)
+                    elif c["config_operation"] == m.OP_SET:
+                        store[c["name"]] = c["value"] or ""
+            responses.append({"error_code": m.NONE, "error_message": None,
+                              "resource_type": r["resource_type"],
+                              "resource_name": r["resource_name"]})
+        return {"throttle_time_ms": 0, "responses": responses}
+
+    def _h_alter_reassign(self, broker_id: int, req: dict) -> dict:
+        responses = []
+        for t in req["topics"] or []:
+            parts_out = []
+            for pr in t["partitions"] or []:
+                p = self._partition(t["name"], pr["partition_index"])
+                if p is None:
+                    parts_out.append({
+                        "partition_index": pr["partition_index"],
+                        "error_code": m.UNKNOWN_TOPIC_OR_PARTITION,
+                        "error_message": "unknown partition"})
+                    continue
+                target = pr["replicas"]
+                if target is None:  # cancel
+                    if p.target is None:
+                        parts_out.append({
+                            "partition_index": pr["partition_index"],
+                            "error_code": m.NO_REASSIGNMENT_IN_PROGRESS,
+                            "error_message": None})
+                        continue
+                    p.replicas = [b for b in p.replicas
+                                  if b not in p.adding]
+                    p.isr = [b for b in p.isr if b in p.replicas]
+                    p.adding, p.removing, p.target = [], [], None
+                    if p.leader not in p.replicas:
+                        p.leader = p.replicas[0] if p.replicas else -1
+                else:
+                    original = [b for b in p.replicas if b not in p.adding]
+                    p.target = list(target)
+                    p.adding = [b for b in target if b not in original]
+                    p.removing = [b for b in original if b not in target]
+                    # Full replica set during the move (URP view).
+                    p.replicas = original + [b for b in p.adding]
+                    if self.auto_complete:
+                        self._finish_reassignment(p)
+                parts_out.append({
+                    "partition_index": pr["partition_index"],
+                    "error_code": m.NONE, "error_message": None})
+            responses.append({"name": t["name"], "partitions": parts_out})
+        return {"throttle_time_ms": 0, "error_code": m.NONE,
+                "error_message": None, "responses": responses}
+
+    def _h_list_reassign(self, broker_id: int, req: dict) -> dict:
+        topics_out = []
+        for name, t in self.topics.items():
+            parts = [{"partition_index": i, "replicas": list(p.replicas),
+                      "adding_replicas": list(p.adding),
+                      "removing_replicas": list(p.removing)}
+                     for i, p in t.partitions.items() if p.target is not None]
+            if parts:
+                topics_out.append({"name": name, "partitions": parts})
+        return {"throttle_time_ms": 0, "error_code": m.NONE,
+                "error_message": None, "topics": topics_out}
+
+    def _h_elect_leaders(self, broker_id: int, req: dict) -> dict:
+        results = []
+        targets: list[tuple[str, list[int]]]
+        if req["topic_partitions"] is None:
+            targets = [(name, list(t.partitions))
+                       for name, t in self.topics.items()]
+        else:
+            targets = [(e["topic"], e["partitions"])
+                       for e in req["topic_partitions"]]
+        for name, parts in targets:
+            parts_out = []
+            for i in parts:
+                p = self._partition(name, i)
+                if p is None:
+                    parts_out.append({
+                        "partition_id": i,
+                        "error_code": m.UNKNOWN_TOPIC_OR_PARTITION,
+                        "error_message": None})
+                    continue
+                preferred = p.replicas[0] if p.replicas else -1
+                if p.leader == preferred:
+                    parts_out.append({"partition_id": i,
+                                      "error_code": m.ELECTION_NOT_NEEDED,
+                                      "error_message": None})
+                elif preferred in p.isr and preferred not in self._dead:
+                    p.leader = preferred
+                    parts_out.append({"partition_id": i,
+                                      "error_code": m.NONE,
+                                      "error_message": None})
+                else:
+                    parts_out.append({
+                        "partition_id": i,
+                        "error_code": m.PREFERRED_LEADER_NOT_AVAILABLE,
+                        "error_message": "preferred replica not in ISR"})
+            results.append({"topic": name, "partition_results": parts_out})
+        return {"throttle_time_ms": 0, "error_code": m.NONE,
+                "replica_election_results": results}
+
+    def _h_describe_log_dirs(self, broker_id: int, req: dict) -> dict:
+        wanted = None
+        if req["topics"] is not None:
+            wanted = {(t["topic"], i)
+                      for t in req["topics"] for i in t["partitions"]}
+        results = []
+        for d in self.logdir_names:
+            healthy = self.logdir_health[broker_id].get(d, True)
+            topics_out: dict[str, list[dict]] = {}
+            for name, t in self.topics.items():
+                for i, p in t.partitions.items():
+                    if wanted is not None and (name, i) not in wanted:
+                        continue
+                    if p.logdir.get(broker_id) == d:
+                        topics_out.setdefault(name, []).append({
+                            "partition_index": i,
+                            "partition_size": sum(
+                                len(r.value or b"") for r in p.records),
+                            "offset_lag": 0, "is_future_key": False})
+            results.append({
+                "error_code": m.NONE if healthy else m.KAFKA_STORAGE_ERROR,
+                "log_dir": d,
+                "topics": [{"name": n, "partitions": ps}
+                           for n, ps in topics_out.items()]})
+        return {"throttle_time_ms": 0, "results": results}
+
+    def _h_alter_replica_log_dirs(self, broker_id: int, req: dict) -> dict:
+        by_topic: dict[str, list[dict]] = {}
+        for d in req["dirs"]:
+            path = d["path"]
+            for t in d["topics"]:
+                for i in t["partitions"]:
+                    p = self._partition(t["name"], i)
+                    if p is None or broker_id not in p.replicas:
+                        code = m.REPLICA_NOT_AVAILABLE
+                    elif path not in self.logdir_names:
+                        code = m.LOG_DIR_NOT_FOUND
+                    elif not self.logdir_health[broker_id].get(path, True):
+                        code = m.KAFKA_STORAGE_ERROR
+                    else:
+                        p.logdir[broker_id] = path
+                        code = m.NONE
+                    by_topic.setdefault(t["name"], []).append(
+                        {"partition_index": i, "error_code": code})
+        return {"throttle_time_ms": 0,
+                "results": [{"topic_name": n, "partitions": ps}
+                            for n, ps in by_topic.items()]}
+
+
+def wait_port_open(host: str, port: int, timeout_s: float = 5.0) -> None:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            with socket.create_connection((host, port), timeout=0.5):
+                return
+        except OSError:
+            time.sleep(0.02)
+    raise TimeoutError(f"{host}:{port} never opened")

@@ -1,0 +1,415 @@
+"""Blocking wire client: connections, correlation, metadata routing.
+
+A deliberately small synchronous client — the framework's Kafka traffic is
+low-rate control-plane calls (admin ops, metric/sample topics), not a
+streaming data plane, so one in-flight request per connection with
+correlation-id verification is the right simplicity/safety trade-off.
+
+Reference parity: the Java AdminClient/Producer/Consumer surface used by
+ExecutorAdminUtils.java, CruiseControlMetricsReporter.java:241,
+KafkaSampleStore.java:94-204 — collapsed to the calls the framework makes.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from typing import Iterable, Mapping, Sequence
+
+from . import messages as m
+from .records import Record, decode_batches, encode_batch
+from .types import NullableString, TaggedFields, decode, encode
+
+LOG = logging.getLogger(__name__)
+
+
+class ConnectionError_(ConnectionError):
+    pass
+
+
+class BrokerConnection:
+    """One TCP connection; thread-safe, one request in flight."""
+
+    def __init__(self, host: str, port: int, client_id: str,
+                 timeout_s: float = 30.0):
+        self._addr = (host, port)
+        self._client_id = client_id
+        self._timeout = timeout_s
+        self._sock: socket.socket | None = None
+        self._correlation = 0
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(self._addr, timeout=self._timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def _read_exact(self, n: int) -> bytes:
+        sock = self._sock
+        assert sock is not None
+        chunks = []
+        while n:
+            chunk = sock.recv(n)
+            if not chunk:
+                raise ConnectionError_(f"connection to {self._addr} closed")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def send(self, api: m.Api, body: dict) -> dict:
+        with self._lock:
+            self._correlation += 1
+            corr = self._correlation
+            # Request header v2 for flexible APIs, v1 otherwise.
+            head = bytearray(struct.pack(">hhi", api.key, api.version, corr))
+            NullableString.write(head, self._client_id)
+            if api.flexible:
+                TaggedFields.write(head, None)
+            payload = bytes(head) + encode(api.request, body)
+            try:
+                sock = self._connect()
+                sock.sendall(struct.pack(">i", len(payload)) + payload)
+                (size,) = struct.unpack(">i", self._read_exact(4))
+                frame = self._read_exact(size)
+            except (OSError, ConnectionError) as e:
+                self.close()
+                raise ConnectionError_(
+                    f"request to {self._addr} failed: {e}") from e
+            (rcorr,) = struct.unpack_from(">i", frame, 0)
+            if rcorr != corr:
+                self.close()
+                raise ConnectionError_(
+                    f"correlation mismatch from {self._addr}: "
+                    f"sent {corr}, got {rcorr}")
+            pos = 4
+            if api.flexible:  # response header v1 carries tagged fields
+                _tags, pos = TaggedFields.read(memoryview(frame), pos)
+            return decode(api.response, memoryview(frame)[pos:])
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+
+def _parse_bootstrap(servers: str | Sequence[str]) -> list[tuple[str, int]]:
+    if isinstance(servers, str):
+        servers = [s for s in servers.split(",") if s.strip()]
+    out = []
+    for s in servers:
+        host, _, port = s.strip().rpartition(":")
+        out.append((host or "localhost", int(port)))
+    return out
+
+
+class WireClient:
+    """Cluster-level operations over per-broker connections."""
+
+    def __init__(self, bootstrap_servers: str | Sequence[str],
+                 client_id: str = "cruise-control-tpu",
+                 timeout_s: float = 30.0):
+        self._bootstrap = _parse_bootstrap(bootstrap_servers)
+        if not self._bootstrap:
+            raise ValueError("empty bootstrap server list")
+        self._client_id = client_id
+        self._timeout = timeout_s
+        self._conns: dict[int, BrokerConnection] = {}
+        self._boot_conn: BrokerConnection | None = None
+        self._brokers: dict[int, tuple[str, int]] = {}
+        self._controller_id: int | None = None
+        self._lock = threading.Lock()
+
+    # ---- connection management -------------------------------------------
+    def _bootstrap_connection(self) -> BrokerConnection:
+        if self._boot_conn is None:
+            errors = []
+            for host, port in self._bootstrap:
+                conn = BrokerConnection(host, port, self._client_id,
+                                        self._timeout)
+                try:
+                    conn.send(m.API_VERSIONS, {})
+                    self._boot_conn = conn
+                    break
+                except ConnectionError as e:  # try next bootstrap server
+                    errors.append(str(e))
+            else:
+                raise ConnectionError_(
+                    f"no bootstrap server reachable: {errors}")
+        return self._boot_conn
+
+    def connection(self, node_id: int) -> BrokerConnection:
+        with self._lock:
+            conn = self._conns.get(node_id)
+        if conn is not None:
+            return conn
+        if node_id not in self._brokers:
+            self.metadata()
+        if node_id not in self._brokers:
+            raise ConnectionError_(f"unknown broker id {node_id}")
+        host, port = self._brokers[node_id]
+        conn = BrokerConnection(host, port, self._client_id, self._timeout)
+        with self._lock:
+            self._conns.setdefault(node_id, conn)
+            return self._conns[node_id]
+
+    def controller(self) -> BrokerConnection:
+        if self._controller_id is None:
+            self.metadata()
+        assert self._controller_id is not None
+        return self.connection(self._controller_id)
+
+    def close(self) -> None:
+        with self._lock:
+            for conn in self._conns.values():
+                conn.close()
+            self._conns.clear()
+            if self._boot_conn is not None:
+                self._boot_conn.close()
+                self._boot_conn = None
+
+    # ---- metadata --------------------------------------------------------
+    def api_versions(self) -> dict[int, tuple[int, int]]:
+        resp = self._bootstrap_connection().send(m.API_VERSIONS, {})
+        return {e["api_key"]: (e["min_version"], e["max_version"])
+                for e in resp["api_keys"]}
+
+    def metadata(self, topics: Sequence[str] | None = None) -> dict:
+        resp = self._bootstrap_connection().send(
+            m.METADATA, {"topics": list(topics) if topics is not None
+                         else None})
+        self._brokers = {b["node_id"]: (b["host"], b["port"])
+                         for b in resp["brokers"]}
+        self._controller_id = resp["controller_id"]
+        return resp
+
+    def alive_broker_ids(self) -> set[int]:
+        self.metadata(topics=[])
+        return set(self._brokers)
+
+    def partitions_for(self, topic: str) -> dict[int, dict]:
+        meta = self.metadata([topic])
+        for t in meta["topics"]:
+            if t["name"] == topic:
+                if t["error_code"] != m.NONE:
+                    raise m.KafkaProtocolError(t["error_code"], topic)
+                return {p["index"]: p for p in t["partitions"]}
+        return {}
+
+    def leader_of(self, topic: str, partition: int) -> int:
+        parts = self.partitions_for(topic)
+        if partition not in parts:
+            raise m.KafkaProtocolError(m.UNKNOWN_TOPIC_OR_PARTITION,
+                                       f"{topic}-{partition}")
+        return parts[partition]["leader"]
+
+    # ---- admin -----------------------------------------------------------
+    def create_topic(self, name: str, num_partitions: int,
+                     replication_factor: int = 1,
+                     configs: Mapping[str, str] | None = None,
+                     error_ok: tuple[int, ...] = (m.TOPIC_ALREADY_EXISTS,),
+                     ) -> int:
+        resp = self.controller().send(m.CREATE_TOPICS, {
+            "topics": [{"name": name, "num_partitions": num_partitions,
+                        "replication_factor": replication_factor,
+                        "assignments": [],
+                        "configs": [{"name": k, "value": v}
+                                    for k, v in (configs or {}).items()]}],
+            "timeout_ms": int(self._timeout * 1000)})
+        code = resp["topics"][0]["error_code"]
+        if code not in (m.NONE, *error_ok):
+            raise m.KafkaProtocolError(code, f"create_topic({name})")
+        return code
+
+    def describe_configs(self, resource_type: int, names: Iterable,
+                         ) -> dict[str, dict[str, str]]:
+        """name -> {config: value}. BROKER resources are routed to the
+        broker itself (broker configs are broker-local state)."""
+        out: dict[str, dict[str, str]] = {}
+        for name in names:
+            conn = (self.connection(int(name))
+                    if resource_type == m.RESOURCE_BROKER
+                    else self._bootstrap_connection())
+            resp = conn.send(m.DESCRIBE_CONFIGS, {"resources": [
+                {"resource_type": resource_type, "resource_name": str(name),
+                 "configuration_keys": None}]})
+            for r in resp["results"]:
+                if r["error_code"] != m.NONE:
+                    raise m.KafkaProtocolError(
+                        r["error_code"],
+                        f"describe_configs({r['resource_name']})")
+                out[r["resource_name"]] = {
+                    c["name"]: c["value"] for c in r["configs"]
+                    if c["value"] is not None}
+        return out
+
+    def incremental_alter_configs(
+            self, resource_type: int,
+            updates: Mapping[object, Mapping[str, str | None]]) -> None:
+        """{resource_name: {key: value-or-None}}; None deletes the key
+        (real KIP-339 semantics — no describe-merge round trip)."""
+        for name, kv in updates.items():
+            conn = (self.connection(int(name))
+                    if resource_type == m.RESOURCE_BROKER
+                    else self.controller())
+            resp = conn.send(m.INCREMENTAL_ALTER_CONFIGS, {
+                "resources": [{
+                    "resource_type": resource_type,
+                    "resource_name": str(name),
+                    "configs": [
+                        {"name": k,
+                         "config_operation": m.OP_DELETE if v is None
+                         else m.OP_SET,
+                         "value": None if v is None else str(v)}
+                        for k, v in kv.items()]}],
+                "validate_only": False})
+            for r in resp["responses"]:
+                if r["error_code"] != m.NONE:
+                    raise m.KafkaProtocolError(
+                        r["error_code"],
+                        f"alter_configs({r['resource_name']}): "
+                        f"{r['error_message']}")
+
+    def alter_partition_reassignments(
+            self, targets: Mapping[tuple[str, int],
+                                   Sequence[int] | None]) -> None:
+        by_topic: dict[str, list[dict]] = {}
+        for (topic, part), replicas in targets.items():
+            by_topic.setdefault(topic, []).append({
+                "partition_index": part,
+                "replicas": list(replicas) if replicas is not None else None})
+        resp = self.controller().send(m.ALTER_PARTITION_REASSIGNMENTS, {
+            "timeout_ms": int(self._timeout * 1000),
+            "topics": [{"name": t, "partitions": ps}
+                       for t, ps in by_topic.items()]})
+        if resp["error_code"] != m.NONE:
+            raise m.KafkaProtocolError(resp["error_code"],
+                                       "alter_partition_reassignments")
+        for t in resp["responses"] or []:
+            for p in t["partitions"] or []:
+                # Cancelling nothing is success for our callers' purposes.
+                if p["error_code"] not in (m.NONE,
+                                           m.NO_REASSIGNMENT_IN_PROGRESS):
+                    raise m.KafkaProtocolError(
+                        p["error_code"],
+                        f"{t['name']}-{p['partition_index']}: "
+                        f"{p['error_message']}")
+
+    def list_partition_reassignments(self) -> dict[tuple[str, int], dict]:
+        resp = self.controller().send(m.LIST_PARTITION_REASSIGNMENTS, {
+            "timeout_ms": int(self._timeout * 1000), "topics": None})
+        if resp["error_code"] != m.NONE:
+            raise m.KafkaProtocolError(resp["error_code"],
+                                       "list_partition_reassignments")
+        out = {}
+        for t in resp["topics"] or []:
+            for p in t["partitions"] or []:
+                out[(t["name"], p["partition_index"])] = {
+                    "replicas": p["replicas"] or [],
+                    "adding": p["adding_replicas"] or [],
+                    "removing": p["removing_replicas"] or []}
+        return out
+
+    def elect_leaders(self, partitions: Iterable[tuple[str, int]],
+                      election_type: int = m.ELECTION_PREFERRED,
+                      ) -> list[tuple[str, int, int]]:
+        """Returns per-partition failures as (topic, partition, error_code)
+        — a degraded partition (e.g. preferred replica out of ISR during
+        broker recovery) must not abort the rest of the batch; the caller
+        decides per task (the executor dead-marks it and moves on)."""
+        by_topic: dict[str, list[int]] = {}
+        for topic, part in partitions:
+            by_topic.setdefault(topic, []).append(part)
+        resp = self.controller().send(m.ELECT_LEADERS, {
+            "election_type": election_type,
+            "topic_partitions": [{"topic": t, "partitions": ps}
+                                 for t, ps in by_topic.items()],
+            "timeout_ms": int(self._timeout * 1000)})
+        if resp["error_code"] != m.NONE:
+            raise m.KafkaProtocolError(resp["error_code"], "elect_leaders")
+        failed = []
+        for t in resp["replica_election_results"]:
+            for p in t["partition_results"]:
+                if p["error_code"] not in (m.NONE, m.ELECTION_NOT_NEEDED):
+                    failed.append((t["topic"], p["partition_id"],
+                                   p["error_code"]))
+        return failed
+
+    def describe_log_dirs(self, node_id: int) -> list[dict]:
+        resp = self.connection(node_id).send(m.DESCRIBE_LOG_DIRS,
+                                             {"topics": None})
+        return resp["results"]
+
+    def alter_replica_log_dirs(
+            self, node_id: int,
+            moves: Mapping[str, Mapping[str, Sequence[int]]],
+            ) -> list[tuple[str, int, int]]:
+        """{dst_dir: {topic: [partition]}} for one broker; returns
+        [(topic, partition, error_code)] for rejected moves."""
+        resp = self.connection(node_id).send(m.ALTER_REPLICA_LOG_DIRS, {
+            "dirs": [{"path": path,
+                      "topics": [{"name": t, "partitions": list(ps)}
+                                 for t, ps in topics.items()]}
+                     for path, topics in moves.items()]})
+        failed = []
+        for t in resp["results"]:
+            for p in t["partitions"]:
+                if p["error_code"] != m.NONE:
+                    failed.append((t["topic_name"], p["partition_index"],
+                                   p["error_code"]))
+        return failed
+
+    # ---- data plane ------------------------------------------------------
+    def produce(self, topic: str, partition: int, records: list[Record],
+                acks: int = 1) -> int:
+        """Append records to the partition leader; returns base offset."""
+        batch = encode_batch(records, base_offset=0)
+        leader = self.leader_of(topic, partition)
+        resp = self.connection(leader).send(m.PRODUCE, {
+            "transactional_id": None, "acks": acks,
+            "timeout_ms": int(self._timeout * 1000),
+            "topics": [{"name": topic, "partitions": [
+                {"index": partition, "records": batch}]}]})
+        p = resp["topics"][0]["partitions"][0]
+        if p["error_code"] != m.NONE:
+            raise m.KafkaProtocolError(p["error_code"],
+                                       f"produce({topic}-{partition})")
+        return p["base_offset"]
+
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_bytes: int = 8 << 20) -> tuple[list[Record], int]:
+        """Returns (records from ``offset``, high watermark)."""
+        leader = self.leader_of(topic, partition)
+        resp = self.connection(leader).send(m.FETCH, {
+            "replica_id": -1, "max_wait_ms": 100, "min_bytes": 1,
+            "max_bytes": max_bytes, "isolation_level": 0,
+            "topics": [{"name": topic, "partitions": [
+                {"index": partition, "fetch_offset": offset,
+                 "max_bytes": max_bytes}]}]})
+        p = resp["topics"][0]["partitions"][0]
+        if p["error_code"] != m.NONE:
+            raise m.KafkaProtocolError(p["error_code"],
+                                       f"fetch({topic}-{partition})")
+        batch = p["records"] or b""
+        return ([r for r in decode_batches(batch) if r.offset >= offset],
+                p["high_watermark"])
+
+    def list_offsets(self, topic: str, partition: int,
+                     timestamp_ms: int) -> tuple[int, int]:
+        """(offset, timestamp) of the first record at/after timestamp_ms;
+        (-1, -1) when none. Special timestamps: -1 latest, -2 earliest."""
+        leader = self.leader_of(topic, partition)
+        resp = self.connection(leader).send(m.LIST_OFFSETS, {
+            "replica_id": -1,
+            "topics": [{"name": topic, "partitions": [
+                {"index": partition, "timestamp_ms": timestamp_ms}]}]})
+        p = resp["topics"][0]["partitions"][0]
+        if p["error_code"] != m.NONE:
+            raise m.KafkaProtocolError(p["error_code"],
+                                       f"list_offsets({topic}-{partition})")
+        return p["offset"], p["timestamp_ms"]

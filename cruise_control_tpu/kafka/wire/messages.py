@@ -1,0 +1,398 @@
+"""Request/response schemas for the APIs the framework speaks.
+
+One pinned version per API — the lowest version carrying the semantics the
+framework needs (the embedded broker advertises exactly these, and real
+brokers ≥2.4 support all of them):
+
+======================== === === ========================================
+API                      key ver why this version
+======================== === === ========================================
+Produce                    0   3 record-batch v2 (magic 2) required
+Fetch                      1   4 record-batch v2 + isolation level
+ListOffsets                2   1 timestamp-indexed lookup (KIP-79)
+Metadata                   3   1 rack + controller + is_internal
+ApiVersions               18   0 bootstrap negotiation
+CreateTopics              19   0 topic auto-creation
+DescribeConfigs           32   0 throttle/config reads
+AlterConfigs              33   0 legacy full-replace (kept for parity)
+AlterReplicaLogDirs       34   0 JBOD intra-broker moves
+DescribeLogDirs           35   0 disk failure detection + JBOD state
+ElectLeaders              43   1 PREFERRED/UNCLEAN election types
+IncrementalAlterConfigs   44   0 real incremental throttle updates
+AlterPartitionReassign.   45   0 KIP-455 reassignment (flexible)
+ListPartitionReassign.    46   0 KIP-455 in-flight view (flexible)
+======================== === === ========================================
+
+Keys 45/46 have only flexible versions (born at 2.4 post-KIP-482), so
+their schemas use compact encodings + tagged fields; everything else is
+pinned to classic encodings.
+
+Reference parity: ExecutorAdminUtils.java (the Java AdminClient calls
+these same APIs), CruiseControlMetricsReporter.java:241 (produce),
+KafkaSampleStore.java:204 (fetch/list-offsets replay).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .types import (
+    Array, Boolean, Bytes, Codec, CompactArray, CompactNullableString,
+    CompactString, Int8, Int16, Int32, Int64, NullableString, String, Struct,
+)
+
+API_PRODUCE = 0
+API_FETCH = 1
+API_LIST_OFFSETS = 2
+API_METADATA = 3
+API_API_VERSIONS = 18
+API_CREATE_TOPICS = 19
+API_DESCRIBE_CONFIGS = 32
+API_ALTER_CONFIGS = 33
+API_ALTER_REPLICA_LOG_DIRS = 34
+API_DESCRIBE_LOG_DIRS = 35
+API_ELECT_LEADERS = 43
+API_INCREMENTAL_ALTER_CONFIGS = 44
+API_ALTER_PARTITION_REASSIGNMENTS = 45
+API_LIST_PARTITION_REASSIGNMENTS = 46
+
+# Special ListOffsets timestamps (KIP-79).
+LATEST_TIMESTAMP = -1
+EARLIEST_TIMESTAMP = -2
+
+# Config resource types (shared with DescribeConfigs/AlterConfigs).
+RESOURCE_TOPIC = 2
+RESOURCE_BROKER = 4
+
+ELECTION_PREFERRED = 0
+ELECTION_UNCLEAN = 1
+
+
+@dataclass(frozen=True)
+class Api:
+    key: int
+    version: int
+    request: Codec
+    response: Codec
+    flexible: bool = False
+
+
+def _arr(*fields: tuple[str, Codec]) -> Array:
+    return Array(Struct(*fields))
+
+
+def _carr(*fields: tuple[str, Codec]) -> CompactArray:
+    return CompactArray(Struct(*fields, flexible=True))
+
+
+PRODUCE = Api(API_PRODUCE, 3, request=Struct(
+    ("transactional_id", NullableString),
+    ("acks", Int16),
+    ("timeout_ms", Int32),
+    ("topics", _arr(
+        ("name", String),
+        ("partitions", _arr(
+            ("index", Int32),
+            ("records", Bytes))))),
+), response=Struct(
+    ("topics", _arr(
+        ("name", String),
+        ("partitions", _arr(
+            ("index", Int32),
+            ("error_code", Int16),
+            ("base_offset", Int64),
+            ("log_append_time_ms", Int64))))),
+    ("throttle_time_ms", Int32),
+))
+
+FETCH = Api(API_FETCH, 4, request=Struct(
+    ("replica_id", Int32),
+    ("max_wait_ms", Int32),
+    ("min_bytes", Int32),
+    ("max_bytes", Int32),
+    ("isolation_level", Int8),
+    ("topics", _arr(
+        ("name", String),
+        ("partitions", _arr(
+            ("index", Int32),
+            ("fetch_offset", Int64),
+            ("max_bytes", Int32))))),
+), response=Struct(
+    ("throttle_time_ms", Int32),
+    ("topics", _arr(
+        ("name", String),
+        ("partitions", _arr(
+            ("index", Int32),
+            ("error_code", Int16),
+            ("high_watermark", Int64),
+            ("last_stable_offset", Int64),
+            ("aborted_transactions", _arr(
+                ("producer_id", Int64),
+                ("first_offset", Int64))),
+            ("records", Bytes))))),
+))
+
+LIST_OFFSETS = Api(API_LIST_OFFSETS, 1, request=Struct(
+    ("replica_id", Int32),
+    ("topics", _arr(
+        ("name", String),
+        ("partitions", _arr(
+            ("index", Int32),
+            ("timestamp_ms", Int64))))),
+), response=Struct(
+    ("topics", _arr(
+        ("name", String),
+        ("partitions", _arr(
+            ("index", Int32),
+            ("error_code", Int16),
+            ("timestamp_ms", Int64),
+            ("offset", Int64))))),
+))
+
+METADATA = Api(API_METADATA, 1, request=Struct(
+    ("topics", Array(String)),  # null = all topics
+), response=Struct(
+    ("brokers", _arr(
+        ("node_id", Int32),
+        ("host", String),
+        ("port", Int32),
+        ("rack", NullableString))),
+    ("controller_id", Int32),
+    ("topics", _arr(
+        ("error_code", Int16),
+        ("name", String),
+        ("is_internal", Boolean),
+        ("partitions", _arr(
+            ("error_code", Int16),
+            ("index", Int32),
+            ("leader", Int32),
+            ("replicas", Array(Int32)),
+            ("isr", Array(Int32)))))),
+))
+
+API_VERSIONS = Api(API_API_VERSIONS, 0, request=Struct(), response=Struct(
+    ("error_code", Int16),
+    ("api_keys", _arr(
+        ("api_key", Int16),
+        ("min_version", Int16),
+        ("max_version", Int16))),
+))
+
+CREATE_TOPICS = Api(API_CREATE_TOPICS, 0, request=Struct(
+    ("topics", _arr(
+        ("name", String),
+        ("num_partitions", Int32),
+        ("replication_factor", Int16),
+        ("assignments", _arr(
+            ("partition_index", Int32),
+            ("broker_ids", Array(Int32)))),
+        ("configs", _arr(
+            ("name", String),
+            ("value", NullableString))))),
+    ("timeout_ms", Int32),
+), response=Struct(
+    ("topics", _arr(
+        ("name", String),
+        ("error_code", Int16))),
+))
+
+DESCRIBE_CONFIGS = Api(API_DESCRIBE_CONFIGS, 0, request=Struct(
+    ("resources", _arr(
+        ("resource_type", Int8),
+        ("resource_name", String),
+        ("configuration_keys", Array(String)))),  # null = all keys
+), response=Struct(
+    ("throttle_time_ms", Int32),
+    ("results", _arr(
+        ("error_code", Int16),
+        ("error_message", NullableString),
+        ("resource_type", Int8),
+        ("resource_name", String),
+        ("configs", _arr(
+            ("name", String),
+            ("value", NullableString),
+            ("read_only", Boolean),
+            ("is_default", Boolean),
+            ("is_sensitive", Boolean))))),
+))
+
+ALTER_CONFIGS = Api(API_ALTER_CONFIGS, 0, request=Struct(
+    ("resources", _arr(
+        ("resource_type", Int8),
+        ("resource_name", String),
+        ("configs", _arr(
+            ("name", String),
+            ("value", NullableString))))),
+    ("validate_only", Boolean),
+), response=Struct(
+    ("throttle_time_ms", Int32),
+    ("responses", _arr(
+        ("error_code", Int16),
+        ("error_message", NullableString),
+        ("resource_type", Int8),
+        ("resource_name", String))),
+))
+
+# Incremental ops (KIP-339).
+OP_SET = 0
+OP_DELETE = 1
+OP_APPEND = 2
+OP_SUBTRACT = 3
+
+INCREMENTAL_ALTER_CONFIGS = Api(API_INCREMENTAL_ALTER_CONFIGS, 0,
+                                request=Struct(
+    ("resources", _arr(
+        ("resource_type", Int8),
+        ("resource_name", String),
+        ("configs", _arr(
+            ("name", String),
+            ("config_operation", Int8),
+            ("value", NullableString))))),
+    ("validate_only", Boolean),
+), response=Struct(
+    ("throttle_time_ms", Int32),
+    ("responses", _arr(
+        ("error_code", Int16),
+        ("error_message", NullableString),
+        ("resource_type", Int8),
+        ("resource_name", String))),
+))
+
+ALTER_REPLICA_LOG_DIRS = Api(API_ALTER_REPLICA_LOG_DIRS, 0, request=Struct(
+    ("dirs", _arr(
+        ("path", String),
+        ("topics", _arr(
+            ("name", String),
+            ("partitions", Array(Int32)))))),
+), response=Struct(
+    ("throttle_time_ms", Int32),
+    ("results", _arr(
+        ("topic_name", String),
+        ("partitions", _arr(
+            ("partition_index", Int32),
+            ("error_code", Int16))))),
+))
+
+DESCRIBE_LOG_DIRS = Api(API_DESCRIBE_LOG_DIRS, 0, request=Struct(
+    ("topics", _arr(
+        ("topic", String),
+        ("partitions", Array(Int32)))),  # null = every partition hosted
+), response=Struct(
+    ("throttle_time_ms", Int32),
+    ("results", _arr(
+        ("error_code", Int16),
+        ("log_dir", String),
+        ("topics", _arr(
+            ("name", String),
+            ("partitions", _arr(
+                ("partition_index", Int32),
+                ("partition_size", Int64),
+                ("offset_lag", Int64),
+                ("is_future_key", Boolean))))))),
+))
+
+ELECT_LEADERS = Api(API_ELECT_LEADERS, 1, request=Struct(
+    ("election_type", Int8),
+    ("topic_partitions", _arr(
+        ("topic", String),
+        ("partitions", Array(Int32)))),  # null = all eligible
+    ("timeout_ms", Int32),
+), response=Struct(
+    ("throttle_time_ms", Int32),
+    ("error_code", Int16),
+    ("replica_election_results", _arr(
+        ("topic", String),
+        ("partition_results", _arr(
+            ("partition_id", Int32),
+            ("error_code", Int16),
+            ("error_message", NullableString))))),
+))
+
+ALTER_PARTITION_REASSIGNMENTS = Api(
+    API_ALTER_PARTITION_REASSIGNMENTS, 0, flexible=True, request=Struct(
+        ("timeout_ms", Int32),
+        ("topics", _carr(
+            ("name", CompactString),
+            ("partitions", _carr(
+                ("partition_index", Int32),
+                ("replicas", CompactArray(Int32)))))),  # null = cancel
+        flexible=True,
+    ), response=Struct(
+        ("throttle_time_ms", Int32),
+        ("error_code", Int16),
+        ("error_message", CompactNullableString),
+        ("responses", _carr(
+            ("name", CompactString),
+            ("partitions", _carr(
+                ("partition_index", Int32),
+                ("error_code", Int16),
+                ("error_message", CompactNullableString))))),
+        flexible=True,
+    ))
+
+LIST_PARTITION_REASSIGNMENTS = Api(
+    API_LIST_PARTITION_REASSIGNMENTS, 0, flexible=True, request=Struct(
+        ("timeout_ms", Int32),
+        ("topics", _carr(
+            ("name", CompactString),
+            ("partition_indexes", CompactArray(Int32)))),  # null = all
+        flexible=True,
+    ), response=Struct(
+        ("throttle_time_ms", Int32),
+        ("error_code", Int16),
+        ("error_message", CompactNullableString),
+        ("topics", _carr(
+            ("name", CompactString),
+            ("partitions", _carr(
+                ("partition_index", Int32),
+                ("replicas", CompactArray(Int32)),
+                ("adding_replicas", CompactArray(Int32)),
+                ("removing_replicas", CompactArray(Int32)))))),
+        flexible=True,
+    ))
+
+ALL_APIS: tuple[Api, ...] = (
+    PRODUCE, FETCH, LIST_OFFSETS, METADATA, API_VERSIONS, CREATE_TOPICS,
+    DESCRIBE_CONFIGS, ALTER_CONFIGS, ALTER_REPLICA_LOG_DIRS,
+    DESCRIBE_LOG_DIRS, ELECT_LEADERS, INCREMENTAL_ALTER_CONFIGS,
+    ALTER_PARTITION_REASSIGNMENTS, LIST_PARTITION_REASSIGNMENTS,
+)
+
+BY_KEY: dict[int, Api] = {api.key: api for api in ALL_APIS}
+
+# ---- error codes (the subset the framework produces/interprets) ----------
+NONE = 0
+UNKNOWN_SERVER_ERROR = -1
+OFFSET_OUT_OF_RANGE = 1
+UNKNOWN_TOPIC_OR_PARTITION = 3
+NOT_LEADER_OR_FOLLOWER = 6
+TOPIC_ALREADY_EXISTS = 36
+INVALID_REQUEST = 42
+LOG_DIR_NOT_FOUND = 57
+KAFKA_STORAGE_ERROR = 56
+NO_REASSIGNMENT_IN_PROGRESS = 85
+ELECTION_NOT_NEEDED = 84
+PREFERRED_LEADER_NOT_AVAILABLE = 80
+REPLICA_NOT_AVAILABLE = 9
+
+ERROR_NAMES = {
+    NONE: "NONE", UNKNOWN_SERVER_ERROR: "UNKNOWN_SERVER_ERROR",
+    OFFSET_OUT_OF_RANGE: "OFFSET_OUT_OF_RANGE",
+    UNKNOWN_TOPIC_OR_PARTITION: "UNKNOWN_TOPIC_OR_PARTITION",
+    NOT_LEADER_OR_FOLLOWER: "NOT_LEADER_OR_FOLLOWER",
+    TOPIC_ALREADY_EXISTS: "TOPIC_ALREADY_EXISTS",
+    INVALID_REQUEST: "INVALID_REQUEST",
+    LOG_DIR_NOT_FOUND: "LOG_DIR_NOT_FOUND",
+    KAFKA_STORAGE_ERROR: "KAFKA_STORAGE_ERROR",
+    NO_REASSIGNMENT_IN_PROGRESS: "NO_REASSIGNMENT_IN_PROGRESS",
+    ELECTION_NOT_NEEDED: "ELECTION_NOT_NEEDED",
+    PREFERRED_LEADER_NOT_AVAILABLE: "PREFERRED_LEADER_NOT_AVAILABLE",
+    REPLICA_NOT_AVAILABLE: "REPLICA_NOT_AVAILABLE",
+}
+
+
+class KafkaProtocolError(RuntimeError):
+    def __init__(self, code: int, context: str = ""):
+        self.code = code
+        name = ERROR_NAMES.get(code, str(code))
+        super().__init__(f"{name}{f' ({context})' if context else ''}")

@@ -1,0 +1,159 @@
+"""Record batch v2 (magic 2) serde — the on-wire/on-disk record format
+since Kafka 0.11 (KIP-98).
+
+Layout (all big-endian):
+
+    baseOffset:          int64
+    batchLength:         int32   (bytes after this field)
+    partitionLeaderEpoch:int32
+    magic:               int8    (= 2)
+    crc:                 uint32  (CRC-32C of everything after this field)
+    attributes:          int16   (compression in bits 0-2; 0 = none)
+    lastOffsetDelta:     int32
+    baseTimestamp:       int64
+    maxTimestamp:        int64
+    producerId:          int64   (-1 when idempotence unused)
+    producerEpoch:       int16
+    baseSequence:        int32
+    recordCount:         int32
+    records:             Record[recordCount]
+
+Each Record is varint-framed:
+
+    length:              varint  (bytes after this field)
+    attributes:          int8
+    timestampDelta:      varlong
+    offsetDelta:         varint
+    key:                 varint length (-1 = null) + bytes
+    value:               varint length (-1 = null) + bytes
+    headers:             varint count, each (varint-len key, varint-len value)
+
+Compression is intentionally unsupported (attributes must be 0): the
+framework's own topics are small JSON/binary payloads and the embedded
+broker mirrors that — an unsupported codec raises instead of corrupting.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from .crc32c import crc32c
+from .types import VarInt
+
+NO_PRODUCER_ID = -1
+_HEADER_FMT = ">qiibIhiqqqhii"
+_HEADER_SIZE = struct.calcsize(_HEADER_FMT)  # 61
+_CRC_OFFSET = 8 + 4 + 4 + 1  # baseOffset + batchLength + leaderEpoch + magic
+_AFTER_CRC = _CRC_OFFSET + 4
+
+
+@dataclass
+class Record:
+    offset: int
+    timestamp_ms: int
+    key: bytes | None
+    value: bytes | None
+    headers: list[tuple[str, bytes]] = field(default_factory=list)
+
+
+def _write_varbytes(out: bytearray, data: bytes | None) -> None:
+    if data is None:
+        VarInt.write(out, -1)
+    else:
+        VarInt.write(out, len(data))
+        out += data
+
+
+def _read_varbytes(buf: memoryview, pos: int):
+    n, pos = VarInt.read(buf, pos)
+    if n < 0:
+        return None, pos
+    return bytes(buf[pos:pos + n]), pos + n
+
+
+def encode_batch(records: list[Record], base_offset: int | None = None,
+                 partition_leader_epoch: int = 0) -> bytes:
+    """Encode one batch; record offsets/timestamps are taken from the
+    records themselves (base = first record)."""
+    if not records:
+        raise ValueError("empty record batch")
+    base = records[0].offset if base_offset is None else base_offset
+    base_ts = records[0].timestamp_ms
+    max_ts = max(r.timestamp_ms for r in records)
+
+    body = bytearray()
+    for r in records:
+        rec = bytearray()
+        rec.append(0)  # record attributes (unused)
+        VarInt.write(rec, r.timestamp_ms - base_ts)
+        VarInt.write(rec, r.offset - base)
+        _write_varbytes(rec, r.key)
+        _write_varbytes(rec, r.value)
+        VarInt.write(rec, len(r.headers))
+        for hk, hv in r.headers:
+            raw = hk.encode("utf-8")
+            VarInt.write(rec, len(raw))
+            rec += raw
+            _write_varbytes(rec, hv)
+        VarInt.write(body, len(rec))
+        body += rec
+
+    last_delta = records[-1].offset - base
+    # after-crc section: attributes .. recordCount, then records
+    after = struct.pack(">hiqqqhii", 0, last_delta, base_ts, max_ts,
+                        NO_PRODUCER_ID, -1, -1, len(records)) + bytes(body)
+    batch_length = 4 + 1 + 4 + len(after)  # leaderEpoch + magic + crc + rest
+    head = struct.pack(">qiibI", base, batch_length, partition_leader_epoch,
+                       2, crc32c(after))
+    return head + after
+
+
+def decode_batches(data: bytes | memoryview,
+                   verify_crc: bool = True) -> list[Record]:
+    """Decode a concatenation of record batches (a fetch response's record
+    set); a trailing partial batch (broker-side truncation at the fetch
+    byte limit) is dropped, matching client semantics."""
+    buf = memoryview(data)
+    out: list[Record] = []
+    pos = 0
+    while pos + 12 <= len(buf):
+        base, batch_length = struct.unpack_from(">qi", buf, pos)
+        end = pos + 12 + batch_length
+        if end > len(buf):
+            break  # partial trailing batch
+        magic = buf[pos + 16]
+        if magic != 2:
+            raise ValueError(f"unsupported record-batch magic {magic}")
+        (crc,) = struct.unpack_from(">I", buf, pos + _CRC_OFFSET)
+        after = buf[pos + _AFTER_CRC:end]
+        if verify_crc and crc32c(bytes(after)) != crc:
+            raise ValueError(f"record batch CRC mismatch at offset {base}")
+        attrs, _last_delta, base_ts, _max_ts, _pid, _pep, _seq, count = \
+            struct.unpack_from(">hiqqqhii", after, 0)
+        if attrs & 0x07:
+            raise ValueError(f"unsupported compression codec {attrs & 0x07}")
+        rpos = struct.calcsize(">hiqqqhii")
+        for _ in range(count):
+            length, rpos = VarInt.read(after, rpos)
+            rend = rpos + length
+            rpos += 1  # record attributes
+            ts_delta, rpos = VarInt.read(after, rpos)
+            off_delta, rpos = VarInt.read(after, rpos)
+            key, rpos = _read_varbytes(after, rpos)
+            value, rpos = _read_varbytes(after, rpos)
+            n_headers, rpos = VarInt.read(after, rpos)
+            headers = []
+            for _ in range(n_headers):
+                klen, rpos = VarInt.read(after, rpos)
+                hk = bytes(after[rpos:rpos + klen]).decode("utf-8")
+                rpos += klen
+                hv, rpos = _read_varbytes(after, rpos)
+                headers.append((hk, hv))
+            if rpos != rend:
+                raise ValueError("record length mismatch")
+            out.append(Record(offset=base + off_delta,
+                              timestamp_ms=base_ts + ts_delta,
+                              key=key, value=value, headers=headers))
+        pos = end
+    return out

@@ -1,0 +1,321 @@
+"""Wire-format codecs.
+
+Kafka's protocol primitives (KIP-482 for the flexible/compact variants):
+big-endian fixed-width ints, length-prefixed strings/bytes (int16/int32
+classic, uvarint(N+1) compact), zigzag varints inside record batches, and
+tagged fields on flexible message versions.
+
+Every codec is a singleton with ``write(out: bytearray, value)`` and
+``read(buf: memoryview, pos: int) -> (value, pos)``; ``Struct`` composes
+them over dicts keyed by field name — messages stay declarative data, not
+classes (the schema IS the documentation).
+"""
+
+from __future__ import annotations
+
+import struct as _struct
+
+
+class Codec:
+    def write(self, out: bytearray, value) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def read(self, buf: memoryview, pos: int):  # pragma: no cover
+        raise NotImplementedError
+
+
+class _Fixed(Codec):
+    def __init__(self, fmt: str):
+        self._fmt = ">" + fmt
+        self._size = _struct.calcsize(fmt)
+
+    def write(self, out: bytearray, value) -> None:
+        out += _struct.pack(self._fmt, value)
+
+    def read(self, buf: memoryview, pos: int):
+        (v,) = _struct.unpack_from(self._fmt, buf, pos)
+        return v, pos + self._size
+
+
+Int8 = _Fixed("b")
+Int16 = _Fixed("h")
+Int32 = _Fixed("i")
+Int64 = _Fixed("q")
+UInt32 = _Fixed("I")
+Float64 = _Fixed("d")
+
+
+class _Boolean(Codec):
+    def write(self, out: bytearray, value) -> None:
+        out.append(1 if value else 0)
+
+    def read(self, buf: memoryview, pos: int):
+        return buf[pos] != 0, pos + 1
+
+
+Boolean = _Boolean()
+
+
+class _UVarInt(Codec):
+    """Unsigned LEB128 (compact lengths, tagged-field tags/sizes)."""
+
+    def write(self, out: bytearray, value) -> None:
+        v = value
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                return
+
+    def read(self, buf: memoryview, pos: int):
+        shift, v = 0, 0
+        while True:
+            b = buf[pos]
+            pos += 1
+            v |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return v, pos
+            shift += 7
+
+
+UVarInt = _UVarInt()
+
+
+class _VarInt(Codec):
+    """Zigzag-encoded signed varint (record-batch internals)."""
+
+    def write(self, out: bytearray, value) -> None:
+        UVarInt.write(out, (value << 1) ^ (value >> 63))
+
+    def read(self, buf: memoryview, pos: int):
+        v, pos = UVarInt.read(buf, pos)
+        return (v >> 1) ^ -(v & 1), pos
+
+
+VarInt = _VarInt()
+
+
+class _String(Codec):
+    """Classic non-nullable string: int16 length + utf8."""
+
+    def write(self, out: bytearray, value) -> None:
+        raw = value.encode("utf-8")
+        Int16.write(out, len(raw))
+        out += raw
+
+    def read(self, buf: memoryview, pos: int):
+        n, pos = Int16.read(buf, pos)
+        if n < 0:
+            raise ValueError("null for non-nullable string")
+        return bytes(buf[pos:pos + n]).decode("utf-8"), pos + n
+
+
+String = _String()
+
+
+class _NullableString(Codec):
+    def write(self, out: bytearray, value) -> None:
+        if value is None:
+            Int16.write(out, -1)
+        else:
+            String.write(out, value)
+
+    def read(self, buf: memoryview, pos: int):
+        n, pos = Int16.read(buf, pos)
+        if n < 0:
+            return None, pos
+        return bytes(buf[pos:pos + n]).decode("utf-8"), pos + n
+
+
+NullableString = _NullableString()
+
+
+class _CompactString(Codec):
+    """Flexible-version string: uvarint(len+1) + utf8; 0 = null."""
+
+    def __init__(self, nullable: bool):
+        self._nullable = nullable
+
+    def write(self, out: bytearray, value) -> None:
+        if value is None:
+            if not self._nullable:
+                raise ValueError("null for non-nullable compact string")
+            UVarInt.write(out, 0)
+            return
+        raw = value.encode("utf-8")
+        UVarInt.write(out, len(raw) + 1)
+        out += raw
+
+    def read(self, buf: memoryview, pos: int):
+        n, pos = UVarInt.read(buf, pos)
+        if n == 0:
+            return None, pos
+        n -= 1
+        return bytes(buf[pos:pos + n]).decode("utf-8"), pos + n
+
+
+CompactString = _CompactString(nullable=False)
+CompactNullableString = _CompactString(nullable=True)
+
+
+class _Bytes(Codec):
+    """Classic nullable bytes: int32 length (-1 = null) + raw."""
+
+    def write(self, out: bytearray, value) -> None:
+        if value is None:
+            Int32.write(out, -1)
+            return
+        Int32.write(out, len(value))
+        out += value
+
+    def read(self, buf: memoryview, pos: int):
+        n, pos = Int32.read(buf, pos)
+        if n < 0:
+            return None, pos
+        return bytes(buf[pos:pos + n]), pos + n
+
+
+Bytes = _Bytes()
+
+
+class _CompactBytes(Codec):
+    def write(self, out: bytearray, value) -> None:
+        if value is None:
+            UVarInt.write(out, 0)
+            return
+        UVarInt.write(out, len(value) + 1)
+        out += value
+
+    def read(self, buf: memoryview, pos: int):
+        n, pos = UVarInt.read(buf, pos)
+        if n == 0:
+            return None, pos
+        n -= 1
+        return bytes(buf[pos:pos + n]), pos + n
+
+
+CompactBytes = _CompactBytes()
+
+
+class Array(Codec):
+    """Classic nullable array: int32 count (-1 = null)."""
+
+    def __init__(self, element: Codec):
+        self._element = element
+
+    def write(self, out: bytearray, value) -> None:
+        if value is None:
+            Int32.write(out, -1)
+            return
+        Int32.write(out, len(value))
+        for item in value:
+            self._element.write(out, item)
+
+    def read(self, buf: memoryview, pos: int):
+        n, pos = Int32.read(buf, pos)
+        if n < 0:
+            return None, pos
+        out = []
+        for _ in range(n):
+            item, pos = self._element.read(buf, pos)
+            out.append(item)
+        return out, pos
+
+
+class CompactArray(Codec):
+    """Flexible-version array: uvarint(count+1); 0 = null."""
+
+    def __init__(self, element: Codec):
+        self._element = element
+
+    def write(self, out: bytearray, value) -> None:
+        if value is None:
+            UVarInt.write(out, 0)
+            return
+        UVarInt.write(out, len(value) + 1)
+        for item in value:
+            self._element.write(out, item)
+
+    def read(self, buf: memoryview, pos: int):
+        n, pos = UVarInt.read(buf, pos)
+        if n == 0:
+            return None, pos
+        out = []
+        for _ in range(n - 1):
+            item, pos = self._element.read(buf, pos)
+            out.append(item)
+        return out, pos
+
+
+class _TaggedFields(Codec):
+    """KIP-482 tagged fields. None of the APIs this client speaks carries
+    tags it needs, so writes emit the empty set and reads skip unknown
+    tags (the forward-compatibility contract)."""
+
+    def write(self, out: bytearray, value) -> None:
+        UVarInt.write(out, 0 if not value else len(value))
+        if value:
+            for tag in sorted(value):
+                UVarInt.write(out, tag)
+                UVarInt.write(out, len(value[tag]))
+                out += value[tag]
+
+    def read(self, buf: memoryview, pos: int):
+        n, pos = UVarInt.read(buf, pos)
+        out = {}
+        for _ in range(n):
+            tag, pos = UVarInt.read(buf, pos)
+            size, pos = UVarInt.read(buf, pos)
+            out[tag] = bytes(buf[pos:pos + size])
+            pos += size
+        return out, pos
+
+
+TaggedFields = _TaggedFields()
+
+
+class Struct(Codec):
+    """Named-field composite; values are plain dicts.
+
+    ``flexible=True`` appends the struct's trailing tagged-fields block
+    (every nested struct in a flexible message version has one)."""
+
+    def __init__(self, *fields: tuple[str, Codec], flexible: bool = False):
+        self.fields = fields
+        self.flexible = flexible
+
+    def write(self, out: bytearray, value) -> None:
+        for name, codec in self.fields:
+            try:
+                codec.write(out, value[name])
+            except KeyError:
+                raise ValueError(f"missing field {name!r}") from None
+        if self.flexible:
+            TaggedFields.write(out, value.get("_tags"))
+
+    def read(self, buf: memoryview, pos: int):
+        out = {}
+        for name, codec in self.fields:
+            out[name], pos = codec.read(buf, pos)
+        if self.flexible:
+            tags, pos = TaggedFields.read(buf, pos)
+            if tags:
+                out["_tags"] = tags
+        return out, pos
+
+
+def encode(codec: Codec, value) -> bytes:
+    out = bytearray()
+    codec.write(out, value)
+    return bytes(out)
+
+
+def decode(codec: Codec, data: bytes | memoryview):
+    buf = memoryview(data)
+    value, pos = codec.read(buf, 0)
+    if pos != len(buf):
+        raise ValueError(f"{len(buf) - pos} trailing bytes after decode")
+    return value

@@ -5,7 +5,7 @@ CruiseControlMetricsReporterSampler (consumes the reporter's metrics topic),
 PrometheusMetricSampler (PromQL over HTTP), and NoopSampler.
 
 Redesign: the Kafka consumer is abstracted behind ``MetricsTransport`` (an
-in-memory queue in this image — a kafka-python/confluent binding implements
+in-memory queue in this image — the wire binding (kafka.transport.KafkaMetricsTransport) implements
 the same two methods against the real ``__CruiseControlMetrics`` topic).
 The Prometheus sampler maps PromQL queries onto raw metric types like the
 reference's PrometheusAdapter but is gated on an injectable ``http_get``
