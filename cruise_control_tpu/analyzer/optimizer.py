@@ -150,6 +150,10 @@ class GoalOptimizer:
         self._priority_weight = self._config.get_double("goal.balancedness.priority.weight")
         self._strictness_weight = self._config.get_double("goal.balancedness.strictness.weight")
         self._fused_chain = self._config.get_boolean("solver.chain.fused")
+        self._fused_max_brokers = self._config.get_int(
+            "solver.fused.chain.max.brokers")
+        self._dispatch_rounds = self._config.get_int(
+            "solver.dispatch.max.rounds")
         if mesh == "auto":
             import jax
 
@@ -267,9 +271,11 @@ class GoalOptimizer:
                 meta.num_topics, mesh, masks)
             goal_results = _apportioned_goal_results(
                 goal_chain, infos, time.time() - t0)
-        elif self._fused_chain:
-            # Production path: the whole chain in ONE device dispatch
-            # (chain.chain_optimize_full).
+        elif self._fused_chain and (
+                self._fused_max_brokers == 0
+                or state.num_brokers <= self._fused_max_brokers):
+            # Production path at small/medium scale: the whole chain in ONE
+            # device dispatch (chain.chain_optimize_full).
             t0 = time.time()
             state, infos = optimize_chain(
                 state, goal_chain, self._constraint, search_cfg,
@@ -277,15 +283,21 @@ class GoalOptimizer:
             goal_results = _apportioned_goal_results(
                 goal_chain, infos, time.time() - t0)
         else:
-            # Per-goal dispatch path (kept for equivalence tests and
-            # per-goal wall-clock attribution). Same on-entry
-            # violated_before semantics as the fused path.
+            # Per-goal bounded-dispatch path: same kernels and trajectory,
+            # ≤ solver.dispatch.max.rounds search rounds per XLA execution
+            # so no single dispatch runs long enough to trip a device
+            # runtime's execution watchdog at 1k+ brokers (also kept for
+            # equivalence tests and per-goal wall-clock attribution). Same
+            # on-entry violated_before semantics as the fused path.
+            dispatch_rounds = self._dispatch_rounds if self._fused_chain \
+                else 0
             goal_results = []
             for i, g in enumerate(goal_chain):
                 t0 = time.time()
                 state, info = optimize_goal_in_chain(
                     state, goal_chain, i, self._constraint, search_cfg,
-                    meta.num_topics, masks)
+                    meta.num_topics, masks,
+                    dispatch_rounds=dispatch_rounds)
                 goal_results.append(GoalResult(
                     name=g.name, is_hard=g.is_hard,
                     succeeded=info["succeeded"],

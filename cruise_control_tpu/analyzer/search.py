@@ -208,16 +208,25 @@ def cumulative_select(state: ClusterTensors, deltas, score: jax.Array,
 
 
 def run_rounds_loop(round_body, state: ClusterTensors, max_rounds: int,
+                    budget=None,
                     ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
     """Shared fused-driver scaffold: iterate ``round_body(state) ->
     (new_state, applied)`` under ``lax.while_loop`` until a round applies
     nothing (or ``max_rounds``) entirely on device — ONE host round-trip
     for the whole loop. Returns (final_state, total_applied, rounds_run).
-    Used by the single-chip, chain-shared, and sharded drivers alike."""
+    Used by the single-chip, chain-shared, and sharded drivers alike.
+
+    ``budget`` (optional TRACED int) further caps the rounds this call may
+    run without recompiling per value — the bounded-dispatch driver passes
+    the remaining global round budget so a dispatch never overshoots
+    ``cfg.max_rounds`` (the static ``max_rounds`` alone would admit up to
+    a full dispatch past it)."""
+    cap = max_rounds if budget is None else jnp.minimum(
+        jnp.int32(max_rounds), budget.astype(jnp.int32))
 
     def cond(c):
         _s, _total, rounds, last = c
-        return (last > 0) & (rounds < max_rounds)
+        return (last > 0) & (rounds < cap)
 
     def body(c):
         s, total, rounds, _last = c

@@ -132,7 +132,7 @@ def _definition() -> ConfigDef:
              "Legacy ZK persistence path; the file store replaces it.")
     d.define("network.client.provider.class", T.CLASS, None, None, I.LOW,
              "Network client factory override (reference plumbing; the "
-             "kafka-python binding manages its own clients).")
+             "wire binding manages its own connections).")
 
     # --- Analyzer (AnalyzerConfig.java) ---
     d.define("goals", T.LIST, list(DEFAULT_GOALS), None, I.HIGH,
@@ -236,6 +236,16 @@ def _definition() -> ConfigDef:
              "TPU solver: run the whole goal chain in one device dispatch "
              "(chain.chain_optimize_full) instead of one dispatch per goal "
              "phase.")
+    d.define("solver.fused.chain.max.brokers", T.INT, 512, Range.at_least(0),
+             I.MEDIUM,
+             "Above this broker count the solver switches from the whole-"
+             "chain single dispatch to bounded per-goal dispatches: one "
+             "XLA program running tens of seconds trips execution "
+             "watchdogs on tunneled TPU runtimes. 0 = never switch.")
+    d.define("solver.dispatch.max.rounds", T.INT, 16, Range.at_least(1),
+             I.MEDIUM,
+             "Search rounds per device dispatch on the bounded per-goal "
+             "path (the host loops to the same fixed point).")
     d.define("goal.violation.distribution.threshold.multiplier", T.DOUBLE, 1.0,
              Range.at_least(1), I.LOW,
              "Detector-triggered balance-threshold relaxation.")

@@ -197,8 +197,12 @@ class KafkaAdminBackend:
                 for r in results:
                     for t in r["topics"]:
                         for p in t["partitions"]:
-                            sizes[(t["name"], p["partition_index"], b)] = \
-                                p["partition_size"]
+                            # Skip future (in-flight JBOD move) entries:
+                            # the partially-copied future replica shares
+                            # the key and would under-report the size.
+                            if not p["is_future_key"]:
+                                sizes[(t["name"], p["partition_index"], b)] \
+                                    = p["partition_size"]
             return sizes
         return self._view("sizes", sweep)
 

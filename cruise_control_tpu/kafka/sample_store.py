@@ -101,7 +101,17 @@ class KafkaSampleStore:
             except m.KafkaProtocolError:
                 continue  # topic absent: cold start
             for partition in sorted(parts):
-                offset = 0
+                try:
+                    # Log-start, not 0: retention (cleanup.policy=delete)
+                    # advances the start offset, and fetch(0) would return
+                    # OFFSET_OUT_OF_RANGE — skipping records that still
+                    # exist at higher offsets.
+                    offset, _ts = self._client.list_offsets(
+                        topic, partition, m.EARLIEST_TIMESTAMP)
+                except (ConnectionError, m.KafkaProtocolError):
+                    LOG.warning("sample replay failed for %s-%d", topic,
+                                partition, exc_info=True)
+                    continue
                 while True:
                     try:
                         records, hw = self._client.fetch(topic, partition,

@@ -28,6 +28,7 @@ class KafkaMetricsTransport:
 
     def __init__(self, bootstrap_servers: str, topic: str = METRICS_TOPIC,
                  num_partitions: int = 32, replication_factor: int = 1,
+                 max_pending_records: int = 100_000,
                  client: WireClient | None = None, **_compat):
         self._client = client or WireClient(
             bootstrap_servers, client_id="cruise-control-tpu-metrics")
@@ -35,6 +36,7 @@ class KafkaMetricsTransport:
         self._num_partitions = num_partitions
         self._rf = replication_factor
         self._pending: list[Record] = []
+        self._max_pending = max_pending_records
         self._rr = 0  # round-robin partition cursor
 
     # ---- topic auto-creation (reporter side) -----------------------------
@@ -76,12 +78,28 @@ class KafkaMetricsTransport:
             for i, rec in enumerate(batch):
                 rec.offset = i
             self._client.produce(self._topic, parts[self._rr], batch)
-        except (ConnectionError, m.KafkaProtocolError):
+        except ConnectionError:
             # Re-queue so a transient broker blip does not punch a hole in
             # the metric windows the load model trains on (the Java
             # producer's in-flight buffer gives the reference the same
-            # durability, CruiseControlMetricsReporter.java:241).
-            self._pending = batch + self._pending
+            # durability, CruiseControlMetricsReporter.java:241) — bounded
+            # like buffer.memory: during a LONG outage the OLDEST records
+            # are dropped first (they age out of the aggregation windows
+            # anyway; unbounded growth would OOM the broker agent). A
+            # PROTOCOL rejection (e.g. MESSAGE_TOO_LARGE) is NOT re-queued:
+            # the same batch would fail identically every interval and
+            # poison the head of the buffer.
+            requeued = batch + self._pending
+            if len(requeued) > self._max_pending:
+                dropped = len(requeued) - self._max_pending
+                requeued = requeued[dropped:]
+                LOG.warning("metrics buffer full: dropped %d oldest records",
+                            dropped)
+            self._pending = requeued
+            raise
+        except m.KafkaProtocolError:
+            LOG.warning("broker rejected metrics batch (%d records): "
+                        "dropping it", len(batch), exc_info=True)
             raise
 
     def poll(self, start_ms: int, end_ms: int) -> list[bytes]:

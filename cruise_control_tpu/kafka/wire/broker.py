@@ -76,6 +76,7 @@ class EmbeddedKafkaCluster:
         self._servers: dict[int, socket.socket] = {}
         self._ports: dict[int, int] = {}
         self._threads: list[threading.Thread] = []
+        self._conns: dict[int, set[socket.socket]] = {}
         self._dead: set[int] = set()
         self._running = False
 
@@ -127,6 +128,14 @@ class EmbeddedKafkaCluster:
         srv = self._servers.pop(broker_id, None)
         if srv is not None:
             srv.close()
+        # A dead broker resets its established connections too — in-flight
+        # clients must see a connection error, not one last answer.
+        for conn in list(self._conns.get(broker_id, ())):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            conn.close()
 
     def revive_broker(self, broker_id: int) -> None:
         self._dead.discard(broker_id)
@@ -169,6 +178,14 @@ class EmbeddedKafkaCluster:
         if p.leader not in p.replicas:
             p.leader = next((b for b in p.replicas if b in p.isr), -1)
         p.adding, p.removing, p.target = [], [], None
+
+    def trim_log(self, topic: str, partition: int, new_start: int) -> None:
+        """Advance the log start offset (retention simulation): records
+        below ``new_start`` disappear, fetches below it become
+        OFFSET_OUT_OF_RANGE — the real cleanup.policy=delete behavior."""
+        with self._lock:
+            p = self.topics[topic].partitions[partition]
+            p.records = [r for r in p.records if r.offset >= new_start]
 
     # ---- topic helpers (test setup) -------------------------------------
     def create_topic(self, name: str, num_partitions: int = 1, rf: int = 1,
@@ -219,6 +236,13 @@ class EmbeddedKafkaCluster:
 
     def _serve(self, broker_id: int, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._conns.setdefault(broker_id, set()).add(conn)
+        try:
+            self._serve_loop(broker_id, conn)
+        finally:
+            self._conns.get(broker_id, set()).discard(conn)
+
+    def _serve_loop(self, broker_id: int, conn: socket.socket) -> None:
         with conn:
             while self._running and broker_id not in self._dead:
                 head = self._read_exact(conn, 4)
@@ -378,7 +402,8 @@ class EmbeddedKafkaCluster:
                         "aborted_transactions": None, "records": None})
                     continue
                 offset = pr["fetch_offset"]
-                if offset > p.next_offset or offset < 0:
+                log_start = p.records[0].offset if p.records else 0
+                if offset > p.next_offset or offset < log_start:
                     parts_out.append({
                         "index": pr["index"],
                         "error_code": m.OFFSET_OUT_OF_RANGE,

@@ -16,6 +16,7 @@ import logging
 import socket
 import struct
 import threading
+import time
 from typing import Iterable, Mapping, Sequence
 
 from . import messages as m
@@ -98,13 +99,24 @@ class BrokerConnection:
                 self._sock = None
 
 
+DEFAULT_PORT = 9092
+
+
 def _parse_bootstrap(servers: str | Sequence[str]) -> list[tuple[str, int]]:
     if isinstance(servers, str):
         servers = [s for s in servers.split(",") if s.strip()]
     out = []
     for s in servers:
-        host, _, port = s.strip().rpartition(":")
-        out.append((host or "localhost", int(port)))
+        host, sep, port = s.strip().rpartition(":")
+        if not sep:
+            out.append((s.strip(), DEFAULT_PORT))
+            continue
+        try:
+            out.append((host or "localhost", int(port)))
+        except ValueError:
+            raise ValueError(
+                f"malformed bootstrap server {s!r}: expected host[:port]"
+            ) from None
     return out
 
 
@@ -113,15 +125,17 @@ class WireClient:
 
     def __init__(self, bootstrap_servers: str | Sequence[str],
                  client_id: str = "cruise-control-tpu",
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, metadata_ttl_s: float = 5.0):
         self._bootstrap = _parse_bootstrap(bootstrap_servers)
         if not self._bootstrap:
             raise ValueError("empty bootstrap server list")
         self._client_id = client_id
         self._timeout = timeout_s
+        self._meta_ttl = metadata_ttl_s
         self._conns: dict[int, BrokerConnection] = {}
         self._boot_conn: BrokerConnection | None = None
         self._brokers: dict[int, tuple[str, int]] = {}
+        self._topic_meta: dict[str, tuple[float, dict[int, dict]]] = {}
         self._controller_id: int | None = None
         self._lock = threading.Lock()
 
@@ -129,19 +143,32 @@ class WireClient:
     def _bootstrap_connection(self) -> BrokerConnection:
         if self._boot_conn is None:
             errors = []
-            for host, port in self._bootstrap:
+            # Known brokers first (post-metadata they may outlive the
+            # original bootstrap list), then the configured servers.
+            candidates = list(self._brokers.values()) + self._bootstrap
+            for host, port in candidates:
                 conn = BrokerConnection(host, port, self._client_id,
                                         self._timeout)
                 try:
                     conn.send(m.API_VERSIONS, {})
                     self._boot_conn = conn
                     break
-                except ConnectionError as e:  # try next bootstrap server
+                except ConnectionError as e:  # try next server
                     errors.append(str(e))
             else:
                 raise ConnectionError_(
                     f"no bootstrap server reachable: {errors}")
         return self._boot_conn
+
+    def _boot_send(self, api: m.Api, body: dict) -> dict:
+        """Send via the bootstrap connection, failing over across the
+        server list once: a died bootstrap broker must not pin the client
+        to a dead address while the rest of the cluster is healthy."""
+        try:
+            return self._bootstrap_connection().send(api, body)
+        except ConnectionError:
+            self._boot_conn = None
+            return self._bootstrap_connection().send(api, body)
 
     def connection(self, node_id: int) -> BrokerConnection:
         with self._lock:
@@ -164,6 +191,24 @@ class WireClient:
         assert self._controller_id is not None
         return self.connection(self._controller_id)
 
+    def _controller_send(self, api: m.Api, body: dict) -> dict:
+        """Send to the controller, re-resolving it once on NOT_CONTROLLER
+        or a connection error: the controller moves on broker restart and
+        the cached id must not wedge every admin call until some unrelated
+        metadata refresh happens."""
+        try:
+            resp = self._controller_send_once(api, body)
+        except ConnectionError:
+            self._controller_id = None
+            return self._controller_send_once(api, body)
+        if resp.get("error_code") == m.NOT_CONTROLLER:
+            self._controller_id = None
+            return self._controller_send_once(api, body)
+        return resp
+
+    def _controller_send_once(self, api: m.Api, body: dict) -> dict:
+        return self.controller().send(api, body)
+
     def close(self) -> None:
         with self._lock:
             for conn in self._conns.values():
@@ -175,24 +220,39 @@ class WireClient:
 
     # ---- metadata --------------------------------------------------------
     def api_versions(self) -> dict[int, tuple[int, int]]:
-        resp = self._bootstrap_connection().send(m.API_VERSIONS, {})
+        resp = self._boot_send(m.API_VERSIONS, {})
         return {e["api_key"]: (e["min_version"], e["max_version"])
                 for e in resp["api_keys"]}
 
     def metadata(self, topics: Sequence[str] | None = None) -> dict:
-        resp = self._bootstrap_connection().send(
+        resp = self._boot_send(
             m.METADATA, {"topics": list(topics) if topics is not None
                          else None})
         self._brokers = {b["node_id"]: (b["host"], b["port"])
                          for b in resp["brokers"]}
         self._controller_id = resp["controller_id"]
+        now = time.monotonic()
+        for t in resp["topics"]:
+            if t["error_code"] == m.NONE:
+                self._topic_meta[t["name"]] = (
+                    now, {p["index"]: p for p in t["partitions"]})
         return resp
 
     def alive_broker_ids(self) -> set[int]:
         self.metadata(topics=[])
         return set(self._brokers)
 
+    def invalidate_topic(self, topic: str) -> None:
+        self._topic_meta.pop(topic, None)
+
     def partitions_for(self, topic: str) -> dict[int, dict]:
+        """Partition metadata, cached for ``metadata_ttl_s``: the data-plane
+        hot paths (one fetch per batch per partition) must not pay a full
+        Metadata round-trip each call. Stale leadership degrades to a
+        NOT_LEADER error, which invalidates + retries (``_leader_call``)."""
+        hit = self._topic_meta.get(topic)
+        if hit is not None and time.monotonic() - hit[0] <= self._meta_ttl:
+            return hit[1]
         meta = self.metadata([topic])
         for t in meta["topics"]:
             if t["name"] == topic:
@@ -208,13 +268,27 @@ class WireClient:
                                        f"{topic}-{partition}")
         return parts[partition]["leader"]
 
+    def _leader_call(self, topic: str, partition: int, call):
+        """Run ``call(leader_connection)``; on stale-leadership or
+        connection errors, refresh the topic's metadata once and retry."""
+        try:
+            return call(self.connection(self.leader_of(topic, partition)))
+        except m.KafkaProtocolError as e:
+            if e.code not in (m.NOT_LEADER_OR_FOLLOWER,
+                              m.UNKNOWN_TOPIC_OR_PARTITION):
+                raise
+            self.invalidate_topic(topic)
+        except ConnectionError:
+            self.invalidate_topic(topic)
+        return call(self.connection(self.leader_of(topic, partition)))
+
     # ---- admin -----------------------------------------------------------
     def create_topic(self, name: str, num_partitions: int,
                      replication_factor: int = 1,
                      configs: Mapping[str, str] | None = None,
                      error_ok: tuple[int, ...] = (m.TOPIC_ALREADY_EXISTS,),
                      ) -> int:
-        resp = self.controller().send(m.CREATE_TOPICS, {
+        resp = self._controller_send(m.CREATE_TOPICS, {
             "topics": [{"name": name, "num_partitions": num_partitions,
                         "replication_factor": replication_factor,
                         "assignments": [],
@@ -228,16 +302,23 @@ class WireClient:
 
     def describe_configs(self, resource_type: int, names: Iterable,
                          ) -> dict[str, dict[str, str]]:
-        """name -> {config: value}. BROKER resources are routed to the
-        broker itself (broker configs are broker-local state)."""
+        """name -> {config: value}. One BATCHED request per destination —
+        the request schema takes an array of resources, and a per-name
+        round-trip would turn a whole-cluster topic-config sweep into
+        thousands of sequential RPCs. BROKER resources are still routed to
+        the broker itself (broker configs are broker-local state)."""
         out: dict[str, dict[str, str]] = {}
-        for name in names:
-            conn = (self.connection(int(name))
-                    if resource_type == m.RESOURCE_BROKER
-                    else self._bootstrap_connection())
-            resp = conn.send(m.DESCRIBE_CONFIGS, {"resources": [
-                {"resource_type": resource_type, "resource_name": str(name),
-                 "configuration_keys": None}]})
+        names = list(names)
+        if resource_type == m.RESOURCE_BROKER:
+            batches = [(self.connection(int(n)), [n]) for n in names]
+        else:
+            batches = [(None, names)] if names else []
+        for conn, batch in batches:
+            body = {"resources": [
+                {"resource_type": resource_type, "resource_name": str(n),
+                 "configuration_keys": None} for n in batch]}
+            resp = (conn.send(m.DESCRIBE_CONFIGS, body) if conn is not None
+                    else self._boot_send(m.DESCRIBE_CONFIGS, body))
             for r in resp["results"]:
                 if r["error_code"] != m.NONE:
                     raise m.KafkaProtocolError(
@@ -254,10 +335,7 @@ class WireClient:
         """{resource_name: {key: value-or-None}}; None deletes the key
         (real KIP-339 semantics — no describe-merge round trip)."""
         for name, kv in updates.items():
-            conn = (self.connection(int(name))
-                    if resource_type == m.RESOURCE_BROKER
-                    else self.controller())
-            resp = conn.send(m.INCREMENTAL_ALTER_CONFIGS, {
+            body = {
                 "resources": [{
                     "resource_type": resource_type,
                     "resource_name": str(name),
@@ -267,7 +345,13 @@ class WireClient:
                          else m.OP_SET,
                          "value": None if v is None else str(v)}
                         for k, v in kv.items()]}],
-                "validate_only": False})
+                "validate_only": False}
+            if resource_type == m.RESOURCE_BROKER:
+                resp = self.connection(int(name)).send(
+                    m.INCREMENTAL_ALTER_CONFIGS, body)
+            else:
+                # Topic configs: any broker accepts and forwards.
+                resp = self._boot_send(m.INCREMENTAL_ALTER_CONFIGS, body)
             for r in resp["responses"]:
                 if r["error_code"] != m.NONE:
                     raise m.KafkaProtocolError(
@@ -283,10 +367,12 @@ class WireClient:
             by_topic.setdefault(topic, []).append({
                 "partition_index": part,
                 "replicas": list(replicas) if replicas is not None else None})
-        resp = self.controller().send(m.ALTER_PARTITION_REASSIGNMENTS, {
+        resp = self._controller_send(m.ALTER_PARTITION_REASSIGNMENTS, {
             "timeout_ms": int(self._timeout * 1000),
             "topics": [{"name": t, "partitions": ps}
                        for t, ps in by_topic.items()]})
+        for t in by_topic:  # replica sets are changing: drop cached views
+            self.invalidate_topic(t)
         if resp["error_code"] != m.NONE:
             raise m.KafkaProtocolError(resp["error_code"],
                                        "alter_partition_reassignments")
@@ -301,7 +387,7 @@ class WireClient:
                         f"{p['error_message']}")
 
     def list_partition_reassignments(self) -> dict[tuple[str, int], dict]:
-        resp = self.controller().send(m.LIST_PARTITION_REASSIGNMENTS, {
+        resp = self._controller_send(m.LIST_PARTITION_REASSIGNMENTS, {
             "timeout_ms": int(self._timeout * 1000), "topics": None})
         if resp["error_code"] != m.NONE:
             raise m.KafkaProtocolError(resp["error_code"],
@@ -325,11 +411,13 @@ class WireClient:
         by_topic: dict[str, list[int]] = {}
         for topic, part in partitions:
             by_topic.setdefault(topic, []).append(part)
-        resp = self.controller().send(m.ELECT_LEADERS, {
+        resp = self._controller_send(m.ELECT_LEADERS, {
             "election_type": election_type,
             "topic_partitions": [{"topic": t, "partitions": ps}
                                  for t, ps in by_topic.items()],
             "timeout_ms": int(self._timeout * 1000)})
+        for t in by_topic:  # leadership is changing: drop cached views
+            self.invalidate_topic(t)
         if resp["error_code"] != m.NONE:
             raise m.KafkaProtocolError(resp["error_code"], "elect_leaders")
         failed = []
@@ -369,47 +457,56 @@ class WireClient:
                 acks: int = 1) -> int:
         """Append records to the partition leader; returns base offset."""
         batch = encode_batch(records, base_offset=0)
-        leader = self.leader_of(topic, partition)
-        resp = self.connection(leader).send(m.PRODUCE, {
-            "transactional_id": None, "acks": acks,
-            "timeout_ms": int(self._timeout * 1000),
-            "topics": [{"name": topic, "partitions": [
-                {"index": partition, "records": batch}]}]})
-        p = resp["topics"][0]["partitions"][0]
-        if p["error_code"] != m.NONE:
-            raise m.KafkaProtocolError(p["error_code"],
-                                       f"produce({topic}-{partition})")
-        return p["base_offset"]
+
+        def call(conn):
+            resp = conn.send(m.PRODUCE, {
+                "transactional_id": None, "acks": acks,
+                "timeout_ms": int(self._timeout * 1000),
+                "topics": [{"name": topic, "partitions": [
+                    {"index": partition, "records": batch}]}]})
+            p = resp["topics"][0]["partitions"][0]
+            if p["error_code"] != m.NONE:
+                raise m.KafkaProtocolError(p["error_code"],
+                                           f"produce({topic}-{partition})")
+            return p["base_offset"]
+
+        return self._leader_call(topic, partition, call)
 
     def fetch(self, topic: str, partition: int, offset: int,
               max_bytes: int = 8 << 20) -> tuple[list[Record], int]:
         """Returns (records from ``offset``, high watermark)."""
-        leader = self.leader_of(topic, partition)
-        resp = self.connection(leader).send(m.FETCH, {
-            "replica_id": -1, "max_wait_ms": 100, "min_bytes": 1,
-            "max_bytes": max_bytes, "isolation_level": 0,
-            "topics": [{"name": topic, "partitions": [
-                {"index": partition, "fetch_offset": offset,
-                 "max_bytes": max_bytes}]}]})
-        p = resp["topics"][0]["partitions"][0]
-        if p["error_code"] != m.NONE:
-            raise m.KafkaProtocolError(p["error_code"],
-                                       f"fetch({topic}-{partition})")
-        batch = p["records"] or b""
-        return ([r for r in decode_batches(batch) if r.offset >= offset],
-                p["high_watermark"])
+
+        def call(conn):
+            resp = conn.send(m.FETCH, {
+                "replica_id": -1, "max_wait_ms": 100, "min_bytes": 1,
+                "max_bytes": max_bytes, "isolation_level": 0,
+                "topics": [{"name": topic, "partitions": [
+                    {"index": partition, "fetch_offset": offset,
+                     "max_bytes": max_bytes}]}]})
+            p = resp["topics"][0]["partitions"][0]
+            if p["error_code"] != m.NONE:
+                raise m.KafkaProtocolError(p["error_code"],
+                                           f"fetch({topic}-{partition})")
+            batch = p["records"] or b""
+            return ([r for r in decode_batches(batch)
+                     if r.offset >= offset], p["high_watermark"])
+
+        return self._leader_call(topic, partition, call)
 
     def list_offsets(self, topic: str, partition: int,
                      timestamp_ms: int) -> tuple[int, int]:
         """(offset, timestamp) of the first record at/after timestamp_ms;
         (-1, -1) when none. Special timestamps: -1 latest, -2 earliest."""
-        leader = self.leader_of(topic, partition)
-        resp = self.connection(leader).send(m.LIST_OFFSETS, {
-            "replica_id": -1,
-            "topics": [{"name": topic, "partitions": [
-                {"index": partition, "timestamp_ms": timestamp_ms}]}]})
-        p = resp["topics"][0]["partitions"][0]
-        if p["error_code"] != m.NONE:
-            raise m.KafkaProtocolError(p["error_code"],
-                                       f"list_offsets({topic}-{partition})")
-        return p["offset"], p["timestamp_ms"]
+
+        def call(conn):
+            resp = conn.send(m.LIST_OFFSETS, {
+                "replica_id": -1,
+                "topics": [{"name": topic, "partitions": [
+                    {"index": partition, "timestamp_ms": timestamp_ms}]}]})
+            p = resp["topics"][0]["partitions"][0]
+            if p["error_code"] != m.NONE:
+                raise m.KafkaProtocolError(
+                    p["error_code"], f"list_offsets({topic}-{partition})")
+            return p["offset"], p["timestamp_ms"]
+
+        return self._leader_call(topic, partition, call)

@@ -370,6 +370,7 @@ TOPIC_ALREADY_EXISTS = 36
 INVALID_REQUEST = 42
 LOG_DIR_NOT_FOUND = 57
 KAFKA_STORAGE_ERROR = 56
+NOT_CONTROLLER = 41
 NO_REASSIGNMENT_IN_PROGRESS = 85
 ELECTION_NOT_NEEDED = 84
 PREFERRED_LEADER_NOT_AVAILABLE = 80
@@ -384,6 +385,7 @@ ERROR_NAMES = {
     INVALID_REQUEST: "INVALID_REQUEST",
     LOG_DIR_NOT_FOUND: "LOG_DIR_NOT_FOUND",
     KAFKA_STORAGE_ERROR: "KAFKA_STORAGE_ERROR",
+    NOT_CONTROLLER: "NOT_CONTROLLER",
     NO_REASSIGNMENT_IN_PROGRESS: "NO_REASSIGNMENT_IN_PROGRESS",
     ELECTION_NOT_NEEDED: "ELECTION_NOT_NEEDED",
     PREFERRED_LEADER_NOT_AVAILABLE: "PREFERRED_LEADER_NOT_AVAILABLE",

@@ -118,6 +118,70 @@ def test_fused_full_chain_matches_per_goal_chain():
             seq["residual_violation"], rel=1e-5, abs=1e-5)
 
 
+def test_bounded_dispatch_matches_unbounded():
+    """dispatch_rounds caps rounds per XLA execution (the TPU-tunnel
+    watchdog mitigation); the host loop must walk the IDENTICAL trajectory
+    to the unbounded driver — same final assignment, moves, and swaps."""
+    state, meta = _cluster()
+    constraint = BalancingConstraint()
+    masks = ExclusionMasks()
+    cfg = SearchConfig(num_sources=32, num_dests=8, moves_per_round=32,
+                       max_rounds=60)
+
+    st_unbounded = state
+    infos_unbounded = []
+    for i in range(len(CHAIN)):
+        st_unbounded, info = optimize_goal_in_chain(
+            st_unbounded, CHAIN, i, constraint, cfg, meta.num_topics, masks)
+        infos_unbounded.append(info)
+
+    for k in (1, 3):
+        st_bounded = state
+        infos_bounded = []
+        for i in range(len(CHAIN)):
+            st_bounded, info = optimize_goal_in_chain(
+                st_bounded, CHAIN, i, constraint, cfg, meta.num_topics,
+                masks, dispatch_rounds=k)
+            infos_bounded.append(info)
+        np.testing.assert_array_equal(np.asarray(st_bounded.assignment),
+                                      np.asarray(st_unbounded.assignment))
+        np.testing.assert_array_equal(np.asarray(st_bounded.leader_slot),
+                                      np.asarray(st_unbounded.leader_slot))
+        for a, b in zip(infos_unbounded, infos_bounded):
+            assert a["moves_applied"] == b["moves_applied"], (k, a["goal"])
+            assert a["swaps_applied"] == b["swaps_applied"], (k, a["goal"])
+            assert a["succeeded"] == b["succeeded"]
+
+
+def test_optimizer_switches_to_bounded_path_at_scale():
+    """GoalOptimizer must route clusters above solver.fused.chain.max.brokers
+    through the bounded per-goal path, with identical results."""
+    from cruise_control_tpu.analyzer.optimizer import (
+        GoalOptimizer, goals_by_priority,
+    )
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.model.fixtures import Dist, random_cluster
+
+    state, meta = random_cluster(num_brokers=12, num_topics=6,
+                                 num_partitions=240, rf=2, num_racks=4,
+                                 dist=Dist.EXPONENTIAL, seed=3,
+                                 target_utilization=0.5)
+    cfg_fused = CruiseControlConfig()
+    cfg_bounded = CruiseControlConfig(
+        {"solver.fused.chain.max.brokers": "8",
+         "solver.dispatch.max.rounds": "4"})
+    _, res_fused = GoalOptimizer(cfg_fused).optimizations(
+        state, meta, goals=goals_by_priority(cfg_fused))
+    _, res_bounded = GoalOptimizer(cfg_bounded).optimizations(
+        state, meta, goals=goals_by_priority(cfg_bounded))
+    assert sorted((p.topic, p.partition) for p in res_bounded.proposals) == \
+        sorted((p.topic, p.partition) for p in res_fused.proposals)
+    assert res_bounded.balancedness_after == pytest.approx(
+        res_fused.balancedness_after)
+
+
 def test_fused_chain_skips_satisfied_goals():
     """A goal with zero violations and no offline replicas on entry runs
     zero rounds in the fused kernel (the on-device fast path)."""

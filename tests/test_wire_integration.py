@@ -318,6 +318,40 @@ def test_sample_store_roundtrip(cluster):
     store.close()
 
 
+def test_sample_replay_survives_retention_trim(cluster):
+    """Warm-start replay must begin at the LOG-START offset, not 0:
+    cleanup.policy=delete advances the log start on a real cluster, and a
+    fetch(0) would be OFFSET_OUT_OF_RANGE — silently skipping the whole
+    partition (KafkaSampleStore.loadSamples uses earliest, not 0)."""
+    from cruise_control_tpu.monitor.sampling.sampler import SamplerResult
+    from cruise_control_tpu.monitor.sampling.samples import (
+        PartitionEntity, PartitionMetricSample,
+    )
+
+    store = KafkaSampleStore(cluster.bootstrap_servers, num_partitions=1)
+    for i in range(6):
+        store.store_samples(SamplerResult(
+            [PartitionMetricSample(PartitionEntity("t", i), 1000 + i,
+                                   (float(i),) * 4)], [], 0))
+    topic = store._topics["partition"]
+    cluster.trim_log(topic, 0, 3)
+    replayed = store.load_samples()
+    assert sorted(s.entity.partition for s in replayed.partition_samples) \
+        == [3, 4, 5]
+    store.close()
+
+
+def test_controller_failover_reroutes_admin_ops(cluster, client):
+    """Killing the controller must not wedge controller-routed admin ops:
+    the client re-resolves the controller and retries."""
+    client.create_topic("cf", 1, 2)
+    assert client._controller_id == 0
+    cluster.kill_broker(0)
+    client.create_topic("cf2", 1, 1)  # must reroute to the new controller
+    assert client._controller_id != 0
+    assert "cf2" in cluster.topics
+
+
 def test_fetch_paginates_whole_batches(cluster, client):
     """A byte-budget smaller than the full window must yield complete
     batches that make progress, never a truncated batch that decodes to []
