@@ -266,9 +266,14 @@ class GoalOptimizer:
             from ..parallel import optimize_chain_sharded, shard_cluster
             t0 = time.time()
             state = shard_cluster(state, mesh)
+            # Same large-cluster dispatch bound as the single-device path:
+            # one multi-minute XLA execution trips device-runtime watchdogs.
+            bounded = (self._fused_max_brokers > 0
+                       and state.num_brokers > self._fused_max_brokers)
             state, infos = optimize_chain_sharded(
                 state, goal_chain, self._constraint, search_cfg,
-                meta.num_topics, mesh, masks)
+                meta.num_topics, mesh, masks,
+                dispatch_rounds=self._dispatch_rounds if bounded else 0)
             goal_results = _apportioned_goal_results(
                 goal_chain, infos, time.time() - t0)
         elif self._fused_chain and (

@@ -78,17 +78,22 @@ class KafkaMetricsTransport:
             for i, rec in enumerate(batch):
                 rec.offset = i
             self._client.produce(self._topic, parts[self._rr], batch)
-        except ConnectionError:
-            # Re-queue so a transient broker blip does not punch a hole in
+        except (ConnectionError, m.KafkaProtocolError) as e:
+            # A PERMANENTLY-rejected batch (e.g. MESSAGE_TOO_LARGE) is NOT
+            # re-queued: the identical batch would fail identically every
+            # interval and poison the head of the buffer.
+            if isinstance(e, m.KafkaProtocolError) and e.is_permanent:
+                LOG.warning("broker rejected metrics batch (%d records): "
+                            "dropping it", len(batch), exc_info=True)
+                raise
+            # Transient failures (connection errors, leader elections in
+            # progress) re-queue so a broker blip does not punch a hole in
             # the metric windows the load model trains on (the Java
             # producer's in-flight buffer gives the reference the same
             # durability, CruiseControlMetricsReporter.java:241) — bounded
             # like buffer.memory: during a LONG outage the OLDEST records
             # are dropped first (they age out of the aggregation windows
-            # anyway; unbounded growth would OOM the broker agent). A
-            # PROTOCOL rejection (e.g. MESSAGE_TOO_LARGE) is NOT re-queued:
-            # the same batch would fail identically every interval and
-            # poison the head of the buffer.
+            # anyway; unbounded growth would OOM the broker agent).
             requeued = batch + self._pending
             if len(requeued) > self._max_pending:
                 dropped = len(requeued) - self._max_pending
@@ -96,10 +101,6 @@ class KafkaMetricsTransport:
                 LOG.warning("metrics buffer full: dropped %d oldest records",
                             dropped)
             self._pending = requeued
-            raise
-        except m.KafkaProtocolError:
-            LOG.warning("broker rejected metrics batch (%d records): "
-                        "dropping it", len(batch), exc_info=True)
             raise
 
     def poll(self, start_ms: int, end_ms: int) -> list[bytes]:

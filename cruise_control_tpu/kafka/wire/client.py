@@ -143,9 +143,13 @@ class WireClient:
     def _bootstrap_connection(self) -> BrokerConnection:
         if self._boot_conn is None:
             errors = []
-            # Known brokers first (post-metadata they may outlive the
-            # original bootstrap list), then the configured servers.
-            candidates = list(self._brokers.values()) + self._bootstrap
+            # Configured servers first (short, operator-chosen), then the
+            # brokers learned from metadata (they may outlive a stale
+            # bootstrap list). Deduplicated; each connect pays the full
+            # timeout, so the known list must not come first on a large
+            # cluster full of unreachable nodes.
+            candidates = list(dict.fromkeys(
+                self._bootstrap + list(self._brokers.values())))
             for host, port in candidates:
                 conn = BrokerConnection(host, port, self._client_id,
                                         self._timeout)
@@ -268,9 +272,14 @@ class WireClient:
                                        f"{topic}-{partition}")
         return parts[partition]["leader"]
 
-    def _leader_call(self, topic: str, partition: int, call):
-        """Run ``call(leader_connection)``; on stale-leadership or
-        connection errors, refresh the topic's metadata once and retry."""
+    def _leader_call(self, topic: str, partition: int, call,
+                     retry_conn_error: bool = True):
+        """Run ``call(leader_connection)``; on stale-leadership (or, when
+        ``retry_conn_error``, connection) errors, refresh the topic's
+        metadata once and retry. Produce passes ``retry_conn_error=False``:
+        a connection that died AFTER the broker committed the batch would
+        make the blind re-send a duplicate append — the caller owns that
+        at-least-once decision, not this helper."""
         try:
             return call(self.connection(self.leader_of(topic, partition)))
         except m.KafkaProtocolError as e:
@@ -280,6 +289,8 @@ class WireClient:
             self.invalidate_topic(topic)
         except ConnectionError:
             self.invalidate_topic(topic)
+            if not retry_conn_error:
+                raise
         return call(self.connection(self.leader_of(topic, partition)))
 
     # ---- admin -----------------------------------------------------------
@@ -288,14 +299,21 @@ class WireClient:
                      configs: Mapping[str, str] | None = None,
                      error_ok: tuple[int, ...] = (m.TOPIC_ALREADY_EXISTS,),
                      ) -> int:
-        resp = self._controller_send(m.CREATE_TOPICS, {
+        body = {
             "topics": [{"name": name, "num_partitions": num_partitions,
                         "replication_factor": replication_factor,
                         "assignments": [],
                         "configs": [{"name": k, "value": v}
                                     for k, v in (configs or {}).items()]}],
-            "timeout_ms": int(self._timeout * 1000)})
+            "timeout_ms": int(self._timeout * 1000)}
+        resp = self._controller_send(m.CREATE_TOPICS, body)
         code = resp["topics"][0]["error_code"]
+        if code == m.NOT_CONTROLLER:
+            # CreateTopics carries error codes per topic, not top-level, so
+            # _controller_send cannot see a stale-controller answer itself.
+            self._controller_id = None
+            resp = self._controller_send(m.CREATE_TOPICS, body)
+            code = resp["topics"][0]["error_code"]
         if code not in (m.NONE, *error_ok):
             raise m.KafkaProtocolError(code, f"create_topic({name})")
         return code
@@ -470,7 +488,8 @@ class WireClient:
                                            f"produce({topic}-{partition})")
             return p["base_offset"]
 
-        return self._leader_call(topic, partition, call)
+        return self._leader_call(topic, partition, call,
+                                 retry_conn_error=False)
 
     def fetch(self, topic: str, partition: int, offset: int,
               max_bytes: int = 8 << 20) -> tuple[list[Record], int]:

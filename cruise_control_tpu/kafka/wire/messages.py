@@ -364,7 +364,10 @@ BY_KEY: dict[int, Api] = {api.key: api for api in ALL_APIS}
 NONE = 0
 UNKNOWN_SERVER_ERROR = -1
 OFFSET_OUT_OF_RANGE = 1
+CORRUPT_MESSAGE = 2
 UNKNOWN_TOPIC_OR_PARTITION = 3
+MESSAGE_TOO_LARGE = 10
+UNSUPPORTED_VERSION = 35
 NOT_LEADER_OR_FOLLOWER = 6
 TOPIC_ALREADY_EXISTS = 36
 INVALID_REQUEST = 42
@@ -379,6 +382,9 @@ REPLICA_NOT_AVAILABLE = 9
 ERROR_NAMES = {
     NONE: "NONE", UNKNOWN_SERVER_ERROR: "UNKNOWN_SERVER_ERROR",
     OFFSET_OUT_OF_RANGE: "OFFSET_OUT_OF_RANGE",
+    CORRUPT_MESSAGE: "CORRUPT_MESSAGE",
+    MESSAGE_TOO_LARGE: "MESSAGE_TOO_LARGE",
+    UNSUPPORTED_VERSION: "UNSUPPORTED_VERSION",
     UNKNOWN_TOPIC_OR_PARTITION: "UNKNOWN_TOPIC_OR_PARTITION",
     NOT_LEADER_OR_FOLLOWER: "NOT_LEADER_OR_FOLLOWER",
     TOPIC_ALREADY_EXISTS: "TOPIC_ALREADY_EXISTS",
@@ -393,8 +399,19 @@ ERROR_NAMES = {
 }
 
 
+# Codes where re-sending the SAME request can never succeed — callers that
+# buffer-and-retry must drop on these instead of re-queueing.
+PERMANENT_ERRORS = frozenset({
+    CORRUPT_MESSAGE, MESSAGE_TOO_LARGE, UNSUPPORTED_VERSION, INVALID_REQUEST,
+})
+
+
 class KafkaProtocolError(RuntimeError):
     def __init__(self, code: int, context: str = ""):
         self.code = code
         name = ERROR_NAMES.get(code, str(code))
         super().__init__(f"{name}{f' ({context})' if context else ''}")
+
+    @property
+    def is_permanent(self) -> bool:
+        return self.code in PERMANENT_ERRORS

@@ -538,11 +538,17 @@ def optimize_chain_sharded(state: ClusterTensors, chain,
                            num_topics: int, mesh: Mesh,
                            masks: ExclusionMasks | None = None,
                            swap_moves: int = 8, swap_max_rounds: int = 64,
+                           dispatch_rounds: int = 0,
                            ) -> tuple[ClusterTensors, list[dict]]:
     """Sharded analogue of ``analyzer.chain.optimize_chain``: the whole
     chain in one dispatch over the mesh, same info-dict contract and error
     behavior (hard-goal failure / stats-regression raised per goal in chain
-    order from the stacked stats)."""
+    order from the stacked stats).
+
+    ``dispatch_rounds`` > 0 selects the bounded per-goal driver instead —
+    same kernels and trajectory, ≤ that many search rounds per device
+    dispatch (the large-cluster watchdog mitigation of
+    ``analyzer.chain.optimize_goal_in_chain``, under the mesh)."""
     masks = masks or ExclusionMasks()
     goals = tuple(chain)
     if not goals:
@@ -550,8 +556,125 @@ def optimize_chain_sharded(state: ClusterTensors, chain,
     presence = (masks.excluded_topics is not None,
                 masks.excluded_replica_move_brokers is not None,
                 masks.excluded_leadership_brokers is not None)
+    if dispatch_rounds > 0:
+        return _optimize_chain_sharded_bounded(
+            state, goals, constraint, cfg, num_topics, mesh, masks, presence,
+            swap_moves, swap_max_rounds, dispatch_rounds)
     fn = _make_chain_full(mesh, goals, constraint, cfg, num_topics, presence,
                           swap_moves, swap_max_rounds)
     state, stats = fn(state, masks)
     stats = {k: jax.device_get(v) for k, v in stats.items()}
+    return state, _chain_infos_from_stats(goals, stats)
+
+
+@lru_cache(maxsize=64)
+def _make_chain_phase_kernels(mesh: Mesh, goals, constraint,
+                              cfg: SearchConfig, num_topics: int,
+                              mask_presence: tuple[bool, bool, bool],
+                              swap_moves: int, swap_max_rounds: int):
+    """Per-goal sharded kernels (move pass / swap pass / stats), each ONE
+    compile for the whole chain via traced (active_idx, prior_mask) — the
+    bounded-dispatch counterparts of ``_make_chain_full``."""
+    shards = mesh.devices.size
+    rep = P()  # replicated scalars
+
+    def move_body(state, masks, active_idx, prior_mask, budget):
+        return run_rounds_loop(
+            lambda st: _chain_round_local(
+                st, masks, active_idx, prior_mask, goals=goals,
+                constraint=constraint, cfg=cfg, num_topics=num_topics,
+                num_shards=shards),
+            state, cfg.max_rounds, budget=budget)
+
+    def swap_body(state, masks, active_idx, prior_mask, budget):
+        return run_rounds_loop(
+            lambda st: _chain_swap_local(
+                st, masks, active_idx, prior_mask, goals=goals,
+                constraint=constraint, num_topics=num_topics,
+                num_shards=shards, moves=swap_moves),
+            state, swap_max_rounds, budget=budget)
+
+    def stats_body(state, masks, active_idx):
+        return _chain_stats_local(state, masks, active_idx, goals=goals,
+                                  constraint=constraint,
+                                  num_topics=num_topics)
+
+    mask_specs = _mask_specs(mask_presence)
+    move = jax.jit(shard_map(
+        move_body, mesh=mesh,
+        in_specs=(_state_specs(), mask_specs, rep, rep, rep),
+        out_specs=(_state_specs(), rep, rep), check_vma=False))
+    swap = jax.jit(shard_map(
+        swap_body, mesh=mesh,
+        in_specs=(_state_specs(), mask_specs, rep, rep, rep),
+        out_specs=(_state_specs(), rep, rep), check_vma=False))
+    stats = jax.jit(shard_map(
+        stats_body, mesh=mesh,
+        in_specs=(_state_specs(), mask_specs, rep),
+        out_specs=(rep, rep, rep), check_vma=False))
+    return move, swap, stats
+
+
+def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
+                                    num_topics, mesh, masks, presence,
+                                    swap_moves, swap_max_rounds,
+                                    dispatch_rounds: int,
+                                    ) -> tuple[ClusterTensors, list[dict]]:
+    """Host-looped per-goal sharded driver: the trajectory of
+    ``_chain_full_local`` with every device dispatch bounded to
+    ``dispatch_rounds`` search rounds."""
+    move, swap, stats_fn = _make_chain_phase_kernels(
+        mesh, goals, constraint, cfg, num_topics, presence, swap_moves,
+        swap_max_rounds)
+    k = dispatch_rounds
+    per_goal = {name: [] for name in
+                ("viol_before", "obj_before", "offline_before", "viol_after",
+                 "obj_after", "offline_after", "moves", "swaps", "rounds")}
+
+    def run_pass(kernel, st, idx, prior, pass_cap: int):
+        applied_total, pass_rounds = 0, 0
+        while pass_rounds < pass_cap:
+            budget = min(k, pass_cap - pass_rounds)
+            st, applied, r = kernel(st, masks, idx, prior,
+                                    jnp.int32(budget))
+            applied_total += int(applied)
+            pass_rounds += int(r)
+            if int(r) < budget:
+                break
+        return st, applied_total, pass_rounds
+
+    for g, goal in enumerate(goals):
+        idx = jnp.int32(g)
+        prior = jnp.asarray([j < g for j in range(len(goals))])
+        viol0, obj0, offline0 = stats_fn(state, masks, idx)
+        per_goal["viol_before"].append(float(viol0))
+        per_goal["obj_before"].append(float(obj0))
+        per_goal["offline_before"].append(int(offline0))
+        moves_total = swaps_total = rounds = 0
+        # The fused kernel's per-goal fast path: zero violations + no
+        # offline replicas + no drain pending = skip entirely.
+        drain = masks.excluded_replica_move_brokers is not None
+        if float(viol0) > 0 or int(offline0) > 0 or drain:
+            while rounds < cfg.max_rounds:
+                state, m_, r = run_pass(move, state, idx, prior,
+                                        cfg.max_rounds)
+                moves_total += m_
+                rounds += r
+                if not goal.supports_swap:
+                    break
+                state, sw, sr = run_pass(swap, state, idx, prior,
+                                         swap_max_rounds)
+                swaps_total += sw
+                rounds += sr
+                if sw == 0:
+                    break
+        viol1, obj1, offline1 = stats_fn(state, masks, idx)
+        per_goal["viol_after"].append(float(viol1))
+        per_goal["obj_after"].append(float(obj1))
+        per_goal["offline_after"].append(int(offline1))
+        per_goal["moves"].append(moves_total)
+        per_goal["swaps"].append(swaps_total)
+        per_goal["rounds"].append(rounds)
+    import numpy as np
+    stats = {kname: np.asarray(v) for kname, v in per_goal.items()}
     return state, _chain_infos_from_stats(goals, stats)

@@ -199,6 +199,39 @@ def test_sharded_full_chain_matches_single_device_outcome(mesh, cluster):
     assert float(viol.sum()) <= 1e-6
 
 
+def test_sharded_bounded_dispatch_matches_fused(mesh, cluster):
+    """The bounded per-goal sharded driver (dispatch_rounds > 0) must walk
+    the IDENTICAL trajectory to the fused whole-chain mesh kernel — same
+    final assignment and per-goal moves/swaps (both run the same per-device
+    round bodies; only dispatch boundaries differ)."""
+    from cruise_control_tpu.analyzer.goals import ReplicaCapacityGoal
+    from cruise_control_tpu.parallel import optimize_chain_sharded
+
+    state, meta = cluster
+    chain = (RackAwareGoal(), ReplicaCapacityGoal(),
+             ReplicaDistributionGoal(),
+             NetworkOutboundUsageDistributionGoal())
+    cfg = SearchConfig(num_sources=32, num_dests=8, moves_per_round=8,
+                       max_rounds=60)
+    sharded = shard_cluster(state, mesh)
+    st_fused, infos_fused = optimize_chain_sharded(
+        sharded, chain, CONSTRAINT, cfg, meta.num_topics, mesh)
+    st_bounded, infos_bounded = optimize_chain_sharded(
+        shard_cluster(state, mesh), chain, CONSTRAINT, cfg,
+        meta.num_topics, mesh, dispatch_rounds=3)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(st_bounded).assignment),
+        np.asarray(jax.device_get(st_fused).assignment))
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(st_bounded).leader_slot),
+        np.asarray(jax.device_get(st_fused).leader_slot))
+    for f, b in zip(infos_fused, infos_bounded):
+        assert f["goal"] == b["goal"]
+        assert f["succeeded"] == b["succeeded"]
+        assert f["moves_applied"] == b["moves_applied"], f["goal"]
+        assert f["swaps_applied"] == b["swaps_applied"], f["goal"]
+
+
 def test_goal_optimizer_uses_mesh(mesh, cluster):
     """GoalOptimizer(mesh=...) routes optimizations through the sharded
     chain kernel and reports the device count."""
