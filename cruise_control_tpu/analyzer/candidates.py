@@ -22,8 +22,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from ..common.resources import Resource
-from ..model.tensors import ClusterTensors, is_leader_slot, replica_exists, replica_load
+from ..model.tensors import ClusterTensors, is_leader_slot, replica_exists
 from .derived import DerivedState
 
 KIND_MOVE = 0

@@ -13,7 +13,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from ..common.resources import NUM_RESOURCES, Resource
+from ..common.resources import Resource
 from ..model.tensors import (
     ClusterTensors, alive_mask, broker_leader_counts, broker_load,
     broker_replica_counts, new_broker_mask, potential_nw_out,

@@ -9,13 +9,12 @@ from __future__ import annotations
 
 import dataclasses
 
-import jax
 import jax.numpy as jnp
 
 from ...common.resources import Resource
 from ...model.tensors import replica_load
 from ..candidates import CandidateDeltas
-from .base import Goal, new_broker_gate, pair_improvement
+from .base import Goal, pair_improvement
 
 
 @dataclasses.dataclass(frozen=True)

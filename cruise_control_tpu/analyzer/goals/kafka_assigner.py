@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from ...common.resources import Resource
 from ..candidates import CandidateDeltas
-from .base import Goal, gather_pair, pair_improvement
+from .base import Goal, pair_improvement
 from .rack import RackAwareGoal
 
 

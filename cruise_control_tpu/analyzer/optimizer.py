@@ -26,7 +26,7 @@ from .constraint import BalancingConstraint, OptimizationOptions
 from .goals import ALL_GOALS
 from .goals.base import Goal
 from .proposals import ExecutionProposal, diff_proposals
-from .search import ExclusionMasks, OptimizationFailureError, SearchConfig
+from .search import ExclusionMasks, SearchConfig
 
 LOG = logging.getLogger(__name__)
 

@@ -20,10 +20,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
 from ..config.cruise_control_config import CruiseControlConfig
-from ..facade import CruiseControl, OperationResult
+from ..facade import CruiseControl
 from ..monitor.load_monitor import NotEnoughValidWindowsError
 from . import responses
-from .endpoints import REVIEWABLE_ENDPOINTS, EndPoint, Role, endpoint_for_path
+from .endpoints import REVIEWABLE_ENDPOINTS, EndPoint, endpoint_for_path
 from .parameters import ParameterParseError, parse_parameters
 from .purgatory import Purgatory
 from .security import (

@@ -15,7 +15,7 @@ import logging
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Sequence
+from typing import Any
 
 from ..config.cruise_control_config import CruiseControlConfig
 from .anomaly import Anomaly, AnomalyType

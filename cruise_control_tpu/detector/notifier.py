@@ -17,7 +17,7 @@ import json
 import logging
 import time
 import urllib.request
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable
 
 from ..config.cruise_control_config import CruiseControlConfig

@@ -15,7 +15,7 @@ from __future__ import annotations
 import enum
 import threading
 import time
-from typing import Callable, Iterable, Sequence
+from typing import Callable, Sequence
 
 from ..analyzer.proposals import ExecutionProposal
 from .admin import AdminBackend

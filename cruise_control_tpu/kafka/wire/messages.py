@@ -367,6 +367,7 @@ OFFSET_OUT_OF_RANGE = 1
 CORRUPT_MESSAGE = 2
 UNKNOWN_TOPIC_OR_PARTITION = 3
 MESSAGE_TOO_LARGE = 10
+RECORD_LIST_TOO_LARGE = 18
 UNSUPPORTED_VERSION = 35
 NOT_LEADER_OR_FOLLOWER = 6
 TOPIC_ALREADY_EXISTS = 36
@@ -384,6 +385,7 @@ ERROR_NAMES = {
     OFFSET_OUT_OF_RANGE: "OFFSET_OUT_OF_RANGE",
     CORRUPT_MESSAGE: "CORRUPT_MESSAGE",
     MESSAGE_TOO_LARGE: "MESSAGE_TOO_LARGE",
+    RECORD_LIST_TOO_LARGE: "RECORD_LIST_TOO_LARGE",
     UNSUPPORTED_VERSION: "UNSUPPORTED_VERSION",
     UNKNOWN_TOPIC_OR_PARTITION: "UNKNOWN_TOPIC_OR_PARTITION",
     NOT_LEADER_OR_FOLLOWER: "NOT_LEADER_OR_FOLLOWER",
@@ -401,8 +403,11 @@ ERROR_NAMES = {
 
 # Codes where re-sending the SAME request can never succeed — callers that
 # buffer-and-retry must drop on these instead of re-queueing.
+# CORRUPT_MESSAGE is deliberately NOT here: in-transit corruption succeeds
+# on re-send (the Java client treats CorruptRecordException as retriable).
 PERMANENT_ERRORS = frozenset({
-    CORRUPT_MESSAGE, MESSAGE_TOO_LARGE, UNSUPPORTED_VERSION, INVALID_REQUEST,
+    MESSAGE_TOO_LARGE, RECORD_LIST_TOO_LARGE, UNSUPPORTED_VERSION,
+    INVALID_REQUEST,
 })
 
 

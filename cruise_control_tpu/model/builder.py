@@ -59,6 +59,18 @@ class ClusterModelBuilder:
         self._brokers.append(BrokerSpec(broker_id, rack, capacity, state))
         return self
 
+    @property
+    def broker_specs(self) -> list[BrokerSpec]:
+        return list(self._brokers)
+
+    @property
+    def partition_bucket(self) -> int:
+        return self._partition_bucket
+
+    @property
+    def broker_bucket(self) -> int:
+        return self._broker_bucket
+
     def add_partition(self, topic: str, partition: int, replicas: Sequence[int],
                       leader_load: Mapping[Resource, float] | None = None,
                       follower_load: Mapping[Resource, float] | None = None,
@@ -192,9 +204,28 @@ def build_cluster_from_arrays(brokers: Sequence[BrokerSpec],
     max_rf = max((len(r) for r in replicas), default=1)
 
     assignment = np.full((n_p, max_rf), -1, dtype=np.int32)
-    for i, reps in enumerate(replicas):
-        for s, bid in enumerate(reps):
-            assignment[i, s] = broker_index[bid]
+    if isinstance(replicas, np.ndarray):
+        # Bulk path: [N, rf] broker-ID matrix → index lookup table (a
+        # per-replica Python loop is minutes at 1M partitions). -1 slots
+        # are the empty-slot sentinel and pass through unchanged; any
+        # other out-of-table id is an error (negative ids must not wrap
+        # into lut[-1], and too-large ids must not surface as a raw
+        # IndexError).
+        empty = replicas < 0
+        if ((replicas < -1) | (replicas > max(broker_ids))).any():
+            raise ValueError("replica matrix references unknown broker ids")
+        lut = np.full(max(broker_ids) + 1, -1, dtype=np.int32)
+        lut[np.asarray(broker_ids)] = np.arange(len(broker_ids),
+                                                dtype=np.int32)
+        mapped = lut[np.where(empty, 0, replicas)]
+        if (mapped[~empty] < 0).any():
+            raise ValueError("replica matrix references unknown broker ids")
+        assignment[:len(replicas), :replicas.shape[1]] = \
+            np.where(empty, -1, mapped)
+    else:
+        for i, reps in enumerate(replicas):
+            for s, bid in enumerate(reps):
+                assignment[i, s] = broker_index[bid]
     leader_slot = np.full((n_p,), -1, dtype=np.int32)
     leader_slot[:n] = np.asarray(leader_indices, dtype=np.int32)
     ll = np.zeros((n_p, NUM_RESOURCES), dtype=np.float32)

@@ -128,6 +128,10 @@ def random_cluster(num_brokers: int, num_topics: int, num_partitions: int,
         / (per_broker * (1.0 + 0.4 * (rf - 1))),
     }
 
+    if num_partitions >= 200_000:
+        return _random_cluster_bulk(b, rng, num_brokers, num_partitions, rf,
+                                    topic_of, base, weights, coeff)
+
     per_topic_counter: dict[int, int] = {}
     for i in range(num_partitions):
         t = int(topic_of[i])
@@ -139,3 +143,64 @@ def random_cluster(num_brokers: int, num_topics: int, num_partitions: int,
             f"topic{t}", pnum, [int(x) for x in replicas],
             leader_load={r: coeff[r] * scale for r in Resource})
     return b.build()
+
+
+def _random_cluster_bulk(b: ClusterModelBuilder, rng, num_brokers: int,
+                         num_partitions: int, rf: int, topic_of, base,
+                         weights, coeff) -> tuple[ClusterTensors, ClusterMeta]:
+    """Vectorized generator for LinkedIn-scale fixtures (7k brokers / 1M
+    partitions): the per-partition ``rng.choice(replace=False, p=...)``
+    loop costs minutes at that size. Weighted sampling rides the inverse
+    CDF (with replacement), then only the rows that drew a duplicate
+    broker are re-drawn — a vanishing fraction when rf ≪ num_brokers."""
+    from .builder import build_cluster_from_arrays
+    from ..common.resources import NUM_RESOURCES
+
+    cdf = np.cumsum(weights)
+    replicas = np.searchsorted(
+        cdf, rng.random((num_partitions, rf)) * cdf[-1]).astype(np.int32)
+    replicas = np.minimum(replicas, num_brokers - 1)
+    for _ in range(64):
+        srt = np.sort(replicas, axis=1)
+        bad = (srt[:, 1:] == srt[:, :-1]).any(axis=1)
+        if not bad.any():
+            break
+        n_bad = int(bad.sum())
+        replicas[bad] = np.minimum(np.searchsorted(
+            cdf, rng.random((n_bad, rf)) * cdf[-1]), num_brokers - 1)
+    else:  # pragma: no cover - rf ~ num_brokers degenerate case
+        for i in np.flatnonzero(bad):
+            replicas[i] = rng.choice(num_brokers, size=rf, replace=False,
+                                     p=weights)
+
+    # Partition numbers in draw order within each topic, rows ordered by
+    # (lexicographic topic name, partition) — identical layout to the
+    # per-partition builder path.
+    names = [f"topic{t}" for t in range(len(np.bincount(topic_of)))]
+    order = np.argsort(topic_of, kind="stable")
+    counts = np.bincount(topic_of, minlength=len(names))
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    pnum_sorted = np.arange(num_partitions) - np.repeat(starts, counts)
+    pnum = np.empty(num_partitions, dtype=np.int64)
+    pnum[order] = pnum_sorted
+    lex = np.argsort(np.array(names))
+    lex_rank = np.empty(len(names), dtype=np.int64)
+    lex_rank[lex] = np.arange(len(names))
+    row_order = np.lexsort((pnum, lex_rank[topic_of]))
+
+    ll = np.zeros((num_partitions, NUM_RESOURCES), dtype=np.float32)
+    for r, c in coeff.items():
+        ll[:, int(r)] = c * base
+    # Vectorized derive_follower_load (same 0.4 follower CPU fraction).
+    fl = np.array(ll, dtype=np.float32)
+    fl[:, int(Resource.NW_OUT)] = 0.0
+    fl[:, int(Resource.CPU)] *= 0.4
+
+    part_names = [(names[int(t)], int(p))
+                  for t, p in zip(topic_of[row_order], pnum[row_order])]
+    return build_cluster_from_arrays(
+        b.broker_specs, part_names, replicas[row_order],
+        np.zeros(num_partitions, dtype=np.int32),
+        ll[row_order], fl[row_order],
+        partition_bucket=b.partition_bucket,
+        broker_bucket=b.broker_bucket)

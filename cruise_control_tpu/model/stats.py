@@ -14,7 +14,6 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from ..common.resources import NUM_RESOURCES
 from .tensors import (
     ClusterTensors, alive_mask, broker_leader_counts, broker_load,
     broker_replica_counts, potential_nw_out,

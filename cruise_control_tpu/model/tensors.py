@@ -37,7 +37,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..common.broker_state import BrokerState
-from ..common.resources import NUM_RESOURCES
 
 
 @partial(jax.tree_util.register_dataclass,

@@ -37,7 +37,7 @@ from .aggregator.aggregator import (
 )
 from .capacity import BrokerCapacityConfigResolver, StaticCapacityResolver
 from .sampling.fetcher import MetricFetcherManager
-from .sampling.sampler import MetricSampler, now_ms
+from .sampling.sampler import MetricSampler
 from .sampling.sample_store import NoopSampleStore, SampleStore
 from .task_runner import LoadMonitorTaskRunner, SamplingMode
 

@@ -17,7 +17,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import time
-from typing import Callable, Iterable, Mapping, Protocol
+from typing import Callable, Mapping, Protocol
 
 from ...executor.admin import PartitionState
 from ...metricdef.raw_metric_type import RawMetricType as R

@@ -12,7 +12,7 @@ from __future__ import annotations
 import enum
 import logging
 import threading
-from typing import Mapping
+
 
 from ..executor.admin import AdminBackend
 from .sampling.fetcher import MetricFetcherManager

@@ -652,8 +652,20 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
         per_goal["offline_before"].append(int(offline0))
         moves_total = swaps_total = rounds = 0
         # The fused kernel's per-goal fast path: zero violations + no
-        # offline replicas + no drain pending = skip entirely.
-        drain = masks.excluded_replica_move_brokers is not None
+        # offline replicas + no drain pending = skip entirely. Drain
+        # pending mirrors _chain_full_local.drain_pending — an alive
+        # excluded broker STILL HOSTING replicas, not mere mask presence
+        # (presence alone would run every goal on an already-drained
+        # cluster that the fused path skips).
+        drain = False
+        if masks.excluded_replica_move_brokers is not None:
+            excl_alive = (masks.excluded_replica_move_brokers
+                          & alive_mask(state))
+            b_dim = state.num_brokers
+            seg = jnp.where(state.assignment >= 0, state.assignment, b_dim)
+            on_excl = jnp.concatenate(
+                [excl_alive, jnp.array([False])])[seg]
+            drain = bool(on_excl.any())
         if float(viol0) > 0 or int(offline0) > 0 or drain:
             while rounds < cfg.max_rounds:
                 state, m_, r = run_pass(move, state, idx, prior,

@@ -166,3 +166,29 @@ def test_random_cluster_shapes():
                                       skew_to_first=3.0)
     counts = np.asarray(broker_replica_counts(skew))
     assert counts[0] > counts[-1]
+
+
+def test_random_cluster_bulk_path_invariants():
+    """The vectorized LinkedIn-scale generator (>=200k partitions) must
+    satisfy the same layout invariants as the per-partition path: valid
+    broker-diverse replica rows, (topic, partition) row ordering, leaders
+    in slot 0, and the configured placement skew."""
+    state, meta = fixtures.random_cluster(
+        num_brokers=500, num_topics=50, num_partitions=200_000, rf=3,
+        num_racks=8, dist=fixtures.Dist.EXPONENTIAL, seed=11,
+        skew_to_first=2.0, target_utilization=0.55)
+    a = np.asarray(state.assignment)
+    assert a.shape == (200_000, 3)
+    assert (a >= 0).all() and (a < 500).all()
+    srt = np.sort(a, axis=1)
+    assert not (srt[:, 1:] == srt[:, :-1]).any(), "duplicate replicas"
+    assert meta.partition_index == sorted(meta.partition_index)
+    assert (np.asarray(state.leader_slot) == 0).all()
+    counts = np.bincount(a.reshape(-1), minlength=500)
+    assert counts[0] > counts[499], "skew_to_first must bias placement"
+    # utilization normalization holds on the bulk path too
+    from cruise_control_tpu.model.tensors import broker_load
+    from cruise_control_tpu.common.resources import Resource
+    load = np.asarray(broker_load(state))
+    util = load[:, int(Resource.NW_OUT)].mean() / 1000.0
+    assert 0.4 < util < 0.7, util
