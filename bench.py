@@ -209,8 +209,21 @@ def _guarded_main(deadline: float) -> int:
         if remaining < 60:
             break
         t0 = time.time()
-        _emit(_run_stage(jax, num_brokers, num_partitions, drain, device,
-                         on_cpu=platform is None or platform == "cpu"))
+        try:
+            _emit(_run_stage(jax, num_brokers, num_partitions, drain, device,
+                             on_cpu=platform is None or platform == "cpu"))
+        except _Watchdog:
+            raise
+        except Exception as e:  # noqa: BLE001 — a dead stage must still
+            # leave a parseable record (e.g. the TPU worker being killed at
+            # scale); the device is likely gone, so stop rather than hang
+            # the remaining stages on a dead tunnel.
+            _emit({"metric": "stage_failed", "value": round(
+                time.time() - t0, 3), "unit": "s", "vs_baseline": 0.0,
+                "extras": {"stage": f"{num_brokers}b_{num_partitions}p"
+                           + (f"_drain{drain}" if drain else ""),
+                           "error": f"{type(e).__name__}: {e}"[:500]}})
+            return 0
         prev_total = time.time() - t0
     return 0
 

@@ -84,6 +84,23 @@ def _gated_aux(needed: jax.Array, goal: Goal, state, derived, constraint,
     return goal.finalize_aux(partial_aux, state, derived, constraint)
 
 
+def excluded_hosting_replicas(state: ClusterTensors,
+                              excluded_replica_move_brokers: jax.Array,
+                              ) -> jax.Array:
+    """[P, S] bool: replica sits on an ALIVE excluded-for-replica-move
+    broker. Any() of this is "drain pending" — goals must keep running to
+    shed replicas off excluded brokers even with zero violations
+    (requireLessLoad includes excluded brokers,
+    ResourceDistributionGoal.java:387). Shared by the fused and
+    bounded-dispatch drivers on both the single-device and sharded paths
+    so their per-goal fast-path skip conditions cannot diverge."""
+    from ..model.tensors import alive_mask
+    excl_alive = excluded_replica_move_brokers & alive_mask(state)
+    b = state.num_brokers
+    seg = jnp.where(state.assignment >= 0, state.assignment, b)
+    return jnp.concatenate([excl_alive, jnp.array([False])])[seg]
+
+
 def _goal_flags(goals: tuple[Goal, ...]):
     lead_only = jnp.asarray([g.leadership_only for g in goals])
     incl_lead = jnp.asarray([g.include_leadership or g.leadership_only
@@ -363,19 +380,13 @@ def chain_optimize_full(state: ClusterTensors, goals: tuple[Goal, ...],
     supports_swap = jnp.asarray([g.supports_swap for g in goals])
 
     def drain_pending(s: ClusterTensors) -> jax.Array:
-        """True while any ALIVE excluded-for-replica-move broker still hosts
-        replicas: the drain story (requireLessLoad includes excluded
-        brokers, ResourceDistributionGoal.java:387) — goals shed replicas
-        off excluded brokers even when their own violations are zero, so
-        the per-goal fast path must stay off."""
+        """True while any ALIVE excluded-for-replica-move broker still
+        hosts replicas — the per-goal fast path must stay off during a
+        drain (see excluded_hosting_replicas)."""
         if masks.excluded_replica_move_brokers is None:
             return jnp.bool_(False)
-        from ..model.tensors import alive_mask
-        excl_alive = masks.excluded_replica_move_brokers & alive_mask(s)
-        b = s.num_brokers
-        seg = jnp.where(s.assignment >= 0, s.assignment, b)
-        on_excl = jnp.concatenate([excl_alive, jnp.array([False])])[seg]
-        return on_excl.any()
+        return excluded_hosting_replicas(
+            s, masks.excluded_replica_move_brokers).any()
 
     def per_goal(carry_state, g):
         prior = jnp.arange(g_count) < g

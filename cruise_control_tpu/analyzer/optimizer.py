@@ -192,8 +192,12 @@ class GoalOptimizer:
         resource."""
         b = state.num_brokers
         budget = self._cand_budget if self._cand_budget_explicit \
-            else max(self._cand_budget, min(65_536, b * 64))
-        num_dests = max(16, min(256, b // 4))
+            else max(self._cand_budget, min(131_072, b * 64))
+        # dests/moves caps bind only above ~2k brokers (1k keeps 250/500 —
+        # measured best quality there); at 7k the wider 512-dest grid and
+        # 1024-move rounds roughly halve the round count for the
+        # count-distribution goals, the scarce resource at that scale.
+        num_dests = max(16, min(512, b // 4))
         if self._cand_budget_explicit:
             # Honor the operator's budget as a bound on the move grid:
             # sources × dests ≤ budget (floors drop to the minimum viable).
@@ -201,7 +205,7 @@ class GoalOptimizer:
             num_sources = max(16, min(1024, budget // num_dests))
         else:
             num_sources = max(64, min(1024, budget // num_dests))
-        moves = max(self._moves_base, min(512, b // 2))
+        moves = max(self._moves_base, min(1024, b // 2))
         return SearchConfig(num_sources=num_sources, num_dests=num_dests,
                             moves_per_round=moves,
                             max_rounds=self._max_rounds)

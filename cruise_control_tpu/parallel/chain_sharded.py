@@ -38,6 +38,7 @@ from ..analyzer.candidates import (
 )
 from ..analyzer.chain import (
     _chain_infos_from_stats, _gated_aux, _goal_flags, _switch_scores,
+    excluded_hosting_replicas,
 )
 from ..analyzer.constraint import BalancingConstraint
 from ..analyzer.derived import compute_derived
@@ -47,7 +48,7 @@ from ..analyzer.search import (
     run_rounds_loop,
 )
 from ..common.resources import Resource
-from ..model.tensors import ClusterTensors, alive_mask, offline_replicas
+from ..model.tensors import ClusterTensors, offline_replicas
 from .mesh import PARTITION_AXIS
 from .sharded import _mask_specs, _psum, _state_specs
 
@@ -448,11 +449,9 @@ def _chain_full_local(state: ClusterTensors, masks: ExclusionMasks, *,
     def drain_pending(s: ClusterTensors) -> jax.Array:
         if masks.excluded_replica_move_brokers is None:
             return jnp.bool_(False)
-        excl_alive = masks.excluded_replica_move_brokers & alive_mask(s)
-        b = s.num_brokers
-        seg = jnp.where(s.assignment >= 0, s.assignment, b)
-        on_excl = jnp.concatenate([excl_alive, jnp.array([False])])[seg]
-        return _psum(on_excl.sum()) > 0
+        on_excl = excluded_hosting_replicas(
+            s, masks.excluded_replica_move_brokers)
+        return _psum(on_excl.sum()) > 0  # replicated predicate on the mesh
 
     def per_goal(carry_state, g):
         prior = jnp.arange(g_count) < g
@@ -659,13 +658,8 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
         # cluster that the fused path skips).
         drain = False
         if masks.excluded_replica_move_brokers is not None:
-            excl_alive = (masks.excluded_replica_move_brokers
-                          & alive_mask(state))
-            b_dim = state.num_brokers
-            seg = jnp.where(state.assignment >= 0, state.assignment, b_dim)
-            on_excl = jnp.concatenate(
-                [excl_alive, jnp.array([False])])[seg]
-            drain = bool(on_excl.any())
+            drain = bool(excluded_hosting_replicas(
+                state, masks.excluded_replica_move_brokers).any())
         if float(viol0) > 0 or int(offline0) > 0 or drain:
             while rounds < cfg.max_rounds:
                 state, m_, r = run_pass(move, state, idx, prior,
