@@ -1,0 +1,140 @@
+"""Analyzer plugin SPIs: optimization-options generation + rack-id mapping.
+
+Reference parity:
+- ``OptimizationOptionsGenerator`` /
+  ``DefaultOptimizationOptionsGenerator.java`` — a config-swappable hook
+  deciding the ``OptimizationOptions`` used for goal-violation detection
+  and cached-proposal computation (config key
+  ``optimization.options.generator.class``, AnalyzerConfig.java:241).
+- ``RackAwareGoalRackIdMapper`` (goals/rackaware/, AnalyzerConfig.java:249)
+  — transforms broker rack ids before rack-aware goals group by them
+  (e.g. collapse availability-zone suffixes). The NoOp default is
+  identity.
+
+Both resolve through ``abstract_config.resolve_class`` (the
+getConfiguredInstance analogue); the excluded-topics regex
+``topics.excluded.from.partition.movement`` is applied by the default
+generator exactly like the reference's
+``KafkaCruiseControlUtils.excludedTopics``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, Protocol, Sequence
+
+from ..config.cruise_control_config import CruiseControlConfig
+from .constraint import OptimizationOptions
+
+
+class RackAwareGoalRackIdMapper(Protocol):
+    def apply(self, rack_id: str) -> str: ...
+
+
+class NoOpRackAwareGoalRackIdMapper:
+    """Identity (NoOpRackAwareGoalRackIdMapper.java)."""
+
+    def apply(self, rack_id: str) -> str:
+        return rack_id
+
+
+def rack_id_mapper_from_config(config: CruiseControlConfig,
+                               ) -> RackAwareGoalRackIdMapper:
+    spec = config.get("rack.aware.goal.rack.id.mapper.class")
+    if not spec:
+        return NoOpRackAwareGoalRackIdMapper()
+    from ..config.abstract_config import resolve_class
+    cls = resolve_class(spec) if isinstance(spec, str) else spec
+    return cls()
+
+
+def compile_excluded_topics_pattern(config: CruiseControlConfig):
+    """Compiled ``topics.excluded.from.partition.movement`` regex or None.
+    Compiling at construction makes a malformed pattern fail FAST (at app
+    startup) instead of inside every detection cycle."""
+    pattern = config.get("topics.excluded.from.partition.movement") or ""
+    if not pattern:
+        return None
+    try:
+        return re.compile(pattern)
+    except re.error as e:
+        from ..config.configdef import ConfigException
+        raise ConfigException(
+            f"invalid topics.excluded.from.partition.movement regex "
+            f"{pattern!r}: {e}") from None
+
+
+def excluded_topics_from_config(config: CruiseControlConfig,
+                                topic_names: Iterable[str],
+                                ) -> tuple[str, ...]:
+    """Topics matching ``topics.excluded.from.partition.movement``
+    (KafkaCruiseControlUtils.excludedTopics semantics: a full-match
+    regex)."""
+    rx = compile_excluded_topics_pattern(config)
+    if rx is None:
+        return ()
+    return tuple(t for t in topic_names if rx.fullmatch(t))
+
+
+class OptimizationOptionsGenerator(Protocol):
+    def for_goal_violation_detection(
+            self, topic_names: Sequence[str],
+            excluded_topics: Sequence[str],
+            excluded_brokers_for_leadership: Sequence[int],
+            excluded_brokers_for_replica_move: Sequence[int],
+    ) -> OptimizationOptions: ...
+
+    def for_cached_proposal_calculation(
+            self, topic_names: Sequence[str],
+            excluded_topics: Sequence[str],
+    ) -> OptimizationOptions: ...
+
+
+class DefaultOptimizationOptionsGenerator:
+    """DefaultOptimizationOptionsGenerator.java: detection excludes the
+    recently-demoted/removed brokers it is handed; the cached-proposal
+    path excludes only topics. Both merge the config regex."""
+
+    def __init__(self, config: CruiseControlConfig):
+        self._config = config
+        self._pattern = compile_excluded_topics_pattern(config)
+
+    def _merged_topics(self, topic_names: Sequence[str],
+                       excluded_topics: Sequence[str]) -> tuple[str, ...]:
+        merged = set(excluded_topics)
+        if self._pattern is not None:
+            merged.update(t for t in topic_names
+                          if self._pattern.fullmatch(t))
+        return tuple(sorted(merged))
+
+    def for_goal_violation_detection(
+            self, topic_names: Sequence[str],
+            excluded_topics: Sequence[str],
+            excluded_brokers_for_leadership: Sequence[int],
+            excluded_brokers_for_replica_move: Sequence[int],
+    ) -> OptimizationOptions:
+        return OptimizationOptions(
+            excluded_topics=self._merged_topics(topic_names, excluded_topics),
+            excluded_brokers_for_leadership=tuple(
+                excluded_brokers_for_leadership),
+            excluded_brokers_for_replica_move=tuple(
+                excluded_brokers_for_replica_move),
+            is_triggered_by_goal_violation=True)
+
+    def for_cached_proposal_calculation(
+            self, topic_names: Sequence[str],
+            excluded_topics: Sequence[str],
+    ) -> OptimizationOptions:
+        return OptimizationOptions(
+            excluded_topics=self._merged_topics(topic_names,
+                                                excluded_topics))
+
+
+def options_generator_from_config(config: CruiseControlConfig,
+                                  ) -> OptimizationOptionsGenerator:
+    spec = config.get("optimization.options.generator.class")
+    if not spec:
+        return DefaultOptimizationOptionsGenerator(config)
+    from ..config.abstract_config import resolve_class
+    cls = resolve_class(spec) if isinstance(spec, str) else spec
+    return cls(config)

@@ -258,6 +258,16 @@ def _definition() -> ConfigDef:
     d.define("intra.broker.goals", T.LIST,
              ["IntraBrokerDiskCapacityGoal", "IntraBrokerDiskUsageDistributionGoal"],
              None, I.LOW, "Goal chain for rebalance_disk/remove_disks.")
+    d.define("optimization.options.generator.class", T.CLASS, None, None,
+             I.LOW,
+             "Pluggable OptimizationOptions generation for goal-violation "
+             "detection and cached-proposal computation "
+             "(DefaultOptimizationOptionsGenerator.java).")
+    d.define("rack.aware.goal.rack.id.mapper.class", T.CLASS, None, None,
+             I.LOW,
+             "Transforms broker rack ids before rack-aware goals group by "
+             "them, e.g. collapsing AZ suffixes (goals/rackaware/"
+             "RackAwareGoalRackIdMapper.java).")
     d.define("topics.excluded.from.partition.movement", T.STRING, "", None,
              I.MEDIUM, "Regex of topics never moved.")
     d.define("topic.replica.count.balance.min.gap", T.INT, 2,
@@ -273,8 +283,6 @@ def _definition() -> ConfigDef:
     d.define("allow.capacity.estimation.on.proposal.precompute", T.BOOLEAN,
              True, None, I.LOW,
              "Precompute passes may estimate missing capacities.")
-    d.define("optimization.options.generator.class", T.CLASS, None, None,
-             I.LOW, "OptimizationOptions generator plugin.")
     d.define("broker.set.resolver.class", T.CLASS, None, None, I.LOW,
              "BrokerSet membership resolver plugin.")
     d.define("broker.set.assignment.policy.class", T.CLASS, None, None, I.LOW,

@@ -34,6 +34,13 @@ class GoalViolationDetector:
         self._report = report
         self._goals = goals_by_priority(
             config, config.get_list("anomaly.detection.goals"))
+        from ..analyzer.plugins import options_generator_from_config
+        self._options_generator = options_generator_from_config(config)
+        # The facade shares its recently-removed/demoted broker sets so
+        # detection excludes them like the reference's detector does
+        # (GoalViolationDetector.java optimizationOptions call).
+        self.excluded_brokers_for_leadership: set[int] = set()
+        self.excluded_brokers_for_replica_move: set[int] = set()
         self._last_checked_generation = -1
         self._balancedness_score = 100.0
         self._last_result: OptimizerResult | None = None
@@ -65,7 +72,12 @@ class GoalViolationDetector:
             return None
         self._last_checked_generation = gen
 
-        _final, result = self._optimizer.optimizations(state, meta, self._goals)
+        options = self._options_generator.for_goal_violation_detection(
+            meta.topic_names, (),
+            sorted(self.excluded_brokers_for_leadership),
+            sorted(self.excluded_brokers_for_replica_move))
+        _final, result = self._optimizer.optimizations(state, meta,
+                                                       self._goals, options)
         self._last_result = result
         # Fixable = violated before and satisfiable by the solver; unfixable =
         # still violated after optimization (GoalViolationDetector fixability

@@ -114,15 +114,21 @@ class CruiseControl:
         self._anomaly_detector = AnomalyDetectorManager(
             config, self._notifier, facade=self)
         self.maintenance_reader = self._configured_maintenance_reader(config)
+        # Executor.java demotion/removal history consumed by the
+        # exclude_recently_* request parameters and the ADMIN drop_* params;
+        # initialized BEFORE detector wiring, which shares the live sets.
+        self.recently_removed_brokers: set[int] = set()
+        self.recently_demoted_brokers: set[int] = set()
+        from .analyzer.plugins import (
+            compile_excluded_topics_pattern, options_generator_from_config,
+        )
+        self._options_generator = options_generator_from_config(config)
+        self._excluded_topics_rx = compile_excluded_topics_pattern(config)
         self._wire_detectors()
 
         self._proposal_cache: tuple[int, float, OptimizerResult] | None = None
         self._proposal_lock = threading.Lock()
         self._started = False
-        # Executor.java demotion/removal history consumed by the
-        # exclude_recently_* request parameters and the ADMIN drop_* params.
-        self.recently_removed_brokers: set[int] = set()
-        self.recently_demoted_brokers: set[int] = set()
         from .detector.provisioner import BasicProvisioner
         self.provisioner = BasicProvisioner()
 
@@ -153,6 +159,12 @@ class CruiseControl:
         mgr = self._anomaly_detector
         self.goal_violation_detector = GoalViolationDetector(
             cfg, self._load_monitor, self._optimizer, report)
+        # Detection excludes the same recently-removed/demoted brokers the
+        # user-facing operations do (shared live sets, not copies).
+        self.goal_violation_detector.excluded_brokers_for_replica_move = \
+            self.recently_removed_brokers
+        self.goal_violation_detector.excluded_brokers_for_leadership = \
+            self.recently_demoted_brokers
         mgr.add_detector(self.goal_violation_detector, interval)
         mgr.add_detector(BrokerFailureDetector(
             self._admin, report,
@@ -291,6 +303,21 @@ class CruiseControl:
             concurrency_overrides=concurrency or None)
         return True
 
+    def _with_config_excluded_topics(self, meta,
+                                     options: OptimizationOptions,
+                                     ) -> OptimizationOptions:
+        """Merge ``topics.excluded.from.partition.movement`` matches into
+        the options of EVERY operation that may move partitions — the
+        config contract ('never moved') must hold on the execution paths,
+        not just the dryrun/detection previews."""
+        if self._excluded_topics_rx is None:
+            return options
+        import dataclasses as _dc
+        merged = set(options.excluded_topics)
+        merged.update(t for t in meta.topic_names
+                      if self._excluded_topics_rx.fullmatch(t))
+        return _dc.replace(options, excluded_topics=tuple(sorted(merged)))
+
     # -- operations (the runnables) ----------------------------------------
     def proposals(self, goals: Sequence[str] | None = None,
                   ignore_proposal_cache: bool = False,
@@ -308,8 +335,10 @@ class CruiseControl:
                         "proposals", dryrun=True, optimizer_result=cached[2],
                         proposals=cached[2].proposals, reason="cached")
         state, meta = self._model()
+        options = self._options_generator.for_cached_proposal_calculation(
+            meta.topic_names, ())
         _final, result = self._optimizer.optimizations(
-            state, meta, self._goal_chain(goals))
+            state, meta, self._goal_chain(goals), options)
         if goals is None:
             with self._proposal_lock:
                 self._proposal_cache = (gen, time.time(), result)
@@ -338,6 +367,7 @@ class CruiseControl:
             excluded_brokers_for_replica_move=no_replicas,
             requested_destination_broker_ids=tuple(destination_broker_ids),
             is_triggered_by_goal_violation=not is_triggered_by_user_request)
+        options = self._with_config_excluded_topics(meta, options)
         _final, result = self._optimizer.optimizations(
             state, meta, self._goal_chain(goals), options)
         executed = self._maybe_execute(result, dryrun, "rebalance", reason, uuid)
@@ -352,8 +382,10 @@ class CruiseControl:
         onto them (ResourceDistributionGoal.rebalanceByMovingLoadIn:444)."""
         state, meta = self._model()
         state = self._mark_brokers(state, meta, broker_ids, BrokerState.NEW)
+        options = self._with_config_excluded_topics(meta,
+                                                    OptimizationOptions())
         _final, result = self._optimizer.optimizations(
-            state, meta, self._goal_chain(goals))
+            state, meta, self._goal_chain(goals), options)
         executed = self._maybe_execute(result, dryrun, "add_broker", reason, uuid)
         return OperationResult("add_broker", dryrun, result, result.proposals,
                                executed, reason)
@@ -366,9 +398,10 @@ class CruiseControl:
         becomes self-healing-eligible and must be relocated."""
         state, meta = self._model()
         state = self._mark_brokers(state, meta, broker_ids, BrokerState.DEAD)
-        options = OptimizationOptions(
-            excluded_brokers_for_replica_move=tuple(broker_ids),
-            excluded_brokers_for_leadership=tuple(broker_ids))
+        options = self._with_config_excluded_topics(
+            meta, OptimizationOptions(
+                excluded_brokers_for_replica_move=tuple(broker_ids),
+                excluded_brokers_for_leadership=tuple(broker_ids)))
         _final, result = self._optimizer.optimizations(
             state, meta, self._goal_chain(goals), options)
         executed = self._maybe_execute(result, dryrun, "remove_broker", reason, uuid)
@@ -402,7 +435,8 @@ class CruiseControl:
         """FixOfflineReplicasRunnable — the model already marks replicas on
         dead brokers offline; the goal chain must relocate them."""
         state, meta = self._model()
-        options = OptimizationOptions(only_move_immigrant_replicas=False)
+        options = self._with_config_excluded_topics(
+            meta, OptimizationOptions(only_move_immigrant_replicas=False))
         _final, result = self._optimizer.optimizations(
             state, meta, self._goal_chain(goals), options)
         executed = self._maybe_execute(result, dryrun, "fix_offline_replicas",

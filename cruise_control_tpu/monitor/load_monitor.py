@@ -94,6 +94,8 @@ class LoadMonitor:
         self._metadata = metadata
         self._capacity = capacity_resolver or StaticCapacityResolver({})
         self._broker_racks = dict(broker_racks or {})
+        from ..analyzer.plugins import rack_id_mapper_from_config
+        self._rack_mapper = rack_id_mapper_from_config(config)
         self._cpu = cpu_estimator or CpuEstimator()
         self._partition_bucket = partition_bucket
 
@@ -271,8 +273,11 @@ class LoadMonitor:
 
         all_brokers = sorted({b for st in partitions.values() for b in st.replicas}
                              | alive)
+        # Rack ids pass through the configured mapper before rack-aware
+        # goals group by them (AbstractRackAwareGoal.java:51).
         brokers = [BrokerSpec(
-            bid, rack=self._broker_racks.get(bid, str(bid)),
+            bid, rack=self._rack_mapper.apply(
+                self._broker_racks.get(bid, str(bid))),
             capacity=self._capacity.capacity_for(bid),
             state=(BrokerState.ALIVE if bid in alive else BrokerState.DEAD))
             for bid in all_brokers]

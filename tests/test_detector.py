@@ -399,3 +399,49 @@ def test_topic_reader_feeds_detector_and_drops_corrupt_plans():
     # Same plan re-submitted within the idempotence window: dropped.
     transport.records = [serialize_plan(plan, time_ms=1)]
     assert detector.run_once() == []
+
+
+def test_options_generator_merges_excluded_topics_regex():
+    """topics.excluded.from.partition.movement must flow into the options
+    the generator produces (KafkaCruiseControlUtils.excludedTopics)."""
+    from cruise_control_tpu.analyzer.plugins import (
+        DefaultOptimizationOptionsGenerator, options_generator_from_config,
+    )
+
+    cfg = CruiseControlConfig(
+        {"topics.excluded.from.partition.movement": "__.*"})
+    gen = options_generator_from_config(cfg)
+    assert isinstance(gen, DefaultOptimizationOptionsGenerator)
+    topics = ["__consumer_offsets", "orders", "__CruiseControlMetrics"]
+    opts = gen.for_goal_violation_detection(topics, ("orders",), [1], [2])
+    assert set(opts.excluded_topics) == {"__consumer_offsets", "orders",
+                                         "__CruiseControlMetrics"}
+    assert opts.excluded_brokers_for_leadership == (1,)
+    assert opts.excluded_brokers_for_replica_move == (2,)
+    assert opts.is_triggered_by_goal_violation
+    cached = gen.for_cached_proposal_calculation(topics, ())
+    assert set(cached.excluded_topics) == {"__consumer_offsets",
+                                           "__CruiseControlMetrics"}
+    assert cached.excluded_brokers_for_replica_move == ()
+
+
+class _CollapseAzMapper:
+    """rack id 'rack1-az2' -> 'rack1' (the canonical mapper use case)."""
+
+    def apply(self, rack_id: str) -> str:
+        return rack_id.split("-")[0]
+
+
+def test_rack_id_mapper_is_config_swappable():
+    from cruise_control_tpu.analyzer.plugins import (
+        NoOpRackAwareGoalRackIdMapper, rack_id_mapper_from_config,
+    )
+
+    noop = rack_id_mapper_from_config(CruiseControlConfig())
+    assert isinstance(noop, NoOpRackAwareGoalRackIdMapper)
+    assert noop.apply("rack1-az2") == "rack1-az2"
+    cfg = CruiseControlConfig({
+        "rack.aware.goal.rack.id.mapper.class":
+            f"{_CollapseAzMapper.__module__}.{_CollapseAzMapper.__qualname__}"})
+    mapper = rack_id_mapper_from_config(cfg)
+    assert mapper.apply("rack1-az2") == "rack1"

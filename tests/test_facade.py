@@ -169,3 +169,27 @@ def test_self_healing_broker_failure_end_to_end():
     cc.executor.await_completion()
     for st in backend.describe_partitions().values():
         assert 3 not in st.replicas
+
+
+def test_config_excluded_topics_regex_holds_on_rebalance_path():
+    """topics.excluded.from.partition.movement must bind the EXECUTING
+    operations, not just dryrun previews: no proposal may touch a matching
+    topic (KafkaCruiseControlUtils.excludedTopics contract)."""
+    cc, backend = _cruise_control(
+        _partitions(), extra_cfg={
+            "topics.excluded.from.partition.movement": "t0"})
+    res = cc.rebalance(dryrun=True)
+    assert res.proposals, "t1 still needs rebalancing"
+    assert not any(p.topic == "t0" for p in res.proposals), \
+        [p.topic for p in res.proposals]
+    # the cached-proposal path (PROPOSALS endpoint) honors it too
+    res2 = cc.proposals()
+    assert not any(p.topic == "t0" for p in res2.proposals)
+
+
+def test_invalid_excluded_topics_regex_fails_fast():
+    from cruise_control_tpu.config.configdef import ConfigException
+
+    with pytest.raises(ConfigException, match="regex"):
+        _cruise_control(_partitions(), extra_cfg={
+            "topics.excluded.from.partition.movement": "[__"})
