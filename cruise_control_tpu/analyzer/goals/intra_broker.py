@@ -35,9 +35,10 @@ class IntraBrokerDiskCapacityGoal:
                                        balance_band=None)
 
     def optimize(self, state: ClusterTensors, disks: DiskTensors,
-                 max_rounds: int = 64) -> DiskTensors:
+                 max_rounds: int = 64, movable=None) -> DiskTensors:
         return balance_intra_broker(state, disks, self.capacity_threshold,
-                                    balance_band=None, max_rounds=max_rounds)
+                                    balance_band=None, max_rounds=max_rounds,
+                                    movable=movable)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,7 +60,7 @@ class IntraBrokerDiskUsageDistributionGoal:
                                        balance_band=self._band())
 
     def optimize(self, state: ClusterTensors, disks: DiskTensors,
-                 max_rounds: int = 64) -> DiskTensors:
+                 max_rounds: int = 64, movable=None) -> DiskTensors:
         return balance_intra_broker(state, disks, self.capacity_threshold,
                                     balance_band=self._band(),
-                                    max_rounds=max_rounds)
+                                    max_rounds=max_rounds, movable=movable)

@@ -64,16 +64,6 @@ def compile_excluded_topics_pattern(config: CruiseControlConfig):
             f"{pattern!r}: {e}") from None
 
 
-def excluded_topics_from_config(config: CruiseControlConfig,
-                                topic_names: Iterable[str],
-                                ) -> tuple[str, ...]:
-    """Topics matching ``topics.excluded.from.partition.movement``
-    (KafkaCruiseControlUtils.excludedTopics semantics: a full-match
-    regex)."""
-    rx = compile_excluded_topics_pattern(config)
-    if rx is None:
-        return ()
-    return tuple(t for t in topic_names if rx.fullmatch(t))
 
 
 class OptimizationOptionsGenerator(Protocol):
@@ -99,13 +89,20 @@ class DefaultOptimizationOptionsGenerator:
         self._config = config
         self._pattern = compile_excluded_topics_pattern(config)
 
-    def _merged_topics(self, topic_names: Sequence[str],
-                       excluded_topics: Sequence[str]) -> tuple[str, ...]:
+    def merged_excluded_topics(self, topic_names: Sequence[str],
+                               excluded_topics: Sequence[str] = (),
+                               ) -> tuple[str, ...]:
+        """Explicit exclusions merged with the config regex matches — the
+        ONE implementation of the never-move-these-topics rule, shared by
+        detection, proposals, and every executing operation (a second copy
+        would let the dryrun and execution paths diverge)."""
         merged = set(excluded_topics)
         if self._pattern is not None:
             merged.update(t for t in topic_names
                           if self._pattern.fullmatch(t))
         return tuple(sorted(merged))
+
+    _merged_topics = merged_excluded_topics  # internal alias
 
     def for_goal_violation_detection(
             self, topic_names: Sequence[str],

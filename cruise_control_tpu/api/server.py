@@ -365,11 +365,13 @@ class CruiseControlApi:
                 leadership_cluster=conc.get("concurrent_leader_movements"))
         dropped_removed = p.get("drop_recently_removed_brokers", ())
         if dropped_removed:
-            cc.recently_removed_brokers -= set(dropped_removed)
+            with cc.excluded_sets_lock:
+                cc.recently_removed_brokers -= set(dropped_removed)
             changed["droppedRecentlyRemoved"] = sorted(dropped_removed)
         dropped_demoted = p.get("drop_recently_demoted_brokers", ())
         if dropped_demoted:
-            cc.recently_demoted_brokers -= set(dropped_demoted)
+            with cc.excluded_sets_lock:
+                cc.recently_demoted_brokers -= set(dropped_demoted)
             changed["droppedRecentlyDemoted"] = sorted(dropped_demoted)
         return responses.envelope(changed or {"message": "no admin action given"})
 

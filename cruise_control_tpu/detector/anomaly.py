@@ -103,9 +103,23 @@ class GoalViolations(Anomaly):
     def fix(self, facade: Any) -> bool:
         if not self.fixable_goals:
             return False
-        facade.rebalance(goals=None, dryrun=False,
-                         is_triggered_by_user_request=False,
-                         reason=f"self-healing goal violation {self.fixable_goals}")
+        # The self-healing plan must honor the same exclusions detection
+        # classified fixability under (self.healing.exclude.recently.*
+        # configs) — otherwise a 'fixable' verdict computed with broker 7
+        # excluded could be fixed by moving replicas back onto broker 7.
+        cfg = getattr(facade, "config", None)
+        if not hasattr(cfg, "get_boolean"):  # test doubles without config
+            cfg = None
+        facade.rebalance(
+            goals=None, dryrun=False,
+            exclude_recently_demoted_brokers=cfg.get_boolean(
+                "self.healing.exclude.recently.demoted.brokers")
+            if cfg else True,
+            exclude_recently_removed_brokers=cfg.get_boolean(
+                "self.healing.exclude.recently.removed.brokers")
+            if cfg else True,
+            is_triggered_by_user_request=False,
+            reason=f"self-healing goal violation {self.fixable_goals}")
         return True
 
 

@@ -36,11 +36,15 @@ class GoalViolationDetector:
             config, config.get_list("anomaly.detection.goals"))
         from ..analyzer.plugins import options_generator_from_config
         self._options_generator = options_generator_from_config(config)
-        # The facade shares its recently-removed/demoted broker sets so
-        # detection excludes them like the reference's detector does
-        # (GoalViolationDetector.java optimizationOptions call).
-        self.excluded_brokers_for_leadership: set[int] = set()
-        self.excluded_brokers_for_replica_move: set[int] = set()
+        # The facade wires a snapshot supplier over its recently-removed/
+        # demoted broker sets so detection excludes them like the
+        # reference's detector does (GoalViolationDetector.java
+        # optimizationOptions call). A SUPPLIER, not the live sets: the
+        # detection thread iterating a set an API thread is mutating
+        # in-place would raise mid-cycle; the facade copies under its own
+        # lock.
+        self.excluded_brokers_supplier: Callable[
+            [], tuple[tuple[int, ...], tuple[int, ...]]] = lambda: ((), ())
         self._last_checked_generation = -1
         self._balancedness_score = 100.0
         self._last_result: OptimizerResult | None = None
@@ -72,10 +76,10 @@ class GoalViolationDetector:
             return None
         self._last_checked_generation = gen
 
+        no_leadership, no_replicas = self.excluded_brokers_supplier()
         options = self._options_generator.for_goal_violation_detection(
-            meta.topic_names, (),
-            sorted(self.excluded_brokers_for_leadership),
-            sorted(self.excluded_brokers_for_replica_move))
+            meta.topic_names, (), sorted(no_leadership),
+            sorted(no_replicas))
         _final, result = self._optimizer.optimizations(state, meta,
                                                        self._goals, options)
         self._last_result = result

@@ -70,6 +70,10 @@ class AnomalyDetectorManager:
 
     def report(self, anomaly: Anomaly) -> None:
         """Producer side (what detectors call). Thread-safe."""
+        # Per-type anomaly rate (AnomalyDetectorManager.java:190 sensors).
+        from ..utils.sensors import SENSORS
+        SENSORS.count("anomaly_detector_anomalies", labels={
+            "type": anomaly.anomaly_type.name})
         rec = AnomalyRecord(anomaly)
         with self._cv:
             self._records[anomaly.anomaly_id] = rec

@@ -119,11 +119,11 @@ class CruiseControl:
         # initialized BEFORE detector wiring, which shares the live sets.
         self.recently_removed_brokers: set[int] = set()
         self.recently_demoted_brokers: set[int] = set()
-        from .analyzer.plugins import (
-            compile_excluded_topics_pattern, options_generator_from_config,
-        )
+        # Guards ALL reads/writes of the two sets above (API threads mutate
+        # them; the detection thread snapshots them).
+        self.excluded_sets_lock = threading.Lock()
+        from .analyzer.plugins import options_generator_from_config
         self._options_generator = options_generator_from_config(config)
-        self._excluded_topics_rx = compile_excluded_topics_pattern(config)
         self._wire_detectors()
 
         self._proposal_cache: tuple[int, float, OptimizerResult] | None = None
@@ -159,12 +159,18 @@ class CruiseControl:
         mgr = self._anomaly_detector
         self.goal_violation_detector = GoalViolationDetector(
             cfg, self._load_monitor, self._optimizer, report)
+
         # Detection excludes the same recently-removed/demoted brokers the
-        # user-facing operations do (shared live sets, not copies).
-        self.goal_violation_detector.excluded_brokers_for_replica_move = \
-            self.recently_removed_brokers
-        self.goal_violation_detector.excluded_brokers_for_leadership = \
-            self.recently_demoted_brokers
+        # user-facing operations do — snapshotted under the facade's lock
+        # so the detection thread never iterates a set an API thread is
+        # mutating.
+        def _excluded_snapshot():
+            with self.excluded_sets_lock:
+                return (tuple(self.recently_demoted_brokers),
+                        tuple(self.recently_removed_brokers))
+
+        self.goal_violation_detector.excluded_brokers_supplier = \
+            _excluded_snapshot
         mgr.add_detector(self.goal_violation_detector, interval)
         mgr.add_detector(BrokerFailureDetector(
             self._admin, report,
@@ -309,14 +315,34 @@ class CruiseControl:
         """Merge ``topics.excluded.from.partition.movement`` matches into
         the options of EVERY operation that may move partitions — the
         config contract ('never moved') must hold on the execution paths,
-        not just the dryrun/detection previews."""
-        if self._excluded_topics_rx is None:
+        not just the dryrun/detection previews. Delegates to the options
+        generator so there is exactly one merge implementation."""
+        merge = getattr(self._options_generator, "merged_excluded_topics",
+                        None)
+        if merge is None:  # custom generator without the helper
+            return options
+        merged = merge(meta.topic_names, options.excluded_topics)
+        if merged == options.excluded_topics:
             return options
         import dataclasses as _dc
-        merged = set(options.excluded_topics)
-        merged.update(t for t in meta.topic_names
-                      if self._excluded_topics_rx.fullmatch(t))
-        return _dc.replace(options, excluded_topics=tuple(sorted(merged)))
+        return _dc.replace(options, excluded_topics=merged)
+
+    def _movable_partition_mask(self, state, meta):
+        """[P] bool (True = movable) from the merged excluded topics, or
+        None when nothing is excluded — the intra-broker disk kernels'
+        view of the same never-move contract."""
+        merge = getattr(self._options_generator, "merged_excluded_topics",
+                        None)
+        excluded = set(merge(meta.topic_names)) if merge else set()
+        if not excluded:
+            return None
+        import jax.numpy as jnp
+        bad_ids = [i for i, t in enumerate(meta.topic_names) if t in excluded]
+        mask = np.ones(state.num_partitions, dtype=bool)
+        topic_arr = np.asarray(state.topic)
+        for tid in bad_ids:
+            mask &= topic_arr != tid
+        return jnp.asarray(mask)
 
     # -- operations (the runnables) ----------------------------------------
     def proposals(self, goals: Sequence[str] | None = None,
@@ -406,7 +432,8 @@ class CruiseControl:
             state, meta, self._goal_chain(goals), options)
         executed = self._maybe_execute(result, dryrun, "remove_broker", reason, uuid)
         if executed:
-            self.recently_removed_brokers |= set(broker_ids)
+            with self.excluded_sets_lock:
+                self.recently_removed_brokers |= set(broker_ids)
         return OperationResult("remove_broker", dryrun, result,
                                result.proposals, executed, reason)
 
@@ -424,7 +451,8 @@ class CruiseControl:
             state, meta, [PreferredLeaderElectionGoal()], options)
         executed = self._maybe_execute(result, dryrun, "demote_broker", reason, uuid)
         if executed:
-            self.recently_demoted_brokers |= set(broker_ids)
+            with self.excluded_sets_lock:
+                self.recently_demoted_brokers |= set(broker_ids)
         return OperationResult("demote_broker", dryrun, result,
                                result.proposals, executed, reason)
 
@@ -565,7 +593,8 @@ class CruiseControl:
             if not dead[i].any():
                 raise ValueError(f"broker {broker}: no remaining alive log dirs")
         marked = dc.replace(disks, disk_alive=jnp.asarray(dead))
-        balanced = IntraBrokerDiskCapacityGoal().optimize(state, marked)
+        balanced = IntraBrokerDiskCapacityGoal().optimize(
+            state, marked, movable=self._movable_partition_mask(state, meta))
         return self._intra_broker_result("remove_disks", state, meta, marked,
                                          balanced, disk_meta, dryrun, reason)
 
@@ -578,7 +607,8 @@ class CruiseControl:
         )
         state, meta = self._model()
         disks, disk_meta = self._disk_model(state, meta)
-        balanced = IntraBrokerDiskUsageDistributionGoal().optimize(state, disks)
+        balanced = IntraBrokerDiskUsageDistributionGoal().optimize(
+            state, disks, movable=self._movable_partition_mask(state, meta))
         return self._intra_broker_result("rebalance_disk", state, meta, disks,
                                          balanced, disk_meta, dryrun, reason)
 

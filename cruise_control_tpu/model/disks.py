@@ -92,18 +92,27 @@ def intra_broker_violations(state: ClusterTensors, disks: DiskTensors,
 def balance_intra_broker(state: ClusterTensors, disks: DiskTensors,
                          capacity_threshold: float = 0.8,
                          balance_band: tuple[float, float] | None = None,
-                         max_rounds: int = 64) -> DiskTensors:
+                         max_rounds: int = 64,
+                         movable: "jax.Array | None" = None) -> DiskTensors:
     """One fused `lax.while_loop`: per round, EVERY broker moves the
     heaviest replica off its most-violating disk onto its least-utilized
-    alive disk (if that improves the violation), until fixed-point."""
+    alive disk (if that improves the violation), until fixed-point.
+
+    ``movable`` ([P] bool, optional) pins partitions whose replicas must
+    never move (topics.excluded.from.partition.movement): their load still
+    counts toward disk utilization, they are just never candidates."""
     b, d = state.num_brokers, disks.max_disks
     p_count, s = state.assignment.shape
     rep_load = replica_load(state)[:, :, Resource.DISK]            # [P, S]
     exists = replica_exists(state)
+    if movable is not None:
+        exists_candidates = exists & movable[:, None]
+    else:
+        exists_candidates = exists
     # Flatten replicas for per-(broker,disk) argmax selection: for each
     # (broker, disk) find its heaviest replica each round via segment_max.
     flat_broker = jnp.where(exists, state.assignment, b).reshape(-1)
-    flat_load = jnp.where(exists, rep_load, -1.0).reshape(-1)
+    flat_load = jnp.where(exists_candidates, rep_load, -1.0).reshape(-1)
 
     def round_fn(carry):
         assign, _moved = carry

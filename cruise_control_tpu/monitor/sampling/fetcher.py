@@ -90,6 +90,9 @@ class MetricFetcherManager:
         except Exception:
             LOG.exception("metric sampler failed for interval [%s, %s)",
                           start_ms, end_ms)
+            # sampling-fetch failure rate (LoadMonitorTaskRunner sensors).
+            from ...utils.sensors import SENSORS
+            SENSORS.count("monitor_sampling_fetch_failures")
             return SamplerResult([], [], len(bucket))
 
     def _ingest(self, result: SamplerResult, time_ms: int, store: bool) -> None:

@@ -1,0 +1,31 @@
+"""Validate the bounded-dispatch solver at 1k brokers on the real TPU."""
+import os
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/cc_tpu_jax_cache")
+
+import jax
+
+print("devices:", jax.devices(), flush=True)
+
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, goals_by_priority
+from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+from cruise_control_tpu.model.fixtures import Dist, random_cluster
+
+t0 = time.time()
+state, meta = random_cluster(
+    num_brokers=1000, num_topics=100, num_partitions=100_000, rf=3,
+    num_racks=8, dist=Dist.EXPONENTIAL, seed=42, skew_to_first=2.0,
+    target_utilization=0.55)
+state = jax.device_put(state)
+jax.block_until_ready(state.assignment)
+print(f"build {time.time()-t0:.1f}s", flush=True)
+
+cfg = CruiseControlConfig()
+opt = GoalOptimizer(cfg, mesh="auto")
+for name in ("warm", "steady"):
+    t0 = time.time()
+    _, res = opt.optimizations(state, meta, goals=goals_by_priority(cfg))
+    print(f"{name}: {time.time()-t0:.2f}s proposals={len(res.proposals)} "
+          f"bal={res.balancedness_after:.2f} "
+          f"violated={res.violated_goals_after}", flush=True)
