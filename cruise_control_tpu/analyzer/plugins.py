@@ -21,7 +21,7 @@ generator exactly like the reference's
 from __future__ import annotations
 
 import re
-from typing import Iterable, Protocol, Sequence
+from typing import Protocol, Sequence
 
 from ..config.cruise_control_config import CruiseControlConfig
 from .constraint import OptimizationOptions

@@ -122,8 +122,14 @@ class CruiseControl:
         # Guards ALL reads/writes of the two sets above (API threads mutate
         # them; the detection thread snapshots them).
         self.excluded_sets_lock = threading.Lock()
-        from .analyzer.plugins import options_generator_from_config
+        from .analyzer.plugins import (
+            compile_excluded_topics_pattern, options_generator_from_config,
+        )
         self._options_generator = options_generator_from_config(config)
+        # Fallback for CUSTOM generators that lack merged_excluded_topics:
+        # the config's never-move contract must hold regardless of which
+        # generator is plugged in.
+        self._excluded_topics_rx = compile_excluded_topics_pattern(config)
         self._wire_detectors()
 
         self._proposal_cache: tuple[int, float, OptimizerResult] | None = None
@@ -309,19 +315,32 @@ class CruiseControl:
             concurrency_overrides=concurrency or None)
         return True
 
+    def _config_excluded_topics(self, topic_names,
+                                explicit=()) -> tuple[str, ...]:
+        """Explicit exclusions ∪ config-regex matches. Delegates to the
+        generator's single merge implementation; a custom generator
+        without the helper falls back to the facade's own compiled
+        pattern — the config's never-move contract must hold regardless
+        of which generator is plugged in."""
+        merge = getattr(self._options_generator, "merged_excluded_topics",
+                        None)
+        if merge is not None:
+            return merge(topic_names, explicit)
+        merged = set(explicit)
+        if self._excluded_topics_rx is not None:
+            merged.update(t for t in topic_names
+                          if self._excluded_topics_rx.fullmatch(t))
+        return tuple(sorted(merged))
+
     def _with_config_excluded_topics(self, meta,
                                      options: OptimizationOptions,
                                      ) -> OptimizationOptions:
         """Merge ``topics.excluded.from.partition.movement`` matches into
         the options of EVERY operation that may move partitions — the
         config contract ('never moved') must hold on the execution paths,
-        not just the dryrun/detection previews. Delegates to the options
-        generator so there is exactly one merge implementation."""
-        merge = getattr(self._options_generator, "merged_excluded_topics",
-                        None)
-        if merge is None:  # custom generator without the helper
-            return options
-        merged = merge(meta.topic_names, options.excluded_topics)
+        not just the dryrun/detection previews."""
+        merged = self._config_excluded_topics(meta.topic_names,
+                                              options.excluded_topics)
         if merged == options.excluded_topics:
             return options
         import dataclasses as _dc
@@ -331,17 +350,13 @@ class CruiseControl:
         """[P] bool (True = movable) from the merged excluded topics, or
         None when nothing is excluded — the intra-broker disk kernels'
         view of the same never-move contract."""
-        merge = getattr(self._options_generator, "merged_excluded_topics",
-                        None)
-        excluded = set(merge(meta.topic_names)) if merge else set()
+        excluded = set(self._config_excluded_topics(meta.topic_names))
         if not excluded:
             return None
         import jax.numpy as jnp
-        bad_ids = [i for i, t in enumerate(meta.topic_names) if t in excluded]
-        mask = np.ones(state.num_partitions, dtype=bool)
-        topic_arr = np.asarray(state.topic)
-        for tid in bad_ids:
-            mask &= topic_arr != tid
+        bad_ids = np.asarray(
+            [i for i, t in enumerate(meta.topic_names) if t in excluded])
+        mask = ~np.isin(np.asarray(state.topic), bad_ids)
         return jnp.asarray(mask)
 
     # -- operations (the runnables) ----------------------------------------
@@ -383,10 +398,11 @@ class CruiseControl:
         """RebalanceRunnable.workWithoutClusterModel:115."""
         del ignore_proposal_cache  # explicit model pass below is always fresh
         state, meta = self._model()
-        no_leadership = tuple(self.recently_demoted_brokers) \
-            if exclude_recently_demoted_brokers else ()
-        no_replicas = tuple(self.recently_removed_brokers) \
-            if exclude_recently_removed_brokers else ()
+        with self.excluded_sets_lock:  # snapshot: API threads mutate these
+            no_leadership = tuple(self.recently_demoted_brokers) \
+                if exclude_recently_demoted_brokers else ()
+            no_replicas = tuple(self.recently_removed_brokers) \
+                if exclude_recently_removed_brokers else ()
         options = OptimizationOptions(
             excluded_topics=tuple(excluded_topics),
             excluded_brokers_for_leadership=no_leadership,
@@ -593,8 +609,32 @@ class CruiseControl:
             if not dead[i].any():
                 raise ValueError(f"broker {broker}: no remaining alive log dirs")
         marked = dc.replace(disks, disk_alive=jnp.asarray(dead))
+        movable = self._movable_partition_mask(state, meta)
+        if movable is not None:
+            # A pinned (never-move) replica on a dir being REMOVED is an
+            # unresolvable conflict between the two contracts: draining it
+            # violates the exclusion, leaving it silently loses the
+            # replica when the operator pulls the disk. Refuse loudly.
+            assign = np.asarray(disks.disk_assignment)
+            broker_of = np.asarray(state.assignment)
+            pinned = ~np.asarray(movable)
+            alive_arr = np.asarray(dead)
+            stuck = []
+            for p_idx in np.nonzero(pinned)[0]:
+                for s in range(assign.shape[1]):
+                    b_i, d_i = broker_of[p_idx, s], assign[p_idx, s]
+                    if b_i >= 0 and d_i >= 0 and not alive_arr[b_i, d_i]:
+                        stuck.append(meta.partition_index[p_idx]
+                                     if p_idx < len(meta.partition_index)
+                                     else p_idx)
+            if stuck:
+                raise ValueError(
+                    f"excluded-topic replicas live on the removed log dirs "
+                    f"and may not be moved "
+                    f"(topics.excluded.from.partition.movement): "
+                    f"{stuck[:10]}")
         balanced = IntraBrokerDiskCapacityGoal().optimize(
-            state, marked, movable=self._movable_partition_mask(state, meta))
+            state, marked, movable=movable)
         return self._intra_broker_result("remove_disks", state, meta, marked,
                                          balanced, disk_meta, dryrun, reason)
 

@@ -156,3 +156,25 @@ def test_random_cluster_load_conserved():
                                     goals=goals_by_priority(CFG, CHAIN))
     total_after = np.asarray(broker_load(final)).sum(axis=0)
     np.testing.assert_allclose(total_after, total_before, rtol=1e-4)
+
+
+@pytest.mark.parametrize("dist", [Dist.UNIFORM, Dist.EXPONENTIAL])
+def test_random_bounded_dispatch_equivalence(dist):
+    """The bounded-dispatch production path (the large-cluster watchdog
+    mitigation) walks the identical trajectory to the fused chain on
+    random clusters — exact same proposals and balancedness."""
+    state, meta = _cluster(dist, seed=3)
+    fused = GoalOptimizer(CFG)
+    bounded = GoalOptimizer(CruiseControlConfig({
+        "max.solver.rounds": 200, "failed.brokers.file.path": "",
+        "solver.fused.chain.max.brokers": "4",
+        "solver.dispatch.max.rounds": "5"}))
+    _f, rf_ = fused.optimizations(state, meta,
+                                  goals=goals_by_priority(CFG, CHAIN))
+    _b, rb_ = bounded.optimizations(state, meta,
+                                    goals=goals_by_priority(CFG, CHAIN))
+    assert sorted((p.topic, p.partition, p.new_replicas, p.new_leader)
+                  for p in rb_.proposals) == \
+        sorted((p.topic, p.partition, p.new_replicas, p.new_leader)
+               for p in rf_.proposals)
+    assert rb_.balancedness_after == pytest.approx(rf_.balancedness_after)
