@@ -31,7 +31,8 @@ from .security import (
     SecurityProvider,
 )
 from .user_tasks import (
-    USER_TASK_HEADER, TooManyUserTasksError, UserTaskManager,
+    USER_TASK_HEADER, TaskOwnershipError, TooManyUserTasksError,
+    UserTaskManager,
 )
 
 LOG = logging.getLogger(__name__)
@@ -195,6 +196,8 @@ class CruiseControlApi:
             return e.status, self._error(str(e)), out_headers
         except TooManyUserTasksError as e:
             return 429, self._error(str(e)), out_headers
+        except TaskOwnershipError as e:
+            return 403, self._error(str(e)), out_headers
         except NotEnoughValidWindowsError as e:
             return 503, self._error(f"load model not ready: {e}"), out_headers
         except (KeyError, ValueError) as e:

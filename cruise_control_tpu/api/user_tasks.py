@@ -32,6 +32,12 @@ class TooManyUserTasksError(RuntimeError):
     max.active.user.tasks)."""
 
 
+class TaskOwnershipError(RuntimeError):
+    """Maps to HTTP 403: a User-Task-ID presented by a client other than
+    the one that created the task (UserTaskManager.java session binding —
+    task ids are capability tokens scoped to their creator)."""
+
+
 @dataclass
 class UserTaskInfo:
     task_id: str
@@ -118,7 +124,17 @@ class UserTaskManager:
         with self._lock:
             self._expire_locked()
             if task_id and task_id in self._tasks:
-                return self._tasks[task_id]
+                info = self._tasks[task_id]
+                # Session binding (UserTaskManager.java:222 matches the
+                # task against the requesting session): a client may only
+                # resume ITS OWN task — presenting a guessed/leaked UUID
+                # from a different identity must not expose another
+                # client's operation result.
+                if info.client != client:
+                    raise TaskOwnershipError(
+                        f"user task {task_id} belongs to a different "
+                        f"client")
+                return info
             active = sum(1 for t in self._tasks.values() if not t.future.done())
             if active >= self._max_active:
                 raise TooManyUserTasksError(

@@ -611,28 +611,28 @@ class CruiseControl:
         marked = dc.replace(disks, disk_alive=jnp.asarray(dead))
         movable = self._movable_partition_mask(state, meta)
         if movable is not None:
-            # A pinned (never-move) replica on a dir being REMOVED is an
-            # unresolvable conflict between the two contracts: draining it
-            # violates the exclusion, leaving it silently loses the
-            # replica when the operator pulls the disk. Refuse loudly.
+            # A pinned (never-move) replica on a dir being REMOVED BY THIS
+            # REQUEST is an unresolvable conflict between the two
+            # contracts: draining it violates the exclusion, leaving it
+            # silently loses the replica when the operator pulls the disk.
+            # Refuse loudly. Only alive→dead transitions count — a
+            # long-offline dir elsewhere must not block this operation.
             assign = np.asarray(disks.disk_assignment)
             broker_of = np.asarray(state.assignment)
             pinned = ~np.asarray(movable)
-            alive_arr = np.asarray(dead)
-            stuck = []
-            for p_idx in np.nonzero(pinned)[0]:
-                for s in range(assign.shape[1]):
-                    b_i, d_i = broker_of[p_idx, s], assign[p_idx, s]
-                    if b_i >= 0 and d_i >= 0 and not alive_arr[b_i, d_i]:
-                        stuck.append(meta.partition_index[p_idx]
-                                     if p_idx < len(meta.partition_index)
-                                     else p_idx)
-            if stuck:
+            removed_now = np.asarray(disks.disk_alive) & ~dead
+            valid = (broker_of >= 0) & (assign >= 0)
+            hit = pinned[:, None] & valid & removed_now[
+                np.clip(broker_of, 0, None), np.clip(assign, 0, None)]
+            stuck_rows = np.nonzero(hit.any(axis=1))[0]
+            if stuck_rows.size:
+                names = [meta.partition_index[p] if
+                         p < len(meta.partition_index) else int(p)
+                         for p in stuck_rows[:10]]
                 raise ValueError(
                     f"excluded-topic replicas live on the removed log dirs "
                     f"and may not be moved "
-                    f"(topics.excluded.from.partition.movement): "
-                    f"{stuck[:10]}")
+                    f"(topics.excluded.from.partition.movement): {names}")
         balanced = IntraBrokerDiskCapacityGoal().optimize(
             state, marked, movable=movable)
         return self._intra_broker_result("remove_disks", state, meta, marked,

@@ -551,3 +551,25 @@ def test_jwt_rs256_round_trip():
     with pytest_mod.raises(AuthenticationError, match="signature"):
         provider.authenticate(
             {"Authorization": f"Bearer {head}.{evil}.{sig}"})
+
+
+def test_user_task_id_bound_to_client():
+    """A User-Task-ID is a capability scoped to its creator: another
+    client presenting the id gets 403, not the first client's result
+    (UserTaskManager.java session binding)."""
+    from cruise_control_tpu.api.user_tasks import (
+        TaskOwnershipError, UserTaskManager,
+    )
+
+    mgr = UserTaskManager()
+    info = mgr.get_or_create_task("PROPOSALS", "", lambda: 42,
+                                  client="alice")
+    assert info.future.result(timeout=5) == 42
+    # same client resumes fine
+    again = mgr.get_or_create_task("PROPOSALS", "", lambda: 43,
+                                   task_id=info.task_id, client="alice")
+    assert again.task_id == info.task_id
+    with pytest.raises(TaskOwnershipError):
+        mgr.get_or_create_task("PROPOSALS", "", lambda: 44,
+                               task_id=info.task_id, client="mallory")
+    mgr.shutdown()
