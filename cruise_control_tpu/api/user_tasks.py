@@ -135,11 +135,18 @@ class UserTaskManager:
                         f"user task {task_id} belongs to a different "
                         f"client")
                 return info
+            if task_id:
+                # Unknown/expired id presented: 400, NOT a new task under
+                # the client-chosen id — otherwise another client could
+                # squat an evicted id and 403 the legitimate owner's next
+                # poll (the reference 400s invalid User-Task-IDs too).
+                raise ValueError(
+                    f"unknown or expired {USER_TASK_HEADER} {task_id}")
             active = sum(1 for t in self._tasks.values() if not t.future.done())
             if active >= self._max_active:
                 raise TooManyUserTasksError(
                     f"exceeded max active user tasks ({self._max_active})")
-            tid = task_id or str(uuid_mod.uuid4())
+            tid = str(uuid_mod.uuid4())
             progress = OperationProgress(endpoint)
 
             def tracked():

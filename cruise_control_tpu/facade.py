@@ -597,6 +597,7 @@ class CruiseControl:
         state, meta = self._model()
         disks, disk_meta = self._disk_model(state, meta)
         dead = np.asarray(disks.disk_alive).copy()
+        requested = np.zeros_like(dead)  # dirs named in THIS request
         idx = {bid: i for i, bid in enumerate(meta.broker_ids)}
         for broker, dirs in broker_logdirs.items():
             if broker not in idx:
@@ -605,7 +606,9 @@ class CruiseControl:
             for d in dirs:
                 if d not in disk_meta.dir_names[i]:
                     raise ValueError(f"broker {broker} has no log dir {d!r}")
-                dead[i, disk_meta.dir_names[i].index(d)] = False
+                slot = disk_meta.dir_names[i].index(d)
+                dead[i, slot] = False
+                requested[i, slot] = True
             if not dead[i].any():
                 raise ValueError(f"broker {broker}: no remaining alive log dirs")
         marked = dc.replace(disks, disk_alive=jnp.asarray(dead))
@@ -615,12 +618,14 @@ class CruiseControl:
             # REQUEST is an unresolvable conflict between the two
             # contracts: draining it violates the exclusion, leaving it
             # silently loses the replica when the operator pulls the disk.
-            # Refuse loudly. Only alive→dead transitions count — a
-            # long-offline dir elsewhere must not block this operation.
+            # Refuse loudly. Only dirs NAMED IN THIS REQUEST count — a
+            # long-offline dir elsewhere must not block this operation
+            # (and a named dir that was already offline still counts: the
+            # operator is about to pull that disk).
             assign = np.asarray(disks.disk_assignment)
             broker_of = np.asarray(state.assignment)
             pinned = ~np.asarray(movable)
-            removed_now = np.asarray(disks.disk_alive) & ~dead
+            removed_now = requested
             valid = (broker_of >= 0) & (assign >= 0)
             hit = pinned[:, None] & valid & removed_now[
                 np.clip(broker_of, 0, None), np.clip(assign, 0, None)]

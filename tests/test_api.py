@@ -573,3 +573,18 @@ def test_user_task_id_bound_to_client():
         mgr.get_or_create_task("PROPOSALS", "", lambda: 44,
                                task_id=info.task_id, client="mallory")
     mgr.shutdown()
+
+
+def test_unknown_user_task_id_is_rejected_not_squatted():
+    """An unknown/expired User-Task-ID must 400, never create a task
+    under the client-chosen id (id squatting would 403 the legitimate
+    owner's next poll after cache eviction)."""
+    from cruise_control_tpu.api.user_tasks import UserTaskManager
+
+    mgr = UserTaskManager()
+    with pytest.raises(ValueError, match="unknown or expired"):
+        mgr.get_or_create_task("PROPOSALS", "", lambda: 1,
+                               task_id="11111111-2222-3333-4444-555555555555",
+                               client="mallory")
+    assert mgr.all_tasks() == []
+    mgr.shutdown()
