@@ -55,6 +55,71 @@ def build_demo_cruise_control(cfg: CruiseControlConfig) -> CruiseControl:
     return CruiseControl(cfg, backend, load_monitor=monitor)
 
 
+def _configured_sample_store(cfg: CruiseControlConfig, bootstrap: str):
+    """sample.store.class resolution for live mode: the Kafka store gets
+    the bootstrap servers, the file store its configured path, a custom
+    class a bare constructor. The configured store must actually be built
+    — silently dropping it would cold-start the load model on every
+    restart (no warm-window replay)."""
+    from ..config.abstract_config import resolve_class
+    from ..kafka import KafkaSampleStore
+    from ..monitor.sampling.sample_store import FileSampleStore
+
+    spec = cfg.get("sample.store.class")
+    cls = resolve_class(spec) if isinstance(spec, str) else spec
+    if cls is KafkaSampleStore:
+        return KafkaSampleStore(bootstrap)
+    if cls is FileSampleStore or cls is None:
+        return FileSampleStore(cfg.get("sample.store.path"))
+    return cls()
+
+
+def _configured_capacity_resolver(cfg: CruiseControlConfig):
+    """broker.capacity.config.resolver.class resolution (the
+    getConfiguredInstance path): hardcoding a default here would feed the
+    goals fictitious capacities on heterogeneous clusters."""
+    from ..config.abstract_config import resolve_class
+    from ..monitor.capacity import FileCapacityResolver
+
+    spec = cfg.get("broker.capacity.config.resolver.class")
+    cls = resolve_class(spec) if isinstance(spec, str) else spec
+    if cls is FileCapacityResolver or cls is None:
+        return FileCapacityResolver(cfg.get("capacity.config.file"))
+    return cls()
+
+
+def build_live_cruise_control(cfg: CruiseControlConfig) -> CruiseControl:
+    """Wire the full stack against a LIVE Kafka cluster through the
+    framework's own wire-protocol client (kafka/): admin ops, the
+    __CruiseControlMetrics reporter-topic sampler, the configured sample
+    store and capacity resolver, and broker racks from cluster metadata
+    (refreshed per model build for late-joining brokers)."""
+    from ..kafka import KafkaAdminBackend, KafkaMetricsTransport
+    from ..monitor import LoadMonitor
+    from ..monitor.sampling.sampler import CruiseControlMetricsReporterSampler
+
+    bootstrap = ",".join(cfg.get_list("bootstrap.servers"))
+    admin = KafkaAdminBackend(bootstrap)
+    transport = KafkaMetricsTransport(bootstrap)
+    sampler = CruiseControlMetricsReporterSampler(transport)
+    monitor = LoadMonitor(
+        cfg, admin, samplers=[sampler],
+        sample_store=_configured_sample_store(cfg, bootstrap),
+        capacity_resolver=_configured_capacity_resolver(cfg))
+    return CruiseControl(cfg, admin, load_monitor=monitor)
+
+
+# Demo-mode tunables: a fresh operator should see a working rebalance in
+# seconds, not after the production 5-minute window fills (the reference
+# demo tour has the same cold-start, but it needs a live cluster anyway).
+_DEMO_DEFAULTS = {
+    "metric.sampling.interval.ms": 2_000,
+    "partition.metrics.window.ms": 5_000,
+    "broker.metrics.window.ms": 5_000,
+    "min.valid.partition.ratio": 0.0,
+}
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="cruise-control-tpu")
     parser.add_argument("--properties", help="config properties file")
@@ -68,15 +133,13 @@ def main(argv: list[str] | None = None) -> int:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s "
                         "%(levelname)s %(message)s")
     overrides = load_properties(args.properties) if args.properties else {}
-    cfg = CruiseControlConfig(overrides)
     if overrides.get("bootstrap.servers") and not args.demo:
-        # Honest failure over a silent fake: this build ships the in-memory
-        # backend only (a live-Kafka AdminBackend is a deployment add-on);
-        # pass --demo to run the synthetic cluster with these tunables.
-        parser.error("bootstrap.servers is set but no live-Kafka backend is "
-                     "available in this build; pass --demo to run the "
-                     "synthetic in-memory cluster with this config")
-    cc = build_demo_cruise_control(cfg)
+        # Live mode: the wire-protocol client manages the real cluster.
+        cc = build_live_cruise_control(CruiseControlConfig(overrides))
+    else:
+        demo_cfg = dict(_DEMO_DEFAULTS)
+        demo_cfg.update(overrides)
+        cc = build_demo_cruise_control(CruiseControlConfig(demo_cfg))
     cc.start_up(block_on_load=False)
 
     server, api = make_server(cc, host=args.host, port=args.port)

@@ -89,6 +89,14 @@ class KafkaAdminBackend:
     def alive_brokers(self) -> set[int]:
         return self._client.alive_broker_ids()
 
+    def broker_racks(self) -> dict[int, str]:
+        """broker id -> rack from cluster metadata (brokers without a
+        configured broker.rack are omitted). LoadMonitor refreshes this
+        per model build so late-joining brokers get their racks."""
+        meta = self._client.metadata(topics=[])
+        return {b["node_id"]: b["rack"] for b in meta["brokers"]
+                if b.get("rack")}
+
     # ---- configs (real KIP-339 incremental semantics) --------------------
     def alter_broker_configs(self,
                              configs: Mapping[int, Mapping[str, str]]) -> None:

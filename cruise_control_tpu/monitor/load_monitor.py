@@ -273,6 +273,17 @@ class LoadMonitor:
 
         all_brokers = sorted({b for st in partitions.values() for b in st.replicas}
                              | alive)
+        # Brokers with no known rack refresh from the metadata backend
+        # when it exposes racks (KafkaAdminBackend.broker_racks) — a
+        # transient boot failure or a late-joining broker must not leave
+        # rack-aware goals blind to real topology.
+        if any(bid not in self._broker_racks for bid in all_brokers):
+            racks_fn = getattr(self._metadata, "broker_racks", None)
+            if racks_fn is not None:
+                try:
+                    self._broker_racks.update(racks_fn())
+                except Exception:  # noqa: BLE001 — topology hint only
+                    LOG.warning("broker rack refresh failed", exc_info=True)
         # Rack ids pass through the configured mapper before rack-aware
         # goals group by them (AbstractRackAwareGoal.java:51).
         brokers = [BrokerSpec(

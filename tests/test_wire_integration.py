@@ -550,3 +550,87 @@ def test_codec_fuzz_roundtrips():
             for _ in range(20):
                 v = value_for(codec)
                 assert decode(codec, encode(codec, v)) == v, (api.key, v)
+
+
+def test_full_stack_live_mode_against_embedded_cluster():
+    """The COMPLETE live-mode story over real wire bytes: broker-side
+    reporter agents produce metrics to the embedded cluster's
+    __CruiseControlMetrics topic; the app's live wiring (the same
+    build_live_cruise_control the server boots with) consumes them
+    through the reporter-topic sampler, builds a load model, and serves a
+    dryrun rebalance through the REST dispatch pipeline."""
+    import time
+
+    from cruise_control_tpu.api.app import build_live_cruise_control
+    from cruise_control_tpu.api.server import CruiseControlApi
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.monitor.capacity import StaticCapacityResolver
+    from cruise_control_tpu.common.resources import Resource
+    from cruise_control_tpu.reporter.agent import (
+        BrokerMetricsRegistry, MetricsReporterAgent,
+    )
+
+    cluster = EmbeddedKafkaCluster(
+        num_brokers=3, racks={0: "rA", 1: "rB", 2: "rC"}).start()
+    try:
+        # a skewed workload: broker 0 leads everything
+        cluster.create_topic("events", 6, 2, assignment={
+            i: [0, 1 + i % 2] for i in range(6)})
+        cfg = CruiseControlConfig({
+            "bootstrap.servers": cluster.bootstrap_servers,
+            "partition.metrics.window.ms": 1000,
+            "num.partition.metrics.windows": 2,
+            "min.valid.partition.ratio": 0.0,
+            "max.solver.rounds": 40,
+            "failed.brokers.file.path": ""})
+        cc = build_live_cruise_control(cfg)
+        # deterministic capacities for the test (the default resolver
+        # would read config/capacity.json broker ids)
+        cc._load_monitor._capacity = StaticCapacityResolver(
+            {}, {Resource.CPU: 100.0, Resource.DISK: 1e7,
+                 Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6})
+        # racks come from cluster metadata over the wire (refreshed at
+        # model build through the public accessor)
+        assert cc._admin.broker_racks() == {0: "rA", 1: "rB", 2: "rC"}
+
+        # One reporter agent per broker, producing REAL records to the
+        # metrics topic through the wire transport. Two produce+sample
+        # ROUNDS separated in wall time: the fetcher ingests each
+        # sampling interval into the window of its end timestamp, and the
+        # newest window is the current (incomplete) one — two rounds give
+        # one closed, valid window.
+        from cruise_control_tpu.kafka import KafkaMetricsTransport
+        agents = []
+        for b in range(3):
+            reg = BrokerMetricsRegistry(broker_id=b)
+            reg.set_cpu_util(30.0 + 20 * (b == 0))
+            reg.set_topic_rate("events", 50_000.0 if b == 0 else 5_000.0,
+                               80_000.0 if b == 0 else 8_000.0)
+            for i in range(6):
+                reg.set_partition_size("events", i, 1e6)
+            transport = KafkaMetricsTransport(cluster.bootstrap_servers)
+            agents.append(MetricsReporterAgent(reg, transport,
+                                               interval_s=3600))
+        t0 = int(time.time() * 1000)
+        for a in agents:
+            a.report_once()
+        cc._load_monitor.task_runner.run_sampling_once(end_ms=t0 + 50)
+        time.sleep(0.2)
+        for a in agents:
+            a.report_once()
+        cc._load_monitor.task_runner.run_sampling_once(end_ms=t0 + 1200)
+
+        api = CruiseControlApi(cc)
+        api._async_wait_s = 180
+        status, body, _h = api.handle(
+            "POST", "/kafkacruisecontrol/rebalance", "dryrun=true")
+        assert status == 200, body
+        assert body.get("proposals"), "skewed live cluster must yield moves"
+        # the model-build rack refresh populated real topology
+        assert cc._load_monitor._broker_racks == {0: "rA", 1: "rB", 2: "rC"}
+        api.shutdown()
+        cc.shutdown()
+    finally:
+        cluster.stop()
