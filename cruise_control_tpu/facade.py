@@ -218,8 +218,42 @@ class CruiseControl:
         self._load_monitor.start_up(block_on_load=block_on_load)
         self._anomaly_detector.start_detection()
         self._started = True
+        if getattr(self, "_precompute_thread", None) is None \
+                or not self._precompute_thread.is_alive():
+            self._stop_precompute = threading.Event()
+            self._precompute_thread = threading.Thread(
+                target=self._proposal_precompute_loop, daemon=True,
+                name="proposal-precompute")
+            self._precompute_thread.start()
+
+    def _proposal_precompute_loop(self) -> None:
+        """GoalOptimizer.run (GoalOptimizer.java:152-203): keep the cached
+        proposals fresh in the background so a PROPOSALS/REBALANCE request
+        hits a warm cache. Refresh-ahead: an entry with less than one
+        wake interval of budget left is recomputed NOW, so requests never
+        find the cache expired between wakes. Tolerates a not-ready load
+        model."""
+        interval_s = max(
+            1.0, self._config.get_long("proposal.expiration.ms") / 2000.0)
+        while not self._stop_precompute.wait(interval_s):
+            try:
+                gen = self._load_monitor.model_generation
+                if self._cached_proposals_fresh(gen, margin_s=interval_s):
+                    continue
+                self.proposals(ignore_proposal_cache=True)
+                from .utils.sensors import SENSORS
+                SENSORS.count("analyzer_proposal_precompute_runs")
+            except Exception:  # noqa: BLE001 — model may not be ready yet
+                LOG.debug("proposal precompute skipped", exc_info=True)
 
     def shutdown(self) -> None:
+        if getattr(self, "_stop_precompute", None) is not None:
+            self._stop_precompute.set()
+        thread = getattr(self, "_precompute_thread", None)
+        if thread is not None and thread.is_alive():
+            # Join BEFORE tearing down the monitor/executor: an in-flight
+            # precompute must not race a half-shut-down load monitor.
+            thread.join(timeout=30.0)
         self._anomaly_detector.shutdown()
         self._executor.stop_execution()
         self._load_monitor.shutdown()
@@ -360,21 +394,33 @@ class CruiseControl:
         return jnp.asarray(mask)
 
     # -- operations (the runnables) ----------------------------------------
+    def _cached_proposals_fresh(self, gen: int, margin_s: float = 0.0):
+        """The ONE validCachedProposal predicate
+        (GoalOptimizer.validCachedProposal:232): cache entry if it matches
+        the model generation and has more than ``margin_s`` of its
+        expiration budget left, else None. The precompute loop passes its
+        own interval as margin (refresh-ahead: the cache must never be
+        found expired by a request between two wakes)."""
+        expiration_s = self._config.get_long("proposal.expiration.ms") / 1000.0
+        with self._proposal_lock:
+            cached = self._proposal_cache
+        if cached is not None and cached[0] == gen \
+                and time.time() - cached[1] < expiration_s - margin_s:
+            return cached
+        return None
+
     def proposals(self, goals: Sequence[str] | None = None,
                   ignore_proposal_cache: bool = False,
                   ) -> OperationResult:
         """ProposalsRunnable — cached when the model generation and the
         expiration budget allow (GoalOptimizer.validCachedProposal:232)."""
-        expiration_s = self._config.get_long("proposal.expiration.ms") / 1000.0
         gen = self._load_monitor.model_generation
         if not ignore_proposal_cache and goals is None:
-            with self._proposal_lock:
-                cached = self._proposal_cache
-                if cached is not None and cached[0] == gen \
-                        and time.time() - cached[1] < expiration_s:
-                    return OperationResult(
-                        "proposals", dryrun=True, optimizer_result=cached[2],
-                        proposals=cached[2].proposals, reason="cached")
+            cached = self._cached_proposals_fresh(gen)
+            if cached is not None:
+                return OperationResult(
+                    "proposals", dryrun=True, optimizer_result=cached[2],
+                    proposals=cached[2].proposals, reason="cached")
         state, meta = self._model()
         options = self._options_generator.for_cached_proposal_calculation(
             meta.topic_names, ())

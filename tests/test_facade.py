@@ -193,3 +193,29 @@ def test_invalid_excluded_topics_regex_fails_fast():
     with pytest.raises(ConfigException, match="regex"):
         _cruise_control(_partitions(), extra_cfg={
             "topics.excluded.from.partition.movement": "[__"})
+
+
+def test_background_proposal_precompute_warms_cache():
+    """GoalOptimizer.java:152-203 parity: the precompute loop keeps cached
+    proposals fresh so a PROPOSALS request hits a warm cache without ever
+    computing inline."""
+    import time as _time
+
+    cc, _backend = _cruise_control(
+        _partitions(), extra_cfg={"proposal.expiration.ms": 2000},
+        synchronous_executor=True)
+    cc.start_up(block_on_load=False)
+    try:
+        deadline = _time.time() + 20
+        while _time.time() < deadline:
+            with cc._proposal_lock:
+                if cc._proposal_cache is not None:
+                    break
+            _time.sleep(0.2)
+        with cc._proposal_lock:
+            assert cc._proposal_cache is not None, \
+                "precompute never populated the cache"
+        res = cc.proposals()
+        assert res.reason == "cached"
+    finally:
+        cc.shutdown()
