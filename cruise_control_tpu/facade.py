@@ -134,6 +134,13 @@ class CruiseControl:
 
         self._proposal_cache: tuple[int, float, OptimizerResult] | None = None
         self._proposal_lock = threading.Lock()
+        # Serializes the EXPENSIVE proposal computation (the reference's
+        # in-progress coordination, GoalOptimizer.java:152-203): the
+        # precompute loop and an API request must not run two identical
+        # optimization passes concurrently.
+        self._proposal_compute_lock = threading.Lock()
+        self._stop_precompute: threading.Event | None = None
+        self._precompute_thread: threading.Thread | None = None
         self._started = False
         from .detector.provisioner import BasicProvisioner
         self.provisioner = BasicProvisioner()
@@ -218,7 +225,7 @@ class CruiseControl:
         self._load_monitor.start_up(block_on_load=block_on_load)
         self._anomaly_detector.start_detection()
         self._started = True
-        if getattr(self, "_precompute_thread", None) is None \
+        if self._precompute_thread is None \
                 or not self._precompute_thread.is_alive():
             self._stop_precompute = threading.Event()
             self._precompute_thread = threading.Thread(
@@ -233,27 +240,38 @@ class CruiseControl:
         wake interval of budget left is recomputed NOW, so requests never
         find the cache expired between wakes. Tolerates a not-ready load
         model."""
-        interval_s = max(
-            1.0, self._config.get_long("proposal.expiration.ms") / 2000.0)
-        while not self._stop_precompute.wait(interval_s):
+        expiration_s = self._config.get_long("proposal.expiration.ms") / 1000.0
+        interval_s = max(1.0, expiration_s / 2.0)
+        # Refresh-ahead headroom: 1.5 wake intervals so an entry never
+        # expires between one wake deciding "fresh" and the next wake's
+        # recompute finishing — clamped for pathologically short budgets
+        # (expiration < interval), where some inline computes are what the
+        # operator's config demands.
+        margin_s = min(1.5 * interval_s, 0.75 * expiration_s)
+        stop = self._stop_precompute
+        while not stop.wait(interval_s):
             try:
                 gen = self._load_monitor.model_generation
-                if self._cached_proposals_fresh(gen, margin_s=interval_s):
+                if self._cached_proposals_fresh(gen, margin_s=margin_s):
                     continue
-                self.proposals(ignore_proposal_cache=True)
+                self.proposals(_freshness_margin_s=margin_s)
                 from .utils.sensors import SENSORS
                 SENSORS.count("analyzer_proposal_precompute_runs")
             except Exception:  # noqa: BLE001 — model may not be ready yet
                 LOG.debug("proposal precompute skipped", exc_info=True)
 
     def shutdown(self) -> None:
-        if getattr(self, "_stop_precompute", None) is not None:
+        if self._stop_precompute is not None:
             self._stop_precompute.set()
-        thread = getattr(self, "_precompute_thread", None)
-        if thread is not None and thread.is_alive():
+        if self._precompute_thread is not None \
+                and self._precompute_thread.is_alive():
             # Join BEFORE tearing down the monitor/executor: an in-flight
             # precompute must not race a half-shut-down load monitor.
-            thread.join(timeout=30.0)
+            self._precompute_thread.join(timeout=30.0)
+        # Forget the thread either way — a later start_up() must spawn a
+        # fresh loop even if this join timed out (the old thread exits on
+        # its own already-set stop event).
+        self._precompute_thread = None
         self._anomaly_detector.shutdown()
         self._executor.stop_execution()
         self._load_monitor.shutdown()
@@ -411,24 +429,41 @@ class CruiseControl:
 
     def proposals(self, goals: Sequence[str] | None = None,
                   ignore_proposal_cache: bool = False,
-                  ) -> OperationResult:
+                  _freshness_margin_s: float = 0.0) -> OperationResult:
         """ProposalsRunnable — cached when the model generation and the
-        expiration budget allow (GoalOptimizer.validCachedProposal:232)."""
+        expiration budget allow (GoalOptimizer.validCachedProposal:232).
+        The expensive computation is serialized: a loser of the compute
+        lock re-checks the cache so two callers never run the identical
+        optimization concurrently (``_freshness_margin_s`` is the
+        precompute loop's refresh-ahead knob)."""
         gen = self._load_monitor.model_generation
-        if not ignore_proposal_cache and goals is None:
-            cached = self._cached_proposals_fresh(gen)
-            if cached is not None:
-                return OperationResult(
-                    "proposals", dryrun=True, optimizer_result=cached[2],
-                    proposals=cached[2].proposals, reason="cached")
-        state, meta = self._model()
-        options = self._options_generator.for_cached_proposal_calculation(
-            meta.topic_names, ())
-        _final, result = self._optimizer.optimizations(
-            state, meta, self._goal_chain(goals), options)
-        if goals is None:
-            with self._proposal_lock:
-                self._proposal_cache = (gen, time.time(), result)
+        use_cache = goals is None and not ignore_proposal_cache
+
+        def cached_result():
+            cached = self._cached_proposals_fresh(gen, _freshness_margin_s)
+            if cached is None:
+                return None
+            return OperationResult(
+                "proposals", dryrun=True, optimizer_result=cached[2],
+                proposals=cached[2].proposals, reason="cached")
+
+        if use_cache:
+            out = cached_result()
+            if out is not None:
+                return out
+        with self._proposal_compute_lock:
+            if goals is None and not ignore_proposal_cache:
+                out = cached_result()  # a concurrent compute just finished
+                if out is not None:
+                    return out
+            state, meta = self._model()
+            options = self._options_generator.for_cached_proposal_calculation(
+                meta.topic_names, ())
+            _final, result = self._optimizer.optimizations(
+                state, meta, self._goal_chain(goals), options)
+            if goals is None:
+                with self._proposal_lock:
+                    self._proposal_cache = (gen, time.time(), result)
         return OperationResult("proposals", dryrun=True,
                                optimizer_result=result,
                                proposals=result.proposals)

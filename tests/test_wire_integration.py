@@ -634,3 +634,26 @@ def test_full_stack_live_mode_against_embedded_cluster():
         cc.shutdown()
     finally:
         cluster.stop()
+
+
+def test_executor_intra_broker_jbod_flow_over_wire(cluster):
+    """The executor's intra-broker (JBOD) phase against the embedded
+    cluster: AlterReplicaLogDirs submitted over the wire, completion
+    observed via replica_logdirs polling, task COMPLETED."""
+    from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+    from cruise_control_tpu.executor.executor import Executor
+
+    cluster.create_topic("jbod", 2, 1, assignment={0: [1], 1: [1]})
+    admin = KafkaAdminBackend(cluster.bootstrap_servers)
+    executor = Executor(admin, progress_check_interval_s=0.01,
+                        synchronous=True)
+    proposals = [ExecutionProposal(
+        topic="jbod", partition=0, old_leader=1, old_replicas=(1,),
+        new_replicas=(1,), new_leader=1, logdir_broker=1,
+        source_logdir="/data/d0", destination_logdir="/data/d1")]
+    executor.execute_proposals(proposals, uuid="jbod-wire")
+    assert admin.replica_logdirs([1])[("jbod", 0, 1)] == "/data/d1"
+    counts = executor.execution_state()["recentHistory"][-1]["taskCounts"]
+    intra = counts.get("intra_broker_replica_action", {})
+    assert intra.get("completed") == 1, counts
+    admin.close()
