@@ -5,7 +5,9 @@ set -euo pipefail
 base_dir=$(dirname "$0")
 config=${1:-"$base_dir/config/cruisecontrol.properties"}
 port=${2:-}
-args=(--properties "$config" --demo)
+# Live mode when the properties set bootstrap.servers; demo otherwise
+# (the app auto-selects).
+args=(--properties "$config")
 [[ -n "$port" ]] && args+=(--port "$port")
 mkdir -p "$base_dir/fileStore"
 echo $$ > "$base_dir/fileStore/cruise-control-tpu.pid"

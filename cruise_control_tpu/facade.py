@@ -436,10 +436,12 @@ class CruiseControl:
         lock re-checks the cache so two callers never run the identical
         optimization concurrently (``_freshness_margin_s`` is the
         precompute loop's refresh-ahead knob)."""
-        gen = self._load_monitor.model_generation
         use_cache = goals is None and not ignore_proposal_cache
 
         def cached_result():
+            # Generation read fresh at check time: a stale pre-lock value
+            # would mislabel the cache entry and defeat the dedup.
+            gen = self._load_monitor.model_generation
             cached = self._cached_proposals_fresh(gen, _freshness_margin_s)
             if cached is None:
                 return None
@@ -451,17 +453,28 @@ class CruiseControl:
             out = cached_result()
             if out is not None:
                 return out
-        with self._proposal_compute_lock:
-            if goals is None and not ignore_proposal_cache:
-                out = cached_result()  # a concurrent compute just finished
-                if out is not None:
-                    return out
+
+        def compute():
             state, meta = self._model()
             options = self._options_generator.for_cached_proposal_calculation(
                 meta.topic_names, ())
             _final, result = self._optimizer.optimizations(
                 state, meta, self._goal_chain(goals), options)
-            if goals is None:
+            return result
+
+        if goals is not None:
+            # Custom-goal requests are never cached and share nothing with
+            # the default-chain computation — no reason to serialize them
+            # behind a long-running precompute pass.
+            result = compute()
+        else:
+            with self._proposal_compute_lock:
+                if use_cache:
+                    out = cached_result()  # a concurrent compute finished
+                    if out is not None:
+                        return out
+                gen = self._load_monitor.model_generation
+                result = compute()
                 with self._proposal_lock:
                     self._proposal_cache = (gen, time.time(), result)
         return OperationResult("proposals", dryrun=True,
