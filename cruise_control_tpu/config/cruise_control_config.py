@@ -81,6 +81,9 @@ def _definition() -> ConfigDef:
              "Max extrapolated windows tolerated per partition entity.")
     d.define("max.allowed.extrapolations.per.broker", T.INT, 8, Range.at_least(0), I.LOW,
              "Max extrapolated windows tolerated per broker entity.")
+    d.define("prometheus.server.endpoint", T.STRING, None, None, I.LOW,
+             "Prometheus base URL for PrometheusMetricSampler.from_endpoint "
+             "(prometheus/PrometheusMetricSampler.java config).")
     d.define("metric.sampler.class", T.CLASS,
              "cruise_control_tpu.monitor.sampling.synthetic_sampler.SyntheticMetricSampler",
              None, I.HIGH, "Pluggable MetricSampler implementation.")

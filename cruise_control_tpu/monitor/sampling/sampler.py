@@ -114,9 +114,60 @@ DEFAULT_PROMETHEUS_QUERIES: dict[R, str] = {
 }
 
 
+def prometheus_http_get(endpoint: str, timeout_s: float = 10.0,
+                        ) -> "Callable[[str, float], list[tuple[dict, float]]]":
+    """Production ``http_get`` for ``PrometheusMetricSampler``: an instant
+    query against ``{endpoint}/api/v1/query`` via stdlib urllib
+    (prometheus/PrometheusAdapter.java:queryMetric). Returns
+    [(labels, value)] rows; non-success statuses raise."""
+    import json as _json
+    import urllib.parse
+    import urllib.request
+
+    base = endpoint.rstrip("/")
+
+    def http_get(query: str, time_s: float) -> list[tuple[dict, float]]:
+        import urllib.error
+
+        url = (f"{base}/api/v1/query?"
+               + urllib.parse.urlencode({"query": query, "time": time_s}))
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+                payload = _json.load(resp)
+        except urllib.error.HTTPError as e:
+            # Prometheus reports query errors (e.g. bad PromQL) as non-2xx
+            # WITH a JSON body — surface its detail, not a bare 400.
+            try:
+                payload = _json.load(e)
+            except Exception:  # noqa: BLE001 — body was not JSON
+                raise RuntimeError(
+                    f"prometheus query failed: HTTP {e.code}") from e
+        if payload.get("status") != "success":
+            raise RuntimeError(f"prometheus query failed: "
+                               f"{payload.get('error', payload)}")
+        out = []
+        for row in payload.get("data", {}).get("result", []):
+            value = row.get("value", [None, "nan"])[1]
+            out.append((row.get("metric", {}), float(value)))
+        return out
+
+    return http_get
+
+
 class PrometheusMetricSampler:
     """PromQL-backed sampler. ``http_get(query, time_s) -> [(labels, value)]``
-    is injected (urllib against /api/v1/query in production)."""
+    is injected for tests; production uses ``from_endpoint`` (the stdlib
+    urllib client against ``/api/v1/query``, with the server URL from the
+    ``prometheus.server.endpoint`` config key)."""
+
+    @classmethod
+    def from_endpoint(cls, endpoint: str,
+                      broker_of_instance: Callable[[str], int | None],
+                      queries: Mapping[R, str] | None = None,
+                      cpu_estimator: CpuEstimator | None = None,
+                      ) -> "PrometheusMetricSampler":
+        return cls(prometheus_http_get(endpoint), broker_of_instance,
+                   queries, cpu_estimator)
 
     def __init__(self, http_get: Callable[[str, float], list[tuple[dict, float]]],
                  broker_of_instance: Callable[[str], int | None],

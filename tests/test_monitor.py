@@ -217,3 +217,57 @@ def test_train_fits_linear_cpu_model():
     c = result["coefficients"]
     assert c[0] == pytest.approx(0.001, rel=0.05)
     assert c[1] == pytest.approx(0.0005, rel=0.1)
+
+
+def test_prometheus_http_get_against_local_server():
+    """The stdlib Prometheus client speaks /api/v1/query for real: a tiny
+    local HTTP server plays Prometheus (PrometheusAdapter.java parity)."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+    from urllib.parse import parse_qs, urlparse
+
+    from cruise_control_tpu.monitor.sampling.sampler import (
+        PrometheusMetricSampler, prometheus_http_get,
+    )
+
+    seen = {}
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            u = urlparse(self.path)
+            q = parse_qs(u.query)
+            seen["path"] = u.path
+            seen["query"] = q.get("query", [""])[0]
+            body = json.dumps({
+                "status": "success",
+                "data": {"result": [
+                    {"metric": {"instance": "b1:7071", "topic": "t"},
+                     "value": [q.get("time", ["0"])[0], "123.5"]}]}})
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body.encode())
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        http_get = prometheus_http_get(
+            f"http://127.0.0.1:{srv.server_address[1]}")
+        rows = http_get("rate(kafka_server_bytes_in[1m])", 1234.0)
+        assert seen["path"] == "/api/v1/query"
+        assert "rate(" in seen["query"]
+        assert rows == [({"instance": "b1:7071", "topic": "t"}, 123.5)]
+        # from_endpoint wires the urllib client end to end: get_samples
+        # consumes the local server's answers through the real path
+        sampler = PrometheusMetricSampler.from_endpoint(
+            f"http://127.0.0.1:{srv.server_address[1]}",
+            broker_of_instance=lambda inst: 1 if inst.startswith("b1") else None)
+        res = sampler.get_samples({}, 0, 2_000_000)
+        assert res.broker_samples, "sampler must produce broker samples"
+    finally:
+        srv.shutdown()
+        srv.server_close()
