@@ -76,14 +76,18 @@ _PROPOSAL_PARAMS = {**_GOALS_PARAMS, "ignore_proposal_cache": _bool,
 
 _EXECUTION_PARAMS = {
     "dryrun": _bool, "concurrent_partition_movements_per_broker": _int,
+    "max_partition_movements_in_cluster": _int,
     "concurrent_intra_broker_partition_movements": _int,
-    "concurrent_leader_movements": _int, "execution_progress_check_interval_ms": _long_ms,
+    "concurrent_leader_movements": _int,
+    "broker_concurrent_leader_movements": _int,
+    "execution_progress_check_interval_ms": _long_ms,
     "skip_hard_goal_check": _bool, "replication_throttle": _int,
     "replica_movement_strategies": _csv, "review_id": _int,
     "stop_ongoing_execution": _bool}
 
 SCHEMAS: dict[EndPoint, dict[str, Callable[[str], Any]]] = {
-    EndPoint.BOOTSTRAP: {"start": _long_ms, "end": _long_ms, "clearmetrics": _bool},
+    EndPoint.BOOTSTRAP: {"start": _long_ms, "end": _long_ms,
+                         "clearmetrics": _bool, "developer_mode": _bool},
     EndPoint.TRAIN: {"start": _long_ms, "end": _long_ms},
     EndPoint.LOAD: {"time": _long_ms, "start": _long_ms, "end": _long_ms,
                     "allow_capacity_estimation": _bool, "populate_disk_info": _bool,
@@ -111,7 +115,9 @@ SCHEMAS: dict[EndPoint, dict[str, Callable[[str], Any]]] = {
     EndPoint.REBALANCE: {**_PROPOSAL_PARAMS, **_EXECUTION_PARAMS,
                          "destination_broker_ids": _int_csv,
                          "ignore_proposal_cache": _bool},
-    EndPoint.STOP_PROPOSAL_EXECUTION: {"force_stop": _bool, "review_id": _int},
+    EndPoint.STOP_PROPOSAL_EXECUTION: {"force_stop": _bool,
+                                       "stop_external_agent": _bool,
+                                       "review_id": _int},
     EndPoint.PAUSE_SAMPLING: {"review_id": _int},
     EndPoint.RESUME_SAMPLING: {"review_id": _int},
     EndPoint.DEMOTE_BROKER: {**_EXECUTION_PARAMS, "brokerid": _int_csv,
@@ -119,6 +125,9 @@ SCHEMAS: dict[EndPoint, dict[str, Callable[[str], Any]]] = {
                              "exclude_follower_demotion": _bool},
     EndPoint.ADMIN: {"disable_self_healing_for": _csv,
                      "enable_self_healing_for": _csv,
+                     "disable_concurrency_adjuster_for": _csv,
+                     "enable_concurrency_adjuster_for": _csv,
+                     "min_isr_based_concurrency_adjustment": _bool,
                      "concurrent_partition_movements_per_broker": _int,
                      "concurrent_intra_broker_partition_movements": _int,
                      "concurrent_leader_movements": _int,
@@ -127,7 +136,8 @@ SCHEMAS: dict[EndPoint, dict[str, Callable[[str], Any]]] = {
                      "review_id": _int},
     EndPoint.REVIEW: {"approve": _int_csv, "discard": _int_csv},
     EndPoint.TOPIC_CONFIGURATION: {**_EXECUTION_PARAMS, "topic": _str,
-                                   "replication_factor": _int},
+                                   "replication_factor": _int,
+                                   "skip_rack_awareness_check": _bool},
     EndPoint.RIGHTSIZE: {"numbrokerstoadd": _int, "partition_count": _int,
                          "topic": _str, "review_id": _int},
     EndPoint.REMOVE_DISKS: {**_EXECUTION_PARAMS,

@@ -320,9 +320,17 @@ class CruiseControlApi:
             cc.resume_metric_sampling(p.get("reason", ""))
             return responses.envelope({"message": "metric sampling resumed"})
         if endpoint is EndPoint.STOP_PROPOSAL_EXECUTION:
-            cc.stop_proposal_execution()
+            cc.stop_proposal_execution(
+                force_stop=p.get("force_stop", False),
+                stop_external_agent=p.get("stop_external_agent", False))
             return responses.envelope({"message": "execution stop requested"})
         if endpoint is EndPoint.BOOTSTRAP:
+            if not p.get("developer_mode", False):
+                # BootstrapRequest.java:29: without developer_mode=true the
+                # endpoint does nothing but say so.
+                return responses.envelope({
+                    "message": "This endpoint is used only for development "
+                               "purposes in developer_mode=true."})
             start = p.get("start")
             if start is None:
                 raise ParameterParseError("bootstrap requires start")
@@ -366,6 +374,25 @@ class CruiseControlApi:
                 intra_broker_per_broker=conc.get(
                     "concurrent_intra_broker_partition_movements"),
                 leadership_cluster=conc.get("concurrent_leader_movements"))
+        # Validate every adjuster name BEFORE applying any: a typo in one
+        # CSV entry must 400 the request without partially toggling others.
+        from ..executor.concurrency import ExecutionConcurrencyManager
+        adjuster_toggles = [(n, False) for n in
+                            p.get("disable_concurrency_adjuster_for", ())] + \
+                           [(n, True) for n in
+                            p.get("enable_concurrency_adjuster_for", ())]
+        for name, _e in adjuster_toggles:
+            if name.upper() not in ExecutionConcurrencyManager.ADJUSTER_TYPES:
+                raise ParameterParseError(
+                    f"unknown concurrency type {name!r}; expected one of "
+                    f"{', '.join(ExecutionConcurrencyManager.ADJUSTER_TYPES)}")
+        for name, enabled in adjuster_toggles:
+            old = cc.executor.set_concurrency_adjuster_for(name, enabled)
+            changed.setdefault("concurrencyAdjusterEnabledBefore", {})[name] = old
+        if "min_isr_based_concurrency_adjustment" in p:
+            changed["minIsrBasedAdjustmentBefore"] = \
+                cc.executor.set_min_isr_based_adjustment(
+                    p["min_isr_based_concurrency_adjustment"])
         dropped_removed = p.get("drop_recently_removed_brokers", ())
         if dropped_removed:
             with cc.excluded_sets_lock:
@@ -402,6 +429,12 @@ class CruiseControlApi:
                     p["concurrent_intra_broker_partition_movements"]
             if "concurrent_leader_movements" in p:
                 conc["leadership_cluster"] = p["concurrent_leader_movements"]
+            if "max_partition_movements_in_cluster" in p:
+                conc["cluster_inter_broker"] = \
+                    p["max_partition_movements_in_cluster"]
+            if "broker_concurrent_leader_movements" in p:
+                conc["leadership_per_broker"] = \
+                    p["broker_concurrent_leader_movements"]
             strategies = p.get("replica_movement_strategies", ())
             if conc or strategies:
                 return cc.execution_overrides(strategies, conc)
@@ -467,8 +500,10 @@ class CruiseControlApi:
                     "topic_configuration requires topic and replication_factor")
             with exec_scope():
                 return responses.optimization_result(
-                    cc.update_topic_replication_factor([topic], rf, dryrun,
-                                                       reason=reason), verbose)
+                    cc.update_topic_replication_factor(
+                        [topic], rf, dryrun, reason=reason,
+                        skip_rack_awareness_check=p.get(
+                            "skip_rack_awareness_check", False)), verbose)
 
         def remove_disks():
             mapping = p.get("brokerid_and_logdirs")

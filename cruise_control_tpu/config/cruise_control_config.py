@@ -397,6 +397,11 @@ def _definition() -> ConfigDef:
     d.define("self.healing.exclude.recently.removed.brokers", T.BOOLEAN, True,
              None, I.LOW, "Self-healing skips recently removed brokers for "
              "replica placement.")
+    d.define("replication.factor.self.healing.skip.rack.awareness.check",
+             T.BOOLEAN, False, None, I.LOW,
+             "Allow self-healing RF changes to place multiple replicas of a "
+             "partition in one rack when racks < RF "
+             "(AnomalyDetectorConfig.java:309).")
     d.define("num.cached.recent.anomaly.states", T.INT, 10, Range.at_least(1),
              I.LOW, "Recent anomalies kept per type in the detector state.")
     d.define("anomaly.detection.allow.capacity.estimation", T.BOOLEAN, True,

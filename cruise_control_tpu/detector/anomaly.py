@@ -224,10 +224,13 @@ class TopicAnomaly(Anomaly):
     def fix(self, facade: Any) -> bool:
         if not self.topics_by_desired_rf:
             return False
+        skip = facade.config.get_boolean(
+            "replication.factor.self.healing.skip.rack.awareness.check")
         for rf, topics in sorted(self.topics_by_desired_rf.items()):
             facade.update_topic_replication_factor(
                 list(topics), rf, dryrun=False,
                 is_triggered_by_user_request=False,
+                skip_rack_awareness_check=skip,
                 reason="self-healing topic replication factor")
         return True
 
@@ -271,8 +274,11 @@ class MaintenanceEvent(Anomaly):
         elif self.event_type is t.FIX_OFFLINE_REPLICAS:
             facade.fix_offline_replicas(**kw)
         elif self.event_type is t.TOPIC_REPLICATION_FACTOR:
+            skip = facade.config.get_boolean(
+                "replication.factor.self.healing.skip.rack.awareness.check")
             for rf, topics in sorted(self.topics_by_rf.items()):
-                facade.update_topic_replication_factor(list(topics), rf, **kw)
+                facade.update_topic_replication_factor(
+                    list(topics), rf, skip_rack_awareness_check=skip, **kw)
         else:
             facade.rebalance(goals=None, **kw)
         return True

@@ -37,12 +37,19 @@ class ExecutionConcurrencyManager:
     MAX_INTER_BROKER_MULTIPLIER = 2
     MIN_LEADERSHIP = 100
 
+    # ConcurrencyType names accepted by the ADMIN endpoint's
+    # (en|dis)able_concurrency_adjuster_for toggles (ConcurrencyType.java).
+    ADJUSTER_TYPES = ("INTER_BROKER_REPLICA", "INTRA_BROKER_REPLICA",
+                      "LEADERSHIP")
+
     def __init__(self, caps: ConcurrencyCaps | None = None):
         self._caps = caps or ConcurrencyCaps()
         self._base = dataclasses.replace(self._caps)
         self._lock = threading.Lock()
         self._inter_in_flight: dict[int, int] = {}   # broker -> count
         self._cluster_inter_in_flight = 0
+        self._adjuster_enabled = {t: True for t in self.ADJUSTER_TYPES}
+        self._min_isr_based_adjustment = True
 
     # ---- capacity queries -------------------------------------------------
     def inter_broker_headroom(self, broker: int) -> int:
@@ -97,6 +104,15 @@ class ExecutionConcurrencyManager:
         left alone (the reference skips user-requested dimensions); all
         others keep adjusting, including the min-ISR safety step-down."""
         with self._lock:
+            if not self._min_isr_based_adjustment:
+                # ADMIN min_isr_based_concurrency_adjustment=false: the
+                # adjuster ignores (At/Under)MinISR pressure entirely
+                # (Executor.java min.isr-based adjustment toggle).
+                has_under_min_isr = False
+            if not self._adjuster_enabled["INTER_BROKER_REPLICA"]:
+                frozen = frozen | {"inter_broker_per_broker"}
+            if not self._adjuster_enabled["LEADERSHIP"]:
+                frozen = frozen | {"leadership_cluster"}
             if "inter_broker_per_broker" not in frozen:
                 cap = self._caps.inter_broker_per_broker
                 if has_under_min_isr:
@@ -119,6 +135,27 @@ class ExecutionConcurrencyManager:
                     lcap = min(self._base.leadership_cluster, lcap + 100)
                 self._caps.leadership_cluster = lcap
 
+    def set_adjuster_enabled(self, concurrency_type: str,
+                             enabled: bool) -> bool:
+        """Toggle the adaptive adjuster for one ConcurrencyType (the ADMIN
+        endpoint's (en|dis)able_concurrency_adjuster_for). Returns the
+        previous setting; unknown types raise (a typo must not no-op)."""
+        key = concurrency_type.upper()
+        if key not in self._adjuster_enabled:
+            raise ValueError(
+                f"unknown concurrency type {concurrency_type!r}; expected "
+                f"one of {', '.join(self.ADJUSTER_TYPES)}")
+        with self._lock:
+            old = self._adjuster_enabled[key]
+            self._adjuster_enabled[key] = enabled
+            return old
+
+    def set_min_isr_based_adjustment(self, enabled: bool) -> bool:
+        with self._lock:
+            old = self._min_isr_based_adjustment
+            self._min_isr_based_adjustment = enabled
+            return old
+
     def snapshot(self) -> ConcurrencyCaps:
         with self._lock:
             return dataclasses.replace(self._caps)
@@ -137,4 +174,6 @@ class ExecutionConcurrencyManager:
                 "clusterInterBroker": self._caps.cluster_inter_broker,
                 "leadershipCluster": self._caps.leadership_cluster,
                 "interBrokerInFlight": self._cluster_inter_in_flight,
+                "adjusterEnabled": dict(self._adjuster_enabled),
+                "minIsrBasedAdjustment": self._min_isr_based_adjustment,
             }

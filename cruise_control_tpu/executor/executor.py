@@ -272,6 +272,20 @@ class Executor:
             self._state = ExecutorState.STOPPING_EXECUTION
             self._stop_requested.set()
 
+    def stop_external_reassignments(self) -> int:
+        """Cancel reassignments this executor did not start
+        (maybeStopExternalAgent:1261). Holds the lock across the
+        ongoing-execution check and the cancel, so a concurrently starting
+        internal execution (which reserves state under the same lock before
+        submitting) can never be mistaken for an external agent."""
+        with self._lock:
+            if self.has_ongoing_execution():
+                return 0
+            external = self._admin.list_reassigning_partitions()
+            if external:
+                self._admin.cancel_partition_reassignments(external)
+            return len(external)
+
     def await_completion(self, timeout_s: float = 60.0) -> bool:
         t = self._thread
         if t is not None:
@@ -296,7 +310,10 @@ class Executor:
 
     def set_requested_concurrency(self, inter_broker_per_broker: int | None = None,
                                   intra_broker_per_broker: int | None = None,
-                                  leadership_cluster: int | None = None) -> dict:
+                                  leadership_cluster: int | None = None,
+                                  cluster_inter_broker: int | None = None,
+                                  leadership_per_broker: int | None = None,
+                                  ) -> dict:
         """Operator concurrency override
         (Executor.setRequestedExecutionConcurrency)."""
         caps = self._concurrency._caps
@@ -306,7 +323,24 @@ class Executor:
             caps.intra_broker_per_broker = intra_broker_per_broker
         if leadership_cluster is not None:
             caps.leadership_cluster = leadership_cluster
+        if cluster_inter_broker is not None:
+            # max_partition_movements_in_cluster per-request override
+            # (ParameterUtils.MAX_PARTITION_MOVEMENTS_IN_CLUSTER_PARAM).
+            caps.cluster_inter_broker = cluster_inter_broker
+        if leadership_per_broker is not None:
+            # broker_concurrent_leader_movements per-request override.
+            caps.leadership_per_broker = leadership_per_broker
         return self._concurrency.state()
+
+    def set_concurrency_adjuster_for(self, concurrency_type: str,
+                                     enabled: bool) -> bool:
+        """ADMIN (en|dis)able_concurrency_adjuster_for toggle."""
+        return self._concurrency.set_adjuster_enabled(concurrency_type,
+                                                      enabled)
+
+    def set_min_isr_based_adjustment(self, enabled: bool) -> bool:
+        """ADMIN min_isr_based_concurrency_adjustment toggle."""
+        return self._concurrency.set_min_isr_based_adjustment(enabled)
 
     def _set_phase(self, phase: ExecutorState) -> None:
         # Never overwrite a user-requested STOPPING state from the worker.

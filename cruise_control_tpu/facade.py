@@ -587,6 +587,7 @@ class CruiseControl:
                                         dryrun: bool = True,
                                         is_triggered_by_user_request: bool = True,
                                         reason: str = "", uuid: str = "",
+                                        skip_rack_awareness_check: bool = False,
                                         ) -> OperationResult:
         """UpdateTopicConfigurationRunnable — grow/shrink each partition's
         replica list to the target RF (rack-diverse, least-loaded brokers
@@ -595,9 +596,24 @@ class CruiseControl:
         want = set(topics)
         partitions = self._admin.describe_partitions()
         alive = self._admin.alive_brokers()
+        racks = {bid: meta.rack_names[int(r)]
+                 for bid, r in zip(meta.broker_ids, np.asarray(state.rack))}
+        # populateRackInfoForReplicationFactorChange (RunnableUtils.java:74):
+        # RF above the alive-broker count is always impossible; RF above the
+        # rack count breaks one-replica-per-rack and needs the explicit
+        # skip_rack_awareness_check opt-in.
+        if replication_factor > len(alive):
+            raise ValueError(
+                f"replication factor {replication_factor} exceeds the "
+                f"{len(alive)} alive broker(s)")
+        if not skip_rack_awareness_check:
+            num_racks = len({racks[b] for b in alive if b in racks})
+            if replication_factor > max(num_racks, 1):
+                raise ValueError(
+                    f"replication factor {replication_factor} exceeds the "
+                    f"{num_racks} distinct alive rack(s); pass "
+                    "skip_rack_awareness_check=true to override")
         counts: dict[int, int] = {b: 0 for b in alive}
-        racks = {bid: meta.rack_names[int(state.rack[i])]
-                 for i, bid in enumerate(meta.broker_ids)}
         for st in partitions.values():
             for b in st.replicas:
                 counts[b] = counts.get(b, 0) + 1
@@ -781,8 +797,23 @@ class CruiseControl:
     def resume_metric_sampling(self, reason: str = "") -> None:
         self._load_monitor.resume_metric_sampling(reason)
 
-    def stop_proposal_execution(self) -> None:
+    def stop_proposal_execution(self, force_stop: bool = False,
+                                stop_external_agent: bool = False) -> None:
+        """STOP_PROPOSAL_EXECUTION (Executor.userTriggeredStopExecution:1139).
+        ``force_stop`` is accepted for parameter parity — with the
+        AdminClient (KIP-455) cancellation path both modes cancel in-flight
+        reassignments, the old soft/force split only existed for ZK-based
+        stops. ``stop_external_agent`` additionally cancels reassignments
+        this executor did not start (maybeStopExternalAgent:1261) when no
+        internal execution is running."""
+        del force_stop
         self._executor.stop_execution()
+        if stop_external_agent:
+            cancelled = self._executor.stop_external_reassignments()
+            if cancelled:
+                OPERATION_LOG.info(
+                    "stop_proposal_execution cancelled %d external "
+                    "reassignment(s)", cancelled)
 
     # -- state (the STATE endpoint dashboard) -------------------------------
     def state(self, substates: Sequence[str] = ()) -> dict:

@@ -174,6 +174,69 @@ def test_admin_concurrency_override(api, cc):
     assert cc.executor._concurrency._caps.inter_broker_per_broker == 3
 
 
+def test_admin_concurrency_adjuster_toggles(api, cc):
+    mgr = cc.executor._concurrency
+    base = mgr.snapshot()
+    status, body, _ = api.handle(
+        "POST", "/kafkacruisecontrol/admin",
+        "disable_concurrency_adjuster_for=leadership"
+        "&min_isr_based_concurrency_adjustment=false")
+    assert status == 200
+    assert body["concurrencyAdjusterEnabledBefore"] == {"leadership": True}
+    assert body["minIsrBasedAdjustmentBefore"] is True
+    # LEADERSHIP adjuster off + min-ISR-based adjustment off: an
+    # under-min-ISR tick changes neither cap.
+    mgr.adjust(cluster_healthy=False, has_under_min_isr=True)
+    after = mgr.snapshot()
+    assert after.leadership_cluster == base.leadership_cluster
+    assert after.inter_broker_per_broker == base.inter_broker_per_broker
+    # Re-enable: the same tick now halves the inter-broker cap again.
+    assert api.handle("POST", "/kafkacruisecontrol/admin",
+                      "enable_concurrency_adjuster_for=leadership"
+                      "&min_isr_based_concurrency_adjustment=true")[0] == 200
+    mgr.adjust(cluster_healthy=False, has_under_min_isr=True)
+    assert mgr.snapshot().inter_broker_per_broker == \
+        max(mgr.MIN_INTER_BROKER, base.inter_broker_per_broker // 2)
+    cc.executor.set_requested_concurrency(
+        inter_broker_per_broker=base.inter_broker_per_broker,
+        leadership_cluster=base.leadership_cluster)
+    # A typo'd concurrency type must 400, not silently no-op.
+    assert api.handle("POST", "/kafkacruisecontrol/admin",
+                      "disable_concurrency_adjuster_for=warp_drive")[0] == 400
+
+
+def test_stop_execution_stop_external_agent(api, cc):
+    backend = cc._admin
+    # An "external agent" reassignment: destination broker 9 is dead, so the
+    # fake cluster's tick never completes it.
+    backend.alter_partition_reassignments({("t0", 0): (0, 9)})
+    assert backend.list_reassigning_partitions()
+    # A plain stop leaves the external reassignment alone ...
+    assert api.handle("POST",
+                      "/kafkacruisecontrol/stop_proposal_execution")[0] == 200
+    assert backend.list_reassigning_partitions()
+    # ... stop_external_agent=true cancels it (maybeStopExternalAgent:1261).
+    assert api.handle("POST", "/kafkacruisecontrol/stop_proposal_execution",
+                      "stop_external_agent=true&force_stop=true")[0] == 200
+    assert not backend.list_reassigning_partitions()
+
+
+def test_execution_param_surface_parses():
+    p = parse_parameters(EndPoint.REBALANCE, {
+        "max_partition_movements_in_cluster": ["600"],
+        "broker_concurrent_leader_movements": ["50"],
+        "dryrun": ["false"]})
+    assert p["max_partition_movements_in_cluster"] == 600
+    assert p["broker_concurrent_leader_movements"] == 50
+    p = parse_parameters(EndPoint.TOPIC_CONFIGURATION,
+                         {"skip_rack_awareness_check": ["true"],
+                          "topic": ["t0"], "replication_factor": ["3"]})
+    assert p["skip_rack_awareness_check"] is True
+    p = parse_parameters(EndPoint.BOOTSTRAP, {"developer_mode": ["true"],
+                                              "start": ["0"]})
+    assert p["developer_mode"] is True
+
+
 def test_pause_resume_and_stop(api, cc):
     assert api.handle("POST", "/kafkacruisecontrol/pause_sampling",
                       "reason=maintenance")[0] == 200

@@ -128,7 +128,16 @@ def test_demote_brokers_sheds_leadership_only():
 
 def test_update_topic_replication_factor_grows_rack_aware():
     cc, _ = _cruise_control(_partitions(rf=2))
-    res = cc.update_topic_replication_factor(["t0"], 3, dryrun=True)
+    # The fixture has 2 racks (r0/r1): growing to RF 3 must refuse without
+    # the explicit opt-in (RunnableUtils.java:91-99) ...
+    with pytest.raises(ValueError, match="skip_rack_awareness_check"):
+        cc.update_topic_replication_factor(["t0"], 3, dryrun=True)
+    # ... and RF above the alive-broker count is always impossible (:87-90).
+    with pytest.raises(ValueError, match="alive broker"):
+        cc.update_topic_replication_factor(["t0"], 5, dryrun=True,
+                                           skip_rack_awareness_check=True)
+    res = cc.update_topic_replication_factor(["t0"], 3, dryrun=True,
+                                             skip_rack_awareness_check=True)
     assert res.proposals
     for pr in res.proposals:
         assert len(pr.new_replicas) == 3
