@@ -154,6 +154,8 @@ class GoalOptimizer:
             "solver.fused.chain.max.brokers")
         self._dispatch_rounds = self._config.get_int(
             "solver.dispatch.max.rounds")
+        self._dispatch_target_s = self._config.get_double(
+            "solver.dispatch.target.seconds")
         if mesh == "auto":
             import jax
 
@@ -277,7 +279,8 @@ class GoalOptimizer:
             state, infos = optimize_chain_sharded(
                 state, goal_chain, self._constraint, search_cfg,
                 meta.num_topics, mesh, masks,
-                dispatch_rounds=self._dispatch_rounds if bounded else 0)
+                dispatch_rounds=self._dispatch_rounds if bounded else 0,
+                dispatch_target_s=self._dispatch_target_s)
             goal_results = _apportioned_goal_results(
                 goal_chain, infos, time.time() - t0)
         elif self._fused_chain and (
@@ -300,13 +303,20 @@ class GoalOptimizer:
             # on-entry violated_before semantics as the fused path.
             dispatch_rounds = self._dispatch_rounds if self._fused_chain \
                 else 0
+            # One adaptive controller across the chain: per-round cost is a
+            # property of the cluster shape, not the goal, so the budget
+            # learned on goal 1 carries to goal 15.
+            from .chain import AdaptiveDispatch
+            controller = AdaptiveDispatch(
+                dispatch_rounds, self._dispatch_target_s) \
+                if dispatch_rounds > 0 else None
             goal_results = []
             for i, g in enumerate(goal_chain):
                 t0 = time.time()
                 state, info = optimize_goal_in_chain(
                     state, goal_chain, i, self._constraint, search_cfg,
                     meta.num_topics, masks,
-                    dispatch_rounds=dispatch_rounds)
+                    dispatch_rounds=dispatch_rounds, dispatch=controller)
                 goal_results.append(GoalResult(
                     name=g.name, is_hard=g.is_hard,
                     succeeded=info["succeeded"],

@@ -353,16 +353,35 @@ class CruiseControlApi:
 
     def _admin_handler(self, p: dict) -> dict:
         from ..detector.anomaly import AnomalyType
+        from ..executor.concurrency import ExecutionConcurrencyManager
         cc = self._cc
+        # Validate EVERY name-typed argument before applying ANY mutation:
+        # a typo anywhere must 400 the whole request, not leave the earlier
+        # toggles silently applied under an error response.
+        healing_toggles = [(n, False) for n in
+                           p.get("disable_self_healing_for", ())] + \
+                          [(n, True) for n in
+                           p.get("enable_self_healing_for", ())]
+        for name, _e in healing_toggles:
+            if name.upper() not in AnomalyType.__members__:
+                raise ParameterParseError(
+                    f"unknown anomaly type {name!r}; expected one of "
+                    f"{', '.join(AnomalyType.__members__)}")
+        adjuster_toggles = [(n, False) for n in
+                            p.get("disable_concurrency_adjuster_for", ())] + \
+                           [(n, True) for n in
+                            p.get("enable_concurrency_adjuster_for", ())]
+        for name, _e in adjuster_toggles:
+            if name.upper() not in ExecutionConcurrencyManager.ADJUSTER_TYPES:
+                raise ParameterParseError(
+                    f"unknown concurrency type {name!r}; expected one of "
+                    f"{', '.join(ExecutionConcurrencyManager.ADJUSTER_TYPES)}")
         changed: dict[str, Any] = {}
-        for name in p.get("disable_self_healing_for", ()):
+        for name, enabled in healing_toggles:
             old = cc.anomaly_detector.set_self_healing_for(
-                AnomalyType[name.upper()], False)
-            changed.setdefault("selfHealingDisabledBefore", {})[name] = old
-        for name in p.get("enable_self_healing_for", ()):
-            old = cc.anomaly_detector.set_self_healing_for(
-                AnomalyType[name.upper()], True)
-            changed.setdefault("selfHealingEnabledBefore", {})[name] = old
+                AnomalyType[name.upper()], enabled)
+            changed.setdefault("selfHealingEnabledBefore" if enabled
+                               else "selfHealingDisabledBefore", {})[name] = old
         conc = {k: p[k] for k in
                 ("concurrent_partition_movements_per_broker",
                  "concurrent_intra_broker_partition_movements",
@@ -374,18 +393,6 @@ class CruiseControlApi:
                 intra_broker_per_broker=conc.get(
                     "concurrent_intra_broker_partition_movements"),
                 leadership_cluster=conc.get("concurrent_leader_movements"))
-        # Validate every adjuster name BEFORE applying any: a typo in one
-        # CSV entry must 400 the request without partially toggling others.
-        from ..executor.concurrency import ExecutionConcurrencyManager
-        adjuster_toggles = [(n, False) for n in
-                            p.get("disable_concurrency_adjuster_for", ())] + \
-                           [(n, True) for n in
-                            p.get("enable_concurrency_adjuster_for", ())]
-        for name, _e in adjuster_toggles:
-            if name.upper() not in ExecutionConcurrencyManager.ADJUSTER_TYPES:
-                raise ParameterParseError(
-                    f"unknown concurrency type {name!r}; expected one of "
-                    f"{', '.join(ExecutionConcurrencyManager.ADJUSTER_TYPES)}")
         for name, enabled in adjuster_toggles:
             old = cc.executor.set_concurrency_adjuster_for(name, enabled)
             changed.setdefault("concurrencyAdjusterEnabledBefore", {})[name] = old

@@ -247,8 +247,17 @@ def _definition() -> ConfigDef:
              "watchdogs on tunneled TPU runtimes. 0 = never switch.")
     d.define("solver.dispatch.max.rounds", T.INT, 16, Range.at_least(1),
              I.MEDIUM,
-             "Search rounds per device dispatch on the bounded per-goal "
-             "path (the host loops to the same fixed point).")
+             "Initial (and minimum) search rounds per device dispatch on "
+             "the bounded per-goal path (the host loops to the same fixed "
+             "point).")
+    d.define("solver.dispatch.target.seconds", T.DOUBLE, 2.5,
+             Range.at_least(0), I.MEDIUM,
+             "Adaptive bounded-dispatch sizing: grow the per-dispatch round "
+             "budget while a full dispatch completes under half this "
+             "wall-clock, shrink when it overshoots 2x. Amortizes the "
+             "per-dispatch host-device link latency (a tunneled TPU pays a "
+             "fixed RTT per execution) while every dispatch stays far "
+             "below execution-watchdog territory. 0 disables adaptation.")
     d.define("goal.violation.distribution.threshold.multiplier", T.DOUBLE, 1.0,
              Range.at_least(1), I.LOW,
              "Detector-triggered balance-threshold relaxation.")

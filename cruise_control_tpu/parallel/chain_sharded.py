@@ -538,6 +538,7 @@ def optimize_chain_sharded(state: ClusterTensors, chain,
                            masks: ExclusionMasks | None = None,
                            swap_moves: int = 8, swap_max_rounds: int = 64,
                            dispatch_rounds: int = 0,
+                           dispatch_target_s: float = 0.0,
                            ) -> tuple[ClusterTensors, list[dict]]:
     """Sharded analogue of ``analyzer.chain.optimize_chain``: the whole
     chain in one dispatch over the mesh, same info-dict contract and error
@@ -558,7 +559,7 @@ def optimize_chain_sharded(state: ClusterTensors, chain,
     if dispatch_rounds > 0:
         return _optimize_chain_sharded_bounded(
             state, goals, constraint, cfg, num_topics, mesh, masks, presence,
-            swap_moves, swap_max_rounds, dispatch_rounds)
+            swap_moves, swap_max_rounds, dispatch_rounds, dispatch_target_s)
     fn = _make_chain_full(mesh, goals, constraint, cfg, num_topics, presence,
                           swap_moves, swap_max_rounds)
     state, stats = fn(state, masks)
@@ -618,14 +619,19 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
                                     num_topics, mesh, masks, presence,
                                     swap_moves, swap_max_rounds,
                                     dispatch_rounds: int,
+                                    dispatch_target_s: float = 0.0,
                                     ) -> tuple[ClusterTensors, list[dict]]:
     """Host-looped per-goal sharded driver: the trajectory of
-    ``_chain_full_local`` with every device dispatch bounded to
-    ``dispatch_rounds`` search rounds."""
+    ``_chain_full_local`` with every device dispatch bounded — starting at
+    ``dispatch_rounds`` search rounds and adaptively resized toward
+    ``dispatch_target_s`` of wall-clock per dispatch (AdaptiveDispatch)."""
+    import time as _time
+
+    from ..analyzer.chain import AdaptiveDispatch
     move, swap, stats_fn = _make_chain_phase_kernels(
         mesh, goals, constraint, cfg, num_topics, presence, swap_moves,
         swap_max_rounds)
-    k = dispatch_rounds
+    controller = AdaptiveDispatch(dispatch_rounds, dispatch_target_s)
     per_goal = {name: [] for name in
                 ("viol_before", "obj_before", "offline_before", "viol_after",
                  "obj_after", "offline_after", "moves", "swaps", "rounds")}
@@ -633,12 +639,15 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
     def run_pass(kernel, st, idx, prior, pass_cap: int):
         applied_total, pass_rounds = 0, 0
         while pass_rounds < pass_cap:
-            budget = min(k, pass_cap - pass_rounds)
+            budget = controller.budget(pass_cap - pass_rounds)
+            t0 = _time.monotonic()
             st, applied, r = kernel(st, masks, idx, prior,
                                     jnp.int32(budget))
             applied_total += int(applied)
-            pass_rounds += int(r)
-            if int(r) < budget:
+            r = int(r)
+            controller.observe(r, budget, _time.monotonic() - t0)
+            pass_rounds += r
+            if r < budget:
                 break
         return st, applied_total, pass_rounds
 
@@ -660,7 +669,8 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
         if masks.excluded_replica_move_brokers is not None:
             drain = bool(excluded_hosting_replicas(
                 state, masks.excluded_replica_move_brokers).any())
-        if float(viol0) > 0 or int(offline0) > 0 or drain:
+        ran = float(viol0) > 0 or int(offline0) > 0 or drain
+        if ran:
             while rounds < cfg.max_rounds:
                 state, m_, r = run_pass(move, state, idx, prior,
                                         cfg.max_rounds)
@@ -674,7 +684,11 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
                 rounds += sr
                 if sw == 0:
                     break
-        viol1, obj1, offline1 = stats_fn(state, masks, idx)
+            viol1, obj1, offline1 = stats_fn(state, masks, idx)
+        else:
+            # Skipped goal: state untouched, entry stats ARE exit stats
+            # (saves the second stats dispatch per idle goal).
+            viol1, obj1, offline1 = viol0, obj0, offline0
         per_goal["viol_after"].append(float(viol1))
         per_goal["obj_after"].append(float(obj1))
         per_goal["offline_after"].append(int(offline1))

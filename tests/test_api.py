@@ -205,6 +205,20 @@ def test_admin_concurrency_adjuster_toggles(api, cc):
                       "disable_concurrency_adjuster_for=warp_drive")[0] == 400
 
 
+def test_admin_rejects_whole_request_on_any_bad_name(api, cc):
+    """A typo anywhere in an ADMIN request must 400 WITHOUT applying the
+    valid toggles that preceded it (no partial mutation under an error)."""
+    st_before = cc.anomaly_detector.state()["selfHealingEnabled"]
+    status, _b, _ = api.handle(
+        "POST", "/kafkacruisecontrol/admin",
+        "disable_self_healing_for=broker_failure"
+        "&disable_concurrency_adjuster_for=warp_drive")
+    assert status == 400
+    assert cc.anomaly_detector.state()["selfHealingEnabled"] == st_before
+    assert api.handle("POST", "/kafkacruisecontrol/admin",
+                      "enable_self_healing_for=warp_core")[0] == 400
+
+
 def test_stop_execution_stop_external_agent(api, cc):
     backend = cc._admin
     # An "external agent" reassignment: destination broker 9 is dead, so the
