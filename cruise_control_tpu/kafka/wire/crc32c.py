@@ -1,21 +1,12 @@
 """CRC-32C (Castagnoli) — the record-batch v2 checksum (KIP-98).
 
 Python's ``zlib.crc32`` is CRC-32 (IEEE), not CRC-32C, so the polynomial
-is implemented here: a C fast path compiled on first use (8-way
-slicing-by-8 would be overkill; the simple table loop in C is ~20×
-the pure-Python loop), with a table-driven pure-Python fallback when no
-compiler is available.
+is implemented here: the native runtime library's C kernel when available
+(native/ccnative.c — shared with the record-batch index parser), with a
+table-driven pure-Python fallback when no compiler is available.
 """
 
 from __future__ import annotations
-
-import ctypes
-import logging
-import os
-import subprocess
-import tempfile
-
-LOG = logging.getLogger(__name__)
 
 _POLY = 0x82F63B78  # reversed Castagnoli polynomial
 
@@ -26,79 +17,13 @@ for _n in range(256):
         _c = (_c >> 1) ^ _POLY if _c & 1 else _c >> 1
     _TABLE.append(_c)
 
-_C_SRC = r"""
-#include <stdint.h>
-#include <stddef.h>
-
-static uint32_t table[256];
-static int init_done = 0;
-
-static void init_table(void) {
-    for (uint32_t n = 0; n < 256; n++) {
-        uint32_t c = n;
-        for (int k = 0; k < 8; k++)
-            c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
-        table[n] = c;
-    }
-    init_done = 1;
-}
-
-uint32_t cc_crc32c(uint32_t crc, const unsigned char *buf, size_t len) {
-    if (!init_done) init_table();
-    crc = ~crc;
-    for (size_t i = 0; i < len; i++)
-        crc = table[(crc ^ buf[i]) & 0xFF] ^ (crc >> 8);
-    return ~crc;
-}
-"""
-
-_clib = None
-_clib_tried = False
-
-
-def _load_native():
-    """Compile + dlopen the C kernel once per interpreter; any failure
-    (no compiler, read-only tmp) falls back to pure Python silently."""
-    global _clib, _clib_tried
-    if _clib_tried:
-        return _clib
-    _clib_tried = True
-    try:
-        # Per-user 0700 cache dir, ownership-verified before any dlopen: a
-        # world-writable shared path would let another local user plant a
-        # malicious .so under the predictable name.
-        cache = os.path.join(tempfile.gettempdir(),
-                             f"cc_tpu_native_{os.getuid()}")
-        os.makedirs(cache, mode=0o700, exist_ok=True)
-        st = os.stat(cache)
-        if st.st_uid != os.getuid() or st.st_mode & 0o022:
-            cache = tempfile.mkdtemp(prefix="cc_tpu_native_")
-        so_path = os.path.join(cache, "libcccrc32c.so")
-        if not os.path.exists(so_path):
-            with tempfile.NamedTemporaryFile(
-                    "w", suffix=".c", dir=cache, delete=False) as f:
-                f.write(_C_SRC)
-                c_path = f.name
-            subprocess.run(
-                ["cc", "-O2", "-shared", "-fPIC", "-o", so_path, c_path],
-                check=True, capture_output=True, timeout=60)
-            os.unlink(c_path)
-        lib = ctypes.CDLL(so_path)
-        lib.cc_crc32c.restype = ctypes.c_uint32
-        lib.cc_crc32c.argtypes = [ctypes.c_uint32, ctypes.c_char_p,
-                                  ctypes.c_size_t]
-        _clib = lib
-    except Exception:  # noqa: BLE001 — optional acceleration only
-        LOG.debug("native crc32c unavailable; using pure-Python table",
-                  exc_info=True)
-        _clib = None
-    return _clib
-
 
 def crc32c(data: bytes, crc: int = 0) -> int:
-    lib = _load_native()
-    if lib is not None:
-        return lib.cc_crc32c(crc, data, len(data))
+    from ...native import lib
+
+    handle = lib()
+    if handle is not None:
+        return handle.cc_crc32c(crc, data, len(data))
     crc ^= 0xFFFFFFFF
     for b in data:
         crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)

@@ -113,7 +113,41 @@ def decode_batches(data: bytes | memoryview,
                    verify_crc: bool = True) -> list[Record]:
     """Decode a concatenation of record batches (a fetch response's record
     set); a trailing partial batch (broker-side truncation at the fetch
-    byte limit) is dropped, matching client semantics."""
+    byte limit) is dropped, matching client semantics.
+
+    Fast path: the native index parser (native/ccnative.c) does the varint
+    walk in one C pass; Python only slices spans out of the buffer. Falls
+    back to the pure-Python walk below when the native library is
+    unavailable. Both paths are fuzzed against each other
+    (tests/test_native.py)."""
+    from ...native import index_records, lib
+
+    if lib() is not None:
+        # The bytes copy (ctypes needs contiguous bytes) happens ONLY once
+        # the library is known to be loadable — a compiler-less host must
+        # not pay a full record-set copy just to fall through.
+        raw = data if isinstance(data, bytes) else bytes(data)
+        idx = index_records(raw, verify_crc)
+    else:
+        idx = None
+    if idx is not None:
+        out = []
+        mv = memoryview(raw)
+        for off, ts, koff, klen, voff, vlen, hoff, hcount in idx.tolist():
+            key = raw[koff:koff + klen] if koff >= 0 else None
+            value = raw[voff:voff + vlen] if voff >= 0 else None
+            headers: list[tuple[str, bytes]] = []
+            if hcount:
+                hpos = hoff
+                for _ in range(hcount):
+                    hklen, hpos = VarInt.read(raw, hpos)
+                    hk = raw[hpos:hpos + hklen].decode("utf-8")
+                    hpos += hklen
+                    hv, hpos = _read_varbytes(mv, hpos)
+                    headers.append((hk, hv))
+            out.append(Record(offset=off, timestamp_ms=ts, key=key,
+                              value=value, headers=headers))
+        return out
     buf = memoryview(data)
     out: list[Record] = []
     pos = 0
@@ -125,35 +159,44 @@ def decode_batches(data: bytes | memoryview,
         magic = buf[pos + 16]
         if magic != 2:
             raise ValueError(f"unsupported record-batch magic {magic}")
-        (crc,) = struct.unpack_from(">I", buf, pos + _CRC_OFFSET)
-        after = buf[pos + _AFTER_CRC:end]
-        if verify_crc and crc32c(bytes(after)) != crc:
-            raise ValueError(f"record batch CRC mismatch at offset {base}")
-        attrs, _last_delta, base_ts, _max_ts, _pid, _pep, _seq, count = \
-            struct.unpack_from(">hiqqqhii", after, 0)
-        if attrs & 0x07:
-            raise ValueError(f"unsupported compression codec {attrs & 0x07}")
-        rpos = struct.calcsize(">hiqqqhii")
-        for _ in range(count):
-            length, rpos = VarInt.read(after, rpos)
-            rend = rpos + length
-            rpos += 1  # record attributes
-            ts_delta, rpos = VarInt.read(after, rpos)
-            off_delta, rpos = VarInt.read(after, rpos)
-            key, rpos = _read_varbytes(after, rpos)
-            value, rpos = _read_varbytes(after, rpos)
-            n_headers, rpos = VarInt.read(after, rpos)
-            headers = []
-            for _ in range(n_headers):
-                klen, rpos = VarInt.read(after, rpos)
-                hk = bytes(after[rpos:rpos + klen]).decode("utf-8")
-                rpos += klen
-                hv, rpos = _read_varbytes(after, rpos)
-                headers.append((hk, hv))
-            if rpos != rend:
-                raise ValueError("record length mismatch")
-            out.append(Record(offset=base + off_delta,
-                              timestamp_ms=base_ts + ts_delta,
-                              key=key, value=value, headers=headers))
+        try:
+            (crc,) = struct.unpack_from(">I", buf, pos + _CRC_OFFSET)
+            after = buf[pos + _AFTER_CRC:end]
+            if verify_crc and crc32c(bytes(after)) != crc:
+                raise ValueError(
+                    f"record batch CRC mismatch at offset {base}")
+            attrs, _last_delta, base_ts, _max_ts, _pid, _pep, _seq, count = \
+                struct.unpack_from(">hiqqqhii", after, 0)
+            if attrs & 0x07:
+                raise ValueError(
+                    f"unsupported compression codec {attrs & 0x07}")
+            rpos = struct.calcsize(">hiqqqhii")
+            for _ in range(count):
+                length, rpos = VarInt.read(after, rpos)
+                rend = rpos + length
+                rpos += 1  # record attributes
+                ts_delta, rpos = VarInt.read(after, rpos)
+                off_delta, rpos = VarInt.read(after, rpos)
+                key, rpos = _read_varbytes(after, rpos)
+                value, rpos = _read_varbytes(after, rpos)
+                n_headers, rpos = VarInt.read(after, rpos)
+                headers = []
+                for _ in range(n_headers):
+                    klen, rpos = VarInt.read(after, rpos)
+                    hk = bytes(after[rpos:rpos + klen]).decode("utf-8")
+                    rpos += klen
+                    hv, rpos = _read_varbytes(after, rpos)
+                    headers.append((hk, hv))
+                if rpos != rend:
+                    raise ValueError("record length mismatch")
+                out.append(Record(offset=base + off_delta,
+                                  timestamp_ms=base_ts + ts_delta,
+                                  key=key, value=value, headers=headers))
+        except (IndexError, struct.error) as e:
+            # A truncated varint / span in a malformed batch must surface
+            # as the parser's error class, not an internal IndexError
+            # (the native parser returns MALFORMED for the same inputs).
+            raise ValueError(f"malformed record batch at offset {base}: "
+                             f"{e}") from e
         pos = end
     return out
