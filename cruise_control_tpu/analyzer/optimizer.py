@@ -240,6 +240,42 @@ class GoalOptimizer:
                               excluded_replica_move_brokers=rm_mask,
                               excluded_leadership_brokers=ld_mask)
 
+    def _resolve_broker_sets(self, goal_chain: list[Goal],
+                             meta: ClusterMeta) -> list[Goal]:
+        """Bind broker→broker-set ids into any BrokerSetAwareGoal that has
+        none: the configured mapping policy
+        (replica.to.broker.set.mapping.policy.class, called with
+        (config, broker_ids) — BrokerSetResolutionHelper), else the
+        brokerSets.json file resolver (broker.set.config.file)."""
+        from .goals.broker_set import BrokerSetAwareGoal, broker_sets_from_file
+        if not any(isinstance(g, BrokerSetAwareGoal) and not g.broker_sets
+                   for g in goal_chain):
+            return goal_chain
+        sets: tuple[int, ...] | None = None
+        policy = self._config.get("replica.to.broker.set.mapping.policy.class")
+        if policy:
+            cls = resolve_class(policy) if isinstance(policy, str) else policy
+            mapper = cls() if isinstance(cls, type) else cls
+            sets = tuple(mapper(self._config, list(meta.broker_ids)))
+        else:
+            import os
+            path = self._config.get("broker.set.config.file")
+            if path and os.path.exists(path):
+                sets = broker_sets_from_file(path, list(meta.broker_ids))
+        if sets is None:
+            # The operator put BrokerSetAwareGoal in the chain but no
+            # mapping resolves — failing loud beats a vacuous constraint
+            # (empty sets = one implicit cluster-wide set, which would let
+            # replicas cross broker-set boundaries silently).
+            raise ValueError(
+                "BrokerSetAwareGoal is configured but no broker-set mapping "
+                "is available: set replica.to.broker.set.mapping.policy.class "
+                f"or point broker.set.config.file at an existing file "
+                f"(currently {self._config.get('broker.set.config.file')!r})")
+        return [dataclasses.replace(g, broker_sets=sets)
+                if isinstance(g, BrokerSetAwareGoal) and not g.broker_sets
+                else g for g in goal_chain]
+
     def optimizations(self, state: ClusterTensors, meta: ClusterMeta,
                       goals: Sequence[Goal] | None = None,
                       options: OptimizationOptions | None = None,
@@ -251,6 +287,7 @@ class GoalOptimizer:
         options = options or OptimizationOptions()
         goal_chain = list(goals) if goals is not None \
             else goals_by_priority(self._config)
+        goal_chain = self._resolve_broker_sets(goal_chain, meta)
         masks = self._masks(state, meta, options)
         search_cfg = self.search_config(state)
         initial = state

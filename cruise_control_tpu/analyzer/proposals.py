@@ -35,6 +35,10 @@ class ExecutionProposal:
     logdir_broker: int = -1
     source_logdir: str | None = None
     destination_logdir: str | None = None
+    # Partition size (ExecutionProposal.dataToMoveInMB): what each new
+    # replica must copy; feeds throttling decisions and the executor's
+    # movement-rate alerting.
+    data_to_move_mb: float = 0.0
 
     @property
     def is_leadership_only(self) -> bool:
@@ -79,11 +83,14 @@ def diff_proposals(initial: ClusterTensors, final: ClusterTensors,
                    meta: ClusterMeta) -> list[ExecutionProposal]:
     """Set of ExecutionProposals for partitions whose replica set, order, or
     leader changed (AnalyzerUtils.getDiff)."""
+    from ..common.resources import Resource
+
     a0 = np.asarray(initial.assignment)
     a1 = np.asarray(final.assignment)
     l0 = np.asarray(initial.leader_slot)
     l1 = np.asarray(final.leader_slot)
     mask = np.asarray(initial.partition_mask)
+    disk_mb = np.asarray(initial.leader_load[:, int(Resource.DISK)])
 
     changed = ((a0 != a1).any(axis=1) | (l0 != l1)) & mask
     proposals: list[ExecutionProposal] = []
@@ -95,5 +102,6 @@ def diff_proposals(initial: ClusterTensors, final: ClusterTensors,
         topic, pnum = meta.partition_index[p]
         proposals.append(ExecutionProposal(
             topic=topic, partition=pnum, old_leader=old_leader,
-            old_replicas=old_reps, new_replicas=new_reps, new_leader=new_leader))
+            old_replicas=old_reps, new_replicas=new_reps,
+            new_leader=new_leader, data_to_move_mb=float(disk_mb[p])))
     return proposals

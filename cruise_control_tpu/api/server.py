@@ -50,6 +50,17 @@ _SYNC_ENDPOINTS = {
 }
 
 
+# Proposal-executing endpoints gated by request.reason.required (the
+# parameter classes that consult REQUEST_REASON_REQUIRED_CONFIG:
+# Rebalance/AddedOrRemovedBroker/DemoteBroker/FixOfflineReplicas/
+# TopicConfiguration/RemoveDisks Parameters.java).
+_REASON_REQUIRED_ENDPOINTS = {
+    EndPoint.REBALANCE, EndPoint.ADD_BROKER, EndPoint.REMOVE_BROKER,
+    EndPoint.DEMOTE_BROKER, EndPoint.FIX_OFFLINE_REPLICAS,
+    EndPoint.TOPIC_CONFIGURATION, EndPoint.REMOVE_DISKS,
+}
+
+
 class ApiError(Exception):
     def __init__(self, status: int, message: str):
         super().__init__(message)
@@ -72,6 +83,15 @@ class CruiseControlApi:
         self._two_step = cfg.get_boolean("two.step.verification.enabled")
         self._purgatory = Purgatory(
             retention_ms=cfg.get_long("two.step.purgatory.retention.time.ms"))
+        from .user_tasks import CC_ADMIN, CC_MONITOR, KAFKA_ADMIN, KAFKA_MONITOR
+        retention_overrides = {
+            cls: cfg.get_long(key)
+            for cls, key in (
+                (KAFKA_MONITOR, "completed.kafka.monitor.user.task.retention.time.ms"),
+                (KAFKA_ADMIN, "completed.kafka.admin.user.task.retention.time.ms"),
+                (CC_MONITOR, "completed.cruise.control.monitor.user.task.retention.time.ms"),
+                (CC_ADMIN, "completed.cruise.control.admin.user.task.retention.time.ms"))
+            if cfg.get(key) is not None}
         self._tasks = UserTaskManager(
             max_active_tasks=cfg.get_int("max.active.user.tasks"),
             completed_retention_ms=cfg.get_long(
@@ -81,9 +101,15 @@ class CruiseControlApi:
             max_cached_completed_admin_tasks=cfg.get_int(
                 "max.cached.completed.kafka.admin.user.tasks"),
             max_cached_completed_tasks=cfg.get_int(
-                "max.cached.completed.user.tasks"))
+                "max.cached.completed.user.tasks"),
+            max_cached_completed_cc_monitor_tasks=cfg.get_int(
+                "max.cached.completed.cruise.control.monitor.user.tasks"),
+            max_cached_completed_cc_admin_tasks=cfg.get_int(
+                "max.cached.completed.cruise.control.admin.user.tasks"),
+            retention_ms_by_class=retention_overrides)
         self._async_wait_s = cfg.get_long(
             "webserver.request.maxBlockTimeMs") / 1000.0
+        self._reason_required = cfg.get_boolean("request.reason.required")
 
     @staticmethod
     def _configured_security(cfg: CruiseControlConfig) -> SecurityProvider:
@@ -159,6 +185,11 @@ class CruiseControlApi:
             self._security.authorize(principal, endpoint)
             query = urllib.parse.parse_qs(query_string, keep_blank_values=True)
             params = self._parse(endpoint, query)
+            if self._reason_required and endpoint in _REASON_REQUIRED_ENDPOINTS \
+                    and not params.get("reason"):
+                raise ParameterParseError(
+                    f"{endpoint.name} requires a reason parameter "
+                    "(request.reason.required=true)")
             review_id = params.pop("review_id", None)
             if self._two_step and endpoint in REVIEWABLE_ENDPOINTS:
                 if review_id is None:
@@ -577,6 +608,17 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(content)
 
     def _serve(self, method: str) -> None:
+        cfg0 = self.api._config
+        header_bytes = sum(len(k) + len(v) for k, v in self.headers.items())
+        if header_bytes > cfg0.get_int("webserver.http.header.size"):
+            data = json.dumps({"errorMessage": "request headers too "
+                               "large"}).encode()
+            self.send_response(431)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
         parsed = urllib.parse.urlparse(self.path)
         scrape_paths = {"/metrics": "metrics", URL_PREFIX + "/metrics": "metrics",
                         "/openapi": "openapi", URL_PREFIX + "/openapi": "openapi"}
@@ -620,6 +662,13 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
         cfg = self.api._config
+        if cfg.get_boolean("webserver.ssl.enable") and \
+                cfg.get_boolean("webserver.ssl.sts.enabled"):
+            # webserver.ssl.sts.* (WebServerConfig HSTS surface).
+            sts = f"max-age={cfg.get_long('webserver.ssl.sts.max.age')}"
+            if cfg.get_boolean("webserver.ssl.sts.include.subdomains"):
+                sts += "; includeSubDomains"
+            self.send_header("Strict-Transport-Security", sts)
         if cfg.get_boolean("webserver.http.cors.enabled"):
             # webserver.http.cors.* (WebServerConfig CORS surface).
             self.send_header("Access-Control-Allow-Origin",

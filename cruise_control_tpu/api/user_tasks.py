@@ -20,11 +20,29 @@ from ..utils.progress import OperationProgress, set_current
 
 USER_TASK_HEADER = "User-Task-ID"
 
-# Endpoint-class split (UserTaskManager.TaskState caches): read-only
-# monitor endpoints vs state-changing admin endpoints.
-_MONITOR_ENDPOINTS = {"LOAD", "PARTITION_LOAD", "PROPOSALS", "STATE",
-                      "KAFKA_CLUSTER_STATE", "USER_TASKS", "REVIEW_BOARD",
-                      "PERMISSIONS"}
+# Endpoint-class split (UserTaskManager.TaskState caches): the reference
+# keeps FOUR completed-task caches — Kafka-facing vs Cruise-Control-facing,
+# each split monitor (read-only) vs admin (state-changing).
+KAFKA_MONITOR = "KAFKA_MONITOR"
+KAFKA_ADMIN = "KAFKA_ADMIN"
+CC_MONITOR = "CC_MONITOR"
+CC_ADMIN = "CC_ADMIN"
+
+_ENDPOINT_CLASS = {
+    "LOAD": KAFKA_MONITOR, "PARTITION_LOAD": KAFKA_MONITOR,
+    "PROPOSALS": KAFKA_MONITOR, "KAFKA_CLUSTER_STATE": KAFKA_MONITOR,
+    "STATE": CC_MONITOR, "USER_TASKS": CC_MONITOR,
+    "REVIEW_BOARD": CC_MONITOR, "PERMISSIONS": CC_MONITOR,
+    "ADMIN": CC_ADMIN, "REVIEW": CC_ADMIN, "PAUSE_SAMPLING": CC_ADMIN,
+    "RESUME_SAMPLING": CC_ADMIN, "STOP_PROPOSAL_EXECUTION": CC_ADMIN,
+    "RIGHTSIZE": CC_ADMIN, "BOOTSTRAP": CC_ADMIN, "TRAIN": CC_ADMIN,
+}
+
+
+def task_class(endpoint: str) -> str:
+    """Cluster-changing endpoints (rebalance, add/remove/demote broker,
+    fix-offline, RF change, remove-disks) default to KAFKA_ADMIN."""
+    return _ENDPOINT_CLASS.get(endpoint, KAFKA_ADMIN)
 
 
 class TooManyUserTasksError(RuntimeError):
@@ -60,8 +78,8 @@ class UserTaskInfo:
         return "CompletedWithError" if self.future.exception() else "Completed"
 
     @property
-    def is_monitor_task(self) -> bool:
-        return self.endpoint in _MONITOR_ENDPOINTS
+    def task_class(self) -> str:
+        return task_class(self.endpoint)
 
     def to_dict(self) -> dict:
         out = {"UserTaskId": self.task_id,
@@ -79,13 +97,30 @@ class UserTaskManager:
                  num_threads: int = 8,
                  max_cached_completed_monitor_tasks: int = 20,
                  max_cached_completed_admin_tasks: int = 30,
-                 max_cached_completed_tasks: int = 100):
+                 max_cached_completed_tasks: int = 100,
+                 max_cached_completed_cc_monitor_tasks: int | None = None,
+                 max_cached_completed_cc_admin_tasks: int | None = None,
+                 retention_ms_by_class: dict | None = None):
+        """The monitor/admin caps apply to the Kafka-facing classes; the
+        Cruise-Control-facing classes default to the same caps unless given
+        their own (max.cached.completed.cruise.control.*.user.tasks).
+        ``retention_ms_by_class`` overrides the default retention per task
+        class (completed.<class>.user.task.retention.time.ms)."""
         self._lock = threading.Lock()
         self._tasks: dict[str, UserTaskInfo] = {}
         self._max_active = max_active_tasks
         self._retention_ms = completed_retention_ms
-        self._max_completed = {True: max_cached_completed_monitor_tasks,
-                               False: max_cached_completed_admin_tasks}
+        self._max_completed = {
+            KAFKA_MONITOR: max_cached_completed_monitor_tasks,
+            KAFKA_ADMIN: max_cached_completed_admin_tasks,
+            CC_MONITOR: (max_cached_completed_cc_monitor_tasks
+                         if max_cached_completed_cc_monitor_tasks is not None
+                         else max_cached_completed_monitor_tasks),
+            CC_ADMIN: (max_cached_completed_cc_admin_tasks
+                       if max_cached_completed_cc_admin_tasks is not None
+                       else max_cached_completed_admin_tasks),
+        }
+        self._retention_by_class = dict(retention_ms_by_class or {})
         self._max_completed_total = max_cached_completed_tasks
         self._pool = ThreadPoolExecutor(max_workers=num_threads,
                                         thread_name_prefix="user-task")
@@ -97,16 +132,16 @@ class UserTaskManager:
         now = int(time.time() * 1000)
         for tid in [t for t, info in self._tasks.items()
                     if info.future.done()
-                    and now - info.start_ms > self._retention_ms]:
+                    and now - info.start_ms > self._retention_by_class.get(
+                        info.task_class, self._retention_ms)]:
             del self._tasks[tid]
         # Per-endpoint-class completed caches: keep the newest N completed
-        # monitor-type and admin-type tasks (UserTaskManager.java:69-138).
-        for is_monitor in (True, False):
+        # tasks of each of the four classes (UserTaskManager.java:69-138).
+        for cls, cap in self._max_completed.items():
             done = sorted((t for t in self._tasks.values()
-                           if t.future.done()
-                           and t.is_monitor_task == is_monitor),
+                           if t.future.done() and t.task_class == cls),
                           key=lambda t: -t.start_ms)
-            for info in done[self._max_completed[is_monitor]:]:
+            for info in done[cap:]:
                 del self._tasks[info.task_id]
         # Overall completed bound on top of the per-class caches
         # (max.cached.completed.user.tasks).

@@ -202,10 +202,73 @@ def _definition() -> ConfigDef:
              True, None, I.LOW, "Adjust inter-broker movement caps.")
     d.define("concurrency.adjuster.leadership.enabled", T.BOOLEAN, True, None,
              I.LOW, "Adjust leadership movement caps.")
-    d.define("concurrency.adjuster.max.leadership.movements", T.INT, 1_000,
-             Range.at_least(1), I.LOW, "Adjuster ceiling for leadership.")
+    d.define("concurrency.adjuster.max.leadership.movements", T.INT, 1_100,
+             Range.at_least(1), I.LOW, "Adjuster ceiling for cluster "
+             "leadership movements (ExecutorConfig.java:350).")
     d.define("concurrency.adjuster.min.leadership.movements", T.INT, 100,
              Range.at_least(1), I.LOW, "Adjuster floor for leadership.")
+    # AIMD tuning surface (ExecutorConfig.java:340-583).
+    d.define("concurrency.adjuster.additive.increase.inter.broker.replica",
+             T.INT, 1, Range.at_least(1), I.LOW,
+             "Per-tick additive increase of the per-broker inter-broker "
+             "movement cap while the cluster is healthy.")
+    d.define("concurrency.adjuster.additive.increase.leadership", T.INT, 100,
+             Range.at_least(1), I.LOW,
+             "Per-tick additive increase of the cluster leadership cap.")
+    d.define("concurrency.adjuster.additive.increase.leadership.per.broker",
+             T.INT, 25, Range.at_least(1), I.LOW,
+             "Per-tick additive increase of the per-broker leadership cap.")
+    d.define("concurrency.adjuster.multiplicative.decrease.inter.broker.replica",
+             T.DOUBLE, 2.0, Range.at_least(1), I.LOW,
+             "Divisor applied to the inter-broker cap under min-ISR or "
+             "metric-limit pressure.")
+    d.define("concurrency.adjuster.multiplicative.decrease.leadership",
+             T.DOUBLE, 2.0, Range.at_least(1), I.LOW,
+             "Divisor applied to the cluster leadership cap under pressure.")
+    d.define("concurrency.adjuster.multiplicative.decrease.leadership.per.broker",
+             T.DOUBLE, 2.0, Range.at_least(1), I.LOW,
+             "Divisor applied to the per-broker leadership cap under "
+             "pressure.")
+    d.define("concurrency.adjuster.min.partition.movements.per.broker", T.INT,
+             1, Range.at_least(1), I.LOW,
+             "Adjuster floor for per-broker inter-broker movements.")
+    d.define("concurrency.adjuster.max.partition.movements.per.broker", T.INT,
+             12, Range.at_least(1), I.LOW,
+             "Adjuster ceiling for per-broker inter-broker movements.")
+    d.define("concurrency.adjuster.min.leadership.movements.per.broker",
+             T.INT, 25, Range.at_least(1), I.LOW,
+             "Adjuster floor for per-broker leadership movements.")
+    d.define("concurrency.adjuster.max.leadership.movements.per.broker",
+             T.INT, 500, Range.at_least(1), I.LOW,
+             "Adjuster ceiling for per-broker leadership movements.")
+    d.define("concurrency.adjuster.leadership.per.broker.enabled", T.BOOLEAN,
+             False, None, I.LOW,
+             "Adjust the per-broker leadership cap too.")
+    d.define("concurrency.adjuster.limit.log.flush.time.ms", T.DOUBLE, 2000.0,
+             Range.at_least(0), I.LOW,
+             "Broker log-flush p999 above this counts as a metric-limit "
+             "violation.")
+    d.define("concurrency.adjuster.limit.follower.fetch.local.time.ms",
+             T.DOUBLE, 500.0, Range.at_least(0), I.LOW,
+             "Follower-fetch local-time p999 limit.")
+    d.define("concurrency.adjuster.limit.produce.local.time.ms", T.DOUBLE,
+             1000.0, Range.at_least(0), I.LOW,
+             "Produce local-time p999 limit.")
+    d.define("concurrency.adjuster.limit.consumer.fetch.local.time.ms",
+             T.DOUBLE, 500.0, Range.at_least(0), I.LOW,
+             "Consumer-fetch local-time p999 limit.")
+    d.define("concurrency.adjuster.limit.request.queue.size", T.DOUBLE,
+             1000.0, Range.at_least(0), I.LOW,
+             "Request-queue size limit.")
+    d.define("min.num.brokers.violate.metric.limit.to.decrease.cluster.concurrency",
+             T.INT, 2, Range.at_least(1), I.LOW,
+             "Brokers that must exceed a metric limit before the adjuster "
+             "decreases concurrency.")
+    d.define("concurrency.adjuster.num.min.isr.check", T.INT, 5,
+             Range.at_least(1), I.LOW,
+             "Recent adjuster ticks whose (At/Under)MinISR observations "
+             "stay sticky: pressure seen in ANY of the last N checks keeps "
+             "the decrease signal active.")
     d.define("num.concurrent.leader.movements.per.broker", T.INT, 250,
              Range.at_least(1), I.MEDIUM,
              "Per-broker bound on leadership movements per batch.")
@@ -484,6 +547,71 @@ def _definition() -> ConfigDef:
     d.define("max.cached.completed.kafka.admin.user.tasks", T.INT, 30,
              Range.at_least(1), I.LOW,
              "Per-endpoint-class retention: admin-type tasks.")
+    d.define("max.cached.completed.cruise.control.monitor.user.tasks", T.INT,
+             20, Range.at_least(1), I.LOW,
+             "Per-endpoint-class retention: Cruise-Control-monitor tasks "
+             "(STATE, USER_TASKS, REVIEW_BOARD, PERMISSIONS).")
+    d.define("max.cached.completed.cruise.control.admin.user.tasks", T.INT,
+             30, Range.at_least(1), I.LOW,
+             "Per-endpoint-class retention: Cruise-Control-admin tasks "
+             "(ADMIN, REVIEW, PAUSE/RESUME_SAMPLING, STOP, RIGHTSIZE).")
+    d.define("completed.kafka.monitor.user.task.retention.time.ms", T.LONG,
+             None, None, I.LOW,
+             "Retention override for Kafka-monitor tasks (None = the "
+             "completed.user.task.retention.time.ms default).")
+    d.define("completed.kafka.admin.user.task.retention.time.ms", T.LONG,
+             None, None, I.LOW,
+             "Retention override for Kafka-admin tasks.")
+    d.define("completed.cruise.control.monitor.user.task.retention.time.ms",
+             T.LONG, None, None, I.LOW,
+             "Retention override for Cruise-Control-monitor tasks.")
+    d.define("completed.cruise.control.admin.user.task.retention.time.ms",
+             T.LONG, None, None, I.LOW,
+             "Retention override for Cruise-Control-admin tasks.")
+    d.define("request.reason.required", T.BOOLEAN, False, None, I.LOW,
+             "Require a non-empty reason parameter on proposal-executing "
+             "POST endpoints (ExecutorConfig.REQUEST_REASON_REQUIRED).")
+    d.define("webserver.http.header.size", T.INT, 65_536, Range.at_least(1),
+             I.LOW, "Reject requests whose combined header bytes exceed "
+             "this (431).")
+    d.define("webserver.ssl.sts.enabled", T.BOOLEAN, False, None, I.LOW,
+             "Send Strict-Transport-Security on HTTPS responses.")
+    d.define("webserver.ssl.sts.include.subdomains", T.BOOLEAN, True, None,
+             I.LOW, "includeSubDomains on the STS header.")
+    d.define("webserver.ssl.sts.max.age", T.LONG, 31_536_000,
+             Range.at_least(0), I.LOW, "STS max-age seconds.")
+    d.define("provisioner.enable", T.BOOLEAN, True, None, I.LOW,
+             "Right-sizing provisioner on/off: when disabled, RIGHTSIZE "
+             "requests are refused and provision recommendations are not "
+             "acted on (AnomalyDetectorConfig.PROVISIONER_ENABLE).")
+    d.define("partition.metric.sample.aggregator.completeness.cache.size",
+             T.INT, 5, Range.at_least(1), I.LOW,
+             "Aggregation/completeness result cache entries kept on the "
+             "partition aggregator (MonitorConfig).")
+    d.define("broker.metric.sample.aggregator.completeness.cache.size",
+             T.INT, 5, Range.at_least(1), I.LOW,
+             "Aggregation/completeness result cache entries kept on the "
+             "broker aggregator.")
+    d.define("linear.regression.model.min.num.cpu.util.buckets", T.INT, 5,
+             Range.at_least(1), I.LOW,
+             "CPU-utilization buckets that must hold enough samples before "
+             "the linear CPU model trains.")
+    d.define("linear.regression.model.required.samples.per.bucket", T.INT,
+             100, Range.at_least(1), I.LOW,
+             "Samples a bucket needs before it counts toward training "
+             "completeness (MonitorConfig default 100).")
+    d.define("replica.to.broker.set.mapping.policy.class", T.CLASS, None,
+             None, I.LOW,
+             "Pluggable broker→broker-set mapping (default: the "
+             "brokerSets.json file resolver; BrokerSetResolutionHelper).")
+    d.define("inter.broker.replica.movement.rate.alerting.threshold",
+             T.DOUBLE, 0.1, Range.at_least(0), I.LOW,
+             "Alert when an execution's average inter-broker data movement "
+             "rate (MB/s) falls below this.")
+    d.define("intra.broker.replica.movement.rate.alerting.threshold",
+             T.DOUBLE, 0.2, Range.at_least(0), I.LOW,
+             "Alert when an execution's average intra-broker data movement "
+             "rate (MB/s) falls below this.")
     d.define("webserver.request.maxBlockTimeMs", T.LONG, 10_000,
              Range.at_least(0), I.LOW,
              "How long a request blocks inline before returning 202 + "

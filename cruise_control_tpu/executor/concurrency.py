@@ -28,28 +28,128 @@ class ConcurrencyCaps:
     leadership_per_broker: int = 250
 
 
+@dataclasses.dataclass(frozen=True)
+class ConcurrencyAdjusterConfig:
+    """The adjuster's tuning surface (ExecutorConfig.java:340-583 —
+    AIMD per concurrency type: additive increase while healthy,
+    multiplicative decrease under (At/Under)MinISR pressure or broker
+    metric-limit violations, clamped to [min, max])."""
+
+    additive_increase_inter_broker: int = 1
+    additive_increase_leadership: int = 100
+    additive_increase_leadership_per_broker: int = 25
+    multiplicative_decrease_inter_broker: float = 2.0
+    multiplicative_decrease_leadership: float = 2.0
+    multiplicative_decrease_leadership_per_broker: float = 2.0
+    min_partition_movements_per_broker: int = 1
+    max_partition_movements_per_broker: int = 12
+    min_leadership_movements: int = 100
+    max_leadership_movements: int = 1100
+    min_leadership_movements_per_broker: int = 25
+    max_leadership_movements_per_broker: int = 500
+    leadership_per_broker_enabled: bool = False
+    limit_log_flush_time_ms: float = 2000.0
+    limit_follower_fetch_local_time_ms: float = 500.0
+    limit_produce_local_time_ms: float = 1000.0
+    limit_consumer_fetch_local_time_ms: float = 500.0
+    limit_request_queue_size: float = 1000.0
+    min_brokers_violate_metric_limit: int = 2
+    num_min_isr_check: int = 5
+
+    # metric-name → limit-field mapping (KafkaMetricDef BrokerMetric names;
+    # ConcurrencyAdjuster's CONCURRENCY_ADJUSTER_METRICS).
+    LIMIT_METRICS = (
+        ("BROKER_LOG_FLUSH_TIME_MS_999TH", "limit_log_flush_time_ms"),
+        ("BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_999TH",
+         "limit_follower_fetch_local_time_ms"),
+        ("BROKER_PRODUCE_LOCAL_TIME_MS_999TH", "limit_produce_local_time_ms"),
+        ("BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_999TH",
+         "limit_consumer_fetch_local_time_ms"),
+        ("BROKER_REQUEST_QUEUE_SIZE", "limit_request_queue_size"),
+    )
+
+    @classmethod
+    def from_config(cls, cfg) -> "ConcurrencyAdjusterConfig":
+        g = cfg.get_int
+        return cls(
+            additive_increase_inter_broker=g(
+                "concurrency.adjuster.additive.increase.inter.broker.replica"),
+            additive_increase_leadership=g(
+                "concurrency.adjuster.additive.increase.leadership"),
+            additive_increase_leadership_per_broker=g(
+                "concurrency.adjuster.additive.increase.leadership.per.broker"),
+            multiplicative_decrease_inter_broker=cfg.get_double(
+                "concurrency.adjuster.multiplicative.decrease.inter.broker.replica"),
+            multiplicative_decrease_leadership=cfg.get_double(
+                "concurrency.adjuster.multiplicative.decrease.leadership"),
+            multiplicative_decrease_leadership_per_broker=cfg.get_double(
+                "concurrency.adjuster.multiplicative.decrease.leadership.per.broker"),
+            min_partition_movements_per_broker=g(
+                "concurrency.adjuster.min.partition.movements.per.broker"),
+            max_partition_movements_per_broker=g(
+                "concurrency.adjuster.max.partition.movements.per.broker"),
+            min_leadership_movements=g(
+                "concurrency.adjuster.min.leadership.movements"),
+            max_leadership_movements=g(
+                "concurrency.adjuster.max.leadership.movements"),
+            min_leadership_movements_per_broker=g(
+                "concurrency.adjuster.min.leadership.movements.per.broker"),
+            max_leadership_movements_per_broker=g(
+                "concurrency.adjuster.max.leadership.movements.per.broker"),
+            leadership_per_broker_enabled=cfg.get_boolean(
+                "concurrency.adjuster.leadership.per.broker.enabled"),
+            limit_log_flush_time_ms=cfg.get_double(
+                "concurrency.adjuster.limit.log.flush.time.ms"),
+            limit_follower_fetch_local_time_ms=cfg.get_double(
+                "concurrency.adjuster.limit.follower.fetch.local.time.ms"),
+            limit_produce_local_time_ms=cfg.get_double(
+                "concurrency.adjuster.limit.produce.local.time.ms"),
+            limit_consumer_fetch_local_time_ms=cfg.get_double(
+                "concurrency.adjuster.limit.consumer.fetch.local.time.ms"),
+            limit_request_queue_size=cfg.get_double(
+                "concurrency.adjuster.limit.request.queue.size"),
+            min_brokers_violate_metric_limit=g(
+                "min.num.brokers.violate.metric.limit.to.decrease.cluster.concurrency"),
+            num_min_isr_check=g("concurrency.adjuster.num.min.isr.check"),
+        )
+
+    def brokers_violating_limits(self, broker_metrics) -> int:
+        """#brokers whose latest metrics exceed ANY adjuster limit
+        (withinConcurrencyAdjusterLimit, Executor.java:465-683).
+        ``broker_metrics``: {broker_id: {metric_name: value}}."""
+        n = 0
+        for metrics in (broker_metrics or {}).values():
+            for name, field in self.LIMIT_METRICS:
+                v = metrics.get(name)
+                if v is not None and v > getattr(self, field):
+                    n += 1
+                    break
+        return n
+
+
 class ExecutionConcurrencyManager:
     """Tracks caps + in-flight counts; thread-safe
     (ExecutionConcurrencyManager.java)."""
-
-    # Adjuster bounds (ConcurrencyAdjuster MIN/MAX constants).
-    MIN_INTER_BROKER = 1
-    MAX_INTER_BROKER_MULTIPLIER = 2
-    MIN_LEADERSHIP = 100
 
     # ConcurrencyType names accepted by the ADMIN endpoint's
     # (en|dis)able_concurrency_adjuster_for toggles (ConcurrencyType.java).
     ADJUSTER_TYPES = ("INTER_BROKER_REPLICA", "INTRA_BROKER_REPLICA",
                       "LEADERSHIP")
 
-    def __init__(self, caps: ConcurrencyCaps | None = None):
+    def __init__(self, caps: ConcurrencyCaps | None = None,
+                 adjuster: ConcurrencyAdjusterConfig | None = None):
         self._caps = caps or ConcurrencyCaps()
         self._base = dataclasses.replace(self._caps)
+        self._adj = adjuster or ConcurrencyAdjusterConfig()
         self._lock = threading.Lock()
         self._inter_in_flight: dict[int, int] = {}   # broker -> count
         self._cluster_inter_in_flight = 0
         self._adjuster_enabled = {t: True for t in self.ADJUSTER_TYPES}
         self._min_isr_based_adjustment = True
+
+    @property
+    def adjuster_config(self) -> ConcurrencyAdjusterConfig:
+        return self._adj
 
     # ---- capacity queries -------------------------------------------------
     def inter_broker_headroom(self, broker: int) -> int:
@@ -96,44 +196,68 @@ class ExecutionConcurrencyManager:
 
     # ---- adaptive adjustment (ConcurrencyAdjuster) ------------------------
     def adjust(self, cluster_healthy: bool, has_under_min_isr: bool,
-               frozen: frozenset[str] = frozenset()) -> None:
-        """One adjuster tick: halve inter-broker concurrency under min-ISR
-        pressure, step up toward 2× base when healthy
-        (Executor.java:465-683). ``frozen`` names ConcurrencyCaps fields
-        carrying a per-execution OPERATOR override — those dimensions are
-        left alone (the reference skips user-requested dimensions); all
-        others keep adjusting, including the min-ISR safety step-down."""
+               frozen: frozenset[str] = frozenset(),
+               brokers_violating_metric_limits: int = 0) -> None:
+        """One AIMD adjuster tick (Executor.java:465-683): multiplicative
+        decrease under (At/Under)MinISR pressure OR when at least
+        ``min.num.brokers.violate.metric.limit...`` brokers exceed a broker
+        metric limit; additive increase toward the max cap while healthy.
+        ``frozen`` names ConcurrencyCaps fields carrying a per-execution
+        OPERATOR override — those dimensions are left alone (the reference
+        skips user-requested dimensions); all others keep adjusting,
+        including the safety step-down."""
+        adj = self._adj
         with self._lock:
             if not self._min_isr_based_adjustment:
                 # ADMIN min_isr_based_concurrency_adjustment=false: the
                 # adjuster ignores (At/Under)MinISR pressure entirely
                 # (Executor.java min.isr-based adjustment toggle).
                 has_under_min_isr = False
+            decrease = has_under_min_isr or (
+                brokers_violating_metric_limits
+                >= adj.min_brokers_violate_metric_limit)
             if not self._adjuster_enabled["INTER_BROKER_REPLICA"]:
                 frozen = frozen | {"inter_broker_per_broker"}
             if not self._adjuster_enabled["LEADERSHIP"]:
-                frozen = frozen | {"leadership_cluster"}
-            if "inter_broker_per_broker" not in frozen:
-                cap = self._caps.inter_broker_per_broker
-                if has_under_min_isr:
-                    cap = max(self.MIN_INTER_BROKER, cap // 2)
-                elif cluster_healthy:
-                    cap = min(self._base.inter_broker_per_broker
-                              * self.MAX_INTER_BROKER_MULTIPLIER, cap + 1)
-                # Unhealthy WITHOUT min-ISR pressure (e.g. offline replicas
-                # mid-drain — the very workload self-healing is executing)
-                # HOLDS the cap: decrementing here would decay recovery
-                # throughput to the minimum for the whole execution, since
-                # health only returns once recovery finishes.
-                self._caps.inter_broker_per_broker = cap
+                frozen = frozen | {"leadership_cluster",
+                                   "leadership_per_broker"}
+            if not adj.leadership_per_broker_enabled:
+                frozen = frozen | {"leadership_per_broker"}
 
+            def aimd(cap, dec, add, lo, hi):
+                if decrease:
+                    return max(lo, int(cap / dec))
+                if cluster_healthy:
+                    return min(hi, cap + add)
+                # Unhealthy WITHOUT decrease pressure (e.g. offline
+                # replicas mid-drain — the very workload self-healing is
+                # executing) HOLDS the cap: decrementing here would decay
+                # recovery throughput to the minimum for the whole
+                # execution, since health only returns once recovery
+                # finishes.
+                return cap
+
+            if "inter_broker_per_broker" not in frozen:
+                self._caps.inter_broker_per_broker = aimd(
+                    self._caps.inter_broker_per_broker,
+                    adj.multiplicative_decrease_inter_broker,
+                    adj.additive_increase_inter_broker,
+                    adj.min_partition_movements_per_broker,
+                    adj.max_partition_movements_per_broker)
             if "leadership_cluster" not in frozen:
-                lcap = self._caps.leadership_cluster
-                if has_under_min_isr:
-                    lcap = max(self.MIN_LEADERSHIP, lcap // 2)
-                elif cluster_healthy:
-                    lcap = min(self._base.leadership_cluster, lcap + 100)
-                self._caps.leadership_cluster = lcap
+                self._caps.leadership_cluster = aimd(
+                    self._caps.leadership_cluster,
+                    adj.multiplicative_decrease_leadership,
+                    adj.additive_increase_leadership,
+                    adj.min_leadership_movements,
+                    adj.max_leadership_movements)
+            if "leadership_per_broker" not in frozen:
+                self._caps.leadership_per_broker = aimd(
+                    self._caps.leadership_per_broker,
+                    adj.multiplicative_decrease_leadership_per_broker,
+                    adj.additive_increase_leadership_per_broker,
+                    adj.min_leadership_movements_per_broker,
+                    adj.max_leadership_movements_per_broker)
 
     def set_adjuster_enabled(self, concurrency_type: str,
                              enabled: bool) -> bool:

@@ -65,16 +65,35 @@ class Executor:
                  synchronous: bool = False,
                  notifier: ExecutorNotifier | None = None,
                  adjuster_enabled: bool = True,
-                 adjuster_interval_s: float = 1.0):
+                 adjuster_interval_s: float = 1.0,
+                 adjuster_config: "ConcurrencyAdjusterConfig | None" = None,
+                 broker_metrics_supplier: Callable[[], dict] | None = None,
+                 inter_rate_alert_mb_s: float = 0.0,
+                 intra_rate_alert_mb_s: float = 0.0):
         self._admin = admin
-        self._concurrency = ExecutionConcurrencyManager(caps)
+        self._concurrency = ExecutionConcurrencyManager(caps, adjuster_config)
         # ConcurrencyAdjuster (Executor.java:465-683): every interval the
-        # poll loop re-evaluates broker health and (At/Under)MinISR state
-        # from live metadata and re-tunes the caps.
+        # poll loop re-evaluates broker health, (At/Under)MinISR state, and
+        # broker metric limits (via ``broker_metrics_supplier``, typically
+        # the LoadMonitor's latest broker window) and re-tunes the caps.
         self._adjuster_enabled = adjuster_enabled
         self._adjuster_interval_s = adjuster_interval_s
         self._min_isr_cache = TopicMinIsrCache()
         self._last_adjust = 0.0
+        self._broker_metrics_supplier = broker_metrics_supplier
+        # Sticky min-ISR window (concurrency.adjuster.num.min.isr.check):
+        # pressure seen in ANY of the last N ticks keeps the decrease
+        # signal active, so a transiently-recovered ISR doesn't bounce
+        # concurrency straight back up.
+        from collections import deque
+        n_checks = (adjuster_config.num_min_isr_check
+                    if adjuster_config else 5)
+        self._min_isr_window: deque[bool] = deque(maxlen=max(1, n_checks))
+        # (inter|intra).broker.replica.movement.rate.alerting.threshold:
+        # a finished execution whose average data movement rate fell below
+        # these MB/s marks is reported as slow (0 = disabled).
+        self._inter_rate_alert = inter_rate_alert_mb_s
+        self._intra_rate_alert = intra_rate_alert_mb_s
         self._strategy = strategy
         self._interval = progress_check_interval_s
         self._task_timeout_s = task_timeout_s
@@ -133,6 +152,9 @@ class Executor:
                 self._admin.cancel_partition_reassignments(external)
             self._state = ExecutorState.STARTING_EXECUTION
             self._stop_requested.clear()
+            # Stale pressure from a PREVIOUS execution must not suppress
+            # this one's starting concurrency.
+            self._min_isr_window.clear()
             self._uuid = uuid
             if concurrency_overrides:
                 self._caps_snapshot = self._concurrency.snapshot()
@@ -233,6 +255,7 @@ class Executor:
             "durationS": round(time.time() - t0, 3),
             "taskCounts": tm.tracker.counts() if tm else {},
         }
+        self._check_movement_rate(summary)
         self._history.append(summary)
         # Execution sensors (Executor.java:145-148,346).
         from ..utils.sensors import SENSORS
@@ -261,6 +284,41 @@ class Executor:
 
             logging.getLogger(__name__).warning(
                 "executor notifier failed", exc_info=True)
+
+    def _check_movement_rate(self, summary: dict) -> None:
+        """Slow-execution alerting ((inter|intra).broker.replica.movement.
+        rate.alerting.threshold): average MB/s of completed replica moves
+        below the threshold is recorded in the summary and counted as a
+        sensor — operators watch for stuck/throttled executions."""
+        tm = self._task_manager
+        duration = summary.get("durationS") or 0
+        if tm is None or duration <= 0:
+            return
+        from ..utils.sensors import SENSORS
+        for task_type, threshold, key in (
+                (TaskType.INTER_BROKER_REPLICA_ACTION,
+                 self._inter_rate_alert, "interBroker"),
+                (TaskType.INTRA_BROKER_REPLICA_ACTION,
+                 self._intra_rate_alert, "intraBroker")):
+            if threshold <= 0:
+                continue
+            moved_mb = sum(
+                t.proposal.data_to_move_mb
+                * max(1, len(t.proposal.replicas_to_add))
+                for t in tm.tracker.tasks_in(task_type, TaskState.COMPLETED))
+            if moved_mb <= 0:
+                continue
+            rate = moved_mb / duration
+            summary[f"{key}MovementRateMBps"] = round(rate, 3)
+            if rate < threshold:
+                summary[f"{key}MovementRateSlow"] = True
+                SENSORS.count("executor_slow_movement_rate",
+                              labels={"type": task_type.value})
+                import logging
+                logging.getLogger(__name__).warning(
+                    "%s movement rate %.3f MB/s below alerting threshold "
+                    "%.3f MB/s (execution %s)", key, rate, threshold,
+                    self._uuid)
 
     def stop_execution(self) -> None:
         """User-triggered stop (Executor.userTriggeredStopExecution:1139):
@@ -433,11 +491,25 @@ class Executor:
         min_isr = self._min_isr_cache.min_isr_by_topic(
             self._admin, {p.topic for p in parts.values()})
         healthy, under = cluster_isr_state(parts, alive, min_isr)
+        self._min_isr_window.append(under)
+        sticky_under = any(self._min_isr_window)
+        # Broker metric limits (Executor.java:465-683): latest broker
+        # metrics from the monitor, counted against the adjuster's limits.
+        violating = 0
+        if self._broker_metrics_supplier is not None:
+            try:
+                violating = self._concurrency.adjuster_config \
+                    .brokers_violating_limits(self._broker_metrics_supplier())
+            except Exception:  # noqa: BLE001 — metrics are advisory
+                import logging
+                logging.getLogger(__name__).warning(
+                    "broker metrics supplier failed", exc_info=True)
         # Dimensions carrying a per-execution OPERATOR override are frozen
         # (the reference skips user-requested dimensions); the others —
         # including the min-ISR safety step-down — keep adjusting.
-        self._concurrency.adjust(healthy, under,
-                                 frozen=frozenset(self._override_dims))
+        self._concurrency.adjust(healthy, sticky_under,
+                                 frozen=frozenset(self._override_dims),
+                                 brokers_violating_metric_limits=violating)
 
     def _poll_inter_broker(self, in_flight: list[ExecutionTask]) -> None:
         """waitForInterBrokerReplicaTasksToFinish: poll reassignment state,

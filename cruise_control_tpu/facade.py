@@ -45,7 +45,7 @@ from .detector.metric_anomaly import MetricAnomalyDetector
 from .detector.notifier import AnomalyNotifier, SelfHealingNotifier
 from .detector.topic_anomaly import TopicAnomalyDetector
 from .executor.admin import AdminBackend
-from .executor.concurrency import ConcurrencyCaps
+from .executor.concurrency import ConcurrencyAdjusterConfig, ConcurrencyCaps
 from .executor.executor import Executor
 from .model.tensors import ClusterMeta, ClusterTensors, set_broker_state
 from .monitor.load_monitor import LoadMonitor, ModelCompletenessRequirements
@@ -108,7 +108,15 @@ class CruiseControl:
             on_sampling_mode_change=self._on_execution_sampling_change,
             adjuster_enabled=config.get_boolean("concurrency.adjuster.enabled"),
             adjuster_interval_s=config.get_long(
-                "concurrency.adjuster.interval.ms") / 1000.0)
+                "concurrency.adjuster.interval.ms") / 1000.0,
+            adjuster_config=ConcurrencyAdjusterConfig.from_config(config),
+            broker_metrics_supplier=lambda: (
+                self._load_monitor.latest_broker_metrics(
+                    [n for n, _f in ConcurrencyAdjusterConfig.LIMIT_METRICS])),
+            inter_rate_alert_mb_s=config.get_double(
+                "inter.broker.replica.movement.rate.alerting.threshold"),
+            intra_rate_alert_mb_s=config.get_double(
+                "intra.broker.replica.movement.rate.alerting.threshold"))
         self._optimizer = GoalOptimizer(config)
         self._notifier = notifier or SelfHealingNotifier(config)
         self._anomaly_detector = AnomalyDetectorManager(
@@ -677,11 +685,16 @@ class CruiseControl:
             # Submit through the Executor (intra-broker phase: per-broker
             # caps, completion polling, dead-task handling — Executor.java
             # :1672), NOT by calling the admin directly.
+            from .common.resources import Resource
+            disk_mb = np.asarray(state.leader_load[:, int(Resource.DISK)])
+            row_of = {tp: i for i, tp in enumerate(meta.partition_index)}
             proposals = [ExecutionProposal(
                 topic=m.topic, partition=m.partition, old_leader=-1,
                 old_replicas=(), new_replicas=(), new_leader=-1,
                 logdir_broker=m.broker_id, source_logdir=m.source_logdir,
-                destination_logdir=m.destination_logdir) for m in moves]
+                destination_logdir=m.destination_logdir,
+                data_to_move_mb=float(disk_mb[row_of[(m.topic, m.partition)]])
+                ) for m in moves]
             OPERATION_LOG.info("%s executing %d intra-broker moves "
                                "(reason: %s)", operation, len(moves), reason)
             self._executor.execute_proposals(proposals, uuid=operation)
@@ -771,6 +784,9 @@ class CruiseControl:
                   topic: str | None = None) -> OperationResult:
         """RightsizeRunnable — hand a ProvisionRecommendation to the
         configured Provisioner."""
+        if not self._config.get_boolean("provisioner.enable"):
+            raise ValueError(
+                "provisioner is disabled (provisioner.enable=false)")
         from .detector.provisioner import ProvisionRecommendation, ProvisionStatus
         rec = ProvisionRecommendation(
             status=ProvisionStatus.UNDER_PROVISIONED,

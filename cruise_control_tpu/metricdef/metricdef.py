@@ -54,6 +54,9 @@ class MetricDef:
     def metric_info(self, name: str) -> MetricInfo:
         return self._by_name[name]
 
+    def has_metric(self, name: str) -> bool:
+        return name in self._by_name
+
     def metric_info_for_id(self, metric_id: int) -> MetricInfo:
         return self._by_id[metric_id]
 

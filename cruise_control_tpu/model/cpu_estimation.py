@@ -107,9 +107,23 @@ class LinearRegressionCpuModel:
     NUM_FEATURES = 3
 
     def __init__(self, num_buckets: int = 20, max_per_bucket: int = 500,
-                 min_completeness: float = 0.5):
+                 min_completeness: float = 0.5,
+                 required_samples_per_bucket: int = 1,
+                 min_num_buckets: int | None = None):
+        """``required_samples_per_bucket`` — a bucket counts toward
+        completeness only once it holds this many observations
+        (linear.regression.model.required.samples.per.bucket).
+        ``min_num_buckets`` — buckets that must be complete before training
+        proceeds (linear.regression.model.min.num.cpu.util.buckets);
+        overrides ``min_completeness`` when given."""
         self._num_buckets = num_buckets
         self._max_per_bucket = max_per_bucket
+        self._required_per_bucket = max(1, required_samples_per_bucket)
+        if min_num_buckets is not None:
+            # Clamp: more required buckets than exist would make the
+            # completeness threshold unreachable (>1.0) and training
+            # silently never finish.
+            min_completeness = min(min_num_buckets, num_buckets) / num_buckets
         self._min_completeness = min_completeness
         self._buckets: list[list[np.ndarray]] = [[] for _ in range(num_buckets)]
         self._coef: np.ndarray | None = None
@@ -135,7 +149,7 @@ class LinearRegressionCpuModel:
     @property
     def training_completeness(self) -> float:
         with self._lock:
-            return sum(1 for b in self._buckets if b) / self._num_buckets
+            return self.training_completeness_locked()
 
     @property
     def trained(self) -> bool:
@@ -159,7 +173,8 @@ class LinearRegressionCpuModel:
         return True
 
     def training_completeness_locked(self) -> float:
-        return sum(1 for b in self._buckets if b) / self._num_buckets
+        return sum(1 for b in self._buckets
+                   if len(b) >= self._required_per_bucket) / self._num_buckets
 
     def estimate_leader_cpu_util(self, partition_bytes_in: np.ndarray,
                                  partition_bytes_out: np.ndarray) -> np.ndarray:

@@ -84,7 +84,8 @@ class MetricSampleAggregator:
     """
 
     def __init__(self, num_windows: int, window_ms: int, min_samples_per_window: int,
-                 metric_def: MetricDef, group_fn: Callable[[Any], Hashable] | None = None):
+                 metric_def: MetricDef, group_fn: Callable[[Any], Hashable] | None = None,
+                 completeness_cache_size: int = 5):
         self._lock = threading.RLock()
         self._window_ms = int(window_ms)
         self._num_windows = int(num_windows)
@@ -92,7 +93,11 @@ class MetricSampleAggregator:
         self._group_fn = group_fn or (lambda e: e)
         self._store = RawMetricStore(num_windows, min_samples_per_window, metric_def)
         self._generation = 0
+        # Bounded aggregation/completeness result cache
+        # (MonitorConfig *.metric.sample.aggregator.completeness.cache.size;
+        # distinct AggregationOptions keys evict oldest-first).
         self._cache: dict[tuple, AggregationResult] = {}
+        self._cache_size = max(1, completeness_cache_size)
 
     @property
     def window_ms(self) -> int:
@@ -309,6 +314,8 @@ class MetricSampleAggregator:
                 completeness=completeness,
             )
             self._cache[cache_key] = result
+            while len(self._cache) > self._cache_size:
+                self._cache.pop(next(iter(self._cache)))
             return result
 
     def peek_current_window(self) -> tuple[list, np.ndarray]:

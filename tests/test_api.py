@@ -195,8 +195,11 @@ def test_admin_concurrency_adjuster_toggles(api, cc):
                       "enable_concurrency_adjuster_for=leadership"
                       "&min_isr_based_concurrency_adjustment=true")[0] == 200
     mgr.adjust(cluster_healthy=False, has_under_min_isr=True)
+    adj = mgr.adjuster_config
     assert mgr.snapshot().inter_broker_per_broker == \
-        max(mgr.MIN_INTER_BROKER, base.inter_broker_per_broker // 2)
+        max(adj.min_partition_movements_per_broker,
+            int(base.inter_broker_per_broker
+                / adj.multiplicative_decrease_inter_broker))
     cc.executor.set_requested_concurrency(
         inter_broker_per_broker=base.inter_broker_per_broker,
         leadership_cluster=base.leadership_cluster)
@@ -665,3 +668,67 @@ def test_unknown_user_task_id_is_rejected_not_squatted():
                                client="mallory")
     assert mgr.all_tasks() == []
     mgr.shutdown()
+
+
+def test_request_reason_required(cc):
+    api2 = CruiseControlApi(cc)
+    api2._reason_required = True
+    try:
+        status, body, _ = api2.handle("POST", "/kafkacruisecontrol/rebalance",
+                                      "dryrun=true")
+        assert status == 400 and "reason" in body["errorMessage"]
+        # Non-executing POSTs stay exempt (ParameterUtils scopes the flag to
+        # the proposal-executing parameter classes).
+        assert api2.handle("POST",
+                           "/kafkacruisecontrol/pause_sampling")[0] == 200
+        assert api2.handle("POST", "/kafkacruisecontrol/resume_sampling",
+                           "reason=x")[0] == 200
+    finally:
+        api2.shutdown()
+
+
+def test_provisioner_disabled_refuses_rightsize():
+    partitions = _partitions()
+    backend = InMemoryAdminBackend(partitions.values())
+    cfg = CruiseControlConfig({
+        "partition.metrics.window.ms": 1000,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "provisioner.enable": False,
+        "failed.brokers.file.path": ""})
+    caps = StaticCapacityResolver({}, {Resource.CPU: 100.0, Resource.DISK: 1e7,
+                                       Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6})
+    monitor = LoadMonitor(cfg, backend, samplers=[SyntheticSampler()],
+                          capacity_resolver=caps)
+    cc2 = CruiseControl(cfg, backend, load_monitor=monitor,
+                        executor=Executor(backend, synchronous=True))
+    api2 = CruiseControlApi(cc2)
+    try:
+        status, body, _ = api2.handle("POST", "/kafkacruisecontrol/rightsize",
+                                      "numbrokerstoadd=2")
+        assert status == 400
+        assert "provisioner" in body["errorMessage"]
+    finally:
+        api2.shutdown()
+
+
+def test_user_task_manager_four_retention_classes():
+    from cruise_control_tpu.api.user_tasks import task_class
+
+    assert task_class("LOAD") == "KAFKA_MONITOR"
+    assert task_class("REBALANCE") == "KAFKA_ADMIN"
+    assert task_class("STATE") == "CC_MONITOR"
+    assert task_class("ADMIN") == "CC_ADMIN"
+    mgr = UserTaskManager(max_cached_completed_monitor_tasks=2,
+                          max_cached_completed_admin_tasks=5,
+                          max_cached_completed_cc_monitor_tasks=1)
+    try:
+        for i in range(4):
+            mgr.get_or_create_task("LOAD", f"q{i}", lambda: 1).future.result()
+        for i in range(3):
+            mgr.get_or_create_task("STATE", f"q{i}", lambda: 1).future.result()
+        tasks = mgr.all_tasks()
+        assert sum(1 for t in tasks if t.endpoint == "LOAD") == 2
+        assert sum(1 for t in tasks if t.endpoint == "STATE") == 1
+    finally:
+        mgr.shutdown()

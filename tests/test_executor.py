@@ -120,7 +120,46 @@ def test_concurrency_adjuster_halves_and_recovers():
     assert m.state()["interBrokerPerBroker"] == 2
     for _ in range(20):
         m.adjust(cluster_healthy=True, has_under_min_isr=False)
-    assert m.state()["interBrokerPerBroker"] == 16  # 2x base ceiling
+    # AIMD ceiling = concurrency.adjuster.max.partition.movements.per.broker
+    # (ExecutorConfig.java:340, default 12) — not the old 2x-base rule.
+    assert m.state()["interBrokerPerBroker"] == 12
+
+
+def test_concurrency_adjuster_metric_limits_and_aimd_knobs():
+    from cruise_control_tpu.executor.concurrency import (
+        ConcurrencyAdjusterConfig,
+    )
+    adj = ConcurrencyAdjusterConfig(min_brokers_violate_metric_limit=2,
+                                    leadership_per_broker_enabled=True)
+    m = ExecutionConcurrencyManager(
+        ConcurrencyCaps(inter_broker_per_broker=8, leadership_cluster=800,
+                        leadership_per_broker=200), adjuster=adj)
+    # One violating broker: below the threshold — healthy growth continues.
+    m.adjust(cluster_healthy=True, has_under_min_isr=False,
+             brokers_violating_metric_limits=1)
+    assert m.state()["interBrokerPerBroker"] == 9
+    # Two violating brokers: multiplicative decrease on every dimension
+    # (including per-broker leadership, enabled here).
+    m.adjust(cluster_healthy=True, has_under_min_isr=False,
+             brokers_violating_metric_limits=2)
+    s = m.state()
+    assert s["interBrokerPerBroker"] == 4          # (8+1) / 2
+    assert s["leadershipCluster"] == 450           # (800+100) / 2
+    assert m._caps.leadership_per_broker == 112    # (200+25) / 2
+    # brokers_violating_limits counts a broker once even with two limits hit.
+    metrics = {1: {"BROKER_LOG_FLUSH_TIME_MS_999TH": 9000.0,
+                   "BROKER_REQUEST_QUEUE_SIZE": 5000.0},
+               2: {"BROKER_REQUEST_QUEUE_SIZE": 10.0},
+               3: {"BROKER_PRODUCE_LOCAL_TIME_MS_999TH": 1500.0}}
+    assert adj.brokers_violating_limits(metrics) == 2
+    # AIMD floors: decreases clamp at the configured minimums.
+    for _ in range(10):
+        m.adjust(cluster_healthy=False, has_under_min_isr=True)
+    s = m.state()
+    assert s["interBrokerPerBroker"] == adj.min_partition_movements_per_broker
+    assert s["leadershipCluster"] == adj.min_leadership_movements
+    assert m._caps.leadership_per_broker == \
+        adj.min_leadership_movements_per_broker
 
 
 def test_concurrency_headroom_accounting():

@@ -106,7 +106,8 @@ def test_file_sample_store_roundtrip(tmp_path):
     assert loaded.broker_samples == res.broker_samples
 
 
-def _load_monitor(partitions, transport=None, store=None, interval_ms=1000):
+def _load_monitor(partitions, transport=None, store=None, interval_ms=1000,
+                  extra_cfg=None):
     backend = InMemoryAdminBackend(partitions.values())
     cfg = CruiseControlConfig({
         "metric.sampling.interval.ms": interval_ms,
@@ -114,6 +115,7 @@ def _load_monitor(partitions, transport=None, store=None, interval_ms=1000):
         "broker.metrics.window.ms": interval_ms,
         "num.partition.metrics.windows": 3,
         "min.valid.partition.ratio": 0.5,
+        **(extra_cfg or {}),
     })
     sampler = (CruiseControlMetricsReporterSampler(transport)
                if transport is not None else SyntheticSampler())
@@ -194,7 +196,10 @@ def test_train_fits_linear_cpu_model():
     from cruise_control_tpu.monitor.sampling.samples import BrokerEntity
 
     partitions = _partitions(n_topics=1, parts_per_topic=2, brokers=(0, 1, 2))
-    monitor = _load_monitor(partitions)
+    # The faithful defaults need 100 samples/bucket (MonitorConfig); this
+    # fixture feeds 120 rows total, so relax the per-bucket requirement.
+    monitor = _load_monitor(partitions, extra_cfg={
+        "linear.regression.model.required.samples.per.bucket": 1})
     bdef = KafkaMetricDef.broker_metric_def()
     agg = monitor.broker_aggregator
     ids = {n: bdef.metric_info(n).id for n in
