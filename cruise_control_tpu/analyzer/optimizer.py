@@ -195,10 +195,6 @@ class GoalOptimizer:
         b = state.num_brokers
         budget = self._cand_budget if self._cand_budget_explicit \
             else max(self._cand_budget, min(131_072, b * 64))
-        # dests/moves caps bind only above ~2k brokers (1k keeps 250/500 —
-        # measured best quality there); at 7k the wider 512-dest grid and
-        # 1024-move rounds roughly halve the round count for the
-        # count-distribution goals, the scarce resource at that scale.
         num_dests = max(16, min(512, b // 4))
         if self._cand_budget_explicit:
             # Honor the operator's budget as a bound on the move grid:
@@ -206,6 +202,15 @@ class GoalOptimizer:
             num_dests = min(num_dests, max(4, budget // 16))
             num_sources = max(16, min(1024, budget // num_dests))
         else:
+            # Batch width is a QUALITY knob, not just a speed knob
+            # (measured at 1k/100k, seed 42): 256 sources → 1,142 rounds,
+            # balancedness 86.0; 500 → 644 rounds but 82.7; 1,000 → 341
+            # rounds but 74.5. Wider joint batches mean fewer re-scoring
+            # points per move, and the coarser layout the early count
+            # goals lock in is then defended by their acceptance against
+            # the later resource-distribution goals' fixes. Keep the
+            # measured-best grid; round count is bought with dispatch
+            # amortization (AdaptiveDispatch) instead.
             num_sources = max(64, min(1024, budget // num_dests))
         moves = max(self._moves_base, min(1024, b // 2))
         return SearchConfig(num_sources=num_sources, num_dests=num_dests,
