@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 import urllib.parse
@@ -600,64 +601,17 @@ def _as_text(value: Any, indent: int = 0) -> str:
 class _Handler(BaseHTTPRequestHandler):
     api: CruiseControlApi  # set by make_server
 
-    def _serve_text(self, content: bytes, content_type: str) -> None:
-        self.send_response(200)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(content)))
-        self.end_headers()
-        self.wfile.write(content)
+    _UI_TYPES = {".html": "text/html; charset=utf-8",
+                 ".js": "text/javascript", ".css": "text/css",
+                 ".json": "application/json", ".svg": "image/svg+xml",
+                 ".png": "image/png", ".ico": "image/x-icon",
+                 ".woff2": "font/woff2", ".map": "application/json"}
 
-    def _serve(self, method: str) -> None:
-        cfg0 = self.api._config
-        header_bytes = sum(len(k) + len(v) for k, v in self.headers.items())
-        if header_bytes > cfg0.get_int("webserver.http.header.size"):
-            data = json.dumps({"errorMessage": "request headers too "
-                               "large"}).encode()
-            self.send_response(431)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
-            return
-        parsed = urllib.parse.urlparse(self.path)
-        scrape_paths = {"/metrics": "metrics", URL_PREFIX + "/metrics": "metrics",
-                        "/openapi": "openapi", URL_PREFIX + "/openapi": "openapi"}
-        kind = scrape_paths.get(parsed.path) if method == "GET" else None
-        if kind is not None:
-            # These surfaces sit outside the endpoint enum but NOT outside
-            # security: live operational state must not leak unauthenticated.
-            from .security import AuthenticationError
-            try:
-                self.api.authenticate_readonly(dict(self.headers),
-                                               self.client_address[0])
-            except AuthenticationError as e:
-                data = json.dumps({"errorMessage": str(e)}).encode()
-                self.send_response(401)
-                self.send_header("WWW-Authenticate",
-                                 self.api._security.challenge())
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-                return
-            if kind == "metrics":
-                self._serve_text(self.api.metrics_text().encode(),
-                                 "text/plain; version=0.0.4; charset=utf-8")
-            else:
-                from .openapi import openapi_yaml
-                self._serve_text(openapi_yaml().encode(), "application/yaml")
-            return
-        t0 = time.time()
-        status, body, extra = self.api.handle(
-            method, parsed.path, parsed.query, dict(self.headers),
-            self.client_address[0])
-        if isinstance(body, dict) and "__text__" in body:
-            data = (body["__text__"] + "\n").encode()
-            content_type = extra.pop("Content-Type",
-                                     "text/plain; charset=utf-8")
-        else:
-            data = json.dumps(body, indent=2).encode()
-            content_type = extra.pop("Content-Type", "application/json")
+    def _send(self, method: str, t0: float, status: int, data: bytes,
+              content_type: str, extra: dict[str, str] | None = None) -> None:
+        """The single response writer: every surface (API, scrapes, UI,
+        errors) goes through here so HSTS, CORS, and the access log apply
+        uniformly."""
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
@@ -677,7 +631,7 @@ class _Handler(BaseHTTPRequestHandler):
                              cfg.get("webserver.http.cors.allowmethods"))
             self.send_header("Access-Control-Expose-Headers",
                              cfg.get("webserver.http.cors.exposeheaders"))
-        for k, v in extra.items():
+        for k, v in (extra or {}).items():
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
@@ -685,6 +639,87 @@ class _Handler(BaseHTTPRequestHandler):
             LOG.info('access %s "%s %s" %d %dB %.1fms',
                      self.client_address[0], method, self.path, status,
                      len(data), 1000 * (time.time() - t0))
+
+    def _ui_lookup(self, path: str) -> tuple[bytes, str] | None:
+        """(content, content-type) for the static Web-UI surface
+        (KafkaCruiseControlServletApp serves the webroot at
+        webserver.ui.diskpath): the configured directory when set, else the
+        bundled single-file dashboard. Assets only — all DATA flows through
+        the API endpoints."""
+        if path.startswith(URL_PREFIX):
+            return None
+        cfg = self.api._config
+        base = cfg.get("webserver.ui.diskpath")
+        bundled = not base
+        if bundled:
+            import cruise_control_tpu.webui as webui
+            base = os.path.dirname(webui.__file__)
+        rel = path.lstrip("/") or "index.html"
+        full = os.path.realpath(os.path.join(base, rel))
+        # Traversal guard: the resolved file must stay inside the UI dir.
+        if not full.startswith(os.path.realpath(base) + os.sep):
+            return None
+        ext = os.path.splitext(full)[1].lower()
+        if bundled and ext not in self._UI_TYPES:
+            # The bundled dir is a Python package: only recognized asset
+            # types are public (never __init__.py / __pycache__ bytecode).
+            return None
+        if not os.path.isfile(full):
+            return None
+        with open(full, "rb") as f:
+            return f.read(), self._UI_TYPES.get(ext,
+                                                "application/octet-stream")
+
+    def _serve(self, method: str) -> None:
+        t0 = time.time()
+        cfg = self.api._config
+        header_bytes = sum(len(k) + len(v) for k, v in self.headers.items())
+        if header_bytes > cfg.get_int("webserver.http.header.size"):
+            self._send(method, t0, 431, json.dumps(
+                {"errorMessage": "request headers too large"}).encode(),
+                "application/json")
+            return
+        parsed = urllib.parse.urlparse(self.path)
+        scrape_paths = {"/metrics": "metrics", URL_PREFIX + "/metrics": "metrics",
+                        "/openapi": "openapi", URL_PREFIX + "/openapi": "openapi"}
+        kind = scrape_paths.get(parsed.path) if method == "GET" else None
+        ui = None
+        if method == "GET" and kind is None:
+            ui = self._ui_lookup(parsed.path)
+        if kind is not None or ui is not None:
+            # These surfaces sit outside the endpoint enum but NOT outside
+            # security: operational state — and operator-configured disk
+            # content — must not leak unauthenticated.
+            from .security import AuthenticationError
+            try:
+                self.api.authenticate_readonly(dict(self.headers),
+                                               self.client_address[0])
+            except AuthenticationError as e:
+                self._send(method, t0, 401, json.dumps(
+                    {"errorMessage": str(e)}).encode(), "application/json",
+                    {"WWW-Authenticate": self.api._security.challenge()})
+                return
+            if ui is not None:
+                self._send(method, t0, 200, ui[0], ui[1])
+            elif kind == "metrics":
+                self._send(method, t0, 200, self.api.metrics_text().encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            else:
+                from .openapi import openapi_yaml
+                self._send(method, t0, 200, openapi_yaml().encode(),
+                           "application/yaml")
+            return
+        status, body, extra = self.api.handle(
+            method, parsed.path, parsed.query, dict(self.headers),
+            self.client_address[0])
+        if isinstance(body, dict) and "__text__" in body:
+            data = (body["__text__"] + "\n").encode()
+            content_type = extra.pop("Content-Type",
+                                     "text/plain; charset=utf-8")
+        else:
+            data = json.dumps(body, indent=2).encode()
+            content_type = extra.pop("Content-Type", "application/json")
+        self._send(method, t0, status, data, content_type, extra)
 
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         self._serve("GET")

@@ -1,0 +1,2 @@
+"""Bundled single-file operational dashboard (see index.html). Replaced
+wholesale by pointing webserver.ui.diskpath at an external UI bundle."""

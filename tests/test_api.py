@@ -6,6 +6,7 @@ security suites — against the stdlib server)."""
 import json
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -732,3 +733,72 @@ def test_user_task_manager_four_retention_classes():
         assert sum(1 for t in tasks if t.endpoint == "STATE") == 1
     finally:
         mgr.shutdown()
+
+
+def test_web_ui_served_with_traversal_guard(cc):
+    server, api2 = make_server(cc, host="127.0.0.1", port=0)
+    try:
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        port = server.server_address[1]
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/") as r:
+            body = r.read().decode()
+            assert r.headers["Content-Type"].startswith("text/html")
+            assert "cruise-control-tpu" in body
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/index.html") as r:
+            assert r.status == 200
+        # Traversal attempts must not escape the UI directory.
+        for evil in ("/../facade.py", "/..%2f..%2fetc%2fpasswd",
+                     "/nonexistent.js"):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{evil}") as r:
+                    assert r.status == 404, evil
+            except urllib.error.HTTPError as e:
+                assert e.code == 404, evil
+    finally:
+        server.shutdown()
+        api2.shutdown()
+
+
+def test_web_ui_bundled_package_files_not_served(cc):
+    server, api2 = make_server(cc, host="127.0.0.1", port=0)
+    try:
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        port = server.server_address[1]
+        # Only recognized asset types are public from the bundled package.
+        for hidden in ("/__init__.py", "/__pycache__/__init__.cpython-311.pyc"):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{hidden}") as r:
+                    assert r.status == 404, hidden
+            except urllib.error.HTTPError as e:
+                assert e.code == 404, hidden
+    finally:
+        server.shutdown()
+        api2.shutdown()
+
+
+def test_web_ui_requires_auth_when_security_enabled(cc):
+    from cruise_control_tpu.api.security import BasicSecurityProvider, Role
+    import base64 as b64
+    provider = BasicSecurityProvider(users={"ops": ("pw", Role.VIEWER)})
+    server, api2 = make_server(cc, host="127.0.0.1", port=0,
+                               security_provider=provider)
+    try:
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        port = server.server_address[1]
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/")
+            raise AssertionError("expected 401")
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/",
+            headers={"Authorization": "Basic "
+                     + b64.b64encode(b"ops:pw").decode()})
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+            assert "cruise-control-tpu" in r.read().decode()
+    finally:
+        server.shutdown()
+        api2.shutdown()
