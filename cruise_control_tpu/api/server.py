@@ -154,9 +154,13 @@ class CruiseControlApi:
             extra["executor_in_execution"] = \
                 0.0 if ex.get("state") == "NO_TASK_IN_PROGRESS" else 1.0
             ad = st.get("AnomalyDetectorState", {})
-            for a_type, enabled in (ad.get("selfHealingEnabled") or {}).items():
-                SENSORS.gauge("anomaly_detector_self_healing_enabled",
-                              1.0 if enabled else 0.0,
+            # selfHealing(Enabled|Disabled) are LISTS of type names
+            # (AnomalyDetectorManager.state).
+            for a_type in ad.get("selfHealingEnabled") or ():
+                SENSORS.gauge("anomaly_detector_self_healing_enabled", 1.0,
+                              labels={"anomaly_type": str(a_type)})
+            for a_type in ad.get("selfHealingDisabled") or ():
+                SENSORS.gauge("anomaly_detector_self_healing_enabled", 0.0,
                               labels={"anomaly_type": str(a_type)})
         except Exception:  # noqa: BLE001 — a scrape must not 500 on state
             LOG.warning("metrics state snapshot failed", exc_info=True)

@@ -137,5 +137,57 @@ class KafkaMetricsTransport:
                             partition, exc_info=True)
         return out
 
+    def poll_columns(self, start_ms: int, end_ms: int):
+        """Columnar ``poll``: (concatenated buffer, value spans [N, 2])
+        with the same timestamp-bound semantics, but no per-record Python
+        objects — the native record-batch index supplies offsets,
+        timestamps, and value spans in one C pass per fetch. Returns None
+        when the native library is unavailable (caller falls back to
+        ``poll``)."""
+        from ..native import index_records, lib
+        if lib() is None:
+            return None
+        import numpy as np
+
+        chunks: list[bytes] = []
+        span_parts: list[np.ndarray] = []
+        base = 0
+        try:
+            parts = self._client.partitions_for(self._topic)
+        except m.KafkaProtocolError:
+            return b"", np.zeros((0, 2), dtype=np.int64)
+        for partition in sorted(parts):
+            try:
+                start, _ts = self._client.list_offsets(self._topic, partition,
+                                                       start_ms)
+                if start < 0:
+                    continue
+                offset = start
+                while True:
+                    raw, hw = self._client.fetch_raw(self._topic, partition,
+                                                     offset)
+                    idx = index_records(raw)
+                    if idx is None or not len(idx):
+                        break
+                    keep = (idx[:, 0] >= offset) \
+                        & (idx[:, 1] >= start_ms) & (idx[:, 1] < end_ms) \
+                        & (idx[:, 4] >= 0)
+                    if keep.any():
+                        chunks.append(raw)
+                        span = idx[keep][:, 4:6].copy()
+                        span[:, 0] += base
+                        span_parts.append(span)
+                        base += len(raw)
+                    offset = int(idx[-1, 0]) + 1
+                    if offset >= hw:
+                        break
+            except (ConnectionError, m.KafkaProtocolError):
+                LOG.warning("metrics poll failed for %s-%d", self._topic,
+                            partition, exc_info=True)
+        data = b"".join(chunks)
+        spans = (np.concatenate(span_parts) if span_parts
+                 else np.zeros((0, 2), dtype=np.int64))
+        return data, spans
+
     def close(self) -> None:
         self._client.close()

@@ -494,6 +494,15 @@ class WireClient:
     def fetch(self, topic: str, partition: int, offset: int,
               max_bytes: int = 8 << 20) -> tuple[list[Record], int]:
         """Returns (records from ``offset``, high watermark)."""
+        batch, hw = self.fetch_raw(topic, partition, offset, max_bytes)
+        return ([r for r in decode_batches(batch)
+                 if r.offset >= offset], hw)
+
+    def fetch_raw(self, topic: str, partition: int, offset: int,
+                  max_bytes: int = 8 << 20) -> tuple[bytes, int]:
+        """(raw record-set bytes, high watermark) — the undecoded fetch for
+        columnar consumers (native.index_records + vectorized value
+        parsing), skipping per-record Python objects entirely."""
 
         def call(conn):
             resp = conn.send(m.FETCH, {
@@ -506,9 +515,7 @@ class WireClient:
             if p["error_code"] != m.NONE:
                 raise m.KafkaProtocolError(p["error_code"],
                                            f"fetch({topic}-{partition})")
-            batch = p["records"] or b""
-            return ([r for r in decode_batches(batch)
-                     if r.offset >= offset], p["high_watermark"])
+            return p["records"] or b"", p["high_watermark"]
 
         return self._leader_call(topic, partition, call)
 

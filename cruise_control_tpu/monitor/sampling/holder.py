@@ -77,3 +77,64 @@ def group_by_broker(metrics) -> dict[int, BrokerLoad]:
     for m in metrics:
         loads.setdefault(m.broker_id, BrokerLoad(m.broker_id)).record(m)
     return loads
+
+
+def broker_loads_from_columns(cols) -> dict[int, BrokerLoad]:
+    """Columnar ``group_by_broker``: one numpy grouping pass instead of a
+    ``record()`` call per metric. Per-(key) means are stored as one-element
+    lists so every ``BrokerLoad`` view behaves identically to the scalar
+    path (the views average their lists); partition sizes keep
+    LAST-observation-wins semantics like ``record``."""
+    import numpy as np
+
+    loads: dict[int, BrokerLoad] = {}
+    if not len(cols):
+        return loads
+    scope = cols.scope
+
+    def mean_by(keys_2d, values):
+        """(unique key rows, mean value per key) via lexicographic sort."""
+        uniq, inv = np.unique(keys_2d, axis=0, return_inverse=True)
+        sums = np.zeros(len(uniq))
+        counts = np.zeros(len(uniq))
+        np.add.at(sums, inv, values)
+        np.add.at(counts, inv, 1.0)
+        return uniq, sums / counts
+
+    b_rows = np.nonzero(scope == 0)[0]
+    if b_rows.size:
+        uniq, means = mean_by(
+            np.stack([cols.broker[b_rows], cols.raw_id[b_rows]], axis=1),
+            cols.value[b_rows])
+        for (bid, rid), v in zip(uniq.tolist(), means.tolist()):
+            loads.setdefault(bid, BrokerLoad(bid)) \
+                .broker_metrics[RawMetricType(rid)].append(v)
+    t_rows = np.nonzero(scope == 1)[0]
+    if t_rows.size:
+        uniq, means = mean_by(
+            np.stack([cols.broker[t_rows], cols.topic_id[t_rows],
+                      cols.raw_id[t_rows]], axis=1), cols.value[t_rows])
+        for (bid, tid, rid), v in zip(uniq.tolist(), means.tolist()):
+            loads.setdefault(bid, BrokerLoad(bid)) \
+                .topic_metrics[(cols.topics[tid], RawMetricType(rid))].append(v)
+    p_rows = np.nonzero(scope == 2)[0]
+    if p_rows.size:
+        # Last observation wins: iterate brokers, bulk-build each dict
+        # from the LAST occurrence per (topic, partition).
+        for bid in np.unique(cols.broker[p_rows]).tolist():
+            rows = p_rows[cols.broker[p_rows] == bid]
+            key = (cols.topic_id[rows].astype(np.int64) << 32) \
+                | cols.partition[rows].astype(np.int64)
+            # np.unique keeps the FIRST occurrence of each key in the order
+            # given; reversing makes that the last observation.
+            rev = rows[::-1]
+            rkey = key[::-1]
+            _u, first = np.unique(rkey, return_index=True)
+            keep = rev[first]
+            load = loads.setdefault(bid, BrokerLoad(bid))
+            load.partition_sizes.update(zip(
+                ((cols.topics[t], int(p)) for t, p in
+                 zip(cols.topic_id[keep].tolist(),
+                     cols.partition[keep].tolist())),
+                cols.value[keep].tolist()))
+    return loads

@@ -57,8 +57,13 @@ class CruiseControlMetricsProcessor:
 
     def process(self, metrics: Iterable[CruiseControlMetric],
                 partitions: Mapping[tuple[str, int], PartitionState],
-                time_ms: int) -> ProcessorResult:
-        loads = group_by_broker(metrics)
+                time_ms: int,
+                loads: Mapping[int, BrokerLoad] | None = None,
+                ) -> ProcessorResult:
+        """``loads`` short-circuits the per-metric grouping when the caller
+        already built BrokerLoads columnar (broker_loads_from_columns)."""
+        if loads is None:
+            loads = group_by_broker(metrics)
         # leader broker → [(topic, partition)]
         by_leader: dict[int, list[tuple[str, int]]] = defaultdict(list)
         for (topic, part), st in partitions.items():

@@ -87,15 +87,51 @@ class CruiseControlMetricsReporterSampler:
         self._processor = CruiseControlMetricsProcessor(cpu_estimator)
 
     def get_samples(self, partitions, start_ms: int, end_ms: int) -> SamplerResult:
-        raw = [deserialize(b) for b in self._transport.poll(start_ms, end_ms)]
-        if partitions:
-            assigned = set(partitions)
-            raw = [m for m in raw
-                   if m.topic is None or m.partition < 0
-                   or (m.topic, m.partition) in assigned]
-        res: ProcessorResult = self._processor.process(raw, partitions, end_ms)
+        res = self._columnar_samples(partitions, start_ms, end_ms)
+        if res is None:
+            raw = [deserialize(b) for b in self._transport.poll(start_ms, end_ms)]
+            if partitions:
+                assigned = set(partitions)
+                raw = [m for m in raw
+                       if m.topic is None or m.partition < 0
+                       or (m.topic, m.partition) in assigned]
+            res = self._processor.process(raw, partitions, end_ms)
         return SamplerResult(res.partition_samples, res.broker_samples,
                              res.skipped_partitions)
+
+    def _columnar_samples(self, partitions, start_ms: int,
+                          end_ms: int) -> "ProcessorResult | None":
+        """The vectorized ingest path: raw record-set bytes → native span
+        index → one columnar serde parse → batched BrokerLoads. Falls back
+        to the per-record path when the transport cannot serve spans (the
+        in-memory test transport, or no C compiler)."""
+        poll_columns = getattr(self._transport, "poll_columns", None)
+        if poll_columns is None:
+            return None
+        got = poll_columns(start_ms, end_ms)
+        if got is None:
+            return None
+        import numpy as np
+
+        from ...monitor.sampling.holder import broker_loads_from_columns
+        from ...reporter.metrics import deserialize_columns
+
+        data, spans = got
+        cols = deserialize_columns(data, spans)
+        if partitions and len(cols):
+            # Assigned-partition filter (scalar path parity): only
+            # partition-scope rows are filtered; broker/topic scope passes.
+            tid_of = {t: i for i, t in enumerate(cols.topics)}
+            assigned = np.array(
+                [(tid_of[t] << 32) | p for (t, p) in partitions
+                 if t in tid_of], dtype=np.int64)
+            keys = (cols.topic_id.astype(np.int64) << 32) \
+                | (cols.partition.astype(np.int64) & 0xFFFFFFFF)
+            ok = (cols.scope != 2) | np.isin(keys, assigned)
+            if not ok.all():
+                cols = cols.take(ok)
+        loads = broker_loads_from_columns(cols)
+        return self._processor.process((), partitions, end_ms, loads=loads)
 
     def close(self) -> None:
         pass

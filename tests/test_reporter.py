@@ -201,3 +201,101 @@ def test_system_metrics_registry_psutil_bridge(tmp_path):
     second = reg.snapshot(time_ms=2_000)
     types2 = {m.raw_type for m in second}
     assert {R.ALL_TOPIC_BYTES_IN, R.ALL_TOPIC_BYTES_OUT} <= types2
+
+
+def test_columnar_deserialize_matches_scalar():
+    """deserialize_columns over a concatenated buffer must reproduce every
+    field of the per-record deserialize."""
+    import numpy as np
+
+    from cruise_control_tpu.metricdef.raw_metric_type import RawMetricType as R
+    from cruise_control_tpu.reporter.metrics import (
+        broker_metric, deserialize, deserialize_columns, partition_metric,
+        serialize, topic_metric,
+    )
+
+    rng = np.random.default_rng(7)
+    metrics = []
+    for i in range(500):
+        kind = i % 3
+        if kind == 0:
+            metrics.append(broker_metric(R.BROKER_CPU_UTIL, 1000 + i, i % 9,
+                                         float(rng.uniform(0, 1))))
+        elif kind == 1:
+            metrics.append(topic_metric(R.TOPIC_BYTES_IN, 1000 + i, i % 9,
+                                        f"topic-{i % 13}",
+                                        float(rng.uniform(0, 1e6))))
+        else:
+            metrics.append(partition_metric(R.PARTITION_SIZE, 1000 + i, i % 9,
+                                            f"topic-{i % 13}", i % 40,
+                                            float(rng.uniform(0, 1e7))))
+    payloads = [serialize(m) for m in metrics]
+    data = b"".join(payloads)
+    spans, off = [], 0
+    for p in payloads:
+        spans.append((off, len(p)))
+        off += len(p)
+    cols = deserialize_columns(data, np.asarray(spans, dtype=np.int64))
+    assert len(cols) == len(metrics)
+    for i, m in enumerate(metrics):
+        ref = deserialize(payloads[i])
+        assert R(int(cols.raw_id[i])) is ref.raw_type
+        assert int(cols.time_ms[i]) == ref.time_ms
+        assert int(cols.broker[i]) == ref.broker_id
+        assert float(cols.value[i]) == ref.value
+        topic = cols.topics[cols.topic_id[i]] if cols.topic_id[i] >= 0 else None
+        assert topic == ref.topic
+        part = int(cols.partition[i])
+        assert part == (ref.partition if ref.partition >= 0 else -1)
+
+
+def test_columnar_broker_loads_match_scalar_grouping():
+    import numpy as np
+
+    from cruise_control_tpu.metricdef.raw_metric_type import RawMetricType as R
+    from cruise_control_tpu.monitor.sampling.holder import (
+        broker_loads_from_columns, group_by_broker,
+    )
+    from cruise_control_tpu.reporter.metrics import (
+        broker_metric, deserialize_columns, partition_metric, serialize,
+        topic_metric,
+    )
+
+    rng = np.random.default_rng(3)
+    metrics = []
+    for i in range(600):
+        b = int(rng.integers(0, 5))
+        kind = int(rng.integers(0, 3))
+        if kind == 0:
+            metrics.append(broker_metric(R.ALL_TOPIC_BYTES_IN, 1000, b,
+                                         float(rng.uniform(0, 100))))
+        elif kind == 1:
+            metrics.append(topic_metric(R.TOPIC_BYTES_OUT, 1000, b,
+                                        f"t{int(rng.integers(0, 4))}",
+                                        float(rng.uniform(0, 100))))
+        else:
+            # duplicates on purpose: last-observation-wins for sizes
+            metrics.append(partition_metric(R.PARTITION_SIZE, 1000, b,
+                                            f"t{int(rng.integers(0, 4))}",
+                                            int(rng.integers(0, 6)),
+                                            float(rng.uniform(0, 100))))
+    payloads = [serialize(m) for m in metrics]
+    data = b"".join(payloads)
+    spans, off = [], 0
+    for p in payloads:
+        spans.append((off, len(p)))
+        off += len(p)
+    cols = deserialize_columns(data, np.asarray(spans, dtype=np.int64))
+    col_loads = broker_loads_from_columns(cols)
+    ref_loads = group_by_broker(metrics)
+    assert set(col_loads) == set(ref_loads)
+    for b, ref in ref_loads.items():
+        got = col_loads[b]
+        # Derived views must agree (means of lists vs single-element mean).
+        for raw in set(ref.broker_metrics):
+            assert got.broker_metric(raw) == pytest.approx(
+                ref.broker_metric(raw))
+        for (t, raw) in set(ref.topic_metrics):
+            assert got.topic_metric(t, raw) == pytest.approx(
+                ref.topic_metric(t, raw))
+        assert got.partition_sizes == ref.partition_sizes

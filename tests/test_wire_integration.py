@@ -657,3 +657,74 @@ def test_executor_intra_broker_jbod_flow_over_wire(cluster):
     intra = counts.get("intra_broker_replica_action", {})
     assert intra.get("completed") == 1, counts
     admin.close()
+
+
+def test_columnar_poll_matches_record_poll(cluster):
+    """poll_columns over real sockets must yield the same metric set as the
+    per-record poll, and the columnar sampler path must equal the scalar
+    one sample-for-sample."""
+    import numpy as np
+
+    from cruise_control_tpu.metricdef.raw_metric_type import RawMetricType as R
+    from cruise_control_tpu.monitor.sampling.sampler import (
+        CruiseControlMetricsReporterSampler,
+    )
+    from cruise_control_tpu.native import lib
+    from cruise_control_tpu.reporter.metrics import (
+        broker_metric, deserialize, deserialize_columns, partition_metric,
+        serialize, topic_metric,
+    )
+
+    if lib() is None:
+        pytest.skip("no C compiler for the native index")
+    t = KafkaMetricsTransport(cluster.bootstrap_servers, num_partitions=3,
+                              replication_factor=1)
+    t.ensure_topic()
+    now = 1_700_000_000_000
+    import time as _time
+    real_now = int(_time.time() * 1000)
+    sent = []
+    for b in range(3):
+        sent.append(broker_metric(R.BROKER_CPU_UTIL, now, b, 0.1 * (b + 1)))
+        sent.append(broker_metric(R.ALL_TOPIC_BYTES_IN, now, b, 100.0 * (b + 1)))
+        sent.append(broker_metric(R.ALL_TOPIC_BYTES_OUT, now, b, 10.0))
+        sent.append(broker_metric(R.ALL_TOPIC_REPLICATION_BYTES_IN, now, b, 1.0))
+        for p in range(4):
+            sent.append(topic_metric(R.TOPIC_BYTES_IN, now, b, "demo", 50.0))
+            sent.append(partition_metric(R.PARTITION_SIZE, now, b, "demo", p,
+                                         1000.0 + p))
+    for m_ in sent:
+        t.produce(serialize(m_))
+    t.flush()
+
+    lo, hi = real_now - 60_000, real_now + 60_000
+    scalar = [deserialize(b) for b in t.poll(lo, hi)]
+    data, spans = t.poll_columns(lo, hi)
+    cols = deserialize_columns(data, np.asarray(spans))
+    assert len(cols) == len(scalar) == len(sent)
+    got = sorted((int(cols.raw_id[i]), int(cols.broker[i]),
+                  cols.topics[cols.topic_id[i]] if cols.topic_id[i] >= 0 else None,
+                  int(cols.partition[i]), float(cols.value[i]))
+                 for i in range(len(cols)))
+    want = sorted((int(m_.raw_type), m_.broker_id, m_.topic,
+                   m_.partition if m_.partition >= 0 else -1, m_.value)
+                  for m_ in scalar)
+    assert got == want
+
+    # Sampler equality: columnar fast path vs forced scalar fallback.
+    parts = {("demo", p): type("PS", (), {"leader": p % 3})() for p in range(4)}
+    sampler = CruiseControlMetricsReporterSampler(t)
+    res_col = sampler.get_samples(parts, lo, hi)
+    poll_columns = t.poll_columns
+    try:
+        t.poll_columns = lambda *a: None      # force the per-record path
+        res_scalar = sampler.get_samples(parts, lo, hi)
+    finally:
+        t.poll_columns = poll_columns
+    def norm(res):
+        return (sorted((s.entity, tuple(np.round(s.values, 6).tolist()))
+                       for s in res.partition_samples),
+                sorted((s.entity, tuple(np.round(s.values, 6).tolist()))
+                       for s in res.broker_samples))
+    assert norm(res_col) == norm(res_scalar)
+    t.close()
