@@ -203,6 +203,7 @@ class Executor:
                 return 0
             self._state = ExecutorState.STARTING_EXECUTION
             self._stop_requested.clear()
+            self._min_isr_window.clear()
             self._uuid = uuid
             self._task_manager = ExecutionTaskManager()
             self._planner = ExecutionTaskPlanner(self._strategy)

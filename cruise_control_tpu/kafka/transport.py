@@ -103,16 +103,17 @@ class KafkaMetricsTransport:
             self._pending = requeued
             raise
 
-    def poll(self, start_ms: int, end_ms: int) -> list[bytes]:
-        """All payloads with record timestamp in [start_ms, end_ms): seek
-        each partition to the start offset by time (ListOffsets), read to
-        the high watermark, filter BOTH bounds so adjacent windows never
-        double-count under producer clock skew."""
-        out: list[bytes] = []
+    def _consume_raw(self, start_ms: int, handle) -> None:
+        """The shared per-partition consume loop: seek each partition to
+        the start offset by time (ListOffsets), fetch raw record sets to
+        the high watermark, and feed each to ``handle(raw, fetch_offset)``
+        which returns the next offset to fetch (None = partition
+        exhausted). Both the record-object and columnar polls ride this
+        one loop so their offset/window semantics can never diverge."""
         try:
             parts = self._client.partitions_for(self._topic)
         except m.KafkaProtocolError:
-            return []
+            return
         for partition in sorted(parts):
             try:
                 start, _ts = self._client.list_offsets(self._topic, partition,
@@ -121,20 +122,37 @@ class KafkaMetricsTransport:
                     continue
                 offset = start
                 while True:
-                    records, hw = self._client.fetch(self._topic, partition,
+                    raw, hw = self._client.fetch_raw(self._topic, partition,
                                                      offset)
-                    if not records:
+                    nxt = handle(raw, offset)
+                    if nxt is None or nxt <= offset:
                         break
-                    for r in records:
-                        if start_ms <= r.timestamp_ms < end_ms \
-                                and r.value is not None:
-                            out.append(r.value)
-                    offset = records[-1].offset + 1
+                    offset = nxt
                     if offset >= hw:
                         break
             except (ConnectionError, m.KafkaProtocolError):
                 LOG.warning("metrics poll failed for %s-%d", self._topic,
                             partition, exc_info=True)
+
+    def poll(self, start_ms: int, end_ms: int) -> list[bytes]:
+        """All payloads with record timestamp in [start_ms, end_ms):
+        filter BOTH bounds so adjacent windows never double-count under
+        producer clock skew."""
+        from .wire.records import decode_batches
+
+        out: list[bytes] = []
+
+        def handle(raw: bytes, offset: int):
+            records = decode_batches(raw)
+            if not records:
+                return None
+            for r in records:
+                if r.offset >= offset and r.value is not None \
+                        and start_ms <= r.timestamp_ms < end_ms:
+                    out.append(r.value)
+            return records[-1].offset + 1
+
+        self._consume_raw(start_ms, handle)
         return out
 
     def poll_columns(self, start_ms: int, end_ms: int):
@@ -151,39 +169,24 @@ class KafkaMetricsTransport:
 
         chunks: list[bytes] = []
         span_parts: list[np.ndarray] = []
-        base = 0
-        try:
-            parts = self._client.partitions_for(self._topic)
-        except m.KafkaProtocolError:
-            return b"", np.zeros((0, 2), dtype=np.int64)
-        for partition in sorted(parts):
-            try:
-                start, _ts = self._client.list_offsets(self._topic, partition,
-                                                       start_ms)
-                if start < 0:
-                    continue
-                offset = start
-                while True:
-                    raw, hw = self._client.fetch_raw(self._topic, partition,
-                                                     offset)
-                    idx = index_records(raw)
-                    if idx is None or not len(idx):
-                        break
-                    keep = (idx[:, 0] >= offset) \
-                        & (idx[:, 1] >= start_ms) & (idx[:, 1] < end_ms) \
-                        & (idx[:, 4] >= 0)
-                    if keep.any():
-                        chunks.append(raw)
-                        span = idx[keep][:, 4:6].copy()
-                        span[:, 0] += base
-                        span_parts.append(span)
-                        base += len(raw)
-                    offset = int(idx[-1, 0]) + 1
-                    if offset >= hw:
-                        break
-            except (ConnectionError, m.KafkaProtocolError):
-                LOG.warning("metrics poll failed for %s-%d", self._topic,
-                            partition, exc_info=True)
+        state = {"base": 0}
+
+        def handle(raw: bytes, offset: int):
+            idx = index_records(raw)
+            if idx is None or not len(idx):
+                return None
+            keep = (idx[:, 0] >= offset) \
+                & (idx[:, 1] >= start_ms) & (idx[:, 1] < end_ms) \
+                & (idx[:, 4] >= 0)
+            if keep.any():
+                chunks.append(raw)
+                span = idx[keep][:, 4:6].copy()
+                span[:, 0] += state["base"]
+                span_parts.append(span)
+                state["base"] += len(raw)
+            return int(idx[-1, 0]) + 1
+
+        self._consume_raw(start_ms, handle)
         data = b"".join(chunks)
         spans = (np.concatenate(span_parts) if span_parts
                  else np.zeros((0, 2), dtype=np.int64))
