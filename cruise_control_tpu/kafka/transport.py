@@ -15,7 +15,7 @@ import time
 
 from .wire import messages as m
 from .wire.client import WireClient
-from .wire.records import Record
+from .wire.records import Record, decode_batches
 
 LOG = logging.getLogger(__name__)
 
@@ -138,8 +138,6 @@ class KafkaMetricsTransport:
         """All payloads with record timestamp in [start_ms, end_ms):
         filter BOTH bounds so adjacent windows never double-count under
         producer clock skew."""
-        from .wire.records import decode_batches
-
         out: list[bytes] = []
 
         def handle(raw: bytes, offset: int):

@@ -285,3 +285,62 @@ def test_swap_phase_balances_when_moves_cannot():
     assert (counts == 4).all(), counts
     assert info["swaps_applied"] > 0, info
     assert abs(after[0] - after[1]) < abs(before[0] - before[1]), (before, after)
+
+
+def test_optimizer_resolves_broker_sets_from_config():
+    """GoalOptimizer must bind broker→set ids into a bare BrokerSetAwareGoal
+    from the configured mapping policy / brokerSets.json, and fail loud when
+    neither resolves (a vacuous broker-set constraint must be impossible)."""
+    import json as json_mod
+    import os as os_mod
+    import tempfile
+
+    import pytest as _pytest
+
+    from cruise_control_tpu.analyzer.goals import (
+        BrokerSetAwareGoal, RackAwareGoal,
+    )
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.model.fixtures import random_cluster
+
+    state, meta = random_cluster(num_brokers=4, num_topics=2,
+                                 num_partitions=16, rf=2, num_racks=2, seed=0)
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json_mod.dump({"brokerSets": [
+            {"brokerSetId": "a", "brokerIds": meta.broker_ids[:2]},
+            {"brokerSetId": "b", "brokerIds": meta.broker_ids[2:]}]}, f)
+        path = f.name
+    try:
+        cfg = CruiseControlConfig({"broker.set.config.file": path})
+        opt = GoalOptimizer(cfg)
+        chain = opt._resolve_broker_sets(
+            [RackAwareGoal(), BrokerSetAwareGoal()], meta)
+    finally:
+        os_mod.unlink(path)
+    assert chain[1].broker_sets == (0, 0, 1, 1)
+    assert isinstance(chain[0], RackAwareGoal)       # others untouched
+    # A goal that already carries sets is left alone.
+    pre = BrokerSetAwareGoal(broker_sets=(1, 1, 0, 0))
+    assert opt._resolve_broker_sets([pre], meta)[0].broker_sets == (1, 1, 0, 0)
+    # No mapping resolvable -> loud failure, not a vacuous constraint.
+    cfg_missing = CruiseControlConfig(
+        {"broker.set.config.file": "/nonexistent/brokerSets.json"})
+    with _pytest.raises(ValueError, match="broker-set mapping"):
+        GoalOptimizer(cfg_missing)._resolve_broker_sets(
+            [BrokerSetAwareGoal()], meta)
+    # A pluggable mapping policy wins over the file.
+    cfg_policy = CruiseControlConfig({
+        "replica.to.broker.set.mapping.policy.class":
+            "tests.test_analyzer.modulo_broker_sets"})
+    chain = GoalOptimizer(cfg_policy)._resolve_broker_sets(
+        [BrokerSetAwareGoal()], meta)
+    assert chain[0].broker_sets == (0, 1, 0, 1)
+
+
+def modulo_broker_sets(_config, broker_ids):
+    """Test mapping policy plugin (replica.to.broker.set.mapping.policy.class)."""
+    return tuple(i % 2 for i in range(len(broker_ids)))
