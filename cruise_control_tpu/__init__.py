@@ -15,4 +15,4 @@ A brand-new framework with the capabilities of Kafka Cruise Control
 Reference layer map: see SURVEY.md §1 (cruise-control/src/main/java/...).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
