@@ -47,6 +47,16 @@ class Goal:
     # the partition axis (rack duplicates, non-preferred leaders): under a
     # partition-sharded mesh the sharded search psums them across devices.
     partition_additive_scores: bool = False
+    # True for goals whose per-round accepted-move count is source-limited
+    # and whose band structure tolerates wide joint batches WITHOUT the
+    # final-quality loss wider batches cause for the early count/resource
+    # goals (measured at 1k/100k, docs/DESIGN.md): the bounded per-goal
+    # driver runs these with a 4x source grid. Only goals late enough in
+    # the chain that their coarser placements cannot be locked in against
+    # later goals' fixes should set this (validated for
+    # TopicReplicaDistributionGoal: rounds 482 -> 106, balancedness and
+    # violated set unchanged).
+    prefers_wide_batches: bool = False
 
     # -- evaluation kernels (traced) --------------------------------------
     def prepare_partial(self, state: ClusterTensors, num_topics: int) -> Any:

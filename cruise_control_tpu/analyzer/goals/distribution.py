@@ -269,6 +269,8 @@ class TopicReplicaDistributionGoal(Goal):
     (TopicReplicaDistributionGoal.java:594LoC). Uses a [T, B] count plane —
     fine up to mid-size clusters; sharded over the mesh at large T×B."""
 
+    prefers_wide_batches: bool = True
+
     def prepare_partial(self, state, num_topics):
         return {"counts": topic_broker_replica_counts(state, num_topics)
                 .astype(jnp.float32)}

@@ -313,6 +313,11 @@ def _definition() -> ConfigDef:
              "Initial (and minimum) search rounds per device dispatch on "
              "the bounded per-goal path (the host loops to the same fixed "
              "point).")
+    d.define("solver.wide.batch.min.brokers", T.INT, 512, Range.at_least(0),
+             I.LOW,
+             "Cluster size from which goals flagged prefers_wide_batches "
+             "run with the widened source grid on the bounded per-goal "
+             "path (0 disables wide batches entirely).")
     d.define("solver.dispatch.target.seconds", T.DOUBLE, 2.5,
              Range.at_least(0), I.MEDIUM,
              "Adaptive bounded-dispatch sizing: grow the per-dispatch round "

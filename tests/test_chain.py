@@ -346,3 +346,39 @@ def test_bounded_single_device_skips_satisfied_goals():
         if info["residual_violation"] == 0.0:
             assert info["rounds"] == 0, info
     np.testing.assert_array_equal(np.asarray(st.assignment), before)
+
+
+def test_wide_batch_config_derivation():
+    """Goal.prefers_wide_batches widens the source grid only in regime:
+    above solver.wide.batch.min.brokers, with a wide goal in the chain,
+    floored at the base config, disabled by threshold 0."""
+    from cruise_control_tpu.analyzer.goals import (
+        RackAwareGoal, TopicReplicaDistributionGoal,
+    )
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+
+    assert TopicReplicaDistributionGoal().prefers_wide_batches
+    assert not RackAwareGoal().prefers_wide_batches
+    opt = GoalOptimizer(CruiseControlConfig())
+    base = SearchConfig(num_sources=256, num_dests=250, moves_per_round=500,
+                        max_rounds=2000)
+    chain = [RackAwareGoal(), TopicReplicaDistributionGoal()]
+    wide = opt._wide_config(base, chain, num_brokers=1000)
+    assert wide.num_sources == 1024 and wide.moves_per_round == 1000
+    assert wide.num_dests == base.num_dests
+    # Below the regime threshold / no wide goal in the chain -> None.
+    assert opt._wide_config(base, chain, num_brokers=100) is None
+    assert opt._wide_config(base, [RackAwareGoal()], 1000) is None
+    # An operator-raised base can never exceed the "wide" config.
+    big = SearchConfig(num_sources=2048, num_dests=250, moves_per_round=4096,
+                       max_rounds=2000)
+    wide = opt._wide_config(big, chain, num_brokers=1000)
+    assert wide.num_sources >= big.num_sources
+    assert wide.moves_per_round >= big.moves_per_round
+    # Threshold 0 disables wide batches entirely.
+    opt_off = GoalOptimizer(CruiseControlConfig(
+        {"solver.wide.batch.min.brokers": "0"}))
+    assert opt_off._wide_config(base, chain, num_brokers=5000) is None
