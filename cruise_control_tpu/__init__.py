@@ -15,4 +15,26 @@ A brand-new framework with the capabilities of Kafka Cruise Control
 Reference layer map: see SURVEY.md §1 (cruise-control/src/main/java/...).
 """
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
+
+
+def enable_persistent_compile_cache(cache_dir: str | None = None) -> str:
+    """Point XLA's persistent compilation cache at ``cache_dir`` (default:
+    $JAX_COMPILATION_CACHE_DIR or /tmp/cc_tpu_jax_cache).
+
+    jax 0.9 does NOT honor the JAX_COMPILATION_CACHE_DIR environment
+    variable (``jax.config.jax_compilation_cache_dir`` stays None unless
+    set programmatically) — every entry point that relied on the env var
+    was cold-compiling the full solver chain on every process start
+    (~19 min at 7k brokers). Calling this before the first compilation
+    makes restarts hit the on-disk cache. Idempotent; safe after jax
+    import, must run before the first jit execution to help it."""
+    import os
+
+    import jax
+
+    cache_dir = cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                            "/tmp/cc_tpu_jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return cache_dir

@@ -561,6 +561,7 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
                            masks: ExclusionMasks | None = None,
                            dispatch_rounds: int = 0,
                            dispatch: AdaptiveDispatch | None = None,
+                           wall_budget_s: float = 0.0,
                            ) -> tuple[ClusterTensors, dict]:
     """Run goal ``chain[index]`` to convergence under the acceptance of
     ``chain[:index]``, using the chain-shared kernels (same semantics and
@@ -579,8 +580,22 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
     entry. Skipped when offline replicas exist at entry — self-healing
     placement takes precedence over the goal's own balance objective
     (ClusterModel.selfHealingEligibleReplicas semantics).
+
+    ``wall_budget_s`` > 0 (fast mode: fast.mode.per.broker.move.timeout.ms
+    x num_brokers) stops dispatching further search rounds for this goal
+    once its elapsed wall-clock exceeds the budget — the batch-search
+    analogue of the reference's per-broker move timeout
+    (ResourceDistributionGoal.java:470-475), enforceable at dispatch
+    granularity on the bounded path. Hard goals still raise on residual
+    violations, exactly like the reference in fast mode.
     """
     import time as _time
+
+    goal_t0 = _time.monotonic()
+
+    def out_of_time() -> bool:
+        return wall_budget_s > 0 \
+            and _time.monotonic() - goal_t0 > wall_budget_s
 
     masks = masks or ExclusionMasks()
     goals = tuple(chain)
@@ -611,7 +626,7 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
             st, applied, r = kernel(st, idx, prior, goals, constraint, **kw)
             return st, int(applied), int(r)
         applied_total, pass_rounds = 0, 0
-        while pass_rounds < pass_cap:
+        while pass_rounds < pass_cap and not out_of_time():
             budget = dispatch.budget(pass_cap - pass_rounds)
             t0 = _time.monotonic()
             st, applied, r = kernel(st, idx, prior, goals, constraint,
@@ -634,7 +649,7 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
             state, masks.excluded_replica_move_brokers).any())
     ran = float(viol0) > 0 or int(offline0) > 0 or drain
     if ran:
-        while rounds < cfg.max_rounds:
+        while rounds < cfg.max_rounds and not out_of_time():
             state, moves, r = run_pass(chain_optimize_rounds, state,
                                        cfg.max_rounds, cfg=cfg,
                                        num_topics=num_topics, masks=masks)

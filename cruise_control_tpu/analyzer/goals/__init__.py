@@ -39,7 +39,8 @@ def _preset(base, **kwargs):
 
 RackAwareGoal = _preset(_RackAwareBase, name="RackAwareGoal", is_hard=True,
                         partition_additive_scores=True,
-                        independent_per_broker=True)
+                        independent_per_broker=True,
+                        prefers_wide_batches=True)
 RackAwareDistributionGoal = _preset(_RackAwareDistBase,
                                     name="RackAwareDistributionGoal", is_hard=True,
                                     partition_additive_scores=True,
@@ -47,46 +48,58 @@ RackAwareDistributionGoal = _preset(_RackAwareDistBase,
 ReplicaCapacityGoal = _preset(_ReplicaCapacityBase, name="ReplicaCapacityGoal",
                               is_hard=True)
 DiskCapacityGoal = _preset(ResourceCapacityGoal, name="DiskCapacityGoal",
-                           is_hard=True, resource=Resource.DISK)
+                           is_hard=True, uses_resource_metrics=True,
+                           resource=Resource.DISK)
 NetworkInboundCapacityGoal = _preset(ResourceCapacityGoal,
                                      name="NetworkInboundCapacityGoal",
-                                     is_hard=True, resource=Resource.NW_IN)
+                                     is_hard=True, uses_resource_metrics=True,
+                                     resource=Resource.NW_IN)
 NetworkOutboundCapacityGoal = _preset(ResourceCapacityGoal,
                                       name="NetworkOutboundCapacityGoal",
                                       is_hard=True, include_leadership=True,
+                                      uses_resource_metrics=True,
                                       resource=Resource.NW_OUT)
 CpuCapacityGoal = _preset(ResourceCapacityGoal, name="CpuCapacityGoal",
                           is_hard=True, include_leadership=True,
+                          uses_resource_metrics=True,
                           resource=Resource.CPU)
 DiskUsageDistributionGoal = _preset(ResourceDistributionGoal,
                                     name="DiskUsageDistributionGoal",
                                     supports_swap=True,
+                                    uses_resource_metrics=True,
                                     resource=Resource.DISK)
 NetworkInboundUsageDistributionGoal = _preset(ResourceDistributionGoal,
                                               name="NetworkInboundUsageDistributionGoal",
                                               supports_swap=True,
+                                              uses_resource_metrics=True,
                                               resource=Resource.NW_IN)
 NetworkOutboundUsageDistributionGoal = _preset(ResourceDistributionGoal,
                                                name="NetworkOutboundUsageDistributionGoal",
                                                include_leadership=True,
                                                supports_swap=True,
+                                               uses_resource_metrics=True,
                                                resource=Resource.NW_OUT)
 CpuUsageDistributionGoal = _preset(ResourceDistributionGoal,
                                    name="CpuUsageDistributionGoal",
                                    include_leadership=True,
                                    supports_swap=True,
+                                   uses_resource_metrics=True,
                                    resource=Resource.CPU)
 ReplicaDistributionGoal = _preset(CountDistributionGoal,
-                                  name="ReplicaDistributionGoal", leaders=False)
+                                  name="ReplicaDistributionGoal", leaders=False,
+                                  prefers_wide_batches=True)
 LeaderReplicaDistributionGoal = _preset(CountDistributionGoal,
                                         name="LeaderReplicaDistributionGoal",
-                                        include_leadership=True, leaders=True)
+                                        include_leadership=True, leaders=True,
+                                        prefers_wide_batches=True)
 TopicReplicaDistributionGoal = _preset(_TopicReplicaBase,
                                        name="TopicReplicaDistributionGoal")
-PotentialNwOutGoal = _preset(_PotentialNwOutBase, name="PotentialNwOutGoal")
+PotentialNwOutGoal = _preset(_PotentialNwOutBase, name="PotentialNwOutGoal",
+                             uses_resource_metrics=True)
 LeaderBytesInDistributionGoal = _preset(_LeaderBytesInBase,
                                         name="LeaderBytesInDistributionGoal",
                                         include_leadership=True,
+                                        uses_resource_metrics=True,
                                         leadership_only=True)
 PreferredLeaderElectionGoal = _preset(_PreferredLeaderBase,
                                       name="PreferredLeaderElectionGoal",
@@ -106,7 +119,7 @@ KafkaAssignerEvenRackAwareGoal = _preset(_KafkaAssignerRackBase,
                                          partition_additive_scores=True)
 KafkaAssignerDiskUsageDistributionGoal = _preset(
     _KafkaAssignerDiskBase, name="KafkaAssignerDiskUsageDistributionGoal",
-    supports_swap=True)
+    supports_swap=True, uses_resource_metrics=True)
 
 ALL_GOALS = {cls.__name__: cls for cls in [
     RackAwareGoal, RackAwareDistributionGoal, ReplicaCapacityGoal,

@@ -57,6 +57,25 @@ class Goal:
     # TopicReplicaDistributionGoal: rounds 482 -> 106, balancedness and
     # violated set unchanged).
     prefers_wide_batches: bool = False
+    # True for goals whose decisions read measured resource loads (the
+    # capacity / resource-distribution / potential-NW-out / leader-bytes-in
+    # family): they need a substantially complete metric model, mirroring
+    # ResourceDistributionGoal.clusterModelCompletenessRequirements:164-167
+    # (numWindows/2 valid windows + min.valid.partition.ratio). Structural
+    # goals (rack, counts, preferred leader) run on topology alone — one
+    # window, any coverage (ReplicaDistributionAbstractGoal's weak
+    # requirements).
+    uses_resource_metrics: bool = False
+
+    def completeness_requirements(self, num_windows: int,
+                                  min_valid_partition_ratio: float,
+                                  ) -> tuple[int, float]:
+        """(min_valid_windows, min_monitored_partitions_ratio) this goal
+        needs before its output is trustworthy
+        (Goal.clusterModelCompletenessRequirements)."""
+        if self.uses_resource_metrics:
+            return max(1, num_windows // 2), min_valid_partition_ratio
+        return 1, 0.0
 
     # -- evaluation kernels (traced) --------------------------------------
     def prepare_partial(self, state: ClusterTensors, num_topics: int) -> Any:

@@ -452,21 +452,43 @@ class LeaderBytesInDistributionGoal(Goal):
 
 @dataclasses.dataclass(frozen=True)
 class PreferredLeaderElectionGoal(Goal):
-    """Make slot 0 (the preferred replica) the leader everywhere
-    (PreferredLeaderElectionGoal.java:232LoC). Leadership-only."""
+    """Make the PREFERRED replica the leader everywhere — the first
+    replica in list order whose broker is allowed to lead
+    (PreferredLeaderElectionGoal.java:232LoC: demoted/excluded brokers are
+    skipped, so demotion moves leadership to the next eligible replica,
+    not merely to slot 0). Leadership-only."""
+
+    def _preferred_slot(self, state, derived):
+        """[P] int32 — first existing slot whose broker may lead;
+        ``S`` (out of range) when no slot is eligible."""
+        b = state.num_brokers
+        exists = replica_exists(state)
+        ok = exists & derived.allowed_leadership[
+            jnp.clip(state.assignment, 0, b - 1)]
+        s = state.max_replication_factor
+        slot_ids = jnp.arange(s, dtype=jnp.int32)[None, :]
+        return jnp.where(ok, slot_ids, s).min(axis=1)
+
+    def _misled(self, state, derived):
+        """[P] bool — leader differs from the preferred eligible slot."""
+        pref = self._preferred_slot(state, derived)
+        s = state.max_replication_factor
+        return state.partition_mask & (pref < s) \
+            & (state.leader_slot != pref)
 
     def broker_violations(self, state, derived, constraint, aux):
-        not_preferred = (state.leader_slot > 0) & state.partition_mask
+        misled = self._misled(state, derived)
         b = state.num_brokers
         lead_b = jnp.take_along_axis(
             state.assignment, jnp.maximum(state.leader_slot, 0)[:, None], axis=1)[:, 0]
-        seg = jnp.where(not_preferred, jnp.clip(lead_b, 0, b - 1), b)
-        return jax.ops.segment_sum(not_preferred.astype(jnp.float32), seg,
+        seg = jnp.where(misled, jnp.clip(lead_b, 0, b - 1), b)
+        return jax.ops.segment_sum(misled.astype(jnp.float32), seg,
                                    num_segments=b + 1)[:b]
 
     def improvement(self, state, derived, constraint, aux, deltas):
+        pref = self._preferred_slot(state, derived)[deltas.partition]
         is_lead = deltas.replica_delta == 0
-        fixes = (deltas.src_slot != 0) & (deltas.dst_slot == 0)
+        fixes = (deltas.src_slot != pref) & (deltas.dst_slot == pref)
         imp = jnp.where(is_lead & fixes, 1.0, 0.0)
         return jnp.where(deltas.valid, imp, -jnp.inf)
 
@@ -474,8 +496,8 @@ class PreferredLeaderElectionGoal(Goal):
         return jnp.where(derived.allowed_leadership, 0.0, -jnp.inf)
 
     def replica_weight(self, state, derived, constraint, aux):
-        not_preferred = (state.leader_slot > 0)[:, None]
-        return jnp.where(is_leader_slot(state) & not_preferred, 1.0, -jnp.inf)
+        misled = self._misled(state, derived)[:, None]
+        return jnp.where(is_leader_slot(state) & misled, 1.0, -jnp.inf)
 
     def source_score(self, state, derived, constraint, aux):
         return jnp.ones(state.num_brokers)

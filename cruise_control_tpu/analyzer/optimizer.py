@@ -245,6 +245,18 @@ class GoalOptimizer:
                               excluded_replica_move_brokers=rm_mask,
                               excluded_leadership_brokers=ld_mask)
 
+    @staticmethod
+    def _widen(search_cfg: SearchConfig) -> SearchConfig:
+        """The wide-batch grid: 4x sources, 2x moves (floored at the base
+        config so an operator-raised solver.moves.per.round can never make
+        the "wide" config narrower than the narrow one)."""
+        return dataclasses.replace(
+            search_cfg,
+            num_sources=max(search_cfg.num_sources,
+                            min(2048, search_cfg.num_sources * 4)),
+            moves_per_round=max(search_cfg.moves_per_round,
+                                min(2048, search_cfg.moves_per_round * 2)))
+
     def _wide_config(self, search_cfg: SearchConfig,
                      goal_chain: Sequence[Goal],
                      num_brokers: int) -> SearchConfig | None:
@@ -253,19 +265,12 @@ class GoalOptimizer:
         goals cut their round count ~4x at measured-identical quality
         (TopicReplicaDistribution at 1k/100k: 482 -> 106 rounds, same
         balancedness and violated set; one extra compile of the chain
-        kernels at the wide shape). Floored at the base config so an
-        operator-raised solver.moves.per.round can never make the "wide"
-        config narrower than the narrow one."""
+        kernels at the wide shape)."""
         threshold = self._config.get_int("solver.wide.batch.min.brokers")
         if threshold <= 0 or num_brokers < threshold \
                 or not any(g.prefers_wide_batches for g in goal_chain):
             return None
-        return dataclasses.replace(
-            search_cfg,
-            num_sources=max(search_cfg.num_sources,
-                            min(2048, search_cfg.num_sources * 4)),
-            moves_per_round=max(search_cfg.moves_per_round,
-                                min(2048, search_cfg.moves_per_round * 2)))
+        return self._widen(search_cfg)
 
     def _resolve_broker_sets(self, goal_chain: list[Goal],
                              meta: ClusterMeta) -> list[Goal]:
@@ -317,6 +322,18 @@ class GoalOptimizer:
         goal_chain = self._resolve_broker_sets(goal_chain, meta)
         masks = self._masks(state, meta, options)
         search_cfg = self.search_config(state)
+        # fast_mode (ParameterUtils FAST_MODE_PARAM): the reference bounds
+        # per-broker greedy time (fast.mode.per.broker.move.timeout.ms,
+        # ResourceDistributionGoal.java:470-475). The batch-search analogue:
+        # every goal runs the WIDE grid (fewer, coarser rounds) and each
+        # goal's search wall-clock is capped at timeout_ms x num_brokers on
+        # the bounded-dispatch path.
+        fast = bool(options.fast_mode)
+        if fast:
+            search_cfg = self._widen(search_cfg)
+        fast_budget_s = (self._config.get_long(
+            "fast.mode.per.broker.move.timeout.ms") * state.num_brokers
+            / 1000.0) if fast else 0.0
         initial = state
         stats_before = cluster_stats(state)
 
@@ -347,7 +364,7 @@ class GoalOptimizer:
                 dispatch_target_s=self._dispatch_target_s)
             goal_results = _apportioned_goal_results(
                 goal_chain, infos, time.time() - t0)
-        elif self._fused_chain and (
+        elif self._fused_chain and not fast and (
                 self._fused_max_brokers == 0
                 or state.num_brokers <= self._fused_max_brokers):
             # Production path at small/medium scale: the whole chain in ONE
@@ -365,8 +382,8 @@ class GoalOptimizer:
             # runtime's execution watchdog at 1k+ brokers (also kept for
             # equivalence tests and per-goal wall-clock attribution). Same
             # on-entry violated_before semantics as the fused path.
-            dispatch_rounds = self._dispatch_rounds if self._fused_chain \
-                else 0
+            dispatch_rounds = self._dispatch_rounds \
+                if (self._fused_chain or fast) else 0
             # One adaptive controller across the chain: per-round cost is a
             # property of the cluster shape, not the goal, so the budget
             # learned on goal 1 carries to goal 15.
@@ -374,8 +391,10 @@ class GoalOptimizer:
             controller = AdaptiveDispatch(
                 dispatch_rounds, self._dispatch_target_s) \
                 if dispatch_rounds > 0 else None
-            wide_cfg = self._wide_config(search_cfg, goal_chain,
-                                         state.num_brokers)
+            # In fast mode search_cfg is already wide for every goal — a
+            # second per-goal widening would compile a third grid shape.
+            wide_cfg = None if fast else self._wide_config(
+                search_cfg, goal_chain, state.num_brokers)
             # Wide rounds cost ~4x a narrow round, so the wide goals get
             # their OWN dispatch controller: a round budget learned on
             # cheap narrow dispatches would overshoot the wall-clock
@@ -393,7 +412,8 @@ class GoalOptimizer:
                     wide_cfg if use_wide else search_cfg,
                     meta.num_topics, masks,
                     dispatch_rounds=dispatch_rounds,
-                    dispatch=controller_wide if use_wide else controller)
+                    dispatch=controller_wide if use_wide else controller,
+                    wall_budget_s=fast_budget_s)
                 goal_results.append(GoalResult(
                     name=g.name, is_hard=g.is_hard,
                     succeeded=info["succeeded"],

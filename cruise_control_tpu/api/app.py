@@ -132,6 +132,8 @@ def main(argv: list[str] | None = None) -> int:
 
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s "
                         "%(levelname)s %(message)s")
+    from cruise_control_tpu import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
     overrides = load_properties(args.properties) if args.properties else {}
     if overrides.get("bootstrap.servers") and not args.demo:
         # Live mode: the wire-protocol client manages the real cluster.

@@ -36,6 +36,13 @@ def _long_ms(v: str) -> int:
     return _int(v)
 
 
+def _float(v: str) -> float:
+    try:
+        return float(v)
+    except ValueError:
+        raise ParameterParseError(f"not a number: {v!r}")
+
+
 def _str(v: str) -> str:
     return v
 
@@ -95,7 +102,7 @@ SCHEMAS: dict[EndPoint, dict[str, Callable[[str], Any]]] = {
     EndPoint.PARTITION_LOAD: {"resource": _str, "start": _long_ms, "end": _long_ms,
                               "entries": _int, "max_load": _bool, "avg_load": _bool,
                               "topic": _str, "partition": _str,
-                              "min_valid_partition_ratio": _str,
+                              "min_valid_partition_ratio": _float,
                               "allow_capacity_estimation": _bool,
                               "brokerid": _int_csv},
     EndPoint.PROPOSALS: _PROPOSAL_PARAMS,

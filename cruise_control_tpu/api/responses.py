@@ -27,8 +27,32 @@ def envelope(payload: dict) -> dict:
     return {"version": JSON_VERSION, **payload}
 
 
-def broker_stats(state: ClusterTensors, meta: ClusterMeta) -> dict:
-    """LOAD endpoint body (response/stats/BrokerStats.java)."""
+def broker_capacities(admin, capacity_resolver) -> dict:
+    """LOAD?capacity_only=true body: per-broker capacities straight from
+    the capacity config — no metric model required (ParameterUtils
+    capacityOnly excludes the time/model params)."""
+    rows = []
+    for bid in sorted(admin.alive_brokers()):
+        caps = capacity_resolver.capacity_for(bid)
+        rows.append({
+            "Broker": bid,
+            "DiskMB": round(float(caps[Resource.DISK]), 3),
+            "CpuPct": round(float(caps[Resource.CPU]), 3),
+            "NwInRate": round(float(caps[Resource.NW_IN]), 3),
+            "NwOutRate": round(float(caps[Resource.NW_OUT]), 3),
+            "DiskCapacityByLogdir":
+                capacity_resolver.disk_capacity_by_logdir(bid),
+            "Estimated": bool(getattr(capacity_resolver, "is_estimated",
+                                      lambda _b: False)(bid)),
+        })
+    return envelope({"brokers": rows, "hosts": []})
+
+
+def broker_stats(state: ClusterTensors, meta: ClusterMeta,
+                 disk_info=None) -> dict:
+    """LOAD endpoint body (response/stats/BrokerStats.java).
+    ``disk_info`` = (logdirs_by_broker, capacity_resolver) adds per-logdir
+    capacity + liveness per broker (populate_disk_info=true)."""
     loads = np.asarray(broker_load(state), dtype=np.float64)       # [B, R]
     caps = np.asarray(state.capacity, dtype=np.float64)
     with np.errstate(divide="ignore", invalid="ignore"):
@@ -44,7 +68,7 @@ def broker_stats(state: ClusterTensors, meta: ClusterMeta) -> dict:
     for i, bid in enumerate(meta.broker_ids):
         if not mask[i]:
             continue
-        rows.append({
+        row = {
             "Broker": bid,
             "BrokerState": BrokerState(int(states[i])).name,
             "Rack": meta.rack_names[int(racks[i])],
@@ -56,15 +80,31 @@ def broker_stats(state: ClusterTensors, meta: ClusterMeta) -> dict:
             "PnwOutRate": round(float(pnw[i]), 3),
             "Replicas": int(replicas[i]),
             "Leaders": int(leaders[i]),
-        })
+        }
+        if disk_info is not None:
+            logdirs_by_broker, resolver = disk_info
+            caps_by_dir = resolver.disk_capacity_by_logdir(bid) or {}
+            alive_dirs = logdirs_by_broker.get(bid, {})
+            row["DiskState"] = {
+                d: {"DiskMB": round(float(caps_by_dir.get(d, 0.0)), 3),
+                    "alive": bool(alive)}
+                for d, alive in sorted(alive_dirs.items())} or {
+                d: {"DiskMB": round(float(c), 3), "alive": True}
+                for d, c in sorted(caps_by_dir.items())}
+        rows.append(row)
     return envelope({"brokers": rows, "hosts": []})
 
 
 def partition_load(state: ClusterTensors, meta: ClusterMeta,
                    resource: str = "DISK", entries: int | None = None,
-                   max_load: bool = False) -> dict:
+                   topic_rx: str | None = None,
+                   partition_range: str | None = None,
+                   brokerids: tuple[int, ...] = ()) -> dict:
     """PARTITION_LOAD body: partitions sorted by the requested resource,
-    heaviest first (PartitionLoadState.java)."""
+    heaviest first (PartitionLoadState.java). ``topic_rx`` is a topic
+    regex, ``partition_range`` a partition id or "start-end" range, and
+    ``brokerids`` keeps only partitions with a replica on one of the
+    brokers (ParameterUtils TOPIC/PARTITION/BROKER_ID params)."""
     aliases = {"NETWORK_INBOUND": "NW_IN", "NETWORK_OUTBOUND": "NW_OUT"}
     name = resource.upper()
     try:
@@ -72,6 +112,26 @@ def partition_load(state: ClusterTensors, meta: ClusterMeta,
     except KeyError:
         from .parameters import ParameterParseError
         raise ParameterParseError(f"unknown resource {resource!r}")
+    from .parameters import ParameterParseError
+    rx = None
+    if topic_rx:
+        import re
+        try:
+            rx = re.compile(topic_rx)
+        except re.error as e:
+            raise ParameterParseError(f"bad topic regex {topic_rx!r}: {e}")
+    p_lo = p_hi = None
+    if partition_range:
+        lo, sep, hi = partition_range.partition("-")
+        try:
+            p_lo = int(lo)
+            p_hi = int(hi) if sep else p_lo
+        except ValueError:
+            raise ParameterParseError(
+                f"bad partition range {partition_range!r} (want N or N-M)")
+    want_brokers = {int(b) for b in brokerids}
+    id_of = {bid: i for i, bid in enumerate(meta.broker_ids)}
+    want_idx = {id_of[b] for b in want_brokers if b in id_of}
     per_slot = np.asarray(replica_load(state))          # [P, S, R]
     mask = np.asarray(state.partition_mask)
     leader_loads = np.asarray(state.leader_load)
@@ -79,10 +139,21 @@ def partition_load(state: ClusterTensors, meta: ClusterMeta,
     assignment = np.asarray(state.assignment)
     leader_slot = np.asarray(state.leader_slot)
     records = []
-    for p in order[: entries or len(order)]:
+    for p in order:
+        if entries is not None and len(records) >= entries:
+            break
         if not mask[p]:
             continue
         topic, part = meta.partition_index[int(p)]
+        if rx is not None and not rx.fullmatch(topic):
+            continue
+        if p_lo is not None and not (p_lo <= part <= p_hi):
+            continue
+        if want_brokers and not any(int(b) in want_idx for b in assignment[p]
+                                    if b >= 0):
+            # Guard on the REQUESTED set: ids that don't resolve to model
+            # brokers must filter everything out, not disable the filter.
+            continue
         ls = int(leader_slot[p])
         leader_b = int(assignment[p, ls]) if 0 <= ls < assignment.shape[1] else -1
         followers = [int(meta.broker_ids[b]) for s, b in enumerate(assignment[p])

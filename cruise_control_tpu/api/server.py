@@ -61,6 +61,50 @@ _REASON_REQUIRED_ENDPOINTS = {
     EndPoint.TOPIC_CONFIGURATION, EndPoint.REMOVE_DISKS,
 }
 
+# The two-goal chain kafka_assigner mode swaps in
+# (ParameterUtils.getGoals:755-771, RunnableUtils.KAFKA_ASSIGNER_GOALS).
+_KAFKA_ASSIGNER_GOALS = ["KafkaAssignerEvenRackAwareGoal",
+                         "KafkaAssignerDiskUsageDistributionGoal"]
+
+# Endpoints whose EXPLICIT goal lists must contain the configured hard
+# goals (GoalBasedOperationRunnable.init → sanityCheckGoals; PROPOSALS is
+# dryrun-only and exempt, as in ProposalsParameters).
+_HARD_GOAL_CHECKED_ENDPOINTS = {
+    EndPoint.REBALANCE, EndPoint.ADD_BROKER, EndPoint.REMOVE_BROKER,
+    EndPoint.FIX_OFFLINE_REPLICAS, EndPoint.TOPIC_CONFIGURATION,
+}
+
+
+def _resolve_goal_names(p: dict) -> list[str] | None:
+    """Request goal list after mode switches (ParameterUtils.getGoals:755):
+    kafka_assigner mode uses exactly the two assigner goals and conflicts
+    with both explicit goals and rebalance-disk mode; rebalance-disk mode
+    picks its intra-broker chain in the facade."""
+    explicit = list(p["goals"]) if "goals" in p else None
+    if p.get("kafka_assigner"):
+        if p.get("rebalance_disk"):
+            raise ParameterParseError(
+                "Kafka assigner mode and rebalance disk mode cannot be set "
+                "at the same time.")
+        if explicit:
+            raise ParameterParseError(
+                "Kafka assigner mode does not support explicitly specifying "
+                "goals in request.")
+        if p.get("use_ready_default_goals"):
+            raise ParameterParseError(
+                "use_ready_default_goals is about the DEFAULT goal chain; "
+                "it cannot be combined with kafka_assigner mode.")
+        return list(_KAFKA_ASSIGNER_GOALS)
+    if p.get("rebalance_disk") and explicit:
+        raise ParameterParseError(
+            "Rebalance disk mode does not support explicitly specifying "
+            "goals in request.")
+    if explicit and p.get("use_ready_default_goals"):
+        raise ParameterParseError(
+            "use_ready_default_goals cannot be combined with explicitly "
+            "specified goals.")
+    return explicit
+
 
 class ApiError(Exception):
     def __init__(self, status: int, message: str):
@@ -186,6 +230,13 @@ class CruiseControlApi:
         out_headers: dict[str, str] = {}
         try:
             endpoint = self._resolve(method, path)
+            # The doas request param (ParameterUtils DO_AS_PARAM) is the
+            # query-string form of trusted-proxy delegation: surface it to
+            # the provider as the X-Do-As header when none is present.
+            if "doas=" in query_string and "X-Do-As" not in headers:
+                qs = urllib.parse.parse_qs(query_string)
+                if qs.get("doas"):
+                    headers = {**headers, "X-Do-As": qs["doas"][-1]}
             principal = self._security.authenticate(headers, remote_addr)
             self._security.authorize(principal, endpoint)
             query = urllib.parse.parse_qs(query_string, keep_blank_values=True)
@@ -319,7 +370,9 @@ class CruiseControlApi:
                       principal: Principal) -> dict:
         cc = self._cc
         if endpoint is EndPoint.STATE:
-            return responses.envelope(cc.state(p.get("substates", ())))
+            return responses.envelope(cc.state(
+                p.get("substates", ()),
+                super_verbose=p.get("super_verbose", False)))
         if endpoint is EndPoint.KAFKA_CLUSTER_STATE:
             return responses.kafka_cluster_state(cc._admin, p.get("topic", ""))
         if endpoint is EndPoint.USER_TASKS:
@@ -330,7 +383,27 @@ class CruiseControlApi:
             eps = set(p.get("endpoints", ()))
             if eps:
                 tasks = [t for t in tasks if t.endpoint in eps]
+            clients = set(p.get("client_ids", ()))
+            if clients:
+                tasks = [t for t in tasks if t.client in clients]
+            # types filter: task state names, e.g. Active / Completed /
+            # CompletedWithError (UserTaskManager.TaskState).
+            states = {s.lower() for s in p.get("types", ())}
+            if states:
+                tasks = [t for t in tasks
+                         if t.to_dict()["Status"].lower() in states]
             tasks = tasks[: p.get("entries", len(tasks))]
+            if p.get("fetch_completed_task"):
+                # Return the stored final response of each completed task
+                # instead of the summary row (FETCH_COMPLETED_TASK_PARAM).
+                out = []
+                for t in tasks:
+                    body = None
+                    if t.future is not None and t.future.done() \
+                            and not t.future.exception():
+                        body = t.future.result()
+                    out.append({**t.to_dict(), "originalResponse": body})
+                return responses.envelope({"userTasks": out})
             return responses.envelope(
                 {"userTasks": [t.to_dict() for t in tasks]})
         if endpoint is EndPoint.REVIEW_BOARD:
@@ -448,10 +521,36 @@ class CruiseControlApi:
             changed["droppedRecentlyDemoted"] = sorted(dropped_demoted)
         return responses.envelope(changed or {"message": "no admin action given"})
 
+    def _sanity_check_hard_goals(self, endpoint: EndPoint, p: dict) -> None:
+        """Explicitly requested goals must include every configured hard
+        goal unless skip_hard_goal_check=true
+        (KafkaCruiseControlUtils.sanityCheckGoals:426-437; a sole
+        PreferredLeaderElectionGoal is exempt). Mode-derived chains
+        (kafka_assigner, rebalance_disk) are not user goal lists and skip
+        the check."""
+        explicit = p.get("goals")
+        if endpoint not in _HARD_GOAL_CHECKED_ENDPOINTS or not explicit \
+                or p.get("skip_hard_goal_check", False):
+            return
+        short = [g.rsplit(".", 1)[-1] for g in explicit]
+        if short == ["PreferredLeaderElectionGoal"]:
+            return
+        hard = {g.rsplit(".", 1)[-1]
+                for g in self._cc._config.get_list("hard.goals")}
+        missing = sorted(hard - set(short))
+        if missing:
+            raise ParameterParseError(
+                f"Missing hard goals {missing} in the provided goals: "
+                f"{short}. Add skip_hard_goal_check=true parameter to "
+                "ignore this sanity check.")
+
     def _async_work(self, endpoint: EndPoint, p: dict):
         cc = self._cc
         dryrun = p.get("dryrun", True)
-        goals = list(p["goals"]) if "goals" in p else None
+        goals = _resolve_goal_names(p)
+        self._sanity_check_hard_goals(endpoint, p)
+        use_ready = p.get("use_ready_default_goals", False)
+        fast_mode = p.get("fast_mode", False)
         reason = p.get("reason", "")
         verbose = p.get("verbose", False)
 
@@ -479,23 +578,71 @@ class CruiseControlApi:
                 conc["leadership_per_broker"] = \
                     p["broker_concurrent_leader_movements"]
             strategies = p.get("replica_movement_strategies", ())
-            if conc or strategies:
-                return cc.execution_overrides(strategies, conc)
+            extras = {}
+            if "execution_progress_check_interval_ms" in p:
+                extras["progress_check_interval_s"] = \
+                    p["execution_progress_check_interval_ms"] / 1000.0
+            if "replication_throttle" in p:
+                extras["replication_throttle"] = p["replication_throttle"]
+            if p.get("stop_ongoing_execution"):
+                extras["stop_ongoing_execution"] = True
+            # throttle_added_broker / throttle_removed_broker = false:
+            # leave the brokers being added/removed unthrottled
+            # (AddedOrRemovedBrokerParameters.java).
+            if (endpoint is EndPoint.ADD_BROKER
+                    and not p.get("throttle_added_broker", True)) \
+                    or (endpoint is EndPoint.REMOVE_BROKER
+                        and not p.get("throttle_removed_broker", True)):
+                extras["throttle_excluded_brokers"] = \
+                    tuple(p.get("brokerid", ()))
+            if conc or strategies or extras:
+                return cc.execution_overrides(strategies, conc, extras)
             return contextlib.nullcontext()
 
         def load():
-            state, meta = cc.load_monitor.cluster_model()
-            return responses.broker_stats(state, meta)
+            if p.get("capacity_only"):
+                # capacity_only=true answers from the capacity config alone
+                # — no metric completeness needed (ParameterUtils
+                # capacityOnly, excludes the time-range params).
+                return responses.broker_capacities(
+                    cc._admin, cc.load_monitor.capacity_resolver)
+            state, meta = cc.load_monitor.cluster_model(
+                allow_capacity_estimation=p.get("allow_capacity_estimation",
+                                                True),
+                start_ms=p.get("start", -1),
+                end_ms=p.get("time", p.get("end", -1)))
+            disk_info = None
+            if p.get("populate_disk_info"):
+                disk_info = (getattr(cc._admin, "describe_logdirs",
+                                     lambda: {})(),
+                             cc.load_monitor.capacity_resolver)
+            return responses.broker_stats(state, meta, disk_info=disk_info)
 
         def partition_load():
-            state, meta = cc.load_monitor.cluster_model()
+            # max_load/avg_load pick the window reduction at model build
+            # (Load.expectedUtilizationFor wantMaxLoad).
+            reduction = "max" if p.get("max_load") \
+                else ("avg" if p.get("avg_load") else "default")
+            state, meta = cc.load_monitor.cluster_model(
+                allow_capacity_estimation=p.get("allow_capacity_estimation",
+                                                True),
+                start_ms=p.get("start", -1), end_ms=p.get("end", -1),
+                min_valid_partition_ratio=p.get("min_valid_partition_ratio"),
+                reduction=reduction)
             return responses.partition_load(
                 state, meta, p.get("resource", "DISK"), p.get("entries"),
-                p.get("max_load", False))
+                topic_rx=p.get("topic"), partition_range=p.get("partition"),
+                brokerids=p.get("brokerid", ()))
+
+        data_from = p.get("data_from")
+        allow_cap = p.get("allow_capacity_estimation", True)
 
         def proposals():
             return responses.optimization_result(cc.proposals(
-                goals, p.get("ignore_proposal_cache", False)), verbose)
+                goals, p.get("ignore_proposal_cache", False),
+                use_ready_default_goals=use_ready, fast_mode=fast_mode,
+                data_from=data_from, allow_capacity_estimation=allow_cap),
+                verbose)
 
         def rebalance():
             with exec_scope():
@@ -510,30 +657,42 @@ class CruiseControlApi:
                         "exclude_recently_demoted_brokers", False),
                     exclude_recently_removed_brokers=p.get(
                         "exclude_recently_removed_brokers", False),
+                    use_ready_default_goals=use_ready, fast_mode=fast_mode,
+                    data_from=data_from, allow_capacity_estimation=allow_cap,
                     reason=reason), verbose)
 
         def add_broker():
             with exec_scope():
                 return responses.optimization_result(cc.add_brokers(
                     list(p.get("brokerid", ())), dryrun, goals,
+                    use_ready_default_goals=use_ready, fast_mode=fast_mode,
+                    data_from=data_from, allow_capacity_estimation=allow_cap,
                     reason=reason), verbose)
 
         def remove_broker():
             with exec_scope():
                 return responses.optimization_result(cc.remove_brokers(
                     list(p.get("brokerid", ())), dryrun, goals,
+                    use_ready_default_goals=use_ready, fast_mode=fast_mode,
+                    data_from=data_from, allow_capacity_estimation=allow_cap,
                     reason=reason), verbose)
 
         def demote_broker():
             with exec_scope():
                 return responses.optimization_result(cc.demote_brokers(
-                    list(p.get("brokerid", ())), dryrun, reason=reason),
-                    verbose)
+                    list(p.get("brokerid", ())), dryrun,
+                    skip_urp_demotion=p.get("skip_urp_demotion", True),
+                    exclude_follower_demotion=p.get(
+                        "exclude_follower_demotion", False),
+                    reason=reason), verbose)
 
         def fix_offline_replicas():
             with exec_scope():
                 return responses.optimization_result(cc.fix_offline_replicas(
-                    dryrun, goals, reason=reason), verbose)
+                    dryrun, goals, use_ready_default_goals=use_ready,
+                    fast_mode=fast_mode, data_from=data_from,
+                    allow_capacity_estimation=allow_cap,
+                    reason=reason), verbose)
 
         def topic_configuration():
             topic = p.get("topic")

@@ -34,8 +34,10 @@ _ENDPOINT_CLASS = {
     "STATE": CC_MONITOR, "USER_TASKS": CC_MONITOR,
     "REVIEW_BOARD": CC_MONITOR, "PERMISSIONS": CC_MONITOR,
     "ADMIN": CC_ADMIN, "REVIEW": CC_ADMIN, "PAUSE_SAMPLING": CC_ADMIN,
-    "RESUME_SAMPLING": CC_ADMIN, "STOP_PROPOSAL_EXECUTION": CC_ADMIN,
-    "RIGHTSIZE": CC_ADMIN, "BOOTSTRAP": CC_ADMIN, "TRAIN": CC_ADMIN,
+    "RESUME_SAMPLING": CC_ADMIN, "BOOTSTRAP": CC_ADMIN, "TRAIN": CC_ADMIN,
+    # STOP_PROPOSAL_EXECUTION and RIGHTSIZE act on the KAFKA cluster, not
+    # on Cruise Control itself (CruiseControlEndPoint.java assigns both to
+    # KAFKA_ADMIN) — they fall through to the KAFKA_ADMIN default below.
 }
 
 

@@ -191,8 +191,9 @@ def _definition() -> ConfigDef:
     d.define("concurrency.adjuster.interval.ms", T.LONG, 1_000,
              Range.at_least(1), I.LOW,
              "ConcurrencyAdjuster evaluation interval.")
-    d.define("concurrency.adjuster.min.isr.check.enabled", T.BOOLEAN, True,
-             None, I.LOW, "Consult (At/Under)MinISR state when adjusting.")
+    d.define("concurrency.adjuster.min.isr.check.enabled", T.BOOLEAN, False,
+             None, I.LOW, "Consult (At/Under)MinISR state when adjusting "
+             "(reference default: false, ExecutorConfig.java:583).")
     d.define("concurrency.adjuster.min.isr.retention.ms", T.LONG, 30_000,
              Range.at_least(1), I.LOW,
              "TopicMinIsrCache entry TTL (TopicMinIsrCache.java).")
@@ -334,7 +335,11 @@ def _definition() -> ConfigDef:
     d.define("goal.balancedness.strictness.weight", T.DOUBLE, 1.5, Range.at_least(1), I.LOW,
              "Extra weight for hard goals in balancedness score.")
     d.define("fast.mode.per.broker.move.timeout.ms", T.LONG, 500, Range.at_least(1), I.LOW,
-             "Fast-mode per-broker time budget.")
+             "Fast-mode (fast_mode=true request param) per-broker time "
+             "budget: each goal's search wall-clock is capped at this "
+             "value x num_brokers, and every goal runs the wide-batch "
+             "grid (fewer, coarser rounds). Batch-search mapping of the "
+             "reference's per-broker greedy timeout.")
     d.define("intra.broker.goals", T.LIST,
              ["IntraBrokerDiskCapacityGoal", "IntraBrokerDiskUsageDistributionGoal"],
              None, I.LOW, "Goal chain for rebalance_disk/remove_disks.")

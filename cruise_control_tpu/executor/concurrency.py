@@ -55,6 +55,14 @@ class ConcurrencyAdjusterConfig:
     limit_request_queue_size: float = 1000.0
     min_brokers_violate_metric_limit: int = 2
     num_min_isr_check: int = 5
+    # Per-type enablement seeds (Executor.java:230-237): operators set the
+    # concurrency.adjuster.*.enabled keys; the ADMIN endpoint can still
+    # flip them at runtime. INTRA_BROKER_REPLICA is hard-disabled in the
+    # reference (pending linkedin/cruise-control#1299) — kept OFF here for
+    # the same semantics.
+    inter_broker_enabled: bool = True
+    leadership_enabled: bool = True
+    min_isr_check_enabled: bool = False
 
     # metric-name → limit-field mapping (KafkaMetricDef BrokerMetric names;
     # ConcurrencyAdjuster's CONCURRENCY_ADJUSTER_METRICS).
@@ -111,6 +119,12 @@ class ConcurrencyAdjusterConfig:
             min_brokers_violate_metric_limit=g(
                 "min.num.brokers.violate.metric.limit.to.decrease.cluster.concurrency"),
             num_min_isr_check=g("concurrency.adjuster.num.min.isr.check"),
+            inter_broker_enabled=cfg.get_boolean(
+                "concurrency.adjuster.inter.broker.replica.enabled"),
+            leadership_enabled=cfg.get_boolean(
+                "concurrency.adjuster.leadership.enabled"),
+            min_isr_check_enabled=cfg.get_boolean(
+                "concurrency.adjuster.min.isr.check.enabled"),
         )
 
     def brokers_violating_limits(self, broker_metrics) -> int:
@@ -144,8 +158,16 @@ class ExecutionConcurrencyManager:
         self._lock = threading.Lock()
         self._inter_in_flight: dict[int, int] = {}   # broker -> count
         self._cluster_inter_in_flight = 0
-        self._adjuster_enabled = {t: True for t in self.ADJUSTER_TYPES}
-        self._min_isr_based_adjustment = True
+        # Seeded from config (Executor.java:230-237); INTRA_BROKER_REPLICA
+        # stays disabled (reference hard-disables it pending
+        # linkedin/cruise-control#1299). The ADMIN endpoint toggles these
+        # at runtime.
+        self._adjuster_enabled = {
+            "INTER_BROKER_REPLICA": self._adj.inter_broker_enabled,
+            "INTRA_BROKER_REPLICA": False,
+            "LEADERSHIP": self._adj.leadership_enabled,
+        }
+        self._min_isr_based_adjustment = self._adj.min_isr_check_enabled
 
     @property
     def adjuster_config(self) -> ConcurrencyAdjusterConfig:

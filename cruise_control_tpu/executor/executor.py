@@ -27,7 +27,7 @@ from .strategy import ReplicaMovementStrategy
 from .task import (
     ExecutionTask, ExecutionTaskManager, TaskState, TaskType,
 )
-from .throttle import ReplicationThrottleHelper
+from .throttle import _KEEP as _KEEP_RATE, ReplicationThrottleHelper
 
 
 class ExecutorState(enum.Enum):
@@ -96,6 +96,9 @@ class Executor:
         self._intra_rate_alert = intra_rate_alert_mb_s
         self._strategy = strategy
         self._interval = progress_check_interval_s
+        # Per-execution execution_progress_check_interval_ms override;
+        # cleared in _finish_run.
+        self._interval_override: float | None = None
         self._task_timeout_s = task_timeout_s
         self._throttle = ReplicationThrottleHelper(admin, replication_throttle)
         # Executor.java:1408-1424: pause/restore metric sampling around
@@ -117,6 +120,11 @@ class Executor:
 
     # ---- public surface ---------------------------------------------------
     @property
+    def _poll_interval(self) -> float:
+        return self._interval if self._interval_override is None \
+            else self._interval_override
+
+    @property
     def state(self) -> ExecutorState:
         return self._state
 
@@ -127,7 +135,11 @@ class Executor:
                           uuid: str = "",
                           stop_external_agent: bool = False,
                           strategy: ReplicaMovementStrategy | None = None,
-                          concurrency_overrides: dict | None = None) -> None:
+                          concurrency_overrides: dict | None = None,
+                          progress_check_interval_s: float | None = None,
+                          replication_throttle: int | None = None,
+                          throttle_excluded_brokers: Sequence[int] = (),
+                          ) -> None:
         """Start executing; raises OngoingExecutionError when busy
         (Executor.executeProposals:809). Reassignments already in flight
         that this executor did not start are EXTERNAL: refused by default
@@ -136,7 +148,12 @@ class Executor:
 
         ``strategy``/``concurrency_overrides`` apply to THIS execution only
         (the reference resets requested concurrency when the execution
-        finishes); the caps snapshot is restored in ``_finish_run``."""
+        finishes); the caps snapshot is restored in ``_finish_run``.
+        ``progress_check_interval_s`` (execution_progress_check_interval_ms
+        request param), ``replication_throttle`` (rate override; None =
+        keep the configured rate) and ``throttle_excluded_brokers``
+        (throttle_added_broker/throttle_removed_broker=false) likewise
+        last for this execution only."""
         with self._lock:
             if self.has_ongoing_execution():
                 raise OngoingExecutionError(
@@ -156,6 +173,14 @@ class Executor:
             # this one's starting concurrency.
             self._min_isr_window.clear()
             self._uuid = uuid
+            if progress_check_interval_s is not None:
+                self._interval_override = progress_check_interval_s
+            if replication_throttle is not None or throttle_excluded_brokers:
+                self._throttle.begin_execution(
+                    rate_override=(replication_throttle
+                                   if replication_throttle is not None
+                                   else _KEEP_RATE),
+                    excluded_brokers=throttle_excluded_brokers)
             if concurrency_overrides:
                 self._caps_snapshot = self._concurrency.snapshot()
                 self._override_dims = set(concurrency_overrides)
@@ -241,7 +266,7 @@ class Executor:
                     self._abort_pending_and_inflight(in_flight)
                     stopped = True
                     break
-                time.sleep(self._interval)
+                time.sleep(self._poll_interval)
                 self._poll_inter_broker(in_flight)
         finally:
             if self._on_sampling_mode_change:
@@ -271,6 +296,7 @@ class Executor:
         # in an in-progress state forever.
         with self._lock:
             self._state = ExecutorState.NO_TASK_IN_PROGRESS
+            self._interval_override = None
             if self._caps_snapshot is not None:
                 self._concurrency.restore(self._caps_snapshot)
                 self._caps_snapshot = None
@@ -476,7 +502,7 @@ class Executor:
                     TaskType.INTER_BROKER_REPLICA_ACTION) == 0:
                 return True
 
-            time.sleep(self._interval)
+            time.sleep(self._poll_interval)
             self._poll_inter_broker(in_flight)
 
     def _maybe_adjust_concurrency(self, parts, alive: set[int]) -> None:
@@ -606,7 +632,7 @@ class Executor:
                     TaskType.INTRA_BROKER_REPLICA_ACTION) == 0:
                 return True
 
-            time.sleep(self._interval)
+            time.sleep(self._poll_interval)
             self._poll_intra_broker(in_flight, lookup)
 
     def _poll_intra_broker(self, in_flight: list[ExecutionTask],

@@ -15,6 +15,8 @@ from typing import Iterable
 from .admin import AdminBackend
 from .task import ExecutionTask
 
+_KEEP = object()  # sentinel: begin_execution() keeps the configured rate
+
 LEADER_RATE = "leader.replication.throttled.rate"
 FOLLOWER_RATE = "follower.replication.throttled.rate"
 LEADER_REPLICAS = "leader.replication.throttled.replicas"
@@ -26,12 +28,28 @@ class ReplicationThrottleHelper:
     def __init__(self, admin: AdminBackend, rate_bytes_per_sec: int | None):
         self._admin = admin
         self._rate = rate_bytes_per_sec
+        self._default_rate = rate_bytes_per_sec
+        # Brokers excluded from throttling for the CURRENT execution
+        # (throttle_added_broker/throttle_removed_broker=false:
+        # ReplicationThrottleHelper.java applies rates only to brokers the
+        # caller asks to throttle).
+        self._excluded_brokers: set[int] = set()
         # broker/topic -> {key: previous value} so operator-set throttles are
         # restored on clear (ReplicationThrottleHelper.java checks existing
         # configs before removing). None marks a key that did not exist;
         # clear passes it through as a config DELETE.
         self._saved_broker: dict[int, dict[str, str | None]] = {}
         self._saved_topic: dict[str, dict[str, str | None]] = {}
+
+    def begin_execution(self, rate_override: int | None = _KEEP,
+                        excluded_brokers: Iterable[int] = ()) -> None:
+        """Per-execution settings (cleared by ``clear_throttles``): a
+        replication_throttle request-param override of the configured rate,
+        and brokers to leave unthrottled
+        (throttle_added_broker/throttle_removed_broker=false)."""
+        if rate_override is not _KEEP:
+            self._rate = rate_override
+        self._excluded_brokers = set(excluded_brokers)
 
     def set_throttles(self, tasks: Iterable[ExecutionTask]) -> None:
         if self._rate is None:
@@ -41,6 +59,7 @@ class ReplicationThrottleHelper:
         for t in tasks:
             brokers |= set(t.proposal.old_replicas) | set(t.proposal.new_replicas)
             topics.add(t.proposal.topic)
+        brokers -= self._excluded_brokers
         new_brokers = brokers - self._saved_broker.keys()
         if new_brokers:
             existing = self._admin.describe_broker_configs(new_brokers)
@@ -61,11 +80,13 @@ class ReplicationThrottleHelper:
                 for t in new_topics})
 
     def clear_throttles(self) -> None:
-        if self._rate is None:
-            return
-        if self._saved_broker:
-            self._admin.alter_broker_configs(dict(self._saved_broker))
-            self._saved_broker.clear()
-        if self._saved_topic:
-            self._admin.alter_topic_configs(dict(self._saved_topic))
-            self._saved_topic.clear()
+        if self._rate is not None:
+            if self._saved_broker:
+                self._admin.alter_broker_configs(dict(self._saved_broker))
+                self._saved_broker.clear()
+            if self._saved_topic:
+                self._admin.alter_topic_configs(dict(self._saved_topic))
+                self._saved_topic.clear()
+        # Per-execution overrides do not outlive the execution.
+        self._rate = self._default_rate
+        self._excluded_brokers = set()

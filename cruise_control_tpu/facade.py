@@ -18,6 +18,7 @@ identical thing on its object graph before optimizing.
 from __future__ import annotations
 
 import contextvars
+import dataclasses
 import logging
 import threading
 import time
@@ -54,10 +55,12 @@ from .monitor.task_runner import SamplingMode
 LOG = logging.getLogger(__name__)
 OPERATION_LOG = logging.getLogger("cruise_control_tpu.operation")
 
-# Per-request execution overrides (strategy, concurrency dict) — thread/task
-# scoped via ContextVar; see CruiseControl.execution_overrides.
+# Per-request execution overrides (strategy, concurrency dict, extras dict)
+# — thread/task scoped via ContextVar; see CruiseControl.execution_overrides.
+# extras keys: progress_check_interval_s, replication_throttle,
+# throttle_excluded_brokers, stop_ongoing_execution.
 _EXECUTION_OVERRIDES: contextvars.ContextVar[tuple] = \
-    contextvars.ContextVar("execution_overrides", default=(None, {}))
+    contextvars.ContextVar("execution_overrides", default=(None, {}, {}))
 
 
 @dataclass
@@ -308,8 +311,44 @@ class CruiseControl:
 
     # -- model helpers -----------------------------------------------------
     def _model(self, requirements: ModelCompletenessRequirements | None = None,
+               allow_capacity_estimation: bool = True,
                ) -> tuple[ClusterTensors, ClusterMeta]:
-        return self._load_monitor.cluster_model(requirements)
+        return self._load_monitor.cluster_model(
+            requirements, allow_capacity_estimation=allow_capacity_estimation)
+
+    def _chain_and_model(self, goals, use_ready_default_goals: bool,
+                         data_from: str | None,
+                         allow_capacity_estimation: bool):
+        """Shared preamble of every goal-based operation: resolve the goal
+        chain (ready-filtered when asked), then build the model under the
+        chain's data_from-derived completeness requirements."""
+        chain = self._goal_chain(goals, use_ready_default_goals)
+        state, meta = self._model(
+            self._requirements_for(data_from, chain),
+            allow_capacity_estimation=allow_capacity_estimation)
+        return chain, state, meta
+
+    def _requirements_for(self, data_from: str | None, chain,
+                          ) -> ModelCompletenessRequirements | None:
+        """data_from request param → model completeness requirements
+        (GoalBasedOptimizationParameters.getRequirements:93 merged weaker
+        with the chain's own requirements): valid_windows weakens the
+        window count to 1; valid_partitions keeps the chain's window
+        requirement but drops the partition-coverage floor."""
+        if not data_from:
+            return None
+        df = data_from.lower()
+        nw = self._config.get_int("num.partition.metrics.windows")
+        ratio = self._config.get_double("min.valid.partition.ratio")
+        if df == "valid_windows":
+            return ModelCompletenessRequirements(1, ratio)
+        if df == "valid_partitions":
+            goal_windows = max(
+                (g.completeness_requirements(nw, ratio)[0] for g in chain),
+                default=1)
+            return ModelCompletenessRequirements(goal_windows, 0.0)
+        raise ValueError(f"unknown data_from {data_from!r} "
+                         "(valid_windows | valid_partitions)")
 
     def alive_brokers(self) -> set[int]:
         """Live broker set (anomaly re-validation + dashboards)."""
@@ -339,25 +378,68 @@ class CruiseControl:
             state = set_broker_state(state, np.int32(i), int(code))
         return state
 
-    def _goal_chain(self, goals: Sequence[str] | None):
+    def _goal_chain(self, goals: Sequence[str] | None,
+                    use_ready_default_goals: bool = False):
         names = list(goals) if goals else None
-        return goals_by_priority(self._config, names)
+        chain = goals_by_priority(self._config, names)
+        if names is None and use_ready_default_goals:
+            ready = self.ready_goals(chain)
+            if not ready:
+                raise ValueError(
+                    "use_ready_default_goals: no default goal's model-"
+                    "completeness requirement is currently met")
+            chain = ready
+        return chain
+
+    def ready_goals(self, chain=None, monitor_state=None) -> list:
+        """The subset of ``chain`` (default: the configured goal chain)
+        whose model-completeness requirements the monitor currently meets
+        (Goal.clusterModelCompletenessRequirements × the monitor's valid
+        windows/coverage; the ``use_ready_default_goals`` request param and
+        the STATE AnalyzerState.readyGoals field). Pass ``monitor_state``
+        when one is already computed — LoadMonitor.state() walks the whole
+        partition metadata, too expensive to repeat per request."""
+        if chain is None:
+            chain = goals_by_priority(self._config)
+        try:
+            ms = monitor_state or self._load_monitor.state()
+            windows, coverage = ms.num_valid_windows, \
+                ms.monitored_partitions_percentage
+        except Exception:  # noqa: BLE001 — monitor not started yet
+            return []
+        num_windows = self._config.get_int("num.partition.metrics.windows")
+        min_ratio = self._config.get_double("min.valid.partition.ratio")
+        out = []
+        for g in chain:
+            need_w, need_ratio = g.completeness_requirements(
+                num_windows, min_ratio)
+            if windows >= need_w and coverage >= need_ratio:
+                out.append(g)
+        return out
 
     @contextmanager
     def execution_overrides(self,
                             replica_movement_strategies: Sequence[str] = (),
-                            concurrency: Mapping[str, int] | None = None):
+                            concurrency: Mapping[str, int] | None = None,
+                            extras: Mapping[str, Any] | None = None):
         """Per-request execution overrides (ParameterUtils), scoped to the
         operation run inside the ``with`` block. Carried in a ContextVar:
         each request thread (ThreadingHTTPServer / user-task pool) sees only
         ITS overrides — concurrent requests cannot clobber or clear each
         other's — and exit always restores, so a dry run, zero-proposal
-        result, or optimizer exception never leaks them."""
+        result, or optimizer exception never leaks them.
+
+        ``extras``: progress_check_interval_s (float),
+        replication_throttle (int rate override),
+        throttle_excluded_brokers (broker ids to leave unthrottled),
+        stop_ongoing_execution (bool: gracefully stop + wait before this
+        execution, RunnableUtils.maybeStopOngoingExecutionToModifyAndWait)."""
         strategy = None
         if replica_movement_strategies:
             from .executor.strategy import strategy_chain
             strategy = strategy_chain(list(replica_movement_strategies))
-        token = _EXECUTION_OVERRIDES.set((strategy, dict(concurrency or {})))
+        token = _EXECUTION_OVERRIDES.set(
+            (strategy, dict(concurrency or {}), dict(extras or {})))
         try:
             yield
         finally:
@@ -369,10 +451,25 @@ class CruiseControl:
             return False
         OPERATION_LOG.info("%s executing %d proposals (reason: %s)",
                            operation, len(result.proposals), reason)
-        strategy, concurrency = _EXECUTION_OVERRIDES.get()
+        strategy, concurrency, extras = _EXECUTION_OVERRIDES.get()
+        if extras.get("stop_ongoing_execution") \
+                and self._executor.has_ongoing_execution():
+            # maybeStopOngoingExecutionToModifyAndWait (RunnableUtils.java):
+            # gracefully stop the current execution, wait for it to wind
+            # down, then start this one.
+            OPERATION_LOG.info("%s stopping ongoing execution first", operation)
+            self._executor.stop_execution()
+            deadline = time.time() + 60.0
+            while self._executor.has_ongoing_execution() \
+                    and time.time() < deadline:
+                time.sleep(0.05)
         self._executor.execute_proposals(
             result.proposals, uuid=uuid, strategy=strategy,
-            concurrency_overrides=concurrency or None)
+            concurrency_overrides=concurrency or None,
+            progress_check_interval_s=extras.get("progress_check_interval_s"),
+            replication_throttle=extras.get("replication_throttle"),
+            throttle_excluded_brokers=extras.get(
+                "throttle_excluded_brokers", ()))
         return True
 
     def _config_excluded_topics(self, topic_names,
@@ -437,6 +534,10 @@ class CruiseControl:
 
     def proposals(self, goals: Sequence[str] | None = None,
                   ignore_proposal_cache: bool = False,
+                  use_ready_default_goals: bool = False,
+                  fast_mode: bool = False,
+                  data_from: str | None = None,
+                  allow_capacity_estimation: bool = True,
                   _freshness_margin_s: float = 0.0) -> OperationResult:
         """ProposalsRunnable — cached when the model generation and the
         expiration budget allow (GoalOptimizer.validCachedProposal:232).
@@ -444,7 +545,15 @@ class CruiseControl:
         lock re-checks the cache so two callers never run the identical
         optimization concurrently (``_freshness_margin_s`` is the
         precompute loop's refresh-ahead knob)."""
-        use_cache = goals is None and not ignore_proposal_cache
+        # A ready-filtered chain is a custom chain for caching purposes:
+        # the cache holds full-default-chain results; a data_from override
+        # is a weaker-requirement model (hasWeakerRequirement,
+        # KafkaCruiseControl.ignoreProposalCache:565-583).
+        # fast_mode results are quality-degraded: they must neither be
+        # served from nor stored into the default-chain cache.
+        use_cache = goals is None and not ignore_proposal_cache \
+            and not use_ready_default_goals and data_from is None \
+            and not fast_mode
 
         def cached_result():
             # Generation read fresh at check time: a stale pre-lock value
@@ -463,17 +572,24 @@ class CruiseControl:
                 return out
 
         def compute():
-            state, meta = self._model()
+            chain, state, meta = self._chain_and_model(
+                goals, use_ready_default_goals, data_from,
+                allow_capacity_estimation)
             options = self._options_generator.for_cached_proposal_calculation(
                 meta.topic_names, ())
+            if fast_mode:
+                options = dataclasses.replace(options, fast_mode=True)
             _final, result = self._optimizer.optimizations(
-                state, meta, self._goal_chain(goals), options)
+                state, meta, chain, options)
             return result
 
-        if goals is not None:
-            # Custom-goal requests are never cached and share nothing with
-            # the default-chain computation — no reason to serialize them
-            # behind a long-running precompute pass.
+        if goals is not None or use_ready_default_goals or fast_mode \
+                or data_from is not None:
+            # Custom-goal / fast-mode / weakened-model requests are never
+            # cached (neither served nor STORED — a degraded result must
+            # not become the canonical default-chain cache entry) and share
+            # nothing with the default-chain computation — no reason to
+            # serialize them behind a long-running precompute pass.
             result = compute()
         else:
             with self._proposal_compute_lock:
@@ -496,10 +612,16 @@ class CruiseControl:
                   exclude_recently_demoted_brokers: bool = False,
                   exclude_recently_removed_brokers: bool = False,
                   is_triggered_by_user_request: bool = True,
+                  use_ready_default_goals: bool = False,
+                  fast_mode: bool = False,
+                  data_from: str | None = None,
+                  allow_capacity_estimation: bool = True,
                   reason: str = "", uuid: str = "") -> OperationResult:
         """RebalanceRunnable.workWithoutClusterModel:115."""
         del ignore_proposal_cache  # explicit model pass below is always fresh
-        state, meta = self._model()
+        chain, state, meta = self._chain_and_model(
+            goals, use_ready_default_goals, data_from,
+            allow_capacity_estimation)
         with self.excluded_sets_lock:  # snapshot: API threads mutate these
             no_leadership = tuple(self.recently_demoted_brokers) \
                 if exclude_recently_demoted_brokers else ()
@@ -510,10 +632,11 @@ class CruiseControl:
             excluded_brokers_for_leadership=no_leadership,
             excluded_brokers_for_replica_move=no_replicas,
             requested_destination_broker_ids=tuple(destination_broker_ids),
-            is_triggered_by_goal_violation=not is_triggered_by_user_request)
+            is_triggered_by_goal_violation=not is_triggered_by_user_request,
+            fast_mode=fast_mode)
         options = self._with_config_excluded_topics(meta, options)
         _final, result = self._optimizer.optimizations(
-            state, meta, self._goal_chain(goals), options)
+            state, meta, chain, options)
         executed = self._maybe_execute(result, dryrun, "rebalance", reason, uuid)
         return OperationResult("rebalance", dryrun, result, result.proposals,
                                executed, reason)
@@ -521,15 +644,21 @@ class CruiseControl:
     def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
                     goals: Sequence[str] | None = None,
                     is_triggered_by_user_request: bool = True,
+                    use_ready_default_goals: bool = False,
+                    fast_mode: bool = False,
+                    data_from: str | None = None,
+                    allow_capacity_estimation: bool = True,
                     reason: str = "", uuid: str = "") -> OperationResult:
         """AddBrokersRunnable — mark NEW; the new-broker gate routes load
         onto them (ResourceDistributionGoal.rebalanceByMovingLoadIn:444)."""
-        state, meta = self._model()
+        chain, state, meta = self._chain_and_model(
+            goals, use_ready_default_goals, data_from,
+            allow_capacity_estimation)
         state = self._mark_brokers(state, meta, broker_ids, BrokerState.NEW)
-        options = self._with_config_excluded_topics(meta,
-                                                    OptimizationOptions())
+        options = self._with_config_excluded_topics(
+            meta, OptimizationOptions(fast_mode=fast_mode))
         _final, result = self._optimizer.optimizations(
-            state, meta, self._goal_chain(goals), options)
+            state, meta, chain, options)
         executed = self._maybe_execute(result, dryrun, "add_broker", reason, uuid)
         return OperationResult("add_broker", dryrun, result, result.proposals,
                                executed, reason)
@@ -537,17 +666,24 @@ class CruiseControl:
     def remove_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
                        goals: Sequence[str] | None = None,
                        is_triggered_by_user_request: bool = True,
+                       use_ready_default_goals: bool = False,
+                       fast_mode: bool = False,
+                       data_from: str | None = None,
+                       allow_capacity_estimation: bool = True,
                        reason: str = "", uuid: str = "") -> OperationResult:
         """RemoveBrokersRunnable — mark DEAD so every replica they host
         becomes self-healing-eligible and must be relocated."""
-        state, meta = self._model()
+        chain, state, meta = self._chain_and_model(
+            goals, use_ready_default_goals, data_from,
+            allow_capacity_estimation)
         state = self._mark_brokers(state, meta, broker_ids, BrokerState.DEAD)
         options = self._with_config_excluded_topics(
             meta, OptimizationOptions(
                 excluded_brokers_for_replica_move=tuple(broker_ids),
-                excluded_brokers_for_leadership=tuple(broker_ids)))
+                excluded_brokers_for_leadership=tuple(broker_ids),
+                fast_mode=fast_mode))
         _final, result = self._optimizer.optimizations(
-            state, meta, self._goal_chain(goals), options)
+            state, meta, chain, options)
         executed = self._maybe_execute(result, dryrun, "remove_broker", reason, uuid)
         if executed:
             with self.excluded_sets_lock:
@@ -557,9 +693,18 @@ class CruiseControl:
 
     def demote_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
                        is_triggered_by_user_request: bool = True,
+                       skip_urp_demotion: bool = True,
+                       exclude_follower_demotion: bool = False,
                        reason: str = "", uuid: str = "") -> OperationResult:
         """DemoteBrokerRunnable — PreferredLeaderElectionGoal with the
-        demoted brokers excluded from leadership."""
+        demoted brokers excluded from leadership.
+
+        ``skip_urp_demotion`` (default true, DemoteBrokerRunnable
+        SKIP_URP_DEMOTION): partitions currently under-replicated are left
+        alone. ``exclude_follower_demotion=False`` (the default) also
+        reorders each affected partition's replica list so the demoted
+        brokers' replicas come last (the reference's follower demotion);
+        true limits the operation to leadership transfers."""
         from .analyzer.goals import PreferredLeaderElectionGoal
         state, meta = self._model()
         state = self._mark_brokers(state, meta, broker_ids, BrokerState.DEMOTED)
@@ -567,6 +712,38 @@ class CruiseControl:
             excluded_brokers_for_leadership=tuple(broker_ids))
         _final, result = self._optimizer.optimizations(
             state, meta, [PreferredLeaderElectionGoal()], options)
+        proposals = list(result.proposals)
+        parts = self._admin.describe_partitions()
+        if skip_urp_demotion:
+            urp = {key for key, st in parts.items()
+                   if set(st.replicas) - set(st.isr)}
+            proposals = [p for p in proposals
+                         if (p.topic, p.partition) not in urp]
+        if not exclude_follower_demotion:
+            demoted = set(broker_ids)
+            covered = {(p.topic, p.partition): i
+                       for i, p in enumerate(proposals)}
+            for (topic, part), st in sorted(parts.items()):
+                if skip_urp_demotion and set(st.replicas) - set(st.isr):
+                    continue
+                hit = [b for b in st.replicas if b in demoted]
+                if not hit:
+                    continue
+                keep = [b for b in st.replicas if b not in demoted]
+                reordered = tuple(keep + hit)
+                idx = covered.get((topic, part))
+                if idx is not None:
+                    p0 = proposals[idx]
+                    keep2 = [b for b in p0.new_replicas if b not in demoted]
+                    hit2 = [b for b in p0.new_replicas if b in demoted]
+                    proposals[idx] = dataclasses.replace(
+                        p0, new_replicas=tuple(keep2 + hit2))
+                elif reordered != tuple(st.replicas):
+                    proposals.append(ExecutionProposal(
+                        topic=topic, partition=part, old_leader=st.leader,
+                        old_replicas=tuple(st.replicas),
+                        new_replicas=reordered, new_leader=st.leader))
+        result = dataclasses.replace(result, proposals=proposals)
         executed = self._maybe_execute(result, dryrun, "demote_broker", reason, uuid)
         if executed:
             with self.excluded_sets_lock:
@@ -577,14 +754,21 @@ class CruiseControl:
     def fix_offline_replicas(self, dryrun: bool = True,
                              goals: Sequence[str] | None = None,
                              is_triggered_by_user_request: bool = True,
+                             use_ready_default_goals: bool = False,
+                             fast_mode: bool = False,
+                             data_from: str | None = None,
+                             allow_capacity_estimation: bool = True,
                              reason: str = "", uuid: str = "") -> OperationResult:
         """FixOfflineReplicasRunnable — the model already marks replicas on
         dead brokers offline; the goal chain must relocate them."""
-        state, meta = self._model()
+        chain, state, meta = self._chain_and_model(
+            goals, use_ready_default_goals, data_from,
+            allow_capacity_estimation)
         options = self._with_config_excluded_topics(
-            meta, OptimizationOptions(only_move_immigrant_replicas=False))
+            meta, OptimizationOptions(only_move_immigrant_replicas=False,
+                                      fast_mode=fast_mode))
         _final, result = self._optimizer.optimizations(
-            state, meta, self._goal_chain(goals), options)
+            state, meta, chain, options)
         executed = self._maybe_execute(result, dryrun, "fix_offline_replicas",
                                        reason, uuid)
         return OperationResult("fix_offline_replicas", dryrun, result,
@@ -832,12 +1016,33 @@ class CruiseControl:
                     "reassignment(s)", cancelled)
 
     # -- state (the STATE endpoint dashboard) -------------------------------
-    def state(self, substates: Sequence[str] = ()) -> dict:
+    def state(self, substates: Sequence[str] = (),
+              super_verbose: bool = False) -> dict:
+        """STATE body; ``super_verbose`` adds the per-window detail the
+        reference's CruiseControlState verbose/super_verbose flags expose
+        (monitored window timestamps, executor history)."""
         want = {s.lower() for s in substates} or \
             {"monitor", "executor", "analyzer", "anomaly_detector"}
         out: dict[str, Any] = {}
+        # LoadMonitor.state() walks full partition metadata + completeness:
+        # compute at most once per request (shared by monitor + analyzer).
+        _ms_cache: list = []
+
+        def monitor_state():
+            if not _ms_cache:
+                _ms_cache.append(self._load_monitor.state())
+            return _ms_cache[0]
+
+        def _ready_names():
+            # Guarded: a not-yet-started monitor degrades readyGoals to []
+            # instead of failing the whole STATE request.
+            try:
+                return self.ready_goals(monitor_state=monitor_state())
+            except Exception:  # noqa: BLE001 — monitor not started yet
+                return []
+
         if "monitor" in want:
-            ms = self._load_monitor.state()
+            ms = monitor_state()
             out["MonitorState"] = {
                 "state": ms.runner_state,
                 "numValidWindows": ms.num_valid_windows,
@@ -848,14 +1053,23 @@ class CruiseControl:
                 "numPartitionSamples": ms.num_partition_samples,
                 "modelGeneration": ms.model_generation,
             }
+            if super_verbose:
+                try:
+                    out["MonitorState"]["windowTimestampsMs"] = \
+                        self._load_monitor.window_times()
+                except Exception:  # noqa: BLE001 — detail only
+                    out["MonitorState"]["windowTimestampsMs"] = []
         if "executor" in want:
             out["ExecutorState"] = self._executor.execution_state()
+            if super_verbose:
+                out["ExecutorState"]["recentExecutions"] = \
+                    list(getattr(self._executor, "_history", []))[-10:]
         if "analyzer" in want:
             with self._proposal_lock:
                 cached = self._proposal_cache
             out["AnalyzerState"] = {
                 "isProposalReady": cached is not None,
-                "readyGoals": self._config.get_list("goals"),
+                "readyGoals": [g.name for g in _ready_names()],
                 "balancednessScore":
                     self.goal_violation_detector.balancedness_score,
             }

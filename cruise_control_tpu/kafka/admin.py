@@ -97,6 +97,14 @@ class KafkaAdminBackend:
         return {b["node_id"]: b["rack"] for b in meta["brokers"]
                 if b.get("rack")}
 
+    def broker_hosts(self) -> dict[int, str]:
+        """broker id -> advertised host from cluster metadata (the
+        Host.java topology level: rackless brokers fall back to their host
+        as the fault domain, and co-hosted brokers share it)."""
+        meta = self._client.metadata(topics=[])
+        return {b["node_id"]: b["host"] for b in meta["brokers"]
+                if b.get("host")}
+
     # ---- configs (real KIP-339 incremental semantics) --------------------
     def alter_broker_configs(self,
                              configs: Mapping[int, Mapping[str, str]]) -> None:

@@ -493,10 +493,24 @@ class WireClient:
 
     def fetch(self, topic: str, partition: int, offset: int,
               max_bytes: int = 8 << 20) -> tuple[list[Record], int]:
-        """Returns (records from ``offset``, high watermark)."""
+        """Returns (records from ``offset``, high watermark).
+
+        Decode runs OUTSIDE ``_leader_call`` on purpose: its retry +
+        leader-refresh handling is for transport/leadership errors, and a
+        batch that fails CRC/framing came from a completed fetch — the
+        likely cause is payload corruption, which a blind refetch from the
+        same leader mostly repeats. One decode retry with a fresh metadata
+        refresh covers the transient cases (mid-truncation read, stale
+        leader serving a partial segment); a second failure surfaces to
+        the caller (transport.poll isolates per-partition errors)."""
         batch, hw = self.fetch_raw(topic, partition, offset, max_bytes)
-        return ([r for r in decode_batches(batch)
-                 if r.offset >= offset], hw)
+        try:
+            records = decode_batches(batch)
+        except ValueError:
+            self.invalidate_topic(topic)
+            batch, hw = self.fetch_raw(topic, partition, offset, max_bytes)
+            records = decode_batches(batch)
+        return ([r for r in records if r.offset >= offset], hw)
 
     def fetch_raw(self, topic: str, partition: int, offset: int,
                   max_bytes: int = 8 << 20) -> tuple[bytes, int]:

@@ -46,6 +46,9 @@ _HEADER_FMT = ">qiibIhiqqqhii"
 _HEADER_SIZE = struct.calcsize(_HEADER_FMT)  # 61
 _CRC_OFFSET = 8 + 4 + 4 + 1  # baseOffset + batchLength + leaderEpoch + magic
 _AFTER_CRC = _CRC_OFFSET + 4
+# Smallest legal batchLength: epoch+magic+crc (9) + the 40-byte after-crc
+# fixed head (mirrors MIN_BATCH_LEN in native/ccnative.c).
+_MIN_BATCH_LEN = 49
 
 
 @dataclass
@@ -154,8 +157,13 @@ def decode_batches(data: bytes | memoryview,
     while pos + 12 <= len(buf):
         base, batch_length = struct.unpack_from(">qi", buf, pos)
         end = pos + 12 + batch_length
-        if end > len(buf):
-            break  # partial trailing batch
+        if batch_length >= 0 and end > len(buf):
+            break  # partial trailing batch (fields untrusted — no checks)
+        if batch_length < _MIN_BATCH_LEN:
+            # Matches the native decoder's CC_ERR_MALFORMED for a complete
+            # batch whose length cannot hold the fixed header (ADVICE r3:
+            # the two decoders must agree on every input).
+            raise ValueError(f"malformed record batch length {batch_length}")
         magic = buf[pos + 16]
         if magic != 2:
             raise ValueError(f"unsupported record-batch magic {magic}")

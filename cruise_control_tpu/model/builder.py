@@ -34,6 +34,20 @@ class BrokerSpec:
     rack: str
     capacity: Mapping[Resource, float]
     state: BrokerState = BrokerState.ALIVE
+    # Physical host (model/Host.java). Empty = unknown -> the broker is
+    # its own host. A broker with an EMPTY rack inherits its host as the
+    # fault domain (rack-falls-back-to-host, ClusterModel.createBroker:
+    # rack == null ? host : rack), so co-hosted rackless brokers share one
+    # rack index and RackAwareGoal keeps them replica-disjoint.
+    host: str = ""
+
+
+def _effective_rack(b: "BrokerSpec") -> str:
+    return b.rack or _effective_host(b)
+
+
+def _effective_host(b: "BrokerSpec") -> str:
+    return b.host or f"broker-{b.broker_id}"
 
 
 @dataclasses.dataclass
@@ -55,8 +69,10 @@ class ClusterModelBuilder:
 
     def add_broker(self, broker_id: int, rack: str,
                    capacity: Mapping[Resource, float],
-                   state: BrokerState = BrokerState.ALIVE) -> "ClusterModelBuilder":
-        self._brokers.append(BrokerSpec(broker_id, rack, capacity, state))
+                   state: BrokerState = BrokerState.ALIVE,
+                   host: str = "") -> "ClusterModelBuilder":
+        self._brokers.append(BrokerSpec(broker_id, rack, capacity, state,
+                                        host=host))
         return self
 
     @property
@@ -87,8 +103,10 @@ class ClusterModelBuilder:
         if len(set(broker_ids)) != len(broker_ids):
             raise ValueError("duplicate broker ids")
         broker_index = {bid: i for i, bid in enumerate(broker_ids)}
-        racks = sorted({b.rack for b in brokers})
+        racks = sorted({_effective_rack(b) for b in brokers})
         rack_index = {r: i for i, r in enumerate(racks)}
+        hosts = sorted({_effective_host(b) for b in brokers})
+        host_index = {h: i for i, h in enumerate(hosts)}
 
         topics = sorted({p.topic for p in self._partitions})
         topic_index = {t: i for i, t in enumerate(topics)}
@@ -136,12 +154,14 @@ class ClusterModelBuilder:
 
         capacity = np.zeros((n_b, NUM_RESOURCES), dtype=np.float32)
         rack_arr = np.zeros((n_b,), dtype=np.int32)
+        host_arr = np.arange(n_b, dtype=np.int32) + len(hosts)  # pad rows: own host
         broker_state = np.full((n_b,), int(BrokerState.DEAD), dtype=np.int8)
         broker_mask = np.zeros((n_b,), dtype=bool)
         for i, b in enumerate(brokers):
             for r, v in b.capacity.items():
                 capacity[i, int(r)] = v
-            rack_arr[i] = rack_index[b.rack]
+            rack_arr[i] = rack_index[_effective_rack(b)]
+            host_arr[i] = host_index[_effective_host(b)]
             broker_state[i] = int(b.state)
             broker_mask[i] = True
 
@@ -157,10 +177,11 @@ class ClusterModelBuilder:
             topic=jnp.asarray(topic_arr),
             partition_mask=jnp.asarray(partition_mask),
             broker_mask=jnp.asarray(broker_mask),
+            host=jnp.asarray(host_arr),
         )
         meta = ClusterMeta(broker_ids=broker_ids, topic_names=topics,
                            rack_names=racks, num_topics=len(topics),
-                           partition_index=part_names)
+                           partition_index=part_names, host_names=hosts)
         return state, meta
 
 
@@ -193,8 +214,10 @@ def build_cluster_from_arrays(brokers: Sequence[BrokerSpec],
     brokers = sorted(brokers, key=lambda b: b.broker_id)
     broker_ids = [b.broker_id for b in brokers]
     broker_index = {bid: i for i, bid in enumerate(broker_ids)}
-    racks = sorted({b.rack for b in brokers})
+    racks = sorted({_effective_rack(b) for b in brokers})
     rack_index = {r: i for i, r in enumerate(racks)}
+    hosts = sorted({_effective_host(b) for b in brokers})
+    host_index = {h: i for i, h in enumerate(hosts)}
     topics = sorted({t for t, _p in part_names})
     topic_index = {t: i for i, t in enumerate(topics)}
 
@@ -239,12 +262,14 @@ def build_cluster_from_arrays(brokers: Sequence[BrokerSpec],
 
     capacity = np.zeros((n_b, NUM_RESOURCES), dtype=np.float32)
     rack_arr = np.zeros((n_b,), dtype=np.int32)
+    host_arr = np.arange(n_b, dtype=np.int32) + len(hosts)  # pad rows: own host
     broker_state = np.full((n_b,), int(BrokerState.DEAD), dtype=np.int8)
     broker_mask = np.zeros((n_b,), dtype=bool)
     for i, b in enumerate(brokers):
         for r, v in b.capacity.items():
             capacity[i, int(r)] = v
-        rack_arr[i] = rack_index[b.rack]
+        rack_arr[i] = rack_index[_effective_rack(b)]
+        host_arr[i] = host_index[_effective_host(b)]
         broker_state[i] = int(b.state)
         broker_mask[i] = True
 
@@ -254,8 +279,9 @@ def build_cluster_from_arrays(brokers: Sequence[BrokerSpec],
         capacity=jnp.asarray(capacity), rack=jnp.asarray(rack_arr),
         broker_state=jnp.asarray(broker_state), topic=jnp.asarray(topic_arr),
         partition_mask=jnp.asarray(partition_mask),
-        broker_mask=jnp.asarray(broker_mask))
+        broker_mask=jnp.asarray(broker_mask),
+        host=jnp.asarray(host_arr))
     meta = ClusterMeta(broker_ids=broker_ids, topic_names=topics,
                        rack_names=racks, num_topics=len(topics),
-                       partition_index=list(part_names))
+                       partition_index=list(part_names), host_names=hosts)
     return state, meta

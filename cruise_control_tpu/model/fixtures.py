@@ -89,16 +89,23 @@ def random_cluster(num_brokers: int, num_topics: int, num_partitions: int,
                    seed: int = 0, skew_to_first: float = 0.0,
                    partition_bucket: int = 0, broker_bucket: int = 0,
                    target_utilization: float = 0.5,
+                   brokers_per_host: int = 1,
                    ) -> tuple[ClusterTensors, ClusterMeta]:
     """Random cluster à la RandomCluster.java: partition loads drawn from the
     given distribution; ``skew_to_first`` biases placement toward low-index
     brokers to create imbalance worth fixing. Loads are normalized so the
-    cluster-average NW_OUT utilization ≈ ``target_utilization``."""
+    cluster-average NW_OUT utilization ≈ ``target_utilization``.
+
+    ``num_racks=0`` builds a RACKLESS cluster; with ``brokers_per_host``
+    > 1 consecutive brokers share a physical host, so the fault domain
+    degrades to host-awareness (Host.java / rack-falls-back-to-host)."""
     rng = np.random.default_rng(seed)
     rf = min(rf, num_brokers)
     b = ClusterModelBuilder(partition_bucket=partition_bucket, broker_bucket=broker_bucket)
     for i in range(num_brokers):
-        b.add_broker(i, f"rack{i % num_racks}", _CAP)
+        b.add_broker(i, f"rack{i % num_racks}" if num_racks > 0 else "",
+                     _CAP, host=(f"host{i // brokers_per_host}"
+                                 if brokers_per_host > 1 else ""))
 
     if dist is Dist.UNIFORM:
         base = rng.uniform(0.2, 1.0, size=num_partitions)

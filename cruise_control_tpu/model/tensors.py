@@ -42,7 +42,7 @@ from ..common.broker_state import BrokerState
 @partial(jax.tree_util.register_dataclass,
          data_fields=["assignment", "leader_slot", "leader_load", "follower_load",
                       "capacity", "rack", "broker_state", "topic",
-                      "partition_mask", "broker_mask"],
+                      "partition_mask", "broker_mask", "host"],
          meta_fields=[])
 @dataclasses.dataclass(frozen=True)
 class ClusterTensors:
@@ -51,11 +51,27 @@ class ClusterTensors:
     leader_load: jax.Array    # [P, R] float32
     follower_load: jax.Array  # [P, R] float32
     capacity: jax.Array       # [B, R] float32
+    # Fault-domain index per broker (Rack.java semantics): the builder
+    # folds rack-falls-back-to-host in — a broker with no configured rack
+    # gets its HOST's domain, so co-hosted brokers share one rack index
+    # (ClusterModel.handleDeadBroker / Host.java level). Rack-aware goal
+    # kernels therefore need no host special-casing.
     rack: jax.Array           # [B] int32
     broker_state: jax.Array   # [B] int8
     topic: jax.Array          # [P] int32
     partition_mask: jax.Array  # [P] bool
     broker_mask: jax.Array    # [B] bool
+    # Physical host index per broker (model/Host.java, the level between
+    # rack and broker): multiple brokers may share a host; host-level
+    # stats and the rack fallback derive from it. Defaults to one host
+    # per broker when topology is unknown.
+    host: jax.Array = None    # [B] int32
+
+    def __post_init__(self):
+        if self.host is None:
+            object.__setattr__(
+                self, "host",
+                jnp.arange(self.capacity.shape[0], dtype=jnp.int32))
 
     @property
     def num_partitions(self) -> int:
@@ -85,6 +101,9 @@ class ClusterMeta:
     rack_names: list[str]
     num_topics: int
     partition_index: list[tuple[str, int]]  # row → (topic, partition number)
+    # Physical host names indexed by ClusterTensors.host (Host.java level);
+    # empty when the builder predates host topology.
+    host_names: list[str] = dataclasses.field(default_factory=list)
 
 
 # ---- derived quantities (all jittable) -----------------------------------

@@ -47,6 +47,12 @@ class AggregationOptions:
     granularity: Granularity = Granularity.ENTITY
     interested_entities: tuple | None = None  # None = all known entities
     include_invalid_entities: bool = False
+    # Wall-clock range restriction (LOAD/PARTITION_LOAD start/end/time
+    # request params → MetricSampleAggregator.aggregate(from, to)); -1 =
+    # unbounded. A window overlaps the range iff its [w*ms, (w+1)*ms) span
+    # intersects [start_ms, end_ms].
+    start_ms: int = -1
+    end_ms: int = -1
 
 
 @dataclasses.dataclass
@@ -190,6 +196,15 @@ class MetricSampleAggregator:
         with self._lock:
             return self._completeness_locked(options)
 
+    def _window_in_range(self, w: int, options: AggregationOptions) -> bool:
+        """Window w spans [w*window_ms, (w+1)*window_ms); it participates
+        when that span intersects the requested [start_ms, end_ms]."""
+        if options.start_ms >= 0 and (w + 1) * self._window_ms <= options.start_ms:
+            return False
+        if options.end_ms >= 0 and w * self._window_ms > options.end_ms:
+            return False
+        return True
+
     def _group_indices(self, entities) -> tuple[np.ndarray, int]:
         """Dense group index per entity + group count."""
         group_of: dict = {}
@@ -214,6 +229,13 @@ class MetricSampleAggregator:
         if not windows or not entities:
             raise NotEnoughValidWindowsError(
                 f"0 valid windows (required {options.min_valid_windows})")
+
+        in_range = np.array([self._window_in_range(w, options)
+                             for w in windows])
+        if not in_range.any():
+            raise NotEnoughValidWindowsError(
+                f"0 stable windows overlap [{options.start_ms}, "
+                f"{options.end_ms}] (required {options.min_valid_windows})")
 
         _cats, valid, extrapolated = self._store.classify()
         # Unknown interested entities contribute all-invalid rows.
@@ -240,7 +262,7 @@ class MetricSampleAggregator:
             entity_ratio = (group_valid[group_index] & valid_sel).mean(axis=0)
 
         ok = (entity_ratio >= options.min_valid_entity_ratio) & \
-             (group_ratio >= options.min_valid_entity_group_ratio)
+             (group_ratio >= options.min_valid_entity_group_ratio) & in_range
         valid_windows = [w for w, keep in zip(windows, ok) if keep]
         if len(valid_windows) < options.min_valid_windows:
             raise NotEnoughValidWindowsError(
@@ -265,7 +287,8 @@ class MetricSampleAggregator:
             cache_key = (self._generation, options.min_valid_entity_ratio,
                          options.min_valid_entity_group_ratio, options.min_valid_windows,
                          options.max_allowed_extrapolations_per_entity, options.granularity,
-                         options.interested_entities, options.include_invalid_entities)
+                         options.interested_entities, options.include_invalid_entities,
+                         options.start_ms, options.end_ms)
             if cache_key in self._cache:
                 return self._cache[cache_key]
             completeness = self._completeness_locked(options)

@@ -25,9 +25,22 @@ class BrokerCapacityConfigResolver(Protocol):
 
     def disk_capacity_by_logdir(self, broker_id: int) -> dict[str, float] | None: ...
 
+    def is_estimated(self, broker_id: int) -> bool:
+        """True when the broker's capacity is an estimate rather than an
+        explicit config entry (BrokerCapacityInfo.estimationInfo). Gated by
+        the allow_capacity_estimation request parameter."""
+        ...
+
+
+class CapacityEstimationError(ValueError):
+    """allow_capacity_estimation=false but a broker capacity is estimated
+    (BrokerCapacityResolutionException)."""
+
 
 class StaticCapacityResolver:
-    """Fixed capacities from a mapping (tests / synthetic clusters)."""
+    """Fixed capacities from a mapping (tests / synthetic clusters): the
+    operator supplied every value programmatically, so nothing is an
+    estimate."""
 
     def __init__(self, by_broker: Mapping[int, Mapping[Resource, float]],
                  default: Mapping[Resource, float] | None = None):
@@ -39,6 +52,9 @@ class StaticCapacityResolver:
 
     def disk_capacity_by_logdir(self, broker_id: int):
         return None
+
+    def is_estimated(self, broker_id: int) -> bool:
+        return False
 
 
 class FileCapacityResolver:
@@ -86,3 +102,9 @@ class FileCapacityResolver:
     def disk_capacity_by_logdir(self, broker_id: int):
         dirs = self._logdirs.get(broker_id, self._logdirs.get(DEFAULT_BROKER_ID))
         return dict(dirs) if dirs else None
+
+    def is_estimated(self, broker_id: int) -> bool:
+        """A broker served by the broker-id -1 default entry (or the
+        builtin default) got an ESTIMATE, exactly the case
+        BrokerCapacityConfigFileResolver marks with estimation info."""
+        return broker_id not in self._caps

@@ -228,6 +228,16 @@ class LoadMonitor:
                 for e, row in zip(entities, values)}
 
     # -- state ------------------------------------------------------------
+    @property
+    def capacity_resolver(self):
+        """The configured BrokerCapacityConfigResolver (capacity_only and
+        populate_disk_info responses read it directly)."""
+        return self._capacity
+
+    def window_times(self) -> list[int]:
+        """Stable window start timestamps (STATE super_verbose detail)."""
+        return self._partition_agg.all_window_times()
+
     def state(self) -> LoadMonitorState:
         partitions = self._metadata.describe_partitions()
         opts = self._aggregation_options(ModelCompletenessRequirements(1, 0.0))
@@ -258,13 +268,29 @@ class LoadMonitor:
             include_invalid_entities=False)
 
     def cluster_model(self, requirements: ModelCompletenessRequirements | None = None,
+                      allow_capacity_estimation: bool = True,
+                      start_ms: int = -1, end_ms: int = -1,
+                      min_valid_partition_ratio: float | None = None,
+                      reduction: str = "default",
                       ) -> tuple[ClusterTensors, ClusterMeta]:
         """LoadMonitor.clusterModel:489 — aggregate valid windows, resolve
-        capacities, populate per-partition loads, freeze to tensors."""
+        capacities, populate per-partition loads, freeze to tensors.
+
+        ``allow_capacity_estimation=False`` raises CapacityEstimationError
+        when any alive broker's capacity is an estimate (the
+        allow_capacity_estimation request param). ``start_ms``/``end_ms``
+        restrict aggregation to windows overlapping the range (the LOAD
+        endpoint's time/start/end params); -1 = unbounded.
+        ``min_valid_partition_ratio`` overrides the configured completeness
+        ratio (PARTITION_LOAD param). ``reduction`` overrides the
+        per-metric window-reduction strategy: "max"/"avg" mirror
+        Load.expectedUtilizationFor(wantMaxLoad/avgLoad)."""
         req = requirements or ModelCompletenessRequirements(
             min_valid_windows=1,
-            min_monitored_partitions_percentage=self._config.get(
-                "min.valid.partition.ratio"))
+            min_monitored_partitions_percentage=(
+                self._config.get("min.valid.partition.ratio")
+                if min_valid_partition_ratio is None
+                else min_valid_partition_ratio))
         from ..utils.progress import step
         step("WaitingForClusterModel")
         with self._model_semaphore:
@@ -274,9 +300,24 @@ class LoadMonitor:
             step("AggregatingMetrics")
             partitions = self._metadata.describe_partitions()
             alive = self._metadata.alive_brokers()
-            agg = self._partition_agg.aggregate(self._aggregation_options(req))
+            if not allow_capacity_estimation:
+                from .capacity import CapacityEstimationError
+                estimated = sorted(
+                    b for b in alive
+                    if getattr(self._capacity, "is_estimated",
+                               lambda _b: False)(b))
+                if estimated:
+                    raise CapacityEstimationError(
+                        f"allow_capacity_estimation=false but capacities of "
+                        f"brokers {estimated} are estimated (no explicit "
+                        "entry in the capacity config)")
+            opts = self._aggregation_options(req)
+            if start_ms >= 0 or end_ms >= 0:
+                import dataclasses as _dc
+                opts = _dc.replace(opts, start_ms=start_ms, end_ms=end_ms)
+            agg = self._partition_agg.aggregate(opts)
             step("GeneratingClusterModel")
-            built = self._build(partitions, alive, agg)
+            built = self._build(partitions, alive, agg, reduction)
         # cluster-model-creation-timer (LoadMonitor.java:177).
         from ..utils.sensors import SENSORS
         SENSORS.record_timer("monitor_cluster_model_creation",
@@ -285,9 +326,12 @@ class LoadMonitor:
 
     def _build(self, partitions: Mapping[tuple[str, int], PartitionState],
                alive: set[int], agg: AggregationResult,
+               reduction: str = "default",
                ) -> tuple[ClusterTensors, ClusterMeta]:
         # Window reduction per metric strategy (Load.expectedUtilizationFor:
         # AVG over windows for rates, LATEST window for disk usage).
+        # ``reduction`` "max"/"avg" force one reduction for every metric
+        # (the PARTITION_LOAD max_load/avg_load request params).
         mdef = KafkaMetricDef.common_metric_def()
         vals = agg.values  # [E, M, W]
         if vals.shape[2] == 0:
@@ -295,6 +339,12 @@ class LoadMonitor:
         reduced = np.empty(vals.shape[:2], dtype=np.float64)  # [E, M]
         for info in mdef.all():
             col = vals[:, info.id, :]
+            if reduction == "max":
+                reduced[:, info.id] = col.max(axis=1)
+                continue
+            if reduction == "avg":
+                reduced[:, info.id] = col.mean(axis=1)
+                continue
             if info.strategy is S.LATEST:
                 reduced[:, info.id] = col[:, -1]
             elif info.strategy is S.MAX:
@@ -317,12 +367,25 @@ class LoadMonitor:
                 except Exception:  # noqa: BLE001 — topology hint only
                     LOG.warning("broker rack refresh failed", exc_info=True)
         # Rack ids pass through the configured mapper before rack-aware
-        # goals group by them (AbstractRackAwareGoal.java:51).
+        # goals group by them (AbstractRackAwareGoal.java:51). A broker
+        # with NO configured rack gets rack="" and the builder falls back
+        # to its HOST as the fault domain (ClusterModel.createBroker:
+        # rack == null ? host : rack) — co-hosted rackless brokers then
+        # share one rack index, Host.java semantics.
+        hosts_fn = getattr(self._metadata, "broker_hosts", None)
+        hosts: dict[int, str] = {}
+        if hosts_fn is not None:
+            try:
+                hosts = hosts_fn()
+            except Exception:  # noqa: BLE001 — topology hint only
+                LOG.warning("broker host refresh failed", exc_info=True)
         brokers = [BrokerSpec(
-            bid, rack=self._rack_mapper.apply(
-                self._broker_racks.get(bid, str(bid))),
+            bid,
+            rack=(self._rack_mapper.apply(self._broker_racks[bid])
+                  if bid in self._broker_racks else ""),
             capacity=self._capacity.capacity_for(bid),
-            state=(BrokerState.ALIVE if bid in alive else BrokerState.DEAD))
+            state=(BrokerState.ALIVE if bid in alive else BrokerState.DEAD),
+            host=hosts.get(bid, ""))
             for bid in all_brokers]
 
         # Vectorized load assembly: one gather from the reduced [E, M]

@@ -98,9 +98,15 @@ int64_t cc_count_records(const unsigned char *buf, size_t len) {
     int64_t total = 0;
     while (pos + 12 <= len) {
         int32_t batch_len = (int32_t)rd32be(buf + pos + 8);
+        /* Drop a trailing PARTIAL batch before validating its fields: a
+         * fragment's batchLength bytes may be garbage, and the Python
+         * fallback breaks on end > len first — the two decoders must
+         * agree on every input (ADVICE r3). Signed end arithmetic so a
+         * negative batch_len cannot wrap the unsigned sum. */
+        int64_t end64 = (int64_t)pos + 12 + (int64_t)batch_len;
+        if (batch_len >= 0 && end64 > (int64_t)len) break;
         if (batch_len < MIN_BATCH_LEN) return CC_ERR_MALFORMED;
-        size_t end = pos + 12 + (size_t)batch_len;
-        if (end > len) break;
+        size_t end = (size_t)end64;
         if (buf[pos + 16] != 2) return CC_ERR_MAGIC;
         int32_t count = (int32_t)rd32be(buf + pos + BATCH_AFTER_CRC + AFTER_COUNT);
         /* A record is at least 7 bytes (length varint + attrs + 3 varints
@@ -131,9 +137,12 @@ int64_t cc_index_records(const unsigned char *buf, size_t len, int verify_crc,
     while (pos + 12 <= len) {
         int64_t base = rd64be(buf + pos);
         int32_t batch_len = (int32_t)rd32be(buf + pos + 8);
+        /* Partial-trailing-batch drop BEFORE field validation (see
+         * cc_count_records). */
+        int64_t end64 = (int64_t)pos + 12 + (int64_t)batch_len;
+        if (batch_len >= 0 && end64 > (int64_t)len) break;
         if (batch_len < MIN_BATCH_LEN) return CC_ERR_MALFORMED;
-        size_t end = pos + 12 + (size_t)batch_len;
-        if (end > len) break;
+        size_t end = (size_t)end64;
         if (buf[pos + 16] != 2) return CC_ERR_MAGIC;
         uint32_t crc = rd32be(buf + pos + BATCH_CRC_OFF);
         const unsigned char *after = buf + pos + BATCH_AFTER_CRC;

@@ -50,7 +50,7 @@ def _state_specs() -> ClusterTensors:
         assignment=P(PARTITION_AXIS), leader_slot=P(PARTITION_AXIS),
         leader_load=P(PARTITION_AXIS), follower_load=P(PARTITION_AXIS),
         capacity=P(), rack=P(), broker_state=P(), topic=P(PARTITION_AXIS),
-        partition_mask=P(PARTITION_AXIS), broker_mask=P())
+        partition_mask=P(PARTITION_AXIS), broker_mask=P(), host=P())
 
 
 def shard_cluster(state: ClusterTensors, mesh: Mesh) -> ClusterTensors:

@@ -5,7 +5,11 @@ The ambient environment may pin jax to a TPU tunnel (axon) via
 sitecustomize; see cruise_control_tpu/utils/platform.py — the shared home
 of the workaround — for why env vars alone are not enough."""
 
+from cruise_control_tpu import enable_persistent_compile_cache
 from cruise_control_tpu.utils import force_host_cpu_devices
 
 jax = force_host_cpu_devices(8)
 jax.config.update("jax_enable_x64", False)
+# jax 0.9 ignores the JAX_COMPILATION_CACHE_DIR env var; without the
+# programmatic enable every test session cold-compiles the solver kernels.
+enable_persistent_compile_cache()

@@ -361,7 +361,11 @@ def test_wide_batch_config_derivation():
     )
 
     assert TopicReplicaDistributionGoal().prefers_wide_batches
-    assert not RackAwareGoal().prefers_wide_batches
+    # r4: RackAwareGoal joined the wide-batch class (validated at 1k:
+    # rounds 145 -> 38, balancedness + violated set unchanged).
+    assert RackAwareGoal().prefers_wide_batches
+    from cruise_control_tpu.analyzer.goals import CpuCapacityGoal
+    assert not CpuCapacityGoal().prefers_wide_batches
     opt = GoalOptimizer(CruiseControlConfig())
     base = SearchConfig(num_sources=256, num_dests=250, moves_per_round=500,
                         max_rounds=2000)
@@ -371,7 +375,7 @@ def test_wide_batch_config_derivation():
     assert wide.num_dests == base.num_dests
     # Below the regime threshold / no wide goal in the chain -> None.
     assert opt._wide_config(base, chain, num_brokers=100) is None
-    assert opt._wide_config(base, [RackAwareGoal()], 1000) is None
+    assert opt._wide_config(base, [CpuCapacityGoal()], 1000) is None
     # An operator-raised base can never exceed the "wide" config.
     big = SearchConfig(num_sources=2048, num_dests=250, moves_per_round=4096,
                        max_rounds=2000)

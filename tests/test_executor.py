@@ -113,7 +113,14 @@ def test_strategy_orders_small_first_and_postpones_urp():
 # ---- concurrency -----------------------------------------------------------
 
 def test_concurrency_adjuster_halves_and_recovers():
-    m = ExecutionConcurrencyManager(ConcurrencyCaps(inter_broker_per_broker=8))
+    from cruise_control_tpu.executor.concurrency import (
+        ConcurrencyAdjusterConfig,
+    )
+    # min.isr.check.enabled defaults FALSE (ExecutorConfig.java:583);
+    # enabled explicitly because this test exercises min-ISR pressure.
+    m = ExecutionConcurrencyManager(
+        ConcurrencyCaps(inter_broker_per_broker=8),
+        adjuster=ConcurrencyAdjusterConfig(min_isr_check_enabled=True))
     m.adjust(cluster_healthy=False, has_under_min_isr=True)
     assert m.state()["interBrokerPerBroker"] == 4
     m.adjust(cluster_healthy=False, has_under_min_isr=True)
@@ -130,7 +137,8 @@ def test_concurrency_adjuster_metric_limits_and_aimd_knobs():
         ConcurrencyAdjusterConfig,
     )
     adj = ConcurrencyAdjusterConfig(min_brokers_violate_metric_limit=2,
-                                    leadership_per_broker_enabled=True)
+                                    leadership_per_broker_enabled=True,
+                                    min_isr_check_enabled=True)
     m = ExecutionConcurrencyManager(
         ConcurrencyCaps(inter_broker_per_broker=8, leadership_cluster=800,
                         leadership_per_broker=200), adjuster=adj)
@@ -490,9 +498,16 @@ def test_adjuster_reduces_batch_when_isr_shrinks_mid_execution():
         orig(targets)
 
     admin.alter_partition_reassignments = spy
+    from cruise_control_tpu.executor.concurrency import (
+        ConcurrencyAdjusterConfig,
+    )
+    # min.isr.check.enabled defaults FALSE (reference parity); this test
+    # exercises the min-ISR pressure path, so enable it explicitly.
     ex = Executor(admin, ConcurrencyCaps(inter_broker_per_broker=4),
                   progress_check_interval_s=0.01,
-                  adjuster_enabled=True, adjuster_interval_s=0.0)
+                  adjuster_enabled=True, adjuster_interval_s=0.0,
+                  adjuster_config=ConcurrencyAdjusterConfig(
+                      min_isr_check_enabled=True))
     ex.execute_proposals(
         [proposal(part=i, old=(0, 1), new=(2, 1), new_leader=2)
          for i in range(12)], uuid="adj")

@@ -158,3 +158,41 @@ def test_native_malformed_garbage_does_not_crash():
             assert native == pure, trial
         elif pure is ValueError:
             assert native is ValueError, trial
+
+
+@needs_native
+def test_native_decode_corrupt_trailing_fragment_parity():
+    """A trailing fragment whose batchLength field reads < MIN_BATCH_LEN
+    must be treated the same by BOTH decoders: silently dropped when the
+    fragment is partial (end > len), rejected when it claims to be a
+    complete batch (ADVICE r3: the decoders previously disagreed)."""
+    import struct
+
+    rng = random.Random(11)
+    full = encode_batch(_random_records(rng, 4, 0))
+
+    # Partial trailing fragment with a garbage (tiny) batchLength: both
+    # decoders drop it — the fragment's fields are untrusted.
+    frag = struct.pack(">qi", 99, 5) + b"\x01\x02"          # end > len
+    data = full + frag
+    assert decode_batches(data) == _python_decode(data)
+    assert len(decode_batches(data)) == 4
+
+    # "Complete" batch whose length can't hold the fixed header: both
+    # decoders reject.
+    bad = struct.pack(">qi", 99, 5) + b"\x00" * 5           # end <= len
+    for decoder in (decode_batches, _python_decode):
+        try:
+            decoder(full + bad)
+            raise AssertionError("expected ValueError")
+        except ValueError:
+            pass
+
+    # Negative batchLength: both reject (signed arithmetic must not wrap).
+    neg = struct.pack(">qi", 99, -40) + b"\x00" * 8
+    for decoder in (decode_batches, _python_decode):
+        try:
+            decoder(full + neg)
+            raise AssertionError("expected ValueError")
+        except ValueError:
+            pass

@@ -25,6 +25,8 @@ def main() -> int:
     num_partitions = int(os.environ.get("NS_PARTITIONS", "1000000"))
     import jax
 
+    from cruise_control_tpu import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
     from cruise_control_tpu.analyzer.optimizer import (
         GoalOptimizer, goals_by_priority,
     )
