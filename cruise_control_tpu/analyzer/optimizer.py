@@ -46,6 +46,7 @@ class GoalResult:
     residual_violation: float
     duration_s: float
     violated_before: bool
+    swaps_applied: int = 0
 
 
 @dataclasses.dataclass
@@ -122,7 +123,8 @@ def _apportioned_goal_results(goal_chain: Sequence[Goal], infos: list[dict],
         residual_violation=info["residual_violation"],
         duration_s=chain_s * (info["rounds"] / total_rounds
                               if total_rounds else 1 / len(infos)),
-        violated_before=info["violated_on_entry"] or not info["succeeded"])
+        violated_before=info["violated_on_entry"] or not info["succeeded"],
+        swaps_applied=info.get("swaps_applied", 0))
         for g, info in zip(goal_chain, infos)]
 
 
@@ -421,7 +423,8 @@ class GoalOptimizer:
                     residual_violation=info["residual_violation"],
                     duration_s=time.time() - t0,
                     violated_before=info["violated_on_entry"]
-                    or not info["succeeded"]))
+                    or not info["succeeded"],
+                    swaps_applied=info.get("swaps_applied", 0)))
 
         violated_before = [r.name for r in goal_results if r.violated_before]
         violated_after = [r.name for r in goal_results if not r.succeeded]

@@ -319,6 +319,16 @@ def _definition() -> ConfigDef:
              "Cluster size from which goals flagged prefers_wide_batches "
              "run with the widened source grid on the bounded per-goal "
              "path (0 disables wide batches entirely).")
+    d.define("solver.partition.bucket.size", T.INT, 1024, Range.at_least(0),
+             I.LOW,
+             "Pad the model's partition axis up to a multiple of this so "
+             "ordinary partition-count changes reuse the already-compiled "
+             "solver kernels (XLA compiles per shape; a full-chain compile "
+             "at large scale is minutes). 0 disables padding.")
+    d.define("solver.broker.bucket.size", T.INT, 32, Range.at_least(0), I.LOW,
+             "Pad the broker axis up to a multiple of this (see "
+             "solver.partition.bucket.size). Pad brokers are masked out "
+             "(broker_mask) and DEAD. 0 disables padding.")
     d.define("solver.dispatch.target.seconds", T.DOUBLE, 2.5,
              Range.at_least(0), I.MEDIUM,
              "Adaptive bounded-dispatch sizing: grow the per-dispatch round "

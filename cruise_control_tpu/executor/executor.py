@@ -378,14 +378,14 @@ class Executor:
             return not t.is_alive()
         return True
 
-    def execution_state(self) -> dict:
+    def execution_state(self, history_limit: int = 5) -> dict:
         tm = self._task_manager
         return {
             "state": self._state.value,
             "uuid": self._uuid,
             "taskCounts": tm.tracker.counts() if tm else {},
             "concurrency": self._concurrency.state(),
-            "recentHistory": self._history[-5:],
+            "recentHistory": self._history[-history_limit:],
         }
 
     def adjust_concurrency(self, cluster_healthy: bool,

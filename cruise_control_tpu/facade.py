@@ -1060,10 +1060,8 @@ class CruiseControl:
                 except Exception:  # noqa: BLE001 — detail only
                     out["MonitorState"]["windowTimestampsMs"] = []
         if "executor" in want:
-            out["ExecutorState"] = self._executor.execution_state()
-            if super_verbose:
-                out["ExecutorState"]["recentExecutions"] = \
-                    list(getattr(self._executor, "_history", []))[-10:]
+            out["ExecutorState"] = self._executor.execution_state(
+                history_limit=20 if super_verbose else 5)
         if "analyzer" in want:
             with self._proposal_lock:
                 cached = self._proposal_cache

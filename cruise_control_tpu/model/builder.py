@@ -19,6 +19,18 @@ from ..common.resources import NUM_RESOURCES, Resource
 from .tensors import ClusterMeta, ClusterTensors
 
 
+def graduated_bucket(n: int, bucket: int) -> int:
+    """Shape-bucket size capped at ~n/8: padding overhead stays bounded
+    (≤ ~12.5%) while shapes still quantize to a handful per octave, so
+    ordinary cluster growth reuses compiled kernels without tiny clusters
+    paying large pads (solver.partition.bucket.size semantics)."""
+    if bucket <= 0:
+        return 0
+    while bucket > 1 and bucket > max(1, n // 8):
+        bucket //= 2
+    return bucket
+
+
 def _pad_up(n: int, bucket: int) -> int:
     """Round up to a bucket size so recompilation only happens when a
     cluster crosses a bucket boundary (dynamic topics/partitions strategy,

@@ -68,7 +68,12 @@ class ClusterTensors:
     host: jax.Array = None    # [B] int32
 
     def __post_init__(self):
-        if self.host is None:
+        # Default host topology = one host per broker. Guarded on capacity
+        # actually being an array: pytree unflattens re-enter __init__ with
+        # arbitrary leaf payloads (tree_map/broadcast_prefix pass None or
+        # spec objects through), and those dummy trees must round-trip
+        # untouched.
+        if self.host is None and hasattr(self.capacity, "shape"):
             object.__setattr__(
                 self, "host",
                 jnp.arange(self.capacity.shape[0], dtype=jnp.int32))

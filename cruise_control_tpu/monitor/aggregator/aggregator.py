@@ -116,7 +116,19 @@ class MetricSampleAggregator:
 
     @property
     def store(self) -> RawMetricStore:
+        """The raw store. READ-ONLY access: mutating it directly bypasses
+        the aggregator's generation bump and can serve stale cached
+        aggregates — use the aggregator's own ingest/roll methods."""
         return self._store
+
+    def roll_to(self, window_index: int) -> int:
+        """Advance the current window (MetricSampleAggregator's window
+        maintenance on sample arrival, exposed for time-driven rollout);
+        bumps the generation so cached aggregates invalidate."""
+        with self._lock:
+            abandoned = self._store.roll_to(window_index)
+            self._generation += 1
+        return abandoned
 
     def window_index_of(self, time_ms: int) -> int:
         return int(time_ms) // self._window_ms

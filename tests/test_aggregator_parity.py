@@ -1,0 +1,332 @@
+"""Aggregator edge-case parity suite (VERDICT r3 weak #5 / next #7).
+
+Ports the CASES — not the code — of the reference's
+cruise-control-core RawMetricValuesTest.java and
+MetricSampleAggregatorTest.java: window rollout at boundaries,
+AVG_ADJACENT at the first/last stable window, the wrap-around cases
+where rolling the ring turns an edge window into an interior one (and a
+large leap evicts the neighbour instead), FORCED_INSUFFICIENT
+thresholds, and ENTITY vs ENTITY_GROUP completeness option matrices.
+Each test names the reference case it mirrors.
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.metricdef.metricdef import (
+    MetricDef, ValueComputingStrategy as S,
+)
+from cruise_control_tpu.monitor.aggregator import (
+    AggregationOptions, Extrapolation, Granularity, MetricSampleAggregator,
+    NotEnoughValidWindowsError,
+)
+
+WINDOW_MS = 1000
+
+
+def make_def():
+    d = MetricDef()
+    d.define("avg_m", S.AVG)
+    d.define("max_m", S.MAX)
+    d.define("latest_m", S.LATEST)
+    return d
+
+
+def agg(num_windows=6, min_samples=2, group_fn=None):
+    return MetricSampleAggregator(num_windows, WINDOW_MS, min_samples,
+                                  make_def(), group_fn=group_fn)
+
+
+def fill(a, entity, window, n, base=10.0):
+    for i in range(n):
+        a.add_sample(entity, window * WINDOW_MS + i,
+                     np.array([base + i, base + i, base + i]))
+
+
+def cats_of(a, entity="e0", **opts):
+    res = a.aggregate(AggregationOptions(min_valid_windows=1,
+                                         include_invalid_entities=True,
+                                         **opts))
+    row = res.entities.index(entity)
+    return res, res.extrapolations[row], res.values[row]
+
+
+# ---- RawMetricValuesTest ports ------------------------------------------
+
+def test_add_sample_to_evicted_window_is_dropped():
+    """testAddSampleToEvictedWindows: a sample older than the retained
+    range must be silently dropped, not resurrect an evicted window."""
+    a = agg(num_windows=2)
+    fill(a, "e0", 5, 2)
+    assert not a.add_sample("e0", 1 * WINDOW_MS, np.zeros(3))
+    assert a.num_samples() == 2
+
+
+def test_add_sample_update_extrapolation_two_gaps():
+    """testAddSampleUpdateExtrapolation: windows 3 and 5 empty; filling 4
+    turns BOTH into valid AVG_ADJACENT windows (each now has two
+    sufficient stable neighbours); before that they are invalid."""
+    a = agg(num_windows=6, min_samples=1)
+    for w in (2, 6):
+        fill(a, "e0", w, 1)
+    a.roll_to(7)  # stable range [2, 6]
+    _res, cats, _vals = cats_of(a)
+    # windows order: [2, 3, 4, 5, 6]
+    assert cats[1] == Extrapolation.NO_VALID_EXTRAPOLATION  # 3
+    assert cats[3] == Extrapolation.NO_VALID_EXTRAPOLATION  # 5
+    fill(a, "e0", 4, 1)
+    a.roll_to(7)
+    _res, cats, _vals = cats_of(a)
+    assert cats[0] == Extrapolation.NONE                    # 2
+    assert cats[1] == Extrapolation.AVG_ADJACENT            # 3
+    assert cats[2] == Extrapolation.NONE                    # 4
+    assert cats[3] == Extrapolation.AVG_ADJACENT            # 5
+    assert cats[4] == Extrapolation.NONE                    # 6
+
+
+def test_aggregate_single_window_progression():
+    """testAggregateSingleWindow: category walks NO_VALID →
+    FORCED_INSUFFICIENT → AVG_AVAILABLE → NONE as samples accumulate in
+    one window (min_samples=4, half-min=2)."""
+    a = agg(num_windows=3, min_samples=4)
+    a.roll_to(0)
+    a.roll_to(1)  # window 0 stable, empty
+
+    def window0_cat():
+        cats, valid, _extra = a.store.classify()
+        return (int(cats[0, 0]) if cats.size else None,
+                bool(valid[0, 0]) if valid.size else None)
+
+    fill(a, "e0", 1, 4)  # give the entity a row; window 1 stays current-ish
+    a.roll_to(2)
+    c, v = window0_cat()
+    assert c == Extrapolation.NO_VALID_EXTRAPOLATION and not v
+
+    fill(a, "e0", 0, 1)                      # 1 < half-min
+    c, v = window0_cat()
+    assert c == Extrapolation.FORCED_INSUFFICIENT and v
+
+    fill(a, "e0", 0, 1, base=20.0)           # 2 == half-min
+    c, v = window0_cat()
+    assert c == Extrapolation.AVG_AVAILABLE and v
+
+    fill(a, "e0", 0, 2, base=30.0)           # 4 == min
+    c, v = window0_cat()
+    assert c == Extrapolation.NONE and v
+
+
+def test_adjacent_avg_value_blend_at_middle():
+    """testExtrapolationAdjacentAvgAtMiddle: the AVG metric blends by
+    sample count; MAX/LATEST blend by window count."""
+    a = agg(num_windows=4, min_samples=2)
+    fill(a, "e0", 0, 2, base=10.0)   # avg 10.5, max 11
+    fill(a, "e0", 2, 2, base=12.0)   # avg 12.5, max 13
+    fill(a, "e0", 3, 1)              # current
+    a.roll_to(3)
+    _res, cats, vals = cats_of(a)
+    assert cats[1] == Extrapolation.AVG_ADJACENT
+    # AVG: (10+11+12+13)/4 = 11.5 (the reference's 11.5 case).
+    assert vals[0, 1] == pytest.approx(11.5)
+    # MAX: (11 + 13)/2 = 12 (reference's 13.0 case shape: window-count blend).
+    assert vals[1, 1] == pytest.approx(12.0)
+
+
+def test_adjacent_avg_not_at_left_edge():
+    """testExtrapolationAdjacentAvgAtLeftEdge: the FIRST stable window has
+    no previous neighbour — an empty one is NO_VALID, never ADJACENT."""
+    a = agg(num_windows=4, min_samples=2)
+    a.roll_to(0)  # first tracked window = 0 (otherwise 0 is never retained)
+    fill(a, "e0", 1, 2)
+    fill(a, "e0", 2, 2)
+    a.roll_to(3)  # stable [0, 2]; window 0 empty at the left edge
+    _res, cats, vals = cats_of(a)
+    assert cats[0] == Extrapolation.NO_VALID_EXTRAPOLATION
+    assert vals[0, 0] == 0.0 and vals[1, 0] == 0.0
+
+
+def test_adjacent_avg_not_at_right_edge():
+    """testExtrapolationAdjacentAvgAtRightEdge: the LAST stable window has
+    no next stable neighbour (the current window does not count)."""
+    a = agg(num_windows=4, min_samples=2)
+    fill(a, "e0", 0, 2)
+    fill(a, "e0", 1, 2)
+    fill(a, "e0", 3, 2)  # current window — NOT a stable neighbour
+    a.roll_to(3)   # stable [0, 2]; window 2 empty at the right edge
+    _res, cats, _vals = cats_of(a)
+    assert cats[2] == Extrapolation.NO_VALID_EXTRAPOLATION
+
+
+def test_edge_window_becomes_adjacent_when_ring_rolls():
+    """testAdjacentAvgAtEdgeWhenNewWindowRollsOut: an empty window at the
+    RIGHT edge becomes AVG_ADJACENT once the ring rolls one step forward
+    and its next neighbour becomes stable."""
+    a = agg(num_windows=6, min_samples=2)
+    for w in (0, 1, 2, 4):
+        fill(a, "e0", w, 2)
+    a.roll_to(4)   # stable [0, 3]; 3 empty at right edge
+    _res, cats, _vals = cats_of(a)
+    assert cats[3] == Extrapolation.NO_VALID_EXTRAPOLATION
+    a.roll_to(5)   # stable [0, 4]; 3 now interior with full 2 and 4
+    _res, cats, _vals = cats_of(a)
+    assert cats[3] == Extrapolation.AVG_ADJACENT
+
+
+def test_edge_window_stays_invalid_after_large_leap():
+    """testAdjacentAvgAtEdgeWhenNewWindowRollsOutWithLargeLeap: a far roll
+    evicts the would-be neighbour, so the gap window never becomes
+    ADJACENT — it is evicted or still neighbourless."""
+    a = agg(num_windows=4, min_samples=2)
+    for w in (0, 1, 2):
+        fill(a, "e0", w, 2)
+    a.roll_to(4)   # stable [0, 3]; 3 empty at edge
+    a.roll_to(8)   # large leap: everything evicted/reset
+    cats, valid, _ = a.store.classify()
+    assert not valid[0].any()
+    assert (cats[0] == int(Extrapolation.NO_VALID_EXTRAPOLATION)).all()
+
+
+def test_forced_insufficient_thresholds_exact():
+    """RawMetricValues.java:61 + :425-465 — the half-min boundary: with
+    min_samples=5 (half-min=2), count 1 is FORCED_INSUFFICIENT, count 2
+    is AVG_AVAILABLE, count 4 is still AVG_AVAILABLE, count 5 is NONE."""
+    a = agg(num_windows=8, min_samples=5)
+    for w, n in ((0, 5), (1, 1), (2, 5), (3, 2), (4, 4), (5, 5)):
+        fill(a, "e0", w, n)
+    a.roll_to(6)
+    _res, cats, _vals = cats_of(a)
+    # window 1 has full neighbours 0 and 2 -> ADJACENT takes precedence
+    # over FORCED only when count < half-min AND neighbours qualify.
+    assert cats[1] == Extrapolation.AVG_ADJACENT
+    assert cats[3] == Extrapolation.AVG_AVAILABLE
+    assert cats[4] == Extrapolation.AVG_AVAILABLE
+    assert cats[0] == Extrapolation.NONE and cats[5] == Extrapolation.NONE
+
+    # Without qualifying neighbours, count < half-min is FORCED.
+    b = agg(num_windows=4, min_samples=5)
+    fill(b, "e0", 0, 1)
+    fill(b, "e0", 1, 1)
+    b.roll_to(2)
+    _res, cats_b, _vals = cats_of(b)
+    assert cats_b[0] == Extrapolation.FORCED_INSUFFICIENT
+    assert cats_b[1] == Extrapolation.FORCED_INSUFFICIENT
+
+
+def test_max_allowed_extrapolations_gate():
+    """RawMetricValues.isValid: an entity stays valid only while its
+    extrapolated-window count is within max.allowed.extrapolations."""
+    a = agg(num_windows=6, min_samples=4)
+    for w in range(6):
+        n = 2 if w in (1, 3) else 4   # two AVG_AVAILABLE windows
+        fill(a, "e0", w, n)
+    res = a.aggregate(AggregationOptions(
+        min_valid_windows=1, max_allowed_extrapolations_per_entity=2))
+    assert res.entity_valid[0]
+    res = a.aggregate(AggregationOptions(
+        min_valid_windows=1, max_allowed_extrapolations_per_entity=1,
+        include_invalid_entities=True))
+    assert not res.entity_valid[0]
+
+
+# ---- MetricSampleAggregatorTest option-matrix ports ----------------------
+
+def _two_topic_aggregator():
+    """Fixture shaped like testAggregationOption1-7: topic t1 fully
+    monitored, topic t2's second partition missing half its windows."""
+    group_fn = lambda e: e.split("-")[0]
+    a = agg(num_windows=4, min_samples=1, group_fn=group_fn)
+    for w in range(5):
+        fill(a, "t1-p0", w, 1)
+        fill(a, "t1-p1", w, 1)
+        fill(a, "t2-p0", w, 1)
+        if w < 2:
+            fill(a, "t2-p1", w, 1)
+    a.roll_to(4)
+    return a
+
+
+def test_aggregation_option_entity_coverage_gate():
+    """testAggregationOption1/2: a high min_valid_entity_ratio rejects
+    windows where the sparse entity is invalid; lowering it admits them."""
+    a = _two_topic_aggregator()
+    with pytest.raises(NotEnoughValidWindowsError):
+        a.aggregate(AggregationOptions(min_valid_entity_ratio=0.9,
+                                       min_valid_windows=4))
+    res = a.aggregate(AggregationOptions(min_valid_entity_ratio=0.5,
+                                         min_valid_windows=4))
+    assert len(res.window_indices) == 4
+
+
+def test_aggregation_option_group_granularity_poisons_topic():
+    """testAggregationOption3/4: under ENTITY_GROUP granularity the sparse
+    partition invalidates its whole topic in the missing windows."""
+    a = _two_topic_aggregator()
+    comp_e = a.completeness(AggregationOptions(
+        min_valid_windows=1, granularity=Granularity.ENTITY))
+    comp_g = a.completeness(AggregationOptions(
+        min_valid_windows=1, granularity=Granularity.ENTITY_GROUP))
+    # Later windows: 3/4 entities valid; group mode drops both t2 members.
+    assert comp_e.valid_entity_ratio_by_window[-1] == pytest.approx(3 / 4)
+    assert comp_g.valid_entity_ratio_by_window[-1] == pytest.approx(2 / 4)
+    assert comp_g.valid_entity_group_ratio_by_window[-1] == pytest.approx(1 / 2)
+
+
+def test_aggregation_option_interested_entities_subset():
+    """testAggregationOption5/6: completeness is computed over the
+    interested-entity universe only."""
+    a = _two_topic_aggregator()
+    res = a.aggregate(AggregationOptions(
+        min_valid_entity_ratio=1.0, min_valid_windows=4,
+        interested_entities=("t1-p0", "t1-p1", "t2-p0")))
+    assert len(res.window_indices) == 4
+    assert sorted(res.entities) == ["t1-p0", "t1-p1", "t2-p0"]
+
+
+def test_aggregation_option_include_invalid_entities():
+    """testAggregationOption7: include_invalid_entities keeps the sparse
+    entity's rows (zeros where invalid) instead of dropping them."""
+    a = _two_topic_aggregator()
+    res = a.aggregate(AggregationOptions(min_valid_windows=1,
+                                         include_invalid_entities=True))
+    row = res.entities.index("t2-p1")
+    assert not res.entity_valid[row]
+    assert res.values.shape[0] == 4
+    res2 = a.aggregate(AggregationOptions(min_valid_windows=1))
+    row2 = res2.entities.index("t2-p1")
+    # Excluded: zeroed rows, alignment preserved.
+    assert (res2.values[row2] == 0.0).all()
+
+
+def test_window_range_restriction_start_end():
+    """LOAD start/end params: only windows overlapping the range
+    participate; an empty overlap raises NotEnoughValidWindows."""
+    a = agg(num_windows=6, min_samples=1)
+    for w in range(6):
+        fill(a, "e0", w, 1)
+    res = a.aggregate(AggregationOptions(
+        min_valid_windows=1, start_ms=1 * WINDOW_MS, end_ms=3 * WINDOW_MS))
+    assert res.window_indices == [1, 2, 3]
+    with pytest.raises(NotEnoughValidWindowsError):
+        a.aggregate(AggregationOptions(min_valid_windows=1,
+                                       start_ms=50_000, end_ms=60_000))
+
+
+def test_peek_current_window():
+    """testPeekCurrentWindow: the in-fill window is readable without
+    waiting for it to roll stable."""
+    a = agg(num_windows=4, min_samples=1)
+    for w in range(3):
+        fill(a, "e0", w, 1)
+    fill(a, "e0", 3, 2, base=40.0)  # current
+    entities, vals = a.peek_current_window()
+    assert entities == ["e0"]
+    assert vals[0, 0] == pytest.approx(40.5)  # AVG of 40, 41
+
+
+def test_large_interval_roll_resets_only_reentered_slots():
+    """testAddSamplesWithLargeInterval: rolling far forward resets the ring
+    slots that are re-entered; samples land in the fresh window."""
+    a = agg(num_windows=3, min_samples=1)
+    fill(a, "e0", 0, 2)
+    fill(a, "e0", 100, 2)
+    assert a.available_windows() == [97, 98, 99]
+    assert a.num_samples() == 2  # only the current window's two samples

@@ -1,0 +1,101 @@
+"""Sharded-vs-single-device solver comparison on a virtual CPU mesh.
+
+VERDICT r3 #5: demonstrate the multi-chip story past a smoke test — run
+the SAME 1k-broker fixture through the single-device fused solver and the
+8-virtual-device sharded solver, record both wall-clocks, and check the
+final assignments/quality against each other (the trajectory-equivalence
+check of tests/test_parallel.py at bench scale).
+
+Host-CPU devices share the same physical cores, so the 8-device wall-clock
+here measures SPMD overhead (collectives + per-device dispatch), not
+speedup — the ratio is the lower bound a real 8-chip ICI mesh improves on
+(each real chip has its own compute). Prints one JSON line per
+configuration plus a comparison line.
+
+    python tools/bench_mesh.py [brokers] [partitions]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_DEV = int(os.environ.get("MESH_DEVICES", "8"))
+
+
+def main() -> int:
+    num_brokers = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    num_partitions = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
+    from cruise_control_tpu.utils import force_host_cpu_devices
+
+    jax = force_host_cpu_devices(N_DEV)
+    import numpy as np
+
+    from cruise_control_tpu import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
+    from cruise_control_tpu.analyzer.optimizer import (
+        GoalOptimizer, goals_by_priority,
+    )
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.model.fixtures import Dist, random_cluster
+    from cruise_control_tpu.parallel import make_mesh
+
+    state, meta = random_cluster(
+        num_brokers=num_brokers, num_topics=max(8, num_brokers // 10),
+        num_partitions=num_partitions, rf=3, num_racks=8,
+        dist=Dist.EXPONENTIAL, seed=42, skew_to_first=2.0,
+        target_utilization=0.55, partition_bucket=N_DEV)
+    cfg = CruiseControlConfig()
+
+    results = {}
+    for label, mesh in (("single_device", None),
+                        (f"mesh_{N_DEV}dev", make_mesh(N_DEV))):
+        optimizer = GoalOptimizer(cfg, mesh=mesh)
+        t0 = time.time()
+        final, res = optimizer.optimizations(state, meta,
+                                             goals=goals_by_priority(cfg))
+        warm_s = time.time() - t0
+        t0 = time.time()
+        final, res = optimizer.optimizations(state, meta,
+                                             goals=goals_by_priority(cfg))
+        steady_s = time.time() - t0
+        results[label] = (np.asarray(jax.device_get(final).assignment),
+                          res, steady_s)
+        print(json.dumps({
+            "metric": f"mesh_bench_{label}_{num_brokers}b",
+            "value": round(steady_s, 3), "unit": "s", "vs_baseline": 1.0,
+            "extras": {
+                "devices": optimizer.solver_devices(),
+                "warmup_incl_compile_s": round(warm_s, 3),
+                "num_proposals": len(res.proposals),
+                "balancedness_after": round(res.balancedness_after, 2),
+                "violated_goals_after": res.violated_goals_after,
+                "total_rounds": sum(g.rounds for g in res.goal_results),
+            }}), flush=True)
+
+    (a1, r1, t1) = results["single_device"]
+    (a8, r8, t8) = results[f"mesh_{N_DEV}dev"]
+    print(json.dumps({
+        "metric": f"mesh_bench_ratio_{num_brokers}b",
+        "value": round(t1 / t8, 3), "unit": "x_single_over_mesh",
+        "vs_baseline": 1.0,
+        "extras": {
+            "assignments_identical": bool((a1 == a8).all()),
+            "balancedness_match": round(r1.balancedness_after, 2)
+            == round(r8.balancedness_after, 2),
+            "violated_goals_match":
+                r1.violated_goals_after == r8.violated_goals_after,
+            "note": "host-CPU devices share cores: ratio measures SPMD "
+                    "overhead, a lower bound for a real 8-chip mesh",
+        }}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

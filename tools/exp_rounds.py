@@ -63,7 +63,7 @@ def main() -> int:
     steady_s = time.time() - t0
     for g in res.goal_results:
         print(json.dumps({"goal": g.name, "rounds": g.rounds,
-                          "moves": g.moves_applied,
+                          "moves": g.moves_applied, "swaps": g.swaps_applied,
                           "duration_s": round(g.duration_s, 3),
                           "violation": round(g.residual_violation, 4)}),
               flush=True)
