@@ -34,6 +34,9 @@ import jax
 import jax.numpy as jnp
 
 from ..model.tensors import ClusterTensors, offline_replicas
+from .agg import (
+    AggCarry, apply_deltas_to_agg, compute_agg, maybe_refresh, pot_lbi_deltas,
+)
 from .candidates import compute_deltas, generate_candidates
 from .constraint import BalancingConstraint
 from .derived import compute_derived
@@ -41,18 +44,33 @@ from .goals.base import Goal
 from .search import (
     _OFFLINE_BONUS, ExclusionMasks, OptimizationFailureError, SearchConfig,
     apply_selected, apply_swap_selection, cumulative_select, goal_aux,
-    run_rounds_loop, swap_grid,
+    run_carry_loop, swap_grid,
 )
 
 
 def _gated_aux(needed: jax.Array, goal: Goal, state, derived, constraint,
-               num_topics: int, psum=None):
+               num_topics: int, psum=None, agg=None):
     """Compute ``goal``'s aux pytree only when ``needed`` (traced bool) —
     zeros otherwise. Keeps the single chain kernel from paying every goal's
     O(P) aux reductions on every round. ``psum`` combines partition-additive
     aux partials across a mesh (the collective runs in BOTH branches — a
     ``lax.cond`` whose branches disagree on collectives would deadlock, and
-    psum of the zero pytree is free)."""
+    psum of the zero pytree is free). With an ``agg`` carry, agg-backed
+    goals read their (already-global) partial from it — collective-free, so
+    the whole aux is safely gated even under a mesh."""
+    if agg is not None and goal.partial_from_agg(agg) is not None:
+        def compute_from_agg(_):
+            return goal.finalize_aux(goal.partial_from_agg(agg), state,
+                                     derived, constraint)
+
+        shapes = jax.eval_shape(compute_from_agg, 0)
+        if not jax.tree_util.tree_leaves(shapes):
+            return compute_from_agg(0)
+
+        def zeros_from_agg(_):
+            return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+        return jax.lax.cond(needed, compute_from_agg, zeros_from_agg, 0)
 
     def compute(_):
         return goal_aux(goal, state, derived, constraint, num_topics, psum)
@@ -128,22 +146,27 @@ def _switch_scores(active_idx, goals, aux_list, state, derived, constraint):
     return jax.lax.switch(active_idx, [branch(i) for i in range(len(goals))], 0)
 
 
-def _chain_round_body(state: ClusterTensors, active_idx: jax.Array,
+def _chain_round_body(state: ClusterTensors, agg: "AggCarry | None",
+                      active_idx: jax.Array,
                       prior_mask: jax.Array, goals: tuple[Goal, ...],
                       constraint: BalancingConstraint, cfg: SearchConfig,
                       num_topics: int, masks: ExclusionMasks,
-                      ) -> tuple[ClusterTensors, jax.Array]:
-    """One search round, chain-parameterized (traced body)."""
+                      ) -> tuple[ClusterTensors, "AggCarry | None", jax.Array]:
+    """One search round, chain-parameterized (traced body). ``agg`` is the
+    incrementally-maintained aggregate carry (analyzer.agg): the round reads
+    its per-broker aggregates from it instead of O(P·S) segment-sums and
+    returns it updated by the applied batch (None = recompute-per-round,
+    kept for the oracle paths)."""
     lead_only_f, incl_lead_f, indep_f = _goal_flags(goals)
     is_lead_only = lead_only_f[active_idx]
     has_leadership = incl_lead_f[active_idx]
 
     derived = compute_derived(state, masks.excluded_topics,
                               masks.excluded_replica_move_brokers,
-                              masks.excluded_leadership_brokers)
+                              masks.excluded_leadership_brokers, agg=agg)
     is_active = jnp.arange(len(goals)) == active_idx
     aux_list = [_gated_aux(prior_mask[i] | is_active[i], g, state, derived,
-                           constraint, num_topics)
+                           constraint, num_topics, agg=agg)
                 for i, g in enumerate(goals)]
 
     src_score, dst_score, weight = _switch_scores(
@@ -212,12 +235,15 @@ def _chain_round_body(state: ClusterTensors, active_idx: jax.Array,
             a &= (~is_active_f[i]) | (~has_earlier) | g_acc
         return a
 
-    top_idx, sel = cumulative_select(state, deltas, score, layout, m,
-                                     cfg.moves_per_round, independent, recheck)
+    top_idx, sel, sub, pot_d, lbi_d = cumulative_select(
+        state, deltas, score, layout, m, cfg.moves_per_round, independent,
+        recheck)
+    if agg is not None:
+        agg = apply_deltas_to_agg(agg, sub, sel, pot_d, lbi_d)
     new_state = apply_selected(
         state, sel, deltas.partition[top_idx], deltas.src_slot[top_idx],
         deltas.dst_broker[top_idx], cand.kind[top_idx], cand.dst_slot[top_idx])
-    return new_state, sel.sum()
+    return new_state, agg, sel.sum()
 
 
 @partial(jax.jit, static_argnames=("goals", "constraint", "cfg", "num_topics"))
@@ -230,24 +256,37 @@ def chain_optimize_rounds(state: ClusterTensors, active_idx: jax.Array,
     """Fused multi-round driver for ANY goal in the chain: one compilation
     serves all G (active_idx, prior_mask) combinations. Returns
     (final_state, total_moves, rounds_run). ``budget`` (traced) further
-    caps rounds without recompiling (bounded-dispatch path)."""
-    return run_rounds_loop(
-        lambda s: _chain_round_body(s, active_idx, prior_mask, goals,
-                                    constraint, cfg, num_topics, masks),
-        state, cfg.max_rounds, budget=budget)
+    caps rounds without recompiling (bounded-dispatch path).
+
+    Aggregates are computed once at entry and maintained incrementally
+    through the loop (analyzer.agg), with a periodic fresh recompute to
+    bound f32 drift."""
+    def body(carry, rounds_done):
+        s, a = carry
+        a = maybe_refresh(a, s, num_topics, rounds_done)
+        ns, na, applied = _chain_round_body(s, a, active_idx, prior_mask,
+                                            goals, constraint, cfg,
+                                            num_topics, masks)
+        return (ns, na), applied
+
+    (final, _agg), total, rounds = run_carry_loop(
+        body, (state, compute_agg(state, num_topics)), cfg.max_rounds,
+        budget=budget)
+    return final, total, rounds
 
 
-def _chain_swap_body(state: ClusterTensors, active_idx: jax.Array,
+def _chain_swap_body(state: ClusterTensors, agg: "AggCarry | None",
+                     active_idx: jax.Array,
                      prior_mask: jax.Array, goals: tuple[Goal, ...],
                      constraint: BalancingConstraint, num_topics: int,
                      masks: ExclusionMasks, moves: int = 8,
-                     ) -> tuple[ClusterTensors, jax.Array]:
+                     ) -> tuple[ClusterTensors, "AggCarry | None", jax.Array]:
     derived = compute_derived(state, masks.excluded_topics,
                               masks.excluded_replica_move_brokers,
-                              masks.excluded_leadership_brokers)
+                              masks.excluded_leadership_brokers, agg=agg)
     is_active = jnp.arange(len(goals)) == active_idx
     aux_list = [_gated_aux(prior_mask[i] | is_active[i], g, state, derived,
-                           constraint, num_topics)
+                           constraint, num_topics, agg=agg)
                 for i, g in enumerate(goals)]
     src_score, dst_score, weight = _switch_scores(
         active_idx, goals, aux_list, state, derived, constraint)
@@ -271,8 +310,16 @@ def _chain_swap_body(state: ClusterTensors, active_idx: jax.Array,
     imp = jax.lax.switch(active_idx,
                          [imp_branch(i) for i in range(len(goals))], 0)
     score = jnp.where(accept, imp, -jnp.inf)
-    return apply_swap_selection(state, score, p1, s1, p2, s2, src_b, dst_b,
-                                moves)
+    new_state, applied, top_idx, sel = apply_swap_selection(
+        state, score, p1, s1, p2, s2, src_b, dst_b, moves)
+    if agg is not None:
+        # Both directional legs of every accepted swap scatter onto the
+        # carry (replica + load + leadership travel per leg).
+        for leg in (fwd, rev):
+            leg_sub = jax.tree.map(lambda a: a[top_idx], leg)
+            pot_d, lbi_d = pot_lbi_deltas(state, leg_sub)
+            agg = apply_deltas_to_agg(agg, leg_sub, sel, pot_d, lbi_d)
+    return new_state, agg, applied
 
 
 @partial(jax.jit, static_argnames=("goals", "constraint", "num_topics",
@@ -284,11 +331,20 @@ def chain_swap_rounds(state: ClusterTensors, active_idx: jax.Array,
                       max_rounds: int = 64,
                       budget: jax.Array | None = None,
                       ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
-    """Fused swap-phase driver, chain-parameterized."""
-    return run_rounds_loop(
-        lambda s: _chain_swap_body(s, active_idx, prior_mask, goals,
-                                   constraint, num_topics, masks, moves),
-        state, max_rounds, budget=budget)
+    """Fused swap-phase driver, chain-parameterized (incremental-aggregate
+    carry, as chain_optimize_rounds)."""
+    def body(carry, rounds_done):
+        s, a = carry
+        a = maybe_refresh(a, s, num_topics, rounds_done)
+        ns, na, applied = _chain_swap_body(s, a, active_idx, prior_mask,
+                                           goals, constraint, num_topics,
+                                           masks, moves)
+        return (ns, na), applied
+
+    (final, _agg), total, rounds = run_carry_loop(
+        body, (state, compute_agg(state, num_topics)), max_rounds,
+        budget=budget)
+    return final, total, rounds
 
 
 def _chain_goal_stats_body(state: ClusterTensors, active_idx: jax.Array,
@@ -396,37 +452,59 @@ def chain_optimize_full(state: ClusterTensors, goals: tuple[Goal, ...],
         def run(s):
             # Interleave the fused move driver with the fused swap driver
             # until a swap pass applies nothing (the host loop of
-            # optimize_goal_in_chain, on device).
+            # optimize_goal_in_chain, on device). The aggregate carry is
+            # computed once per goal and threaded through both phases.
             def outer_cond(c):
-                _s, _m, _sw, rounds, last_swapped, first = c
+                _s, _a, _m, _sw, rounds, last_swapped, first = c
                 return (first | (last_swapped > 0)) & (rounds < cfg.max_rounds)
 
             def outer_body(c):
-                s, m_tot, sw_tot, rounds, _ls, _first = c
-                s, m, r = run_rounds_loop(
-                    lambda st: _chain_round_body(st, g, prior, goals,
-                                                 constraint, cfg, num_topics,
-                                                 masks),
-                    s, cfg.max_rounds)
+                s, a, m_tot, sw_tot, rounds, _ls, _first = c
 
-                def do_swap(st):
-                    return run_rounds_loop(
-                        lambda st2: _chain_swap_body(st2, g, prior, goals,
-                                                     constraint, num_topics,
-                                                     masks, swap_moves),
-                        st, swap_max_rounds)
+                # The refresh cadence must count ROUNDS SINCE THE LAST FULL
+                # RECOMPUTE, which spans move/swap segments — each inner
+                # loop's private counter restarts at 0, so it is offset by
+                # the goal's cumulative round count (else a pass of many
+                # short segments would never refresh).
+                def move_body(carry, rounds_done):
+                    st, ag = carry
+                    ag = maybe_refresh(ag, st, num_topics,
+                                       rounds + rounds_done)
+                    ns, nag, applied = _chain_round_body(
+                        st, ag, g, prior, goals, constraint, cfg, num_topics,
+                        masks)
+                    return (ns, nag), applied
 
-                def no_swap(st):
-                    return st, jnp.int32(0), jnp.int32(0)
+                (s, a), m, r = run_carry_loop(move_body, (s, a),
+                                              cfg.max_rounds)
 
-                s, sw, sr = jax.lax.cond(supports_swap[g], do_swap, no_swap, s)
-                return (s, m_tot + m, sw_tot + sw, rounds + r + sr, sw,
+                def do_swap(st_ag):
+                    def swap_body(carry, rounds_done):
+                        st, ag = carry
+                        ag = maybe_refresh(ag, st, num_topics,
+                                           rounds + r + rounds_done)
+                        ns, nag, applied = _chain_swap_body(
+                            st, ag, g, prior, goals, constraint, num_topics,
+                            masks, swap_moves)
+                        return (ns, nag), applied
+
+                    (st, ag), sw, sr = run_carry_loop(swap_body, st_ag,
+                                                      swap_max_rounds)
+                    return st, ag, sw, sr
+
+                def no_swap(st_ag):
+                    st, ag = st_ag
+                    return st, ag, jnp.int32(0), jnp.int32(0)
+
+                s, a, sw, sr = jax.lax.cond(supports_swap[g], do_swap,
+                                            no_swap, (s, a))
+                return (s, a, m_tot + m, sw_tot + sw, rounds + r + sr, sw,
                         jnp.bool_(False))
 
-            s, m, sw, rounds, _, _ = jax.lax.while_loop(
+            s, a, m, sw, rounds, _, _ = jax.lax.while_loop(
                 outer_cond, outer_body,
-                (s, jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
-                 jnp.bool_(True)))
+                (s, compute_agg(s, num_topics), jnp.int32(0), jnp.int32(0),
+                 jnp.int32(0), jnp.int32(0), jnp.bool_(True)))
             return s, m, sw, rounds
 
         def skip(s):

@@ -47,20 +47,28 @@ def compute_derived(state: ClusterTensors,
                     excluded_topic_mask: jax.Array | None = None,
                     excluded_replica_move_brokers: jax.Array | None = None,
                     excluded_leadership_brokers: jax.Array | None = None,
-                    psum=None) -> DerivedState:
+                    psum=None, agg=None) -> DerivedState:
     """All per-broker aggregates + cluster averages in one pass.
 
     ``excluded_*`` are boolean masks aligned with topics/brokers (host-built
     from OptimizationOptions by the optimizer). ``psum`` combines the
     partition-additive aggregates across a sharded mesh (identity when the
-    whole model lives on one device).
+    whole model lives on one device). ``agg`` (an
+    :class:`~cruise_control_tpu.analyzer.agg.AggCarry`) supplies the
+    per-broker aggregates pre-computed — the incrementally-maintained loop
+    carry — skipping the O(P·S) segment-sums (and their psums: the carry is
+    already global on a mesh).
     """
     p = psum or (lambda x: x)
     alive = alive_mask(state)
-    load = p(broker_load(state))
-    reps = p(broker_replica_counts(state))
-    leads = p(broker_leader_counts(state))
-    pot = p(potential_nw_out(state))
+    if agg is not None:
+        load, reps, leads, pot = (agg.broker_load, agg.broker_replicas,
+                                  agg.broker_leaders, agg.pot_nw_out)
+    else:
+        load = p(broker_load(state))
+        reps = p(broker_replica_counts(state))
+        leads = p(broker_leader_counts(state))
+        pot = p(potential_nw_out(state))
     new_b = new_broker_mask(state)
 
     excl_rm = (jnp.zeros(state.num_brokers, dtype=bool)

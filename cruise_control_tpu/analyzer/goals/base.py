@@ -84,6 +84,13 @@ class Goal:
         device computes its partial and the search psums the pytree."""
         return None
 
+    def partial_from_agg(self, agg) -> Any:
+        """This goal's prepare_partial result read from the incrementally-
+        maintained AggCarry (analyzer.agg) instead of an O(P·S) recompute,
+        or None when the goal is not agg-backed. The returned partial is
+        already GLOBAL (no psum needed on a mesh)."""
+        return None
+
     def finalize_aux(self, partial: Any, state: ClusterTensors,
                      derived: DerivedState,
                      constraint: BalancingConstraint) -> Any:

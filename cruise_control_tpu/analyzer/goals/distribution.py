@@ -275,6 +275,9 @@ class TopicReplicaDistributionGoal(Goal):
         return {"counts": topic_broker_replica_counts(state, num_topics)
                 .astype(jnp.float32)}
 
+    def partial_from_agg(self, agg):
+        return {"counts": agg.topic_counts.astype(jnp.float32)}
+
     def finalize_aux(self, partial, state, derived, constraint):
         counts = partial["counts"]
         n_alive = jnp.maximum(derived.alive.sum(), 1)
@@ -392,15 +395,11 @@ class LeaderBytesInDistributionGoal(Goal):
     (LeaderBytesInDistributionGoal.java:288LoC)."""
 
     def prepare_partial(self, state, num_topics):
-        b = state.num_brokers
-        lead = is_leader_slot(state)
-        seg = jnp.where(lead, jnp.clip(state.assignment, 0, b - 1), b).reshape(-1)
-        nw_in = jnp.broadcast_to(
-            state.leader_load[:, int(Resource.NW_IN)][:, None],
-            lead.shape).reshape(-1)
-        lbi = jax.ops.segment_sum(jnp.where(seg < b, nw_in, 0.0), seg,
-                                  num_segments=b + 1)[:b]
-        return {"lbi": lbi}
+        from ...model.tensors import leader_bytes_in
+        return {"lbi": leader_bytes_in(state)}
+
+    def partial_from_agg(self, agg):
+        return {"lbi": agg.lbi}
 
     def finalize_aux(self, partial, state, derived, constraint):
         lbi = partial["lbi"]

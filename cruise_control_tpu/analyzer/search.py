@@ -73,9 +73,16 @@ class ExclusionMasks:
 
 
 def goal_aux(goal: Goal, state: ClusterTensors, derived: DerivedState,
-             constraint: BalancingConstraint, num_topics: int, psum=None):
+             constraint: BalancingConstraint, num_topics: int, psum=None,
+             agg=None):
     """Per-goal aux tensors; the partition-additive partial is psum'd when a
-    mesh hook is given (Goal.prepare_partial/finalize_aux contract)."""
+    mesh hook is given (Goal.prepare_partial/finalize_aux contract). With an
+    ``agg`` carry, agg-backed goals read their partial from it instead of
+    an O(P·S) recompute (already global: no psum)."""
+    if agg is not None:
+        partial_aux = goal.partial_from_agg(agg)
+        if partial_aux is not None:
+            return goal.finalize_aux(partial_aux, state, derived, constraint)
     partial_aux = goal.prepare_partial(state, num_topics)
     if partial_aux is not None and psum is not None:
         partial_aux = jax.tree.map(psum, partial_aux)
@@ -173,7 +180,10 @@ def cumulative_select(state: ClusterTensors, deltas, score: jax.Array,
     moves may share a broker as long as their joint effect stays inside
     every goal's bands/limits.
 
-    Returns (top_idx into the full grid, sel mask)."""
+    Returns (top_idx into the full grid, sel mask, selected sub-batch,
+    pot_delta, lbi_delta) — the latter three so aggregate-carrying drivers
+    can scatter the batch's effect without re-deriving it."""
+    from .agg import pot_lbi_deltas
     red_idx = reduce_per_source(score, layout)
     red_score = score[red_idx]
     k = min(m, red_score.shape[0])
@@ -189,12 +199,7 @@ def cumulative_select(state: ClusterTensors, deltas, score: jax.Array,
     part_ok = ok & (first_p[sel_p] == rank)
 
     sub = jax.tree.map(lambda a: a[idx], deltas)
-    pot = jnp.where(sub.replica_delta > 0,
-                    state.leader_load[sub.partition, int(Resource.NW_OUT)],
-                    0.0)
-    lbi = jnp.where(sub.leader_delta > 0,
-                    state.leader_load[sub.partition, int(Resource.NW_IN)],
-                    0.0)
+    pot, lbi = pot_lbi_deltas(state, sub)
     sub, has_earlier = attach_cumulative(sub, part_ok, pot, lbi)
     sel = part_ok & recheck(sub, has_earlier)
     within_cap = jnp.cumsum(sel.astype(jnp.int32)) <= moves_cap
@@ -204,17 +209,16 @@ def cumulative_select(state: ClusterTensors, deltas, score: jax.Array,
         sel &= within_cap
     else:
         sel &= jnp.where(independent, True, within_cap)
-    return idx, sel
+    return idx, sel, sub, pot, lbi
 
 
-def run_rounds_loop(round_body, state: ClusterTensors, max_rounds: int,
-                    budget=None,
-                    ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
-    """Shared fused-driver scaffold: iterate ``round_body(state) ->
-    (new_state, applied)`` under ``lax.while_loop`` until a round applies
+def run_carry_loop(round_body, carry0, max_rounds: int, budget=None):
+    """Generic fused-driver scaffold: iterate ``round_body(carry, rounds)
+    -> (carry, applied)`` under ``lax.while_loop`` until a round applies
     nothing (or ``max_rounds``) entirely on device — ONE host round-trip
-    for the whole loop. Returns (final_state, total_applied, rounds_run).
-    Used by the single-chip, chain-shared, and sharded drivers alike.
+    for the whole loop. ``carry0`` is any pytree (the incremental-aggregate
+    drivers carry (state, AggCarry)). Returns (final_carry, total_applied,
+    rounds_run).
 
     ``budget`` (optional TRACED int) further caps the rounds this call may
     run without recompiling per value — the bounded-dispatch driver passes
@@ -225,18 +229,30 @@ def run_rounds_loop(round_body, state: ClusterTensors, max_rounds: int,
         jnp.int32(max_rounds), budget.astype(jnp.int32))
 
     def cond(c):
-        _s, _total, rounds, last = c
+        _carry, _total, rounds, last = c
         return (last > 0) & (rounds < cap)
 
     def body(c):
-        s, total, rounds, _last = c
-        ns, applied = round_body(s)
+        carry, total, rounds, _last = c
+        carry, applied = round_body(carry, rounds)
         applied = applied.astype(jnp.int32)
-        return ns, total + applied, rounds + 1, applied
+        return carry, total + applied, rounds + 1, applied
 
     final, total, rounds, _ = jax.lax.while_loop(
-        cond, body, (state, jnp.int32(0), jnp.int32(0), jnp.int32(1)))
+        cond, body, (carry0, jnp.int32(0), jnp.int32(0), jnp.int32(1)))
     return final, total, rounds
+
+
+def run_rounds_loop(round_body, state: ClusterTensors, max_rounds: int,
+                    budget=None,
+                    ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
+    """State-only wrapper of :func:`run_carry_loop` — iterate
+    ``round_body(state) -> (new_state, applied)`` to its fixed point.
+    Returns (final_state, total_applied, rounds_run). Used by the per-goal
+    kernels (the equivalence oracles) and any driver without an aggregate
+    carry."""
+    return run_carry_loop(lambda s, _r: round_body(s), state, max_rounds,
+                          budget=budget)
 
 
 def score_round_candidates(state: ClusterTensors, masks: ExclusionMasks,
@@ -463,8 +479,12 @@ def swap_round_candidates(state: ClusterTensors, masks: ExclusionMasks,
 def apply_swap_selection(state: ClusterTensors, score: jax.Array,
                          p1: jax.Array, s1: jax.Array, p2: jax.Array,
                          s2: jax.Array, src_b: jax.Array, dst_b: jax.Array,
-                         moves: int = 8) -> tuple[ClusterTensors, jax.Array]:
-    """Select + apply a conflict-free batch of scored swaps.
+                         moves: int = 8,
+                         ) -> tuple[ClusterTensors, jax.Array, jax.Array, jax.Array]:
+    """Select + apply a conflict-free batch of scored swaps. Returns
+    (new_state, num_applied, top_idx, sel) — the selection indices/mask so
+    aggregate-carrying drivers can scatter the swap's effect onto the
+    carry.
 
     Selection: no two accepted swaps may share ANY partition (p1 or p2,
     across roles — else one partition could gain two replicas on a broker
@@ -494,7 +514,8 @@ def apply_swap_selection(state: ClusterTensors, score: jax.Array,
                                     mode="drop") \
         .at[rows2, s2[top_idx]].set(src_b[top_idx].astype(state.assignment.dtype),
                                     mode="drop")
-    return dataclasses.replace(state, assignment=new_assignment), sel.sum()
+    return (dataclasses.replace(state, assignment=new_assignment), sel.sum(),
+            top_idx, sel)
 
 
 def _swap_round_body(state: ClusterTensors, goal: Goal,
@@ -505,8 +526,9 @@ def _swap_round_body(state: ClusterTensors, goal: Goal,
     """One batched swap round (traced body)."""
     score, p1, s1, p2, s2, src_b, dst_b = swap_round_candidates(
         state, masks, goal, optimized, constraint, num_topics)
-    return apply_swap_selection(state, score, p1, s1, p2, s2, src_b, dst_b,
-                                moves)
+    new_state, applied, _idx, _sel = apply_swap_selection(
+        state, score, p1, s1, p2, s2, src_b, dst_b, moves)
+    return new_state, applied
 
 
 @partial(jax.jit, static_argnames=("goal", "optimized", "constraint",
@@ -546,8 +568,9 @@ def _round_body(state: ClusterTensors, goal: Goal, optimized: tuple[Goal, ...],
                                               aux, sub)
         return a
 
-    top_idx, sel = cumulative_select(state, deltas, score, layout, m,
-                                     cfg.moves_per_round, independent, recheck)
+    top_idx, sel, _sub, _pot, _lbi = cumulative_select(
+        state, deltas, score, layout, m, cfg.moves_per_round, independent,
+        recheck)
     new_state = apply_selected(
         state, sel, deltas.partition[top_idx], deltas.src_slot[top_idx],
         deltas.dst_broker[top_idx], cand.kind[top_idx], cand.dst_slot[top_idx])

@@ -36,6 +36,9 @@ from ..analyzer.candidates import (
     Candidates, CandidateDeltas, attach_cumulative, compute_deltas,
     generate_candidates,
 )
+from ..analyzer.agg import (
+    AggDelta, apply_deltas_to_agg, compute_agg, pot_lbi_deltas,
+)
 from ..analyzer.chain import (
     _chain_infos_from_stats, _gated_aux, _goal_flags, _switch_scores,
     excluded_hosting_replicas,
@@ -45,7 +48,7 @@ from ..analyzer.derived import compute_derived
 from ..analyzer.search import (
     _OFFLINE_BONUS, _EPS_IMPROVEMENT, ExclusionMasks, SearchConfig,
     _per_broker_top_replicas, apply_selected, reduce_per_source,
-    run_rounds_loop,
+    run_carry_loop,
 )
 from ..common.resources import Resource
 from ..model.tensors import ClusterTensors, offline_replicas
@@ -62,13 +65,13 @@ def _offline_per_broker(state: ClusterTensors, off: jax.Array) -> jax.Array:
 
 
 def _chain_scores(state, derived, active_idx, prior_mask, goals, constraint,
-                  num_topics, additive_f):
+                  num_topics, additive_f, agg=None):
     """(aux_list, src_score, dst_score, weight) for the active goal under
     the mesh. The psum of partition-additive source scores runs
     unconditionally (collective-safety) and is selected by a traced flag."""
     is_active = jnp.arange(len(goals)) == active_idx
     aux_list = [_gated_aux(prior_mask[i] | is_active[i], g, state, derived,
-                           constraint, num_topics, psum=_psum)
+                           constraint, num_topics, psum=_psum, agg=agg)
                 for i, g in enumerate(goals)]
     src_score, dst_score, weight = _switch_scores(
         active_idx, goals, aux_list, state, derived, constraint)
@@ -76,12 +79,15 @@ def _chain_scores(state, derived, active_idx, prior_mask, goals, constraint,
     return aux_list, src_score, dst_score, weight
 
 
-def _chain_round_local(state: ClusterTensors, masks: ExclusionMasks,
+def _chain_round_local(state: ClusterTensors, agg, masks: ExclusionMasks,
                        active_idx: jax.Array, prior_mask: jax.Array, *,
                        goals, constraint: BalancingConstraint,
                        cfg: SearchConfig, num_topics: int, num_shards: int):
     """One chain-parameterized sharded search round (per-device body):
-    the sharded analogue of ``analyzer.chain._chain_round_body``."""
+    the sharded analogue of ``analyzer.chain._chain_round_body``. ``agg``
+    is the incrementally-maintained GLOBAL aggregate carry (replicated on
+    every device; the selected batch is replicated too, so the update
+    needs no further collectives). Returns (new_state, new_agg, applied)."""
     shard = jax.lax.axis_index(PARTITION_AXIS)
     p_local = state.num_partitions
     p_global = p_local * num_shards
@@ -98,11 +104,12 @@ def _chain_round_local(state: ClusterTensors, masks: ExclusionMasks,
 
     derived = compute_derived(state, masks.excluded_topics,
                               masks.excluded_replica_move_brokers,
-                              masks.excluded_leadership_brokers, psum=_psum)
+                              masks.excluded_leadership_brokers, psum=_psum,
+                              agg=agg)
     is_active = jnp.arange(len(goals)) == active_idx
     aux_list, src_score, dst_score, weight = _chain_scores(
         state, derived, active_idx, prior_mask, goals, constraint,
-        num_topics, additive_f)
+        num_topics, additive_f, agg=agg)
 
     # Self-healing priority (score_round_candidates semantics).
     off = offline_replicas(state)
@@ -156,12 +163,7 @@ def _chain_round_local(state: ClusterTensors, masks: ExclusionMasks,
     # shard); everything the joint-acceptance recheck needs travels with
     # the candidate card.
     local_sub = jax.tree.map(lambda a: a[red_idx], deltas)
-    pot_local = jnp.where(
-        local_sub.replica_delta > 0,
-        state.leader_load[local_sub.partition, int(Resource.NW_OUT)], 0.0)
-    lbi_local = jnp.where(
-        local_sub.leader_delta > 0,
-        state.leader_load[local_sub.partition, int(Resource.NW_IN)], 0.0)
+    pot_local, lbi_local = pot_lbi_deltas(state, local_sub)
 
     g_sub = jax.tree.map(gather, local_sub)
     g_sub = dataclasses.replace(g_sub, partition=gather(
@@ -221,22 +223,27 @@ def _chain_round_local(state: ClusterTensors, masks: ExclusionMasks,
     sel &= jnp.where(independent, True, within_cap)
 
     # ``sel`` is computed from gathered, replicated data — identical on
-    # every device, so its sum is already the global count.
+    # every device, so its sum is already the global count, and the
+    # aggregate-carry update below stays replicated device-for-device.
+    if agg is not None:
+        agg = apply_deltas_to_agg(agg, ranked, sel, g_pot[order],
+                                  g_lbi[order])
     new_state = apply_selected(state, sel, ranked.partition,
                                ranked.src_slot, ranked.dst_broker,
                                g_kind[order], g_dslot[order],
                                row_offset=offset)
-    return new_state, sel.sum()
+    return new_state, agg, sel.sum()
 
 
-def _chain_swap_local(state: ClusterTensors, masks: ExclusionMasks,
+def _chain_swap_local(state: ClusterTensors, agg, masks: ExclusionMasks,
                       active_idx: jax.Array, prior_mask: jax.Array, *,
                       goals, constraint: BalancingConstraint, num_topics: int,
                       num_shards: int, k_brokers: int = 8,
                       j_replicas: int = 4, moves: int = 8):
     """Chain-parameterized sharded swap round — the card-gather kernel of
     ``parallel.sharded._swap_round_local`` with the active goal as a traced
-    switch and prior acceptance as a traced mask."""
+    switch and prior acceptance as a traced mask. ``agg`` as in
+    ``_chain_round_local``; returns (new_state, new_agg, applied)."""
     shard = jax.lax.axis_index(PARTITION_AXIS)
     p_local = state.num_partitions
     p_global = p_local * num_shards
@@ -248,10 +255,11 @@ def _chain_swap_local(state: ClusterTensors, masks: ExclusionMasks,
     additive_f = jnp.asarray([g.partition_additive_scores for g in goals])
     derived = compute_derived(state, masks.excluded_topics,
                               masks.excluded_replica_move_brokers,
-                              masks.excluded_leadership_brokers, psum=_psum)
+                              masks.excluded_leadership_brokers, psum=_psum,
+                              agg=agg)
     aux_list, src_score, dst_score, weight = _chain_scores(
         state, derived, active_idx, prior_mask, goals, constraint,
-        num_topics, additive_f)
+        num_topics, additive_f, agg=agg)
 
     k = min(k_brokers, b)
     src_vals, src_brokers = jax.lax.top_k(
@@ -298,6 +306,11 @@ def _chain_swap_local(state: ClusterTensors, masks: ExclusionMasks,
                        state.follower_load[p2])
     gp1, gp2 = p1 + offset, p2 + offset
     top1 = state.topic[p1]
+    top2 = state.topic[p2]
+    nwout1 = state.leader_load[p1, int(Resource.NW_OUT)]
+    nwout2 = state.leader_load[p2, int(Resource.NW_OUT)]
+    nwin1 = state.leader_load[p1, int(Resource.NW_IN)]
+    nwin2 = state.leader_load[p2, int(Resource.NW_IN)]
 
     def gather_cards(x):
         y = jax.lax.all_gather(x, PARTITION_AXIS)
@@ -325,6 +338,11 @@ def _chain_swap_local(state: ClusterTensors, masks: ExclusionMasks,
     h_s = pick(gather_cards(s1), hsel)
     l_s = pick(gather_cards(s2), lsel)
     h_topic = pick(gather_cards(top1), hsel)
+    l_topic = pick(gather_cards(top2), lsel)
+    h_nwout = pick(gather_cards(nwout1), hsel)
+    l_nwout = pick(gather_cards(nwout2), lsel)
+    h_nwin = pick(gather_cards(nwin1), hsel)
+    l_nwin = pick(gather_cards(nwin2), lsel)
     h_legs = pick(gather_cards(leg_f), hsel)
     l_legs = pick(gather_cards(leg_r), lsel)
     h_w = hv
@@ -389,6 +407,27 @@ def _chain_swap_local(state: ClusterTensors, masks: ExclusionMasks,
     sel = ok & (first_part[t_gp1] == rank) & (first_part[t_gp2] == rank) \
         & (first_broker[t_src] == rank) & (first_broker[t_dst] == rank)
 
+    if agg is not None:
+        # Replicated leg updates (see _chain_round_local): both directional
+        # legs of each accepted swap scatter their exact effect.
+        ones = jnp.ones(k_m, dtype=jnp.int32)
+        h_lead_t = h_lead[si, ai][top_idx].astype(jnp.int32)
+        l_lead_t = l_lead[di, bi][top_idx].astype(jnp.int32)
+        fwd_leg = AggDelta(
+            src_broker=t_src, dst_broker=t_dst,
+            load_delta=h_load[si, ai][top_idx], replica_delta=ones,
+            leader_delta=h_lead_t, topic=h_topic[si, ai][top_idx])
+        rev_leg = AggDelta(
+            src_broker=t_dst, dst_broker=t_src,
+            load_delta=l_load[di, bi][top_idx], replica_delta=ones,
+            leader_delta=l_lead_t, topic=l_topic[di, bi][top_idx])
+        agg = apply_deltas_to_agg(
+            agg, fwd_leg, sel, h_nwout[si, ai][top_idx],
+            h_lead_t * h_nwin[si, ai][top_idx])
+        agg = apply_deltas_to_agg(
+            agg, rev_leg, sel, l_nwout[di, bi][top_idx],
+            l_lead_t * l_nwin[di, bi][top_idx])
+
     p_pad = jnp.int32(p_local)
     row1 = t_gp1 - offset
     row2 = t_gp2 - offset
@@ -399,7 +438,7 @@ def _chain_swap_local(state: ClusterTensors, masks: ExclusionMasks,
             t_dst.astype(state.assignment.dtype), mode="drop") \
         .at[rows2, l_s[di, bi][top_idx]].set(
             t_src.astype(state.assignment.dtype), mode="drop")
-    return dataclasses.replace(state, assignment=new_assignment), sel.sum()
+    return dataclasses.replace(state, assignment=new_assignment), agg, sel.sum()
 
 
 def _chain_stats_local(state: ClusterTensors, masks: ExclusionMasks,
@@ -460,38 +499,55 @@ def _chain_full_local(state: ClusterTensors, masks: ExclusionMasks, *,
             num_topics=num_topics)
 
         def run(s):
+            # Aggregate carry computed once per goal (psum'd -> global,
+            # replicated) and threaded through both phases; no in-loop
+            # refresh on the mesh (a cond-gated psum would be collective-
+            # unsafe) — counts stay exact, f32 drift is bounded by the
+            # pass length and reset at every stats recompute.
             def outer_cond(c):
-                _s, _m, _sw, rounds, last_swapped, first = c
+                _s, _a, _m, _sw, rounds, last_swapped, first = c
                 return (first | (last_swapped > 0)) & (rounds < cfg.max_rounds)
 
             def outer_body(c):
-                s, m_tot, sw_tot, rounds, _ls, _first = c
-                s, m, r = run_rounds_loop(
-                    lambda st: _chain_round_local(
-                        st, masks, g, prior, goals=goals,
+                s, a, m_tot, sw_tot, rounds, _ls, _first = c
+
+                def move_body(carry, _r):
+                    st, ag = carry
+                    ns, nag, applied = _chain_round_local(
+                        st, ag, masks, g, prior, goals=goals,
                         constraint=constraint, cfg=cfg,
-                        num_topics=num_topics, num_shards=num_shards),
-                    s, cfg.max_rounds)
+                        num_topics=num_topics, num_shards=num_shards)
+                    return (ns, nag), applied
 
-                def do_swap(st):
-                    return run_rounds_loop(
-                        lambda st2: _chain_swap_local(
-                            st2, masks, g, prior, goals=goals,
+                (s, a), m, r = run_carry_loop(move_body, (s, a),
+                                              cfg.max_rounds)
+
+                def do_swap(st_ag):
+                    def swap_body(carry, _r):
+                        st, ag = carry
+                        ns, nag, applied = _chain_swap_local(
+                            st, ag, masks, g, prior, goals=goals,
                             constraint=constraint, num_topics=num_topics,
-                            num_shards=num_shards, moves=swap_moves),
-                        st, swap_max_rounds)
+                            num_shards=num_shards, moves=swap_moves)
+                        return (ns, nag), applied
 
-                def no_swap(st):
-                    return st, jnp.int32(0), jnp.int32(0)
+                    (st, ag), sw, sr = run_carry_loop(swap_body, st_ag,
+                                                      swap_max_rounds)
+                    return st, ag, sw, sr
 
-                s, sw, sr = jax.lax.cond(supports_swap[g], do_swap, no_swap, s)
-                return (s, m_tot + m, sw_tot + sw, rounds + r + sr, sw,
+                def no_swap(st_ag):
+                    st, ag = st_ag
+                    return st, ag, jnp.int32(0), jnp.int32(0)
+
+                s, a, sw, sr = jax.lax.cond(supports_swap[g], do_swap,
+                                            no_swap, (s, a))
+                return (s, a, m_tot + m, sw_tot + sw, rounds + r + sr, sw,
                         jnp.bool_(False))
 
-            s, m, sw, rounds, _, _ = jax.lax.while_loop(
+            s, a, m, sw, rounds, _, _ = jax.lax.while_loop(
                 outer_cond, outer_body,
-                (s, jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
-                 jnp.bool_(True)))
+                (s, compute_agg(s, num_topics, psum=_psum), jnp.int32(0),
+                 jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.bool_(True)))
             return s, m, sw, rounds
 
         def skip(s):
@@ -579,20 +635,32 @@ def _make_chain_phase_kernels(mesh: Mesh, goals, constraint,
     rep = P()  # replicated scalars
 
     def move_body(state, masks, active_idx, prior_mask, budget):
-        return run_rounds_loop(
-            lambda st: _chain_round_local(
-                st, masks, active_idx, prior_mask, goals=goals,
+        def body(carry, _r):
+            st, ag = carry
+            ns, nag, applied = _chain_round_local(
+                st, ag, masks, active_idx, prior_mask, goals=goals,
                 constraint=constraint, cfg=cfg, num_topics=num_topics,
-                num_shards=shards),
-            state, cfg.max_rounds, budget=budget)
+                num_shards=shards)
+            return (ns, nag), applied
+
+        (st, _a), total, rounds = run_carry_loop(
+            body, (state, compute_agg(state, num_topics, psum=_psum)),
+            cfg.max_rounds, budget=budget)
+        return st, total, rounds
 
     def swap_body(state, masks, active_idx, prior_mask, budget):
-        return run_rounds_loop(
-            lambda st: _chain_swap_local(
-                st, masks, active_idx, prior_mask, goals=goals,
+        def body(carry, _r):
+            st, ag = carry
+            ns, nag, applied = _chain_swap_local(
+                st, ag, masks, active_idx, prior_mask, goals=goals,
                 constraint=constraint, num_topics=num_topics,
-                num_shards=shards, moves=swap_moves),
-            state, swap_max_rounds, budget=budget)
+                num_shards=shards, moves=swap_moves)
+            return (ns, nag), applied
+
+        (st, _a), total, rounds = run_carry_loop(
+            body, (state, compute_agg(state, num_topics, psum=_psum)),
+            swap_max_rounds, budget=budget)
+        return st, total, rounds
 
     def stats_body(state, masks, active_idx):
         return _chain_stats_local(state, masks, active_idx, goals=goals,

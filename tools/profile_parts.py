@@ -1,0 +1,160 @@
+"""Per-round cost attribution: time each stage of the chain round body.
+
+Times jitted sub-stages of ``_chain_round_body`` separately (derived state,
+per-goal aux, scores, candidate generation, deltas + acceptance stack,
+selection, apply) and the fused whole for comparison — the gap between the
+sum of parts and the fused round is what XLA fusion buys.
+
+    JAX_PLATFORMS=cpu python tools/profile_parts.py [brokers] [partitions] [active_goal_idx]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/cc_tpu_jax_cache")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench(fn, *args, n=20, **kw):
+    import jax
+    out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    t0 = time.monotonic()
+    for _ in range(n):
+        out = fn(*args, **kw)
+    jax.block_until_ready(out)
+    return (time.monotonic() - t0) / n, out
+
+
+def main() -> int:
+    num_brokers = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    num_partitions = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
+    active_idx = int(sys.argv[3]) if len(sys.argv) > 3 else 12
+    import jax
+    import jax.numpy as jnp
+
+    from cruise_control_tpu import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
+    from cruise_control_tpu.analyzer.candidates import (
+        compute_deltas, generate_candidates,
+    )
+    from cruise_control_tpu.analyzer.derived import compute_derived
+    from cruise_control_tpu.analyzer.optimizer import (
+        GoalOptimizer, goals_by_priority,
+    )
+    from cruise_control_tpu.analyzer.search import (
+        ExclusionMasks, cumulative_select, goal_aux,
+    )
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.model.fixtures import Dist, random_cluster
+
+    print(f"platform: {jax.devices()[0].platform}", flush=True)
+    state, meta = random_cluster(
+        num_brokers=num_brokers, num_topics=max(8, num_brokers // 10),
+        num_partitions=num_partitions, rf=3, num_racks=8,
+        dist=Dist.EXPONENTIAL, seed=42, skew_to_first=2.0,
+        target_utilization=0.55)
+    state = jax.device_put(state)
+    jax.block_until_ready(state.assignment)
+
+    cfg = CruiseControlConfig()
+    optimizer = GoalOptimizer(cfg)
+    scfg = optimizer.search_config(state)
+    goals = tuple(goals_by_priority(cfg))
+    masks = ExclusionMasks()
+    constraint = optimizer.constraint
+    nt = meta.num_topics
+    print(f"grid: sources={scfg.num_sources} dests={scfg.num_dests} "
+          f"moves={scfg.moves_per_round} active={goals[active_idx].name}")
+
+    t, derived = bench(jax.jit(lambda s: compute_derived(s)), state)
+    print(f"{'compute_derived':44s} {t * 1e3:8.2f} ms")
+
+    aux_t = {}
+    for i, g in enumerate(goals):
+        fn = jax.jit(lambda s, d, g=g: goal_aux(g, s, d, constraint, nt))
+        t, _ = bench(fn, state, derived)
+        aux_t[g.name] = t
+    total_aux = sum(aux_t.values())
+    for name, t in sorted(aux_t.items(), key=lambda kv: -kv[1])[:5]:
+        print(f"  aux {name:40s} {t * 1e3:8.2f} ms")
+    print(f"{'aux total (all 15)':44s} {total_aux * 1e3:8.2f} ms")
+
+    g = goals[active_idx]
+
+    @jax.jit
+    def scores(s, d):
+        a = goal_aux(g, s, d, constraint, nt)
+        return (g.source_score(s, d, constraint, a),
+                g.dest_score(s, d, constraint, a),
+                g.replica_weight(s, d, constraint, a))
+
+    t, (src, dst, w) = bench(scores, state, derived)
+    print(f"{'active scores (incl aux)':44s} {t * 1e3:8.2f} ms")
+
+    gen = jax.jit(lambda s, d, a, b, c: generate_candidates(
+        s, d, a, b, c, scfg.num_sources, scfg.num_dests, True, False)[0])
+    t, cand = bench(gen, state, derived, src, dst, w)
+    # Static grid layout (generate_candidates returns it as python ints,
+    # which a jitted return would trace).
+    s_dim = state.max_replication_factor
+    n_flat = state.num_partitions * s_dim
+    layout = ((min(scfg.num_sources, n_flat), min(scfg.num_dests, num_brokers)),
+              (min(scfg.num_sources, n_flat), s_dim))
+    print(f"{'generate_candidates':44s} {t * 1e3:8.2f} ms")
+
+    t, deltas = bench(jax.jit(compute_deltas), state, derived, cand)
+    print(f"{'compute_deltas':44s} {t * 1e3:8.2f} ms")
+
+    @jax.jit
+    def acceptance_stack(s, d, dl):
+        acc = dl.valid
+        for gg in goals[:active_idx]:
+            a = goal_aux(gg, s, d, constraint, nt)
+            acc &= gg.acceptance(s, d, constraint, a, dl)
+        return acc
+
+    t, accept = bench(acceptance_stack, state, derived, deltas)
+    print(f"{'acceptance stack (prior aux+accept)':44s} {t * 1e3:8.2f} ms")
+
+    @jax.jit
+    def select(s, d, dl, acc):
+        a = goal_aux(g, s, d, constraint, nt)
+        imp = g.improvement(s, d, constraint, a, dl)
+        score = jnp.where(acc, imp, -jnp.inf)
+        m = max(scfg.moves_per_round, scfg.num_sources)
+
+        def recheck(sub, has_earlier):
+            out = jnp.ones(sub.valid.shape[0], dtype=bool)
+            for gg in goals[:active_idx]:
+                aa = goal_aux(gg, s, d, constraint, nt)
+                out &= gg.acceptance(s, d, constraint, aa, sub)
+            return out
+
+        return cumulative_select(s, dl, score, layout, m,
+                                 scfg.moves_per_round, False, recheck)
+
+    t, _ = bench(select, state, derived, deltas, accept)
+    print(f"{'improvement + cumulative_select':44s} {t * 1e3:8.2f} ms")
+
+    # Fused single round for comparison (budget=1).
+    from cruise_control_tpu.analyzer.chain import chain_optimize_rounds
+    prior = jnp.asarray([j < active_idx for j in range(len(goals))])
+
+    def one_round(s):
+        st, mv, r = chain_optimize_rounds(
+            s, jnp.int32(active_idx), prior, goals, constraint, scfg, nt,
+            masks, budget=jnp.int32(1))
+        return st.assignment
+    t, _ = bench(one_round, state, n=10)
+    print(f"{'FUSED full round (chain kernel, budget=1)':44s} {t * 1e3:8.2f} ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
