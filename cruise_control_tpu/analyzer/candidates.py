@@ -140,8 +140,19 @@ def compute_deltas(state: ClusterTensors, derived: DerivedState,
     # Destination must not already host the partition (moves only);
     # comparing against all S slots of the partition.
     already_hosts = (assign_p == dst_broker[:, None]).any(axis=1)
+    # Moving a LEADER replica transfers leadership with it, so destinations
+    # excluded for leadership are ineligible for leader-replica moves
+    # (GoalUtils.filterOutBrokersExcludedForLeadership:120-137: excluded
+    # brokers are removed when action is LEADERSHIP_MOVEMENT or
+    # replica.isLeader()). Offline replicas are exempt — self-healing
+    # placement must proceed even onto leadership-excluded brokers
+    # (eligibleReplicasForSwap's !isOriginalOffline carve-out).
+    src_safe = jnp.clip(src_broker, 0, b - 1)
+    src_offline = ~derived.alive[src_safe]
+    lead_dst_ok = (~moving_is_leader) | src_offline \
+        | derived.allowed_leadership[dst_safe]
     move_ok = (~already_hosts) & derived.allowed_replica_move[dst_safe] \
-        & (src_broker != dst_broker)
+        & (src_broker != dst_broker) & lead_dst_ok
     # Leadership: destination slot must hold a live replica on an
     # allowed-for-leadership broker, and differ from the current leader.
     dst_slot_live = jnp.take_along_axis(

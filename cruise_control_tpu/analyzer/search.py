@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 from ..common.resources import Resource
 from ..model.tensors import ClusterTensors, offline_replicas
+from .agg import pot_lbi_deltas
 from .candidates import (
     KIND_MOVE, attach_cumulative, compute_deltas, generate_candidates,
 )
@@ -183,7 +184,6 @@ def cumulative_select(state: ClusterTensors, deltas, score: jax.Array,
     Returns (top_idx into the full grid, sel mask, selected sub-batch,
     pot_delta, lbi_delta) — the latter three so aggregate-carrying drivers
     can scatter the batch's effect without re-deriving it."""
-    from .agg import pot_lbi_deltas
     red_idx = reduce_per_source(score, layout)
     red_score = score[red_idx]
     k = min(m, red_score.shape[0])
@@ -416,6 +416,11 @@ def swap_grid(state: ClusterTensors, derived: DerivedState,
     # Load vectors travel with the replicas (leadership keeps its replica).
     lead1 = (state.leader_slot[p1] == s1)
     lead2 = (state.leader_slot[p2] == s2)
+    # A leader leg may not land on a leadership-excluded broker
+    # (GoalUtils.eligibleReplicasForSwap:266 — swap sources are never
+    # offline, so no self-healing carve-out is needed here).
+    base_valid &= (~lead1) | derived.allowed_leadership[dst_b]
+    base_valid &= (~lead2) | derived.allowed_leadership[src_b]
     load_a = jnp.where(lead1[:, None], state.leader_load[p1],
                        state.follower_load[p1])
     load_b = jnp.where(lead2[:, None], state.leader_load[p2],

@@ -198,14 +198,12 @@ def leader_bytes_in(state: ClusterTensors) -> jax.Array:
     """[B] float32 — leader NW_IN per broker (the LeaderBytesInDistribution
     aggregate; also maintained incrementally by analyzer.agg)."""
     from ..common.resources import Resource
-    b = state.num_brokers
-    lead = is_leader_slot(state)
-    seg = jnp.where(lead, jnp.clip(state.assignment, 0, b - 1), b).reshape(-1)
-    nw_in = jnp.broadcast_to(
-        state.leader_load[:, int(Resource.NW_IN)][:, None],
-        lead.shape).reshape(-1)
-    return jax.ops.segment_sum(jnp.where(seg < b, nw_in, 0.0), seg,
-                               num_segments=b + 1)[:b]
+    per_slot = jnp.where(
+        is_leader_slot(state),
+        jnp.broadcast_to(state.leader_load[:, int(Resource.NW_IN)][:, None],
+                         state.assignment.shape),
+        0.0)
+    return _scatter_to_brokers(state, per_slot)
 
 
 def rack_partition_counts(state: ClusterTensors, num_racks: int) -> jax.Array:

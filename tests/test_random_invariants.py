@@ -112,6 +112,54 @@ def test_random_new_broker_gating():
     assert set(gained.tolist()) <= set(new), gained
 
 
+def test_random_excluded_brokers_for_replica_move_gain_nothing():
+    """ExcludedBrokersForReplicaMoveTest: brokers excluded for replica
+    moves never GAIN a replica during a full chain run (they may shed —
+    requireLessLoad includes excluded brokers,
+    ResourceDistributionGoal.java:387)."""
+    state, meta = _cluster(Dist.EXPONENTIAL, seed=3)
+    excluded = [2, 9]
+    excluded_ids = tuple(meta.broker_ids[b] for b in excluded)
+    before = np.asarray(state.assignment).copy()
+    opt = GoalOptimizer(CFG)
+    final, _res = opt.optimizations(
+        state, meta, goals=goals_by_priority(CFG, CHAIN),
+        options=OptimizationOptions(
+            excluded_brokers_for_replica_move=excluded_ids))
+    after = np.asarray(final.assignment)
+    for b in excluded:
+        hosted_before = set(map(tuple, np.argwhere(before == b)))
+        hosted_after = set(map(tuple, np.argwhere(after == b)))
+        gained = {p for p, _s in hosted_after} - {p for p, _s in hosted_before}
+        assert not gained, f"excluded broker {b} gained partitions {gained}"
+    _assert_consistent(final, meta)
+
+
+def test_random_excluded_brokers_for_leadership_gain_no_leaders():
+    """ExcludedBrokersForLeadershipTest: brokers excluded for leadership
+    never end up leading a partition they did not already lead."""
+    state, meta = _cluster(Dist.LINEAR, seed=9)
+    excluded = [0, 5]
+    excluded_ids = tuple(meta.broker_ids[b] for b in excluded)
+    a0 = np.asarray(state.assignment)
+    l0 = np.asarray(state.leader_slot)
+    leaders_before = {p: a0[p, l0[p]] for p in range(a0.shape[0])}
+    opt = GoalOptimizer(CFG)
+    final, _res = opt.optimizations(
+        state, meta, goals=goals_by_priority(CFG, CHAIN),
+        options=OptimizationOptions(
+            excluded_brokers_for_leadership=excluded_ids))
+    a1 = np.asarray(final.assignment)
+    l1 = np.asarray(final.leader_slot)
+    mask = np.asarray(final.partition_mask)
+    for p in np.nonzero(mask)[0]:
+        leader = a1[p, l1[p]]
+        if leader in excluded:
+            assert leaders_before[p] == leader, \
+                f"excluded broker {leader} GAINED leadership of {p}"
+    _assert_consistent(final, meta)
+
+
 def test_random_excluded_topics_never_move():
     """ExcludedTopicsTest: replicas of excluded topics keep their exact
     placement through a full chain run."""
