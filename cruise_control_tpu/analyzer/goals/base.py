@@ -18,7 +18,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from ...model.tensors import ClusterTensors, replica_load
+from ...model.tensors import ClusterTensors, replica_load_total
 from ..candidates import CandidateDeltas
 from ..constraint import BalancingConstraint
 from ..derived import DerivedState
@@ -174,7 +174,7 @@ class Goal:
 
     def replica_weight(self, state, derived, constraint, aux) -> jax.Array:
         """[P, S] — which replicas to move first (SortedReplicas analogue)."""
-        return replica_load(state).sum(axis=-1)
+        return replica_load_total(state)
 
 
 def pair_improvement(values: jax.Array, deltas: CandidateDeltas,

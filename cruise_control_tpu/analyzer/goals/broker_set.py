@@ -21,7 +21,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-from ...model.tensors import replica_exists, replica_load
+from ...model.tensors import replica_exists, replica_load_total
 from ..candidates import CandidateDeltas
 from .base import Goal
 
@@ -123,4 +123,4 @@ class BrokerSetAwareGoal(Goal):
 
     def replica_weight(self, state, derived, constraint, aux):
         mis = self._misplaced(state, aux)
-        return jnp.where(mis, 1.0 + replica_load(state).sum(axis=-1), -jnp.inf)
+        return jnp.where(mis, 1.0 + replica_load_total(state), -jnp.inf)

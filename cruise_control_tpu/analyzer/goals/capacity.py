@@ -12,7 +12,7 @@ import dataclasses
 import jax.numpy as jnp
 
 from ...common.resources import Resource
-from ...model.tensors import replica_load
+from ...model.tensors import replica_load_column, replica_load_total
 from ..candidates import CandidateDeltas
 from .base import Goal, pair_improvement
 
@@ -59,7 +59,7 @@ class ResourceCapacityGoal(Goal):
         return jnp.where(derived.allowed_replica_move, headroom, -jnp.inf)
 
     def replica_weight(self, state, derived, constraint, aux):
-        return replica_load(state)[:, :, int(self.resource)]
+        return replica_load_column(state, int(self.resource))
 
     def swap_leg_acceptance(self, state, derived, constraint, aux, leg):
         # Judged on the net transfer only — a leg-wise capacity check would
@@ -108,7 +108,7 @@ class ReplicaCapacityGoal(Goal):
 
     def replica_weight(self, state, derived, constraint, aux):
         # Any replica works; prefer light ones to minimize load disturbance.
-        return -replica_load(state).sum(axis=-1)
+        return -replica_load_total(state)
 
     def swap_leg_acceptance(self, state, derived, constraint, aux, leg):
         # Swaps never change per-broker replica counts: always acceptable.

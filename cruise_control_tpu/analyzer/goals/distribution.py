@@ -17,7 +17,8 @@ import jax.numpy as jnp
 
 from ...common.resources import Resource
 from ...model.tensors import (
-    is_leader_slot, replica_exists, replica_load, topic_broker_leader_counts,
+    is_leader_slot, replica_exists, replica_load_column, replica_load_total,
+    topic_broker_leader_counts,
     topic_broker_replica_counts,
 )
 from ..candidates import CandidateDeltas
@@ -139,7 +140,7 @@ class ResourceDistributionGoal(Goal):
         return jnp.where(eligible & (headroom > 0), headroom + under_bonus, -jnp.inf)
 
     def replica_weight(self, state, derived, constraint, aux):
-        return replica_load(state)[:, :, int(self.resource)]
+        return replica_load_column(state, int(self.resource))
 
     def swap_leg_acceptance(self, state, derived, constraint, aux, leg):
         # Judged on the net transfer only (leg-wise band checks would veto
@@ -237,7 +238,7 @@ class CountDistributionGoal(Goal):
         return jnp.where(eligible & (headroom > 0), headroom + under_bonus, -jnp.inf)
 
     def replica_weight(self, state, derived, constraint, aux):
-        w = -replica_load(state).sum(axis=-1)  # light replicas first
+        w = -replica_load_total(state)  # light replicas first
         if self.leaders:
             return jnp.where(is_leader_slot(state), w, -jnp.inf)
         return w

@@ -78,10 +78,12 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
         # Unlike the pure rack goal (which only moves duplicated replicas),
         # the count ceiling needs ordinary replicas movable too: prioritize
         # rack-duplicates, then lighter replicas (cheaper to relocate).
-        from ...model.tensors import replica_exists, replica_load
+        from ...model.tensors import (
+            replica_exists, replica_load_column, replica_load_total,
+        )
         from .rack import _duplicate_mask
         dup = _duplicate_mask(state)
-        load = replica_load(state).sum(axis=-1)
+        load = replica_load_total(state)
         peak = load.max() + 1.0
         return jnp.where(dup, peak + load,
                          jnp.where(replica_exists(state), peak - load, -jnp.inf))
@@ -149,5 +151,5 @@ class KafkaAssignerDiskUsageDistributionGoal(Goal):
                          -jnp.inf)
 
     def replica_weight(self, state, derived, constraint, aux):
-        from ...model.tensors import replica_load
-        return replica_load(state)[:, :, Resource.DISK]
+        from ...model.tensors import replica_load_column
+        return replica_load_column(state, int(Resource.DISK))

@@ -17,7 +17,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-from ...model.tensors import replica_exists, replica_load
+from ...model.tensors import replica_exists, replica_load_total
 from ..candidates import CandidateDeltas
 from .base import Goal
 
@@ -98,7 +98,7 @@ class RackAwareGoal(Goal):
 
     def replica_weight(self, state, derived, constraint, aux):
         dup = _duplicate_mask(state)
-        return jnp.where(dup, 1.0 + replica_load(state).sum(axis=-1), -jnp.inf)
+        return jnp.where(dup, 1.0 + replica_load_total(state), -jnp.inf)
 
     def source_score(self, state, derived, constraint, aux):
         # Sources = brokers hosting duplicated replicas.

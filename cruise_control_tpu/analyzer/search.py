@@ -74,16 +74,11 @@ class ExclusionMasks:
 
 
 def goal_aux(goal: Goal, state: ClusterTensors, derived: DerivedState,
-             constraint: BalancingConstraint, num_topics: int, psum=None,
-             agg=None):
+             constraint: BalancingConstraint, num_topics: int, psum=None):
     """Per-goal aux tensors; the partition-additive partial is psum'd when a
-    mesh hook is given (Goal.prepare_partial/finalize_aux contract). With an
-    ``agg`` carry, agg-backed goals read their partial from it instead of
-    an O(P·S) recompute (already global: no psum)."""
-    if agg is not None:
-        partial_aux = goal.partial_from_agg(agg)
-        if partial_aux is not None:
-            return goal.finalize_aux(partial_aux, state, derived, constraint)
+    mesh hook is given (Goal.prepare_partial/finalize_aux contract). The
+    agg-carry read path lives in chain._gated_aux — the per-goal kernels
+    here stay recompute-only as the equivalence oracle."""
     partial_aux = goal.prepare_partial(state, num_topics)
     if partial_aux is not None and psum is not None:
         partial_aux = jax.tree.map(psum, partial_aux)

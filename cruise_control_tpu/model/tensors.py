@@ -132,6 +132,28 @@ def replica_load(state: ClusterTensors) -> jax.Array:
     return load * replica_exists(state)[:, :, None]
 
 
+def replica_load_total(state: ClusterTensors) -> jax.Array:
+    """[P, S] float32 — summed-over-resources load per replica slot.
+    Equivalent to ``replica_load(state).sum(axis=-1)`` without
+    materializing the [P, S, R] cube: the per-partition leader/follower
+    totals are loop-invariant [P] reductions (XLA hoists them out of the
+    search while-loop), leaving only a [P, S] select per round."""
+    lsum = state.leader_load.sum(axis=-1)
+    fsum = state.follower_load.sum(axis=-1)
+    lead = is_leader_slot(state)
+    return jnp.where(lead, lsum[:, None], fsum[:, None]) \
+        * replica_exists(state)
+
+
+def replica_load_column(state: ClusterTensors, r: int) -> jax.Array:
+    """[P, S] float32 — one resource column of the per-replica load,
+    without the [P, S, R] materialization (see replica_load_total)."""
+    lead = is_leader_slot(state)
+    return jnp.where(lead, state.leader_load[:, r][:, None],
+                     state.follower_load[:, r][:, None]) \
+        * replica_exists(state)
+
+
 def _scatter_to_brokers(state: ClusterTensors, per_slot: jax.Array) -> jax.Array:
     """Sum a [P, S] or [P, S, R] per-replica quantity into per-broker rows
     ([B] or [B, R]). Padded slots route to a dead bucket at index B."""

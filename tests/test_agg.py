@@ -66,12 +66,15 @@ def test_carry_tracks_moves_and_leadership(setup):
     agg = compute_agg(state, meta.num_topics)
     # Mid-chain resource goal first (moves), then the leadership-only tail
     # goal (leadership movements), with all prior goals' acceptance stacked.
+    total = 0
     for active, rounds in ((8, 6), (14, 4)):
         prior = jnp.asarray([j < active for j in range(len(goals))])
         for _ in range(rounds):
             state, agg, applied = _chain_round_body(
                 state, agg, jnp.int32(active), prior, goals, constraint,
                 cfg, meta.num_topics, masks)
+            total += int(applied)
+    assert total > 0, "fixture applied no moves: carry never exercised"
     _check_against_recompute(agg, state, meta.num_topics)
 
 
@@ -89,6 +92,7 @@ def test_carry_tracks_swaps(setup):
             state, agg, jnp.int32(active), prior, goals, constraint,
             meta.num_topics, masks)
         total += int(applied)
+    assert total > 0, "fixture applied no swaps: swap-leg carry not exercised"
     _check_against_recompute(agg, state, meta.num_topics)
 
 
