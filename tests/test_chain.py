@@ -371,8 +371,11 @@ def test_wide_batch_config_derivation():
                         max_rounds=2000)
     chain = [RackAwareGoal(), TopicReplicaDistributionGoal()]
     wide = opt._wide_config(base, chain, num_brokers=1000)
-    assert wide.num_sources == 1024 and wide.moves_per_round == 1000
+    # r4: wide sources = min(2048, base x multiplier(8), B) — width beyond
+    # ~B only inflates per-round cost (measured, optimizer._widen).
+    assert wide.num_sources == 1000 and wide.moves_per_round == 1000
     assert wide.num_dests == base.num_dests
+    assert opt._wide_config(base, chain, num_brokers=7000).num_sources == 2048
     # Below the regime threshold / no wide goal in the chain -> None.
     assert opt._wide_config(base, chain, num_brokers=100) is None
     assert opt._wide_config(base, [CpuCapacityGoal()], 1000) is None
