@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from ...common.resources import Resource
 from ..candidates import CandidateDeltas
 from .base import Goal, pair_improvement
-from .rack import RackAwareGoal
+from .rack import RackAwareGoal, _duplicate_mask
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,10 +43,21 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
     def acceptance(self, state, derived, constraint, aux, deltas: CandidateDeltas):
         rack_ok = super().acceptance(state, derived, constraint, aux, deltas)
         cap = self._ceiling(derived)
-        under_cap = derived.broker_replicas[deltas.dst_broker] \
-            + deltas.pre0("pre_dst_count") + 1 <= cap
+        dst_after = derived.broker_replicas[deltas.dst_broker] \
+            + deltas.pre0("pre_dst_count") + 1
+        under_cap = dst_after <= cap
+        # Deadlock breaker: a RACK-duplicate-fixing move may overshoot the
+        # even ceiling by ONE. On skewed clusters every under-ceiling
+        # broker in a partition's free rack can sit exactly at the
+        # ceiling, and a pure greedy stalls where the reference's
+        # swap-based inner loop (KafkaAssignerEvenRackAwareGoal.java's
+        # per-position swaps) proceeds; the overshoot converts the rack
+        # violation into a count violation that later rounds shed
+        # (improvement weights rack 2x count, so both steps score > 0).
+        fixes_dup = _duplicate_mask(state)[deltas.partition, deltas.src_slot]
+        tolerant = fixes_dup & (dst_after <= cap + 1)
         is_move = deltas.replica_delta > 0
-        return rack_ok & jnp.where(is_move, under_cap, True)
+        return rack_ok & jnp.where(is_move, under_cap | tolerant, True)
 
     def improvement(self, state, derived, constraint, aux, deltas):
         rack_imp = super().improvement(state, derived, constraint, aux, deltas)
@@ -55,7 +66,10 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
         count_imp = pair_improvement(
             counts, deltas, deltas.replica_delta.astype(jnp.float32),
             lambda v, _b: jnp.maximum(v - cap, 0.0))
-        return jnp.where(deltas.valid, rack_imp + count_imp, -jnp.inf)
+        # Rack fixes outweigh the count violation they may create (the
+        # two-step deadlock-breaking path above must score positive at
+        # both steps; terminates because 2*rack + count strictly falls).
+        return jnp.where(deltas.valid, 2.0 * rack_imp + count_imp, -jnp.inf)
 
     def source_score(self, state, derived, constraint, aux):
         return self.broker_violations(state, derived, constraint, aux)
@@ -63,7 +77,10 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
     def dest_score(self, state, derived, constraint, aux):
         cap = self._ceiling(derived)
         room = (cap - derived.broker_replicas).astype(jnp.float32)
-        return jnp.where(derived.allowed_replica_move & (room > 0), room,
+        # room >= 0 (not > 0): AT-CAP brokers must stay in the candidate
+        # grid — the duplicate-fixing overshoot path in ``acceptance`` is
+        # unreachable if dest_score filters them to -inf before scoring.
+        return jnp.where(derived.allowed_replica_move & (room >= 0), room,
                          -jnp.inf)
 
     def swap_leg_acceptance(self, state, derived, constraint, aux, leg):
@@ -78,10 +95,7 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
         # Unlike the pure rack goal (which only moves duplicated replicas),
         # the count ceiling needs ordinary replicas movable too: prioritize
         # rack-duplicates, then lighter replicas (cheaper to relocate).
-        from ...model.tensors import (
-            replica_exists, replica_load_column, replica_load_total,
-        )
-        from .rack import _duplicate_mask
+        from ...model.tensors import replica_exists, replica_load_total
         dup = _duplicate_mask(state)
         load = replica_load_total(state)
         peak = load.max() + 1.0

@@ -238,6 +238,35 @@ def test_kafka_assigner_even_rack_aware_goal():
     assert reps.max() <= int(np.ceil(total / 6)) + 1, reps
 
 
+def test_kafka_assigner_even_rack_deadlock_fixture():
+    """Regression: on a skewed fixture where every under-ceiling broker in
+    a partition's free rack sits at the even ceiling, a pure greedy stalls
+    (hard-goal failure). Duplicate-fixing moves may overshoot the ceiling
+    by one (then shed), matching the reference's swap-based inner loop's
+    reachability (analyzer/kafkaassigner/KafkaAssignerEvenRackAwareGoal
+    .java)."""
+    from cruise_control_tpu.analyzer.optimizer import (
+        GoalOptimizer, goals_by_priority,
+    )
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    cfg = CruiseControlConfig()
+    state, meta = fixtures.random_cluster(
+        num_brokers=24, num_topics=8, num_partitions=768, rf=3, num_racks=4,
+        dist=fixtures.Dist.EXPONENTIAL, seed=11, target_utilization=0.55)
+    opt = GoalOptimizer(cfg)
+    final, res = opt.optimizations(state, meta, goals=goals_by_priority(
+        cfg, ["KafkaAssignerEvenRackAwareGoal",
+              "KafkaAssignerDiskUsageDistributionGoal"]))
+    assert res.violated_goals_after == []
+    counts = np.asarray(rack_partition_counts(final, len(meta.rack_names)))
+    live = np.asarray(final.partition_mask)
+    assert (counts[live] <= 1).all(), "rack-awareness must hold"
+    reps = np.asarray(broker_replica_counts(final))[:24]
+    assert reps.max() <= int(np.ceil(reps.sum() / 24)), reps
+
+
 def test_kafka_assigner_disk_goal_balances_disk():
     from cruise_control_tpu.analyzer.goals import (
         KafkaAssignerDiskUsageDistributionGoal,
