@@ -24,6 +24,23 @@ Failure modes are first-class (VERDICT round 1):
   whatever is stuck; every completed stage has already been printed.
 - A bootstrap line is printed as soon as the device resolves, so even a
   timeout leaves a parseable tail.
+
+Output hygiene (VERDICT round 4 — the round-4 artifact recorded NOTHING
+because XLA:CPU ``cpu_aot_loader`` machine-feature-mismatch errors, one
+per persisted kernel, flooded the captured tail and displaced every
+metric line):
+- fd 2 is redirected at the OS level to BENCH_STDERR_FILE (default
+  /tmp/cc_bench_stderr.log) before jax loads, so native XLA/absl spam can
+  never share the captured stream with the metric lines (set
+  BENCH_KEEP_STDERR=1 to disable when debugging interactively).
+- The persistent compile cache is partitioned per host fingerprint
+  (``cruise_control_tpu.enable_persistent_compile_cache``), so AOT
+  artifacts from a different machine are invisible instead of loudly
+  rejected.
+- Every emitted line is journaled in-process; after the run — including
+  the hard-exit watchdog path — every completed stage line is RE-emitted
+  followed by one ``bench_summary`` JSON line, so any tail window of
+  stdout contains the full story.
 """
 
 from __future__ import annotations
@@ -37,6 +54,22 @@ import time
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/cc_tpu_jax_cache")
 
+if not os.environ.get("BENCH_KEEP_STDERR"):
+    # OS-level redirect (not sys.stderr): XLA / absl / TSL log from C++
+    # directly to fd 2, bypassing Python objects entirely.
+    _stderr_path = os.environ.get("BENCH_STDERR_FILE",
+                                  "/tmp/cc_bench_stderr.log")
+    try:
+        _stderr_fd = os.open(_stderr_path,
+                             os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        os.dup2(_stderr_fd, 2)
+        os.close(_stderr_fd)
+        sys.stderr = os.fdopen(2, "w", buffering=1)
+    except OSError:
+        _stderr_path = "(redirect failed; stderr left on tty)"
+else:
+    _stderr_path = "(kept on tty: BENCH_KEEP_STDERR)"
+
 # (num_brokers, num_partitions, drain) smallest-first; BASELINE.md configs
 # #2/#3/#4 — drain N means N brokers are marked DEAD (RemoveBrokers path:
 # every hosted replica becomes offline and must be re-placed under capacity
@@ -44,11 +77,49 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/cc_tpu_jax_cache")
 STAGES = [(16, 512, 0), (50, 2_000, 0), (100, 10_000, 0), (1_000, 100_000, 0),
           (1_000, 100_000, 50), (7_000, 1_000_000, 0)]
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
-BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "840"))
+# Default budget sized so the 7,000-broker headline stage FITS after the
+# earlier stages (~500-650 s steady on host CPU, plus compiles on a cold
+# cache): the r3/r4 artifacts both lost the headline to an 840 s default /
+# externally-imposed watchdog. Per-stage emission + the exit re-emission
+# tail mean a late watchdog only ever costs the unfinished stage.
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "3600"))
+
+
+# Journal of every emitted line, re-printed at exit (even via the watchdog
+# hard-exit) so the final stdout tail always contains every completed stage.
+_EMITTED: list[dict] = []
 
 
 def _emit(obj) -> None:
+    _EMITTED.append(obj)
     print(json.dumps(obj), flush=True)
+
+
+def _emit_summary_tail() -> None:
+    """Re-emit every completed stage line + one summary line, LAST on
+    stdout. Idempotent and exception-free: it runs inside the watchdog
+    hard-exit path."""
+    try:
+        stages = [o for o in _EMITTED
+                  if str(o.get("metric", "")).startswith(
+                      "rebalance_proposal_wall_clock")]
+        for o in stages:
+            print(json.dumps(o), flush=True)
+        headline = stages[-1] if stages else None
+        print(json.dumps({
+            "metric": "bench_summary",
+            "value": headline["value"] if headline else 0.0,
+            "unit": "s",
+            "vs_baseline": headline["vs_baseline"] if headline else 0.0,
+            "extras": {
+                "headline_metric": headline["metric"] if headline else None,
+                "stages_completed": [o["metric"] for o in stages],
+                "device": (headline or {}).get("extras", {}).get("device"),
+                "stderr_file": _stderr_path,
+            },
+        }), flush=True)
+    except Exception:  # pragma: no cover — never let the tail re-emit
+        pass            # throw away the primary emission path's output.
 
 
 def _probe_device_once(timeout_s: float) -> str | None:
@@ -194,7 +265,12 @@ def main() -> int:
     # never runs — the daemon timer backstop hard-exits (results so far
     # are already printed and flushed line-by-line).
     import threading
-    backstop = threading.Timer(BUDGET_S + 30.0, lambda: os._exit(0))
+
+    def _hard_exit():
+        _emit_summary_tail()
+        os._exit(0)
+
+    backstop = threading.Timer(BUDGET_S + 30.0, _hard_exit)
     backstop.daemon = True
     backstop.start()
     signal.signal(signal.SIGALRM, _alarm)
@@ -206,6 +282,7 @@ def main() -> int:
     finally:
         signal.alarm(0)
         backstop.cancel()
+        _emit_summary_tail()
 
 
 def _guarded_main(deadline: float) -> int:
@@ -223,13 +300,15 @@ def _guarded_main(deadline: float) -> int:
     import jax
 
     from cruise_control_tpu import enable_persistent_compile_cache
-    enable_persistent_compile_cache()
+    cache_dir = enable_persistent_compile_cache()
     if platform is None:
         jax.config.update("jax_platforms", "cpu")
     n_dev = jax.device_count()
     _emit({"metric": "bench_bootstrap", "value": round(time.time() - t0, 3),
            "unit": "s", "vs_baseline": 1.0,
-           "extras": {"device": device, "num_devices": n_dev}})
+           "extras": {"device": device, "num_devices": n_dev,
+                      "compile_cache_dir": cache_dir,
+                      "stderr_file": _stderr_path}})
 
     stages = STAGES[:2] if os.environ.get("BENCH_SCALE") == "small" else STAGES
     prev_total = 0.0

@@ -28,13 +28,54 @@ def enable_persistent_compile_cache(cache_dir: str | None = None) -> str:
     was cold-compiling the full solver chain on every process start
     (~19 min at 7k brokers). Calling this before the first compilation
     makes restarts hit the on-disk cache. Idempotent; safe after jax
-    import, must run before the first jit execution to help it."""
+    import, must run before the first jit execution to help it.
+
+    The cache is partitioned per host fingerprint (CPU feature flags +
+    jaxlib version + requested platform set): XLA:CPU persists AOT
+    artifacts compiled against the *builder's* CPU features, and loading
+    them on a host with different features emits one ``cpu_aot_loader``
+    machine-feature-mismatch error per kernel — enough stderr spam to
+    displace every metric line from a log tail (this emptied the round-4
+    bench artifact). Entries written on one machine are simply invisible
+    to a different machine instead of being loaded and rejected loudly."""
     import os
 
     import jax
 
     cache_dir = cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR",
                                             "/tmp/cc_tpu_jax_cache")
+    cache_dir = os.path.join(cache_dir, _host_fingerprint())
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return cache_dir
+
+
+def _host_fingerprint() -> str:
+    """Stable id for (CPU features, jaxlib, requested platforms) — the
+    inputs that decide whether a persisted XLA:CPU AOT artifact is loadable
+    on this host. /proc/cpuinfo flags cover the machine-feature axis the
+    XLA cache key omits; JAX_PLATFORMS covers cpu-vs-tpu entry points that
+    share one cache root."""
+    import hashlib
+    import os
+    import platform as _platform
+
+    flags = _platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    flags = line.strip()
+                    break
+    except OSError:
+        pass
+    try:
+        import jaxlib
+
+        jl = getattr(jaxlib, "__version__", "unknown")
+    except Exception:  # pragma: no cover - jaxlib always ships with jax
+        jl = "unknown"
+    key = "|".join([flags, jl, os.environ.get("JAX_PLATFORMS", ""),
+                    "tunnel" if os.environ.get("PALLAS_AXON_POOL_IPS")
+                    else "local"])
+    return hashlib.sha256(key.encode()).hexdigest()[:16]
