@@ -177,29 +177,16 @@ def compute_deltas(state: ClusterTensors, derived: DerivedState,
     )
 
 
-def generate_candidates(state: ClusterTensors, derived: DerivedState,
-                        source_score: jax.Array, dest_score: jax.Array,
-                        replica_weight: jax.Array, num_sources: int,
-                        num_dests: int, include_leadership: bool,
-                        leadership_only: bool = False,
-                        ) -> "tuple[Candidates, tuple[tuple[int, int], ...]]":
-    """Top-k × top-k candidate grid.
+def select_sources(state: ClusterTensors, source_score: jax.Array,
+                   replica_weight: jax.Array, num_sources: int,
+                   ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The move grid's source-replica selection (broker-diverse top-k; see
+    generate_candidates). Returns (cand_p [k], cand_s [k], src_valid [k]).
 
-    - ``source_score[B]``: how much each broker needs to shed (>0 = source).
-    - ``dest_score[B]``: how attractive each broker is as a destination
-      (-inf = not eligible).
-    - ``replica_weight[P, S]``: which replicas are worth moving (higher =
-      try first; the per-goal analogue of SortedReplicas score functions).
-
-    Replica moves: the ``num_sources`` highest-weight replicas living on
-    positive-score source brokers × the ``num_dests`` best destinations.
-    Leadership: the top leader slots on source brokers × their follower
-    slots (dst_broker implied by slot).
-
-    Returns (candidates, layout) where ``layout`` describes the grid blocks
-    — [k_src × k_dst] moves then [k_l × S] leadership — so the selector can
-    do a per-source best-destination reduction before global ranking.
-    """
+    Deterministic in its inputs and called by both generate_candidates and
+    callers that need the source list FIRST (to compute per-card targeted
+    destinations, analyzer.fill) — the duplicated trace is structurally
+    identical, so XLA CSE collapses it."""
     b = state.num_brokers
     s_dim = state.max_replication_factor
     exists = replica_exists(state)
@@ -258,6 +245,46 @@ def generate_candidates(state: ClusterTensors, derived: DerivedState,
     top_idx = jnp.minimum(top_idx, n_flat - 1)
     cand_p = (top_idx // s_dim).astype(jnp.int32)
     cand_s = (top_idx % s_dim).astype(jnp.int32)
+    return cand_p, cand_s, src_valid
+
+
+def generate_candidates(state: ClusterTensors, derived: DerivedState,
+                        source_score: jax.Array, dest_score: jax.Array,
+                        replica_weight: jax.Array, num_sources: int,
+                        num_dests: int, include_leadership: bool,
+                        leadership_only: bool = False,
+                        extra_dst: "tuple[jax.Array, jax.Array] | None" = None,
+                        ) -> "tuple[Candidates, tuple[tuple[int, int], ...]]":
+    """Top-k × top-k candidate grid.
+
+    - ``source_score[B]``: how much each broker needs to shed (>0 = source).
+    - ``dest_score[B]``: how attractive each broker is as a destination
+      (-inf = not eligible).
+    - ``replica_weight[P, S]``: which replicas are worth moving (higher =
+      try first; the per-goal analogue of SortedReplicas score functions).
+    - ``extra_dst``: optional (dst [k_src], ok [k_src]) per-card TARGETED
+      destination (Goal.target_dests over the select_sources card list),
+      appended as one more column of the move block so each source also
+      competes with a destination constructed for it.
+
+    Replica moves: the ``num_sources`` highest-weight replicas living on
+    positive-score source brokers × the ``num_dests`` best destinations.
+    Leadership: the top leader slots on source brokers × their follower
+    slots (dst_broker implied by slot).
+
+    Returns (candidates, layout) where ``layout`` describes the grid blocks
+    — [k_src × (k_dst + extra)] moves then [k_l × S] leadership — so the
+    selector can do a per-source best-destination reduction before global
+    ranking.
+    """
+    b = state.num_brokers
+    s_dim = state.max_replication_factor
+    exists = replica_exists(state)
+    seg = jnp.where(state.assignment >= 0, state.assignment, b)
+    on_source = (jnp.concatenate([source_score, jnp.array([-1.0])])[seg] > 0.0) & exists
+    k_src = min(num_sources, exists.size)
+    cand_p, cand_s, src_valid = select_sources(state, source_score,
+                                               replica_weight, num_sources)
 
     layout: list[tuple[int, int]] = []
     parts: list[Candidates] = []
@@ -265,16 +292,25 @@ def generate_candidates(state: ClusterTensors, derived: DerivedState,
         k_dst = min(num_dests, b)
         _dst_score, dst_idx = jax.lax.top_k(dest_score, k_dst)
         dst_valid = jnp.isfinite(_dst_score)
-        n = k_src * k_dst
-        grid_p = jnp.repeat(cand_p, k_dst)
-        grid_s = jnp.repeat(cand_s, k_dst)
-        grid_valid = jnp.repeat(src_valid, k_dst) & jnp.tile(dst_valid, k_src)
-        grid_dst = jnp.tile(dst_idx.astype(jnp.int32), k_src)
+        cols_dst = jnp.broadcast_to(dst_idx.astype(jnp.int32)[None, :],
+                                    (k_src, k_dst))
+        cols_ok = jnp.broadcast_to(dst_valid[None, :], (k_src, k_dst))
+        if extra_dst is not None:
+            t_dst, t_ok = extra_dst
+            cols_dst = jnp.concatenate(
+                [cols_dst, t_dst.astype(jnp.int32)[:, None]], axis=1)
+            cols_ok = jnp.concatenate([cols_ok, t_ok[:, None]], axis=1)
+        k_cols = cols_dst.shape[1]
+        n = k_src * k_cols
+        grid_p = jnp.repeat(cand_p, k_cols)
+        grid_s = jnp.repeat(cand_s, k_cols)
+        grid_valid = jnp.repeat(src_valid, k_cols) & cols_ok.reshape(-1)
+        grid_dst = cols_dst.reshape(-1)
         parts.append(Candidates(
             kind=jnp.zeros(n, dtype=jnp.int8),
             partition=grid_p, src_slot=grid_s, dst_broker=grid_dst,
             dst_slot=jnp.zeros(n, dtype=jnp.int32), valid=grid_valid))
-        layout.append((k_src, k_dst))
+        layout.append((k_src, k_cols))
 
     if include_leadership or leadership_only:
         # Leadership candidates: for each top source replica that IS a

@@ -37,7 +37,8 @@ from ..model.tensors import ClusterTensors, offline_replicas
 from .agg import (
     AggCarry, apply_deltas_to_agg, compute_agg, maybe_refresh, pot_lbi_deltas,
 )
-from .candidates import compute_deltas, generate_candidates
+from .candidates import compute_deltas, generate_candidates, select_sources
+from .fill import TARGET_DESTS_ON
 from .constraint import BalancingConstraint
 from .derived import compute_derived
 from .goals.base import Goal
@@ -146,6 +147,27 @@ def _switch_scores(active_idx, goals, aux_list, state, derived, constraint):
     return jax.lax.switch(active_idx, [branch(i) for i in range(len(goals))], 0)
 
 
+def _switch_target_dests(active_idx, goals, aux_list, state, derived,
+                         constraint, cand_p, cand_s, src_valid):
+    """The active goal's targeted-destination column (Goal.target_dests,
+    analyzer.fill) — goals without a rule contribute an all-invalid
+    column so every branch returns the same shapes."""
+
+    def branch(i):
+        g = goals[i]
+
+        def fn(_):
+            td = g.target_dests(state, derived, constraint, aux_list[i],
+                                cand_p, cand_s, src_valid)
+            if td is None:
+                return (jnp.zeros_like(cand_p),
+                        jnp.zeros(cand_p.shape, dtype=bool))
+            return td[0].astype(jnp.int32), td[1]
+        return fn
+
+    return jax.lax.switch(active_idx, [branch(i) for i in range(len(goals))], 0)
+
+
 def _chain_round_body(state: ClusterTensors, agg: "AggCarry | None",
                       active_idx: jax.Array,
                       prior_mask: jax.Array, goals: tuple[Goal, ...],
@@ -185,11 +207,22 @@ def _chain_round_body(state: ClusterTensors, agg: "AggCarry | None",
 
     # UNIFORM grid layout: both the move and the leadership block always
     # exist (static shapes shared by every goal); the active goal's traced
-    # flags mask out the block it doesn't use.
+    # flags mask out the block it doesn't use. The targeted-destination
+    # column (Goal.target_dests) rides the move block; select_sources here
+    # duplicates generate_candidates' internal selection structurally, so
+    # XLA CSE collapses the two.
+    extra = None
+    if TARGET_DESTS_ON:
+        cand_p, cand_s, src_valid = select_sources(state, src_score, weight,
+                                                   cfg.num_sources)
+        extra = _switch_target_dests(active_idx, goals, aux_list, state,
+                                     derived, constraint, cand_p, cand_s,
+                                     src_valid)
     cand, layout = generate_candidates(state, derived, src_score, dst_score,
                                        weight, cfg.num_sources, cfg.num_dests,
                                        include_leadership=True,
-                                       leadership_only=False)
+                                       leadership_only=False,
+                                       extra_dst=extra)
     (r0, c0), (r1, c1) = layout
     block_ok = jnp.concatenate([
         jnp.broadcast_to(~is_lead_only, (r0 * c0,)),

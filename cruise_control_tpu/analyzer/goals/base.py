@@ -176,6 +176,19 @@ class Goal:
         """[P, S] — which replicas to move first (SortedReplicas analogue)."""
         return replica_load_total(state)
 
+    def target_dests(self, state, derived, constraint, aux,
+                     cand_p: jax.Array, cand_s: jax.Array,
+                     src_valid: jax.Array,
+                     ) -> "tuple[jax.Array, jax.Array] | None":
+        """Optional constructive per-card destination (analyzer.fill): for
+        the selected source replicas ``(cand_p, cand_s)[k]``, return
+        (dst_broker [k] int32, ok [k] bool) — one destination built for
+        each card — or None when the goal has no per-card destination
+        rule. The search appends the result as an extra column of the
+        move grid; all acceptance/selection machinery applies unchanged,
+        so a targeted destination is a HINT, never a bypass."""
+        return None
+
 
 def pair_improvement(values: jax.Array, deltas: CandidateDeltas,
                      delta: jax.Array, viol_fn) -> jax.Array:

@@ -35,6 +35,7 @@ from ..model.tensors import ClusterTensors, offline_replicas
 from .agg import pot_lbi_deltas
 from .candidates import (
     KIND_MOVE, attach_cumulative, compute_deltas, generate_candidates,
+    select_sources,
 )
 from .constraint import BalancingConstraint
 from .derived import DerivedState, compute_derived
@@ -288,9 +289,28 @@ def score_round_candidates(state: ClusterTensors, masks: ExclusionMasks,
         src_score = src_score + offline_per_broker
         weight = jnp.where(off, 1e30, weight)  # finite: top-k validity uses isfinite
 
+    # Targeted destination column (Goal.target_dests over the shared
+    # source selection, analyzer.fill). When enabled it is ALWAYS
+    # appended — goals without a target rule get an all-invalid column —
+    # so the move block's column count (and reduce_per_source's rotation
+    # arithmetic) is identical across the per-goal, chain and sharded
+    # kernels.
+    from .fill import TARGET_DESTS_ON
+    k_eff = k_src or cfg.num_sources
+    extra = None
+    if TARGET_DESTS_ON and not goal.leadership_only:
+        cand_p, cand_s, src_valid = select_sources(state, src_score, weight,
+                                                   k_eff)
+        extra = goal.target_dests(state, derived, constraint, aux,
+                                  cand_p, cand_s, src_valid)
+        if extra is None:
+            extra = (jnp.zeros_like(cand_p),
+                     jnp.zeros(cand_p.shape, dtype=bool))
+
     cand, layout = generate_candidates(state, derived, src_score, dst_score, weight,
-                                       k_src or cfg.num_sources, cfg.num_dests,
-                                       goal.include_leadership, goal.leadership_only)
+                                       k_eff, cfg.num_dests,
+                                       goal.include_leadership, goal.leadership_only,
+                                       extra_dst=extra)
     deltas = compute_deltas(state, derived, cand)
 
     accept = deltas.valid

@@ -25,6 +25,7 @@ precompute thread pool (SURVEY.md §2.11 row 1).
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import lru_cache, partial
 
 import jax
@@ -34,17 +35,18 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..analyzer.candidates import (
     Candidates, CandidateDeltas, attach_cumulative, compute_deltas,
-    generate_candidates,
+    generate_candidates, select_sources,
 )
 from ..analyzer.agg import (
     AggDelta, apply_deltas_to_agg, compute_agg, pot_lbi_deltas,
 )
 from ..analyzer.chain import (
     _chain_infos_from_stats, _gated_aux, _goal_flags, _switch_scores,
-    excluded_hosting_replicas,
+    _switch_target_dests, excluded_hosting_replicas,
 )
 from ..analyzer.constraint import BalancingConstraint
 from ..analyzer.derived import compute_derived
+from ..analyzer.fill import TARGET_DESTS_ON
 from ..analyzer.search import (
     _OFFLINE_BONUS, _EPS_IMPROVEMENT, ExclusionMasks, SearchConfig,
     _per_broker_top_replicas, apply_selected, reduce_per_source,
@@ -54,6 +56,65 @@ from ..common.resources import Resource
 from ..model.tensors import ClusterTensors, offline_replicas
 from .mesh import PARTITION_AXIS
 from .sharded import _mask_specs, _psum, _state_specs
+
+
+# Per-device source-width policy for the sharded move grid. Measured on the
+# 1k/100k fixture, 8 virtual devices (tools/bench_mesh.py, rounds are
+# deterministic):
+# - "split"  — exact num_sources//shards per device: each device surfaces
+#   only its LOCAL top slice; 1,352 rounds vs 492 single-device (r4).
+# - "oversample4" — 4x the split width (r4 trial): 2,513 rounds — WORSE
+#   (wider per-device grids admit weaker local sources; recorded negative,
+#   commit 7e538cd).
+# - "full" (default) — full num_sources width per device: every device's
+#   grid is a SUPERSET of the single-device grid restricted to its shard,
+#   so the union covers the global top-k and the search trajectory tracks
+#   the single-device one (rounds ≈ single-device). Per-device grid work
+#   stays at single-device scale (redundant across devices) — on real
+#   chips the non-grid phases (derived state, scores, [P]-indexed work)
+#   still shard, and round-count parity is what lets 8 chips beat 1 at
+#   all.
+# - CC_MESH_THETA=1 additionally masks sources below the global top-k_src
+#   weight threshold. Measured NEGATIVE at 1k/8dev (balancedness 86.0 →
+#   83.55, extra violated goal): the mask starves the broker-diversity
+#   source blocks and thins the leadership block, so it is OFF by
+#   default; kept behind the env var as a measured-negative record.
+_SRC_WIDTH_POLICY = os.environ.get("CC_MESH_SRC_WIDTH", "full")
+_GLOBAL_THETA = os.environ.get("CC_MESH_THETA", "0") == "1"
+
+
+def _per_device_source_width(num_sources: int, num_shards: int) -> int:
+    if _SRC_WIDTH_POLICY == "split":
+        return max(16, min(num_sources, max(1, num_sources // num_shards)))
+    if _SRC_WIDTH_POLICY == "oversample4":
+        return max(16, min(num_sources,
+                           4 * max(1, num_sources // num_shards)))
+    return num_sources  # "full"
+
+
+def _global_source_threshold(weight: jax.Array, src_score: jax.Array,
+                             state: ClusterTensors, k_src: int) -> jax.Array:
+    """Mask ``weight`` so only the GLOBAL top-``k_src`` eligible replicas
+    stay finite. Eligibility mirrors generate_candidates' on-source mask
+    (replica exists, broker source-score > 0). The threshold is exact: the
+    k-th largest of the union of per-device top-k covers the global top-k.
+    Offline replicas carry weight 1e30, so self-healing sources always
+    survive the cut."""
+    from ..model.tensors import replica_exists
+
+    b = state.num_brokers
+    exists = replica_exists(state)
+    seg = jnp.where(state.assignment >= 0, state.assignment, b)
+    on_source = (jnp.concatenate([src_score, jnp.array([-1.0])])[seg]
+                 > 0.0) & exists
+    w_eff = jnp.where(on_source, weight, -jnp.inf)
+    k = min(k_src, w_eff.size)
+    local_top, _ = jax.lax.top_k(w_eff.reshape(-1), k)
+    g_top = jax.lax.all_gather(local_top, PARTITION_AXIS).reshape(-1)
+    theta = jax.lax.top_k(g_top, k)[0][-1]
+    # -inf theta (fewer than k eligible replicas globally) keeps all.
+    keep = w_eff >= jnp.where(jnp.isfinite(theta), theta, -jnp.inf)
+    return jnp.where(keep, weight, -jnp.inf)
 
 
 def _offline_per_broker(state: ClusterTensors, off: jax.Array) -> jax.Array:
@@ -92,15 +153,7 @@ def _chain_round_local(state: ClusterTensors, agg, masks: ExclusionMasks,
     p_local = state.num_partitions
     p_global = p_local * num_shards
     offset = shard * p_local
-    # Per-device source width: an exact num_sources/shards split surfaces
-    # only each device's LOCAL top slice, and on skewed clusters the union
-    # is a poor proxy for the global top-k — measured at 1k/8dev it
-    # nearly tripled total rounds vs single-device (1,352 vs 492,
-    # tools/bench_mesh.py). Oversampling 4x per device (capped at the full
-    # width) recovers most of the global ordering for a gather of
-    # 4*num_sources cards; the grid stays sharded.
-    k_src = max(16, min(cfg.num_sources,
-                        4 * max(1, cfg.num_sources // num_shards)))
+    k_src = _per_device_source_width(cfg.num_sources, num_shards)
 
     lead_only_f, incl_lead_f, indep_f = _goal_flags(goals)
     additive_f = jnp.asarray([g.partition_additive_scores for g in goals])
@@ -121,11 +174,26 @@ def _chain_round_local(state: ClusterTensors, agg, masks: ExclusionMasks,
     offline_pb = _offline_per_broker(state, off)
     src_score = src_score + jnp.where(is_lead_only, 0.0, offline_pb)
     weight = jnp.where(off & ~is_lead_only, 1e30, weight)
+    if _GLOBAL_THETA and num_shards > 1:
+        weight = _global_source_threshold(weight, src_score, state, k_src)
 
+    # Targeted-destination column (Goal.target_dests): aux/derived
+    # aggregates are replicated, card ranks are device-local — devices
+    # fill the same deficit profile independently, so cross-device
+    # overfill of one destination is possible and is vetoed by the joint
+    # acceptance recheck below (same contract as the conflict rules).
+    extra = None
+    if TARGET_DESTS_ON:
+        cand_p, cand_s, src_valid = select_sources(state, src_score, weight,
+                                                   k_src)
+        extra = _switch_target_dests(active_idx, goals, aux_list, state,
+                                     derived, constraint, cand_p, cand_s,
+                                     src_valid)
     cand, layout = generate_candidates(state, derived, src_score, dst_score,
                                        weight, k_src, cfg.num_dests,
                                        include_leadership=True,
-                                       leadership_only=False)
+                                       leadership_only=False,
+                                       extra_dst=extra)
     (r0, c0), (r1, c1) = layout
     block_ok = jnp.concatenate([
         jnp.broadcast_to(~is_lead_only, (r0 * c0,)),
@@ -504,17 +572,20 @@ def _chain_full_local(state: ClusterTensors, masks: ExclusionMasks, *,
             num_topics=num_topics)
 
         def run(s):
-            # Aggregate carry computed once per goal (psum'd -> global,
-            # replicated) and threaded through both phases; no in-loop
-            # refresh on the mesh (a cond-gated psum would be collective-
-            # unsafe) — counts stay exact, f32 drift is bounded by the
-            # pass length and reset at every stats recompute.
+            # Aggregate carry: psum'd -> global, replicated, threaded
+            # through both phases. A cond-GATED in-loop refresh would be
+            # collective-unsafe, but while_loop bodies execute collectives
+            # unconditionally on every device, so an ungated recompute at
+            # the top of each outer iteration is safe — it bounds f32
+            # drift to one move+swap cycle instead of a full
+            # cfg.max_rounds pass (ADVICE r4; counts stay exact always).
             def outer_cond(c):
                 _s, _a, _m, _sw, rounds, last_swapped, first = c
                 return (first | (last_swapped > 0)) & (rounds < cfg.max_rounds)
 
             def outer_body(c):
-                s, a, m_tot, sw_tot, rounds, _ls, _first = c
+                s, _a, m_tot, sw_tot, rounds, _ls, _first = c
+                a = compute_agg(s, num_topics, psum=_psum)
 
                 def move_body(carry, _r):
                     st, ag = carry
