@@ -45,7 +45,50 @@ def broker_capacities(admin, capacity_resolver) -> dict:
             "Estimated": bool(getattr(capacity_resolver, "is_estimated",
                                       lambda _b: False)(bid)),
         })
+    # capacity_only bypasses the model entirely (admin + capacity config
+    # only), and the admin surface carries no host topology — host rows
+    # exist on the model-backed LOAD path (broker_stats below).
     return envelope({"brokers": rows, "hosts": []})
+
+
+def _host_name(meta: ClusterMeta, h: int) -> str:
+    if 0 <= h < len(meta.host_names):
+        return meta.host_names[h]
+    return f"host-{h}"  # builder predates host topology / fixture default
+
+
+def _host_rows(state: ClusterTensors, meta: ClusterMeta, loads, caps,
+               replicas, leaders, pnw, mask) -> list[dict]:
+    """Per-host aggregate rows (BrokerStats.java host section /
+    model/Host.java:275): every stat summed over the host's brokers,
+    utilization pct over the host's summed capacity."""
+    hosts = np.asarray(state.host)[mask]
+    uniq, inv = np.unique(hosts, return_inverse=True)
+    n = len(uniq)
+
+    def by_host(col):
+        return np.bincount(inv, weights=col, minlength=n)
+
+    load = {r: by_host(loads[mask, int(r)]) for r in
+            (Resource.DISK, Resource.CPU, Resource.NW_IN, Resource.NW_OUT)}
+    disk_cap = by_host(caps[mask, int(Resource.DISK)])
+    h_pnw = by_host(np.asarray(pnw, dtype=np.float64)[mask])
+    h_replicas = by_host(np.asarray(replicas, dtype=np.float64)[mask])
+    h_leaders = by_host(np.asarray(leaders, dtype=np.float64)[mask])
+    with np.errstate(divide="ignore", invalid="ignore"):
+        disk_pct = np.where(disk_cap > 0,
+                            100.0 * load[Resource.DISK] / disk_cap, 0.0)
+    return [{
+        "Host": _host_name(meta, int(uniq[i])),
+        "DiskMB": round(float(load[Resource.DISK][i]), 3),
+        "DiskPct": round(float(disk_pct[i]), 3),
+        "CpuPct": round(float(load[Resource.CPU][i]), 3),
+        "NwInRate": round(float(load[Resource.NW_IN][i]), 3),
+        "NwOutRate": round(float(load[Resource.NW_OUT][i]), 3),
+        "PnwOutRate": round(float(h_pnw[i]), 3),
+        "Replicas": int(h_replicas[i]),
+        "Leaders": int(h_leaders[i]),
+    } for i in range(n)]
 
 
 def broker_stats(state: ClusterTensors, meta: ClusterMeta,
@@ -62,6 +105,7 @@ def broker_stats(state: ClusterTensors, meta: ClusterMeta,
     pnw = np.asarray(potential_nw_out(state))
     states = np.asarray(state.broker_state)
     racks = np.asarray(state.rack)
+    hosts = np.asarray(state.host)
     mask = np.asarray(state.broker_mask)
     from ..common.broker_state import BrokerState
     rows = []
@@ -72,6 +116,7 @@ def broker_stats(state: ClusterTensors, meta: ClusterMeta,
             "Broker": bid,
             "BrokerState": BrokerState(int(states[i])).name,
             "Rack": meta.rack_names[int(racks[i])],
+            "Host": _host_name(meta, int(hosts[i])),
             "DiskMB": round(float(loads[i, Resource.DISK]), 3),
             "DiskPct": round(float(pct[i, Resource.DISK]), 3),
             "CpuPct": round(float(loads[i, Resource.CPU]), 3),
@@ -92,7 +137,9 @@ def broker_stats(state: ClusterTensors, meta: ClusterMeta,
                 d: {"DiskMB": round(float(c), 3), "alive": True}
                 for d, c in sorted(caps_by_dir.items())}
         rows.append(row)
-    return envelope({"brokers": rows, "hosts": []})
+    return envelope({"brokers": rows,
+                     "hosts": _host_rows(state, meta, loads, caps, replicas,
+                                         leaders, pnw, mask)})
 
 
 def partition_load(state: ClusterTensors, meta: ClusterMeta,

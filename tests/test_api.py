@@ -116,6 +116,13 @@ def test_load_and_partition_load(api):
     assert status == 200
     assert len(body["brokers"]) == 4
     assert all("DiskMB" in b and "CpuPct" in b for b in body["brokers"])
+    # Host-level rows (BrokerStats.java host section): default topology is
+    # one host per broker, so sums must match broker-for-broker.
+    assert len(body["hosts"]) == 4
+    assert all("Host" in h and "Replicas" in h and "DiskMB" in h
+               for h in body["hosts"])
+    assert sum(h["Replicas"] for h in body["hosts"]) \
+        == sum(b["Replicas"] for b in body["brokers"])
     status, body, _ = api.handle(
         "GET", "/kafkacruisecontrol/partition_load",
         "resource=network_outbound&entries=5")
@@ -124,6 +131,39 @@ def test_load_and_partition_load(api):
     status, _body, _ = api.handle("GET", "/kafkacruisecontrol/partition_load",
                                   "resource=warp_drive")
     assert status == 400
+
+
+def test_load_host_rows_rack_falls_back_to_host():
+    """Rack-falls-back-to-host end-to-end through the LOAD body
+    (ClusterModel.createBroker: rack == null ? host : rack +
+    model/Host.java:275 host aggregation): two rackless brokers sharing a
+    host collapse to one fault domain AND one aggregated host row."""
+    from cruise_control_tpu.api.responses import broker_stats
+    from cruise_control_tpu.model.builder import ClusterModelBuilder
+
+    cap = {Resource.CPU: 100.0, Resource.NW_IN: 1e5, Resource.NW_OUT: 1e5,
+           Resource.DISK: 1e6}
+    load = {Resource.CPU: 1.0, Resource.NW_IN: 10.0, Resource.NW_OUT: 10.0,
+            Resource.DISK: 100.0}
+    b = ClusterModelBuilder()
+    b.add_broker(0, "", cap, host="shared-host")
+    b.add_broker(1, "", cap, host="shared-host")
+    b.add_broker(2, "rackA", cap, host="solo-host")
+    b.add_partition("t", 0, [0, 2], leader_load=load)
+    b.add_partition("t", 1, [1, 2], leader_load=load)
+    state, meta = b.build()
+    body = broker_stats(state, meta)
+
+    by_host = {h["Host"]: h for h in body["hosts"]}
+    assert set(by_host) == {"shared-host", "solo-host"}
+    assert by_host["shared-host"]["Replicas"] == 2   # brokers 0 + 1
+    assert by_host["solo-host"]["Replicas"] == 2     # broker 2's two
+    assert by_host["shared-host"]["DiskMB"] == pytest.approx(200.0)
+    rows = {r["Broker"]: r for r in body["brokers"]}
+    # Rackless brokers inherit their host as the fault domain.
+    assert rows[0]["Rack"] == rows[1]["Rack"] == "shared-host"
+    assert rows[0]["Host"] == rows[1]["Host"] == "shared-host"
+    assert rows[2]["Rack"] == "rackA" and rows[2]["Host"] == "solo-host"
 
 
 def test_proposals_and_rebalance_dryrun(api):
