@@ -92,6 +92,16 @@ def deserialize_plan(payload: bytes) -> MaintenanceEvent:
                       for rf, ts in (content.get("topicsByRF") or {}).items()})
 
 
+def publish_plan(transport, event: MaintenanceEvent,
+                 time_ms: int | None = None) -> None:
+    """Ops-pipeline producer half: serialize a plan and produce it to the
+    maintenance topic through any metrics-shaped transport
+    (produce + flush). The reference leaves production to external
+    tooling; this is the equivalent one-liner for python pipelines."""
+    transport.produce(serialize_plan(event, time_ms=time_ms))
+    transport.flush()
+
+
 class TopicMaintenanceEventReader:
     """MaintenanceEventReader over a maintenance-plan topic.
 
@@ -99,15 +109,23 @@ class TopicMaintenanceEventReader:
     Iterable[bytes]`` — the same shape as the metrics-topic transport
     (kafka/transport.py KafkaMetricsTransport), so the live binding and the
     in-memory fake both plug in. Undecodable/corrupt plans are dropped with
-    a log line (MaintenanceEventTopicReader skips bad records)."""
+    a log line (MaintenanceEventTopicReader skips bad records).
 
-    def __init__(self, transport, now_ms: Callable[[], int] | None = None):
+    Poll windows are [last_end, now - settle_ms): the settle buffer keeps
+    a plan whose record timestamp ties with the poll instant (or lags it
+    under producer clock skew) readable by the NEXT poll instead of being
+    skipped forever once last_end advances past it — the role of the
+    reference's acceptable consumption lag."""
+
+    def __init__(self, transport, now_ms: Callable[[], int] | None = None,
+                 settle_ms: int = 1000):
         self._transport = transport
         self._now_ms = now_ms or (lambda: int(time.time() * 1000))
+        self._settle_ms = settle_ms
         self._last_poll_ms = 0
 
     def read_events(self) -> list[MaintenanceEvent]:
-        end = self._now_ms()
+        end = max(self._last_poll_ms, self._now_ms() - self._settle_ms)
         payloads: Iterable[bytes] = self._transport.poll(
             self._last_poll_ms, end)
         self._last_poll_ms = end

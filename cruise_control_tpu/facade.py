@@ -164,10 +164,26 @@ class CruiseControl:
         default in-memory reader takes no arguments; custom readers are
         instantiated bare and may read their own config via attributes."""
         from .config.abstract_config import resolve_class
+        from .detector.maintenance_serde import TopicMaintenanceEventReader
         spec = config.get("maintenance.event.reader.class")
         cls = resolve_class(spec) if isinstance(spec, str) else spec
         if cls is InMemoryMaintenanceEventReader or cls is None:
             return InMemoryMaintenanceEventReader()
+        if cls is TopicMaintenanceEventReader:
+            # Live Kafka binding (MaintenanceEventTopicReader.java:350):
+            # consume plans an ops pipeline produces to
+            # ``maintenance.event.topic`` over the wire client.
+            bootstrap = config.get("bootstrap.servers")
+            if not bootstrap:
+                LOG.warning("maintenance.event.reader.class is the topic "
+                            "reader but bootstrap.servers is unset; using "
+                            "the in-memory reader")
+                return InMemoryMaintenanceEventReader()
+            from .kafka.transport import KafkaMetricsTransport
+            transport = KafkaMetricsTransport(
+                bootstrap, topic=config.get("maintenance.event.topic"),
+                num_partitions=1)
+            return TopicMaintenanceEventReader(transport)
         try:
             return cls()
         except TypeError:
@@ -209,8 +225,13 @@ class CruiseControl:
             mgr.add_detector(TopicAnomalyDetector(
                 self._admin, report, cfg, desired_rf=int(target_rf),
                 topic_pattern=cfg.get("topic.anomaly.topic.pattern")), interval)
+        idem_retention = cfg.get_long("maintenance.event.idempotence."
+                                      "retention.ms")
+        if not cfg.get_boolean("maintenance.event.enable.idempotence"):
+            idem_retention = 0  # zero-retention cache never matches
         mgr.add_detector(MaintenanceEventDetector(
-            self.maintenance_reader, report), interval)
+            self.maintenance_reader, report,
+            idempotence_retention_ms=idem_retention), interval)
 
     def _on_execution_sampling_change(self, executing: bool) -> None:
         """Executor.java:1408-1424 — reduce sampling scope during moves and

@@ -659,6 +659,76 @@ def test_executor_intra_broker_jbod_flow_over_wire(cluster):
     admin.close()
 
 
+def test_maintenance_plan_topic_flow_over_wire(cluster):
+    """Kafka-topic maintenance flow (MaintenanceEventTopicReader.java:350):
+    an ops pipeline produces a serialized plan to the maintenance topic on
+    the embedded cluster; the topic reader consumes it through the wire
+    transport; the detector reports it ONCE (idempotence cache), drops the
+    tampered duplicate, and the anomaly's fix dispatches the mapped
+    facade operation."""
+    import json
+
+    from cruise_control_tpu.detector.anomaly import (
+        AnomalyType, MaintenanceEvent, MaintenanceEventType,
+    )
+    from cruise_control_tpu.detector.maintenance import (
+        MaintenanceEventDetector,
+    )
+    from cruise_control_tpu.detector.maintenance_serde import (
+        MAINTENANCE_TOPIC, TopicMaintenanceEventReader, publish_plan,
+        serialize_plan,
+    )
+
+    transport = KafkaMetricsTransport(cluster.bootstrap_servers,
+                                      topic=MAINTENANCE_TOPIC,
+                                      num_partitions=1)
+    plan = MaintenanceEvent(event_type=MaintenanceEventType.REMOVE_BROKER,
+                            broker_ids=[2])
+    publish_plan(transport, plan)
+    publish_plan(transport, plan)          # duplicate: idempotence drops it
+    # Tampered payload: CRC guard must reject it before the detector.
+    raw = json.loads(serialize_plan(plan).decode())
+    raw["content"]["brokers"] = [0]        # corrupt without re-CRCing
+    transport.produce(json.dumps(raw).encode())
+    transport.flush()
+
+    import time as _time
+
+    reported = []
+    # settle_ms=0 + explicit sleeps: deterministic window edges in-test
+    # (the production default keeps a 1 s settle for clock-skew safety).
+    reader = TopicMaintenanceEventReader(transport, settle_ms=0)
+    detector = MaintenanceEventDetector(reader, reported.append)
+    _time.sleep(0.005)
+    events = detector.run_once()
+    assert len(events) == 1 == len(reported)
+    event = reported[0]
+    assert event.anomaly_type is AnomalyType.MAINTENANCE_EVENT
+    assert event.event_type is MaintenanceEventType.REMOVE_BROKER
+    assert list(event.broker_ids) == [2]
+
+    # Fix dispatch: REMOVE_BROKER plans map to facade.remove_brokers.
+    class FakeFacade:
+        def __init__(self):
+            self.calls = []
+
+        def remove_brokers(self, brokers, **kw):
+            self.calls.append(("remove_brokers", tuple(brokers)))
+
+    facade = FakeFacade()
+    assert event.fix(facade) is True
+    assert facade.calls == [("remove_brokers", (2,))]
+
+    # Later polls see nothing new; a NEW distinct plan flows through.
+    assert detector.run_once() == []
+    publish_plan(transport, MaintenanceEvent(
+        event_type=MaintenanceEventType.REBALANCE))
+    _time.sleep(0.005)
+    assert [e.event_type for e in detector.run_once()] \
+        == [MaintenanceEventType.REBALANCE]
+    transport.close()
+
+
 def test_columnar_poll_matches_record_poll(cluster):
     """poll_columns over real sockets must yield the same metric set as the
     per-record poll, and the columnar sampler path must equal the scalar
