@@ -8,6 +8,19 @@ threshold band). The reference's swap-based inner loop is re-expressed as
 the batched move search: the conflict-free accept step reaches the same
 balance band invariant that the pairwise swaps do, one fused round at a
 time (the two halves of a swap land in consecutive rounds).
+
+Reference-parity deviation (deliberate): the reference's swap inner loop
+never exceeds the even ceiling at ANY intermediate state, while this
+goal's deadlock-breaking acceptance lets a rack-duplicate-fixing move
+land on a broker at ceiling+1 transiently (see ``acceptance``); later
+rounds shed the overage (2·rack + count strictly decreases, so the
+two-step path terminates). Failure mode if the shed move is vetoed by a
+stacked goal or the round cap: the final placement can retain a
+ceiling+1 broker — the overage is counted in ``broker_violations``, so
+the hard goal REPORTS as violated (OptimizationFailureError) rather than
+failing silently. Randomized skewed-rack sweeps exercising both the
+curated deadlock fixture and the property-level invariant live in
+tests/test_kafka_assigner_property.py.
 """
 
 from __future__ import annotations
@@ -54,8 +67,23 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
         # per-position swaps) proceeds; the overshoot converts the rack
         # violation into a count violation that later rounds shed
         # (improvement weights rack 2x count, so both steps score > 0).
+        #
+        # Overshoot GUARD (r5 property-sweep finding): an overshoot onto a
+        # broker with no shed channel — no hosted replica with a feasible
+        # rack-compatible under-cap destination — is a dead end: the
+        # ceiling+1 count violation can never be shed, and near-tight
+        # layouts (e.g. 9/4/4/1 racks at RF 2) stalled exactly there. The
+        # reference never hits this because its swap exchanges the two
+        # replicas atomically; here the overshoot leg is only admitted
+        # where the shed leg exists, so the two-step path stays live.
         fixes_dup = _duplicate_mask(state)[deltas.partition, deltas.src_slot]
-        tolerant = fixes_dup & (dst_after <= cap + 1)
+        _dup_ok, shed_ok = self._rack_dest_feasibility(state, derived)
+        b = state.num_brokers
+        seg = jnp.where(state.assignment >= 0, state.assignment, b)
+        has_shed = jnp.zeros(b + 1, jnp.int32).at[seg].add(
+            shed_ok.astype(jnp.int32))[:b] > 0
+        tolerant = fixes_dup & (dst_after <= cap + 1) \
+            & (under_cap | has_shed[deltas.dst_broker])
         is_move = deltas.replica_delta > 0
         return rack_ok & jnp.where(is_move, under_cap | tolerant, True)
 
@@ -91,16 +119,112 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
         return RackAwareGoal.acceptance(self, state, derived, constraint,
                                         aux, leg)
 
+    def _rack_dest_feasibility(self, state, derived):
+        """([P, S] dup-feasible, [P, S] shed-feasible): does a
+        rack-compatible destination currently exist for this replica —
+        at-cap brokers count for duplicate fixes (the ceiling+1 overshoot
+        path), strictly-under-cap for plain count sheds. A replica's
+        destination rack may be (a) any rack the partition does not use,
+        or (b) its OWN rack (same-rack relocation never creates a
+        duplicate). Rack scatter sizes are bounded by B (rack ids < B),
+        so everything stays static-shaped."""
+        from .rack import _slot_racks
+        from ...model.tensors import replica_exists
+
+        b = state.num_brokers
+        room = self._ceiling(derived) - derived.broker_replicas
+        ok = derived.allowed_replica_move & derived.alive
+        under = ok & (room > 0)
+        at = ok & (room >= 0)
+        rack_of = jnp.clip(state.rack, 0, b - 1)
+        n_under_by_rack = jnp.zeros(b, jnp.int32).at[rack_of].add(
+            under.astype(jnp.int32))
+        n_at_by_rack = jnp.zeros(b, jnp.int32).at[rack_of].add(
+            at.astype(jnp.int32))
+        has_under = n_under_by_rack > 0     # [B]-indexed by rack id
+        has_at = n_at_by_rack > 0
+
+        racks = _slot_racks(state)          # [P, S]; empty slots negative
+        exists = replica_exists(state)
+        same = racks[:, :, None] == racks[:, None, :]
+        s = state.max_replication_factor
+        earlier = jnp.tril(jnp.ones((s, s), dtype=bool), k=-1)[None]
+        first_occ = exists & ~(same & earlier).any(axis=2)
+        safe_racks = jnp.clip(racks, 0, b - 1)
+
+        def feasible(has_room):
+            # (a) an unused rack with room: #rooms racks > #distinct used
+            # rooms racks (used non-room racks never block an unused one).
+            n_rooms = has_room.sum()
+            used_rooms = (first_occ & has_room[safe_racks]).sum(axis=1)
+            unused_rack = (n_rooms > used_rooms)[:, None]         # [P, 1]
+            # (b) own-rack relocation: this slot's rack has room and no
+            # OTHER slot of the partition shares it.
+            sole = ~((same & ~jnp.eye(s, dtype=bool)[None]) & exists[:, None, :]
+                     ).any(axis=2)
+            own_ok = has_room[safe_racks] & sole & exists
+            return (unused_rack & exists) | own_ok
+
+        return feasible(has_at), feasible(has_under)
+
     def replica_weight(self, state, derived, constraint, aux):
         # Unlike the pure rack goal (which only moves duplicated replicas),
-        # the count ceiling needs ordinary replicas movable too: prioritize
-        # rack-duplicates, then lighter replicas (cheaper to relocate).
+        # the count ceiling needs ordinary replicas movable too. Priority
+        # is FEASIBILITY-AWARE (property-sweep finding: on heavily skewed
+        # layouts the deterministic top-k filled with currently-unmovable
+        # duplicates while the over-cap sheds that would free the needed
+        # headroom never surfaced — a stall the reference's swap inner
+        # loop sidesteps by exchanging in place):
+        #   1. duplicates with a feasible rack-compatible destination,
+        #   2. replicas on over-ceiling brokers with a feasible
+        #      strictly-under-cap destination (the headroom openers),
+        #   3. everything else (retried as feasibility shifts).
         from ...model.tensors import replica_exists, replica_load_total
         dup = _duplicate_mask(state)
         load = replica_load_total(state)
         peak = load.max() + 1.0
-        return jnp.where(dup, peak + load,
-                         jnp.where(replica_exists(state), peak - load, -jnp.inf))
+        dup_ok, shed_ok = self._rack_dest_feasibility(state, derived)
+        over = derived.broker_replicas > self._ceiling(derived)
+        b = state.num_brokers
+        on_over = jnp.concatenate([over, jnp.array([False])])[
+            jnp.where(state.assignment >= 0, state.assignment, b)]
+        w = jnp.where(replica_exists(state), peak - load, -jnp.inf)
+        w = jnp.where(on_over & shed_ok & ~dup, 3 * peak + load, w)
+        w = jnp.where(dup & dup_ok, 5 * peak + load, w)
+        return jnp.where(dup & ~dup_ok, peak + load, w)
+
+    def target_dests(self, state, derived, constraint, aux,
+                     cand_p, cand_s, src_valid):
+        # Per-card RACK-COMPATIBLE destination: the shared top-num_dests
+        # list ranks by count headroom alone, and on skewed layouts every
+        # listed destination can be rack-conflicted for the specific
+        # partitions that must shed (property-sweep stall: count
+        # violations at a fixed point). Choose, per card, the
+        # most-headroom broker whose rack hosts no OTHER replica of the
+        # card's partition; duplicate-fixing cards may also target at-cap
+        # brokers (the ceiling+1 overshoot path in ``acceptance``).
+        # O(k·B) mask — kafka-assigner chains run at tool scale.
+        b = state.num_brokers
+        s = state.max_replication_factor
+        assign_p = state.assignment[cand_p]                        # [k, S]
+        slot_racks = jnp.where(assign_p >= 0,
+                               state.rack[jnp.clip(assign_p, 0, b - 1)], -1)
+        not_moving = jnp.arange(s, dtype=jnp.int32)[None, :] \
+            != cand_s[:, None]
+        used = jnp.where(not_moving & (assign_p >= 0), slot_racks, -1)
+        conflict = (state.rack[None, None, :] == used[:, :, None]) \
+            .any(axis=1)                                           # [k, B]
+        room = (self._ceiling(derived) - derived.broker_replicas) \
+            .astype(jnp.float32)                                   # [B]
+        fixes_dup = _duplicate_mask(state)[cand_p, cand_s]
+        min_room = jnp.where(fixes_dup, 0.0, 1.0)
+        score = jnp.where(
+            derived.allowed_replica_move[None, :] & derived.alive[None, :]
+            & ~conflict & (room[None, :] >= min_room[:, None]),
+            room[None, :], -jnp.inf)
+        dst = jnp.argmax(score, axis=1).astype(jnp.int32)
+        ok = jnp.isfinite(jnp.max(score, axis=1)) & src_valid
+        return dst, ok
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,116 @@
+"""Randomized property sweep for kafka-assigner mode (VERDICT r4 #8).
+
+The r4 deadlock fix (commit 2346255: rack-duplicate fixes may transiently
+overshoot the even ceiling by one, later rounds shed the overage) was
+validated on one curated fixture. This sweep exercises the property on
+randomized HEAVILY SKEWED rack layouts — uneven rack sizes are exactly the
+shape that used to deadlock (every under-ceiling destination in a
+partition's free rack at the even ceiling).
+
+Feasibility math (drives the layout choices): strict rack-awareness caps a
+rack at ONE replica per partition, so a layout is satisfiable iff
+Σ_r min(P, ceiling·n_r) ≥ RF·P. With RF = 2, B = 18 and P = 361 the even
+ceiling is ceil(722/18) = 41 (rounds UP → slack 16), and any layout whose
+largest rack holds ≤ B/RF = 9 brokers is feasible. A layout with a
+12-broker rack is PROVABLY infeasible (361 + 6·41 = 607 < 722) — the goal
+must then fail LOUDLY (OptimizationFailureError), never silently.
+
+Invariants per feasible run (reference: analyzer/kafkaassigner/
+KafkaAssignerEvenRackAwareGoal.java):
+- strict rack-awareness: no rack holds two replicas of one partition;
+- even ceiling: every broker ends at or under ceil(total/alive) — the
+  transient overshoot must have been shed by convergence;
+- the optimizer reports success (no violated hard goal).
+
+All runs share one tensor shape so the chain compiles once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.optimizer import (
+    GoalOptimizer, goals_by_priority,
+)
+from cruise_control_tpu.analyzer.search import OptimizationFailureError
+from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+from cruise_control_tpu.model import fixtures
+from cruise_control_tpu.model.tensors import (
+    broker_replica_counts, rack_partition_counts,
+)
+
+_B, _T, _P, _RF, _RACKS = 18, 6, 361, 2, 4
+
+# Uneven rack layouts (brokers per rack; sum = _B; max ≤ _B/_RF = 9 keeps
+# them feasible per the module docstring). A rack barely wider than one
+# broker forces the at-ceiling free-rack shape.
+#
+# MAX-TIGHT layouts — a 9-broker rack is exactly B/RF, so that rack must
+# absorb one replica of (almost) every partition — are the enumerated
+# residual gap of the r5 deadlock work: the overshoot-guarded greedy
+# still stalls at residual ≤ 2 on some seeds (one unshed duplicate),
+# where the reference's swap inner loop exchanges the two replicas
+# atomically (KafkaAssignerEvenRackAwareGoal.java per-position swaps).
+# They run as xfail(strict=False): a loud OptimizationFailureError is the
+# documented behavior until a swap/exchange kernel lands
+# (docs/DESIGN.md known limits).
+_LAYOUTS = [
+    (9, 5, 3, 1),   # max-tight
+    (8, 6, 3, 1),
+    (9, 4, 4, 1),   # max-tight
+    (7, 7, 3, 1),
+]
+_MAX_TIGHT = {(9, 5, 3, 1), (9, 4, 4, 1)}
+
+
+def _rack_vector(layout: tuple[int, ...]) -> jnp.ndarray:
+    racks = []
+    for r, n in enumerate(layout):
+        racks.extend([r] * n)
+    return jnp.asarray(racks, dtype=jnp.int32)
+
+
+def _run(seed: int, layout: tuple[int, ...]):
+    cfg = CruiseControlConfig()
+    state, meta = fixtures.random_cluster(
+        num_brokers=_B, num_topics=_T, num_partitions=_P, rf=_RF,
+        num_racks=_RACKS, dist=fixtures.Dist.EXPONENTIAL, seed=seed,
+        target_utilization=0.55)
+    state = dataclasses.replace(state, rack=_rack_vector(layout))
+    opt = GoalOptimizer(cfg)
+    return opt.optimizations(state, meta, goals=goals_by_priority(
+        cfg, ["KafkaAssignerEvenRackAwareGoal",
+              "KafkaAssignerDiskUsageDistributionGoal"]))
+
+
+@pytest.mark.parametrize(
+    "seed,layout",
+    [pytest.param(s, lo,
+                  marks=[pytest.mark.xfail(
+                      reason="max-tight rack layout: greedy + overshoot "
+                      "guard may stall at residual ≤ 2 (needs the "
+                      "reference's atomic swap exchange); fails LOUDLY",
+                      strict=False)] if lo in _MAX_TIGHT else [])
+     for s in (3, 11, 29) for lo in _LAYOUTS])
+def test_even_rack_skewed_layout_sweep(seed, layout):
+    final, res = _run(seed, layout)
+    assert res.violated_goals_after == []
+    counts = np.asarray(rack_partition_counts(final, _RACKS))
+    live = np.asarray(final.partition_mask)
+    assert (counts[live] <= 1).all(), "rack-awareness must hold"
+    reps = np.asarray(broker_replica_counts(final))[:_B]
+    assert reps.max() <= int(np.ceil(reps.sum() / _B)), \
+        (layout, seed, reps.tolist())
+
+
+def test_even_rack_infeasible_layout_fails_loudly():
+    """A 12-broker rack makes the even ceiling + strict rack-awareness
+    jointly unsatisfiable (see module docstring); the hard goal must
+    RAISE — the documented overshoot failure mode reports, never passes
+    silently."""
+    with pytest.raises(OptimizationFailureError, match="EvenRackAware"):
+        _run(3, (12, 3, 2, 1))
