@@ -17,6 +17,7 @@ A candidate is (kind, partition, src_slot, dst_broker, dst_slot):
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 
 import jax
@@ -344,6 +345,102 @@ def generate_candidates(state: ClusterTensors, derived: DerivedState,
     ), tuple(layout)
 
 
+def _exclusive_group_prefix(keys: "tuple[jax.Array, ...]",
+                            values: jax.Array) -> jax.Array:
+    """For each row i: sum of ``values[j]`` over EARLIER rows j < i whose
+    key tuple equals row i's — the per-group exclusive prefix sum, by one
+    lexicographic sort on (keys..., index) + a cumsum + a group-base
+    gather: O(m log m) instead of the [m, m] mask matmul. Key tuples
+    avoid composite-integer keys (int64 is unavailable without
+    jax_enable_x64). ``values`` is [m, C]."""
+    m = values.shape[0]
+    # np.lexsort semantics: LAST key is primary; appending the index makes
+    # the order total, so within a group rows appear in index order.
+    perm = jnp.lexsort((jnp.arange(m),) + tuple(reversed(keys)))
+    v_sorted = values[perm]
+    cs_prev = jnp.concatenate(
+        [jnp.zeros((1, values.shape[1]), values.dtype),
+         jnp.cumsum(v_sorted, axis=0)[:-1]])
+    is_start = jnp.zeros(m, dtype=bool).at[0].set(True)
+    for k in keys:
+        ks = k[perm]
+        is_start = is_start | jnp.concatenate(
+            [jnp.array([True]), ks[1:] != ks[:-1]])
+    start_pos = jnp.maximum.accumulate(
+        jnp.where(is_start, jnp.arange(m), 0))
+    excl = cs_prev - cs_prev[start_pos]
+    return jnp.zeros_like(values).at[perm].set(excl)
+
+
+def attach_cumulative_segments(sub: CandidateDeltas, considered: jax.Array,
+                               pot_delta: jax.Array, lbi_delta: jax.Array,
+                               ) -> tuple[CandidateDeltas, jax.Array]:
+    """O(m log m) ``attach_cumulative``: per-key exclusive prefix sums via
+    sorted segments instead of [m, m] mask matmuls. Numerically the sums
+    run in sorted order rather than index order — equal up to f32
+    reassociation — and the m² → m log m change is what makes SELECTION
+    widths beyond ~2k affordable (the pairwise matmul is the width
+    bottleneck of the wide-batch grids at 7k scale)."""
+    f32 = jnp.float32
+    m = sub.partition.shape[0]
+    rep = sub.replica_delta.astype(f32)
+    lead = sub.leader_delta.astype(f32)
+    r = sub.load_delta.shape[1]
+    src_vals = jnp.concatenate(
+        [sub.load_delta, rep[:, None], lead[:, None]], axis=1)   # [m, R+2]
+    dst_vals = jnp.concatenate(
+        [sub.load_delta, rep[:, None], lead[:, None], pot_delta[:, None],
+         lbi_delta[:, None]], axis=1)                            # [m, R+4]
+    cons = considered.astype(f32)[:, None]
+    src_out = _exclusive_group_prefix((sub.src_broker,), src_vals * cons)
+    dst_out = _exclusive_group_prefix((sub.dst_broker,), dst_vals * cons)
+    topic_vals = jnp.stack([rep, lead], axis=1) * cons
+    st_out = _exclusive_group_prefix((sub.src_broker, sub.topic), topic_vals)
+    dt_out = _exclusive_group_prefix((sub.dst_broker, sub.topic), topic_vals)
+
+    # has_earlier: any earlier CONSIDERED row touching either of my
+    # brokers in either role. Per-broker first-touch rank via the same
+    # sorted-group machinery (a dense [B] scatter would need a traced
+    # broker bound for its shape): each row contributes its (src, rank)
+    # and (dst, rank) entries; within a sorted group the first entry IS
+    # the min rank, broadcast group-wide through the start-position
+    # gather and scattered back to entry order.
+    idx = jnp.arange(m, dtype=jnp.int32)
+    rank_eff = jnp.where(considered, idx, m)
+    keys2 = jnp.concatenate([sub.src_broker, sub.dst_broker])
+    ranks2 = jnp.concatenate([rank_eff, rank_eff])
+    perm2 = jnp.lexsort((jnp.arange(2 * m), ranks2, keys2))
+    k_sorted = keys2[perm2]
+    is_start = jnp.concatenate(
+        [jnp.array([True]), k_sorted[1:] != k_sorted[:-1]])
+    start_pos = jnp.maximum.accumulate(
+        jnp.where(is_start, jnp.arange(2 * m), 0))
+    group_min = ranks2[perm2][start_pos]
+    entry_min = jnp.zeros(2 * m, jnp.int32).at[perm2].set(group_min)
+    has_earlier = (entry_min[:m] < idx) | (entry_min[m:] < idx)
+
+    return dataclasses.replace(
+        sub,
+        pre_src_load=src_out[:, :r],
+        pre_dst_load=dst_out[:, :r],
+        pre_src_count=src_out[:, r],
+        pre_dst_count=dst_out[:, r],
+        pre_src_leaders=src_out[:, r + 1],
+        pre_dst_leaders=dst_out[:, r + 1],
+        pre_src_topic_count=st_out[:, 0],
+        pre_dst_topic_count=dt_out[:, 0],
+        pre_src_topic_leaders=st_out[:, 1],
+        pre_dst_pot=dst_out[:, r + 2],
+        pre_dst_lbi=dst_out[:, r + 3],
+    ), has_earlier
+
+
+# Cumulative pre-delta implementation: "segment" (O(m log m) sort-based,
+# default) or "matmul" ([m, m] pairwise masks — the MXU-friendly form,
+# kept selectable for TPU experiments and as the equivalence oracle).
+_ATTACH_IMPL = os.environ.get("CC_ATTACH", "segment")
+
+
 def attach_cumulative(sub: CandidateDeltas, considered: jax.Array,
                       pot_delta: jax.Array, lbi_delta: jax.Array,
                       ) -> tuple[CandidateDeltas, jax.Array]:
@@ -364,6 +461,9 @@ def attach_cumulative(sub: CandidateDeltas, considered: jax.Array,
     marks candidates sharing a src or dst broker with an earlier considered
     candidate (the first candidate per broker keeps single-candidate
     acceptance semantics)."""
+    if _ATTACH_IMPL == "segment":
+        return attach_cumulative_segments(sub, considered, pot_delta,
+                                          lbi_delta)
     m = sub.partition.shape[0]
     idx = jnp.arange(m)
     earlier = (idx[:, None] > idx[None, :]) & considered[None, :]

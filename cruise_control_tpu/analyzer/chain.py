@@ -38,7 +38,7 @@ from .agg import (
     AggCarry, apply_deltas_to_agg, compute_agg, maybe_refresh, pot_lbi_deltas,
 )
 from .candidates import compute_deltas, generate_candidates, select_sources
-from .fill import TARGET_DESTS_ON
+from .fill import targets_enabled
 from .constraint import BalancingConstraint
 from .derived import compute_derived
 from .goals.base import Goal
@@ -212,12 +212,19 @@ def _chain_round_body(state: ClusterTensors, agg: "AggCarry | None",
     # duplicates generate_candidates' internal selection structurally, so
     # XLA CSE collapses the two.
     extra = None
-    if TARGET_DESTS_ON:
+    if targets_enabled(state.num_partitions):
         cand_p, cand_s, src_valid = select_sources(state, src_score, weight,
                                                    cfg.num_sources)
-        extra = _switch_target_dests(active_idx, goals, aux_list, state,
-                                     derived, constraint, cand_p, cand_s,
-                                     src_valid)
+        # Targets pause while ANY offline replica exists (traced scalar):
+        # targeted steering during a drain locks in placements later
+        # goals cannot repair (1k drain-50: balancedness 86.0 -> 82.74
+        # with CpuUsage violated). Self-healing and the drain's rebalance
+        # keep the r4 full-grid semantics; targets resume once healing
+        # completes.
+        t_dst, t_ok = _switch_target_dests(active_idx, goals, aux_list,
+                                           state, derived, constraint,
+                                           cand_p, cand_s, src_valid)
+        extra = (t_dst, t_ok & ~off.any())
     cand, layout = generate_candidates(state, derived, src_score, dst_score,
                                        weight, cfg.num_sources, cfg.num_dests,
                                        include_leadership=True,
@@ -270,7 +277,7 @@ def _chain_round_body(state: ClusterTensors, agg: "AggCarry | None",
 
     top_idx, sel, sub, pot_d, lbi_d = cumulative_select(
         state, deltas, score, layout, m, cfg.moves_per_round, independent,
-        recheck)
+        recheck, extra_last_col=targets_enabled(state.num_partitions))
     if agg is not None:
         agg = apply_deltas_to_agg(agg, sub, sel, pot_d, lbi_d)
     new_state = apply_selected(

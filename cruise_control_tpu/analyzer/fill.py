@@ -47,6 +47,29 @@ import jax.numpy as jnp
 # attributing per-round cost and fixed-point depth to this machinery.
 TARGET_DESTS_ON = os.environ.get("CC_TARGET_DESTS", "1") == "1"
 
+# Scale gate (measured at 7k/1M, r5): the per-round cost of the targeted
+# branch (per-card fill ranks + cumulative profiles) buys nothing at
+# north-star scale — TopicReplica reaches the same deep fixed point
+# without it (~242 s vs ~288 s per full pass) because the 2048-wide grid
+# already saturates the deficit profile over enough rounds; at tool/mid
+# scale the column clears residuals the shared grid cannot reach. Static
+# per-shape decision (num_partitions is a trace-time constant).
+TARGET_DESTS_MAX_P = int(os.environ.get("CC_TARGET_DESTS_MAX_P", "500000"))
+
+
+def targets_enabled(num_partitions: int) -> bool:
+    return TARGET_DESTS_ON and num_partitions < TARGET_DESTS_MAX_P
+
+
+# Per-goal-class filter for attribution experiments: comma-separated class
+# names; empty = all classes contribute targeted destinations.
+_TGT_CLASSES = os.environ.get("CC_TGT_CLASSES", "")
+
+
+def class_enabled(goal) -> bool:
+    return (not _TGT_CLASSES
+            or type(goal).__name__ in _TGT_CLASSES.split(","))
+
 
 def row_searchsorted(cum: jax.Array, rows: jax.Array, q: jax.Array,
                      ) -> jax.Array:

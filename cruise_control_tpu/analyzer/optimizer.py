@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import time
 from typing import Sequence
 
@@ -259,13 +260,18 @@ class GoalOptimizer:
         than the extra rounds they save; at 7k they cut total rounds 28%
         at identical quality)."""
         mult = self._config.get_int("solver.wide.batch.source.multiplier")
+        # The width cap bounds SELECTION size m = max(moves, sources) too;
+        # with the O(m log m) segment cumulative (candidates.py) the old
+        # m² matmul ceiling no longer binds it — the cap stays a measured
+        # quality/throughput knob (CC_WIDE_CAP for experiments).
+        cap = int(os.environ.get("CC_WIDE_CAP", "2048"))
         return dataclasses.replace(
             search_cfg,
             num_sources=max(search_cfg.num_sources,
-                            min(2048, search_cfg.num_sources * mult,
+                            min(cap, search_cfg.num_sources * mult,
                                 num_brokers)),
             moves_per_round=max(search_cfg.moves_per_round,
-                                min(2048, search_cfg.moves_per_round * 2)))
+                                min(cap, search_cfg.moves_per_round * 2)))
 
     def _wide_config(self, search_cfg: SearchConfig,
                      goal_chain: Sequence[Goal],

@@ -88,7 +88,8 @@ def goal_aux(goal: Goal, state: ClusterTensors, derived: DerivedState,
 
 def reduce_per_source(score: jax.Array,
                       layout: tuple[tuple[int, int], ...],
-                      row_offset: jax.Array | int = 0) -> jax.Array:
+                      row_offset: jax.Array | int = 0,
+                      extra_last_col: bool = False) -> jax.Array:
     """Per-source best-destination reduction: each [rows × cols] grid block
     collapses to one candidate per source replica. Without this, equal
     scores cluster one partition's candidates at the head of the global
@@ -102,10 +103,20 @@ def reduce_per_source(score: jax.Array,
     round at one move. Columns outside the tie window are never chosen, so
     a genuinely better candidate (e.g. the only one fixing a tiny capacity
     violation) cannot be displaced. ``row_offset`` decorrelates devices in
-    the sharded path."""
+    the sharded path.
+
+    ``extra_last_col``: the FIRST block's last column is the targeted-
+    destination column (generate_candidates ``extra_dst``); it is kept
+    OUT of the rotation cycle (rank = cols, i.e. last among ties) so its
+    mere presence cannot perturb the rotation arithmetic of the shared
+    destinations — an all-invalid targeted column then selects
+    bit-identically to no column at all (measured: the modulo shift
+    alone flipped the 1k drain-50 fixture 86.0 → 82.74). A targeted
+    destination still wins whenever it scores strictly above the tie
+    window, which is what it is for."""
     red_parts = []
     offset = 0
-    for rows, cols in layout:
+    for block_i, (rows, cols) in enumerate(layout):
         block = score[offset:offset + rows * cols].reshape(rows, cols)
         finite = jnp.isfinite(block)
         safe = jnp.where(finite, block, -jnp.inf)
@@ -113,10 +124,13 @@ def reduce_per_source(score: jax.Array,
         window = _TIE_WINDOW * jnp.maximum(jnp.abs(row_max), 1e-6)
         tied = finite & (safe >= row_max - window)
 
+        rot_cols = cols - 1 if (extra_last_col and block_i == 0) else cols
         col_ids = jnp.arange(cols, dtype=jnp.int32)[None, :]
         row_ids = jnp.arange(rows, dtype=jnp.int32)[:, None] + row_offset
-        # Rotation rank: 0 for the row's preferred column, increasing after.
-        rot = (col_ids - row_ids) % cols
+        # Rotation rank: 0 for the row's preferred column, increasing
+        # after; the extra column (if any) ranks last among ties.
+        rot = jnp.where(col_ids < rot_cols,
+                        (col_ids - row_ids) % max(rot_cols, 1), cols)
         best_col = jnp.argmin(jnp.where(tied, rot, cols + 1), axis=1)
         # Rows with no tied (finite) column keep plain argmax (all -inf:
         # conflict selection drops them anyway).
@@ -164,7 +178,8 @@ def _conflict_free_top_m(score: jax.Array, partition: jax.Array,
 
 def cumulative_select(state: ClusterTensors, deltas, score: jax.Array,
                       layout, m: int, moves_cap: int,
-                      independent: bool | jax.Array, recheck):
+                      independent: bool | jax.Array, recheck,
+                      extra_last_col: bool = False):
     """Conflict selection with JOINT acceptance instead of broker dedupe.
 
     The old rule admitted at most ONE move per src/dst broker per round
@@ -180,7 +195,7 @@ def cumulative_select(state: ClusterTensors, deltas, score: jax.Array,
     Returns (top_idx into the full grid, sel mask, selected sub-batch,
     pot_delta, lbi_delta) — the latter three so aggregate-carrying drivers
     can scatter the batch's effect without re-deriving it."""
-    red_idx = reduce_per_source(score, layout)
+    red_idx = reduce_per_source(score, layout, extra_last_col=extra_last_col)
     red_score = score[red_idx]
     k = min(m, red_score.shape[0])
     top_score, top_i = jax.lax.top_k(red_score, k)
@@ -290,15 +305,21 @@ def score_round_candidates(state: ClusterTensors, masks: ExclusionMasks,
         weight = jnp.where(off, 1e30, weight)  # finite: top-k validity uses isfinite
 
     # Targeted destination column (Goal.target_dests over the shared
-    # source selection, analyzer.fill). When enabled it is ALWAYS
-    # appended — goals without a target rule get an all-invalid column —
-    # so the move block's column count (and reduce_per_source's rotation
-    # arithmetic) is identical across the per-goal, chain and sharded
-    # kernels.
-    from .fill import TARGET_DESTS_ON
+    # source selection, analyzer.fill): SINGLE-DEVICE only (psum None)
+    # and scale-gated (targets_enabled). Where enabled, it is appended
+    # for every goal — goals without a target rule get an all-invalid
+    # column — so the single-device per-goal and chain kernels share one
+    # move-block column count; the sharded kernels never append it (and
+    # the column stays out of the tie-rotation cycle either way, so the
+    # kernels' shared-destination arithmetic agrees).
+    from .fill import targets_enabled
     k_eff = k_src or cfg.num_sources
     extra = None
-    if TARGET_DESTS_ON and not goal.leadership_only:
+    # psum set = partition-sharded mesh: targeted fills are single-device
+    # only (device-local fill ranks collide across shards — see
+    # parallel/chain_sharded.py).
+    if targets_enabled(state.num_partitions) and not goal.leadership_only \
+            and psum is None:
         cand_p, cand_s, src_valid = select_sources(state, src_score, weight,
                                                    k_eff)
         extra = goal.target_dests(state, derived, constraint, aux,
@@ -306,6 +327,10 @@ def score_round_candidates(state: ClusterTensors, masks: ExclusionMasks,
         if extra is None:
             extra = (jnp.zeros_like(cand_p),
                      jnp.zeros(cand_p.shape, dtype=bool))
+        else:
+            # Targets pause while any offline replica exists (see
+            # chain._chain_round_body).
+            extra = (extra[0], extra[1] & ~off.any())
 
     cand, layout = generate_candidates(state, derived, src_score, dst_score, weight,
                                        k_eff, cfg.num_dests,
@@ -588,9 +613,12 @@ def _round_body(state: ClusterTensors, goal: Goal, optimized: tuple[Goal, ...],
                                               aux, sub)
         return a
 
+    from .fill import targets_enabled
     top_idx, sel, _sub, _pot, _lbi = cumulative_select(
         state, deltas, score, layout, m, cfg.moves_per_round, independent,
-        recheck)
+        recheck,
+        extra_last_col=targets_enabled(state.num_partitions)
+        and not goal.leadership_only)
     new_state = apply_selected(
         state, sel, deltas.partition[top_idx], deltas.src_slot[top_idx],
         deltas.dst_broker[top_idx], cand.kind[top_idx], cand.dst_slot[top_idx])

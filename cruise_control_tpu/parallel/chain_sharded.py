@@ -46,7 +46,7 @@ from ..analyzer.chain import (
 )
 from ..analyzer.constraint import BalancingConstraint
 from ..analyzer.derived import compute_derived
-from ..analyzer.fill import TARGET_DESTS_ON
+from ..analyzer.fill import targets_enabled
 from ..analyzer.search import (
     _OFFLINE_BONUS, _EPS_IMPROVEMENT, ExclusionMasks, SearchConfig,
     _per_broker_top_replicas, apply_selected, reduce_per_source,
@@ -177,18 +177,30 @@ def _chain_round_local(state: ClusterTensors, agg, masks: ExclusionMasks,
     if _GLOBAL_THETA and num_shards > 1:
         weight = _global_source_threshold(weight, src_score, state, k_src)
 
-    # Targeted-destination column (Goal.target_dests): aux/derived
-    # aggregates are replicated, card ranks are device-local — devices
-    # fill the same deficit profile independently, so cross-device
-    # overfill of one destination is possible and is vetoed by the joint
-    # acceptance recheck below (same contract as the conflict rules).
+    # Targeted-destination column (Goal.target_dests): DISABLED on multi-
+    # device meshes. Card fill ranks are device-local, so every device
+    # computes the SAME fill positions against the same replicated
+    # deficit/headroom profile and all shards converge their targeted
+    # cards on identical destinations — measured at 1k/8dev this drops
+    # balancedness 86.0 → 74.5 and violates three extra resource goals
+    # (the joint recheck bounds each goal's own band but cannot repair
+    # the wasted per-round throughput). A shard-offset fill (rank +
+    # shard * k/num_shards) is the known next step.
+    # Scale gate on the GLOBAL partition count (p_local * num_shards):
+    # the threshold's measured meaning is cluster scale, and a future
+    # shard-offset fill that drops the num_shards == 1 conjunct must not
+    # silently re-enable targets at north-star scale via the smaller
+    # per-shard count.
     extra = None
-    if TARGET_DESTS_ON:
+    if targets_enabled(p_global) and num_shards == 1:
         cand_p, cand_s, src_valid = select_sources(state, src_score, weight,
                                                    k_src)
-        extra = _switch_target_dests(active_idx, goals, aux_list, state,
-                                     derived, constraint, cand_p, cand_s,
-                                     src_valid)
+        t_dst, t_ok = _switch_target_dests(active_idx, goals, aux_list,
+                                           state, derived, constraint,
+                                           cand_p, cand_s, src_valid)
+        # Targets pause while any offline replica exists ANYWHERE on the
+        # mesh (psum'd below via offline_pb; see chain._chain_round_body).
+        extra = (t_dst, t_ok & ~(_psum(off.sum()) > 0))
     cand, layout = generate_candidates(state, derived, src_score, dst_score,
                                        weight, k_src, cfg.num_dests,
                                        include_leadership=True,
@@ -224,7 +236,9 @@ def _chain_round_local(state: ClusterTensors, agg, masks: ExclusionMasks,
                     jnp.maximum(imp, 0.0) + _OFFLINE_BONUS, imp)
     score = jnp.where(accept, imp, -jnp.inf)
 
-    red_idx = reduce_per_source(score, layout, row_offset=shard * k_src)
+    red_idx = reduce_per_source(
+        score, layout, row_offset=shard * k_src,
+        extra_last_col=targets_enabled(p_global) and num_shards == 1)
     k_local = red_idx.shape[0]
 
     def gather(x):

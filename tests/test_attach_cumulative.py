@@ -1,0 +1,100 @@
+"""Equivalence oracle for the two attach_cumulative implementations.
+
+The O(m²) pairwise-matmul form is the reference semantics
+(candidates.attach_cumulative's original body); the O(m log m)
+sorted-segment form must produce the same pre_* fields and has_earlier
+mask up to f32 reassociation on random rank-ordered batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.candidates import (
+    CandidateDeltas, attach_cumulative_segments,
+)
+
+_PRE_FIELDS = [
+    "pre_src_load", "pre_dst_load", "pre_src_count", "pre_dst_count",
+    "pre_src_leaders", "pre_dst_leaders", "pre_src_topic_count",
+    "pre_dst_topic_count", "pre_src_topic_leaders", "pre_dst_pot",
+    "pre_dst_lbi",
+]
+
+
+def _matmul_reference(sub, considered, pot_delta, lbi_delta):
+    """The original [m, m] mask-matmul attach_cumulative, inlined as the
+    oracle so the production dispatcher can default to segments."""
+    m = sub.partition.shape[0]
+    idx = jnp.arange(m)
+    earlier = (idx[:, None] > idx[None, :]) & considered[None, :]
+    same_dst = earlier & (sub.dst_broker[:, None] == sub.dst_broker[None, :])
+    same_src = earlier & (sub.src_broker[:, None] == sub.src_broker[None, :])
+    cross_sd = earlier & (sub.src_broker[:, None] == sub.dst_broker[None, :])
+    cross_ds = earlier & (sub.dst_broker[:, None] == sub.src_broker[None, :])
+    same_topic = sub.topic[:, None] == sub.topic[None, :]
+    f32 = jnp.float32
+    rep = sub.replica_delta.astype(f32)
+    lead = sub.leader_delta.astype(f32)
+    r = sub.load_delta.shape[1]
+    src_vals = jnp.concatenate(
+        [sub.load_delta, rep[:, None], lead[:, None]], axis=1)
+    dst_vals = jnp.concatenate(
+        [sub.load_delta, rep[:, None], lead[:, None], pot_delta[:, None],
+         lbi_delta[:, None]], axis=1)
+    src_out = same_src.astype(f32) @ src_vals
+    dst_out = same_dst.astype(f32) @ dst_vals
+    st_out = (same_src & same_topic).astype(f32) @ jnp.stack(
+        [rep, lead], axis=1)
+    dt_count = ((same_dst & same_topic).astype(f32) @ rep[:, None])[:, 0]
+    has_earlier = (same_dst | same_src | cross_sd | cross_ds).any(axis=1)
+    return dataclasses.replace(
+        sub, pre_src_load=src_out[:, :r], pre_dst_load=dst_out[:, :r],
+        pre_src_count=src_out[:, r], pre_dst_count=dst_out[:, r],
+        pre_src_leaders=src_out[:, r + 1], pre_dst_leaders=dst_out[:, r + 1],
+        pre_src_topic_count=st_out[:, 0], pre_dst_topic_count=dt_count,
+        pre_src_topic_leaders=st_out[:, 1], pre_dst_pot=dst_out[:, r + 2],
+        pre_dst_lbi=dst_out[:, r + 3]), has_earlier
+
+
+def _random_batch(rng, m, b, t):
+    kind_move = rng.random(m) < 0.8
+    return CandidateDeltas(
+        src_broker=jnp.asarray(rng.integers(0, b, m), jnp.int32),
+        dst_broker=jnp.asarray(rng.integers(0, b, m), jnp.int32),
+        load_delta=jnp.asarray(rng.random((m, 4)), jnp.float32),
+        replica_delta=jnp.asarray(kind_move, jnp.int32),
+        leader_delta=jnp.asarray(rng.random(m) < 0.5, jnp.int32),
+        partition=jnp.asarray(rng.integers(0, 10 * m, m), jnp.int32),
+        topic=jnp.asarray(rng.integers(0, t, m), jnp.int32),
+        src_slot=jnp.zeros(m, jnp.int32),
+        dst_slot=jnp.zeros(m, jnp.int32),
+        valid=jnp.asarray(rng.random(m) < 0.9),
+    )
+
+
+@pytest.mark.parametrize("m,b,t,seed", [
+    (64, 5, 3, 0),       # dense broker collisions
+    (256, 40, 11, 1),
+    (512, 1000, 700, 2),  # sparse: most groups singleton
+    (333, 7, 2, 3),       # odd size, heavy topic collisions
+])
+def test_segment_matches_matmul(m, b, t, seed):
+    rng = np.random.default_rng(seed)
+    sub = _random_batch(rng, m, b, t)
+    considered = jnp.asarray(rng.random(m) < 0.7)
+    pot = jnp.asarray(rng.random(m), jnp.float32)
+    lbi = jnp.asarray(rng.random(m), jnp.float32)
+
+    ref, he_ref = _matmul_reference(sub, considered, pot, lbi)
+    seg, he_seg = attach_cumulative_segments(sub, considered, pot, lbi)
+
+    np.testing.assert_array_equal(np.asarray(he_ref), np.asarray(he_seg))
+    for f in _PRE_FIELDS:
+        np.testing.assert_allclose(
+            np.asarray(getattr(ref, f)), np.asarray(getattr(seg, f)),
+            rtol=1e-5, atol=1e-4, err_msg=f)
