@@ -1,0 +1,126 @@
+"""Diagnose TopicReplicaDistribution's accepted-moves-per-round density.
+
+Runs the chain up to (but not including) TopicReplica with the per-goal
+chain kernels, then single-steps TR rounds and histograms where the 2048
+candidate slots go: invalid cards, vetoed by which prior goal's
+acceptance, lost to the active goal's non-positive improvement, dropped
+by per-partition dedup, or rejected by the joint recheck.
+
+    JAX_PLATFORMS=cpu python tools/diag_tr_density.py [brokers] [partitions] [rounds]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/cc_tpu_jax_cache")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    num_brokers = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+    num_partitions = int(sys.argv[2]) if len(sys.argv) > 2 else 200_000
+    diag_rounds = int(sys.argv[3]) if len(sys.argv) > 3 else 3
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cruise_control_tpu import enable_persistent_compile_cache
+    enable_persistent_compile_cache()
+    from cruise_control_tpu.analyzer.chain import optimize_goal_in_chain
+    from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+    from cruise_control_tpu.analyzer.optimizer import (
+        GoalOptimizer, goals_by_priority,
+    )
+    from cruise_control_tpu.analyzer.search import (
+        ExclusionMasks, score_round_candidates, reduce_per_source,
+        cumulative_select, apply_selected,
+    )
+    from cruise_control_tpu.analyzer.candidates import compute_deltas
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.model.fixtures import Dist, random_cluster
+
+    state, meta = random_cluster(
+        num_brokers=num_brokers, num_topics=max(8, num_brokers // 10),
+        num_partitions=num_partitions, rf=3, num_racks=8,
+        dist=Dist.EXPONENTIAL, seed=42, skew_to_first=2.0,
+        target_utilization=0.55)
+    cfg = CruiseControlConfig()
+    opt = GoalOptimizer(cfg)
+    goals = tuple(goals_by_priority(cfg))
+    constraint = BalancingConstraint.from_config(cfg)
+
+    scfg = opt.search_config(state)
+    wide = opt._widen(scfg, num_brokers)
+    masks = ExclusionMasks()
+    tr_idx = next(i for i, g in enumerate(goals)
+                  if g.name == "TopicReplicaDistributionGoal")
+
+    t0 = time.time()
+    for i in range(tr_idx):
+        state, info = optimize_goal_in_chain(
+            state, goals, i, constraint,
+            wide if goals[i].prefers_wide_batches else scfg,
+            meta.num_topics, masks)
+    print(f"pre-TR chain done in {time.time() - t0:.1f}s", flush=True)
+
+    goal = goals[tr_idx]
+    prior = tuple(goals[:tr_idx])
+
+    for rnd in range(diag_rounds):
+        cand, deltas, score, layout, (derived, aux, aux_by) = \
+            score_round_candidates(state, masks, goal, prior, constraint,
+                                   wide, meta.num_topics)
+        # Per-prior-goal veto counts over VALID cards.
+        valid = np.asarray(deltas.valid)
+        n = valid.size
+        print(f"--- round {rnd}: grid {n} cards, valid {valid.sum()}")
+        acc = np.ones(n, bool)
+        for g in prior:
+            a = np.asarray(g.acceptance(state, derived, constraint,
+                                        aux_by[g.name], deltas))
+            newly = (acc & ~a & valid).sum()
+            acc &= a
+            if newly:
+                print(f"    vetoed by {g.name}: {newly}")
+        imp = np.asarray(goal.improvement(state, derived, constraint, aux,
+                                          deltas))
+        pos = valid & acc & np.isfinite(imp) & (imp > 1e-9)
+        print(f"    valid+accepted {int((valid & acc).sum())}, "
+              f"positive-improvement {int(pos.sum())}")
+
+        red_idx = np.asarray(reduce_per_source(score, layout))
+        red_score = np.asarray(score)[red_idx]
+        good_rows = np.isfinite(red_score) & (red_score > 1e-9)
+        print(f"    rows with a usable winner: {int(good_rows.sum())} "
+              f"of {red_idx.size}")
+
+        def recheck(sub, has_earlier):
+            a = jnp.ones(sub.valid.shape[0], dtype=bool)
+            for g in prior:
+                a &= g.acceptance(state, derived, constraint,
+                                  aux_by[g.name], sub)
+            a &= (~has_earlier) | goal.acceptance(state, derived, constraint,
+                                                  aux, sub)
+            return a
+
+        m = max(wide.moves_per_round, wide.num_sources)
+        top_idx, sel, _sub, _pot, _lbi = cumulative_select(
+            state, deltas, score, layout, m, wide.moves_per_round,
+            False, recheck,
+            extra_last_col=True)
+        sel_np = np.asarray(sel)
+        print(f"    selected after dedup+recheck: {int(sel_np.sum())}")
+        state = apply_selected(
+            state, sel, deltas.partition[top_idx], deltas.src_slot[top_idx],
+            deltas.dst_broker[top_idx], cand.kind[top_idx],
+            cand.dst_slot[top_idx])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
