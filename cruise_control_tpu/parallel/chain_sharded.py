@@ -236,8 +236,16 @@ def _chain_round_local(state: ClusterTensors, agg, masks: ExclusionMasks,
                     jnp.maximum(imp, 0.0) + _OFFLINE_BONUS, imp)
     score = jnp.where(accept, imp, -jnp.inf)
 
+    # Device-decorrelating rotation offset: with thin per-device slices
+    # different devices should lean toward different destinations among
+    # ties; with FULL-width grids each device already holds distinct
+    # (local) sources. Measured at 1k/8dev: zeroing the offset
+    # (CC_MESH_ROT=flat) is neutral — 649 vs 667 rounds at identical
+    # quality — so the offset stays (it strictly helps thinner widths).
+    rot_offset = 0 if os.environ.get("CC_MESH_ROT") == "flat" \
+        else shard * k_src
     red_idx = reduce_per_source(
-        score, layout, row_offset=shard * k_src,
+        score, layout, row_offset=rot_offset,
         extra_last_col=targets_enabled(p_global) and num_shards == 1)
     k_local = red_idx.shape[0]
 
