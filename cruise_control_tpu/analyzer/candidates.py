@@ -435,10 +435,18 @@ def attach_cumulative_segments(sub: CandidateDeltas, considered: jax.Array,
     ), has_earlier
 
 
-# Cumulative pre-delta implementation: "segment" (O(m log m) sort-based,
-# default) or "matmul" ([m, m] pairwise masks — the MXU-friendly form,
-# kept selectable for TPU experiments and as the equivalence oracle).
-_ATTACH_IMPL = os.environ.get("CC_ATTACH", "segment")
+# Cumulative pre-delta implementation: "segment" (O(m log m) sort-based)
+# or "matmul" ([m, m] pairwise masks — the MXU-friendly form and the
+# equivalence oracle). Default is BACKEND-AWARE, decided lazily at trace
+# time (the backend is not known at import): segment on CPU (measured
+# −13% TopicReplica round cost at 7k), matmul on accelerators (the MXU
+# eats [m, m] matmuls; device-side sorts are comparatively slow and the
+# segment form is unmeasured on the chip). CC_ATTACH overrides.
+def _attach_impl() -> str:
+    impl = os.environ.get("CC_ATTACH")
+    if impl:
+        return impl
+    return "segment" if jax.default_backend() == "cpu" else "matmul"
 
 
 def attach_cumulative(sub: CandidateDeltas, considered: jax.Array,
@@ -461,7 +469,7 @@ def attach_cumulative(sub: CandidateDeltas, considered: jax.Array,
     marks candidates sharing a src or dst broker with an earlier considered
     candidate (the first candidate per broker keeps single-candidate
     acceptance semantics)."""
-    if _ATTACH_IMPL == "segment":
+    if _attach_impl() == "segment":
         return attach_cumulative_segments(sub, considered, pot_delta,
                                           lbi_delta)
     m = sub.partition.shape[0]
