@@ -128,23 +128,38 @@ def _goal_flags(goals: tuple[Goal, ...]):
     return lead_only, incl_lead, indep
 
 
-def _switch_scores(active_idx, goals, aux_list, state, derived, constraint):
-    """(src_score[B], dst_score[B], weight[P,S]) of the active goal."""
-
+def _switch_goal_fn(active_idx, goals, fn):
+    """``lax.switch`` over the goal index: run ``fn(goal, i)`` for the
+    ACTIVE goal only (all branches traced once, one executed). The shared
+    scaffolding for every per-goal dispatch in the chain kernels."""
     def branch(i):
-        g = goals[i]
-
-        def fn(_):
-            a = aux_list[i]
-            return (g.source_score(state, derived, constraint, a)
-                    .astype(jnp.float32),
-                    g.dest_score(state, derived, constraint, a)
-                    .astype(jnp.float32),
-                    g.replica_weight(state, derived, constraint, a)
-                    .astype(jnp.float32))
-        return fn
+        def run(_):
+            return fn(goals[i], i)
+        return run
 
     return jax.lax.switch(active_idx, [branch(i) for i in range(len(goals))], 0)
+
+
+def _switch_scores(active_idx, goals, aux_list, state, derived, constraint):
+    """(src_score[B], dst_score[B], weight[P,S]) of the active goal."""
+    return _switch_goal_fn(
+        active_idx, goals,
+        lambda g, i: (g.source_score(state, derived, constraint, aux_list[i])
+                      .astype(jnp.float32),
+                      g.dest_score(state, derived, constraint, aux_list[i])
+                      .astype(jnp.float32),
+                      g.replica_weight(state, derived, constraint,
+                                       aux_list[i]).astype(jnp.float32)))
+
+
+def _switch_swap_dest_score(active_idx, goals, aux_list, state, derived,
+                            constraint):
+    """[B] swap counterparty score of the active goal (shared by the
+    single-device and sharded swap bodies)."""
+    return _switch_goal_fn(
+        active_idx, goals,
+        lambda g, i: g.swap_dest_score(state, derived, constraint,
+                                       aux_list[i]).astype(jnp.float32))
 
 
 def _switch_target_dests(active_idx, goals, aux_list, state, derived,
@@ -328,8 +343,10 @@ def _chain_swap_body(state: ClusterTensors, agg: "AggCarry | None",
     aux_list = [_gated_aux(prior_mask[i] | is_active[i], g, state, derived,
                            constraint, num_topics, agg=agg)
                 for i, g in enumerate(goals)]
-    src_score, dst_score, weight = _switch_scores(
+    src_score, _dst_score, weight = _switch_scores(
         active_idx, goals, aux_list, state, derived, constraint)
+    dst_score = _switch_swap_dest_score(active_idx, goals, aux_list, state,
+                                        derived, constraint)
 
     fwd, rev, net, p1, s1, p2, s2, src_b, dst_b, base_valid = swap_grid(
         state, derived, src_score, dst_score, weight)
@@ -343,8 +360,9 @@ def _chain_swap_body(state: ClusterTensors, agg: "AggCarry | None",
         g = goals[i]
 
         def fn(_):
-            return g.improvement(state, derived, constraint, aux_list[i],
-                                 net).astype(jnp.float32)
+            return g.swap_improvement(state, derived, constraint,
+                                      aux_list[i], fwd, rev,
+                                      net).astype(jnp.float32)
         return fn
 
     imp = jax.lax.switch(active_idx,

@@ -148,6 +148,28 @@ class Goal:
         solver, so per-partition gathers are out of bounds there."""
         return jnp.ones(net.valid.shape[0], dtype=bool)
 
+    def swap_improvement(self, state, derived, constraint, aux,
+                         fwd: CandidateDeltas, rev: CandidateDeltas,
+                         net: CandidateDeltas) -> jax.Array:
+        """[N] — decrease of this goal's objective if the SWAP is applied.
+        Default: ``improvement`` on the net transfer (sufficient for
+        totals-judged goals, where a swap is the signed net move).
+        Structural goals whose objective lives on BOTH legs — e.g. the
+        kafka-assigner even-rack goal, where each leg can fix or create a
+        rack duplicate while the net transfer moves no replica — override
+        this to score the legs (the reference's swap inner loop evaluates
+        the exchange as a pair, KafkaAssignerEvenRackAwareGoal.java)."""
+        return self.improvement(state, derived, constraint, aux, net)
+
+    def swap_dest_score(self, state, derived, constraint, aux) -> jax.Array:
+        """[B] — counterparty attractiveness for the SWAP grid. Default:
+        ``dest_score``. Goals whose move destinations exclude exactly the
+        brokers swaps exist to reach (the even-rack goal's dest_score
+        drops over-ceiling brokers, but a count-preserving exchange WANTS
+        the over-ceiling broker holding the replica to take back)
+        override this."""
+        return self.dest_score(state, derived, constraint, aux)
+
     def swap_acceptance(self, state, derived, constraint, aux,
                         fwd: CandidateDeltas, rev: CandidateDeltas,
                         net: CandidateDeltas) -> jax.Array:

@@ -41,6 +41,14 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
 
     name: str = "KafkaAssignerEvenRackAwareGoal"
     is_hard: bool = True
+    # The reference's inner loop is SWAP-based (per-position exchanges
+    # that never disturb per-broker counts); the move search covers most
+    # shapes, but max-tight layouts (a rack at exactly B/RF brokers)
+    # need a count-preserving exchange: a duplicate leaves its crowded
+    # rack for an at-ceiling broker whose own movable replica returns to
+    # the freed under-ceiling broker. See swap_improvement/
+    # swap_dest_score below.
+    supports_swap: bool = True
 
     def _ceiling(self, derived) -> jnp.ndarray:
         total = (derived.broker_replicas * derived.alive).sum()
@@ -77,11 +85,7 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
         # replicas atomically; here the overshoot leg is only admitted
         # where the shed leg exists, so the two-step path stays live.
         fixes_dup = _duplicate_mask(state)[deltas.partition, deltas.src_slot]
-        _dup_ok, shed_ok = self._rack_dest_feasibility(state, derived)
-        b = state.num_brokers
-        seg = jnp.where(state.assignment >= 0, state.assignment, b)
-        has_shed = jnp.zeros(b + 1, jnp.int32).at[seg].add(
-            shed_ok.astype(jnp.int32))[:b] > 0
+        has_shed = self._has_shed_per_broker(state, derived)
         tolerant = fixes_dup & (dst_after <= cap + 1) \
             & (under_cap | has_shed[deltas.dst_broker])
         is_move = deltas.replica_delta > 0
@@ -118,6 +122,46 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
         # of kafka-assigner mode).
         return RackAwareGoal.acceptance(self, state, derived, constraint,
                                         aux, leg)
+
+    def swap_improvement(self, state, derived, constraint, aux,
+                         fwd, rev, net):
+        # Each directional leg judged as a rack move (duplicate fixed
+        # minus conflict created); counts are swap-invariant so the even
+        # ceiling needs no term. A swap that fixes one duplicate while
+        # creating another sums to 0 and is never applied.
+        imp_f = RackAwareGoal.improvement(self, state, derived, constraint,
+                                          aux, fwd)
+        imp_r = RackAwareGoal.improvement(self, state, derived, constraint,
+                                          aux, rev)
+        both = jnp.where(jnp.isfinite(imp_f), imp_f, 0.0) \
+            + jnp.where(jnp.isfinite(imp_r), imp_r, 0.0)
+        return jnp.where(net.valid, both, -jnp.inf)
+
+    def swap_dest_score(self, state, derived, constraint, aux):
+        # Counterparties for the exchange: over-ceiling brokers first
+        # (they hold overage an exchange pulls back), then at-ceiling
+        # brokers WITH a shed channel (a hosted replica that can move
+        # into an under-ceiling rack without creating a duplicate — the
+        # replica the reverse leg sends back). dest_score would exclude
+        # them all (room <= 0), which is exactly why moves alone stall on
+        # max-tight layouts.
+        over = jnp.maximum(
+            derived.broker_replicas - self._ceiling(derived), 0
+        ).astype(jnp.float32)
+        has_shed = self._has_shed_per_broker(state, derived) \
+            .astype(jnp.float32)
+        ok = derived.allowed_replica_move & derived.alive
+        return jnp.where(ok, 2.0 * over + has_shed + 0.1, -jnp.inf)
+
+    def _has_shed_per_broker(self, state, derived):
+        """[B] bool — broker hosts at least one replica with a feasible
+        rack-compatible strictly-under-cap destination (the shed
+        channel); shared by the overshoot guard and swap_dest_score."""
+        _dup_ok, shed_ok = self._rack_dest_feasibility(state, derived)
+        b = state.num_brokers
+        seg = jnp.where(state.assignment >= 0, state.assignment, b)
+        return jnp.zeros(b + 1, jnp.int32).at[seg].add(
+            shed_ok.astype(jnp.int32))[:b] > 0
 
     def _rack_dest_feasibility(self, state, derived):
         """([P, S] dup-feasible, [P, S] shed-feasible): does a
@@ -189,6 +233,12 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
         on_over = jnp.concatenate([over, jnp.array([False])])[
             jnp.where(state.assignment >= 0, state.assignment, b)]
         w = jnp.where(replica_exists(state), peak - load, -jnp.inf)
+        # Shed-feasible replicas on NON-over brokers rank LIGHTEST: they
+        # are the replicas the swap grid's light-side selection must
+        # offer as the exchange's reverse leg (at-ceiling counterparties,
+        # swap_dest_score). Move-grid sources are unaffected — their
+        # brokers have zero violations, so on_source excludes them.
+        w = jnp.where(shed_ok & ~on_over & ~dup, 0.5 * peak - load, w)
         w = jnp.where(on_over & shed_ok & ~dup, 3 * peak + load, w)
         w = jnp.where(dup & dup_ok, 5 * peak + load, w)
         return jnp.where(dup & ~dup_ok, peak + load, w)

@@ -507,7 +507,7 @@ def swap_round_candidates(state: ClusterTensors, masks: ExclusionMasks,
                    for g in optimized}
 
     src_score = goal.source_score(state, derived, constraint, aux)
-    dst_score = goal.dest_score(state, derived, constraint, aux)
+    dst_score = goal.swap_dest_score(state, derived, constraint, aux)
     weight = goal.replica_weight(state, derived, constraint, aux)
 
     fwd, rev, net, p1, s1, p2, s2, src_b, dst_b, base_valid = swap_grid(
@@ -516,7 +516,8 @@ def swap_round_candidates(state: ClusterTensors, masks: ExclusionMasks,
     for g in optimized:
         accept &= g.swap_acceptance(state, derived, constraint,
                                     aux_by_goal[g.name], fwd, rev, net)
-    imp = goal.improvement(state, derived, constraint, aux, net)
+    imp = goal.swap_improvement(state, derived, constraint, aux, fwd, rev,
+                                net)
     score = jnp.where(accept, imp, -jnp.inf)
     return score, p1, s1, p2, s2, src_b, dst_b
 

@@ -42,7 +42,8 @@ from ..analyzer.agg import (
 )
 from ..analyzer.chain import (
     _chain_infos_from_stats, _gated_aux, _goal_flags, _switch_scores,
-    _switch_target_dests, excluded_hosting_replicas,
+    _switch_swap_dest_score, _switch_target_dests,
+    excluded_hosting_replicas,
 )
 from ..analyzer.constraint import BalancingConstraint
 from ..analyzer.derived import compute_derived
@@ -352,9 +353,18 @@ def _chain_swap_local(state: ClusterTensors, agg, masks: ExclusionMasks,
                               masks.excluded_replica_move_brokers,
                               masks.excluded_leadership_brokers, psum=_psum,
                               agg=agg)
-    aux_list, src_score, dst_score, weight = _chain_scores(
+    aux_list, src_score, _dst_score, weight = _chain_scores(
         state, derived, active_idx, prior_mask, goals, constraint,
         num_topics, additive_f, agg=agg)
+
+    # Swap counterparties rank by swap_dest_score (broker-indexed, mesh-
+    # safe). NOTE: swap IMPROVEMENT on the mesh stays net-transfer-based
+    # (goal.improvement(net)) — leg-scored overrides (swap_improvement)
+    # need the legs' partition-local state, which lives on the owning
+    # device; the kafka-assigner tool mode that relies on leg scoring
+    # runs single-device.
+    dst_score = _switch_swap_dest_score(active_idx, goals, aux_list, state,
+                                        derived, constraint)
 
     k = min(k_brokers, b)
     src_vals, src_brokers = jax.lax.top_k(

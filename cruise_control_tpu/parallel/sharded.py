@@ -205,7 +205,11 @@ def _swap_round_local(state: ClusterTensors, masks: ExclusionMasks, *, goal,
     src_score = goal.source_score(state, derived, constraint, aux)
     if goal.partition_additive_scores:
         src_score = _psum(src_score)
-    dst_score = goal.dest_score(state, derived, constraint, aux)
+    # Swap counterparties rank by swap_dest_score (broker-indexed, mesh-
+    # safe) — consistent with the chain swap bodies. Leg-scored swap
+    # IMPROVEMENT overrides still stay single-device (see
+    # chain_sharded._chain_swap_local).
+    dst_score = goal.swap_dest_score(state, derived, constraint, aux)
     weight = goal.replica_weight(state, derived, constraint, aux)
 
     k = min(k_brokers, b)

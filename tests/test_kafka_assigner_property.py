@@ -51,13 +51,14 @@ _B, _T, _P, _RF, _RACKS = 18, 6, 361, 2, 4
 #
 # MAX-TIGHT layouts — a 9-broker rack is exactly B/RF, so that rack must
 # absorb one replica of (almost) every partition — are the enumerated
-# residual gap of the r5 deadlock work: the overshoot-guarded greedy
-# still stalls at residual ≤ 2 on some seeds (one unshed duplicate),
-# where the reference's swap inner loop exchanges the two replicas
-# atomically (KafkaAssignerEvenRackAwareGoal.java per-position swaps).
-# They run as xfail(strict=False): a loud OptimizationFailureError is the
-# documented behavior until a swap/exchange kernel lands
-# (docs/DESIGN.md known limits).
+# residual gap of the r5 deadlock work. With the count-preserving swap
+# exchange (r5) the rack duplicates now fully resolve; the remaining
+# stall shape on some seeds is a SINGLE ceiling+1 count overage stranded
+# on a broker whose shed channel was consumed by the same round's batch
+# (residual ≤ 2, loudly reported). The known fix is an overage-relay
+# move (the overage hops to an at-ceiling broker that still has a shed
+# channel) — it needs a termination argument, since relays can cycle.
+# These run as xfail(strict=False) until that lands (docs/DESIGN.md).
 _LAYOUTS = [
     (9, 5, 3, 1),   # max-tight
     (8, 6, 3, 1),
@@ -91,9 +92,10 @@ def _run(seed: int, layout: tuple[int, ...]):
     "seed,layout",
     [pytest.param(s, lo,
                   marks=[pytest.mark.xfail(
-                      reason="max-tight rack layout: greedy + overshoot "
-                      "guard may stall at residual ≤ 2 (needs the "
-                      "reference's atomic swap exchange); fails LOUDLY",
+                      reason="max-tight rack layout: a single ceiling+1 "
+                      "overage can strand on a shed-less broker (rack "
+                      "duplicates fully resolve via the swap exchange); "
+                      "fails LOUDLY — needs an overage-relay move",
                       strict=False)] if lo in _MAX_TIGHT else [])
      for s in (3, 11, 29) for lo in _LAYOUTS])
 def test_even_rack_skewed_layout_sweep(seed, layout):
