@@ -85,9 +85,16 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
         # replicas atomically; here the overshoot leg is only admitted
         # where the shed leg exists, so the two-step path stays live.
         fixes_dup = _duplicate_mask(state)[deltas.partition, deltas.src_slot]
-        has_shed = self._has_shed_per_broker(state, derived)
+        shed_count = self._shed_count_per_broker(state, derived)
+        # COUNT-matched, not boolean: each same-round overshoot onto a
+        # broker must claim a DISTINCT shed channel (pre_dst_count is the
+        # cumulative same-round inflow, conservatively overcounted), else
+        # two overshoots can share one channel and strand a ceiling+1
+        # overage on a broker that can no longer shed.
         tolerant = fixes_dup & (dst_after <= cap + 1) \
-            & (under_cap | has_shed[deltas.dst_broker])
+            & (under_cap
+               | (deltas.pre0("pre_dst_count")
+                  < shed_count[deltas.dst_broker]))
         is_move = deltas.replica_delta > 0
         return rack_ok & jnp.where(is_move, under_cap | tolerant, True)
 
@@ -138,30 +145,34 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
         return jnp.where(net.valid, both, -jnp.inf)
 
     def swap_dest_score(self, state, derived, constraint, aux):
-        # Counterparties for the exchange: over-ceiling brokers first
-        # (they hold overage an exchange pulls back), then at-ceiling
-        # brokers WITH a shed channel (a hosted replica that can move
-        # into an under-ceiling rack without creating a duplicate — the
-        # replica the reverse leg sends back). dest_score would exclude
-        # them all (room <= 0), which is exactly why moves alone stall on
-        # max-tight layouts.
-        over = jnp.maximum(
-            derived.broker_replicas - self._ceiling(derived), 0
-        ).astype(jnp.float32)
-        has_shed = self._has_shed_per_broker(state, derived) \
-            .astype(jnp.float32)
-        ok = derived.allowed_replica_move & derived.alive
-        return jnp.where(ok, 2.0 * over + has_shed + 0.1, -jnp.inf)
+        # Counterparties for the exchange: AT-ceiling brokers with a shed
+        # channel (a hosted replica that can move into an under-ceiling
+        # rack without creating a duplicate — the replica the reverse leg
+        # sends back). dest_score would exclude them all (room <= 0),
+        # which is exactly why moves alone stall on max-tight layouts.
+        # OVER-ceiling brokers are EXCLUDED: a count-preserving exchange
+        # does nothing for their overage but consumes the very replica
+        # their shed needs (the measured strand: a ceiling+1 broker whose
+        # channel a swap ate). The SOURCE side needs no twin exclusion:
+        # move passes run to their fixed point before each swap pass, so
+        # a shed-feasible replica on an over broker (duplicate or not)
+        # has already been moved out as a plain shed/dup-fix before any
+        # swap could trade it away.
+        over = derived.broker_replicas > self._ceiling(derived)
+        has_shed = (self._shed_count_per_broker(state, derived) > 0
+                    ).astype(jnp.float32)
+        ok = derived.allowed_replica_move & derived.alive & ~over
+        return jnp.where(ok, has_shed + 0.1, -jnp.inf)
 
-    def _has_shed_per_broker(self, state, derived):
-        """[B] bool — broker hosts at least one replica with a feasible
-        rack-compatible strictly-under-cap destination (the shed
-        channel); shared by the overshoot guard and swap_dest_score."""
+    def _shed_count_per_broker(self, state, derived):
+        """[B] int32 — number of hosted replicas with a feasible
+        rack-compatible strictly-under-cap destination (shed channels);
+        shared by the overshoot guard and swap_dest_score."""
         _dup_ok, shed_ok = self._rack_dest_feasibility(state, derived)
         b = state.num_brokers
         seg = jnp.where(state.assignment >= 0, state.assignment, b)
         return jnp.zeros(b + 1, jnp.int32).at[seg].add(
-            shed_ok.astype(jnp.int32))[:b] > 0
+            shed_ok.astype(jnp.int32))[:b]
 
     def _rack_dest_feasibility(self, state, derived):
         """([P, S] dup-feasible, [P, S] shed-feasible): does a

@@ -109,6 +109,76 @@ def test_even_rack_skewed_layout_sweep(seed, layout):
         (layout, seed, reps.tolist())
 
 
+def test_swap_counterparty_and_overshoot_guard_semantics():
+    """Pin the r5 strand fixes directly (they are invisible to the sweep's
+    pass/xfail pattern): swap_dest_score must EXCLUDE over-ceiling
+    brokers (an exchange preserves their count but eats the replica
+    their shed needs), and the overshoot guard must be COUNT-matched —
+    same-round overshoots beyond a broker's distinct shed channels are
+    vetoed even though the boolean has-shed form would admit them."""
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer.derived import compute_derived
+    from cruise_control_tpu.analyzer.goals import (
+        KafkaAssignerEvenRackAwareGoal,
+    )
+    from cruise_control_tpu.model.builder import ClusterModelBuilder
+    from cruise_control_tpu.common.resources import Resource
+
+    cap = {Resource.CPU: 100.0, Resource.NW_IN: 1e5, Resource.NW_OUT: 1e5,
+           Resource.DISK: 1e6}
+    load = {Resource.CPU: 1.0, Resource.NW_IN: 10.0, Resource.NW_OUT: 10.0,
+            Resource.DISK: 100.0}
+    b = ClusterModelBuilder()
+    for i, rack in enumerate(["r0", "r0", "r1", "r2"]):
+        b.add_broker(i, rack, cap)
+    # Broker 0: 3 replicas (over the ceiling of ceil(8/4) = 2);
+    # brokers 1-3 at or under. Partition layouts leave broker 0 with
+    # movable replicas and give broker 2 a shed channel.
+    b.add_partition("t", 0, [0, 2], leader_load=load)
+    b.add_partition("t", 1, [0, 3], leader_load=load)
+    b.add_partition("t", 2, [0, 2], leader_load=load)
+    b.add_partition("t", 3, [1, 3], leader_load=load)
+    state, meta = b.build()
+    goal = KafkaAssignerEvenRackAwareGoal()
+    derived = compute_derived(state, None, None, None)
+
+    score = np.asarray(goal.swap_dest_score(state, derived, None, None))
+    counts = np.asarray(derived.broker_replicas)[:4]
+    ceiling = int(np.ceil(counts.sum() / 4))
+    over = counts > ceiling
+    assert over[0], "fixture must have an over-ceiling broker"
+    assert not np.isfinite(score[0]), \
+        "over-ceiling brokers must be excluded as swap counterparties"
+    assert np.isfinite(score[1:]).all()
+
+    shed = np.asarray(goal._shed_count_per_broker(state, derived))
+    assert shed.shape == (4,) and (shed >= 0).all()
+    # Count-matched guard: with pre_dst_count == shed_count the overshoot
+    # path must close even where the boolean form would stay open.
+    import dataclasses as dc
+
+    from cruise_control_tpu.analyzer.candidates import (
+        CandidateDeltas, compute_deltas, Candidates,
+    )
+    dst = int(np.argmax(shed))
+    if shed[dst] > 0:
+        cand = Candidates(kind=jnp.zeros(1, jnp.int8),
+                          partition=jnp.zeros(1, jnp.int32),
+                          src_slot=jnp.zeros(1, jnp.int32),
+                          dst_broker=jnp.asarray([dst], jnp.int32),
+                          dst_slot=jnp.zeros(1, jnp.int32),
+                          valid=jnp.ones(1, bool))
+        deltas = compute_deltas(state, derived, cand)
+        sat = dc.replace(deltas,
+                         pre_dst_count=jnp.asarray([float(shed[dst])]))
+        acc_sat = goal.acceptance(state, derived, None, None, sat)
+        fresh = dc.replace(deltas, pre_dst_count=jnp.zeros(1))
+        acc_fresh = goal.acceptance(state, derived, None, None, fresh)
+        # Saturated channels can only ever be MORE restrictive.
+        assert bool(np.asarray(acc_sat)[0]) <= bool(np.asarray(acc_fresh)[0])
+
+
 def test_even_rack_infeasible_layout_fails_loudly():
     """A 12-broker rack makes the even ceiling + strict rack-awareness
     jointly unsatisfiable (see module docstring); the hard goal must
