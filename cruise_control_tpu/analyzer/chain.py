@@ -163,17 +163,22 @@ def _switch_swap_dest_score(active_idx, goals, aux_list, state, derived,
 
 
 def _switch_target_dests(active_idx, goals, aux_list, state, derived,
-                         constraint, cand_p, cand_s, src_valid):
+                         constraint, cand_p, cand_s, src_valid,
+                         rank_stride: int = 1, rank_offset=0):
     """The active goal's targeted-destination column (Goal.target_dests,
     analyzer.fill) — goals without a rule contribute an all-invalid
-    column so every branch returns the same shapes."""
+    column so every branch returns the same shapes. ``rank_stride``/
+    ``rank_offset`` interleave per-device fill positions on a mesh (see
+    Goal.target_dests)."""
 
     def branch(i):
         g = goals[i]
 
         def fn(_):
             td = g.target_dests(state, derived, constraint, aux_list[i],
-                                cand_p, cand_s, src_valid)
+                                cand_p, cand_s, src_valid,
+                                rank_stride=rank_stride,
+                                rank_offset=rank_offset)
             if td is None:
                 return (jnp.zeros_like(cand_p),
                         jnp.zeros(cand_p.shape, dtype=bool))

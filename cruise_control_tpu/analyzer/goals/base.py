@@ -200,7 +200,8 @@ class Goal:
 
     def target_dests(self, state, derived, constraint, aux,
                      cand_p: jax.Array, cand_s: jax.Array,
-                     src_valid: jax.Array,
+                     src_valid: jax.Array, rank_stride: int = 1,
+                     rank_offset=0,
                      ) -> "tuple[jax.Array, jax.Array] | None":
         """Optional constructive per-card destination (analyzer.fill): for
         the selected source replicas ``(cand_p, cand_s)[k]``, return
@@ -208,7 +209,15 @@ class Goal:
         each card — or None when the goal has no per-card destination
         rule. The search appends the result as an extra column of the
         move grid; all acceptance/selection machinery applies unchanged,
-        so a targeted destination is a HINT, never a bypass."""
+        so a targeted destination is a HINT, never a bypass.
+
+        ``rank_stride``/``rank_offset`` map local fill ranks onto a
+        GLOBAL fill-position space (position = rank·stride + offset):
+        the partition-sharded mesh passes (num_shards, shard) so each
+        device claims an interleaved, collision-free slice of the shared
+        deficit/headroom profile — without it every device fills the
+        same positions and the targeted column collapses mesh quality
+        (measured r5). Single-device callers keep the identity (1, 0)."""
         return None
 
 

@@ -191,7 +191,8 @@ class ResourceDistributionGoal(Goal):
         return jnp.where(fits, peak + size, size)
 
     def target_dests(self, state, derived, constraint, aux,
-                     cand_p, cand_s, src_valid):
+                     cand_p, cand_s, src_valid, rank_stride=1,
+                     rank_offset=0):
         from ..fill import class_enabled
         if not class_enabled(self):
             return None
@@ -204,7 +205,8 @@ class ResourceDistributionGoal(Goal):
         lower, upper, _cap = self._limits(state, derived, constraint)
         headroom = upper - derived.broker_load[:, r]
         size = replica_load_column(state, r)[cand_p, cand_s]
-        dst, ok = best_fit_dests(size, exclusive_rank(src_valid), headroom,
+        rank = exclusive_rank(src_valid) * rank_stride + rank_offset
+        dst, ok = best_fit_dests(size, rank, headroom,
                                  _dest_eligible(derived) & (headroom > 0))
         return dst, ok & src_valid \
             & ~self._low_util(derived, constraint)
@@ -311,7 +313,8 @@ class CountDistributionGoal(Goal):
         return w
 
     def target_dests(self, state, derived, constraint, aux,
-                     cand_p, cand_s, src_valid):
+                     cand_p, cand_s, src_valid, rank_stride=1,
+                     rank_offset=0):
         from ..fill import class_enabled
         if not class_enabled(self):
             return None
@@ -323,8 +326,9 @@ class CountDistributionGoal(Goal):
         counts = self._counts(derived)
         deficit, headroom = _int_deficit_headroom(counts[None, :],
                                                   lower, upper)
+        rank = exclusive_rank(src_valid) * rank_stride + rank_offset
         dst, ok = deficit_fill_dests(
-            jnp.zeros_like(cand_p), exclusive_rank(src_valid), deficit,
+            jnp.zeros_like(cand_p), rank, deficit,
             headroom, _dest_eligible(derived))
         return dst, ok & src_valid
 
@@ -426,7 +430,8 @@ class TopicReplicaDistributionGoal(Goal):
         return jnp.where(replica_exists(state), w, -jnp.inf)
 
     def target_dests(self, state, derived, constraint, aux,
-                     cand_p, cand_s, src_valid):
+                     cand_p, cand_s, src_valid, rank_stride=1,
+                     rank_offset=0):
         from ..fill import class_enabled
         if not class_enabled(self):
             return None
@@ -443,7 +448,8 @@ class TopicReplicaDistributionGoal(Goal):
         t = state.topic[cand_p]
         deficit, headroom = _int_deficit_headroom(
             aux["counts"], aux["lower"][:, None], aux["upper"][:, None])
-        dst, ok = deficit_fill_dests(t, rank_within_group(t, src_valid),
+        rank = rank_within_group(t, src_valid) * rank_stride + rank_offset
+        dst, ok = deficit_fill_dests(t, rank,
                                      deficit, headroom,
                                      _dest_eligible(derived))
         return dst, ok & src_valid

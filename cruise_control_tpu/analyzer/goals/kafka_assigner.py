@@ -255,7 +255,8 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
         return jnp.where(dup & ~dup_ok, peak + load, w)
 
     def target_dests(self, state, derived, constraint, aux,
-                     cand_p, cand_s, src_valid):
+                     cand_p, cand_s, src_valid, rank_stride=1,
+                     rank_offset=0):
         from ..fill import class_enabled
         if not class_enabled(self):
             return None

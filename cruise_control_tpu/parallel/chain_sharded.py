@@ -178,27 +178,30 @@ def _chain_round_local(state: ClusterTensors, agg, masks: ExclusionMasks,
     if _GLOBAL_THETA and num_shards > 1:
         weight = _global_source_threshold(weight, src_score, state, k_src)
 
-    # Targeted-destination column (Goal.target_dests): DISABLED on multi-
-    # device meshes. Card fill ranks are device-local, so every device
-    # computes the SAME fill positions against the same replicated
-    # deficit/headroom profile and all shards converge their targeted
-    # cards on identical destinations — measured at 1k/8dev this drops
-    # balancedness 86.0 → 74.5 and violates three extra resource goals
-    # (the joint recheck bounds each goal's own band but cannot repair
-    # the wasted per-round throughput). A shard-offset fill (rank +
-    # shard * k/num_shards) is the known next step.
+    # Targeted-destination column (Goal.target_dests). Card fill ranks
+    # are device-local against a REPLICATED deficit/headroom profile, so
+    # a naive fill has every device claim the same positions — measured
+    # at 1k/8dev that drops balancedness 86.0 → 74.5 with three extra
+    # violated goals. The SHARD-OFFSET fill (device d's cards take
+    # interleaved global positions rank·num_shards + d, CC_MESH_TARGETS=1
+    # to enable) fixes the quality collapse — measured 86.0 with the
+    # violated set pinned — but buys NO round reduction (672 vs 667 at
+    # 1k/8dev: the mesh's round inflation lives in selection, not
+    # destination starvation), so the default keeps the targeted branch
+    # off the mesh and its per-round cost with it.
     # Scale gate on the GLOBAL partition count (p_local * num_shards):
-    # the threshold's measured meaning is cluster scale, and a future
-    # shard-offset fill that drops the num_shards == 1 conjunct must not
-    # silently re-enable targets at north-star scale via the smaller
-    # per-shard count.
+    # the threshold's measured meaning is cluster scale.
     extra = None
-    if targets_enabled(p_global) and num_shards == 1:
+    use_targets = targets_enabled(p_global) and (
+        num_shards == 1 or os.environ.get("CC_MESH_TARGETS") == "1")
+    if use_targets:
         cand_p, cand_s, src_valid = select_sources(state, src_score, weight,
                                                    k_src)
         t_dst, t_ok = _switch_target_dests(active_idx, goals, aux_list,
                                            state, derived, constraint,
-                                           cand_p, cand_s, src_valid)
+                                           cand_p, cand_s, src_valid,
+                                           rank_stride=num_shards,
+                                           rank_offset=shard)
         # Targets pause while any offline replica exists ANYWHERE on the
         # mesh (psum'd below via offline_pb; see chain._chain_round_body).
         extra = (t_dst, t_ok & ~(_psum(off.sum()) > 0))
@@ -246,8 +249,7 @@ def _chain_round_local(state: ClusterTensors, agg, masks: ExclusionMasks,
     rot_offset = 0 if os.environ.get("CC_MESH_ROT") == "flat" \
         else shard * k_src
     red_idx = reduce_per_source(
-        score, layout, row_offset=rot_offset,
-        extra_last_col=targets_enabled(p_global) and num_shards == 1)
+        score, layout, row_offset=rot_offset, extra_last_col=use_targets)
     k_local = red_idx.shape[0]
 
     def gather(x):
