@@ -366,8 +366,10 @@ def _exclusive_group_prefix(keys: "tuple[jax.Array, ...]",
         ks = k[perm]
         is_start = is_start | jnp.concatenate(
             [jnp.array([True]), ks[1:] != ks[:-1]])
-    start_pos = jnp.maximum.accumulate(
-        jnp.where(is_start, jnp.arange(m), 0))
+    # lax.cummax, not jnp.maximum.accumulate: jnp ufunc objects carry no
+    # .accumulate under jitted tracing on this jax line.
+    start_pos = jax.lax.cummax(
+        jnp.where(is_start, jnp.arange(m), 0), axis=0)
     excl = cs_prev - cs_prev[start_pos]
     return jnp.zeros_like(values).at[perm].set(excl)
 
@@ -413,8 +415,8 @@ def attach_cumulative_segments(sub: CandidateDeltas, considered: jax.Array,
     k_sorted = keys2[perm2]
     is_start = jnp.concatenate(
         [jnp.array([True]), k_sorted[1:] != k_sorted[:-1]])
-    start_pos = jnp.maximum.accumulate(
-        jnp.where(is_start, jnp.arange(2 * m), 0))
+    start_pos = jax.lax.cummax(
+        jnp.where(is_start, jnp.arange(2 * m), 0), axis=0)
     group_min = ranks2[perm2][start_pos]
     entry_min = jnp.zeros(2 * m, jnp.int32).at[perm2].set(group_min)
     has_earlier = (entry_min[:m] < idx) | (entry_min[m:] < idx)

@@ -195,9 +195,7 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
         n_under_by_rack = jnp.zeros(b, jnp.int32).at[rack_of].add(
             under.astype(jnp.int32))
         n_at_by_rack = jnp.zeros(b, jnp.int32).at[rack_of].add(
-            at.astype(jnp.int32))
-        has_under = n_under_by_rack > 0     # [B]-indexed by rack id
-        has_at = n_at_by_rack > 0
+            at.astype(jnp.int32))      # [B]-indexed by rack id
 
         racks = _slot_racks(state)          # [P, S]; empty slots negative
         exists = replica_exists(state)
@@ -207,20 +205,33 @@ class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
         first_occ = exists & ~(same & earlier).any(axis=2)
         safe_racks = jnp.clip(racks, 0, b - 1)
 
-        def feasible(has_room):
+        own_broker = jnp.where(state.assignment >= 0, state.assignment, b)
+
+        def feasible(room, n_by_rack):
             # (a) an unused rack with room: #rooms racks > #distinct used
             # rooms racks (used non-room racks never block an unused one).
+            has_room = n_by_rack > 0
             n_rooms = has_room.sum()
             used_rooms = (first_occ & has_room[safe_racks]).sum(axis=1)
             unused_rack = (n_rooms > used_rooms)[:, None]         # [P, 1]
-            # (b) own-rack relocation: this slot's rack has room and no
-            # OTHER slot of the partition shares it.
+            # (b) own-rack relocation: this slot's rack has a room-bearing
+            # broker OTHER THAN the replica's own, and no other slot of
+            # the partition shares the rack. The own broker must be
+            # excluded from its rack's room count: a replica cannot
+            # relocate onto the broker already hosting it, and counting
+            # it manufactured a self-referential "shed channel" that let
+            # the overshoot guard admit a same-round ceiling+1 overshoot
+            # (ADVICE round-5 finding).
             sole = ~((same & ~jnp.eye(s, dtype=bool)[None]) & exists[:, None, :]
                      ).any(axis=2)
-            own_ok = has_room[safe_racks] & sole & exists
+            self_room = jnp.concatenate(
+                [room, jnp.array([False])])[own_broker]
+            others_room = n_by_rack[safe_racks] - self_room.astype(jnp.int32)
+            own_ok = (others_room > 0) & sole & exists
             return (unused_rack & exists) | own_ok
 
-        return feasible(has_at), feasible(has_under)
+        return (feasible(at, n_at_by_rack),
+                feasible(under, n_under_by_rack))
 
     def replica_weight(self, state, derived, constraint, aux):
         # Unlike the pure rack goal (which only moves duplicated replicas),

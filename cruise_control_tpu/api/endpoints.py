@@ -46,6 +46,10 @@ class EndPoint(enum.Enum):
     TOPIC_CONFIGURATION = (20, "POST", Role.ADMIN)
     RIGHTSIZE = (21, "POST", Role.ADMIN)
     REMOVE_DISKS = (22, "POST", Role.ADMIN)
+    # Fleet federation (no reference analogue: the reference is one
+    # service per cluster; here one process serves many clusters and
+    # this endpoint is the fleet-wide dashboard).
+    FLEET = (23, "GET", Role.VIEWER)
 
     @property
     def method(self) -> str:

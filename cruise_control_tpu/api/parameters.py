@@ -70,6 +70,10 @@ def _broker_logdir_csv(v: str) -> dict[int, tuple[str, ...]]:
 _COMMON: dict[str, Callable[[str], Any]] = {
     "json": _bool, "verbose": _bool, "get_response_schema": _bool,
     "doas": _str, "reason": _str,
+    # Fleet federation routing: which registered cluster the request
+    # targets (fleet.registry). Absent = the process's default cluster,
+    # so every single-cluster deployment is untouched.
+    "cluster": _str,
 }
 
 _GOALS_PARAMS = {"goals": _csv, "allow_capacity_estimation": _bool,
@@ -149,6 +153,7 @@ SCHEMAS: dict[EndPoint, dict[str, Callable[[str], Any]]] = {
                          "topic": _str, "review_id": _int},
     EndPoint.REMOVE_DISKS: {**_EXECUTION_PARAMS,
                             "brokerid_and_logdirs": _broker_logdir_csv},
+    EndPoint.FLEET: {},
 }
 
 

@@ -17,8 +17,21 @@ from ..executor.admin import AdminBackend
 from ..facade import OperationResult
 from ..model.tensors import (
     ClusterMeta, ClusterTensors, broker_leader_counts, broker_load,
-    broker_replica_counts, potential_nw_out, replica_load,
+    broker_replica_counts, leader_bytes_in, potential_nw_out, replica_load,
 )
+
+
+def _num_cores(cpu_capacity_pct: float) -> int:
+    """NumCore from the CPU capacity column. The reference carries an
+    explicit core count from its BrokerCapacityConfigResolver; this model
+    expresses CPU capacity in percent-of-machine (100.0 = the whole
+    broker), so cores are DERIVED as capacity/100 — see docs/DESIGN.md
+    ("LOAD response wire-format notes"). Zero capacity = zero cores (the
+    floor of 1 applies only to brokers with SOME capacity, so dead-weight
+    rows cannot inflate a mixed host's total)."""
+    if cpu_capacity_pct <= 0:
+        return 0
+    return max(1, int(round(cpu_capacity_pct / 100.0)))
 
 JSON_VERSION = 1
 
@@ -58,7 +71,7 @@ def _host_name(meta: ClusterMeta, h: int) -> str:
 
 
 def _host_rows(state: ClusterTensors, meta: ClusterMeta, loads, caps,
-               replicas, leaders, pnw, mask) -> list[dict]:
+               replicas, leaders, pnw, lead_in, mask) -> list[dict]:
     """Per-host aggregate rows (BrokerStats.java host section /
     model/Host.java:275): every stat summed over the host's brokers,
     utilization pct over the host's summed capacity."""
@@ -72,7 +85,10 @@ def _host_rows(state: ClusterTensors, meta: ClusterMeta, loads, caps,
     load = {r: by_host(loads[mask, int(r)]) for r in
             (Resource.DISK, Resource.CPU, Resource.NW_IN, Resource.NW_OUT)}
     disk_cap = by_host(caps[mask, int(Resource.DISK)])
+    nw_in_cap = by_host(caps[mask, int(Resource.NW_IN)])
+    nw_out_cap = by_host(caps[mask, int(Resource.NW_OUT)])
     h_pnw = by_host(np.asarray(pnw, dtype=np.float64)[mask])
+    h_lead_in = by_host(np.asarray(lead_in, dtype=np.float64)[mask])
     h_replicas = by_host(np.asarray(replicas, dtype=np.float64)[mask])
     h_leaders = by_host(np.asarray(leaders, dtype=np.float64)[mask])
     with np.errstate(divide="ignore", invalid="ignore"):
@@ -83,11 +99,18 @@ def _host_rows(state: ClusterTensors, meta: ClusterMeta, loads, caps,
         "DiskMB": round(float(load[Resource.DISK][i]), 3),
         "DiskPct": round(float(disk_pct[i]), 3),
         "CpuPct": round(float(load[Resource.CPU][i]), 3),
-        "NwInRate": round(float(load[Resource.NW_IN][i]), 3),
+        "LeaderNwInRate": round(float(h_lead_in[i]), 3),
+        "FollowerNwInRate": round(
+            float(load[Resource.NW_IN][i] - h_lead_in[i]), 3),
         "NwOutRate": round(float(load[Resource.NW_OUT][i]), 3),
         "PnwOutRate": round(float(h_pnw[i]), 3),
         "Replicas": int(h_replicas[i]),
         "Leaders": int(h_leaders[i]),
+        "DiskCapacityMB": round(float(disk_cap[i]), 3),
+        "NetworkInCapacity": round(float(nw_in_cap[i]), 3),
+        "NetworkOutCapacity": round(float(nw_out_cap[i]), 3),
+        "NumCore": sum(_num_cores(float(c))
+                       for c in caps[mask, int(Resource.CPU)][inv == i]),
     } for i in range(n)]
 
 
@@ -103,6 +126,7 @@ def broker_stats(state: ClusterTensors, meta: ClusterMeta,
     replicas = np.asarray(broker_replica_counts(state))
     leaders = np.asarray(broker_leader_counts(state))
     pnw = np.asarray(potential_nw_out(state))
+    lead_in = np.asarray(leader_bytes_in(state), dtype=np.float64)
     states = np.asarray(state.broker_state)
     racks = np.asarray(state.rack)
     hosts = np.asarray(state.host)
@@ -120,11 +144,19 @@ def broker_stats(state: ClusterTensors, meta: ClusterMeta,
             "DiskMB": round(float(loads[i, Resource.DISK]), 3),
             "DiskPct": round(float(pct[i, Resource.DISK]), 3),
             "CpuPct": round(float(loads[i, Resource.CPU]), 3),
-            "NwInRate": round(float(loads[i, Resource.NW_IN]), 3),
+            # Reference wire format (BrokerStats.java): NW_IN is reported
+            # split by replica role, not combined.
+            "LeaderNwInRate": round(float(lead_in[i]), 3),
+            "FollowerNwInRate": round(
+                float(loads[i, Resource.NW_IN] - lead_in[i]), 3),
             "NwOutRate": round(float(loads[i, Resource.NW_OUT]), 3),
             "PnwOutRate": round(float(pnw[i]), 3),
             "Replicas": int(replicas[i]),
             "Leaders": int(leaders[i]),
+            "DiskCapacityMB": round(float(caps[i, Resource.DISK]), 3),
+            "NetworkInCapacity": round(float(caps[i, Resource.NW_IN]), 3),
+            "NetworkOutCapacity": round(float(caps[i, Resource.NW_OUT]), 3),
+            "NumCore": _num_cores(float(caps[i, Resource.CPU])),
         }
         if disk_info is not None:
             logdirs_by_broker, resolver = disk_info
@@ -139,7 +171,7 @@ def broker_stats(state: ClusterTensors, meta: ClusterMeta,
         rows.append(row)
     return envelope({"brokers": rows,
                      "hosts": _host_rows(state, meta, loads, caps, replicas,
-                                         leaders, pnw, mask)})
+                                         leaders, pnw, lead_in, mask)})
 
 
 def partition_load(state: ClusterTensors, meta: ClusterMeta,

@@ -22,6 +22,7 @@ from typing import Any
 
 from ..config.cruise_control_config import CruiseControlConfig
 from ..facade import CruiseControl
+from ..fleet.registry import ClusterPausedError, UnknownClusterError
 from ..monitor.load_monitor import NotEnoughValidWindowsError
 from . import responses
 from .endpoints import REVIEWABLE_ENDPOINTS, EndPoint, endpoint_for_path
@@ -47,7 +48,20 @@ _SYNC_ENDPOINTS = {
     EndPoint.REVIEW_BOARD, EndPoint.PERMISSIONS, EndPoint.REVIEW,
     EndPoint.PAUSE_SAMPLING, EndPoint.RESUME_SAMPLING,
     EndPoint.STOP_PROPOSAL_EXECUTION, EndPoint.ADMIN, EndPoint.BOOTSTRAP,
-    EndPoint.TRAIN, EndPoint.RIGHTSIZE,
+    EndPoint.TRAIN, EndPoint.RIGHTSIZE, EndPoint.FLEET,
+}
+
+# Endpoints that consume solver time. In fleet mode these (a) are refused
+# for paused clusters and (b) run through the FleetScheduler as ON_DEMAND
+# jobs, so one cluster's requests share the device fairly with every
+# other cluster's precompute and self-healing (fleet.scheduler).
+# RIGHTSIZE is deliberately absent: it hands a recommendation to the
+# provisioner without touching the solver (and is answered inline).
+_SOLVER_ENDPOINTS = {
+    EndPoint.PROPOSALS, EndPoint.REBALANCE, EndPoint.ADD_BROKER,
+    EndPoint.REMOVE_BROKER, EndPoint.DEMOTE_BROKER,
+    EndPoint.FIX_OFFLINE_REPLICAS, EndPoint.TOPIC_CONFIGURATION,
+    EndPoint.REMOVE_DISKS,
 }
 
 
@@ -118,8 +132,14 @@ class CruiseControlApi:
 
     def __init__(self, cc: CruiseControl,
                  security_provider: SecurityProvider | None = None,
-                 config: CruiseControlConfig | None = None):
+                 config: CruiseControlConfig | None = None,
+                 fleet=None):
         self._cc = cc
+        # Optional fleet.FleetRegistry: enables ?cluster= routing on every
+        # endpoint plus the FLEET dashboard. The default (no ?cluster=)
+        # path always serves ``cc`` — single-cluster deployments are
+        # byte-for-byte unchanged.
+        self._fleet = fleet
         cfg = config or cc.config
         self._config = cfg
         self._security = security_provider or (
@@ -208,6 +228,19 @@ class CruiseControlApi:
                               labels={"anomaly_type": str(a_type)})
         except Exception:  # noqa: BLE001 — a scrape must not 500 on state
             LOG.warning("metrics state snapshot failed", exc_info=True)
+        if self._fleet is not None:
+            # Per-cluster fleet gauges (explicit labels; the ambient
+            # cluster_label context covers per-cluster WORK, a scrape is
+            # fleet-wide).
+            for e in self._fleet.entries():
+                labels = {"cluster": e.cluster_id}
+                SENSORS.gauge("fleet_cluster_paused",
+                              1.0 if e.paused else 0.0, labels=labels)
+                if e.shape is not None:
+                    SENSORS.gauge("fleet_cluster_brokers", e.shape[0],
+                                  labels=labels)
+                    SENSORS.gauge("fleet_cluster_partitions", e.shape[1],
+                                  labels=labels)
         return SENSORS.render(extra)
 
     @property
@@ -262,8 +295,22 @@ class CruiseControlApi:
                 params = self._parse(endpoint, urllib.parse.parse_qs(
                     query_string, keep_blank_values=True))
                 params.pop("review_id", None)
-            body = self._dispatch(endpoint, params, principal, query_string,
-                                  headers, out_headers)
+            # Fleet routing: ?cluster= selects the registered cluster's
+            # facade (popped AFTER the purgatory replay so the reviewed
+            # query's cluster wins over the resubmission's). A request
+            # WITHOUT the parameter against a default facade that is
+            # itself fleet-registered is that cluster's request too —
+            # its solver work must share the device under the scheduler
+            # and respect the pause state, not sneak around both.
+            cluster_id = params.pop("cluster", None)
+            if cluster_id is None and self._fleet is not None:
+                cluster_id = self._fleet.cluster_id_of(self._cc)
+            cc = self._route_cluster(endpoint, cluster_id)
+            from ..utils.sensors import cluster_label
+            with cluster_label(cluster_id):
+                body = self._dispatch(endpoint, params, principal,
+                                      query_string, headers, out_headers,
+                                      cc=cc, cluster_id=cluster_id)
             if params.get("get_response_schema"):
                 body = {**body, "responseSchema": _schema_of(body)}
             if params.get("json") is False:
@@ -274,6 +321,11 @@ class CruiseControlApi:
             return 200, body, out_headers
         except ParameterParseError as e:
             return 400, self._error(str(e)), out_headers
+        except UnknownClusterError as e:
+            return 404, self._error(
+                f"unknown cluster {e.args[0]!r}"), out_headers
+        except ClusterPausedError as e:
+            return 409, self._error(str(e)), out_headers
         except AuthenticationError as e:
             out_headers["WWW-Authenticate"] = self._security.challenge()
             return 401, self._error(str(e)), out_headers
@@ -292,6 +344,20 @@ class CruiseControlApi:
         except Exception as e:
             LOG.exception("internal error handling %s %s", method, path)
             return 500, self._error(f"{type(e).__name__}: {e}"), out_headers
+
+    def _route_cluster(self, endpoint: EndPoint,
+                       cluster_id: str | None) -> CruiseControl:
+        """?cluster= → the registered cluster's facade. No parameter =
+        the default facade (single-cluster deployments unchanged); solver
+        endpoints are refused for paused clusters."""
+        if cluster_id is None:
+            return self._cc
+        if self._fleet is None:
+            raise ParameterParseError(
+                "cluster parameter given but this server is not running "
+                "a fleet (no FleetRegistry configured)")
+        return self._fleet.get(
+            cluster_id, for_operation=endpoint in _SOLVER_ENDPOINTS)
 
     # Reference plugin-key spelling for each endpoint
     # (CruiseControlParametersConfig / CruiseControlRequestConfig).
@@ -332,8 +398,10 @@ class CruiseControlApi:
     # -- handlers ----------------------------------------------------------
     def _dispatch(self, endpoint: EndPoint, params: dict, principal: Principal,
                   query_string: str, headers: dict[str, str],
-                  out_headers: dict[str, str]) -> dict:
-        cc = self._cc
+                  out_headers: dict[str, str],
+                  cc: CruiseControl | None = None,
+                  cluster_id: str | None = None) -> dict:
+        cc = cc or self._cc
         p = params
         custom = self._plugin(endpoint, "request")
         if custom is not None:
@@ -342,9 +410,21 @@ class CruiseControlApi:
             handler = custom() if isinstance(custom, type) else custom
             return handler.handle(cc, p, principal)
         if endpoint in _SYNC_ENDPOINTS:
-            return self._sync_handler(endpoint, p, principal)
-        # Async (model-building) endpoints run as user tasks.
-        work = self._async_work(endpoint, p)
+            return self._sync_handler(endpoint, p, principal, cc)
+        # Async (model-building) endpoints run as user tasks. The
+        # cluster label must be re-established INSIDE the work callable:
+        # ContextVars do not cross into the user-task thread pool, so the
+        # handle()-level context alone would label nothing async.
+        work = self._async_work(endpoint, p, cc)
+        if cluster_id is not None:
+            inner_work = work
+
+            def work(inner=inner_work, cid=cluster_id):
+                from ..utils.sensors import cluster_label
+                with cluster_label(cid):
+                    return inner()
+
+        work = self._schedule_fleet_work(endpoint, cluster_id, work, cc, p)
         info = self._tasks.get_or_create_task(
             endpoint.name, query_string, work,
             task_id=headers.get(USER_TASK_HEADER), client=principal.name)
@@ -359,6 +439,8 @@ class CruiseControlApi:
                 "message": f"operation still running; poll with "
                            f"{USER_TASK_HEADER} {info.task_id}"})
         if exc is not None:
+            if isinstance(exc, ApiError):
+                raise exc
             if isinstance(exc, (ParameterParseError, ValueError, KeyError)):
                 raise ApiError(400, str(exc))
             if isinstance(exc, NotEnoughValidWindowsError):
@@ -366,9 +448,64 @@ class CruiseControlApi:
             raise ApiError(500, f"{type(exc).__name__}: {exc}")
         return info.future.result()
 
+    def _schedule_fleet_work(self, endpoint: EndPoint,
+                             cluster_id: str | None, work,
+                             cc: CruiseControl | None = None,
+                             p: dict | None = None):
+        """Wrap a fleet-routed solver work callable so it runs as an
+        ON_DEMAND FleetScheduler job: the user-task thread submits and
+        blocks on the future (202-poll behavior unchanged), while the
+        device itself is shared under the scheduler's priorities and
+        starvation bound. Inline when no worker is draining (embedded or
+        test schedulers) — blocking on a future nobody serves would hang
+        the task forever."""
+        if cluster_id is None or self._fleet is None \
+                or endpoint not in _SOLVER_ENDPOINTS:
+            return work
+        sched = self._fleet.scheduler
+        if sched is None or not sched.running:
+            return work
+        if endpoint is EndPoint.PROPOSALS and cc is not None \
+                and p is not None and not any(
+                    p.get(k) for k in ("goals", "ignore_proposal_cache",
+                                       "use_ready_default_goals",
+                                       "fast_mode", "data_from")):
+            # A default-chain PROPOSALS request with a fresh cache needs
+            # NO solver time — answering inline keeps the pre-fleet
+            # instant-cached-read behavior instead of parking a zero-work
+            # request behind another cluster's multi-second solve.
+            try:
+                if cc._cached_proposals_fresh(
+                        cc._load_monitor.model_generation):
+                    return work
+            except Exception:  # noqa: BLE001 — fall through to the queue
+                pass
+        from ..fleet.scheduler import JobKind
+
+        def scheduled():
+            from concurrent.futures import CancelledError
+            try:
+                return sched.submit(cluster_id, JobKind.ON_DEMAND,
+                                    work).result()
+            except CancelledError:
+                # Scheduler shut down before the job ran: a meaningful
+                # 503 beats an opaque "CancelledError:" 500.
+                raise ApiError(
+                    503, "fleet scheduler shut down before the request "
+                    "could run; retry once the fleet is back up")
+
+        return scheduled
+
     def _sync_handler(self, endpoint: EndPoint, p: dict,
-                      principal: Principal) -> dict:
-        cc = self._cc
+                      principal: Principal,
+                      cc: CruiseControl | None = None) -> dict:
+        cc = cc or self._cc
+        if endpoint is EndPoint.FLEET:
+            if self._fleet is None:
+                return responses.envelope(
+                    {"numClusters": 0, "clusters": {},
+                     "message": "fleet mode not enabled"})
+            return responses.envelope(self._fleet.state())
         if endpoint is EndPoint.STATE:
             return responses.envelope(cc.state(
                 p.get("substates", ()),
@@ -457,13 +594,14 @@ class CruiseControlApi:
                                p.get("partition_count", 0), p.get("topic"))
             return responses.optimization_result(res)
         if endpoint is EndPoint.ADMIN:
-            return self._admin_handler(p)
+            return self._admin_handler(p, cc)
         raise ApiError(500, f"no sync handler for {endpoint.name}")
 
-    def _admin_handler(self, p: dict) -> dict:
+    def _admin_handler(self, p: dict,
+                       cc: CruiseControl | None = None) -> dict:
         from ..detector.anomaly import AnomalyType
         from ..executor.concurrency import ExecutionConcurrencyManager
-        cc = self._cc
+        cc = cc or self._cc
         # Validate EVERY name-typed argument before applying ANY mutation:
         # a typo anywhere must 400 the whole request, not leave the earlier
         # toggles silently applied under an error response.
@@ -521,7 +659,8 @@ class CruiseControlApi:
             changed["droppedRecentlyDemoted"] = sorted(dropped_demoted)
         return responses.envelope(changed or {"message": "no admin action given"})
 
-    def _sanity_check_hard_goals(self, endpoint: EndPoint, p: dict) -> None:
+    def _sanity_check_hard_goals(self, endpoint: EndPoint, p: dict,
+                                 cc: CruiseControl | None = None) -> None:
         """Explicitly requested goals must include every configured hard
         goal unless skip_hard_goal_check=true
         (KafkaCruiseControlUtils.sanityCheckGoals:426-437; a sole
@@ -536,7 +675,7 @@ class CruiseControlApi:
         if short == ["PreferredLeaderElectionGoal"]:
             return
         hard = {g.rsplit(".", 1)[-1]
-                for g in self._cc._config.get_list("hard.goals")}
+                for g in (cc or self._cc)._config.get_list("hard.goals")}
         missing = sorted(hard - set(short))
         if missing:
             raise ParameterParseError(
@@ -544,11 +683,12 @@ class CruiseControlApi:
                 f"{short}. Add skip_hard_goal_check=true parameter to "
                 "ignore this sanity check.")
 
-    def _async_work(self, endpoint: EndPoint, p: dict):
-        cc = self._cc
+    def _async_work(self, endpoint: EndPoint, p: dict,
+                    cc: CruiseControl | None = None):
+        cc = cc or self._cc
         dryrun = p.get("dryrun", True)
         goals = _resolve_goal_names(p)
-        self._sanity_check_hard_goals(endpoint, p)
+        self._sanity_check_hard_goals(endpoint, p, cc)
         use_ready = p.get("use_ready_default_goals", False)
         fast_mode = p.get("fast_mode", False)
         reason = p.get("reason", "")
@@ -897,9 +1037,9 @@ class _Handler(BaseHTTPRequestHandler):
 def make_server(cc: CruiseControl, host: str | None = None,
                 port: int | None = None,
                 security_provider: SecurityProvider | None = None,
-                ) -> tuple[ThreadingHTTPServer, CruiseControlApi]:
+                fleet=None) -> tuple[ThreadingHTTPServer, CruiseControlApi]:
     cfg = cc.config
-    api = CruiseControlApi(cc, security_provider)
+    api = CruiseControlApi(cc, security_provider, fleet=fleet)
     handler = type("BoundHandler", (_Handler,), {"api": api})
     server = ThreadingHTTPServer(
         (host or cfg.get("webserver.http.address"),

@@ -345,6 +345,35 @@ def _definition() -> ConfigDef:
              "per-dispatch host-device link latency (a tunneled TPU pays a "
              "fixed RTT per execution) while every dispatch stays far "
              "below execution-watchdog territory. 0 disables adaptation.")
+    d.define("fleet.bucket.broker.base", T.INT, 4, Range.at_least(1), I.LOW,
+             "Fleet federation: smallest broker-axis bucket of the shared "
+             "geometric shape grid (fleet.bucketing.BucketGrid). Every "
+             "registered cluster's model is padded up to a grid point so "
+             "N clusters share a handful of compiled chain kernels.")
+    d.define("fleet.bucket.partition.base", T.INT, 256, Range.at_least(1),
+             I.LOW,
+             "Fleet federation: smallest partition-axis bucket of the "
+             "shared geometric shape grid.")
+    d.define("fleet.bucket.topic.base", T.INT, 8, Range.at_least(1), I.LOW,
+             "Fleet federation: smallest bucket for the topic-count "
+             "static solver argument (the [T, B] topic planes); pad "
+             "topics host no replicas and are goal-neutral.")
+    d.define("fleet.bucket.geometric.factor", T.DOUBLE, 2.0,
+             Range.at_least(1.01), I.LOW,
+             "Fleet federation: growth factor between grid points on both "
+             "axes (bucket sizes base x factor^k; 2.0 = powers of two, "
+             "bounding pad overhead below one octave).")
+    d.define("fleet.precompute.cadence.ms", T.LONG, 60_000,
+             Range.at_least(1), I.LOW,
+             "Fleet federation: per-cluster proposal-precompute cadence "
+             "enforced by the FleetScheduler's pacer (overridable per "
+             "cluster via its registration overlay). The fleet analogue "
+             "of the facade's own precompute loop.")
+    d.define("fleet.scheduler.starvation.bound.ms", T.LONG, 30_000,
+             Range.at_least(1), I.LOW,
+             "Fleet federation: any queued solver job older than this "
+             "runs next regardless of priority class, so one cluster's "
+             "flood can delay but never starve another cluster's work.")
     d.define("goal.violation.distribution.threshold.multiplier", T.DOUBLE, 1.0,
              Range.at_least(1), I.LOW,
              "Detector-triggered balance-threshold relaxation.")
@@ -727,7 +756,8 @@ def _definition() -> ConfigDef:
                "permissions", "add.broker", "remove.broker",
                "fix.offline.replicas", "rebalance", "stop.proposal",
                "pause.sampling", "resume.sampling", "demote.broker", "admin",
-               "review", "topic.configuration", "rightsize", "remove.disks"):
+               "review", "topic.configuration", "rightsize", "remove.disks",
+               "fleet"):
         d.define(f"{ep}.parameters.class", T.CLASS, None, None, I.LOW,
                  f"Parameter-parsing plugin for the {ep} endpoint "
                  "(callable(query) -> params dict).")

@@ -63,6 +63,11 @@ class AnomalyDetectorManager:
         self._num_self_healing_started = 0
         self._num_fix_failures = 0
         self._recheck: list[tuple[float, Anomaly]] = []  # (due time s, anomaly)
+        # Optional fix-dispatch hook: callable(fn) -> fn's result. A fleet
+        # registry points this at the FleetScheduler (SELF_HEALING
+        # priority) so one device serves every cluster's fixes in
+        # priority order; None = run inline on the handler thread.
+        self.fix_runner = None
 
     # -- wiring ------------------------------------------------------------
     def add_detector(self, detector: Any, interval_ms: int) -> None:
@@ -187,7 +192,8 @@ class AnomalyDetectorManager:
             LOG.info("skipping fix: load model not ready for self-healing")
             return AnomalyStatus.FIX_FAILED_TO_START
         try:
-            started = anomaly.fix(self._facade)
+            run = self.fix_runner or (lambda fn: fn())
+            started = run(lambda: anomaly.fix(self._facade))
         except Exception:
             LOG.exception("anomaly fix failed to start")
             self._num_fix_failures += 1

@@ -91,7 +91,8 @@ class CruiseControl:
     def __init__(self, config: CruiseControlConfig, admin: AdminBackend,
                  load_monitor: LoadMonitor | None = None,
                  executor: Executor | None = None,
-                 notifier: AnomalyNotifier | None = None):
+                 notifier: AnomalyNotifier | None = None,
+                 optimizer: GoalOptimizer | None = None):
         self._config = config
         self._admin = admin
         self._load_monitor = load_monitor or LoadMonitor(config, admin)
@@ -120,7 +121,11 @@ class CruiseControl:
                 "inter.broker.replica.movement.rate.alerting.threshold"),
             intra_rate_alert_mb_s=config.get_double(
                 "intra.broker.replica.movement.rate.alerting.threshold"))
-        self._optimizer = GoalOptimizer(config)
+        # ``optimizer`` injection is the fleet's solver-sharing seam
+        # (fleet.registry): every cluster facade in a federated process
+        # runs the SAME GoalOptimizer (and device/mesh), so bucketed
+        # shapes land in one compiled-kernel set.
+        self._optimizer = optimizer or GoalOptimizer(config)
         self._notifier = notifier or SelfHealingNotifier(config)
         self._anomaly_detector = AnomalyDetectorManager(
             config, self._notifier, facade=self)
@@ -253,12 +258,17 @@ class CruiseControl:
             LOG.exception("could not flip sampling mode")
 
     # -- lifecycle (KafkaCruiseControl.startUp:221) ------------------------
-    def start_up(self, block_on_load: bool = True) -> None:
+    def start_up(self, block_on_load: bool = True,
+                 start_precompute: bool = True) -> None:
+        """``start_precompute=False`` leaves the facade's own proposal
+        precompute loop off — fleet deployments route precompute through
+        the FleetScheduler's pacer instead (one device, many clusters:
+        per-facade loops would contend for it unscheduled)."""
         self._load_monitor.start_up(block_on_load=block_on_load)
         self._anomaly_detector.start_detection()
         self._started = True
-        if self._precompute_thread is None \
-                or not self._precompute_thread.is_alive():
+        if start_precompute and (self._precompute_thread is None
+                                 or not self._precompute_thread.is_alive()):
             self._stop_precompute = threading.Event()
             self._precompute_thread = threading.Thread(
                 target=self._proposal_precompute_loop, daemon=True,

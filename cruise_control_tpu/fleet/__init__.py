@@ -1,0 +1,32 @@
+"""Fleet federation: many Kafka clusters, one solver.
+
+The reference deployment model is one service instance per cluster; fleet
+operation at LinkedIn scale (~7K brokers across many clusters, PAPER.md)
+is done by hand outside the tool. The TPU formulation makes federation
+natural: ``ClusterTensors`` is a frozen pytree and the chain kernels are
+shape-polymorphic up to padding, so one device (or mesh) can serve the
+proposal/self-healing load of an entire fleet through a handful of
+compiled kernels instead of one process per cluster.
+
+- ``bucketing``: shape-bucket padding onto a small geometric grid so N
+  clusters reuse a few compiled chain kernels.
+- ``registry``: cluster lifecycle (register/deregister/pause) with
+  per-cluster config overlays; each cluster owns its monitor/detector/
+  executor context while sharing the process-wide solver.
+- ``scheduler``: a fair solver-work scheduler multiplexing per-cluster
+  precompute, self-healing, and on-demand requests onto the single
+  device/mesh with priorities and a starvation bound.
+"""
+
+from .bucketing import BucketGrid, pad_to_bucket, unpad_state
+from .registry import (
+    ClusterPausedError, FleetEntry, FleetRegistry, UnknownClusterError,
+)
+from .scheduler import FleetScheduler, JobKind
+
+__all__ = [
+    "BucketGrid", "pad_to_bucket", "unpad_state",
+    "FleetRegistry", "FleetEntry", "UnknownClusterError",
+    "ClusterPausedError",
+    "FleetScheduler", "JobKind",
+]

@@ -1,0 +1,151 @@
+"""Shape-bucket padding onto a small geometric grid.
+
+XLA compiles one executable per array shape, and a full-chain compile at
+scale is minutes (solver.partition.bucket.size rationale). The builder's
+per-cluster bucket multiples keep ONE cluster's shape stable over time;
+a fleet needs the stronger property that DIFFERENT clusters land on the
+same shape. Rounding (num_brokers, num_partitions) up to a geometric
+grid (base x factor^k) quantizes the whole fleet to a handful of shapes
+per octave, so N clusters share O(log N) compiled chain kernels.
+
+Padding soundness (why a padded solve is byte-identical to an unpadded
+one on the real rows): padded brokers enter DEAD with zero capacity and
+``broker_mask`` False, so ``alive_mask`` excludes them, every per-broker
+score the candidate generators read is -inf/invalid for them, and they
+can be neither source nor destination; padded partitions carry
+``assignment = -1`` and ``partition_mask`` False, so ``replica_exists``
+masks them out of every reduction and candidate weight. Selection is
+score-then-lowest-index, and padding only APPENDS rows, so real rows
+keep their indices and the per-round argmax/top-k picks are identical.
+The equivalence tests in tests/test_fleet.py pin this byte-for-byte at
+two bucket sizes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..common.broker_state import BrokerState
+from ..model.tensors import ClusterMeta, ClusterTensors
+
+
+def geometric_round_up(n: int, base: int, factor: float) -> int:
+    """Smallest grid point ``ceil(base * factor^k) >= n`` (k >= 0)."""
+    if n <= 0:
+        return max(1, base)
+    size = max(1, base)
+    while size < n:
+        size = max(size + 1, int(np.ceil(size * factor)))
+    return size
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketGrid:
+    """The fleet's shared shape grid. One instance per process: every
+    cluster registered with the fleet is padded onto THIS grid, which is
+    what makes their solver kernels shape-compatible."""
+
+    broker_base: int = 4
+    partition_base: int = 256
+    topic_base: int = 8
+    factor: float = 2.0
+
+    @classmethod
+    def from_config(cls, config) -> "BucketGrid":
+        return cls(
+            broker_base=config.get_int("fleet.bucket.broker.base"),
+            partition_base=config.get_int("fleet.bucket.partition.base"),
+            topic_base=config.get_int("fleet.bucket.topic.base"),
+            factor=config.get_double("fleet.bucket.geometric.factor"))
+
+    def bucket_shape(self, num_brokers: int,
+                     num_partitions: int) -> tuple[int, int]:
+        """(padded_brokers, padded_partitions) for a cluster shape."""
+        return (geometric_round_up(num_brokers, self.broker_base, self.factor),
+                geometric_round_up(num_partitions, self.partition_base,
+                                   self.factor))
+
+    def pad_model(self, state: ClusterTensors, meta: ClusterMeta,
+                  ) -> tuple[ClusterTensors, ClusterMeta]:
+        """Pad a built model up to its grid bucket (the LoadMonitor
+        ``model_transform`` hook). ``meta.num_topics`` — a STATIC solver
+        argument sizing the [T, B] topic planes — is quantized onto the
+        grid too, else two same-shaped clusters with different topic
+        counts would still compile twice; pad topics host zero replicas,
+        so their balance bands collapse to [0, 0] and they contribute
+        nothing to any goal. The name tables keep naming only REAL rows."""
+        nb, npart = self.bucket_shape(state.num_brokers,
+                                      state.num_partitions)
+        nt = geometric_round_up(meta.num_topics, self.topic_base, self.factor)
+        if nt != meta.num_topics:
+            meta = dataclasses.replace(meta, num_topics=nt)
+        return pad_to_bucket(state, nb, npart,
+                             num_hosts=len(meta.host_names)), meta
+
+
+def pad_to_bucket(state: ClusterTensors, num_brokers: int,
+                  num_partitions: int, num_hosts: int = 0) -> ClusterTensors:
+    """Append pad rows so ``state`` has exactly (num_brokers,
+    num_partitions) — same pad-row encoding as the builder: DEAD
+    zero-capacity masked brokers on rack 0 with a private host id,
+    masked empty partitions of topic 0. No-op when already at size."""
+    import jax.numpy as jnp
+
+    b0, p0 = state.num_brokers, state.num_partitions
+    if num_brokers < b0 or num_partitions < p0:
+        raise ValueError(
+            f"bucket ({num_brokers}, {num_partitions}) smaller than the "
+            f"cluster shape ({b0}, {p0})")
+    if num_brokers == b0 and num_partitions == p0:
+        return state
+    db, dp = num_brokers - b0, num_partitions - p0
+    rf = state.max_replication_factor
+
+    def pad_rows(a, rows, fill):
+        if rows == 0:
+            return a
+        shape = (rows,) + tuple(a.shape[1:])
+        return jnp.concatenate([a, jnp.full(shape, fill, dtype=a.dtype)])
+
+    # Builder pad-row parity: host ids for pad rows are one-past the real
+    # host table (each pad broker is its own host) so host-level
+    # aggregation never merges them with a real host.
+    pad_hosts = jnp.arange(b0, num_brokers, dtype=state.host.dtype) \
+        + max(num_hosts, 0)
+    return dataclasses.replace(
+        state,
+        assignment=pad_rows(state.assignment, dp, -1),
+        leader_slot=pad_rows(state.leader_slot, dp, -1),
+        leader_load=pad_rows(state.leader_load, dp, 0),
+        follower_load=pad_rows(state.follower_load, dp, 0),
+        topic=pad_rows(state.topic, dp, 0),
+        partition_mask=pad_rows(state.partition_mask, dp, False),
+        capacity=pad_rows(state.capacity, db, 0),
+        rack=pad_rows(state.rack, db, 0),
+        broker_state=pad_rows(state.broker_state, db,
+                              int(BrokerState.DEAD)),
+        broker_mask=pad_rows(state.broker_mask, db, False),
+        host=jnp.concatenate([state.host, pad_hosts])
+        if db else state.host)
+
+
+def unpad_state(state: ClusterTensors, num_brokers: int,
+                num_partitions: int) -> ClusterTensors:
+    """Slice a padded state back to the real shape (padding only appends
+    rows, so this is exact — used by the equivalence tests and anywhere a
+    real-shaped tensor view is wanted)."""
+    return dataclasses.replace(
+        state,
+        assignment=state.assignment[:num_partitions],
+        leader_slot=state.leader_slot[:num_partitions],
+        leader_load=state.leader_load[:num_partitions],
+        follower_load=state.follower_load[:num_partitions],
+        topic=state.topic[:num_partitions],
+        partition_mask=state.partition_mask[:num_partitions],
+        capacity=state.capacity[:num_brokers],
+        rack=state.rack[:num_brokers],
+        broker_state=state.broker_state[:num_brokers],
+        broker_mask=state.broker_mask[:num_brokers],
+        host=state.host[:num_brokers])

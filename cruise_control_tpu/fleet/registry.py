@@ -1,0 +1,347 @@
+"""Cluster lifecycle for the fleet: register / deregister / pause.
+
+Each registered cluster owns its FULL single-cluster context — admin
+backend, load monitor, anomaly detectors, executor — exactly as a
+standalone deployment would, built from the fleet's base config merged
+with a per-cluster overlay. What clusters SHARE is the solver: one
+``GoalOptimizer`` (and its device/mesh) serves every cluster, with each
+cluster's model padded onto the fleet's ``BucketGrid`` so the chain
+kernels compile once per bucket shape instead of once per cluster.
+
+Solver work is routed through the ``FleetScheduler`` when one is
+attached: proposal precompute via the pacer, self-healing fixes via the
+detector manager's ``fix_runner`` hook, on-demand API requests via the
+server's fleet routing. A paused cluster keeps sampling metrics and
+serving reads but gets NO solver time: paced precompute and self-healing
+are skipped and solver-class API endpoints are refused (administrative
+toggles — sampling pause/resume, self-healing flags — stay available so
+an operator can reconfigure a paused cluster before resuming it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Callable, Mapping
+
+from ..analyzer.optimizer import GoalOptimizer
+from ..config.cruise_control_config import CruiseControlConfig
+from ..facade import CruiseControl
+from .bucketing import BucketGrid
+from .scheduler import FleetScheduler, JobKind
+
+LOG = logging.getLogger(__name__)
+
+
+class UnknownClusterError(KeyError):
+    """No such cluster id in the fleet (HTTP 404 at the API layer)."""
+
+
+class ClusterPausedError(RuntimeError):
+    """Operation refused: the cluster is administratively paused."""
+
+
+@dataclasses.dataclass
+class FleetEntry:
+    cluster_id: str
+    cc: CruiseControl
+    config: CruiseControlConfig
+    paused: bool = False
+    registered_at_ms: int = 0
+    # Monotonic timestamp of the last paced precompute (scheduler pacer).
+    last_precompute: float = 0.0
+    # Last-seen (real_brokers, real_partitions) -> padded bucket shape,
+    # recorded by the pad hook on every model build.
+    shape: tuple[int, int] | None = None
+    bucket: tuple[int, int] | None = None
+    # Whether deregister() should shut the facade down (False when the
+    # embedder handed us a facade it manages itself).
+    owns_cc: bool = True
+
+
+def _default_factory(config: CruiseControlConfig, admin,
+                     optimizer: GoalOptimizer) -> CruiseControl:
+    return CruiseControl(config, admin, optimizer=optimizer)
+
+
+class FleetRegistry:
+    """The fleet's cluster table + shared-solver wiring."""
+
+    def __init__(self, base_config: CruiseControlConfig | None = None,
+                 optimizer: GoalOptimizer | None = None,
+                 scheduler: FleetScheduler | None = None,
+                 grid: BucketGrid | None = None,
+                 factory: Callable[..., CruiseControl] | None = None):
+        self._base = base_config or CruiseControlConfig()
+        self._optimizer = optimizer or GoalOptimizer(self._base)
+        self._grid = grid or BucketGrid.from_config(self._base)
+        self._scheduler = scheduler
+        if scheduler is not None:
+            scheduler.bind(self)
+        self._factory = factory or _default_factory
+        self._entries: dict[str, FleetEntry] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def optimizer(self) -> GoalOptimizer:
+        return self._optimizer
+
+    @property
+    def grid(self) -> BucketGrid:
+        return self._grid
+
+    @property
+    def scheduler(self) -> FleetScheduler | None:
+        return self._scheduler
+
+    # -- lifecycle ---------------------------------------------------------
+    def register(self, cluster_id: str, admin=None,
+                 overlay: Mapping[str, Any] | None = None,
+                 cc: CruiseControl | None = None,
+                 start: bool = False, block_on_load: bool = False,
+                 ) -> FleetEntry:
+        """Add a cluster. Either pass a live ``admin`` backend (the
+        registry builds the full per-cluster context from base config +
+        ``overlay``) or a prebuilt facade ``cc`` (the embedder keeps
+        ownership; its optimizer should be the fleet's for kernel
+        sharing). ``start=True`` also starts monitor + detectors — with
+        the facade's own precompute loop DISABLED; the fleet scheduler's
+        pacer owns precompute cadence."""
+        if (admin is None) == (cc is None):
+            raise ValueError("register needs exactly one of admin= or cc=")
+        if cc is not None and overlay:
+            # A prebuilt facade already owns its config; silently dropping
+            # the overlay would leave the operator believing a per-cluster
+            # override is active.
+            raise ValueError(
+                "overlay= applies only when the registry builds the "
+                "cluster context (admin=); a prebuilt cc= carries its own "
+                "config")
+        # Reserve the id BEFORE building: a racing duplicate must fail
+        # before it constructs (and wires fleet hooks into) a whole
+        # facade that would then leak un-shutdown.
+        with self._lock:
+            if cluster_id in self._entries:
+                raise ValueError(f"cluster {cluster_id!r} already registered")
+            self._entries[cluster_id] = None  # reservation placeholder
+        try:
+            owns = cc is None
+            if cc is None:
+                config = self._overlay_config(overlay)
+                cc = self._factory(config, admin, self._optimizer)
+            else:
+                config = cc.config
+            entry = FleetEntry(cluster_id=cluster_id, cc=cc, config=config,
+                               registered_at_ms=int(time.time() * 1000),
+                               owns_cc=owns)
+            self._wire(entry)
+            with self._lock:
+                self._entries[cluster_id] = entry
+        except BaseException:
+            with self._lock:
+                if self._entries.get(cluster_id) is None:
+                    self._entries.pop(cluster_id, None)
+            raise
+        if start:
+            try:
+                cc.start_up(block_on_load=block_on_load,
+                            start_precompute=False)
+            except BaseException:
+                # A half-started facade must not stay registered: unwind
+                # to the pre-register state so the caller can retry. A
+                # registry-built facade is also shut down — its monitor
+                # threads may already be sampling, and the reference
+                # would otherwise leak with no owner left to stop them.
+                with self._lock:
+                    self._entries.pop(cluster_id, None)
+                cc.load_monitor.model_transform = None
+                cc.anomaly_detector.fix_runner = None
+                if owns:
+                    try:
+                        cc.shutdown()
+                    except Exception:  # noqa: BLE001 — unwind must finish
+                        LOG.exception("fleet: unwind shutdown of %s failed",
+                                      cluster_id)
+                raise
+        self._refresh_gauges()
+        LOG.info("fleet: registered cluster %s", cluster_id)
+        return entry
+
+    def _overlay_config(self, overlay: Mapping[str, Any] | None,
+                        ) -> CruiseControlConfig:
+        merged = dict(self._base.originals())
+        merged.update(overlay or {})
+        return CruiseControlConfig(merged)
+
+    def _wire(self, entry: FleetEntry) -> None:
+        """Attach the fleet hooks to a cluster's context: grid padding on
+        every model build, and self-healing routed through the scheduler
+        at top priority."""
+        grid = self._grid
+
+        def pad_hook(state, meta, _entry=entry):
+            padded, meta = grid.pad_model(state, meta)
+            _entry.shape = (state.num_brokers, state.num_partitions)
+            _entry.bucket = (padded.num_brokers, padded.num_partitions)
+            return padded, meta
+
+        entry.cc.load_monitor.model_transform = pad_hook
+        if self._scheduler is not None:
+            sched, cid = self._scheduler, entry.cluster_id
+
+            def run_fix(fn, _entry=entry):
+                if _entry.paused:
+                    # Expected administrative state, not a failure: report
+                    # "fix did not start" instead of raising, so the
+                    # anomaly manager neither stack-traces nor counts a
+                    # fix failure for every anomaly on a paused cluster.
+                    LOG.debug("fleet: cluster %s paused; self-healing "
+                              "fix skipped", cid)
+                    return False
+                if not sched.running:
+                    # No worker draining the queue (not started yet, shut
+                    # down, or a run_pending-driven embedder): blocking on
+                    # the future would hang the anomaly-handler thread
+                    # forever. Run inline — correctness over fairness.
+                    return fn()
+                from concurrent.futures import CancelledError
+                try:
+                    return sched.submit(cid, JobKind.SELF_HEALING,
+                                        fn).result()
+                except CancelledError:
+                    # Scheduler shut down underneath us; CancelledError
+                    # is a BaseException the anomaly manager's `except
+                    # Exception` would NOT catch — translate to "fix did
+                    # not start" so the detector thread survives.
+                    LOG.info("fleet: self-healing fix for %s cancelled by "
+                             "scheduler shutdown", cid)
+                    return False
+
+            entry.cc.anomaly_detector.fix_runner = run_fix
+
+    def deregister(self, cluster_id: str) -> None:
+        with self._lock:
+            entry = self._entries.get(cluster_id)
+            if entry is None:
+                # Absent, or a mid-register reservation placeholder —
+                # popping the placeholder would break the in-flight
+                # register's duplicate guard.
+                raise UnknownClusterError(cluster_id)
+            del self._entries[cluster_id]
+        # Unwire the fleet hooks either way: an embedder-owned facade
+        # handed back must stop padding onto the fleet grid and stop
+        # submitting fixes to a scheduler it no longer belongs to.
+        entry.cc.load_monitor.model_transform = None
+        entry.cc.anomaly_detector.fix_runner = None
+        if entry.owns_cc:
+            try:
+                entry.cc.shutdown()
+            except Exception:  # noqa: BLE001 — removal must complete
+                LOG.exception("fleet: shutdown of %s failed", cluster_id)
+        from ..utils.sensors import SENSORS
+        SENSORS.remove_labeled("cluster", cluster_id)
+        self._refresh_gauges()
+        LOG.info("fleet: deregistered cluster %s", cluster_id)
+
+    def pause(self, cluster_id: str) -> None:
+        self.entry(cluster_id).paused = True
+        self._refresh_gauges()
+
+    def resume(self, cluster_id: str) -> None:
+        self.entry(cluster_id).paused = False
+        self._refresh_gauges()
+
+    # -- lookup ------------------------------------------------------------
+    def entry(self, cluster_id: str) -> FleetEntry:
+        with self._lock:
+            entry = self._entries.get(cluster_id)
+        if entry is None:
+            raise UnknownClusterError(cluster_id)
+        return entry
+
+    def get(self, cluster_id: str,
+            for_operation: bool = False) -> CruiseControl:
+        """The cluster's facade; ``for_operation=True`` additionally
+        refuses paused clusters (mutating/solver paths)."""
+        entry = self.entry(cluster_id)
+        if for_operation and entry.paused:
+            raise ClusterPausedError(f"cluster {cluster_id!r} is paused")
+        return entry.cc
+
+    def cluster_id_of(self, cc: CruiseControl) -> str | None:
+        """Reverse lookup: the cluster id a facade is registered under,
+        or None. Lets the API treat a no-?cluster= request against a
+        registered default facade as THAT cluster's request (scheduler
+        routing + pause semantics apply either way)."""
+        with self._lock:
+            for cid, e in self._entries.items():
+                if e is not None and e.cc is cc:
+                    return cid
+        return None
+
+    def cluster_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(cid for cid, e in self._entries.items()
+                          if e is not None)
+
+    def entries(self) -> list[FleetEntry]:
+        with self._lock:
+            return [e for e in self._entries.values() if e is not None]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(1 for e in self._entries.values() if e is not None)
+
+    # -- observability -----------------------------------------------------
+    def _refresh_gauges(self) -> None:
+        from ..utils.sensors import SENSORS
+        entries = self.entries()
+        SENSORS.gauge("fleet_clusters_registered", len(entries))
+        SENSORS.gauge("fleet_clusters_paused",
+                      sum(1 for e in entries if e.paused))
+        SENSORS.gauge("fleet_bucket_shapes",
+                      len({e.bucket for e in entries if e.bucket}))
+
+    def state(self) -> dict:
+        """The FLEET endpoint body."""
+        clusters = {}
+        for e in self.entries():
+            row: dict[str, Any] = {
+                "paused": e.paused,
+                "registeredAtMs": e.registered_at_ms,
+            }
+            if e.shape is not None:
+                row["numBrokers"], row["numPartitions"] = e.shape
+            if e.bucket is not None:
+                row["bucketBrokers"], row["bucketPartitions"] = e.bucket
+            try:
+                with e.cc._proposal_lock:
+                    row["proposalReady"] = e.cc._proposal_cache is not None
+            except Exception:  # noqa: BLE001 — state is best-effort
+                row["proposalReady"] = False
+            clusters[e.cluster_id] = row
+        buckets = sorted({e.bucket for e in self.entries()
+                          if e.bucket is not None})
+        body = {
+            "clusters": clusters,
+            "numClusters": len(clusters),
+            "bucketShapes": [list(b) for b in buckets],
+            "grid": {"brokerBase": self._grid.broker_base,
+                     "partitionBase": self._grid.partition_base,
+                     "factor": self._grid.factor},
+        }
+        if self._scheduler is not None:
+            body["scheduler"] = {
+                "pendingJobs": self._scheduler.pending(),
+                "jobsRun": self._scheduler.jobs_run,
+            }
+        return body
+
+    def shutdown(self) -> None:
+        for cid in self.cluster_ids():
+            try:
+                self.deregister(cid)
+            except UnknownClusterError:
+                pass

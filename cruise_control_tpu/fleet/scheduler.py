@@ -1,0 +1,302 @@
+"""Fair solver-work scheduler: one device, many clusters.
+
+All solver work in a fleet funnels through ONE device (or mesh); this
+scheduler decides whose work runs next. Three priority classes —
+self-healing > expiring proposal cache > on-demand requests — with
+round-robin fairness ACROSS clusters inside each class, and a
+starvation bound: any job that has waited longer than the bound runs
+next regardless of class, oldest first, so a cluster flooding a higher
+class can delay but never indefinitely starve another cluster's work.
+
+The reference has no analogue (one JVM per cluster = the OS scheduler);
+the closest relative is GoalOptimizer's proposal-precompute executor
+(GoalOptimizer.java:112-119), which this subsumes fleet-wide: the
+pacer enqueues one EXPIRING_CACHE job per cluster at that cluster's
+configured cadence (fleet.precompute.cadence.ms) whenever its proposal
+cache is no longer fresh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable
+
+LOG = logging.getLogger(__name__)
+
+
+class JobKind(enum.IntEnum):
+    """Priority classes, lower = more urgent."""
+
+    SELF_HEALING = 0
+    EXPIRING_CACHE = 1
+    ON_DEMAND = 2
+
+
+@dataclasses.dataclass
+class SolverJob:
+    kind: JobKind
+    cluster_id: str
+    fn: Callable[[], Any]
+    future: Future
+    enqueued_at: float
+    seq: int
+
+
+class FleetScheduler:
+    """Single-consumer priority queue over the fleet's solver work.
+
+    ``submit`` returns a Future; one worker thread (or a test calling
+    ``run_pending`` synchronously) drains the queue. ``clock`` is
+    injectable so starvation/fairness behavior is testable without
+    real waiting.
+    """
+
+    @classmethod
+    def from_config(cls, config) -> "FleetScheduler":
+        """Build with the configured starvation bound
+        (fleet.scheduler.starvation.bound.ms)."""
+        return cls(starvation_bound_s=config.get_long(
+            "fleet.scheduler.starvation.bound.ms") / 1000.0)
+
+    def __init__(self, starvation_bound_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self._starvation_bound_s = starvation_bound_s
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._queue: list[SolverJob] = []
+        self._seq = 0
+        self._picks = 0
+        # cluster -> pick-counter value of its last pick, for round-robin
+        # fairness inside a priority class (least recently served wins).
+        self._last_served: dict[str, int] = {}
+        self._stop = threading.Event()
+        self._shut = False
+        self._worker: threading.Thread | None = None
+        self._pacer: threading.Thread | None = None
+        self._registry = None
+        self._jobs_run = 0
+        # (cluster_id, kind) of the job currently executing, so the pacer
+        # can see in-flight work pending() no longer counts.
+        self._active: tuple[str, JobKind] | None = None
+
+    # -- submission --------------------------------------------------------
+    def submit(self, cluster_id: str, kind: JobKind,
+               fn: Callable[[], Any]) -> Future:
+        job = SolverJob(kind=kind, cluster_id=cluster_id, fn=fn,
+                        future=Future(), enqueued_at=self._clock(),
+                        seq=self._next_seq())
+        with self._cond:
+            if self._shut:
+                # After shutdown nothing drains the queue; a queued job's
+                # .result() would block its caller forever. Run inline —
+                # correctness over fairness (mirrors the not-running
+                # guards at the call sites).
+                inline = True
+            else:
+                inline = False
+                self._queue.append(job)
+                self._cond.notify()
+        from ..utils.sensors import SENSORS
+        SENSORS.count("fleet_scheduler_jobs_submitted",
+                      labels={"cluster": cluster_id, "kind": kind.name})
+        if inline:
+            self._run(job)
+        return job.future
+
+    def _next_seq(self) -> int:
+        with self._cond:
+            self._seq += 1
+            return self._seq
+
+    def pending(self, cluster_id: str | None = None,
+                kind: JobKind | None = None) -> int:
+        with self._cond:
+            return sum(1 for j in self._queue
+                       if (cluster_id is None or j.cluster_id == cluster_id)
+                       and (kind is None or j.kind == kind))
+
+    # -- selection ---------------------------------------------------------
+    def _pick_locked(self) -> SolverJob | None:
+        """Next job under priority + fairness + the starvation bound.
+        Caller holds the condition lock."""
+        if not self._queue:
+            return None
+        now = self._clock()
+        overdue = [j for j in self._queue
+                   if now - j.enqueued_at >= self._starvation_bound_s]
+        if overdue:
+            # The bound dominates everything: oldest overdue job first.
+            job = min(overdue, key=lambda j: (j.enqueued_at, j.seq))
+        else:
+            best_kind = min(j.kind for j in self._queue)
+            in_class = [j for j in self._queue if j.kind == best_kind]
+            # Round-robin by cluster: the cluster served longest ago goes
+            # first; within a cluster, FIFO.
+            job = min(in_class, key=lambda j: (
+                self._last_served.get(j.cluster_id, 0), j.seq))
+        self._queue.remove(job)
+        self._picks += 1
+        self._last_served[job.cluster_id] = self._picks
+        # Marked active HERE, under the same lock as the dequeue: a
+        # pacer sweep must never observe the job as neither queued nor
+        # active (the window between dequeue and execution).
+        self._active = (job.cluster_id, job.kind)
+        return job
+
+    def _run(self, job: SolverJob) -> None:
+        from ..utils.sensors import SENSORS, cluster_label
+        wait_s = self._clock() - job.enqueued_at
+        SENSORS.record_timer("fleet_scheduler_queue_wait",
+                             max(wait_s, 0.0),
+                             labels={"cluster": job.cluster_id,
+                                     "kind": job.kind.name})
+        t0 = time.monotonic()
+        try:
+            with cluster_label(job.cluster_id):
+                result = job.fn()
+        except BaseException as e:  # noqa: BLE001 — carried by the future
+            job.future.set_exception(e)
+        else:
+            job.future.set_result(result)
+        finally:
+            with self._cond:
+                self._active = None
+        self._jobs_run += 1
+        SENSORS.record_timer("fleet_scheduler_job",
+                             time.monotonic() - t0,
+                             labels={"cluster": job.cluster_id,
+                                     "kind": job.kind.name})
+
+    def run_pending(self, max_jobs: int | None = None) -> int:
+        """Synchronously drain queued jobs on the calling thread (the
+        deterministic test driver; also usable by an embedder that wants
+        its own loop). Returns the number of jobs run."""
+        ran = 0
+        while max_jobs is None or ran < max_jobs:
+            with self._cond:
+                job = self._pick_locked()
+            if job is None:
+                break
+            self._run(job)
+            ran += 1
+        return ran
+
+    # -- worker + precompute pacer ----------------------------------------
+    def bind(self, registry) -> None:
+        """Attach the registry whose clusters the pacer sweeps (called by
+        FleetRegistry at construction; no threads started)."""
+        self._registry = registry
+
+    def start(self, registry=None, pacer_interval_s: float = 1.0,
+              pacer: bool = True) -> None:
+        """Start the worker thread; with a registry (or one already
+        bound), also the precompute pacer that keeps every unpaused
+        cluster's proposal cache warm at its configured cadence
+        (``pacer=False`` starts the worker alone)."""
+        registry = registry or self._registry
+        self._registry = registry
+        with self._cond:
+            self._shut = False
+        if self._worker is None or not self._worker.is_alive():
+            self._stop.clear()
+            self._worker = threading.Thread(target=self._worker_loop,
+                                            daemon=True, name="fleet-solver")
+            self._worker.start()
+        if pacer and registry is not None and (self._pacer is None
+                                               or not self._pacer.is_alive()):
+            self._pacer = threading.Thread(
+                target=self._pacer_loop, args=(pacer_interval_s,),
+                daemon=True, name="fleet-precompute-pacer")
+            self._pacer.start()
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._cond:
+                job = self._pick_locked()
+                if job is None:
+                    self._cond.wait(timeout=0.2)
+                    continue
+            self._run(job)
+
+    def _pacer_loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self.pace_once()
+            except Exception:  # noqa: BLE001 — pacing must not die
+                LOG.exception("fleet precompute pacing failed")
+
+    def pace_once(self) -> int:
+        """One pacing sweep: enqueue an EXPIRING_CACHE precompute for
+        every unpaused cluster whose cadence has elapsed and that has no
+        precompute already queued. Returns the number enqueued."""
+        if self._registry is None:
+            return 0
+        n = 0
+        for entry in self._registry.entries():
+            if entry.paused:
+                continue
+            cadence_s = entry.config.get_long(
+                "fleet.precompute.cadence.ms") / 1000.0
+            now = self._clock()
+            if now - entry.last_precompute < cadence_s:
+                continue
+            with self._cond:
+                # One lock acquisition for BOTH states: a precompute that
+                # is queued or still executing must suppress re-enqueue —
+                # chaining redundant back-to-back solves would hog the
+                # device for any cluster whose precompute outlasts its
+                # cadence.
+                key = (entry.cluster_id, JobKind.EXPIRING_CACHE)
+                busy = self._active == key or any(
+                    (j.cluster_id, j.kind) == key for j in self._queue)
+            if busy:
+                continue
+            entry.last_precompute = now
+            cc, cid = entry.cc, entry.cluster_id
+            fut = self.submit(cid, JobKind.EXPIRING_CACHE,
+                              lambda cc=cc: cc.proposals())
+
+            def report(f, cid=cid):
+                # The pacer owns this future — surface failures, else a
+                # cluster whose precompute consistently fails would serve
+                # a cold cache with no trace anywhere.
+                exc = None if f.cancelled() else f.exception()
+                if exc is not None:
+                    LOG.warning("fleet: precompute for %s failed: %s",
+                                cid, exc)
+                    from ..utils.sensors import SENSORS
+                    SENSORS.count("fleet_precompute_failures",
+                                  labels={"cluster": cid})
+
+            fut.add_done_callback(report)
+            n += 1
+        return n
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._shut = True
+            self._cond.notify_all()
+        for t in (self._worker, self._pacer):
+            if t is not None and t.is_alive():
+                t.join(timeout=10.0)
+        self._worker = self._pacer = None
+        with self._cond:
+            leftovers, self._queue = self._queue, []
+        for job in leftovers:
+            job.future.cancel()
+
+    @property
+    def jobs_run(self) -> int:
+        return self._jobs_run
+
+    @property
+    def running(self) -> bool:
+        """True while a worker thread is draining the queue (callers that
+        would block on a Future must run inline when nothing drains)."""
+        return self._worker is not None and self._worker.is_alive()

@@ -106,6 +106,11 @@ class LoadMonitor:
             config.get_int("solver.partition.bucket.size")
             if partition_bucket is None else partition_bucket)
         self._broker_bucket = config.get_int("solver.broker.bucket.size")
+        # Post-build model hook: (state, meta) -> (state, meta), applied to
+        # every cluster_model result. The fleet registry installs the
+        # shared BucketGrid's padding here so all of a process's clusters
+        # land on the same compiled solver shapes (fleet.bucketing).
+        self.model_transform = None
 
         self._partition_agg = MetricSampleAggregator(
             num_windows=config.get("num.partition.metrics.windows"),
@@ -326,6 +331,8 @@ class LoadMonitor:
             agg = self._partition_agg.aggregate(opts)
             step("GeneratingClusterModel")
             built = self._build(partitions, alive, agg, reduction)
+            if self.model_transform is not None:
+                built = self.model_transform(*built)
         # cluster-model-creation-timer (LoadMonitor.java:177).
         from ..utils.sensors import SENSORS
         SENSORS.record_timer("monitor_cluster_model_creation",

@@ -30,7 +30,6 @@ from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..analyzer.candidates import (
@@ -55,7 +54,7 @@ from ..analyzer.search import (
 )
 from ..common.resources import Resource
 from ..model.tensors import ClusterTensors, offline_replicas
-from .mesh import PARTITION_AXIS
+from .mesh import PARTITION_AXIS, shard_map
 from .sharded import _mask_specs, _psum, _state_specs
 
 

@@ -28,7 +28,6 @@ from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..analyzer.candidates import Candidates, CandidateDeltas, compute_deltas
@@ -40,7 +39,7 @@ from ..analyzer.search import (
     reduce_per_source, run_rounds_loop, score_round_candidates,
 )
 from ..model.tensors import ClusterTensors
-from .mesh import PARTITION_AXIS
+from .mesh import PARTITION_AXIS, shard_map
 
 
 def _state_specs() -> ClusterTensors:

@@ -16,9 +16,34 @@ floats beyond a plain mutex, nothing device-side.
 
 from __future__ import annotations
 
+import contextvars
 import threading
+from contextlib import contextmanager
 
 _PREFIX = "kafka_cruisecontrol"
+
+# Ambient per-cluster label (fleet federation): work executed on behalf of
+# a registered cluster — a scheduler job, a ?cluster=-routed API request —
+# runs inside ``cluster_label(cid)``, and every sensor written underneath
+# picks up the ``cluster`` label without touching the call sites. Scoped
+# via ContextVar so concurrent per-cluster work cannot mislabel each other.
+_CLUSTER: contextvars.ContextVar[str | None] = \
+    contextvars.ContextVar("sensor_cluster_label", default=None)
+
+
+@contextmanager
+def cluster_label(cluster_id: str | None):
+    """Attribute all sensors recorded inside the block to ``cluster_id``
+    (None = no-op, so call sites need no branching)."""
+    token = _CLUSTER.set(cluster_id)
+    try:
+        yield
+    finally:
+        _CLUSTER.reset(token)
+
+
+def current_cluster_label() -> str | None:
+    return _CLUSTER.get()
 
 
 class SensorRegistry:
@@ -33,6 +58,9 @@ class SensorRegistry:
 
     @staticmethod
     def _key(name: str, labels: dict | None) -> tuple[str, tuple]:
+        cluster = _CLUSTER.get()
+        if cluster is not None and "cluster" not in (labels or {}):
+            labels = {**(labels or {}), "cluster": cluster}
         return name, tuple(sorted((labels or {}).items()))
 
     def count(self, name: str, value: float = 1.0,
@@ -58,6 +86,20 @@ class SensorRegistry:
             self._counters.clear()
             self._gauges.clear()
             self._timers.clear()
+
+    def remove_labeled(self, label: str, value: str) -> int:
+        """Drop every series carrying ``label=value`` (fleet deregister:
+        a removed cluster's series must disappear from the export, not
+        freeze at their last values). Returns the number removed."""
+        pair = (label, value)
+        removed = 0
+        with self._lock:
+            for store in (self._counters, self._gauges, self._timers):
+                stale = [k for k in store if pair in k[1]]
+                for k in stale:
+                    del store[k]
+                removed += len(stale)
+        return removed
 
     # -- exposition --------------------------------------------------------
     @staticmethod

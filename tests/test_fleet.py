@@ -1,0 +1,501 @@
+"""Fleet federation (cruise_control_tpu/fleet/): bucketing equivalence,
+registry lifecycle, scheduler fairness/starvation bound, shared-kernel
+compile accounting, and ?cluster= API routing."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.chain import chain_optimize_full, optimize_chain
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.goals import (
+    LeaderReplicaDistributionGoal, RackAwareGoal, ReplicaCapacityGoal,
+    ReplicaDistributionGoal, TopicReplicaDistributionGoal,
+)
+from cruise_control_tpu.analyzer.search import ExclusionMasks, SearchConfig
+from cruise_control_tpu.common.broker_state import BrokerState
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+from cruise_control_tpu.executor.admin import InMemoryAdminBackend, PartitionState
+from cruise_control_tpu.executor.executor import Executor
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.fleet import (
+    BucketGrid, ClusterPausedError, FleetRegistry, FleetScheduler, JobKind,
+    UnknownClusterError, pad_to_bucket, unpad_state,
+)
+from cruise_control_tpu.fleet.bucketing import geometric_round_up
+from cruise_control_tpu.model.fixtures import random_cluster
+from cruise_control_tpu.monitor import LoadMonitor, StaticCapacityResolver
+from cruise_control_tpu.monitor.sampling import SyntheticSampler
+
+# ---- shared fixtures -----------------------------------------------------
+
+_CAPS = StaticCapacityResolver({}, {Resource.CPU: 100.0, Resource.DISK: 1e7,
+                                    Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6})
+
+
+def _partitions(brokers=(0, 1, 2, 3), topics=2, parts=6):
+    out = {}
+    for t in range(topics):
+        for p in range(parts):
+            reps = (brokers[0], brokers[1 + (t + p) % (len(brokers) - 1)])
+            out[(f"t{t}", p)] = PartitionState(f"t{t}", p, reps, reps[0],
+                                               isr=reps)
+    return out
+
+
+def _base_config(extra=None):
+    return CruiseControlConfig({
+        "partition.metrics.window.ms": 1000,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "max.solver.rounds": 30,
+        "failed.brokers.file.path": "",
+        # The fleet grid replaces the builder's per-cluster buckets.
+        "solver.partition.bucket.size": 0,
+        "solver.broker.bucket.size": 0,
+        "fleet.bucket.broker.base": 4,
+        "fleet.bucket.partition.base": 16,
+        "fleet.bucket.topic.base": 8,
+        **(extra or {})})
+
+
+def _make_cc(config, partitions, optimizer=None):
+    backend = InMemoryAdminBackend(partitions.values())
+    monitor = LoadMonitor(config, backend, samplers=[SyntheticSampler()],
+                          capacity_resolver=_CAPS)
+    cc = CruiseControl(config, backend, load_monitor=monitor,
+                       executor=Executor(backend, synchronous=True),
+                       optimizer=optimizer)
+    for k in range(1, 4):
+        monitor.task_runner.run_sampling_once(end_ms=k * 1000)
+    return cc
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """A two-cluster fleet sharing one solver through the bucket grid:
+    different topic and partition counts, same bucket. Shapes are chosen
+    inside the byte-identical regime (see the equivalence test below):
+    the search grid must fit the REAL shape, so the broker count sits on
+    a grid point and the real replica-slot count exceeds the source
+    width."""
+    base = _base_config()
+    scheduler = FleetScheduler(starvation_bound_s=30.0)
+    registry = FleetRegistry(base_config=base, scheduler=scheduler)
+    brokers = tuple(range(16))
+    registry.register(
+        "alpha", cc=_make_cc(base, _partitions(brokers, topics=2, parts=65),
+                             optimizer=registry.optimizer))
+    registry.register(
+        "beta", cc=_make_cc(base, _partitions(brokers, topics=3, parts=67),
+                            optimizer=registry.optimizer))
+    yield registry, scheduler
+    scheduler.shutdown()
+
+
+# ---- bucketing -----------------------------------------------------------
+
+def test_geometric_round_up_grid():
+    assert geometric_round_up(1, 4, 2.0) == 4
+    assert geometric_round_up(4, 4, 2.0) == 4
+    assert geometric_round_up(5, 4, 2.0) == 8
+    assert geometric_round_up(100, 4, 2.0) == 128
+    # Fleet-wide property: any two clusters within one grid step share
+    # a bucket; the grid has O(log n) points up to n.
+    grid = BucketGrid(broker_base=4, partition_base=16, factor=2.0)
+    assert grid.bucket_shape(3, 24) == grid.bucket_shape(4, 32) == (4, 32)
+    points = {geometric_round_up(n, 16, 2.0) for n in range(1, 4096)}
+    assert len(points) == 9  # 16 .. 4096: one bucket per octave
+
+
+def test_pad_to_bucket_matches_builder_encoding():
+    state, meta = random_cluster(num_brokers=5, num_topics=3,
+                                 num_partitions=20, rf=2, num_racks=2, seed=7)
+    padded = pad_to_bucket(state, 8, 32, num_hosts=len(meta.host_names))
+    assert padded.num_brokers == 8 and padded.num_partitions == 32
+    # Pad brokers: DEAD, zero capacity, masked, private host ids.
+    assert np.all(np.asarray(padded.broker_state[5:]) == int(BrokerState.DEAD))
+    assert np.all(np.asarray(padded.capacity[5:]) == 0)
+    assert not np.asarray(padded.broker_mask[5:]).any()
+    assert len(set(np.asarray(padded.host).tolist())) == 8
+    # Pad partitions: empty, masked.
+    assert np.all(np.asarray(padded.assignment[20:]) == -1)
+    assert np.all(np.asarray(padded.leader_slot[20:]) == -1)
+    assert not np.asarray(padded.partition_mask[20:]).any()
+    # Exact round-trip.
+    back = unpad_state(padded, 5, 20)
+    for f in ("assignment", "leader_slot", "leader_load", "follower_load",
+              "capacity", "rack", "broker_state", "topic", "partition_mask",
+              "broker_mask", "host"):
+        np.testing.assert_array_equal(np.asarray(getattr(back, f)),
+                                      np.asarray(getattr(state, f)))
+
+
+_EQ_CHAIN = (RackAwareGoal(), ReplicaCapacityGoal(),
+             ReplicaDistributionGoal(), TopicReplicaDistributionGoal(),
+             LeaderReplicaDistributionGoal())
+_EQ_CFG = SearchConfig(num_sources=32, num_dests=8, moves_per_round=32,
+                       max_rounds=40)
+
+
+@pytest.mark.parametrize("bucket", [(16, 64, 8), (24, 96, 12)])
+def test_padded_chain_trajectory_byte_identical(bucket):
+    """The padding-soundness contract at two bucket sizes: the whole-chain
+    solve on the padded model must land on EXACTLY the same assignment
+    and leadership for the real rows as the unpadded solve, with the same
+    per-goal move/round counts — padded brokers/partitions/topics are
+    invisible to the search.
+
+    Byte-identity requires the static search grid to fit the REAL shape
+    (num_dests and the swap k within the real broker count, num_sources
+    within the real replica-slot count): the grid's top-k sizes clamp to
+    min(k, shape), so a grid larger than the real cluster would change
+    the selection STRUCTURE — not just its contents — when padding grows
+    the shape. The fleet's bucket grid operates in that regime by
+    construction (grids are sized for production scale, pads are < one
+    octave)."""
+    nb, npart, ntop = bucket
+    state, meta = random_cluster(num_brokers=12, num_topics=5,
+                                 num_partitions=48, rf=2, num_racks=3,
+                                 seed=11, skew_to_first=2.0)
+    constraint = BalancingConstraint()
+    masks = ExclusionMasks()
+
+    final_plain, infos_plain = optimize_chain(
+        state, _EQ_CHAIN, constraint, _EQ_CFG, meta.num_topics, masks)
+    padded = pad_to_bucket(state, nb, npart,
+                           num_hosts=len(meta.host_names))
+    final_pad, infos_pad = optimize_chain(
+        padded, _EQ_CHAIN, constraint, _EQ_CFG, ntop, masks)
+
+    real = unpad_state(final_pad, state.num_brokers, state.num_partitions)
+    np.testing.assert_array_equal(np.asarray(real.assignment),
+                                  np.asarray(final_plain.assignment))
+    np.testing.assert_array_equal(np.asarray(real.leader_slot),
+                                  np.asarray(final_plain.leader_slot))
+    # No replica may ever land on a pad broker.
+    assert int(np.asarray(final_pad.assignment).max()) < state.num_brokers
+    # Pad rows stay untouched.
+    assert np.all(np.asarray(final_pad.assignment[state.num_partitions:])
+                  == -1)
+    for a, b in zip(infos_plain, infos_pad):
+        assert (a["goal"], a["rounds"], a["moves_applied"],
+                a["swaps_applied"]) == \
+            (b["goal"], b["rounds"], b["moves_applied"], b["swaps_applied"])
+
+
+# ---- registry + shared solver -------------------------------------------
+
+def test_fleet_serves_both_clusters_through_shared_kernels(fleet):
+    """Acceptance: a two-cluster fleet serves proposals for both clusters
+    with total chain compilations <= distinct bucket shapes (not
+    clusters), and each cluster's padded solve equals its unpadded one."""
+    registry, scheduler = fleet
+    cache0 = chain_optimize_full._cache_size()
+    futs = {cid: scheduler.submit(cid, JobKind.ON_DEMAND,
+                                  lambda cid=cid: registry.get(cid).proposals())
+            for cid in ("alpha", "beta")}
+    scheduler.run_pending()
+    results = {cid: f.result() for cid, f in futs.items()}
+    assert all(r.proposals for r in results.values())
+
+    entries = {e.cluster_id: e for e in registry.entries()}
+    buckets = {entries[c].bucket for c in ("alpha", "beta")}
+    assert buckets == {(16, 256)}  # same grid point, different shapes
+    compiles = chain_optimize_full._cache_size() - cache0
+    assert compiles <= len(buckets), \
+        f"{compiles} chain compiles for {len(buckets)} bucket shape(s)"
+
+    # Per-cluster padded-vs-unpadded equality end to end: rebuild each
+    # model WITHOUT the fleet pad hook and solve with the same static
+    # search configuration the fleet used (derived from the padded
+    # shape); the proposal set must match byte for byte.
+    for cid in ("alpha", "beta"):
+        cc = registry.get(cid)
+        hook, cc.load_monitor.model_transform = \
+            cc.load_monitor.model_transform, None
+        try:
+            state, meta = cc.load_monitor.cluster_model()
+        finally:
+            cc.load_monitor.model_transform = hook
+        from cruise_control_tpu.analyzer.optimizer import goals_by_priority
+        from cruise_control_tpu.analyzer.proposals import diff_proposals
+        chain = tuple(goals_by_priority(cc.config))
+        cfg = registry.optimizer.search_config(state)
+        final, _ = optimize_chain(state, chain,
+                                  registry.optimizer.constraint, cfg,
+                                  meta.num_topics, ExclusionMasks())
+        plain = diff_proposals(state, final, meta)
+        assert list(results[cid].proposals) == list(plain)
+
+
+def test_registry_lifecycle():
+    base = _base_config()
+    registry = FleetRegistry(base_config=base)
+    backend = InMemoryAdminBackend(_partitions().values())
+    entry = registry.register("gamma", admin=backend,
+                              overlay={"max.solver.rounds": 7})
+    # Overlay wins over base for this cluster only.
+    assert entry.config.get_int("max.solver.rounds") == 7
+    assert base.get_int("max.solver.rounds") == 30
+    assert registry.cluster_ids() == ["gamma"]
+    with pytest.raises(ValueError, match="already registered"):
+        registry.register("gamma", admin=backend)
+    with pytest.raises(ValueError, match="exactly one"):
+        registry.register("delta")
+    with pytest.raises(ValueError, match="overlay"):
+        registry.register("delta", cc=entry.cc,
+                          overlay={"max.solver.rounds": 5})
+    assert registry.cluster_id_of(entry.cc) == "gamma"
+
+    registry.pause("gamma")
+    assert registry.get("gamma") is entry.cc  # reads still allowed
+    with pytest.raises(ClusterPausedError):
+        registry.get("gamma", for_operation=True)
+    registry.resume("gamma")
+    assert registry.get("gamma", for_operation=True) is entry.cc
+
+    with pytest.raises(UnknownClusterError):
+        registry.get("nope")
+    cc = entry.cc
+    assert cc.load_monitor.model_transform is not None
+    from cruise_control_tpu.utils.sensors import SENSORS
+    SENSORS.gauge("fleet_test_lifecycle_gauge", 1.0,
+                  labels={"cluster": "gamma"})
+    registry.deregister("gamma")
+    assert registry.cluster_ids() == []
+    # Deregistration hands the facade back clean: the fleet pad hook and
+    # the scheduler-routed fix runner are both detached, and the
+    # cluster's labeled sensor series are dropped from the export.
+    assert cc.load_monitor.model_transform is None
+    assert cc.anomaly_detector.fix_runner is None
+    assert 'cluster="gamma"' not in SENSORS.render()
+    with pytest.raises(UnknownClusterError):
+        registry.deregister("gamma")
+
+
+def test_registry_state_reports_buckets(fleet):
+    registry, _ = fleet
+    body = registry.state()
+    assert body["numClusters"] == 2
+    assert set(body["clusters"]) == {"alpha", "beta"}
+    for row in body["clusters"].values():
+        assert row["bucketBrokers"] == 16
+        assert row["bucketPartitions"] == 256
+    assert body["bucketShapes"] == [[16, 256]]
+    assert "scheduler" in body
+
+
+# ---- scheduler -----------------------------------------------------------
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_scheduler_from_config_reads_starvation_bound():
+    sched = FleetScheduler.from_config(
+        _base_config({"fleet.scheduler.starvation.bound.ms": 5_000}))
+    assert sched._starvation_bound_s == 5.0
+
+
+def test_fix_runner_runs_inline_when_no_worker_drains():
+    """A self-healing fix must not block on a future nobody serves: with
+    the scheduler worker not running, the runner executes inline."""
+    base = _base_config()
+    scheduler = FleetScheduler()  # never started
+    registry = FleetRegistry(base_config=base, scheduler=scheduler)
+    entry = registry.register(
+        "solo", cc=_make_cc(base, _partitions(), optimizer=registry.optimizer))
+    assert entry.cc.anomaly_detector.fix_runner(lambda: "healed") == "healed"
+    assert scheduler.pending() == 0
+
+
+def test_scheduler_priorities_and_round_robin_fairness():
+    clock = _FakeClock()
+    sched = FleetScheduler(starvation_bound_s=1e9, clock=clock)
+    order = []
+
+    def job(tag):
+        return lambda: order.append(tag)
+
+    # Interleave submissions: on-demand flood from A, precompute for A
+    # and B, one self-healing for B.
+    for i in range(3):
+        sched.submit("A", JobKind.ON_DEMAND, job(f"A-od{i}"))
+    sched.submit("A", JobKind.EXPIRING_CACHE, job("A-pre"))
+    sched.submit("B", JobKind.EXPIRING_CACHE, job("B-pre"))
+    sched.submit("B", JobKind.SELF_HEALING, job("B-heal"))
+    sched.submit("B", JobKind.ON_DEMAND, job("B-od"))
+    assert sched.run_pending() == 7
+    # Highest class first; inside a class, clusters alternate; inside a
+    # cluster, FIFO. B just ran (healing), so the cache class starts at A.
+    assert order[0] == "B-heal"
+    assert order[1:3] == ["A-pre", "B-pre"]
+    # On-demand: A has 3 queued vs B's 1 — B must not wait for all of A.
+    assert order[3:] == ["A-od0", "B-od", "A-od1", "A-od2"]
+
+
+def test_scheduler_starvation_bound_overrides_priority():
+    clock = _FakeClock()
+    sched = FleetScheduler(starvation_bound_s=10.0, clock=clock)
+    order = []
+    sched.submit("A", JobKind.ON_DEMAND, lambda: order.append("A-old"))
+    clock.now += 11.0  # A's on-demand is now past the bound
+    sched.submit("B", JobKind.SELF_HEALING, lambda: order.append("B-heal"))
+    sched.run_pending()
+    assert order == ["A-old", "B-heal"]
+
+
+def test_flooded_cluster_cannot_starve_other_precompute(fleet):
+    """Acceptance: with one cluster flooding on-demand requests, the
+    other cluster's precompute still runs within its cadence — the
+    EXPIRING_CACHE class outranks ON_DEMAND, and the pacer enqueues it
+    as soon as the cadence elapses."""
+    registry, scheduler = fleet
+    ran = []
+    for i in range(20):
+        scheduler.submit("alpha", JobKind.ON_DEMAND,
+                         lambda i=i: ran.append(f"flood{i}"))
+    # Cadence elapsed for both clusters -> pacer enqueues precompute.
+    for e in registry.entries():
+        e.last_precompute = 0.0
+    assert scheduler.pace_once() == 2
+    scheduler.run_pending(max_jobs=2)
+    # Both precomputes ran BEFORE any of the 20 flooded requests.
+    assert ran == []
+    for e in registry.entries():
+        with e.cc._proposal_lock:
+            assert e.cc._proposal_cache is not None
+    scheduler.run_pending()
+    assert len(ran) == 20
+
+
+def test_self_healing_routes_through_scheduler():
+    base = _base_config()
+    scheduler = FleetScheduler()
+    registry = FleetRegistry(base_config=base, scheduler=scheduler)
+    entry = registry.register(
+        "heal", cc=_make_cc(base, _partitions(), optimizer=registry.optimizer))
+    runner = entry.cc.anomaly_detector.fix_runner
+    assert runner is not None
+    scheduler.start(pacer=False)  # live worker drains the SELF_HEALING job
+    try:
+        assert runner(lambda: "fixed") == "fixed"
+        assert scheduler.jobs_run == 1
+        # Paused = administrative, not a failure: the runner reports "fix
+        # did not start" instead of raising into the anomaly manager.
+        registry.pause("heal")
+        assert runner(lambda: "never") is False
+    finally:
+        scheduler.shutdown()
+    # After shutdown a late submit must not strand its caller: it runs
+    # inline on the submitting thread.
+    assert scheduler.submit("heal", JobKind.SELF_HEALING,
+                            lambda: "late").result(timeout=5) == "late"
+
+
+# ---- API routing ---------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet_api(fleet):
+    from cruise_control_tpu.api.server import CruiseControlApi
+    registry, _ = fleet
+    default_cc = registry.get("alpha")
+    api = CruiseControlApi(default_cc, fleet=registry)
+    api._async_wait_s = 180
+    yield api, registry
+    api.shutdown()
+
+
+def test_api_routes_cluster_parameter(fleet_api):
+    api, registry = fleet_api
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/state",
+                                 "cluster=beta&substates=monitor")
+    assert status == 200
+    beta_parts = registry.get("beta") \
+        ._load_monitor.state().total_num_partitions
+    assert body["MonitorState"]["totalNumPartitions"] == beta_parts
+
+
+def test_api_without_cluster_param_unchanged(fleet_api):
+    api, registry = fleet_api
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/state",
+                                 "substates=monitor")
+    assert status == 200
+    alpha_parts = registry.get("alpha") \
+        ._load_monitor.state().total_num_partitions
+    assert body["MonitorState"]["totalNumPartitions"] == alpha_parts
+
+
+def test_api_unknown_cluster_404(fleet_api):
+    api, _ = fleet_api
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/state",
+                                 "cluster=nope")
+    assert status == 404
+    assert "unknown cluster" in body["errorMessage"]
+
+
+def test_api_paused_cluster_refuses_solver_endpoints(fleet_api):
+    api, registry = fleet_api
+    registry.pause("beta")
+    try:
+        status, body, _ = api.handle("GET", "/kafkacruisecontrol/proposals",
+                                     "cluster=beta")
+        assert status == 409
+        assert "paused" in body["errorMessage"]
+        # Reads keep working while paused.
+        status, _body, _ = api.handle("GET", "/kafkacruisecontrol/state",
+                                      "cluster=beta")
+        assert status == 200
+    finally:
+        registry.resume("beta")
+
+
+def test_api_default_cluster_gets_fleet_semantics(fleet_api):
+    """A request WITHOUT ?cluster= against a default facade that is
+    itself registered is that cluster's request: pausing it refuses
+    solver endpoints on the default route too."""
+    api, registry = fleet_api
+    registry.pause("alpha")  # alpha is the fixture's default facade
+    try:
+        status, body, _ = api.handle("GET", "/kafkacruisecontrol/proposals",
+                                     "")
+        assert status == 409
+        assert "paused" in body["errorMessage"]
+    finally:
+        registry.resume("alpha")
+
+
+def test_api_cluster_param_without_fleet_is_400():
+    from cruise_control_tpu.api.server import CruiseControlApi
+    cc = _make_cc(_base_config(), _partitions())
+    api = CruiseControlApi(cc)
+    try:
+        status, body, _ = api.handle("GET", "/kafkacruisecontrol/state",
+                                     "cluster=alpha")
+        assert status == 400
+        assert "not running a fleet" in body["errorMessage"]
+    finally:
+        api.shutdown()
+
+
+def test_fleet_endpoint_dashboard(fleet_api):
+    api, _ = fleet_api
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/fleet", "")
+    assert status == 200
+    assert body["numClusters"] == 2
+    assert set(body["clusters"]) == {"alpha", "beta"}
+
+
+def test_metrics_carry_cluster_labels(fleet_api):
+    api, _ = fleet_api
+    from cruise_control_tpu.utils.sensors import SENSORS, cluster_label
+    with cluster_label("alpha"):
+        SENSORS.count("fleet_test_labeled_counter")
+    text = api.metrics_text()
+    assert 'fleet_test_labeled_counter_total{cluster="alpha"} 1.0' in text
+    assert 'fleet_cluster_paused{cluster="beta"}' in text

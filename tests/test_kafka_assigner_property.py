@@ -50,22 +50,23 @@ _B, _T, _P, _RF, _RACKS = 18, 6, 361, 2, 4
 # broker forces the at-ceiling free-rack shape.
 #
 # MAX-TIGHT layouts — a 9-broker rack is exactly B/RF, so that rack must
-# absorb one replica of (almost) every partition — are the enumerated
-# residual gap of the r5 deadlock work. With the count-preserving swap
-# exchange (r5) the rack duplicates now fully resolve; the remaining
-# stall shape on some seeds is a SINGLE ceiling+1 count overage stranded
-# on a broker whose shed channel was consumed by the same round's batch
-# (residual ≤ 2, loudly reported). The known fix is an overage-relay
-# move (the overage hops to an at-ceiling broker that still has a shed
-# channel) — it needs a termination argument, since relays can cycle.
-# These run as xfail(strict=False) until that lands (docs/DESIGN.md).
+# absorb one replica of (almost) every partition — were the enumerated
+# residual gap of the r5 deadlock work: a SINGLE ceiling+1 count overage
+# stranded on a broker whose shed channel was consumed by the same
+# round's batch. Round 6 closed the remaining strand mechanism: the
+# own-rack feasibility branch counted the replica's OWN broker as a
+# room-bearing rack-mate, so a self-referential "shed channel" (a move
+# onto the broker already hosting the replica — not a real move) could
+# admit a same-round overshoot whose real channel did not exist. With
+# the own-broker exclusion (_rack_dest_feasibility) every sweep layout,
+# max-tight included, converges — these run unmarked.
 _LAYOUTS = [
     (9, 5, 3, 1),   # max-tight
     (8, 6, 3, 1),
     (9, 4, 4, 1),   # max-tight
     (7, 7, 3, 1),
 ]
-_MAX_TIGHT = {(9, 5, 3, 1), (9, 4, 4, 1)}
+_MAX_TIGHT = {(9, 5, 3, 1), (9, 4, 4, 1)}  # hardest shapes (see above)
 
 
 def _rack_vector(layout: tuple[int, ...]) -> jnp.ndarray:
@@ -90,14 +91,7 @@ def _run(seed: int, layout: tuple[int, ...]):
 
 @pytest.mark.parametrize(
     "seed,layout",
-    [pytest.param(s, lo,
-                  marks=[pytest.mark.xfail(
-                      reason="max-tight rack layout: a single ceiling+1 "
-                      "overage can strand on a shed-less broker (rack "
-                      "duplicates fully resolve via the swap exchange); "
-                      "fails LOUDLY — needs an overage-relay move",
-                      strict=False)] if lo in _MAX_TIGHT else [])
-     for s in (3, 11, 29) for lo in _LAYOUTS])
+    [pytest.param(s, lo) for s in (3, 11, 29) for lo in _LAYOUTS])
 def test_even_rack_skewed_layout_sweep(seed, layout):
     final, res = _run(seed, layout)
     assert res.violated_goals_after == []

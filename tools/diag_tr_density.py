@@ -39,6 +39,7 @@ def main() -> int:
         cumulative_select, apply_selected,
     )
     from cruise_control_tpu.analyzer.candidates import compute_deltas
+    from cruise_control_tpu.analyzer.fill import targets_enabled
     from cruise_control_tpu.config.cruise_control_config import (
         CruiseControlConfig,
     )
@@ -93,7 +94,12 @@ def main() -> int:
         print(f"    valid+accepted {int((valid & acc).sum())}, "
               f"positive-improvement {int(pos.sum())}")
 
-        red_idx = np.asarray(reduce_per_source(score, layout))
+        # Mirror search._round_body: the targeted-destination column is
+        # only present when targets are enabled for this shape, and the
+        # tie-rotation modulo must match production selection exactly.
+        extra_col = targets_enabled(state.num_partitions)
+        red_idx = np.asarray(reduce_per_source(score, layout,
+                                               extra_last_col=extra_col))
         red_score = np.asarray(score)[red_idx]
         good_rows = np.isfinite(red_score) & (red_score > 1e-9)
         print(f"    rows with a usable winner: {int(good_rows.sum())} "
@@ -112,7 +118,7 @@ def main() -> int:
         top_idx, sel, _sub, _pot, _lbi = cumulative_select(
             state, deltas, score, layout, m, wide.moves_per_round,
             False, recheck,
-            extra_last_col=True)
+            extra_last_col=extra_col)
         sel_np = np.asarray(sel)
         print(f"    selected after dedup+recheck: {int(sel_np.sum())}")
         state = apply_selected(
