@@ -74,6 +74,13 @@ class InMemoryAdminBackend:
         self._parts: dict[tuple[str, int], PartitionState] = {
             (p.topic, p.partition): p for p in partitions}
         self._alive: set[int] = {b for p in self._parts.values() for b in p.replicas}
+        # Metadata generation: bumped on every STRUCTURAL topology change
+        # (replica sets, broker liveness) — NOT on leader-only elections,
+        # which the model pipeline re-derives every refresh. The
+        # LoadMonitor's incremental pipeline keys its topology cache on
+        # this: an unchanged generation means the device-resident topology
+        # tensors can be reused without any re-derivation or transfer.
+        self._meta_gen = 0
         self._steps_per_tick = steps_per_tick
         self._dir_moves_per_tick = dir_moves_per_tick
         self._pending_dir_moves: dict[tuple[str, int, int], str] = {}
@@ -85,14 +92,22 @@ class InMemoryAdminBackend:
         self.reassignment_calls = 0
         self.election_calls = 0
 
+    def metadata_generation(self) -> int:
+        """O(1) topology-change stamp (see __init__). Pure read — it must
+        never tick the simulation itself."""
+        with self._lock:
+            return self._meta_gen
+
     # ---- test controls ----------------------------------------------------
     def kill_broker(self, broker: int) -> None:
         with self._lock:
             self._alive.discard(broker)
+            self._meta_gen += 1
 
     def revive_broker(self, broker: int) -> None:
         with self._lock:
             self._alive.add(broker)
+            self._meta_gen += 1
 
     def tick(self) -> None:
         """Advance the simulated cluster one progress interval."""
@@ -128,6 +143,7 @@ class InMemoryAdminBackend:
                 self._parts[key] = PartitionState(
                     topic=p.topic, partition=p.partition, replicas=target,
                     leader=leader, isr=tuple(b for b in target if b in self._alive))
+                self._meta_gen += 1
                 budget -= 1
 
     # ---- AdminBackend protocol -------------------------------------------
@@ -144,6 +160,7 @@ class InMemoryAdminBackend:
                     topic=topic, partition=part, replicas=merged, leader=leader,
                     isr=tuple(b for b in merged if b in self._alive),
                     adding=adding, removing=removing)
+                self._meta_gen += 1
 
     def cancel_partition_reassignments(self, partitions) -> None:
         with self._lock:
@@ -156,6 +173,7 @@ class InMemoryAdminBackend:
                     topic=p.topic, partition=p.partition, replicas=original,
                     leader=p.leader if p.leader in original else original[0],
                     isr=tuple(b for b in original if b in self._alive))
+                self._meta_gen += 1
 
     def elect_leaders(self, partitions) -> None:
         with self._lock:
@@ -164,6 +182,10 @@ class InMemoryAdminBackend:
                 p = self._parts[key]
                 preferred = p.replicas[0] if p.replicas else -1
                 if preferred in self._alive and preferred in p.isr:
+                    # Leader-only change: deliberately NOT a metadata
+                    # generation bump — the model pipeline re-derives
+                    # leadership from the live partition states on every
+                    # refresh, so elections stay on the cheap path.
                     self._parts[key] = dataclasses.replace(p, leader=preferred)
 
     def list_reassigning_partitions(self):

@@ -258,6 +258,20 @@ class FleetScheduler:
                 continue
             entry.last_precompute = now
             cc, cid = entry.cc, entry.cluster_id
+            # Overlap host-side model assembly with whatever solve is
+            # currently holding the device: kick the monitor's background
+            # prefetch BEFORE enqueueing, so by the time this cluster's
+            # precompute reaches the head of the queue its cluster model
+            # is already built (the solve then starts immediately instead
+            # of paying the assembly on the device's critical path).
+            prefetch = getattr(getattr(cc, "load_monitor", None),
+                               "prefetch_model", None)
+            if prefetch is not None:
+                try:
+                    prefetch()
+                except Exception:  # noqa: BLE001 — overlap is best-effort
+                    LOG.debug("fleet: model prefetch kickoff for %s failed",
+                              cid, exc_info=True)
             fut = self.submit(cid, JobKind.EXPIRING_CACHE,
                               lambda cc=cc: cc.proposals())
 

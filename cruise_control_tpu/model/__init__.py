@@ -6,5 +6,6 @@ from .tensors import (
     set_broker_state, topic_broker_leader_counts, topic_broker_replica_counts,
 )
 from .builder import BrokerSpec, ClusterModelBuilder, PartitionSpec, derive_follower_load
+from .refresh import IncrementalModelPipeline, RefreshStats, TopologyCache
 from .stats import ClusterModelStats, cluster_stats
 from . import fixtures

@@ -19,16 +19,30 @@ from ..common.resources import NUM_RESOURCES, Resource
 from .tensors import ClusterMeta, ClusterTensors
 
 
-def graduated_bucket(n: int, bucket: int) -> int:
+def graduated_bucket(n: int, bucket: int, prev: int | None = None,
+                     hysteresis: float = 0.125) -> int:
     """Shape-bucket size capped at ~n/8: padding overhead stays bounded
     (≤ ~12.5%) while shapes still quantize to a handful per octave, so
     ordinary cluster growth reuses compiled kernels without tiny clusters
-    paying large pads (solver.partition.bucket.size semantics)."""
+    paying large pads (solver.partition.bucket.size semantics).
+
+    ``prev`` is the bucket last used for this axis: a cluster hovering at
+    an ``n // 8`` boundary (bucket b is freshly selected iff n >= 8b)
+    would otherwise flap between b and b/2 — alternating padded shapes
+    and recompiling the solver chain on alternate cycles. With
+    hysteresis, the previous bucket is kept while n stays inside
+    ``[8·prev·(1-h), 16·prev·(1+h))``, so only a real move past a
+    boundary (by margin h) changes the padded shape. The padding-overhead
+    bound loosens to ~12.5%·(1+h) while the sticky bucket is held."""
     if bucket <= 0:
         return 0
-    while bucket > 1 and bucket > max(1, n // 8):
-        bucket //= 2
-    return bucket
+    fresh = bucket
+    while fresh > 1 and fresh > max(1, n // 8):
+        fresh //= 2
+    if prev and prev != fresh and prev <= bucket \
+            and 8 * prev * (1.0 - hysteresis) <= n < 16 * prev * (1.0 + hysteresis):
+        return prev
+    return fresh
 
 
 def _pad_up(n: int, bucket: int) -> int:
@@ -247,16 +261,18 @@ def build_cluster_from_arrays(brokers: Sequence[BrokerSpec],
         # into lut[-1], and too-large ids must not surface as a raw
         # IndexError).
         empty = replicas < 0
-        if ((replicas < -1) | (replicas > max(broker_ids))).any():
-            raise ValueError("replica matrix references unknown broker ids")
-        lut = np.full(max(broker_ids) + 1, -1, dtype=np.int32)
-        lut[np.asarray(broker_ids)] = np.arange(len(broker_ids),
-                                                dtype=np.int32)
-        mapped = lut[np.where(empty, 0, replicas)]
-        if (mapped[~empty] < 0).any():
-            raise ValueError("replica matrix references unknown broker ids")
-        assignment[:len(replicas), :replicas.shape[1]] = \
-            np.where(empty, -1, mapped)
+        if replicas.size:
+            if not broker_ids or ((replicas < -1)
+                                  | (replicas > max(broker_ids))).any():
+                raise ValueError("replica matrix references unknown broker ids")
+            lut = np.full(max(broker_ids) + 1, -1, dtype=np.int32)
+            lut[np.asarray(broker_ids)] = np.arange(len(broker_ids),
+                                                    dtype=np.int32)
+            mapped = lut[np.where(empty, 0, replicas)]
+            if (mapped[~empty] < 0).any():
+                raise ValueError("replica matrix references unknown broker ids")
+            assignment[:len(replicas), :replicas.shape[1]] = \
+                np.where(empty, -1, mapped)
     else:
         for i, reps in enumerate(replicas):
             for s, bid in enumerate(reps):

@@ -28,8 +28,9 @@ from ..config.cruise_control_config import CruiseControlConfig
 from ..executor.admin import AdminBackend, PartitionState
 from ..metricdef.kafka_metric_def import CommonMetric as CM, KafkaMetricDef
 from ..metricdef.metricdef import ValueComputingStrategy as S
-from ..model.builder import BrokerSpec, build_cluster_from_arrays
+from ..model.builder import BrokerSpec
 from ..model.cpu_estimation import CpuEstimator
+from ..model.refresh import IncrementalModelPipeline, TopologyCache
 from ..model.tensors import ClusterMeta, ClusterTensors
 from .aggregator.aggregator import (
     AggregationOptions, AggregationResult, Granularity, MetricSampleAggregator,
@@ -139,6 +140,19 @@ class LoadMonitor:
             self._fetcher, self._metadata, store,
             sampling_interval_ms=config.get("metric.sampling.interval.ms"))
         self._model_semaphore = ModelGenerationSemaphore()
+        # Incremental device-resident refresh: topology tables + device
+        # tensors cached across cluster_model() calls, invalidated by the
+        # backend's metadata generation (or a structural fingerprint);
+        # steady-state calls only re-gather loads (model/refresh.py).
+        self._pipeline = IncrementalModelPipeline(self._partition_bucket,
+                                                  self._broker_bucket)
+        # Background model prefetch (the fleet pacer's overlap hook):
+        # (agg generation, metadata token, (state, meta)) built
+        # off-thread, consumed by the next default-argument
+        # cluster_model() call.
+        self._prefetch_lock = threading.Lock()
+        self._prefetched: tuple | None = None
+        self._prefetch_thread: threading.Thread | None = None
 
     # -- lifecycle --------------------------------------------------------
     def start_up(self, block_on_load: bool = True) -> None:
@@ -304,6 +318,25 @@ class LoadMonitor:
                 self._config.get("min.valid.partition.ratio")
                 if min_valid_partition_ratio is None
                 else min_valid_partition_ratio))
+        defaults = (requirements is None and allow_capacity_estimation
+                    and start_ms < 0 and end_ms < 0
+                    and min_valid_partition_ratio is None
+                    and reduction == "default")
+        if defaults:
+            # A background prefetch (fleet pacer overlap) that matches the
+            # CURRENT aggregation generation AND metadata generation is
+            # this call's answer — the assembly already happened while the
+            # solver was busy elsewhere. Both stamps matter: a topology
+            # change (broker death, completed reassignment) does not bump
+            # the sample-aggregator generation, and a stale-topology model
+            # must never shortcut the pipeline's own invalidation.
+            with self._prefetch_lock:
+                pre, self._prefetched = self._prefetched, None
+            if pre is not None and pre[0] == self.model_generation \
+                    and pre[1] == self._metadata_token():
+                from ..utils.sensors import SENSORS
+                SENSORS.count("model_prefetch_hits")
+                return pre[2]
         from ..utils.progress import step
         step("WaitingForClusterModel")
         with self._model_semaphore:
@@ -311,6 +344,13 @@ class LoadMonitor:
             # WaitingForClusterModel step, not model-creation time.
             t0 = time.time()
             step("AggregatingMetrics")
+            # Token BEFORE the partitions snapshot: if a concurrent
+            # topology change lands between the two reads, the snapshot's
+            # (possibly stale) tables get cached under the OLD token and
+            # the next call rebuilds — the reverse order would cache
+            # pre-change replica data under the post-change key and serve
+            # it until the next unrelated topology bump.
+            token = self._metadata_token()
             partitions = self._metadata.describe_partitions()
             alive = self._metadata.alive_brokers()
             if not allow_capacity_estimation:
@@ -330,7 +370,7 @@ class LoadMonitor:
                 opts = _dc.replace(opts, start_ms=start_ms, end_ms=end_ms)
             agg = self._partition_agg.aggregate(opts)
             step("GeneratingClusterModel")
-            built = self._build(partitions, alive, agg, reduction)
+            built = self._build(partitions, alive, agg, reduction, token)
             if self.model_transform is not None:
                 built = self.model_transform(*built)
         # cluster-model-creation-timer (LoadMonitor.java:177).
@@ -341,7 +381,7 @@ class LoadMonitor:
 
     def _build(self, partitions: Mapping[tuple[str, int], PartitionState],
                alive: set[int], agg: AggregationResult,
-               reduction: str = "default",
+               reduction: str = "default", token: object = None,
                ) -> tuple[ClusterTensors, ClusterMeta]:
         # Window reduction per metric strategy (Load.expectedUtilizationFor:
         # AVG over windows for rates, LATEST window for disk usage).
@@ -366,8 +406,25 @@ class LoadMonitor:
                 reduced[:, info.id] = col.max(axis=1)
             else:
                 reduced[:, info.id] = col.mean(axis=1)
-        row_of = {e: i for i, e in enumerate(agg.entities)}
 
+        brokers = self._broker_specs(partitions, alive)
+
+        def fill_loads(cache: TopologyCache) -> None:
+            self._fill_loads(cache, agg, reduced)
+
+        return self._pipeline.assemble(brokers, partitions, fill_loads,
+                                       topology_token=token)
+
+    def _metadata_token(self):
+        """The backend's O(1) metadata-generation stamp, or None when it
+        has none (the pipeline then falls back to structural
+        fingerprinting; prefetch consumption becomes best-effort on the
+        topology axis — the aggregation-generation check still applies)."""
+        gen_fn = getattr(self._metadata, "metadata_generation", None)
+        return gen_fn() if callable(gen_fn) else None
+
+    def _broker_specs(self, partitions: Mapping[tuple[str, int], PartitionState],
+                      alive: set[int]) -> list[BrokerSpec]:
         all_brokers = sorted({b for st in partitions.values() for b in st.replicas}
                              | alive)
         # Brokers with no known rack refresh from the metadata backend
@@ -394,7 +451,7 @@ class LoadMonitor:
                 hosts = hosts_fn()
             except Exception:  # noqa: BLE001 — topology hint only
                 LOG.warning("broker host refresh failed", exc_info=True)
-        brokers = [BrokerSpec(
+        return [BrokerSpec(
             bid,
             rack=(self._rack_mapper.apply(self._broker_racks[bid])
                   if bid in self._broker_racks else ""),
@@ -403,16 +460,15 @@ class LoadMonitor:
             host=hosts.get(bid, ""))
             for bid in all_brokers]
 
-        # Vectorized load assembly: one gather from the reduced [E, M]
-        # matrix into [P, R] rows; entities with no valid aggregation
-        # contribute zero load (the reference drops them from the model;
-        # keeping them with zero load preserves placement for hard goals).
-        from .sampling.samples import PartitionEntity
-        ordered = sorted(partitions.items())
-        part_names = [tp for tp, _st in ordered]
-        states = [st for _tp, st in ordered]
-        rows = np.array([row_of.get(PartitionEntity(t, p), -1)
-                         for t, p in part_names], dtype=np.int64)
+    def _fill_loads(self, cache: TopologyCache, agg: AggregationResult,
+                    reduced: np.ndarray) -> None:
+        """Vectorized load assembly into the pipeline's preallocated
+        buffers: one gather from the reduced [E, M] matrix into [P, R]
+        rows; entities with no valid aggregation contribute zero load
+        (the reference drops them from the model; keeping them with zero
+        load preserves placement for hard goals)."""
+        n = len(cache.part_names)
+        rows = self._entity_rows(cache, agg)
         valid = (rows >= 0)
         valid[valid] &= agg.entity_valid[rows[valid]]
 
@@ -421,24 +477,78 @@ class LoadMonitor:
                         CM.DISK_USAGE)]
         res_cols = [int(Resource.CPU), int(Resource.NW_IN),
                     int(Resource.NW_OUT), int(Resource.DISK)]
-        leader_load = np.zeros((len(ordered), len(Resource)), dtype=np.float32)
-        leader_load[np.ix_(valid, res_cols)] = reduced[rows[valid]][:, metric_cols]
+        ll, fl = cache.ll_buf, cache.fl_buf
+        ll[np.ix_(valid, res_cols)] = reduced[rows[valid]][:, metric_cols]
 
-        follower_load = leader_load.copy()
-        follower_load[:, int(Resource.NW_OUT)] = 0.0
-        follower_load[:, int(Resource.CPU)] = self._cpu.follower_cpu(
-            leader_load[:, int(Resource.NW_IN)],
-            leader_load[:, int(Resource.NW_OUT)],
-            leader_load[:, int(Resource.CPU)])
+        fl[:n] = ll[:n]
+        fl[:n, int(Resource.NW_OUT)] = 0.0
+        fl[:n, int(Resource.CPU)] = self._cpu.follower_cpu(
+            ll[:n, int(Resource.NW_IN)],
+            ll[:n, int(Resource.NW_OUT)],
+            ll[:n, int(Resource.CPU)])
 
-        leader_indices = np.array(
-            [st.replicas.index(st.leader) if st.leader in st.replicas else -1
-             for st in states], dtype=np.int32)
-        from ..model.builder import graduated_bucket
-        return build_cluster_from_arrays(
-            brokers, part_names, [st.replicas for st in states],
-            leader_indices, leader_load, follower_load,
-            partition_bucket=graduated_bucket(len(part_names),
-                                              self._partition_bucket),
-            broker_bucket=graduated_bucket(len(brokers),
-                                           self._broker_bucket))
+    @staticmethod
+    def _entity_rows(cache: TopologyCache, agg: AggregationResult,
+                     ) -> np.ndarray:
+        """[P] row index into the aggregation matrix per partition (-1 =
+        no entity). Cached in the topology cache's scratch area: rows only
+        change when the aggregation ENTITY LIST changes (entity set or
+        validity churn), so steady-state cycles skip the O(P) dict-lookup
+        loop entirely."""
+        from .sampling.samples import PartitionEntity
+        ents = agg.entities
+        cached = cache.scratch.get("entity_rows")
+        if cached is not None:
+            cid, cents, rows = cached
+            if cid == id(ents) or cents == ents:
+                cache.scratch["entity_rows"] = (id(ents), ents, rows)
+                return rows
+        row_of = {e: i for i, e in enumerate(ents)}
+        n = len(cache.part_names)
+        rows = np.fromiter(
+            (row_of.get(PartitionEntity(t, p), -1)
+             for t, p in cache.part_names), dtype=np.int64, count=n)
+        cache.scratch["entity_rows"] = (id(ents), ents, rows)
+        return rows
+
+    @property
+    def pipeline(self) -> IncrementalModelPipeline:
+        """The incremental refresh pipeline (observability + tests)."""
+        return self._pipeline
+
+    def prefetch_model(self) -> bool:
+        """Kick off a BACKGROUND assembly of the default cluster model for
+        the current generation, overlapping host-side model work with
+        whatever the solver is currently executing (the fleet precompute
+        pacer calls this right before enqueueing a cluster's solve).
+        Non-blocking; at most one prefetch runs at a time. Returns True
+        when a build was started."""
+        with self._prefetch_lock:
+            if self._prefetch_thread is not None \
+                    and self._prefetch_thread.is_alive():
+                return False
+            gen = self.model_generation
+            token = self._metadata_token()
+            pre = self._prefetched
+            if pre is not None and pre[0] == gen and pre[1] == token:
+                return False
+
+            def build():
+                try:
+                    built = self.cluster_model()
+                except Exception:  # noqa: BLE001 — model may not be ready
+                    LOG.debug("model prefetch failed", exc_info=True)
+                    return
+                with self._prefetch_lock:
+                    # Stamped with the generations at build START: if
+                    # samples or topology changed mid-build, the entry is
+                    # stale and the consumer's checks discard it.
+                    self._prefetched = (gen, token, built)
+                from ..utils.sensors import SENSORS
+                SENSORS.count("model_prefetch_builds")
+
+            t = threading.Thread(target=build, daemon=True,
+                                 name="model-prefetch")
+            self._prefetch_thread = t
+            t.start()
+            return True

@@ -1,0 +1,314 @@
+"""Incremental device-resident model pipeline (model/refresh.py):
+topology-cache transitions, byte-identical incremental-vs-cold pins,
+donation-path reuse, bucket hysteresis, and the LoadMonitor/fleet wiring."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.broker_state import BrokerState
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.executor.admin import InMemoryAdminBackend, PartitionState
+from cruise_control_tpu.model.builder import BrokerSpec, graduated_bucket
+from cruise_control_tpu.model.refresh import (
+    IncrementalModelPipeline, TOPOLOGY_FIELDS,
+)
+
+_CAP = {Resource.CPU: 100.0, Resource.NW_IN: 1000.0,
+        Resource.NW_OUT: 1000.0, Resource.DISK: 10000.0}
+
+
+def _brokers(n):
+    return [BrokerSpec(i, rack=f"r{i % 3}", capacity=_CAP,
+                       state=BrokerState.ALIVE, host=f"h{i // 2}")
+            for i in range(n)]
+
+
+def _partitions(num_brokers, num_partitions, rf=3, topics=4):
+    out = {}
+    for i in range(num_partitions):
+        topic, part = f"t{i % topics}", i // topics
+        reps = tuple((i * 7 + k) % num_brokers for k in range(rf))
+        out[(topic, part)] = PartitionState(topic, part, reps, reps[0],
+                                            isr=reps)
+    return out
+
+
+def _filler(seed):
+    def fill(cache):
+        rng = np.random.default_rng(seed)
+        n = len(cache.part_names)
+        cache.ll_buf[:n] = rng.random((n, NUM_RESOURCES)).astype(np.float32)
+        cache.fl_buf[:n] = cache.ll_buf[:n] * np.float32(0.5)
+        cache.fl_buf[:n, int(Resource.NW_OUT)] = 0.0
+    return fill
+
+
+def _assert_states_identical(a, b):
+    for f in TOPOLOGY_FIELDS + ("leader_load", "follower_load", "leader_slot"):
+        xa, xb = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        assert xa.dtype == xb.dtype, f
+        assert np.array_equal(xa, xb), f
+
+
+@pytest.mark.parametrize("num_brokers,num_partitions", [(5, 64), (12, 300)])
+def test_incremental_refresh_byte_identical_to_cold_rebuild(
+        num_brokers, num_partitions):
+    """The correctness bar: a load-only refresh through the warm cache is
+    byte-identical to a cold rebuild with the same inputs — at two cluster
+    sizes, across a load change AND a topology change."""
+    parts = _partitions(num_brokers, num_partitions)
+    warm = IncrementalModelPipeline(partition_bucket=32, broker_bucket=4)
+    warm.assemble(_brokers(num_brokers), parts, _filler(0), topology_token=0)
+    # Load-only change: warm pipeline takes the hit path.
+    s_inc, m_inc = warm.assemble(_brokers(num_brokers), parts, _filler(1),
+                                 topology_token=0)
+    assert warm.topology_hits == 1 and warm.topology_misses == 1
+    cold = IncrementalModelPipeline(partition_bucket=32, broker_bucket=4)
+    s_cold, m_cold = cold.assemble(_brokers(num_brokers), parts, _filler(1),
+                                   topology_token=0)
+    _assert_states_identical(s_inc, s_cold)
+    assert m_inc == m_cold
+
+    # Topology change (replica set moved): both rebuild, still identical.
+    (tp, st) = next(iter(sorted(parts.items())))
+    new_reps = tuple((b + 1) % num_brokers for b in st.replicas)
+    parts[tp] = PartitionState(st.topic, st.partition, new_reps, new_reps[0],
+                               isr=new_reps)
+    s_inc2, _ = warm.assemble(_brokers(num_brokers), parts, _filler(2),
+                              topology_token=1)
+    assert warm.topology_misses == 2
+    s_cold2, _ = cold.assemble(_brokers(num_brokers), parts, _filler(2),
+                               topology_token=1)
+    _assert_states_identical(s_inc2, s_cold2)
+
+
+def test_topology_cache_dirty_and_clean_transitions():
+    parts = _partitions(6, 48)
+    pipe = IncrementalModelPipeline()
+    pipe.assemble(_brokers(6), parts, _filler(0), topology_token=7)
+    assert (pipe.topology_misses, pipe.topology_hits) == (1, 0)
+    # Clean: same token → hit; repeated hits stay hits.
+    pipe.assemble(_brokers(6), parts, _filler(1), topology_token=7)
+    pipe.assemble(_brokers(6), parts, _filler(2), topology_token=7)
+    assert (pipe.topology_misses, pipe.topology_hits) == (1, 2)
+    # Dirty: token bump → miss even with identical content.
+    pipe.assemble(_brokers(6), parts, _filler(3), topology_token=8)
+    assert (pipe.topology_misses, pipe.topology_hits) == (2, 2)
+    # Dirty: broker-table change (capacity) invalidates under a clean token.
+    brokers = _brokers(6)
+    brokers[0] = BrokerSpec(0, rack="r0", capacity={Resource.CPU: 7.0},
+                            state=BrokerState.ALIVE, host="h0")
+    pipe.assemble(brokers, parts, _filler(4), topology_token=8)
+    assert (pipe.topology_misses, pipe.topology_hits) == (3, 2)
+
+
+def test_fingerprint_fallback_detects_replica_and_leader_changes():
+    """Without a metadata-generation token the pipeline fingerprints the
+    replica structure; leader-only elections must stay on the hit path
+    (leadership is re-derived every refresh from the live states)."""
+    parts = _partitions(5, 40)
+    pipe = IncrementalModelPipeline()
+    pipe.assemble(_brokers(5), parts, _filler(0))
+    s1, _ = pipe.assemble(_brokers(5), parts, _filler(1))
+    assert pipe.topology_hits == 1
+
+    # Leader-only change: still a hit, and the new leader slot shows up.
+    tp = sorted(parts)[0]
+    st = parts[tp]
+    parts[tp] = PartitionState(st.topic, st.partition, st.replicas,
+                               st.replicas[1], isr=st.replicas)
+    s2, _ = pipe.assemble(_brokers(5), parts, _filler(1))
+    assert pipe.topology_hits == 2
+    row = sorted(parts).index(tp)
+    assert int(np.asarray(s2.leader_slot)[row]) == 1
+    assert int(np.asarray(s1.leader_slot)[row]) == 0
+
+    # Replica-set change: fingerprint differs → rebuild.
+    parts[tp] = PartitionState(st.topic, st.partition,
+                               tuple((b + 1) % 5 for b in st.replicas),
+                               (st.replicas[0] + 1) % 5, isr=())
+    pipe.assemble(_brokers(5), parts, _filler(1))
+    assert pipe.topology_misses == 2
+
+
+def test_refresh_reuses_topology_device_buffers_and_donation_path():
+    """Hit-path reuse: topology tensors are the SAME device buffers across
+    refreshes (zero re-transfer), and the donate=True shipper produces
+    identical values. A still-referenced previous state is never donated
+    (the sole-owner guard), so its arrays stay readable."""
+    parts = _partitions(4, 32)
+    pipe = IncrementalModelPipeline(donate=True)
+    s0, _ = pipe.assemble(_brokers(4), parts, _filler(0), topology_token=0)
+    s1, _ = pipe.assemble(_brokers(4), parts, _filler(1), topology_token=0)
+    for f in TOPOLOGY_FIELDS:
+        assert getattr(s0, f) is getattr(s1, f), f
+    # s0 is still alive here: the sole-owner guard must have refused to
+    # donate its load buffers — they remain readable and correct.
+    ref = IncrementalModelPipeline().assemble(
+        _brokers(4), parts, _filler(0), topology_token=0)[0]
+    assert np.array_equal(np.asarray(s0.leader_load),
+                          np.asarray(ref.leader_load))
+    # Drop every external reference and refresh twice: the donation path
+    # (or its CPU no-op) must keep producing byte-identical loads.
+    del s0, ref
+    s2, _ = pipe.assemble(_brokers(4), parts, _filler(2), topology_token=0)
+    del s1
+    s3, _ = pipe.assemble(_brokers(4), parts, _filler(3), topology_token=0)
+    want = IncrementalModelPipeline().assemble(
+        _brokers(4), parts, _filler(3), topology_token=0)[0]
+    assert np.array_equal(np.asarray(s3.leader_load),
+                          np.asarray(want.leader_load))
+    del s2
+
+
+def test_graduated_bucket_hysteresis_absorbs_boundary_flap():
+    # Bucket 64 is freshly selected at n >= 512; without hysteresis a
+    # cluster oscillating 511<->512 flips 32<->64 every cycle.
+    assert graduated_bucket(512, 1024) == 64
+    assert graduated_bucket(511, 1024) == 32
+    # With the previous bucket pinned, ±1 hovering keeps the shape...
+    assert graduated_bucket(511, 1024, prev=64) == 64
+    assert graduated_bucket(512, 1024, prev=32) == 32
+    # ...but a real move past the hysteresis margin switches.
+    assert graduated_bucket(int(512 * 0.8), 1024, prev=64) == 32
+    assert graduated_bucket(int(1024 * 1.2), 1024, prev=32) == 128
+    # prev from a different config (not reachable) is ignored.
+    assert graduated_bucket(512, 1024, prev=4096) == 64
+
+
+def test_load_monitor_uses_cache_and_metadata_generation():
+    """End-to-end monitor wiring: repeated cluster_model calls with
+    unchanged metadata hit the topology cache and agree exactly with the
+    first build; a broker death (metadata generation bump) rebuilds and
+    marks the broker DEAD."""
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.monitor import (
+        LoadMonitor, ModelCompletenessRequirements,
+    )
+    from cruise_control_tpu.monitor.sampling import SyntheticSampler
+
+    parts = _partitions(3, 12, rf=2)
+    backend = InMemoryAdminBackend(parts.values())
+    cfg = CruiseControlConfig({"partition.metrics.window.ms": 1000,
+                               "num.partition.metrics.windows": 2,
+                               "min.valid.partition.ratio": 0.0})
+    monitor = LoadMonitor(cfg, backend, samplers=[SyntheticSampler()])
+    monitor.task_runner.run_sampling_once(end_ms=1000)
+    monitor.task_runner.run_sampling_once(end_ms=2000)
+    req = ModelCompletenessRequirements(1, 0.0)
+    s1, m1 = monitor.cluster_model(req)
+    assert monitor.pipeline.topology_misses == 1
+    s2, m2 = monitor.cluster_model(req)
+    assert monitor.pipeline.topology_hits == 1
+    _assert_states_identical(s1, s2)
+    assert m1 == m2
+
+    backend.kill_broker(1)
+    s3, m3 = monitor.cluster_model(req)
+    assert monitor.pipeline.topology_misses == 2
+    dead = np.asarray(s3.broker_state) == int(BrokerState.DEAD)
+    assert dead[m3.broker_ids.index(1)]
+
+    # New samples only (load change, topology unchanged): hit again, and
+    # the refreshed state reflects the new aggregation generation.
+    monitor.task_runner.run_sampling_once(end_ms=3000)
+    monitor.cluster_model(req)
+    assert monitor.pipeline.topology_hits == 2
+
+
+def test_prefetch_model_overlaps_and_is_consumed_once():
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.monitor import LoadMonitor
+    from cruise_control_tpu.monitor.sampling import SyntheticSampler
+
+    parts = _partitions(3, 9, rf=2)
+    backend = InMemoryAdminBackend(parts.values())
+    cfg = CruiseControlConfig({"partition.metrics.window.ms": 1000,
+                               "num.partition.metrics.windows": 2,
+                               "min.valid.partition.ratio": 0.0})
+    monitor = LoadMonitor(cfg, backend, samplers=[SyntheticSampler()])
+    monitor.task_runner.run_sampling_once(end_ms=1000)
+    monitor.task_runner.run_sampling_once(end_ms=2000)
+    assert monitor.prefetch_model() is True
+    monitor._prefetch_thread.join(timeout=30)
+    assert monitor._prefetched is not None
+    pre = monitor._prefetched[2]
+    # The next default-argument call consumes the prebuilt model...
+    got = monitor.cluster_model()
+    assert got is pre
+    # ...exactly once.
+    again = monitor.cluster_model()
+    assert again is not pre
+    _assert_states_identical(got[0], again[0])
+
+    # A stale prefetch (aggregation generation moved on) is discarded.
+    assert monitor.prefetch_model() is True
+    monitor._prefetch_thread.join(timeout=30)
+    monitor.task_runner.run_sampling_once(end_ms=3000)
+    stale = monitor._prefetched[2]
+    fresh = monitor.cluster_model()
+    assert fresh is not stale
+
+    # A topology-stale prefetch (metadata generation bumped, NO new
+    # samples) is discarded too: the dead broker must show up.
+    assert monitor.prefetch_model() is True
+    monitor._prefetch_thread.join(timeout=30)
+    stale2 = monitor._prefetched[2]
+    backend.kill_broker(2)
+    served = monitor.cluster_model()
+    assert served is not stale2
+    dead = np.asarray(served[0].broker_state) == int(BrokerState.DEAD)
+    assert dead[served[1].broker_ids.index(2)]
+
+
+def test_fleet_pacer_kicks_model_prefetch():
+    """The precompute pacer's overlap hook: pace_once() starts a model
+    prefetch for the cluster it enqueues."""
+    from cruise_control_tpu.fleet.scheduler import FleetScheduler
+
+    class _Monitor:
+        def __init__(self):
+            self.prefetches = 0
+
+        def prefetch_model(self):
+            self.prefetches += 1
+            return True
+
+    class _CC:
+        def __init__(self):
+            self.load_monitor = _Monitor()
+            self.calls = 0
+
+        def proposals(self):
+            self.calls += 1
+            return "ok"
+
+    class _Entry:
+        def __init__(self, cid, cc):
+            self.cluster_id, self.cc = cid, cc
+            self.paused = False
+            self.last_precompute = 0.0
+            from cruise_control_tpu.config.cruise_control_config import (
+                CruiseControlConfig,
+            )
+            self.config = CruiseControlConfig(
+                {"fleet.precompute.cadence.ms": 1})
+
+    class _Registry:
+        def __init__(self, entries):
+            self._entries = entries
+
+        def entries(self):
+            return self._entries
+
+    cc = _CC()
+    sched = FleetScheduler(clock=lambda: 100.0)
+    sched.bind(_Registry([_Entry("alpha", cc)]))
+    assert sched.pace_once() == 1
+    assert cc.load_monitor.prefetches == 1
+    assert sched.run_pending() == 1
+    assert cc.calls == 1
