@@ -260,6 +260,56 @@ def _model_pipeline_probe(num_brokers: int, num_partitions: int,
     }
 
 
+def _tracing_noop_overhead_ns(iterations: int = 100_000) -> float:
+    """Per-call cost of a DISABLED tracer span (the acceptance guard:
+    tracing off must add nothing measurable to the solver hot path —
+    the disabled path is one shared no-op context manager)."""
+    from cruise_control_tpu.utils.tracing import TRACER
+    was_enabled = TRACER.enabled
+    TRACER.configure(enabled=False)
+    try:
+        t0 = time.perf_counter_ns()
+        for _ in range(iterations):
+            with TRACER.span("noop"):
+                pass
+        return (time.perf_counter_ns() - t0) / iterations
+    finally:
+        TRACER.configure(enabled=was_enabled)
+
+
+_QUANTILE_SPANS = ("analyzer.optimize", "goal.solve", "model.assemble",
+                   "monitor.aggregate", "analyzer.proposal_diff")
+
+
+def _span_histogram_snapshots() -> dict:
+    from cruise_control_tpu.utils.sensors import SENSORS
+    return {s: SENSORS.histogram_snapshot("trace_span_seconds",
+                                          labels={"span": s})
+            for s in _QUANTILE_SPANS}
+
+
+def _span_quantile_extras(baseline: dict) -> dict:
+    """p50/p99 per key pipeline stage from the trace_span_seconds
+    histograms, diffed against the snapshot taken at STAGE START so each
+    stage's columns reflect only its own observations (a cumulative read
+    would let an early fast stage mask a later stage's tail)."""
+    from cruise_control_tpu.utils.sensors import bucket_quantile
+    p50, p99 = {}, {}
+    for span, after in _span_histogram_snapshots().items():
+        if after is None:
+            continue
+        counts = list(after["counts"])
+        before = baseline.get(span)
+        if before is not None:
+            counts = [a - b for a, b in zip(counts, before["counts"])]
+        q50 = bucket_quantile(after["buckets"], counts, 0.50)
+        if q50 is None:
+            continue
+        p50[span] = round(q50, 4)
+        p99[span] = round(bucket_quantile(after["buckets"], counts, 0.99), 4)
+    return {"span_p50_s": p50, "span_p99_s": p99}
+
+
 def _run_stage(jax, num_brokers: int, num_partitions: int, drain: int,
                device: str, on_cpu: bool, progress: dict) -> dict:
     from cruise_control_tpu.analyzer.optimizer import (
@@ -295,6 +345,10 @@ def _run_stage(jax, num_brokers: int, num_partitions: int, drain: int,
     jax.block_until_ready(state.assignment)
     build_s = time.time() - t0
     progress["model_build_s"] = round(build_s, 3)
+
+    from cruise_control_tpu.utils.tracing import TRACER
+    spans_before = TRACER.spans_closed
+    hist_baseline = _span_histogram_snapshots()
 
     cfg = CruiseControlConfig()
     # The solver mesh spans every available chip (single-chip TPU tunnel →
@@ -351,6 +405,8 @@ def _run_stage(jax, num_brokers: int, num_partitions: int, drain: int,
             "goal_durations_steady_s": {
                 g.name: round(g.duration_s, 4) for g in result.goal_results},
             "budget_s_prorated": round(budget_s, 3),
+            "trace_span_count": TRACER.spans_closed - spans_before,
+            **_span_quantile_extras(hist_baseline),
             **pipeline_extras,
         },
     }
@@ -402,10 +458,34 @@ def _guarded_main(deadline: float) -> int:
     if platform is None:
         jax.config.update("jax_platforms", "cpu")
     n_dev = jax.device_count()
+
+    # Tracing + XLA telemetry for the whole run: every optimizer pass
+    # records a span tree (JSONL-dumped for the CI artifact) and every
+    # XLA compile lands in the shape-labeled histograms the per-stage
+    # p50/p99 extras read. The disabled-path overhead is measured and
+    # emitted FIRST so a tracing hot-path regression fails loudly.
+    from cruise_control_tpu.utils.tracing import TRACER
+    from cruise_control_tpu.utils.xla_telemetry import install as _xla_install
+    trace_file = os.environ.get("BENCH_TRACE_FILE",
+                                "/tmp/cc_bench_trace.jsonl")
+    try:  # a stale dump must not accrete across runs
+        os.unlink(trace_file)
+    except OSError:
+        pass
+    TRACER.configure(enabled=True, jsonl_path=trace_file)
+    _xla_install()
+    noop_ns = _tracing_noop_overhead_ns()
+    _emit({"metric": "tracing_noop_span_overhead", "value": round(noop_ns, 1),
+           "unit": "ns", "vs_baseline": 1.0,
+           "extras": {"trace_file": trace_file,
+                      "guard": "disabled tracing must stay sub-microsecond "
+                               "per call (nothing on the solver hot path)"}})
+
     _emit({"metric": "bench_bootstrap", "value": round(time.time() - t0, 3),
            "unit": "s", "vs_baseline": 1.0,
            "extras": {"device": device, "num_devices": n_dev,
                       "compile_cache_dir": cache_dir,
+                      "trace_file": trace_file,
                       "stderr_file": _stderr_path}})
 
     stages = STAGES[:2] if os.environ.get("BENCH_SCALE") == "small" else STAGES

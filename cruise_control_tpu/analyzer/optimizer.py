@@ -129,6 +129,20 @@ def _apportioned_goal_results(goal_chain: Sequence[Goal], infos: list[dict],
         for g, info in zip(goal_chain, infos)]
 
 
+def _record_goal_spans(tracer, goal_results: Sequence[GoalResult],
+                       search_cfg: SearchConfig) -> None:
+    """Per-goal spans for the single-dispatch paths: the whole chain runs
+    in one XLA execution, so the goals' spans cannot be opened live —
+    they are attached after the fact with the same apportioned durations
+    GoalResult carries (attributes mark them as such)."""
+    for r in goal_results:
+        tracer.record_span(
+            "goal.solve", r.duration_s, goal=r.name, rounds=r.rounds,
+            moves_applied=r.moves_applied, succeeded=r.succeeded,
+            candidates=search_cfg.num_sources * search_cfg.num_dests,
+            apportioned=True)
+
+
 class GoalOptimizer:
     """Facade over the batched chain search (GoalOptimizer.java:65).
 
@@ -330,8 +344,22 @@ class GoalOptimizer:
                       ) -> tuple[ClusterTensors, OptimizerResult]:
         """Run the goal chain; returns (final_state, OptimizerResult)."""
         from ..utils.progress import step
+        from ..utils.tracing import TRACER
+        from ..utils.xla_telemetry import shape_scope
         step("OptimizationForGoalChain")
-        t_start = time.time()
+        with TRACER.span("analyzer.optimize",
+                         num_partitions=state.num_partitions,
+                         num_brokers=state.num_brokers) as _opt_span, \
+                shape_scope(state.num_partitions, state.num_brokers):
+            return self._optimizations_traced(
+                state, meta, goals, options, _opt_span, t_start=time.time())
+
+    def _optimizations_traced(self, state: ClusterTensors, meta: ClusterMeta,
+                              goals: Sequence[Goal] | None,
+                              options: OptimizationOptions | None,
+                              _opt_span, t_start: float,
+                              ) -> tuple[ClusterTensors, OptimizerResult]:
+        from ..utils.tracing import TRACER
         options = options or OptimizationOptions()
         goal_chain = list(goals) if goals is not None \
             else goals_by_priority(self._config)
@@ -380,6 +408,7 @@ class GoalOptimizer:
                 dispatch_target_s=self._dispatch_target_s)
             goal_results = _apportioned_goal_results(
                 goal_chain, infos, time.time() - t0)
+            _record_goal_spans(TRACER, goal_results, search_cfg)
         elif self._fused_chain and not fast and (
                 self._fused_max_brokers == 0
                 or state.num_brokers <= self._fused_max_brokers):
@@ -391,6 +420,7 @@ class GoalOptimizer:
                 meta.num_topics, masks)
             goal_results = _apportioned_goal_results(
                 goal_chain, infos, time.time() - t0)
+            _record_goal_spans(TRACER, goal_results, search_cfg)
         else:
             # Per-goal bounded-dispatch path: same kernels and trajectory,
             # ≤ solver.dispatch.max.rounds search rounds per XLA execution
@@ -423,13 +453,19 @@ class GoalOptimizer:
             for i, g in enumerate(goal_chain):
                 t0 = time.time()
                 use_wide = wide_cfg is not None and g.prefers_wide_batches
-                state, info = optimize_goal_in_chain(
-                    state, goal_chain, i, self._constraint,
-                    wide_cfg if use_wide else search_cfg,
-                    meta.num_topics, masks,
-                    dispatch_rounds=dispatch_rounds,
-                    dispatch=controller_wide if use_wide else controller,
-                    wall_budget_s=fast_budget_s)
+                cfg_used = wide_cfg if use_wide else search_cfg
+                with TRACER.span("goal.solve", goal=g.name,
+                                 candidates=cfg_used.num_sources
+                                 * cfg_used.num_dests) as gsp:
+                    state, info = optimize_goal_in_chain(
+                        state, goal_chain, i, self._constraint,
+                        cfg_used, meta.num_topics, masks,
+                        dispatch_rounds=dispatch_rounds,
+                        dispatch=controller_wide if use_wide else controller,
+                        wall_budget_s=fast_budget_s)
+                    gsp.set(rounds=info["rounds"],
+                            moves_applied=info["moves_applied"],
+                            succeeded=info["succeeded"])
                 goal_results.append(GoalResult(
                     name=g.name, is_hard=g.is_hard,
                     succeeded=info["succeeded"],
@@ -442,8 +478,13 @@ class GoalOptimizer:
 
         violated_before = [r.name for r in goal_results if r.violated_before]
         violated_after = [r.name for r in goal_results if not r.succeeded]
-        stats_after = cluster_stats(state)
-        proposals = diff_proposals(initial, state, meta)
+        with TRACER.span("analyzer.proposal_diff") as dsp:
+            stats_after = cluster_stats(state)
+            proposals = diff_proposals(initial, state, meta)
+            dsp.set(num_proposals=len(proposals))
+        _opt_span.set(num_proposals=len(proposals),
+                      violated_goals_after=",".join(violated_after),
+                      devices=self.solver_devices())
         # proposal-computation-timer + per-pass gauges
         # (GoalOptimizer.java:128, Sensors.md).
         from ..utils.sensors import SENSORS

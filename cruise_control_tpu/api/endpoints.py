@@ -50,6 +50,10 @@ class EndPoint(enum.Enum):
     # service per cluster; here one process serves many clusters and
     # this endpoint is the fleet-wide dashboard).
     FLEET = (23, "GET", Role.VIEWER)
+    # Pipeline tracing (no reference analogue — the reference exposes JMX
+    # sensors but no request-scoped causality): recent span trees from
+    # utils.tracing, filterable by ?cluster= and ?operation=.
+    TRACE = (24, "GET", Role.VIEWER)
 
     @property
     def method(self) -> str:

@@ -154,6 +154,10 @@ SCHEMAS: dict[EndPoint, dict[str, Callable[[str], Any]]] = {
     EndPoint.REMOVE_DISKS: {**_EXECUTION_PARAMS,
                             "brokerid_and_logdirs": _broker_logdir_csv},
     EndPoint.FLEET: {},
+    # cluster (in _COMMON) filters by the trace's recorded cluster label
+    # rather than routing; operation filters by runnable name
+    # (rebalance/proposals/sampling/execution/...).
+    EndPoint.TRACE: {"operation": _str, "entries": _int},
 }
 
 

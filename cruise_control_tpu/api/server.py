@@ -205,6 +205,14 @@ class CruiseControlApi:
         from ..utils.sensors import SENSORS
         extra: dict = {}
         try:
+            # Live device-side telemetry (utils.xla_telemetry): memory
+            # gauges refreshed at scrape time so the series track the
+            # allocator, not the last model build.
+            from ..utils import xla_telemetry
+            xla_telemetry.refresh_device_gauges()
+        except Exception:  # noqa: BLE001 — a scrape must not 500
+            LOG.warning("device telemetry refresh failed", exc_info=True)
+        try:
             st = self._cc.state()
             ms = st.get("MonitorState", {})
             extra["monitor_num_valid_windows"] = ms.get("numValidWindows", 0)
@@ -303,14 +311,28 @@ class CruiseControlApi:
             # its solver work must share the device under the scheduler
             # and respect the pause state, not sneak around both.
             cluster_id = params.pop("cluster", None)
-            if cluster_id is None and self._fleet is not None:
-                cluster_id = self._fleet.cluster_id_of(self._cc)
-            cc = self._route_cluster(endpoint, cluster_id)
-            from ..utils.sensors import cluster_label
-            with cluster_label(cluster_id):
-                body = self._dispatch(endpoint, params, principal,
-                                      query_string, headers, out_headers,
-                                      cc=cc, cluster_id=cluster_id)
+            if endpoint is EndPoint.TRACE:
+                # cluster here FILTERS recorded traces (it is a label on
+                # the trace, not a route) — valid without a fleet, and
+                # never subject to the pause gate. The request-class
+                # plugin seam still applies (TRACE bypasses _dispatch,
+                # where other endpoints' plugins are resolved).
+                handler = self._request_plugin(endpoint)
+                if handler is not None:
+                    body = handler.handle(
+                        self._cc, {**params, "cluster": cluster_id},
+                        principal)
+                else:
+                    body = self._trace_handler(params, cluster_id)
+            else:
+                if cluster_id is None and self._fleet is not None:
+                    cluster_id = self._fleet.cluster_id_of(self._cc)
+                cc = self._route_cluster(endpoint, cluster_id)
+                from ..utils.sensors import cluster_label
+                with cluster_label(cluster_id):
+                    body = self._dispatch(endpoint, params, principal,
+                                          query_string, headers, out_headers,
+                                          cc=cc, cluster_id=cluster_id)
             if params.get("get_response_schema"):
                 body = {**body, "responseSchema": _schema_of(body)}
             if params.get("json") is False:
@@ -345,6 +367,20 @@ class CruiseControlApi:
             LOG.exception("internal error handling %s %s", method, path)
             return 500, self._error(f"{type(e).__name__}: {e}"), out_headers
 
+    def _trace_handler(self, p: dict, cluster_id: str | None) -> dict:
+        """GET /trace: recent span trees (newest first) from the tracer's
+        ring, as OTLP-shaped JSON. ``?cluster=`` / ``?operation=`` filter;
+        ``?entries=`` bounds the response."""
+        from ..utils.tracing import TRACER
+        traces = TRACER.traces(cluster=cluster_id,
+                               operation=p.get("operation"),
+                               limit=p.get("entries", 50))
+        return responses.envelope({
+            "tracingEnabled": TRACER.enabled,
+            "numTraces": len(traces),
+            "spansClosed": TRACER.spans_closed,
+            "traces": traces})
+
     def _route_cluster(self, endpoint: EndPoint,
                        cluster_id: str | None) -> CruiseControl:
         """?cluster= → the registered cluster's facade. No parameter =
@@ -371,6 +407,15 @@ class CruiseControlApi:
             return None
         from ..config.abstract_config import resolve_class
         return resolve_class(spec) if isinstance(spec, str) else spec
+
+    def _request_plugin(self, endpoint: EndPoint):
+        """Resolved ``<endpoint>.request.class`` handler instance or None
+        — the ONE plugin seam, shared by _dispatch and the TRACE branch
+        (which bypasses _dispatch for its no-route cluster semantics)."""
+        custom = self._plugin(endpoint, "request")
+        if custom is None:
+            return None
+        return custom() if isinstance(custom, type) else custom
 
     def _parse(self, endpoint: EndPoint, query: dict) -> dict:
         """Config-swappable parameter parsing
@@ -403,11 +448,10 @@ class CruiseControlApi:
                   cluster_id: str | None = None) -> dict:
         cc = cc or self._cc
         p = params
-        custom = self._plugin(endpoint, "request")
-        if custom is not None:
+        handler = self._request_plugin(endpoint)
+        if handler is not None:
             # CruiseControlRequestConfig reflection: the configured request
             # class handles the endpoint end to end.
-            handler = custom() if isinstance(custom, type) else custom
             return handler.handle(cc, p, principal)
         if endpoint in _SYNC_ENDPOINTS:
             return self._sync_handler(endpoint, p, principal, cc)

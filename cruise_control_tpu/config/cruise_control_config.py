@@ -374,6 +374,23 @@ def _definition() -> ConfigDef:
              "Fleet federation: any queued solver job older than this "
              "runs next regardless of priority class, so one cluster's "
              "flood can delay but never starve another cluster's work.")
+    d.define("tracing.enabled", T.BOOLEAN, True, None, I.LOW,
+             "Pipeline span tracing (utils.tracing): every operation — "
+             "sampling, model build, per-goal solve, execution — records "
+             "a span tree served at GET /trace, with per-stage latency "
+             "histograms on /metrics. Disabled, the tracer is a shared "
+             "no-op context manager: nothing on the solver hot path.")
+    d.define("tracing.max.traces", T.INT, 256, Range.at_least(1), I.LOW,
+             "Bound on the in-memory ring of recent traces (oldest "
+             "evicted; ~a few KB per trace).")
+    d.define("tracing.jsonl.path", T.STRING, "", None, I.LOW,
+             "Append one JSON line per completed trace to this file "
+             "(bench/CI artifact hook); empty = off.")
+    d.define("xla.telemetry.enabled", T.BOOLEAN, True, None, I.LOW,
+             "Hook jax.monitoring compile events (per padded-bucket-shape "
+             "count + seconds — the recompile-churn watchdog), "
+             "compilation-cache hit/miss counters, and device memory "
+             "gauges into /metrics (utils.xla_telemetry).")
     d.define("goal.violation.distribution.threshold.multiplier", T.DOUBLE, 1.0,
              Range.at_least(1), I.LOW,
              "Detector-triggered balance-threshold relaxation.")
@@ -757,7 +774,7 @@ def _definition() -> ConfigDef:
                "fix.offline.replicas", "rebalance", "stop.proposal",
                "pause.sampling", "resume.sampling", "demote.broker", "admin",
                "review", "topic.configuration", "rightsize", "remove.disks",
-               "fleet"):
+               "fleet", "trace"):
         d.define(f"{ep}.parameters.class", T.CLASS, None, None, I.LOW,
                  f"Parameter-parsing plugin for the {ep} endpoint "
                  "(callable(query) -> params dict).")

@@ -245,6 +245,12 @@ class Executor:
     def _run_adopted(self, tasks: list[ExecutionTask]) -> None:
         """Poll already-submitted reassignments to completion (no new
         alterPartitionReassignments calls)."""
+        from ..utils.tracing import TRACER
+        with TRACER.span("executor.execute", operation="execution",
+                         uuid=self._uuid, adopted=True):
+            self._run_adopted_inner(tasks)
+
+    def _run_adopted_inner(self, tasks: list[ExecutionTask]) -> None:
         t0 = time.time()
         tracker = self._task_manager.tracker
         in_flight = [t for t in tasks
@@ -285,6 +291,13 @@ class Executor:
         self._history.append(summary)
         # Execution sensors (Executor.java:145-148,346).
         from ..utils.sensors import SENSORS
+        from ..utils.tracing import TRACER
+        # Outcome attributes land on the ambient executor.execute span
+        # (opened in _run/_run_adopted around this call).
+        TRACER.annotate(
+            stopped=bool(summary["stopped"]),
+            tasks=sum(n for by_state in summary["taskCounts"].values()
+                      for n in by_state.values()))
         SENSORS.record_timer("executor_execution", time.time() - t0)
         SENSORS.count("executor_executions_stopped"
                       if summary["stopped"] else "executor_executions_finished")
@@ -437,19 +450,25 @@ class Executor:
     def _run(self) -> None:
         t0 = time.time()
         stopped = False
-        try:
-            if self._on_sampling_mode_change:
-                self._on_sampling_mode_change(True)
-            stopped = not self._inter_broker_move_phase()
-            if not stopped:
-                stopped = not self._intra_broker_move_phase()
-            if not stopped:
-                stopped = not self._leadership_phase()
-        finally:
-            self._throttle.clear_throttles()
-            if self._on_sampling_mode_change:
-                self._on_sampling_mode_change(False)
-            self._finish_run(t0, stopped)
+        # One span for the whole execution: batch_submit spans open on
+        # this thread and MUST nest under it — parentless they would each
+        # become a single-span trace and flood the tracer's ring.
+        from ..utils.tracing import TRACER
+        with TRACER.span("executor.execute", operation="execution",
+                         uuid=self._uuid):
+            try:
+                if self._on_sampling_mode_change:
+                    self._on_sampling_mode_change(True)
+                stopped = not self._inter_broker_move_phase()
+                if not stopped:
+                    stopped = not self._intra_broker_move_phase()
+                if not stopped:
+                    stopped = not self._leadership_phase()
+            finally:
+                self._throttle.clear_throttles()
+                if self._on_sampling_mode_change:
+                    self._on_sampling_mode_change(False)
+                self._finish_run(t0, stopped)
 
     def _abort_pending_and_inflight(self, in_flight: list[ExecutionTask]) -> None:
         assert self._planner is not None and self._task_manager is not None
@@ -488,14 +507,19 @@ class Executor:
                 self._concurrency.inter_broker_headroom,
                 max_total=self._concurrency.cluster_inter_broker_headroom())
             if batch:
-                self._throttle.set_throttles(batch)
-                targets = {t.topic_partition: t.proposal.new_replicas for t in batch}
-                self._admin.alter_partition_reassignments(targets)
-                for task in batch:
-                    tracker.transition(task, task.in_progress)
-                    self._concurrency.acquire_inter_broker(
-                        tuple(set(task.proposal.replicas_to_add)
-                              | set(task.proposal.replicas_to_remove)))
+                from ..utils.tracing import TRACER
+                with TRACER.span("executor.batch_submit",
+                                 type="INTER_BROKER_REPLICA_ACTION",
+                                 tasks=len(batch)):
+                    self._throttle.set_throttles(batch)
+                    targets = {t.topic_partition: t.proposal.new_replicas
+                               for t in batch}
+                    self._admin.alter_partition_reassignments(targets)
+                    for task in batch:
+                        tracker.transition(task, task.in_progress)
+                        self._concurrency.acquire_inter_broker(
+                            tuple(set(task.proposal.replicas_to_add)
+                                  | set(task.proposal.replicas_to_remove)))
                 in_flight.extend(batch)
 
             if not in_flight and self._planner.num_pending(
@@ -614,19 +638,25 @@ class Executor:
                 per_broker_cap=self._concurrency.intra_broker_per_broker_cap(),
                 in_flight_per_broker=inflight_per_broker)
             if batch:
-                rejected = set(alter(
-                    [(t.topic_partition, t.proposal.logdir_broker,
-                      t.proposal.destination_logdir) for t in batch]) or ())
-                for task in batch:
-                    tracker.transition(task, task.in_progress)
-                    p = task.proposal
-                    if (p.topic, p.partition, p.logdir_broker) in rejected:
-                        # Broker refused the move (bad/dead destination dir):
-                        # DEAD immediately, don't poll a move that will
-                        # never happen.
-                        tracker.transition(task, task.kill)
-                    else:
-                        in_flight.append(task)
+                from ..utils.tracing import TRACER
+                with TRACER.span("executor.batch_submit",
+                                 type="INTRA_BROKER_REPLICA_ACTION",
+                                 tasks=len(batch)):
+                    rejected = set(alter(
+                        [(t.topic_partition, t.proposal.logdir_broker,
+                          t.proposal.destination_logdir)
+                         for t in batch]) or ())
+                    for task in batch:
+                        tracker.transition(task, task.in_progress)
+                        p = task.proposal
+                        if (p.topic, p.partition, p.logdir_broker) \
+                                in rejected:
+                            # Broker refused the move (bad/dead destination
+                            # dir): DEAD immediately, don't poll a move
+                            # that will never happen.
+                            tracker.transition(task, task.kill)
+                        else:
+                            in_flight.append(task)
 
             if not in_flight and self._planner.num_pending(
                     TaskType.INTRA_BROKER_REPLICA_ACTION) == 0:
@@ -682,13 +712,17 @@ class Executor:
                 per_broker_cap=self._concurrency.leadership_per_broker_cap())
             if not batch:
                 return True
-            self._admin.elect_leaders([t.topic_partition for t in batch])
-            parts = self._admin.describe_partitions()
-            for task in batch:
-                tracker.transition(task, task.in_progress)
-                p = parts.get(task.topic_partition)
-                if p is not None and p.leader == task.proposal.new_leader:
-                    tracker.transition(task, task.completed)
-                else:
-                    tracker.transition(task, task.kill)
+            from ..utils.tracing import TRACER
+            with TRACER.span("executor.batch_submit",
+                             type="LEADER_ACTION", tasks=len(batch)):
+                self._admin.elect_leaders(
+                    [t.topic_partition for t in batch])
+                parts = self._admin.describe_partitions()
+                for task in batch:
+                    tracker.transition(task, task.in_progress)
+                    p = parts.get(task.topic_partition)
+                    if p is not None and p.leader == task.proposal.new_leader:
+                        tracker.transition(task, task.completed)
+                    else:
+                        tracker.transition(task, task.kill)
             time.sleep(0)  # yield between batches

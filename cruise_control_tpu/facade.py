@@ -63,6 +63,24 @@ _EXECUTION_OVERRIDES: contextvars.ContextVar[tuple] = \
     contextvars.ContextVar("execution_overrides", default=(None, {}, {}))
 
 
+def _traced_op(name: str):
+    """Root-span wrapper for the operation runnables: each facade
+    operation becomes one trace (operation attribute = runnable name;
+    cluster attribution comes from the ambient sensor label). Child
+    spans — aggregate, model assembly, per-goal solve, execution — open
+    contextvar-deep with no plumbing."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            from .utils.tracing import TRACER
+            with TRACER.span(name, operation=name):
+                return fn(self, *args, **kwargs)
+        return wrapper
+    return deco
+
+
 @dataclass
 class OperationResult:
     """What every operation returns (the runnable's computeResult)."""
@@ -95,6 +113,19 @@ class CruiseControl:
                  optimizer: GoalOptimizer | None = None):
         self._config = config
         self._admin = admin
+        # Observability wiring (round 8): one process-wide tracer,
+        # (re)configured from each facade's config — fleet overlays
+        # inherit the tracing.* keys from the base config, and per-cluster
+        # attribution comes from the ambient cluster label, not from
+        # per-facade tracers. XLA telemetry hooks jax.monitoring once.
+        from .utils import xla_telemetry
+        from .utils.tracing import TRACER
+        TRACER.configure(
+            enabled=config.get_boolean("tracing.enabled"),
+            max_traces=config.get_int("tracing.max.traces"),
+            jsonl_path=config.get("tracing.jsonl.path") or None)
+        xla_telemetry.install(
+            enabled=config.get_boolean("xla.telemetry.enabled"))
         self._load_monitor = load_monitor or LoadMonitor(config, admin)
         self._executor = executor or Executor(
             admin,
@@ -563,6 +594,7 @@ class CruiseControl:
             return cached
         return None
 
+    @_traced_op("proposals")
     def proposals(self, goals: Sequence[str] | None = None,
                   ignore_proposal_cache: bool = False,
                   use_ready_default_goals: bool = False,
@@ -636,6 +668,7 @@ class CruiseControl:
                                optimizer_result=result,
                                proposals=result.proposals)
 
+    @_traced_op("rebalance")
     def rebalance(self, goals: Sequence[str] | None = None, dryrun: bool = True,
                   ignore_proposal_cache: bool = False,
                   excluded_topics: Sequence[str] = (),
@@ -672,6 +705,7 @@ class CruiseControl:
         return OperationResult("rebalance", dryrun, result, result.proposals,
                                executed, reason)
 
+    @_traced_op("add_broker")
     def add_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
                     goals: Sequence[str] | None = None,
                     is_triggered_by_user_request: bool = True,
@@ -694,6 +728,7 @@ class CruiseControl:
         return OperationResult("add_broker", dryrun, result, result.proposals,
                                executed, reason)
 
+    @_traced_op("remove_broker")
     def remove_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
                        goals: Sequence[str] | None = None,
                        is_triggered_by_user_request: bool = True,
@@ -722,6 +757,7 @@ class CruiseControl:
         return OperationResult("remove_broker", dryrun, result,
                                result.proposals, executed, reason)
 
+    @_traced_op("demote_broker")
     def demote_brokers(self, broker_ids: Sequence[int], dryrun: bool = True,
                        is_triggered_by_user_request: bool = True,
                        skip_urp_demotion: bool = True,
@@ -782,6 +818,7 @@ class CruiseControl:
         return OperationResult("demote_broker", dryrun, result,
                                result.proposals, executed, reason)
 
+    @_traced_op("fix_offline_replicas")
     def fix_offline_replicas(self, dryrun: bool = True,
                              goals: Sequence[str] | None = None,
                              is_triggered_by_user_request: bool = True,
@@ -805,6 +842,7 @@ class CruiseControl:
         return OperationResult("fix_offline_replicas", dryrun, result,
                                result.proposals, executed, reason)
 
+    @_traced_op("topic_configuration")
     def update_topic_replication_factor(self, topics: Sequence[str],
                                         replication_factor: int,
                                         dryrun: bool = True,
@@ -921,6 +959,7 @@ class CruiseControl:
                  "broker": m.broker_id, "sourceLogdir": m.source_logdir,
                  "destinationLogdir": m.destination_logdir} for m in moves]})
 
+    @_traced_op("remove_disks")
     def remove_disks(self, broker_logdirs: Mapping[int, Sequence[str]],
                      dryrun: bool = True, reason: str = "",
                      uuid: str = "") -> OperationResult:
@@ -981,6 +1020,7 @@ class CruiseControl:
         return self._intra_broker_result("remove_disks", state, meta, marked,
                                          balanced, disk_meta, dryrun, reason)
 
+    @_traced_op("rebalance_disk")
     def rebalance_disk(self, dryrun: bool = True, reason: str = "",
                        uuid: str = "") -> OperationResult:
         """REBALANCE?rebalance_disk=true — intra-broker disk-usage balance

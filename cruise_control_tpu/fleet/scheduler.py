@@ -150,14 +150,27 @@ class FleetScheduler:
 
     def _run(self, job: SolverJob) -> None:
         from ..utils.sensors import SENSORS, cluster_label
-        wait_s = self._clock() - job.enqueued_at
-        SENSORS.record_timer("fleet_scheduler_queue_wait",
-                             max(wait_s, 0.0),
+        from ..utils.tracing import TRACER
+        wait_s = max(self._clock() - job.enqueued_at, 0.0)
+        SENSORS.record_timer("fleet_scheduler_queue_wait", wait_s,
                              labels={"cluster": job.cluster_id,
                                      "kind": job.kind.name})
+        # Queue-wait DISTRIBUTION per priority class: the timer above
+        # collapses to count/sum/last/max; fairness regressions live in
+        # the tail, which only a histogram preserves.
+        SENSORS.observe("fleet_queue_wait_seconds", wait_s,
+                        labels={"cluster": job.cluster_id,
+                                "kind": job.kind.name})
         t0 = time.monotonic()
         try:
-            with cluster_label(job.cluster_id):
+            # The job's own operation trace (the facade op opens the root
+            # span) gets the queue wait attached via the wrapping span —
+            # worker threads have no ambient parent, so fleet.job IS the
+            # root and the op span nests under it.
+            with cluster_label(job.cluster_id), \
+                    TRACER.span("fleet.job", operation=f"fleet.{job.kind.name.lower()}",
+                                cluster=job.cluster_id, kind=job.kind.name,
+                                queue_wait_s=round(wait_s, 6)):
                 result = job.fn()
         except BaseException as e:  # noqa: BLE001 — carried by the future
             job.future.set_exception(e)

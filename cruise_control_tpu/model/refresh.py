@@ -157,6 +157,7 @@ class IncrementalModelPipeline:
         ``topology_token`` is an O(1) metadata-generation stamp when the
         backend provides one (None → structural fingerprint, O(cluster)
         hashing but still far cheaper than a rebuild)."""
+        from ..utils.tracing import TRACER
         t0 = time.perf_counter()
         brokers = sorted(brokers, key=lambda b: b.broker_id)
         bfp = broker_table_fingerprint(brokers)
@@ -164,7 +165,9 @@ class IncrementalModelPipeline:
             key = ("fp", partition_topology_fingerprint(partitions), bfp)
         else:
             key = ("gen", topology_token, bfp)
-        with self._lock:
+        with TRACER.span("model.assemble",
+                         num_partitions=len(partitions),
+                         num_brokers=len(brokers)), self._lock:
             cache = self._cache
             if cache is not None and cache.key == key \
                     and len(partitions) == len(cache.part_names):
@@ -254,6 +257,13 @@ class IncrementalModelPipeline:
         cache.load_dev = (state.leader_load, state.follower_load,
                           state.leader_slot)
         self._cache = cache
+        # Cold build ships EVERYTHING (topology + loads) — account it so
+        # the hit path's near-zero transfer is visible by contrast.
+        from ..utils.xla_telemetry import record_transfer
+        record_transfer(
+            sum(getattr(a, "nbytes", 0) for a in cache.topo_dev.values())
+            + sum(getattr(a, "nbytes", 0) for a in cache.load_dev),
+            direction="h2d", source="model_rebuild")
         self._record(RefreshStats(False, assemble_s=t1 - t0,
                                   freeze_s=t2 - t1, transfer_s=0.0))
         return state, meta
@@ -313,14 +323,23 @@ class IncrementalModelPipeline:
             host = tuple(a.copy() for a in host)
         dev = jax.device_put(host)
         cache.load_dev = dev
+        # Transfer accounting: the fused load device_put is THE recurring
+        # host→device shipment of the steady-state pipeline; counted in
+        # /metrics and attached to the ambient model.assemble span.
+        from ..utils.xla_telemetry import record_transfer
+        record_transfer(sum(a.nbytes for a in host), direction="h2d",
+                        source="model_refresh")
         return dev
 
     def _record(self, stats: RefreshStats) -> None:
         self.last_stats = stats
         from ..utils.sensors import SENSORS
+        from ..utils.tracing import TRACER
         SENSORS.count("model_topology_cache_hit" if stats.topology_hit
                       else "model_topology_cache_miss")
         SENSORS.record_timer("model_refresh_assemble", stats.assemble_s)
+        TRACER.annotate(topology_hit=stats.topology_hit,
+                        assemble_s=round(stats.assemble_s, 6))
         if stats.topology_hit:
             SENSORS.record_timer("model_refresh_transfer", stats.transfer_s)
         else:

@@ -295,14 +295,17 @@ class MetricSampleAggregator:
     def aggregate(self, options: AggregationOptions) -> AggregationResult:
         """Aggregate stable windows meeting the completeness requirements
         (MetricSampleAggregator.aggregate:193). Cached by generation."""
-        with self._lock:
+        from ...utils.tracing import TRACER
+        with self._lock, TRACER.span("monitor.aggregate") as sp:
             cache_key = (self._generation, options.min_valid_entity_ratio,
                          options.min_valid_entity_group_ratio, options.min_valid_windows,
                          options.max_allowed_extrapolations_per_entity, options.granularity,
                          options.interested_entities, options.include_invalid_entities,
                          options.start_ms, options.end_ms)
             if cache_key in self._cache:
+                sp.set(cache_hit=True, generation=self._generation)
                 return self._cache[cache_key]
+            sp.set(cache_hit=False, generation=self._generation)
             completeness = self._completeness_locked(options)
             entities, rows = self._entity_rows(options)
             values, cats = self._store.aggregate_values()
@@ -351,6 +354,8 @@ class MetricSampleAggregator:
             self._cache[cache_key] = result
             while len(self._cache) > self._cache_size:
                 self._cache.pop(next(iter(self._cache)))
+            sp.set(num_entities=len(entities),
+                   num_windows=len(completeness.valid_windows))
             return result
 
     def peek_current_window(self) -> tuple[list, np.ndarray]:

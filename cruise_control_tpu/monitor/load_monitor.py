@@ -153,6 +153,10 @@ class LoadMonitor:
         self._prefetch_lock = threading.Lock()
         self._prefetched: tuple | None = None
         self._prefetch_thread: threading.Thread | None = None
+        # Last full cluster_model() wall-clock: the in-flight progress
+        # estimate for the GeneratingClusterModel step (progress.to_list
+        # reports a live completionPercentage from it).
+        self._last_model_s: float | None = None
 
     # -- lifecycle --------------------------------------------------------
     def start_up(self, block_on_load: bool = True) -> None:
@@ -335,11 +339,15 @@ class LoadMonitor:
             if pre is not None and pre[0] == self.model_generation \
                     and pre[1] == self._metadata_token():
                 from ..utils.sensors import SENSORS
+                from ..utils.tracing import TRACER
                 SENSORS.count("model_prefetch_hits")
+                TRACER.annotate(model_prefetch_hit=True)
                 return pre[2]
         from ..utils.progress import step
+        from ..utils.tracing import TRACER
         step("WaitingForClusterModel")
-        with self._model_semaphore:
+        with self._model_semaphore, \
+                TRACER.span("monitor.cluster_model") as sp:
             # Timer starts INSIDE the semaphore: queue wait is the
             # WaitingForClusterModel step, not model-creation time.
             t0 = time.time()
@@ -369,14 +377,17 @@ class LoadMonitor:
                 import dataclasses as _dc
                 opts = _dc.replace(opts, start_ms=start_ms, end_ms=end_ms)
             agg = self._partition_agg.aggregate(opts)
-            step("GeneratingClusterModel")
+            step("GeneratingClusterModel", estimate_s=self._last_model_s)
             built = self._build(partitions, alive, agg, reduction, token)
             if self.model_transform is not None:
                 built = self.model_transform(*built)
+            sp.set(generation=self.model_generation,
+                   num_partitions=len(partitions), num_brokers=len(alive))
         # cluster-model-creation-timer (LoadMonitor.java:177).
         from ..utils.sensors import SENSORS
+        self._last_model_s = time.time() - t0
         SENSORS.record_timer("monitor_cluster_model_creation",
-                             time.time() - t0)
+                             self._last_model_s)
         return built
 
     def _build(self, partitions: Mapping[tuple[str, int], PartitionState],

@@ -72,17 +72,25 @@ class MetricFetcherManager:
     def fetch_metric_samples(self, partitions: Mapping[tuple[str, int], PartitionState],
                              start_ms: int, end_ms: int,
                              store: bool = True) -> SamplerResult:
-        buckets = self._assignor(partitions, len(self._samplers))
-        futures = [self._pool.submit(self._fetch_one, s, b, start_ms, end_ms)
-                   for s, b in zip(self._samplers, buckets)]
-        merged = SamplerResult([], [], 0)
-        for f in futures:
-            r = f.result()
-            merged.partition_samples.extend(r.partition_samples)
-            merged.broker_samples.extend(r.broker_samples)
-            merged.skipped_partitions += r.skipped_partitions
-        self._ingest(merged, end_ms, store)
-        return merged
+        from ...utils.tracing import TRACER
+        with TRACER.span("monitor.sample_fetch", operation="sampling",
+                         num_partitions=len(partitions),
+                         num_fetchers=len(self._samplers)) as sp:
+            buckets = self._assignor(partitions, len(self._samplers))
+            futures = [self._pool.submit(self._fetch_one, s, b,
+                                         start_ms, end_ms)
+                       for s, b in zip(self._samplers, buckets)]
+            merged = SamplerResult([], [], 0)
+            for f in futures:
+                r = f.result()
+                merged.partition_samples.extend(r.partition_samples)
+                merged.broker_samples.extend(r.broker_samples)
+                merged.skipped_partitions += r.skipped_partitions
+            self._ingest(merged, end_ms, store)
+            sp.set(partition_samples=len(merged.partition_samples),
+                   broker_samples=len(merged.broker_samples),
+                   skipped_partitions=merged.skipped_partitions)
+            return merged
 
     def _fetch_one(self, sampler: MetricSampler, bucket, start_ms, end_ms):
         try:

@@ -28,40 +28,60 @@ class OperationProgress:
         self._lock = threading.Lock()
         self._steps: list[dict] = []
 
-    def start_step(self, description: str) -> None:
+    def _finish_last_locked(self, now: float) -> None:
+        """Close the in-flight step exactly once: a re-entered ``done()``
+        (layers at different depths both signal completion) must neither
+        overwrite the recorded duration nor restart the clock."""
+        if not self._steps or self._steps[-1].get("doneFlag"):
+            return
+        last = self._steps[-1]
+        last.setdefault("durationS", round(now - last["startS"], 3))
+        last["completionPercentage"] = 100.0
+        last["doneFlag"] = True
+
+    def start_step(self, description: str,
+                   estimate_s: float | None = None) -> None:
+        """Open a new step (closing the previous one). ``estimate_s`` is
+        the layer's expected duration, letting ``to_list()`` report a
+        LIVE completionPercentage for the in-flight step instead of a
+        frozen 0.0 (e.g. the monitor passes its last model-build time)."""
         now = time.time()
         with self._lock:
-            if self._steps:
-                self._steps[-1].setdefault("durationS", round(
-                    now - self._steps[-1]["startS"], 3))
-                self._steps[-1]["completionPercentage"] = 100.0
-            self._steps.append({"step": description, "startS": now,
-                                "completionPercentage": 0.0})
+            self._finish_last_locked(now)
+            step = {"step": description, "startS": now,
+                    "completionPercentage": 0.0}
+            if estimate_s is not None and estimate_s > 0:
+                step["estimateS"] = float(estimate_s)
+            self._steps.append(step)
 
     def done(self) -> None:
         with self._lock:
-            if self._steps:
-                self._steps[-1].setdefault("durationS", round(
-                    time.time() - self._steps[-1]["startS"], 3))
-                self._steps[-1]["completionPercentage"] = 100.0
+            self._finish_last_locked(time.time())
 
     def to_list(self) -> list[dict]:
+        now = time.time()
         with self._lock:
-            return [{"step": s["step"],
-                     "completionPercentage": s["completionPercentage"],
-                     **({"durationS": s["durationS"]} if "durationS" in s
-                        else {})}
-                    for s in self._steps] or \
-                [{"step": "Pending", "completionPercentage": 0.0}]
+            out = []
+            for s in self._steps:
+                pct = s["completionPercentage"]
+                if not s.get("doneFlag") and "estimateS" in s:
+                    # Live estimate for the in-flight step, clamped below
+                    # 100: only done() may declare completion.
+                    pct = min(99.0, round(
+                        100.0 * (now - s["startS"]) / s["estimateS"], 1))
+                out.append({"step": s["step"], "completionPercentage": pct,
+                            **({"durationS": s["durationS"]}
+                               if "durationS" in s else {})})
+            return out or [{"step": "Pending", "completionPercentage": 0.0}]
 
 
 def set_current(progress: OperationProgress | None):
     return _current.set(progress)
 
 
-def step(description: str) -> None:
+def step(description: str, estimate_s: float | None = None) -> None:
     """Record a step on the ambient operation's progress (no-op outside a
     tracked user task)."""
     progress = _current.get()
     if progress is not None:
-        progress.start_step(description)
+        progress.start_step(description, estimate_s=estimate_s)

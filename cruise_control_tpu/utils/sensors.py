@@ -10,17 +10,31 @@ counts/timers Executor.java:145-148,346). JMX is a JVM-ism; the TPU-era
 export surface is a Prometheus ``/metrics`` endpoint fed by the same
 sensor registry.
 
+Four metric kinds: counters, gauges, timers (count/sum/last/max — the
+Dropwizard shape), and histograms (``observe``): log-spaced buckets
+rendered as cumulative ``_bucket{le=...}`` series so latency
+DISTRIBUTIONS survive aggregation — the timer shape collapses to
+count/sum/last/max and no p99 can be recovered from it. The span tracer
+(utils.tracing) feeds one histogram series per span name automatically.
+
 Hot-path cost is one dict write per record — no locks on read-modify of
 floats beyond a plain mutex, nothing device-side.
 """
 
 from __future__ import annotations
 
+import bisect
 import contextvars
 import threading
 from contextlib import contextmanager
 
 _PREFIX = "kafka_cruisecontrol"
+
+# Log-spaced default histogram buckets (seconds): the 1-2.5-5 decade
+# ladder from 1 ms to 60 s, covering everything from a span around a
+# single device dispatch to a full 7k-broker chain solve.
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 60.0)
 
 # Ambient per-cluster label (fleet federation): work executed on behalf of
 # a registered cluster — a scheduler job, a ?cluster=-routed API request —
@@ -46,8 +60,62 @@ def current_cluster_label() -> str | None:
     return _CLUSTER.get()
 
 
+def escape_label_value(value) -> str:
+    """Prometheus text-format label escaping: backslash, double quote and
+    newline must be escaped or the scrape line is syntactically broken
+    (a single quoted value with an embedded ``"`` truncates the label
+    set and corrupts every sample after it)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+class _Histogram:
+    """Per-series bucket counts. ``counts[i]`` is the NON-cumulative count
+    of observations ≤ ``buckets[i]`` and > the previous bound;
+    ``counts[-1]`` is the +Inf overflow. Cumulated at render time."""
+
+    __slots__ = ("buckets", "counts", "total", "count")
+
+    def __init__(self, buckets: tuple):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect.bisect_left(self.buckets, value)] += 1
+        self.total += value
+        self.count += 1
+
+    def quantile(self, q: float) -> float | None:
+        return bucket_quantile(self.buckets, self.counts, q)
+
+
+def bucket_quantile(buckets: tuple, counts: list, q: float) -> float | None:
+    """Estimated q-quantile (0..1) over NON-cumulative bucket counts
+    (+Inf overflow last), with linear interpolation inside the landing
+    bucket (the Prometheus histogram_quantile estimate); None when empty.
+    The +Inf bucket clamps to the top finite bound. Exposed standalone so
+    callers holding snapshot DIFFS (per-stage bench windows) reuse the
+    same math."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= rank and c:
+            if i >= len(buckets):
+                return float(buckets[-1])
+            lo = buckets[i - 1] if i else 0.0
+            hi = buckets[i]
+            return float(lo + (hi - lo) * (rank - (cum - c)) / c)
+    return float(buckets[-1])
+
+
 class SensorRegistry:
-    """Counters, gauges and timers keyed by (name, labels)."""
+    """Counters, gauges, timers and histograms keyed by (name, labels)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -55,6 +123,7 @@ class SensorRegistry:
         self._gauges: dict[tuple[str, tuple], float] = {}
         # name -> (count, total_seconds, last_seconds, max_seconds)
         self._timers: dict[tuple[str, tuple], tuple[int, float, float, float]] = {}
+        self._histograms: dict[tuple[str, tuple], _Histogram] = {}
 
     @staticmethod
     def _key(name: str, labels: dict | None) -> tuple[str, tuple]:
@@ -81,11 +150,47 @@ class SensorRegistry:
             self._timers[k] = (count + 1, total + seconds, seconds,
                               max(mx, seconds))
 
+    def observe(self, name: str, value: float, labels: dict | None = None,
+                buckets: tuple | None = None) -> None:
+        """Record into the histogram series ``(name, labels)``. The bucket
+        layout is fixed by the FIRST observation of a series (Prometheus
+        semantics: bucket bounds of a live series never change)."""
+        k = self._key(name, labels)
+        with self._lock:
+            h = self._histograms.get(k)
+            if h is None:
+                h = self._histograms[k] = _Histogram(
+                    tuple(buckets) if buckets else DEFAULT_BUCKETS)
+            h.observe(value)
+
+    def quantile(self, name: str, q: float,
+                 labels: dict | None = None) -> float | None:
+        """Estimated q-quantile of a histogram series (None when the
+        series does not exist or is empty) — the bench/CI summary hook
+        for p50/p99 columns."""
+        k = self._key(name, labels)
+        with self._lock:
+            h = self._histograms.get(k)
+            return h.quantile(q) if h is not None else None
+
+    def histogram_snapshot(self, name: str, labels: dict | None = None,
+                           ) -> dict | None:
+        """{buckets, counts (non-cumulative, +Inf last), sum, count} of a
+        series, or None (test/introspection surface)."""
+        k = self._key(name, labels)
+        with self._lock:
+            h = self._histograms.get(k)
+            if h is None:
+                return None
+            return {"buckets": h.buckets, "counts": list(h.counts),
+                    "sum": h.total, "count": h.count}
+
     def clear(self) -> None:
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
             self._timers.clear()
+            self._histograms.clear()
 
     def remove_labeled(self, label: str, value: str) -> int:
         """Drop every series carrying ``label=value`` (fleet deregister:
@@ -94,7 +199,8 @@ class SensorRegistry:
         pair = (label, value)
         removed = 0
         with self._lock:
-            for store in (self._counters, self._gauges, self._timers):
+            for store in (self._counters, self._gauges, self._timers,
+                          self._histograms):
                 stale = [k for k in store if pair in k[1]]
                 for k in stale:
                     del store[k]
@@ -103,35 +209,64 @@ class SensorRegistry:
 
     # -- exposition --------------------------------------------------------
     @staticmethod
-    def _fmt(name: str, labels: tuple, value: float) -> str:
-        full = f"{_PREFIX}_{name}"
-        if labels:
-            inner = ",".join(f'{k}="{v}"' for k, v in labels)
-            full += "{" + inner + "}"
-        return f"{full} {value}"
+    def _labels_str(labels: tuple, extra: tuple = ()) -> str:
+        pairs = labels + extra
+        if not pairs:
+            return ""
+        inner = ",".join(f'{k}="{escape_label_value(v)}"' for k, v in pairs)
+        return "{" + inner + "}"
+
+    @classmethod
+    def _fmt(cls, name: str, labels: tuple, value: float) -> str:
+        return f"{_PREFIX}_{name}{cls._labels_str(labels)} {value}"
+
+    @staticmethod
+    def _type_line(lines: list[str], seen: set, family: str,
+                   kind: str) -> None:
+        if family not in seen:
+            seen.add(family)
+            lines.append(f"# TYPE {_PREFIX}_{family} {kind}")
 
     def render(self, extra_gauges: dict | None = None) -> str:
         """Prometheus text format. ``extra_gauges`` lets the scrape handler
         mix in live values (name -> value or (value, labels))."""
         lines: list[str] = []
+        typed: set[str] = set()
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             timers = dict(self._timers)
+            histograms = {k: (h.buckets, list(h.counts), h.total, h.count)
+                          for k, h in self._histograms.items()}
         for name, value in (extra_gauges or {}).items():
             labels: dict | None = None
             if isinstance(value, tuple):
                 value, labels = value
             gauges[self._key(name, labels)] = float(value)
         for (name, labels), v in sorted(counters.items()):
+            self._type_line(lines, typed, name + "_total", "counter")
             lines.append(self._fmt(name + "_total", labels, v))
         for (name, labels), v in sorted(gauges.items()):
+            self._type_line(lines, typed, name, "gauge")
             lines.append(self._fmt(name, labels, v))
         for (name, labels), (count, total, last, mx) in sorted(timers.items()):
             lines.append(self._fmt(name + "_seconds_count", labels, count))
             lines.append(self._fmt(name + "_seconds_sum", labels, total))
             lines.append(self._fmt(name + "_seconds_last", labels, last))
             lines.append(self._fmt(name + "_seconds_max", labels, mx))
+        for (name, labels), (buckets, counts, total, count) in sorted(
+                histograms.items()):
+            self._type_line(lines, typed, name, "histogram")
+            full = f"{_PREFIX}_{name}_bucket"
+            cum = 0
+            for bound, c in zip(buckets, counts):
+                cum += c
+                lines.append(full + self._labels_str(
+                    labels, (("le", repr(float(bound))),)) + f" {cum}")
+            lines.append(full + self._labels_str(
+                labels, (("le", "+Inf"),)) + f" {count}")
+            lines.append(self._fmt(name + "_sum", labels, total))
+            lines.append(self._fmt(name + "_count", labels, count))
         return "\n".join(lines) + "\n"
 
 

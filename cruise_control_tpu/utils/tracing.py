@@ -1,0 +1,333 @@
+"""Request-scoped span tracing for the rebalance pipeline.
+
+Dapper-style (Sigelman et al., 2010) span trees over every operation the
+service runs: a rebalance cycle becomes one trace — sample fetch →
+aggregate → model assembly (cache hit/miss, transfer bytes) → per-goal
+solve → proposal diff → execution — instead of forty disconnected
+counters. The reference exposes ~40 JMX sensors but nothing that explains
+*why* one proposal took 12 s; spans carry the causality.
+
+Design points:
+
+- **Contextvar propagation** (the same pattern as ``sensors.cluster_label``
+  and ``progress.OperationProgress``): deep layers open child spans with
+  no plumbing; a span opened on a worker thread with no ambient parent
+  becomes its own trace root (the fleet scheduler's jobs, the executor's
+  run thread, the background sampling loop).
+- **Bounded ring** of recent traces, served by ``GET
+  /kafkacruisecontrol/trace`` as OTLP-compatible JSON span trees
+  (traceId/spanId/parentSpanId/startTimeUnixNano/attributes key-value
+  shape), filterable by cluster and operation.
+- **Automatic histograms**: every span close records into the
+  ``trace_span_seconds`` histogram (one series per span name, ambient
+  cluster label applies) so ``/metrics`` grows a ``_bucket`` latency
+  distribution per pipeline stage with zero extra call sites.
+- **JSONL dump** (``configure(jsonl_path=...)``): bench runs append one
+  JSON line per completed trace for offline analysis / CI artifacts.
+- **Zero-cost when disabled**: ``span()`` returns a shared no-op context
+  manager — no allocation, no contextvar write, no clock read — so the
+  config flag removes tracing from the solver hot path entirely.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import threading
+import time
+
+from .sensors import SENSORS, current_cluster_label
+
+import contextvars
+
+_CURRENT: contextvars.ContextVar["Span | None"] = \
+    contextvars.ContextVar("trace_current_span", default=None)
+
+# Monotone span-id source; thread-safe in CPython (single bytecode next()).
+_IDS = itertools.count(1)
+
+SPAN_HISTOGRAM = "trace_span_seconds"
+
+
+class Span:
+    """One timed, attributed node of a trace tree."""
+
+    __slots__ = ("name", "span_id", "parent", "trace_id", "start_ns",
+                 "end_ns", "attributes", "children")
+
+    def __init__(self, name: str, parent: "Span | None"):
+        self.name = name
+        self.parent = parent
+        self.span_id = f"{next(_IDS):016x}"
+        self.trace_id = parent.trace_id if parent is not None \
+            else f"{next(_IDS):032x}"
+        self.start_ns = time.time_ns()
+        self.end_ns = 0
+        self.attributes: dict = {}
+        self.children: list[Span] = []
+
+    def set(self, **attributes) -> None:
+        """Attach attributes (goal name, candidate count, transfer bytes…)."""
+        self.attributes.update(attributes)
+
+    @property
+    def duration_s(self) -> float:
+        return max(0.0, (self.end_ns - self.start_ns) / 1e9)
+
+    def to_dict(self) -> dict:
+        """OTLP-compatible field shape, nested (children inline — the
+        trace endpoint serves trees, not flat span lists)."""
+        return {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentSpanId": self.parent.span_id if self.parent else "",
+            "name": self.name,
+            "startTimeUnixNano": str(self.start_ns),
+            "endTimeUnixNano": str(self.end_ns),
+            "durationMs": round((self.end_ns - self.start_ns) / 1e6, 3),
+            "attributes": [{"key": k, "value": _otlp_value(v)}
+                           for k, v in self.attributes.items()],
+            "children": [c.to_dict() for c in self.children],
+        }
+
+
+def _otlp_value(v) -> dict:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}  # OTLP JSON encodes int64 as string
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+class _NullSpan:
+    """Shared no-op context manager for disabled tracing: the hot path
+    pays one attribute load and one ``is None``-style branch, nothing
+    else."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **attributes) -> None:
+        pass
+
+
+_NULL = _NullSpan()
+
+
+class _SpanScope:
+    """Live span context manager: opens on enter, closes (histogram +
+    trace completion) on exit. Exceptions mark the span and propagate."""
+
+    __slots__ = ("_tracer", "_span", "_token")
+
+    def __init__(self, tracer: "Tracer", name: str, attributes: dict):
+        self._tracer = tracer
+        self._span = Span(name, _CURRENT.get())
+        if attributes:
+            self._span.attributes.update(attributes)
+
+    def __enter__(self) -> Span:
+        self._token = _CURRENT.set(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        _CURRENT.reset(self._token)
+        if exc_type is not None:
+            self._span.attributes.setdefault("error", exc_type.__name__)
+        self._tracer._close(self._span)
+        return False
+
+
+class Trace:
+    """A completed span tree plus its routing metadata."""
+
+    __slots__ = ("root", "operation", "operations", "cluster", "span_count")
+
+    def __init__(self, root: Span, cluster: str | None, span_count: int):
+        self.root = root
+        self.operation = str(root.attributes.get("operation", root.name))
+        # EVERY operation attribute in the tree, for filtering: a
+        # fleet-routed request's root is the scheduler's "fleet.on_demand"
+        # wrapper span with the actual runnable ("rebalance") nested one
+        # level down — ?operation=rebalance must still find it.
+        ops = {self.operation}
+        stack = [root]
+        while stack:
+            s = stack.pop()
+            op = s.attributes.get("operation")
+            if op is not None:
+                ops.add(str(op))
+            stack.extend(s.children)
+        self.operations = frozenset(ops)
+        self.cluster = cluster
+        self.span_count = span_count
+
+    def to_dict(self) -> dict:
+        return {
+            "traceId": self.root.trace_id,
+            "operation": self.operation,
+            "operations": sorted(self.operations),
+            "cluster": self.cluster,
+            "startTimeUnixNano": str(self.root.start_ns),
+            "durationMs": round(
+                (self.root.end_ns - self.root.start_ns) / 1e6, 3),
+            "spanCount": self.span_count,
+            "root": self.root.to_dict(),
+        }
+
+
+class Tracer:
+    """Process-wide tracer: span factory + bounded trace ring + exports."""
+
+    def __init__(self, max_traces: int = 256):
+        self._lock = threading.Lock()
+        # JSONL appends serialize on their own lock: a multi-KB trace line
+        # is bigger than any atomic-append guarantee, and two threads
+        # closing root spans concurrently must not interleave bytes in
+        # the dump — but the ring lock must not be held across file I/O.
+        self._dump_lock = threading.Lock()
+        self._enabled = True
+        self._ring: collections.deque[Trace] = \
+            collections.deque(maxlen=max_traces)
+        self._jsonl_path: str | None = None
+        self.spans_closed = 0
+        self.traces_completed = 0
+
+    # -- configuration -----------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def configure(self, enabled: bool | None = None,
+                  max_traces: int | None = None,
+                  jsonl_path: str | None = ...) -> None:
+        """Apply the config surface (tracing.enabled / tracing.max.traces /
+        tracing.jsonl.path). ``jsonl_path``: ``...`` = leave unchanged,
+        None/"" = off, a path = append one JSON line per trace."""
+        with self._lock:
+            if enabled is not None:
+                self._enabled = bool(enabled)
+            if max_traces is not None and max_traces != self._ring.maxlen:
+                self._ring = collections.deque(self._ring,
+                                               maxlen=max(1, max_traces))
+            if jsonl_path is not ...:
+                self._jsonl_path = jsonl_path or None
+
+    # -- recording ---------------------------------------------------------
+    def span(self, name: str, **attributes):
+        """Open a child span of the ambient span (or a new trace root).
+        Returns a context manager yielding the Span (``.set(**attrs)``)."""
+        if not self._enabled:
+            return _NULL
+        return _SpanScope(self, name, attributes)
+
+    def record_span(self, name: str, duration_s: float, **attributes) -> None:
+        """Attach an ALREADY-TIMED child span to the ambient span (the
+        fused-chain path: per-goal wall-clock is apportioned after one
+        device dispatch, so the goals' spans cannot be opened live)."""
+        if not self._enabled:
+            return
+        parent = _CURRENT.get()
+        span = Span(name, parent)
+        span.end_ns = time.time_ns()
+        span.start_ns = span.end_ns - int(duration_s * 1e9)
+        span.attributes.update(attributes)
+        self._close(span)
+
+    def annotate(self, **attributes) -> None:
+        """Attach attributes to the ambient span; no-op outside one (deep
+        layers can report cache hits / byte counts without plumbing)."""
+        if not self._enabled:
+            return
+        span = _CURRENT.get()
+        if span is not None:
+            span.attributes.update(attributes)
+
+    def current_span(self) -> Span | None:
+        return _CURRENT.get()
+
+    def _close(self, span: Span) -> None:
+        if not span.end_ns:
+            span.end_ns = time.time_ns()
+        SENSORS.observe(SPAN_HISTOGRAM, span.duration_s,
+                        labels={"span": span.name})
+        parent = span.parent
+        if parent is not None:
+            parent.children.append(span)
+            with self._lock:
+                self.spans_closed += 1
+            return
+        trace = Trace(span, current_cluster_label(),
+                      span_count=_count_spans(span))
+        with self._lock:
+            self.spans_closed += 1
+            self.traces_completed += 1
+            self._ring.append(trace)
+            path = self._jsonl_path
+        if path:
+            try:
+                line = json.dumps(trace.to_dict()) + "\n"
+                with self._dump_lock, open(path, "a") as f:
+                    f.write(line)
+            except OSError:  # pragma: no cover — dump is best-effort
+                pass
+
+    # -- export ------------------------------------------------------------
+    def traces(self, cluster: str | None = None,
+               operation: str | None = None,
+               limit: int | None = None) -> list[dict]:
+        """Recent traces, newest first, optionally filtered by the cluster
+        label they ran under and/or operation name."""
+        with self._lock:
+            snapshot = list(self._ring)
+        out: list[dict] = []
+        if limit is not None and limit <= 0:
+            return out
+        for t in reversed(snapshot):
+            if cluster is not None and t.cluster != cluster:
+                continue
+            if operation is not None and operation not in t.operations:
+                continue
+            out.append(t.to_dict())
+            if limit is not None and len(out) >= limit:
+                break
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+def _count_spans(span: Span) -> int:
+    n = 1
+    stack = list(span.children)
+    while stack:
+        s = stack.pop()
+        n += 1
+        stack.extend(s.children)
+    return n
+
+
+def span_names(trace_dict: dict) -> list[str]:
+    """Flat pre-order span-name list of a ``Trace.to_dict()`` payload
+    (test/assertion helper)."""
+    out: list[str] = []
+
+    def walk(node: dict) -> None:
+        out.append(node["name"])
+        for c in node["children"]:
+            walk(c)
+
+    walk(trace_dict["root"])
+    return out
+
+
+TRACER = Tracer()

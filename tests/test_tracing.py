@@ -1,0 +1,317 @@
+"""Pipeline tracing + XLA/device telemetry (round 8).
+
+Unit coverage of the span tracer (nesting, ring bound, disabled no-op,
+JSONL dump), the OperationProgress fixes (idempotent done, live
+completion estimate), and the end-to-end acceptance bar: one rebalance
+dry-run against the in-memory fixture yields ONE trace tree —
+aggregate → model (cache hit/miss + transfer bytes) → per-goal solve →
+proposal diff — retrievable from GET /kafkacruisecontrol/trace, with
+well-formed per-stage ``_bucket`` histograms plus ``xla_compile_seconds``
+and ``device_memory_bytes`` series on /metrics."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from cruise_control_tpu.api.server import CruiseControlApi
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+from cruise_control_tpu.executor.admin import InMemoryAdminBackend, PartitionState
+from cruise_control_tpu.executor.executor import Executor
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.monitor import LoadMonitor, StaticCapacityResolver
+from cruise_control_tpu.monitor.sampling import SyntheticSampler
+from cruise_control_tpu.utils.progress import OperationProgress
+from cruise_control_tpu.utils.tracing import TRACER, Tracer, span_names
+
+
+# ---- tracer unit behavior ------------------------------------------------
+
+def test_span_nesting_and_attributes():
+    tracer = Tracer(max_traces=8)
+    with tracer.span("root", operation="op") as r:
+        with tracer.span("child") as c:
+            c.set(k=1)
+            with tracer.span("grandchild"):
+                tracer.annotate(deep=True)
+        r.set(done=True)
+    traces = tracer.traces()
+    assert len(traces) == 1
+    t = traces[0]
+    assert t["operation"] == "op"
+    assert t["spanCount"] == 3
+    assert span_names(t) == ["root", "child", "grandchild"]
+    child = t["root"]["children"][0]
+    assert {"key": "k", "value": {"intValue": "1"}} in child["attributes"]
+    grand = child["children"][0]
+    assert {"key": "deep", "value": {"boolValue": True}} in grand["attributes"]
+    # OTLP-compatible ids: 32-hex trace id shared, distinct 16-hex span ids
+    assert len(t["traceId"]) == 32
+    ids = {t["root"]["spanId"], child["spanId"], grand["spanId"]}
+    assert len(ids) == 3 and all(len(i) == 16 for i in ids)
+    assert child["parentSpanId"] == t["root"]["spanId"]
+
+
+def test_ring_bound_and_filters():
+    tracer = Tracer(max_traces=2)
+    for i in range(4):
+        with tracer.span(f"op{i}", operation=f"op{i}"):
+            pass
+    traces = tracer.traces()
+    assert [t["operation"] for t in traces] == ["op3", "op2"]
+    assert tracer.traces(operation="op3")[0]["operation"] == "op3"
+    assert tracer.traces(operation="op0") == []
+    assert tracer.traces(limit=1)[0]["operation"] == "op3"
+    assert tracer.traces(limit=0) == []
+
+
+def test_disabled_records_nothing_and_is_reentrant():
+    tracer = Tracer()
+    tracer.configure(enabled=False)
+    with tracer.span("a") as s:
+        s.set(x=1)  # the null span accepts set()
+        with tracer.span("b"):
+            tracer.annotate(y=2)
+        tracer.record_span("c", 0.1)
+    assert tracer.traces() == []
+    assert tracer.spans_closed == 0
+    # the disabled path hands back one shared object — no per-call alloc
+    assert tracer.span("a") is tracer.span("b")
+
+
+def test_record_span_attaches_pre_timed_child():
+    tracer = Tracer()
+    with tracer.span("root"):
+        tracer.record_span("goal.solve", 0.25, goal="RackAwareGoal",
+                           apportioned=True)
+    t = tracer.traces()[0]
+    goal = t["root"]["children"][0]
+    assert goal["name"] == "goal.solve"
+    assert 200 <= goal["durationMs"] <= 300
+    assert {"key": "goal", "value": {"stringValue": "RackAwareGoal"}} \
+        in goal["attributes"]
+
+
+def test_exception_marks_span_and_propagates():
+    tracer = Tracer()
+    with pytest.raises(ValueError):
+        with tracer.span("boom", operation="x"):
+            raise ValueError("nope")
+    t = tracer.traces()[0]
+    assert {"key": "error", "value": {"stringValue": "ValueError"}} \
+        in t["root"]["attributes"]
+
+
+def test_jsonl_dump(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    tracer = Tracer()
+    tracer.configure(jsonl_path=str(path))
+    with tracer.span("a", operation="bench"):
+        with tracer.span("b"):
+            pass
+    with tracer.span("c", operation="bench"):
+        pass
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["spanCount"] == 2
+    assert span_names(lines[0]) == ["a", "b"]
+
+
+def test_operation_filter_matches_nested_operations():
+    # Fleet mode: the scheduler's fleet.job wrapper is the trace ROOT and
+    # the routed runnable ("rebalance") nests under it — the operation
+    # filter must still find the trace by the nested runnable name.
+    tracer = Tracer()
+    with tracer.span("fleet.job", operation="fleet.on_demand",
+                     cluster="alpha"):
+        with tracer.span("rebalance", operation="rebalance"):
+            pass
+    assert tracer.traces(operation="rebalance"), \
+        "fleet-wrapped operations must stay filterable by runnable name"
+    assert tracer.traces(operation="fleet.on_demand")
+    t = tracer.traces()[0]
+    assert t["operation"] == "fleet.on_demand"  # the root stays primary
+    assert set(t["operations"]) == {"fleet.on_demand", "rebalance"}
+
+
+def test_cross_thread_spans_become_roots():
+    tracer = Tracer()
+    done = threading.Event()
+
+    def worker():
+        with tracer.span("worker.job", operation="background"):
+            pass
+        done.set()
+
+    with tracer.span("main.op", operation="main"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert done.wait(1)
+    ops = {t["operation"] for t in tracer.traces()}
+    assert ops == {"main", "background"}
+
+
+# ---- OperationProgress satellites ---------------------------------------
+
+def test_progress_done_idempotent():
+    p = OperationProgress("op")
+    p.start_step("A")
+    time.sleep(0.01)
+    p.done()
+    first = p.to_list()[0]["durationS"]
+    time.sleep(0.02)
+    p.done()  # re-entered done() must not overwrite the duration
+    assert p.to_list()[0]["durationS"] == first
+    assert p.to_list()[0]["completionPercentage"] == 100.0
+
+
+def test_progress_live_completion_estimate():
+    p = OperationProgress("op")
+    p.start_step("Model", estimate_s=0.05)
+    time.sleep(0.02)
+    live = p.to_list()[0]["completionPercentage"]
+    assert 10.0 <= live < 100.0, \
+        f"in-flight step with an estimate must report progress, got {live}"
+    time.sleep(0.06)
+    assert p.to_list()[0]["completionPercentage"] == 99.0  # clamped
+    p.done()
+    assert p.to_list()[0]["completionPercentage"] == 100.0
+
+
+def test_progress_without_estimate_stays_zero():
+    p = OperationProgress("op")
+    p.start_step("NoEstimate")
+    assert p.to_list()[0]["completionPercentage"] == 0.0
+
+
+# ---- end-to-end: rebalance trace + telemetry exposition ------------------
+
+def _partitions(brokers=(0, 1, 2, 3), topics=2, parts=4):
+    out = {}
+    for t in range(topics):
+        for p in range(parts):
+            reps = (brokers[0], brokers[1 + (t + p) % (len(brokers) - 1)])
+            out[(f"t{t}", p)] = PartitionState(f"t{t}", p, reps, reps[0],
+                                               isr=reps)
+    return out
+
+
+@pytest.fixture(scope="module")
+def traced_api():
+    partitions = _partitions()
+    backend = InMemoryAdminBackend(partitions.values())
+    cfg = CruiseControlConfig({
+        "partition.metrics.window.ms": 1000,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "max.solver.rounds": 30,
+        "failed.brokers.file.path": ""})
+    caps = StaticCapacityResolver({}, {Resource.CPU: 100.0, Resource.DISK: 1e7,
+                                       Resource.NW_IN: 1e6,
+                                       Resource.NW_OUT: 1e6})
+    monitor = LoadMonitor(cfg, backend, samplers=[SyntheticSampler()],
+                          capacity_resolver=caps)
+    cc = CruiseControl(cfg, backend, load_monitor=monitor,
+                       executor=Executor(backend, synchronous=True))
+    for k in range(1, 4):
+        monitor.task_runner.run_sampling_once(end_ms=k * 1000)
+    api = CruiseControlApi(cc)
+    api._async_wait_s = 180
+    yield api
+    api.shutdown()
+    TRACER.configure(enabled=True, jsonl_path=None)
+
+
+def test_rebalance_dryrun_yields_full_trace_tree(traced_api):
+    assert TRACER.enabled  # facade wired tracing.enabled from config
+    status, body, _ = traced_api.handle(
+        "POST", "/kafkacruisecontrol/rebalance", "dryrun=true")
+    assert status == 200, body
+    status, body, _ = traced_api.handle(
+        "GET", "/kafkacruisecontrol/trace", "operation=rebalance&entries=1")
+    assert status == 200, body
+    assert body["tracingEnabled"] is True
+    assert body["numTraces"] == 1
+    trace = body["traces"][0]
+    names = span_names(trace)
+    assert names[0] == "rebalance"
+    for expected in ("monitor.cluster_model", "monitor.aggregate",
+                     "model.assemble", "analyzer.optimize", "goal.solve",
+                     "analyzer.proposal_diff"):
+        assert expected in names, f"missing {expected} in {names}"
+    assert names.count("goal.solve") >= 2, "per-goal spans expected"
+
+    def find(node, name):
+        if node["name"] == name:
+            return node
+        for c in node["children"]:
+            hit = find(c, name)
+            if hit is not None:
+                return hit
+        return None
+
+    assemble = find(trace["root"], "model.assemble")
+    attrs = {a["key"]: a["value"] for a in assemble["attributes"]}
+    assert "topology_hit" in attrs, "cache hit/miss must be attributed"
+    assert "transfer_bytes" in attrs
+    assert int(attrs["transfer_bytes"]["intValue"]) > 0
+    goal = find(trace["root"], "goal.solve")
+    gattrs = {a["key"]: a["value"] for a in goal["attributes"]}
+    assert "goal" in gattrs and "candidates" in gattrs
+
+
+def test_sampling_fetch_traces_recorded(traced_api):
+    assert TRACER.traces(operation="sampling"), \
+        "each sampling cycle should record its own fetch trace"
+
+
+def test_metrics_expose_histograms_and_device_telemetry(traced_api):
+    # Run at least one traced operation first (module fixture already did).
+    text = traced_api.metrics_text()
+    # per-stage span histograms, well-formed
+    for stage in ("monitor.aggregate", "model.assemble", "goal.solve",
+                  "analyzer.optimize"):
+        assert (f'kafka_cruisecontrol_trace_span_seconds_bucket'
+                f'{{span="{stage}",le="+Inf"}}') in text, stage
+    assert "# TYPE kafka_cruisecontrol_trace_span_seconds histogram" in text
+    # XLA compile telemetry (per padded-shape labels)
+    assert "kafka_cruisecontrol_xla_compile_seconds_bucket" in text
+    assert 'shape="' in text
+    # device memory gauges exist on every backend (CPU falls back to the
+    # live-array footprint)
+    assert "kafka_cruisecontrol_device_memory_bytes{" in text
+    # transfer accounting from the model pipeline
+    assert "kafka_cruisecontrol_device_transfer_bytes_total" in text
+    # No duplicate sample lines anywhere: Prometheus rejects the whole
+    # scrape if one series (name + label set) appears twice.
+    samples = [ln.split(" ")[0] for ln in text.splitlines()
+               if ln and not ln.startswith("#")]
+    dupes = {s for s in samples if samples.count(s) > 1}
+    assert not dupes, f"duplicate series in /metrics: {sorted(dupes)[:5]}"
+
+
+def test_trace_endpoint_cluster_filter_no_fleet(traced_api):
+    # ?cluster= on /trace FILTERS by recorded label (no fleet required;
+    # nothing in this fixture ran under a cluster label).
+    status, body, _ = traced_api.handle(
+        "GET", "/kafkacruisecontrol/trace", "cluster=nosuch")
+    assert status == 200
+    assert body["numTraces"] == 0
+
+
+def test_tracing_disabled_no_new_traces(traced_api):
+    TRACER.configure(enabled=False)
+    try:
+        before = TRACER.spans_closed
+        status, _body, _ = traced_api.handle(
+            "POST", "/kafkacruisecontrol/rebalance", "dryrun=true")
+        assert status == 200
+        assert TRACER.spans_closed == before
+        status, body, _ = traced_api.handle(
+            "GET", "/kafkacruisecontrol/trace", "")
+        assert status == 200 and body["tracingEnabled"] is False
+    finally:
+        TRACER.configure(enabled=True)
