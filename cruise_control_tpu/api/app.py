@@ -97,11 +97,22 @@ def build_live_cruise_control(cfg: CruiseControlConfig) -> CruiseControl:
     from ..kafka import KafkaAdminBackend, KafkaMetricsTransport
     from ..monitor import LoadMonitor
     from ..monitor.sampling.sampler import CruiseControlMetricsReporterSampler
+    from ..utils.resilience import RetryPolicy
 
     bootstrap = ",".join(cfg.get_list("bootstrap.servers"))
-    admin = KafkaAdminBackend(bootstrap)
+    admin = KafkaAdminBackend(bootstrap,
+                              retry_policy=RetryPolicy.from_config(cfg))
     transport = KafkaMetricsTransport(bootstrap)
     sampler = CruiseControlMetricsReporterSampler(transport)
+    if cfg.get_boolean("chaos.enabled"):
+        # Game-day drill wiring: wrap BEFORE the monitor is built so the
+        # sampling fetch and monitor metadata paths see injected faults
+        # too (the facade's own wrap is idempotent and shares this
+        # schedule — wrapping only there would leave the monitor clean
+        # and report resilience as proven without exercising it).
+        from ..testing.chaos import ChaosAdminBackend, ChaosSampler
+        admin = ChaosAdminBackend.from_config(admin, cfg)
+        sampler = ChaosSampler(sampler, schedule=admin.schedule)
     monitor = LoadMonitor(
         cfg, admin, samplers=[sampler],
         sample_store=_configured_sample_store(cfg, bootstrap),

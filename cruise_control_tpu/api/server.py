@@ -24,6 +24,7 @@ from ..config.cruise_control_config import CruiseControlConfig
 from ..facade import CruiseControl
 from ..fleet.registry import ClusterPausedError, UnknownClusterError
 from ..monitor.load_monitor import NotEnoughValidWindowsError
+from ..utils.resilience import BreakerOpenError
 from . import responses
 from .endpoints import REVIEWABLE_ENDPOINTS, EndPoint, endpoint_for_path
 from .parameters import ParameterParseError, parse_parameters
@@ -361,6 +362,11 @@ class CruiseControlApi:
             return 403, self._error(str(e)), out_headers
         except NotEnoughValidWindowsError as e:
             return 503, self._error(f"load model not ready: {e}"), out_headers
+        except BreakerOpenError as e:
+            # Resilience layer (round 9): an open circuit breaker fails
+            # fast and tells the client exactly when to come back.
+            out_headers["Retry-After"] = str(max(1, int(e.retry_after_s + 0.5)))
+            return 503, self._error(str(e)), out_headers
         except (KeyError, ValueError) as e:
             return 400, self._error(str(e)), out_headers
         except Exception as e:
@@ -485,6 +491,8 @@ class CruiseControlApi:
         if exc is not None:
             if isinstance(exc, ApiError):
                 raise exc
+            if isinstance(exc, BreakerOpenError):
+                raise exc  # handle() renders 503 + Retry-After
             if isinstance(exc, (ParameterParseError, ValueError, KeyError)):
                 raise ApiError(400, str(exc))
             if isinstance(exc, NotEnoughValidWindowsError):

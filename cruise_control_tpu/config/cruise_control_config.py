@@ -391,6 +391,71 @@ def _definition() -> ConfigDef:
              "count + seconds — the recompile-churn watchdog), "
              "compilation-cache hit/miss counters, and device memory "
              "gauges into /metrics (utils.xla_telemetry).")
+    # --- Resilience layer (utils/resilience.py, round 9) ---
+    d.define("resilience.enabled", T.BOOLEAN, True, None, I.MEDIUM,
+             "Retry/backoff + circuit breaking on every external "
+             "interaction (sampling fetch, admin calls, reassignment "
+             "submission, fleet jobs, detector runs). Disabled, every "
+             "wrapped call is a bare passthrough (ns-scale, bench-"
+             "guarded by resilience_noop_overhead).")
+    d.define("resilience.retry.max.attempts", T.INT, 5, Range.at_least(1),
+             I.MEDIUM, "Attempts per wrapped call (1 = no retries).")
+    d.define("resilience.retry.base.backoff.ms", T.LONG, 100,
+             Range.at_least(0), I.LOW,
+             "Backoff before the first re-attempt; doubles (see "
+             "multiplier) up to the max per further attempt.")
+    d.define("resilience.retry.max.backoff.ms", T.LONG, 10_000,
+             Range.at_least(0), I.LOW, "Backoff ceiling per attempt.")
+    d.define("resilience.retry.backoff.multiplier", T.DOUBLE, 2.0,
+             Range.at_least(1), I.LOW, "Exponential backoff growth factor.")
+    d.define("resilience.retry.jitter.ratio", T.DOUBLE, 0.2,
+             Range.between(0, 1), I.LOW,
+             "Fraction of the exponential backoff subtracted by the "
+             "DETERMINISTIC seeded jitter (crc32 of seed:op:attempt — "
+             "replayable, not a PRNG stream).")
+    d.define("resilience.retry.seed", T.INT, 0, None, I.LOW,
+             "Jitter seed; the same seed replays the same backoff "
+             "schedule byte-for-byte (chaos-test determinism).")
+    d.define("resilience.retry.overall.deadline.ms", T.LONG, 60_000,
+             Range.at_least(1), I.LOW,
+             "Overall wall budget per wrapped call: a retry whose "
+             "backoff would overrun it gives up instead of sleeping.")
+    d.define("resilience.breaker.failure.threshold", T.INT, 5,
+             Range.at_least(0), I.MEDIUM,
+             "Consecutive failures per target (cluster id, detector, "
+             "model path) before its circuit breaker opens; 0 disables "
+             "breaking while keeping retries.")
+    d.define("resilience.breaker.recovery.ms", T.LONG, 30_000,
+             Range.at_least(1), I.LOW,
+             "Open-breaker recovery window; afterwards one half-open "
+             "probe decides reopen vs. close. Also the Retry-After "
+             "hint on 503 responses for open targets.")
+    d.define("resilience.sampling.min.completeness", T.DOUBLE, 0.5,
+             Range.between(0, 1), I.MEDIUM,
+             "Minimum fraction of the partition universe a sampling "
+             "interval must fetch to be ingested: windows above the "
+             "floor are accepted PARTIAL (degraded beats absent), "
+             "below it rejected (PartialWindowError).")
+    d.define("resilience.executor.dead.letter.attempts", T.INT, 3,
+             Range.at_least(1), I.MEDIUM,
+             "Failed submissions per execution task before it is dead-"
+             "lettered to the EXECUTION_ABANDONED terminal state (with "
+             "a notifier event) instead of hanging the execution.")
+    # --- Chaos harness (testing/chaos.py) ---
+    d.define("chaos.enabled", T.BOOLEAN, False, None, I.LOW,
+             "Wrap the admin backend in the deterministic fault "
+             "injector (game-day drills; NEVER in production serving).")
+    d.define("chaos.seed", T.INT, 0, None, I.LOW,
+             "Fault-schedule seed: the same seed injects the same "
+             "fault sequence byte-for-byte.")
+    d.define("chaos.fault.rate", T.DOUBLE, 0.1, Range.between(0, 1), I.LOW,
+             "Per-call injected fault probability (timeout / transient "
+             "/ partial / slow, crc32-uniform).")
+    d.define("chaos.broker.flap.rate", T.DOUBLE, 0.0, Range.between(0, 1),
+             I.LOW,
+             "Per-call probability that alive_brokers transiently "
+             "omits one deterministic broker (flap injection; opt-in — "
+             "flapped destinations DEAD-mark in-flight tasks).")
     d.define("goal.violation.distribution.threshold.multiplier", T.DOUBLE, 1.0,
              Range.at_least(1), I.LOW,
              "Detector-triggered balance-threshold relaxation.")

@@ -52,6 +52,14 @@ class AnomalyDetectorManager:
         self._config = config or CruiseControlConfig()
         self._notifier = notifier or SelfHealingNotifier(self._config)
         self._facade = facade
+        # Detector isolation (round 9): a detector that keeps crashing
+        # trips its own breaker and is SKIPPED until the recovery window
+        # elapses — one broken detector must neither kill its scheduler
+        # thread (the try/except below already prevented that) nor burn
+        # its interval stack-tracing forever.
+        from ..utils.resilience import CircuitBreaker
+        self._detector_breaker = CircuitBreaker.from_config(
+            self._config, name="detector")
         self._detectors: list[tuple[Any, float]] = []   # (detector, interval_s)
         self._queue: list[tuple[tuple[int, int], int, Anomaly]] = []
         self._queue_seq = 0
@@ -119,10 +127,32 @@ class AnomalyDetectorManager:
 
     def _detector_loop(self, detector: Any, interval_s: float) -> None:
         while not self._stop.wait(interval_s):
-            try:
-                detector.run_once()
-            except Exception:
-                LOG.exception("detector %s failed", type(detector).__name__)
+            self.run_detector_once(detector)
+
+    def run_detector_once(self, detector: Any) -> bool:
+        """One isolated detector tick (the scheduler-loop body; public so
+        tests and embedders drive it synchronously): exceptions are
+        contained and counted, and a detector past its breaker's failure
+        threshold is SKIPPED until the recovery window elapses. Returns
+        True when the detector actually ran and succeeded."""
+        name = type(detector).__name__
+        breaker = self._detector_breaker
+        if breaker is not None and not breaker.allow(name):
+            from ..utils.sensors import SENSORS
+            SENSORS.count("detector_runs_skipped", labels={"detector": name})
+            return False
+        try:
+            detector.run_once()
+        except Exception:
+            LOG.exception("detector %s failed", name)
+            from ..utils.sensors import SENSORS
+            SENSORS.count("detector_failures", labels={"detector": name})
+            if breaker is not None:
+                breaker.record_failure(name)
+            return False
+        if breaker is not None:
+            breaker.record_success(name)
+        return True
 
     # -- the handler (AnomalyHandlerTask, :343) ----------------------------
     def _take(self, timeout_s: float) -> Anomaly | None:
@@ -157,8 +187,16 @@ class AnomalyDetectorManager:
     def _handler_loop(self) -> None:
         while not self._stop.is_set():
             anomaly = self._take(timeout_s=0.5)
-            if anomaly is not None:
+            if anomaly is None:
+                continue
+            try:
                 self.handle_anomaly(anomaly)
+            except Exception:  # noqa: BLE001 — the single fix-queue
+                # consumer must survive anything one anomaly throws
+                # (handle_anomaly guards the notifier and the fix, but
+                # not e.g. a broken anomaly's own accessors).
+                LOG.exception("anomaly handler failed for %s",
+                              getattr(anomaly, "anomaly_id", anomaly))
 
     def handle_anomaly(self, anomaly: Anomaly) -> str:
         """One notifier-consult + fix cycle; returns the AnomalyStatus.

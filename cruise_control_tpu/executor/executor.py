@@ -18,6 +18,7 @@ import time
 from typing import Callable, Sequence
 
 from ..analyzer.proposals import ExecutionProposal
+from ..utils.resilience import RetryPolicy, call_with_resilience
 from .admin import AdminBackend
 from .concurrency import ConcurrencyCaps, ExecutionConcurrencyManager
 from .min_isr import TopicMinIsrCache, cluster_isr_state
@@ -69,8 +70,23 @@ class Executor:
                  adjuster_config: "ConcurrencyAdjusterConfig | None" = None,
                  broker_metrics_supplier: Callable[[], dict] | None = None,
                  inter_rate_alert_mb_s: float = 0.0,
-                 intra_rate_alert_mb_s: float = 0.0):
+                 intra_rate_alert_mb_s: float = 0.0,
+                 retry_policy: RetryPolicy | None = None,
+                 dead_letter_attempts: int = 3):
         self._admin = admin
+        # Resilience (round 9): every admin call runs under the retry
+        # policy (None = bare calls, the pre-round-9 behavior); a batch
+        # whose SUBMISSION keeps failing transiently is requeued and,
+        # after ``dead_letter_attempts`` failed submissions, dead-
+        # lettered to the EXECUTION_ABANDONED terminal state instead of
+        # hanging the execution until the global task timeout.
+        self._retry_policy = retry_policy
+        self._dead_letter_attempts = max(1, dead_letter_attempts)
+        self._submit_attempts: dict[int, int] = {}
+        # Separate budget for COMPLETION-VERIFY failures (the submission
+        # reached the cluster; the read-back did not): exhausting it
+        # DEAD-marks, never dead-letters — see _requeue_or_kill_unverified.
+        self._verify_attempts: dict[int, int] = {}
         self._concurrency = ExecutionConcurrencyManager(caps, adjuster_config)
         # ConcurrencyAdjuster (Executor.java:465-683): every interval the
         # poll loop re-evaluates broker health, (At/Under)MinISR state, and
@@ -158,6 +174,10 @@ class Executor:
             if self.has_ongoing_execution():
                 raise OngoingExecutionError(
                     f"execution {self._uuid!r} still in progress")
+            # Deliberately NOT retried: this runs under self._lock, and
+            # backoff sleeps here would block stop_execution/state reads
+            # for the whole retry budget. A transient failure fails the
+            # request; the caller retries from outside the lock.
             external = self._admin.list_reassigning_partitions()
             if external:
                 if not stop_external_agent:
@@ -187,6 +207,8 @@ class Executor:
                 self.set_requested_concurrency(**concurrency_overrides)
             self._task_manager = ExecutionTaskManager()
             self._planner = ExecutionTaskPlanner(strategy or self._strategy)
+            self._submit_attempts = {}
+            self._verify_attempts = {}
             tasks = self._task_manager.tasks_from_proposals(proposals)
             self._planner.add_tasks(tasks, self._admin)
         if self._synchronous:
@@ -379,6 +401,7 @@ class Executor:
         with self._lock:
             if self.has_ongoing_execution():
                 return 0
+            # Not retried: runs under self._lock (see execute_proposals).
             external = self._admin.list_reassigning_partitions()
             if external:
                 self._admin.cancel_partition_reassignments(external)
@@ -446,6 +469,120 @@ class Executor:
             if not self._stop_requested.is_set():
                 self._state = phase
 
+    # ---- resilience helpers (round 9) ------------------------------------
+    def _admin_call(self, op: str, fn):
+        """One admin-backend call under the retry policy (bare when no
+        policy is configured — the zero-overhead path)."""
+        return call_with_resilience(op, fn, policy=self._retry_policy)
+
+    def _notify_event(self, name: str, payload: dict) -> None:
+        """Best-effort optional notifier event (on_task_timeout /
+        on_tasks_abandoned): a custom notifier without the round-9
+        methods — or one that raises — must not affect execution."""
+        fn = getattr(self._notifier, name, None)
+        if fn is None:
+            return
+        try:
+            fn(payload)
+        except Exception:  # noqa: BLE001 — notification is best-effort
+            import logging
+            logging.getLogger(__name__).warning(
+                "executor notifier %s failed", name, exc_info=True)
+
+    def _requeue_or_abandon(self, batch: list[ExecutionTask]) -> None:
+        """A batch whose submission failed past the retry policy: count
+        the failed submission per task, requeue the survivors into the
+        planner (they re-dequeue under normal concurrency headroom) and
+        dead-letter tasks past the attempt budget to EXECUTION_ABANDONED
+        with a notifier event."""
+        assert self._planner is not None and self._task_manager is not None
+        tracker = self._task_manager.tracker
+        retry: list[ExecutionTask] = []
+        abandoned: list[ExecutionTask] = []
+        for task in batch:
+            n = self._submit_attempts.get(task.execution_id, 0) + 1
+            self._submit_attempts[task.execution_id] = n
+            if n >= self._dead_letter_attempts:
+                tracker.transition(task, task.abandon)
+                abandoned.append(task)
+            else:
+                retry.append(task)
+        from ..utils.sensors import SENSORS
+        if abandoned:
+            by_type: dict[str, int] = {}
+            for t in abandoned:
+                by_type[t.task_type.value] = by_type.get(t.task_type.value,
+                                                         0) + 1
+            for task_type, n in by_type.items():
+                SENSORS.count("executor_tasks_abandoned", n,
+                              labels={"type": task_type})
+            self._notify_event("on_tasks_abandoned", {
+                "uuid": self._uuid, "numTasks": len(abandoned),
+                "byType": by_type,
+                "taskIds": [t.execution_id for t in abandoned],
+                "attempts": self._dead_letter_attempts})
+        if retry:
+            self._planner.add_tasks(retry, self._admin)
+
+    def _requeue_or_kill_unverified(self, batch: list[ExecutionTask]) -> None:
+        """Tasks whose SUBMISSION succeeded but whose completion could
+        not be verified (the metadata read-back failed or was partial):
+        requeue for re-verification — re-submitting a preferred-leader
+        election is idempotent — and after the attempt budget DEAD-mark
+        them. Never dead-letters: EXECUTION_ABANDONED means 'the control
+        plane never got through', which would misreport work the cluster
+        may well have applied."""
+        assert self._planner is not None and self._task_manager is not None
+        tracker = self._task_manager.tracker
+        retry: list[ExecutionTask] = []
+        killed = 0
+        for task in batch:
+            n = self._verify_attempts.get(task.execution_id, 0) + 1
+            self._verify_attempts[task.execution_id] = n
+            if n >= self._dead_letter_attempts:
+                tracker.transition(task, task.in_progress)
+                tracker.transition(task, task.kill)
+                killed += 1
+            else:
+                retry.append(task)
+        if killed:
+            from ..utils.sensors import SENSORS
+            SENSORS.count("executor_tasks_unverified", killed,
+                          labels={"type": batch[0].task_type.value})
+        if retry:
+            self._planner.add_tasks(retry, self._admin)
+
+    def _submit_batch(self, op: str, batch: list[ExecutionTask],
+                      submit_fn) -> bool:
+        """Run a batch submission under the retry policy; on final
+        failure requeue/dead-letter the batch and return False (the
+        phase loop continues — later polls pick the requeue up)."""
+        try:
+            self._admin_call(op, submit_fn)
+            return True
+        except Exception:  # noqa: BLE001 — transient classification done
+            import logging
+            logging.getLogger(__name__).warning(
+                "%s submission failed after retries; requeueing %d task(s)",
+                op, len(batch), exc_info=True)
+            from ..utils.sensors import SENSORS
+            SENSORS.count("executor_submit_failures", labels={"op": op})
+            self._requeue_or_abandon(batch)
+            return False
+
+    def _task_timed_out(self, task: ExecutionTask, now: float) -> bool:
+        """The ONE task-timeout predicate shared by the inter- and
+        intra-broker polls (previously two near-identical inline
+        blocks): true when the task overran ``task_timeout_s``, with a
+        ``task_timeouts_total{type=}`` sensor and a notifier event."""
+        if task.start_time_ms <= 0 \
+                or now - task.start_time_ms / 1000 <= self._task_timeout_s:
+            return False
+        from ..utils.sensors import SENSORS
+        SENSORS.count("task_timeouts", labels={"type": task.task_type.value})
+        self._notify_event("on_task_timeout", task.to_dict())
+        return True
+
     # ---- the proposal execution runnable ---------------------------------
     def _run(self) -> None:
         t0 = time.time()
@@ -480,8 +617,16 @@ class Executor:
             tracker.transition(task, task.abort)
             tracker.transition(task, task.aborted)
         if in_flight:
-            self._admin.cancel_partition_reassignments(
-                [t.topic_partition for t in in_flight])
+            try:
+                self._admin_call(
+                    "admin.cancel_partition_reassignments",
+                    lambda: self._admin.cancel_partition_reassignments(
+                        [t.topic_partition for t in in_flight]))
+            except Exception:  # noqa: BLE001 — stop must complete; the
+                # cluster finishes the uncancelled moves on its own.
+                import logging
+                logging.getLogger(__name__).warning(
+                    "cancel on stop failed", exc_info=True)
             for task in in_flight:
                 tracker.transition(task, task.abort)
                 tracker.transition(task, task.aborted)
@@ -510,17 +655,29 @@ class Executor:
                 from ..utils.tracing import TRACER
                 with TRACER.span("executor.batch_submit",
                                  type="INTER_BROKER_REPLICA_ACTION",
-                                 tasks=len(batch)):
-                    self._throttle.set_throttles(batch)
+                                 tasks=len(batch)) as sp:
                     targets = {t.topic_partition: t.proposal.new_replicas
                                for t in batch}
-                    self._admin.alter_partition_reassignments(targets)
-                    for task in batch:
-                        tracker.transition(task, task.in_progress)
-                        self._concurrency.acquire_inter_broker(
-                            tuple(set(task.proposal.replicas_to_add)
-                                  | set(task.proposal.replicas_to_remove)))
-                in_flight.extend(batch)
+
+                    def submit():
+                        # Throttles inside the retried closure: altering
+                        # the same config values twice is idempotent, and
+                        # a throttle that failed alongside the submit must
+                        # be re-applied with it.
+                        self._throttle.set_throttles(batch)
+                        self._admin.alter_partition_reassignments(targets)
+
+                    if self._submit_batch(
+                            "admin.alter_partition_reassignments",
+                            batch, submit):
+                        for task in batch:
+                            tracker.transition(task, task.in_progress)
+                            self._concurrency.acquire_inter_broker(
+                                tuple(set(task.proposal.replicas_to_add)
+                                      | set(task.proposal.replicas_to_remove)))
+                        in_flight.extend(batch)
+                    else:
+                        sp.set(submit_failed=True)
 
             if not in_flight and self._planner.num_pending(
                     TaskType.INTER_BROKER_REPLICA_ACTION) == 0:
@@ -568,8 +725,21 @@ class Executor:
         (ExecutionUtils.isInterBrokerReplicaActionDone)."""
         assert self._task_manager is not None
         tracker = self._task_manager.tracker
-        parts = self._admin.describe_partitions()
-        alive = self._admin.alive_brokers()
+        try:
+            parts = self._admin_call("admin.describe_partitions",
+                                     self._admin.describe_partitions)
+            alive = self._admin_call("admin.alive_brokers",
+                                     self._admin.alive_brokers)
+        except Exception:  # noqa: BLE001 — degrade: skip this poll round
+            # A transiently unreachable control plane must not kill the
+            # execution thread; the next poll interval retries.
+            from ..utils.sensors import SENSORS
+            SENSORS.count("executor_poll_failures")
+            import logging
+            logging.getLogger(__name__).warning(
+                "executor poll failed; will retry next interval",
+                exc_info=True)
+            return
         self._maybe_adjust_concurrency(parts, alive)
         now = time.time()
         still: list[ExecutionTask] = []
@@ -582,11 +752,19 @@ class Executor:
             if done:
                 tracker.transition(task, task.completed)
                 self._concurrency.release_inter_broker(brokers)
-            elif any(b not in alive for b in task.proposal.replicas_to_add) or \
-                    (task.start_time_ms > 0
-                     and now - task.start_time_ms / 1000 > self._task_timeout_s):
+            elif any(b not in alive for b in task.proposal.replicas_to_add) \
+                    or self._task_timed_out(task, now):
                 # Destination died or task timed out: mark DEAD, cancel.
-                self._admin.cancel_partition_reassignments([task.topic_partition])
+                try:
+                    self._admin_call(
+                        "admin.cancel_partition_reassignments",
+                        lambda tp=task.topic_partition:
+                        self._admin.cancel_partition_reassignments([tp]))
+                except Exception:  # noqa: BLE001 — cancel is best-effort
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "cancel of %s failed", task.topic_partition,
+                        exc_info=True)
                 tracker.transition(task, task.kill)
                 self._concurrency.release_inter_broker(brokers)
             else:
@@ -641,11 +819,16 @@ class Executor:
                 from ..utils.tracing import TRACER
                 with TRACER.span("executor.batch_submit",
                                  type="INTRA_BROKER_REPLICA_ACTION",
-                                 tasks=len(batch)):
-                    rejected = set(alter(
-                        [(t.topic_partition, t.proposal.logdir_broker,
-                          t.proposal.destination_logdir)
-                         for t in batch]) or ())
+                                 tasks=len(batch)) as sp:
+                    moves = [(t.topic_partition, t.proposal.logdir_broker,
+                              t.proposal.destination_logdir) for t in batch]
+                    rejected: set = set()
+                    ok = self._submit_batch(
+                        "admin.alter_replica_logdirs", batch,
+                        lambda: rejected.update(alter(moves) or ()))
+                    if not ok:
+                        sp.set(submit_failed=True)
+                        batch = []
                     for task in batch:
                         tracker.transition(task, task.in_progress)
                         p = task.proposal
@@ -674,12 +857,25 @@ class Executor:
         tracker = self._task_manager.tracker
         # Restrict the DescribeLogDirs fan-out to brokers with in-flight
         # moves (ExecutorAdminUtils.getLogdirInfoForExecutingReplicaMove).
+        def fetch_dirs():
+            try:
+                return lookup(sorted({t.proposal.logdir_broker
+                                      for t in in_flight}))
+            except TypeError:
+                return lookup()
+
         try:
-            dirs = lookup(sorted({t.proposal.logdir_broker
-                                  for t in in_flight}))
-        except TypeError:
-            dirs = lookup()
-        alive = self._admin.alive_brokers()
+            dirs = self._admin_call("admin.replica_logdirs", fetch_dirs)
+            alive = self._admin_call("admin.alive_brokers",
+                                     self._admin.alive_brokers)
+        except Exception:  # noqa: BLE001 — degrade: skip this poll round
+            from ..utils.sensors import SENSORS
+            SENSORS.count("executor_poll_failures")
+            import logging
+            logging.getLogger(__name__).warning(
+                "executor logdir poll failed; will retry next interval",
+                exc_info=True)
+            return
         now = time.time()
         still: list[ExecutionTask] = []
         for task in in_flight:
@@ -687,9 +883,8 @@ class Executor:
             key = (p.topic, p.partition, p.logdir_broker)
             if dirs.get(key) == p.destination_logdir:
                 tracker.transition(task, task.completed)
-            elif p.logdir_broker not in alive or \
-                    (task.start_time_ms > 0
-                     and now - task.start_time_ms / 1000 > self._task_timeout_s):
+            elif p.logdir_broker not in alive \
+                    or self._task_timed_out(task, now):
                 tracker.transition(task, task.kill)
             else:
                 still.append(task)
@@ -713,16 +908,50 @@ class Executor:
             if not batch:
                 return True
             from ..utils.tracing import TRACER
+            failed = False
             with TRACER.span("executor.batch_submit",
-                             type="LEADER_ACTION", tasks=len(batch)):
-                self._admin.elect_leaders(
-                    [t.topic_partition for t in batch])
-                parts = self._admin.describe_partitions()
-                for task in batch:
-                    tracker.transition(task, task.in_progress)
-                    p = parts.get(task.topic_partition)
-                    if p is not None and p.leader == task.proposal.new_leader:
-                        tracker.transition(task, task.completed)
+                             type="LEADER_ACTION", tasks=len(batch)) as sp:
+                if not self._submit_batch(
+                        "admin.elect_leaders", batch,
+                        lambda: self._admin.elect_leaders(
+                            [t.topic_partition for t in batch])):
+                    sp.set(submit_failed=True)
+                    failed = True
+                else:
+                    try:
+                        parts = self._admin_call(
+                            "admin.describe_partitions",
+                            self._admin.describe_partitions)
+                    except Exception:  # noqa: BLE001 — the election
+                        # landed; only the completion READ-BACK failed
+                        # past retries. A verify failure, not a
+                        # submission failure: requeue on the verify
+                        # budget (idempotent re-election), never
+                        # dead-letter.
+                        from ..utils.sensors import SENSORS
+                        SENSORS.count("executor_poll_failures")
+                        self._requeue_or_kill_unverified(batch)
+                        failed = True
                     else:
-                        tracker.transition(task, task.kill)
+                        missing: list[ExecutionTask] = []
+                        for task in batch:
+                            p = parts.get(task.topic_partition)
+                            if p is None:
+                                # Absent from a (possibly PARTIAL/
+                                # degraded) metadata read: unknown is
+                                # not failed — re-verify.
+                                missing.append(task)
+                                continue
+                            tracker.transition(task, task.in_progress)
+                            if p.leader == task.proposal.new_leader:
+                                tracker.transition(task, task.completed)
+                            else:
+                                tracker.transition(task, task.kill)
+                        if missing:
+                            self._requeue_or_kill_unverified(missing)
+            if failed:
+                # Outside the span: idle backoff must not inflate the
+                # recorded batch_submit duration.
+                time.sleep(self._poll_interval)
+                continue
             time.sleep(0)  # yield between batches

@@ -20,12 +20,25 @@ class ExecutorNotifier(Protocol):
 
     def on_execution_stopped(self, summary: dict) -> None: ...
 
+    # Resilience events (round 9). Optional for custom notifiers: the
+    # executor dispatches them via getattr, so an implementation
+    # predating the protocol extension keeps working.
+    def on_task_timeout(self, task: dict) -> None: ...
+
+    def on_tasks_abandoned(self, summary: dict) -> None: ...
+
 
 class NoopExecutorNotifier:
     def on_execution_finished(self, summary: dict) -> None:
         pass
 
     def on_execution_stopped(self, summary: dict) -> None:
+        pass
+
+    def on_task_timeout(self, task: dict) -> None:
+        pass
+
+    def on_tasks_abandoned(self, summary: dict) -> None:
         pass
 
 
@@ -37,6 +50,13 @@ class LoggingExecutorNotifier:
 
     def on_execution_stopped(self, summary: dict) -> None:
         LOG.warning("execution stopped: %s", summary)
+
+    def on_task_timeout(self, task: dict) -> None:
+        LOG.warning("execution task timed out: %s", task)
+
+    def on_tasks_abandoned(self, summary: dict) -> None:
+        LOG.error("execution tasks dead-lettered (submission kept "
+                  "failing): %s", summary)
 
 
 class WebhookExecutorNotifier:
@@ -54,3 +74,9 @@ class WebhookExecutorNotifier:
 
     def on_execution_stopped(self, summary: dict) -> None:
         self._post(self._url, {"event": "execution_stopped", **summary})
+
+    def on_task_timeout(self, task: dict) -> None:
+        self._post(self._url, {"event": "task_timeout", **task})
+
+    def on_tasks_abandoned(self, summary: dict) -> None:
+        self._post(self._url, {"event": "tasks_abandoned", **summary})

@@ -32,17 +32,26 @@ class TaskState(enum.Enum):
     ABORTED = "aborted"
     DEAD = "dead"
     COMPLETED = "completed"
+    # EXECUTION_ABANDONED (resilience layer, round 9): the dead-letter
+    # terminal state — submission kept failing transiently past the
+    # retry budget, so the task is parked instead of hanging the whole
+    # execution until the global timeout. Distinct from DEAD (the
+    # cluster rejected/lost the work) so operators can tell "broker
+    # refused" from "control plane never got through".
+    ABANDONED = "abandoned"
 
 
-# Legal transitions (ExecutionTask.java VALID_TRANSFER map).
+# Legal transitions (ExecutionTask.java VALID_TRANSFER map; ABANDONED is
+# reached from PENDING — the task was never successfully submitted).
 _VALID = {
-    TaskState.PENDING: {TaskState.IN_PROGRESS},
+    TaskState.PENDING: {TaskState.IN_PROGRESS, TaskState.ABANDONED},
     TaskState.IN_PROGRESS: {TaskState.ABORTING, TaskState.DEAD,
                             TaskState.COMPLETED},
     TaskState.ABORTING: {TaskState.ABORTED, TaskState.DEAD},
     TaskState.ABORTED: set(),
     TaskState.DEAD: set(),
     TaskState.COMPLETED: set(),
+    TaskState.ABANDONED: set(),
 }
 
 
@@ -79,6 +88,10 @@ class ExecutionTask:
 
     def abort(self) -> None:
         self._transfer(TaskState.ABORTING)
+
+    def abandon(self, now_ms: int | None = None) -> None:
+        self._transfer(TaskState.ABANDONED)
+        self.end_time_ms = now_ms if now_ms is not None else _now_ms()
 
     def aborted(self, now_ms: int | None = None) -> None:
         self._transfer(TaskState.ABORTED)
@@ -147,7 +160,8 @@ class ExecutionTaskTracker:
             return [self._by_id[i] for i in sorted(ids)]
 
     def num_finished(self) -> int:
-        done = (TaskState.COMPLETED, TaskState.ABORTED, TaskState.DEAD)
+        done = (TaskState.COMPLETED, TaskState.ABORTED, TaskState.DEAD,
+                TaskState.ABANDONED)
         with self._lock:
             return sum(len(self._tasks[t][s]) for t in TaskType for s in done)
 

@@ -49,7 +49,9 @@ from .executor.admin import AdminBackend
 from .executor.concurrency import ConcurrencyAdjusterConfig, ConcurrencyCaps
 from .executor.executor import Executor
 from .model.tensors import ClusterMeta, ClusterTensors, set_broker_state
-from .monitor.load_monitor import LoadMonitor, ModelCompletenessRequirements
+from .monitor.load_monitor import (
+    LoadMonitor, ModelCompletenessRequirements, NotEnoughValidWindowsError,
+)
 from .monitor.task_runner import SamplingMode
 
 LOG = logging.getLogger(__name__)
@@ -112,7 +114,25 @@ class CruiseControl:
                  notifier: AnomalyNotifier | None = None,
                  optimizer: GoalOptimizer | None = None):
         self._config = config
+        # Chaos harness (round 9): ``chaos.enabled=true`` wraps the admin
+        # backend in the deterministic fault injector — game-day drills
+        # run the REAL pipeline against injected timeouts/transients/
+        # partial metadata, exercising the same resilience layer the
+        # chaos suite pins.
+        if config.get_boolean("chaos.enabled"):
+            from .testing.chaos import ChaosAdminBackend
+            if not isinstance(admin, ChaosAdminBackend):
+                # Idempotent: a builder that already wrapped (so its
+                # monitor/sampler share the SAME fault schedule — see
+                # api/app.build_live_cruise_control) is left alone.
+                admin = ChaosAdminBackend.from_config(admin, config)
         self._admin = admin
+        # Resilience (round 9): one retry policy + one breaker per
+        # facade, shared by the executor's admin calls and the proposal
+        # path's stale-cache fallback below.
+        from .utils.resilience import CircuitBreaker, RetryPolicy
+        self._retry_policy = RetryPolicy.from_config(config)
+        self._model_breaker = CircuitBreaker.from_config(config, name="model")
         # Observability wiring (round 8): one process-wide tracer,
         # (re)configured from each facade's config — fleet overlays
         # inherit the tracing.* keys from the base config, and per-cluster
@@ -151,7 +171,10 @@ class CruiseControl:
             inter_rate_alert_mb_s=config.get_double(
                 "inter.broker.replica.movement.rate.alerting.threshold"),
             intra_rate_alert_mb_s=config.get_double(
-                "intra.broker.replica.movement.rate.alerting.threshold"))
+                "intra.broker.replica.movement.rate.alerting.threshold"),
+            retry_policy=self._retry_policy,
+            dead_letter_attempts=config.get_int(
+                "resilience.executor.dead.letter.attempts"))
         # ``optimizer`` injection is the fleet's solver-sharing seam
         # (fleet.registry): every cluster facade in a federated process
         # runs the SAME GoalOptimizer (and device/mesh), so bucketed
@@ -412,9 +435,16 @@ class CruiseControl:
         raise ValueError(f"unknown data_from {data_from!r} "
                          "(valid_windows | valid_partitions)")
 
+    def _admin_call(self, op: str, fn):
+        """Admin-backend read under the facade's retry policy (bare when
+        resilience is disabled)."""
+        from .utils.resilience import call_with_resilience
+        return call_with_resilience(op, fn, policy=self._retry_policy)
+
     def alive_brokers(self) -> set[int]:
         """Live broker set (anomaly re-validation + dashboards)."""
-        return self._admin.alive_brokers()
+        return self._admin_call("admin.alive_brokers",
+                                self._admin.alive_brokers)
 
     def ready_for_self_healing(self) -> bool:
         """Completeness gate consulted before anomaly fixes
@@ -655,13 +685,56 @@ class CruiseControl:
             # serialize them behind a long-running precompute pass.
             result = compute()
         else:
+            # Graceful degradation (round 9): when the model build /
+            # optimization fails, serve the LAST GOOD cached proposal set
+            # — any age, any generation — clearly marked stale=true,
+            # instead of a hard error. Repeated failures trip the model
+            # breaker (keyed by the ambient cluster label), and an OPEN
+            # breaker fails fast with BreakerOpenError, which the API
+            # layer renders as 503 + Retry-After.
+            from .utils.sensors import current_cluster_label
+            breaker = self._model_breaker
+            target = current_cluster_label() or "default"
+            if breaker is not None:
+                breaker.guard(target)
             with self._proposal_compute_lock:
                 if use_cache:
                     out = cached_result()  # a concurrent compute finished
                     if out is not None:
                         return out
                 gen = self._load_monitor.model_generation
-                result = compute()
+                try:
+                    result = compute()
+                except NotEnoughValidWindowsError:
+                    # Model not ready (warmup) is not a dependency fault:
+                    # feeding it to the breaker would trip 503s that
+                    # outlive the warmup and mask the real diagnostic.
+                    raise
+                except Exception as e:
+                    if breaker is not None:
+                        breaker.record_failure(target)
+                    with self._proposal_lock:
+                        cached = self._proposal_cache
+                    if cached is None or ignore_proposal_cache:
+                        # No fallback to serve — or the caller EXPLICITLY
+                        # refused cached answers (ignore_proposal_cache):
+                        # serving stale would override their contract.
+                        raise
+                    LOG.warning("proposal computation failed; serving the "
+                                "last good cached proposals as STALE",
+                                exc_info=True)
+                    from .utils.sensors import SENSORS
+                    SENSORS.count("proposals_stale_served")
+                    from .utils.tracing import TRACER
+                    TRACER.annotate(stale=True)
+                    return OperationResult(
+                        "proposals", dryrun=True, optimizer_result=cached[2],
+                        proposals=cached[2].proposals,
+                        reason="stale cache fallback "
+                               f"({type(e).__name__}: {e})",
+                        extra={"stale": True})
+                if breaker is not None:
+                    breaker.record_success(target)
                 with self._proposal_lock:
                     self._proposal_cache = (gen, time.time(), result)
         return OperationResult("proposals", dryrun=True,
@@ -780,7 +853,8 @@ class CruiseControl:
         _final, result = self._optimizer.optimizations(
             state, meta, [PreferredLeaderElectionGoal()], options)
         proposals = list(result.proposals)
-        parts = self._admin.describe_partitions()
+        parts = self._admin_call("admin.describe_partitions",
+                                 self._admin.describe_partitions)
         if skip_urp_demotion:
             urp = {key for key, st in parts.items()
                    if set(st.replicas) - set(st.isr)}
@@ -855,8 +929,10 @@ class CruiseControl:
         first for growth; drop the most-loaded non-leader for shrink)."""
         state, meta = self._model()
         want = set(topics)
-        partitions = self._admin.describe_partitions()
-        alive = self._admin.alive_brokers()
+        partitions = self._admin_call("admin.describe_partitions",
+                                      self._admin.describe_partitions)
+        alive = self._admin_call("admin.alive_brokers",
+                                 self._admin.alive_brokers)
         racks = {bid: meta.rack_names[int(r)]
                  for bid, r in zip(meta.broker_ids, np.asarray(state.rack))}
         # populateRackInfoForReplicationFactorChange (RunnableUtils.java:74):

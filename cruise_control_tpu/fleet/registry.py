@@ -80,6 +80,10 @@ class FleetRegistry:
         self._scheduler = scheduler
         if scheduler is not None:
             scheduler.bind(self)
+            # Embedder handed a bare scheduler: attach the per-cluster
+            # breaker from the base config (no-op when one was injected,
+            # so injected-clock test breakers stay untouched).
+            scheduler.ensure_breaker(self._base)
         self._factory = factory or _default_factory
         self._entries: dict[str, FleetEntry] = {}
         self._lock = threading.Lock()

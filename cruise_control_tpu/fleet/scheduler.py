@@ -26,6 +26,8 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable
 
+from ..utils.resilience import BreakerOpenError, CircuitBreaker
+
 LOG = logging.getLogger(__name__)
 
 
@@ -59,14 +61,23 @@ class FleetScheduler:
     @classmethod
     def from_config(cls, config) -> "FleetScheduler":
         """Build with the configured starvation bound
-        (fleet.scheduler.starvation.bound.ms)."""
-        return cls(starvation_bound_s=config.get_long(
-            "fleet.scheduler.starvation.bound.ms") / 1000.0)
+        (fleet.scheduler.starvation.bound.ms) and the per-cluster
+        circuit breaker (resilience.breaker.*)."""
+        return cls(
+            starvation_bound_s=config.get_long(
+                "fleet.scheduler.starvation.bound.ms") / 1000.0,
+            breaker=CircuitBreaker.from_config(config, name="fleet"))
 
     def __init__(self, starvation_bound_s: float = 30.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 breaker: CircuitBreaker | None = None):
         self._starvation_bound_s = starvation_bound_s
         self._clock = clock
+        # Per-cluster breaker (round 9): a cluster whose jobs keep
+        # failing trips open and its queued work is SKIPPED (futures
+        # fail fast with BreakerOpenError) instead of burning solver
+        # rounds and starving the round-robin for healthy clusters.
+        self._breaker = breaker
         self._cond = threading.Condition()
         self._queue: list[SolverJob] = []
         self._seq = 0
@@ -124,6 +135,24 @@ class FleetScheduler:
     def _pick_locked(self) -> SolverJob | None:
         """Next job under priority + fairness + the starvation bound.
         Caller holds the condition lock."""
+        if self._queue and self._breaker is not None:
+            # Skip (fail fast) queued jobs for open-breaker clusters —
+            # an API caller blocked on the future gets 503 + Retry-After,
+            # the pacer's precompute re-enqueues next sweep, and healthy
+            # clusters' work proceeds. ``allow`` flips a recovered
+            # cluster to half-open, so its next job runs as the probe.
+            skipped = [j for j in self._queue
+                       if not self._breaker.allow(j.cluster_id)]
+            if skipped:
+                from ..utils.sensors import SENSORS
+                for job in skipped:
+                    self._queue.remove(job)
+                    SENSORS.count("fleet_jobs_skipped",
+                                  labels={"cluster": job.cluster_id,
+                                          "kind": job.kind.name})
+                    job.future.set_exception(BreakerOpenError(
+                        job.cluster_id,
+                        self._breaker.retry_after_s(job.cluster_id)))
         if not self._queue:
             return None
         now = self._clock()
@@ -173,8 +202,12 @@ class FleetScheduler:
                                 queue_wait_s=round(wait_s, 6)):
                 result = job.fn()
         except BaseException as e:  # noqa: BLE001 — carried by the future
+            if self._breaker is not None:
+                self._breaker.record_failure(job.cluster_id)
             job.future.set_exception(e)
         else:
+            if self._breaker is not None:
+                self._breaker.record_success(job.cluster_id)
             job.future.set_result(result)
         finally:
             with self._cond:
@@ -321,6 +354,20 @@ class FleetScheduler:
     @property
     def jobs_run(self) -> int:
         return self._jobs_run
+
+    @property
+    def breaker(self) -> CircuitBreaker | None:
+        """The per-cluster circuit breaker (None = breaking disabled)."""
+        return self._breaker
+
+    def ensure_breaker(self, config) -> None:
+        """Attach the configured per-cluster breaker when none was
+        injected (the FleetRegistry's wiring hook for bare schedulers);
+        an existing breaker — including an injected-clock test one — is
+        left untouched. Runs on the scheduler's own clock."""
+        if self._breaker is None:
+            self._breaker = CircuitBreaker.from_config(
+                config, name="fleet", clock=self._clock)
 
     @property
     def running(self) -> bool:

@@ -20,6 +20,7 @@ import logging
 from typing import Iterable, Mapping, Sequence
 
 from ..executor.admin import PartitionState
+from ..utils.resilience import RetryPolicy
 from .wire import messages as m
 from .wire.client import WireClient
 
@@ -33,10 +34,15 @@ class KafkaAdminBackend:
                  client_id: str = "cruise-control-tpu",
                  request_timeout_ms: int = 30_000,
                  client: WireClient | None = None,
-                 view_snapshot_ttl_s: float = 5.0):
+                 view_snapshot_ttl_s: float = 5.0,
+                 retry_policy: RetryPolicy | None = None):
         self._client = client or WireClient(
             bootstrap_servers, client_id=client_id,
             timeout_s=request_timeout_ms / 1000.0)
+        # Per-broker request resilience (round 9): broker-local calls
+        # (DescribeLogDirs) retry under the policy before the broker is
+        # written off for the sweep.
+        self._retry_policy = retry_policy
         # Movement-strategy views (partition_size etc.) are called once per
         # TASK while sorting a plan; a short-TTL snapshot turns N-task sorts
         # into one metadata + one logdir sweep instead of N full sweeps.
@@ -129,15 +135,28 @@ class KafkaAdminBackend:
     def _each_broker(self, brokers: Iterable[int] | None):
         """DescribeLogDirs is broker-local state: fan out per broker, and
         degrade per broker — one unreachable broker must not kill the
-        executor's poll thread (ExecutorAdminUtils semantics)."""
+        executor's poll thread (ExecutorAdminUtils semantics). Each
+        broker's request runs under the retry policy first; a broker
+        that STILL fails is dropped from the sweep with a
+        ``logdir_describe_failures_total{broker=}`` sensor, so a
+        persistently unreachable broker shrinking the
+        DiskFailureDetector's view is visible, not invisible."""
+        from ..utils.resilience import call_with_resilience
+        from ..utils.sensors import SENSORS
         targets = (set(brokers) if brokers is not None
                    else self._client.alive_broker_ids())
         for b in sorted(targets):
             try:
-                yield b, self._client.describe_log_dirs(b)
-            except (ConnectionError, m.KafkaProtocolError):
+                yield b, call_with_resilience(
+                    "admin.describe_log_dirs",
+                    lambda b=b: self._client.describe_log_dirs(b),
+                    policy=self._retry_policy)
+            except (ConnectionError, TimeoutError, OSError,
+                    m.KafkaProtocolError):
                 LOG.warning("logdir request to broker %s failed", b,
                             exc_info=True)
+                SENSORS.count("logdir_describe_failures",
+                              labels={"broker": str(b)})
 
     def describe_logdirs(self) -> dict[int, dict[str, bool]]:
         """broker -> {log_dir: healthy} (DiskFailureDetector's view)."""

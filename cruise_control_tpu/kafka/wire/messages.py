@@ -410,6 +410,15 @@ PERMANENT_ERRORS = frozenset({
     INVALID_REQUEST,
 })
 
+# Codes whose condition is expected to clear on its own (leadership or
+# controller movement, in-transit corruption) — the Java client's
+# RetriableException analogue. utils.resilience.default_retryable reads
+# the ``transient`` property below, so these retry under a RetryPolicy.
+RETRIABLE_ERRORS = frozenset({
+    CORRUPT_MESSAGE, NOT_LEADER_OR_FOLLOWER, NOT_CONTROLLER,
+    REPLICA_NOT_AVAILABLE, PREFERRED_LEADER_NOT_AVAILABLE,
+})
+
 
 class KafkaProtocolError(RuntimeError):
     def __init__(self, code: int, context: str = ""):
@@ -420,3 +429,7 @@ class KafkaProtocolError(RuntimeError):
     @property
     def is_permanent(self) -> bool:
         return self.code in PERMANENT_ERRORS
+
+    @property
+    def transient(self) -> bool:
+        return self.code in RETRIABLE_ERRORS

@@ -133,9 +133,34 @@ class LoadMonitor:
         if samplers is None:
             from .sampling.sampler import NoopSampler
             samplers = [NoopSampler()]
+        # Sampling resilience (round 9): per-fetcher retries under the
+        # shared policy — with the attempt budget the reference spells
+        # fetch.metric.samples.max.retry.count — and partial-window
+        # acceptance above the configured completeness floor.
+        from ..utils.resilience import RetryPolicy
+        # Metadata reads (describe_partitions / alive_brokers) retry
+        # under the shared policy: a transiently unreachable control
+        # plane must not fail a model build that aggregation already
+        # paid for.
+        self._retry_policy = RetryPolicy.from_config(config)
+        fetch_policy = None
+        if self._retry_policy is not None:
+            # Same policy, but the attempt budget the reference spells
+            # fetch.metric.samples.max.retry.count (RETRIES; the policy
+            # counts ATTEMPTS).
+            fetch_policy = dataclasses.replace(
+                self._retry_policy, max_attempts=1 + max(0, config.get_int(
+                    "fetch.metric.samples.max.retry.count")))
         self._fetcher = MetricFetcherManager(
             samplers, self._partition_agg, self._broker_agg, store,
-            num_fetchers=config.get_int("num.metric.fetchers"))
+            num_fetchers=config.get_int("num.metric.fetchers"),
+            retry_policy=fetch_policy,
+            # The completeness floor is part of the resilience layer:
+            # disabled means bare pre-round-9 behavior (ingest whatever
+            # arrived), not stricter rejection with no retries.
+            min_completeness=(config.get_double(
+                "resilience.sampling.min.completeness")
+                if config.get_boolean("resilience.enabled") else 0.0))
         self._task_runner = LoadMonitorTaskRunner(
             self._fetcher, self._metadata, store,
             sampling_interval_ms=config.get("metric.sampling.interval.ms"))
@@ -270,6 +295,9 @@ class LoadMonitor:
         return self._partition_agg.all_window_times()
 
     def state(self) -> LoadMonitorState:
+        # Deliberately NOT retried: /state is the diagnostic surface an
+        # operator hits DURING an outage — it must fail fast, not sleep
+        # through the retry schedule (model builds keep the retries).
         partitions = self._metadata.describe_partitions()
         opts = self._aggregation_options(ModelCompletenessRequirements(1, 0.0))
         try:
@@ -359,8 +387,14 @@ class LoadMonitor:
             # pre-change replica data under the post-change key and serve
             # it until the next unrelated topology bump.
             token = self._metadata_token()
-            partitions = self._metadata.describe_partitions()
-            alive = self._metadata.alive_brokers()
+            from ..utils.resilience import call_with_resilience
+            partitions = call_with_resilience(
+                "admin.describe_partitions",
+                self._metadata.describe_partitions,
+                policy=self._retry_policy)
+            alive = call_with_resilience(
+                "admin.alive_brokers", self._metadata.alive_brokers,
+                policy=self._retry_policy)
             if not allow_capacity_estimation:
                 from .capacity import CapacityEstimationError
                 estimated = sorted(

@@ -9,17 +9,25 @@ from __future__ import annotations
 
 import logging
 import threading
+import zlib
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Mapping
 
 import numpy as np
 
 from ...executor.admin import PartitionState
+from ...utils.resilience import RetryPolicy, call_with_resilience
 from .sampler import MetricSampler, SamplerResult
 from .sample_store import SampleStore
 from .samples import samples_to_matrix
 
 LOG = logging.getLogger(__name__)
+
+
+class PartialWindowError(RuntimeError):
+    """The sampling interval fetched less than the configured
+    completeness floor — the window is rejected rather than ingested
+    (the task runner logs and the next interval retries)."""
 
 
 def default_partition_assignor(partitions: Mapping[tuple[str, int], PartitionState],
@@ -29,10 +37,14 @@ def default_partition_assignor(partitions: Mapping[tuple[str, int], PartitionSta
     topic's partitions in one bucket is load-bearing: the processor derives
     per-partition rates from topic-level rates using share weights over the
     partitions it sees, so splitting a topic across fetchers would make each
-    fetcher attribute the full topic rate to its subset."""
+    fetcher attribute the full topic rate to its subset.
+
+    The topic hash is ``crc32`` (NOT builtin ``hash``, which varies per
+    process under PYTHONHASHSEED): topic→fetcher placement must survive
+    restarts so per-fetcher sample stores and caches stay warm."""
     buckets: list[dict] = [{} for _ in range(num_fetchers)]
     for (topic, part), st in partitions.items():
-        idx = hash(topic) % num_fetchers
+        idx = zlib.crc32(topic.encode("utf-8")) % num_fetchers
         buckets[idx][(topic, part)] = st
     return buckets
 
@@ -45,9 +57,20 @@ class MetricFetcherManager:
                  partition_aggregator, broker_aggregator,
                  sample_store: SampleStore,
                  assignor: Callable = default_partition_assignor,
-                 num_fetchers: int | None = None):
+                 num_fetchers: int | None = None,
+                 retry_policy: RetryPolicy | None = None,
+                 min_completeness: float = 0.0):
         if not samplers:
             raise ValueError("at least one sampler required")
+        # Resilience (round 9): each fetcher retries its sampler under
+        # the policy; a fetcher that still fails costs only ITS bucket.
+        # The merged interval is accepted as a PARTIAL window while the
+        # fetched fraction stays at or above ``min_completeness``
+        # (reference parity: sampling completeness) and rejected with
+        # PartialWindowError below it — degraded data beats no data,
+        # but a mostly-empty window would poison the aggregates.
+        self._retry_policy = retry_policy
+        self._min_completeness = min_completeness
         # num.metric.fetchers fan-out (MetricFetcherManager.java:37-110):
         # the reference runs N fetcher threads each with its own sampler
         # instance. With one configured sampler and N > 1, clone it per
@@ -86,19 +109,43 @@ class MetricFetcherManager:
                 merged.partition_samples.extend(r.partition_samples)
                 merged.broker_samples.extend(r.broker_samples)
                 merged.skipped_partitions += r.skipped_partitions
+            total = len(partitions)
+            completeness = 1.0 if total == 0 \
+                else 1.0 - merged.skipped_partitions / total
+            if total and completeness < self._min_completeness:
+                from ...utils.sensors import SENSORS
+                SENSORS.count("monitor_windows_rejected")
+                sp.set(completeness=round(completeness, 4), rejected=True)
+                raise PartialWindowError(
+                    f"sampling interval [{start_ms}, {end_ms}) fetched "
+                    f"{completeness:.1%} of {total} partitions, below the "
+                    f"{self._min_completeness:.1%} completeness floor")
+            if merged.skipped_partitions:
+                # Degraded but above the floor: accept the partial window
+                # (the reference's sampling-completeness semantics) and
+                # make the degradation visible.
+                from ...utils.sensors import SENSORS
+                SENSORS.count("monitor_partial_windows")
+                sp.set(partial=True)
             self._ingest(merged, end_ms, store)
             sp.set(partition_samples=len(merged.partition_samples),
                    broker_samples=len(merged.broker_samples),
-                   skipped_partitions=merged.skipped_partitions)
+                   skipped_partitions=merged.skipped_partitions,
+                   completeness=round(completeness, 4))
             return merged
 
     def _fetch_one(self, sampler: MetricSampler, bucket, start_ms, end_ms):
         try:
-            return sampler.get_samples(bucket, start_ms, end_ms)
+            return call_with_resilience(
+                "sampler.get_samples",
+                lambda: sampler.get_samples(bucket, start_ms, end_ms),
+                policy=self._retry_policy)
         except Exception:
             LOG.exception("metric sampler failed for interval [%s, %s)",
                           start_ms, end_ms)
             # sampling-fetch failure rate (LoadMonitorTaskRunner sensors).
+            # Per-fetcher degradation: this bucket's partitions count as
+            # skipped; the other fetchers' samples still land.
             from ...utils.sensors import SENSORS
             SENSORS.count("monitor_sampling_fetch_failures")
             return SamplerResult([], [], len(bucket))

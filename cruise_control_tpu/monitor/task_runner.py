@@ -126,9 +126,18 @@ class LoadMonitorTaskRunner:
         with self._lock:
             if self._state is RunnerState.RUNNING:
                 self._state = RunnerState.SAMPLING
+        from .sampling.fetcher import PartialWindowError
         try:
             partitions = self._metadata.describe_partitions()
             self._fetcher.fetch_metric_samples(partitions, start, end)
+            self._last_sample_ms = end
+        except PartialWindowError as e:
+            # The window is below the completeness floor and LOST either
+            # way — advance the clock so the next interval fetches only
+            # ITS span. Leaving start pinned would re-fetch the whole
+            # outage range every interval (O(outage²) sampler work).
+            LOG.warning("sampling interval [%s, %s) rejected: %s",
+                        start, end, e)
             self._last_sample_ms = end
         except Exception:
             LOG.exception("sampling interval [%s, %s) failed", start, end)
@@ -151,7 +160,15 @@ class LoadMonitorTaskRunner:
             t = start_ms
             while t < end_ms and not self._stop.is_set():
                 nxt = min(t + self._interval_ms, end_ms)
-                self._fetcher.fetch_metric_samples(partitions, t, nxt, store=False)
+                try:
+                    self._fetcher.fetch_metric_samples(partitions, t, nxt,
+                                                       store=False)
+                except Exception:  # noqa: BLE001 — one bad window (e.g.
+                    # below the partial-completeness floor, or a range
+                    # predating available metrics) must not abort the
+                    # whole historic replay; later windows still warm.
+                    LOG.warning("bootstrap window [%s, %s) failed; "
+                                "continuing", t, nxt, exc_info=True)
                 t = nxt
             self._last_sample_ms = end_ms
         finally:

@@ -1,0 +1,17 @@
+"""Deterministic fault-injection harnesses (chaos testing).
+
+Not test-only code: ``chaos.ChaosAdminBackend`` can wrap the production
+admin backend via the ``chaos.enabled`` config key for game-day drills,
+and bench.py drives a faulted executor cycle through it for the
+``degraded_cycle_s`` extra.
+"""
+
+from .chaos import (
+    ChaosAdminBackend, ChaosSampler, ChaosTimeout, ChaosTransientError,
+    FaultSchedule, run_faulted_executor_cycle,
+)
+
+__all__ = [
+    "ChaosAdminBackend", "ChaosSampler", "ChaosTimeout",
+    "ChaosTransientError", "FaultSchedule", "run_faulted_executor_cycle",
+]

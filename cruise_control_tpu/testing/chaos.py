@@ -1,0 +1,318 @@
+"""Deterministic fault injection for the whole rebalance pipeline.
+
+The resilience layer (utils/resilience.py) is only trustworthy if it is
+*exercised*: this module wraps any admin backend or metric sampler and
+injects timeouts, transient errors, partial metadata, slow calls, and
+broker flaps on a SEEDED, WALL-CLOCK-FREE schedule. The same seed
+replays the same fault sequence byte-for-byte, so the chaos suite
+(tests/test_chaos.py) asserts exact convergence with zero flakes and
+the tier-1 CPU run stays deterministic.
+
+Fault decisions are a pure function of (seed, op, per-op call index)
+via crc32 — no PRNG stream that concurrent threads could reorder. A
+"slow" fault never sleeps (that would couple the tier-1 run to real
+time); it is accounted in ``injected`` and surfaced as a sensor so
+tests can assert the schedule fired without paying for it.
+
+Production hook: ``chaos.enabled=true`` makes the facade wrap its admin
+backend here (game-day drills against a staging cluster); the keys are
+``chaos.seed`` / ``chaos.fault.rate`` / ``chaos.broker.flap.rate``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from collections import Counter
+
+_U32 = float(0xFFFFFFFF)
+
+# Fault kinds a schedule rotates through; broker flaps are separate
+# (rate-gated on their own knob — killing destinations mid-move is DEAD-
+# task semantics, not a retryable blip, so convergence tests opt in).
+FAULT_KINDS = ("timeout", "transient", "partial", "slow")
+
+
+class ChaosTimeout(TimeoutError):
+    """Injected call timeout (retryable by default_retryable)."""
+
+    transient = True
+
+
+class ChaosTransientError(ConnectionError):
+    """Injected transient backend error (retryable)."""
+
+    transient = True
+
+
+class FaultSchedule:
+    """Seeded deterministic fault decisions, one counter per op name.
+
+    ``next_fault(op)`` returns a kind from FAULT_KINDS (or None) for
+    the N-th call of ``op``; the decision is crc32-uniform in
+    ``fault_rate``. ``stop()`` turns all injection off (the "faults
+    stop, run converges" phase of the chaos suite); ``max_faults``
+    self-stops after a budget.
+    """
+
+    def __init__(self, seed: int = 0, fault_rate: float = 0.1,
+                 kinds: tuple[str, ...] = FAULT_KINDS,
+                 broker_flap_rate: float = 0.0,
+                 max_faults: int | None = None):
+        self.seed = seed
+        self.fault_rate = fault_rate
+        self.kinds = kinds
+        self.broker_flap_rate = broker_flap_rate
+        self.max_faults = max_faults
+        self._lock = threading.Lock()
+        self._counts: Counter[str] = Counter()
+        self._injected = 0
+        self._stopped = False
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+
+    def resume(self) -> None:
+        with self._lock:
+            self._stopped = False
+
+    @property
+    def faults_injected(self) -> int:
+        with self._lock:
+            return self._injected
+
+    def _hash01(self, op: str, n: int, salt: str = "") -> float:
+        return zlib.crc32(f"{self.seed}:{salt}{op}:{n}".encode()) / _U32
+
+    def next_fault(self, op: str) -> str | None:
+        with self._lock:
+            n = self._counts[op]
+            self._counts[op] += 1
+            if self._stopped or not self.kinds:
+                return None
+            if self.max_faults is not None \
+                    and self._injected >= self.max_faults:
+                return None
+            u = self._hash01(op, n)
+            if u >= self.fault_rate:
+                return None
+            self._injected += 1
+            kind = self.kinds[
+                zlib.crc32(f"{self.seed}:kind:{op}:{n}".encode())
+                % len(self.kinds)]
+            return kind
+
+    def next_flap(self, op: str) -> bool:
+        """Separate broker-flap stream (its own rate and counter)."""
+        with self._lock:
+            n = self._counts["flap:" + op]
+            self._counts["flap:" + op] += 1
+            if self._stopped or self.broker_flap_rate <= 0:
+                return False
+            return self._hash01(op, n, salt="flap:") \
+                < self.broker_flap_rate
+
+
+class _ChaosBase:
+    """Shared injection plumbing for backend/sampler decorators."""
+
+    def __init__(self, inner, schedule: FaultSchedule | None = None,
+                 seed: int = 0, fault_rate: float = 0.1,
+                 broker_flap_rate: float = 0.0):
+        self._inner = inner
+        self.schedule = schedule or FaultSchedule(
+            seed=seed, fault_rate=fault_rate,
+            broker_flap_rate=broker_flap_rate)
+        self.injected: Counter[str] = Counter()
+
+    def __getattr__(self, name):
+        # Test controls (tick, kill_broker, enable_jbod, ...) and any
+        # surface not explicitly faulted pass through untouched.
+        return getattr(self._inner, name)
+
+    def _fault(self, op: str) -> str | None:
+        """Roll the schedule for ``op``; raise for timeout/transient,
+        return "partial"/"slow"/None for the caller to act on."""
+        kind = self.schedule.next_fault(op)
+        if kind is None:
+            return None
+        self.injected[f"{op}:{kind}"] += 1
+        from ..utils.sensors import SENSORS
+        SENSORS.count("chaos_faults_injected",
+                      labels={"op": op, "kind": kind})
+        if kind == "timeout":
+            raise ChaosTimeout(f"injected timeout in {op}")
+        if kind == "transient":
+            raise ChaosTransientError(f"injected transient error in {op}")
+        return kind  # partial / slow: degraded result, caller decides
+
+
+class ChaosAdminBackend(_ChaosBase):
+    """Fault-injecting decorator around any ``AdminBackend``.
+
+    - timeout/transient: the call raises (retryable) without reaching
+      the inner backend — no partial state.
+    - partial: ``describe_partitions``/``replica_logdirs`` drop a
+      deterministic 1-in-8 slice of their result (the shrunk-metadata
+      failure mode that silently starved the DiskFailureDetector).
+    - slow: accounted, never slept (see module docstring).
+    - flap: ``alive_brokers`` transiently omits one deterministic
+      broker when ``broker_flap_rate`` > 0.
+    """
+
+    @classmethod
+    def from_config(cls, inner, config) -> "ChaosAdminBackend":
+        return cls(inner, seed=config.get_int("chaos.seed"),
+                   fault_rate=config.get_double("chaos.fault.rate"),
+                   broker_flap_rate=config.get_double(
+                       "chaos.broker.flap.rate"))
+
+    # -- mutating calls: raise-before-delegate ------------------------------
+    def alter_partition_reassignments(self, targets) -> None:
+        self._fault("admin.alter_partition_reassignments")
+        return self._inner.alter_partition_reassignments(targets)
+
+    def cancel_partition_reassignments(self, partitions) -> None:
+        self._fault("admin.cancel_partition_reassignments")
+        return self._inner.cancel_partition_reassignments(partitions)
+
+    def elect_leaders(self, partitions) -> None:
+        self._fault("admin.elect_leaders")
+        return self._inner.elect_leaders(partitions)
+
+    def alter_replica_logdirs(self, moves):
+        self._fault("admin.alter_replica_logdirs")
+        return self._inner.alter_replica_logdirs(moves)
+
+    def alter_broker_configs(self, configs) -> None:
+        self._fault("admin.alter_broker_configs")
+        return self._inner.alter_broker_configs(configs)
+
+    def alter_topic_configs(self, configs) -> None:
+        self._fault("admin.alter_topic_configs")
+        return self._inner.alter_topic_configs(configs)
+
+    # -- reads: raise or degrade -------------------------------------------
+    def list_reassigning_partitions(self):
+        self._fault("admin.list_reassigning_partitions")
+        return self._inner.list_reassigning_partitions()
+
+    def describe_partitions(self):
+        kind = self._fault("admin.describe_partitions")
+        parts = self._inner.describe_partitions()
+        if kind == "partial":
+            # Deterministic 1-in-8 drop keyed off the sorted order so
+            # the same seed shrinks the same slice every run.
+            keys = sorted(parts)
+            return {k: parts[k] for i, k in enumerate(keys) if i % 8 != 7}
+        return parts
+
+    def alive_brokers(self):
+        self._fault("admin.alive_brokers")
+        alive = self._inner.alive_brokers()
+        if alive and self.schedule.next_flap("admin.alive_brokers"):
+            flapped = sorted(alive)[
+                zlib.crc32(f"{self.schedule.seed}:flapped".encode())
+                % len(alive)]
+            self.injected["admin.alive_brokers:flap"] += 1
+            return {b for b in alive if b != flapped}
+        return alive
+
+    def describe_logdirs(self):
+        self._fault("admin.describe_logdirs")
+        return self._inner.describe_logdirs()
+
+    def replica_logdirs(self, brokers=None):
+        kind = self._fault("admin.replica_logdirs")
+        dirs = self._inner.replica_logdirs(brokers)
+        if kind == "partial":
+            keys = sorted(dirs)
+            return {k: dirs[k] for i, k in enumerate(keys) if i % 8 != 7}
+        return dirs
+
+    def describe_broker_configs(self, brokers):
+        self._fault("admin.describe_broker_configs")
+        return self._inner.describe_broker_configs(brokers)
+
+    def describe_topic_configs(self, topics):
+        self._fault("admin.describe_topic_configs")
+        return self._inner.describe_topic_configs(topics)
+
+
+class ChaosSampler(_ChaosBase):
+    """Fault-injecting decorator around any ``MetricSampler``: exercises
+    the fetcher's per-sampler tolerance + partial-window acceptance.
+    "partial" drops a deterministic half of the returned partition
+    samples (a sampler that answered for only part of its bucket)."""
+
+    def get_samples(self, partitions, start_ms, end_ms):
+        kind = self._fault("sampler.get_samples")
+        res = self._inner.get_samples(partitions, start_ms, end_ms)
+        if kind == "partial":
+            kept = res.partition_samples[::2]
+            dropped = len(res.partition_samples) - len(kept)
+            from ..monitor.sampling.sampler import SamplerResult
+            return SamplerResult(kept, res.broker_samples,
+                                 res.skipped_partitions + dropped)
+        return res
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+def run_faulted_executor_cycle(num_partitions: int = 24,
+                               brokers: tuple[int, ...] = (0, 1, 2, 3),
+                               seed: int = 0, fault_rate: float = 0.2,
+                               max_attempts: int = 6,
+                               dead_letter_attempts: int = 4,
+                               rf: int = 2) -> dict:
+    """One full executor cycle against the fault-injecting backend:
+    rotate every partition's replica set one broker over and execute
+    through a ChaosAdminBackend with retries enabled (zero-sleep
+    backoff — deterministic and fast). Shared by tests/test_chaos.py
+    and bench.py's ``degraded_cycle_s`` extra.
+
+    Returns {elapsed_s, injected, converged, abandoned, task_counts}.
+    """
+    from ..analyzer.proposals import ExecutionProposal
+    from ..executor.admin import InMemoryAdminBackend, PartitionState
+    from ..executor.executor import Executor
+    from ..utils.resilience import RetryPolicy
+
+    parts: dict[tuple[str, int], PartitionState] = {}
+    for i in range(num_partitions):
+        t, p = f"t{i % 3}", i // 3
+        reps = tuple(brokers[(i + k) % len(brokers)] for k in range(rf))
+        parts[(t, p)] = PartitionState(t, p, reps, reps[0], isr=reps)
+    backend = InMemoryAdminBackend(parts.values())
+    chaos = ChaosAdminBackend(backend, seed=seed, fault_rate=fault_rate)
+    policy = RetryPolicy(max_attempts=max_attempts, base_backoff_s=0.0,
+                         max_backoff_s=0.0, jitter_ratio=0.0, seed=seed)
+    executor = Executor(chaos, synchronous=True,
+                        progress_check_interval_s=0.0,
+                        adjuster_enabled=False,
+                        retry_policy=policy,
+                        dead_letter_attempts=dead_letter_attempts)
+    proposals = []
+    for (t, p), st in sorted(parts.items()):
+        new = tuple(brokers[(brokers.index(b) + 1) % len(brokers)]
+                    for b in st.replicas)
+        proposals.append(ExecutionProposal(
+            topic=t, partition=p, old_leader=st.leader,
+            old_replicas=st.replicas, new_replicas=new, new_leader=new[0]))
+    t0 = time.perf_counter()
+    executor.execute_proposals(proposals, uuid=f"chaos-{seed}")
+    elapsed = time.perf_counter() - t0
+    after = backend.describe_partitions()
+    converged = all(
+        set(after[(pr.topic, pr.partition)].replicas) == set(pr.new_replicas)
+        for pr in proposals)
+    counts = executor.execution_state()["taskCounts"]
+    abandoned = sum(by_state.get("abandoned", 0)
+                    for by_state in counts.values())
+    return {"elapsed_s": elapsed, "injected": dict(chaos.injected),
+            "faults_injected": chaos.schedule.faults_injected,
+            "converged": converged and abandoned == 0,
+            "abandoned": abandoned, "task_counts": counts}

@@ -1,0 +1,318 @@
+"""Unified resilience layer: retry/backoff, circuit breaking, wrappers.
+
+The reference survives a 7k-broker production fleet because every
+external interaction is allowed to fail: AdminClient calls time out,
+samplers drop intervals, brokers flap — and the JVM stack retries,
+degrades, or isolates. This module is the TPU-era equivalent, one
+policy object + one breaker shared by every boundary in the pipeline
+(sampling fetch, metadata/admin calls, reassignment submission, fleet
+jobs, detector runs):
+
+- ``RetryPolicy``: exponential backoff with DETERMINISTIC seeded jitter
+  (``crc32(seed:op:attempt)`` — two runs with the same seed produce
+  byte-identical backoff schedules, so chaos tests assert exact retry
+  timing with no statistical slack) and an overall deadline measured on
+  an injectable clock (no ``time.sleep`` dependence in tests).
+- ``CircuitBreaker``: per-target closed → open → half-open state
+  machine keyed by any string (broker id, cluster id, backend op).
+  Open targets fail fast with ``BreakerOpenError`` carrying the
+  remaining recovery time (the API layer turns it into
+  503 + ``Retry-After``).
+- ``call_with_resilience``: the one wrapper call sites use. Emits
+  ``retry_attempts_total{op=}`` / ``breaker_state{target=}`` sensors
+  and opens a ``resilience.retry`` child span per RE-attempt so every
+  retry is visible in ``GET /kafkacruisecontrol/trace``. The happy
+  path (no policy, no breaker) is a single branch + direct call —
+  guarded ns-scale by bench.py's ``resilience_noop_overhead``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time
+import zlib
+from typing import Callable
+
+_U32 = float(0xFFFFFFFF)
+
+
+class BreakerState(enum.IntEnum):
+    """Gauge-friendly encoding (breaker_state{target=} exports the int)."""
+
+    CLOSED = 0
+    OPEN = 1
+    HALF_OPEN = 2
+
+
+class BreakerOpenError(RuntimeError):
+    """Fail-fast refusal: the target's breaker is open. ``retry_after_s``
+    is the remaining recovery window (API layer: 503 + Retry-After)."""
+
+    def __init__(self, target: str, retry_after_s: float):
+        super().__init__(
+            f"circuit breaker open for {target!r}; retry in "
+            f"{retry_after_s:.1f}s")
+        self.target = target
+        self.retry_after_s = retry_after_s
+
+
+def default_retryable(exc: BaseException) -> bool:
+    """Transient-error classification: connection/timeout/OS errors and
+    anything self-declaring ``transient=True`` (the chaos faults, the
+    wire client's protocol-retriable errors) retry; programming errors
+    (ValueError, KeyError, ...) never do."""
+    return isinstance(exc, (ConnectionError, TimeoutError, OSError)) \
+        or bool(getattr(exc, "transient", False))
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff + deterministic seeded jitter + deadlines.
+
+    ``backoff_s(op, attempt)`` is a pure function of (policy, op,
+    attempt): jitter comes from ``crc32`` over the seed, not a PRNG
+    stream, so concurrent call sites cannot perturb each other and a
+    chaos run replays identically under the same seed.
+    """
+
+    max_attempts: int = 5
+    base_backoff_s: float = 0.1
+    max_backoff_s: float = 10.0
+    multiplier: float = 2.0
+    jitter_ratio: float = 0.2
+    seed: int = 0
+    overall_deadline_s: float = 60.0
+    retryable: Callable[[BaseException], bool] = default_retryable
+
+    @classmethod
+    def from_config(cls, config) -> "RetryPolicy | None":
+        """The ``resilience.retry.*`` keys; None when the layer is
+        disabled (call sites then run bare — the no-op fast path)."""
+        if not config.get_boolean("resilience.enabled"):
+            return None
+        return cls(
+            max_attempts=config.get_int("resilience.retry.max.attempts"),
+            base_backoff_s=config.get_long(
+                "resilience.retry.base.backoff.ms") / 1000.0,
+            max_backoff_s=config.get_long(
+                "resilience.retry.max.backoff.ms") / 1000.0,
+            multiplier=config.get_double(
+                "resilience.retry.backoff.multiplier"),
+            jitter_ratio=config.get_double("resilience.retry.jitter.ratio"),
+            seed=config.get_int("resilience.retry.seed"),
+            overall_deadline_s=config.get_long(
+                "resilience.retry.overall.deadline.ms") / 1000.0)
+
+    def backoff_s(self, op: str, attempt: int) -> float:
+        """Backoff before re-attempt number ``attempt`` (first retry =
+        attempt 2). Jitter SUBTRACTS up to ``jitter_ratio`` of the base
+        so the result never exceeds the exponential envelope."""
+        exp = min(self.max_backoff_s,
+                  self.base_backoff_s * self.multiplier ** max(0, attempt - 2))
+        if self.jitter_ratio <= 0:
+            return exp
+        u = zlib.crc32(f"{self.seed}:{op}:{attempt}".encode()) / _U32
+        return exp * (1.0 - self.jitter_ratio * u)
+
+
+@dataclasses.dataclass
+class _Target:
+    state: BreakerState = BreakerState.CLOSED
+    consecutive_failures: int = 0
+    opened_at: float = 0.0
+
+
+class CircuitBreaker:
+    """Per-target breaker map: closed → open after N consecutive
+    failures, open → half-open after the recovery window, half-open →
+    closed on the probe's success / back to open on its failure.
+
+    ``clock`` is injectable (monotonic seconds) so every transition is
+    testable without real waiting. ``failure_threshold <= 0`` disables
+    the breaker entirely (``allow`` is always True, nothing recorded).
+    """
+
+    def __init__(self, failure_threshold: int = 5, recovery_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 name: str = "default"):
+        self.failure_threshold = failure_threshold
+        self.recovery_s = recovery_s
+        self._clock = clock
+        self._name = name
+        self._lock = threading.Lock()
+        self._targets: dict[str, _Target] = {}
+
+    @classmethod
+    def from_config(cls, config, name: str = "default",
+                    clock: Callable[[], float] = time.monotonic,
+                    ) -> "CircuitBreaker | None":
+        if not config.get_boolean("resilience.enabled"):
+            return None
+        return cls(
+            failure_threshold=config.get_int(
+                "resilience.breaker.failure.threshold"),
+            recovery_s=config.get_long(
+                "resilience.breaker.recovery.ms") / 1000.0,
+            clock=clock, name=name)
+
+    @property
+    def enabled(self) -> bool:
+        return self.failure_threshold > 0
+
+    def _entry(self, target: str) -> _Target:
+        t = self._targets.get(target)
+        if t is None:
+            t = self._targets[target] = _Target()
+        return t
+
+    def _set_state(self, target: str, t: _Target, state: BreakerState) -> None:
+        if t.state is state:
+            return
+        t.state = state
+        from .sensors import SENSORS
+        SENSORS.gauge("breaker_state", int(state),
+                      labels={"breaker": self._name, "target": target})
+        SENSORS.count("breaker_transitions",
+                      labels={"breaker": self._name, "target": target,
+                              "to": state.name})
+
+    def state(self, target: str) -> BreakerState:
+        with self._lock:
+            return self._targets.get(target, _Target()).state
+
+    def allow(self, target: str) -> bool:
+        """True when a call to ``target`` may proceed. An open target
+        whose recovery window elapsed flips to half-open and the call
+        proceeds as the probe (single-consumer call sites — the fleet
+        worker, the detector scheduler — probe one at a time by
+        construction; concurrent probes are harmless, the first result
+        decides)."""
+        if not self.enabled:
+            return True
+        with self._lock:
+            t = self._targets.get(target)
+            if t is None or t.state is BreakerState.CLOSED:
+                return True
+            if t.state is BreakerState.OPEN:
+                if self._clock() - t.opened_at < self.recovery_s:
+                    return False
+                self._set_state(target, t, BreakerState.HALF_OPEN)
+            return True  # half-open: probe allowed
+
+    def retry_after_s(self, target: str) -> float:
+        """Remaining recovery window (0 when not open)."""
+        with self._lock:
+            t = self._targets.get(target)
+            if t is None or t.state is not BreakerState.OPEN:
+                return 0.0
+            return max(0.0, self.recovery_s - (self._clock() - t.opened_at))
+
+    def record_success(self, target: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            t = self._entry(target)
+            t.consecutive_failures = 0
+            self._set_state(target, t, BreakerState.CLOSED)
+
+    def record_failure(self, target: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            t = self._entry(target)
+            t.consecutive_failures += 1
+            if t.state is BreakerState.HALF_OPEN \
+                    or t.consecutive_failures >= self.failure_threshold:
+                # A failed half-open probe re-opens with a fresh window.
+                t.opened_at = self._clock()
+                self._set_state(target, t, BreakerState.OPEN)
+
+    def guard(self, target: str) -> None:
+        """Raise BreakerOpenError when the target is open (the fail-fast
+        entry check call sites use before expensive work)."""
+        if not self.allow(target):
+            raise BreakerOpenError(target, self.retry_after_s(target))
+
+
+def call_with_resilience(op: str, fn: Callable, *,
+                         policy: RetryPolicy | None = None,
+                         breaker: CircuitBreaker | None = None,
+                         target: str | None = None,
+                         clock: Callable[[], float] = time.monotonic,
+                         sleep: Callable[[float], None] = time.sleep):
+    """Run ``fn()`` under the retry policy and/or breaker.
+
+    - No policy and no breaker: direct call (the disabled fast path —
+      one tuple compare, nothing else; bench-guarded).
+    - Breaker (keyed by ``target``, default ``op``): open targets raise
+      ``BreakerOpenError`` without calling ``fn``; every outcome is
+      recorded.
+    - Policy: retryable failures back off (``sleep`` injectable) and
+      re-attempt until attempts or the overall deadline run out; each
+      RE-attempt records ``retry_attempts_total{op=}`` and runs inside
+      a ``resilience.retry`` span so traces show exactly where time
+      went. The last failure propagates unchanged.
+    """
+    if policy is None and breaker is None:
+        return fn()
+    key = target if target is not None else op
+    if breaker is not None:
+        breaker.guard(key)
+    max_attempts = policy.max_attempts if policy is not None else 1
+    deadline = clock() + policy.overall_deadline_s \
+        if policy is not None else None
+    attempt = 1
+    while True:
+        try:
+            if attempt == 1:
+                result = fn()
+            else:
+                from .sensors import SENSORS
+                from .tracing import TRACER
+                SENSORS.count("retry_attempts", labels={"op": op})
+                with TRACER.span("resilience.retry", operation=op,
+                                 attempt=attempt):
+                    result = fn()
+        except BaseException as exc:  # noqa: BLE001 — classified below
+            if breaker is not None:
+                breaker.record_failure(key)
+            retryable = policy is not None and policy.retryable(exc)
+            if not retryable or attempt >= max_attempts:
+                if policy is not None and attempt >= max_attempts:
+                    from .sensors import SENSORS
+                    SENSORS.count("retry_exhausted", labels={"op": op})
+                raise
+            backoff = policy.backoff_s(op, attempt + 1)
+            if deadline is not None and clock() + backoff > deadline:
+                from .sensors import SENSORS
+                SENSORS.count("retry_deadline_exceeded", labels={"op": op})
+                raise
+            from .tracing import TRACER
+            TRACER.annotate(retry_backoff_s=round(backoff, 4))
+            sleep(backoff)
+            attempt += 1
+            continue
+        if breaker is not None:
+            breaker.record_success(key)
+        return result
+
+
+def with_resilience(op: str, *, policy: RetryPolicy | None = None,
+                    breaker: CircuitBreaker | None = None,
+                    target: str | None = None,
+                    clock: Callable[[], float] = time.monotonic,
+                    sleep: Callable[[float], None] = time.sleep):
+    """Decorator form of ``call_with_resilience`` for module-level
+    functions/methods with a fixed op name."""
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return call_with_resilience(
+                op, lambda: fn(*args, **kwargs), policy=policy,
+                breaker=breaker, target=target, clock=clock, sleep=sleep)
+        return wrapper
+    return deco

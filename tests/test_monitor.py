@@ -96,6 +96,25 @@ def test_partition_assignor_is_deterministic_and_complete():
     assert sum(len(x) for x in a) == len(partitions)
 
 
+def test_partition_assignor_is_stable_across_processes():
+    """Topic→fetcher placement must survive restarts: the assignor hashes
+    with crc32, NOT builtin hash() (which varies per process under
+    PYTHONHASHSEED). Pinned against literal crc32 values so a regression
+    back to hash() fails regardless of this process's seed."""
+    import zlib
+
+    partitions = _partitions(n_topics=6, parts_per_topic=2)
+    buckets = default_partition_assignor(partitions, 4)
+    for i, bucket in enumerate(buckets):
+        for (topic, _part) in bucket:
+            assert zlib.crc32(topic.encode("utf-8")) % 4 == i
+    # Topic granularity holds: no topic is split across fetchers.
+    seen: dict[str, int] = {}
+    for i, bucket in enumerate(buckets):
+        for (topic, _part) in bucket:
+            assert seen.setdefault(topic, i) == i
+
+
 def test_file_sample_store_roundtrip(tmp_path):
     store = FileSampleStore(str(tmp_path / "samples"))
     partitions = _partitions(n_topics=1, parts_per_topic=1, brokers=(0,))
