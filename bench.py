@@ -400,6 +400,11 @@ def _run_stage(jax, num_brokers: int, num_partitions: int, drain: int,
                                         goals=goals_by_priority(cfg))
     steady_s = time.time() - t0
     progress["steady_s"] = round(steady_s, 3)
+    # Megastep dispatch accounting for the steady pass: how many XLA
+    # executions the solve cost and the median rounds each carried (the
+    # link-latency amortization the megastep path exists for).
+    dispatch_stats = optimizer.last_dispatch_stats()
+    progress.update(dispatch_stats)
 
     # Incremental model pipeline probe (cold rebuild vs. warm refresh) —
     # capped at the acceptance scale; the synthetic partition-table setup
@@ -435,6 +440,11 @@ def _run_stage(jax, num_brokers: int, num_partitions: int, drain: int,
             "goal_durations_steady_s": {
                 g.name: round(g.duration_s, 4) for g in result.goal_results},
             "budget_s_prorated": round(budget_s, 3),
+            "solve_wall_clock_s": round(steady_s, 3),
+            "dispatch_count": dispatch_stats.get("dispatch_count", 0),
+            "rounds_per_dispatch_p50": dispatch_stats.get(
+                "rounds_per_dispatch_p50", 0.0),
+            "donated_dispatches": dispatch_stats.get("donated_dispatches", 0),
             "trace_span_count": TRACER.spans_closed - spans_before,
             **_span_quantile_extras(hist_baseline),
             **pipeline_extras,

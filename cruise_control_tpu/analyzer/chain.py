@@ -27,8 +27,9 @@ accepted by every previously-optimized goal), SURVEY.md §A.3.
 from __future__ import annotations
 
 import dataclasses
+import time as _time
 from functools import partial
-from typing import Sequence
+from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -306,6 +307,32 @@ def _chain_round_body(state: ClusterTensors, agg: "AggCarry | None",
     return new_state, agg, sel.sum()
 
 
+def _chain_rounds_driver(state: ClusterTensors, active_idx: jax.Array,
+                         prior_mask: jax.Array, goals: tuple[Goal, ...],
+                         constraint: BalancingConstraint, cfg: SearchConfig,
+                         num_topics: int, masks: ExclusionMasks,
+                         budget: jax.Array | None = None,
+                         ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
+    """Traced body of the fused move driver — the MEGASTEP: up to
+    ``budget`` round-bodies under one ``lax.while_loop`` whose carry is
+    ``((state, agg), moves, rounds, last_applied)`` with ``last_applied``
+    as the on-device early-exit flag (a zero-apply round freezes the state
+    and ends the loop — no host involvement). Shared by the plain and the
+    donated jits below."""
+    def body(carry, rounds_done):
+        s, a = carry
+        a = maybe_refresh(a, s, num_topics, rounds_done)
+        ns, na, applied = _chain_round_body(s, a, active_idx, prior_mask,
+                                            goals, constraint, cfg,
+                                            num_topics, masks)
+        return (ns, na), applied
+
+    (final, _agg), total, rounds = run_carry_loop(
+        body, (state, compute_agg(state, num_topics)), cfg.max_rounds,
+        budget=budget)
+    return final, total, rounds
+
+
 @partial(jax.jit, static_argnames=("goals", "constraint", "cfg", "num_topics"))
 def chain_optimize_rounds(state: ClusterTensors, active_idx: jax.Array,
                           prior_mask: jax.Array, goals: tuple[Goal, ...],
@@ -321,18 +348,50 @@ def chain_optimize_rounds(state: ClusterTensors, active_idx: jax.Array,
     Aggregates are computed once at entry and maintained incrementally
     through the loop (analyzer.agg), with a periodic fresh recompute to
     bound f32 drift."""
-    def body(carry, rounds_done):
-        s, a = carry
-        a = maybe_refresh(a, s, num_topics, rounds_done)
-        ns, na, applied = _chain_round_body(s, a, active_idx, prior_mask,
-                                            goals, constraint, cfg,
-                                            num_topics, masks)
-        return (ns, na), applied
+    return _chain_rounds_driver(state, active_idx, prior_mask, goals,
+                                constraint, cfg, num_topics, masks, budget)
 
-    (final, _agg), total, rounds = run_carry_loop(
-        body, (state, compute_agg(state, num_topics)), cfg.max_rounds,
-        budget=budget)
-    return final, total, rounds
+
+def strip_mutable(state: ClusterTensors) -> ClusterTensors:
+    """The read-only remainder of a split state: ``assignment`` and
+    ``leader_slot`` replaced by 0-row placeholders. The donated megastep
+    kernels take the two mutable tensors as SEPARATE donated arguments —
+    donating the whole pytree would also consume the topology tensors
+    (topic/rack/capacity/...), which the incremental model pipeline
+    (model/refresh.py) shares across generations from its topology cache;
+    a donated shared buffer is deleted under the cache's feet."""
+    s = state.max_replication_factor
+    return dataclasses.replace(
+        state,
+        assignment=jnp.zeros((0, s), state.assignment.dtype),
+        leader_slot=jnp.zeros((0,), state.leader_slot.dtype))
+
+
+@partial(jax.jit, static_argnames=("goals", "constraint", "cfg",
+                                   "num_topics"), donate_argnums=(0, 1))
+def chain_optimize_rounds_donated(assignment: jax.Array,
+                                  leader_slot: jax.Array,
+                                  rest: ClusterTensors,
+                                  active_idx: jax.Array,
+                                  prior_mask: jax.Array,
+                                  goals: tuple[Goal, ...],
+                                  constraint: BalancingConstraint,
+                                  cfg: SearchConfig, num_topics: int,
+                                  masks: ExclusionMasks, budget: jax.Array,
+                                  ) -> tuple[jax.Array, jax.Array,
+                                             jax.Array, jax.Array]:
+    """The donated megastep: identical trace to ``chain_optimize_rounds``
+    with the two mutable tensors donated, so XLA writes the new assignment
+    into the old buffers instead of allocating a fresh generation per
+    dispatch. Callers pass ``strip_mutable(state)`` as ``rest`` and must
+    not touch the donated arrays afterwards. Returns (assignment,
+    leader_slot, moves, rounds)."""
+    state = dataclasses.replace(rest, assignment=assignment,
+                                leader_slot=leader_slot)
+    final, total, rounds = _chain_rounds_driver(
+        state, active_idx, prior_mask, goals, constraint, cfg, num_topics,
+        masks, budget)
+    return final.assignment, final.leader_slot, total, rounds
 
 
 def _chain_swap_body(state: ClusterTensors, agg: "AggCarry | None",
@@ -385,17 +444,13 @@ def _chain_swap_body(state: ClusterTensors, agg: "AggCarry | None",
     return new_state, agg, applied
 
 
-@partial(jax.jit, static_argnames=("goals", "constraint", "num_topics",
-                                   "moves", "max_rounds"))
-def chain_swap_rounds(state: ClusterTensors, active_idx: jax.Array,
-                      prior_mask: jax.Array, goals: tuple[Goal, ...],
-                      constraint: BalancingConstraint, num_topics: int,
-                      masks: ExclusionMasks, moves: int = 8,
-                      max_rounds: int = 64,
-                      budget: jax.Array | None = None,
-                      ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
-    """Fused swap-phase driver, chain-parameterized (incremental-aggregate
-    carry, as chain_optimize_rounds)."""
+def _chain_swap_driver(state: ClusterTensors, active_idx: jax.Array,
+                       prior_mask: jax.Array, goals: tuple[Goal, ...],
+                       constraint: BalancingConstraint, num_topics: int,
+                       masks: ExclusionMasks, moves: int = 8,
+                       max_rounds: int = 64,
+                       budget: jax.Array | None = None,
+                       ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
     def body(carry, rounds_done):
         s, a = carry
         a = maybe_refresh(a, s, num_topics, rounds_done)
@@ -408,6 +463,43 @@ def chain_swap_rounds(state: ClusterTensors, active_idx: jax.Array,
         body, (state, compute_agg(state, num_topics)), max_rounds,
         budget=budget)
     return final, total, rounds
+
+
+@partial(jax.jit, static_argnames=("goals", "constraint", "num_topics",
+                                   "moves", "max_rounds"))
+def chain_swap_rounds(state: ClusterTensors, active_idx: jax.Array,
+                      prior_mask: jax.Array, goals: tuple[Goal, ...],
+                      constraint: BalancingConstraint, num_topics: int,
+                      masks: ExclusionMasks, moves: int = 8,
+                      max_rounds: int = 64,
+                      budget: jax.Array | None = None,
+                      ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
+    """Fused swap-phase driver, chain-parameterized (incremental-aggregate
+    carry, as chain_optimize_rounds)."""
+    return _chain_swap_driver(state, active_idx, prior_mask, goals,
+                              constraint, num_topics, masks, moves,
+                              max_rounds, budget)
+
+
+@partial(jax.jit, static_argnames=("goals", "constraint", "num_topics",
+                                   "moves", "max_rounds"),
+         donate_argnums=(0, 1))
+def chain_swap_rounds_donated(assignment: jax.Array, leader_slot: jax.Array,
+                              rest: ClusterTensors, active_idx: jax.Array,
+                              prior_mask: jax.Array, goals: tuple[Goal, ...],
+                              constraint: BalancingConstraint,
+                              num_topics: int, masks: ExclusionMasks,
+                              moves: int, max_rounds: int,
+                              budget: jax.Array,
+                              ) -> tuple[jax.Array, jax.Array, jax.Array,
+                                         jax.Array]:
+    """Donated swap megastep (see chain_optimize_rounds_donated)."""
+    state = dataclasses.replace(rest, assignment=assignment,
+                                leader_slot=leader_slot)
+    final, total, rounds = _chain_swap_driver(
+        state, active_idx, prior_mask, goals, constraint, num_topics, masks,
+        moves, max_rounds, budget)
+    return final.assignment, final.leader_slot, total, rounds
 
 
 def _chain_goal_stats_body(state: ClusterTensors, active_idx: jax.Array,
@@ -696,6 +788,196 @@ class AdaptiveDispatch:
             self.k = min(self.k * 2, self.MAX_ROUNDS)
 
 
+@dataclasses.dataclass(frozen=True)
+class MegastepConfig:
+    """Knobs of the bounded-dispatch megastep path (optimizer-owned; the
+    chain drivers take it pre-resolved so tests can pin each switch).
+
+    - ``donate``: request buffer donation for the mutable state tensors.
+      The effective decision additionally requires a non-zero-copy backend
+      (``donation_enabled``) — on CPU, ``device_put`` may alias host
+      memory (model/refresh.py's snapshot rule), and a donated aliased
+      buffer would let XLA scribble over the model cache.
+    - ``async_readback``: enqueue dispatch N+1 before reading dispatch N's
+      scalars (one-behind pipeline; AdaptiveDispatch then learns from the
+      COMPLETED dispatch one step late — its documented staleness
+      contract). Off = read-then-enqueue, the r9 behavior.
+    - ``deficit_moves_cap``: > 0 sizes count-distribution goals'
+      moves_per_round / num_sources from the measured total surplus
+      (deficit_sized_config); 0 disables sizing entirely.
+    """
+
+    donate: bool = True
+    async_readback: bool = True
+    deficit_moves_cap: int = 0
+
+
+def donation_enabled(megastep: "MegastepConfig | None") -> bool:
+    """Donate only off zero-copy backends: on CPU the state tensors may
+    alias host buffers owned by the incremental model pipeline
+    (refresh.py ships loads zero-copy when alignment allows), and the
+    topology cache shares device arrays across generations — the same
+    rule refresh.py applies to its own donation decision."""
+    return (megastep is not None and megastep.donate
+            and jax.default_backend() != "cpu")
+
+
+class DispatchStats:
+    """Per-optimization-pass dispatch accounting: how many device
+    dispatches the solve cost, how many rounds each carried, and how many
+    were donated / speculative (the async pump's post-convergence no-op).
+    Mirrored into the sensor registry via utils.xla_telemetry so the
+    bench and CI can read dispatch_count / rounds_per_dispatch_p50
+    without threading state through every driver."""
+
+    def __init__(self):
+        self.rounds_per_dispatch: list[int] = []
+        self.donated = 0
+        self.speculative = 0
+
+    def record(self, kind: str, rounds: int, donated: bool = False,
+               speculative: bool = False) -> None:
+        self.rounds_per_dispatch.append(int(rounds))
+        if donated:
+            self.donated += 1
+        if speculative:
+            self.speculative += 1
+        from ..utils.xla_telemetry import record_dispatch
+        record_dispatch(kind, int(rounds), donated=donated,
+                        speculative=speculative)
+
+    @property
+    def dispatch_count(self) -> int:
+        return len(self.rounds_per_dispatch)
+
+    def rounds_p50(self) -> float:
+        if not self.rounds_per_dispatch:
+            return 0.0
+        ordered = sorted(self.rounds_per_dispatch)
+        return float(ordered[(len(ordered) - 1) // 2])
+
+    def as_dict(self) -> dict:
+        return {"dispatch_count": self.dispatch_count,
+                "rounds_per_dispatch_p50": self.rounds_p50(),
+                "donated_dispatches": self.donated,
+                "speculative_dispatches": self.speculative}
+
+
+def deficit_sized_config(cfg: SearchConfig, viol0: float,
+                         cap: int) -> SearchConfig:
+    """Deficit-aware batch sizing for the count-distribution goals: size
+    the per-round move budget (and the source width that bounds how many
+    moves a round can actually admit — selection takes at most one move
+    per source row) from the goal's measured total band violation instead
+    of the configured constant, so an O(10k)-move imbalance is not fed
+    through hundreds of fixed-width rounds.
+
+    Each move shifts one replica from an over-band broker to an
+    under-band one, reducing the total violation by up to 2 — the move
+    target is ``viol0 / 2``. The width is rounded UP to a power of two
+    (compile-count quantization: every distinct (sources, moves) pair is
+    a new XLA program) and clamped to [cfg values, cap]. Sizing depends
+    only on the goal's ENTRY violations, so it is identical for any
+    dispatch-budget sequence — trajectory invariance holds per sized
+    config."""
+    from .fill import pow2_width
+    target = int(viol0) // 2
+    if target <= cfg.moves_per_round:
+        return cfg
+    q = min(pow2_width(target), max(cap, cfg.moves_per_round))
+    if q <= cfg.moves_per_round and q <= cfg.num_sources:
+        return cfg
+    return dataclasses.replace(
+        cfg, moves_per_round=max(cfg.moves_per_round, q),
+        num_sources=max(cfg.num_sources, q))
+
+
+def run_bounded_pass(enqueue: Callable, st, pass_cap: int,
+                     controller: AdaptiveDispatch,
+                     out_of_time: Callable[[], bool] | None = None,
+                     async_readback: bool = True,
+                     stats: DispatchStats | None = None,
+                     kind: str = "move"):
+    """Drive one logical pass (a fixed-point loop of at most ``pass_cap``
+    search rounds) as a sequence of bounded megastep dispatches.
+
+    ``enqueue(st, budget) -> (st, applied, rounds, donated)`` fires one
+    dispatch and returns WITHOUT reading anything back (jax async
+    dispatch); the scalars are device futures and ``donated`` reports
+    whether THIS dispatch ran the donated kernel (per-dispatch, so the
+    donation telemetry stays exact). With ``async_readback`` the pump
+    keeps one dispatch in flight: dispatch N+1 is enqueued — chained on
+    N's output state, budgeted against the PESSIMISTIC estimate that N
+    runs its full budget (the estimate can only under-budget N+1, never
+    overshoot ``pass_cap``) — before N's scalars are read, so the
+    host↔device link latency of the readback overlaps device compute.
+    ``controller`` observes each dispatch when its scalars arrive — one
+    step behind the enqueue decision it feeds (the AdaptiveDispatch
+    staleness contract). In the pipelined steady state dispatch N cannot
+    start on device before N−1 completes (its input is N−1's output), so
+    N's own cost is measured as the delta from the PREVIOUS readback's
+    return to this one — timing from enqueue would fold N−1's remaining
+    execution into N and systematically ~double the observed cost,
+    pinning the budget at its floor.
+
+    A dispatch that reports fewer rounds than its budget hit the pass's
+    fixed point; the speculatively-enqueued successor (if any) re-runs a
+    single zero-apply round that leaves the state byte-identical and
+    applies nothing — it is recorded in ``stats`` (speculative=True) but
+    contributes neither moves nor rounds to the pass totals, so the
+    round budget matches the synchronous path's exactly. Trajectory is
+    invariant to all of it: same round sequence, only dispatch boundaries
+    and readback timing differ.
+
+    Returns (st, applied_total, pass_rounds)."""
+    applied_total = 0
+    pass_rounds = 0
+    est_rounds = 0
+    prev = None    # (applied, rounds, budget, t0, donated) — unread
+    last_read_t = None
+    converged = False
+    while True:
+        cur = None
+        may_enqueue = prev is None or async_readback
+        if may_enqueue and not converged and est_rounds < pass_cap \
+                and not (out_of_time is not None and out_of_time()):
+            budget = controller.budget(pass_cap - est_rounds)
+            t0 = _time.monotonic()
+            st, applied, r, donated = enqueue(st, budget)
+            cur = (applied, r, budget, t0, donated)
+            est_rounds += budget
+        if prev is not None:
+            applied_p, r_p, budget_p, t0_p, donated_p = prev
+            r_read = int(r_p)                       # blocks on dispatch N
+            now = _time.monotonic()
+            start = t0_p if last_read_t is None else max(t0_p, last_read_t)
+            applied_total += int(applied_p)
+            controller.observe(r_read, budget_p, now - start)
+            last_read_t = now
+            if stats is not None:
+                stats.record(kind, r_read, donated=donated_p)
+            pass_rounds += r_read
+            est_rounds -= budget_p - r_read         # correct the estimate
+            if r_read < budget_p:
+                converged = True
+        if converged and cur is not None:
+            # Speculative post-convergence dispatch: one re-run of the
+            # terminal zero-apply round (state frozen on device, applies
+            # nothing). Its rounds are NOT added to pass_rounds — they
+            # make no search progress, and counting them would consume
+            # cfg.max_rounds budget the synchronous per-round path does
+            # not pay, diverging the paths at the round-cap boundary.
+            if stats is not None:
+                stats.record(kind, int(cur[1]), donated=cur[4],
+                             speculative=True)
+            cur = None
+        prev = cur
+        if prev is None and (converged or est_rounds >= pass_cap
+                             or (out_of_time is not None and out_of_time())):
+            break
+    return st, applied_total, pass_rounds
+
+
 def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
                            index: int, constraint: BalancingConstraint,
                            cfg: SearchConfig, num_topics: int,
@@ -703,6 +985,9 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
                            dispatch_rounds: int = 0,
                            dispatch: AdaptiveDispatch | None = None,
                            wall_budget_s: float = 0.0,
+                           megastep: MegastepConfig | None = None,
+                           stats: DispatchStats | None = None,
+                           donate_input: bool = False,
                            ) -> tuple[ClusterTensors, dict]:
     """Run goal ``chain[index]`` to convergence under the acceptance of
     ``chain[:index]``, using the chain-shared kernels (same semantics and
@@ -729,9 +1014,15 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
     (ResourceDistributionGoal.java:470-475), enforceable at dispatch
     granularity on the bounded path. Hard goals still raise on residual
     violations, exactly like the reference in fast mode.
-    """
-    import time as _time
 
+    ``megastep`` selects the bounded path's dispatch machinery (donation,
+    async readback, deficit-aware count-goal sizing; see MegastepConfig);
+    None keeps the r9 synchronous non-donating behavior. ``donate_input``
+    declares the CALLER relinquishes ``state`` — the first dispatch then
+    donates it directly; otherwise it donates a device COPY of the two
+    mutable tensors (intermediate states are loop-owned and donated
+    as-is). ``stats`` collects per-dispatch accounting.
+    """
     goal_t0 = _time.monotonic()
 
     def out_of_time() -> bool:
@@ -752,33 +1043,82 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
     bounded = dispatch_rounds > 0
     if bounded and dispatch is None:
         dispatch = AdaptiveDispatch(dispatch_rounds, target_s=0.0)
+    donate = donation_enabled(megastep) and bounded
+    async_rb = bool(megastep.async_readback) if megastep is not None \
+        else False
+    if bounded and megastep is not None and megastep.deficit_moves_cap > 0 \
+            and goal.count_based:
+        # Deficit-aware sizing from the goal's ENTRY violations — a
+        # pass-level constant, so the trajectory stays invariant to the
+        # dispatch-budget sequence under the sized config.
+        cfg = deficit_sized_config(cfg, float(viol0),
+                                   megastep.deficit_moves_cap)
+    # Donation gate: the first dispatch consumes the caller's state —
+    # donatable only on the caller's say-so; everything after consumes
+    # loop-owned intermediates. With donation ON, the first dispatch
+    # COPIES the two mutable tensors instead of falling back to the
+    # non-donated kernel: a copy is an O(P·RF) device op, while the
+    # fallback would compile the full-chain program TWICE (plain +
+    # donated — minutes each at scale).
+    can_donate = [bool(donate_input)]
 
-    def run_pass(kernel, st, pass_cap: int, **kw):
-        """One logical pass (a single unbounded ``run_rounds_loop`` call of
-        up to ``pass_cap`` rounds), split into bounded dispatches when
-        bounded (round budget sized by ``dispatch``). The per-dispatch cap
-        rides a TRACED budget (no recompile per value); a dispatch stopping
-        below its budget hit a zero-apply round, i.e. the pass's fixed
-        point. Identical trajectory either way — the round sequence is the
-        same, only dispatch boundaries differ."""
+    def run_pass(phase: str, st, pass_cap: int):
+        """One logical pass (a single fixed-point loop of up to
+        ``pass_cap`` rounds), split into bounded megastep dispatches when
+        bounded (round budget sized by ``dispatch``, pumped by
+        run_bounded_pass). The per-dispatch cap rides a TRACED budget (no
+        recompile per value); a dispatch stopping below its budget hit a
+        zero-apply round, i.e. the pass's fixed point. Identical
+        trajectory either way — the round sequence is the same, only
+        dispatch boundaries differ."""
         if not bounded:
             # One dispatch IS the whole pass (the kernel's static cap
             # equals pass_cap).
-            st, applied, r = kernel(st, idx, prior, goals, constraint, **kw)
+            if phase == "move":
+                st, applied, r = chain_optimize_rounds(
+                    st, idx, prior, goals, constraint, cfg, num_topics,
+                    masks)
+            else:
+                st, applied, r = chain_swap_rounds(
+                    st, idx, prior, goals, constraint, num_topics, masks)
+            if stats is not None:
+                stats.record(phase, int(r))
             return st, int(applied), int(r)
-        applied_total, pass_rounds = 0, 0
-        while pass_rounds < pass_cap and not out_of_time():
-            budget = dispatch.budget(pass_cap - pass_rounds)
-            t0 = _time.monotonic()
-            st, applied, r = kernel(st, idx, prior, goals, constraint,
-                                    **kw, budget=jnp.int32(budget))
-            applied_total += int(applied)
-            r = int(r)
-            dispatch.observe(r, budget, _time.monotonic() - t0)
-            pass_rounds += r
-            if r < budget:
-                break
-        return st, applied_total, pass_rounds
+
+        def enqueue(st, budget: int):
+            b = jnp.int32(budget)
+            if donate:
+                if not can_donate[0]:
+                    # Caller retains the input: donate a copy of the two
+                    # mutable tensors, never the caller's buffers.
+                    st = dataclasses.replace(
+                        st, assignment=jnp.copy(st.assignment),
+                        leader_slot=jnp.copy(st.leader_slot))
+                rest = strip_mutable(st)
+                if phase == "move":
+                    a, l, applied, r = chain_optimize_rounds_donated(
+                        st.assignment, st.leader_slot, rest, idx, prior,
+                        goals, constraint, cfg, num_topics, masks, b)
+                else:
+                    a, l, applied, r = chain_swap_rounds_donated(
+                        st.assignment, st.leader_slot, rest, idx, prior,
+                        goals, constraint, num_topics, masks, 8, 64, b)
+                st = dataclasses.replace(st, assignment=a, leader_slot=l)
+            elif phase == "move":
+                st, applied, r = chain_optimize_rounds(
+                    st, idx, prior, goals, constraint, cfg, num_topics,
+                    masks, budget=b)
+            else:
+                st, applied, r = chain_swap_rounds(
+                    st, idx, prior, goals, constraint, num_topics, masks,
+                    budget=b)
+            can_donate[0] = True
+            return st, applied, r, donate
+
+        return run_bounded_pass(
+            enqueue, st, pass_cap, dispatch,
+            out_of_time=out_of_time if wall_budget_s > 0 else None,
+            async_readback=async_rb, stats=stats, kind=phase)
 
     # Fast path (parity with chain_optimize_full's per-goal lax.cond skip
     # and the sharded bounded driver): nothing violated, nothing offline,
@@ -791,15 +1131,12 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
     ran = float(viol0) > 0 or int(offline0) > 0 or drain
     if ran:
         while rounds < cfg.max_rounds and not out_of_time():
-            state, moves, r = run_pass(chain_optimize_rounds, state,
-                                       cfg.max_rounds, cfg=cfg,
-                                       num_topics=num_topics, masks=masks)
+            state, moves, r = run_pass("move", state, cfg.max_rounds)
             total_applied += moves
             rounds += r
             if not goal.supports_swap:
                 break
-            state, swapped, sr = run_pass(chain_swap_rounds, state, 64,
-                                          num_topics=num_topics, masks=masks)
+            state, swapped, sr = run_pass("swap", state, 64)
             total_swaps += swapped
             total_applied += swapped
             rounds += sr

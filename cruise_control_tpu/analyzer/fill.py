@@ -61,6 +61,14 @@ def targets_enabled(num_partitions: int) -> bool:
     return TARGET_DESTS_ON and num_partitions < TARGET_DESTS_MAX_P
 
 
+def pow2_width(n: int) -> int:
+    """Round a measured work size up to the next power of two — the
+    compile-count quantization of every deficit-sized grid width (each
+    distinct static width is a new XLA program, so sized widths must come
+    from a tiny set)."""
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
 # Per-goal-class filter for attribution experiments: comma-separated class
 # names; empty = all classes contribute targeted destinations.
 _TGT_CLASSES = os.environ.get("CC_TGT_CLASSES", "")

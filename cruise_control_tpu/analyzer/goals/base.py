@@ -57,6 +57,15 @@ class Goal:
     # TopicReplicaDistributionGoal: rounds 482 -> 106, balancedness and
     # violated set unchanged).
     prefers_wide_batches: bool = False
+    # True for the count-distribution family (replica / leader-replica /
+    # topic-replica counts): total band violation ≈ 2 × the moves still
+    # needed, so the bounded megastep driver may size the per-round move
+    # budget and source width from the MEASURED surplus
+    # (chain.deficit_sized_config) instead of the configured constant —
+    # an O(10k)-move imbalance then stops burning hundreds of fixed-width
+    # rounds. Resource goals must NOT set this: their violation is in
+    # load units, not move counts.
+    count_based: bool = False
     # True for goals whose decisions read measured resource loads (the
     # capacity / resource-distribution / potential-NW-out / leader-bytes-in
     # family): they need a substantially complete metric model, mirroring

@@ -241,6 +241,7 @@ class CountDistributionGoal(Goal):
     (ReplicaDistributionGoal.java, LeaderReplicaDistributionGoal.java)."""
 
     leaders: bool = False
+    count_based: bool = True
 
     def _counts(self, derived):
         return (derived.broker_leaders if self.leaders
@@ -360,6 +361,7 @@ class TopicReplicaDistributionGoal(Goal):
     fine up to mid-size clusters; sharded over the mesh at large T×B."""
 
     prefers_wide_batches: bool = True
+    count_based: bool = True
 
     def prepare_partial(self, state, num_topics):
         return {"counts": topic_broker_replica_counts(state, num_topics)

@@ -173,6 +173,31 @@ class GoalOptimizer:
             "solver.dispatch.max.rounds")
         self._dispatch_target_s = self._config.get_double(
             "solver.dispatch.target.seconds")
+        self._megastep_donate = self._config.get_boolean(
+            "solver.megastep.donate")
+        self._async_readback = self._config.get_boolean(
+            "solver.dispatch.async.readback")
+        self._deficit_moves_cap = self._config.get_int(
+            "solver.deficit.moves.cap")
+        # Adaptive dispatch controllers PERSIST across optimization passes,
+        # keyed by MODEL SHAPE: per-round cost is a property of the
+        # cluster shape, so the budget learned on one pass carries to the
+        # next pass of the SAME shape — the fleet pacer's repeated
+        # precomputes skip the relearning ramp — while a fleet-shared
+        # optimizer can never apply a big budget learned on a cheap small
+        # cluster to a 10x-larger one's first dispatch (watchdog risk).
+        # The shape key is the padded bucket shape, so the set stays tiny.
+        import threading
+        self._controllers: dict = {}
+        self._controllers_lock = threading.Lock()
+        self._dispatch_stats = None
+        self._pass_seq = 0
+        # Exact per-caller attribution on a shared optimizer: each pass
+        # also records (seq, stats) thread-locally, so a caller whose
+        # solve runs synchronously on its own thread (the fleet pacer)
+        # can read back THE pass it ran, immune to passes other threads
+        # start concurrently or to its request being cache-served.
+        self._tls = threading.local()
         if mesh == "auto":
             import jax
 
@@ -192,6 +217,85 @@ class GoalOptimizer:
         axis does not divide it, and reporting the mesh size then would
         corrupt the vs-baseline comparison)."""
         return self._devices_used
+
+    def last_dispatch_stats(self) -> dict:
+        """Dispatch accounting of the LAST optimization pass (bench/CI
+        surface): dispatch_count, rounds_per_dispatch_p50, donated and
+        speculative tallies. Empty dict before any pass. On a fleet-shared
+        optimizer this reflects the most recently STARTED pass — callers
+        that need per-job attribution (the pacer's precompute job) must
+        read it on the solving thread immediately after their own solve
+        returns, before another thread can start a pass — and compare
+        ``pass_seq()`` across the call to detect that no new pass ran at
+        all (a cache-served request must not claim another pass's
+        stats)."""
+        return self._dispatch_stats.as_dict() if self._dispatch_stats \
+            else {}
+
+    def pass_seq(self) -> int:
+        """Monotonic count of optimization passes STARTED on this
+        optimizer. Pairs with last_dispatch_stats(): a caller whose
+        request may be served from a proposal cache snapshots the seq
+        before and after — unchanged seq means no solve ran, so the
+        current stats belong to some other caller's pass."""
+        return self._pass_seq
+
+    def thread_pass_seq(self) -> int:
+        """Seq of the last pass run ON THE CALLING THREAD (0 if none).
+        Unlike pass_seq() this cannot be advanced by another thread's
+        pass, so snapshot-before / compare-after brackets exactly the
+        caller's own solves."""
+        last = getattr(self._tls, "last_pass", None)
+        return last[0] if last else 0
+
+    def thread_dispatch_stats(self) -> dict:
+        """Dispatch accounting of the last pass run ON THE CALLING
+        THREAD — exact attribution for embedders (the fleet pacer) whose
+        solve happens synchronously inside their call, regardless of
+        what passes other threads start meanwhile. {} if this thread
+        never ran one."""
+        last = getattr(self._tls, "last_pass", None)
+        return last[1].as_dict() if last else {}
+
+    def _controller_pair(self, state: ClusterTensors):
+        """(narrow, wide) persistent AdaptiveDispatch pair for this model
+        shape (created on first use; lock-guarded — facade request
+        threads and the fleet worker may solve concurrently).
+
+        Only the dict lookup is locked: the controllers themselves are
+        deliberately unsynchronized. Two same-shape solves running
+        concurrently contend for the device, inflate each other's
+        observed per-dispatch wall-clock, and can transiently halve the
+        shared budget — accepted, because the error is bounded (k never
+        leaves [1, max]), self-correcting (k doubles again on the next
+        on-target dispatch of a solo pass), and affects only dispatch
+        boundaries, never the trajectory. A lock around observe/budget
+        would serialize readbacks across solves on the hot path to
+        protect a heuristic."""
+        from .chain import AdaptiveDispatch
+        key = (state.num_partitions, state.num_brokers)
+        with self._controllers_lock:
+            pair = self._controllers.get(key)
+            if pair is None:
+                pair = (AdaptiveDispatch(max(1, self._dispatch_rounds),
+                                         self._dispatch_target_s),
+                        AdaptiveDispatch(max(1, self._dispatch_rounds),
+                                         self._dispatch_target_s))
+                self._controllers[key] = pair
+        return pair
+
+    def _megastep_config(self, num_brokers: int):
+        """Resolve the megastep knobs for one pass. Deficit-aware count-
+        goal sizing shares the wide-batch regime gate: below it the fused
+        whole-chain kernel is the production path and the bounded drivers
+        must walk its exact trajectory (the cross-path parity contract)."""
+        from .chain import MegastepConfig
+        threshold = self._config.get_int("solver.wide.batch.min.brokers")
+        in_regime = threshold > 0 and num_brokers >= threshold
+        return MegastepConfig(
+            donate=self._megastep_donate,
+            async_readback=self._async_readback,
+            deficit_moves_cap=self._deficit_moves_cap if in_regime else 0)
 
     @property
     def constraint(self) -> BalancingConstraint:
@@ -381,6 +485,13 @@ class GoalOptimizer:
         initial = state
         stats_before = cluster_stats(state)
 
+        from .chain import DispatchStats
+        stats = DispatchStats()
+        self._dispatch_stats = stats
+        self._pass_seq += 1
+        self._tls.last_pass = (self._pass_seq, stats)
+        megastep = self._megastep_config(state.num_brokers)
+
         mesh = self._mesh
         if mesh is not None and state.num_partitions % mesh.devices.size != 0:
             # Partition axis must divide the mesh (pad via the builder's
@@ -401,11 +512,30 @@ class GoalOptimizer:
             # one multi-minute XLA execution trips device-runtime watchdogs.
             bounded = (self._fused_max_brokers > 0
                        and state.num_brokers > self._fused_max_brokers)
+            # donate_input stays False: shard_cluster's device_put is a
+            # NO-OP (alias, not copy) when the input is already sharded
+            # exactly right — e.g. a caller feeding back the sharded
+            # state a previous pass returned — and donating an aliased
+            # buffer would delete it under ``initial`` and the caller.
+            # The first bounded dispatch instead donates a cheap device
+            # copy of the two mutable tensors (chain_sharded's
+            # can_donate gate), same discipline as the single-device
+            # chain_owns_state gate.
+            # The persistent per-shape controllers ride along so mesh
+            # precomputes skip the budget-relearning ramp too; the wide
+            # one bills the deficit-sized count goals' dispatches.
+            ctl_pair = self._controller_pair(state) if bounded \
+                else (None, None)
             state, infos = optimize_chain_sharded(
                 state, goal_chain, self._constraint, search_cfg,
                 meta.num_topics, mesh, masks,
                 dispatch_rounds=self._dispatch_rounds if bounded else 0,
-                dispatch_target_s=self._dispatch_target_s)
+                dispatch_target_s=self._dispatch_target_s,
+                dispatch=ctl_pair[1 if fast else 0],
+                dispatch_wide=ctl_pair[1],
+                megastep=megastep, stats=stats, donate_input=False)
+            if not bounded:
+                stats.record("chain", sum(i["rounds"] for i in infos))
             goal_results = _apportioned_goal_results(
                 goal_chain, infos, time.time() - t0)
             _record_goal_spans(TRACER, goal_results, search_cfg)
@@ -418,6 +548,7 @@ class GoalOptimizer:
             state, infos = optimize_chain(
                 state, goal_chain, self._constraint, search_cfg,
                 meta.num_topics, masks)
+            stats.record("chain", sum(i["rounds"] for i in infos))
             goal_results = _apportioned_goal_results(
                 goal_chain, infos, time.time() - t0)
             _record_goal_spans(TRACER, goal_results, search_cfg)
@@ -430,13 +561,19 @@ class GoalOptimizer:
             # on-entry violated_before semantics as the fused path.
             dispatch_rounds = self._dispatch_rounds \
                 if (self._fused_chain or fast) else 0
-            # One adaptive controller across the chain: per-round cost is a
+            # One adaptive controller across the chain AND across
+            # same-shape passes (see __init__): per-round cost is a
             # property of the cluster shape, not the goal, so the budget
-            # learned on goal 1 carries to goal 15.
-            from .chain import AdaptiveDispatch
-            controller = AdaptiveDispatch(
-                dispatch_rounds, self._dispatch_target_s) \
-                if dispatch_rounds > 0 else None
+            # learned on goal 1 carries to goal 15 — and to the next
+            # precompute of this shape.
+            ctl_pair = self._controller_pair(state) if dispatch_rounds > 0 \
+                else (None, None)
+            # Fast mode runs every goal on the WIDENED grid, so its
+            # dispatches belong to the wide controller's cost class — the
+            # narrow controller's persisted budget would overshoot ~4x on
+            # the first wide dispatch (the exact cross-contamination the
+            # narrow/wide split exists to prevent).
+            controller = ctl_pair[1] if fast else ctl_pair[0]
             # In fast mode search_cfg is already wide for every goal — a
             # second per-goal widening would compile a third grid shape.
             wide_cfg = None if fast else self._wide_config(
@@ -446,14 +583,25 @@ class GoalOptimizer:
             # cheap narrow dispatches would overshoot the wall-clock
             # target ~4x on the first wide dispatch (watchdog territory),
             # then depress the narrow goals' budget after the halving.
-            controller_wide = AdaptiveDispatch(
-                dispatch_rounds, self._dispatch_target_s) \
-                if (wide_cfg is not None and dispatch_rounds > 0) else None
+            # Deficit-sized count goals belong to the same wide cost
+            # class: chain.deficit_sized_config can widen their
+            # sources/moves past the wide grid even though they run the
+            # narrow cfg, so billing them to the narrow controller would
+            # recreate exactly that overshoot-then-depress cycle — and
+            # persist it across same-shape passes.
+            deficit_sizing = megastep.deficit_moves_cap > 0
             goal_results = []
+            # Donation gate for the chain's FIRST mutating dispatch: until
+            # some goal has actually run a dispatch, the threaded state is
+            # still the caller's buffers (``initial`` feeds the proposal
+            # diff) and must not be donated; afterwards every input is a
+            # chain-owned intermediate.
+            chain_owns_state = False
             for i, g in enumerate(goal_chain):
                 t0 = time.time()
                 use_wide = wide_cfg is not None and g.prefers_wide_batches
                 cfg_used = wide_cfg if use_wide else search_cfg
+                wide_class = use_wide or (deficit_sizing and g.count_based)
                 with TRACER.span("goal.solve", goal=g.name,
                                  candidates=cfg_used.num_sources
                                  * cfg_used.num_dests) as gsp:
@@ -461,8 +609,11 @@ class GoalOptimizer:
                         state, goal_chain, i, self._constraint,
                         cfg_used, meta.num_topics, masks,
                         dispatch_rounds=dispatch_rounds,
-                        dispatch=controller_wide if use_wide else controller,
-                        wall_budget_s=fast_budget_s)
+                        dispatch=ctl_pair[1] if wide_class else controller,
+                        wall_budget_s=fast_budget_s,
+                        megastep=megastep, stats=stats,
+                        donate_input=chain_owns_state)
+                    chain_owns_state |= info["rounds"] > 0
                     gsp.set(rounds=info["rounds"],
                             moves_applied=info["moves_applied"],
                             succeeded=info["succeeded"])

@@ -235,7 +235,16 @@ def run_carry_loop(round_body, carry0, max_rounds: int, budget=None):
     run without recompiling per value — the bounded-dispatch driver passes
     the remaining global round budget so a dispatch never overshoots
     ``cfg.max_rounds`` (the static ``max_rounds`` alone would admit up to
-    a full dispatch past it)."""
+    a full dispatch past it).
+
+    This loop IS the megastep (docs/DESIGN.md round 10): the while carry
+    ``(carry, total, rounds, last_applied)`` keeps the early-exit flag —
+    ``last_applied == 0`` — on device, so a budget-K dispatch that reaches
+    its fixed point mid-budget freezes the state and stops WITHOUT a host
+    round-trip; the host detects convergence purely from the returned
+    ``rounds_run < budget``. That detectability is what the async
+    readback pump and its speculative post-convergence dispatch rely on
+    (chain.run_bounded_pass)."""
     cap = max_rounds if budget is None else jnp.minimum(
         jnp.int32(max_rounds), budget.astype(jnp.int32))
 

@@ -345,6 +345,30 @@ def _definition() -> ConfigDef:
              "per-dispatch host-device link latency (a tunneled TPU pays a "
              "fixed RTT per execution) while every dispatch stays far "
              "below execution-watchdog territory. 0 disables adaptation.")
+    d.define("solver.megastep.donate", T.BOOLEAN, True, None, I.LOW,
+             "Bounded megastep dispatches donate the mutable state tensors "
+             "(assignment, leader_slot) to XLA so each dispatch rewrites "
+             "them in place instead of allocating a fresh generation. "
+             "Automatically disabled on zero-copy backends (CPU), where "
+             "device arrays may alias host buffers owned by the "
+             "incremental model pipeline.")
+    d.define("solver.dispatch.async.readback", T.BOOLEAN, True, None, I.LOW,
+             "Bounded-dispatch pipelining: enqueue the next megastep "
+             "before reading the previous one's stats scalars, so the "
+             "host-device readback RTT overlaps device compute. The "
+             "adaptive dispatch controller then learns from the completed "
+             "dispatch one step behind. Trajectory-invariant; the only "
+             "cost is one speculative zero-apply round per pass.")
+    d.define("solver.deficit.moves.cap", T.INT, 2048, Range.at_least(0),
+             I.LOW,
+             "Deficit-aware batch sizing for count-distribution goals on "
+             "the bounded path: moves-per-round / source width are sized "
+             "from the goal's measured total band violation (~2x the "
+             "moves still needed), rounded up to a power of two and "
+             "capped here, instead of the fixed configured width — an "
+             "O(10k)-move imbalance stops burning hundreds of fixed-"
+             "width rounds. Applies at/above "
+             "solver.wide.batch.min.brokers; 0 disables sizing.")
     d.define("fleet.bucket.broker.base", T.INT, 4, Range.at_least(1), I.LOW,
              "Fleet federation: smallest broker-axis bucket of the shared "
              "geometric shape grid (fleet.bucketing.BucketGrid). Every "

@@ -318,8 +318,39 @@ class FleetScheduler:
                 except Exception:  # noqa: BLE001 — overlap is best-effort
                     LOG.debug("fleet: model prefetch kickoff for %s failed",
                               cid, exc_info=True)
-            fut = self.submit(cid, JobKind.EXPIRING_CACHE,
-                              lambda cc=cc: cc.proposals())
+            def precompute(cc=cc, cid=cid):
+                opt = getattr(cc, "optimizer", None)
+                if opt is None or not hasattr(opt, "thread_dispatch_stats"):
+                    return cc.proposals()
+                seq0 = opt.thread_pass_seq()
+                result = cc.proposals()
+                # Megastep dispatch accounting per cluster: the pacer's
+                # precompute is the steady-state solve, so its dispatch
+                # count / rounds-per-dispatch ARE the fleet's device-link
+                # cost profile (and the visible payoff of the optimizer's
+                # pass-persistent AdaptiveDispatch budget). Attribution
+                # uses the optimizer's THREAD-LOCAL pass record: the
+                # solve (if any) ran synchronously on this worker thread
+                # inside proposals(), so an advanced thread_pass_seq
+                # proves the stats are exactly this precompute's — a
+                # cache-served request advances nothing, and passes that
+                # other clusters' facade threads start concurrently are
+                # invisible here (the shared last_dispatch_stats slot
+                # could report either).
+                if opt.thread_pass_seq() == seq0:
+                    return result
+                from ..utils.sensors import SENSORS
+                ds = opt.thread_dispatch_stats()
+                if ds.get("dispatch_count"):
+                    SENSORS.gauge("fleet_precompute_dispatches",
+                                  ds["dispatch_count"],
+                                  labels={"cluster": cid})
+                    SENSORS.gauge("fleet_precompute_rounds_per_dispatch_p50",
+                                  ds["rounds_per_dispatch_p50"],
+                                  labels={"cluster": cid})
+                return result
+
+            fut = self.submit(cid, JobKind.EXPIRING_CACHE, precompute)
 
             def report(f, cid=cid):
                 # The pacer owns this future — surface failures, else a

@@ -55,7 +55,7 @@ from ..analyzer.search import (
 from ..common.resources import Resource
 from ..model.tensors import ClusterTensors, offline_replicas
 from .mesh import PARTITION_AXIS, shard_map
-from .sharded import _mask_specs, _psum, _state_specs
+from .sharded import _mask_specs, _psum, _state_specs, mutable_state_specs
 
 
 # Per-device source-width policy for the sharded move grid. Measured on the
@@ -704,6 +704,9 @@ def optimize_chain_sharded(state: ClusterTensors, chain,
                            swap_moves: int = 8, swap_max_rounds: int = 64,
                            dispatch_rounds: int = 0,
                            dispatch_target_s: float = 0.0,
+                           dispatch=None, dispatch_wide=None,
+                           megastep=None, stats=None,
+                           donate_input: bool = False,
                            ) -> tuple[ClusterTensors, list[dict]]:
     """Sharded analogue of ``analyzer.chain.optimize_chain``: the whole
     chain in one dispatch over the mesh, same info-dict contract and error
@@ -713,7 +716,15 @@ def optimize_chain_sharded(state: ClusterTensors, chain,
     ``dispatch_rounds`` > 0 selects the bounded per-goal driver instead —
     same kernels and trajectory, ≤ that many search rounds per device
     dispatch (the large-cluster watchdog mitigation of
-    ``analyzer.chain.optimize_goal_in_chain``, under the mesh)."""
+    ``analyzer.chain.optimize_goal_in_chain``, under the mesh), driven as
+    donated megastep dispatches with asynchronous stats readback when
+    ``megastep`` (chain.MegastepConfig) asks for them. ``dispatch`` /
+    ``dispatch_wide`` pass the optimizer's persistent per-shape
+    controllers: deficit-sized count goals run wide-cost-class rounds
+    and are billed to ``dispatch_wide`` so they cannot overshoot (then
+    depress) the base-width budget. ``donate_input`` declares the
+    caller relinquishes ``state`` (e.g. a fresh shard_cluster
+    placement) so even the first dispatch may donate."""
     masks = masks or ExclusionMasks()
     goals = tuple(chain)
     if not goals:
@@ -724,12 +735,14 @@ def optimize_chain_sharded(state: ClusterTensors, chain,
     if dispatch_rounds > 0:
         return _optimize_chain_sharded_bounded(
             state, goals, constraint, cfg, num_topics, mesh, masks, presence,
-            swap_moves, swap_max_rounds, dispatch_rounds, dispatch_target_s)
+            swap_moves, swap_max_rounds, dispatch_rounds, dispatch_target_s,
+            dispatch=dispatch, dispatch_wide=dispatch_wide,
+            megastep=megastep, stats=stats, donate_input=donate_input)
     fn = _make_chain_full(mesh, goals, constraint, cfg, num_topics, presence,
                           swap_moves, swap_max_rounds)
-    state, stats = fn(state, masks)
-    stats = {k: jax.device_get(v) for k, v in stats.items()}
-    return state, _chain_infos_from_stats(goals, stats)
+    state, stats_dev = fn(state, masks)
+    stats_dev = {k: jax.device_get(v) for k, v in stats_dev.items()}
+    return state, _chain_infos_from_stats(goals, stats_dev)
 
 
 @lru_cache(maxsize=64)
@@ -776,7 +789,24 @@ def _make_chain_phase_kernels(mesh: Mesh, goals, constraint,
                                   constraint=constraint,
                                   num_topics=num_topics)
 
+    def move_body_donated(assignment, leader_slot, rest, masks, active_idx,
+                          prior_mask, budget):
+        state = dataclasses.replace(rest, assignment=assignment,
+                                    leader_slot=leader_slot)
+        st, total, rounds = move_body(state, masks, active_idx, prior_mask,
+                                      budget)
+        return st.assignment, st.leader_slot, total, rounds
+
+    def swap_body_donated(assignment, leader_slot, rest, masks, active_idx,
+                          prior_mask, budget):
+        state = dataclasses.replace(rest, assignment=assignment,
+                                    leader_slot=leader_slot)
+        st, total, rounds = swap_body(state, masks, active_idx, prior_mask,
+                                      budget)
+        return st.assignment, st.leader_slot, total, rounds
+
     mask_specs = _mask_specs(mask_presence)
+    part_a, part_l = mutable_state_specs()
     move = jax.jit(shard_map(
         move_body, mesh=mesh,
         in_specs=(_state_specs(), mask_specs, rep, rep, rep),
@@ -785,11 +815,26 @@ def _make_chain_phase_kernels(mesh: Mesh, goals, constraint,
         swap_body, mesh=mesh,
         in_specs=(_state_specs(), mask_specs, rep, rep, rep),
         out_specs=(_state_specs(), rep, rep), check_vma=False))
+    # Donated megastep variants (chain.chain_optimize_rounds_donated under
+    # the mesh): the two mutable tensors ride as separate donated
+    # arguments so XLA rewrites the sharded assignment in place — the
+    # read-only remainder (strip_mutable) keeps the topology tensors out
+    # of the donation set.
+    move_d = jax.jit(shard_map(
+        move_body_donated, mesh=mesh,
+        in_specs=(part_a, part_l, _state_specs(), mask_specs, rep, rep, rep),
+        out_specs=(part_a, part_l, rep, rep), check_vma=False),
+        donate_argnums=(0, 1))
+    swap_d = jax.jit(shard_map(
+        swap_body_donated, mesh=mesh,
+        in_specs=(part_a, part_l, _state_specs(), mask_specs, rep, rep, rep),
+        out_specs=(part_a, part_l, rep, rep), check_vma=False),
+        donate_argnums=(0, 1))
     stats = jax.jit(shard_map(
         stats_body, mesh=mesh,
         in_specs=(_state_specs(), mask_specs, rep),
         out_specs=(rep, rep, rep), check_vma=False))
-    return move, swap, stats
+    return move, swap, stats, move_d, swap_d
 
 
 def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
@@ -797,36 +842,78 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
                                     swap_moves, swap_max_rounds,
                                     dispatch_rounds: int,
                                     dispatch_target_s: float = 0.0,
+                                    dispatch=None, dispatch_wide=None,
+                                    megastep=None, stats=None,
+                                    donate_input: bool = False,
                                     ) -> tuple[ClusterTensors, list[dict]]:
     """Host-looped per-goal sharded driver: the trajectory of
     ``_chain_full_local`` with every device dispatch bounded — starting at
     ``dispatch_rounds`` search rounds and adaptively resized toward
-    ``dispatch_target_s`` of wall-clock per dispatch (AdaptiveDispatch)."""
-    import time as _time
-
-    from ..analyzer.chain import AdaptiveDispatch
-    move, swap, stats_fn = _make_chain_phase_kernels(
-        mesh, goals, constraint, cfg, num_topics, presence, swap_moves,
-        swap_max_rounds)
-    controller = AdaptiveDispatch(dispatch_rounds, dispatch_target_s)
+    ``dispatch_target_s`` of wall-clock per dispatch (AdaptiveDispatch;
+    ``dispatch`` passes the optimizer's persistent per-shape controller
+    so mesh precomputes keep their learned budget across passes), pumped
+    as donated megasteps with async stats readback per ``megastep``
+    (analyzer.chain machinery, shared verbatim)."""
+    from ..analyzer.chain import (
+        AdaptiveDispatch, deficit_sized_config, donation_enabled,
+        run_bounded_pass, strip_mutable,
+    )
+    controller = dispatch if dispatch is not None \
+        else AdaptiveDispatch(dispatch_rounds, dispatch_target_s)
+    donate = donation_enabled(megastep)
+    async_rb = bool(megastep.async_readback) if megastep is not None \
+        else False
+    deficit_cap = megastep.deficit_moves_cap if megastep is not None else 0
+    # Deficit-sized count goals run wide-cost-class rounds (sizing can
+    # multiply sources/moves 10-60x), so they get their OWN controller —
+    # the single-device path's narrow/wide split: a budget learned on
+    # cheap base-width rounds would overshoot the dispatch target by the
+    # width ratio on the first sized dispatch, then the halvings would
+    # depress the base-width budget, persisted across same-shape passes.
+    controller_wide = dispatch_wide if dispatch_wide is not None \
+        else (AdaptiveDispatch(dispatch_rounds, dispatch_target_s)
+              if deficit_cap > 0 else controller)
     per_goal = {name: [] for name in
                 ("viol_before", "obj_before", "offline_before", "viol_after",
                  "obj_after", "offline_after", "moves", "swaps", "rounds")}
+    base_kernels = _make_chain_phase_kernels(
+        mesh, goals, constraint, cfg, num_topics, presence, swap_moves,
+        swap_max_rounds)
+    stats_fn = base_kernels[2]
+    can_donate = [bool(donate_input)]
 
-    def run_pass(kernel, st, idx, prior, pass_cap: int):
-        applied_total, pass_rounds = 0, 0
-        while pass_rounds < pass_cap:
-            budget = controller.budget(pass_cap - pass_rounds)
-            t0 = _time.monotonic()
-            st, applied, r = kernel(st, masks, idx, prior,
-                                    jnp.int32(budget))
-            applied_total += int(applied)
-            r = int(r)
-            controller.observe(r, budget, _time.monotonic() - t0)
-            pass_rounds += r
-            if r < budget:
-                break
-        return st, applied_total, pass_rounds
+    def run_pass(kernels, phase, st, idx, prior, pass_cap: int, ctl):
+        move_k, _, _stats_k, move_d, _ = kernels
+        # Swap kernels always come from the BASE factory result: the swap
+        # bodies close over (swap_moves, swap_max_rounds) only — cfg never
+        # reaches them — so a deficit-sized width must not recompile the
+        # full-chain sharded swap programs.
+        _, swap_k, _, _, swap_d = base_kernels
+
+        def enqueue(st, budget: int):
+            b = jnp.int32(budget)
+            if donate:
+                if not can_donate[0]:
+                    # Caller retains the input: donate a sharding-
+                    # preserving copy of the two mutable tensors (the
+                    # plain-kernel fallback would compile every shard_map
+                    # program twice — see chain.optimize_goal_in_chain).
+                    st = dataclasses.replace(
+                        st, assignment=jnp.copy(st.assignment),
+                        leader_slot=jnp.copy(st.leader_slot))
+                k = move_d if phase == "move" else swap_d
+                a, l, applied, r = k(st.assignment, st.leader_slot,
+                                     strip_mutable(st), masks, idx, prior, b)
+                st = dataclasses.replace(st, assignment=a, leader_slot=l)
+            else:
+                k = move_k if phase == "move" else swap_k
+                st, applied, r = k(st, masks, idx, prior, b)
+            can_donate[0] = True
+            return st, applied, r, donate
+
+        return run_bounded_pass(enqueue, st, pass_cap, ctl,
+                                async_readback=async_rb, stats=stats,
+                                kind=phase)
 
     for g, goal in enumerate(goals):
         idx = jnp.int32(g)
@@ -835,6 +922,21 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
         per_goal["viol_before"].append(float(viol0))
         per_goal["obj_before"].append(float(obj0))
         per_goal["offline_before"].append(int(offline0))
+        # Deficit-aware sizing for count goals (chain.deficit_sized_config
+        # semantics): a sized config selects its own phase kernels — the
+        # lru_cached factory bounds the compile set to the pow2-quantized
+        # widths actually reached.
+        cfg_g = cfg
+        if deficit_cap > 0 and goal.count_based:
+            cfg_g = deficit_sized_config(cfg, float(viol0), deficit_cap)
+        kernels_g = base_kernels if cfg_g is cfg else \
+            _make_chain_phase_kernels(mesh, goals, constraint, cfg_g,
+                                      num_topics, presence, swap_moves,
+                                      swap_max_rounds)
+        # Both phases of a sized count goal bill to the wide controller
+        # (mirrors the single-device per-goal dispatch= routing).
+        ctl_g = controller_wide if (deficit_cap > 0 and goal.count_based) \
+            else controller
         moves_total = swaps_total = rounds = 0
         # The fused kernel's per-goal fast path: zero violations + no
         # offline replicas + no drain pending = skip entirely. Drain
@@ -849,14 +951,14 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
         ran = float(viol0) > 0 or int(offline0) > 0 or drain
         if ran:
             while rounds < cfg.max_rounds:
-                state, m_, r = run_pass(move, state, idx, prior,
-                                        cfg.max_rounds)
+                state, m_, r = run_pass(kernels_g, "move", state, idx,
+                                        prior, cfg.max_rounds, ctl_g)
                 moves_total += m_
                 rounds += r
                 if not goal.supports_swap:
                     break
-                state, sw, sr = run_pass(swap, state, idx, prior,
-                                         swap_max_rounds)
+                state, sw, sr = run_pass(kernels_g, "swap", state, idx,
+                                         prior, swap_max_rounds, ctl_g)
                 swaps_total += sw
                 rounds += sr
                 if sw == 0:
@@ -873,5 +975,8 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
         per_goal["swaps"].append(swaps_total)
         per_goal["rounds"].append(rounds)
     import numpy as np
-    stats = {kname: np.asarray(v) for kname, v in per_goal.items()}
-    return state, _chain_infos_from_stats(goals, stats)
+    # stats_np, not stats: the DispatchStats parameter must stay visible
+    # (the unbounded sibling renamed its local to stats_dev for the same
+    # reason).
+    stats_np = {kname: np.asarray(v) for kname, v in per_goal.items()}
+    return state, _chain_infos_from_stats(goals, stats_np)

@@ -52,6 +52,15 @@ def _state_specs() -> ClusterTensors:
         partition_mask=P(PARTITION_AXIS), broker_mask=P(), host=P())
 
 
+def mutable_state_specs() -> tuple:
+    """(assignment, leader_slot) specs — the two tensors the search
+    mutates, and therefore the EXACT donation set of the donated megastep
+    kernels (parallel.chain_sharded): they ride as separate donated
+    arguments while everything else travels read-only through
+    ``chain.strip_mutable``'s remainder."""
+    return P(PARTITION_AXIS), P(PARTITION_AXIS)
+
+
 def shard_cluster(state: ClusterTensors, mesh: Mesh) -> ClusterTensors:
     """Place a ClusterTensors on the mesh with the partition axis sharded.
     Partition count must divide the mesh size (pad via the builder's

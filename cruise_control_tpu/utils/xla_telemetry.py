@@ -127,6 +127,37 @@ def install(enabled: bool = True) -> bool:
         return True
 
 
+# Rounds-per-dispatch are megastep budgets: pow2-ish from 1 to the
+# AdaptiveDispatch ceiling (1024).
+DISPATCH_ROUND_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                          256.0, 512.0, 1024.0)
+
+
+def record_dispatch(kind: str, rounds: int, donated: bool = False,
+                    speculative: bool = False) -> None:
+    """Account one solver device dispatch (a bounded megastep or a fused
+    whole-pass execution): counters by kind (move/swap/chain), donation
+    and speculative (async post-convergence no-op) tallies, and the
+    rounds-per-dispatch histogram the bench reads its p50 from. The
+    ambient trace span (goal.solve) gets a dispatch tally so traces show
+    how many XLA executions a goal cost."""
+    from .tracing import TRACER
+    span = TRACER.current_span()
+    if span is not None:
+        span.attributes["dispatches"] = \
+            int(span.attributes.get("dispatches", 0)) + 1
+    if not _enabled:
+        return
+    labels = {"kind": kind}
+    SENSORS.count("solver_dispatches", labels=labels)
+    SENSORS.observe("solver_dispatch_rounds", float(rounds), labels=labels,
+                    buckets=DISPATCH_ROUND_BUCKETS)
+    if donated:
+        SENSORS.count("solver_dispatch_donations", labels=labels)
+    if speculative:
+        SENSORS.count("solver_dispatch_speculative", labels=labels)
+
+
 def record_transfer(nbytes: int, direction: str = "h2d",
                     source: str = "model_refresh") -> None:
     """Account one host↔device transfer: counters + the ambient span's
