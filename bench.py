@@ -92,6 +92,17 @@ BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "780"))
 # skipped (stages are ordered smallest-first, so a bigger stage cannot
 # fit where a smaller one expired).
 
+# --scenarios: run the digital-twin canonical scenario library
+# (testing/simulator.py) instead of the perf stages — one JSON line per
+# scenario with the ScenarioScore extras the CI SCENARIO_MATRIX table
+# reads. Same watchdog discipline: each scenario gets a prorated
+# deadline and emits a stage_partial_* record on expiry.
+SCENARIO_MODE = "--scenarios" in sys.argv or bool(
+    os.environ.get("BENCH_SCENARIOS"))
+SCENARIO_SEED = int(os.environ.get("BENCH_SCENARIO_SEED", "0"))
+# 0 = each scenario's full spec horizon.
+SCENARIO_TICKS = int(os.environ.get("BENCH_SCENARIO_TICKS", "0"))
+
 
 # Journal of every emitted line, re-printed at exit (even via the watchdog
 # hard-exit) so the final stdout tail always contains every completed stage.
@@ -110,11 +121,13 @@ def _emit_summary_tail() -> None:
     try:
         stages = [o for o in _EMITTED
                   if str(o.get("metric", "")).startswith(
-                      ("rebalance_proposal_wall_clock", "stage_partial"))]
+                      ("rebalance_proposal_wall_clock", "stage_partial",
+                       "scenario_"))]
         for o in stages:
             print(json.dumps(o), flush=True)
         completed = [o for o in stages
-                     if str(o["metric"]).startswith("rebalance")]
+                     if str(o["metric"]).startswith(
+                         ("rebalance", "scenario_"))]
         headline = completed[-1] if completed else None
         print(json.dumps({
             "metric": "bench_summary",
@@ -305,6 +318,90 @@ def _degraded_cycle_probe(seed: int = 11) -> dict:
     return {"degraded_cycle_s": round(r["elapsed_s"], 4),
             "degraded_cycle_converged": r["converged"],
             "degraded_cycle_faults_injected": r["faults_injected"]}
+
+
+def _scenario_record(name: str, seed: int, ticks: int | None) -> dict:
+    """Run one canonical scenario on the digital twin and flatten its
+    ScenarioScore into the extras the SCENARIO_MATRIX table reads."""
+    from cruise_control_tpu.testing.simulator import run_scenario
+    r = run_scenario(name, seed=seed, ticks=ticks)
+    d = r.score.as_dict()
+    return {
+        "metric": f"scenario_{name}",
+        "value": round(r.wall_s, 3),
+        "unit": "s",
+        # >0 = every SLO held; the matrix table prints the violation list.
+        "vs_baseline": 0.0 if d["sloViolations"] else 1.0,
+        "extras": {
+            "scenario": name, "seed": seed,
+            "ticks": d["ticks"], "sim_hours": d["simHours"],
+            "replica_moves": d["churn"]["replicaMoves"],
+            "leader_moves": d["churn"]["leaderMoves"],
+            "bytes_mb_per_simhour": d["churn"]["bytesMbPerSimHour"],
+            "moves_per_simhour": d["churn"]["movesPerSimHour"],
+            "time_to_heal_p95_ticks": d["heal"]["p95Ticks"],
+            "unhealed_faults": d["heal"]["unhealed"],
+            "dead_letters": d["deadLetters"],
+            "stale_served": d["degraded"]["staleServed"],
+            "degraded_ticks": d["degraded"]["degradedTicks"],
+            "balancedness_final": d["balancedness"]["final"],
+            "events_applied": d["eventsApplied"],
+            "faults_injected": d["faultsInjected"],
+            "slo_violations": d["sloViolations"],
+            "assignment_digest": r.assignment_digest,
+        },
+    }
+
+
+def _run_scenario_matrix(deadline: float) -> int:
+    """The --scenarios mode body: every canonical scenario under the same
+    per-stage prorated-deadline discipline as the perf stages (weights =
+    simulated ticks ≈ cost), so the matrix can NEVER ride one slow
+    scenario into an external rc=124 kill."""
+    from cruise_control_tpu.testing.simulator import CANONICAL_SCENARIOS
+    items = sorted(CANONICAL_SCENARIOS.items(),
+                   key=lambda kv: kv[1].ticks)
+    for i, (name, spec) in enumerate(items):
+        remaining = deadline - time.time()
+        if remaining < 45:
+            # No silent caps: every un-run scenario still leaves a
+            # parseable record, so the CI matrix can tell "skipped for
+            # budget" apart from "never existed".
+            for skipped_name, _s in items[i:]:
+                _emit({"metric": f"stage_partial_scenario_{skipped_name}",
+                       "value": 0.0, "unit": "s", "vs_baseline": 0.0,
+                       "extras": {"scenario": skipped_name,
+                                  "partial": True, "skipped": True,
+                                  "reason": "budget exhausted"}})
+            break
+        weights = [s.ticks for _n, s in items[i:]]
+        stage_budget = min(remaining - 15.0,
+                           max(60.0, remaining * weights[0] / sum(weights)))
+        t0 = time.time()
+        signal.alarm(max(1, int(stage_budget)))
+        try:
+            record = _scenario_record(
+                name, SCENARIO_SEED, SCENARIO_TICKS or None)
+            signal.alarm(0)
+            _emit(record)
+        except _Watchdog:
+            _emit({"metric": f"stage_partial_scenario_{name}",
+                   "value": round(time.time() - t0, 3), "unit": "s",
+                   "vs_baseline": 0.0,
+                   "extras": {"scenario": name, "partial": True,
+                              "stage_budget_s": round(stage_budget, 1)}})
+            continue
+        except Exception as e:  # noqa: BLE001 — a crashed scenario must
+            # still leave a parseable record; the library is independent
+            # per scenario, so keep going.
+            _emit({"metric": "stage_failed", "value": round(
+                time.time() - t0, 3), "unit": "s", "vs_baseline": 0.0,
+                "extras": {"stage": f"scenario_{name}",
+                           "error": f"{type(e).__name__}: {e}"[:500]}})
+            continue
+        finally:
+            signal.alarm(0)
+    return 0
 
 
 _QUANTILE_SPANS = ("analyzer.optimize", "goal.solve", "model.assemble",
@@ -514,6 +611,21 @@ def _guarded_main(deadline: float) -> int:
         pass
     TRACER.configure(enabled=True, jsonl_path=trace_file)
     _xla_install()
+    if SCENARIO_MODE:
+        # Scenario matrix replaces the perf stages AND the overhead
+        # probes: the whole budget belongs to the digital twin (each
+        # scenario.run span still lands in the JSONL artifact).
+        _emit({"metric": "bench_bootstrap",
+               "value": round(time.time() - t0, 3), "unit": "s",
+               "vs_baseline": 1.0,
+               "extras": {"device": device, "num_devices": n_dev,
+                          "mode": "scenarios",
+                          "scenario_seed": SCENARIO_SEED,
+                          "scenario_ticks": SCENARIO_TICKS or "spec",
+                          "compile_cache_dir": cache_dir,
+                          "trace_file": trace_file,
+                          "stderr_file": _stderr_path}})
+        return _run_scenario_matrix(deadline)
     noop_ns = _tracing_noop_overhead_ns()
     _emit({"metric": "tracing_noop_span_overhead", "value": round(noop_ns, 1),
            "unit": "ns", "vs_baseline": 1.0,

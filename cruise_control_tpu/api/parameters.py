@@ -85,6 +85,13 @@ _PROPOSAL_PARAMS = {**_GOALS_PARAMS, "ignore_proposal_cache": _bool,
                     "data_from": _str, "excluded_topics": _csv,
                     "kafka_assigner": _bool, "rebalance_disk": _bool}
 
+# Digital-twin what-if replay (testing/simulator.py): a PROPOSALS request
+# with what_if=<scenario> runs the named canonical scenario on a
+# simulated twin and returns the scored trajectory — a time-dimension
+# extension of the dry run; it never executes anything.
+_WHAT_IF_PARAMS = {"what_if": _str, "what_if_seed": _int,
+                   "what_if_ticks": _int}
+
 _EXECUTION_PARAMS = {
     "dryrun": _bool, "concurrent_partition_movements_per_broker": _int,
     "max_partition_movements_in_cluster": _int,
@@ -109,7 +116,7 @@ SCHEMAS: dict[EndPoint, dict[str, Callable[[str], Any]]] = {
                               "min_valid_partition_ratio": _float,
                               "allow_capacity_estimation": _bool,
                               "brokerid": _int_csv},
-    EndPoint.PROPOSALS: _PROPOSAL_PARAMS,
+    EndPoint.PROPOSALS: {**_PROPOSAL_PARAMS, **_WHAT_IF_PARAMS},
     EndPoint.STATE: {"substates": _csv, "super_verbose": _bool},
     EndPoint.KAFKA_CLUSTER_STATE: {"topic": _str},
     EndPoint.USER_TASKS: {"user_task_ids": _csv, "client_ids": _csv,

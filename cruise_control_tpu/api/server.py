@@ -701,15 +701,40 @@ class CruiseControlApi:
                     p["min_isr_based_concurrency_adjustment"])
         dropped_removed = p.get("drop_recently_removed_brokers", ())
         if dropped_removed:
-            with cc.excluded_sets_lock:
-                cc.recently_removed_brokers -= set(dropped_removed)
+            cc.drop_recently_removed_brokers(dropped_removed)
             changed["droppedRecentlyRemoved"] = sorted(dropped_removed)
         dropped_demoted = p.get("drop_recently_demoted_brokers", ())
         if dropped_demoted:
-            with cc.excluded_sets_lock:
-                cc.recently_demoted_brokers -= set(dropped_demoted)
+            cc.drop_recently_demoted_brokers(dropped_demoted)
             changed["droppedRecentlyDemoted"] = sorted(dropped_demoted)
         return responses.envelope(changed or {"message": "no admin action given"})
+
+    def _what_if_handler(self, cc: CruiseControl, p: dict) -> dict:
+        """PROPOSALS ``?what_if=<scenario>``: replay a canonical scenario
+        on the digital twin (testing/simulator.py) and return the scored
+        trajectory — the time-dimension extension of the proposals dry
+        run. The simulator wires its OWN backend/executor, so this
+        cluster's executor state is never touched; tick counts are capped
+        by ``scenario.what.if.max.ticks`` since a replay is real solver
+        work."""
+        from ..testing.simulator import CANONICAL_SCENARIOS, run_scenario
+        name = p["what_if"]
+        if name not in CANONICAL_SCENARIOS:
+            raise ParameterParseError(
+                f"unknown what_if scenario {name!r}; expected one of "
+                f"{', '.join(sorted(CANONICAL_SCENARIOS))}")
+        cap = cc.config.get_int("scenario.what.if.max.ticks")
+        ticks = p.get("what_if_ticks")
+        ticks = min(CANONICAL_SCENARIOS[name].ticks, cap) if ticks is None \
+            else max(1, min(int(ticks), cap))
+        seed = p.get("what_if_seed", 0)
+        result = run_scenario(name, seed=seed, ticks=ticks)
+        return responses.envelope({
+            "operation": "what_if", "dryrun": True, "executed": False,
+            "scenario": name, "seed": seed, "ticks": ticks,
+            "score": result.score.as_dict(),
+            "finalAssignmentDigest": result.assignment_digest,
+            "events": result.events})
 
     def _sanity_check_hard_goals(self, endpoint: EndPoint, p: dict,
                                  cc: CruiseControl | None = None) -> None:
@@ -830,6 +855,8 @@ class CruiseControlApi:
         allow_cap = p.get("allow_capacity_estimation", True)
 
         def proposals():
+            if p.get("what_if"):
+                return self._what_if_handler(cc, p)
             return responses.optimization_result(cc.proposals(
                 goals, p.get("ignore_proposal_cache", False),
                 use_ready_default_goals=use_ready, fast_mode=fast_mode,

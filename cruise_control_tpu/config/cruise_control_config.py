@@ -480,6 +480,37 @@ def _definition() -> ConfigDef:
              "Per-call probability that alive_brokers transiently "
              "omits one deterministic broker (flap injection; opt-in — "
              "flapped destinations DEAD-mark in-flight tasks).")
+    # --- Digital-twin scenario harness (testing/simulator.py, round 11) ---
+    d.define("scenario.tick.seconds", T.DOUBLE, 60.0, Range.at_least(0.001),
+             I.LOW,
+             "Simulated seconds each digital-twin tick advances the "
+             "injected clock (the scenario harness's time step).")
+    d.define("scenario.default.ticks", T.INT, 120, Range.at_least(1), I.LOW,
+             "Default number of simulated ticks a scenario runs when the "
+             "caller does not override it.")
+    d.define("scenario.what.if.max.ticks", T.INT, 240, Range.at_least(1),
+             I.LOW,
+             "Cap on the tick count a PROPOSALS ?what_if= request may ask "
+             "for (a what-if replay is real solver work; unbounded ticks "
+             "would let one request monopolize the device).")
+    d.define("scenario.slo.balancedness.min", T.DOUBLE, 75.0,
+             Range.between(0, 100), I.LOW,
+             "Quality SLO floor: a tick whose balancedness score sits "
+             "below this (once detection has scored at all) counts as an "
+             "SLO violation in the scenario report.")
+    d.define("scenario.slo.heal.ticks", T.INT, 30, Range.at_least(1), I.LOW,
+             "Stability SLO: an injected fault not healed within this "
+             "many ticks — or never healed — is an SLO violation.")
+    d.define("scenario.slo.moves.per.simhour", T.DOUBLE, 0.0,
+             Range.at_least(0), I.LOW,
+             "Churn SLO: replica moves per simulated hour above this "
+             "rate are an SLO violation (0 disables the churn SLO).")
+    d.define("scenario.proposal.probe.ticks", T.INT, 10, Range.at_least(0),
+             I.LOW,
+             "Every N simulated ticks the scenario harness issues a "
+             "client-style proposals() probe so degraded serving "
+             "(stale=true responses, model-build failures) is part of "
+             "the scored trajectory (0 disables probing).")
     d.define("goal.violation.distribution.threshold.multiplier", T.DOUBLE, 1.0,
              Range.at_least(1), I.LOW,
              "Detector-triggered balance-threshold relaxation.")

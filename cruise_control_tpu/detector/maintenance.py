@@ -99,10 +99,15 @@ class IdempotenceCache:
 class MaintenanceEventDetector:
     def __init__(self, reader: MaintenanceEventReader,
                  report: Callable[[MaintenanceEvent], None],
-                 idempotence_retention_ms: int = 3_600_000):
+                 idempotence_retention_ms: int = 3_600_000,
+                 now_ms: Callable[[], int] | None = None):
+        # ``now_ms`` is the idempotence window's clock seam: the simulator
+        # injects simulated time so duplicate-plan suppression ages out on
+        # sim time, not wall time. Default (None) stays wall clock.
         self._reader = reader
         self._report = report
-        self._cache = IdempotenceCache(idempotence_retention_ms)
+        self._cache = IdempotenceCache(idempotence_retention_ms,
+                                       now_ms=now_ms)
 
     def run_once(self) -> list[MaintenanceEvent]:
         out = []

@@ -15,7 +15,7 @@ import logging
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any
+from typing import Any, Callable
 
 from ..config.cruise_control_config import CruiseControlConfig
 from .anomaly import Anomaly, AnomalyType
@@ -48,10 +48,19 @@ class AnomalyDetectorManager:
 
     def __init__(self, config: CruiseControlConfig | None = None,
                  notifier: AnomalyNotifier | None = None,
-                 facade: Any = None):
+                 facade: Any = None,
+                 clock: "Callable[[], float] | None" = None):
         self._config = config or CruiseControlConfig()
         self._notifier = notifier or SelfHealingNotifier(self._config)
         self._facade = facade
+        # Injectable clock (round 11): every time comparison in the fix
+        # pipeline — recheck due times, record timestamps, the detector
+        # breaker's recovery window, and run_due() tick scheduling — reads
+        # THIS clock, so the digital-twin simulator can drive anomaly
+        # detection on simulated time. Default is wall clock: production
+        # behavior is unchanged (the scheduler threads still pace
+        # themselves on Event.wait).
+        self._clock = clock or time.time
         # Detector isolation (round 9): a detector that keeps crashing
         # trips its own breaker and is SKIPPED until the recovery window
         # elapses — one broken detector must neither kill its scheduler
@@ -59,7 +68,8 @@ class AnomalyDetectorManager:
         # its interval stack-tracing forever.
         from ..utils.resilience import CircuitBreaker
         self._detector_breaker = CircuitBreaker.from_config(
-            self._config, name="detector")
+            self._config, name="detector",
+            clock=clock if clock is not None else time.monotonic)
         self._detectors: list[tuple[Any, float]] = []   # (detector, interval_s)
         self._queue: list[tuple[tuple[int, int], int, Anomaly]] = []
         self._queue_seq = 0
@@ -71,6 +81,10 @@ class AnomalyDetectorManager:
         self._num_self_healing_started = 0
         self._num_fix_failures = 0
         self._recheck: list[tuple[float, Anomaly]] = []  # (due time s, anomaly)
+        # run_due() schedule: detector index → next due time on the
+        # injected clock (the simulator's synchronous replacement for the
+        # per-detector scheduler threads).
+        self._next_due: dict[int, float] = {}
         # Optional fix-dispatch hook: callable(fn) -> fn's result. A fleet
         # registry points this at the FleetScheduler (SELF_HEALING
         # priority) so one device serves every cluster's fixes in
@@ -87,7 +101,8 @@ class AnomalyDetectorManager:
         from ..utils.sensors import SENSORS
         SENSORS.count("anomaly_detector_anomalies", labels={
             "type": anomaly.anomaly_type.name})
-        rec = AnomalyRecord(anomaly)
+        rec = AnomalyRecord(anomaly,
+                            status_time_ms=int(self._clock() * 1000))
         with self._cv:
             self._records[anomaly.anomaly_id] = rec
             self._history.append(rec)
@@ -154,27 +169,74 @@ class AnomalyDetectorManager:
             breaker.record_success(name)
         return True
 
+    # -- simulated-time driving (digital-twin simulator, round 11) ---------
+    def run_due(self, now_s: float | None = None) -> int:
+        """Run every detector whose interval has elapsed on the injected
+        clock — the synchronous replacement for the per-detector scheduler
+        threads (testing/simulator.py drives this once per simulated
+        tick). First sight of a detector schedules it one interval out,
+        matching ``_detector_loop``'s wait-then-run pacing. Returns the
+        number of detectors run this call."""
+        now = self._clock() if now_s is None else now_s
+        ran = 0
+        for i, (det, interval_s) in enumerate(self._detectors):
+            due = self._next_due.get(i)
+            if due is None:
+                self._next_due[i] = now + interval_s
+                continue
+            if now >= due:
+                self.run_detector_once(det)
+                self._next_due[i] = now + interval_s
+                ran += 1
+        return ran
+
+    def drain_anomalies(self, max_anomalies: int = 1000) -> int:
+        """Synchronously drain due rechecks + the fix queue on the
+        injected clock (the handler thread's job, callable without any
+        thread for wall-clock-free simulation). Returns the number of
+        anomalies handled."""
+        handled = 0
+        while handled < max_anomalies:
+            with self._cv:
+                self._promote_due_rechecks(self._clock())
+                anomaly = heapq.heappop(self._queue)[2] if self._queue \
+                    else None
+            if anomaly is None:
+                return handled
+            try:
+                self.handle_anomaly(anomaly)
+            except Exception:  # noqa: BLE001 — same contract as the
+                # handler loop: one broken anomaly must not stop the drain
+                LOG.exception("anomaly handler failed for %s",
+                              getattr(anomaly, "anomaly_id", anomaly))
+            handled += 1
+        return handled
+
     # -- the handler (AnomalyHandlerTask, :343) ----------------------------
+    def _promote_due_rechecks(self, now: float) -> None:
+        """Move due CHECK_WITH_DELAY anomalies back onto the queue,
+        dropping any whose condition cleared meanwhile (e.g. the failed
+        broker recovered) instead of fixing a stale snapshot. Caller must
+        hold ``_cv``."""
+        while self._recheck and self._recheck[0][0] <= now:
+            _due, anomaly = heapq.heappop(self._recheck)
+            if self._facade is not None and \
+                    not anomaly.still_valid(self._facade):
+                rec = self._records.get(anomaly.anomaly_id)
+                if rec is not None:
+                    rec.status = AnomalyStatus.IGNORED
+                continue
+            heapq.heappush(self._queue, (
+                (anomaly.anomaly_type.priority, anomaly.detection_time_ms),
+                self._queue_seq, anomaly))
+            self._queue_seq += 1
+
     def _take(self, timeout_s: float) -> Anomaly | None:
-        deadline = time.time() + timeout_s
+        deadline = self._clock() + timeout_s
         with self._cv:
             while True:
-                now = time.time()
-                while self._recheck and self._recheck[0][0] <= now:
-                    _due, anomaly = heapq.heappop(self._recheck)
-                    # Drop parked anomalies whose condition cleared meanwhile
-                    # (e.g. the failed broker recovered) instead of fixing a
-                    # stale snapshot.
-                    if self._facade is not None and \
-                            not anomaly.still_valid(self._facade):
-                        rec = self._records.get(anomaly.anomaly_id)
-                        if rec is not None:
-                            rec.status = AnomalyStatus.IGNORED
-                        continue
-                    heapq.heappush(self._queue, (
-                        (anomaly.anomaly_type.priority, anomaly.detection_time_ms),
-                        self._queue_seq, anomaly))
-                    self._queue_seq += 1
+                now = self._clock()
+                self._promote_due_rechecks(now)
                 if self._queue:
                     return heapq.heappop(self._queue)[2]
                 if self._stop.is_set() or now >= deadline:
@@ -213,12 +275,13 @@ class AnomalyDetectorManager:
         elif result.action is AnomalyNotificationAction.CHECK:
             rec.status = AnomalyStatus.CHECK_WITH_DELAY
             with self._cv:
-                heapq.heappush(self._recheck,
-                               (time.time() + result.delay_ms / 1000.0, anomaly))
+                heapq.heappush(
+                    self._recheck,
+                    (self._clock() + result.delay_ms / 1000.0, anomaly))
                 self._cv.notify_all()
         else:
             rec.status = self._fix(anomaly)
-        rec.status_time_ms = int(time.time() * 1000)
+        rec.status_time_ms = int(self._clock() * 1000)
         return rec.status
 
     def _fix(self, anomaly: Anomaly) -> str:

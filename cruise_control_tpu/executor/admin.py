@@ -109,6 +109,75 @@ class InMemoryAdminBackend:
             self._alive.add(broker)
             self._meta_gen += 1
 
+    def create_topic(self, topic: str, num_partitions: int, rf: int = 2,
+                     brokers: Sequence[int] | None = None) -> None:
+        """Topic-churn control (digital-twin simulator): add a topic with
+        ``num_partitions`` partitions spread round-robin over the alive
+        brokers (or an explicit ``brokers`` list). Structural change →
+        metadata generation bump."""
+        with self._lock:
+            pool = sorted(self._alive) if brokers is None else list(brokers)
+            if not pool:
+                raise ValueError("create_topic: no alive brokers")
+            eff_rf = min(rf, len(pool))
+            for p in range(num_partitions):
+                reps = tuple(pool[(p + k) % len(pool)] for k in range(eff_rf))
+                self._parts[(topic, p)] = PartitionState(
+                    topic, p, reps, reps[0], isr=reps)
+                if hasattr(self, "_logdirs"):
+                    for i, b in enumerate(reps):
+                        dirs = sorted(self._logdirs.get(b, {}))
+                        if dirs:
+                            self._replica_dirs[(topic, p, b)] = \
+                                dirs[(p + i) % len(dirs)]
+            self._meta_gen += 1
+
+    def delete_topic(self, topic: str) -> int:
+        """Topic-churn control: drop every partition of ``topic`` (and its
+        pending dir moves / dir placements). Returns partitions removed."""
+        with self._lock:
+            keys = [k for k in self._parts if k[0] == topic]
+            for k in keys:
+                del self._parts[k]
+            for store in (self._pending_dir_moves,
+                          getattr(self, "_replica_dirs", {})):
+                for k in [k for k in store if k[0] == topic]:
+                    del store[k]
+            if keys:
+                self._meta_gen += 1
+            return len(keys)
+
+    def expand_partitions(self, topic: str, new_count: int) -> int:
+        """Topic-churn control: grow ``topic`` to ``new_count`` partitions
+        (Kafka partition expansion — existing partitions untouched, new
+        ones placed round-robin on alive brokers at the topic's RF).
+        Returns the number of partitions added."""
+        with self._lock:
+            existing = sorted(p for (t, p) in self._parts if t == topic)
+            if not existing:
+                raise ValueError(f"expand_partitions: unknown topic {topic!r}")
+            rf = len(self._parts[(topic, existing[0])].replicas)
+            pool = sorted(self._alive)
+            added = 0
+            for p in range(existing[-1] + 1, new_count):
+                reps = tuple(pool[(p + k) % len(pool)]
+                             for k in range(min(rf, len(pool))))
+                self._parts[(topic, p)] = PartitionState(
+                    topic, p, reps, reps[0], isr=reps)
+                if hasattr(self, "_logdirs"):
+                    # Same placement rule as create_topic: expanded
+                    # partitions must be visible to disk-health checks
+                    # and intra-broker moves on JBOD clusters.
+                    for i, b in enumerate(reps):
+                        dirs = sorted(self._logdirs.get(b, {}))
+                        if dirs:
+                            self._replica_dirs[(topic, p, b)] = \
+                                dirs[(p + i) % len(dirs)]
+                added += 1
+            if added:
+                self._meta_gen += 1
+            return added
+
     def tick(self) -> None:
         """Advance the simulated cluster one progress interval."""
         with self._lock:

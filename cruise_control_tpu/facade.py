@@ -24,7 +24,7 @@ import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Any, Mapping, Sequence
+from typing import Any, Callable, Mapping, Sequence
 
 import numpy as np
 
@@ -112,8 +112,19 @@ class CruiseControl:
                  load_monitor: LoadMonitor | None = None,
                  executor: Executor | None = None,
                  notifier: AnomalyNotifier | None = None,
-                 optimizer: GoalOptimizer | None = None):
+                 optimizer: GoalOptimizer | None = None,
+                 clock: "Callable[[], float] | None" = None,
+                 configure_observability: bool = True):
         self._config = config
+        # Injectable clock (round 11): when given, simulated time drives
+        # every detector-pipeline time comparison — anomaly tick
+        # scheduling, broker-failure escalation thresholds, maintenance
+        # idempotence windows, and the model breaker's recovery window —
+        # so the digital-twin simulator replays hours of cluster drift
+        # wall-clock-free. None (production) keeps wall time everywhere.
+        self._clock = clock
+        self._now_ms = (lambda: int(clock() * 1000)) \
+            if clock is not None else None
         # Chaos harness (round 9): ``chaos.enabled=true`` wraps the admin
         # backend in the deterministic fault injector — game-day drills
         # run the REAL pipeline against injected timeouts/transients/
@@ -132,20 +143,28 @@ class CruiseControl:
         # path's stale-cache fallback below.
         from .utils.resilience import CircuitBreaker, RetryPolicy
         self._retry_policy = RetryPolicy.from_config(config)
-        self._model_breaker = CircuitBreaker.from_config(config, name="model")
+        self._model_breaker = CircuitBreaker.from_config(
+            config, name="model",
+            clock=clock if clock is not None else time.monotonic)
         # Observability wiring (round 8): one process-wide tracer,
         # (re)configured from each facade's config — fleet overlays
         # inherit the tracing.* keys from the base config, and per-cluster
         # attribution comes from the ambient cluster label, not from
         # per-facade tracers. XLA telemetry hooks jax.monitoring once.
-        from .utils import xla_telemetry
-        from .utils.tracing import TRACER
-        TRACER.configure(
-            enabled=config.get_boolean("tracing.enabled"),
-            max_traces=config.get_int("tracing.max.traces"),
-            jsonl_path=config.get("tracing.jsonl.path") or None)
-        xla_telemetry.install(
-            enabled=config.get_boolean("xla.telemetry.enabled"))
+        # ``configure_observability=False`` (digital-twin simulators,
+        # other EMBEDDED facades) leaves the process-wide tracer/telemetry
+        # exactly as the HOST configured them: a ?what_if= replay must not
+        # rewrite the serving process's tracing settings, and bench
+        # --scenarios must keep its own JSONL dump path.
+        if configure_observability:
+            from .utils import xla_telemetry
+            from .utils.tracing import TRACER
+            TRACER.configure(
+                enabled=config.get_boolean("tracing.enabled"),
+                max_traces=config.get_int("tracing.max.traces"),
+                jsonl_path=config.get("tracing.jsonl.path") or None)
+            xla_telemetry.install(
+                enabled=config.get_boolean("xla.telemetry.enabled"))
         self._load_monitor = load_monitor or LoadMonitor(config, admin)
         self._executor = executor or Executor(
             admin,
@@ -180,17 +199,32 @@ class CruiseControl:
         # runs the SAME GoalOptimizer (and device/mesh), so bucketed
         # shapes land in one compiled-kernel set.
         self._optimizer = optimizer or GoalOptimizer(config)
-        self._notifier = notifier or SelfHealingNotifier(config)
+        self._notifier = notifier or SelfHealingNotifier(
+            config, now_ms=self._now_ms)
         self._anomaly_detector = AnomalyDetectorManager(
-            config, self._notifier, facade=self)
+            config, self._notifier, facade=self, clock=self._clock)
         self.maintenance_reader = self._configured_maintenance_reader(config)
         # Executor.java demotion/removal history consumed by the
         # exclude_recently_* request parameters and the ADMIN drop_* params;
-        # initialized BEFORE detector wiring, which shares the live sets.
-        self.recently_removed_brokers: set[int] = set()
-        self.recently_demoted_brokers: set[int] = set()
-        # Guards ALL reads/writes of the two sets above (API threads mutate
-        # them; the detection thread snapshots them).
+        # initialized BEFORE detector wiring, which shares the live
+        # history. Entries are TIMESTAMPED and expire after
+        # *.history.retention.time.ms on the injected clock (reference
+        # parity: Executor.java removalHistory/demotionHistory retention).
+        # The digital-twin multi_az_failure scenario surfaced why a bare
+        # set is wrong: a self-healed broker removal excluded the broker
+        # from replica moves FOREVER, so after the failed AZ revived,
+        # goal-violation detection reported "unfixable
+        # ReplicaDistributionGoal" endlessly instead of rebalancing onto
+        # the recovered brokers.
+        self._removal_history: dict[int, int] = {}   # broker -> stamp ms
+        self._demotion_history: dict[int, int] = {}
+        self._removal_retention_ms = config.get_long(
+            "removal.history.retention.time.ms")
+        self._demotion_retention_ms = config.get_long(
+            "demotion.history.retention.time.ms")
+        # Guards ALL reads/writes of the two histories above (API threads
+        # mutate them; the detection thread snapshots them). Taken INSIDE
+        # the recently_*_brokers properties — callers must not hold it.
         self.excluded_sets_lock = threading.Lock()
         from .analyzer.plugins import (
             compile_excluded_topics_pattern, options_generator_from_config,
@@ -260,20 +294,20 @@ class CruiseControl:
             cfg, self._load_monitor, self._optimizer, report)
 
         # Detection excludes the same recently-removed/demoted brokers the
-        # user-facing operations do — snapshotted under the facade's lock
-        # so the detection thread never iterates a set an API thread is
-        # mutating.
+        # user-facing operations do — the history properties snapshot
+        # under the facade's lock, so the detection thread never iterates
+        # a dict an API thread is mutating.
         def _excluded_snapshot():
-            with self.excluded_sets_lock:
-                return (tuple(self.recently_demoted_brokers),
-                        tuple(self.recently_removed_brokers))
+            return (tuple(sorted(self.recently_demoted_brokers)),
+                    tuple(sorted(self.recently_removed_brokers)))
 
         self.goal_violation_detector.excluded_brokers_supplier = \
             _excluded_snapshot
         mgr.add_detector(self.goal_violation_detector, interval)
         mgr.add_detector(BrokerFailureDetector(
             self._admin, report,
-            failed_brokers_file_path=cfg.get("failed.brokers.file.path")),
+            failed_brokers_file_path=cfg.get("failed.brokers.file.path"),
+            now_ms=self._now_ms),
             interval)
         mgr.add_detector(DiskFailureDetector(self._admin, report), interval)
         mgr.add_detector(MetricAnomalyDetector(
@@ -290,7 +324,8 @@ class CruiseControl:
             idem_retention = 0  # zero-retention cache never matches
         mgr.add_detector(MaintenanceEventDetector(
             self.maintenance_reader, report,
-            idempotence_retention_ms=idem_retention), interval)
+            idempotence_retention_ms=idem_retention,
+            now_ms=self._now_ms), interval)
 
     def _on_execution_sampling_change(self, executing: bool) -> None:
         """Executor.java:1408-1424 — reduce sampling scope during moves and
@@ -723,16 +758,23 @@ class CruiseControl:
                     LOG.warning("proposal computation failed; serving the "
                                 "last good cached proposals as STALE",
                                 exc_info=True)
+                    # staleness_s: age of the entry being served degraded
+                    # (cache stamps are wall time regardless of the sim
+                    # clock — the cache itself lives on wall time). The
+                    # SLO scorer and clients both read it: degraded
+                    # serving is only an SLO if its DURATION is visible.
+                    staleness_s = round(time.time() - cached[1], 3)
                     from .utils.sensors import SENSORS
                     SENSORS.count("proposals_stale_served")
+                    SENSORS.gauge("proposals_stale_age_seconds", staleness_s)
                     from .utils.tracing import TRACER
-                    TRACER.annotate(stale=True)
+                    TRACER.annotate(stale=True, staleness_s=staleness_s)
                     return OperationResult(
                         "proposals", dryrun=True, optimizer_result=cached[2],
                         proposals=cached[2].proposals,
                         reason="stale cache fallback "
                                f"({type(e).__name__}: {e})",
-                        extra={"stale": True})
+                        extra={"stale": True, "staleness_s": staleness_s})
                 if breaker is not None:
                     breaker.record_success(target)
                 with self._proposal_lock:
@@ -740,6 +782,52 @@ class CruiseControl:
         return OperationResult("proposals", dryrun=True,
                                optimizer_result=result,
                                proposals=result.proposals)
+
+    # -- removal/demotion history (Executor.java retention parity) ---------
+    def _history_now_ms(self) -> int:
+        return self._now_ms() if self._now_ms is not None \
+            else int(time.time() * 1000)
+
+    def _history_active(self, hist: dict[int, int],
+                        retention_ms: int) -> set[int]:
+        """Prune expired entries and return the still-active broker ids."""
+        now = self._history_now_ms()
+        with self.excluded_sets_lock:
+            for b in [b for b, ts in hist.items()
+                      if now - ts > retention_ms]:
+                del hist[b]
+            return set(hist)
+
+    def _history_record(self, hist: dict[int, int],
+                        broker_ids: Sequence[int]) -> None:
+        now = self._history_now_ms()
+        with self.excluded_sets_lock:
+            for b in broker_ids:
+                hist[int(b)] = now
+
+    @property
+    def recently_removed_brokers(self) -> set[int]:
+        """Brokers removed by an executed remove_brokers within the
+        removal-history retention window — excluded as replica-move
+        destinations by detection and exclude_recently_removed_brokers
+        requests until the window (on the injected clock) lapses."""
+        return self._history_active(self._removal_history,
+                                    self._removal_retention_ms)
+
+    @property
+    def recently_demoted_brokers(self) -> set[int]:
+        return self._history_active(self._demotion_history,
+                                    self._demotion_retention_ms)
+
+    def drop_recently_removed_brokers(self, broker_ids: Sequence[int]) -> None:
+        with self.excluded_sets_lock:
+            for b in broker_ids:
+                self._removal_history.pop(int(b), None)
+
+    def drop_recently_demoted_brokers(self, broker_ids: Sequence[int]) -> None:
+        with self.excluded_sets_lock:
+            for b in broker_ids:
+                self._demotion_history.pop(int(b), None)
 
     @_traced_op("rebalance")
     def rebalance(self, goals: Sequence[str] | None = None, dryrun: bool = True,
@@ -759,11 +847,11 @@ class CruiseControl:
         chain, state, meta = self._chain_and_model(
             goals, use_ready_default_goals, data_from,
             allow_capacity_estimation)
-        with self.excluded_sets_lock:  # snapshot: API threads mutate these
-            no_leadership = tuple(self.recently_demoted_brokers) \
-                if exclude_recently_demoted_brokers else ()
-            no_replicas = tuple(self.recently_removed_brokers) \
-                if exclude_recently_removed_brokers else ()
+        # The history properties snapshot under the facade's lock.
+        no_leadership = tuple(sorted(self.recently_demoted_brokers)) \
+            if exclude_recently_demoted_brokers else ()
+        no_replicas = tuple(sorted(self.recently_removed_brokers)) \
+            if exclude_recently_removed_brokers else ()
         options = OptimizationOptions(
             excluded_topics=tuple(excluded_topics),
             excluded_brokers_for_leadership=no_leadership,
@@ -798,6 +886,13 @@ class CruiseControl:
         _final, result = self._optimizer.optimizations(
             state, meta, chain, options)
         executed = self._maybe_execute(result, dryrun, "add_broker", reason, uuid)
+        if executed:
+            # An added broker is a live destination again: clear any
+            # removal-history entry so detection and
+            # exclude_recently_removed_brokers requests stop excluding it
+            # (AddBrokersRunnable drops re-added brokers from the
+            # Executor's removal history).
+            self.drop_recently_removed_brokers(broker_ids)
         return OperationResult("add_broker", dryrun, result, result.proposals,
                                executed, reason)
 
@@ -825,8 +920,7 @@ class CruiseControl:
             state, meta, chain, options)
         executed = self._maybe_execute(result, dryrun, "remove_broker", reason, uuid)
         if executed:
-            with self.excluded_sets_lock:
-                self.recently_removed_brokers |= set(broker_ids)
+            self._history_record(self._removal_history, broker_ids)
         return OperationResult("remove_broker", dryrun, result,
                                result.proposals, executed, reason)
 
@@ -887,8 +981,7 @@ class CruiseControl:
         result = dataclasses.replace(result, proposals=proposals)
         executed = self._maybe_execute(result, dryrun, "demote_broker", reason, uuid)
         if executed:
-            with self.excluded_sets_lock:
-                self.recently_demoted_brokers |= set(broker_ids)
+            self._history_record(self._demotion_history, broker_ids)
         return OperationResult("demote_broker", dryrun, result,
                                result.proposals, executed, reason)
 

@@ -1,0 +1,457 @@
+"""Digital-twin scenario harness (round 11).
+
+Unit coverage: the simulated clock, seeded event-stream expansion, the
+backend's topic-churn controls, synchronous detector driving on an
+injected clock, and the facade's TTL'd removal/demotion history.
+Integration coverage: seed-pinned canonical scenario regressions (exact
+final-assignment digests, finite time-to-heal bounds, zero dead letters
+on the non-chaos scenarios), byte-identical determinism of a full
+>=100-tick broker-loss-under-drift replay, and the ``?what_if=``
+time-dimension extension of the PROPOSALS dry run.
+"""
+
+import functools
+import json
+
+import pytest
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+from cruise_control_tpu.detector.notifier import (
+    AnomalyNotificationAction, AnomalyNotificationResult,
+)
+from cruise_control_tpu.executor.admin import (
+    InMemoryAdminBackend, PartitionState,
+)
+from cruise_control_tpu.executor.executor import Executor
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.monitor import LoadMonitor, StaticCapacityResolver
+from cruise_control_tpu.monitor.sampling import SyntheticSampler
+from cruise_control_tpu.testing.simulator import (
+    CANONICAL_SCENARIOS, DriftSpec, DriftingSampler, SimClock, run_scenario,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@functools.lru_cache(maxsize=None)
+def _run(name: str, seed: int):
+    return run_scenario(name, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# Clock + event-stream determinism units
+# ---------------------------------------------------------------------------
+
+def test_sim_clock_advances_and_sleep_consumes_sim_time():
+    clk = SimClock(start_s=5.0)
+    assert clk() == 5.0 and clk.now_ms() == 5000
+    clk.advance(2.5)
+    clk.sleep(1.5)          # backoff sleeps burn sim time, never wall time
+    assert clk.now_s() == 9.0
+
+
+def test_expand_events_is_pure_in_seed_and_sorted():
+    spec = CANONICAL_SCENARIOS["topic_churn_storm"]
+    a = [e.as_dict() for e in spec.expand_events(seed=7)]
+    b = [e.as_dict() for e in spec.expand_events(seed=7)]
+    assert a == b, "generator streams must be pure in (seed, spec)"
+    assert a == sorted(a, key=lambda d: d["tick"])
+    c = [e.as_dict() for e in spec.expand_events(seed=8)]
+    assert a != c, "different seeds must vary the stream"
+
+
+def test_drifting_sampler_is_wall_clock_free_and_seeded():
+    parts = {("t0", 0): PartitionState("t0", 0, (0, 1), 0, isr=(0, 1))}
+    s1 = DriftingSampler(seed=3, drift=DriftSpec(amplitude=0.5))
+    s2 = DriftingSampler(seed=3, drift=DriftSpec(amplitude=0.5))
+    r1 = s1.get_samples(parts, 0, 60_000)
+    r2 = s2.get_samples(parts, 0, 60_000)
+    assert r1.partition_samples[0].values == r2.partition_samples[0].values
+    # Drift is a function of the SIM timestamp handed in, nothing else.
+    r3 = s1.get_samples(parts, 0, 90_000)
+    assert r3.partition_samples[0].values != r1.partition_samples[0].values
+
+
+# ---------------------------------------------------------------------------
+# Backend topic-churn controls
+# ---------------------------------------------------------------------------
+
+def _backend(brokers=4, topics=1, parts=4):
+    out = {}
+    for t in range(topics):
+        for p in range(parts):
+            reps = (p % brokers, (p + 1) % brokers)
+            out[(f"t{t}", p)] = PartitionState(f"t{t}", p, reps, reps[0],
+                                               isr=reps)
+    return InMemoryAdminBackend(out.values())
+
+
+def test_create_delete_expand_topic_bump_metadata_generation():
+    b = _backend()
+    g0 = b.metadata_generation()
+    b.create_topic("new", 6, rf=2)
+    assert b.metadata_generation() > g0
+    created = {k: st for k, st in b.describe_partitions().items()
+               if k[0] == "new"}
+    assert len(created) == 6
+    assert all(len(set(st.replicas)) == 2 and st.leader in st.replicas
+               for st in created.values())
+
+    g1 = b.metadata_generation()
+    assert b.expand_partitions("new", 9) == 3
+    assert b.metadata_generation() > g1
+    assert len([k for k in b.describe_partitions() if k[0] == "new"]) == 9
+
+    g2 = b.metadata_generation()
+    assert b.delete_topic("new") == 9
+    assert b.metadata_generation() > g2
+    assert not [k for k in b.describe_partitions() if k[0] == "new"]
+    # Unknown-topic expansion is an error, not a silent create.
+    with pytest.raises(ValueError):
+        b.expand_partitions("nope", 4)
+
+
+def test_expand_partitions_places_logdirs_on_jbod():
+    b = _backend()
+    b.enable_jbod({br: ["/d0", "/d1"] for br in range(4)})
+    b.create_topic("j", 2, rf=2)
+    b.expand_partitions("j", 4)
+    placed = b.replica_logdirs()
+    for p in range(2, 4):        # the EXPANDED partitions, same rule as
+        st = b.describe_partitions()[("j", p)]   # create_topic's
+        for br in st.replicas:
+            assert placed.get(("j", p, br)) in ("/d0", "/d1"), \
+                "expanded partitions must be visible to disk-health checks"
+
+
+# ---------------------------------------------------------------------------
+# Synchronous detector driving on the injected clock
+# ---------------------------------------------------------------------------
+
+class _CountingDetector:
+    def __init__(self):
+        self.runs = 0
+
+    def run_once(self):
+        self.runs += 1
+        return []
+
+
+def test_run_due_paces_detectors_on_injected_clock():
+    clk = FakeClock()
+    mgr = AnomalyDetectorManager(CruiseControlConfig(), clock=clk)
+    det = _CountingDetector()
+    mgr.add_detector(det, 10_000)   # 10 s interval
+    # First sight only schedules (matches the scheduler thread's
+    # wait-then-run pacing) — nothing runs at t=0.
+    assert mgr.run_due() == 0 and det.runs == 0
+    clk.advance(9.9)
+    assert mgr.run_due() == 0
+    clk.advance(0.2)
+    assert mgr.run_due() == 1 and det.runs == 1
+    clk.advance(5.0)
+    assert mgr.run_due() == 0 and det.runs == 1
+    clk.advance(5.0)
+    assert mgr.run_due() == 1 and det.runs == 2
+
+
+class _ScriptedNotifier:
+    """First consult: re-check after 5 s; second: ignore."""
+
+    def __init__(self):
+        self.consults = 0
+
+    def on_anomaly(self, anomaly):
+        self.consults += 1
+        if self.consults == 1:
+            return AnomalyNotificationResult(
+                AnomalyNotificationAction.CHECK, delay_ms=5_000)
+        return AnomalyNotificationResult(AnomalyNotificationAction.IGNORE)
+
+    def self_healing_enabled(self):
+        return {}
+
+
+def test_drain_anomalies_promotes_rechecks_on_sim_time():
+    from cruise_control_tpu.detector.anomaly import Anomaly
+    clk = FakeClock()
+    notifier = _ScriptedNotifier()
+    mgr = AnomalyDetectorManager(CruiseControlConfig(), notifier, clock=clk)
+    mgr.report(Anomaly())
+    assert mgr.drain_anomalies() == 1           # consult 1 -> parked
+    assert notifier.consults == 1
+    clk.advance(4.0)
+    assert mgr.drain_anomalies() == 0           # not due yet on sim time
+    clk.advance(2.0)
+    assert mgr.drain_anomalies() == 1           # promoted + consulted again
+    assert notifier.consults == 2
+
+
+# ---------------------------------------------------------------------------
+# Facade removal/demotion history: TTL on the injected clock
+# ---------------------------------------------------------------------------
+
+def test_removal_history_expires_on_injected_clock():
+    clk = FakeClock()
+    cc = CruiseControl(
+        CruiseControlConfig({"failed.brokers.file.path": "",
+                             "removal.history.retention.time.ms": 60_000,
+                             "demotion.history.retention.time.ms": 30_000}),
+        _backend(), clock=clk)
+    cc._history_record(cc._removal_history, [3, 4])
+    cc._history_record(cc._demotion_history, [5])
+    assert cc.recently_removed_brokers == {3, 4}
+    assert cc.recently_demoted_brokers == {5}
+    clk.advance(31.0)       # demotion retention (30 s) lapses first
+    assert cc.recently_demoted_brokers == set()
+    assert cc.recently_removed_brokers == {3, 4}
+    # Operator drop (the ADMIN drop_recently_* path) beats the TTL.
+    cc.drop_recently_removed_brokers([3])
+    assert cc.recently_removed_brokers == {4}
+    clk.advance(30.0)       # removal retention (60 s) lapses
+    assert cc.recently_removed_brokers == set()
+
+
+def test_removal_history_rerecord_refreshes_stamp():
+    clk = FakeClock()
+    cc = CruiseControl(
+        CruiseControlConfig({"failed.brokers.file.path": "",
+                             "removal.history.retention.time.ms": 60_000}),
+        _backend(), clock=clk)
+    cc._history_record(cc._removal_history, [7])
+    clk.advance(40.0)
+    cc._history_record(cc._removal_history, [7])   # removed again
+    clk.advance(40.0)       # 80 s after first stamp, 40 s after second
+    assert cc.recently_removed_brokers == {7}
+
+
+# ---------------------------------------------------------------------------
+# Seed-pinned canonical scenario regressions
+# ---------------------------------------------------------------------------
+
+# (scenario, seed) -> exact expectations. These runs are fully
+# deterministic: any drift here is a behavior change in the pipeline the
+# twin drives, not noise.
+PINNED = {
+    ("broker_loss_drift", 0):
+        dict(digest="b8ea3087", heal_p95=8, moves=16, bal_final=100.0),
+    ("broker_loss_drift", 1):
+        dict(digest="b8ea3087", heal_p95=8, moves=16, bal_final=100.0),
+    ("multi_az_failure", 0):
+        dict(digest="0d3c895b", heal_p95=6, moves=66, bal_final=100.0),
+    ("multi_az_failure", 1):
+        dict(digest="d1d3cfc2", heal_p95=6, moves=66, bal_final=100.0),
+    ("topic_churn_storm", 0):
+        dict(digest="035ad16a", heal_p95=None, moves=4, bal_final=62.264),
+    ("topic_churn_storm", 1):
+        dict(digest="556c9b4e", heal_p95=None, moves=20, bal_final=100.0),
+}
+
+
+@pytest.mark.parametrize("name,seed", sorted(PINNED))
+def test_seed_pinned_scenario_regression(name, seed):
+    exp = PINNED[(name, seed)]
+    r = _run(name, seed)
+    d = r.score.as_dict()
+    assert r.assignment_digest == exp["digest"]
+    assert d["heal"]["p95Ticks"] == exp["heal_p95"]
+    assert d["heal"]["unhealed"] == 0
+    assert d["churn"]["replicaMoves"] == exp["moves"]
+    assert d["balancedness"]["final"] == exp["bal_final"]
+    assert d["deadLetters"] == 0, \
+        "non-chaos scenarios must never dead-letter"
+    assert d["sloViolations"] == []
+
+
+def test_broker_loss_time_to_heal_is_finite_and_bounded():
+    r = _run("broker_loss_drift", 0)
+    heals = r.score.heal_events
+    assert heals, "the kill_broker event must open a heal measurement"
+    for h in heals:
+        assert h.ticks_to_heal is not None, "time-to-heal must be finite"
+        assert 0 < h.ticks_to_heal <= 30   # the scenario.slo.heal.ticks SLO
+
+
+def test_full_broker_loss_scenario_is_byte_identical_at_one_seed():
+    """Acceptance: >=100 simulated ticks of broker loss + load drift,
+    two runs at one seed -> byte-identical event streams, final
+    assignments, and ScenarioScore JSON."""
+    assert CANONICAL_SCENARIOS["broker_loss_drift"].ticks >= 100
+    a = _run("broker_loss_drift", 0)
+    b = run_scenario("broker_loss_drift", seed=0)   # fresh simulator
+    assert json.dumps(a.events, sort_keys=True) \
+        == json.dumps(b.events, sort_keys=True)
+    assert a.final_assignment == b.final_assignment
+    assert a.score.to_json() == b.score.to_json()
+    assert a.assignment_digest == b.assignment_digest
+
+
+def test_chaos_drift_converges_through_injected_faults():
+    r = _run("chaos_drift", 0)
+    d = r.score.as_dict()
+    assert d["faultsInjected"] > 0, "chaos must actually fire"
+    assert d["heal"]["unhealed"] == 0
+    assert d["balancedness"]["final"] == 100.0
+    assert d["sloViolations"] == []
+
+
+def test_simulator_leaves_host_tracer_configuration_alone():
+    """A ?what_if= replay (or any embedded twin) must not rewrite the
+    serving process's tracing settings — the twin's facade is built with
+    configure_observability=False."""
+    from cruise_control_tpu.testing.simulator import ClusterSimulator
+    from cruise_control_tpu.utils.tracing import TRACER
+    with TRACER._lock:
+        prev_enabled = TRACER._enabled
+        prev_path = TRACER._jsonl_path
+    sentinel = "/tmp/host-trace-sentinel.jsonl"
+    TRACER.configure(enabled=False, jsonl_path=sentinel)
+    try:
+        ClusterSimulator(CANONICAL_SCENARIOS["broker_loss_drift"], seed=0)
+        with TRACER._lock:
+            assert TRACER._enabled is False
+            assert TRACER._jsonl_path == sentinel
+    finally:
+        TRACER.configure(enabled=prev_enabled, jsonl_path=prev_path)
+
+
+def test_ticks_override_truncates_and_unknown_scenario_raises():
+    r = run_scenario("broker_loss_drift", seed=0, ticks=10)
+    assert r.score.as_dict()["ticks"] == 10
+    with pytest.raises(ValueError, match="unknown scenario"):
+        run_scenario("definitely_not_a_scenario")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name,digest", [
+    ("rolling_maintenance", "20978f4d"),
+    ("capacity_heterogeneity", "265784f8"),
+])
+def test_slow_scenarios_seed_pinned(name, digest):
+    r = _run(name, 0)
+    d = r.score.as_dict()
+    assert r.assignment_digest == digest
+    assert d["balancedness"]["final"] == 100.0
+    assert d["deadLetters"] == 0
+    assert d["sloViolations"] == []
+
+
+# ---------------------------------------------------------------------------
+# ?what_if= — the time-dimension extension of the PROPOSALS dry run
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def what_if_api():
+    from cruise_control_tpu.api.server import CruiseControlApi
+    backend = _backend(brokers=4, topics=2, parts=4)
+    cfg = CruiseControlConfig({
+        "partition.metrics.window.ms": 1000,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "failed.brokers.file.path": ""})
+    caps = StaticCapacityResolver({}, {Resource.CPU: 100.0,
+                                       Resource.DISK: 1e7,
+                                       Resource.NW_IN: 1e6,
+                                       Resource.NW_OUT: 1e6})
+    monitor = LoadMonitor(cfg, backend, samplers=[SyntheticSampler()],
+                          capacity_resolver=caps)
+    cc = CruiseControl(cfg, backend, load_monitor=monitor,
+                       executor=Executor(backend, synchronous=True))
+    api = CruiseControlApi(cc)
+    api._async_wait_s = 300     # cover first-compile of the sim's shapes
+    yield api, cc
+    api.shutdown()
+
+
+def test_what_if_returns_scored_trajectory_without_executing(what_if_api):
+    api, cc = what_if_api
+    before = cc.executor.execution_state()
+    status, body, _ = api.handle(
+        "GET", "/kafkacruisecontrol/proposals",
+        "what_if=broker_loss_drift&what_if_ticks=30&what_if_seed=1")
+    assert status == 200
+    assert body["scenario"] == "broker_loss_drift"
+    assert body["ticks"] == 30 and body["seed"] == 1
+    assert body["dryrun"] is True and body["executed"] is False
+    assert body["score"]["ticks"] == 30
+    assert body["score"]["churn"]["replicaMoves"] >= 0
+    assert body["finalAssignmentDigest"]
+    assert body["events"] == [
+        {"tick": 23, "kind": "kill_broker", "params": {"broker": 5}}]
+    # The replay ran on its OWN twin: this cluster's executor state is
+    # untouched and its partitions unmoved.
+    assert cc.executor.execution_state() == before
+
+
+def test_what_if_rejects_unknown_scenario(what_if_api):
+    api, _cc = what_if_api
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/proposals",
+                                 "what_if=nope")
+    assert status == 400
+    assert "unknown what_if scenario" in json.dumps(body)
+
+
+def test_what_if_tick_cap_is_enforced():
+    from cruise_control_tpu.api.server import CruiseControlApi
+    backend = _backend()
+    cfg = CruiseControlConfig({"failed.brokers.file.path": "",
+                               "scenario.what.if.max.ticks": 5})
+    cc = CruiseControl(cfg, backend)
+    api = CruiseControlApi(cc)
+    api._async_wait_s = 300
+    try:
+        status, body, _ = api.handle(
+            "GET", "/kafkacruisecontrol/proposals",
+            "what_if=broker_loss_drift&what_if_ticks=50")
+        assert status == 200
+        assert body["ticks"] == 5, "cap must bound requested ticks"
+        assert body["score"]["ticks"] == 5
+    finally:
+        api.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# staleness_s on the degraded-serving path (round 9's stale=true)
+# ---------------------------------------------------------------------------
+
+def test_stale_proposal_response_carries_staleness_age():
+    backend = _backend(brokers=4, topics=2, parts=4)
+    cfg = CruiseControlConfig({
+        "partition.metrics.window.ms": 1000,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "failed.brokers.file.path": ""})
+    caps = StaticCapacityResolver({}, {Resource.CPU: 100.0,
+                                       Resource.DISK: 1e7,
+                                       Resource.NW_IN: 1e6,
+                                       Resource.NW_OUT: 1e6})
+    monitor = LoadMonitor(cfg, backend, samplers=[SyntheticSampler()],
+                          capacity_resolver=caps)
+    cc = CruiseControl(cfg, backend, load_monitor=monitor,
+                       executor=Executor(backend, synchronous=True))
+    for k in range(1, 4):
+        monitor.task_runner.run_sampling_once(end_ms=k * 1000)
+    good = cc.proposals()
+    assert not good.extra.get("stale")
+
+    def explode(*a, **k):
+        raise RuntimeError("model build failed")
+
+    cc._optimizer.optimizations = explode
+    monitor.task_runner.run_sampling_once(end_ms=5000)
+    res = cc.proposals()
+    assert res.extra["stale"] is True
+    age = res.extra["staleness_s"]
+    assert isinstance(age, float) and age >= 0.0, \
+        "degraded serving must expose its duration to the SLO scorer"
