@@ -290,6 +290,31 @@ def _tracing_noop_overhead_ns(iterations: int = 100_000) -> float:
         TRACER.configure(enabled=was_enabled)
 
 
+def _flight_recorder_noop_overhead_ns(iterations: int = 100_000) -> float:
+    """Per-call cost of a DISABLED flight recorder's record sites (the
+    acceptance guard, same discipline as the tracing span: pass_scope
+    returns a shared no-op whose goal() returns a shared no-op hook, so
+    recording off must add nothing measurable to the solver driver
+    paths). One iteration = one pass open/close + one goal hook + the
+    three per-goal record calls + one per-dispatch call — strictly MORE
+    work than any real driver pays per dispatch."""
+    from cruise_control_tpu.utils.flight_recorder import FLIGHT
+    was_enabled = FLIGHT.enabled
+    FLIGHT.configure(enabled=False)
+    try:
+        t0 = time.perf_counter_ns()
+        for _ in range(iterations):
+            with FLIGHT.pass_scope(seq=0) as p:
+                g = p.goal("noop")
+                g.entry(violation=0.0)
+                g.grid(8, 8, 8)
+                g.dispatch("move", 8, 8, 0)
+                g.exit(violation=0.0)
+        return (time.perf_counter_ns() - t0) / iterations
+    finally:
+        FLIGHT.configure(enabled=was_enabled)
+
+
 def _resilience_noop_overhead_ns(iterations: int = 100_000) -> float:
     """Per-call cost of the resilience wrapper with retries DISABLED
     (policy=None, breaker=None — the production configuration when
@@ -305,6 +330,198 @@ def _resilience_noop_overhead_ns(iterations: int = 100_000) -> float:
     for _ in range(iterations):
         call_with_resilience("noop", fn)
     return (time.perf_counter_ns() - t0) / iterations
+
+
+def _flight_ring_overhead_probe(num_brokers: int = 200,
+                                num_partitions: int = 5_000,
+                                goal_idx: int = 12, k: int = 24) -> dict:
+    """Marginal per-round cost of the RECORDING move kernel vs. the plain
+    one (chain_optimize_rounds ring_rounds=16 vs 0), chained-marginal
+    style (profile_round.py: (t2k - tk) / extra-rounds so dispatch glue
+    cancels). The noop guard only covers the DISABLED hooks; recording is
+    default-on in production, and its per-round stats row includes a
+    broker_violations reduction the round body does not otherwise run —
+    this probe is the live cost of that choice."""
+    import jax
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer.chain import chain_optimize_rounds
+    from cruise_control_tpu.analyzer.optimizer import (
+        GoalOptimizer, goals_by_priority,
+    )
+    from cruise_control_tpu.analyzer.search import ExclusionMasks
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.model.fixtures import Dist, random_cluster
+
+    state, meta = random_cluster(
+        num_brokers=num_brokers, num_topics=max(8, num_brokers // 10),
+        num_partitions=num_partitions, rf=3, num_racks=8,
+        dist=Dist.EXPONENTIAL, seed=42, skew_to_first=2.0,
+        target_utilization=0.55)
+    state = jax.device_put(state)
+    jax.block_until_ready(state.assignment)
+    cfg = CruiseControlConfig()
+    opt = GoalOptimizer(cfg)
+    scfg = opt.search_config(state)
+    goals = tuple(goals_by_priority(cfg))
+    masks = ExclusionMasks()
+    prior = jnp.asarray([j < goal_idx for j in range(len(goals))])
+
+    def run(budget: int, ring: int) -> int:
+        out = chain_optimize_rounds(
+            state, jnp.int32(goal_idx), prior, goals, opt.constraint, scfg,
+            meta.num_topics, masks, budget=jnp.int32(budget),
+            ring_rounds=ring)
+        jax.block_until_ready(out[0].assignment)
+        return int(out[2])
+
+    def marginal(ring: int) -> tuple[float, int]:
+        run(1, ring)                         # compile + warm
+        t0 = time.monotonic()
+        r1 = run(k, ring)
+        t1 = time.monotonic()
+        r2 = run(2 * k, ring)
+        t2 = time.monotonic()
+        return ((t2 - t1) - (t1 - t0)) / max(1, r2 - r1), r2
+
+    off_s, off_r = marginal(0)
+    on_s, on_r = marginal(16)
+    return {
+        "ms_per_round_recording_off": round(off_s * 1e3, 3),
+        "ms_per_round_recording_on": round(on_s * 1e3, 3),
+        "recording_overhead_ms_per_round": round((on_s - off_s) * 1e3, 3),
+        "rounds_measured": {"off": off_r, "on": on_r},
+        "shape": f"b{num_brokers}_p{num_partitions}",
+        "goal": goals[goal_idx].name,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Regression sentry (bench_baseline.json)
+#
+# The exact failure mode that forced two TopicReplica reverts — a perf fix
+# silently flipping the CpuUsageDistribution canary 86.0 → 82.74 — gets an
+# automated gate: solution QUALITY (balancedness_after, the violated-goals
+# set) is a hard canary and FAILS the comparison; perf-shaped numbers
+# (solve wall clock, dispatch counts) are machine-sensitive and only get a
+# tolerance band (warn). CI fails the job on any canary failure; warns are
+# surfaced in the REGRESSION_SENTRY table for a human eye.
+# ---------------------------------------------------------------------------
+
+BASELINE_FILE = os.environ.get(
+    "BENCH_BASELINE_FILE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "bench_baseline.json"))
+
+
+def load_baseline(path: str = "") -> dict | None:
+    try:
+        with open(path or BASELINE_FILE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def compare_stage_to_baseline(record: dict, baseline: dict) -> dict | None:
+    """One stage record vs. its committed baseline entry → the sentry
+    verdict dict (None when the stage has no baseline entry). Canaries
+    (hard fail): balancedness_after dropping more than
+    ``tolerance.balancedness_abs`` below baseline, and any goal newly in
+    the violated set. Tolerance band (warn): solve wall clock or dispatch
+    count above ``tolerance.*_ratio`` × baseline, and goals that LEFT the
+    violated set (an improvement — flagged so the baseline gets
+    re-pinned, not silently absorbed)."""
+    entry = (baseline.get("stages") or {}).get(record["metric"])
+    if entry is None:
+        return None
+    tol = baseline.get("tolerance") or {}
+    bal_abs = float(tol.get("balancedness_abs", 0.05))
+    wall_ratio = float(tol.get("wall_clock_ratio", 2.0))
+    disp_ratio = float(tol.get("dispatch_ratio", 1.5))
+    ex = record.get("extras") or {}
+    canaries: list[str] = []
+    warnings: list[str] = []
+
+    bal = ex.get("balancedness_after")
+    bal_base = entry.get("balancedness_after")
+    if bal is not None and bal_base is not None \
+            and bal < bal_base - bal_abs:
+        canaries.append(f"balancedness_after {bal} < baseline {bal_base} "
+                        f"- {bal_abs}")
+    new_viol = sorted(set(ex.get("violated_goals_after") or ())
+                      - set(entry.get("violated_goals_after") or ()))
+    gone_viol = sorted(set(entry.get("violated_goals_after") or ())
+                       - set(ex.get("violated_goals_after") or ()))
+    if new_viol:
+        canaries.append(f"newly violated goals: {new_viol}")
+    if gone_viol:
+        warnings.append(f"goals no longer violated (re-pin baseline): "
+                        f"{gone_viol}")
+
+    wall = ex.get("solve_wall_clock_s")
+    wall_base = entry.get("solve_wall_clock_s")
+    if wall is not None and wall_base and wall > wall_ratio * wall_base:
+        warnings.append(f"solve_wall_clock_s {wall} > {wall_ratio}x "
+                        f"baseline {wall_base}")
+    disp = ex.get("dispatch_count")
+    disp_base = entry.get("dispatch_count")
+    if disp is not None and disp_base and disp > disp_ratio * disp_base:
+        warnings.append(f"dispatch_count {disp} > {disp_ratio}x "
+                        f"baseline {disp_base}")
+
+    status = "fail" if canaries else ("warn" if warnings else "ok")
+    return {
+        "metric": f"regression_sentry_{record['metric']}",
+        "value": 0.0 if canaries else 1.0,
+        "unit": "pass",
+        "vs_baseline": 0.0 if canaries else 1.0,
+        "extras": {
+            "stage": record["metric"], "status": status,
+            "canaries": canaries, "warnings": warnings,
+            "balancedness_after": bal,
+            "balancedness_baseline": bal_base,
+            "violated_goals_after": ex.get("violated_goals_after"),
+            "violated_goals_baseline": entry.get("violated_goals_after"),
+            "solve_wall_clock_s": wall,
+            "solve_wall_clock_baseline_s": wall_base,
+            "dispatch_count": disp,
+            "dispatch_count_baseline": disp_base,
+        },
+    }
+
+
+def _emit_sentry_summary(verdicts: list[dict], baseline: dict | None) -> None:
+    """The sentry's closing verdict. A baselined stage that never produced
+    a comparison (timed out, crashed, or was budget-skipped) makes the
+    summary ``incomplete`` — NOT ok: a regression severe enough to also
+    break its stage must not pass the gate by breaking it (the CI gate
+    fails on incomplete just like fail)."""
+    statuses = [v["extras"]["status"] for v in verdicts]
+    compared = {v["extras"]["stage"] for v in verdicts}
+    expected = set((baseline or {}).get("stages") or {})
+    missing = sorted(expected - compared)
+    if baseline is None:
+        status = "no_baseline"
+    elif "fail" in statuses:
+        status = "fail"
+    elif missing:
+        status = "incomplete"
+    elif "warn" in statuses:
+        status = "warn"
+    else:
+        status = "ok"
+    bad = status in ("fail", "incomplete")
+    _emit({"metric": "regression_sentry_summary",
+           "value": 0.0 if bad else 1.0, "unit": "pass",
+           "vs_baseline": 0.0 if bad else 1.0,
+           "extras": {"status": status,
+                      "baseline_file": BASELINE_FILE,
+                      "baseline_found": baseline is not None,
+                      "stages_compared": [v["extras"]["stage"]
+                                          for v in verdicts],
+                      "stages_missing": missing}})
 
 
 def _degraded_cycle_probe(seed: int = 11) -> dict:
@@ -638,6 +855,28 @@ def _guarded_main(deadline: float) -> int:
            "extras": {"guard": "resilience wrapper with retries disabled "
                                "must stay ns-scale (same no-op discipline "
                                "as tracing)"}})
+    flight_ns = _flight_recorder_noop_overhead_ns()
+    _emit({"metric": "flight_recorder_noop_overhead",
+           "value": round(flight_ns, 1), "unit": "ns", "vs_baseline": 1.0,
+           "extras": {"guard": "disabled flight recorder must stay ns-scale "
+                               "per record site (shared no-op hooks, same "
+                               "guard as tracing_noop_span_overhead)"}})
+    try:
+        ring = _flight_ring_overhead_probe()
+        _emit({"metric": "flight_ring_overhead",
+               "value": ring["recording_overhead_ms_per_round"],
+               "unit": "ms", "vs_baseline": 1.0,
+               "extras": {**ring,
+                          "guard": "per-round cost of the RECORDING move "
+                                   "kernel vs plain (recording is "
+                                   "default-on; the noop guard only "
+                                   "covers the disabled hooks)"}})
+    except Exception as e:  # noqa: BLE001 — a probe failure must not
+        # cost the stages their budget
+        _emit({"metric": "stage_failed", "value": 0.0, "unit": "s",
+               "vs_baseline": 0.0,
+               "extras": {"stage": "flight_ring_overhead_probe",
+                          "error": f"{type(e).__name__}: {e}"[:300]}})
     degraded = _degraded_cycle_probe()
     _emit({"metric": "degraded_cycle_s",
            "value": degraded["degraded_cycle_s"], "unit": "s",
@@ -650,6 +889,8 @@ def _guarded_main(deadline: float) -> int:
                       "trace_file": trace_file,
                       "stderr_file": _stderr_path}})
 
+    baseline = load_baseline()
+    sentry_verdicts: list[dict] = []
     stages = STAGES[:2] if os.environ.get("BENCH_SCALE") == "small" else STAGES
     prev_total = 0.0
     for i, (num_brokers, num_partitions, drain) in enumerate(stages):
@@ -683,6 +924,11 @@ def _guarded_main(deadline: float) -> int:
             # record the same stage as both completed and partial.
             signal.alarm(0)
             _emit(record)
+            if baseline is not None:
+                verdict = compare_stage_to_baseline(record, baseline)
+                if verdict is not None:
+                    sentry_verdicts.append(verdict)
+                    _emit(verdict)
         except _Watchdog:
             # Stage deadline expired: emit the phases it DID finish as a
             # partial record and move on — a stage capped by the proration
@@ -704,11 +950,33 @@ def _guarded_main(deadline: float) -> int:
                 "extras": {"stage": stage_name,
                            "error": f"{type(e).__name__}: {e}"[:500],
                            **progress}})
+            _emit_sentry_summary(sentry_verdicts, baseline)
+            _dump_flight_recorder()
             return 0
         finally:
             signal.alarm(0)
         prev_total = time.time() - t0
+    _emit_sentry_summary(sentry_verdicts, baseline)
+    _dump_flight_recorder()
     return 0
+
+
+def _dump_flight_recorder() -> None:
+    """Write every retained flight-recorder pass to BENCH_FLIGHT_FILE (CI
+    uploads it next to the trace JSONL): the per-PR record of what the
+    bench's solves actually did — acceptance densities, kill attribution,
+    per-round violation trajectories — so a sentry warn/fail comes with
+    its own diagnosis attached."""
+    flight_file = os.environ.get("BENCH_FLIGHT_FILE",
+                                 "/tmp/cc_bench_flight.json")
+    try:
+        from cruise_control_tpu.utils.flight_recorder import FLIGHT
+        n = FLIGHT.dump_json(flight_file)
+        _emit({"metric": "flight_recorder_dump", "value": float(n),
+               "unit": "passes", "vs_baseline": 1.0,
+               "extras": {"flight_file": flight_file}})
+    except Exception:  # noqa: BLE001 — the dump is best-effort
+        pass
 
 
 if __name__ == "__main__":

@@ -44,10 +44,12 @@ from .constraint import BalancingConstraint
 from .derived import compute_derived
 from .goals.base import Goal
 from .search import (
-    _OFFLINE_BONUS, ExclusionMasks, OptimizationFailureError, SearchConfig,
-    apply_selected, apply_swap_selection, cumulative_select, goal_aux,
+    _EPS_IMPROVEMENT, _OFFLINE_BONUS, ExclusionMasks,
+    OptimizationFailureError, SearchConfig, apply_selected,
+    apply_swap_selection, cumulative_select, goal_aux, reduce_per_source,
     run_carry_loop, swap_grid,
 )
+from ..utils.flight_recorder import NO_FLIGHT, STAT_WIDTH as _FLIGHT_STATS
 
 
 def _gated_aux(needed: jax.Array, goal: Goal, state, derived, constraint,
@@ -194,12 +196,23 @@ def _chain_round_body(state: ClusterTensors, agg: "AggCarry | None",
                       prior_mask: jax.Array, goals: tuple[Goal, ...],
                       constraint: BalancingConstraint, cfg: SearchConfig,
                       num_topics: int, masks: ExclusionMasks,
-                      ) -> tuple[ClusterTensors, "AggCarry | None", jax.Array]:
+                      collect: bool = False,
+                      ) -> tuple[ClusterTensors, "AggCarry | None",
+                                 jax.Array, "jax.Array | None"]:
     """One search round, chain-parameterized (traced body). ``agg`` is the
     incrementally-maintained aggregate carry (analyzer.agg): the round reads
     its per-broker aggregates from it instead of O(P·S) segment-sums and
     returns it updated by the applied batch (None = recompute-per-round,
-    kept for the oracle paths)."""
+    kept for the oracle paths).
+
+    ``collect`` (trace-time) additionally returns a ``[STAT_WIDTH]`` f32
+    flight-stats row for this round (utils.flight_recorder.STAT_COLUMNS:
+    applied / valid / accepted / positive / winners / active-goal
+    violation) — pure REDUCTIONS over tensors the round already computes
+    (the duplicated ``reduce_per_source`` is structurally identical to
+    the one inside ``cumulative_select``, so XLA CSE collapses the two),
+    never a new selection input: the trajectory is byte-identical with
+    collection on or off (pinned in tests/test_flight_recorder.py)."""
     lead_only_f, incl_lead_f, indep_f = _goal_flags(goals)
     is_lead_only = lead_only_f[active_idx]
     has_leadership = incl_lead_f[active_idx]
@@ -304,7 +317,27 @@ def _chain_round_body(state: ClusterTensors, agg: "AggCarry | None",
     new_state = apply_selected(
         state, sel, deltas.partition[top_idx], deltas.src_slot[top_idx],
         deltas.dst_broker[top_idx], cand.kind[top_idx], cand.dst_slot[top_idx])
-    return new_state, agg, sel.sum()
+    applied = sel.sum()
+    stat = None
+    if collect:
+        red_idx = reduce_per_source(
+            score, layout, extra_last_col=targets_enabled(
+                state.num_partitions))
+        viol = _switch_goal_fn(
+            active_idx, goals,
+            lambda g, i: g.broker_violations(
+                state, derived, constraint, aux_list[i]).sum()
+            .astype(jnp.float32))
+        stat = jnp.stack([
+            applied.astype(jnp.float32),
+            deltas.valid.sum().astype(jnp.float32),
+            accept.sum().astype(jnp.float32),
+            (score > _EPS_IMPROVEMENT).sum().astype(jnp.float32),
+            (score[red_idx] > _EPS_IMPROVEMENT).sum().astype(jnp.float32),
+            viol,
+        ])
+        assert stat.shape == (_FLIGHT_STATS,)
+    return new_state, agg, applied, stat
 
 
 def _chain_rounds_driver(state: ClusterTensors, active_idx: jax.Array,
@@ -312,44 +345,77 @@ def _chain_rounds_driver(state: ClusterTensors, active_idx: jax.Array,
                          constraint: BalancingConstraint, cfg: SearchConfig,
                          num_topics: int, masks: ExclusionMasks,
                          budget: jax.Array | None = None,
-                         ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
+                         ring_rounds: int = 0,
+                         ) -> tuple[ClusterTensors, jax.Array, jax.Array,
+                                    "jax.Array | None"]:
     """Traced body of the fused move driver — the MEGASTEP: up to
     ``budget`` round-bodies under one ``lax.while_loop`` whose carry is
     ``((state, agg), moves, rounds, last_applied)`` with ``last_applied``
     as the on-device early-exit flag (a zero-apply round freezes the state
     and ends the loop — no host involvement). Shared by the plain and the
-    donated jits below."""
+    donated jits below.
+
+    ``ring_rounds`` > 0 (trace-time, the flight recorder's knob) adds a
+    ``[ring_rounds, STAT_WIDTH]`` f32 ring to the carry: each round
+    writes its flight-stats row at ``round % ring_rounds``, and the ring
+    rides the dispatch's existing async readback (one more output
+    tensor, ~3 KB at the default length — no extra host round-trip).
+    Returns (final_state, total_moves, rounds_run, ring-or-None)."""
+    collect = ring_rounds > 0
+
     def body(carry, rounds_done):
-        s, a = carry
+        if collect:
+            s, a, ring = carry
+        else:
+            s, a = carry
         a = maybe_refresh(a, s, num_topics, rounds_done)
-        ns, na, applied = _chain_round_body(s, a, active_idx, prior_mask,
-                                            goals, constraint, cfg,
-                                            num_topics, masks)
+        ns, na, applied, stat = _chain_round_body(
+            s, a, active_idx, prior_mask, goals, constraint, cfg,
+            num_topics, masks, collect=collect)
+        if collect:
+            ring = ring.at[rounds_done % ring_rounds].set(stat)
+            return (ns, na, ring), applied
         return (ns, na), applied
 
-    (final, _agg), total, rounds = run_carry_loop(
-        body, (state, compute_agg(state, num_topics)), cfg.max_rounds,
-        budget=budget)
-    return final, total, rounds
+    carry0 = (state, compute_agg(state, num_topics))
+    if collect:
+        carry0 = carry0 + (jnp.zeros((ring_rounds, _FLIGHT_STATS),
+                                     jnp.float32),)
+    final_carry, total, rounds = run_carry_loop(
+        body, carry0, cfg.max_rounds, budget=budget)
+    if collect:
+        final, _agg, ring = final_carry
+        return final, total, rounds, ring
+    final, _agg = final_carry
+    return final, total, rounds, None
 
 
-@partial(jax.jit, static_argnames=("goals", "constraint", "cfg", "num_topics"))
+@partial(jax.jit, static_argnames=("goals", "constraint", "cfg", "num_topics",
+                                   "ring_rounds"))
 def chain_optimize_rounds(state: ClusterTensors, active_idx: jax.Array,
                           prior_mask: jax.Array, goals: tuple[Goal, ...],
                           constraint: BalancingConstraint, cfg: SearchConfig,
                           num_topics: int, masks: ExclusionMasks,
                           budget: jax.Array | None = None,
-                          ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
+                          ring_rounds: int = 0):
     """Fused multi-round driver for ANY goal in the chain: one compilation
     serves all G (active_idx, prior_mask) combinations. Returns
     (final_state, total_moves, rounds_run). ``budget`` (traced) further
     caps rounds without recompiling (bounded-dispatch path).
 
+    ``ring_rounds`` > 0 (static — the flight recorder's ON switch, one
+    extra compilation per process when enabled) appends the per-round
+    flight-stats ring as a FOURTH output; 0 keeps the 3-tuple contract.
+
     Aggregates are computed once at entry and maintained incrementally
     through the loop (analyzer.agg), with a periodic fresh recompute to
     bound f32 drift."""
-    return _chain_rounds_driver(state, active_idx, prior_mask, goals,
-                                constraint, cfg, num_topics, masks, budget)
+    final, total, rounds, ring = _chain_rounds_driver(
+        state, active_idx, prior_mask, goals, constraint, cfg, num_topics,
+        masks, budget, ring_rounds=ring_rounds)
+    if ring_rounds > 0:
+        return final, total, rounds, ring
+    return final, total, rounds
 
 
 def strip_mutable(state: ClusterTensors) -> ClusterTensors:
@@ -368,7 +434,8 @@ def strip_mutable(state: ClusterTensors) -> ClusterTensors:
 
 
 @partial(jax.jit, static_argnames=("goals", "constraint", "cfg",
-                                   "num_topics"), donate_argnums=(0, 1))
+                                   "num_topics", "ring_rounds"),
+         donate_argnums=(0, 1))
 def chain_optimize_rounds_donated(assignment: jax.Array,
                                   leader_slot: jax.Array,
                                   rest: ClusterTensors,
@@ -378,19 +445,22 @@ def chain_optimize_rounds_donated(assignment: jax.Array,
                                   constraint: BalancingConstraint,
                                   cfg: SearchConfig, num_topics: int,
                                   masks: ExclusionMasks, budget: jax.Array,
-                                  ) -> tuple[jax.Array, jax.Array,
-                                             jax.Array, jax.Array]:
+                                  ring_rounds: int = 0):
     """The donated megastep: identical trace to ``chain_optimize_rounds``
     with the two mutable tensors donated, so XLA writes the new assignment
     into the old buffers instead of allocating a fresh generation per
     dispatch. Callers pass ``strip_mutable(state)`` as ``rest`` and must
     not touch the donated arrays afterwards. Returns (assignment,
-    leader_slot, moves, rounds)."""
+    leader_slot, moves, rounds) — plus the flight-stats ring when
+    ``ring_rounds`` > 0 (chain_optimize_rounds; the ring is loop-created,
+    never part of the donation set)."""
     state = dataclasses.replace(rest, assignment=assignment,
                                 leader_slot=leader_slot)
-    final, total, rounds = _chain_rounds_driver(
+    final, total, rounds, ring = _chain_rounds_driver(
         state, active_idx, prior_mask, goals, constraint, cfg, num_topics,
-        masks, budget)
+        masks, budget, ring_rounds=ring_rounds)
+    if ring_rounds > 0:
+        return final.assignment, final.leader_slot, total, rounds, ring
     return final.assignment, final.leader_slot, total, rounds
 
 
@@ -625,7 +695,7 @@ def chain_optimize_full(state: ClusterTensors, goals: tuple[Goal, ...],
                     st, ag = carry
                     ag = maybe_refresh(ag, st, num_topics,
                                        rounds + rounds_done)
-                    ns, nag, applied = _chain_round_body(
+                    ns, nag, applied, _stat = _chain_round_body(
                         st, ag, g, prior, goals, constraint, cfg, num_topics,
                         masks)
                     return (ns, nag), applied
@@ -733,7 +803,9 @@ def _chain_infos_from_stats(goals: tuple[Goal, ...], stats: dict,
             "residual_violation": total_violation,
             "succeeded": succeeded,
             "objective": obj1,
+            "violation_before": float(stats["viol_before"][i]),
             "violated_on_entry": float(stats["viol_before"][i]) > 1e-6,
+            "offline_before": int(stats["offline_before"][i]),
             "offline_remaining": int(stats["offline_after"][i]),
         })
     return infos
@@ -897,15 +969,21 @@ def run_bounded_pass(enqueue: Callable, st, pass_cap: int,
                      out_of_time: Callable[[], bool] | None = None,
                      async_readback: bool = True,
                      stats: DispatchStats | None = None,
-                     kind: str = "move"):
+                     kind: str = "move",
+                     flight=NO_FLIGHT):
     """Drive one logical pass (a fixed-point loop of at most ``pass_cap``
     search rounds) as a sequence of bounded megastep dispatches.
 
-    ``enqueue(st, budget) -> (st, applied, rounds, donated)`` fires one
-    dispatch and returns WITHOUT reading anything back (jax async
+    ``enqueue(st, budget) -> (st, applied, rounds, donated, ring)`` fires
+    one dispatch and returns WITHOUT reading anything back (jax async
     dispatch); the scalars are device futures and ``donated`` reports
     whether THIS dispatch ran the donated kernel (per-dispatch, so the
-    donation telemetry stays exact). With ``async_readback`` the pump
+    donation telemetry stays exact). ``ring`` is the dispatch's per-round
+    flight-stats buffer (None on paths without one); it is read — and
+    handed to ``flight`` (utils.flight_recorder goal hook) together with
+    the dispatch's budget/rounds/applied/controller state — exactly when
+    the dispatch's scalars are read, so recording never adds a host
+    round-trip. With ``async_readback`` the pump
     keeps one dispatch in flight: dispatch N+1 is enqueued — chained on
     N's output state, budgeted against the PESSIMISTIC estimate that N
     runs its full budget (the estimate can only under-budget N+1, never
@@ -933,7 +1011,7 @@ def run_bounded_pass(enqueue: Callable, st, pass_cap: int,
     applied_total = 0
     pass_rounds = 0
     est_rounds = 0
-    prev = None    # (applied, rounds, budget, t0, donated) — unread
+    prev = None    # (applied, rounds, budget, t0, donated, ring) — unread
     last_read_t = None
     converged = False
     while True:
@@ -943,11 +1021,11 @@ def run_bounded_pass(enqueue: Callable, st, pass_cap: int,
                 and not (out_of_time is not None and out_of_time()):
             budget = controller.budget(pass_cap - est_rounds)
             t0 = _time.monotonic()
-            st, applied, r, donated = enqueue(st, budget)
-            cur = (applied, r, budget, t0, donated)
+            st, applied, r, donated, ring = enqueue(st, budget)
+            cur = (applied, r, budget, t0, donated, ring)
             est_rounds += budget
         if prev is not None:
-            applied_p, r_p, budget_p, t0_p, donated_p = prev
+            applied_p, r_p, budget_p, t0_p, donated_p, ring_p = prev
             r_read = int(r_p)                       # blocks on dispatch N
             now = _time.monotonic()
             start = t0_p if last_read_t is None else max(t0_p, last_read_t)
@@ -956,6 +1034,9 @@ def run_bounded_pass(enqueue: Callable, st, pass_cap: int,
             last_read_t = now
             if stats is not None:
                 stats.record(kind, r_read, donated=donated_p)
+            flight.dispatch(kind, budget_p, r_read, int(applied_p),
+                            donated=donated_p, elapsed_s=now - start,
+                            controller_k=controller.k, ring=ring_p)
             pass_rounds += r_read
             est_rounds -= budget_p - r_read         # correct the estimate
             if r_read < budget_p:
@@ -967,9 +1048,13 @@ def run_bounded_pass(enqueue: Callable, st, pass_cap: int,
             # make no search progress, and counting them would consume
             # cfg.max_rounds budget the synchronous per-round path does
             # not pay, diverging the paths at the round-cap boundary.
+            # Its ring rows repeat the terminal round — dropped for the
+            # same reason.
             if stats is not None:
                 stats.record(kind, int(cur[1]), donated=cur[4],
                              speculative=True)
+            flight.dispatch(kind, cur[2], int(cur[1]), 0, donated=cur[4],
+                            speculative=True, controller_k=controller.k)
             cur = None
         prev = cur
         if prev is None and (converged or est_rounds >= pass_cap
@@ -988,6 +1073,7 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
                            megastep: MegastepConfig | None = None,
                            stats: DispatchStats | None = None,
                            donate_input: bool = False,
+                           flight=NO_FLIGHT,
                            ) -> tuple[ClusterTensors, dict]:
     """Run goal ``chain[index]`` to convergence under the acceptance of
     ``chain[:index]``, using the chain-shared kernels (same semantics and
@@ -1022,6 +1108,12 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
     donates it directly; otherwise it donates a device COPY of the two
     mutable tensors (intermediate states are loop-owned and donated
     as-is). ``stats`` collects per-dispatch accounting.
+
+    ``flight`` (utils.flight_recorder goal hook) records entry/exit
+    violations, grid geometry, sizing decisions, and per-dispatch
+    telemetry; when it is recording, the MOVE-phase kernels run with the
+    per-round stats ring enabled (``ring_rounds``) — reductions only, so
+    the trajectory is unchanged (the recorder's parity contract).
     """
     goal_t0 = _time.monotonic()
 
@@ -1037,6 +1129,8 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
 
     viol0, obj0, offline0 = chain_goal_stats(state, idx, goals, constraint,
                                              num_topics, masks)
+    flight.entry(violation=float(viol0), objective=float(obj0),
+                 offline=int(offline0))
     total_applied = 0
     total_swaps = 0
     rounds = 0
@@ -1051,8 +1145,20 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
         # Deficit-aware sizing from the goal's ENTRY violations — a
         # pass-level constant, so the trajectory stays invariant to the
         # dispatch-budget sequence under the sized config.
+        base_cfg = cfg
         cfg = deficit_sized_config(cfg, float(viol0),
                                    megastep.deficit_moves_cap)
+        flight.sizing(entry_violation=float(viol0),
+                      base_moves=base_cfg.moves_per_round,
+                      base_sources=base_cfg.num_sources,
+                      sized_moves=cfg.moves_per_round,
+                      sized_sources=cfg.num_sources,
+                      cap=megastep.deficit_moves_cap)
+    flight.grid(cfg.num_sources, cfg.num_dests, cfg.moves_per_round)
+    # Per-round on-device flight ring: MOVE phases of the single-device
+    # chain kernels only (the stats live in the round body; swap phases
+    # and the sharded kernels record at dispatch granularity).
+    ring_n = flight.ring_rounds if flight.recording else 0
     # Donation gate: the first dispatch consumes the caller's state —
     # donatable only on the caller's say-so; everything after consumes
     # loop-owned intermediates. With donation ON, the first dispatch
@@ -1074,19 +1180,27 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
         if not bounded:
             # One dispatch IS the whole pass (the kernel's static cap
             # equals pass_cap).
+            ring = None
             if phase == "move":
-                st, applied, r = chain_optimize_rounds(
+                # 3-tuple when ring_n == 0, 4-tuple with the ring
+                # appended otherwise (the kernel's static-flag contract).
+                out = chain_optimize_rounds(
                     st, idx, prior, goals, constraint, cfg, num_topics,
-                    masks)
+                    masks, ring_rounds=ring_n)
+                st, applied, r = out[:3]
+                ring = out[3] if ring_n > 0 else None
             else:
                 st, applied, r = chain_swap_rounds(
                     st, idx, prior, goals, constraint, num_topics, masks)
             if stats is not None:
                 stats.record(phase, int(r))
+            flight.dispatch(phase, pass_cap, int(r), int(applied),
+                            ring=ring)
             return st, int(applied), int(r)
 
         def enqueue(st, budget: int):
             b = jnp.int32(budget)
+            ring = None
             if donate:
                 if not can_donate[0]:
                     # Caller retains the input: donate a copy of the two
@@ -1096,29 +1210,35 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
                         leader_slot=jnp.copy(st.leader_slot))
                 rest = strip_mutable(st)
                 if phase == "move":
-                    a, l, applied, r = chain_optimize_rounds_donated(
+                    out = chain_optimize_rounds_donated(
                         st.assignment, st.leader_slot, rest, idx, prior,
-                        goals, constraint, cfg, num_topics, masks, b)
+                        goals, constraint, cfg, num_topics, masks, b,
+                        ring_rounds=ring_n)
+                    a, l, applied, r = out[:4]
+                    ring = out[4] if ring_n > 0 else None
                 else:
                     a, l, applied, r = chain_swap_rounds_donated(
                         st.assignment, st.leader_slot, rest, idx, prior,
                         goals, constraint, num_topics, masks, 8, 64, b)
                 st = dataclasses.replace(st, assignment=a, leader_slot=l)
             elif phase == "move":
-                st, applied, r = chain_optimize_rounds(
+                out = chain_optimize_rounds(
                     st, idx, prior, goals, constraint, cfg, num_topics,
-                    masks, budget=b)
+                    masks, budget=b, ring_rounds=ring_n)
+                st, applied, r = out[:3]
+                ring = out[3] if ring_n > 0 else None
             else:
                 st, applied, r = chain_swap_rounds(
                     st, idx, prior, goals, constraint, num_topics, masks,
                     budget=b)
             can_donate[0] = True
-            return st, applied, r, donate
+            return st, applied, r, donate, ring
 
         return run_bounded_pass(
             enqueue, st, pass_cap, dispatch,
             out_of_time=out_of_time if wall_budget_s > 0 else None,
-            async_readback=async_rb, stats=stats, kind=phase)
+            async_readback=async_rb, stats=stats, kind=phase,
+            flight=flight)
 
     # Fast path (parity with chain_optimize_full's per-goal lax.cond skip
     # and the sharded bounded driver): nothing violated, nothing offline,
@@ -1149,6 +1269,8 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
     else:
         # Skipped goal: the state is untouched, entry stats ARE exit stats.
         viol, obj, offline = viol0, obj0, offline0
+    flight.exit(violation=float(viol), objective=float(obj),
+                offline=int(offline))
     if int(offline0) == 0:
         before, after = float(obj0), float(obj)
         if after > before + 1e-4 * max(1.0, abs(before)):

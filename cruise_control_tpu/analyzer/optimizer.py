@@ -447,21 +447,30 @@ class GoalOptimizer:
                       options: OptimizationOptions | None = None,
                       ) -> tuple[ClusterTensors, OptimizerResult]:
         """Run the goal chain; returns (final_state, OptimizerResult)."""
+        from ..utils.flight_recorder import FLIGHT
         from ..utils.progress import step
         from ..utils.tracing import TRACER
         from ..utils.xla_telemetry import shape_scope
         step("OptimizationForGoalChain")
+        # seq anticipates the increment inside _optimizations_traced (the
+        # one place _pass_seq advances), so the flight record and
+        # pass_seq()/thread_pass_seq() agree on the pass's identity.
         with TRACER.span("analyzer.optimize",
                          num_partitions=state.num_partitions,
                          num_brokers=state.num_brokers) as _opt_span, \
-                shape_scope(state.num_partitions, state.num_brokers):
+                shape_scope(state.num_partitions, state.num_brokers), \
+                FLIGHT.pass_scope(
+                    seq=self._pass_seq + 1,
+                    shape=(state.num_partitions,
+                           state.num_brokers)) as flight_pass:
             return self._optimizations_traced(
-                state, meta, goals, options, _opt_span, t_start=time.time())
+                state, meta, goals, options, _opt_span, flight_pass,
+                t_start=time.time())
 
     def _optimizations_traced(self, state: ClusterTensors, meta: ClusterMeta,
                               goals: Sequence[Goal] | None,
                               options: OptimizationOptions | None,
-                              _opt_span, t_start: float,
+                              _opt_span, flight_pass, t_start: float,
                               ) -> tuple[ClusterTensors, OptimizerResult]:
         from ..utils.tracing import TRACER
         options = options or OptimizationOptions()
@@ -526,6 +535,7 @@ class GoalOptimizer:
             # one bills the deficit-sized count goals' dispatches.
             ctl_pair = self._controller_pair(state) if bounded \
                 else (None, None)
+            flight_pass.set(path="mesh", bounded=bounded)
             state, infos = optimize_chain_sharded(
                 state, goal_chain, self._constraint, search_cfg,
                 meta.num_topics, mesh, masks,
@@ -533,9 +543,11 @@ class GoalOptimizer:
                 dispatch_target_s=self._dispatch_target_s,
                 dispatch=ctl_pair[1 if fast else 0],
                 dispatch_wide=ctl_pair[1],
-                megastep=megastep, stats=stats, donate_input=False)
+                megastep=megastep, stats=stats, donate_input=False,
+                flight=flight_pass)
             if not bounded:
                 stats.record("chain", sum(i["rounds"] for i in infos))
+                flight_pass.record_goal_infos(infos)
             goal_results = _apportioned_goal_results(
                 goal_chain, infos, time.time() - t0)
             _record_goal_spans(TRACER, goal_results, search_cfg)
@@ -545,10 +557,12 @@ class GoalOptimizer:
             # Production path at small/medium scale: the whole chain in ONE
             # device dispatch (chain.chain_optimize_full).
             t0 = time.time()
+            flight_pass.set(path="fused")
             state, infos = optimize_chain(
                 state, goal_chain, self._constraint, search_cfg,
                 meta.num_topics, masks)
             stats.record("chain", sum(i["rounds"] for i in infos))
+            flight_pass.record_goal_infos(infos)
             goal_results = _apportioned_goal_results(
                 goal_chain, infos, time.time() - t0)
             _record_goal_spans(TRACER, goal_results, search_cfg)
@@ -590,6 +604,8 @@ class GoalOptimizer:
             # recreate exactly that overshoot-then-depress cycle — and
             # persist it across same-shape passes.
             deficit_sizing = megastep.deficit_moves_cap > 0
+            flight_pass.set(path="bounded" if dispatch_rounds > 0
+                            else "pergoal")
             goal_results = []
             # Donation gate for the chain's FIRST mutating dispatch: until
             # some goal has actually run a dispatch, the threaded state is
@@ -612,7 +628,8 @@ class GoalOptimizer:
                         dispatch=ctl_pair[1] if wide_class else controller,
                         wall_budget_s=fast_budget_s,
                         megastep=megastep, stats=stats,
-                        donate_input=chain_owns_state)
+                        donate_input=chain_owns_state,
+                        flight=flight_pass.goal(g.name))
                     chain_owns_state |= info["rounds"] > 0
                     gsp.set(rounds=info["rounds"],
                             moves_applied=info["moves_applied"],

@@ -54,6 +54,17 @@ class EndPoint(enum.Enum):
     # sensors but no request-scoped causality): recent span trees from
     # utils.tracing, filterable by ?cluster= and ?operation=.
     TRACE = (24, "GET", Role.VIEWER)
+    # Solver flight recorder (no reference analogue — the reference's
+    # optimizer is host-side and debuggable in place; the donated
+    # on-device megastep is not): recorded per-goal, per-dispatch search
+    # telemetry from utils.flight_recorder, filterable by ?cluster= and
+    # ?goal=.
+    SOLVER = (25, "GET", Role.VIEWER)
+    # On-demand device profiling (utils.profiling): jax.profiler trace
+    # capture of live solves + the in-process op-class microbench. USER,
+    # not VIEWER: a capture occupies the profiler gate and the microbench
+    # occupies the device — both consume shared machine time.
+    PROFILE = (26, "GET", Role.USER)
 
     @property
     def method(self) -> str:

@@ -16,6 +16,7 @@ from .server import URL_PREFIX
 _TYPE_BY_COERCION = {
     "_bool": ("boolean", None),
     "_int": ("integer", None),
+    "_float": ("number", None),
     "_long_ms": ("integer", "epoch milliseconds"),
     "_str": ("string", None),
     "_csv": ("string", "comma-separated list"),

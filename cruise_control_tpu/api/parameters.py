@@ -165,6 +165,15 @@ SCHEMAS: dict[EndPoint, dict[str, Callable[[str], Any]]] = {
     # rather than routing; operation filters by runnable name
     # (rebalance/proposals/sampling/execution/...).
     EndPoint.TRACE: {"operation": _str, "entries": _int},
+    # cluster (in _COMMON) filters by the pass's recorded cluster label
+    # (same no-route semantics as TRACE); goal trims each pass to one
+    # goal's record.
+    EndPoint.SOLVER: {"goal": _str, "entries": _int},
+    # duration_s > 0 = jax.profiler capture window; microbench=true = the
+    # in-process op-class while_loop marginals instead (brokers/
+    # partitions/iters size it).
+    EndPoint.PROFILE: {"duration_s": _float, "microbench": _bool,
+                       "brokers": _int, "partitions": _int, "iters": _int},
 }
 
 

@@ -312,19 +312,26 @@ class CruiseControlApi:
             # its solver work must share the device under the scheduler
             # and respect the pause state, not sneak around both.
             cluster_id = params.pop("cluster", None)
-            if endpoint is EndPoint.TRACE:
-                # cluster here FILTERS recorded traces (it is a label on
-                # the trace, not a route) — valid without a fleet, and
-                # never subject to the pause gate. The request-class
-                # plugin seam still applies (TRACE bypasses _dispatch,
-                # where other endpoints' plugins are resolved).
+            if endpoint in (EndPoint.TRACE, EndPoint.SOLVER,
+                            EndPoint.PROFILE):
+                # Observability endpoints: cluster FILTERS recorded
+                # traces/passes (it is a label on the record, not a
+                # route) — valid without a fleet, and never subject to
+                # the pause gate; PROFILE is process-wide by nature (one
+                # device, one profiler gate). The request-class plugin
+                # seam still applies (these bypass _dispatch, where other
+                # endpoints' plugins are resolved).
                 handler = self._request_plugin(endpoint)
                 if handler is not None:
                     body = handler.handle(
                         self._cc, {**params, "cluster": cluster_id},
                         principal)
-                else:
+                elif endpoint is EndPoint.TRACE:
                     body = self._trace_handler(params, cluster_id)
+                elif endpoint is EndPoint.SOLVER:
+                    body = self._solver_handler(params, cluster_id)
+                else:
+                    body = self._profile_handler(params, out_headers)
             else:
                 if cluster_id is None and self._fleet is not None:
                     cluster_id = self._fleet.cluster_id_of(self._cc)
@@ -386,6 +393,60 @@ class CruiseControlApi:
             "numTraces": len(traces),
             "spansClosed": TRACER.spans_closed,
             "traces": traces})
+
+    def _solver_handler(self, p: dict, cluster_id: str | None) -> dict:
+        """GET /solver: recent recorded optimization passes (newest first)
+        from the flight recorder's ring — per-goal acceptance density,
+        candidate-kill attribution, per-round violation trajectories,
+        deficit-sizing decisions, and per-dispatch controller state.
+        ``?cluster=`` / ``?goal=`` filter; ``?entries=`` bounds the
+        response."""
+        from ..utils.flight_recorder import FLIGHT
+        passes = FLIGHT.passes(cluster=cluster_id, goal=p.get("goal"),
+                               limit=p.get("entries", 20))
+        return responses.envelope({
+            "flightRecorderEnabled": FLIGHT.enabled,
+            "ringRounds": FLIGHT.ring_rounds,
+            "numPasses": len(passes),
+            "passesClosed": FLIGHT.passes_closed,
+            "dispatchesRecorded": FLIGHT.dispatches_recorded,
+            "passes": passes})
+
+    def _profile_handler(self, p: dict,
+                         out_headers: dict[str, str]) -> dict:
+        """GET /profile: on-demand device profiling (utils.profiling).
+        ``?duration_s=`` captures a jax.profiler (Perfetto/TensorBoard)
+        trace of whatever the live process executes during the window;
+        ``?microbench=true`` runs the in-process op-class while_loop
+        marginals instead. Both hold the single-flight profiler gate — a
+        concurrent request gets 503 + Retry-After (the breaker response
+        shape)."""
+        from ..utils.profiling import PROFILER, ProfilerBusyError
+        if not self._config.get_boolean("profiling.enabled"):
+            raise ApiError(403, "profiling is disabled "
+                                "(profiling.enabled=false)")
+        try:
+            if p.get("microbench"):
+                result = PROFILER.microbench(
+                    brokers=p.get("brokers", 1000),
+                    partitions=p.get("partitions", 100_000),
+                    iters=p.get("iters", 16))
+                return responses.envelope(
+                    {"profile": "microbench", **result})
+            if "duration_s" not in p:
+                raise ParameterParseError(
+                    "PROFILE requires duration_s (seconds to capture) or "
+                    "microbench=true")
+            result = PROFILER.capture(
+                p["duration_s"],
+                trace_dir=self._config.get("profiling.trace.dir"),
+                max_duration_s=self._config.get_double(
+                    "profiling.max.duration.seconds"))
+            return responses.envelope({"profile": "trace", **result})
+        except ProfilerBusyError as e:
+            out_headers["Retry-After"] = str(
+                max(1, int(e.retry_after_s + 0.5)))
+            raise ApiError(503, str(e)) from None
 
     def _route_cluster(self, endpoint: EndPoint,
                        cluster_id: str | None) -> CruiseControl:

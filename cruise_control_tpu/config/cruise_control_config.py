@@ -410,6 +410,49 @@ def _definition() -> ConfigDef:
     d.define("tracing.jsonl.path", T.STRING, "", None, I.LOW,
              "Append one JSON line per completed trace to this file "
              "(bench/CI artifact hook); empty = off.")
+    d.define("tracing.jsonl.max.bytes", T.LONG, 67_108_864,
+             Range.at_least(0), I.LOW,
+             "Size cap on the tracing JSONL dump: when an append would "
+             "push the file past this, it is rotated to <path>.1 (one "
+             "rotated generation kept) so a long-running process can "
+             "never grow the dump without bound. 0 = unlimited.")
+    d.define("solver.flight.recorder.enabled", T.BOOLEAN, True, None, I.LOW,
+             "Solver flight recorder (utils.flight_recorder): per-goal, "
+             "per-dispatch search telemetry — acceptance density, "
+             "candidate-kill attribution, per-round violation "
+             "trajectories, deficit-sizing decisions, AdaptiveDispatch "
+             "state — served at GET /solver and exported as "
+             "solver_flight_* sensors. Recording never changes solver "
+             "trajectories (byte-parity pinned in tests); disabled, "
+             "every hook is a shared no-op (bench-guarded by "
+             "flight_recorder_noop_overhead).")
+    d.define("solver.flight.recorder.max.passes", T.INT, 64,
+             Range.at_least(1), I.LOW,
+             "Bound on the in-memory ring of recorded optimization "
+             "passes (oldest evicted).")
+    d.define("solver.flight.recorder.ring.rounds", T.INT, 128,
+             Range.at_least(0), I.LOW,
+             "Length of the on-device per-round stats ring carried "
+             "through the single-device move megasteps (~24 bytes per "
+             "slot; older rounds of a longer dispatch are overwritten "
+             "oldest-first). Trace-time constant: changing it recompiles "
+             "the recording chain kernels. 0 records at dispatch "
+             "granularity only.")
+    d.define("profiling.enabled", T.BOOLEAN, True, None, I.LOW,
+             "On-demand device profiling (GET /profile): "
+             "jax.profiler.trace captures of live solves plus the "
+             "in-process op-class microbench (utils.profiling; "
+             "single-flight, busy requests get 503 + Retry-After).")
+    d.define("profiling.trace.dir", T.STRING, "/tmp/cc_profile", None,
+             I.LOW,
+             "Directory receiving Perfetto/TensorBoard trace captures "
+             "(one timestamped subdirectory per capture).")
+    d.define("profiling.max.duration.seconds", T.DOUBLE, 60.0,
+             Range.at_least(0.05), I.LOW,
+             "Cap on one profile capture's duration_s: the capture holds "
+             "the profiler gate and buffers host/device events for its "
+             "whole window, so an oversized request is clamped, not "
+             "honored.")
     d.define("xla.telemetry.enabled", T.BOOLEAN, True, None, I.LOW,
              "Hook jax.monitoring compile events (per padded-bucket-shape "
              "count + seconds — the recompile-churn watchdog), "
@@ -894,7 +937,7 @@ def _definition() -> ConfigDef:
                "fix.offline.replicas", "rebalance", "stop.proposal",
                "pause.sampling", "resume.sampling", "demote.broker", "admin",
                "review", "topic.configuration", "rightsize", "remove.disks",
-               "fleet", "trace"):
+               "fleet", "trace", "solver", "profile"):
         d.define(f"{ep}.parameters.class", T.CLASS, None, None, I.LOW,
                  f"Parameter-parsing plugin for the {ep} endpoint "
                  "(callable(query) -> params dict).")

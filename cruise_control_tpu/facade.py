@@ -158,11 +158,18 @@ class CruiseControl:
         # --scenarios must keep its own JSONL dump path.
         if configure_observability:
             from .utils import xla_telemetry
+            from .utils.flight_recorder import FLIGHT
             from .utils.tracing import TRACER
             TRACER.configure(
                 enabled=config.get_boolean("tracing.enabled"),
                 max_traces=config.get_int("tracing.max.traces"),
-                jsonl_path=config.get("tracing.jsonl.path") or None)
+                jsonl_path=config.get("tracing.jsonl.path") or None,
+                jsonl_max_bytes=config.get_long("tracing.jsonl.max.bytes"))
+            FLIGHT.configure(
+                enabled=config.get_boolean("solver.flight.recorder.enabled"),
+                max_passes=config.get_int("solver.flight.recorder.max.passes"),
+                ring_rounds=config.get_int(
+                    "solver.flight.recorder.ring.rounds"))
             xla_telemetry.install(
                 enabled=config.get_boolean("xla.telemetry.enabled"))
         self._load_monitor = load_monitor or LoadMonitor(config, admin)

@@ -707,6 +707,7 @@ def optimize_chain_sharded(state: ClusterTensors, chain,
                            dispatch=None, dispatch_wide=None,
                            megastep=None, stats=None,
                            donate_input: bool = False,
+                           flight=None,
                            ) -> tuple[ClusterTensors, list[dict]]:
     """Sharded analogue of ``analyzer.chain.optimize_chain``: the whole
     chain in one dispatch over the mesh, same info-dict contract and error
@@ -724,7 +725,12 @@ def optimize_chain_sharded(state: ClusterTensors, chain,
     and are billed to ``dispatch_wide`` so they cannot overshoot (then
     depress) the base-width budget. ``donate_input`` declares the
     caller relinquishes ``state`` (e.g. a fresh shard_cluster
-    placement) so even the first dispatch may donate."""
+    placement) so even the first dispatch may donate. ``flight`` (a
+    utils.flight_recorder pass handle) records per-goal entry/exit
+    violations, sizing decisions, and per-dispatch telemetry on the
+    bounded path — at DISPATCH granularity: the per-round stats ring is
+    single-device machinery (its reductions would need extra collectives
+    under the mesh)."""
     masks = masks or ExclusionMasks()
     goals = tuple(chain)
     if not goals:
@@ -737,7 +743,8 @@ def optimize_chain_sharded(state: ClusterTensors, chain,
             state, goals, constraint, cfg, num_topics, mesh, masks, presence,
             swap_moves, swap_max_rounds, dispatch_rounds, dispatch_target_s,
             dispatch=dispatch, dispatch_wide=dispatch_wide,
-            megastep=megastep, stats=stats, donate_input=donate_input)
+            megastep=megastep, stats=stats, donate_input=donate_input,
+            flight=flight)
     fn = _make_chain_full(mesh, goals, constraint, cfg, num_topics, presence,
                           swap_moves, swap_max_rounds)
     state, stats_dev = fn(state, masks)
@@ -845,6 +852,7 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
                                     dispatch=None, dispatch_wide=None,
                                     megastep=None, stats=None,
                                     donate_input: bool = False,
+                                    flight=None,
                                     ) -> tuple[ClusterTensors, list[dict]]:
     """Host-looped per-goal sharded driver: the trajectory of
     ``_chain_full_local`` with every device dispatch bounded — starting at
@@ -858,6 +866,8 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
         AdaptiveDispatch, deficit_sized_config, donation_enabled,
         run_bounded_pass, strip_mutable,
     )
+    from ..utils.flight_recorder import _NULL_PASS
+    flight = flight if flight is not None else _NULL_PASS
     controller = dispatch if dispatch is not None \
         else AdaptiveDispatch(dispatch_rounds, dispatch_target_s)
     donate = donation_enabled(megastep)
@@ -882,7 +892,8 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
     stats_fn = base_kernels[2]
     can_donate = [bool(donate_input)]
 
-    def run_pass(kernels, phase, st, idx, prior, pass_cap: int, ctl):
+    def run_pass(kernels, phase, st, idx, prior, pass_cap: int, ctl,
+                 goal_flight):
         move_k, _, _stats_k, move_d, _ = kernels
         # Swap kernels always come from the BASE factory result: the swap
         # bodies close over (swap_moves, swap_max_rounds) only — cfg never
@@ -909,11 +920,11 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
                 k = move_k if phase == "move" else swap_k
                 st, applied, r = k(st, masks, idx, prior, b)
             can_donate[0] = True
-            return st, applied, r, donate
+            return st, applied, r, donate, None
 
         return run_bounded_pass(enqueue, st, pass_cap, ctl,
                                 async_readback=async_rb, stats=stats,
-                                kind=phase)
+                                kind=phase, flight=goal_flight)
 
     for g, goal in enumerate(goals):
         idx = jnp.int32(g)
@@ -922,6 +933,9 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
         per_goal["viol_before"].append(float(viol0))
         per_goal["obj_before"].append(float(obj0))
         per_goal["offline_before"].append(int(offline0))
+        gf = flight.goal(goal.name)
+        gf.entry(violation=float(viol0), objective=float(obj0),
+                 offline=int(offline0))
         # Deficit-aware sizing for count goals (chain.deficit_sized_config
         # semantics): a sized config selects its own phase kernels — the
         # lru_cached factory bounds the compile set to the pow2-quantized
@@ -929,6 +943,12 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
         cfg_g = cfg
         if deficit_cap > 0 and goal.count_based:
             cfg_g = deficit_sized_config(cfg, float(viol0), deficit_cap)
+            gf.sizing(entry_violation=float(viol0),
+                      base_moves=cfg.moves_per_round,
+                      base_sources=cfg.num_sources,
+                      sized_moves=cfg_g.moves_per_round,
+                      sized_sources=cfg_g.num_sources, cap=deficit_cap)
+        gf.grid(cfg_g.num_sources, cfg_g.num_dests, cfg_g.moves_per_round)
         kernels_g = base_kernels if cfg_g is cfg else \
             _make_chain_phase_kernels(mesh, goals, constraint, cfg_g,
                                       num_topics, presence, swap_moves,
@@ -952,13 +972,13 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
         if ran:
             while rounds < cfg.max_rounds:
                 state, m_, r = run_pass(kernels_g, "move", state, idx,
-                                        prior, cfg.max_rounds, ctl_g)
+                                        prior, cfg.max_rounds, ctl_g, gf)
                 moves_total += m_
                 rounds += r
                 if not goal.supports_swap:
                     break
                 state, sw, sr = run_pass(kernels_g, "swap", state, idx,
-                                         prior, swap_max_rounds, ctl_g)
+                                         prior, swap_max_rounds, ctl_g, gf)
                 swaps_total += sw
                 rounds += sr
                 if sw == 0:
@@ -968,6 +988,8 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
             # Skipped goal: state untouched, entry stats ARE exit stats
             # (saves the second stats dispatch per idle goal).
             viol1, obj1, offline1 = viol0, obj0, offline0
+        gf.exit(violation=float(viol1), objective=float(obj1),
+                offline=int(offline1))
         per_goal["viol_after"].append(float(viol1))
         per_goal["obj_after"].append(float(obj1))
         per_goal["offline_after"].append(int(offline1))

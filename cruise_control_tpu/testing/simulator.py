@@ -253,6 +253,13 @@ class ScenarioScore:
         self.anomalies_handled = 0
         self.events_applied = 0
         self.faults_injected = 0
+        # Flight-recorder summary of every optimizer pass the scenario
+        # drove (utils.flight_recorder.summarize_passes): acceptance
+        # density, kill attribution, per-goal rounds/moves — the WHY
+        # behind a balancedness move, not just that it moved. None when
+        # the recorder is disabled. Wall-clock-free, so it keeps the
+        # byte-identical-JSON determinism contract.
+        self.solver_flight: dict | None = None
 
     # -- per-tick observation ----------------------------------------------
     def observe_tick(self, tick: int, balancedness: float | None,
@@ -355,6 +362,7 @@ class ScenarioScore:
                 "stalenessTicksMax": self.staleness_ticks_max,
             },
             "deadLetters": self.dead_letters,
+            "solverFlight": self.solver_flight,
             "fixesStarted": self.fixes_started,
             "anomaliesHandled": self.anomalies_handled,
             "eventsApplied": self.events_applied,
@@ -682,8 +690,15 @@ class ClusterSimulator:
                                 degraded=degraded)
 
     def run(self) -> ScenarioResult:
+        from ..utils.flight_recorder import FLIGHT, summarize_passes
         from ..utils.tracing import TRACER
         t0 = time.perf_counter()
+        # Flight-recorder window for THIS scenario's solves: the marker
+        # bounds passes_since to what the twin itself drove (the host's
+        # own passes closed before the marker are excluded; the recorder
+        # is process-global, so a concurrent host solve could still land
+        # in the window — scenario runs are sequential in practice).
+        flight_marker = FLIGHT.marker()
         with TRACER.span("scenario.run", operation="scenario",
                          scenario=self.spec.name, seed=self.seed,
                          ticks=self.spec.ticks) as sp:
@@ -694,6 +709,27 @@ class ClusterSimulator:
                 by_state.get("abandoned", 0) for by_state in counts.values())
             if self.chaos is not None:
                 self.score.faults_injected = self.chaos.schedule.faults_injected
+            if FLIGHT.enabled:
+                sf = summarize_passes(FLIGHT.passes_since(flight_marker))
+                # Drop the dispatch count: on the bounded path the
+                # AdaptiveDispatch controller partitions the same total
+                # rounds into a WALL-CLOCK-dependent number of dispatches,
+                # and the score JSON must stay byte-identical at one seed.
+                # Rounds, moves, and densities are budget-partitioning-
+                # invariant (the megastep trajectory contract). The
+                # per-round-derived fields (killAttribution, per-goal
+                # violationTrajectory) are invariant only while every
+                # dispatch's rounds fit the ring — i.e. while
+                # solver.flight.recorder.ring.rounds (128) >= the pass's
+                # max.solver.rounds: a longer dispatch overwrites its
+                # oldest rows, and WHICH rows survive depends on the
+                # partitioning. The simulator's config pins
+                # max.solver.rounds=40, so the canonical library (and any
+                # scenario keeping that default) is safely inside the
+                # bound; overriding it past ring.rounds trades the
+                # byte-identical guarantee for deeper logs.
+                sf.pop("dispatches", None)
+                self.score.solver_flight = sf
             sp.set(slo_violations=len(self.score.slo_violations()),
                    replica_moves=self.score.replica_moves,
                    heal_p95_ticks=self.score.time_to_heal_p95_ticks(),

@@ -1,0 +1,133 @@
+"""In-process device microbench: per-op-class cost inside a fused
+while_loop, at solver-realistic shapes.
+
+The op-class campaign ROADMAP item 2 waits on (scatter/top-k/small-op
+marginals on a real chip) lived only in ``tools/microbench_device.py`` —
+runnable exclusively from a shell on the host with the TPU grant. This
+module is the same measurement as a library call, served by
+``GET /kafkacruisecontrol/profile?microbench=true`` so the marginals are
+one HTTP call away the day the TPU tunnel unwedges (the CLI tool now
+wraps this module, so the two can never drift).
+
+Marginal method per class (tools/profile_round.py discipline): run k and
+2k iterations of a tight ``lax.while_loop`` of the class's body and
+report ``(t2k - tk) / k`` — dispatch glue and link RTT cancel.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+# Op classes, in the order they appear in the solver round body's cost
+# profile (see tools/profile_parts.py): top-k selections over the
+# flattened replica axis, segment reductions for per-broker aggregates,
+# grid gathers, scatter applies, elementwise sweeps, and the pairwise
+# cumulative-select mask.
+CASE_NAMES = ("topk128", "topk1024", "approx1024", "segsum", "segmax",
+              "gather_grid", "scatter_m", "elemwise", "pairwise_m")
+
+
+def _build_cases(brokers: int, partitions: int):
+    import jax
+    import jax.numpy as jnp
+
+    s = 3
+    n_flat = partitions * s
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (n_flat,))
+    seg = jax.random.randint(key, (n_flat,), 0, brokers)
+    grid = 256 * max(16, min(512, brokers // 4))
+    gscore = jax.random.normal(key, (grid,))
+    gidx = jax.random.randint(key, (grid,), 0, brokers)
+    m = 512
+    midx = jax.random.randint(key, (m,), 0, brokers)
+    mvals = jax.random.normal(key, (m, 4))
+    loads = jax.random.normal(key, (brokers, 4))
+
+    def loop(body, carry, iters):
+        def c(st):
+            return st[0] < iters
+
+        def bd(st):
+            i, x = st
+            return (i + 1, body(x))
+        return jax.lax.while_loop(c, bd, (jnp.int32(0), carry))[1]
+
+    @partial(jax.jit, static_argnames=("iters", "which"))
+    def run(x, iters, which):
+        if which == "topk128":
+            return loop(lambda v: jax.lax.top_k(v + 1.0, 128)[0].sum() + v,
+                        x, iters)
+        if which == "topk1024":
+            return loop(lambda v: jax.lax.top_k(v + 1.0, 1024)[0].sum() + v,
+                        x, iters)
+        if which == "approx1024":
+            return loop(
+                lambda v: jax.lax.approx_max_k(v + 1.0, 1024)[0].sum() + v,
+                x, iters)
+        if which == "segsum":
+            return loop(
+                lambda v: v + jax.ops.segment_sum(
+                    v, seg, num_segments=brokers + 1)[seg] * 1e-9, x, iters)
+        if which == "segmax":
+            return loop(
+                lambda v: v + jax.ops.segment_max(
+                    v, seg, num_segments=brokers + 1)[seg] * 1e-9, x, iters)
+        if which == "gather_grid":
+            return loop(
+                lambda v: v + (v[gidx % grid] * 1e-9).sum(), x, iters)
+        if which == "scatter_m":
+            return loop(
+                lambda v: v.at[midx].add(mvals * 1e-9), x, iters)
+        if which == "elemwise":
+            return loop(lambda v: jnp.where(v > 0, v * 0.999999, v), x, iters)
+        if which == "pairwise_m":
+            # attach_cumulative-like [m, m] mask + matmul
+            def bd(v):
+                mask = (v[:, :1] > v[None, :, 0]).astype(jnp.float32)
+                return v + (mask @ v) * 1e-9
+            return loop(bd, x, iters)
+        raise ValueError(which)
+
+    inputs = {"topk128": w, "topk1024": w, "approx1024": w, "segsum": w,
+              "segmax": w, "gather_grid": gscore, "scatter_m": loads,
+              "elemwise": w, "pairwise_m": mvals}
+    return run, inputs
+
+
+def run_microbench(brokers: int = 1000, partitions: int = 100_000,
+                   iters: int = 16,
+                   cases: tuple[str, ...] | None = None) -> dict:
+    """Measure each op class's marginal ms/iteration inside a fused
+    while_loop at (brokers, partitions) scale. Returns
+    ``{platform, brokers, partitions, iters, results: {case: ms_per_iter
+    | {"error": ...}}}`` — a failed class records its error and the rest
+    keep running (the same per-case isolation as the CLI tool)."""
+    import jax
+
+    run, inputs = _build_cases(brokers, partitions)
+    results: dict[str, float | dict] = {}
+    for name in (cases or CASE_NAMES):
+        if name not in inputs:
+            results[name] = {"error": f"unknown case {name!r}"}
+            continue
+        x = inputs[name]
+        try:
+            # Warm EACH timed variant (iters is static: k and 2k are
+            # separate compilations a smaller warmup would not cover).
+            jax.block_until_ready(run(x, iters, name))
+            jax.block_until_ready(run(x, 2 * iters, name))
+            t0 = time.monotonic()
+            jax.block_until_ready(run(x, iters, name))
+            t1 = time.monotonic()
+            jax.block_until_ready(run(x, 2 * iters, name))
+            t2 = time.monotonic()
+            results[name] = round(
+                ((t2 - t1) - (t1 - t0)) / iters * 1e3, 4)
+        except Exception as e:  # noqa: BLE001 — per-case isolation
+            results[name] = {"error": f"{type(e).__name__}: {e}"}
+    return {"platform": jax.devices()[0].platform,
+            "brokers": int(brokers), "partitions": int(partitions),
+            "iters": int(iters), "unit": "ms_per_iter",
+            "results": results}

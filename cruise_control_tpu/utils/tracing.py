@@ -34,6 +34,7 @@ from __future__ import annotations
 import collections
 import itertools
 import json
+import os
 import threading
 import time
 
@@ -198,8 +199,10 @@ class Tracer:
         self._ring: collections.deque[Trace] = \
             collections.deque(maxlen=max_traces)
         self._jsonl_path: str | None = None
+        self._jsonl_max_bytes = 0
         self.spans_closed = 0
         self.traces_completed = 0
+        self.jsonl_rotations = 0
 
     # -- configuration -----------------------------------------------------
     @property
@@ -208,10 +211,14 @@ class Tracer:
 
     def configure(self, enabled: bool | None = None,
                   max_traces: int | None = None,
-                  jsonl_path: str | None = ...) -> None:
+                  jsonl_path: str | None = ...,
+                  jsonl_max_bytes: int | None = None) -> None:
         """Apply the config surface (tracing.enabled / tracing.max.traces /
-        tracing.jsonl.path). ``jsonl_path``: ``...`` = leave unchanged,
-        None/"" = off, a path = append one JSON line per trace."""
+        tracing.jsonl.path / tracing.jsonl.max.bytes). ``jsonl_path``:
+        ``...`` = leave unchanged, None/"" = off, a path = append one JSON
+        line per trace. ``jsonl_max_bytes``: rotate the dump to
+        ``<path>.1`` before an append would push it past this size
+        (0 = unlimited)."""
         with self._lock:
             if enabled is not None:
                 self._enabled = bool(enabled)
@@ -220,6 +227,8 @@ class Tracer:
                                                maxlen=max(1, max_traces))
             if jsonl_path is not ...:
                 self._jsonl_path = jsonl_path or None
+            if jsonl_max_bytes is not None:
+                self._jsonl_max_bytes = max(0, int(jsonl_max_bytes))
 
     # -- recording ---------------------------------------------------------
     def span(self, name: str, **attributes):
@@ -272,13 +281,35 @@ class Tracer:
             self.traces_completed += 1
             self._ring.append(trace)
             path = self._jsonl_path
+            max_bytes = self._jsonl_max_bytes
         if path:
             try:
                 line = json.dumps(trace.to_dict()) + "\n"
-                with self._dump_lock, open(path, "a") as f:
-                    f.write(line)
+                with self._dump_lock:
+                    self._maybe_rotate_jsonl(path, len(line), max_bytes)
+                    with open(path, "a") as f:
+                        f.write(line)
             except OSError:  # pragma: no cover — dump is best-effort
                 pass
+
+    def _maybe_rotate_jsonl(self, path: str, incoming: int,
+                            max_bytes: int) -> None:
+        """Size-capped rotation (tracing.jsonl.max.bytes): when the next
+        append would push the dump past the cap, the current file becomes
+        ``<path>.1`` (one rotated generation kept — bounded total footprint
+        of ~2× the cap) and the append starts a fresh file. Called under
+        ``_dump_lock``. A single line larger than the cap still lands (in
+        an otherwise-empty file): dropping traces silently would defeat
+        the dump's whole purpose."""
+        if max_bytes <= 0:
+            return
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return  # no file yet — nothing to rotate
+        if size and size + incoming > max_bytes:
+            os.replace(path, path + ".1")
+            self.jsonl_rotations += 1
 
     # -- export ------------------------------------------------------------
     def traces(self, cluster: str | None = None,

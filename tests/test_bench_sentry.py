@@ -1,0 +1,167 @@
+"""Bench regression sentry (bench.py + bench_baseline.json): the
+deliberate-fixture verification the acceptance bar demands — a stage
+record regressed the way the two reverted TopicReplica fixes regressed
+(balancedness canary flip, new violated goal) MUST fail the comparison;
+perf drift inside the tolerance band must only warn."""
+
+import copy
+import json
+import os
+import pathlib
+
+# bench.py redirects fd 2 at import time unless told not to — a test
+# import must never steal pytest's stderr.
+os.environ["BENCH_KEEP_STDERR"] = "1"
+
+import bench  # noqa: E402
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+BASELINE = {
+    "tolerance": {"balancedness_abs": 0.05, "wall_clock_ratio": 3.0,
+                  "dispatch_ratio": 1.5},
+    "stages": {
+        "rebalance_proposal_wall_clock_16brokers_512partitions": {
+            "balancedness_after": 86.0,
+            "violated_goals_after": ["PotentialNwOutGoal"],
+            "solve_wall_clock_s": 0.2,
+            "dispatch_count": 4,
+        }
+    },
+}
+
+RECORD = {
+    "metric": "rebalance_proposal_wall_clock_16brokers_512partitions",
+    "value": 0.2, "unit": "s", "vs_baseline": 1.0,
+    "extras": {
+        "balancedness_after": 86.0,
+        "violated_goals_after": ["PotentialNwOutGoal"],
+        "solve_wall_clock_s": 0.2,
+        "dispatch_count": 4,
+    },
+}
+
+
+def _verdict(mutate):
+    record = copy.deepcopy(RECORD)
+    mutate(record["extras"])
+    return bench.compare_stage_to_baseline(record, BASELINE)
+
+
+def test_clean_stage_passes():
+    v = _verdict(lambda ex: None)
+    assert v["extras"]["status"] == "ok"
+    assert v["value"] == 1.0 and not v["extras"]["canaries"]
+
+
+def test_balancedness_canary_fails():
+    # The exact historical regression: 86.0 -> 82.74 must FAIL.
+    v = _verdict(lambda ex: ex.update(balancedness_after=82.74))
+    assert v["extras"]["status"] == "fail"
+    assert v["value"] == 0.0
+    assert any("balancedness" in c for c in v["extras"]["canaries"])
+
+
+def test_balancedness_within_tolerance_ok():
+    v = _verdict(lambda ex: ex.update(balancedness_after=85.96))
+    assert v["extras"]["status"] == "ok"
+
+
+def test_new_violated_goal_fails():
+    v = _verdict(lambda ex: ex.update(violated_goals_after=[
+        "PotentialNwOutGoal", "CpuUsageDistributionGoal"]))
+    assert v["extras"]["status"] == "fail"
+    assert any("CpuUsageDistributionGoal" in c
+               for c in v["extras"]["canaries"])
+
+
+def test_goal_leaving_violated_set_warns_only():
+    # An IMPROVEMENT must not fail — but must be flagged so the baseline
+    # gets re-pinned instead of silently drifting.
+    v = _verdict(lambda ex: ex.update(violated_goals_after=[]))
+    assert v["extras"]["status"] == "warn"
+    assert not v["extras"]["canaries"]
+    assert any("re-pin" in w for w in v["extras"]["warnings"])
+
+
+def test_wall_clock_and_dispatch_drift_warn_only():
+    v = _verdict(lambda ex: ex.update(solve_wall_clock_s=10.0,
+                                      dispatch_count=40))
+    assert v["extras"]["status"] == "warn"
+    assert v["value"] == 1.0
+    assert len(v["extras"]["warnings"]) == 2
+
+
+def test_unknown_stage_and_missing_baseline():
+    record = copy.deepcopy(RECORD)
+    record["metric"] = "rebalance_proposal_wall_clock_unpinned_stage"
+    assert bench.compare_stage_to_baseline(record, BASELINE) is None
+    assert bench.load_baseline("/nonexistent/baseline.json") is None
+
+
+def test_committed_baseline_is_valid():
+    """The checked-in bench_baseline.json parses and covers the two
+    BENCH_SCALE=small stages CI actually runs."""
+    baseline = json.loads((ROOT / "bench_baseline.json").read_text())
+    stages = baseline["stages"]
+    for b, p, drain in bench.STAGES[:2]:
+        name = f"rebalance_proposal_wall_clock_{b}brokers_" \
+            + (f"{p // 1000}kpartitions" if p >= 1000 else f"{p}partitions")
+        assert name in stages, f"baseline missing CI stage {name}"
+        entry = stages[name]
+        assert isinstance(entry["balancedness_after"], float)
+        assert isinstance(entry["violated_goals_after"], list)
+    tol = baseline["tolerance"]
+    assert tol["balancedness_abs"] > 0 and tol["wall_clock_ratio"] > 1
+
+
+def test_flight_recorder_noop_overhead_probe():
+    """The bench guard the acceptance bar names: the probe runs and the
+    disabled-path cost stays ns-scale (generous CI bound — the guard's
+    job is catching an accidental O(work) disabled path, not ns drift)."""
+    ns = bench._flight_recorder_noop_overhead_ns(iterations=2000)
+    assert 0 < ns < 100_000
+
+
+def test_sentry_summary_statuses():
+    rec = copy.deepcopy(RECORD)
+    ok = bench.compare_stage_to_baseline(rec, BASELINE)
+    emitted = []
+    orig = bench._emit
+    bench._emit = emitted.append
+    try:
+        bench._emit_sentry_summary([ok], BASELINE)
+        rec2 = copy.deepcopy(RECORD)
+        rec2["extras"]["balancedness_after"] = 1.0
+        bad = bench.compare_stage_to_baseline(rec2, BASELINE)
+        bench._emit_sentry_summary([ok, bad], BASELINE)
+        bench._emit_sentry_summary([], None)
+    finally:
+        bench._emit = orig
+    assert emitted[0]["extras"]["status"] == "ok"
+    assert emitted[1]["extras"]["status"] == "fail"
+    assert emitted[1]["value"] == 0.0
+    assert emitted[2]["extras"]["status"] == "no_baseline"
+
+
+def test_sentry_summary_incomplete_when_baselined_stage_missing():
+    """A baselined stage that never produced a verdict (timed out /
+    crashed / budget-skipped) must surface as 'incomplete' — a regression
+    severe enough to also break its stage must not pass by breaking it."""
+    rec = copy.deepcopy(RECORD)
+    ok = bench.compare_stage_to_baseline(rec, BASELINE)
+    two_stage = copy.deepcopy(BASELINE)
+    two_stage["stages"]["rebalance_proposal_wall_clock_50brokers_2kpartitions"] = \
+        dict(two_stage["stages"][RECORD["metric"]])
+    emitted = []
+    orig = bench._emit
+    bench._emit = emitted.append
+    try:
+        bench._emit_sentry_summary([ok], two_stage)
+    finally:
+        bench._emit = orig
+    ex = emitted[0]["extras"]
+    assert ex["status"] == "incomplete"
+    assert emitted[0]["value"] == 0.0
+    assert ex["stages_missing"] == [
+        "rebalance_proposal_wall_clock_50brokers_2kpartitions"]

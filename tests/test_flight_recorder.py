@@ -1,0 +1,462 @@
+"""Solver flight recorder (round 12): ring decode, kill attribution,
+pass ring/filters, the byte-identical trajectory parity contract at two
+padded bucket shapes, the GET /solver surface, and the on-demand
+profiling gate.
+
+The parity tests ARE the acceptance bar: recording adds reductions over
+tensors the round body already computes — never a new selection input —
+so the solver trajectory must be byte-identical with recording on or
+off, per shape, on the bounded megastep path that carries the on-device
+per-round ring."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.utils.flight_recorder import (
+    FLIGHT, NO_FLIGHT, STAT_COLUMNS, FlightRecorder, decode_ring,
+    summarize_passes,
+)
+
+
+@pytest.fixture(autouse=True)
+def _restore_flight():
+    yield
+    FLIGHT.configure(enabled=True, max_passes=64, ring_rounds=128)
+    FLIGHT.clear()
+
+
+# ---- ring decode ---------------------------------------------------------
+
+def test_decode_ring_no_wrap():
+    ring = np.arange(12, dtype=np.float32).reshape(4, 3)
+    rows = decode_ring(ring, 2)
+    assert rows == [[0.0, 1.0, 2.0], [3.0, 4.0, 5.0]]
+    assert decode_ring(ring, 0) == []
+
+
+def test_decode_ring_wraps_oldest_first():
+    # 6 rounds into a 4-slot ring: rounds 2..5 survive, oldest at
+    # slot 6 % 4 = 2.
+    ring = np.zeros((4, 1), dtype=np.float32)
+    for r in range(6):
+        ring[r % 4, 0] = r
+    rows = decode_ring(ring, 6)
+    assert [r[0] for r in rows] == [2.0, 3.0, 4.0, 5.0]
+
+
+# ---- goal records --------------------------------------------------------
+
+def _fake_ring(rows):
+    """rows: list of (applied, valid, accepted, positive, winners, viol)."""
+    return np.asarray(rows, dtype=np.float32)
+
+
+def test_kill_attribution_and_trajectory():
+    rec = FlightRecorder()
+    with rec.pass_scope(seq=1) as p:
+        g = p.goal("TopicReplicaDistributionGoal")
+        g.grid(64, 16, 32)
+        g.entry(violation=40.0)
+        g.dispatch("move", budget=8, rounds=2, applied=3, ring=_fake_ring([
+            (2, 100, 60, 30, 10, 38.0),
+            (1, 90, 50, 20, 5, 37.0)]))
+        g.exit(violation=37.0)
+    (pd,) = rec.passes()
+    (gd,) = pd["goals"]
+    ka = gd["killAttribution"]
+    assert ka["rounds"] == 2
+    assert ka["validCards"] == 190
+    assert ka["killedByPriorVeto"] == 190 - 110        # valid - accepted
+    assert ka["killedByNonPositive"] == 110 - 50       # accepted - positive
+    assert ka["killedByPerSourceReduce"] == 50 - 15    # positive - winners
+    assert ka["killedByDedupRecheck"] == 15 - 3        # winners - applied
+    assert ka["applied"] == 3
+    assert gd["violationTrajectory"] == [38.0, 37.0]
+    # density = applied / rounds / selection_width (= max(moves, sources))
+    assert gd["acceptanceDensity"] == pytest.approx(3 / 2 / 64, abs=1e-6)
+    assert gd["violationBefore"] == 40.0
+    assert gd["violationAfter"] == 37.0
+    rows = gd["dispatches"][0]["rounds_log"]
+    assert list(rows[0]) == list(STAT_COLUMNS)
+
+
+def test_speculative_dispatches_excluded_from_density():
+    rec = FlightRecorder()
+    with rec.pass_scope(seq=1) as p:
+        g = p.goal("g")
+        g.grid(8, 8, 8)
+        g.dispatch("move", budget=4, rounds=4, applied=8)
+        g.dispatch("move", budget=4, rounds=4, applied=0, speculative=True)
+    (pd,) = rec.passes()
+    (gd,) = pd["goals"]
+    assert gd["rounds"] == 4 and gd["movesApplied"] == 8
+    assert gd["dispatchCount"] == 2
+    assert gd["acceptanceDensity"] == pytest.approx(8 / 4 / 8)
+
+
+def test_gridless_goal_summaries_report_no_density():
+    """Fused/sharded-unbounded passes record goal summaries with NO grid
+    (record_goal_infos): density must be 0.0, never raw moves-per-round
+    masquerading as a density > 1."""
+    rec = FlightRecorder()
+    with rec.pass_scope(seq=1) as p:
+        p.set(path="fused")
+        p.record_goal_infos([{"goal": "g", "residual_violation": 2.0,
+                              "violation_before": 9.5, "offline_before": 1,
+                              "rounds": 10, "moves_applied": 50}])
+    (pd,) = rec.passes()
+    (gd,) = pd["goals"]
+    assert gd["movesApplied"] == 50 and gd["rounds"] == 10
+    assert gd["acceptanceDensity"] == 0.0
+    # entry stats from the whole-chain stats land too (violationBefore
+    # must not be null on the production fused path)
+    assert gd["violationBefore"] == 9.5 and gd["offlineBefore"] == 1
+    s = summarize_passes(rec.passes())
+    assert s["meanAcceptanceDensity"] == 0.0
+    assert s["movesApplied"] == 50
+
+
+def test_swap_dispatches_excluded_from_density():
+    """grid() records the MOVE config's geometry; swap kernels run their
+    own fixed grid, so swap dispatches carry no density and stay out of
+    the histogram and the per-goal aggregate."""
+    from cruise_control_tpu.utils.sensors import SENSORS
+    rec = FlightRecorder()
+    with rec.pass_scope(seq=1) as p:
+        g = p.goal("SwapDensityGoal")
+        g.grid(2048, 16, 1024)
+        g.dispatch("move", budget=4, rounds=4, applied=8)
+        g.dispatch("swap", budget=4, rounds=4, applied=32)
+    (pd,) = rec.passes()
+    (gd,) = pd["goals"]
+    swap = [d for d in gd["dispatches"] if d["kind"] == "swap"][0]
+    assert swap["acceptanceDensity"] == 0.0
+    # aggregate density uses move rounds/moves only
+    assert gd["acceptanceDensity"] == pytest.approx(8 / 4 / 2048, abs=1e-6)
+    snap = SENSORS.histogram_snapshot("solver_acceptance_density",
+                                      labels={"goal": "SwapDensityGoal"})
+    assert snap is not None and snap["count"] == 1, \
+        "only the move dispatch may land in the density histogram"
+
+
+def test_pass_ring_bound_filters_and_marker():
+    rec = FlightRecorder(max_passes=2)
+    from cruise_control_tpu.utils.sensors import cluster_label
+    marker0 = rec.marker()
+    for i, cluster in enumerate((None, "alpha", "beta")):
+        with cluster_label(cluster):
+            with rec.pass_scope(seq=i) as p:
+                g = p.goal(f"goal{i}")
+                g.entry(violation=float(i))
+                g.exit(violation=0.0)
+    assert rec.passes_closed == 3
+    passes = rec.passes()
+    assert len(passes) == 2                       # ring bound: oldest gone
+    assert [p["passSeq"] for p in passes] == [2, 1]   # newest first
+    assert rec.passes(cluster="alpha")[0]["passSeq"] == 1
+    assert rec.passes(cluster="nope") == []
+    assert [p["passSeq"] for p in rec.passes(limit=1)] == [2]
+    assert rec.passes(limit=0) == []
+    # goal filter keeps only passes touching the goal AND trims to it
+    got = rec.passes(goal="goal2")
+    assert len(got) == 1 and [g["goal"] for g in got[0]["goals"]] == ["goal2"]
+    # passes_since: bounded best-effort tail, oldest first
+    since = rec.passes_since(marker0)
+    assert [p["passSeq"] for p in since] == [1, 2]
+    assert rec.passes_since(rec.marker()) == []
+
+
+def test_disabled_scope_is_shared_noop():
+    rec = FlightRecorder()
+    rec.configure(enabled=False)
+    p1 = rec.pass_scope(seq=1)
+    p2 = rec.pass_scope(seq=2)
+    assert p1 is p2                      # shared no-op object, no alloc
+    with p1 as p:
+        g = p.goal("x")
+        assert g is NO_FLIGHT
+        assert not g.recording and g.ring_rounds == 0
+        g.entry(violation=1.0)
+        g.grid(8, 8, 8)
+        g.sizing(1.0, 8, 8, 8, 8, 0)
+        g.dispatch("move", 8, 8, 8)
+        g.exit(violation=0.0)
+        p.record_goal_infos([])
+        p.set(path="none")
+    assert rec.passes() == [] and rec.passes_closed == 0
+
+
+def test_configure_ring_and_max_passes():
+    rec = FlightRecorder(max_passes=4, ring_rounds=128)
+    rec.configure(ring_rounds=16, max_passes=1)
+    assert rec.ring_rounds == 16
+    for i in range(3):
+        with rec.pass_scope(seq=i):
+            pass
+    assert len(rec.passes()) == 1
+
+
+def test_summarize_passes_aggregates():
+    rec = FlightRecorder()
+    for i, viol in enumerate((5.0, 3.0)):
+        with rec.pass_scope(seq=i) as p:
+            g = p.goal("g")
+            g.grid(10, 4, 10)
+            g.dispatch("move", budget=4, rounds=4, applied=2,
+                       ring=_fake_ring([(2, 20, 10, 6, 4, viol)] * 4))
+            g.exit(violation=viol)
+    s = summarize_passes(rec.passes())
+    assert s["passes"] == 2 and s["dispatches"] == 2
+    assert s["rounds"] == 8 and s["movesApplied"] == 4
+    assert s["killAttribution"]["killedByPerSourceReduce"] == 2 * 4 * (6 - 4)
+    assert s["byGoal"]["g"]["lastViolationAfter"] == 5.0 \
+        or s["byGoal"]["g"]["lastViolationAfter"] == 3.0
+    assert sorted(s["byGoal"]["g"]["violationTrajectory"]) == [3.0, 5.0]
+    # mean density: each dispatch contributes applied/width per round
+    assert s["meanAcceptanceDensity"] == pytest.approx(2 / 4 / 10, abs=1e-6)
+
+
+# ---- trajectory parity (the acceptance bar) ------------------------------
+
+_G = "cruise_control_tpu.analyzer.goals"
+_PARITY_GOALS = [f"{_G}.RackAwareGoal", f"{_G}.ReplicaCapacityGoal",
+                 f"{_G}.ReplicaDistributionGoal",
+                 f"{_G}.TopicReplicaDistributionGoal"]
+
+
+def _parity_solve(num_brokers, num_partitions, enabled: bool):
+    import jax
+
+    from cruise_control_tpu.analyzer.optimizer import (
+        GoalOptimizer, goals_by_priority,
+    )
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.model.fixtures import Dist, random_cluster
+
+    FLIGHT.configure(enabled=enabled, ring_rounds=16)
+    FLIGHT.clear()
+    state, meta = random_cluster(
+        num_brokers=num_brokers, num_topics=8,
+        num_partitions=num_partitions, rf=3, num_racks=4,
+        dist=Dist.EXPONENTIAL, seed=7, skew_to_first=2.0,
+        target_utilization=0.55)
+    cfg = CruiseControlConfig({
+        # Force the bounded per-goal megastep path — the one that carries
+        # the on-device per-round stats ring.
+        "solver.fused.chain.max.brokers": 1,
+        "solver.dispatch.max.rounds": 8,
+        "max.solver.rounds": 24,
+        "goals": list(_PARITY_GOALS),
+        "hard.goals": _PARITY_GOALS[:2],
+        "anomaly.detection.goals": _PARITY_GOALS[:2],
+    })
+    optimizer = GoalOptimizer(cfg)
+    final, result = optimizer.optimizations(
+        state, meta, goals=goals_by_priority(cfg))
+    jax.block_until_ready(final.assignment)
+    return (np.asarray(final.assignment).tobytes(),
+            np.asarray(final.leader_slot).tobytes(),
+            result.balancedness_after, result.violated_goals_after)
+
+
+@pytest.mark.parametrize("shape", [(16, 512), (50, 2000)],
+                         ids=["bucket512", "bucket2k"])
+def test_recording_parity_byte_identical(shape):
+    """Flight recording on vs. off: byte-identical final assignment and
+    leadership at two padded bucket shapes, identical quality verdicts —
+    AND the recording run actually captured per-round detail."""
+    b, p = shape
+    on = _parity_solve(b, p, enabled=True)
+    passes = FLIGHT.passes()
+    off = _parity_solve(b, p, enabled=False)
+    assert on[0] == off[0], "assignment trajectories diverged"
+    assert on[1] == off[1], "leadership trajectories diverged"
+    assert on[2] == off[2] and on[3] == off[3]
+    # The enabled run recorded the pass with real search telemetry.
+    assert passes and passes[0]["path"] == "bounded"
+    goals = passes[0]["goals"]
+    assert [g["goal"] for g in goals] == [g.rsplit(".", 1)[-1]
+                                          for g in _PARITY_GOALS]
+    moved = [g for g in goals if g["movesApplied"] > 0]
+    assert moved, "no goal recorded applied moves"
+    with_ring = [g for g in moved if g.get("killAttribution")]
+    assert with_ring, "no per-round ring rows captured on the bounded path"
+    g = with_ring[0]
+    assert g["acceptanceDensity"] > 0
+    assert len(g["violationTrajectory"]) >= 1
+    ka = g["killAttribution"]
+    assert ka["applied"] >= 1 and ka["validCards"] >= ka["applied"]
+    assert FLIGHT.passes() == [], "disabled run must record nothing"
+
+
+# ---- GET /solver + /profile ----------------------------------------------
+
+@pytest.fixture(scope="module")
+def solver_api():
+    from cruise_control_tpu.api.server import CruiseControlApi
+    from cruise_control_tpu.common.resources import Resource
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.executor.admin import (
+        InMemoryAdminBackend, PartitionState,
+    )
+    from cruise_control_tpu.executor.executor import Executor
+    from cruise_control_tpu.facade import CruiseControl
+    from cruise_control_tpu.monitor import LoadMonitor, StaticCapacityResolver
+    from cruise_control_tpu.monitor.sampling import SyntheticSampler
+
+    parts = {}
+    for t in range(2):
+        for p in range(8):
+            reps = (0, 1 + (t + p) % 3)
+            parts[(f"t{t}", p)] = PartitionState(f"t{t}", p, reps, reps[0],
+                                                 isr=reps)
+    backend = InMemoryAdminBackend(parts.values())
+    cfg = CruiseControlConfig({
+        "partition.metrics.window.ms": 1000,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "max.solver.rounds": 24,
+        # Bounded path so /solver shows per-dispatch + per-round detail.
+        "solver.fused.chain.max.brokers": 1,
+        "solver.dispatch.max.rounds": 8,
+        "goals": list(_PARITY_GOALS),
+        "hard.goals": _PARITY_GOALS[:2],
+        "anomaly.detection.goals": _PARITY_GOALS[:2],
+        "failed.brokers.file.path": ""})
+    caps = StaticCapacityResolver(
+        {}, {Resource.CPU: 100.0, Resource.DISK: 1e7,
+             Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6})
+    monitor = LoadMonitor(cfg, backend, samplers=[SyntheticSampler()],
+                          capacity_resolver=caps)
+    cc = CruiseControl(cfg, backend, load_monitor=monitor,
+                       executor=Executor(backend, synchronous=True))
+    for k in range(1, 4):
+        monitor.task_runner.run_sampling_once(end_ms=k * 1000)
+    api = CruiseControlApi(cc)
+    api._async_wait_s = 180
+    FLIGHT.clear()
+    yield api
+    api.shutdown()
+    FLIGHT.configure(enabled=True, max_passes=64, ring_rounds=128)
+    FLIGHT.clear()
+
+
+def test_solver_endpoint_serves_real_rebalance(solver_api):
+    status, body, _ = solver_api.handle(
+        "POST", "/kafkacruisecontrol/rebalance", "dryrun=true")
+    assert status == 200, body
+    status, body, _ = solver_api.handle(
+        "GET", "/kafkacruisecontrol/solver", "entries=1")
+    assert status == 200, body
+    assert body["flightRecorderEnabled"] is True
+    assert body["numPasses"] == 1
+    p = body["passes"][0]
+    assert p["path"] == "bounded"
+    assert p["shape"] == {"partitions": 16, "brokers": 4}
+    goals = p["goals"]
+    assert goals and all("acceptanceDensity" in g for g in goals)
+    moved = [g for g in goals if g.get("killAttribution")]
+    assert moved, "expected per-round kill attribution for a real rebalance"
+    assert moved[0]["violationTrajectory"]
+    assert moved[0]["dispatches"][0]["rounds_log"]
+    # goal filter trims each pass to the named goal
+    status, body, _ = solver_api.handle(
+        "GET", "/kafkacruisecontrol/solver",
+        f"goal={goals[0]['goal']}")
+    assert status == 200
+    assert [g["goal"] for g in body["passes"][0]["goals"]] \
+        == [goals[0]["goal"]]
+    # unknown params rejected like every other endpoint
+    status, _body, _ = solver_api.handle(
+        "GET", "/kafkacruisecontrol/solver", "nope=1")
+    assert status == 400
+
+
+def test_solver_endpoint_sensors_exported(solver_api):
+    from cruise_control_tpu.utils.sensors import SENSORS
+    text = solver_api.metrics_text()
+    assert "kafka_cruisecontrol_solver_flight_passes_total" in text
+    assert "kafka_cruisecontrol_solver_acceptance_density_bucket" in text
+    snap = SENSORS.histogram_snapshot(
+        "solver_acceptance_density",
+        labels={"goal": "ReplicaDistributionGoal"})
+    assert snap is None or snap["count"] >= 0  # series shape is valid
+
+
+def test_profile_endpoint_capture_and_busy(solver_api, tmp_path):
+    solver_api._config._values["profiling.trace.dir"] = str(tmp_path)
+    status, body, _ = solver_api.handle(
+        "GET", "/kafkacruisecontrol/profile", "duration_s=0.05")
+    assert status == 200, body
+    assert body["profile"] == "trace"
+    assert body["traceDir"].startswith(str(tmp_path))
+    assert body["numFiles"] >= 1, "profiler produced no trace files"
+    # missing duration_s and microbench → 400
+    status, body, _ = solver_api.handle(
+        "GET", "/kafkacruisecontrol/profile", "")
+    assert status == 400
+    # single-flight: a concurrent holder makes the request fail fast with
+    # Retry-After (the breaker-style busy response)
+    from cruise_control_tpu.utils.profiling import PROFILER
+    PROFILER._acquire(5.0)
+    try:
+        status, body, headers = solver_api.handle(
+            "GET", "/kafkacruisecontrol/profile", "duration_s=0.05")
+        assert status == 503
+        assert int(headers["Retry-After"]) >= 1
+    finally:
+        PROFILER._lock.release()
+
+
+def test_profile_endpoint_disabled(solver_api):
+    solver_api._config._values["profiling.enabled"] = False
+    try:
+        status, body, _ = solver_api.handle(
+            "GET", "/kafkacruisecontrol/profile", "duration_s=0.05")
+        assert status == 403
+    finally:
+        solver_api._config._values["profiling.enabled"] = True
+
+
+def test_profile_busy_error_concurrent_capture(tmp_path):
+    """Two overlapping captures: exactly one wins the gate."""
+    from cruise_control_tpu.utils.profiling import (
+        DeviceProfiler, ProfilerBusyError,
+    )
+    prof = DeviceProfiler()
+    results = []
+
+    def capture():
+        try:
+            results.append(prof.capture(0.2, str(tmp_path)))
+        except ProfilerBusyError as e:
+            results.append(e)
+
+    threads = [threading.Thread(target=capture) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    busy = [r for r in results if isinstance(r, ProfilerBusyError)]
+    ok = [r for r in results if isinstance(r, dict)]
+    assert len(ok) == 1 and len(busy) == 1
+    assert busy[0].retry_after_s >= 0.5
+
+
+def test_microbench_in_process_small():
+    from cruise_control_tpu.utils.microbench import run_microbench
+    out = run_microbench(brokers=20, partitions=200, iters=2,
+                         cases=("elemwise", "segsum"))
+    assert out["unit"] == "ms_per_iter"
+    assert set(out["results"]) == {"elemwise", "segsum"}
+    for v in out["results"].values():
+        assert isinstance(v, float), v   # no errors on CPU
+    bad = run_microbench(brokers=20, partitions=200, iters=2,
+                         cases=("nope",))
+    assert "error" in bad["results"]["nope"]

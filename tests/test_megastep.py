@@ -178,7 +178,7 @@ class _Script:
             if rounds < budget:
                 rounds += 1     # the in-dispatch zero-apply round
                 rounds = min(rounds, budget)
-        return st + applied, applied, rounds, False
+        return st + applied, applied, rounds, False, None
 
 
 class _SpyController(AdaptiveDispatch):

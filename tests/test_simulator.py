@@ -274,6 +274,24 @@ def test_seed_pinned_scenario_regression(name, seed):
     assert d["sloViolations"] == []
 
 
+def test_scenario_score_embeds_solver_flight_summary():
+    """Round-12 satellite: the score carries the flight-recorder summary
+    of the solves the scenario drove — the WHY behind a quality move
+    (acceptance density, kill attribution, per-goal violation
+    trajectories), wall-clock-free so determinism holds."""
+    r = _run("broker_loss_drift", 0)
+    sf = r.score.as_dict()["solverFlight"]
+    assert sf is not None, "flight recorder is on by default"
+    assert sf["passes"] >= 1, "self-healing must have driven solves"
+    assert sf["movesApplied"] >= 1
+    assert set(sf["killAttribution"]) == {
+        "killedByPriorVeto", "killedByNonPositive", "killedByPerSourceReduce",
+        "killedByDedupRecheck"}
+    assert sf["byGoal"], "per-goal summaries expected"
+    g = next(iter(sf["byGoal"].values()))
+    assert "violationTrajectory" in g and "lastViolationAfter" in g
+
+
 def test_broker_loss_time_to_heal_is_finite_and_bounded():
     r = _run("broker_loss_drift", 0)
     heals = r.score.heal_events

@@ -315,3 +315,113 @@ def test_tracing_disabled_no_new_traces(traced_api):
         assert status == 200 and body["tracingEnabled"] is False
     finally:
         TRACER.configure(enabled=True)
+
+
+def test_jsonl_rotation_caps_file_size(tmp_path):
+    """tracing.jsonl.max.bytes: an append that would push the dump past
+    the cap rotates the file to <path>.1 first (one rotated generation
+    kept — total footprint bounded at ~2x the cap); an unlimited cap (0)
+    never rotates."""
+    path = tmp_path / "trace.jsonl"
+    tracer = Tracer()
+    tracer.configure(jsonl_path=str(path))
+    with tracer.span("sizer", operation="bench"):
+        pass
+    line_size = len(path.read_text())
+    # Cap at ~2.5 lines: the 3rd close must rotate.
+    tracer.configure(jsonl_max_bytes=int(2.5 * line_size))
+    path.write_text("")  # restart the dump empty
+    for _ in range(3):
+        with tracer.span("sizer", operation="bench"):
+            pass
+    rotated = tmp_path / "trace.jsonl.1"
+    assert rotated.exists(), "rotation did not happen"
+    assert tracer.jsonl_rotations == 1
+    assert len((rotated).read_text().splitlines()) == 2
+    assert len(path.read_text().splitlines()) == 1
+    # Every line in both generations is still valid JSON.
+    for f in (path, rotated):
+        for ln in f.read_text().splitlines():
+            json.loads(ln)
+    # A second overflow replaces the rotated generation (bounded at one).
+    for _ in range(2):
+        with tracer.span("sizer", operation="bench"):
+            pass
+    assert tracer.jsonl_rotations == 2
+    assert len(rotated.read_text().splitlines()) == 2
+
+
+def test_jsonl_no_rotation_when_unlimited(tmp_path):
+    path = tmp_path / "trace.jsonl"
+    tracer = Tracer()
+    tracer.configure(jsonl_path=str(path), jsonl_max_bytes=0)
+    for _ in range(5):
+        with tracer.span("a", operation="bench"):
+            pass
+    assert not (tmp_path / "trace.jsonl.1").exists()
+    assert len(path.read_text().splitlines()) == 5
+
+
+# ---- xla_telemetry unit coverage (round 12 satellite) --------------------
+
+def test_device_memory_bytes_cpu_live_array_fallback():
+    """CPU backends have no allocator stats: refresh_device_gauges must
+    fall back to the summed live jax.Array footprint so the
+    device_memory_bytes series exists everywhere."""
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.utils import xla_telemetry
+    from cruise_control_tpu.utils.sensors import SENSORS
+
+    keep = jnp.ones((256, 4), jnp.float32)  # ≥ 4 KB live on the device
+    xla_telemetry.refresh_device_gauges()
+    gauges = {k: v for k, v in SENSORS._gauges.items()
+              if k[0] == "device_memory_bytes"}
+    assert gauges, "no device_memory_bytes series on CPU"
+    cpu_in_use = [(k, v) for k, v in gauges.items()
+                  if ("kind", "bytes_in_use") in k[1]
+                  and any(lk == "device" and lv.startswith("cpu")
+                          for lk, lv in k[1])]
+    assert cpu_in_use, f"no cpu bytes_in_use gauge in {list(gauges)}"
+    assert max(v for _k, v in cpu_in_use) >= keep.nbytes
+
+
+def test_record_dispatch_counter_and_histogram_labels():
+    from cruise_control_tpu.utils import xla_telemetry
+    from cruise_control_tpu.utils.sensors import SENSORS
+
+    def counter(name, kind):
+        return SENSORS._counters.get((name, (("kind", kind),)), 0.0)
+
+    base = counter("solver_dispatches", "move")
+    base_don = counter("solver_dispatch_donations", "move")
+    base_spec = counter("solver_dispatch_speculative", "move")
+    snap0 = SENSORS.histogram_snapshot("solver_dispatch_rounds",
+                                       labels={"kind": "move"})
+    count0 = snap0["count"] if snap0 else 0
+    xla_telemetry.record_dispatch("move", rounds=12, donated=True)
+    xla_telemetry.record_dispatch("move", rounds=3, speculative=True)
+    assert counter("solver_dispatches", "move") == base + 2
+    assert counter("solver_dispatch_donations", "move") == base_don + 1
+    assert counter("solver_dispatch_speculative", "move") == base_spec + 1
+    snap = SENSORS.histogram_snapshot("solver_dispatch_rounds",
+                                      labels={"kind": "move"})
+    assert snap["count"] == count0 + 2
+    assert snap["buckets"] == xla_telemetry.DISPATCH_ROUND_BUCKETS
+    # swap dispatches land in their OWN labeled series
+    swap_base = counter("solver_dispatches", "swap")
+    xla_telemetry.record_dispatch("swap", rounds=1)
+    assert counter("solver_dispatches", "swap") == swap_base + 1
+
+
+def test_record_dispatch_annotates_ambient_span():
+    from cruise_control_tpu.utils import xla_telemetry
+    tracer_was = TRACER.enabled
+    TRACER.configure(enabled=True)
+    try:
+        with TRACER.span("goal.solve") as sp:
+            xla_telemetry.record_dispatch("move", rounds=4)
+            xla_telemetry.record_dispatch("move", rounds=4)
+            assert sp.attributes["dispatches"] == 2
+    finally:
+        TRACER.configure(enabled=tracer_was)

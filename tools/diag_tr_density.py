@@ -11,12 +11,10 @@ by per-partition dedup, or rejected by the joint recheck.
 
 from __future__ import annotations
 
-import os
 import sys
 import time
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/cc_tpu_jax_cache")
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common
 
 
 def main() -> int:
@@ -27,8 +25,7 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
-    from cruise_control_tpu import enable_persistent_compile_cache
-    enable_persistent_compile_cache()
+    _common.enable_cache()
     from cruise_control_tpu.analyzer.chain import optimize_goal_in_chain
     from cruise_control_tpu.analyzer.constraint import BalancingConstraint
     from cruise_control_tpu.analyzer.optimizer import (

@@ -5,109 +5,34 @@ totals; this attributes them to op classes by timing tight while_loops of
 each class at solver-realistic shapes. Marginal method per class: run k and
 2k iterations, report (t2k - tk) / k — dispatch/RTT cancels.
 
+Thin CLI over ``cruise_control_tpu.utils.microbench`` — the SAME
+measurement the live service serves at
+``GET /kafkacruisecontrol/profile?microbench=true``, so the shell tool and
+the HTTP surface can never drift.
+
     python tools/microbench_device.py [brokers] [partitions]   # ambient env = TPU
 """
 
 from __future__ import annotations
 
-import os
 import sys
-import time
-from functools import partial
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/cc_tpu_jax_cache")
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common
 
 
 def main() -> int:
     b = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
     p = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
-    import jax
-    import jax.numpy as jnp
+    _common.enable_cache()
+    from cruise_control_tpu.utils.microbench import run_microbench
 
-    from cruise_control_tpu import enable_persistent_compile_cache
-    enable_persistent_compile_cache()
-    print(f"platform: {jax.devices()[0].platform}  B={b} P={p}", flush=True)
-
-    s = 3
-    n_flat = p * s
-    key = jax.random.PRNGKey(0)
-    w = jax.random.normal(key, (n_flat,))
-    seg = jax.random.randint(key, (n_flat,), 0, b)
-    grid = 256 * max(16, min(512, b // 4))
-    gscore = jax.random.normal(key, (grid,))
-    gidx = jax.random.randint(key, (grid,), 0, b)
-    m = 512
-    midx = jax.random.randint(key, (m,), 0, b)
-    mvals = jax.random.normal(key, (m, 4))
-    loads = jax.random.normal(key, (b, 4))
-
-    def loop(body, carry, iters):
-        def c(st):
-            return st[0] < iters
-
-        def bd(st):
-            i, x = st
-            return (i + 1, body(x))
-        return jax.lax.while_loop(c, bd, (jnp.int32(0), carry))[1]
-
-    @partial(jax.jit, static_argnames=("iters", "which"))
-    def run(x, iters, which):
-        if which == "topk128":
-            return loop(lambda v: jax.lax.top_k(v + 1.0, 128)[0].sum() + v,
-                        x, iters)
-        if which == "topk1024":
-            return loop(lambda v: jax.lax.top_k(v + 1.0, 1024)[0].sum() + v,
-                        x, iters)
-        if which == "approx1024":
-            return loop(
-                lambda v: jax.lax.approx_max_k(v + 1.0, 1024)[0].sum() + v,
-                x, iters)
-        if which == "segsum":
-            return loop(
-                lambda v: v + jax.ops.segment_sum(v, seg, num_segments=b + 1)[
-                    seg] * 1e-9, x, iters)
-        if which == "segmax":
-            return loop(
-                lambda v: v + jax.ops.segment_max(v, seg, num_segments=b + 1)[
-                    seg] * 1e-9, x, iters)
-        if which == "gather_grid":
-            return loop(
-                lambda v: v + (v[gidx % grid] * 1e-9).sum(), x, iters)
-        if which == "scatter_m":
-            return loop(
-                lambda v: v.at[midx].add(mvals * 1e-9), x, iters)
-        if which == "elemwise":
-            return loop(lambda v: jnp.where(v > 0, v * 0.999999, v), x, iters)
-        if which == "pairwise_m":
-            # attach_cumulative-like [m, m] mask + matmul
-            def bd(v):
-                mask = (v[:, :1] > v[None, :, 0]).astype(jnp.float32)
-                return v + (mask @ v) * 1e-9
-            return loop(bd, x, iters)
-        raise ValueError(which)
-
-    cases = [
-        ("topk128", w), ("topk1024", w), ("approx1024", w),
-        ("segsum", w), ("segmax", w),
-        ("gather_grid", gscore), ("scatter_m", loads),
-        ("elemwise", w), ("pairwise_m", mvals),
-    ]
-    for name, x in cases:
-        try:
-            # Warm EACH timed variant (iters is static: 16 and 32 are
-            # separate compilations the iters=2 warmup would not cover).
-            jax.block_until_ready(run(x, 16, name))
-            jax.block_until_ready(run(x, 32, name))
-            t0 = time.monotonic()
-            jax.block_until_ready(run(x, 16, name))
-            t1 = time.monotonic()
-            jax.block_until_ready(run(x, 32, name))
-            t2 = time.monotonic()
-            per = ((t2 - t1) - (t1 - t0)) / 16
-            print(f"{name:14s} ~{per * 1e3:8.3f} ms/iter", flush=True)
-        except Exception as e:  # noqa: BLE001
-            print(f"{name:14s} FAILED: {type(e).__name__}: {e}", flush=True)
+    out = run_microbench(brokers=b, partitions=p)
+    print(f"platform: {out['platform']}  B={b} P={p}", flush=True)
+    for name, res in out["results"].items():
+        if isinstance(res, dict):
+            print(f"{name:14s} FAILED: {res['error']}", flush=True)
+        else:
+            print(f"{name:14s} ~{res:8.3f} ms/iter", flush=True)
     return 0
 
 

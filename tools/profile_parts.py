@@ -10,12 +10,10 @@ sum of parts and the fused round is what XLA fusion buys.
 
 from __future__ import annotations
 
-import os
 import sys
 import time
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/cc_tpu_jax_cache")
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common
 
 
 def bench(fn, *args, n=20, **kw):
@@ -36,8 +34,7 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
-    from cruise_control_tpu import enable_persistent_compile_cache
-    enable_persistent_compile_cache()
+    _common.enable_cache()
     from cruise_control_tpu.analyzer.candidates import (
         compute_deltas, generate_candidates,
     )

@@ -10,11 +10,10 @@ k and 2k rounds and report (t2k - tk) / k — RTT and dispatch glue cancel.
 
 from __future__ import annotations
 
-import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _common
 
 
 def main() -> int:
@@ -23,8 +22,7 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
-    from cruise_control_tpu import enable_persistent_compile_cache
-    enable_persistent_compile_cache()
+    _common.enable_cache()
     from cruise_control_tpu.analyzer.chain import chain_optimize_rounds
     from cruise_control_tpu.analyzer.optimizer import (
         GoalOptimizer, goals_by_priority,
