@@ -110,6 +110,9 @@ _EMITTED: list[dict] = []
 
 
 def _emit(obj) -> None:
+    # ccsa: ok[CCSA007] single-writer journal: only the main bench thread
+    # appends; the watchdog hard-exit path READS a snapshot under the GIL
+    # and tolerates a missing in-flight line (summary tail is best-effort)
     _EMITTED.append(obj)
     print(json.dumps(obj), flush=True)
 

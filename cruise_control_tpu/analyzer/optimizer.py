@@ -274,6 +274,10 @@ class GoalOptimizer:
         protect a heuristic."""
         from .chain import AdaptiveDispatch
         key = (state.num_partitions, state.num_brokers)
+        # ccsa: ok[CCSA007] PR 5 tolerance, machine-readable: registry
+        # lookups locked below; the AdaptiveDispatch values are
+        # deliberately unsynchronized — bounded (k stays in [1, max]),
+        # self-correcting, dispatch-boundary-only (see docstring)
         with self._controllers_lock:
             pair = self._controllers.get(key)
             if pair is None:

@@ -372,6 +372,8 @@ class InMemoryAdminBackend:
     def is_under_min_isr_with_offline(self, topic: str, partition: int) -> bool:
         with self._lock:
             p = self._parts[(topic, partition)]
+            # ccsa: ok[CCSA005] KAFKA topic-config key space (broker-side
+            # TopicConfig), not a cruise-control config key
             raw = self.topic_configs.get(topic, {}).get(
                 "min.insync.replicas", "1")
             live = [b for b in p.isr if b in self._alive]

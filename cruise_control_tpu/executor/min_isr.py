@@ -33,6 +33,7 @@ class TopicMinIsrCache:
             except Exception:  # noqa: BLE001 — degrade to defaults
                 configs = {}
             for t in missing:
+                # ccsa: ok[CCSA005] KAFKA topic-config key space
                 raw = (configs.get(t) or {}).get("min.insync.replicas")
                 try:
                     value = int(raw) if raw is not None else DEFAULT_MIN_ISR

@@ -246,6 +246,7 @@ class KafkaAdminBackend:
             topics = {t for t, _p in self._partitions_view()}
             out = {}
             for t, cfg in self.describe_topic_configs(topics).items():
+                # ccsa: ok[CCSA005] KAFKA topic-config key space
                 raw = cfg.get("min.insync.replicas")
                 try:
                     out[t] = int(raw) if raw is not None else 1

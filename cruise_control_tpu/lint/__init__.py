@@ -1,0 +1,29 @@
+"""ccsa — the cruise-control-tpu static-analysis gate.
+
+The reference gates every build on spotbugs + checkstyle before the test
+suite (build.gradle:83-132); this package is the analogous gate for the
+invariants THIS repo has paid for the hard way: donation-set exactness
+(PR 5), host-sync discipline in the async pump (PR 5), trace-time purity
+of ``lax`` body functions, wall-clock-free determinism in the digital
+twin (PR 6) and the PYTHONHASHSEED rule (PR 4), config-key / sensor-name
+doc drift (tools/gen_docs.py), and lock discipline on module-level
+shared state.
+
+Pure-stdlib ``ast`` walking — importing this package never imports jax.
+The doc-drift tree rules import the (stdlib-only) config registry and
+``tools/gen_docs.py`` lazily when they run.
+
+CLI: ``python -m tools.ccsa`` (see docs/STATIC_ANALYSIS.md).
+"""
+
+from .core import (  # noqa: F401
+    Finding, FileContext, LintResult, Rule, all_rules, build_contexts,
+    collect_files, iter_suppressions, load_baseline, run_lint,
+    write_baseline,
+)
+
+# Importing the rule modules registers every rule with the core registry.
+from . import rules_jax  # noqa: F401
+from . import rules_determinism  # noqa: F401
+from . import rules_drift  # noqa: F401
+from . import rules_concurrency  # noqa: F401

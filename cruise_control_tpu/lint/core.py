@@ -1,0 +1,435 @@
+"""ccsa core: rule registry, per-file context, suppressions, baseline.
+
+Design (mirrors the reference's checkstyle/spotbugs gate semantics):
+
+- A **rule** is a class with a ``rule_id`` (``CCSA0xx``), a one-line
+  ``title``, and either ``check_file(ctx)`` (runs per Python file) or
+  ``check_tree(root, ctxs)`` (runs once per lint invocation — the doc
+  drift rules). Rules register themselves via the ``@register``
+  decorator at import time.
+- A **suppression** is an inline comment ``# ccsa: ok[CCSA001] reason``
+  on the finding's line or on a comment line directly above it. The
+  reason is REQUIRED — a reasonless suppression does not suppress and
+  additionally raises a CCSA000 meta finding, so every tolerance in the
+  tree is documented where it lives. ``ok[CCSA001,CCSA007]`` covers
+  several rules with one comment.
+- The **baseline** is a committed JSON list of finding fingerprints
+  (``.ccsa-baseline.json``): findings in it are reported but do not fail
+  the gate, so the linter can land before the last legacy finding is
+  fixed. The repo's bias is an EMPTY baseline — fix or suppress instead
+  of baselining (ISSUE 9). Fingerprints hash the *normalized line text*,
+  not the line number, so unrelated edits don't churn the baseline.
+
+Everything here is stdlib-only; rules that need the config registry or
+``tools/gen_docs.py`` import them lazily inside ``check_tree``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import pathlib
+import re
+import tokenize
+import zlib
+from typing import Iterable, Sequence
+
+#: Repo root derived from this file's location (…/cruise_control_tpu/lint/
+#: core.py → two parents up). The CLI can override via --root.
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+#: Directories never scanned (the ccsa fixture corpus is deliberately
+#: violating — scanning it would make the tree red by construction).
+EXCLUDED_DIR_PARTS = {"__pycache__", ".git", ".ccsa-fixtures"}
+EXCLUDED_REL_PREFIXES = ("tests/fixtures/ccsa",)
+
+#: Default scan targets — the same surface the pyflakes CI gate covers,
+#: minus tests (fixture snippets there violate rules on purpose; the
+#: test suite lints them explicitly with spoofed paths).
+DEFAULT_PATHS = ("cruise_control_tpu", "tools", "bench.py",
+                 "__graft_entry__.py")
+
+DEFAULT_BASELINE = ".ccsa-baseline.json"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*ccsa:\s*ok\[\s*([A-Za-z0-9_,\s]+?)\s*\]\s*(.*?)\s*$")
+
+META_RULE = "CCSA000"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str           # repo-relative posix path
+    line: int           # 1-based; 0 = whole file / tree-level
+    message: str
+    suppressed: bool = False
+    reason: str = ""    # the suppression reason when suppressed
+    baselined: bool = False
+
+    def with_status(self, *, suppressed: bool = False, reason: str = "",
+                    baselined: bool = False) -> "Finding":
+        return dataclasses.replace(self, suppressed=suppressed,
+                                   reason=reason, baselined=baselined)
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "suppressed": self.suppressed,
+                "reason": self.reason, "baselined": self.baselined}
+
+
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    path: str
+    line: int
+    rules: tuple[str, ...]
+    reason: str
+
+
+class FileContext:
+    """One parsed Python file: source, AST, and its suppression map."""
+
+    def __init__(self, path: pathlib.Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        # lineno -> {RULE: reason}; reason may be "" (invalid — see
+        # suppression_for). Markers are located via REAL comment tokens,
+        # not a regex over raw lines: a `# ccsa: ok[...]` inside a string
+        # literal or docstring must neither suppress nor show up in
+        # --list-suppressions.
+        self.suppressions: dict[int, dict[str, str]] = {}
+        for lineno, comment in self._comments(source):
+            m = _SUPPRESS_RE.search(comment)
+            if not m:
+                continue
+            rules = tuple(r.strip().upper() for r in m.group(1).split(",")
+                          if r.strip())
+            reason = (m.group(2) or "").strip()
+            self.suppressions[lineno] = {r: reason for r in rules}
+
+    @staticmethod
+    def _comments(source: str):
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    yield tok.start[0], tok.string
+        except (tokenize.TokenError, IndentationError):
+            return   # ast.parse succeeded, so this is effectively dead
+
+    def _comment_only(self, lineno: int) -> bool:
+        if not (1 <= lineno <= len(self.lines)):
+            return False
+        return self.lines[lineno - 1].lstrip().startswith("#")
+
+    def suppression_for(self, line: int, rule: str) -> str | None:
+        """The suppression reason covering ``rule`` at ``line``: on the
+        line itself, or in the contiguous block of comment-only lines
+        directly above it (so reasons may wrap over several comment
+        lines — the ``# ccsa:`` marker line starts the block that
+        counts). ``None`` when not suppressed; ``""`` when suppressed
+        without a reason (invalid)."""
+        entry = self.suppressions.get(line)
+        if entry is not None and rule in entry:
+            return entry[rule]
+        cand = line - 1
+        while self._comment_only(cand):
+            entry = self.suppressions.get(cand)
+            if entry is not None and rule in entry:
+                return entry[rule]
+            # A marker for a DIFFERENT rule doesn't end the walk: stacked
+            # single-rule suppressions above one line all apply to it.
+            cand -= 1
+        return None
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+
+class Rule:
+    """Base rule. Subclasses set ``rule_id``/``title`` and override one
+    or both hooks. ``check_file`` findings are suppressible inline;
+    ``check_tree`` findings (doc drift) are not — they point at
+    generated files whose fix is regeneration, not annotation."""
+
+    rule_id = "CCSA???"
+    title = ""
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        return []
+
+    def check_tree(self, root: pathlib.Path,
+                   ctxs: Sequence[FileContext]) -> list[Finding]:
+        return []
+
+    # -- shared AST helpers -------------------------------------------------
+    @staticmethod
+    def dotted(node: ast.AST) -> str | None:
+        """``a.b.c`` for a Name/Attribute chain, else None."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return None
+
+    @staticmethod
+    def own_assigned_names(func: ast.AST) -> set[str]:
+        """Names bound in ``func``'s OWN scope (params, assignments,
+        loop/with/comprehension targets) — bindings inside nested
+        functions/lambdas do NOT leak out (Python scoping): a name a
+        nested closure rebinds for itself must not count as shadowed in
+        the enclosing function, or shadow-aware rules fail open."""
+        names: set[str] = set()
+        if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            a = func.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                        + ([a.vararg] if a.vararg else [])
+                        + ([a.kwarg] if a.kwarg else [])):
+                names.add(arg.arg)
+            stack = list(func.body) if not isinstance(func, ast.Lambda) \
+                else [func.body]
+        else:
+            stack = list(ast.iter_child_nodes(func))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    names.add(node.name)   # the def itself binds its name
+                continue                   # nested scope: do not descend
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)):
+                names.add(node.id)
+            elif isinstance(node, ast.comprehension):
+                for t in ast.walk(node.target):
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+            stack.extend(ast.iter_child_nodes(node))
+        return names
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and register a rule by its id."""
+    inst = cls()
+    # ccsa: ok[CCSA007] import-time-only mutation: rule modules register
+    # while this package imports, serialized by the interpreter's import
+    # lock; the registry is read-only afterwards
+    _REGISTRY[inst.rule_id] = inst
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    return dict(sorted(_REGISTRY.items()))
+
+
+# -- file collection --------------------------------------------------------
+
+def _excluded(rel: str) -> bool:
+    parts = rel.split("/")
+    if any(p in EXCLUDED_DIR_PARTS for p in parts):
+        return True
+    return any(rel == pre or rel.startswith(pre + "/")
+               for pre in EXCLUDED_REL_PREFIXES)
+
+
+def collect_files(paths: Iterable[str | pathlib.Path],
+                  root: pathlib.Path) -> list[pathlib.Path]:
+    """Expand ``paths`` to .py files. The exclusion list applies only to
+    directory EXPANSION — a path the caller names explicitly (or whose
+    given root already sits inside an excluded prefix, e.g. the ccsa
+    fixture corpus in the CI red-gate step) is always scanned."""
+    out: list[pathlib.Path] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        if not p.is_absolute():
+            p = root / p
+        if p.is_dir():
+            forced = _excluded(_relpath(p, root))
+            out.extend(sorted(
+                f for f in p.rglob("*.py")
+                if forced or not _excluded(_relpath(f, root))))
+        elif p.suffix == ".py" and p.exists():
+            out.append(p)
+    uniq: list[pathlib.Path] = []
+    seen: set[pathlib.Path] = set()
+    for f in out:
+        f = f.resolve()
+        if f in seen:
+            continue
+        seen.add(f)
+        uniq.append(f)
+    return uniq
+
+
+def _relpath(path: pathlib.Path, root: pathlib.Path) -> str:
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+# -- baseline ---------------------------------------------------------------
+
+def fingerprint(finding: Finding, line_text: str) -> str:
+    """Stable id for baselining: rule + path + crc32 of the normalized
+    line text. Line numbers deliberately excluded so edits elsewhere in
+    the file don't churn the baseline; two identical lines in one file
+    share a fingerprint (collapsing them in the baseline is acceptable —
+    the baseline's target size is zero)."""
+    norm = " ".join(line_text.split())
+    return f"{finding.rule}:{finding.path}:{zlib.crc32(norm.encode()):08x}"
+
+
+def load_baseline(path: pathlib.Path) -> set[str]:
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text())
+    return set(data.get("fingerprints", []))
+
+
+def write_baseline(path: pathlib.Path, fingerprints: Iterable[str]) -> None:
+    path.write_text(json.dumps(
+        {"comment": "ccsa accepted-finding fingerprints — keep EMPTY; "
+                    "fix or `# ccsa: ok[RULE] reason`-suppress instead "
+                    "(docs/STATIC_ANALYSIS.md)",
+         "fingerprints": sorted(set(fingerprints))}, indent=2) + "\n")
+
+
+# -- runner -----------------------------------------------------------------
+
+@dataclasses.dataclass
+class LintResult:
+    new: list[Finding]
+    baselined: list[Finding]
+    suppressed: list[Finding]
+    errors: list[Finding]       # CCSA000 meta findings (always gate-failing)
+    files_scanned: int
+    #: The parsed contexts of the run (path-keyed consumers — baseline
+    #: writing — reuse these instead of re-collecting + re-parsing).
+    contexts: list = dataclasses.field(default_factory=list)
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.new or self.errors)
+
+    def counts(self) -> dict[str, dict[str, int]]:
+        table: dict[str, dict[str, int]] = {}
+        for bucket, items in (("new", self.new + self.errors),
+                              ("baselined", self.baselined),
+                              ("suppressed", self.suppressed)):
+            for f in items:
+                row = table.setdefault(
+                    f.rule, {"new": 0, "baselined": 0, "suppressed": 0})
+                row[bucket] += 1
+        return dict(sorted(table.items()))
+
+
+def iter_suppressions(ctxs: Sequence[FileContext]) -> list[Suppression]:
+    """Every inline suppression in the scanned tree — the machine-readable
+    registry of documented tolerances (``--list-suppressions``)."""
+    out: list[Suppression] = []
+    for ctx in ctxs:
+        for line, entry in sorted(ctx.suppressions.items()):
+            reasons = set(entry.values())
+            out.append(Suppression(ctx.rel, line, tuple(sorted(entry)),
+                                   next(iter(reasons)) if reasons else ""))
+    return out
+
+
+def build_contexts(files: Sequence[pathlib.Path], root: pathlib.Path,
+                   ) -> tuple[list[FileContext], list[Finding]]:
+    ctxs: list[FileContext] = []
+    errors: list[Finding] = []
+    for f in files:
+        rel = _relpath(f, root)
+        try:
+            source = f.read_text()
+        except OSError as exc:
+            errors.append(Finding(META_RULE, rel, 0, f"unreadable: {exc}"))
+            continue
+        try:
+            ctxs.append(FileContext(f, rel, source))
+        except SyntaxError as exc:
+            errors.append(Finding(META_RULE, rel, exc.lineno or 0,
+                                  f"syntax error: {exc.msg}"))
+    return ctxs, errors
+
+
+def run_lint(paths: Sequence[str | pathlib.Path] | None = None,
+             root: pathlib.Path | None = None,
+             rules: Sequence[str] | None = None,
+             baseline: set[str] | None = None) -> LintResult:
+    """Run the gate. ``rules`` filters by id (None = all); ``baseline``
+    is the accepted-fingerprint set (None = empty)."""
+    root = (root or REPO_ROOT).resolve()
+    errors: list[Finding] = []
+    files: list[pathlib.Path] = []
+    for p in (paths or DEFAULT_PATHS):
+        matched = collect_files([p], root)
+        if not matched:
+            # A typo'd path silently expanding to zero files would make
+            # the gate pass vacuously — that is a gate failure, not a
+            # clean run.
+            errors.append(Finding(META_RULE, str(p), 0,
+                                  "path matched no Python files"))
+        files.extend(matched)
+    files = list(dict.fromkeys(files))   # overlapping paths: scan once
+    ctxs, ctx_errors = build_contexts(files, root)
+    errors.extend(ctx_errors)
+    baseline = baseline or set()
+    active = all_rules()
+    if rules is not None:
+        wanted = {r.upper() for r in rules}
+        unknown = wanted - set(active)
+        for r in sorted(unknown):
+            errors.append(Finding(META_RULE, "", 0, f"unknown rule: {r}"))
+        active = {k: v for k, v in active.items() if k in wanted}
+
+    raw: list[Finding] = []
+    ctx_by_rel = {c.rel: c for c in ctxs}
+    for rule in active.values():
+        for ctx in ctxs:
+            raw.extend(rule.check_file(ctx))
+        raw.extend(rule.check_tree(root, ctxs))
+
+    new: list[Finding] = []
+    baselined: list[Finding] = []
+    suppressed: list[Finding] = []
+    for f in raw:
+        ctx = ctx_by_rel.get(f.path)
+        reason = ctx.suppression_for(f.line, f.rule) if ctx else None
+        if reason is not None:
+            if not reason:
+                errors.append(Finding(
+                    META_RULE, f.path, f.line,
+                    f"suppression for {f.rule} has no reason — "
+                    "`# ccsa: ok[RULE] <why this is safe>` is required"))
+                new.append(f)
+            else:
+                suppressed.append(f.with_status(suppressed=True,
+                                                reason=reason))
+            continue
+        line_text = ctx.line_text(f.line) if ctx else ""
+        if fingerprint(f, line_text) in baseline:
+            baselined.append(f.with_status(baselined=True))
+        else:
+            new.append(f)
+
+    order = (lambda f: (f.path, f.line, f.rule))
+    return LintResult(sorted(new, key=order), sorted(baselined, key=order),
+                      sorted(suppressed, key=order), errors, len(ctxs),
+                      contexts=ctxs)

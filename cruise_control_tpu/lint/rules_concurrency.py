@@ -1,0 +1,154 @@
+"""CCSA007: lock discipline on module-level shared mutable state.
+
+A module-level mutable container is process-global: every thread in the
+server (fleet scheduler workers, detector loop, HTTP handlers, the bench
+watchdog) shares it. The rule flags **runtime mutations** of such
+containers — mutator method calls, subscript writes/deletes — performed
+inside function bodies without an enclosing ``with <lock>:``.
+
+Import-time initialization (module-scope loops filling a table) is
+exempt: the import lock serializes it. Containers that are only ever
+read after import are never flagged — the rule keys on the mutation,
+not the declaration, so constant registries stay annotation-free.
+
+A deliberate unsynchronized-access tolerance (the PR 5 persistent
+dispatch-controller pattern: lock the registry, tolerate racy field
+updates on the values) is documented in place with
+``# ccsa: ok[CCSA007] <bounded/self-correcting tolerance>`` — which
+``python -m tools.ccsa --list-suppressions`` then reports, making every
+such tolerance in the tree machine-enumerable.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, FileContext, Rule, register
+
+_CONTAINER_CALLS = ("list", "dict", "set", "deque", "defaultdict",
+                    "OrderedDict", "Counter", "ChainMap")
+_MUTATORS = ("append", "extend", "add", "update", "insert", "pop",
+             "popitem", "remove", "discard", "clear", "setdefault",
+             "appendleft", "extendleft", "rotate")
+
+
+def _is_mutable_container(value: ast.expr) -> bool:
+    if isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        name = Rule.dotted(value.func) or ""
+        return name.rpartition(".")[2] in _CONTAINER_CALLS
+    return False
+
+
+def _lockish(expr: ast.expr) -> bool:
+    """Heuristic: a with-context guards a critical section when any
+    identifier in it contains 'lock' (``self._lock``, ``REG_LOCK``,
+    ``lock.acquire…``) or it constructs/calls a threading primitive."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and "lock" in node.id.lower():
+            return True
+        if isinstance(node, ast.Attribute) and "lock" in node.attr.lower():
+            return True
+    return False
+
+
+@register
+class LockDisciplineRule(Rule):
+    rule_id = "CCSA007"
+    title = "unlocked mutation of module-level mutable container"
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        # Container declarations at MODULE scope — including ones nested
+        # under module-level if/try/with blocks (a gate that only looked
+        # at tree.body would fail open on those) — but never inside a
+        # function or class body.
+        containers: set[str] = set()
+        stack: list = list(ctx.tree.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            targets: list[ast.Name] = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets = [t for t in node.targets
+                           if isinstance(t, ast.Name)]
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is not None and _is_mutable_container(value):
+                containers.update(t.id for t in targets)
+            stack.extend(ast.iter_child_nodes(node))
+        if not containers:
+            return []
+
+        findings: list[Finding] = []
+        self._walk(ctx, ctx.tree, containers, in_func=False,
+                   in_lock=False, shadowed=frozenset(), findings=findings)
+        return findings
+
+    def _walk(self, ctx: FileContext, node: ast.AST, containers: set[str],
+              in_func: bool, in_lock: bool, shadowed: frozenset,
+              findings: list[Finding]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Only names bound in THIS function's own scope shadow the
+            # module container here — a nested closure rebinding the
+            # name for itself must not hide the outer mutation
+            # (own_assigned_names stops at nested def boundaries).
+            local = self.own_assigned_names(node)
+            declared_global = {n for sub in ast.walk(node)
+                              if isinstance(sub, ast.Global)
+                              for n in sub.names}
+            shadowed = frozenset((local - declared_global)
+                                 & containers) | shadowed
+            shadowed = frozenset(shadowed - declared_global)
+            # A function defined inside a `with lock:` block runs LATER,
+            # when the lock is long released — the guard never carries
+            # into a nested scope.
+            for child in node.body:
+                self._walk(ctx, child, containers, True, False,
+                           shadowed, findings)
+            return
+        if isinstance(node, ast.With):
+            locked = in_lock or any(_lockish(item.context_expr)
+                                    for item in node.items)
+            for child in node.body:
+                self._walk(ctx, child, containers, in_func, locked,
+                           shadowed, findings)
+            return
+        if in_func and not in_lock:
+            hit = self._mutation(node, containers - shadowed)
+            if hit is not None:
+                findings.append(Finding(
+                    self.rule_id, ctx.rel, node.lineno,
+                    f"module-level container `{hit}` mutated outside a "
+                    "lock — guard with `with <lock>:` or document the "
+                    "tolerance: `# ccsa: ok[CCSA007] <why unsynchronized "
+                    "access is safe here>`"))
+        for child in ast.iter_child_nodes(node):
+            self._walk(ctx, child, containers, in_func, in_lock, shadowed,
+                       findings)
+
+    @staticmethod
+    def _mutation(node: ast.AST, containers: set[str]) -> str | None:
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in containers:
+            return node.func.value.id
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            targets = node.targets if isinstance(node, ast.Assign) else (
+                [node.target] if isinstance(node, ast.AugAssign)
+                else node.targets)
+            for t in targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id in containers:
+                    return t.value.id
+        return None

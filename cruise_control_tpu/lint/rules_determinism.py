@@ -1,0 +1,119 @@
+"""CCSA004: wall-clock and ``hash()`` determinism.
+
+Two sub-checks with different scopes:
+
+- In the **deterministic modules** (the digital twin, the chaos harness,
+  the flight recorder — everything whose replay/scoring contract is
+  "same seed ⇒ byte-identical output", PR 6): calling ``time.*`` clock
+  functions, ``datetime.now``-family constructors, or anything off the
+  ``random`` module is banned. *References* stay legal — passing
+  ``time.monotonic`` as a default argument IS the injection seam
+  (``SimClock`` / ``RetryPolicy(clock=)`` discipline); *calling* it
+  inline is the violation.
+- **Repo-wide**: the builtin ``hash()`` is banned outside ``__hash__``
+  methods. Its value changes per process under PYTHONHASHSEED for
+  strings — PR 4 already converted one assignor from ``hash()`` to
+  ``zlib.crc32`` after exactly this bit them. In-process-only uses are
+  suppressible with that documented contract.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, FileContext, Rule, register
+
+
+@register
+class DeterminismRule(Rule):
+    rule_id = "CCSA004"
+    title = "wall-clock / hash() in deterministic modules"
+
+    #: Modules under the byte-identical-replay contract.
+    DETERMINISTIC_MODULES = (
+        "cruise_control_tpu/testing/simulator.py",
+        "cruise_control_tpu/testing/chaos.py",
+        "cruise_control_tpu/utils/flight_recorder.py",
+    )
+
+    CLOCK_CALLS = ("time.time", "time.time_ns", "time.monotonic",
+                   "time.monotonic_ns", "time.perf_counter",
+                   "time.perf_counter_ns", "time.localtime", "time.gmtime",
+                   "datetime.now", "datetime.utcnow", "datetime.today",
+                   "datetime.datetime.now", "datetime.datetime.utcnow",
+                   "datetime.datetime.today", "datetime.date.today")
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        deterministic = ctx.rel in self.DETERMINISTIC_MODULES
+        aliases = self._module_aliases(ctx.tree)
+        hash_exempt_ranges = self._hash_exempt_ranges(ctx.tree)
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = self.dotted(node.func)
+            if name is None:
+                continue
+            norm = self._normalize(name, aliases)
+            if deterministic:
+                if norm in self.CLOCK_CALLS:
+                    findings.append(Finding(
+                        self.rule_id, ctx.rel, node.lineno,
+                        f"`{norm}()` called in a deterministic module — "
+                        "inject the clock (pass the function, call the "
+                        "parameter: the SimClock seam) so same seed stays "
+                        "byte-identical"))
+                elif norm.startswith("random."):
+                    findings.append(Finding(
+                        self.rule_id, ctx.rel, node.lineno,
+                        f"`{norm}()` in a deterministic module — use "
+                        "crc32-seeded derivation (testing.chaos pattern), "
+                        "never the global `random` state"))
+            if norm == "hash" and isinstance(node.func, ast.Name) \
+                    and not self._in_ranges(node.lineno, hash_exempt_ranges):
+                findings.append(Finding(
+                    self.rule_id, ctx.rel, node.lineno,
+                    "builtin `hash()` is PYTHONHASHSEED-randomized for "
+                    "strings — use `zlib.crc32` for anything compared, "
+                    "persisted, or replayed across processes (PR 4's "
+                    "assignor fix); in-process-only uses need "
+                    "`# ccsa: ok[CCSA004] <in-process contract>`"))
+        return findings
+
+    @staticmethod
+    def _module_aliases(tree: ast.Module) -> dict[str, str]:
+        """``import time as _t`` → {'_t': 'time'} so aliasing can't dodge
+        the ban."""
+        out: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module in ("time", "datetime", "random"):
+                for a in node.names:
+                    out[a.asname or a.name] = f"{node.module}.{a.name}"
+        return out
+
+    @staticmethod
+    def _normalize(name: str, aliases: dict[str, str]) -> str:
+        head, _, rest = name.partition(".")
+        mapped = aliases.get(head)
+        if mapped is None:
+            return name
+        return f"{mapped}.{rest}" if rest else mapped
+
+    @staticmethod
+    def _hash_exempt_ranges(tree: ast.Module) -> list[tuple[int, int]]:
+        """Line ranges of ``__hash__`` methods — in-process identity is
+        the one place builtin ``hash()`` is the right tool."""
+        out = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) and node.name == "__hash__":
+                out.append((node.lineno, node.end_lineno or node.lineno))
+        return out
+
+    @staticmethod
+    def _in_ranges(line: int, ranges: list[tuple[int, int]]) -> bool:
+        return any(lo <= line <= hi for lo, hi in ranges)

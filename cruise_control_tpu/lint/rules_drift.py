@@ -1,0 +1,139 @@
+"""CCSA005/006: config-key and sensor-name drift.
+
+Both rules reuse ``tools/gen_docs.py`` — the same registry walk that
+GENERATES docs/CONFIGURATION.md and docs/SENSORS.md also verifies them,
+so the docs cannot drift from the code without failing lint (previously
+they just rotted silently until someone re-ran the generator).
+
+- CCSA005 (file part): every dotted-key string literal passed to a
+  config getter (``cfg.get("a.b.c")``, ``get_int``, …) must be declared
+  in the ConfigDef registry. An undeclared literal is either a typo'd
+  key (returns the None/default silently) or a key someone forgot to
+  register + document. Lookups into EXTERNAL key spaces (Kafka
+  topic/broker configs share the dotted style) are suppressible with
+  that stated contract.
+- CCSA005 (tree part): regenerated CONFIGURATION.md must equal the
+  committed file.
+- CCSA006 (tree part): the sensor-name walk must match docs/SENSORS.md
+  in both directions — every registered sensor documented, every
+  documented sensor still registered — plus the full-text staleness
+  check.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import importlib.util
+import pathlib
+import re
+from typing import Sequence
+
+from .core import Finding, FileContext, Rule, register
+
+_KEY_RE = re.compile(r"^[a-z][a-z0-9]*(\.[a-z0-9]+)+$")
+_GETTERS = ("get", "get_int", "get_long", "get_double", "get_boolean",
+            "get_string", "get_list", "get_configured_instance",
+            "get_configured_instances")
+
+
+@functools.lru_cache(maxsize=4)
+def _load_gen_docs(root: pathlib.Path):
+    """Import tools/gen_docs.py by path (works regardless of whether
+    ``tools`` is importable as a package from the caller's sys.path).
+    Cached per root: CCSA005 and CCSA006 share one module exec per
+    process instead of re-executing it per rule."""
+    path = root / "tools" / "gen_docs.py"
+    if not path.exists():
+        return None
+    spec = importlib.util.spec_from_file_location("_ccsa_gen_docs", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _declared_keys() -> set[str]:
+    from ..config.cruise_control_config import _DEFINITION
+    return set(_DEFINITION.names)
+
+
+@register
+class ConfigKeyDriftRule(Rule):
+    rule_id = "CCSA005"
+    title = "config-key drift (undeclared keys / stale CONFIGURATION.md)"
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        used: list[tuple[str, int]] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _GETTERS and node.args:
+                a0 = node.args[0]
+                if isinstance(a0, ast.Constant) and isinstance(a0.value, str) \
+                        and _KEY_RE.match(a0.value):
+                    used.append((a0.value, node.lineno))
+        if not used:
+            return []
+        declared = _declared_keys()
+        return [Finding(
+            self.rule_id, ctx.rel, line,
+            f"config key `{key}` is not declared in "
+            "config/cruise_control_config.py — declare it (and rerun "
+            "tools/gen_docs.py), or mark an external key space: "
+            "`# ccsa: ok[CCSA005] <whose key this is>`")
+            for key, line in used if key not in declared]
+
+    def check_tree(self, root: pathlib.Path,
+                   ctxs: Sequence[FileContext]) -> list[Finding]:
+        gen = _load_gen_docs(root)
+        if gen is None:
+            return []
+        doc = root / "docs" / "CONFIGURATION.md"
+        current = doc.read_text() if doc.exists() else ""
+        expected = gen.gen_configuration()
+        if current.strip() == expected.strip():
+            return []
+        return [Finding(
+            self.rule_id, "docs/CONFIGURATION.md", 1,
+            "stale: does not match the ConfigDef registry — run "
+            "`python tools/gen_docs.py` and commit the result")]
+
+
+@register
+class SensorDriftRule(Rule):
+    rule_id = "CCSA006"
+    title = "sensor-name drift (code registrations vs docs/SENSORS.md)"
+
+    _DOC_ROW = re.compile(r"^\|\s*`kafka_cruisecontrol_([a-z0-9_]+)`")
+
+    def check_tree(self, root: pathlib.Path,
+                   ctxs: Sequence[FileContext]) -> list[Finding]:
+        gen = _load_gen_docs(root)
+        if gen is None:
+            return []
+        doc = root / "docs" / "SENSORS.md"
+        current = doc.read_text() if doc.exists() else ""
+        expected = gen.gen_sensors()
+        if current.strip() == expected.strip():
+            return []
+
+        documented = {m.group(1) for line in current.splitlines()
+                      if (m := self._DOC_ROW.match(line.strip()))}
+        registered = {m.group(1) for line in expected.splitlines()
+                      if (m := self._DOC_ROW.match(line.strip()))}
+        findings = [Finding(
+            self.rule_id, "docs/SENSORS.md", 1,
+            f"sensor `{name}` is registered in code but missing from "
+            "docs/SENSORS.md — run `python tools/gen_docs.py`")
+            for name in sorted(registered - documented)]
+        findings += [Finding(
+            self.rule_id, "docs/SENSORS.md", 1,
+            f"documented sensor `{name}` is no longer registered anywhere "
+            "— run `python tools/gen_docs.py`")
+            for name in sorted(documented - registered)]
+        if not findings:
+            findings.append(Finding(
+                self.rule_id, "docs/SENSORS.md", 1,
+                "stale: text differs from the generated output — run "
+                "`python tools/gen_docs.py` and commit the result"))
+        return findings

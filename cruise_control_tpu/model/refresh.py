@@ -54,6 +54,8 @@ def broker_table_fingerprint(brokers: Sequence[BrokerSpec]) -> int:
     capacity). Always part of the cache key — capacity-config or
     broker-state changes must invalidate even when the metadata
     generation token says partitions are unchanged."""
+    # ccsa: ok[CCSA004] in-process cache key only: compared against keys
+    # from the SAME interpreter, never persisted or replayed cross-process
     return hash(tuple(
         (b.broker_id, b.rack, b.host, int(b.state),
          tuple(sorted((int(r), float(v)) for r, v in b.capacity.items())))
@@ -66,6 +68,7 @@ def partition_topology_fingerprint(partitions: Mapping) -> int:
     deliberately excluded — leadership is re-derived on every refresh from
     the live partition states, so a leader-only election stays on the
     cheap path."""
+    # ccsa: ok[CCSA004] in-process cache key only (see above)
     return hash(frozenset(
         (t, p, st.replicas) for (t, p), st in partitions.items()))
 

@@ -85,6 +85,8 @@ class FileCapacityResolver:
                 out[Resource.DISK] = float(disk)
             cpu = cap.get("CPU", DEFAULT_CAPACITY[Resource.CPU])
             if isinstance(cpu, dict):  # capacityCores.json format
+                # ccsa: ok[CCSA005] capacityCores.json field (reference
+                # BrokerCapacityConfigFileResolver format), not a config key
                 out[Resource.CPU] = float(cpu.get("num.cores", 1)) * 100.0
             else:
                 out[Resource.CPU] = float(cpu)

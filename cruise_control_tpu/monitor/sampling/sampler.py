@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import time
+import zlib
 from typing import Callable, Mapping, Protocol
 
 from ...executor.admin import PartitionState
@@ -236,8 +237,11 @@ class PrometheusMetricSampler:
 
 class SyntheticSampler:
     """Deterministic load generator for demos and tests: stable per-partition
-    rates derived from a hash of (topic, partition) so windows are
-    self-consistent across intervals."""
+    rates derived from a crc32 of (seed, topic, partition) so windows are
+    self-consistent across intervals AND across processes (builtin
+    ``hash()`` is PYTHONHASHSEED-randomized for the topic string — the
+    same trap PR 4 fixed in the partition assignor; CCSA004 now polices
+    it)."""
 
     def __init__(self, seed: int = 0, cpu_per_kb: float = 2e-4):
         self._seed = seed
@@ -250,7 +254,8 @@ class SyntheticSampler:
         for (topic, part), st in partitions.items():
             if st.leader < 0:
                 continue
-            h = (hash((self._seed, topic, part)) % 1000) / 1000.0
+            h = (zlib.crc32(f"{self._seed}:{topic}:{part}".encode())
+                 % 1000) / 1000.0
             bytes_in = 50.0 + 950.0 * h
             bytes_out = 2.0 * bytes_in
             psamples.append(PartitionMetricSample.make(topic, part, end_ms, {

@@ -324,10 +324,10 @@ class PassFlight:
         self.seq = seq
         self.shape = shape
         self.cluster = cluster
-        self.started_ms = int(time.time() * 1000)
+        self.started_ms = int(recorder._clock() * 1000)
         self.attributes: dict = {}
         self.goals: list[GoalFlight] = []
-        self._t0 = time.monotonic()
+        self._t0 = recorder._monotonic()
 
     def __enter__(self) -> "PassFlight":
         return self
@@ -335,7 +335,8 @@ class PassFlight:
     def __exit__(self, exc_type, exc, tb) -> bool:
         if exc_type is not None:
             self.attributes.setdefault("error", exc_type.__name__)
-        self._recorder._close_pass(self, time.monotonic() - self._t0)
+        self._recorder._close_pass(
+            self, self._recorder._monotonic() - self._t0)
         return False
 
     def goal(self, name: str) -> GoalFlight:
@@ -384,7 +385,14 @@ class FlightRecorder:
     """Process-wide recorder: pass factory + bounded pass ring + export
     (the ``utils.tracing.Tracer`` pattern)."""
 
-    def __init__(self, max_passes: int = 64, ring_rounds: int = 128):
+    def __init__(self, max_passes: int = 64, ring_rounds: int = 128,
+                 clock=time.time, monotonic=time.monotonic):
+        # Injectable clocks (CCSA004 seam, the SimClock discipline): the
+        # recorder's pass timestamps/durations are observability-only —
+        # already excluded from scenario score JSON (round 12) — but an
+        # injected pair keeps a twin's flight dumps replay-stable too.
+        self._clock = clock
+        self._monotonic = monotonic
         self._lock = threading.Lock()
         self._enabled = True
         self._ring_rounds = int(ring_rounds)

@@ -1,0 +1,11 @@
+"""CCSA005 fixture: dotted-key literals that no ConfigDef declares."""
+
+
+def read(cfg):
+    a = cfg.get("totally.unknown.key")          # finding
+    b = cfg.get_int("another.unknown.key")      # finding
+    c = cfg.get("anomaly.detection.interval.ms")   # clean: declared
+    # ccsa: ok[CCSA005] fixture: external key space
+    d = cfg.get("externally.owned.key")
+    e = cfg.get("plainword")                    # clean: not dotted
+    return a, b, c, d, e

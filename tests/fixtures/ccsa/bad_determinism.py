@@ -1,0 +1,30 @@
+"""CCSA004 fixture: PYTHONHASHSEED-dependent hash() (repo-wide check)
+and wall-clock calls (deterministic-module check — tests lint this file
+under a spoofed testing/simulator.py path)."""
+
+import time
+
+
+def unstable_key(topic: str) -> int:
+    return hash(topic) % 1000        # finding anywhere in the repo
+
+
+def stamp() -> float:
+    return time.time()               # finding under a deterministic path
+
+
+def injected(clock=time.monotonic) -> float:
+    return clock()                   # clean: reference is the seam
+
+
+def tolerated(parts: tuple) -> int:
+    # ccsa: ok[CCSA004] fixture: in-process memo key, never persisted
+    return hash(parts)
+
+
+class Keyed:
+    def __init__(self, value):
+        self.value = value
+
+    def __hash__(self) -> int:
+        return hash(self.value)      # clean: __hash__ is exempt

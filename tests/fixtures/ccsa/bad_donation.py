@@ -1,0 +1,21 @@
+"""CCSA002 fixture: donation outside the mutable set."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def donates_topology(assignment, leader_slot, rest):   # finding: rest
+    return assignment, leader_slot, rest
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def donates_mutable_set(assignment, leader_slot):      # clean
+    return assignment, leader_slot
+
+
+# ccsa: ok[CCSA002] fixture: scratch buffer owned by the caller-free test
+@partial(jax.jit, donate_argnums=(0,))
+def suppressed_donation(scratch):
+    return scratch * 2

@@ -1,0 +1,15 @@
+"""CCSA001 fixture: host syncs inside the async pump region.
+
+Linted by tests/test_ccsa.py under a spoofed ``analyzer/chain.py``
+relative path (the rule is scoped to the pump modules)."""
+
+import numpy as np
+
+
+def run_bounded_pass(enqueue, st, pass_cap):
+    st, applied, rounds, donated, ring = enqueue(st, pass_cap)
+    moves = float(applied)                      # finding: blocks the pump
+    snapshot = np.asarray(ring)                 # finding: device transfer
+    # ccsa: ok[CCSA001] fixture: documented intentional readback
+    rounds_read = int(rounds)
+    return st, moves, rounds_read, donated, snapshot

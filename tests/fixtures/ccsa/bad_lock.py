@@ -1,0 +1,34 @@
+"""CCSA007 fixture: module-level mutable containers mutated at runtime."""
+
+import threading
+
+_CACHE: dict = {}
+_LOCK = threading.Lock()
+_TOLERATED: list = []
+_TABLE: list = []
+for _i in range(4):
+    _TABLE.append(_i * _i)           # clean: import-time initialization
+
+
+def put(key, value):
+    _CACHE[key] = value              # finding: unlocked mutation
+
+
+def drop(key):
+    _CACHE.pop(key, None)            # finding: unlocked mutation
+
+
+def put_locked(key, value):
+    with _LOCK:
+        _CACHE[key] = value          # clean: lock-guarded
+
+
+def shadowed(values):
+    _CACHE = {}                      # local shadow, not the module global
+    _CACHE["n"] = len(values)        # clean
+    return _CACHE
+
+
+def mark(x):
+    # ccsa: ok[CCSA007] fixture: single-threaded accumulator by contract
+    _TOLERATED.append(x)

@@ -1,0 +1,40 @@
+"""CCSA003 fixture: Python side effects inside lax body functions."""
+
+import jax
+
+
+def leaky_loop(x):
+    log = []
+
+    def loop_cond(carry):
+        return carry < 3
+
+    def loop_body(carry):
+        log.append(carry)            # finding: runs once, at trace time
+        return carry + 1
+
+    return jax.lax.while_loop(loop_cond, loop_body, x), log
+
+
+def leaky_scan(xs):
+    totals = {}
+
+    def scan_step(carry, x):
+        totals["n"] = carry          # finding: subscript write upward
+        return carry + x, x
+
+    return jax.lax.scan(scan_step, 0, xs), totals
+
+
+def tolerated_loop(x):
+    trace_marks = []
+
+    def ok_cond(carry):
+        return carry < 3
+
+    def ok_body(carry):
+        # ccsa: ok[CCSA003] fixture: deliberate trace-time-only marker
+        trace_marks.append(1)
+        return carry + 1
+
+    return jax.lax.while_loop(ok_cond, ok_body, x), trace_marks

@@ -1,0 +1,4 @@
+"""Offline diagnostic + lint tools. Package-ized so gate entry points
+run as modules from the repo root (``python -m tools.ccsa``); the
+standalone scripts here still run directly (``python tools/bench_*.py``)
+via the ``import _common`` preamble."""

@@ -1,0 +1,179 @@
+"""ccsa CLI — the repo's invariant lint gate (docs/STATIC_ANALYSIS.md).
+
+Usage (from the repo root)::
+
+    python -m tools.ccsa                      # lint the default tree
+    python -m tools.ccsa path/to/file.py      # lint specific paths
+    python -m tools.ccsa --format=json        # machine output
+    python -m tools.ccsa --format=github      # ::error annotations + job
+                                              # summary table (CI gate)
+    python -m tools.ccsa --rules CCSA004,CCSA007 paths...
+    python -m tools.ccsa --write-baseline     # accept current findings
+    python -m tools.ccsa --list-rules
+    python -m tools.ccsa --list-suppressions  # every documented tolerance
+
+Exit codes: 0 = clean (no new findings), 1 = new findings, 2 = usage or
+internal error. Runs before pyflakes in CI; the committed baseline
+(.ccsa-baseline.json) is kept EMPTY by policy — fix or suppress with
+``# ccsa: ok[RULE] reason`` instead of baselining.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from cruise_control_tpu.lint import (  # noqa: E402
+    all_rules, build_contexts, collect_files, iter_suppressions,
+    load_baseline, run_lint, write_baseline,
+)
+from cruise_control_tpu.lint.core import (  # noqa: E402
+    DEFAULT_BASELINE, DEFAULT_PATHS, fingerprint,
+)
+
+
+def _counts_table(result) -> str:
+    lines = ["| rule | new | baselined | suppressed |",
+             "|---|---|---|---|"]
+    counts = result.counts()
+    for rule_id, row in counts.items():
+        lines.append(f"| {rule_id} | {row['new']} | {row['baselined']} | "
+                     f"{row['suppressed']} |")
+    if not counts:
+        lines.append("| (none) | 0 | 0 | 0 |")
+    total_new = len(result.new) + len(result.errors)
+    lines.append(f"\nCCSA={'FAILED' if result.failed else 'PASSED'} "
+                 f"({result.files_scanned} files, {total_new} new, "
+                 f"{len(result.baselined)} baselined, "
+                 f"{len(result.suppressed)} suppressed)")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.ccsa",
+        description="cruise-control-tpu invariant linter")
+    ap.add_argument("paths", nargs="*",
+                    help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
+    ap.add_argument("--format", choices=("human", "json", "github"),
+                    default="human")
+    ap.add_argument("--root", default=str(REPO_ROOT),
+                    help="repo root for relative paths + doc rules")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: <root>/{DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline entirely")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept all current findings into the baseline")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--list-suppressions", action="store_true",
+                    help="enumerate every documented `# ccsa: ok[...]` "
+                         "tolerance in the tree")
+    args = ap.parse_args(argv)
+
+    root = pathlib.Path(args.root).resolve()
+    if args.list_rules:
+        for rule_id, rule in all_rules().items():
+            print(f"{rule_id}  {rule.title}")
+        return 0
+
+    paths = args.paths or list(DEFAULT_PATHS)
+    if args.list_suppressions:
+        ctxs, _ = build_contexts(collect_files(paths, root), root)
+        for s in iter_suppressions(ctxs):
+            rules = ",".join(s.rules)
+            print(f"{s.path}:{s.line}: ok[{rules}] {s.reason}")
+        return 0
+
+    baseline_path = pathlib.Path(args.baseline) if args.baseline \
+        else root / DEFAULT_BASELINE
+    baseline = set() if args.no_baseline else load_baseline(baseline_path)
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()] \
+        if args.rules else None
+
+    try:
+        result = run_lint(paths, root=root, rules=rules, baseline=baseline)
+    except Exception as exc:  # internal error must not pass as clean
+        print(f"ccsa: internal error: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        by_rel = {c.rel: c for c in result.contexts}
+        # New AND still-present previously-baselined findings: rewriting
+        # the file must never un-accept a prior acceptance.
+        fps = {fingerprint(f, by_rel[f.path].line_text(f.line)
+                           if f.path in by_rel else "")
+               for f in result.new + result.baselined
+               if f.rule != "CCSA000"}
+        if args.paths:
+            # Scoped run: out-of-scope files were never linted, so their
+            # accepted fingerprints must carry over untouched — only a
+            # FULL default-tree run may shrink the baseline.
+            fps |= baseline
+        write_baseline(baseline_path, fps)
+        print(f"wrote {len(fps)} fingerprints to {baseline_path}")
+        return 0
+
+    rc = 1 if result.failed else 0
+    try:
+        _report(args, result)
+    except BrokenPipeError:
+        # Downstream (`| head`) closed the pipe mid-print: the VERDICT is
+        # already computed and must survive — only the output is lost.
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+    return rc
+
+
+def _report(args, result) -> None:
+    reportable = result.errors + result.new + result.baselined
+    if args.format == "json":
+        print(json.dumps({
+            "failed": result.failed,
+            "files_scanned": result.files_scanned,
+            "counts": result.counts(),
+            "findings": [f.as_dict() for f in reportable],
+            "suppressed": [f.as_dict() for f in result.suppressed],
+        }, indent=2))
+    elif args.format == "github":
+        for f in result.errors + result.new:
+            print(f"::error file={f.path},line={max(f.line, 1)},"
+                  f"title={f.rule}::{f.message}")
+        for f in result.baselined:
+            print(f"::warning file={f.path},line={max(f.line, 1)},"
+                  f"title={f.rule} (baselined)::{f.message}")
+        summary = os.environ.get("GITHUB_STEP_SUMMARY")
+        table = "### CCSA invariant lint\n\n" + _counts_table(result) + "\n"
+        if summary:
+            with open(summary, "a") as fh:
+                fh.write(table)
+        else:
+            print(table)
+    else:
+        for f in result.errors + result.new:
+            print(f"{f.path}:{f.line}: {f.rule} {f.message}")
+        for f in result.baselined:
+            print(f"{f.path}:{f.line}: {f.rule} [baselined] {f.message}")
+        print()
+        print(_counts_table(result))
+
+
+if __name__ == "__main__":
+    try:
+        rc = main()
+    except BrokenPipeError:
+        # Pipe closed before the lint even reported (e.g. --list-* piped
+        # to head): no verdict was lost, exit clean.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        rc = 0
+    raise SystemExit(rc)
