@@ -103,6 +103,14 @@ SCENARIO_SEED = int(os.environ.get("BENCH_SCENARIO_SEED", "0"))
 # 0 = each scenario's full spec horizon.
 SCENARIO_TICKS = int(os.environ.get("BENCH_SCENARIO_TICKS", "0"))
 
+# --fleet: run ONLY the megabatch fleet stage (K same-bucket synthetic
+# clusters solved serially vs through one batched device program —
+# ROADMAP item 3's throughput lever). The stage also runs at the END of
+# every default bench pass, so the CI MEGABATCH row and the regression
+# sentry see it without a separate invocation.
+FLEET_MODE = "--fleet" in sys.argv or bool(os.environ.get("BENCH_FLEET"))
+FLEET_K = int(os.environ.get("BENCH_FLEET_CLUSTERS", "4"))
+
 
 # Journal of every emitted line, re-printed at exit (even via the watchdog
 # hard-exit) so the final stdout tail always contains every completed stage.
@@ -621,7 +629,201 @@ def _run_scenario_matrix(deadline: float) -> int:
             continue
         finally:
             signal.alarm(0)
+    # The fleet_megabatch TWIN scenario (round 14) closes the matrix:
+    # two ClusterSimulators sharing one bucket, one optimizer, and a
+    # coalescing scheduler — the multi-cluster case the single-cluster
+    # library cannot represent.
+    remaining = deadline - time.time()
+    if remaining < 60:
+        _emit({"metric": "stage_partial_scenario_fleet_megabatch",
+               "value": 0.0, "unit": "s", "vs_baseline": 0.0,
+               "extras": {"scenario": "fleet_megabatch", "partial": True,
+                          "skipped": True, "reason": "budget exhausted"}})
+        return 0
+    t0 = time.time()
+    signal.alarm(max(1, int(min(remaining - 15.0, 240.0))))
+    try:
+        record = _fleet_twin_scenario_record()
+        signal.alarm(0)
+        _emit(record)
+    except _Watchdog:
+        _emit({"metric": "stage_partial_scenario_fleet_megabatch",
+               "value": round(time.time() - t0, 3), "unit": "s",
+               "vs_baseline": 0.0,
+               "extras": {"scenario": "fleet_megabatch", "partial": True}})
+    except Exception as e:  # noqa: BLE001 — parseable record always
+        _emit({"metric": "stage_failed", "value": round(
+            time.time() - t0, 3), "unit": "s", "vs_baseline": 0.0,
+            "extras": {"stage": "scenario_fleet_megabatch",
+                       "error": f"{type(e).__name__}: {e}"[:500]}})
+    finally:
+        signal.alarm(0)
     return 0
+
+
+def _run_fleet_stage(progress: dict, k: int | None = None) -> dict:
+    """The --fleet stage: K same-bucket synthetic clusters pushed
+    through the CHAIN-SOLVE layer serially (one bounded
+    optimize_goal_in_chain pass per cluster — round 6's fleet
+    scheduling) vs megabatched (one optimize_goal_in_chain_megabatch
+    over all K — round 14). The chain layer is exactly what the
+    megabatch batches — per-cluster host work around it (model build,
+    proposal diff, result assembly) is unchanged by batching and
+    excluded from the ratio. Both paths are warmed so the ratio
+    compares steady states; per-cluster results are asserted
+    BYTE-IDENTICAL between the two paths (the parity pin — CI
+    hard-fails on anything but "ok"), and per-cluster balancedness over
+    the stage chain rides the extras so the regression sentry guards
+    batched solve QUALITY alongside throughput."""
+    import numpy as np
+
+    from cruise_control_tpu.analyzer.chain import (
+        AdaptiveDispatch, DispatchStats, MegastepConfig,
+        optimize_goal_in_chain, optimize_goal_in_chain_megabatch,
+        stack_states, unstack_state,
+    )
+    from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+    from cruise_control_tpu.analyzer.goals import (
+        NetworkOutboundUsageDistributionGoal, PreferredLeaderElectionGoal,
+        RackAwareGoal, ReplicaCapacityGoal, ReplicaDistributionGoal,
+    )
+    from cruise_control_tpu.analyzer.optimizer import balancedness_score
+    from cruise_control_tpu.analyzer.search import (
+        ExclusionMasks, SearchConfig,
+    )
+    from cruise_control_tpu.model.fixtures import random_cluster
+
+    k = k or FLEET_K
+    chain = (RackAwareGoal(), ReplicaCapacityGoal(),
+             NetworkOutboundUsageDistributionGoal(),
+             ReplicaDistributionGoal(), PreferredLeaderElectionGoal())
+    cfg = SearchConfig(num_sources=32, num_dests=8, moves_per_round=32,
+                       max_rounds=60)
+    mega = MegastepConfig(donate=True, async_readback=True,
+                          deficit_moves_cap=0)
+    constraint = BalancingConstraint()
+    masks = ExclusionMasks()
+    dispatch_rounds = 16
+
+    t0 = time.time()
+    clusters = [random_cluster(num_brokers=12, num_topics=6,
+                               num_partitions=96, rf=2, num_racks=3,
+                               seed=3 + s, skew_to_first=2.0,
+                               partition_bucket=32) for s in range(k)]
+    num_topics = clusters[0][1].num_topics
+    progress["fleet_model_build_s"] = round(time.time() - t0, 3)
+
+    def serial_solve(state, stats=None):
+        d = AdaptiveDispatch(dispatch_rounds, 0.0)
+        infos = []
+        for i in range(len(chain)):
+            state, info = optimize_goal_in_chain(
+                state, chain, i, constraint, cfg, num_topics, masks,
+                dispatch_rounds=dispatch_rounds, dispatch=d, megastep=mega,
+                stats=stats,
+                donate_input=bool(infos)
+                and any(x["rounds"] > 0 for x in infos))
+            infos.append(info)
+        return state, infos
+
+    def batch_solve(states, physical=None):
+        batched = stack_states(states)
+        d = AdaptiveDispatch(dispatch_rounds, 0.0)
+        mask = np.ones(len(states), dtype=bool)
+        infos_per_goal = []
+        ran = False
+        for i in range(len(chain)):
+            batched, infos = optimize_goal_in_chain_megabatch(
+                batched, chain, i, constraint, cfg, num_topics, masks,
+                mask, dispatch_rounds=dispatch_rounds, dispatch=d,
+                megastep=mega, physical_stats=physical, donate_input=ran)
+            ran = ran or any(x["rounds"] > 0 for x in infos)
+            infos_per_goal.append(infos)
+        return batched, infos_per_goal
+
+    t0 = time.time()
+    serial_solve(clusters[0][0])
+    progress["fleet_warm_serial_s"] = round(time.time() - t0, 3)
+    t0 = time.time()
+    batch_solve([st for st, _m in clusters])
+    progress["fleet_warm_megabatch_s"] = round(time.time() - t0, 3)
+
+    t0 = time.time()
+    serial = [serial_solve(st) for st, _m in clusters]
+    serial_s = max(time.time() - t0, 1e-9)
+    progress["fleet_serial_s"] = round(serial_s, 3)
+    physical = DispatchStats()
+    t0 = time.time()
+    batched, infos_per_goal = batch_solve([st for st, _m in clusters],
+                                          physical=physical)
+    mb_s = max(time.time() - t0, 1e-9)
+    progress["fleet_megabatch_s"] = round(mb_s, 3)
+
+    parity = "ok"
+    balancedness = []
+    violated: set[str] = set()
+    for b, (s_final, s_infos) in enumerate(serial):
+        m_final = unstack_state(batched, b)
+        if not np.array_equal(np.asarray(s_final.assignment),
+                              np.asarray(m_final.assignment)) \
+                or not np.array_equal(np.asarray(s_final.leader_slot),
+                                      np.asarray(m_final.leader_slot)):
+            parity = "MISMATCH"
+        viol_b = {chain[i].name for i in range(len(chain))
+                  if not infos_per_goal[i][b]["succeeded"]}
+        violated.update(viol_b)
+        balancedness.append(round(balancedness_score(chain, viol_b), 2))
+
+    speedup = serial_s / mb_s
+    return {
+        "metric": f"fleet_megabatch_solve_{k}clusters",
+        "value": round(mb_s, 3),
+        "unit": "s",
+        # Acceptance bar: >= 2x clusters-per-second over serial
+        # scheduling (>1 here means the bar is met).
+        "vs_baseline": round(speedup / 2.0, 3),
+        "extras": {
+            "clusters": k,
+            "parity_pin": parity,
+            "serial_solve_s": round(serial_s, 3),
+            "megabatch_solve_s": round(mb_s, 3),
+            "megabatch_speedup": round(speedup, 3),
+            "serial_clusters_per_s": round(k / serial_s, 3),
+            "fleet_solve_throughput_clusters_per_s": round(k / mb_s, 3),
+            "megabatch_clusters_per_dispatch": k,
+            "megabatch_occupancy": k,
+            "measured_layer": "chain solve (bounded megastep drivers; "
+                              "per-cluster model build / proposal diff "
+                              "excluded — unchanged by batching)",
+            "balancedness_per_cluster": balancedness,
+            "balancedness_after": min(balancedness) if balancedness
+            else None,
+            "violated_goals_after": sorted(violated),
+            "solve_wall_clock_s": round(mb_s, 3),
+            "dispatch_count": physical.dispatch_count,
+            "donated_dispatches": physical.donated,
+            **progress,
+        },
+    }
+
+
+def _fleet_twin_scenario_record() -> dict:
+    """The fleet_megabatch twin scenario (testing/fleet_twin.py) as a
+    SCENARIO_MATRIX row: two drifting clusters sharing one bucket, both
+    self-healing a broker loss while their precomputes flow through
+    megabatched solves (slo_violations includes a no-batched-solves
+    guard, so a silent fallback to solo precomputes fails the matrix)."""
+    from cruise_control_tpu.testing.fleet_twin import run_fleet_megabatch
+    r = run_fleet_megabatch(seed=SCENARIO_SEED,
+                            ticks=SCENARIO_TICKS or None)
+    wall = r.pop("wall_s")
+    return {
+        "metric": "scenario_fleet_megabatch",
+        "value": wall,
+        "unit": "s",
+        "vs_baseline": 0.0 if r["slo_violations"] else 1.0,
+        "extras": r,
+    }
 
 
 _QUANTILE_SPANS = ("analyzer.optimize", "goal.solve", "model.assemble",
@@ -846,6 +1048,22 @@ def _guarded_main(deadline: float) -> int:
                           "trace_file": trace_file,
                           "stderr_file": _stderr_path}})
         return _run_scenario_matrix(deadline)
+    if FLEET_MODE:
+        _emit({"metric": "bench_bootstrap",
+               "value": round(time.time() - t0, 3), "unit": "s",
+               "vs_baseline": 1.0,
+               "extras": {"device": device, "num_devices": n_dev,
+                          "mode": "fleet", "clusters": FLEET_K,
+                          "compile_cache_dir": cache_dir,
+                          "stderr_file": _stderr_path}})
+        try:
+            _emit(_run_fleet_stage({}))
+        except Exception as e:  # noqa: BLE001 — parseable record always
+            _emit({"metric": "stage_failed", "value": 0.0, "unit": "s",
+                   "vs_baseline": 0.0,
+                   "extras": {"stage": "fleet_megabatch",
+                              "error": f"{type(e).__name__}: {e}"[:500]}})
+        return 0
     noop_ns = _tracing_noop_overhead_ns()
     _emit({"metric": "tracing_noop_span_overhead", "value": round(noop_ns, 1),
            "unit": "ns", "vs_baseline": 1.0,
@@ -959,6 +1177,42 @@ def _guarded_main(deadline: float) -> int:
         finally:
             signal.alarm(0)
         prev_total = time.time() - t0
+    # The megabatch fleet stage rides every default pass (cheap, CI-scale
+    # shapes) so the MEGABATCH summary row and the regression sentry see
+    # batched throughput + per-cluster balancedness on every run.
+    remaining = deadline - time.time()
+    if remaining > 90:
+        progress: dict = {}
+        t0 = time.time()
+        signal.alarm(max(1, int(min(remaining - 15.0, 300.0))))
+        try:
+            record = _run_fleet_stage(progress)
+            signal.alarm(0)
+            _emit(record)
+            if baseline is not None:
+                verdict = compare_stage_to_baseline(record, baseline)
+                if verdict is not None:
+                    sentry_verdicts.append(verdict)
+                    _emit(verdict)
+        except _Watchdog:
+            _emit({"metric": "stage_partial_fleet_megabatch",
+                   "value": round(time.time() - t0, 3), "unit": "s",
+                   "vs_baseline": 0.0,
+                   "extras": {"stage": "fleet_megabatch", "partial": True,
+                              **progress}})
+        except Exception as e:  # noqa: BLE001 — parseable record always
+            _emit({"metric": "stage_failed", "value": round(
+                time.time() - t0, 3), "unit": "s", "vs_baseline": 0.0,
+                "extras": {"stage": "fleet_megabatch",
+                           "error": f"{type(e).__name__}: {e}"[:500],
+                           **progress}})
+        finally:
+            signal.alarm(0)
+    else:
+        _emit({"metric": "stage_partial_fleet_megabatch", "value": 0.0,
+               "unit": "s", "vs_baseline": 0.0,
+               "extras": {"stage": "fleet_megabatch", "partial": True,
+                          "skipped": True, "reason": "budget exhausted"}})
     _emit_sentry_summary(sentry_verdicts, baseline)
     _dump_flight_recorder()
     return 0

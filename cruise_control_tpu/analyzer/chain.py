@@ -924,12 +924,18 @@ class DispatchStats:
         self.speculative = 0
 
     def record(self, kind: str, rounds: int, donated: bool = False,
-               speculative: bool = False) -> None:
+               speculative: bool = False, telemetry: bool = True) -> None:
+        """``telemetry=False`` keeps the tally local: the megabatch pump
+        splits ONE physical dispatch into per-cluster accounting records,
+        and only the physical record may hit the solver_dispatches
+        sensors (a 4-cluster dispatch is one XLA execution, not four)."""
         self.rounds_per_dispatch.append(int(rounds))
         if donated:
             self.donated += 1
         if speculative:
             self.speculative += 1
+        if not telemetry:
+            return
         from ..utils.xla_telemetry import record_dispatch
         record_dispatch(kind, int(rounds), donated=donated,
                         speculative=speculative)
@@ -1086,6 +1092,614 @@ def run_bounded_pass(enqueue: Callable, st, pass_cap: int,
                              or (out_of_time is not None and out_of_time())):
             break
     return st, applied_total, pass_rounds
+
+
+# ---------------------------------------------------------------------------
+# Megabatch: whole buckets of clusters through ONE device program
+# ---------------------------------------------------------------------------
+#
+# The fleet layer pads every cluster onto a shared bucket grid
+# (fleet.bucketing), so same-bucket clusters are shape-identical pytrees.
+# Stacking them along a leading cluster axis and vmapping the round body
+# turns the megastep into a FLEET megastep: one donated dispatch advances
+# every cluster in the batch by up to ``budget`` rounds, with a
+# per-cluster early-exit mask replacing the scalar early-exit flag — a
+# converged (or inert pad-slot) cluster's carry is frozen by a select, so
+# its state stays byte-identical to a serial solve while its neighbors
+# keep searching. Rounds run in lockstep: the batched dispatch costs
+# max-over-clusters rounds instead of the serial sum — the
+# Podracer/Anakin lever (compile once per bucket shape, amortize the
+# whole fleet through it).
+
+
+def stack_states(states: Sequence[ClusterTensors]) -> ClusterTensors:
+    """Stack shape-identical cluster states along a new leading cluster
+    axis (the megabatch layout). All states must share one padded bucket
+    shape — the fleet assembler's grouping contract."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def unstack_state(batched: ClusterTensors, index: int) -> ClusterTensors:
+    """Slice cluster ``index`` back out of a megabatch state."""
+    return jax.tree.map(lambda x: x[index], batched)
+
+
+def inert_state_like(state: ClusterTensors) -> ClusterTensors:
+    """A zero-weight pad-slot cluster at ``state``'s shape: every broker
+    DEAD/masked with zero capacity, every partition empty and masked —
+    the same pad-row encoding fleet.bucketing uses for rows, applied to a
+    WHOLE cluster slot. It generates no candidates, no violations, and no
+    offline replicas, so the per-goal activation mask never wakes it; a
+    partially-filled megabatch pads with these so one compiled program
+    per bucket shape serves any occupancy."""
+    from ..common.broker_state import BrokerState
+    return dataclasses.replace(
+        state,
+        assignment=jnp.full_like(state.assignment, -1),
+        leader_slot=jnp.full_like(state.leader_slot, -1),
+        leader_load=jnp.zeros_like(state.leader_load),
+        follower_load=jnp.zeros_like(state.follower_load),
+        capacity=jnp.zeros_like(state.capacity),
+        rack=jnp.zeros_like(state.rack),
+        broker_state=jnp.full_like(state.broker_state,
+                                   int(BrokerState.DEAD)),
+        topic=jnp.zeros_like(state.topic),
+        partition_mask=jnp.zeros_like(state.partition_mask),
+        broker_mask=jnp.zeros_like(state.broker_mask))
+
+
+def _mask_axes(masks: ExclusionMasks):
+    """(fields, vmap axes) for a BATCHED ExclusionMasks: each field is
+    either None for every cluster in the batch or stacked ``[C, ...]``
+    (the assembler's mask-uniformity contract)."""
+    fields = (masks.excluded_topics, masks.excluded_replica_move_brokers,
+              masks.excluded_leadership_brokers)
+    return fields, tuple(None if f is None else 0 for f in fields)
+
+
+def _megabatch_rounds_driver(states: ClusterTensors, active0: jax.Array,
+                             active_idx: jax.Array, prior_mask: jax.Array,
+                             goals: tuple[Goal, ...],
+                             constraint: BalancingConstraint,
+                             cfg: SearchConfig, num_topics: int,
+                             masks: ExclusionMasks, budget: jax.Array,
+                             ring_rounds: int = 0):
+    """Traced body of the batched move megastep: one ``lax.while_loop``
+    whose body vmaps ``_chain_round_body`` over the leading cluster axis.
+
+    ``active0[C]`` is the per-cluster early-exit mask threaded DISPATCH TO
+    DISPATCH as a device value (the pump chains it like the state, so
+    enqueueing the next dispatch never reads it back): a cluster runs a
+    round only while active, a zero-apply round deactivates it, and an
+    inactive cluster's whole carry (state, aggregate, ring) is frozen by a
+    select — byte-identical to the serial megastep, which simply stops
+    dispatching at that point. The loop ends when every cluster is
+    inactive or the shared round budget is spent; while active, a
+    cluster's within-dispatch round index equals the global one (all
+    clusters start at round 0 together), so the aggregate refresh cadence
+    matches the serial driver's exactly.
+
+    ``ring_rounds`` > 0 grows the flight ring a CLUSTER axis:
+    ``[C, ring_rounds, STAT_WIDTH]``, one per-round stats row per cluster,
+    frozen with the rest of the carry once the cluster exits.
+
+    Returns (states, total[C], rounds[C], active_out[C], ring-or-None)."""
+    collect = ring_rounds > 0
+    c = states.assignment.shape[0]
+    mask_fields, mask_ax = _mask_axes(masks)
+
+    def per_cluster(s, a, ring, tm, rm, lm, gr):
+        m = ExclusionMasks(tm, rm, lm)
+        a = maybe_refresh(a, s, num_topics, gr)
+        ns, na, applied, stat = _chain_round_body(
+            s, a, active_idx, prior_mask, goals, constraint, cfg,
+            num_topics, m, collect=collect)
+        if collect:
+            ring = ring.at[gr % ring_rounds].set(stat)
+        return ns, na, ring, applied
+
+    vround = jax.vmap(per_cluster,
+                      in_axes=(0, 0, 0) + mask_ax + (None,))
+
+    def freeze(active):
+        def sel(new, old):
+            keep = active.reshape((c,) + (1,) * (new.ndim - 1))
+            return jnp.where(keep, new, old)
+        return sel
+
+    cap = jnp.minimum(jnp.int32(cfg.max_rounds), budget.astype(jnp.int32))
+
+    def cond(carry):
+        _s, _a, _r, _tot, _rnd, gr, active = carry
+        return active.any() & (gr < cap)
+
+    def body(carry):
+        st, ag, ring, tot, rnd, gr, active = carry
+        nst, nag, nring, applied = vround(st, ag, ring, *mask_fields, gr)
+        sel = freeze(active)
+        st = jax.tree.map(sel, nst, st)
+        ag = jax.tree.map(sel, nag, ag)
+        ring = sel(nring, ring)
+        applied = jnp.where(active, applied, 0).astype(jnp.int32)
+        return (st, ag, ring, tot + applied,
+                rnd + active.astype(jnp.int32), gr + 1,
+                active & (applied > 0))
+
+    agg0 = jax.vmap(lambda s: compute_agg(s, num_topics))(states)
+    ring0 = jnp.zeros((c, ring_rounds if collect else 0, _FLIGHT_STATS),
+                      jnp.float32)
+    final, _agg, ring, total, rounds, _gr, active = jax.lax.while_loop(
+        cond, body,
+        (states, agg0, ring0, jnp.zeros((c,), jnp.int32),
+         jnp.zeros((c,), jnp.int32), jnp.int32(0), active0))
+    return final, total, rounds, active, (ring if collect else None)
+
+
+@partial(jax.jit, static_argnames=("goals", "constraint", "cfg", "num_topics",
+                                   "ring_rounds"))
+def megabatch_optimize_rounds(states: ClusterTensors, active0: jax.Array,
+                              active_idx: jax.Array, prior_mask: jax.Array,
+                              goals: tuple[Goal, ...],
+                              constraint: BalancingConstraint,
+                              cfg: SearchConfig, num_topics: int,
+                              masks: ExclusionMasks, budget: jax.Array,
+                              ring_rounds: int = 0):
+    """Batched fused move driver (the non-donating megabatch twin of
+    ``chain_optimize_rounds``; the CPU / parity-oracle path). Occupancy is
+    a traced property (``active0`` plus inert pad-slot clusters), so ONE
+    compilation per bucket shape serves any fill level."""
+    final, total, rounds, active, ring = _megabatch_rounds_driver(
+        states, active0, active_idx, prior_mask, goals, constraint, cfg,
+        num_topics, masks, budget, ring_rounds=ring_rounds)
+    if ring_rounds > 0:
+        return final, total, rounds, active, ring
+    return final, total, rounds, active
+
+
+@partial(jax.jit, static_argnames=("goals", "constraint", "cfg",
+                                   "num_topics", "ring_rounds"),
+         donate_argnums=(0, 1))
+def megabatch_optimize_rounds_donated(assignment: jax.Array,
+                                      leader_slot: jax.Array,
+                                      rest: ClusterTensors,
+                                      active0: jax.Array,
+                                      active_idx: jax.Array,
+                                      prior_mask: jax.Array,
+                                      goals: tuple[Goal, ...],
+                                      constraint: BalancingConstraint,
+                                      cfg: SearchConfig, num_topics: int,
+                                      masks: ExclusionMasks,
+                                      budget: jax.Array,
+                                      ring_rounds: int = 0):
+    """The donated fleet megastep: identical trace to
+    ``megabatch_optimize_rounds`` with the BATCHED mutable pair
+    ``{assignment[C,P,S], leader_slot[C,P]}`` donated — exactly the
+    strip_mutable donation set grown a cluster axis, nothing else (the
+    stacked topology planes in ``rest`` are built from the refresh
+    cache's shared arrays and must never be donated; CCSA002 verifies the
+    batched kernel form too). Callers pass ``strip_mutable`` applied
+    per cluster before stacking as ``rest``."""
+    states = dataclasses.replace(rest, assignment=assignment,
+                                 leader_slot=leader_slot)
+    final, total, rounds, active, ring = _megabatch_rounds_driver(
+        states, active0, active_idx, prior_mask, goals, constraint, cfg,
+        num_topics, masks, budget, ring_rounds=ring_rounds)
+    if ring_rounds > 0:
+        return (final.assignment, final.leader_slot, total, rounds, active,
+                ring)
+    return final.assignment, final.leader_slot, total, rounds, active
+
+
+def _megabatch_swap_driver(states: ClusterTensors, active0: jax.Array,
+                           active_idx: jax.Array, prior_mask: jax.Array,
+                           goals: tuple[Goal, ...],
+                           constraint: BalancingConstraint, num_topics: int,
+                           masks: ExclusionMasks, moves: int,
+                           max_rounds: int, budget: jax.Array):
+    """Batched swap-phase driver (same per-cluster freeze discipline as
+    the move driver; swap phases carry no flight ring)."""
+    c = states.assignment.shape[0]
+    mask_fields, mask_ax = _mask_axes(masks)
+
+    def per_cluster(s, a, tm, rm, lm, gr):
+        m = ExclusionMasks(tm, rm, lm)
+        a = maybe_refresh(a, s, num_topics, gr)
+        ns, na, applied = _chain_swap_body(s, a, active_idx, prior_mask,
+                                           goals, constraint, num_topics,
+                                           m, moves)
+        return ns, na, applied
+
+    vround = jax.vmap(per_cluster, in_axes=(0, 0) + mask_ax + (None,))
+    cap = jnp.minimum(jnp.int32(max_rounds), budget.astype(jnp.int32))
+
+    def cond(carry):
+        _s, _a, _tot, _rnd, gr, active = carry
+        return active.any() & (gr < cap)
+
+    def body(carry):
+        st, ag, tot, rnd, gr, active = carry
+        nst, nag, applied = vround(st, ag, *mask_fields, gr)
+
+        def sel(new, old):
+            keep = active.reshape((c,) + (1,) * (new.ndim - 1))
+            return jnp.where(keep, new, old)
+
+        st = jax.tree.map(sel, nst, st)
+        ag = jax.tree.map(sel, nag, ag)
+        applied = jnp.where(active, applied, 0).astype(jnp.int32)
+        return (st, ag, tot + applied, rnd + active.astype(jnp.int32),
+                gr + 1, active & (applied > 0))
+
+    agg0 = jax.vmap(lambda s: compute_agg(s, num_topics))(states)
+    final, _agg, total, rounds, _gr, active = jax.lax.while_loop(
+        cond, body,
+        (states, agg0, jnp.zeros((c,), jnp.int32),
+         jnp.zeros((c,), jnp.int32), jnp.int32(0), active0))
+    return final, total, rounds, active
+
+
+@partial(jax.jit, static_argnames=("goals", "constraint", "num_topics",
+                                   "moves", "max_rounds"))
+def megabatch_swap_rounds(states: ClusterTensors, active0: jax.Array,
+                          active_idx: jax.Array, prior_mask: jax.Array,
+                          goals: tuple[Goal, ...],
+                          constraint: BalancingConstraint, num_topics: int,
+                          masks: ExclusionMasks, moves: int,
+                          max_rounds: int, budget: jax.Array):
+    """Batched fused swap driver (non-donating twin)."""
+    return _megabatch_swap_driver(states, active0, active_idx, prior_mask,
+                                  goals, constraint, num_topics, masks,
+                                  moves, max_rounds, budget)
+
+
+@partial(jax.jit, static_argnames=("goals", "constraint", "num_topics",
+                                   "moves", "max_rounds"),
+         donate_argnums=(0, 1))
+def megabatch_swap_rounds_donated(assignment: jax.Array,
+                                  leader_slot: jax.Array,
+                                  rest: ClusterTensors, active0: jax.Array,
+                                  active_idx: jax.Array,
+                                  prior_mask: jax.Array,
+                                  goals: tuple[Goal, ...],
+                                  constraint: BalancingConstraint,
+                                  num_topics: int, masks: ExclusionMasks,
+                                  moves: int, max_rounds: int,
+                                  budget: jax.Array):
+    """Donated batched swap megastep (see
+    megabatch_optimize_rounds_donated for the donation contract)."""
+    states = dataclasses.replace(rest, assignment=assignment,
+                                 leader_slot=leader_slot)
+    final, total, rounds, active = _megabatch_swap_driver(
+        states, active0, active_idx, prior_mask, goals, constraint,
+        num_topics, masks, moves, max_rounds, budget)
+    return final.assignment, final.leader_slot, total, rounds, active
+
+
+@partial(jax.jit, static_argnames=("goals", "constraint", "num_topics"))
+def megabatch_goal_stats(states: ClusterTensors, active_idx: jax.Array,
+                         goals: tuple[Goal, ...],
+                         constraint: BalancingConstraint, num_topics: int,
+                         masks: ExclusionMasks):
+    """Per-cluster (violation, objective, offline) of the active goal on a
+    megabatch state — the batched twin of ``chain_goal_stats``, one device
+    call for the whole bucket."""
+    mask_fields, mask_ax = _mask_axes(masks)
+
+    def per_cluster(s, tm, rm, lm):
+        return _chain_goal_stats_body(s, active_idx, goals, constraint,
+                                      num_topics,
+                                      ExclusionMasks(tm, rm, lm))
+
+    return jax.vmap(per_cluster, in_axes=(0,) + mask_ax)(states,
+                                                         *mask_fields)
+
+
+def run_megabatch_pass(enqueue: Callable, st, active0, pass_cap: int,
+                       controller: AdaptiveDispatch,
+                       async_readback: bool = True,
+                       stats: "list[DispatchStats] | None" = None,
+                       physical_stats: "DispatchStats | None" = None,
+                       kind: str = "move", flights=None):
+    """Drive one logical BATCHED pass as a sequence of bounded megabatch
+    dispatches — the fleet twin of ``run_bounded_pass``, same one-behind
+    pump. ``enqueue(st, active, budget) -> (st, active_out, applied,
+    rounds, donated, ring)`` fires one batched dispatch and returns
+    device futures only; the per-cluster early-exit mask ``active_out``
+    chains into the next enqueue exactly like the state, so pipelining
+    never waits on it. Scalars become ``[C]`` arrays: the readback
+    decodes them ONCE per dispatch and splits per-cluster accounting out
+    of it — ``stats[b]`` records cluster b's rounds (dispatch accounting
+    split), ``flights[b]`` gets its dispatch record plus its slice of the
+    cluster-axis flight ring, and ``physical_stats`` records the ONE
+    actual XLA execution (the sensor-facing tally; per-cluster splits
+    skip telemetry so a 4-cluster dispatch never counts as 4 device
+    executions).
+
+    The pass converges when every cluster's early-exit mask clears. The
+    speculatively-enqueued successor then runs ZERO rounds (every
+    cluster inactive at entry — cheaper than the serial speculative
+    zero-apply round, and byte-identical since inactive clusters are
+    frozen); it is recorded speculative and contributes nothing.
+
+    Returns (st, active_final_host, applied_totals, rounds_totals) with
+    the totals as per-cluster numpy int arrays."""
+    import numpy as np
+    c = active0.shape[0]
+    applied_total = np.zeros(c, dtype=np.int64)
+    rounds_total = np.zeros(c, dtype=np.int64)
+    # ccsa: ok[CCSA001] pass-entry decode of the caller's activation
+    # mask — nothing is in flight before the first enqueue
+    active_host = np.asarray(active0).astype(bool)
+    entry_active = active_host.copy()
+    active_dev = active0
+    est_rounds = 0
+    prev = None   # (applied, rounds, active_out, budget, t0, donated, ring)
+    last_read_t = None
+    converged = False
+    while True:
+        cur = None
+        may_enqueue = prev is None or async_readback
+        if may_enqueue and not converged and est_rounds < pass_cap:
+            budget = controller.budget(pass_cap - est_rounds)
+            t0 = _time.monotonic()
+            st, active_dev, applied, r, donated, ring = enqueue(
+                st, active_dev, budget)
+            cur = (applied, r, active_dev, budget, t0, donated, ring)
+            est_rounds += budget
+        if prev is not None:
+            applied_p, r_p, act_p, budget_p, t0_p, donated_p, ring_p = prev
+            # ccsa: ok[CCSA001] THE megabatch pump readback: dispatch N's
+            # per-cluster arrays are read here exactly one enqueue behind
+            # — N+1 is already in flight chained on N's output state and
+            # early-exit mask, so this block overlaps device compute
+            rounds_np = np.asarray(r_p)             # blocks on dispatch N
+            now = _time.monotonic()
+            start = t0_p if last_read_t is None else max(t0_p, last_read_t)
+            # ccsa: ok[CCSA001] same readback point: N already synced via
+            # rounds_np, these transfers are paid, not new stalls
+            applied_np = np.asarray(applied_p)
+            # ccsa: ok[CCSA001] same readback point (the early-exit mask
+            # the NEXT enqueue already consumed on device)
+            active_host = np.asarray(act_p).astype(bool)
+            # ccsa: ok[CCSA001] decode of the already-fetched host array
+            global_rounds = int(rounds_np.max()) if c else 0
+            applied_total += applied_np
+            rounds_total += rounds_np
+            controller.observe(global_rounds, budget_p, now - start)
+            last_read_t = now
+            if physical_stats is not None:
+                physical_stats.record(kind, global_rounds, donated=donated_p)
+            for b in range(c):
+                if rounds_np[b] <= 0:
+                    continue
+                if stats is not None:
+                    # ccsa: ok[CCSA001] per-cluster split of the paid
+                    # readback: host numpy scalar decodes only
+                    stats[b].record(kind, int(rounds_np[b]),
+                                    donated=donated_p, telemetry=False)
+                if flights is not None:
+                    # ccsa: ok[CCSA001] same split, host numpy decodes
+                    r_b, a_b = int(rounds_np[b]), int(applied_np[b])
+                    flights[b].dispatch(
+                        kind, budget_p, r_b, a_b, donated=donated_p,
+                        elapsed_s=now - start, controller_k=controller.k,
+                        ring=None if ring_p is None else ring_p[b])
+            est_rounds -= budget_p - global_rounds
+            if not active_host.any():
+                converged = True
+        if converged and cur is not None:
+            # Speculative post-convergence dispatch: every cluster entered
+            # inactive, so the batched while_loop ran zero rounds and the
+            # state is untouched — recorded, never counted.
+            if physical_stats is not None:
+                physical_stats.record(kind, 0, donated=cur[5],
+                                      speculative=True)
+            if flights is not None:
+                # Only clusters that PARTICIPATED in this pass get the
+                # speculative record — a goal-satisfied (or pad-slot)
+                # cluster that never activated records no dispatch at
+                # all, exactly like its serial solve.
+                for b in range(c):
+                    if entry_active[b]:
+                        flights[b].dispatch(kind, cur[3], 0, 0,
+                                            donated=cur[5],
+                                            speculative=True,
+                                            controller_k=controller.k)
+            cur = None
+        prev = cur
+        if prev is None and (converged or est_rounds >= pass_cap):
+            break
+    return st, active_host, applied_total, rounds_total
+
+
+def optimize_goal_in_chain_megabatch(states: ClusterTensors,
+                                     chain: Sequence[Goal], index: int,
+                                     constraint: BalancingConstraint,
+                                     cfg: SearchConfig, num_topics: int,
+                                     masks: ExclusionMasks,
+                                     cluster_mask,
+                                     dispatch_rounds: int,
+                                     dispatch: AdaptiveDispatch,
+                                     megastep: MegastepConfig,
+                                     stats: "list[DispatchStats] | None" = None,
+                                     physical_stats: "DispatchStats | None" = None,
+                                     flights=None,
+                                     donate_input: bool = False,
+                                     ) -> tuple[ClusterTensors, list[dict]]:
+    """Run goal ``chain[index]`` for EVERY cluster in a megabatch under
+    the acceptance of ``chain[:index]`` — the batched twin of
+    ``optimize_goal_in_chain``, bounded-dispatch path only (the megabatch
+    exists to amortize dispatches; there is no batched unbounded path).
+
+    ``cluster_mask[C]`` (host bool array) marks real cluster slots: inert
+    pad slots are never activated, count no rounds, and get no info dict
+    semantics beyond zeros. Per-cluster failures do NOT raise — a hard
+    goal failing on cluster 2 must not abort clusters 0, 1, 3 — instead
+    each returned info dict may carry ``error``/``error_type`` and the
+    caller freezes that cluster for the rest of the chain (its state then
+    matches the serial solve's at its raise point).
+
+    Deficit-aware count-goal sizing is structurally OFF here: it sizes
+    the search grid from ONE cluster's entry violation, and a megabatch
+    shares one compiled grid across the bucket (the assembler's config
+    key pins this).
+
+    Returns (states, [per-cluster info dict])."""
+    import numpy as np
+    goals = tuple(chain)
+    goal = goals[index]
+    idx = jnp.int32(index)
+    prior = jnp.asarray([j < index for j in range(len(goals))])
+    c = states.assignment.shape[0]
+    cluster_mask = np.asarray(cluster_mask).astype(bool)
+    assert dispatch_rounds > 0, "megabatch requires the bounded path"
+
+    viol0_d, obj0_d, off0_d = megabatch_goal_stats(states, idx, goals,
+                                                   constraint, num_topics,
+                                                   masks)
+    viol0 = np.asarray(viol0_d)
+    obj0 = np.asarray(obj0_d)
+    off0 = np.asarray(off0_d)
+    if flights is not None:
+        for b in range(c):
+            if cluster_mask[b]:
+                flights[b].entry(violation=float(viol0[b]),
+                                 objective=float(obj0[b]),
+                                 offline=int(off0[b]))
+                flights[b].grid(cfg.num_sources, cfg.num_dests,
+                                cfg.moves_per_round)
+    drain = np.zeros(c, dtype=bool)
+    if masks.excluded_replica_move_brokers is not None:
+        drain = np.asarray(jax.vmap(excluded_hosting_replicas)(
+            states, masks.excluded_replica_move_brokers).any(axis=(1, 2)))
+    ran = cluster_mask & ((viol0 > 0) | (off0 > 0) | drain)
+
+    donate = donation_enabled(megastep)
+    async_rb = bool(megastep.async_readback)
+    ring_n = 0
+    if flights is not None and flights and flights[0].recording:
+        ring_n = flights[0].ring_rounds
+    can_donate = [bool(donate_input)]
+
+    def make_enqueue(phase: str):
+        def enqueue(st, active, budget: int):
+            b = jnp.int32(budget)
+            ring = None
+            if donate:
+                if not can_donate[0]:
+                    st = dataclasses.replace(
+                        st, assignment=jnp.copy(st.assignment),
+                        leader_slot=jnp.copy(st.leader_slot))
+                rest = dataclasses.replace(
+                    st,
+                    assignment=jnp.zeros((c, 0, st.assignment.shape[2]),
+                                         st.assignment.dtype),
+                    leader_slot=jnp.zeros((c, 0), st.leader_slot.dtype))
+                if phase == "move":
+                    out = megabatch_optimize_rounds_donated(
+                        st.assignment, st.leader_slot, rest, active, idx,
+                        prior, goals, constraint, cfg, num_topics, masks,
+                        b, ring_rounds=ring_n)
+                    a, l, applied, r, act = out[:5]
+                    ring = out[5] if ring_n > 0 else None
+                else:
+                    a, l, applied, r, act = megabatch_swap_rounds_donated(
+                        st.assignment, st.leader_slot, rest, active, idx,
+                        prior, goals, constraint, num_topics, masks, 8,
+                        64, b)
+                st = dataclasses.replace(st, assignment=a, leader_slot=l)
+            elif phase == "move":
+                out = megabatch_optimize_rounds(
+                    st, active, idx, prior, goals, constraint, cfg,
+                    num_topics, masks, b, ring_rounds=ring_n)
+                st, applied, r, act = out[:4]
+                ring = out[4] if ring_n > 0 else None
+            else:
+                st, applied, r, act = megabatch_swap_rounds(
+                    st, active, idx, prior, goals, constraint, num_topics,
+                    masks, 8, 64, b)
+            can_donate[0] = True
+            return st, act, applied, r, donate, ring
+        return enqueue
+
+    applied_total = np.zeros(c, dtype=np.int64)
+    swaps_total = np.zeros(c, dtype=np.int64)
+    rounds_total = np.zeros(c, dtype=np.int64)
+    alive = ran.copy()
+    while True:
+        # A cluster joins the next move+swap cycle exactly when the serial
+        # host loop would: its last swap pass applied something (or this
+        # is its first cycle) and its cumulative rounds sit below the cap.
+        participate = alive & (rounds_total < cfg.max_rounds)
+        if not participate.any():
+            break
+        active0 = jnp.asarray(participate)
+        states, _act, moved, r = run_megabatch_pass(
+            make_enqueue("move"), states, active0, cfg.max_rounds,
+            dispatch, async_readback=async_rb, stats=stats,
+            physical_stats=physical_stats, kind="move", flights=flights)
+        applied_total += moved
+        rounds_total += r
+        if not goal.supports_swap:
+            break
+        states, _act, swapped, sr = run_megabatch_pass(
+            make_enqueue("swap"), states, jnp.asarray(participate), 64,
+            dispatch, async_readback=async_rb, stats=stats,
+            physical_stats=physical_stats, kind="swap", flights=flights)
+        swaps_total += swapped
+        applied_total += swapped
+        rounds_total += sr
+        alive = participate & (swapped > 0)
+
+    if ran.any():
+        viol1_d, obj1_d, off1_d = megabatch_goal_stats(
+            states, idx, goals, constraint, num_topics, masks)
+        viol1 = np.asarray(viol1_d)
+        obj1 = np.asarray(obj1_d)
+        off1 = np.asarray(off1_d)
+    else:
+        viol1, obj1, off1 = viol0, obj0, off0
+    # Skipped clusters never ran: their entry stats ARE their exit stats
+    # (the batched kernels froze them, but the goal-stats recompute on a
+    # frozen state is the same value — use the entry read for exactness).
+    viol1 = np.where(ran, viol1, viol0)
+    obj1 = np.where(ran, obj1, obj0)
+    off1 = np.where(ran, off1, off0)
+
+    infos: list[dict] = []
+    for b in range(c):
+        if flights is not None and cluster_mask[b]:
+            flights[b].exit(violation=float(viol1[b]),
+                            objective=float(obj1[b]),
+                            offline=int(off1[b]))
+        total_violation = float(viol1[b])
+        succeeded = total_violation <= 1e-6
+        info = {
+            "goal": goal.name,
+            "rounds": int(rounds_total[b]),
+            "moves_applied": int(applied_total[b]),
+            "swaps_applied": int(swaps_total[b]),
+            "residual_violation": total_violation,
+            "succeeded": succeeded,
+            "objective": float(obj1[b]),
+            "violated_on_entry": float(viol0[b]) > 1e-6,
+            "offline_remaining": int(off1[b]),
+        }
+        if cluster_mask[b] and int(off0[b]) == 0:
+            before, after = float(obj0[b]), float(obj1[b])
+            if after > before + 1e-4 * max(1.0, abs(before)):
+                info["error_type"] = "StatsRegressionError"
+                info["error"] = (
+                    f"goal {goal.name} regressed its own objective during "
+                    f"its optimization: {before:.6g} -> {after:.6g}")
+        if cluster_mask[b] and goal.is_hard and not succeeded \
+                and "error" not in info:
+            info["error_type"] = "OptimizationFailureError"
+            info["error"] = (
+                f"hard goal {goal.name} unsatisfied: residual violation "
+                f"{total_violation:.4f} after {int(rounds_total[b])} rounds")
+        infos.append(info)
+    return states, infos
 
 
 def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],

@@ -257,10 +257,16 @@ class GoalOptimizer:
         last = getattr(self._tls, "last_pass", None)
         return last[1].as_dict() if last else {}
 
-    def _controller_pair(self, state: ClusterTensors):
+    def _controller_pair(self, state: ClusterTensors, batch: int = 0):
         """(narrow, wide) persistent AdaptiveDispatch pair for this model
         shape (created on first use; lock-guarded — facade request
         threads and the fleet worker may solve concurrently).
+
+        ``batch`` > 0 keys a MEGABATCH width into the shape: a batched
+        round costs ~occupancy× a single-cluster round on a busy device,
+        so the budget learned on solo solves of this shape must not carry
+        onto the first 8-wide fleet dispatch (and vice versa) — same
+        cost-class discipline as the narrow/wide split.
 
         Only the dict lookup is locked: the controllers themselves are
         deliberately unsynchronized. Two same-shape solves running
@@ -273,7 +279,7 @@ class GoalOptimizer:
         would serialize readbacks across solves on the hot path to
         protect a heuristic."""
         from .chain import AdaptiveDispatch
-        key = (state.num_partitions, state.num_brokers)
+        key = (state.num_partitions, state.num_brokers, batch)
         # ccsa: ok[CCSA007] PR 5 tolerance, machine-readable: registry
         # lookups locked below; the AdaptiveDispatch values are
         # deliberately unsynchronized — bounded (k stays in [1, max]),
@@ -679,3 +685,277 @@ class GoalOptimizer:
             duration_s=time.time() - t_start,
         )
         return state, result
+
+    # -- megabatch: whole buckets of clusters in one device program --------
+    def megabatch_chain(self, meta: ClusterMeta,
+                        goals: Sequence[Goal] | None = None) -> tuple:
+        """The resolved goal chain a megabatch slot would run — the
+        grouping key component the fleet assembler compares: clusters may
+        share one compiled batched program only when their resolved
+        chains are identical (broker-set bindings included)."""
+        goal_chain = list(goals) if goals is not None \
+            else goals_by_priority(self._config)
+        return tuple(self._resolve_broker_sets(goal_chain, meta))
+
+    def optimizations_megabatch(self, items: Sequence[tuple],
+                                goals: Sequence[Goal] | None = None,
+                                options: OptimizationOptions | None = None,
+                                width: int = 0,
+                                ) -> list:
+        """Solve MANY same-bucket clusters in one batched device program
+        (ROADMAP item 3): every model in ``items`` — a sequence of
+        ``(state, meta, cluster_id)`` — is stacked along a leading
+        cluster axis and the whole goal chain runs through the batched
+        megastep drivers (chain.optimize_goal_in_chain_megabatch), so the
+        fleet pays max-over-clusters rounds instead of the serial sum and
+        ONE compiled program per bucket shape serves any occupancy.
+
+        Preconditions (the fleet assembler's grouping contract — violated
+        ones raise ValueError before any device work): identical padded
+        bucket shape including the replica-slot axis, identical
+        ``num_topics``, an identical resolved goal chain, uniform
+        exclusion-mask presence, and no fast mode. ``width`` > len(items)
+        pads the batch with inert zero-weight cluster slots (all-dead
+        brokers, fully masked partitions) so one compiled program per
+        bucket shape serves any occupancy.
+
+        Deficit-aware count-goal sizing is forced OFF: it specializes the
+        search grid to one cluster's entry violation, which cannot be
+        shared across a batch. Controllers are the persistent per-shape
+        pair keyed WITH the batch width (see _controller_pair).
+
+        Returns a list aligned with ``items``: ``(final_state,
+        OptimizerResult)`` per cluster, or the per-cluster Exception a
+        serial solve would have raised (hard-goal failure / stats
+        regression) — one cluster's failure never aborts its batchmates.
+        """
+        import contextlib
+
+        import jax
+
+        from .chain import (
+            DispatchStats, inert_state_like, optimize_goal_in_chain_megabatch,
+            stack_states, unstack_state,
+        )
+        from ..utils.flight_recorder import FLIGHT, NO_FLIGHT
+        from ..utils.sensors import SENSORS, cluster_label
+        from ..utils.tracing import TRACER
+        from ..utils.xla_telemetry import shape_scope
+
+        if not items:
+            return []
+        options = options or OptimizationOptions()
+        if options.fast_mode:
+            raise ValueError("megabatch does not support fast_mode")
+        n = len(items)
+        states = [it[0] for it in items]
+        metas = [it[1] for it in items]
+        cluster_ids = [it[2] if len(it) > 2 else None for it in items]
+        shape0 = jax.tree.map(lambda x: x.shape, states[0])
+        for st in states[1:]:
+            if jax.tree.map(lambda x: x.shape, st) != shape0:
+                raise ValueError("megabatch models must share one padded "
+                                 "bucket shape")
+        num_topics = metas[0].num_topics
+        if any(m.num_topics != num_topics for m in metas):
+            raise ValueError("megabatch models must share num_topics")
+        chain0 = self.megabatch_chain(metas[0], goals)
+        for m in metas[1:]:
+            if self.megabatch_chain(m, goals) != chain0:
+                raise ValueError("megabatch models must share one resolved "
+                                 "goal chain")
+        goal_chain = list(chain0)
+
+        masks_list = [self._masks(st, m, options)
+                      for st, m in zip(states, metas)]
+
+        c = max(n, int(width) or n)
+        pad = c - n
+        if pad:
+            inert = inert_state_like(states[0])
+            states = states + [inert] * pad
+            # Pad slots need mask rows too (the stacked mask axis must
+            # match the cluster axis): all-False masks matching the real
+            # clusters' presence pattern — an inert slot excludes
+            # nothing, and it generates no candidates anyway.
+            import jax.numpy as jnp
+            pad_masks = ExclusionMasks(*(
+                None if f is None else jnp.zeros_like(f)
+                for f in (masks_list[0].excluded_topics,
+                          masks_list[0].excluded_replica_move_brokers,
+                          masks_list[0].excluded_leadership_brokers)))
+            masks_list = masks_list + [pad_masks] * pad
+        batched_masks = self._stack_masks(masks_list)
+        cluster_mask = np.concatenate([np.ones(n, dtype=bool),
+                                       np.zeros(pad, dtype=bool)])
+
+        state0 = items[0][0]
+        search_cfg = self.search_config(state0)
+        megastep = dataclasses.replace(
+            self._megastep_config(state0.num_brokers), deficit_moves_cap=0)
+        dispatch_rounds = max(1, self._dispatch_rounds)
+        ctl_pair = self._controller_pair(state0, batch=c)
+        wide_cfg = self._wide_config(search_cfg, goal_chain,
+                                     state0.num_brokers)
+
+        physical = DispatchStats()
+        per_cluster_stats = [DispatchStats() for _ in range(c)]
+        self._dispatch_stats = physical
+        t_start = time.time()
+
+        batched = stack_states(states)
+        initial_states = [it[0] for it in items]
+        stats_before = [cluster_stats(st) for st in initial_states]
+
+        results_per_goal: list[list[dict]] = []
+        durations: list[float] = []
+        dead = np.zeros(c, dtype=bool)
+        errors: list[Exception | None] = [None] * c
+        with contextlib.ExitStack() as scopes:
+            flight_passes = []
+            for b in range(c):
+                if not cluster_mask[b]:
+                    flight_passes.append(None)
+                    continue
+                self._pass_seq += 1
+                fp = FLIGHT.pass_scope(
+                    seq=self._pass_seq,
+                    shape=(state0.num_partitions, state0.num_brokers),
+                    cluster=cluster_ids[b])
+                scopes.enter_context(fp)
+                fp.set(path="megabatch", occupancy=n, batch_width=c)
+                flight_passes.append(fp)
+            self._tls.last_pass = (self._pass_seq, physical)
+            with TRACER.span("analyzer.megabatch", occupancy=n,
+                             batch_width=c,
+                             num_partitions=state0.num_partitions,
+                             num_brokers=state0.num_brokers) as sp, \
+                    shape_scope(state0.num_partitions, state0.num_brokers):
+                chain_owns_state = False
+                for i, g in enumerate(goal_chain):
+                    t0 = time.time()
+                    use_wide = wide_cfg is not None and g.prefers_wide_batches
+                    cfg_used = wide_cfg if use_wide else search_cfg
+                    flights = [
+                        flight_passes[b].goal(g.name)
+                        if flight_passes[b] is not None else NO_FLIGHT
+                        for b in range(c)]
+                    batched, infos = optimize_goal_in_chain_megabatch(
+                        batched, goal_chain, i, self._constraint, cfg_used,
+                        num_topics, batched_masks, cluster_mask & ~dead,
+                        dispatch_rounds=dispatch_rounds,
+                        dispatch=ctl_pair[1 if use_wide else 0],
+                        megastep=megastep, stats=per_cluster_stats,
+                        physical_stats=physical, flights=flights,
+                        donate_input=chain_owns_state)
+                    chain_owns_state |= any(
+                        info["rounds"] > 0 for info in infos)
+                    durations.append(time.time() - t0)
+                    results_per_goal.append(infos)
+                    for b, info in enumerate(infos):
+                        if cluster_mask[b] and not dead[b] \
+                                and "error" in info:
+                            # The serial solve would raise HERE and leave
+                            # the cluster at exactly this state; freezing
+                            # it for the rest of the chain preserves that.
+                            errors[b] = self._megabatch_error(info)
+                            dead[b] = True
+                sp.set(dispatches=physical.dispatch_count,
+                       errors=int(dead[cluster_mask].sum()))
+
+        out: list = []
+        for b in range(n):
+            cid = cluster_ids[b]
+            if errors[b] is not None:
+                out.append(errors[b])
+                continue
+            final = unstack_state(batched, b)
+            goal_results = [GoalResult(
+                name=g.name, is_hard=g.is_hard,
+                succeeded=results_per_goal[i][b]["succeeded"],
+                rounds=results_per_goal[i][b]["rounds"],
+                moves_applied=results_per_goal[i][b]["moves_applied"],
+                residual_violation=results_per_goal[i][b][
+                    "residual_violation"],
+                duration_s=durations[i],
+                violated_before=results_per_goal[i][b]["violated_on_entry"]
+                or not results_per_goal[i][b]["succeeded"],
+                swaps_applied=results_per_goal[i][b]["swaps_applied"])
+                for i, g in enumerate(goal_chain)
+                if i < len(results_per_goal)]
+            violated_before = [r.name for r in goal_results
+                               if r.violated_before]
+            violated_after = [r.name for r in goal_results
+                              if not r.succeeded]
+            with cluster_label(cid) if cid is not None \
+                    else contextlib.nullcontext():
+                proposals = diff_proposals(initial_states[b], final,
+                                           metas[b])
+                result = OptimizerResult(
+                    proposals=proposals, goal_results=goal_results,
+                    stats_before=stats_before[b],
+                    stats_after=cluster_stats(final),
+                    violated_goals_before=violated_before,
+                    violated_goals_after=violated_after,
+                    balancedness_before=balancedness_score(
+                        goal_chain, set(violated_before),
+                        self._priority_weight, self._strictness_weight),
+                    balancedness_after=balancedness_score(
+                        goal_chain, set(violated_after),
+                        self._priority_weight, self._strictness_weight),
+                    duration_s=time.time() - t_start)
+                SENSORS.record_timer("analyzer_proposal_computation",
+                                     time.time() - t_start)
+                SENSORS.gauge("analyzer_num_proposals", len(proposals))
+                SENSORS.gauge("analyzer_violated_goals_after",
+                              len(violated_after))
+            out.append((final, result))
+        self._megabatch_cluster_stats = {
+            cluster_ids[b] or b: per_cluster_stats[b].as_dict()
+            for b in range(n)}
+        SENSORS.observe("solver_megabatch_occupancy", float(n),
+                        buckets=(1, 2, 4, 8, 16, 32, 64))
+        SENSORS.gauge("solver_megabatch_width", float(c))
+        return out
+
+    def last_megabatch_cluster_stats(self) -> dict:
+        """Per-cluster dispatch accounting of the LAST megabatch pass,
+        split out of the batched readback (cluster id -> DispatchStats
+        dict). The fleet runner reads it to report
+        fleet_precompute_dispatches{cluster=} exactly."""
+        return dict(getattr(self, "_megabatch_cluster_stats", {}))
+
+    @staticmethod
+    def _stack_masks(masks_list: list[ExclusionMasks]) -> ExclusionMasks:
+        """Stack per-cluster exclusion masks along the cluster axis.
+        Presence must be uniform: a field is None for every cluster or an
+        array for every cluster (the batched kernels compile one mask
+        layout per program)."""
+        import jax.numpy as jnp
+
+        def stack_field(name: str):
+            vals = [getattr(m, name) for m in masks_list]
+            present = [v is not None for v in vals]
+            if not any(present):
+                return None
+            if not all(present):
+                raise ValueError(
+                    f"megabatch exclusion-mask presence for {name} must "
+                    "be uniform across the batch")
+            return jnp.stack(vals)
+
+        return ExclusionMasks(
+            excluded_topics=stack_field("excluded_topics"),
+            excluded_replica_move_brokers=stack_field(
+                "excluded_replica_move_brokers"),
+            excluded_leadership_brokers=stack_field(
+                "excluded_leadership_brokers"))
+
+    @staticmethod
+    def _megabatch_error(info: dict) -> Exception:
+        from .chain import StatsRegressionError
+        from .search import OptimizationFailureError
+        cls = {"StatsRegressionError": StatsRegressionError,
+               "OptimizationFailureError": OptimizationFailureError}.get(
+            info.get("error_type"), RuntimeError)
+        return cls(info.get("error", "megabatch cluster solve failed"))

@@ -397,7 +397,26 @@ def _definition() -> ConfigDef:
              Range.at_least(1), I.LOW,
              "Fleet federation: any queued solver job older than this "
              "runs next regardless of priority class, so one cluster's "
-             "flood can delay but never starve another cluster's work.")
+             "flood can delay but never starve another cluster's work. "
+             "With megabatch coalescing the bound applies to BATCHES: "
+             "the overdue job is picked first and its compatible queued "
+             "peers ride along in its batch.")
+    d.define("fleet.megabatch.enabled", T.BOOLEAN, True, None, I.MEDIUM,
+             "Megabatch fleet solver (round 14): the scheduler drains "
+             "compatible queued precomputes (same bucket shape + goal "
+             "chain) into ONE batched device program — same-bucket "
+             "clusters stacked along a cluster axis and solved through "
+             "the donated megastep kernels, byte-identical per cluster "
+             "to serial solves. Solver throughput then scales with the "
+             "batch, not threads. Disabled, every job runs solo (the "
+             "round-6 behavior).")
+    d.define("fleet.megabatch.width", T.INT, 4, Range.at_least(1), I.LOW,
+             "Cluster-axis width of a megabatch program. FIXED per "
+             "bucket shape: partially-filled batches pad with inert "
+             "zero-weight cluster slots, so one compiled program per "
+             "bucket shape serves any occupancy (occupancy is traced, "
+             "never a new compile). More queued compatibles than the "
+             "width split into multiple batches.")
     d.define("tracing.enabled", T.BOOLEAN, True, None, I.LOW,
              "Pipeline span tracing (utils.tracing): every operation — "
              "sampling, model build, per-goal solve, execution — records "

@@ -790,6 +790,30 @@ class CruiseControl:
                                optimizer_result=result,
                                proposals=result.proposals)
 
+    # -- megabatch precompute seams (fleet.megabatch) ----------------------
+    def precompute_inputs(self):
+        """(chain, state, meta, options, generation) for a DEFAULT-chain
+        cached-proposal computation — the megabatch runner's model-build
+        seam. Mirrors ``proposals()``'s compute preamble exactly (same
+        chain resolution, model requirements, and options generator), so
+        a batched precompute stores a cache entry indistinguishable from
+        a solo one. The generation is read BEFORE the build, like the
+        serial path, so a mid-build metadata bump invalidates the entry
+        rather than mislabeling it."""
+        gen = self._load_monitor.model_generation
+        chain, state, meta = self._chain_and_model(None, False, None, True)
+        options = self._options_generator.for_cached_proposal_calculation(
+            meta.topic_names, ())
+        return chain, state, meta, options, gen
+
+    def store_precomputed(self, generation: int, result) -> None:
+        """Write an externally computed default-chain OptimizerResult
+        into the proposal cache (the megabatch runner's write-back seam —
+        the batched twin of the cache store at the end of
+        ``proposals()``)."""
+        with self._proposal_lock:
+            self._proposal_cache = (generation, time.time(), result)
+
     # -- removal/demotion history (Executor.java retention parity) ---------
     def _history_now_ms(self) -> int:
         return self._now_ms() if self._now_ms is not None \

@@ -15,10 +15,16 @@ compiled kernels instead of one process per cluster.
   executor context while sharing the process-wide solver.
 - ``scheduler``: a fair solver-work scheduler multiplexing per-cluster
   precompute, self-healing, and on-demand requests onto the single
-  device/mesh with priorities and a starvation bound.
+  device/mesh with priorities and a starvation bound — plus a megabatch
+  coalescing mode that drains compatible queued jobs into one batch.
+- ``megabatch``: the megabatch fleet solver (round 14) — same-bucket
+  clusters stacked along a cluster axis and solved in ONE donated
+  megastep dispatch, one compiled program per bucket shape at any
+  occupancy.
 """
 
 from .bucketing import BucketGrid, pad_to_bucket, unpad_state
+from .megabatch import MegabatchRunner, PrecomputePayload
 from .registry import (
     ClusterPausedError, FleetEntry, FleetRegistry, UnknownClusterError,
 )
@@ -29,4 +35,5 @@ __all__ = [
     "FleetRegistry", "FleetEntry", "UnknownClusterError",
     "ClusterPausedError",
     "FleetScheduler", "JobKind",
+    "MegabatchRunner", "PrecomputePayload",
 ]

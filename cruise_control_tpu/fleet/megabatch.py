@@ -1,0 +1,191 @@
+"""Megabatch fleet solver: one donated device program, whole buckets of
+clusters.
+
+PR 1's fleet layer multiplexes clusters through a fair scheduler — one
+cluster per device program, throughput scaling with threads. This module
+is ROADMAP item 3's fix: same-bucket clusters stack along a leading
+cluster axis and solve in ONE donated megastep dispatch
+(analyzer.chain's ``megabatch_*`` kernels, the Podracer/Anakin
+keep-everything-on-device discipline applied fleet-wide). Compile once
+per bucket shape, amortize across the fleet; a batched pass costs
+max-over-clusters rounds instead of the serial sum.
+
+The pieces:
+
+- ``precompute_batch_key``: the pacer-side coalescing HINT — last-seen
+  bucket shape plus a solver-config fingerprint. Exact compatibility is
+  re-verified after the models are built (shapes can drift between the
+  hint and the build); incompatible stragglers fall back to their own
+  batched solve at occupancy 1.
+- ``PrecomputePayload``: what a batchable precompute job carries — the
+  cluster's facade, whose ``precompute_inputs``/``store_precomputed``
+  seams bracket the batched solve exactly like a solo ``proposals()``
+  call.
+- ``MegabatchRunner``: the scheduler's batch runner. Builds every
+  coalesced job's model on the worker thread, groups by ACTUAL
+  compatibility — (padded bucket shape incl. the replica-slot axis,
+  ``num_topics``, the resolved goal chain, options) — pads each group to
+  the configured batch width with inert zero-weight cluster slots (one
+  compiled program per bucket shape serves any occupancy), solves via
+  ``GoalOptimizer.optimizations_megabatch``, writes each cluster's
+  OptimizerResult back into its proposal cache, and splits per-cluster
+  dispatch accounting out of the batched readback
+  (``fleet_precompute_dispatches{cluster=}``).
+
+Failure containment mirrors the serial scheduler: a cluster whose model
+build or solve fails gets exactly its own future failed (and its breaker
+debited by the scheduler); batchmates proceed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Any
+
+LOG = logging.getLogger(__name__)
+
+
+def solver_config_fingerprint(config) -> tuple:
+    """The solver-relevant config identity two clusters must share to sit
+    in one batch HINT. The shared GoalOptimizer derives the search grid
+    from its own base config, so only the goal-chain spec (which per-
+    cluster overlays CAN change) needs fingerprinting here; exact chain
+    equality — broker-set bindings included — is re-checked per batch by
+    ``GoalOptimizer.optimizations_megabatch``."""
+    return tuple(str(g) for g in config.get_list("goals"))
+
+
+def precompute_batch_key(entry) -> tuple | None:
+    """Coalescing hint for one cluster's paced precompute, or None when
+    the cluster has no recorded bucket yet (its first model build will
+    run solo and record one)."""
+    if entry.bucket is None:
+        return None
+    return ("precompute", entry.bucket,
+            solver_config_fingerprint(entry.config))
+
+
+@dataclasses.dataclass
+class PrecomputePayload:
+    """Batchable precompute job payload (SolverJob.payload)."""
+
+    cluster_id: str
+    cc: Any  # CruiseControl
+
+
+class MegabatchRunner:
+    """Executes a coalesced batch of fleet jobs as megabatched solves.
+
+    Attached to the FleetScheduler via ``set_batch_runner``; the
+    scheduler guarantees every job's future is resolved even if this
+    runner raises. Occupancy statistics feed ``GET /fleet`` and the
+    ``solver_megabatch_*`` sensors."""
+
+    def __init__(self, optimizer, width: int = 4):
+        self._optimizer = optimizer
+        self._width = max(1, int(width))
+        self._lock = threading.Lock()
+        self.batches_solved = 0
+        self.clusters_solved = 0
+        self.build_failures = 0
+        self.last_occupancy = 0
+        self._occupancy_sum = 0
+
+    @property
+    def width(self) -> int:
+        return self._width
+
+    def stats(self) -> dict:
+        """The /fleet dashboard's megabatch section."""
+        with self._lock:
+            batches = self.batches_solved
+            return {
+                "width": self._width,
+                "batchesSolved": batches,
+                "clustersSolved": self.clusters_solved,
+                "buildFailures": self.build_failures,
+                "lastOccupancy": self.last_occupancy,
+                "avgOccupancy": round(self._occupancy_sum / batches, 3)
+                if batches else 0.0,
+            }
+
+    # -- the batch body ----------------------------------------------------
+    def __call__(self, jobs: list) -> None:
+        from ..utils.sensors import SENSORS
+        prepared: list[tuple] = []
+        for job in jobs:
+            payload = job.payload
+            try:
+                chain, state, meta, options, gen = \
+                    payload.cc.precompute_inputs()
+            except Exception as e:  # noqa: BLE001 — fail THIS job only
+                with self._lock:
+                    self.build_failures += 1
+                job.future.set_exception(e)
+                continue
+            resolved = tuple(self._optimizer.megabatch_chain(meta, chain))
+            key = (state.num_partitions, state.num_brokers,
+                   state.max_replication_factor, meta.num_topics,
+                   resolved, options)
+            prepared.append((job, payload, resolved, state, meta, options,
+                            gen, key))
+
+        groups: dict = {}
+        for item in prepared:
+            groups.setdefault(item[-1], []).append(item)
+        for key, members in groups.items():
+            for start in range(0, len(members), self._width):
+                self._solve_chunk(members[start:start + self._width])
+        SENSORS.gauge("fleet_megabatch_width", self._width)
+
+    def _solve_chunk(self, members: list[tuple]) -> None:
+        from ..facade import OperationResult
+        from ..utils.sensors import SENSORS
+        items = [(state, meta, payload.cluster_id)
+                 for (_j, payload, _c, state, meta, _o, _g, _k) in members]
+        chain = members[0][2]
+        options = members[0][5]
+        try:
+            results = self._optimizer.optimizations_megabatch(
+                items, goals=list(chain), options=options,
+                width=self._width)
+        except Exception as e:  # noqa: BLE001 — a batch-level failure
+            # fails exactly the chunk's futures; other chunks proceed
+            LOG.warning("fleet: megabatch solve of %d clusters failed: %s",
+                        len(members), e)
+            for (job, *_rest) in members:
+                job.future.set_exception(e)
+            return
+        split = self._optimizer.last_megabatch_cluster_stats()
+        occupancy = len(members)
+        with self._lock:
+            self.batches_solved += 1
+            self.clusters_solved += occupancy
+            self.last_occupancy = occupancy
+            self._occupancy_sum += occupancy
+        SENSORS.count("fleet_megabatch_batches")
+        SENSORS.count("fleet_megabatch_clusters", occupancy)
+        for (job, payload, _c, _s, _m, _o, gen, _k), res in \
+                zip(members, results):
+            if isinstance(res, Exception):
+                job.future.set_exception(res)
+                continue
+            _final, result = res
+            payload.cc.store_precomputed(gen, result)
+            # Per-cluster dispatch accounting, split out of the batched
+            # readback — the megabatch analogue of the pacer's
+            # thread-local attribution (the batched solve ran on THIS
+            # worker thread, so the split is exactly this batch's).
+            ds = split.get(payload.cluster_id) or {}
+            if ds.get("dispatch_count"):
+                SENSORS.gauge("fleet_precompute_dispatches",
+                              ds["dispatch_count"],
+                              labels={"cluster": payload.cluster_id})
+                SENSORS.gauge("fleet_precompute_rounds_per_dispatch_p50",
+                              ds["rounds_per_dispatch_p50"],
+                              labels={"cluster": payload.cluster_id})
+            job.future.set_result(OperationResult(
+                "proposals", dryrun=True, optimizer_result=result,
+                proposals=result.proposals, reason="megabatch precompute"))

@@ -78,12 +78,23 @@ class FleetRegistry:
         self._optimizer = optimizer or GoalOptimizer(self._base)
         self._grid = grid or BucketGrid.from_config(self._base)
         self._scheduler = scheduler
+        self._megabatch = None
         if scheduler is not None:
             scheduler.bind(self)
             # Embedder handed a bare scheduler: attach the per-cluster
             # breaker from the base config (no-op when one was injected,
             # so injected-clock test breakers stay untouched).
             scheduler.ensure_breaker(self._base)
+            # Megabatch coalescing (round 14): same-bucket precomputes
+            # drain into one batched device program. An embedder that
+            # attached its own batch runner keeps it.
+            if self._base.get_boolean("fleet.megabatch.enabled") \
+                    and not scheduler.coalescing:
+                from .megabatch import MegabatchRunner
+                self._megabatch = MegabatchRunner(
+                    self._optimizer,
+                    width=self._base.get_int("fleet.megabatch.width"))
+                scheduler.set_batch_runner(self._megabatch)
         self._factory = factory or _default_factory
         self._entries: dict[str, FleetEntry] = {}
         self._lock = threading.Lock()
@@ -99,6 +110,11 @@ class FleetRegistry:
     @property
     def scheduler(self) -> FleetScheduler | None:
         return self._scheduler
+
+    @property
+    def megabatch(self):
+        """The megabatch coalescing runner (None = disabled)."""
+        return self._megabatch
 
     # -- lifecycle ---------------------------------------------------------
     def register(self, cluster_id: str, admin=None,
@@ -341,6 +357,8 @@ class FleetRegistry:
                 "pendingJobs": self._scheduler.pending(),
                 "jobsRun": self._scheduler.jobs_run,
             }
+        if self._megabatch is not None:
+            body["megabatch"] = self._megabatch.stats()
         return body
 
     def shutdown(self) -> None:

@@ -47,6 +47,13 @@ class SolverJob:
     future: Future
     enqueued_at: float
     seq: int
+    # Coalescing hint (megabatch mode): queued jobs sharing a non-None
+    # batch_key are drained together when one of them is picked and
+    # solved as ONE batched device program. ``payload`` carries what the
+    # batch runner needs (fleet.megabatch.PrecomputePayload); ``fn``
+    # stays the solo fallback for inline/shutdown execution.
+    batch_key: tuple | None = None
+    payload: Any = None
 
 
 class FleetScheduler:
@@ -91,16 +98,38 @@ class FleetScheduler:
         self._pacer: threading.Thread | None = None
         self._registry = None
         self._jobs_run = 0
-        # (cluster_id, kind) of the job currently executing, so the pacer
-        # can see in-flight work pending() no longer counts.
-        self._active: tuple[str, JobKind] | None = None
+        # Megabatch coalescing (round 14): when a batch runner is
+        # attached, a picked job with a batch_key drains every queued
+        # job sharing that key and the whole set solves as ONE batched
+        # device program. Fairness and the starvation bound apply to
+        # BATCHES: the pick that seeds a batch is chosen by the normal
+        # priority/fairness/starvation rules, and every coalesced
+        # cluster counts as served by that pick.
+        self._batch_runner: Callable[[list[SolverJob]], None] | None = None
+        # (cluster_id, kind) keys currently executing — a SET because a
+        # coalesced megabatch executes many clusters' jobs at once and
+        # the pacer must see every one of them as in-flight.
+        self._active: set[tuple[str, JobKind]] = set()
+
+    def set_batch_runner(self, runner: "Callable | None") -> None:
+        """Attach the megabatch coalescing runner (fleet.megabatch).
+        ``runner(jobs)`` receives the drained batch and must resolve
+        every job's future; None disables coalescing."""
+        with self._cond:
+            self._batch_runner = runner
+
+    @property
+    def coalescing(self) -> bool:
+        return self._batch_runner is not None
 
     # -- submission --------------------------------------------------------
     def submit(self, cluster_id: str, kind: JobKind,
-               fn: Callable[[], Any]) -> Future:
+               fn: Callable[[], Any], batch_key: tuple | None = None,
+               payload: Any = None) -> Future:
         job = SolverJob(kind=kind, cluster_id=cluster_id, fn=fn,
                         future=Future(), enqueued_at=self._clock(),
-                        seq=self._next_seq())
+                        seq=self._next_seq(), batch_key=batch_key,
+                        payload=payload)
         with self._cond:
             if self._shut:
                 # After shutdown nothing drains the queue; a queued job's
@@ -174,8 +203,30 @@ class FleetScheduler:
         # Marked active HERE, under the same lock as the dequeue: a
         # pacer sweep must never observe the job as neither queued nor
         # active (the window between dequeue and execution).
-        self._active = (job.cluster_id, job.kind)
+        self._active.add((job.cluster_id, job.kind))
         return job
+
+    def _take_locked(self) -> list[SolverJob] | None:
+        """Pick the next job, then — in coalescing mode — drain every
+        queued job sharing its batch_key into one megabatch. The PICK is
+        fairness's unit (priority, round-robin, starvation bound all
+        choose the seed job); the drained peers ride along and every
+        coalesced cluster counts as served by this pick, so the
+        round-robin cannot re-serve a freshly batched cluster ahead of
+        one still waiting. Caller holds the condition lock."""
+        job = self._pick_locked()
+        if job is None:
+            return None
+        batch = [job]
+        if self._batch_runner is not None and job.batch_key is not None:
+            peers = [j for j in self._queue
+                     if j.batch_key == job.batch_key]
+            for p in peers:
+                self._queue.remove(p)
+                self._last_served[p.cluster_id] = self._picks
+                self._active.add((p.cluster_id, p.kind))
+            batch += peers
+        return batch
 
     def _run(self, job: SolverJob) -> None:
         from ..utils.sensors import SENSORS, cluster_label
@@ -211,12 +262,66 @@ class FleetScheduler:
             job.future.set_result(result)
         finally:
             with self._cond:
-                self._active = None
+                self._active.discard((job.cluster_id, job.kind))
         self._jobs_run += 1
         SENSORS.record_timer("fleet_scheduler_job",
                              time.monotonic() - t0,
                              labels={"cluster": job.cluster_id,
                                      "kind": job.kind.name})
+
+    def _run_batch(self, jobs: list[SolverJob]) -> None:
+        """Execute a coalesced megabatch through the batch runner. The
+        runner must resolve every job's future (result or exception);
+        anything it leaves unresolved — or a batch-level crash — fails
+        the affected futures here so no caller ever blocks forever.
+        Per-cluster breaker accounting mirrors ``_run``'s."""
+        from ..utils.sensors import SENSORS
+        from ..utils.tracing import TRACER
+        t0 = time.monotonic()
+        for job in jobs:
+            wait_s = max(self._clock() - job.enqueued_at, 0.0)
+            SENSORS.record_timer("fleet_scheduler_queue_wait", wait_s,
+                                 labels={"cluster": job.cluster_id,
+                                         "kind": job.kind.name})
+            SENSORS.observe("fleet_queue_wait_seconds", wait_s,
+                            labels={"cluster": job.cluster_id,
+                                    "kind": job.kind.name})
+        try:
+            # No ambient cluster label: the batch belongs to the FLEET
+            # (per-cluster attribution happens inside the runner with
+            # explicit labels; an ambient lead-cluster label would
+            # mislabel the batch-level occupancy sensors).
+            with TRACER.span("fleet.megabatch",
+                             operation="fleet.megabatch",
+                             clusters=",".join(j.cluster_id
+                                               for j in jobs),
+                             occupancy=len(jobs)):
+                self._batch_runner(jobs)
+        except BaseException as e:  # noqa: BLE001 — carried by futures
+            for job in jobs:
+                if not job.future.done():
+                    job.future.set_exception(e)
+        finally:
+            for job in jobs:
+                if not job.future.done():
+                    job.future.set_exception(RuntimeError(
+                        "megabatch runner left the job unresolved"))
+            with self._cond:
+                for job in jobs:
+                    self._active.discard((job.cluster_id, job.kind))
+            self._jobs_run += len(jobs)
+        if self._breaker is not None:
+            for job in jobs:
+                if job.future.cancelled() or \
+                        job.future.exception() is not None:
+                    self._breaker.record_failure(job.cluster_id)
+                else:
+                    self._breaker.record_success(job.cluster_id)
+        SENSORS.count("fleet_jobs_coalesced", len(jobs))
+        SENSORS.record_timer("fleet_scheduler_job",
+                             time.monotonic() - t0,
+                             labels={"cluster": jobs[0].cluster_id,
+                                     "kind": jobs[0].kind.name})
 
     def run_pending(self, max_jobs: int | None = None) -> int:
         """Synchronously drain queued jobs on the calling thread (the
@@ -225,11 +330,15 @@ class FleetScheduler:
         ran = 0
         while max_jobs is None or ran < max_jobs:
             with self._cond:
-                job = self._pick_locked()
-            if job is None:
+                batch = self._take_locked()
+            if batch is None:
                 break
-            self._run(job)
-            ran += 1
+            if self._batch_runner is not None \
+                    and batch[0].batch_key is not None:
+                self._run_batch(batch)
+            else:
+                self._run(batch[0])
+            ran += len(batch)
         return ran
 
     # -- worker + precompute pacer ----------------------------------------
@@ -263,11 +372,15 @@ class FleetScheduler:
     def _worker_loop(self) -> None:
         while not self._stop.is_set():
             with self._cond:
-                job = self._pick_locked()
-                if job is None:
+                batch = self._take_locked()
+                if batch is None:
                     self._cond.wait(timeout=0.2)
                     continue
-            self._run(job)
+            if self._batch_runner is not None \
+                    and batch[0].batch_key is not None:
+                self._run_batch(batch)
+            else:
+                self._run(batch[0])
 
     def _pacer_loop(self, interval_s: float) -> None:
         while not self._stop.wait(interval_s):
@@ -298,7 +411,7 @@ class FleetScheduler:
                 # device for any cluster whose precompute outlasts its
                 # cadence.
                 key = (entry.cluster_id, JobKind.EXPIRING_CACHE)
-                busy = self._active == key or any(
+                busy = key in self._active or any(
                     (j.cluster_id, j.kind) == key for j in self._queue)
             if busy:
                 continue
@@ -350,7 +463,21 @@ class FleetScheduler:
                                   labels={"cluster": cid})
                 return result
 
-            fut = self.submit(cid, JobKind.EXPIRING_CACHE, precompute)
+            # Whole-bucket batch fills (ROADMAP item 3): in coalescing
+            # mode every due cluster's precompute carries its bucket's
+            # batch key, so a sweep that finds the whole bucket due
+            # emits ONE megabatch fill instead of per-cluster solves
+            # (the runner reports fleet_precompute_dispatches{cluster=}
+            # from the split readback). A cluster with no recorded
+            # bucket yet (first build pending) submits solo.
+            batch_key = payload = None
+            if self._batch_runner is not None:
+                from .megabatch import PrecomputePayload, precompute_batch_key
+                batch_key = precompute_batch_key(entry)
+                if batch_key is not None:
+                    payload = PrecomputePayload(cluster_id=cid, cc=cc)
+            fut = self.submit(cid, JobKind.EXPIRING_CACHE, precompute,
+                              batch_key=batch_key, payload=payload)
 
             def report(f, cid=cid):
                 # The pacer owns this future — surface failures, else a

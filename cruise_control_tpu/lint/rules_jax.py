@@ -82,14 +82,18 @@ class HostSyncInPumpRule(Rule):
     title = "host-sync leak in the megastep pump / donated drivers"
 
     #: Files containing the pump machinery. The rule is repo-specific by
-    #: design — these are the two modules that own the one-behind
-    #: dispatch pipeline.
+    #: design — these are the modules that own the one-behind dispatch
+    #: pipelines (single-cluster, sharded, and the fleet megabatch).
     PUMP_FILES = ("cruise_control_tpu/analyzer/chain.py",
-                  "cruise_control_tpu/parallel/chain_sharded.py")
-    #: Region functions: the pump itself, its per-dispatch ``enqueue``
-    #: closures, and the async-readback decode helpers. Donated-jit
-    #: kernels are detected structurally on top of this set.
-    REGION_FUNCS = ("run_bounded_pass", "enqueue", "_chain_infos_from_stats")
+                  "cruise_control_tpu/parallel/chain_sharded.py",
+                  "cruise_control_tpu/fleet/megabatch.py")
+    #: Region functions: the pumps themselves, their per-dispatch
+    #: ``enqueue`` closures (the megabatch's batched enqueues share the
+    #: name, so they are covered structurally), and the async-readback
+    #: decode helpers. Donated-jit kernels are detected structurally on
+    #: top of this set.
+    REGION_FUNCS = ("run_bounded_pass", "run_megabatch_pass", "enqueue",
+                    "_chain_infos_from_stats")
 
     SYNC_BUILTINS = ("float", "int", "bool")
     SYNC_METHODS = ("item", "tolist")
@@ -194,10 +198,16 @@ class DonationSetRule(Rule):
                              defs_by_name: dict[str, list[ast.FunctionDef]],
                              ) -> tuple[list[str] | None, str]:
         """Positional params of the function a jit call wraps. Unwraps
-        one transform layer (``jax.jit(shard_map(body, ...), ...)``)."""
+        transform layers (``jax.jit(shard_map(body, ...), ...)``,
+        ``jax.jit(jax.vmap(body), ...)``, and stacks thereof — the
+        megabatch kernels resolve their donation set THROUGH vmap, which
+        maps each donated argument to the same-position parameter of the
+        batched body)."""
         target = call.args[0] if call.args else None
-        if isinstance(target, ast.Call) and target.args:
-            target = target.args[0]   # shard_map(body, mesh=...) -> body
+        seen = 0
+        while isinstance(target, ast.Call) and target.args and seen < 8:
+            target = target.args[0]   # vmap(body)/shard_map(body) -> body
+            seen += 1
         if isinstance(target, ast.Name):
             cands = defs_by_name.get(target.id, [])
             if len(cands) == 1:

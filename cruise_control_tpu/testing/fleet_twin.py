@@ -1,0 +1,154 @@
+"""Fleet-megabatch twin scenario: two drifting clusters, one bucket, one
+batched solver.
+
+Round 12's digital twin drives ONE cluster through the real
+monitor→analyzer→executor loop; this module runs TWO of them in lockstep
+on one shared ``SimClock``, registered in a real ``FleetRegistry`` whose
+coalescing ``FleetScheduler`` drains both clusters' paced precomputes
+into ONE megabatched device program per sweep (fleet.megabatch, round
+14). Each twin takes a broker loss at a different tick and must
+self-heal through the real detector/executor machinery WHILE the fleet
+keeps both proposal caches warm through batched solves — the CI scenario
+matrix's proof that megabatching and self-healing compose.
+
+Determinism: both simulators run off the shared injected clock, the
+scheduler runs off the same clock, and solves are seeded — one seed
+yields byte-identical event streams, final assignments, and score JSON
+for both twins (same contract as ClusterSimulator)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+import zlib
+
+from .simulator import (
+    ClusterSimulator, DriftSpec, ScenarioEvent, ScenarioSpec, SimClock,
+)
+
+LOG = logging.getLogger(__name__)
+
+#: The twin spec: same geometry for both clusters (SHARED bucket — the
+#: whole point), diurnal drift, one broker loss each at distinct ticks.
+#: The fleet grid keys pin the padded bucket to the simulator's own
+#: (128-partition, 8-broker) shape so the chain compiles once.
+FLEET_MEGABATCH_SPEC = ScenarioSpec(
+    name="fleet_megabatch",
+    description="Two drifting clusters sharing one bucket, precomputes "
+                "megabatched through one device program; each twin "
+                "loses a broker and must self-heal through the real "
+                "loop while batched solves keep both caches warm.",
+    ticks=60,
+    drift=DriftSpec(amplitude=0.4, period_ticks=60),
+    config_overrides={
+        "fleet.bucket.broker.base": 8,
+        "fleet.bucket.partition.base": 128,
+        "fleet.bucket.topic.base": 8,
+        "fleet.megabatch.enabled": True,
+        "fleet.megabatch.width": 4,
+        "fleet.precompute.cadence.ms": 60_000,
+    })
+
+#: Per-twin broker-loss ticks (off the detection cadence, as in
+#: broker_loss_drift, so detection latency is part of time-to-heal).
+TWIN_EVENTS = {
+    "twin-a": (ScenarioEvent(17, "kill_broker", {"broker": 5}),),
+    "twin-b": (ScenarioEvent(29, "kill_broker", {"broker": 4}),),
+}
+
+
+def run_fleet_megabatch(seed: int = 0, ticks: int | None = None) -> dict:
+    """Run the twin scenario; returns the flattened record the CI
+    scenario matrix and tests read (per-twin scores, merged SLO list,
+    megabatch occupancy proof, crc digest over both final assignments)."""
+    from ..fleet import FleetRegistry, FleetScheduler
+
+    spec = FLEET_MEGABATCH_SPEC
+    if ticks is not None:
+        spec = dataclasses.replace(spec, ticks=int(ticks))
+    # ccsa: ok[CCSA004] observability-only wall measurement (the record's
+    # value column); never enters the event stream or score JSON
+    t0 = time.perf_counter()
+    clock = SimClock()
+    sims: dict[str, ClusterSimulator] = {}
+    first = None
+    for cid, events in TWIN_EVENTS.items():
+        twin_spec = dataclasses.replace(spec, events=events)
+        sims[cid] = ClusterSimulator(
+            twin_spec, seed=seed, clock=clock,
+            optimizer=None if first is None else first.cc.optimizer)
+        if first is None:
+            first = sims[cid]
+
+    scheduler = FleetScheduler(starvation_bound_s=3600.0, clock=clock)
+    registry = FleetRegistry(base_config=first.config,
+                             optimizer=first.cc.optimizer,
+                             scheduler=scheduler)
+    assert registry.megabatch is not None, "twin requires megabatch mode"
+    for cid, sim in sims.items():
+        registry.register(cid, cc=sim.cc)
+    try:
+        cids = list(sims)
+        for tick in range(spec.ticks):
+            for i, cid in enumerate(cids):
+                sims[cid].run_tick(tick, advance=(i == 0))
+            # The fleet side of the tick: pace every due cluster (both
+            # share one cadence, so a due sweep is a whole-bucket fill)
+            # and drain the queue — coalesced solves run here.
+            scheduler.pace_once()
+            scheduler.run_pending()
+        mb = registry.megabatch.stats()
+        scores = {cid: sims[cid].score for cid in cids}
+        finals = {cid: {f"{t}-{p}": sorted(st.replicas)
+                        for (t, p), st in sorted(
+                            sims[cid].backend.describe_partitions().items())}
+                  for cid in cids}
+    finally:
+        # Deregister WITHOUT shutting the embedder-owned facades down
+        # (registry.owns_cc=False for cc= registrations), then stop the
+        # (threadless) scheduler.
+        registry.shutdown()
+        scheduler.shutdown()
+
+    digest = zlib.crc32(json.dumps(finals, sort_keys=True).encode())
+    slo = [f"{cid}: {v}" for cid in sims
+           for v in scores[cid].slo_violations()]
+    if not mb["batchesSolved"] or mb["lastOccupancy"] < 2:
+        # The scenario exists to prove batched solves actually happened:
+        # a run that silently fell back to solo precomputes must fail
+        # the matrix, not pass vacuously.
+        slo.append(f"no_megabatch_solves (batches={mb['batchesSolved']}, "
+                   f"last_occupancy={mb['lastOccupancy']})")
+    heal_p95 = [s.time_to_heal_p95_ticks() for s in scores.values()]
+    heal_p95 = [h for h in heal_p95 if h is not None]
+    bal = [s.balancedness[-1] for s in scores.values() if s.balancedness]
+    return {
+        "scenario": "fleet_megabatch",
+        "seed": seed,
+        "ticks": spec.ticks,
+        "sim_hours": round(sum(s.sim_hours for s in scores.values()), 3),
+        "replica_moves": sum(s.replica_moves for s in scores.values()),
+        "leader_moves": sum(s.leader_moves for s in scores.values()),
+        "bytes_mb_per_simhour": round(
+            sum(s.bytes_moved_mb for s in scores.values())
+            / max(sum(s.sim_hours for s in scores.values()), 1e-9), 1),
+        "moves_per_simhour": round(
+            sum(s.moves_per_simhour() for s in scores.values()), 2),
+        "time_to_heal_p95_ticks": max(heal_p95) if heal_p95 else None,
+        "unhealed_faults": sum(s.unhealed() for s in scores.values()),
+        "dead_letters": sum(s.dead_letters for s in scores.values()),
+        "stale_served": sum(s.stale_served for s in scores.values()),
+        "degraded_ticks": sum(s.degraded_ticks for s in scores.values()),
+        "balancedness_final": min(bal) if bal else None,
+        "events_applied": sum(s.events_applied for s in scores.values()),
+        "faults_injected": sum(s.faults_injected for s in scores.values()),
+        "slo_violations": slo,
+        "assignment_digest": f"{digest:08x}",
+        "megabatch_batches": mb["batchesSolved"],
+        "megabatch_clusters_solved": mb["clustersSolved"],
+        "megabatch_last_occupancy": mb["lastOccupancy"],
+        "megabatch_avg_occupancy": mb["avgOccupancy"],
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }

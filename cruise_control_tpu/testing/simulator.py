@@ -427,7 +427,13 @@ class ClusterSimulator:
     in-memory cluster."""
 
     def __init__(self, spec: ScenarioSpec, seed: int = 0,
-                 config_overrides: Mapping | None = None):
+                 config_overrides: Mapping | None = None,
+                 optimizer=None, clock: "SimClock | None" = None):
+        """``optimizer``/``clock`` are the FLEET-TWIN seams (round 14):
+        two simulators sharing one GoalOptimizer and one SimClock model
+        two clusters served by one fleet solver — the megabatch twin
+        scenario drives them in lockstep (the second twin ticks with
+        ``advance=False`` so the shared clock advances once per tick)."""
         from ..common.resources import Resource
         from ..config.cruise_control_config import CruiseControlConfig
         from ..executor.admin import InMemoryAdminBackend, PartitionState
@@ -451,7 +457,7 @@ class ClusterSimulator:
             ticks=int(overrides.get("scenario.default.ticks", spec.ticks)))
         self.spec = spec
         self.seed = seed
-        self.clock = SimClock()
+        self.clock = clock if clock is not None else SimClock()
         tick_ms = int(spec.tick_s * 1000)
         _g = "cruise_control_tpu.analyzer.goals"
         cfg_map = {
@@ -547,6 +553,7 @@ class ClusterSimulator:
         # never rewrite the serving process's tracing settings.
         self.cc = CruiseControl(self.config, admin, load_monitor=monitor,
                                 executor=executor, clock=self.clock,
+                                optimizer=optimizer,
                                 configure_observability=False)
         self._events_by_tick: dict[int, list[ScenarioEvent]] = {}
         self.events = spec.expand_events(seed)
@@ -660,9 +667,10 @@ class ClusterSimulator:
         return False
 
     # -- the loop -----------------------------------------------------------
-    def run_tick(self, tick: int) -> None:
+    def run_tick(self, tick: int, advance: bool = True) -> None:
         mgr = self.cc.anomaly_detector
-        self.clock.advance(self.spec.tick_s)
+        if advance:
+            self.clock.advance(self.spec.tick_s)
         for e in self._events_by_tick.get(tick, ()):
             self._apply_event(e, tick)
         self.backend.tick()

@@ -428,12 +428,18 @@ class FlightRecorder:
 
     # -- recording ---------------------------------------------------------
     def pass_scope(self, seq: int = 0,
-                   shape: tuple[int, int] | None = None):
+                   shape: tuple[int, int] | None = None,
+                   cluster: str | None = None):
         """Open a pass record (context manager). Disabled → shared no-op
-        whose ``goal()`` returns the shared no-op goal hook."""
+        whose ``goal()`` returns the shared no-op goal hook. ``cluster``
+        overrides the ambient cluster label — the megabatch solver opens
+        one pass PER CLUSTER in the batch from a single worker thread, so
+        ``GET /solver`` keeps answering per cluster."""
         if not self._enabled:
             return _NULL_PASS
-        return PassFlight(self, seq, shape, current_cluster_label())
+        return PassFlight(self, seq, shape,
+                          cluster if cluster is not None
+                          else current_cluster_label())
 
     def _on_dispatch(self, goal: GoalFlight, rec: dict) -> None:
         with self._lock:

@@ -19,3 +19,16 @@ def donates_mutable_set(assignment, leader_slot):      # clean
 @partial(jax.jit, donate_argnums=(0,))
 def suppressed_donation(scratch):
     return scratch * 2
+
+
+# Megabatch call form (round 14): the donation set resolves THROUGH the
+# vmap wrapper to the batched body's same-position parameters.
+def batched_body(assignment, leader_slot, rest):
+    return assignment, leader_slot, rest
+
+
+megabatch_bad = jax.jit(jax.vmap(batched_body),
+                        donate_argnums=(0, 1, 2))  # finding: rest
+
+megabatch_ok = jax.jit(jax.vmap(batched_body),
+                       donate_argnums=(0, 1))      # clean

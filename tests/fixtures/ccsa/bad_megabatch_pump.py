@@ -1,0 +1,28 @@
+"""CCSA001 fixture: host syncs inside the MEGABATCH pump region.
+
+Linted by tests/test_ccsa.py under a spoofed
+``cruise_control_tpu/fleet/megabatch.py`` relative path (the rule's pump
+modules grew the fleet megabatch in round 14); the batched enqueue
+closure shares the ``enqueue`` region name, so it is scoped too."""
+
+import numpy as np
+
+
+def run_megabatch_pass(enqueue, st, active, pass_cap):
+    def make_enqueue():
+        def enqueue_inner(st, active, budget):
+            return st, active, budget
+        return enqueue_inner
+
+    st, active, applied, rounds, donated, ring = enqueue(st, active,
+                                                         pass_cap)
+    per_cluster = np.asarray(rounds)            # finding: device transfer
+    occupancy = int(active.sum())               # finding: blocks the pump
+    # ccsa: ok[CCSA001] fixture: documented intentional readback
+    moved = np.asarray(applied)
+    return st, per_cluster, occupancy, moved, donated, ring
+
+
+def enqueue(st, active, budget):
+    batched = float(budget)                     # finding: enqueue region
+    return st, active, batched

@@ -64,17 +64,38 @@ def test_ccsa001_outside_pump_modules_is_silent():
 def test_ccsa002_donation_fixture():
     ctx = ctx_for(FIXTURES / "bad_donation.py")
     active, suppressed = findings_of("CCSA002", ctx)
-    assert len(active) == 1
-    assert "'rest'" in active[0].message or "rest" in active[0].message
+    # decorator-form `rest` + the vmap-call-form `rest` (the megabatch
+    # kernel shape: donation resolved THROUGH jax.vmap to the batched
+    # body's parameters).
+    assert len(active) == 2
+    assert all("rest" in f.message for f in active)
     assert len(suppressed) == 1       # the scratch-buffer donation
 
 
+def test_ccsa001_megabatch_pump_fixture():
+    """Round-14 scoping: the fleet megabatch module is a pump file, its
+    pump + enqueue closures are regions, suppressions still apply."""
+    ctx = ctx_for(FIXTURES / "bad_megabatch_pump.py",
+                  "cruise_control_tpu/fleet/megabatch.py")
+    active, suppressed = findings_of("CCSA001", ctx)
+    # np.asarray(rounds) + int(active.sum()) in the pump, float(budget)
+    # in the module-level enqueue region.
+    assert len(active) == 3
+    assert len(suppressed) == 1
+    # Outside the pump modules the same file is silent.
+    plain = ctx_for(FIXTURES / "bad_megabatch_pump.py")
+    a2, s2 = findings_of("CCSA001", plain)
+    assert not a2 and not s2
+
+
 def test_ccsa002_repo_donation_sites_resolve():
-    """The four real donated kernels (decorator form in analyzer/chain,
-    jit-call form wrapping shard_map bodies in parallel/chain_sharded)
-    must verify CLEAN — donation exactly {assignment, leader_slot}."""
+    """The real donated kernels (decorator form in analyzer/chain —
+    including the round-14 batched megabatch twins — and the jit-call
+    form wrapping shard_map bodies in parallel/chain_sharded) must
+    verify CLEAN — donation exactly {assignment, leader_slot}."""
     for rel in ("cruise_control_tpu/analyzer/chain.py",
-                "cruise_control_tpu/parallel/chain_sharded.py"):
+                "cruise_control_tpu/parallel/chain_sharded.py",
+                "cruise_control_tpu/fleet/megabatch.py"):
         ctx = ctx_for(ROOT / rel, rel)
         active, suppressed = findings_of("CCSA002", ctx)
         assert not active, [f.message for f in active]

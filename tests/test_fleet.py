@@ -499,3 +499,127 @@ def test_metrics_carry_cluster_labels(fleet_api):
     text = api.metrics_text()
     assert 'fleet_test_labeled_counter_total{cluster="alpha"} 1.0' in text
     assert 'fleet_cluster_paused{cluster="beta"}' in text
+
+
+# ---------------------------------------------------------------------------
+# Megabatch coalescing (round 14): whole-bucket fills through one
+# batched device program.
+
+_G = "cruise_control_tpu.analyzer.goals"
+_SHORT_CHAIN = [f"{_G}.RackAwareGoal", f"{_G}.ReplicaCapacityGoal",
+                f"{_G}.ReplicaDistributionGoal"]
+
+
+def _megabatch_fleet(extra=None):
+    base = _base_config(extra={
+        "goals": _SHORT_CHAIN,
+        "hard.goals": [f"{_G}.RackAwareGoal", f"{_G}.ReplicaCapacityGoal"],
+        "anomaly.detection.goals": _SHORT_CHAIN,
+        **(extra or {})})
+    scheduler = FleetScheduler(starvation_bound_s=30.0)
+    registry = FleetRegistry(base_config=base, scheduler=scheduler)
+    brokers = tuple(range(8))
+    registry.register(
+        "mb-a", cc=_make_cc(base, _partitions(brokers, topics=2, parts=10),
+                            optimizer=registry.optimizer))
+    registry.register(
+        "mb-b", cc=_make_cc(base, _partitions(brokers, topics=2, parts=11),
+                            optimizer=registry.optimizer))
+    return registry, scheduler
+
+
+def test_megabatch_runner_wired_by_config():
+    registry, scheduler = _megabatch_fleet()
+    try:
+        assert registry.megabatch is not None
+        assert scheduler.coalescing
+    finally:
+        registry.shutdown()
+    base = _base_config(extra={"fleet.megabatch.enabled": False})
+    off = FleetRegistry(base_config=base,
+                        scheduler=FleetScheduler(starvation_bound_s=30.0))
+    assert off.megabatch is None
+
+
+def test_megabatch_pacer_emits_whole_bucket_fill():
+    """The whole-bucket batch fill (ROADMAP item 3): both clusters due
+    simultaneously coalesce into ONE batched solve at occupancy 2; the
+    proposal caches fill, per-cluster dispatch gauges come from the
+    SPLIT readback, the flight recorder answers per cluster, and the
+    /fleet dashboard shows occupancy."""
+    from cruise_control_tpu.utils.flight_recorder import FLIGHT
+    from cruise_control_tpu.utils.sensors import SENSORS
+    registry, scheduler = _megabatch_fleet()
+    try:
+        # Sweep 1: no bucket recorded yet -> solo solves record buckets.
+        for e in registry.entries():
+            e.last_precompute = 0.0
+        assert scheduler.pace_once() == 2
+        scheduler.run_pending()
+        assert registry.megabatch.stats()["batchesSolved"] == 0
+        # Sweep 2: buckets known -> one megabatch of occupancy 2.
+        for e in registry.entries():
+            e.last_precompute = 0.0
+            with e.cc._proposal_lock:
+                e.cc._proposal_cache = None
+        assert scheduler.pace_once() == 2
+        ran = scheduler.run_pending()
+        assert ran == 2
+        stats = registry.megabatch.stats()
+        assert stats["batchesSolved"] == 1
+        assert stats["lastOccupancy"] == 2
+        assert stats["clustersSolved"] == 2
+        for e in registry.entries():
+            with e.cc._proposal_lock:
+                assert e.cc._proposal_cache is not None, e.cluster_id
+        body = registry.state()
+        assert body["megabatch"]["lastOccupancy"] == 2
+        assert body["megabatch"]["width"] == 4
+        for cid in ("mb-a", "mb-b"):
+            key = ("fleet_precompute_dispatches", (("cluster", cid),))
+            assert SENSORS._gauges.get(key, 0) > 0, cid
+            passes = FLIGHT.passes(cluster=cid, limit=4)
+            assert passes and passes[0]["path"] == "megabatch"
+            assert passes[0]["attributes"]["occupancy"] == 2
+        snap = SENSORS.histogram_snapshot("solver_megabatch_occupancy")
+        assert snap is not None and snap["count"] >= 1
+    finally:
+        registry.shutdown()
+
+
+def test_megabatch_batch_failure_contained():
+    """A cluster whose model build fails at batch time fails ONLY its
+    own future; the batchmate still solves and stores its cache."""
+    registry, scheduler = _megabatch_fleet()
+    try:
+        for e in registry.entries():
+            e.last_precompute = 0.0
+        scheduler.pace_once()
+        scheduler.run_pending()          # record buckets
+        from cruise_control_tpu.fleet import PrecomputePayload
+        from cruise_control_tpu.fleet.megabatch import precompute_batch_key
+        ea = registry.entry("mb-a")
+        eb = registry.entry("mb-b")
+        with eb.cc._proposal_lock:
+            eb.cc._proposal_cache = None
+
+        class Broken:
+            def precompute_inputs(self):
+                raise RuntimeError("model build exploded")
+
+        key = precompute_batch_key(ea)
+        assert key == precompute_batch_key(eb)
+        fut_a = scheduler.submit(
+            "mb-a", JobKind.EXPIRING_CACHE, lambda: None, batch_key=key,
+            payload=PrecomputePayload("mb-a", Broken()))
+        fut_b = scheduler.submit(
+            "mb-b", JobKind.EXPIRING_CACHE, lambda: None, batch_key=key,
+            payload=PrecomputePayload("mb-b", eb.cc))
+        scheduler.run_pending()
+        with pytest.raises(RuntimeError, match="exploded"):
+            fut_a.result(timeout=5)
+        assert fut_b.result(timeout=5).proposals is not None
+        with eb.cc._proposal_lock:
+            assert eb.cc._proposal_cache is not None
+    finally:
+        registry.shutdown()

@@ -1,0 +1,35 @@
+"""fleet_megabatch twin scenario (testing/fleet_twin.py): two drifting
+simulated clusters sharing one bucket, precomputes megabatched through
+one coalescing fleet, self-healing through the real loop."""
+
+import pytest
+
+from cruise_control_tpu.testing.fleet_twin import run_fleet_megabatch
+
+
+def test_fleet_twin_megabatch_smoke():
+    """Short horizon (one broker loss, twin-a's): batched solves really
+    happen at occupancy 2, the loss heals through the real detector/
+    executor machinery, and the combined SLO list is clean."""
+    r = run_fleet_megabatch(seed=0, ticks=24)
+    assert r["megabatch_batches"] > 0
+    assert r["megabatch_last_occupancy"] == 2
+    assert r["megabatch_avg_occupancy"] == 2.0
+    assert r["unhealed_faults"] == 0
+    assert r["events_applied"] == 1        # twin-b's loss is at tick 29
+    assert r["slo_violations"] == []
+    assert r["dead_letters"] == 0
+    assert r["balancedness_final"] is not None
+
+
+@pytest.mark.slow
+def test_fleet_twin_deterministic():
+    """Same seed => byte-identical record (assignments of BOTH twins,
+    heal timings, scores) — the ClusterSimulator determinism contract
+    extended across the shared clock, scheduler, and batched solves."""
+    a = run_fleet_megabatch(seed=1, ticks=36)
+    b = run_fleet_megabatch(seed=1, ticks=36)
+    a.pop("wall_s")
+    b.pop("wall_s")
+    assert a == b
+    assert a["megabatch_batches"] > 0

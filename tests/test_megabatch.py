@@ -1,0 +1,327 @@
+"""Megabatch fleet solver (round 14): whole buckets of clusters through
+one donated device program.
+
+The load-bearing contract (same discipline as PR 5's bounded==fused
+pins): a megabatch solve of N clusters is BYTE-IDENTICAL per cluster to
+N serial solves, at any occupancy — pad slots are inert, a converged
+cluster is frozen by its early-exit mask while batchmates keep
+searching, and occupancy never compiles a new program (one compiled
+program per bucket shape, XLA-compile-counter asserted)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.chain import (
+    AdaptiveDispatch, DispatchStats, MegastepConfig, inert_state_like,
+    megabatch_goal_stats, megabatch_optimize_rounds,
+    optimize_goal_in_chain, optimize_goal_in_chain_megabatch,
+    run_megabatch_pass, stack_states, unstack_state,
+)
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.goals import (
+    NetworkOutboundUsageDistributionGoal, PreferredLeaderElectionGoal,
+    RackAwareGoal, ReplicaCapacityGoal, ReplicaDistributionGoal,
+)
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.analyzer.search import (
+    ExclusionMasks, OptimizationFailureError, SearchConfig,
+)
+from cruise_control_tpu.model.fixtures import random_cluster
+
+# Same chain / grid / shapes as tests/test_megastep.py, so the serial
+# reference kernels are already compiled when both files run in one
+# session — the megabatch pins then only pay the batched compiles.
+CHAIN = (RackAwareGoal(), ReplicaCapacityGoal(),
+         NetworkOutboundUsageDistributionGoal(), ReplicaDistributionGoal(),
+         PreferredLeaderElectionGoal())
+CFG = SearchConfig(num_sources=32, num_dests=8, moves_per_round=32,
+                   max_rounds=60)
+MEGA = MegastepConfig(donate=True, async_readback=True, deficit_moves_cap=0)
+WIDTH = 4
+SEEDS = (3, 5, 7, 11)
+
+
+def _cluster(seed, partition_bucket=32):
+    return random_cluster(num_brokers=12, num_topics=6, num_partitions=96,
+                          rf=2, num_racks=3, seed=seed, skew_to_first=2.0,
+                          partition_bucket=partition_bucket)
+
+
+def _run_serial(state, meta, k=8):
+    masks = ExclusionMasks()
+    dispatch = AdaptiveDispatch(k, 0.0)
+    infos = []
+    for i in range(len(CHAIN)):
+        state, info = optimize_goal_in_chain(
+            state, CHAIN, i, BalancingConstraint(), CFG, meta.num_topics,
+            masks, dispatch_rounds=k, dispatch=dispatch, megastep=MEGA,
+            donate_input=bool(infos) and any(x["rounds"] > 0 for x in infos))
+        infos.append(info)
+    return state, infos
+
+
+def _run_megabatch(states, num_topics, cluster_mask, k=8):
+    """Drive the whole chain through the batched per-goal driver (the
+    optimizer's megabatch loop, minus the result assembly)."""
+    batched = stack_states(states)
+    masks = ExclusionMasks()
+    dispatch = AdaptiveDispatch(k, 0.0)
+    cluster_mask = np.asarray(cluster_mask, dtype=bool)
+    dead = np.zeros(len(states), dtype=bool)
+    infos_per_goal = []
+    donate_input = False
+    for i in range(len(CHAIN)):
+        batched, infos = optimize_goal_in_chain_megabatch(
+            batched, CHAIN, i, BalancingConstraint(), CFG, num_topics,
+            masks, cluster_mask & ~dead, dispatch_rounds=k,
+            dispatch=dispatch, megastep=MEGA, donate_input=donate_input)
+        donate_input = donate_input or any(x["rounds"] > 0 for x in infos)
+        for b, info in enumerate(infos):
+            if "error" in info:
+                dead[b] = True
+        infos_per_goal.append(infos)
+    return batched, infos_per_goal
+
+
+# The two pinned bucket shapes (32 keeps P=96 unpadded; 128 pads the
+# partition axis) x the two pinned occupancies {full, 1-of-4 padded}.
+@pytest.mark.parametrize("bucket", [32, 128])
+def test_megabatch_parity_pin_and_one_program_per_shape(bucket):
+    clusters = [_cluster(s, partition_bucket=bucket) for s in SEEDS]
+    serial = [_run_serial(st, meta) for st, meta in clusters]
+    num_topics = clusters[0][1].num_topics
+    cache0 = megabatch_optimize_rounds._cache_size()
+
+    # Full occupancy: 4 real clusters.
+    full, infos_full = _run_megabatch([st for st, _m in clusters],
+                                      num_topics, [True] * WIDTH)
+    # 1-of-4: one real cluster + three inert pad slots, SAME program.
+    lone = [clusters[0][0]] + [inert_state_like(clusters[0][0])] * 3
+    padded, infos_padded = _run_megabatch(lone, num_topics,
+                                          [True, False, False, False])
+    # One compiled batched move program serves both occupancies of this
+    # bucket shape (occupancy is traced, never a recompile).
+    assert megabatch_optimize_rounds._cache_size() - cache0 == 1
+
+    for b in range(WIDTH):
+        ref_state, ref_infos = serial[b]
+        got = unstack_state(full, b)
+        np.testing.assert_array_equal(np.asarray(ref_state.assignment),
+                                      np.asarray(got.assignment))
+        np.testing.assert_array_equal(np.asarray(ref_state.leader_slot),
+                                      np.asarray(got.leader_slot))
+        for gi, a in enumerate(ref_infos):
+            m = infos_full[gi][b]
+            assert a["rounds"] == m["rounds"], (b, gi)
+            assert a["moves_applied"] == m["moves_applied"], (b, gi)
+            assert a["swaps_applied"] == m["swaps_applied"], (b, gi)
+            assert a["succeeded"] == m["succeeded"], (b, gi)
+            assert abs(a["residual_violation"]
+                       - m["residual_violation"]) < 1e-5
+
+    # The lone real cluster in the padded batch walks the same bytes.
+    ref_state, ref_infos = serial[0]
+    got = unstack_state(padded, 0)
+    np.testing.assert_array_equal(np.asarray(ref_state.assignment),
+                                  np.asarray(got.assignment))
+    np.testing.assert_array_equal(np.asarray(ref_state.leader_slot),
+                                  np.asarray(got.leader_slot))
+    for gi, a in enumerate(ref_infos):
+        assert a["rounds"] == infos_padded[gi][0]["rounds"], gi
+
+    # Inert pad slots: byte-frozen, zero rounds, zero moves.
+    inert = inert_state_like(clusters[0][0])
+    for b in (1, 2, 3):
+        got = unstack_state(padded, b)
+        np.testing.assert_array_equal(np.asarray(inert.assignment),
+                                      np.asarray(got.assignment))
+        for gi in range(len(CHAIN)):
+            assert infos_padded[gi][b]["rounds"] == 0
+            assert infos_padded[gi][b]["moves_applied"] == 0
+
+
+def test_early_exit_mask_freezes_converged_cluster():
+    """A converged cluster in a live batch runs exactly one zero-apply
+    round and freezes (per-cluster early-exit), while its skewed
+    batchmate keeps searching — the batched analogue of the serial
+    on-device early-exit pin."""
+    (st_a, meta), (st_b, _mb) = _cluster(3), _cluster(7)
+    converged, _ = _run_serial(st_a, meta)
+    batched = stack_states([converged, st_b])
+    out = megabatch_optimize_rounds(
+        batched, jnp.asarray([True, True]), jnp.int32(3),
+        jnp.asarray([j < 3 for j in range(len(CHAIN))]), CHAIN,
+        BalancingConstraint(), CFG, meta.num_topics, ExclusionMasks(),
+        jnp.int32(50))
+    new_states, applied, rounds, active = out[:4]
+    rounds = np.asarray(rounds)
+    # Already-optimized cluster A: PreferredLeader etc. of goal 3 —
+    # converged means its first round applies nothing and exits.
+    assert rounds[0] >= 1
+    np.testing.assert_array_equal(
+        np.asarray(unstack_state(new_states, 0).assignment),
+        np.asarray(converged.assignment))
+    assert not bool(np.asarray(active)[0])
+
+
+def test_pump_speculative_dispatch_runs_zero_rounds():
+    """With async readback the pump enqueues one dispatch past
+    convergence; every cluster enters it inactive, so it runs ZERO
+    rounds (cheaper than the serial speculative zero-apply round) and is
+    recorded speculative without contributing rounds or moves."""
+    st, meta = _cluster(3)
+    final, _ = _run_serial(st, meta)
+    batched = stack_states([final, final])
+    idx = jnp.int32(len(CHAIN) - 1)
+    prior = jnp.asarray([j < len(CHAIN) - 1 for j in range(len(CHAIN))])
+
+    def enqueue(states, active, budget):
+        out = megabatch_optimize_rounds(
+            states, active, idx, prior, CHAIN, BalancingConstraint(), CFG,
+            meta.num_topics, ExclusionMasks(), jnp.int32(budget))
+        states, applied, rounds, act = out[:4]
+        return states, act, applied, rounds, False, None
+
+    physical = DispatchStats()
+    per_cluster = [DispatchStats(), DispatchStats()]
+    controller = AdaptiveDispatch(8, 0.0)
+    _st, active, applied, rounds = run_megabatch_pass(
+        enqueue, batched, jnp.asarray([True, True]), CFG.max_rounds,
+        controller, async_readback=True, stats=per_cluster,
+        physical_stats=physical)
+    assert not active.any()
+    # One real dispatch (the terminal zero-apply round per cluster) plus
+    # the speculative zero-round drain.
+    assert physical.speculative == 1
+    assert physical.dispatch_count == 2
+    assert list(rounds) == [1, 1]
+    assert list(applied) == [0, 0]
+    for s in per_cluster:
+        assert s.speculative == 0 and s.rounds_per_dispatch == [1]
+
+
+def test_optimizer_megabatch_matches_serial_results():
+    """Integration parity at the GoalOptimizer level: final states,
+    balancedness, violated sets, and proposal lists all match serial
+    optimizations(); per-cluster dispatch stats split out of the batched
+    readback."""
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    cfg = CruiseControlConfig({"max.solver.rounds": 60})
+    opt = GoalOptimizer(cfg)
+    items = []
+    for seed in (3, 7):
+        st, meta = _cluster(seed)
+        items.append((st, meta, f"c{seed}"))
+    serial = [opt.optimizations(st, meta, goals=list(CHAIN))
+              for st, meta, _ in items]
+    out = opt.optimizations_megabatch(items, goals=list(CHAIN), width=WIDTH)
+    for b, ((s_final, s_res), r) in enumerate(zip(serial, out)):
+        assert not isinstance(r, Exception), r
+        m_final, m_res = r
+        np.testing.assert_array_equal(np.asarray(s_final.assignment),
+                                      np.asarray(m_final.assignment))
+        assert s_res.balancedness_after == m_res.balancedness_after
+        assert s_res.violated_goals_after == m_res.violated_goals_after
+        assert [(p.topic, p.partition, p.new_replicas)
+                for p in s_res.proposals] == \
+            [(p.topic, p.partition, p.new_replicas)
+             for p in m_res.proposals]
+    split = opt.last_megabatch_cluster_stats()
+    assert set(split) == {"c3", "c7"}
+    assert all(v["dispatch_count"] > 0 for v in split.values())
+
+
+def test_per_cluster_error_containment():
+    """A hard-goal failure on one cluster fails exactly that cluster's
+    slot (with the exception a serial solve would raise) and leaves its
+    batchmate's solve intact."""
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    cfg = CruiseControlConfig({"max.solver.rounds": 60})
+    opt = GoalOptimizer(cfg)
+    healthy_st, healthy_meta = _cluster(3)
+    # One rack + rf=2: RackAwareGoal (hard) is structurally unfixable.
+    poisoned_st, poisoned_meta = random_cluster(
+        num_brokers=12, num_topics=6, num_partitions=96, rf=2, num_racks=1,
+        seed=5, skew_to_first=2.0, partition_bucket=32)
+    out = opt.optimizations_megabatch(
+        [(poisoned_st, poisoned_meta, "bad"),
+         (healthy_st, healthy_meta, "good")],
+        goals=list(CHAIN), width=WIDTH)
+    assert isinstance(out[0], OptimizationFailureError)
+    final, res = out[1]
+    ref_final, ref_res = opt.optimizations(healthy_st, healthy_meta,
+                                           goals=list(CHAIN))
+    np.testing.assert_array_equal(np.asarray(ref_final.assignment),
+                                  np.asarray(final.assignment))
+    assert ref_res.violated_goals_after == res.violated_goals_after
+
+
+def test_megabatch_precondition_mismatch_raises():
+    st1, meta1 = _cluster(3)
+    st2, meta2 = _cluster(7, partition_bucket=128)
+    opt = GoalOptimizer()
+    with pytest.raises(ValueError, match="bucket shape"):
+        opt.optimizations_megabatch([(st1, meta1, "a"), (st2, meta2, "b")],
+                                    goals=list(CHAIN))
+    with pytest.raises(ValueError, match="fast_mode"):
+        from cruise_control_tpu.analyzer.constraint import (
+            OptimizationOptions,
+        )
+        opt.optimizations_megabatch(
+            [(st1, meta1, "a")], goals=list(CHAIN),
+            options=OptimizationOptions(fast_mode=True))
+
+
+def test_padded_megabatch_with_exclusion_masks():
+    """Regression: a PARTIALLY-FILLED batch with a non-None exclusion
+    mask must pad the stacked mask axis alongside the inert cluster
+    slots (review finding: masks stacked at occupancy n while states
+    padded to width c crashed vmap with an axis-size mismatch) — and
+    stay byte-identical to the serial solve under the same options."""
+    from cruise_control_tpu.analyzer.constraint import OptimizationOptions
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    cfg = CruiseControlConfig({"max.solver.rounds": 60})
+    opt = GoalOptimizer(cfg)
+    st, meta = _cluster(3)
+    options = OptimizationOptions(excluded_topics=(meta.topic_names[0],))
+    out = opt.optimizations_megabatch([(st, meta, "only")],
+                                      goals=list(CHAIN), options=options,
+                                      width=WIDTH)
+    assert not isinstance(out[0], Exception), out[0]
+    m_final, m_res = out[0]
+    s_final, s_res = opt.optimizations(st, meta, goals=list(CHAIN),
+                                       options=options)
+    np.testing.assert_array_equal(np.asarray(s_final.assignment),
+                                  np.asarray(m_final.assignment))
+    assert s_res.violated_goals_after == m_res.violated_goals_after
+
+
+def test_stack_masks_uniformity():
+    opt = GoalOptimizer()
+    with pytest.raises(ValueError, match="uniform"):
+        opt._stack_masks([
+            ExclusionMasks(excluded_topics=jnp.zeros(4, bool)),
+            ExclusionMasks()])
+    stacked = opt._stack_masks([
+        ExclusionMasks(excluded_topics=jnp.zeros(4, bool)),
+        ExclusionMasks(excluded_topics=jnp.ones(4, bool))])
+    assert stacked.excluded_topics.shape == (2, 4)
+    assert stacked.excluded_replica_move_brokers is None
+
+
+def test_inert_state_generates_no_work():
+    st, meta = _cluster(3)
+    inert = inert_state_like(st)
+    batched = stack_states([inert, inert])
+    viol, _obj, off = megabatch_goal_stats(
+        batched, jnp.int32(0), CHAIN, BalancingConstraint(),
+        meta.num_topics, ExclusionMasks())
+    assert float(np.asarray(viol).sum()) == 0.0
+    assert int(np.asarray(off).sum()) == 0
