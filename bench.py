@@ -111,6 +111,21 @@ SCENARIO_TICKS = int(os.environ.get("BENCH_SCENARIO_TICKS", "0"))
 FLEET_MODE = "--fleet" in sys.argv or bool(os.environ.get("BENCH_FLEET"))
 FLEET_K = int(os.environ.get("BENCH_FLEET_CLUSTERS", "4"))
 
+# --futures: run ONLY the futures-engine stage (N sampled candidate
+# futures advanced to their decision points, then solved serially vs
+# through one batched megabatch program — ROADMAP item 5's throughput
+# lever). Like --fleet, the stage also rides the END of every default
+# bench pass so the CI FUTURES row and the regression sentry (which
+# hard-fails a ranked-order flip) see it without a separate invocation.
+FUTURES_MODE = "--futures" in sys.argv or bool(os.environ.get("BENCH_FUTURES"))
+FUTURES_N = int(os.environ.get("BENCH_FUTURES_COUNT", "8"))
+
+# Generator-sampled SCENARIO_MATRIX rows (pinned (template, seed) pairs
+# so the matrix stays deterministic): the scenario-diversity axis beyond
+# the 6-scenario canonical library. Violation-free at these pins by
+# construction — a new SLO violation on one IS a regression.
+SAMPLED_MATRIX = (("load_ramp", 3), ("cascading_failures", 5))
+
 
 # Journal of every emitted line, re-printed at exit (even via the watchdog
 # hard-exit) so the final stdout tail always contains every completed stage.
@@ -471,6 +486,17 @@ def compare_stage_to_baseline(record: dict, baseline: dict) -> dict | None:
         warnings.append(f"goals no longer violated (re-pin baseline): "
                         f"{gone_viol}")
 
+    rank = ex.get("ranked_order")
+    rank_base = entry.get("ranked_order")
+    if rank is not None and rank_base is not None \
+            and list(rank) != list(rank_base):
+        # The futures stage's headline contract: which future WINS is a
+        # solution-quality statement, deterministic at pinned seeds —
+        # a flip is a regression (or a deliberate change that must
+        # re-pin the baseline and say why).
+        canaries.append(f"ranked order flipped: {rank} != baseline "
+                        f"{rank_base}")
+
     wall = ex.get("solve_wall_clock_s")
     wall_base = entry.get("solve_wall_clock_s")
     if wall is not None and wall_base and wall > wall_ratio * wall_base:
@@ -499,6 +525,8 @@ def compare_stage_to_baseline(record: dict, baseline: dict) -> dict | None:
             "solve_wall_clock_baseline_s": wall_base,
             "dispatch_count": disp,
             "dispatch_count_baseline": disp_base,
+            "ranked_order": rank,
+            "ranked_order_baseline": rank_base,
         },
     }
 
@@ -548,12 +576,16 @@ def _degraded_cycle_probe(seed: int = 11) -> dict:
             "degraded_cycle_faults_injected": r["faults_injected"]}
 
 
-def _scenario_record(name: str, seed: int, ticks: int | None) -> dict:
-    """Run one canonical scenario on the digital twin and flatten its
-    ScenarioScore into the extras the SCENARIO_MATRIX table reads."""
+def _scenario_record(scenario, seed: int, ticks: int | None,
+                     label: str | None = None) -> dict:
+    """Run one scenario (a canonical name or a generator-sampled
+    ScenarioSpec) on the digital twin and flatten its ScenarioScore into
+    the extras the SCENARIO_MATRIX table reads. ``label`` names the
+    metric for sampled specs (colons don't belong in metric names)."""
     from cruise_control_tpu.testing.simulator import run_scenario
-    r = run_scenario(name, seed=seed, ticks=ticks)
+    r = run_scenario(scenario, seed=seed, ticks=ticks)
     d = r.score.as_dict()
+    name = label or d["scenario"]
     return {
         "metric": f"scenario_{name}",
         "value": round(r.wall_s, 3),
@@ -561,7 +593,7 @@ def _scenario_record(name: str, seed: int, ticks: int | None) -> dict:
         # >0 = every SLO held; the matrix table prints the violation list.
         "vs_baseline": 0.0 if d["sloViolations"] else 1.0,
         "extras": {
-            "scenario": name, "seed": seed,
+            "scenario": d["scenario"], "seed": seed,
             "ticks": d["ticks"], "sim_hours": d["simHours"],
             "replica_moves": d["churn"]["replicaMoves"],
             "leader_moves": d["churn"]["leaderMoves"],
@@ -586,9 +618,16 @@ def _run_scenario_matrix(deadline: float) -> int:
     per-stage prorated-deadline discipline as the perf stages (weights =
     simulated ticks ≈ cost), so the matrix can NEVER ride one slow
     scenario into an external rc=124 kill."""
+    from cruise_control_tpu.futures.generator import sample_scenario
     from cruise_control_tpu.testing.simulator import CANONICAL_SCENARIOS
     items = sorted(CANONICAL_SCENARIOS.items(),
                    key=lambda kv: kv[1].ticks)
+    # Generator-sampled rows at pinned (template, seed) pairs: the
+    # scenario-diversity axis the canonical library cannot cover, kept
+    # deterministic (and SLO-clean at these pins) so the matrix gate
+    # applies to them unchanged.
+    items = items + [(f"random_{t}_s{s}", sample_scenario(t, s))
+                     for t, s in SAMPLED_MATRIX]
     for i, (name, spec) in enumerate(items):
         remaining = deadline - time.time()
         if remaining < 45:
@@ -609,7 +648,8 @@ def _run_scenario_matrix(deadline: float) -> int:
         signal.alarm(max(1, int(stage_budget)))
         try:
             record = _scenario_record(
-                name, SCENARIO_SEED, SCENARIO_TICKS or None)
+                spec if name.startswith("random_") else name,
+                SCENARIO_SEED, SCENARIO_TICKS or None, label=name)
             signal.alarm(0)
             _emit(record)
         except _Watchdog:
@@ -802,6 +842,122 @@ def _run_fleet_stage(progress: dict, k: int | None = None) -> dict:
             "solve_wall_clock_s": round(mb_s, 3),
             "dispatch_count": physical.dispatch_count,
             "donated_dispatches": physical.donated,
+            **progress,
+        },
+    }
+
+
+def _run_futures_stage(progress: dict, n: int | None = None) -> dict:
+    """The --futures stage: evaluating N sampled candidate futures the
+    round-11 way (one FULL serial ``run_scenario`` replay per future —
+    exactly what ``?what_if=`` does per request: detection, self-healing
+    solves, and probes every tick) vs the round-15 futures engine
+    (per-future advance with detection off + ONE batched decision
+    solve). Same templates, same seeds, same compressed story in the
+    same tick horizon — the workload-level ratio is the acceptance bar
+    (≥ 2x futures/s on CPU; measured ~27x at 8 futures / 16 ticks on a
+    2-core dev box).
+
+    Transparency split: the DECISION-SOLVE layer is also timed serial
+    (one fused ``optimizations()`` per future) vs batched, with
+    per-future scores asserted BYTE-IDENTICAL between those two paths
+    (the parity pin — CI hard-fails anything but "ok"). At CI's toy
+    shapes the fused solo solve is individually cheaper than a batched
+    bounded program — the batch pays off in dispatch amortization at
+    real link latency and in compile-once sharing — so the solve split
+    is reported, not gated. The RANKED ORDER rides the extras as a
+    regression-sentry canary: a rank flip against the committed
+    baseline hard-fails the sentry."""
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.futures.evaluator import (
+        PRESENT, FutureSpec, evaluate_prepared, plan_futures,
+        prepare_future, rank_results,
+    )
+    from cruise_control_tpu.futures.generator import sample_future
+    from cruise_control_tpu.testing.simulator import run_scenario
+    n = n or FUTURES_N
+    ticks = int(os.environ.get("BENCH_FUTURES_TICKS", "16"))
+    width = n + 1  # every future + the present in ONE batched program
+    plan = plan_futures((), n, seed=0, ticks=ticks)
+    specs = plan + [FutureSpec(PRESENT, 0, ticks)]
+
+    # Warm both worlds (compiles) before timing steady states.
+    t0 = time.time()
+    run_scenario(sample_future(plan[0].template,
+                               plan[0].seed).replay_spec(ticks),
+                 seed=plan[0].seed)
+    progress["futures_warm_replay_s"] = round(time.time() - t0, 3)
+    t0 = time.time()
+    prepared = [prepare_future(fs) for fs in specs]
+    optimizer = GoalOptimizer(prepared[0].config)
+    evaluate_prepared(prepared, optimizer, batched=False)
+    evaluate_prepared(prepared, optimizer, width=width)
+    progress["futures_warm_engine_s"] = round(time.time() - t0, 3)
+
+    # The round-11 way: one full serial replay per candidate future —
+    # the SAME story compressed into the same horizon (replay_spec
+    # rescales every event; plain truncation would let the baseline
+    # under-work by dropping late faults/maintenance).
+    t0 = time.time()
+    for fs in plan:
+        run_scenario(sample_future(fs.template,
+                                   fs.seed).replay_spec(ticks),
+                     seed=fs.seed)
+    replay_s = max(time.time() - t0, 1e-9)
+
+    # The futures engine, end to end: advance every twin + ONE batched
+    # decision solve (the COMPARE_FUTURES body, minus response shaping).
+    t0 = time.time()
+    prepared = [prepare_future(fs) for fs in specs]
+    batched = evaluate_prepared(prepared, optimizer, width=width)
+    engine_s = max(time.time() - t0, 1e-9)
+    dispatch_stats = optimizer.last_dispatch_stats()
+
+    # Decision-solve transparency split + the byte-parity pin.
+    t0 = time.time()
+    serial = evaluate_prepared(prepared, optimizer, batched=False)
+    solve_serial_s = max(time.time() - t0, 1e-9)
+    t0 = time.time()
+    batched2 = evaluate_prepared(prepared, optimizer, width=width)
+    solve_batched_s = max(time.time() - t0, 1e-9)
+    parity = "ok" if [r.score_dict() for r in serial] \
+        == [r.score_dict() for r in batched] \
+        == [r.score_dict() for r in batched2] else "MISMATCH"
+
+    ranked = rank_results(batched)
+    ranked_order = [r.future_id for r in ranked]
+    bals = [r.balancedness_after for r in ranked if r.error is None]
+    violated = sorted({g for r in ranked for g in r.violated_goals_after})
+    speedup = replay_s / engine_s
+    return {
+        "metric": f"futures_compare_{n}futures",
+        "value": round(engine_s, 3),
+        "unit": "s",
+        # Acceptance bar: >= 2x futures/s over serial replay on CPU
+        # (>1 here means the bar is met).
+        "vs_baseline": round(speedup / 2.0, 3),
+        "extras": {
+            "futures": n,
+            "ticks": ticks,
+            "parity_pin": parity,
+            "replay_serial_s": round(replay_s, 3),
+            "engine_batched_s": round(engine_s, 3),
+            "futures_speedup": round(speedup, 3),
+            "futures_per_s_replay": round(n / replay_s, 3),
+            "futures_per_s_batched": round(n / engine_s, 3),
+            "futures_occupancy": len(prepared),
+            "decision_solve_serial_s": round(solve_serial_s, 3),
+            "decision_solve_batched_s": round(solve_batched_s, 3),
+            "ranked_order": ranked_order,
+            "measured_layer": "whole evaluation workload (serial "
+                              "run_scenario replay per future vs "
+                              "advance + one batched decision solve); "
+                              "decision_solve_* is the solve-layer "
+                              "split, parity-pinned",
+            "balancedness_after": min(bals) if bals else None,
+            "violated_goals_after": violated,
+            "solve_wall_clock_s": round(engine_s, 3),
+            "dispatch_count": dispatch_stats.get("dispatch_count", 0),
             **progress,
         },
     }
@@ -1064,6 +1220,22 @@ def _guarded_main(deadline: float) -> int:
                    "extras": {"stage": "fleet_megabatch",
                               "error": f"{type(e).__name__}: {e}"[:500]}})
         return 0
+    if FUTURES_MODE:
+        _emit({"metric": "bench_bootstrap",
+               "value": round(time.time() - t0, 3), "unit": "s",
+               "vs_baseline": 1.0,
+               "extras": {"device": device, "num_devices": n_dev,
+                          "mode": "futures", "futures": FUTURES_N,
+                          "compile_cache_dir": cache_dir,
+                          "stderr_file": _stderr_path}})
+        try:
+            _emit(_run_futures_stage({}))
+        except Exception as e:  # noqa: BLE001 — parseable record always
+            _emit({"metric": "stage_failed", "value": 0.0, "unit": "s",
+                   "vs_baseline": 0.0,
+                   "extras": {"stage": "futures_compare",
+                              "error": f"{type(e).__name__}: {e}"[:500]}})
+        return 0
     noop_ns = _tracing_noop_overhead_ns()
     _emit({"metric": "tracing_noop_span_overhead", "value": round(noop_ns, 1),
            "unit": "ns", "vs_baseline": 1.0,
@@ -1212,6 +1384,42 @@ def _guarded_main(deadline: float) -> int:
         _emit({"metric": "stage_partial_fleet_megabatch", "value": 0.0,
                "unit": "s", "vs_baseline": 0.0,
                "extras": {"stage": "fleet_megabatch", "partial": True,
+                          "skipped": True, "reason": "budget exhausted"}})
+    # The futures stage rides every default pass too (round 15): the CI
+    # FUTURES row, the parity pin, and the ranked-order canary see it
+    # per-PR without a separate invocation.
+    remaining = deadline - time.time()
+    if remaining > 90:
+        progress = {}
+        t0 = time.time()
+        signal.alarm(max(1, int(min(remaining - 15.0, 300.0))))
+        try:
+            record = _run_futures_stage(progress)
+            signal.alarm(0)
+            _emit(record)
+            if baseline is not None:
+                verdict = compare_stage_to_baseline(record, baseline)
+                if verdict is not None:
+                    sentry_verdicts.append(verdict)
+                    _emit(verdict)
+        except _Watchdog:
+            _emit({"metric": "stage_partial_futures_compare",
+                   "value": round(time.time() - t0, 3), "unit": "s",
+                   "vs_baseline": 0.0,
+                   "extras": {"stage": "futures_compare", "partial": True,
+                              **progress}})
+        except Exception as e:  # noqa: BLE001 — parseable record always
+            _emit({"metric": "stage_failed", "value": round(
+                time.time() - t0, 3), "unit": "s", "vs_baseline": 0.0,
+                "extras": {"stage": "futures_compare",
+                           "error": f"{type(e).__name__}: {e}"[:500],
+                           **progress}})
+        finally:
+            signal.alarm(0)
+    else:
+        _emit({"metric": "stage_partial_futures_compare", "value": 0.0,
+               "unit": "s", "vs_baseline": 0.0,
+               "extras": {"stage": "futures_compare", "partial": True,
                           "skipped": True, "reason": "budget exhausted"}})
     _emit_sentry_summary(sentry_verdicts, baseline)
     _dump_flight_recorder()

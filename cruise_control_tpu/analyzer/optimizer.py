@@ -307,6 +307,15 @@ class GoalOptimizer:
             async_readback=self._async_readback,
             deficit_moves_cap=self._deficit_moves_cap if in_regime else 0)
 
+    def deficit_sizing_active(self, num_brokers: int) -> bool:
+        """Whether a SERIAL solve of this broker count would run
+        deficit-aware count-goal sizing. The megabatch path structurally
+        disables it (the grid cannot specialize to one batch member), so
+        callers with a choice of path — the facade's fleet-wired
+        ``_optimize`` seam — must keep the serial path in this regime or
+        silently change solution quality vs a standalone deployment."""
+        return self._megastep_config(num_brokers).deficit_moves_cap > 0
+
     @property
     def constraint(self) -> BalancingConstraint:
         return self._constraint
@@ -704,20 +713,30 @@ class GoalOptimizer:
                                 ) -> list:
         """Solve MANY same-bucket clusters in one batched device program
         (ROADMAP item 3): every model in ``items`` — a sequence of
-        ``(state, meta, cluster_id)`` — is stacked along a leading
-        cluster axis and the whole goal chain runs through the batched
-        megastep drivers (chain.optimize_goal_in_chain_megabatch), so the
-        fleet pays max-over-clusters rounds instead of the serial sum and
-        ONE compiled program per bucket shape serves any occupancy.
+        ``(state, meta, cluster_id)`` or ``(state, meta, cluster_id,
+        options)`` — is stacked along a leading cluster axis and the
+        whole goal chain runs through the batched megastep drivers
+        (chain.optimize_goal_in_chain_megabatch), so the fleet pays
+        max-over-clusters rounds instead of the serial sum and ONE
+        compiled program per bucket shape serves any occupancy.
+
+        PER-ITEM options (the 4-tuple form, round 15) carry each
+        cluster's own exclusion set — the fix path's recently-removed
+        brokers, a future's drained brokers — into per-cluster exclusion
+        MASKS stacked along the cluster axis. Mask presence is
+        normalized across the batch: when any item excludes along a
+        field, items without exclusions get an all-False mask (inert:
+        it filters nothing), so mixed batches share one compiled mask
+        layout instead of splitting into per-presence programs.
 
         Preconditions (the fleet assembler's grouping contract — violated
         ones raise ValueError before any device work): identical padded
         bucket shape including the replica-slot axis, identical
-        ``num_topics``, an identical resolved goal chain, uniform
-        exclusion-mask presence, and no fast mode. ``width`` > len(items)
-        pads the batch with inert zero-weight cluster slots (all-dead
-        brokers, fully masked partitions) so one compiled program per
-        bucket shape serves any occupancy.
+        ``num_topics``, an identical resolved goal chain, and no fast
+        mode. ``width`` > len(items) pads the batch with inert
+        zero-weight cluster slots (all-dead brokers, fully masked
+        partitions) so one compiled program per bucket shape serves any
+        occupancy.
 
         Deficit-aware count-goal sizing is forced OFF: it specializes the
         search grid to one cluster's entry violation, which cannot be
@@ -745,12 +764,14 @@ class GoalOptimizer:
         if not items:
             return []
         options = options or OptimizationOptions()
-        if options.fast_mode:
-            raise ValueError("megabatch does not support fast_mode")
         n = len(items)
         states = [it[0] for it in items]
         metas = [it[1] for it in items]
         cluster_ids = [it[2] if len(it) > 2 else None for it in items]
+        opts_list = [it[3] if len(it) > 3 and it[3] is not None else options
+                     for it in items]
+        if any(o.fast_mode for o in opts_list):
+            raise ValueError("megabatch does not support fast_mode")
         shape0 = jax.tree.map(lambda x: x.shape, states[0])
         for st in states[1:]:
             if jax.tree.map(lambda x: x.shape, st) != shape0:
@@ -766,8 +787,9 @@ class GoalOptimizer:
                                  "goal chain")
         goal_chain = list(chain0)
 
-        masks_list = [self._masks(st, m, options)
-                      for st, m in zip(states, metas)]
+        masks_list = self._uniform_mask_presence(
+            [self._masks(st, m, o)
+             for st, m, o in zip(states, metas, opts_list)])
 
         c = max(n, int(width) or n)
         pad = c - n
@@ -924,6 +946,29 @@ class GoalOptimizer:
         dict). The fleet runner reads it to report
         fleet_precompute_dispatches{cluster=} exactly."""
         return dict(getattr(self, "_megabatch_cluster_stats", {}))
+
+    @staticmethod
+    def _uniform_mask_presence(masks_list: list[ExclusionMasks],
+                               ) -> list[ExclusionMasks]:
+        """Normalize per-cluster mask presence for stacking: a field set
+        by ANY cluster is filled with an inert all-False mask for the
+        rest (excluding nothing is exactly what an absent mask means),
+        so per-item options never split a batch by mask layout."""
+        import jax.numpy as jnp
+        fields = ("excluded_topics", "excluded_replica_move_brokers",
+                  "excluded_leadership_brokers")
+        fills = {}
+        for name in fields:
+            first = next((getattr(m, name) for m in masks_list
+                          if getattr(m, name) is not None), None)
+            if first is not None:
+                fills[name] = jnp.zeros_like(first)
+        if not fills:
+            return masks_list
+        return [ExclusionMasks(**{
+            name: getattr(m, name) if getattr(m, name) is not None
+            else fills.get(name) for name in fields})
+            for m in masks_list]
 
     @staticmethod
     def _stack_masks(masks_list: list[ExclusionMasks]) -> ExclusionMasks:

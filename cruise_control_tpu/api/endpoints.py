@@ -65,6 +65,14 @@ class EndPoint(enum.Enum):
     # not VIEWER: a capture occupies the profiler gate and the microbench
     # occupies the device — both consume shared machine time.
     PROFILE = (26, "GET", Role.USER)
+    # Futures engine (round 15, no reference analogue — the reference's
+    # what-if is one dry run per request): evaluate a batch of sampled
+    # candidate futures of the cluster in one megabatched solve and
+    # return them ranked with score deltas vs the present. Async (202 +
+    # User-Task-ID), dry-run only — a futures request can never execute
+    # anything. USER like PROPOSALS/PROFILE: the batched solve consumes
+    # shared device time even though the answer is viewer-safe.
+    COMPARE_FUTURES = (27, "GET", Role.USER)
 
     @property
     def method(self) -> str:

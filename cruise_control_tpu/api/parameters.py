@@ -89,6 +89,8 @@ _PROPOSAL_PARAMS = {**_GOALS_PARAMS, "ignore_proposal_cache": _bool,
 # with what_if=<scenario> runs the named canonical scenario on a
 # simulated twin and returns the scored trajectory — a time-dimension
 # extension of the dry run; it never executes anything.
+# what_if=random:<template>:<seed> replays a generator-sampled scenario
+# (futures/generator.py) instead — same caps, same determinism contract.
 _WHAT_IF_PARAMS = {"what_if": _str, "what_if_seed": _int,
                    "what_if_ticks": _int}
 
@@ -174,6 +176,13 @@ SCHEMAS: dict[EndPoint, dict[str, Callable[[str], Any]]] = {
     # partitions/iters size it).
     EndPoint.PROFILE: {"duration_s": _float, "microbench": _bool,
                        "brokers": _int, "partitions": _int, "iters": _int},
+    # Futures engine (futures/evaluator.py): templates picks the sampled
+    # scenario templates (default: all), num_futures how many candidates
+    # (capped by futures.max.count), seed the base generator seed, ticks
+    # the advance horizon (capped by futures.max.ticks).
+    EndPoint.COMPARE_FUTURES: {"templates": _csv, "num_futures": _int,
+                               "seed": _int, "ticks": _int,
+                               "include_present": _bool},
 }
 
 

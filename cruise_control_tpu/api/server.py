@@ -62,7 +62,7 @@ _SOLVER_ENDPOINTS = {
     EndPoint.PROPOSALS, EndPoint.REBALANCE, EndPoint.ADD_BROKER,
     EndPoint.REMOVE_BROKER, EndPoint.DEMOTE_BROKER,
     EndPoint.FIX_OFFLINE_REPLICAS, EndPoint.TOPIC_CONFIGURATION,
-    EndPoint.REMOVE_DISKS,
+    EndPoint.REMOVE_DISKS, EndPoint.COMPARE_FUTURES,
 }
 
 
@@ -595,11 +595,36 @@ class CruiseControlApi:
                 pass
         from ..fleet.scheduler import JobKind
 
+        batch_key = payload = None
+        if endpoint is EndPoint.COMPARE_FUTURES and sched.coalescing \
+                and p is not None:
+            # Futures coalesce with precomputes (round 15): the request
+            # submits under its cluster's precompute batch key plus a
+            # runner payload, so a scheduler turn that picks either
+            # drains both — the futures' decision solves and the paced
+            # cache fills share one worker turn (and, when compatible,
+            # one batched program). Solo fallback (``work``) covers
+            # shutdown/inline execution unchanged.
+            try:
+                batch_key = \
+                    self._precompute_key_for(cluster_id)
+            except Exception:  # noqa: BLE001 — hint only; run solo
+                batch_key = None
+            if batch_key is not None:
+                from ..futures.evaluator import FuturesPayload
+                req = self._futures_request(cc, p)
+                payload = FuturesPayload(
+                    cluster_id, req["templates"], req["num_futures"],
+                    req["seed"], req["ticks"],
+                    include_present=req["include_present"],
+                    wrap=responses.envelope)
+
         def scheduled():
             from concurrent.futures import CancelledError
             try:
                 return sched.submit(cluster_id, JobKind.ON_DEMAND,
-                                    work).result()
+                                    work, batch_key=batch_key,
+                                    payload=payload).result()
             except CancelledError:
                 # Scheduler shut down before the job ran: a meaningful
                 # 503 beats an opaque "CancelledError:" 500.
@@ -608,6 +633,32 @@ class CruiseControlApi:
                     "could run; retry once the fleet is back up")
 
         return scheduled
+
+    def _precompute_key_for(self, cluster_id: str) -> tuple | None:
+        """The cluster's precompute coalescing key (None when it has no
+        recorded bucket yet)."""
+        from ..fleet.megabatch import precompute_batch_key
+        return precompute_batch_key(self._fleet.entry(cluster_id))
+
+    def _futures_request(self, cc: CruiseControl, p: dict) -> dict:
+        """Resolve + validate a COMPARE_FUTURES request against the
+        cluster's config caps (shared by the direct work path and the
+        fleet-coalesced payload path; template typos 400 up front)."""
+        from ..futures.generator import FUTURE_TEMPLATES
+        cfg = cc.config
+        templates = [t for t in p.get("templates", ()) if t]
+        for t in templates:
+            if t not in FUTURE_TEMPLATES:
+                raise ParameterParseError(
+                    f"unknown futures template {t!r}; expected one of "
+                    f"{', '.join(sorted(FUTURE_TEMPLATES))}")
+        n = p.get("num_futures", cfg.get_int("futures.default.count"))
+        n = max(1, min(int(n), cfg.get_int("futures.max.count")))
+        ticks = p.get("ticks", cfg.get_int("futures.default.ticks"))
+        ticks = max(1, min(int(ticks), cfg.get_int("futures.max.ticks")))
+        return {"templates": templates or None, "num_futures": n,
+                "seed": p.get("seed", 0), "ticks": ticks,
+                "include_present": p.get("include_present", True)}
 
     def _sync_handler(self, endpoint: EndPoint, p: dict,
                       principal: Principal,
@@ -774,25 +825,48 @@ class CruiseControlApi:
         """PROPOSALS ``?what_if=<scenario>``: replay a canonical scenario
         on the digital twin (testing/simulator.py) and return the scored
         trajectory — the time-dimension extension of the proposals dry
-        run. The simulator wires its OWN backend/executor, so this
+        run. ``what_if=random:<template>:<seed>`` replays a
+        generator-sampled scenario (futures/generator.py) instead —
+        every sampled row of a COMPARE_FUTURES answer is replayable this
+        way. The simulator wires its OWN backend/executor, so this
         cluster's executor state is never touched; tick counts are capped
         by ``scenario.what.if.max.ticks`` since a replay is real solver
         work."""
         from ..testing.simulator import CANONICAL_SCENARIOS, run_scenario
         name = p["what_if"]
-        if name not in CANONICAL_SCENARIOS:
-            raise ParameterParseError(
-                f"unknown what_if scenario {name!r}; expected one of "
-                f"{', '.join(sorted(CANONICAL_SCENARIOS))}")
+        if name.startswith("random:"):
+            from ..futures.generator import FUTURE_TEMPLATES, sample_scenario
+            parts = name.split(":")
+            template = parts[1] if len(parts) >= 2 else ""
+            if len(parts) not in (2, 3) or template not in FUTURE_TEMPLATES:
+                raise ParameterParseError(
+                    f"unknown futures template {template!r} in "
+                    f"what_if={name!r}; expected "
+                    "random:<template>[:<seed>] with a template from: "
+                    f"{', '.join(sorted(FUTURE_TEMPLATES))}")
+            try:
+                gen_seed = int(parts[2]) if len(parts) == 3 else 0
+            except ValueError:
+                raise ParameterParseError(
+                    f"bad generator seed in what_if={name!r}: "
+                    f"{parts[2]!r} is not an integer")
+            spec = sample_scenario(template, gen_seed)
+        else:
+            if name not in CANONICAL_SCENARIOS:
+                raise ParameterParseError(
+                    f"unknown what_if scenario {name!r}; expected one of "
+                    f"{', '.join(sorted(CANONICAL_SCENARIOS))} or "
+                    "random:<template>:<seed>")
+            spec = CANONICAL_SCENARIOS[name]
         cap = cc.config.get_int("scenario.what.if.max.ticks")
         ticks = p.get("what_if_ticks")
-        ticks = min(CANONICAL_SCENARIOS[name].ticks, cap) if ticks is None \
+        ticks = min(spec.ticks, cap) if ticks is None \
             else max(1, min(int(ticks), cap))
         seed = p.get("what_if_seed", 0)
-        result = run_scenario(name, seed=seed, ticks=ticks)
+        result = run_scenario(spec, seed=seed, ticks=ticks)
         return responses.envelope({
             "operation": "what_if", "dryrun": True, "executed": False,
-            "scenario": name, "seed": seed, "ticks": ticks,
+            "scenario": spec.name, "seed": seed, "ticks": ticks,
             "score": result.score.as_dict(),
             "finalAssignmentDigest": result.assignment_digest,
             "events": result.events})
@@ -915,6 +989,19 @@ class CruiseControlApi:
         data_from = p.get("data_from")
         allow_cap = p.get("allow_capacity_estimation", True)
 
+        # Validated EAGERLY (not inside the work closure) so a template
+        # typo 400s the request before a user task is ever created.
+        futures_req = self._futures_request(cc, p) \
+            if endpoint is EndPoint.COMPARE_FUTURES else None
+
+        def compare_futures():
+            from ..futures.evaluator import compare_futures as _compare
+            body = _compare(
+                optimizer=cc.optimizer,
+                width=cc.config.get_int("futures.batch.width"),
+                **futures_req)
+            return responses.envelope(body)
+
         def proposals():
             if p.get("what_if"):
                 return self._what_if_handler(cc, p)
@@ -1003,7 +1090,8 @@ class CruiseControlApi:
                  EndPoint.DEMOTE_BROKER: demote_broker,
                  EndPoint.FIX_OFFLINE_REPLICAS: fix_offline_replicas,
                  EndPoint.TOPIC_CONFIGURATION: topic_configuration,
-                 EndPoint.REMOVE_DISKS: remove_disks}
+                 EndPoint.REMOVE_DISKS: remove_disks,
+                 EndPoint.COMPARE_FUTURES: compare_futures}
         return table[endpoint]
 
 

@@ -31,6 +31,9 @@ CC_ADMIN = "CC_ADMIN"
 _ENDPOINT_CLASS = {
     "LOAD": KAFKA_MONITOR, "PARTITION_LOAD": KAFKA_MONITOR,
     "PROPOSALS": KAFKA_MONITOR, "KAFKA_CLUSTER_STATE": KAFKA_MONITOR,
+    # COMPARE_FUTURES is read-only analysis of the Kafka cluster's
+    # candidate futures (dry-run only, never executes).
+    "COMPARE_FUTURES": KAFKA_MONITOR,
     "STATE": CC_MONITOR, "USER_TASKS": CC_MONITOR,
     "REVIEW_BOARD": CC_MONITOR, "PERMISSIONS": CC_MONITOR,
     "ADMIN": CC_ADMIN, "REVIEW": CC_ADMIN, "PAUSE_SAMPLING": CC_ADMIN,

@@ -573,6 +573,33 @@ def _definition() -> ConfigDef:
              "client-style proposals() probe so degraded serving "
              "(stale=true responses, model-build failures) is part of "
              "the scored trajectory (0 disables probing).")
+    # --- Futures engine (futures/, round 15) ---
+    d.define("futures.default.count", T.INT, 8, Range.at_least(1), I.LOW,
+             "Candidate futures a COMPARE_FUTURES request evaluates when "
+             "num_futures is not given (templates round-robin, seeds "
+             "advance per cycle — every row replayable via "
+             "what_if=random:<template>:<seed>).")
+    d.define("futures.max.count", T.INT, 32, Range.at_least(1), I.LOW,
+             "Cap on num_futures per COMPARE_FUTURES request: each "
+             "future costs a twin advance (host) and a batched solve "
+             "slot (device); unbounded requests would let one client "
+             "monopolize both.")
+    d.define("futures.default.ticks", T.INT, 12, Range.at_least(4), I.LOW,
+             "Default advance horizon (simulated ticks to each future's "
+             "decision point) when a COMPARE_FUTURES request omits "
+             "ticks. Floor 4: the twin fills one metrics window per "
+             "tick and the decision model build needs its windows.")
+    d.define("futures.max.ticks", T.INT, 60, Range.at_least(4), I.LOW,
+             "Cap on a COMPARE_FUTURES advance horizon (the advance is "
+             "per-future host-side simulation; the what-if replay cap "
+             "scenario.what.if.max.ticks plays the same role for full-"
+             "loop replays).")
+    d.define("futures.batch.width", T.INT, 8, Range.at_least(1), I.LOW,
+             "Cluster-axis width of a batched futures solve (the "
+             "evaluator's direct path; fleet-coalesced futures use "
+             "fleet.megabatch.width). Fixed per bucket shape: partial "
+             "chunks pad with inert slots so one compiled program per "
+             "shape serves any occupancy.")
     d.define("goal.violation.distribution.threshold.multiplier", T.DOUBLE, 1.0,
              Range.at_least(1), I.LOW,
              "Detector-triggered balance-threshold relaxation.")
@@ -956,7 +983,7 @@ def _definition() -> ConfigDef:
                "fix.offline.replicas", "rebalance", "stop.proposal",
                "pause.sampling", "resume.sampling", "demote.broker", "admin",
                "review", "topic.configuration", "rightsize", "remove.disks",
-               "fleet", "trace", "solver", "profile"):
+               "fleet", "trace", "solver", "profile", "compare.futures"):
         d.define(f"{ep}.parameters.class", T.CLASS, None, None, I.LOW,
                  f"Parameter-parsing plugin for the {ep} endpoint "
                  "(callable(query) -> params dict).")

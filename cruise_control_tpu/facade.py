@@ -253,6 +253,14 @@ class CruiseControl:
         self._stop_precompute: threading.Event | None = None
         self._precompute_thread: threading.Thread | None = None
         self._started = False
+        # Fleet seam (ROADMAP item 3c tail, round 15): when the registry
+        # wires a nonzero width, goal-chain solves — self-healing fixes
+        # and on-demand operations included — run through the BATCHED
+        # megabatch kernels at occupancy 1 instead of compiling the solo
+        # chain programs: one compiled program per bucket shape serves
+        # precompute fills, fixes, and futures alike, and per-request
+        # exclusion options ride the batched mask assembler.
+        self.megabatch_solve_width = 0
         from .detector.provisioner import BasicProvisioner
         self.provisioner = BasicProvisioner()
 
@@ -790,6 +798,37 @@ class CruiseControl:
                                optimizer_result=result,
                                proposals=result.proposals)
 
+    def _optimize(self, state, meta, chain, options: OptimizationOptions,
+                  ) -> tuple[Any, OptimizerResult]:
+        """The single-cluster solve seam for the goal-chain operations.
+        With a fleet-wired ``megabatch_solve_width`` the solve routes
+        through ``optimizations_megabatch`` at occupancy 1 — the same
+        compiled batched program (and the same per-cluster exclusion-mask
+        assembly) the fleet's coalesced precompute fills use, so fix and
+        on-demand solves pay zero extra compilations on a megabatching
+        deployment. Per-cluster failures surface as the exact exception
+        a serial solve would raise. Fast mode and mesh solvers keep the
+        serial path (the megabatch supports neither), and so does the
+        deficit-sizing regime: the batched path structurally disables
+        deficit-aware count-goal sizing, and a fleet-wired deployment
+        must not return different proposals than a standalone one for
+        the same cluster state."""
+        width = self.megabatch_solve_width
+        if width and not options.fast_mode \
+                and self._optimizer.mesh is None \
+                and not self._optimizer.deficit_sizing_active(
+                    state.num_brokers):
+            from .utils.sensors import current_cluster_label
+            cid = current_cluster_label() or "default"
+            out = self._optimizer.optimizations_megabatch(
+                [(state, meta, cid, options)], goals=list(chain),
+                width=width)
+            res = out[0]
+            if isinstance(res, Exception):
+                raise res
+            return res
+        return self._optimizer.optimizations(state, meta, chain, options)
+
     # -- megabatch precompute seams (fleet.megabatch) ----------------------
     def precompute_inputs(self):
         """(chain, state, meta, options, generation) for a DEFAULT-chain
@@ -891,8 +930,7 @@ class CruiseControl:
             is_triggered_by_goal_violation=not is_triggered_by_user_request,
             fast_mode=fast_mode)
         options = self._with_config_excluded_topics(meta, options)
-        _final, result = self._optimizer.optimizations(
-            state, meta, chain, options)
+        _final, result = self._optimize(state, meta, chain, options)
         executed = self._maybe_execute(result, dryrun, "rebalance", reason, uuid)
         return OperationResult("rebalance", dryrun, result, result.proposals,
                                executed, reason)
@@ -914,8 +952,7 @@ class CruiseControl:
         state = self._mark_brokers(state, meta, broker_ids, BrokerState.NEW)
         options = self._with_config_excluded_topics(
             meta, OptimizationOptions(fast_mode=fast_mode))
-        _final, result = self._optimizer.optimizations(
-            state, meta, chain, options)
+        _final, result = self._optimize(state, meta, chain, options)
         executed = self._maybe_execute(result, dryrun, "add_broker", reason, uuid)
         if executed:
             # An added broker is a live destination again: clear any
@@ -947,8 +984,7 @@ class CruiseControl:
                 excluded_brokers_for_replica_move=tuple(broker_ids),
                 excluded_brokers_for_leadership=tuple(broker_ids),
                 fast_mode=fast_mode))
-        _final, result = self._optimizer.optimizations(
-            state, meta, chain, options)
+        _final, result = self._optimize(state, meta, chain, options)
         executed = self._maybe_execute(result, dryrun, "remove_broker", reason, uuid)
         if executed:
             self._history_record(self._removal_history, broker_ids)
@@ -1033,8 +1069,7 @@ class CruiseControl:
         options = self._with_config_excluded_topics(
             meta, OptimizationOptions(only_move_immigrant_replicas=False,
                                       fast_mode=fast_mode))
-        _final, result = self._optimizer.optimizations(
-            state, meta, chain, options)
+        _final, result = self._optimize(state, meta, chain, options)
         executed = self._maybe_execute(result, dryrun, "fix_offline_replicas",
                                        reason, uuid)
         return OperationResult("fix_offline_replicas", dryrun, result,

@@ -1,40 +1,42 @@
 """Megabatch fleet solver: one donated device program, whole buckets of
-clusters.
+clusters — and, since round 15, whatever ELSE is batchable in the same
+scheduler turn.
 
 PR 1's fleet layer multiplexes clusters through a fair scheduler — one
 cluster per device program, throughput scaling with threads. This module
-is ROADMAP item 3's fix: same-bucket clusters stack along a leading
-cluster axis and solve in ONE donated megastep dispatch
-(analyzer.chain's ``megabatch_*`` kernels, the Podracer/Anakin
-keep-everything-on-device discipline applied fleet-wide). Compile once
-per bucket shape, amortize across the fleet; a batched pass costs
-max-over-clusters rounds instead of the serial sum.
+is ROADMAP item 3's fix: same-bucket work stacks along a leading cluster
+axis and solves in ONE donated megastep dispatch (analyzer.chain's
+``megabatch_*`` kernels, the Podracer/Anakin keep-everything-on-device
+discipline applied fleet-wide). Compile once per bucket shape, amortize
+across the fleet; a batched pass costs max-over-clusters rounds instead
+of the serial sum.
 
-The pieces:
+The payload protocol (round 15 generalization): a coalesced job's
+``payload`` prepares a list of ``SolveItem``s on the worker thread and
+reassembles its own result from their outcomes —
 
-- ``precompute_batch_key``: the pacer-side coalescing HINT — last-seen
-  bucket shape plus a solver-config fingerprint. Exact compatibility is
-  re-verified after the models are built (shapes can drift between the
-  hint and the build); incompatible stragglers fall back to their own
-  batched solve at occupancy 1.
-- ``PrecomputePayload``: what a batchable precompute job carries — the
-  cluster's facade, whose ``precompute_inputs``/``store_precomputed``
-  seams bracket the batched solve exactly like a solo ``proposals()``
-  call.
-- ``MegabatchRunner``: the scheduler's batch runner. Builds every
-  coalesced job's model on the worker thread, groups by ACTUAL
-  compatibility — (padded bucket shape incl. the replica-slot axis,
-  ``num_topics``, the resolved goal chain, options) — pads each group to
-  the configured batch width with inert zero-weight cluster slots (one
-  compiled program per bucket shape serves any occupancy), solves via
-  ``GoalOptimizer.optimizations_megabatch``, writes each cluster's
-  OptimizerResult back into its proposal cache, and splits per-cluster
-  dispatch accounting out of the batched readback
-  (``fleet_precompute_dispatches{cluster=}``).
+- ``payload.prepare(optimizer) -> list[SolveItem]`` builds the models
+  (may raise: exactly that job's future fails, batchmates proceed);
+- the runner flattens items ACROSS jobs, groups by actual compatibility
+  (padded bucket shape, static topic axis, resolved goal chain — options
+  are per-item now, carried into per-cluster exclusion masks), chunks to
+  the configured width, and solves each chunk through
+  ``GoalOptimizer.optimizations_megabatch``;
+- ``payload.complete(outcomes, stats) -> result`` receives its items'
+  aligned outcomes (``(final_state, OptimizerResult)`` or the per-item
+  Exception) plus the split per-item dispatch stats, and returns the
+  job future's value (or raises to fail it).
 
-Failure containment mirrors the serial scheduler: a cluster whose model
-build or solve fails gets exactly its own future failed (and its breaker
-debited by the scheduler); batchmates proceed.
+Two payloads ship in-tree: ``PrecomputePayload`` (a paced proposal
+precompute — stores a cache entry indistinguishable from a solo
+``proposals()`` call) and ``futures.evaluator.FuturesPayload`` (a
+COMPARE_FUTURES request whose candidate futures coalesce with the
+precomputes sharing the turn — batch occupancy driven by user traffic,
+not fleet size).
+
+Failure containment mirrors the serial scheduler: a job whose prepare or
+solve fails gets exactly its own future failed (and its breaker debited
+by the scheduler); batchmates proceed.
 """
 
 from __future__ import annotations
@@ -53,14 +55,17 @@ def solver_config_fingerprint(config) -> tuple:
     from its own base config, so only the goal-chain spec (which per-
     cluster overlays CAN change) needs fingerprinting here; exact chain
     equality — broker-set bindings included — is re-checked per batch by
-    ``GoalOptimizer.optimizations_megabatch``."""
+    the runner's grouping."""
     return tuple(str(g) for g in config.get_list("goals"))
 
 
 def precompute_batch_key(entry) -> tuple | None:
     """Coalescing hint for one cluster's paced precompute, or None when
     the cluster has no recorded bucket yet (its first model build will
-    run solo and record one)."""
+    run solo and record one). COMPARE_FUTURES jobs reuse the same key so
+    a futures request drains into the same runner turn as the bucket's
+    precomputes (the runner regroups by ACTUAL compatibility, so the
+    futures' twin-shaped models simply form their own chunks)."""
     if entry.bucket is None:
         return None
     return ("precompute", entry.bucket,
@@ -68,11 +73,59 @@ def precompute_batch_key(entry) -> tuple | None:
 
 
 @dataclasses.dataclass
+class SolveItem:
+    """One batched-solve slot a payload contributes: a model, its
+    resolved goal chain, and its OWN options (per-item exclusion sets
+    ride the batched mask assembler). ``item_id`` labels the slot's
+    flight pass / sensors (a cluster id, or ``future:<id>``)."""
+
+    item_id: str
+    chain: tuple
+    state: Any
+    meta: Any
+    options: Any = None
+
+
+@dataclasses.dataclass
 class PrecomputePayload:
-    """Batchable precompute job payload (SolverJob.payload)."""
+    """Batchable precompute job payload (SolverJob.payload): one cache
+    fill, bracketed by the facade's precompute_inputs/store_precomputed
+    seams exactly like a solo ``proposals()`` call."""
 
     cluster_id: str
     cc: Any  # CruiseControl
+
+    def prepare(self, optimizer) -> list[SolveItem]:
+        chain, state, meta, options, gen = self.cc.precompute_inputs()
+        self._generation = gen
+        return [SolveItem(
+            item_id=self.cluster_id,
+            chain=tuple(optimizer.megabatch_chain(meta, chain)),
+            state=state, meta=meta, options=options)]
+
+    def complete(self, outcomes: list, stats: list):
+        from ..facade import OperationResult
+        from ..utils.sensors import SENSORS
+        res = outcomes[0]
+        if isinstance(res, Exception):
+            raise res
+        _final, result = res
+        self.cc.store_precomputed(self._generation, result)
+        # Per-cluster dispatch accounting, split out of the batched
+        # readback — the megabatch analogue of the pacer's thread-local
+        # attribution (the batched solve ran on THIS worker thread, so
+        # the split is exactly this batch's).
+        ds = stats[0] or {}
+        if ds.get("dispatch_count"):
+            SENSORS.gauge("fleet_precompute_dispatches",
+                          ds["dispatch_count"],
+                          labels={"cluster": self.cluster_id})
+            SENSORS.gauge("fleet_precompute_rounds_per_dispatch_p50",
+                          ds["rounds_per_dispatch_p50"],
+                          labels={"cluster": self.cluster_id})
+        return OperationResult(
+            "proposals", dryrun=True, optimizer_result=result,
+            proposals=result.proposals, reason="megabatch precompute")
 
 
 class MegabatchRunner:
@@ -114,51 +167,64 @@ class MegabatchRunner:
     # -- the batch body ----------------------------------------------------
     def __call__(self, jobs: list) -> None:
         from ..utils.sensors import SENSORS
-        prepared: list[tuple] = []
+        prepared: list[tuple] = []     # (job, payload, outcomes, stats)
+        flat: list[tuple] = []         # (prepared_idx, slot, SolveItem)
         for job in jobs:
             payload = job.payload
             try:
-                chain, state, meta, options, gen = \
-                    payload.cc.precompute_inputs()
+                entries = payload.prepare(self._optimizer)
             except Exception as e:  # noqa: BLE001 — fail THIS job only
                 with self._lock:
                     self.build_failures += 1
                 job.future.set_exception(e)
                 continue
-            resolved = tuple(self._optimizer.megabatch_chain(meta, chain))
-            key = (state.num_partitions, state.num_brokers,
-                   state.max_replication_factor, meta.num_topics,
-                   resolved, options)
-            prepared.append((job, payload, resolved, state, meta, options,
-                            gen, key))
+            pidx = len(prepared)
+            prepared.append((job, payload,
+                             [None] * len(entries), [None] * len(entries)))
+            for slot, item in enumerate(entries):
+                flat.append((pidx, slot, item))
 
-        groups: dict = {}
-        for item in prepared:
-            groups.setdefault(item[-1], []).append(item)
-        for key, members in groups.items():
+        groups: dict[tuple, list[tuple]] = {}
+        for pidx, slot, item in flat:
+            key = (self._shape_key(item.state), item.meta.num_topics,
+                   item.chain)
+            groups.setdefault(key, []).append((pidx, slot, item))
+        for members in groups.values():
             for start in range(0, len(members), self._width):
-                self._solve_chunk(members[start:start + self._width])
+                self._solve_chunk(prepared, members[start:start + self._width])
         SENSORS.gauge("fleet_megabatch_width", self._width)
 
-    def _solve_chunk(self, members: list[tuple]) -> None:
-        from ..facade import OperationResult
+        for job, payload, outcomes, stats in prepared:
+            try:
+                value = payload.complete(outcomes, stats)
+            except Exception as e:  # noqa: BLE001 — carried by the future
+                job.future.set_exception(e)
+            else:
+                job.future.set_result(value)
+
+    @staticmethod
+    def _shape_key(state) -> tuple:
+        import jax
+        return tuple(jax.tree_util.tree_leaves(
+            jax.tree.map(lambda x: tuple(x.shape), state)))
+
+    def _solve_chunk(self, prepared: list[tuple],
+                     members: list[tuple]) -> None:
         from ..utils.sensors import SENSORS
-        items = [(state, meta, payload.cluster_id)
-                 for (_j, payload, _c, state, meta, _o, _g, _k) in members]
-        chain = members[0][2]
-        options = members[0][5]
+        chain = members[0][2].chain
+        items = [(item.state, item.meta, item.item_id, item.options)
+                 for (_p, _s, item) in members]
         try:
             results = self._optimizer.optimizations_megabatch(
-                items, goals=list(chain), options=options,
-                width=self._width)
-        except Exception as e:  # noqa: BLE001 — a batch-level failure
-            # fails exactly the chunk's futures; other chunks proceed
-            LOG.warning("fleet: megabatch solve of %d clusters failed: %s",
+                items, goals=list(chain), width=self._width)
+            split = self._optimizer.last_megabatch_cluster_stats()
+        except Exception as e:  # noqa: BLE001 — a chunk-level failure
+            # fails exactly the chunk's slots; other chunks proceed
+            LOG.warning("fleet: megabatch solve of %d models failed: %s",
                         len(members), e)
-            for (job, *_rest) in members:
-                job.future.set_exception(e)
+            for (pidx, slot, _item) in members:
+                prepared[pidx][2][slot] = e
             return
-        split = self._optimizer.last_megabatch_cluster_stats()
         occupancy = len(members)
         with self._lock:
             self.batches_solved += 1
@@ -167,25 +233,13 @@ class MegabatchRunner:
             self._occupancy_sum += occupancy
         SENSORS.count("fleet_megabatch_batches")
         SENSORS.count("fleet_megabatch_clusters", occupancy)
-        for (job, payload, _c, _s, _m, _o, gen, _k), res in \
-                zip(members, results):
-            if isinstance(res, Exception):
-                job.future.set_exception(res)
-                continue
-            _final, result = res
-            payload.cc.store_precomputed(gen, result)
-            # Per-cluster dispatch accounting, split out of the batched
-            # readback — the megabatch analogue of the pacer's
-            # thread-local attribution (the batched solve ran on THIS
-            # worker thread, so the split is exactly this batch's).
-            ds = split.get(payload.cluster_id) or {}
-            if ds.get("dispatch_count"):
-                SENSORS.gauge("fleet_precompute_dispatches",
-                              ds["dispatch_count"],
-                              labels={"cluster": payload.cluster_id})
-                SENSORS.gauge("fleet_precompute_rounds_per_dispatch_p50",
-                              ds["rounds_per_dispatch_p50"],
-                              labels={"cluster": payload.cluster_id})
-            job.future.set_result(OperationResult(
-                "proposals", dryrun=True, optimizer_result=result,
-                proposals=result.proposals, reason="megabatch precompute"))
+        for (pidx, slot, item), res in zip(members, results):
+            prepared[pidx][2][slot] = res
+            # Per-item stats carry the chunk geometry that ACTUALLY ran
+            # (payloads report occupancy from execution, never from a
+            # re-derivation that could drift from the runner's chunking).
+            prepared[pidx][3][slot] = {
+                **(split.get(item.item_id) or {}),
+                "batch_occupancy": occupancy,
+                "batch_width": self._width,
+            }

@@ -178,6 +178,7 @@ class FleetRegistry:
                     self._entries.pop(cluster_id, None)
                 cc.load_monitor.model_transform = None
                 cc.anomaly_detector.fix_runner = None
+                cc.megabatch_solve_width = 0
                 if owns:
                     try:
                         cc.shutdown()
@@ -208,6 +209,14 @@ class FleetRegistry:
             return padded, meta
 
         entry.cc.load_monitor.model_transform = pad_hook
+        # Megabatch everywhere (ROADMAP item 3c tail): with coalescing
+        # on, the facade's own goal-chain solves — self-healing fixes and
+        # on-demand operations — run through the batched kernels at
+        # occupancy 1, reusing the ONE compiled program per bucket shape
+        # the coalesced precompute fills already pay for (per-request
+        # exclusion options ride the batched mask assembler).
+        if self._megabatch is not None:
+            entry.cc.megabatch_solve_width = self._megabatch.width
         if self._scheduler is not None:
             sched, cid = self._scheduler, entry.cluster_id
 
@@ -255,6 +264,7 @@ class FleetRegistry:
         # submitting fixes to a scheduler it no longer belongs to.
         entry.cc.load_monitor.model_transform = None
         entry.cc.anomaly_detector.fix_runner = None
+        entry.cc.megabatch_solve_width = 0
         if entry.owns_cc:
             try:
                 entry.cc.shutdown()

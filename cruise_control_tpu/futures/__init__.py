@@ -1,0 +1,19 @@
+"""Futures engine (round 15): batched what-if scenario evaluation as a
+serving workload.
+
+- ``generator``: seeded randomized scenario templates over the digital
+  twin's ``DriftSpec``/event machinery — every sampled future is a pure
+  function of ``(template, seed)``.
+- ``evaluator``: advances each candidate future's twin to its decision
+  point, then solves ALL same-bucket futures in one megabatch-style
+  device program and serves ranked ``ScenarioScore``-style comparisons.
+"""
+
+from .generator import (  # noqa: F401
+    FUTURE_TEMPLATES, SampledFuture, present_future, sample_future,
+    sample_scenario,
+)
+from .evaluator import (  # noqa: F401
+    PRESENT, FutureSpec, FuturesPayload, compare_futures, evaluate_prepared,
+    plan_futures, prepare_future, rank_results,
+)

@@ -1,0 +1,472 @@
+"""Batched futures evaluator: dozens of candidate futures, one solve.
+
+Round 11 answers "what if?" by replaying ONE scenario serially on a twin
+(every tick pays its own detector/solver cycle). This module turns
+scenario evaluation into a batched device workload (ROADMAP item 5):
+
+1. **Advance** — each candidate future gets its own digital twin
+   (``testing/simulator.py`` with anomaly detection off: the advance
+   phase is pure simulation, no solver work) and runs to its decision
+   point: load-shaping events applied, drift sampled, the monitor's
+   windows filled on the injected clock.
+2. **Decide** — each future's decision-point mutations (brokers dying or
+   draining in that future) are marked on its cluster model exactly like
+   the facade's remove/add operations, with matching per-future
+   exclusion options.
+3. **Solve** — all same-bucket futures stack through
+   ``GoalOptimizer.optimizations_megabatch`` (per-item options ride the
+   batched mask assembler; inert pad slots mean ONE compiled program per
+   bucket shape serves any occupancy) instead of solving serially.
+4. **Rank** — per-future ``ScenarioScore``-style dicts, ranked best
+   balancedness first with byte-stable tie-breaks, each carrying score
+   deltas vs the ``present`` baseline future.
+
+Determinism contract (CCSA004 scope): the response body contains NO
+wall-clock-derived values — same ``(templates, seed, ticks)`` request ⇒
+byte-identical ranked JSON, batched or serial, at any occupancy. Wall
+time goes to sensors/spans only.
+
+``FuturesPayload`` adapts a COMPARE_FUTURES request to the fleet's
+``MegabatchRunner`` payload protocol, so a futures request queued behind
+(or beside) paced precomputes coalesces into the same scheduler turn —
+the first workload where batch occupancy is driven by user traffic
+rather than fleet size.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Mapping, Sequence
+
+import numpy as np
+
+PRESENT = "present"
+
+#: Twin overrides for the advance phase: detection/self-healing OFF (the
+#: decision solve is the only solver work a future costs) and no
+#: proposal probes (there is no serving path inside an advance twin).
+_ADVANCE_OVERRIDES = {
+    "self.healing.enabled": False,
+    "anomaly.detection.interval.ms": 10 ** 12,
+    "metric.anomaly.detection.interval.ms": 10 ** 12,
+    "scenario.proposal.probe.ticks": 0,
+}
+
+#: The monitor needs its window count filled before a model build; the
+#: twin fills one window per tick.
+_MIN_TICKS = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class FutureSpec:
+    """One requested future: which template, which seed, how far to
+    advance before the decision solve."""
+
+    template: str
+    seed: int = 0
+    ticks: int = 12
+
+    @property
+    def future_id(self) -> str:
+        if self.template == PRESENT:
+            return PRESENT
+        return f"{self.template}:{self.seed}"
+
+
+def plan_futures(templates: Sequence[str], num_futures: int, seed: int,
+                 ticks: int) -> list[FutureSpec]:
+    """Expand a request into concrete (template, seed) pairs: templates
+    round-robin, seeds advance once per full cycle — every row of the
+    answer is independently replayable via
+    ``?what_if=random:<template>:<seed>``. Duplicate template names are
+    dropped (order-preserving): repeating a template cannot mean
+    anything but re-solving the identical future, and colliding
+    future ids would corrupt the ranked answer."""
+    from .generator import _unknown, FUTURE_TEMPLATES
+    templates = list(dict.fromkeys(templates)) or sorted(FUTURE_TEMPLATES)
+    for t in templates:
+        if t not in FUTURE_TEMPLATES:
+            raise _unknown(t)
+    ticks = max(_MIN_TICKS, int(ticks))
+    return [FutureSpec(templates[i % len(templates)],
+                       seed + i // len(templates), ticks)
+            for i in range(max(1, int(num_futures)))]
+
+
+@dataclasses.dataclass
+class PreparedFuture:
+    """A future advanced to its decision point: the model to solve, the
+    per-future options, and the advance-phase bookkeeping that goes into
+    its score."""
+
+    spec: FutureSpec
+    config: Any                       # the twin's CruiseControlConfig
+    chain: tuple                      # goal chain (unresolved)
+    state: Any                        # ClusterTensors at the decision point
+    meta: Any                         # ClusterMeta
+    options: Any                      # OptimizationOptions (per-future)
+    events: list[dict]                # advance events actually applied
+    decision: dict                    # {"removeBrokers": [...], ...}
+    disk_mb: np.ndarray               # [P] per-partition disk footprint
+
+    @property
+    def future_id(self) -> str:
+        return self.spec.future_id
+
+
+def prepare_future(fspec: FutureSpec, optimizer=None,
+                   config_overrides: Mapping | None = None,
+                   ) -> PreparedFuture:
+    """Advance one future's twin to its decision point and build the
+    model + options its batched solve slot needs. Host-side work only —
+    no device program runs here."""
+    from ..analyzer.constraint import OptimizationOptions
+    from ..analyzer.optimizer import goals_by_priority
+    from ..common.broker_state import BrokerState
+    from ..model.tensors import set_broker_state
+    from ..testing.simulator import ClusterSimulator
+    from .generator import present_future, sample_future
+
+    sampled = present_future() if fspec.template == PRESENT \
+        else sample_future(fspec.template, fspec.seed)
+    ticks = max(_MIN_TICKS, int(fspec.ticks))
+    adv_events = sampled.advance_events(ticks)
+    spec = dataclasses.replace(sampled.spec, ticks=ticks,
+                               events=adv_events, generators=())
+    overrides = {**_ADVANCE_OVERRIDES, **dict(config_overrides or {})}
+    sim = ClusterSimulator(spec, seed=fspec.seed,
+                           config_overrides=overrides, optimizer=optimizer)
+    sim.advance(ticks)
+    state, meta = sim.cc.load_monitor.cluster_model()
+
+    idx = {bid: i for i, bid in enumerate(meta.broker_ids)}
+    removed = tuple(b for b in sampled.remove_brokers if b in idx)
+    added = tuple(b for b in sampled.add_brokers if b in idx)
+    for b in removed:
+        state = set_broker_state(state, np.int32(idx[b]),
+                                 int(BrokerState.DEAD))
+    for b in added:
+        state = set_broker_state(state, np.int32(idx[b]),
+                                 int(BrokerState.NEW))
+    options = OptimizationOptions(
+        excluded_brokers_for_replica_move=removed,
+        excluded_brokers_for_leadership=removed)
+
+    from ..common.resources import Resource
+    disk_mb = np.asarray(state.leader_load[:, int(Resource.DISK)])
+    return PreparedFuture(
+        spec=fspec, config=sim.config,
+        chain=tuple(goals_by_priority(sim.config)),
+        state=state, meta=meta, options=options,
+        events=[e.as_dict() for e in sim.events],
+        decision={"removeBrokers": sorted(removed),
+                  "addBrokers": sorted(added)},
+        disk_mb=disk_mb)
+
+
+@dataclasses.dataclass
+class FutureResult:
+    """One future's scored decision solve (the per-future ScenarioScore
+    of the COMPARE_FUTURES response). ``error`` futures rank last."""
+
+    future_id: str
+    template: str
+    seed: int
+    ticks: int
+    events_applied: int
+    decision: dict
+    error: str | None = None
+    balancedness_before: float | None = None
+    balancedness_after: float | None = None
+    violated_goals_before: list[str] = dataclasses.field(default_factory=list)
+    violated_goals_after: list[str] = dataclasses.field(default_factory=list)
+    num_proposals: int = 0
+    replica_moves: int = 0
+    leader_moves: int = 0
+    bytes_to_move_mb: float = 0.0
+    rank: int = 0
+    delta_vs_present: dict | None = None
+
+    def sort_key(self) -> tuple:
+        # Best balancedness first; among equals, the cheaper future
+        # (fewer bytes, then proposals) wins; the id breaks exact ties
+        # byte-stably. Errors rank last.
+        bal = -1.0 if self.error is not None else self.balancedness_after
+        return (-bal, self.bytes_to_move_mb, self.num_proposals,
+                self.future_id)
+
+    def score_dict(self) -> dict:
+        return {
+            "balancednessBefore": self.balancedness_before,
+            "balancednessAfter": self.balancedness_after,
+            "violatedGoalsBefore": self.violated_goals_before,
+            "violatedGoalsAfter": self.violated_goals_after,
+            "numProposals": self.num_proposals,
+            "replicaMoves": self.replica_moves,
+            "leaderMoves": self.leader_moves,
+            "bytesToMoveMb": round(self.bytes_to_move_mb, 1),
+        }
+
+    def as_dict(self) -> dict:
+        out = {
+            "future": self.future_id,
+            "template": self.template,
+            "seed": self.seed,
+            "ticks": self.ticks,
+            "eventsApplied": self.events_applied,
+            "decision": self.decision,
+            "rank": self.rank,
+            "score": self.score_dict(),
+        }
+        if self.error is not None:
+            out["error"] = self.error
+        if self.delta_vs_present is not None:
+            out["deltaVsPresent"] = self.delta_vs_present
+        return out
+
+
+def _result_from(prepared: PreparedFuture, outcome) -> FutureResult:
+    base = FutureResult(
+        future_id=prepared.future_id, template=prepared.spec.template,
+        seed=prepared.spec.seed, ticks=prepared.spec.ticks,
+        events_applied=len(prepared.events), decision=prepared.decision)
+    if isinstance(outcome, Exception):
+        # Type name only: serial raises and batched slot-reconstructed
+        # exceptions agree on the class, which is what a ranked answer
+        # needs (full messages can differ in incidental detail).
+        base.error = type(outcome).__name__
+        return base
+    _final, res = outcome
+    replica = leader = 0
+    bytes_mb = 0.0
+    row_of = {tp: i for i, tp in enumerate(prepared.meta.partition_index)}
+    for p in res.proposals:
+        if p.is_leadership_only:
+            leader += 1
+        else:
+            replica += 1
+            row = row_of.get((p.topic, p.partition))
+            if row is not None:
+                bytes_mb += float(prepared.disk_mb[row])
+    base.balancedness_before = round(res.balancedness_before, 3)
+    base.balancedness_after = round(res.balancedness_after, 3)
+    base.violated_goals_before = list(res.violated_goals_before)
+    base.violated_goals_after = list(res.violated_goals_after)
+    base.num_proposals = len(res.proposals)
+    base.replica_moves = replica
+    base.leader_moves = leader
+    base.bytes_to_move_mb = bytes_mb
+    return base
+
+
+def _compat_key(optimizer, prepared: PreparedFuture) -> tuple:
+    """The megabatch grouping key: padded bucket shape + static topic
+    axis + resolved goal chain (the optimizations_megabatch
+    preconditions)."""
+    import jax
+    shapes = tuple(jax.tree_util.tree_leaves(
+        jax.tree.map(lambda x: tuple(x.shape), prepared.state)))
+    return (shapes, prepared.meta.num_topics,
+            tuple(optimizer.megabatch_chain(prepared.meta,
+                                            list(prepared.chain))))
+
+
+def evaluate_prepared(prepared: Sequence[PreparedFuture], optimizer,
+                      width: int = 8, batched: bool = True,
+                      occupancies: list[int] | None = None,
+                      ) -> list[FutureResult]:
+    """Solve every prepared future's decision model and score it.
+
+    ``batched=True`` groups same-bucket futures and solves each group
+    through ``optimizations_megabatch`` in chunks of ``width`` (one
+    compiled program per bucket shape serves any occupancy; per-future
+    flight passes land under ``cluster=future:<id>`` in ``GET /solver``).
+    ``batched=False`` is the serial reference replay — byte-identical
+    results, one device program per future (the parity pin in
+    tests/test_futures.py). Results align with ``prepared`` by POSITION
+    (ids are labels, not keys). When ``occupancies`` is given, the chunk
+    occupancies actually solved are appended to it — the response-body
+    report comes from the execution itself, never a re-derivation."""
+    from ..utils.sensors import SENSORS
+    results: list[FutureResult | None] = [None] * len(prepared)
+    if batched:
+        groups: dict[tuple, list[int]] = {}
+        for i, p in enumerate(prepared):
+            groups.setdefault(_compat_key(optimizer, p), []).append(i)
+        for members in groups.values():
+            chain = list(prepared[members[0]].chain)
+            for start in range(0, len(members), max(1, int(width))):
+                chunk = members[start:start + max(1, int(width))]
+                items = [(prepared[i].state, prepared[i].meta,
+                          f"future:{prepared[i].future_id}",
+                          prepared[i].options) for i in chunk]
+                out = optimizer.optimizations_megabatch(
+                    items, goals=chain, width=width)
+                SENSORS.observe("futures_batch_occupancy",
+                                float(len(chunk)),
+                                buckets=(1, 2, 4, 8, 16, 32, 64))
+                if occupancies is not None:
+                    occupancies.append(len(chunk))
+                for i, outcome in zip(chunk, out):
+                    results[i] = _result_from(prepared[i], outcome)
+    else:
+        for i, p in enumerate(prepared):
+            try:
+                outcome = optimizer.optimizations(
+                    p.state, p.meta, list(p.chain), p.options)
+            except Exception as e:  # noqa: BLE001 — scored, ranked last
+                outcome = e
+            results[i] = _result_from(p, outcome)
+            if occupancies is not None:
+                occupancies.append(1)
+    return results
+
+
+def rank_results(results: Sequence[FutureResult]) -> list[FutureResult]:
+    """Rank candidate futures (present excluded from the ranking — it is
+    the baseline) and attach score deltas vs the present solve."""
+    present = next((r for r in results if r.future_id == PRESENT), None)
+    ranked = sorted((r for r in results if r.future_id != PRESENT),
+                    key=FutureResult.sort_key)
+    for i, r in enumerate(ranked):
+        r.rank = i + 1
+        if present is not None and r.error is None \
+                and present.error is None:
+            r.delta_vs_present = {
+                "balancednessAfter": round(
+                    r.balancedness_after - present.balancedness_after, 3),
+                "numProposals": r.num_proposals - present.num_proposals,
+                "bytesToMoveMb": round(
+                    r.bytes_to_move_mb - present.bytes_to_move_mb, 1),
+            }
+    return ranked
+
+
+def _response_body(plan: list[FutureSpec], ranked: list[FutureResult],
+                   present: FutureResult | None, batched: bool,
+                   width: int, occupancies: list[int]) -> dict:
+    return {
+        "operation": "compare_futures", "dryrun": True, "executed": False,
+        "numFutures": len(plan),
+        "ticks": plan[0].ticks if plan else 0,
+        "batched": batched,
+        "batchWidth": width,
+        "occupancies": occupancies,
+        "present": present.as_dict() if present is not None else None,
+        "futures": [r.as_dict() for r in ranked],
+    }
+
+
+def compare_futures(templates: Sequence[str] | None = None,
+                    num_futures: int = 8, seed: int = 0, ticks: int = 12,
+                    optimizer=None, width: int = 8, batched: bool = True,
+                    include_present: bool = True,
+                    config_overrides: Mapping | None = None) -> dict:
+    """Evaluate a batch of candidate futures end to end and return the
+    ranked comparison body (the COMPARE_FUTURES response). Never touches
+    the serving cluster: every future runs on its own twin, and the only
+    device work is the (batched) decision solve."""
+    from ..analyzer.optimizer import GoalOptimizer
+    from ..utils.sensors import SENSORS
+    from ..utils.tracing import TRACER
+    plan = plan_futures(templates or (), num_futures, seed, ticks)
+    specs = list(plan)
+    if include_present:
+        specs = specs + [FutureSpec(PRESENT, 0, plan[0].ticks)]
+    # ccsa: ok[CCSA004] observability-only timers (sensor/span); nothing
+    # wall-clock-derived enters the response body, so byte-identical
+    # ranked JSON holds at one (templates, seed, ticks) request
+    t0 = time.perf_counter()
+    with TRACER.span("futures.evaluate", operation="futures",
+                     num_futures=len(plan), ticks=plan[0].ticks,
+                     batched=batched) as sp:
+        prepared = []
+        for fs in specs:
+            prepared.append(prepare_future(
+                fs, optimizer=optimizer, config_overrides=config_overrides))
+        if optimizer is None:
+            optimizer = GoalOptimizer(prepared[0].config)
+        # ccsa: ok[CCSA004] observability-only timer (see t0)
+        prep_s = time.perf_counter() - t0
+        SENSORS.record_timer("futures_prepare", prep_s)
+        occupancies: list[int] = []
+        results = evaluate_prepared(prepared, optimizer, width=width,
+                                    batched=batched,
+                                    occupancies=occupancies)
+        ranked = rank_results(results)
+        present = next((r for r in results if r.future_id == PRESENT),
+                       None)
+        sp.set(occupancies=",".join(str(o) for o in occupancies),
+               errors=sum(1 for r in results if r.error))
+    SENSORS.count("futures_requests")
+    SENSORS.count("futures_evaluated", len(plan))
+    # ccsa: ok[CCSA004] observability-only timer (see t0)
+    SENSORS.record_timer("futures_evaluate", time.perf_counter() - t0)
+    return _response_body(plan, ranked, present, batched, width,
+                          occupancies)
+
+
+class FuturesPayload:
+    """MegabatchRunner payload for a fleet-scheduled COMPARE_FUTURES job:
+    the request's futures prepare on the worker thread and their decision
+    solves coalesce with whatever same-bucket work (paced precomputes,
+    other futures requests) shares the scheduler turn — batch occupancy
+    driven by user traffic, not fleet size."""
+
+    def __init__(self, cluster_id: str,
+                 templates: Sequence[str] | None, num_futures: int,
+                 seed: int, ticks: int, include_present: bool = True,
+                 wrap: Callable[[dict], Any] | None = None):
+        self.cluster_id = cluster_id
+        self._plan = plan_futures(templates or (), num_futures, seed, ticks)
+        self._include_present = include_present
+        self._wrap = wrap
+        self._prepared: list[PreparedFuture] = []
+
+    def prepare(self, optimizer) -> list:
+        from ..fleet.megabatch import SolveItem
+        specs = list(self._plan)
+        if self._include_present:
+            specs = specs + [FutureSpec(PRESENT, 0, self._plan[0].ticks)]
+        self._prepared = [prepare_future(fs, optimizer=optimizer)
+                          for fs in specs]
+        return [SolveItem(item_id=f"future:{p.future_id}",
+                          chain=tuple(optimizer.megabatch_chain(
+                              p.meta, list(p.chain))),
+                          state=p.state, meta=p.meta, options=p.options)
+                for p in self._prepared]
+
+    def complete(self, outcomes: list, stats: list) -> Any:
+        from ..utils.sensors import SENSORS
+        results = [_result_from(p, o)
+                   for p, o in zip(self._prepared, outcomes)]
+        ranked = rank_results(results)
+        present = next((r for r in results if r.future_id == PRESENT),
+                       None)
+        # Chunk occupancies reconstructed from the runner's per-item
+        # execution stats (batch_occupancy k appears once per k items of
+        # that chunk; a residue means a chunk SHARED with coalesced
+        # batchmates — e.g. precomputes — and still counts once). The
+        # report reflects what ran, whichever scheduling path ran it.
+        occs: list[int] = []
+        counts: dict[int, int] = {}
+        width = None
+        for ds in stats:
+            ds = ds or {}
+            width = ds.get("batch_width", width)
+            k = ds.get("batch_occupancy")
+            if k:
+                counts[k] = counts.get(k, 0) + 1
+                if counts[k] == k:
+                    occs.append(k)
+                    counts[k] = 0
+        occs.extend(k for k, c in counts.items() if c)
+        SENSORS.count("futures_requests")
+        SENSORS.count("futures_evaluated", len(self._plan))
+        for k in occs:
+            SENSORS.observe("futures_batch_occupancy", float(k),
+                            buckets=(1, 2, 4, 8, 16, 32, 64))
+        body = _response_body(self._plan, ranked, present, True,
+                              width or len(self._prepared), occs)
+        return self._wrap(body) if self._wrap is not None else body

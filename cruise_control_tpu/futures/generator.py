@@ -1,0 +1,339 @@
+"""Seeded randomized scenario generator: candidate *futures* of a cluster.
+
+Round 11's canonical library is six hand-written scenarios; this module
+grows the scenario-diversity axis ROADMAP item 5 names — heterogeneous
+capacities, cascading broker failures, partition-churn storms,
+maintenance plans, forecast-percentile load ramps — as TEMPLATES whose
+concrete parameters (which broker dies, how hot the ramp runs, when the
+churn lands) are sampled from a seed.
+
+Determinism contract (the same one ``testing/simulator.py`` carries, and
+the reason this module sits under CCSA004): every sampler is a pure
+function of ``(template, seed)`` via crc32 derivation — no wall clock, no
+``random`` module, no ``hash()`` — so a sampled scenario is byte-for-byte
+reproducible from its ``(template, seed)`` pair, a ``?what_if=
+random:<template>:<seed>`` replay returns the same score JSON every
+time, and the CI matrix can pin sampled rows like canonical ones.
+
+Two consumers with two views of one sample:
+
+- ``sample_scenario(template, seed)`` → a full ``ScenarioSpec`` for the
+  digital twin's COMPLETE loop (detection + self-healing on): the
+  ``?what_if=random:...`` replay path and the CI SCENARIO_MATRIX rows.
+- ``sample_future(template, seed, ticks)`` → a ``SampledFuture``: the
+  load-shaping events rescaled into the evaluator's (short) advance
+  horizon plus the DECISION-POINT mutations (brokers to mark dead/new at
+  the batched solve) — ``futures/evaluator.py``'s input. Fault and
+  maintenance content lives in the decision mutations there, because the
+  evaluator advances its twins with detection off and asks "what would
+  the solver propose if this future arrived now?".
+
+All templates share one cluster geometry (``BASE_SPEC``) so every
+sampled future pads to the SAME bucket shape and the evaluator can stack
+dozens of them through one compiled megabatch program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Callable
+
+from ..testing.simulator import (
+    DriftSpec, ScenarioEvent, ScenarioSpec, _hash01,
+)
+
+#: Shared geometry: every template (and the "present" baseline) uses this
+#: spec, so all sampled futures share one padded bucket shape — the
+#: megabatch grouping precondition. Topic count is FIXED (churn is
+#: partition-expansion only) because ``num_topics`` is a static solver
+#: argument: creating topics would split futures into separate programs.
+BASE_SPEC = ScenarioSpec(
+    name="present",
+    description="The cluster as it is: no injected events, no drift.",
+    num_brokers=6, num_topics=4, partitions_per_topic=12, rf=2,
+    num_racks=3, ticks=60, tick_s=60.0,
+    # The futures goal chain adds a load-distribution goal to the twin's
+    # churn-sensitive default so load-shaped futures (ramps, hotspots,
+    # capacity skew) actually rank differently; shared across templates
+    # so the resolved chain is one grouping key.
+    config_overrides={
+        "goals": [
+            "cruise_control_tpu.analyzer.goals.RackAwareGoal",
+            "cruise_control_tpu.analyzer.goals.ReplicaCapacityGoal",
+            "cruise_control_tpu.analyzer.goals.DiskCapacityGoal",
+            "cruise_control_tpu.analyzer.goals."
+            "NetworkInboundUsageDistributionGoal",
+            "cruise_control_tpu.analyzer.goals.ReplicaDistributionGoal",
+        ],
+    })
+
+#: Event kinds the EVALUATOR replays during its advance phase (they shape
+#: the load/topology the decision solve sees). Fault/maintenance kinds are
+#: decision-point content there — the full-loop what-if replay keeps them
+#: as scripted events.
+ADVANCE_KINDS = ("set_load", "hotspot", "clear_hotspot",
+                 "expand_partitions")
+
+
+def _pick(seed: int, tag: str, n: int) -> int:
+    """Deterministic choice in [0, n) (PYTHONHASHSEED-stable)."""
+    return zlib.crc32(f"{seed}:{tag}".encode()) % max(1, n)
+
+
+@dataclasses.dataclass(frozen=True)
+class SampledFuture:
+    """One sampled candidate future of the cluster.
+
+    ``spec`` is the full-loop scenario (what-if replay / CI matrix);
+    ``remove_brokers``/``add_brokers`` are the decision-point mutations
+    the batched evaluator applies to the model before the solve (marked
+    DEAD/NEW exactly like the facade's remove/add operations, with the
+    removed brokers excluded from replica moves and leadership — the
+    per-future exclusion options that ride the megabatch mask
+    assembler)."""
+
+    template: str
+    seed: int
+    spec: ScenarioSpec
+    remove_brokers: tuple[int, ...] = ()
+    add_brokers: tuple[int, ...] = ()
+
+    @property
+    def future_id(self) -> str:
+        return f"{self.template}:{self.seed}"
+
+    def _rescaled_events(self, ticks: int,
+                         kinds: tuple[str, ...] | None = None,
+                         ) -> tuple[ScenarioEvent, ...]:
+        """Event times are proportional positions on the spec's horizon:
+        rescale them into a horizon of ``ticks`` (optionally filtered to
+        ``kinds``) so a shorter run sees the same STORY, compressed.
+        Pure in (self, ticks, kinds)."""
+        out = []
+        for e in self.spec.events:
+            if kinds is not None and e.kind not in kinds:
+                continue
+            t = min(ticks - 1, max(0, round(e.tick * ticks
+                                            / max(1, self.spec.ticks))))
+            out.append(ScenarioEvent(t, e.kind, e.params))
+        return tuple(sorted(out, key=lambda e: (e.tick, e.kind,
+                                                sorted(e.params.items()))))
+
+    def advance_events(self, ticks: int) -> tuple[ScenarioEvent, ...]:
+        """The load-shaping subset of the sampled events, rescaled into
+        the evaluator's advance horizon."""
+        return self._rescaled_events(ticks, ADVANCE_KINDS)
+
+    def replay_spec(self, ticks: int) -> ScenarioSpec:
+        """The FULL-loop spec compressed into ``ticks`` — every event
+        (faults and maintenance included) rescaled proportionally, so a
+        short serial replay evaluates the same story the evaluator's
+        advance horizon sees (the bench's apples-to-apples serial
+        baseline; plain truncation would silently drop late events)."""
+        return dataclasses.replace(self.spec, ticks=int(ticks),
+                                   events=self._rescaled_events(ticks))
+
+
+def _named(template: str, seed: int, description: str,
+           **changes) -> ScenarioSpec:
+    overrides = {**dict(BASE_SPEC.config_overrides),
+                 **changes.pop("config_overrides", {})}
+    return dataclasses.replace(
+        BASE_SPEC, name=f"random:{template}:{seed}",
+        description=description, config_overrides=overrides, **changes)
+
+
+# ---------------------------------------------------------------------------
+# Templates
+# ---------------------------------------------------------------------------
+
+def _load_ramp(seed: int) -> SampledFuture:
+    """Forecast-percentile load ramp: the cluster's next N hours under a
+    demand forecast — which percentile arrives, when the ramp lands, and
+    how hard the diurnal swing rides on top are all sampled."""
+    u = _hash01(seed, "ramp", "pct")
+    pct, factor = ("p50", 1.25) if u < 1 / 3 else \
+        ("p90", 1.7) if u < 2 / 3 else ("p99", 2.4)
+    amp = round(0.15 + 0.35 * _hash01(seed, "ramp", "amp"), 3)
+    start = 6 + _pick(seed, "ramp:start", 18)
+    hot_topic = f"t{_pick(seed, 'ramp:topic', BASE_SPEC.num_topics)}"
+    hot = round(1.5 + 2.0 * _hash01(seed, "ramp", "hot"), 2)
+    events = (
+        ScenarioEvent(start, "set_load", {"factor": factor}),
+        ScenarioEvent(start + 8, "hotspot",
+                      {"topic": hot_topic, "factor": hot}),
+    )
+    return SampledFuture("load_ramp", seed, _named(
+        "load_ramp", seed,
+        f"Forecast {pct} load ramp (x{factor}) from tick {start} with a "
+        f"x{hot} hotspot on {hot_topic}, diurnal amplitude {amp}.",
+        drift=DriftSpec(amplitude=amp, period_ticks=40), events=events,
+        config_overrides={"scenario.slo.balancedness.min": 60.0}))
+
+
+def _capacity_skew(seed: int) -> SampledFuture:
+    """Heterogeneous capacities: half the fleet scaled by a sampled
+    factor (a mixed-generation hardware future), with a sampled hotspot
+    so placement by capacity share actually matters."""
+    skew = round(1.5 + 1.5 * _hash01(seed, "skew", "factor"), 2)
+    hot_topic = f"t{_pick(seed, 'skew:topic', BASE_SPEC.num_topics)}"
+    hot = round(1.5 + 1.5 * _hash01(seed, "skew", "hot"), 2)
+    start = 5 + _pick(seed, "skew:start", 15)
+    events = (
+        ScenarioEvent(start, "hotspot", {"topic": hot_topic,
+                                         "factor": hot}),
+    )
+    return SampledFuture("capacity_skew", seed, _named(
+        "capacity_skew", seed,
+        f"Brokers 0-{BASE_SPEC.num_brokers // 2 - 1} at x{skew} capacity "
+        f"(heterogeneous fleet) under a x{hot} hotspot on {hot_topic}.",
+        capacity_skew=skew, events=events,
+        config_overrides={"scenario.slo.balancedness.min": 60.0}))
+
+
+def _cascading_failures(seed: int) -> SampledFuture:
+    """Cascading broker/AZ failures: a first broker dies, then a second
+    in a DIFFERENT rack a few ticks later (the cross-AZ cascade), both
+    reviving late in the replay. The evaluator's decision point sits
+    mid-outage: both victims marked DEAD at the solve, excluded from
+    replica moves and leadership."""
+    b = BASE_SPEC.num_brokers
+    first = _pick(seed, "cascade:first", b)
+    # A different rack (racks are broker % num_racks): step by one so the
+    # cascade always crosses an AZ boundary.
+    second = (first + 1) % b
+    t1 = 8 + _pick(seed, "cascade:t1", 10)
+    gap = 3 + _pick(seed, "cascade:gap", 6)
+    revive = BASE_SPEC.ticks - 18
+    events = (
+        ScenarioEvent(t1, "kill_broker", {"broker": first}),
+        ScenarioEvent(t1 + gap, "kill_broker", {"broker": second}),
+        ScenarioEvent(revive, "revive_broker", {"broker": first}),
+        ScenarioEvent(revive, "revive_broker", {"broker": second}),
+    )
+    return SampledFuture(
+        "cascading_failures", seed, _named(
+            "cascading_failures", seed,
+            f"Broker {first} dies at tick {t1}, broker {second} (next "
+            f"rack) follows {gap} ticks later; both revive at "
+            f"tick {revive}.",
+            events=events,
+            # Sub-horizon removal history (the multi_az_failure lesson):
+            # healed-then-revived brokers must become placement targets
+            # again before the replay ends.
+            config_overrides={
+                "removal.history.retention.time.ms": 1_200_000,
+                "scenario.slo.balancedness.min": 60.0}),
+        remove_brokers=(first, second))
+
+
+def _churn_storm(seed: int) -> SampledFuture:
+    """Partition-expansion churn storm: existing topics grow in sampled
+    bursts (topic COUNT stays fixed so every churn future shares the
+    batch's static topic axis; total partitions stay inside the 128
+    bucket so the storm never changes the compiled shape)."""
+    events = []
+    grown: dict[str, int] = {}
+    budget = 48  # base 48 partitions + at most 48 grown = 96 <= 128
+    cadence = 5 + _pick(seed, "churn:cadence", 5)
+    for tick in range(cadence, BASE_SPEC.ticks - 5, cadence):
+        if budget <= 0:
+            break
+        topic = f"t{_pick(seed, f'churn:topic:{tick}', BASE_SPEC.num_topics)}"
+        step = min(budget, 4 + 4 * _pick(seed, f"churn:step:{tick}", 2))
+        grown[topic] = grown.get(topic, BASE_SPEC.partitions_per_topic) + step
+        budget -= step
+        events.append(ScenarioEvent(tick, "expand_partitions",
+                                    {"topic": topic, "to": grown[topic]}))
+    return SampledFuture("churn_storm", seed, _named(
+        "churn_storm", seed,
+        f"Partition-expansion bursts every {cadence} ticks across "
+        f"{len(grown)} topics (+{48 - budget} partitions total).",
+        events=tuple(events),
+        config_overrides={"scenario.slo.balancedness.min": 60.0}))
+
+
+def _maintenance_plan(seed: int) -> SampledFuture:
+    """Maintenance plan: one sampled broker drained (REMOVE_BROKER plan)
+    and re-added later in the replay. At the evaluator's decision point
+    the drain is in force: the broker is marked DEAD and excluded, the
+    solve prices evacuating it."""
+    victim = _pick(seed, "maint:broker", BASE_SPEC.num_brokers)
+    t1 = 8 + _pick(seed, "maint:t1", 12)
+    t2 = BASE_SPEC.ticks - 15
+    events = (
+        ScenarioEvent(t1, "maintenance",
+                      {"plan": "REMOVE_BROKER", "brokers": [victim]}),
+        ScenarioEvent(t2, "maintenance",
+                      {"plan": "ADD_BROKER", "brokers": [victim]}),
+    )
+    return SampledFuture(
+        "maintenance_plan", seed, _named(
+            "maintenance_plan", seed,
+            f"Drain broker {victim} at tick {t1} (maintenance plan), "
+            f"re-add at tick {t2}.",
+            events=events,
+            config_overrides={"scenario.slo.balancedness.min": 60.0}),
+        remove_brokers=(victim,))
+
+
+@dataclasses.dataclass(frozen=True)
+class FutureTemplate:
+    name: str
+    description: str
+    sample: Callable[[int], SampledFuture]
+
+
+FUTURE_TEMPLATES: dict[str, FutureTemplate] = {t.name: t for t in (
+    FutureTemplate("load_ramp",
+                   "Forecast-percentile load ramp + hotspot under drift",
+                   _load_ramp),
+    FutureTemplate("capacity_skew",
+                   "Heterogeneous broker capacities (mixed generations)",
+                   _capacity_skew),
+    FutureTemplate("cascading_failures",
+                   "Cross-AZ cascading broker failures, revived late",
+                   _cascading_failures),
+    FutureTemplate("churn_storm",
+                   "Seeded partition-expansion bursts (fixed topic axis)",
+                   _churn_storm),
+    FutureTemplate("maintenance_plan",
+                   "Broker drain + re-add maintenance plan",
+                   _maintenance_plan),
+)}
+
+
+def _unknown(template: str) -> ValueError:
+    return ValueError(
+        f"unknown futures template {template!r}; expected one of "
+        f"{', '.join(sorted(FUTURE_TEMPLATES))}")
+
+
+def sample_future(template: str, seed: int,
+                  ticks: int | None = None) -> SampledFuture:
+    """Sample one candidate future — pure in ``(template, seed)``.
+    ``ticks`` re-times the spec's replay horizon (the advance-phase
+    event positions rescale with it via ``advance_events``)."""
+    t = FUTURE_TEMPLATES.get(template)
+    if t is None:
+        raise _unknown(template)
+    sampled = t.sample(int(seed))
+    if ticks is not None:
+        sampled = dataclasses.replace(
+            sampled, spec=dataclasses.replace(sampled.spec,
+                                              ticks=int(ticks)))
+    return sampled
+
+
+def sample_scenario(template: str, seed: int) -> ScenarioSpec:
+    """The full-loop ``ScenarioSpec`` view of a sample (the
+    ``?what_if=random:<template>:<seed>`` replay and the CI matrix's
+    generator-sampled rows)."""
+    return sample_future(template, seed).spec
+
+
+def present_future() -> SampledFuture:
+    """The baseline slot: the cluster exactly as it is. Ranked answers
+    report score DELTAS against this future's solve."""
+    return SampledFuture("present", 0, BASE_SPEC)

@@ -34,6 +34,11 @@ class DeterminismRule(Rule):
         "cruise_control_tpu/testing/simulator.py",
         "cruise_control_tpu/testing/chaos.py",
         "cruise_control_tpu/utils/flight_recorder.py",
+        # Futures engine (round 15): sampled scenarios are pure in
+        # (template, seed) and ranked score JSON is byte-identical per
+        # request — the serving contract, not just a test convenience.
+        "cruise_control_tpu/futures/generator.py",
+        "cruise_control_tpu/futures/evaluator.py",
     )
 
     CLOCK_CALLS = ("time.time", "time.time_ns", "time.monotonic",

@@ -698,6 +698,15 @@ class ClusterSimulator:
                                 bytes_mb, healthy=self._healthy(),
                                 degraded=degraded)
 
+    def advance(self, ticks: int) -> None:
+        """Run ``ticks`` simulated ticks without the run()-level scoring
+        wrap-up — the futures engine's advance-to-decision-point
+        primitive (futures/evaluator.py builds one twin per candidate
+        future, advances it here with detection disabled, and batches
+        the decision solves)."""
+        for tick in range(int(ticks)):
+            self.run_tick(tick)
+
     def run(self) -> ScenarioResult:
         from ..utils.flight_recorder import FLIGHT, summarize_passes
         from ..utils.tracing import TRACER

@@ -1,0 +1,30 @@
+"""CCSA004 fixture: wall-clock and global-``random`` leaks in a futures
+sampler (tests lint this file under a spoofed
+cruise_control_tpu/futures/generator.py path — the round-15 modules sit
+under the same byte-identical determinism contract as the twin)."""
+
+import random
+import time
+import zlib
+
+
+def bad_sample_tick() -> int:
+    return int(time.time()) % 60          # finding: wall clock in sampler
+
+
+def bad_sample_factor() -> float:
+    return 1.0 + random.random()          # finding: global random state
+
+
+def good_sample_factor(seed: int) -> float:
+    return 1.0 + zlib.crc32(f"{seed}:factor".encode()) / 0xFFFFFFFF
+
+
+def injected(clock=time.monotonic) -> float:
+    return clock()                        # clean: reference is the seam
+
+
+def timed_probe() -> float:
+    # ccsa: ok[CCSA004] fixture: observability-only timer, never enters
+    # the sampled event stream or the ranked score JSON
+    return time.perf_counter()

@@ -92,6 +92,26 @@ def test_wall_clock_and_dispatch_drift_warn_only():
     assert len(v["extras"]["warnings"]) == 2
 
 
+def test_ranked_order_flip_is_a_hard_canary():
+    """Round 15: the futures stage pins WHICH future wins. A rank flip
+    against the baseline fails hard; matching order (or a stage/baseline
+    without one) stays clean."""
+    baseline = copy.deepcopy(BASELINE)
+    stage = baseline["stages"][RECORD["metric"]]
+    stage["ranked_order"] = ["a:1", "b:1", "c:1"]
+    record = copy.deepcopy(RECORD)
+    record["extras"]["ranked_order"] = ["a:1", "b:1", "c:1"]
+    v = bench.compare_stage_to_baseline(record, baseline)
+    assert v["extras"]["status"] == "ok"
+    record["extras"]["ranked_order"] = ["b:1", "a:1", "c:1"]
+    v = bench.compare_stage_to_baseline(record, baseline)
+    assert v["extras"]["status"] == "fail"
+    assert any("ranked order" in c for c in v["extras"]["canaries"])
+    # No baseline order recorded -> the canary does not apply.
+    v = _verdict(lambda ex: ex.update(ranked_order=["x:1"]))
+    assert v["extras"]["status"] == "ok"
+
+
 def test_unknown_stage_and_missing_baseline():
     record = copy.deepcopy(RECORD)
     record["metric"] = "rebalance_proposal_wall_clock_unpinned_stage"

@@ -122,6 +122,24 @@ def test_ccsa004_determinism_fixture():
     assert kinds == {"hash()", "time.time"} or len(kinds) == 2
 
 
+def test_ccsa004_covers_futures_modules():
+    """The round-15 futures engine sits under the same byte-identical
+    determinism contract as the twin: wall-clock and global-random
+    calls are findings under the futures paths, the injected-clock
+    reference and the documented observability suppression stay legal —
+    and the REAL modules verify clean."""
+    spoofed = ctx_for(FIXTURES / "bad_futures_generator.py",
+                      "cruise_control_tpu/futures/generator.py")
+    active, suppressed = findings_of("CCSA004", spoofed)
+    assert len(active) == 2           # time.time() + random.random()
+    assert len(suppressed) == 1       # the documented perf_counter probe
+    for rel in ("cruise_control_tpu/futures/generator.py",
+                "cruise_control_tpu/futures/evaluator.py"):
+        ctx = ctx_for(ROOT / rel, rel)
+        real_active, _sup = findings_of("CCSA004", ctx)
+        assert not real_active, [f.message for f in real_active]
+
+
 def test_ccsa004_hash_ban_is_repo_wide_but_clock_is_not():
     plain = ctx_for(FIXTURES / "bad_determinism.py")
     active, suppressed = findings_of("CCSA004", plain)

@@ -587,6 +587,37 @@ def test_megabatch_pacer_emits_whole_bucket_fill():
         registry.shutdown()
 
 
+def test_fix_and_on_demand_solves_route_through_megabatch():
+    """ROADMAP item 3c tail (round 15): with coalescing wired, a
+    registered facade's goal-chain operations — the self-healing fix
+    path and on-demand requests — run through the BATCHED kernels at
+    occupancy 1 (flight path=megabatch), with per-request exclusion
+    options riding the batched mask assembler, and return results
+    byte-identical to the serial solve."""
+    from cruise_control_tpu.utils.flight_recorder import FLIGHT
+    registry, _scheduler = _megabatch_fleet()
+    try:
+        ea = registry.entry("mb-a")
+        assert ea.cc.megabatch_solve_width == registry.megabatch.width
+        marker = FLIGHT.marker()
+        from cruise_control_tpu.utils.sensors import cluster_label
+        with cluster_label("mb-a"):
+            batched = ea.cc.rebalance(
+                dryrun=True, excluded_topics=("t0",))
+        passes = FLIGHT.passes_since(marker)
+        assert passes and any(p["path"] == "megabatch" for p in passes)
+        ea.cc.megabatch_solve_width = 0
+        serial = ea.cc.rebalance(dryrun=True, excluded_topics=("t0",))
+        assert [(p.topic, p.partition, p.new_replicas)
+                for p in batched.proposals] == \
+            [(p.topic, p.partition, p.new_replicas)
+             for p in serial.proposals]
+        assert batched.optimizer_result.balancedness_after \
+            == serial.optimizer_result.balancedness_after
+    finally:
+        registry.shutdown()
+
+
 def test_megabatch_batch_failure_contained():
     """A cluster whose model build fails at batch time fails ONLY its
     own future; the batchmate still solves and stores its cache."""

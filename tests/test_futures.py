@@ -1,0 +1,337 @@
+"""Futures engine (round 15): seeded scenario generation, batched
+what-if evaluation, and the COMPARE_FUTURES serving surface.
+
+The load-bearing contracts:
+
+- Generator determinism: a sampled scenario is a pure function of
+  ``(template, seed)`` — byte-identical event streams on re-sample.
+- Batched == serial: a futures batch at ANY occupancy scores every
+  future byte-identically to serial solves, and changing occupancy
+  never compiles a new batched program (jit-cache-counter pinned).
+- Ranked-answer determinism: the COMPARE_FUTURES body is byte-identical
+  across repeated runs at one (templates, seed, ticks) request — no
+  wall-clock-derived values anywhere in it.
+- The endpoint is an async dry run: 202/200 + User-Task-ID semantics,
+  never an execution, per-future flight passes on GET /solver.
+"""
+
+import json
+
+import pytest
+
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.futures.evaluator import (
+    PRESENT, FutureSpec, compare_futures, evaluate_prepared, plan_futures,
+    prepare_future, rank_results,
+)
+from cruise_control_tpu.futures.generator import (
+    FUTURE_TEMPLATES, sample_future, sample_scenario,
+)
+
+TICKS = 6
+WIDTH = 4
+
+
+# ---------------------------------------------------------------------------
+# Generator
+# ---------------------------------------------------------------------------
+
+def _event_stream(template: str, seed: int) -> str:
+    spec = sample_scenario(template, seed)
+    return json.dumps([e.as_dict() for e in spec.expand_events(0)],
+                      sort_keys=True)
+
+
+def test_templates_are_deterministic_and_seed_sensitive():
+    for t in FUTURE_TEMPLATES:
+        assert _event_stream(t, 3) == _event_stream(t, 3), t
+        assert sample_scenario(t, 3).name == f"random:{t}:3"
+    # Seeds actually change the sampled content somewhere.
+    assert any(_event_stream(t, 1) != _event_stream(t, 2)
+               for t in FUTURE_TEMPLATES)
+
+
+def test_unknown_template_lists_valid_names():
+    with pytest.raises(ValueError) as ei:
+        sample_scenario("nope", 0)
+    for t in FUTURE_TEMPLATES:
+        assert t in str(ei.value)
+
+
+def test_advance_events_rescale_and_filter_decision_content():
+    cascade = sample_future("cascading_failures", 7)
+    # Kills/revives are decision-point content for the evaluator: the
+    # advance stream carries only load-shaping kinds.
+    assert {e.kind for e in cascade.spec.events} \
+        == {"kill_broker", "revive_broker"}
+    assert cascade.advance_events(8) == ()
+    assert len(cascade.remove_brokers) == 2
+    churn = sample_future("churn_storm", 7)
+    adv = churn.advance_events(8)
+    assert adv, "churn must shape the advance"
+    assert all(e.kind == "expand_partitions" for e in adv)
+    assert all(0 <= e.tick < 8 for e in adv)
+
+
+def test_plan_futures_round_robins_templates_and_seeds():
+    plan = plan_futures(["load_ramp", "churn_storm"], 5, seed=4, ticks=TICKS)
+    assert [(p.template, p.seed) for p in plan] == [
+        ("load_ramp", 4), ("churn_storm", 4), ("load_ramp", 5),
+        ("churn_storm", 5), ("load_ramp", 6)]
+    with pytest.raises(ValueError, match="load_ramp"):
+        plan_futures(["typo"], 2, 0, TICKS)
+    # Duplicate template names dedupe (review finding: colliding future
+    # ids would corrupt the ranked answer and double-solve).
+    plan = plan_futures(["load_ramp", "load_ramp"], 2, seed=0, ticks=TICKS)
+    assert [(p.template, p.seed) for p in plan] == [
+        ("load_ramp", 0), ("load_ramp", 1)]
+    assert len({p.future_id for p in plan}) == 2
+
+
+def test_replay_spec_compresses_the_whole_story():
+    """The bench's serial-replay baseline must see every sampled event
+    inside the shortened horizon (plain truncation would drop late
+    faults/maintenance and under-work the baseline)."""
+    cascade = sample_future("cascading_failures", 7)
+    spec = cascade.replay_spec(10)
+    assert spec.ticks == 10
+    assert {e.kind for e in spec.events} \
+        == {e.kind for e in cascade.spec.events}
+    assert len(spec.events) == len(cascade.spec.events)
+    assert all(0 <= e.tick < 10 for e in spec.events)
+    # Relative order of kill -> revive survives the compression.
+    kills = [e.tick for e in spec.events if e.kind == "kill_broker"]
+    revives = [e.tick for e in spec.events if e.kind == "revive_broker"]
+    assert max(kills) <= min(revives)
+
+
+# ---------------------------------------------------------------------------
+# Batched evaluation: parity, occupancy, one program per shape
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def prepared_set():
+    """Three futures + the present baseline, advanced once and shared by
+    the parity tests (the twins are read-only inputs to the solves)."""
+    specs = [FutureSpec("maintenance_plan", 1, TICKS),
+             FutureSpec("load_ramp", 1, TICKS),
+             FutureSpec("churn_storm", 1, TICKS),
+             FutureSpec(PRESENT, 0, TICKS)]
+    prepared = [prepare_future(fs) for fs in specs]
+    optimizer = GoalOptimizer(prepared[0].config)
+    return prepared, optimizer
+
+
+def _scores(results) -> list[dict]:
+    return [{"future": r.future_id, **r.score_dict()} for r in results]
+
+
+def test_batched_matches_serial_at_two_occupancies_one_program(prepared_set):
+    from cruise_control_tpu.analyzer.chain import megabatch_optimize_rounds
+    prepared, optimizer = prepared_set
+    serial = evaluate_prepared(prepared, optimizer, batched=False)
+    full = evaluate_prepared(prepared, optimizer, width=WIDTH)
+    cache_after_full = megabatch_optimize_rounds._cache_size()
+    # Occupancy 1-of-4: one future only — inert pad slots fill the rest.
+    padded = evaluate_prepared(prepared[:1], optimizer, width=WIDTH)
+    # One compiled batched program per bucket shape serves BOTH
+    # occupancies: the second run must not compile anything new.
+    assert megabatch_optimize_rounds._cache_size() == cache_after_full
+    assert _scores(full) == _scores(serial)
+    assert _scores(padded) == _scores(serial)[:1]
+    # The maintenance future's drained broker actually shaped its solve:
+    # its per-future exclusion options rode the batched mask assembler.
+    maint = full[0]
+    assert maint.decision["removeBrokers"]
+    assert maint.num_proposals > 0
+
+
+def test_rank_is_deterministic_with_deltas(prepared_set):
+    prepared, optimizer = prepared_set
+    results = evaluate_prepared(prepared, optimizer, width=WIDTH)
+    ranked = rank_results(results)
+    assert [r.rank for r in ranked] == [1, 2, 3]
+    assert all(r.future_id != PRESENT for r in ranked)
+    # Ranked best-balancedness first (ties broken byte-stably).
+    bals = [r.balancedness_after for r in ranked]
+    assert bals == sorted(bals, reverse=True)
+    for r in ranked:
+        assert r.delta_vs_present is not None
+        assert set(r.delta_vs_present) == {"balancednessAfter",
+                                           "numProposals", "bytesToMoveMb"}
+
+
+def test_compare_futures_body_is_byte_identical():
+    kwargs = dict(templates=["maintenance_plan", "capacity_skew"],
+                  num_futures=2, seed=1, ticks=TICKS, width=WIDTH)
+    b1 = compare_futures(**kwargs)
+    b2 = compare_futures(**kwargs)
+    assert json.dumps(b1, sort_keys=True) == json.dumps(b2, sort_keys=True)
+    assert b1["numFutures"] == 2
+    assert [f["rank"] for f in b1["futures"]] == [1, 2]
+    assert b1["present"]["future"] == PRESENT
+    assert b1["dryrun"] is True and b1["executed"] is False
+    # Every row is independently replayable:
+    for f in b1["futures"]:
+        assert f["future"] == f"{f['template']}:{f['seed']}"
+
+
+# ---------------------------------------------------------------------------
+# Serving surface: COMPARE_FUTURES + what_if=random:
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def api_cc():
+    from cruise_control_tpu.api.server import CruiseControlApi
+    from cruise_control_tpu.common.resources import Resource
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.executor.admin import (
+        InMemoryAdminBackend, PartitionState,
+    )
+    from cruise_control_tpu.executor.executor import Executor
+    from cruise_control_tpu.facade import CruiseControl
+    from cruise_control_tpu.monitor import (
+        LoadMonitor, StaticCapacityResolver,
+    )
+    from cruise_control_tpu.monitor.sampling import SyntheticSampler
+    parts = {}
+    for t in range(2):
+        for p in range(6):
+            reps = (0, 1 + (t + p) % 3)
+            parts[(f"t{t}", p)] = PartitionState(f"t{t}", p, reps, reps[0],
+                                                 isr=reps)
+    backend = InMemoryAdminBackend(parts.values())
+    cfg = CruiseControlConfig({
+        "partition.metrics.window.ms": 1000,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "failed.brokers.file.path": "",
+        "futures.default.ticks": TICKS,
+        "futures.max.count": 3,
+        "futures.max.ticks": 20,
+        "futures.batch.width": WIDTH})
+    caps = StaticCapacityResolver({}, {Resource.CPU: 100.0,
+                                       Resource.DISK: 1e7,
+                                       Resource.NW_IN: 1e6,
+                                       Resource.NW_OUT: 1e6})
+    monitor = LoadMonitor(cfg, backend, samplers=[SyntheticSampler()],
+                          capacity_resolver=caps)
+    cc = CruiseControl(cfg, backend, load_monitor=monitor,
+                       executor=Executor(backend, synchronous=True))
+    for k in range(1, 4):
+        monitor.task_runner.run_sampling_once(end_ms=k * 1000)
+    api = CruiseControlApi(cc)
+    api._async_wait_s = 300     # cover first-compile of the twin shapes
+    yield api, cc
+    api.shutdown()
+
+
+def test_compare_futures_endpoint_serves_ranked_dry_run(api_cc):
+    api, cc = api_cc
+    before = cc.executor.execution_state()
+    status, body, headers = api.handle(
+        "GET", "/kafkacruisecontrol/compare_futures",
+        f"templates=maintenance_plan,capacity_skew&num_futures=2"
+        f"&seed=1&ticks={TICKS}")
+    assert status == 200, body
+    assert headers.get("User-Task-ID")
+    assert body["numFutures"] == 2
+    assert [f["rank"] for f in body["futures"]] == [1, 2]
+    assert body["executed"] is False
+    # A futures request never touches THIS cluster's executor.
+    assert cc.executor.execution_state() == before
+    # Per-future flight passes are addressable on GET /solver.
+    fid = body["futures"][0]["future"]
+    status, solver, _ = api.handle("GET", "/kafkacruisecontrol/solver",
+                                   f"cluster=future:{fid}")
+    assert status == 200
+    assert solver["numPasses"] >= 1
+    # Occupancy rode the futures_* sensors.
+    from cruise_control_tpu.utils.sensors import SENSORS
+    snap = SENSORS.histogram_snapshot("futures_batch_occupancy")
+    assert snap is not None and sum(snap["counts"]) >= 1
+
+
+def test_compare_futures_endpoint_rejects_unknown_template(api_cc):
+    api, _cc = api_cc
+    status, body, _ = api.handle(
+        "GET", "/kafkacruisecontrol/compare_futures", "templates=nope")
+    assert status == 400
+    assert "maintenance_plan" in json.dumps(body)
+
+
+def test_compare_futures_caps_are_enforced(api_cc):
+    api, cc = api_cc
+    status, body, _ = api.handle(
+        "GET", "/kafkacruisecontrol/compare_futures",
+        "templates=load_ramp&num_futures=500&ticks=10000&seed=0")
+    assert status == 200, body
+    assert body["numFutures"] <= cc.config.get_int("futures.max.count")
+    assert body["ticks"] <= cc.config.get_int("futures.max.ticks")
+
+
+def test_what_if_random_replays_sampled_scenario(api_cc):
+    api, _cc = api_cc
+    q = ("what_if=random:load_ramp:3&what_if_ticks=6&what_if_seed=1")
+    status, b1, _ = api.handle("GET", "/kafkacruisecontrol/proposals", q)
+    assert status == 200, b1
+    assert b1["scenario"] == "random:load_ramp:3"
+    assert b1["ticks"] == 6
+    status, b2, _ = api.handle("GET", "/kafkacruisecontrol/proposals", q)
+    assert json.dumps(b1["score"], sort_keys=True) \
+        == json.dumps(b2["score"], sort_keys=True)
+
+
+def test_what_if_random_unknown_template_is_400_listing_templates(api_cc):
+    api, _cc = api_cc
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/proposals",
+                                 "what_if=random:nope:3")
+    assert status == 400
+    text = json.dumps(body)
+    for t in FUTURE_TEMPLATES:
+        assert t in text
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/proposals",
+                                 "what_if=random:load_ramp:abc")
+    assert status == 400
+    assert "not an integer" in json.dumps(body)
+
+
+def test_what_if_random_respects_tick_cap(api_cc):
+    api, cc = api_cc
+    cap = cc.config.get_int("scenario.what.if.max.ticks")
+    status, body, _ = api.handle(
+        "GET", "/kafkacruisecontrol/proposals",
+        f"what_if=random:churn_storm:1&what_if_ticks={cap + 500}")
+    assert status == 200, body
+    assert body["ticks"] == cap
+
+
+# ---------------------------------------------------------------------------
+# Fleet coalescing: FuturesPayload through the MegabatchRunner
+# ---------------------------------------------------------------------------
+
+def test_futures_payload_rides_the_megabatch_runner(prepared_set):
+    from concurrent.futures import Future
+    from types import SimpleNamespace
+
+    from cruise_control_tpu.fleet.megabatch import MegabatchRunner
+    from cruise_control_tpu.futures.evaluator import FuturesPayload
+    _prepared, optimizer = prepared_set
+    runner = MegabatchRunner(optimizer, width=WIDTH)
+    payload = FuturesPayload("c1", ["maintenance_plan", "load_ramp"], 2,
+                             seed=1, ticks=TICKS)
+    job = SimpleNamespace(payload=payload, future=Future())
+    runner([job])
+    body = job.future.result(timeout=0)
+    assert body["numFutures"] == 2
+    assert [f["rank"] for f in body["futures"]] == [1, 2]
+    assert runner.stats()["clustersSolved"] >= 3  # 2 futures + present
+    # The direct evaluator and the runner path agree byte-for-byte on
+    # the ranked content (the runner's width differs only in padding).
+    direct = compare_futures(templates=["maintenance_plan", "load_ramp"],
+                             num_futures=2, seed=1, ticks=TICKS,
+                             width=WIDTH)
+    assert json.dumps(body["futures"], sort_keys=True) \
+        == json.dumps(direct["futures"], sort_keys=True)

@@ -303,6 +303,63 @@ def test_padded_megabatch_with_exclusion_masks():
     assert s_res.violated_goals_after == m_res.violated_goals_after
 
 
+def test_per_item_options_mixed_batch_parity():
+    """Round 15: items may carry their OWN options (the fix path's and
+    the futures engine's per-cluster exclusion sets). A mixed batch —
+    one cluster excluding a topic and brokers, one excluding nothing —
+    normalizes mask presence (inert all-False fills) and stays
+    byte-identical per cluster to serial solves under the same
+    options."""
+    from cruise_control_tpu.analyzer.constraint import OptimizationOptions
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    cfg = CruiseControlConfig({"max.solver.rounds": 60})
+    opt = GoalOptimizer(cfg)
+    st_a, meta_a = _cluster(3)
+    st_b, meta_b = _cluster(7)
+    opts_a = OptimizationOptions(
+        excluded_topics=(meta_a.topic_names[0],),
+        excluded_brokers_for_replica_move=(meta_a.broker_ids[0],))
+    opts_b = OptimizationOptions()
+    out = opt.optimizations_megabatch(
+        [(st_a, meta_a, "a", opts_a), (st_b, meta_b, "b", opts_b)],
+        goals=list(CHAIN), width=WIDTH)
+    for (st, meta, options), r in zip(
+            [(st_a, meta_a, opts_a), (st_b, meta_b, opts_b)], out):
+        assert not isinstance(r, Exception), r
+        m_final, m_res = r
+        s_final, s_res = opt.optimizations(st, meta, goals=list(CHAIN),
+                                           options=options)
+        np.testing.assert_array_equal(np.asarray(s_final.assignment),
+                                      np.asarray(m_final.assignment))
+        assert s_res.violated_goals_after == m_res.violated_goals_after
+        assert [(p.topic, p.partition, p.new_replicas)
+                for p in s_res.proposals] == \
+            [(p.topic, p.partition, p.new_replicas)
+             for p in m_res.proposals]
+    with pytest.raises(ValueError, match="fast_mode"):
+        from cruise_control_tpu.analyzer.constraint import (
+            OptimizationOptions as OO,
+        )
+        opt.optimizations_megabatch(
+            [(st_a, meta_a, "a", OO(fast_mode=True))], goals=list(CHAIN))
+
+
+def test_uniform_mask_presence_normalization():
+    opt = GoalOptimizer()
+    masked = ExclusionMasks(excluded_topics=jnp.ones(4, bool))
+    bare = ExclusionMasks()
+    out = opt._uniform_mask_presence([masked, bare])
+    assert out[0].excluded_topics is masked.excluded_topics
+    assert out[1].excluded_topics.shape == (4,)
+    assert not bool(np.asarray(out[1].excluded_topics).any())
+    assert out[1].excluded_replica_move_brokers is None
+    # All-bare lists pass through untouched.
+    bares = [ExclusionMasks(), ExclusionMasks()]
+    assert opt._uniform_mask_presence(bares) == bares
+
+
 def test_stack_masks_uniformity():
     opt = GoalOptimizer()
     with pytest.raises(ValueError, match="uniform"):
