@@ -73,6 +73,14 @@ class EndPoint(enum.Enum):
     # anything. USER like PROPOSALS/PROFILE: the batched solve consumes
     # shared device time even though the answer is viewer-safe.
     COMPARE_FUTURES = (27, "GET", Role.USER)
+    # Heal ledger (round 16, no reference analogue — the reference's
+    # AnomalyDetectorState shows per-anomaly status snapshots, not the
+    # causal chain): correlated anomaly-lifecycle chains from
+    # utils.heal_ledger — detection → notifier verdict → fix → solve
+    # (flight-recorder pass ids) → execution → terminal outcome, with
+    # per-phase durations. ``?cluster=`` routes to that cluster's
+    # facade ledger; ``?anomaly_type=`` / ``?entries=`` filter.
+    HEALS = (28, "GET", Role.VIEWER)
 
     @property
     def method(self) -> str:

@@ -171,6 +171,10 @@ SCHEMAS: dict[EndPoint, dict[str, Callable[[str], Any]]] = {
     # (same no-route semantics as TRACE); goal trims each pass to one
     # goal's record.
     EndPoint.SOLVER: {"goal": _str, "entries": _int},
+    # cluster (in _COMMON) ROUTES to that cluster's facade ledger (each
+    # facade journals its own heals on its own clock); anomaly_type
+    # filters chains; entries bounds the response.
+    EndPoint.HEALS: {"anomaly_type": _str, "entries": _int},
     # duration_s > 0 = jax.profiler capture window; microbench=true = the
     # in-process op-class while_loop marginals instead (brokers/
     # partitions/iters size it).

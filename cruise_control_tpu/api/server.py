@@ -49,7 +49,7 @@ _SYNC_ENDPOINTS = {
     EndPoint.REVIEW_BOARD, EndPoint.PERMISSIONS, EndPoint.REVIEW,
     EndPoint.PAUSE_SAMPLING, EndPoint.RESUME_SAMPLING,
     EndPoint.STOP_PROPOSAL_EXECUTION, EndPoint.ADMIN, EndPoint.BOOTSTRAP,
-    EndPoint.TRAIN, EndPoint.RIGHTSIZE, EndPoint.FLEET,
+    EndPoint.TRAIN, EndPoint.RIGHTSIZE, EndPoint.FLEET, EndPoint.HEALS,
 }
 
 # Endpoints that consume solver time. In fleet mode these (a) are refused
@@ -670,6 +670,21 @@ class CruiseControlApi:
                     {"numClusters": 0, "clusters": {},
                      "message": "fleet mode not enabled"})
             return responses.envelope(self._fleet.state())
+        if endpoint is EndPoint.HEALS:
+            # GET /heals: correlated anomaly-lifecycle chains from the
+            # routed facade's heal ledger (per-facade journals — a
+            # fleet's ?cluster= routes, a twin's ledger stays its own).
+            ledger = cc.heal_ledger
+            chains = ledger.chains(anomaly_type=p.get("anomaly_type"),
+                                   limit=p.get("entries", 20))
+            return responses.envelope({
+                "healLedgerEnabled": ledger.enabled,
+                "numChains": len(chains),
+                "chainsOpened": ledger.chains_opened,
+                "chainsResolved": ledger.chains_resolved,
+                "healsOpen": ledger.open_count(),
+                "meanTimeToStartFixMs": ledger.mean_time_to_start_fix_ms(),
+                "chains": chains})
         if endpoint is EndPoint.STATE:
             return responses.envelope(cc.state(
                 p.get("substates", ()),

@@ -457,6 +457,25 @@ def _definition() -> ConfigDef:
              "oldest-first). Trace-time constant: changing it recompiles "
              "the recording chain kernels. 0 records at dispatch "
              "granularity only.")
+    d.define("heal.ledger.enabled", T.BOOLEAN, True, None, I.LOW,
+             "Heal ledger (utils.heal_ledger): per-anomaly lifecycle "
+             "chains — detection, notifier verdicts, fix dispatch, "
+             "model/solve phases (flight-recorder pass ids linked), "
+             "execution progress, and the terminal outcome — served at "
+             "GET /heals and exported as heal_phase_seconds{phase=} / "
+             "time_to_heal_seconds{type=} histograms and the "
+             "heals_open{type=} gauge. Observation only: proposals and "
+             "final assignments are byte-identical with the ledger on "
+             "or off (pinned); disabled, every hook is the shared NO_HEAL "
+             "no-op (bench-guarded by heal_ledger_noop_overhead).")
+    d.define("heal.ledger.max.chains", T.INT, 256, Range.at_least(1), I.LOW,
+             "Bound on retained heal chains per facade (oldest evicted; "
+             "a still-open evicted chain terminates as 'evicted' so no "
+             "heal silently vanishes from the export).")
+    d.define("heal.ledger.max.phases", T.INT, 64, Range.at_least(4), I.LOW,
+             "Bound on phase transitions kept per chain; further "
+             "transitions are counted in the chain's droppedPhases "
+             "field instead of growing it without bound.")
     d.define("profiling.enabled", T.BOOLEAN, True, None, I.LOW,
              "On-demand device profiling (GET /profile): "
              "jax.profiler.trace captures of live solves plus the "
@@ -983,7 +1002,8 @@ def _definition() -> ConfigDef:
                "fix.offline.replicas", "rebalance", "stop.proposal",
                "pause.sampling", "resume.sampling", "demote.broker", "admin",
                "review", "topic.configuration", "rightsize", "remove.disks",
-               "fleet", "trace", "solver", "profile", "compare.futures"):
+               "fleet", "trace", "solver", "profile", "compare.futures",
+               "heals"):
         d.define(f"{ep}.parameters.class", T.CLASS, None, None, I.LOW,
                  f"Parameter-parsing plugin for the {ep} endpoint "
                  "(callable(query) -> params dict).")

@@ -23,6 +23,10 @@ LOG = logging.getLogger(__name__)
 
 
 class BrokerFailureDetector:
+    #: Heal-ledger all-clear contract (detector/manager.py): a run that
+    #: found no failed brokers re-checked the violation clear.
+    CLEARS = ("BROKER_FAILURE",)
+
     def __init__(self, metadata: AdminBackend,
                  report: Callable[[BrokerFailures], None],
                  failed_brokers_file_path: str = "",
@@ -37,6 +41,11 @@ class BrokerFailureDetector:
     @property
     def failed_brokers(self) -> dict[int, int]:
         return dict(self._failed)
+
+    def all_clear(self) -> bool:
+        """True when the last run observed no broker hosting replicas
+        while dead — the heal ledger's violation re-check."""
+        return not self._failed
 
     # -- persistence (AbstractBrokerFailureDetector.java:92-117) -----------
     def _load_persisted_failures(self) -> None:

@@ -18,11 +18,19 @@ LOG = logging.getLogger(__name__)
 
 
 class DiskFailureDetector:
+    #: Heal-ledger all-clear contract (detector/manager.py): a run that
+    #: found no offline log dirs re-checked the violation clear.
+    CLEARS = ("DISK_FAILURE",)
+
     def __init__(self, metadata: AdminBackend,
                  report: Callable[[DiskFailures], None]):
         self._metadata = metadata
         self._report = report
         self._last_reported: dict[int, tuple[str, ...]] = {}
+        self._last_offline_empty = False
+
+    def all_clear(self) -> bool:
+        return self._last_offline_empty
 
     def _offline_dirs(self) -> Mapping[int, Sequence[str]]:
         describe = getattr(self._metadata, "describe_logdirs", None)
@@ -38,6 +46,7 @@ class DiskFailureDetector:
     def run_once(self) -> DiskFailures | None:
         offline = self._offline_dirs()
         snapshot = {b: tuple(sorted(d)) for b, d in offline.items()}
+        self._last_offline_empty = not snapshot
         if not snapshot or snapshot == self._last_reported:
             if not snapshot:
                 self._last_reported = {}

@@ -25,6 +25,12 @@ LOG = logging.getLogger(__name__)
 
 
 class GoalViolationDetector:
+    #: Heal-ledger all-clear contract (detector/manager.py): a full
+    #: detection pass that found NO violations re-checked the violation
+    #: clear. Generation-unchanged or model-unready runs keep the last
+    #: full pass's verdict (nothing changed since).
+    CLEARS = ("GOAL_VIOLATION",)
+
     def __init__(self, config: CruiseControlConfig, load_monitor: LoadMonitor,
                  optimizer: GoalOptimizer,
                  report: Callable[[GoalViolations], None]):
@@ -48,6 +54,7 @@ class GoalViolationDetector:
         self._last_checked_generation = -1
         self._balancedness_score = 100.0
         self._last_result: OptimizerResult | None = None
+        self._last_pass_clear = False
         self._priority_weight = config.get_double("goal.balancedness.priority.weight")
         self._strictness_weight = config.get_double("goal.balancedness.strictness.weight")
 
@@ -59,6 +66,9 @@ class GoalViolationDetector:
     @property
     def last_result(self) -> OptimizerResult | None:
         return self._last_result
+
+    def all_clear(self) -> bool:
+        return self._last_pass_clear
 
     def run_once(self) -> GoalViolations | None:
         gen = self._load_monitor.model_generation
@@ -92,6 +102,7 @@ class GoalViolationDetector:
         self._balancedness_score = balancedness_score(
             self._goals, set(result.violated_goals_before),
             self._priority_weight, self._strictness_weight)
+        self._last_pass_clear = not fixable and not unfixable
         if not fixable and not unfixable:
             return None
         violations = GoalViolations(fixable_goals=fixable,

@@ -49,10 +49,21 @@ class AnomalyDetectorManager:
     def __init__(self, config: CruiseControlConfig | None = None,
                  notifier: AnomalyNotifier | None = None,
                  facade: Any = None,
-                 clock: "Callable[[], float] | None" = None):
+                 clock: "Callable[[], float] | None" = None,
+                 ledger: Any = None):
         self._config = config or CruiseControlConfig()
         self._notifier = notifier or SelfHealingNotifier(self._config)
         self._facade = facade
+        # Heal ledger (round 16): every reported anomaly opens a
+        # correlation chain at detection; the manager records the
+        # notifier verdict and the fix dispatch onto it, and enters the
+        # ambient heal scope around both so the facade/scheduler/
+        # executor phases attribute with zero plumbing. The facade
+        # passes ITS ledger (per-facade isolation + shared clock); a
+        # bare manager gets its own on the same injected clock.
+        from ..utils.heal_ledger import HealLedger
+        self.heal_ledger = ledger if ledger is not None else HealLedger(
+            clock=clock if clock is not None else time.time)
         # Injectable clock (round 11): every time comparison in the fix
         # pipeline — recheck due times, record timestamps, the detector
         # breaker's recovery window, and run_due() tick scheduling — reads
@@ -78,7 +89,11 @@ class AnomalyDetectorManager:
         self._threads: list[threading.Thread] = []
         self._history: list[AnomalyRecord] = []
         self._records: dict[str, AnomalyRecord] = {}
-        self._num_self_healing_started = 0
+        # Per-type self-healing starts (AnomalyDetectorManager.java:190
+        # gauges): the unlabeled plain int became a per-type breakdown +
+        # the self_healing_started_total{type=} sensor; the state()
+        # JSON's numSelfHealingStarted stays the sum.
+        self._self_healing_started_by_type: dict[str, int] = {}
         self._num_fix_failures = 0
         self._recheck: list[tuple[float, Anomaly]] = []  # (due time s, anomaly)
         # run_due() schedule: detector index → next due time on the
@@ -95,12 +110,37 @@ class AnomalyDetectorManager:
     def add_detector(self, detector: Any, interval_ms: int) -> None:
         self._detectors.append((detector, interval_ms / 1000.0))
 
+    @staticmethod
+    def _anomaly_signature(anomaly: Anomaly) -> tuple:
+        """Incident identity for heal-chain dedup: a detector
+        re-reporting the SAME ongoing condition each interval is one
+        heal, not many. Types without a natural signature never dedup
+        (each report is its own chain)."""
+        failed = getattr(anomaly, "failed_brokers", None)
+        if failed:
+            return tuple(sorted(failed))
+        disks = getattr(anomaly, "failed_disks", None)
+        if disks:
+            return tuple(sorted((b, tuple(sorted(d)))
+                                for b, d in disks.items()))
+        fixable = getattr(anomaly, "fixable_goals", None)
+        unfixable = getattr(anomaly, "unfixable_goals", None)
+        if fixable is not None or unfixable is not None:
+            return tuple(sorted(fixable or ())) \
+                + tuple(sorted(unfixable or ()))
+        return (anomaly.anomaly_id,)
+
     def report(self, anomaly: Anomaly) -> None:
         """Producer side (what detectors call). Thread-safe."""
         # Per-type anomaly rate (AnomalyDetectorManager.java:190 sensors).
         from ..utils.sensors import SENSORS
         SENSORS.count("anomaly_detector_anomalies", labels={
             "type": anomaly.anomaly_type.name})
+        # Heal ledger: the correlation chain opens HERE, at detection —
+        # phase transitions downstream attach to this chain's id.
+        self.heal_ledger.open(anomaly.anomaly_type.name,
+                              anomaly.anomaly_id,
+                              self._anomaly_signature(anomaly))
         rec = AnomalyRecord(anomaly,
                             status_time_ms=int(self._clock() * 1000))
         with self._cv:
@@ -167,6 +207,20 @@ class AnomalyDetectorManager:
             return False
         if breaker is not None:
             breaker.record_success(name)
+        # Heal-ledger all-clear seam: a detector that just verified its
+        # condition GONE is the violation re-check — open chains of the
+        # types it owns resolve as cleared. Detectors opt in by exposing
+        # ``CLEARS`` (anomaly type names) + ``all_clear()``.
+        clears = getattr(detector, "CLEARS", ())
+        probe = getattr(detector, "all_clear", None)
+        if clears and probe is not None:
+            try:
+                if probe():
+                    self.heal_ledger.clear_types(clears)
+            except Exception:  # noqa: BLE001 — observation must never
+                # affect the detection loop
+                LOG.debug("heal-ledger all-clear probe failed for %s",
+                          name, exc_info=True)
         return True
 
     # -- simulated-time driving (digital-twin simulator, round 11) ---------
@@ -225,7 +279,13 @@ class AnomalyDetectorManager:
                 rec = self._records.get(anomaly.anomaly_id)
                 if rec is not None:
                     rec.status = AnomalyStatus.IGNORED
+                # The condition cleared on its own while parked: the
+                # documented self_cleared terminal.
+                self.heal_ledger.handle_for(anomaly.anomaly_id).resolve(
+                    "self_cleared")
                 continue
+            self.heal_ledger.handle_for(anomaly.anomaly_id).phase(
+                "recheck_promoted")
             heapq.heappush(self._queue, (
                 (anomaly.anomaly_type.priority, anomaly.detection_time_ms),
                 self._queue_seq, anomaly))
@@ -263,45 +323,86 @@ class AnomalyDetectorManager:
     def handle_anomaly(self, anomaly: Anomaly) -> str:
         """One notifier-consult + fix cycle; returns the AnomalyStatus.
         Public so tests and embedded deployments can drive it synchronously."""
+        from ..utils.heal_ledger import heal_scope
         rec = self._records.get(anomaly.anomaly_id) or AnomalyRecord(anomaly)
+        heal = self.heal_ledger.handle_for(anomaly.anomaly_id)
         try:
-            result = self._notifier.on_anomaly(anomaly)
+            # The notifier consult runs inside the heal scope so its
+            # escalation outcomes (alert webhooks) attribute themselves.
+            with heal_scope(heal):
+                result = self._notifier.on_anomaly(anomaly)
         except Exception:
             LOG.exception("notifier failed; ignoring anomaly")
             rec.status = AnomalyStatus.IGNORED
+            heal.resolve("ignored", reason="notifier failed")
             return rec.status
         if result.action is AnomalyNotificationAction.IGNORE:
             rec.status = AnomalyStatus.IGNORED
+            heal.resolve("ignored", verdict="IGNORE")
         elif result.action is AnomalyNotificationAction.CHECK:
             rec.status = AnomalyStatus.CHECK_WITH_DELAY
+            heal.phase("verdict", action="CHECK", delayMs=result.delay_ms)
             with self._cv:
                 heapq.heappush(
                     self._recheck,
                     (self._clock() + result.delay_ms / 1000.0, anomaly))
                 self._cv.notify_all()
         else:
-            rec.status = self._fix(anomaly)
+            heal.phase("verdict", action="FIX")
+            rec.status = self._fix(anomaly, heal=heal)
         rec.status_time_ms = int(self._clock() * 1000)
         return rec.status
 
-    def _fix(self, anomaly: Anomaly) -> str:
-        """Completeness gate + fix dispatch (:513-549)."""
+    def _fix(self, anomaly: Anomaly, heal: Any = None) -> str:
+        """Completeness gate + fix dispatch (:513-549). ``heal`` is the
+        chain handle the caller already resolved (handle_anomaly passes
+        its own — one lookup, one handle, so the verdict and fix phases
+        can never land on different chains across a ring eviction)."""
+        from ..utils.heal_ledger import heal_scope
+        if heal is None:
+            heal = self.heal_ledger.handle_for(anomaly.anomaly_id)
         if self._facade is None:
+            heal.resolve("fix_failed_to_start", reason="no facade")
             return AnomalyStatus.FIX_FAILED_TO_START
         ready = getattr(self._facade, "ready_for_self_healing", lambda: True)
         if not ready():
             LOG.info("skipping fix: load model not ready for self-healing")
+            heal.resolve("fix_failed_to_start", reason="model not ready")
             return AnomalyStatus.FIX_FAILED_TO_START
         try:
             run = self.fix_runner or (lambda fn: fn())
-            started = run(lambda: anomaly.fix(self._facade))
-        except Exception:
+            # fix_started lands BEFORE the dispatch: time-to-start-fix
+            # (AnomalyDetectorState parity) measures detection→dispatch,
+            # not detection→completion.
+            heal.phase("fix_started")
+            with heal_scope(heal):
+                started = run(lambda: anomaly.fix(self._facade))
+        except Exception as e:
+            from ..utils.resilience import BreakerOpenError
+            if isinstance(e, BreakerOpenError):
+                # The fleet scheduler (or model breaker) failed the fix
+                # fast — a documented terminal distinct from a fix that
+                # crashed: the heal was never attempted.
+                LOG.warning("anomaly fix skipped: breaker open (%s)", e)
+                self._num_fix_failures += 1
+                heal.resolve("breaker_skipped", reason=str(e),
+                             own_fix_started=True)
+                return AnomalyStatus.FIX_FAILED_TO_START
             LOG.exception("anomaly fix failed to start")
             self._num_fix_failures += 1
+            heal.resolve("fix_failed_to_start",
+                         reason=type(e).__name__, own_fix_started=True)
             return AnomalyStatus.FIX_FAILED_TO_START
         if started:
-            self._num_self_healing_started += 1
+            a_type = anomaly.anomaly_type.name
+            with self._cv:
+                self._self_healing_started_by_type[a_type] = \
+                    self._self_healing_started_by_type.get(a_type, 0) + 1
+            from ..utils.sensors import SENSORS
+            SENSORS.count("self_healing_started", labels={"type": a_type})
             return AnomalyStatus.FIX_STARTED
+        heal.resolve("fix_failed_to_start", reason="fix declined",
+                     own_fix_started=True)
         return AnomalyStatus.FIX_FAILED_TO_START
 
     # -- state (anomaly_detector_state endpoint) ---------------------------
@@ -311,6 +412,8 @@ class AnomalyDetectorManager:
 
     def state(self) -> dict:
         enabled = self._notifier.self_healing_enabled()
+        with self._cv:
+            started_by_type = dict(self._self_healing_started_by_type)
         return {
             "selfHealingEnabled": [t.name for t, on in enabled.items() if on],
             "selfHealingDisabled": [t.name for t, on in enabled.items() if not on],
@@ -321,8 +424,18 @@ class AnomalyDetectorManager:
                  "statusTimeMs": r.status_time_ms,
                  "reasons": r.anomaly.reasons()}
                 for r in self._history[-20:]],
+            # Heal-ledger parity fields (AnomalyDetectorState.java:
+            # anomaly state history + mean-time-to-start-fix): the last
+            # N correlated chains and the detected→fix_started mean.
+            "recentHeals": self.heal_ledger.recent_summaries(10),
+            "meanTimeToStartFixMs":
+                self.heal_ledger.mean_time_to_start_fix_ms(),
             "metrics": {
-                "numSelfHealingStarted": self._num_self_healing_started,
+                # The sum keeps the pre-round-16 JSON field; the per-type
+                # breakdown is new (self_healing_started_total{type=} is
+                # the sensor twin).
+                "numSelfHealingStarted": sum(started_by_type.values()),
+                "selfHealingStartedByType": started_by_type,
                 "numFixFailures": self._num_fix_failures,
                 "queueSize": len(self._queue)},
         }

@@ -106,6 +106,11 @@ class SelfHealingNotifier(AnomalyNotifier):
     def alert(self, anomaly: Anomaly, auto_fix_triggered: bool) -> None:
         LOG.warning("anomaly alert (auto_fix=%s): %s", auto_fix_triggered,
                     anomaly.reasons())
+        # Heal ledger: the escalation outcome lands on the anomaly's
+        # correlation chain (the manager consults the notifier inside
+        # the ambient heal scope; standalone notifiers record nothing).
+        from ..utils.heal_ledger import current_heal
+        current_heal().phase("alerted", autoFix=bool(auto_fix_triggered))
 
     def on_anomaly(self, anomaly: Anomaly) -> AnomalyNotificationResult:
         if anomaly.anomaly_type is AnomalyType.BROKER_FAILURE:

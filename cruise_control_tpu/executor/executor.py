@@ -18,6 +18,7 @@ import time
 from typing import Callable, Sequence
 
 from ..analyzer.proposals import ExecutionProposal
+from ..utils.heal_ledger import NO_HEAL, current_heal
 from ..utils.resilience import RetryPolicy, call_with_resilience
 from .admin import AdminBackend
 from .concurrency import ConcurrencyCaps, ExecutionConcurrencyManager
@@ -133,6 +134,10 @@ class Executor:
         self._history: list[dict] = []
         self._caps_snapshot: ConcurrencyCaps | None = None
         self._override_dims: set[str] = set()
+        # Heal ledger: the correlation handle captured at
+        # execute_proposals time (the execution runnable is a fresh
+        # thread, so the ambient ContextVar would not cross into it).
+        self._heal = NO_HEAL
 
     # ---- public surface ---------------------------------------------------
     @property
@@ -211,6 +216,13 @@ class Executor:
             self._verify_attempts = {}
             tasks = self._task_manager.tasks_from_proposals(proposals)
             self._planner.add_tasks(tasks, self._admin)
+            # A self-healing fix's execution attributes its submit/
+            # progress/timeout/dead-letter phases to the heal chain
+            # ambient on the SUBMITTING thread (NO_HEAL otherwise).
+            self._heal = current_heal()
+            self._heal.phase("execution_started", uuid=uuid,
+                             numProposals=len(proposals),
+                             numTasks=len(tasks))
         if self._synchronous:
             self._run()
         else:
@@ -309,6 +321,15 @@ class Executor:
             "durationS": round(time.time() - t0, 3),
             "taskCounts": tm.tracker.counts() if tm else {},
         }
+        heal, self._heal = self._heal, NO_HEAL
+        abandoned = sum(by_state.get("abandoned", 0)
+                        for by_state in summary["taskCounts"].values())
+        heal.phase("execution_finished", stopped=bool(summary["stopped"]),
+                   taskCounts=summary["taskCounts"])
+        if abandoned:
+            # Dead-lettered submissions are a documented heal terminal:
+            # the control plane never got the whole fix through.
+            heal.resolve("dead_lettered", numTasks=abandoned)
         self._check_movement_rate(summary)
         self._history.append(summary)
         # Execution sensors (Executor.java:145-148,346).
@@ -521,6 +542,8 @@ class Executor:
                 "byType": by_type,
                 "taskIds": [t.execution_id for t in abandoned],
                 "attempts": self._dead_letter_attempts})
+            self._heal.phase("dead_letter", numTasks=len(abandoned),
+                             byType=by_type)
         if retry:
             self._planner.add_tasks(retry, self._admin)
 
@@ -581,6 +604,8 @@ class Executor:
         from ..utils.sensors import SENSORS
         SENSORS.count("task_timeouts", labels={"type": task.task_type.value})
         self._notify_event("on_task_timeout", task.to_dict())
+        self._heal.phase("task_timeout", type=task.task_type.value,
+                         executionId=task.execution_id)
         return True
 
     # ---- the proposal execution runnable ---------------------------------
@@ -676,6 +701,11 @@ class Executor:
                                 tuple(set(task.proposal.replicas_to_add)
                                       | set(task.proposal.replicas_to_remove)))
                         in_flight.extend(batch)
+                        finished, total = tracker.progress()
+                        self._heal.phase(
+                            "execution_progress",
+                            type="inter_broker", submitted=len(batch),
+                            finished=finished, total=total)
                     else:
                         sp.set(submit_failed=True)
 
@@ -829,6 +859,12 @@ class Executor:
                     if not ok:
                         sp.set(submit_failed=True)
                         batch = []
+                    if batch:
+                        finished, total = tracker.progress()
+                        self._heal.phase(
+                            "execution_progress",
+                            type="intra_broker", submitted=len(batch),
+                            finished=finished, total=total)
                     for task in batch:
                         tracker.transition(task, task.in_progress)
                         p = task.proposal
@@ -949,6 +985,11 @@ class Executor:
                                 tracker.transition(task, task.kill)
                         if missing:
                             self._requeue_or_kill_unverified(missing)
+                        finished, total = tracker.progress()
+                        self._heal.phase(
+                            "execution_progress",
+                            type="leadership", submitted=len(batch),
+                            finished=finished, total=total)
             if failed:
                 # Outside the span: idle backoff must not inflate the
                 # recorded batch_submit duration.

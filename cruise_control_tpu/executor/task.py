@@ -165,6 +165,16 @@ class ExecutionTaskTracker:
         with self._lock:
             return sum(len(self._tasks[t][s]) for t in TaskType for s in done)
 
+    def progress(self) -> tuple[int, int]:
+        """(finished, total) under one lock acquisition — the heal
+        ledger's per-batch movement-progress snapshot."""
+        done = (TaskState.COMPLETED, TaskState.ABORTED, TaskState.DEAD,
+                TaskState.ABANDONED)
+        with self._lock:
+            finished = sum(len(self._tasks[t][s])
+                           for t in TaskType for s in done)
+            return finished, len(self._by_id)
+
     def num_total(self) -> int:
         with self._lock:
             return len(self._by_id)

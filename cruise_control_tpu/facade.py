@@ -208,8 +208,21 @@ class CruiseControl:
         self._optimizer = optimizer or GoalOptimizer(config)
         self._notifier = notifier or SelfHealingNotifier(
             config, now_ms=self._now_ms)
+        # Heal ledger (round 16): the anomaly-lifecycle journal. One
+        # PER FACADE — a fleet's clusters and an embedded digital twin
+        # each journal on their own (possibly simulated) clock, the same
+        # isolation discipline as configure_observability. Served as
+        # GET /heals; the detector manager opens chains at detection and
+        # the facade/scheduler/executor phases attach ambiently.
+        from .utils.heal_ledger import HealLedger
+        self.heal_ledger = HealLedger(
+            enabled=config.get_boolean("heal.ledger.enabled"),
+            max_chains=config.get_int("heal.ledger.max.chains"),
+            max_phases=config.get_int("heal.ledger.max.phases"),
+            clock=clock if clock is not None else time.time)
         self._anomaly_detector = AnomalyDetectorManager(
-            config, self._notifier, facade=self, clock=self._clock)
+            config, self._notifier, facade=self, clock=self._clock,
+            ledger=self.heal_ledger)
         self.maintenance_reader = self._configured_maintenance_reader(config)
         # Executor.java demotion/removal history consumed by the
         # exclude_recently_* request parameters and the ADMIN drop_* params;
@@ -461,6 +474,12 @@ class CruiseControl:
         state, meta = self._model(
             self._requirements_for(data_from, chain),
             allow_capacity_estimation=allow_capacity_estimation)
+        # Heal ledger: a fix operation's model build is a phase on its
+        # correlation chain (NO_HEAL no-op outside a heal scope).
+        from .utils.heal_ledger import current_heal
+        current_heal().phase("model_built",
+                             brokers=len(meta.broker_ids),
+                             partitions=len(meta.partition_index))
         return chain, state, meta
 
     def _requirements_for(self, data_from: str | None, chain,
@@ -782,6 +801,10 @@ class CruiseControl:
                     from .utils.sensors import SENSORS
                     SENSORS.count("proposals_stale_served")
                     SENSORS.gauge("proposals_stale_age_seconds", staleness_s)
+                    # Stale-serving window correlation: any heal in
+                    # flight carries the evidence that serving degraded
+                    # during its window.
+                    self.heal_ledger.note_stale(staleness_s)
                     from .utils.tracing import TRACER
                     TRACER.annotate(stale=True, staleness_s=staleness_s)
                     return OperationResult(
@@ -813,11 +836,26 @@ class CruiseControl:
         deficit-aware count-goal sizing, and a fleet-wired deployment
         must not return different proposals than a standalone one for
         the same cluster state."""
+        from .utils.heal_ledger import current_heal
+        heal = current_heal()
         width = self.megabatch_solve_width
-        if width and not options.fast_mode \
-                and self._optimizer.mesh is None \
-                and not self._optimizer.deficit_sizing_active(
-                    state.num_brokers):
+        batched = bool(width and not options.fast_mode
+                       and self._optimizer.mesh is None
+                       and not self._optimizer.deficit_sizing_active(
+                           state.num_brokers))
+        # Heal-correlated solves link the flight recorder's pass ids:
+        # the chain's solve_completed phase names the passSeq values that
+        # resolve in GET /solver (best-effort window — a concurrent
+        # solve from another thread can land inside it, so the ids are
+        # filtered by this solve's ambient cluster label).
+        marker = None
+        if heal.recording:
+            from .utils.flight_recorder import FLIGHT
+            if FLIGHT.enabled:
+                marker = FLIGHT.marker()
+            heal.phase("solve_dispatched",
+                       path="megabatch" if batched else "serial")
+        if batched:
             from .utils.sensors import current_cluster_label
             cid = current_cluster_label() or "default"
             out = self._optimizer.optimizations_megabatch(
@@ -826,8 +864,30 @@ class CruiseControl:
             res = out[0]
             if isinstance(res, Exception):
                 raise res
-            return res
-        return self._optimizer.optimizations(state, meta, chain, options)
+        else:
+            res = self._optimizer.optimizations(state, meta, chain, options)
+        if heal.recording:
+            detail: dict = {}
+            if marker is not None:
+                from .utils.flight_recorder import FLIGHT
+                from .utils.sensors import current_cluster_label
+                # The batched path records its flight pass under the
+                # same "default" fallback it solved under — the filter
+                # label must match or the /solver link comes back empty
+                # exactly on the megabatch path.
+                label = current_cluster_label() \
+                    or ("default" if batched else None)
+                detail["passSeqs"] = [
+                    p["passSeq"] for p in FLIGHT.passes_since(marker)
+                    if p.get("cluster") == label]
+            if batched:
+                # The fleet-wired solve rode the batched kernels at
+                # occupancy 1 (one compiled program per bucket shape
+                # serves fixes and precomputes alike).
+                detail["batchWidth"] = width
+            heal.phase("solve_completed", **detail)
+            heal.phase("proposal_ready", numProposals=len(res[1].proposals))
+        return res
 
     # -- megabatch precompute seams (fleet.megabatch) ----------------------
     def precompute_inputs(self):

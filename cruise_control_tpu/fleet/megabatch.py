@@ -170,6 +170,14 @@ class MegabatchRunner:
         prepared: list[tuple] = []     # (job, payload, outcomes, stats)
         flat: list[tuple] = []         # (prepared_idx, slot, SolveItem)
         for job in jobs:
+            heal = getattr(job, "heal", None)
+            if heal is not None:
+                # Batch-coalescing attribution: a heal-correlated job
+                # that drained into a megabatch turn records the batch
+                # geometry it actually shared.
+                heal.phase("batch_coalesced", occupancy=len(jobs),
+                           width=self._width)
+        for job in jobs:
             payload = job.payload
             try:
                 entries = payload.prepare(self._optimizer)

@@ -54,6 +54,11 @@ class SolverJob:
     # stays the solo fallback for inline/shutdown execution.
     batch_key: tuple | None = None
     payload: Any = None
+    # Heal-ledger correlation (round 16): the ambient heal handle at
+    # submit time (None when no heal in flight). A self-healing fix
+    # routed through the scheduler re-enters its heal scope on the
+    # worker thread and attributes its queue wait to the chain.
+    heal: Any = None
 
 
 class FleetScheduler:
@@ -126,10 +131,13 @@ class FleetScheduler:
     def submit(self, cluster_id: str, kind: JobKind,
                fn: Callable[[], Any], batch_key: tuple | None = None,
                payload: Any = None) -> Future:
+        from ..utils.heal_ledger import current_heal
+        heal = current_heal()
         job = SolverJob(kind=kind, cluster_id=cluster_id, fn=fn,
                         future=Future(), enqueued_at=self._clock(),
                         seq=self._next_seq(), batch_key=batch_key,
-                        payload=payload)
+                        payload=payload,
+                        heal=heal if heal.recording else None)
         with self._cond:
             if self._shut:
                 # After shutdown nothing drains the queue; a queued job's
@@ -179,6 +187,14 @@ class FleetScheduler:
                     SENSORS.count("fleet_jobs_skipped",
                                   labels={"cluster": job.cluster_id,
                                           "kind": job.kind.name})
+                    if job.heal is not None:
+                        # A fix skipped by an open breaker is a
+                        # documented heal terminal — the manager also
+                        # resolves breaker_skipped on the raised error,
+                        # but the resolve is idempotent (first wins) and
+                        # a non-fix correlated job records it here.
+                        job.heal.resolve("breaker_skipped",
+                                         cluster=job.cluster_id)
                     job.future.set_exception(BreakerOpenError(
                         job.cluster_id,
                         self._breaker.retry_after_s(job.cluster_id)))
@@ -229,6 +245,7 @@ class FleetScheduler:
         return batch
 
     def _run(self, job: SolverJob) -> None:
+        from ..utils.heal_ledger import heal_scope
         from ..utils.sensors import SENSORS, cluster_label
         from ..utils.tracing import TRACER
         wait_s = max(self._clock() - job.enqueued_at, 0.0)
@@ -241,16 +258,24 @@ class FleetScheduler:
         SENSORS.observe("fleet_queue_wait_seconds", wait_s,
                         labels={"cluster": job.cluster_id,
                                 "kind": job.kind.name})
+        if job.heal is not None:
+            # Where the heal's time went, scheduler edition: the chain
+            # sees how long the fix sat behind other clusters' work.
+            job.heal.phase("solver_queued", kind=job.kind.name,
+                           waitS=round(wait_s, 6))
         t0 = time.monotonic()
         try:
             # The job's own operation trace (the facade op opens the root
             # span) gets the queue wait attached via the wrapping span —
             # worker threads have no ambient parent, so fleet.job IS the
-            # root and the op span nests under it.
+            # root and the op span nests under it. The heal scope is
+            # re-entered explicitly: ContextVars do not cross into the
+            # worker thread.
             with cluster_label(job.cluster_id), \
                     TRACER.span("fleet.job", operation=f"fleet.{job.kind.name.lower()}",
                                 cluster=job.cluster_id, kind=job.kind.name,
-                                queue_wait_s=round(wait_s, 6)):
+                                queue_wait_s=round(wait_s, 6)), \
+                    heal_scope(job.heal):
                 result = job.fn()
         except BaseException as e:  # noqa: BLE001 — carried by the future
             if self._breaker is not None:
@@ -286,6 +311,9 @@ class FleetScheduler:
             SENSORS.observe("fleet_queue_wait_seconds", wait_s,
                             labels={"cluster": job.cluster_id,
                                     "kind": job.kind.name})
+            if job.heal is not None:
+                job.heal.phase("solver_queued", kind=job.kind.name,
+                               waitS=round(wait_s, 6))
         try:
             # No ambient cluster label: the batch belongs to the FLEET
             # (per-cluster attribution happens inside the runner with

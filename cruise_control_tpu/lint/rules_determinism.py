@@ -39,6 +39,11 @@ class DeterminismRule(Rule):
         # request — the serving contract, not just a test convenience.
         "cruise_control_tpu/futures/generator.py",
         "cruise_control_tpu/futures/evaluator.py",
+        # Heal ledger (round 16): chains stamp every phase from the
+        # injectable clock seam — a wall-clock call here would desync
+        # the twin's cross-validation (ledger durations must equal
+        # ScenarioScore time-to-heal on the sim clock).
+        "cruise_control_tpu/utils/heal_ledger.py",
     )
 
     CLOCK_CALLS = ("time.time", "time.time_ns", "time.monotonic",

@@ -694,8 +694,15 @@ class ClusterSimulator:
         bal = self.cc.goal_violation_detector.balancedness_score \
             if self.cc.goal_violation_detector._last_result is not None \
             else None
+        healthy = self._healthy()
+        # Heal-ledger cross-validation anchor: the twin feeds the ledger
+        # the SAME per-tick health observation the score closes its
+        # HealEvents with, so ledger heal durations and ScenarioScore
+        # time-to-heal share one closing tick (observation only — the
+        # score JSON and trajectory are byte-identical ledger on/off).
+        self.cc.heal_ledger.observe_health(healthy)
         self.score.observe_tick(tick, bal, replica_moves, leader_moves,
-                                bytes_mb, healthy=self._healthy(),
+                                bytes_mb, healthy=healthy,
                                 degraded=degraded)
 
     def advance(self, ticks: int) -> None:

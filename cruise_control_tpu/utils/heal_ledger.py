@@ -1,0 +1,521 @@
+"""Heal ledger: end-to-end anomaly lifecycle tracking.
+
+ROADMAP item 3 targets second-scale anomaly→proposal latency scored by
+the twin's time-to-heal SLO — but until this module, time-to-heal was
+only measurable *inside* ``testing/simulator.py``. A production process
+could not answer "how long did the last broker-failure heal take, and
+where did the time go?". The ledger is that ruler: a bounded,
+lock-guarded, injectable-clock journal that assigns every anomaly a
+correlation id at detection and records phase transitions across the
+whole pipeline —
+
+  detected → (alerted / verdict: fix|check|ignore) → fix_started →
+  model_built → solve_dispatched / solve_completed (linking the flight
+  recorder's pass ids) → proposal_ready → execution_started →
+  per-batch execution_progress → execution_finished → **cleared**
+  (the violation re-checked clear), or a terminal alternative:
+  ignored / self_cleared / fix_failed_to_start / breaker_skipped /
+  dead_lettered / evicted.
+
+Correlation rides the pipeline AMBIENTLY (the ``cluster_label`` /
+tracing discipline): the detector manager opens a chain at ``report()``
+and enters ``heal_scope(handle)`` around the notifier consult and the
+fix dispatch; the facade's model/solve seams, the fleet scheduler's
+queue, the megabatch runner, and the executor all record onto
+``current_heal()`` with zero plumbing. Handles are BOUND to their
+ledger, so a fleet process (one ledger per cluster facade) and an
+embedded digital twin (its own facade, its own sim-clocked ledger)
+never cross-pollinate — the same isolation rule as
+``configure_observability=False``.
+
+Contract (pinned in tests/test_heal_ledger.py, the flight-recorder
+family):
+
+- **Observation never changes behavior**: the ledger reads values the
+  pipeline already computed — proposals and final assignments are
+  byte-identical with the ledger on or off.
+- **Near-zero disabled overhead**: disabled, every hook resolves to the
+  shared ``NO_HEAL`` no-op handle; bench emits the measured ns/call as
+  ``heal_ledger_noop_overhead``.
+- **Cross-validated against the twin**: on the injectable clock the
+  digital twin drives the ledger and ``ScenarioScore`` from the same
+  health observation, so per-fault ledger heal durations equal the
+  score's time-to-heal ticks exactly (tests/test_heal_ledger.py).
+
+Served as ``GET /kafkacruisecontrol/heals`` (VIEWER) and exported as
+``heal_phase_seconds{phase=}`` / ``time_to_heal_seconds{type=}``
+histograms, the ``heals_open{type=}`` gauge, and the per-type
+``self_healing_started_total{type=}`` counter (detector/manager.py).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import threading
+import time
+from contextlib import contextmanager
+
+from .sensors import SENSORS, current_cluster_label
+
+# Heal durations span "one solve" to "hours of escalation": a wider
+# log-spaced ladder than the default span buckets.
+HEAL_BUCKETS = (0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 150.0, 300.0,
+                600.0, 1800.0, 3600.0, 14400.0)
+
+#: Terminal outcomes a chain can resolve with (documented vocabulary —
+#: tests pin that every escalation path lands on one of these).
+OUTCOMES = ("cleared", "self_cleared", "ignored", "fix_failed_to_start",
+            "breaker_skipped", "dead_lettered", "evicted")
+
+#: Anomaly types whose heal is a cluster-health condition: a healthy
+#: cluster observation (``observe_health``) closes their open chains,
+#: mirroring ScenarioScore's heal-event semantics.
+HEALTH_TYPES = ("BROKER_FAILURE", "DISK_FAILURE")
+
+
+class _NullHealHandle:
+    """Shared no-op handle: the disabled path (and every call site with
+    no heal in flight) costs one attribute load + one empty-method call
+    per record site — all of which sit at phase granularity, never in a
+    solver loop."""
+
+    __slots__ = ()
+    recording = False
+
+    def phase(self, name: str, **detail) -> None:
+        pass
+
+    def resolve(self, outcome: str, **detail) -> None:
+        pass
+
+
+NO_HEAL = _NullHealHandle()
+
+# Ambient correlation (the sensors.cluster_label pattern): the handle of
+# the heal currently being worked on this thread/task, or NO_HEAL.
+_HEAL: contextvars.ContextVar["HealHandle | _NullHealHandle"] = \
+    contextvars.ContextVar("heal_handle", default=NO_HEAL)
+
+
+def current_heal() -> "HealHandle | _NullHealHandle":
+    return _HEAL.get()
+
+
+@contextmanager
+def heal_scope(handle: "HealHandle | _NullHealHandle | None"):
+    """Attribute all heal phases recorded inside the block to ``handle``
+    (None → NO_HEAL, so call sites need no branching)."""
+    token = _HEAL.set(handle if handle is not None else NO_HEAL)
+    try:
+        yield
+    finally:
+        _HEAL.reset(token)
+
+
+class HealChain:
+    """One anomaly's lifecycle record (one incident: re-detections of
+    the same ongoing condition alias onto the open chain instead of
+    opening a new one)."""
+
+    __slots__ = ("chain_id", "anomaly_id", "anomaly_type", "cluster",
+                 "signature", "opened_ms", "phases", "outcome",
+                 "resolved_ms", "dropped_phases")
+
+    def __init__(self, chain_id: str, anomaly_id: str, anomaly_type: str,
+                 cluster: str | None, signature: tuple, opened_ms: int):
+        self.chain_id = chain_id
+        self.anomaly_id = anomaly_id
+        self.anomaly_type = anomaly_type
+        self.cluster = cluster
+        self.signature = signature
+        self.opened_ms = opened_ms
+        self.phases: list[dict] = [{"phase": "detected", "atMs": opened_ms,
+                                    "durationMs": 0}]
+        self.outcome: str | None = None
+        self.resolved_ms: int | None = None
+        self.dropped_phases = 0
+
+    @property
+    def open(self) -> bool:
+        return self.outcome is None
+
+    @property
+    def last_ms(self) -> int:
+        return self.phases[-1]["atMs"] if self.phases else self.opened_ms
+
+    def heal_seconds(self) -> float | None:
+        if self.resolved_ms is None:
+            return None
+        return (self.resolved_ms - self.opened_ms) / 1000.0
+
+    def time_to_start_fix_ms(self) -> int | None:
+        for p in self.phases:
+            if p["phase"] == "fix_started":
+                return p["atMs"] - self.opened_ms
+        return None
+
+    def to_dict(self) -> dict:
+        out = {
+            "chainId": self.chain_id,
+            "anomalyId": self.anomaly_id,
+            "anomalyType": self.anomaly_type,
+            "cluster": self.cluster,
+            "signature": list(self.signature),
+            "openedAtMs": self.opened_ms,
+            "outcome": self.outcome,
+            "resolvedAtMs": self.resolved_ms,
+            "healSeconds": self.heal_seconds(),
+            "timeToStartFixMs": self.time_to_start_fix_ms(),
+            "phases": [dict(p) for p in self.phases],
+        }
+        if self.dropped_phases:
+            # No silent caps: a chain past max_phases says how many
+            # transitions it could not keep.
+            out["droppedPhases"] = self.dropped_phases
+        return out
+
+
+class HealHandle:
+    """Correlation handle bound to (ledger, chain): what rides the
+    ambient context through the pipeline. Stays valid after the chain
+    resolves (late executor phases on a dead-lettered chain are
+    recorded; a second resolve is ignored)."""
+
+    __slots__ = ("_ledger", "chain_id")
+    recording = True
+
+    def __init__(self, ledger: "HealLedger", chain_id: str):
+        self._ledger = ledger
+        self.chain_id = chain_id
+
+    def phase(self, name: str, **detail) -> None:
+        self._ledger._phase(self.chain_id, name, detail)
+
+    def resolve(self, outcome: str, **detail) -> None:
+        self._ledger._resolve(self.chain_id, outcome, detail)
+
+
+class HealLedger:
+    """Bounded, lock-guarded, injectable-clock journal of heal chains.
+
+    One instance per CruiseControl facade (so a fleet's clusters and an
+    embedded twin each journal on their OWN clock); the API serves the
+    routed facade's ledger. The injectable ``clock`` (seconds; the
+    SimClock seam) is the only time source — CCSA004 lists this module
+    as deterministic."""
+
+    def __init__(self, enabled: bool = True, max_chains: int = 256,
+                 max_phases: int = 64, clock=time.time):
+        self._enabled = bool(enabled)
+        self._max_chains = max(1, int(max_chains))
+        self._max_phases = max(4, int(max_phases))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._chains: list[HealChain] = []           # oldest first, bounded
+        self._by_id: dict[str, HealChain] = {}       # chain_id → chain
+        self._aliases: dict[str, str] = {}           # anomaly_id → chain_id
+        # Types the heals_open gauge has ever reported: a type whose
+        # chains all left the ring must re-emit 0, not freeze at its
+        # last nonzero value.
+        self._gauge_types: set[str] = set()
+        self.chains_opened = 0
+        self.chains_resolved = 0
+
+    # -- configuration -----------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def configure(self, enabled: bool | None = None,
+                  max_chains: int | None = None,
+                  max_phases: int | None = None) -> None:
+        with self._lock:
+            if enabled is not None:
+                self._enabled = bool(enabled)
+            if max_chains is not None:
+                self._max_chains = max(1, int(max_chains))
+            if max_phases is not None:
+                self._max_phases = max(4, int(max_phases))
+
+    def _now_ms(self) -> int:
+        return int(self._clock() * 1000)
+
+    # -- recording ---------------------------------------------------------
+    def open(self, anomaly_type: str, anomaly_id: str,
+             signature: tuple = ()) -> "HealHandle | _NullHealHandle":
+        """Open a chain at detection (or alias onto the open chain of
+        the same ongoing incident: same type + signature ⇒ one chain,
+        a ``redetected`` phase, and the new anomaly id resolving to it —
+        a detector re-reporting an unfixed condition every interval is
+        ONE heal, not many)."""
+        if not self._enabled:
+            return NO_HEAL
+        now = self._now_ms()
+        signature = tuple(signature)
+        with self._lock:
+            for c in reversed(self._chains):
+                if c.open and c.anomaly_type == anomaly_type \
+                        and c.signature == signature:
+                    self._aliases[anomaly_id] = c.chain_id
+                    self._append_phase_locked(c, "redetected", now,
+                                              {"anomalyId": anomaly_id})
+                    return HealHandle(self, c.chain_id)
+            self._seq += 1
+            chain = HealChain(f"heal-{self._seq}", anomaly_id, anomaly_type,
+                              current_cluster_label(), signature, now)
+            evicted = None
+            if len(self._chains) >= self._max_chains:
+                evicted = self._chains.pop(0)
+            self._chains.append(chain)
+            self._by_id[chain.chain_id] = chain
+            self._aliases[anomaly_id] = chain.chain_id
+            self.chains_opened += 1
+            evicted_open_type = None
+            if evicted is not None:
+                evicted_open_type = self._drop_locked(evicted)
+        SENSORS.count("heal_chains_opened",
+                      labels={"type": anomaly_type})
+        if evicted_open_type is not None:
+            # The ring bound closed a still-open heal: account it like
+            # any other terminal so chainsOpened/chainsResolved
+            # reconcile and the eviction is visible in /metrics even
+            # though the chain itself left the bounded export.
+            SENSORS.count("heal_chains_resolved",
+                          labels={"type": evicted_open_type,
+                                  "outcome": "evicted"})
+        self._emit_open_gauges()
+        return HealHandle(self, chain.chain_id)
+
+    def handle_for(self, anomaly_id: str) -> "HealHandle | _NullHealHandle":
+        """The handle correlated with ``anomaly_id`` (aliases included),
+        or NO_HEAL when the ledger is disabled / never saw it."""
+        if not self._enabled:
+            return NO_HEAL
+        with self._lock:
+            chain_id = self._aliases.get(anomaly_id)
+        return HealHandle(self, chain_id) if chain_id is not None else NO_HEAL
+
+    def _drop_locked(self, chain: HealChain) -> str | None:
+        """Forget an evicted chain (ring bound): a still-open chain
+        terminates as ``evicted`` and counts as resolved, so
+        chains_opened/chains_resolved always reconcile and the eviction
+        is observable (the caller emits the outcome sensor outside the
+        lock). Returns the anomaly type when an OPEN chain was closed,
+        else None. Caller holds the lock."""
+        was_open = chain.open
+        if was_open:
+            chain.outcome = "evicted"
+            chain.resolved_ms = self._now_ms()
+            self.chains_resolved += 1
+        self._by_id.pop(chain.chain_id, None)
+        for a in [a for a, cid in self._aliases.items()
+                  if cid == chain.chain_id]:
+            del self._aliases[a]
+        return chain.anomaly_type if was_open else None
+
+    def _append_phase_locked(self, chain: HealChain, name: str, now: int,
+                             detail: dict) -> dict | None:
+        if len(chain.phases) >= self._max_phases:
+            chain.dropped_phases += 1
+            return None
+        rec = {"phase": name, "atMs": now,
+               "durationMs": max(0, now - chain.last_ms)}
+        rec.update(detail)
+        chain.phases.append(rec)
+        return rec
+
+    def _phase(self, chain_id: str, name: str, detail: dict) -> None:
+        now = self._now_ms()
+        with self._lock:
+            chain = self._by_id.get(chain_id)
+            if chain is None:
+                return
+            rec = self._append_phase_locked(chain, name, now, detail)
+        if rec is not None:
+            SENSORS.observe("heal_phase_seconds", rec["durationMs"] / 1000.0,
+                            labels={"phase": name}, buckets=HEAL_BUCKETS)
+
+    def _resolve(self, chain_id: str, outcome: str, detail: dict) -> None:
+        now = self._now_ms()
+        with self._lock:
+            chain = self._by_id.get(chain_id)
+            if chain is None or not chain.open:
+                return
+            if outcome in ("fix_failed_to_start", "breaker_skipped"):
+                # SOFT terminals: a re-detection of an incident whose
+                # earlier fix IS already in flight (or done) can fail to
+                # start a redundant second fix — that must not close the
+                # chain out from under the real heal. ``own_fix_started``
+                # (popped — bookkeeping, not chain detail) says whether
+                # THIS failing attempt recorded a fix_started phase of
+                # its own (the dispatch-crash paths do; the no-facade /
+                # model-not-ready early-outs do not): the chain
+                # terminates only when no OTHER fix ever started; later
+                # failed attempts become phases and the chain stays open
+                # for cleared/dead_lettered to decide.
+                own = 1 if detail.pop("own_fix_started", False) else 0
+                attempts = sum(1 for p in chain.phases
+                               if p["phase"] == "fix_started")
+                if attempts > own:
+                    self._append_phase_locked(
+                        chain, f"{outcome}_attempt", now, detail)
+                    return
+            self._append_phase_locked(chain, outcome, now, detail)
+            chain.outcome = outcome
+            chain.resolved_ms = now
+            self.chains_resolved += 1
+            a_type = chain.anomaly_type
+            dur = chain.heal_seconds()
+        SENSORS.count("heal_chains_resolved",
+                      labels={"type": a_type, "outcome": outcome})
+        if outcome == "cleared":
+            SENSORS.observe("time_to_heal_seconds", dur,
+                            labels={"type": a_type}, buckets=HEAL_BUCKETS)
+        self._emit_open_gauges()
+
+    def _emit_open_gauges(self) -> None:
+        counts = self.open_counts()
+        with self._lock:
+            self._gauge_types |= set(counts)
+            types = sorted(self._gauge_types)
+        for a_type in types:
+            SENSORS.gauge("heals_open", counts.get(a_type, 0),
+                          labels={"type": a_type})
+
+    # -- clearing seams ----------------------------------------------------
+    def clear_types(self, anomaly_types, via: str = "detector_all_clear",
+                    ) -> int:
+        """Resolve every open chain of the given types as ``cleared`` —
+        the detector all-clear seam: a detector pass that found its
+        condition gone IS the violation re-check. Returns the number
+        cleared."""
+        if not self._enabled:
+            return 0
+        want = {str(getattr(t, "name", t)) for t in anomaly_types}
+        with self._lock:
+            due = [c.chain_id for c in self._chains
+                   if c.open and c.anomaly_type in want]
+        for cid in due:
+            self._resolve(cid, "cleared", {"via": via})
+        return len(due)
+
+    def observe_health(self, healthy: bool,
+                       anomaly_types=HEALTH_TYPES) -> int:
+        """Cluster-health observation seam: a healthy observation clears
+        the open chains of the cluster-health anomaly types, at the
+        observation's clock time. The digital twin calls this where it
+        scores per-tick health, so ledger heal durations and
+        ``ScenarioScore`` time-to-heal share the same closing anchor;
+        a production embedder with its own health probe may do the same
+        (the detector all-clear path covers deployments without one, at
+        detector-cadence granularity)."""
+        if not healthy:
+            return 0
+        return self.clear_types(anomaly_types, via="health_observation")
+
+    def note_stale(self, staleness_s: float) -> None:
+        """Degraded-serving correlation: the facade's stale-proposal
+        fallback stamps every open chain, so a heal whose window
+        overlapped stale serving carries the evidence. CONSECUTIVE
+        stamps coalesce into one phase (updated in place with a
+        ``staleServed`` count and the latest staleness) — a dashboard
+        polling a broken proposals path must not burn the chain's
+        max_phases budget and drop its real lifecycle phases."""
+        if not self._enabled:
+            return
+        now = self._now_ms()
+        detail = {"stalenessS": round(float(staleness_s), 3)}
+        with self._lock:
+            for c in self._chains:
+                if not c.open:
+                    continue
+                last = c.phases[-1]
+                if last["phase"] == "stale_serving":
+                    last["atMs"] = now
+                    last["stalenessS"] = detail["stalenessS"]
+                    last["staleServed"] = last.get("staleServed", 1) + 1
+                else:
+                    self._append_phase_locked(
+                        c, "stale_serving", now,
+                        {**detail, "staleServed": 1})
+
+    # -- export ------------------------------------------------------------
+    def open_counts(self) -> dict[str, int]:
+        with self._lock:
+            out: dict[str, int] = {}
+            for c in self._chains:
+                out.setdefault(c.anomaly_type, 0)
+                if c.open:
+                    out[c.anomaly_type] += 1
+            return out
+
+    def open_count(self) -> int:
+        with self._lock:
+            return sum(1 for c in self._chains if c.open)
+
+    def chains(self, anomaly_type: str | None = None,
+               limit: int | None = None) -> list[dict]:
+        """Recorded chains, newest first; ``anomaly_type`` filters."""
+        with self._lock:
+            snapshot = list(self._chains)
+        out: list[dict] = []
+        if limit is not None and limit <= 0:
+            return out
+        for c in reversed(snapshot):
+            if anomaly_type is not None and c.anomaly_type != anomaly_type:
+                continue
+            out.append(c.to_dict())
+            if limit is not None and len(out) >= limit:
+                break
+        return out
+
+    def recent_summaries(self, limit: int = 10) -> list[dict]:
+        """Compact rows for the STATE detector substate (type, duration,
+        outcome — the AnomalyDetectorState recentHeals parity field)."""
+        with self._lock:
+            snapshot = list(self._chains)[-limit:]
+        return [{"chainId": c.chain_id, "type": c.anomaly_type,
+                 "outcome": c.outcome,
+                 "healSeconds": c.heal_seconds(),
+                 "timeToStartFixMs": c.time_to_start_fix_ms()}
+                for c in reversed(snapshot)]
+
+    def mean_time_to_start_fix_ms(self) -> float | None:
+        """Mean detected→fix_started latency over recorded chains that
+        started a fix (AnomalyDetectorState.meanTimeToStartFix parity);
+        None when no fix ever started."""
+        with self._lock:
+            vals = [c.time_to_start_fix_ms() for c in self._chains]
+        vals = [v for v in vals if v is not None]
+        if not vals:
+            return None
+        return round(sum(vals) / len(vals), 3)
+
+    def heal_durations_s(self, anomaly_type: str | None = None,
+                         ) -> list[float]:
+        """Sorted heal durations (seconds) of CLEARED chains — the
+        bench/CI heal_p50/p99 hook and the twin cross-validation's
+        ground-truth comparison surface."""
+        with self._lock:
+            vals = [c.heal_seconds() for c in self._chains
+                    if c.outcome == "cleared"
+                    and (anomaly_type is None
+                         or c.anomaly_type == anomaly_type)]
+        return sorted(v for v in vals if v is not None)
+
+    def dump_json(self, path: str) -> int:
+        """Write every retained chain as one JSON document (bench/CI
+        observability artifact). Returns the number of chains written."""
+        chains = self.chains()
+        doc = {"numChains": len(chains), "chains": chains}
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+        return len(chains)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._chains.clear()
+            self._by_id.clear()
+            self._aliases.clear()

@@ -1,0 +1,39 @@
+"""CCSA004 + CCSA007 fixture: a heal-ledger-shaped journal with a
+wall-clock leak and an unlocked module-level chain ring (tests lint this
+file under a spoofed cruise_control_tpu/utils/heal_ledger.py path — the
+round-16 ledger sits under the same injectable-clock determinism
+contract as the twin, and its ring mutations must hold the lock)."""
+
+import threading
+import time
+
+_CHAINS: list = []
+_LOCK = threading.Lock()
+
+
+def bad_stamp() -> int:
+    return int(time.time() * 1000)       # finding: wall clock inline
+
+
+def injected_stamp(clock=time.time) -> int:
+    return int(clock() * 1000)           # clean: reference is the seam
+
+
+def bad_open(chain) -> None:
+    _CHAINS.append(chain)                # finding: unlocked ring mutation
+
+
+def good_open(chain) -> None:
+    with _LOCK:
+        _CHAINS.append(chain)            # clean: lock-guarded
+
+
+def tolerated_probe(chain) -> None:
+    # ccsa: ok[CCSA007] fixture: single-writer test harness by contract
+    _CHAINS.append(chain)
+
+
+def timed_probe() -> float:
+    # ccsa: ok[CCSA004] fixture: observability-only timer, never enters
+    # a chain's phase stamps
+    return time.perf_counter()

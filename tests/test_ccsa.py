@@ -140,6 +140,28 @@ def test_ccsa004_covers_futures_modules():
         assert not real_active, [f.message for f in real_active]
 
 
+def test_ccsa_covers_heal_ledger_module():
+    """The round-16 heal ledger is a deterministic module (CCSA004: its
+    phase stamps come from the injectable clock seam) whose chain ring
+    must mutate under the lock (CCSA007) — the fixture exercises both
+    under the spoofed ledger path, and the REAL module verifies clean."""
+    spoofed = ctx_for(FIXTURES / "bad_heal_ledger.py",
+                      "cruise_control_tpu/utils/heal_ledger.py")
+    active, suppressed = findings_of("CCSA004", spoofed)
+    assert len(active) == 1           # inline time.time()
+    assert len(suppressed) == 1       # documented perf_counter probe
+    assert "time.time" in active[0].message
+    lock_active, lock_suppressed = findings_of("CCSA007", spoofed)
+    assert len(lock_active) == 1      # unlocked _CHAINS.append
+    assert len(lock_suppressed) == 1  # documented single-writer append
+    assert "_CHAINS" in lock_active[0].message
+    rel = "cruise_control_tpu/utils/heal_ledger.py"
+    real = ctx_for(ROOT / rel, rel)
+    for rule in ("CCSA004", "CCSA007"):
+        real_active, _sup = findings_of(rule, real)
+        assert not real_active, [f.message for f in real_active]
+
+
 def test_ccsa004_hash_ban_is_repo_wide_but_clock_is_not():
     plain = ctx_for(FIXTURES / "bad_determinism.py")
     active, suppressed = findings_of("CCSA004", plain)

@@ -1,0 +1,635 @@
+"""Heal-ledger tests: journal mechanics on an injected clock, the
+notifier escalation paths' documented terminal phases, the
+observation-never-changes-behavior parity pin (ledger on/off ⇒
+byte-identical proposals + final assignment at two bucket shapes), the
+twin cross-validation (ledger heal durations == ScenarioScore
+time-to-heal ticks on the sim clock, score JSON unchanged), and the
+GET /heals endpoint serving a complete correlated chain whose solver
+pass ids resolve in GET /solver."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import sys
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from cruise_control_tpu.utils.heal_ledger import (  # noqa: E402
+    NO_HEAL, HealLedger, current_heal, heal_scope,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# Journal mechanics
+
+def test_chain_lifecycle_on_injected_clock():
+    clk = FakeClock()
+    led = HealLedger(clock=clk)
+    h = led.open("BROKER_FAILURE", "a-1", signature=(5,))
+    clk.t += 2.0
+    h.phase("verdict", action="FIX")
+    clk.t += 3.0
+    h.phase("fix_started")
+    clk.t += 5.0
+    h.resolve("cleared")
+    (c,) = led.chains()
+    assert c["outcome"] == "cleared"
+    assert c["healSeconds"] == 10.0
+    assert c["timeToStartFixMs"] == 5000
+    phases = [p["phase"] for p in c["phases"]]
+    assert phases == ["detected", "verdict", "fix_started", "cleared"]
+    assert [p["durationMs"] for p in c["phases"]] == [0, 2000, 3000, 5000]
+    assert led.heal_durations_s("BROKER_FAILURE") == [10.0]
+    assert led.mean_time_to_start_fix_ms() == 5000.0
+
+
+def test_redetection_aliases_onto_open_chain():
+    led = HealLedger(clock=FakeClock())
+    h1 = led.open("BROKER_FAILURE", "a-1", signature=(5,))
+    h2 = led.open("BROKER_FAILURE", "a-2", signature=(5,))
+    assert h2.chain_id == h1.chain_id
+    assert led.handle_for("a-2").chain_id == h1.chain_id
+    assert len(led.chains()) == 1
+    assert [p["phase"] for p in led.chains()[0]["phases"]] \
+        == ["detected", "redetected"]
+    # A different signature is a different incident.
+    h3 = led.open("BROKER_FAILURE", "a-3", signature=(7,))
+    assert h3.chain_id != h1.chain_id
+    # Resolved chains never absorb re-detections: same condition again
+    # later = a new heal.
+    h1.resolve("cleared")
+    h4 = led.open("BROKER_FAILURE", "a-4", signature=(5,))
+    assert h4.chain_id not in (h1.chain_id, h3.chain_id)
+
+
+def test_ring_bound_evicts_open_chains_loudly():
+    led = HealLedger(max_chains=2, clock=FakeClock())
+    h1 = led.open("GOAL_VIOLATION", "a-1", ("g1",))
+    led.open("GOAL_VIOLATION", "a-2", ("g2",))
+    led.open("GOAL_VIOLATION", "a-3", ("g3",))
+    chains = led.chains()
+    assert len(chains) == 2
+    # The evicted chain's handle goes dead (no resurrection) and its
+    # alias is pruned.
+    assert led.handle_for("a-1") is NO_HEAL
+    h1.phase("late")  # no-op on an evicted chain, never raises
+    h1.resolve("cleared")
+    assert {c["anomalyId"] for c in chains} == {"a-2", "a-3"}
+    # An open eviction counts as resolved (outcome=evicted), so the
+    # opened/resolved counters always reconcile.
+    assert led.chains_opened == 3
+    assert led.chains_resolved == 1
+    assert led.open_count() == 2
+
+
+def test_max_phases_counts_drops():
+    led = HealLedger(max_phases=4, clock=FakeClock())
+    h = led.open("BROKER_FAILURE", "a-1")
+    for i in range(6):
+        h.phase(f"p{i}")
+    (c,) = led.chains()
+    assert len(c["phases"]) == 4
+    assert c["droppedPhases"] == 3
+
+
+def test_disabled_ledger_is_shared_noop():
+    led = HealLedger(enabled=False)
+    h = led.open("BROKER_FAILURE", "a-1")
+    assert h is NO_HEAL and not h.recording
+    h.phase("anything")
+    h.resolve("cleared")
+    assert led.handle_for("a-1") is NO_HEAL
+    assert led.chains() == [] and led.open_count() == 0
+    assert led.clear_types(("BROKER_FAILURE",)) == 0
+
+
+def test_ambient_scope_and_null_default():
+    assert current_heal() is NO_HEAL
+    led = HealLedger(clock=FakeClock())
+    h = led.open("DISK_FAILURE", "a-1")
+    with heal_scope(h):
+        assert current_heal() is h
+        with heal_scope(None):
+            assert current_heal() is NO_HEAL
+        assert current_heal() is h
+    assert current_heal() is NO_HEAL
+
+
+def test_observe_health_clears_health_types_only():
+    led = HealLedger(clock=FakeClock())
+    led.open("BROKER_FAILURE", "a-1", (5,))
+    led.open("GOAL_VIOLATION", "a-2", ("g",))
+    assert led.observe_health(False) == 0
+    assert led.observe_health(True) == 1
+    by_id = {c["anomalyId"]: c for c in led.chains()}
+    assert by_id["a-1"]["outcome"] == "cleared"
+    assert by_id["a-1"]["phases"][-1]["via"] == "health_observation"
+    assert by_id["a-2"]["outcome"] is None
+    assert led.clear_types(("GOAL_VIOLATION",)) == 1
+    assert led.open_count() == 0
+
+
+def test_stale_stamps_coalesce_and_never_exhaust_phase_budget():
+    led = HealLedger(max_phases=8, clock=FakeClock())
+    h = led.open("BROKER_FAILURE", "a-1", (5,))
+    for i in range(50):   # a dashboard hammering a broken proposals path
+        led.note_stale(1.0 + i)
+    (c,) = led.chains()
+    stale = [p for p in c["phases"] if p["phase"] == "stale_serving"]
+    assert len(stale) == 1
+    assert stale[0]["staleServed"] == 50
+    assert stale[0]["stalenessS"] == 50.0
+    # The real lifecycle still fits: phases interleaved with stale
+    # windows append a new coalesced stamp, not 50 of them.
+    h.phase("fix_started")
+    led.note_stale(99.0)
+    h.resolve("cleared")
+    (c,) = led.chains()
+    assert [p["phase"] for p in c["phases"]] == [
+        "detected", "stale_serving", "fix_started", "stale_serving",
+        "cleared"]
+    assert c.get("droppedPhases") is None
+
+
+def test_heals_open_gauge_zeroes_after_type_vanishes():
+    from cruise_control_tpu.utils.sensors import SENSORS
+    led = HealLedger(max_chains=1, clock=FakeClock())
+    led.open("BROKER_FAILURE", "a-1", (5,))
+    # Churn of another type evicts every BROKER_FAILURE chain from the
+    # ring; the gauge must drop to 0, not freeze at 1.
+    led.open("GOAL_VIOLATION", "g-1", ("g",))
+    text = SENSORS.render()
+    assert 'heals_open{type="BROKER_FAILURE"} 0.0' in text
+    assert 'heals_open{type="GOAL_VIOLATION"} 1.0' in text
+
+
+def test_soft_terminal_keeps_chain_open_after_real_fix():
+    """A re-detected incident's redundant second fix attempt failing to
+    start must not close a chain whose first fix is already executing
+    (the per-tick-detection twin hits exactly this)."""
+    led = HealLedger(clock=FakeClock())
+    h = led.open("BROKER_FAILURE", "a-1", (5,))
+    h.phase("fix_started")
+    h.phase("execution_started")
+    h.phase("fix_started")            # the redundant re-attempt
+    h.resolve("fix_failed_to_start", own_fix_started=True)
+    (c,) = led.chains()
+    assert c["outcome"] is None       # still open
+    assert c["phases"][-1]["phase"] == "fix_failed_to_start_attempt"
+    assert "own_fix_started" not in c["phases"][-1]  # bookkeeping popped
+    h.resolve("cleared")
+    assert led.chains()[0]["outcome"] == "cleared"
+    # An early-out failure (no facade / model not ready) records NO
+    # fix_started of its own — it must not close a chain whose real
+    # fix already started either.
+    h1b = led.open("BROKER_FAILURE", "c-1", (6,))
+    h1b.phase("fix_started")
+    h1b.resolve("fix_failed_to_start", reason="model not ready")
+    assert led.chains()[0]["outcome"] is None
+    assert led.chains()[0]["phases"][-1]["phase"] \
+        == "fix_failed_to_start_attempt"
+    h1b.resolve("cleared")
+    # But a chain whose ONLY attempt failed terminates.
+    h2 = led.open("BROKER_FAILURE", "b-1", (7,))
+    h2.phase("fix_started")
+    h2.resolve("fix_failed_to_start", own_fix_started=True)
+    assert led.chains()[0]["outcome"] == "fix_failed_to_start"
+    # ...and an early-out with no fix ever started terminates too.
+    h3 = led.open("BROKER_FAILURE", "d-1", (8,))
+    h3.resolve("fix_failed_to_start", reason="no facade")
+    assert led.chains()[0]["outcome"] == "fix_failed_to_start"
+
+
+# ---------------------------------------------------------------------------
+# Escalation paths through the real manager (satellite: each path leaves
+# its documented terminal phase)
+
+def _manager(notifier=None, facade=None, clock=None):
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+    cfg = CruiseControlConfig({
+        "self.healing.enabled": True,
+        "broker.failure.alert.threshold.ms": 0,
+        "broker.failure.self.healing.threshold.ms": 1000,
+    })
+    return AnomalyDetectorManager(cfg, notifier=notifier, facade=facade,
+                                  clock=clock)
+
+
+class _Facade:
+    def __init__(self, fix_ok=True, valid=True):
+        self.fix_ok = fix_ok
+        self.valid = valid
+        self.fixes = 0
+
+    def ready_for_self_healing(self):
+        return True
+
+
+class _Anomaly:
+    """Minimal anomaly double (duck-typed like the manager's users)."""
+
+    def __init__(self, aid="x-1", fix_ok=True, valid=True):
+        from cruise_control_tpu.detector.anomaly import AnomalyType
+        self.anomaly_type = AnomalyType.BROKER_FAILURE
+        self.anomaly_id = aid
+        self.detection_time_ms = 0
+        self.failed_brokers = {5: 0}
+        self._fix_ok = fix_ok
+        self._valid = valid
+
+    def reasons(self):
+        return ["test"]
+
+    def still_valid(self, facade):
+        return self._valid
+
+    def fix(self, facade):
+        if isinstance(self._fix_ok, Exception):
+            raise self._fix_ok
+        facade.fixes += 1
+        return self._fix_ok
+
+
+def _fix_notifier():
+    from cruise_control_tpu.detector.notifier import (
+        AnomalyNotificationResult, AnomalyNotifier,
+    )
+
+    class N(AnomalyNotifier):
+        def on_anomaly(self, anomaly):
+            return AnomalyNotificationResult.fix()
+    return N()
+
+
+def _verdict_notifier(result):
+    from cruise_control_tpu.detector.notifier import AnomalyNotifier
+
+    class N(AnomalyNotifier):
+        def on_anomaly(self, anomaly):
+            return result
+    return N()
+
+
+def test_ignore_verdict_terminal():
+    from cruise_control_tpu.detector.notifier import (
+        AnomalyNotificationResult,
+    )
+    mgr = _manager(_verdict_notifier(AnomalyNotificationResult.ignore()))
+    a = _Anomaly()
+    mgr.report(a)
+    mgr.handle_anomaly(a)
+    (c,) = mgr.heal_ledger.chains()
+    assert c["outcome"] == "ignored"
+    assert c["phases"][-1]["verdict"] == "IGNORE"
+
+
+def test_delayed_check_then_recheck_promotion_to_fix():
+    clk = FakeClock(0.0)
+    facade = _Facade()
+    from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    cfg = CruiseControlConfig({
+        "self.healing.enabled": True,
+        "broker.failure.alert.threshold.ms": 0,
+        "broker.failure.self.healing.threshold.ms": 1000,
+    })
+    notifier = SelfHealingNotifier(cfg, now_ms=lambda: int(clk() * 1000))
+    mgr = _manager(notifier, facade=facade, clock=clk)
+    a = _Anomaly()
+    mgr.report(a)
+    assert mgr.drain_anomalies() == 1   # verdict: CHECK, recheck parked
+    (c,) = mgr.heal_ledger.chains()
+    assert [p["phase"] for p in c["phases"]] \
+        == ["detected", "alerted", "verdict"]
+    assert c["phases"][-1]["action"] == "CHECK"
+    # Past the self-healing threshold the recheck promotes to FIX.
+    clk.t = 2.0
+    assert mgr.drain_anomalies() == 1
+    (c,) = mgr.heal_ledger.chains()
+    phases = [p["phase"] for p in c["phases"]]
+    assert "recheck_promoted" in phases and "fix_started" in phases
+    assert facade.fixes == 1
+    assert c["outcome"] is None   # open until the violation re-checks clear
+    # The detector all-clear seam is the production re-check.
+    mgr.heal_ledger.clear_types(("BROKER_FAILURE",))
+    (c,) = mgr.heal_ledger.chains()
+    assert c["outcome"] == "cleared"
+    assert c["phases"][-1]["via"] == "detector_all_clear"
+
+
+def test_recheck_self_cleared_terminal():
+    clk = FakeClock(0.0)
+    from cruise_control_tpu.detector.notifier import (
+        AnomalyNotificationResult,
+    )
+    mgr = _manager(_verdict_notifier(AnomalyNotificationResult.check(500)),
+                   facade=_Facade(), clock=clk)
+    a = _Anomaly(valid=False)
+    mgr.report(a)
+    mgr.handle_anomaly(a)
+    clk.t = 2.0
+    mgr.drain_anomalies()
+    (c,) = mgr.heal_ledger.chains()
+    assert c["outcome"] == "self_cleared"
+
+
+def test_breaker_skipped_fix_terminal():
+    from cruise_control_tpu.utils.resilience import BreakerOpenError
+    mgr = _manager(_fix_notifier(), facade=_Facade())
+
+    def skipping_runner(fn):
+        raise BreakerOpenError("c1", 12.0)
+
+    mgr.fix_runner = skipping_runner
+    a = _Anomaly()
+    mgr.report(a)
+    assert mgr.handle_anomaly(a) == "FIX_FAILED_TO_START"
+    (c,) = mgr.heal_ledger.chains()
+    assert c["outcome"] == "breaker_skipped"
+
+
+def test_fix_crash_terminal_and_started_counter_by_type():
+    mgr = _manager(_fix_notifier(), facade=_Facade())
+    bad = _Anomaly("bad", fix_ok=RuntimeError("boom"))
+    mgr.report(bad)
+    mgr.handle_anomaly(bad)
+    assert mgr.heal_ledger.chains()[0]["outcome"] == "fix_failed_to_start"
+    good = _Anomaly("good")
+    good.failed_brokers = {9: 0}  # distinct signature → its own chain
+    mgr.report(good)
+    assert mgr.handle_anomaly(good) == "FIX_STARTED"
+    st = mgr.state()
+    assert st["metrics"]["numSelfHealingStarted"] == 1
+    assert st["metrics"]["selfHealingStartedByType"] \
+        == {"BROKER_FAILURE": 1}
+    assert st["meanTimeToStartFixMs"] is not None
+    assert any(r["type"] == "BROKER_FAILURE" for r in st["recentHeals"])
+
+
+def test_executor_dead_letter_terminal():
+    """An execution whose submissions dead-letter resolves the
+    correlated heal as dead_lettered (the documented terminal)."""
+    from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+    from cruise_control_tpu.executor.admin import (
+        InMemoryAdminBackend, PartitionState,
+    )
+    from cruise_control_tpu.executor.executor import Executor
+    from cruise_control_tpu.utils.resilience import RetryPolicy
+
+    parts = [PartitionState("t0", 0, (0, 1), 0, isr=(0, 1))]
+    backend = InMemoryAdminBackend(parts)
+
+    class FailingBackend:
+        def __getattr__(self, name):
+            return getattr(backend, name)
+
+        def alter_partition_reassignments(self, targets):
+            raise TimeoutError("control plane unreachable")
+
+    led = HealLedger(clock=FakeClock())
+    h = led.open("BROKER_FAILURE", "a-1", (1,))
+    h.phase("fix_started")
+    ex = Executor(FailingBackend(), synchronous=True,
+                  progress_check_interval_s=0.0, adjuster_enabled=False,
+                  retry_policy=RetryPolicy(max_attempts=1,
+                                           base_backoff_s=0.0,
+                                           max_backoff_s=0.0),
+                  dead_letter_attempts=1)
+    proposal = ExecutionProposal(topic="t0", partition=0, old_leader=0,
+                                 old_replicas=(0, 1), new_replicas=(0, 2),
+                                 new_leader=0)
+    with heal_scope(h):
+        ex.execute_proposals([proposal], uuid="heal-fix")
+    (c,) = led.chains()
+    assert c["outcome"] == "dead_lettered"
+    phases = [p["phase"] for p in c["phases"]]
+    assert "execution_started" in phases and "dead_letter" in phases
+    assert "execution_finished" in phases
+    # The executor forgets the handle afterwards: an uncorrelated
+    # execution records nothing more on the chain.
+    assert ex._heal is NO_HEAL
+
+
+def test_scheduler_queue_wait_and_breaker_skip_attribution():
+    from cruise_control_tpu.fleet.scheduler import FleetScheduler, JobKind
+    from cruise_control_tpu.utils.resilience import (
+        BreakerOpenError, CircuitBreaker,
+    )
+
+    led = HealLedger(clock=FakeClock())
+    h = led.open("BROKER_FAILURE", "a-1", (5,))
+    clk = FakeClock(0.0)
+    sched = FleetScheduler(starvation_bound_s=100.0, clock=clk)
+    with heal_scope(h):
+        fut = sched.submit("c1", JobKind.SELF_HEALING, lambda: "done")
+    clk.t = 4.0
+    assert sched.run_pending() == 1
+    assert fut.result() == "done"
+    (c,) = led.chains()
+    queued = [p for p in c["phases"] if p["phase"] == "solver_queued"]
+    assert queued and queued[0]["kind"] == "SELF_HEALING"
+    assert queued[0]["waitS"] == 4.0
+
+    # Open breaker: the queued fix resolves breaker_skipped.
+    h2 = led.open("BROKER_FAILURE", "b-1", (7,))
+    breaker = CircuitBreaker(failure_threshold=1, recovery_s=1000.0,
+                             clock=clk)
+    breaker.record_failure("c2")
+    sched2 = FleetScheduler(starvation_bound_s=100.0, clock=clk,
+                            breaker=breaker)
+    with heal_scope(h2):
+        fut2 = sched2.submit("c2", JobKind.SELF_HEALING, lambda: "done")
+    sched2.run_pending()
+    with pytest.raises(BreakerOpenError):
+        fut2.result(timeout=1)
+    assert led.chains()[0]["outcome"] == "breaker_skipped"
+
+
+# ---------------------------------------------------------------------------
+# The twin: parity pin, cross-validation, and the served chain
+
+def _twin(ticks=28, overrides=None):
+    from cruise_control_tpu.testing.simulator import (
+        CANONICAL_SCENARIOS, ClusterSimulator,
+    )
+    spec = dataclasses.replace(CANONICAL_SCENARIOS["broker_loss_drift"],
+                               ticks=ticks)
+    # Per-tick detection: the detector sees the kill the tick it lands,
+    # so the ledger's detected anchor equals the score's injected tick —
+    # the precondition for exact cross-validation (with the canonical
+    # 10-tick cadence the score deliberately charges detection latency
+    # the ledger cannot see).
+    return ClusterSimulator(spec, seed=0, config_overrides={
+        "anomaly.detection.interval.ms": 60_000, **(overrides or {})})
+
+
+@pytest.fixture(scope="module")
+def healed_twin():
+    from cruise_control_tpu.utils.flight_recorder import FLIGHT
+    FLIGHT.configure(enabled=True)
+    sim = _twin()
+    result = sim.run()
+    return sim, result
+
+
+def test_twin_cross_validation_equals_scenario_score(healed_twin):
+    """The instrument vs the ground truth: every injected broker fault's
+    ScenarioScore time-to-heal (ticks) equals the ledger chain's heal
+    duration on the sim clock, exactly."""
+    sim, result = healed_twin
+    tick_s = sim.spec.tick_s
+    events = [h for h in result.score.heal_events if h.kind == "kill_broker"]
+    assert events and all(h.ticks_to_heal is not None for h in events)
+    chains = sim.cc.heal_ledger.chains(anomaly_type="BROKER_FAILURE")
+    cleared = [c for c in chains if c["outcome"] == "cleared"]
+    assert cleared
+    for ev in events:
+        broker = None
+        for e in sim.events:
+            if e.kind == "kill_broker" and e.tick == ev.injected_tick:
+                broker = int(e.params["broker"])
+        covering = [c for c in cleared if broker in c["signature"]]
+        assert covering, f"no ledger chain covers broker {broker}"
+        assert covering[0]["healSeconds"] == ev.ticks_to_heal * tick_s
+
+
+def test_twin_multi_az_cross_validation():
+    sim_cls = _twin  # reuse the override recipe
+    from cruise_control_tpu.testing.simulator import (
+        CANONICAL_SCENARIOS, ClusterSimulator,
+    )
+    spec = dataclasses.replace(CANONICAL_SCENARIOS["multi_az_failure"],
+                               ticks=32)
+    sim = ClusterSimulator(spec, seed=0, config_overrides={
+        "anomaly.detection.interval.ms": 60_000})
+    result = sim.run()
+    del sim_cls
+    events = [h for h in result.score.heal_events
+              if h.kind == "kill_broker" and h.ticks_to_heal is not None]
+    assert events
+    cleared = [c for c in sim.cc.heal_ledger.chains(
+        anomaly_type="BROKER_FAILURE") if c["outcome"] == "cleared"]
+    assert cleared
+    for ev in events:
+        durations = {c["healSeconds"] for c in cleared}
+        assert ev.ticks_to_heal * spec.tick_s in durations
+
+
+@pytest.mark.parametrize("bucket", [128, 256])
+def test_ledger_parity_byte_identical(bucket):
+    """Ledger on vs off: byte-identical final assignment, score JSON,
+    and post-run proposals at two padded bucket shapes (observation
+    never changes behavior — the flight-recorder contract family)."""
+    outs = []
+    for enabled in (True, False):
+        sim = _twin(ticks=26, overrides={
+            "solver.partition.bucket.size": bucket,
+            "heal.ledger.enabled": enabled})
+        result = sim.run()
+        props = sim.cc.proposals(ignore_proposal_cache=True)
+        outs.append((result.assignment_digest, result.score.to_json(),
+                     [dataclasses.astuple(p) for p in props.proposals]))
+        if enabled:
+            assert sim.cc.heal_ledger.chains(), \
+                "enabled run must have journaled chains"
+        else:
+            assert sim.cc.heal_ledger.chains() == []
+    on, off = outs
+    assert on[0] == off[0], "final assignments diverged"
+    assert on[1] == off[1], "score JSON diverged"
+    assert on[2] == off[2], "proposals diverged"
+
+
+def test_heals_endpoint_serves_complete_chain(healed_twin):
+    """GET /heals returns the full detected→…→cleared chain for the
+    self-healed broker failure, and its solver pass ids resolve in
+    GET /solver (acceptance criterion)."""
+    from cruise_control_tpu.api.server import CruiseControlApi
+    sim, _result = healed_twin
+    api = CruiseControlApi(sim.cc)
+    try:
+        status, body, _ = api.handle("GET", "/kafkacruisecontrol/heals",
+                                     "anomaly_type=BROKER_FAILURE")
+        assert status == 200, body
+        assert body["healLedgerEnabled"] is True
+        assert body["numChains"] >= 1
+        assert body["meanTimeToStartFixMs"] is not None
+        chains = [c for c in body["chains"] if c["outcome"] == "cleared"]
+        assert chains
+        c = chains[0]
+        phases = [p["phase"] for p in c["phases"]]
+        for expected in ("detected", "verdict", "fix_started",
+                         "model_built", "solve_dispatched",
+                         "solve_completed", "proposal_ready",
+                         "execution_started", "execution_progress",
+                         "execution_finished", "cleared"):
+            assert expected in phases, f"missing phase {expected}: {phases}"
+        # Causal ordering + per-phase durations.
+        at = [p["atMs"] for p in c["phases"]]
+        assert at == sorted(at)
+        assert all("durationMs" in p for p in c["phases"])
+        # The chain links the flight recorder: its pass ids resolve in
+        # GET /solver.
+        seqs = [p["passSeqs"] for p in c["phases"]
+                if p["phase"] == "solve_completed" and p.get("passSeqs")]
+        assert seqs, "solve_completed must carry flight pass ids"
+        status, solver_body, _ = api.handle(
+            "GET", "/kafkacruisecontrol/solver", "entries=64")
+        assert status == 200
+        recorded = {p["passSeq"] for p in solver_body["passes"]}
+        assert set(seqs[0]) <= recorded, \
+            f"pass ids {seqs[0]} not resolvable in /solver ({recorded})"
+        # anomaly_type filter + entries bound + unknown-param 400.
+        status, body2, _ = api.handle("GET", "/kafkacruisecontrol/heals",
+                                     "entries=1")
+        assert status == 200 and len(body2["chains"]) == 1
+        status, _b, _ = api.handle("GET", "/kafkacruisecontrol/heals",
+                                   "nope=1")
+        assert status == 400
+    finally:
+        api.shutdown()
+
+
+def test_state_substate_and_sensors(healed_twin):
+    sim, _result = healed_twin
+    st = sim.cc.state(substates=("anomaly_detector",))
+    ad = st["AnomalyDetectorState"]
+    assert ad["meanTimeToStartFixMs"] is not None
+    assert ad["recentHeals"] and \
+        any(r["outcome"] == "cleared" for r in ad["recentHeals"])
+    assert ad["metrics"]["numSelfHealingStarted"] >= 1
+    assert sum(ad["metrics"]["selfHealingStartedByType"].values()) \
+        == ad["metrics"]["numSelfHealingStarted"]
+    from cruise_control_tpu.utils.sensors import SENSORS
+    text = SENSORS.render()
+    assert "kafka_cruisecontrol_self_healing_started_total" in text
+    assert "kafka_cruisecontrol_time_to_heal_seconds_bucket" in text
+    assert "kafka_cruisecontrol_heal_phase_seconds_bucket" in text
+    assert "kafka_cruisecontrol_heals_open" in text
+
+
+def test_ledger_dump_json(healed_twin, tmp_path):
+    sim, _result = healed_twin
+    path = tmp_path / "heals.json"
+    n = sim.cc.heal_ledger.dump_json(str(path))
+    doc = json.loads(path.read_text())
+    assert doc["numChains"] == n >= 1
+    assert all("phases" in c for c in doc["chains"])
