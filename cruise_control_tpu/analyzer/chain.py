@@ -893,11 +893,23 @@ class MegastepConfig:
     - ``deficit_moves_cap``: > 0 sizes count-distribution goals'
       moves_per_round / num_sources from the measured total surplus
       (deficit_sized_config); 0 disables sizing entirely.
+    - ``direct_assignment``: run the direct-assignment transport kernel
+      (analyzer.direct) as a pre-pass for count-distribution goals whose
+      chain prefix is guard-representable (direct_eligible): the bulk
+      surplus→deficit matching lands in ONE dispatch, the greedy rounds
+      only polish the structurally-blocked residue. The optimizer sets
+      this from ``solver.direct.assignment.enabled`` AND the wide-regime
+      gate (it replaces deficit-sized greedy; below the gate the greedy
+      path is kept so the fused/bounded byte-parity pins hold).
+    - ``direct_max_sweeps``: sweep budget of one direct dispatch
+      (``solver.direct.max.sweeps``).
     """
 
     donate: bool = True
     async_readback: bool = True
     deficit_moves_cap: int = 0
+    direct_assignment: bool = False
+    direct_max_sweeps: int = 16
 
 
 def donation_enabled(megastep: "MegastepConfig | None") -> bool:
@@ -922,6 +934,7 @@ class DispatchStats:
         self.rounds_per_dispatch: list[int] = []
         self.donated = 0
         self.speculative = 0
+        self.by_kind: dict[str, int] = {}
 
     def record(self, kind: str, rounds: int, donated: bool = False,
                speculative: bool = False, telemetry: bool = True) -> None:
@@ -930,6 +943,7 @@ class DispatchStats:
         and only the physical record may hit the solver_dispatches
         sensors (a 4-cluster dispatch is one XLA execution, not four)."""
         self.rounds_per_dispatch.append(int(rounds))
+        self.by_kind[kind] = self.by_kind.get(kind, 0) + 1
         if donated:
             self.donated += 1
         if speculative:
@@ -951,10 +965,15 @@ class DispatchStats:
         return float(ordered[(len(ordered) - 1) // 2])
 
     def as_dict(self) -> dict:
-        return {"dispatch_count": self.dispatch_count,
-                "rounds_per_dispatch_p50": self.rounds_p50(),
-                "donated_dispatches": self.donated,
-                "speculative_dispatches": self.speculative}
+        out = {"dispatch_count": self.dispatch_count,
+               "rounds_per_dispatch_p50": self.rounds_p50(),
+               "donated_dispatches": self.donated,
+               "speculative_dispatches": self.speculative}
+        if self.by_kind.get("direct"):
+            # Present only when the direct-assignment kernel ran, so
+            # pre-direct accounting consumers see an unchanged dict.
+            out["direct_dispatches"] = self.by_kind["direct"]
+        return out
 
 
 def deficit_sized_config(cfg: SearchConfig, viol0: float,
@@ -1625,6 +1644,73 @@ def optimize_goal_in_chain_megabatch(states: ClusterTensors,
     applied_total = np.zeros(c, dtype=np.int64)
     swaps_total = np.zeros(c, dtype=np.int64)
     rounds_total = np.zeros(c, dtype=np.int64)
+    direct_moves = np.zeros(c, dtype=np.int64)
+    direct_sweeps = np.zeros(c, dtype=np.int64)
+    # Direct-assignment pre-pass, batched (analyzer.direct megabatch
+    # twins): one dispatch advances EVERY participating cluster's bulk
+    # transport in lockstep, with inactive clusters (pad slots, clusters
+    # with offline replicas or drains — those keep the full greedy
+    # semantics) frozen by the batched early-exit mask; the greedy cycle
+    # below polishes the residue. Occupancy stays traced — the direct
+    # program compiles once per bucket shape, like every other megabatch
+    # kernel.
+    use_direct = False
+    if megastep.direct_assignment:
+        from .direct import direct_eligible, direct_regime_ok
+        use_direct = direct_eligible(goals, index) \
+            and direct_regime_ok(goal, states.assignment.shape[1],
+                                 states.assignment.shape[2],
+                                 states.capacity.shape[1], num_topics)
+    direct_active = ran & (off0 == 0) & ~drain & (viol0 > 0)
+    if use_direct and direct_active.any():
+        from .direct import (
+            megabatch_direct_rounds, megabatch_direct_rounds_donated,
+        )
+        from ..utils.sensors import SENSORS
+        active0 = jnp.asarray(direct_active)
+        t0 = _time.monotonic()
+        if donate:
+            if not can_donate[0]:
+                states = dataclasses.replace(
+                    states, assignment=jnp.copy(states.assignment),
+                    leader_slot=jnp.copy(states.leader_slot))
+            rest = dataclasses.replace(
+                states,
+                assignment=jnp.zeros((c, 0, states.assignment.shape[2]),
+                                     states.assignment.dtype),
+                leader_slot=jnp.zeros((c, 0), states.leader_slot.dtype))
+            a, l, mv, sw, _act = megabatch_direct_rounds_donated(
+                states.assignment, states.leader_slot, rest, active0,
+                goals, index, constraint, num_topics, masks,
+                megastep.direct_max_sweeps)
+            states = dataclasses.replace(states, assignment=a,
+                                         leader_slot=l)
+            can_donate[0] = True
+        else:
+            states, mv, sw, _act = megabatch_direct_rounds(
+                states, active0, goals, index, constraint, num_topics,
+                masks, megastep.direct_max_sweeps)
+        mv_np = np.asarray(mv)
+        sw_np = np.asarray(sw)
+        elapsed = _time.monotonic() - t0
+        direct_moves += mv_np
+        direct_sweeps += sw_np
+        applied_total += mv_np
+        # ONE physical XLA execution; per-cluster splits skip telemetry
+        # (the run_megabatch_pass accounting discipline).
+        if physical_stats is not None:
+            physical_stats.record("direct", int(sw_np.max()),
+                                  donated=donate)
+        for b in range(c):
+            if stats is not None and sw_np[b] > 0:
+                stats[b].record("direct", int(sw_np[b]), donated=donate,
+                                telemetry=False)
+            if flights is not None and direct_active[b]:
+                flights[b].dispatch(
+                    "direct", megastep.direct_max_sweeps, int(sw_np[b]),
+                    int(mv_np[b]), donated=donate, elapsed_s=elapsed)
+        SENSORS.count("solver_direct_sweeps", int(sw_np.max()))
+        SENSORS.count("solver_direct_moves", int(mv_np.sum()))
     alive = ran.copy()
     while True:
         # A cluster joins the next move+swap cycle exactly when the serial
@@ -1685,6 +1771,9 @@ def optimize_goal_in_chain_megabatch(states: ClusterTensors,
             "violated_on_entry": float(viol0[b]) > 1e-6,
             "offline_remaining": int(off1[b]),
         }
+        if use_direct:
+            info["direct_moves"] = int(direct_moves[b])
+            info["direct_sweeps"] = int(direct_sweeps[b])
         if cluster_mask[b] and int(off0[b]) == 0:
             before, after = float(obj0[b]), float(obj1[b])
             if after > before + 1e-4 * max(1.0, abs(before)):
@@ -1779,8 +1868,26 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
     donate = donation_enabled(megastep) and bounded
     async_rb = bool(megastep.async_readback) if megastep is not None \
         else False
+    drain = False
+    if masks.excluded_replica_move_brokers is not None:
+        drain = bool(excluded_hosting_replicas(
+            state, masks.excluded_replica_move_brokers).any())
+    # Direct-assignment pre-pass eligibility (analyzer.direct): bounded
+    # path, kernel enabled for this pass (the optimizer resolves the
+    # config flag AND the wide-regime gate into megastep), a
+    # guard-representable chain prefix, and a clean model — self-healing
+    # (offline replicas) and drains keep the full greedy semantics, the
+    # same pause rule as the targeted-destination column.
+    use_direct = False
+    if bounded and megastep is not None and megastep.direct_assignment \
+            and int(offline0) == 0 and not drain:
+        from .direct import direct_eligible, direct_regime_ok
+        use_direct = direct_eligible(goals, index) \
+            and direct_regime_ok(goal, state.num_partitions,
+                                 state.max_replication_factor,
+                                 state.num_brokers, num_topics)
     if bounded and megastep is not None and megastep.deficit_moves_cap > 0 \
-            and goal.count_based:
+            and goal.count_based and not use_direct:
         # Deficit-aware sizing from the goal's ENTRY violations — a
         # pass-level constant, so the trajectory stays invariant to the
         # dispatch-budget sequence under the sized config.
@@ -1883,11 +1990,49 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
     # and the sharded bounded driver): nothing violated, nothing offline,
     # no drain pending = the search fixed point is immediate — skip the
     # drivers and their dispatch round-trips entirely.
-    drain = False
-    if masks.excluded_replica_move_brokers is not None:
-        drain = bool(excluded_hosting_replicas(
-            state, masks.excluded_replica_move_brokers).any())
     ran = float(viol0) > 0 or int(offline0) > 0 or drain
+    direct_moves = 0
+    direct_sweeps = 0
+    if ran and use_direct and float(viol0) > 0:
+        # Direct-assignment pre-pass: the bulk transport in ONE dispatch
+        # (kind="direct" in stats/flight — its own dispatch series, out
+        # of the acceptance-density histogram); the greedy loop below
+        # polishes whatever the feasibility masks vetoed.
+        from .direct import run_direct_pass
+        (state, direct_moves, direct_sweeps, d_donated,
+         d_stranded) = run_direct_pass(
+            state, goals, index, constraint, num_topics, masks, megastep,
+            megastep.direct_max_sweeps, stats=stats, flight=flight,
+            donate_input=can_donate[0])
+        if d_donated:
+            # The direct kernel consumed (a copy of) the mutable pair;
+            # its outputs are chain-owned, so later dispatches may donate
+            # them directly.
+            can_donate[0] = True
+        total_applied += direct_moves
+        if megastep.deficit_moves_cap > 0 and goal.count_based:
+            # Deficit-size the POLISH from the larger of two residual
+            # estimates (no extra stats dispatch): viol0 − moves (a
+            # transport move fixes at least 1 unit — but margin-depth
+            # moves fix 0, so this alone can zero out) and 2× the
+            # STRANDED movers the kernel reports at exit (each stranded
+            # mover is up to 2 violation units feasibility refused to
+            # place). When the transport left a real residue, the polish
+            # must not grind it through base-width rounds.
+            base_cfg = cfg
+            cfg = deficit_sized_config(
+                cfg, max(float(viol0) - float(direct_moves),
+                         2.0 * float(d_stranded)),
+                megastep.deficit_moves_cap)
+            if cfg is not base_cfg:
+                flight.sizing(entry_violation=float(viol0),
+                              base_moves=base_cfg.moves_per_round,
+                              base_sources=base_cfg.num_sources,
+                              sized_moves=cfg.moves_per_round,
+                              sized_sources=cfg.num_sources,
+                              cap=megastep.deficit_moves_cap)
+                flight.grid(cfg.num_sources, cfg.num_dests,
+                            cfg.moves_per_round)
     if ran:
         while rounds < cfg.max_rounds and not out_of_time():
             state, moves, r = run_pass("move", state, cfg.max_rounds)
@@ -1933,4 +2078,10 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
         "violated_on_entry": float(viol0) > 1e-6,
         "offline_remaining": int(offline),
     }
+    if use_direct:
+        # Direct-pass attribution (keys present only when the direct mode
+        # was in force, so the disabled path's info dict stays identical
+        # to the pre-direct contract).
+        info["direct_moves"] = direct_moves
+        info["direct_sweeps"] = direct_sweeps
     return state, info

@@ -1,0 +1,956 @@
+"""Direct-assignment transport kernels for the count-distribution goals.
+
+The greedy search pays for a count imbalance in ROUNDS: each round
+scores a top-k grid, accepts a conflict-free batch, and re-dispatches —
+at the 7k-broker/1M-partition north star TopicReplicaDistributionGoal
+alone burns hundreds of acceptance-density-limited rounds shedding ~980
+moves each (ROADMAP item 1). But a count goal's fixed point is KNOWN in
+closed form: the per-broker (or per-topic×broker) target band is a pure
+function of the counts, so the whole solve is a transport problem —
+surplus replicas → deficit slots — not a search problem. This module
+solves that transport as a vectorized matching in one (or a few) device
+dispatches (the Podracer/Anakin "stop iterating" lever):
+
+1. **Target counts on device**: the active goal's count plane
+   ``[G, B]`` and band ``[lower, upper]`` (``G`` = 1 for the
+   replica/leader goals, ``num_topics`` for the topic goal), with
+   donor widening when deficits exceed base surplus (the
+   ``donor_widened_shed`` semantics, integral and deterministic).
+2. **Surplus replica selection**: ONE segmented sort of the flattened
+   replica axis by ``(cell, weight)`` — cell = (group, src broker) —
+   ranks every replica within its cell; the ``surplus[cell]`` lightest
+   movable replicas are the movers (light-first, matching the greedy's
+   ``replica_weight``).
+3. **Cumsum rank-assignment**: each mover's rank within its group maps
+   through the group's cumulative ``[deficit | headroom]`` profile
+   (``analyzer.fill.deficit_fill_dests`` — the same kernel the targeted
+   destination column uses per-card) to a destination broker, so the
+   joint assignment respects every cell's integer gap by construction.
+4. **Feasibility masking**: RF-sibling exclusion (destination must not
+   already host the partition — nor receive two siblings in one
+   sweep), rack-awareness when a rack goal is stacked prior, dead
+   brokers, per-request exclusion options, the new-broker gate, and
+   leadership-excluded destinations for leader movers.
+5. **Prior-goal guards**: destination caps and source floors of every
+   previously-optimized goal (replica-capacity / count bands / resource
+   bands / capacity thresholds / potential NW-out), evaluated JOINTLY
+   via dst-/src-sorted segmented exclusive cumsums — the
+   ``attach_cumulative`` pre-delta contract at O(n log n) instead of
+   O(m²), with the same conservative-overcount semantics (a vetoed
+   earlier mover still shifts later movers' checks, which can only make
+   them stricter).
+6. **One-shot scatter apply**: all surviving movers land in a single
+   functional scatter; a small on-device sweep loop (``max_sweeps``)
+   re-runs the plan on the updated counts until nothing moves, so
+   feasibility-vetoed leftovers get a second pairing without a host
+   round-trip.
+
+Anything the transport cannot place (structurally-blocked residue)
+stays for the greedy polish pass that follows — the kernel REPLACES the
+deficit-sized bulk rounds, not the acceptance machinery's judgment.
+
+Safety discipline (two prior density "fixes" silently flipped the
+86.0 → 82.74 CpuUsageDistribution canary and were reverted): the kernel
+ships behind ``solver.direct.assignment.enabled`` (default OFF), only
+activates in the wide regime (``solver.wide.batch.min.brokers``) where
+deficit-sized greedy ran before, refuses chains whose prior goals it
+cannot guard (``direct_eligible``), and is gated on the bench
+regression sentry + full fixture matrix, never on round counts.
+
+Donation contract: the donated twins donate EXACTLY the strip_mutable
+pair ``{assignment, leader_slot}`` (CCSA002-checked); topology tensors
+are refresh-cache-shared and never donated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..common.resources import Resource
+from ..model.tensors import (
+    ClusterTensors, is_leader_slot, replica_load_total,
+    topic_broker_replica_counts,
+)
+from .constraint import BalancingConstraint
+from .derived import compute_derived, count_limits, resource_limits
+from .fill import deficit_fill_dests
+from .goals.base import Goal
+from .goals.capacity import ReplicaCapacityGoal, ResourceCapacityGoal
+from .goals.distribution import (
+    CountDistributionGoal, PotentialNwOutGoal, TopicReplicaDistributionGoal,
+    _int_deficit_headroom,
+)
+from .goals.rack import RackAwareGoal
+from .search import ExclusionMasks, goal_aux
+
+_EPS = 1e-6
+
+
+@dataclasses.dataclass(frozen=True)
+class DirectGuards:
+    """Static (trace-time) description of the prior-goal constraints the
+    transport plan must respect — computed from the chain prefix, one
+    flag/tuple per constraint family the feasibility pass knows how to
+    model."""
+
+    rack: bool = False              # strict sibling-rack exclusion
+    replica_cap: bool = False       # ReplicaCapacityGoal hard cap
+    replica_band: bool = False      # per-broker replica-count band
+    leader_band: bool = False       # per-broker leader-count band
+    topic_band: bool = False        # per-(topic, broker) count band
+    resources: tuple[int, ...] = ()      # distribution bands (upper+lower)
+    cap_resources: tuple[int, ...] = ()  # hard capacity thresholds
+    pot_nw_out: bool = False        # potential NW-out limit
+
+
+def _guards_for(goals: tuple[Goal, ...], index: int) -> DirectGuards:
+    priors = goals[:index]
+    from .goals.distribution import ResourceDistributionGoal
+    return DirectGuards(
+        rack=any(isinstance(g, RackAwareGoal) for g in priors),
+        replica_cap=any(isinstance(g, ReplicaCapacityGoal) for g in priors),
+        replica_band=any(isinstance(g, CountDistributionGoal)
+                         and not g.leaders for g in priors),
+        leader_band=any(isinstance(g, CountDistributionGoal)
+                        and g.leaders for g in priors),
+        topic_band=any(isinstance(g, TopicReplicaDistributionGoal)
+                       for g in priors),
+        resources=tuple(sorted({int(g.resource) for g in priors
+                                if isinstance(g, ResourceDistributionGoal)})),
+        cap_resources=tuple(sorted({int(g.resource) for g in priors
+                                    if isinstance(g, ResourceCapacityGoal)})),
+        pot_nw_out=any(isinstance(g, PotentialNwOutGoal) for g in priors))
+
+
+#: Mean replicas per (topic, broker) cell below which the TOPIC-plane
+#: transport is skipped (the sparse-cell regime): at ~1.5 replicas/cell
+#: (the 1k/100k fixture — and north-star scale) the plan's granularity
+#: equals the band width, feasibility-vetoed churn dominates, and the
+#: greedy polish lands in a WORSE local optimum than the greedy-only
+#: trajectory (measured ~10k residual vs 316; more sweeps made it
+#: worse). The cluster-wide planes (replica/leader counts) have B cells
+#: for P·S replicas and are always dense.
+MIN_TOPIC_CELL_DENSITY = 4.0
+
+
+def direct_regime_ok(goal: Goal, num_partitions: int, max_rf: int,
+                     num_brokers: int, num_topics: int) -> bool:
+    """Host-side density gate for the per-goal transport plan (shape
+    arithmetic only — no device sync, so it works on batched megabatch
+    shapes too): the integration layer skips the direct pre-pass for
+    plane geometries the plan is known to mis-fit, falling back to
+    deficit-sized greedy."""
+    if isinstance(goal, TopicReplicaDistributionGoal):
+        cells = max(1, num_topics * num_brokers)
+        return num_partitions * max_rf / cells >= MIN_TOPIC_CELL_DENSITY
+    return True
+
+
+def direct_eligible(goals, index: int) -> bool:
+    """True when ``goals[index]`` has a direct transport formulation AND
+    every prior goal's acceptance is representable by the guard set —
+    an unrecognized prior (broker sets, kafka-assigner variants, custom
+    plugins) means the plan could silently violate a constraint the
+    greedy's lexicographic stack would have vetoed, so the caller must
+    keep the greedy path (the conservative fallback is the contract)."""
+    from .goals.distribution import ResourceDistributionGoal
+    goal = goals[index]
+    if not getattr(goal, "supports_direct", False):
+        return False
+    recognized = (RackAwareGoal, ReplicaCapacityGoal, ResourceCapacityGoal,
+                  CountDistributionGoal, TopicReplicaDistributionGoal,
+                  PotentialNwOutGoal, ResourceDistributionGoal)
+    return all(isinstance(g, recognized) for g in goals[:index])
+
+
+# ---------------------------------------------------------------------------
+# Segmented helpers over a key-sorted axis
+# ---------------------------------------------------------------------------
+
+def _segment_starts(keys: jax.Array) -> jax.Array:
+    """[N] bool — first element of each equal-key run (keys sorted)."""
+    return jnp.concatenate([jnp.ones((1,), bool), keys[1:] != keys[:-1]])
+
+
+def _segment_rank(keys: jax.Array) -> jax.Array:
+    """[N] int32 — position within the element's equal-key run."""
+    pos = jnp.arange(keys.shape[0], dtype=jnp.int32)
+    start = jax.lax.cummax(jnp.where(_segment_starts(keys), pos, 0))
+    return pos - start
+
+
+def _segment_exclusive(keys: jax.Array, values: jax.Array) -> jax.Array:
+    """Exclusive within-segment cumsum of NON-NEGATIVE ``values`` ([N] or
+    [N, R]) over a key-sorted axis. Non-negativity makes the running
+    total monotone, so each segment's base is recoverable by a cummax of
+    the totals pinned at segment starts — no scatter, no scan."""
+    cum_ex = jnp.cumsum(values, axis=0) - values
+    starts = _segment_starts(keys)
+    if values.ndim == 2:
+        starts = starts[:, None]
+    base = jax.lax.cummax(jnp.where(starts, cum_ex, jnp.zeros_like(cum_ex)),
+                          axis=0)
+    return cum_ex - base
+
+
+# ---------------------------------------------------------------------------
+# The sweep bodies (traced)
+# ---------------------------------------------------------------------------
+
+def _dst_load_caps(ds, lv_d, state, derived, constraint,
+                   guards: DirectGuards):
+    """Joint per-resource upper-band + hard-capacity caps at the
+    destination, in the dst-sorted frame (``lv_d`` is each mover's load
+    vector already masked to selected movers). Shared by BOTH transport
+    modes so the prior-goal contract cannot drift between them.
+    Returns (okd [N] bool, pre_load [N, R])."""
+    f32 = jnp.float32
+    n = ds.shape[0]
+    okd = jnp.ones(n, bool)
+    inf1 = jnp.full((1,), jnp.inf, f32)
+    pre_load = _segment_exclusive(ds, lv_d)
+    for r in guards.resources:
+        _lo, up_r, _c = resource_limits(state, derived, constraint,
+                                        Resource(r))
+        up_pad = jnp.concatenate([up_r, inf1])
+        dl_pad = jnp.concatenate([derived.broker_load[:, r],
+                                  jnp.zeros((1,), f32)])
+        okd &= dl_pad[ds] + pre_load[:, r] + lv_d[:, r] <= up_pad[ds] + _EPS
+    for r in guards.cap_resources:
+        limit = constraint.capacity_threshold[r] * state.capacity[:, r]
+        lim_pad = jnp.concatenate([limit, inf1])
+        dl_pad = jnp.concatenate([derived.broker_load[:, r],
+                                  jnp.zeros((1,), f32)])
+        okd &= dl_pad[ds] + pre_load[:, r] + lv_d[:, r] <= lim_pad[ds] + _EPS
+    return okd, pre_load
+
+
+def _src_load_floors(ss, lv_s, state, derived, constraint,
+                     guards: DirectGuards):
+    """Joint per-resource lower-band floors at the source, in the
+    src-sorted frame (``lv_s`` is each mover's OUTBOUND load vector
+    masked to selected movers): cumulative outflow must not take the
+    source below a previously-optimized resource goal's lower band (the
+    greedy's stays-in-band source arm). Shared by both transport
+    modes."""
+    f32 = jnp.float32
+    n = ss.shape[0]
+    oks = jnp.ones(n, bool)
+    ninf1 = jnp.full((1,), -jnp.inf, f32)
+    pre_out = _segment_exclusive(ss, lv_s)
+    for r in guards.resources:
+        lo_r, _up, _c = resource_limits(state, derived, constraint,
+                                        Resource(r))
+        lo_pad = jnp.concatenate([lo_r, ninf1])
+        sl_pad = jnp.concatenate([derived.broker_load[:, r],
+                                  jnp.zeros((1,), f32)])
+        oks &= sl_pad[ss] - pre_out[:, r] - lv_s[:, r] >= lo_pad[ss] - _EPS
+    return oks
+
+
+def _surplus_deficit(cnt, lower, upper, alive, elig_dst):
+    """Integral (surplus, deficit, headroom) planes with donor widening
+    (donor_widened_shed made integral and deterministic): when a group's
+    deficits exceed its base surplus, in-band donors shed the difference,
+    filled greedily in broker-index order so the plan is a pure function
+    of the counts.
+
+    Band-edge slack: violators shed down to (and receivers fill only up
+    to) ``upper − margin`` with margin = 25% of the band width — NOT to
+    the band's brim. A transport that parks every touched broker exactly
+    AT the upper bound leaves later goals zero joint slack (every
+    subsequent count/load move into those brokers is band-vetoed), and
+    the greedy polish then stalls in a worse local optimum than the
+    greedy-only trajectory, whose variance tiebreak naturally lands
+    mid-band (measured at 64/2048: TopicReplica residual 70 vs 0).
+    Sources are still ONLY actual violators (plus widened donors), so
+    the extra depth costs a bounded per-violator margin, never an O(B)
+    in-band churn."""
+    margin = jnp.floor(jnp.maximum(upper - lower, 0.0) * 0.25)
+    upper_eff = jnp.maximum(upper - margin, lower)
+    base_sur = jnp.where(
+        alive[None, :] & (cnt > upper + _EPS),
+        jnp.floor(jnp.maximum(cnt - upper_eff, 0.0) + _EPS), 0.0)
+    # Receivers likewise fill only to ``lower + margin`` (clamped into
+    # the band): deficits land center-ward instead of spreading across
+    # every broker's full remaining headroom, so no receiver is left
+    # sitting exactly AT lower — the mirror-image edge with zero
+    # OUTBOUND slack for later goals' source-side checks.
+    fill_cap = jnp.minimum(lower + jnp.maximum(margin, 1.0), upper_eff)
+    defi, headr = _int_deficit_headroom(cnt, lower, fill_cap)
+    defi = jnp.where(elig_dst[None, :], defi, 0.0)
+    headr = jnp.where(elig_dst[None, :], headr, 0.0)
+    need = jnp.maximum(defi.sum(axis=1, keepdims=True)
+                       - base_sur.sum(axis=1, keepdims=True), 0.0)
+    donor_room = jnp.where(
+        alive[None, :],
+        jnp.floor(jnp.maximum(cnt - lower, 0.0) + _EPS) - base_sur, 0.0)
+    donor_room = jnp.maximum(donor_room, 0.0)
+    cum_before = jnp.cumsum(donor_room, axis=1) - donor_room
+    extra = jnp.clip(need - cum_before, 0.0, donor_room)
+    return base_sur + extra, defi, headr
+
+
+def _leadership_sweep(state: ClusterTensors, goals: tuple[Goal, ...],
+                      index: int, constraint: BalancingConstraint,
+                      num_topics: int, masks: ExclusionMasks,
+                      sweep: jax.Array | int = 0,
+                      ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
+    """Transport sweep for the LEADER-count goal via leadership
+    TRANSFERS: after the replica goals have balanced counts, a leader
+    replica move is almost always vetoed by the prior replica-count band
+    — the reference (and the greedy here) rebalances leader counts by
+    electing a different in-sync sibling instead. Each surplus leader's
+    destination menu is its partition's own sibling replicas, so the
+    plan picks the best sibling broker with leader-band room and caps
+    joint intake per destination; replica placement (and every
+    count/rack plane) is untouched, leaving only the resource-load
+    guards (leadership carries ``leader_load − follower_load``)."""
+    goal = goals[index]
+    guards = _guards_for(goals, index)
+    derived = compute_derived(state, masks.excluded_topics,
+                              masks.excluded_replica_move_brokers,
+                              masks.excluded_leadership_brokers)
+    aux = goal_aux(goal, state, derived, constraint, num_topics)
+    counts, lower, upper, _group, movable = goal.direct_spec(
+        state, derived, constraint, aux, num_topics)
+
+    p, s = state.assignment.shape
+    b = state.num_brokers
+    n = p * s
+    f32 = jnp.float32
+    alive = derived.alive
+    lead_elig = derived.allowed_leadership & alive
+    cnt = counts.astype(f32)
+    surplus, defi, headr = _surplus_deficit(cnt, lower, upper, alive,
+                                            lead_elig)
+    room = (defi + headr)[0]                                       # [B]
+
+    # Movers: the surplus[src] lightest leaders per over-band broker.
+    # Leadership leaving a broker removes (leader_load − follower_load)
+    # from it — the same dst-independent source pre-filter as the
+    # replica transport: a leader whose departure ALONE would cross a
+    # prior resource goal's lower band can reach no sibling at all, so
+    # it must not occupy a surplus rank (negative components clamped —
+    # an outflow that RAISES the source's load cannot cross a floor).
+    alive_pad = jnp.concatenate([alive, jnp.zeros((1,), bool)])
+    src_plane = jnp.where(state.assignment >= 0, state.assignment, b)
+    mv = movable & derived.movable_partition[:, None] & alive_pad[src_plane]
+    if guards.resources:
+        ninf1 = jnp.full((1,), -jnp.inf, f32)
+        for r in guards.resources:
+            lo_r, _up_r, _c = resource_limits(state, derived, constraint,
+                                              Resource(r))
+            own_r = jnp.maximum(state.leader_load[:, r]
+                                - state.follower_load[:, r], 0.0)[:, None]
+            load_pad = jnp.concatenate([derived.broker_load[:, r],
+                                        jnp.zeros((1,), f32)])
+            lo_pad = jnp.concatenate([lo_r, ninf1])
+            mv &= load_pad[src_plane] - own_r >= lo_pad[src_plane] - _EPS
+    cell = jnp.where(mv, src_plane, b).astype(jnp.int32)
+    weight = replica_load_total(state)
+    sc, _sk, si = jax.lax.sort(
+        (cell.reshape(-1), weight.reshape(-1),
+         jnp.arange(n, dtype=jnp.int32)), num_keys=2)
+    rank_cell = _segment_rank(sc)
+    sur_pad = jnp.concatenate([surplus[0], jnp.zeros((1,), f32)])
+    mover = rank_cell.astype(f32) < sur_pad[sc]
+
+    # Destination menu = the partition's own existing sibling replicas
+    # on leadership-eligible brokers with band room; best room wins
+    # (deficits before headroom), ties to the lowest slot.
+    p_m = si // s
+    s_m = si % s
+    src = jnp.minimum((sc % (b + 1)).astype(jnp.int32), b - 1)
+    assign_p = state.assignment[p_m]                               # [N, S]
+    not_me = jnp.arange(s, dtype=jnp.int32)[None, :] != s_m[:, None]
+    sib_b = jnp.clip(assign_p, 0, b - 1)
+    room_pad = room
+    lead_elig_sib = lead_elig[sib_b] & (assign_p >= 0) & not_me
+    sib_room = jnp.where(lead_elig_sib, room_pad[sib_b], -1.0)
+    sib_score = jnp.where(lead_elig_sib,
+                          defi[0][sib_b] * 1e6 + headr[0][sib_b], -jnp.inf)
+    best_slot = jnp.argmax(sib_score, axis=1).astype(jnp.int32)
+    dst = sib_b[jnp.arange(n), best_slot]
+    ok = mover & (jnp.take_along_axis(
+        sib_room, best_slot[:, None], axis=1)[:, 0] >= 1.0)
+    ok &= dst != src
+
+    sel = ok
+    pos = jnp.arange(n, dtype=jnp.int32)
+    # Joint intake cap per destination + prior resource-band guards, in
+    # one dst-sorted pass (leadership shifts leader_load − follower_load;
+    # negative components are clamped to zero — ignoring an inflow that
+    # REDUCES load only makes the check stricter).
+    lead_vec = jnp.maximum(state.leader_load[p_m] - state.follower_load[p_m],
+                           0.0)
+    dkey = jnp.where(sel, dst, b)
+    ds, _dp, d_i = jax.lax.sort((dkey, pos, pos), num_keys=2)
+    sel_d = sel[d_i]
+    one_d = sel_d.astype(f32)
+    pre_cnt = _segment_exclusive(ds, one_d)
+    room_cap = jnp.concatenate([room, jnp.full((1,), jnp.inf, f32)])
+    okd = pre_cnt + 1.0 <= room_cap[ds] + _EPS
+    if guards.resources or guards.cap_resources:
+        okd_load, _pre = _dst_load_caps(ds, lead_vec[d_i] * sel_d[:, None],
+                                        state, derived, constraint, guards)
+        okd &= okd_load
+    sel &= jnp.zeros(n, bool).at[d_i].set(okd)
+
+    # Joint source-side floors (the greedy's stays-in-band src arm):
+    # several leaderships leaving ONE broker in the same sweep must not
+    # jointly take its load below a prior resource goal's lower band —
+    # the per-mover pre-filter above only bounds a single departure.
+    if guards.resources:
+        skey = jnp.where(sel, src, b)
+        ss, _sp, s_i = jax.lax.sort((skey, pos, pos), num_keys=2)
+        sel_s = sel[s_i]
+        oks = _src_load_floors(ss, lead_vec[s_i] * sel_s[:, None],
+                               state, derived, constraint, guards)
+        sel &= jnp.zeros(n, bool).at[s_i].set(oks)
+
+    rows = jnp.where(sel, p_m, p)
+    new_leader = state.leader_slot.at[rows].set(
+        best_slot.astype(state.leader_slot.dtype), mode="drop")
+    return (dataclasses.replace(state, leader_slot=new_leader),
+            sel.sum().astype(jnp.int32),
+            mover.sum().astype(jnp.int32))
+
+def _direct_sweep(state: ClusterTensors, goals: tuple[Goal, ...], index: int,
+                  constraint: BalancingConstraint, num_topics: int,
+                  masks: ExclusionMasks, sweep: jax.Array | int = 0,
+                  ) -> tuple[ClusterTensors, jax.Array]:
+    """One transport sweep for ``goals[index]``: plan the full
+    surplus→deficit matching on the current counts, veto infeasible
+    assignments, apply the rest in one scatter. ``sweep`` (traced)
+    cyclically rotates each group's rank→profile mapping so a pairing
+    vetoed by feasibility (sibling/rack collisions) is re-paired with a
+    DIFFERENT destination on the next sweep even when the counts did not
+    change — without it a fully-vetoed plan is a fixed point and the
+    residue never re-pairs. Returns (new_state, applied)."""
+    goal = goals[index]
+    guards = _guards_for(goals, index)
+    derived = compute_derived(state, masks.excluded_topics,
+                              masks.excluded_replica_move_brokers,
+                              masks.excluded_leadership_brokers)
+    aux = goal_aux(goal, state, derived, constraint, num_topics)
+    counts, lower, upper, group, movable = goal.direct_spec(
+        state, derived, constraint, aux, num_topics)
+
+    p, s = state.assignment.shape
+    b = state.num_brokers
+    g_dim = counts.shape[0]
+    n = p * s
+    f32 = jnp.float32
+
+    alive = derived.alive
+    has_new = derived.new_brokers.any()
+    elig_dst = jnp.where(has_new, derived.new_brokers,
+                         derived.allowed_replica_move) & alive
+    cnt = counts.astype(f32)
+
+    # --- target distribution: integral surplus / deficit / headroom ------
+    surplus, defi, headr = _surplus_deficit(cnt, lower, upper, alive,
+                                            elig_dst)               # [G, B]
+
+    # --- mover selection: segmented sort by (cell, weight) ---------------
+    alive_pad = jnp.concatenate([alive, jnp.zeros((1,), bool)])
+    src_plane = jnp.where(state.assignment >= 0, state.assignment, b)
+    mv = movable & derived.movable_partition[:, None] & alive_pad[src_plane]
+    # Destination-INDEPENDENT source feasibility must be filtered out
+    # BEFORE ranking: a replica whose departure alone would cross a
+    # prior resource goal's lower band can reach no destination at all,
+    # so letting it occupy a surplus rank wedges that rank forever (the
+    # destination rotation can only re-pair, never re-select movers) —
+    # measured at 64/2048: leader replicas of near-lower-band brokers
+    # froze ~50 surplus ranks the greedy clears with other replicas.
+    ninf1 = jnp.full((1,), -jnp.inf, f32)
+    if guards.resources:
+        lead_plane = is_leader_slot(state)
+        for r in guards.resources:
+            lo_r, _up_r, _c = resource_limits(state, derived, constraint,
+                                              Resource(r))
+            own_r = jnp.where(lead_plane, state.leader_load[:, r][:, None],
+                              state.follower_load[:, r][:, None])
+            load_pad = jnp.concatenate([derived.broker_load[:, r],
+                                        jnp.zeros((1,), f32)])
+            lo_pad = jnp.concatenate([lo_r, ninf1])
+            mv &= load_pad[src_plane] - own_r >= lo_pad[src_plane] - _EPS
+    if guards.replica_band:
+        rl, _ru = count_limits(derived.avg_replicas,
+                               constraint.replica_balance_threshold)
+        reps_pad = jnp.concatenate([derived.broker_replicas.astype(f32),
+                                    jnp.zeros((1,), f32)])
+        rlo_pad = jnp.concatenate([jnp.broadcast_to(rl, (b,)), ninf1])
+        mv &= reps_pad[src_plane] - 1.0 >= rlo_pad[src_plane] - _EPS
+    if guards.leader_band:
+        lead_plane = is_leader_slot(state)
+        ll, _lu = count_limits(derived.avg_leaders,
+                               constraint.leader_replica_balance_threshold)
+        leads_pad = jnp.concatenate([derived.broker_leaders.astype(f32),
+                                     jnp.zeros((1,), f32)])
+        llo_pad = jnp.concatenate([jnp.broadcast_to(ll, (b,)), ninf1])
+        mv &= (~lead_plane) \
+            | (leads_pad[src_plane] - 1.0 >= llo_pad[src_plane] - _EPS)
+    cell = jnp.where(mv, group * (b + 1) + src_plane,
+                     g_dim * (b + 1)).astype(jnp.int32)
+    weight = replica_load_total(state)
+    sc, _sk, si = jax.lax.sort(
+        (cell.reshape(-1), weight.reshape(-1),
+         jnp.arange(n, dtype=jnp.int32)), num_keys=2)
+    rank_cell = _segment_rank(sc)
+    sur_pad = jnp.concatenate([surplus, jnp.zeros((g_dim, 1), f32)],
+                              axis=1).reshape(-1)
+    sur_pad = jnp.concatenate([sur_pad, jnp.zeros((1,), f32)])
+    mover = rank_cell.astype(f32) < sur_pad[sc]
+
+    # --- cumsum rank-assignment over the [deficit | headroom] profile ----
+    grp_key = sc // (b + 1)                     # sorted; sentinel = g_dim
+    grp = jnp.minimum(grp_key, g_dim - 1)
+    rank_grp = _segment_exclusive(grp_key, mover.astype(jnp.int32))
+    # Per-sweep cyclic rotation within each group's position space: a
+    # bijection on [0, total), so position uniqueness (and therefore every
+    # cell's integer intake bound) is preserved; out-of-range ranks stay
+    # put and keep their profile-overflow invalidity.
+    tot_pos = (defi + headr).sum(axis=1)                           # [G]
+    t_g = tot_pos[grp]
+    rank_f = rank_grp.astype(f32)
+    # Golden-ratio stride: consecutive profile positions usually belong
+    # to the SAME broker (a deficit of d occupies d adjacent positions),
+    # so a +1 rotation retries the same vetoed destination; a
+    # ~0.618·total jump lands on a different broker almost every sweep.
+    offs = jnp.floor(jnp.asarray(sweep, f32) * 0.6180339887 * t_g)
+    rank_f = jnp.where(rank_f < t_g,
+                       jnp.mod(rank_f + offs, jnp.maximum(t_g, 1.0)),
+                       rank_f)
+    dst, ok = deficit_fill_dests(grp, rank_f, defi, headr, elig_dst)
+    ok &= mover
+
+    # --- structural feasibility ------------------------------------------
+    p_m = si // s
+    s_m = si % s
+    src = (sc % (b + 1)).astype(jnp.int32)
+    ok &= dst != jnp.minimum(src, b - 1)
+    assign_p = state.assignment[p_m]                           # [N, S]
+    ok &= ~(assign_p == dst[:, None]).any(axis=1)
+    is_lead = state.leader_slot[p_m] == s_m
+    ok &= (~is_lead) | derived.allowed_leadership[dst]
+    not_me = jnp.arange(s, dtype=jnp.int32)[None, :] != s_m[:, None]
+    if guards.rack:
+        rack_pad = jnp.concatenate([state.rack, state.rack[:1]])
+        slot_racks = jnp.where(assign_p >= 0,
+                               rack_pad[jnp.clip(assign_p, 0, b - 1)], -1)
+        dst_rack = state.rack[dst]
+        ok &= ~((slot_racks == dst_rack[:, None]) & not_me
+                & (assign_p >= 0)).any(axis=1)
+
+    # --- same-sweep sibling dedup via planned-destination planes ---------
+    # ``si`` is a permutation of the replica axis, so one scatter writes
+    # every slot exactly once; a mover is vetoed when an EARLIER (lower
+    # sorted position) sibling of its partition claims the same broker —
+    # or, under the rack guard, the same rack.
+    pos = jnp.arange(n, dtype=jnp.int32)
+    sel0 = mover & ok
+    planned_dst = jnp.zeros((p, s), jnp.int32).at[p_m, s_m].set(
+        jnp.where(sel0, dst, -1))
+    planned_pri = jnp.zeros((p, s), jnp.int32).at[p_m, s_m].set(
+        jnp.where(sel0, pos, n))
+    others_dst = planned_dst[p_m]                              # [N, S]
+    others_pri = planned_pri[p_m]
+    earlier = not_me & (others_pri < pos[:, None])
+    ok &= ~((others_dst == dst[:, None]) & earlier).any(axis=1)
+    if guards.rack:
+        others_rack = jnp.where(others_dst >= 0,
+                                rack_pad[jnp.clip(others_dst, 0, b - 1)], -2)
+        ok &= ~((others_rack == dst_rack[:, None]) & earlier).any(axis=1)
+
+    sel = mover & ok
+    # Per-mover load vector: a moving leader carries its leader load
+    # (leadership travels with the slot), a follower its follower load.
+    load_vec = jnp.where(is_lead[:, None], state.leader_load[p_m],
+                         state.follower_load[p_m])              # [N, R]
+
+    # --- prior-goal guards: dst-sorted joint caps ------------------------
+    dst_caps = (guards.replica_cap or guards.replica_band
+                or guards.leader_band or guards.resources
+                or guards.cap_resources or guards.pot_nw_out)
+    if dst_caps:
+        dkey = jnp.where(sel, dst, b)
+        ds, _dp, d_i = jax.lax.sort((dkey, pos, pos), num_keys=2)
+        sel_d = sel[d_i]
+        one_d = sel_d.astype(f32)
+        okd = jnp.ones(n, bool)
+        inf1 = jnp.full((1,), jnp.inf, f32)
+        if guards.replica_cap or guards.replica_band:
+            reps = derived.broker_replicas.astype(f32)
+            cap_b = jnp.full((b,), jnp.inf, f32)
+            if guards.replica_band:
+                _rl, ru = count_limits(derived.avg_replicas,
+                                       constraint.replica_balance_threshold)
+                cap_b = jnp.minimum(cap_b, ru - reps)
+            if guards.replica_cap:
+                cap_b = jnp.minimum(
+                    cap_b, constraint.max_replicas_per_broker - reps)
+            pre_cnt = _segment_exclusive(ds, one_d)
+            okd &= pre_cnt + 1.0 <= jnp.concatenate([cap_b, inf1])[ds] + _EPS
+        if guards.leader_band:
+            lead_d = (is_lead[d_i] & sel_d).astype(f32)
+            _ll, lu = count_limits(derived.avg_leaders,
+                                   constraint.leader_replica_balance_threshold)
+            lcap = jnp.concatenate(
+                [lu - derived.broker_leaders.astype(f32), inf1])
+            pre_lead = _segment_exclusive(ds, lead_d)
+            okd &= (lead_d == 0) | (pre_lead + 1.0 <= lcap[ds] + _EPS)
+        if guards.resources or guards.cap_resources:
+            okd_load, _pre = _dst_load_caps(ds, load_vec[d_i] * sel_d[:, None],
+                                            state, derived, constraint,
+                                            guards)
+            okd &= okd_load
+        if guards.pot_nw_out:
+            r = int(Resource.NW_OUT)
+            pot_own = state.leader_load[p_m, r][d_i] * one_d
+            pre_pot = _segment_exclusive(ds, pot_own)
+            limit = constraint.capacity_threshold[r] * state.capacity[:, r]
+            lim_pad = jnp.concatenate([limit, inf1])
+            pt_pad = jnp.concatenate([derived.pot_nw_out,
+                                      jnp.zeros((1,), f32)])
+            # The reference's escape hatch (PotentialNwOutGoal
+            # .actionAcceptance): a move whose SOURCE already violates
+            # its potential limit is tolerated — without it, a cluster
+            # whose potential exceeds limits everywhere (the goal
+            # violated at entry, e.g. the 1k/100k fixture at 140k
+            # residual) vetoes EVERY transport move forever.
+            src_pot = jnp.concatenate([derived.pot_nw_out,
+                                       jnp.zeros((1,), f32)])
+            src_lim = jnp.concatenate([limit, inf1])
+            src_d = jnp.minimum(src[d_i], b)
+            src_viol = src_pot[src_d] > src_lim[src_d] + _EPS
+            okd &= (pt_pad[ds] + pre_pot + pot_own
+                    <= lim_pad[ds] + _EPS) | src_viol
+        sel &= jnp.zeros(n, bool).at[d_i].set(okd)
+
+    # --- prior-goal guards: src-sorted joint floors ----------------------
+    src_floors = (guards.replica_band or guards.leader_band
+                  or guards.resources)
+    if src_floors:
+        skey = jnp.where(sel, src, b)
+        ss, _sp, s_i = jax.lax.sort((skey, pos, pos), num_keys=2)
+        sel_s = sel[s_i]
+        one_s = sel_s.astype(f32)
+        oks = jnp.ones(n, bool)
+        ninf1 = jnp.full((1,), -jnp.inf, f32)
+        out_rank = _segment_exclusive(ss, one_s)
+        if guards.replica_band:
+            rl, _ru = count_limits(derived.avg_replicas,
+                                   constraint.replica_balance_threshold)
+            reps_pad = jnp.concatenate(
+                [derived.broker_replicas.astype(f32),
+                 jnp.zeros((1,), f32)])
+            floor_pad = jnp.concatenate([jnp.broadcast_to(rl, (b,)), ninf1])
+            oks &= reps_pad[ss] - out_rank - 1.0 >= floor_pad[ss] - _EPS
+        if guards.leader_band:
+            lead_s = (is_lead[s_i] & sel_s).astype(f32)
+            ll, _lu = count_limits(derived.avg_leaders,
+                                   constraint.leader_replica_balance_threshold)
+            leads_pad = jnp.concatenate(
+                [derived.broker_leaders.astype(f32), jnp.zeros((1,), f32)])
+            lfloor = jnp.concatenate([jnp.broadcast_to(ll, (b,)), ninf1])
+            pre_lead_out = _segment_exclusive(ss, lead_s)
+            oks &= (lead_s == 0) \
+                | (leads_pad[ss] - pre_lead_out - 1.0 >= lfloor[ss] - _EPS)
+        if guards.resources:
+            oks &= _src_load_floors(ss, load_vec[s_i] * sel_s[:, None],
+                                    state, derived, constraint, guards)
+        sel &= jnp.zeros(n, bool).at[s_i].set(oks)
+
+    # --- per-(topic, broker) band of a PRIOR topic goal ------------------
+    if guards.topic_band and not isinstance(goal,
+                                            TopicReplicaDistributionGoal):
+        tb = topic_broker_replica_counts(state, num_topics).astype(f32)
+        n_alive = jnp.maximum(alive.sum(), 1)
+        t_avg = (tb * alive[None, :]).sum(axis=1) / n_alive
+        t_up = jnp.ceil(t_avg * constraint.topic_replica_balance_threshold)
+        t_lo = jnp.floor(t_avg / constraint.topic_replica_balance_threshold)
+        topic_m = state.topic[p_m]
+        # dst side: joint intake per (topic, dst) cell must stay under the
+        # prior topic band's upper.
+        tdkey = jnp.where(sel, topic_m * (b + 1) + dst,
+                          num_topics * (b + 1)).astype(jnp.int32)
+        ts, _tp, t_i = jax.lax.sort((tdkey, pos, pos), num_keys=2)
+        sel_t = sel[t_i].astype(f32)
+        pre_td = _segment_exclusive(ts, sel_t)
+        tb_pad = jnp.concatenate([tb, jnp.zeros((num_topics, 1), f32)],
+                                 axis=1).reshape(-1)
+        tb_pad = jnp.concatenate([tb_pad, jnp.zeros((1,), f32)])
+        up_flat = jnp.concatenate(
+            [jnp.broadcast_to(t_up[:, None], (num_topics, b + 1)).reshape(-1),
+             jnp.full((1,), jnp.inf, f32)])
+        okt = (sel_t == 0) \
+            | (tb_pad[ts] + pre_td + 1.0 <= up_flat[ts] + _EPS)
+        sel &= jnp.zeros(n, bool).at[t_i].set(okt)
+        # src side: joint outflow per (topic, src) must stay at/above the
+        # prior topic band's lower.
+        tskey = jnp.where(sel, topic_m * (b + 1) + src,
+                          num_topics * (b + 1)).astype(jnp.int32)
+        ts2, _tp2, t2_i = jax.lax.sort((tskey, pos, pos), num_keys=2)
+        sel_t2 = sel[t2_i].astype(f32)
+        pre_ts = _segment_exclusive(ts2, sel_t2)
+        lo_flat = jnp.concatenate(
+            [jnp.broadcast_to(t_lo[:, None], (num_topics, b + 1)).reshape(-1),
+             jnp.full((1,), -jnp.inf, f32)])
+        okt2 = (sel_t2 == 0) \
+            | (tb_pad[ts2] - pre_ts - 1.0 >= lo_flat[ts2] - _EPS)
+        sel &= jnp.zeros(n, bool).at[t2_i].set(okt2)
+
+    # --- one-shot scatter apply ------------------------------------------
+    rows = jnp.where(sel, p_m, p)
+    new_assignment = state.assignment.at[rows, s_m].set(
+        dst.astype(state.assignment.dtype), mode="drop")
+    return (dataclasses.replace(state, assignment=new_assignment),
+            sel.sum().astype(jnp.int32),
+            mover.sum().astype(jnp.int32))
+
+
+def _sweep_fn(goals: tuple[Goal, ...], index: int):
+    """Leader-count goals transport LEADERSHIP (sibling re-election);
+    every other count goal transports replicas. Trace-time dispatch."""
+    g = goals[index]
+    if isinstance(g, CountDistributionGoal) and g.leaders:
+        return _leadership_sweep
+    return _direct_sweep
+
+
+def _stall_limit(goals: tuple[Goal, ...], index: int) -> int:
+    """Consecutive zero-apply sweeps tolerated before the loop gives the
+    residue up to the greedy polish. The replica transports re-pair
+    vetoed movers by rotation, so a zero-apply sweep can still unlock
+    the next one — give rotation a few chances; the leadership sweep
+    has no rotation (its destination menu is the partition's own
+    siblings), so a zero-apply sweep would recompute a byte-identical
+    plan forever — exit on the first."""
+    return 1 if _sweep_fn(goals, index) is _leadership_sweep else 3
+
+
+def _direct_rounds_driver(state: ClusterTensors, goals: tuple[Goal, ...],
+                          index: int, constraint: BalancingConstraint,
+                          num_topics: int, masks: ExclusionMasks,
+                          max_sweeps: int):
+    """Sweep loop (traced): unlike the greedy megastep's zero-APPLY exit,
+    the direct loop keeps sweeping while the plan still has MOVERS —
+    a sweep whose every pairing was feasibility-vetoed applies nothing,
+    but the next sweep's rotation can re-pair the residue. A bounded
+    zero-apply STREAK (``_stall_limit``) still ends a stalled loop: a
+    structurally-stuck residue must fall to the greedy polish, not burn
+    the whole ``max_sweeps`` budget recomputing vetoed plans."""
+    if not direct_eligible(goals, index):   # trace-time guard
+        raise ValueError(
+            f"goal {goals[index].name} / chain prefix not direct-eligible "
+            "(see direct_eligible)")
+    sweep_fn = _sweep_fn(goals, index)
+    stall = _stall_limit(goals, index)
+
+    def cond(c):
+        _st, _tot, i, planned, zeros = c
+        return (planned > 0) & (i < max_sweeps) & (zeros < stall)
+
+    def body(c):
+        st, tot, i, _planned, zeros = c
+        ns, applied, planned = sweep_fn(st, goals, index, constraint,
+                                        num_topics, masks, sweep=i)
+        zeros = jnp.where(applied > 0, jnp.int32(0), zeros + 1)
+        return ns, tot + applied, i + 1, planned, zeros
+
+    final, total, sweeps, planned, _z = jax.lax.while_loop(
+        cond, body,
+        (state, jnp.int32(0), jnp.int32(0), jnp.int32(1), jnp.int32(0)))
+    # ``planned`` at exit = movers the plan still wanted but could not
+    # place (0 when the transport fully converged): the caller's honest
+    # residue signal for sizing the greedy polish.
+    return final, total, sweeps, planned
+
+
+@partial(jax.jit, static_argnames=("goals", "index", "constraint",
+                                   "num_topics", "max_sweeps"))
+def direct_transport_rounds(state: ClusterTensors, goals: tuple[Goal, ...],
+                            index: int, constraint: BalancingConstraint,
+                            num_topics: int, masks: ExclusionMasks,
+                            max_sweeps: int = 8):
+    """The direct-assignment solve for ``goals[index]`` under the guards
+    of ``goals[:index]``: up to ``max_sweeps`` transport sweeps inside
+    ONE ``lax.while_loop`` dispatch (a stalled loop ends on device).
+    Returns (final_state, moves_applied, sweeps_run, movers_stranded)."""
+    return _direct_rounds_driver(state, goals, index, constraint,
+                                 num_topics, masks, max_sweeps)
+
+
+@partial(jax.jit, static_argnames=("goals", "index", "constraint",
+                                   "num_topics", "max_sweeps"),
+         donate_argnums=(0, 1))
+def direct_transport_rounds_donated(assignment: jax.Array,
+                                    leader_slot: jax.Array,
+                                    rest: ClusterTensors,
+                                    goals: tuple[Goal, ...], index: int,
+                                    constraint: BalancingConstraint,
+                                    num_topics: int, masks: ExclusionMasks,
+                                    max_sweeps: int = 8):
+    """Donated twin (identical trace): callers pass
+    ``chain.strip_mutable(state)`` as ``rest`` and relinquish the two
+    mutable tensors — the donation set is exactly the strip_mutable pair,
+    nothing else (CCSA002)."""
+    state = dataclasses.replace(rest, assignment=assignment,
+                                leader_slot=leader_slot)
+    final, total, sweeps, planned = _direct_rounds_driver(
+        state, goals, index, constraint, num_topics, masks, max_sweeps)
+    return final.assignment, final.leader_slot, total, sweeps, planned
+
+
+# ---------------------------------------------------------------------------
+# Megabatch twins: whole buckets of clusters, one direct program
+# ---------------------------------------------------------------------------
+
+def _megabatch_direct_driver(states: ClusterTensors, active0: jax.Array,
+                             goals: tuple[Goal, ...], index: int,
+                             constraint: BalancingConstraint,
+                             num_topics: int, masks: ExclusionMasks,
+                             max_sweeps: int):
+    """Batched sweep loop with the megabatch freeze discipline: an
+    inactive cluster's whole state is frozen by a select, so a pad slot
+    (or a cluster whose plan converged) stays byte-identical while its
+    batchmates keep sweeping — one compiled program per bucket shape
+    serves any occupancy (occupancy is traced, never a new compile)."""
+    if not direct_eligible(goals, index):   # trace-time guard
+        raise ValueError(
+            f"goal {goals[index].name} / chain prefix not direct-eligible "
+            "(see direct_eligible)")
+    c = states.assignment.shape[0]
+    fields = (masks.excluded_topics, masks.excluded_replica_move_brokers,
+              masks.excluded_leadership_brokers)
+    ax = tuple(None if f is None else 0 for f in fields)
+
+    sweep_fn = _sweep_fn(goals, index)
+    stall = _stall_limit(goals, index)
+
+    def per_cluster(st, tm, rm, lm, i):
+        return sweep_fn(st, goals, index, constraint, num_topics,
+                        ExclusionMasks(tm, rm, lm), sweep=i)
+
+    vsweep = jax.vmap(per_cluster, in_axes=(0,) + ax + (None,))
+
+    def cond(carry):
+        _st, _tot, _swp, i, active, _z = carry
+        return active.any() & (i < max_sweeps)
+
+    def body(carry):
+        st, tot, swp, i, active, zeros = carry
+        nst, applied, planned = vsweep(st, *fields, i)
+
+        def keep(new, old):
+            k = active.reshape((c,) + (1,) * (new.ndim - 1))
+            return jnp.where(k, new, old)
+
+        st = jax.tree.map(keep, nst, st)
+        applied = jnp.where(active, applied, 0).astype(jnp.int32)
+        zeros = jnp.where(active & (applied == 0), zeros + 1,
+                          jnp.where(active, 0, zeros))
+        return (st, tot + applied, swp + active.astype(jnp.int32), i + 1,
+                active & (planned > 0) & (zeros < stall), zeros)
+
+    final, total, sweeps, _i, active, _z = jax.lax.while_loop(
+        cond, body,
+        (states, jnp.zeros((c,), jnp.int32), jnp.zeros((c,), jnp.int32),
+         jnp.int32(0), active0, jnp.zeros((c,), jnp.int32)))
+    return final, total, sweeps, active
+
+
+@partial(jax.jit, static_argnames=("goals", "index", "constraint",
+                                   "num_topics", "max_sweeps"))
+def megabatch_direct_rounds(states: ClusterTensors, active0: jax.Array,
+                            goals: tuple[Goal, ...], index: int,
+                            constraint: BalancingConstraint,
+                            num_topics: int, masks: ExclusionMasks,
+                            max_sweeps: int = 8):
+    """Batched direct solve over a leading cluster axis. Returns
+    (states, moves[C], sweeps[C], active_out[C])."""
+    return _megabatch_direct_driver(states, active0, goals, index,
+                                    constraint, num_topics, masks,
+                                    max_sweeps)
+
+
+@partial(jax.jit, static_argnames=("goals", "index", "constraint",
+                                   "num_topics", "max_sweeps"),
+         donate_argnums=(0, 1))
+def megabatch_direct_rounds_donated(assignment: jax.Array,
+                                    leader_slot: jax.Array,
+                                    rest: ClusterTensors, active0: jax.Array,
+                                    goals: tuple[Goal, ...], index: int,
+                                    constraint: BalancingConstraint,
+                                    num_topics: int, masks: ExclusionMasks,
+                                    max_sweeps: int = 8):
+    """Donated batched twin: donation set is exactly the strip_mutable
+    pair grown a cluster axis ``{assignment[C,P,S], leader_slot[C,P]}``
+    (CCSA002); the stacked topology planes in ``rest`` are
+    refresh-cache-shared and never donated."""
+    states = dataclasses.replace(rest, assignment=assignment,
+                                 leader_slot=leader_slot)
+    final, total, sweeps, active = _megabatch_direct_driver(
+        states, active0, goals, index, constraint, num_topics, masks,
+        max_sweeps)
+    return final.assignment, final.leader_slot, total, sweeps, active
+
+
+# ---------------------------------------------------------------------------
+# Host-side pass driver
+# ---------------------------------------------------------------------------
+
+def run_direct_pass(state: ClusterTensors, goals, index: int,
+                    constraint: BalancingConstraint, num_topics: int,
+                    masks: ExclusionMasks, megastep, max_sweeps: int,
+                    stats=None, flight=None, donate_input: bool = False):
+    """Fire the direct solve as ONE device dispatch and read its scalars
+    back synchronously (there is nothing to pipeline behind a single
+    dispatch). Donation follows the megastep discipline: the first
+    mutating dispatch either consumes the caller's buffers
+    (``donate_input``) or donates a device COPY of the two mutable
+    tensors; the flight record and dispatch stats land under
+    ``kind="direct"`` so solver_dispatches{kind="direct"} is its own
+    series and the acceptance-density histogram (defined only for greedy
+    move dispatches on a recorded grid) never sees these.
+
+    Returns (state, moves, sweeps, donated, stranded) — ``stranded`` is
+    the mover count the plan still wanted but could not place at exit
+    (the caller's residue signal for sizing the greedy polish)."""
+    import time as _time
+
+    from ..utils.sensors import SENSORS
+    from .chain import donation_enabled, strip_mutable
+    goals = tuple(goals)
+    donate = donation_enabled(megastep)
+    t0 = _time.monotonic()
+    if donate:
+        if not donate_input:
+            state = dataclasses.replace(
+                state, assignment=jnp.copy(state.assignment),
+                leader_slot=jnp.copy(state.leader_slot))
+        a, l, total, sweeps, planned = direct_transport_rounds_donated(
+            state.assignment, state.leader_slot, strip_mutable(state),
+            goals, index, constraint, num_topics, masks, max_sweeps)
+        state = dataclasses.replace(state, assignment=a, leader_slot=l)
+    else:
+        state, total, sweeps, planned = direct_transport_rounds(
+            state, goals, index, constraint, num_topics, masks, max_sweeps)
+    moves = int(total)
+    sweeps_run = int(sweeps)
+    stranded = int(planned)
+    elapsed = _time.monotonic() - t0
+    if stats is not None:
+        stats.record("direct", sweeps_run, donated=donate)
+    if flight is not None:
+        flight.dispatch("direct", max_sweeps, sweeps_run, moves,
+                        donated=donate, elapsed_s=elapsed)
+    SENSORS.count("solver_direct_sweeps", sweeps_run)
+    SENSORS.count("solver_direct_moves", moves)
+    return state, moves, sweeps_run, donate, stranded

@@ -75,6 +75,14 @@ class Goal:
     # window, any coverage (ReplicaDistributionAbstractGoal's weak
     # requirements).
     uses_resource_metrics: bool = False
+    # True when the goal's fixed point has a closed-form transport
+    # formulation the direct-assignment kernel (analyzer.direct) can
+    # solve: ``direct_spec`` must then return the count plane + band +
+    # grouping the kernel plans over. Only the count-distribution family
+    # qualifies; whether the kernel actually RUNS additionally requires
+    # every prior goal in the chain to be guard-representable
+    # (analyzer.direct.direct_eligible).
+    supports_direct: bool = False
 
     def completeness_requirements(self, num_windows: int,
                                   min_valid_partition_ratio: float,
@@ -227,6 +235,16 @@ class Goal:
         deficit/headroom profile — without it every device fills the
         same positions and the targeted column collapses mesh quality
         (measured r5). Single-device callers keep the identity (1, 0)."""
+        return None
+
+    def direct_spec(self, state, derived, constraint, aux, num_topics: int):
+        """The direct-assignment transport formulation
+        (analyzer.direct; only meaningful when ``supports_direct``):
+        ``(counts [G, B], lower [G, 1], upper [G, 1], group [P, S] int32,
+        movable [P, S] bool)`` — the count plane the goal balances, its
+        band, which group each replica slot belongs to, and which
+        replicas the goal may relocate. ``G`` = 1 for cluster-wide count
+        goals, ``num_topics`` for per-topic planes."""
         return None
 
 

@@ -242,6 +242,7 @@ class CountDistributionGoal(Goal):
 
     leaders: bool = False
     count_based: bool = True
+    supports_direct: bool = True
 
     def _counts(self, derived):
         return (derived.broker_leaders if self.leaders
@@ -333,6 +334,20 @@ class CountDistributionGoal(Goal):
             headroom, _dest_eligible(derived))
         return dst, ok & src_valid
 
+    def direct_spec(self, state, derived, constraint, aux, num_topics):
+        # One cluster-wide group: the [B] count plane and its band. The
+        # leaders variant relocates LEADER replicas (leadership travels
+        # with the slot, so a relocation shifts the leader count exactly
+        # like the greedy's leader-replica moves).
+        lower, upper = self._limits(derived, constraint)
+        counts = self._counts(derived)[None, :]
+        group = jnp.zeros(state.assignment.shape, jnp.int32)
+        movable = is_leader_slot(state) if self.leaders \
+            else replica_exists(state)
+        return (counts, jnp.reshape(lower, (1, 1)).astype(jnp.float32),
+                jnp.reshape(upper, (1, 1)).astype(jnp.float32), group,
+                movable)
+
     def swap_leg_acceptance(self, state, derived, constraint, aux, leg):
         # Counts are judged on the net transfer only.
         return jnp.ones(leg.valid.shape[0], dtype=bool)
@@ -362,6 +377,7 @@ class TopicReplicaDistributionGoal(Goal):
 
     prefers_wide_batches: bool = True
     count_based: bool = True
+    supports_direct: bool = True
 
     def prepare_partial(self, state, num_topics):
         return {"counts": topic_broker_replica_counts(state, num_topics)
@@ -455,6 +471,16 @@ class TopicReplicaDistributionGoal(Goal):
                                      deficit, headroom,
                                      _dest_eligible(derived))
         return dst, ok & src_valid
+
+    def direct_spec(self, state, derived, constraint, aux, num_topics):
+        # Per-topic groups over the [T, B] count plane (the aux the goal
+        # already maintains); every existing replica is movable, grouped
+        # by its partition's topic.
+        group = jnp.broadcast_to(state.topic[:, None],
+                                 state.assignment.shape).astype(jnp.int32)
+        return (aux["counts"], aux["lower"][:, None].astype(jnp.float32),
+                aux["upper"][:, None].astype(jnp.float32), group,
+                replica_exists(state))
 
 
 @dataclasses.dataclass(frozen=True)

@@ -179,6 +179,10 @@ class GoalOptimizer:
             "solver.dispatch.async.readback")
         self._deficit_moves_cap = self._config.get_int(
             "solver.deficit.moves.cap")
+        self._direct_enabled = self._config.get_boolean(
+            "solver.direct.assignment.enabled")
+        self._direct_max_sweeps = self._config.get_int(
+            "solver.direct.max.sweeps")
         # Adaptive dispatch controllers PERSIST across optimization passes,
         # keyed by MODEL SHAPE: per-round cost is a property of the
         # cluster shape, so the budget learned on one pass carries to the
@@ -305,7 +309,12 @@ class GoalOptimizer:
         return MegastepConfig(
             donate=self._megastep_donate,
             async_readback=self._async_readback,
-            deficit_moves_cap=self._deficit_moves_cap if in_regime else 0)
+            deficit_moves_cap=self._deficit_moves_cap if in_regime else 0,
+            # Direct-assignment transport shares the wide-regime gate: it
+            # REPLACES deficit-sized greedy there; below the gate the
+            # greedy path is kept byte-identical (the parity pins).
+            direct_assignment=self._direct_enabled and in_regime,
+            direct_max_sweeps=self._direct_max_sweeps)
 
     def deficit_sizing_active(self, num_brokers: int) -> bool:
         """Whether a SERIAL solve of this broker count would run
@@ -649,7 +658,8 @@ class GoalOptimizer:
                         megastep=megastep, stats=stats,
                         donate_input=chain_owns_state,
                         flight=flight_pass.goal(g.name))
-                    chain_owns_state |= info["rounds"] > 0
+                    chain_owns_state |= info["rounds"] > 0 \
+                        or info.get("direct_sweeps", 0) > 0
                     gsp.set(rounds=info["rounds"],
                             moves_applied=info["moves_applied"],
                             succeeded=info["succeeded"])
@@ -871,7 +881,8 @@ class GoalOptimizer:
                         physical_stats=physical, flights=flights,
                         donate_input=chain_owns_state)
                     chain_owns_state |= any(
-                        info["rounds"] > 0 for info in infos)
+                        info["rounds"] > 0 or info.get("direct_sweeps", 0) > 0
+                        for info in infos)
                     durations.append(time.time() - t0)
                     results_per_goal.append(infos)
                     for b, info in enumerate(infos):

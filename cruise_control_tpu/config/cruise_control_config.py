@@ -369,6 +369,33 @@ def _definition() -> ConfigDef:
              "O(10k)-move imbalance stops burning hundreds of fixed-"
              "width rounds. Applies at/above "
              "solver.wide.batch.min.brokers; 0 disables sizing.")
+    d.define("solver.direct.assignment.enabled", T.BOOLEAN, False, None,
+             I.MEDIUM,
+             "Direct-assignment transport kernels for the count-"
+             "distribution goals (analyzer.direct): compute the per-"
+             "broker / per-topic target counts on device and solve the "
+             "surplus-to-deficit matching as a vectorized rank "
+             "assignment in one (or a few) dispatches, instead of "
+             "hundreds of acceptance-density-limited greedy rounds; the "
+             "greedy rounds then only polish the feasibility-vetoed "
+             "residue. Applies at/above solver.wide.batch.min.brokers "
+             "(it replaces deficit-sized greedy; below the gate the "
+             "greedy path is kept byte-identical) and only to chains "
+             "whose prior goals the transport feasibility masks can "
+             "represent. Ships OFF: enable only with the bench "
+             "regression sentry green on the full fixture matrix — "
+             "final quality is chaotically sensitive to source "
+             "composition (two prior density fixes silently flipped the "
+             "86.0 -> 82.74 CpuUsageDistribution canary).")
+    d.define("solver.direct.max.sweeps", T.INT, 16, Range.at_least(1), I.LOW,
+             "Sweep budget of one direct-assignment dispatch: each sweep "
+             "re-plans the transport on the updated counts (vetoed "
+             "pairings rotate to different destinations), so a bounded "
+             "number of sweeps clears what feasibility allows and the "
+             "rest falls to the greedy polish. The loop exits early when "
+             "no movers remain OR a few consecutive sweeps apply nothing "
+             "(a stalled rotation), so budget beyond convergence is "
+             "near-free.")
     d.define("fleet.bucket.broker.base", T.INT, 4, Range.at_least(1), I.LOW,
              "Fleet federation: smallest broker-axis bucket of the shared "
              "geometric shape grid (fleet.bucketing.BucketGrid). Every "

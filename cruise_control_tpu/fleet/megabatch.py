@@ -134,7 +134,17 @@ class MegabatchRunner:
     Attached to the FleetScheduler via ``set_batch_runner``; the
     scheduler guarantees every job's future is resolved even if this
     runner raises. Occupancy statistics feed ``GET /fleet`` and the
-    ``solver_megabatch_*`` sensors."""
+    ``solver_megabatch_*`` sensors.
+
+    Batched solves inherit the optimizer's direct-assignment mode
+    (``solver.direct.assignment.enabled``, round 17): with it on, count-
+    distribution goals run their batched transport pre-pass across the
+    whole chunk in one dispatch, and the per-cluster accounting split
+    reported back to each payload (and to
+    ``fleet_precompute_dispatches{cluster=}``) carries the
+    ``direct_dispatches`` tally alongside the greedy dispatch counts —
+    per-item stats need no new plumbing here because the split rides
+    ``DispatchStats.as_dict`` unchanged."""
 
     def __init__(self, optimizer, width: int = 4):
         self._optimizer = optimizer

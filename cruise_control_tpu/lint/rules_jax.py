@@ -83,8 +83,12 @@ class HostSyncInPumpRule(Rule):
 
     #: Files containing the pump machinery. The rule is repo-specific by
     #: design — these are the modules that own the one-behind dispatch
-    #: pipelines (single-cluster, sharded, and the fleet megabatch).
+    #: pipelines (single-cluster, sharded, and the fleet megabatch) plus
+    #: the direct-assignment transport kernels (round 17: its donated
+    #: jits are detected structurally, and any host sync traced into a
+    #: sweep body would be a silent per-compile constant).
     PUMP_FILES = ("cruise_control_tpu/analyzer/chain.py",
+                  "cruise_control_tpu/analyzer/direct.py",
                   "cruise_control_tpu/parallel/chain_sharded.py",
                   "cruise_control_tpu/fleet/megabatch.py")
     #: Region functions: the pumps themselves, their per-dispatch

@@ -874,6 +874,15 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
     async_rb = bool(megastep.async_readback) if megastep is not None \
         else False
     deficit_cap = megastep.deficit_moves_cap if megastep is not None else 0
+    # Direct-assignment mode (megastep.direct_assignment) is ACCEPTED but
+    # intentionally a no-op on the mesh path: the transport kernel ranks
+    # movers within each (group, broker) cell over the FULL replica axis,
+    # which is partition-sharded here — device-local ranks would each
+    # claim the cell's whole global surplus and jointly overshoot it, so
+    # the mesh keeps the deficit-sized greedy below (same trajectory and
+    # compiled-program set as before the flag existed). Interleaved
+    # rank_stride/rank_offset fill positions (the target_dests treatment)
+    # are the prepared extension if the mesh ever needs the direct path.
     # Deficit-sized count goals run wide-cost-class rounds (sizing can
     # multiply sources/moves 10-60x), so they get their OWN controller —
     # the single-device path's narrow/wide split: a budget learned on

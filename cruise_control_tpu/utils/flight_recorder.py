@@ -209,7 +209,13 @@ class GoalFlight:
         ``grid()`` geometry is the move config's — swap kernels run their
         own fixed grid, and the single-dispatch whole-chain paths never
         record one): everything else reports 0.0 and stays out of the
-        density histogram."""
+        density histogram. Direct-assignment dispatches
+        (``kind="direct"``, analyzer.direct — ``budget`` is the sweep cap
+        and ``rounds`` the sweeps run) are deliberately in that
+        "everything else": a transport solve has no per-round selection
+        grid, so folding its moves-per-sweep into the density histogram
+        would masquerade as an off-scale greedy density and corrupt the
+        exact distribution the kill-attribution investigation reads."""
         density = (float(applied) / max(1, int(rounds))) \
             / self.selection_width \
             if (kind == "move" and not speculative
@@ -289,8 +295,20 @@ class GoalFlight:
                        if not d["speculative"] and d["kind"] == "move")
         density = (m_moves / m_rounds / self.selection_width) \
             if m_rounds and self.selection_width > 0 else 0.0
+        # Solve-mode label: without it, a goal bulk-solved by the direct
+        # kernel shows near-zero greedy rounds and a ~0 density, which
+        # reads as "the search died instantly" — kill attribution must
+        # not be misread as zero-density when the transport simply took
+        # the work.
+        kinds = {d["kind"] for d in self.dispatches}
+        if "direct" in kinds:
+            mode = "direct+greedy" if kinds & {"move", "swap", "chain"} \
+                else "direct"
+        else:
+            mode = "greedy"
         out = {
             "goal": self.name,
+            "solveMode": mode,
             "violationBefore": self.viol_before,
             "violationAfter": self.viol_after,
             "offlineBefore": self.offline_before,
@@ -542,6 +560,7 @@ def summarize_passes(passes: list[dict]) -> dict:
     scenario score embeds (wall-clock-free: only counts and densities, so
     the summary is deterministic for a deterministic trajectory)."""
     dispatches = rounds = moves = 0
+    direct_dispatches = direct_moves = 0
     kills = {"killedByPriorVeto": 0, "killedByNonPositive": 0,
              "killedByPerSourceReduce": 0, "killedByDedupRecheck": 0}
     by_goal: dict[str, dict] = {}
@@ -550,6 +569,10 @@ def summarize_passes(passes: list[dict]) -> dict:
             real = [d for d in g.get("dispatches", ())
                     if not d.get("speculative")]
             dispatches += len(real)
+            direct_dispatches += sum(1 for d in real
+                                     if d.get("kind") == "direct")
+            direct_moves += sum(d["applied"] for d in real
+                                if d.get("kind") == "direct")
             g_rounds = sum(d["rounds"] for d in real)
             g_moves = sum(d["applied"] for d in real)
             rounds += g_rounds
@@ -584,10 +607,17 @@ def summarize_passes(passes: list[dict]) -> dict:
     total_rounds = sum(r for _a, r, _w in width_weighted)
     density = (sum(a / w for a, _r, w in width_weighted)
                / total_rounds) if total_rounds else 0.0
-    return {
+    out = {
         "passes": len(passes), "dispatches": dispatches,
         "rounds": rounds, "movesApplied": moves,
         "meanAcceptanceDensity": round(density, 6),
         "killAttribution": kills,
         "byGoal": {k: by_goal[k] for k in sorted(by_goal)},
     }
+    if direct_dispatches:
+        # Present only when the direct-assignment kernel ran, so the
+        # scenario score JSON (byte-identical pinned digests) is
+        # untouched on the greedy-only paths.
+        out["directDispatches"] = direct_dispatches
+        out["directMoves"] = direct_moves
+    return out

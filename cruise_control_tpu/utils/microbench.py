@@ -23,9 +23,16 @@ from functools import partial
 # profile (see tools/profile_parts.py): top-k selections over the
 # flattened replica axis, segment reductions for per-broker aggregates,
 # grid gathers, scatter applies, elementwise sweeps, and the pairwise
-# cumulative-select mask.
+# cumulative-select mask. The last three are the direct-assignment
+# transport kernel's op classes (analyzer.direct, round 17): the
+# multi-key segmented sort of the replica axis, the cumsum
+# rank-assignment (cumulative profile + per-card binary search), and
+# the one-shot scatter apply of a full mover batch — so the ROADMAP
+# item-2 chip campaign can attribute the new kernel in the same
+# ``GET /profile?microbench=true`` call as the greedy round's classes.
 CASE_NAMES = ("topk128", "topk1024", "approx1024", "segsum", "segmax",
-              "gather_grid", "scatter_m", "elemwise", "pairwise_m")
+              "gather_grid", "scatter_m", "elemwise", "pairwise_m",
+              "segsort", "rankfill", "scatter_apply")
 
 
 def _build_cases(brokers: int, partitions: int):
@@ -88,11 +95,50 @@ def _build_cases(brokers: int, partitions: int):
                 mask = (v[:, :1] > v[None, :, 0]).astype(jnp.float32)
                 return v + (mask @ v) * 1e-9
             return loop(bd, x, iters)
+        if which == "segsort":
+            # direct.py's mover selection: multi-key (cell, weight) sort
+            # of the flattened replica axis + within-run ranks.
+            idx = jnp.arange(n_flat, dtype=jnp.int32)
+
+            def bd(v):
+                sc, sk, _si = jax.lax.sort((seg.astype(jnp.int32), v, idx),
+                                           num_keys=2)
+                return v + sk * 1e-9 + (sc[:1] - sc[:1]).astype(v.dtype)
+            return loop(bd, x, iters)
+        if which == "rankfill":
+            # cumsum rank-assignment (fill.deficit_fill_dests shape): a
+            # [G, B] cumulative profile + per-card binary search.
+            from ..analyzer.fill import deficit_fill_dests
+            g_rows = 64
+            prof = jnp.abs(jax.random.normal(key, (g_rows, brokers)))
+            elig = jnp.ones((brokers,), bool)
+            grp = (seg % g_rows).astype(jnp.int32)
+            rank = jnp.arange(n_flat, dtype=jnp.int32) % brokers
+
+            def bd(v):
+                dst, ok = deficit_fill_dests(grp, rank, prof + v[0] * 1e-9,
+                                             prof, elig)
+                return v + ok.sum() * 1e-12 + dst.sum() * 1e-12
+            return loop(bd, x, iters)
+        if which == "scatter_apply":
+            # one-shot scatter apply of a full mover batch onto [P, S].
+            plane = jnp.zeros((partitions, s), jnp.int32)
+            rows = jnp.arange(n_flat, dtype=jnp.int32) // s
+            cols = jnp.arange(n_flat, dtype=jnp.int32) % s
+
+            def bd(v):
+                sel = v > 0
+                r = jnp.where(sel, rows, partitions)
+                upd = plane.at[r, cols].set(seg.astype(jnp.int32),
+                                            mode="drop")
+                return v + upd[0, 0].astype(v.dtype) * 1e-9
+            return loop(bd, x, iters)
         raise ValueError(which)
 
     inputs = {"topk128": w, "topk1024": w, "approx1024": w, "segsum": w,
               "segmax": w, "gather_grid": gscore, "scatter_m": loads,
-              "elemwise": w, "pairwise_m": mvals}
+              "elemwise": w, "pairwise_m": mvals, "segsort": w,
+              "rankfill": w, "scatter_apply": w}
     return run, inputs
 
 

@@ -1,0 +1,33 @@
+"""CCSA001/CCSA002 fixture for the direct-assignment transport kernels
+(analyzer/direct.py, round 17): a donated direct kernel is a pump-file
+region (detected structurally via its donate_argnums decorator), so a
+host sync traced into it is a per-compile constant — the
+silent-wrong-answer class — and its donation set must stay exactly the
+strip_mutable pair. Scanned under the SPOOFED rel path
+``cruise_control_tpu/analyzer/direct.py`` by tests/test_ccsa.py; under
+its own path the file is silent for CCSA001 (path-scoped rule)."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def direct_transport_rounds_donated(assignment, leader_slot, rest, plan):
+    sweeps = float(plan)            # finding: CCSA001 host sync in region
+    moves = plan.tolist()           # finding: CCSA001 host sync in region
+    # ccsa: ok[CCSA001] fixture: annotated deliberate readback
+    budget = int(plan)
+    return assignment, leader_slot, sweeps, moves, budget
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def direct_donates_topology(assignment, leader_slot, rest):
+    # finding: CCSA002 — `rest` is refresh-cache-shared topology
+    return assignment, leader_slot, rest
+
+
+def run_direct_pass(state, plan):
+    # NOT a region (plain host driver): a synchronous readback after a
+    # single dispatch is the documented contract — silent here.
+    return int(plan)

@@ -94,12 +94,46 @@ def test_ccsa002_repo_donation_sites_resolve():
     form wrapping shard_map bodies in parallel/chain_sharded) must
     verify CLEAN — donation exactly {assignment, leader_slot}."""
     for rel in ("cruise_control_tpu/analyzer/chain.py",
+                "cruise_control_tpu/analyzer/direct.py",
                 "cruise_control_tpu/parallel/chain_sharded.py",
                 "cruise_control_tpu/fleet/megabatch.py"):
         ctx = ctx_for(ROOT / rel, rel)
         active, suppressed = findings_of("CCSA002", ctx)
         assert not active, [f.message for f in active]
         assert not suppressed
+
+
+def test_ccsa001_direct_kernel_fixture():
+    """Round-17 scoping: analyzer/direct.py is a pump file — its donated
+    transport kernels are regions (structural donate_argnums detection),
+    host syncs inside them fire, suppressions apply, and the file is
+    silent under a non-pump path."""
+    ctx = ctx_for(FIXTURES / "bad_direct.py",
+                  "cruise_control_tpu/analyzer/direct.py")
+    active, suppressed = findings_of("CCSA001", ctx)
+    assert len(active) == 2           # float(plan) + plan.tolist()
+    assert len(suppressed) == 1       # the annotated int(plan)
+    plain = ctx_for(FIXTURES / "bad_direct.py")
+    a2, s2 = findings_of("CCSA001", plain)
+    assert not a2 and not s2
+
+
+def test_ccsa002_direct_fixture():
+    ctx = ctx_for(FIXTURES / "bad_direct.py")
+    active, _suppressed = findings_of("CCSA002", ctx)
+    assert len(active) == 1
+    assert "rest" in active[0].message
+
+
+def test_ccsa001_real_direct_module_clean():
+    """The real direct.py must lint clean: its donated kernels are pure
+    traced code, and the synchronous readback lives in run_direct_pass
+    (a plain host driver, not a region)."""
+    rel = "cruise_control_tpu/analyzer/direct.py"
+    ctx = ctx_for(ROOT / rel, rel)
+    active, suppressed = findings_of("CCSA001", ctx)
+    assert not active, [f.message for f in active]
+    assert not suppressed
 
 
 def test_ccsa003_trace_mutation_fixture():

@@ -1,0 +1,454 @@
+"""Direct-assignment transport kernels (analyzer/direct.py, round 17).
+
+The load-bearing contracts:
+
+- **Transport invariants**: final per-broker / per-topic counts land
+  inside the goal's band (targets hit exactly on feasible instances),
+  no RF-sibling colocation is ever created, rack-awareness and
+  exclusion masks are respected, and the plan is byte-deterministic.
+- **Below-gate parity**: with the kernel enabled but the cluster below
+  ``solver.wide.batch.min.brokers``, the optimizer's trajectory is
+  byte-identical to the disabled path (the greedy byte-parity pins
+  keep holding).
+- **Megabatch composition**: a direct solve on a partially-filled batch
+  leaves inert pad slots byte-frozen, matches the solo solve per
+  cluster, and occupancy stays traced (one compiled program per shape).
+- **Telemetry**: direct dispatches record as their own
+  ``kind="direct"`` series, stay OUT of the acceptance-density
+  histogram, and label the goal's solve mode.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.chain import (
+    DispatchStats, MegastepConfig, inert_state_like, optimize_goal_in_chain,
+    stack_states, unstack_state,
+)
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.derived import compute_derived, count_limits
+from cruise_control_tpu.analyzer.direct import (
+    direct_eligible, direct_transport_rounds, megabatch_direct_rounds,
+    run_direct_pass,
+)
+from cruise_control_tpu.analyzer.goals import (
+    LeaderBytesInDistributionGoal, LeaderReplicaDistributionGoal,
+    NetworkOutboundUsageDistributionGoal, PreferredLeaderElectionGoal,
+    RackAwareGoal, ReplicaCapacityGoal, ReplicaDistributionGoal,
+    TopicReplicaDistributionGoal,
+)
+from cruise_control_tpu.analyzer.search import ExclusionMasks, SearchConfig
+from cruise_control_tpu.model.fixtures import random_cluster
+from cruise_control_tpu.model.tensors import (
+    broker_leader_counts, broker_replica_counts, replica_exists,
+    topic_broker_replica_counts,
+)
+
+CHAIN = (RackAwareGoal(), ReplicaCapacityGoal(),
+         NetworkOutboundUsageDistributionGoal(), ReplicaDistributionGoal(),
+         TopicReplicaDistributionGoal(), LeaderReplicaDistributionGoal(),
+         PreferredLeaderElectionGoal())
+REPL_IDX = 3
+TR_IDX = 4
+LEAD_IDX = 5
+CFG = SearchConfig(num_sources=32, num_dests=8, moves_per_round=32,
+                   max_rounds=60)
+CON = BalancingConstraint()
+MASKS = ExclusionMasks()
+DIRECT = MegastepConfig(donate=False, async_readback=True,
+                        direct_assignment=True, direct_max_sweeps=8)
+GREEDY = MegastepConfig(donate=False, async_readback=True)
+
+
+def _cluster(seed=3, partition_bucket=0):
+    return random_cluster(num_brokers=12, num_topics=6, num_partitions=96,
+                          rf=2, num_racks=3, seed=seed, skew_to_first=2.0,
+                          partition_bucket=partition_bucket)
+
+
+def _sibling_clean(state) -> bool:
+    a = np.asarray(state.assignment)
+    for pi in range(a.shape[0]):
+        row = a[pi][a[pi] >= 0]
+        if len(set(row.tolist())) != len(row):
+            return False
+    return True
+
+
+def _rack_duplicates(state) -> int:
+    a = np.asarray(state.assignment)
+    rack = np.asarray(state.rack)
+    dups = 0
+    for pi in range(a.shape[0]):
+        row = a[pi][a[pi] >= 0]
+        rr = rack[row].tolist()
+        dups += len(rr) - len(set(rr))
+    return dups
+
+
+def _run_chain(state, meta, mega, masks=MASKS, chain=CHAIN):
+    infos = []
+    for i in range(len(chain)):
+        state, info = optimize_goal_in_chain(
+            state, chain, i, CON, CFG, meta.num_topics, masks,
+            dispatch_rounds=8, megastep=mega,
+            donate_input=bool(infos) and any(
+                x["rounds"] > 0 or x.get("direct_sweeps", 0) > 0
+                for x in infos))
+        infos.append(info)
+    return state, infos
+
+
+def test_direct_eligibility_whitelist():
+    """Only the count goals have a transport formulation, and an
+    unrecognized prior goal (here LeaderBytesIn) disables the kernel for
+    everything stacked after it — the conservative-fallback contract."""
+    assert [direct_eligible(CHAIN, i) for i in range(len(CHAIN))] == \
+        [False, False, False, True, True, True, False]
+    tainted = (LeaderBytesInDistributionGoal(), ReplicaDistributionGoal(),
+               TopicReplicaDistributionGoal())
+    assert [direct_eligible(tainted, i) for i in range(3)] == \
+        [False, False, False]
+
+
+def test_direct_density_regime_gate():
+    """The topic-plane transport engages only on dense cell geometries
+    (the sparse regime is the measured polish-stall hazard); the
+    cluster-wide planes are always in regime."""
+    from cruise_control_tpu.analyzer.direct import direct_regime_ok
+    tr = TopicReplicaDistributionGoal()
+    repl = ReplicaDistributionGoal()
+    lead = LeaderReplicaDistributionGoal()
+    # 1k/100k fixture geometry: ~1.5 replicas/cell -> out of regime.
+    assert not direct_regime_ok(tr, 100_000, 3, 1000, 200)
+    # dense topic plane -> in regime.
+    assert direct_regime_ok(tr, 100_000, 3, 100, 50)
+    assert direct_regime_ok(repl, 100_000, 3, 1000, 200)
+    assert direct_regime_ok(lead, 100_000, 3, 1000, 200)
+
+
+def test_direct_replica_counts_hit_target_band():
+    """The transport lands every alive broker inside the replica-count
+    band (targets hit exactly — residual violation 0 on this feasible
+    instance), creates no sibling colocation, and is byte-deterministic
+    at a fixed seed."""
+    state, meta = _cluster()
+    chain = (RackAwareGoal(), ReplicaCapacityGoal(),
+             ReplicaDistributionGoal())
+    st, moves, sweeps, _pl = direct_transport_rounds(
+        state, chain, 2, CON, meta.num_topics, MASKS, 16)
+    assert int(moves) > 0
+    derived = compute_derived(st)
+    lo, up = count_limits(derived.avg_replicas,
+                          CON.replica_balance_threshold)
+    cnt = np.asarray(broker_replica_counts(st))
+    alive = np.asarray(derived.alive)
+    viol = np.sum((np.maximum(cnt - float(up), 0)
+                   + np.maximum(float(lo) - cnt, 0)) * alive)
+    assert viol <= 3.0, (cnt, float(lo), float(up))
+    assert _sibling_clean(st)
+    st2, m2, s2, _pl2 = direct_transport_rounds(
+        state, chain, 2, CON, meta.num_topics, MASKS, 16)
+    np.testing.assert_array_equal(np.asarray(st.assignment),
+                                  np.asarray(st2.assignment))
+    assert int(m2) == int(moves) and int(s2) == int(sweeps)
+
+
+def test_direct_topic_counts_respect_band_and_priors():
+    """The per-topic plane lands inside its band while the prior
+    replica-count band is NOT violated by the transport (the dst-cap /
+    src-floor guards)."""
+    state, meta = _cluster(seed=7)
+    chain = (RackAwareGoal(), ReplicaCapacityGoal(),
+             ReplicaDistributionGoal(), TopicReplicaDistributionGoal())
+    st, _m, _s, _pl = direct_transport_rounds(
+        state, chain, 2, CON, meta.num_topics, MASKS, 16)
+    viol_repl_before = _replica_band_violation(st)
+    st2, moves, _sw, _pl = direct_transport_rounds(
+        st, chain, 3, CON, meta.num_topics, MASKS, 16)
+    assert int(moves) > 0
+    assert _sibling_clean(st2)
+    # prior replica band untouched (guards held jointly across the batch)
+    assert _replica_band_violation(st2) <= viol_repl_before + 1e-6
+    tb = np.asarray(topic_broker_replica_counts(st2, meta.num_topics))
+    d2 = compute_derived(st2)
+    alive = np.asarray(d2.alive)
+    avg = (tb * alive[None, :]).sum(1) / max(int(alive.sum()), 1)
+    up = np.ceil(avg * CON.topic_replica_balance_threshold)
+    lo = np.floor(avg / CON.topic_replica_balance_threshold)
+    viol = ((np.maximum(tb - up[:, None], 0)
+             + np.maximum(lo[:, None] - tb, 0)) * alive[None, :]).sum()
+    before = _topic_band_violation(st, meta.num_topics)
+    assert viol < before, (viol, before)
+
+
+def _replica_band_violation(state) -> float:
+    derived = compute_derived(state)
+    lo, up = count_limits(derived.avg_replicas,
+                          CON.replica_balance_threshold)
+    cnt = np.asarray(broker_replica_counts(state))
+    alive = np.asarray(derived.alive)
+    return float(np.sum((np.maximum(cnt - float(up), 0)
+                         + np.maximum(float(lo) - cnt, 0)) * alive))
+
+
+def _topic_band_violation(state, num_topics) -> float:
+    tb = np.asarray(topic_broker_replica_counts(state, num_topics))
+    derived = compute_derived(state)
+    alive = np.asarray(derived.alive)
+    avg = (tb * alive[None, :]).sum(1) / max(int(alive.sum()), 1)
+    up = np.ceil(avg * CON.topic_replica_balance_threshold)
+    lo = np.floor(avg / CON.topic_replica_balance_threshold)
+    return float(((np.maximum(tb - up[:, None], 0)
+                   + np.maximum(lo[:, None] - tb, 0))
+                  * alive[None, :]).sum())
+
+
+def test_direct_respects_rack_awareness():
+    """With a rack goal stacked prior, the transport never creates a
+    rack duplicate: starting from a rack-clean state, duplicates stay at
+    zero through the replica and topic transports."""
+    state, meta = _cluster()
+    rack_chain = (RackAwareGoal(), ReplicaCapacityGoal(),
+                  ReplicaDistributionGoal(), TopicReplicaDistributionGoal())
+    # Clean racks first with the greedy rack goal.
+    st, _ = optimize_goal_in_chain(state, rack_chain, 0, CON, CFG,
+                                   meta.num_topics, MASKS)
+    assert _rack_duplicates(st) == 0
+    st2, _m, _s, _pl = direct_transport_rounds(
+        st, rack_chain, 2, CON, meta.num_topics, MASKS, 16)
+    st3, _m2, _s2, _pl2 = direct_transport_rounds(
+        st2, rack_chain, 3, CON, meta.num_topics, MASKS, 16)
+    assert _rack_duplicates(st3) == 0
+    assert _sibling_clean(st3)
+
+
+def test_direct_respects_exclusion_masks():
+    """Excluded-for-replica-move brokers receive NOTHING from the
+    transport, and partitions of excluded topics never move."""
+    state, meta = _cluster()
+    chain = (RackAwareGoal(), ReplicaCapacityGoal(),
+             ReplicaDistributionGoal())
+    excluded = jnp.zeros(state.num_brokers, dtype=bool).at[7].set(True) \
+        .at[11].set(True)
+    topic_mask = jnp.asarray(
+        np.array([t == 0 for t in np.asarray(state.topic)], dtype=bool))
+    masks = ExclusionMasks(excluded_topics=topic_mask,
+                           excluded_replica_move_brokers=excluded)
+    before = np.asarray(broker_replica_counts(state))
+    a_before = np.asarray(state.assignment)
+    st, _m, _s, _pl = direct_transport_rounds(
+        state, chain, 2, CON, meta.num_topics, masks, 16)
+    after = np.asarray(broker_replica_counts(st))
+    assert after[7] <= before[7] and after[11] <= before[11]
+    # excluded-topic rows byte-identical
+    t0_rows = np.asarray(state.topic) == 0
+    np.testing.assert_array_equal(np.asarray(st.assignment)[t0_rows],
+                                  a_before[t0_rows])
+
+
+def test_leadership_mode_transfers_leadership_only():
+    """The leader-count goal's transport re-elects sibling replicas:
+    leader counts move toward the band while the ASSIGNMENT (replica
+    placement) stays byte-identical — and a PRIOR resource goal's band
+    is respected on both sides (leadership shifts leader−follower load
+    off the source and onto the destination)."""
+    state, meta = _cluster(seed=42)
+    chain = (RackAwareGoal(), ReplicaCapacityGoal(),
+             NetworkOutboundUsageDistributionGoal(),
+             LeaderReplicaDistributionGoal())
+    before = _leader_band_violation(state)
+    nwout_before = _resource_band_violation(state, 2)
+    st, moves, _sw, _pl = direct_transport_rounds(
+        state, chain, 3, CON, meta.num_topics, MASKS, 16)
+    # prior NwOut band not worsened by the joint leadership plan
+    assert _resource_band_violation(st, 2) <= nwout_before + 1e-3
+    np.testing.assert_array_equal(np.asarray(st.assignment),
+                                  np.asarray(state.assignment))
+    assert int(moves) > 0
+    assert _leader_band_violation(st) < before
+    # every leader slot still points at an existing replica
+    exists = np.asarray(replica_exists(st))
+    ls = np.asarray(st.leader_slot)
+    pm = np.asarray(st.partition_mask)
+    for pi in range(ls.shape[0]):
+        if pm[pi] and ls[pi] >= 0:
+            assert exists[pi, ls[pi]]
+
+
+def _resource_band_violation(state, r: int) -> float:
+    from cruise_control_tpu.analyzer.derived import resource_limits
+    from cruise_control_tpu.common.resources import Resource
+    derived = compute_derived(state)
+    lo, up, _c = resource_limits(state, derived, CON, Resource(r))
+    load = np.asarray(derived.broker_load[:, r])
+    alive = np.asarray(derived.alive)
+    return float(np.sum((np.maximum(load - np.asarray(up), 0)
+                         + np.maximum(np.asarray(lo) - load, 0)) * alive))
+
+
+def _leader_band_violation(state) -> float:
+    derived = compute_derived(state)
+    lo, up = count_limits(derived.avg_leaders,
+                          CON.leader_replica_balance_threshold)
+    cnt = np.asarray(broker_leader_counts(state))
+    alive = np.asarray(derived.alive)
+    return float(np.sum((np.maximum(cnt - float(up), 0)
+                         + np.maximum(float(lo) - cnt, 0)) * alive))
+
+
+def test_direct_full_chain_composes_with_greedy_polish():
+    """Direct pre-pass + greedy polish through the whole chain: hard
+    goals all succeed, count-goal work moves into kind="direct"
+    dispatches, and the succeeded set matches the greedy-only run on
+    this fixture."""
+    state, meta = _cluster()
+    g_st, g_infos = _run_chain(state, meta, GREEDY)
+    stats = DispatchStats()
+    st = state
+    d_infos = []
+    for i in range(len(CHAIN)):
+        st, info = optimize_goal_in_chain(
+            st, CHAIN, i, CON, CFG, meta.num_topics, MASKS,
+            dispatch_rounds=8, megastep=DIRECT, stats=stats,
+            donate_input=bool(d_infos) and any(
+                x["rounds"] > 0 or x.get("direct_sweeps", 0) > 0
+                for x in d_infos))
+        d_infos.append(info)
+    assert [i["succeeded"] for i in d_infos] == \
+        [i["succeeded"] for i in g_infos]
+    count_infos = [d_infos[REPL_IDX], d_infos[LEAD_IDX]]
+    assert all("direct_sweeps" in i for i in count_infos)
+    assert sum(i.get("direct_moves", 0) for i in count_infos) > 0
+    # TopicReplica at this fixture (~2.7 replicas per (topic, broker)
+    # cell) sits below the sparse-cell density gate: the transport is
+    # skipped and the greedy path keeps the goal.
+    assert "direct_sweeps" not in d_infos[TR_IDX]
+    assert stats.by_kind.get("direct", 0) >= 2
+    assert stats.as_dict()["direct_dispatches"] == stats.by_kind["direct"]
+    assert _sibling_clean(st)
+
+
+def test_direct_below_gate_byte_parity(tmp_path):
+    """With the kernel ENABLED but the cluster below the wide-regime
+    gate, the optimizer's result is byte-identical to the disabled
+    config — at two padded bucket shapes (the disabled-path pin)."""
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    for bucket in (32, 128):
+        state, meta = _cluster(partition_bucket=bucket)
+        outs = []
+        for enabled in (False, True):
+            opt = GoalOptimizer(CruiseControlConfig({
+                "solver.direct.assignment.enabled": enabled}))
+            # 12 brokers < solver.wide.batch.min.brokers (512): the
+            # resolved megastep must keep direct OFF.
+            assert opt._megastep_config(
+                state.num_brokers).direct_assignment is False or not enabled
+            st, res = opt.optimizations(state, meta)
+            outs.append((np.asarray(st.assignment).copy(),
+                         np.asarray(st.leader_slot).copy(),
+                         [dataclasses.asdict(g) for g in res.goal_results]))
+        np.testing.assert_array_equal(outs[0][0], outs[1][0])
+        np.testing.assert_array_equal(outs[0][1], outs[1][1])
+        for a, b in zip(outs[0][2], outs[1][2]):
+            a.pop("duration_s"), b.pop("duration_s")
+            assert a == b
+
+
+def test_megabatch_direct_pads_frozen_and_parity():
+    """A direct solve on a partially-filled batch: inert pad slots stay
+    byte-frozen, the occupied slot matches the solo solve, and a
+    different occupancy reuses the SAME compiled program (occupancy is
+    traced)."""
+    state, meta = _cluster()
+    chain = (RackAwareGoal(), ReplicaCapacityGoal(),
+             ReplicaDistributionGoal())
+    inert = inert_state_like(state)
+    batched = stack_states([state, inert, inert, inert])
+    active0 = jnp.asarray([True, False, False, False])
+    cache0 = megabatch_direct_rounds._cache_size()
+    out, mv, sw, _act = megabatch_direct_rounds(
+        batched, active0, chain, 2, CON, meta.num_topics, MASKS, 8)
+    solo, smv, _ssw, _spl = direct_transport_rounds(
+        state, chain, 2, CON, meta.num_topics, MASKS, 8)
+    np.testing.assert_array_equal(
+        np.asarray(unstack_state(out, 0).assignment),
+        np.asarray(solo.assignment))
+    assert int(np.asarray(mv)[0]) == int(smv)
+    for b in (1, 2, 3):
+        np.testing.assert_array_equal(
+            np.asarray(unstack_state(out, b).assignment),
+            np.asarray(inert.assignment))
+        assert int(np.asarray(mv)[b]) == 0
+        assert int(np.asarray(sw)[b]) == 0
+    assert megabatch_direct_rounds._cache_size() - cache0 == 1
+    # Second occupancy: same program (no compiled-program-per-occupancy
+    # regression — the jit cache counter pin).
+    state2, _ = _cluster(seed=7)
+    batched2 = stack_states([state, state2, inert, inert])
+    megabatch_direct_rounds(batched2, jnp.asarray([True, True, False, False]),
+                            chain, 2, CON, meta.num_topics, MASKS, 8)
+    assert megabatch_direct_rounds._cache_size() - cache0 == 1
+
+
+def test_direct_dispatch_telemetry_out_of_density_histogram():
+    """kind="direct" dispatches: density 0.0, excluded from the
+    acceptance-density histogram, counted by the recorder, and the goal
+    summary labels the solve mode."""
+    from cruise_control_tpu.utils.flight_recorder import FlightRecorder
+    rec = FlightRecorder(max_passes=4, ring_rounds=0)
+    with rec.pass_scope(seq=1, shape=(96, 12)) as p:
+        g = p.goal("TopicReplicaDistributionGoal")
+        g.grid(32, 8, 32)
+        g.entry(violation=40.0)
+        g.dispatch("direct", 8, 3, 37, elapsed_s=0.1)
+        g.dispatch("move", 16, 2, 3, elapsed_s=0.1)
+        g.exit(violation=0.0)
+    d = rec.passes()[0]["goals"][0]
+    assert d["solveMode"] == "direct+greedy"
+    kinds = {x["kind"]: x for x in d["dispatches"]}
+    assert kinds["direct"]["acceptanceDensity"] == 0.0
+    assert kinds["move"]["acceptanceDensity"] > 0.0
+    # density aggregate counts MOVE dispatches only
+    assert d["acceptanceDensity"] == pytest.approx(3 / 2 / 32, rel=1e-6)
+    # summarize_passes surfaces the direct tally only when present
+    from cruise_control_tpu.utils.flight_recorder import summarize_passes
+    summary = summarize_passes(rec.passes())
+    assert summary["directDispatches"] == 1
+    assert summary["directMoves"] == 37
+    rec2 = FlightRecorder(max_passes=4, ring_rounds=0)
+    with rec2.pass_scope(seq=1, shape=(96, 12)) as p:
+        g = p.goal("x")
+        g.grid(32, 8, 32)
+        g.dispatch("move", 16, 2, 3)
+    s2 = summarize_passes(rec2.passes())
+    assert "directDispatches" not in s2
+    assert s2["passes"] == 1
+
+
+def test_run_direct_pass_records_stats_and_flight():
+    state, meta = _cluster()
+    chain = (RackAwareGoal(), ReplicaCapacityGoal(),
+             ReplicaDistributionGoal())
+    stats = DispatchStats()
+    from cruise_control_tpu.utils.flight_recorder import FlightRecorder
+    rec = FlightRecorder(max_passes=4, ring_rounds=0)
+    with rec.pass_scope(seq=1, shape=(96, 12)) as p:
+        g = p.goal("ReplicaDistributionGoal")
+        st, moves, sweeps, donated, _stranded = run_direct_pass(
+            state, chain, 2, CON, meta.num_topics, MASKS, DIRECT, 8,
+            stats=stats, flight=g)
+    assert moves > 0 and sweeps > 0
+    assert donated is False            # CPU backend: donation gated off
+    assert stats.by_kind == {"direct": 1}
+    d = rec.passes()[0]["goals"][0]
+    assert d["solveMode"] == "direct"
+    assert d["dispatches"][0]["kind"] == "direct"
+    assert d["dispatches"][0]["rounds"] == sweeps
+    assert d["dispatches"][0]["applied"] == moves
