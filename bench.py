@@ -132,6 +132,20 @@ DIRECT_MODE = "--direct" in sys.argv or bool(os.environ.get("BENCH_DIRECT"))
 DIRECT_BROKERS = int(os.environ.get("BENCH_DIRECT_BROKERS", "200"))
 DIRECT_PARTITIONS = int(os.environ.get("BENCH_DIRECT_PARTITIONS", "10000"))
 
+# --warmstart: run ONLY the always-hot stage (round 18): (1) restart-to-
+# first-proposal measured in FRESH subprocesses — cold cache vs persistent
+# cache + background prewarm — and (2) steady-state warm-seeded vs cold
+# solves under the round-11 drift twin, with a balancedness/violated-set
+# flip between the two arms as a hard in-run canary (the WARMSTART CI
+# row). Like the other riders, the stage also runs at the END of every
+# default bench pass.
+WARMSTART_MODE = "--warmstart" in sys.argv or bool(
+    os.environ.get("BENCH_WARMSTART"))
+WARMSTART_BROKERS = int(os.environ.get("BENCH_WARMSTART_BROKERS", "16"))
+WARMSTART_PARTITIONS = int(
+    os.environ.get("BENCH_WARMSTART_PARTITIONS", "512"))
+WARMSTART_TICKS = int(os.environ.get("BENCH_WARMSTART_TICKS", "32"))
+
 # Generator-sampled SCENARIO_MATRIX rows (pinned (template, seed) pairs
 # so the matrix stays deterministic): the scenario-diversity axis beyond
 # the 6-scenario canonical library. Violation-free at these pins by
@@ -1181,6 +1195,382 @@ def _run_futures_stage(progress: dict, n: int | None = None) -> dict:
     }
 
 
+# Self-contained restart probe run in a FRESH python process: builds a
+# deterministic skewed cluster facade, starts it up (which wires the
+# persistent compile cache + background prewarm per config), and times
+# the first proposal. Reports its own phase breakdown as one JSON line;
+# the parent times the whole subprocess. Parameterized by env so the
+# script stays byte-identical across arms (same code path, different
+# config switches).
+_RESTART_PROBE_SCRIPT = r"""
+import json, os, time
+T0 = time.time()
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.config.cruise_control_config import (
+    CruiseControlConfig,
+)
+from cruise_control_tpu.executor.admin import (
+    InMemoryAdminBackend, PartitionState,
+)
+from cruise_control_tpu.executor.executor import Executor
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.monitor import LoadMonitor, StaticCapacityResolver
+from cruise_control_tpu.monitor.sampling import SyntheticSampler
+from cruise_control_tpu.warmstart import prewarm_manager
+
+brokers = int(os.environ["WS_BROKERS"])
+parts = int(os.environ["WS_PARTITIONS"])
+partitions = {}
+for p in range(parts):
+    a = p % brokers
+    b = (a + 1 + (p * 7) % (brokers - 1)) % brokers
+    reps = (0 if p % 3 == 0 else a, b if b != (0 if p % 3 == 0 else a)
+            else (b + 1) % brokers)
+    partitions[(f"t{p % 8}", p // 8)] = PartitionState(
+        f"t{p % 8}", p // 8, reps, reps[0], isr=reps)
+props = {
+    "partition.metrics.window.ms": 1000,
+    "num.partition.metrics.windows": 3,
+    "min.valid.partition.ratio": 0.0,
+    "anomaly.detection.interval.ms": 600_000,
+    "failed.brokers.file.path": "",
+    "solver.compile.cache.enabled": os.environ["WS_CACHE"] == "1",
+    "solver.prewarm.enabled": os.environ["WS_PREWARM"] == "1",
+}
+if os.environ.get("WS_CACHE_DIR"):
+    props["solver.compile.cache.dir"] = os.environ["WS_CACHE_DIR"]
+cfg = CruiseControlConfig(props)
+caps = StaticCapacityResolver({}, {Resource.CPU: 100.0, Resource.DISK: 1e7,
+                                   Resource.NW_IN: 1e6,
+                                   Resource.NW_OUT: 1e6})
+backend = InMemoryAdminBackend(partitions.values())
+monitor = LoadMonitor(cfg, backend, samplers=[SyntheticSampler()],
+                      capacity_resolver=caps,
+                      broker_racks={b: f"r{b % 3}" for b in range(brokers)})
+cc = CruiseControl(cfg, backend, load_monitor=monitor,
+                   executor=Executor(backend, synchronous=True))
+for k in range(1, 4):
+    monitor.task_runner.run_sampling_once(end_ms=k * 1000)
+t_model = time.time()
+cc.start_up(block_on_load=False, start_precompute=False)
+prewarm_wait_s = 0.0
+prewarm = None
+if os.environ.get("WS_WAIT_PREWARM") == "1":
+    mgr = prewarm_manager(cc.optimizer)
+    if mgr is not None:
+        t = time.time()
+        mgr.join(timeout=float(os.environ.get("WS_TIMEOUT", "240")))
+        prewarm_wait_s = time.time() - t
+        prewarm = mgr.status_dict()
+t_req = time.time()
+res = cc.proposals()
+done = time.time()
+print(json.dumps({
+    "import_and_model_s": round(t_model - T0, 3),
+    "prewarm_wait_s": round(prewarm_wait_s, 3),
+    "first_proposal_request_s": round(done - t_req, 3),
+    "process_to_first_proposal_s": round(done - T0, 3),
+    "num_proposals": len(res.proposals),
+    "balancedness_after": res.optimizer_result.balancedness_after,
+    "prewarm": prewarm,
+}))
+cc.shutdown()
+"""
+
+
+def _restart_probe(cache: bool, prewarm: bool, wait_prewarm: bool,
+                   cache_dir: str, timeout_s: float) -> dict:
+    """One fresh-subprocess restart measurement (arm of the --warmstart
+    stage)."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "WS_BROKERS": str(WARMSTART_BROKERS),
+        "WS_PARTITIONS": str(WARMSTART_PARTITIONS),
+        "WS_CACHE": "1" if cache else "0",
+        "WS_CACHE_DIR": cache_dir,
+        "WS_PREWARM": "1" if prewarm else "0",
+        "WS_WAIT_PREWARM": "1" if wait_prewarm else "0",
+        "WS_TIMEOUT": str(int(timeout_s)),
+        # The probe must pay its OWN compiles (or cache retrievals) —
+        # never inherit a cache dir from the parent bench process.
+        "JAX_COMPILATION_CACHE_DIR": "",
+    })
+    t0 = time.time()
+    proc = subprocess.run([sys.executable, "-c", _RESTART_PROBE_SCRIPT],
+                          capture_output=True, text=True, env=env,
+                          timeout=timeout_s, cwd=os.path.dirname(
+                              os.path.abspath(__file__)))
+    wall = time.time() - t0
+    if proc.returncode != 0:
+        return {"error": (proc.stderr or "")[-400:], "subprocess_s": wall}
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    out["subprocess_s"] = round(wall, 3)
+    return out
+
+
+def _run_warmstart_stage(progress: dict) -> dict:
+    """The --warmstart stage (round 18, two measurements):
+
+    (1) RESTART-TO-FIRST-PROPOSAL in fresh subprocesses — arm A pays the
+    full cold compile on the request path (no persistent cache, no
+    prewarm); a prime run populates the persistent cache + shape
+    registry; arm B restarts against them with background prewarm and
+    measures both the prewarm sweep and the first request after it.
+
+    (2) STEADY-STATE warm vs cold under the round-11 drift twin
+    (broker_loss_drift, per-tick detection): identical scenario at one
+    seed with ``solver.warm.start.enabled`` flipped. The in-run canary
+    HARD-FAILS (vs_baseline=0) on a balancedness or violated-set flip
+    between the arms — warm starts must never change solution quality
+    beyond the sentry band."""
+    import dataclasses as _dc
+    import tempfile
+
+    from cruise_control_tpu.testing.simulator import (
+        CANONICAL_SCENARIOS, ClusterSimulator,
+    )
+    from cruise_control_tpu.utils.sensors import SENSORS
+
+    cache_dir = tempfile.mkdtemp(prefix="cc_warmstart_cache_")
+    probe_timeout = float(os.environ.get("BENCH_WARMSTART_TIMEOUT_S",
+                                         "240"))
+    t0 = time.time()
+    # Arm A is cold AND prime at once: the cache starts empty, so its
+    # first proposal pays the full compile on the request path (cache
+    # writes/shape recording are off-path — this IS the cold
+    # measurement), while populating the disk cache + shape registry
+    # arm B restarts against.
+    cold = _restart_probe(cache=True, prewarm=True, wait_prewarm=True,
+                          cache_dir=cache_dir, timeout_s=probe_timeout)
+    progress["restart_cold"] = cold
+    warm = _restart_probe(cache=True, prewarm=True, wait_prewarm=True,
+                          cache_dir=cache_dir, timeout_s=probe_timeout)
+    progress["restart_warm"] = warm
+    restart_s = time.time() - t0
+
+    def _counter(name: str) -> float:
+        return SENSORS._counters.get((name, ()), 0.0)
+
+    # (2) the drift twin, cold arm then warm arm.
+    spec = _dc.replace(CANONICAL_SCENARIOS["broker_loss_drift"],
+                       ticks=WARMSTART_TICKS)
+    overrides = {"anomaly.detection.interval.ms": int(spec.tick_s * 1000)}
+    # Warm both arms' COMPILES first (discarded run): the wall-clock
+    # comparison below must measure warm seeding, not whichever arm
+    # happened to pay the jit compiles for the twin's shapes.
+    t0 = time.time()
+    ClusterSimulator(spec, seed=0, config_overrides=overrides).run()
+    progress["twin_compile_warmup_s"] = round(time.time() - t0, 3)
+    t0 = time.time()
+    cold_run = ClusterSimulator(spec, seed=0, config_overrides=overrides
+                                ).run()
+    cold_twin_s = time.time() - t0
+    seeded0 = _counter("solver_warm_seeded")
+    fallback0 = _counter("solver_warm_fallbacks")
+    skipped0 = _counter("solver_goals_skipped")
+    t0 = time.time()
+    warm_run = ClusterSimulator(
+        spec, seed=0, config_overrides={
+            **overrides, "solver.warm.start.enabled": True}).run()
+    warm_twin_s = time.time() - t0
+
+    def _summ(run):
+        s = run.score
+        return {
+            "final_balancedness": s.balancedness[-1] if s.balancedness
+            else None,
+            "ticks_below_balancedness_slo": s.ticks_below_balancedness_slo,
+            "slo_violations": s.slo_violations(),
+            "heal_p95_ticks": s.time_to_heal_p95_ticks(),
+            "replica_moves": s.replica_moves,
+        }
+
+    cold_s, warm_s = _summ(cold_run), _summ(warm_run)
+
+    # Steady-state drift A/B on the BOUNDED dispatch path (the at-scale
+    # production path, where per-goal dispatches are the cost the warm
+    # seed + fingerprint skip remove; the twin's 6-broker facade runs
+    # the fused path, whose on-device skip already hides them): solve a
+    # skewed cluster, drift its loads ±5%, then solve the drifted model
+    # cold vs warm-seeded from the accepted target.
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from cruise_control_tpu.analyzer.constraint import OptimizationOptions
+    from cruise_control_tpu.analyzer.optimizer import (
+        GoalOptimizer, goals_by_priority,
+    )
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.model.fixtures import random_cluster
+    from cruise_control_tpu.warmstart import WarmSeedStore, apply_seed
+    cfg = CruiseControlConfig({"solver.fused.chain.max.brokers": 4})
+    optzr = GoalOptimizer(cfg)
+    st, meta = random_cluster(
+        num_brokers=WARMSTART_BROKERS, num_topics=8,
+        num_partitions=WARMSTART_PARTITIONS, rf=2, num_racks=3, seed=7,
+        skew_to_first=2.0)
+    chain_goals = goals_by_priority(cfg)
+    t0 = time.time()
+    final1, res1 = optzr.optimizations(st, meta, chain_goals,
+                                       OptimizationOptions())
+    progress["steady_compile_pass_s"] = round(time.time() - t0, 3)
+    store = WarmSeedStore()
+    store.store(final1, meta, res1)
+
+    def solve(model, seed=None):
+        t = time.time()
+        if seed is None:
+            f, r = optzr.optimizations(model, meta, chain_goals,
+                                       OptimizationOptions())
+        else:
+            f, r = optzr.optimizations(
+                apply_seed(model, seed), meta, chain_goals,
+                OptimizationOptions(), initial_state=model)
+        return f, r, time.time() - t, optzr.last_dispatch_stats()
+
+    flips_steady: list[str] = []
+    # (a) REFRESH: re-solve the UNCHANGED model — the proposal-cache
+    # refresh / regeneration case (the precompute loop's every tick when
+    # nothing moved). This is where warm seeding collapses the dispatch
+    # floor.
+    _f, res_rc, refresh_cold_s, stats_rc = solve(st)
+    _f, res_rw, refresh_warm_s, stats_rw = solve(st,
+                                                 store.match(st, meta))
+    if abs(res_rw.balancedness_after - res_rc.balancedness_after) > 0.05 \
+            or set(res_rw.violated_goals_after) \
+            - set(res_rc.violated_goals_after):
+        flips_steady.append(
+            f"steady refresh A/B: warm balancedness "
+            f"{res_rw.balancedness_after:.3f} vs cold "
+            f"{res_rc.balancedness_after:.3f}")
+    # (b) DRIFT: the loads move ±5% and the cluster did NOT execute the
+    # previous target (the adversarial case for warm seeds — from the
+    # old target the chain can converge band-worse). Measured WITH the
+    # facade's quality gate: a warm attempt below the seed's accepted
+    # band falls back to a counted cold re-solve, so the SERVED quality
+    # is gate-protected exactly like production.
+    wave = 1.0 + 0.05 * _np.cos(
+        _np.arange(st.num_partitions, dtype=_np.float32))
+    drifted = _dc.replace(
+        st, leader_load=st.leader_load * jnp.asarray(wave)[:, None],
+        follower_load=st.follower_load * jnp.asarray(wave)[:, None])
+    _f, res_cold, steady_cold_s, stats_cold = solve(drifted)
+    seed = store.match(drifted, meta)
+    _f, res_attempt, attempt_s, stats_warm = solve(drifted, seed)
+    # THE production gate predicate (warmstart.warm_quality_ok) at the
+    # configured band — the bench's "SERVED semantics" can never drift
+    # from what the facade actually serves.
+    from cruise_control_tpu.warmstart import warm_quality_ok
+    band = cfg.get_double("solver.warm.start.quality.band")
+    steady_fallback = not warm_quality_ok(
+        res_attempt, res1.balancedness_after,
+        res1.violated_goals_after, band)
+    if steady_fallback:
+        _f, res_served, fb_s, _stats_fb = solve(drifted)
+        steady_warm_s = attempt_s + fb_s
+    else:
+        res_served = res_attempt
+        steady_warm_s = attempt_s
+    if abs(res_served.balancedness_after - res_cold.balancedness_after) \
+            > 0.05 or set(res_served.violated_goals_after) \
+            - set(res_cold.violated_goals_after):
+        flips_steady.append(
+            f"steady drift A/B (served): warm-path balancedness "
+            f"{res_served.balancedness_after:.3f} vs cold "
+            f"{res_cold.balancedness_after:.3f}, warm-only violated "
+            f"{sorted(set(res_served.violated_goals_after) - set(res_cold.violated_goals_after))}")
+    # The in-run canary: the warm arm must not lose balancedness beyond
+    # the sentry band nor pick up an SLO violation the cold arm lacks.
+    flips: list[str] = []
+    if cold_s["final_balancedness"] is not None \
+            and warm_s["final_balancedness"] is not None \
+            and warm_s["final_balancedness"] \
+            < cold_s["final_balancedness"] - 0.05:
+        flips.append(
+            f"warm final balancedness {warm_s['final_balancedness']} < "
+            f"cold {cold_s['final_balancedness']} - 0.05")
+    new_slo = sorted(set(warm_s["slo_violations"])
+                     - set(cold_s["slo_violations"]))
+    if new_slo:
+        flips.append(f"warm-only SLO violations: {new_slo}")
+    flips.extend(flips_steady)
+    # A crashed restart-probe arm is a hard failure, not a row of None
+    # cells: the probes exist to exercise exactly the cache/prewarm
+    # start_up path a regression there would break.
+    for arm, out in (("cold", cold), ("warm", warm)):
+        if "error" in out:
+            flips.append(f"restart probe {arm} arm failed: "
+                         f"{out['error'][:200]}")
+
+    return {
+        "metric": "warmstart_always_hot",
+        "value": round(warm_twin_s, 3),
+        "unit": "s",
+        "vs_baseline": 0.0 if flips else 1.0,
+        "extras": {
+            "canary_flips": flips,
+            "restart_cold_first_proposal_s":
+                cold.get("process_to_first_proposal_s"),
+            "restart_prewarmed_first_proposal_s":
+                warm.get("process_to_first_proposal_s"),
+            "restart_prewarmed_request_s":
+                warm.get("first_proposal_request_s"),
+            "restart_prewarm_wait_s": warm.get("prewarm_wait_s"),
+            "restart_speedup": round(
+                cold["process_to_first_proposal_s"]
+                / max(warm.get("first_proposal_request_s") or 1e-9, 1e-9),
+                2) if "process_to_first_proposal_s" in cold
+            and "first_proposal_request_s" in warm else None,
+            "restart_probe_shapes": warm.get("prewarm"),
+            "restart_measurement_s": round(restart_s, 3),
+            "twin": f"broker_loss_drift@{WARMSTART_TICKS}ticks",
+            "twin_cold": cold_s,
+            "twin_warm": warm_s,
+            "twin_cold_wall_s": round(cold_twin_s, 3),
+            "twin_warm_wall_s": round(warm_twin_s, 3),
+            "refresh_cold_solve_s": round(refresh_cold_s, 3),
+            "refresh_warm_solve_s": round(refresh_warm_s, 3),
+            "refresh_warm_speedup": round(refresh_cold_s
+                                          / max(refresh_warm_s, 1e-9), 2),
+            "refresh_cold_dispatches": stats_rc.get("dispatch_count"),
+            "refresh_warm_dispatches": stats_rw.get("dispatch_count"),
+            "refresh_warm_goals_skipped": stats_rw.get("goals_skipped", 0),
+            "steady_cold_solve_s": round(steady_cold_s, 3),
+            "steady_warm_solve_s": round(steady_warm_s, 3),
+            "steady_warm_fallback": steady_fallback,
+            "steady_warm_attempt_s": round(attempt_s, 3),
+            "steady_cold_dispatches": stats_cold.get("dispatch_count"),
+            "steady_warm_dispatches": stats_warm.get("dispatch_count"),
+            "steady_warm_goals_skipped": stats_warm.get("goals_skipped", 0),
+            "steady_balancedness_cold": round(
+                res_cold.balancedness_after, 3),
+            "steady_balancedness_served": round(
+                res_served.balancedness_after, 3),
+            "warm_seeded_solves": _counter("solver_warm_seeded") - seeded0,
+            "warm_fallbacks": _counter("solver_warm_fallbacks") - fallback0,
+            "goals_skipped": _counter("solver_goals_skipped") - skipped0,
+            # Sentry canaries come from ONE deterministic arm — the
+            # drift A/B's SERVED result (solver byte-determinism at the
+            # pinned seed): a chain regression that shifts quality in
+            # BOTH twin arms equally passes the in-run A/B canary but
+            # still trips these against bench_baseline.json.
+            "balancedness_after": round(res_served.balancedness_after, 3),
+            "violated_goals_after": sorted(res_served.violated_goals_after),
+            "twin_final_balancedness": warm_s["final_balancedness"],
+            "solve_wall_clock_s": round(warm_twin_s, 3),
+            "measured_layer": "restart: fresh subprocess to first "
+                              "proposal (cold vs persistent-cache + "
+                              "prewarm); steady state: identical drift "
+                              "twin with warm starts flipped; the canary "
+                              "compares the two arms in-run",
+            **progress,
+        },
+    }
+
+
 def _fleet_twin_scenario_record() -> dict:
     """The fleet_megabatch twin scenario (testing/fleet_twin.py) as a
     SCENARIO_MATRIX row: two drifting clusters sharing one bucket, both
@@ -1471,6 +1861,30 @@ def _guarded_main(deadline: float) -> int:
                    "extras": {"stage": "direct_vs_greedy",
                               "error": f"{type(e).__name__}: {e}"[:500]}})
         return 0
+    if WARMSTART_MODE:
+        _emit({"metric": "bench_bootstrap",
+               "value": round(time.time() - t0, 3), "unit": "s",
+               "vs_baseline": 1.0,
+               "extras": {"device": device, "num_devices": n_dev,
+                          "mode": "warmstart",
+                          "brokers": WARMSTART_BROKERS,
+                          "partitions": WARMSTART_PARTITIONS,
+                          "compile_cache_dir": cache_dir,
+                          "stderr_file": _stderr_path}})
+        try:
+            record = _run_warmstart_stage({})
+            _emit(record)
+            baseline = load_baseline()
+            if baseline is not None:
+                verdict = compare_stage_to_baseline(record, baseline)
+                if verdict is not None:
+                    _emit(verdict)
+        except Exception as e:  # noqa: BLE001 — parseable record always
+            _emit({"metric": "stage_failed", "value": 0.0, "unit": "s",
+                   "vs_baseline": 0.0,
+                   "extras": {"stage": "warmstart_always_hot",
+                              "error": f"{type(e).__name__}: {e}"[:500]}})
+        return 0
     noop_ns = _tracing_noop_overhead_ns()
     _emit({"metric": "tracing_noop_span_overhead", "value": round(noop_ns, 1),
            "unit": "ns", "vs_baseline": 1.0,
@@ -1736,6 +2150,43 @@ def _guarded_main(deadline: float) -> int:
         _emit({"metric": "stage_partial_direct_vs_greedy", "value": 0.0,
                "unit": "s", "vs_baseline": 0.0,
                "extras": {"stage": "direct_vs_greedy", "partial": True,
+                          "skipped": True, "reason": "budget exhausted"}})
+    # The always-hot stage rides every default pass too (round 18): the
+    # CI WARMSTART row sees restart-to-first-proposal (cold vs
+    # prewarmed) and the warm-vs-cold drift-twin canary per PR without a
+    # separate invocation.
+    remaining = deadline - time.time()
+    if remaining > 120:
+        progress = {}
+        t0 = time.time()
+        signal.alarm(max(1, int(min(remaining - 15.0, 600.0))))
+        try:
+            record = _run_warmstart_stage(progress)
+            signal.alarm(0)
+            _emit(record)
+            if baseline is not None:
+                verdict = compare_stage_to_baseline(record, baseline)
+                if verdict is not None:
+                    sentry_verdicts.append(verdict)
+                    _emit(verdict)
+        except _Watchdog:
+            _emit({"metric": "stage_partial_warmstart_always_hot",
+                   "value": round(time.time() - t0, 3), "unit": "s",
+                   "vs_baseline": 0.0,
+                   "extras": {"stage": "warmstart_always_hot",
+                              "partial": True, **progress}})
+        except Exception as e:  # noqa: BLE001 — parseable record always
+            _emit({"metric": "stage_failed", "value": round(
+                time.time() - t0, 3), "unit": "s", "vs_baseline": 0.0,
+                "extras": {"stage": "warmstart_always_hot",
+                           "error": f"{type(e).__name__}: {e}"[:500],
+                           **progress}})
+        finally:
+            signal.alarm(0)
+    else:
+        _emit({"metric": "stage_partial_warmstart_always_hot", "value": 0.0,
+               "unit": "s", "vs_baseline": 0.0,
+               "extras": {"stage": "warmstart_always_hot", "partial": True,
                           "skipped": True, "reason": "budget exhausted"}})
     _emit_sentry_summary(sentry_verdicts, baseline)
     _dump_flight_recorder()

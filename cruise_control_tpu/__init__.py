@@ -18,7 +18,8 @@ Reference layer map: see SURVEY.md §1 (cruise-control/src/main/java/...).
 __version__ = "0.4.0"
 
 
-def enable_persistent_compile_cache(cache_dir: str | None = None) -> str:
+def enable_persistent_compile_cache(cache_dir: str | None = None,
+                                    min_compile_secs: float = 1.0) -> str:
     """Point XLA's persistent compilation cache at ``cache_dir`` (default:
     $JAX_COMPILATION_CACHE_DIR or /tmp/cc_tpu_jax_cache).
 
@@ -46,7 +47,8 @@ def enable_persistent_compile_cache(cache_dir: str | None = None) -> str:
                                             "/tmp/cc_tpu_jax_cache")
     cache_dir = os.path.join(cache_dir, _host_fingerprint())
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_secs))
     return cache_dir
 
 

@@ -627,6 +627,63 @@ def chain_all_violations(state: ClusterTensors, goals: tuple[Goal, ...],
     return jnp.stack(totals)
 
 
+def _chain_all_goal_stats_body(state: ClusterTensors,
+                               goals: tuple[Goal, ...],
+                               constraint: BalancingConstraint,
+                               num_topics: int, masks: ExclusionMasks,
+                               ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    derived = compute_derived(state, masks.excluded_topics,
+                              masks.excluded_replica_move_brokers,
+                              masks.excluded_leadership_brokers)
+    viols, objs = [], []
+    for g in goals:
+        aux = goal_aux(g, state, derived, constraint, num_topics)
+        viols.append(g.broker_violations(state, derived, constraint,
+                                         aux).sum().astype(jnp.float32))
+        objs.append(g.objective(state, derived, constraint,
+                                aux).astype(jnp.float32))
+    return (jnp.stack(viols), jnp.stack(objs),
+            offline_replicas(state).sum())
+
+
+@partial(jax.jit, static_argnames=("goals", "constraint", "num_topics"))
+def chain_all_goal_stats(state: ClusterTensors, goals: tuple[Goal, ...],
+                         constraint: BalancingConstraint, num_topics: int,
+                         masks: ExclusionMasks,
+                         ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """([G] violation, [G] objective, offline) for EVERY goal on ``state``
+    in ONE device call — the fingerprint-skip snapshot (round 18). The
+    per-goal entry stats dispatches of the bounded path collapse into this
+    one program: a goal whose snapshot shows zero violation (with zero
+    offline replicas and no drain pending) applies nothing, so its
+    move/swap dispatches — and its own entry/exit stats dispatches — can
+    be skipped byte-identically, as long as no earlier goal has mutated
+    the state since the snapshot (the hint-validity contract enforced by
+    the optimizer's ``chain_owns_state`` gate)."""
+    return _chain_all_goal_stats_body(state, goals, constraint, num_topics,
+                                      masks)
+
+
+@partial(jax.jit, static_argnames=("goals", "constraint", "num_topics"))
+def megabatch_all_goal_stats(states: ClusterTensors,
+                             goals: tuple[Goal, ...],
+                             constraint: BalancingConstraint,
+                             num_topics: int, masks: ExclusionMasks,
+                             ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Batched fingerprint-skip snapshot: ([C, G] violation, [C, G]
+    objective, [C] offline) for every goal of every cluster in ONE device
+    call (the ``chain_all_goal_stats`` twin on the megabatch cluster
+    axis)."""
+    mask_fields, mask_ax = _mask_axes(masks)
+
+    def per_cluster(s, tm, rm, lm):
+        return _chain_all_goal_stats_body(s, goals, constraint, num_topics,
+                                          ExclusionMasks(tm, rm, lm))
+
+    return jax.vmap(per_cluster, in_axes=(0,) + mask_ax)(states,
+                                                         *mask_fields)
+
+
 @partial(jax.jit, static_argnames=("goals", "constraint", "cfg", "num_topics",
                                    "swap_moves", "swap_max_rounds"))
 def chain_optimize_full(state: ClusterTensors, goals: tuple[Goal, ...],
@@ -935,6 +992,13 @@ class DispatchStats:
         self.donated = 0
         self.speculative = 0
         self.by_kind: dict[str, int] = {}
+        # Goals that consumed ZERO dispatches thanks to the
+        # fingerprint-skip snapshot (round 18): their entry stats came
+        # from the one batched pre-chain program and showed nothing to do.
+        self.goals_skipped = 0
+        # crc32 of the pass's per-goal entry-violation vector (the
+        # round-18 fingerprint; None when the snapshot did not run).
+        self.fingerprint = None
 
     def record(self, kind: str, rounds: int, donated: bool = False,
                speculative: bool = False, telemetry: bool = True) -> None:
@@ -973,6 +1037,12 @@ class DispatchStats:
             # Present only when the direct-assignment kernel ran, so
             # pre-direct accounting consumers see an unchanged dict.
             out["direct_dispatches"] = self.by_kind["direct"]
+        if self.goals_skipped:
+            # Present only when the fingerprint snapshot actually skipped
+            # goals (same compatibility discipline as direct_dispatches).
+            out["goals_skipped"] = self.goals_skipped
+        if self.fingerprint is not None:
+            out["violation_fingerprint"] = self.fingerprint
         return out
 
 
@@ -1544,6 +1614,8 @@ def optimize_goal_in_chain_megabatch(states: ClusterTensors,
                                      physical_stats: "DispatchStats | None" = None,
                                      flights=None,
                                      donate_input: bool = False,
+                                     entry_stats: tuple | None = None,
+                                     drain_hint=None,
                                      ) -> tuple[ClusterTensors, list[dict]]:
     """Run goal ``chain[index]`` for EVERY cluster in a megabatch under
     the acceptance of ``chain[:index]`` — the batched twin of
@@ -1563,6 +1635,14 @@ def optimize_goal_in_chain_megabatch(states: ClusterTensors,
     shares one compiled grid across the bucket (the assembler's config
     key pins this).
 
+    ``entry_stats`` / ``drain_hint`` (round 18): this goal's per-cluster
+    ``([C] violation, [C] objective, [C] offline)`` and drain-pending
+    ``[C]`` bools already computed by ONE ``megabatch_all_goal_stats``
+    snapshot for the whole chain — valid only while no goal has mutated
+    any cluster since the snapshot (the ``chain_owns_state`` gate). A
+    goal the snapshot shows inactive for EVERY cluster consumes zero
+    batched dispatches.
+
     Returns (states, [per-cluster info dict])."""
     import numpy as np
     goals = tuple(chain)
@@ -1573,12 +1653,17 @@ def optimize_goal_in_chain_megabatch(states: ClusterTensors,
     cluster_mask = np.asarray(cluster_mask).astype(bool)
     assert dispatch_rounds > 0, "megabatch requires the bounded path"
 
-    viol0_d, obj0_d, off0_d = megabatch_goal_stats(states, idx, goals,
-                                                   constraint, num_topics,
-                                                   masks)
-    viol0 = np.asarray(viol0_d)
-    obj0 = np.asarray(obj0_d)
-    off0 = np.asarray(off0_d)
+    if entry_stats is not None:
+        viol0, obj0, off0 = (np.asarray(entry_stats[0]),
+                             np.asarray(entry_stats[1]),
+                             np.asarray(entry_stats[2]))
+    else:
+        viol0_d, obj0_d, off0_d = megabatch_goal_stats(states, idx, goals,
+                                                       constraint,
+                                                       num_topics, masks)
+        viol0 = np.asarray(viol0_d)
+        obj0 = np.asarray(obj0_d)
+        off0 = np.asarray(off0_d)
     if flights is not None:
         for b in range(c):
             if cluster_mask[b]:
@@ -1589,9 +1674,21 @@ def optimize_goal_in_chain_megabatch(states: ClusterTensors,
                                 cfg.moves_per_round)
     drain = np.zeros(c, dtype=bool)
     if masks.excluded_replica_move_brokers is not None:
-        drain = np.asarray(jax.vmap(excluded_hosting_replicas)(
-            states, masks.excluded_replica_move_brokers).any(axis=(1, 2)))
+        drain = np.asarray(drain_hint).astype(bool) \
+            if drain_hint is not None \
+            else np.asarray(jax.vmap(excluded_hosting_replicas)(
+                states, masks.excluded_replica_move_brokers).any(axis=(1, 2)))
     ran = cluster_mask & ((viol0 > 0) | (off0 > 0) | drain)
+    if entry_stats is not None and not ran.any():
+        # Whole-goal fingerprint skip: no cluster has anything to do, so
+        # the goal pays zero batched dispatches (entry/exit stats both
+        # come from the snapshot).
+        if physical_stats is not None:
+            physical_stats.goals_skipped += 1
+        if stats is not None:
+            for b in range(c):
+                if cluster_mask[b]:
+                    stats[b].goals_skipped += 1
 
     donate = donation_enabled(megastep)
     async_rb = bool(megastep.async_readback)
@@ -1802,6 +1899,8 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
                            stats: DispatchStats | None = None,
                            donate_input: bool = False,
                            flight=NO_FLIGHT,
+                           entry_stats: tuple | None = None,
+                           drain_hint: bool | None = None,
                            ) -> tuple[ClusterTensors, dict]:
     """Run goal ``chain[index]`` to convergence under the acceptance of
     ``chain[:index]``, using the chain-shared kernels (same semantics and
@@ -1842,6 +1941,18 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
     telemetry; when it is recording, the MOVE-phase kernels run with the
     per-round stats ring enabled (``ring_rounds``) — reductions only, so
     the trajectory is unchanged (the recorder's parity contract).
+
+    ``entry_stats`` (round 18 fingerprint skip): the goal's
+    ``(violation, objective, offline)`` ALREADY computed by the one
+    batched pre-chain ``chain_all_goal_stats`` program — valid only while
+    no earlier goal has mutated the state since that snapshot (the
+    caller's responsibility; the optimizer gates on ``chain_owns_state``).
+    With it provided, the per-goal entry stats dispatch is skipped, and a
+    goal with nothing to do consumes ZERO dispatches (counted in
+    ``stats.goals_skipped``) — byte-identical to the unhinted path, since
+    the hint holds the exact values that dispatch would have returned.
+    ``drain_hint`` is the matching precomputed drain-pending bool (drain
+    is goal-independent, a function of state + masks only).
     """
     goal_t0 = _time.monotonic()
 
@@ -1855,8 +1966,12 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
     idx = jnp.int32(index)
     prior = jnp.asarray([j < index for j in range(len(goals))])
 
-    viol0, obj0, offline0 = chain_goal_stats(state, idx, goals, constraint,
-                                             num_topics, masks)
+    if entry_stats is not None:
+        viol0, obj0, offline0 = entry_stats
+    else:
+        viol0, obj0, offline0 = chain_goal_stats(state, idx, goals,
+                                                 constraint, num_topics,
+                                                 masks)
     flight.entry(violation=float(viol0), objective=float(obj0),
                  offline=int(offline0))
     total_applied = 0
@@ -1870,8 +1985,9 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
         else False
     drain = False
     if masks.excluded_replica_move_brokers is not None:
-        drain = bool(excluded_hosting_replicas(
-            state, masks.excluded_replica_move_brokers).any())
+        drain = bool(drain_hint) if drain_hint is not None \
+            else bool(excluded_hosting_replicas(
+                state, masks.excluded_replica_move_brokers).any())
     # Direct-assignment pre-pass eligibility (analyzer.direct): bounded
     # path, kernel enabled for this pass (the optimizer resolves the
     # config flag AND the wide-regime gate into megastep), a
@@ -1991,6 +2107,11 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
     # no drain pending = the search fixed point is immediate — skip the
     # drivers and their dispatch round-trips entirely.
     ran = float(viol0) > 0 or int(offline0) > 0 or drain
+    if not ran and entry_stats is not None and stats is not None:
+        # Fingerprint skip: the goal consumed ZERO dispatches — its entry
+        # stats came from the batched pre-chain snapshot and its exit
+        # stats ARE its entry stats (nothing ran).
+        stats.goals_skipped += 1
     direct_moves = 0
     direct_sweeps = 0
     if ran and use_direct and float(viol0) > 0:

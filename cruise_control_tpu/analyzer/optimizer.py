@@ -183,6 +183,17 @@ class GoalOptimizer:
             "solver.direct.assignment.enabled")
         self._direct_max_sweeps = self._config.get_int(
             "solver.direct.max.sweeps")
+        # Fingerprint goal skipping (round 18): ONE batched stats program
+        # snapshots every goal's entry violation before the bounded
+        # per-goal loop; goals with nothing to do consume zero dispatches
+        # (byte-identical — a violation-free goal applies nothing).
+        self._fingerprint_skip = self._config.get_boolean(
+            "solver.fingerprint.skip.enabled")
+        # Prewarm shape registry (round 18, warmstart.ensure_prewarm):
+        # when attached, every solve records its padded tensor signature
+        # so a FRESH process can compile the whole per-shape kernel set
+        # in a background thread before its first request.
+        self._shape_registry = None
         # Adaptive dispatch controllers PERSIST across optimization passes,
         # keyed by MODEL SHAPE: per-round cost is a property of the
         # cluster shape, so the budget learned on one pass carries to the
@@ -473,8 +484,18 @@ class GoalOptimizer:
     def optimizations(self, state: ClusterTensors, meta: ClusterMeta,
                       goals: Sequence[Goal] | None = None,
                       options: OptimizationOptions | None = None,
+                      initial_state: ClusterTensors | None = None,
                       ) -> tuple[ClusterTensors, OptimizerResult]:
-        """Run the goal chain; returns (final_state, OptimizerResult)."""
+        """Run the goal chain; returns (final_state, OptimizerResult).
+
+        ``initial_state`` (round 18 warm starts): the TRUE current model
+        when ``state`` is a warm-seeded search start — the proposal
+        diff, stats_before, and the before picture
+        (violated_goals_before / balancedness_before, from one batched
+        violation snapshot of the true initial — per-goal violations at
+        chain start rather than the serial path's at-its-turn reading)
+        are computed against it, so results always describe reality,
+        never the previous target."""
         from ..utils.flight_recorder import FLIGHT
         from ..utils.progress import step
         from ..utils.tracing import TRACER
@@ -493,12 +514,13 @@ class GoalOptimizer:
                            state.num_brokers)) as flight_pass:
             return self._optimizations_traced(
                 state, meta, goals, options, _opt_span, flight_pass,
-                t_start=time.time())
+                t_start=time.time(), initial_state=initial_state)
 
     def _optimizations_traced(self, state: ClusterTensors, meta: ClusterMeta,
                               goals: Sequence[Goal] | None,
                               options: OptimizationOptions | None,
                               _opt_span, flight_pass, t_start: float,
+                              initial_state: ClusterTensors | None = None,
                               ) -> tuple[ClusterTensors, OptimizerResult]:
         from ..utils.tracing import TRACER
         options = options or OptimizationOptions()
@@ -519,8 +541,12 @@ class GoalOptimizer:
         fast_budget_s = (self._config.get_long(
             "fast.mode.per.broker.move.timeout.ms") * state.num_brokers
             / 1000.0) if fast else 0.0
-        initial = state
-        stats_before = cluster_stats(state)
+        # Warm-seeded solves diff against the TRUE current model: the
+        # chain runs from the seeded ``state`` but proposals/stats_before
+        # describe moves from reality (facade warm-start contract).
+        initial = initial_state if initial_state is not None else state
+        stats_before = cluster_stats(initial)
+        self._maybe_record_shape(state, meta, goal_chain, masks)
 
         from .chain import DispatchStats
         stats = DispatchStats()
@@ -634,6 +660,33 @@ class GoalOptimizer:
             deficit_sizing = megastep.deficit_moves_cap > 0
             flight_pass.set(path="bounded" if dispatch_rounds > 0
                             else "pergoal")
+            # Fingerprint goal skipping (round 18): ONE batched stats
+            # program snapshots every goal's entry (violation, objective)
+            # plus the goal-independent offline count and drain flag.
+            # While no goal has mutated the state (chain_owns_state
+            # False), each goal's entry stats come from the snapshot —
+            # and a goal it shows inactive consumes zero dispatches.
+            # After the first mutation the hints are stale and goals
+            # dispatch their own entry stats exactly as before.
+            hint_viol = hint_obj = None
+            hint_off = 0
+            hint_drain = None
+            if self._fingerprint_skip and not fast:
+                from ..warmstart import violation_fingerprint
+                from .chain import chain_all_goal_stats
+                av, ao, aoff = chain_all_goal_stats(
+                    state, tuple(goal_chain), self._constraint,
+                    meta.num_topics, masks)
+                hint_viol = np.asarray(av)
+                hint_obj = np.asarray(ao)
+                hint_off = int(aoff)
+                hint_drain = False
+                if masks.excluded_replica_move_brokers is not None:
+                    from .chain import excluded_hosting_replicas
+                    hint_drain = bool(excluded_hosting_replicas(
+                        state,
+                        masks.excluded_replica_move_brokers).any())
+                stats.fingerprint = violation_fingerprint(hint_viol)
             goal_results = []
             # Donation gate for the chain's FIRST mutating dispatch: until
             # some goal has actually run a dispatch, the threaded state is
@@ -646,6 +699,10 @@ class GoalOptimizer:
                 use_wide = wide_cfg is not None and g.prefers_wide_batches
                 cfg_used = wide_cfg if use_wide else search_cfg
                 wide_class = use_wide or (deficit_sizing and g.count_based)
+                entry = None
+                if hint_viol is not None and not chain_owns_state:
+                    entry = (float(hint_viol[i]), float(hint_obj[i]),
+                             hint_off)
                 with TRACER.span("goal.solve", goal=g.name,
                                  candidates=cfg_used.num_sources
                                  * cfg_used.num_dests) as gsp:
@@ -657,7 +714,10 @@ class GoalOptimizer:
                         wall_budget_s=fast_budget_s,
                         megastep=megastep, stats=stats,
                         donate_input=chain_owns_state,
-                        flight=flight_pass.goal(g.name))
+                        flight=flight_pass.goal(g.name),
+                        entry_stats=entry,
+                        drain_hint=hint_drain if entry is not None
+                        else None)
                     chain_owns_state |= info["rounds"] > 0 \
                         or info.get("direct_sweeps", 0) > 0
                     gsp.set(rounds=info["rounds"],
@@ -673,7 +733,23 @@ class GoalOptimizer:
                     or not info["succeeded"],
                     swaps_applied=info.get("swaps_applied", 0)))
 
-        violated_before = [r.name for r in goal_results if r.violated_before]
+        if stats.goals_skipped:
+            from ..utils.sensors import SENSORS as _S
+            _S.count("solver_goals_skipped", stats.goals_skipped)
+        if initial_state is not None:
+            # Warm-seeded solve: the per-goal entry stats describe the
+            # SEEDED search start, but the user-facing "before" picture
+            # (violated_goals_before, balancedness_before) must describe
+            # reality — one batched snapshot on the true initial.
+            from .chain import chain_all_violations
+            av0 = np.asarray(chain_all_violations(
+                initial, tuple(goal_chain), self._constraint,
+                meta.num_topics, masks))
+            violated_before = [g.name for g, v in zip(goal_chain, av0)
+                               if float(v) > 1e-6]
+        else:
+            violated_before = [r.name for r in goal_results
+                               if r.violated_before]
         violated_after = [r.name for r in goal_results if not r.succeeded]
         with TRACER.span("analyzer.proposal_diff") as dsp:
             stats_after = cluster_stats(state)
@@ -780,6 +856,13 @@ class GoalOptimizer:
         cluster_ids = [it[2] if len(it) > 2 else None for it in items]
         opts_list = [it[3] if len(it) > 3 and it[3] is not None else options
                      for it in items]
+        # Optional per-item TRUE initial state (5th element, round 18
+        # warm starts): the chain solves from the seeded ``state`` but
+        # each cluster's proposal diff / stats_before / before-picture
+        # use reality.
+        warm_seeded = [len(it) > 4 and it[4] is not None for it in items]
+        true_initials = [it[4] if w else it[0]
+                         for w, it in zip(warm_seeded, items)]
         if any(o.fast_mode for o in opts_list):
             raise ValueError("megabatch does not support fast_mode")
         shape0 = jax.tree.map(lambda x: x.shape, states[0])
@@ -836,8 +919,34 @@ class GoalOptimizer:
         t_start = time.time()
 
         batched = stack_states(states)
-        initial_states = [it[0] for it in items]
+        initial_states = true_initials
         stats_before = [cluster_stats(st) for st in initial_states]
+        self._maybe_record_shape(states[0], metas[0], goal_chain,
+                                 masks_list[0], batch=c)
+
+        # Fingerprint goal skipping, batched (round 18): one [C, G]
+        # snapshot for the whole chain; a goal it shows inactive for
+        # EVERY cluster consumes zero batched dispatches. Hints go stale
+        # at the first mutation (chain_owns_state), like the serial path.
+        hint = None
+        hint_drain = None
+        if self._fingerprint_skip:
+            from ..warmstart import violation_fingerprint
+            from .chain import (
+                excluded_hosting_replicas, megabatch_all_goal_stats,
+            )
+            av, ao, aoff = megabatch_all_goal_stats(
+                batched, tuple(goal_chain), self._constraint, num_topics,
+                batched_masks)
+            hint = (np.asarray(av), np.asarray(ao), np.asarray(aoff))
+            if batched_masks.excluded_replica_move_brokers is not None:
+                hint_drain = np.asarray(jax.vmap(excluded_hosting_replicas)(
+                    batched,
+                    batched_masks.excluded_replica_move_brokers,
+                ).any(axis=(1, 2)))
+            else:
+                hint_drain = np.zeros(c, dtype=bool)
+            physical.fingerprint = violation_fingerprint(hint[0])
 
         results_per_goal: list[list[dict]] = []
         durations: list[float] = []
@@ -872,6 +981,9 @@ class GoalOptimizer:
                         flight_passes[b].goal(g.name)
                         if flight_passes[b] is not None else NO_FLIGHT
                         for b in range(c)]
+                    entry = None
+                    if hint is not None and not chain_owns_state:
+                        entry = (hint[0][:, i], hint[1][:, i], hint[2])
                     batched, infos = optimize_goal_in_chain_megabatch(
                         batched, goal_chain, i, self._constraint, cfg_used,
                         num_topics, batched_masks, cluster_mask & ~dead,
@@ -879,7 +991,10 @@ class GoalOptimizer:
                         dispatch=ctl_pair[1 if use_wide else 0],
                         megastep=megastep, stats=per_cluster_stats,
                         physical_stats=physical, flights=flights,
-                        donate_input=chain_owns_state)
+                        donate_input=chain_owns_state,
+                        entry_stats=entry,
+                        drain_hint=hint_drain if entry is not None
+                        else None)
                     chain_owns_state |= any(
                         info["rounds"] > 0 or info.get("direct_sweeps", 0) > 0
                         for info in infos)
@@ -895,6 +1010,32 @@ class GoalOptimizer:
                             dead[b] = True
                 sp.set(dispatches=physical.dispatch_count,
                        errors=int(dead[cluster_mask].sum()))
+            if physical.goals_skipped:
+                SENSORS.count("solver_goals_skipped",
+                              physical.goals_skipped)
+
+        # Warm-path before picture, ONE batched snapshot for every
+        # warm-seeded member (a per-cluster host loop of
+        # chain_all_violations would pay one device round-trip per
+        # cluster — on a tunneled chip that is ~0.5 s of RTT each,
+        # eroding exactly the dispatch savings warm starts buy).
+        warm_violated_before: dict[int, list] = {}
+        warm_rows = [b for b in range(n) if warm_seeded[b]
+                     and errors[b] is None]
+        if warm_rows:
+            from .chain import megabatch_all_goal_stats, stack_states
+            init_batch = stack_states([initial_states[b]
+                                       for b in warm_rows])
+            init_masks = self._stack_masks([masks_list[b]
+                                            for b in warm_rows])
+            av, _ao, _aoff = megabatch_all_goal_stats(
+                init_batch, tuple(goal_chain), self._constraint,
+                num_topics, init_masks)
+            av = np.asarray(av)
+            for i, b in enumerate(warm_rows):
+                warm_violated_before[b] = [
+                    g.name for g, v in zip(goal_chain, av[i])
+                    if float(v) > 1e-6]
 
         out: list = []
         for b in range(n):
@@ -916,8 +1057,14 @@ class GoalOptimizer:
                 swaps_applied=results_per_goal[i][b]["swaps_applied"])
                 for i, g in enumerate(goal_chain)
                 if i < len(results_per_goal)]
-            violated_before = [r.name for r in goal_results
-                               if r.violated_before]
+            if b in warm_violated_before:
+                # Reality-first "before" picture, from the one batched
+                # snapshot above (same semantics as the serial warm
+                # path).
+                violated_before = warm_violated_before[b]
+            else:
+                violated_before = [r.name for r in goal_results
+                                   if r.violated_before]
             violated_after = [r.name for r in goal_results
                               if not r.succeeded]
             with cluster_label(cid) if cid is not None \
@@ -957,6 +1104,174 @@ class GoalOptimizer:
         dict). The fleet runner reads it to report
         fleet_precompute_dispatches{cluster=} exactly."""
         return dict(getattr(self, "_megabatch_cluster_stats", {}))
+
+    # -- prewarm (round 18, warmstart.py) ----------------------------------
+    def attach_shape_registry(self, registry) -> None:
+        """warmstart.ensure_prewarm's recording seam: every solve after
+        this records its padded tensor signature, so a FRESH process can
+        compile the whole per-shape kernel set before its first request."""
+        self._shape_registry = registry
+
+    def _maybe_record_shape(self, state, meta, goal_chain, masks,
+                            batch: int = 0) -> None:
+        reg = self._shape_registry
+        if reg is None:
+            return
+        try:
+            from ..warmstart import shape_signature
+            sig = shape_signature(state, meta.num_topics, goal_chain,
+                                  masks, batch=batch)
+            if sig is not None:
+                reg.record(sig)
+        except Exception:  # noqa: BLE001 — recording must never break a solve
+            LOG.debug("prewarm shape recording failed", exc_info=True)
+
+    def prewarm_shape(self, entry: dict) -> bool:
+        """Warm the solver-program set for ONE recorded shape signature by
+        EXECUTING the production chain kernels on an inert synthetic model
+        of that shape (zero-round budgets, all-dead brokers: every kernel
+        compiles fully but does no search work). In-process this fills the
+        jit dispatch caches the first real solve will hit; with the
+        persistent compile cache enabled the XLA backend artifacts also
+        land on disk, so the NEXT restart retrieves instead of compiling.
+        Returns False when the entry is not reproducible here (unknown or
+        non-default goal spec, mesh-sharded solver) — never raises for a
+        merely mismatched entry; kernel failures propagate to the prewarm
+        manager, which records and continues."""
+        import jax
+        from ..utils.flight_recorder import FLIGHT
+        from ..warmstart import synthetic_masks, synthetic_state
+        from .chain import (
+            chain_all_goal_stats, chain_goal_stats, chain_optimize_full,
+            chain_optimize_rounds, chain_optimize_rounds_donated,
+            chain_swap_rounds, chain_swap_rounds_donated, donation_enabled,
+            megabatch_all_goal_stats, megabatch_goal_stats,
+            megabatch_optimize_rounds, megabatch_optimize_rounds_donated,
+            megabatch_swap_rounds, megabatch_swap_rounds_donated,
+            stack_states, strip_mutable,
+        )
+        from .goals import ALL_GOALS
+        if self._mesh is not None:
+            return False
+        names = entry.get("goals") or []
+        if not names or any(n not in ALL_GOALS for n in names):
+            return False
+        goals = tuple(ALL_GOALS[n]() for n in names)
+        state = synthetic_state(entry)
+        masks = synthetic_masks(entry)
+        num_topics = int(entry["num_topics"])
+        batch = int(entry.get("batch") or 0)
+        constraint = self._constraint
+        cfg = self.search_config(state)
+        megastep = self._megastep_config(state.num_brokers)
+        donate = donation_enabled(megastep)
+        ring_n = FLIGHT.ring_rounds if FLIGHT.enabled else 0
+        wide_cfg = self._wide_config(cfg, goals, state.num_brokers)
+        idx = jnp.int32(0)
+        prior = jnp.asarray([False] * len(goals))
+        zero = jnp.int32(0)
+
+        def wait(out):
+            jax.tree.map(lambda x: x.block_until_ready()
+                         if hasattr(x, "block_until_ready") else x, out)
+
+        if batch > 0:
+            batched = stack_states([state] * batch)
+            bmasks = ExclusionMasks(*(
+                None if f is None else jnp.stack([f] * batch)
+                for f in (masks.excluded_topics,
+                          masks.excluded_replica_move_brokers,
+                          masks.excluded_leadership_brokers)))
+            active = jnp.zeros((batch,), bool)
+            if self._fingerprint_skip:
+                wait(megabatch_all_goal_stats(batched, goals, constraint,
+                                              num_topics, bmasks))
+            wait(megabatch_goal_stats(batched, idx, goals, constraint,
+                                      num_topics, bmasks))
+            for c in [cfg] + ([wide_cfg] if wide_cfg else []):
+                if donate:
+                    rest = dataclasses.replace(
+                        batched,
+                        assignment=jnp.zeros(
+                            (batch, 0, batched.assignment.shape[2]),
+                            batched.assignment.dtype),
+                        leader_slot=jnp.zeros((batch, 0),
+                                              batched.leader_slot.dtype))
+                    wait(megabatch_optimize_rounds_donated(
+                        jnp.copy(batched.assignment),
+                        jnp.copy(batched.leader_slot), rest, active, idx,
+                        prior, goals, constraint, c, num_topics, bmasks,
+                        zero, ring_rounds=ring_n))
+                else:
+                    wait(megabatch_optimize_rounds(
+                        batched, active, idx, prior, goals, constraint, c,
+                        num_topics, bmasks, zero, ring_rounds=ring_n))
+            if donate:
+                rest = dataclasses.replace(
+                    batched,
+                    assignment=jnp.zeros(
+                        (batch, 0, batched.assignment.shape[2]),
+                        batched.assignment.dtype),
+                    leader_slot=jnp.zeros((batch, 0),
+                                          batched.leader_slot.dtype))
+                wait(megabatch_swap_rounds_donated(
+                    jnp.copy(batched.assignment),
+                    jnp.copy(batched.leader_slot), rest, active, idx,
+                    prior, goals, constraint, num_topics, bmasks, 8, 64,
+                    zero))
+            else:
+                wait(megabatch_swap_rounds(batched, active, idx, prior,
+                                           goals, constraint, num_topics,
+                                           bmasks, 8, 64, zero))
+            return True
+
+        fused = self._fused_chain and (
+            self._fused_max_brokers == 0
+            or state.num_brokers <= self._fused_max_brokers)
+        if fused:
+            # The production path at this scale is the ONE whole-chain
+            # program — the 46-63 s warmup compile of BENCH r02/r03.
+            wait(chain_optimize_full(state, goals, constraint, cfg,
+                                     num_topics, masks))
+            return True
+        # Mirror _optimizations_traced's per-goal dispatch selection
+        # exactly: with the fused chain configured, oversized clusters
+        # run BOUNDED dispatches (traced budget arg); with it off, the
+        # per-goal drivers run unbounded (no budget arg — a different
+        # trace, so a prewarm of the wrong variant would warm nothing).
+        bounded = self._fused_chain and self._dispatch_rounds > 0
+        if self._fingerprint_skip:
+            wait(chain_all_goal_stats(state, goals, constraint, num_topics,
+                                      masks))
+        wait(chain_goal_stats(state, idx, goals, constraint, num_topics,
+                              masks))
+        for c in [cfg] + ([wide_cfg] if wide_cfg else []):
+            if donate and bounded:
+                wait(chain_optimize_rounds_donated(
+                    jnp.copy(state.assignment), jnp.copy(state.leader_slot),
+                    strip_mutable(state), idx, prior, goals, constraint, c,
+                    num_topics, masks, zero, ring_rounds=ring_n))
+            elif bounded:
+                wait(chain_optimize_rounds(state, idx, prior, goals,
+                                           constraint, c, num_topics, masks,
+                                           budget=zero,
+                                           ring_rounds=ring_n))
+            else:
+                wait(chain_optimize_rounds(state, idx, prior, goals,
+                                           constraint, c, num_topics, masks,
+                                           ring_rounds=ring_n))
+        if donate and bounded:
+            wait(chain_swap_rounds_donated(
+                jnp.copy(state.assignment), jnp.copy(state.leader_slot),
+                strip_mutable(state), idx, prior, goals, constraint,
+                num_topics, masks, 8, 64, zero))
+        elif bounded:
+            wait(chain_swap_rounds(state, idx, prior, goals, constraint,
+                                   num_topics, masks, budget=zero))
+        else:
+            wait(chain_swap_rounds(state, idx, prior, goals, constraint,
+                                   num_topics, masks))
+        return True
 
     @staticmethod
     def _uniform_mask_presence(masks_list: list[ExclusionMasks],

@@ -143,8 +143,6 @@ def main(argv: list[str] | None = None) -> int:
 
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s "
                         "%(levelname)s %(message)s")
-    from cruise_control_tpu import enable_persistent_compile_cache
-    enable_persistent_compile_cache()
     overrides = load_properties(args.properties) if args.properties else {}
     if overrides.get("bootstrap.servers") and not args.demo:
         # Live mode: the wire-protocol client manages the real cluster.
@@ -153,6 +151,13 @@ def main(argv: list[str] | None = None) -> int:
         demo_cfg = dict(_DEMO_DEFAULTS)
         demo_cfg.update(overrides)
         cc = build_demo_cruise_control(CruiseControlConfig(demo_cfg))
+    # start_up wires the persistent compile cache + the background shape
+    # prewarm from the solver.compile.cache.* / solver.prewarm.* config
+    # keys (round 18) — no wrapper-script env vars needed; configure the
+    # cache as early as possible anyway so even monitor-warmup jits land
+    # in it.
+    from cruise_control_tpu.warmstart import configure_compile_cache
+    configure_compile_cache(cc.config)
     cc.start_up(block_on_load=False)
 
     server, api = make_server(cc, host=args.host, port=args.port)

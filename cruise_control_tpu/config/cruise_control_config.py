@@ -396,6 +396,60 @@ def _definition() -> ConfigDef:
              "no movers remain OR a few consecutive sweeps apply nothing "
              "(a stalled rotation), so budget beyond convergence is "
              "near-free.")
+    d.define("solver.fingerprint.skip.enabled", T.BOOLEAN, True, None, I.LOW,
+             "Always-hot solver (round 18): snapshot EVERY goal's entry "
+             "violation in ONE batched stats program before the bounded "
+             "chain loop, and skip a goal's move/swap (and per-goal "
+             "stats) dispatches entirely while the snapshot is valid and "
+             "shows nothing to do — byte-identical to the unskipped "
+             "path, since a violation-free goal applies nothing. Under "
+             "sustained drift with warm starts most goals skip, so the "
+             "per-goal dispatch floor collapses to one program.")
+    d.define("solver.warm.start.enabled", T.BOOLEAN, False, None, I.MEDIUM,
+             "Always-hot solver (round 18): seed each default-chain solve "
+             "from the facade's last ACCEPTED (assignment, leader_slot) "
+             "instead of the cold model state — proposals still diff "
+             "against the TRUE current model, and a warm-seeded result "
+             "worse than the cold path's sentry band (see "
+             "solver.warm.start.quality.band) triggers a counted cold "
+             "re-solve, so warm starts can never silently degrade "
+             "proposals. OFF by default: warm-seeded searches may reach "
+             "a different (quality-band-equivalent) optimum than cold "
+             "ones, which flips byte-pinned replay digests.")
+    d.define("solver.warm.start.quality.band", T.DOUBLE, 0.05,
+             Range.at_least(0.0), I.LOW,
+             "Warm-start fallback band: a warm-seeded solve whose "
+             "balancedness_after drops more than this below the seed's "
+             "own accepted balancedness, or that violates a goal the "
+             "seed's solve did not, is discarded and re-solved cold "
+             "(counted in solver_warm_fallbacks). Matches the bench "
+             "regression sentry's balancedness canary band.")
+    d.define("solver.compile.cache.enabled", T.BOOLEAN, True, None, I.LOW,
+             "Persist XLA compilation artifacts across process restarts "
+             "(the enable_persistent_compile_cache seam, called from "
+             "facade start_up so SERVING processes get the cache without "
+             "wrapper scripts). The cache is partitioned per host "
+             "fingerprint; see solver.compile.cache.dir.")
+    d.define("solver.compile.cache.dir", T.STRING, None, None, I.LOW,
+             "Root directory of the persistent compile cache. Unset "
+             "falls back to $JAX_COMPILATION_CACHE_DIR, then "
+             "/tmp/cc_tpu_jax_cache.")
+    d.define("solver.compile.cache.min.compile.secs", T.DOUBLE, 1.0,
+             Range.at_least(0.0), I.LOW,
+             "Minimum backend-compile duration for an artifact to be "
+             "persisted (jax_persistent_cache_min_compile_time_secs): "
+             "keeps the cache to the expensive solver programs.")
+    d.define("solver.prewarm.enabled", T.BOOLEAN, False, None, I.MEDIUM,
+             "Always-hot solver (round 18): record every solved padded "
+             "bucket-shape signature under the persistent compile "
+             "cache's host partition, and have a fresh process compile "
+             "the whole known-shape kernel set in a background thread at "
+             "start_up (GoalOptimizer.prewarm_shape on inert synthetic "
+             "models) — a new replica serves its first rebalance in "
+             "seconds instead of paying the warmup compile on the "
+             "request path. Requires solver.compile.cache.enabled; "
+             "progress on GET /state and /fleet, compiles watched by "
+             "xla_compile_cache_{hits,misses}.")
     d.define("fleet.bucket.broker.base", T.INT, 4, Range.at_least(1), I.LOW,
              "Fleet federation: smallest broker-axis bucket of the shared "
              "geometric shape grid (fleet.bucketing.BucketGrid). Every "

@@ -274,6 +274,23 @@ class CruiseControl:
         # precompute fills, fixes, and futures alike, and per-request
         # exclusion options ride the batched mask assembler.
         self.megabatch_solve_width = 0
+        # Always-hot solver (round 18): the last ACCEPTED (assignment,
+        # leader_slot) seeds the next default-chain solve — under
+        # sustained drift most goals are already satisfied at the
+        # previous target, so rounds-to-convergence collapses. The
+        # quality fallback (_warm_quality_ok) re-solves cold whenever a
+        # warm result falls below the sentry band, so warm starts can
+        # never silently degrade proposals. One store per facade = one
+        # per cluster (the heal-ledger isolation discipline).
+        from .warmstart import WarmSeedStore
+        self._warm_enabled = config.get_boolean("solver.warm.start.enabled")
+        self._warm_band = config.get_double("solver.warm.start.quality.band")
+        self._warm_seeds = WarmSeedStore()
+        # Pending warm context across the precompute seams (set by
+        # precompute_inputs, consumed by store_precomputed on the SAME
+        # worker thread — the megabatch runner's prepare/complete both
+        # run inside one scheduler turn).
+        self._tls_warm = threading.local()
         from .detector.provisioner import BasicProvisioner
         self.provisioner = BasicProvisioner()
 
@@ -381,6 +398,17 @@ class CruiseControl:
         precompute loop off — fleet deployments route precompute through
         the FleetScheduler's pacer instead (one device, many clusters:
         per-facade loops would contend for it unscheduled)."""
+        # Always-hot solver (round 18): point XLA's persistent compile
+        # cache at the configured dir (serving processes get it without
+        # wrapper scripts — idempotent, safest before the first solve
+        # jit), then prewarm the known bucket-shape set in a background
+        # thread so a fresh replica serves its first rebalance in
+        # seconds. Both no-op when their config switches are off; the
+        # prewarm manager is per-optimizer, so fleet clusters sharing
+        # one solver prewarm exactly once.
+        from .warmstart import configure_compile_cache, ensure_prewarm
+        configure_compile_cache(self._config)
+        ensure_prewarm(self._optimizer, self._config)
         self._load_monitor.start_up(block_on_load=block_on_load)
         self._anomaly_detector.start_detection()
         self._started = True
@@ -741,8 +769,16 @@ class CruiseControl:
                 meta.topic_names, ())
             if fast_mode:
                 options = dataclasses.replace(options, fast_mode=True)
-            _final, result = self._optimizer.optimizations(
-                state, meta, chain, options)
+            # Through the shared solve seam (round 18): proposal
+            # computes get warm seeding + the quality fallback, and on a
+            # fleet-wired facade ride the same batched kernels as fixes
+            # (occupancy-1 parity is pinned in test_fleet). Only the
+            # CANONICAL default-chain compute is warm-eligible — custom
+            # chains / weakened models are incomparable solve classes.
+            _final, result = self._optimize(
+                state, meta, chain, options,
+                warm_eligible=goals is None and not use_ready_default_goals
+                and data_from is None and not fast_mode)
             return result
 
         if goals is not None or use_ready_default_goals or fast_mode \
@@ -822,6 +858,7 @@ class CruiseControl:
                                proposals=result.proposals)
 
     def _optimize(self, state, meta, chain, options: OptimizationOptions,
+                  warm_eligible: bool = False,
                   ) -> tuple[Any, OptimizerResult]:
         """The single-cluster solve seam for the goal-chain operations.
         With a fleet-wired ``megabatch_solve_width`` the solve routes
@@ -837,12 +874,36 @@ class CruiseControl:
         must not return different proposals than a standalone one for
         the same cluster state."""
         from .utils.heal_ledger import current_heal
+        from .utils.sensors import SENSORS
         heal = current_heal()
         width = self.megabatch_solve_width
         batched = bool(width and not options.fast_mode
                        and self._optimizer.mesh is None
                        and not self._optimizer.deficit_sizing_active(
                            state.num_brokers))
+        # Warm start (round 18): seed the search from the last accepted
+        # target when one is valid for this model's index space. The
+        # solve still diffs against the TRUE current ``state`` (the
+        # optimizer's initial_state seam), so proposals always encode
+        # moves from reality. ``warm_eligible`` scopes seeding to the
+        # CANONICAL default-chain solve class (proposals/precompute):
+        # broker-scoped operations, custom chains, and per-request
+        # exclusion sets are incomparable solve classes — their results
+        # must neither consume nor become seeds, or the single-slot
+        # store's quality reference cross-contaminates (a drained
+        # remove_brokers result as the gate reference would let a
+        # degraded warm default solve pass; the default reference would
+        # spuriously fail legitimate constrained solves).
+        warm = warm_eligible and self._warm_enabled \
+            and not options.fast_mode
+        warm_seed = None
+        warm_state = state
+        if warm:
+            from .warmstart import apply_seed
+            warm_seed = self._warm_seeds.match(state, meta)
+            if warm_seed is not None:
+                warm_state = apply_seed(state, warm_seed)
+                SENSORS.count("solver_warm_seeded")
         # Heal-correlated solves link the flight recorder's pass ids:
         # the chain's solve_completed phase names the passSeq values that
         # resolve in GET /solver (best-effort window — a concurrent
@@ -854,18 +915,53 @@ class CruiseControl:
             if FLIGHT.enabled:
                 marker = FLIGHT.marker()
             heal.phase("solve_dispatched",
-                       path="megabatch" if batched else "serial")
-        if batched:
-            from .utils.sensors import current_cluster_label
-            cid = current_cluster_label() or "default"
-            out = self._optimizer.optimizations_megabatch(
-                [(state, meta, cid, options)], goals=list(chain),
-                width=width)
-            res = out[0]
-            if isinstance(res, Exception):
-                raise res
+                       path="megabatch" if batched else "serial",
+                       warmStart=warm_seed is not None)
+
+        def run(solve_state, initial):
+            if batched:
+                from .utils.sensors import current_cluster_label
+                cid = current_cluster_label() or "default"
+                out = self._optimizer.optimizations_megabatch(
+                    [(solve_state, meta, cid, options, initial)],
+                    goals=list(chain), width=width)
+                r = out[0]
+                if isinstance(r, Exception):
+                    raise r
+                return r
+            return self._optimizer.optimizations(
+                solve_state, meta, chain, options, initial_state=initial)
+
+        warm_fallback = False
+        if warm_seed is not None:
+            try:
+                res = run(warm_state, state)
+            except Exception:  # noqa: BLE001 — warm failure falls back cold
+                LOG.warning("warm-seeded solve failed; re-solving cold",
+                            exc_info=True)
+                res = None
+            if res is not None and not self._warm_quality_ok(res[1],
+                                                             warm_seed):
+                LOG.info(
+                    "warm-seeded solve below the sentry band "
+                    "(balancedness %.3f vs accepted %.3f, violated %s); "
+                    "re-solving cold", res[1].balancedness_after,
+                    warm_seed.balancedness_after,
+                    res[1].violated_goals_after)
+                res = None
+            if res is None:
+                # The fallback contract: a warm start may cost an extra
+                # solve, but can never degrade what gets served.
+                warm_fallback = True
+                SENSORS.count("solver_warm_fallbacks")
+                self._warm_seeds.clear()
+                res = run(state, None)
         else:
-            res = self._optimizer.optimizations(state, meta, chain, options)
+            res = run(state, None)
+        if warm:
+            self._warm_store(res[0], meta, res[1], seed=warm_seed,
+                             warm_accepted=warm_seed is not None
+                             and not warm_fallback)
         if heal.recording:
             detail: dict = {}
             if marker is not None:
@@ -885,31 +981,106 @@ class CruiseControl:
                 # occupancy 1 (one compiled program per bucket shape
                 # serves fixes and precomputes alike).
                 detail["batchWidth"] = width
+            # Warm-path adoption attrs (round 18): GET /heals can
+            # distinguish warm from cold heals, and the fingerprint
+            # skip's dispatch savings are attributable per chain.
+            detail["warmStart"] = warm_seed is not None
+            if warm_fallback:
+                detail["warmFallback"] = True
+            skipped = self._optimizer.thread_dispatch_stats().get(
+                "goals_skipped", 0)
+            if skipped:
+                detail["goalsSkipped"] = skipped
             heal.phase("solve_completed", **detail)
             heal.phase("proposal_ready", numProposals=len(res[1].proposals))
         return res
 
+    def _warm_quality_ok(self, result, seed) -> bool:
+        """The warm-start sentry band: no violated goal the seed's own
+        accepted solve did not have, and balancedness within
+        ``solver.warm.start.quality.band`` of the seed's (the shared
+        warmstart.warm_quality_ok predicate — bench measures SERVED
+        semantics with the same function)."""
+        from .warmstart import warm_quality_ok
+        return warm_quality_ok(result, seed.balancedness_after,
+                               seed.violated_after, self._warm_band)
+
+    def _warm_store(self, final_state, meta, result, seed=None,
+                    warm_accepted: bool = False) -> None:
+        """Store an accepted solve as the next seed. ``warm_accepted``
+        marks a gate-passing WARM result: its reference is sticky —
+        max(seed reference, own balancedness) with its own (gate-bounded)
+        violated set — so only cold solves re-anchor the gate (see
+        WarmSeedStore.store). ONE implementation for the serial solve
+        and the fleet-precompute write-back, so the never-degrade
+        contract cannot diverge between the two paths."""
+        if warm_accepted and seed is not None:
+            self._warm_seeds.store(final_state, meta, result, reference=(
+                max(seed.balancedness_after, result.balancedness_after),
+                frozenset(result.violated_goals_after)))
+        else:
+            self._warm_seeds.store(final_state, meta, result)
+
     # -- megabatch precompute seams (fleet.megabatch) ----------------------
     def precompute_inputs(self):
-        """(chain, state, meta, options, generation) for a DEFAULT-chain
-        cached-proposal computation — the megabatch runner's model-build
-        seam. Mirrors ``proposals()``'s compute preamble exactly (same
-        chain resolution, model requirements, and options generator), so
-        a batched precompute stores a cache entry indistinguishable from
-        a solo one. The generation is read BEFORE the build, like the
-        serial path, so a mid-build metadata bump invalidates the entry
-        rather than mislabeling it."""
+        """(chain, state, meta, options, generation, initial_state) for a
+        DEFAULT-chain cached-proposal computation — the megabatch
+        runner's model-build seam. Mirrors ``proposals()``'s compute
+        preamble exactly (same chain resolution, model requirements, and
+        options generator), so a batched precompute stores a cache entry
+        indistinguishable from a solo one. The generation is read BEFORE
+        the build, like the serial path, so a mid-build metadata bump
+        invalidates the entry rather than mislabeling it.
+
+        Warm starts (round 18): with a valid seed, ``state`` is the
+        warm-seeded search start and ``initial_state`` the TRUE current
+        model the batched solve must diff against; the pending seed is
+        held for ``store_precomputed``'s quality gate on the same worker
+        thread. ``initial_state`` is None on cold computes."""
         gen = self._load_monitor.model_generation
         chain, state, meta = self._chain_and_model(None, False, None, True)
         options = self._options_generator.for_cached_proposal_calculation(
             meta.topic_names, ())
-        return chain, state, meta, options, gen
+        initial = None
+        self._tls_warm.ctx = None
+        if self._warm_enabled:
+            from .utils.sensors import SENSORS
+            from .warmstart import apply_seed
+            seed = self._warm_seeds.match(state, meta)
+            self._tls_warm.ctx = (seed, state, meta, chain, options)
+            if seed is not None:
+                SENSORS.count("solver_warm_seeded")
+                initial = state
+                state = apply_seed(state, seed)
+        return chain, state, meta, options, gen, initial
 
-    def store_precomputed(self, generation: int, result) -> None:
+    def store_precomputed(self, generation: int, result,
+                          final_state=None) -> None:
         """Write an externally computed default-chain OptimizerResult
         into the proposal cache (the megabatch runner's write-back seam —
         the batched twin of the cache store at the end of
-        ``proposals()``)."""
+        ``proposals()``). A warm-seeded precompute that falls below the
+        sentry band is NOT stored: the seed is dropped, the fallback
+        counted, and the cluster re-solved cold inline (on the runner's
+        worker thread) — the same never-degrade contract as the serial
+        warm path."""
+        ctx = getattr(self._tls_warm, "ctx", None)
+        self._tls_warm.ctx = None
+        if ctx is not None:
+            seed, initial, meta, chain, options = ctx
+            warm_ok = seed is not None
+            if seed is not None and not self._warm_quality_ok(result, seed):
+                from .utils.sensors import SENSORS
+                warm_ok = False
+                SENSORS.count("solver_warm_fallbacks")
+                self._warm_seeds.clear()
+                LOG.info("warm-seeded precompute below the sentry band; "
+                         "re-solving cold")
+                final_state, result = self._optimizer.optimizations(
+                    initial, meta, chain, options)
+            if final_state is not None:
+                self._warm_store(final_state, meta, result, seed=seed,
+                                 warm_accepted=warm_ok)
         with self._proposal_lock:
             self._proposal_cache = (generation, time.time(), result)
 
@@ -1437,6 +1608,14 @@ class CruiseControl:
                 "balancednessScore":
                     self.goal_violation_detector.balancedness_score,
             }
+            # Prewarm progress (round 18): how far the background
+            # known-shape compile sweep has come — the signal a fresh
+            # replica's readiness probe should watch before admitting
+            # solver traffic. Absent when prewarm is disabled.
+            from .warmstart import prewarm_status
+            pw = prewarm_status(self._optimizer)
+            if pw is not None:
+                out["AnalyzerState"]["prewarm"] = pw
         if "anomaly_detector" in want:
             out["AnomalyDetectorState"] = self._anomaly_detector.state()
         return out

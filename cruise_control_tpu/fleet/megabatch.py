@@ -84,6 +84,10 @@ class SolveItem:
     state: Any
     meta: Any
     options: Any = None
+    # Round 18 warm starts: the TRUE current model when ``state`` is a
+    # warm-seeded search start (the batched solve diffs against it);
+    # None = state IS the initial.
+    initial_state: Any = None
 
 
 @dataclasses.dataclass
@@ -96,12 +100,17 @@ class PrecomputePayload:
     cc: Any  # CruiseControl
 
     def prepare(self, optimizer) -> list[SolveItem]:
-        chain, state, meta, options, gen = self.cc.precompute_inputs()
+        out = self.cc.precompute_inputs()
+        chain, state, meta, options, gen = out[:5]
+        # 6th element (round 18): the true initial when the facade
+        # warm-seeded the search start (older/stub facades return 5).
+        initial = out[5] if len(out) > 5 else None
         self._generation = gen
         return [SolveItem(
             item_id=self.cluster_id,
             chain=tuple(optimizer.megabatch_chain(meta, chain)),
-            state=state, meta=meta, options=options)]
+            state=state, meta=meta, options=options,
+            initial_state=initial)]
 
     def complete(self, outcomes: list, stats: list):
         from ..facade import OperationResult
@@ -110,7 +119,8 @@ class PrecomputePayload:
         if isinstance(res, Exception):
             raise res
         _final, result = res
-        self.cc.store_precomputed(self._generation, result)
+        self.cc.store_precomputed(self._generation, result,
+                                  final_state=_final)
         # Per-cluster dispatch accounting, split out of the batched
         # readback — the megabatch analogue of the pacer's thread-local
         # attribution (the batched solve ran on THIS worker thread, so
@@ -230,7 +240,8 @@ class MegabatchRunner:
                      members: list[tuple]) -> None:
         from ..utils.sensors import SENSORS
         chain = members[0][2].chain
-        items = [(item.state, item.meta, item.item_id, item.options)
+        items = [(item.state, item.meta, item.item_id, item.options,
+                  item.initial_state)
                  for (_p, _s, item) in members]
         try:
             results = self._optimizer.optimizations_megabatch(

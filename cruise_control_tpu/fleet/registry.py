@@ -369,6 +369,14 @@ class FleetRegistry:
             }
         if self._megabatch is not None:
             body["megabatch"] = self._megabatch.stats()
+        # Prewarm progress of the SHARED solver (round 18): the fleet's
+        # clusters compile once per bucket shape, so one sweep covers
+        # them all — horizontal-scaling replicas watch this before
+        # taking solver traffic. Absent when prewarm is disabled.
+        from ..warmstart import prewarm_status
+        pw = prewarm_status(self._optimizer)
+        if pw is not None:
+            body["prewarm"] = pw
         return body
 
     def shutdown(self) -> None:

@@ -423,6 +423,19 @@ class FleetScheduler:
         precompute already queued. Returns the number enqueued."""
         if self._registry is None:
             return 0
+        # Prewarm deferral (round 18): while the shared solver's
+        # background shape sweep is still compiling, hold paced
+        # precomputes back one sweep — racing them would compile the
+        # same per-shape programs twice on the startup critical path.
+        # Due clusters enqueue on the first sweep after prewarm settles
+        # (last_precompute is untouched here).
+        from ..warmstart import prewarm_manager
+        optimizer = getattr(self._registry, "optimizer", None)
+        mgr = prewarm_manager(optimizer) if optimizer is not None else None
+        if mgr is not None and mgr.running:
+            from ..utils.sensors import SENSORS
+            SENSORS.count("fleet_pacer_prewarm_deferrals")
+            return 0
         n = 0
         for entry in self._registry.entries():
             if entry.paused:

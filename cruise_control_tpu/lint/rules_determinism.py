@@ -44,6 +44,11 @@ class DeterminismRule(Rule):
         # the twin's cross-validation (ledger durations must equal
         # ScenarioScore time-to-heal on the sim clock).
         "cruise_control_tpu/utils/heal_ledger.py",
+        # Always-hot solver (round 18): warm seeds feed SOLVER INPUTS —
+        # seeding/validity/fallback must be pure functions of model
+        # state (no age-based staleness); the prewarm manager times
+        # itself through the injectable ``monotonic`` seam only.
+        "cruise_control_tpu/warmstart.py",
     )
 
     CLOCK_CALLS = ("time.time", "time.time_ns", "time.monotonic",

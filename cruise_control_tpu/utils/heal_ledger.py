@@ -43,9 +43,11 @@ family):
   score's time-to-heal ticks exactly (tests/test_heal_ledger.py).
 
 Served as ``GET /kafkacruisecontrol/heals`` (VIEWER) and exported as
-``heal_phase_seconds{phase=}`` / ``time_to_heal_seconds{type=}``
-histograms, the ``heals_open{type=}`` gauge, and the per-type
-``self_healing_started_total{type=}`` counter (detector/manager.py).
+``heal_phase_seconds{phase=}`` / ``time_to_heal_seconds{type=,warm=}``
+histograms (``warm`` slices heal latency by warm-path adoption — the
+round-18 always-hot campaign's ruler), the ``heals_open{type=}`` gauge,
+and the per-type ``self_healing_started_total{type=}`` counter
+(detector/manager.py).
 """
 
 from __future__ import annotations
@@ -368,11 +370,20 @@ class HealLedger:
             self.chains_resolved += 1
             a_type = chain.anomaly_type
             dur = chain.heal_seconds()
+            # Warm-path adoption slicing (round 18): a chain whose solve
+            # was warm-seeded heals on the warm path — the attr the
+            # facade stamped on its solve_dispatched phase. Lets
+            # time_to_heal_seconds be sliced by warm adoption (the ruler
+            # the always-hot campaign is scored against).
+            warm = any(p.get("warmStart") for p in chain.phases
+                       if p["phase"] == "solve_dispatched")
         SENSORS.count("heal_chains_resolved",
                       labels={"type": a_type, "outcome": outcome})
         if outcome == "cleared":
             SENSORS.observe("time_to_heal_seconds", dur,
-                            labels={"type": a_type}, buckets=HEAL_BUCKETS)
+                            labels={"type": a_type,
+                                    "warm": "true" if warm else "false"},
+                            buckets=HEAL_BUCKETS)
         self._emit_open_gauges()
 
     def _emit_open_gauges(self) -> None:

@@ -1,0 +1,42 @@
+"""CCSA004 + CCSA007 fixture: a warmstart-shaped module with an
+age-stamped seed (wall-clock leak into solver-input state) and an
+unlocked module-level prewarm-manager registry (tests lint this file
+under the spoofed cruise_control_tpu/warmstart.py path — the round-18
+warm path feeds SOLVER INPUTS and sits under the deterministic-module
+contract; the prewarm registry is module-level shared state and must
+mutate under its lock)."""
+
+import threading
+import time
+
+_MANAGERS: dict = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def bad_seed_stamp() -> float:
+    return time.monotonic()              # finding: wall clock inline
+
+
+def injected_stamp(monotonic=time.monotonic) -> float:
+    return monotonic()                   # clean: reference is the seam
+
+
+def bad_register(opt, mgr) -> None:
+    _MANAGERS[id(opt)] = mgr             # finding: unlocked registry write
+
+
+def good_register(opt, mgr) -> None:
+    with _REGISTRY_LOCK:
+        _MANAGERS[id(opt)] = mgr         # clean: lock-guarded
+
+
+def tolerated_register(opt, mgr) -> None:
+    # ccsa: ok[CCSA007] fixture: import-time-only single writer by
+    # documented contract
+    _MANAGERS[id(opt)] = mgr
+
+
+def timed_sweep() -> float:
+    # ccsa: ok[CCSA004] fixture: observability-only duration, never
+    # enters seed validity or solver inputs
+    return time.perf_counter()

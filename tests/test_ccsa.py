@@ -196,6 +196,30 @@ def test_ccsa_covers_heal_ledger_module():
         assert not real_active, [f.message for f in real_active]
 
 
+def test_ccsa_covers_warmstart_module():
+    """The round-18 warmstart module is a deterministic module (CCSA004:
+    seed validity/fallback are pure functions of model state; the
+    prewarm manager's duration rides the injectable monotonic seam) and
+    its module-level prewarm-manager registry must mutate under
+    _REGISTRY_LOCK (CCSA007) — fixture true-positive + suppressed pairs
+    under the spoofed path, and the REAL module verifies clean."""
+    spoofed = ctx_for(FIXTURES / "bad_warmstart.py",
+                      "cruise_control_tpu/warmstart.py")
+    active, suppressed = findings_of("CCSA004", spoofed)
+    assert len(active) == 1           # inline time.monotonic()
+    assert len(suppressed) == 1       # documented perf_counter sweep
+    assert "time.monotonic" in active[0].message
+    lock_active, lock_suppressed = findings_of("CCSA007", spoofed)
+    assert len(lock_active) == 1      # unlocked _MANAGERS write
+    assert len(lock_suppressed) == 1  # documented single-writer write
+    assert "_MANAGERS" in lock_active[0].message
+    rel = "cruise_control_tpu/warmstart.py"
+    real = ctx_for(ROOT / rel, rel)
+    for rule in ("CCSA004", "CCSA007"):
+        real_active, _sup = findings_of(rule, real)
+        assert not real_active, [f.message for f in real_active]
+
+
 def test_ccsa004_hash_ban_is_repo_wide_but_clock_is_not():
     plain = ctx_for(FIXTURES / "bad_determinism.py")
     active, suppressed = findings_of("CCSA004", plain)
