@@ -377,6 +377,38 @@ class GoalOptimizer:
                             moves_per_round=moves,
                             max_rounds=self._max_rounds)
 
+    # -- entry snapshots (round 19: forecast scoring + warm pre-check) -----
+    def goal_entry_stats(self, state: ClusterTensors, meta: ClusterMeta,
+                         goals: Sequence[Goal] | None = None,
+                         options: OptimizationOptions | None = None,
+                         ) -> tuple[list[Goal], np.ndarray, np.ndarray, int]:
+        """Every goal's entry (violation, objective) plus the offline
+        count on ``state`` in ONE batched device program — the round-18
+        ``chain_all_goal_stats`` snapshot as a public seam. Two callers:
+        the predictive detector scores the forecaster's PROJECTED model
+        through it, and the facade's warm-band pre-check scores the warm
+        seed against the drifted loads before committing to the full
+        chain. Returns (resolved chain, [G] violations, [G] objectives,
+        offline replicas)."""
+        options = options or OptimizationOptions()
+        chain = list(goals) if goals is not None \
+            else goals_by_priority(self._config)
+        chain = self._resolve_broker_sets(chain, meta)
+        masks = self._masks(state, meta, options)
+        from .chain import chain_all_goal_stats
+        av, ao, aoff = chain_all_goal_stats(
+            state, tuple(chain), self._constraint, meta.num_topics, masks)
+        return chain, np.asarray(av), np.asarray(ao), int(aoff)
+
+    def balancedness_of(self, chain: Sequence[Goal],
+                        violated: "set[str] | Sequence[str]") -> float:
+        """The 0..100 balancedness score of a violated-goal set under
+        this optimizer's configured weights (the same formula the
+        detector and OptimizerResult use)."""
+        return balancedness_score(list(chain), set(violated),
+                                  self._priority_weight,
+                                  self._strictness_weight)
+
     def _masks(self, state: ClusterTensors, meta: ClusterMeta,
                options: OptimizationOptions) -> ExclusionMasks:
         topic_mask = None

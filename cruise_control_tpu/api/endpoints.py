@@ -81,6 +81,14 @@ class EndPoint(enum.Enum):
     # per-phase durations. ``?cluster=`` routes to that cluster's
     # facade ledger; ``?anomaly_type=`` / ``?entries=`` filter.
     HEALS = (28, "GET", Role.VIEWER)
+    # Predictive rebalancing (round 19, no reference analogue — the
+    # reference is purely reactive): the facade's forecast engine state —
+    # per-broker current-vs-projected loads with the confidence band,
+    # horizon/fit geometry, and the predictive detector's lifecycle
+    # counters (predictions made / confirmed / missed, hit rate).
+    # ``?refresh=true`` fits a fresh forecast inline (explicit opt-in:
+    # it is device work); the default serves the last cached fit.
+    FORECAST = (29, "GET", Role.VIEWER)
 
     @property
     def method(self) -> str:

@@ -187,6 +187,11 @@ SCHEMAS: dict[EndPoint, dict[str, Callable[[str], Any]]] = {
     EndPoint.COMPARE_FUTURES: {"templates": _csv, "num_futures": _int,
                                "seed": _int, "ticks": _int,
                                "include_present": _bool},
+    # Predictive rebalancing (forecast/engine.py): refresh=true fits a
+    # fresh forecast inline (device work, explicit opt-in); default
+    # serves the engine's last cached projection. cluster (in _COMMON)
+    # ROUTES to that cluster's facade engine.
+    EndPoint.FORECAST: {"refresh": _bool},
 }
 
 

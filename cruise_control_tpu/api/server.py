@@ -17,6 +17,7 @@ import threading
 import time
 import urllib.parse
 from concurrent.futures import TimeoutError as FuturesTimeoutError
+from functools import lru_cache
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
@@ -50,6 +51,7 @@ _SYNC_ENDPOINTS = {
     EndPoint.PAUSE_SAMPLING, EndPoint.RESUME_SAMPLING,
     EndPoint.STOP_PROPOSAL_EXECUTION, EndPoint.ADMIN, EndPoint.BOOTSTRAP,
     EndPoint.TRAIN, EndPoint.RIGHTSIZE, EndPoint.FLEET, EndPoint.HEALS,
+    EndPoint.FORECAST,
 }
 
 # Endpoints that consume solver time. In fleet mode these (a) are refused
@@ -526,7 +528,23 @@ class CruiseControlApi:
         # cluster label must be re-established INSIDE the work callable:
         # ContextVars do not cross into the user-task thread pool, so the
         # handle()-level context alone would label nothing async.
-        work = self._async_work(endpoint, p, cc)
+        # COMPARE_FUTURES validation runs ONCE here — a template typo
+        # 400s before a user task is ever created — but the live-seed
+        # MODEL BUILD is deferred into a lazy once-supplier shared by
+        # the work closure AND the fleet-coalesced payload path:
+        # _dispatch runs on the HTTP handler thread on EVERY request,
+        # including each poll of an in-flight task, and must not pay a
+        # cluster-model build the task dedup would discard.
+        futures_req = futures_live = None
+        if endpoint is EndPoint.COMPARE_FUTURES:
+            futures_req = self._futures_request(cc, p)
+
+            @lru_cache(maxsize=1)
+            def futures_live():
+                from ..futures.evaluator import live_seed_from
+                return live_seed_from(cc)
+        work = self._async_work(endpoint, p, cc, futures_req=futures_req,
+                                futures_live=futures_live)
         if cluster_id is not None:
             inner_work = work
 
@@ -535,7 +553,9 @@ class CruiseControlApi:
                 with cluster_label(cid):
                     return inner()
 
-        work = self._schedule_fleet_work(endpoint, cluster_id, work, cc, p)
+        work = self._schedule_fleet_work(endpoint, cluster_id, work, cc, p,
+                                         futures_req=futures_req,
+                                         futures_live=futures_live)
         info = self._tasks.get_or_create_task(
             endpoint.name, query_string, work,
             task_id=headers.get(USER_TASK_HEADER), client=principal.name)
@@ -564,7 +584,9 @@ class CruiseControlApi:
     def _schedule_fleet_work(self, endpoint: EndPoint,
                              cluster_id: str | None, work,
                              cc: CruiseControl | None = None,
-                             p: dict | None = None):
+                             p: dict | None = None,
+                             futures_req: dict | None = None,
+                             futures_live=None):
         """Wrap a fleet-routed solver work callable so it runs as an
         ON_DEMAND FleetScheduler job: the user-task thread submits and
         blocks on the future (202-poll behavior unchanged), while the
@@ -610,14 +632,23 @@ class CruiseControlApi:
                     self._precompute_key_for(cluster_id)
             except Exception:  # noqa: BLE001 — hint only; run solo
                 batch_key = None
-            if batch_key is not None:
+            if batch_key is not None and futures_req is not None:
                 from ..futures.evaluator import FuturesPayload
-                req = self._futures_request(cc, p)
+                req = futures_req
                 payload = FuturesPayload(
                     cluster_id, req["templates"], req["num_futures"],
                     req["seed"], req["ticks"],
                     include_present=req["include_present"],
-                    wrap=responses.envelope)
+                    wrap=responses.envelope,
+                    # _dispatch's lazy once-supplier: the live seed
+                    # builds at most ONE cluster model per request, on
+                    # the worker thread, shared with the solo work path.
+                    live_supplier=futures_live)
+            if payload is None:
+                # No payload to drain under the key: submit as a plain
+                # solo job rather than a batch-keyed job with nothing
+                # coalescible behind it.
+                batch_key = None
 
         def scheduled():
             from concurrent.futures import CancelledError
@@ -647,11 +678,33 @@ class CruiseControlApi:
         from ..futures.generator import FUTURE_TEMPLATES
         cfg = cc.config
         templates = [t for t in p.get("templates", ()) if t]
+        live_templates = []
         for t in templates:
             if t not in FUTURE_TEMPLATES:
                 raise ParameterParseError(
                     f"unknown futures template {t!r}; expected one of "
                     f"{', '.join(sorted(FUTURE_TEMPLATES))}")
+            if FUTURE_TEMPLATES[t].requires_live:
+                live_templates.append(t)
+        if live_templates:
+            # Validated ONCE outside the template loop. Only CHEAP
+            # checks run here — this executes on the HTTP handler
+            # thread for every request, including task polls; the
+            # cluster-model build itself is deferred to _dispatch's
+            # lazy once-supplier on the worker thread.
+            t = live_templates[0]
+            if not cfg.get_boolean("futures.live.seed.enabled"):
+                raise ParameterParseError(
+                    f"template {t!r} requires the live-cluster seam "
+                    "(futures.live.seed.enabled=true)")
+            if not cc.load_monitor.window_times():
+                # Eager 400 with the REAL cause for the common case
+                # (no stable windows yet — probe is a list read, no
+                # model build); a build failure past this probe still
+                # surfaces as the worker path's 400/503.
+                raise ParameterParseError(
+                    f"template {t!r} requires the live cluster model, "
+                    "which is not ready yet (monitor still warming)")
         n = p.get("num_futures", cfg.get_int("futures.default.count"))
         n = max(1, min(int(n), cfg.get_int("futures.max.count")))
         ticks = p.get("ticks", cfg.get_int("futures.default.ticks"))
@@ -685,6 +738,40 @@ class CruiseControlApi:
                 "healsOpen": ledger.open_count(),
                 "meanTimeToStartFixMs": ledger.mean_time_to_start_fix_ms(),
                 "chains": chains})
+        if endpoint is EndPoint.FORECAST:
+            # GET /forecast: the routed facade's forecast engine —
+            # per-broker current-vs-projected loads, horizon geometry,
+            # and the predictive detector's hit-rate counters.
+            refresh = bool(p.get("refresh", False))
+
+            def _forecast_work():
+                return responses.envelope(
+                    cc.forecast_state(refresh=refresh))
+
+            if refresh and self._fleet is not None:
+                # refresh=true runs the jitted fit — device work, maybe
+                # a first-shape compile. In fleet mode it shares the
+                # device under the scheduler like every other
+                # solver-time request instead of contending from the
+                # HTTP handler thread mid-solve (the _SOLVER_ENDPOINTS
+                # discipline; the cached read stays inline).
+                sched = self._fleet.scheduler
+                cid = self._fleet.cluster_id_of(cc)
+                if sched is not None and sched.running \
+                        and cid is not None:
+                    from concurrent.futures import CancelledError
+
+                    from ..fleet.scheduler import JobKind
+                    try:
+                        return sched.submit(
+                            cid, JobKind.ON_DEMAND,
+                            _forecast_work).result()
+                    except CancelledError:
+                        raise ApiError(
+                            503, "fleet scheduler shut down before the "
+                            "forecast refresh could run; retry once the "
+                            "fleet is back up")
+            return _forecast_work()
         if endpoint is EndPoint.STATE:
             return responses.envelope(cc.state(
                 p.get("substates", ()),
@@ -859,6 +946,17 @@ class CruiseControlApi:
                     f"what_if={name!r}; expected "
                     "random:<template>[:<seed>] with a template from: "
                     f"{', '.join(sorted(FUTURE_TEMPLATES))}")
+            if FUTURE_TEMPLATES[template].requires_live:
+                # A requires_live template's standalone spec is a bare
+                # renamed BASE_SPEC (its content lives in the
+                # evaluator's live seam): replaying it here would serve
+                # a meaningless synthetic trajectory under the
+                # template's name. COMPARE_FUTURES is the surface that
+                # answers it — same 400 discipline as there.
+                raise ParameterParseError(
+                    f"template {template!r} requires the live-cluster "
+                    "seam and has no standalone replay; request it via "
+                    "COMPARE_FUTURES (templates parameter) instead")
             try:
                 gen_seed = int(parts[2]) if len(parts) == 3 else 0
             except ValueError:
@@ -911,7 +1009,9 @@ class CruiseControlApi:
                 "ignore this sanity check.")
 
     def _async_work(self, endpoint: EndPoint, p: dict,
-                    cc: CruiseControl | None = None):
+                    cc: CruiseControl | None = None,
+                    futures_req: dict | None = None,
+                    futures_live=None):
         cc = cc or self._cc
         dryrun = p.get("dryrun", True)
         goals = _resolve_goal_names(p)
@@ -1004,16 +1104,16 @@ class CruiseControlApi:
         data_from = p.get("data_from")
         allow_cap = p.get("allow_capacity_estimation", True)
 
-        # Validated EAGERLY (not inside the work closure) so a template
-        # typo 400s the request before a user task is ever created.
-        futures_req = self._futures_request(cc, p) \
-            if endpoint is EndPoint.COMPARE_FUTURES else None
+        # futures_req arrives pre-validated from _dispatch; the live
+        # seed builds here on the WORKER thread via _dispatch's lazy
+        # once-supplier (shared with the fleet payload path).
 
         def compare_futures():
             from ..futures.evaluator import compare_futures as _compare
             body = _compare(
                 optimizer=cc.optimizer,
                 width=cc.config.get_int("futures.batch.width"),
+                live=futures_live() if futures_live is not None else None,
                 **futures_req)
             return responses.envelope(body)
 

@@ -450,6 +450,74 @@ def _definition() -> ConfigDef:
              "request path. Requires solver.compile.cache.enabled; "
              "progress on GET /state and /fleet, compiles watched by "
              "xla_compile_cache_{hits,misses}.")
+    d.define("solver.warm.start.precheck.enabled", T.BOOLEAN, True, None,
+             I.LOW,
+             "Warm-band pre-check (round 19, ROADMAP 3a tail): before "
+             "committing to a full warm chain, score the seed against "
+             "the CURRENT loads in one batched goal-stats program and "
+             "skip the warm attempt when the seed's entry picture "
+             "already breaches the sentry band (a violated goal its "
+             "accepted solve did not have) — the measured drift case "
+             "where warm pays attempt+fallback for the cold answer. "
+             "Skips counted in solver_warm_precheck_skips. The skip "
+             "path serves exactly the fallback's cold solve; a "
+             "band-worse seed the full chain COULD have repaired back "
+             "into the band is served cold instead — a forfeited warm "
+             "win, never degraded quality.")
+    d.define("forecast.enabled", T.BOOLEAN, False, None, I.MEDIUM,
+             "Predictive rebalancing (round 19): fit a seasonal-trend "
+             "forecaster over the monitor's windowed per-partition "
+             "history in ONE batched jitted program, project each "
+             "resource load forecast.horizon.windows ahead, and let the "
+             "PredictiveViolationDetector raise PREDICTED_GOAL_VIOLATION "
+             "anomalies whose fix PRECOMPUTES the proposal (never "
+             "executes; see anomaly.detection.predictive.fix.enabled). "
+             "OFF by default: off means off — the engine and detector "
+             "cost one config read per tick and serving behavior is "
+             "byte-identical (forecast_noop_overhead guards it).")
+    d.define("forecast.fit.windows", T.INT, 16, Range.at_least(4), I.LOW,
+             "Exactly how many of the monitor's most recent stable "
+             "windows the forecaster fits (fixed so ONE program "
+             "compiles per shape instead of one per history length); "
+             "fewer available windows = forecast not ready "
+             "(forecast_skipped_not_ready).")
+    d.define("forecast.horizon.windows", T.INT, 6, Range.at_least(1), I.LOW,
+             "How many windows past the last observation the forecaster "
+             "projects. The violation-scoring view takes the per-cell "
+             "PEAK over the horizon, so one goal-stats program answers "
+             "'does any window within the horizon violate?'.")
+    d.define("forecast.seasonal.period.windows", T.INT, 0,
+             Range.at_least(0), I.LOW,
+             "Seasonal period (windows) added to the fit basis as a "
+             "sin/cos pair — set to the diurnal period in window units "
+             "for daily load shapes; 0 = trend-only fit.")
+    d.define("forecast.confidence.z", T.DOUBLE, 2.0, Range.at_least(0.0),
+             I.LOW,
+             "Confidence-band width in residual-RMS units reported with "
+             "each projection (GET /forecast bandMax; detection scores "
+             "the mean projection — documented in DESIGN.md).")
+    d.define("anomaly.detection.predictive.fix.enabled", T.BOOLEAN, False,
+             None, I.MEDIUM,
+             "Opt-in PROACTIVE execution for predicted violations: when "
+             "true, a PREDICTED_GOAL_VIOLATION fix runs a real "
+             "self-healing rebalance BEFORE the violation materializes. "
+             "Default false: the fix only precomputes (projected-model "
+             "dry-run solve + warm-seed store + fleet pacer promotion) "
+             "so the proposal is hot when the real violation lands.")
+    d.define("self.healing.predicted.violation.enabled", T.BOOLEAN, True,
+             None, I.LOW,
+             "Per-type self-healing switch for PREDICTED_GOAL_VIOLATION "
+             "anomalies (the notifier's FIX verdict gate). The fix is a "
+             "dry-run precompute unless "
+             "anomaly.detection.predictive.fix.enabled is also true, so "
+             "the default-on only spends solver time, never moves.")
+    d.define("futures.live.seed.enabled", T.BOOLEAN, True, None, I.LOW,
+             "Futures engine (ROADMAP 5b tail): seed COMPARE_FUTURES "
+             "twins from the LIVE cluster's geometry (brokers, racks, "
+             "topics, RF) instead of the synthetic BASE_SPEC, and let "
+             "the forecast_horizon template solve the REAL projected "
+             "loads — candidate futures become futures of THIS cluster. "
+             "Falls back to BASE_SPEC when the model is not ready.")
     d.define("fleet.bucket.broker.base", T.INT, 4, Range.at_least(1), I.LOW,
              "Fleet federation: smallest broker-axis bucket of the shared "
              "geometric shape grid (fleet.bucketing.BucketGrid). Every "
@@ -1084,7 +1152,7 @@ def _definition() -> ConfigDef:
                "pause.sampling", "resume.sampling", "demote.broker", "admin",
                "review", "topic.configuration", "rightsize", "remove.disks",
                "fleet", "trace", "solver", "profile", "compare.futures",
-               "heals"):
+               "heals", "forecast"):
         d.define(f"{ep}.parameters.class", T.CLASS, None, None, I.LOW,
                  f"Parameter-parsing plugin for the {ep} endpoint "
                  "(callable(query) -> params dict).")

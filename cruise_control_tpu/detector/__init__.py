@@ -7,7 +7,8 @@ over the broker aggregator's window tensor.
 
 from .anomaly import (
     Anomaly, AnomalyType, BrokerFailures, DiskFailures, GoalViolations,
-    MaintenanceEvent, MaintenanceEventType, MetricAnomaly, TopicAnomaly,
+    MaintenanceEvent, MaintenanceEventType, MetricAnomaly,
+    PredictedGoalViolations, TopicAnomaly,
 )
 from .broker_failure import BrokerFailureDetector
 from .disk_failure import DiskFailureDetector
@@ -20,6 +21,7 @@ from .manager import AnomalyDetectorManager, AnomalyStatus
 from .metric_anomaly import (
     MetricAnomalyDetector, PercentileMetricAnomalyFinder, SlowBrokerFinder,
 )
+from .predictive import PredictiveViolationDetector
 from .notifier import (
     AlertaSelfHealingNotifier, AnomalyNotificationAction,
     AnomalyNotificationResult, AnomalyNotifier, MSTeamsSelfHealingNotifier,
@@ -37,7 +39,8 @@ from .topic_anomaly import (
 __all__ = [
     "Anomaly", "AnomalyType", "BrokerFailures", "DiskFailures",
     "GoalViolations", "MaintenanceEvent", "MaintenanceEventType",
-    "MetricAnomaly", "TopicAnomaly", "BrokerFailureDetector",
+    "MetricAnomaly", "PredictedGoalViolations", "TopicAnomaly",
+    "PredictiveViolationDetector", "BrokerFailureDetector",
     "DiskFailureDetector", "GoalViolationDetector",
     "FileMaintenanceEventReader", "IdempotenceCache",
     "InMemoryMaintenanceEventReader", "MaintenanceEventDetector",

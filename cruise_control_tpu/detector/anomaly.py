@@ -31,6 +31,11 @@ class AnomalyType(enum.Enum):
     GOAL_VIOLATION = 3
     TOPIC_ANOMALY = 4
     MAINTENANCE_EVENT = 5
+    # Predictive rebalancing (round 19, no reference analogue — the
+    # reference is purely reactive): a goal violation the forecaster
+    # PROJECTS within the horizon. Lowest priority: a prediction must
+    # never preempt a real anomaly in the fix queue.
+    PREDICTED_GOAL_VIOLATION = 6
 
     @property
     def priority(self) -> int:
@@ -75,6 +80,8 @@ class Anomaly:
             AnomalyType.GOAL_VIOLATION: "self.healing.goal.violation.enabled",
             AnomalyType.TOPIC_ANOMALY: "self.healing.topic.anomaly.enabled",
             AnomalyType.MAINTENANCE_EVENT: "self.healing.maintenance.event.enabled",
+            AnomalyType.PREDICTED_GOAL_VIOLATION:
+                "self.healing.predicted.violation.enabled",
         }[self.anomaly_type]
 
     def __lt__(self, other: "Anomaly") -> bool:
@@ -233,6 +240,49 @@ class TopicAnomaly(Anomaly):
                 skip_rack_awareness_check=skip,
                 reason="self-healing topic replication factor")
         return True
+
+
+@dataclass
+class PredictedGoalViolations(Anomaly):
+    """Round 19 (no reference analogue): goal violations the forecaster
+    PROJECTS ``horizon_s`` seconds ahead — a first-class anomaly whose
+    heal-ledger chain carries ``predicted=true``. The fix NEVER
+    auto-executes by default: it precomputes the proposal on the
+    PROJECTED model (warming the facade's warm-seed store and flagging
+    the fleet pacer for an immediate cache fill) so the answer is hot
+    the moment the real violation lands. The opt-in
+    ``anomaly.detection.predictive.fix.enabled`` gate turns the fix
+    into a real proactive rebalance."""
+
+    predicted_goals: list[str] = field(default_factory=list)
+    horizon_s: float = 0.0
+    confidence_band: float = 0.0   # max residual-RMS band of the fit
+
+    def __post_init__(self):
+        self.anomaly_type = AnomalyType.PREDICTED_GOAL_VIOLATION
+
+    def reasons(self) -> list[str]:
+        return [f"predicted goal violation in {self.horizon_s:.0f}s: {g}"
+                for g in self.predicted_goals]
+
+    def fix(self, facade: Any) -> bool:
+        if not self.predicted_goals:
+            return False
+        cfg = getattr(facade, "config", None)
+        if not hasattr(cfg, "get_boolean"):  # test doubles without config
+            cfg = None
+        fix_fn = getattr(facade, "fix_predicted_violation", None)
+        if fix_fn is None:
+            return False
+        # The fix always solves the PROJECTED model (a current-model
+        # rebalance would see nothing wrong yet); the opt-in gate only
+        # decides whether those proposals EXECUTE or precompute.
+        execute = bool(cfg is not None and cfg.get_boolean(
+            "anomaly.detection.predictive.fix.enabled"))
+        return fix_fn(
+            execute=execute,
+            reason=f"proactive predicted violation {self.predicted_goals}",
+            anomaly_id=self.anomaly_id)
 
 
 class MaintenanceEventType(enum.Enum):

@@ -123,6 +123,12 @@ class AnomalyDetectorManager:
         if disks:
             return tuple(sorted((b, tuple(sorted(d)))
                                 for b, d in disks.items()))
+        predicted = getattr(anomaly, "predicted_goals", None)
+        if predicted:
+            # A standing prediction re-reported each interval is ONE
+            # incident (type differs from GOAL_VIOLATION, so a predicted
+            # and a real chain over the same goals never alias).
+            return tuple(sorted(predicted))
         fixable = getattr(anomaly, "fixable_goals", None)
         unfixable = getattr(anomaly, "unfixable_goals", None)
         if fixable is not None or unfixable is not None:

@@ -1,0 +1,231 @@
+"""Predictive goal-violation detector (round 19).
+
+The inverse of ``GoalViolationDetector``: instead of replaying the
+detection goals on the CURRENT model, run the forecaster's PROJECTED
+model — the horizon-peak load planes from ``forecast/engine.py`` —
+through the same ONE batched goal-stats program the fingerprint skip
+uses (``GoalOptimizer.goal_entry_stats`` → ``chain_all_goal_stats``,
+round 18's entry snapshot), and report goals that are clean NOW but
+violated AT THE HORIZON as first-class ``PredictedGoalViolations``
+anomalies.
+
+Lifecycle honesty (the hit-rate ledger):
+
+- A standing prediction re-reported each interval aliases onto ONE heal
+  chain (the manager's signature dedup), stamped ``predicted=true``.
+- When the real violation lands within the horizon, the prediction is
+  CONFIRMED: its chain resolves ``cleared`` (via=prediction_confirmed,
+  the real violation's own chain takes over the heal) and
+  ``anomaly_predicted_confirmed`` counts the hit.
+- When the deadline passes without the real violation, the prediction
+  MISSED: the chain resolves ``self_cleared`` and
+  ``anomaly_predicted_missed`` counts the miss — GET /forecast serves
+  the running hit rate.
+
+Off means off: with ``forecast.enabled=false`` a detector tick is one
+config read (the bench ``forecast_noop_overhead`` probe); serving
+behavior is byte-identical to a build without the detector.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable
+
+from ..config.cruise_control_config import CruiseControlConfig
+from .anomaly import PredictedGoalViolations
+
+LOG = logging.getLogger(__name__)
+
+
+class PredictiveViolationDetector:
+    #: Heal-ledger all-clear seam (detector/manager.py): a full pass
+    #: whose horizon shows NO predicted violation re-checked the clear —
+    #: but predictions resolve through the confirm/miss bookkeeping
+    #: below (self_cleared on a miss, cleared on a confirm), so the
+    #: generic all-clear stays out of the way.
+    CLEARS = ()
+
+    def __init__(self, config: CruiseControlConfig, engine,
+                 optimizer, report: Callable,
+                 ledger=None, clock: Callable[[], float] | None = None):
+        self._config = config
+        self._engine = engine
+        self._optimizer = optimizer
+        self._report = report
+        self._ledger = ledger
+        self._clock = clock or time.time
+        from ..analyzer.optimizer import goals_by_priority
+        self._goals = goals_by_priority(
+            config, config.get_list("anomaly.detection.goals"))
+        from ..analyzer.plugins import options_generator_from_config
+        self._options_generator = options_generator_from_config(config)
+        # Same exclusion discipline as GoalViolationDetector: the facade
+        # wires a snapshot supplier over its recently-removed/demoted
+        # history.
+        self.excluded_brokers_supplier: Callable[
+            [], tuple[tuple[int, ...], tuple[int, ...]]] = lambda: ((), ())
+        self._last_checked_generation = -1
+        # Open predictions: anomaly_id -> (deadline_s on the injected
+        # clock, frozenset of predicted goal names).
+        self._open: dict[str, tuple[float, frozenset]] = {}
+        self._last_prediction: list[str] = []
+        # Predictions whose own proactive fix EXECUTED (facade
+        # fix_predicted_violation(execute=True) marks them): a lapse
+        # without the real violation is then an AVERTED heal, not a
+        # forecasting miss.
+        self._proactive_fixed: set[str] = set()
+        self.predictions_made = 0
+        self.predictions_confirmed = 0
+        self.predictions_missed = 0
+        self.predictions_averted = 0
+
+    def note_proactive_fix(self, anomaly_id: str) -> None:
+        """Facade callback: this prediction's proactive fix executed —
+        a later lapse without the real violation is an averted heal."""
+        self._proactive_fixed.add(anomaly_id)
+
+    # -- state (GET /forecast body) ----------------------------------------
+    def state(self) -> dict:
+        # Hit rate over the settled predictions whose outcome says
+        # something about forecast ACCURACY: confirmed + averted are
+        # hits (the violation arrived, or the fix we ran on its account
+        # removed it), plain lapses are misses.
+        hits = self.predictions_confirmed + self.predictions_averted
+        total = hits + self.predictions_missed
+        return {
+            "openPredictions": sorted(
+                g for _dl, gs in self._open.values() for g in gs),
+            "lastPrediction": list(self._last_prediction),
+            "predictionsMade": self.predictions_made,
+            "predictionsConfirmed": self.predictions_confirmed,
+            "predictionsAverted": self.predictions_averted,
+            "predictionsMissed": self.predictions_missed,
+            "hitRate": round(hits / total, 3) if total else None,
+        }
+
+    # -- the pass ----------------------------------------------------------
+    def run_once(self) -> PredictedGoalViolations | None:
+        if not self._engine.enabled:
+            # Off means off for NEW work — but predictions opened before
+            # the flip must still lapse to their terminal, or their heal
+            # chains leak open forever. Guarded on _open so the disabled
+            # tick stays one config read (the noop-overhead probe).
+            if self._open:
+                self._settle_open(set(), [])
+            return None
+        result = self._engine.forecast()
+        if result is None:
+            # No current forecast (monitor lost its stable windows):
+            # nothing backs the "still predicted" claim, so open
+            # predictions must lapse on their deadlines rather than be
+            # held open forever by the STALE last-prediction list.
+            if self._open:
+                self._settle_open(set(), [])
+            return None
+        if result.generation == self._last_checked_generation:
+            # Nothing new to predict from, but deadlines still advance
+            # on the injected clock: lapsed predictions must resolve.
+            if self._open:
+                self._settle_open(set(), self._last_prediction)
+            return None
+        self._last_checked_generation = result.generation
+
+        no_leadership, no_replicas = self.excluded_brokers_supplier()
+        options = self._options_generator.for_goal_violation_detection(
+            result.meta.topic_names, (), sorted(no_leadership),
+            sorted(no_replicas))
+        # TWO entry snapshots through the ONE batched stats program
+        # (round 18's chain_all_goal_stats): the current model separates
+        # "already violated" (the reactive detector's job) from
+        # "violated only at the horizon" (ours).
+        chain, viol_now, _obj_now, _off_now = \
+            self._optimizer.goal_entry_stats(
+                result.state, result.meta, self._goals, options)
+        _chain, viol_h, _obj_h, _off_h = self._optimizer.goal_entry_stats(
+            result.projected_state, result.meta, self._goals, options)
+        now_set = {g.name for g, v in zip(chain, viol_now)
+                   if float(v) > 1e-6}
+        horizon_set = {g.name for g, v in zip(chain, viol_h)
+                       if float(v) > 1e-6}
+        predicted = sorted(horizon_set - now_set)
+        self._last_prediction = predicted
+        self._settle_open(now_set, predicted)
+        if not predicted:
+            return None
+        for anomaly_id, (_dl, goals) in self._open.items():
+            if goals & set(predicted):
+                # The SAME standing incident (any goal overlap — a
+                # prediction whose goal set grows is still one
+                # incident, not a second chain): absorb the new goals,
+                # refresh the deadline (the condition is still
+                # forecast, so the horizon slides), and do not
+                # re-report — one incident, one chain, one
+                # fix/precompute.
+                self._open[anomaly_id] = (
+                    self._clock() + result.horizon_s,
+                    goals | frozenset(predicted))
+                return None
+        anomaly = PredictedGoalViolations(
+            predicted_goals=predicted, horizon_s=result.horizon_s,
+            confidence_band=round(float(result.band.max()), 4)
+            if result.band.size else 0.0)
+        self._report(anomaly)
+        self._open[anomaly.anomaly_id] = (
+            self._clock() + result.horizon_s, frozenset(predicted))
+        self.predictions_made += 1
+        from ..utils.sensors import SENSORS
+        SENSORS.count("anomaly_predicted_violations")
+        if self._ledger is not None:
+            # The predicted=true stamp: GET /heals shows the chain as a
+            # prediction from its first phase (re-detections alias onto
+            # the same chain, so the stamp lands once per incident).
+            self._ledger.handle_for(anomaly.anomaly_id).phase(
+                "predicted", predicted=True, goals=predicted,
+                horizonS=round(result.horizon_s, 3),
+                confidenceBand=anomaly.confidence_band)
+        return anomaly
+
+    def _settle_open(self, now_violated: set[str],
+                     still_predicted: list[str]) -> None:
+        """Resolve open predictions: confirmed when the real violation
+        landed, missed when the deadline lapsed without it. A prediction
+        still inside its window and still forecast stays open (the next
+        report aliases onto its chain)."""
+        from ..utils.sensors import SENSORS
+        now = self._clock()
+        pred_set = set(still_predicted)
+        for anomaly_id, (deadline, goals) in list(self._open.items()):
+            if goals & now_violated:
+                del self._open[anomaly_id]
+                self._proactive_fixed.discard(anomaly_id)
+                self.predictions_confirmed += 1
+                SENSORS.count("anomaly_predicted_confirmed")
+                if self._ledger is not None:
+                    self._ledger.handle_for(anomaly_id).resolve(
+                        "cleared", via="prediction_confirmed",
+                        predicted=True)
+            elif now >= deadline and not (goals & pred_set):
+                del self._open[anomaly_id]
+                if anomaly_id in self._proactive_fixed:
+                    # The prediction's OWN proactive fix executed and
+                    # the violation never arrived: averted, the
+                    # predictive campaign's win condition.
+                    self._proactive_fixed.discard(anomaly_id)
+                    self.predictions_averted += 1
+                    SENSORS.count("anomaly_predicted_averted")
+                    if self._ledger is not None:
+                        self._ledger.handle_for(anomaly_id).resolve(
+                            "cleared", via="violation_averted",
+                            predicted=True)
+                else:
+                    # Past the horizon AND no longer forecast: the
+                    # documented self_cleared terminal for a missed
+                    # prediction.
+                    self.predictions_missed += 1
+                    SENSORS.count("anomaly_predicted_missed")
+                    if self._ledger is not None:
+                        self._ledger.handle_for(anomaly_id).resolve(
+                            "self_cleared", via="prediction_missed",
+                            predicted=True)

@@ -254,6 +254,18 @@ class CruiseControl:
         # the config's never-move contract must hold regardless of which
         # generator is plugged in.
         self._excluded_topics_rx = compile_excluded_topics_pattern(config)
+        # Predictive rebalancing (round 19): one forecast engine per
+        # facade (the heal-ledger isolation discipline — a fleet's
+        # clusters and an embedded twin each forecast their OWN
+        # monitor's history). Off-means-off: with forecast.enabled=false
+        # the engine and its detector cost one config read per tick and
+        # serving behavior is byte-identical.
+        from .forecast import ForecastEngine
+        self.forecast_engine = ForecastEngine(config, self._load_monitor)
+        # Pacer promotion flag: a predicted violation's precompute marks
+        # this cluster due for an immediate paced cache fill regardless
+        # of its cadence (fleet/scheduler.pace_once consumes + clears).
+        self.predicted_precompute_pending = False
         self._wire_detectors()
 
         self._proposal_cache: tuple[int, float, OptimizerResult] | None = None
@@ -285,6 +297,12 @@ class CruiseControl:
         from .warmstart import WarmSeedStore
         self._warm_enabled = config.get_boolean("solver.warm.start.enabled")
         self._warm_band = config.get_double("solver.warm.start.quality.band")
+        # Warm-band pre-check (round 19, ROADMAP 3a tail): score the
+        # seed against the CURRENT loads in one batched stats program
+        # before committing to the full warm chain — a seed that
+        # drifted band-worse is skipped without paying attempt+fallback.
+        self._warm_precheck = config.get_boolean(
+            "solver.warm.start.precheck.enabled")
         self._warm_seeds = WarmSeedStore()
         # Pending warm context across the precompute seams (set by
         # precompute_inputs, consumed by store_precomputed on the SAME
@@ -349,6 +367,20 @@ class CruiseControl:
         self.goal_violation_detector.excluded_brokers_supplier = \
             _excluded_snapshot
         mgr.add_detector(self.goal_violation_detector, interval)
+        # Predictive twin of the goal-violation detector (round 19):
+        # scores the forecaster's projected model through the same
+        # batched goal-stats program and reports predicted violations as
+        # first-class anomalies. Registered unconditionally — a disabled
+        # engine makes its tick a single config read (the noop-overhead
+        # guard family).
+        from .detector.predictive import PredictiveViolationDetector
+        self.predictive_detector = PredictiveViolationDetector(
+            cfg, self.forecast_engine, self._optimizer, report,
+            ledger=self.heal_ledger,
+            clock=self._clock if self._clock is not None else time.time)
+        self.predictive_detector.excluded_brokers_supplier = \
+            _excluded_snapshot
+        mgr.add_detector(self.predictive_detector, interval)
         mgr.add_detector(BrokerFailureDetector(
             self._admin, report,
             failed_brokers_file_path=cfg.get("failed.brokers.file.path"),
@@ -903,6 +935,49 @@ class CruiseControl:
             warm_seed = self._warm_seeds.match(state, meta)
             if warm_seed is not None:
                 warm_state = apply_seed(state, warm_seed)
+            if warm_seed is not None and self._warm_precheck:
+                # Warm-band pre-check (ROADMAP 3a tail): score the seed
+                # against the CURRENT (drifted) loads in ONE batched
+                # goal-stats program. A seed whose entry picture already
+                # breaches the sentry band — a violated goal its
+                # accepted solve did not have (the band rule collapses
+                # to that on the 0-100 scale) — would fail the quality
+                # gate after the full chain anyway; skipping here saves
+                # the doomed attempt+fallback double solve. SERVED
+                # results stay byte-equal: the skip path runs exactly
+                # the cold solve the fallback would have (pinned in
+                # tests/test_warmstart.py).
+                from .warmstart import seed_band_ok
+                try:
+                    pre_chain, pv, _po, _poff = \
+                        self._optimizer.goal_entry_stats(
+                            warm_state, meta, chain, options)
+                    pre_violated = {g.name for g, v in zip(pre_chain, pv)
+                                    if float(v) > 1e-6}
+                    pre_bal = self._optimizer.balancedness_of(
+                        pre_chain, pre_violated)
+                except Exception:  # noqa: BLE001 — pre-check is an
+                    # optimization; a failure falls through to the
+                    # gate-protected warm attempt
+                    LOG.debug("warm pre-check failed; attempting warm",
+                              exc_info=True)
+                else:
+                    if not seed_band_ok(pre_bal, pre_violated, warm_seed,
+                                        self._warm_band):
+                        LOG.info(
+                            "warm seed band-worse on entry (balancedness "
+                            "%.3f vs accepted %.3f, violated %s); "
+                            "skipping the warm attempt", pre_bal,
+                            warm_seed.balancedness_after,
+                            sorted(pre_violated))
+                        SENSORS.count("solver_warm_precheck_skips")
+                        self._warm_seeds.clear()
+                        warm_seed = None
+                        warm_state = state
+            if warm_seed is not None:
+                # Counted AFTER the pre-check: a skipped seed is a cold
+                # solve, and solver_warm_seeded must mean "this solve
+                # actually rode a warm seed" (the warm-adoption ruler).
                 SENSORS.count("solver_warm_seeded")
         # Heal-correlated solves link the flight recorder's pass ids:
         # the chain's solve_completed phase names the passSeq values that
@@ -1083,6 +1158,145 @@ class CruiseControl:
                                  warm_accepted=warm_ok)
         with self._proposal_lock:
             self._proposal_cache = (generation, time.time(), result)
+
+    # -- predictive rebalancing (round 19) ---------------------------------
+    def fix_predicted_violation(self, execute: bool = False,
+                                reason: str = "",
+                                anomaly_id: str | None = None) -> bool:
+        """The PREDICTED_GOAL_VIOLATION fix: solve the forecaster's
+        PROJECTED model — the current assignment under the horizon-peak
+        loads, so proposals diff against the TRUE current state and are
+        executable on the real cluster.
+
+        ``execute=False`` (the default precompute mode) never moves
+        anything:
+
+        - the solve's compiled programs land on the exact jit cache keys
+          the real fix will hit (same shape, same chain),
+        - the predicted TARGET seeds the warm-seed store, so the real
+          solve warm-starts from it (``solver.warm.start.enabled``
+          consumes it; the store is written regardless so flipping warm
+          on mid-incident still finds the seed), and
+        - the fleet pacer is flagged (``predicted_precompute_pending``)
+          to refresh this cluster's REAL proposal cache on its next
+          sweep instead of waiting out the cadence.
+
+        ``execute=True`` (the ``anomaly.detection.predictive.fix.enabled``
+        opt-in) additionally EXECUTES the projected-model proposals —
+        the proactive rebalance that heals before the violation.
+        Returns True when a fix/precompute ran (the anomaly fix-started
+        contract)."""
+        from .utils.heal_ledger import current_heal
+        from .utils.sensors import SENSORS
+        last = self.forecast_engine.last_result
+        if last is None:
+            return False
+        chain = self._goal_chain(None)
+        # Same exclusion contract as the reactive goal-violation fix:
+        # the self.healing.exclude.recently.* configs and the config's
+        # never-move topics hold on the predictive path too.
+        no_leadership = tuple(sorted(self.recently_demoted_brokers)) \
+            if self._config.get_boolean(
+                "self.healing.exclude.recently.demoted.brokers") else ()
+        no_replicas = tuple(sorted(self.recently_removed_brokers)) \
+            if self._config.get_boolean(
+                "self.healing.exclude.recently.removed.brokers") else ()
+        options = OptimizationOptions(
+            excluded_brokers_for_leadership=no_leadership,
+            excluded_brokers_for_replica_move=no_replicas,
+            is_triggered_by_goal_violation=True)
+        options = self._with_config_excluded_topics(last.meta, options)
+        heal = current_heal()
+        heal.phase("predictive_solve", horizonS=round(last.horizon_s, 3),
+                   execute=bool(execute))
+        final, result = self._optimize(last.projected_state, last.meta,
+                                       chain, options)
+        # The predicted target is the next solve's warm seed — but its
+        # quality gate reference must describe REALITY, not the
+        # projected model: a projected-model score can be optimistic
+        # (warm attempts would spuriously fall back — one wasted solve)
+        # or PESSIMISTIC (a too-low reference would let a degraded warm
+        # result pass the sentry band — the round-18 cross-contamination
+        # the incomparable-solve-class rule exists to prevent). Score
+        # the predicted target against the CURRENT loads in one batched
+        # entry snapshot and anchor the gate there.
+        try:
+            ref_state = dataclasses.replace(
+                final, leader_load=last.state.leader_load,
+                follower_load=last.state.follower_load)
+            ref_chain, rv, _ro, _roff = self._optimizer.goal_entry_stats(
+                ref_state, last.meta, chain, options)
+            ref_violated = frozenset(
+                g.name for g, v in zip(ref_chain, rv) if float(v) > 1e-6)
+            reference = (self._optimizer.balancedness_of(ref_chain,
+                                                         ref_violated),
+                         ref_violated)
+            self._warm_seeds.store(final, last.meta, result,
+                                   reference=reference)
+        except Exception:  # noqa: BLE001 — reference scoring is an
+            # accuracy refinement; fall back to the solve's own quality
+            LOG.debug("predicted-seed reference scoring failed",
+                      exc_info=True)
+            self._warm_seeds.store(final, last.meta, result)
+        heal.phase("proposal_ready", predicted=True,
+                   numProposals=len(result.proposals))
+        if execute:
+            executed = self._maybe_execute(
+                result, dryrun=False, operation="predictive_rebalance",
+                reason=reason or "proactive predicted-violation fix")
+            if executed:
+                SENSORS.count("anomaly_predicted_fixes")
+                if anomaly_id is not None:
+                    # The detector's settle pass distinguishes a
+                    # prediction AVERTED by its own proactive fix
+                    # (cleared) from one that plainly missed
+                    # (self_cleared).
+                    det = getattr(self, "predictive_detector", None)
+                    if det is not None:
+                        det.note_proactive_fix(anomaly_id)
+                return True
+            # Execution refused (executor busy / stop requested / zero
+            # proposals): fall back to the precompute contract — the
+            # prediction still leaves a hot answer and a pacer flag,
+            # and the averted bookkeeping is correctly NOT marked.
+        self.predicted_precompute_pending = True
+        SENSORS.count("anomaly_predicted_precomputes")
+        return True
+
+    # Backwards-compatible precompute entry (the anomaly's default fix).
+    def precompute_predicted(self) -> bool:
+        return self.fix_predicted_violation(execute=False)
+
+    def forecast_state(self, refresh: bool = False) -> dict:
+        """GET /forecast body: the engine's last projection (per-broker
+        current-vs-projected loads + confidence band) and the predictive
+        detector's lifecycle counters. ``refresh=True`` fits a fresh
+        forecast inline (device work — the param is explicit opt-in)."""
+        eng = self.forecast_engine
+        body: dict[str, Any] = {
+            "forecastEnabled": eng.enabled,
+            "horizonWindows": self._config.get_int(
+                "forecast.horizon.windows"),
+            "fitWindows": self._config.get_int("forecast.fit.windows"),
+            "seasonalPeriodWindows": self._config.get_int(
+                "forecast.seasonal.period.windows"),
+            "predictiveFixEnabled": self._config.get_boolean(
+                "anomaly.detection.predictive.fix.enabled"),
+        }
+        result = None
+        if eng.enabled:
+            # A refresh whose fresh fit is not ready yet (monitor short
+            # of stable windows) falls back to the cached projection —
+            # refresh means "at least as fresh as the cache", never
+            # worse. A DISABLED engine serves null even if a pre-flip
+            # fit is still cached (off means off).
+            result = eng.forecast() if refresh else eng.last_result
+            if result is None:
+                result = eng.last_result
+        body["forecast"] = result.to_dict() if result is not None else None
+        det = getattr(self, "predictive_detector", None)
+        body["detector"] = det.state() if det is not None else None
+        return body
 
     # -- removal/demotion history (Executor.java retention parity) ---------
     def _history_now_ms(self) -> int:

@@ -443,7 +443,14 @@ class FleetScheduler:
             cadence_s = entry.config.get_long(
                 "fleet.precompute.cadence.ms") / 1000.0
             now = self._clock()
-            if now - entry.last_precompute < cadence_s:
+            # Predicted-violation promotion (round 19): a cluster whose
+            # predictive detector just precomputed a projected target is
+            # due NOW — its real proposal cache must be hot (and
+            # warm-seeded from the predicted target) before the real
+            # violation lands, not a cadence later.
+            predicted = bool(getattr(entry.cc,
+                                     "predicted_precompute_pending", False))
+            if not predicted and now - entry.last_precompute < cadence_s:
                 continue
             with self._cond:
                 # One lock acquisition for BOTH states: a precompute that
@@ -457,6 +464,11 @@ class FleetScheduler:
             if busy:
                 continue
             entry.last_precompute = now
+            if predicted:
+                entry.cc.predicted_precompute_pending = False
+                from ..utils.sensors import SENSORS
+                SENSORS.count("fleet_pacer_predicted_promotions",
+                              labels={"cluster": entry.cluster_id})
             cc, cid = entry.cc, entry.cluster_id
             # Overlap host-side model assembly with whatever solve is
             # currently holding the device: kick the monitor's background

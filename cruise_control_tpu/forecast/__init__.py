@@ -1,0 +1,27 @@
+"""Predictive rebalancing (round 19): batched on-device load forecasting.
+
+The reference system is purely reactive — windowed MetricSampleAggregator
+history in, anomaly detection out (PAPER.md §Monitor/Core) — so every
+heal starts after the SLO is already broken. The windowed history is
+already device-resident here; this package closes ROADMAP item 6:
+
+- ``forecaster``: a seasonal-trend least-squares fit + projection over
+  the FULL ``[windows, partitions, resources]`` history tensor, vmapped
+  over the flattened series axis inside ONE jitted program (no
+  per-partition host loops; pinned via the jit-cache counter).
+- ``engine``: the serving wrapper — pulls the monitor's history export
+  seam, runs the fit, and builds the PROJECTED cluster model (peak load
+  over the horizon, per partition and resource, with a residual-std
+  confidence band) that ``detector/predictive.py`` scores through the
+  existing batched goal-stats program.
+
+Determinism: both modules sit under CCSA004's deterministic contract —
+the projection feeds solver inputs and anomaly decisions, so no wall
+clock and no global randomness anywhere on the fit path.
+"""
+
+from .engine import ForecastEngine, ForecastResult
+from .forecaster import fit_project_loads, project_series
+
+__all__ = ["ForecastEngine", "ForecastResult", "fit_project_loads",
+           "project_series"]

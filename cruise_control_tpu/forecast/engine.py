@@ -1,0 +1,204 @@
+"""ForecastEngine: the serving wrapper around the batched forecaster.
+
+One engine per facade (the heal-ledger isolation discipline: a fleet's
+clusters and an embedded digital twin each forecast their OWN monitor's
+history on their own cadence). The engine
+
+1. pulls the monitor's history export seam
+   (``LoadMonitor.load_history`` — the last ``forecast.fit.windows``
+   stable windows aligned with the current model's partition rows),
+2. runs ``forecaster.fit_project_loads`` — ONE jitted program over the
+   whole tensor — and
+3. builds the PROJECTED cluster model: the current ``ClusterTensors``
+   with its load planes replaced by the per-cell horizon peak, plus the
+   confidence band and per-broker aggregates ``GET /forecast`` serves.
+
+Off means off: with ``forecast.enabled=false`` ``forecast()`` returns
+None after one config read — no model build, no aggregation, no device
+work (the bench ``forecast_noop_overhead`` probe measures exactly this
+path, the tracing/resilience guard family).
+
+Determinism (CCSA004): the projection is a pure function of the history
+tensor; the engine stamps results with the monitor's model GENERATION,
+never wall time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Any
+
+import numpy as np
+
+LOG = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ForecastResult:
+    """One forecasting pass: the projected model plus everything the
+    serving surface reports. All values derive from the history tensor
+    and the model generation — nothing wall-clock, so a pinned-seed twin
+    serves byte-identical forecast bodies."""
+
+    generation: int              # monitor model generation fitted at
+    horizon_windows: int
+    horizon_s: float             # horizon_windows × window_ms / 1000
+    windows_used: int
+    period_windows: int
+    state: Any                   # current ClusterTensors
+    meta: Any                    # ClusterMeta
+    projected_state: Any         # state with load planes at horizon peak
+    band: np.ndarray             # [P, R] residual-RMS confidence band
+    trajectory_broker: np.ndarray  # [H, B, R] projected per-broker loads
+
+    def per_broker(self) -> dict:
+        """{broker_id: {resource: {current, projected, band}}} — the
+        GET /forecast body's core table (projected = horizon peak,
+        band = the broker's aggregated residual-RMS uncertainty)."""
+        from ..common.resources import Resource
+        from ..model.tensors import broker_load
+        cur = np.asarray(broker_load(self.state))
+        proj = np.asarray(broker_load(self.projected_state))
+        band_b = self._broker_band()
+        out: dict = {}
+        names = [r.name for r in Resource]
+        for i, bid in enumerate(self.meta.broker_ids):
+            out[int(bid)] = {
+                names[r]: {
+                    "current": round(float(cur[i, r]), 3),
+                    "projected": round(float(proj[i, r]), 3),
+                    "band": round(float(band_b[i, r]), 3),
+                } for r in range(cur.shape[1])}
+        return out
+
+    def _broker_band(self) -> np.ndarray:
+        """[B, R] per-broker confidence band: each partition's residual
+        band attributed to its leader broker in quadrature (the broker
+        load is a sum over its partitions; independent per-series
+        residuals add as root-sum-square on that sum)."""
+        assignment = np.asarray(self.state.assignment)      # [P, S]
+        leader_slot = np.asarray(self.state.leader_slot)    # [P]
+        num_b = int(self.state.capacity.shape[0])
+        p_idx = np.arange(assignment.shape[0])
+        slot = np.clip(leader_slot, 0, assignment.shape[1] - 1)
+        leader_broker = assignment[p_idx, slot]
+        valid = (leader_slot >= 0) & (leader_broker >= 0) \
+            & np.asarray(self.state.partition_mask)
+        var = np.zeros((num_b, self.band.shape[1]), dtype=np.float64)
+        lb = np.clip(leader_broker, 0, num_b - 1)
+        for r in range(self.band.shape[1]):
+            np.add.at(var[:, r], lb[valid],
+                      np.square(self.band[valid, r], dtype=np.float64))
+        return np.sqrt(var)
+
+    def to_dict(self) -> dict:
+        return {
+            "generation": self.generation,
+            "horizonWindows": self.horizon_windows,
+            "horizonSeconds": round(self.horizon_s, 3),
+            "windowsUsed": self.windows_used,
+            "seasonalPeriodWindows": self.period_windows,
+            "bandMax": round(float(self.band.max()), 4)
+            if self.band.size else 0.0,
+            "perBroker": self.per_broker(),
+        }
+
+
+class ForecastEngine:
+    """Config-gated forecaster for one facade. ``forecast()`` is
+    generation-cached: re-forecasting an unchanged monitor generation
+    returns the cached result (the detector runs every interval; the
+    fit only re-runs when new windows landed)."""
+
+    def __init__(self, config, load_monitor):
+        self._config = config
+        self._monitor = load_monitor
+        self._lock = threading.Lock()
+        self._last: ForecastResult | None = None
+
+    @property
+    def enabled(self) -> bool:
+        return self._config.get_boolean("forecast.enabled")
+
+    @property
+    def last_result(self) -> ForecastResult | None:
+        # Lock-FREE read of the published result (atomic reference
+        # swap): the cached GET /forecast path must stay inline even
+        # while a fit — possibly a first-shape XLA compile — holds the
+        # single-flight lock.
+        return self._last
+
+    def forecast(self) -> ForecastResult | None:
+        """Fit + project the current history; None when disabled or the
+        monitor has fewer than ``forecast.fit.windows`` stable windows."""
+        if not self.enabled:
+            return None
+        from ..utils.sensors import SENSORS
+        from ..utils.tracing import TRACER
+        fit_windows = self._config.get_int("forecast.fit.windows")
+        # The whole fit runs UNDER the lock (single-flight): the
+        # detector tick, a /forecast?refresh=true request, and a futures
+        # worker can all arrive for the same uncached generation — one
+        # fit serves them all instead of three byte-identical model
+        # builds + device programs racing last-writer-wins.
+        with self._lock:
+            gen = self._monitor.model_generation
+            if self._last is not None and self._last.generation == gen:
+                return self._last
+            exported = self._monitor.load_history(fit_windows)
+            if exported is None:
+                SENSORS.count("forecast_skipped_not_ready")
+                return None
+            history, window_ms, state, meta = exported
+            horizon = self._config.get_int("forecast.horizon.windows")
+            period = self._config.get_int(
+                "forecast.seasonal.period.windows")
+            with TRACER.span("forecast.fit", windows=fit_windows,
+                             horizon=horizon,
+                             partitions=int(state.num_partitions)):
+                import jax.numpy as jnp
+
+                from .forecaster import fit_project_loads
+                peak_l, peak_f, band, traj = fit_project_loads(
+                    jnp.asarray(history), state.leader_load,
+                    state.follower_load, horizon, period)
+                projected = dataclasses.replace(
+                    state, leader_load=jnp.asarray(peak_l),
+                    follower_load=jnp.asarray(peak_f))
+                traj_broker = self._broker_trajectory(
+                    state, np.asarray(traj))
+            result = ForecastResult(
+                generation=gen, horizon_windows=horizon,
+                horizon_s=horizon * window_ms / 1000.0,
+                windows_used=fit_windows, period_windows=period,
+                state=state, meta=meta, projected_state=projected,
+                band=np.asarray(band), trajectory_broker=traj_broker)
+            self._last = result
+        SENSORS.count("forecast_runs")
+        SENSORS.gauge("forecast_windows_used", fit_windows)
+        return result
+
+    @staticmethod
+    def _broker_trajectory(state, trajectory: np.ndarray) -> np.ndarray:
+        """[H, B, R] projected per-broker LEADER loads per horizon window
+        (the /forecast sparkline view): attribute each partition row's
+        projected leader load to its leader broker."""
+        import numpy as _np
+        assignment = _np.asarray(state.assignment)      # [P, S]
+        leader_slot = _np.asarray(state.leader_slot)    # [P]
+        num_b = int(state.capacity.shape[0])
+        p_idx = _np.arange(assignment.shape[0])
+        slot = _np.clip(leader_slot, 0, assignment.shape[1] - 1)
+        leader_broker = assignment[p_idx, slot]
+        valid = (leader_slot >= 0) & (leader_broker >= 0) \
+            & _np.asarray(state.partition_mask)
+        out = _np.zeros((trajectory.shape[0], num_b, trajectory.shape[2]),
+                        dtype=_np.float32)
+        lb = _np.clip(leader_broker, 0, num_b - 1)
+        for h in range(trajectory.shape[0]):
+            for r in range(trajectory.shape[2]):
+                _np.add.at(out[h, :, r], lb[valid],
+                           trajectory[h, valid, r])
+        return out

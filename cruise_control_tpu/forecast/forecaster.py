@@ -1,0 +1,148 @@
+"""Batched per-partition load forecaster: one jitted fit+projection.
+
+Model: per (partition, resource) series ``y[w]`` over the last ``W``
+stable windows, fit a small linear basis by least squares —
+
+    y(t) ≈ b0 + b1·t  (+ b2·sin(2πt/T) + b3·cos(2πt/T) when a seasonal
+                        period ``T`` is configured)
+
+— and project it ``H`` windows past the last observation. The fit is a
+closed-form normal-equations solve shared across every series (one
+``[K, K]`` Gram matrix for the whole tensor), vmapped over the flattened
+``partitions × resources`` series axis, so the WHOLE history tensor fits
+and projects in ONE jitted device program: no per-partition host loop,
+and the jit cache holds exactly one entry per (W, P, R, H, T) shape
+(pinned in tests/test_forecast.py via the ``_cache_size`` counter, the
+same discipline as the megabatch/warmstart rounds).
+
+The confidence band is the per-series residual RMS — honest about what a
+4-basis fit can promise: it widens exactly where the history refuses to
+be a trend + one sinusoid. Projections are clamped at zero (loads are
+non-negative) and the violation-scoring view takes the per-cell PEAK
+over the horizon, so one goal-stats program answers "does any window
+within H violate?" conservatively.
+
+Determinism (CCSA004): pure functions of the history tensor — no wall
+clock, no randomness; same history bytes ⇒ same projection bytes.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+#: Ridge term on the Gram diagonal: the basis columns are well scaled
+#: (t normalized to [0, 1]) so this only guards the degenerate
+#: constant-history case from a singular solve.
+_RIDGE = 1e-6
+
+
+def _basis(t: jax.Array, num_windows: int, period: int) -> jax.Array:
+    """[len(t), K] design matrix. ``t`` is the window index (0 = oldest
+    fitted window); the trend column is normalized by the fit span so
+    coefficients stay O(data) regardless of W."""
+    span = max(1, num_windows - 1)
+    cols = [jnp.ones_like(t), t / span]
+    if period > 0:
+        w = 2.0 * math.pi / period
+        cols += [jnp.sin(w * t), jnp.cos(w * t)]
+    return jnp.stack(cols, axis=1)
+
+
+@partial(jax.jit, static_argnames=("horizon", "period"))
+def project_series(history: jax.Array, horizon: int, period: int,
+                   ) -> tuple[jax.Array, jax.Array]:
+    """Fit + project every series of ``history [W, S]`` in one program.
+
+    Returns ``(projected [H, S], sigma [S])`` — the per-window
+    projections for the next ``horizon`` windows and the per-series
+    residual RMS of the fit. ``period`` (windows) adds the seasonal
+    pair to the basis; 0 = trend-only.
+    """
+    num_windows = history.shape[0]
+    t_fit = jnp.arange(num_windows, dtype=jnp.float32)
+    t_proj = num_windows - 1 + jnp.arange(1, horizon + 1, dtype=jnp.float32)
+    x_fit = _basis(t_fit, num_windows, period)            # [W, K]
+    x_proj = _basis(t_proj, num_windows, period)          # [H, K]
+    gram = x_fit.T @ x_fit + _RIDGE * jnp.eye(x_fit.shape[1],
+                                              dtype=jnp.float32)
+
+    def fit_one(y):
+        beta = jnp.linalg.solve(gram, x_fit.T @ y)        # [K]
+        resid = y - x_fit @ beta
+        sigma = jnp.sqrt(jnp.mean(resid * resid))
+        return x_proj @ beta, sigma
+
+    # vmapped over the flattened series axis: the whole tensor fits in
+    # one batched program (out axis 1 keeps [H, S] layout).
+    proj, sigma = jax.vmap(fit_one, in_axes=1, out_axes=(1, 0))(history)
+    return jnp.maximum(proj, 0.0), sigma
+
+
+@partial(jax.jit, static_argnames=("horizon", "period"))
+def fit_project_loads(history: jax.Array, cur_leader: jax.Array,
+                      cur_follower: jax.Array, horizon: int, period: int,
+                      avg_resource: jax.Array | None = None,
+                      ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """The full forecasting program over the history tensor.
+
+    ``history [W, P, R]`` is the leader-load view of the last W stable
+    windows (monitor history export seam); ``cur_leader``/``cur_follower``
+    ``[P, R]`` are the CURRENT model's load planes. Returns
+
+    - ``peak_leader [P, R]``: per-cell PEAK projected MODEL-VIEW leader
+      load over the horizon (the conservative violation-scoring view),
+    - ``peak_follower [P, R]``: the current follower plane scaled by the
+      same per-cell projection ratio (follower load tracks its leader's
+      ingest; the ratio keeps the model's CPU-estimation relationship
+      rather than refitting a second tensor),
+    - ``band [P, R]``: the residual-RMS confidence band,
+    - ``trajectory [H, P, R]``: the per-window MODEL-VIEW projections
+      (served on GET /forecast).
+
+    MODEL VIEW: the cluster model reduces AVG-strategy resources (CPU,
+    NW_IN, NW_OUT) by the MEAN over its retained windows, so what the
+    detector will see in ``h`` windows is the rolling mean of the last
+    ``W`` windows at that point — ``mean(history[h:] ∪ proj[:h])`` —
+    not the raw window value. Scoring the raw projection would predict
+    violations the lagging model never reports (phantom predictions
+    that can only miss). LATEST-strategy resources (DISK) take the raw
+    projected window. ``avg_resource [R]`` bool marks the AVG columns
+    (defaults to the Kafka metric-def layout: all but DISK).
+
+    One jitted program end to end — fit, projection, the rolling-mean
+    model view, peak reduction, and the follower scaling all trace into
+    a single XLA executable.
+    """
+    num_w, num_p, num_r = history.shape
+    flat = history.reshape(num_w, num_p * num_r)
+    proj, sigma = project_series(flat, horizon, period)
+    raw = proj.reshape(horizon, num_p, num_r)
+    band = sigma.reshape(num_p, num_r)
+    if avg_resource is None:
+        from ..common.resources import Resource
+        avg_resource = jnp.asarray(
+            [r is not Resource.DISK for r in Resource])
+    # Rolling model mean at horizon h (1-indexed) over a W-window span:
+    # (sum(history[h:]) + sum(raw[max(0, h-W):h])) / W.
+    hs = jnp.cumsum(history[::-1], axis=0)[::-1]   # hs[k] = Σ history[k:]
+    pp = jnp.concatenate([jnp.zeros((1, num_p, num_r), raw.dtype),
+                          jnp.cumsum(raw, axis=0)])  # pp[k] = Σ raw[:k]
+    h_idx = jnp.arange(1, horizon + 1)
+    suffix = jnp.where((h_idx < num_w)[:, None, None],
+                       hs[jnp.clip(h_idx, 0, num_w - 1)], 0.0)
+    proj_part = pp[h_idx] - pp[jnp.maximum(0, h_idx - num_w)]
+    rolled = (suffix + proj_part) / float(num_w)
+    trajectory = jnp.where(avg_resource[None, None, :], rolled, raw)
+    peak_leader = jnp.max(trajectory, axis=0)
+    # Follower plane: scale by the projected/current ratio where the
+    # current leader load is meaningful; keep the current value where it
+    # is ~zero (idle partitions stay idle rather than exploding on a
+    # 0/0 ratio).
+    safe = jnp.where(cur_leader > 1e-9, cur_leader, 1.0)
+    ratio = jnp.where(cur_leader > 1e-9, peak_leader / safe, 1.0)
+    peak_follower = jnp.maximum(cur_follower * ratio, 0.0)
+    return peak_leader, peak_follower, band, trajectory

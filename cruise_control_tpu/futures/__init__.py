@@ -10,10 +10,11 @@ serving workload.
 """
 
 from .generator import (  # noqa: F401
-    FUTURE_TEMPLATES, SampledFuture, present_future, sample_future,
-    sample_scenario,
+    DEFAULT_TEMPLATES, FUTURE_TEMPLATES, SampledFuture, present_future,
+    sample_future, sample_scenario,
 )
 from .evaluator import (  # noqa: F401
-    PRESENT, FutureSpec, FuturesPayload, compare_futures, evaluate_prepared,
-    plan_futures, prepare_future, rank_results,
+    PRESENT, FutureSpec, FuturesPayload, LiveSeed, compare_futures,
+    evaluate_prepared, live_seed_from, plan_futures, prepare_future,
+    rank_results,
 )

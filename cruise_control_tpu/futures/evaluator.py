@@ -82,9 +82,13 @@ def plan_futures(templates: Sequence[str], num_futures: int, seed: int,
     ``?what_if=random:<template>:<seed>``. Duplicate template names are
     dropped (order-preserving): repeating a template cannot mean
     anything but re-solving the identical future, and colliding
-    future ids would corrupt the ranked answer."""
-    from .generator import _unknown, FUTURE_TEMPLATES
-    templates = list(dict.fromkeys(templates)) or sorted(FUTURE_TEMPLATES)
+    future ids would corrupt the ranked answer. Default expansion (an
+    empty request) covers the SYNTHETIC templates only —
+    ``requires_live`` ones (forecast_horizon) must be named, so pinned
+    default plans (bench ranked_order, the CI matrix) never change
+    under a new live-only template."""
+    from .generator import _unknown, DEFAULT_TEMPLATES, FUTURE_TEMPLATES
+    templates = list(dict.fromkeys(templates)) or list(DEFAULT_TEMPLATES)
     for t in templates:
         if t not in FUTURE_TEMPLATES:
             raise _unknown(t)
@@ -92,6 +96,54 @@ def plan_futures(templates: Sequence[str], num_futures: int, seed: int,
     return [FutureSpec(templates[i % len(templates)],
                        seed + i // len(templates), ticks)
             for i in range(max(1, int(num_futures)))]
+
+
+@dataclasses.dataclass
+class LiveSeed:
+    """The live-cluster seam (ROADMAP 5b): the serving facade's model,
+    config, and forecast engine, plus a ``base`` ScenarioSpec carrying
+    the LIVE geometry — candidate futures sampled against it are
+    futures of THIS cluster, not of the reference twin."""
+
+    state: Any
+    meta: Any
+    config: Any
+    engine: Any = None     # ForecastEngine | None
+    base: Any = None       # ScenarioSpec with live geometry
+
+
+def live_base_spec(state, meta):
+    """BASE_SPEC with the live cluster's geometry swapped in (brokers,
+    racks, RF, topic/partition counts); the shared futures goal chain
+    and replay horizon are kept so sampled futures stay comparable."""
+    import math as _math
+
+    from .generator import BASE_SPEC
+    num_topics = max(1, len(meta.topic_names))
+    num_parts = max(1, len(meta.partition_index))
+    num_brokers = max(1, len(meta.broker_ids))
+    return dataclasses.replace(
+        BASE_SPEC,
+        num_brokers=num_brokers,
+        num_topics=num_topics,
+        partitions_per_topic=max(1, _math.ceil(num_parts / num_topics)),
+        rf=max(1, min(int(state.max_replication_factor), num_brokers)),
+        num_racks=max(1, len(meta.rack_names)))
+
+
+def live_seed_from(cc) -> "LiveSeed | None":
+    """Build the live seam from a serving facade, or None when live
+    seeding is disabled or the model is not ready (callers fall back to
+    the synthetic BASE_SPEC behavior)."""
+    if not cc.config.get_boolean("futures.live.seed.enabled"):
+        return None
+    try:
+        state, meta = cc.load_monitor.cluster_model()
+    except Exception:  # noqa: BLE001 — monitor warming up: synthetic path
+        return None
+    return LiveSeed(state=state, meta=meta, config=cc.config,
+                    engine=getattr(cc, "forecast_engine", None),
+                    base=live_base_spec(state, meta))
 
 
 @dataclasses.dataclass
@@ -115,21 +167,98 @@ class PreparedFuture:
         return self.spec.future_id
 
 
+def _prepare_live_forecast(fspec: FutureSpec, live: LiveSeed,
+                           ) -> PreparedFuture:
+    """The forecast_horizon future: the LIVE cluster's model with its
+    load planes replaced by the forecaster's projection at the sampled
+    confidence-band position — no twin, no advance; the decision solve
+    runs this cluster's OWN goal chain against the loads its own
+    forecaster says are coming. Falls back to the current loads (noted
+    in ``decision.forecastReady``) when the engine is off or not ready,
+    so the future still ranks instead of crashing the request."""
+    import jax.numpy as jnp
+
+    from ..analyzer.constraint import OptimizationOptions
+    from ..analyzer.optimizer import goals_by_priority
+    from ..common.resources import Resource
+    from .generator import band_position
+    pos = band_position(fspec.seed)
+    state, meta = live.state, live.meta
+    fc = None
+    if live.engine is not None and live.engine.enabled:
+        fc = live.engine.forecast()
+    if fc is not None:
+        shifted = np.maximum(
+            np.asarray(fc.projected_state.leader_load) + pos * fc.band,
+            0.0).astype(np.float32)
+        state = dataclasses.replace(
+            fc.projected_state, leader_load=jnp.asarray(shifted))
+        meta = fc.meta
+    disk_mb = np.asarray(state.leader_load[:, int(Resource.DISK)])
+    return PreparedFuture(
+        spec=fspec, config=live.config,
+        chain=tuple(goals_by_priority(live.config)),
+        state=state, meta=meta, options=OptimizationOptions(),
+        events=[], decision={"forecastReady": fc is not None,
+                             "bandPosition": pos},
+        disk_mb=disk_mb)
+
+
+#: Live preparers for ``requires_live`` templates, keyed by template
+#: name. ``prepare_future`` dispatches here for every requires_live
+#: template — a new live-only template registers its preparer alongside
+#: its ``FutureTemplate`` entry or its futures raise loudly.
+_LIVE_PREPARERS: dict = {"forecast_horizon": _prepare_live_forecast}
+
+
 def prepare_future(fspec: FutureSpec, optimizer=None,
                    config_overrides: Mapping | None = None,
+                   live: "LiveSeed | None" = None,
                    ) -> PreparedFuture:
     """Advance one future's twin to its decision point and build the
     model + options its batched solve slot needs. Host-side work only —
-    no device program runs here."""
+    no device program runs here, with ONE documented exception: the
+    ``forecast_horizon`` template reads the live engine's
+    GENERATION-CACHED forecast, which re-runs the one batched fit
+    program (a first-shape call also compiles it) only when no fit for
+    the current model generation exists — on a serving facade the
+    predictive detector keeps that cache warm every interval. With
+    ``live`` (the ROADMAP 5b seam) the twins take the LIVE cluster's
+    geometry and the ``forecast_horizon`` template solves the live
+    model under its own projected loads."""
     from ..analyzer.constraint import OptimizationOptions
     from ..analyzer.optimizer import goals_by_priority
     from ..common.broker_state import BrokerState
     from ..model.tensors import set_broker_state
     from ..testing.simulator import ClusterSimulator
-    from .generator import present_future, sample_future
+    from .generator import FUTURE_TEMPLATES, present_future, sample_future
 
+    tmpl = FUTURE_TEMPLATES.get(fspec.template)
+    if tmpl is not None and tmpl.requires_live:
+        # Generic requires_live dispatch: every live-only template MUST
+        # have a registered live preparer — falling through to
+        # t.sample() would silently replay a bare renamed base spec
+        # under the template's name (the exact failure the what_if 400
+        # guards against).
+        if live is None:
+            raise ValueError(
+                f"template {fspec.template!r} requires the live-cluster "
+                "seam — futures.live.seed.enabled on a serving facade "
+                "whose model is ready (live_seed_from returns None while "
+                "the monitor is still warming)")
+        preparer = _LIVE_PREPARERS.get(fspec.template)
+        if preparer is None:
+            raise ValueError(
+                f"requires_live template {fspec.template!r} has no live "
+                "preparer registered in futures.evaluator._LIVE_PREPARERS")
+        return preparer(fspec, live)
+    base = live.base if live is not None and live.base is not None else None
     sampled = present_future() if fspec.template == PRESENT \
-        else sample_future(fspec.template, fspec.seed)
+        else sample_future(fspec.template, fspec.seed, base=base)
+    if fspec.template == PRESENT and base is not None:
+        sampled = dataclasses.replace(sampled, spec=dataclasses.replace(
+            base, name=PRESENT,
+            description="The cluster as it is (live geometry)."))
     ticks = max(_MIN_TICKS, int(fspec.ticks))
     adv_events = sampled.advance_events(ticks)
     spec = dataclasses.replace(sampled.spec, ticks=ticks,
@@ -345,7 +474,8 @@ def rank_results(results: Sequence[FutureResult]) -> list[FutureResult]:
 
 def _response_body(plan: list[FutureSpec], ranked: list[FutureResult],
                    present: FutureResult | None, batched: bool,
-                   width: int, occupancies: list[int]) -> dict:
+                   width: int, occupancies: list[int],
+                   live_seeded: bool = False) -> dict:
     return {
         "operation": "compare_futures", "dryrun": True, "executed": False,
         "numFutures": len(plan),
@@ -353,6 +483,7 @@ def _response_body(plan: list[FutureSpec], ranked: list[FutureResult],
         "batched": batched,
         "batchWidth": width,
         "occupancies": occupancies,
+        "liveSeeded": live_seeded,
         "present": present.as_dict() if present is not None else None,
         "futures": [r.as_dict() for r in ranked],
     }
@@ -362,7 +493,8 @@ def compare_futures(templates: Sequence[str] | None = None,
                     num_futures: int = 8, seed: int = 0, ticks: int = 12,
                     optimizer=None, width: int = 8, batched: bool = True,
                     include_present: bool = True,
-                    config_overrides: Mapping | None = None) -> dict:
+                    config_overrides: Mapping | None = None,
+                    live: "LiveSeed | None" = None) -> dict:
     """Evaluate a batch of candidate futures end to end and return the
     ranked comparison body (the COMPARE_FUTURES response). Never touches
     the serving cluster: every future runs on its own twin, and the only
@@ -384,7 +516,8 @@ def compare_futures(templates: Sequence[str] | None = None,
         prepared = []
         for fs in specs:
             prepared.append(prepare_future(
-                fs, optimizer=optimizer, config_overrides=config_overrides))
+                fs, optimizer=optimizer, config_overrides=config_overrides,
+                live=live))
         if optimizer is None:
             optimizer = GoalOptimizer(prepared[0].config)
         # ccsa: ok[CCSA004] observability-only timer (see t0)
@@ -404,7 +537,7 @@ def compare_futures(templates: Sequence[str] | None = None,
     # ccsa: ok[CCSA004] observability-only timer (see t0)
     SENSORS.record_timer("futures_evaluate", time.perf_counter() - t0)
     return _response_body(plan, ranked, present, batched, width,
-                          occupancies)
+                          occupancies, live_seeded=live is not None)
 
 
 class FuturesPayload:
@@ -417,11 +550,16 @@ class FuturesPayload:
     def __init__(self, cluster_id: str,
                  templates: Sequence[str] | None, num_futures: int,
                  seed: int, ticks: int, include_present: bool = True,
-                 wrap: Callable[[dict], Any] | None = None):
+                 wrap: Callable[[dict], Any] | None = None,
+                 live_supplier: Callable[[], "LiveSeed | None"] | None = None):
         self.cluster_id = cluster_id
         self._plan = plan_futures(templates or (), num_futures, seed, ticks)
         self._include_present = include_present
         self._wrap = wrap
+        # Live seam resolved LAZILY on the worker thread (the model
+        # build belongs in the scheduler turn, not the request thread).
+        self._live_supplier = live_supplier
+        self._live: LiveSeed | None = None
         self._prepared: list[PreparedFuture] = []
 
     def prepare(self, optimizer) -> list:
@@ -429,7 +567,10 @@ class FuturesPayload:
         specs = list(self._plan)
         if self._include_present:
             specs = specs + [FutureSpec(PRESENT, 0, self._plan[0].ticks)]
-        self._prepared = [prepare_future(fs, optimizer=optimizer)
+        self._live = self._live_supplier() \
+            if self._live_supplier is not None else None
+        self._prepared = [prepare_future(fs, optimizer=optimizer,
+                                         live=self._live)
                           for fs in specs]
         return [SolveItem(item_id=f"future:{p.future_id}",
                           chain=tuple(optimizer.megabatch_chain(
@@ -468,5 +609,6 @@ class FuturesPayload:
             SENSORS.observe("futures_batch_occupancy", float(k),
                             buckets=(1, 2, 4, 8, 16, 32, 64))
         body = _response_body(self._plan, ranked, present, True,
-                              width or len(self._prepared), occs)
+                              width or len(self._prepared), occs,
+                              live_seeded=self._live is not None)
         return self._wrap(body) if self._wrap is not None else body

@@ -135,12 +135,12 @@ class SampledFuture:
                                    events=self._rescaled_events(ticks))
 
 
-def _named(template: str, seed: int, description: str,
-           **changes) -> ScenarioSpec:
-    overrides = {**dict(BASE_SPEC.config_overrides),
+def _named(template: str, seed: int, base: ScenarioSpec,
+           description: str, **changes) -> ScenarioSpec:
+    overrides = {**dict(base.config_overrides),
                  **changes.pop("config_overrides", {})}
     return dataclasses.replace(
-        BASE_SPEC, name=f"random:{template}:{seed}",
+        base, name=f"random:{template}:{seed}",
         description=description, config_overrides=overrides, **changes)
 
 
@@ -148,7 +148,8 @@ def _named(template: str, seed: int, description: str,
 # Templates
 # ---------------------------------------------------------------------------
 
-def _load_ramp(seed: int) -> SampledFuture:
+def _load_ramp(seed: int, base: ScenarioSpec = BASE_SPEC,
+               ) -> SampledFuture:
     """Forecast-percentile load ramp: the cluster's next N hours under a
     demand forecast — which percentile arrives, when the ramp lands, and
     how hard the diurnal swing rides on top are all sampled."""
@@ -157,7 +158,7 @@ def _load_ramp(seed: int) -> SampledFuture:
         ("p90", 1.7) if u < 2 / 3 else ("p99", 2.4)
     amp = round(0.15 + 0.35 * _hash01(seed, "ramp", "amp"), 3)
     start = 6 + _pick(seed, "ramp:start", 18)
-    hot_topic = f"t{_pick(seed, 'ramp:topic', BASE_SPEC.num_topics)}"
+    hot_topic = f"t{_pick(seed, 'ramp:topic', base.num_topics)}"
     hot = round(1.5 + 2.0 * _hash01(seed, "ramp", "hot"), 2)
     events = (
         ScenarioEvent(start, "set_load", {"factor": factor}),
@@ -165,19 +166,20 @@ def _load_ramp(seed: int) -> SampledFuture:
                       {"topic": hot_topic, "factor": hot}),
     )
     return SampledFuture("load_ramp", seed, _named(
-        "load_ramp", seed,
+        "load_ramp", seed, base,
         f"Forecast {pct} load ramp (x{factor}) from tick {start} with a "
         f"x{hot} hotspot on {hot_topic}, diurnal amplitude {amp}.",
         drift=DriftSpec(amplitude=amp, period_ticks=40), events=events,
         config_overrides={"scenario.slo.balancedness.min": 60.0}))
 
 
-def _capacity_skew(seed: int) -> SampledFuture:
+def _capacity_skew(seed: int, base: ScenarioSpec = BASE_SPEC,
+                   ) -> SampledFuture:
     """Heterogeneous capacities: half the fleet scaled by a sampled
     factor (a mixed-generation hardware future), with a sampled hotspot
     so placement by capacity share actually matters."""
     skew = round(1.5 + 1.5 * _hash01(seed, "skew", "factor"), 2)
-    hot_topic = f"t{_pick(seed, 'skew:topic', BASE_SPEC.num_topics)}"
+    hot_topic = f"t{_pick(seed, 'skew:topic', base.num_topics)}"
     hot = round(1.5 + 1.5 * _hash01(seed, "skew", "hot"), 2)
     start = 5 + _pick(seed, "skew:start", 15)
     events = (
@@ -185,27 +187,28 @@ def _capacity_skew(seed: int) -> SampledFuture:
                                          "factor": hot}),
     )
     return SampledFuture("capacity_skew", seed, _named(
-        "capacity_skew", seed,
-        f"Brokers 0-{BASE_SPEC.num_brokers // 2 - 1} at x{skew} capacity "
+        "capacity_skew", seed, base,
+        f"Brokers 0-{base.num_brokers // 2 - 1} at x{skew} capacity "
         f"(heterogeneous fleet) under a x{hot} hotspot on {hot_topic}.",
         capacity_skew=skew, events=events,
         config_overrides={"scenario.slo.balancedness.min": 60.0}))
 
 
-def _cascading_failures(seed: int) -> SampledFuture:
+def _cascading_failures(seed: int, base: ScenarioSpec = BASE_SPEC,
+                        ) -> SampledFuture:
     """Cascading broker/AZ failures: a first broker dies, then a second
     in a DIFFERENT rack a few ticks later (the cross-AZ cascade), both
     reviving late in the replay. The evaluator's decision point sits
     mid-outage: both victims marked DEAD at the solve, excluded from
     replica moves and leadership."""
-    b = BASE_SPEC.num_brokers
+    b = base.num_brokers
     first = _pick(seed, "cascade:first", b)
     # A different rack (racks are broker % num_racks): step by one so the
     # cascade always crosses an AZ boundary.
     second = (first + 1) % b
     t1 = 8 + _pick(seed, "cascade:t1", 10)
     gap = 3 + _pick(seed, "cascade:gap", 6)
-    revive = BASE_SPEC.ticks - 18
+    revive = base.ticks - 18
     events = (
         ScenarioEvent(t1, "kill_broker", {"broker": first}),
         ScenarioEvent(t1 + gap, "kill_broker", {"broker": second}),
@@ -214,7 +217,7 @@ def _cascading_failures(seed: int) -> SampledFuture:
     )
     return SampledFuture(
         "cascading_failures", seed, _named(
-            "cascading_failures", seed,
+            "cascading_failures", seed, base,
             f"Broker {first} dies at tick {t1}, broker {second} (next "
             f"rack) follows {gap} ticks later; both revive at "
             f"tick {revive}.",
@@ -228,40 +231,53 @@ def _cascading_failures(seed: int) -> SampledFuture:
         remove_brokers=(first, second))
 
 
-def _churn_storm(seed: int) -> SampledFuture:
+def _churn_storm(seed: int, base: ScenarioSpec = BASE_SPEC,
+                 ) -> SampledFuture:
     """Partition-expansion churn storm: existing topics grow in sampled
     bursts (topic COUNT stays fixed so every churn future shares the
-    batch's static topic axis; total partitions stay inside the 128
-    bucket so the storm never changes the compiled shape)."""
+    batch's static topic axis; total partitions stay within ONE
+    geometric 128-grid step of the base so the storm crosses at most
+    one padded-shape boundary)."""
+    from ..fleet.bucketing import geometric_round_up
     events = []
     grown: dict[str, int] = {}
-    budget = 48  # base 48 partitions + at most 48 grown = 96 <= 128
+    # At most double the base partition count, additionally capped at
+    # the next 128-based geometric grid point strictly above the base
+    # total (BASE_SPEC: 48 -> min(48, 128-48) = 48, digests unchanged).
+    # A LIVE base near or past a bucket boundary must not grow the twin
+    # across several padded shapes: each crossing recompiles mid-replay
+    # and splits the decision solve out of the batch's shared shape.
+    total = base.num_topics * base.partitions_per_topic
+    bound = geometric_round_up(total + 1, 128, 2.0)
+    budget = budget0 = min(total, max(0, bound - total))
     cadence = 5 + _pick(seed, "churn:cadence", 5)
-    for tick in range(cadence, BASE_SPEC.ticks - 5, cadence):
+    for tick in range(cadence, base.ticks - 5, cadence):
         if budget <= 0:
             break
-        topic = f"t{_pick(seed, f'churn:topic:{tick}', BASE_SPEC.num_topics)}"
+        topic = f"t{_pick(seed, f'churn:topic:{tick}', base.num_topics)}"
         step = min(budget, 4 + 4 * _pick(seed, f"churn:step:{tick}", 2))
-        grown[topic] = grown.get(topic, BASE_SPEC.partitions_per_topic) + step
+        grown[topic] = grown.get(topic, base.partitions_per_topic) + step
         budget -= step
         events.append(ScenarioEvent(tick, "expand_partitions",
                                     {"topic": topic, "to": grown[topic]}))
     return SampledFuture("churn_storm", seed, _named(
-        "churn_storm", seed,
+        "churn_storm", seed, base,
         f"Partition-expansion bursts every {cadence} ticks across "
-        f"{len(grown)} topics (+{48 - budget} partitions total).",
+        f"{len(grown)} topics "
+        f"(+{budget0 - budget} partitions total).",
         events=tuple(events),
         config_overrides={"scenario.slo.balancedness.min": 60.0}))
 
 
-def _maintenance_plan(seed: int) -> SampledFuture:
+def _maintenance_plan(seed: int, base: ScenarioSpec = BASE_SPEC,
+                      ) -> SampledFuture:
     """Maintenance plan: one sampled broker drained (REMOVE_BROKER plan)
     and re-added later in the replay. At the evaluator's decision point
     the drain is in force: the broker is marked DEAD and excluded, the
     solve prices evacuating it."""
-    victim = _pick(seed, "maint:broker", BASE_SPEC.num_brokers)
+    victim = _pick(seed, "maint:broker", base.num_brokers)
     t1 = 8 + _pick(seed, "maint:t1", 12)
-    t2 = BASE_SPEC.ticks - 15
+    t2 = base.ticks - 15
     events = (
         ScenarioEvent(t1, "maintenance",
                       {"plan": "REMOVE_BROKER", "brokers": [victim]}),
@@ -270,7 +286,7 @@ def _maintenance_plan(seed: int) -> SampledFuture:
     )
     return SampledFuture(
         "maintenance_plan", seed, _named(
-            "maintenance_plan", seed,
+            "maintenance_plan", seed, base,
             f"Drain broker {victim} at tick {t1} (maintenance plan), "
             f"re-add at tick {t2}.",
             events=events,
@@ -278,11 +294,39 @@ def _maintenance_plan(seed: int) -> SampledFuture:
         remove_brokers=(victim,))
 
 
+def _forecast_horizon(seed: int, base: ScenarioSpec = BASE_SPEC,
+                      ) -> SampledFuture:
+    """The forecaster's own projection as a future (round 19, the
+    natural sixth template): "what would the solver propose against the
+    loads the forecaster says are coming?". LIVE-ONLY — the evaluator
+    builds this future directly from the serving cluster's model with
+    its load planes replaced by the engine's projection at a SAMPLED
+    band position (lower / mean / upper confidence band, the
+    percentile axis other templates fake with synthetic factors), so it
+    is meaningless without the live seam and is excluded from default
+    template expansion (``requires_live``). The spec here only carries
+    the shared goal chain + naming for ranking/replay bookkeeping."""
+    return SampledFuture("forecast_horizon", seed, _named(
+        "forecast_horizon", seed, base,
+        f"The live cluster under its own forecast at band position "
+        f"{band_position(seed):+d}σ."))
+
+
+def band_position(seed: int) -> int:
+    """Sampled confidence-band position for a forecast_horizon future:
+    -1 (lower band), 0 (mean), +1 (upper band) — pure in seed."""
+    return _pick(seed, "fh:band", 3) - 1
+
+
 @dataclasses.dataclass(frozen=True)
 class FutureTemplate:
     name: str
     description: str
     sample: Callable[[int], SampledFuture]
+    #: True = only meaningful with the live-cluster seam (evaluator
+    #: LiveSeed): excluded from default template expansion so pinned
+    #: default plans (bench ranked_order, the CI matrix) are unchanged.
+    requires_live: bool = False
 
 
 FUTURE_TEMPLATES: dict[str, FutureTemplate] = {t.name: t for t in (
@@ -301,7 +345,16 @@ FUTURE_TEMPLATES: dict[str, FutureTemplate] = {t.name: t for t in (
     FutureTemplate("maintenance_plan",
                    "Broker drain + re-add maintenance plan",
                    _maintenance_plan),
+    FutureTemplate("forecast_horizon",
+                   "The live cluster under its own projected loads "
+                   "(round 19; live seam only)",
+                   _forecast_horizon, requires_live=True),
 )}
+
+#: Default expansion set (an empty templates request): the synthetic
+#: templates only — requires_live ones must be asked for by name.
+DEFAULT_TEMPLATES = tuple(sorted(
+    n for n, t in FUTURE_TEMPLATES.items() if not t.requires_live))
 
 
 def _unknown(template: str) -> ValueError:
@@ -311,14 +364,19 @@ def _unknown(template: str) -> ValueError:
 
 
 def sample_future(template: str, seed: int,
-                  ticks: int | None = None) -> SampledFuture:
-    """Sample one candidate future — pure in ``(template, seed)``.
-    ``ticks`` re-times the spec's replay horizon (the advance-phase
-    event positions rescale with it via ``advance_events``)."""
+                  ticks: int | None = None,
+                  base: ScenarioSpec | None = None) -> SampledFuture:
+    """Sample one candidate future — pure in ``(template, seed)`` (and
+    ``base`` when the live seam supplies one: same seed + same live
+    geometry ⇒ the same future). ``ticks`` re-times the spec's replay
+    horizon (the advance-phase event positions rescale with it via
+    ``advance_events``); ``base`` swaps the shared BASE_SPEC geometry
+    for the LIVE cluster's (futures of THIS cluster, ROADMAP 5b)."""
     t = FUTURE_TEMPLATES.get(template)
     if t is None:
         raise _unknown(template)
-    sampled = t.sample(int(seed))
+    sampled = t.sample(int(seed)) if base is None \
+        else t.sample(int(seed), base)
     if ticks is not None:
         sampled = dataclasses.replace(
             sampled, spec=dataclasses.replace(sampled.spec,

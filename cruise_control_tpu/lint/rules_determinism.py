@@ -49,6 +49,13 @@ class DeterminismRule(Rule):
         # state (no age-based staleness); the prewarm manager times
         # itself through the injectable ``monotonic`` seam only.
         "cruise_control_tpu/warmstart.py",
+        # Predictive rebalancing (round 19): the projection feeds solver
+        # inputs and anomaly decisions — the fit must be a pure function
+        # of the history tensor (byte-identical twin replays depend on
+        # it), and the detector's deadlines ride the injected clock.
+        "cruise_control_tpu/forecast/forecaster.py",
+        "cruise_control_tpu/forecast/engine.py",
+        "cruise_control_tpu/detector/predictive.py",
     )
 
     CLOCK_CALLS = ("time.time", "time.time_ns", "time.monotonic",

@@ -561,6 +561,61 @@ class LoadMonitor:
         """The incremental refresh pipeline (observability + tests)."""
         return self._pipeline
 
+    # -- history export (forecast seam, round 19) --------------------------
+    def load_history(self, num_windows: int,
+                     ) -> "tuple[np.ndarray, int, ClusterTensors, ClusterMeta] | None":
+        """The windowed per-partition resource history the forecaster
+        fits: ``(history [W, P, R], window_ms, state, meta)`` where the
+        partition axis is ALIGNED with the current cluster model's rows
+        (``state``/``meta`` are this call's ``cluster_model()`` result,
+        so the projected loads can be swapped straight into the model)
+        and ``W == num_windows`` — exactly the LAST ``num_windows``
+        stable windows, oldest first, so the forecaster compiles ONE
+        program per (W, P, R) shape instead of one per history length.
+        Returns None when fewer stable windows are available (forecast
+        not ready) or the model cannot be built yet.
+
+        Entities with no valid aggregation contribute zero rows (the
+        same convention as ``_fill_loads``); the resource columns are
+        the leader-load view (CPU, NW_IN, NW_OUT, DISK)."""
+        from ..common.resources import NUM_RESOURCES
+        try:
+            state, meta = self.cluster_model()
+        except Exception:  # noqa: BLE001 — monitor warming up
+            return None
+        opts = AggregationOptions(
+            min_valid_entity_ratio=0.0, min_valid_windows=1,
+            max_allowed_extrapolations_per_entity=self._config.get(
+                "max.allowed.extrapolations.per.partition"),
+            granularity=Granularity.ENTITY,
+            include_invalid_entities=True)
+        try:
+            agg = self._partition_agg.aggregate(opts)
+        except NotEnoughValidWindowsError:
+            return None
+        if len(agg.window_indices) < num_windows:
+            return None
+        vals = agg.values[:, :, -num_windows:]            # [E, M, W]
+        row_of = {e: i for i, e in enumerate(agg.entities)}
+        from .sampling.samples import PartitionEntity
+        num_p = int(state.num_partitions)
+        rows = np.full(num_p, -1, dtype=np.int64)
+        for i, (t, p) in enumerate(meta.partition_index):
+            rows[i] = row_of.get(PartitionEntity(t, p), -1)
+        metric_cols = [KafkaMetricDef.common_metric_id(m) for m in
+                       (CM.CPU_USAGE, CM.LEADER_BYTES_IN,
+                        CM.LEADER_BYTES_OUT, CM.DISK_USAGE)]
+        res_cols = [int(Resource.CPU), int(Resource.NW_IN),
+                    int(Resource.NW_OUT), int(Resource.DISK)]
+        history = np.zeros((num_windows, num_p, NUM_RESOURCES),
+                           dtype=np.float32)
+        known = rows >= 0
+        # [Ek, Mk, W] -> [W, Pk, Rk]
+        gathered = vals[rows[known]][:, metric_cols, :]
+        history[:, known.nonzero()[0][:, None], res_cols] = \
+            np.transpose(gathered, (2, 0, 1))
+        return history, self._partition_agg.window_ms, state, meta
+
     def prefetch_model(self) -> bool:
         """Kick off a BACKGROUND assembly of the default cluster model for
         the current generation, overlapping host-side model work with

@@ -188,6 +188,25 @@ def warm_quality_ok(result, reference_balancedness: float,
     return result.balancedness_after >= reference_balancedness - band
 
 
+def seed_band_ok(entry_balancedness: float, entry_violated,
+                 seed: WarmSeed, band: float) -> bool:
+    """The warm-band PRE-CHECK predicate (round 19, ROADMAP 3a tail):
+    the seed scored against the CURRENT loads — one batched
+    ``chain_all_goal_stats`` entry snapshot — must sit inside the same
+    sentry band ``warm_quality_ok`` enforces after the solve: no
+    violated goal the seed's accepted solve did not have, balancedness
+    within ``band`` of the accepted reference. Honest trade: the chain
+    COULD sometimes repair an out-of-band seed and keep the warm win,
+    but the measured drift case (±5 % wave, bench --warmstart) converges
+    band-worse and pays attempt+fallback — the pre-check skips that
+    doomed double solve. Served results stay byte-equal either way: the
+    skip path runs exactly the fallback's cold solve (pinned in
+    tests/test_warmstart.py)."""
+    if set(entry_violated) - set(seed.violated_after):
+        return False
+    return entry_balancedness >= seed.balancedness_after - band
+
+
 def apply_seed(state, seed: WarmSeed):
     """``state`` with the seed's mutable pair swapped in — the warm
     search start. The seed arrays enter the chain exactly like the cold

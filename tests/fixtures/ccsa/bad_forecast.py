@@ -1,0 +1,28 @@
+"""CCSA004 fixture: a forecaster-shaped module that stamps projections
+with the wall clock and samples noise from the global ``random`` state
+(tests lint this file under the spoofed
+cruise_control_tpu/forecast/forecaster.py path — the round-19 projection
+feeds SOLVER INPUTS and anomaly decisions, so the fit must be a pure
+function of the history tensor; the detector's deadlines ride the
+injected clock seam)."""
+
+import random
+import time
+
+
+def bad_projection_stamp() -> float:
+    return time.time()                   # finding: wall clock inline
+
+
+def bad_band_noise() -> float:
+    return random.random()               # finding: global random state
+
+
+def injected_deadline(clock=time.time) -> float:
+    return clock()                       # clean: reference is the seam
+
+
+def timed_fit() -> float:
+    # ccsa: ok[CCSA004] fixture: observability-only fit duration, never
+    # enters the projection or the anomaly decision
+    return time.perf_counter()

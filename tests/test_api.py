@@ -503,6 +503,22 @@ def test_metrics_endpoint_renders_prometheus(api):
            ',type="inter_broker_replica_action"} 3' in text
 
 
+def test_forecast_endpoint_serves_state(api):
+    """GET /forecast (round 19): VIEWER-safe engine + detector state;
+    disabled by default (off means off) with the config geometry still
+    reported so an operator can see what flipping it on would do."""
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/forecast", "")
+    assert status == 200
+    assert body["forecastEnabled"] is False
+    assert body["forecast"] is None
+    assert body["detector"]["predictionsMade"] == 0
+    assert body["horizonWindows"] >= 1 and body["fitWindows"] >= 4
+    # Unknown params still 400 (the shared parameter discipline).
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/forecast",
+                                 "bogus=1")
+    assert status == 400
+
+
 def test_openapi_spec_covers_all_endpoints():
     import yaml
 

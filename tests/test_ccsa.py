@@ -220,6 +220,27 @@ def test_ccsa_covers_warmstart_module():
         assert not real_active, [f.message for f in real_active]
 
 
+def test_ccsa_covers_forecast_modules():
+    """The round-19 forecast subsystem feeds SOLVER INPUTS and anomaly
+    decisions, so it sits under CCSA004's deterministic contract: wall
+    clock and global randomness are findings under the forecast paths,
+    the injected-clock reference and the documented observability
+    suppression stay legal — and the REAL modules verify clean."""
+    spoofed = ctx_for(FIXTURES / "bad_forecast.py",
+                      "cruise_control_tpu/forecast/forecaster.py")
+    active, suppressed = findings_of("CCSA004", spoofed)
+    assert len(active) == 2           # time.time() + random.random()
+    assert len(suppressed) == 1       # the documented perf_counter probe
+    assert any("time.time" in f.message for f in active)
+    assert any("random.random" in f.message for f in active)
+    for rel in ("cruise_control_tpu/forecast/forecaster.py",
+                "cruise_control_tpu/forecast/engine.py",
+                "cruise_control_tpu/detector/predictive.py"):
+        ctx = ctx_for(ROOT / rel, rel)
+        real_active, _sup = findings_of("CCSA004", ctx)
+        assert not real_active, [f.message for f in real_active]
+
+
 def test_ccsa004_hash_ban_is_repo_wide_but_clock_is_not():
     plain = ctx_for(FIXTURES / "bad_determinism.py")
     active, suppressed = findings_of("CCSA004", plain)
@@ -634,6 +655,9 @@ def test_default_scan_skips_fixture_corpus():
     "cruise_control_tpu/testing/simulator.py",
     "cruise_control_tpu/testing/chaos.py",
     "cruise_control_tpu/utils/flight_recorder.py",
+    "cruise_control_tpu/forecast/forecaster.py",
+    "cruise_control_tpu/forecast/engine.py",
+    "cruise_control_tpu/detector/predictive.py",
 ])
 def test_deterministic_modules_lint_clean(rel):
     """The twin/chaos/flight-recorder modules carry no ACTIVE wall-clock

@@ -374,6 +374,28 @@ def test_flooded_cluster_cannot_starve_other_precompute(fleet):
     assert len(ran) == 20
 
 
+def test_pacer_promotes_predicted_precompute(fleet):
+    """Round 19: a cluster flagged predicted_precompute_pending is due
+    NOW — the pacer enqueues its precompute regardless of cadence and
+    clears the flag; unflagged clusters keep waiting theirs out."""
+    registry, scheduler = fleet
+    now = __import__("time").monotonic()
+    for e in registry.entries():
+        e.last_precompute = now          # nobody due by cadence
+    assert scheduler.pace_once() == 0
+    entry = registry.entries()[0]
+    entry.cc.predicted_precompute_pending = True
+    assert scheduler.pace_once() == 1
+    assert entry.cc.predicted_precompute_pending is False
+    assert scheduler.pending(entry.cluster_id,
+                             JobKind.EXPIRING_CACHE) == 1
+    scheduler.run_pending()
+    with entry.cc._proposal_lock:
+        assert entry.cc._proposal_cache is not None
+    # One promotion, one sweep: the flag does not re-trigger.
+    assert scheduler.pace_once() == 0
+
+
 def test_self_healing_routes_through_scheduler():
     base = _base_config()
     scheduler = FleetScheduler()

@@ -335,3 +335,78 @@ def test_futures_payload_rides_the_megabatch_runner(prepared_set):
                              width=WIDTH)
     assert json.dumps(body["futures"], sort_keys=True) \
         == json.dumps(direct["futures"], sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# Live-cluster seeding + the forecast_horizon template (round 19)
+# ---------------------------------------------------------------------------
+
+def test_forecast_horizon_excluded_from_default_expansion():
+    """The live-only template must not change pinned default plans
+    (bench ranked_order, the CI matrix): an empty templates request
+    expands to the synthetic set only."""
+    from cruise_control_tpu.futures.generator import DEFAULT_TEMPLATES
+    assert "forecast_horizon" in FUTURE_TEMPLATES
+    assert FUTURE_TEMPLATES["forecast_horizon"].requires_live
+    assert "forecast_horizon" not in DEFAULT_TEMPLATES
+    plan = plan_futures((), 12, seed=0, ticks=TICKS)
+    assert all(p.template != "forecast_horizon" for p in plan)
+    # Named explicitly it is valid.
+    plan = plan_futures(["forecast_horizon"], 2, seed=0, ticks=TICKS)
+    assert [p.template for p in plan] == ["forecast_horizon"] * 2
+
+
+def test_forecast_horizon_requires_live_seam():
+    with pytest.raises(ValueError, match="live"):
+        prepare_future(FutureSpec("forecast_horizon", 0, TICKS))
+
+
+def test_live_base_swaps_geometry_deterministically():
+    """Samplers are pure in (template, seed, live geometry): the same
+    live base yields byte-identical event streams, and the sampled spec
+    carries the LIVE cluster's geometry, not BASE_SPEC's."""
+    import dataclasses as _dc
+
+    from cruise_control_tpu.futures.generator import BASE_SPEC
+    live_base = _dc.replace(BASE_SPEC, num_brokers=4, num_topics=2,
+                            partitions_per_topic=6, rf=2, num_racks=2)
+    a = sample_future("cascading_failures", 5, base=live_base)
+    b = sample_future("cascading_failures", 5, base=live_base)
+    assert a.spec.num_brokers == 4 and a.spec.num_topics == 2
+    assert json.dumps([e.as_dict() for e in a.spec.events]) \
+        == json.dumps([e.as_dict() for e in b.spec.events])
+    # Broker picks stay inside the live broker range.
+    assert all(b_id < 4 for b_id in a.remove_brokers)
+    # A different base geometry is a different (deterministic) sample.
+    c = sample_future("cascading_failures", 5)
+    assert c.spec.num_brokers == BASE_SPEC.num_brokers
+
+
+def test_compare_futures_with_live_seed(api_cc):
+    """End to end through the live seam: twins take the live cluster's
+    geometry, forecast_horizon solves the live model under its (not
+    ready here -> current) loads, and the body says liveSeeded."""
+    from cruise_control_tpu.futures.evaluator import live_seed_from
+    _api, cc = api_cc
+    live = live_seed_from(cc)
+    assert live is not None
+    assert live.base.num_brokers == 4          # the fixture's cluster
+    assert live.base.num_topics == 2
+    body = compare_futures(
+        templates=["forecast_horizon", "maintenance_plan"],
+        num_futures=2, seed=0, ticks=TICKS, optimizer=cc.optimizer,
+        width=WIDTH, live=live)
+    assert body["liveSeeded"] is True
+    futures = {f["future"]: f for f in body["futures"]}
+    fh = futures["forecast_horizon:0"]
+    # Engine off in this fixture: honest decision note, still ranked.
+    assert fh["decision"]["forecastReady"] is False
+    assert fh["rank"] in (1, 2)
+    mp = futures["maintenance_plan:0"]
+    assert all(b < 4 for b in mp["decision"]["removeBrokers"])
+    # Disabled by config -> no live seam.
+    cc.config._values["futures.live.seed.enabled"] = False
+    try:
+        assert live_seed_from(cc) is None
+    finally:
+        cc.config._values["futures.live.seed.enabled"] = True

@@ -420,6 +420,20 @@ def test_what_if_rejects_unknown_scenario(what_if_api):
     assert "unknown what_if scenario" in json.dumps(body)
 
 
+def test_what_if_rejects_requires_live_template(what_if_api):
+    """A requires_live futures template (forecast_horizon) has no
+    standalone replay spec — its content lives in the evaluator's live
+    seam, so replaying its bare renamed BASE_SPEC would serve a
+    meaningless trajectory under the template's name. 400, pointing at
+    COMPARE_FUTURES (the surface that answers it)."""
+    api, _cc = what_if_api
+    status, body, _ = api.handle(
+        "GET", "/kafkacruisecontrol/proposals",
+        "what_if=random:forecast_horizon:0")
+    assert status == 400
+    assert "requires the live-cluster seam" in json.dumps(body)
+
+
 def test_what_if_tick_cap_is_enforced():
     from cruise_control_tpu.api.server import CruiseControlApi
     backend = _backend()

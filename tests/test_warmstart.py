@@ -271,8 +271,12 @@ def test_facade_warm_fallback_on_adversarial_seed():
     """A seed whose accepted quality the warm solve cannot re-reach (the
     adversarial drift step, simulated by doctoring the accepted band)
     triggers the counted cold fallback, drops the seed, and serves the
-    cold solve's proposals."""
-    cc, _ = _facade_cluster({"solver.warm.start.enabled": True})
+    cold solve's proposals. Pre-check OFF here: this test pins the
+    POST-SOLVE gate specifically (the round-19 pre-check would catch
+    the doctored seed before the attempt — covered by
+    test_warm_precheck_skips_band_worse_seed)."""
+    cc, _ = _facade_cluster({"solver.warm.start.enabled": True,
+                             "solver.warm.start.precheck.enabled": False})
     cc.proposals()                       # stores the first seed
     seed = cc._warm_seeds._seed
     assert seed is not None
@@ -292,6 +296,59 @@ def test_facade_warm_fallback_on_adversarial_seed():
     # The post-fallback stored seed reflects the COLD solve's quality.
     assert cc._warm_seeds._seed.balancedness_after \
         == cold.optimizer_result.balancedness_after
+
+
+def test_warm_precheck_skips_band_worse_seed():
+    """Round 19 warm-band pre-check (ROADMAP 3a tail): a seed that
+    scores band-worse against the CURRENT loads is skipped BEFORE the
+    full warm chain — solver_warm_precheck_skips counts it, no warm
+    attempt+fallback is paid — and the served proposals are byte-equal
+    to the pre-check-off fallback path's (both serve the cold solve)."""
+    overrides = {"solver.warm.start.enabled": True}
+    cc_on, _ = _facade_cluster(overrides)
+    cc_off, _ = _facade_cluster({**overrides,
+                                 "solver.warm.start.precheck.enabled":
+                                 False})
+    for cc in (cc_on, cc_off):
+        cc.proposals()                   # store the first seed
+        seed = cc._warm_seeds._seed
+        assert seed is not None
+        # Adversarial seed: an accepted band no re-solve can reach —
+        # the pre-check's entry snapshot sees the violated set beyond
+        # the (empty) reference and skips; the post-solve gate would
+        # pay attempt+fallback for the same verdict.
+        cc._warm_seeds._seed = dataclasses.replace(
+            seed, balancedness_after=seed.balancedness_after + 50.0,
+            violated_after=frozenset())
+    skips0 = _counter("solver_warm_precheck_skips")
+    fallbacks0 = _counter("solver_warm_fallbacks")
+    r_on = cc_on.proposals(ignore_proposal_cache=True)
+    assert _counter("solver_warm_precheck_skips") == skips0 + 1
+    assert _counter("solver_warm_fallbacks") == fallbacks0  # no attempt
+    assert cc_on._warm_seeds._seed is not None  # cold result re-seeded
+    r_off = cc_off.proposals(ignore_proposal_cache=True)
+    assert _counter("solver_warm_fallbacks") == fallbacks0 + 1
+    # Byte-equal served quality: pre-check skip == post-solve fallback.
+    assert sorted((p.topic, p.partition, p.new_replicas, p.new_leader)
+                  for p in r_on.proposals) \
+        == sorted((p.topic, p.partition, p.new_replicas, p.new_leader)
+                  for p in r_off.proposals)
+    assert r_on.optimizer_result.balancedness_after \
+        == r_off.optimizer_result.balancedness_after
+
+
+def test_warm_precheck_passes_in_band_seed():
+    """A seed still inside the band (the refresh case: unchanged model)
+    is NOT skipped by the pre-check — the warm attempt proceeds and
+    serves gate-equal quality."""
+    cc, _ = _facade_cluster({"solver.warm.start.enabled": True})
+    cc.proposals()
+    skips0 = _counter("solver_warm_precheck_skips")
+    seeded0 = _counter("solver_warm_seeded")
+    r = cc.proposals(ignore_proposal_cache=True)
+    assert _counter("solver_warm_seeded") > seeded0
+    assert _counter("solver_warm_precheck_skips") == skips0
+    assert r.optimizer_result is not None
 
 
 def test_warm_reference_is_sticky_and_scoped_to_default_chain():
