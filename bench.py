@@ -167,6 +167,23 @@ FORECAST_OVERRIDES = {
     "anomaly.detection.predictive.fix.enabled": True,
 }
 
+# --serving: run ONLY the serving front-door stage (round 20): (1) a
+# parity pre-pass — fresh solve vs response-cache replay must be
+# byte-identical at TWO different fleet bucket shapes, and concurrent
+# identical requests (coalesced or cache-served) must match the serial
+# body; (2) a steady arm — the pinned-seed mixed loadgen schedule
+# replayed through the task engine against the REAL api, its schedule
+# digest pinned in bench_baseline.json via the ranked_order hard canary;
+# (3) an overload arm — a solver admission bound of zero must shed every
+# new solve with Retry-After while viewer reads keep flowing. Like the
+# other riders, the stage also runs at the END of every default bench
+# pass (the CI SERVING row).
+SERVING_MODE = "--serving" in sys.argv or bool(
+    os.environ.get("BENCH_SERVING"))
+SERVING_SEED = int(os.environ.get("BENCH_SERVING_SEED", "0"))
+SERVING_RATE_RPS = float(os.environ.get("BENCH_SERVING_RATE", "50"))
+SERVING_DURATION_S = float(os.environ.get("BENCH_SERVING_DURATION", "2"))
+
 # Generator-sampled SCENARIO_MATRIX rows (pinned (template, seed) pairs
 # so the matrix stays deterministic): the scenario-diversity axis beyond
 # the 6-scenario canonical library. Violation-free at these pins by
@@ -1741,6 +1758,217 @@ def _run_forecast_stage(progress: dict) -> dict:
     }
 
 
+def _run_serving_stage(progress: dict) -> dict:
+    """Serving front-door stage (round 20): three arms against the REAL
+    api (``api.handle`` — the transport-independent surface CI can drive
+    without sockets). Parity pre-pass: a fresh solve vs its
+    response-cache replay must be byte-identical at two different fleet
+    bucket shapes, and concurrent identical requests must resolve to the
+    serial body (one solve, N responses). Steady arm: the pinned-seed
+    mixed loadgen schedule replayed through the task engine, with the
+    schedule digest as the ranked_order hard canary and loose in-run
+    SLOs (latency is machine-sensitive — only error/shed rates and
+    response-body stability hard-fail). Overload arm: solver admission
+    bound 0 must shed every new solve with Retry-After while viewer
+    reads keep flowing."""
+    import threading
+
+    from cruise_control_tpu.api.server import CruiseControlApi
+    from cruise_control_tpu.common.resources import Resource
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.executor.admin import (
+        InMemoryAdminBackend, PartitionState,
+    )
+    from cruise_control_tpu.executor.executor import Executor
+    from cruise_control_tpu.facade import CruiseControl
+    from cruise_control_tpu.fleet import FleetRegistry, FleetScheduler
+    from cruise_control_tpu.monitor import (
+        LoadMonitor, StaticCapacityResolver,
+    )
+    from cruise_control_tpu.monitor.sampling import SyntheticSampler
+    from cruise_control_tpu.serving import loadgen
+
+    caps = StaticCapacityResolver({}, {
+        Resource.CPU: 100.0, Resource.DISK: 1e7,
+        Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6})
+
+    def _parts(brokers, topics, parts):
+        out = {}
+        for t in range(topics):
+            for p in range(parts):
+                reps = (brokers[0],
+                        brokers[1 + (t + p) % (len(brokers) - 1)])
+                out[(f"t{t}", p)] = PartitionState(
+                    f"t{t}", p, reps, reps[0], isr=reps)
+        return out
+
+    def _config(extra=None):
+        return CruiseControlConfig({
+            "partition.metrics.window.ms": 1000,
+            "num.partition.metrics.windows": 3,
+            "min.valid.partition.ratio": 0.0,
+            "max.solver.rounds": 30,
+            "failed.brokers.file.path": "",
+            "solver.partition.bucket.size": 0,
+            "solver.broker.bucket.size": 0,
+            "fleet.bucket.broker.base": 4,
+            "fleet.bucket.partition.base": 16,
+            **(extra or {})})
+
+    def _make_cc(config, parts):
+        backend = InMemoryAdminBackend(parts.values())
+        monitor = LoadMonitor(config, backend,
+                              samplers=[SyntheticSampler()],
+                              capacity_resolver=caps)
+        cc = CruiseControl(config, backend, load_monitor=monitor,
+                           executor=Executor(backend, synchronous=True))
+        for k in range(1, 4):
+            monitor.task_runner.run_sampling_once(end_ms=k * 1000)
+        return cc
+
+    flips: list[str] = []
+    base = _config()
+    scheduler = FleetScheduler(starvation_bound_s=30.0)
+    registry = FleetRegistry(base_config=base, scheduler=scheduler)
+    # alpha pads to bucket (16, 256), gamma to (4, 16): the byte-identity
+    # claim is pinned at two genuinely different padded shapes.
+    registry.register("alpha", cc=_make_cc(
+        base, _parts(tuple(range(16)), 2, 65)))
+    registry.register("gamma", cc=_make_cc(
+        base, _parts((0, 1, 2, 3), 2, 6)))
+    api = CruiseControlApi(registry.get("alpha"), fleet=registry)
+    api._async_wait_s = 300
+    t_stage0 = time.time()
+    report = oreport = None
+    coalesced_delta = 0
+    try:
+        # -- parity pre-pass: cache replay byte-identity at two shapes --
+        for cid in ("alpha", "gamma"):
+            s1, b1, _h1 = api.handle(
+                "GET", "/kafkacruisecontrol/proposals", f"cluster={cid}")
+            s2, b2, h2 = api.handle(
+                "GET", "/kafkacruisecontrol/proposals", f"cluster={cid}")
+            if s1 != 200 or s2 != 200:
+                flips.append(f"parity: {cid} proposals statuses "
+                             f"({s1}, {s2})")
+                continue
+            if h2.get("X-Serving-Cache") != "hit":
+                flips.append(f"parity: {cid} replay missed the cache")
+            if json.dumps(b1, sort_keys=True) != \
+                    json.dumps(b2, sort_keys=True):
+                flips.append(f"parity: {cid} cache replay not "
+                             "byte-identical")
+        progress["parity"] = "done"
+
+        # -- coalesce parity: N concurrent identical requests, then one
+        # serial cache replay — all bodies must be the SAME bytes (one
+        # leader solve; the rest attach in flight or hit the cache).
+        api.response_cache.invalidate()
+        coalesced0 = api._tasks.coalesced
+        conc: list = [None] * 6
+
+        def _req(i):
+            conc[i] = api.handle("GET", "/kafkacruisecontrol/proposals",
+                                 "cluster=alpha")
+
+        threads = [threading.Thread(target=_req, args=(i,), daemon=True)
+                   for i in range(len(conc))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        _s, serial, _h = api.handle(
+            "GET", "/kafkacruisecontrol/proposals", "cluster=alpha")
+        want = json.dumps(serial, sort_keys=True)
+        for i, r in enumerate(conc):
+            if r is None or r[0] != 200:
+                flips.append(f"parity: concurrent request {i} failed "
+                             f"({'hung' if r is None else r[0]})")
+            elif json.dumps(r[1], sort_keys=True) != want:
+                flips.append(f"parity: concurrent request {i} body "
+                             "diverged from the serial replay")
+        coalesced_delta = api._tasks.coalesced - coalesced0
+        progress["coalesce"] = "done"
+
+        # -- steady arm: the pinned-seed mixed schedule against the
+        # real api. The digest is a pure function of the seed — pinned
+        # in bench_baseline.json through the ranked_order hard canary.
+        api.response_cache.invalidate()
+        schedule = loadgen.generate_schedule(
+            loadgen.mixed_profile(["alpha", "gamma"]), seed=SERVING_SEED,
+            rate_rps=SERVING_RATE_RPS, duration_s=SERVING_DURATION_S)
+        sched_digest = loadgen.schedule_digest(schedule)
+        progress["schedule_digest"] = sched_digest
+        t0 = time.time()
+        report = loadgen.run_schedule(api, schedule, concurrency=8)
+        steady_wall = time.time() - t0
+        flips.extend(f"steady: {f}" for f in loadgen.slo_violations(
+            report, {"max_error_rate": 0.0, "max_shed_rate": 0.0,
+                     "min_throughput_rps": 1.0}))
+        # Response stability: the load model's generation never moves
+        # during the run, so every 200 body a proposals spec produced
+        # must be ONE byte pattern (first solve, then replays/joins).
+        for name, digs in sorted(report.digests.items()):
+            if name.startswith("proposals") and len(digs) > 1:
+                flips.append(f"steady: {name} produced {len(digs)} "
+                             "distinct response bodies")
+        progress["steady"] = "done"
+    finally:
+        api.shutdown()
+        scheduler.shutdown()
+
+    # -- overload arm: shed-all solver bound on a solo api (cache and
+    # coalescing off so every solver request actually reaches admission).
+    ocfg = _config({"serving.admission.queue.solver.max": 0,
+                    "serving.coalesce.enabled": False,
+                    "serving.cache.enabled": False})
+    oapi = CruiseControlApi(_make_cc(ocfg, _parts((0, 1, 2, 3), 2, 6)))
+    oapi._async_wait_s = 300
+    try:
+        oschedule = loadgen.generate_schedule(
+            loadgen.mixed_profile(), seed=SERVING_SEED + 5,
+            rate_rps=30.0, duration_s=1.0)
+        oreport = loadgen.run_schedule(oapi, oschedule, concurrency=4)
+        flips.extend(f"overload: {f}" for f in loadgen.slo_violations(
+            oreport, {"min_shed": 1, "require_retry_after": True,
+                      "max_error_rate": 0.0}))
+    finally:
+        oapi.shutdown()
+    progress["overload"] = "done"
+
+    wall = time.time() - t_stage0
+    steady = report.to_dict() if report is not None else {}
+    return {
+        "metric": "serving_loadgen_mixed",
+        "value": round(steady_wall, 3),
+        "unit": "s",
+        "vs_baseline": 0.0 if flips else 1.0,
+        "extras": {
+            "canary_flips": flips,
+            # The schedule digest rides the sentry's ranked_order hard
+            # canary: same seed ⇒ byte-identical arrival schedule, so a
+            # flip means the loadgen's determinism contract broke.
+            "ranked_order": [f"serving:sched:{sched_digest}"],
+            "seed": SERVING_SEED,
+            "steady_report": steady,
+            "steady_wall_s": round(steady_wall, 3),
+            "coalesced_in_parity_pass": coalesced_delta,
+            "overload_report":
+                oreport.to_dict() if oreport is not None else {},
+            "stage_wall_s": round(wall, 3),
+            "solve_wall_clock_s": round(steady_wall, 3),
+            "measured_layer": "parity pre-pass (cache + coalesce "
+                              "byte-identity at two bucket shapes), the "
+                              "pinned-seed mixed loadgen replay, and the "
+                              "shed-all overload arm, all through the "
+                              "real api.handle surface",
+            **progress,
+        },
+    }
+
+
 def _fleet_twin_scenario_record() -> dict:
     """The fleet_megabatch twin scenario (testing/fleet_twin.py) as a
     SCENARIO_MATRIX row: two drifting clusters sharing one bucket, both
@@ -2075,6 +2303,30 @@ def _guarded_main(deadline: float) -> int:
             _emit({"metric": "stage_failed", "value": 0.0, "unit": "s",
                    "vs_baseline": 0.0,
                    "extras": {"stage": "forecast_proactive_vs_reactive",
+                              "error": f"{type(e).__name__}: {e}"[:500]}})
+        return 0
+    if SERVING_MODE:
+        _emit({"metric": "bench_bootstrap",
+               "value": round(time.time() - t0, 3), "unit": "s",
+               "vs_baseline": 1.0,
+               "extras": {"device": device, "num_devices": n_dev,
+                          "mode": "serving", "seed": SERVING_SEED,
+                          "rate_rps": SERVING_RATE_RPS,
+                          "duration_s": SERVING_DURATION_S,
+                          "compile_cache_dir": cache_dir,
+                          "stderr_file": _stderr_path}})
+        try:
+            record = _run_serving_stage({})
+            _emit(record)
+            baseline = load_baseline()
+            if baseline is not None:
+                verdict = compare_stage_to_baseline(record, baseline)
+                if verdict is not None:
+                    _emit(verdict)
+        except Exception as e:  # noqa: BLE001 — parseable record always
+            _emit({"metric": "stage_failed", "value": 0.0, "unit": "s",
+                   "vs_baseline": 0.0,
+                   "extras": {"stage": "serving_loadgen_mixed",
                               "error": f"{type(e).__name__}: {e}"[:500]}})
         return 0
     noop_ns = _tracing_noop_overhead_ns()
@@ -2423,6 +2675,44 @@ def _guarded_main(deadline: float) -> int:
         _emit({"metric": "stage_partial_forecast_proactive_vs_reactive",
                "value": 0.0, "unit": "s", "vs_baseline": 0.0,
                "extras": {"stage": "forecast_proactive_vs_reactive",
+                          "partial": True, "skipped": True,
+                          "reason": "budget exhausted"}})
+    # The serving stage rides every default pass too (round 20): the CI
+    # SERVING row sees cache/coalesce byte-identity at two bucket shapes,
+    # the pinned-seed loadgen schedule digest, and the overload-sheds-
+    # with-Retry-After contract per PR without a separate invocation.
+    remaining = deadline - time.time()
+    if remaining > 60:
+        progress = {}
+        t0 = time.time()
+        signal.alarm(max(1, int(min(remaining - 15.0, 300.0))))
+        try:
+            record = _run_serving_stage(progress)
+            signal.alarm(0)
+            _emit(record)
+            if baseline is not None:
+                verdict = compare_stage_to_baseline(record, baseline)
+                if verdict is not None:
+                    sentry_verdicts.append(verdict)
+                    _emit(verdict)
+        except _Watchdog:
+            _emit({"metric": "stage_partial_serving_loadgen_mixed",
+                   "value": round(time.time() - t0, 3), "unit": "s",
+                   "vs_baseline": 0.0,
+                   "extras": {"stage": "serving_loadgen_mixed",
+                              "partial": True, **progress}})
+        except Exception as e:  # noqa: BLE001 — parseable record always
+            _emit({"metric": "stage_failed", "value": round(
+                time.time() - t0, 3), "unit": "s", "vs_baseline": 0.0,
+                "extras": {"stage": "serving_loadgen_mixed",
+                           "error": f"{type(e).__name__}: {e}"[:500],
+                           **progress}})
+        finally:
+            signal.alarm(0)
+    else:
+        _emit({"metric": "stage_partial_serving_loadgen_mixed",
+               "value": 0.0, "unit": "s", "vs_baseline": 0.0,
+               "extras": {"stage": "serving_loadgen_mixed",
                           "partial": True, "skipped": True,
                           "reason": "budget exhausted"}})
     _emit_sentry_summary(sentry_verdicts, baseline)

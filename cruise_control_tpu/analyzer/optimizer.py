@@ -1166,10 +1166,14 @@ class GoalOptimizer:
         jit dispatch caches the first real solve will hit; with the
         persistent compile cache enabled the XLA backend artifacts also
         land on disk, so the NEXT restart retrieves instead of compiling.
-        Returns False when the entry is not reproducible here (unknown or
-        non-default goal spec, mesh-sharded solver) — never raises for a
-        merely mismatched entry; kernel failures propagate to the prewarm
-        manager, which records and continues."""
+        Returns False when the entry is not reproducible here (unknown
+        goal spec, or a megabatch entry under a mesh) — never raises for
+        a merely mismatched entry; kernel failures propagate to the
+        prewarm manager, which records and continues. Bound-state goal
+        chains (e.g. broker-set mappings) rebuild from their signature
+        specs, and mesh-sharded optimizers warm the sharded chain
+        programs (_prewarm_shape_sharded) — both round-18 gaps closed in
+        round 20."""
         import jax
         from ..utils.flight_recorder import FLIGHT
         from ..warmstart import synthetic_masks, synthetic_state
@@ -1183,12 +1187,16 @@ class GoalOptimizer:
             stack_states, strip_mutable,
         )
         from .goals import ALL_GOALS
-        if self._mesh is not None:
-            return False
         names = entry.get("goals") or []
-        if not names or any(n not in ALL_GOALS for n in names):
+        if not names:
             return False
-        goals = tuple(ALL_GOALS[n]() for n in names)
+        try:
+            from ..warmstart import goal_from_spec
+            goals = tuple(goal_from_spec(s, ALL_GOALS) for s in names)
+        except Exception:  # noqa: BLE001 — unknown/irreproducible spec
+            return False
+        if self._mesh is not None:
+            return self._prewarm_shape_sharded(entry, goals)
         state = synthetic_state(entry)
         masks = synthetic_masks(entry)
         num_topics = int(entry["num_topics"])
@@ -1303,6 +1311,78 @@ class GoalOptimizer:
         else:
             wait(chain_swap_rounds(state, idx, prior, goals, constraint,
                                    num_topics, masks))
+        return True
+
+    def _prewarm_shape_sharded(self, entry: dict, goals: tuple) -> bool:
+        """Mesh analogue of ``prewarm_shape`` (the round-18 documented
+        gap): compile the sharded chain programs THIS process would run
+        for the entry's shape by executing them on an inert sharded
+        synthetic model — the whole-chain ``_make_chain_full`` program at
+        fused scale, the per-goal phase kernels (donated or plain,
+        matching the megastep donation mode) past fused.max.brokers,
+        mirroring ``_optimize``'s mesh-branch selection exactly.
+        Megabatch entries (batch > 0) are single-device machinery and
+        stay unreproducible under the mesh, as are shapes whose
+        partition axis does not divide the mesh (the _optimize fallback
+        would run them single-device anyway). Deficit-sized wide kernels
+        still compile lazily at their pow2-quantized widths — sizing
+        depends on live violation counts no signature can know."""
+        import jax
+
+        from ..parallel import shard_cluster
+        from ..parallel.chain_sharded import (
+            _make_chain_full, _make_chain_phase_kernels,
+        )
+        from ..warmstart import synthetic_masks, synthetic_state
+        from .chain import donation_enabled, strip_mutable
+        mesh = self._mesh
+        if int(entry.get("batch") or 0) > 0:
+            return False
+        state = synthetic_state(entry)
+        if state.num_partitions % mesh.devices.size != 0:
+            return False
+        masks = synthetic_masks(entry)
+        num_topics = int(entry["num_topics"])
+        cfg = self.search_config(state)
+        presence = (masks.excluded_topics is not None,
+                    masks.excluded_replica_move_brokers is not None,
+                    masks.excluded_leadership_brokers is not None)
+
+        def wait(out):
+            jax.tree.map(lambda x: x.block_until_ready()
+                         if hasattr(x, "block_until_ready") else x, out)
+
+        sharded = shard_cluster(state, mesh)
+        bounded = (self._fused_max_brokers > 0
+                   and state.num_brokers > self._fused_max_brokers)
+        if not bounded:
+            fn = _make_chain_full(mesh, goals, self._constraint, cfg,
+                                  num_topics, presence, 8, 64)
+            wait(fn(sharded, masks))
+            return True
+        megastep = self._megastep_config(state.num_brokers)
+        donate = donation_enabled(megastep)
+        move, swap, stats, move_d, swap_d = _make_chain_phase_kernels(
+            mesh, goals, self._constraint, cfg, num_topics, presence,
+            8, 64)
+        idx = jnp.int32(0)
+        prior = jnp.asarray([False] * len(goals))
+        zero = jnp.int32(0)
+        wait(stats(sharded, masks, idx))
+        if donate:
+            a, ls, *_ = move_d(jnp.copy(sharded.assignment),
+                               jnp.copy(sharded.leader_slot),
+                               strip_mutable(sharded), masks, idx, prior,
+                               zero)
+            wait((a, ls))
+            a, ls, *_ = swap_d(jnp.copy(sharded.assignment),
+                               jnp.copy(sharded.leader_slot),
+                               strip_mutable(sharded), masks, idx, prior,
+                               zero)
+            wait((a, ls))
+        else:
+            wait(move(sharded, masks, idx, prior, zero))
+            wait(swap(sharded, masks, idx, prior, zero))
         return True
 
     @staticmethod

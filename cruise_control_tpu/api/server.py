@@ -25,6 +25,11 @@ from ..config.cruise_control_config import CruiseControlConfig
 from ..facade import CruiseControl
 from ..fleet.registry import ClusterPausedError, UnknownClusterError
 from ..monitor.load_monitor import NotEnoughValidWindowsError
+from ..serving import (
+    AdmissionController, AdmissionShedError, AsyncTaskEngine, ResponseCache,
+    TaskQueueFullError, canonical_params, task_class_of,
+)
+from ..serving.cache import CACHEABLE_ENDPOINTS, COALESCIBLE_ENDPOINTS
 from ..utils.resilience import BreakerOpenError
 from . import responses
 from .endpoints import REVIEWABLE_ENDPOINTS, EndPoint, endpoint_for_path
@@ -66,6 +71,14 @@ _SOLVER_ENDPOINTS = {
     EndPoint.FIX_OFFLINE_REPLICAS, EndPoint.TOPIC_CONFIGURATION,
     EndPoint.REMOVE_DISKS, EndPoint.COMPARE_FUTURES,
 }
+
+# Async endpoints whose work is a cluster-model BUILD (device transfers +
+# stats kernels, no solver search). In fleet mode these run through the
+# FleetScheduler too (round 20, ROADMAP item 4 tail) so the handler layer
+# never touches the device directly — but they stay outside
+# _SOLVER_ENDPOINTS: reads keep working against a PAUSED cluster, and the
+# breaker treats them as monitor traffic.
+_MODEL_BUILD_ENDPOINTS = {EndPoint.LOAD, EndPoint.PARTITION_LOAD}
 
 
 # Proposal-executing endpoints gated by request.reason.required (the
@@ -160,6 +173,23 @@ class CruiseControlApi:
                 (CC_MONITOR, "completed.cruise.control.monitor.user.task.retention.time.ms"),
                 (CC_ADMIN, "completed.cruise.control.admin.user.task.retention.time.ms"))
             if cfg.get(key) is not None}
+        # Serving front door (round 20): the unified async task engine
+        # (bounded per-class queues), the model-generation response
+        # cache, cross-user coalescing, and queue-depth admission.
+        self._engine = AsyncTaskEngine(
+            viewer_capacity=cfg.get_int("serving.task.queue.viewer.capacity"),
+            solver_capacity=cfg.get_int("serving.task.queue.solver.capacity"),
+            viewer_threads=cfg.get_int("serving.task.viewer.threads"),
+            solver_threads=cfg.get_int("serving.task.solver.threads"))
+        self._response_cache = ResponseCache(
+            max_entries=cfg.get_int("serving.cache.max.entries"),
+            enabled=cfg.get_boolean("serving.cache.enabled"),
+            cache_state=cfg.get_boolean("serving.cache.state.enabled"))
+        self._coalesce_enabled = cfg.get_boolean("serving.coalesce.enabled")
+        self._admission = AdmissionController(
+            viewer_max=cfg.get_int("serving.admission.queue.viewer.max"),
+            solver_max=cfg.get_int("serving.admission.queue.solver.max"),
+            enabled=cfg.get_boolean("serving.admission.enabled"))
         self._tasks = UserTaskManager(
             max_active_tasks=cfg.get_int("max.active.user.tasks"),
             completed_retention_ms=cfg.get_long(
@@ -174,7 +204,8 @@ class CruiseControlApi:
                 "max.cached.completed.cruise.control.monitor.user.tasks"),
             max_cached_completed_cc_admin_tasks=cfg.get_int(
                 "max.cached.completed.cruise.control.admin.user.tasks"),
-            retention_ms_by_class=retention_overrides)
+            retention_ms_by_class=retention_overrides,
+            engine=self._engine)
         self._async_wait_s = cfg.get_long(
             "webserver.request.maxBlockTimeMs") / 1000.0
         self._reason_required = cfg.get_boolean("request.reason.required")
@@ -264,6 +295,28 @@ class CruiseControlApi:
 
     def shutdown(self) -> None:
         self._tasks.shutdown()
+        self._engine.shutdown()
+
+    @property
+    def task_engine(self) -> AsyncTaskEngine:
+        return self._engine
+
+    @property
+    def response_cache(self) -> ResponseCache:
+        return self._response_cache
+
+    @property
+    def admission(self) -> AdmissionController:
+        return self._admission
+
+    def serving_stats(self) -> dict:
+        """One snapshot of the serving front door's counters — what the
+        load harness reads before/after a run (engine queues and service
+        rates, cache hits/misses, coalesced joins, per-class sheds)."""
+        return {"engine": self._engine.stats(),
+                "cache": self._response_cache.stats(),
+                "coalesced": self._tasks.coalesced,
+                "admission": self._admission.stats()}
 
     # -- the dispatch pipeline ---------------------------------------------
     def handle(self, method: str, path: str, query_string: str = "",
@@ -366,6 +419,12 @@ class CruiseControlApi:
         except ApiError as e:
             return e.status, self._error(str(e)), out_headers
         except TooManyUserTasksError as e:
+            return 429, self._error(str(e)), out_headers
+        except (AdmissionShedError, TaskQueueFullError) as e:
+            # Serving admission (round 20): overload sheds BEFORE a task
+            # exists, with a Retry-After derived from the observed
+            # per-class service rate.
+            out_headers["Retry-After"] = str(max(1, int(e.retry_after_s + 0.5)))
             return 429, self._error(str(e)), out_headers
         except TaskOwnershipError as e:
             return 403, self._error(str(e)), out_headers
@@ -543,6 +602,38 @@ class CruiseControlApi:
             def futures_live():
                 from ..futures.evaluator import live_seed_from
                 return live_seed_from(cc)
+        # Serving front door (round 20): on a NEW request (no User-Task-ID
+        # presented), try the generation-keyed response cache, build the
+        # coalescing key, and run admission — in that order, so a cache
+        # hit or a coalesced join is never shed (neither consumes solver
+        # capacity). Polls of existing tasks skip all three.
+        resume_id = headers.get(USER_TASK_HEADER)
+        store_key = coalesce_key = None
+        if resume_id is None:
+            identity = self._response_identity(cc, cluster_id)
+            if identity is not None:
+                generation, fingerprint = identity
+                pkey = canonical_params(endpoint.name, p,
+                                        allowed=CACHEABLE_ENDPOINTS)
+                if pkey is not None:
+                    store_key = (cluster_id, endpoint.name, pkey,
+                                 generation, fingerprint)
+                    cached = self._response_cache.get(store_key)
+                    if cached is not None:
+                        out_headers["X-Serving-Cache"] = "hit"
+                        return cached
+                if self._coalesce_enabled:
+                    ckey_params = canonical_params(
+                        endpoint.name, p, allowed=COALESCIBLE_ENDPOINTS)
+                    if ckey_params is not None:
+                        coalesce_key = (cluster_id, endpoint.name,
+                                        ckey_params, generation,
+                                        fingerprint)
+            if not self._tasks.has_inflight(coalesce_key):
+                klass = task_class_of(endpoint.name)
+                self._admission.admit(
+                    klass, self._engine.queue_depth(klass),
+                    self._engine.service_time_s(klass))
         work = self._async_work(endpoint, p, cc, futures_req=futures_req,
                                 futures_live=futures_live)
         if cluster_id is not None:
@@ -556,9 +647,21 @@ class CruiseControlApi:
         work = self._schedule_fleet_work(endpoint, cluster_id, work, cc, p,
                                          futures_req=futures_req,
                                          futures_live=futures_live)
+        if store_key is not None:
+            # Outermost wrapper (outside the fleet scheduling) so the
+            # cached body is the FINAL envelope whichever path produced
+            # it — solo work, scheduled job, or coalesced futures payload.
+            caching_inner = work
+
+            def work(inner=caching_inner, key=store_key):
+                body = inner()
+                self._response_cache.put(key, body)
+                return body
+
         info = self._tasks.get_or_create_task(
             endpoint.name, query_string, work,
-            task_id=headers.get(USER_TASK_HEADER), client=principal.name)
+            task_id=resume_id, client=principal.name,
+            coalesce_key=coalesce_key)
         out_headers[USER_TASK_HEADER] = info.task_id
         try:
             exc = info.future.exception(timeout=self._async_wait_s)
@@ -581,6 +684,22 @@ class CruiseControlApi:
             raise ApiError(500, f"{type(exc).__name__}: {exc}")
         return info.future.result()
 
+    @staticmethod
+    def _response_identity(cc: CruiseControl,
+                           cluster_id: str | None) -> tuple | None:
+        """(load-model generation, goal-chain fingerprint) — the serving
+        cache/coalescing identity (round 20) — or None when the facade
+        cannot provide one (a plugin facade without a monitor, say):
+        without an identity nothing is cached or coalesced, never the
+        other way around."""
+        try:
+            generation = int(cc.load_monitor.model_generation)
+            from ..fleet.megabatch import solver_config_fingerprint
+            fingerprint = solver_config_fingerprint(cc.config)
+        except Exception:  # noqa: BLE001 — identity is best-effort
+            return None
+        return generation, fingerprint
+
     def _schedule_fleet_work(self, endpoint: EndPoint,
                              cluster_id: str | None, work,
                              cc: CruiseControl | None = None,
@@ -593,9 +712,13 @@ class CruiseControlApi:
         device itself is shared under the scheduler's priorities and
         starvation bound. Inline when no worker is draining (embedded or
         test schedulers) — blocking on a future nobody serves would hang
-        the task forever."""
+        the task forever. Model-build reads (_MODEL_BUILD_ENDPOINTS,
+        round 20) schedule too — the handler layer no longer touches the
+        device at all — but keep their monitor-class semantics (no pause
+        gate, no breaker accounting as solver traffic)."""
         if cluster_id is None or self._fleet is None \
-                or endpoint not in _SOLVER_ENDPOINTS:
+                or (endpoint not in _SOLVER_ENDPOINTS
+                    and endpoint not in _MODEL_BUILD_ENDPOINTS):
             return work
         sched = self._fleet.scheduler
         if sched is None or not sched.running:
@@ -773,9 +896,28 @@ class CruiseControlApi:
                             "fleet is back up")
             return _forecast_work()
         if endpoint is EndPoint.STATE:
-            return responses.envelope(cc.state(
+            key = None
+            if self._response_cache.cache_state:
+                # Opt-in only (serving.cache.state.enabled): /state is
+                # NOT generation-pure — executor progress and anomaly
+                # state move without a model-generation bump, so this
+                # trades freshness for poll throughput, explicitly.
+                cid = self._fleet.cluster_id_of(cc) \
+                    if self._fleet is not None else None
+                identity = self._response_identity(cc, cid)
+                if identity is not None:
+                    key = (cid, "STATE",
+                           tuple(sorted((k, repr(v))
+                                        for k, v in p.items())),
+                           *identity)
+                    cached = self._response_cache.get(key)
+                    if cached is not None:
+                        return cached
+            body = responses.envelope(cc.state(
                 p.get("substates", ()),
                 super_verbose=p.get("super_verbose", False)))
+            self._response_cache.put(key, body)
+            return body
         if endpoint is EndPoint.KAFKA_CLUSTER_STATE:
             return responses.kafka_cluster_state(cc._admin, p.get("topic", ""))
         if endpoint is EndPoint.USER_TASKS:

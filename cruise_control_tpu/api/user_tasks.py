@@ -17,6 +17,7 @@ from dataclasses import dataclass
 from typing import Any, Callable
 
 from ..utils.progress import OperationProgress, set_current
+from ..utils.sensors import SENSORS
 
 USER_TASK_HEADER = "User-Task-ID"
 
@@ -71,6 +72,9 @@ class UserTaskInfo:
     client: str = ""
     status_override: str | None = None
     progress: OperationProgress | None = None
+    # Round-20 serving engine lifecycle record (serving.tasks.EngineTask);
+    # a COALESCED task shares its leader's record, like its future.
+    engine_task: Any = None
 
     @property
     def status(self) -> str:
@@ -91,6 +95,11 @@ class UserTaskInfo:
                "RequestURL": f"{self.endpoint}?{self.query}",
                "Status": self.status, "StartMs": self.start_ms,
                "ClientIdentity": self.client}
+        if self.engine_task is not None:
+            # queued|running|done|failed|evicted — the engine's finer
+            # lifecycle alongside the reference-shaped Status.
+            out["TaskLifecycle"] = self.engine_task.lifecycle
+            out["TaskClass"] = self.engine_task.klass.value
         if self.progress is not None:
             out["Progress"] = self.progress.to_list()
         return out
@@ -105,13 +114,25 @@ class UserTaskManager:
                  max_cached_completed_tasks: int = 100,
                  max_cached_completed_cc_monitor_tasks: int | None = None,
                  max_cached_completed_cc_admin_tasks: int | None = None,
-                 retention_ms_by_class: dict | None = None):
+                 retention_ms_by_class: dict | None = None,
+                 engine=None):
         """The monitor/admin caps apply to the Kafka-facing classes; the
         Cruise-Control-facing classes default to the same caps unless given
         their own (max.cached.completed.cruise.control.*.user.tasks).
         ``retention_ms_by_class`` overrides the default retention per task
-        class (completed.<class>.user.task.retention.time.ms)."""
-        self._lock = threading.Lock()
+        class (completed.<class>.user.task.retention.time.ms).
+        ``engine`` (serving.tasks.AsyncTaskEngine, round 20) replaces the
+        undifferentiated thread pool with bounded per-class queues; the
+        202/User-Task-ID protocol, session binding, and retention caches
+        are unchanged. An RLock because the coalescing index is cleared by
+        future done-callbacks that may fire inline under the lock."""
+        self._lock = threading.RLock()
+        self._engine = engine
+        # Cross-user coalescing (round 20): identical concurrent in-flight
+        # requests (same cluster, endpoint, canonical params, generation,
+        # goal chain) share ONE solve — key -> leader task id.
+        self._inflight: dict[tuple, str] = {}
+        self.coalesced = 0
         self._tasks: dict[str, UserTaskInfo] = {}
         self._max_active = max_active_tasks
         self._retention_ms = completed_retention_ms
@@ -133,13 +154,20 @@ class UserTaskManager:
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
 
+    def _drop_locked(self, tid: str) -> None:
+        del self._tasks[tid]
+        if self._engine is not None:
+            # The engine record outlives the dropped RESULT: a late poll
+            # of the id sees lifecycle "evicted" on GET /user_tasks.
+            self._engine.evict(tid)
+
     def _expire_locked(self) -> None:
         now = int(time.time() * 1000)
         for tid in [t for t, info in self._tasks.items()
                     if info.future.done()
                     and now - info.start_ms > self._retention_by_class.get(
                         info.task_class, self._retention_ms)]:
-            del self._tasks[tid]
+            self._drop_locked(tid)
         # Per-endpoint-class completed caches: keep the newest N completed
         # tasks of each of the four classes (UserTaskManager.java:69-138).
         for cls, cap in self._max_completed.items():
@@ -147,20 +175,38 @@ class UserTaskManager:
                            if t.future.done() and t.task_class == cls),
                           key=lambda t: -t.start_ms)
             for info in done[cap:]:
-                del self._tasks[info.task_id]
+                self._drop_locked(info.task_id)
         # Overall completed bound on top of the per-class caches
         # (max.cached.completed.user.tasks).
         done = sorted((t for t in self._tasks.values() if t.future.done()),
                       key=lambda t: -t.start_ms)
         for info in done[self._max_completed_total:]:
-            del self._tasks[info.task_id]
+            self._drop_locked(info.task_id)
+
+    def has_inflight(self, coalesce_key: tuple | None) -> bool:
+        """True when an ACTIVE task already serves this coalescing key —
+        the admission layer never sheds a request that would only attach
+        to an existing solve."""
+        if coalesce_key is None:
+            return False
+        with self._lock:
+            tid = self._inflight.get(coalesce_key)
+            info = self._tasks.get(tid) if tid else None
+            return info is not None and not info.future.done()
 
     def get_or_create_task(self, endpoint: str, query: str,
                            work: Callable[[], Any],
                            task_id: str | None = None,
-                           client: str = "") -> UserTaskInfo:
+                           client: str = "",
+                           coalesce_key: tuple | None = None,
+                           ) -> UserTaskInfo:
         """Resume the task for a presented User-Task-ID, else submit a new
-        one (UserTaskManager.getOrCreateUserTask:222)."""
+        one (UserTaskManager.getOrCreateUserTask:222). With a
+        ``coalesce_key`` (round 20), an identical concurrent in-flight
+        request ATTACHES instead: the caller gets its OWN session-bound
+        task id whose future (and progress) IS the leader's — one solve,
+        N pollable tasks, capability-token semantics intact (a shared id
+        would 403 every non-leader's poll)."""
         with self._lock:
             self._expire_locked()
             if task_id and task_id in self._tasks:
@@ -182,6 +228,24 @@ class UserTaskManager:
                 # poll (the reference 400s invalid User-Task-IDs too).
                 raise ValueError(
                     f"unknown or expired {USER_TASK_HEADER} {task_id}")
+            if coalesce_key is not None:
+                leader_id = self._inflight.get(coalesce_key)
+                leader = self._tasks.get(leader_id) if leader_id else None
+                if leader is not None and not leader.future.done():
+                    # Attach BEFORE the max-active check: a join consumes
+                    # no worker, no queue slot, no solver time.
+                    tid = str(uuid_mod.uuid4())
+                    info = UserTaskInfo(
+                        task_id=tid, endpoint=endpoint, query=query,
+                        start_ms=int(time.time() * 1000),
+                        future=leader.future, client=client,
+                        progress=leader.progress,
+                        engine_task=leader.engine_task)
+                    self._tasks[tid] = info
+                    self.coalesced += 1
+                    SENSORS.count("serving_coalesced_requests",
+                                  labels={"endpoint": endpoint})
+                    return info
             active = sum(1 for t in self._tasks.values() if not t.future.done())
             if active >= self._max_active:
                 raise TooManyUserTasksError(
@@ -197,11 +261,28 @@ class UserTaskManager:
                     progress.done()
                     token.var.reset(token)
 
+            engine_task = None
+            if self._engine is not None:
+                future, engine_task = self._engine.submit(
+                    endpoint, tracked, task_id=tid)
+            else:
+                future = self._pool.submit(tracked)
             info = UserTaskInfo(task_id=tid, endpoint=endpoint, query=query,
                                 start_ms=int(time.time() * 1000),
-                                future=self._pool.submit(tracked),
-                                client=client, progress=progress)
+                                future=future, client=client,
+                                progress=progress, engine_task=engine_task)
             self._tasks[tid] = info
+            if coalesce_key is not None:
+                self._inflight[coalesce_key] = tid
+
+                def _clear(_f, key=coalesce_key, leader=tid):
+                    # RLock: may fire inline on this thread if the work
+                    # completed synchronously (engine shutdown path).
+                    with self._lock:
+                        if self._inflight.get(key) == leader:
+                            del self._inflight[key]
+
+                future.add_done_callback(_clear)
             return info
 
     def task(self, task_id: str) -> UserTaskInfo | None:

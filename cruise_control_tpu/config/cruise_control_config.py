@@ -566,6 +566,73 @@ def _definition() -> ConfigDef:
              "bucket shape serves any occupancy (occupancy is traced, "
              "never a new compile). More queued compatibles than the "
              "width split into multiple batches.")
+    d.define("serving.task.queue.viewer.capacity", T.INT, 64,
+             Range.at_least(1), I.LOW,
+             "Serving front door (round 20): bound on QUEUED "
+             "VIEWER-class async tasks (cheap reads: load, "
+             "partition_load, ...). A full queue sheds the request with "
+             "429 + Retry-After before any task is created.")
+    d.define("serving.task.queue.solver.capacity", T.INT, 32,
+             Range.at_least(1), I.LOW,
+             "Serving front door: bound on QUEUED SOLVER-class async "
+             "tasks (proposals, rebalance, broker ops, futures — the "
+             "device-heavy endpoints).")
+    d.define("serving.task.viewer.threads", T.INT, 4, Range.at_least(1),
+             I.LOW,
+             "Serving front door: worker threads draining the VIEWER "
+             "task queue.")
+    d.define("serving.task.solver.threads", T.INT, 2, Range.at_least(1),
+             I.LOW,
+             "Serving front door: worker threads draining the SOLVER "
+             "task queue. These threads only WAIT on fleet-scheduler "
+             "futures — the device work itself runs on the scheduler's "
+             "worker, so this bounds concurrent waiters, not compiles.")
+    d.define("serving.cache.enabled", T.BOOLEAN, True, None, I.MEDIUM,
+             "Serving front door: model-generation-keyed response cache. "
+             "A response is identified by (cluster, endpoint, canonical "
+             "params, load-model generation, goal-chain fingerprint) and "
+             "served byte-identical until the generation or the "
+             "configured goal chain moves. Only deterministic "
+             "generation-pure endpoints (proposals, futures) are "
+             "cacheable; cache-busting params (ignore_proposal_cache, "
+             "data_from, what_if, ...) bypass it.")
+    d.define("serving.cache.max.entries", T.INT, 256, Range.at_least(1),
+             I.LOW,
+             "Serving front door: response-cache entry bound (oldest "
+             "evicted first; entries also die with their generation).")
+    d.define("serving.cache.state.enabled", T.BOOLEAN, False, None, I.LOW,
+             "Serving front door: also cache GET /state envelopes. OFF "
+             "by default — executor progress and anomaly-detector state "
+             "move WITHOUT a model-generation bump, so a generation-"
+             "keyed /state cache can serve stale operational truth; "
+             "enable only for dashboards that poll faster than they "
+             "need freshness.")
+    d.define("serving.coalesce.enabled", T.BOOLEAN, True, None, I.MEDIUM,
+             "Serving front door: cross-user request coalescing. "
+             "Identical concurrent in-flight requests (same cluster, "
+             "endpoint, canonical params, generation, goal chain) "
+             "attach to ONE solve — each caller still gets its own "
+             "session-bound User-Task-ID, but every task shares the "
+             "leader's future (the round-15 precompute-coalescing "
+             "contract generalized to user traffic).")
+    d.define("serving.admission.enabled", T.BOOLEAN, True, None, I.MEDIUM,
+             "Serving front door: queue-depth-aware admission control "
+             "layered ABOVE the per-cluster breaker. New work arriving "
+             "while a class queue is past its depth bound is shed with "
+             "429 + Retry-After derived from the observed per-class "
+             "service rate (depth x EWMA service time). Polls of "
+             "existing tasks, cache hits and coalesced joins are never "
+             "shed.")
+    d.define("serving.admission.queue.viewer.max", T.INT, 32,
+             Range.at_least(1), I.LOW,
+             "Serving front door: VIEWER queue depth beyond which new "
+             "viewer requests are shed (must not exceed the queue "
+             "capacity or the capacity bound sheds first).")
+    d.define("serving.admission.queue.solver.max", T.INT, 8,
+             Range.at_least(0), I.LOW,
+             "Serving front door: SOLVER queue depth beyond which new "
+             "solver requests are shed. 0 sheds ALL new solver work — a "
+             "drain valve for maintenance windows.")
     d.define("tracing.enabled", T.BOOLEAN, True, None, I.LOW,
              "Pipeline span tracing (utils.tracing): every operation — "
              "sampling, model build, per-goal solve, execution — records "

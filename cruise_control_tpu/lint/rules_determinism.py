@@ -56,6 +56,16 @@ class DeterminismRule(Rule):
         "cruise_control_tpu/forecast/forecaster.py",
         "cruise_control_tpu/forecast/engine.py",
         "cruise_control_tpu/detector/predictive.py",
+        # Serving front door (round 20): the loadgen arrival schedule is
+        # a pure function of the seed (byte-identical, digest-pinned in
+        # bench_baseline.json); the task engine, response cache, and
+        # admission controller time themselves only through injected
+        # ``monotonic`` seams — an inline clock call in any of them
+        # would desync replayed load tests and cache-identity canaries.
+        "cruise_control_tpu/serving/tasks.py",
+        "cruise_control_tpu/serving/cache.py",
+        "cruise_control_tpu/serving/admission.py",
+        "cruise_control_tpu/serving/loadgen.py",
     )
 
     CLOCK_CALLS = ("time.time", "time.time_ns", "time.monotonic",

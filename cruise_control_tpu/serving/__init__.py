@@ -1,0 +1,36 @@
+"""Serving front door (round 20).
+
+The api layer's user-facing machinery, factored into one subsystem:
+
+- ``tasks``: the unified async task engine — bounded per-class queues
+  (VIEWER-cheap vs SOLVER-heavy), task lifecycle
+  (queued → running → done/failed/evicted), per-class worker pools whose
+  solver threads only WAIT on FleetScheduler futures (device work always
+  runs under the scheduler's fairness, never on a handler thread).
+- ``cache``: the model-generation-keyed response cache — a response's
+  identity is (cluster, endpoint, canonical params, load-model
+  generation, goal-chain fingerprint); byte-identical replays until the
+  generation or the configured chain moves.
+- ``admission``: queue-depth-aware shedding layered above the
+  per-cluster breaker — 429 + Retry-After derived from observed
+  per-class service rates.
+- ``loadgen``: the deterministic load harness — a seeded, wall-clock-free
+  open-loop arrival schedule over a mixed request-class profile, driving
+  the REAL transport-independent api (`bench.py --serving`).
+"""
+
+from .admission import AdmissionController, AdmissionShedError
+from .cache import (
+    CACHEABLE_ENDPOINTS, COALESCIBLE_ENDPOINTS, ResponseCache,
+    canonical_params,
+)
+from .tasks import (
+    AsyncTaskEngine, TaskClass, TaskQueueFullError, task_class_of,
+)
+
+__all__ = [
+    "AdmissionController", "AdmissionShedError", "AsyncTaskEngine",
+    "CACHEABLE_ENDPOINTS", "COALESCIBLE_ENDPOINTS", "ResponseCache",
+    "TaskClass", "TaskQueueFullError", "canonical_params",
+    "task_class_of",
+]

@@ -1,0 +1,64 @@
+"""Queue-depth-aware admission control (round 20 serving front door).
+
+Layered ABOVE the per-cluster circuit breaker: the breaker protects the
+fleet from a FAILING cluster, admission protects the front door from an
+OVERLOADED one. When a class queue is already past its depth bound, new
+work is shed immediately with 429 + Retry-After derived from the
+observed per-class service rate (excess depth x EWMA service time) — the
+client learns exactly when capacity should exist, and the accepted
+requests keep their latency band instead of everyone queueing into
+timeout. Polls of existing tasks, response-cache hits, and coalesced
+joins are never shed: they consume no solver capacity.
+"""
+
+from __future__ import annotations
+
+from ..utils.sensors import SENSORS
+from .tasks import TaskClass
+
+
+class AdmissionShedError(RuntimeError):
+    """Maps to HTTP 429 + Retry-After."""
+
+    def __init__(self, klass: TaskClass, depth: int, max_depth: int,
+                 retry_after_s: float):
+        super().__init__(
+            f"{klass.value} queue depth {depth} over admission bound "
+            f"{max_depth}; request shed — retry in "
+            f"{retry_after_s:.0f}s")
+        self.klass = klass
+        self.depth = depth
+        self.max_depth = max_depth
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionController:
+    def __init__(self, viewer_max: int = 32, solver_max: int = 8,
+                 enabled: bool = True):
+        self.enabled = bool(enabled)
+        self._max = {TaskClass.VIEWER: int(viewer_max),
+                     TaskClass.SOLVER: int(solver_max)}
+        self.shed = {k: 0 for k in TaskClass}
+
+    def max_depth(self, klass: TaskClass) -> int:
+        return self._max[klass]
+
+    def admit(self, klass: TaskClass, depth: int,
+              service_time_s: float) -> None:
+        """Raise AdmissionShedError when the class queue is past its
+        bound; otherwise record the depth gauge and admit."""
+        SENSORS.gauge("serving_queue_depth", float(depth),
+                      labels={"class": klass.value})
+        if not self.enabled or depth < self._max[klass]:
+            return
+        retry = max(1.0,
+                    (depth - self._max[klass] + 1) * float(service_time_s))
+        self.shed[klass] += 1
+        SENSORS.count("serving_requests_shed",
+                      labels={"class": klass.value})
+        raise AdmissionShedError(klass, depth, self._max[klass], retry)
+
+    def stats(self) -> dict:
+        return {"enabled": self.enabled,
+                "maxDepth": {k.value: v for k, v in self._max.items()},
+                "shed": {k.value: v for k, v in self.shed.items()}}

@@ -1,0 +1,109 @@
+"""Model-generation-keyed response cache + coalescing keys (round 20).
+
+The refresh cache's ClusterMeta + metadata generation is the identity of
+everything the solver serves: two requests with the same (cluster,
+endpoint, canonical params, load-model generation, goal-chain
+fingerprint) are answers to the SAME question, and the solver is
+deterministic, so the answer may be replayed byte-identical until the
+generation or the configured chain moves. The cache stores the final
+response envelope (the exact dict ``json.dumps`` serializes), keyed on
+that identity — a hit never re-enters the task engine, the admission
+layer, or the scheduler.
+
+Honest negative: ``/state`` is NOT generation-pure — executor progress
+and anomaly-detector state move without a model-generation bump — so
+state caching is opt-in (serving.cache.state.enabled) and documented as
+a freshness trade, never a default.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+from ..utils.sensors import SENSORS
+
+# Generation-pure endpoints whose whole response is a deterministic
+# function of the cache identity. REBALANCE and the broker operations are
+# deliberately absent: with dryrun=false they mutate the cluster, and
+# even a dry run's purpose is usually a fresh look before acting.
+CACHEABLE_ENDPOINTS = frozenset({"PROPOSALS", "COMPARE_FUTURES"})
+
+# Read-only endpoints whose identical concurrent requests may share ONE
+# in-flight solve (cross-user coalescing): the cacheable set plus the
+# model-build reads.
+COALESCIBLE_ENDPOINTS = CACHEABLE_ENDPOINTS | {"LOAD", "PARTITION_LOAD"}
+
+# Parameters that explicitly ask for fresh work (or route to the
+# simulator twin): their presence disables caching AND coalescing for
+# the request. Every other parameter is part of the canonical key —
+# same params, same answer.
+CACHE_BUSTING_PARAMS = frozenset({"ignore_proposal_cache", "what_if"})
+
+
+def canonical_params(endpoint: str, params: dict,
+                     allowed=COALESCIBLE_ENDPOINTS) -> tuple | None:
+    """Order-independent canonical form of a request's parameters, or
+    None when the request must not be cached/coalesced (endpoint not in
+    ``allowed``, or a cache-busting parameter present)."""
+    if endpoint not in allowed:
+        return None
+    if any(params.get(k) for k in CACHE_BUSTING_PARAMS):
+        return None
+    return tuple(sorted((k, repr(v)) for k, v in params.items()))
+
+
+class ResponseCache:
+    """Bounded generation-keyed response store. Keys are full identity
+    tuples (cluster, endpoint, canonical params, generation,
+    fingerprint); values are the response envelope dicts. Entries for a
+    dead generation age out by LRU — they can never be hit again, so no
+    TTL machinery is needed."""
+
+    def __init__(self, max_entries: int = 256, enabled: bool = True,
+                 cache_state: bool = False):
+        self._lock = threading.Lock()
+        self._entries: collections.OrderedDict[tuple, dict] = \
+            collections.OrderedDict()
+        self._max = max(1, int(max_entries))
+        self.enabled = bool(enabled)
+        self.cache_state = bool(cache_state)
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: tuple | None) -> dict | None:
+        if not self.enabled or key is None:
+            return None
+        endpoint = key[1] if len(key) > 1 else ""
+        with self._lock:
+            body = self._entries.get(key)
+            if body is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+        if body is not None:
+            SENSORS.count("serving_cache_hits",
+                          labels={"endpoint": str(endpoint)})
+        else:
+            SENSORS.count("serving_cache_misses",
+                          labels={"endpoint": str(endpoint)})
+        return body
+
+    def put(self, key: tuple | None, body: dict) -> None:
+        if not self.enabled or key is None or not isinstance(body, dict):
+            return
+        with self._lock:
+            self._entries[key] = body
+            self._entries.move_to_end(key)
+            while len(self._entries) > self._max:
+                self._entries.popitem(last=False)
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "hits": self.hits,
+                    "misses": self.misses, "enabled": self.enabled}

@@ -1,0 +1,290 @@
+"""Deterministic load-test harness (round 20 serving front door).
+
+The digital-twin discipline applied to HTTP: the arrival SCHEDULE is a
+pure function of the seed — a crc32-derived open-loop Poisson process
+over a mixed request-class profile, generated entirely in virtual time
+(wall-clock-free, byte-identical per seed, digestable) — while the
+EXECUTION drives the real transport-independent
+``CruiseControlApi.handle`` with genuine thread concurrency. Latency is
+observed through the injected ``monotonic`` seam (CCSA004: the schedule
+never depends on it; only the measured report does, and a measurement IS
+machine-dependent by nature — the SLO bands pinned in
+bench_baseline.json absorb that).
+
+The report carries everything the SERVING CI row judges: per-class
+p50/p99 latency, throughput, shed rate (429s with Retry-After),
+response-status histogram, and per-profile-entry body digests for
+byte-identity canaries against solo solves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import threading
+import time
+import zlib
+from typing import Callable
+
+_U32 = float(0xFFFFFFFF)
+
+URL_PREFIX = "/kafkacruisecontrol"
+
+
+def _u01(seed: int, salt: str, n: int) -> float:
+    """Uniform [0, 1] from the crc32 counter-mode derivation
+    (testing/chaos.py's idiom) — no ``random`` module, no global state."""
+    return zlib.crc32(f"{seed}:{salt}:{n}".encode()) / _U32
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestSpec:
+    """One profile entry: a concrete request plus its class label and
+    sampling weight."""
+
+    name: str
+    method: str = "GET"
+    path: str = f"{URL_PREFIX}/state"
+    query: str = ""
+    klass: str = "VIEWER"
+    weight: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduledRequest:
+    seq: int
+    at_s: float  # virtual arrival time from schedule start
+    spec: RequestSpec
+
+
+def mixed_profile(cluster_ids=()) -> list[RequestSpec]:
+    """The default mixed request-class profile: mostly cheap viewer
+    reads, a steady trickle of solver-heavy proposals — per registered
+    cluster when ids are given, against the default facade otherwise."""
+    suffixes = [f"cluster={cid}" for cid in cluster_ids] or [""]
+    out = []
+    for sfx in suffixes:
+        tag = f":{sfx.split('=', 1)[1]}" if sfx else ""
+        amp = "&" if sfx else ""
+        out.extend([
+            RequestSpec(f"state{tag}", "GET", f"{URL_PREFIX}/state",
+                        sfx, "VIEWER", 4.0),
+            RequestSpec(f"kafka_cluster_state{tag}", "GET",
+                        f"{URL_PREFIX}/kafka_cluster_state", sfx,
+                        "VIEWER", 2.0),
+            RequestSpec(f"load{tag}", "GET", f"{URL_PREFIX}/load", sfx,
+                        "VIEWER", 2.0),
+            RequestSpec(f"user_tasks{tag}", "GET",
+                        f"{URL_PREFIX}/user_tasks", sfx, "VIEWER", 1.0),
+            RequestSpec(f"proposals{tag}", "GET",
+                        f"{URL_PREFIX}/proposals", sfx, "SOLVER", 2.0),
+            RequestSpec(f"proposals_verbose{tag}", "GET",
+                        f"{URL_PREFIX}/proposals",
+                        f"{sfx}{amp}verbose=true", "SOLVER", 1.0),
+        ])
+    return out
+
+
+def generate_schedule(profile: list[RequestSpec], seed: int = 0,
+                      rate_rps: float = 50.0, duration_s: float = 2.0,
+                      ) -> list[ScheduledRequest]:
+    """Open-loop Poisson arrivals in VIRTUAL time: exponential
+    inter-arrival gaps and weighted endpoint picks, both crc32-derived
+    from (seed, counter). Same seed ⇒ byte-identical schedule."""
+    total_w = sum(s.weight for s in profile)
+    if total_w <= 0:
+        raise ValueError("profile weights must sum to > 0")
+    out: list[ScheduledRequest] = []
+    t = 0.0
+    n = 0
+    while True:
+        u = max(_u01(seed, "gap", n), 1e-9)
+        t += -math.log(u) / max(rate_rps, 1e-9)
+        if t >= duration_s:
+            break
+        pick = _u01(seed, "pick", n) * total_w
+        acc = 0.0
+        spec = profile[-1]
+        for s in profile:
+            acc += s.weight
+            if pick < acc:
+                spec = s
+                break
+        out.append(ScheduledRequest(seq=n, at_s=round(t, 9), spec=spec))
+        n += 1
+    return out
+
+
+def schedule_digest(schedule: list[ScheduledRequest]) -> str:
+    """crc32 of the canonical JSON rendering — the determinism canary
+    pinned in bench_baseline.json."""
+    rows = [[r.seq, f"{r.at_s:.9f}", r.spec.name, r.spec.method,
+             r.spec.path, r.spec.query] for r in schedule]
+    payload = json.dumps(rows, separators=(",", ":"))
+    return f"{zlib.crc32(payload.encode()):08x}"
+
+
+def body_digest(body: dict) -> str:
+    """crc32 of the sorted-key JSON serialization — byte-identity proxy
+    for response-parity canaries."""
+    payload = json.dumps(body, sort_keys=True, default=str)
+    return f"{zlib.crc32(payload.encode()):08x}"
+
+
+@dataclasses.dataclass
+class RequestResult:
+    seq: int
+    name: str
+    klass: str
+    status: int
+    latency_s: float
+    retry_after: bool
+    digest: str
+
+
+@dataclasses.dataclass
+class LoadReport:
+    schedule_digest: str
+    requests: int
+    wall_s: float
+    throughput_rps: float
+    by_status: dict
+    by_class: dict          # klass -> {count, p50_s, p99_s}
+    shed: int               # 429 responses
+    shed_with_retry_after: int
+    shed_rate: float
+    digests: dict           # spec name -> set of 200-response digests
+    results: list
+
+    def to_dict(self) -> dict:
+        return {
+            "schedule_digest": self.schedule_digest,
+            "requests": self.requests,
+            "wall_s": round(self.wall_s, 4),
+            "throughput_rps": round(self.throughput_rps, 2),
+            "by_status": dict(sorted(self.by_status.items())),
+            "by_class": self.by_class,
+            "shed": self.shed,
+            "shed_with_retry_after": self.shed_with_retry_after,
+            "shed_rate": round(self.shed_rate, 4),
+        }
+
+
+def _quantile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def run_schedule(api, schedule: list[ScheduledRequest],
+                 concurrency: int = 8,
+                 headers: dict | None = None,
+                 monotonic: Callable[[], float] = time.monotonic,
+                 ) -> LoadReport:
+    """Execute the schedule against the REAL api: ``concurrency`` worker
+    threads consume requests in arrival ORDER (the open-loop property
+    lives in the schedule — arrivals never wait for completions beyond
+    the worker bound), each measuring its own wall latency through the
+    injected clock seam."""
+    results: list[RequestResult | None] = [None] * len(schedule)
+    cursor = [0]
+    lock = threading.Lock()
+    hdrs = headers or {}
+
+    def worker():
+        while True:
+            with lock:
+                i = cursor[0]
+                if i >= len(schedule):
+                    return
+                cursor[0] = i + 1
+            req = schedule[i]
+            t0 = monotonic()
+            status, body, out_headers = api.handle(
+                req.spec.method, req.spec.path, req.spec.query,
+                dict(hdrs), "loadgen")
+            dt = monotonic() - t0
+            results[i] = RequestResult(
+                seq=req.seq, name=req.spec.name, klass=req.spec.klass,
+                status=int(status), latency_s=dt,
+                retry_after="Retry-After" in out_headers,
+                digest=body_digest(body) if status == 200 else "")
+
+    t_start = monotonic()
+    threads = [threading.Thread(target=worker, name=f"loadgen-{i}",
+                                daemon=True)
+               for i in range(max(1, concurrency))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = max(monotonic() - t_start, 1e-9)
+
+    done = [r for r in results if r is not None]
+    by_status: dict[int, int] = {}
+    by_class: dict[str, dict] = {}
+    digests: dict[str, set] = {}
+    shed = shed_ra = 0
+    lat: dict[str, list[float]] = {}
+    for r in done:
+        by_status[r.status] = by_status.get(r.status, 0) + 1
+        lat.setdefault(r.klass, []).append(r.latency_s)
+        if r.status == 429:
+            shed += 1
+            if r.retry_after:
+                shed_ra += 1
+        if r.status == 200 and r.digest:
+            digests.setdefault(r.name, set()).add(r.digest)
+    for klass, vals in lat.items():
+        vals.sort()
+        by_class[klass] = {"count": len(vals),
+                           "p50_s": round(_quantile(vals, 0.50), 6),
+                           "p99_s": round(_quantile(vals, 0.99), 6)}
+    return LoadReport(
+        schedule_digest=schedule_digest(schedule),
+        requests=len(done), wall_s=wall,
+        throughput_rps=len(done) / wall,
+        by_status=by_status, by_class=by_class,
+        shed=shed, shed_with_retry_after=shed_ra,
+        shed_rate=shed / max(1, len(done)),
+        digests=digests, results=done)
+
+
+def slo_violations(report: LoadReport, slo: dict) -> list[str]:
+    """Judge a report against an SLO dict — the canary contract for the
+    bench stage. Supported keys: ``max_p99_s`` ({class: seconds}),
+    ``min_throughput_rps``, ``max_shed_rate``, ``min_shed`` (overload
+    arms must actually shed), ``require_retry_after`` (every 429 carries
+    the header), ``max_error_rate`` (non-200/202/429 responses)."""
+    flips: list[str] = []
+    for klass, bound in (slo.get("max_p99_s") or {}).items():
+        got = (report.by_class.get(klass) or {}).get("p99_s", 0.0)
+        if got > bound:
+            flips.append(f"{klass} p99 {got:.3f}s > SLO {bound:.3f}s")
+    min_tp = slo.get("min_throughput_rps")
+    if min_tp is not None and report.throughput_rps < min_tp:
+        flips.append(f"throughput {report.throughput_rps:.1f} rps < "
+                     f"SLO {min_tp:.1f}")
+    max_shed = slo.get("max_shed_rate")
+    if max_shed is not None and report.shed_rate > max_shed:
+        flips.append(f"shed rate {report.shed_rate:.3f} > "
+                     f"SLO {max_shed:.3f}")
+    min_shed = slo.get("min_shed")
+    if min_shed is not None and report.shed < min_shed:
+        flips.append(f"only {report.shed} requests shed; overload arm "
+                     f"expected >= {min_shed}")
+    if slo.get("require_retry_after") and \
+            report.shed_with_retry_after < report.shed:
+        flips.append(f"{report.shed - report.shed_with_retry_after} "
+                     "shed responses missing Retry-After")
+    max_err = slo.get("max_error_rate")
+    if max_err is not None:
+        errors = sum(v for k, v in report.by_status.items()
+                     if k not in (200, 202, 429))
+        rate = errors / max(1, report.requests)
+        if rate > max_err:
+            flips.append(f"error rate {rate:.3f} > SLO {max_err:.3f} "
+                         f"(statuses {report.by_status})")
+    return flips

@@ -1,0 +1,278 @@
+"""Unified async task engine (round 20 serving front door).
+
+Reference parity: servlet/UserTaskManager.java runs every async endpoint
+on one undifferentiated thread pool. At fleet scale that conflates two
+very different request classes: VIEWER reads (load, partition_load — a
+model build at most) and SOLVER requests (proposals, rebalance, futures —
+real device time). The engine gives each class its OWN bounded queue and
+worker pool with an explicit task lifecycle
+(queued → running → done/failed → evicted), so
+
+- queue depth per class is an observable admission signal
+  (serving.admission), not an opaque pool backlog;
+- a flood of solver requests can never exhaust the threads a dashboard's
+  state polls ride on;
+- SOLVER workers only ever WAIT on FleetScheduler futures — the api layer
+  wraps solver work as ON_DEMAND scheduler jobs, so the engine bounds
+  concurrent *waiters* while the device itself stays under the
+  scheduler's fairness and starvation bound.
+
+The engine is deterministic machinery (CCSA004): all timestamps ride the
+injected ``monotonic`` seam, service-rate EWMAs are pure functions of
+observed durations.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import enum
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable
+
+from ..utils.sensors import SENSORS
+
+
+class TaskClass(enum.Enum):
+    VIEWER = "VIEWER"
+    SOLVER = "SOLVER"
+
+
+# Device-heavy endpoints by NAME (the api layer's _SOLVER_ENDPOINTS,
+# mirrored as strings so the engine has no import edge back into api/).
+SOLVER_CLASS_ENDPOINTS = frozenset({
+    "PROPOSALS", "REBALANCE", "ADD_BROKER", "REMOVE_BROKER",
+    "DEMOTE_BROKER", "FIX_OFFLINE_REPLICAS", "TOPIC_CONFIGURATION",
+    "REMOVE_DISKS", "COMPARE_FUTURES",
+})
+
+# Seed service-time estimates until the EWMA has real observations: a
+# viewer read is a model build at most, a solver request is device time.
+_DEFAULT_SERVICE_S = {TaskClass.VIEWER: 0.05, TaskClass.SOLVER: 2.0}
+_EWMA_ALPHA = 0.2
+
+# Finished task records kept for lifecycle queries (GET /user_tasks);
+# oldest evicted past this bound. The RESULTS live in the
+# UserTaskManager's per-class retention caches, not here.
+_MAX_RECORDS = 1024
+
+
+def task_class_of(endpoint: str) -> TaskClass:
+    return TaskClass.SOLVER if endpoint in SOLVER_CLASS_ENDPOINTS \
+        else TaskClass.VIEWER
+
+
+class TaskQueueFullError(RuntimeError):
+    """A class queue at hard capacity — the backstop bound above the
+    admission layer's (softer) depth threshold. Maps to HTTP 429 +
+    Retry-After."""
+
+    def __init__(self, klass: TaskClass, capacity: int,
+                 retry_after_s: float):
+        super().__init__(
+            f"{klass.value} task queue at capacity ({capacity}); "
+            "retry later")
+        self.klass = klass
+        self.capacity = capacity
+        self.retry_after_s = retry_after_s
+
+
+@dataclasses.dataclass
+class EngineTask:
+    """Lifecycle record of one engine submission. ``evicted`` means the
+    UserTaskManager's retention dropped the stored result — the record
+    outlives the result so a late poll sees WHY the id is gone."""
+
+    task_id: str
+    endpoint: str
+    klass: TaskClass
+    lifecycle: str = "queued"  # queued|running|done|failed|evicted
+    enqueued_s: float = 0.0
+    started_s: float = 0.0
+    finished_s: float = 0.0
+
+
+class AsyncTaskEngine:
+    def __init__(self, viewer_capacity: int = 64,
+                 solver_capacity: int = 32,
+                 viewer_threads: int = 4, solver_threads: int = 2,
+                 monotonic: Callable[[], float] = time.monotonic):
+        self._monotonic = monotonic
+        self._cv = threading.Condition()
+        self._shutdown = False
+        self._capacity = {TaskClass.VIEWER: int(viewer_capacity),
+                          TaskClass.SOLVER: int(solver_capacity)}
+        self._queues: dict[TaskClass, collections.deque] = {
+            k: collections.deque() for k in TaskClass}
+        self._records: collections.OrderedDict[str, EngineTask] = \
+            collections.OrderedDict()
+        self._ewma_s: dict[TaskClass, float | None] = {
+            k: None for k in TaskClass}
+        self.completed = {k: 0 for k in TaskClass}
+        self.evicted = 0
+        self._threads: list[threading.Thread] = []
+        counts = {TaskClass.VIEWER: int(viewer_threads),
+                  TaskClass.SOLVER: int(solver_threads)}
+        for klass, n in counts.items():
+            for i in range(n):
+                t = threading.Thread(
+                    target=self._worker, args=(klass,),
+                    name=f"serving-{klass.value.lower()}-{i}", daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    # -- submission --------------------------------------------------------
+    def submit(self, endpoint: str, fn: Callable[[], Any],
+               task_id: str) -> tuple[Future, EngineTask]:
+        """Enqueue ``fn`` on the endpoint's class queue. Raises
+        TaskQueueFullError at capacity. After shutdown the call runs
+        INLINE (the FleetScheduler's submit-after-shutdown discipline:
+        teardown races resolve to synchronous execution, never a hang)."""
+        klass = task_class_of(endpoint)
+        rec = EngineTask(task_id=task_id, endpoint=endpoint, klass=klass,
+                         enqueued_s=self._monotonic())
+        fut: Future = Future()
+        with self._cv:
+            if self._shutdown:
+                self._record_locked(rec)
+                self._run(rec, fn, fut, inline=True)
+                return fut, rec
+            depth = len(self._queues[klass])
+            if depth >= self._capacity[klass]:
+                retry = self._retry_after_locked(klass, depth + 1)
+                raise TaskQueueFullError(klass, self._capacity[klass],
+                                         retry)
+            self._record_locked(rec)
+            self._queues[klass].append((rec, fn, fut))
+            depth += 1
+            # One condition serves BOTH class queues: notify_all, because
+            # a single notify may wake only a worker of the OTHER class,
+            # which re-waits and swallows the wakeup — the queued task
+            # would sit until the next submission.
+            self._cv.notify_all()
+        SENSORS.count("serving_tasks_submitted",
+                      labels={"class": klass.value})
+        SENSORS.gauge("serving_queue_depth", float(depth),
+                      labels={"class": klass.value})
+        return fut, rec
+
+    def _record_locked(self, rec: EngineTask) -> None:
+        self._records[rec.task_id] = rec
+        while len(self._records) > _MAX_RECORDS:
+            self._records.popitem(last=False)
+
+    # -- workers -----------------------------------------------------------
+    def _worker(self, klass: TaskClass) -> None:
+        q = self._queues[klass]
+        while True:
+            with self._cv:
+                while not q and not self._shutdown:
+                    self._cv.wait()
+                if not q:
+                    return  # shutdown with the queue drained
+                rec, fn, fut = q.popleft()
+            self._run(rec, fn, fut)
+
+    def _run(self, rec: EngineTask, fn, fut: Future,
+             inline: bool = False) -> None:
+        if not inline and not fut.set_running_or_notify_cancel():
+            rec.lifecycle = "evicted"
+            return
+        rec.lifecycle = "running"
+        rec.started_s = self._monotonic()
+        try:
+            result = fn()
+        except BaseException as e:  # noqa: BLE001 — future carries it
+            rec.lifecycle = "failed"
+            self._finish(rec)
+            fut.set_exception(e)
+        else:
+            rec.lifecycle = "done"
+            self._finish(rec)
+            fut.set_result(result)
+
+    def _finish(self, rec: EngineTask) -> None:
+        rec.finished_s = self._monotonic()
+        dt = max(0.0, rec.finished_s - rec.started_s)
+        with self._cv:
+            prev = self._ewma_s[rec.klass]
+            self._ewma_s[rec.klass] = dt if prev is None \
+                else (1.0 - _EWMA_ALPHA) * prev + _EWMA_ALPHA * dt
+            self.completed[rec.klass] += 1
+        SENSORS.observe("serving_request_seconds", dt,
+                        labels={"class": rec.klass.value})
+
+    # -- observation / lifecycle -------------------------------------------
+    def queue_depth(self, klass: TaskClass) -> int:
+        with self._cv:
+            return len(self._queues[klass])
+
+    def service_time_s(self, klass: TaskClass) -> float:
+        """EWMA of observed service durations (seeded with a class-typical
+        default until real observations arrive) — the admission layer's
+        Retry-After basis."""
+        with self._cv:
+            est = self._ewma_s[klass]
+        return est if est is not None else _DEFAULT_SERVICE_S[klass]
+
+    def _retry_after_locked(self, klass: TaskClass, depth: int) -> float:
+        est = self._ewma_s[klass]
+        if est is None:
+            est = _DEFAULT_SERVICE_S[klass]
+        workers = max(1, sum(1 for t in self._threads
+                             if t.name.startswith(
+                                 f"serving-{klass.value.lower()}-")))
+        return max(1.0, depth * est / workers)
+
+    def retry_after_s(self, klass: TaskClass, depth: int) -> float:
+        """Seconds until ``depth`` queued tasks of this class should have
+        drained at the observed service rate."""
+        with self._cv:
+            return self._retry_after_locked(klass, depth)
+
+    def lifecycle(self, task_id: str) -> str | None:
+        with self._cv:
+            rec = self._records.get(task_id)
+            return rec.lifecycle if rec is not None else None
+
+    def evict(self, task_id: str) -> None:
+        """Mark a finished task's record evicted (the UserTaskManager's
+        retention dropped its stored result). Unknown ids are a no-op —
+        coalesced joiner ids never had their own engine record."""
+        with self._cv:
+            rec = self._records.get(task_id)
+            if rec is None or rec.lifecycle not in ("done", "failed"):
+                return
+            rec.lifecycle = "evicted"
+            self.evicted += 1
+        SENSORS.count("serving_tasks_evicted",
+                      labels={"class": rec.klass.value})
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "queued": {k.value: len(q)
+                           for k, q in self._queues.items()},
+                "completed": {k.value: v
+                              for k, v in self.completed.items()},
+                "serviceTimeS": {
+                    k.value: self._ewma_s[k]
+                    if self._ewma_s[k] is not None
+                    else _DEFAULT_SERVICE_S[k]
+                    for k in TaskClass},
+                "evicted": self.evicted,
+            }
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._shutdown = True
+            for q in self._queues.values():
+                while q:
+                    rec, _fn, fut = q.popleft()
+                    rec.lifecycle = "evicted"
+                    fut.cancel()
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5.0)

@@ -223,22 +223,69 @@ _MASK_FIELDS = ("excluded_topics", "excluded_replica_move_brokers",
                 "excluded_leadership_brokers")
 
 
+def goal_spec(g) -> str | dict | None:
+    """Reproducible signature spec of ONE goal instance: the bare
+    registry name for a default-constructible goal; a ``{"name",
+    "state"}`` dict when the goal carries bound JSON-round-trippable
+    dataclass state (round 20: bound-broker-set chains prewarm too —
+    the round-18 documented gap); None when the instance cannot be
+    rebuilt equal in a fresh process (then the chain records nothing,
+    as before)."""
+    name = type(g).__name__
+    try:
+        if type(g)() == g:
+            return name
+    except Exception:  # noqa: BLE001 — bound state; try the dict spec
+        pass
+    if not dataclasses.is_dataclass(g):
+        return None
+    try:
+        state = json.loads(json.dumps(dataclasses.asdict(g)))
+    except (TypeError, ValueError):
+        return None
+    spec = {"name": name, "state": state}
+    try:
+        from .analyzer.goals import ALL_GOALS
+        # The spec is only a spec if it round-trips to an EQUAL instance
+        # — anything lossy (non-tuple containers, derived fields) must
+        # fall back to recording nothing rather than prewarming a
+        # different program.
+        if goal_from_spec(spec, ALL_GOALS) != g:
+            return None
+    except Exception:  # noqa: BLE001 — unregistered/unbuildable goal
+        return None
+    return spec
+
+
+def goal_from_spec(spec: str | dict, registry: dict):
+    """Rebuild a goal instance from its signature spec (KeyError for
+    names missing from ``registry``). JSON turned the frozen dataclass's
+    tuples into lists; top-level sequence fields convert back."""
+    if isinstance(spec, str):
+        return registry[spec]()
+    cls = registry[spec["name"]]
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in spec.get("state", {}):
+            v = spec["state"][f.name]
+            kwargs[f.name] = tuple(v) if isinstance(v, list) else v
+    return cls(**kwargs)
+
+
 def shape_signature(state, num_topics: int, goal_chain, masks,
                     batch: int = 0) -> dict | None:
     """JSON-serializable identity of one solved shape: every tensor
     field's (shape, dtype), the mask layout, the goal chain (by
-    registry name — only DEFAULT-constructible goals are reproducible in
-    a fresh process; chains with bound state record nothing), and the
-    megabatch width. Enough to rebuild an inert synthetic model and
-    re-compile the exact kernel set."""
+    registry name, or ``goal_spec`` dicts for goals with bound
+    JSON-round-trippable state; chains with irreproducible state record
+    nothing), and the megabatch width. Enough to rebuild an inert
+    synthetic model and re-compile the exact kernel set."""
     names = []
     for g in goal_chain:
-        try:
-            if type(g)() != g:
-                return None
-        except Exception:  # noqa: BLE001 — non-default goal ctor
+        spec = goal_spec(g)
+        if spec is None:
             return None
-        names.append(type(g).__name__)
+        names.append(spec)
     tensors = {}
     for f in dataclasses.fields(state):
         arr = getattr(state, f.name)

@@ -1,0 +1,28 @@
+"""CCSA004 fixture: a loadgen-shaped module that derives arrival gaps
+from the wall clock and endpoint picks from the global ``random`` state
+(tests lint this file under the spoofed
+cruise_control_tpu/serving/loadgen.py path — the round-20 load-test
+schedule is a pure function of the seed and its digest is pinned in
+bench_baseline.json, so any inline clock/random call desyncs replays;
+latency measurement rides the injected ``monotonic`` seam)."""
+
+import random
+import time
+
+
+def bad_arrival_gap() -> float:
+    return time.time()                   # finding: wall clock inline
+
+
+def bad_endpoint_pick() -> float:
+    return random.random()               # finding: global random state
+
+
+def injected_latency(monotonic=time.monotonic) -> float:
+    return monotonic()                   # clean: reference is the seam
+
+
+def timed_run() -> float:
+    # ccsa: ok[CCSA004] fixture: observability-only harness wall time,
+    # never enters the schedule or any digest
+    return time.perf_counter()

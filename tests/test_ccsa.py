@@ -241,6 +241,31 @@ def test_ccsa_covers_forecast_modules():
         assert not real_active, [f.message for f in real_active]
 
 
+def test_ccsa_covers_serving_modules():
+    """The round-20 serving front door sits under CCSA004's deterministic
+    contract: the loadgen schedule is a pure function of the seed (its
+    digest is pinned in bench_baseline.json) and the engine/cache/
+    admission layers time themselves through injected ``monotonic``
+    seams only — wall clock and global randomness are findings under the
+    serving paths, the injected-seam reference and the documented
+    observability suppression stay legal, and the REAL modules verify
+    clean."""
+    spoofed = ctx_for(FIXTURES / "bad_serving_loadgen.py",
+                      "cruise_control_tpu/serving/loadgen.py")
+    active, suppressed = findings_of("CCSA004", spoofed)
+    assert len(active) == 2           # time.time() + random.random()
+    assert len(suppressed) == 1       # the documented perf_counter probe
+    assert any("time.time" in f.message for f in active)
+    assert any("random.random" in f.message for f in active)
+    for rel in ("cruise_control_tpu/serving/tasks.py",
+                "cruise_control_tpu/serving/cache.py",
+                "cruise_control_tpu/serving/admission.py",
+                "cruise_control_tpu/serving/loadgen.py"):
+        ctx = ctx_for(ROOT / rel, rel)
+        real_active, _sup = findings_of("CCSA004", ctx)
+        assert not real_active, [f.message for f in real_active]
+
+
 def test_ccsa004_hash_ban_is_repo_wide_but_clock_is_not():
     plain = ctx_for(FIXTURES / "bad_determinism.py")
     active, suppressed = findings_of("CCSA004", plain)

@@ -1,0 +1,577 @@
+"""Serving front door (round 20): the async task engine's per-class
+queues and lifecycle, the model-generation response cache, cross-request
+coalescing, admission shedding, and the deterministic load-test harness
+— units plus end-to-end byte-identity through the REAL api."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from cruise_control_tpu.api.server import CruiseControlApi
+from cruise_control_tpu.api.user_tasks import (
+    USER_TASK_HEADER, TaskOwnershipError, UserTaskManager,
+)
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+from cruise_control_tpu.executor.admin import InMemoryAdminBackend, PartitionState
+from cruise_control_tpu.executor.executor import Executor
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.fleet import FleetRegistry, FleetScheduler
+from cruise_control_tpu.monitor import LoadMonitor, StaticCapacityResolver
+from cruise_control_tpu.monitor.sampling import SyntheticSampler
+from cruise_control_tpu.serving import (
+    AdmissionController, AdmissionShedError, AsyncTaskEngine, ResponseCache,
+    TaskClass, TaskQueueFullError, canonical_params, task_class_of,
+)
+from cruise_control_tpu.serving import loadgen
+from cruise_control_tpu.serving.cache import CACHEABLE_ENDPOINTS
+
+_WAIT_S = 20.0
+
+
+def _poll(predicate, timeout_s=_WAIT_S):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.005)
+    return predicate()
+
+
+# ---- task engine ---------------------------------------------------------
+
+def test_task_class_mapping():
+    assert task_class_of("PROPOSALS") is TaskClass.SOLVER
+    assert task_class_of("COMPARE_FUTURES") is TaskClass.SOLVER
+    assert task_class_of("REBALANCE") is TaskClass.SOLVER
+    assert task_class_of("LOAD") is TaskClass.VIEWER
+    assert task_class_of("PARTITION_LOAD") is TaskClass.VIEWER
+
+
+def test_engine_lifecycle_and_results():
+    engine = AsyncTaskEngine(viewer_threads=1, solver_threads=1)
+    try:
+        ev = threading.Event()
+        fut, rec = engine.submit("LOAD", lambda: ev.wait(_WAIT_S) and "ok",
+                                 task_id="t-run")
+        assert _poll(lambda: rec.lifecycle == "running")
+        ev.set()
+        assert fut.result(timeout=_WAIT_S) == "ok"
+        assert rec.lifecycle == "done"
+        assert engine.lifecycle("t-run") == "done"
+
+        def boom():
+            raise RuntimeError("kaput")
+
+        fut2, rec2 = engine.submit("PROPOSALS", boom, task_id="t-fail")
+        with pytest.raises(RuntimeError, match="kaput"):
+            fut2.result(timeout=_WAIT_S)
+        assert rec2.lifecycle == "failed"
+        assert rec2.klass is TaskClass.SOLVER
+        assert engine.completed[TaskClass.SOLVER] == 1
+    finally:
+        engine.shutdown()
+
+
+def test_engine_queue_capacity_sheds_with_retry_after():
+    engine = AsyncTaskEngine(viewer_capacity=2, viewer_threads=1,
+                             solver_threads=1)
+    try:
+        ev = threading.Event()
+        _fut, rec = engine.submit("LOAD", lambda: ev.wait(_WAIT_S),
+                                  task_id="blocker")
+        assert _poll(lambda: rec.lifecycle == "running")
+        engine.submit("LOAD", lambda: 1, task_id="q1")
+        engine.submit("LOAD", lambda: 2, task_id="q2")
+        assert engine.queue_depth(TaskClass.VIEWER) == 2
+        with pytest.raises(TaskQueueFullError) as exc:
+            engine.submit("LOAD", lambda: 3, task_id="q3")
+        assert exc.value.klass is TaskClass.VIEWER
+        assert exc.value.capacity == 2
+        assert exc.value.retry_after_s >= 1.0
+        ev.set()
+    finally:
+        engine.shutdown()
+
+
+def test_engine_shutdown_evicts_queued_and_runs_inline_after():
+    engine = AsyncTaskEngine(viewer_threads=1, solver_threads=1)
+    ev = threading.Event()
+    _fut, rec = engine.submit("LOAD", lambda: ev.wait(_WAIT_S),
+                              task_id="hold")
+    assert _poll(lambda: rec.lifecycle == "running")
+    fut2, rec2 = engine.submit("LOAD", lambda: "never", task_id="queued")
+    closer = threading.Thread(target=engine.shutdown, daemon=True)
+    closer.start()
+    assert _poll(lambda: fut2.cancelled())
+    assert rec2.lifecycle == "evicted"
+    ev.set()
+    closer.join(timeout=_WAIT_S)
+    assert not closer.is_alive()
+    # The FleetScheduler discipline: submit after shutdown runs INLINE.
+    fut3, rec3 = engine.submit("PROPOSALS", lambda: 42, task_id="late")
+    assert fut3.result(timeout=0) == 42
+    assert rec3.lifecycle == "done"
+
+
+def test_engine_ewma_service_time_and_retry_after():
+    clock = [0.0]
+
+    def monotonic():
+        return clock[0]
+
+    engine = AsyncTaskEngine(viewer_threads=1, solver_threads=1,
+                             monotonic=monotonic)
+    try:
+        def takes(seconds):
+            def fn():
+                clock[0] += seconds
+            return fn
+
+        engine.submit("LOAD", takes(2.0), task_id="a")[0].result(_WAIT_S)
+        assert engine.service_time_s(TaskClass.VIEWER) == pytest.approx(2.0)
+        engine.submit("LOAD", takes(4.0), task_id="b")[0].result(_WAIT_S)
+        # EWMA(0.2): 0.8 * 2.0 + 0.2 * 4.0
+        assert engine.service_time_s(TaskClass.VIEWER) == pytest.approx(2.4)
+        # depth * est / workers, floored at 1s.
+        assert engine.retry_after_s(TaskClass.VIEWER, 2) \
+            == pytest.approx(4.8)
+        assert engine.retry_after_s(TaskClass.VIEWER, 0) == 1.0
+        # SOLVER never observed: seeded default, not the viewer EWMA.
+        assert engine.service_time_s(TaskClass.SOLVER) == pytest.approx(2.0)
+    finally:
+        engine.shutdown()
+
+
+def test_engine_evict_marks_done_records_only():
+    engine = AsyncTaskEngine(viewer_threads=1, solver_threads=1)
+    try:
+        fut, rec = engine.submit("LOAD", lambda: 1, task_id="gone")
+        fut.result(timeout=_WAIT_S)
+        engine.evict("gone")
+        assert rec.lifecycle == "evicted"
+        assert engine.evicted == 1
+        engine.evict("gone")           # idempotent
+        engine.evict("never-existed")  # unknown ids are a no-op
+        assert engine.evicted == 1
+        assert engine.stats()["evicted"] == 1
+    finally:
+        engine.shutdown()
+
+
+# ---- response cache + canonical params -----------------------------------
+
+def test_canonical_params_order_independent_and_busting():
+    a = canonical_params("PROPOSALS", {"goals": ("G1",), "verbose": True})
+    b = canonical_params("PROPOSALS", {"verbose": True, "goals": ("G1",)})
+    assert a == b and a is not None
+    assert canonical_params("PROPOSALS", {}) == ()
+    # Cache-busting parameters disable the whole identity.
+    assert canonical_params(
+        "PROPOSALS", {"ignore_proposal_cache": True}) is None
+    assert canonical_params("COMPARE_FUTURES", {"what_if": True}) is None
+    assert canonical_params(
+        "PROPOSALS", {"ignore_proposal_cache": False}) is not None
+    # Endpoint scoping: LOAD coalesces but is not in the cacheable set;
+    # mutating endpoints are in neither.
+    assert canonical_params("LOAD", {}) == ()
+    assert canonical_params("LOAD", {}, allowed=CACHEABLE_ENDPOINTS) is None
+    assert canonical_params("REBALANCE", {}) is None
+
+
+def test_response_cache_lru_and_counters():
+    cache = ResponseCache(max_entries=2)
+    k1 = ("c", "PROPOSALS", (), 1, ("G",))
+    k2 = ("c", "PROPOSALS", (("verbose", "True"),), 1, ("G",))
+    k3 = ("c", "COMPARE_FUTURES", (), 1, ("G",))
+    assert cache.get(k1) is None
+    cache.put(k1, {"v": 1})
+    cache.put(k2, {"v": 2})
+    assert cache.get(k1) == {"v": 1}
+    cache.put(k3, {"v": 3})            # evicts k2 (LRU; k1 was touched)
+    assert cache.get(k2) is None
+    assert cache.get(k1) == {"v": 1}
+    assert cache.stats()["entries"] == 2
+    assert cache.hits == 2 and cache.misses == 2
+    cache.put(None, {"v": 9})          # None key is a no-op
+    cache.put(k1, "not-a-dict")        # non-dict body is a no-op
+    assert cache.get(k1) == {"v": 1}
+    assert cache.hits == 3
+    cache.invalidate()
+    assert cache.get(k1) is None
+    disabled = ResponseCache(enabled=False)
+    disabled.put(k1, {"v": 1})
+    assert disabled.get(k1) is None
+    assert disabled.hits == 0 and disabled.misses == 0
+
+
+def test_admission_controller_sheds_past_depth_bound():
+    adm = AdmissionController(viewer_max=4, solver_max=2)
+    adm.admit(TaskClass.SOLVER, 1, 2.0)      # below bound: admitted
+    with pytest.raises(AdmissionShedError) as exc:
+        adm.admit(TaskClass.SOLVER, 2, 2.0)  # at bound: shed
+    assert exc.value.retry_after_s == pytest.approx(2.0)
+    with pytest.raises(AdmissionShedError) as exc:
+        adm.admit(TaskClass.SOLVER, 5, 2.0)  # deeper: longer horizon
+    assert exc.value.retry_after_s == pytest.approx(8.0)
+    assert adm.shed[TaskClass.SOLVER] == 2
+    assert adm.stats()["shed"]["SOLVER"] == 2
+    adm.admit(TaskClass.VIEWER, 3, 0.05)
+    off = AdmissionController(solver_max=0, enabled=False)
+    off.admit(TaskClass.SOLVER, 100, 2.0)    # disabled: always admits
+
+
+# ---- coalescing (UserTaskManager unit) -----------------------------------
+
+def test_user_task_manager_coalesces_identical_inflight_requests():
+    engine = AsyncTaskEngine(viewer_threads=1, solver_threads=1)
+    mgr = UserTaskManager(engine=engine)
+    try:
+        ev = threading.Event()
+        key = ("c", "PROPOSALS", (), 7, ("G",))
+
+        def slow():
+            ev.wait(_WAIT_S)
+            return {"answer": 42}
+
+        def never():
+            raise AssertionError("joiner work must not run")
+
+        leader = mgr.get_or_create_task("PROPOSALS", "q=1", slow,
+                                        client="alice", coalesce_key=key)
+        assert mgr.has_inflight(key)
+        joiner = mgr.get_or_create_task("PROPOSALS", "q=1", never,
+                                        client="bob", coalesce_key=key)
+        assert joiner.task_id != leader.task_id
+        assert joiner.future is leader.future
+        assert joiner.engine_task is leader.engine_task
+        assert mgr.coalesced == 1
+        # Capability tokens stay session-bound: bob cannot poll alice's id.
+        with pytest.raises(TaskOwnershipError):
+            mgr.get_or_create_task("PROPOSALS", "q=1", never,
+                                   task_id=leader.task_id, client="bob")
+        ev.set()
+        assert leader.future.result(timeout=_WAIT_S) == {"answer": 42}
+        assert joiner.future.result(timeout=0) == {"answer": 42}
+        # Completed solves never coalesce: the next identical request is
+        # fresh work (the generation may have moved).
+        after = mgr.get_or_create_task("PROPOSALS", "q=1",
+                                       lambda: {"answer": 43},
+                                       client="carol", coalesce_key=key)
+        assert after.future is not leader.future
+        assert after.future.result(timeout=_WAIT_S) == {"answer": 43}
+        assert not mgr.has_inflight(key)
+    finally:
+        engine.shutdown()
+
+
+# ---- loadgen -------------------------------------------------------------
+
+def test_loadgen_schedule_is_pure_in_the_seed():
+    profile = loadgen.mixed_profile()
+    s1 = loadgen.generate_schedule(profile, seed=0, rate_rps=50.0,
+                                   duration_s=2.0)
+    s2 = loadgen.generate_schedule(profile, seed=0, rate_rps=50.0,
+                                   duration_s=2.0)
+    assert s1 == s2
+    # The digest pinned in bench_baseline.json: crc32 counter-mode means
+    # this value is stable across platforms and Python versions.
+    assert loadgen.schedule_digest(s1) == "3318f2f9"
+    assert len(s1) == 107
+    s3 = loadgen.generate_schedule(profile, seed=1, rate_rps=50.0,
+                                   duration_s=2.0)
+    assert loadgen.schedule_digest(s3) != loadgen.schedule_digest(s1)
+    ts = [r.at_s for r in s1]
+    assert ts == sorted(ts) and 0.0 < ts[0] and ts[-1] < 2.0
+    names = {r.spec.name for r in s1}
+    assert "state" in names and "proposals" in names
+
+
+def test_loadgen_profile_per_cluster():
+    profile = loadgen.mixed_profile(["alpha", "beta"])
+    assert len(profile) == 12
+    byname = {s.name: s for s in profile}
+    assert byname["proposals:alpha"].query == "cluster=alpha"
+    assert byname["proposals_verbose:beta"].query == \
+        "cluster=beta&verbose=true"
+    assert byname["proposals:alpha"].klass == "SOLVER"
+    assert byname["state:beta"].klass == "VIEWER"
+
+
+class _StubApi:
+    """Deterministic stand-in transport: viewer paths answer 200,
+    proposals shed 429 + Retry-After."""
+
+    def handle(self, method, path, query, headers, remote):
+        if "proposals" in path:
+            return 429, {"errorMessage": "shed"}, {"Retry-After": "2"}
+        return 200, {"version": 1, "path": path, "query": query}, {}
+
+
+def test_loadgen_run_schedule_report_and_slo_judgement():
+    profile = loadgen.mixed_profile()
+    schedule = loadgen.generate_schedule(profile, seed=3, rate_rps=40.0,
+                                         duration_s=1.5)
+    report = loadgen.run_schedule(_StubApi(), schedule, concurrency=4)
+    n_solver = sum(1 for r in schedule if r.spec.klass == "SOLVER")
+    assert report.requests == len(schedule)
+    assert report.schedule_digest == loadgen.schedule_digest(schedule)
+    assert report.shed == n_solver
+    assert report.shed_with_retry_after == n_solver
+    assert report.by_status == {200: len(schedule) - n_solver,
+                                429: n_solver}
+    assert set(report.by_class) == {"VIEWER", "SOLVER"}
+    assert report.by_class["VIEWER"]["count"] == len(schedule) - n_solver
+    # The stub is deterministic, so each spec has exactly one 200 digest.
+    assert all(len(d) == 1 for d in report.digests.values())
+    assert report.throughput_rps > 0
+    d = report.to_dict()
+    assert d["shed"] == n_solver and "by_class" in d
+    # SLO judgement: the report passes its own bands and flips on
+    # impossible ones.
+    assert loadgen.slo_violations(report, {
+        "min_shed": 1, "require_retry_after": True,
+        "max_error_rate": 0.0}) == []
+    flips = loadgen.slo_violations(report, {
+        "max_p99_s": {"VIEWER": 0.0},
+        "min_throughput_rps": 1e12,
+        "max_shed_rate": 0.0,
+    })
+    assert len(flips) == 3
+    assert any("p99" in f for f in flips)
+    assert any("throughput" in f for f in flips)
+    assert any("shed rate" in f for f in flips)
+
+
+# ---- end-to-end through the REAL api -------------------------------------
+
+_CAPS = StaticCapacityResolver({}, {Resource.CPU: 100.0, Resource.DISK: 1e7,
+                                    Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6})
+
+
+def _partitions(brokers=(0, 1, 2, 3), topics=2, parts=6):
+    out = {}
+    for t in range(topics):
+        for p in range(parts):
+            reps = (brokers[0], brokers[1 + (t + p) % (len(brokers) - 1)])
+            out[(f"t{t}", p)] = PartitionState(f"t{t}", p, reps, reps[0],
+                                               isr=reps)
+    return out
+
+
+_G = "cruise_control_tpu.analyzer.goals"
+# Serving tests exercise the front door (cache/coalesce/admission), not
+# the goal chain — a short chain keeps the two per-shape compiles cheap.
+# bench.py --serving runs the full default chain.
+_SHORT_CHAIN = [f"{_G}.RackAwareGoal", f"{_G}.ReplicaCapacityGoal",
+                f"{_G}.ReplicaDistributionGoal"]
+
+
+def _base_config(extra=None):
+    return CruiseControlConfig({
+        "goals": _SHORT_CHAIN,
+        "hard.goals": [f"{_G}.RackAwareGoal", f"{_G}.ReplicaCapacityGoal"],
+        "anomaly.detection.goals": _SHORT_CHAIN,
+        "partition.metrics.window.ms": 1000,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "max.solver.rounds": 30,
+        "failed.brokers.file.path": "",
+        "solver.partition.bucket.size": 0,
+        "solver.broker.bucket.size": 0,
+        "fleet.bucket.broker.base": 4,
+        "fleet.bucket.partition.base": 16,
+        **(extra or {})})
+
+
+def _make_cc(config, partitions, optimizer=None):
+    backend = InMemoryAdminBackend(partitions.values())
+    monitor = LoadMonitor(config, backend, samplers=[SyntheticSampler()],
+                          capacity_resolver=_CAPS)
+    cc = CruiseControl(config, backend, load_monitor=monitor,
+                       executor=Executor(backend, synchronous=True))
+    for k in range(1, 4):
+        monitor.task_runner.run_sampling_once(end_ms=k * 1000)
+    return cc
+
+
+@pytest.fixture(scope="module")
+def fleet_api():
+    """Two clusters at two DIFFERENT bucket shapes sharing one api:
+    alpha pads to (8, 64), gamma to (4, 16) — the cache byte-identity
+    claim is pinned at both shapes."""
+    base = _base_config()
+    scheduler = FleetScheduler(starvation_bound_s=30.0)
+    registry = FleetRegistry(base_config=base, scheduler=scheduler)
+    registry.register("alpha", cc=_make_cc(
+        base, _partitions(tuple(range(8)), topics=2, parts=17)))
+    registry.register("gamma", cc=_make_cc(
+        base, _partitions((0, 1, 2, 3), topics=2, parts=6)))
+    api = CruiseControlApi(registry.get("alpha"), fleet=registry)
+    api._async_wait_s = 180
+    yield api, registry
+    api.shutdown()
+    scheduler.shutdown()
+
+
+def test_cache_hit_is_byte_identical_at_two_bucket_shapes(fleet_api):
+    api, _registry = fleet_api
+    api.response_cache.invalidate()
+    for cid in ("alpha", "gamma"):
+        tasks_before = len(api._tasks.all_tasks())
+        s1, b1, h1 = api.handle("GET", "/kafkacruisecontrol/proposals",
+                                f"cluster={cid}")
+        assert s1 == 200, b1
+        assert "X-Serving-Cache" not in h1
+        s2, b2, h2 = api.handle("GET", "/kafkacruisecontrol/proposals",
+                                f"cluster={cid}")
+        assert s2 == 200
+        assert h2.get("X-Serving-Cache") == "hit"
+        assert json.dumps(b1, sort_keys=True) == \
+            json.dumps(b2, sort_keys=True)
+        # A hit never creates a task (no queue slot, no solver time).
+        assert len(api._tasks.all_tasks()) == tasks_before + 1
+    assert api.response_cache.hits >= 2
+
+
+def test_parallel_requests_byte_identical_to_serial(fleet_api):
+    api, _registry = fleet_api
+    api.response_cache.invalidate()
+    s0, solo, _ = api.handle("GET", "/kafkacruisecontrol/proposals",
+                             "cluster=alpha")
+    assert s0 == 200, solo
+    want = json.dumps(solo, sort_keys=True)
+    results = [None] * 6
+
+    def worker(i):
+        results[i] = api.handle("GET", "/kafkacruisecontrol/proposals",
+                                "cluster=alpha")
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(results))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=_WAIT_S * 6)
+    for status, body, _hdrs in results:
+        assert status == 200
+        assert json.dumps(body, sort_keys=True) == want
+
+
+def test_cache_busting_param_skips_the_cache(fleet_api):
+    api, _registry = fleet_api
+    api.response_cache.invalidate()
+    api.handle("GET", "/kafkacruisecontrol/proposals", "cluster=gamma")
+    _s, _b, h = api.handle("GET", "/kafkacruisecontrol/proposals",
+                           "cluster=gamma&ignore_proposal_cache=true")
+    assert "X-Serving-Cache" not in h
+
+
+def test_user_tasks_surface_engine_lifecycle(fleet_api):
+    api, _registry = fleet_api
+    s, _body, _h = api.handle("GET", "/kafkacruisecontrol/load",
+                              "cluster=gamma")
+    assert s == 200
+    s2, tasks, _h2 = api.handle("GET", "/kafkacruisecontrol/user_tasks")
+    assert s2 == 200
+    rows = [t for t in tasks["userTasks"]
+            if t.get("TaskLifecycle") is not None]
+    assert rows, tasks
+    assert any(t["TaskLifecycle"] == "done" and t["TaskClass"] == "VIEWER"
+               for t in rows)
+
+
+@pytest.fixture(scope="module")
+def solo_api():
+    cfg = _base_config()
+    cc = _make_cc(cfg, _partitions())
+    api = CruiseControlApi(cc)
+    api._async_wait_s = 180
+    yield api, cc
+    api.shutdown()
+
+
+def test_identical_inflight_request_attaches_through_dispatch(solo_api):
+    """A real request arriving while an identical solve is in flight
+    coalesces: it returns the LEADER's body under its OWN task id."""
+    api, cc = solo_api
+    api.response_cache.invalidate()
+    identity = CruiseControlApi._response_identity(cc, None)
+    assert identity is not None
+    key = (None, "PROPOSALS", canonical_params("PROPOSALS", {}), *identity)
+    ev = threading.Event()
+    sentinel = {"version": 1, "sentinel": True}
+
+    def slow():
+        ev.wait(_WAIT_S)
+        return sentinel
+
+    before = api._tasks.coalesced
+    leader = api._tasks.get_or_create_task(
+        "PROPOSALS", "", slow, client="someone-else", coalesce_key=key)
+    out = {}
+
+    def request():
+        out["r"] = api.handle("GET", "/kafkacruisecontrol/proposals")
+
+    t = threading.Thread(target=request, daemon=True)
+    t.start()
+    assert _poll(lambda: api._tasks.coalesced > before)
+    ev.set()
+    t.join(timeout=_WAIT_S)
+    assert not t.is_alive()
+    status, body, hdrs = out["r"]
+    assert status == 200
+    assert body == sentinel
+    assert hdrs[USER_TASK_HEADER] != leader.task_id
+    # The joiner's own id polls the shared result; the sentinel never
+    # entered the response cache (only the joiner's discarded closure
+    # would have stored it).
+    s2, b2, _ = api.handle("GET", "/kafkacruisecontrol/proposals", "",
+                           {USER_TASK_HEADER: hdrs[USER_TASK_HEADER]})
+    assert s2 == 200 and b2 == sentinel
+    s3, _b3, h3 = api.handle("GET", "/kafkacruisecontrol/proposals")
+    assert s3 == 200 and "X-Serving-Cache" not in h3
+
+
+@pytest.fixture(scope="module")
+def overloaded_api():
+    """Solver admission bound of zero: every NEW solver request sheds
+    immediately while viewer traffic keeps flowing."""
+    cfg = _base_config({"serving.admission.queue.solver.max": 0,
+                        "serving.coalesce.enabled": False,
+                        "serving.cache.enabled": False})
+    cc = _make_cc(cfg, _partitions())
+    api = CruiseControlApi(cc)
+    api._async_wait_s = 180
+    yield api
+    api.shutdown()
+
+
+def test_overload_sheds_solver_class_with_retry_after(overloaded_api):
+    api = overloaded_api
+    status, body, headers = api.handle(
+        "GET", "/kafkacruisecontrol/proposals")
+    assert status == 429
+    assert "shed" in body["errorMessage"]
+    assert int(headers["Retry-After"]) >= 1
+    # Viewer classes are untouched by the solver bound.
+    assert api.handle("GET", "/kafkacruisecontrol/load")[0] == 200
+    assert api.handle("GET", "/kafkacruisecontrol/state")[0] == 200
+    assert api.admission.stats()["shed"]["SOLVER"] >= 1
+
+
+def test_loadgen_overload_arm_against_real_api(overloaded_api):
+    api = overloaded_api
+    schedule = loadgen.generate_schedule(loadgen.mixed_profile(), seed=5,
+                                         rate_rps=30.0, duration_s=1.0)
+    report = loadgen.run_schedule(api, schedule, concurrency=4)
+    assert report.requests == len(schedule)
+    assert report.shed >= 1
+    assert report.shed_with_retry_after == report.shed
+    assert set(report.by_status) <= {200, 429}
+    assert loadgen.slo_violations(report, {
+        "min_shed": 1, "require_retry_after": True,
+        "max_error_rate": 0.0}) == []

@@ -516,6 +516,104 @@ def _empty_masks():
     return ExclusionMasks()
 
 
+# ---------------------------------------------------------------------------
+# Round-20 prewarm extensions: bound-state goal chains and mesh-sharded
+# solvers (the two documented round-18 gaps).
+
+def test_goal_spec_round_trips_bound_state():
+    import json
+
+    from cruise_control_tpu.analyzer.goals import (
+        ALL_GOALS, BrokerSetAwareGoal, ReplicaDistributionGoal,
+    )
+    # Default-constructible goals keep the compact name-string spec.
+    assert warmstart.goal_spec(ReplicaDistributionGoal()) \
+        == "ReplicaDistributionGoal"
+    assert warmstart.goal_from_spec("ReplicaDistributionGoal", ALL_GOALS) \
+        == ReplicaDistributionGoal()
+    # Bound state records a {"name", "state"} dict that survives the
+    # registry's JSON persistence and rebuilds an EQUAL instance.
+    bound = BrokerSetAwareGoal(broker_sets=(0, 0, 1, 1))
+    spec = warmstart.goal_spec(bound)
+    assert isinstance(spec, dict) and spec["name"] == "BrokerSetAwareGoal"
+    spec = json.loads(json.dumps(spec))
+    assert warmstart.goal_from_spec(spec, ALL_GOALS) == bound
+    with pytest.raises(KeyError):
+        warmstart.goal_from_spec("NoSuchGoal", ALL_GOALS)
+    with pytest.raises(KeyError):
+        warmstart.goal_from_spec({"name": "NoSuchGoal", "state": {}},
+                                 ALL_GOALS)
+
+
+def test_prewarm_covers_bound_broker_set_chains():
+    """A chain carrying a BOUND BrokerSetAwareGoal (the round-18
+    documented gap) records a reproducible signature and prewarms."""
+    import json
+
+    from cruise_control_tpu.analyzer.goals import BrokerSetAwareGoal
+    tmp = tempfile.mkdtemp()
+    cfg = _prewarm_cfg(tmp)
+    opt = GoalOptimizer(cfg)
+    state, meta = _cluster()
+    chain = tuple(goals_by_priority(cfg)) + (
+        BrokerSetAwareGoal(
+            broker_sets=tuple(i % 2 for i in range(state.num_brokers))),)
+    entry = warmstart.shape_signature(state, meta.num_topics, chain,
+                                      _empty_masks(), 0)
+    assert entry is not None
+    assert any(isinstance(s, dict) for s in entry["goals"])
+    # Through the registry's JSON persistence, as a fresh process would
+    # load it.
+    entry = json.loads(json.dumps(entry))
+    assert opt.prewarm_shape(entry) is True
+
+
+def test_prewarm_mesh_sharded_whole_chain():
+    """A mesh optimizer prewarms the SHARDED chain program a production
+    solve of the shape would run — the solve after prewarm builds no new
+    program."""
+    from cruise_control_tpu.parallel import chain_sharded, make_mesh
+    tmp = tempfile.mkdtemp()
+    cfg = _prewarm_cfg(tmp)
+    opt = GoalOptimizer(cfg, mesh=make_mesh(8))
+    state, meta = _cluster()               # 96 partitions: divides the mesh
+    chain = goals_by_priority(cfg)
+    entry = warmstart.shape_signature(state, meta.num_topics, chain,
+                                      _empty_masks(), 0)
+    assert opt.prewarm_shape(entry) is True
+    programs = chain_sharded._make_chain_full.cache_info().currsize
+    opt.optimizations(state, meta, chain, OptimizationOptions())
+    assert chain_sharded._make_chain_full.cache_info().currsize \
+        == programs, "post-prewarm mesh solve built a new chain program"
+    # Megabatch entries stay single-device machinery under a mesh.
+    assert opt.prewarm_shape(dict(entry, batch=4)) is False
+    # A partition axis that does not divide the mesh falls back to the
+    # single-device solver in _optimize — nothing to prewarm here.
+    odd_state, odd_meta = random_cluster(num_brokers=12, num_topics=6,
+                                         num_partitions=90, rf=2,
+                                         num_racks=3, seed=3)
+    odd = warmstart.shape_signature(odd_state, odd_meta.num_topics, chain,
+                                    _empty_masks(), 0)
+    assert opt.prewarm_shape(odd) is False
+
+
+def test_prewarm_mesh_bounded_phase_kernels():
+    """Past the fused-broker gate the mesh path dispatches per-goal phase
+    kernels — the prewarm compiles that bounded set instead."""
+    from cruise_control_tpu.parallel import chain_sharded, make_mesh
+    cfg = _prewarm_cfg(tempfile.mkdtemp(),
+                       **{"solver.fused.chain.max.brokers": 4})
+    opt = GoalOptimizer(cfg, mesh=make_mesh(8))
+    state, meta = _cluster()               # 12 brokers > the gate of 4
+    entry = warmstart.shape_signature(state, meta.num_topics,
+                                      goals_by_priority(cfg),
+                                      _empty_masks(), 0)
+    before = chain_sharded._make_chain_phase_kernels.cache_info().currsize
+    assert opt.prewarm_shape(entry) is True
+    assert chain_sharded._make_chain_phase_kernels.cache_info().currsize \
+        == before + 1
+
+
 def test_shape_registry_dedupes_and_persists():
     tmp = tempfile.mkdtemp()
     reg = warmstart.ShapeRegistry(f"{tmp}/shapes.json")
