@@ -960,6 +960,14 @@ class MegastepConfig:
       path is kept so the fused/bounded byte-parity pins hold).
     - ``direct_max_sweeps``: sweep budget of one direct dispatch
       (``solver.direct.max.sweeps``).
+    - ``direct_sparse_margin``: fractional band-edge margin of the
+      sparse-aware plan (``solver.direct.sparse.margin.frac``) — the
+      shed/fill targets sit this fraction of the band width inside the
+      edges; resolved per cell by deterministic randomized rounding.
+    - ``direct_sparse_salt``: extra salt string folded (crc32, trace
+      time) into the rounding seed (``solver.direct.sparse.rounding.salt``)
+      so fleets can decorrelate rounding replays; "" keeps the module
+      default seed.
     """
 
     donate: bool = True
@@ -967,6 +975,8 @@ class MegastepConfig:
     deficit_moves_cap: int = 0
     direct_assignment: bool = False
     direct_max_sweeps: int = 16
+    direct_sparse_margin: float = 0.25
+    direct_sparse_salt: str = ""
 
 
 def donation_enabled(megastep: "MegastepConfig | None") -> bool:
@@ -1753,15 +1763,13 @@ def optimize_goal_in_chain_megabatch(states: ClusterTensors,
     # kernel.
     use_direct = False
     if megastep.direct_assignment:
-        from .direct import direct_eligible, direct_regime_ok
-        use_direct = direct_eligible(goals, index) \
-            and direct_regime_ok(goal, states.assignment.shape[1],
-                                 states.assignment.shape[2],
-                                 states.capacity.shape[1], num_topics)
+        from .direct import direct_eligible
+        use_direct = direct_eligible(goals, index)
     direct_active = ran & (off0 == 0) & ~drain & (viol0 > 0)
     if use_direct and direct_active.any():
         from .direct import (
             megabatch_direct_rounds, megabatch_direct_rounds_donated,
+            sparse_rounding_seed,
         )
         from ..utils.sensors import SENSORS
         active0 = jnp.asarray(direct_active)
@@ -1779,14 +1787,18 @@ def optimize_goal_in_chain_megabatch(states: ClusterTensors,
             a, l, mv, sw, _act = megabatch_direct_rounds_donated(
                 states.assignment, states.leader_slot, rest, active0,
                 goals, index, constraint, num_topics, masks,
-                megastep.direct_max_sweeps)
+                megastep.direct_max_sweeps,
+                margin_frac=megastep.direct_sparse_margin,
+                seed=sparse_rounding_seed(megastep.direct_sparse_salt))
             states = dataclasses.replace(states, assignment=a,
                                          leader_slot=l)
             can_donate[0] = True
         else:
             states, mv, sw, _act = megabatch_direct_rounds(
                 states, active0, goals, index, constraint, num_topics,
-                masks, megastep.direct_max_sweeps)
+                masks, megastep.direct_max_sweeps,
+                margin_frac=megastep.direct_sparse_margin,
+                seed=sparse_rounding_seed(megastep.direct_sparse_salt))
         mv_np = np.asarray(mv)
         sw_np = np.asarray(sw)
         elapsed = _time.monotonic() - t0
@@ -1997,11 +2009,8 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
     use_direct = False
     if bounded and megastep is not None and megastep.direct_assignment \
             and int(offline0) == 0 and not drain:
-        from .direct import direct_eligible, direct_regime_ok
-        use_direct = direct_eligible(goals, index) \
-            and direct_regime_ok(goal, state.num_partitions,
-                                 state.max_replication_factor,
-                                 state.num_brokers, num_topics)
+        from .direct import direct_eligible
+        use_direct = direct_eligible(goals, index)
     if bounded and megastep is not None and megastep.deficit_moves_cap > 0 \
             and goal.count_based and not use_direct:
         # Deficit-aware sizing from the goal's ENTRY violations — a

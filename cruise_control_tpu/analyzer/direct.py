@@ -13,9 +13,11 @@ dispatches (the Podracer/Anakin "stop iterating" lever):
 
 1. **Target counts on device**: the active goal's count plane
    ``[G, B]`` and band ``[lower, upper]`` (``G`` = 1 for the
-   replica/leader goals, ``num_topics`` for the topic goal), with
-   donor widening when deficits exceed base surplus (the
-   ``donor_widened_shed`` semantics, integral and deterministic).
+   replica/leader goals, ``num_topics`` for the topic goal), as
+   FRACTIONAL per-cell shed/fill targets resolved to integers by
+   deterministic randomized rounding (round 21: one plan for every
+   density regime — see ``_surplus_deficit``), with proportional donor
+   widening when deficits exceed base surplus.
 2. **Surplus replica selection**: ONE segmented sort of the flattened
    replica axis by ``(cell, weight)`` — cell = (group, src broker) —
    ranks every replica within its cell; the ``surplus[cell]`` lightest
@@ -57,6 +59,20 @@ deficit-sized greedy ran before, refuses chains whose prior goals it
 cannot guard (``direct_eligible``), and is gated on the bench
 regression sentry + full fixture matrix, never on round counts.
 
+SPMD layout (round 21): every rank the plan assigns — within-cell
+mover ranks, group fill ranks, per-destination intake positions,
+per-source outflow positions — is parameterized by
+``(rank_stride, block)``: a replica on block ``d`` with local rank
+``r`` occupies global position ``r·stride + d``. On the partition-
+sharded mesh each device passes its shard index as ``block`` and the
+shard count as ``rank_stride``, so device-local sorts yield globally
+unique positions without a global sort (the ``target_dests``
+interleaved-fill treatment, generalized to the whole plan). Load-sum
+guards cannot interleave (per-mover loads are heterogeneous), so each
+block is budgeted ``1/stride`` of the remaining headroom —
+conservative, never unsafe. ``rank_stride == 1`` (every single-device
+caller) is byte-identical to the unparameterized plan.
+
 Donation contract: the donated twins donate EXACTLY the strip_mutable
 pair ``{assignment, leader_slot}`` (CCSA002-checked); topology tensors
 are refresh-cache-shared and never donated.
@@ -65,6 +81,7 @@ are refresh-cache-shared and never donated.
 from __future__ import annotations
 
 import dataclasses
+import zlib
 from functools import partial
 
 import jax
@@ -82,7 +99,6 @@ from .goals.base import Goal
 from .goals.capacity import ReplicaCapacityGoal, ResourceCapacityGoal
 from .goals.distribution import (
     CountDistributionGoal, PotentialNwOutGoal, TopicReplicaDistributionGoal,
-    _int_deficit_headroom,
 )
 from .goals.rack import RackAwareGoal
 from .search import ExclusionMasks, goal_aux
@@ -126,30 +142,6 @@ def _guards_for(goals: tuple[Goal, ...], index: int) -> DirectGuards:
         pot_nw_out=any(isinstance(g, PotentialNwOutGoal) for g in priors))
 
 
-#: Mean replicas per (topic, broker) cell below which the TOPIC-plane
-#: transport is skipped (the sparse-cell regime): at ~1.5 replicas/cell
-#: (the 1k/100k fixture — and north-star scale) the plan's granularity
-#: equals the band width, feasibility-vetoed churn dominates, and the
-#: greedy polish lands in a WORSE local optimum than the greedy-only
-#: trajectory (measured ~10k residual vs 316; more sweeps made it
-#: worse). The cluster-wide planes (replica/leader counts) have B cells
-#: for P·S replicas and are always dense.
-MIN_TOPIC_CELL_DENSITY = 4.0
-
-
-def direct_regime_ok(goal: Goal, num_partitions: int, max_rf: int,
-                     num_brokers: int, num_topics: int) -> bool:
-    """Host-side density gate for the per-goal transport plan (shape
-    arithmetic only — no device sync, so it works on batched megabatch
-    shapes too): the integration layer skips the direct pre-pass for
-    plane geometries the plan is known to mis-fit, falling back to
-    deficit-sized greedy."""
-    if isinstance(goal, TopicReplicaDistributionGoal):
-        cells = max(1, num_topics * num_brokers)
-        return num_partitions * max_rf / cells >= MIN_TOPIC_CELL_DENSITY
-    return True
-
-
 def direct_eligible(goals, index: int) -> bool:
     """True when ``goals[index]`` has a direct transport formulation AND
     every prior goal's acceptance is representable by the guard set —
@@ -165,6 +157,62 @@ def direct_eligible(goals, index: int) -> bool:
                   CountDistributionGoal, TopicReplicaDistributionGoal,
                   PotentialNwOutGoal, ResourceDistributionGoal)
     return all(isinstance(g, recognized) for g in goals[:index])
+
+
+# ---------------------------------------------------------------------------
+# Deterministic randomized rounding (the sparse-plan PRNG, CCSA004)
+# ---------------------------------------------------------------------------
+
+#: Trace-time crc32-derived seed of the rounding PRNG — the repo's
+#: approved deterministic-seeding idiom (lint CCSA004: no host RNG, no
+#: clocks, no builtin hash()). Callers may override it with a crc32 of
+#: ``solver.direct.sparse.rounding.salt`` so fleets can decorrelate
+#: replays without breaking byte-determinism within one configuration.
+SPARSE_ROUNDING_SEED = zlib.crc32(b"cruise-control:direct.sparse.rounding")
+_SALT_SURPLUS = zlib.crc32(b"direct.sparse.plane:surplus")
+_SALT_HEADROOM = zlib.crc32(b"direct.sparse.plane:headroom")
+
+
+def sparse_rounding_seed(salt: str = "") -> int:
+    """The rounding seed for a configured salt string
+    (``solver.direct.sparse.rounding.salt``): empty → the module
+    default; otherwise crc32 of the salt folded over it. Host-side,
+    trace-time only — the value enters the kernels as a static."""
+    if not salt:
+        return SPARSE_ROUNDING_SEED
+    return SPARSE_ROUNDING_SEED ^ zlib.crc32(salt.encode("utf-8"))
+
+
+def _hash_uniform(idx: jax.Array, sweep, salt: int) -> jax.Array:
+    """Deterministic per-index uniforms in [0, 1): a splitmix-style
+    integer finalizer over (index, sweep, trace-time crc32 salt) — pure
+    jnp on uint32, so the draw replays byte-identically on device with
+    no host RNG in the loop (the CCSA004 contract)."""
+    x = idx.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+    x = x + jnp.asarray(sweep, jnp.uint32) * jnp.uint32(0x85EBCA77)
+    x = x + jnp.uint32(salt & 0xFFFFFFFF)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return jnp.minimum(x.astype(jnp.float32) * jnp.float32(2.0 ** -32),
+                       jnp.float32(1.0 - 1e-7))
+
+
+def _round_systematic(x: jax.Array, u: jax.Array) -> jax.Array:
+    """Systematic (low-discrepancy) randomized rounding along the broker
+    axis: ``x`` [G, B] non-negative fractional targets, ``u`` [G]
+    uniforms. ``T[g, b] = ⌊cum[b] + u⌋ − ⌊cum[b−1] + u⌋ ∈ {⌊x⌋, ⌈x⌉}``
+    with ``E[T] = x`` exactly and ``|Σ_b T − Σ_b x| < 1`` per group —
+    expected counts match the fractional band math, and a group's
+    realized total stays within one replica of it (independent
+    per-cell Bernoulli draws would drift by O(√B)). Integral inputs
+    pass through unchanged, so the dense regime keeps its exact
+    plans."""
+    c = jnp.cumsum(x, axis=1)
+    y = jnp.floor(c + u[:, None])
+    return jnp.diff(y, axis=1, prepend=0.0)
 
 
 # ---------------------------------------------------------------------------
@@ -202,14 +250,24 @@ def _segment_exclusive(keys: jax.Array, values: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 def _dst_load_caps(ds, lv_d, state, derived, constraint,
-                   guards: DirectGuards):
+                   guards: DirectGuards, ds_b=None, share: float = 1.0):
     """Joint per-resource upper-band + hard-capacity caps at the
     destination, in the dst-sorted frame (``lv_d`` is each mover's load
     vector already masked to selected movers). Shared by BOTH transport
     modes so the prior-goal contract cannot drift between them.
-    Returns (okd [N] bool, pre_load [N, R])."""
+
+    ``ds`` is the SEGMENT key (at ``rank_stride > 1`` a composite
+    ``dst·stride + block``); ``ds_b`` the broker index it maps to, and
+    ``share`` the stride: load sums cannot interleave like count ranks
+    (per-mover loads are heterogeneous), so each block is budgeted
+    ``1/stride`` of the destination's remaining headroom — a block's
+    inflow scaled by ``stride`` must fit the full headroom. Conservative
+    (joint overshoot impossible; unbalanced blocks under-use the cap and
+    re-pair next sweep), exact at stride 1. Returns
+    (okd [N] bool, pre_load [N, R])."""
     f32 = jnp.float32
     n = ds.shape[0]
+    ds_b = ds if ds_b is None else ds_b
     okd = jnp.ones(n, bool)
     inf1 = jnp.full((1,), jnp.inf, f32)
     pre_load = _segment_exclusive(ds, lv_d)
@@ -219,26 +277,31 @@ def _dst_load_caps(ds, lv_d, state, derived, constraint,
         up_pad = jnp.concatenate([up_r, inf1])
         dl_pad = jnp.concatenate([derived.broker_load[:, r],
                                   jnp.zeros((1,), f32)])
-        okd &= dl_pad[ds] + pre_load[:, r] + lv_d[:, r] <= up_pad[ds] + _EPS
+        okd &= dl_pad[ds_b] + (pre_load[:, r] + lv_d[:, r]) * share \
+            <= up_pad[ds_b] + _EPS
     for r in guards.cap_resources:
         limit = constraint.capacity_threshold[r] * state.capacity[:, r]
         lim_pad = jnp.concatenate([limit, inf1])
         dl_pad = jnp.concatenate([derived.broker_load[:, r],
                                   jnp.zeros((1,), f32)])
-        okd &= dl_pad[ds] + pre_load[:, r] + lv_d[:, r] <= lim_pad[ds] + _EPS
+        okd &= dl_pad[ds_b] + (pre_load[:, r] + lv_d[:, r]) * share \
+            <= lim_pad[ds_b] + _EPS
     return okd, pre_load
 
 
 def _src_load_floors(ss, lv_s, state, derived, constraint,
-                     guards: DirectGuards):
+                     guards: DirectGuards, ss_b=None, share: float = 1.0):
     """Joint per-resource lower-band floors at the source, in the
     src-sorted frame (``lv_s`` is each mover's OUTBOUND load vector
     masked to selected movers): cumulative outflow must not take the
     source below a previously-optimized resource goal's lower band (the
-    greedy's stays-in-band source arm). Shared by both transport
-    modes."""
+    greedy's stays-in-band source arm). Shared by both transport modes.
+    ``ss``/``ss_b``/``share`` follow the ``_dst_load_caps`` stride
+    contract (each block budgeted ``1/stride`` of the floor
+    headroom)."""
     f32 = jnp.float32
     n = ss.shape[0]
+    ss_b = ss if ss_b is None else ss_b
     oks = jnp.ones(n, bool)
     ninf1 = jnp.full((1,), -jnp.inf, f32)
     pre_out = _segment_exclusive(ss, lv_s)
@@ -248,57 +311,101 @@ def _src_load_floors(ss, lv_s, state, derived, constraint,
         lo_pad = jnp.concatenate([lo_r, ninf1])
         sl_pad = jnp.concatenate([derived.broker_load[:, r],
                                   jnp.zeros((1,), f32)])
-        oks &= sl_pad[ss] - pre_out[:, r] - lv_s[:, r] >= lo_pad[ss] - _EPS
+        oks &= sl_pad[ss_b] - (pre_out[:, r] + lv_s[:, r]) * share \
+            >= lo_pad[ss_b] - _EPS
     return oks
 
 
-def _surplus_deficit(cnt, lower, upper, alive, elig_dst):
-    """Integral (surplus, deficit, headroom) planes with donor widening
-    (donor_widened_shed made integral and deterministic): when a group's
-    deficits exceed its base surplus, in-band donors shed the difference,
-    filled greedily in broker-index order so the plan is a pure function
-    of the counts.
+def _surplus_deficit(cnt, lower, upper, alive, elig_dst, sweep=0,
+                     margin_frac: float = 0.25,
+                     seed: int = SPARSE_ROUNDING_SEED):
+    """Integral (surplus, deficit, headroom) planes from FRACTIONAL
+    per-cell targets resolved by deterministic randomized rounding —
+    ONE plan for every density regime (round 21, retiring the
+    ``MIN_TOPIC_CELL_DENSITY`` gate).
 
-    Band-edge slack: violators shed down to (and receivers fill only up
-    to) ``upper − margin`` with margin = 25% of the band width — NOT to
-    the band's brim. A transport that parks every touched broker exactly
-    AT the upper bound leaves later goals zero joint slack (every
-    subsequent count/load move into those brokers is band-vetoed), and
-    the greedy polish then stalls in a worse local optimum than the
-    greedy-only trajectory, whose variance tiebreak naturally lands
-    mid-band (measured at 64/2048: TopicReplica residual 70 vs 0).
-    Sources are still ONLY actual violators (plus widened donors), so
-    the extra depth costs a bounded per-violator margin, never an O(B)
-    in-band churn."""
-    margin = jnp.floor(jnp.maximum(upper - lower, 0.0) * 0.25)
-    upper_eff = jnp.maximum(upper - margin, lower)
-    base_sur = jnp.where(
-        alive[None, :] & (cnt > upper + _EPS),
-        jnp.floor(jnp.maximum(cnt - upper_eff, 0.0) + _EPS), 0.0)
-    # Receivers likewise fill only to ``lower + margin`` (clamped into
-    # the band): deficits land center-ward instead of spreading across
-    # every broker's full remaining headroom, so no receiver is left
-    # sitting exactly AT lower — the mirror-image edge with zero
-    # OUTBOUND slack for later goals' source-side checks.
-    fill_cap = jnp.minimum(lower + jnp.maximum(margin, 1.0), upper_eff)
-    defi, headr = _int_deficit_headroom(cnt, lower, fill_cap)
-    defi = jnp.where(elig_dst[None, :], defi, 0.0)
-    headr = jnp.where(elig_dst[None, :], headr, 0.0)
+    The round-17 plan floored its band-edge margin and its donor room
+    to integers — exact in the dense regime, but at a 1-count band
+    (the sparse-cell regime: ~1.5 replicas per (topic, broker) cell at
+    1k/100k and north-star scale) the floor collapsed the margin to
+    zero, every touched cell landed exactly AT the band edge, donor
+    widening drained in-band donors in broker-index order (packing
+    low-index brokers), and the greedy polish inherited a layout it
+    could not fix (measured residual ~10k vs greedy's 316). Here the
+    shed target (``upper − margin``), the fill target
+    (``lower + max(margin, 0.5)``) and the donor-widening shares all
+    stay FRACTIONAL: a group-wide violation gap is spread across its
+    in-band donors proportional to their fractional room (no
+    broker-index packing), and systematic randomized rounding — one
+    crc32-derived uniform per (group, plane, sweep), ``_hash_uniform``
+    — resolves every fractional plane to integers with expectation
+    EQUAL to the fractional band math and per-group totals within one
+    replica of it. Re-drawing per sweep lets a rounding outcome that
+    paired badly re-round after the counts update.
+
+    Hard integral caps close the loop independent of the rounding: a
+    source never sheds below ``lower`` (``⌊cnt − lower⌋``), a receiver
+    never fills above ``upper`` (``⌊upper − cnt⌋``), so every rounding
+    outcome stays inside the band by construction.
+
+    Band-edge slack rationale (unchanged from round 17): a transport
+    that parks every touched broker exactly AT a band edge leaves
+    later goals zero joint slack and the greedy polish stalls in a
+    worse local optimum than greedy-only (measured at 64/2048:
+    TopicReplica residual 70 vs 0). Deficits are violation-sized only
+    (``lower − cnt``); receivers additionally expose headroom up to
+    the fill target, so inflow lands center-ward without O(B) in-band
+    churn."""
+    g_dim = cnt.shape[0]
+    width = jnp.maximum(upper - lower, 0.0)
+    margin = width * margin_frac
+    hi_t = jnp.maximum(upper - margin, lower)   # fractional shed ceiling
+    lo_t = jnp.minimum(lower + jnp.maximum(margin, 0.5), hi_t)  # fill target
+    gidx = jnp.arange(g_dim, dtype=jnp.uint32)
+
+    viol_dst = elig_dst[None, :] & (cnt < lower - _EPS)
+    sur_f = jnp.where(alive[None, :] & (cnt > upper + _EPS),
+                      jnp.maximum(cnt - hi_t, 0.0), 0.0)
+    # Deficits are integral by construction (band edges and counts are
+    # integers); the fractional mass lives in the shed targets and the
+    # center-ward headroom below.
+    defi = jnp.where(viol_dst, lower - cnt, 0.0)
+    head_f = jnp.where(elig_dst[None, :],
+                       jnp.maximum(lo_t - jnp.maximum(cnt, lower), 0.0), 0.0)
+
+    # Proportional donor widening: when violation deficits exceed base
+    # surplus, in-band donors cover the gap in proportion to their
+    # fractional room (cnt down to the fill target) — spread across the
+    # whole group instead of drained in broker-index order.
     need = jnp.maximum(defi.sum(axis=1, keepdims=True)
-                       - base_sur.sum(axis=1, keepdims=True), 0.0)
-    donor_room = jnp.where(
-        alive[None, :],
-        jnp.floor(jnp.maximum(cnt - lower, 0.0) + _EPS) - base_sur, 0.0)
-    donor_room = jnp.maximum(donor_room, 0.0)
-    cum_before = jnp.cumsum(donor_room, axis=1) - donor_room
-    extra = jnp.clip(need - cum_before, 0.0, donor_room)
-    return base_sur + extra, defi, headr
+                       - sur_f.sum(axis=1, keepdims=True), 0.0)
+    donor_room = jnp.where(alive[None, :],
+                           jnp.maximum(jnp.minimum(cnt, hi_t) - lo_t, 0.0),
+                           0.0)
+    share = donor_room / jnp.maximum(donor_room.sum(axis=1, keepdims=True),
+                                     _EPS)
+    extra_f = jnp.minimum(need * share, donor_room)
+
+    u_s = _hash_uniform(gidx, sweep, seed ^ _SALT_SURPLUS)
+    u_h = _hash_uniform(gidx, sweep, seed ^ _SALT_HEADROOM)
+    sur_cap = jnp.where(alive[None, :],
+                        jnp.floor(jnp.maximum(cnt - lower, 0.0) + _EPS), 0.0)
+    surplus = jnp.minimum(_round_systematic(sur_f + extra_f, u_s), sur_cap)
+    room_cap = jnp.floor(jnp.maximum(upper - cnt, 0.0) + _EPS)
+    defi = jnp.minimum(defi, room_cap)
+    headr = jnp.where(elig_dst[None, :],
+                      jnp.minimum(_round_systematic(head_f, u_h),
+                                  jnp.maximum(room_cap - defi, 0.0)), 0.0)
+    return surplus, defi, headr
 
 
 def _leadership_sweep(state: ClusterTensors, goals: tuple[Goal, ...],
                       index: int, constraint: BalancingConstraint,
                       num_topics: int, masks: ExclusionMasks,
                       sweep: jax.Array | int = 0,
+                      rank_stride: int = 1, block: jax.Array | int = 0,
+                      psum=None, margin_frac: float = 0.25,
+                      seed: int = SPARSE_ROUNDING_SEED,
                       ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
     """Transport sweep for the LEADER-count goal via leadership
     TRANSFERS: after the replica goals have balanced counts, a leader
@@ -312,10 +419,11 @@ def _leadership_sweep(state: ClusterTensors, goals: tuple[Goal, ...],
     guards (leadership carries ``leader_load − follower_load``)."""
     goal = goals[index]
     guards = _guards_for(goals, index)
+    ps = psum if psum is not None else (lambda x: x)
     derived = compute_derived(state, masks.excluded_topics,
                               masks.excluded_replica_move_brokers,
-                              masks.excluded_leadership_brokers)
-    aux = goal_aux(goal, state, derived, constraint, num_topics)
+                              masks.excluded_leadership_brokers, psum=psum)
+    aux = goal_aux(goal, state, derived, constraint, num_topics, psum=psum)
     counts, lower, upper, _group, movable = goal.direct_spec(
         state, derived, constraint, aux, num_topics)
 
@@ -323,11 +431,14 @@ def _leadership_sweep(state: ClusterTensors, goals: tuple[Goal, ...],
     b = state.num_brokers
     n = p * s
     f32 = jnp.float32
+    stride = int(rank_stride)
+    str_f = f32(stride)
     alive = derived.alive
     lead_elig = derived.allowed_leadership & alive
     cnt = counts.astype(f32)
-    surplus, defi, headr = _surplus_deficit(cnt, lower, upper, alive,
-                                            lead_elig)
+    surplus, defi, headr = _surplus_deficit(
+        cnt, lower, upper, alive, lead_elig, sweep=sweep,
+        margin_frac=margin_frac, seed=seed)
     room = (defi + headr)[0]                                       # [B]
 
     # Movers: the surplus[src] lightest leaders per over-band broker.
@@ -353,19 +464,27 @@ def _leadership_sweep(state: ClusterTensors, goals: tuple[Goal, ...],
             mv &= load_pad[src_plane] - own_r >= lo_pad[src_plane] - _EPS
     cell = jnp.where(mv, src_plane, b).astype(jnp.int32)
     weight = replica_load_total(state)
+    if stride > 1:
+        blk_rows = jnp.broadcast_to(jnp.asarray(block, jnp.int32), (p,))
+        blk_plane = jnp.broadcast_to(blk_rows[:, None], (p, s))
+        key0 = cell * stride + blk_plane
+    else:
+        key0 = cell
     sc, _sk, si = jax.lax.sort(
-        (cell.reshape(-1), weight.reshape(-1),
+        (key0.reshape(-1), weight.reshape(-1),
          jnp.arange(n, dtype=jnp.int32)), num_keys=2)
     rank_cell = _segment_rank(sc)
+    cell_s = sc // stride if stride > 1 else sc
+    blk_s = sc % stride if stride > 1 else jnp.zeros_like(sc)
     sur_pad = jnp.concatenate([surplus[0], jnp.zeros((1,), f32)])
-    mover = rank_cell.astype(f32) < sur_pad[sc]
+    mover = (rank_cell * stride + blk_s).astype(f32) < sur_pad[cell_s]
 
     # Destination menu = the partition's own existing sibling replicas
     # on leadership-eligible brokers with band room; best room wins
     # (deficits before headroom), ties to the lowest slot.
     p_m = si // s
     s_m = si % s
-    src = jnp.minimum((sc % (b + 1)).astype(jnp.int32), b - 1)
+    src = jnp.minimum((cell_s % (b + 1)).astype(jnp.int32), b - 1)
     assign_p = state.assignment[p_m]                               # [N, S]
     not_me = jnp.arange(s, dtype=jnp.int32)[None, :] != s_m[:, None]
     sib_b = jnp.clip(assign_p, 0, b - 1)
@@ -389,15 +508,20 @@ def _leadership_sweep(state: ClusterTensors, goals: tuple[Goal, ...],
     lead_vec = jnp.maximum(state.leader_load[p_m] - state.follower_load[p_m],
                            0.0)
     dkey = jnp.where(sel, dst, b)
-    ds, _dp, d_i = jax.lax.sort((dkey, pos, pos), num_keys=2)
+    dkey_s = dkey * stride + blk_s if stride > 1 else dkey
+    ds, _dp, d_i = jax.lax.sort((dkey_s, pos, pos), num_keys=2)
+    ds_b = ds // stride if stride > 1 else ds
+    blk_d = (ds % stride).astype(f32) if stride > 1 \
+        else jnp.zeros((n,), f32)
     sel_d = sel[d_i]
     one_d = sel_d.astype(f32)
     pre_cnt = _segment_exclusive(ds, one_d)
     room_cap = jnp.concatenate([room, jnp.full((1,), jnp.inf, f32)])
-    okd = pre_cnt + 1.0 <= room_cap[ds] + _EPS
+    okd = pre_cnt * str_f + blk_d + 1.0 <= room_cap[ds_b] + _EPS
     if guards.resources or guards.cap_resources:
         okd_load, _pre = _dst_load_caps(ds, lead_vec[d_i] * sel_d[:, None],
-                                        state, derived, constraint, guards)
+                                        state, derived, constraint, guards,
+                                        ds_b=ds_b, share=str_f)
         okd &= okd_load
     sel &= jnp.zeros(n, bool).at[d_i].set(okd)
 
@@ -407,23 +531,29 @@ def _leadership_sweep(state: ClusterTensors, goals: tuple[Goal, ...],
     # the per-mover pre-filter above only bounds a single departure.
     if guards.resources:
         skey = jnp.where(sel, src, b)
-        ss, _sp, s_i = jax.lax.sort((skey, pos, pos), num_keys=2)
+        skey_s = skey * stride + blk_s if stride > 1 else skey
+        ss, _sp, s_i = jax.lax.sort((skey_s, pos, pos), num_keys=2)
+        ss_b = ss // stride if stride > 1 else ss
         sel_s = sel[s_i]
         oks = _src_load_floors(ss, lead_vec[s_i] * sel_s[:, None],
-                               state, derived, constraint, guards)
+                               state, derived, constraint, guards,
+                               ss_b=ss_b, share=str_f)
         sel &= jnp.zeros(n, bool).at[s_i].set(oks)
 
     rows = jnp.where(sel, p_m, p)
     new_leader = state.leader_slot.at[rows].set(
         best_slot.astype(state.leader_slot.dtype), mode="drop")
     return (dataclasses.replace(state, leader_slot=new_leader),
-            sel.sum().astype(jnp.int32),
-            mover.sum().astype(jnp.int32))
+            ps(sel.sum().astype(jnp.int32)),
+            ps(mover.sum().astype(jnp.int32)))
 
 def _direct_sweep(state: ClusterTensors, goals: tuple[Goal, ...], index: int,
                   constraint: BalancingConstraint, num_topics: int,
                   masks: ExclusionMasks, sweep: jax.Array | int = 0,
-                  ) -> tuple[ClusterTensors, jax.Array]:
+                  rank_stride: int = 1, block: jax.Array | int = 0,
+                  psum=None, margin_frac: float = 0.25,
+                  seed: int = SPARSE_ROUNDING_SEED,
+                  ) -> tuple[ClusterTensors, jax.Array, jax.Array]:
     """One transport sweep for ``goals[index]``: plan the full
     surplus→deficit matching on the current counts, veto infeasible
     assignments, apply the rest in one scatter. ``sweep`` (traced)
@@ -431,13 +561,22 @@ def _direct_sweep(state: ClusterTensors, goals: tuple[Goal, ...], index: int,
     vetoed by feasibility (sibling/rack collisions) is re-paired with a
     DIFFERENT destination on the next sweep even when the counts did not
     change — without it a fully-vetoed plan is a fixed point and the
-    residue never re-pairs. Returns (new_state, applied)."""
+    residue never re-pairs.
+
+    ``(rank_stride, block)`` select the SPMD rank layout (module
+    docstring): on the mesh each device passes its shard index and the
+    shard count, and ``psum`` (the mesh collective) makes the count
+    planes and the returned scalars global. The same kernel evaluated
+    single-device with ``block = partition_row // shard_rows`` is the
+    mesh path's byte-parity reference. Returns
+    (new_state, applied, planned)."""
     goal = goals[index]
     guards = _guards_for(goals, index)
+    ps = psum if psum is not None else (lambda x: x)
     derived = compute_derived(state, masks.excluded_topics,
                               masks.excluded_replica_move_brokers,
-                              masks.excluded_leadership_brokers)
-    aux = goal_aux(goal, state, derived, constraint, num_topics)
+                              masks.excluded_leadership_brokers, psum=psum)
+    aux = goal_aux(goal, state, derived, constraint, num_topics, psum=psum)
     counts, lower, upper, group, movable = goal.direct_spec(
         state, derived, constraint, aux, num_topics)
 
@@ -446,6 +585,7 @@ def _direct_sweep(state: ClusterTensors, goals: tuple[Goal, ...], index: int,
     g_dim = counts.shape[0]
     n = p * s
     f32 = jnp.float32
+    stride = int(rank_stride)
 
     alive = derived.alive
     has_new = derived.new_brokers.any()
@@ -454,8 +594,9 @@ def _direct_sweep(state: ClusterTensors, goals: tuple[Goal, ...], index: int,
     cnt = counts.astype(f32)
 
     # --- target distribution: integral surplus / deficit / headroom ------
-    surplus, defi, headr = _surplus_deficit(cnt, lower, upper, alive,
-                                            elig_dst)               # [G, B]
+    surplus, defi, headr = _surplus_deficit(
+        cnt, lower, upper, alive, elig_dst, sweep=sweep,
+        margin_frac=margin_frac, seed=seed)                         # [G, B]
 
     # --- mover selection: segmented sort by (cell, weight) ---------------
     alive_pad = jnp.concatenate([alive, jnp.zeros((1,), bool)])
@@ -499,19 +640,45 @@ def _direct_sweep(state: ClusterTensors, goals: tuple[Goal, ...], index: int,
     cell = jnp.where(mv, group * (b + 1) + src_plane,
                      g_dim * (b + 1)).astype(jnp.int32)
     weight = replica_load_total(state)
+    if stride > 1:
+        # Sort by (cell, block, weight): each block's rows keep their
+        # local light-first order, and a device owning ONE block sees
+        # the exact order of its local (cell, weight) sort — the SPMD
+        # equivalence that makes single-device emulation byte-exact.
+        blk_rows = jnp.broadcast_to(jnp.asarray(block, jnp.int32), (p,))
+        blk_plane = jnp.broadcast_to(blk_rows[:, None], (p, s))
+        key0 = cell * stride + blk_plane
+    else:
+        key0 = cell
     sc, _sk, si = jax.lax.sort(
-        (cell.reshape(-1), weight.reshape(-1),
+        (key0.reshape(-1), weight.reshape(-1),
          jnp.arange(n, dtype=jnp.int32)), num_keys=2)
-    rank_cell = _segment_rank(sc)
+    rank_cell = _segment_rank(sc)              # within (cell, block)
+    cell_s = sc // stride if stride > 1 else sc
+    blk_s = sc % stride if stride > 1 else jnp.zeros_like(sc)
     sur_pad = jnp.concatenate([surplus, jnp.zeros((g_dim, 1), f32)],
                               axis=1).reshape(-1)
     sur_pad = jnp.concatenate([sur_pad, jnp.zeros((1,), f32)])
-    mover = rank_cell.astype(f32) < sur_pad[sc]
+    # Interleaved global within-cell rank: local rank · stride + block.
+    mover = (rank_cell * stride + blk_s).astype(f32) < sur_pad[cell_s]
 
     # --- cumsum rank-assignment over the [deficit | headroom] profile ----
-    grp_key = sc // (b + 1)                     # sorted; sentinel = g_dim
+    grp_key = cell_s // (b + 1)                 # sorted; sentinel = g_dim
     grp = jnp.minimum(grp_key, g_dim - 1)
-    rank_grp = _segment_exclusive(grp_key, mover.astype(jnp.int32))
+    if stride > 1:
+        # Within-(group, block) mover ordinal, interleaved to a globally
+        # unique fill position (ordinal · stride + block) — computed in a
+        # second sorted frame because (group, block) runs are not
+        # contiguous in the (cell, block)-major frame.
+        pos0 = jnp.arange(n, dtype=jnp.int32)
+        gb_key = jnp.where(grp_key < g_dim, grp_key * stride + blk_s,
+                           g_dim * stride).astype(jnp.int32)
+        gs, _gp, g_i = jax.lax.sort((gb_key, pos0, pos0), num_keys=2)
+        r_local = _segment_exclusive(gs, mover[g_i].astype(jnp.int32))
+        rank_grp = jnp.zeros((n,), jnp.int32).at[g_i].set(
+            r_local * stride + gs % stride)
+    else:
+        rank_grp = _segment_exclusive(grp_key, mover.astype(jnp.int32))
     # Per-sweep cyclic rotation within each group's position space: a
     # bijection on [0, total), so position uniqueness (and therefore every
     # cell's integer intake bound) is preserved; out-of-range ranks stay
@@ -533,7 +700,7 @@ def _direct_sweep(state: ClusterTensors, goals: tuple[Goal, ...], index: int,
     # --- structural feasibility ------------------------------------------
     p_m = si // s
     s_m = si % s
-    src = (sc % (b + 1)).astype(jnp.int32)
+    src = (cell_s % (b + 1)).astype(jnp.int32)
     ok &= dst != jnp.minimum(src, b - 1)
     assign_p = state.assignment[p_m]                           # [N, S]
     ok &= ~(assign_p == dst[:, None]).any(axis=1)
@@ -575,12 +742,23 @@ def _direct_sweep(state: ClusterTensors, goals: tuple[Goal, ...], index: int,
                          state.follower_load[p_m])              # [N, R]
 
     # --- prior-goal guards: dst-sorted joint caps ------------------------
+    # At rank_stride > 1 the frame segments on (dst, block): COUNT caps
+    # interleave (a block's k-th intake claims global position
+    # k·stride + block, unique per destination, so the joint bound holds
+    # across blocks with no collective); LOAD caps budget each block
+    # 1/stride of the headroom (_dst_load_caps). stride == 1 reduces to
+    # the exact round-17 formulas.
+    str_f = f32(stride)
     dst_caps = (guards.replica_cap or guards.replica_band
                 or guards.leader_band or guards.resources
                 or guards.cap_resources or guards.pot_nw_out)
     if dst_caps:
         dkey = jnp.where(sel, dst, b)
-        ds, _dp, d_i = jax.lax.sort((dkey, pos, pos), num_keys=2)
+        dkey_s = dkey * stride + blk_s if stride > 1 else dkey
+        ds, _dp, d_i = jax.lax.sort((dkey_s, pos, pos), num_keys=2)
+        ds_b = ds // stride if stride > 1 else ds
+        blk_d = (ds % stride).astype(f32) if stride > 1 \
+            else jnp.zeros((n,), f32)
         sel_d = sel[d_i]
         one_d = sel_d.astype(f32)
         okd = jnp.ones(n, bool)
@@ -596,7 +774,8 @@ def _direct_sweep(state: ClusterTensors, goals: tuple[Goal, ...], index: int,
                 cap_b = jnp.minimum(
                     cap_b, constraint.max_replicas_per_broker - reps)
             pre_cnt = _segment_exclusive(ds, one_d)
-            okd &= pre_cnt + 1.0 <= jnp.concatenate([cap_b, inf1])[ds] + _EPS
+            okd &= pre_cnt * str_f + blk_d + 1.0 \
+                <= jnp.concatenate([cap_b, inf1])[ds_b] + _EPS
         if guards.leader_band:
             lead_d = (is_lead[d_i] & sel_d).astype(f32)
             _ll, lu = count_limits(derived.avg_leaders,
@@ -604,11 +783,12 @@ def _direct_sweep(state: ClusterTensors, goals: tuple[Goal, ...], index: int,
             lcap = jnp.concatenate(
                 [lu - derived.broker_leaders.astype(f32), inf1])
             pre_lead = _segment_exclusive(ds, lead_d)
-            okd &= (lead_d == 0) | (pre_lead + 1.0 <= lcap[ds] + _EPS)
+            okd &= (lead_d == 0) \
+                | (pre_lead * str_f + blk_d + 1.0 <= lcap[ds_b] + _EPS)
         if guards.resources or guards.cap_resources:
             okd_load, _pre = _dst_load_caps(ds, load_vec[d_i] * sel_d[:, None],
                                             state, derived, constraint,
-                                            guards)
+                                            guards, ds_b=ds_b, share=str_f)
             okd &= okd_load
         if guards.pot_nw_out:
             r = int(Resource.NW_OUT)
@@ -629,16 +809,23 @@ def _direct_sweep(state: ClusterTensors, goals: tuple[Goal, ...], index: int,
             src_lim = jnp.concatenate([limit, inf1])
             src_d = jnp.minimum(src[d_i], b)
             src_viol = src_pot[src_d] > src_lim[src_d] + _EPS
-            okd &= (pt_pad[ds] + pre_pot + pot_own
-                    <= lim_pad[ds] + _EPS) | src_viol
+            okd &= (pt_pad[ds_b] + (pre_pot + pot_own) * str_f
+                    <= lim_pad[ds_b] + _EPS) | src_viol
         sel &= jnp.zeros(n, bool).at[d_i].set(okd)
 
     # --- prior-goal guards: src-sorted joint floors ----------------------
+    # Mirror of the dst caps: COUNT floors interleave outflow positions
+    # (k-th departure from block d holds global position k·stride + d),
+    # LOAD floors budget each block 1/stride of the slack above the band.
     src_floors = (guards.replica_band or guards.leader_band
                   or guards.resources)
     if src_floors:
         skey = jnp.where(sel, src, b)
-        ss, _sp, s_i = jax.lax.sort((skey, pos, pos), num_keys=2)
+        skey_s = skey * stride + blk_s if stride > 1 else skey
+        ss, _sp, s_i = jax.lax.sort((skey_s, pos, pos), num_keys=2)
+        ss_b = ss // stride if stride > 1 else ss
+        blk_o = (ss % stride).astype(f32) if stride > 1 \
+            else jnp.zeros((n,), f32)
         sel_s = sel[s_i]
         one_s = sel_s.astype(f32)
         oks = jnp.ones(n, bool)
@@ -651,7 +838,8 @@ def _direct_sweep(state: ClusterTensors, goals: tuple[Goal, ...], index: int,
                 [derived.broker_replicas.astype(f32),
                  jnp.zeros((1,), f32)])
             floor_pad = jnp.concatenate([jnp.broadcast_to(rl, (b,)), ninf1])
-            oks &= reps_pad[ss] - out_rank - 1.0 >= floor_pad[ss] - _EPS
+            oks &= reps_pad[ss_b] - (out_rank * str_f + blk_o) - 1.0 \
+                >= floor_pad[ss_b] - _EPS
         if guards.leader_band:
             lead_s = (is_lead[s_i] & sel_s).astype(f32)
             ll, _lu = count_limits(derived.avg_leaders,
@@ -661,26 +849,32 @@ def _direct_sweep(state: ClusterTensors, goals: tuple[Goal, ...], index: int,
             lfloor = jnp.concatenate([jnp.broadcast_to(ll, (b,)), ninf1])
             pre_lead_out = _segment_exclusive(ss, lead_s)
             oks &= (lead_s == 0) \
-                | (leads_pad[ss] - pre_lead_out - 1.0 >= lfloor[ss] - _EPS)
+                | (leads_pad[ss_b] - (pre_lead_out * str_f + blk_o) - 1.0
+                   >= lfloor[ss_b] - _EPS)
         if guards.resources:
             oks &= _src_load_floors(ss, load_vec[s_i] * sel_s[:, None],
-                                    state, derived, constraint, guards)
+                                    state, derived, constraint, guards,
+                                    ss_b=ss_b, share=str_f)
         sel &= jnp.zeros(n, bool).at[s_i].set(oks)
 
     # --- per-(topic, broker) band of a PRIOR topic goal ------------------
     if guards.topic_band and not isinstance(goal,
                                             TopicReplicaDistributionGoal):
-        tb = topic_broker_replica_counts(state, num_topics).astype(f32)
+        tb = ps(topic_broker_replica_counts(state, num_topics)).astype(f32)
         n_alive = jnp.maximum(alive.sum(), 1)
         t_avg = (tb * alive[None, :]).sum(axis=1) / n_alive
         t_up = jnp.ceil(t_avg * constraint.topic_replica_balance_threshold)
         t_lo = jnp.floor(t_avg / constraint.topic_replica_balance_threshold)
         topic_m = state.topic[p_m]
         # dst side: joint intake per (topic, dst) cell must stay under the
-        # prior topic band's upper.
+        # prior topic band's upper (interleaved positions at stride > 1).
         tdkey = jnp.where(sel, topic_m * (b + 1) + dst,
                           num_topics * (b + 1)).astype(jnp.int32)
-        ts, _tp, t_i = jax.lax.sort((tdkey, pos, pos), num_keys=2)
+        tdkey_s = tdkey * stride + blk_s if stride > 1 else tdkey
+        ts, _tp, t_i = jax.lax.sort((tdkey_s, pos, pos), num_keys=2)
+        ts_b = ts // stride if stride > 1 else ts
+        blk_t = (ts % stride).astype(f32) if stride > 1 \
+            else jnp.zeros((n,), f32)
         sel_t = sel[t_i].astype(f32)
         pre_td = _segment_exclusive(ts, sel_t)
         tb_pad = jnp.concatenate([tb, jnp.zeros((num_topics, 1), f32)],
@@ -690,20 +884,26 @@ def _direct_sweep(state: ClusterTensors, goals: tuple[Goal, ...], index: int,
             [jnp.broadcast_to(t_up[:, None], (num_topics, b + 1)).reshape(-1),
              jnp.full((1,), jnp.inf, f32)])
         okt = (sel_t == 0) \
-            | (tb_pad[ts] + pre_td + 1.0 <= up_flat[ts] + _EPS)
+            | (tb_pad[ts_b] + pre_td * str_f + blk_t + 1.0
+               <= up_flat[ts_b] + _EPS)
         sel &= jnp.zeros(n, bool).at[t_i].set(okt)
         # src side: joint outflow per (topic, src) must stay at/above the
         # prior topic band's lower.
         tskey = jnp.where(sel, topic_m * (b + 1) + src,
                           num_topics * (b + 1)).astype(jnp.int32)
-        ts2, _tp2, t2_i = jax.lax.sort((tskey, pos, pos), num_keys=2)
+        tskey_s = tskey * stride + blk_s if stride > 1 else tskey
+        ts2, _tp2, t2_i = jax.lax.sort((tskey_s, pos, pos), num_keys=2)
+        ts2_b = ts2 // stride if stride > 1 else ts2
+        blk_t2 = (ts2 % stride).astype(f32) if stride > 1 \
+            else jnp.zeros((n,), f32)
         sel_t2 = sel[t2_i].astype(f32)
         pre_ts = _segment_exclusive(ts2, sel_t2)
         lo_flat = jnp.concatenate(
             [jnp.broadcast_to(t_lo[:, None], (num_topics, b + 1)).reshape(-1),
              jnp.full((1,), -jnp.inf, f32)])
         okt2 = (sel_t2 == 0) \
-            | (tb_pad[ts2] - pre_ts - 1.0 >= lo_flat[ts2] - _EPS)
+            | (tb_pad[ts2_b] - (pre_ts * str_f + blk_t2) - 1.0
+               >= lo_flat[ts2_b] - _EPS)
         sel &= jnp.zeros(n, bool).at[t2_i].set(okt2)
 
     # --- one-shot scatter apply ------------------------------------------
@@ -711,8 +911,8 @@ def _direct_sweep(state: ClusterTensors, goals: tuple[Goal, ...], index: int,
     new_assignment = state.assignment.at[rows, s_m].set(
         dst.astype(state.assignment.dtype), mode="drop")
     return (dataclasses.replace(state, assignment=new_assignment),
-            sel.sum().astype(jnp.int32),
-            mover.sum().astype(jnp.int32))
+            ps(sel.sum().astype(jnp.int32)),
+            ps(mover.sum().astype(jnp.int32)))
 
 
 def _sweep_fn(goals: tuple[Goal, ...], index: int):
@@ -738,35 +938,68 @@ def _stall_limit(goals: tuple[Goal, ...], index: int) -> int:
 def _direct_rounds_driver(state: ClusterTensors, goals: tuple[Goal, ...],
                           index: int, constraint: BalancingConstraint,
                           num_topics: int, masks: ExclusionMasks,
-                          max_sweeps: int):
+                          max_sweeps: int, rank_stride: int = 1,
+                          block: jax.Array | int = 0, psum=None,
+                          margin_frac: float = 0.25,
+                          seed: int = SPARSE_ROUNDING_SEED):
     """Sweep loop (traced): unlike the greedy megastep's zero-APPLY exit,
     the direct loop keeps sweeping while the plan still has MOVERS —
     a sweep whose every pairing was feasibility-vetoed applies nothing,
     but the next sweep's rotation can re-pair the residue. A bounded
     zero-apply STREAK (``_stall_limit``) still ends a stalled loop: a
     structurally-stuck residue must fall to the greedy polish, not burn
-    the whole ``max_sweeps`` budget recomputing vetoed plans."""
+    the whole ``max_sweeps`` budget recomputing vetoed plans.
+
+    A second streak watches PROGRESS: because the fractional plan keeps
+    a headroom/widening tail alive until every deficit is filled, a
+    wedged residue can apply a tiny trickle of moves each sweep without
+    ever shrinking the plan — the zero-apply streak never fires and the
+    loop burns the whole budget on a plateau (measured at 200b/10k/40t:
+    all three count goals ran 13-16 of 16 sweeps for moves the polish
+    replays in 2-4 rounds). A sweep must shrink ``planned`` by at least
+    an EIGHTH below the best seen so far to reset the streak;
+    ``_stall_limit`` consecutive non-improving sweeps end the loop. The
+    geometric bar (not strict decrease) matters twice over: the
+    per-sweep rounding re-draw wobbles the plan by ±1 per group per
+    plane, so a plateau still "improves" by one count every few sweeps,
+    and a sweep costs roughly 1.3 greedy polish rounds — progress in
+    single counts per sweep is cheaper replayed by the polish, which
+    the caller already sizes from the stranded residue.
+
+    ``(rank_stride, block, psum)`` thread the SPMD layout (module
+    docstring) so the mesh path can run THIS loop per shard — the
+    returned scalars are already psum'd global, so the while predicate
+    agrees across devices by construction."""
     if not direct_eligible(goals, index):   # trace-time guard
         raise ValueError(
             f"goal {goals[index].name} / chain prefix not direct-eligible "
             "(see direct_eligible)")
     sweep_fn = _sweep_fn(goals, index)
     stall = _stall_limit(goals, index)
+    big = jnp.int32(jnp.iinfo(jnp.int32).max)
 
     def cond(c):
-        _st, _tot, i, planned, zeros = c
-        return (planned > 0) & (i < max_sweeps) & (zeros < stall)
+        _st, _tot, i, planned, zeros, _best, noprog = c
+        return ((planned > 0) & (i < max_sweeps) & (zeros < stall)
+                & (noprog < stall))
 
     def body(c):
-        st, tot, i, _planned, zeros = c
+        st, tot, i, _planned, zeros, best, noprog = c
         ns, applied, planned = sweep_fn(st, goals, index, constraint,
-                                        num_topics, masks, sweep=i)
+                                        num_topics, masks, sweep=i,
+                                        rank_stride=rank_stride, block=block,
+                                        psum=psum, margin_frac=margin_frac,
+                                        seed=seed)
         zeros = jnp.where(applied > 0, jnp.int32(0), zeros + 1)
-        return ns, tot + applied, i + 1, planned, zeros
+        improved = planned < best - best // 8
+        noprog = jnp.where(improved, jnp.int32(0), noprog + 1)
+        return (ns, tot + applied, i + 1, planned, zeros,
+                jnp.minimum(best, planned), noprog)
 
-    final, total, sweeps, planned, _z = jax.lax.while_loop(
+    final, total, sweeps, planned, _z, _b, _np = jax.lax.while_loop(
         cond, body,
-        (state, jnp.int32(0), jnp.int32(0), jnp.int32(1), jnp.int32(0)))
+        (state, jnp.int32(0), jnp.int32(0), jnp.int32(1), jnp.int32(0),
+         big, jnp.int32(0)))
     # ``planned`` at exit = movers the plan still wanted but could not
     # place (0 when the transport fully converged): the caller's honest
     # residue signal for sizing the greedy polish.
@@ -774,21 +1007,25 @@ def _direct_rounds_driver(state: ClusterTensors, goals: tuple[Goal, ...],
 
 
 @partial(jax.jit, static_argnames=("goals", "index", "constraint",
-                                   "num_topics", "max_sweeps"))
+                                   "num_topics", "max_sweeps",
+                                   "margin_frac", "seed"))
 def direct_transport_rounds(state: ClusterTensors, goals: tuple[Goal, ...],
                             index: int, constraint: BalancingConstraint,
                             num_topics: int, masks: ExclusionMasks,
-                            max_sweeps: int = 8):
+                            max_sweeps: int = 8, margin_frac: float = 0.25,
+                            seed: int = SPARSE_ROUNDING_SEED):
     """The direct-assignment solve for ``goals[index]`` under the guards
     of ``goals[:index]``: up to ``max_sweeps`` transport sweeps inside
     ONE ``lax.while_loop`` dispatch (a stalled loop ends on device).
     Returns (final_state, moves_applied, sweeps_run, movers_stranded)."""
     return _direct_rounds_driver(state, goals, index, constraint,
-                                 num_topics, masks, max_sweeps)
+                                 num_topics, masks, max_sweeps,
+                                 margin_frac=margin_frac, seed=seed)
 
 
 @partial(jax.jit, static_argnames=("goals", "index", "constraint",
-                                   "num_topics", "max_sweeps"),
+                                   "num_topics", "max_sweeps",
+                                   "margin_frac", "seed"),
          donate_argnums=(0, 1))
 def direct_transport_rounds_donated(assignment: jax.Array,
                                     leader_slot: jax.Array,
@@ -796,7 +1033,9 @@ def direct_transport_rounds_donated(assignment: jax.Array,
                                     goals: tuple[Goal, ...], index: int,
                                     constraint: BalancingConstraint,
                                     num_topics: int, masks: ExclusionMasks,
-                                    max_sweeps: int = 8):
+                                    max_sweeps: int = 8,
+                                    margin_frac: float = 0.25,
+                                    seed: int = SPARSE_ROUNDING_SEED):
     """Donated twin (identical trace): callers pass
     ``chain.strip_mutable(state)`` as ``rest`` and relinquish the two
     mutable tensors — the donation set is exactly the strip_mutable pair,
@@ -804,7 +1043,8 @@ def direct_transport_rounds_donated(assignment: jax.Array,
     state = dataclasses.replace(rest, assignment=assignment,
                                 leader_slot=leader_slot)
     final, total, sweeps, planned = _direct_rounds_driver(
-        state, goals, index, constraint, num_topics, masks, max_sweeps)
+        state, goals, index, constraint, num_topics, masks, max_sweeps,
+        margin_frac=margin_frac, seed=seed)
     return final.assignment, final.leader_slot, total, sweeps, planned
 
 
@@ -816,7 +1056,8 @@ def _megabatch_direct_driver(states: ClusterTensors, active0: jax.Array,
                              goals: tuple[Goal, ...], index: int,
                              constraint: BalancingConstraint,
                              num_topics: int, masks: ExclusionMasks,
-                             max_sweeps: int):
+                             max_sweeps: int, margin_frac: float = 0.25,
+                             seed: int = SPARSE_ROUNDING_SEED):
     """Batched sweep loop with the megabatch freeze discipline: an
     inactive cluster's whole state is frozen by a select, so a pad slot
     (or a cluster whose plan converged) stays byte-identical while its
@@ -836,7 +1077,8 @@ def _megabatch_direct_driver(states: ClusterTensors, active0: jax.Array,
 
     def per_cluster(st, tm, rm, lm, i):
         return sweep_fn(st, goals, index, constraint, num_topics,
-                        ExclusionMasks(tm, rm, lm), sweep=i)
+                        ExclusionMasks(tm, rm, lm), sweep=i,
+                        margin_frac=margin_frac, seed=seed)
 
     vsweep = jax.vmap(per_cluster, in_axes=(0,) + ax + (None,))
 
@@ -867,21 +1109,25 @@ def _megabatch_direct_driver(states: ClusterTensors, active0: jax.Array,
 
 
 @partial(jax.jit, static_argnames=("goals", "index", "constraint",
-                                   "num_topics", "max_sweeps"))
+                                   "num_topics", "max_sweeps",
+                                   "margin_frac", "seed"))
 def megabatch_direct_rounds(states: ClusterTensors, active0: jax.Array,
                             goals: tuple[Goal, ...], index: int,
                             constraint: BalancingConstraint,
                             num_topics: int, masks: ExclusionMasks,
-                            max_sweeps: int = 8):
+                            max_sweeps: int = 8, margin_frac: float = 0.25,
+                            seed: int = SPARSE_ROUNDING_SEED):
     """Batched direct solve over a leading cluster axis. Returns
     (states, moves[C], sweeps[C], active_out[C])."""
     return _megabatch_direct_driver(states, active0, goals, index,
                                     constraint, num_topics, masks,
-                                    max_sweeps)
+                                    max_sweeps, margin_frac=margin_frac,
+                                    seed=seed)
 
 
 @partial(jax.jit, static_argnames=("goals", "index", "constraint",
-                                   "num_topics", "max_sweeps"),
+                                   "num_topics", "max_sweeps",
+                                   "margin_frac", "seed"),
          donate_argnums=(0, 1))
 def megabatch_direct_rounds_donated(assignment: jax.Array,
                                     leader_slot: jax.Array,
@@ -889,7 +1135,9 @@ def megabatch_direct_rounds_donated(assignment: jax.Array,
                                     goals: tuple[Goal, ...], index: int,
                                     constraint: BalancingConstraint,
                                     num_topics: int, masks: ExclusionMasks,
-                                    max_sweeps: int = 8):
+                                    max_sweeps: int = 8,
+                                    margin_frac: float = 0.25,
+                                    seed: int = SPARSE_ROUNDING_SEED):
     """Donated batched twin: donation set is exactly the strip_mutable
     pair grown a cluster axis ``{assignment[C,P,S], leader_slot[C,P]}``
     (CCSA002); the stacked topology planes in ``rest`` are
@@ -898,7 +1146,7 @@ def megabatch_direct_rounds_donated(assignment: jax.Array,
                                  leader_slot=leader_slot)
     final, total, sweeps, active = _megabatch_direct_driver(
         states, active0, goals, index, constraint, num_topics, masks,
-        max_sweeps)
+        max_sweeps, margin_frac=margin_frac, seed=seed)
     return final.assignment, final.leader_slot, total, sweeps, active
 
 
@@ -929,6 +1177,10 @@ def run_direct_pass(state: ClusterTensors, goals, index: int,
     from .chain import donation_enabled, strip_mutable
     goals = tuple(goals)
     donate = donation_enabled(megastep)
+    margin_frac = float(getattr(megastep, "direct_sparse_margin", 0.25))
+    seed = sparse_rounding_seed(getattr(megastep, "direct_sparse_salt", ""))
+    # ccsa: ok[CCSA004] flight-telemetry stamp on the host driver — the
+    # value never feeds the plan or the rounding seed
     t0 = _time.monotonic()
     if donate:
         if not donate_input:
@@ -937,14 +1189,18 @@ def run_direct_pass(state: ClusterTensors, goals, index: int,
                 leader_slot=jnp.copy(state.leader_slot))
         a, l, total, sweeps, planned = direct_transport_rounds_donated(
             state.assignment, state.leader_slot, strip_mutable(state),
-            goals, index, constraint, num_topics, masks, max_sweeps)
+            goals, index, constraint, num_topics, masks, max_sweeps,
+            margin_frac=margin_frac, seed=seed)
         state = dataclasses.replace(state, assignment=a, leader_slot=l)
     else:
         state, total, sweeps, planned = direct_transport_rounds(
-            state, goals, index, constraint, num_topics, masks, max_sweeps)
+            state, goals, index, constraint, num_topics, masks, max_sweeps,
+            margin_frac=margin_frac, seed=seed)
     moves = int(total)
     sweeps_run = int(sweeps)
     stranded = int(planned)
+    # ccsa: ok[CCSA004] flight-telemetry stamp on the host driver — the
+    # value never feeds the plan or the rounding seed
     elapsed = _time.monotonic() - t0
     if stats is not None:
         stats.record("direct", sweeps_run, donated=donate)
@@ -953,4 +1209,5 @@ def run_direct_pass(state: ClusterTensors, goals, index: int,
                         donated=donate, elapsed_s=elapsed)
     SENSORS.count("solver_direct_sweeps", sweeps_run)
     SENSORS.count("solver_direct_moves", moves)
+    SENSORS.count("solver_direct_stranded", stranded)
     return state, moves, sweeps_run, donate, stranded

@@ -183,6 +183,10 @@ class GoalOptimizer:
             "solver.direct.assignment.enabled")
         self._direct_max_sweeps = self._config.get_int(
             "solver.direct.max.sweeps")
+        self._direct_sparse_margin = self._config.get_double(
+            "solver.direct.sparse.margin.frac")
+        self._direct_sparse_salt = self._config.get_string(
+            "solver.direct.sparse.rounding.salt")
         # Fingerprint goal skipping (round 18): ONE batched stats program
         # snapshots every goal's entry violation before the bounded
         # per-goal loop; goals with nothing to do consume zero dispatches
@@ -325,7 +329,9 @@ class GoalOptimizer:
             # REPLACES deficit-sized greedy there; below the gate the
             # greedy path is kept byte-identical (the parity pins).
             direct_assignment=self._direct_enabled and in_regime,
-            direct_max_sweeps=self._direct_max_sweeps)
+            direct_max_sweeps=self._direct_max_sweeps,
+            direct_sparse_margin=self._direct_sparse_margin,
+            direct_sparse_salt=self._direct_sparse_salt)
 
     def deficit_sizing_active(self, num_brokers: int) -> bool:
         """Whether a SERIAL solve of this broker count would run

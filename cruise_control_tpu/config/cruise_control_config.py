@@ -396,6 +396,25 @@ def _definition() -> ConfigDef:
              "no movers remain OR a few consecutive sweeps apply nothing "
              "(a stalled rotation), so budget beyond convergence is "
              "near-free.")
+    d.define("solver.direct.sparse.margin.frac", T.DOUBLE, 0.25,
+             Range.between(0.0, 0.5), I.LOW,
+             "Fractional band-edge margin of the sparse-aware transport "
+             "plan (round 21): shed targets sit margin.frac x band-width "
+             "inside the upper edge, fill targets the mirror above the "
+             "lower (never below half a count, so 1-count bands keep a "
+             "center-ward pull), and deterministic randomized rounding "
+             "resolves the fractional per-cell targets so EXPECTED "
+             "counts equal the fractional band math in every density "
+             "regime. 0 reproduces the parked-at-the-edge plans that "
+             "stalled the greedy polish; 0.5 pulls everything to the "
+             "band center.")
+    d.define("solver.direct.sparse.rounding.salt", T.STRING, "", None, I.LOW,
+             "Extra salt folded (crc32, trace time) into the sparse "
+             "plan's deterministic rounding seed. Empty keeps the "
+             "module's fixed crc32 seed — byte-identical replays per "
+             "configuration (the CCSA004 contract); fleets set distinct "
+             "salts to decorrelate rounding across replicas without "
+             "giving up determinism within each.")
     d.define("solver.fingerprint.skip.enabled", T.BOOLEAN, True, None, I.LOW,
              "Always-hot solver (round 18): snapshot EVERY goal's entry "
              "violation in ONE batched stats program before the bounded "

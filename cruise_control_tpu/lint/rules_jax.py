@@ -86,7 +86,11 @@ class HostSyncInPumpRule(Rule):
     #: pipelines (single-cluster, sharded, and the fleet megabatch) plus
     #: the direct-assignment transport kernels (round 17: its donated
     #: jits are detected structurally, and any host sync traced into a
-    #: sweep body would be a silent per-compile constant).
+    #: sweep body would be a silent per-compile constant). The round-21
+    #: sparse-plan kernels ride the same set: the fractional/rounding
+    #: planes live in analyzer/direct.py and the mesh rank_stride twins
+    #: in parallel/chain_sharded.py — both already pump files, so their
+    #: donated forms are regions from the moment they are written.
     PUMP_FILES = ("cruise_control_tpu/analyzer/chain.py",
                   "cruise_control_tpu/analyzer/direct.py",
                   "cruise_control_tpu/parallel/chain_sharded.py",

@@ -46,6 +46,9 @@ from ..analyzer.chain import (
 )
 from ..analyzer.constraint import BalancingConstraint
 from ..analyzer.derived import compute_derived
+from ..analyzer.direct import (
+    _direct_rounds_driver, direct_eligible, sparse_rounding_seed,
+)
 from ..analyzer.fill import targets_enabled
 from ..analyzer.search import (
     _OFFLINE_BONUS, _EPS_IMPROVEMENT, ExclusionMasks, SearchConfig,
@@ -844,6 +847,59 @@ def _make_chain_phase_kernels(mesh: Mesh, goals, constraint,
     return move, swap, stats, move_d, swap_d
 
 
+@lru_cache(maxsize=64)
+def _make_direct_phase_kernels(mesh: Mesh, goals, index: int, constraint,
+                               num_topics: int,
+                               mask_presence: tuple[bool, bool, bool],
+                               max_sweeps: int, margin_frac: float,
+                               seed: int):
+    """Sharded direct-transport kernel pair for ONE goal index. Unlike
+    the move/swap kernels (traced ``active_idx`` + prior mask, one
+    compile per chain), the direct sweep bodies are selected by
+    TRACE-TIME Python dispatch on the goal index (``_sweep_fn`` /
+    ``_guards_for`` build the guard closure from ``goals[:index]``), so
+    the mesh kernel is built per-(mesh, index) — the lru_cache bounds
+    the set to the direct-eligible count goals actually reached.
+
+    The body is the SAME sweep driver as the single-device path, run
+    per-shard under the interleaved rank layout: every device ranks only
+    its local replica rows but occupies global fill positions
+    ``local_rank * num_shards + device`` (``rank_stride``/``block``), so
+    the union of per-device movers tiles each cell's surplus exactly —
+    no device claims another's positions and the joint plan equals the
+    single-device plan under a row permutation. Count/load caps budget
+    each device ``1/num_shards`` of every band, and the returned scalars
+    are psum'd global, so the while-loop predicate agrees across devices
+    by construction."""
+    shards = mesh.devices.size
+    rep = P()
+
+    def direct_body(state, masks):
+        return _direct_rounds_driver(
+            state, goals, index, constraint, num_topics, masks, max_sweeps,
+            rank_stride=shards, block=jax.lax.axis_index(PARTITION_AXIS),
+            psum=_psum, margin_frac=margin_frac, seed=seed)
+
+    def direct_body_donated(assignment, leader_slot, rest, masks):
+        st = dataclasses.replace(rest, assignment=assignment,
+                                 leader_slot=leader_slot)
+        final, total, sweeps, planned = direct_body(st, masks)
+        return final.assignment, final.leader_slot, total, sweeps, planned
+
+    mask_specs = _mask_specs(mask_presence)
+    part_a, part_l = mutable_state_specs()
+    direct_k = jax.jit(shard_map(
+        direct_body, mesh=mesh,
+        in_specs=(_state_specs(), mask_specs),
+        out_specs=(_state_specs(), rep, rep, rep), check_vma=False))
+    direct_d = jax.jit(shard_map(
+        direct_body_donated, mesh=mesh,
+        in_specs=(part_a, part_l, _state_specs(), mask_specs),
+        out_specs=(part_a, part_l, rep, rep, rep), check_vma=False),
+        donate_argnums=(0, 1))
+    return direct_k, direct_d
+
+
 def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
                                     num_topics, mesh, masks, presence,
                                     swap_moves, swap_max_rounds,
@@ -874,15 +930,21 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
     async_rb = bool(megastep.async_readback) if megastep is not None \
         else False
     deficit_cap = megastep.deficit_moves_cap if megastep is not None else 0
-    # Direct-assignment mode (megastep.direct_assignment) is ACCEPTED but
-    # intentionally a no-op on the mesh path: the transport kernel ranks
-    # movers within each (group, broker) cell over the FULL replica axis,
-    # which is partition-sharded here — device-local ranks would each
-    # claim the cell's whole global surplus and jointly overshoot it, so
-    # the mesh keeps the deficit-sized greedy below (same trajectory and
-    # compiled-program set as before the flag existed). Interleaved
-    # rank_stride/rank_offset fill positions (the target_dests treatment)
-    # are the prepared extension if the mesh ever needs the direct path.
+    # Direct-assignment mode on the mesh (round 21): the sweep kernels
+    # carry the interleaved (rank_stride, block) layout, so each device
+    # ranks its LOCAL replica rows into global fill positions
+    # rank·shards + device — the per-device plans tile each cell's
+    # surplus instead of jointly overshooting it, and the pre-pass runs
+    # here exactly as on the single-device bounded path (one dispatch,
+    # kind="direct", greedy polish after).
+    direct_enabled = bool(megastep is not None
+                          and megastep.direct_assignment)
+    direct_sweeps_cap = (int(megastep.direct_max_sweeps)
+                         if megastep is not None else 16)
+    direct_margin = (float(megastep.direct_sparse_margin)
+                     if megastep is not None else 0.25)
+    direct_seed = sparse_rounding_seed(
+        megastep.direct_sparse_salt if megastep is not None else "")
     # Deficit-sized count goals run wide-cost-class rounds (sizing can
     # multiply sources/moves 10-60x), so they get their OWN controller —
     # the single-device path's narrow/wide split: a budget learned on
@@ -935,6 +997,43 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
                                 async_readback=async_rb, stats=stats,
                                 kind=phase, flight=goal_flight)
 
+    def run_direct(st, g, goal_flight):
+        """Direct-transport pre-pass for goal index ``g``: one sharded
+        dispatch, synchronous scalar readback (nothing to pipeline
+        behind a single dispatch) — the mesh twin of
+        ``direct.run_direct_pass`` with the same donation discipline
+        and kind="direct" stats/flight accounting."""
+        import time as _time
+
+        from ..utils.sensors import SENSORS
+        direct_k, direct_d = _make_direct_phase_kernels(
+            mesh, goals, g, constraint, num_topics, presence,
+            direct_sweeps_cap, direct_margin, direct_seed)
+        t0 = _time.monotonic()
+        if donate:
+            if not can_donate[0]:
+                st = dataclasses.replace(
+                    st, assignment=jnp.copy(st.assignment),
+                    leader_slot=jnp.copy(st.leader_slot))
+            a, l, total, sweeps, planned = direct_d(
+                st.assignment, st.leader_slot, strip_mutable(st), masks)
+            st = dataclasses.replace(st, assignment=a, leader_slot=l)
+            can_donate[0] = True
+        else:
+            st, total, sweeps, planned = direct_k(st, masks)
+        moves = int(total)
+        sweeps_run = int(sweeps)
+        stranded = int(planned)
+        elapsed = _time.monotonic() - t0
+        if stats is not None:
+            stats.record("direct", sweeps_run, donated=donate)
+        goal_flight.dispatch("direct", direct_sweeps_cap, sweeps_run,
+                             moves, donated=donate, elapsed_s=elapsed)
+        SENSORS.count("solver_direct_sweeps", sweeps_run)
+        SENSORS.count("solver_direct_moves", moves)
+        SENSORS.count("solver_direct_stranded", stranded)
+        return st, moves, sweeps_run, stranded
+
     for g, goal in enumerate(goals):
         idx = jnp.int32(g)
         prior = jnp.asarray([j < g for j in range(len(goals))])
@@ -945,14 +1044,41 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
         gf = flight.goal(goal.name)
         gf.entry(violation=float(viol0), objective=float(obj0),
                  offline=int(offline0))
+        # The fused kernel's per-goal fast path: zero violations + no
+        # offline replicas + no drain pending = skip entirely. Drain
+        # pending mirrors _chain_full_local.drain_pending — an alive
+        # excluded broker STILL HOSTING replicas, not mere mask presence
+        # (presence alone would run every goal on an already-drained
+        # cluster that the fused path skips).
+        drain = False
+        if masks.excluded_replica_move_brokers is not None:
+            drain = bool(excluded_hosting_replicas(
+                state, masks.excluded_replica_move_brokers).any())
+        ran = float(viol0) > 0 or int(offline0) > 0 or drain
+        moves_total = swaps_total = rounds = 0
+        # Direct-assignment pre-pass (optimize_goal_in_chain semantics):
+        # enabled kernel, guard-representable chain prefix, clean model —
+        # offline replicas and drains keep the full greedy trajectory.
+        use_direct = (direct_enabled and int(offline0) == 0 and not drain
+                      and direct_eligible(goals, g))
+        sizing_viol = float(viol0)
+        if ran and use_direct and float(viol0) > 0:
+            state, d_moves, _d_sweeps, d_stranded = run_direct(state, g, gf)
+            moves_total += d_moves
+            # Size the greedy POLISH from the larger of two residual
+            # estimates (chain.py's post-direct re-size): entry
+            # violations minus applied transport moves, and 2x the
+            # movers the plan wanted but feasibility refused to place.
+            sizing_viol = max(float(viol0) - float(d_moves),
+                              2.0 * float(d_stranded))
         # Deficit-aware sizing for count goals (chain.deficit_sized_config
         # semantics): a sized config selects its own phase kernels — the
         # lru_cached factory bounds the compile set to the pow2-quantized
         # widths actually reached.
         cfg_g = cfg
         if deficit_cap > 0 and goal.count_based:
-            cfg_g = deficit_sized_config(cfg, float(viol0), deficit_cap)
-            gf.sizing(entry_violation=float(viol0),
+            cfg_g = deficit_sized_config(cfg, sizing_viol, deficit_cap)
+            gf.sizing(entry_violation=sizing_viol,
                       base_moves=cfg.moves_per_round,
                       base_sources=cfg.num_sources,
                       sized_moves=cfg_g.moves_per_round,
@@ -966,18 +1092,6 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
         # (mirrors the single-device per-goal dispatch= routing).
         ctl_g = controller_wide if (deficit_cap > 0 and goal.count_based) \
             else controller
-        moves_total = swaps_total = rounds = 0
-        # The fused kernel's per-goal fast path: zero violations + no
-        # offline replicas + no drain pending = skip entirely. Drain
-        # pending mirrors _chain_full_local.drain_pending — an alive
-        # excluded broker STILL HOSTING replicas, not mere mask presence
-        # (presence alone would run every goal on an already-drained
-        # cluster that the fused path skips).
-        drain = False
-        if masks.excluded_replica_move_brokers is not None:
-            drain = bool(excluded_hosting_replicas(
-                state, masks.excluded_replica_move_brokers).any())
-        ran = float(viol0) > 0 or int(offline0) > 0 or drain
         if ran:
             while rounds < cfg.max_rounds:
                 state, m_, r = run_pass(kernels_g, "move", state, idx,

@@ -30,9 +30,15 @@ from functools import partial
 # the one-shot scatter apply of a full mover batch — so the ROADMAP
 # item-2 chip campaign can attribute the new kernel in the same
 # ``GET /profile?microbench=true`` call as the greedy round's classes.
+# The round-21 sparse plan adds three more: the cell-aggregate segment
+# sum onto the [G, B] count plane, the fractional-target systematic
+# rounding (hash uniforms + per-group cumsum diff), and the
+# stride-interleaved composite-key sort the mesh rank layout pays
+# instead of the plain segsort.
 CASE_NAMES = ("topk128", "topk1024", "approx1024", "segsum", "segmax",
               "gather_grid", "scatter_m", "elemwise", "pairwise_m",
-              "segsort", "rankfill", "scatter_apply")
+              "segsort", "rankfill", "scatter_apply",
+              "cell_segsum", "frac_round", "stride_sort")
 
 
 def _build_cases(brokers: int, partitions: int):
@@ -120,6 +126,52 @@ def _build_cases(brokers: int, partitions: int):
                                              prof, elig)
                 return v + ok.sum() * 1e-12 + dst.sum() * 1e-12
             return loop(bd, x, iters)
+        if which == "cell_segsum":
+            # direct.py's count-plane aggregation: segment_sum of the
+            # flattened replica axis onto [G, B] cells via the composite
+            # cell id grp·(B+1)+broker (the +1 row absorbs unassigned).
+            g_rows = 64
+            cell = (seg % g_rows) * (brokers + 1) + seg
+
+            def bd(v):
+                plane = jax.ops.segment_sum(
+                    jnp.ones_like(v), cell,
+                    num_segments=g_rows * (brokers + 1))
+                return v + plane[cell] * 1e-9
+            return loop(bd, x, iters)
+        if which == "frac_round":
+            # The sparse plan's fractional-target rounding: splitmix
+            # hash uniforms per group, then the systematic cumsum-diff
+            # rounding over the [G, B] plane (analyzer.direct round 21).
+            from ..analyzer.direct import (
+                SPARSE_ROUNDING_SEED, _hash_uniform, _round_systematic,
+            )
+            g_rows = 64
+            frac = jnp.abs(jax.random.normal(key, (g_rows, brokers))) * 0.7
+            gids = jnp.arange(g_rows, dtype=jnp.int32)
+
+            def bd(v):
+                u = _hash_uniform(gids, v[0, 0].astype(jnp.int32),
+                                  SPARSE_ROUNDING_SEED)
+                t = _round_systematic(frac + v * 1e-9, u)
+                return v + t * 1e-9
+            return loop(bd, frac, iters)
+        if which == "stride_sort":
+            # The mesh rank layout's extra cost over plain segsort: the
+            # composite (key·stride + block) two-key sort PLUS the
+            # second group-ordinal sort frame (analyzer.direct round
+            # 21, rank_stride treatment).
+            stride = 8
+            idx = jnp.arange(n_flat, dtype=jnp.int32)
+            blk = idx % stride
+            ck = seg.astype(jnp.int32) * stride + blk
+
+            def bd(v):
+                cs, cv, ci = jax.lax.sort((ck, v, idx), num_keys=2)
+                gb = (cs // stride) * stride + blk[ci]
+                gs, _gv, _gi = jax.lax.sort((gb, cv, ci), num_keys=2)
+                return v + cv * 1e-9 + (gs[:1] - gs[:1]).astype(v.dtype)
+            return loop(bd, x, iters)
         if which == "scatter_apply":
             # one-shot scatter apply of a full mover batch onto [P, S].
             plane = jnp.zeros((partitions, s), jnp.int32)
@@ -138,7 +190,8 @@ def _build_cases(brokers: int, partitions: int):
     inputs = {"topk128": w, "topk1024": w, "approx1024": w, "segsum": w,
               "segmax": w, "gather_grid": gscore, "scatter_m": loads,
               "elemwise": w, "pairwise_m": mvals, "segsort": w,
-              "rankfill": w, "scatter_apply": w}
+              "rankfill": w, "scatter_apply": w, "cell_segsum": w,
+              "frac_round": w, "stride_sort": w}
     return run, inputs
 
 

@@ -31,3 +31,49 @@ def run_direct_pass(state, plan):
     # NOT a region (plain host driver): a synchronous readback after a
     # single dispatch is the documented contract — silent here.
     return int(plan)
+
+
+# --- round 21: mesh traced-driver donation form (CCSA002) ----------------
+# The sharded direct pre-pass donates THROUGH shard_map: the argnums
+# must resolve to the body's same-position parameters, exactly like the
+# megabatch's vmap form.
+from jax.experimental.shard_map import shard_map  # noqa: E402
+
+MESH = None
+SPECS = None
+
+
+def mesh_direct_body_donated(assignment, leader_slot, rest, masks):
+    return assignment, leader_slot
+
+
+mesh_direct_bad = jax.jit(
+    shard_map(mesh_direct_body_donated, mesh=MESH, in_specs=SPECS,
+              out_specs=SPECS),
+    donate_argnums=(0, 1, 2))   # finding: CCSA002 — `rest` is topology
+
+mesh_direct_ok = jax.jit(
+    shard_map(mesh_direct_body_donated, mesh=MESH, in_specs=SPECS,
+              out_specs=SPECS),
+    donate_argnums=(0, 1))      # clean: exactly the strip_mutable pair
+
+
+# --- round 21: sparse-plan rounding PRNG (CCSA004) -----------------------
+# Under the spoofed analyzer/direct.py path the module carries the
+# byte-identical replan contract: rounding uniforms come from the
+# crc32-seeded splitmix hash ONLY.
+import random  # noqa: E402
+import zlib  # noqa: E402
+
+
+def rounding_seed_bad():
+    return random.random()          # finding: CCSA004 global-random draw
+
+
+def rounding_seed_good(salt: str) -> int:
+    return zlib.crc32(salt.encode("utf-8"))   # clean: crc32 derivation
+
+
+def rounding_jitter_tolerated():
+    # ccsa: ok[CCSA004] fixture: documented non-replayed diagnostic
+    return random.uniform(0.0, 1.0)

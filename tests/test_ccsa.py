@@ -119,10 +119,43 @@ def test_ccsa001_direct_kernel_fixture():
 
 
 def test_ccsa002_direct_fixture():
+    """Decorator form (round 17) AND the round-21 mesh traced-driver
+    form: donation through ``jax.jit(shard_map(body, ...))`` resolves
+    the argnums to the body's same-position parameters, so donating the
+    topology `rest` fires in both shapes and the strip_mutable pair
+    stays clean."""
     ctx = ctx_for(FIXTURES / "bad_direct.py")
     active, _suppressed = findings_of("CCSA002", ctx)
-    assert len(active) == 1
-    assert "rest" in active[0].message
+    assert len(active) == 2
+    assert all("rest" in f.message for f in active)
+
+
+def test_ccsa004_direct_rounding_fixture():
+    """Round-21 scoping: analyzer/direct.py is a deterministic module —
+    the rounding PRNG must be crc32-seeded derivation only, so a global
+    `random` draw fires under the spoofed path, the documented
+    suppression holds, the crc32 helper stays clean, and the fixture is
+    silent under its own (non-deterministic-module) path."""
+    spoofed = ctx_for(FIXTURES / "bad_direct.py",
+                      "cruise_control_tpu/analyzer/direct.py")
+    active, suppressed = findings_of("CCSA004", spoofed)
+    assert len(active) == 1           # random.random() in rounding_seed_bad
+    assert "random.random" in active[0].message
+    assert len(suppressed) == 1       # the annotated random.uniform
+    plain = ctx_for(FIXTURES / "bad_direct.py")
+    a2, s2 = findings_of("CCSA004", plain)
+    assert not a2 and not s2
+
+
+def test_ccsa004_real_direct_module_contract():
+    """The real kernel module carries the replan determinism contract:
+    no active CCSA004 findings, and exactly the two documented
+    flight-telemetry clock suppressions in the host driver."""
+    rel = "cruise_control_tpu/analyzer/direct.py"
+    ctx = ctx_for(ROOT / rel, rel)
+    active, suppressed = findings_of("CCSA004", ctx)
+    assert not active, [f.message for f in active]
+    assert len(suppressed) == 2
 
 
 def test_ccsa001_real_direct_module_clean():

@@ -452,9 +452,11 @@ def test_profile_busy_error_concurrent_capture(tmp_path):
 def test_microbench_in_process_small():
     from cruise_control_tpu.utils.microbench import run_microbench
     out = run_microbench(brokers=20, partitions=200, iters=2,
-                         cases=("elemwise", "segsum"))
+                         cases=("elemwise", "segsum", "cell_segsum",
+                                "frac_round", "stride_sort"))
     assert out["unit"] == "ms_per_iter"
-    assert set(out["results"]) == {"elemwise", "segsum"}
+    assert set(out["results"]) == {"elemwise", "segsum", "cell_segsum",
+                                   "frac_round", "stride_sort"}
     for v in out["results"].values():
         assert isinstance(v, float), v   # no errors on CPU
     bad = run_microbench(brokers=20, partitions=200, iters=2,

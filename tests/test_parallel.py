@@ -281,3 +281,93 @@ def test_sharded_topic_replica_aux_psum(mesh, cluster):
     assert infos[0]["moves_applied"] > 0
     assert infos[0]["residual_violation"] <= \
         infos_ref[0]["residual_violation"] + 2
+
+
+def _direct_chain():
+    from cruise_control_tpu.analyzer.goals import ReplicaCapacityGoal
+
+    return (RackAwareGoal(), ReplicaCapacityGoal(),
+            ReplicaDistributionGoal(), TopicReplicaDistributionGoal())
+
+
+def test_sharded_direct_prepass_mesh1_matches_single_device_bytes(cluster):
+    """The mesh direct pre-pass at rank_stride=1 (a 1-device mesh) must
+    be BYTE-identical to the single-device bounded trajectory with the
+    same megastep — the stride layout at stride 1 is algebraically the
+    plain kernel, so any divergence is a mesh-path bug, not a different
+    valid basin. Assignment AND leader_slot are pinned."""
+    from cruise_control_tpu.analyzer.chain import (
+        DispatchStats, MegastepConfig, optimize_goal_in_chain,
+    )
+    from cruise_control_tpu.parallel import optimize_chain_sharded
+
+    state, meta = cluster
+    chain = _direct_chain()
+    cfg = SearchConfig(num_sources=32, num_dests=8, moves_per_round=8,
+                       max_rounds=60)
+    ms = MegastepConfig(direct_assignment=True, direct_max_sweeps=16)
+
+    st1 = state
+    for i in range(len(chain)):
+        st1, _ = optimize_goal_in_chain(st1, chain, i, CONSTRAINT, cfg,
+                                        meta.num_topics, dispatch_rounds=3,
+                                        megastep=ms)
+    mesh1 = make_mesh(1)
+    stats = DispatchStats()
+    stm, _ = optimize_chain_sharded(
+        shard_cluster(state, mesh1), chain, CONSTRAINT, cfg,
+        meta.num_topics, mesh1, dispatch_rounds=3, megastep=ms,
+        stats=stats)
+    assert stats.by_kind.get("direct", 0) >= 1
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(stm).assignment),
+        np.asarray(st1.assignment))
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(stm).leader_slot),
+        np.asarray(st1.leader_slot))
+
+
+def test_sharded_direct_prepass_runs_deterministically_on_mesh(mesh,
+                                                               cluster):
+    """On the 8-way mesh the direct pre-pass actually dispatches
+    (kind="direct"), the chain lands rack-clean with replica spread no
+    worse than the single-device direct run +2, and the interleaved
+    rank_stride layout replays byte-identically run to run (the crc32
+    rounding contract has no host RNG to drift)."""
+    from cruise_control_tpu.analyzer.chain import (
+        DispatchStats, MegastepConfig, optimize_goal_in_chain,
+    )
+    from cruise_control_tpu.parallel import optimize_chain_sharded
+
+    state, meta = cluster
+    chain = _direct_chain()
+    cfg = SearchConfig(num_sources=32, num_dests=8, moves_per_round=8,
+                       max_rounds=60)
+    ms = MegastepConfig(direct_assignment=True, direct_max_sweeps=16)
+
+    outs = []
+    for _ in range(2):
+        stats = DispatchStats()
+        st8, infos = optimize_chain_sharded(
+            shard_cluster(state, mesh), chain, CONSTRAINT, cfg,
+            meta.num_topics, mesh, dispatch_rounds=3, megastep=ms,
+            stats=stats)
+        assert stats.by_kind.get("direct", 0) >= 1
+        outs.append((np.asarray(jax.device_get(st8).assignment).copy(),
+                     np.asarray(jax.device_get(st8).leader_slot).copy()))
+    np.testing.assert_array_equal(outs[0][0], outs[1][0])
+    np.testing.assert_array_equal(outs[0][1], outs[1][1])
+
+    full = jax.device_get(st8)
+    derived = compute_derived(full)
+    viol = RackAwareGoal().broker_violations(full, derived, CONSTRAINT, None)
+    assert float(viol.sum()) <= 1e-6
+
+    st1 = state
+    for i in range(len(chain)):
+        st1, _ = optimize_goal_in_chain(st1, chain, i, CONSTRAINT, cfg,
+                                        meta.num_topics, dispatch_rounds=3,
+                                        megastep=ms)
+    c8 = np.asarray(broker_replica_counts(full))
+    c1 = np.asarray(broker_replica_counts(st1))
+    assert (c8.max() - c8.min()) <= (c1.max() - c1.min()) + 2

@@ -6,6 +6,17 @@ candidate slots go: invalid cards, vetoed by which prior goal's
 acceptance, lost to the active goal's non-positive improvement, dropped
 by per-partition dedup, or rejected by the joint recheck.
 
+Before the greedy rounds it prints the SPARSE-PLAN attribution (round
+21): the fractional per-cell shed/fill targets of the direct transport,
+the rounding outcome per plane (systematic randomized rounding under the
+crc32 seed), and — for one live transport sweep — how many planned
+movers rank-filled a destination vs died to a feasibility veto
+(stranded). This is the column to read when the sparse-regime transport
+under-delivers: a large fractional mass with a small rounded plan means
+the margin knob (solver.direct.sparse.margin.frac) is starving the
+fill; a large planned-vs-applied gap means the guard set is vetoing the
+plan and the polish will inherit the residue.
+
     JAX_PLATFORMS=cpu python tools/diag_tr_density.py [brokers] [partitions] [rounds]
 """
 
@@ -68,6 +79,61 @@ def main() -> int:
 
     goal = goals[tr_idx]
     prior = tuple(goals[:tr_idx])
+
+    # --- sparse-plan attribution (round 21) -----------------------------
+    # The direct transport's view of the same instant: fractional
+    # targets, their rounding outcome, and rank-fill vs veto kill for
+    # one live sweep.
+    from cruise_control_tpu.analyzer import direct as direct_mod
+    from cruise_control_tpu.analyzer.derived import compute_derived
+
+    if direct_mod.direct_eligible(goals, tr_idx):
+        derived = compute_derived(state)
+        aux = direct_mod.goal_aux(goal, state, derived, constraint,
+                                  meta.num_topics)
+        cnt, lower, upper, _grp, _mv = goal.direct_spec(
+            state, derived, constraint, aux, meta.num_topics)
+        cnt = np.asarray(cnt, dtype=np.float64)
+        lower = np.asarray(jnp.broadcast_to(lower, cnt.shape), np.float64)
+        upper = np.asarray(jnp.broadcast_to(upper, cnt.shape), np.float64)
+        alive = np.asarray(derived.alive)
+        margin_frac = 0.25
+        width = np.maximum(upper - lower, 0.0)
+        margin = width * margin_frac
+        hi_t = np.maximum(upper - margin, lower)
+        lo_t = np.minimum(lower + np.maximum(margin, 0.5), hi_t)
+        over = alive[None, :] & (cnt > upper + 1e-6)
+        under = alive[None, :] & (cnt < lower - 1e-6)
+        sur_frac = np.where(over, np.maximum(cnt - hi_t, 0.0), 0.0)
+        head_frac = np.where(alive[None, :],
+                             np.maximum(lo_t - np.maximum(cnt, lower), 0.0),
+                             0.0)
+        sur, defi, headr = (np.asarray(x) for x in direct_mod._surplus_deficit(
+            jnp.asarray(cnt, jnp.float32), jnp.asarray(lower, jnp.float32),
+            jnp.asarray(upper, jnp.float32), derived.alive,
+            derived.allowed_replica_move & derived.alive))
+        dens = cnt.sum() / max(float(alive.sum()) * cnt.shape[0], 1.0)
+        print(f"--- sparse plan: {cnt.shape[0]} groups x {cnt.shape[1]} "
+              f"brokers, {dens:.2f} replicas/cell "
+              f"(retired-gate regime: {'SPARSE' if dens < 4.0 else 'dense'})")
+        print(f"    cells over band {int(over.sum())}, under band "
+              f"{int(under.sum())}")
+        print(f"    fractional target mass: shed {sur_frac.sum():.1f} "
+              f"fill-headroom {head_frac.sum():.1f}")
+        print(f"    rounded plan: surplus {sur.sum():.0f} deficit "
+              f"{defi.sum():.0f} headroom {headr.sum():.0f} "
+              f"(rounding delta {sur.sum() - sur_frac.sum():+.1f} on the "
+              f"shed plane)")
+        st_sw, applied, planned = direct_mod._direct_sweep(
+            state, goals, tr_idx, constraint, meta.num_topics, masks)
+        applied, planned = int(applied), int(planned)
+        killed = planned - applied
+        print(f"    live sweep: planned movers {planned}, rank-filled "
+              f"{applied}, veto-killed {killed} "
+              f"({killed / max(planned, 1):.0%} of the plan)", flush=True)
+    else:
+        print("--- sparse plan: chain prefix not direct-eligible; "
+              "greedy-only diagnostics below", flush=True)
 
     for rnd in range(diag_rounds):
         cand, deltas, score, layout, (derived, aux, aux_by) = \
