@@ -444,6 +444,42 @@ def _heal_ledger_noop_overhead_ns(iterations: int = 100_000) -> float:
     return (time.perf_counter_ns() - t0) / iterations
 
 
+def _journey_noop_overhead_ns(iterations: int = 100_000) -> float:
+    """Per-call cost of a DISABLED journey log's stamp sites (the
+    acceptance guard, same discipline as the heal ledger: open() on a
+    disabled log returns the shared NO_JOURNEY handle, every stamp a
+    no-op). One iteration = one open + one segment scope + one ambient
+    read/stamp + one note + one close — strictly MORE work than any
+    request pays per stamp site."""
+    from cruise_control_tpu.serving.journey import JourneyLog, current_journey
+    log = JourneyLog(enabled=False)
+    t0 = time.perf_counter_ns()
+    for _ in range(iterations):
+        j = log.open("PROPOSALS")
+        with j.seg("noop"):
+            pass
+        current_journey().add("noop", 0.0)
+        j.note(outcome="ok")
+        log.close(j)
+    return (time.perf_counter_ns() - t0) / iterations
+
+
+def _slo_noop_overhead_ns(iterations: int = 100_000) -> float:
+    """Per-call cost of a DISABLED SLO registry's record sites (the
+    acceptance guard: slo.enabled=false means every probe is one
+    attribute check and an early return — nothing on the front-door
+    path). One iteration = one request classification + one staleness
+    + one heal observation — MORE than any single response pays."""
+    from cruise_control_tpu.utils.slo import SloRegistry
+    reg = SloRegistry(enabled=False)
+    t0 = time.perf_counter_ns()
+    for _ in range(iterations):
+        reg.record_request(0.01, 200)
+        reg.observe_staleness(1.0)
+        reg.observe_heal(1.0)
+    return (time.perf_counter_ns() - t0) / iterations
+
+
 def _run_heal_stage(progress: dict) -> dict:
     """The heal-ledger stage: drive the broker_loss_drift twin with
     per-tick detection (the cross-validation configuration — detection
@@ -1967,7 +2003,12 @@ def _run_serving_stage(progress: dict) -> dict:
         return cc
 
     flips: list[str] = []
-    base = _config()
+    # SLO engine ON for the steady arm: its false-positive canary (a
+    # healthy run must never page). The latency threshold is lifted far
+    # above machine noise — the canary judges the burn MACHINERY, not
+    # this host's latency.
+    base = _config({"slo.enabled": True,
+                    "slo.objectives.latency.threshold.seconds": 30.0})
     scheduler = FleetScheduler(starvation_bound_s=30.0)
     registry = FleetRegistry(base_config=base, scheduler=scheduler)
     # alpha pads to bucket (16, 256), gamma to (4, 16): the byte-identity
@@ -1981,6 +2022,9 @@ def _run_serving_stage(progress: dict) -> dict:
     t_stage0 = time.time()
     report = oreport = None
     coalesced_delta = 0
+    attribution: dict = {}
+    journey_file = os.environ.get("BENCH_JOURNEY_FILE")
+    steady_burns = 0
     try:
         # -- parity pre-pass: cache replay byte-identity at two shapes --
         for cid in ("alpha", "gamma"):
@@ -2040,7 +2084,9 @@ def _run_serving_stage(progress: dict) -> dict:
         sched_digest = loadgen.schedule_digest(schedule)
         progress["schedule_digest"] = sched_digest
         t0 = time.time()
-        report = loadgen.run_schedule(api, schedule, concurrency=8)
+        report = loadgen.run_schedule(
+            api, schedule, concurrency=8,
+            journey_log=registry.get("alpha").journeys)
         steady_wall = time.time() - t0
         flips.extend(f"steady: {f}" for f in loadgen.slo_violations(
             report, {"max_error_rate": 0.0, "max_shed_rate": 0.0,
@@ -2052,6 +2098,35 @@ def _run_serving_stage(progress: dict) -> dict:
             if name.startswith("proposals") and len(digs) > 1:
                 flips.append(f"steady: {name} produced {len(digs)} "
                              "distinct response bodies")
+        # -- journey attribution canary: >= 95% of the steady-arm
+        # request wall must land in NAMED segments across BOTH facades'
+        # rings (parity-pass journeys included — coalesce followers
+        # attribute their wait as coalesce_wait, never silently).
+        from cruise_control_tpu.serving.journey import segment_attribution
+        entries = registry.get("alpha").journeys.entries() \
+            + registry.get("gamma").journeys.entries()
+        attribution = segment_attribution(entries)
+        if attribution["journeys"] == 0:
+            flips.append("journeys: steady arm recorded no journeys")
+        elif attribution["attributed_fraction"] < 0.95:
+            flips.append(
+                f"journeys: only {attribution['attributed_fraction']:.1%}"
+                f" of {attribution['wall_s']:.3f}s request wall "
+                "attributed to named segments "
+                f"(unattributed {attribution['unattributed_s']:.3f}s)")
+        if journey_file:
+            try:
+                registry.get("alpha").journeys.dump_json(journey_file)
+            except Exception:  # noqa: BLE001 — the dump is best-effort
+                pass
+        # -- SLO false-positive canary: a healthy steady arm must not
+        # burn (one detector tick on the live registry raises nothing).
+        acc = registry.get("alpha")
+        acc.anomaly_detector.run_detector_once(acc.slo_burn_detector)
+        steady_burns = acc.slo_burn_detector.state()["burnsRaised"]
+        if steady_burns:
+            flips.append(f"slo: steady arm raised {steady_burns} "
+                         "SLO_BURN anomalies (false positive)")
         progress["steady"] = "done"
     finally:
         api.shutdown()
@@ -2059,11 +2134,22 @@ def _run_serving_stage(progress: dict) -> dict:
 
     # -- overload arm: shed-all solver bound on a solo api (cache and
     # coalescing off so every solver request actually reaches admission).
+    # SLO engine + SLO_BURN self-healing ON with a tight shed budget: the
+    # sustained shedding must raise EXACTLY ONE burn heal chain (fast AND
+    # slow pairs both over threshold), reach fix_started, then clear once
+    # recovery traffic dilutes the shed fraction below the thresholds.
     ocfg = _config({"serving.admission.queue.solver.max": 0,
                     "serving.coalesce.enabled": False,
-                    "serving.cache.enabled": False})
-    oapi = CruiseControlApi(_make_cc(ocfg, _parts((0, 1, 2, 3), 2, 6)))
+                    "serving.cache.enabled": False,
+                    "slo.enabled": True,
+                    "slo.objectives.shed.budget": 0.01,
+                    "slo.objectives.latency.threshold.seconds": 30.0,
+                    "self.healing.enabled": True,
+                    "self.healing.slo.burn.enabled": True})
+    occ = _make_cc(ocfg, _parts((0, 1, 2, 3), 2, 6))
+    oapi = CruiseControlApi(occ)
     oapi._async_wait_s = 300
+    slo_burn_chains: list = []
     try:
         oschedule = loadgen.generate_schedule(
             loadgen.mixed_profile(), seed=SERVING_SEED + 5,
@@ -2072,6 +2158,33 @@ def _run_serving_stage(progress: dict) -> dict:
         flips.extend(f"overload: {f}" for f in loadgen.slo_violations(
             oreport, {"min_shed": 1, "require_retry_after": True,
                       "max_error_rate": 0.0}))
+        # Burn detection + fix dispatch, driven synchronously (the
+        # simulator's run_detector_once/drain discipline — no threads).
+        occ.anomaly_detector.run_detector_once(occ.slo_burn_detector)
+        occ.anomaly_detector.drain_anomalies()
+        raised = occ.slo_burn_detector.state()["burnsRaised"]
+        if raised != 1:
+            flips.append(f"slo: overload arm raised {raised} SLO_BURN "
+                         "anomalies; expected exactly 1 (shed burn)")
+        # Recovery: enough healthy viewer reads to pull the shed
+        # fraction back under BOTH burn thresholds, then one more
+        # detector tick must clear the standing burn.
+        for _ in range(220):
+            oapi.handle("GET", "/kafkacruisecontrol/state", "")
+        occ.anomaly_detector.run_detector_once(occ.slo_burn_detector)
+        slo_burn_chains = occ.heal_ledger.chains(anomaly_type="SLO_BURN")
+        if len(slo_burn_chains) != 1:
+            flips.append(f"slo: {len(slo_burn_chains)} SLO_BURN heal "
+                         "chains; expected exactly 1")
+        else:
+            chain = slo_burn_chains[0]
+            phases = {p["phase"] for p in chain["phases"]}
+            if "fix_started" not in phases:
+                flips.append("slo: the burn chain never reached "
+                             f"fix_started (phases {sorted(phases)})")
+            if chain["outcome"] != "cleared":
+                flips.append("slo: the burn chain did not clear after "
+                             f"load dropped (outcome {chain['outcome']})")
     finally:
         oapi.shutdown()
     progress["overload"] = "done"
@@ -2095,6 +2208,13 @@ def _run_serving_stage(progress: dict) -> dict:
             "coalesced_in_parity_pass": coalesced_delta,
             "overload_report":
                 oreport.to_dict() if oreport is not None else {},
+            "attribution": attribution,
+            "journey_file": journey_file,
+            "steady_slo_burns": steady_burns,
+            "overload_slo_burn_chains": [
+                {"chainId": c["chainId"], "outcome": c["outcome"],
+                 "timeToStartFixMs": c["timeToStartFixMs"]}
+                for c in slo_burn_chains],
             "stage_wall_s": round(wall, 3),
             "solve_wall_clock_s": round(steady_wall, 3),
             "measured_layer": "parity pre-pass (cache + coalesce "
@@ -2524,6 +2644,18 @@ def _guarded_main(deadline: float) -> int:
                                "predictive-detector tick one config read "
                                "(off means off: no monitor touch, no "
                                "model build, no device work)"}})
+    journey_ns = _journey_noop_overhead_ns()
+    _emit({"metric": "journey_noop_overhead",
+           "value": round(journey_ns, 1), "unit": "ns", "vs_baseline": 1.0,
+           "extras": {"guard": "disabled journey log must stay ns-scale "
+                               "per stamp site (shared NO_JOURNEY handle, "
+                               "same guard family as the heal ledger)"}})
+    slo_ns = _slo_noop_overhead_ns()
+    _emit({"metric": "slo_noop_overhead",
+           "value": round(slo_ns, 1), "unit": "ns", "vs_baseline": 1.0,
+           "extras": {"guard": "slo.enabled=false must make every record "
+                               "probe one attribute check + early return "
+                               "(off means off on the front-door path)"}})
     try:
         ring = _flight_ring_overhead_probe()
         _emit({"metric": "flight_ring_overhead",

@@ -89,6 +89,18 @@ class EndPoint(enum.Enum):
     # ``?refresh=true`` fits a fresh forecast inline (explicit opt-in:
     # it is device work); the default serves the last cached fit.
     FORECAST = (29, "GET", Role.VIEWER)
+    # Request journeys (no reference analogue — the reference exposes
+    # per-endpoint latency sensors, not per-request attribution): the
+    # facade's bounded ring of completed serving/journey.py records —
+    # which segment (admission, cache, queue wait, model build, solve,
+    # render, ...) each request's wall went to. ``?cluster=`` ROUTES to
+    # that cluster's facade ring; ``?endpoint=`` / ``?entries=`` filter.
+    JOURNEYS = (30, "GET", Role.VIEWER)
+    # SLO engine (utils/slo.py): declarative objective registry state —
+    # per-window burn rates, remaining error budget, burning verdicts —
+    # plus the SLO-burn detector's raised/cleared lifecycle counters.
+    # ``?cluster=`` ROUTES to that cluster's facade registry.
+    SLO = (31, "GET", Role.VIEWER)
 
     @property
     def method(self) -> str:

@@ -192,6 +192,13 @@ SCHEMAS: dict[EndPoint, dict[str, Callable[[str], Any]]] = {
     # serves the engine's last cached projection. cluster (in _COMMON)
     # ROUTES to that cluster's facade engine.
     EndPoint.FORECAST: {"refresh": _bool},
+    # cluster (in _COMMON) ROUTES to that cluster's facade journey ring;
+    # endpoint filters by the journey's endpoint name; entries bounds
+    # the response (newest first).
+    EndPoint.JOURNEYS: {"endpoint": _str, "entries": _int},
+    # cluster (in _COMMON) ROUTES to that cluster's facade SLO registry;
+    # objective trims the body to one objective's evaluation.
+    EndPoint.SLO: {"objective": _str},
 }
 
 

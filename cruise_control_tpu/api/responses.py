@@ -119,6 +119,9 @@ def broker_stats(state: ClusterTensors, meta: ClusterMeta,
     """LOAD endpoint body (response/stats/BrokerStats.java).
     ``disk_info`` = (logdirs_by_broker, capacity_resolver) adds per-logdir
     capacity + liveness per broker (populate_disk_info=true)."""
+    from ..serving.journey import current_journey
+    jny = current_journey()
+    t0 = jny.now()
     loads = np.asarray(broker_load(state), dtype=np.float64)       # [B, R]
     caps = np.asarray(state.capacity, dtype=np.float64)
     with np.errstate(divide="ignore", invalid="ignore"):
@@ -169,9 +172,11 @@ def broker_stats(state: ClusterTensors, meta: ClusterMeta,
                 d: {"DiskMB": round(float(c), 3), "alive": True}
                 for d, c in sorted(caps_by_dir.items())}
         rows.append(row)
-    return envelope({"brokers": rows,
+    body = envelope({"brokers": rows,
                      "hosts": _host_rows(state, meta, loads, caps, replicas,
                                          leaders, pnw, lead_in, mask)})
+    jny.add("render", jny.now() - t0, brokers=len(rows))
+    return body
 
 
 def partition_load(state: ClusterTensors, meta: ClusterMeta,
@@ -184,6 +189,9 @@ def partition_load(state: ClusterTensors, meta: ClusterMeta,
     regex, ``partition_range`` a partition id or "start-end" range, and
     ``brokerids`` keeps only partitions with a replica on one of the
     brokers (ParameterUtils TOPIC/PARTITION/BROKER_ID params)."""
+    from ..serving.journey import current_journey
+    jny = current_journey()
+    t0 = jny.now()
     aliases = {"NETWORK_INBOUND": "NW_IN", "NETWORK_OUTBOUND": "NW_OUT"}
     name = resource.upper()
     try:
@@ -246,7 +254,9 @@ def partition_load(state: ClusterTensors, meta: ClusterMeta,
             "networkInbound": round(float(per_slot[p, :, Resource.NW_IN].sum()), 3),
             "networkOutbound": round(float(per_slot[p, :, Resource.NW_OUT].sum()), 3),
         })
-    return envelope({"records": records})
+    body = envelope({"records": records})
+    jny.add("render", jny.now() - t0, records=len(records))
+    return body
 
 
 def kafka_cluster_state(admin: AdminBackend, topic_filter: str = "") -> dict:
@@ -325,30 +335,36 @@ def optimization_result(op: OperationResult, verbose: bool = False) -> dict:
     """Proposal-bearing POST/GET body (response/OptimizationResult.java:191).
     ``verbose`` lifts the proposal-list cap and adds before/after cluster
     stats (ParameterUtils verbose semantics)."""
+    from ..serving.journey import current_journey
+    jny = current_journey()
     body: dict = {"operation": op.operation, "dryrun": op.dryrun,
                   "executed": op.executed}
-    r: OptimizerResult | None = op.optimizer_result
-    if r is not None:
-        s = r.summary()
-        body["summary"] = s
-        body["goalSummary"] = [
-            {"goal": g.name, "status": "FIXED" if g.succeeded else "VIOLATED",
-             "optimizationTimeMs": round(1000 * g.duration_s, 1)}
-            for g in r.goal_results]
-        if verbose:
-            body["loadBeforeOptimization"] = _stats_dict(r.stats_before)
-            body["loadAfterOptimization"] = _stats_dict(r.stats_after)
-    proposals = list(op.proposals)
-    body["numProposals"] = len(proposals)
-    if not verbose and len(proposals) > _NON_VERBOSE_PROPOSAL_CAP:
-        body["proposalsTruncated"] = True
-        proposals = proposals[:_NON_VERBOSE_PROPOSAL_CAP]
-    body["proposals"] = [
-        {"topicPartition": {"topic": p.topic, "partition": p.partition},
-         "oldLeader": p.old_leader,
-         "oldReplicas": list(p.old_replicas),
-         "newReplicas": list(p.new_replicas),
-         "newLeader": p.new_leader}
-        for p in proposals]
+    with jny.seg("render"):
+        r: OptimizerResult | None = op.optimizer_result
+        if r is not None:
+            s = r.summary()
+            body["summary"] = s
+            body["goalSummary"] = [
+                {"goal": g.name,
+                 "status": "FIXED" if g.succeeded else "VIOLATED",
+                 "optimizationTimeMs": round(1000 * g.duration_s, 1)}
+                for g in r.goal_results]
+            if verbose:
+                body["loadBeforeOptimization"] = _stats_dict(r.stats_before)
+                body["loadAfterOptimization"] = _stats_dict(r.stats_after)
+    with jny.seg("proposal_diff") as seg:
+        proposals = list(op.proposals)
+        body["numProposals"] = len(proposals)
+        if not verbose and len(proposals) > _NON_VERBOSE_PROPOSAL_CAP:
+            body["proposalsTruncated"] = True
+            proposals = proposals[:_NON_VERBOSE_PROPOSAL_CAP]
+        body["proposals"] = [
+            {"topicPartition": {"topic": p.topic, "partition": p.partition},
+             "oldLeader": p.old_leader,
+             "oldReplicas": list(p.old_replicas),
+             "newReplicas": list(p.new_replicas),
+             "newLeader": p.new_leader}
+            for p in proposals]
+        seg.set(numProposals=len(proposals))
     body.update(op.extra)
     return envelope(body)

@@ -56,7 +56,7 @@ _SYNC_ENDPOINTS = {
     EndPoint.PAUSE_SAMPLING, EndPoint.RESUME_SAMPLING,
     EndPoint.STOP_PROPOSAL_EXECUTION, EndPoint.ADMIN, EndPoint.BOOTSTRAP,
     EndPoint.TRAIN, EndPoint.RIGHTSIZE, EndPoint.FLEET, EndPoint.HEALS,
-    EndPoint.FORECAST,
+    EndPoint.FORECAST, EndPoint.JOURNEYS, EndPoint.SLO,
 }
 
 # Endpoints that consume solver time. In fleet mode these (a) are refused
@@ -322,6 +322,25 @@ class CruiseControlApi:
     def handle(self, method: str, path: str, query_string: str = "",
                headers: dict[str, str] | None = None,
                remote_addr: str = "") -> tuple[int, dict, dict[str, str]]:
+        """→ (http status, json body, extra response headers). Wraps the
+        pipeline with the SLO registry's request classification
+        (utils/slo.py): every front-door response — sheds and errors
+        included — is one latency/error/shed event. Off means off: a
+        disabled or absent registry costs one attribute read."""
+        slo = getattr(self._cc, "slo", None)
+        if slo is None or not slo.enabled:
+            return self._handle_inner(method, path, query_string, headers,
+                                      remote_addr)
+        t0 = time.monotonic()
+        status, body, out_headers = self._handle_inner(
+            method, path, query_string, headers, remote_addr)
+        slo.record_request(time.monotonic() - t0, status)
+        return status, body, out_headers
+
+    def _handle_inner(self, method: str, path: str, query_string: str = "",
+                      headers: dict[str, str] | None = None,
+                      remote_addr: str = "",
+                      ) -> tuple[int, dict, dict[str, str]]:
         """→ (http status, json body, extra response headers)."""
         headers = headers or {}
         out_headers: dict[str, str] = {}
@@ -574,6 +593,37 @@ class CruiseControlApi:
                   out_headers: dict[str, str],
                   cc: CruiseControl | None = None,
                   cluster_id: str | None = None) -> dict:
+        """Journey shell around the pipeline (serving/journey.py): open
+        the ambient per-request record, run the real dispatch under its
+        scope, close it with the outcome. Off means off: a disabled or
+        absent journey log falls straight through to the inner
+        pipeline."""
+        journeys = getattr(cc or self._cc, "journeys", None)
+        if journeys is None or not journeys.enabled:
+            return self._dispatch_inner(endpoint, params, principal,
+                                        query_string, headers, out_headers,
+                                        cc=cc, cluster_id=cluster_id)
+        from ..serving.journey import journey_scope
+        jny = journeys.open(endpoint.name, cluster=cluster_id)
+        with journey_scope(jny):
+            try:
+                body = self._dispatch_inner(endpoint, params, principal,
+                                            query_string, headers,
+                                            out_headers, cc=cc,
+                                            cluster_id=cluster_id)
+            except BaseException as e:
+                jny.note(error=type(e).__name__)
+                journeys.close(jny, status="error")
+                raise
+        journeys.close(jny, status=jny.attrs.get("outcome", "ok"))
+        return body
+
+    def _dispatch_inner(self, endpoint: EndPoint, params: dict,
+                        principal: Principal, query_string: str,
+                        headers: dict[str, str],
+                        out_headers: dict[str, str],
+                        cc: CruiseControl | None = None,
+                        cluster_id: str | None = None) -> dict:
         cc = cc or self._cc
         p = params
         handler = self._request_plugin(endpoint)
@@ -581,8 +631,13 @@ class CruiseControlApi:
             # CruiseControlRequestConfig reflection: the configured request
             # class handles the endpoint end to end.
             return handler.handle(cc, p, principal)
+        from ..serving.journey import current_journey
+        jny = current_journey()
         if endpoint in _SYNC_ENDPOINTS:
-            return self._sync_handler(endpoint, p, principal, cc)
+            # One segment for inline endpoints: their wall IS response
+            # assembly (STATE is the loadgen mix's heaviest read).
+            with jny.seg("render"):
+                return self._sync_handler(endpoint, p, principal, cc)
         # Async (model-building) endpoints run as user tasks. The
         # cluster label must be re-established INSIDE the work callable:
         # ContextVars do not cross into the user-task thread pool, so the
@@ -610,30 +665,35 @@ class CruiseControlApi:
         resume_id = headers.get(USER_TASK_HEADER)
         store_key = coalesce_key = None
         if resume_id is None:
-            identity = self._response_identity(cc, cluster_id)
-            if identity is not None:
-                generation, fingerprint = identity
-                pkey = canonical_params(endpoint.name, p,
-                                        allowed=CACHEABLE_ENDPOINTS)
-                if pkey is not None:
-                    store_key = (cluster_id, endpoint.name, pkey,
-                                 generation, fingerprint)
-                    cached = self._response_cache.get(store_key)
-                    if cached is not None:
-                        out_headers["X-Serving-Cache"] = "hit"
-                        return cached
-                if self._coalesce_enabled:
-                    ckey_params = canonical_params(
-                        endpoint.name, p, allowed=COALESCIBLE_ENDPOINTS)
-                    if ckey_params is not None:
-                        coalesce_key = (cluster_id, endpoint.name,
-                                        ckey_params, generation,
-                                        fingerprint)
+            with jny.seg("cache_lookup") as cache_seg:
+                identity = self._response_identity(cc, cluster_id)
+                if identity is not None:
+                    generation, fingerprint = identity
+                    pkey = canonical_params(endpoint.name, p,
+                                            allowed=CACHEABLE_ENDPOINTS)
+                    if pkey is not None:
+                        store_key = (cluster_id, endpoint.name, pkey,
+                                     generation, fingerprint)
+                        cached = self._response_cache.get(store_key)
+                        if cached is not None:
+                            cache_seg.set(result="hit")
+                            jny.note(outcome="cache_hit")
+                            out_headers["X-Serving-Cache"] = "hit"
+                            return cached
+                    cache_seg.set(result="miss")
+                    if self._coalesce_enabled:
+                        ckey_params = canonical_params(
+                            endpoint.name, p, allowed=COALESCIBLE_ENDPOINTS)
+                        if ckey_params is not None:
+                            coalesce_key = (cluster_id, endpoint.name,
+                                            ckey_params, generation,
+                                            fingerprint)
             if not self._tasks.has_inflight(coalesce_key):
                 klass = task_class_of(endpoint.name)
-                self._admission.admit(
-                    klass, self._engine.queue_depth(klass),
-                    self._engine.service_time_s(klass))
+                with jny.seg("admission", **{"class": klass.value}):
+                    self._admission.admit(
+                        klass, self._engine.queue_depth(klass),
+                        self._engine.service_time_s(klass))
         work = self._async_work(endpoint, p, cc, futures_req=futures_req,
                                 futures_live=futures_live)
         if cluster_id is not None:
@@ -642,6 +702,19 @@ class CruiseControlApi:
             def work(inner=inner_work, cid=cluster_id):
                 from ..utils.sensors import cluster_label
                 with cluster_label(cid):
+                    return inner()
+
+        if jny.recording:
+            # Same rewrap discipline as the cluster label just above:
+            # ContextVars do not cross into the worker pools, so the
+            # journey scope is re-established inside the work callable —
+            # the model-build/solve stamps land on THIS request's record
+            # whichever thread runs them.
+            journey_inner = work
+
+            def work(inner=journey_inner, j=jny):
+                from ..serving.journey import journey_scope
+                with journey_scope(j):
                     return inner()
 
         work = self._schedule_fleet_work(endpoint, cluster_id, work, cc, p,
@@ -653,9 +726,10 @@ class CruiseControlApi:
             # it — solo work, scheduled job, or coalesced futures payload.
             caching_inner = work
 
-            def work(inner=caching_inner, key=store_key):
+            def work(inner=caching_inner, key=store_key, j=jny):
                 body = inner()
-                self._response_cache.put(key, body)
+                with j.seg("cache_store"):
+                    self._response_cache.put(key, body)
                 return body
 
         info = self._tasks.get_or_create_task(
@@ -663,15 +737,35 @@ class CruiseControlApi:
             task_id=resume_id, client=principal.name,
             coalesce_key=coalesce_key)
         out_headers[USER_TASK_HEADER] = info.task_id
+        engine_task = getattr(info, "engine_task", None)
+        # Follower ⟺ the user task rides another task's engine record
+        # (user_tasks.get_or_create_task coalescing). A follower's wall
+        # is spent WAITING on the leader's future — its own journey has
+        # no work segments, so the wait itself is the named segment.
+        follower = engine_task is not None \
+            and engine_task.task_id != info.task_id
+        if jny.recording and coalesce_key is not None \
+                and engine_task is not None:
+            jny.note(coalesce="follower" if follower else "leader")
+        wait_t0 = jny.now() if follower else 0.0
         try:
             exc = info.future.exception(timeout=self._async_wait_s)
         except FuturesTimeoutError:
+            if follower:
+                jny.add("coalesce_wait", jny.now() - wait_t0)
+            else:
+                self._stamp_queue_wait(jny, engine_task)
+            jny.note(outcome="in_progress")
             progress = info.progress.to_list() if info.progress else []
             return responses.envelope({
                 "progress": [{"operation": endpoint.name, **p}
                              for p in progress],
                 "message": f"operation still running; poll with "
                            f"{USER_TASK_HEADER} {info.task_id}"})
+        if follower:
+            jny.add("coalesce_wait", jny.now() - wait_t0)
+        else:
+            self._stamp_queue_wait(jny, engine_task)
         if exc is not None:
             if isinstance(exc, ApiError):
                 raise exc
@@ -683,6 +777,19 @@ class CruiseControlApi:
                 raise ApiError(503, f"load model not ready: {exc}")
             raise ApiError(500, f"{type(exc).__name__}: {exc}")
         return info.future.result()
+
+    @staticmethod
+    def _stamp_queue_wait(jny, engine_task) -> None:
+        """One ``queue_wait`` segment from the engine's lifecycle record
+        (started − enqueued on the engine's monotonic seam) — stamped
+        once the task left its class queue; a still-queued 202 has no
+        wait to report yet (its poll will)."""
+        if not jny.recording or engine_task is None \
+                or engine_task.started_s <= 0.0:
+            return
+        jny.add("queue_wait",
+                engine_task.started_s - engine_task.enqueued_s,
+                **{"class": engine_task.klass.value})
 
     @staticmethod
     def _response_identity(cc: CruiseControl,
@@ -773,11 +880,25 @@ class CruiseControlApi:
                 # coalescible behind it.
                 batch_key = None
 
+        # Captured on the handler thread (the journey scope does not
+        # cross into the engine worker that runs ``scheduled``): the
+        # sched_wait segment is submit → the scheduler's device turn.
+        from ..serving.journey import current_journey
+        jny = current_journey()
+
         def scheduled():
             from concurrent.futures import CancelledError
+            job = work
+            if jny.recording:
+                t0 = jny.now()
+
+                def job(inner=work, j=jny, t0=t0):
+                    j.add("sched_wait", j.now() - t0)
+                    return inner()
+
             try:
                 return sched.submit(cluster_id, JobKind.ON_DEMAND,
-                                    work, batch_key=batch_key,
+                                    job, batch_key=batch_key,
                                     payload=payload).result()
             except CancelledError:
                 # Scheduler shut down before the job ran: a meaningful
@@ -895,6 +1016,40 @@ class CruiseControlApi:
                             "forecast refresh could run; retry once the "
                             "fleet is back up")
             return _forecast_work()
+        if endpoint is EndPoint.JOURNEYS:
+            # GET /journeys: the routed facade's completed-request ring
+            # (serving/journey.py) — per-request latency attribution,
+            # newest first. ``?endpoint=`` / ``?entries=`` filter.
+            journeys = getattr(cc, "journeys", None)
+            if journeys is None:
+                return responses.envelope({
+                    "journeysEnabled": False, "numJourneys": 0,
+                    "journeys": []})
+            entries = journeys.entries(endpoint=p.get("endpoint"),
+                                       limit=p.get("entries", 50))
+            return responses.envelope({
+                **journeys.stats(),
+                "numJourneys": len(entries),
+                "journeys": entries})
+        if endpoint is EndPoint.SLO:
+            # GET /slo: the routed facade's objective registry
+            # (utils/slo.py) — per-window burn rates, remaining budget,
+            # burning verdicts — plus the burn detector's lifecycle.
+            slo = getattr(cc, "slo", None)
+            if slo is None:
+                return responses.envelope(
+                    {"sloEnabled": False, "objectives": {}})
+            body = slo.state()
+            objective = p.get("objective")
+            if objective:
+                body["objectives"] = {
+                    name: entry
+                    for name, entry in body["objectives"].items()
+                    if name == objective}
+            detector = getattr(cc, "slo_burn_detector", None)
+            if detector is not None:
+                body["burnDetector"] = detector.state()
+            return responses.envelope(body)
         if endpoint is EndPoint.STATE:
             key = None
             if self._response_cache.cache_state:

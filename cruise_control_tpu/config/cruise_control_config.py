@@ -667,9 +667,15 @@ def _definition() -> ConfigDef:
     d.define("tracing.jsonl.max.bytes", T.LONG, 67_108_864,
              Range.at_least(0), I.LOW,
              "Size cap on the tracing JSONL dump: when an append would "
-             "push the file past this, it is rotated to <path>.1 (one "
-             "rotated generation kept) so a long-running process can "
-             "never grow the dump without bound. 0 = unlimited.")
+             "push the file past this, it is rotated to <path>.1 (see "
+             "tracing.jsonl.max.files for how many rotated generations "
+             "are kept) so a long-running process can never grow the "
+             "dump without bound. 0 = unlimited.")
+    d.define("tracing.jsonl.max.files", T.INT, 1, Range.at_least(1), I.LOW,
+             "Rotated JSONL generations kept: rotation cascades "
+             "<path>.1 -> <path>.2 -> ... up to this count before the "
+             "oldest falls off. 1 preserves the historical single-"
+             "generation behavior.")
     d.define("solver.flight.recorder.enabled", T.BOOLEAN, True, None, I.LOW,
              "Solver flight recorder (utils.flight_recorder): per-goal, "
              "per-dispatch search telemetry — acceptance density, "
@@ -711,6 +717,85 @@ def _definition() -> ConfigDef:
              "Bound on phase transitions kept per chain; further "
              "transitions are counted in the chain's droppedPhases "
              "field instead of growing it without bound.")
+    # --- Request journeys + SLO engine (round 18) ---
+    d.define("journey.enabled", T.BOOLEAN, True, None, I.LOW,
+             "Request journeys (serving.journey): per-request segment "
+             "attribution — admission, cache lookup, coalesce join, "
+             "queue wait, fleet-scheduler wait, model build, solve "
+             "(flight-recorder pass ids + heal chain linked), proposal "
+             "diff, render, cache store — kept in a bounded ring served "
+             "at GET /journeys and exported as "
+             "journey_segment_seconds{endpoint=,segment=} histograms. "
+             "Observation only: responses are byte-identical with "
+             "journeys on or off (pinned); disabled, the open() hook "
+             "returns the shared NO_JOURNEY no-op (bench-guarded by "
+             "journey_noop_overhead).")
+    d.define("journey.max.entries", T.INT, 256, Range.at_least(1), I.LOW,
+             "Bound on the in-memory ring of completed journeys per "
+             "facade (oldest evicted; ~1 KB per journey).")
+    d.define("slo.enabled", T.BOOLEAN, False, None, I.MEDIUM,
+             "SLO engine (utils.slo): declarative objectives evaluated "
+             "over sliding multi-window counters, exported as "
+             "slo_error_budget_remaining{objective=} and "
+             "slo_burn_rate{objective=,window=} and served at GET /slo. "
+             "Off (default) the engine records nothing and every probe "
+             "is ns-scale (bench-guarded by slo_noop_overhead).")
+    d.define("slo.objectives", T.LIST, ["latency", "error", "shed"], None,
+             I.LOW,
+             "Active objective kinds (subset of latency, error, shed, "
+             "staleness, heal); each kind reads its own "
+             "slo.objectives.<kind>.* budget/threshold keys.")
+    d.define("slo.objectives.latency.quantile", T.DOUBLE, 0.99,
+             Range.between(0, 1), I.LOW,
+             "Latency objective: the serving_request_seconds quantile "
+             "the threshold applies to (reported on GET /slo; the burn "
+             "accounting itself is per-request event-based).")
+    d.define("slo.objectives.latency.threshold.seconds", T.DOUBLE, 2.0,
+             Range.at_least(0), I.LOW,
+             "Latency objective: a successful request slower than this "
+             "is a bad event against the latency budget.")
+    d.define("slo.objectives.latency.budget", T.DOUBLE, 0.05,
+             Range.between(0, 1), I.LOW,
+             "Latency objective: tolerated bad-event fraction (error "
+             "budget). Burn rate = observed bad fraction / budget.")
+    d.define("slo.objectives.error.budget", T.DOUBLE, 0.01,
+             Range.between(0, 1), I.LOW,
+             "Error objective: tolerated fraction of requests answering "
+             "5xx/4xx (sheds excluded — they have their own objective).")
+    d.define("slo.objectives.shed.budget", T.DOUBLE, 0.05,
+             Range.between(0, 1), I.LOW,
+             "Shed objective: tolerated fraction of requests answered "
+             "429 by the admission layer.")
+    d.define("slo.objectives.staleness.threshold.seconds", T.DOUBLE, 300.0,
+             Range.at_least(0), I.LOW,
+             "Staleness objective: a stale-serve whose proposal age "
+             "exceeds this is a bad event.")
+    d.define("slo.objectives.staleness.budget", T.DOUBLE, 0.05,
+             Range.between(0, 1), I.LOW,
+             "Staleness objective: tolerated bad-event fraction among "
+             "stale serves.")
+    d.define("slo.objectives.heal.threshold.seconds", T.DOUBLE, 600.0,
+             Range.at_least(0), I.LOW,
+             "Heal objective: a completed heal chain slower than this "
+             "(detection -> cleared) is a bad event.")
+    d.define("slo.objectives.heal.budget", T.DOUBLE, 0.1,
+             Range.between(0, 1), I.LOW,
+             "Heal objective: tolerated fraction of slow heals.")
+    d.define("slo.burn.windows", T.LIST,
+             ["300", "3600", "1800", "21600"], None, I.LOW,
+             "Burn-rate windows in seconds, ordered fast-short, "
+             "fast-long, slow-short, slow-long (the multi-window "
+             "multi-burn-rate alerting shape: a page needs BOTH windows "
+             "of a pair burning, so a blip can't page and a slow leak "
+             "can't hide).")
+    d.define("slo.burn.fast.threshold", T.DOUBLE, 14.4,
+             Range.at_least(0), I.LOW,
+             "Fast-pair burn multiple that raises SLO_BURN (14.4x "
+             "spends 2% of a 30-day budget in an hour).")
+    d.define("slo.burn.slow.threshold", T.DOUBLE, 6.0,
+             Range.at_least(0), I.LOW,
+             "Slow-pair burn multiple that raises SLO_BURN (6x spends "
+             "5% of a 30-day budget in 6 hours).")
     d.define("profiling.enabled", T.BOOLEAN, True, None, I.LOW,
              "On-demand device profiling (GET /profile): "
              "jax.profiler.trace captures of live solves plus the "
@@ -962,6 +1047,12 @@ def _definition() -> ConfigDef:
     d.define("self.healing.metric.anomaly.enabled", T.BOOLEAN, False, None, I.MEDIUM, "")
     d.define("self.healing.topic.anomaly.enabled", T.BOOLEAN, False, None, I.MEDIUM, "")
     d.define("self.healing.maintenance.event.enabled", T.BOOLEAN, False, None, I.MEDIUM, "")
+    d.define("self.healing.slo.burn.enabled", T.BOOLEAN, False, None,
+             I.MEDIUM,
+             "Per-type self-healing switch for SLO_BURN anomalies (the "
+             "notifier's FIX verdict gate). The fix is a mitigation "
+             "nudge — it marks the predictive precompute pending so the "
+             "next fleet cycle refreshes proposals — never a move.")
     d.define("maintenance.event.reader.class", T.CLASS,
              "cruise_control_tpu.detector.maintenance.InMemoryMaintenanceEventReader",
              None, I.MEDIUM,
@@ -1238,7 +1329,7 @@ def _definition() -> ConfigDef:
                "pause.sampling", "resume.sampling", "demote.broker", "admin",
                "review", "topic.configuration", "rightsize", "remove.disks",
                "fleet", "trace", "solver", "profile", "compare.futures",
-               "heals", "forecast"):
+               "heals", "forecast", "journeys", "slo"):
         d.define(f"{ep}.parameters.class", T.CLASS, None, None, I.LOW,
                  f"Parameter-parsing plugin for the {ep} endpoint "
                  "(callable(query) -> params dict).")

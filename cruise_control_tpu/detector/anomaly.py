@@ -36,6 +36,12 @@ class AnomalyType(enum.Enum):
     # PROJECTS within the horizon. Lowest priority: a prediction must
     # never preempt a real anomaly in the fix queue.
     PREDICTED_GOAL_VIOLATION = 6
+    # SLO burn (no reference analogue — the reference has no SLO
+    # evaluation at all): an objective's fast+slow burn windows both
+    # over threshold (utils/slo.py, detector/slo_burn.py). Lowest
+    # priority: budget burn is a service-quality signal, never more
+    # urgent than a concrete fault.
+    SLO_BURN = 7
 
     @property
     def priority(self) -> int:
@@ -82,6 +88,7 @@ class Anomaly:
             AnomalyType.MAINTENANCE_EVENT: "self.healing.maintenance.event.enabled",
             AnomalyType.PREDICTED_GOAL_VIOLATION:
                 "self.healing.predicted.violation.enabled",
+            AnomalyType.SLO_BURN: "self.healing.slo.burn.enabled",
         }[self.anomaly_type]
 
     def __lt__(self, other: "Anomaly") -> bool:
@@ -282,6 +289,45 @@ class PredictedGoalViolations(Anomaly):
         return fix_fn(
             execute=execute,
             reason=f"proactive predicted violation {self.predicted_goals}",
+            anomaly_id=self.anomaly_id)
+
+
+@dataclass
+class SloBurn(Anomaly):
+    """SLO burn-rate anomaly (no reference analogue): one objective's
+    error budget burning fast enough that BOTH multi-window pairs
+    (utils/slo.py) agree. The signature is the OBJECTIVE, so a standing
+    burn aliases onto one heal chain; the chain resolves ``cleared``
+    when the budget recovers (detector/slo_burn.py). The fix never
+    mutates the cluster: it stamps the heal chain and flags the pacer
+    for an immediate precompute so a capacity answer is hot — burning
+    budget is a service-quality signal, not a placement fault."""
+
+    objective: str = ""
+    fast_burn: float = 0.0     # burn rate over the fast (shortest) window
+    slow_burn: float = 0.0     # burn rate over the slow-confirm window
+    budget_remaining: float = 0.0
+
+    def __post_init__(self):
+        self.anomaly_type = AnomalyType.SLO_BURN
+
+    def reasons(self) -> list[str]:
+        return [f"SLO burn on objective {self.objective!r}: "
+                f"fast burn {self.fast_burn:.1f}x, "
+                f"slow burn {self.slow_burn:.1f}x, "
+                f"budget remaining {self.budget_remaining:.2f}"]
+
+    def fix(self, facade: Any) -> bool:
+        if not self.objective:
+            return False
+        fix_fn = getattr(facade, "fix_slo_burn", None)
+        if fix_fn is None:
+            return False
+        return fix_fn(
+            objective=self.objective,
+            reason=f"SLO burn on {self.objective} "
+                   f"(fast {self.fast_burn:.1f}x / "
+                   f"slow {self.slow_burn:.1f}x)",
             anomaly_id=self.anomaly_id)
 
 

@@ -134,6 +134,11 @@ class AnomalyDetectorManager:
         if fixable is not None or unfixable is not None:
             return tuple(sorted(fixable or ())) \
                 + tuple(sorted(unfixable or ()))
+        objective = getattr(anomaly, "objective", None)
+        if objective:
+            # A standing SLO burn re-reported while still burning is ONE
+            # incident per objective (detector/slo_burn.py).
+            return (objective,)
         return (anomaly.anomaly_id,)
 
     def report(self, anomaly: Anomaly) -> None:

@@ -1,0 +1,134 @@
+"""SLO burn-rate detector: multi-window budget burn → SLO_BURN anomaly.
+
+The production half of the shared SLO definition (``utils/slo.py``): a
+detector tick evaluates every registered objective's multi-window burn
+rule and raises a first-class ``SloBurn`` anomaly when an objective is
+burning — fast pair (5m/1h) both over the fast threshold, or slow pair
+(30m/6h) both over the slow threshold. The anomaly signature is the
+OBJECTIVE (detector/manager.py), so a standing burn re-reported each
+interval aliases onto ONE heal chain; when the budget recovers the
+detector resolves that chain's terminal ``cleared``
+(via=budget_recovered) itself.
+
+The tick also feeds the time-to-heal objective: cleared heal-ledger
+chains publish their durations (``heal_durations_s``), and the multiset
+diff against what this detector already fed becomes
+``registry.observe_heal`` events — healing speed is itself an SLO.
+
+Lifecycle (mirrors ``PredictiveViolationDetector``):
+
+- burning & no open chain → report ``SloBurn`` (one per objective),
+  stamp the chain's ``burning`` phase with the live rates;
+- burning & open chain → nothing (the signature alias absorbs it);
+- recovered & open chain → resolve ``cleared`` via=budget_recovered.
+
+Off means off: with ``slo.enabled=false`` a tick is one attribute read
+(the bench ``slo_noop_overhead`` probe covers the registry hooks), and
+open chains raised before the flip still resolve so no chain leaks.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+from typing import Callable
+
+from .anomaly import SloBurn
+
+LOG = logging.getLogger(__name__)
+
+
+class SloBurnDetector:
+    #: Heal-ledger all-clear seam (detector/manager.py): burns resolve
+    #: through the detector's OWN budget-recovered bookkeeping below —
+    #: the generic all-clear would race it to the terminal with a
+    #: different via, so it stays out of the way.
+    CLEARS = ()
+
+    def __init__(self, registry, report: Callable, ledger=None):
+        self._registry = registry
+        self._report = report
+        self._ledger = ledger
+        # Open burns: objective name -> anomaly_id of its heal chain.
+        self._open: dict[str, str] = {}
+        # Multiset of heal durations already fed to the time-to-heal
+        # objective (heal_durations_s returns the full sorted history;
+        # the Counter diff isolates chains cleared since the last tick).
+        self._heals_seen: collections.Counter = collections.Counter()
+        self.burns_raised = 0
+        self.burns_cleared = 0
+
+    # -- state (merged into the GET /slo body) -----------------------------
+    def state(self) -> dict:
+        return {
+            "openBurns": sorted(self._open),
+            "burnsRaised": self.burns_raised,
+            "burnsCleared": self.burns_cleared,
+        }
+
+    # -- the pass ----------------------------------------------------------
+    def run_once(self) -> SloBurn | None:
+        if not self._registry.enabled:
+            # Off means off for NEW burns — but chains opened before the
+            # flip must still reach a terminal or they leak open
+            # forever. Guarded on _open so the disabled tick stays one
+            # attribute read.
+            if self._open:
+                for objective in list(self._open):
+                    self._clear(objective, via="slo_disabled")
+            return None
+        self._feed_heals()
+        raised: SloBurn | None = None
+        for obj in self._registry.objectives():
+            burning = self._registry.burning(obj.name)
+            if burning and obj.name not in self._open:
+                rates = self._registry.burn_rates(obj.name)
+                w = self._registry.windows_s
+                anomaly = SloBurn(
+                    objective=obj.name,
+                    fast_burn=round(rates.get(w[0], 0.0), 3),
+                    slow_burn=round(rates.get(w[3], 0.0), 3),
+                    budget_remaining=round(
+                        self._registry.budget_remaining(obj.name), 4))
+                self._report(anomaly)
+                self._open[obj.name] = anomaly.anomaly_id
+                self.burns_raised += 1
+                from ..utils.sensors import SENSORS
+                SENSORS.count("slo_burn_anomalies")
+                if self._ledger is not None:
+                    # First phase on the chain: the live rates that
+                    # crossed the rule (re-detections alias onto this
+                    # chain via the objective signature, so the stamp
+                    # lands once per incident).
+                    self._ledger.handle_for(anomaly.anomaly_id).phase(
+                        "burning", objective=obj.name,
+                        fastBurn=anomaly.fast_burn,
+                        slowBurn=anomaly.slow_burn,
+                        budgetRemaining=anomaly.budget_remaining)
+                raised = raised or anomaly
+            elif not burning and obj.name in self._open:
+                self._clear(obj.name, via="budget_recovered")
+        return raised
+
+    def _clear(self, objective: str, via: str) -> None:
+        anomaly_id = self._open.pop(objective)
+        self.burns_cleared += 1
+        from ..utils.sensors import SENSORS
+        SENSORS.count("slo_burn_cleared")
+        if self._ledger is not None:
+            self._ledger.handle_for(anomaly_id).resolve(
+                "cleared", via=via, objective=objective)
+
+    def _feed_heals(self) -> None:
+        """Cleared heal chains → time-to-heal objective events. The
+        ledger serves the full sorted duration history; the multiset
+        diff against what we already fed isolates the fresh clears."""
+        if self._ledger is None:
+            return
+        durations = collections.Counter(
+            round(d, 6) for d in self._ledger.heal_durations_s())
+        fresh = durations - self._heals_seen
+        self._heals_seen = durations
+        for duration_s, n in sorted(fresh.items()):
+            for _ in range(n):
+                self._registry.observe_heal(duration_s)

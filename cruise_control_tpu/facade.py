@@ -164,7 +164,8 @@ class CruiseControl:
                 enabled=config.get_boolean("tracing.enabled"),
                 max_traces=config.get_int("tracing.max.traces"),
                 jsonl_path=config.get("tracing.jsonl.path") or None,
-                jsonl_max_bytes=config.get_long("tracing.jsonl.max.bytes"))
+                jsonl_max_bytes=config.get_long("tracing.jsonl.max.bytes"),
+                jsonl_max_files=config.get_int("tracing.jsonl.max.files"))
             FLIGHT.configure(
                 enabled=config.get_boolean("solver.flight.recorder.enabled"),
                 max_passes=config.get_int("solver.flight.recorder.max.passes"),
@@ -220,6 +221,19 @@ class CruiseControl:
             max_chains=config.get_int("heal.ledger.max.chains"),
             max_phases=config.get_int("heal.ledger.max.phases"),
             clock=clock if clock is not None else time.time)
+        # Request journeys + SLO engine (round 21): per-facade like the
+        # heal ledger — a fleet's clusters and an embedded twin each
+        # keep their own ring and their own objective windows, on their
+        # own (possibly simulated) clock.
+        from .serving.journey import JourneyLog
+        self.journeys = JourneyLog(
+            enabled=config.get_boolean("journey.enabled"),
+            max_entries=config.get_int("journey.max.entries"),
+            monotonic=clock if clock is not None else time.monotonic,
+            clock=clock if clock is not None else time.time)
+        from .utils.slo import SloRegistry
+        self.slo = SloRegistry.from_config(
+            config, clock=clock if clock is not None else time.time)
         self._anomaly_detector = AnomalyDetectorManager(
             config, self._notifier, facade=self, clock=self._clock,
             ledger=self.heal_ledger)
@@ -381,6 +395,15 @@ class CruiseControl:
         self.predictive_detector.excluded_brokers_supplier = \
             _excluded_snapshot
         mgr.add_detector(self.predictive_detector, interval)
+        # SLO burn detector (round 21): evaluates the facade's objective
+        # registry's multi-window burn rule and raises SLO_BURN anomalies
+        # through the same manager/ledger path. Registered
+        # unconditionally — a disabled registry makes its tick one
+        # attribute read (the noop-overhead guard family).
+        from .detector.slo_burn import SloBurnDetector
+        self.slo_burn_detector = SloBurnDetector(
+            self.slo, report, ledger=self.heal_ledger)
+        mgr.add_detector(self.slo_burn_detector, interval)
         mgr.add_detector(BrokerFailureDetector(
             self._admin, report,
             failed_brokers_file_path=cfg.get("failed.brokers.file.path"),
@@ -873,6 +896,9 @@ class CruiseControl:
                     # flight carries the evidence that serving degraded
                     # during its window.
                     self.heal_ledger.note_stale(staleness_s)
+                    # Staleness-age SLO objective: a degraded serve is
+                    # one classified event (bad past the threshold).
+                    self.slo.observe_staleness(staleness_s)
                     from .utils.tracing import TRACER
                     TRACER.annotate(stale=True, staleness_s=staleness_s)
                     return OperationResult(
@@ -905,9 +931,12 @@ class CruiseControl:
         deficit-aware count-goal sizing, and a fleet-wired deployment
         must not return different proposals than a standalone one for
         the same cluster state."""
+        from .serving.journey import current_journey
         from .utils.heal_ledger import current_heal
         from .utils.sensors import SENSORS
         heal = current_heal()
+        jny = current_journey()
+        jny_t0 = jny.now()
         width = self.megabatch_solve_width
         batched = bool(width and not options.fast_mode
                        and self._optimizer.mesh is None
@@ -985,10 +1014,11 @@ class CruiseControl:
         # solve from another thread can land inside it, so the ids are
         # filtered by this solve's ambient cluster label).
         marker = None
-        if heal.recording:
+        if heal.recording or jny.recording:
             from .utils.flight_recorder import FLIGHT
             if FLIGHT.enabled:
                 marker = FLIGHT.marker()
+        if heal.recording:
             heal.phase("solve_dispatched",
                        path="megabatch" if batched else "serial",
                        warmStart=warm_seed is not None)
@@ -1037,20 +1067,36 @@ class CruiseControl:
             self._warm_store(res[0], meta, res[1], seed=warm_seed,
                              warm_accepted=warm_seed is not None
                              and not warm_fallback)
+        pass_seqs = None
+        if marker is not None:
+            from .utils.flight_recorder import FLIGHT
+            from .utils.sensors import current_cluster_label
+            # The batched path records its flight pass under the
+            # same "default" fallback it solved under — the filter
+            # label must match or the /solver link comes back empty
+            # exactly on the megabatch path.
+            label = current_cluster_label() \
+                or ("default" if batched else None)
+            pass_seqs = [
+                p["passSeq"] for p in FLIGHT.passes_since(marker)
+                if p.get("cluster") == label]
+        if jny.recording:
+            # The request's solve segment, linked to the same flight
+            # recorder passes and (when ambient) the heal chain the
+            # solve ran on account of.
+            attrs: dict = {"path": "megabatch" if batched else "serial",
+                           "warmStart": warm_seed is not None}
+            if warm_fallback:
+                attrs["warmFallback"] = True
+            if pass_seqs:
+                attrs["passSeqs"] = pass_seqs
+            if heal.recording:
+                attrs["healChainId"] = heal.chain_id
+            jny.add("solve", jny.now() - jny_t0, **attrs)
         if heal.recording:
             detail: dict = {}
-            if marker is not None:
-                from .utils.flight_recorder import FLIGHT
-                from .utils.sensors import current_cluster_label
-                # The batched path records its flight pass under the
-                # same "default" fallback it solved under — the filter
-                # label must match or the /solver link comes back empty
-                # exactly on the megabatch path.
-                label = current_cluster_label() \
-                    or ("default" if batched else None)
-                detail["passSeqs"] = [
-                    p["passSeq"] for p in FLIGHT.passes_since(marker)
-                    if p.get("cluster") == label]
+            if pass_seqs is not None:
+                detail["passSeqs"] = pass_seqs
             if batched:
                 # The fleet-wired solve rode the batched kernels at
                 # occupancy 1 (one compiled program per bucket shape
@@ -1266,6 +1312,28 @@ class CruiseControl:
     # Backwards-compatible precompute entry (the anomaly's default fix).
     def precompute_predicted(self) -> bool:
         return self.fix_predicted_violation(execute=False)
+
+    def fix_slo_burn(self, objective: str = "", reason: str = "",
+                     anomaly_id: str | None = None) -> bool:
+        """The SLO_BURN fix: no rebalance to run — the burn is a serving
+        condition, not an assignment problem — but the chain must reach
+        FIX_STARTED and stay OPEN until the detector's budget-recovered
+        terminal (returning False would close it ``fix_failed_to_start``
+        and the clear would have no chain to land on). Mitigation is the
+        precompute pacer flag: a hot proposal cache removes solve time
+        from the request path, the one lever self-healing owns against a
+        latency/shed burn. Returns True (the fix-started contract)."""
+        from .utils.heal_ledger import current_heal
+        from .utils.sensors import SENSORS
+        current_heal().phase("mitigation_started", objective=objective,
+                             reason=reason or "slo burn",
+                             action="precompute_refresh")
+        # Same lever as the predictive fix's precompute mode: the fleet
+        # pacer refreshes this cluster's proposal cache on its next
+        # sweep instead of waiting out the cadence.
+        self.predicted_precompute_pending = True
+        SENSORS.count("slo_burn_mitigations")
+        return True
 
     def forecast_state(self, refresh: bool = False) -> dict:
         """GET /forecast body: the engine's last projection (per-broker

@@ -76,6 +76,14 @@ class DeterminismRule(Rule):
         # driver's flight-telemetry timing is the one documented
         # suppression.
         "cruise_control_tpu/analyzer/direct.py",
+        # Journeys + SLO engine (round 18/observability): journey
+        # segments and SLO window events stamp from injected
+        # monotonic/clock seams only — the twin replays both on the sim
+        # clock, and the burn detector's multi-window verdicts must be
+        # byte-identical per seed.
+        "cruise_control_tpu/serving/journey.py",
+        "cruise_control_tpu/utils/slo.py",
+        "cruise_control_tpu/detector/slo_burn.py",
     )
 
     CLOCK_CALLS = ("time.time", "time.time_ns", "time.monotonic",

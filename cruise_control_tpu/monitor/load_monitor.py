@@ -422,6 +422,12 @@ class LoadMonitor:
         self._last_model_s = time.time() - t0
         SENSORS.record_timer("monitor_cluster_model_creation",
                              self._last_model_s)
+        # The request's model_build segment (NO_JOURNEY no-op outside a
+        # journey scope — the ambient-stamp discipline of current_heal).
+        from ..serving.journey import current_journey
+        current_journey().add("model_build", self._last_model_s,
+                              generation=self.model_generation,
+                              brokers=len(alive))
         return built
 
     def _build(self, partitions: Mapping[tuple[str, int], PartitionState],

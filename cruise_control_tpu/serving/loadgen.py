@@ -156,9 +156,14 @@ class LoadReport:
     shed_rate: float
     digests: dict           # spec name -> set of 200-response digests
     results: list
+    # Per-segment latency attribution from the facade's journey ring
+    # (serving.journey.segment_attribution): segment -> count/total/
+    # p50/p99 plus the attributed-fraction rollup. None when journeys
+    # are disabled or no ring was supplied.
+    attribution: dict | None = None
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "schedule_digest": self.schedule_digest,
             "requests": self.requests,
             "wall_s": round(self.wall_s, 4),
@@ -169,6 +174,9 @@ class LoadReport:
             "shed_with_retry_after": self.shed_with_retry_after,
             "shed_rate": round(self.shed_rate, 4),
         }
+        if self.attribution is not None:
+            out["attribution"] = self.attribution
+        return out
 
 
 def _quantile(sorted_vals: list[float], q: float) -> float:
@@ -182,12 +190,14 @@ def run_schedule(api, schedule: list[ScheduledRequest],
                  concurrency: int = 8,
                  headers: dict | None = None,
                  monotonic: Callable[[], float] = time.monotonic,
-                 ) -> LoadReport:
+                 journey_log=None) -> LoadReport:
     """Execute the schedule against the REAL api: ``concurrency`` worker
     threads consume requests in arrival ORDER (the open-loop property
     lives in the schedule — arrivals never wait for completions beyond
     the worker bound), each measuring its own wall latency through the
-    injected clock seam."""
+    injected clock seam. Pass the facade's ``journey_log`` to fold its
+    per-request segment attribution into the report (where did the wall
+    time GO, not just how long it took)."""
     results: list[RequestResult | None] = [None] * len(schedule)
     cursor = [0]
     lock = threading.Lock()
@@ -242,6 +252,10 @@ def run_schedule(api, schedule: list[ScheduledRequest],
         by_class[klass] = {"count": len(vals),
                            "p50_s": round(_quantile(vals, 0.50), 6),
                            "p99_s": round(_quantile(vals, 0.99), 6)}
+    attribution = None
+    if journey_log is not None and getattr(journey_log, "enabled", False):
+        from .journey import segment_attribution
+        attribution = segment_attribution(journey_log.entries())
     return LoadReport(
         schedule_digest=schedule_digest(schedule),
         requests=len(done), wall_s=wall,
@@ -249,7 +263,7 @@ def run_schedule(api, schedule: list[ScheduledRequest],
         by_status=by_status, by_class=by_class,
         shed=shed, shed_with_retry_after=shed_ra,
         shed_rate=shed / max(1, len(done)),
-        digests=digests, results=done)
+        digests=digests, results=done, attribution=attribution)
 
 
 def slo_violations(report: LoadReport, slo: dict) -> list[str]:

@@ -182,6 +182,11 @@ class AsyncTaskEngine:
             return
         rec.lifecycle = "running"
         rec.started_s = self._monotonic()
+        # The REAL wait distribution behind the Retry-After EWMA: queue
+        # time per class, observable instead of EWMA-internal.
+        SENSORS.observe("serving_queue_wait_seconds",
+                        max(0.0, rec.started_s - rec.enqueued_s),
+                        labels={"class": rec.klass.value})
         try:
             result = fn()
         except BaseException as e:  # noqa: BLE001 — future carries it

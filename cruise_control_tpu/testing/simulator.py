@@ -315,22 +315,19 @@ class ScenarioScore:
         return self.replica_moves / max(self.sim_hours, 1e-9)
 
     def slo_violations(self) -> list[str]:
-        out = []
-        if self.unhealed():
-            out.append(f"unhealed_faults={self.unhealed()}")
-        p95 = self.time_to_heal_p95_ticks()
-        if p95 is not None and p95 > self._slo_heal_ticks:
-            out.append(f"time_to_heal_p95={p95}>"
-                       f"{self._slo_heal_ticks}_ticks")
-        if self.ticks_below_balancedness_slo:
-            out.append(f"balancedness_below_{self._slo_bal_min}_for_"
-                       f"{self.ticks_below_balancedness_slo}_ticks")
-        if self._slo_moves_hr and self.moves_per_simhour() > self._slo_moves_hr:
-            out.append(f"moves_per_simhour={self.moves_per_simhour():.1f}>"
-                       f"{self._slo_moves_hr}")
-        if self.dead_letters:
-            out.append(f"dead_letters={self.dead_letters}")
-        return out
+        # ONE SLO definition for twin and production: the floor verdicts
+        # render through utils.slo so GET /slo and the scenario report
+        # can never drift apart (strings pinned byte-identical).
+        from ..utils.slo import scenario_floor_violations
+        return scenario_floor_violations(
+            unhealed=self.unhealed(),
+            time_to_heal_p95_ticks=self.time_to_heal_p95_ticks(),
+            heal_ticks_floor=self._slo_heal_ticks,
+            ticks_below_balancedness=self.ticks_below_balancedness_slo,
+            balancedness_min=self._slo_bal_min,
+            moves_per_simhour=self.moves_per_simhour(),
+            moves_floor=self._slo_moves_hr,
+            dead_letters=self.dead_letters)
 
     def as_dict(self) -> dict:
         p95 = self.time_to_heal_p95_ticks()

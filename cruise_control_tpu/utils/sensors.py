@@ -87,20 +87,25 @@ class _Histogram:
         self.total += value
         self.count += 1
 
-    def quantile(self, q: float) -> float | None:
+    def quantile(self, q: float) -> float:
         return bucket_quantile(self.buckets, self.counts, q)
 
 
-def bucket_quantile(buckets: tuple, counts: list, q: float) -> float | None:
+def bucket_quantile(buckets: tuple, counts: list, q: float) -> float:
     """Estimated q-quantile (0..1) over NON-cumulative bucket counts
     (+Inf overflow last), with linear interpolation inside the landing
-    bucket (the Prometheus histogram_quantile estimate); None when empty.
-    The +Inf bucket clamps to the top finite bound. Exposed standalone so
-    callers holding snapshot DIFFS (per-stage bench windows) reuse the
-    same math."""
+    bucket (the Prometheus histogram_quantile estimate). The +Inf bucket
+    clamps to the top finite bound. Edge cases are PINNED, never
+    None/NaN: an empty window (all-zero counts, or no finite bounds)
+    is 0.0; a single-bucket layout answers its one bound — the SLO
+    engine's latency objectives call this hot and must get a number.
+    Exposed standalone so callers holding snapshot DIFFS (per-stage
+    bench windows) reuse the same math."""
     total = sum(counts)
-    if total == 0:
-        return None
+    if total == 0 or not buckets:
+        return 0.0
+    if len(buckets) == 1:
+        return float(buckets[0])
     rank = q * total
     cum = 0
     for i, c in enumerate(counts):
@@ -165,9 +170,10 @@ class SensorRegistry:
 
     def quantile(self, name: str, q: float,
                  labels: dict | None = None) -> float | None:
-        """Estimated q-quantile of a histogram series (None when the
-        series does not exist or is empty) — the bench/CI summary hook
-        for p50/p99 columns."""
+        """Estimated q-quantile of a histogram series (None ONLY when
+        the series does not exist; an existing-but-empty window pins to
+        0.0 via bucket_quantile) — the bench/CI summary hook for
+        p50/p99 columns."""
         k = self._key(name, labels)
         with self._lock:
             h = self._histograms.get(k)

@@ -1,0 +1,394 @@
+"""Declarative SLO registry with multi-window burn-rate evaluation.
+
+The production side of ScenarioScore's SLO floors (ROADMAP items 4/5):
+a registry of service-level objectives — latency-quantile, error-rate,
+shed-rate, staleness-age, time-to-heal — each a budgeted bad-event
+fraction evaluated over sliding multi-window counters on the injectable
+clock. Burn rate is the Google SRE Workbook definition:
+
+    burn(window) = bad_fraction(window) / budget
+
+so burn 1.0 spends the budget exactly at the objective period's pace,
+and multi-window alerting (fast 5m/1h AND slow 30m/6h pairs both over
+threshold) turns a standing burn into ONE low-flap signal —
+``detector/slo_burn.py`` raises it as a first-class heal-ledger-tracked
+anomaly.
+
+Event-based windows: a window holds the events whose record time falls
+inside it; no events → burn 0.0 (never NaN). Exposed as
+``slo_error_budget_remaining{objective}`` /
+``slo_burn_rate{objective,window}`` gauges and ``GET /slo``.
+
+The SAME module evaluates the twin's floors:
+``scenario_floor_violations`` renders ScenarioScore's verdict strings
+byte-identically (testing/simulator.py delegates), so twin and
+production share one SLO definition.
+
+Off-means-off: a disabled registry's ``record*`` hooks return
+immediately (benched as ``slo_noop_overhead``); observation never
+changes behavior. Deterministic machinery (CCSA004): all timestamps
+ride the injected ``clock`` seam.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Callable
+
+from .sensors import SENSORS
+
+#: (fast, fast-confirm, slow, slow-confirm) window lengths in seconds —
+#: the SRE Workbook's 5m/1h + 30m/6h multi-window pairs.
+DEFAULT_WINDOWS_S = (300.0, 3600.0, 1800.0, 21600.0)
+
+#: Objective kinds the registry understands. latency/staleness/heal are
+#: threshold-classified durations; error/shed classify by status.
+OBJECTIVE_KINDS = ("latency", "error", "shed", "staleness", "heal")
+
+#: Events older than the longest window plus this slack are pruned.
+_PRUNE_SLACK_S = 60.0
+
+#: Per-objective event-ring bound (a backstop above any realistic rate;
+#: windows prune by age first).
+_MAX_EVENTS = 65536
+
+
+@dataclasses.dataclass(frozen=True)
+class Objective:
+    """One declarative objective: ``budget`` is the allowed bad-event
+    fraction; ``threshold_s`` classifies duration-kind events;
+    ``quantile`` is the latency objective's reporting quantile."""
+
+    name: str
+    kind: str
+    budget: float
+    threshold_s: float = 0.0
+    quantile: float = 0.99
+
+
+class SloRegistry:
+    """Sliding multi-window good/bad counters per objective.
+
+    ``record_request`` classifies one front-door response into every
+    request-kind objective; ``observe_staleness`` / ``observe_heal``
+    feed the age/duration objectives from their own seams. ``evaluate``
+    computes per-window burn rates + remaining budget and mirrors them
+    into the sensor registry; ``burning`` applies the multi-window
+    alert rule."""
+
+    def __init__(self, objectives: list[Objective] | None = None,
+                 enabled: bool = True,
+                 windows_s: tuple = DEFAULT_WINDOWS_S,
+                 fast_threshold: float = 14.4,
+                 slow_threshold: float = 6.0,
+                 clock: Callable[[], float] = time.time):
+        self._enabled = bool(enabled)
+        self._clock = clock
+        self._windows = tuple(float(w) for w in windows_s)
+        if len(self._windows) != 4:
+            raise ValueError("windows_s must be (fast, fast_confirm, "
+                             "slow, slow_confirm)")
+        self.fast_threshold = float(fast_threshold)
+        self.slow_threshold = float(slow_threshold)
+        self._lock = threading.Lock()
+        self._objectives: dict[str, Objective] = {}
+        # name -> deque[(t, bad: bool)]
+        self._events: dict[str, collections.deque] = {}
+        self.events_recorded = 0
+        for obj in objectives or ():
+            self.add_objective(obj)
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @property
+    def windows_s(self) -> tuple:
+        return self._windows
+
+    def add_objective(self, obj: Objective) -> None:
+        if obj.kind not in OBJECTIVE_KINDS:
+            raise ValueError(f"unknown objective kind {obj.kind!r}; "
+                             f"expected one of {OBJECTIVE_KINDS}")
+        if not (0.0 < obj.budget <= 1.0):
+            raise ValueError(f"objective {obj.name!r} budget must be in "
+                             f"(0, 1], got {obj.budget}")
+        with self._lock:
+            self._objectives[obj.name] = obj
+            self._events.setdefault(
+                obj.name, collections.deque(maxlen=_MAX_EVENTS))
+
+    def objectives(self) -> list[Objective]:
+        with self._lock:
+            return list(self._objectives.values())
+
+    @classmethod
+    def from_config(cls, config,
+                    clock: Callable[[], float] = time.time,
+                    ) -> "SloRegistry":
+        """The ``slo.*`` config surface → a registry. ``slo.objectives``
+        names the active kinds; each kind reads its own budget/threshold
+        keys."""
+        names = [n.strip() for n in config.get_list("slo.objectives")
+                 if n.strip()]
+        objs: list[Objective] = []
+        for name in names:
+            if name not in OBJECTIVE_KINDS:
+                raise ValueError(
+                    f"slo.objectives entry {name!r} unknown; expected "
+                    f"kinds from {OBJECTIVE_KINDS}")
+            if name == "latency":
+                objs.append(Objective(
+                    "latency", "latency",
+                    budget=config.get_double("slo.objectives.latency.budget"),
+                    threshold_s=config.get_double(
+                        "slo.objectives.latency.threshold.seconds"),
+                    quantile=config.get_double(
+                        "slo.objectives.latency.quantile")))
+            elif name == "error":
+                objs.append(Objective(
+                    "error", "error",
+                    budget=config.get_double("slo.objectives.error.budget")))
+            elif name == "shed":
+                objs.append(Objective(
+                    "shed", "shed",
+                    budget=config.get_double("slo.objectives.shed.budget")))
+            elif name == "staleness":
+                objs.append(Objective(
+                    "staleness", "staleness",
+                    budget=config.get_double(
+                        "slo.objectives.staleness.budget"),
+                    threshold_s=config.get_double(
+                        "slo.objectives.staleness.threshold.seconds")))
+            elif name == "heal":
+                objs.append(Objective(
+                    "heal", "heal",
+                    budget=config.get_double("slo.objectives.heal.budget"),
+                    threshold_s=config.get_double(
+                        "slo.objectives.heal.threshold.seconds")))
+        windows = tuple(float(w) for w in
+                        config.get_list("slo.burn.windows"))
+        return cls(objs, enabled=config.get_boolean("slo.enabled"),
+                   windows_s=windows,
+                   fast_threshold=config.get_double(
+                       "slo.burn.fast.threshold"),
+                   slow_threshold=config.get_double(
+                       "slo.burn.slow.threshold"),
+                   clock=clock)
+
+    # -- recording ---------------------------------------------------------
+    def record(self, objective: str, bad: bool) -> None:
+        """One classified event for one objective (no-op when disabled
+        or the objective is not registered)."""
+        if not self._enabled:
+            return
+        with self._lock:
+            events = self._events.get(objective)
+            if events is None:
+                return
+            events.append((self._clock(), bool(bad)))
+            self.events_recorded += 1
+
+    def record_request(self, seconds: float, status: int) -> None:
+        """Classify one front-door response into every request-kind
+        objective: latency counts successful responses over/under the
+        threshold, error counts non-(200/202/429) statuses, shed counts
+        429s."""
+        if not self._enabled:
+            return
+        now = self._clock()
+        ok = status in (200, 202)
+        with self._lock:
+            for obj in self._objectives.values():
+                if obj.kind == "latency":
+                    if ok:
+                        self._events[obj.name].append(
+                            (now, seconds > obj.threshold_s))
+                        self.events_recorded += 1
+                elif obj.kind == "error":
+                    self._events[obj.name].append(
+                        (now, status not in (200, 202, 429)))
+                    self.events_recorded += 1
+                elif obj.kind == "shed":
+                    self._events[obj.name].append((now, status == 429))
+                    self.events_recorded += 1
+
+    def observe_staleness(self, age_s: float) -> None:
+        """Staleness-age objective seam (the facade's stale-serving
+        observations): bad when the served age exceeds the threshold."""
+        if not self._enabled:
+            return
+        with self._lock:
+            for obj in self._objectives.values():
+                if obj.kind == "staleness":
+                    self._events[obj.name].append(
+                        (self._clock(), age_s > obj.threshold_s))
+                    self.events_recorded += 1
+
+    def observe_heal(self, duration_s: float) -> None:
+        """Time-to-heal objective seam (fed from cleared heal-ledger
+        chains): bad when the heal took longer than the threshold."""
+        if not self._enabled:
+            return
+        with self._lock:
+            for obj in self._objectives.values():
+                if obj.kind == "heal":
+                    self._events[obj.name].append(
+                        (self._clock(), duration_s > obj.threshold_s))
+                    self.events_recorded += 1
+
+    # -- evaluation --------------------------------------------------------
+    def _counts_locked(self, objective: str, now: float,
+                       window_s: float) -> tuple[int, int]:
+        good = bad = 0
+        cutoff = now - window_s
+        for t, is_bad in self._events[objective]:
+            if t < cutoff:
+                continue
+            if is_bad:
+                bad += 1
+            else:
+                good += 1
+        return good, bad
+
+    def _prune_locked(self, now: float) -> None:
+        horizon = now - max(self._windows) - _PRUNE_SLACK_S
+        for events in self._events.values():
+            while events and events[0][0] < horizon:
+                events.popleft()
+
+    def burn_rates(self, objective: str) -> dict[float, float]:
+        """window seconds → burn rate (bad_fraction / budget; 0.0 when
+        the window holds no events — never NaN)."""
+        with self._lock:
+            obj = self._objectives.get(objective)
+            if obj is None:
+                return {}
+            now = self._clock()
+            self._prune_locked(now)
+            out = {}
+            for w in self._windows:
+                good, bad = self._counts_locked(objective, now, w)
+                total = good + bad
+                frac = bad / total if total else 0.0
+                out[w] = frac / obj.budget
+            return out
+
+    def budget_remaining(self, objective: str) -> float:
+        """Error budget left over the LONGEST window, clamped [0, 1]."""
+        with self._lock:
+            obj = self._objectives.get(objective)
+            if obj is None:
+                return 1.0
+            now = self._clock()
+            good, bad = self._counts_locked(objective, now,
+                                            max(self._windows))
+            total = good + bad
+            frac = bad / total if total else 0.0
+        return min(1.0, max(0.0, 1.0 - frac / obj.budget))
+
+    def burning(self, objective: str) -> bool:
+        """The multi-window alert rule: the fast pair (windows 0 and 1)
+        both over the fast threshold, OR the slow pair (2 and 3) both
+        over the slow threshold."""
+        rates = self.burn_rates(objective)
+        if not rates:
+            return False
+        w = self._windows
+        fast = rates[w[0]] > self.fast_threshold \
+            and rates[w[1]] > self.fast_threshold
+        slow = rates[w[2]] > self.slow_threshold \
+            and rates[w[3]] > self.slow_threshold
+        return fast or slow
+
+    def evaluate(self) -> dict:
+        """Evaluate every objective: burn per window, remaining budget,
+        burning verdict — mirrored into the
+        ``slo_burn_rate{objective,window}`` /
+        ``slo_error_budget_remaining{objective}`` gauges. The latency
+        objective also reads the live request-latency quantile from the
+        sensor registry (`SensorRegistry.quantile` — the hot caller the
+        empty/single-bucket pinning exists for)."""
+        out: dict[str, dict] = {}
+        for obj in self.objectives():
+            rates = self.burn_rates(obj.name)
+            remaining = self.budget_remaining(obj.name)
+            for w, rate in rates.items():
+                SENSORS.gauge("slo_burn_rate", rate,
+                              labels={"objective": obj.name,
+                                      "window": f"{int(w)}s"})
+            SENSORS.gauge("slo_error_budget_remaining", remaining,
+                          labels={"objective": obj.name})
+            entry = {
+                "kind": obj.kind,
+                "budget": obj.budget,
+                "burnRate": {f"{int(w)}s": round(r, 4)
+                             for w, r in rates.items()},
+                "budgetRemaining": round(remaining, 4),
+                "burning": self.burning(obj.name),
+            }
+            if obj.kind in ("latency", "staleness", "heal"):
+                entry["thresholdSeconds"] = obj.threshold_s
+            if obj.kind == "latency":
+                observed = SENSORS.quantile("serving_request_seconds",
+                                            obj.quantile)
+                entry["quantile"] = obj.quantile
+                entry["observedQuantileS"] = round(observed, 6) \
+                    if observed is not None else None
+            out[obj.name] = entry
+        return out
+
+    def scenario_violations(self, **floors) -> list[str]:
+        """The twin's floor verdicts through the registry — one SLO
+        definition for production and twin (ScenarioScore delegates to
+        the same renderer)."""
+        return scenario_floor_violations(**floors)
+
+    def state(self) -> dict:
+        """The ``GET /slo`` body: config surface + live evaluation."""
+        with self._lock:
+            counts = {name: len(events)
+                      for name, events in self._events.items()}
+            recorded = self.events_recorded
+        return {
+            "sloEnabled": self._enabled,
+            "windowsS": [int(w) for w in self._windows],
+            "fastBurnThreshold": self.fast_threshold,
+            "slowBurnThreshold": self.slow_threshold,
+            "eventsRecorded": recorded,
+            "eventsHeld": counts,
+            "objectives": self.evaluate(),
+        }
+
+
+def scenario_floor_violations(*, unhealed: int,
+                              time_to_heal_p95_ticks,
+                              heal_ticks_floor: int,
+                              ticks_below_balancedness: int,
+                              balancedness_min: float,
+                              moves_per_simhour: float,
+                              moves_floor: float,
+                              dead_letters: int) -> list[str]:
+    """ScenarioScore's SLO floor verdicts — the twin's half of the
+    shared SLO definition. The rendered strings are PINNED: twin
+    verdicts must stay byte-identical to the pre-registry
+    ``scenario.slo.*`` behavior (tests/test_simulator.py)."""
+    out: list[str] = []
+    if unhealed:
+        out.append(f"unhealed_faults={unhealed}")
+    p95 = time_to_heal_p95_ticks
+    if p95 is not None and p95 > heal_ticks_floor:
+        out.append(f"time_to_heal_p95={p95}>"
+                   f"{heal_ticks_floor}_ticks")
+    if ticks_below_balancedness:
+        out.append(f"balancedness_below_{balancedness_min}_for_"
+                   f"{ticks_below_balancedness}_ticks")
+    if moves_floor and moves_per_simhour > moves_floor:
+        out.append(f"moves_per_simhour={moves_per_simhour:.1f}>"
+                   f"{moves_floor}")
+    if dead_letters:
+        out.append(f"dead_letters={dead_letters}")
+    return out

@@ -200,6 +200,7 @@ class Tracer:
             collections.deque(maxlen=max_traces)
         self._jsonl_path: str | None = None
         self._jsonl_max_bytes = 0
+        self._jsonl_max_files = 1
         self.spans_closed = 0
         self.traces_completed = 0
         self.jsonl_rotations = 0
@@ -212,13 +213,17 @@ class Tracer:
     def configure(self, enabled: bool | None = None,
                   max_traces: int | None = None,
                   jsonl_path: str | None = ...,
-                  jsonl_max_bytes: int | None = None) -> None:
+                  jsonl_max_bytes: int | None = None,
+                  jsonl_max_files: int | None = None) -> None:
         """Apply the config surface (tracing.enabled / tracing.max.traces /
-        tracing.jsonl.path / tracing.jsonl.max.bytes). ``jsonl_path``:
-        ``...`` = leave unchanged, None/"" = off, a path = append one JSON
-        line per trace. ``jsonl_max_bytes``: rotate the dump to
-        ``<path>.1`` before an append would push it past this size
-        (0 = unlimited)."""
+        tracing.jsonl.path / tracing.jsonl.max.bytes /
+        tracing.jsonl.max.files). ``jsonl_path``: ``...`` = leave
+        unchanged, None/"" = off, a path = append one JSON line per
+        trace. ``jsonl_max_bytes``: rotate the dump before an append
+        would push it past this size (0 = unlimited).
+        ``jsonl_max_files``: rotated generations kept — the cascade
+        renames ``.1→.2→…→.N`` and drops ``.N`` (default 1, today's
+        single-``.1`` behavior)."""
         with self._lock:
             if enabled is not None:
                 self._enabled = bool(enabled)
@@ -229,6 +234,8 @@ class Tracer:
                 self._jsonl_path = jsonl_path or None
             if jsonl_max_bytes is not None:
                 self._jsonl_max_bytes = max(0, int(jsonl_max_bytes))
+            if jsonl_max_files is not None:
+                self._jsonl_max_files = max(1, int(jsonl_max_files))
 
     # -- recording ---------------------------------------------------------
     def span(self, name: str, **attributes):
@@ -282,25 +289,30 @@ class Tracer:
             self._ring.append(trace)
             path = self._jsonl_path
             max_bytes = self._jsonl_max_bytes
+            max_files = self._jsonl_max_files
         if path:
             try:
                 line = json.dumps(trace.to_dict()) + "\n"
                 with self._dump_lock:
-                    self._maybe_rotate_jsonl(path, len(line), max_bytes)
+                    self._maybe_rotate_jsonl(path, len(line), max_bytes,
+                                             max_files)
                     with open(path, "a") as f:
                         f.write(line)
             except OSError:  # pragma: no cover — dump is best-effort
                 pass
 
     def _maybe_rotate_jsonl(self, path: str, incoming: int,
-                            max_bytes: int) -> None:
+                            max_bytes: int, max_files: int = 1) -> None:
         """Size-capped rotation (tracing.jsonl.max.bytes): when the next
-        append would push the dump past the cap, the current file becomes
-        ``<path>.1`` (one rotated generation kept — bounded total footprint
-        of ~2× the cap) and the append starts a fresh file. Called under
-        ``_dump_lock``. A single line larger than the cap still lands (in
-        an otherwise-empty file): dropping traces silently would defeat
-        the dump's whole purpose."""
+        append would push the dump past the cap, the generation cascade
+        runs — ``.{N-1}→.N`` down to ``path→.1`` — keeping
+        ``max_files`` rotated generations (tracing.jsonl.max.files;
+        bounded total footprint of ~(max_files+1)× the cap).
+        ``jsonl_rotations`` counts per generation MOVED, so a deep
+        cascade is visible as more than one rotation. Called under
+        ``_dump_lock``. A single line larger than the cap still lands
+        (in an otherwise-empty file): dropping traces silently would
+        defeat the dump's whole purpose."""
         if max_bytes <= 0:
             return
         try:
@@ -308,6 +320,11 @@ class Tracer:
         except OSError:
             return  # no file yet — nothing to rotate
         if size and size + incoming > max_bytes:
+            for gen in range(max(1, max_files), 1, -1):
+                older = f"{path}.{gen - 1}"
+                if os.path.exists(older):
+                    os.replace(older, f"{path}.{gen}")
+                    self.jsonl_rotations += 1
             os.replace(path, path + ".1")
             self.jsonl_rotations += 1
 

@@ -1,0 +1,605 @@
+"""Request journeys + SLO burn-rate engine (observability round).
+
+Unit coverage: the journey record/ring/ambient-scope machinery and the
+multi-window SLO registry, both on injected clocks; the SLO_BURN
+detector lifecycle (confirm -> fix -> budget-recovered clear) through a
+real heal ledger.
+
+Integration coverage: off-means-off byte-identity of GET /proposals at
+two partition shapes with observation on vs off, the GET /journeys and
+GET /slo endpoints through the real api, loadgen segment attribution,
+and twin ScenarioScore floor verdicts staying byte-identical to the
+shared utils.slo renderer at two seeds."""
+
+import json
+
+import pytest
+
+from cruise_control_tpu.api.server import CruiseControlApi
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.config.cruise_control_config import CruiseControlConfig
+from cruise_control_tpu.detector.slo_burn import SloBurnDetector
+from cruise_control_tpu.executor.admin import InMemoryAdminBackend, PartitionState
+from cruise_control_tpu.executor.executor import Executor
+from cruise_control_tpu.facade import CruiseControl
+from cruise_control_tpu.monitor import LoadMonitor, StaticCapacityResolver
+from cruise_control_tpu.monitor.sampling import SyntheticSampler
+from cruise_control_tpu.serving import loadgen
+from cruise_control_tpu.serving.journey import (
+    NO_JOURNEY, JourneyLog, current_journey, journey_scope,
+    segment_attribution,
+)
+from cruise_control_tpu.utils.heal_ledger import HealLedger
+from cruise_control_tpu.utils.slo import (
+    DEFAULT_WINDOWS_S, Objective, SloRegistry, scenario_floor_violations,
+)
+
+
+class _Clock:
+    """Injected monotonic/wall seam for deterministic journeys/windows."""
+
+    def __init__(self, t: float = 1_000_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+# ---- journeys ------------------------------------------------------------
+
+def test_disabled_log_returns_shared_null_and_records_nothing():
+    log = JourneyLog(enabled=False)
+    jny = log.open("PROPOSALS", cluster="alpha")
+    assert jny is NO_JOURNEY
+    assert not jny.recording
+    # Every stamp site calls through unconditionally; all must no-op.
+    jny.add("solve", 1.0)
+    jny.note(outcome="ok")
+    with jny.seg("render") as seg:
+        seg.set(numProposals=3)
+    log.close(jny)
+    assert log.entries() == []
+    assert log.stats() == {"journeysEnabled": False, "journeysOpened": 0,
+                           "journeysClosed": 0, "ringSize": 0}
+
+
+def test_segments_and_attribution_on_injected_clock():
+    clk = _Clock()
+    log = JourneyLog(enabled=True, monotonic=clk, clock=clk)
+    jny = log.open("PROPOSALS", cluster="alpha")
+    with jny.seg("solve", chain="default") as seg:
+        clk.advance(2.0)
+        seg.set(passSeqs=[7])
+    jny.add("queue_wait", 0.5, klass="SOLVER")  # timed on another thread
+    clk.advance(1.0)                            # deliberately unattributed
+    jny.note(outcome="ok", coalesce="leader")
+    log.close(jny)
+
+    (entry,) = log.entries()
+    assert entry["endpoint"] == "PROPOSALS"
+    assert entry["cluster"] == "alpha"
+    assert entry["status"] == "ok"
+    assert entry["totalS"] == pytest.approx(3.0)
+    assert entry["attributes"] == {"outcome": "ok", "coalesce": "leader"}
+    segs = {s["segment"]: s for s in entry["segments"]}
+    assert segs["solve"]["seconds"] == pytest.approx(2.0)
+    assert segs["solve"]["passSeqs"] == [7]
+    assert segs["queue_wait"]["klass"] == "SOLVER"
+    # The remainder is REPORTED, never hidden.
+    assert entry["unattributedS"] == pytest.approx(0.5)
+
+    table = segment_attribution(log.entries())
+    assert table["journeys"] == 1
+    assert table["wall_s"] == pytest.approx(3.0)
+    assert table["attributed_s"] == pytest.approx(2.5)
+    assert table["unattributed_s"] == pytest.approx(0.5)
+    assert table["attributed_fraction"] == pytest.approx(2.5 / 3.0, abs=1e-4)
+    assert table["segments"]["solve"]["count"] == 1
+
+
+def test_ring_is_bounded_and_newest_first():
+    clk = _Clock()
+    log = JourneyLog(enabled=True, max_entries=3, monotonic=clk, clock=clk)
+    for i in range(7):
+        jny = log.open(f"EP{i}")
+        clk.advance(0.1)
+        log.close(jny)
+    entries = log.entries()
+    assert [e["endpoint"] for e in entries] == ["EP6", "EP5", "EP4"]
+    assert log.stats()["ringSize"] == 3
+    assert log.stats()["journeysClosed"] == 7
+    # endpoint filter + limit both apply on the export path.
+    assert log.entries(endpoint="EP5")[0]["endpoint"] == "EP5"
+    assert len(log.entries(limit=2)) == 2
+
+
+def test_stamps_after_close_are_dropped():
+    clk = _Clock()
+    log = JourneyLog(enabled=True, monotonic=clk, clock=clk)
+    jny = log.open("STATE")
+    clk.advance(1.0)
+    log.close(jny)
+    jny.add("late_solve", 9.0)      # a 202's solve finishing after return
+    jny.note(outcome="late")
+    log.close(jny, status="error")  # double close ignored
+    (entry,) = log.entries()
+    assert entry["segments"] == []
+    assert entry["attributes"] == {}
+    assert entry["status"] == "ok"
+    assert log.stats()["journeysClosed"] == 1
+
+
+def test_segment_scope_records_error_type():
+    clk = _Clock()
+    log = JourneyLog(enabled=True, monotonic=clk, clock=clk)
+    jny = log.open("REBALANCE")
+    with pytest.raises(ValueError):
+        with jny.seg("solve"):
+            clk.advance(0.25)
+            raise ValueError("boom")
+    log.close(jny, status="error")
+    (entry,) = log.entries()
+    (seg,) = entry["segments"]
+    assert seg["segment"] == "solve"
+    assert seg["error"] == "ValueError"
+    assert seg["seconds"] == pytest.approx(0.25)
+
+
+def test_ambient_scope_is_null_outside_and_rewraps():
+    assert current_journey() is NO_JOURNEY
+    log = JourneyLog(enabled=True)
+    jny = log.open("LOAD")
+    with journey_scope(jny):
+        assert current_journey() is jny
+        # The engine-worker rewrap discipline: a nested scope with the
+        # null journey must make deep stamps no-op, not leak the outer.
+        with journey_scope(NO_JOURNEY):
+            assert current_journey() is NO_JOURNEY
+        assert current_journey() is jny
+    assert current_journey() is NO_JOURNEY
+
+
+# ---- SLO registry --------------------------------------------------------
+
+def _registry(objectives, clk, **kw):
+    kw.setdefault("windows_s", DEFAULT_WINDOWS_S)
+    return SloRegistry(objectives, enabled=True, clock=clk, **kw)
+
+
+def test_empty_windows_burn_zero_never_nan():
+    clk = _Clock()
+    reg = _registry([Objective("error", "error", budget=0.01)], clk)
+    rates = reg.burn_rates("error")
+    assert set(rates) == set(DEFAULT_WINDOWS_S)
+    assert all(r == 0.0 for r in rates.values())
+    assert reg.budget_remaining("error") == 1.0
+    assert reg.burning("error") is False
+    # The full evaluation must serialize with allow_nan=False.
+    json.dumps(reg.state(), allow_nan=False)
+
+
+def test_record_request_classifies_into_every_kind():
+    clk = _Clock()
+    reg = _registry(
+        [Objective("latency", "latency", budget=0.05, threshold_s=2.0),
+         Objective("error", "error", budget=0.01),
+         Objective("shed", "shed", budget=0.05)], clk)
+    reg.record_request(0.1, 200)    # fast success: all good
+    reg.record_request(5.0, 200)    # slow success: latency bad
+    reg.record_request(0.1, 500)    # error bad; latency NOT recorded
+    reg.record_request(0.1, 429)    # shed bad; neither latency nor error
+    w = max(DEFAULT_WINDOWS_S)
+    assert reg.burn_rates("latency")[w] == pytest.approx((1 / 2) / 0.05)
+    assert reg.burn_rates("error")[w] == pytest.approx((1 / 4) / 0.01)
+    assert reg.burn_rates("shed")[w] == pytest.approx((1 / 4) / 0.05)
+    # 25x error burn exhausts the 1% budget: remaining clamps to 0.
+    assert reg.budget_remaining("error") == 0.0
+
+
+def test_multi_window_rule_needs_both_windows_of_a_pair():
+    clk = _Clock()
+    # Windows: fast pair (300s, 3600s), slow pair (1800s, 21600s).
+    reg = _registry([Objective("shed", "shed", budget=0.01)], clk)
+    for _ in range(20):
+        reg.record("shed", True)
+    # All events recent: every window burns 100x -> both pairs fire.
+    assert reg.burning("shed") is True
+    # Age the events past the 300s fast window: the fast pair loses its
+    # short window but the slow pair (1800s + 21600s) still agrees.
+    clk.advance(400.0)
+    rates = reg.burn_rates("shed")
+    assert rates[300.0] == 0.0 and rates[1800.0] > 6.0
+    assert reg.burning("shed") is True
+    # Age past 1800s: only the two LONG windows still hold events — one
+    # window per pair is not a verdict, so the burn is over.
+    clk.advance(1700.0)
+    rates = reg.burn_rates("shed")
+    assert rates[3600.0] > 0.0 and rates[21600.0] > 0.0
+    assert reg.burning("shed") is False
+
+
+def test_disabled_registry_records_nothing():
+    clk = _Clock()
+    reg = SloRegistry([Objective("shed", "shed", budget=0.01)],
+                      enabled=False, clock=clk)
+    reg.record_request(9.0, 429)
+    reg.record("shed", True)
+    reg.observe_staleness(1e6)
+    reg.observe_heal(1e6)
+    assert reg.events_recorded == 0
+    assert reg.burning("shed") is False
+
+
+def test_from_config_reads_the_slo_surface():
+    cfg = CruiseControlConfig({
+        "slo.enabled": True,
+        "slo.objectives": ["latency", "error", "shed", "staleness", "heal"],
+        "slo.burn.windows": ["60", "600", "300", "3600"],
+        "slo.objectives.shed.budget": 0.02,
+    })
+    reg = SloRegistry.from_config(cfg)
+    assert reg.enabled
+    assert reg.windows_s == (60.0, 600.0, 300.0, 3600.0)
+    by_name = {o.name: o for o in reg.objectives()}
+    assert sorted(by_name) == ["error", "heal", "latency", "shed",
+                               "staleness"]
+    assert by_name["shed"].budget == 0.02
+    assert by_name["latency"].threshold_s == 2.0
+    assert reg.fast_threshold == 14.4 and reg.slow_threshold == 6.0
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError, match="unknown objective kind"):
+        SloRegistry([Objective("x", "nope", budget=0.1)])
+    with pytest.raises(ValueError, match="budget"):
+        SloRegistry([Objective("error", "error", budget=0.0)])
+    with pytest.raises(ValueError, match="windows_s"):
+        SloRegistry(windows_s=(300.0, 3600.0))
+
+
+# ---- burn detector lifecycle (injected clock, real heal ledger) ----------
+
+def _burn_rig(clk, objectives):
+    reg = SloRegistry(objectives, enabled=True, clock=clk)
+    ledger = HealLedger(clock=clk)
+
+    def report(anomaly):
+        # detector/manager.py's report seam: the heal chain opens at
+        # detection, keyed by the objective signature.
+        ledger.open(anomaly.anomaly_type.name, anomaly.anomaly_id,
+                    (anomaly.objective,))
+
+    det = SloBurnDetector(reg, report, ledger=ledger)
+    return reg, ledger, det
+
+
+def test_slo_burn_lifecycle_confirm_then_budget_recovered_clear():
+    clk = _Clock()
+    reg, ledger, det = _burn_rig(
+        clk, [Objective("shed", "shed", budget=0.01),
+              Objective("heal", "heal", budget=0.1, threshold_s=600.0)])
+    # Quiet tick: nothing raised, nothing open.
+    assert det.run_once() is None
+    assert det.state() == {"openBurns": [], "burnsRaised": 0,
+                           "burnsCleared": 0}
+    # 20 sheds -> 100x burn on every window: ONE anomaly, chain opens
+    # with the live rates stamped on its first phase.
+    for _ in range(20):
+        reg.record("shed", True)
+    anomaly = det.run_once()
+    assert anomaly is not None and anomaly.objective == "shed"
+    assert anomaly.fast_burn == pytest.approx(100.0)
+    assert anomaly.budget_remaining == 0.0
+    assert "shed" in anomaly.reasons()[0]
+    # Standing burn: the next tick raises NOTHING new (signature alias).
+    clk.advance(5.0)
+    assert det.run_once() is None
+    assert det.state()["openBurns"] == ["shed"]
+    assert det.state()["burnsRaised"] == 1
+    (chain,) = ledger.chains(anomaly_type="SLO_BURN")
+    assert chain["outcome"] is None      # still open
+
+    burning = next(p for p in chain["phases"] if p["phase"] == "burning")
+    assert burning["objective"] == "shed"
+    assert burning["fastBurn"] == pytest.approx(100.0)
+    # Recovery: dilute the bad fraction under the slow threshold
+    # (20/420 = 4.8% -> 4.8x < 6.0x) and tick again -> terminal clear.
+    clk.advance(5.0)
+    for _ in range(400):
+        reg.record("shed", False)
+    assert det.run_once() is None
+    assert det.state() == {"openBurns": [], "burnsRaised": 1,
+                           "burnsCleared": 1}
+    (chain,) = ledger.chains(anomaly_type="SLO_BURN")
+    assert chain["outcome"] == "cleared"
+    assert chain["phases"][-1]["via"] == "budget_recovered"
+    # Heal durations ride the injected clock exactly: opened at t,
+    # cleared at t+10s.
+    assert chain["healSeconds"] == pytest.approx(10.0)
+    # The NEXT tick feeds that cleared chain into the time-to-heal
+    # objective (10s < 600s threshold -> a good event).
+    det.run_once()
+    w = max(DEFAULT_WINDOWS_S)
+    assert reg.burn_rates("heal")[w] == 0.0
+    assert reg.state()["eventsHeld"]["heal"] == 1
+
+
+def test_slo_burn_detector_re_raises_after_a_clear():
+    clk = _Clock()
+    reg, ledger, det = _burn_rig(clk,
+                                 [Objective("shed", "shed", budget=0.01)])
+    for _ in range(20):
+        reg.record("shed", True)
+    assert det.run_once() is not None
+    # Everything ages out -> clear; then a FRESH burn is a NEW incident
+    # (new chain: the old one is terminal, so no signature alias).
+    clk.advance(30_000.0)
+    assert det.run_once() is None
+    assert det.state()["burnsCleared"] == 1
+    for _ in range(20):
+        reg.record("shed", True)
+    assert det.run_once() is not None
+    assert det.state()["burnsRaised"] == 2
+    chains = ledger.chains(anomaly_type="SLO_BURN")
+    assert sorted((c["outcome"] or "open") for c in chains) == \
+        ["cleared", "open"]
+
+
+def test_slo_burn_disabled_flip_still_clears_open_chains():
+    clk = _Clock()
+    reg, ledger, det = _burn_rig(clk,
+                                 [Objective("shed", "shed", budget=0.01)])
+    for _ in range(20):
+        reg.record("shed", True)
+    assert det.run_once() is not None
+    # Flip the registry off under an open burn: the chain must reach a
+    # terminal rather than leak open forever.
+    reg._enabled = False
+    assert det.run_once() is None
+    assert det.state()["openBurns"] == []
+    (chain,) = ledger.chains(anomaly_type="SLO_BURN")
+    assert chain["outcome"] == "cleared"
+    assert chain["phases"][-1]["via"] == "slo_disabled"
+
+
+# ---- end to end through the real api -------------------------------------
+
+_CAPS = StaticCapacityResolver({}, {Resource.CPU: 100.0, Resource.DISK: 1e7,
+                                    Resource.NW_IN: 1e6, Resource.NW_OUT: 1e6})
+
+
+def _partitions(brokers=(0, 1, 2, 3), topics=2, parts=6):
+    out = {}
+    for t in range(topics):
+        for p in range(parts):
+            reps = (brokers[0], brokers[1 + (t + p) % (len(brokers) - 1)])
+            out[(f"t{t}", p)] = PartitionState(f"t{t}", p, reps, reps[0],
+                                               isr=reps)
+    return out
+
+
+_G = "cruise_control_tpu.analyzer.goals"
+_SHORT_CHAIN = [f"{_G}.RackAwareGoal", f"{_G}.ReplicaCapacityGoal",
+                f"{_G}.ReplicaDistributionGoal"]
+
+
+def _solo_api(extra, partitions):
+    cfg = CruiseControlConfig({
+        "goals": _SHORT_CHAIN,
+        "hard.goals": [f"{_G}.RackAwareGoal", f"{_G}.ReplicaCapacityGoal"],
+        "anomaly.detection.goals": _SHORT_CHAIN,
+        "partition.metrics.window.ms": 1000,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "max.solver.rounds": 30,
+        "failed.brokers.file.path": "",
+        "solver.partition.bucket.size": 0,
+        "solver.broker.bucket.size": 0,
+        **(extra or {})})
+    backend = InMemoryAdminBackend(partitions.values())
+    monitor = LoadMonitor(cfg, backend, samplers=[SyntheticSampler()],
+                          capacity_resolver=_CAPS)
+    cc = CruiseControl(cfg, backend, load_monitor=monitor,
+                       executor=Executor(backend, synchronous=True))
+    for k in range(1, 4):
+        monitor.task_runner.run_sampling_once(end_ms=k * 1000)
+    api = CruiseControlApi(cc)
+    api._async_wait_s = 180
+    return api, cc
+
+
+def _scrubbed(body) -> str:
+    """Canonical JSON minus the two wall-clock measurement fields (goal
+    durations are machine noise with or without observation)."""
+    b = json.loads(json.dumps(body))
+    if isinstance(b.get("summary"), dict):
+        b["summary"].pop("duration_s", None)
+    for g in b.get("goalSummary") or []:
+        g.pop("optimizationTimeMs", None)
+    return json.dumps(b, sort_keys=True)
+
+
+_SHAPES = {"narrow": dict(brokers=(0, 1, 2, 3), topics=2, parts=6),
+           "wide": dict(brokers=tuple(range(8)), topics=2, parts=17)}
+
+
+@pytest.mark.parametrize("shape", sorted(_SHAPES))
+def test_observation_disabled_is_byte_identical(shape):
+    """Off means off: journeys+SLO enabled vs disabled must produce the
+    same proposals bytes (modulo the wall-clock duration fields) and the
+    same loadgen schedule/response stability at two partition shapes."""
+    bodies = {}
+    sched_digests = {}
+    for flag in (True, False):
+        api, cc = _solo_api({"journey.enabled": flag, "slo.enabled": flag},
+                            _partitions(**_SHAPES[shape]))
+        try:
+            status, body, _h = api.handle(
+                "GET", "/kafkacruisecontrol/proposals")
+            assert status == 200, body
+            bodies[flag] = _scrubbed(body)
+            assert cc.journeys.enabled is flag
+            assert cc.slo.enabled is flag
+            # A short pinned-seed loadgen run: the arrival schedule is a
+            # pure function of the seed (never of the flags), and every
+            # proposals spec must stay ONE byte pattern within the run.
+            schedule = loadgen.generate_schedule(
+                loadgen.mixed_profile(), seed=7, rate_rps=30.0,
+                duration_s=0.4)
+            report = loadgen.run_schedule(
+                api, schedule, concurrency=4,
+                journey_log=cc.journeys if flag else None)
+            sched_digests[flag] = report.schedule_digest
+            assert report.by_status.get(200, 0) >= 1
+            for name, digs in report.digests.items():
+                assert len(digs) == 1, (name, digs)
+            assert (report.attribution is not None) is flag
+            if not flag:
+                assert cc.journeys.stats()["journeysOpened"] == 0
+                assert cc.slo.events_recorded == 0
+        finally:
+            api.shutdown()
+    assert bodies[True] == bodies[False]
+    assert sched_digests[True] == sched_digests[False]
+
+
+@pytest.fixture(scope="module")
+def observed_api():
+    api, cc = _solo_api({"journey.enabled": True, "slo.enabled": True},
+                        _partitions())
+    yield api, cc
+    api.shutdown()
+
+
+def test_journeys_attribute_a_real_solve(observed_api):
+    api, cc = observed_api
+    api.response_cache.invalidate()
+    status, _body, _h = api.handle("GET", "/kafkacruisecontrol/proposals")
+    assert status == 200
+    entries = cc.journeys.entries(endpoint="PROPOSALS")
+    assert entries, cc.journeys.stats()
+    segs = {s["segment"] for s in entries[0]["segments"]}
+    # The solve pipeline's named stages all land on the leader journey.
+    assert {"admission", "cache_lookup", "queue_wait", "model_build",
+            "solve", "render"} <= segs
+    solve = next(s for s in entries[0]["segments"]
+                 if s["segment"] == "solve")
+    assert solve["seconds"] > 0.0
+    table = segment_attribution(entries)
+    assert table["attributed_fraction"] > 0.5
+
+
+def test_journeys_endpoint_serves_the_ring(observed_api):
+    api, _cc = observed_api
+    api.handle("GET", "/kafkacruisecontrol/state")
+    status, body, _h = api.handle("GET", "/kafkacruisecontrol/journeys",
+                                  "endpoint=STATE&entries=5")
+    assert status == 200
+    assert body["journeysEnabled"] is True
+    assert 1 <= body["numJourneys"] <= 5
+    assert all(e["endpoint"] == "STATE" for e in body["journeys"])
+
+
+def test_slo_endpoint_reports_objectives_and_detector(observed_api):
+    api, _cc = observed_api
+    api.handle("GET", "/kafkacruisecontrol/state")
+    status, body, _h = api.handle("GET", "/kafkacruisecontrol/slo")
+    assert status == 200
+    assert body["sloEnabled"] is True
+    assert body["eventsRecorded"] >= 1
+    assert sorted(body["objectives"]) == ["error", "latency", "shed"]
+    lat = body["objectives"]["latency"]
+    assert set(lat["burnRate"]) == {f"{int(w)}s" for w in DEFAULT_WINDOWS_S}
+    assert lat["budgetRemaining"] == 1.0
+    assert body["burnDetector"] == {"openBurns": [], "burnsRaised": 0,
+                                    "burnsCleared": 0}
+    json.dumps(body, allow_nan=False)
+    # ?objective= filters the table.
+    _s, filtered, _h = api.handle("GET", "/kafkacruisecontrol/slo",
+                                  "objective=shed")
+    assert sorted(filtered["objectives"]) == ["shed"]
+
+
+def test_loadgen_report_carries_segment_attribution(observed_api):
+    api, cc = observed_api
+    schedule = loadgen.generate_schedule(
+        loadgen.mixed_profile(), seed=3, rate_rps=40.0, duration_s=0.5)
+    assert schedule
+    report = loadgen.run_schedule(api, schedule, concurrency=4,
+                                  journey_log=cc.journeys)
+    assert report.attribution is not None
+    assert report.attribution["journeys"] >= len(schedule)
+    assert report.attribution["attributed_fraction"] > 0.5
+    assert report.to_dict()["attribution"] == report.attribution
+    # Without a ring the report simply omits the table (old behavior).
+    again = loadgen.run_schedule(api, schedule[:2], concurrency=2)
+    assert again.attribution is None
+    assert "attribution" not in again.to_dict()
+
+
+def test_queue_wait_and_segment_histograms_are_emitted(observed_api):
+    """serving_queue_wait_seconds{class=} lands at dequeue and every
+    closed journey mirrors its segments into
+    journey_segment_seconds{endpoint,segment}."""
+    from cruise_control_tpu.utils.sensors import SENSORS
+    api, _cc = observed_api
+    api.response_cache.invalidate()
+    assert api.handle("GET", "/kafkacruisecontrol/proposals")[0] == 200
+    assert api.handle("GET", "/kafkacruisecontrol/state")[0] == 200
+    with SENSORS._lock:
+        series = list(SENSORS._histograms)
+    queue_classes = {dict(labels).get("class")
+                     for name, labels in series
+                     if name == "serving_queue_wait_seconds"}
+    assert {"SOLVER", "VIEWER"} <= queue_classes
+    segments = {dict(labels).get("segment")
+                for name, labels in series
+                if name == "journey_segment_seconds"}
+    assert {"admission", "cache_lookup", "solve", "render"} <= segments
+    snap = SENSORS.histogram_snapshot(
+        "serving_queue_wait_seconds", labels={"class": "SOLVER"})
+    assert snap is not None and snap["count"] >= 1
+
+
+# ---- twin parity ---------------------------------------------------------
+
+def test_scenario_floor_strings_are_pinned():
+    """The five verdict strings render byte-identically to the
+    pre-registry ScenarioScore.slo_violations bodies."""
+    assert scenario_floor_violations(
+        unhealed=2, time_to_heal_p95_ticks=9, heal_ticks_floor=5,
+        ticks_below_balancedness=3, balancedness_min=0.8,
+        moves_per_simhour=125.0, moves_floor=100.0, dead_letters=1) == [
+            "unhealed_faults=2",
+            "time_to_heal_p95=9>5_ticks",
+            "balancedness_below_0.8_for_3_ticks",
+            "moves_per_simhour=125.0>100.0",
+            "dead_letters=1"]
+    assert scenario_floor_violations(
+        unhealed=0, time_to_heal_p95_ticks=None, heal_ticks_floor=5,
+        ticks_below_balancedness=0, balancedness_min=0.8,
+        moves_per_simhour=50.0, moves_floor=100.0, dead_letters=0) == []
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_twin_score_verdicts_match_the_shared_renderer(seed):
+    """ONE SLO definition for twin and production: the ScenarioScore
+    floors render through utils.slo, byte-identical per seed."""
+    from cruise_control_tpu.testing.simulator import run_scenario
+    r = run_scenario("broker_loss_drift", seed=seed, ticks=12)
+    score = r.score
+    expected = scenario_floor_violations(
+        unhealed=score.unhealed(),
+        time_to_heal_p95_ticks=score.time_to_heal_p95_ticks(),
+        heal_ticks_floor=score._slo_heal_ticks,
+        ticks_below_balancedness=score.ticks_below_balancedness_slo,
+        balancedness_min=score._slo_bal_min,
+        moves_per_simhour=score.moves_per_simhour(),
+        moves_floor=score._slo_moves_hr,
+        dead_letters=score.dead_letters)
+    assert score.slo_violations() == expected
+    assert json.dumps(score.slo_violations()) == json.dumps(expected)

@@ -137,3 +137,30 @@ def test_clear_covers_histograms():
     r.clear()
     assert r.histogram_snapshot("h") is None
     assert "bucket" not in r.render()
+
+
+def test_bucket_quantile_edge_cases_are_pinned():
+    """The SLO engine's latency objectives call this hot: edges answer a
+    NUMBER (0.0 / the bucket bound), never None/NaN (round 18)."""
+    from cruise_control_tpu.utils.sensors import bucket_quantile
+    # Empty window: all-zero counts -> 0.0.
+    assert bucket_quantile((0.1, 1.0), [0, 0, 0], 0.99) == 0.0
+    # No finite bounds at all -> 0.0.
+    assert bucket_quantile((), [5], 0.5) == 0.0
+    # Single-bucket layout answers its one bound.
+    assert bucket_quantile((2.5,), [3, 1], 0.5) == 2.5
+    # +Inf overflow clamps to the top finite bound.
+    assert bucket_quantile((0.1, 1.0), [0, 0, 7], 0.99) == 1.0
+    # A NaN can never escape: every answer compares equal to itself.
+    for counts in ([0, 0, 0], [1, 0, 0], [0, 0, 9]):
+        got = bucket_quantile((0.5, 5.0), counts, 0.99)
+        assert got == got
+
+
+def test_registry_quantile_none_only_for_absent_series():
+    r = SensorRegistry()
+    assert r.quantile("never_observed", 0.5) is None
+    r.observe("lat", 0.2, buckets=(0.1, 1.0))
+    assert r.quantile("lat", 0.5) is not None
+    # Same name, different labels = a different (absent) series.
+    assert r.quantile("lat", 0.5, labels={"cluster": "x"}) is None

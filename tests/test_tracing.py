@@ -425,3 +425,41 @@ def test_record_dispatch_annotates_ambient_span():
             assert sp.attributes["dispatches"] == 2
     finally:
         TRACER.configure(enabled=tracer_was)
+
+
+def test_jsonl_rotation_cascade_keeps_max_files_generations(tmp_path):
+    """tracing.jsonl.max.files: each overflow cascades .{N-1}->.N down to
+    path->.1, keeping exactly max_files rotated generations (total
+    footprint ~(max_files+1)x the cap); jsonl_rotations counts every
+    generation MOVED, so a deep cascade is more than one per overflow."""
+    path = tmp_path / "trace.jsonl"
+    tracer = Tracer()
+    tracer.configure(jsonl_path=str(path))
+    with tracer.span("sizer", operation="bench"):
+        pass
+    line_size = len(path.read_text())
+    tracer.configure(jsonl_max_bytes=int(1.5 * line_size),
+                     jsonl_max_files=2)
+    path.write_text("")  # restart the dump empty
+    # Overflow #1: path -> .1 (one move).
+    for _ in range(2):
+        with tracer.span("sizer", operation="bench"):
+            pass
+    assert (tmp_path / "trace.jsonl.1").exists()
+    assert not (tmp_path / "trace.jsonl.2").exists()
+    assert tracer.jsonl_rotations == 1
+    # Overflow #2 cascades: .1 -> .2, then path -> .1 (two moves).
+    with tracer.span("sizer", operation="bench"):
+        pass
+    assert (tmp_path / "trace.jsonl.2").exists()
+    assert tracer.jsonl_rotations == 3
+    # Overflow #3: .2 is replaced (the ring is bounded at max_files);
+    # every surviving generation holds exactly one valid-JSON line.
+    with tracer.span("sizer", operation="bench"):
+        pass
+    assert tracer.jsonl_rotations == 5
+    assert not (tmp_path / "trace.jsonl.3").exists()
+    for f in (path, tmp_path / "trace.jsonl.1", tmp_path / "trace.jsonl.2"):
+        lines = f.read_text().splitlines()
+        assert len(lines) == 1
+        json.loads(lines[0])
