@@ -203,6 +203,31 @@ SERVING_SEED = int(os.environ.get("BENCH_SERVING_SEED", "0"))
 SERVING_RATE_RPS = float(os.environ.get("BENCH_SERVING_RATE", "50"))
 SERVING_DURATION_S = float(os.environ.get("BENCH_SERVING_DURATION", "2"))
 
+# --redteam: run ONLY the adversarial-mining stage (round 22): (1) the
+# PINNED regression replays — the committed frontier's worst entries
+# replayed full-loop; a flipped SLO verdict set hard-fails the stage
+# (vs_baseline=0) because a mined worst case that stopped violating (or
+# started violating differently) is exactly the regression the frontier
+# exists to catch; (2) a budget-bounded FRESH mining sweep whose
+# frontier JSON lands in the observability artifact bundle
+# (BENCH_REDTEAM_FILE) with the margin histogram, blind-spot count, and
+# found-below-library tally in the extras (the CI RED_TEAM row). Like
+# the other riders, the stage also runs at the END of every default
+# bench pass.
+REDTEAM_MODE = "--redteam" in sys.argv or bool(
+    os.environ.get("BENCH_REDTEAM"))
+# Sweep seed 3 is the committed-frontier pin: at this (seed, shape) the
+# 4th generation's late-fault squeeze (fault_timing +16 on a cascading
+# kill pair) lands a genuine unhealed_faults violation inside the CI
+# budget — regenerate fileStore/redteam_frontier.json if these change.
+REDTEAM_SEED = int(os.environ.get("BENCH_REDTEAM_SEED", "3"))
+REDTEAM_POP = int(os.environ.get("BENCH_REDTEAM_POP", "6"))
+REDTEAM_GENERATIONS = int(os.environ.get("BENCH_REDTEAM_GENERATIONS", "4"))
+REDTEAM_SURVIVORS = int(os.environ.get("BENCH_REDTEAM_SURVIVORS", "2"))
+REDTEAM_TICKS = int(os.environ.get("BENCH_REDTEAM_TICKS", "16"))
+REDTEAM_EVAL_BUDGET = int(os.environ.get("BENCH_REDTEAM_EVALS", "40"))
+REDTEAM_REPLAYS = int(os.environ.get("BENCH_REDTEAM_REPLAYS", "2"))
+
 # Generator-sampled SCENARIO_MATRIX rows (pinned (template, seed) pairs
 # so the matrix stays deterministic): the scenario-diversity axis beyond
 # the 6-scenario canonical library. Violation-free at these pins by
@@ -2391,6 +2416,115 @@ def _run_stage(jax, num_brokers: int, num_partitions: int, drain: int,
     }
 
 
+def _run_redteam_stage(progress: dict, budget_s: float | None = None) -> dict:
+    """The --redteam stage (round 22): pinned regression replays of the
+    committed frontier + a budget-bounded fresh mining sweep.
+
+    Phase 1 replays the committed frontier's worst entries full-loop
+    (``replay_entry`` — the exact recipe the miner stamped) and compares
+    the rendered SLO verdict set against the entry's pin: a FLIP
+    hard-fails the stage (vs_baseline=0). The score-JSON digest ride
+    along per entry (digest_match) — byte drift without a verdict flip
+    is reported, not gated, because verdict stability is the contract
+    serving depends on.
+
+    Phase 2 runs ``mine()`` fresh at CI scale under the caller's wall
+    budget (the miner itself never reads the clock — bench passes
+    ``time.monotonic``), writes the mined frontier JSON to
+    BENCH_REDTEAM_FILE for the artifact bundle, and reports the margin
+    histogram, blind-spot count, and how many mined entries got UNDER
+    the canonical library's minimum margin (the committed frontier
+    carries the library map so the stage never pays for the canonical
+    replays itself)."""
+    import zlib
+
+    from cruise_control_tpu.redteam import (
+        load_frontier, mine, replay_entry, save_frontier,
+    )
+    from cruise_control_tpu.utils.slo import scenario_margin
+
+    committed_path = os.environ.get("BENCH_REDTEAM_FRONTIER",
+                                    "fileStore/redteam_frontier.json")
+    committed = load_frontier(committed_path)
+    progress["redteam_committed_frontier"] = committed is not None
+
+    # Phase 1: pinned regression replays (worst margin first — the
+    # committed frontier is already sorted that way).
+    t0 = time.time()
+    replayed, flips = [], []
+    for entry in ((committed or {}).get("frontier") or [])[:REDTEAM_REPLAYS]:
+        result = replay_entry(entry)
+        margin = round(scenario_margin(result.score.slo_margins()), 6)
+        digest = f"{zlib.crc32(result.score.to_json().encode()):08x}"
+        flip = sorted(result.score.slo_violations()) \
+            != sorted(entry.get("sloViolations", []))
+        if flip:
+            flips.append(entry.get("id"))
+        replayed.append({
+            "id": entry.get("id"),
+            "margin_pin": entry.get("margin"), "margin": margin,
+            "digest_pin": entry.get("scoreDigest"), "digest": digest,
+            "digest_match": digest == entry.get("scoreDigest"),
+            "verdict_flip": flip})
+    replay_s = round(time.time() - t0, 3)
+    progress["redteam_pinned_replays"] = len(replayed)
+    progress["redteam_replay_s"] = replay_s
+
+    # Phase 2: a fresh CI-scale sweep under the remaining wall budget.
+    library = ((committed or {}).get("library") or {}).get("margins")
+    t0 = time.time()
+    mined = mine(
+        REDTEAM_SEED, population=REDTEAM_POP,
+        generations=REDTEAM_GENERATIONS, survivors=REDTEAM_SURVIVORS,
+        frontier_size=REDTEAM_POP, ticks=REDTEAM_TICKS,
+        eval_budget=REDTEAM_EVAL_BUDGET, library=library,
+        budget_s=(None if budget_s is None
+                  else max(30.0, budget_s - replay_s)),
+        clock=time.monotonic)
+    mine_s = round(time.time() - t0, 3)
+    redteam_file = os.environ.get("BENCH_REDTEAM_FILE",
+                                  "/tmp/cc_bench_redteam_frontier.json")
+    save_frontier(mined, redteam_file)
+
+    margins = [e["margin"] for e in mined["frontier"]]
+    histogram = {
+        "violating(<0)": sum(1 for m in margins if m < 0),
+        "near(0..0.1)": sum(1 for m in margins if 0 <= m < 0.1),
+        "tight(0.1..0.5)": sum(1 for m in margins if 0.1 <= m < 0.5),
+        "comfortable(>=0.5)": sum(1 for m in margins if m >= 0.5),
+    }
+    return {
+        "metric": "redteam_mine",
+        "value": mine_s,
+        "unit": "s",
+        # Hard gate: any pinned replay whose SLO verdict set flipped.
+        "vs_baseline": 0.0 if flips else 1.0,
+        "extras": {
+            "pinned_replays": len(replayed),
+            "verdict_flips": flips,
+            "pinned_replay_detail": replayed,
+            "replay_s": replay_s,
+            "sweep_seed": REDTEAM_SEED,
+            "generations_run": mined["generationsRun"],
+            "evals": mined["evals"],
+            "replays": mined["replays"],
+            "partial": mined["partial"],
+            "partial_reason": mined["partialReason"],
+            "frontier_entries": len(mined["frontier"]),
+            "frontier_margin_min": min(margins) if margins else None,
+            "margin_histogram": histogram,
+            "blind_spot_count": mined["blindSpotCount"],
+            "found_below_library": mined["foundBelowLibrary"],
+            "library_min_margin": (min(library.values())
+                                   if library else None),
+            "redteam_file": redteam_file,
+            "committed_frontier": committed_path
+            if committed is not None else None,
+            **progress,
+        },
+    }
+
+
 def main() -> int:
     deadline = time.time() + BUDGET_S
     # Two-tier watchdog: SIGALRM interrupts Python-level code gracefully,
@@ -2610,6 +2744,29 @@ def _guarded_main(deadline: float) -> int:
             _emit({"metric": "stage_failed", "value": 0.0, "unit": "s",
                    "vs_baseline": 0.0,
                    "extras": {"stage": "serving_loadgen_mixed",
+                              "error": f"{type(e).__name__}: {e}"[:500]}})
+        return 0
+    if REDTEAM_MODE:
+        _emit({"metric": "bench_bootstrap",
+               "value": round(time.time() - t0, 3), "unit": "s",
+               "vs_baseline": 1.0,
+               "extras": {"device": device, "num_devices": n_dev,
+                          "mode": "redteam", "sweep_seed": REDTEAM_SEED,
+                          "compile_cache_dir": cache_dir,
+                          "stderr_file": _stderr_path}})
+        try:
+            record = _run_redteam_stage({}, budget_s=deadline - time.time()
+                                        - 30.0)
+            _emit(record)
+            baseline = load_baseline()
+            if baseline is not None:
+                verdict = compare_stage_to_baseline(record, baseline)
+                if verdict is not None:
+                    _emit(verdict)
+        except Exception as e:  # noqa: BLE001 — parseable record always
+            _emit({"metric": "stage_failed", "value": 0.0, "unit": "s",
+                   "vs_baseline": 0.0,
+                   "extras": {"stage": "redteam_mine",
                               "error": f"{type(e).__name__}: {e}"[:500]}})
         return 0
     noop_ns = _tracing_noop_overhead_ns()
@@ -3049,6 +3206,44 @@ def _guarded_main(deadline: float) -> int:
                "extras": {"stage": "transport_sparse_tr",
                           "partial": True, "skipped": True,
                           "reason": "budget exhausted"}})
+    # The red-team stage rides every default pass too (round 22): the CI
+    # RED_TEAM row sees the pinned frontier replays (SLO verdict flips
+    # hard-fail) plus a budget-bounded fresh mining sweep whose frontier
+    # JSON lands in the observability artifact bundle per PR.
+    remaining = deadline - time.time()
+    if remaining > 90:
+        progress = {}
+        t0 = time.time()
+        stage_budget = min(remaining - 15.0, 300.0)
+        signal.alarm(max(1, int(stage_budget)))
+        try:
+            record = _run_redteam_stage(progress, budget_s=stage_budget)
+            signal.alarm(0)
+            _emit(record)
+            if baseline is not None:
+                verdict = compare_stage_to_baseline(record, baseline)
+                if verdict is not None:
+                    sentry_verdicts.append(verdict)
+                    _emit(verdict)
+        except _Watchdog:
+            _emit({"metric": "stage_partial_redteam_mine",
+                   "value": round(time.time() - t0, 3), "unit": "s",
+                   "vs_baseline": 0.0,
+                   "extras": {"stage": "redteam_mine", "partial": True,
+                              **progress}})
+        except Exception as e:  # noqa: BLE001 — parseable record always
+            _emit({"metric": "stage_failed", "value": round(
+                time.time() - t0, 3), "unit": "s", "vs_baseline": 0.0,
+                "extras": {"stage": "redteam_mine",
+                           "error": f"{type(e).__name__}: {e}"[:500],
+                           **progress}})
+        finally:
+            signal.alarm(0)
+    else:
+        _emit({"metric": "stage_partial_redteam_mine",
+               "value": 0.0, "unit": "s", "vs_baseline": 0.0,
+               "extras": {"stage": "redteam_mine", "partial": True,
+                          "skipped": True, "reason": "budget exhausted"}})
     _emit_sentry_summary(sentry_verdicts, baseline)
     _dump_flight_recorder()
     return 0

@@ -101,6 +101,11 @@ class EndPoint(enum.Enum):
     # plus the SLO-burn detector's raised/cleared lifecycle counters.
     # ``?cluster=`` ROUTES to that cluster's facade registry.
     SLO = (31, "GET", Role.VIEWER)
+    # Red-team regression frontier (redteam/, round 22): the mined
+    # worst-case scenario set with per-entry SLO margins, verdicts and
+    # replay recipes, plus the forecaster blind-spot report. Each entry
+    # replays via ``proposals?what_if=mined:<id>``.
+    REDTEAM = (32, "GET", Role.VIEWER)
 
     @property
     def method(self) -> str:

@@ -199,6 +199,10 @@ SCHEMAS: dict[EndPoint, dict[str, Callable[[str], Any]]] = {
     # cluster (in _COMMON) ROUTES to that cluster's facade SLO registry;
     # objective trims the body to one objective's evaluation.
     EndPoint.SLO: {"objective": _str},
+    # Red-team frontier (redteam/): entries bounds the frontier list
+    # (worst margin first); blind_spots=false drops the per-entry
+    # forecaster blind-spot detail for a compact body.
+    EndPoint.REDTEAM: {"entries": _int, "blind_spots": _bool},
 }
 
 

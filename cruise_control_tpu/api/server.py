@@ -56,7 +56,7 @@ _SYNC_ENDPOINTS = {
     EndPoint.PAUSE_SAMPLING, EndPoint.RESUME_SAMPLING,
     EndPoint.STOP_PROPOSAL_EXECUTION, EndPoint.ADMIN, EndPoint.BOOTSTRAP,
     EndPoint.TRAIN, EndPoint.RIGHTSIZE, EndPoint.FLEET, EndPoint.HEALS,
-    EndPoint.FORECAST, EndPoint.JOURNEYS, EndPoint.SLO,
+    EndPoint.FORECAST, EndPoint.JOURNEYS, EndPoint.SLO, EndPoint.REDTEAM,
 }
 
 # Endpoints that consume solver time. In fleet mode these (a) are refused
@@ -1050,6 +1050,48 @@ class CruiseControlApi:
             if detector is not None:
                 body["burnDetector"] = detector.state()
             return responses.envelope(body)
+        if endpoint is EndPoint.REDTEAM:
+            # GET /redteam: the mined worst-case regression frontier
+            # (redteam/, round 22) — per-entry SLO margins, verdict
+            # strings, replay recipes, the forecaster blind-spot
+            # report, and the canonical library's margin bar. Serves
+            # the COMMITTED frontier file; mining never runs on the
+            # request path.
+            if not cc.config.get_boolean("redteam.enabled"):
+                raise ParameterParseError(
+                    "redteam.enabled=false: the mined frontier surface "
+                    "is disabled on this cluster")
+            from ..redteam.frontier import load_frontier
+            path = cc.config.get_string("redteam.frontier.path")
+            frontier = load_frontier(path)
+            if frontier is None:
+                return responses.envelope({
+                    "redteamEnabled": True, "frontierPath": path,
+                    "frontierFound": False, "numEntries": 0,
+                    "frontier": [],
+                    "hint": "no frontier file; run the miner — "
+                            "python bench.py --redteam"})
+            entries = list(frontier.get("frontier") or [])
+            limit = p.get("entries")
+            if limit is not None:
+                entries = entries[:max(0, int(limit))]
+            if not p.get("blind_spots", True):
+                entries = [{k: v for k, v in e.items()
+                            if k != "blindSpot"} for e in entries]
+            return responses.envelope({
+                "redteamEnabled": True, "frontierPath": path,
+                "frontierFound": True,
+                "sweepSeed": frontier.get("sweepSeed"),
+                "generationsRun": frontier.get("generationsRun"),
+                "evals": frontier.get("evals"),
+                "replays": frontier.get("replays"),
+                "partial": frontier.get("partial"),
+                "partialReason": frontier.get("partialReason"),
+                "library": frontier.get("library"),
+                "foundBelowLibrary": frontier.get("foundBelowLibrary"),
+                "blindSpotCount": frontier.get("blindSpotCount"),
+                "numEntries": len(entries),
+                "frontier": entries})
         if endpoint is EndPoint.STATE:
             key = None
             if self._response_cache.cache_state:
@@ -1227,13 +1269,44 @@ class CruiseControlApi:
         run. ``what_if=random:<template>:<seed>`` replays a
         generator-sampled scenario (futures/generator.py) instead —
         every sampled row of a COMPARE_FUTURES answer is replayable this
-        way. The simulator wires its OWN backend/executor, so this
+        way — and ``what_if=mined:<frontier-id>`` replays a mined
+        red-team frontier entry (redteam/, round 22) from its recorded
+        recipe. The simulator wires its OWN backend/executor, so this
         cluster's executor state is never touched; tick counts are capped
         by ``scenario.what.if.max.ticks`` since a replay is real solver
         work."""
         from ..testing.simulator import CANONICAL_SCENARIOS, run_scenario
         name = p["what_if"]
-        if name.startswith("random:"):
+        default_seed = 0
+        if name.startswith("mined:"):
+            # Mined frontier replay (redteam/, round 22): the entry's
+            # recipe rebuilds the exact perturbed spec; the default sim
+            # seed is the entry's recorded replaySeed so a bare
+            # what_if=mined:<id> reproduces the mined score byte-for-
+            # byte (what_if_seed still overrides for exploration).
+            from ..redteam.frontier import entry_spec, load_frontier
+            if not cc.config.get_boolean("redteam.enabled"):
+                raise ParameterParseError(
+                    "redteam.enabled=false: mined frontier replays are "
+                    "disabled on this cluster")
+            path = cc.config.get_string("redteam.frontier.path")
+            frontier = load_frontier(path)
+            entries = (frontier or {}).get("frontier") or []
+            if not entries:
+                raise ParameterParseError(
+                    f"mined frontier is empty (no frontier file at "
+                    f"{path!r}); run the miner — python bench.py "
+                    "--redteam — to populate it")
+            by_id = {e["id"]: e for e in entries}
+            wanted = name[len("mined:"):]
+            entry = by_id.get(wanted)
+            if entry is None:
+                raise ParameterParseError(
+                    f"unknown mined frontier id {wanted!r}; known ids: "
+                    f"{', '.join(sorted(by_id))}")
+            spec = entry_spec(entry)
+            default_seed = int(entry.get("replaySeed", 0))
+        elif name.startswith("random:"):
             from ..futures.generator import FUTURE_TEMPLATES, sample_scenario
             parts = name.split(":")
             template = parts[1] if len(parts) >= 2 else ""
@@ -1272,7 +1345,7 @@ class CruiseControlApi:
         ticks = p.get("what_if_ticks")
         ticks = min(spec.ticks, cap) if ticks is None \
             else max(1, min(int(ticks), cap))
-        seed = p.get("what_if_seed", 0)
+        seed = p.get("what_if_seed", default_seed)
         result = run_scenario(spec, seed=seed, ticks=ticks)
         return responses.envelope({
             "operation": "what_if", "dryrun": True, "executed": False,

@@ -939,6 +939,42 @@ def _definition() -> ConfigDef:
              "fleet.megabatch.width). Fixed per bucket shape: partial "
              "chunks pad with inert slots so one compiled program per "
              "shape serves any occupancy.")
+    # --- Red-team scenario mining (redteam/, round 22) ---
+    d.define("redteam.enabled", T.BOOLEAN, True, None, I.LOW,
+             "Serve the mined regression frontier (GET /redteam, "
+             "what_if=mined:<id> replays). False = both surfaces answer "
+             "400 and nothing else changes: mining only ever runs when "
+             "explicitly invoked (bench.py --redteam), never on the "
+             "serving path.")
+    d.define("redteam.population", T.INT, 12, Range.at_least(2), I.LOW,
+             "Candidates per mining generation (half mutations of the "
+             "current frontier, half fresh crc32-derived samples; "
+             "generation 0 is all fresh).")
+    d.define("redteam.generations", T.INT, 4, Range.at_least(1), I.LOW,
+             "Mining generations per sweep: sample -> megabatch screen "
+             "-> full-loop score survivors -> keep the K worst -> "
+             "mutate.")
+    d.define("redteam.survivors", T.INT, 4, Range.at_least(1), I.LOW,
+             "Worst-screened candidates per generation that earn a "
+             "full-loop scored replay (detection + self-healing on) — "
+             "the expensive half of the eval budget.")
+    d.define("redteam.frontier.size", T.INT, 8, Range.at_least(1), I.LOW,
+             "Worst-case survivors the frontier retains (lowest SLO "
+             "margin first, ties broken on entry id byte-stably).")
+    d.define("redteam.ticks", T.INT, 24, Range.at_least(4), I.LOW,
+             "Full-loop horizon of a mined candidate (its sampled story "
+             "compresses into this many ticks, faults included). Floor "
+             "4: one metrics window fills per tick.")
+    d.define("redteam.eval.budget", T.INT, 200, Range.at_least(1), I.LOW,
+             "Total candidate evaluations (megabatch screens + full-"
+             "loop replays) one sweep may spend; exhaustion ends the "
+             "sweep partial=True with the reason recorded — never a "
+             "silent cap.")
+    d.define("redteam.frontier.path", T.STRING,
+             "fileStore/redteam_frontier.json", None, I.LOW,
+             "The committed regression frontier file GET /redteam and "
+             "what_if=mined:<id> serve (sorted-keys JSON; every entry "
+             "replayable byte-identically).")
     d.define("goal.violation.distribution.threshold.multiplier", T.DOUBLE, 1.0,
              Range.at_least(1), I.LOW,
              "Detector-triggered balance-threshold relaxation.")
@@ -1329,7 +1365,7 @@ def _definition() -> ConfigDef:
                "pause.sampling", "resume.sampling", "demote.broker", "admin",
                "review", "topic.configuration", "rightsize", "remove.disks",
                "fleet", "trace", "solver", "profile", "compare.futures",
-               "heals", "forecast", "journeys", "slo"):
+               "heals", "forecast", "journeys", "slo", "redteam"):
         d.define(f"{ep}.parameters.class", T.CLASS, None, None, I.LOW,
                  f"Parameter-parsing plugin for the {ep} endpoint "
                  "(callable(query) -> params dict).")

@@ -226,11 +226,6 @@ def prepare_future(fspec: FutureSpec, optimizer=None,
     ``live`` (the ROADMAP 5b seam) the twins take the LIVE cluster's
     geometry and the ``forecast_horizon`` template solves the live
     model under its own projected loads."""
-    from ..analyzer.constraint import OptimizationOptions
-    from ..analyzer.optimizer import goals_by_priority
-    from ..common.broker_state import BrokerState
-    from ..model.tensors import set_broker_state
-    from ..testing.simulator import ClusterSimulator
     from .generator import FUTURE_TEMPLATES, present_future, sample_future
 
     tmpl = FUTURE_TEMPLATES.get(fspec.template)
@@ -259,7 +254,28 @@ def prepare_future(fspec: FutureSpec, optimizer=None,
         sampled = dataclasses.replace(sampled, spec=dataclasses.replace(
             base, name=PRESENT,
             description="The cluster as it is (live geometry)."))
-    ticks = max(_MIN_TICKS, int(fspec.ticks))
+    return prepare_sampled(sampled, fspec.ticks, optimizer=optimizer,
+                           config_overrides=config_overrides, fspec=fspec)
+
+
+def prepare_sampled(sampled, ticks: int, *, optimizer=None,
+                    config_overrides: Mapping | None = None,
+                    fspec: "FutureSpec | None" = None) -> PreparedFuture:
+    """The decision-point seam under ``prepare_future``, taking an
+    EXPLICIT ``SampledFuture`` instead of a (template, seed) lookup —
+    the round-22 red-team miner prepares PERTURBED candidates
+    (``generator.perturbed_future``) through the exact same advance +
+    mark-dead + exclusion path the template futures take, so mined and
+    template candidates stack into one megabatch."""
+    from ..analyzer.constraint import OptimizationOptions
+    from ..analyzer.optimizer import goals_by_priority
+    from ..common.broker_state import BrokerState
+    from ..model.tensors import set_broker_state
+    from ..testing.simulator import ClusterSimulator
+
+    if fspec is None:
+        fspec = FutureSpec(sampled.template, sampled.seed, int(ticks))
+    ticks = max(_MIN_TICKS, int(ticks))
     adv_events = sampled.advance_events(ticks)
     spec = dataclasses.replace(sampled.spec, ticks=ticks,
                                events=adv_events, generators=())

@@ -395,3 +395,121 @@ def present_future() -> SampledFuture:
     """The baseline slot: the cluster exactly as it is. Ranked answers
     report score DELTAS against this future's solve."""
     return SampledFuture("present", 0, BASE_SPEC)
+
+
+# ---------------------------------------------------------------------------
+# Perturbations (round 22): the red-team miner's mutation alphabet
+# ---------------------------------------------------------------------------
+
+#: Mutation kinds the red-team miner composes. Each is a PURE spec
+#: transform — ``(spec, perturbation) -> spec`` with no sampling inside —
+#: so a frontier entry (template, seed, ticks, perturbations) rebuilds a
+#: byte-identical ScenarioSpec forever.
+PERTURBATION_KINDS = ("drift_amplitude", "drift_phase", "event_timing",
+                      "fault_reorder", "fault_timing")
+
+#: Fault-event kinds the ``fault_reorder`` perturbation permutes (the
+#: heal-triggering set — timing order between correlated faults is
+#: exactly what a cascade's severity hangs on).
+_FAULT_KINDS = ("kill_broker", "kill_logdir")
+
+
+@dataclasses.dataclass(frozen=True)
+class Perturbation:
+    """One serializable mutation of a sampled spec.
+
+    - ``drift_amplitude``: multiply the diurnal amplitude by ``value``
+      (a zero-amplitude spec is seeded at 0.2 first so the perturbation
+      has something to scale), clamped below 1.0.
+    - ``drift_phase``: shift the drift wave by ``value`` ticks
+      (``DriftSpec.phase_ticks``) — the scenario starts elsewhere on
+      the wave, e.g. at the crest the moment a broker dies.
+    - ``event_timing``: shift every scripted event by ``round(value)``
+      ticks, clamped into the horizon (relative order preserved away
+      from the clamp edges).
+    - ``fault_reorder``: rotate the tick assignments among the
+      heal-triggering events by ``round(value)`` positions — the
+      cascade arrives in a different order at the same instants.
+    - ``fault_timing``: shift ONLY the heal-triggering events by
+      ``round(value)`` ticks (load/maintenance script untouched),
+      clamped into the horizon — the late-fault squeeze: how close to
+      the end of the SLO window can a kill land and still heal inside
+      it? Positive values past the healer's closing speed are exactly
+      the unhealed-fault violations the miner hunts.
+    """
+
+    kind: str
+    value: float = 0.0
+
+    def as_dict(self) -> dict:
+        return {"kind": self.kind, "value": self.value}
+
+    @classmethod
+    def from_dict(cls, d) -> "Perturbation":
+        return cls(str(d["kind"]), float(d.get("value", 0.0)))
+
+
+def _sorted_events(events) -> tuple[ScenarioEvent, ...]:
+    return tuple(sorted(events, key=lambda e: (e.tick, e.kind,
+                                               sorted(e.params.items()))))
+
+
+def apply_perturbations(spec: ScenarioSpec,
+                        perturbations) -> ScenarioSpec:
+    """Apply a perturbation sequence to a spec — pure, order-sensitive,
+    and total (an unknown kind raises instead of silently no-opping, so
+    a frontier file from a future alphabet cannot half-replay)."""
+    for p in perturbations:
+        if p.kind == "drift_amplitude":
+            base_amp = spec.drift.amplitude or 0.2
+            amp = round(min(0.95, max(0.0, base_amp * float(p.value))), 4)
+            spec = dataclasses.replace(
+                spec, drift=dataclasses.replace(spec.drift, amplitude=amp))
+        elif p.kind == "drift_phase":
+            phase = round(spec.drift.phase_ticks + float(p.value), 4)
+            spec = dataclasses.replace(
+                spec, drift=dataclasses.replace(spec.drift,
+                                                phase_ticks=phase))
+        elif p.kind == "event_timing":
+            delta = int(round(float(p.value)))
+            moved = [ScenarioEvent(min(spec.ticks - 1, max(0, e.tick + delta)),
+                                   e.kind, e.params)
+                     for e in spec.events]
+            spec = dataclasses.replace(spec, events=_sorted_events(moved))
+        elif p.kind == "fault_timing":
+            delta = int(round(float(p.value)))
+            moved = [ScenarioEvent(min(spec.ticks - 1, max(0, e.tick + delta)),
+                                   e.kind, e.params)
+                     if e.kind in _FAULT_KINDS else e
+                     for e in spec.events]
+            spec = dataclasses.replace(spec, events=_sorted_events(moved))
+        elif p.kind == "fault_reorder":
+            faults = [e for e in spec.events if e.kind in _FAULT_KINDS]
+            if len(faults) > 1:
+                rot = int(round(float(p.value))) % len(faults)
+                ticks = [e.tick for e in faults]
+                rotated = {id(e): ticks[(i + rot) % len(faults)]
+                           for i, e in enumerate(faults)}
+                moved = [ScenarioEvent(rotated[id(e)], e.kind, e.params)
+                         if id(e) in rotated else e
+                         for e in spec.events]
+                spec = dataclasses.replace(spec,
+                                           events=_sorted_events(moved))
+        else:
+            raise ValueError(
+                f"unknown perturbation kind {p.kind!r}; expected one of "
+                f"{', '.join(PERTURBATION_KINDS)}")
+    return spec
+
+
+def perturbed_future(template: str, seed: int, ticks: int,
+                     perturbations,
+                     base: ScenarioSpec | None = None) -> SampledFuture:
+    """The miner's candidate constructor: sample ``(template, seed)``,
+    compress the full story into ``ticks`` (``replay_spec`` — faults
+    included), then apply the perturbation sequence. Pure in all
+    arguments, so a frontier entry IS this call's argument list."""
+    sampled = sample_future(template, seed, base=base)
+    spec = sampled.replay_spec(int(ticks))
+    spec = apply_perturbations(spec, tuple(perturbations))
+    return dataclasses.replace(sampled, spec=spec)

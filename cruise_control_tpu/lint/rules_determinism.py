@@ -84,6 +84,15 @@ class DeterminismRule(Rule):
         "cruise_control_tpu/serving/journey.py",
         "cruise_control_tpu/utils/slo.py",
         "cruise_control_tpu/detector/slo_burn.py",
+        # Red-team miner (round 22): the whole search — sampling,
+        # mutation, tie-breaks, frontier order — is crc32-derived from
+        # the sweep seed (one seed ⇒ byte-identical frontier JSON), and
+        # the wall budget rides the caller-injected ``clock`` callable
+        # only. An inline clock or `random` call anywhere here would
+        # silently fork the committed regression frontier.
+        "cruise_control_tpu/redteam/miner.py",
+        "cruise_control_tpu/redteam/frontier.py",
+        "cruise_control_tpu/redteam/blindspot.py",
     )
 
     CLOCK_CALLS = ("time.time", "time.time_ns", "time.monotonic",

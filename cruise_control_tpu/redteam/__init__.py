@@ -1,0 +1,20 @@
+"""Adversarial scenario mining (round 22): a red-team search engine
+over the futures evaluator, with a persistent worst-case regression
+frontier. See ``miner.py`` for the search loop, ``frontier.py`` for the
+replayable persistence format, ``blindspot.py`` for the forecaster
+blind-spot tagging."""
+
+from .blindspot import entry_blind_spot, forecast_miss, global_factor_series
+from .frontier import (
+    DEFAULT_FRONTIER_PATH, entry_candidate, entry_spec, frontier_json,
+    load_frontier, replay_entry, save_frontier,
+)
+from .miner import Candidate, MinedEntry, library_margins, mine, params_from_config
+
+__all__ = [
+    "Candidate", "MinedEntry", "mine", "library_margins",
+    "params_from_config",
+    "DEFAULT_FRONTIER_PATH", "frontier_json", "load_frontier",
+    "save_frontier", "entry_candidate", "entry_spec", "replay_entry",
+    "global_factor_series", "forecast_miss", "entry_blind_spot",
+]

@@ -1,0 +1,113 @@
+"""Forecaster blind-spot tagging: which mined scenarios could the
+round-19 predictive detector NOT have seen coming?
+
+The forecaster's documented negatives — a step change inside the fit
+window, a uniform swell the rolling model-mean lags — have so far been
+asserted in prose. This module measures them: for each mined near-
+violation, rebuild the scenario's GLOBAL load-factor trajectory
+analytically (drift wave + ``set_load`` steps — the same formula
+``DriftingSampler`` scales every partition by), fit the first half with
+the forecaster's own ``project_series``, and check whether the tail the
+violation lives in stays inside the fit's residual band. A mined
+violation the fit projects correctly was FORESEEABLE (a ramp the trend
+basis extrapolates); one outside the band is a measured blind spot —
+the step-change negative, now a number in the frontier artifact
+instead of a sentence in a docstring.
+
+Determinism (CCSA004): pure functions of the spec — the series is
+closed-form, ``project_series`` is a jitted pure fit, and every float
+in the report is rounded before it reaches JSON.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping, Sequence
+
+from ..testing.simulator import ScenarioSpec
+
+#: Entries with overall margin below this are near-violations worth a
+#: blind-spot verdict (mirrors miner.NEAR_MARGIN; kept separate so this
+#: module has no import cycle with the miner).
+_NEAR_MARGIN = 0.1
+
+#: The fit must miss by more than max(this many sigmas, _MISS_FLOOR ×
+#: the mean history level) to count as a blind spot — one honest
+#: threshold, not a tunable to chase a desired count.
+_MISS_SIGMAS = 3.0
+_MISS_FLOOR = 0.05
+
+
+def global_factor_series(spec: ScenarioSpec,
+                         ticks: int | None = None) -> list[float]:
+    """The spec's global load-factor trajectory, closed-form: the
+    ``set_load`` step schedule × the diurnal drift wave — exactly the
+    global scaling ``DriftingSampler._factor`` applies (per-topic
+    hotspots excluded: this is the GLOBAL view the forecaster's
+    capacity question cares about)."""
+    n = int(ticks if ticks is not None else spec.ticks)
+    steps = sorted(((e.tick, float(e.params["factor"]))
+                    for e in spec.events if e.kind == "set_load"),
+                   key=lambda t: t[0])
+    amp = spec.drift.amplitude
+    period = max(1.0, float(spec.drift.period_ticks))
+    phase = spec.drift.phase_ticks
+    out = []
+    factor = 1.0
+    i = 0
+    for t in range(n):
+        while i < len(steps) and steps[i][0] <= t:
+            factor = steps[i][1]
+            i += 1
+        drift = 1.0
+        if amp:
+            drift = 1.0 + amp * math.sin(
+                2.0 * math.pi * (t + phase) / period)
+        out.append(round(max(factor * drift, 0.01), 6))
+    return out
+
+
+def forecast_miss(series: Sequence[float], split: int,
+                  period: int = 0) -> dict:
+    """Fit ``series[:split]`` with the forecaster's ``project_series``
+    and measure how far the actual tail escapes the projection.
+    ``miss=True`` = the trajectory was NOT foreseeable from the fit
+    window (deviation beyond the residual band) — the blind-spot
+    verdict."""
+    import jax.numpy as jnp
+
+    from ..forecast.forecaster import project_series
+
+    split = max(2, min(int(split), len(series) - 1))
+    horizon = len(series) - split
+    hist = jnp.asarray(series[:split], dtype=jnp.float32)[:, None]
+    projected, sigma = project_series(hist, horizon, period)
+    proj = [float(v) for v in projected[:, 0]]
+    actual = list(series[split:])
+    deviation = max(abs(a - p) for a, p in zip(actual, proj))
+    mean_level = sum(abs(v) for v in series[:split]) / split
+    band = max(_MISS_SIGMAS * float(sigma[0]), _MISS_FLOOR * mean_level)
+    return {
+        "miss": bool(deviation > band),
+        "maxDeviation": round(deviation, 6),
+        "band": round(band, 6),
+        "split": split,
+        "horizon": horizon,
+    }
+
+
+def entry_blind_spot(spec: ScenarioSpec, margin: float) -> dict:
+    """One frontier entry's blind-spot verdict: ``tagged`` iff the
+    entry is a near-violation (margin < 0.1) AND its global trajectory
+    escapes the forecaster's fit band — a worst case the predictive
+    detector could not have predicted. Foreseeable near-violations and
+    comfortable survivors report the same measurements untagged, so
+    the report carries its negatives too."""
+    series = global_factor_series(spec)
+    split = max(4, len(series) // 2)
+    fit = forecast_miss(series, split)
+    return {
+        "tagged": bool(margin < _NEAR_MARGIN and fit["miss"]),
+        "nearViolation": bool(margin < _NEAR_MARGIN),
+        **fit,
+    }

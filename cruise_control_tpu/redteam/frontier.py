@@ -1,0 +1,77 @@
+"""Frontier persistence: the mined worst-case set as REPLAYABLE specs.
+
+The frontier file is the red team's lasting output — a regression
+library the system earned instead of imagined. Every entry is a
+``Candidate`` recipe (template, seed, ticks, perturbation list) plus
+the score pins its replay must reproduce: ``replay_entry`` rebuilds the
+exact ScenarioSpec through ``generator.perturbed_future`` and runs it
+full-loop through ``run_scenario``; a byte-different ScenarioScore or a
+flipped SLO verdict is a regression (bench's RED_TEAM stage hard-fails
+on it).
+
+Format: sorted-keys JSON (2-space indent, trailing newline) so one
+mining sweep at one sweep seed writes a byte-identical file — the same
+determinism contract every other artifact in this repo carries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Mapping
+
+from .miner import Candidate
+
+#: Where the committed regression frontier lives, relative to the repo
+#: root (the ``redteam.frontier.path`` config default).
+DEFAULT_FRONTIER_PATH = "fileStore/redteam_frontier.json"
+
+
+def frontier_json(result: Mapping) -> str:
+    """The canonical byte encoding of a mining result (or loaded
+    frontier): sorted keys, 2-space indent, one trailing newline."""
+    return json.dumps(result, sort_keys=True, indent=2) + "\n"
+
+
+def save_frontier(result: Mapping, path: str) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(frontier_json(result))
+
+
+def load_frontier(path: str) -> dict | None:
+    """The parsed frontier file, or None when it does not exist yet
+    (the miner has never run — callers surface that hint, never
+    invent an empty frontier)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except OSError:
+        return None
+
+
+def entry_candidate(entry: Mapping) -> Candidate:
+    """The replay recipe of one frontier entry."""
+    return Candidate.from_dict(entry)
+
+
+def entry_spec(entry: Mapping):
+    """The entry's full-loop ScenarioSpec, rebuilt from the recipe —
+    pure, so the same entry dict yields the same spec bytes forever."""
+    return entry_candidate(entry).future().spec
+
+
+def replay_entry(entry: Mapping, seed: int | None = None,
+                 ticks: int | None = None,
+                 config_overrides: Mapping | None = None):
+    """Full-loop regression replay of one frontier entry. With default
+    arguments this reproduces the mined run exactly (``replaySeed`` is
+    the sweep's sim seed): the returned result's score JSON digest must
+    equal the entry's ``scoreDigest`` pin."""
+    from ..testing.simulator import run_scenario
+    if seed is None:
+        seed = int(entry.get("replaySeed", entry.get("seed", 0)))
+    return run_scenario(entry_spec(entry), seed=seed, ticks=ticks,
+                        config_overrides=config_overrides)

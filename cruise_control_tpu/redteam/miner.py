@@ -1,0 +1,404 @@
+"""The red-team search loop: deterministic adversarial mining over the
+(template, seed, perturbation) space (round 22).
+
+The scenario library is hand-written — the system is only ever tested
+against the failures somebody already imagined. This module points a
+fuzzer-style mutate–score–keep loop at ``ScenarioScore``'s SLO floors
+and makes the twin hunt for its own worst cases:
+
+1. **Sample** a generation of candidate futures. Every choice is crc32-
+   derived from the sweep seed (``_pick``/``zlib.crc32`` — the CCSA004
+   discipline), so one sweep seed reproduces the whole search byte-for-
+   byte.
+2. **Screen** every candidate cheaply through the round-15 futures
+   evaluator: advance each candidate's twin to its decision point
+   (detection off) and solve all same-bucket decision models through
+   ONE ``optimizations_megabatch`` program. The screen's
+   ``balancedness_after`` ranks how stressed the topology is at the
+   decision point. Perturbations that only re-time faults tie here
+   (the screen never replays faults) — ties prefer the candidate with
+   more heal-triggering events, then break byte-stably on the entry
+   id, and the full-loop replay re-ranks the survivors honestly.
+3. **Score** the worst survivors full-loop: ``run_scenario`` with
+   detection + self-healing ON, scored by ``ScenarioScore`` whose
+   margins and verdict strings render through ``utils/slo.py`` — mined
+   verdicts are byte-identical to serving's.
+4. **Keep** the K lowest-margin survivors as the frontier; the next
+   generation mutates them (amplitude/phase/timing perturbations of
+   the drift and event script, fault reordering, the late-fault
+   squeeze) alongside fresh samples.
+
+Budget discipline: the caller passes a ``clock`` callable and
+``budget_s`` (or an eval budget); the miner NEVER reads the wall clock
+itself (this module sits under CCSA004) and never silently truncates —
+an exhausted budget ends the sweep with ``partial=True`` and the
+reason recorded, the ``stage_partial`` rule bench enforces everywhere
+else.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import zlib
+from typing import Callable, Mapping, Sequence
+
+from ..futures.generator import (
+    DEFAULT_TEMPLATES, PERTURBATION_KINDS, Perturbation, _pick,
+    perturbed_future,
+)
+from ..utils.sensors import SENSORS
+from ..utils.slo import scenario_margin
+
+#: Entries with overall margin below this are "near-violations": close
+#: enough to a floor that the forecaster blind-spot report asks whether
+#: the predictive detector could have seen them coming. 0.1 = within
+#: 10 points of the balancedness floor / 10% of the heal floor.
+NEAR_MARGIN = 0.1
+
+#: Mutation value alphabets, one per perturbation kind — small, named,
+#: and crc32-indexed so a mutation is pure in (sweep seed, generation,
+#: parent id, slot).
+_MUTATION_VALUES: dict[str, tuple[float, ...]] = {
+    "drift_amplitude": (0.5, 1.5, 2.0, 3.0),
+    "drift_phase": (-20.0, -10.0, 10.0, 20.0),
+    "event_timing": (-6.0, -3.0, 3.0, 6.0),
+    "fault_reorder": (1.0, 2.0, 3.0),
+    # The late-fault squeeze reaches deep into the horizon on purpose:
+    # the healer closes small shifts easily, so the interesting values
+    # are the ones that land a kill inside the window the heal can no
+    # longer finish in.
+    "fault_timing": (-8.0, 8.0, 16.0, 20.0),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One point in the search space — and, serialized, one frontier
+    entry's REPLAY RECIPE: ``perturbed_future(template, seed, ticks,
+    perturbations)`` rebuilds the exact ScenarioSpec forever."""
+
+    template: str
+    seed: int
+    ticks: int
+    perturbations: tuple[Perturbation, ...] = ()
+
+    def key_json(self) -> str:
+        return json.dumps({
+            "template": self.template, "seed": self.seed,
+            "ticks": self.ticks,
+            "perturbations": [p.as_dict() for p in self.perturbations],
+        }, sort_keys=True)
+
+    @property
+    def entry_id(self) -> str:
+        return f"m{zlib.crc32(self.key_json().encode()):08x}"
+
+    def future(self):
+        return perturbed_future(self.template, self.seed, self.ticks,
+                                self.perturbations)
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Candidate":
+        return cls(str(d["template"]), int(d["seed"]), int(d["ticks"]),
+                   tuple(Perturbation.from_dict(p)
+                         for p in d.get("perturbations", ())))
+
+
+@dataclasses.dataclass(frozen=True)
+class MinedEntry:
+    """One scored frontier member: the candidate recipe plus the full-
+    loop score pins (margin, verdicts, digests) its regression replay
+    must reproduce byte-identically."""
+
+    candidate: Candidate
+    generation: int
+    margin: float
+    margins: Mapping[str, float]
+    slo_violations: tuple[str, ...]
+    score_digest: str
+    assignment_digest: str
+    balancedness_min: float | None
+    blind_spot: Mapping | None = None
+
+    @property
+    def entry_id(self) -> str:
+        return self.candidate.entry_id
+
+    def as_dict(self) -> dict:
+        return {
+            "id": self.entry_id,
+            "template": self.candidate.template,
+            "seed": self.candidate.seed,
+            "ticks": self.candidate.ticks,
+            "perturbations": [p.as_dict()
+                              for p in self.candidate.perturbations],
+            "replaySeed": self.candidate.seed,
+            "generation": self.generation,
+            "margin": self.margin,
+            "margins": dict(self.margins),
+            "sloViolations": list(self.slo_violations),
+            "scoreDigest": self.score_digest,
+            "assignmentDigest": self.assignment_digest,
+            "balancednessMin": self.balancedness_min,
+            "blindSpot": dict(self.blind_spot)
+            if self.blind_spot is not None else None,
+        }
+
+
+def params_from_config(config) -> dict:
+    """The ``redteam.*`` knobs as ``mine()`` keyword arguments — the one
+    translation both bench's stage and the tests use, so the config
+    surface is the real parameterization and not decoration."""
+    return {
+        "population": config.get_int("redteam.population"),
+        "generations": config.get_int("redteam.generations"),
+        "survivors": config.get_int("redteam.survivors"),
+        "frontier_size": config.get_int("redteam.frontier.size"),
+        "ticks": config.get_int("redteam.ticks"),
+        "eval_budget": config.get_int("redteam.eval.budget"),
+    }
+
+
+def _fresh(sweep_seed: int, gen: int, slot: int, ticks: int,
+           templates: Sequence[str]) -> Candidate:
+    tag = f"g{gen}:fresh:{slot}"
+    template = templates[_pick(sweep_seed, f"{tag}:tmpl", len(templates))]
+    seed = zlib.crc32(f"{sweep_seed}:{tag}:seed".encode()) % 100_000
+    return Candidate(template, seed, ticks)
+
+
+def _mutate(parent: Candidate, sweep_seed: int, gen: int,
+            slot: int) -> Candidate:
+    tag = f"g{gen}:mut:{parent.entry_id}:{slot}"
+    kind = PERTURBATION_KINDS[
+        _pick(sweep_seed, f"{tag}:kind", len(PERTURBATION_KINDS))]
+    values = _MUTATION_VALUES[kind]
+    value = values[_pick(sweep_seed, f"{tag}:value", len(values))]
+    return dataclasses.replace(
+        parent,
+        perturbations=parent.perturbations + (Perturbation(kind, value),))
+
+
+def _screen(candidates: Sequence[Candidate], optimizer, width: int,
+            config_overrides: Mapping | None) -> list[tuple]:
+    """Cheap generation screen: one megabatched decision solve per
+    candidate, worst topology first. Returns ``(ranked, optimizer)``:
+    ``ranked`` is ``(screen_score, entry_id, candidate)`` sorted
+    ascending — a candidate whose solve ERRORS screens worst of all
+    (-1.0): a future the optimizer cannot even answer is exactly what a
+    red team wants a closer look at — and ``optimizer`` is the (lazily
+    created) GoalOptimizer the sweep reuses so later generations hit
+    the same compiled programs.
+
+    The screen never replays faults, so every fault story ties on
+    ``balancedness_after``. Among ties the candidate carrying MORE
+    heal-triggering events ranks first (then the entry id, byte-
+    stably): the fuzzer prior that a kill-bearing future deserves the
+    full-loop replay over a calm one with the same decision topology —
+    without it, fault futures lose the tie-break lottery and the whole
+    unhealed-fault family goes unscored."""
+    from ..analyzer.optimizer import GoalOptimizer
+    from ..futures.evaluator import (
+        FutureSpec, evaluate_prepared, prepare_sampled,
+    )
+    from ..testing.simulator import HEAL_TRIGGERING
+    prepared = []
+    faults = {}
+    for c in candidates:
+        f = c.future()
+        faults[c.entry_id] = sum(1 for e in f.spec.events
+                                 if e.kind in HEAL_TRIGGERING)
+        prepared.append(prepare_sampled(
+            f, c.ticks, optimizer=optimizer,
+            config_overrides=config_overrides,
+            fspec=FutureSpec(c.template, c.seed, c.ticks)))
+    if optimizer is None:
+        optimizer = GoalOptimizer(prepared[0].config)
+    results = evaluate_prepared(prepared, optimizer, width=width,
+                                batched=True)
+    ranked = []
+    for c, r in zip(candidates, results):
+        score = -1.0 if r.error else float(r.balancedness_after or 0.0)
+        ranked.append((score, c.entry_id, c))
+    ranked.sort(key=lambda t: (t[0], -faults[t[1]], t[1]))
+    return ranked, optimizer
+
+
+def _score_full_loop(cand: Candidate, generation: int,
+                     config_overrides: Mapping | None) -> MinedEntry:
+    """The survivor's honest evaluation: full loop (detection + self-
+    healing ON), scored through the shared SLO renderer."""
+    from ..testing.simulator import run_scenario
+    result = run_scenario(cand.future().spec, seed=cand.seed,
+                          config_overrides=config_overrides)
+    margins = result.score.slo_margins()
+    score_digest = f"{zlib.crc32(result.score.to_json().encode()):08x}"
+    bal = result.score.balancedness
+    return MinedEntry(
+        candidate=cand, generation=generation,
+        margin=round(scenario_margin(margins), 6),
+        margins=margins,
+        slo_violations=tuple(result.score.slo_violations()),
+        score_digest=score_digest,
+        assignment_digest=result.assignment_digest,
+        balancedness_min=min(bal) if bal else None)
+
+
+def mine(sweep_seed: int = 0, *,
+         templates: Sequence[str] | None = None,
+         population: int = 12, generations: int = 4, survivors: int = 4,
+         frontier_size: int = 8, ticks: int = 24, eval_budget: int = 200,
+         width: int = 8, optimizer=None,
+         config_overrides: Mapping | None = None,
+         library: Mapping[str, float] | None = None,
+         budget_s: float | None = None,
+         clock: Callable[[], float] | None = None,
+         tag_blind_spots: bool = True) -> dict:
+    """One mining sweep → the frontier dict (``frontier.frontier_json``
+    serializes it byte-identically at one sweep seed).
+
+    ``clock``/``budget_s`` are the wall budget seam: the CALLER owns the
+    clock (bench passes ``time.monotonic``; deterministic tests pass
+    nothing) — this module never reads wall time. ``eval_budget``
+    bounds total candidate evaluations (screen solves + full-loop
+    replays). Either budget expiring ends the sweep with
+    ``partial=True`` + the reason — never a silent cap. ``library``
+    is the canonical library's margin map (``library_margins``),
+    carried into the result so "did the miner beat every hand-written
+    scenario?" is answered inside the artifact."""
+    templates = tuple(templates or DEFAULT_TEMPLATES)
+    start = clock() if clock is not None else None
+
+    def wall_exceeded() -> bool:
+        return (clock is not None and budget_s is not None
+                and clock() - start > budget_s)
+
+    frontier: dict[str, MinedEntry] = {}
+    seen: set[str] = set()
+    evals = replays = 0
+    gens_run = 0
+    partial_reason: str | None = None
+
+    for gen in range(generations):
+        if wall_exceeded():
+            partial_reason = f"wall budget ({budget_s}s) before gen {gen}"
+            break
+        if evals + replays >= eval_budget:
+            partial_reason = (f"eval budget ({eval_budget}) before "
+                              f"gen {gen}")
+            break
+        # Build the generation: mutations of the current frontier
+        # (worst first, round-robin) fill half the population, fresh
+        # crc32-derived samples the rest. Generation 0 is all fresh.
+        cands: list[Candidate] = []
+        parents = sorted(frontier.values(),
+                         key=lambda e: (e.margin, e.entry_id))
+        slot = 0
+        while parents and len(cands) < population // 2:
+            parent = parents[slot % len(parents)]
+            cand = _mutate(parent.candidate, sweep_seed, gen, slot)
+            slot += 1
+            if cand.entry_id in seen:
+                continue
+            seen.add(cand.entry_id)
+            cands.append(cand)
+            if slot > population * 4:    # all mutations already seen
+                break
+        slot = 0
+        while len(cands) < population:
+            cand = _fresh(sweep_seed, gen, slot, ticks, templates)
+            slot += 1
+            if cand.entry_id in seen:
+                continue
+            seen.add(cand.entry_id)
+            cands.append(cand)
+            if slot > population * 4:
+                break
+        if not cands:
+            break
+        remaining = max(0, eval_budget - evals - replays)
+        if len(cands) > remaining:
+            cands = cands[:remaining]
+            partial_reason = (f"eval budget ({eval_budget}) truncated "
+                              f"gen {gen} to {len(cands)} candidates")
+        ranked, optimizer = _screen(cands, optimizer, width,
+                                    config_overrides)
+        evals += len(cands)
+        SENSORS.count("redteam_evals", len(cands))
+        gens_run = gen + 1
+        for _score, _eid, cand in ranked[:survivors]:
+            if wall_exceeded():
+                partial_reason = (f"wall budget ({budget_s}s) during "
+                                  f"gen {gen} replays")
+                break
+            if evals + replays >= eval_budget:
+                partial_reason = (f"eval budget ({eval_budget}) during "
+                                  f"gen {gen} replays")
+                break
+            entry = _score_full_loop(cand, gen, config_overrides)
+            replays += 1
+            SENSORS.count("redteam_replays")
+            frontier[entry.entry_id] = entry
+        worst = sorted(frontier.values(),
+                       key=lambda e: (e.margin, e.entry_id))
+        frontier = {e.entry_id: e for e in worst[:frontier_size]}
+        if partial_reason:
+            break
+
+    entries = sorted(frontier.values(), key=lambda e: (e.margin,
+                                                       e.entry_id))
+    blind_spots = 0
+    out_entries = []
+    for e in entries:
+        blind = None
+        if tag_blind_spots:
+            from .blindspot import entry_blind_spot
+            blind = entry_blind_spot(e.candidate.future().spec, e.margin)
+            if blind["tagged"]:
+                blind_spots += 1
+        out_entries.append(dataclasses.replace(e, blind_spot=blind)
+                           .as_dict())
+    if entries:
+        SENSORS.gauge("redteam_frontier_margin_min", entries[0].margin)
+    SENSORS.count("redteam_blind_spots", blind_spots)
+
+    lib = None
+    found_below_library = None
+    if library is not None:
+        lib_min = min(library.values()) if library else None
+        lib = {"margins": dict(library), "minMargin": lib_min}
+        if lib_min is not None:
+            found_below_library = sum(
+                1 for e in entries if e.margin < lib_min)
+    return {
+        "version": 1,
+        "sweepSeed": sweep_seed,
+        "templates": list(templates),
+        "ticks": ticks,
+        "population": population,
+        "generationsRequested": generations,
+        "generationsRun": gens_run,
+        "evals": evals,
+        "replays": replays,
+        "partial": partial_reason is not None,
+        "partialReason": partial_reason,
+        "library": lib,
+        "foundBelowLibrary": found_below_library,
+        "blindSpotCount": blind_spots,
+        "frontier": out_entries,
+    }
+
+
+def library_margins(seed: int = 0) -> dict[str, float]:
+    """The canonical library's overall margins, full-loop at their
+    native horizons — the bar a mined scenario must get UNDER to count
+    as a discovery (acceptance: margin below the library's minimum).
+    Expensive (it replays every canonical scenario); run offline to
+    stamp the committed frontier, not inside the CI stage budget."""
+    from ..testing.simulator import CANONICAL_SCENARIOS, run_scenario
+    out = {}
+    for name, spec in sorted(CANONICAL_SCENARIOS.items()):
+        result = run_scenario(spec, seed=seed)
+        out[name] = round(scenario_margin(result.score.slo_margins()), 6)
+    return out

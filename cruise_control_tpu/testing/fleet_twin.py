@@ -59,13 +59,50 @@ TWIN_EVENTS = {
 }
 
 
-def run_fleet_megabatch(seed: int = 0, ticks: int | None = None) -> dict:
+#: The red-team correlated variant (round 22): the SAME tick kills a
+#: broker in EVERY fleet member sharing the megabatch bucket — the
+#: shared-infrastructure outage (a rack power loss under two tenants)
+#: the per-twin staggered losses above never exercise. Both heals and
+#: both backfill solves land in the SAME scheduler sweeps.
+CASCADE_KILL_TICK = 17
+
+
+def correlated_cascade_events(kill_tick: int = CASCADE_KILL_TICK,
+                              ) -> dict[str, tuple[ScenarioEvent, ...]]:
+    """Per-twin event scripts for the correlated cross-fleet cascade:
+    distinct victims (each twin's own broker), one shared instant."""
+    return {
+        "twin-a": (ScenarioEvent(kill_tick, "kill_broker", {"broker": 5}),),
+        "twin-b": (ScenarioEvent(kill_tick, "kill_broker", {"broker": 4}),),
+    }
+
+
+def run_fleet_cascade(seed: int = 0, ticks: int | None = None,
+                      kill_tick: int = CASCADE_KILL_TICK) -> dict:
+    """The correlated multi-cluster cascade, full loop: both twins lose
+    a broker at the same tick and must self-heal through the shared
+    scheduler while megabatched solves keep both caches warm (the
+    round-22 red-team satellite: heals clean, zero dead letters)."""
+    return run_fleet_megabatch(
+        seed=seed, ticks=ticks, name="fleet_correlated_cascade",
+        twin_events=correlated_cascade_events(kill_tick))
+
+
+def run_fleet_megabatch(seed: int = 0, ticks: int | None = None,
+                        twin_events: dict | None = None,
+                        name: str = "fleet_megabatch") -> dict:
     """Run the twin scenario; returns the flattened record the CI
     scenario matrix and tests read (per-twin scores, merged SLO list,
-    megabatch occupancy proof, crc digest over both final assignments)."""
+    megabatch occupancy proof, crc digest over both final assignments).
+    ``twin_events`` swaps the per-twin event scripts (the correlated-
+    cascade variant above); default = the staggered TWIN_EVENTS."""
     from ..fleet import FleetRegistry, FleetScheduler
 
     spec = FLEET_MEGABATCH_SPEC
+    if twin_events is None:
+        twin_events = TWIN_EVENTS
+    if name != "fleet_megabatch":
+        spec = dataclasses.replace(spec, name=name)
     if ticks is not None:
         spec = dataclasses.replace(spec, ticks=int(ticks))
     # ccsa: ok[CCSA004] observability-only wall measurement (the record's
@@ -74,7 +111,7 @@ def run_fleet_megabatch(seed: int = 0, ticks: int | None = None) -> dict:
     clock = SimClock()
     sims: dict[str, ClusterSimulator] = {}
     first = None
-    for cid, events in TWIN_EVENTS.items():
+    for cid, events in twin_events.items():
         twin_spec = dataclasses.replace(spec, events=events)
         sims[cid] = ClusterSimulator(
             twin_spec, seed=seed, clock=clock,
@@ -125,7 +162,7 @@ def run_fleet_megabatch(seed: int = 0, ticks: int | None = None) -> dict:
     heal_p95 = [h for h in heal_p95 if h is not None]
     bal = [s.balancedness[-1] for s in scores.values() if s.balancedness]
     return {
-        "scenario": "fleet_megabatch",
+        "scenario": name,
         "seed": seed,
         "ticks": spec.ticks,
         "sim_hours": round(sum(s.sim_hours for s in scores.values()), 3),

@@ -100,11 +100,15 @@ HEAL_TRIGGERING = ("kill_broker", "kill_logdir")
 @dataclasses.dataclass(frozen=True)
 class DriftSpec:
     """Load-drift shape: rates scale by
-    ``global_factor × (1 + amplitude × sin(2π · t / period))`` — the
-    diurnal ramp — on the simulated clock."""
+    ``global_factor × (1 + amplitude × sin(2π · (t + phase) / period))``
+    — the diurnal ramp — on the simulated clock. ``phase_ticks``
+    (round 22) shifts where in the wave the scenario starts: the
+    red-team miner's phase perturbation, default 0.0 so every existing
+    spec's trajectory is byte-identical."""
 
     amplitude: float = 0.0
     period_ticks: int = 60
+    phase_ticks: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -181,7 +185,8 @@ class DriftingSampler:
         f = self.global_factor * self.hotspots.get(topic, 1.0)
         if self._drift.amplitude:
             period_s = max(1.0, self._drift.period_ticks * self._tick_s)
-            phase = 2.0 * math.pi * (t_ms / 1000.0) / period_s
+            t_s = t_ms / 1000.0 + self._drift.phase_ticks * self._tick_s
+            phase = 2.0 * math.pi * t_s / period_s
             f *= 1.0 + self._drift.amplitude * math.sin(phase)
         return max(f, 0.01)
 
@@ -324,6 +329,23 @@ class ScenarioScore:
             time_to_heal_p95_ticks=self.time_to_heal_p95_ticks(),
             heal_ticks_floor=self._slo_heal_ticks,
             ticks_below_balancedness=self.ticks_below_balancedness_slo,
+            balancedness_min=self._slo_bal_min,
+            moves_per_simhour=self.moves_per_simhour(),
+            moves_floor=self._slo_moves_hr,
+            dead_letters=self.dead_letters)
+
+    def slo_margins(self) -> dict:
+        # The red-team miner's ranking signal (round 22): normalized
+        # per-floor headroom, rendered through the same utils.slo module
+        # as the verdicts so margin<0 and a rendered violation can never
+        # disagree on one run.
+        from ..utils.slo import scenario_floor_margins
+        return scenario_floor_margins(
+            unhealed=self.unhealed(),
+            time_to_heal_p95_ticks=self.time_to_heal_p95_ticks(),
+            heal_ticks_floor=self._slo_heal_ticks,
+            balancedness_min_observed=(min(self.balancedness)
+                                       if self.balancedness else None),
             balancedness_min=self._slo_bal_min,
             moves_per_simhour=self.moves_per_simhour(),
             moves_floor=self._slo_moves_hr,

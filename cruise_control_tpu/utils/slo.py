@@ -36,7 +36,7 @@ import collections
 import dataclasses
 import threading
 import time
-from typing import Callable
+from typing import Callable, Mapping
 
 from .sensors import SENSORS
 
@@ -347,6 +347,13 @@ class SloRegistry:
         the same renderer)."""
         return scenario_floor_violations(**floors)
 
+    def scenario_margins(self, **floors) -> dict:
+        """The twin's floor MARGINS through the registry — the red-team
+        miner's ranking signal (round 22), kept next to the verdict
+        renderer so margin<0 and a rendered verdict can never drift
+        apart."""
+        return scenario_floor_margins(**floors)
+
     def state(self) -> dict:
         """The ``GET /slo`` body: config surface + live evaluation."""
         with self._lock:
@@ -392,3 +399,50 @@ def scenario_floor_violations(*, unhealed: int,
     if dead_letters:
         out.append(f"dead_letters={dead_letters}")
     return out
+
+
+def scenario_floor_margins(*, unhealed: int,
+                           time_to_heal_p95_ticks,
+                           heal_ticks_floor: int,
+                           balancedness_min_observed,
+                           balancedness_min: float,
+                           moves_per_simhour: float,
+                           moves_floor: float,
+                           dead_letters: int) -> dict:
+    """Normalized headroom per SLO floor — the red-team miner's ranking
+    signal (round 22). Contract with ``scenario_floor_violations``:
+    ``margin < 0`` for a floor if and only if that floor's verdict
+    string renders (same inputs, same floors), so the frontier's
+    "worst case" ordering and the serving verdicts can never disagree.
+    0 means exactly at the floor; count-style floors (unhealed faults,
+    dead letters) have no continuum above the floor, so a clean run
+    reports a fixed +1.0 and a dirty one ``-count``. A disabled moves
+    floor (0.0) reports +1.0: it cannot be approached, let alone
+    crossed."""
+    margins: dict[str, float] = {}
+    margins["unhealed_faults"] = 1.0 if not unhealed else -float(unhealed)
+    p95 = time_to_heal_p95_ticks
+    if p95 is None:
+        margins["time_to_heal"] = 1.0
+    else:
+        margins["time_to_heal"] = round(
+            (heal_ticks_floor - float(p95)) / float(max(1, heal_ticks_floor)),
+            6)
+    if balancedness_min_observed is None:
+        margins["balancedness"] = 1.0
+    else:
+        margins["balancedness"] = round(
+            (float(balancedness_min_observed) - balancedness_min) / 100.0, 6)
+    if moves_floor:
+        margins["moves_per_simhour"] = round(
+            (moves_floor - moves_per_simhour) / max(moves_floor, 1e-9), 6)
+    else:
+        margins["moves_per_simhour"] = 1.0
+    margins["dead_letters"] = 1.0 if not dead_letters else -float(dead_letters)
+    return margins
+
+
+def scenario_margin(margins: Mapping) -> float:
+    """The scalar frontier key: the tightest floor's headroom.
+    Negative = at least one floor violated."""
+    return min(float(v) for v in margins.values())

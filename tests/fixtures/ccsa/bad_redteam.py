@@ -1,0 +1,29 @@
+"""CCSA004 fixture: a miner-shaped module that derives candidate seeds
+from the wall clock and mutation picks from the global ``random`` state
+(tests lint this file under the spoofed
+cruise_control_tpu/redteam/miner.py path — the round-22 mining sweep is
+a pure function of the sweep seed and the committed frontier JSON is
+byte-identical per seed, so any inline clock/random call silently forks
+the regression frontier; the wall budget rides the caller-injected
+``clock`` callable only)."""
+
+import random
+import time
+
+
+def bad_candidate_seed() -> float:
+    return time.time()                   # finding: wall clock inline
+
+
+def bad_mutation_pick() -> float:
+    return random.random()               # finding: global random state
+
+
+def injected_budget(clock=time.monotonic) -> float:
+    return clock()                       # clean: reference is the seam
+
+
+def timed_sweep() -> float:
+    # ccsa: ok[CCSA004] fixture: observability-only harness wall time,
+    # never enters the frontier JSON or any digest
+    return time.perf_counter()

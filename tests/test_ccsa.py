@@ -299,6 +299,30 @@ def test_ccsa_covers_serving_modules():
         assert not real_active, [f.message for f in real_active]
 
 
+def test_ccsa_covers_redteam_modules():
+    """The round-22 red-team miner sits under CCSA004's deterministic
+    contract: the whole search — sampling, mutation, tie-breaks,
+    frontier order — is crc32-derived from the sweep seed (the committed
+    frontier JSON is byte-identical per seed) and the wall budget rides
+    the caller-injected ``clock`` callable only. Wall clock and global
+    randomness are findings under the redteam paths, the injected-clock
+    reference and the documented observability suppression stay legal,
+    and the REAL modules verify clean."""
+    spoofed = ctx_for(FIXTURES / "bad_redteam.py",
+                      "cruise_control_tpu/redteam/miner.py")
+    active, suppressed = findings_of("CCSA004", spoofed)
+    assert len(active) == 2           # time.time() + random.random()
+    assert len(suppressed) == 1       # the documented perf_counter probe
+    assert any("time.time" in f.message for f in active)
+    assert any("random.random" in f.message for f in active)
+    for rel in ("cruise_control_tpu/redteam/miner.py",
+                "cruise_control_tpu/redteam/frontier.py",
+                "cruise_control_tpu/redteam/blindspot.py"):
+        ctx = ctx_for(ROOT / rel, rel)
+        real_active, _sup = findings_of("CCSA004", ctx)
+        assert not real_active, [f.message for f in real_active]
+
+
 def test_ccsa004_hash_ban_is_repo_wide_but_clock_is_not():
     plain = ctx_for(FIXTURES / "bad_determinism.py")
     active, suppressed = findings_of("CCSA004", plain)

@@ -7,6 +7,8 @@ import pytest
 from cruise_control_tpu.testing.fleet_twin import run_fleet_megabatch
 
 
+@pytest.mark.slow  # ~9 s of twin ticks; the full-spec twin below and
+# CI's fleet_megabatch matrix row cover the same machinery
 def test_fleet_twin_megabatch_smoke():
     """Short horizon (one broker loss, twin-a's): batched solves really
     happen at occupancy 2, the loss heals through the real detector/

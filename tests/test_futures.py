@@ -298,6 +298,8 @@ def test_what_if_random_unknown_template_is_400_listing_templates(api_cc):
     assert "not an integer" in json.dumps(body)
 
 
+@pytest.mark.slow  # ~19 s: replays a capped-horizon scenario full-loop;
+# the cap logic itself is a one-line clamp covered by the 400-path tests
 def test_what_if_random_respects_tick_cap(api_cc):
     api, cc = api_cc
     cap = cc.config.get_int("scenario.what.if.max.ticks")

@@ -1,0 +1,429 @@
+"""Round-22 adversarial scenario mining (redteam/): deterministic
+frontier JSON, committed-frontier replay pins, the correlated fleet
+cascade, forecaster blind-spot verdicts, the REDTEAM API surface +
+``what_if=mined:`` replays, and off-means-off."""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import zlib
+
+import pytest
+
+from cruise_control_tpu.futures.generator import (
+    Perturbation, apply_perturbations, perturbed_future, sample_future,
+)
+from cruise_control_tpu.redteam import (
+    Candidate, entry_spec, forecast_miss, frontier_json,
+    global_factor_series, load_frontier, mine, replay_entry,
+    save_frontier,
+)
+from cruise_control_tpu.redteam.blindspot import entry_blind_spot
+from cruise_control_tpu.testing.simulator import (
+    DriftSpec, ScenarioEvent, ScenarioSpec,
+)
+from cruise_control_tpu.utils.slo import scenario_margin
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+COMMITTED_FRONTIER = ROOT / "fileStore" / "redteam_frontier.json"
+
+#: One toy-scale sweep configuration shared by the determinism tests:
+#: small enough for tier-1, deep enough to exercise mutation + frontier
+#: trimming (generation 1 mutates generation 0's survivors).
+SWEEP_KW = dict(population=3, generations=2, survivors=1,
+                frontier_size=4, ticks=8, eval_budget=10, width=4)
+
+
+@pytest.fixture(scope="module")
+def shared_optimizer():
+    """One GoalOptimizer for every mine() call in this module, so the
+    decision-solve programs compile once (results are optimizer-
+    independent — the parity pin in test_futures covers that)."""
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.futures.evaluator import (
+        FutureSpec, prepare_sampled,
+    )
+    f = perturbed_future("load_ramp", 1, 8, ())
+    p = prepare_sampled(f, 8, fspec=FutureSpec("load_ramp", 1, 8))
+    return GoalOptimizer(p.config)
+
+
+# ---------------------------------------------------------------------------
+# Perturbations (futures/generator.py)
+# ---------------------------------------------------------------------------
+
+def test_perturbations_are_pure_and_bounded():
+    base = sample_future("cascading_failures", 5).replay_spec(24)
+    amp = apply_perturbations(base, (Perturbation("drift_amplitude", 3.0),
+                                     Perturbation("drift_amplitude", 3.0)))
+    assert amp.drift.amplitude <= 0.95          # clamp
+    phase = apply_perturbations(base, (Perturbation("drift_phase", 10.0),))
+    assert phase.drift.phase_ticks == base.drift.phase_ticks + 10.0
+    shifted = apply_perturbations(base, (Perturbation("event_timing", 6.0),))
+    assert [e.tick for e in shifted.events] \
+        == [min(base.ticks - 1, e.tick + 6) for e in base.events]
+    # Same inputs, same spec bytes — and the base spec is untouched.
+    again = apply_perturbations(base, (Perturbation("event_timing", 6.0),))
+    assert shifted == again
+    assert base == sample_future("cascading_failures", 5).replay_spec(24)
+    with pytest.raises(ValueError, match="unknown perturbation"):
+        apply_perturbations(base, (Perturbation("nope", 1.0),))
+
+
+def test_fault_reorder_permutes_fault_ticks_only():
+    base = sample_future("cascading_failures", 5).replay_spec(24)
+    fault_kinds = {"kill_broker", "kill_logdir"}
+    faults = [e for e in base.events if e.kind in fault_kinds]
+    if len(faults) < 2:
+        pytest.skip("sampled spec has <2 fault events")
+    rot = apply_perturbations(base, (Perturbation("fault_reorder", 1.0),))
+    rot_faults = [e for e in rot.events if e.kind in fault_kinds]
+    # The tick multiset is preserved (rotation, not a shift) but WHICH
+    # fault fires at which tick changes — the schedule permutes.
+    assert sorted(e.tick for e in rot_faults) \
+        == sorted(e.tick for e in faults)
+    assert [(e.tick, e.kind, sorted(e.params.items()))
+            for e in rot_faults] \
+        != [(e.tick, e.kind, sorted(e.params.items())) for e in faults]
+    others = [e for e in base.events if e.kind not in fault_kinds]
+    rot_others = [e for e in rot.events if e.kind not in fault_kinds]
+    assert others == rot_others
+
+
+# ---------------------------------------------------------------------------
+# Miner determinism: one sweep seed ⇒ byte-identical frontier JSON
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sweep_seed", [0, 7])
+def test_frontier_json_byte_identical_per_sweep_seed(sweep_seed,
+                                                     shared_optimizer):
+    lib = {"stub_scenario": 0.25}
+    r1 = mine(sweep_seed, optimizer=shared_optimizer, library=lib,
+              **SWEEP_KW)
+    r2 = mine(sweep_seed, optimizer=shared_optimizer, library=lib,
+              **SWEEP_KW)
+    assert frontier_json(r1) == frontier_json(r2)
+    assert r1["frontier"], "toy sweep must keep at least one entry"
+    assert r1["sweepSeed"] == sweep_seed
+    assert r1["generationsRun"] == SWEEP_KW["generations"]
+    assert not r1["partial"] and r1["partialReason"] is None
+    assert r1["library"]["minMargin"] == 0.25
+    # Frontier is sorted worst-first with deterministic ties.
+    margins = [e["margin"] for e in r1["frontier"]]
+    assert margins == sorted(margins)
+    for e in r1["frontier"]:
+        assert e["id"] == Candidate.from_dict(e).entry_id
+        assert e["blindSpot"] is not None
+
+
+def test_mine_eval_budget_exhaustion_is_partial_not_silent(
+        shared_optimizer):
+    r = mine(0, optimizer=shared_optimizer,
+             **{**SWEEP_KW, "eval_budget": 3})
+    assert r["partial"] is True
+    assert "eval budget" in r["partialReason"]
+    assert r["evals"] + r["replays"] <= 4     # one truncated generation
+
+
+def test_mine_wall_budget_exhaustion_is_partial_not_silent(
+        shared_optimizer):
+    ticks = iter(range(1000))
+
+    def fake_clock() -> float:
+        return float(next(ticks))
+
+    r = mine(0, optimizer=shared_optimizer, clock=fake_clock,
+             budget_s=0.5, **SWEEP_KW)
+    assert r["partial"] is True
+    assert "wall budget" in r["partialReason"]
+
+
+# ---------------------------------------------------------------------------
+# Committed-frontier replay pins (the regression contract)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # two full-loop 24-tick replays
+@pytest.mark.parametrize("idx", [0, 1])
+def test_committed_frontier_entry_replays_byte_identical(idx):
+    committed = load_frontier(str(COMMITTED_FRONTIER))
+    assert committed is not None, "committed frontier file missing"
+    entries = committed["frontier"]
+    assert len(entries) > idx, "committed frontier too small"
+    entry = entries[idx]
+    result = replay_entry(entry)
+    digest = f"{zlib.crc32(result.score.to_json().encode()):08x}"
+    assert digest == entry["scoreDigest"]
+    assert result.assignment_digest == entry["assignmentDigest"]
+    margins = result.score.slo_margins()
+    assert round(scenario_margin(margins), 6) == entry["margin"]
+    assert sorted(result.score.slo_violations()) \
+        == sorted(entry["sloViolations"])
+
+
+def test_committed_frontier_beats_library_minimum():
+    """The acceptance bar: the miner found at least one scenario with a
+    lower SLO margin than every hand-written canonical scenario."""
+    committed = load_frontier(str(COMMITTED_FRONTIER))
+    assert committed is not None, "committed frontier file missing"
+    lib_min = committed["library"]["minMargin"]
+    assert committed["foundBelowLibrary"] >= 1
+    assert min(e["margin"] for e in committed["frontier"]) < lib_min
+
+
+# ---------------------------------------------------------------------------
+# Correlated multi-cluster cascade (testing/fleet_twin.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # twin full-loop ticks
+def test_fleet_correlated_cascade_heals_clean():
+    from cruise_control_tpu.testing.fleet_twin import run_fleet_cascade
+    r = run_fleet_cascade(seed=0, ticks=32)
+    assert r["scenario"] == "fleet_correlated_cascade"
+    assert r["events_applied"] == 2       # both kills land (same tick)
+    # faults_injected counts CHAOS-schedule injections (none here);
+    # scripted kills prove themselves through the heal accounting.
+    assert r["time_to_heal_p95_ticks"] is not None
+    assert r["unhealed_faults"] == 0
+    assert r["dead_letters"] == 0
+    assert r["slo_violations"] == []
+    assert r["megabatch_batches"] > 0
+    assert r["megabatch_last_occupancy"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Forecaster blind-spot report (redteam/blindspot.py)
+# ---------------------------------------------------------------------------
+
+def test_forecast_miss_step_is_blind_spot_ramp_is_not():
+    step = [1.0] * 12 + [3.0] * 12
+    r = forecast_miss(step, 12)
+    assert r["miss"] is True              # step after the fit window
+    ramp = [1.0 + 0.05 * t for t in range(24)]
+    r2 = forecast_miss(ramp, 12)
+    assert r2["miss"] is False            # the trend basis extrapolates
+    assert r2["maxDeviation"] <= r2["band"]
+
+
+def test_entry_blind_spot_tags_near_violating_step_only():
+    step_spec = ScenarioSpec(
+        name="rt_step", description="", ticks=24,
+        events=(ScenarioEvent(12, "set_load", {"factor": 3.0}),))
+    tagged = entry_blind_spot(step_spec, margin=0.05)
+    assert tagged["nearViolation"] and tagged["miss"] and tagged["tagged"]
+    # Same trajectory, comfortable margin: measured but untagged.
+    assert entry_blind_spot(step_spec, margin=0.5)["tagged"] is False
+    flat_spec = ScenarioSpec(name="rt_flat", description="", ticks=24)
+    flat = entry_blind_spot(flat_spec, margin=0.05)
+    assert flat["nearViolation"] is True
+    assert flat["miss"] is False and flat["tagged"] is False
+
+
+def test_global_factor_series_applies_steps_and_phase():
+    spec = ScenarioSpec(
+        name="rt_series", description="", ticks=8,
+        drift=DriftSpec(amplitude=0.5, period_ticks=8, phase_ticks=2.0),
+        events=(ScenarioEvent(4, "set_load", {"factor": 2.0}),))
+    series = global_factor_series(spec)
+    assert len(series) == 8
+    import math
+    for t in (0, 3, 4, 7):
+        base = 2.0 if t >= 4 else 1.0
+        want = base * (1.0 + 0.5 * math.sin(2.0 * math.pi * (t + 2.0) / 8))
+        assert series[t] == round(max(want, 0.01), 6)
+
+
+# ---------------------------------------------------------------------------
+# API surface: GET /redteam + what_if=mined:<id>
+# ---------------------------------------------------------------------------
+
+def _make_api(extra_config: dict):
+    from cruise_control_tpu.api.server import CruiseControlApi
+    from cruise_control_tpu.common.resources import Resource
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    from cruise_control_tpu.executor.admin import (
+        InMemoryAdminBackend, PartitionState,
+    )
+    from cruise_control_tpu.executor.executor import Executor
+    from cruise_control_tpu.facade import CruiseControl
+    from cruise_control_tpu.monitor import (
+        LoadMonitor, StaticCapacityResolver,
+    )
+    from cruise_control_tpu.monitor.sampling import SyntheticSampler
+    parts = {}
+    for t in range(2):
+        for p in range(4):
+            reps = (0, 1 + (t + p) % 3)
+            parts[(f"t{t}", p)] = PartitionState(f"t{t}", p, reps,
+                                                 reps[0], isr=reps)
+    backend = InMemoryAdminBackend(parts.values())
+    cfg = CruiseControlConfig({
+        "partition.metrics.window.ms": 1000,
+        "num.partition.metrics.windows": 3,
+        "min.valid.partition.ratio": 0.0,
+        "failed.brokers.file.path": "",
+        **extra_config})
+    caps = StaticCapacityResolver({}, {Resource.CPU: 100.0,
+                                       Resource.DISK: 1e7,
+                                       Resource.NW_IN: 1e6,
+                                       Resource.NW_OUT: 1e6})
+    monitor = LoadMonitor(cfg, backend, samplers=[SyntheticSampler()],
+                          capacity_resolver=caps)
+    cc = CruiseControl(cfg, backend, load_monitor=monitor,
+                       executor=Executor(backend, synchronous=True))
+    for k in range(1, 4):
+        monitor.task_runner.run_sampling_once(end_ms=k * 1000)
+    api = CruiseControlApi(cc)
+    api._async_wait_s = 300
+    return api, cc
+
+
+@pytest.fixture(scope="module")
+def mined_frontier(tmp_path_factory, shared_optimizer):
+    """A real toy-scale mined frontier saved to a tmp path — the API
+    fixtures point redteam.frontier.path here."""
+    path = tmp_path_factory.mktemp("redteam") / "frontier.json"
+    result = mine(0, optimizer=shared_optimizer, **SWEEP_KW)
+    save_frontier(result, str(path))
+    return str(path), result
+
+
+@pytest.fixture(scope="module")
+def redteam_api(mined_frontier):
+    path, _result = mined_frontier
+    api, cc = _make_api({"redteam.frontier.path": path})
+    yield api, cc
+    api.shutdown()
+
+
+def test_redteam_endpoint_serves_frontier(redteam_api, mined_frontier):
+    api, _cc = redteam_api
+    _path, result = mined_frontier
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/redteam", "")
+    assert status == 200, body
+    assert body["frontierFound"] is True
+    assert body["sweepSeed"] == 0
+    assert body["numEntries"] == len(result["frontier"])
+    assert [e["id"] for e in body["frontier"]] \
+        == [e["id"] for e in result["frontier"]]
+    assert body["frontier"][0]["blindSpot"] is not None
+    # entries= bounds, blind_spots=false strips the per-entry detail.
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/redteam",
+                                 "entries=1&blind_spots=false")
+    assert status == 200
+    assert body["numEntries"] == 1
+    assert "blindSpot" not in body["frontier"][0]
+
+
+def test_redteam_endpoint_missing_frontier_hints_at_miner():
+    api, _cc = _make_api({"redteam.frontier.path": "/tmp/rt_nope.json"})
+    try:
+        status, body, _ = api.handle("GET",
+                                     "/kafkacruisecontrol/redteam", "")
+        assert status == 200
+        assert body["frontierFound"] is False
+        assert "bench.py --redteam" in body["hint"]
+        status, body, _ = api.handle(
+            "GET", "/kafkacruisecontrol/proposals", "what_if=mined:m0")
+        assert status == 400
+        assert "mined frontier is empty" in body["errorMessage"]
+        assert "bench.py --redteam" in body["errorMessage"]
+    finally:
+        api.shutdown()
+
+
+def test_what_if_mined_replays_frontier_entry(redteam_api, mined_frontier):
+    api, _cc = redteam_api
+    _path, result = mined_frontier
+    entry = result["frontier"][0]
+    status, body, _ = api.handle(
+        "GET", "/kafkacruisecontrol/proposals",
+        f"what_if=mined:{entry['id']}")
+    assert status == 200, body
+    assert body["dryrun"] is True and body["executed"] is False
+    assert body["seed"] == entry["replaySeed"]
+    assert body["ticks"] == entry["ticks"]
+    assert body["finalAssignmentDigest"] == entry["assignmentDigest"]
+    digest = f"{zlib.crc32(json.dumps(body['score'], sort_keys=True).encode()):08x}"
+    assert digest == entry["scoreDigest"]
+
+
+def test_what_if_mined_unknown_id_lists_known_ids(redteam_api,
+                                                  mined_frontier):
+    api, _cc = redteam_api
+    _path, result = mined_frontier
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/proposals",
+                                 "what_if=mined:zzz")
+    assert status == 400
+    msg = body["errorMessage"]
+    assert "unknown mined frontier id 'zzz'" in msg
+    for e in result["frontier"]:
+        assert e["id"] in msg
+
+
+# ---------------------------------------------------------------------------
+# Off means off
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def disabled_api(mined_frontier):
+    path, _result = mined_frontier
+    api, cc = _make_api({"redteam.enabled": False,
+                         "redteam.frontier.path": path})
+    yield api, cc
+    api.shutdown()
+
+
+def test_redteam_disabled_surfaces_400(disabled_api, mined_frontier):
+    api, _cc = disabled_api
+    _path, result = mined_frontier
+    status, body, _ = api.handle("GET", "/kafkacruisecontrol/redteam", "")
+    assert status == 400
+    assert "redteam.enabled=false" in body["errorMessage"]
+    status, body, _ = api.handle(
+        "GET", "/kafkacruisecontrol/proposals",
+        f"what_if=mined:{result['frontier'][0]['id']}")
+    assert status == 400
+    assert "redteam.enabled=false" in body["errorMessage"]
+
+
+def test_redteam_disabled_leaves_proposal_bytes_unchanged(redteam_api,
+                                                          disabled_api):
+    """Off means off: the same what_if replay request returns BYTE-
+    identical proposal bodies whether redteam is enabled or disabled —
+    the subsystem adds a surface, it never perturbs the existing one."""
+    q = "what_if=random:load_ramp:3&what_if_ticks=6"
+    _s1, b1, _ = redteam_api[0].handle(
+        "GET", "/kafkacruisecontrol/proposals", q)
+    _s2, b2, _ = disabled_api[0].handle(
+        "GET", "/kafkacruisecontrol/proposals", q)
+    assert json.dumps(b1, sort_keys=True) == json.dumps(b2, sort_keys=True)
+
+
+def test_redteam_disabled_leaves_loadgen_schedule_digest_pinned():
+    """The serving loadgen schedule is untouched by the red-team
+    subsystem: the bench_baseline.json digest pin holds with
+    redteam.enabled=false (same pin test_serving asserts by default)."""
+    from cruise_control_tpu.serving import loadgen
+    profile = loadgen.mixed_profile()
+    s = loadgen.generate_schedule(profile, seed=0, rate_rps=50.0,
+                                  duration_s=2.0)
+    assert loadgen.schedule_digest(s) == "3318f2f9"
+
+
+# ---------------------------------------------------------------------------
+# Frontier persistence round-trip
+# ---------------------------------------------------------------------------
+
+def test_frontier_save_load_round_trip(tmp_path, mined_frontier):
+    _path, result = mined_frontier
+    p = tmp_path / "nested" / "frontier.json"
+    save_frontier(result, str(p))
+    loaded = load_frontier(str(p))
+    assert frontier_json(loaded) == frontier_json(result)
+    entry = loaded["frontier"][0]
+    spec = entry_spec(entry)
+    assert spec.ticks == entry["ticks"]
+    assert load_frontier(str(tmp_path / "missing.json")) is None

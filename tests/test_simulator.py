@@ -259,6 +259,9 @@ PINNED = {
 }
 
 
+@pytest.mark.slow  # ~75 s across params; CI's SCENARIO_MATRIX replays
+# every canonical scenario per-PR, and the slow suite still runs these
+# exact pins — tier-1 keeps the cheaper determinism tests above.
 @pytest.mark.parametrize("name,seed", sorted(PINNED))
 def test_seed_pinned_scenario_regression(name, seed):
     exp = PINNED[(name, seed)]
