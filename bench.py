@@ -112,6 +112,32 @@ SCENARIO_TICKS = int(os.environ.get("BENCH_SCENARIO_TICKS", "0"))
 FLEET_MODE = "--fleet" in sys.argv or bool(os.environ.get("BENCH_FLEET"))
 FLEET_K = int(os.environ.get("BENCH_FLEET_CLUSTERS", "4"))
 
+# --fleet-shard: run ONLY the device-sharded megabatch stage (round 23):
+# hundreds of tiny same-bucket clusters pushed through the chain-solve
+# layer, A/B-ing exactly what fleet.shard.enabled toggles — each
+# W·N-wide bucket batch solved as ONE single-device megabatch program
+# (global early exit: every round computes every row until the bucket's
+# slowest cluster converges) vs sharded across the N-device mesh at
+# FIXED per-device occupancy W (device-local exit: a device whose W
+# clusters converged stops computing). The mesh comes from a fresh
+# subprocess pinning --xla_force_host_platform_device_count=N (a
+# process-level XLA init flag — the only way to grow a host-CPU mesh,
+# so the stage cannot run in-process). vs_baseline is the clusters/s
+# ratio against the 1.6x acceptance bar; per-cluster results are
+# asserted BYTE-IDENTICAL between the arms (the parity pin — the CI
+# FLEET_SHARD row hard-fails anything but "ok"). Like the other riders,
+# the stage also runs at the END of every default bench pass.
+# --fleet-shard-child is the subprocess entry, handled before any
+# device probing.
+FLEETSHARD_MODE = "--fleet-shard" in sys.argv or bool(
+    os.environ.get("BENCH_FLEET_SHARD"))
+FLEETSHARD_CHILD = "--fleet-shard-child" in sys.argv
+FLEETSHARD_DEVICES = int(os.environ.get("BENCH_FLEET_SHARD_DEVICES", "4"))
+FLEETSHARD_OCCUPANCY = int(
+    os.environ.get("BENCH_FLEET_SHARD_OCCUPANCY", "16"))
+FLEETSHARD_CLUSTERS = int(
+    os.environ.get("BENCH_FLEET_SHARD_CLUSTERS", "256"))
+
 # --futures: run ONLY the futures-engine stage (N sampled candidate
 # futures advanced to their decision points, then solved serially vs
 # through one batched megabatch program — ROADMAP item 5's throughput
@@ -1092,6 +1118,210 @@ def _run_fleet_stage(progress: dict, k: int | None = None) -> dict:
     }
 
 
+def _run_fleet_shard_child() -> int:
+    """Subprocess body for --fleet-shard (round 23). Runs with
+    ``--xla_force_host_platform_device_count=N`` already in XLA_FLAGS
+    (set by the parent — a process-level init flag, hence the fresh
+    process). The A/B is exactly what ``fleet.shard.enabled`` toggles
+    in production: the same W·N-wide bucket batches solved as ONE
+    single-device megabatch program (the round-14 path — every round
+    computes every row until the bucket's SLOWEST cluster converges)
+    vs sharded across the N-device mesh at the control plane's fixed
+    per-device occupancy of W cluster slots, where each device's
+    while_loop exits as soon as ITS W clusters converge. The workload
+    is difficulty-banded along the cluster axis (three light bands +
+    one heavy — the realistic fleet shape: most clusters near
+    equilibrium, a few churning), so single-core hosts see the
+    early-exit-locality win and a real mesh adds device parallelism on
+    top. The freeze-select discipline makes each cluster's trajectory
+    a function of its own rows plus the global round index, so
+    per-cluster results must be BYTE-IDENTICAL across the arms. Prints
+    one JSON line with both arms' clusters/s and the parity verdict."""
+    import numpy as np
+
+    import jax
+
+    from cruise_control_tpu.analyzer.chain import (
+        AdaptiveDispatch, MegastepConfig, optimize_goal_in_chain_megabatch,
+        stack_states, unstack_state,
+    )
+    from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+    from cruise_control_tpu.analyzer.goals import (
+        NetworkOutboundUsageDistributionGoal, ReplicaDistributionGoal,
+    )
+    from cruise_control_tpu.analyzer.search import (
+        ExclusionMasks, SearchConfig,
+    )
+    from cruise_control_tpu.model.fixtures import random_cluster
+    from cruise_control_tpu.parallel.megabatch_sharded import (
+        shard_megabatch, shard_megabatch_masks,
+    )
+    from cruise_control_tpu.parallel.mesh import make_mesh
+
+    ndev = jax.device_count()
+    w = FLEETSHARD_OCCUPANCY
+    wide = w * ndev
+    c = FLEETSHARD_CLUSTERS - FLEETSHARD_CLUSTERS % wide
+    chain = (NetworkOutboundUsageDistributionGoal(),
+             ReplicaDistributionGoal())
+    cfg = SearchConfig(num_sources=8, num_dests=4, moves_per_round=4,
+                       max_rounds=96)
+    mega = MegastepConfig(donate=True, async_readback=True,
+                          deficit_moves_cap=0)
+    constraint = BalancingConstraint()
+    masks = ExclusionMasks()
+    dispatch_rounds = 96
+    num_topics = 6
+
+    def skew(s):
+        # Difficulty band by device block: the last block churns (deep
+        # imbalance, many rounds), the rest sit near equilibrium.
+        band = (s % wide) // w
+        return 32.0 if band == ndev - 1 else 1.0 + 0.4 * band
+
+    states = [random_cluster(num_brokers=6, num_topics=num_topics,
+                             num_partitions=96, rf=2, num_racks=3,
+                             seed=3 + s, skew_to_first=skew(s),
+                             partition_bucket=32)[0] for s in range(c)]
+    mesh = make_mesh(ndev)
+
+    def assemble(chunk, m):
+        batched = stack_states(chunk)
+        bmasks = masks
+        if m is not None:
+            batched = shard_megabatch(batched, m)
+            bmasks = shard_megabatch_masks(masks, m)
+        jax.block_until_ready(batched.assignment)
+        return batched, bmasks
+
+    def solve(batched, bmasks, n, m):
+        d = AdaptiveDispatch(dispatch_rounds, 0.0)
+        act = np.ones(n, dtype=bool)
+        ran = False
+        for i in range(len(chain)):
+            batched, infos = optimize_goal_in_chain_megabatch(
+                batched, chain, i, constraint, cfg, num_topics, bmasks,
+                act, dispatch_rounds=dispatch_rounds, dispatch=d,
+                megastep=mega, donate_input=ran, mesh=m)
+            ran = ran or any(x["rounds"] > 0 for x in infos)
+        return batched
+
+    # Warm both arms (compiles) before timing steady states. Bucket
+    # assembly (stack + shard placement) happens OUTSIDE the timed
+    # region both times — it is per-cluster host work the sharding does
+    # not change, exactly like the --fleet stage's model-build split.
+    for m in (None, mesh):
+        b, bm = assemble(states[:wide], m)
+        jax.block_until_ready(solve(b, bm, wide, m).assignment)
+
+    walls = {}
+    finals = {}
+    for label, m in (("single", None), ("sharded", mesh)):
+        best = None
+        for _rep in range(3):
+            pre = [assemble(states[j * wide:(j + 1) * wide], m)
+                   for j in range(c // wide)]
+            t0 = time.time()
+            outs = [solve(b, bm, wide, m) for b, bm in pre]
+            jax.block_until_ready([o.assignment for o in outs])
+            dt = max(time.time() - t0, 1e-9)
+            best = dt if best is None else min(best, dt)
+        walls[label] = best
+        finals[label] = outs
+
+    parity = "ok"
+    for s in range(c):
+        j, r = divmod(s, wide)
+        a = unstack_state(finals["single"][j], r)
+        b = unstack_state(finals["sharded"][j], r)
+        if not np.array_equal(np.asarray(a.assignment),
+                              np.asarray(b.assignment)) \
+                or not np.array_equal(np.asarray(a.leader_slot),
+                                      np.asarray(b.leader_slot)):
+            parity = f"MISMATCH(cluster {s})"
+            break
+
+    print(json.dumps({
+        "devices": ndev, "clusters": c, "per_device_occupancy": w,
+        "bucket_width": wide,
+        "single_device_s": round(walls["single"], 3),
+        "sharded_s": round(walls["sharded"], 3),
+        "clusters_per_s_single": round(c / walls["single"], 3),
+        "clusters_per_s_sharded": round(c / walls["sharded"], 3),
+        "parity_pin": parity}), flush=True)
+    return 0
+
+
+def _run_fleet_shard_stage(progress: dict, budget_s: float = 480.0) -> dict:
+    """The --fleet-shard stage (round 23): the device-sharded megabatch
+    measured where it matters — clusters/s for the same bucket queue
+    with ``fleet.shard.enabled`` off (one single-device program per
+    W·N-wide bucket batch) vs on (the batch sharded across the N-device
+    mesh at fixed per-device occupancy W, device-local early exit). The
+    measurement runs in a fresh subprocess (``_run_fleet_shard_child``)
+    because XLA's host-platform device count is a process-level init
+    flag. vs_baseline is the clusters/s ratio against the 1.6x
+    acceptance bar; the cross-arm byte-parity pin rides the extras (the
+    CI FLEET_SHARD row hard-fails anything but "ok")."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count="
+                        + str(FLEETSHARD_DEVICES))
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--fleet-shard-child"],
+        env=env, capture_output=True, text=True,
+        timeout=max(60.0, budget_s))
+    progress["fleet_shard_child_s"] = round(time.time() - t0, 3)
+    data = None
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("{"):
+            try:
+                data = json.loads(line)
+                break
+            except ValueError:
+                continue
+    if proc.returncode != 0 or data is None:
+        raise RuntimeError(
+            f"fleet-shard child rc={proc.returncode}: "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    speedup = data["clusters_per_s_sharded"] / max(
+        data["clusters_per_s_single"], 1e-9)
+    return {
+        "metric": f"fleet_shard_solve_{data['clusters']}clusters_"
+                  f"{data['devices']}dev",
+        "value": data["sharded_s"],
+        "unit": "s",
+        # Acceptance bar: >= 1.6x clusters/s at N devices vs 1 at fixed
+        # per-device occupancy (>1 here means the bar is met).
+        "vs_baseline": round(speedup / 1.6, 3),
+        "extras": {
+            "devices": data["devices"],
+            "clusters": data["clusters"],
+            "per_device_occupancy": data["per_device_occupancy"],
+            "bucket_width": data["bucket_width"],
+            "parity_pin": data["parity_pin"],
+            "single_device_s": data["single_device_s"],
+            "sharded_s": data["sharded_s"],
+            "fleet_shard_speedup": round(speedup, 3),
+            "clusters_per_s_single": data["clusters_per_s_single"],
+            "clusters_per_s_sharded": data["clusters_per_s_sharded"],
+            "clusters_per_s_per_device": round(
+                data["clusters_per_s_sharded"]
+                / max(data["devices"], 1), 3),
+            "solve_wall_clock_s": data["sharded_s"],
+            "measured_layer": "chain solve via the shard_map twins "
+                              "(same bucket batch both arms: one "
+                              "single-device program vs the N-device "
+                              "mesh; byte parity asserted per cluster)",
+            **progress,
+        },
+    }
+
+
 def _run_direct_stage(progress: dict) -> dict:
     """The --direct stage: the count-distribution goals solved by the
     deficit-sized GREEDY path vs the DIRECT-assignment transport + greedy
@@ -1212,8 +1442,18 @@ def _run_transport_stage(progress: dict) -> dict:
     geometry (tiny per-broker deficits; reported honestly in per_goal,
     not gated) — the stage's bar is TR plus the same balancedness /
     no-new-violated canary as --direct; the CI TRANSPORT row hard-fails
-    on a canary flip or this stage missing."""
-    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    on a canary flip or this stage missing.
+
+    Round 23 adds the per-goal density choice to the pins: below
+    ``solver.direct.density.sparse.threshold`` replicas per cell the
+    shipped optimizer routes only the sparse-plan winners (TR) through
+    the transport kernel and lets REPL/Leader keep their faster greedy
+    path — ``density_path_choice`` in the extras records which path
+    each count goal took at this stage's density, so the choice is
+    pinned per PR."""
+    from cruise_control_tpu.analyzer.optimizer import (
+        GoalOptimizer, direct_goal_choice, replica_density,
+    )
     from cruise_control_tpu.config.cruise_control_config import (
         CruiseControlConfig,
     )
@@ -1229,8 +1469,13 @@ def _run_transport_stage(progress: dict) -> dict:
                                  num_partitions=p, rf=3, num_racks=5,
                                  seed=11, skew_to_first=2.0)
     progress["transport_model_build_s"] = round(time.time() - t0, 3)
-    density = p * 3 / max(1, TRANSPORT_TOPICS * b)
+    density = replica_density(state, TRANSPORT_TOPICS)
     progress["transport_replicas_per_cell"] = round(density, 3)
+    sparse_threshold = CruiseControlConfig().get_double(
+        "solver.direct.density.sparse.threshold")
+    chosen = direct_goal_choice(density, sparse_threshold)
+    path_choice = {g: ("direct" if chosen is None or g in chosen
+                       else "greedy") for g in count_goals}
 
     def arm(enabled: bool):
         cfg = CruiseControlConfig({
@@ -1287,6 +1532,8 @@ def _run_transport_stage(progress: dict) -> dict:
         "extras": {
             "brokers": b, "partitions": p, "topics": TRANSPORT_TOPICS,
             "replicas_per_cell": round(density, 3),
+            "sparse_threshold": sparse_threshold,
+            "density_path_choice": path_choice,
             "canary": canary,
             "tr_wall_greedy_s": tr["greedy_s"],
             "tr_wall_direct_s": tr["direct_s"],
@@ -2526,6 +2773,11 @@ def _run_redteam_stage(progress: dict, budget_s: float | None = None) -> dict:
 
 
 def main() -> int:
+    if FLEETSHARD_CHILD:
+        # The --fleet-shard subprocess body: no watchdog, no device
+        # probe — the parent owns the budget and set the env (JAX must
+        # init from the forced-device-count XLA_FLAGS untouched).
+        return _run_fleet_shard_child()
     deadline = time.time() + BUDGET_S
     # Two-tier watchdog: SIGALRM interrupts Python-level code gracefully,
     # but a wedged TPU call blocks inside native code where the handler
@@ -2616,6 +2868,32 @@ def _guarded_main(deadline: float) -> int:
             _emit({"metric": "stage_failed", "value": 0.0, "unit": "s",
                    "vs_baseline": 0.0,
                    "extras": {"stage": "fleet_megabatch",
+                              "error": f"{type(e).__name__}: {e}"[:500]}})
+        return 0
+    if FLEETSHARD_MODE:
+        _emit({"metric": "bench_bootstrap",
+               "value": round(time.time() - t0, 3), "unit": "s",
+               "vs_baseline": 1.0,
+               "extras": {"device": device, "num_devices": n_dev,
+                          "mode": "fleet_shard",
+                          "virtual_devices": FLEETSHARD_DEVICES,
+                          "clusters": FLEETSHARD_CLUSTERS,
+                          "per_device_occupancy": FLEETSHARD_OCCUPANCY,
+                          "compile_cache_dir": cache_dir,
+                          "stderr_file": _stderr_path}})
+        try:
+            record = _run_fleet_shard_stage(
+                {}, budget_s=deadline - time.time() - 30.0)
+            _emit(record)
+            baseline = load_baseline()
+            if baseline is not None:
+                verdict = compare_stage_to_baseline(record, baseline)
+                if verdict is not None:
+                    _emit(verdict)
+        except Exception as e:  # noqa: BLE001 — parseable record always
+            _emit({"metric": "stage_failed", "value": 0.0, "unit": "s",
+                   "vs_baseline": 0.0,
+                   "extras": {"stage": "fleet_shard",
                               "error": f"{type(e).__name__}: {e}"[:500]}})
         return 0
     if FUTURES_MODE:
@@ -3243,6 +3521,46 @@ def _guarded_main(deadline: float) -> int:
         _emit({"metric": "stage_partial_redteam_mine",
                "value": 0.0, "unit": "s", "vs_baseline": 0.0,
                "extras": {"stage": "redteam_mine", "partial": True,
+                          "skipped": True, "reason": "budget exhausted"}})
+    # The fleet-shard stage rides every default pass too (round 23): the
+    # CI FLEET_SHARD row sees the N-virtual-device clusters/s scaling
+    # and the cross-arm byte-parity pin per PR without a separate
+    # invocation (the measurement itself lives in a fresh subprocess —
+    # the forced host device count is a process-level XLA init flag).
+    remaining = deadline - time.time()
+    if remaining > 120:
+        progress = {}
+        t0 = time.time()
+        stage_budget = min(remaining - 15.0, 420.0)
+        signal.alarm(max(1, int(stage_budget)))
+        try:
+            record = _run_fleet_shard_stage(progress,
+                                            budget_s=stage_budget - 10.0)
+            signal.alarm(0)
+            _emit(record)
+            if baseline is not None:
+                verdict = compare_stage_to_baseline(record, baseline)
+                if verdict is not None:
+                    sentry_verdicts.append(verdict)
+                    _emit(verdict)
+        except _Watchdog:
+            _emit({"metric": "stage_partial_fleet_shard",
+                   "value": round(time.time() - t0, 3), "unit": "s",
+                   "vs_baseline": 0.0,
+                   "extras": {"stage": "fleet_shard", "partial": True,
+                              **progress}})
+        except Exception as e:  # noqa: BLE001 — parseable record always
+            _emit({"metric": "stage_failed", "value": round(
+                time.time() - t0, 3), "unit": "s", "vs_baseline": 0.0,
+                "extras": {"stage": "fleet_shard",
+                           "error": f"{type(e).__name__}: {e}"[:500],
+                           **progress}})
+        finally:
+            signal.alarm(0)
+    else:
+        _emit({"metric": "stage_partial_fleet_shard",
+               "value": 0.0, "unit": "s", "vs_baseline": 0.0,
+               "extras": {"stage": "fleet_shard", "partial": True,
                           "skipped": True, "reason": "budget exhausted"}})
     _emit_sentry_summary(sentry_verdicts, baseline)
     _dump_flight_recorder()

@@ -968,6 +968,15 @@ class MegastepConfig:
       time) into the rounding seed (``solver.direct.sparse.rounding.salt``)
       so fleets can decorrelate rounding replays; "" keeps the module
       default seed.
+    - ``direct_goals``: per-goal density-aware path CHOICE (ROADMAP 2d).
+      ``None`` routes every direct-eligible goal through the transport
+      kernel (today's behavior); a tuple restricts it to the NAMED goals,
+      the rest taking the greedy arm even when eligible. The optimizer
+      resolves this from replica density: at sparse geometry
+      Replica/LeaderReplica are measurably faster under greedy while TR
+      wins under direct+polish (the documented honest negative), so
+      below ``solver.direct.density.sparse.threshold`` only TR keeps the
+      direct arm.
     """
 
     donate: bool = True
@@ -977,6 +986,16 @@ class MegastepConfig:
     direct_max_sweeps: int = 16
     direct_sparse_margin: float = 0.25
     direct_sparse_salt: str = ""
+    direct_goals: "tuple[str, ...] | None" = None
+
+
+def direct_path_chosen(megastep: "MegastepConfig", goal_name: str) -> bool:
+    """Whether the per-goal density-aware choice keeps the direct arm for
+    this goal (None = all direct-eligible goals, the pre-choice
+    behavior). The eligibility guard (``direct.direct_eligible``) still
+    applies on top — this only narrows it."""
+    return (megastep.direct_goals is None
+            or goal_name in megastep.direct_goals)
 
 
 def donation_enabled(megastep: "MegastepConfig | None") -> bool:
@@ -1626,6 +1645,7 @@ def optimize_goal_in_chain_megabatch(states: ClusterTensors,
                                      donate_input: bool = False,
                                      entry_stats: tuple | None = None,
                                      drain_hint=None,
+                                     mesh=None,
                                      ) -> tuple[ClusterTensors, list[dict]]:
     """Run goal ``chain[index]`` for EVERY cluster in a megabatch under
     the acceptance of ``chain[:index]`` — the batched twin of
@@ -1653,6 +1673,14 @@ def optimize_goal_in_chain_megabatch(states: ClusterTensors,
     goal the snapshot shows inactive for EVERY cluster consumes zero
     batched dispatches.
 
+    ``mesh`` (round 23): a 1-D device mesh routes every batched kernel
+    through its shard_map twin (parallel.megabatch_sharded) — the
+    cluster axis splits ``batch_width / n_devices`` slots per device,
+    everything else (this whole host loop, the pump, the donation guard)
+    is unchanged because the sharded wrappers are call-compatible. The
+    caller must have placed ``states``/``masks`` on the mesh and padded
+    the batch to a device multiple.
+
     Returns (states, [per-cluster info dict])."""
     import numpy as np
     goals = tuple(chain)
@@ -1663,14 +1691,33 @@ def optimize_goal_in_chain_megabatch(states: ClusterTensors,
     cluster_mask = np.asarray(cluster_mask).astype(bool)
     assert dispatch_rounds > 0, "megabatch requires the bounded path"
 
+    # Resolve the kernel family ONCE (single-path code below): either the
+    # single-device jitted megabatch kernels or their sharded twins with
+    # the mesh bound in. Lazy import — analyzer must not depend on
+    # parallel at module load.
+    if mesh is not None:
+        from ..parallel import megabatch_sharded as _mbs
+        mb_stats = partial(_mbs.megabatch_goal_stats_sharded, mesh)
+        mb_move = partial(_mbs.megabatch_optimize_rounds_sharded, mesh)
+        mb_move_don = partial(
+            _mbs.megabatch_optimize_rounds_donated_sharded, mesh)
+        mb_swap = partial(_mbs.megabatch_swap_rounds_sharded, mesh)
+        mb_swap_don = partial(
+            _mbs.megabatch_swap_rounds_donated_sharded, mesh)
+    else:
+        mb_stats = megabatch_goal_stats
+        mb_move = megabatch_optimize_rounds
+        mb_move_don = megabatch_optimize_rounds_donated
+        mb_swap = megabatch_swap_rounds
+        mb_swap_don = megabatch_swap_rounds_donated
+
     if entry_stats is not None:
         viol0, obj0, off0 = (np.asarray(entry_stats[0]),
                              np.asarray(entry_stats[1]),
                              np.asarray(entry_stats[2]))
     else:
-        viol0_d, obj0_d, off0_d = megabatch_goal_stats(states, idx, goals,
-                                                       constraint,
-                                                       num_topics, masks)
+        viol0_d, obj0_d, off0_d = mb_stats(states, idx, goals, constraint,
+                                           num_topics, masks)
         viol0 = np.asarray(viol0_d)
         obj0 = np.asarray(obj0_d)
         off0 = np.asarray(off0_d)
@@ -1722,26 +1769,26 @@ def optimize_goal_in_chain_megabatch(states: ClusterTensors,
                                          st.assignment.dtype),
                     leader_slot=jnp.zeros((c, 0), st.leader_slot.dtype))
                 if phase == "move":
-                    out = megabatch_optimize_rounds_donated(
+                    out = mb_move_don(
                         st.assignment, st.leader_slot, rest, active, idx,
                         prior, goals, constraint, cfg, num_topics, masks,
                         b, ring_rounds=ring_n)
                     a, l, applied, r, act = out[:5]
                     ring = out[5] if ring_n > 0 else None
                 else:
-                    a, l, applied, r, act = megabatch_swap_rounds_donated(
+                    a, l, applied, r, act = mb_swap_don(
                         st.assignment, st.leader_slot, rest, active, idx,
                         prior, goals, constraint, num_topics, masks, 8,
                         64, b)
                 st = dataclasses.replace(st, assignment=a, leader_slot=l)
             elif phase == "move":
-                out = megabatch_optimize_rounds(
+                out = mb_move(
                     st, active, idx, prior, goals, constraint, cfg,
                     num_topics, masks, b, ring_rounds=ring_n)
                 st, applied, r, act = out[:4]
                 ring = out[4] if ring_n > 0 else None
             else:
-                st, applied, r, act = megabatch_swap_rounds(
+                st, applied, r, act = mb_swap(
                     st, active, idx, prior, goals, constraint, num_topics,
                     masks, 8, 64, b)
             can_donate[0] = True
@@ -1762,16 +1809,23 @@ def optimize_goal_in_chain_megabatch(states: ClusterTensors,
     # program compiles once per bucket shape, like every other megabatch
     # kernel.
     use_direct = False
-    if megastep.direct_assignment:
+    if megastep.direct_assignment and direct_path_chosen(megastep,
+                                                         goal.name):
         from .direct import direct_eligible
         use_direct = direct_eligible(goals, index)
     direct_active = ran & (off0 == 0) & ~drain & (viol0 > 0)
     if use_direct and direct_active.any():
-        from .direct import (
-            megabatch_direct_rounds, megabatch_direct_rounds_donated,
-            sparse_rounding_seed,
-        )
+        from .direct import sparse_rounding_seed
         from ..utils.sensors import SENSORS
+        if mesh is not None:
+            mb_direct = partial(_mbs.megabatch_direct_rounds_sharded, mesh)
+            mb_direct_don = partial(
+                _mbs.megabatch_direct_rounds_donated_sharded, mesh)
+        else:
+            from .direct import megabatch_direct_rounds as mb_direct
+            from .direct import (
+                megabatch_direct_rounds_donated as mb_direct_don,
+            )
         active0 = jnp.asarray(direct_active)
         t0 = _time.monotonic()
         if donate:
@@ -1784,7 +1838,7 @@ def optimize_goal_in_chain_megabatch(states: ClusterTensors,
                 assignment=jnp.zeros((c, 0, states.assignment.shape[2]),
                                      states.assignment.dtype),
                 leader_slot=jnp.zeros((c, 0), states.leader_slot.dtype))
-            a, l, mv, sw, _act = megabatch_direct_rounds_donated(
+            a, l, mv, sw, _act = mb_direct_don(
                 states.assignment, states.leader_slot, rest, active0,
                 goals, index, constraint, num_topics, masks,
                 megastep.direct_max_sweeps,
@@ -1794,7 +1848,7 @@ def optimize_goal_in_chain_megabatch(states: ClusterTensors,
                                          leader_slot=l)
             can_donate[0] = True
         else:
-            states, mv, sw, _act = megabatch_direct_rounds(
+            states, mv, sw, _act = mb_direct(
                 states, active0, goals, index, constraint, num_topics,
                 masks, megastep.direct_max_sweeps,
                 margin_frac=megastep.direct_sparse_margin,
@@ -1847,7 +1901,7 @@ def optimize_goal_in_chain_megabatch(states: ClusterTensors,
         alive = participate & (swapped > 0)
 
     if ran.any():
-        viol1_d, obj1_d, off1_d = megabatch_goal_stats(
+        viol1_d, obj1_d, off1_d = mb_stats(
             states, idx, goals, constraint, num_topics, masks)
         viol1 = np.asarray(viol1_d)
         obj1 = np.asarray(obj1_d)
@@ -2008,6 +2062,7 @@ def optimize_goal_in_chain(state: ClusterTensors, chain: Sequence[Goal],
     # same pause rule as the targeted-destination column.
     use_direct = False
     if bounded and megastep is not None and megastep.direct_assignment \
+            and direct_path_chosen(megastep, goal.name) \
             and int(offline0) == 0 and not drain:
         from .direct import direct_eligible
         use_direct = direct_eligible(goals, index)

@@ -143,6 +143,36 @@ def _record_goal_spans(tracer, goal_results: Sequence[GoalResult],
             apportioned=True)
 
 
+# Goals whose direct-transport arm stays ahead of greedy even at sparse
+# geometry (bench --transport, ROADMAP 2d): TR's [T, B] cell plane keeps
+# enough surplus per cell for the fractional plan to pay for itself,
+# while Replica/LeaderReplica at the same density solve faster under
+# deficit-sized greedy (the documented honest negative — 2 reverts).
+_SPARSE_DIRECT_GOALS = ("TopicReplicaDistributionGoal",)
+
+
+def replica_density(state, num_topics: int) -> float:
+    """Replicas per (topic, broker) transport cell — the geometry that
+    decides the per-goal direct-vs-greedy choice. The transport plans
+    shed/fill whole cells; below ~2 replicas/cell most cells cannot
+    donate without emptying, so count goals spend their sweeps on
+    stranded movers that greedy would simply route around."""
+    cells = max(1, int(num_topics) * int(state.num_brokers))
+    slots = int(state.assignment.shape[-1])
+    return float(int(state.num_partitions) * slots) / float(cells)
+
+
+def direct_goal_choice(density: float,
+                       threshold: float) -> "tuple[str, ...] | None":
+    """Per-goal density-aware path choice (ROADMAP 2d): None = every
+    direct-eligible goal keeps the direct arm (dense regime / choice
+    disabled); at sparse geometry only ``_SPARSE_DIRECT_GOALS`` keep it
+    and the rest take deficit-sized greedy."""
+    if threshold <= 0 or density >= threshold:
+        return None
+    return _SPARSE_DIRECT_GOALS
+
+
 class GoalOptimizer:
     """Facade over the batched chain search (GoalOptimizer.java:65).
 
@@ -187,6 +217,12 @@ class GoalOptimizer:
             "solver.direct.sparse.margin.frac")
         self._direct_sparse_salt = self._config.get_string(
             "solver.direct.sparse.rounding.salt")
+        self._direct_sparse_threshold = self._config.get_double(
+            "solver.direct.density.sparse.threshold")
+        # Device-sharded megabatch (round 23): shard the CLUSTER axis of
+        # fleet solves across the mesh when one is attached.
+        self._shard_enabled = self._config.get_boolean(
+            "fleet.shard.enabled")
         # Fingerprint goal skipping (round 18): ONE batched stats program
         # snapshots every goal's entry violation before the bounded
         # per-goal loop; goals with nothing to do consume zero dispatches
@@ -276,7 +312,8 @@ class GoalOptimizer:
         last = getattr(self._tls, "last_pass", None)
         return last[1].as_dict() if last else {}
 
-    def _controller_pair(self, state: ClusterTensors, batch: int = 0):
+    def _controller_pair(self, state: ClusterTensors, batch: int = 0,
+                         devices: int = 1):
         """(narrow, wide) persistent AdaptiveDispatch pair for this model
         shape (created on first use; lock-guarded — facade request
         threads and the fleet worker may solve concurrently).
@@ -286,6 +323,12 @@ class GoalOptimizer:
         so the budget learned on solo solves of this shape must not carry
         onto the first 8-wide fleet dispatch (and vice versa) — same
         cost-class discipline as the narrow/wide split.
+
+        ``devices`` keys the mesh size of the SHARDED megabatch (round
+        23): a width-64 batch over 4 devices costs a width-16 round per
+        step, not a width-64 one, so its budget must not mix with the
+        single-device batch=64 controller's (the controller-keying
+        contract in DESIGN.md).
 
         Only the dict lookup is locked: the controllers themselves are
         deliberately unsynchronized. Two same-shape solves running
@@ -298,7 +341,7 @@ class GoalOptimizer:
         would serialize readbacks across solves on the hot path to
         protect a heuristic."""
         from .chain import AdaptiveDispatch
-        key = (state.num_partitions, state.num_brokers, batch)
+        key = (state.num_partitions, state.num_brokers, batch, devices)
         # ccsa: ok[CCSA007] PR 5 tolerance, machine-readable: registry
         # lookups locked below; the AdaptiveDispatch values are
         # deliberately unsynchronized — bounded (k stays in [1, max]),
@@ -313,14 +356,25 @@ class GoalOptimizer:
                 self._controllers[key] = pair
         return pair
 
-    def _megastep_config(self, num_brokers: int):
+    def _megastep_config(self, num_brokers: int,
+                         density: "float | None" = None):
         """Resolve the megastep knobs for one pass. Deficit-aware count-
         goal sizing shares the wide-batch regime gate: below it the fused
         whole-chain kernel is the production path and the bounded drivers
-        must walk its exact trajectory (the cross-path parity contract)."""
+        must walk its exact trajectory (the cross-path parity contract).
+
+        ``density`` (ROADMAP 2d, round 23): the model's replica density —
+        below ``solver.direct.density.sparse.threshold`` the per-goal
+        path choice keeps the direct arm only for the goals measured
+        faster there (see ``direct_goal_choice``). None skips the choice
+        (all direct-eligible goals take the direct arm)."""
         from .chain import MegastepConfig
         threshold = self._config.get_int("solver.wide.batch.min.brokers")
         in_regime = threshold > 0 and num_brokers >= threshold
+        chosen = None
+        if density is not None:
+            chosen = direct_goal_choice(density,
+                                        self._direct_sparse_threshold)
         return MegastepConfig(
             donate=self._megastep_donate,
             async_readback=self._async_readback,
@@ -331,7 +385,8 @@ class GoalOptimizer:
             direct_assignment=self._direct_enabled and in_regime,
             direct_max_sweeps=self._direct_max_sweeps,
             direct_sparse_margin=self._direct_sparse_margin,
-            direct_sparse_salt=self._direct_sparse_salt)
+            direct_sparse_salt=self._direct_sparse_salt,
+            direct_goals=chosen)
 
     def deficit_sizing_active(self, num_brokers: int) -> bool:
         """Whether a SERIAL solve of this broker count would run
@@ -591,7 +646,9 @@ class GoalOptimizer:
         self._dispatch_stats = stats
         self._pass_seq += 1
         self._tls.last_pass = (self._pass_seq, stats)
-        megastep = self._megastep_config(state.num_brokers)
+        megastep = self._megastep_config(
+            state.num_brokers,
+            density=replica_density(state, meta.num_topics))
 
         mesh = self._mesh
         if mesh is not None and state.num_partitions % mesh.devices.size != 0:
@@ -922,7 +979,18 @@ class GoalOptimizer:
             [self._masks(st, m, o)
              for st, m, o in zip(states, metas, opts_list)])
 
+        # Device-sharded megabatch (round 23): with a mesh attached (and
+        # fleet.shard.enabled) the CLUSTER axis shards across it —
+        # c/ndev slots per device, batch width padded to a device
+        # multiple with the same inert slots that pad occupancy (the
+        # fleet/bucketing.py append-only geometry: pow2 steps of the
+        # device count, so the compiled-shape set stays bounded).
+        mesh = self._mesh if self._shard_enabled else None
+        ndev = int(mesh.devices.size) if mesh is not None else 1
         c = max(n, int(width) or n)
+        if mesh is not None:
+            from ..fleet.bucketing import geometric_round_up
+            c = geometric_round_up(c, ndev, 2.0)
         pad = c - n
         if pad:
             inert = inert_state_like(states[0])
@@ -945,9 +1013,12 @@ class GoalOptimizer:
         state0 = items[0][0]
         search_cfg = self.search_config(state0)
         megastep = dataclasses.replace(
-            self._megastep_config(state0.num_brokers), deficit_moves_cap=0)
+            self._megastep_config(
+                state0.num_brokers,
+                density=replica_density(state0, num_topics)),
+            deficit_moves_cap=0)
         dispatch_rounds = max(1, self._dispatch_rounds)
-        ctl_pair = self._controller_pair(state0, batch=c)
+        ctl_pair = self._controller_pair(state0, batch=c, devices=ndev)
         wide_cfg = self._wide_config(search_cfg, goal_chain,
                                      state0.num_brokers)
 
@@ -957,6 +1028,13 @@ class GoalOptimizer:
         t_start = time.time()
 
         batched = stack_states(states)
+        if mesh is not None:
+            from ..parallel.megabatch_sharded import (
+                shard_megabatch, shard_megabatch_masks,
+            )
+            batched = shard_megabatch(batched, mesh)
+            batched_masks = shard_megabatch_masks(batched_masks, mesh)
+        self._devices_used = ndev
         initial_states = true_initials
         stats_before = [cluster_stats(st) for st in initial_states]
         self._maybe_record_shape(states[0], metas[0], goal_chain,
@@ -973,9 +1051,17 @@ class GoalOptimizer:
             from .chain import (
                 excluded_hosting_replicas, megabatch_all_goal_stats,
             )
-            av, ao, aoff = megabatch_all_goal_stats(
-                batched, tuple(goal_chain), self._constraint, num_topics,
-                batched_masks)
+            if mesh is not None:
+                from ..parallel.megabatch_sharded import (
+                    megabatch_all_goal_stats_sharded,
+                )
+                av, ao, aoff = megabatch_all_goal_stats_sharded(
+                    mesh, batched, tuple(goal_chain), self._constraint,
+                    num_topics, batched_masks)
+            else:
+                av, ao, aoff = megabatch_all_goal_stats(
+                    batched, tuple(goal_chain), self._constraint,
+                    num_topics, batched_masks)
             hint = (np.asarray(av), np.asarray(ao), np.asarray(aoff))
             if batched_masks.excluded_replica_move_brokers is not None:
                 hint_drain = np.asarray(jax.vmap(excluded_hosting_replicas)(
@@ -1032,7 +1118,7 @@ class GoalOptimizer:
                         donate_input=chain_owns_state,
                         entry_stats=entry,
                         drain_hint=hint_drain if entry is not None
-                        else None)
+                        else None, mesh=mesh)
                     chain_owns_state |= any(
                         info["rounds"] > 0 or info.get("direct_sweeps", 0) > 0
                         for info in infos)
@@ -1173,13 +1259,14 @@ class GoalOptimizer:
         persistent compile cache enabled the XLA backend artifacts also
         land on disk, so the NEXT restart retrieves instead of compiling.
         Returns False when the entry is not reproducible here (unknown
-        goal spec, or a megabatch entry under a mesh) — never raises for
+        goal spec, or a mesh shape mismatch) — never raises for
         a merely mismatched entry; kernel failures propagate to the
         prewarm manager, which records and continues. Bound-state goal
         chains (e.g. broker-set mappings) rebuild from their signature
         specs, and mesh-sharded optimizers warm the sharded chain
         programs (_prewarm_shape_sharded) — both round-18 gaps closed in
-        round 20."""
+        round 20; round 23 extends the mesh path to megabatch entries
+        (the sharded megabatch chain)."""
         import jax
         from ..utils.flight_recorder import FLIGHT
         from ..warmstart import synthetic_masks, synthetic_state
@@ -1327,12 +1414,17 @@ class GoalOptimizer:
         fused scale, the per-goal phase kernels (donated or plain,
         matching the megastep donation mode) past fused.max.brokers,
         mirroring ``_optimize``'s mesh-branch selection exactly.
-        Megabatch entries (batch > 0) are single-device machinery and
-        stay unreproducible under the mesh, as are shapes whose
-        partition axis does not divide the mesh (the _optimize fallback
-        would run them single-device anyway). Deficit-sized wide kernels
-        still compile lazily at their pow2-quantized widths — sizing
-        depends on live violation counts no signature can know."""
+        Megabatch entries (batch > 0) warm the SHARDED megabatch chain
+        (round 23, closing the round-20 "single-device machinery" gap):
+        the stacked synthetic batch is placed cluster-axis-sharded and
+        the shard_map stats/move/swap twins run at zero budget — the
+        batch must be a device multiple (optimizations_megabatch pads
+        real batches to one, so recorded signatures already are).
+        Non-batch shapes whose partition axis does not divide the mesh
+        stay unreproducible (the _optimize fallback would run them
+        single-device anyway). Deficit-sized wide kernels still compile
+        lazily at their pow2-quantized widths — sizing depends on live
+        violation counts no signature can know."""
         import jax
 
         from ..parallel import shard_cluster
@@ -1342,14 +1434,18 @@ class GoalOptimizer:
         from ..warmstart import synthetic_masks, synthetic_state
         from .chain import donation_enabled, strip_mutable
         mesh = self._mesh
-        if int(entry.get("batch") or 0) > 0:
-            return False
         state = synthetic_state(entry)
-        if state.num_partitions % mesh.devices.size != 0:
-            return False
         masks = synthetic_masks(entry)
         num_topics = int(entry["num_topics"])
         cfg = self.search_config(state)
+        batch = int(entry.get("batch") or 0)
+        if batch > 0:
+            if not self._shard_enabled or batch % mesh.devices.size != 0:
+                return False
+            return self._prewarm_megabatch_sharded(
+                entry, goals, state, masks, num_topics, batch, cfg)
+        if state.num_partitions % mesh.devices.size != 0:
+            return False
         presence = (masks.excluded_topics is not None,
                     masks.excluded_replica_move_brokers is not None,
                     masks.excluded_leadership_brokers is not None)
@@ -1389,6 +1485,89 @@ class GoalOptimizer:
         else:
             wait(move(sharded, masks, idx, prior, zero))
             wait(swap(sharded, masks, idx, prior, zero))
+        return True
+
+    def _prewarm_megabatch_sharded(self, entry: dict, goals: tuple,
+                                   state, masks, num_topics: int,
+                                   batch: int, cfg) -> bool:
+        """Warm the device-sharded megabatch kernel set for one recorded
+        (shape, batch) signature: the mirror of ``prewarm_shape``'s
+        batch > 0 block with every kernel routed through its shard_map
+        twin, so a fresh fleet replica's first batched solve hits warm
+        dispatch caches (and, with the persistent compile cache, warm
+        XLA artifacts) at the mesh size it will actually run."""
+        import jax
+
+        from ..parallel.megabatch_sharded import (
+            megabatch_all_goal_stats_sharded, megabatch_goal_stats_sharded,
+            megabatch_optimize_rounds_donated_sharded,
+            megabatch_optimize_rounds_sharded,
+            megabatch_swap_rounds_donated_sharded,
+            megabatch_swap_rounds_sharded, shard_megabatch,
+            shard_megabatch_masks,
+        )
+        from ..utils.flight_recorder import FLIGHT
+        from .chain import donation_enabled, stack_states
+        mesh = self._mesh
+        constraint = self._constraint
+        megastep = self._megastep_config(state.num_brokers)
+        donate = donation_enabled(megastep)
+        ring_n = FLIGHT.ring_rounds if FLIGHT.enabled else 0
+        wide_cfg = self._wide_config(cfg, goals, state.num_brokers)
+        idx = jnp.int32(0)
+        prior = jnp.asarray([False] * len(goals))
+        zero = jnp.int32(0)
+
+        def wait(out):
+            jax.tree.map(lambda x: x.block_until_ready()
+                         if hasattr(x, "block_until_ready") else x, out)
+
+        batched = shard_megabatch(stack_states([state] * batch), mesh)
+        bmasks = shard_megabatch_masks(ExclusionMasks(*(
+            None if f is None else jnp.stack([f] * batch)
+            for f in (masks.excluded_topics,
+                      masks.excluded_replica_move_brokers,
+                      masks.excluded_leadership_brokers))), mesh)
+        active = jnp.zeros((batch,), bool)
+        if self._fingerprint_skip:
+            wait(megabatch_all_goal_stats_sharded(
+                mesh, batched, goals, constraint, num_topics, bmasks))
+        wait(megabatch_goal_stats_sharded(mesh, batched, idx, goals,
+                                          constraint, num_topics, bmasks))
+        for c in [cfg] + ([wide_cfg] if wide_cfg else []):
+            if donate:
+                rest = dataclasses.replace(
+                    batched,
+                    assignment=jnp.zeros(
+                        (batch, 0, batched.assignment.shape[2]),
+                        batched.assignment.dtype),
+                    leader_slot=jnp.zeros((batch, 0),
+                                          batched.leader_slot.dtype))
+                wait(megabatch_optimize_rounds_donated_sharded(
+                    mesh, jnp.copy(batched.assignment),
+                    jnp.copy(batched.leader_slot), rest, active, idx,
+                    prior, goals, constraint, c, num_topics, bmasks,
+                    zero, ring_rounds=ring_n))
+            else:
+                wait(megabatch_optimize_rounds_sharded(
+                    mesh, batched, active, idx, prior, goals, constraint,
+                    c, num_topics, bmasks, zero, ring_rounds=ring_n))
+        if donate:
+            rest = dataclasses.replace(
+                batched,
+                assignment=jnp.zeros(
+                    (batch, 0, batched.assignment.shape[2]),
+                    batched.assignment.dtype),
+                leader_slot=jnp.zeros((batch, 0),
+                                      batched.leader_slot.dtype))
+            wait(megabatch_swap_rounds_donated_sharded(
+                mesh, jnp.copy(batched.assignment),
+                jnp.copy(batched.leader_slot), rest, active, idx, prior,
+                goals, constraint, num_topics, bmasks, 8, 64, zero))
+        else:
+            wait(megabatch_swap_rounds_sharded(
+                mesh, batched, active, idx, prior, goals, constraint,
+                num_topics, bmasks, 8, 64, zero))
         return True
 
     @staticmethod

@@ -415,6 +415,16 @@ def _definition() -> ConfigDef:
              "configuration (the CCSA004 contract); fleets set distinct "
              "salts to decorrelate rounding across replicas without "
              "giving up determinism within each.")
+    d.define("solver.direct.density.sparse.threshold", T.DOUBLE, 2.0,
+             Range.at_least(0.0), I.LOW,
+             "Per-goal density-aware path choice (round 23, ROADMAP 2d): "
+             "below this many replicas per (topic, broker) transport "
+             "cell, only the goals measured faster under direct at "
+             "sparse geometry (TopicReplicaDistribution) keep the "
+             "direct-transport arm; Replica/LeaderReplica take "
+             "deficit-sized greedy there (the documented honest "
+             "negative). At or above the threshold every direct-eligible "
+             "goal keeps the direct arm. 0 disables the choice.")
     d.define("solver.fingerprint.skip.enabled", T.BOOLEAN, True, None, I.LOW,
              "Always-hot solver (round 18): snapshot EVERY goal's entry "
              "violation in ONE batched stats program before the bounded "
@@ -585,6 +595,24 @@ def _definition() -> ConfigDef:
              "bucket shape serves any occupancy (occupancy is traced, "
              "never a new compile). More queued compatibles than the "
              "width split into multiple batches.")
+    d.define("fleet.shard.enabled", T.BOOLEAN, True, None, I.MEDIUM,
+             "Device-sharded megabatch (round 23): with a device mesh "
+             "attached, shard the megabatch CLUSTER axis across it — "
+             "batch_width / n_devices cluster slots per device, each "
+             "device early-exiting on its own shard's convergence, "
+             "per-cluster results byte-identical to the single-device "
+             "megabatch. Disabled (or single-device), batched solves "
+             "run on one device as in round 14.")
+    d.define("fleet.shard.workers", T.INT, 1, Range.at_least(1), I.MEDIUM,
+             "Multi-replica control plane (round 23): number of fleet "
+             "solver worker threads sharing the scheduler queue, the "
+             "persistent AOT cache, and the shape registry. Placement "
+             "is bucket-affine (a batch key sticks to the worker that "
+             "first solved it, keeping its compiled programs hot) with "
+             "work-stealing: overdue jobs (past the starvation bound) "
+             "and idle workers steal across affinity, so the starvation "
+             "bound holds fleet-wide. 1 = the single-worker round-6..22 "
+             "behavior, byte-identical.")
     d.define("serving.task.queue.viewer.capacity", T.INT, 64,
              Range.at_least(1), I.LOW,
              "Serving front door (round 20): bound on QUEUED "

@@ -1,12 +1,26 @@
-"""Fair solver-work scheduler: one device, many clusters.
+"""Fair solver-work scheduler: one device (or mesh), many clusters.
 
-All solver work in a fleet funnels through ONE device (or mesh); this
-scheduler decides whose work runs next. Three priority classes —
-self-healing > expiring proposal cache > on-demand requests — with
-round-robin fairness ACROSS clusters inside each class, and a
-starvation bound: any job that has waited longer than the bound runs
-next regardless of class, oldest first, so a cluster flooding a higher
-class can delay but never indefinitely starve another cluster's work.
+All solver work in a fleet funnels through this scheduler, which
+decides whose work runs next. Three priority classes — self-healing >
+expiring proposal cache > on-demand requests — with round-robin
+fairness ACROSS clusters inside each class, and a starvation bound:
+any job that has waited longer than the bound runs next regardless of
+class, oldest first, so a cluster flooding a higher class can delay
+but never indefinitely starve another cluster's work.
+
+Multi-replica control plane (round 23, ``fleet.shard.workers``): N
+solver worker threads drain the SAME queue, sharing the process's
+persistent AOT cache and shape registry (both are process-global — a
+program any worker compiles is warm for all). Placement is
+bucket-affine: the first worker to solve a batch key becomes its home,
+so a bucket's compiled megabatch program stays hot on the replica that
+owns it instead of ping-ponging. Two forms of work-stealing keep the
+fairness contract fleet-wide: an OVERDUE job (past the starvation
+bound) is taken by whichever worker sees it first regardless of
+affinity — the bound is a promise to the cluster, not to a worker —
+and an otherwise-idle worker steals affined work rather than sit while
+another replica's queue is deep. ``workers=1`` is byte-identical to
+the single-worker scheduler of rounds 6-22.
 
 The reference has no analogue (one JVM per cluster = the OS scheduler);
 the closest relative is GoalOptimizer's proposal-precompute executor
@@ -73,18 +87,22 @@ class FleetScheduler:
     @classmethod
     def from_config(cls, config) -> "FleetScheduler":
         """Build with the configured starvation bound
-        (fleet.scheduler.starvation.bound.ms) and the per-cluster
-        circuit breaker (resilience.breaker.*)."""
+        (fleet.scheduler.starvation.bound.ms), worker replica count
+        (fleet.shard.workers) and the per-cluster circuit breaker
+        (resilience.breaker.*)."""
         return cls(
             starvation_bound_s=config.get_long(
                 "fleet.scheduler.starvation.bound.ms") / 1000.0,
-            breaker=CircuitBreaker.from_config(config, name="fleet"))
+            breaker=CircuitBreaker.from_config(config, name="fleet"),
+            workers=config.get_int("fleet.shard.workers"))
 
     def __init__(self, starvation_bound_s: float = 30.0,
                  clock: Callable[[], float] = time.monotonic,
-                 breaker: CircuitBreaker | None = None):
+                 breaker: CircuitBreaker | None = None,
+                 workers: int = 1):
         self._starvation_bound_s = starvation_bound_s
         self._clock = clock
+        self._workers_n = max(1, int(workers))
         # Per-cluster breaker (round 9): a cluster whose jobs keep
         # failing trips open and its queued work is SKIPPED (futures
         # fail fast with BreakerOpenError) instead of burning solver
@@ -100,6 +118,12 @@ class FleetScheduler:
         self._stop = threading.Event()
         self._shut = False
         self._worker: threading.Thread | None = None
+        self._solvers: list[threading.Thread] = []
+        # batch_key -> home worker id (round 23 bucket affinity): set by
+        # the first pick of a job carrying that key; later picks prefer
+        # the home worker so the bucket's compiled megabatch program
+        # stays hot there. Overdue jobs and idle workers steal across it.
+        self._affinity: dict[tuple, int] = {}
         self._pacer: threading.Thread | None = None
         self._registry = None
         self._jobs_run = 0
@@ -169,8 +193,9 @@ class FleetScheduler:
                        and (kind is None or j.kind == kind))
 
     # -- selection ---------------------------------------------------------
-    def _pick_locked(self) -> SolverJob | None:
-        """Next job under priority + fairness + the starvation bound.
+    def _pick_locked(self, worker_id: int = 0) -> SolverJob | None:
+        """Next job for ``worker_id`` under priority + fairness + the
+        starvation bound + bucket affinity (round 23).
         Caller holds the condition lock."""
         if self._queue and self._breaker is not None:
             # Skip (fail fast) queued jobs for open-breaker clusters —
@@ -201,28 +226,65 @@ class FleetScheduler:
         if not self._queue:
             return None
         now = self._clock()
+        stolen = False
         overdue = [j for j in self._queue
                    if now - j.enqueued_at >= self._starvation_bound_s]
         if overdue:
-            # The bound dominates everything: oldest overdue job first.
+            # The bound dominates everything — including affinity: the
+            # oldest overdue job runs on WHICHEVER worker sees it first
+            # (the bound is a promise to the cluster, not to a worker),
+            # so the starvation guarantee holds fleet-wide.
             job = min(overdue, key=lambda j: (j.enqueued_at, j.seq))
+            stolen = self._affined_elsewhere(job, worker_id)
         else:
             best_kind = min(j.kind for j in self._queue)
             in_class = [j for j in self._queue if j.kind == best_kind]
+            # Bucket affinity (round 23): prefer jobs homed on this
+            # worker or not yet homed; an idle worker STEALS an
+            # affined-elsewhere job rather than sit while another
+            # replica's share is deep (throughput over placement — the
+            # shared AOT cache makes a steal a cache miss, not a
+            # recompile).
+            mine = [j for j in in_class
+                    if not self._affined_elsewhere(j, worker_id)]
+            pool = mine or in_class
+            stolen = not mine
             # Round-robin by cluster: the cluster served longest ago goes
             # first; within a cluster, FIFO.
-            job = min(in_class, key=lambda j: (
+            job = min(pool, key=lambda j: (
                 self._last_served.get(j.cluster_id, 0), j.seq))
         self._queue.remove(job)
         self._picks += 1
         self._last_served[job.cluster_id] = self._picks
+        if job.batch_key is not None:
+            from ..utils.sensors import SENSORS
+            home = self._affinity.get(job.batch_key)
+            if home is None:
+                # First pick homes the bucket on this worker.
+                self._affinity[job.batch_key] = worker_id
+            elif home == worker_id:
+                SENSORS.count("fleet_shard_affinity_hits")
+            if stolen:
+                # A steal re-homes the bucket: the stealing worker's
+                # dispatch caches are now the warm ones.
+                self._affinity[job.batch_key] = worker_id
+                SENSORS.count("fleet_shard_steals")
         # Marked active HERE, under the same lock as the dequeue: a
         # pacer sweep must never observe the job as neither queued nor
         # active (the window between dequeue and execution).
         self._active.add((job.cluster_id, job.kind))
         return job
 
-    def _take_locked(self) -> list[SolverJob] | None:
+    def _affined_elsewhere(self, job: SolverJob, worker_id: int) -> bool:
+        """Whether the job's bucket is homed on a DIFFERENT worker (jobs
+        without a batch key are never affined — any worker serves
+        them)."""
+        if job.batch_key is None:
+            return False
+        home = self._affinity.get(job.batch_key)
+        return home is not None and home != worker_id
+
+    def _take_locked(self, worker_id: int = 0) -> list[SolverJob] | None:
         """Pick the next job, then — in coalescing mode — drain every
         queued job sharing its batch_key into one megabatch. The PICK is
         fairness's unit (priority, round-robin, starvation bound all
@@ -230,7 +292,7 @@ class FleetScheduler:
         coalesced cluster counts as served by this pick, so the
         round-robin cannot re-serve a freshly batched cluster ahead of
         one still waiting. Caller holds the condition lock."""
-        job = self._pick_locked()
+        job = self._pick_locked(worker_id)
         if job is None:
             return None
         batch = [job]
@@ -351,14 +413,17 @@ class FleetScheduler:
                              labels={"cluster": jobs[0].cluster_id,
                                      "kind": jobs[0].kind.name})
 
-    def run_pending(self, max_jobs: int | None = None) -> int:
+    def run_pending(self, max_jobs: int | None = None,
+                    worker_id: int = 0) -> int:
         """Synchronously drain queued jobs on the calling thread (the
         deterministic test driver; also usable by an embedder that wants
-        its own loop). Returns the number of jobs run."""
+        its own loop). ``worker_id`` is the replica identity used for
+        bucket affinity — tests drive multi-worker placement by calling
+        with different ids. Returns the number of jobs run."""
         ran = 0
         while max_jobs is None or ran < max_jobs:
             with self._cond:
-                batch = self._take_locked()
+                batch = self._take_locked(worker_id)
             if batch is None:
                 break
             if self._batch_runner is not None \
@@ -377,19 +442,28 @@ class FleetScheduler:
 
     def start(self, registry=None, pacer_interval_s: float = 1.0,
               pacer: bool = True) -> None:
-        """Start the worker thread; with a registry (or one already
-        bound), also the precompute pacer that keeps every unpaused
-        cluster's proposal cache warm at its configured cadence
-        (``pacer=False`` starts the worker alone)."""
+        """Start the solver worker thread(s) — ``fleet.shard.workers``
+        replicas draining the shared queue; with a registry (or one
+        already bound), also the precompute pacer that keeps every
+        unpaused cluster's proposal cache warm at its configured cadence
+        (``pacer=False`` starts the workers alone)."""
         registry = registry or self._registry
         self._registry = registry
         with self._cond:
             self._shut = False
-        if self._worker is None or not self._worker.is_alive():
+        if not any(t.is_alive() for t in self._solvers):
             self._stop.clear()
-            self._worker = threading.Thread(target=self._worker_loop,
-                                            daemon=True, name="fleet-solver")
-            self._worker.start()
+            self._solvers = [
+                threading.Thread(target=self._worker_loop, args=(i,),
+                                 daemon=True, name=f"fleet-solver-{i}")
+                for i in range(self._workers_n)]
+            for t in self._solvers:
+                t.start()
+            # ``_worker`` stays an alias of replica 0 for embedders that
+            # poke at the single-worker field directly.
+            self._worker = self._solvers[0]
+            from ..utils.sensors import SENSORS
+            SENSORS.gauge("fleet_shard_workers", self._workers_n)
         if pacer and registry is not None and (self._pacer is None
                                                or not self._pacer.is_alive()):
             self._pacer = threading.Thread(
@@ -397,10 +471,10 @@ class FleetScheduler:
                 daemon=True, name="fleet-precompute-pacer")
             self._pacer.start()
 
-    def _worker_loop(self) -> None:
+    def _worker_loop(self, worker_id: int = 0) -> None:
         while not self._stop.is_set():
             with self._cond:
-                batch = self._take_locked()
+                batch = self._take_locked(worker_id)
                 if batch is None:
                     self._cond.wait(timeout=0.2)
                     continue
@@ -553,9 +627,10 @@ class FleetScheduler:
         with self._cond:
             self._shut = True
             self._cond.notify_all()
-        for t in (self._worker, self._pacer):
+        for t in (*self._solvers, self._pacer):
             if t is not None and t.is_alive():
                 t.join(timeout=10.0)
+        self._solvers = []
         self._worker = self._pacer = None
         with self._cond:
             leftovers, self._queue = self._queue, []
@@ -582,6 +657,7 @@ class FleetScheduler:
 
     @property
     def running(self) -> bool:
-        """True while a worker thread is draining the queue (callers that
-        would block on a Future must run inline when nothing drains)."""
-        return self._worker is not None and self._worker.is_alive()
+        """True while any worker thread is draining the queue (callers
+        that would block on a Future must run inline when nothing
+        drains)."""
+        return any(t.is_alive() for t in self._solvers)

@@ -919,8 +919,8 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
     as donated megasteps with async stats readback per ``megastep``
     (analyzer.chain machinery, shared verbatim)."""
     from ..analyzer.chain import (
-        AdaptiveDispatch, deficit_sized_config, donation_enabled,
-        run_bounded_pass, strip_mutable,
+        AdaptiveDispatch, deficit_sized_config, direct_path_chosen,
+        donation_enabled, run_bounded_pass, strip_mutable,
     )
     from ..utils.flight_recorder import _NULL_PASS
     flight = flight if flight is not None else _NULL_PASS
@@ -1060,6 +1060,7 @@ def _optimize_chain_sharded_bounded(state, goals, constraint, cfg,
         # enabled kernel, guard-representable chain prefix, clean model —
         # offline replicas and drains keep the full greedy trajectory.
         use_direct = (direct_enabled and int(offline0) == 0 and not drain
+                      and direct_path_chosen(megastep, goal.name)
                       and direct_eligible(goals, g))
         sizing_viol = float(viol0)
         if ran and use_direct and float(viol0) > 0:

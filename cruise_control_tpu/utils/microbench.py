@@ -34,11 +34,16 @@ from functools import partial
 # sum onto the [G, B] count plane, the fractional-target systematic
 # rounding (hash uniforms + per-group cumsum diff), and the
 # stride-interleaved composite-key sort the mesh rank layout pays
-# instead of the plain segsort.
+# instead of the plain segsort. Round 23 adds the fused variant of that
+# sort: quantize the weight into the low bits of ONE composite integer
+# key so the interleave costs a single single-key sort frame instead of
+# two two-key frames — the candidate replacement the chip campaign
+# prices against ``stride_sort``.
 CASE_NAMES = ("topk128", "topk1024", "approx1024", "segsum", "segmax",
               "gather_grid", "scatter_m", "elemwise", "pairwise_m",
               "segsort", "rankfill", "scatter_apply",
-              "cell_segsum", "frac_round", "stride_sort")
+              "cell_segsum", "frac_round", "stride_sort",
+              "stride_sort_fused")
 
 
 def _build_cases(brokers: int, partitions: int):
@@ -172,6 +177,24 @@ def _build_cases(brokers: int, partitions: int):
                 gs, _gv, _gi = jax.lax.sort((gb, cv, ci), num_keys=2)
                 return v + cv * 1e-9 + (gs[:1] - gs[:1]).astype(v.dtype)
             return loop(bd, x, iters)
+        if which == "stride_sort_fused":
+            # Fused composite-key variant of stride_sort: the weight is
+            # quantized to 11 bits and packed under the (key·stride +
+            # block) composite, so ONE single-key sort frame yields the
+            # interleaved order — ties inside a quantization bucket
+            # break by index, which the solver tolerates (ordering
+            # within an epsilon band is already arbitrary).
+            stride = 8
+            idx = jnp.arange(n_flat, dtype=jnp.int32)
+            blk = idx % stride
+            ck = seg.astype(jnp.int32) * stride + blk
+
+            def bd(v):
+                q = (v * 1024.0).astype(jnp.int32)
+                fk = ck * 2048 + (q & 2047)
+                fs, fv, _fi = jax.lax.sort((fk, v, idx), num_keys=1)
+                return v + fv * 1e-9 + (fs[:1] - fs[:1]).astype(v.dtype)
+            return loop(bd, x, iters)
         if which == "scatter_apply":
             # one-shot scatter apply of a full mover batch onto [P, S].
             plane = jnp.zeros((partitions, s), jnp.int32)
@@ -191,7 +214,7 @@ def _build_cases(brokers: int, partitions: int):
               "segmax": w, "gather_grid": gscore, "scatter_m": loads,
               "elemwise": w, "pairwise_m": mvals, "segsort": w,
               "rankfill": w, "scatter_apply": w, "cell_segsum": w,
-              "frac_round": w, "stride_sort": w}
+              "frac_round": w, "stride_sort": w, "stride_sort_fused": w}
     return run, inputs
 
 

@@ -56,6 +56,7 @@ def _check_against_recompute(agg, state, num_topics):
                                rtol=1e-4, atol=1e-3)
 
 
+@pytest.mark.slow  # ~25 s: many-round carry-vs-recompute sweep; tier-2.
 def test_carry_tracks_moves_and_leadership(setup):
     """Rounds of the chain move body (replica moves + leadership transfers,
     goal switched mid-stream) keep the carry equal to the recompute."""

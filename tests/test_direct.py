@@ -568,3 +568,65 @@ def test_run_direct_pass_records_stats_and_flight():
     assert d["dispatches"][0]["kind"] == "direct"
     assert d["dispatches"][0]["rounds"] == sweeps
     assert d["dispatches"][0]["applied"] == moves
+
+
+# ---------------------------------------------------------------------------
+# Density-aware per-goal path choice (ROADMAP 2d, round 23)
+
+def test_replica_density_is_replicas_per_transport_cell():
+    from cruise_control_tpu.analyzer.optimizer import replica_density
+    state, meta = _cluster()
+    expect = (int(state.num_partitions) * int(state.assignment.shape[-1])
+              / (meta.num_topics * int(state.num_brokers)))
+    assert replica_density(state, meta.num_topics) == pytest.approx(expect)
+
+
+def test_direct_goal_choice_threshold_semantics():
+    from cruise_control_tpu.analyzer.optimizer import (
+        _SPARSE_DIRECT_GOALS, direct_goal_choice,
+    )
+    # Dense regime or disabled choice: every eligible goal stays direct.
+    assert direct_goal_choice(4.0, 2.0) is None
+    assert direct_goal_choice(2.0, 2.0) is None       # at-threshold = dense
+    assert direct_goal_choice(0.5, 0.0) is None       # threshold off
+    assert direct_goal_choice(0.5, -1.0) is None
+    # Sparse: only the goals measured faster on the direct arm keep it.
+    assert direct_goal_choice(1.5, 2.0) == _SPARSE_DIRECT_GOALS
+    assert "TopicReplicaDistributionGoal" in _SPARSE_DIRECT_GOALS
+
+
+def test_direct_path_chosen_gates_per_goal():
+    from cruise_control_tpu.analyzer.chain import direct_path_chosen
+    all_direct = MegastepConfig(direct_assignment=True)
+    assert direct_path_chosen(all_direct, "ReplicaDistributionGoal")
+    assert direct_path_chosen(all_direct, "TopicReplicaDistributionGoal")
+    sparse = MegastepConfig(direct_assignment=True,
+                            direct_goals=("TopicReplicaDistributionGoal",))
+    assert direct_path_chosen(sparse, "TopicReplicaDistributionGoal")
+    assert not direct_path_chosen(sparse, "ReplicaDistributionGoal")
+    assert not direct_path_chosen(sparse, "LeaderReplicaDistributionGoal")
+
+
+def test_optimizer_wires_density_into_megastep_config():
+    from cruise_control_tpu.analyzer.optimizer import (
+        _SPARSE_DIRECT_GOALS, GoalOptimizer,
+    )
+    from cruise_control_tpu.config.cruise_control_config import (
+        CruiseControlConfig,
+    )
+    opt = GoalOptimizer(CruiseControlConfig({
+        "solver.direct.assignment.enabled": True,
+        "solver.wide.batch.min.brokers": 8}))
+    dense = opt._megastep_config(12, density=3.0)
+    assert dense.direct_assignment and dense.direct_goals is None
+    sparse = opt._megastep_config(12, density=1.5)   # default threshold 2.0
+    assert sparse.direct_assignment
+    assert sparse.direct_goals == _SPARSE_DIRECT_GOALS
+    # density=None (non-model callers) skips the choice entirely.
+    assert opt._megastep_config(12).direct_goals is None
+    # Threshold 0 disables the choice even at sparse geometry.
+    off = GoalOptimizer(CruiseControlConfig({
+        "solver.direct.assignment.enabled": True,
+        "solver.wide.batch.min.brokers": 8,
+        "solver.direct.density.sparse.threshold": 0.0}))
+    assert off._megastep_config(12, density=0.5).direct_goals is None

@@ -186,6 +186,8 @@ def test_padded_chain_trajectory_byte_identical(bucket):
 
 # ---- registry + shared solver -------------------------------------------
 
+@pytest.mark.slow  # ~24 s: two full padded solves + compile counting;
+# the padded-trajectory and megabatch-routing pins below stay tier-1.
 def test_fleet_serves_both_clusters_through_shared_kernels(fleet):
     """Acceptance: a two-cluster fleet serves proposals for both clusters
     with total chain compilations <= distinct bucket shapes (not
@@ -276,6 +278,11 @@ def test_registry_lifecycle():
 
 def test_registry_state_reports_buckets(fleet):
     registry, _ = fleet
+    # The pad hook records an entry's bucket on model BUILD; build both
+    # models here (no solve) so this test stands alone — the shared-kernel
+    # acceptance test that used to populate the buckets is tier-2 slow.
+    for cid in ("alpha", "beta"):
+        registry.get(cid).load_monitor.cluster_model()
     body = registry.state()
     assert body["numClusters"] == 2
     assert set(body["clusters"]) == {"alpha", "beta"}
@@ -676,3 +683,135 @@ def test_megabatch_batch_failure_contained():
             assert eb.cc._proposal_cache is not None
     finally:
         registry.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Multi-replica control plane (round 23): N scheduler workers over one
+# shared queue/AOT cache, bucket-affinity placement, work stealing.
+
+def _counter(name):
+    from cruise_control_tpu.utils.sensors import SENSORS
+    return SENSORS._counters.get((name, ()), 0.0)
+
+
+def test_scheduler_from_config_reads_worker_count():
+    sched = FleetScheduler.from_config(
+        _base_config({"fleet.shard.workers": 3}))
+    assert sched._workers_n == 3
+    # Default stays a single replica — byte-identical control plane.
+    assert FleetScheduler.from_config(_base_config())._workers_n == 1
+
+
+def test_start_spawns_worker_replicas_and_gauge():
+    from cruise_control_tpu.utils.sensors import SENSORS
+    sched = FleetScheduler(starvation_bound_s=30.0, workers=2)
+    sched.start(pacer=False)
+    try:
+        names = sorted(t.name for t in sched._solvers)
+        assert names == ["fleet-solver-0", "fleet-solver-1"]
+        assert all(t.is_alive() for t in sched._solvers)
+        assert SENSORS._gauges.get(("fleet_shard_workers", ())) == 2.0
+        assert sched.submit("x", JobKind.ON_DEMAND,
+                            lambda: "ran").result(timeout=5) == "ran"
+    finally:
+        sched.shutdown()
+    assert not any(t.is_alive() for t in sched._solvers)
+
+
+def test_bucket_affinity_homes_then_prefers_home_worker():
+    """First pick homes the bucket; a later pick by the home worker is
+    an affinity hit; a DIFFERENT worker with its own work available
+    leaves the homed bucket alone even when the homed job is older."""
+    clock = _FakeClock()
+    sched = FleetScheduler(starvation_bound_s=1e9, clock=clock, workers=2)
+    order = []
+    k1, k2 = ("bucket", 16, 256), ("bucket", 24, 512)
+    # Home k1 on worker 0, k2 on worker 1.
+    sched.submit("A", JobKind.ON_DEMAND, lambda: order.append("a0"),
+                 batch_key=k1)
+    assert sched.run_pending(max_jobs=1, worker_id=0) == 1
+    sched.submit("B", JobKind.ON_DEMAND, lambda: order.append("b0"),
+                 batch_key=k2)
+    assert sched.run_pending(max_jobs=1, worker_id=1) == 1
+    assert sched._affinity == {k1: 0, k2: 1}
+    hits0 = _counter("fleet_shard_affinity_hits")
+    steals0 = _counter("fleet_shard_steals")
+    # Queue one job per bucket; the k2 job is OLDER (submitted first).
+    sched.submit("B", JobKind.ON_DEMAND, lambda: order.append("b1"),
+                 batch_key=k2)
+    sched.submit("A", JobKind.ON_DEMAND, lambda: order.append("a1"),
+                 batch_key=k1)
+    # Worker 0 skips B's older job (homed on 1) and serves its own.
+    assert sched.run_pending(max_jobs=1, worker_id=0) == 1
+    assert order[-1] == "a1"
+    assert sched.run_pending(max_jobs=1, worker_id=1) == 1
+    assert order[-1] == "b1"
+    assert _counter("fleet_shard_affinity_hits") == hits0 + 2
+    assert _counter("fleet_shard_steals") == steals0
+    assert sched._affinity == {k1: 0, k2: 1}
+
+
+def test_idle_worker_steals_and_rehomes_bucket():
+    """A worker with NO work of its own steals an affined-elsewhere job
+    instead of idling, and the steal re-homes the bucket on it (its
+    dispatch caches are now the warm ones)."""
+    clock = _FakeClock()
+    sched = FleetScheduler(starvation_bound_s=1e9, clock=clock, workers=2)
+    k = ("bucket", 16, 256)
+    sched.submit("A", JobKind.ON_DEMAND, lambda: None, batch_key=k)
+    sched.run_pending(max_jobs=1, worker_id=0)      # homed on 0
+    steals0 = _counter("fleet_shard_steals")
+    sched.submit("A", JobKind.ON_DEMAND, lambda: None, batch_key=k)
+    assert sched.run_pending(max_jobs=1, worker_id=1) == 1
+    assert _counter("fleet_shard_steals") == steals0 + 1
+    assert sched._affinity[k] == 1
+    # The new home now takes hits; the old home would steal back.
+    hits0 = _counter("fleet_shard_affinity_hits")
+    sched.submit("A", JobKind.ON_DEMAND, lambda: None, batch_key=k)
+    sched.run_pending(max_jobs=1, worker_id=1)
+    assert _counter("fleet_shard_affinity_hits") == hits0 + 1
+
+
+def test_starvation_bound_overrides_affinity():
+    """The starvation bound is a promise to the CLUSTER, not to a
+    worker: an overdue job runs on whichever worker sees it first, even
+    against affinity, and the steal re-homes its bucket."""
+    clock = _FakeClock()
+    sched = FleetScheduler(starvation_bound_s=10.0, clock=clock, workers=2)
+    k = ("bucket", 16, 256)
+    order = []
+    sched.submit("A", JobKind.ON_DEMAND, lambda: order.append("a0"),
+                 batch_key=k)
+    sched.run_pending(max_jobs=1, worker_id=0)      # homed on 0
+    sched.submit("A", JobKind.ON_DEMAND, lambda: order.append("a-old"),
+                 batch_key=k)
+    sched.submit("B", JobKind.SELF_HEALING, lambda: order.append("b-heal"))
+    clock.now += 11.0                                # A's job now overdue
+    steals0 = _counter("fleet_shard_steals")
+    assert sched.run_pending(max_jobs=1, worker_id=1) == 1
+    # Overdue beats both the higher-priority class AND the affinity.
+    assert order[-1] == "a-old"
+    assert _counter("fleet_shard_steals") == steals0 + 1
+    assert sched._affinity[k] == 1
+    sched.run_pending(worker_id=1)
+    assert order[-1] == "b-heal"
+
+
+def test_single_worker_scheduling_unchanged_by_affinity():
+    """workers=1 (the default): every bucket homes on worker 0 and the
+    pick order is byte-identical to the pre-round-23 scheduler —
+    affinity can only influence placement when there are replicas."""
+    clock = _FakeClock()
+    sched = FleetScheduler(starvation_bound_s=1e9, clock=clock)
+    order = []
+    steals0 = _counter("fleet_shard_steals")
+    sched.submit("A", JobKind.ON_DEMAND, lambda: order.append("a0"),
+                 batch_key=("k", 1))
+    sched.submit("B", JobKind.ON_DEMAND, lambda: order.append("b0"),
+                 batch_key=("k", 2))
+    sched.submit("A", JobKind.ON_DEMAND, lambda: order.append("a1"),
+                 batch_key=("k", 1))
+    assert sched.run_pending() == 3
+    assert order == ["a0", "b0", "a1"]
+    assert set(sched._affinity.values()) == {0}
+    assert _counter("fleet_shard_steals") == steals0

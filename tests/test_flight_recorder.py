@@ -389,6 +389,8 @@ def test_solver_endpoint_sensors_exported(solver_api):
     assert snap is None or snap["count"] >= 0  # series shape is valid
 
 
+@pytest.mark.slow  # ~22 s: real device trace capture via the endpoint;
+# the disabled-403 and microbench endpoint pins stay tier-1.
 def test_profile_endpoint_capture_and_busy(solver_api, tmp_path):
     solver_api._config._values["profiling.trace.dir"] = str(tmp_path)
     status, body, _ = solver_api.handle(
@@ -424,6 +426,7 @@ def test_profile_endpoint_disabled(solver_api):
         solver_api._config._values["profiling.enabled"] = True
 
 
+@pytest.mark.slow  # ~19 s: real concurrent device captures; tier-2.
 def test_profile_busy_error_concurrent_capture(tmp_path):
     """Two overlapping captures: exactly one wins the gate."""
     from cruise_control_tpu.utils.profiling import (

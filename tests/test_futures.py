@@ -126,6 +126,8 @@ def _scores(results) -> list[dict]:
     return [{"future": r.future_id, **r.score_dict()} for r in results]
 
 
+@pytest.mark.slow  # ~20 s: serial-vs-batched at two occupancies; the
+# batched-vs-serial decision parity stays tier-1 via the ranking tests.
 def test_batched_matches_serial_at_two_occupancies_one_program(prepared_set):
     from cruise_control_tpu.analyzer.chain import megabatch_optimize_rounds
     prepared, optimizer = prepared_set

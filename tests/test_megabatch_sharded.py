@@ -1,0 +1,276 @@
+"""Device-sharded megabatch (round 23): byte parity of the shard_map
+twins against the single-device batched kernels, pad-slot freezing on
+the sharded cluster axis, compile accounting (one program per (bucket
+shape, mesh)), and the chain-layer goal loop routed through a mesh.
+
+Runs on the 8-device virtual CPU platform from conftest.py: the mesh
+here is 4 devices x 2 cluster slots each, so every test exercises a
+REAL sharded cluster axis with per-device early exit."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.chain import (
+    AdaptiveDispatch, MegastepConfig, inert_state_like,
+    megabatch_all_goal_stats, megabatch_goal_stats,
+    megabatch_optimize_rounds, megabatch_swap_rounds,
+    optimize_goal_in_chain_megabatch, stack_states, unstack_state,
+)
+from cruise_control_tpu.analyzer.direct import megabatch_direct_rounds
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.goals import (
+    NetworkOutboundUsageDistributionGoal, RackAwareGoal,
+    ReplicaDistributionGoal,
+)
+from cruise_control_tpu.analyzer.search import ExclusionMasks, SearchConfig
+from cruise_control_tpu.parallel.megabatch_sharded import (
+    _make_move_kernels, megabatch_all_goal_stats_sharded,
+    megabatch_direct_rounds_donated_sharded, megabatch_direct_rounds_sharded,
+    megabatch_goal_stats_sharded, megabatch_optimize_rounds_donated_sharded,
+    megabatch_optimize_rounds_sharded, megabatch_swap_rounds_sharded,
+    shard_megabatch, shard_megabatch_masks,
+)
+from cruise_control_tpu.parallel.mesh import make_mesh
+from cruise_control_tpu.model.fixtures import random_cluster
+
+CONSTRAINT = BalancingConstraint()
+CFG = SearchConfig(num_sources=8, num_dests=4, moves_per_round=8,
+                   max_rounds=12)
+GOALS = (RackAwareGoal(), ReplicaDistributionGoal())
+MASKS = ExclusionMasks()
+NUM_TOPICS = 4
+WIDTH = 8  # 4 devices x 2 cluster slots
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 4, "conftest must provide virtual devices"
+    return make_mesh(4)
+
+
+def _batch(num_partitions, partition_bucket, n_real):
+    """WIDTH-slot megabatch: n_real skewed clusters + inert pad slots,
+    plus the host-side active/real masks."""
+    states = [random_cluster(num_brokers=6, num_topics=NUM_TOPICS,
+                             num_partitions=num_partitions, rf=2,
+                             num_racks=2, seed=3 + i, skew_to_first=2.0,
+                             partition_bucket=partition_bucket)[0]
+              for i in range(n_real)]
+    states += [inert_state_like(states[0])] * (WIDTH - n_real)
+    real = np.arange(WIDTH) < n_real
+    return stack_states(states), jnp.asarray(real), real
+
+
+def _assert_state_equal(a, b):
+    for f in dataclasses.fields(a):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f.name)), np.asarray(getattr(b, f.name)),
+            err_msg=f.name)
+
+
+# Two bucket shapes x {full, partial} occupancy — the ISSUE-20 parity
+# grid. Partial occupancy (inert pad slots, active mask off) must leave
+# the pads byte-frozen THROUGH the sharded program.
+@pytest.mark.parametrize("shape", [(24, 32), (100, 128)],
+                         ids=["bucket32", "bucket128"])
+@pytest.mark.parametrize("n_real", [WIDTH, WIDTH - 3],
+                         ids=["full", "partial"])
+def test_sharded_move_rounds_byte_identical(mesh, shape, n_real):
+    npart, bucket = shape
+    batched, active, real = _batch(npart, bucket, n_real)
+    idx = jnp.int32(1)           # ReplicaDistribution under RackAware
+    prior = jnp.asarray([True, False])
+    budget = jnp.int32(12)
+
+    ref, rt, rr, ra = megabatch_optimize_rounds(
+        batched, active, idx, prior, GOALS, CONSTRAINT, CFG, NUM_TOPICS,
+        MASKS, budget)
+    out, ot, orr, oa = megabatch_optimize_rounds_sharded(
+        mesh, shard_megabatch(batched, mesh), active, idx, prior, GOALS,
+        CONSTRAINT, CFG, NUM_TOPICS, shard_megabatch_masks(MASKS, mesh),
+        budget)
+
+    _assert_state_equal(jax.device_get(out), jax.device_get(ref))
+    np.testing.assert_array_equal(np.asarray(ot), np.asarray(rt))
+    np.testing.assert_array_equal(np.asarray(orr), np.asarray(rr))
+    np.testing.assert_array_equal(np.asarray(oa), np.asarray(ra))
+    assert np.asarray(rt)[real].sum() > 0, "no moves — test is vacuous"
+    # Pad slots byte-frozen through the sharded program.
+    for s in np.flatnonzero(~real):
+        _assert_state_equal(unstack_state(jax.device_get(out), int(s)),
+                            unstack_state(jax.device_get(batched), int(s)))
+        assert int(np.asarray(ot)[s]) == 0 and int(np.asarray(orr)[s]) == 0
+
+
+def test_sharded_donated_matches_plain(mesh):
+    """CCSA002 on the mesh: the donated twin (separately-donated sharded
+    {assignment, leader_slot} + read-only zero-row rest) lands on the
+    same bytes as the plain sharded kernel."""
+    batched, active, _real = _batch(24, 32, WIDTH)
+    idx, prior, budget = jnp.int32(1), jnp.asarray([True, False]), \
+        jnp.int32(12)
+    sb = shard_megabatch(batched, mesh)
+    sm = shard_megabatch_masks(MASKS, mesh)
+    ref, rt, _rr, _ra = megabatch_optimize_rounds_sharded(
+        mesh, sb, active, idx, prior, GOALS, CONSTRAINT, CFG, NUM_TOPICS,
+        sm, budget)
+    rest = dataclasses.replace(
+        sb, assignment=jnp.zeros((WIDTH, 0, sb.assignment.shape[2]),
+                                 sb.assignment.dtype),
+        leader_slot=jnp.zeros((WIDTH, 0), sb.leader_slot.dtype))
+    a, l, dt, _dr, _da = megabatch_optimize_rounds_donated_sharded(
+        mesh, jnp.copy(sb.assignment), jnp.copy(sb.leader_slot), rest,
+        active, idx, prior, GOALS, CONSTRAINT, CFG, NUM_TOPICS, sm, budget)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(ref.assignment))
+    np.testing.assert_array_equal(np.asarray(l),
+                                  np.asarray(ref.leader_slot))
+    np.testing.assert_array_equal(np.asarray(dt), np.asarray(rt))
+
+
+def test_sharded_swap_rounds_byte_identical(mesh):
+    batched, active, _real = _batch(24, 32, WIDTH)
+    goals = (NetworkOutboundUsageDistributionGoal(),)
+    idx, prior, budget = jnp.int32(0), jnp.asarray([False]), jnp.int32(8)
+    ref, rt, rr, ra = megabatch_swap_rounds(
+        batched, active, idx, prior, goals, CONSTRAINT, NUM_TOPICS, MASKS,
+        8, 64, budget)
+    out, ot, orr, oa = megabatch_swap_rounds_sharded(
+        mesh, shard_megabatch(batched, mesh), active, idx, prior, goals,
+        CONSTRAINT, NUM_TOPICS, shard_megabatch_masks(MASKS, mesh), 8, 64,
+        budget)
+    _assert_state_equal(jax.device_get(out), jax.device_get(ref))
+    np.testing.assert_array_equal(np.asarray(ot), np.asarray(rt))
+    np.testing.assert_array_equal(np.asarray(orr), np.asarray(rr))
+    np.testing.assert_array_equal(np.asarray(oa), np.asarray(ra))
+
+
+def test_sharded_direct_rounds_byte_identical(mesh):
+    """The direct-transport twin, including its deterministic rounding
+    PRNG: same seed, same plan, same bytes across the mesh split."""
+    batched, active, _real = _batch(100, 128, WIDTH)
+    goals = (ReplicaDistributionGoal(),)
+    ref, rt, rs, ra = megabatch_direct_rounds(
+        batched, active, goals, 0, CONSTRAINT, NUM_TOPICS, MASKS)
+    sb = shard_megabatch(batched, mesh)
+    sm = shard_megabatch_masks(MASKS, mesh)
+    out, ot, os_, oa = megabatch_direct_rounds_sharded(
+        mesh, sb, active, goals, 0, CONSTRAINT, NUM_TOPICS, sm)
+    _assert_state_equal(jax.device_get(out), jax.device_get(ref))
+    np.testing.assert_array_equal(np.asarray(ot), np.asarray(rt))
+    np.testing.assert_array_equal(np.asarray(os_), np.asarray(rs))
+    rest = dataclasses.replace(
+        sb, assignment=jnp.zeros((WIDTH, 0, sb.assignment.shape[2]),
+                                 sb.assignment.dtype),
+        leader_slot=jnp.zeros((WIDTH, 0), sb.leader_slot.dtype))
+    a, l, dt, _ds, _da = megabatch_direct_rounds_donated_sharded(
+        mesh, jnp.copy(sb.assignment), jnp.copy(sb.leader_slot), rest,
+        active, goals, 0, CONSTRAINT, NUM_TOPICS, sm)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(ref.assignment))
+    np.testing.assert_array_equal(np.asarray(dt), np.asarray(rt))
+
+
+def test_sharded_stats_byte_identical(mesh):
+    batched, active, _real = _batch(24, 32, WIDTH - 2)
+    sb = shard_megabatch(batched, mesh)
+    sm = shard_megabatch_masks(MASKS, mesh)
+    v1, o1, f1 = megabatch_goal_stats(batched, jnp.int32(1), GOALS,
+                                      CONSTRAINT, NUM_TOPICS, MASKS)
+    v2, o2, f2 = megabatch_goal_stats_sharded(mesh, sb, jnp.int32(1),
+                                              GOALS, CONSTRAINT,
+                                              NUM_TOPICS, sm)
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2))
+    a1 = megabatch_all_goal_stats(batched, GOALS, CONSTRAINT, NUM_TOPICS,
+                                  MASKS)
+    a2 = megabatch_all_goal_stats_sharded(mesh, sb, GOALS, CONSTRAINT,
+                                          NUM_TOPICS, sm)
+    for x, y in zip(a1, a2):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_one_compiled_program_per_shape_and_mesh(mesh):
+    """Compile accounting: re-running the sharded move kernel on new
+    DATA at a known (bucket shape, mesh) adds no compilation; a new
+    bucket shape adds exactly one; the kernel factory itself is cached
+    per (mesh, chain config)."""
+    move, _ = _make_move_kernels(mesh, GOALS, CONSTRAINT, CFG, NUM_TOPICS,
+                                 (False, False, False), 0)
+    move2, _ = _make_move_kernels(mesh, GOALS, CONSTRAINT, CFG, NUM_TOPICS,
+                                  (False, False, False), 0)
+    assert move is move2, "factory must be cached per (mesh, config)"
+
+    idx, prior, budget = jnp.int32(1), jnp.asarray([True, False]), \
+        jnp.int32(4)
+
+    def run(npart, bucket, seed_base):
+        states = [random_cluster(num_brokers=6, num_topics=NUM_TOPICS,
+                                 num_partitions=npart, rf=2, num_racks=2,
+                                 seed=seed_base + i, skew_to_first=2.0,
+                                 partition_bucket=bucket)[0]
+                  for i in range(WIDTH)]
+        sb = shard_megabatch(stack_states(states), mesh)
+        sm = shard_megabatch_masks(MASKS, mesh)
+        out = move(sb, jnp.ones(WIDTH, bool), sm, idx, prior, budget)
+        jax.block_until_ready(out[0].assignment)
+
+    # Bucket shapes no other test in this module touches, so the deltas
+    # are exact regardless of suite order (the factory's lru_cache
+    # shares one jit object module-wide).
+    run(40, 64, 3)
+    n0 = move._cache_size()
+    run(40, 64, 101)             # same shape, different clusters
+    assert move._cache_size() == n0
+    run(200, 256, 3)             # new bucket shape
+    assert move._cache_size() == n0 + 1
+
+
+def test_shard_megabatch_rejects_indivisible_width(mesh):
+    states = [random_cluster(num_brokers=6, num_topics=NUM_TOPICS,
+                             num_partitions=24, rf=2, num_racks=2,
+                             seed=3 + i, partition_bucket=32)[0]
+              for i in range(6)]  # 6 % 4 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        shard_megabatch(stack_states(states), mesh)
+
+
+def test_chain_goal_loop_mesh_matches_single_device(mesh):
+    """The chain layer's megabatch goal loop (pump, donation guard,
+    per-cluster infos) routed through ``mesh=`` lands byte-identical to
+    ``mesh=None`` — the production parity contract the --fleet-shard
+    stage pins at scale."""
+    batched, active_mask, real = _batch(24, 32, WIDTH - 1)
+    chain = GOALS
+    mega = MegastepConfig(donate=True, async_readback=True,
+                          deficit_moves_cap=0)
+
+    def run(m):
+        st = batched
+        bmasks = MASKS
+        if m is not None:
+            st = shard_megabatch(st, m)
+            bmasks = shard_megabatch_masks(MASKS, m)
+        infos_all = []
+        ran = False
+        for i in range(len(chain)):
+            st, infos = optimize_goal_in_chain_megabatch(
+                st, chain, i, CONSTRAINT, CFG, NUM_TOPICS, bmasks,
+                np.asarray(real), dispatch_rounds=6,
+                dispatch=AdaptiveDispatch(6, 0.0), megastep=mega,
+                donate_input=ran, mesh=m)
+            ran = ran or any(x["rounds"] > 0 for x in infos)
+            infos_all.append(infos)
+        return jax.device_get(st), infos_all
+
+    ref, ref_infos = run(None)
+    out, out_infos = run(mesh)
+    _assert_state_equal(out, ref)
+    for gi_ref, gi_out in zip(ref_infos, out_infos):
+        for a, b in zip(gi_ref, gi_out):
+            assert (a["goal"], a["rounds"], a["moves_applied"]) == \
+                (b["goal"], b["rounds"], b["moves_applied"])
+    assert sum(x["moves_applied"] for g in ref_infos for x in g) > 0

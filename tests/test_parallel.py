@@ -199,6 +199,8 @@ def test_sharded_full_chain_matches_single_device_outcome(mesh, cluster):
     assert float(viol.sum()) <= 1e-6
 
 
+@pytest.mark.slow  # ~18 s: bounded-vs-fused trajectory sweep; the
+# full-chain mesh-vs-single-device pin stays tier-1.
 def test_sharded_bounded_dispatch_matches_fused(mesh, cluster):
     """The bounded per-goal sharded driver (dispatch_rounds > 0) must walk
     the IDENTICAL trajectory to the fused whole-chain mesh kernel — same

@@ -95,7 +95,10 @@ def test_fault_reorder_permutes_fault_ticks_only():
 # Miner determinism: one sweep seed ⇒ byte-identical frontier JSON
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("sweep_seed", [0, 7])
+@pytest.mark.parametrize(
+    "sweep_seed",
+    # Seed 0 (~17 s) is tier-2; seed 7 keeps the byte-pin tier-1.
+    [pytest.param(0, marks=pytest.mark.slow), 7])
 def test_frontier_json_byte_identical_per_sweep_seed(sweep_seed,
                                                      shared_optimizer):
     lib = {"stub_scenario": 0.25}

@@ -132,6 +132,8 @@ def test_fingerprint_skip_megabatch_parity():
         assert ra.violated_goals_after == rb.violated_goals_after
 
 
+@pytest.mark.slow  # ~18 s: full 5-tuple megabatch warm solve; the
+# fingerprint-skip megabatch parity pin stays tier-1.
 def test_megabatch_warm_item_diffs_and_reports_from_true_initial():
     """A 5-tuple megabatch item (warm-seeded state + true initial)
     solves from the seed but reports proposals AND the before picture

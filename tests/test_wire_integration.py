@@ -552,6 +552,8 @@ def test_codec_fuzz_roundtrips():
                 assert decode(codec, encode(codec, v)) == v, (api.key, v)
 
 
+@pytest.mark.slow  # ~17 s: full live-mode stack over real wire bytes;
+# the per-API codec roundtrips above keep tier-1 wire coverage.
 def test_full_stack_live_mode_against_embedded_cluster():
     """The COMPLETE live-mode story over real wire bytes: broker-side
     reporter agents produce metrics to the embedded cluster's
